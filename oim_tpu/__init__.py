@@ -1,0 +1,22 @@
+"""oim_tpu — a TPU-native infrastructure-management framework.
+
+A registry → controller → CSI-driver control plane that attaches TPU slices to
+Kubernetes pods (capability parity with intel/oim, which attaches SPDK block
+devices; see SURVEY.md), plus a JAX/XLA compute path (mesh construction,
+DP/TP/SP/PP/EP shardings, ring attention, pallas kernels, a flagship model)
+that runs on the provisioned slices.
+
+Layer map (bottom → top), mirroring /root/reference layers 0-8:
+  native/tpu-agent      C++ device-plane daemon (≙ SPDK vhost)
+  oim_tpu.agent         JSON-RPC client + typed wrappers (≙ pkg/spdk)
+  oim_tpu.controller    per-device controller gRPC service (≙ pkg/oim-controller)
+  oim_tpu.registry      KV + transparent gRPC proxy (≙ pkg/oim-registry)
+  oim_tpu.csi           CSI driver, local/remote backends (≙ pkg/oim-csi-driver)
+  oim_tpu.common        shared infra (≙ pkg/oim-common)
+  oim_tpu.log           context-carried structured logging (≙ pkg/log)
+  oim_tpu.spec          wire spec + generated protobuf bindings (≙ pkg/spec)
+  oim_tpu.cli           binaries (≙ cmd/*)
+  oim_tpu.parallel/ops/models   the JAX compute path running ON provisioned slices
+"""
+
+__version__ = "0.1.0"

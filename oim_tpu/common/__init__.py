@@ -1,0 +1,1 @@
+"""Shared control-plane infrastructure (≙ reference pkg/oim-common)."""

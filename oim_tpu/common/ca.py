@@ -1,0 +1,122 @@
+"""Certificate-authority utility for the mTLS control mesh.
+
+The reference drives its CN-based authorization model from a shell script
+(`test/setup-ca.sh`, invoked at reference test/test.make:188-191) producing a
+CA plus per-component certs named ``component.registry``, ``controller.<id>``,
+``host.<id>``, ``user.admin``.  Here the same capability is library code (used
+by tests, the demo cluster and deploy bootstrap) built on ``cryptography``.
+
+Naming convention (≙ reference README.md:84-120):
+  component.registry   the registry server
+  controller.<id>      a controller (may SetValue only its own address)
+  host.<id>            a CSI node agent (may proxy only to controller.<id>)
+  user.admin           operator; may SetValue anything
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from dataclasses import dataclass
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+
+@dataclass
+class Credential:
+    cert_pem: bytes
+    key_pem: bytes
+
+
+def _key() -> ec.EllipticCurvePrivateKey:
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def _key_pem(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+
+
+class CertAuthority:
+    """An in-memory CA that issues component certificates."""
+
+    def __init__(self, name: str = "OIM TPU CA") -> None:
+        self.name = name
+        self._key = _key()
+        subject = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, name)])
+        now = datetime.datetime.now(datetime.timezone.utc)
+        self._cert = (
+            x509.CertificateBuilder()
+            .subject_name(subject)
+            .issuer_name(subject)
+            .public_key(self._key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=3650))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None), True)
+            .sign(self._key, hashes.SHA256())
+        )
+
+    @property
+    def ca_pem(self) -> bytes:
+        return self._cert.public_bytes(serialization.Encoding.PEM)
+
+    def issue(
+        self,
+        common_name: str,
+        dns_names: tuple[str, ...] = (),
+        ip_addresses: tuple[str, ...] = ("127.0.0.1",),
+    ) -> Credential:
+        """Issue a cert whose CN and SAN carry ``common_name``.
+
+        The SAN always includes the CN itself as a DNS name so clients can pin
+        the peer via TLS server-name override (the reference pins ServerName to
+        the expected CN, pkg/oim-common/grpc.go:77-101); localhost + loopback
+        are included for tests and same-host deployments.
+        """
+        import ipaddress
+
+        key = _key()
+        now = datetime.datetime.now(datetime.timezone.utc)
+        sans: list[x509.GeneralName] = [x509.DNSName(common_name)]
+        sans += [x509.DNSName(d) for d in dns_names if d != common_name]
+        if "localhost" not in (common_name, *dns_names):
+            sans.append(x509.DNSName("localhost"))
+        sans += [x509.IPAddress(ipaddress.ip_address(i)) for i in ip_addresses]
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(
+                x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+            )
+            .issuer_name(self._cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .add_extension(x509.SubjectAlternativeName(sans), False)
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None), True)
+            .sign(self._key, hashes.SHA256())
+        )
+        return Credential(
+            cert_pem=cert.public_bytes(serialization.Encoding.PEM),
+            key_pem=_key_pem(key),
+        )
+
+    def write_tree(self, directory: str, names: list[str]) -> None:
+        """Write ``ca.crt`` plus ``<name>.crt``/``<name>.key`` per component,
+        the on-disk layout the reference's setup-ca.sh produces in ``_work/ca``."""
+        os.makedirs(directory, exist_ok=True)
+        with open(os.path.join(directory, "ca.crt"), "wb") as f:
+            f.write(self.ca_pem)
+        for name in names:
+            cred = self.issue(name)
+            with open(os.path.join(directory, f"{name}.crt"), "wb") as f:
+                f.write(cred.cert_pem)
+            with open(os.path.join(directory, f"{name}.key"), "wb") as f:
+                f.write(cred.key_pem)

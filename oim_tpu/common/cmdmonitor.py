@@ -1,0 +1,59 @@
+"""Child-process death detection via an inherited pipe.
+
+≙ reference pkg/oim-common/cmdmonitor.go:14-51: the parent creates a pipe and
+passes the write end to the child; because the child never writes, the read
+end sees EOF exactly when every holder of the write end (i.e. the child and
+any of its descendants that inherited it) has exited — detecting death without
+reaping and regardless of who the child's parent is.
+"""
+
+from __future__ import annotations
+
+import os
+import select
+import threading
+from typing import Callable
+
+
+class CmdMonitor:
+    def __init__(self) -> None:
+        self._r, self._w = os.pipe()
+        os.set_inheritable(self._w, True)
+        self._closed = False
+
+    @property
+    def child_fd(self) -> int:
+        """Pass this in ``subprocess.Popen(..., pass_fds=[monitor.child_fd])``."""
+        return self._w
+
+    def after_spawn(self) -> None:
+        """Close the parent's copy of the write end; must be called once the
+        child has been spawned, otherwise EOF never arrives."""
+        if not self._closed:
+            os.close(self._w)
+            self._closed = True
+
+    def dead(self, timeout: float = 0.0) -> bool:
+        """True once the child (and inheritors) have exited."""
+        r, _, _ = select.select([self._r], [], [], timeout)
+        if not r:
+            return False
+        return os.read(self._r, 1) == b""
+
+    def watch(self, callback: Callable[[], None]) -> threading.Thread:
+        """Invoke ``callback`` from a daemon thread when the child dies."""
+
+        def run() -> None:
+            while True:
+                r, _, _ = select.select([self._r], [], [], None)
+                if r and os.read(self._r, 1) == b"":
+                    callback()
+                    return
+
+        t = threading.Thread(target=run, daemon=True, name="cmdmonitor")
+        t.start()
+        return t
+
+    def close(self) -> None:
+        self.after_spawn()
+        os.close(self._r)

@@ -1,0 +1,52 @@
+"""Endpoint parsing for ``(unix|tcp|tcp4|tcp6)://`` addresses.
+
+≙ reference pkg/oim-common/server.go:28-40 (``ParseEndpoint``), adapted to the
+address syntaxes grpc-python expects (``unix:/path`` and ``host:port``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+_SCHEMES = ("unix", "tcp", "tcp4", "tcp6")
+
+
+@dataclass(frozen=True)
+class Endpoint:
+    scheme: str  # unix | tcp | tcp4 | tcp6
+    address: str  # filesystem path for unix, host:port for tcp
+
+    @property
+    def is_unix(self) -> bool:
+        return self.scheme == "unix"
+
+    def grpc_target(self) -> str:
+        """Channel target string for grpc.*_channel."""
+        if self.is_unix:
+            return f"unix:{self.address}"
+        return self.address
+
+    def grpc_listen(self) -> str:
+        """Listen address for grpc.Server.add_*_port."""
+        if self.is_unix:
+            return f"unix:{self.address}"
+        return self.address
+
+    def __str__(self) -> str:
+        return f"{self.scheme}://{self.address}"
+
+
+def parse(endpoint: str) -> Endpoint:
+    for scheme in _SCHEMES:
+        prefix = scheme + "://"
+        if endpoint.startswith(prefix):
+            address = endpoint[len(prefix) :]
+            if not address:
+                raise ValueError(f"empty address in endpoint {endpoint!r}")
+            return Endpoint(scheme, address)
+    if "://" in endpoint:
+        raise ValueError(f"unsupported endpoint scheme in {endpoint!r}")
+    if not endpoint:
+        raise ValueError("empty endpoint")
+    # Bare host:port defaults to tcp, mirroring the reference's tolerance.
+    return Endpoint("tcp", endpoint)

@@ -1,0 +1,194 @@
+"""gRPC server interceptors: payload logging and peer-CN enforcement.
+
+≙ reference pkg/oim-common/tracing.go:29-148 (``LogGRPCServer`` with pluggable
+payload formatters incl. secret stripping) and grpc.go:102-125 (server-side
+expected-peer verification).  Handlers run with a context logger tagged with
+the gRPC method so nested calls show causality (≙ tracing.go:134-140).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import grpc
+
+from oim_tpu import log
+from oim_tpu.common.tlsconfig import peer_common_name
+
+# ---------------------------------------------------------------------------
+# Payload formatters (≙ CompletePayloadFormatter / StripSecretsFormatter)
+
+_SECRET_FIELD_NAMES = ("secret", "passphrase", "password", "credential")
+
+
+def _is_secret_field(name: str) -> bool:
+    lowered = name.lower()
+    return any(s in lowered for s in _SECRET_FIELD_NAMES)
+
+
+def complete_formatter(msg) -> str:
+    """Log the full payload."""
+    try:
+        return _format_msg(msg, strip=False)
+    except Exception:
+        return repr(msg)
+
+
+def strip_secrets_formatter(msg) -> str:
+    """Log payloads with secret-ish fields redacted (≙ protosanitizer use)."""
+    try:
+        return _format_msg(msg, strip=True)
+    except Exception:
+        return f"<{type(msg).__name__}>"
+
+
+def null_formatter(msg) -> str:
+    return f"<{type(msg).__name__}>"
+
+
+def _format_msg(msg, strip: bool) -> str:
+    if not hasattr(msg, "DESCRIPTOR"):
+        return repr(msg)
+    parts = []
+    for fd, value in msg.ListFields():
+        if strip and _is_secret_field(fd.name):
+            parts.append(f"{fd.name}=***stripped***")
+        elif fd.type == fd.TYPE_MESSAGE:
+            if fd.label == fd.LABEL_REPEATED:
+                parts.append(
+                    f"{fd.name}=[{', '.join(_format_msg(v, strip) for v in value)}]"
+                )
+            else:
+                parts.append(f"{fd.name}={_format_msg(value, strip)}")
+        else:
+            parts.append(f"{fd.name}={value!r}")
+    return f"{type(msg).__name__}({', '.join(parts)})"
+
+
+# ---------------------------------------------------------------------------
+# Server interceptors.
+#
+# grpc-python interceptors only see call details, not the ServicerContext, so
+# both logging and peer checks wrap the *behavior* function where the context
+# (and thus the TLS auth info) is available.
+
+
+def _wrap_handler(handler: grpc.RpcMethodHandler, wrap: Callable):
+    if handler is None:
+        return None
+    if handler.unary_unary:
+        return grpc.unary_unary_rpc_method_handler(
+            wrap(handler.unary_unary),
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+    if handler.unary_stream:
+        return grpc.unary_stream_rpc_method_handler(
+            wrap(handler.unary_stream),
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+    if handler.stream_unary:
+        return grpc.stream_unary_rpc_method_handler(
+            wrap(handler.stream_unary),
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+    return grpc.stream_stream_rpc_method_handler(
+        wrap(handler.stream_stream),
+        request_deserializer=handler.request_deserializer,
+        response_serializer=handler.response_serializer,
+    )
+
+
+class LogServerInterceptor(grpc.ServerInterceptor):
+    """Logs every call with the configured payload formatter and binds the
+    context logger with the method name for the duration of the handler."""
+
+    def __init__(self, formatter: Callable = strip_secrets_formatter) -> None:
+        self.formatter = formatter
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None:
+            return None
+        method = handler_call_details.method
+        fmt = self.formatter
+
+        streams_response = bool(handler.unary_stream or handler.stream_stream)
+
+        def log_request(logger, request_or_iterator):
+            if hasattr(request_or_iterator, "DESCRIPTOR"):
+                logger.debug("request", payload=fmt(request_or_iterator))
+            else:
+                logger.debug("request", payload=f"<{type(request_or_iterator).__name__}>")
+
+        def wrap(behavior):
+            if streams_response:
+                # The behavior returns a generator that gRPC drains *after*
+                # the call below returns, so the method-tagged context and
+                # error capture must live for the whole iteration.
+                def wrapped_stream(request_or_iterator, context):
+                    with log.with_fields(method=method):
+                        logger = log.current()
+                        log_request(logger, request_or_iterator)
+                        try:
+                            yield from behavior(request_or_iterator, context)
+                        except grpc.RpcError:
+                            raise
+                        except Exception as exc:
+                            logger.error("handler failed", error=str(exc))
+                            raise
+
+                return wrapped_stream
+
+            def wrapped(request_or_iterator, context):
+                with log.with_fields(method=method):
+                    logger = log.current()
+                    log_request(logger, request_or_iterator)
+                    try:
+                        response = behavior(request_or_iterator, context)
+                    except grpc.RpcError:
+                        raise
+                    except Exception as exc:
+                        logger.error("handler failed", error=str(exc))
+                        raise
+                    if hasattr(response, "DESCRIPTOR"):
+                        logger.debug("response", payload=fmt(response))
+                    return response
+
+            return wrapped
+
+        return _wrap_handler(handler, wrap)
+
+
+class PeerCheckInterceptor(grpc.ServerInterceptor):
+    """Rejects calls whose client CN differs from the expected one.
+
+    ≙ the reference's server-side ``VerifyPeerCertificate`` pinning (reference
+    pkg/oim-common/grpc.go:102-125): a controller only accepts the registry
+    (CN ``component.registry``) as a client.
+    """
+
+    def __init__(self, expected_cn: str) -> None:
+        self.expected_cn = expected_cn
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or not self.expected_cn:
+            return handler
+        expected = self.expected_cn
+
+        def wrap(behavior):
+            def wrapped(request_or_iterator, context):
+                cn = peer_common_name(context)
+                if cn != expected:
+                    context.abort(
+                        grpc.StatusCode.UNAUTHENTICATED,
+                        f"expected peer {expected!r}, got {cn!r}",
+                    )
+                return behavior(request_or_iterator, context)
+
+            return wrapped
+
+        return _wrap_handler(handler, wrap)

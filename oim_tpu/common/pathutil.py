@@ -1,0 +1,28 @@
+"""Registry path sanitation (≙ reference pkg/oim-common/path.go:23-38).
+
+Registry keys are ``/``-separated paths like ``controller-1/address``.  Path
+elements may contain only ``[a-zA-Z0-9._-]`` and may not be empty, ``.`` or
+``..``; leading/trailing/duplicate slashes are normalized away.
+"""
+
+from __future__ import annotations
+
+import re
+
+_ELEMENT_RE = re.compile(r"^[a-zA-Z0-9._-]+$")
+
+
+def clean_path(path: str) -> str:
+    elements = [e for e in path.split("/") if e != ""]
+    if not elements:
+        raise ValueError("empty registry path")
+    for e in elements:
+        if e in (".", ".."):
+            raise ValueError(f"invalid registry path element {e!r} in {path!r}")
+        if not _ELEMENT_RE.match(e):
+            raise ValueError(f"invalid characters in registry path element {e!r}")
+    return "/".join(elements)
+
+
+def split_path(path: str) -> list[str]:
+    return clean_path(path).split("/")

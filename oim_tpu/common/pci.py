@@ -1,0 +1,87 @@
+"""PCI BDF parsing / merging / pretty-printing.
+
+Capability mirror of reference pkg/oim-common/pci.go:19-91: BDF strings in the
+form ``[[domain:]bus:]device.function`` with hex components; any component may
+be "unknown", encoded as 0xFFFF (no real component can reach it — domain is 16
+bits in sysfs but 0xFFFF is reserved here, like the reference). TPU chips show
+up under the same sysfs PCI namespace (/dev/accelN ↔ 0000:xx:00.0), so the
+type is reused unchanged; ``merge`` implements the registry-default completion
+trick (``CompletePCIAddress``, reference pkg/oim-csi-driver/remote.go:170-190).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+UNKNOWN = 0xFFFF
+
+_BDF_RE = re.compile(
+    r"^(?:(?:(?P<domain>[0-9a-fA-F]{1,4}):)?(?P<bus>[0-9a-fA-F]{1,4}):)?"
+    r"(?P<device>[0-9a-fA-F]{1,4})\.(?P<function>[0-9a-fA-F]{1,4})$"
+)
+
+
+@dataclass(frozen=True)
+class PCIAddress:
+    domain: int = UNKNOWN
+    bus: int = UNKNOWN
+    device: int = UNKNOWN
+    function: int = UNKNOWN
+
+    def __str__(self) -> str:
+        def c(v: int, width: int) -> str:
+            return "*" * width if v == UNKNOWN else f"{v:0{width}x}"
+
+        return (
+            f"{c(self.domain, 4)}:{c(self.bus, 2)}:"
+            f"{c(self.device, 2)}.{c(self.function, 1)}"
+        )
+
+    def complete(self) -> bool:
+        return UNKNOWN not in (self.domain, self.bus, self.device, self.function)
+
+
+def parse_bdf_string(s: str) -> PCIAddress:
+    """Parse ``[[domain:]bus:]device.function``; missing parts are UNKNOWN.
+
+    ≙ ``ParseBDFString`` (reference pkg/oim-common/pci.go:19-58).
+    """
+    m = _BDF_RE.match(s.strip())
+    if not m:
+        raise ValueError(f"invalid PCI BDF {s!r}")
+
+    def g(name: str, width: int) -> int:
+        v = m.group(name)
+        if v is None:
+            return UNKNOWN
+        value = int(v, 16)
+        # Range-check explicit components before the UNKNOWN encoding kicks
+        # in: an explicit "ffff" bus/device/function is a typo, not a
+        # wildcard.  Only the 16-bit domain reserves 0xFFFF as unknown.
+        if value >= (1 << width) or (width < 16 and value == UNKNOWN):
+            raise ValueError(f"PCI BDF component {name}={v!r} out of range in {s!r}")
+        return value
+
+    return PCIAddress(
+        g("domain", 16), g("bus", 8), g("device", 8), g("function", 8)
+    )
+
+
+def merge(primary: PCIAddress, fallback: PCIAddress) -> PCIAddress:
+    """Fill UNKNOWN components of ``primary`` from ``fallback``.
+
+    ≙ the registry-default merging in ``CompletePCIAddress`` (reference
+    pkg/oim-csi-driver/remote.go:170-190): the controller reply may carry a
+    partial address that the registry's ``<id>/pci`` default completes.
+    """
+
+    def pick(a: int, b: int) -> int:
+        return b if a == UNKNOWN else a
+
+    return PCIAddress(
+        pick(primary.domain, fallback.domain),
+        pick(primary.bus, fallback.bus),
+        pick(primary.device, fallback.device),
+        pick(primary.function, fallback.function),
+    )

@@ -1,0 +1,113 @@
+"""Non-blocking gRPC server wrapper.
+
+≙ reference pkg/oim-common/server.go:43-137 (``NonBlockingGRPCServer``):
+start/wait/stop/force-stop lifecycle around a grpc server bound to a parsed
+``unix://``/``tcp://`` endpoint, with ``addr()`` reporting the actual bound
+address so tests can listen on ``tcp://127.0.0.1:0`` and discover the port.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent import futures
+from typing import Callable
+
+import grpc
+
+from oim_tpu.common import endpoint as ep
+from oim_tpu.common.tlsconfig import TLSConfig
+from oim_tpu import log
+
+Registrar = Callable[[grpc.Server], None]
+
+
+def _unix_socket_alive(path: str) -> bool:
+    import socket as _socket
+
+    s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    try:
+        s.settimeout(0.5)
+        s.connect(path)
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+class NonBlockingGRPCServer:
+    def __init__(
+        self,
+        endpoint: str,
+        tls: TLSConfig | None = None,
+        interceptors: tuple = (),
+        max_workers: int = 16,
+        options: tuple = (),
+    ) -> None:
+        self.endpoint = ep.parse(endpoint)
+        self.tls = tls
+        self.interceptors = interceptors
+        self.max_workers = max_workers
+        self.options = options
+        self._server: grpc.Server | None = None
+        self._port: int | None = None
+
+    def start(self, *registrars: Registrar) -> None:
+        if self._server is not None:
+            raise RuntimeError("server already started")
+        if self.endpoint.is_unix:
+            sock = self.endpoint.address
+            parent = os.path.dirname(sock)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            if os.path.exists(sock):
+                # Only remove the socket if nothing is serving on it; silently
+                # unlinking a live server's socket would steal the address.
+                if _unix_socket_alive(sock):
+                    raise RuntimeError(f"{self.endpoint} is already in use")
+                os.unlink(sock)
+        server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self.max_workers),
+            interceptors=list(self.interceptors),
+            options=list(self.options),
+        )
+        for registrar in registrars:
+            registrar(server)
+        listen = self.endpoint.grpc_listen()
+        if self.tls is not None:
+            port = server.add_secure_port(listen, self.tls.server_credentials())
+        else:
+            port = server.add_insecure_port(listen)
+        if port == 0:
+            raise RuntimeError(f"failed to bind {self.endpoint}")
+        self._port = port
+        self._server = server
+        server.start()
+        log.current().info("gRPC server listening", endpoint=str(self.addr()))
+
+    def addr(self) -> ep.Endpoint:
+        """Actual bound endpoint (resolves ``:0`` to the real port)."""
+        if self._server is None or self._port is None:
+            raise RuntimeError("server not started")
+        if self.endpoint.is_unix:
+            return self.endpoint
+        host = self.endpoint.address.rsplit(":", 1)[0]
+        return ep.Endpoint(self.endpoint.scheme, f"{host}:{self._port}")
+
+    def wait(self) -> None:
+        assert self._server is not None
+        self._server.wait_for_termination()
+
+    def run(self, *registrars: Registrar) -> None:
+        """start() + wait(), the blocking mode used by the CLI binaries
+        (≙ reference server.go:131-137)."""
+        self.start(*registrars)
+        self.wait()
+
+    def stop(self, grace: float | None = 5.0) -> None:
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+
+    def force_stop(self) -> None:
+        self.stop(grace=None)

@@ -1,0 +1,74 @@
+"""mTLS configuration with CommonName pinning.
+
+≙ reference pkg/oim-common/grpc.go:77-127 (``LoadTLS``/``LoadTLSConfig``):
+every control-plane connection is mutually authenticated against one CA; the
+*client* pins the expected server identity by overriding the TLS server name
+to the peer's CN, and a *server* may additionally restrict which peer CN is
+allowed to call it (the reference's ``VerifyPeerCertificate``; here a gRPC
+server interceptor, see ``peer_check_interceptor``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import grpc
+
+
+@dataclass
+class TLSConfig:
+    ca_pem: bytes
+    cert_pem: bytes
+    key_pem: bytes
+    # Expected remote CommonName. As a client: pinned via TLS server-name
+    # override. As a server: "" accepts any CA-signed peer (per-method checks
+    # happen later, like the registry, reference cmd/oim-registry/main.go:53).
+    peer_name: str = ""
+
+    def server_credentials(self) -> grpc.ServerCredentials:
+        return grpc.ssl_server_credentials(
+            [(self.key_pem, self.cert_pem)],
+            root_certificates=self.ca_pem,
+            require_client_auth=True,
+        )
+
+    def channel_credentials(self) -> grpc.ChannelCredentials:
+        return grpc.ssl_channel_credentials(
+            root_certificates=self.ca_pem,
+            private_key=self.key_pem,
+            certificate_chain=self.cert_pem,
+        )
+
+    def channel_options(self) -> list[tuple[str, str]]:
+        if not self.peer_name:
+            return []
+        return [("grpc.ssl_target_name_override", self.peer_name)]
+
+    def with_peer(self, peer_name: str) -> "TLSConfig":
+        return TLSConfig(self.ca_pem, self.cert_pem, self.key_pem, peer_name)
+
+
+def load_tls(
+    ca_file: str, cert_file: str, key_file: str, peer_name: str = ""
+) -> TLSConfig:
+    """Load PEM files (≙ ``LoadTLS``; key/cert naming follows setup-ca.sh)."""
+    with open(ca_file, "rb") as f:
+        ca = f.read()
+    with open(cert_file, "rb") as f:
+        cert = f.read()
+    with open(key_file, "rb") as f:
+        key = f.read()
+    return TLSConfig(ca, cert, key, peer_name)
+
+
+def peer_common_name(context: grpc.ServicerContext) -> str | None:
+    """CommonName of the authenticated client, or None when unauthenticated.
+
+    Source of truth for the registry's per-method authorization (reference
+    pkg/oim-registry/registry.go:100-109 checks this CN).
+    """
+    auth = context.auth_context()
+    names = auth.get("x509_common_name")
+    if not names:
+        return None
+    return names[0].decode()

@@ -1,0 +1,21 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before the first ``import jax`` anywhere in the test session.  The
+image's site hook registers an ``axon`` TPU platform whenever
+``PALLAS_AXON_POOL_IPS`` is set; tests always run CPU-only so they work on
+machines with no TPU attached (the analog of the reference running its unit
+tiers without SPDK/QEMU, /root/reference/test/test.make:1-16).
+"""
+
+import os
+import sys
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

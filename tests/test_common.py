@@ -1,0 +1,58 @@
+"""Tests for endpoints, registry paths, cmdmonitor (≙ reference
+pkg/oim-common/{server,path}_test.go, cmdmonitor behavior)."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from oim_tpu.common import endpoint, pathutil
+from oim_tpu.common.cmdmonitor import CmdMonitor
+
+
+class TestEndpoint:
+    def test_unix(self):
+        e = endpoint.parse("unix:///tmp/x/csi.sock")
+        assert e.scheme == "unix" and e.address == "/tmp/x/csi.sock"
+        assert e.grpc_target() == "unix:/tmp/x/csi.sock"
+
+    def test_tcp(self):
+        e = endpoint.parse("tcp://127.0.0.1:8999")
+        assert e.scheme == "tcp" and e.address == "127.0.0.1:8999"
+        assert e.grpc_target() == "127.0.0.1:8999"
+
+    def test_bare_defaults_tcp(self):
+        assert endpoint.parse("host:1234").scheme == "tcp"
+
+    def test_invalid(self):
+        for bad in ["", "ftp://x", "unix://"]:
+            with pytest.raises(ValueError):
+                endpoint.parse(bad)
+
+
+class TestPath:
+    def test_clean(self):
+        assert pathutil.clean_path("/ctrl-1//address/") == "ctrl-1/address"
+        assert pathutil.split_path("a/b.c/d_e") == ["a", "b.c", "d_e"]
+
+    def test_reject(self):
+        for bad in ["", "//", "../x", "a/../b", "a/b c", "a/$x"]:
+            with pytest.raises(ValueError):
+                pathutil.clean_path(bad)
+
+
+class TestCmdMonitor:
+    def test_detects_child_death(self):
+        mon = CmdMonitor()
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(0.3)"],
+            pass_fds=[mon.child_fd],
+            close_fds=True,
+        )
+        mon.after_spawn()
+        assert not mon.dead(timeout=0.05)
+        proc.wait()
+        deadline = time.time() + 2
+        while not mon.dead(timeout=0.1):
+            assert time.time() < deadline, "monitor missed child death"

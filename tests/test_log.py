@@ -1,0 +1,53 @@
+"""Tests for oim_tpu.log (≙ reference pkg/log/*_test.go)."""
+
+import io
+
+import pytest
+
+from oim_tpu import log
+from oim_tpu.log.level import Level, threshold_from_string
+
+
+def test_threshold_filtering():
+    out = io.StringIO()
+    logger = log.SimpleLogger(threshold=Level.WARNING, out=out, timestamps=False)
+    logger.debug("nope")
+    logger.info("nope")
+    logger.warning("yes-warn")
+    logger.error("yes-err", code=5)
+    lines = out.getvalue().splitlines()
+    assert lines == ["W yes-warn", "E yes-err code=5"]
+
+
+def test_level_parsing():
+    assert threshold_from_string("debug") == Level.DEBUG
+    assert threshold_from_string("WARN") == Level.WARNING
+    with pytest.raises(ValueError):
+        threshold_from_string("loud")
+
+
+def test_bound_fields_inherit():
+    t = log.TestLogger()
+    child = t.with_fields(vol="v1")
+    grandchild = child.with_fields(step="stage")
+    grandchild.info("hello", extra=1)
+    assert t.records[-1].fields == {"vol": "v1", "step": "stage", "extra": 1}
+
+
+def test_context_carriage():
+    t = log.TestLogger()
+    with log.with_logger(t):
+        with log.with_fields(method="/oim.v1.Registry/SetValue"):
+            log.current().info("in-call")
+        log.current().info("outside")
+    assert t.records[0].fields == {"method": "/oim.v1.Registry/SetValue"}
+    assert t.records[1].fields == {}
+    # Outside the with_logger block the global logger is current again.
+    assert log.current() is log.L()
+
+
+def test_fatal_raises_systemexit():
+    t = log.TestLogger()
+    with pytest.raises(SystemExit):
+        t.fatal("boom")
+    assert t.messages() == ["boom"]

@@ -1,0 +1,41 @@
+"""Tests for PCI BDF parsing/merging (≙ reference pkg/oim-common/pci_test.go)."""
+
+import pytest
+
+from oim_tpu.common import pci
+
+
+def test_full_bdf():
+    a = pci.parse_bdf_string("0000:00:02.0")
+    assert (a.domain, a.bus, a.device, a.function) == (0, 0, 2, 0)
+    assert str(a) == "0000:00:02.0"
+    assert a.complete()
+
+
+def test_partial_bdf():
+    a = pci.parse_bdf_string("02.1")
+    assert a.domain == pci.UNKNOWN and a.bus == pci.UNKNOWN
+    assert (a.device, a.function) == (2, 1)
+    assert str(a) == "****:**:02.1"
+    assert not a.complete()
+
+    b = pci.parse_bdf_string("3f:02.1")
+    assert b.domain == pci.UNKNOWN and b.bus == 0x3F
+
+
+def test_invalid():
+    for bad in ["", "xyz", "0000:00:02", "00:02:0.0.0", "10000:00:02.0"]:
+        with pytest.raises(ValueError):
+            pci.parse_bdf_string(bad)
+
+
+def test_merge_registry_default():
+    # The controller replies with a partial address; the registry's stored
+    # default fills the gaps (≙ CompletePCIAddress, remote.go:170-190).
+    partial = pci.parse_bdf_string("02.0")
+    default = pci.parse_bdf_string("0000:3f:1f.7")
+    merged = pci.merge(partial, default)
+    assert str(merged) == "0000:3f:02.0"
+
+    # Known components win over the fallback.
+    assert pci.merge(default, partial) == default
