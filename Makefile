@@ -1,0 +1,31 @@
+# OIM-TPU build entry points.
+#
+# ≙ the reference's Makefile roles: proto extraction + codegen (reference
+# Makefile:77-116), native daemon build (Makefile:71-75), test running.
+
+PYTHON ?= python3
+PROTOC ?= protoc
+
+.PHONY: all gen test test-cpu agent clean
+
+all: gen agent
+
+# Extract proto from the literate spec and regenerate Python bindings.
+gen:
+	$(PYTHON) tools/extract_proto.py
+	$(PROTOC) -Iproto --python_out=oim_tpu/spec/gen proto/oim/v1/oim.proto
+	$(PROTOC) -Iproto --python_out=oim_tpu/spec/gen proto/csi/v1/csi.proto
+
+# Verify spec/proto/bindings are in sync (CI gate; also run by pytest).
+check-gen:
+	$(PYTHON) tools/extract_proto.py --check
+
+# The native device-plane daemon.
+agent:
+	$(MAKE) -C native/tpu-agent
+
+test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+clean:
+	$(MAKE) -C native/tpu-agent clean || true

@@ -1,0 +1,356 @@
+#include "chip_store.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <functional>
+#include <numeric>
+#include <set>
+
+namespace oim {
+
+namespace {
+
+// Row-major enumeration of all coordinates inside `dims`.
+std::vector<std::vector<int>> AllCoords(const std::vector<int>& dims) {
+  std::vector<std::vector<int>> out;
+  std::vector<int> cur(dims.size(), 0);
+  std::function<void(size_t)> rec = [&](size_t axis) {
+    if (axis == dims.size()) {
+      out.push_back(cur);
+      return;
+    }
+    for (int i = 0; i < dims[axis]; i++) {
+      cur[axis] = i;
+      rec(axis + 1);
+    }
+  };
+  rec(0);
+  if (dims.empty()) out.push_back({});
+  return out;
+}
+
+int Product(const std::vector<int>& dims) {
+  int p = 1;
+  for (int d : dims) p *= d;
+  return p;
+}
+
+}  // namespace
+
+std::vector<std::vector<int>> SubBoxes(int n, const std::vector<int>& dims) {
+  std::set<std::vector<int>> shapes;
+  std::vector<int> prefix;
+  std::function<void(int, size_t)> rec = [&](int remaining, size_t axis) {
+    if (axis == dims.size()) {
+      if (remaining == 1) shapes.insert(prefix);
+      return;
+    }
+    int limit = std::min(dims[axis], remaining);
+    for (int d = 1; d <= limit; d++) {
+      if (remaining % d == 0) {
+        prefix.push_back(d);
+        rec(remaining / d, axis + 1);
+        prefix.pop_back();
+      }
+    }
+  };
+  rec(n, 0);
+  std::vector<std::vector<int>> out(shapes.begin(), shapes.end());
+  std::sort(out.begin(), out.end(),
+            [](const std::vector<int>& a, const std::vector<int>& b) {
+              int ma = *std::max_element(a.begin(), a.end());
+              int mb = *std::max_element(b.begin(), b.end());
+              if (ma != mb) return ma < mb;
+              int sa = std::accumulate(a.begin(), a.end(), 0);
+              int sb = std::accumulate(b.begin(), b.end(), 0);
+              if (sa != sb) return sa < sb;
+              return a < b;
+            });
+  return out;
+}
+
+ChipStore::ChipStore(std::vector<int> mesh, std::string accel_type,
+                     std::vector<std::string> device_paths,
+                     std::string pjrt_version,
+                     std::vector<std::string> pci_addrs)
+    : mesh_(std::move(mesh)),
+      accel_type_(std::move(accel_type)),
+      pjrt_version_(std::move(pjrt_version)) {
+  auto coords = AllCoords(mesh_);
+  chips_.reserve(device_paths.size());
+  for (size_t i = 0; i < device_paths.size(); i++) {
+    Chip chip;
+    chip.chip_id = static_cast<int>(i);
+    chip.device_path = device_paths[i];
+    if (i < pci_addrs.size() && !pci_addrs[i].empty()) {
+      chip.pci = pci_addrs[i];
+    } else {
+      char pci[32];
+      std::snprintf(pci, sizeof(pci), "0000:%02zx:05.0", i);
+      chip.pci = pci;
+    }
+    chip.accel_type = accel_type_;
+    chip.phys_coord = coords[i];
+    chips_.push_back(std::move(chip));
+  }
+}
+
+int ChipStore::CoordToId(const std::vector<int>& coord) const {
+  // Row-major index within mesh_.
+  int idx = 0;
+  for (size_t a = 0; a < mesh_.size(); a++) {
+    idx = idx * mesh_[a] + coord[a];
+  }
+  return idx;
+}
+
+bool ChipStore::FindChips(int n, const std::vector<int>& topology,
+                          std::vector<int>* ids, std::vector<int>* mesh) {
+  std::set<int> free;
+  for (const Chip& c : chips_) {
+    if (c.allocation.empty()) free.insert(c.chip_id);
+  }
+  if (n > static_cast<int>(free.size())) {
+    throw RpcError{kErrNoSpace, "need " + std::to_string(n) + " chips, " +
+                                    std::to_string(free.size()) + " free"};
+  }
+  std::vector<std::vector<int>> shapes;
+  if (!topology.empty()) {
+    shapes.push_back(topology);
+  } else {
+    shapes = SubBoxes(n, mesh_);
+  }
+  for (const auto& shape : shapes) {
+    if (shape.size() != mesh_.size()) continue;
+    // Slide the box over every origin in deterministic (row-major) order.
+    std::vector<int> origin_dims;
+    bool fits = true;
+    for (size_t a = 0; a < shape.size(); a++) {
+      int range = mesh_[a] - shape[a] + 1;
+      if (range <= 0) fits = false;
+      origin_dims.push_back(range);
+    }
+    if (!fits) continue;
+    for (const auto& origin : AllCoords(origin_dims)) {
+      std::vector<int> candidate;
+      bool ok = true;
+      for (const auto& offset : AllCoords(shape)) {
+        std::vector<int> coord(shape.size());
+        for (size_t a = 0; a < shape.size(); a++) {
+          coord[a] = origin[a] + offset[a];
+        }
+        int cid = CoordToId(coord);
+        if (!free.count(cid)) {
+          ok = false;
+          break;
+        }
+        candidate.push_back(cid);
+      }
+      if (ok) {
+        *ids = candidate;
+        *mesh = shape;
+        return true;
+      }
+    }
+  }
+  if (!topology.empty()) {
+    std::string shape_str;
+    for (size_t i = 0; i < topology.size(); i++) {
+      if (i) shape_str += "x";
+      shape_str += std::to_string(topology[i]);
+    }
+    throw RpcError{kErrNoSpace, "no free " + shape_str + " sub-mesh"};
+  }
+  // Fragmented: linear mesh over the lowest-id free chips.
+  ids->assign(free.begin(), free.end());
+  ids->resize(n);
+  *mesh = {n};
+  return true;
+}
+
+Allocation& ChipStore::CreateAllocation(const std::string& name,
+                                        int chip_count,
+                                        const std::vector<int>& topology) {
+  if (name.empty() || chip_count <= 0) {
+    throw RpcError{kErrInvalidParams, "name and chip_count>0 required"};
+  }
+  if (!topology.empty() && Product(topology) != chip_count) {
+    throw RpcError{kErrInvalidParams,
+                   "topology does not multiply to chip_count"};
+  }
+  auto it = allocations_.find(name);
+  if (it != allocations_.end()) {
+    if (static_cast<int>(it->second.chip_ids.size()) != chip_count) {
+      throw RpcError{kErrExist, "allocation '" + name + "' exists with " +
+                                    std::to_string(it->second.chip_ids.size()) +
+                                    " chips"};
+    }
+    return it->second;
+  }
+  Allocation alloc;
+  alloc.name = name;
+  FindChips(chip_count, topology, &alloc.chip_ids, &alloc.mesh);
+  auto offsets = AllCoords(alloc.mesh);
+  for (size_t i = 0; i < alloc.chip_ids.size(); i++) {
+    alloc.coords[alloc.chip_ids[i]] = offsets[i];
+    chips_[alloc.chip_ids[i]].allocation = name;
+  }
+  return allocations_.emplace(name, std::move(alloc)).first->second;
+}
+
+void ChipStore::DeleteAllocation(const std::string& name) {
+  auto it = allocations_.find(name);
+  if (it == allocations_.end()) {
+    throw RpcError{kErrNoDev, "no allocation '" + name + "'"};
+  }
+  if (it->second.attached) {
+    throw RpcError{kErrBusy, "allocation '" + name + "' is attached"};
+  }
+  for (int cid : it->second.chip_ids) chips_[cid].allocation.clear();
+  allocations_.erase(it);
+}
+
+Allocation& ChipStore::AttachAllocation(const std::string& name) {
+  auto it = allocations_.find(name);
+  if (it == allocations_.end()) {
+    throw RpcError{kErrNoDev, "no allocation '" + name + "'"};
+  }
+  Allocation& alloc = it->second;
+  if (!alloc.attached) {
+    std::set<int> used;
+    for (const auto& kv : allocations_) {
+      if (kv.second.attached) used.insert(kv.second.coordinator_port);
+    }
+    int port = kCoordinatorPortBase;
+    while (used.count(port)) port++;
+    alloc.coordinator_port = port;
+    alloc.attached = true;
+  }
+  return alloc;
+}
+
+void ChipStore::DetachAllocation(const std::string& name) {
+  auto it = allocations_.find(name);
+  if (it == allocations_.end()) {
+    throw RpcError{kErrNoDev, "no allocation '" + name + "'"};
+  }
+  it->second.attached = false;
+  it->second.coordinator_port = 0;
+}
+
+// ---------------------------------------------------------------------------
+// JSON views
+
+namespace {
+
+Json IntArray(const std::vector<int>& values) {
+  Json arr = Json::array();
+  for (int v : values) arr.push(Json::integer(v));
+  return arr;
+}
+
+std::vector<int> ParseIntArray(const Json& j) {
+  std::vector<int> out;
+  for (const Json& item : j.items()) {
+    out.push_back(static_cast<int>(item.as_int()));
+  }
+  return out;
+}
+
+}  // namespace
+
+Json ChipStore::ChipJson(const Chip& chip,
+                         const std::vector<int>* coord) const {
+  Json j = Json::object();
+  j.set("chip_id", Json::integer(chip.chip_id));
+  j.set("device_path", Json::str(chip.device_path));
+  j.set("pci", Json::str(chip.pci));
+  j.set("accel_type", Json::str(chip.accel_type));
+  j.set("phys_coord", IntArray(chip.phys_coord));
+  j.set("allocation", Json::str(chip.allocation));
+  if (coord != nullptr) j.set("coord", IntArray(*coord));
+  return j;
+}
+
+Json ChipStore::AllocJson(const Allocation& alloc) const {
+  Json j = Json::object();
+  j.set("name", Json::str(alloc.name));
+  j.set("chip_count", Json::integer(alloc.chip_ids.size()));
+  j.set("mesh", IntArray(alloc.mesh));
+  j.set("attached", Json::boolean(alloc.attached));
+  j.set("coordinator_port", Json::integer(alloc.coordinator_port));
+  Json chips = Json::array();
+  for (int cid : alloc.chip_ids) {
+    chips.push(ChipJson(chips_[cid], &alloc.coords.at(cid)));
+  }
+  j.set("chips", std::move(chips));
+  return j;
+}
+
+Json ChipStore::TopologyJson() {
+  int free = 0;
+  for (const Chip& c : chips_) {
+    if (c.allocation.empty()) free++;
+  }
+  Json j = Json::object();
+  j.set("accel_type", Json::str(accel_type_));
+  j.set("mesh", IntArray(mesh_));
+  j.set("chip_count", Json::integer(chips_.size()));
+  j.set("free_chips", Json::integer(free));
+  if (!pjrt_version_.empty()) j.set("pjrt_version", Json::str(pjrt_version_));
+  return j;
+}
+
+Json ChipStore::Handle(const std::string& method, const Json& params) {
+  std::lock_guard<std::mutex> lock(mutex_);
+  auto name_param = [&]() -> std::string {
+    const Json* name = params.find("name");
+    if (name == nullptr || name->as_string().empty()) {
+      throw RpcError{kErrInvalidParams, "name required"};
+    }
+    return name->as_string();
+  };
+
+  if (method == "get_topology") return TopologyJson();
+  if (method == "get_chips") {
+    Json arr = Json::array();
+    for (const Chip& c : chips_) arr.push(ChipJson(c, nullptr));
+    return arr;
+  }
+  if (method == "get_allocations") {
+    Json arr = Json::array();
+    const Json* name = params.find("name");
+    if (name != nullptr && !name->as_string().empty()) {
+      auto it = allocations_.find(name->as_string());
+      if (it != allocations_.end()) arr.push(AllocJson(it->second));
+    } else {
+      for (const auto& kv : allocations_) arr.push(AllocJson(kv.second));
+    }
+    return arr;
+  }
+  if (method == "create_allocation") {
+    const Json* name = params.find("name");
+    const Json* count = params.find("chip_count");
+    std::vector<int> topology;
+    if (const Json* topo = params.find("topology")) {
+      topology = ParseIntArray(*topo);
+    }
+    return AllocJson(CreateAllocation(
+        name != nullptr ? name->as_string() : "",
+        count != nullptr ? static_cast<int>(count->as_int()) : 0, topology));
+  }
+  if (method == "delete_allocation") {
+    DeleteAllocation(name_param());
+    return Json::boolean(true);
+  }
+  if (method == "attach_allocation") {
+    return AllocJson(AttachAllocation(name_param()));
+  }
+  if (method == "detach_allocation") {
+    DetachAllocation(name_param());
+    return Json::boolean(true);
+  }
+  throw RpcError{kErrMethodNotFound, "method '" + method + "' not found"};
+}
+
+}  // namespace oim

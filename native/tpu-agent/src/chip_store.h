@@ -1,0 +1,98 @@
+// Chip inventory + allocation state: the daemon's source of truth.
+//
+// Semantics are specified by doc/agent-protocol.md and must stay identical
+// to the Python reference implementation (oim_tpu/agent/fake.py) — the
+// shared suite tests/test_agent_protocol.py runs against both.  This plays
+// the role SPDK's bdev/vhost tables play in the reference architecture.
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace oim {
+
+// errno-style application error codes (doc/agent-protocol.md).
+constexpr int kErrExist = -17;
+constexpr int kErrNoDev = -19;
+constexpr int kErrNoSpace = -28;
+constexpr int kErrBusy = -16;
+constexpr int kErrInvalidParams = -32602;
+constexpr int kErrMethodNotFound = -32601;
+constexpr int kErrParse = -32700;
+constexpr int kErrInvalidRequest = -32600;
+
+constexpr int kCoordinatorPortBase = 8476;
+
+struct RpcError {
+  int code;
+  std::string message;
+};
+
+struct Chip {
+  int chip_id;
+  std::string device_path;
+  std::string pci;
+  std::string accel_type;
+  std::vector<int> phys_coord;
+  std::string allocation;  // owning allocation name, "" when free
+};
+
+struct Allocation {
+  std::string name;
+  std::vector<int> chip_ids;          // in mesh row-major order
+  std::vector<int> mesh;
+  bool attached = false;
+  int coordinator_port = 0;
+  std::map<int, std::vector<int>> coords;  // chip_id -> coord within mesh
+};
+
+class ChipStore {
+ public:
+  // Fake mode: fabricate chips on a mesh, stub device files in state_dir.
+  // Real mode: use the given device paths with a linear [n] mesh (or the
+  // configured physical mesh when its product matches).  pci_addrs, when
+  // non-empty, carries one BDF string per device (resolved from sysfs by
+  // main); otherwise synthetic fake-mode addresses are fabricated.
+  ChipStore(std::vector<int> mesh, std::string accel_type,
+            std::vector<std::string> device_paths, std::string pjrt_version,
+            std::vector<std::string> pci_addrs = {});
+
+  // Dispatch one protocol method.  Throws RpcError on failure.
+  Json Handle(const std::string& method, const Json& params);
+
+ private:
+  Json TopologyJson();
+  Json ChipJson(const Chip& chip, const std::vector<int>* coord) const;
+  Json AllocJson(const Allocation& alloc) const;
+
+  Allocation& CreateAllocation(const std::string& name, int chip_count,
+                               const std::vector<int>& topology);
+  void DeleteAllocation(const std::string& name);
+  Allocation& AttachAllocation(const std::string& name);
+  void DetachAllocation(const std::string& name);
+
+  // Deterministic compact sub-box allocator; see doc/agent-protocol.md.
+  bool FindChips(int n, const std::vector<int>& topology,
+                 std::vector<int>* ids, std::vector<int>* mesh);
+
+  int CoordToId(const std::vector<int>& coord) const;
+
+  std::vector<int> mesh_;
+  std::string accel_type_;
+  std::string pjrt_version_;
+  std::vector<Chip> chips_;
+  std::map<std::string, Allocation> allocations_;
+  std::mutex mutex_;
+};
+
+// Enumerates all box shapes with product n fitting in dims, most compact
+// first (longest edge, then perimeter, then lexicographic).
+std::vector<std::vector<int>> SubBoxes(int n, const std::vector<int>& dims);
+
+}  // namespace oim

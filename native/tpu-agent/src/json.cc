@@ -1,0 +1,330 @@
+#include "json.h"
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace oim {
+
+Json Json::boolean(bool b) {
+  Json j;
+  j.type_ = kBool;
+  j.bool_ = b;
+  return j;
+}
+
+Json Json::number(double n) {
+  Json j;
+  j.type_ = kNumber;
+  j.number_ = n;
+  return j;
+}
+
+Json Json::integer(int64_t n) { return number(static_cast<double>(n)); }
+
+Json Json::str(std::string s) {
+  Json j;
+  j.type_ = kString;
+  j.string_ = std::move(s);
+  return j;
+}
+
+Json Json::array() {
+  Json j;
+  j.type_ = kArray;
+  return j;
+}
+
+Json Json::object() {
+  Json j;
+  j.type_ = kObject;
+  return j;
+}
+
+const Json* Json::find(const std::string& key) const {
+  for (const auto& kv : object_) {
+    if (kv.first == key) return &kv.second;
+  }
+  return nullptr;
+}
+
+void Json::set(const std::string& key, Json value) {
+  for (auto& kv : object_) {
+    if (kv.first == key) {
+      kv.second = std::move(value);
+      return;
+    }
+  }
+  object_.emplace_back(key, std::move(value));
+}
+
+void Json::push(Json value) { array_.push_back(std::move(value)); }
+
+static void escape_to(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void Json::dump_to(std::string* out) const {
+  switch (type_) {
+    case kNull: *out += "null"; break;
+    case kBool: *out += bool_ ? "true" : "false"; break;
+    case kNumber: {
+      double intpart;
+      if (std::modf(number_, &intpart) == 0.0 && std::fabs(number_) < 1e15) {
+        char buf[32];
+        std::snprintf(buf, sizeof(buf), "%lld",
+                      static_cast<long long>(number_));
+        *out += buf;
+      } else {
+        char buf[32];
+        std::snprintf(buf, sizeof(buf), "%.17g", number_);
+        *out += buf;
+      }
+      break;
+    }
+    case kString: escape_to(string_, out); break;
+    case kArray: {
+      out->push_back('[');
+      for (size_t i = 0; i < array_.size(); i++) {
+        if (i) out->push_back(',');
+        array_[i].dump_to(out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case kObject: {
+      out->push_back('{');
+      for (size_t i = 0; i < object_.size(); i++) {
+        if (i) out->push_back(',');
+        escape_to(object_[i].first, out);
+        out->push_back(':');
+        object_[i].second.dump_to(out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+std::string Json::dump() const {
+  std::string out;
+  dump_to(&out);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Parser
+
+namespace {
+
+struct Parser {
+  const char* p;
+  const char* end;
+  std::string* error;
+
+  bool fail(const std::string& msg) {
+    *error = msg;
+    return false;
+  }
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+  }
+
+  bool parse_value(Json* out) {
+    skip_ws();
+    if (p >= end) return fail("unexpected end of input");
+    switch (*p) {
+      case '{': return parse_object(out);
+      case '[': return parse_array(out);
+      case '"': {
+        std::string s;
+        if (!parse_string(&s)) return false;
+        *out = Json::str(std::move(s));
+        return true;
+      }
+      case 't':
+        if (end - p >= 4 && std::strncmp(p, "true", 4) == 0) {
+          p += 4;
+          *out = Json::boolean(true);
+          return true;
+        }
+        return fail("bad literal");
+      case 'f':
+        if (end - p >= 5 && std::strncmp(p, "false", 5) == 0) {
+          p += 5;
+          *out = Json::boolean(false);
+          return true;
+        }
+        return fail("bad literal");
+      case 'n':
+        if (end - p >= 4 && std::strncmp(p, "null", 4) == 0) {
+          p += 4;
+          *out = Json();
+          return true;
+        }
+        return fail("bad literal");
+      default: return parse_number(out);
+    }
+  }
+
+  bool parse_string(std::string* out) {
+    p++;  // opening quote
+    out->clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        p++;
+        if (p >= end) return fail("bad escape");
+        switch (*p) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'n': out->push_back('\n'); break;
+          case 'r': out->push_back('\r'); break;
+          case 't': out->push_back('\t'); break;
+          case 'u': {
+            if (end - p < 5) return fail("bad \\u escape");
+            unsigned code = 0;
+            for (int i = 1; i <= 4; i++) {
+              char c = p[i];
+              code <<= 4;
+              if (c >= '0' && c <= '9') code |= c - '0';
+              else if (c >= 'a' && c <= 'f') code |= c - 'a' + 10;
+              else if (c >= 'A' && c <= 'F') code |= c - 'A' + 10;
+              else return fail("bad \\u escape");
+            }
+            p += 4;
+            // UTF-8 encode the BMP code point (surrogate pairs unsupported;
+            // the protocol never uses them).
+            if (code < 0x80) {
+              out->push_back(static_cast<char>(code));
+            } else if (code < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (code >> 6)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xE0 | (code >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default: return fail("bad escape");
+        }
+        p++;
+      } else {
+        out->push_back(*p++);
+      }
+    }
+    if (p >= end) return fail("unterminated string");
+    p++;  // closing quote
+    return true;
+  }
+
+  bool parse_number(Json* out) {
+    const char* start = p;
+    if (p < end && (*p == '-' || *p == '+')) p++;
+    bool digits = false;
+    while (p < end && (std::isdigit(static_cast<unsigned char>(*p)) ||
+                       *p == '.' || *p == 'e' || *p == 'E' || *p == '-' ||
+                       *p == '+')) {
+      if (std::isdigit(static_cast<unsigned char>(*p))) digits = true;
+      p++;
+    }
+    if (!digits) return fail("bad number");
+    *out = Json::number(std::strtod(std::string(start, p).c_str(), nullptr));
+    return true;
+  }
+
+  bool parse_array(Json* out) {
+    p++;  // [
+    *out = Json::array();
+    skip_ws();
+    if (p < end && *p == ']') {
+      p++;
+      return true;
+    }
+    while (true) {
+      Json item;
+      if (!parse_value(&item)) return false;
+      out->push(std::move(item));
+      skip_ws();
+      if (p < end && *p == ',') {
+        p++;
+        continue;
+      }
+      if (p < end && *p == ']') {
+        p++;
+        return true;
+      }
+      return fail("expected , or ] in array");
+    }
+  }
+
+  bool parse_object(Json* out) {
+    p++;  // {
+    *out = Json::object();
+    skip_ws();
+    if (p < end && *p == '}') {
+      p++;
+      return true;
+    }
+    while (true) {
+      skip_ws();
+      if (p >= end || *p != '"') return fail("expected object key");
+      std::string key;
+      if (!parse_string(&key)) return false;
+      skip_ws();
+      if (p >= end || *p != ':') return fail("expected : in object");
+      p++;
+      Json value;
+      if (!parse_value(&value)) return false;
+      out->set(key, std::move(value));
+      skip_ws();
+      if (p < end && *p == ',') {
+        p++;
+        continue;
+      }
+      if (p < end && *p == '}') {
+        p++;
+        return true;
+      }
+      return fail("expected , or } in object");
+    }
+  }
+};
+
+}  // namespace
+
+bool Json::parse(const std::string& text, Json* out, std::string* error) {
+  Parser parser{text.data(), text.data() + text.size(), error};
+  if (!parser.parse_value(out)) return false;
+  parser.skip_ws();
+  if (parser.p != parser.end) {
+    *error = "trailing garbage";
+    return false;
+  }
+  return true;
+}
+
+}  // namespace oim

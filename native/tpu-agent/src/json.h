@@ -1,0 +1,61 @@
+// Minimal JSON value type for the tpu-agent's NDJSON JSON-RPC protocol.
+//
+// The image ships no C++ JSON library, so this is a small self-contained
+// parser/serializer covering exactly what doc/agent-protocol.md needs:
+// null/bool/number/string/array/object, strict parsing, compact output.
+
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace oim {
+
+class Json {
+ public:
+  enum Type { kNull, kBool, kNumber, kString, kArray, kObject };
+
+  Json() = default;
+  static Json boolean(bool b);
+  static Json number(double n);
+  static Json integer(int64_t n);
+  static Json str(std::string s);
+  static Json array();
+  static Json object();
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == kNull; }
+
+  // Accessors; behavior is defined only for the matching type.
+  bool as_bool() const { return bool_; }
+  double as_number() const { return number_; }
+  int64_t as_int() const { return static_cast<int64_t>(number_); }
+  const std::string& as_string() const { return string_; }
+  const std::vector<Json>& items() const { return array_; }
+  std::vector<Json>& items() { return array_; }
+
+  // Object access. find() returns nullptr when the key is absent.
+  const Json* find(const std::string& key) const;
+  void set(const std::string& key, Json value);
+  void push(Json value);
+
+  std::string dump() const;
+
+  // Parses exactly one JSON document from `text`; returns false and sets
+  // `error` on malformed input or trailing garbage.
+  static bool parse(const std::string& text, Json* out, std::string* error);
+
+ private:
+  Type type_ = kNull;
+  bool bool_ = false;
+  double number_ = 0;
+  std::string string_;
+  std::vector<Json> array_;
+  std::vector<std::pair<std::string, Json>> object_;
+
+  void dump_to(std::string* out) const;
+};
+
+}  // namespace oim

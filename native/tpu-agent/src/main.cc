@@ -1,0 +1,198 @@
+// tpu-agent: the device-plane daemon owning one host's TPU chips.
+//
+// The role SPDK vhost plays in the reference (launched the way the
+// reference's test fixture launches vhost, reference
+// test/pkg/spdk/spdk.go:109-177): a native daemon serving a JSON-RPC control
+// socket; the compute data plane (ICI/HBM) lives inside libtpu/PJRT and
+// never passes through this process.
+//
+// Modes:
+//   --fake-chips N [--mesh XxYxZ]   fabricate N chips, stub device files in
+//                                   --state-dir (Malloc-BDev analog)
+//   --devices GLOB                  real mode: chips = matching device files
+//   --pjrt-plugin PATH              dlopen a PJRT plugin as a liveness probe
+
+#include <dlfcn.h>
+#include <glob.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+#include "chip_store.h"
+#include "rpc_server.h"
+
+namespace {
+
+oim::RpcServer* g_server = nullptr;
+
+void HandleSignal(int) {
+  if (g_server != nullptr) g_server->Shutdown();
+}
+
+std::vector<int> ParseMesh(const std::string& spec) {
+  std::vector<int> mesh;
+  size_t start = 0;
+  while (start <= spec.size()) {
+    size_t x = spec.find('x', start);
+    std::string part =
+        spec.substr(start, x == std::string::npos ? x : x - start);
+    if (part.empty()) break;
+    mesh.push_back(std::atoi(part.c_str()));
+    if (x == std::string::npos) break;
+    start = x + 1;
+  }
+  return mesh;
+}
+
+// Best-effort sysfs PCI BDF lookup for a device node like /dev/accel3:
+// /sys/class/accel/accel3/device resolves to .../pci0000:00/0000:00:05.0.
+std::string SysfsPci(const std::string& device_path) {
+  size_t slash = device_path.rfind('/');
+  std::string base =
+      slash == std::string::npos ? device_path : device_path.substr(slash + 1);
+  for (const char* cls : {"accel", "vfio"}) {
+    std::string link = std::string("/sys/class/") + cls + "/" + base + "/device";
+    char resolved[4096];
+    ssize_t n = ::readlink(link.c_str(), resolved, sizeof(resolved) - 1);
+    if (n <= 0) continue;
+    resolved[n] = '\0';
+    std::string target(resolved);
+    size_t pos = target.rfind('/');
+    std::string leaf = pos == std::string::npos ? target : target.substr(pos + 1);
+    // A BDF looks like dddd:bb:dd.f.
+    if (leaf.size() >= 12 && leaf[4] == ':' && leaf[7] == ':' &&
+        leaf[10] == '.') {
+      return leaf;
+    }
+  }
+  return "";
+}
+
+std::string ProbePjrtPlugin(const std::string& path) {
+  void* handle = dlopen(path.c_str(), RTLD_NOW | RTLD_LOCAL);
+  if (handle == nullptr) {
+    std::fprintf(stderr, "warning: dlopen(%s): %s\n", path.c_str(), dlerror());
+    return "";
+  }
+  // Every PJRT plugin exports GetPjrtApi (PJRT C API contract).
+  void* sym = dlsym(handle, "GetPjrtApi");
+  if (sym == nullptr) {
+    std::fprintf(stderr, "warning: %s lacks GetPjrtApi\n", path.c_str());
+    return "";
+  }
+  return "loaded:" + path;
+}
+
+void Usage(const char* argv0) {
+  std::fprintf(
+      stderr,
+      "usage: %s --socket PATH (--fake-chips N [--mesh XxYxZ] "
+      "--state-dir DIR | --devices GLOB [--mesh XxYxZ]) "
+      "[--accel-type TYPE] [--pjrt-plugin PATH]\n",
+      argv0);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string socket_path;
+  std::string state_dir = "/var/run/tpu-agent";
+  std::string devices_glob;
+  std::string accel_type = "v5p";
+  std::string pjrt_plugin;
+  std::string mesh_spec;
+  int fake_chips = 0;
+
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    auto next = [&]() -> const char* {
+      if (i + 1 >= argc) {
+        Usage(argv[0]);
+        std::exit(2);
+      }
+      return argv[++i];
+    };
+    if (arg == "--socket") socket_path = next();
+    else if (arg == "--fake-chips") fake_chips = std::atoi(next());
+    else if (arg == "--mesh") mesh_spec = next();
+    else if (arg == "--state-dir") state_dir = next();
+    else if (arg == "--devices") devices_glob = next();
+    else if (arg == "--accel-type") accel_type = next();
+    else if (arg == "--pjrt-plugin") pjrt_plugin = next();
+    else if (arg == "--help" || arg == "-h") { Usage(argv[0]); return 0; }
+    else {
+      std::fprintf(stderr, "unknown flag %s\n", arg.c_str());
+      Usage(argv[0]);
+      return 2;
+    }
+  }
+  if (socket_path.empty()) {
+    Usage(argv[0]);
+    return 2;
+  }
+  // Real mode is the default: scan the standard TPU accel device nodes.
+  if (fake_chips <= 0 && devices_glob.empty()) devices_glob = "/dev/accel*";
+
+  std::vector<std::string> device_paths;
+  std::vector<std::string> pci_addrs;
+  if (fake_chips > 0) {
+    ::mkdir(state_dir.c_str(), 0755);
+    for (int i = 0; i < fake_chips; i++) {
+      std::string path = state_dir + "/accel" + std::to_string(i);
+      std::ofstream f(path);
+      f << "fake-tpu-chip " << i << "\n";
+      device_paths.push_back(path);
+    }
+  } else {
+    glob_t results;
+    if (::glob(devices_glob.c_str(), 0, nullptr, &results) == 0) {
+      for (size_t i = 0; i < results.gl_pathc; i++) {
+        device_paths.emplace_back(results.gl_pathv[i]);
+      }
+    }
+    ::globfree(&results);
+    if (device_paths.empty()) {
+      std::fprintf(stderr, "no devices match %s\n", devices_glob.c_str());
+      return 1;
+    }
+    for (const std::string& path : device_paths) {
+      pci_addrs.push_back(SysfsPci(path));
+    }
+  }
+
+  std::vector<int> mesh;
+  if (!mesh_spec.empty()) {
+    mesh = ParseMesh(mesh_spec);
+    int product = 1;
+    for (int d : mesh) product *= d;
+    if (product != static_cast<int>(device_paths.size())) {
+      std::fprintf(stderr, "mesh %s does not multiply to %zu chips\n",
+                   mesh_spec.c_str(), device_paths.size());
+      return 2;
+    }
+  } else {
+    mesh = {static_cast<int>(device_paths.size())};
+  }
+
+  std::string pjrt_version;
+  if (!pjrt_plugin.empty()) pjrt_version = ProbePjrtPlugin(pjrt_plugin);
+
+  oim::ChipStore store(mesh, accel_type, device_paths, pjrt_version,
+                       pci_addrs);
+  oim::RpcServer server(&store, socket_path);
+  if (!server.Listen()) return 1;
+  g_server = &server;
+  ::signal(SIGINT, HandleSignal);
+  ::signal(SIGTERM, HandleSignal);
+  std::fprintf(stderr, "tpu-agent serving %zu %s chips on %s\n",
+               device_paths.size(), accel_type.c_str(), socket_path.c_str());
+  server.Serve();
+  return 0;
+}

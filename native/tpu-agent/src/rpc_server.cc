@@ -1,0 +1,174 @@
+#include "rpc_server.h"
+
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace oim {
+
+RpcServer::RpcServer(ChipStore* store, std::string socket_path)
+    : store_(store), socket_path_(std::move(socket_path)) {}
+
+RpcServer::~RpcServer() { Shutdown(); }
+
+bool RpcServer::Listen() {
+  listen_fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) {
+    std::perror("socket");
+    return false;
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  if (socket_path_.size() >= sizeof(addr.sun_path)) {
+    std::fprintf(stderr, "socket path too long: %s\n", socket_path_.c_str());
+    return false;
+  }
+  std::strncpy(addr.sun_path, socket_path_.c_str(), sizeof(addr.sun_path) - 1);
+
+  // Refuse to steal a live socket; remove a stale one.
+  int probe = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (probe >= 0) {
+    if (::connect(probe, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+        0) {
+      std::fprintf(stderr, "%s is already in use\n", socket_path_.c_str());
+      ::close(probe);
+      return false;
+    }
+    ::close(probe);
+  }
+  ::unlink(socket_path_.c_str());
+
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+      0) {
+    std::perror("bind");
+    return false;
+  }
+  if (::listen(listen_fd_, 16) < 0) {
+    std::perror("listen");
+    return false;
+  }
+  return true;
+}
+
+void RpcServer::Serve() {
+  while (!shutdown_.load()) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR || errno == EBADF || shutdown_.load()) break;
+      std::perror("accept");
+      continue;
+    }
+    {
+      std::lock_guard<std::mutex> lock(conn_mutex_);
+      if (shutdown_.load()) {
+        ::close(fd);
+        break;
+      }
+      conn_fds_.insert(fd);
+    }
+    std::thread(&RpcServer::HandleConnection, this, fd).detach();
+  }
+  // Drain: Shutdown() has already shut down every open connection fd, which
+  // makes the handlers' read() return 0; wait for them all to finish before
+  // the caller tears down the ChipStore.
+  std::unique_lock<std::mutex> lock(conn_mutex_);
+  conn_done_.wait(lock, [this] { return conn_fds_.empty(); });
+}
+
+void RpcServer::Shutdown() {
+  if (shutdown_.exchange(true)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  {
+    std::lock_guard<std::mutex> lock(conn_mutex_);
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+  }
+  ::unlink(socket_path_.c_str());
+}
+
+void RpcServer::HandleConnection(int fd) {
+  std::string buffer;
+  char chunk[4096];
+  while (true) {
+    ssize_t n = ::read(fd, chunk, sizeof(chunk));
+    if (n <= 0) break;
+    buffer.append(chunk, static_cast<size_t>(n));
+    size_t pos;
+    bool closed = false;
+    while ((pos = buffer.find('\n')) != std::string::npos) {
+      std::string line = buffer.substr(0, pos);
+      buffer.erase(0, pos + 1);
+      if (line.empty()) continue;
+      std::string response = DispatchLine(line) + "\n";
+      size_t written = 0;
+      while (written < response.size()) {
+        ssize_t w =
+            ::write(fd, response.data() + written, response.size() - written);
+        if (w <= 0) {
+          closed = true;
+          break;
+        }
+        written += static_cast<size_t>(w);
+      }
+      if (closed) break;
+    }
+    if (closed) break;
+  }
+  {
+    std::lock_guard<std::mutex> lock(conn_mutex_);
+    conn_fds_.erase(fd);
+  }
+  conn_done_.notify_all();
+  ::close(fd);
+}
+
+std::string RpcServer::DispatchLine(const std::string& line) {
+  Json response = Json::object();
+  response.set("jsonrpc", Json::str("2.0"));
+  Json request;
+  std::string parse_error;
+  if (!Json::parse(line, &request, &parse_error)) {
+    response.set("id", Json());
+    Json err = Json::object();
+    err.set("code", Json::integer(kErrParse));
+    err.set("message", Json::str(parse_error));
+    response.set("error", std::move(err));
+    return response.dump();
+  }
+  const Json* id = request.find("id");
+  response.set("id", id != nullptr ? *id : Json());
+  try {
+    const Json* version = request.find("jsonrpc");
+    const Json* method = request.find("method");
+    if (version == nullptr || version->as_string() != "2.0" ||
+        method == nullptr) {
+      throw RpcError{kErrInvalidRequest, "not a JSON-RPC 2.0 request"};
+    }
+    const Json* params = request.find("params");
+    Json empty = Json::object();
+    if (params != nullptr && params->type() != Json::kObject) {
+      throw RpcError{kErrInvalidParams, "params must be an object"};
+    }
+    Json result =
+        store_->Handle(method->as_string(), params != nullptr ? *params : empty);
+    response.set("result", std::move(result));
+  } catch (const RpcError& rpc_error) {
+    Json err = Json::object();
+    err.set("code", Json::integer(rpc_error.code));
+    err.set("message", Json::str(rpc_error.message));
+    response.set("error", std::move(err));
+  }
+  return response.dump();
+}
+
+}  // namespace oim

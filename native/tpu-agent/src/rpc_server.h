@@ -1,0 +1,49 @@
+// NDJSON JSON-RPC 2.0 server over a Unix stream socket.
+//
+// The daemon-side counterpart of oim_tpu/agent/client.py: accepts
+// connections, reads one JSON-RPC request per line, dispatches into the
+// ChipStore, writes one response per line.  Thread-per-connection — the
+// control plane is deliberately low-frequency (short-lived, infrequent
+// connections; the data plane never passes through this socket).
+
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <mutex>
+#include <set>
+#include <string>
+
+#include "chip_store.h"
+
+namespace oim {
+
+class RpcServer {
+ public:
+  RpcServer(ChipStore* store, std::string socket_path);
+  ~RpcServer();
+
+  // Binds the socket; returns false (with message on stderr) on failure.
+  bool Listen();
+
+  // Accept loop; returns when Shutdown() is called, after every connection
+  // thread has been joined (so the ChipStore outlives all handlers).
+  void Serve();
+
+  void Shutdown();
+
+ private:
+  void HandleConnection(int fd);
+  std::string DispatchLine(const std::string& line);
+
+  ChipStore* store_;
+  std::string socket_path_;
+  int listen_fd_ = -1;
+  std::atomic<bool> shutdown_{false};
+
+  std::mutex conn_mutex_;
+  std::condition_variable conn_done_;
+  std::set<int> conn_fds_;
+};
+
+}  // namespace oim

@@ -1,0 +1,25 @@
+"""Device-plane client + test double (≙ reference pkg/spdk).
+
+``Client``/``Agent`` talk the NDJSON JSON-RPC protocol of doc/agent-protocol.md
+to a tpu-agent daemon (the C++ one under native/tpu-agent, or the in-process
+Python ``FakeAgentServer``).
+"""
+
+from oim_tpu.agent.client import AgentError, Client, is_agent_error
+from oim_tpu.agent.agent import Agent
+from oim_tpu.agent.fake import FakeAgentServer, ChipStore
+
+__all__ = [
+    "Agent",
+    "AgentError",
+    "Client",
+    "is_agent_error",
+    "FakeAgentServer",
+    "ChipStore",
+]
+
+# errno-style application error codes (doc/agent-protocol.md).
+EEXIST = -17
+ENODEV = -19
+ENOSPC = -28
+EBUSY = -16

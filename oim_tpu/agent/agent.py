@@ -1,0 +1,64 @@
+"""Typed wrappers over the raw JSON-RPC client.
+
+≙ reference pkg/spdk/spdk.go:47-286's per-RPC Args/Response bindings — thin,
+validated entry points the controller and CSI local backend call instead of
+stringly-typed ``invoke``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from oim_tpu.agent.client import Client
+
+
+class Agent:
+    def __init__(self, socket_path: str, timeout: float = 60.0) -> None:
+        self.client = Client(socket_path, timeout=timeout)
+
+    # -- queries -----------------------------------------------------------
+
+    def get_topology(self) -> dict[str, Any]:
+        return self.client.invoke("get_topology")
+
+    def get_chips(self) -> list[dict[str, Any]]:
+        return self.client.invoke("get_chips")
+
+    def get_allocations(self, name: str | None = None) -> list[dict[str, Any]]:
+        params = {"name": name} if name else None
+        return self.client.invoke("get_allocations", params)
+
+    def find_allocation(self, name: str) -> dict[str, Any] | None:
+        found = self.get_allocations(name)
+        return found[0] if found else None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def create_allocation(
+        self,
+        name: str,
+        chip_count: int,
+        topology: list[int] | None = None,
+    ) -> dict[str, Any]:
+        params: dict[str, Any] = {"name": name, "chip_count": chip_count}
+        if topology:
+            params["topology"] = list(topology)
+        return self.client.invoke("create_allocation", params)
+
+    def delete_allocation(self, name: str) -> None:
+        self.client.invoke("delete_allocation", {"name": name})
+
+    def attach_allocation(self, name: str) -> dict[str, Any]:
+        return self.client.invoke("attach_allocation", {"name": name})
+
+    def detach_allocation(self, name: str) -> None:
+        self.client.invoke("detach_allocation", {"name": name})
+
+    def close(self) -> None:
+        self.client.close()
+
+    def __enter__(self) -> "Agent":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

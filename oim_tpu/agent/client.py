@@ -1,0 +1,83 @@
+"""JSON-RPC 2.0 NDJSON client for the tpu-agent socket.
+
+≙ reference pkg/spdk/client.go: a small line-oriented JSON-RPC client over a
+Unix stream socket with full wire logging (client.go:230-262) and errors
+surfaced as typed exceptions matchable by code (≙ ``IsJSONError``,
+client.go:70-85).  Deliberately standalone: depends only on oim_tpu.log.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Any
+
+from oim_tpu import log
+
+
+class AgentError(Exception):
+    """A JSON-RPC error response: ``code: %d msg: %s``."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"code: {code} msg: {message}")
+        self.code = code
+        self.message = message
+
+
+def is_agent_error(exc: BaseException, code: int) -> bool:
+    return isinstance(exc, AgentError) and exc.code == code
+
+
+class Client:
+    """One connection to a tpu-agent socket; thread-safe request/response."""
+
+    def __init__(self, path: str, timeout: float = 60.0) -> None:
+        self.path = path
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        self._sock.connect(path)
+        self._file = self._sock.makefile("rb")
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def invoke(self, method: str, params: dict[str, Any] | None = None) -> Any:
+        with self._lock:
+            self._next_id += 1
+            request: dict[str, Any] = {
+                "jsonrpc": "2.0",
+                "id": self._next_id,
+                "method": method,
+            }
+            # params omitted when empty (≙ reference client.go:104-126).
+            if params:
+                request["params"] = params
+            wire = json.dumps(request, separators=(",", ":")) + "\n"
+            logger = log.current()
+            logger.debug("agent request", data=wire.rstrip())
+            self._sock.sendall(wire.encode())
+            line = self._file.readline()
+            if not line:
+                raise ConnectionError(f"agent at {self.path} closed connection")
+            logger.debug("agent response", data=line.decode().rstrip())
+            response = json.loads(line)
+        if response.get("id") != request["id"]:
+            raise ConnectionError(
+                f"agent response id {response.get('id')} != {request['id']}"
+            )
+        if "error" in response:
+            err = response["error"]
+            raise AgentError(int(err.get("code", 0)), str(err.get("message", "")))
+        return response.get("result")
+
+    def close(self) -> None:
+        try:
+            self._file.close()
+        finally:
+            self._sock.close()
+
+    def __enter__(self) -> "Client":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

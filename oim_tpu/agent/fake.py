@@ -1,0 +1,395 @@
+"""In-process fake tpu-agent: chip store + NDJSON JSON-RPC server.
+
+The Python reference implementation of doc/agent-protocol.md, serving the
+same role as the reference's Malloc BDev mode (volatile fake devices that let
+every layer above run without hardware, reference spec.md:119-122).  The C++
+daemon under native/tpu-agent implements the identical semantics; the shared
+suite in tests/test_agent_protocol.py holds both to this file's behavior.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import socket
+import socketserver
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+from oim_tpu import log
+
+EEXIST = -17
+ENODEV = -19
+ENOSPC = -28
+EBUSY = -16
+INVALID_PARAMS = -32602
+METHOD_NOT_FOUND = -32601
+PARSE_ERROR = -32700
+INVALID_REQUEST = -32600
+
+COORDINATOR_PORT_BASE = 8476
+
+
+class RpcAppError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+@dataclass
+class Chip:
+    chip_id: int
+    device_path: str
+    pci: str
+    accel_type: str
+    phys_coord: tuple[int, ...]
+    allocation: str = ""
+
+    def to_json(self, coord: tuple[int, ...] | None = None) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "chip_id": self.chip_id,
+            "device_path": self.device_path,
+            "pci": self.pci,
+            "accel_type": self.accel_type,
+            "phys_coord": list(self.phys_coord),
+            "allocation": self.allocation,
+        }
+        if coord is not None:
+            out["coord"] = list(coord)
+        return out
+
+
+@dataclass
+class Allocation:
+    name: str
+    chip_ids: list[int]
+    mesh: tuple[int, ...]
+    attached: bool = False
+    coordinator_port: int = 0
+    # chip_id -> coordinate within mesh
+    coords: dict[int, tuple[int, ...]] = field(default_factory=dict)
+
+
+def _sub_boxes(n: int, dims: tuple[int, ...]) -> list[tuple[int, ...]]:
+    """All box shapes with product n fitting inside dims, most compact first.
+
+    Deterministic: sorted by (longest edge, perimeter, shape) so the same
+    request always yields the same placement — the TPU analog of the
+    reference's deterministic SCSI target scan order (reference
+    pkg/oim-controller/controller.go:131-148), except compactness-aware so
+    collectives stay on short ICI paths.
+    """
+    shapes = set()
+
+    def rec(prefix: tuple[int, ...], remaining: int, axis: int) -> None:
+        if axis == len(dims):
+            if remaining == 1:
+                shapes.add(prefix)
+            return
+        for d in range(1, min(dims[axis], remaining) + 1):
+            if remaining % d == 0:
+                rec(prefix + (d,), remaining // d, axis + 1)
+
+    rec((), n, 0)
+    return sorted(shapes, key=lambda s: (max(s), sum(s), s))
+
+
+class ChipStore:
+    """Chip inventory + allocations; the one mutex-guarded source of truth
+    (the role SPDK's bdev/vhost tables play)."""
+
+    def __init__(
+        self,
+        mesh: tuple[int, ...],
+        accel_type: str = "v5p",
+        device_dir: str | None = None,
+        device_paths: list[str] | None = None,
+        pjrt_version: str = "",
+    ) -> None:
+        self.mesh = tuple(int(d) for d in mesh)
+        self.accel_type = accel_type
+        self.pjrt_version = pjrt_version
+        self._lock = threading.Lock()
+        self.allocations: dict[str, Allocation] = {}
+        count = 1
+        for d in self.mesh:
+            count *= d
+        coords = list(itertools.product(*[range(d) for d in self.mesh]))
+        self.chips: dict[int, Chip] = {}
+        for i in range(count):
+            if device_paths is not None:
+                path = device_paths[i]
+            elif device_dir is not None:
+                path = os.path.join(device_dir, f"accel{i}")
+                # Stub device file: NodeStage later bind-mounts/symlinks it
+                # into the pod, so it must exist on disk in fake mode.
+                with open(path, "w") as f:
+                    f.write(f"fake-tpu-chip {i}\n")
+            else:
+                path = f"/dev/accel{i}"
+            self.chips[i] = Chip(
+                chip_id=i,
+                device_path=path,
+                pci=f"0000:{i:02x}:05.0",
+                accel_type=accel_type,
+                phys_coord=coords[i],
+            )
+        self._coord_to_id = {c.phys_coord: c.chip_id for c in self.chips.values()}
+
+    # -- allocator ---------------------------------------------------------
+
+    def _find_chips(
+        self, n: int, topology: tuple[int, ...] | None
+    ) -> tuple[list[int], tuple[int, ...]]:
+        """Pick n free chips; returns (chip_ids in mesh order, mesh shape)."""
+        free = {cid for cid, c in self.chips.items() if not c.allocation}
+        if n > len(free):
+            raise RpcAppError(ENOSPC, f"need {n} chips, {len(free)} free")
+        shapes = (
+            [topology]
+            if topology
+            else _sub_boxes(n, self.mesh) or []
+        )
+        for shape in shapes:
+            if len(shape) != len(self.mesh):
+                continue
+            # Slide the box over every origin, deterministic order.
+            origins = itertools.product(
+                *[range(self.mesh[a] - shape[a] + 1) for a in range(len(shape))]
+            )
+            for origin in origins:
+                ids = []
+                for offset in itertools.product(*[range(d) for d in shape]):
+                    coord = tuple(o + d for o, d in zip(origin, offset))
+                    cid = self._coord_to_id[coord]
+                    if cid not in free:
+                        break
+                    ids.append(cid)
+                else:
+                    return ids, tuple(shape)
+        if topology:
+            raise RpcAppError(
+                ENOSPC, f"no free {'x'.join(map(str, topology))} sub-mesh"
+            )
+        # Fragmented: fall back to a linear mesh of arbitrary free chips.
+        ids = sorted(free)[:n]
+        return ids, (n,)
+
+    # -- RPC semantics -----------------------------------------------------
+
+    def create_allocation(
+        self, name: str, chip_count: int, topology: list[int] | None = None
+    ) -> Allocation:
+        if not name or chip_count <= 0:
+            raise RpcAppError(INVALID_PARAMS, "name and chip_count>0 required")
+        topo = tuple(int(d) for d in topology) if topology else None
+        if topo:
+            prod = 1
+            for d in topo:
+                prod *= d
+            if prod != chip_count:
+                raise RpcAppError(
+                    INVALID_PARAMS,
+                    f"topology {list(topo)} does not multiply to {chip_count}",
+                )
+        with self._lock:
+            existing = self.allocations.get(name)
+            if existing is not None:
+                if len(existing.chip_ids) != chip_count:
+                    raise RpcAppError(
+                        EEXIST,
+                        f"allocation {name!r} exists with "
+                        f"{len(existing.chip_ids)} chips",
+                    )
+                return existing
+            ids, mesh = self._find_chips(chip_count, topo)
+            coords = {
+                cid: offset
+                for cid, offset in zip(
+                    ids, itertools.product(*[range(d) for d in mesh])
+                )
+            }
+            alloc = Allocation(name=name, chip_ids=ids, mesh=mesh, coords=coords)
+            for cid in ids:
+                self.chips[cid].allocation = name
+            self.allocations[name] = alloc
+            return alloc
+
+    def delete_allocation(self, name: str) -> None:
+        with self._lock:
+            alloc = self.allocations.get(name)
+            if alloc is None:
+                raise RpcAppError(ENODEV, f"no allocation {name!r}")
+            if alloc.attached:
+                raise RpcAppError(EBUSY, f"allocation {name!r} is attached")
+            for cid in alloc.chip_ids:
+                self.chips[cid].allocation = ""
+            del self.allocations[name]
+
+    def attach_allocation(self, name: str) -> Allocation:
+        with self._lock:
+            alloc = self.allocations.get(name)
+            if alloc is None:
+                raise RpcAppError(ENODEV, f"no allocation {name!r}")
+            if not alloc.attached:
+                used = {
+                    a.coordinator_port
+                    for a in self.allocations.values()
+                    if a.attached
+                }
+                port = COORDINATOR_PORT_BASE
+                while port in used:
+                    port += 1
+                alloc.coordinator_port = port
+                alloc.attached = True
+            return alloc
+
+    def detach_allocation(self, name: str) -> None:
+        with self._lock:
+            alloc = self.allocations.get(name)
+            if alloc is None:
+                raise RpcAppError(ENODEV, f"no allocation {name!r}")
+            alloc.attached = False
+            alloc.coordinator_port = 0
+
+    # -- JSON views --------------------------------------------------------
+
+    def alloc_json(self, alloc: Allocation) -> dict[str, Any]:
+        return {
+            "name": alloc.name,
+            "chip_count": len(alloc.chip_ids),
+            "mesh": list(alloc.mesh),
+            "attached": alloc.attached,
+            "coordinator_port": alloc.coordinator_port,
+            "chips": [
+                self.chips[cid].to_json(coord=alloc.coords[cid])
+                for cid in alloc.chip_ids
+            ],
+        }
+
+    def handle(self, method: str, params: dict[str, Any]) -> Any:
+        if method == "get_topology":
+            with self._lock:
+                free = sum(1 for c in self.chips.values() if not c.allocation)
+            out = {
+                "accel_type": self.accel_type,
+                "mesh": list(self.mesh),
+                "chip_count": len(self.chips),
+                "free_chips": free,
+            }
+            if self.pjrt_version:
+                out["pjrt_version"] = self.pjrt_version
+            return out
+        if method == "get_chips":
+            with self._lock:
+                return [c.to_json() for c in self.chips.values()]
+        if method == "get_allocations":
+            name = params.get("name")
+            with self._lock:
+                if name:
+                    alloc = self.allocations.get(name)
+                    return [self.alloc_json(alloc)] if alloc else []
+                return [
+                    self.alloc_json(a)
+                    for _, a in sorted(self.allocations.items())
+                ]
+        if method == "create_allocation":
+            alloc = self.create_allocation(
+                params.get("name", ""),
+                int(params.get("chip_count", 0)),
+                params.get("topology"),
+            )
+            return self.alloc_json(alloc)
+        if method == "delete_allocation":
+            self._require_name(params)
+            self.delete_allocation(params["name"])
+            return True
+        if method == "attach_allocation":
+            self._require_name(params)
+            return self.alloc_json(self.attach_allocation(params["name"]))
+        if method == "detach_allocation":
+            self._require_name(params)
+            self.detach_allocation(params["name"])
+            return True
+        raise RpcAppError(METHOD_NOT_FOUND, f"method {method!r} not found")
+
+    @staticmethod
+    def _require_name(params: dict[str, Any]) -> None:
+        if not params.get("name"):
+            raise RpcAppError(INVALID_PARAMS, "name required")
+
+
+class FakeAgentServer:
+    """Threaded Unix-socket NDJSON server around a ChipStore."""
+
+    def __init__(self, store: ChipStore, socket_path: str) -> None:
+        self.store = store
+        self.socket_path = socket_path
+        store_ref = store
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:
+                while True:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    response = _dispatch_line(store_ref, line)
+                    self.wfile.write(
+                        (json.dumps(response, separators=(",", ":")) + "\n").encode()
+                    )
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        parent = os.path.dirname(socket_path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._server = Server(socket_path, Handler)
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "FakeAgentServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="fake-agent"
+        )
+        self._thread.start()
+        log.current().info("fake tpu-agent listening", socket=self.socket_path)
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+
+
+def _dispatch_line(store: ChipStore, line: bytes) -> dict[str, Any]:
+    req_id = None
+    try:
+        request = json.loads(line)
+        if not isinstance(request, dict):
+            raise RpcAppError(INVALID_REQUEST, "not a JSON-RPC 2.0 request")
+        req_id = request.get("id")
+        if request.get("jsonrpc") != "2.0" or "method" not in request:
+            raise RpcAppError(INVALID_REQUEST, "not a JSON-RPC 2.0 request")
+        params = request.get("params") or {}
+        if not isinstance(params, dict):
+            raise RpcAppError(INVALID_PARAMS, "params must be an object")
+        result = store.handle(request["method"], params)
+        return {"jsonrpc": "2.0", "id": req_id, "result": result}
+    except RpcAppError as exc:
+        return {
+            "jsonrpc": "2.0",
+            "id": req_id,
+            "error": {"code": exc.code, "message": exc.message},
+        }
+    except json.JSONDecodeError as exc:
+        return {
+            "jsonrpc": "2.0",
+            "id": req_id,
+            "error": {"code": PARSE_ERROR, "message": str(exc)},
+        }

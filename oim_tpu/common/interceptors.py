@@ -54,7 +54,14 @@ def _format_msg(msg, strip: bool) -> str:
         if strip and _is_secret_field(fd.name):
             parts.append(f"{fd.name}=***stripped***")
         elif fd.type == fd.TYPE_MESSAGE:
-            if fd.label == fd.LABEL_REPEATED:
+            # protobuf >=6 exposes is_repeated as a property; older runtimes
+            # as a method or only as the deprecated .label.
+            rep = getattr(fd, "is_repeated", None)
+            if rep is None:
+                rep = fd.label == fd.LABEL_REPEATED
+            elif callable(rep):
+                rep = rep()
+            if rep:
                 parts.append(
                     f"{fd.name}=[{', '.join(_format_msg(v, strip) for v in value)}]"
                 )

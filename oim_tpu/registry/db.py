@@ -1,0 +1,126 @@
+"""Registry database backends.
+
+≙ reference pkg/oim-registry/registry.go:31-41 (the 3-method ``RegistryDB``
+seam) and memdb.go:15-52 (the mutex-guarded in-memory map).  The reference
+planned an etcd backend behind this seam but never implemented it (reference
+README.md:131-135); here the durable backend is SQLite (WAL mode), which the
+image ships, giving the registry crash-safe state for multi-host deployments
+(BASELINE.json config 5) without an external service.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Protocol
+
+
+class RegistryDB(Protocol):
+    def store(self, path: str, value: str) -> None:
+        """Set ``path`` to ``value``; an empty value deletes the key."""
+        ...
+
+    def lookup(self, path: str) -> str:
+        """Value at ``path``, or "" when absent."""
+        ...
+
+    def keys(self, prefix: str) -> list[str]:
+        """All keys equal to or under ``prefix`` ("" lists everything)."""
+        ...
+
+    def items(self, prefix: str) -> list[tuple[str, str]]:
+        """Sorted (path, value) pairs at or under ``prefix``, read atomically."""
+        ...
+
+
+def _prefix_match(key: str, prefix: str) -> bool:
+    if prefix == "":
+        return True
+    return key == prefix or key.startswith(prefix + "/")
+
+
+def _like_escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("%", "\\%").replace("_", "\\_")
+
+
+class MemRegistryDB:
+    """In-memory backend (≙ memRegistryDB, reference memdb.go:21-52)."""
+
+    def __init__(self) -> None:
+        self._data: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def store(self, path: str, value: str) -> None:
+        with self._lock:
+            if value == "":
+                self._data.pop(path, None)
+            else:
+                self._data[path] = value
+
+    def lookup(self, path: str) -> str:
+        with self._lock:
+            return self._data.get(path, "")
+
+    def keys(self, prefix: str) -> list[str]:
+        with self._lock:
+            return sorted(k for k in self._data if _prefix_match(k, prefix))
+
+    def items(self, prefix: str) -> list[tuple[str, str]]:
+        with self._lock:
+            return sorted(
+                (k, v) for k, v in self._data.items() if _prefix_match(k, prefix)
+            )
+
+
+class SqliteRegistryDB:
+    """Durable backend filling the seam the reference reserved for etcd."""
+
+    def __init__(self, path: str) -> None:
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (path TEXT PRIMARY KEY, value TEXT)"
+            )
+            self._conn.commit()
+
+    def store(self, path: str, value: str) -> None:
+        with self._lock:
+            if value == "":
+                self._conn.execute("DELETE FROM kv WHERE path = ?", (path,))
+            else:
+                self._conn.execute(
+                    "INSERT INTO kv (path, value) VALUES (?, ?) "
+                    "ON CONFLICT(path) DO UPDATE SET value = excluded.value",
+                    (path, value),
+                )
+            self._conn.commit()
+
+    def lookup(self, path: str) -> str:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM kv WHERE path = ?", (path,)
+            ).fetchone()
+        return row[0] if row else ""
+
+    def keys(self, prefix: str) -> list[str]:
+        return [k for k, _ in self.items(prefix)]
+
+    def items(self, prefix: str) -> list[tuple[str, str]]:
+        with self._lock:
+            if prefix == "":
+                rows = self._conn.execute(
+                    "SELECT path, value FROM kv ORDER BY path"
+                ).fetchall()
+            else:
+                rows = self._conn.execute(
+                    "SELECT path, value FROM kv WHERE path = ? "
+                    "OR path LIKE ? ESCAPE '\\' ORDER BY path",
+                    (prefix, _like_escape(prefix) + "/%"),
+                ).fetchall()
+        return [(r[0], r[1]) for r in rows]
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

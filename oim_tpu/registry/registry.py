@@ -1,0 +1,203 @@
+"""The registry service: authz-checked KV plus transparent controller proxy.
+
+≙ reference pkg/oim-registry/registry.go:
+
+- ``SetValue``/``GetValues`` with CommonName authorization
+  (registry.go:84-145): ``user.admin`` may set anything; ``controller.<id>``
+  only its own ``<id>/address``.
+- Transparent proxying of every non-Registry method to the controller named
+  by the ``controllerid`` request metadata (registry.go:147-210 +
+  ``proxy.TransparentHandler``): frames pass through un-deserialized; the
+  proxy dials the controller per call with TLS peer pinned to
+  ``controller.<id>`` and closes the connection when the call ends.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import grpc
+
+from oim_tpu import log
+from oim_tpu.common import endpoint as ep
+from oim_tpu.common import pathutil
+from oim_tpu.common.interceptors import LogServerInterceptor
+from oim_tpu.common.server import NonBlockingGRPCServer
+from oim_tpu.common.tlsconfig import TLSConfig, peer_common_name
+from oim_tpu.registry.db import MemRegistryDB, RegistryDB
+from oim_tpu.spec import REGISTRY, oim_pb2
+
+ADMIN_CN = "user.admin"
+CONTROLLER_CN_PREFIX = "controller."
+HOST_CN_PREFIX = "host."
+
+_ident = lambda b: b
+
+
+class Registry:
+    """gRPC servicer for oim.v1.Registry + proxy director state."""
+
+    def __init__(
+        self,
+        db: RegistryDB | None = None,
+        tls: TLSConfig | None = None,
+        proxy_dial_timeout: float = 10.0,
+    ) -> None:
+        self.db = db if db is not None else MemRegistryDB()
+        self.tls = tls
+        self.proxy_dial_timeout = proxy_dial_timeout
+
+    # -- KV service --------------------------------------------------------
+
+    def SetValue(self, request: oim_pb2.SetValueRequest, context) -> oim_pb2.SetValueReply:
+        try:
+            path = pathutil.clean_path(request.value.path)
+        except ValueError as exc:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        self._check_set_allowed(path, context)
+        self.db.store(path, request.value.value)
+        log.current().info(
+            "registry set", path=path, deleted=request.value.value == ""
+        )
+        return oim_pb2.SetValueReply()
+
+    def GetValues(self, request: oim_pb2.GetValuesRequest, context) -> oim_pb2.GetValuesReply:
+        prefix = ""
+        if request.path:
+            try:
+                prefix = pathutil.clean_path(request.path)
+            except ValueError as exc:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        reply = oim_pb2.GetValuesReply()
+        for key, value in self.db.items(prefix):
+            reply.values.add(path=key, value=value)
+        return reply
+
+    def _check_set_allowed(self, path: str, context) -> None:
+        """CN-based write authorization (≙ registry.go:100-109).
+
+        Unauthenticated (insecure server, e.g. tests) means no restrictions,
+        matching the reference's behavior without TLS configured.
+        """
+        cn = peer_common_name(context)
+        if cn is None or cn == ADMIN_CN:
+            return
+        if cn.startswith(CONTROLLER_CN_PREFIX):
+            controller_id = cn[len(CONTROLLER_CN_PREFIX):]
+            if path == f"{controller_id}/address":
+                return
+            context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                f"{cn!r} may only set {controller_id}/address",
+            )
+        context.abort(
+            grpc.StatusCode.PERMISSION_DENIED,
+            f"{cn!r} is not allowed to set registry values",
+        )
+
+    # -- Transparent proxy -------------------------------------------------
+
+    def _proxy_authz(self, controller_id: str, context) -> None:
+        """Only ``host.<id>`` (the node agent for that controller) and the
+        admin may reach controller ``<id>`` (≙ registry.go:174-184)."""
+        cn = peer_common_name(context)
+        if cn is None or cn == ADMIN_CN:
+            return
+        if cn == f"{HOST_CN_PREFIX}{controller_id}":
+            return
+        context.abort(
+            grpc.StatusCode.PERMISSION_DENIED,
+            f"{cn!r} may not call controller {controller_id!r}",
+        )
+
+    def _connect(self, controller_id: str, context) -> grpc.Channel:
+        """Resolve ``<id>/address`` and dial the controller, pinning its CN
+        (≙ streamDirector.Connect, registry.go:186-203)."""
+        address = self.db.lookup(f"{controller_id}/address")
+        if not address:
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"no address registered for controller {controller_id!r}",
+            )
+        target = ep.parse(address).grpc_target()
+        if self.tls is not None:
+            tls = self.tls.with_peer(f"{CONTROLLER_CN_PREFIX}{controller_id}")
+            return grpc.secure_channel(
+                target, tls.channel_credentials(), options=tls.channel_options()
+            )
+        return grpc.insecure_channel(target)
+
+    def _proxy_behavior(self, method: str):
+        def behavior(request_iterator, context) -> Iterator[bytes]:
+            metadata = dict(context.invocation_metadata())
+            controller_id = metadata.get("controllerid")
+            if not controller_id:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"unknown method {method} without controllerid metadata",
+                )
+            self._proxy_authz(controller_id, context)
+            with log.with_fields(method=method, controllerid=controller_id):
+                log.current().debug("proxying")
+                channel = self._connect(controller_id, context)
+                try:
+                    call = channel.stream_stream(
+                        method,
+                        request_serializer=_ident,
+                        response_deserializer=_ident,
+                    )(
+                        request_iterator,
+                        timeout=context.time_remaining(),
+                        metadata=context.invocation_metadata(),
+                    )
+                    yield from call
+                except grpc.RpcError as exc:
+                    # Surface the controller's status verbatim to the caller.
+                    context.abort(exc.code(), exc.details())
+                finally:
+                    # Per-call connection, released on completion
+                    # (≙ registry.go:206-210).
+                    channel.close()
+
+        return behavior
+
+    def proxy_handler(self) -> grpc.GenericRpcHandler:
+        """Generic handler forwarding any non-Registry method."""
+        registry_prefix = f"/{REGISTRY.full_name}/"
+        proxy = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                method = handler_call_details.method
+                if method.startswith(registry_prefix):
+                    return None
+                return grpc.stream_stream_rpc_method_handler(
+                    proxy._proxy_behavior(method),
+                    request_deserializer=_ident,
+                    response_serializer=_ident,
+                )
+
+        return Handler()
+
+    # -- Serving -----------------------------------------------------------
+
+    def registrar(self):
+        """Registrar wiring the KV service plus the transparent proxy
+        (≙ registry.Server wiring, registry.go:248-261)."""
+
+        def register(server: grpc.Server) -> None:
+            REGISTRY.registrar(self)(server)
+            server.add_generic_rpc_handlers((self.proxy_handler(),))
+
+        return register
+
+    def start_server(
+        self, endpoint: str, interceptors: tuple = ()
+    ) -> NonBlockingGRPCServer:
+        srv = NonBlockingGRPCServer(
+            endpoint,
+            tls=self.tls,
+            interceptors=interceptors or (LogServerInterceptor(),),
+        )
+        srv.start(self.registrar())
+        return srv

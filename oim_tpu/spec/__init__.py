@@ -1,0 +1,31 @@
+"""Wire spec: generated protobuf bindings + gRPC service descriptors.
+
+≙ reference pkg/spec: the generated code lives under ``gen/`` (from
+``make gen``; source of truth is doc/spec.md and proto/csi/v1/csi.proto).
+Because the image has protoc but not the grpc python plugin, service
+client/server plumbing is provided by ``oim_tpu.spec.rpc`` service
+descriptors instead of generated stubs.
+"""
+
+from oim_tpu.spec.gen.oim.v1 import oim_pb2
+from oim_tpu.spec.gen.csi.v1 import csi_pb2
+
+from oim_tpu.spec.rpc import (
+    ServiceSpec,
+    REGISTRY,
+    CONTROLLER,
+    CSI_IDENTITY,
+    CSI_CONTROLLER,
+    CSI_NODE,
+)
+
+__all__ = [
+    "oim_pb2",
+    "csi_pb2",
+    "ServiceSpec",
+    "REGISTRY",
+    "CONTROLLER",
+    "CSI_IDENTITY",
+    "CSI_CONTROLLER",
+    "CSI_NODE",
+]

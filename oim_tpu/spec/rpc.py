@@ -1,0 +1,155 @@
+"""gRPC service descriptors: typed stubs and server registration.
+
+Replaces protoc-generated service code (the image lacks the grpc protoc
+plugin): a ``ServiceSpec`` names a service's methods with their request/reply
+message classes and can mint client stubs (``stub``) and server registrars
+(``registrar``) from them.  Method paths are canonical
+(``/package.Service/Method``) so the wire format matches generated peers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import grpc
+
+from oim_tpu.spec.gen.csi.v1 import csi_pb2
+from oim_tpu.spec.gen.oim.v1 import oim_pb2
+
+
+class ServiceSpec:
+    def __init__(self, full_name: str, methods: dict[str, tuple[type, type]]):
+        self.full_name = full_name
+        self.methods = methods
+
+    def method_path(self, name: str) -> str:
+        if name not in self.methods:
+            raise KeyError(f"{self.full_name} has no method {name}")
+        return f"/{self.full_name}/{name}"
+
+    def stub(self, channel: grpc.Channel) -> "Stub":
+        return Stub(self, channel)
+
+    def registrar(self, servicer: object) -> Callable[[grpc.Server], None]:
+        """A registrar adding ``servicer`` (an object with one method per RPC
+        name, ``(request, context) -> reply``) to a server."""
+        handlers = {}
+        for name, (req_cls, reply_cls) in self.methods.items():
+            behavior = getattr(servicer, name, None)
+            if behavior is None:
+                continue
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                behavior,
+                request_deserializer=req_cls.FromString,
+                response_serializer=reply_cls.SerializeToString,
+            )
+        if not handlers:
+            raise ValueError(
+                f"servicer {servicer!r} implements no {self.full_name} methods"
+            )
+        generic = grpc.method_handlers_generic_handler(self.full_name, handlers)
+
+        def register(server: grpc.Server) -> None:
+            server.add_generic_rpc_handlers((generic,))
+
+        return register
+
+
+class Stub:
+    """Per-service client: one callable attribute per method.
+
+    ``stub.MapVolume(request, timeout=..., metadata=...)`` — metadata is how
+    proxied calls carry the ``controllerid`` routing key (≙ reference
+    pkg/oim-csi-driver/remote.go:78).
+    """
+
+    def __init__(self, spec: ServiceSpec, channel: grpc.Channel):
+        self._spec = spec
+        for name, (req_cls, reply_cls) in spec.methods.items():
+            callable_ = channel.unary_unary(
+                spec.method_path(name),
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=reply_cls.FromString,
+            )
+            setattr(self, name, callable_)
+
+
+REGISTRY = ServiceSpec(
+    "oim.v1.Registry",
+    {
+        "SetValue": (oim_pb2.SetValueRequest, oim_pb2.SetValueReply),
+        "GetValues": (oim_pb2.GetValuesRequest, oim_pb2.GetValuesReply),
+    },
+)
+
+CONTROLLER = ServiceSpec(
+    "oim.v1.Controller",
+    {
+        "MapVolume": (oim_pb2.MapVolumeRequest, oim_pb2.MapVolumeReply),
+        "UnmapVolume": (oim_pb2.UnmapVolumeRequest, oim_pb2.UnmapVolumeReply),
+        "ProvisionSlice": (
+            oim_pb2.ProvisionSliceRequest,
+            oim_pb2.ProvisionSliceReply,
+        ),
+        "CheckSlice": (oim_pb2.CheckSliceRequest, oim_pb2.CheckSliceReply),
+    },
+)
+
+CSI_IDENTITY = ServiceSpec(
+    "csi.v1.Identity",
+    {
+        "GetPluginInfo": (
+            csi_pb2.GetPluginInfoRequest,
+            csi_pb2.GetPluginInfoResponse,
+        ),
+        "GetPluginCapabilities": (
+            csi_pb2.GetPluginCapabilitiesRequest,
+            csi_pb2.GetPluginCapabilitiesResponse,
+        ),
+        "Probe": (csi_pb2.ProbeRequest, csi_pb2.ProbeResponse),
+    },
+)
+
+CSI_CONTROLLER = ServiceSpec(
+    "csi.v1.Controller",
+    {
+        "CreateVolume": (csi_pb2.CreateVolumeRequest, csi_pb2.CreateVolumeResponse),
+        "DeleteVolume": (csi_pb2.DeleteVolumeRequest, csi_pb2.DeleteVolumeResponse),
+        "ValidateVolumeCapabilities": (
+            csi_pb2.ValidateVolumeCapabilitiesRequest,
+            csi_pb2.ValidateVolumeCapabilitiesResponse,
+        ),
+        "GetCapacity": (csi_pb2.GetCapacityRequest, csi_pb2.GetCapacityResponse),
+        "ControllerGetCapabilities": (
+            csi_pb2.ControllerGetCapabilitiesRequest,
+            csi_pb2.ControllerGetCapabilitiesResponse,
+        ),
+    },
+)
+
+CSI_NODE = ServiceSpec(
+    "csi.v1.Node",
+    {
+        "NodeStageVolume": (
+            csi_pb2.NodeStageVolumeRequest,
+            csi_pb2.NodeStageVolumeResponse,
+        ),
+        "NodeUnstageVolume": (
+            csi_pb2.NodeUnstageVolumeRequest,
+            csi_pb2.NodeUnstageVolumeResponse,
+        ),
+        "NodePublishVolume": (
+            csi_pb2.NodePublishVolumeRequest,
+            csi_pb2.NodePublishVolumeResponse,
+        ),
+        "NodeUnpublishVolume": (
+            csi_pb2.NodeUnpublishVolumeRequest,
+            csi_pb2.NodeUnpublishVolumeResponse,
+        ),
+        "NodeGetCapabilities": (
+            csi_pb2.NodeGetCapabilitiesRequest,
+            csi_pb2.NodeGetCapabilitiesResponse,
+        ),
+        "NodeGetInfo": (csi_pb2.NodeGetInfoRequest, csi_pb2.NodeGetInfoResponse),
+    },
+)

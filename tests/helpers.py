@@ -1,0 +1,82 @@
+"""Shared test doubles.
+
+- ``FakeServicerContext`` fabricates a peer with a chosen TLS CommonName so
+  authorization logic is unit-testable without real TLS (≙ reference
+  ``RegistryClientContext``, pkg/oim-registry/tls.go:22-30).
+- ``MockController`` is an in-memory oim.v1.Controller recording requests
+  (≙ reference registry_test.go:28-53 / oim-driver_test.go:117-143).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from oim_tpu.spec import oim_pb2
+
+
+class FakeAbort(Exception):
+    def __init__(self, code: grpc.StatusCode, details: str):
+        super().__init__(f"{code}: {details}")
+        self.code = code
+        self.details = details
+
+
+class FakeServicerContext:
+    def __init__(self, cn: str | None = None):
+        self._cn = cn
+
+    def auth_context(self):
+        if self._cn is None:
+            return {}
+        return {"x509_common_name": [self._cn.encode()]}
+
+    def abort(self, code, details):
+        raise FakeAbort(code, details)
+
+    def invocation_metadata(self):
+        return ()
+
+    def time_remaining(self):
+        return None
+
+
+class MockController:
+    """Records every request; replies with a canned 1-chip assignment."""
+
+    def __init__(self, fail_with: tuple[grpc.StatusCode, str] | None = None):
+        self.requests: list = []
+        self.fail_with = fail_with
+
+    def _maybe_fail(self, context):
+        if self.fail_with is not None:
+            context.abort(*self.fail_with)
+
+    def MapVolume(self, request, context):
+        self.requests.append(request)
+        self._maybe_fail(context)
+        return oim_pb2.MapVolumeReply(
+            chips=[
+                oim_pb2.ChipAssignment(
+                    chip_id=0,
+                    device_path="/dev/accel0",
+                    pci=oim_pb2.PCIAddress(domain=0, bus=0x3F, device=2, function=0),
+                    coord=oim_pb2.MeshCoord(coords=[0, 0, 0]),
+                )
+            ],
+            mesh=oim_pb2.MeshShape(dims=[1, 1, 1]),
+        )
+
+    def UnmapVolume(self, request, context):
+        self.requests.append(request)
+        self._maybe_fail(context)
+        return oim_pb2.UnmapVolumeReply()
+
+    def ProvisionSlice(self, request, context):
+        self.requests.append(request)
+        self._maybe_fail(context)
+        return oim_pb2.ProvisionSliceReply()
+
+    def CheckSlice(self, request, context):
+        self.requests.append(request)
+        self._maybe_fail(context)
+        return oim_pb2.CheckSliceReply(chip_count=1)

@@ -1,0 +1,224 @@
+"""Shared device-plane protocol suite, run against BOTH implementations:
+
+- the in-process Python fake (oim_tpu/agent/fake.py), and
+- the compiled C++ daemon (native/tpu-agent), spawned as a subprocess with a
+  CmdMonitor watching it (≙ the reference spawning real SPDK for its tier-3
+  tests, reference test/pkg/spdk/spdk.go:84-278).
+
+This is the analog of the reference's SPDK client round-trip tests
+(pkg/spdk/spdk_test.go) with the added guarantee that fake and native agree.
+"""
+
+import json
+import socket
+import subprocess
+import time
+
+import pytest
+
+from oim_tpu import agent as agent_mod
+from oim_tpu.agent import Agent, AgentError, FakeAgentServer, ChipStore
+from oim_tpu.common.cmdmonitor import CmdMonitor
+
+NATIVE_BINARY = "native/tpu-agent/tpu-agent"
+
+
+def _build_native():
+    import os
+
+    result = subprocess.run(
+        ["make", "-C", "native/tpu-agent"], capture_output=True, text=True
+    )
+    return result.returncode == 0 and os.path.exists(NATIVE_BINARY)
+
+
+@pytest.fixture(scope="session")
+def native_built():
+    return _build_native()
+
+
+@pytest.fixture(params=["python", "native"])
+def agent_socket(request, tmp_path, native_built):
+    """Yields the socket path of a 2x2x2 v5p agent in fake-chip mode."""
+    sock = str(tmp_path / "agent.sock")
+    if request.param == "python":
+        store = ChipStore(mesh=(2, 2, 2), device_dir=str(tmp_path))
+        server = FakeAgentServer(store, sock).start()
+        yield sock
+        server.stop()
+    else:
+        if not native_built:
+            pytest.skip("native tpu-agent not built")
+        monitor = CmdMonitor()
+        proc = subprocess.Popen(
+            [
+                NATIVE_BINARY,
+                "--socket", sock,
+                "--fake-chips", "8",
+                "--mesh", "2x2x2",
+                "--state-dir", str(tmp_path),
+            ],
+            pass_fds=[monitor.child_fd],
+            close_fds=True,
+            stderr=subprocess.PIPE,
+        )
+        monitor.after_spawn()
+        deadline = time.time() + 10
+        while True:
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                probe.connect(sock)
+                probe.close()
+                break
+            except OSError:
+                probe.close()
+            assert not monitor.dead(0.05), proc.stderr.read().decode()
+            assert time.time() < deadline, "agent socket never came up"
+        yield sock
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_topology_and_chips(agent_socket):
+    with Agent(agent_socket) as a:
+        topo = a.get_topology()
+        assert topo["mesh"] == [2, 2, 2]
+        assert topo["chip_count"] == 8
+        assert topo["free_chips"] == 8
+        assert topo["accel_type"] == "v5p"
+        chips = a.get_chips()
+        assert len(chips) == 8
+        assert chips[0]["device_path"].endswith("accel0")
+        assert chips[0]["phys_coord"] == [0, 0, 0]
+        assert chips[7]["phys_coord"] == [1, 1, 1]
+        assert all(c["allocation"] == "" for c in chips)
+
+
+def test_allocation_lifecycle(agent_socket):
+    with Agent(agent_socket) as a:
+        alloc = a.create_allocation("vol-1", 4)
+        # Compact deterministic placement: 1x2x2 box at the origin.
+        assert alloc["mesh"] == [1, 2, 2]
+        assert [c["chip_id"] for c in alloc["chips"]] == [0, 1, 2, 3]
+        assert [c["coord"] for c in alloc["chips"]] == [
+            [0, 0, 0], [0, 0, 1], [0, 1, 0], [0, 1, 1],
+        ]
+        assert alloc["attached"] is False
+
+        # Idempotent re-create returns the same allocation.
+        again = a.create_allocation("vol-1", 4)
+        assert [c["chip_id"] for c in again["chips"]] == [0, 1, 2, 3]
+
+        # Same name, different size → EEXIST.
+        with pytest.raises(AgentError) as err:
+            a.create_allocation("vol-1", 2)
+        assert err.value.code == agent_mod.EEXIST
+
+        # Free chips shrink; second allocation lands on the other half.
+        assert a.get_topology()["free_chips"] == 4
+        second = a.create_allocation("vol-2", 4)
+        assert [c["chip_id"] for c in second["chips"]] == [4, 5, 6, 7]
+
+        # Now the store is full.
+        with pytest.raises(AgentError) as err:
+            a.create_allocation("vol-3", 1)
+        assert err.value.code == agent_mod.ENOSPC
+
+        a.delete_allocation("vol-2")
+        assert a.get_topology()["free_chips"] == 4
+        assert [al["name"] for al in a.get_allocations()] == ["vol-1"]
+        assert a.find_allocation("vol-2") is None
+
+        with pytest.raises(AgentError) as err:
+            a.delete_allocation("vol-2")
+        assert err.value.code == agent_mod.ENODEV
+
+
+def test_attach_detach(agent_socket):
+    with Agent(agent_socket) as a:
+        a.create_allocation("vol-1", 2)
+        attached = a.attach_allocation("vol-1")
+        assert attached["attached"] is True
+        port = attached["coordinator_port"]
+        assert port >= 8476
+
+        # Idempotent attach keeps the port.
+        assert a.attach_allocation("vol-1")["coordinator_port"] == port
+
+        # A second attached allocation gets a different port.
+        a.create_allocation("vol-2", 2)
+        assert a.attach_allocation("vol-2")["coordinator_port"] != port
+
+        # Attached allocations cannot be deleted (EBUSY), detach first.
+        with pytest.raises(AgentError) as err:
+            a.delete_allocation("vol-1")
+        assert err.value.code == agent_mod.EBUSY
+        a.detach_allocation("vol-1")
+        a.delete_allocation("vol-1")
+
+        with pytest.raises(AgentError) as err:
+            a.attach_allocation("ghost")
+        assert err.value.code == agent_mod.ENODEV
+
+
+def test_explicit_topology(agent_socket):
+    with Agent(agent_socket) as a:
+        alloc = a.create_allocation("vol-t", 4, topology=[2, 2, 1])
+        assert alloc["mesh"] == [2, 2, 1]
+        with pytest.raises(AgentError) as err:
+            a.create_allocation("vol-bad", 4, topology=[3, 1, 1])
+        assert err.value.code == -32602
+
+
+def test_fragmentation_fallback(agent_socket):
+    with Agent(agent_socket) as a:
+        # Pin two chips so no 2x2x2-box-free region of 4 in one plane exists.
+        a.create_allocation("pin-a", 1)  # chip 0
+        a.create_allocation("pin-b", 1, topology=[1, 1, 1])  # chip 1
+        alloc = a.create_allocation("vol-f", 4)
+        # A 1x2x2 box still fits at x=1 → compact placement preferred.
+        assert alloc["mesh"] == [1, 2, 2]
+        assert [c["chip_id"] for c in alloc["chips"]] == [4, 5, 6, 7]
+        # Now only chips 2,3 are free; a request for 2 fits a 1x1x2 box.
+        assert a.create_allocation("vol-g", 2)["mesh"] == [1, 1, 2]
+
+
+def test_linear_fallback_when_no_box_fits(agent_socket):
+    with Agent(agent_socket) as a:
+        # Occupy chips so the 3 remaining free ones never form a box.
+        a.create_allocation("a", 1)  # chip 0
+        a.create_allocation("b", 4, topology=[1, 2, 2])  # chips 4..7
+        # Free: 1,2,3 — no 1x1x3 or 3-box exists in a 2x2x2 mesh.
+        alloc = a.create_allocation("c", 3)
+        assert alloc["mesh"] == [3]
+        assert [c["chip_id"] for c in alloc["chips"]] == [1, 2, 3]
+
+
+def test_wire_errors(agent_socket):
+    """Raw-socket probes of the framing layer."""
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(agent_socket)
+    f = s.makefile("rb")
+
+    def send(line: bytes) -> dict:
+        s.sendall(line + b"\n")
+        return json.loads(f.readline())
+
+    # Parse error.
+    resp = send(b"this is not json")
+    assert resp["error"]["code"] == -32700
+
+    # Valid JSON, not a JSON-RPC request.
+    resp = send(b'{"id": 7, "jsonrpc": "1.0"}')
+    assert resp["error"]["code"] == -32600
+    assert resp["id"] == 7
+
+    # Unknown method.
+    resp = send(b'{"jsonrpc": "2.0", "id": 8, "method": "explode"}')
+    assert resp["error"]["code"] == -32601
+
+    # Non-object params.
+    resp = send(b'{"jsonrpc": "2.0", "id": 9, "method": "get_chips", "params": [1]}')
+    assert resp["error"]["code"] == -32602
+
+    s.close()

@@ -1,0 +1,247 @@
+"""Registry tests: DB backends, KV authz, transparent proxy.
+
+≙ reference pkg/oim-registry/registry_test.go (KV + proxy + authz) and
+memdb_test coverage.
+"""
+
+import grpc
+import pytest
+
+from oim_tpu.common.ca import CertAuthority
+from oim_tpu.common.interceptors import PeerCheckInterceptor
+from oim_tpu.common.server import NonBlockingGRPCServer
+from oim_tpu.common.tlsconfig import TLSConfig
+from oim_tpu.registry import MemRegistryDB, Registry, SqliteRegistryDB
+from oim_tpu.spec import CONTROLLER, REGISTRY, oim_pb2
+
+from helpers import FakeAbort, FakeServicerContext, MockController
+
+
+# ---------------------------------------------------------------------------
+# DB backends
+
+
+@pytest.mark.parametrize("make_db", [MemRegistryDB, None], ids=["mem", "sqlite"])
+def test_db_backend(make_db, tmp_path):
+    db = make_db() if make_db else SqliteRegistryDB(str(tmp_path / "reg.db"))
+    db.store("ctrl-1/address", "tcp://a:1")
+    db.store("ctrl-1/pci", "0000:3f:00.0")
+    db.store("ctrl-10/address", "tcp://b:2")
+    assert db.lookup("ctrl-1/address") == "tcp://a:1"
+    assert db.lookup("missing") == ""
+    # Prefix is path-element-wise: ctrl-1 must not match ctrl-10.
+    assert db.keys("ctrl-1") == ["ctrl-1/address", "ctrl-1/pci"]
+    assert db.keys("") == ["ctrl-1/address", "ctrl-1/pci", "ctrl-10/address"]
+    db.store("ctrl-1/pci", "")
+    assert db.lookup("ctrl-1/pci") == ""
+    assert db.keys("ctrl-1") == ["ctrl-1/address"]
+
+
+def test_sqlite_durability(tmp_path):
+    path = str(tmp_path / "reg.db")
+    db = SqliteRegistryDB(path)
+    db.store("ctrl-1/address", "tcp://a:1")
+    db.close()
+    db2 = SqliteRegistryDB(path)
+    assert db2.lookup("ctrl-1/address") == "tcp://a:1"
+    db2.close()
+
+
+# ---------------------------------------------------------------------------
+# KV authorization (unit-level, fake TLS context)
+
+
+def _set(reg, cn, path, value="v"):
+    req = oim_pb2.SetValueRequest(value=oim_pb2.Value(path=path, value=value))
+    reg.SetValue(req, FakeServicerContext(cn))
+
+
+def test_set_value_authz():
+    reg = Registry()
+    _set(reg, "user.admin", "anything/at/all")
+    _set(reg, "controller.ctrl-1", "ctrl-1/address")
+    with pytest.raises(FakeAbort) as err:
+        _set(reg, "controller.ctrl-1", "ctrl-2/address")
+    assert err.value.code == grpc.StatusCode.PERMISSION_DENIED
+    with pytest.raises(FakeAbort):
+        _set(reg, "controller.ctrl-1", "ctrl-1/pci")
+    with pytest.raises(FakeAbort):
+        _set(reg, "host.ctrl-1", "ctrl-1/address")
+    # Unauthenticated (insecure test server) is unrestricted.
+    _set(reg, None, "whatever")
+
+
+def test_set_value_invalid_path():
+    reg = Registry()
+    with pytest.raises(FakeAbort) as err:
+        _set(reg, "user.admin", "../escape")
+    assert err.value.code == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_get_values_prefix():
+    reg = Registry()
+    _set(reg, None, "a/x", "1")
+    _set(reg, None, "a/y", "2")
+    _set(reg, None, "ab/z", "3")
+    reply = reg.GetValues(
+        oim_pb2.GetValuesRequest(path="a"), FakeServicerContext()
+    )
+    assert [(v.path, v.value) for v in reply.values] == [("a/x", "1"), ("a/y", "2")]
+    everything = reg.GetValues(oim_pb2.GetValuesRequest(), FakeServicerContext())
+    assert len(everything.values) == 3
+
+
+# ---------------------------------------------------------------------------
+# Transparent proxy (insecure, full gRPC chain)
+
+
+@pytest.fixture
+def proxy_chain():
+    """registry server + mock controller server + client channel."""
+    mock = MockController()
+    ctrl_srv = NonBlockingGRPCServer("tcp://127.0.0.1:0")
+    ctrl_srv.start(CONTROLLER.registrar(mock))
+
+    reg = Registry()
+    reg_srv = reg.start_server("tcp://127.0.0.1:0")
+    reg.db.store("ctrl-1/address", str(ctrl_srv.addr()))
+
+    channel = grpc.insecure_channel(reg_srv.addr().grpc_target())
+    yield mock, reg, channel
+    channel.close()
+    reg_srv.stop()
+    ctrl_srv.stop()
+
+
+def test_proxy_routes_by_metadata(proxy_chain):
+    mock, reg, channel = proxy_chain
+    stub = CONTROLLER.stub(channel)
+    reply = stub.MapVolume(
+        oim_pb2.MapVolumeRequest(volume_id="vol-1"),
+        metadata=(("controllerid", "ctrl-1"),),
+        timeout=10,
+    )
+    assert reply.chips[0].device_path == "/dev/accel0"
+    assert len(mock.requests) == 1
+    assert mock.requests[0].volume_id == "vol-1"
+
+
+def test_proxy_requires_controllerid(proxy_chain):
+    _, _, channel = proxy_chain
+    stub = CONTROLLER.stub(channel)
+    with pytest.raises(grpc.RpcError) as err:
+        stub.MapVolume(oim_pb2.MapVolumeRequest(volume_id="v"), timeout=10)
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_proxy_unknown_controller(proxy_chain):
+    _, _, channel = proxy_chain
+    stub = CONTROLLER.stub(channel)
+    with pytest.raises(grpc.RpcError) as err:
+        stub.MapVolume(
+            oim_pb2.MapVolumeRequest(volume_id="v"),
+            metadata=(("controllerid", "ghost"),),
+            timeout=10,
+        )
+    assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+
+
+def test_proxy_propagates_controller_error():
+    mock = MockController(
+        fail_with=(grpc.StatusCode.RESOURCE_EXHAUSTED, "no chips left")
+    )
+    ctrl_srv = NonBlockingGRPCServer("tcp://127.0.0.1:0")
+    ctrl_srv.start(CONTROLLER.registrar(mock))
+    reg = Registry()
+    reg_srv = reg.start_server("tcp://127.0.0.1:0")
+    reg.db.store("ctrl-1/address", str(ctrl_srv.addr()))
+    try:
+        channel = grpc.insecure_channel(reg_srv.addr().grpc_target())
+        stub = CONTROLLER.stub(channel)
+        with pytest.raises(grpc.RpcError) as err:
+            stub.MapVolume(
+                oim_pb2.MapVolumeRequest(volume_id="v"),
+                metadata=(("controllerid", "ctrl-1"),),
+                timeout=10,
+            )
+        assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert "no chips left" in err.value.details()
+        channel.close()
+    finally:
+        reg_srv.stop()
+        ctrl_srv.stop()
+
+
+def test_registry_kv_over_wire(proxy_chain):
+    _, _, channel = proxy_chain
+    stub = REGISTRY.stub(channel)
+    stub.SetValue(
+        oim_pb2.SetValueRequest(
+            value=oim_pb2.Value(path="ctrl-9/pci", value="0000:3f:00.0")
+        ),
+        timeout=10,
+    )
+    reply = stub.GetValues(oim_pb2.GetValuesRequest(path="ctrl-9"), timeout=10)
+    assert [(v.path, v.value) for v in reply.values] == [
+        ("ctrl-9/pci", "0000:3f:00.0")
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Secure proxy: host.<id> routing authorization over real mTLS
+
+
+@pytest.fixture(scope="module")
+def secure_ca():
+    return CertAuthority()
+
+
+def _tls(ca, cn, peer=""):
+    cred = ca.issue(cn)
+    return TLSConfig(ca.ca_pem, cred.cert_pem, cred.key_pem, peer)
+
+
+def test_secure_proxy_host_authz(secure_ca):
+    ca = secure_ca
+    mock = MockController()
+    # Controller only accepts the registry as a client (≙ reference
+    # controller TLS expecting component.registry).
+    ctrl_srv = NonBlockingGRPCServer(
+        "tcp://127.0.0.1:0",
+        tls=_tls(ca, "controller.ctrl-1"),
+        interceptors=(PeerCheckInterceptor("component.registry"),),
+    )
+    ctrl_srv.start(CONTROLLER.registrar(mock))
+
+    reg = Registry(tls=_tls(ca, "component.registry"))
+    reg_srv = reg.start_server("tcp://127.0.0.1:0")
+    reg.db.store("ctrl-1/address", str(ctrl_srv.addr()))
+
+    def call(client_cn, controller_id="ctrl-1"):
+        tls = _tls(ca, client_cn, peer="component.registry")
+        channel = grpc.secure_channel(
+            reg_srv.addr().grpc_target(),
+            tls.channel_credentials(),
+            options=tls.channel_options(),
+        )
+        try:
+            return CONTROLLER.stub(channel).MapVolume(
+                oim_pb2.MapVolumeRequest(volume_id="v"),
+                metadata=(("controllerid", controller_id),),
+                timeout=10,
+            )
+        finally:
+            channel.close()
+
+    try:
+        # The matching host may route to its controller.
+        assert call("host.ctrl-1").chips[0].device_path == "/dev/accel0"
+        # The admin may too.
+        call("user.admin")
+        # A different host may not.
+        with pytest.raises(grpc.RpcError) as err:
+            call("host.ctrl-2")
+        assert err.value.code() == grpc.StatusCode.PERMISSION_DENIED
+    finally:
+        reg_srv.stop()
+        ctrl_srv.stop()

@@ -1,0 +1,60 @@
+#!/usr/bin/env python3
+"""Extract the protobuf source from the literate spec.
+
+≙ the reference's Makefile extraction of spec.md fenced blocks into
+oim.proto (reference Makefile:85-103).  Concatenates every ```protobuf block
+of doc/spec.md, in order, into proto/oim/v1/oim.proto.  With --check, exits
+nonzero if the committed file differs (the CI sync gate).
+"""
+
+import argparse
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPEC = os.path.join(ROOT, "doc", "spec.md")
+OUT = os.path.join(ROOT, "proto", "oim", "v1", "oim.proto")
+
+HEADER = """\
+// Code generated from doc/spec.md by tools/extract_proto.py. DO NOT EDIT.
+//
+// The literate spec is the source of truth; run `make gen` after editing it.
+
+"""
+
+
+def extract() -> str:
+    with open(SPEC) as f:
+        text = f.read()
+    blocks = re.findall(r"```protobuf\n(.*?)```", text, re.DOTALL)
+    if not blocks:
+        raise SystemExit(f"no ```protobuf blocks found in {SPEC}")
+    return HEADER + "\n".join(b.rstrip() + "\n" for b in blocks)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--check", action="store_true")
+    args = parser.parse_args()
+    content = extract()
+    if args.check:
+        try:
+            with open(OUT) as f:
+                current = f.read()
+        except FileNotFoundError:
+            current = ""
+        if current != content:
+            print(f"{OUT} is out of sync with {SPEC}; run `make gen`",
+                  file=sys.stderr)
+            return 1
+        return 0
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        f.write(content)
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
