@@ -170,7 +170,8 @@ bool ChipStore::FindChips(int n, const std::vector<int>& topology,
 
 Allocation& ChipStore::CreateAllocation(const std::string& name,
                                         int chip_count,
-                                        const std::vector<int>& topology) {
+                                        const std::vector<int>& topology,
+                                        bool provisioned) {
   if (name.empty() || chip_count <= 0) {
     throw RpcError{kErrInvalidParams, "name and chip_count>0 required"};
   }
@@ -189,6 +190,7 @@ Allocation& ChipStore::CreateAllocation(const std::string& name,
   }
   Allocation alloc;
   alloc.name = name;
+  alloc.provisioned = provisioned;
   FindChips(chip_count, topology, &alloc.chip_ids, &alloc.mesh);
   auto offsets = AllCoords(alloc.mesh);
   for (size_t i = 0; i < alloc.chip_ids.size(); i++) {
@@ -278,6 +280,7 @@ Json ChipStore::AllocJson(const Allocation& alloc) const {
   j.set("chip_count", Json::integer(alloc.chip_ids.size()));
   j.set("mesh", IntArray(alloc.mesh));
   j.set("attached", Json::boolean(alloc.attached));
+  j.set("provisioned", Json::boolean(alloc.provisioned));
   j.set("coordinator_port", Json::integer(alloc.coordinator_port));
   Json chips = Json::array();
   for (int cid : alloc.chip_ids) {
@@ -335,9 +338,11 @@ Json ChipStore::Handle(const std::string& method, const Json& params) {
     if (const Json* topo = params.find("topology")) {
       topology = ParseIntArray(*topo);
     }
+    const Json* provisioned = params.find("provisioned");
     return AllocJson(CreateAllocation(
         name != nullptr ? name->as_string() : "",
-        count != nullptr ? static_cast<int>(count->as_int()) : 0, topology));
+        count != nullptr ? static_cast<int>(count->as_int()) : 0, topology,
+        provisioned != nullptr && provisioned->as_bool()));
   }
   if (method == "delete_allocation") {
     DeleteAllocation(name_param());

@@ -48,6 +48,7 @@ struct Allocation {
   std::vector<int> chip_ids;          // in mesh row-major order
   std::vector<int> mesh;
   bool attached = false;
+  bool provisioned = false;  // created via ProvisionSlice (Malloc analog)
   int coordinator_port = 0;
   std::map<int, std::vector<int>> coords;  // chip_id -> coord within mesh
 };
@@ -72,7 +73,8 @@ class ChipStore {
   Json AllocJson(const Allocation& alloc) const;
 
   Allocation& CreateAllocation(const std::string& name, int chip_count,
-                               const std::vector<int>& topology);
+                               const std::vector<int>& topology,
+                               bool provisioned);
   void DeleteAllocation(const std::string& name);
   Allocation& AttachAllocation(const std::string& name);
   void DetachAllocation(const std::string& name);

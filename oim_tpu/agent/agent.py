@@ -39,10 +39,13 @@ class Agent:
         name: str,
         chip_count: int,
         topology: list[int] | None = None,
+        provisioned: bool = False,
     ) -> dict[str, Any]:
         params: dict[str, Any] = {"name": name, "chip_count": chip_count}
         if topology:
             params["topology"] = list(topology)
+        if provisioned:
+            params["provisioned"] = True
         return self.client.invoke("create_allocation", params)
 
     def delete_allocation(self, name: str) -> None:

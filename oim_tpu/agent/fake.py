@@ -68,6 +68,7 @@ class Allocation:
     chip_ids: list[int]
     mesh: tuple[int, ...]
     attached: bool = False
+    provisioned: bool = False
     coordinator_port: int = 0
     # chip_id -> coordinate within mesh
     coords: dict[int, tuple[int, ...]] = field(default_factory=dict)
@@ -123,6 +124,7 @@ class ChipStore:
             if device_paths is not None:
                 path = device_paths[i]
             elif device_dir is not None:
+                os.makedirs(device_dir, exist_ok=True)
                 path = os.path.join(device_dir, f"accel{i}")
                 # Stub device file: NodeStage later bind-mounts/symlinks it
                 # into the pod, so it must exist on disk in fake mode.
@@ -181,7 +183,11 @@ class ChipStore:
     # -- RPC semantics -----------------------------------------------------
 
     def create_allocation(
-        self, name: str, chip_count: int, topology: list[int] | None = None
+        self,
+        name: str,
+        chip_count: int,
+        topology: list[int] | None = None,
+        provisioned: bool = False,
     ) -> Allocation:
         if not name or chip_count <= 0:
             raise RpcAppError(INVALID_PARAMS, "name and chip_count>0 required")
@@ -212,7 +218,13 @@ class ChipStore:
                     ids, itertools.product(*[range(d) for d in mesh])
                 )
             }
-            alloc = Allocation(name=name, chip_ids=ids, mesh=mesh, coords=coords)
+            alloc = Allocation(
+                name=name,
+                chip_ids=ids,
+                mesh=mesh,
+                coords=coords,
+                provisioned=provisioned,
+            )
             for cid in ids:
                 self.chips[cid].allocation = name
             self.allocations[name] = alloc
@@ -263,6 +275,7 @@ class ChipStore:
             "chip_count": len(alloc.chip_ids),
             "mesh": list(alloc.mesh),
             "attached": alloc.attached,
+            "provisioned": alloc.provisioned,
             "coordinator_port": alloc.coordinator_port,
             "chips": [
                 self.chips[cid].to_json(coord=alloc.coords[cid])
@@ -301,6 +314,7 @@ class ChipStore:
                 params.get("name", ""),
                 int(params.get("chip_count", 0)),
                 params.get("topology"),
+                provisioned=bool(params.get("provisioned", False)),
             )
             return self.alloc_json(alloc)
         if method == "delete_allocation":

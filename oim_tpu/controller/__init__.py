@@ -1,0 +1,6 @@
+"""Per-device controller (≙ reference pkg/oim-controller)."""
+
+from oim_tpu.controller.controller import Controller
+from oim_tpu.controller.keymutex import KeyMutex
+
+__all__ = ["Controller", "KeyMutex"]

@@ -1,0 +1,377 @@
+"""The per-device controller: volumes ↔ TPU sub-slices.
+
+≙ reference pkg/oim-controller/controller.go:
+
+- ``MapVolume`` ensures the allocation exists (pre-provisioned allocations
+  must already exist, like Malloc BDevs; on-demand ones are created, like
+  Ceph BDevs; controller.go:55-156) and attaches it idempotently, returning
+  chip device paths + PCI addresses + ICI mesh coordinates and the JAX
+  distributed-coordinator rendezvous (the generalization of PCI BDF +
+  SCSI target/LUN).
+- ``UnmapVolume`` detaches and deletes *on-demand* allocations, keeping
+  pre-provisioned ones (controller.go:159-212); unknown volumes succeed.
+- ``ProvisionSlice``/``CheckSlice`` manage pre-provisioned allocations
+  (≙ ProvisionMallocBDev/CheckMallocBDev, controller.go:215-278).
+- Per-volume serialization via KeyMutex (controller.go:44-51).
+- Background self-registration heartbeat re-``SetValue``-ing the
+  controller's address so the registry survives DB loss
+  (controller.go:411-468).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import grpc
+
+from oim_tpu import log
+from oim_tpu.agent import Agent, AgentError
+from oim_tpu.agent import EBUSY, EEXIST, ENODEV, ENOSPC
+from oim_tpu.common import pci as pcilib
+from oim_tpu.common.interceptors import LogServerInterceptor, PeerCheckInterceptor
+from oim_tpu.common.server import NonBlockingGRPCServer
+from oim_tpu.common.tlsconfig import TLSConfig
+from oim_tpu.common import endpoint as ep
+from oim_tpu.controller.keymutex import KeyMutex
+from oim_tpu.spec import CONTROLLER, REGISTRY, oim_pb2
+
+REGISTRY_CN = "component.registry"
+DEFAULT_REGISTRY_DELAY = 60.0
+
+
+def _agent_error_to_status(exc: AgentError) -> grpc.StatusCode:
+    return {
+        ENOSPC: grpc.StatusCode.RESOURCE_EXHAUSTED,
+        ENODEV: grpc.StatusCode.NOT_FOUND,
+        EEXIST: grpc.StatusCode.ALREADY_EXISTS,
+        EBUSY: grpc.StatusCode.FAILED_PRECONDITION,
+    }.get(exc.code, grpc.StatusCode.INTERNAL)
+
+
+class Controller:
+    """gRPC servicer for oim.v1.Controller backed by one tpu-agent."""
+
+    def __init__(
+        self,
+        controller_id: str,
+        agent_socket: str,
+        registry_address: str = "",
+        tls: TLSConfig | None = None,
+        registry_delay: float = DEFAULT_REGISTRY_DELAY,
+        coordinator_host: str = "127.0.0.1",
+    ) -> None:
+        self.controller_id = controller_id
+        self.agent_socket = agent_socket
+        self.registry_address = registry_address
+        self.tls = tls
+        self.registry_delay = registry_delay
+        self.coordinator_host = coordinator_host
+        self._mutex = KeyMutex()
+        self._agent: Agent | None = None
+        self._agent_lock = threading.Lock()
+        # Heartbeat state (Start/Close).
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._advertised_address = ""
+
+    # -- agent connection --------------------------------------------------
+
+    def agent(self) -> Agent:
+        """Lazy, auto-reconnecting agent connection (the reference connects
+        to SPDK at New() time, controller.go:379-408; lazy lets the daemon
+        and controller start in any order)."""
+        with self._agent_lock:
+            if self._agent is None:
+                self._agent = Agent(self.agent_socket)
+            return self._agent
+
+    def _drop_agent(self) -> None:
+        with self._agent_lock:
+            if self._agent is not None:
+                try:
+                    self._agent.close()
+                except Exception:
+                    pass
+                self._agent = None
+
+    def _call_agent(self, context, fn, *args, **kwargs):
+        """Invoke an agent method, mapping transport failures to UNAVAILABLE
+        and protocol errors to their gRPC status."""
+        try:
+            return fn(self.agent(), *args, **kwargs)
+        except AgentError:
+            raise
+        except (ConnectionError, OSError) as exc:
+            self._drop_agent()
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"tpu-agent at {self.agent_socket} unavailable: {exc}",
+            )
+
+    # -- Controller service ------------------------------------------------
+
+    def MapVolume(self, request: oim_pb2.MapVolumeRequest, context) -> oim_pb2.MapVolumeReply:
+        volume_id = request.volume_id
+        if not volume_id:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "volume_id required")
+        which = request.WhichOneof("params")
+        with self._mutex.locked(volume_id):
+            alloc = self._call_agent(
+                context, lambda a: a.find_allocation(volume_id)
+            )
+            if alloc is None:
+                if which == "slice":
+                    topology = list(request.slice.topology.dims) or None
+                    try:
+                        alloc = self._call_agent(
+                            context,
+                            lambda a: a.create_allocation(
+                                volume_id,
+                                request.slice.chip_count,
+                                topology=topology,
+                            ),
+                        )
+                    except AgentError as exc:
+                        context.abort(_agent_error_to_status(exc), str(exc))
+                elif which == "provisioned":
+                    # Pre-provisioned allocations must already exist
+                    # (≙ Malloc BDevs, controller.go:75-95).
+                    context.abort(
+                        grpc.StatusCode.NOT_FOUND,
+                        f"no provisioned allocation {volume_id!r}",
+                    )
+                else:
+                    context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        "MapVolumeRequest.params required for a new volume",
+                    )
+            elif which == "provisioned" and not alloc["provisioned"]:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    f"allocation {volume_id!r} exists but is on-demand, "
+                    "not provisioned",
+                )
+            elif which == "slice":
+                # Idempotency check: an existing mapping must be compatible
+                # (≙ the reference rejecting size mismatches on re-map).
+                if request.slice.chip_count and (
+                    alloc["chip_count"] != request.slice.chip_count
+                ):
+                    context.abort(
+                        grpc.StatusCode.ALREADY_EXISTS,
+                        f"volume {volume_id!r} already mapped with "
+                        f"{alloc['chip_count']} chips",
+                    )
+                requested_topology = list(request.slice.topology.dims)
+                if requested_topology and alloc["mesh"] != requested_topology:
+                    context.abort(
+                        grpc.StatusCode.ALREADY_EXISTS,
+                        f"volume {volume_id!r} already mapped with mesh "
+                        f"{alloc['mesh']}, not {requested_topology}",
+                    )
+            try:
+                attached = self._call_agent(
+                    context, lambda a: a.attach_allocation(volume_id)
+                )
+            except AgentError as exc:
+                context.abort(_agent_error_to_status(exc), str(exc))
+        return self._reply_from_allocation(attached)
+
+    def _reply_from_allocation(self, alloc: dict) -> oim_pb2.MapVolumeReply:
+        reply = oim_pb2.MapVolumeReply(
+            mesh=oim_pb2.MeshShape(dims=alloc["mesh"]),
+            coordinator_address=(
+                f"{self.coordinator_host}:{alloc['coordinator_port']}"
+                if alloc.get("coordinator_port")
+                else ""
+            ),
+            num_processes=1,
+            process_id=0,
+        )
+        for chip in alloc["chips"]:
+            assignment = reply.chips.add(
+                chip_id=chip["chip_id"],
+                device_path=chip["device_path"],
+                coord=oim_pb2.MeshCoord(coords=chip["coord"]),
+            )
+            try:
+                parsed = pcilib.parse_bdf_string(chip["pci"])
+                assignment.pci.domain = parsed.domain
+                assignment.pci.bus = parsed.bus
+                assignment.pci.device = parsed.device
+                assignment.pci.function = parsed.function
+            except ValueError:
+                # Unknown address: leave all components at the UNKNOWN
+                # encoding for registry-default completion.
+                assignment.pci.domain = pcilib.UNKNOWN
+                assignment.pci.bus = pcilib.UNKNOWN
+                assignment.pci.device = pcilib.UNKNOWN
+                assignment.pci.function = pcilib.UNKNOWN
+        return reply
+
+    def UnmapVolume(self, request: oim_pb2.UnmapVolumeRequest, context) -> oim_pb2.UnmapVolumeReply:
+        volume_id = request.volume_id
+        if not volume_id:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "volume_id required")
+        with self._mutex.locked(volume_id):
+            alloc = self._call_agent(
+                context, lambda a: a.find_allocation(volume_id)
+            )
+            if alloc is None:
+                return oim_pb2.UnmapVolumeReply()  # idempotent
+            try:
+                if alloc["attached"]:
+                    self._call_agent(
+                        context, lambda a: a.detach_allocation(volume_id)
+                    )
+                if not alloc["provisioned"]:
+                    # On-demand allocations are torn down; pre-provisioned
+                    # ones persist (≙ delete non-Malloc BDev,
+                    # controller.go:190-209).
+                    self._call_agent(
+                        context, lambda a: a.delete_allocation(volume_id)
+                    )
+            except AgentError as exc:
+                if exc.code != ENODEV:
+                    context.abort(_agent_error_to_status(exc), str(exc))
+        return oim_pb2.UnmapVolumeReply()
+
+    def ProvisionSlice(self, request: oim_pb2.ProvisionSliceRequest, context) -> oim_pb2.ProvisionSliceReply:
+        name = request.name
+        if not name:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "name required")
+        with self._mutex.locked(name):
+            if request.chip_count > 0:
+                try:
+                    alloc = self._call_agent(
+                        context,
+                        lambda a: a.create_allocation(
+                            name, request.chip_count, provisioned=True
+                        ),
+                    )
+                except AgentError as exc:
+                    context.abort(_agent_error_to_status(exc), str(exc))
+                if not alloc["provisioned"]:
+                    # Idempotent create returned an existing *on-demand*
+                    # allocation — the name is taken by a different kind of
+                    # resource.
+                    context.abort(
+                        grpc.StatusCode.ALREADY_EXISTS,
+                        f"{name!r} is in use by an on-demand allocation",
+                    )
+            else:
+                # chip_count == 0 deletes, idempotently
+                # (≙ controller.go:238-252).
+                try:
+                    alloc = self._call_agent(
+                        context, lambda a: a.find_allocation(name)
+                    )
+                    if alloc is not None:
+                        if alloc["attached"]:
+                            self._call_agent(
+                                context, lambda a: a.detach_allocation(name)
+                            )
+                        self._call_agent(
+                            context, lambda a: a.delete_allocation(name)
+                        )
+                except AgentError as exc:
+                    if exc.code != ENODEV:
+                        context.abort(_agent_error_to_status(exc), str(exc))
+        return oim_pb2.ProvisionSliceReply()
+
+    def CheckSlice(self, request: oim_pb2.CheckSliceRequest, context) -> oim_pb2.CheckSliceReply:
+        name = request.name
+        if not name:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "name required")
+        alloc = self._call_agent(context, lambda a: a.find_allocation(name))
+        if alloc is None or not alloc["provisioned"]:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"no provisioned allocation {name!r}"
+            )
+        return oim_pb2.CheckSliceReply(chip_count=alloc["chip_count"])
+
+    # -- self-registration heartbeat ---------------------------------------
+
+    def start(self, advertised_address: str) -> None:
+        """Begin re-registering ``<id>/address`` every ``registry_delay``
+        seconds (immediately, then periodically; ≙ controller.go:411-443).
+        No-op when no registry is configured (local mode)."""
+        if not self.registry_address:
+            return
+        self._advertised_address = advertised_address
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._register_loop, daemon=True, name="controller-register"
+        )
+        self._thread.start()
+
+    def _register_loop(self) -> None:
+        while True:
+            try:
+                self.register()
+            except grpc.RpcError as exc:
+                log.current().warning(
+                    "registration failed",
+                    registry=self.registry_address,
+                    error=exc.code().name,
+                )
+            except Exception as exc:
+                # Never let the heartbeat thread die: a transient local
+                # failure (cert rotation mid-read, bad address) must not
+                # permanently de-register the controller.
+                log.current().error(
+                    "registration error",
+                    registry=self.registry_address,
+                    error=str(exc),
+                )
+            if self._stop.wait(self.registry_delay):
+                return
+
+    def register(self) -> None:
+        """One registration: fresh dial → SetValue → close (per-operation
+        connections survive registry restarts, ≙ controller.go:448-468)."""
+        target = ep.parse(self.registry_address).grpc_target()
+        if self.tls is not None:
+            tls = self.tls.with_peer(REGISTRY_CN)
+            channel = grpc.secure_channel(
+                target, tls.channel_credentials(), options=tls.channel_options()
+            )
+        else:
+            channel = grpc.insecure_channel(target)
+        try:
+            REGISTRY.stub(channel).SetValue(
+                oim_pb2.SetValueRequest(
+                    value=oim_pb2.Value(
+                        path=f"{self.controller_id}/address",
+                        value=self._advertised_address,
+                    )
+                ),
+                timeout=10,
+            )
+            log.current().debug(
+                "registered", id=self.controller_id, address=self._advertised_address
+            )
+        finally:
+            channel.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._drop_agent()
+
+    # -- serving -----------------------------------------------------------
+
+    def start_server(
+        self, endpoint: str, require_registry_peer: bool = True
+    ) -> NonBlockingGRPCServer:
+        """Serve the Controller service.  With TLS, only the registry's CN is
+        accepted as a client (≙ the reference controller expecting
+        component.registry)."""
+        interceptors: tuple = (LogServerInterceptor(),)
+        if self.tls is not None and require_registry_peer:
+            interceptors = (PeerCheckInterceptor(REGISTRY_CN),) + interceptors
+        srv = NonBlockingGRPCServer(endpoint, tls=self.tls, interceptors=interceptors)
+        srv.start(CONTROLLER.registrar(self))
+        return srv

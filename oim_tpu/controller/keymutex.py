@@ -1,0 +1,37 @@
+"""Per-key mutual exclusion.
+
+≙ the reference's keymutex serializing all operations on one volume
+(reference pkg/oim-controller/controller.go:44-51,
+pkg/oim-csi-driver/serialize.go:13-16): concurrent RPCs for different
+volumes proceed in parallel; same-volume RPCs are strictly ordered.
+Locks are refcounted so idle keys do not accumulate.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator
+
+
+class KeyMutex:
+    def __init__(self) -> None:
+        self._guard = threading.Lock()
+        self._locks: dict[str, tuple[threading.Lock, int]] = {}
+
+    @contextlib.contextmanager
+    def locked(self, key: str) -> Iterator[None]:
+        with self._guard:
+            lock, refs = self._locks.get(key, (threading.Lock(), 0))
+            self._locks[key] = (lock, refs + 1)
+        lock.acquire()
+        try:
+            yield
+        finally:
+            lock.release()
+            with self._guard:
+                lock2, refs = self._locks[key]
+                if refs == 1:
+                    del self._locks[key]
+                else:
+                    self._locks[key] = (lock2, refs - 1)

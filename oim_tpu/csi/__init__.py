@@ -1,0 +1,12 @@
+"""CSI driver: the orchestrator-facing surface (≙ reference pkg/oim-csi-driver).
+
+Serves the CSI v1 Identity/Controller/Node services with two backend
+personalities — **local** (drives the tpu-agent socket directly) and
+**remote** (routes through the registry's transparent proxy to a controller)
+— plus emulation hooks translating third-party drivers' volume parameters.
+"""
+
+from oim_tpu.csi.driver import OIMDriver
+from oim_tpu.csi.backend import LocalBackend, RemoteBackend, StagedDevice
+
+__all__ = ["OIMDriver", "LocalBackend", "RemoteBackend", "StagedDevice"]

@@ -1,0 +1,357 @@
+"""CSI driver backends: local (agent socket) vs remote (registry proxy).
+
+≙ the reference's ``OIMBackend`` split (reference
+pkg/oim-csi-driver/oim-driver.go:71-78; local.go; remote.go): the same CSI
+services drive either the device plane directly (local mode — provisioning
+host) or a controller reached through the registry's transparent proxy
+(remote mode — compute host whose kernel cannot see the device plane).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import grpc
+
+from oim_tpu import log
+from oim_tpu.agent import Agent, AgentError, ENODEV, ENOSPC, EEXIST
+from oim_tpu.common import endpoint as ep
+from oim_tpu.common import pci as pcilib
+from oim_tpu.common.tlsconfig import TLSConfig
+from oim_tpu.spec import CONTROLLER, REGISTRY, oim_pb2
+
+
+@dataclass
+class StagedDevice:
+    """What NodeStage needs to materialize a TPU volume in a pod."""
+
+    volume_id: str
+    chips: list[dict] = field(default_factory=list)
+    mesh: list[int] = field(default_factory=list)
+    coordinator_address: str = ""
+    num_processes: int = 1
+    process_id: int = 0
+
+    def bootstrap(self) -> dict:
+        """The tpu-bootstrap.json contents (consumed by
+        oim_tpu.parallel.coordinator)."""
+        return {
+            "volume_id": self.volume_id,
+            "chips": self.chips,
+            "mesh": self.mesh,
+            "coordinator_address": self.coordinator_address,
+            "num_processes": self.num_processes,
+            "process_id": self.process_id,
+        }
+
+
+class VolumeError(Exception):
+    def __init__(self, code: grpc.StatusCode, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+def _parse_chip_count(params: dict, default: int = 1) -> int:
+    raw = params.get("chipCount", str(default))
+    try:
+        value = int(raw)
+    except (TypeError, ValueError):
+        raise VolumeError(
+            grpc.StatusCode.INVALID_ARGUMENT, f"invalid chipCount {raw!r}"
+        ) from None
+    if value < 0:
+        raise VolumeError(
+            grpc.StatusCode.INVALID_ARGUMENT, f"invalid chipCount {raw!r}"
+        )
+    return value
+
+
+def wait_for_devices(paths: list[str], timeout: float, poll: float = 0.1) -> None:
+    """Block until every device file exists.
+
+    ≙ the reference's ``waitForDevice`` sysfs watch (reference
+    pkg/oim-csi-driver/remote.go:249-290): there it waits for virtio-scsi
+    hotplug; here for the agent-owned device nodes to appear, polling with a
+    deadline (the reference used fsnotify + a 5s rescan tick; a poll loop has
+    the same observable behavior for control-plane latencies).
+    """
+    deadline = time.monotonic() + timeout
+    missing = list(paths)
+    while missing:
+        missing = [p for p in missing if not os.path.exists(p)]
+        if not missing:
+            return
+        if time.monotonic() >= deadline:
+            raise VolumeError(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"device(s) never appeared: {missing}",
+            )
+        time.sleep(poll)
+
+
+def _staged_from_reply(
+    volume_id: str, reply: oim_pb2.MapVolumeReply, default_pci: str = ""
+) -> StagedDevice:
+    """Convert a MapVolumeReply, completing partial PCI addresses from the
+    registry default (≙ ``CompletePCIAddress``, remote.go:170-190)."""
+    fallback = None
+    if default_pci:
+        try:
+            fallback = pcilib.parse_bdf_string(default_pci)
+        except ValueError:
+            log.current().warning("invalid registry pci default", value=default_pci)
+    chips = []
+    for chip in reply.chips:
+        addr = pcilib.PCIAddress(
+            chip.pci.domain, chip.pci.bus, chip.pci.device, chip.pci.function
+        )
+        if fallback is not None:
+            addr = pcilib.merge(addr, fallback)
+        chips.append(
+            {
+                "chip_id": chip.chip_id,
+                "device_path": chip.device_path,
+                "pci": str(addr),
+                "coord": list(chip.coord.coords),
+            }
+        )
+    return StagedDevice(
+        volume_id=volume_id,
+        chips=chips,
+        mesh=list(reply.mesh.dims),
+        coordinator_address=reply.coordinator_address,
+        num_processes=reply.num_processes or 1,
+        process_id=reply.process_id,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Local backend
+
+
+class LocalBackend:
+    """Drives the tpu-agent directly (≙ localSPDK, reference local.go:24-84)."""
+
+    def __init__(self, agent_socket: str) -> None:
+        self.agent_socket = agent_socket
+
+    def _agent(self) -> Agent:
+        try:
+            return Agent(self.agent_socket)
+        except OSError as exc:
+            raise VolumeError(
+                grpc.StatusCode.UNAVAILABLE,
+                f"tpu-agent at {self.agent_socket} unavailable: {exc}",
+            ) from exc
+
+    def provision(self, volume_id: str, chip_count: int) -> int:
+        with self._agent() as agent:
+            try:
+                alloc = agent.create_allocation(
+                    volume_id, chip_count, provisioned=True
+                )
+            except AgentError as exc:
+                code = {
+                    ENOSPC: grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    EEXIST: grpc.StatusCode.ALREADY_EXISTS,
+                }.get(exc.code, grpc.StatusCode.INTERNAL)
+                raise VolumeError(code, str(exc)) from exc
+            if not alloc["provisioned"]:
+                raise VolumeError(
+                    grpc.StatusCode.ALREADY_EXISTS,
+                    f"{volume_id!r} is in use by an on-demand allocation",
+                )
+            return alloc["chip_count"]
+
+    def delete(self, volume_id: str) -> None:
+        with self._agent() as agent:
+            alloc = agent.find_allocation(volume_id)
+            if alloc is None:
+                return
+            try:
+                if alloc["attached"]:
+                    agent.detach_allocation(volume_id)
+                agent.delete_allocation(volume_id)
+            except AgentError as exc:
+                if exc.code != ENODEV:
+                    raise VolumeError(grpc.StatusCode.INTERNAL, str(exc)) from exc
+
+    def capacity(self) -> int:
+        with self._agent() as agent:
+            return agent.get_topology()["free_chips"]
+
+    def create_device(self, volume_id: str, params: dict) -> StagedDevice:
+        with self._agent() as agent:
+            alloc = agent.find_allocation(volume_id)
+            if alloc is None:
+                chip_count = _parse_chip_count(params)
+                try:
+                    agent.create_allocation(volume_id, chip_count)
+                except AgentError as exc:
+                    code = {
+                        ENOSPC: grpc.StatusCode.RESOURCE_EXHAUSTED
+                    }.get(exc.code, grpc.StatusCode.INTERNAL)
+                    raise VolumeError(code, str(exc)) from exc
+            attached = agent.attach_allocation(volume_id)
+        staged = StagedDevice(
+            volume_id=volume_id,
+            chips=[
+                {
+                    "chip_id": c["chip_id"],
+                    "device_path": c["device_path"],
+                    "pci": c["pci"],
+                    "coord": c["coord"],
+                }
+                for c in attached["chips"]
+            ],
+            mesh=attached["mesh"],
+            coordinator_address=(
+                f"127.0.0.1:{attached['coordinator_port']}"
+                if attached.get("coordinator_port")
+                else ""
+            ),
+        )
+        return staged
+
+    def destroy_device(self, volume_id: str) -> None:
+        with self._agent() as agent:
+            alloc = agent.find_allocation(volume_id)
+            if alloc is None:
+                return
+            if alloc["attached"]:
+                agent.detach_allocation(volume_id)
+            if not alloc["provisioned"]:
+                agent.delete_allocation(volume_id)
+
+
+# ---------------------------------------------------------------------------
+# Remote backend
+
+
+class RemoteBackend:
+    """Routes through the registry proxy to a controller (≙ remoteSPDK,
+    reference remote.go:33-42).
+
+    Dials the registry per call — TLS material is (re)loaded through
+    ``tls_loader`` on every dial, so rotated keys are picked up without a
+    restart (≙ remote.go:101-114).
+    """
+
+    def __init__(
+        self,
+        registry_address: str,
+        controller_id: str,
+        tls_loader: Callable[[], TLSConfig] | None = None,
+        map_params: Callable[[dict], oim_pb2.MapVolumeRequest] | None = None,
+    ) -> None:
+        self.registry_address = registry_address
+        self.controller_id = controller_id
+        self.tls_loader = tls_loader
+        self.map_params = map_params
+
+    def _channel(self) -> grpc.Channel:
+        target = ep.parse(self.registry_address).grpc_target()
+        if self.tls_loader is not None:
+            tls = self.tls_loader().with_peer("component.registry")
+            return grpc.secure_channel(
+                target, tls.channel_credentials(), options=tls.channel_options()
+            )
+        return grpc.insecure_channel(target)
+
+    def _metadata(self) -> tuple:
+        # Proxy routing key (≙ remote.go:78).
+        return (("controllerid", self.controller_id),)
+
+    def _call(self, fn):
+        channel = self._channel()
+        try:
+            return fn(channel)
+        except grpc.RpcError as exc:
+            raise VolumeError(exc.code(), exc.details()) from exc
+        finally:
+            channel.close()
+
+    def provision(self, volume_id: str, chip_count: int) -> int:
+        def run(channel):
+            stub = CONTROLLER.stub(channel)
+            stub.ProvisionSlice(
+                oim_pb2.ProvisionSliceRequest(name=volume_id, chip_count=chip_count),
+                metadata=self._metadata(),
+                timeout=30,
+            )
+            return stub.CheckSlice(
+                oim_pb2.CheckSliceRequest(name=volume_id),
+                metadata=self._metadata(),
+                timeout=30,
+            ).chip_count
+
+        return self._call(run)
+
+    def delete(self, volume_id: str) -> None:
+        def run(channel):
+            CONTROLLER.stub(channel).ProvisionSlice(
+                oim_pb2.ProvisionSliceRequest(name=volume_id, chip_count=0),
+                metadata=self._metadata(),
+                timeout=30,
+            )
+
+        self._call(run)
+
+    def capacity(self) -> int:
+        raise VolumeError(
+            grpc.StatusCode.UNIMPLEMENTED,
+            "capacity reporting requires local mode",
+        )
+
+    def default_pci(self, channel) -> str:
+        """Registry-stored PCI default for this controller
+        (≙ remote.go:129-145)."""
+        reply = REGISTRY.stub(channel).GetValues(
+            oim_pb2.GetValuesRequest(path=f"{self.controller_id}/pci"),
+            timeout=30,
+        )
+        for value in reply.values:
+            if value.path == f"{self.controller_id}/pci":
+                return value.value
+        return ""
+
+    def create_device(self, volume_id: str, params: dict) -> StagedDevice:
+        def run(channel):
+            default_pci = self.default_pci(channel)
+            if self.map_params is not None:
+                # Emulation hook: translate a foreign driver's parameters
+                # (≙ emulation via MapVolumeParams, remote.go:156-164).
+                try:
+                    request = self.map_params(params)
+                except ValueError as exc:
+                    raise VolumeError(
+                        grpc.StatusCode.INVALID_ARGUMENT, str(exc)
+                    ) from exc
+                request.volume_id = volume_id
+            else:
+                request = oim_pb2.MapVolumeRequest(volume_id=volume_id)
+                chip_count = _parse_chip_count(params, default=0)
+                if chip_count > 0:
+                    request.slice.chip_count = chip_count
+                else:
+                    request.provisioned.SetInParent()
+            reply = CONTROLLER.stub(channel).MapVolume(
+                request, metadata=self._metadata(), timeout=60
+            )
+            return _staged_from_reply(volume_id, reply, default_pci)
+
+        return self._call(run)
+
+    def destroy_device(self, volume_id: str) -> None:
+        def run(channel):
+            CONTROLLER.stub(channel).UnmapVolume(
+                oim_pb2.UnmapVolumeRequest(volume_id=volume_id),
+                metadata=self._metadata(),
+                timeout=60,
+            )
+
+        self._call(run)

@@ -1,0 +1,120 @@
+"""CSI Controller service: volume provisioning.
+
+≙ reference pkg/oim-csi-driver/controllerserver.go: CreateVolume validates
+access modes, serializes per volume name, and provisions through the backend
+(Malloc BDev there; a pre-provisioned TPU allocation here).  Capacity is
+counted in **chips**: ``parameters["chipCount"]`` (StorageClass parameter)
+decides the slice size, and ``Volume.capacity_bytes`` reports chips — the
+TPU generalization of bytes for a device that is not byte-addressed.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from oim_tpu.controller.keymutex import KeyMutex
+from oim_tpu.csi.backend import VolumeError, _parse_chip_count
+from oim_tpu.spec import csi_pb2
+
+SUPPORTED_ACCESS_MODES = (
+    csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER,
+    csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_READER_ONLY,
+)
+
+
+def validate_capabilities(capabilities, context) -> None:
+    if not capabilities:
+        context.abort(
+            grpc.StatusCode.INVALID_ARGUMENT, "volume_capabilities required"
+        )
+    for cap in capabilities:
+        if cap.access_mode.mode not in SUPPORTED_ACCESS_MODES:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "a TPU slice attaches to a single node; access mode "
+                f"{cap.access_mode.mode} unsupported",
+            )
+
+
+class ControllerServer:
+    def __init__(self, backend, driver_name: str, controller_id: str = "") -> None:
+        self.backend = backend
+        self.driver_name = driver_name
+        self.controller_id = controller_id
+        # Per-volume-name serialization (≙ volumeNameMutex,
+        # reference serialize.go:13-16, controllerserver.go:56).
+        self._mutex = KeyMutex()
+
+    def _abort(self, context, exc: VolumeError):
+        context.abort(exc.code, exc.message)
+
+    def CreateVolume(self, request, context) -> csi_pb2.CreateVolumeResponse:
+        if not request.name:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "name required")
+        validate_capabilities(request.volume_capabilities, context)
+        try:
+            chip_count = _parse_chip_count(dict(request.parameters))
+        except VolumeError as exc:
+            context.abort(exc.code, exc.message)
+        if request.capacity_range.required_bytes > 0:
+            # Orchestrators that size PVCs in "bytes" get 1 chip per unit.
+            chip_count = max(chip_count, int(request.capacity_range.required_bytes))
+        with self._mutex.locked(request.name):
+            try:
+                provisioned = self.backend.provision(request.name, chip_count)
+            except VolumeError as exc:
+                self._abort(context, exc)
+        response = csi_pb2.CreateVolumeResponse()
+        response.volume.volume_id = request.name
+        response.volume.capacity_bytes = provisioned
+        response.volume.volume_context["chipCount"] = str(provisioned)
+        for key, value in request.parameters.items():
+            response.volume.volume_context.setdefault(key, value)
+        if self.controller_id:
+            topo = response.volume.accessible_topology.add()
+            topo.segments[f"{self.driver_name}/controller-id"] = self.controller_id
+        return response
+
+    def DeleteVolume(self, request, context) -> csi_pb2.DeleteVolumeResponse:
+        if not request.volume_id:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "volume_id required")
+        with self._mutex.locked(request.volume_id):
+            try:
+                self.backend.delete(request.volume_id)
+            except VolumeError as exc:
+                self._abort(context, exc)
+        return csi_pb2.DeleteVolumeResponse()
+
+    def ValidateVolumeCapabilities(
+        self, request, context
+    ) -> csi_pb2.ValidateVolumeCapabilitiesResponse:
+        if not request.volume_id:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "volume_id required")
+        response = csi_pb2.ValidateVolumeCapabilitiesResponse()
+        for cap in request.volume_capabilities:
+            if cap.access_mode.mode not in SUPPORTED_ACCESS_MODES:
+                response.message = (
+                    f"access mode {cap.access_mode.mode} unsupported"
+                )
+                return response
+        response.confirmed.volume_capabilities.extend(request.volume_capabilities)
+        return response
+
+    def GetCapacity(self, request, context) -> csi_pb2.GetCapacityResponse:
+        try:
+            free = self.backend.capacity()
+        except VolumeError as exc:
+            self._abort(context, exc)
+        return csi_pb2.GetCapacityResponse(available_capacity=free)
+
+    def ControllerGetCapabilities(
+        self, request, context
+    ) -> csi_pb2.ControllerGetCapabilitiesResponse:
+        response = csi_pb2.ControllerGetCapabilitiesResponse()
+        for rpc_type in (
+            csi_pb2.ControllerServiceCapability.RPC.CREATE_DELETE_VOLUME,
+            csi_pb2.ControllerServiceCapability.RPC.GET_CAPACITY,
+        ):
+            cap = response.capabilities.add()
+            cap.rpc.type = rpc_type
+        return response

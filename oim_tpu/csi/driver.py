@@ -1,0 +1,100 @@
+"""Driver assembly: options → servicers → CSI endpoint.
+
+≙ reference pkg/oim-csi-driver/oim-driver.go: functional options choose
+exactly one of local mode (agent socket) or remote mode (registry +
+controller ID), enforced the way the reference does
+(oim-driver.go:216-226); ``emulate`` switches on a foreign driver's
+parameter translation (oim-driver.go:80-99).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from oim_tpu.common.interceptors import LogServerInterceptor
+from oim_tpu.common.server import NonBlockingGRPCServer
+from oim_tpu.common.tlsconfig import TLSConfig
+from oim_tpu.csi.backend import LocalBackend, RemoteBackend
+from oim_tpu.csi.controllerserver import ControllerServer
+from oim_tpu.csi.emulation import emulated_driver
+from oim_tpu.csi.identityserver import IdentityServer
+from oim_tpu.csi.mounter import Mounter
+from oim_tpu.csi.nodeserver import NodeServer
+from oim_tpu.spec import CSI_CONTROLLER, CSI_IDENTITY, CSI_NODE
+
+DEFAULT_DRIVER_NAME = "tpu.oim.io"
+
+
+class OIMDriver:
+    def __init__(
+        self,
+        csi_endpoint: str,
+        node_id: str = "node-0",
+        driver_name: str = DEFAULT_DRIVER_NAME,
+        agent_socket: str = "",
+        registry_address: str = "",
+        controller_id: str = "",
+        tls_loader: Callable[[], TLSConfig] | None = None,
+        emulate: str = "",
+        mounter: Mounter | None = None,
+        device_timeout: float = 60.0,
+    ) -> None:
+        local = bool(agent_socket)
+        remote = bool(registry_address)
+        if local == remote:
+            raise ValueError(
+                "exactly one of agent_socket (local mode) or "
+                "registry_address (remote mode) must be set"
+            )
+        if remote and not controller_id:
+            raise ValueError("remote mode requires controller_id")
+
+        map_params = None
+        if emulate:
+            driver = emulated_driver(emulate)
+            if driver is None:
+                raise ValueError(f"unknown emulated driver {emulate!r}")
+            driver_name = driver.name
+            map_params = driver.map_volume_params
+
+        if local:
+            if map_params is not None:
+                raise ValueError("emulation requires remote mode")
+            self.backend = LocalBackend(agent_socket)
+        else:
+            self.backend = RemoteBackend(
+                registry_address,
+                controller_id,
+                tls_loader=tls_loader,
+                map_params=map_params,
+            )
+
+        self.csi_endpoint = csi_endpoint
+        self.identity = IdentityServer(
+            driver_name, with_topology=bool(controller_id)
+        )
+        self.controller = ControllerServer(
+            self.backend, driver_name, controller_id=controller_id
+        )
+        self.node = NodeServer(
+            self.backend,
+            node_id=node_id,
+            driver_name=driver_name,
+            mounter=mounter,
+            controller_id=controller_id,
+            device_timeout=device_timeout,
+        )
+
+    def start_server(self) -> NonBlockingGRPCServer:
+        """CSI endpoints are plain unix sockets guarded by filesystem
+        permissions (kubelet convention), so no TLS here — matching the
+        reference's CSI socket."""
+        srv = NonBlockingGRPCServer(
+            self.csi_endpoint, interceptors=(LogServerInterceptor(),)
+        )
+        srv.start(
+            CSI_IDENTITY.registrar(self.identity),
+            CSI_CONTROLLER.registrar(self.controller),
+            CSI_NODE.registrar(self.node),
+        )
+        return srv

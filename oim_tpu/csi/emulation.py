@@ -1,0 +1,64 @@
+"""Third-party CSI-driver emulation hooks.
+
+≙ the reference's ceph-csi masquerade (reference
+pkg/oim-csi-driver/oim-driver.go:80-99, ceph-csi.go:33-107): the OIM driver
+can serve under a foreign driver's name and translate that driver's
+NodeStage volume attributes into a ``MapVolumeRequest`` via a per-driver
+registered translation function, so existing StorageClasses keep working.
+
+Built-in: ``gke-tpu`` translating device-plugin-style attributes
+(``google.com/tpu-count``/``google.com/tpu-topology``) into SliceParams.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from oim_tpu.spec import oim_pb2
+
+MapVolumeParams = Callable[[dict], oim_pb2.MapVolumeRequest]
+
+
+@dataclass
+class EmulatedDriver:
+    name: str
+    map_volume_params: MapVolumeParams
+
+
+_EMULATED: dict[str, EmulatedDriver] = {}
+
+
+def register_emulated_driver(name: str, fn: MapVolumeParams) -> None:
+    """≙ ``EmulateCSI0Driver`` registration (oim-driver.go:96-99)."""
+    _EMULATED[name] = EmulatedDriver(name, fn)
+
+
+def emulated_driver(name: str) -> EmulatedDriver | None:
+    return _EMULATED.get(name)
+
+
+def _gke_tpu_params(params: dict) -> oim_pb2.MapVolumeRequest:
+    request = oim_pb2.MapVolumeRequest()
+    topology_spec = params.get("google.com/tpu-topology", "")
+    count = int(params.get("google.com/tpu-count", "0") or "0")
+    dims = [int(d) for d in topology_spec.split("x") if d] if topology_spec else []
+    if dims and not count:
+        count = 1
+        for d in dims:
+            count *= d
+    if not count:
+        raise ValueError(
+            "gke-tpu emulation requires google.com/tpu-count or "
+            "google.com/tpu-topology"
+        )
+    request.slice.chip_count = count
+    if dims:
+        request.slice.topology.dims.extend(dims)
+    accel = params.get("google.com/tpu-accelerator", "")
+    if accel:
+        request.slice.accel_type = accel
+    return request
+
+
+register_emulated_driver("gke-tpu", _gke_tpu_params)

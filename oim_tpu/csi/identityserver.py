@@ -1,0 +1,47 @@
+"""CSI Identity service (≙ reference pkg/oim-csi-driver/identityserver.go)."""
+
+from __future__ import annotations
+
+from oim_tpu.spec import csi_pb2
+
+import oim_tpu
+
+
+class IdentityServer:
+    def __init__(
+        self,
+        driver_name: str,
+        with_controller: bool = True,
+        with_topology: bool = False,
+    ) -> None:
+        self.driver_name = driver_name
+        self.with_controller = with_controller
+        # Only advertised when NodeGetInfo actually reports topology
+        # segments (remote mode with a controller id).
+        self.with_topology = with_topology
+
+    def GetPluginInfo(self, request, context) -> csi_pb2.GetPluginInfoResponse:
+        return csi_pb2.GetPluginInfoResponse(
+            name=self.driver_name, vendor_version=oim_tpu.__version__
+        )
+
+    def GetPluginCapabilities(
+        self, request, context
+    ) -> csi_pb2.GetPluginCapabilitiesResponse:
+        response = csi_pb2.GetPluginCapabilitiesResponse()
+        if self.with_controller:
+            cap = response.capabilities.add()
+            cap.service.type = (
+                csi_pb2.PluginCapability.Service.CONTROLLER_SERVICE
+            )
+        if self.with_topology:
+            cap = response.capabilities.add()
+            cap.service.type = (
+                csi_pb2.PluginCapability.Service.VOLUME_ACCESSIBILITY_CONSTRAINTS
+            )
+        return response
+
+    def Probe(self, request, context) -> csi_pb2.ProbeResponse:
+        response = csi_pb2.ProbeResponse()
+        response.ready.value = True
+        return response

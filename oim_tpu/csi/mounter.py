@@ -1,0 +1,120 @@
+"""Staging/publishing of TPU devices into pod filesystems.
+
+The capability mirror of the reference's vendored ``pkg/mount`` (k8s mount
+utils + SafeFormatAndMount): where a block device gets formatted and mounted
+(reference pkg/oim-csi-driver/nodeserver.go:204-207), a TPU volume gets its
+device files linked into the staging directory together with a
+``tpu-bootstrap.json`` the workload reads to initialize JAX, and publish
+bind-mounts (or symlinks, in rootless mode) staging → target.
+
+``Exec`` is injectable (≙ ``mount.FakeExec``, reference pkg/mount/exec.go:
+35-50) so tests can observe mount commands without privileges.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+from typing import Callable
+
+from oim_tpu import log
+
+BOOTSTRAP_FILE = "tpu-bootstrap.json"
+
+Exec = Callable[[list[str]], subprocess.CompletedProcess]
+
+
+def os_exec(argv: list[str]) -> subprocess.CompletedProcess:
+    return subprocess.run(argv, capture_output=True, text=True)
+
+
+class Mounter:
+    """Default rootless implementation: symlinks for devices, copy-tree for
+    publish.  ``BindMounter`` below uses real bind mounts when privileged."""
+
+    def __init__(self, exec_fn: Exec = os_exec) -> None:
+        self.exec_fn = exec_fn
+
+    # -- staging -----------------------------------------------------------
+
+    def stage(self, staging_dir: str, bootstrap: dict) -> None:
+        """Write the bootstrap file and link each chip's device file."""
+        os.makedirs(staging_dir, exist_ok=True)
+        with open(os.path.join(staging_dir, BOOTSTRAP_FILE), "w") as f:
+            json.dump(bootstrap, f, indent=2, sort_keys=True)
+        for chip in bootstrap.get("chips", []):
+            link = os.path.join(staging_dir, os.path.basename(chip["device_path"]))
+            if os.path.islink(link) or os.path.exists(link):
+                continue
+            os.symlink(chip["device_path"], link)
+        log.current().info(
+            "staged TPU volume",
+            staging_dir=staging_dir,
+            chips=len(bootstrap.get("chips", [])),
+        )
+
+    def is_staged(self, staging_dir: str) -> bool:
+        return os.path.exists(os.path.join(staging_dir, BOOTSTRAP_FILE))
+
+    def unstage(self, staging_dir: str) -> None:
+        if os.path.isdir(staging_dir):
+            for entry in os.listdir(staging_dir):
+                path = os.path.join(staging_dir, entry)
+                if os.path.islink(path) or os.path.isfile(path):
+                    os.unlink(path)
+
+    # -- publishing --------------------------------------------------------
+
+    def publish(self, staging_dir: str, target_dir: str, readonly: bool) -> None:
+        os.makedirs(target_dir, exist_ok=True)
+        for entry in os.listdir(staging_dir):
+            src = os.path.join(staging_dir, entry)
+            dst = os.path.join(target_dir, entry)
+            if os.path.exists(dst) or os.path.islink(dst):
+                continue
+            if os.path.islink(src):
+                os.symlink(os.readlink(src), dst)
+            else:
+                shutil.copy2(src, dst)
+                if readonly:
+                    os.chmod(dst, 0o444)
+
+    def is_published(self, target_dir: str) -> bool:
+        return os.path.exists(os.path.join(target_dir, BOOTSTRAP_FILE))
+
+    def unpublish(self, target_dir: str) -> None:
+        if os.path.isdir(target_dir):
+            for entry in os.listdir(target_dir):
+                path = os.path.join(target_dir, entry)
+                if os.path.islink(path) or os.path.isfile(path):
+                    os.unlink(path)
+
+
+class BindMounter(Mounter):
+    """Privileged variant publishing via ``mount --bind`` (the deployment
+    DaemonSet runs privileged with mount propagation, like the reference's
+    malloc-daemonset.yaml)."""
+
+    def publish(self, staging_dir: str, target_dir: str, readonly: bool) -> None:
+        os.makedirs(target_dir, exist_ok=True)
+        argv = ["mount", "--bind", staging_dir, target_dir]
+        result = self.exec_fn(argv)
+        if result.returncode != 0:
+            raise RuntimeError(f"bind mount failed: {result.stderr}")
+        if readonly:
+            result = self.exec_fn(
+                ["mount", "-o", "remount,ro,bind", target_dir]
+            )
+            if result.returncode != 0:
+                raise RuntimeError(f"ro remount failed: {result.stderr}")
+
+    def unpublish(self, target_dir: str) -> None:
+        if os.path.ismount(target_dir):
+            result = self.exec_fn(["umount", target_dir])
+            if result.returncode != 0:
+                raise RuntimeError(f"umount failed: {result.stderr}")
+
+    def is_published(self, target_dir: str) -> bool:
+        return os.path.ismount(target_dir)

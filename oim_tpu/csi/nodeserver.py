@@ -1,0 +1,147 @@
+"""CSI Node service: stage/publish TPU volumes into pods.
+
+≙ reference pkg/oim-csi-driver/nodeserver.go:
+
+- ``NodeStageVolume`` (:149-210) maps the volume through the backend (the
+  path the north-star metric times), waits for the chip device files (the
+  ``waitForDevice`` analog) and stages them + the JAX bootstrap config into
+  the staging directory — where the reference ran SafeFormatAndMount, this
+  driver materializes what a JAX process needs to initialize on the slice.
+- ``NodePublishVolume`` (:43-120) binds staging → pod target.
+- Unstage/Unpublish are idempotent teardowns; unstage also unmaps the
+  volume through the backend.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from oim_tpu import log
+from oim_tpu.controller.keymutex import KeyMutex
+from oim_tpu.csi.backend import VolumeError, wait_for_devices
+from oim_tpu.csi.mounter import Mounter
+from oim_tpu.spec import csi_pb2
+
+DEFAULT_DEVICE_TIMEOUT = 60.0
+
+
+class NodeServer:
+    def __init__(
+        self,
+        backend,
+        node_id: str,
+        driver_name: str,
+        mounter: Mounter | None = None,
+        controller_id: str = "",
+        device_timeout: float = DEFAULT_DEVICE_TIMEOUT,
+    ) -> None:
+        self.backend = backend
+        self.node_id = node_id
+        self.driver_name = driver_name
+        self.mounter = mounter or Mounter()
+        self.controller_id = controller_id
+        self.device_timeout = device_timeout
+        self._mutex = KeyMutex()
+
+    def NodeStageVolume(self, request, context) -> csi_pb2.NodeStageVolumeResponse:
+        if not request.volume_id:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "volume_id required")
+        if not request.staging_target_path:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "staging_target_path required"
+            )
+        if not request.HasField("volume_capability"):
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "volume_capability required"
+            )
+        with self._mutex.locked(request.volume_id):
+            if self.mounter.is_staged(request.staging_target_path):
+                return csi_pb2.NodeStageVolumeResponse()  # idempotent
+            try:
+                staged = self.backend.create_device(
+                    request.volume_id, dict(request.volume_context)
+                )
+                # Respect the caller's deadline like the reference's
+                # ctx-cancellation-aware device wait
+                # (oim-driver_test.go:209-226).
+                timeout = self.device_timeout
+                remaining = context.time_remaining()
+                if remaining is not None:
+                    timeout = min(timeout, max(remaining - 1.0, 0.1))
+                wait_for_devices(
+                    [chip["device_path"] for chip in staged.chips], timeout
+                )
+            except VolumeError as exc:
+                context.abort(exc.code, exc.message)
+            self.mounter.stage(request.staging_target_path, staged.bootstrap())
+        log.current().info(
+            "NodeStageVolume done",
+            volume=request.volume_id,
+            staging=request.staging_target_path,
+        )
+        return csi_pb2.NodeStageVolumeResponse()
+
+    def NodeUnstageVolume(self, request, context) -> csi_pb2.NodeUnstageVolumeResponse:
+        if not request.volume_id or not request.staging_target_path:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "volume_id and staging_target_path required",
+            )
+        with self._mutex.locked(request.volume_id):
+            self.mounter.unstage(request.staging_target_path)
+            try:
+                self.backend.destroy_device(request.volume_id)
+            except VolumeError as exc:
+                context.abort(exc.code, exc.message)
+        return csi_pb2.NodeUnstageVolumeResponse()
+
+    def NodePublishVolume(self, request, context) -> csi_pb2.NodePublishVolumeResponse:
+        if not request.volume_id:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "volume_id required")
+        if not request.target_path:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "target_path required")
+        if not request.staging_target_path:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "staging_target_path required"
+            )
+        with self._mutex.locked(request.volume_id):
+            if self.mounter.is_published(request.target_path):
+                return csi_pb2.NodePublishVolumeResponse()  # idempotent
+            if not self.mounter.is_staged(request.staging_target_path):
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"volume {request.volume_id!r} is not staged at "
+                    f"{request.staging_target_path!r}",
+                )
+            self.mounter.publish(
+                request.staging_target_path, request.target_path, request.readonly
+            )
+        return csi_pb2.NodePublishVolumeResponse()
+
+    def NodeUnpublishVolume(
+        self, request, context
+    ) -> csi_pb2.NodeUnpublishVolumeResponse:
+        if not request.volume_id or not request.target_path:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "volume_id and target_path required",
+            )
+        with self._mutex.locked(request.volume_id):
+            self.mounter.unpublish(request.target_path)
+        return csi_pb2.NodeUnpublishVolumeResponse()
+
+    def NodeGetCapabilities(
+        self, request, context
+    ) -> csi_pb2.NodeGetCapabilitiesResponse:
+        response = csi_pb2.NodeGetCapabilitiesResponse()
+        cap = response.capabilities.add()
+        cap.rpc.type = csi_pb2.NodeServiceCapability.RPC.STAGE_UNSTAGE_VOLUME
+        return response
+
+    def NodeGetInfo(self, request, context) -> csi_pb2.NodeGetInfoResponse:
+        response = csi_pb2.NodeGetInfoResponse(node_id=self.node_id)
+        if self.controller_id:
+            response.accessible_topology.segments[
+                f"{self.driver_name}/controller-id"
+            ] = self.controller_id
+        return response

@@ -161,6 +161,16 @@ def test_attach_detach(agent_socket):
         assert err.value.code == agent_mod.ENODEV
 
 
+def test_provisioned_flag(agent_socket):
+    with Agent(agent_socket) as a:
+        pre = a.create_allocation("pre", 2, provisioned=True)
+        assert pre["provisioned"] is True
+        on_demand = a.create_allocation("od", 2)
+        assert on_demand["provisioned"] is False
+        # Idempotent re-create does not change the origin flag.
+        assert a.create_allocation("pre", 2)["provisioned"] is True
+
+
 def test_explicit_topology(agent_socket):
     with Agent(agent_socket) as a:
         alloc = a.create_allocation("vol-t", 4, topology=[2, 2, 1])

@@ -1,0 +1,239 @@
+"""Controller tests: map/unmap/provision lifecycle, idempotency, heartbeat.
+
+≙ reference pkg/oim-controller/controller_test.go: registration-loop timing
+(:88-148) and Map/Unmap/Provision idempotency against a device plane
+(:151-304) — here the in-process fake agent.
+"""
+
+import time
+
+import grpc
+import pytest
+
+from oim_tpu.agent import ChipStore, FakeAgentServer
+from oim_tpu.controller import Controller
+from oim_tpu.registry import Registry
+from oim_tpu.spec import CONTROLLER, oim_pb2
+
+
+@pytest.fixture
+def agent_sock(tmp_path):
+    store = ChipStore(mesh=(2, 2, 1), device_dir=str(tmp_path))
+    server = FakeAgentServer(store, str(tmp_path / "agent.sock")).start()
+    yield server.socket_path
+    server.stop()
+
+
+@pytest.fixture
+def ctrl(agent_sock):
+    controller = Controller("ctrl-1", agent_sock)
+    srv = controller.start_server("tcp://127.0.0.1:0")
+    channel = grpc.insecure_channel(srv.addr().grpc_target())
+    yield CONTROLLER.stub(channel)
+    channel.close()
+    srv.stop()
+    controller.close()
+
+
+def _map_slice(stub, volume_id, chips, topology=None):
+    params = oim_pb2.SliceParams(chip_count=chips)
+    if topology:
+        params.topology.dims.extend(topology)
+    return stub.MapVolume(
+        oim_pb2.MapVolumeRequest(volume_id=volume_id, slice=params), timeout=10
+    )
+
+
+def test_map_on_demand_and_idempotent(ctrl):
+    reply = _map_slice(ctrl, "vol-1", 2)
+    assert list(reply.mesh.dims) == [1, 2, 1]
+    assert [c.device_path for c in reply.chips] == [
+        c.device_path for c in reply.chips
+    ]
+    assert reply.coordinator_address.endswith(":8476")
+    assert reply.chips[0].pci.domain == 0  # parsed from the agent's BDF
+
+    # Re-map returns the same assignment (idempotent).
+    again = _map_slice(ctrl, "vol-1", 2)
+    assert [c.chip_id for c in again.chips] == [c.chip_id for c in reply.chips]
+    assert again.coordinator_address == reply.coordinator_address
+
+    # Size mismatch on an existing mapping is rejected.
+    with pytest.raises(grpc.RpcError) as err:
+        _map_slice(ctrl, "vol-1", 4)
+    assert err.value.code() == grpc.StatusCode.ALREADY_EXISTS
+
+
+def test_map_without_params_rejected(ctrl):
+    with pytest.raises(grpc.RpcError) as err:
+        ctrl.MapVolume(oim_pb2.MapVolumeRequest(volume_id="v"), timeout=10)
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    with pytest.raises(grpc.RpcError) as err:
+        ctrl.MapVolume(oim_pb2.MapVolumeRequest(), timeout=10)
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_map_exhausted(ctrl):
+    with pytest.raises(grpc.RpcError) as err:
+        _map_slice(ctrl, "vol-big", 9)
+    assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+
+
+def test_unmap_deletes_on_demand(ctrl):
+    _map_slice(ctrl, "vol-1", 2)
+    ctrl.UnmapVolume(oim_pb2.UnmapVolumeRequest(volume_id="vol-1"), timeout=10)
+    # All four chips free again: an allocation of 4 must now succeed.
+    reply = _map_slice(ctrl, "vol-2", 4)
+    assert len(reply.chips) == 4
+    # Unmapping an unknown volume succeeds (idempotent).
+    ctrl.UnmapVolume(oim_pb2.UnmapVolumeRequest(volume_id="ghost"), timeout=10)
+
+
+def test_provisioned_lifecycle(ctrl):
+    # Mapping a provisioned volume before provisioning fails.
+    with pytest.raises(grpc.RpcError) as err:
+        ctrl.MapVolume(
+            oim_pb2.MapVolumeRequest(
+                volume_id="pre-1", provisioned=oim_pb2.ProvisionedParams()
+            ),
+            timeout=10,
+        )
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+    ctrl.ProvisionSlice(
+        oim_pb2.ProvisionSliceRequest(name="pre-1", chip_count=2), timeout=10
+    )
+    # Provision is idempotent.
+    ctrl.ProvisionSlice(
+        oim_pb2.ProvisionSliceRequest(name="pre-1", chip_count=2), timeout=10
+    )
+    assert (
+        ctrl.CheckSlice(oim_pb2.CheckSliceRequest(name="pre-1"), timeout=10)
+        .chip_count
+        == 2
+    )
+
+    reply = ctrl.MapVolume(
+        oim_pb2.MapVolumeRequest(
+            volume_id="pre-1", provisioned=oim_pb2.ProvisionedParams()
+        ),
+        timeout=10,
+    )
+    assert len(reply.chips) == 2
+
+    # Unmap keeps the provisioned allocation around.
+    ctrl.UnmapVolume(oim_pb2.UnmapVolumeRequest(volume_id="pre-1"), timeout=10)
+    assert (
+        ctrl.CheckSlice(oim_pb2.CheckSliceRequest(name="pre-1"), timeout=10)
+        .chip_count
+        == 2
+    )
+
+    # chip_count=0 deletes, idempotently, even while attached.
+    ctrl.MapVolume(
+        oim_pb2.MapVolumeRequest(
+            volume_id="pre-1", provisioned=oim_pb2.ProvisionedParams()
+        ),
+        timeout=10,
+    )
+    ctrl.ProvisionSlice(oim_pb2.ProvisionSliceRequest(name="pre-1"), timeout=10)
+    ctrl.ProvisionSlice(oim_pb2.ProvisionSliceRequest(name="pre-1"), timeout=10)
+    with pytest.raises(grpc.RpcError) as err:
+        ctrl.CheckSlice(oim_pb2.CheckSliceRequest(name="pre-1"), timeout=10)
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_provisioned_on_demand_name_collision(ctrl):
+    """A name held by an on-demand allocation cannot be provisioned over,
+    and a provisioned-mode map of it is refused."""
+    _map_slice(ctrl, "vol-x", 1)
+    with pytest.raises(grpc.RpcError) as err:
+        ctrl.ProvisionSlice(
+            oim_pb2.ProvisionSliceRequest(name="vol-x", chip_count=1), timeout=10
+        )
+    assert err.value.code() == grpc.StatusCode.ALREADY_EXISTS
+    with pytest.raises(grpc.RpcError) as err:
+        ctrl.MapVolume(
+            oim_pb2.MapVolumeRequest(
+                volume_id="vol-x", provisioned=oim_pb2.ProvisionedParams()
+            ),
+            timeout=10,
+        )
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_remap_topology_mismatch(ctrl):
+    _map_slice(ctrl, "vol-t", 2, topology=[1, 2, 1])
+    # Same shape re-map is idempotent.
+    _map_slice(ctrl, "vol-t", 2, topology=[1, 2, 1])
+    with pytest.raises(grpc.RpcError) as err:
+        _map_slice(ctrl, "vol-t", 2, topology=[2, 1, 1])
+    assert err.value.code() == grpc.StatusCode.ALREADY_EXISTS
+
+
+def test_check_slice_ignores_on_demand(ctrl):
+    """CheckSlice only reports pre-provisioned allocations (Malloc analog)."""
+    _map_slice(ctrl, "vol-od", 1)
+    with pytest.raises(grpc.RpcError) as err:
+        ctrl.CheckSlice(oim_pb2.CheckSliceRequest(name="vol-od"), timeout=10)
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_agent_down_is_unavailable(tmp_path):
+    controller = Controller("ctrl-1", str(tmp_path / "nope.sock"))
+    srv = controller.start_server("tcp://127.0.0.1:0")
+    channel = grpc.insecure_channel(srv.addr().grpc_target())
+    try:
+        with pytest.raises(grpc.RpcError) as err:
+            CONTROLLER.stub(channel).MapVolume(
+                oim_pb2.MapVolumeRequest(
+                    volume_id="v", slice=oim_pb2.SliceParams(chip_count=1)
+                ),
+                timeout=10,
+            )
+        assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+    finally:
+        channel.close()
+        srv.stop()
+        controller.close()
+
+
+# ---------------------------------------------------------------------------
+# Self-registration heartbeat (≙ controller_test.go:88-148)
+
+
+def test_registration_heartbeat(agent_sock):
+    reg = Registry()
+    reg_srv = reg.start_server("tcp://127.0.0.1:0")
+    controller = Controller(
+        "ctrl-hb",
+        agent_sock,
+        registry_address=str(reg_srv.addr()),
+        registry_delay=0.1,
+    )
+    try:
+        controller.start("tcp://10.0.0.5:8999")
+
+        def registered():
+            return reg.db.lookup("ctrl-hb/address") == "tcp://10.0.0.5:8999"
+
+        deadline = time.time() + 5
+        while not registered():
+            assert time.time() < deadline, "controller never registered"
+            time.sleep(0.02)
+
+        # Registry DB loss: the heartbeat restores the entry.
+        reg.db.store("ctrl-hb/address", "")
+        deadline = time.time() + 5
+        while not registered():
+            assert time.time() < deadline, "controller never re-registered"
+            time.sleep(0.02)
+
+        # After close, no more re-registration.
+        controller.close()
+        reg.db.store("ctrl-hb/address", "")
+        time.sleep(0.4)
+        assert not registered()
+    finally:
+        controller.close()
+        reg_srv.stop()

@@ -1,0 +1,453 @@
+"""CSI driver tests: sanity-style lifecycle, remote chain, emulation, timeout.
+
+≙ reference pkg/oim-csi-driver tests: the CSI sanity suite in local mode
+(oim-driver_test.go:40-114), the driver→registry→controller chain with a
+deliberate NodeStage timeout (oim-driver_test.go:209-226), and the sysfs
+device-wait behavior (nodeserver_test.go) — generalized to TPU device files.
+"""
+
+import json
+import os
+
+import grpc
+import pytest
+
+from oim_tpu.agent import ChipStore, FakeAgentServer
+from oim_tpu.controller import Controller
+from oim_tpu.csi import OIMDriver
+from oim_tpu.csi.backend import _staged_from_reply
+from oim_tpu.csi.mounter import BOOTSTRAP_FILE
+from oim_tpu.registry import Registry
+from oim_tpu.spec import CSI_CONTROLLER, CSI_IDENTITY, CSI_NODE, csi_pb2, oim_pb2
+
+
+def _caps(mode=None):
+    cap = csi_pb2.VolumeCapability()
+    cap.mount.SetInParent()
+    cap.access_mode.mode = (
+        mode
+        if mode is not None
+        else csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER
+    )
+    return [cap]
+
+
+class CSIStubs:
+    def __init__(self, channel):
+        self.identity = CSI_IDENTITY.stub(channel)
+        self.controller = CSI_CONTROLLER.stub(channel)
+        self.node = CSI_NODE.stub(channel)
+
+
+@pytest.fixture
+def local_csi(tmp_path):
+    store = ChipStore(mesh=(2, 2, 1), device_dir=str(tmp_path / "dev"))
+    os.makedirs(tmp_path / "dev", exist_ok=True)
+    store2 = store  # alias for clarity
+    agent_srv = FakeAgentServer(store2, str(tmp_path / "agent.sock")).start()
+    driver = OIMDriver(
+        csi_endpoint=f"unix://{tmp_path}/csi.sock",
+        node_id="node-local",
+        agent_socket=agent_srv.socket_path,
+    )
+    srv = driver.start_server()
+    channel = grpc.insecure_channel(srv.addr().grpc_target())
+    yield CSIStubs(channel), tmp_path, store
+    channel.close()
+    srv.stop()
+    agent_srv.stop()
+
+
+def test_identity(local_csi):
+    stubs, _, _ = local_csi
+    info = stubs.identity.GetPluginInfo(csi_pb2.GetPluginInfoRequest(), timeout=10)
+    assert info.name == "tpu.oim.io"
+    assert info.vendor_version
+    probe = stubs.identity.Probe(csi_pb2.ProbeRequest(), timeout=10)
+    assert probe.ready.value is True
+    caps = stubs.identity.GetPluginCapabilities(
+        csi_pb2.GetPluginCapabilitiesRequest(), timeout=10
+    )
+    types = {c.service.type for c in caps.capabilities}
+    assert csi_pb2.PluginCapability.Service.CONTROLLER_SERVICE in types
+
+
+def test_sanity_lifecycle_local(local_csi):
+    """Create → Stage → Publish → Unpublish → Unstage → Delete, with
+    idempotent repeats — the sanity-suite core."""
+    stubs, tmp_path, store = local_csi
+    staging = str(tmp_path / "staging")
+    target = str(tmp_path / "target")
+
+    vol = stubs.controller.CreateVolume(
+        csi_pb2.CreateVolumeRequest(
+            name="pvc-1",
+            volume_capabilities=_caps(),
+            parameters={"chipCount": "2"},
+        ),
+        timeout=10,
+    ).volume
+    assert vol.volume_id == "pvc-1"
+    assert vol.capacity_bytes == 2
+    assert vol.volume_context["chipCount"] == "2"
+
+    # Capacity shrank by 2 chips.
+    cap = stubs.controller.GetCapacity(csi_pb2.GetCapacityRequest(), timeout=10)
+    assert cap.available_capacity == 2
+
+    stage_req = csi_pb2.NodeStageVolumeRequest(
+        volume_id="pvc-1",
+        staging_target_path=staging,
+        volume_capability=_caps()[0],
+        volume_context=dict(vol.volume_context),
+    )
+    stubs.node.NodeStageVolume(stage_req, timeout=10)
+    bootstrap_path = os.path.join(staging, BOOTSTRAP_FILE)
+    with open(bootstrap_path) as f:
+        bootstrap = json.load(f)
+    assert bootstrap["volume_id"] == "pvc-1"
+    assert bootstrap["mesh"] == [1, 2, 1]
+    assert len(bootstrap["chips"]) == 2
+    for chip in bootstrap["chips"]:
+        assert os.path.exists(chip["device_path"])
+        link = os.path.join(staging, os.path.basename(chip["device_path"]))
+        assert os.path.islink(link)
+    assert bootstrap["coordinator_address"].endswith(":8476")
+
+    # Idempotent re-stage.
+    stubs.node.NodeStageVolume(stage_req, timeout=10)
+
+    publish_req = csi_pb2.NodePublishVolumeRequest(
+        volume_id="pvc-1",
+        staging_target_path=staging,
+        target_path=target,
+        volume_capability=_caps()[0],
+    )
+    stubs.node.NodePublishVolume(publish_req, timeout=10)
+    assert os.path.exists(os.path.join(target, BOOTSTRAP_FILE))
+    stubs.node.NodePublishVolume(publish_req, timeout=10)  # idempotent
+
+    stubs.node.NodeUnpublishVolume(
+        csi_pb2.NodeUnpublishVolumeRequest(volume_id="pvc-1", target_path=target),
+        timeout=10,
+    )
+    assert not os.path.exists(os.path.join(target, BOOTSTRAP_FILE))
+
+    stubs.node.NodeUnstageVolume(
+        csi_pb2.NodeUnstageVolumeRequest(
+            volume_id="pvc-1", staging_target_path=staging
+        ),
+        timeout=10,
+    )
+    # The provisioned allocation survives unstage (it is the PV).
+    assert "pvc-1" in store.allocations
+    assert store.allocations["pvc-1"].attached is False
+
+    stubs.controller.DeleteVolume(
+        csi_pb2.DeleteVolumeRequest(volume_id="pvc-1"), timeout=10
+    )
+    assert "pvc-1" not in store.allocations
+    # Idempotent delete.
+    stubs.controller.DeleteVolume(
+        csi_pb2.DeleteVolumeRequest(volume_id="pvc-1"), timeout=10
+    )
+
+
+def test_create_volume_validation(local_csi):
+    stubs, _, _ = local_csi
+    with pytest.raises(grpc.RpcError) as err:
+        stubs.controller.CreateVolume(
+            csi_pb2.CreateVolumeRequest(volume_capabilities=_caps()), timeout=10
+        )
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    with pytest.raises(grpc.RpcError) as err:
+        stubs.controller.CreateVolume(
+            csi_pb2.CreateVolumeRequest(name="v"), timeout=10
+        )
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    with pytest.raises(grpc.RpcError) as err:
+        stubs.controller.CreateVolume(
+            csi_pb2.CreateVolumeRequest(
+                name="v",
+                volume_capabilities=_caps(
+                    csi_pb2.VolumeCapability.AccessMode.MULTI_NODE_MULTI_WRITER
+                ),
+            ),
+            timeout=10,
+        )
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    # Over-capacity provisioning is RESOURCE_EXHAUSTED.
+    with pytest.raises(grpc.RpcError) as err:
+        stubs.controller.CreateVolume(
+            csi_pb2.CreateVolumeRequest(
+                name="too-big",
+                volume_capabilities=_caps(),
+                parameters={"chipCount": "64"},
+            ),
+            timeout=10,
+        )
+    assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+
+
+def test_bad_chip_count_is_invalid_argument(local_csi):
+    stubs, tmp_path, _ = local_csi
+    with pytest.raises(grpc.RpcError) as err:
+        stubs.controller.CreateVolume(
+            csi_pb2.CreateVolumeRequest(
+                name="bad",
+                volume_capabilities=_caps(),
+                parameters={"chipCount": "a-lot"},
+            ),
+            timeout=10,
+        )
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    with pytest.raises(grpc.RpcError) as err:
+        stubs.node.NodeStageVolume(
+            csi_pb2.NodeStageVolumeRequest(
+                volume_id="bad",
+                staging_target_path=str(tmp_path / "sx"),
+                volume_capability=_caps()[0],
+                volume_context={"chipCount": "NaN"},
+            ),
+            timeout=10,
+        )
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_publish_before_stage(local_csi):
+    stubs, tmp_path, _ = local_csi
+    with pytest.raises(grpc.RpcError) as err:
+        stubs.node.NodePublishVolume(
+            csi_pb2.NodePublishVolumeRequest(
+                volume_id="v",
+                staging_target_path=str(tmp_path / "nostage"),
+                target_path=str(tmp_path / "t"),
+            ),
+            timeout=10,
+        )
+    assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+
+def test_node_info_and_caps(local_csi):
+    stubs, _, _ = local_csi
+    info = stubs.node.NodeGetInfo(csi_pb2.NodeGetInfoRequest(), timeout=10)
+    assert info.node_id == "node-local"
+    caps = stubs.node.NodeGetCapabilities(
+        csi_pb2.NodeGetCapabilitiesRequest(), timeout=10
+    )
+    assert caps.capabilities[0].rpc.type == (
+        csi_pb2.NodeServiceCapability.RPC.STAGE_UNSTAGE_VOLUME
+    )
+
+
+# ---------------------------------------------------------------------------
+# Remote mode: CSI driver → registry proxy → controller → agent
+
+
+@pytest.fixture
+def remote_csi(tmp_path):
+    store = ChipStore(mesh=(4,), device_dir=str(tmp_path))
+    agent_srv = FakeAgentServer(store, str(tmp_path / "agent.sock")).start()
+    controller = Controller("host-1", agent_srv.socket_path)
+    ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    registry.db.store("host-1/address", str(ctrl_srv.addr()))
+
+    def make_driver(**kwargs):
+        driver = OIMDriver(
+            csi_endpoint=f"unix://{tmp_path}/csi-{kwargs.get('emulate','std')}.sock",
+            node_id="node-remote",
+            registry_address=str(reg_srv.addr()),
+            controller_id="host-1",
+            **kwargs,
+        )
+        srv = driver.start_server()
+        channel = grpc.insecure_channel(srv.addr().grpc_target())
+        return CSIStubs(channel), srv, channel
+
+    made = []
+
+    def factory(**kwargs):
+        stubs, srv, channel = make_driver(**kwargs)
+        made.append((srv, channel))
+        return stubs
+
+    yield factory, tmp_path, store, registry
+    for srv, channel in made:
+        channel.close()
+        srv.stop()
+    reg_srv.stop()
+    ctrl_srv.stop()
+    controller.close()
+    agent_srv.stop()
+
+
+def test_remote_lifecycle(remote_csi):
+    factory, tmp_path, store, _ = remote_csi
+    stubs = factory()
+    staging = str(tmp_path / "staging-r")
+
+    vol = stubs.controller.CreateVolume(
+        csi_pb2.CreateVolumeRequest(
+            name="pvc-r",
+            volume_capabilities=_caps(),
+            parameters={"chipCount": "2"},
+        ),
+        timeout=10,
+    ).volume
+    assert vol.capacity_bytes == 2
+
+    stubs.node.NodeStageVolume(
+        csi_pb2.NodeStageVolumeRequest(
+            volume_id="pvc-r",
+            staging_target_path=staging,
+            volume_capability=_caps()[0],
+            volume_context=dict(vol.volume_context),
+        ),
+        timeout=10,
+    )
+    with open(os.path.join(staging, BOOTSTRAP_FILE)) as f:
+        bootstrap = json.load(f)
+    assert len(bootstrap["chips"]) == 2
+    assert store.allocations["pvc-r"].attached
+
+    stubs.node.NodeUnstageVolume(
+        csi_pb2.NodeUnstageVolumeRequest(
+            volume_id="pvc-r", staging_target_path=staging
+        ),
+        timeout=10,
+    )
+    assert not store.allocations["pvc-r"].attached
+    stubs.controller.DeleteVolume(
+        csi_pb2.DeleteVolumeRequest(volume_id="pvc-r"), timeout=10
+    )
+    assert "pvc-r" not in store.allocations
+
+
+def test_remote_emulation_gke(remote_csi):
+    """Emulated foreign driver: volume_context in gke-tpu form is translated
+    into SliceParams (≙ ceph-csi emulation, ceph-csi.go:50-107)."""
+    factory, tmp_path, store, _ = remote_csi
+    stubs = factory(emulate="gke-tpu")
+    staging = str(tmp_path / "staging-e")
+    stubs.node.NodeStageVolume(
+        csi_pb2.NodeStageVolumeRequest(
+            volume_id="pvc-e",
+            staging_target_path=staging,
+            volume_capability=_caps()[0],
+            volume_context={"google.com/tpu-topology": "2"},
+        ),
+        timeout=10,
+    )
+    assert len(store.allocations["pvc-e"].chip_ids) == 2
+    info = stubs.identity.GetPluginInfo(csi_pb2.GetPluginInfoRequest(), timeout=10)
+    assert info.name == "gke-tpu"
+
+    # Missing emulation params surface as INVALID_ARGUMENT, not UNKNOWN.
+    with pytest.raises(grpc.RpcError) as err:
+        stubs.node.NodeStageVolume(
+            csi_pb2.NodeStageVolumeRequest(
+                volume_id="pvc-bad",
+                staging_target_path=str(tmp_path / "staging-bad"),
+                volume_capability=_caps()[0],
+                volume_context={},
+            ),
+            timeout=10,
+        )
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_stage_timeout_when_device_never_appears(tmp_path):
+    """≙ the reference's deliberate NodeStage timeout test
+    (oim-driver_test.go:209-226): the controller maps a volume whose device
+    file never shows up; the node server must fail with DEADLINE_EXCEEDED."""
+
+    class GhostController:
+        def MapVolume(self, request, context):
+            reply = oim_pb2.MapVolumeReply(mesh=oim_pb2.MeshShape(dims=[1]))
+            reply.chips.add(
+                chip_id=0, device_path=str(tmp_path / "never-appears")
+            )
+            return reply
+
+        def UnmapVolume(self, request, context):
+            return oim_pb2.UnmapVolumeReply()
+
+    from oim_tpu.common.server import NonBlockingGRPCServer
+    from oim_tpu.spec import CONTROLLER
+
+    ctrl_srv = NonBlockingGRPCServer("tcp://127.0.0.1:0")
+    ctrl_srv.start(CONTROLLER.registrar(GhostController()))
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    registry.db.store("ghost/address", str(ctrl_srv.addr()))
+    driver = OIMDriver(
+        csi_endpoint=f"unix://{tmp_path}/csi.sock",
+        registry_address=str(reg_srv.addr()),
+        controller_id="ghost",
+        device_timeout=0.5,
+    )
+    srv = driver.start_server()
+    channel = grpc.insecure_channel(srv.addr().grpc_target())
+    try:
+        with pytest.raises(grpc.RpcError) as err:
+            CSI_NODE.stub(channel).NodeStageVolume(
+                csi_pb2.NodeStageVolumeRequest(
+                    volume_id="v",
+                    staging_target_path=str(tmp_path / "s"),
+                    volume_capability=_caps()[0],
+                ),
+                timeout=10,
+            )
+        assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    finally:
+        channel.close()
+        srv.stop()
+        reg_srv.stop()
+        ctrl_srv.stop()
+
+
+def test_staged_from_reply_pci_completion():
+    """Partial chip PCI addresses are completed from the registry default
+    (≙ CompletePCIAddress, remote.go:170-190)."""
+    reply = oim_pb2.MapVolumeReply(mesh=oim_pb2.MeshShape(dims=[1]))
+    from oim_tpu.common import pci as pcilib
+
+    reply.chips.add(
+        chip_id=0,
+        device_path="/dev/accel0",
+        pci=oim_pb2.PCIAddress(
+            domain=pcilib.UNKNOWN,
+            bus=pcilib.UNKNOWN,
+            device=5,
+            function=0,
+        ),
+        coord=oim_pb2.MeshCoord(coords=[0]),
+    )
+    staged = _staged_from_reply("v", reply, default_pci="0000:3f:00.0")
+    assert staged.chips[0]["pci"] == "0000:3f:05.0"
+
+
+def test_driver_option_validation(tmp_path):
+    with pytest.raises(ValueError):
+        OIMDriver(csi_endpoint="unix:///tmp/x.sock")  # neither mode
+    with pytest.raises(ValueError):
+        OIMDriver(
+            csi_endpoint="unix:///tmp/x.sock",
+            agent_socket="/a.sock",
+            registry_address="tcp://r:1",
+        )  # both modes
+    with pytest.raises(ValueError):
+        OIMDriver(
+            csi_endpoint="unix:///tmp/x.sock", registry_address="tcp://r:1"
+        )  # remote without controller id
+    with pytest.raises(ValueError):
+        OIMDriver(
+            csi_endpoint="unix:///tmp/x.sock",
+            agent_socket="/a.sock",
+            emulate="gke-tpu",
+        )  # emulation is remote-only

@@ -1,0 +1,216 @@
+"""mTLS security matrix with a parallel evil-CA certificate tree.
+
+≙ reference pkg/oim-registry/registry_test.go:251-390 + test/setup-ca.sh's
+``_work/ca`` / ``_work/evil-ca`` trees: table-driven proof that
+man-in-the-middle, wrong-host and wrong-peer are rejected in both directions
+across the registry and controller surfaces.
+"""
+
+import grpc
+import pytest
+
+from oim_tpu.agent import ChipStore, FakeAgentServer
+from oim_tpu.common.ca import CertAuthority
+from oim_tpu.common.tlsconfig import TLSConfig
+from oim_tpu.controller import Controller
+from oim_tpu.registry import Registry
+from oim_tpu.spec import CONTROLLER, REGISTRY, oim_pb2
+
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    """A secure deployment plus an evil CA: registry + controller + agent."""
+    tmp = tmp_path_factory.mktemp("secmatrix")
+    ca = CertAuthority("GOOD CA")
+    evil = CertAuthority("EVIL CA")
+
+    def tls(authority, cn, peer=""):
+        cred = authority.issue(cn)
+        return TLSConfig(ca.ca_pem, cred.cert_pem, cred.key_pem, peer)
+
+    store = ChipStore(mesh=(2,), device_dir=str(tmp))
+    agent_srv = FakeAgentServer(store, str(tmp / "agent.sock")).start()
+
+    controller = Controller(
+        "ctrl-1",
+        agent_srv.socket_path,
+        tls=tls(ca, "controller.ctrl-1"),
+    )
+    ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
+
+    registry = Registry(tls=tls(ca, "component.registry"))
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    registry.db.store("ctrl-1/address", str(ctrl_srv.addr()))
+
+    yield {
+        "ca": ca,
+        "evil": evil,
+        "registry_addr": reg_srv.addr(),
+        "controller_addr": ctrl_srv.addr(),
+    }
+    reg_srv.stop()
+    ctrl_srv.stop()
+    controller.close()
+    agent_srv.stop()
+
+
+def _client_tls(ca_trusted: CertAuthority, issuer: CertAuthority, cn: str, peer: str):
+    cred = issuer.issue(cn)
+    return TLSConfig(ca_trusted.ca_pem, cred.cert_pem, cred.key_pem, peer)
+
+
+def _registry_set(addr, tls: TLSConfig, path="x/y", value="z", timeout=5):
+    channel = grpc.secure_channel(
+        addr.grpc_target(), tls.channel_credentials(), options=tls.channel_options()
+    )
+    try:
+        REGISTRY.stub(channel).SetValue(
+            oim_pb2.SetValueRequest(value=oim_pb2.Value(path=path, value=value)),
+            timeout=timeout,
+        )
+    finally:
+        channel.close()
+
+
+def _proxy_map(addr, tls: TLSConfig, controller_id="ctrl-1", timeout=5):
+    channel = grpc.secure_channel(
+        addr.grpc_target(), tls.channel_credentials(), options=tls.channel_options()
+    )
+    try:
+        return CONTROLLER.stub(channel).MapVolume(
+            oim_pb2.MapVolumeRequest(
+                volume_id="vol-sec", slice=oim_pb2.SliceParams(chip_count=1)
+            ),
+            metadata=(("controllerid", controller_id),),
+            timeout=timeout,
+        )
+    finally:
+        channel.close()
+
+
+# Table: (description, action, expect_ok, expected_code_or_None)
+def test_security_matrix(world):
+    ca, evil = world["ca"], world["evil"]
+    reg, ctrl = world["registry_addr"], world["controller_addr"]
+
+    cases = [
+        (
+            "admin with good CA may SetValue",
+            lambda: _registry_set(reg, _client_tls(ca, ca, "user.admin", "component.registry")),
+            None,
+        ),
+        (
+            "evil-CA admin cert rejected by registry",
+            lambda: _registry_set(reg, _client_tls(ca, evil, "user.admin", "component.registry")),
+            grpc.StatusCode.UNAVAILABLE,  # TLS handshake failure
+        ),
+        (
+            "client pinning wrong server CN rejects the registry (MITM guard)",
+            lambda: _registry_set(reg, _client_tls(ca, ca, "user.admin", "controller.ctrl-1")),
+            grpc.StatusCode.UNAVAILABLE,
+        ),
+        (
+            "host.ctrl-1 may proxy to its controller",
+            lambda: _proxy_map(reg, _client_tls(ca, ca, "host.ctrl-1", "component.registry")),
+            None,
+        ),
+        (
+            "host.ctrl-2 may NOT proxy to ctrl-1",
+            lambda: _proxy_map(reg, _client_tls(ca, ca, "host.ctrl-2", "component.registry")),
+            grpc.StatusCode.PERMISSION_DENIED,
+        ),
+        (
+            "host CN may not SetValue",
+            lambda: _registry_set(reg, _client_tls(ca, ca, "host.ctrl-1", "component.registry")),
+            grpc.StatusCode.PERMISSION_DENIED,
+        ),
+        (
+            "controller.ctrl-1 may set its own address",
+            lambda: _registry_set(
+                reg,
+                _client_tls(ca, ca, "controller.ctrl-1", "component.registry"),
+                path="ctrl-1/address",
+                value="tcp://127.0.0.1:1",
+            ),
+            None,
+        ),
+        (
+            "controller.ctrl-1 may NOT set another controller's address",
+            lambda: _registry_set(
+                reg,
+                _client_tls(ca, ca, "controller.ctrl-1", "component.registry"),
+                path="ctrl-2/address",
+                value="tcp://evil:1",
+            ),
+            grpc.StatusCode.PERMISSION_DENIED,
+        ),
+        (
+            "direct client→controller bypass rejected (controller only trusts the registry)",
+            lambda: grpc_call_direct(ctrl, _client_tls(ca, ca, "user.admin", "controller.ctrl-1")),
+            grpc.StatusCode.UNAUTHENTICATED,
+        ),
+        (
+            "evil-CA host cert rejected at the TLS layer",
+            lambda: _proxy_map(reg, _client_tls(ca, evil, "host.ctrl-1", "component.registry")),
+            grpc.StatusCode.UNAVAILABLE,
+        ),
+    ]
+
+    failures = []
+    for description, action, expected_code in cases:
+        try:
+            action()
+            if expected_code is not None:
+                failures.append(f"{description}: unexpectedly succeeded")
+        except grpc.RpcError as exc:
+            if expected_code is None:
+                failures.append(f"{description}: failed with {exc.code()}")
+            elif exc.code() != expected_code:
+                failures.append(
+                    f"{description}: got {exc.code()}, want {expected_code}"
+                )
+    assert not failures, "\n".join(failures)
+
+
+def grpc_call_direct(ctrl_addr, tls: TLSConfig):
+    channel = grpc.secure_channel(
+        ctrl_addr.grpc_target(),
+        tls.channel_credentials(),
+        options=tls.channel_options(),
+    )
+    try:
+        return CONTROLLER.stub(channel).MapVolume(
+            oim_pb2.MapVolumeRequest(
+                volume_id="direct", slice=oim_pb2.SliceParams(chip_count=1)
+            ),
+            timeout=5,
+        )
+    finally:
+        channel.close()
+
+
+def test_evil_registry_mitm(world):
+    """A fake registry presenting an evil-CA 'component.registry' cert:
+    the controller's registration client must refuse it."""
+    ca, evil = world["ca"], world["evil"]
+    evil_cred = evil.issue("component.registry")
+    evil_tls = TLSConfig(
+        evil.ca_pem, evil_cred.cert_pem, evil_cred.key_pem, ""
+    )
+    evil_registry = Registry(tls=evil_tls)
+    evil_srv = evil_registry.start_server("tcp://127.0.0.1:0")
+    try:
+        good_cred = ca.issue("controller.ctrl-1")
+        controller = Controller(
+            "ctrl-1",
+            "/nonexistent.sock",
+            registry_address=str(evil_srv.addr()),
+            tls=TLSConfig(ca.ca_pem, good_cred.cert_pem, good_cred.key_pem),
+        )
+        controller._advertised_address = "tcp://127.0.0.1:9"
+        with pytest.raises(grpc.RpcError):
+            controller.register()
+        # Nothing leaked into the evil registry.
+        assert evil_registry.db.lookup("ctrl-1/address") == ""
+    finally:
+        evil_srv.stop()
