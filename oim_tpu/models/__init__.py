@@ -1,0 +1,27 @@
+"""Model zoo: the flagship transformer LM + training utilities.
+
+These are the workloads that run ON control-plane-provisioned slices
+(BASELINE.json configs 2/3/5).  The flagship model demonstrates every
+parallelism axis the framework supports: dp (batch), pp (GPipe stages),
+sp (ring attention), tp (heads/mlp/vocab via GSPMD), ep (switch-MoE experts).
+"""
+
+from oim_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    logical_axes,
+    forward_local,
+    param_pspecs,
+)
+from oim_tpu.models.train import TrainState, make_train_step, data_pspec
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "logical_axes",
+    "forward_local",
+    "param_pspecs",
+    "TrainState",
+    "make_train_step",
+    "data_pspec",
+]

@@ -1,0 +1,202 @@
+"""Training: loss, gradients, optimizer step over the full mesh.
+
+The train step wraps ``forward_local`` in ``shard_map`` (manual dp/sp/pp,
+auto tp/ep), computes the next-token loss with exact sequence-shard
+boundary handling (the label for a shard's last token is fetched from the
+next shard with a one-hop ppermute), takes per-device gradients — the
+collective transposes of pmean/psum/ppermute make them globally correct —
+and applies optax updates outside, where GSPMD keeps parameter math sharded.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from oim_tpu.models.transformer import (
+    TransformerConfig,
+    forward_local,
+    manual_pspecs,
+    param_pspecs,
+)
+
+AUX_LOSS_WEIGHT = 0.01
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params, optimizer) -> "TrainState":
+        return cls(
+            params=params,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+
+def data_pspec() -> P:
+    """Tokens are sharded batch × sequence."""
+    return P("dp", "sp")
+
+
+def _local_loss(params, tokens, cfg: TransformerConfig):
+    """Per-device loss over the local [b, t] token shard.
+
+    The cross-entropy terms are masked to the *last* pipeline stage before
+    the psum: under pp the logits are psum-broadcast to every stage, and
+    counting each stage's identical copy would both scale the loss and send
+    head/final-norm gradient contributions to every stage — the mask keeps
+    exactly one contribution, so the later per-axis gradient psums in
+    ``make_train_step`` are uniform.
+    """
+    sp_size = jax.lax.axis_size("sp")
+    sp_index = jax.lax.axis_index("sp")
+    b, t_local = tokens.shape
+
+    logits, aux = forward_local(params, tokens, cfg)
+
+    # Labels: next token.  The last local position's label is the first
+    # token of the *next* sequence shard (one neighbor hop); the global
+    # final position is masked out.
+    size = sp_size
+    perm = [(i, (i - 1) % size) for i in range(size)]
+    next_first = jax.lax.ppermute(tokens[:, :1], "sp", perm)  # [b, 1]
+    labels = jnp.concatenate([tokens[:, 1:], next_first], axis=1)
+
+    global_pos = sp_index * t_local + jnp.arange(t_local)  # [t]
+    t_global = t_local * size
+    valid = jnp.broadcast_to(global_pos < t_global - 1, (b, t_local))
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    is_last_stage = (
+        jax.lax.axis_index("pp") == jax.lax.axis_size("pp") - 1
+    ).astype(jnp.float32)
+    local_sum = jnp.sum(-token_ll * valid) * is_last_stage
+    local_count = jnp.sum(valid).astype(jnp.float32) * is_last_stage
+
+    total = jax.lax.psum(local_sum, ("dp", "sp", "pp"))
+    count = jax.lax.psum(local_count, ("dp", "sp", "pp"))
+    ce = total / count
+    # The MoE aux loss comes from per-device routing statistics; average it
+    # across data/sequence shards so every rank optimizes the same scalar.
+    aux = jax.lax.pmean(aux, ("dp", "sp"))
+    return ce + AUX_LOSS_WEIGHT * aux, ce
+
+
+def make_train_step(
+    cfg: TransformerConfig,
+    mesh,
+    optimizer=None,
+    learning_rate: float = 3e-4,
+):
+    """Build the jitted ``(state, tokens) -> (state, metrics)`` step.
+
+    Donates the state buffers (in-place update on HBM) and pins shardings:
+    params by their logical axes, tokens by (dp, sp).
+    """
+    optimizer = optimizer or optax.adamw(learning_rate)
+    if mesh.shape["pp"] != cfg.n_stages:
+        raise ValueError(
+            f"mesh pp={mesh.shape['pp']} must equal cfg.n_stages="
+            f"{cfg.n_stages}; otherwise stages would be silently dropped"
+        )
+    # Mosaic (pallas) kernels cannot run inside GSPMD-auto regions: when
+    # tp == ep == 1 there is nothing to auto-partition, so every axis goes
+    # manual and pallas stays on; with real tp/ep the model falls back to
+    # XLA-fused reference ops and tp/ep stay automatic.
+    fully_manual = mesh.shape["tp"] == 1 and mesh.shape["ep"] == 1
+    from dataclasses import replace as dc_replace
+
+    cfg = dc_replace(cfg, use_pallas=cfg.use_pallas and fully_manual)
+    manual_axes = (
+        {"dp", "sp", "pp", "tp", "ep"} if fully_manual else {"dp", "sp", "pp"}
+    )
+    manual_specs = manual_pspecs(cfg)
+
+    def spmd_value_and_grad(params, tokens):
+        (loss, ce), grads = jax.value_and_grad(
+            partial(_local_loss, cfg=cfg), has_aux=True
+        )(params, tokens)
+        # Per-device grads are only each rank's local contribution — the
+        # psum in the loss broadcasts cotangents, it does not sum parameter
+        # gradients.  Reduce explicitly: stage-sharded params over data
+        # axes; replicated params additionally over pp (their contribution
+        # lives on exactly one stage thanks to the loss mask / pipeline
+        # routing, so the psum reconstructs the full gradient everywhere).
+        def reduce_grad(name, g):
+            if manual_specs[name] and manual_specs[name][0] == "pp":
+                return jax.lax.psum(g, ("dp", "sp"))
+            return jax.lax.psum(g, ("dp", "sp", "pp"))
+
+        grads = {name: reduce_grad(name, g) for name, g in grads.items()}
+        return loss, ce, grads
+
+    # NOTE: partial-manual shard_map (manual dp/sp/pp, auto tp/ep) with an
+    # explicit mesh= only traces under jit — make_train_step returns the
+    # jitted step, never call the raw python function.
+    sharded_vag = jax.shard_map(
+        spmd_value_and_grad,
+        mesh=mesh,
+        in_specs=(manual_specs, data_pspec()),
+        out_specs=(P(), P(), manual_specs),
+        axis_names=manual_axes,
+        check_vma=False,
+    )
+
+    def train_step(state: TrainState, tokens: jax.Array):
+        loss, ce, grads = sharded_vag(state.params, tokens)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params,
+            opt_state=new_opt_state,
+            step=state.step + 1,
+        )
+        return new_state, {"loss": loss, "ce": ce}
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+def shard_state(state: TrainState, cfg: TransformerConfig, mesh) -> TrainState:
+    """Place params — and the optimizer state mirroring them — onto the mesh
+    by logical axes.  Optax states are nested namedtuples whose moment
+    pytrees share the params' dict structure, so the same specs apply."""
+    pspecs = param_pspecs(cfg)
+    param_names = set(state.params.keys())
+
+    def place_params(tree: dict) -> dict:
+        return {
+            name: jax.device_put(value, NamedSharding(mesh, pspecs[name]))
+            for name, value in tree.items()
+        }
+
+    def mirror(node):
+        if isinstance(node, dict) and set(node.keys()) == param_names:
+            return place_params(node)
+        if hasattr(node, "_fields"):  # optax namedtuple states
+            return type(node)(*(mirror(getattr(node, f)) for f in node._fields))
+        if isinstance(node, (list, tuple)):
+            return type(node)(mirror(x) for x in node)
+        if hasattr(node, "shape"):
+            return jax.device_put(node, NamedSharding(mesh, P()))
+        return node
+
+    return TrainState(
+        params=place_params(state.params),
+        opt_state=mirror(state.opt_state),
+        step=jax.device_put(state.step, NamedSharding(mesh, P())),
+    )

@@ -1,0 +1,347 @@
+"""Flagship decoder-only transformer LM, TPU-first.
+
+Architecture: pre-RMSNorm, rotary positions, SwiGLU MLP (or switch-routed
+MoE when ``n_experts > 0``), tied nothing, f32 logits.  Layers are *stacked*
+and iterated with ``lax.scan`` (one compiled layer body regardless of depth
+— XLA-friendly, constant compile time), stages stacked again on a leading
+``pp`` dimension.
+
+Parallelism split (see oim_tpu/parallel):
+  manual (shard_map): dp (batch), sp (sequence → ring attention),
+                      pp (GPipe schedule)
+  automatic (GSPMD):  tp (heads / mlp hidden / vocab),
+                      ep (MoE experts; the dispatch einsums reshard
+                      token-major → expert-major, which XLA lowers to
+                      all-to-all on ICI)
+
+``forward_local`` is per-device SPMD code and must run inside
+``shard_map(axis_names={'dp','sp','pp'})``; ``oim_tpu.models.train`` wraps
+it.  All matmuls are einsums on stacked weights → MXU; accumulation dtypes
+are f32 with bf16 params/activations by default.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from oim_tpu.ops import (
+    apply_rope,
+    flash_attention,
+    reference_attention,
+    reference_rmsnorm,
+    rmsnorm,
+)
+from oim_tpu.parallel.pipeline import gpipe_spmd
+from oim_tpu.parallel.ring_attention import ring_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 0  # 0 → 4 * d_model
+    n_experts: int = 0  # 0 → dense SwiGLU
+    expert_capacity_factor: float = 1.25
+    rope_theta: float = 10000.0
+    n_stages: int = 1  # pipeline stages; must divide n_layers
+    n_microbatches: int = 1
+    dtype: str = "bfloat16"  # activation/compute dtype
+    param_dtype: str = "float32"
+    remat: bool = True
+    # Pallas (Mosaic) kernels cannot sit inside GSPMD-auto-partitioned
+    # regions; the train step enables them only when every mesh axis is
+    # manual (tp == ep == 1) and falls back to XLA-fused reference ops
+    # otherwise.
+    use_pallas: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+    @property
+    def layers_per_stage(self) -> int:
+        if self.n_layers % self.n_stages:
+            raise ValueError(
+                f"n_layers={self.n_layers} not divisible by "
+                f"n_stages={self.n_stages}"
+            )
+        return self.n_layers // self.n_stages
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
+    """Truncated-normal init, stacked [n_stages, layers_per_stage, ...]."""
+    pdt = jnp.dtype(cfg.param_dtype)
+    d, n = cfg.d_model, cfg.n_heads * cfg.head_dim
+    f, s, l = cfg.ff_dim, cfg.n_stages, cfg.layers_per_stage
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(key, *shape, fan_in):
+        return (
+            jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+            / math.sqrt(fan_in)
+        ).astype(pdt)
+
+    params = {
+        "wte": dense(next(keys), cfg.vocab_size, d, fan_in=d),
+        "attn_norm": jnp.ones((s, l, d), pdt),
+        "wq": dense(next(keys), s, l, d, n, fan_in=d),
+        "wk": dense(next(keys), s, l, d, n, fan_in=d),
+        "wv": dense(next(keys), s, l, d, n, fan_in=d),
+        "wo": dense(next(keys), s, l, n, d, fan_in=n),
+        "mlp_norm": jnp.ones((s, l, d), pdt),
+        "final_norm": jnp.ones((d,), pdt),
+        "wlm": dense(next(keys), d, cfg.vocab_size, fan_in=d),
+    }
+    if cfg.n_experts:
+        e = cfg.n_experts
+        params.update(
+            {
+                "router": dense(next(keys), s, l, d, e, fan_in=d),
+                "w_gate": dense(next(keys), s, l, e, d, f, fan_in=d),
+                "w_in": dense(next(keys), s, l, e, d, f, fan_in=d),
+                "w_out": dense(next(keys), s, l, e, f, d, fan_in=f),
+            }
+        )
+    else:
+        params.update(
+            {
+                "w_gate": dense(next(keys), s, l, d, f, fan_in=d),
+                "w_in": dense(next(keys), s, l, d, f, fan_in=d),
+                "w_out": dense(next(keys), s, l, f, d, fan_in=f),
+            }
+        )
+    return params
+
+
+def logical_axes(cfg: TransformerConfig) -> dict:
+    """Logical dim names per parameter (see parallel.sharding rules)."""
+    axes = {
+        "wte": ("vocab", "model"),
+        "attn_norm": ("stages", None, None),
+        "wq": ("stages", None, "model", "heads"),
+        "wk": ("stages", None, "model", "heads"),
+        "wv": ("stages", None, "model", "heads"),
+        "wo": ("stages", None, "heads", "model"),
+        "mlp_norm": ("stages", None, None),
+        "final_norm": (None,),
+        "wlm": ("model", "vocab"),
+    }
+    if cfg.n_experts:
+        axes.update(
+            {
+                "router": ("stages", None, "model", None),
+                "w_gate": ("stages", None, "experts", "model", "mlp"),
+                "w_in": ("stages", None, "experts", "model", "mlp"),
+                "w_out": ("stages", None, "experts", "mlp", "model"),
+            }
+        )
+    else:
+        axes.update(
+            {
+                "w_gate": ("stages", None, "model", "mlp"),
+                "w_in": ("stages", None, "model", "mlp"),
+                "w_out": ("stages", None, "mlp", "model"),
+            }
+        )
+    return axes
+
+
+def param_pspecs(cfg: TransformerConfig, rules=None) -> dict:
+    """Full PartitionSpecs (manual + auto axes) per parameter."""
+    from oim_tpu.parallel.sharding import DEFAULT_RULES, partition_spec
+
+    rules = rules or DEFAULT_RULES
+    return {
+        name: partition_spec(dims, rules)
+        for name, dims in logical_axes(cfg).items()
+    }
+
+
+def manual_pspecs(cfg: TransformerConfig) -> dict:
+    """PartitionSpecs restricted to the manual axes (what shard_map sees):
+    only the stacked ``stages`` dimension is manual (pp)."""
+    specs = {}
+    for name, dims in logical_axes(cfg).items():
+        specs[name] = P(*("pp" if dim == "stages" else None for dim in dims))
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Forward (per-device SPMD)
+
+
+def _rmsnorm(x, w, cfg: TransformerConfig):
+    if cfg.use_pallas:
+        return rmsnorm(x, w)
+    return reference_rmsnorm(x, w, 1e-6)
+
+
+def _attention(x, lp, positions, cfg: TransformerConfig, sp_size):
+    b, t, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    normed = _rmsnorm(x, lp["attn_norm"], cfg)
+    q = jnp.einsum("btd,dn->btn", normed, lp["wq"]).reshape(b, t, h, hd)
+    k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, h, hd)
+    v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, h, hd)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    if sp_size > 1:
+        out = ring_attention(q, k, v, "sp", causal=True)
+    elif cfg.use_pallas:
+        out = flash_attention(q, k, v, True)
+    else:
+        out = reference_attention(q, k, v, True)
+    out = out.reshape(b, t, h * hd)
+    return x + jnp.einsum("btn,nd->btd", out, lp["wo"]).astype(x.dtype)
+
+
+def _dense_mlp(x, lp, cfg: TransformerConfig):
+    normed = _rmsnorm(x, lp["mlp_norm"], cfg)
+    gate = jax.nn.silu(jnp.einsum("btd,df->btf", normed, lp["w_gate"]))
+    up = jnp.einsum("btd,df->btf", normed, lp["w_in"])
+    down = jnp.einsum("btf,fd->btd", gate * up, lp["w_out"])
+    return x + down.astype(x.dtype), jnp.zeros((), jnp.float32)
+
+
+def _switch_moe(x, lp, cfg: TransformerConfig):
+    """Top-1 switch routing with capacity, Mesh-TensorFlow style dispatch:
+    the one-hot dispatch/combine einsums ride the MXU and GSPMD turns the
+    token→expert resharding into all-to-all over ``ep``."""
+    b, t, d = x.shape
+    e = cfg.n_experts
+    g = b * t
+    capacity = max(int(cfg.expert_capacity_factor * g / e), 1)
+    normed = _rmsnorm(x, lp["mlp_norm"], cfg).reshape(g, d)
+
+    router_logits = jnp.einsum(
+        "gd,de->ge", normed.astype(jnp.float32), lp["router"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [G, E]
+    expert_idx = jnp.argmax(probs, axis=-1)  # [G]
+    expert_gate = jnp.max(probs, axis=-1)  # [G]
+    assign = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [G, E]
+    # Position of each token within its expert's queue; drop beyond capacity.
+    position = jnp.cumsum(assign, axis=0) * assign - 1.0  # [G, E]
+    keep = (position >= 0) & (position < capacity)
+    dispatch = jax.nn.one_hot(
+        jnp.where(keep, position, -1).astype(jnp.int32),
+        capacity,
+        dtype=jnp.float32,
+    )  # [G, E, C]
+    combine = dispatch * expert_gate[:, None, None]
+
+    expert_in = jnp.einsum("gec,gd->ecd", dispatch, normed.astype(jnp.float32))
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"]))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, lp["w_in"])
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["w_out"])
+    out = jnp.einsum("gec,ecd->gd", combine, expert_out).reshape(b, t, d)
+
+    # Switch-transformer load-balancing auxiliary loss.
+    density = jnp.mean(assign, axis=0)  # fraction routed per expert
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(density * density_proxy)
+    return x + out.astype(x.dtype), aux
+
+
+def _layer(carry, lp, cfg: TransformerConfig, sp_size):
+    x, positions, aux = carry
+    x = _attention(x, lp, positions, cfg, sp_size)
+    if cfg.n_experts:
+        x, layer_aux = _switch_moe(x, lp, cfg)
+    else:
+        x, layer_aux = _dense_mlp(x, lp, cfg)
+    return (x, positions, aux + layer_aux), None
+
+
+def _stage_layer_params(params: dict, cfg: TransformerConfig) -> dict:
+    """This pp-rank's stacked layer weights (leading dim layers_per_stage).
+    Under shard_map the ``stages`` dim arrived pre-sliced to size 1."""
+    layer_names = {"attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+                   "router", "w_gate", "w_in", "w_out"}
+    return {
+        name: value[0]
+        for name, value in params.items()
+        if name in layer_names
+    }
+
+
+def forward_local(
+    params: dict, tokens: jax.Array, cfg: TransformerConfig
+) -> tuple[jax.Array, jax.Array]:
+    """Per-device forward: local token shard → local f32 logits + aux loss.
+
+    tokens: [batch_local, seq_local].  Must run inside shard_map with
+    manual axes {'dp', 'sp', 'pp'}.
+    """
+    sp_size = jax.lax.axis_size("sp")
+    sp_index = jax.lax.axis_index("sp")
+    pp_size = jax.lax.axis_size("pp")
+    b, t_local = tokens.shape
+    dt = cfg.compute_dtype
+
+    x = params["wte"].astype(dt)[tokens]  # [b, t, D]
+    # 1-D positions broadcast over any (micro)batch size.
+    positions = sp_index * t_local + jnp.arange(t_local)
+
+    stage_params = _stage_layer_params(params, cfg)
+
+    layer_fn = partial(_layer, cfg=cfg, sp_size=sp_size)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def run_stage(sp, activation):
+        def scan_body(carry, layer_weights):
+            return layer_fn(carry, layer_weights)
+
+        (out, _, aux), _ = jax.lax.scan(
+            scan_body, (activation, positions, jnp.zeros((), jnp.float32)), sp
+        )
+        return out, aux
+
+    if pp_size > 1:
+        n_micro = max(cfg.n_microbatches, 1)
+        if b % n_micro:
+            raise ValueError(
+                f"local batch {b} not divisible by n_microbatches={n_micro}"
+            )
+        mb = b // n_micro
+        x_micro = x.reshape(n_micro, mb, t_local, cfg.d_model)
+
+        def stage_fn(sp, activation):
+            out, _ = run_stage(sp, activation)
+            return out
+
+        x = gpipe_spmd(stage_fn, stage_params, x_micro, "pp")
+        x = x.reshape(b, t_local, cfg.d_model)
+        # Known limit: the MoE load-balancing aux loss is not collected
+        # under pipeline parallelism (reported as 0).
+        aux = jnp.zeros((), jnp.float32)
+    else:
+        x, aux = run_stage(stage_params, x)
+        aux = jax.lax.psum(aux, "pp")  # no-op at size 1, keeps types uniform
+
+    x = _rmsnorm(x, params["final_norm"], cfg)
+    logits = jnp.einsum(
+        "btd,dv->btv", x.astype(jnp.float32), params["wlm"].astype(jnp.float32)
+    )
+    return logits, aux

@@ -1,0 +1,20 @@
+"""Hot-path TPU kernels (pallas) with CPU interpreter fallbacks.
+
+The pallas kernels target the real memory hierarchy (HBM→VMEM→MXU/VPU,
+/opt/skills/guides/pallas_guide.md); on non-TPU backends they run in
+interpreter mode so the whole framework stays testable on CPU — the compute
+analog of the control plane's fake-device mode.
+"""
+
+from oim_tpu.ops.rmsnorm import rmsnorm, reference_rmsnorm
+from oim_tpu.ops.flash_attention import flash_attention, reference_attention
+from oim_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "rmsnorm",
+    "reference_rmsnorm",
+    "flash_attention",
+    "reference_attention",
+    "apply_rope",
+    "rope_frequencies",
+]

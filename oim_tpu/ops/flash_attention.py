@@ -1,0 +1,128 @@
+"""Flash attention (pallas): blockwise causal attention, O(T) memory.
+
+Forward is a pallas kernel — per (batch·head, q-block) grid step the q block
+sits in VMEM while k/v stream through in blocks with the online-softmax
+running max/denominator, so the [T, T] score matrix never materializes in
+HBM and the two einsums per block ride the MXU.  Backward recomputes via the
+reference formula under ``jax.custom_vjp`` (correct; a fused backward kernel
+is a planned optimization).  Off-TPU the kernel runs interpreted.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_BIG = -1e30
+
+
+def reference_attention(q, k, v, causal: bool = True):
+    d = q.shape[-1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / (d**0.5)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        scores = jnp.where(mask, scores, _NEG_BIG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(
+        q.dtype
+    )
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale):
+    # q_ref: [1, block_q, D]; k_ref/v_ref: [1, T, D] (this head's full K/V
+    # in VMEM); o_ref: [1, block_q, D].  Grid: (B*H, T // block_q).
+    q_block_idx = pl.program_id(1)
+    _, block_q, d = q_ref.shape
+    t = k_ref.shape[1]
+    n_k_blocks = t // block_k
+    q = q_ref[0].astype(jnp.float32) * scale
+    q_pos = q_block_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        scores = q @ k_blk.T  # [block_q, block_k] on the MXU
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            scores = jnp.where(q_pos >= k_pos, scores, _NEG_BIG)
+        block_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, block_max)
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(scores - new_m[:, None])
+        new_l = l * correction + jnp.sum(p, axis=-1)
+        new_acc = acc * correction[:, None] + p @ v_blk
+        return new_m, new_l, new_acc
+
+    m0 = jnp.full((block_q,), _NEG_BIG, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
+    acc0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing; bound the
+        # loop at the q block's last row.
+        upper = jnp.minimum(
+            (q_block_idx + 1) * block_q + block_k - 1, t
+        ) // block_k
+    else:
+        upper = n_k_blocks
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(
+    q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128
+):
+    """Attention over [B, T, H, D] with blockwise online softmax."""
+    return _forward(q, k, v, causal, block_q, block_k)
+
+
+def _forward(q, k, v, causal, block_q, block_k):
+    b, t, h, d = q.shape
+    if t % block_q or t % block_k:
+        # Ragged tails: fall back to the reference (bench shapes are
+        # block-aligned; correctness everywhere beats a padded kernel).
+        return reference_attention(q, k, v, causal)
+    scale = 1.0 / (d**0.5)
+    # [B, T, H, D] -> [B*H, T, D] so each grid row owns one head.
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, block_k=block_k, causal=causal, scale=scale
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        interpret=jax.default_backend() != "tpu",
+    )(qh, kh, vh)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _fwd(q, k, v, causal, block_q, block_k):
+    return _forward(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,32 @@
+"""Rotary position embeddings (plain JAX — XLA fuses these into the
+surrounding projections; a kernel would buy nothing)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 10000.0) -> jnp.ndarray:
+    """Inverse frequencies for the rotated half-pairs: [head_dim // 2]."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponent)
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotate [..., T, H, D] by per-token ``positions`` [..., T].
+
+    Positions are *global* sequence positions — under sequence parallelism
+    the caller passes offsets for its shard, which keeps ring attention
+    exact across shard boundaries.
+    """
+    d = x.shape[-1]
+    freqs = rope_frequencies(d, theta)  # [D/2]
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., T, D/2]
+    cos = jnp.cos(angles)[..., :, None, :]  # [..., T, 1, D/2]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    rotated = jnp.stack(
+        (x1 * cos - x2 * sin, x1 * sin + x2 * cos), axis=-1
+    ).reshape(x.shape)
+    return rotated.astype(x.dtype)

@@ -1,0 +1,40 @@
+"""The JAX compute path: meshes, shardings, collectives, parallelism.
+
+This is the layer that runs ON the slices the control plane provisions
+(SURVEY.md §2.3: the parallelism inventory the TPU build introduces as new
+work).  Everything is TPU-first: SPMD over a `jax.sharding.Mesh` with XLA
+collectives riding ICI, `shard_map` for explicitly-scheduled parallelism
+(ring attention, pipelining), GSPMD sharding constraints elsewhere.
+"""
+
+from oim_tpu.parallel.mesh import AXES, build_mesh, mesh_from_bootstrap
+from oim_tpu.parallel.sharding import (
+    ShardingRules,
+    DEFAULT_RULES,
+    partition_spec,
+    named_sharding,
+    constrain,
+)
+from oim_tpu.parallel.coordinator import (
+    Bootstrap,
+    load_bootstrap,
+    initialize_distributed,
+)
+from oim_tpu.parallel.ring_attention import ring_attention
+from oim_tpu.parallel import collectives
+
+__all__ = [
+    "AXES",
+    "build_mesh",
+    "mesh_from_bootstrap",
+    "ShardingRules",
+    "DEFAULT_RULES",
+    "partition_spec",
+    "named_sharding",
+    "constrain",
+    "Bootstrap",
+    "load_bootstrap",
+    "initialize_distributed",
+    "ring_attention",
+    "collectives",
+]

@@ -1,0 +1,141 @@
+"""Collective wrappers + the ICI bandwidth harness.
+
+The distributed communication backend of the framework (SURVEY.md §2.3): XLA
+collectives over ICI within a slice and DCN across slices — the role
+NCCL/MPI plays in GPU stacks.  The wrappers exist for a stable API surface
+and for the benchmark harness behind BASELINE.md's ≥90%-of-line-rate
+all-reduce target; inside, they are the primitive `jax.lax` collectives that
+XLA lowers straight onto the torus.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis: int = 0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def ppermute_shift(x, axis_name, shift: int = 1):
+    """Rotate shards around the ring by ``shift`` (the ring-attention /
+    pipeline primitive)."""
+    size = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % size) for i in range(size)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, split_axis: int, concat_axis: int):
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+# ---------------------------------------------------------------------------
+# Bandwidth harness (BASELINE.md: ICI all-reduce GB/s/chip)
+
+
+def allreduce_bandwidth(
+    mesh: Mesh,
+    axis: str = "dp",
+    size_mb: float = 64.0,
+    dtype=jnp.bfloat16,
+    iters: int = 10,
+    warmup: int = 3,
+) -> dict:
+    """Time an all-reduce over ``axis`` and report achieved GB/s per chip.
+
+    Bus bandwidth convention (matches NCCL's): for an all-reduce over n
+    devices, each chip moves 2*(n-1)/n × payload bytes over its links.
+    """
+    n = mesh.shape[axis]
+    elem = jnp.dtype(dtype).itemsize
+    per_device_elems = int(size_mb * 1e6 / elem)
+    # Lane-friendly shape: (k, 128) keeps the VPU/ICI path dense.
+    rows = max(per_device_elems // 128, 1)
+    global_shape = (rows * n, 128)
+
+    sharding = NamedSharding(mesh, P(axis, None))
+    x = jax.device_put(
+        jnp.ones(global_shape, dtype=dtype), sharding
+    )
+
+    @partial(
+        jax.jit,
+        out_shardings=sharding,
+    )
+    def step(v):
+        # psum over a mesh axis expressed via GSPMD: sum of all shards,
+        # result re-sharded — an all-reduce on the wire.
+        summed = jax.shard_map(
+            lambda s: jax.lax.psum(s, axis),
+            mesh=mesh,
+            in_specs=P(axis, None),
+            out_specs=P(axis, None),
+        )(v)
+        return summed
+
+    for _ in range(warmup):
+        step(x).block_until_ready()
+    start = time.perf_counter()
+    for _ in range(iters):
+        x = step(x)
+    x.block_until_ready()
+    elapsed = (time.perf_counter() - start) / iters
+
+    payload_bytes = rows * 128 * elem  # per-chip shard
+    bus_bytes = 2 * (n - 1) / n * payload_bytes
+    return {
+        "axis": axis,
+        "devices": n,
+        "payload_mb": payload_bytes / 1e6,
+        "seconds": elapsed,
+        "gbps_per_chip": bus_bytes / elapsed / 1e9,
+    }
+
+
+def matmul_throughput(
+    m: int = 4096,
+    k: int = 4096,
+    n: int = 4096,
+    dtype=jnp.bfloat16,
+    iters: int = 20,
+    warmup: int = 5,
+) -> dict:
+    """Single-chip MXU throughput probe (TFLOP/s) — the compute-side
+    companion to the ICI harness, used by bench.py on the real chip."""
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (m, k), dtype=dtype)
+    b = jax.random.normal(key, (k, n), dtype=dtype)
+
+    @jax.jit
+    def mm(a, b):
+        return a @ b
+
+    for _ in range(warmup):
+        mm(a, b).block_until_ready()
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = mm(a, b)
+    out.block_until_ready()
+    elapsed = (time.perf_counter() - start) / iters
+    flops = 2.0 * m * k * n
+    return {"m": m, "k": k, "n": n, "seconds": elapsed, "tflops": flops / elapsed / 1e12}

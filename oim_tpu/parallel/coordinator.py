@@ -1,0 +1,85 @@
+"""JAX distributed bootstrap from the CSI-staged config.
+
+The node server stages ``tpu-bootstrap.json`` next to the device files
+(oim_tpu/csi/mounter.py) — the TPU analog of the mounted filesystem the
+reference's NodeStage produced.  A workload calls ``initialize()`` first
+thing; on multi-host slices this brings up the JAX distributed coordinator
+(the role the reference's virtio-scsi hotplug + mount played is here
+"PJRT client ready + process group formed").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from oim_tpu import log
+
+DEFAULT_BOOTSTRAP_PATH = "/tpu/tpu-bootstrap.json"
+
+
+@dataclass
+class Bootstrap:
+    volume_id: str = ""
+    chips: list[dict] = field(default_factory=list)
+    mesh: list[int] = field(default_factory=list)
+    coordinator_address: str = ""
+    num_processes: int = 1
+    process_id: int = 0
+
+    @property
+    def chip_count(self) -> int:
+        return len(self.chips)
+
+
+def load_bootstrap(path: str = "") -> Bootstrap:
+    """Read the staged bootstrap file.  Search order: explicit path, the
+    ``TPU_BOOTSTRAP`` env var, the conventional pod mount point."""
+    path = path or os.environ.get("TPU_BOOTSTRAP", "") or DEFAULT_BOOTSTRAP_PATH
+    with open(path) as f:
+        data = json.load(f)
+    return Bootstrap(
+        volume_id=data.get("volume_id", ""),
+        chips=data.get("chips", []),
+        mesh=list(data.get("mesh", [])),
+        coordinator_address=data.get("coordinator_address", ""),
+        num_processes=int(data.get("num_processes", 1)),
+        process_id=int(data.get("process_id", 0)),
+    )
+
+
+def initialize_distributed(bootstrap: Bootstrap) -> None:
+    """Form the multi-host process group when the slice spans hosts.
+
+    Single-host volumes skip coordination entirely (the common case for
+    sub-host slices); multi-host volumes rendezvous at the coordinator the
+    controller allocated (MapVolumeReply.coordinator_address) — the registry
+    KV picked one coordinator per volume, so every host's bootstrap agrees.
+    """
+    if bootstrap.num_processes <= 1:
+        log.current().debug("single-process slice; skipping jax.distributed")
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=bootstrap.coordinator_address,
+        num_processes=bootstrap.num_processes,
+        process_id=bootstrap.process_id,
+    )
+    log.current().info(
+        "jax distributed initialized",
+        coordinator=bootstrap.coordinator_address,
+        process=f"{bootstrap.process_id}/{bootstrap.num_processes}",
+    )
+
+
+def initialize(path: str = "", **mesh_kwargs):
+    """One-call workload entry: read bootstrap, join the process group,
+    return the logical mesh.  ``mesh_kwargs`` are the pp/sp/tp/ep sizes for
+    ``mesh_from_bootstrap``."""
+    from oim_tpu.parallel.mesh import mesh_from_bootstrap
+
+    bootstrap = load_bootstrap(path)
+    initialize_distributed(bootstrap)
+    return mesh_from_bootstrap(bootstrap, **mesh_kwargs)

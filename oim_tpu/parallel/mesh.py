@@ -1,0 +1,80 @@
+"""Logical device meshes over the physical ICI topology.
+
+The control plane hands a workload its physical mesh shape (the
+``MapVolumeReply.mesh`` / bootstrap ``mesh`` field — the actual ICI torus of
+the allocated sub-slice); this module folds it into the canonical logical
+axes used throughout the framework:
+
+    dp   data parallelism        (batch)
+    pp   pipeline parallelism    (layer stages)
+    sp   sequence parallelism    (ring attention / context)
+    tp   tensor parallelism      (heads / mlp / vocab)
+    ep   expert parallelism      (MoE experts)
+
+Axis order is outermost-first: ICI neighbor traffic is heaviest for tp/sp
+collectives, so those sit innermost where `jax.experimental.mesh_utils`-style
+device orderings keep them on adjacent chips.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "pp", "sp", "tp", "ep")
+
+
+def build_mesh(
+    dp: int = 1,
+    pp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    ep: int = 1,
+    devices=None,
+) -> Mesh:
+    """A mesh with the canonical five axes (size-1 axes are fine and cost
+    nothing — shardings over them are no-ops)."""
+    sizes = {"dp": dp, "pp": pp, "sp": sp, "tp": tp, "ep": ep}
+    for name, size in sizes.items():
+        if size < 1:
+            raise ValueError(f"{name}={size} must be >= 1")
+    n = math.prod(sizes.values())
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(*(sizes[a] for a in AXES))
+    return Mesh(arr, AXES)
+
+
+def mesh_from_bootstrap(
+    bootstrap,
+    dp: int = 0,
+    pp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    ep: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build the logical mesh for a CSI-provisioned slice.
+
+    ``dp=0`` (default) absorbs the leftover: dp = n_chips // (pp*sp*tp*ep),
+    so a workload can say "tp=4, everything else data-parallel" regardless of
+    slice size.
+    """
+    n = math.prod(bootstrap.mesh) if bootstrap.mesh else len(bootstrap.chips)
+    fixed = pp * sp * tp * ep
+    if dp == 0:
+        if n % fixed != 0:
+            raise ValueError(
+                f"slice of {n} chips not divisible by pp*sp*tp*ep={fixed}"
+            )
+        dp = n // fixed
+    if dp * fixed != n:
+        raise ValueError(
+            f"dp*pp*sp*tp*ep={dp * fixed} does not match slice size {n}"
+        )
+    return build_mesh(dp=dp, pp=pp, sp=sp, tp=tp, ep=ep, devices=devices)

@@ -1,0 +1,124 @@
+"""Ring attention: exact attention over sequence shards on a ring.
+
+Long-context/sequence parallelism for the framework (SURVEY.md §2.3): each
+device of the ``sp`` axis holds a sequence block of Q, K, V; K/V blocks
+rotate around the ring via ``ppermute`` while every device accumulates its
+queries' attention with a numerically-stable online softmax (flash-attention
+style running max/denominator).  After ``sp`` steps every Q block has seen
+every K/V block — exact attention, O(T/sp) memory per chip, and the
+rotation overlaps with compute on ICI neighbor links (the XLA latency-hiding
+scheduler overlaps the collective-permute with the einsums).
+
+Used inside ``shard_map`` with the sequence dimension sharded over the ring
+axis (blockwise ring attention per Liu et al., implemented from scratch for
+this framework).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_NEG_BIG = -1e30  # finite "-inf": keeps fully-masked rows NaN-free
+
+
+def _block_attention(q, k, v, m, l, o, q_offset, k_offset, causal, scale):
+    """One flash-style accumulation step of local q against one k/v block.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]
+    m, l: [B, H, Tq]; o: [B, Tq, H, D] (running max / denom / numerator)
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(tq)[:, None]
+        k_pos = k_offset + jnp.arange(tk)[None, :]
+        scores = jnp.where(q_pos >= k_pos, scores, _NEG_BIG)
+    block_max = jnp.max(scores, axis=-1)  # [B, H, Tq]
+    new_m = jnp.maximum(m, block_max)
+    correction = jnp.exp(m - new_m)
+    p = jnp.exp(scores - new_m[..., None])  # [B, H, Tq, Tk]
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return new_m, new_l, new_o
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention over a ring of sequence shards.
+
+    Args:
+      q, k, v: local shards ``[batch, seq_local, heads, head_dim]``; the
+        global sequence is the concatenation over the ``axis_name`` ring in
+        axis-index order.
+      axis_name: mesh axis carrying the sequence shards (``sp``).
+      causal: standard causal masking in *global* positions.
+
+    Returns the local output shard ``[batch, seq_local, heads, head_dim]``.
+    """
+    size = jax.lax.axis_size(axis_name)
+    index = jax.lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    scale = 1.0 / (d**0.5)
+    dtype = q.dtype
+    # Accumulate in f32 regardless of input dtype (bf16-safe softmax).
+    qf, kf, vf = q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+
+    # Derive the accumulator inits from q (zeroed) rather than jnp.zeros:
+    # under shard_map the carry must have the same varying-manual-axes type
+    # as the loop outputs, and inheriting q's does that on any jax version.
+    zero_bht = jnp.swapaxes(qf, 1, 2)[..., 0] * 0.0  # [B, H, Tq]
+    m0 = zero_bht + _NEG_BIG
+    l0 = zero_bht
+    o0 = qf * 0.0
+    q_offset = index * t_local
+
+    def step(carry, step_idx):
+        m, l, o, k_blk, v_blk = carry
+        # The k/v block currently held started at ring position
+        # (index - step) mod size.
+        k_owner = (index - step_idx) % size
+        k_offset = k_owner * t_local
+        m, l, o = _block_attention(
+            qf, k_blk, v_blk, m, l, o, q_offset, k_offset, causal, scale
+        )
+        # Rotate k/v one hop around the ring (neighbor traffic on ICI).
+        perm = [(i, (i + 1) % size) for i in range(size)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (m, l, o, k_next, v_next), None
+
+    (m, l, o, _, _), _ = jax.lax.scan(
+        step, (m0, l0, o0, kf, vf), jnp.arange(size)
+    )
+    # Fully-masked rows (can only happen for non-causal degenerate inputs)
+    # keep l == 0; guard the division.
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(dtype)
+
+
+# The O(T²) correctness oracle lives in oim_tpu.ops (one canonical copy).
+from oim_tpu.ops.flash_attention import reference_attention  # noqa: E402
+
+
+def ring_attention_sharded(q, k, v, mesh, causal: bool = True, rules=None):
+    """Convenience wrapper: global arrays in, global arrays out, with the
+    sequence dimension sharded over ``sp`` and batch over ``dp``."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P("dp", "sp", None, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name="sp", causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

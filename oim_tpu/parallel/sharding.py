@@ -1,0 +1,78 @@
+"""Logical-dimension → mesh-axis sharding rules.
+
+Arrays are annotated with *logical* dimension names ("batch", "seq",
+"heads", ...); ``ShardingRules`` maps those to mesh axes, so a model written
+once runs under any parallelism mix — change the rules, not the model.  XLA
+(GSPMD) inserts the collectives implied by the shardings; the framework only
+drops explicit `shard_map` down where the schedule itself matters (ring
+attention, pipeline).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    batch: str | None = "dp"
+    seq: str | None = "sp"
+    heads: str | None = "tp"
+    model: str | None = None  # d_model stays replicated by default
+    mlp: str | None = "tp"  # ffn hidden
+    vocab: str | None = "tp"
+    experts: str | None = "ep"
+    stages: str | None = "pp"  # stacked pipeline stage dimension
+
+    def axis_for(self, logical: str | None) -> str | None:
+        if logical is None:
+            return None
+        try:
+            return getattr(self, logical)
+        except AttributeError:
+            raise ValueError(f"unknown logical dimension {logical!r}") from None
+
+
+DEFAULT_RULES = ShardingRules()
+
+
+def partition_spec(
+    logical_dims: tuple[str | None, ...], rules: ShardingRules = DEFAULT_RULES
+) -> PartitionSpec:
+    return PartitionSpec(*(rules.axis_for(dim) for dim in logical_dims))
+
+
+def named_sharding(
+    mesh: Mesh,
+    logical_dims: tuple[str | None, ...],
+    rules: ShardingRules = DEFAULT_RULES,
+) -> NamedSharding:
+    return NamedSharding(mesh, partition_spec(logical_dims, rules))
+
+
+def constrain(
+    x,
+    logical_dims: tuple[str | None, ...],
+    rules: ShardingRules = DEFAULT_RULES,
+):
+    """``with_sharding_constraint`` by logical names; under jit with a mesh
+    in scope this pins activation layouts so GSPMD keeps collectives where
+    intended (HBM-bandwidth control)."""
+    return jax.lax.with_sharding_constraint(x, partition_spec(logical_dims, rules))
+
+
+def shard_pytree(params, mesh: Mesh, logical_tree, rules: ShardingRules = DEFAULT_RULES):
+    """Place a parameter pytree onto the mesh.
+
+    ``logical_tree`` mirrors ``params`` with tuples of logical dim names as
+    leaves.  Uses ``jax.device_put`` which is a no-op for already-correct
+    placements.
+    """
+    return jax.tree.map(
+        lambda x, logical: jax.device_put(x, named_sharding(mesh, logical, rules)),
+        params,
+        logical_tree,
+    )

@@ -18,4 +18,13 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The image's sitecustomize may have imported jax (registering the axon TPU
+# platform) before this file ran, in which case the env vars above are too
+# late; the backend itself initializes lazily, so forcing the platform via
+# jax.config still wins as long as no devices were touched yet.
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

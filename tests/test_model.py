@@ -1,0 +1,165 @@
+"""Flagship model tests: forward correctness across parallelism mixes and
+actual learning (loss decrease) on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oim_tpu.models import (
+    TrainState,
+    TransformerConfig,
+    init_params,
+    make_train_step,
+)
+from oim_tpu.models.train import shard_state, data_pspec
+from oim_tpu.parallel import build_mesh
+
+import optax
+
+TINY = dict(
+    vocab_size=64,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+)
+
+
+def _data(batch, seq, vocab, seed=0):
+    key = jax.random.PRNGKey(seed)
+    # A learnable pattern: token t+1 = (token t + 1) mod vocab.
+    start = jax.random.randint(key, (batch, 1), 0, vocab)
+    ramp = jnp.arange(seq)[None, :]
+    return (start + ramp) % vocab
+
+
+def _run_steps(cfg, mesh, batch=8, seq=16, steps=8, seed=0):
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    optimizer = optax.adamw(1e-2)
+    state = shard_state(TrainState.create(params, optimizer), cfg, mesh)
+    step_fn = make_train_step(cfg, mesh, optimizer)
+    tokens = jax.device_put(
+        _data(batch, seq, cfg.vocab_size),
+        jax.sharding.NamedSharding(mesh, data_pspec()),
+    )
+    losses = []
+    for _ in range(steps):
+        state, metrics = step_fn(state, tokens)
+        losses.append(float(metrics["ce"]))
+    return losses
+
+
+class TestTrainingMixes:
+    def test_single_device_mesh(self):
+        mesh = build_mesh(devices=jax.devices()[:1])
+        losses = _run_steps(TransformerConfig(**TINY), mesh, batch=4)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.9
+
+    def test_dp_sp_mix(self):
+        mesh = build_mesh(dp=2, sp=4)
+        losses = _run_steps(TransformerConfig(**TINY), mesh)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.9
+
+    def test_dp_tp_mix(self):
+        mesh = build_mesh(dp=2, tp=4)
+        losses = _run_steps(TransformerConfig(**TINY), mesh)
+        assert losses[-1] < losses[0] * 0.9
+
+    def test_pp_pipeline(self):
+        mesh = build_mesh(pp=2, tp=2, dp=2)
+        cfg = TransformerConfig(**TINY, n_stages=2, n_microbatches=2)
+        losses = _run_steps(cfg, mesh)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.9
+
+    def test_moe_ep(self):
+        cfg = TransformerConfig(
+            **{**TINY, "n_experts": 4, "expert_capacity_factor": 2.0}
+        )
+        mesh = build_mesh(dp=2, ep=4)
+        losses = _run_steps(cfg, mesh)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.9
+
+    def test_all_axes_at_once(self):
+        """dp·pp·sp·tp·ep = 2·2·2·1·1 with tp/ep exercised at size 1; the
+        8-device full mix (all >1) needs 32 devices — shape-checked in
+        dryrun_multichip instead."""
+        cfg = TransformerConfig(**TINY, n_stages=2, n_microbatches=2)
+        mesh = build_mesh(dp=2, pp=2, sp=2)
+        losses = _run_steps(cfg, mesh)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.9
+
+
+class TestParallelConsistency:
+    def test_same_loss_across_meshes(self):
+        """The first-step loss must not depend on how the mesh is sliced."""
+        cfg = TransformerConfig(**TINY)
+        results = []
+        for kwargs in [dict(dp=1), dict(dp=2, sp=2), dict(dp=4, tp=2)]:
+            mesh = build_mesh(**kwargs)
+            losses = _run_steps(cfg, mesh, batch=4, seq=8, steps=1, seed=7)
+            results.append(losses[0])
+        np.testing.assert_allclose(results[0], results[1], rtol=1e-4)
+        np.testing.assert_allclose(results[0], results[2], rtol=1e-4)
+
+    def test_moe_params_stay_replicated_across_dp(self):
+        """The MoE aux loss is per-device; without pmean over dp the
+        gradients desynchronize replicated params (regression)."""
+        cfg = TransformerConfig(
+            **{**TINY, "n_experts": 4, "expert_capacity_factor": 2.0}
+        )
+        mesh = build_mesh(dp=2)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        optimizer = optax.adamw(1e-2)
+        state = shard_state(TrainState.create(params, optimizer), cfg, mesh)
+        step_fn = make_train_step(cfg, mesh, optimizer)
+        tokens = jax.device_put(
+            _data(4, 16, cfg.vocab_size, seed=3),
+            jax.sharding.NamedSharding(mesh, data_pspec()),
+        )
+        for _ in range(3):
+            state, _ = step_fn(state, tokens)
+        for name in ("router", "wq", "wte", "wlm"):
+            shards = [
+                np.asarray(s.data) for s in state.params[name].addressable_shards
+            ]
+            for shard in shards[1:]:
+                np.testing.assert_array_equal(shards[0], shard, err_msg=name)
+
+    def test_params_stay_replicated_under_pp(self):
+        """Replicated params (wte/wlm/final_norm) must receive identical
+        gradients on every pipeline stage (regression)."""
+        cfg = TransformerConfig(**TINY, n_stages=2, n_microbatches=2)
+        mesh = build_mesh(dp=2, pp=2)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        optimizer = optax.adamw(1e-2)
+        state = shard_state(TrainState.create(params, optimizer), cfg, mesh)
+        step_fn = make_train_step(cfg, mesh, optimizer)
+        tokens = jax.device_put(
+            _data(4, 16, cfg.vocab_size, seed=4),
+            jax.sharding.NamedSharding(mesh, data_pspec()),
+        )
+        first = None
+        for _ in range(4):
+            state, metrics = step_fn(state, tokens)
+            first = first if first is not None else float(metrics["ce"])
+        assert float(metrics["ce"]) < first  # wte/wlm actually learn
+        for name in ("wte", "wlm", "final_norm"):
+            shards = [
+                np.asarray(s.data) for s in state.params[name].addressable_shards
+            ]
+            for shard in shards[1:]:
+                np.testing.assert_array_equal(shards[0], shard, err_msg=name)
+
+    def test_stage_mesh_mismatch_rejected(self):
+        """n_stages > mesh pp would silently drop layers (regression)."""
+        cfg = TransformerConfig(**TINY, n_stages=2)
+        mesh = build_mesh(dp=2)
+        with pytest.raises(ValueError, match="n_stages"):
+            make_train_step(cfg, mesh)

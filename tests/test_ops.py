@@ -1,0 +1,126 @@
+"""Pallas-op tests (interpreter mode on CPU) against plain-JAX oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oim_tpu.ops import (
+    apply_rope,
+    flash_attention,
+    reference_attention,
+    reference_rmsnorm,
+    rmsnorm,
+)
+
+
+class TestRMSNorm:
+    @pytest.mark.parametrize("shape", [(4, 64), (2, 3, 128), (300, 64)])
+    def test_matches_reference(self, shape):
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, shape)
+        w = jax.random.normal(jax.random.PRNGKey(1), (shape[-1],)) + 1.0
+        np.testing.assert_allclose(
+            np.asarray(rmsnorm(x, w)),
+            np.asarray(reference_rmsnorm(x, w)),
+            rtol=1e-5,
+            atol=1e-5,
+        )
+
+    def test_mixed_dtype_bf16_x_f32_w(self):
+        """bf16 activations with f32 params — the training configuration;
+        forward dtype and backward cotangent types must line up."""
+        x = jax.random.normal(jax.random.PRNGKey(5), (8, 32)).astype(jnp.bfloat16)
+        w = jnp.ones((32,), jnp.float32)
+        out = rmsnorm(x, w)
+        assert out.dtype == jnp.bfloat16
+        grads = jax.grad(
+            lambda x, w: jnp.sum(rmsnorm(x, w).astype(jnp.float32) ** 2), (0, 1)
+        )(x, w)
+        assert grads[0].dtype == jnp.bfloat16
+        assert grads[1].dtype == jnp.float32
+
+    def test_gradients(self):
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 32))
+        w = jnp.ones((32,))
+
+        g_kernel = jax.grad(lambda x, w: jnp.sum(rmsnorm(x, w) ** 2), (0, 1))(x, w)
+        g_ref = jax.grad(
+            lambda x, w: jnp.sum(reference_rmsnorm(x, w) ** 2), (0, 1)
+        )(x, w)
+        for a, b in zip(g_kernel, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference_blocked(self, causal):
+        b, t, h, d = 2, 256, 2, 32
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(key, (b, t, h, d)) for key in keys)
+        out = flash_attention(q, k, v, causal, 128, 128)
+        expected = reference_attention(q, k, v, causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), rtol=2e-5, atol=2e-5
+        )
+
+    def test_ragged_falls_back(self):
+        b, t, h, d = 1, 48, 2, 16  # 48 not divisible by 128
+        keys = jax.random.split(jax.random.PRNGKey(1), 3)
+        q, k, v = (jax.random.normal(key, (b, t, h, d)) for key in keys)
+        out = flash_attention(q, k, v, True)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(reference_attention(q, k, v, True)),
+            rtol=2e-5,
+            atol=2e-5,
+        )
+
+    def test_gradients(self):
+        b, t, h, d = 1, 128, 2, 16
+        q = jax.random.normal(jax.random.PRNGKey(3), (b, t, h, d))
+
+        def loss_flash(q):
+            return jnp.sum(flash_attention(q, q, q, True, 64, 64) ** 2)
+
+        def loss_ref(q):
+            return jnp.sum(reference_attention(q, q, q, True) ** 2)
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(loss_flash)(q)),
+            np.asarray(jax.grad(loss_ref)(q)),
+            rtol=1e-4,
+            atol=1e-4,
+        )
+
+
+class TestRope:
+    def test_rotation_preserves_norm(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4, 32))
+        positions = jnp.broadcast_to(jnp.arange(16), (2, 16))
+        rotated = apply_rope(x, positions)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(rotated), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1),
+            rtol=1e-5,
+        )
+
+    def test_position_zero_is_identity(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 2, 16))
+        rotated = apply_rope(x, jnp.zeros((1, 1), dtype=jnp.int32))
+        np.testing.assert_allclose(np.asarray(rotated), np.asarray(x), rtol=1e-6)
+
+    def test_relative_shift_invariance(self):
+        """RoPE scores depend only on relative positions: q·k at (p, p+Δ) is
+        the same for any p — the property ring attention relies on when
+        passing global offsets."""
+        d = 32
+        q = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 1, d))
+        k = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 1, d))
+
+        def score(p):
+            qr = apply_rope(q, jnp.array([[p]]))
+            kr = apply_rope(k, jnp.array([[p + 5]]))
+            return float(jnp.sum(qr * kr))
+
+        assert abs(score(0) - score(117)) < 1e-3
