@@ -1,0 +1,267 @@
+#!/usr/bin/env python3
+"""Benchmark: CSI NodePublish → first-PJRT-op p50 latency (north star).
+
+Runs the REAL control plane in-process — C++ tpu-agent (fake-chip mode) →
+controller → registry (transparent proxy, self-registration) → CSI driver in
+remote mode — and measures, per iteration, the wall time from CreateVolume
+through NodeStage/NodePublish to the first JAX op completing on the real
+accelerator (the generalization of the reference's attach→mount→first-IO
+path; see BASELINE.md).  Prints ONE JSON line on stdout:
+
+    {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": target/p50}
+
+vs_baseline > 1 means faster than the target budget (TARGET_P50_MS, from
+BASELINE.md — the reference publishes no numbers).  Diagnostics go to stderr.
+"""
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+TARGET_P50_MS = 250.0
+ITERATIONS = 20
+
+NATIVE_AGENT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "native/tpu-agent/tpu-agent"
+)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def start_agent(tmp: str):
+    """Prefer the C++ daemon; fall back to the in-process Python fake."""
+    sock = os.path.join(tmp, "agent.sock")
+    if not os.path.exists(NATIVE_AGENT):
+        subprocess.run(
+            ["make", "-C", os.path.dirname(NATIVE_AGENT)],
+            capture_output=True,
+        )
+    if os.path.exists(NATIVE_AGENT):
+        proc = subprocess.Popen(
+            [
+                NATIVE_AGENT,
+                "--socket", sock,
+                "--fake-chips", "8",
+                "--mesh", "2x2x2",
+                "--state-dir", tmp,
+            ],
+            stderr=subprocess.DEVNULL,
+        )
+        import socket as socketlib
+
+        deadline = time.time() + 10
+        while True:
+            probe = socketlib.socket(socketlib.AF_UNIX)
+            try:
+                probe.connect(sock)
+                probe.close()
+                break
+            except OSError:
+                probe.close()
+                if time.time() > deadline:
+                    raise RuntimeError("native agent never came up")
+                time.sleep(0.05)
+        log(f"bench: device plane = native C++ agent ({NATIVE_AGENT})")
+        return sock, proc.terminate
+    from oim_tpu.agent import ChipStore, FakeAgentServer
+
+    store = ChipStore(mesh=(2, 2, 2), device_dir=tmp)
+    server = FakeAgentServer(store, sock).start()
+    log("bench: device plane = python fake agent")
+    return sock, server.stop
+
+
+def main() -> int:
+    import grpc
+    import jax
+    import jax.numpy as jnp
+
+    from oim_tpu.controller import Controller
+    from oim_tpu.csi import OIMDriver
+    from oim_tpu.registry import Registry
+    from oim_tpu.spec import CSI_CONTROLLER, CSI_NODE, csi_pb2
+
+    log(f"bench: jax backend = {jax.default_backend()}, devices = {jax.devices()}")
+
+    tmp = tempfile.mkdtemp(prefix="oim-bench-")
+    agent_sock, stop_agent = start_agent(tmp)
+    cleanups = [stop_agent]
+    try:
+        return _run(tmp, agent_sock, cleanups)
+    finally:
+        for cleanup in reversed(cleanups):
+            try:
+                cleanup()
+            except Exception:
+                pass
+
+
+def _run(tmp: str, agent_sock: str, cleanups: list) -> int:
+    import grpc
+    import jax
+    import jax.numpy as jnp
+
+    from oim_tpu.controller import Controller
+    from oim_tpu.csi import OIMDriver
+    from oim_tpu.registry import Registry
+    from oim_tpu.spec import CSI_CONTROLLER, CSI_NODE, csi_pb2
+
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    cleanups.append(reg_srv.stop)
+    controller = Controller(
+        "bench-host", agent_sock, registry_address=str(reg_srv.addr()),
+        registry_delay=30.0,
+    )
+    ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
+    cleanups.append(ctrl_srv.stop)
+    cleanups.append(controller.close)
+    controller.start(str(ctrl_srv.addr()))
+    driver = OIMDriver(
+        csi_endpoint=f"unix://{tmp}/csi.sock",
+        registry_address=str(reg_srv.addr()),
+        controller_id="bench-host",
+    )
+    csi_srv = driver.start_server()
+    cleanups.append(csi_srv.stop)
+    channel = grpc.insecure_channel(csi_srv.addr().grpc_target())
+    cleanups.append(channel.close)
+    csi_controller = CSI_CONTROLLER.stub(channel)
+    node = CSI_NODE.stub(channel)
+
+    deadline = time.time() + 10
+    while registry.db.lookup("bench-host/address") == "":
+        if time.time() > deadline:
+            raise RuntimeError("controller never registered")
+        time.sleep(0.01)
+
+    cap = csi_pb2.VolumeCapability()
+    cap.mount.SetInParent()
+    cap.access_mode.mode = csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER
+
+    # The "first PJRT op" a freshly-scheduled workload runs: compiled once
+    # per process (PJRT caches executables), executed per iteration.
+    first_op = jax.jit(lambda x: (x @ x).sum())
+    warm = jnp.ones((128, 128), jnp.bfloat16)
+    first_op(warm).block_until_ready()
+
+    def one_cycle(i: int) -> float:
+        volume = f"bench-{i}"
+        staging = os.path.join(tmp, f"staging-{i}")
+        target = os.path.join(tmp, f"target-{i}")
+        start = time.perf_counter()
+        vol = csi_controller.CreateVolume(
+            csi_pb2.CreateVolumeRequest(
+                name=volume,
+                volume_capabilities=[cap],
+                parameters={"chipCount": "4"},
+            ),
+            timeout=30,
+        ).volume
+        node.NodeStageVolume(
+            csi_pb2.NodeStageVolumeRequest(
+                volume_id=volume,
+                staging_target_path=staging,
+                volume_capability=cap,
+                volume_context=dict(vol.volume_context),
+            ),
+            timeout=30,
+        )
+        node.NodePublishVolume(
+            csi_pb2.NodePublishVolumeRequest(
+                volume_id=volume,
+                staging_target_path=staging,
+                target_path=target,
+                volume_capability=cap,
+            ),
+            timeout=30,
+        )
+        # Pod starts: read the bootstrap, run the first accelerator op.
+        with open(os.path.join(target, "tpu-bootstrap.json")) as f:
+            bootstrap = json.load(f)
+        assert len(bootstrap["chips"]) == 4
+        first_op(warm).block_until_ready()
+        elapsed_ms = (time.perf_counter() - start) * 1000
+        # Teardown outside the timed region.
+        node.NodeUnpublishVolume(
+            csi_pb2.NodeUnpublishVolumeRequest(volume_id=volume, target_path=target),
+            timeout=30,
+        )
+        node.NodeUnstageVolume(
+            csi_pb2.NodeUnstageVolumeRequest(
+                volume_id=volume, staging_target_path=staging
+            ),
+            timeout=30,
+        )
+        csi_controller.DeleteVolume(
+            csi_pb2.DeleteVolumeRequest(volume_id=volume), timeout=30
+        )
+        return elapsed_ms
+
+    one_cycle(-1)  # warm the whole path once
+    latencies = [one_cycle(i) for i in range(ITERATIONS)]
+    p50 = statistics.median(latencies)
+    p95 = sorted(latencies)[int(0.95 * len(latencies)) - 1]
+    log(
+        f"bench: NodePublish→first-op over {ITERATIONS} cycles: "
+        f"p50={p50:.1f}ms p95={p95:.1f}ms min={min(latencies):.1f}ms"
+    )
+
+    # Supplementary: single-chip training throughput of the flagship model.
+    try:
+        import optax
+
+        from oim_tpu.models import TransformerConfig, init_params, make_train_step
+        from oim_tpu.models.train import TrainState, data_pspec, shard_state
+        from oim_tpu.parallel import build_mesh
+
+        mesh = build_mesh(devices=jax.devices()[:1])
+        cfg = TransformerConfig(
+            vocab_size=8192, d_model=512, n_layers=4, n_heads=8, d_ff=1024,
+            dtype="bfloat16",
+        )
+        optimizer = optax.adamw(1e-3)
+        state = shard_state(
+            TrainState.create(init_params(jax.random.PRNGKey(0), cfg), optimizer),
+            cfg,
+            mesh,
+        )
+        step = make_train_step(cfg, mesh, optimizer)
+        tokens = jax.device_put(
+            (jnp.arange(4 * 256) % 8192).reshape(4, 256).astype(jnp.int32),
+            jax.sharding.NamedSharding(mesh, data_pspec()),
+        )
+        state, _ = step(state, tokens)  # compile
+        jax.block_until_ready(state.step)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            state, metrics = step(state, tokens)
+        jax.block_until_ready(metrics["ce"])
+        dt = (time.perf_counter() - t0) / 10
+        log(f"bench: flagship train step {dt*1000:.1f} ms ({4*256/dt:.0f} tok/s)")
+    except Exception as exc:  # pragma: no cover - diagnostics only
+        log(f"bench: training diagnostic skipped: {exc}")
+
+    print(
+        json.dumps(
+            {
+                "metric": "csi_nodepublish_to_first_pjrt_op_p50",
+                "value": round(p50, 2),
+                "unit": "ms",
+                "vs_baseline": round(TARGET_P50_MS / p50, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -112,7 +112,18 @@ int main(int argc, char** argv) {
 
   for (int i = 1; i < argc; i++) {
     std::string arg = argv[i];
-    auto next = [&]() -> const char* {
+    // Accept both "--flag value" and "--flag=value" (k8s manifests commonly
+    // use the latter).
+    std::string inline_value;
+    bool has_inline = false;
+    size_t eq = arg.find('=');
+    if (arg.rfind("--", 0) == 0 && eq != std::string::npos) {
+      inline_value = arg.substr(eq + 1);
+      arg = arg.substr(0, eq);
+      has_inline = true;
+    }
+    auto next = [&]() -> std::string {
+      if (has_inline) return inline_value;
       if (i + 1 >= argc) {
         Usage(argv[0]);
         std::exit(2);
@@ -120,7 +131,7 @@ int main(int argc, char** argv) {
       return argv[++i];
     };
     if (arg == "--socket") socket_path = next();
-    else if (arg == "--fake-chips") fake_chips = std::atoi(next());
+    else if (arg == "--fake-chips") fake_chips = std::atoi(next().c_str());
     else if (arg == "--mesh") mesh_spec = next();
     else if (arg == "--state-dir") state_dir = next();
     else if (arg == "--devices") devices_glob = next();

@@ -1,0 +1,1 @@
+"""CLI binaries (≙ reference cmd/*): thin flag → options → run wiring."""

@@ -1,0 +1,51 @@
+"""tpu-agent-py: the Python fake device-plane daemon as a standalone process.
+
+Development/test convenience only — production uses the C++ daemon under
+native/tpu-agent (same protocol; tests/test_agent_protocol.py holds both to
+identical behavior)."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from oim_tpu import log
+from oim_tpu.agent import ChipStore, FakeAgentServer
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--socket", required=True)
+    parser.add_argument("--fake-chips", type=int, default=8)
+    parser.add_argument("--mesh", default="", help="e.g. 2x2x2")
+    parser.add_argument("--state-dir", default="/tmp/tpu-agent-py")
+    parser.add_argument("--accel-type", default="v5p")
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+
+    log.init_from_string(args.log_level)
+    mesh = (
+        tuple(int(d) for d in args.mesh.split("x"))
+        if args.mesh
+        else (args.fake_chips,)
+    )
+    product = 1
+    for d in mesh:
+        product *= d
+    if product != args.fake_chips:
+        parser.error(f"--mesh {args.mesh} does not multiply to {args.fake_chips}")
+    store = ChipStore(
+        mesh=mesh, accel_type=args.accel_type, device_dir=args.state_dir
+    )
+    server = FakeAgentServer(store, args.socket).start()
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

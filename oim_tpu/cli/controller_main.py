@@ -1,0 +1,70 @@
+"""oim-controller: per-TPU-host controller (≙ reference cmd/oim-controller)."""
+
+from __future__ import annotations
+
+import argparse
+
+from oim_tpu import log
+from oim_tpu.common.tlsconfig import load_tls
+from oim_tpu.controller import Controller
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--id", required=True, help="controller id")
+    parser.add_argument(
+        "--endpoint", default="tcp://0.0.0.0:8998", help="listen endpoint"
+    )
+    parser.add_argument(
+        "--advertised-endpoint",
+        default="",
+        help="address registered with the registry (default: --endpoint)",
+    )
+    parser.add_argument(
+        "--agent-socket",
+        default="/var/run/tpu-agent/agent.sock",
+        help="tpu-agent JSON-RPC socket",
+    )
+    parser.add_argument("--registry", default="", help="registry address")
+    parser.add_argument(
+        "--registry-delay",
+        type=float,
+        default=60.0,
+        help="seconds between re-registrations",
+    )
+    parser.add_argument(
+        "--coordinator-host",
+        default="127.0.0.1",
+        help="host part of the JAX coordinator address handed to workloads",
+    )
+    parser.add_argument("--ca", help="CA cert file (enables mTLS)")
+    parser.add_argument("--cert", help="cert (CN controller.<id>)")
+    parser.add_argument("--key", help="key")
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+
+    log.init_from_string(args.log_level)
+    tls = load_tls(args.ca, args.cert, args.key) if args.ca else None
+    controller = Controller(
+        args.id,
+        args.agent_socket,
+        registry_address=args.registry,
+        tls=tls,
+        registry_delay=args.registry_delay,
+        coordinator_host=args.coordinator_host,
+    )
+    server = controller.start_server(args.endpoint)
+    controller.start(args.advertised_endpoint or str(server.addr()))
+    log.current().info(
+        "oim-controller running", id=args.id, endpoint=str(server.addr())
+    )
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        controller.close()
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

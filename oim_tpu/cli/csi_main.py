@@ -1,0 +1,70 @@
+"""oim-csi-driver: the CSI node/controller plugin (≙ reference
+cmd/oim-csi-driver).  Local vs remote mode is chosen by which of
+--agent-socket / --registry is set, exactly one required (≙ reference
+cmd/oim-csi-driver/main.go:25-26, oim-driver.go:216-226)."""
+
+from __future__ import annotations
+
+import argparse
+
+from oim_tpu import log
+from oim_tpu.common.tlsconfig import load_tls
+from oim_tpu.csi import OIMDriver
+from oim_tpu.csi.mounter import BindMounter, Mounter
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--endpoint", default="unix:///csi/csi.sock", help="CSI endpoint"
+    )
+    parser.add_argument("--node-id", default="node-0")
+    parser.add_argument("--driver-name", default="tpu.oim.io")
+    parser.add_argument("--agent-socket", default="", help="local mode")
+    parser.add_argument("--registry", dest="registry", default="", help="remote mode")
+    parser.add_argument("--controller-id", default="")
+    parser.add_argument("--ca", help="CA cert (remote mode mTLS)")
+    parser.add_argument("--cert", help="cert (CN host.<controller-id>)")
+    parser.add_argument("--key", help="key")
+    parser.add_argument(
+        "--emulate", default="", help="serve as this foreign driver (e.g. gke-tpu)"
+    )
+    parser.add_argument(
+        "--bind-mount",
+        action="store_true",
+        help="publish via mount --bind (requires privilege)",
+    )
+    parser.add_argument("--device-timeout", type=float, default=60.0)
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+
+    log.init_from_string(args.log_level)
+    tls_loader = None
+    if args.ca:
+        # Reload key material on every dial so rotation needs no restart
+        # (≙ reference remote.go:101-114).
+        ca, cert, key = args.ca, args.cert, args.key
+        tls_loader = lambda: load_tls(ca, cert, key)  # noqa: E731
+    driver = OIMDriver(
+        csi_endpoint=args.endpoint,
+        node_id=args.node_id,
+        driver_name=args.driver_name,
+        agent_socket=args.agent_socket,
+        registry_address=args.registry,
+        controller_id=args.controller_id,
+        tls_loader=tls_loader,
+        emulate=args.emulate,
+        mounter=BindMounter() if args.bind_mount else Mounter(),
+        device_timeout=args.device_timeout,
+    )
+    server = driver.start_server()
+    log.current().info("oim-csi-driver running", endpoint=str(server.addr()))
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,103 @@
+"""oimctl: operator tool for the registry (≙ reference cmd/oimctl).
+
+    oimctl get [PATH]             read registry values
+    oimctl set PATH VALUE         write a value (empty VALUE deletes)
+    oimctl map VOLUME --controller ID --chips N    ad-hoc MapVolume
+    oimctl unmap VOLUME --controller ID
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import grpc
+
+from oim_tpu import log
+from oim_tpu.common import endpoint as ep
+from oim_tpu.common.tlsconfig import load_tls
+from oim_tpu.spec import CONTROLLER, REGISTRY, oim_pb2
+
+
+def _channel(args):
+    target = ep.parse(args.registry).grpc_target()
+    if args.ca:
+        tls = load_tls(args.ca, args.cert, args.key, "component.registry")
+        return grpc.secure_channel(
+            target, tls.channel_credentials(), options=tls.channel_options()
+        )
+    return grpc.insecure_channel(target)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--registry", default="tcp://127.0.0.1:8999")
+    parser.add_argument("--ca")
+    parser.add_argument("--cert", help="client cert (CN user.admin)")
+    parser.add_argument("--key")
+    parser.add_argument("--log-level", default="warning")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    get = sub.add_parser("get")
+    get.add_argument("path", nargs="?", default="")
+    set_ = sub.add_parser("set")
+    set_.add_argument("path")
+    set_.add_argument("value")
+    map_ = sub.add_parser("map")
+    map_.add_argument("volume")
+    map_.add_argument("--controller", required=True)
+    map_.add_argument("--chips", type=int, default=0, help="0 = provisioned")
+    unmap = sub.add_parser("unmap")
+    unmap.add_argument("volume")
+    unmap.add_argument("--controller", required=True)
+
+    args = parser.parse_args(argv)
+    log.init_from_string(args.log_level)
+    channel = _channel(args)
+    try:
+        if args.command == "get":
+            reply = REGISTRY.stub(channel).GetValues(
+                oim_pb2.GetValuesRequest(path=args.path), timeout=30
+            )
+            for value in reply.values:
+                print(f"{value.path}={value.value}")
+        elif args.command == "set":
+            REGISTRY.stub(channel).SetValue(
+                oim_pb2.SetValueRequest(
+                    value=oim_pb2.Value(path=args.path, value=args.value)
+                ),
+                timeout=30,
+            )
+        elif args.command == "map":
+            request = oim_pb2.MapVolumeRequest(volume_id=args.volume)
+            if args.chips > 0:
+                request.slice.chip_count = args.chips
+            else:
+                request.provisioned.SetInParent()
+            reply = CONTROLLER.stub(channel).MapVolume(
+                request,
+                metadata=(("controllerid", args.controller),),
+                timeout=60,
+            )
+            print(f"mesh={list(reply.mesh.dims)}")
+            print(f"coordinator={reply.coordinator_address}")
+            for chip in reply.chips:
+                print(
+                    f"chip {chip.chip_id}: {chip.device_path} "
+                    f"coord={list(chip.coord.coords)}"
+                )
+        elif args.command == "unmap":
+            CONTROLLER.stub(channel).UnmapVolume(
+                oim_pb2.UnmapVolumeRequest(volume_id=args.volume),
+                metadata=(("controllerid", args.controller),),
+                timeout=60,
+            )
+    except grpc.RpcError as exc:
+        print(f"error: {exc.code().name}: {exc.details()}")
+        return 1
+    finally:
+        channel.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,46 @@
+"""oim-registry: the cluster registry daemon (≙ reference cmd/oim-registry)."""
+
+from __future__ import annotations
+
+import argparse
+
+from oim_tpu import log
+from oim_tpu.common.tlsconfig import load_tls
+from oim_tpu.registry import MemRegistryDB, Registry, SqliteRegistryDB
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--endpoint", default="tcp://0.0.0.0:8999", help="listen endpoint"
+    )
+    parser.add_argument("--ca", help="CA cert file (enables mTLS)")
+    parser.add_argument("--cert", help="server cert (CN component.registry)")
+    parser.add_argument("--key", help="server key")
+    parser.add_argument(
+        "--db",
+        default="",
+        help="sqlite file for durable state; empty = in-memory",
+    )
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+
+    log.init_from_string(args.log_level)
+    tls = None
+    if args.ca:
+        # Accept any CA-trusted client; per-method CN checks happen inside
+        # (≙ reference cmd/oim-registry/main.go:53).
+        tls = load_tls(args.ca, args.cert, args.key)
+    db = SqliteRegistryDB(args.db) if args.db else MemRegistryDB()
+    registry = Registry(db=db, tls=tls)
+    server = registry.start_server(args.endpoint)
+    log.current().info("oim-registry running", endpoint=str(server.addr()))
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,122 @@
+"""CLI binary smoke test: real processes, driven via oimctl.
+
+≙ the reference's demo-cluster bring-up (`make start`, test/start-stop.make):
+spawn the daemons as subprocesses, verify the operator surface end-to-end.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from oim_tpu.cli import oimctl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(module: str, *args: str) -> subprocess.Popen:
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.Popen(
+        [sys.executable, "-m", module, *args],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def _wait_tcp(port: int, timeout: float = 15.0) -> None:
+    import socket
+
+    deadline = time.time() + timeout
+    while True:
+        s = socket.socket()
+        try:
+            s.connect(("127.0.0.1", port))
+            s.close()
+            return
+        except OSError:
+            s.close()
+            if time.time() > deadline:
+                raise TimeoutError(f"port {port} never came up")
+            time.sleep(0.1)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """registry + python agent + controller as real processes."""
+    agent_sock = str(tmp_path / "agent.sock")
+    procs = []
+    try:
+        procs.append(
+            _spawn(
+                "oim_tpu.cli.agent_main",
+                "--socket", agent_sock,
+                "--fake-chips", "4",
+                "--mesh", "2x2x1",
+                "--state-dir", str(tmp_path),
+            )
+        )
+        procs.append(
+            _spawn(
+                "oim_tpu.cli.registry_main", "--endpoint", "tcp://127.0.0.1:18999"
+            )
+        )
+        _wait_tcp(18999)
+        procs.append(
+            _spawn(
+                "oim_tpu.cli.controller_main",
+                "--id", "cli-host",
+                "--endpoint", "tcp://127.0.0.1:18998",
+                "--agent-socket", agent_sock,
+                "--registry", "tcp://127.0.0.1:18999",
+                "--registry-delay", "0.2",
+            )
+        )
+        _wait_tcp(18998)
+        yield "tcp://127.0.0.1:18999"
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            proc.wait(timeout=10)
+
+
+def _ctl(registry, *args):
+    return oimctl.main(["--registry", registry, *args])
+
+
+def test_cli_cluster_roundtrip(cluster, capsys):
+    registry = cluster
+
+    # Controller self-registers; poll via oimctl get.
+    deadline = time.time() + 10
+    while True:
+        assert _ctl(registry, "get", "cli-host") == 0
+        out = capsys.readouterr().out
+        if "cli-host/address=tcp://127.0.0.1:18998" in out:
+            break
+        assert time.time() < deadline, f"never registered: {out!r}"
+        time.sleep(0.1)
+
+    # KV set/get.
+    assert _ctl(registry, "set", "cli-host/pci", "0000:3f:00.0") == 0
+    assert _ctl(registry, "get", "cli-host/pci") == 0
+    assert "0000:3f:00.0" in capsys.readouterr().out
+
+    # Ad-hoc map through the transparent proxy.
+    assert (
+        _ctl(registry, "map", "vol-cli", "--controller", "cli-host", "--chips", "2")
+        == 0
+    )
+    out = capsys.readouterr().out
+    assert "mesh=[1, 2, 1]" in out
+    assert "coordinator=" in out
+
+    assert _ctl(registry, "unmap", "vol-cli", "--controller", "cli-host") == 0
+
+    # Errors surface as exit code 1 with the gRPC status.
+    assert _ctl(registry, "map", "vol-x", "--controller", "ghost") == 1
+    assert "UNAVAILABLE" in capsys.readouterr().out
