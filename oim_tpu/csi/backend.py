@@ -21,6 +21,7 @@ from oim_tpu.agent import Agent, AgentError, ENODEV, ENOSPC, EEXIST
 from oim_tpu.common import endpoint as ep
 from oim_tpu.common import pci as pcilib
 from oim_tpu.common.tlsconfig import TLSConfig
+from oim_tpu.csi import rendezvous
 from oim_tpu.spec import CONTROLLER, REGISTRY, oim_pb2
 
 
@@ -55,19 +56,52 @@ class VolumeError(Exception):
         self.message = message
 
 
-def _parse_chip_count(params: dict, default: int = 1) -> int:
-    raw = params.get("chipCount", str(default))
+def _parse_int_param(params: dict, key: str, default: int) -> int:
+    raw = params.get(key, str(default))
     try:
         value = int(raw)
     except (TypeError, ValueError):
         raise VolumeError(
-            grpc.StatusCode.INVALID_ARGUMENT, f"invalid chipCount {raw!r}"
+            grpc.StatusCode.INVALID_ARGUMENT, f"invalid {key} {raw!r}"
         ) from None
     if value < 0:
         raise VolumeError(
-            grpc.StatusCode.INVALID_ARGUMENT, f"invalid chipCount {raw!r}"
+            grpc.StatusCode.INVALID_ARGUMENT, f"invalid {key} {raw!r}"
         )
     return value
+
+
+def _parse_chip_count(params: dict, default: int = 1) -> int:
+    return _parse_int_param(params, "chipCount", default)
+
+
+def _parse_membership(params: dict) -> tuple[int, frozenset[str] | None]:
+    """(num_hosts, declared member set or None) from the volume parameters.
+
+    ``hosts`` (comma-separated host ids) declares fixed membership —
+    recommended for multi-host volumes since it makes the rendezvous immune
+    to stale or foreign registry entries; ``numHosts`` alone allows dynamic
+    membership.  Both given must agree.
+    """
+    members = None
+    raw = params.get("hosts", "")
+    if raw:
+        ids = [h.strip() for h in raw.split(",") if h.strip()]
+        if not ids or len(set(ids)) != len(ids):
+            raise VolumeError(
+                grpc.StatusCode.INVALID_ARGUMENT, f"invalid hosts {raw!r}"
+            )
+        members = frozenset(ids)
+    num_hosts = _parse_int_param(params, "numHosts", 0)
+    if members is not None:
+        if num_hosts and num_hosts != len(members):
+            raise VolumeError(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"numHosts={num_hosts} contradicts hosts list of "
+                f"{len(members)}",
+            )
+        num_hosts = len(members)
+    return max(1, num_hosts), members
 
 
 def wait_for_devices(paths: list[str], timeout: float, poll: float = 0.1) -> None:
@@ -184,7 +218,9 @@ class LocalBackend:
         with self._agent() as agent:
             return agent.get_topology()["free_chips"]
 
-    def create_device(self, volume_id: str, params: dict) -> StagedDevice:
+    def create_device(
+        self, volume_id: str, params: dict, deadline: float | None = None
+    ) -> StagedDevice:
         with self._agent() as agent:
             alloc = agent.find_allocation(volume_id)
             if alloc is None:
@@ -247,11 +283,16 @@ class RemoteBackend:
         controller_id: str,
         tls_loader: Callable[[], TLSConfig] | None = None,
         map_params: Callable[[dict], oim_pb2.MapVolumeRequest] | None = None,
+        rendezvous_timeout: float = 60.0,
     ) -> None:
         self.registry_address = registry_address
         self.controller_id = controller_id
         self.tls_loader = tls_loader
         self.map_params = map_params
+        # Multi-host rendezvous identity: one controller per host, so the
+        # controller id doubles as the host id (it is also what the host's
+        # TLS CN ``host.<id>`` pins, so the registry authz lines up).
+        self.rendezvous_timeout = rendezvous_timeout
 
     def _channel(self) -> grpc.Channel:
         target = ep.parse(self.registry_address).grpc_target()
@@ -319,7 +360,9 @@ class RemoteBackend:
                 return value.value
         return ""
 
-    def create_device(self, volume_id: str, params: dict) -> StagedDevice:
+    def create_device(
+        self, volume_id: str, params: dict, deadline: float | None = None
+    ) -> StagedDevice:
         def run(channel):
             default_pci = self.default_pci(channel)
             if self.map_params is not None:
@@ -344,7 +387,38 @@ class RemoteBackend:
             )
             return _staged_from_reply(volume_id, reply, default_pci)
 
-        return self._call(run)
+        staged = self._call(run)
+        num_hosts, members = _parse_membership(params)
+        if num_hosts > 1:
+            # Converge with the volume's other hosts on one coordinator and
+            # a stable process-id assignment (oim_tpu/csi/rendezvous.py).
+            if not staged.coordinator_address:
+                raise VolumeError(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"volume {volume_id!r}: controller returned no "
+                    "coordinator candidate for a multi-host volume",
+                )
+            timeout = self.rendezvous_timeout
+            if deadline is not None:
+                # Respect the CSI call's own deadline, like the device wait
+                # (≙ oim-driver_test.go:209-226's ctx-cancellation check).
+                timeout = min(timeout, max(deadline - time.monotonic(), 0.1))
+            try:
+                placement = rendezvous.join(
+                    self._channel,
+                    volume_id,
+                    self.controller_id,
+                    staged.coordinator_address,
+                    num_hosts,
+                    timeout=timeout,
+                    members=members,
+                )
+            except rendezvous.RendezvousError as exc:
+                raise VolumeError(exc.code, exc.message) from exc
+            staged.num_processes = placement.num_processes
+            staged.process_id = placement.process_id
+            staged.coordinator_address = placement.coordinator_address
+        return staged
 
     def destroy_device(self, volume_id: str) -> None:
         def run(channel):
@@ -355,3 +429,4 @@ class RemoteBackend:
             )
 
         self._call(run)
+        rendezvous.withdraw(self._channel, volume_id, self.controller_id)

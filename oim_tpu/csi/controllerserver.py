@@ -13,25 +13,37 @@ from __future__ import annotations
 import grpc
 
 from oim_tpu.controller.keymutex import KeyMutex
-from oim_tpu.csi.backend import VolumeError, _parse_chip_count
+from oim_tpu.csi.backend import VolumeError, _parse_chip_count, _parse_membership
 from oim_tpu.spec import csi_pb2
 
-SUPPORTED_ACCESS_MODES = (
+SINGLE_NODE_ACCESS_MODES = (
     csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER,
     csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_READER_ONLY,
 )
+# A multi-host slice is staged on every member host by design, which in CSI
+# terms is a multi-node volume.
+MULTI_NODE_ACCESS_MODES = SINGLE_NODE_ACCESS_MODES + (
+    csi_pb2.VolumeCapability.AccessMode.MULTI_NODE_READER_ONLY,
+    csi_pb2.VolumeCapability.AccessMode.MULTI_NODE_MULTI_WRITER,
+)
 
 
-def validate_capabilities(capabilities, context) -> None:
+def _allowed_modes(params: dict):
+    num_hosts, _ = _parse_membership(params)
+    return MULTI_NODE_ACCESS_MODES if num_hosts > 1 else SINGLE_NODE_ACCESS_MODES
+
+
+def validate_capabilities(capabilities, params: dict, context) -> None:
     if not capabilities:
         context.abort(
             grpc.StatusCode.INVALID_ARGUMENT, "volume_capabilities required"
         )
+    allowed = _allowed_modes(params)
     for cap in capabilities:
-        if cap.access_mode.mode not in SUPPORTED_ACCESS_MODES:
+        if cap.access_mode.mode not in allowed:
             context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
-                "a TPU slice attaches to a single node; access mode "
+                "a single-host TPU slice attaches to one node; access mode "
                 f"{cap.access_mode.mode} unsupported",
             )
 
@@ -51,23 +63,37 @@ class ControllerServer:
     def CreateVolume(self, request, context) -> csi_pb2.CreateVolumeResponse:
         if not request.name:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "name required")
-        validate_capabilities(request.volume_capabilities, context)
+        params = dict(request.parameters)
+        validate_capabilities(request.volume_capabilities, params, context)
         try:
-            chip_count = _parse_chip_count(dict(request.parameters))
+            chip_count = _parse_chip_count(params)
+            num_hosts, _ = _parse_membership(params)
         except VolumeError as exc:
             context.abort(exc.code, exc.message)
         if request.capacity_range.required_bytes > 0:
             # Orchestrators that size PVCs in "bytes" get 1 chip per unit.
             chip_count = max(chip_count, int(request.capacity_range.required_bytes))
         with self._mutex.locked(request.name):
-            try:
-                provisioned = self.backend.provision(request.name, chip_count)
-            except VolumeError as exc:
-                self._abort(context, exc)
+            if num_hosts > 1:
+                # Multi-host slices allocate on-demand on each member host
+                # at NodeStage (≙ the reference's Ceph path, created at
+                # MapVolume time, controller.go:280-297); pre-provisioning
+                # on the one controller this server happens to route to
+                # would reserve chips on the wrong host.
+                provisioned = chip_count * num_hosts
+            else:
+                try:
+                    provisioned = self.backend.provision(request.name, chip_count)
+                except VolumeError as exc:
+                    self._abort(context, exc)
         response = csi_pb2.CreateVolumeResponse()
         response.volume.volume_id = request.name
         response.volume.capacity_bytes = provisioned
-        response.volume.volume_context["chipCount"] = str(provisioned)
+        # volume_context chipCount is what each host's NodeStage maps
+        # (per-host chips), not the volume total.
+        response.volume.volume_context["chipCount"] = str(
+            chip_count if num_hosts > 1 else provisioned
+        )
         for key, value in request.parameters.items():
             response.volume.volume_context.setdefault(key, value)
         if self.controller_id:
@@ -91,8 +117,12 @@ class ControllerServer:
         if not request.volume_id:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "volume_id required")
         response = csi_pb2.ValidateVolumeCapabilitiesResponse()
+        try:
+            allowed = _allowed_modes(dict(request.volume_context))
+        except VolumeError:
+            allowed = SINGLE_NODE_ACCESS_MODES
         for cap in request.volume_capabilities:
-            if cap.access_mode.mode not in SUPPORTED_ACCESS_MODES:
+            if cap.access_mode.mode not in allowed:
                 response.message = (
                     f"access mode {cap.access_mode.mode} unsupported"
                 )

@@ -38,6 +38,7 @@ class OIMDriver:
         emulate: str = "",
         mounter: Mounter | None = None,
         device_timeout: float = 60.0,
+        rendezvous_timeout: float = 60.0,
     ) -> None:
         local = bool(agent_socket)
         remote = bool(registry_address)
@@ -67,6 +68,7 @@ class OIMDriver:
                 controller_id,
                 tls_loader=tls_loader,
                 map_params=map_params,
+                rendezvous_timeout=rendezvous_timeout,
             )
 
         self.csi_endpoint = csi_endpoint

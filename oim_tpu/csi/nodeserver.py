@@ -14,6 +14,8 @@
 
 from __future__ import annotations
 
+import time
+
 import grpc
 
 from oim_tpu import log
@@ -58,14 +60,20 @@ class NodeServer:
             if self.mounter.is_staged(request.staging_target_path):
                 return csi_pb2.NodeStageVolumeResponse()  # idempotent
             try:
-                staged = self.backend.create_device(
-                    request.volume_id, dict(request.volume_context)
-                )
                 # Respect the caller's deadline like the reference's
                 # ctx-cancellation-aware device wait
-                # (oim-driver_test.go:209-226).
-                timeout = self.device_timeout
+                # (oim-driver_test.go:209-226) — for both the multi-host
+                # rendezvous inside create_device and the device wait.
                 remaining = context.time_remaining()
+                deadline = (
+                    time.monotonic() + remaining - 1.0
+                    if remaining is not None
+                    else None
+                )
+                staged = self.backend.create_device(
+                    request.volume_id, dict(request.volume_context), deadline
+                )
+                timeout = self.device_timeout
                 if remaining is not None:
                     timeout = min(timeout, max(remaining - 1.0, 0.1))
                 wait_for_devices(

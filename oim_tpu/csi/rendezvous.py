@@ -1,0 +1,259 @@
+"""Multi-host slice rendezvous over the registry KV.
+
+The reference never had N cooperating node agents per volume; a multi-host
+TPU slice does: one NodeStage per host must converge on ONE JAX distributed
+coordinator and a stable process-id assignment before any workload starts
+(SURVEY.md §7 "Multi-host coordination").  The registry KV is the natural
+rendezvous point — it is already the only cluster-wide store, it is reachable
+from every node agent, and its CommonName authorization extends naturally:
+``host.<h>`` may publish only its own ``volumes/<vid>/hosts/<h>`` key
+(≙ the reference letting ``controller.<id>`` set only ``<id>/address``,
+reference pkg/oim-registry/registry.go:100-109).
+
+Protocol (driver-side only; no controller/proto changes):
+
+1. Each host maps the volume against its *local* controller, obtaining a
+   host-reachable coordinator candidate ``host:port``
+   (``MapVolumeReply.coordinator_address``).
+2. It publishes ``volumes/<volume_id>/hosts/<host_id> = host:port`` and
+   polls ``GetValues(volumes/<volume_id>/hosts)`` until ``num_hosts``
+   distinct entries exist (deadline-bounded, like the reference's
+   ``waitForDevice`` wait, remote.go:249-290).
+3. Host ids are sorted lexicographically; a host's process_id is its sort
+   index.  Host ids are stable identities (the controller id), so the *set*
+   of ids — and therefore the process-id assignment — is race-free even when
+   values are being overwritten.
+4. The coordinator is *committed*, not inferred: the sort-first host writes
+   ``volumes/<vid>/coordinator = <its own candidate>`` only after seeing all
+   ``num_hosts`` entries; every other host accepts the commit only when it
+   equals the sort-first host's current entry.  Both keys are written by the
+   same writer in order against the linearizable KV, so a peer can never
+   observe a fresh entry with a stale commit (or vice versa) from an
+   interrupted earlier stage of the same volume.
+5. NodeUnstage withdraws the host's key (SetValue of "" deletes,
+   ≙ reference registry.go:84-98).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import grpc
+
+from oim_tpu import log
+from oim_tpu.spec import REGISTRY, oim_pb2
+
+VOLUMES_PREFIX = "volumes"
+
+
+class RendezvousError(Exception):
+    def __init__(self, code: grpc.StatusCode, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+@dataclass
+class Placement:
+    """One host's place in a converged multi-host volume."""
+
+    num_processes: int
+    process_id: int
+    coordinator_address: str
+
+
+def hosts_path(volume_id: str, host_id: str = "") -> str:
+    base = f"{VOLUMES_PREFIX}/{volume_id}/hosts"
+    return f"{base}/{host_id}" if host_id else base
+
+
+def coordinator_path(volume_id: str) -> str:
+    return f"{VOLUMES_PREFIX}/{volume_id}/coordinator"
+
+
+def _set(channel: grpc.Channel, path: str, value: str) -> None:
+    REGISTRY.stub(channel).SetValue(
+        oim_pb2.SetValueRequest(value=oim_pb2.Value(path=path, value=value)),
+        timeout=30,
+    )
+
+
+def publish(channel: grpc.Channel, volume_id: str, host_id: str, endpoint: str) -> None:
+    """Publish (or with ``endpoint=""`` withdraw) this host's coordinator
+    candidate."""
+    _set(channel, hosts_path(volume_id, host_id), endpoint)
+
+
+def snapshot(channel: grpc.Channel, volume_id: str) -> tuple[dict[str, str], str]:
+    """One consistent read of the volume's rendezvous state:
+    (``host_id -> candidate`` map, committed coordinator or "")."""
+    reply = REGISTRY.stub(channel).GetValues(
+        oim_pb2.GetValuesRequest(path=f"{VOLUMES_PREFIX}/{volume_id}"),
+        timeout=30,
+    )
+    hosts: dict[str, str] = {}
+    commit = ""
+    for value in reply.values:
+        parts = value.path.split("/")
+        if len(parts) == 4 and parts[2] == "hosts" and value.value:
+            hosts[parts[3]] = value.value
+        elif len(parts) == 3 and parts[2] == "coordinator":
+            commit = value.value
+    return hosts, commit
+
+
+# gRPC codes worth retrying inside the deadline; anything else (e.g.
+# INVALID_ARGUMENT from path sanitation, PERMISSION_DENIED from a CN
+# mismatch) is permanent and must surface immediately.
+_RETRYABLE = frozenset(
+    {
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+        grpc.StatusCode.ABORTED,
+        grpc.StatusCode.UNKNOWN,
+        grpc.StatusCode.INTERNAL,
+    }
+)
+
+
+def join(
+    channel_factory,
+    volume_id: str,
+    host_id: str,
+    endpoint: str,
+    num_hosts: int,
+    timeout: float,
+    poll: float = 0.2,
+    members: frozenset[str] | None = None,
+) -> Placement:
+    """Publish this host's candidate and wait for all ``num_hosts`` peers.
+
+    ``channel_factory`` yields a fresh registry channel per dial (per-call
+    connections survive registry restarts mid-rendezvous, ≙ reference
+    remote.go:101-114); the publish re-runs every iteration so a restarted
+    in-memory registry is repopulated, not just re-dialed.
+
+    ``members``, when given (the volume's declared ``hosts`` parameter),
+    fixes the membership: foreign or stale entries from hosts outside the
+    set are ignored rather than counted, so a replaced node or a
+    misbehaving peer cannot wedge the volume.
+    """
+    if num_hosts < 1:
+        raise RendezvousError(
+            grpc.StatusCode.INVALID_ARGUMENT, f"num_hosts={num_hosts} invalid"
+        )
+    if not host_id:
+        raise RendezvousError(
+            grpc.StatusCode.INVALID_ARGUMENT,
+            "multi-host volume requires a host_id",
+        )
+    if members is not None and host_id not in members:
+        raise RendezvousError(
+            grpc.StatusCode.FAILED_PRECONDITION,
+            f"host {host_id!r} is not in the volume's declared hosts "
+            f"{sorted(members)}",
+        )
+    deadline = time.monotonic() + timeout
+    cleared_stale = committed = False
+    coordinator = ""
+    hosts: dict[str, str] = {}
+    while True:
+        channel = channel_factory()
+        try:
+            if not cleared_stale:
+                # A crashed earlier stage can leave a self-consistent
+                # (entry, commit) pair behind.  If our allocation changed
+                # (different endpoint than our recorded entry), that commit
+                # is genuinely stale — clear it before publishing so no
+                # peer converges on the dead coordinator.  An unchanged
+                # endpoint means attach was idempotent and the old commit
+                # is still correct (single-host rejoin keeps working).
+                stale_hosts, stale_commit = snapshot(channel, volume_id)
+                own = stale_hosts.get(host_id, "")
+                if own and own != endpoint and stale_commit:
+                    _set(channel, coordinator_path(volume_id), "")
+                cleared_stale = True
+            # Idempotent overwrite, re-run every iteration.
+            publish(channel, volume_id, host_id, endpoint)
+            hosts, commit = snapshot(channel, volume_id)
+            if members is not None:
+                hosts = {h: e for h, e in hosts.items() if h in members}
+            order = sorted(hosts)
+            if len(order) > num_hosts:
+                raise RendezvousError(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"volume {volume_id!r}: {len(order)} hosts registered "
+                    f"for a {num_hosts}-host volume: {order}",
+                )
+            if len(order) == num_hosts and host_id in hosts:
+                if order[0] == host_id:
+                    # Sort-first host commits its OWN candidate — it knows
+                    # it authoritatively, so no read-of-possibly-stale-value
+                    # is involved.
+                    if not committed:
+                        _set(channel, coordinator_path(volume_id), endpoint)
+                        committed = True
+                    coordinator = endpoint
+                    break
+                if commit and commit == hosts[order[0]]:
+                    # Commit matches the leader's current entry: both were
+                    # written, in order, by the same (current) stage.
+                    coordinator = commit
+                    break
+        except grpc.RpcError as exc:
+            if exc.code() not in _RETRYABLE:
+                raise RendezvousError(
+                    exc.code(),
+                    f"volume {volume_id!r}: registry rejected rendezvous: "
+                    f"{exc.details()}",
+                ) from exc
+            # Transient registry unavailability must not abort the stage;
+            # the deadline bounds total waiting.
+            log.current().warning(
+                "rendezvous registry error",
+                volume=volume_id,
+                error=exc.code().name,
+            )
+        finally:
+            channel.close()
+        if time.monotonic() >= deadline:
+            raise RendezvousError(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"volume {volume_id!r}: {len(hosts)}/{num_hosts} hosts after "
+                f"{timeout:.0f}s: {sorted(hosts)}",
+            )
+        time.sleep(poll)
+    placement = Placement(
+        num_processes=num_hosts,
+        process_id=sorted(hosts).index(host_id),
+        coordinator_address=coordinator,
+    )
+    log.current().info(
+        "multi-host rendezvous converged",
+        volume=volume_id,
+        process=f"{placement.process_id}/{placement.num_processes}",
+        coordinator=placement.coordinator_address,
+    )
+    return placement
+
+
+def withdraw(channel_factory, volume_id: str, host_id: str) -> None:
+    """Remove this host's key on unstage; the last host out also clears the
+    committed coordinator so the volume leaves no KV rows behind.
+    Best-effort (the volume may already be gone, or the registry briefly
+    down — a later stage overwrites whatever remains)."""
+    if not host_id:
+        return
+    channel = channel_factory()
+    try:
+        publish(channel, volume_id, host_id, "")
+        remaining, commit = snapshot(channel, volume_id)
+        if not remaining and commit:
+            _set(channel, coordinator_path(volume_id), "")
+    except grpc.RpcError as exc:
+        log.current().warning(
+            "rendezvous withdraw failed", volume=volume_id, error=exc.code().name
+        )
+    finally:
+        channel.close()

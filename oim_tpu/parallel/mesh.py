@@ -64,8 +64,14 @@ def mesh_from_bootstrap(
     ``dp=0`` (default) absorbs the leftover: dp = n_chips // (pp*sp*tp*ep),
     so a workload can say "tp=4, everything else data-parallel" regardless of
     slice size.
+
+    The bootstrap's ``mesh`` is the *local* (per-host) sub-slice; on a
+    multi-host volume the global device count is local × num_processes, and
+    after ``jax.distributed.initialize`` (oim_tpu.parallel.coordinator)
+    ``jax.devices()`` already returns all of them.
     """
-    n = math.prod(bootstrap.mesh) if bootstrap.mesh else len(bootstrap.chips)
+    local = math.prod(bootstrap.mesh) if bootstrap.mesh else len(bootstrap.chips)
+    n = local * max(1, getattr(bootstrap, "num_processes", 1))
     fixed = pp * sp * tp * ep
     if dp == 0:
         if n % fixed != 0:

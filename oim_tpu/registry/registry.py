@@ -90,6 +90,29 @@ class Registry:
                 grpc.StatusCode.PERMISSION_DENIED,
                 f"{cn!r} may only set {controller_id}/address",
             )
+        if cn.startswith(HOST_CN_PREFIX):
+            # A node agent may publish only its own multi-host rendezvous
+            # key (volumes/<vid>/hosts/<host_id>) — the same least-privilege
+            # shape as controllers setting only their own address.
+            host_id = cn[len(HOST_CN_PREFIX):]
+            parts = path.split("/")
+            if (
+                len(parts) == 4
+                and parts[0] == "volumes"
+                and parts[2] == "hosts"
+                and parts[3] == host_id
+            ):
+                return
+            # Any staging host may commit the volume's coordinator (the
+            # protocol lets only the sort-first one actually do it, but the
+            # registry cannot know the sort without reading volume state).
+            if len(parts) == 3 and parts[0] == "volumes" and parts[2] == "coordinator":
+                return
+            context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                f"{cn!r} may only set volumes/*/hosts/{host_id} "
+                "or volumes/*/coordinator",
+            )
         context.abort(
             grpc.StatusCode.PERMISSION_DENIED,
             f"{cn!r} is not allowed to set registry values",

@@ -1,0 +1,309 @@
+"""Multi-host slice rendezvous: N NodeStages converge on one coordinator.
+
+The genuinely-new control-plane logic over the reference (SURVEY.md §7
+"Multi-host coordination"): each host maps the volume against its local
+controller, publishes its coordinator candidate under
+``volumes/<vid>/hosts/<host_id>`` in the registry KV, and every host
+deterministically computes the same (coordinator, process_id) assignment.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+
+import grpc
+import pytest
+
+from helpers import FakeAbort, FakeServicerContext
+
+from oim_tpu.agent import ChipStore, FakeAgentServer
+from oim_tpu.controller import Controller
+from oim_tpu.csi import rendezvous
+from oim_tpu.csi.backend import RemoteBackend, VolumeError
+from oim_tpu.registry import Registry
+from oim_tpu.spec import oim_pb2
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """Insecure in-process registry + two single-host controllers, each with
+    its own fake agent — the smallest multi-host topology."""
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    hosts = {}
+    for i, host_id in enumerate(["host-a", "host-b"]):
+        store = ChipStore(
+            mesh=(2, 1, 1), device_dir=str(tmp_path / host_id / "dev")
+        )
+        agent = FakeAgentServer(
+            store, str(tmp_path / host_id / "agent.sock")
+        ).start()
+        controller = Controller(
+            host_id,
+            agent.socket_path,
+            registry_address=str(reg_srv.addr()),
+            # Distinct per-host addresses: the coordinator candidate each
+            # host publishes must be reachable from its peers.
+            coordinator_host=f"10.0.0.{i + 1}",
+            registry_delay=0.1,
+        )
+        ctrl_srv = controller.start_server(
+            "tcp://127.0.0.1:0", require_registry_peer=False
+        )
+        controller.start(str(ctrl_srv.addr()))
+        hosts[host_id] = (store, agent, controller, ctrl_srv)
+    # Wait for both self-registrations so proxy routing works.
+    import time
+
+    deadline = time.time() + 5
+    while any(
+        registry.db.lookup(f"{h}/address") != str(hosts[h][3].addr())
+        for h in hosts
+    ):
+        assert time.time() < deadline, "controllers never registered"
+        time.sleep(0.02)
+    yield registry, reg_srv, hosts
+    for _, agent, controller, ctrl_srv in hosts.values():
+        controller.close()
+        ctrl_srv.stop()
+        agent.stop()
+    reg_srv.stop()
+
+
+def _backend(reg_srv, host_id, **kwargs) -> RemoteBackend:
+    return RemoteBackend(str(reg_srv.addr()), host_id, **kwargs)
+
+
+def test_two_hosts_converge(cluster):
+    registry, reg_srv, hosts = cluster
+    params = {"chipCount": "2", "numHosts": "2"}
+
+    def stage(host_id):
+        return _backend(reg_srv, host_id).create_device("pvc-mh", params)
+
+    # Both NodeStages run concurrently — neither can finish alone.
+    with concurrent.futures.ThreadPoolExecutor(2) as pool:
+        staged = list(pool.map(stage, ["host-a", "host-b"]))
+
+    by_host = dict(zip(["host-a", "host-b"], staged))
+    assert all(s.num_processes == 2 for s in staged)
+    # Deterministic process ids: lexicographic host order.
+    assert by_host["host-a"].process_id == 0
+    assert by_host["host-b"].process_id == 1
+    # One coordinator: the sort-first host's candidate, same on both.
+    coords = {s.coordinator_address for s in staged}
+    assert coords == {by_host["host-a"].coordinator_address}
+    assert by_host["host-a"].coordinator_address.startswith("10.0.0.1:")
+    # Each host staged its local chips only.
+    assert all(len(s.chips) == 2 for s in staged)
+
+
+def test_rendezvous_times_out_when_peer_missing(cluster):
+    registry, reg_srv, hosts = cluster
+    backend = _backend(reg_srv, "host-a", rendezvous_timeout=0.5)
+    with pytest.raises(VolumeError) as err:
+        backend.create_device("pvc-lonely", {"chipCount": "1", "numHosts": "2"})
+    assert err.value.code == grpc.StatusCode.DEADLINE_EXCEEDED
+    assert "1/2 hosts" in err.value.message
+
+
+def test_unstage_withdraws_rendezvous_key(cluster):
+    registry, reg_srv, hosts = cluster
+
+    def stage(host_id):
+        return _backend(reg_srv, host_id).create_device(
+            "pvc-wd", {"chipCount": "1", "numHosts": "2"}
+        )
+
+    with concurrent.futures.ThreadPoolExecutor(2) as pool:
+        list(pool.map(stage, ["host-a", "host-b"]))
+    assert registry.db.lookup("volumes/pvc-wd/hosts/host-a")
+    assert registry.db.lookup("volumes/pvc-wd/coordinator")
+    _backend(reg_srv, "host-a").destroy_device("pvc-wd")
+    assert not registry.db.lookup("volumes/pvc-wd/hosts/host-a")
+    assert registry.db.lookup("volumes/pvc-wd/hosts/host-b")
+    # Commit survives while a host remains; the last one out clears it.
+    assert registry.db.lookup("volumes/pvc-wd/coordinator")
+    _backend(reg_srv, "host-b").destroy_device("pvc-wd")
+    assert not registry.db.lookup("volumes/pvc-wd/coordinator")
+
+
+def test_declared_membership_ignores_foreign_entry(cluster):
+    """With a ``hosts`` list, stale/foreign registry entries cannot wedge
+    the volume (the replaced-node scenario)."""
+    registry, reg_srv, hosts = cluster
+    registry.db.store("volumes/pvc-mem/hosts/host-old", "dead:1")
+    params = {"chipCount": "1", "hosts": "host-a,host-b"}
+
+    def stage(host_id):
+        return _backend(reg_srv, host_id).create_device("pvc-mem", params)
+
+    with concurrent.futures.ThreadPoolExecutor(2) as pool:
+        staged = list(pool.map(stage, ["host-a", "host-b"]))
+    assert all(s.num_processes == 2 for s in staged)
+    assert all("dead" not in s.coordinator_address for s in staged)
+
+
+def test_nonmember_host_fails_fast(cluster):
+    registry, reg_srv, hosts = cluster
+    backend = _backend(reg_srv, "host-a", rendezvous_timeout=5)
+    with pytest.raises(VolumeError) as err:
+        backend.create_device(
+            "pvc-x", {"chipCount": "1", "hosts": "host-b,host-c"}
+        )
+    assert err.value.code == grpc.StatusCode.FAILED_PRECONDITION
+
+
+def test_num_hosts_contradicting_hosts_list(cluster):
+    registry, reg_srv, hosts = cluster
+    with pytest.raises(VolumeError) as err:
+        _backend(reg_srv, "host-a").create_device(
+            "pvc-y", {"chipCount": "1", "hosts": "host-a,host-b", "numHosts": "3"}
+        )
+    assert err.value.code == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_permanent_registry_error_surfaces_immediately():
+    """A non-retryable SetValue failure must not be retried into a
+    timeout (here: path sanitation rejecting the volume id)."""
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    try:
+        factory = lambda: grpc.insecure_channel(reg_srv.addr().grpc_target())
+        import time
+
+        t0 = time.monotonic()
+        with pytest.raises(rendezvous.RendezvousError) as err:
+            rendezvous.join(factory, "bad:vol", "h1", "a:1", 2, timeout=30)
+        assert err.value.code == grpc.StatusCode.INVALID_ARGUMENT
+        assert time.monotonic() - t0 < 5
+    finally:
+        reg_srv.stop()
+
+
+def test_restage_overwrites_stale_key(cluster):
+    """A host that crashed mid-stage simply re-publishes; the rendezvous
+    reads the latest value."""
+    registry, reg_srv, hosts = cluster
+    registry.db.store("volumes/pvc-re/hosts/host-a", "stale:1")
+
+    def stage(host_id):
+        return _backend(reg_srv, host_id).create_device(
+            "pvc-re", {"chipCount": "1", "numHosts": "2"}
+        )
+
+    with concurrent.futures.ThreadPoolExecutor(2) as pool:
+        staged = list(pool.map(stage, ["host-a", "host-b"]))
+    assert all("stale" not in s.coordinator_address for s in staged)
+
+
+def test_single_host_skips_rendezvous(cluster):
+    registry, reg_srv, hosts = cluster
+    staged = _backend(reg_srv, "host-a").create_device(
+        "pvc-one", {"chipCount": "1"}
+    )
+    assert staged.num_processes == 1
+    assert staged.process_id == 0
+    assert not registry.db.lookup("volumes/pvc-one/hosts/host-a")
+
+
+def test_host_cn_may_set_only_own_rendezvous_key():
+    """Registry authz: ``host.<h>`` writes only volumes/*/hosts/<h>
+    (the least-privilege extension of reference registry.go:100-109)."""
+    registry = Registry()
+
+    def set_value(cn, path):
+        return registry.SetValue(
+            oim_pb2.SetValueRequest(
+                value=oim_pb2.Value(path=path, value="x")
+            ),
+            FakeServicerContext(cn),
+        )
+
+    set_value("host.h1", "volumes/v/hosts/h1")  # allowed
+    set_value("host.h1", "volumes/v/coordinator")  # commit key: any host
+    set_value("user.admin", "volumes/v/hosts/h2")  # admin sets anything
+    with pytest.raises(FakeAbort) as err:
+        set_value("host.h1", "volumes/v/hosts/h2")
+    assert err.value.code == grpc.StatusCode.PERMISSION_DENIED
+    with pytest.raises(FakeAbort) as err:
+        set_value("host.h1", "h1/address")
+    assert err.value.code == grpc.StatusCode.PERMISSION_DENIED
+    with pytest.raises(FakeAbort) as err:
+        set_value("controller.h1", "volumes/v/hosts/h1")
+    assert err.value.code == grpc.StatusCode.PERMISSION_DENIED
+
+
+def test_placement_math():
+    """join() is deterministic given the same KV contents."""
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    try:
+        factory = lambda: grpc.insecure_channel(reg_srv.addr().grpc_target())
+        cases = [("h2", "b:2"), ("h1", "a:1"), ("h3", "c:3")]
+        with concurrent.futures.ThreadPoolExecutor(3) as pool:
+            results = list(
+                pool.map(
+                    lambda hc: rendezvous.join(
+                        factory, "vol", hc[0], hc[1], 3, timeout=5
+                    ),
+                    cases,
+                )
+            )
+        placements = dict(zip([h for h, _ in cases], results))
+        assert [placements[h].process_id for h in ["h1", "h2", "h3"]] == [0, 1, 2]
+        assert {p.coordinator_address for p in placements.values()} == {"a:1"}
+        # The converged coordinator is durably committed.
+        assert registry.db.lookup("volumes/vol/coordinator") == "a:1"
+    finally:
+        reg_srv.stop()
+
+
+def test_stale_commit_rejected_until_leader_confirms():
+    """A non-leader must not accept a commit that disagrees with the
+    leader's current entry (interrupted-stage leftovers)."""
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    try:
+        factory = lambda: grpc.insecure_channel(reg_srv.addr().grpc_target())
+        # Leftovers: leader re-published a fresh entry, but the old commit
+        # survived an interrupted earlier stage.
+        registry.db.store("volumes/v/hosts/h1", "fresh:1")
+        registry.db.store("volumes/v/coordinator", "stale:9")
+        with concurrent.futures.ThreadPoolExecutor(1) as pool:
+            fut = pool.submit(
+                rendezvous.join, factory, "v", "h2", "b:2", 2, timeout=5, poll=0.05
+            )
+            import time
+
+            time.sleep(0.4)
+            assert not fut.done(), "accepted a stale commit"
+            # Leader's current stage commits; h2 converges on the fresh one.
+            registry.db.store("volumes/v/coordinator", "fresh:1")
+            placement = fut.result(timeout=5)
+        assert placement.coordinator_address == "fresh:1"
+        assert placement.process_id == 1
+    finally:
+        reg_srv.stop()
+
+
+def test_mesh_from_bootstrap_multiprocess():
+    """The global mesh spans local_chips × num_processes devices."""
+    import jax
+
+    from oim_tpu.csi.backend import StagedDevice
+    from oim_tpu.parallel.coordinator import Bootstrap
+    from oim_tpu.parallel.mesh import mesh_from_bootstrap
+
+    bootstrap = Bootstrap(
+        volume_id="v",
+        chips=[{}, {}],
+        mesh=[2],
+        coordinator_address="h:1",
+        num_processes=4,
+        process_id=0,
+    )
+    mesh = mesh_from_bootstrap(bootstrap, tp=2, devices=jax.devices())
+    assert mesh.devices.size == 8
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["dp"] == 4
