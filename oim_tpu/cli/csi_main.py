@@ -35,6 +35,14 @@ def main(argv=None) -> int:
         help="publish via mount --bind (requires privilege)",
     )
     parser.add_argument("--device-timeout", type=float, default=60.0)
+    parser.add_argument(
+        "--csi-version",
+        default="both",
+        choices=["1.0", "0.3", "both"],
+        help="CSI spec generation(s) to serve (≙ reference driver0.go "
+        "legacy personality; 'both' serves csi.v1.* and csi.v0.* from "
+        "the one socket)",
+    )
     parser.add_argument("--log-level", default="info")
     args = parser.parse_args(argv)
 
@@ -56,6 +64,9 @@ def main(argv=None) -> int:
         emulate=args.emulate,
         mounter=BindMounter() if args.bind_mount else Mounter(),
         device_timeout=args.device_timeout,
+        csi_versions=(
+            ("1.0", "0.3") if args.csi_version == "both" else (args.csi_version,)
+        ),
     )
     server = driver.start_server()
     log.current().info("oim-csi-driver running", endpoint=str(server.addr()))

@@ -18,11 +18,20 @@ from oim_tpu.csi.backend import LocalBackend, RemoteBackend
 from oim_tpu.csi.controllerserver import ControllerServer
 from oim_tpu.csi.emulation import emulated_driver
 from oim_tpu.csi.identityserver import IdentityServer
+from oim_tpu.csi.legacy import ControllerServer0, IdentityServer0, NodeServer0
 from oim_tpu.csi.mounter import Mounter
 from oim_tpu.csi.nodeserver import NodeServer
-from oim_tpu.spec import CSI_CONTROLLER, CSI_IDENTITY, CSI_NODE
+from oim_tpu.spec import (
+    CSI0_CONTROLLER,
+    CSI0_IDENTITY,
+    CSI0_NODE,
+    CSI_CONTROLLER,
+    CSI_IDENTITY,
+    CSI_NODE,
+)
 
 DEFAULT_DRIVER_NAME = "tpu.oim.io"
+CSI_VERSIONS = ("1.0", "0.3")
 
 
 class OIMDriver:
@@ -39,6 +48,7 @@ class OIMDriver:
         mounter: Mounter | None = None,
         device_timeout: float = 60.0,
         rendezvous_timeout: float = 60.0,
+        csi_versions: tuple[str, ...] = CSI_VERSIONS,
     ) -> None:
         local = bool(agent_socket)
         remote = bool(registry_address)
@@ -71,6 +81,12 @@ class OIMDriver:
                 rendezvous_timeout=rendezvous_timeout,
             )
 
+        unknown = set(csi_versions) - set(CSI_VERSIONS)
+        if unknown or not csi_versions:
+            raise ValueError(
+                f"csi_versions must be a non-empty subset of {CSI_VERSIONS}"
+            )
+        self.csi_versions = tuple(csi_versions)
         self.csi_endpoint = csi_endpoint
         self.identity = IdentityServer(
             driver_name, with_topology=bool(controller_id)
@@ -90,13 +106,28 @@ class OIMDriver:
     def start_server(self) -> NonBlockingGRPCServer:
         """CSI endpoints are plain unix sockets guarded by filesystem
         permissions (kubelet convention), so no TLS here — matching the
-        reference's CSI socket."""
+        reference's CSI socket.
+
+        Both CSI generations can serve from the one socket — the service
+        names (``csi.v1.*`` vs ``csi.v0.*``) never collide, so unlike the
+        reference (which picks one personality per process,
+        oim-driver.go:39-63) old and new kubelets are handled at once.
+        """
+        registrars = []
+        if "1.0" in self.csi_versions:
+            registrars += [
+                CSI_IDENTITY.registrar(self.identity),
+                CSI_CONTROLLER.registrar(self.controller),
+                CSI_NODE.registrar(self.node),
+            ]
+        if "0.3" in self.csi_versions:
+            registrars += [
+                CSI0_IDENTITY.registrar(IdentityServer0(self.identity)),
+                CSI0_CONTROLLER.registrar(ControllerServer0(self.controller)),
+                CSI0_NODE.registrar(NodeServer0(self.node)),
+            ]
         srv = NonBlockingGRPCServer(
             self.csi_endpoint, interceptors=(LogServerInterceptor(),)
         )
-        srv.start(
-            CSI_IDENTITY.registrar(self.identity),
-            CSI_CONTROLLER.registrar(self.controller),
-            CSI_NODE.registrar(self.node),
-        )
+        srv.start(*registrars)
         return srv

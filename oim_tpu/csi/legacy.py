@@ -1,0 +1,214 @@
+"""CSI 0.3 legacy personality: v0 servicers wrapping the v1 servers.
+
+≙ reference pkg/oim-csi-driver/{driver0.go,identityserver0.go,
+controllerserver0.go,nodeserver0.go}: ``oimDriver03`` embeds ``oimDriver``
+and re-implements the service surface against the vendored CSI 0.3
+protobuf.  Same shape here: each v0 servicer holds the v1 servicer and
+translates requests/replies at the boundary — the volume logic (backends,
+mounter, rendezvous, keymutex) runs once, in the v1 code.
+
+Translation notes (proto/csi/v0/csi.proto documents the wire deltas):
+- ``VolumeCapability``/``Topology`` are wire-identical across versions, so
+  they recode via serialize→parse.
+- v0 ``Volume.id/attributes`` ↔ v1 ``volume_id/volume_context``.
+- v0 ``ValidateVolumeCapabilities`` returns a bare ``supported`` bool.
+- v0 ``NodeGetId`` has no v1 counterpart; it answers from the node server.
+"""
+
+from __future__ import annotations
+
+from oim_tpu.spec import csi0_pb2, csi_pb2
+
+
+def _recode(msg, target_cls):
+    """Re-type a wire-identical message across proto packages."""
+    return target_cls.FromString(msg.SerializeToString())
+
+
+def _recode_all(msgs, target_cls):
+    return [_recode(m, target_cls) for m in msgs]
+
+
+class IdentityServer0:
+    def __init__(self, identity) -> None:
+        self.v1 = identity
+
+    def GetPluginInfo(self, request, context) -> csi0_pb2.GetPluginInfoResponse:
+        reply = self.v1.GetPluginInfo(csi_pb2.GetPluginInfoRequest(), context)
+        out = csi0_pb2.GetPluginInfoResponse(
+            name=reply.name, vendor_version=reply.vendor_version
+        )
+        out.manifest.update(reply.manifest)
+        return out
+
+    def GetPluginCapabilities(
+        self, request, context
+    ) -> csi0_pb2.GetPluginCapabilitiesResponse:
+        reply = self.v1.GetPluginCapabilities(
+            csi_pb2.GetPluginCapabilitiesRequest(), context
+        )
+        out = csi0_pb2.GetPluginCapabilitiesResponse()
+        for cap in reply.capabilities:
+            # Service capability types share numbering (v1's
+            # VOLUME_ACCESSIBILITY_CONSTRAINTS = v0's
+            # ACCESSIBILITY_CONSTRAINTS = 2).
+            out.capabilities.add().service.type = cap.service.type
+        return out
+
+    def Probe(self, request, context) -> csi0_pb2.ProbeResponse:
+        reply = self.v1.Probe(csi_pb2.ProbeRequest(), context)
+        out = csi0_pb2.ProbeResponse()
+        out.ready.value = reply.ready.value
+        return out
+
+
+class ControllerServer0:
+    def __init__(self, controller) -> None:
+        self.v1 = controller
+
+    def CreateVolume(self, request, context) -> csi0_pb2.CreateVolumeResponse:
+        req = csi_pb2.CreateVolumeRequest(
+            name=request.name,
+            volume_capabilities=_recode_all(
+                request.volume_capabilities, csi_pb2.VolumeCapability
+            ),
+        )
+        req.capacity_range.required_bytes = request.capacity_range.required_bytes
+        req.capacity_range.limit_bytes = request.capacity_range.limit_bytes
+        req.parameters.update(request.parameters)
+        reply = self.v1.CreateVolume(req, context)
+        out = csi0_pb2.CreateVolumeResponse()
+        out.volume.capacity_bytes = reply.volume.capacity_bytes
+        out.volume.id = reply.volume.volume_id
+        out.volume.attributes.update(reply.volume.volume_context)
+        for topo in reply.volume.accessible_topology:
+            out.volume.accessible_topology.append(
+                _recode(topo, csi0_pb2.Topology)
+            )
+        return out
+
+    def DeleteVolume(self, request, context) -> csi0_pb2.DeleteVolumeResponse:
+        self.v1.DeleteVolume(
+            csi_pb2.DeleteVolumeRequest(volume_id=request.volume_id), context
+        )
+        return csi0_pb2.DeleteVolumeResponse()
+
+    def ValidateVolumeCapabilities(
+        self, request, context
+    ) -> csi0_pb2.ValidateVolumeCapabilitiesResponse:
+        req = csi_pb2.ValidateVolumeCapabilitiesRequest(
+            volume_id=request.volume_id,
+            volume_capabilities=_recode_all(
+                request.volume_capabilities, csi_pb2.VolumeCapability
+            ),
+        )
+        req.volume_context.update(request.volume_attributes)
+        reply = self.v1.ValidateVolumeCapabilities(req, context)
+        return csi0_pb2.ValidateVolumeCapabilitiesResponse(
+            supported=not reply.message, message=reply.message
+        )
+
+    def GetCapacity(self, request, context) -> csi0_pb2.GetCapacityResponse:
+        reply = self.v1.GetCapacity(csi_pb2.GetCapacityRequest(), context)
+        return csi0_pb2.GetCapacityResponse(
+            available_capacity=reply.available_capacity
+        )
+
+    def ControllerGetCapabilities(
+        self, request, context
+    ) -> csi0_pb2.ControllerGetCapabilitiesResponse:
+        reply = self.v1.ControllerGetCapabilities(
+            csi_pb2.ControllerGetCapabilitiesRequest(), context
+        )
+        out = csi0_pb2.ControllerGetCapabilitiesResponse()
+        for cap in reply.capabilities:
+            # RPC capability types share numbering across versions.
+            out.capabilities.add().rpc.type = cap.rpc.type
+        return out
+
+
+class NodeServer0:
+    def __init__(self, node) -> None:
+        self.v1 = node
+
+    def NodeStageVolume(self, request, context) -> csi0_pb2.NodeStageVolumeResponse:
+        req = csi_pb2.NodeStageVolumeRequest(
+            volume_id=request.volume_id,
+            staging_target_path=request.staging_target_path,
+        )
+        if request.HasField("volume_capability"):
+            req.volume_capability.CopyFrom(
+                _recode(request.volume_capability, csi_pb2.VolumeCapability)
+            )
+        req.publish_context.update(request.publish_info)
+        req.volume_context.update(request.volume_attributes)
+        self.v1.NodeStageVolume(req, context)
+        return csi0_pb2.NodeStageVolumeResponse()
+
+    def NodeUnstageVolume(
+        self, request, context
+    ) -> csi0_pb2.NodeUnstageVolumeResponse:
+        self.v1.NodeUnstageVolume(
+            csi_pb2.NodeUnstageVolumeRequest(
+                volume_id=request.volume_id,
+                staging_target_path=request.staging_target_path,
+            ),
+            context,
+        )
+        return csi0_pb2.NodeUnstageVolumeResponse()
+
+    def NodePublishVolume(
+        self, request, context
+    ) -> csi0_pb2.NodePublishVolumeResponse:
+        req = csi_pb2.NodePublishVolumeRequest(
+            volume_id=request.volume_id,
+            staging_target_path=request.staging_target_path,
+            target_path=request.target_path,
+            readonly=request.readonly,
+        )
+        if request.HasField("volume_capability"):
+            req.volume_capability.CopyFrom(
+                _recode(request.volume_capability, csi_pb2.VolumeCapability)
+            )
+        req.publish_context.update(request.publish_info)
+        req.volume_context.update(request.volume_attributes)
+        self.v1.NodePublishVolume(req, context)
+        return csi0_pb2.NodePublishVolumeResponse()
+
+    def NodeUnpublishVolume(
+        self, request, context
+    ) -> csi0_pb2.NodeUnpublishVolumeResponse:
+        self.v1.NodeUnpublishVolume(
+            csi_pb2.NodeUnpublishVolumeRequest(
+                volume_id=request.volume_id, target_path=request.target_path
+            ),
+            context,
+        )
+        return csi0_pb2.NodeUnpublishVolumeResponse()
+
+    def NodeGetId(self, request, context) -> csi0_pb2.NodeGetIdResponse:
+        # v0-only RPC (removed in v1 in favor of NodeGetInfo).
+        return csi0_pb2.NodeGetIdResponse(node_id=self.v1.node_id)
+
+    def NodeGetCapabilities(
+        self, request, context
+    ) -> csi0_pb2.NodeGetCapabilitiesResponse:
+        reply = self.v1.NodeGetCapabilities(
+            csi_pb2.NodeGetCapabilitiesRequest(), context
+        )
+        out = csi0_pb2.NodeGetCapabilitiesResponse()
+        for cap in reply.capabilities:
+            out.capabilities.add().rpc.type = cap.rpc.type
+        return out
+
+    def NodeGetInfo(self, request, context) -> csi0_pb2.NodeGetInfoResponse:
+        reply = self.v1.NodeGetInfo(csi_pb2.NodeGetInfoRequest(), context)
+        out = csi0_pb2.NodeGetInfoResponse(
+            node_id=reply.node_id,
+            max_volumes_per_node=reply.max_volumes_per_node,
+        )
+        if reply.HasField("accessible_topology"):
+            out.accessible_topology.CopyFrom(
+                _recode(reply.accessible_topology, csi0_pb2.Topology)
+            )
+        return out

@@ -9,6 +9,7 @@ descriptors instead of generated stubs.
 
 from oim_tpu.spec.gen.oim.v1 import oim_pb2
 from oim_tpu.spec.gen.csi.v1 import csi_pb2
+from oim_tpu.spec.gen.csi.v0 import csi_pb2 as csi0_pb2
 
 from oim_tpu.spec.rpc import (
     ServiceSpec,
@@ -17,15 +18,22 @@ from oim_tpu.spec.rpc import (
     CSI_IDENTITY,
     CSI_CONTROLLER,
     CSI_NODE,
+    CSI0_IDENTITY,
+    CSI0_CONTROLLER,
+    CSI0_NODE,
 )
 
 __all__ = [
     "oim_pb2",
     "csi_pb2",
+    "csi0_pb2",
     "ServiceSpec",
     "REGISTRY",
     "CONTROLLER",
     "CSI_IDENTITY",
     "CSI_CONTROLLER",
     "CSI_NODE",
+    "CSI0_IDENTITY",
+    "CSI0_CONTROLLER",
+    "CSI0_NODE",
 ]

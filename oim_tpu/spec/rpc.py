@@ -13,6 +13,7 @@ from typing import Callable
 
 import grpc
 
+from oim_tpu.spec.gen.csi.v0 import csi_pb2 as csi0_pb2
 from oim_tpu.spec.gen.csi.v1 import csi_pb2
 from oim_tpu.spec.gen.oim.v1 import oim_pb2
 
@@ -123,6 +124,81 @@ CSI_CONTROLLER = ServiceSpec(
         "ControllerGetCapabilities": (
             csi_pb2.ControllerGetCapabilitiesRequest,
             csi_pb2.ControllerGetCapabilitiesResponse,
+        ),
+    },
+)
+
+# -- CSI 0.3 legacy personality (≙ reference pkg/spec/csi/v0 +
+# driver0.go) --------------------------------------------------------------
+
+CSI0_IDENTITY = ServiceSpec(
+    "csi.v0.Identity",
+    {
+        "GetPluginInfo": (
+            csi0_pb2.GetPluginInfoRequest,
+            csi0_pb2.GetPluginInfoResponse,
+        ),
+        "GetPluginCapabilities": (
+            csi0_pb2.GetPluginCapabilitiesRequest,
+            csi0_pb2.GetPluginCapabilitiesResponse,
+        ),
+        "Probe": (csi0_pb2.ProbeRequest, csi0_pb2.ProbeResponse),
+    },
+)
+
+CSI0_CONTROLLER = ServiceSpec(
+    "csi.v0.Controller",
+    {
+        "CreateVolume": (
+            csi0_pb2.CreateVolumeRequest,
+            csi0_pb2.CreateVolumeResponse,
+        ),
+        "DeleteVolume": (
+            csi0_pb2.DeleteVolumeRequest,
+            csi0_pb2.DeleteVolumeResponse,
+        ),
+        "ValidateVolumeCapabilities": (
+            csi0_pb2.ValidateVolumeCapabilitiesRequest,
+            csi0_pb2.ValidateVolumeCapabilitiesResponse,
+        ),
+        "GetCapacity": (
+            csi0_pb2.GetCapacityRequest,
+            csi0_pb2.GetCapacityResponse,
+        ),
+        "ControllerGetCapabilities": (
+            csi0_pb2.ControllerGetCapabilitiesRequest,
+            csi0_pb2.ControllerGetCapabilitiesResponse,
+        ),
+    },
+)
+
+CSI0_NODE = ServiceSpec(
+    "csi.v0.Node",
+    {
+        "NodeStageVolume": (
+            csi0_pb2.NodeStageVolumeRequest,
+            csi0_pb2.NodeStageVolumeResponse,
+        ),
+        "NodeUnstageVolume": (
+            csi0_pb2.NodeUnstageVolumeRequest,
+            csi0_pb2.NodeUnstageVolumeResponse,
+        ),
+        "NodePublishVolume": (
+            csi0_pb2.NodePublishVolumeRequest,
+            csi0_pb2.NodePublishVolumeResponse,
+        ),
+        "NodeUnpublishVolume": (
+            csi0_pb2.NodeUnpublishVolumeRequest,
+            csi0_pb2.NodeUnpublishVolumeResponse,
+        ),
+        "NodeGetId": (csi0_pb2.NodeGetIdRequest, csi0_pb2.NodeGetIdResponse),
+        "NodeGetCapabilities": (
+            csi0_pb2.NodeGetCapabilitiesRequest,
+            csi0_pb2.NodeGetCapabilitiesResponse,
+        ),
+        "NodeGetInfo": (
+            csi0_pb2.NodeGetInfoRequest,
+            csi0_pb2.NodeGetInfoResponse,
         ),
     },
 )

@@ -1,0 +1,227 @@
+"""CSI 0.3 legacy personality: the full volume lifecycle over csi.v0.*.
+
+≙ the reference serving CSI 0.3 from the same codebase via the vendored v0
+protobuf (pkg/oim-csi-driver/driver0.go, nodeserver0.go,
+controllerserver0.go).  Here both generations serve from one socket, so a
+0.3 kubelet and a 1.0 kubelet can coexist.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import grpc
+import pytest
+
+from oim_tpu.agent import ChipStore, FakeAgentServer
+from oim_tpu.csi import OIMDriver
+from oim_tpu.spec import (
+    CSI0_CONTROLLER,
+    CSI0_IDENTITY,
+    CSI0_NODE,
+    CSI_IDENTITY,
+    csi0_pb2,
+    csi_pb2,
+)
+
+
+@pytest.fixture
+def stack(tmp_path):
+    store = ChipStore(mesh=(2, 2, 1), device_dir=str(tmp_path / "dev"))
+    agent = FakeAgentServer(store, str(tmp_path / "agent.sock")).start()
+    driver = OIMDriver(
+        csi_endpoint=f"unix://{tmp_path}/csi.sock",
+        node_id="node-legacy",
+        agent_socket=agent.socket_path,
+    )
+    srv = driver.start_server()
+    channel = grpc.insecure_channel(srv.addr().grpc_target())
+    yield channel, tmp_path
+    channel.close()
+    srv.stop()
+    agent.stop()
+
+
+def _cap(mode=csi0_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER):
+    cap = csi0_pb2.VolumeCapability()
+    cap.mount.SetInParent()
+    cap.access_mode.mode = mode
+    return cap
+
+
+def test_v0_identity(stack):
+    channel, _ = stack
+    identity = CSI0_IDENTITY.stub(channel)
+    info = identity.GetPluginInfo(csi0_pb2.GetPluginInfoRequest(), timeout=10)
+    assert info.name == "tpu.oim.io"
+    assert identity.Probe(csi0_pb2.ProbeRequest(), timeout=10).ready.value
+    caps = identity.GetPluginCapabilities(
+        csi0_pb2.GetPluginCapabilitiesRequest(), timeout=10
+    )
+    types = {c.service.type for c in caps.capabilities}
+    assert csi0_pb2.PluginCapability.Service.CONTROLLER_SERVICE in types
+
+
+def test_v0_volume_lifecycle(stack):
+    channel, tmp_path = stack
+    controller = CSI0_CONTROLLER.stub(channel)
+    node = CSI0_NODE.stub(channel)
+
+    vol = controller.CreateVolume(
+        csi0_pb2.CreateVolumeRequest(
+            name="pvc-legacy",
+            volume_capabilities=[_cap()],
+            parameters={"chipCount": "2"},
+        ),
+        timeout=15,
+    ).volume
+    # v0 field names: id + attributes.
+    assert vol.id == "pvc-legacy"
+    assert vol.capacity_bytes == 2
+    assert vol.attributes["chipCount"] == "2"
+
+    staging = str(tmp_path / "staging")
+    target = str(tmp_path / "pod" / "tpu")
+    node.NodeStageVolume(
+        csi0_pb2.NodeStageVolumeRequest(
+            volume_id="pvc-legacy",
+            staging_target_path=staging,
+            volume_capability=_cap(),
+            volume_attributes=dict(vol.attributes),
+        ),
+        timeout=15,
+    )
+    node.NodePublishVolume(
+        csi0_pb2.NodePublishVolumeRequest(
+            volume_id="pvc-legacy",
+            staging_target_path=staging,
+            target_path=target,
+            volume_capability=_cap(),
+        ),
+        timeout=15,
+    )
+    with open(os.path.join(target, "tpu-bootstrap.json")) as f:
+        bootstrap = json.load(f)
+    assert len(bootstrap["chips"]) == 2
+
+    node.NodeUnpublishVolume(
+        csi0_pb2.NodeUnpublishVolumeRequest(
+            volume_id="pvc-legacy", target_path=target
+        ),
+        timeout=15,
+    )
+    node.NodeUnstageVolume(
+        csi0_pb2.NodeUnstageVolumeRequest(
+            volume_id="pvc-legacy", staging_target_path=staging
+        ),
+        timeout=15,
+    )
+    controller.DeleteVolume(
+        csi0_pb2.DeleteVolumeRequest(volume_id="pvc-legacy"), timeout=15
+    )
+
+
+def test_v0_validate_and_node_identity(stack):
+    channel, _ = stack
+    controller = CSI0_CONTROLLER.stub(channel)
+    node = CSI0_NODE.stub(channel)
+
+    ok = controller.ValidateVolumeCapabilities(
+        csi0_pb2.ValidateVolumeCapabilitiesRequest(
+            volume_id="v", volume_capabilities=[_cap()]
+        ),
+        timeout=10,
+    )
+    assert ok.supported
+    bad = controller.ValidateVolumeCapabilities(
+        csi0_pb2.ValidateVolumeCapabilitiesRequest(
+            volume_id="v",
+            volume_capabilities=[
+                _cap(csi0_pb2.VolumeCapability.AccessMode.MULTI_NODE_MULTI_WRITER)
+            ],
+        ),
+        timeout=10,
+    )
+    assert not bad.supported and bad.message
+
+    # NodeGetId is v0-only (v1 removed it for NodeGetInfo).
+    assert (
+        node.NodeGetId(csi0_pb2.NodeGetIdRequest(), timeout=10).node_id
+        == "node-legacy"
+    )
+    info = node.NodeGetInfo(csi0_pb2.NodeGetInfoRequest(), timeout=10)
+    assert info.node_id == "node-legacy"
+    caps = node.NodeGetCapabilities(
+        csi0_pb2.NodeGetCapabilitiesRequest(), timeout=10
+    )
+    types = {c.rpc.type for c in caps.capabilities}
+    assert csi0_pb2.NodeServiceCapability.RPC.STAGE_UNSTAGE_VOLUME in types
+
+
+def test_v0_error_codes_propagate(stack):
+    """The legacy surface must surface the v1 logic's gRPC codes."""
+    channel, _ = stack
+    controller = CSI0_CONTROLLER.stub(channel)
+    with pytest.raises(grpc.RpcError) as err:
+        controller.CreateVolume(
+            csi0_pb2.CreateVolumeRequest(name="nocaps"), timeout=10
+        )
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_both_generations_on_one_socket(stack):
+    channel, _ = stack
+    v0 = CSI0_IDENTITY.stub(channel)
+    v1 = CSI_IDENTITY.stub(channel)
+    assert (
+        v0.GetPluginInfo(csi0_pb2.GetPluginInfoRequest(), timeout=10).name
+        == v1.GetPluginInfo(csi_pb2.GetPluginInfoRequest(), timeout=10).name
+    )
+
+
+def test_capability_wire_compat():
+    """v0 and v1 VolumeCapability are wire-identical (shared field
+    numbers), which is what the legacy recode relies on."""
+    cap = csi0_pb2.VolumeCapability()
+    cap.mount.fs_type = "x"
+    cap.mount.mount_flags.append("ro")
+    cap.access_mode.mode = csi0_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER
+    recoded = csi_pb2.VolumeCapability.FromString(cap.SerializeToString())
+    assert recoded.mount.fs_type == "x"
+    assert list(recoded.mount.mount_flags) == ["ro"]
+    assert (
+        recoded.access_mode.mode
+        == csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER
+    )
+
+
+def test_version_selection(tmp_path):
+    store = ChipStore(mesh=(1, 1, 1), device_dir=str(tmp_path / "dev"))
+    agent = FakeAgentServer(store, str(tmp_path / "agent.sock")).start()
+    driver = OIMDriver(
+        csi_endpoint=f"unix://{tmp_path}/csi.sock",
+        agent_socket=agent.socket_path,
+        csi_versions=("1.0",),
+    )
+    srv = driver.start_server()
+    channel = grpc.insecure_channel(srv.addr().grpc_target())
+    try:
+        CSI_IDENTITY.stub(channel).GetPluginInfo(
+            csi_pb2.GetPluginInfoRequest(), timeout=10
+        )
+        with pytest.raises(grpc.RpcError) as err:
+            CSI0_IDENTITY.stub(channel).GetPluginInfo(
+                csi0_pb2.GetPluginInfoRequest(), timeout=10
+            )
+        assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    finally:
+        channel.close()
+        srv.stop()
+        agent.stop()
+    with pytest.raises(ValueError):
+        OIMDriver(
+            csi_endpoint="unix:///tmp/x.sock",
+            agent_socket="/tmp/y.sock",
+            csi_versions=("2.0",),
+        )
