@@ -16,6 +16,7 @@ gen:
 	$(PROTOC) -Iproto --python_out=oim_tpu/spec/gen proto/oim/v1/oim.proto
 	$(PROTOC) -Iproto --python_out=oim_tpu/spec/gen proto/csi/v1/csi.proto
 	$(PROTOC) -Iproto --python_out=oim_tpu/spec/gen proto/csi/v0/csi.proto
+	$(PROTOC) -Iproto --python_out=oim_tpu/spec/gen proto/etcd/rpc.proto
 
 # Verify spec/proto/bindings are in sync (CI gate; also run by pytest).
 check-gen:

@@ -6,7 +6,24 @@ import argparse
 
 from oim_tpu import log
 from oim_tpu.common.tlsconfig import load_tls
-from oim_tpu.registry import MemRegistryDB, Registry, SqliteRegistryDB
+from oim_tpu.registry import (
+    EtcdKVServer,
+    EtcdRegistryDB,
+    MemRegistryDB,
+    Registry,
+    SqliteRegistryDB,
+)
+
+
+def make_db(spec: str):
+    """``--db`` forms: "" = in-memory, ``etcd://host:port`` = etcd v3 KV
+    backend (the seam the reference reserved, registry.go:31-41), anything
+    else = sqlite file path."""
+    if not spec:
+        return MemRegistryDB()
+    if spec.startswith("etcd://"):
+        return EtcdRegistryDB("tcp://" + spec[len("etcd://"):])
+    return SqliteRegistryDB(spec)
 
 
 def main(argv=None) -> int:
@@ -20,7 +37,15 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--db",
         default="",
-        help="sqlite file for durable state; empty = in-memory",
+        help="durable state: empty = in-memory, etcd://host:port = etcd "
+        "v3 cluster, else sqlite file path",
+    )
+    parser.add_argument(
+        "--etcd-listen",
+        default="",
+        help="also serve the etcd v3 KV subset on this endpoint (an "
+        "in-process etcd stand-in other registry replicas can point "
+        "their --db etcd:// at)",
     )
     parser.add_argument("--log-level", default="info")
     args = parser.parse_args(argv)
@@ -31,7 +56,17 @@ def main(argv=None) -> int:
         # Accept any CA-trusted client; per-method CN checks happen inside
         # (≙ reference cmd/oim-registry/main.go:53).
         tls = load_tls(args.ca, args.cert, args.key)
-    db = SqliteRegistryDB(args.db) if args.db else MemRegistryDB()
+    db = make_db(args.db)
+    etcd_server = None
+    if args.etcd_listen:
+        # The backing store serves the etcd wire; this registry then reads
+        # through the same etcd client as any peer replica would, so all
+        # replicas (local and remote) see one namespaced keyspace.
+        etcd_server = EtcdKVServer(db).start_server(args.etcd_listen)
+        log.current().info(
+            "etcd KV stand-in running", endpoint=str(etcd_server.addr())
+        )
+        db = EtcdRegistryDB(str(etcd_server.addr()))
     registry = Registry(db=db, tls=tls)
     server = registry.start_server(args.endpoint)
     log.current().info("oim-registry running", endpoint=str(server.addr()))
@@ -39,6 +74,8 @@ def main(argv=None) -> int:
         server.wait()
     except KeyboardInterrupt:
         server.stop()
+        if etcd_server is not None:
+            etcd_server.stop()
     return 0
 
 

@@ -1,6 +1,14 @@
 """Cluster registry: KV store + transparent gRPC proxy (≙ pkg/oim-registry)."""
 
 from oim_tpu.registry.db import MemRegistryDB, RegistryDB, SqliteRegistryDB
+from oim_tpu.registry.etcd import EtcdKVServer, EtcdRegistryDB
 from oim_tpu.registry.registry import Registry
 
-__all__ = ["Registry", "RegistryDB", "MemRegistryDB", "SqliteRegistryDB"]
+__all__ = [
+    "Registry",
+    "RegistryDB",
+    "MemRegistryDB",
+    "SqliteRegistryDB",
+    "EtcdRegistryDB",
+    "EtcdKVServer",
+]
