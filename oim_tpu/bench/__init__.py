@@ -1,0 +1,5 @@
+"""Benchmark harnesses emitting perfdash-style results (oim_tpu.perftype)."""
+
+from oim_tpu.bench.allreduce import allreduce_bench
+
+__all__ = ["allreduce_bench"]

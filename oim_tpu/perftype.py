@@ -1,0 +1,93 @@
+"""Perfdash-compatible benchmark result schema.
+
+≙ reference test/e2e/perftype/perftype.go:26-53 — the one metrics artifact
+the reference ships.  Same JSON shape (``version``/``dataItems`` with
+``data``/``unit``/``labels`` buckets) and the same result-framing tags, so
+the emitted blocks drop straight into perfdash-style tooling.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+PERF_RESULT_TAG = "[Result:Performance]"
+PERF_RESULT_END = "[Finish:Performance]"
+
+CURRENT_VERSION = "v1"
+
+
+@dataclass
+class DataItem:
+    """One data point: bucket -> value (e.g. "Perc90" -> 23.5).  Items with
+    the same label combination must share buckets and unit."""
+
+    data: dict[str, float] = field(default_factory=dict)
+    unit: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        out: dict = {"data": self.data, "unit": self.unit}
+        if self.labels:
+            out["labels"] = self.labels
+        return out
+
+
+@dataclass
+class PerfData:
+    version: str = CURRENT_VERSION
+    data_items: list[DataItem] = field(default_factory=list)
+    labels: dict[str, str] = field(default_factory=dict)
+
+    def add(self, unit: str, labels: dict[str, str], **buckets: float) -> DataItem:
+        item = DataItem(data=dict(buckets), unit=unit, labels=labels)
+        self.data_items.append(item)
+        return item
+
+    def to_json(self) -> dict:
+        out: dict = {
+            "version": self.version,
+            "dataItems": [i.to_json() for i in self.data_items],
+        }
+        if self.labels:
+            out["labels"] = self.labels
+        return out
+
+    def render(self) -> str:
+        """The framed block analysis tools scan for (≙ PerfResultTag /
+        PerfResultEnd framing in the reference)."""
+        return (
+            f"{PERF_RESULT_TAG}\n"
+            + json.dumps(self.to_json(), indent=2, sort_keys=True)
+            + f"\n{PERF_RESULT_END}"
+        )
+
+
+def parse(text: str) -> list[PerfData]:
+    """Extract every framed PerfData block from mixed output."""
+    results = []
+    rest = text
+    while True:
+        start = rest.find(PERF_RESULT_TAG)
+        if start < 0:
+            return results
+        end = rest.find(PERF_RESULT_END, start)
+        if end < 0:
+            return results
+        blob = rest[start + len(PERF_RESULT_TAG):end]
+        raw = json.loads(blob)
+        results.append(
+            PerfData(
+                version=raw.get("version", ""),
+                data_items=[
+                    DataItem(
+                        data=i.get("data", {}),
+                        unit=i.get("unit", ""),
+                        labels=i.get("labels", {}),
+                    )
+                    for i in raw.get("dataItems", [])
+                ],
+                labels=raw.get("labels", {}),
+            )
+        )
+        rest = rest[end + len(PERF_RESULT_END):]

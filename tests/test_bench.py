@@ -1,0 +1,58 @@
+"""Benchmark harness: perftype schema + all-reduce bench on the CPU mesh."""
+
+from __future__ import annotations
+
+import json
+
+from oim_tpu import perftype
+from oim_tpu.bench import allreduce_bench
+
+
+def test_perftype_roundtrip():
+    perf = perftype.PerfData(labels={"benchmark": "x"})
+    perf.add(unit="ms", labels={"sizeMB": "1"}, Perc50=1.5, Perc90=2.5)
+    rendered = perf.render()
+    assert rendered.startswith(perftype.PERF_RESULT_TAG)
+    assert rendered.endswith(perftype.PERF_RESULT_END)
+    # The JSON body matches the reference's perfdash shape
+    # (test/e2e/perftype/perftype.go:26-53): version/dataItems/data/unit.
+    body = json.loads(
+        rendered[len(perftype.PERF_RESULT_TAG):-len(perftype.PERF_RESULT_END)]
+    )
+    assert body["version"] == "v1"
+    assert body["dataItems"][0]["data"]["Perc50"] == 1.5
+    assert body["dataItems"][0]["unit"] == "ms"
+    parsed = perftype.parse("noise\n" + rendered + "\ntrailing")
+    assert len(parsed) == 1
+    assert parsed[0].data_items[0].data["Perc90"] == 2.5
+
+
+def test_allreduce_bench_cpu_mesh():
+    """8 virtual CPU devices: the collective reduces correctly (asserted
+    inside the bench) and every bucket is populated."""
+    perf = allreduce_bench(sizes_mb=(0.25, 1), dtype="float32", iters=3, warmup=1)
+    assert perf.labels["devices"] == "8"
+    assert len(perf.data_items) == 2
+    for item in perf.data_items:
+        assert item.unit == "ms"
+        assert item.data["AlgBwGBps"] > 0
+        assert item.data["BusBwGBps"] > item.data["AlgBwGBps"]  # n > 1
+        assert item.data["Perc50"] >= item.data["Perc50"] * 0  # present
+
+
+def test_allreduce_bench_line_rate_fraction():
+    perf = allreduce_bench(
+        sizes_mb=(0.25,), dtype="float32", iters=2, warmup=1, line_rate_gbps=100.0
+    )
+    item = perf.data_items[0]
+    assert item.data["BusBwFraction"] == item.data["BusBwGBps"] / 100.0
+
+
+def test_ici_bench_cli(capsys):
+    import tools.ici_bench as cli
+
+    assert cli.main(["--sizes-mb", "0.25", "--iters", "2", "--warmup", "1",
+                     "--dtype", "float32"]) == 0
+    out = capsys.readouterr().out
+    results = perftype.parse(out)
+    assert results and results[0].labels["benchmark"] == "ici-all-reduce"

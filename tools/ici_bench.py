@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""ICI all-reduce benchmark CLI (BASELINE.md metric 2).
+
+Run directly on whatever ``jax.devices()`` offers, or against a
+CSI-provisioned slice by pointing ``--bootstrap`` at the staged
+``tpu-bootstrap.json`` (config 3 in BASELINE.json: the slice the control
+plane handed out is what gets measured).  Emits a perfdash-framed PerfData
+block (≙ reference test/e2e/perftype).
+
+Examples:
+    # CPU plumbing check (8 virtual devices):
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/ici_bench.py --sizes-mb 1 4
+
+    # On a CSI-provisioned slice, inside the pod:
+    python tools/ici_bench.py --bootstrap /tpu/tpu-bootstrap.json \
+        --line-rate 90
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--sizes-mb", type=float, nargs="+", default=[1, 4, 16, 64]
+    )
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument(
+        "--line-rate",
+        type=float,
+        default=0.0,
+        help="per-direction ICI link rate in GB/s; adds the BusBwFraction "
+        "bucket for the >=90%% target",
+    )
+    parser.add_argument(
+        "--bootstrap",
+        default="",
+        help="path to a CSI-staged tpu-bootstrap.json; joins the slice's "
+        "process group before benchmarking",
+    )
+    args = parser.parse_args(argv)
+
+    if args.bootstrap:
+        from oim_tpu.parallel.coordinator import (
+            initialize_distributed,
+            load_bootstrap,
+        )
+
+        initialize_distributed(load_bootstrap(args.bootstrap))
+
+    from oim_tpu.bench import allreduce_bench
+
+    perf = allreduce_bench(
+        sizes_mb=tuple(args.sizes_mb),
+        dtype=args.dtype,
+        iters=args.iters,
+        warmup=args.warmup,
+        line_rate_gbps=args.line_rate,
+    )
+    print(perf.render())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
