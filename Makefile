@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu agent clean
+.PHONY: all gen test test-cpu agent clean start stop demo
 
 all: gen agent
 
@@ -29,5 +29,16 @@ agent:
 test:
 	$(PYTHON) -m pytest tests/ -x -q
 
+# Interactive demo cluster (≙ reference test/start-stop.make).
+start:
+	$(PYTHON) tools/demo_cluster.py start
+
+stop:
+	$(PYTHON) tools/demo_cluster.py stop
+
+demo:
+	$(PYTHON) tools/demo_cluster.py demo
+
 clean:
 	$(MAKE) -C native/tpu-agent clean || true
+	rm -rf _work

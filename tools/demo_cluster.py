@@ -1,0 +1,288 @@
+#!/usr/bin/env python3
+"""Interactive demo cluster: the whole control plane on one machine.
+
+≙ reference test/start-stop.make — ``make start`` brings up the demo stack
+(there: SPDK vhost + registry + controller + proxied driver; here:
+tpu-agent in fake-chip mode + mTLS registry + controller + CSI driver in
+remote mode), ``make stop`` tears it down, ``make demo`` runs the
+README-style volume round trip (≙ reference README.md:432-449 Malloc
+demo).
+
+State lives under ``_work/demo`` (CA tree, sockets, pidfile, logs), like
+the reference's ``_work``.
+
+Usage:  python tools/demo_cluster.py start|stop|status|demo
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORK = os.path.join(REPO, "_work", "demo")
+PIDFILE = os.path.join(WORK, "pids.json")
+CA_DIR = os.path.join(WORK, "ca")
+REGISTRY_ENDPOINT = "tcp://127.0.0.1:8970"
+CONTROLLER_ENDPOINT = "tcp://127.0.0.1:8971"
+CONTROLLER_ID = "demo-host"
+AGENT_SOCKET = os.path.join(WORK, "tpu-agent.sock")
+CSI_SOCKET = os.path.join(WORK, "csi.sock")
+NATIVE_AGENT = os.path.join(REPO, "native", "tpu-agent", "tpu-agent")
+
+ENV = dict(os.environ, PYTHONPATH=REPO)
+
+
+def _spawn(args: list[str], name: str) -> int:
+    log_path = os.path.join(WORK, f"{name}.log")
+    with open(log_path, "w") as logf:
+        proc = subprocess.Popen(
+            args, stdout=logf, stderr=subprocess.STDOUT, env=ENV,
+            start_new_session=True,
+        )
+    print(f"  {name}: pid {proc.pid} (log {os.path.relpath(log_path, REPO)})")
+    return proc.pid
+
+
+def _wait_file(path: str, timeout: float = 15.0) -> None:
+    deadline = time.time() + timeout
+    while not os.path.exists(path):
+        if time.time() > deadline:
+            raise SystemExit(f"{path} never appeared — check logs in {WORK}")
+        time.sleep(0.05)
+
+
+def _tls_args(cn: str) -> list[str]:
+    return [
+        "--ca", f"{CA_DIR}/ca.crt",
+        "--cert", f"{CA_DIR}/{cn}.crt",
+        "--key", f"{CA_DIR}/{cn}.key",
+    ]
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def _load_pids() -> dict[str, int]:
+    if not os.path.exists(PIDFILE):
+        return {}
+    with open(PIDFILE) as f:
+        return json.load(f)
+
+
+def start() -> None:
+    if any(_alive(p) for p in _load_pids().values()):
+        raise SystemExit("demo cluster already running — `stop` first")
+    os.makedirs(WORK, exist_ok=True)
+
+    from oim_tpu.common.ca import CertAuthority
+
+    if not os.path.exists(f"{CA_DIR}/ca.crt"):
+        CertAuthority().write_tree(
+            CA_DIR,
+            [
+                "component.registry",
+                f"controller.{CONTROLLER_ID}",
+                f"host.{CONTROLLER_ID}",
+                "user.admin",
+            ],
+        )
+        print(f"  CA tree: {os.path.relpath(CA_DIR, REPO)}")
+
+    pids = {}
+    if os.path.exists(NATIVE_AGENT):
+        pids["tpu-agent"] = _spawn(
+            [NATIVE_AGENT, "--socket", AGENT_SOCKET,
+             "--fake-chips", "8", "--mesh", "2x2x2",
+             "--state-dir", os.path.join(WORK, "dev")],
+            "tpu-agent",
+        )
+    else:
+        print("  (native agent not built; using the Python fake)")
+        pids["tpu-agent"] = _spawn(
+            [sys.executable, "-m", "oim_tpu.cli.agent_main",
+             "--socket", AGENT_SOCKET, "--fake-chips", "8", "--mesh", "2x2x2",
+             "--state-dir", os.path.join(WORK, "dev")],
+            "tpu-agent",
+        )
+    _wait_file(AGENT_SOCKET)
+
+    pids["oim-registry"] = _spawn(
+        [sys.executable, "-m", "oim_tpu.cli.registry_main",
+         "--endpoint", REGISTRY_ENDPOINT,
+         "--db", os.path.join(WORK, "registry.db"),
+         *_tls_args("component.registry")],
+        "oim-registry",
+    )
+    pids["oim-controller"] = _spawn(
+        [sys.executable, "-m", "oim_tpu.cli.controller_main",
+         "--id", CONTROLLER_ID,
+         "--endpoint", CONTROLLER_ENDPOINT,
+         "--agent-socket", AGENT_SOCKET,
+         "--registry", REGISTRY_ENDPOINT,
+         "--registry-delay", "10",
+         *_tls_args(f"controller.{CONTROLLER_ID}")],
+        "oim-controller",
+    )
+    pids["oim-csi-driver"] = _spawn(
+        [sys.executable, "-m", "oim_tpu.cli.csi_main",
+         "--endpoint", f"unix://{CSI_SOCKET}",
+         "--node-id", "demo-node",
+         "--registry", REGISTRY_ENDPOINT,
+         "--controller-id", CONTROLLER_ID,
+         *_tls_args(f"host.{CONTROLLER_ID}")],
+        "oim-csi-driver",
+    )
+    _wait_file(CSI_SOCKET)
+    with open(PIDFILE, "w") as f:
+        json.dump(pids, f)
+    print(f"""
+demo cluster up.  Try:
+  python -m oim_tpu.cli.oimctl --registry {REGISTRY_ENDPOINT} \\
+      --ca {CA_DIR}/ca.crt --cert {CA_DIR}/user.admin.crt \\
+      --key {CA_DIR}/user.admin.key -get ""
+  python tools/demo_cluster.py demo     # full volume round trip
+  python tools/demo_cluster.py stop
+""")
+
+
+def stop() -> None:
+    pids = _load_pids()
+    if not pids:
+        print("nothing to stop")
+        return
+    for name, pid in pids.items():
+        if _alive(pid):
+            print(f"  stopping {name} (pid {pid})")
+            try:
+                os.killpg(pid, signal.SIGTERM)
+            except OSError:
+                os.kill(pid, signal.SIGTERM)
+    deadline = time.time() + 5
+    while time.time() < deadline and any(_alive(p) for p in pids.values()):
+        time.sleep(0.1)
+    for name, pid in pids.items():
+        if _alive(pid):
+            print(f"  killing {name}")
+            try:
+                os.killpg(pid, signal.SIGKILL)
+            except OSError:
+                os.kill(pid, signal.SIGKILL)
+    os.unlink(PIDFILE)
+    for sock in (AGENT_SOCKET, CSI_SOCKET):
+        if os.path.exists(sock):
+            os.unlink(sock)
+    print("stopped")
+
+
+def status() -> int:
+    pids = _load_pids()
+    if not pids:
+        print("demo cluster: not running")
+        return 1
+    down = 0
+    for name, pid in pids.items():
+        state = "up" if _alive(pid) else "DOWN"
+        down += state == "DOWN"
+        print(f"  {name:16s} pid {pid:<8d} {state}")
+    return 1 if down else 0
+
+
+def demo() -> None:
+    """CreateVolume → NodeStage → NodePublish → inspect → teardown, over
+    the real sockets (≙ reference README.md:432-449)."""
+    if status() != 0:
+        raise SystemExit("cluster not healthy — `start` first")
+    import grpc
+
+    from oim_tpu.spec import CSI_CONTROLLER, CSI_NODE, csi_pb2
+
+    channel = grpc.insecure_channel(f"unix://{CSI_SOCKET}")
+    cap = csi_pb2.VolumeCapability()
+    cap.mount.SetInParent()
+    cap.access_mode.mode = csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER
+
+    print("CreateVolume pvc-demo (4 chips)...")
+    vol = CSI_CONTROLLER.stub(channel).CreateVolume(
+        csi_pb2.CreateVolumeRequest(
+            name="pvc-demo",
+            volume_capabilities=[cap],
+            parameters={"chipCount": "4"},
+        ),
+        timeout=30,
+    ).volume
+    staging = os.path.join(WORK, "staging")
+    target = os.path.join(WORK, "pod", "tpu")
+    node = CSI_NODE.stub(channel)
+    print("NodeStageVolume...")
+    node.NodeStageVolume(
+        csi_pb2.NodeStageVolumeRequest(
+            volume_id="pvc-demo",
+            staging_target_path=staging,
+            volume_capability=cap,
+            volume_context=dict(vol.volume_context),
+        ),
+        timeout=30,
+    )
+    print("NodePublishVolume...")
+    node.NodePublishVolume(
+        csi_pb2.NodePublishVolumeRequest(
+            volume_id="pvc-demo",
+            staging_target_path=staging,
+            target_path=target,
+            volume_capability=cap,
+        ),
+        timeout=30,
+    )
+    with open(os.path.join(target, "tpu-bootstrap.json")) as f:
+        bootstrap = json.load(f)
+    print("staged bootstrap:")
+    print(json.dumps(bootstrap, indent=2))
+    print("teardown...")
+    node.NodeUnpublishVolume(
+        csi_pb2.NodeUnpublishVolumeRequest(
+            volume_id="pvc-demo", target_path=target
+        ),
+        timeout=30,
+    )
+    node.NodeUnstageVolume(
+        csi_pb2.NodeUnstageVolumeRequest(
+            volume_id="pvc-demo", staging_target_path=staging
+        ),
+        timeout=30,
+    )
+    CSI_CONTROLLER.stub(channel).DeleteVolume(
+        csi_pb2.DeleteVolumeRequest(volume_id="pvc-demo"), timeout=30
+    )
+    print("demo round trip OK")
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1 or argv[0] not in ("start", "stop", "status", "demo"):
+        print(__doc__)
+        return 2
+    if argv[0] == "start":
+        start()
+    elif argv[0] == "stop":
+        stop()
+    elif argv[0] == "status":
+        return status()
+    else:
+        demo()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
