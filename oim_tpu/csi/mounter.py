@@ -20,6 +20,7 @@ import subprocess
 from typing import Callable
 
 from oim_tpu import log
+from oim_tpu.csi import procmounts
 
 BOOTSTRAP_FILE = "tpu-bootstrap.json"
 
@@ -111,10 +112,13 @@ class BindMounter(Mounter):
                 raise RuntimeError(f"ro remount failed: {result.stderr}")
 
     def unpublish(self, target_dir: str) -> None:
-        if os.path.ismount(target_dir):
+        if self.is_published(target_dir):
             result = self.exec_fn(["umount", target_dir])
             if result.returncode != 0:
                 raise RuntimeError(f"umount failed: {result.stderr}")
 
     def is_published(self, target_dir: str) -> bool:
-        return os.path.ismount(target_dir)
+        # The mount table, not os.path.ismount: a bind mount within one
+        # filesystem (this driver's publish pattern) has the same st_dev
+        # as its parent and the heuristic misses it (procmounts.py).
+        return procmounts.is_mount_point(target_dir)

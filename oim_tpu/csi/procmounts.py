@@ -1,0 +1,103 @@
+"""/proc/mounts parsing and mount-point detection.
+
+≙ the mount-table half of the reference's vendored k8s mount utils
+(reference pkg/mount/mount_linux.go: ``parseProcMounts``,
+``IsLikelyNotMountPoint``, ``GetMountRefs``).  The TPU driver has no
+filesystems to format, but the privileged BindMounter still needs a
+truthful "is this target mounted" answer: ``os.path.ismount`` (like the
+reference's ``IsLikelyNotMountPoint``, which it documents as a heuristic)
+compares device numbers with the parent and therefore misses bind mounts
+within one filesystem — exactly the publish pattern this driver uses.  The
+mount table is the authority.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+PROC_MOUNTS = "/proc/mounts"
+
+# /proc/mounts octal-escapes whitespace and backslashes in paths
+# (\040 space, \011 tab, \012 newline, \134 backslash).
+_ESCAPES = {"040": " ", "011": "\t", "012": "\n", "134": "\\"}
+
+
+def _unescape(field_text: str) -> str:
+    out = []
+    i = 0
+    while i < len(field_text):
+        ch = field_text[i]
+        if ch == "\\" and field_text[i + 1 : i + 4] in _ESCAPES:
+            out.append(_ESCAPES[field_text[i + 1 : i + 4]])
+            i += 4
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+@dataclass
+class MountPoint:
+    device: str
+    path: str
+    fstype: str
+    opts: list[str] = field(default_factory=list)
+    freq: int = 0
+    passno: int = 0
+
+
+def parse_mounts(content: str) -> list[MountPoint]:
+    """Parse /proc/mounts content (6 whitespace-separated fields per line,
+    octal-escaped; ≙ parseProcMounts, reference mount_linux.go)."""
+    mounts = []
+    for line in content.splitlines():
+        parts = line.split()
+        if len(parts) != 6:
+            continue  # kernel guarantees 6; skip anything malformed
+        mounts.append(
+            MountPoint(
+                device=_unescape(parts[0]),
+                path=_unescape(parts[1]),
+                fstype=parts[2],
+                opts=parts[3].split(","),
+                freq=int(parts[4]),
+                passno=int(parts[5]),
+            )
+        )
+    return mounts
+
+
+def list_mounts(proc_mounts: str = PROC_MOUNTS) -> list[MountPoint]:
+    try:
+        with open(proc_mounts) as f:
+            return parse_mounts(f.read())
+    except OSError:
+        return []
+
+
+def is_mount_point(path: str, proc_mounts: str = PROC_MOUNTS) -> bool:
+    """Authoritative check against the mount table — catches the
+    same-filesystem bind mounts ``os.path.ismount`` cannot."""
+    real = os.path.realpath(path)
+    return any(m.path == real or m.path == path for m in list_mounts(proc_mounts))
+
+
+def is_likely_not_mount_point(path: str) -> bool:
+    """The fast heuristic (≙ IsLikelyNotMountPoint, reference
+    mount_linux.go): st_dev comparison with the parent.  False negatives on
+    bind mounts; use ``is_mount_point`` when the answer matters."""
+    return not os.path.ismount(path)
+
+
+def mount_refs(path: str, proc_mounts: str = PROC_MOUNTS) -> list[str]:
+    """Other mount points backed by the same device (≙ GetMountRefs) —
+    what an unmounter consults before releasing the underlying resource."""
+    real = os.path.realpath(path)
+    mounts = list_mounts(proc_mounts)
+    device = next(
+        (m.device for m in mounts if m.path in (real, path)), None
+    )
+    if device is None:
+        return []
+    return [m.path for m in mounts if m.device == device and m.path not in (real, path)]

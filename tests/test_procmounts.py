@@ -1,0 +1,61 @@
+"""/proc/mounts parsing (≙ reference pkg/mount mount table handling)."""
+
+from __future__ import annotations
+
+from oim_tpu.csi import procmounts
+
+SAMPLE = """\
+sysfs /sys sysfs rw,nosuid,nodev,noexec,relatime 0 0
+/dev/sda1 / ext4 rw,relatime,errors=remount-ro 0 1
+tmpfs /tmp tmpfs rw,nosuid,nodev 0 0
+/dev/sda1 /var/lib/kubelet/pods/x/volumes/tpu ext4 rw,relatime 0 0
+/dev/sdb1 /mnt/with\\040space ext4 rw 0 0
+/dev/sdc1 /mnt/back\\134slash ext4 rw 0 0
+malformed line without six fields
+"""
+
+
+def test_parse_fields():
+    mounts = procmounts.parse_mounts(SAMPLE)
+    assert len(mounts) == 6  # malformed line skipped
+    root = mounts[1]
+    assert root.device == "/dev/sda1"
+    assert root.path == "/"
+    assert root.fstype == "ext4"
+    assert "relatime" in root.opts
+    assert root.passno == 1
+
+
+def test_octal_escapes():
+    mounts = procmounts.parse_mounts(SAMPLE)
+    paths = [m.path for m in mounts]
+    assert "/mnt/with space" in paths
+    assert "/mnt/back\\slash" in paths
+
+
+def test_is_mount_point_from_table(tmp_path):
+    table = tmp_path / "mounts"
+    table.write_text(SAMPLE)
+    assert procmounts.is_mount_point(
+        "/var/lib/kubelet/pods/x/volumes/tpu", proc_mounts=str(table)
+    )
+    assert not procmounts.is_mount_point("/var/lib", proc_mounts=str(table))
+
+
+def test_bind_mount_same_device_detected(tmp_path):
+    """The case os.path.ismount misses: a bind mount shares st_dev with
+    its parent, but the mount table still lists it."""
+    table = tmp_path / "mounts"
+    table.write_text(
+        "/dev/sda1 / ext4 rw 0 0\n"
+        "/dev/sda1 /staging ext4 rw 0 0\n"
+        "/dev/sda1 /pod/target ext4 rw 0 0\n"
+    )
+    assert procmounts.is_mount_point("/pod/target", proc_mounts=str(table))
+    refs = procmounts.mount_refs("/pod/target", proc_mounts=str(table))
+    assert "/staging" in refs and "/" in refs
+
+
+def test_missing_proc_mounts():
+    assert procmounts.list_mounts("/nonexistent/mounts") == []
+    assert not procmounts.is_mount_point("/x", proc_mounts="/nonexistent/mounts")
