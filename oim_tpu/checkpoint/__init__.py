@@ -1,0 +1,15 @@
+"""Checkpoint / resume for training state.
+
+The reference keeps no durable state of its own — its registry DB is
+reconstructible from controller heartbeats and device state lives in SPDK
+(/root/reference/README.md:131-135, SURVEY.md §5).  The TPU build's
+workloads *do* carry durable state: model parameters, optimizer moments and
+the data-pipeline cursor.  This package is the durable-store seam for that
+state, playing the role the planned etcd backend played for the registry —
+except here the store is orbax over a filesystem, sharding-aware and
+async so saves overlap the next train step.
+"""
+
+from oim_tpu.checkpoint.manager import Checkpointer, CheckpointerOptions
+
+__all__ = ["Checkpointer", "CheckpointerOptions"]

@@ -284,7 +284,9 @@ class Controller:
         if not name:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "name required")
         alloc = self._call_agent(context, lambda a: a.find_allocation(name))
-        if alloc is None or not alloc["provisioned"]:
+        if alloc is None or not (
+            alloc["provisioned"] or request.include_unprovisioned
+        ):
             context.abort(
                 grpc.StatusCode.NOT_FOUND, f"no provisioned allocation {name!r}"
             )

@@ -218,6 +218,12 @@ class LocalBackend:
         with self._agent() as agent:
             return agent.get_topology()["free_chips"]
 
+    def volume_exists(self, volume_id: str) -> bool:
+        """Any allocation counts — a statically provisioned volume staged
+        on demand (provisioned=False) still exists for CSI purposes."""
+        with self._agent() as agent:
+            return agent.find_allocation(volume_id) is not None
+
     def create_device(
         self, volume_id: str, params: dict, deadline: float | None = None
     ) -> StagedDevice:
@@ -347,6 +353,24 @@ class RemoteBackend:
             grpc.StatusCode.UNIMPLEMENTED,
             "capacity reporting requires local mode",
         )
+
+    def volume_exists(self, volume_id: str) -> bool:
+        def run(channel):
+            try:
+                CONTROLLER.stub(channel).CheckSlice(
+                    oim_pb2.CheckSliceRequest(
+                        name=volume_id, include_unprovisioned=True
+                    ),
+                    metadata=self._metadata(),
+                    timeout=30,
+                )
+                return True
+            except grpc.RpcError as exc:
+                if exc.code() == grpc.StatusCode.NOT_FOUND:
+                    return False
+                raise
+
+        return self._call(run)
 
     def default_pci(self, channel) -> str:
         """Registry-stored PCI default for this controller

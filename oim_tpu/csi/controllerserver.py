@@ -116,11 +116,34 @@ class ControllerServer:
     ) -> csi_pb2.ValidateVolumeCapabilitiesResponse:
         if not request.volume_id:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "volume_id required")
-        response = csi_pb2.ValidateVolumeCapabilitiesResponse()
+        if not request.volume_capabilities:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "volume_capabilities required"
+            )
         try:
-            allowed = _allowed_modes(dict(request.volume_context))
+            num_hosts, _ = _parse_membership(dict(request.volume_context))
         except VolumeError:
-            allowed = SINGLE_NODE_ACCESS_MODES
+            # Malformed membership context: treat as the single-host default
+            # for both the existence check and the allowed-modes check below.
+            num_hosts = 1
+        if num_hosts <= 1:
+            # Multi-host volumes allocate per-host at NodeStage (see
+            # CreateVolume) — this controller has no backend state to
+            # consult, so the CSI NOT_FOUND check applies only to
+            # single-host volumes.
+            try:
+                exists = self.backend.volume_exists(request.volume_id)
+            except VolumeError as exc:
+                self._abort(context, exc)
+            if not exists:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    f"volume {request.volume_id!r} does not exist",
+                )
+        response = csi_pb2.ValidateVolumeCapabilitiesResponse()
+        allowed = (
+            MULTI_NODE_ACCESS_MODES if num_hosts > 1 else SINGLE_NODE_ACCESS_MODES
+        )
         for cap in request.volume_capabilities:
             if cap.access_mode.mode not in allowed:
                 response.message = (

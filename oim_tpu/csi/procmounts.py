@@ -90,14 +90,78 @@ def is_likely_not_mount_point(path: str) -> bool:
     return not os.path.ismount(path)
 
 
-def mount_refs(path: str, proc_mounts: str = PROC_MOUNTS) -> list[str]:
-    """Other mount points backed by the same device (≙ GetMountRefs) —
-    what an unmounter consults before releasing the underlying resource."""
-    real = os.path.realpath(path)
-    mounts = list_mounts(proc_mounts)
-    device = next(
-        (m.device for m in mounts if m.path in (real, path)), None
-    )
-    if device is None:
+MOUNTINFO = "/proc/self/mountinfo"
+
+
+@dataclass
+class MountInfoEntry:
+    mount_id: int
+    parent_id: int
+    major_minor: str
+    root: str
+    path: str
+    opts: list[str]
+    fstype: str
+    source: str
+
+
+def parse_mountinfo(content: str) -> list[MountInfoEntry]:
+    """Parse /proc/self/mountinfo.  Unlike /proc/mounts, each entry carries
+    the *root* of the mount within its filesystem — the field that lets a
+    bind mount be distinguished from other mounts of the same device
+    (≙ the reference's k8s mount utils, which use mountinfo for exactly
+    this; see GetMountRefs / SearchMountPoints)."""
+    entries = []
+    for line in content.splitlines():
+        parts = line.split()
+        try:
+            sep = parts.index("-")
+        except ValueError:
+            continue
+        if sep < 6 or len(parts) < sep + 3:
+            continue
+        entries.append(
+            MountInfoEntry(
+                mount_id=int(parts[0]),
+                parent_id=int(parts[1]),
+                major_minor=parts[2],
+                root=_unescape(parts[3]),
+                path=_unescape(parts[4]),
+                opts=parts[5].split(","),
+                fstype=parts[sep + 1],
+                source=_unescape(parts[sep + 2]),
+            )
+        )
+    return entries
+
+
+def list_mountinfo(mountinfo: str = MOUNTINFO) -> list[MountInfoEntry]:
+    try:
+        with open(mountinfo) as f:
+            return parse_mountinfo(f.read())
+    except OSError:
         return []
-    return [m.path for m in mounts if m.device == device and m.path not in (real, path)]
+
+
+def mount_refs(path: str, mountinfo: str = MOUNTINFO) -> list[str]:
+    """Other mount points of the *same filesystem subtree* (≙ GetMountRefs)
+    — what an unmounter consults before releasing the underlying resource.
+    Matching is by (device, root): a bind mount shares both with its source,
+    while unrelated mounts of the same device (e.g. ``/`` when the staging
+    dir lives on the root filesystem) differ in root and are not refs."""
+    real = os.path.realpath(path)
+    entries = list_mountinfo(mountinfo)
+    # Overmounts: the kernel lists mounts in order, the *last* entry at a
+    # path is the visible one — match against that, not a shadowed mount.
+    target = next(
+        (e for e in reversed(entries) if e.path in (real, path)), None
+    )
+    if target is None:
+        return []
+    return [
+        e.path
+        for e in entries
+        if e.major_minor == target.major_minor
+        and e.root == target.root
+        and e.path not in (real, path)
+    ]

@@ -171,32 +171,39 @@ def make_train_step(
     return jax.jit(train_step, donate_argnums=(0,))
 
 
-def shard_state(state: TrainState, cfg: TransformerConfig, mesh) -> TrainState:
-    """Place params — and the optimizer state mirroring them — onto the mesh
-    by logical axes.  Optax states are nested namedtuples whose moment
-    pytrees share the params' dict structure, so the same specs apply."""
+def state_shardings(state, cfg: TransformerConfig, mesh) -> TrainState:
+    """A TrainState-shaped pytree of NamedShardings: params by their logical
+    axes, optimizer moments mirroring the params (optax states are nested
+    namedtuples whose moment pytrees share the params' dict structure, so the
+    same specs apply), everything else replicated.  ``state`` may be concrete
+    or a ``jax.eval_shape`` pytree of ShapeDtypeStructs — only the tree
+    structure is inspected."""
     pspecs = param_pspecs(cfg)
     param_names = set(state.params.keys())
+    replicated = NamedSharding(mesh, P())
 
-    def place_params(tree: dict) -> dict:
-        return {
-            name: jax.device_put(value, NamedSharding(mesh, pspecs[name]))
-            for name, value in tree.items()
-        }
+    def spec_params(tree: dict) -> dict:
+        return {name: NamedSharding(mesh, pspecs[name]) for name in tree}
 
     def mirror(node):
         if isinstance(node, dict) and set(node.keys()) == param_names:
-            return place_params(node)
+            return spec_params(node)
         if hasattr(node, "_fields"):  # optax namedtuple states
             return type(node)(*(mirror(getattr(node, f)) for f in node._fields))
         if isinstance(node, (list, tuple)):
             return type(node)(mirror(x) for x in node)
         if hasattr(node, "shape"):
-            return jax.device_put(node, NamedSharding(mesh, P()))
+            return replicated
         return node
 
     return TrainState(
-        params=place_params(state.params),
+        params=spec_params(state.params),
         opt_state=mirror(state.opt_state),
-        step=jax.device_put(state.step, NamedSharding(mesh, P())),
+        step=replicated,
     )
+
+
+def shard_state(state: TrainState, cfg: TransformerConfig, mesh) -> TrainState:
+    """Place params — and the optimizer state mirroring them — onto the mesh
+    by logical axes (see ``state_shardings``)."""
+    return jax.device_put(state, state_shardings(state, cfg, mesh))

@@ -172,10 +172,24 @@ def test_remap_topology_mismatch(ctrl):
 
 
 def test_check_slice_ignores_on_demand(ctrl):
-    """CheckSlice only reports pre-provisioned allocations (Malloc analog)."""
+    """CheckSlice only reports pre-provisioned allocations (Malloc analog)
+    by default; include_unprovisioned widens it to any allocation (what
+    CSI ValidateVolumeCapabilities needs for statically provisioned
+    volumes staged on demand)."""
     _map_slice(ctrl, "vol-od", 1)
     with pytest.raises(grpc.RpcError) as err:
         ctrl.CheckSlice(oim_pb2.CheckSliceRequest(name="vol-od"), timeout=10)
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
+    reply = ctrl.CheckSlice(
+        oim_pb2.CheckSliceRequest(name="vol-od", include_unprovisioned=True),
+        timeout=10,
+    )
+    assert reply.chip_count == 1
+    with pytest.raises(grpc.RpcError) as err:
+        ctrl.CheckSlice(
+            oim_pb2.CheckSliceRequest(name="ghost", include_unprovisioned=True),
+            timeout=10,
+        )
     assert err.value.code() == grpc.StatusCode.NOT_FOUND
 
 

@@ -127,23 +127,44 @@ def test_v0_validate_and_node_identity(stack):
     controller = CSI0_CONTROLLER.stub(channel)
     node = CSI0_NODE.stub(channel)
 
-    ok = controller.ValidateVolumeCapabilities(
-        csi0_pb2.ValidateVolumeCapabilitiesRequest(
-            volume_id="v", volume_capabilities=[_cap()]
+    # v0 inherits the v1 NOT_FOUND conformance for nonexistent volumes.
+    with pytest.raises(grpc.RpcError) as exc_info:
+        controller.ValidateVolumeCapabilities(
+            csi0_pb2.ValidateVolumeCapabilitiesRequest(
+                volume_id="never-created", volume_capabilities=[_cap()]
+            ),
+            timeout=10,
+        )
+    assert exc_info.value.code() == grpc.StatusCode.NOT_FOUND
+
+    vol = controller.CreateVolume(
+        csi0_pb2.CreateVolumeRequest(
+            name="legacy-validate", volume_capabilities=[_cap()]
         ),
         timeout=10,
-    )
-    assert ok.supported
-    bad = controller.ValidateVolumeCapabilities(
-        csi0_pb2.ValidateVolumeCapabilitiesRequest(
-            volume_id="v",
-            volume_capabilities=[
-                _cap(csi0_pb2.VolumeCapability.AccessMode.MULTI_NODE_MULTI_WRITER)
-            ],
-        ),
-        timeout=10,
-    )
-    assert not bad.supported and bad.message
+    ).volume
+    try:
+        ok = controller.ValidateVolumeCapabilities(
+            csi0_pb2.ValidateVolumeCapabilitiesRequest(
+                volume_id=vol.id, volume_capabilities=[_cap()]
+            ),
+            timeout=10,
+        )
+        assert ok.supported
+        bad = controller.ValidateVolumeCapabilities(
+            csi0_pb2.ValidateVolumeCapabilitiesRequest(
+                volume_id=vol.id,
+                volume_capabilities=[
+                    _cap(csi0_pb2.VolumeCapability.AccessMode.MULTI_NODE_MULTI_WRITER)
+                ],
+            ),
+            timeout=10,
+        )
+        assert not bad.supported and bad.message
+    finally:
+        controller.DeleteVolume(
+            csi0_pb2.DeleteVolumeRequest(volume_id=vol.id), timeout=10
+        )
 
     # NodeGetId is v0-only (v1 removed it for NodeGetInfo).
     assert (

@@ -175,13 +175,55 @@ def test_sanity_validate_capabilities(endpoint):
         ))
         == grpc.StatusCode.INVALID_ARGUMENT
     )  # no volume_id
-    ok = controller.ValidateVolumeCapabilities(
+    assert (
+        _code(lambda: controller.ValidateVolumeCapabilities(
+            csi_pb2.ValidateVolumeCapabilitiesRequest(volume_id="v"),
+            timeout=10,
+        ))
+        == grpc.StatusCode.INVALID_ARGUMENT
+    )  # volume_capabilities is a REQUIRED field
+    assert (
+        _code(lambda: controller.ValidateVolumeCapabilities(
+            csi_pb2.ValidateVolumeCapabilitiesRequest(
+                volume_id="never-created", volume_capabilities=[_cap()]
+            ),
+            timeout=10,
+        ))
+        == grpc.StatusCode.NOT_FOUND
+    )  # CSI spec: nonexistent volume → NOT_FOUND
+    # Multi-host volumes have no controller-local backend state until
+    # NodeStage — the existence check must not reject them.
+    mh_cap = _cap()
+    mh_cap.access_mode.mode = (
+        csi_pb2.VolumeCapability.AccessMode.MULTI_NODE_MULTI_WRITER
+    )
+    multi = controller.ValidateVolumeCapabilities(
         csi_pb2.ValidateVolumeCapabilitiesRequest(
-            volume_id="v", volume_capabilities=[_cap()]
+            volume_id="mh-vol",
+            volume_capabilities=[mh_cap],
+            volume_context={"hosts": "host-a,host-b"},
         ),
         timeout=10,
     )
-    assert ok.confirmed.volume_capabilities
+    assert multi.confirmed.volume_capabilities
+    vol = controller.CreateVolume(
+        csi_pb2.CreateVolumeRequest(
+            name="sanity-validate", volume_capabilities=[_cap()]
+        ),
+        timeout=10,
+    ).volume
+    try:
+        ok = controller.ValidateVolumeCapabilities(
+            csi_pb2.ValidateVolumeCapabilitiesRequest(
+                volume_id=vol.volume_id, volume_capabilities=[_cap()]
+            ),
+            timeout=10,
+        )
+        assert ok.confirmed.volume_capabilities
+    finally:
+        controller.DeleteVolume(
+            csi_pb2.DeleteVolumeRequest(volume_id=vol.volume_id), timeout=10
+        )
 
 
 def test_sanity_controller_capabilities_coherent(endpoint):

@@ -52,10 +52,43 @@ def test_bind_mount_same_device_detected(tmp_path):
         "/dev/sda1 /pod/target ext4 rw 0 0\n"
     )
     assert procmounts.is_mount_point("/pod/target", proc_mounts=str(table))
-    refs = procmounts.mount_refs("/pod/target", proc_mounts=str(table))
-    assert "/staging" in refs and "/" in refs
+
+
+# mountinfo: id parent maj:min root mountpoint opts [optional] - fstype src sopts
+MOUNTINFO_SAMPLE = """\
+20 1 8:1 / / rw,relatime shared:1 - ext4 /dev/sda1 rw
+31 20 8:1 /var/lib/kubelet/staging/vol-1 /pod/target rw,relatime shared:1 - ext4 /dev/sda1 rw
+32 20 8:1 /var/lib/kubelet/staging/vol-1 /pod2/target rw,relatime shared:1 - ext4 /dev/sda1 rw
+33 20 8:1 /home /home rw - ext4 /dev/sda1 rw
+40 20 0:45 / /mnt/with\\040space tmpfs rw - tmpfs tmpfs rw
+malformed line
+"""
+
+
+def test_parse_mountinfo_fields():
+    entries = procmounts.parse_mountinfo(MOUNTINFO_SAMPLE)
+    assert len(entries) == 5  # malformed line skipped
+    bind = entries[1]
+    assert bind.major_minor == "8:1"
+    assert bind.root == "/var/lib/kubelet/staging/vol-1"
+    assert bind.path == "/pod/target"
+    assert bind.fstype == "ext4"
+    assert bind.source == "/dev/sda1"
+    assert entries[4].path == "/mnt/with space"
+
+
+def test_mount_refs_scoped_by_root(tmp_path):
+    """Refs are mounts sharing (device, root) — the other bind mount of the
+    same staging dir is a ref; '/' and '/home' on the same device are NOT
+    (the by-device-only answer would wrongly pin the volume forever)."""
+    info = tmp_path / "mountinfo"
+    info.write_text(MOUNTINFO_SAMPLE)
+    refs = procmounts.mount_refs("/pod/target", mountinfo=str(info))
+    assert refs == ["/pod2/target"]
+    assert procmounts.mount_refs("/not/mounted", mountinfo=str(info)) == []
 
 
 def test_missing_proc_mounts():
     assert procmounts.list_mounts("/nonexistent/mounts") == []
     assert not procmounts.is_mount_point("/x", proc_mounts="/nonexistent/mounts")
+    assert procmounts.mount_refs("/x", mountinfo="/nonexistent/mountinfo") == []
