@@ -315,6 +315,11 @@ Json ChipStore::Handle(const std::string& method, const Json& params) {
   };
 
   if (method == "get_topology") return TopologyJson();
+  if (method == "get_pjrt_info") {
+    // Implementation-specific compute-stack report; {} when the daemon
+    // was started without a PJRT plugin (doc/agent-protocol.md).
+    return pjrt_info_.is_null() ? Json::object() : pjrt_info_;
+  }
   if (method == "get_chips") {
     Json arr = Json::array();
     for (const Chip& c : chips_) arr.push(ChipJson(c, nullptr));

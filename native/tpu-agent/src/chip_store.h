@@ -67,6 +67,9 @@ class ChipStore {
   // Dispatch one protocol method.  Throws RpcError on failure.
   Json Handle(const std::string& method, const Json& params);
 
+  // Full PJRT plugin report (src/pjrt_loader.cc), served by get_pjrt_info.
+  void SetPjrtInfo(Json info) { pjrt_info_ = std::move(info); }
+
  private:
   Json TopologyJson();
   Json ChipJson(const Chip& chip, const std::vector<int>* coord) const;
@@ -88,6 +91,7 @@ class ChipStore {
   std::vector<int> mesh_;
   std::string accel_type_;
   std::string pjrt_version_;
+  Json pjrt_info_;
   std::vector<Chip> chips_;
   std::map<std::string, Allocation> allocations_;
   std::mutex mutex_;

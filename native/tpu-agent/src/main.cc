@@ -10,7 +10,15 @@
 //   --fake-chips N [--mesh XxYxZ]   fabricate N chips, stub device files in
 //                                   --state-dir (Malloc-BDev analog)
 //   --devices GLOB                  real mode: chips = matching device files
-//   --pjrt-plugin PATH              dlopen a PJRT plugin as a liveness probe
+//   --pjrt-plugin PATH              dlopen a PJRT C-API plugin: version
+//                                   handshake + plugin attributes, served
+//                                   via get_pjrt_info
+//   --pjrt-create-client            also create a PJRT client and enumerate
+//                                   real devices (released immediately)
+//   --pjrt-option K=V               named create_options for the client
+//                                   (repeatable; int64/bool auto-detected)
+//   --chips-from-pjrt               chip inventory = PJRT device enumeration
+//                                   (implies --pjrt-create-client)
 
 #include <dlfcn.h>
 #include <glob.h>
@@ -18,6 +26,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -26,6 +35,7 @@
 #include <vector>
 
 #include "chip_store.h"
+#include "pjrt_loader.h"
 #include "rpc_server.h"
 
 namespace {
@@ -75,27 +85,13 @@ std::string SysfsPci(const std::string& device_path) {
   return "";
 }
 
-std::string ProbePjrtPlugin(const std::string& path) {
-  void* handle = dlopen(path.c_str(), RTLD_NOW | RTLD_LOCAL);
-  if (handle == nullptr) {
-    std::fprintf(stderr, "warning: dlopen(%s): %s\n", path.c_str(), dlerror());
-    return "";
-  }
-  // Every PJRT plugin exports GetPjrtApi (PJRT C API contract).
-  void* sym = dlsym(handle, "GetPjrtApi");
-  if (sym == nullptr) {
-    std::fprintf(stderr, "warning: %s lacks GetPjrtApi\n", path.c_str());
-    return "";
-  }
-  return "loaded:" + path;
-}
-
 void Usage(const char* argv0) {
   std::fprintf(
       stderr,
       "usage: %s --socket PATH (--fake-chips N [--mesh XxYxZ] "
-      "--state-dir DIR | --devices GLOB [--mesh XxYxZ]) "
-      "[--accel-type TYPE] [--pjrt-plugin PATH]\n",
+      "--state-dir DIR | --devices GLOB [--mesh XxYxZ] | "
+      "--chips-from-pjrt) [--accel-type TYPE] [--pjrt-plugin PATH] "
+      "[--pjrt-create-client] [--pjrt-option K=V]...\n",
       argv0);
 }
 
@@ -108,6 +104,9 @@ int main(int argc, char** argv) {
   std::string accel_type = "v5p";
   std::string pjrt_plugin;
   std::string mesh_spec;
+  std::vector<oim::PjrtOption> pjrt_options;
+  bool pjrt_create_client = false;
+  bool chips_from_pjrt = false;
   int fake_chips = 0;
 
   for (int i = 1; i < argc; i++) {
@@ -137,6 +136,17 @@ int main(int argc, char** argv) {
     else if (arg == "--devices") devices_glob = next();
     else if (arg == "--accel-type") accel_type = next();
     else if (arg == "--pjrt-plugin") pjrt_plugin = next();
+    else if (arg == "--pjrt-create-client") pjrt_create_client = true;
+    else if (arg == "--chips-from-pjrt") chips_from_pjrt = true;
+    else if (arg == "--pjrt-option") {
+      std::string kv = next();
+      size_t sep = kv.find('=');
+      if (sep == std::string::npos) {
+        std::fprintf(stderr, "--pjrt-option expects K=V, got %s\n", kv.c_str());
+        return 2;
+      }
+      pjrt_options.push_back({kv.substr(0, sep), kv.substr(sep + 1)});
+    }
     else if (arg == "--help" || arg == "-h") { Usage(argv[0]); return 0; }
     else {
       std::fprintf(stderr, "unknown flag %s\n", arg.c_str());
@@ -149,11 +159,105 @@ int main(int argc, char** argv) {
     return 2;
   }
   // Real mode is the default: scan the standard TPU accel device nodes.
-  if (fake_chips <= 0 && devices_glob.empty()) devices_glob = "/dev/accel*";
+  if (fake_chips <= 0 && devices_glob.empty() && !chips_from_pjrt) {
+    devices_glob = "/dev/accel*";
+  }
+
+  oim::Json pjrt_info;
+  if (!pjrt_plugin.empty()) {
+    pjrt_info = oim::LoadPjrtPlugin(
+        pjrt_plugin, pjrt_create_client || chips_from_pjrt, pjrt_options);
+    if (const oim::Json* err = pjrt_info.find("error")) {
+      std::fprintf(stderr, "pjrt: %s\n", err->as_string().c_str());
+    }
+  } else if (chips_from_pjrt) {
+    std::fprintf(stderr, "--chips-from-pjrt requires --pjrt-plugin\n");
+    return 2;
+  }
 
   std::vector<std::string> device_paths;
   std::vector<std::string> pci_addrs;
-  if (fake_chips > 0) {
+  if (chips_from_pjrt) {
+    // Chip inventory = what the PJRT plugin enumerates.  Order devices
+    // row-major by their torus coords so ChipStore's row-major coord
+    // assignment reproduces the plugin's physical topology; the mesh is
+    // the coords' bounding box when consistent, else linear.
+    const oim::Json* client = pjrt_info.find("client");
+    const oim::Json* devices =
+        client != nullptr ? client->find("devices") : nullptr;
+    if (devices == nullptr || devices->items().empty()) {
+      std::fprintf(stderr, "pjrt plugin enumerated no devices\n");
+      return 1;
+    }
+    struct PjrtDev {
+      int id;
+      std::vector<int> coords;
+    };
+    std::vector<PjrtDev> devs;
+    bool have_coords = true;
+    size_t coord_rank = 0;
+    for (const oim::Json& d : devices->items()) {
+      PjrtDev pd;
+      const oim::Json* id = d.find("id");
+      pd.id = id != nullptr ? static_cast<int>(id->as_int())
+                            : static_cast<int>(devs.size());
+      if (const oim::Json* coords = d.find("coords")) {
+        for (const oim::Json& c : coords->items()) {
+          pd.coords.push_back(static_cast<int>(c.as_int()));
+        }
+      }
+      if (devs.empty()) coord_rank = pd.coords.size();
+      if (pd.coords.empty() || pd.coords.size() != coord_rank) {
+        have_coords = false;
+      }
+      devs.push_back(std::move(pd));
+    }
+    // An explicit --mesh wins: keep the operator's topology, linear id
+    // order (the product check below still validates it).
+    bool coords_ordered = false;
+    if (have_coords && mesh_spec.empty()) {
+      std::vector<int> bounds(coord_rank, 0);
+      for (const PjrtDev& d : devs) {
+        for (size_t a = 0; a < coord_rank; a++) {
+          if (d.coords[a] + 1 > bounds[a]) bounds[a] = d.coords[a] + 1;
+        }
+      }
+      int product = 1;
+      for (int b : bounds) product *= b;
+      if (product == static_cast<int>(devs.size())) {
+        std::sort(devs.begin(), devs.end(),
+                  [](const PjrtDev& a, const PjrtDev& b) {
+                    return a.coords < b.coords;
+                  });
+        // Duplicate coords would silently fabricate ICI adjacency that
+        // does not exist; treat them as "no usable coords".
+        for (size_t i = 1; i < devs.size() && have_coords; i++) {
+          if (devs[i].coords == devs[i - 1].coords) {
+            std::fprintf(stderr,
+                         "warning: pjrt devices report duplicate coords; "
+                         "falling back to a linear mesh\n");
+            have_coords = false;
+          }
+        }
+        if (have_coords) {
+          coords_ordered = true;
+          for (size_t a = 0; a < bounds.size(); a++) {
+            mesh_spec += (a > 0 ? "x" : "") + std::to_string(bounds[a]);
+          }
+        }
+      } else {
+        have_coords = false;  // sparse slice: fall back to linear order
+      }
+    }
+    if (!coords_ordered) {
+      std::sort(devs.begin(), devs.end(),
+                [](const PjrtDev& a, const PjrtDev& b) { return a.id < b.id; });
+    }
+    for (const PjrtDev& d : devs) {
+      device_paths.push_back("pjrt:" + std::to_string(d.id));
+      pci_addrs.push_back("");
+    }
+  } else if (fake_chips > 0) {
     ::mkdir(state_dir.c_str(), 0755);
     for (int i = 0; i < fake_chips; i++) {
       std::string path = state_dir + "/accel" + std::to_string(i);
@@ -192,11 +296,27 @@ int main(int argc, char** argv) {
     mesh = {static_cast<int>(device_paths.size())};
   }
 
+  // Summary string surfaced by get_topology (full report via
+  // get_pjrt_info): "pjrt-<maj>.<min>[ <platform_name> <version>]".
   std::string pjrt_version;
-  if (!pjrt_plugin.empty()) pjrt_version = ProbePjrtPlugin(pjrt_plugin);
+  if (!pjrt_info.is_null() && pjrt_info.find("error") == nullptr) {
+    if (const oim::Json* v = pjrt_info.find("api_version")) {
+      pjrt_version = "pjrt-" + std::to_string(v->find("major")->as_int()) +
+                     "." + std::to_string(v->find("minor")->as_int());
+    }
+    if (const oim::Json* client = pjrt_info.find("client")) {
+      if (const oim::Json* name = client->find("platform_name")) {
+        pjrt_version += " " + name->as_string();
+      }
+      if (const oim::Json* ver = client->find("platform_version")) {
+        pjrt_version += " " + ver->as_string();
+      }
+    }
+  }
 
   oim::ChipStore store(mesh, accel_type, device_paths, pjrt_version,
                        pci_addrs);
+  if (!pjrt_info.is_null()) store.SetPjrtInfo(std::move(pjrt_info));
   oim::RpcServer server(&store, socket_path);
   if (!server.Listen()) return 1;
   g_server = &server;

@@ -1,0 +1,331 @@
+#include "pjrt_loader.h"
+
+#include <dlfcn.h>
+
+#include <cstddef>
+#include <cstring>
+
+#include "pjrt/pjrt_c_api.h"
+
+namespace oim {
+namespace {
+
+// The PJRT_Api table grows over releases; a plugin built against an older
+// header ships a smaller table.  Every entry must be bounds-checked against
+// the plugin's own struct_size AND null-checked before the call — the
+// header's versioning contract (pjrt_c_api.h: "Callers can implement
+// forwards compatibility by using PJRT_Api_Version").
+#define PJRT_HAS(api, member)                                          \
+  (offsetof(PJRT_Api, member) + sizeof((api)->member) <=               \
+       (api)->struct_size &&                                           \
+   (api)->member != nullptr)
+
+std::string TakeErrorMessage(const PJRT_Api* api, PJRT_Error* error) {
+  std::string text = "(unreadable PJRT error)";
+  if (PJRT_HAS(api, PJRT_Error_Message)) {
+    PJRT_Error_Message_Args msg{};
+    msg.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+    msg.error = error;
+    api->PJRT_Error_Message(&msg);
+    text.assign(msg.message, msg.message_size);
+  }
+  if (PJRT_HAS(api, PJRT_Error_Destroy)) {
+    PJRT_Error_Destroy_Args destroy{};
+    destroy.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    destroy.error = error;
+    api->PJRT_Error_Destroy(&destroy);
+  }
+  return text;
+}
+
+// For calls whose failure is non-fatal to the report: destroys the error
+// (the PJRT contract makes the caller responsible) and returns success.
+bool CheckOk(const PJRT_Api* api, PJRT_Error* error) {
+  if (error == nullptr) return true;
+  TakeErrorMessage(api, error);
+  return false;
+}
+
+Json NamedValueJson(const PJRT_NamedValue& nv) {
+  switch (nv.type) {
+    case PJRT_NamedValue_kString:
+      return Json::str(std::string(nv.string_value, nv.value_size));
+    case PJRT_NamedValue_kInt64:
+      return Json::integer(nv.int64_value);
+    case PJRT_NamedValue_kInt64List: {
+      Json list = Json::array();
+      for (size_t i = 0; i < nv.value_size; i++) {
+        list.push(Json::integer(nv.int64_array_value[i]));
+      }
+      return list;
+    }
+    case PJRT_NamedValue_kFloat:
+      return Json::number(nv.float_value);
+    case PJRT_NamedValue_kBool:
+      return Json::boolean(nv.bool_value);
+    default:
+      return Json();
+  }
+}
+
+Json NamedValuesJson(const PJRT_NamedValue* values, size_t count) {
+  Json out = Json::object();
+  for (size_t i = 0; i < count; i++) {
+    out.set(std::string(values[i].name, values[i].name_size),
+            NamedValueJson(values[i]));
+  }
+  return out;
+}
+
+// Owns the PJRT_NamedValue array built from --pjrt-option flags; the
+// strings must outlive the PJRT_Client_Create call.
+struct CreateOptions {
+  explicit CreateOptions(const std::vector<PjrtOption>& options) {
+    for (const PjrtOption& opt : options) {
+      PJRT_NamedValue nv{};
+      nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+      nv.name = opt.name.c_str();
+      nv.name_size = opt.name.size();
+      char* end = nullptr;
+      long long as_int = std::strtoll(opt.value.c_str(), &end, 10);
+      if (end != nullptr && *end == '\0' && !opt.value.empty()) {
+        nv.type = PJRT_NamedValue_kInt64;
+        nv.int64_value = as_int;
+        nv.value_size = 1;
+      } else if (opt.value == "true" || opt.value == "false") {
+        nv.type = PJRT_NamedValue_kBool;
+        nv.bool_value = opt.value == "true";
+        nv.value_size = 1;
+      } else {
+        nv.type = PJRT_NamedValue_kString;
+        nv.string_value = opt.value.c_str();
+        nv.value_size = opt.value.size();
+      }
+      values.push_back(nv);
+    }
+  }
+  std::vector<PJRT_NamedValue> values;
+};
+
+Json DeviceJson(const PJRT_Api* api, PJRT_Device* device) {
+  Json out = Json::object();
+  if (!PJRT_HAS(api, PJRT_Device_GetDescription)) {
+    out.set("error", Json::str("plugin lacks PJRT_Device_GetDescription"));
+    return out;
+  }
+  PJRT_Device_GetDescription_Args desc{};
+  desc.struct_size = PJRT_Device_GetDescription_Args_STRUCT_SIZE;
+  desc.device = device;
+  if (PJRT_Error* err = api->PJRT_Device_GetDescription(&desc)) {
+    out.set("error", Json::str(TakeErrorMessage(api, err)));
+    return out;
+  }
+  PJRT_DeviceDescription* dd = desc.device_description;
+
+  if (PJRT_HAS(api, PJRT_DeviceDescription_Id)) {
+    PJRT_DeviceDescription_Id_Args id{};
+    id.struct_size = PJRT_DeviceDescription_Id_Args_STRUCT_SIZE;
+    id.device_description = dd;
+    if (CheckOk(api, api->PJRT_DeviceDescription_Id(&id))) {
+      out.set("id", Json::integer(id.id));
+    }
+  }
+
+  if (PJRT_HAS(api, PJRT_DeviceDescription_ProcessIndex)) {
+    PJRT_DeviceDescription_ProcessIndex_Args pi{};
+    pi.struct_size = PJRT_DeviceDescription_ProcessIndex_Args_STRUCT_SIZE;
+    pi.device_description = dd;
+    if (CheckOk(api, api->PJRT_DeviceDescription_ProcessIndex(&pi))) {
+      out.set("process_index", Json::integer(pi.process_index));
+    }
+  }
+
+  if (PJRT_HAS(api, PJRT_DeviceDescription_Kind)) {
+    PJRT_DeviceDescription_Kind_Args kind{};
+    kind.struct_size = PJRT_DeviceDescription_Kind_Args_STRUCT_SIZE;
+    kind.device_description = dd;
+    if (CheckOk(api, api->PJRT_DeviceDescription_Kind(&kind))) {
+      out.set("kind", Json::str(std::string(kind.device_kind,
+                                            kind.device_kind_size)));
+    }
+  }
+
+  if (PJRT_HAS(api, PJRT_DeviceDescription_Attributes)) {
+    PJRT_DeviceDescription_Attributes_Args attrs{};
+    attrs.struct_size = PJRT_DeviceDescription_Attributes_Args_STRUCT_SIZE;
+    attrs.device_description = dd;
+    if (CheckOk(api, api->PJRT_DeviceDescription_Attributes(&attrs))) {
+      Json attr_json = NamedValuesJson(attrs.attributes, attrs.num_attributes);
+      // TPU plugins expose the chip's physical torus position as "coords";
+      // surface it at top level — it is the ICI analog of the PCI BDF the
+      // reference reads from sysfs (reference pkg/oim-csi-driver/
+      // remote.go:324-373).
+      if (const Json* coords = attr_json.find("coords")) {
+        out.set("coords", *coords);
+      }
+      out.set("attributes", std::move(attr_json));
+    }
+  }
+
+  if (PJRT_HAS(api, PJRT_DeviceDescription_DebugString)) {
+    PJRT_DeviceDescription_DebugString_Args dbg{};
+    dbg.struct_size = PJRT_DeviceDescription_DebugString_Args_STRUCT_SIZE;
+    dbg.device_description = dd;
+    if (CheckOk(api, api->PJRT_DeviceDescription_DebugString(&dbg))) {
+      out.set("debug_string",
+              Json::str(std::string(dbg.debug_string, dbg.debug_string_size)));
+    }
+  }
+  return out;
+}
+
+Json ClientJson(const PJRT_Api* api,
+                const std::vector<PjrtOption>& options, std::string* error) {
+  if (!PJRT_HAS(api, PJRT_Client_Create)) {
+    *error = "plugin lacks PJRT_Client_Create";
+    return Json();
+  }
+  CreateOptions create_options(options);
+  PJRT_Client_Create_Args create{};
+  create.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  create.create_options = create_options.values.data();
+  create.num_options = create_options.values.size();
+  if (PJRT_Error* err = api->PJRT_Client_Create(&create)) {
+    *error = "client_create: " + TakeErrorMessage(api, err);
+    return Json();
+  }
+  PJRT_Client* client = create.client;
+  Json out = Json::object();
+
+  if (PJRT_HAS(api, PJRT_Client_PlatformName)) {
+    PJRT_Client_PlatformName_Args name{};
+    name.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+    name.client = client;
+    if (CheckOk(api, api->PJRT_Client_PlatformName(&name))) {
+      out.set("platform_name", Json::str(std::string(
+                                   name.platform_name,
+                                   name.platform_name_size)));
+    }
+  }
+
+  if (PJRT_HAS(api, PJRT_Client_PlatformVersion)) {
+    PJRT_Client_PlatformVersion_Args version{};
+    version.struct_size = PJRT_Client_PlatformVersion_Args_STRUCT_SIZE;
+    version.client = client;
+    if (CheckOk(api, api->PJRT_Client_PlatformVersion(&version))) {
+      out.set("platform_version",
+              Json::str(std::string(version.platform_version,
+                                    version.platform_version_size)));
+    }
+  }
+
+  if (PJRT_HAS(api, PJRT_Client_ProcessIndex)) {
+    PJRT_Client_ProcessIndex_Args process{};
+    process.struct_size = PJRT_Client_ProcessIndex_Args_STRUCT_SIZE;
+    process.client = client;
+    if (CheckOk(api, api->PJRT_Client_ProcessIndex(&process))) {
+      out.set("process_index", Json::integer(process.process_index));
+    }
+  }
+
+  // Global device count for visibility; the enumerated "devices" list below
+  // is the *addressable* set only — a per-host agent must never inventory
+  // chips that physically live on other hosts of the slice.
+  if (PJRT_HAS(api, PJRT_Client_Devices)) {
+    PJRT_Client_Devices_Args all{};
+    all.struct_size = PJRT_Client_Devices_Args_STRUCT_SIZE;
+    all.client = client;
+    if (CheckOk(api, api->PJRT_Client_Devices(&all))) {
+      out.set("num_global_devices", Json::integer(all.num_devices));
+    }
+  }
+
+  if (!PJRT_HAS(api, PJRT_Client_AddressableDevices)) {
+    *error = "plugin lacks PJRT_Client_AddressableDevices";
+  } else {
+    PJRT_Client_AddressableDevices_Args devices{};
+    devices.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+    devices.client = client;
+    if (PJRT_Error* err = api->PJRT_Client_AddressableDevices(&devices)) {
+      *error = "addressable_devices: " + TakeErrorMessage(api, err);
+    } else {
+      Json device_list = Json::array();
+      for (size_t i = 0; i < devices.num_addressable_devices; i++) {
+        device_list.push(DeviceJson(api, devices.addressable_devices[i]));
+      }
+      out.set("devices", std::move(device_list));
+    }
+  }
+
+  if (PJRT_HAS(api, PJRT_Client_Destroy)) {
+    PJRT_Client_Destroy_Args destroy{};
+    destroy.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    destroy.client = client;
+    CheckOk(api, api->PJRT_Client_Destroy(&destroy));
+  }
+  return out;
+}
+
+}  // namespace
+
+Json LoadPjrtPlugin(const std::string& plugin_path, bool create_client,
+                    const std::vector<PjrtOption>& options) {
+  Json out = Json::object();
+  out.set("plugin_path", Json::str(plugin_path));
+
+  // RTLD_GLOBAL: libtpu-style plugins expect their own symbols visible to
+  // dependent dlopens.  The handle is deliberately never dlclosed — PJRT
+  // plugins do not support unloading.
+  void* handle = dlopen(plugin_path.c_str(), RTLD_NOW | RTLD_GLOBAL);
+  if (handle == nullptr) {
+    out.set("error", Json::str(std::string("dlopen: ") + dlerror()));
+    return out;
+  }
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get_api = reinterpret_cast<GetPjrtApiFn>(dlsym(handle, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    out.set("error", Json::str("plugin lacks GetPjrtApi"));
+    return out;
+  }
+  const PJRT_Api* api = get_api();
+  if (api == nullptr) {
+    out.set("error", Json::str("GetPjrtApi returned null"));
+    return out;
+  }
+
+  Json version = Json::object();
+  version.set("major", Json::integer(api->pjrt_api_version.major_version));
+  version.set("minor", Json::integer(api->pjrt_api_version.minor_version));
+  out.set("api_version", std::move(version));
+
+  if (!PJRT_HAS(api, PJRT_Plugin_Initialize)) {
+    out.set("error", Json::str("plugin lacks PJRT_Plugin_Initialize"));
+    return out;
+  }
+  PJRT_Plugin_Initialize_Args init{};
+  init.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  if (PJRT_Error* err = api->PJRT_Plugin_Initialize(&init)) {
+    out.set("error",
+            Json::str("plugin_initialize: " + TakeErrorMessage(api, err)));
+    return out;
+  }
+
+  if (PJRT_HAS(api, PJRT_Plugin_Attributes)) {
+    PJRT_Plugin_Attributes_Args attrs{};
+    attrs.struct_size = PJRT_Plugin_Attributes_Args_STRUCT_SIZE;
+    if (CheckOk(api, api->PJRT_Plugin_Attributes(&attrs))) {
+      out.set("attributes",
+              NamedValuesJson(attrs.attributes, attrs.num_attributes));
+    }
+  }
+
+  if (create_client) {
+    std::string error;
+    Json client = ClientJson(api, options, &error);
+    if (!error.empty()) out.set("error", Json::str(error));
+    if (!client.is_null()) out.set("client", std::move(client));
+  }
+  return out;
+}
+
+}  // namespace oim

@@ -1,0 +1,52 @@
+// PJRT C API plugin loader: the agent's window into the compute stack.
+//
+// Where the reference's device daemon owns the hardware by linking SPDK's
+// bdev/vhost libraries directly (reference vendor/github.com/spdk/spdk), a
+// TPU is owned by whoever creates the PJRT client on it.  The agent
+// therefore speaks the *PJRT C API* (third_party/pjrt/pjrt_c_api.h) via
+// dlopen: handshake the API version, initialize the plugin, read plugin
+// attributes, and — when asked — create a client and enumerate real
+// devices (id, process index, coords, kind).  No XLA libraries are linked;
+// any conforming plugin works (libtpu.so, CPU plugin, the in-tree test
+// plugin).
+//
+// All failures are reported in-band (the "error" field) rather than
+// thrown: a missing or broken plugin must never take the control-plane
+// daemon down, matching the reference's stance that the control plane
+// stays up when the device plane misbehaves.
+
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace oim {
+
+struct PjrtOption {
+  std::string name;
+  std::string value;  // int64 is auto-detected from decimal strings
+};
+
+// Loads `plugin_path` and returns a JSON report:
+//   {
+//     "plugin_path": "...",
+//     "api_version": {"major": N, "minor": N},
+//     "attributes": {...},               // plugin attributes, if any
+//     "client": {                        // present iff create_client
+//       "platform_name": "...", "platform_version": "...",
+//       "process_index": N,
+//       "devices": [{"id": N, "process_index": N, "kind": "...",
+//                    "coords": [x,y,z]?, "debug_string": "..."}]
+//     },
+//     "error": "..."                     // present iff something failed
+//   }
+// The client, when created, is destroyed again before returning: the agent
+// probes and enumerates but must not hold the chips — workloads own them
+// after NodeStage (same reason the reference daemon releases NBD disks,
+// reference pkg/oim-csi-driver/local.go:136-139).
+Json LoadPjrtPlugin(const std::string& plugin_path, bool create_client,
+                    const std::vector<PjrtOption>& options);
+
+}  // namespace oim

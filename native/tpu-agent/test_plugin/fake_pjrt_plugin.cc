@@ -1,0 +1,242 @@
+// A minimal in-tree PJRT plugin: 8 fake TPU devices on a 2x2x2 torus.
+//
+// The CI analog of a real libtpu.so — it lets the agent's PJRT C API
+// loader (src/pjrt_loader.cc) be exercised end-to-end (dlopen → version
+// handshake → plugin init → client create with named options → device
+// enumeration with coords) on machines with no TPU, the same way the
+// reference tests its device plane against Malloc BDevs instead of real
+// disks (reference spec.md:119-122).  Implements exactly the API subset
+// the loader calls; everything else in the PJRT_Api table stays null.
+//
+// Build: make -C native/tpu-agent test-plugin  → test_plugin/fake_pjrt.so
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "pjrt/pjrt_c_api.h"
+
+namespace {
+
+constexpr int kNumDevices = 8;
+constexpr int kMesh[3] = {2, 2, 2};
+
+std::string* g_last_error_storage = nullptr;
+
+struct FakeDevice {
+  int id;
+  int64_t coords[3];
+  std::string kind;
+  std::string debug;
+  PJRT_NamedValue attrs[2];
+};
+
+FakeDevice g_devices[kNumDevices];
+PJRT_Device* g_device_ptrs[kNumDevices];
+bool g_client_alive = false;
+std::string g_platform_name = "fake_tpu";
+std::string g_platform_version = "fake-pjrt 1.0";
+
+void InitDevices() {
+  static bool done = false;
+  if (done) return;
+  done = true;
+  for (int i = 0; i < kNumDevices; i++) {
+    FakeDevice& d = g_devices[i];
+    d.id = i;
+    d.coords[0] = (i / (kMesh[1] * kMesh[2])) % kMesh[0];
+    d.coords[1] = (i / kMesh[2]) % kMesh[1];
+    d.coords[2] = i % kMesh[2];
+    d.kind = "Fake TPU v5";
+    d.debug = "FakeTpu(id=" + std::to_string(i) + ")";
+    d.attrs[0] = PJRT_NamedValue{};
+    d.attrs[0].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    d.attrs[0].name = "coords";
+    d.attrs[0].name_size = 6;
+    d.attrs[0].type = PJRT_NamedValue_kInt64List;
+    d.attrs[0].int64_array_value = d.coords;
+    d.attrs[0].value_size = 3;
+    d.attrs[1] = PJRT_NamedValue{};
+    d.attrs[1].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    d.attrs[1].name = "core_count";
+    d.attrs[1].name_size = 10;
+    d.attrs[1].type = PJRT_NamedValue_kInt64;
+    d.attrs[1].int64_value = 1;
+    d.attrs[1].value_size = 1;
+    // PJRT_Device/PJRT_DeviceDescription are opaque to callers: hand out
+    // the FakeDevice address under both types and cast back on entry.
+    g_device_ptrs[i] = reinterpret_cast<PJRT_Device*>(&d);
+  }
+}
+
+PJRT_Error* MakeError(const std::string& message) {
+  // One error live at a time is enough for the loader's call pattern.
+  if (g_last_error_storage == nullptr) g_last_error_storage = new std::string;
+  *g_last_error_storage = message;
+  return reinterpret_cast<PJRT_Error*>(g_last_error_storage);
+}
+
+void ErrorDestroy(PJRT_Error_Destroy_Args*) {}
+
+void ErrorMessage(PJRT_Error_Message_Args* args) {
+  const auto* storage = reinterpret_cast<const std::string*>(args->error);
+  args->message = storage->c_str();
+  args->message_size = storage->size();
+}
+
+PJRT_Error* ErrorGetCode(PJRT_Error_GetCode_Args* args) {
+  args->code = PJRT_Error_Code_INTERNAL;
+  return nullptr;
+}
+
+PJRT_Error* PluginInitialize(PJRT_Plugin_Initialize_Args*) {
+  InitDevices();
+  return nullptr;
+}
+
+PJRT_Error* PluginAttributes(PJRT_Plugin_Attributes_Args* args) {
+  static PJRT_NamedValue attrs[1];
+  static std::string mesh_name = "fake_mesh";
+  static int64_t mesh[3] = {kMesh[0], kMesh[1], kMesh[2]};
+  attrs[0] = PJRT_NamedValue{};
+  attrs[0].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+  attrs[0].name = mesh_name.c_str();
+  attrs[0].name_size = mesh_name.size();
+  attrs[0].type = PJRT_NamedValue_kInt64List;
+  attrs[0].int64_array_value = mesh;
+  attrs[0].value_size = 3;
+  args->attributes = attrs;
+  args->num_attributes = 1;
+  return nullptr;
+}
+
+PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  InitDevices();
+  // Honor a "fail" option so tests can exercise the loader's error path.
+  for (size_t i = 0; i < args->num_options; i++) {
+    const PJRT_NamedValue& nv = args->create_options[i];
+    if (std::string(nv.name, nv.name_size) == "fail" &&
+        nv.type == PJRT_NamedValue_kBool && nv.bool_value) {
+      return MakeError("client creation failed by request");
+    }
+  }
+  g_client_alive = true;
+  args->client = reinterpret_cast<PJRT_Client*>(&g_client_alive);
+  return nullptr;
+}
+
+PJRT_Error* ClientDestroy(PJRT_Client_Destroy_Args*) {
+  g_client_alive = false;
+  return nullptr;
+}
+
+PJRT_Error* ClientPlatformName(PJRT_Client_PlatformName_Args* args) {
+  args->platform_name = g_platform_name.c_str();
+  args->platform_name_size = g_platform_name.size();
+  return nullptr;
+}
+
+PJRT_Error* ClientPlatformVersion(PJRT_Client_PlatformVersion_Args* args) {
+  args->platform_version = g_platform_version.c_str();
+  args->platform_version_size = g_platform_version.size();
+  return nullptr;
+}
+
+PJRT_Error* ClientProcessIndex(PJRT_Client_ProcessIndex_Args* args) {
+  args->process_index = 0;
+  return nullptr;
+}
+
+PJRT_Error* ClientDevices(PJRT_Client_Devices_Args* args) {
+  args->devices = g_device_ptrs;
+  args->num_devices = kNumDevices;
+  return nullptr;
+}
+
+PJRT_Error* ClientAddressableDevices(
+    PJRT_Client_AddressableDevices_Args* args) {
+  // Single-process fake: every device is addressable.
+  args->addressable_devices = g_device_ptrs;
+  args->num_addressable_devices = kNumDevices;
+  return nullptr;
+}
+
+PJRT_Error* DeviceGetDescription(PJRT_Device_GetDescription_Args* args) {
+  args->device_description =
+      reinterpret_cast<PJRT_DeviceDescription*>(args->device);
+  return nullptr;
+}
+
+PJRT_Error* DescriptionId(PJRT_DeviceDescription_Id_Args* args) {
+  args->id = reinterpret_cast<FakeDevice*>(args->device_description)->id;
+  return nullptr;
+}
+
+PJRT_Error* DescriptionProcessIndex(
+    PJRT_DeviceDescription_ProcessIndex_Args* args) {
+  args->process_index = 0;
+  return nullptr;
+}
+
+PJRT_Error* DescriptionAttributes(
+    PJRT_DeviceDescription_Attributes_Args* args) {
+  auto* d = reinterpret_cast<FakeDevice*>(args->device_description);
+  args->attributes = d->attrs;
+  args->num_attributes = 2;
+  return nullptr;
+}
+
+PJRT_Error* DescriptionKind(PJRT_DeviceDescription_Kind_Args* args) {
+  auto* d = reinterpret_cast<FakeDevice*>(args->device_description);
+  args->device_kind = d->kind.c_str();
+  args->device_kind_size = d->kind.size();
+  return nullptr;
+}
+
+PJRT_Error* DescriptionDebugString(
+    PJRT_DeviceDescription_DebugString_Args* args) {
+  auto* d = reinterpret_cast<FakeDevice*>(args->device_description);
+  args->debug_string = d->debug.c_str();
+  args->debug_string_size = d->debug.size();
+  return nullptr;
+}
+
+PJRT_Error* DescriptionToString(PJRT_DeviceDescription_ToString_Args* args) {
+  auto* d = reinterpret_cast<FakeDevice*>(args->device_description);
+  args->to_string = d->debug.c_str();
+  args->to_string_size = d->debug.size();
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  static PJRT_Api api = [] {
+    PJRT_Api a{};
+    a.struct_size = PJRT_Api_STRUCT_SIZE;
+    a.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+    a.pjrt_api_version.major_version = PJRT_API_MAJOR;
+    a.pjrt_api_version.minor_version = PJRT_API_MINOR;
+    a.PJRT_Error_Destroy = ErrorDestroy;
+    a.PJRT_Error_Message = ErrorMessage;
+    a.PJRT_Error_GetCode = ErrorGetCode;
+    a.PJRT_Plugin_Initialize = PluginInitialize;
+    a.PJRT_Plugin_Attributes = PluginAttributes;
+    a.PJRT_Client_Create = ClientCreate;
+    a.PJRT_Client_Destroy = ClientDestroy;
+    a.PJRT_Client_PlatformName = ClientPlatformName;
+    a.PJRT_Client_PlatformVersion = ClientPlatformVersion;
+    a.PJRT_Client_ProcessIndex = ClientProcessIndex;
+    a.PJRT_Client_Devices = ClientDevices;
+    a.PJRT_Client_AddressableDevices = ClientAddressableDevices;
+    a.PJRT_Device_GetDescription = DeviceGetDescription;
+    a.PJRT_DeviceDescription_Id = DescriptionId;
+    a.PJRT_DeviceDescription_ProcessIndex = DescriptionProcessIndex;
+    a.PJRT_DeviceDescription_Attributes = DescriptionAttributes;
+    a.PJRT_DeviceDescription_Kind = DescriptionKind;
+    a.PJRT_DeviceDescription_DebugString = DescriptionDebugString;
+    a.PJRT_DeviceDescription_ToString = DescriptionToString;
+    return a;
+  }();
+  return &api;
+}

@@ -28,6 +28,11 @@ class Agent:
         params = {"name": name} if name else None
         return self.client.invoke("get_allocations", params)
 
+    def get_pjrt_info(self) -> dict[str, Any]:
+        """Compute-stack report from the daemon's PJRT C-API plugin; ``{}``
+        when the daemon was started without one."""
+        return self.client.invoke("get_pjrt_info")
+
     def find_allocation(self, name: str) -> dict[str, Any] | None:
         found = self.get_allocations(name)
         return found[0] if found else None

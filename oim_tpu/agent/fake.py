@@ -296,6 +296,14 @@ class ChipStore:
             if self.pjrt_version:
                 out["pjrt_version"] = self.pjrt_version
             return out
+        if method == "get_pjrt_info":
+            # The Python fake never loads a real plugin; report the version
+            # stub when configured so both implementations serve the method
+            # (contents are implementation-specific, doc/agent-protocol.md).
+            if self.pjrt_version:
+                return {"plugin_path": "", "fake": True,
+                        "pjrt_version": self.pjrt_version}
+            return {}
         if method == "get_chips":
             with self._lock:
                 return [c.to_json() for c in self.chips.values()]

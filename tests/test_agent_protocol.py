@@ -232,3 +232,11 @@ def test_wire_errors(agent_socket):
     assert resp["error"]["code"] == -32602
 
     s.close()
+
+
+def test_get_pjrt_info_always_served(agent_socket):
+    """Both implementations serve get_pjrt_info; {} without a plugin."""
+    with Agent(agent_socket) as agent:
+        info = agent.get_pjrt_info()
+        assert isinstance(info, dict)
+        assert info == {}  # fixtures start without a PJRT plugin
