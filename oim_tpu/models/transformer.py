@@ -39,6 +39,7 @@ from oim_tpu.ops import (
 )
 from oim_tpu.parallel.pipeline import gpipe_spmd
 from oim_tpu.parallel.ring_attention import ring_attention
+from oim_tpu.parallel.ulysses import ulysses_attention
 
 
 @dataclass(frozen=True)
@@ -61,6 +62,18 @@ class TransformerConfig:
     # manual (tp == ep == 1) and falls back to XLA-fused reference ops
     # otherwise.
     use_pallas: bool = True
+    # Sequence-parallel attention over sp>1: "ring" rotates K/V blocks via
+    # ppermute (O(T/sp) memory, any head count); "ulysses" trades sequence
+    # for head shards with one all_to_all each way (fewer collective hops,
+    # needs n_heads % sp == 0).  See oim_tpu/parallel/ulysses.py.
+    attn_impl: str = "ring"
+
+    def __post_init__(self):
+        if self.attn_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown attn_impl {self.attn_impl!r}; "
+                "expected 'ring' or 'ulysses'"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -206,7 +219,12 @@ def _attention(x, lp, positions, cfg: TransformerConfig, sp_size):
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     if sp_size > 1:
-        out = ring_attention(q, k, v, "sp", causal=True)
+        if cfg.attn_impl == "ulysses":
+            out = ulysses_attention(
+                q, k, v, "sp", causal=True, use_flash=cfg.use_pallas
+            )
+        else:  # "ring" (validated in __post_init__)
+            out = ring_attention(q, k, v, "sp", causal=True)
     elif cfg.use_pallas:
         out = flash_attention(q, k, v, True)
     else:

@@ -21,6 +21,7 @@ from oim_tpu.parallel.coordinator import (
     initialize_distributed,
 )
 from oim_tpu.parallel.ring_attention import ring_attention
+from oim_tpu.parallel.ulysses import ulysses_attention
 from oim_tpu.parallel import collectives
 
 __all__ = [
@@ -36,5 +37,6 @@ __all__ = [
     "load_bootstrap",
     "initialize_distributed",
     "ring_attention",
+    "ulysses_attention",
     "collectives",
 ]

@@ -1,0 +1,109 @@
+"""Ulysses sequence parallelism: all-to-all seq↔head resharding.
+
+The second sequence-parallel scheme of the framework (SURVEY.md §2.3),
+complementing ring attention: instead of rotating K/V blocks around a ring,
+each device trades its *sequence* shard for a *head* shard with one
+``all_to_all``, computes ordinary full-sequence attention on its heads, and
+trades back (DeepSpeed-Ulysses style, implemented from scratch for this
+framework).
+
+Trade-offs vs the ring (why both exist):
+
+- Ulysses moves Q, K, V, O once each (4 tensor-sized all-to-alls total);
+  the ring moves K and V ``sp`` times (2·sp neighbor hops).  For short-to-
+  moderate sequences or fat heads the all-to-all wins; for very long
+  sequences the ring wins on memory — Ulysses materializes the full
+  sequence per device (heads sharded) during the local attention, and with
+  ``use_flash`` the current flash kernel additionally holds one head's full
+  global K/V in VMEM per grid step and recomputes the backward through the
+  O(T²) reference formula.  Long-context *training* should therefore use
+  the ring; Ulysses shines for inference/prefill and moderate-T training.
+- Ulysses needs ``heads % sp == 0``; the ring has no such constraint.
+- On a TPU torus, ``all_to_all`` over a mesh axis is an XLA collective that
+  rides ICI links directly.
+
+Both schemes consume the same layout — ``[batch, seq_local, heads, head_dim]``
+sharded on ``sp`` — so the model layer can switch per-config
+(``TransformerConfig.attn_impl``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+from oim_tpu.ops.flash_attention import flash_attention, reference_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+    use_flash: bool = True,
+) -> jax.Array:
+    """Exact attention over sequence shards via all-to-all resharding.
+
+    Args:
+      q, k, v: local shards ``[batch, seq_local, heads, head_dim]``; the
+        global sequence is the concatenation over ``axis_name`` in
+        axis-index order (same contract as ``ring_attention``).
+      axis_name: mesh axis carrying the sequence shards (``sp``).
+      causal: causal masking in global positions.
+      use_flash: run the local attention through the pallas flash kernel
+        (falls back to the reference path off-TPU / for ragged shapes).
+
+    Returns the local output shard ``[batch, seq_local, heads, head_dim]``.
+    """
+    size = jax.lax.axis_size(axis_name)
+    if size == 1:
+        attn = flash_attention if use_flash else reference_attention
+        return attn(q, k, v, causal)
+    heads = q.shape[2]
+    if heads % size != 0:
+        raise ValueError(
+            f"ulysses needs heads % sp == 0, got {heads} heads over "
+            f"sp={size} (use ring attention for this shape)"
+        )
+
+    # Trade sequence shards for head shards: [B, T/sp, H, D] → [B, T, H/sp, D].
+    # tiled all_to_all splits the head axis into sp chunks and concatenates
+    # the gathered sequence blocks in axis-index order, which preserves
+    # global positions exactly because the sp axis order IS the sequence
+    # order (mesh contract above).
+    def seq_to_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    q_full = seq_to_heads(q)
+    k_full = seq_to_heads(k)
+    v_full = seq_to_heads(v)
+
+    attn = flash_attention if use_flash else reference_attention
+    o_full = attn(q_full, k_full, v_full, causal)
+
+    return heads_to_seq(o_full)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, causal: bool = True):
+    """Convenience wrapper: global arrays in, global arrays out, sequence
+    sharded over ``sp`` and batch over ``dp`` (mirror of
+    ``ring_attention_sharded``)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P("dp", "sp", None, None)
+    fn = jax.shard_map(
+        partial(ulysses_attention, axis_name="sp", causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

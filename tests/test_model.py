@@ -64,6 +64,17 @@ class TestTrainingMixes:
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0] * 0.9
 
+    def test_dp_sp_mix_ulysses(self):
+        """Same mix with the all-to-all sequence-parallel scheme; the two
+        attn_impls must train to the same losses (exact attention both)."""
+        mesh = build_mesh(dp=2, sp=4)
+        ring = _run_steps(TransformerConfig(**TINY), mesh)
+        ulysses = _run_steps(
+            TransformerConfig(**TINY, attn_impl="ulysses"), mesh
+        )
+        np.testing.assert_allclose(ulysses, ring, rtol=1e-4, atol=1e-5)
+        assert ulysses[-1] < ulysses[0] * 0.9
+
     def test_dp_tp_mix(self):
         mesh = build_mesh(dp=2, tp=4)
         losses = _run_steps(TransformerConfig(**TINY), mesh)
