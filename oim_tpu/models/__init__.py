@@ -14,8 +14,20 @@ from oim_tpu.models.transformer import (
     param_pspecs,
 )
 from oim_tpu.models.train import TrainState, make_train_step, data_pspec
+from oim_tpu.models.decode import (
+    KVCache,
+    decode_step,
+    generate,
+    make_generate_fn,
+    prefill,
+)
 
 __all__ = [
+    "KVCache",
+    "decode_step",
+    "generate",
+    "make_generate_fn",
+    "prefill",
     "TransformerConfig",
     "init_params",
     "logical_axes",

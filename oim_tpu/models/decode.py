@@ -1,0 +1,274 @@
+"""Autoregressive inference: prefill + KV-cache decode + sampling.
+
+The serving-side counterpart of ``models.train`` (the reference framework
+is a control plane and has no model inventory — this is new work grounded
+in SURVEY.md §2.3's TPU-build column).  TPU-first design decisions:
+
+- **Static shapes everywhere.**  The cache is pre-allocated at
+  ``max_len``; the decode loop is a ``lax.scan`` over a fixed number of
+  steps with masking doing the work of "length" — nothing reshapes, so
+  XLA compiles one program for the whole generation.
+- **Prefill and decode share one cached-attention primitive.**  Prefill
+  writes the prompt's K/V into the cache in one shot (big MXU-friendly
+  einsums over the whole prompt); each decode step appends one position
+  via ``dynamic_update_slice``.
+- **GSPMD, not shard_map.**  Decode has no sequence axis to parallelize
+  (t=1), so inference shards batch over ``dp`` and heads over ``tp`` with
+  sharding constraints and lets XLA insert the collectives — the
+  train-path manual axes (sp ring, pp pipeline) don't apply.
+- bf16 activations with f32 logits/softmax, matching the train path.
+
+Weights are the training checkpoints unchanged (same stacked
+``[n_stages, layers_per_stage, ...]`` pytree from ``init_params``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from oim_tpu.models.transformer import (
+    TransformerConfig,
+    _dense_mlp,
+    _rmsnorm,
+)
+from oim_tpu.ops.rope import apply_rope
+
+_NEG_BIG = -1e30
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class KVCache:
+    """Per-layer key/value cache: ``k``, ``v`` are
+    ``[n_layers, batch, max_len, heads, head_dim]``; ``length`` is the
+    number of valid positions (scalar int32, same on every layer)."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array
+
+    @classmethod
+    def create(
+        cls, cfg: TransformerConfig, batch: int, max_len: int
+    ) -> "KVCache":
+        shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+        dt = cfg.compute_dtype
+        return cls(
+            k=jnp.zeros(shape, dt),
+            v=jnp.zeros(shape, dt),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def _flat_layer_params(params: dict, cfg: TransformerConfig) -> dict:
+    """Collapse the stacked [n_stages, layers_per_stage, ...] layer weights
+    to [n_layers, ...] — decode scans plain layers; pipeline staging is a
+    training-throughput construct with no benefit at t=1."""
+    layer_names = {"attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+                   "router", "w_gate", "w_in", "w_out"}
+    out = {}
+    for name, value in params.items():
+        if name in layer_names:
+            out[name] = value.reshape(cfg.n_layers, *value.shape[2:])
+    return out
+
+
+def _cached_attention(x, lp, k_cache, v_cache, start, cfg: TransformerConfig):
+    """Attend x's tokens (global positions start..start+t) against the
+    cache prefix plus themselves; returns (x_out, new_k_cache, new_v_cache).
+
+    x: [B, t, D]; k_cache/v_cache: [B, max_len, H, hd]; start: scalar.
+    """
+    b, t, _ = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    max_len = k_cache.shape[1]
+
+    normed = _rmsnorm(x, lp["attn_norm"], cfg)
+    q = jnp.einsum("btd,dn->btn", normed, lp["wq"]).reshape(b, t, h, hd)
+    k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, h, hd)
+    v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, h, hd)
+    positions = start + jnp.arange(t)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
+    )
+
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) / (hd**0.5)
+    # Causal over global positions; cache slots past start+t are invalid.
+    q_pos = start + jnp.arange(t)[:, None]
+    k_pos = jnp.arange(max_len)[None, :]
+    scores = jnp.where(k_pos <= q_pos, scores, _NEG_BIG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v_cache.astype(jnp.float32)
+    ).astype(x.dtype)
+    out = out.reshape(b, t, h * hd)
+    return x + jnp.einsum("btn,nd->btd", out, lp["wo"]).astype(x.dtype), (
+        k_cache,
+        v_cache,
+    )
+
+
+def _moe_exact(x, lp, cfg: TransformerConfig):
+    """Inference MoE: every token runs through its argmax expert, no
+    capacity dropping.  Train-time ``_switch_moe`` drops tokens past a
+    capacity computed from the *whole* call's token count, which would
+    make cached t=1 decoding route differently from the full forward;
+    standard practice (and this path) is drop-free routing at inference.
+    Computes all experts per token — fine at decode scale (b·1 tokens)."""
+    b, t, d = x.shape
+    normed = _rmsnorm(x, lp["mlp_norm"], cfg).reshape(b * t, d)
+    router_logits = jnp.einsum(
+        "gd,de->ge", normed.astype(jnp.float32), lp["router"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [G, E]
+    assign = jax.nn.one_hot(jnp.argmax(probs, axis=-1), cfg.n_experts)
+    gate_w = jnp.max(probs, axis=-1)  # [G]
+    normed_f = normed.astype(jnp.float32)
+    up_gate = jax.nn.silu(jnp.einsum("gd,edf->gef", normed_f, lp["w_gate"]))
+    up = jnp.einsum("gd,edf->gef", normed_f, lp["w_in"])
+    expert_out = jnp.einsum("gef,efd->ged", up_gate * up, lp["w_out"])
+    out = jnp.einsum("ged,ge,g->gd", expert_out, assign, gate_w)
+    return x + out.reshape(b, t, d).astype(x.dtype)
+
+
+def _forward_cached(params, tokens, cache: KVCache, cfg: TransformerConfig):
+    """Run ``tokens`` (global positions cache.length..+t) through all
+    layers, reading and extending the cache.  Returns (logits, cache)."""
+    # Inference runs under GSPMD auto-partitioning where pallas (Mosaic)
+    # kernels cannot sit (same constraint train.py gates on); XLA fuses
+    # the reference rmsnorm anyway at t=1.
+    cfg = replace(cfg, use_pallas=False)
+    # Overflow guard: jit traces can't check the traced length, but eager
+    # misuse (decode_step past capacity) fails loudly instead of letting
+    # dynamic_update_slice clamp-corrupt the last cache slot.
+    if not isinstance(cache.length, jax.core.Tracer):
+        if int(cache.length) + tokens.shape[1] > cache.max_len:
+            raise ValueError(
+                f"cache overflow: length {int(cache.length)} + "
+                f"{tokens.shape[1]} new tokens > max_len {cache.max_len}"
+            )
+    dt = cfg.compute_dtype
+    x = params["wte"].astype(dt)[tokens]
+    start = cache.length
+    flat = _flat_layer_params(params, cfg)
+
+    def layer_step(x, scanned):
+        lp, k_cache, v_cache = scanned
+        x, (k_cache, v_cache) = _cached_attention(
+            x, lp, k_cache, v_cache, start, cfg
+        )
+        if cfg.n_experts:
+            x = _moe_exact(x, lp, cfg)
+        else:
+            x, _ = _dense_mlp(x, lp, cfg)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(layer_step, x, (flat, cache.k, cache.v))
+    x = _rmsnorm(x, params["final_norm"], cfg)
+    logits = jnp.einsum(
+        "btd,dv->btv", x.astype(jnp.float32), params["wlm"].astype(jnp.float32)
+    )
+    new_cache = KVCache(k=new_k, v=new_v, length=start + tokens.shape[1])
+    return logits, new_cache
+
+
+def prefill(
+    params: dict,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    max_len: int,
+) -> tuple[jax.Array, KVCache]:
+    """Process the whole prompt in one pass.
+
+    tokens: [batch, prompt_len] (all positions valid).  Returns the
+    full-prompt logits ``[batch, prompt_len, vocab]`` and a cache of
+    capacity ``max_len`` holding the prompt's K/V.
+    """
+    b, t = tokens.shape
+    if t > max_len:
+        raise ValueError(f"prompt length {t} exceeds max_len {max_len}")
+    cache = KVCache.create(cfg, b, max_len)
+    return _forward_cached(params, tokens, cache, cfg)
+
+
+def decode_step(
+    params: dict, cache: KVCache, tokens: jax.Array, cfg: TransformerConfig
+) -> tuple[jax.Array, KVCache]:
+    """One autoregressive step: tokens [batch, 1] → logits [batch, vocab]."""
+    logits, cache = _forward_cached(params, tokens, cache, cfg)
+    return logits[:, -1, :], cache
+
+
+def sample_token(logits, temperature: float, key) -> jax.Array:
+    """Greedy at temperature 0 (or no key), else categorical."""
+    if temperature == 0.0 or key is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+        jnp.int32
+    )
+
+
+def generate(
+    params: dict,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt``.
+
+    prompt: [batch, prompt_len] int32.  Returns
+    ``[batch, prompt_len + max_new_tokens]``.  Jit-friendly: one prefill,
+    then a ``lax.scan`` of single-token steps over static length.
+    """
+    b, t = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
+    max_len = t + max_new_tokens
+    logits, cache = prefill(params, prompt, cfg, max_len)
+    if key is None:
+        key = jax.random.PRNGKey(0)  # temperature 0 ignores it (greedy)
+    first_key, key = jax.random.split(key)  # never reuse a consumed key
+    first = sample_token(logits[:, -1, :], temperature, first_key)
+
+    def step(carry, step_key):
+        cache, token = carry
+        logits, cache = decode_step(params, cache, token[:, None], cfg)
+        next_token = sample_token(logits, temperature, step_key)
+        return (cache, next_token), token
+
+    # `first` is generated token 1; the scan produces the remaining n-1.
+    step_keys = jax.random.split(key, max_new_tokens - 1)
+    (_, last), generated = jax.lax.scan(step, (cache, first), step_keys)
+    # ys hold each step's *input* (tokens 1..n-1); the final carry is n.
+    out = jnp.concatenate(
+        [generated.swapaxes(0, 1), last[:, None]], axis=1
+    )
+    return jnp.concatenate([prompt, out], axis=1)
+
+
+def make_generate_fn(cfg: TransformerConfig):
+    """``generate`` jitted once per (prompt-shape, max_new_tokens,
+    temperature); shard params/prompt before calling (batch over ``dp``)
+    and GSPMD propagates head/tensor sharding from the param shardings."""
+    return jax.jit(
+        partial(generate, cfg=cfg),
+        static_argnames=("max_new_tokens", "temperature"),
+    )

@@ -1,0 +1,198 @@
+"""Inference path: prefill/KV-cache/decode vs the training forward.
+
+The oracle is the train-path ``forward_local`` (shard_map, all axes size
+1): prefill must reproduce its logits exactly, and greedy cached decoding
+must match re-running the full forward over the growing sequence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.models.decode import (
+    KVCache,
+    decode_step,
+    generate,
+    make_generate_fn,
+    prefill,
+)
+from oim_tpu.models.transformer import forward_local, manual_pspecs
+from oim_tpu.parallel import build_mesh
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,  # exact oracle comparison, no kernel rounding
+)
+
+
+def _forward_logits(params, tokens, cfg):
+    """Train-path forward on a single device (all manual axes size 1)."""
+    mesh = build_mesh(devices=jax.devices()[:1])
+
+    def fn(p, t):
+        logits, _ = forward_local(p, t, cfg)
+        return logits
+
+    return jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(manual_pspecs(cfg), P("dp", "sp")),
+            out_specs=P("dp", "sp"),
+            check_vma=False,
+        )
+    )(params, tokens)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 101)
+    return cfg, params, prompt
+
+
+class TestPrefill:
+    def test_matches_training_forward(self, setup):
+        cfg, params, prompt = setup
+        logits, cache = prefill(params, prompt, cfg, max_len=16)
+        expected = _forward_logits(params, prompt, cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(expected), rtol=1e-4, atol=1e-4
+        )
+        assert int(cache.length) == 8
+        assert cache.max_len == 16
+
+    def test_prompt_longer_than_cache_rejected(self, setup):
+        cfg, params, prompt = setup
+        with pytest.raises(ValueError, match="exceeds max_len"):
+            prefill(params, prompt, cfg, max_len=4)
+
+
+class TestDecode:
+    def test_step_matches_full_forward(self, setup):
+        """A cached single-token step == full uncached forward's last row."""
+        cfg, params, prompt = setup
+        _, cache = prefill(params, prompt, cfg, max_len=16)
+        next_tok = jnp.full((2, 1), 7, jnp.int32)
+        step_logits, cache = decode_step(params, cache, next_tok, cfg)
+        assert int(cache.length) == 9
+
+        full = jnp.concatenate([prompt, next_tok], axis=1)
+        expected = _forward_logits(params, full, cfg)[:, -1, :]
+        np.testing.assert_allclose(
+            np.asarray(step_logits), np.asarray(expected), rtol=1e-4, atol=1e-4
+        )
+
+    def test_greedy_generate_matches_refeed(self, setup):
+        """Cached greedy decoding == argmax-refeed through the full
+        forward at every step (the O(T^2) no-cache oracle)."""
+        cfg, params, prompt = setup
+        n_new = 6
+        out = generate(params, prompt, cfg, max_new_tokens=n_new)
+        assert out.shape == (2, 8 + n_new)
+        np.testing.assert_array_equal(np.asarray(out[:, :8]), np.asarray(prompt))
+
+        seq = prompt
+        for _ in range(n_new):
+            logits = _forward_logits(params, seq, cfg)[:, -1, :]
+            nxt = jnp.argmax(logits, axis=-1)[:, None].astype(seq.dtype)
+            seq = jnp.concatenate([seq, nxt], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+    def test_single_new_token(self, setup):
+        cfg, params, prompt = setup
+        out = generate(params, prompt, cfg, max_new_tokens=1)
+        assert out.shape == (2, 9)
+
+    def test_sampling_deterministic_per_key(self, setup):
+        cfg, params, prompt = setup
+        key = jax.random.PRNGKey(42)
+        a = generate(params, prompt, cfg, 5, temperature=0.8, key=key)
+        b = generate(params, prompt, cfg, 5, temperature=0.8, key=key)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = generate(
+            params, prompt, cfg, 5, temperature=0.8, key=jax.random.PRNGKey(43)
+        )
+        assert a.shape == c.shape
+
+    def test_moe_decode_matches_refeed(self):
+        """With capacity ample enough that the train path drops nothing,
+        drop-free cached MoE decode == capacity-routed argmax-refeed."""
+        cfg = TransformerConfig(
+            **{**CFG, "n_experts": 4, "expert_capacity_factor": 4.0}
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, 101)
+        n_new = 3
+        out = generate(params, prompt, cfg, max_new_tokens=n_new)
+        assert out.shape == (2, 7)
+
+        seq = prompt
+        for _ in range(n_new):
+            logits = _forward_logits(params, seq, cfg)[:, -1, :]
+            nxt = jnp.argmax(logits, axis=-1)[:, None].astype(seq.dtype)
+            seq = jnp.concatenate([seq, nxt], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+    def test_zero_new_tokens_returns_prompt(self, setup):
+        cfg, params, prompt = setup
+        out = generate(params, prompt, cfg, max_new_tokens=0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+    def test_cache_overflow_rejected_eagerly(self, setup):
+        cfg, params, prompt = setup
+        _, cache = prefill(params, prompt, cfg, max_len=8)  # exactly full
+        with pytest.raises(ValueError, match="cache overflow"):
+            decode_step(params, cache, jnp.zeros((2, 1), jnp.int32), cfg)
+
+    def test_pallas_config_decodes_under_jit(self, setup):
+        """use_pallas=True configs must not lower pallas kernels in the
+        GSPMD decode path (decode gates it off internally)."""
+        _, params, prompt = setup
+        cfg = TransformerConfig(**{**CFG, "use_pallas": True})
+        gen = make_generate_fn(cfg)
+        out = gen(params, prompt, max_new_tokens=2)
+        assert out.shape == (2, 10)
+
+
+class TestShardedDecode:
+    def test_dp_sharded_generate_matches_single_device(self, setup):
+        """Jitted generate with the batch sharded over dp: same tokens."""
+        cfg, params, prompt = setup
+        single = generate(params, prompt, cfg, max_new_tokens=4)
+
+        mesh = build_mesh(dp=2)
+        gen = make_generate_fn(cfg)
+        sharded_prompt = jax.device_put(
+            prompt, NamedSharding(mesh, P("dp", None))
+        )
+        repl = jax.device_put(params, NamedSharding(mesh, P()))
+        out = gen(repl, sharded_prompt, max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(single))
+
+    def test_stacked_stages_flattened(self):
+        """Decode flattens [n_stages, layers_per_stage] — a pipeline-
+        trained checkpoint decodes without reshaping by the caller."""
+        cfg = TransformerConfig(
+            **{**CFG, "n_layers": 4, "n_stages": 2, "n_microbatches": 2}
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 4), 0, 101)
+        flat_cfg = TransformerConfig(**{**CFG, "n_layers": 4})
+        out = generate(params, prompt, cfg, max_new_tokens=3)
+        # Same weights viewed as 4 flat layers must give the same result.
+        flat_params = {
+            k: (v.reshape(1, 4, *v.shape[2:])
+                if v.ndim >= 2 and v.shape[:2] == (2, 2) else v)
+            for k, v in params.items()
+        }
+        out_flat = generate(flat_params, prompt, flat_cfg, max_new_tokens=3)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out_flat))
