@@ -1,0 +1,23 @@
+"""Input pipeline: deterministic sharded token batches + device prefetch.
+
+``TokenBatches`` deals non-overlapping corpus windows into per-process
+batch rows (resumable by step, no iterator state); ``device_prefetch``
+keeps N batches committed to devices ahead of the train loop.
+"""
+
+from oim_tpu.data.loader import (
+    ShardSpec,
+    TokenBatches,
+    split_batch,
+    window_count,
+)
+from oim_tpu.data.prefetch import device_prefetch, to_global
+
+__all__ = [
+    "ShardSpec",
+    "TokenBatches",
+    "split_batch",
+    "window_count",
+    "device_prefetch",
+    "to_global",
+]

@@ -1,0 +1,137 @@
+"""Deterministic sharded token-batch loading.
+
+The host-side feed for the training path (new work for the TPU build —
+the reference is a storage control plane with no input pipeline;
+SURVEY.md §2.3).  Design points, all TPU-driven:
+
+- **Process-sharded, deterministic.**  Every host computes the same
+  global shuffle from the same seed and takes its own disjoint slice
+  by ``(process_index, num_processes)`` — no coordination traffic on the
+  control plane, which stays "short-lived, infrequent connections"
+  (reference README.md:47-49).  Epoch reshuffles derive from
+  ``fold_in(seed, epoch)`` so any step is reproducible from (seed, step)
+  alone — that is what makes checkpoint/resume exact.
+- **Static shapes.**  Every batch is exactly ``[batch_local, seq+1]``
+  (inputs and shifted targets share the +1); ragged tails are dropped,
+  never padded — a padded tail would recompile the train step.
+- **Zero-copy friendly.**  Sources are numpy arrays / memmaps; slicing
+  produces views; the device transfer happens in the prefetcher
+  (oim_tpu.data.prefetch), not here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """Which slice of the global batch this process feeds."""
+
+    process_index: int = 0
+    num_processes: int = 1
+
+    def __post_init__(self):
+        if not 0 <= self.process_index < self.num_processes:
+            raise ValueError(
+                f"process_index {self.process_index} out of range for "
+                f"{self.num_processes} processes"
+            )
+
+
+def window_count(n_tokens: int, seq: int) -> int:
+    """Number of non-overlapping [seq+1]-token windows in a corpus."""
+    return max((n_tokens - 1) // seq, 0)
+
+
+class TokenBatches:
+    """Iterates deterministic ``[batch_local, seq+1]`` int32 batches over a
+    flat token corpus, sharded across processes.
+
+    The corpus is cut into non-overlapping windows of ``seq+1`` tokens
+    (window i covers ``[i*seq, i*seq + seq + 1)`` — adjacent windows share
+    one boundary token so every target is some window's input).  Windows
+    are shuffled per epoch, then dealt round-robin to the global batch;
+    this process materializes only rows ``process_index::num_processes``
+    of each global batch.
+    """
+
+    def __init__(
+        self,
+        tokens: np.ndarray,
+        batch_global: int,
+        seq: int,
+        shard: ShardSpec = ShardSpec(),
+        seed: int = 0,
+        epochs: int | None = None,
+    ) -> None:
+        tokens = np.asarray(tokens)
+        if tokens.ndim != 1:
+            raise ValueError(f"corpus must be 1-D, got shape {tokens.shape}")
+        if batch_global % shard.num_processes:
+            raise ValueError(
+                f"global batch {batch_global} not divisible by "
+                f"{shard.num_processes} processes"
+            )
+        self.tokens = tokens
+        self.batch_global = batch_global
+        self.batch_local = batch_global // shard.num_processes
+        self.seq = seq
+        self.shard = shard
+        self.seed = seed
+        self.epochs = epochs
+        self.n_windows = window_count(len(tokens), seq)
+        if self.n_windows < batch_global:
+            raise ValueError(
+                f"corpus has {self.n_windows} windows of seq={seq}, "
+                f"need at least batch_global={batch_global}"
+            )
+        self.steps_per_epoch = self.n_windows // batch_global
+        self._order_cache: tuple[int, np.ndarray] | None = None
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        # One-slot memo: sequential iteration calls batch_at once per step
+        # and an O(n_windows) reshuffle per *step* (vs per epoch) would
+        # compete with the batch assembly the prefetcher overlaps.
+        cached = self._order_cache
+        if cached is not None and cached[0] == epoch:
+            return cached[1]
+        rng = np.random.default_rng((self.seed, epoch))
+        order = rng.permutation(self.n_windows)
+        self._order_cache = (epoch, order)
+        return order
+
+    def batch_at(self, step: int) -> np.ndarray:
+        """The local batch for a global step (any step, random access —
+        this is the resume path: no iterator state to restore)."""
+        epoch, within = divmod(step, self.steps_per_epoch)
+        order = self._epoch_order(epoch)
+        start = within * self.batch_global
+        rows = order[
+            start
+            + self.shard.process_index : start
+            + self.batch_global : self.shard.num_processes
+        ]
+        out = np.empty((self.batch_local, self.seq + 1), np.int32)
+        for i, w in enumerate(rows):
+            out[i] = self.tokens[w * self.seq : w * self.seq + self.seq + 1]
+        return out
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        step = 0
+        while True:
+            if (
+                self.epochs is not None
+                and step >= self.epochs * self.steps_per_epoch
+            ):
+                return
+            yield self.batch_at(step)
+            step += 1
+
+
+def split_batch(batch: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """``[B, seq+1]`` → (inputs ``[B, seq]``, targets ``[B, seq]``)."""
+    return batch[:, :-1], batch[:, 1:]

@@ -13,9 +13,10 @@ in SURVEY.md §2.3's TPU-build column).  TPU-first design decisions:
   einsums over the whole prompt); each decode step appends one position
   via ``dynamic_update_slice``.
 - **GSPMD, not shard_map.**  Decode has no sequence axis to parallelize
-  (t=1), so inference shards batch over ``dp`` and heads over ``tp`` with
-  sharding constraints and lets XLA insert the collectives — the
-  train-path manual axes (sp ring, pp pipeline) don't apply.
+  (t=1), so inference relies on sharding *propagation*: shard the params
+  (and the prompt's batch over ``dp``) before calling and XLA propagates
+  head/tensor sharding through the cache and inserts the collectives —
+  the train-path manual axes (sp ring, pp pipeline) don't apply.
 - bf16 activations with f32 logits/softmax, matching the train path.
 
 Weights are the training checkpoints unchanged (same stacked
@@ -34,6 +35,7 @@ from oim_tpu.models.transformer import (
     TransformerConfig,
     _dense_mlp,
     _rmsnorm,
+    _switch_moe,
 )
 from oim_tpu.ops.rope import apply_rope
 
@@ -125,12 +127,13 @@ def _cached_attention(x, lp, k_cache, v_cache, start, cfg: TransformerConfig):
 
 
 def _moe_exact(x, lp, cfg: TransformerConfig):
-    """Inference MoE: every token runs through its argmax expert, no
-    capacity dropping.  Train-time ``_switch_moe`` drops tokens past a
-    capacity computed from the *whole* call's token count, which would
-    make cached t=1 decoding route differently from the full forward;
-    standard practice (and this path) is drop-free routing at inference.
-    Computes all experts per token — fine at decode scale (b·1 tokens)."""
+    """Drop-free MoE for single-token decode steps: every token runs
+    through its argmax expert.  Computes all experts per token, which is
+    E× the needed FLOPs — acceptable only at t=1 scale, so *prefill*
+    (whole prompt) instead reuses the train-path ``_switch_moe`` (same
+    capacity semantics as the training forward, hence exact agreement
+    with it), and this path handles the incremental steps where capacity
+    bookkeeping over a 1-token call would misroute."""
     b, t, d = x.shape
     normed = _rmsnorm(x, lp["mlp_norm"], cfg).reshape(b * t, d)
     router_logits = jnp.einsum(
@@ -174,7 +177,10 @@ def _forward_cached(params, tokens, cache: KVCache, cfg: TransformerConfig):
             x, lp, k_cache, v_cache, start, cfg
         )
         if cfg.n_experts:
-            x = _moe_exact(x, lp, cfg)
+            if tokens.shape[1] == 1:
+                x = _moe_exact(x, lp, cfg)
+            else:  # prefill: train-path capacity routing, MXU dispatch
+                x, _ = _switch_moe(x, lp, cfg)
         else:
             x, _ = _dense_mlp(x, lp, cfg)
         return x, (k_cache, v_cache)
@@ -241,10 +247,15 @@ def generate(
     b, t = prompt.shape
     if max_new_tokens <= 0:
         return prompt
+    if temperature != 0.0 and key is None:
+        raise ValueError(
+            "temperature > 0 requires an explicit PRNG key; a silent "
+            "default would make every call return identical samples"
+        )
     max_len = t + max_new_tokens
     logits, cache = prefill(params, prompt, cfg, max_len)
     if key is None:
-        key = jax.random.PRNGKey(0)  # temperature 0 ignores it (greedy)
+        key = jax.random.PRNGKey(0)  # greedy path: key is never consumed
     first_key, key = jax.random.split(key)  # never reuse a consumed key
     first = sample_token(logits[:, -1, :], temperature, first_key)
 

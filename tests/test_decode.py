@@ -142,6 +142,11 @@ class TestDecode:
             seq = jnp.concatenate([seq, nxt], axis=1)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
+    def test_sampling_without_key_rejected(self, setup):
+        cfg, params, prompt = setup
+        with pytest.raises(ValueError, match="requires an explicit PRNG"):
+            generate(params, prompt, cfg, 3, temperature=0.7)
+
     def test_zero_new_tokens_returns_prompt(self, setup):
         cfg, params, prompt = setup
         out = generate(params, prompt, cfg, max_new_tokens=0)
