@@ -150,9 +150,19 @@ def _moe_exact(x, lp, cfg: TransformerConfig):
     return x + out.reshape(b, t, d).astype(x.dtype)
 
 
-def _forward_cached(params, tokens, cache: KVCache, cfg: TransformerConfig):
+def _forward_cached(
+    params,
+    tokens,
+    cache: KVCache,
+    cfg: TransformerConfig,
+    is_prefill: bool = False,
+):
     """Run ``tokens`` (global positions cache.length..+t) through all
-    layers, reading and extending the cache.  Returns (logits, cache)."""
+    layers, reading and extending the cache.  Returns (logits, cache).
+
+    ``is_prefill`` selects MoE routing: prefill uses the train-path
+    capacity routing (exact agreement with the training forward, even for
+    1-token prompts); incremental steps use drop-free argmax routing."""
     # Inference runs under GSPMD auto-partitioning where pallas (Mosaic)
     # kernels cannot sit (same constraint train.py gates on); XLA fuses
     # the reference rmsnorm anyway at t=1.
@@ -177,10 +187,10 @@ def _forward_cached(params, tokens, cache: KVCache, cfg: TransformerConfig):
             x, lp, k_cache, v_cache, start, cfg
         )
         if cfg.n_experts:
-            if tokens.shape[1] == 1:
-                x = _moe_exact(x, lp, cfg)
-            else:  # prefill: train-path capacity routing, MXU dispatch
+            if is_prefill:  # train-path capacity routing, MXU dispatch
                 x, _ = _switch_moe(x, lp, cfg)
+            else:
+                x = _moe_exact(x, lp, cfg)
         else:
             x, _ = _dense_mlp(x, lp, cfg)
         return x, (k_cache, v_cache)
@@ -210,7 +220,7 @@ def prefill(
     if t > max_len:
         raise ValueError(f"prompt length {t} exceeds max_len {max_len}")
     cache = KVCache.create(cfg, b, max_len)
-    return _forward_cached(params, tokens, cache, cfg)
+    return _forward_cached(params, tokens, cache, cfg, is_prefill=True)
 
 
 def decode_step(

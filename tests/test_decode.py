@@ -142,6 +142,21 @@ class TestDecode:
             seq = jnp.concatenate([seq, nxt], axis=1)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
+    def test_moe_one_token_prompt_prefill_matches_forward(self):
+        """A 1-token prompt is still *prefill*: with tight capacity all
+        rows race for one expert's slots and the train-path routing must
+        apply (drop-free routing would diverge from forward_local)."""
+        cfg = TransformerConfig(
+            **{**CFG, "n_experts": 4, "expert_capacity_factor": 1.0}
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((8, 1), jnp.int32)  # all rows identical → 1 expert
+        logits, _ = prefill(params, prompt, cfg, max_len=4)
+        expected = _forward_logits(params, prompt, cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(expected), rtol=1e-4, atol=1e-4
+        )
+
     def test_sampling_without_key_rejected(self, setup):
         cfg, params, prompt = setup
         with pytest.raises(ValueError, match="requires an explicit PRNG"):

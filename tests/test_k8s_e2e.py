@@ -1,0 +1,511 @@
+"""Tier-4 e2e analog: kubelet-sim over the real deploy manifests.
+
+The reference's tier 4 boots a QEMU/kubeadm cluster and lets kubelet
+drive the manifest-deployed driver (reference test/e2e, clear-kvm.make).
+No VM exists in this environment, so the same idea becomes:
+
+1. parse the REAL ``deploy/kubernetes/*.yaml`` (not copies),
+2. materialize every container command as a real local process — volumes
+   become tmpdirs (kubelet's volume plugin), ``fieldRef`` env becomes
+   simulated node facts, ``@OIM_REGISTRY_ADDRESS@`` is substituted exactly
+   the way the reference substitutes it into manifests
+   (reference test/e2e/storage/csi_volumes.go:288-300), and the image
+   binaries map to this repo's entry points,
+3. play kubelet + the CSI sidecars: drive the driver's Unix socket
+   through the provisioner/kubelet call sequence
+   (CreateVolume → NodeStage → NodePublish → … → DeleteVolume),
+4. run the example workload pod's *actual command* against the published
+   volume, as the pod's container would.
+
+Structural manifest validation (the YAML must actually wire together)
+runs first and needs no processes.
+"""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+
+import grpc
+import pytest
+import yaml
+
+from oim_tpu.common.ca import CertAuthority
+from oim_tpu.spec import CSI_CONTROLLER, CSI_IDENTITY, CSI_NODE, csi_pb2
+from tests.test_agent_protocol import NATIVE_BINARY, _build_native
+
+DEPLOY = os.path.join(os.path.dirname(__file__), "..", "deploy", "kubernetes")
+
+NODE_NAME = "node-1"
+NODE_IP = "127.0.0.1"
+
+
+def load_manifest(name):
+    with open(os.path.join(DEPLOY, name)) as f:
+        return [doc for doc in yaml.safe_load_all(f) if doc]
+
+
+def by_kind(docs, kind):
+    return [d for d in docs if d.get("kind") == kind]
+
+
+# ---------------------------------------------------------------------------
+# Structural validation: the manifests must wire together.
+
+
+class TestManifests:
+    def test_all_manifests_parse(self):
+        for name in os.listdir(DEPLOY):
+            if name.endswith(".yaml"):
+                docs = load_manifest(name)
+                assert docs, name
+                for doc in docs:
+                    assert "kind" in doc and "apiVersion" in doc, name
+
+    def test_daemonset_volume_mounts_resolve(self):
+        (ds,) = by_kind(load_manifest("tpu-daemonset.yaml"), "DaemonSet")
+        spec = ds["spec"]["template"]["spec"]
+        declared = {v["name"] for v in spec["volumes"]}
+        for container in spec["containers"]:
+            for mount in container.get("volumeMounts", []):
+                assert mount["name"] in declared, (
+                    f"{container['name']} mounts undeclared {mount['name']}"
+                )
+
+    def test_storageclass_provisioner_matches_csidriver(self):
+        (sc,) = by_kind(load_manifest("storageclass.yaml"), "StorageClass")
+        (drv,) = by_kind(load_manifest("csi-driver.yaml"), "CSIDriver")
+        assert sc["provisioner"] == drv["metadata"]["name"] == "tpu.oim.io"
+
+    def test_registrar_path_matches_csi_socket_hostpath(self):
+        """The registrar advertises the socket kubelet will find on the
+        host — the csi-sock hostPath + the in-container socket name."""
+        (ds,) = by_kind(load_manifest("tpu-daemonset.yaml"), "DaemonSet")
+        spec = ds["spec"]["template"]["spec"]
+        host_path = next(
+            v["hostPath"]["path"]
+            for v in spec["volumes"]
+            if v["name"] == "csi-sock"
+        )
+        registrar = next(
+            c for c in spec["containers"]
+            if c["name"] == "node-driver-registrar"
+        )
+        reg_path = next(
+            a for a in registrar["args"]
+            if a.startswith("--kubelet-registration-path=")
+        ).split("=", 1)[1]
+        driver = next(
+            c for c in spec["containers"] if c["name"] == "csi-driver"
+        )
+        endpoint = next(
+            a for a in driver["command"] if a.startswith("--endpoint=")
+        ).split("=", 1)[1]
+        sock_name = os.path.basename(endpoint)
+        assert reg_path == os.path.join(host_path, sock_name)
+
+    def test_daemonset_serviceaccount_defined_with_provisioner_rbac(self):
+        (ds,) = by_kind(load_manifest("tpu-daemonset.yaml"), "DaemonSet")
+        sa_name = ds["spec"]["template"]["spec"]["serviceAccountName"]
+        rbac = load_manifest("rbac.yaml")
+        sas = by_kind(rbac, "ServiceAccount")
+        assert any(sa["metadata"]["name"] == sa_name for sa in sas)
+        rules = [
+            rule
+            for role in by_kind(rbac, "ClusterRole")
+            for rule in role.get("rules", [])
+        ]
+        pv_verbs = {
+            verb
+            for rule in rules
+            if "persistentvolumes" in rule.get("resources", [])
+            for verb in rule["verbs"]
+        }
+        assert {"create", "delete"} <= pv_verbs
+
+    def test_registry_service_matches_deployment(self):
+        docs = load_manifest("registry.yaml")
+        (dep,) = by_kind(docs, "Deployment")
+        (svc,) = by_kind(docs, "Service")
+        container = dep["spec"]["template"]["spec"]["containers"][0]
+        port = container["ports"][0]["containerPort"]
+        assert svc["spec"]["ports"][0]["targetPort"] == port
+        endpoint = next(
+            a for a in container["command"] if a.startswith("--endpoint=")
+        )
+        assert endpoint.endswith(f":{port}")
+        assert svc["spec"]["selector"] == (
+            dep["spec"]["template"]["metadata"]["labels"]
+        )
+
+    def test_example_workload_wiring(self):
+        docs = load_manifest("example-workload.yaml")
+        (pvc,) = by_kind(docs, "PersistentVolumeClaim")
+        (pod,) = by_kind(docs, "Pod")
+        (sc,) = by_kind(load_manifest("storageclass.yaml"), "StorageClass")
+        assert pvc["spec"]["storageClassName"] == sc["metadata"]["name"]
+        pod_volume = pod["spec"]["volumes"][0]
+        assert (
+            pod_volume["persistentVolumeClaim"]["claimName"]
+            == pvc["metadata"]["name"]
+        )
+        container = pod["spec"]["containers"][0]
+        mount_path = container["volumeMounts"][0]["mountPath"]
+        bootstrap_env = next(
+            e["value"] for e in container["env"] if e["name"] == "TPU_BOOTSTRAP"
+        )
+        assert bootstrap_env.startswith(mount_path + "/")
+
+    def test_controller_registers_with_placeholder_registry(self):
+        """Deployments substitute @OIM_REGISTRY_ADDRESS@ (reference
+        csi_volumes.go:288-300); the manifests must carry the marker."""
+        text = open(os.path.join(DEPLOY, "tpu-daemonset.yaml")).read()
+        assert text.count("@OIM_REGISTRY_ADDRESS@") >= 2  # controller + csi
+
+
+# ---------------------------------------------------------------------------
+# Kubelet-sim: run the manifests' processes and drive the CSI socket.
+
+
+BINARY_MAP = {
+    "tpu-agent": [os.path.abspath(NATIVE_BINARY)],
+    "/usr/local/bin/tpu-agent": [os.path.abspath(NATIVE_BINARY)],
+    "oim-registry": [sys.executable, "-m", "oim_tpu.cli.registry_main"],
+    "oim-controller": [sys.executable, "-m", "oim_tpu.cli.controller_main"],
+    "oim-csi-driver": [sys.executable, "-m", "oim_tpu.cli.csi_main"],
+    "python": [sys.executable],
+}
+
+SIDECARS = {"node-driver-registrar", "csi-provisioner"}  # upstream images
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class PodSim:
+    """One manifest container materialized as a local process."""
+
+    def __init__(self, container, volume_dirs, env, substitutions, cwd):
+        argv = list(container.get("command", [])) + list(
+            container.get("args", [])
+        )
+        self.name = container["name"]
+        mounts = {
+            m["mountPath"]: volume_dirs[m["name"]]
+            for m in container.get("volumeMounts", [])
+        }
+        rewritten = []
+        for token in argv:
+            token = re.sub(
+                r"\$\(([A-Z_]+)\)", lambda m: env[m.group(1)], token
+            )
+            for needle, replacement in substitutions.items():
+                token = token.replace(needle, replacement)
+            # Kubelet's volume plugin: container paths → host dirs
+            # (longest mountPath wins, as nested mounts do; boundary-aware
+            # so /csi does not also rewrite the /csi inside /csi/csi.sock).
+            for mount_path in sorted(mounts, key=len, reverse=True):
+                token = re.sub(
+                    re.escape(mount_path) + r"(?=/|$)",
+                    mounts[mount_path].replace("\\", r"\\"),
+                    token,
+                )
+            rewritten.append(token)
+        self.argv = BINARY_MAP[rewritten[0]] + rewritten[1:]
+        self.cwd = cwd
+        self.proc = None
+
+    def start(self, extra_env=None):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+        env.update(extra_env or {})
+        # File-backed output: PIPE on a long-lived undrained process
+        # deadlocks the child once it writes a pipe buffer's worth.
+        self._log_path = os.path.join(self.cwd, f"{self.name}.log")
+        self._log = open(self._log_path, "wb")
+        self.proc = subprocess.Popen(
+            self.argv,
+            cwd=self.cwd,
+            env=env,
+            stdout=self._log,
+            stderr=subprocess.STDOUT,
+        )
+        return self
+
+    def stop(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        if self.proc:
+            self._log.close()
+
+    def output(self):
+        if not self.proc:
+            return ""
+        self._log.flush()
+        with open(self._log_path, "rb") as f:
+            return f.read().decode(errors="replace")
+
+
+def _wait_for_unix_socket(path, procs, timeout=15):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                probe.connect(path)
+                probe.close()
+                return
+            except OSError:
+                probe.close()
+        for p in procs:
+            if p.proc.poll() is not None:
+                raise AssertionError(
+                    f"{p.name} exited {p.proc.returncode}:\n{p.output()}"
+                )
+        time.sleep(0.05)
+    raise AssertionError(f"{path} never came up")
+
+
+@pytest.fixture(scope="class")
+def cluster(request, tmp_path_factory):
+    if not _build_native():
+        pytest.skip("native toolchain unavailable")
+    root = tmp_path_factory.mktemp("k8s-sim")
+    registry_port = _free_port()
+    controller_port = _free_port()
+
+    # The oim-ca Secret, as deploy/kubernetes/README.md says to create it.
+    certs = str(root / "certs")
+    CertAuthority().write_tree(
+        certs,
+        [
+            "component.registry",
+            f"controller.{NODE_NAME}",
+            f"host.{NODE_NAME}",
+            "user.admin",
+        ],
+    )
+
+    env = {"NODE_NAME": NODE_NAME, "NODE_IP": NODE_IP}
+    substitutions = {
+        "@OIM_REGISTRY_ADDRESS@": f"tcp://127.0.0.1:{registry_port}",
+        "tcp://0.0.0.0:8999": f"tcp://127.0.0.1:{registry_port}",
+        "tcp://0.0.0.0:8998": f"tcp://127.0.0.1:{controller_port}",
+        f"tcp://{NODE_IP}:8998": f"tcp://127.0.0.1:{controller_port}",
+    }
+
+    # Volumes → host dirs (the "kubelet volume plugin").
+    def materialize_volumes(spec, prefix):
+        dirs = {}
+        for volume in spec["volumes"]:
+            d = root / f"{prefix}-{volume['name']}"
+            d.mkdir(exist_ok=True)
+            if "secret" in volume and volume["secret"]["secretName"] == "oim-ca":
+                dirs[volume["name"]] = certs
+            else:
+                dirs[volume["name"]] = str(d)
+        return dirs
+
+    procs = []
+
+    # -- registry Deployment
+    (reg_dep,) = by_kind(load_manifest("registry.yaml"), "Deployment")
+    reg_spec = reg_dep["spec"]["template"]["spec"]
+    reg_vols = materialize_volumes(reg_spec, "registry")
+    for container in reg_spec["containers"]:
+        procs.append(
+            PodSim(container, reg_vols, env, substitutions, str(root)).start()
+        )
+
+    # -- node DaemonSet (one simulated node)
+    (ds,) = by_kind(load_manifest("tpu-daemonset.yaml"), "DaemonSet")
+    ds_spec = ds["spec"]["template"]["spec"]
+    ds_vols = materialize_volumes(ds_spec, "node")
+    # The hostPath /dev of the simulated node: 4 fake accel device files
+    # (the reference substitutes hardware the same way: Malloc BDevs for
+    # real disks, spec.md:119-122).
+    for i in range(4):
+        with open(os.path.join(ds_vols["dev"], f"accel{i}"), "w") as f:
+            f.write(f"sim-chip {i}\n")
+    for container in ds_spec["containers"]:
+        if container["name"] in SIDECARS:
+            continue  # upstream images; their role is played by KubeletSim
+        procs.append(
+            PodSim(container, ds_vols, env, substitutions, str(root)).start()
+        )
+
+    csi_sock = os.path.join(ds_vols["csi-sock"], "csi.sock")
+    agent_sock = os.path.join(ds_vols["agent-sock"], "agent.sock")
+    try:
+        _wait_for_unix_socket(agent_sock, procs)
+        _wait_for_unix_socket(csi_sock, procs)
+        # Controller must have self-registered before CSI calls route.
+        time.sleep(1.0)
+        yield {
+            "csi_sock": csi_sock,
+            "pods_dir": ds_vols["mountpoint-dir"],
+            "plugins_dir": ds_vols["csi-sock"],
+            "root": str(root),
+            "procs": procs,
+        }
+    finally:
+        for p in procs:
+            p.stop()
+
+
+@pytest.mark.usefixtures("cluster")
+class TestKubeletSim:
+    """The call sequence kubelet + the CSI sidecars perform, in order."""
+
+    @pytest.fixture(autouse=True)
+    def _attach(self, cluster):
+        self.cluster = cluster
+        self.channel = grpc.insecure_channel(f"unix:{cluster['csi_sock']}")
+        yield
+        self.channel.close()
+
+    def test_01_identity_and_node_info(self):
+        identity = CSI_IDENTITY.stub(self.channel)
+        info = identity.GetPluginInfo(csi_pb2.GetPluginInfoRequest())
+        assert info.name == "tpu.oim.io"  # == CSIDriver/StorageClass name
+        node = CSI_NODE.stub(self.channel)
+        node_info = node.NodeGetInfo(csi_pb2.NodeGetInfoRequest())
+        assert node_info.node_id == NODE_NAME
+
+    def test_02_full_volume_lifecycle_with_workload(self):
+        cluster = self.cluster
+        (sc,) = by_kind(load_manifest("storageclass.yaml"), "StorageClass")
+        docs = load_manifest("example-workload.yaml")
+        (pvc,) = by_kind(docs, "PersistentVolumeClaim")
+        (pod,) = by_kind(docs, "Pod")
+
+        controller = CSI_CONTROLLER.stub(self.channel)
+        node = CSI_NODE.stub(self.channel)
+
+        # external-provisioner: CreateVolume from the PVC + StorageClass.
+        volume_name = f"pvc-{pvc['metadata']['name']}"
+        created = controller.CreateVolume(
+            csi_pb2.CreateVolumeRequest(
+                name=volume_name,
+                parameters=sc["parameters"],
+                capacity_range=csi_pb2.CapacityRange(
+                    required_bytes=int(
+                        pvc["spec"]["resources"]["requests"]["storage"]
+                    )
+                ),
+                volume_capabilities=[
+                    csi_pb2.VolumeCapability(
+                        mount=csi_pb2.VolumeCapability.MountVolume(),
+                        access_mode=csi_pb2.VolumeCapability.AccessMode(
+                            mode=csi_pb2.VolumeCapability.AccessMode
+                            .SINGLE_NODE_WRITER
+                        ),
+                    )
+                ],
+            )
+        )
+        volume_id = created.volume.volume_id
+        assert created.volume.volume_context["chipCount"] == "4"
+
+        # kubelet: NodeStageVolume into the plugins staging dir...
+        staging = os.path.join(
+            cluster["plugins_dir"], volume_id, "globalmount"
+        )
+        os.makedirs(staging, exist_ok=True)
+        capability = csi_pb2.VolumeCapability(
+            mount=csi_pb2.VolumeCapability.MountVolume(),
+            access_mode=csi_pb2.VolumeCapability.AccessMode(
+                mode=csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER
+            ),
+        )
+        node.NodeStageVolume(
+            csi_pb2.NodeStageVolumeRequest(
+                volume_id=volume_id,
+                staging_target_path=staging,
+                volume_capability=capability,
+                volume_context=created.volume.volume_context,
+            )
+        )
+        assert os.path.exists(os.path.join(staging, "tpu-bootstrap.json"))
+
+        # ... then NodePublishVolume into the pod's volume dir.
+        pod_dir = os.path.join(
+            cluster["pods_dir"],
+            "pod-uid-0001",
+            "volumes",
+            "kubernetes.io~csi",
+            volume_name,
+            "mount",
+        )
+        node.NodePublishVolume(
+            csi_pb2.NodePublishVolumeRequest(
+                volume_id=volume_id,
+                staging_target_path=staging,
+                target_path=pod_dir,
+                volume_capability=capability,
+                volume_context=created.volume.volume_context,
+            )
+        )
+        bootstrap_path = os.path.join(pod_dir, "tpu-bootstrap.json")
+        assert os.path.exists(bootstrap_path)
+        bootstrap = json.load(open(bootstrap_path))
+        assert len(bootstrap["chips"]) == 4
+        assert bootstrap["coordinator_address"]
+
+        # The pod runs: execute the example workload's actual command
+        # with the published volume at its mount path (via TPU_BOOTSTRAP,
+        # since the sim has no mount namespace to remap /tpu).
+        container = pod["spec"]["containers"][0]
+        workload = PodSim(
+            container,
+            {"tpu": os.path.dirname(pod_dir)},
+            {},
+            {},
+            cluster["root"],
+        )
+        workload.argv = [
+            arg.replace("/tpu/", pod_dir + "/") for arg in workload.argv
+        ]
+        workload.start(
+            extra_env={
+                "TPU_BOOTSTRAP": bootstrap_path,
+                "PALLAS_AXON_POOL_IPS": "",
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            }
+        )
+        assert workload.proc.wait(timeout=240) == 0, workload.output()
+        out = workload.output()
+        assert "gbps_per_chip" in out, out
+
+        # Teardown in kubelet order; all idempotent.
+        node.NodeUnpublishVolume(
+            csi_pb2.NodeUnpublishVolumeRequest(
+                volume_id=volume_id, target_path=pod_dir
+            )
+        )
+        assert not os.path.exists(bootstrap_path)
+        node.NodeUnstageVolume(
+            csi_pb2.NodeUnstageVolumeRequest(
+                volume_id=volume_id, staging_target_path=staging
+            )
+        )
+        controller.DeleteVolume(
+            csi_pb2.DeleteVolumeRequest(volume_id=volume_id)
+        )
+        # external-provisioner retries are idempotent:
+        controller.DeleteVolume(
+            csi_pb2.DeleteVolumeRequest(volume_id=volume_id)
+        )
