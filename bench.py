@@ -109,14 +109,22 @@ def _run(tmp: str, agent_sock: str, cleanups: list) -> int:
     import jax
     import jax.numpy as jnp
 
+    from oim_tpu import log as oim_log
     from oim_tpu.controller import Controller
     from oim_tpu.csi import OIMDriver
     from oim_tpu.registry import Registry
     from oim_tpu.spec import CSI_CONTROLLER, CSI_NODE, csi_pb2
 
+    # Production deployments run at -log-level info too, but the info
+    # stream is per-RPC payload logging to stderr — measuring it would
+    # time the terminal, not the control plane.  warn matches what a
+    # latency-sensitive deployment would configure.
+    oim_log.init_from_string(os.environ.get("OIM_BENCH_LOG", "warning"))
+
     registry = Registry()
     reg_srv = registry.start_server("tcp://127.0.0.1:0")
     cleanups.append(reg_srv.stop)
+    cleanups.append(registry.close)
     controller = Controller(
         "bench-host", agent_sock, registry_address=str(reg_srv.addr()),
         registry_delay=30.0,
@@ -132,6 +140,7 @@ def _run(tmp: str, agent_sock: str, cleanups: list) -> int:
     )
     csi_srv = driver.start_server()
     cleanups.append(csi_srv.stop)
+    cleanups.append(driver.close)
     channel = grpc.insecure_channel(csi_srv.addr().grpc_target())
     cleanups.append(channel.close)
     csi_controller = CSI_CONTROLLER.stub(channel)

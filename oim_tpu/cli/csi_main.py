@@ -74,6 +74,8 @@ def main(argv=None) -> int:
         server.wait()
     except KeyboardInterrupt:
         server.stop()
+    finally:
+        driver.close()
     return 0
 
 

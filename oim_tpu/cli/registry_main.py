@@ -76,6 +76,8 @@ def main(argv=None) -> int:
         server.stop()
         if etcd_server is not None:
             etcd_server.stop()
+    finally:
+        registry.close()
     return 0
 
 

@@ -20,6 +20,7 @@ from oim_tpu import log
 from oim_tpu.agent import Agent, AgentError, ENODEV, ENOSPC, EEXIST
 from oim_tpu.common import endpoint as ep
 from oim_tpu.common import pci as pcilib
+from oim_tpu.common.chancache import ChannelCache, RECONNECT_OPTIONS
 from oim_tpu.common.tlsconfig import TLSConfig
 from oim_tpu.csi import rendezvous
 from oim_tpu.spec import CONTROLLER, REGISTRY, oim_pb2
@@ -278,9 +279,11 @@ class RemoteBackend:
     """Routes through the registry proxy to a controller (≙ remoteSPDK,
     reference remote.go:33-42).
 
-    Dials the registry per call — TLS material is (re)loaded through
-    ``tls_loader`` on every dial, so rotated keys are picked up without a
-    restart (≙ remote.go:101-114).
+    TLS material is (re)loaded through ``tls_loader`` on every call, so
+    rotated keys are picked up without a restart (≙ remote.go:101-114) —
+    but the *channel* is reused while the material and target stay
+    unchanged (oim_tpu.common.chancache), dropping the reference's
+    per-call TCP+TLS handshake from the NodeStage hot path.
     """
 
     def __init__(
@@ -299,15 +302,37 @@ class RemoteBackend:
         # controller id doubles as the host id (it is also what the host's
         # TLS CN ``host.<id>`` pins, so the registry authz lines up).
         self.rendezvous_timeout = rendezvous_timeout
+        self._channels = ChannelCache()
+
+        # Rendezvous channel factory: cache-backed, so rendezvous must not
+        # close what it yields (see rendezvous.join's ownership contract).
+        def registry_factory():
+            return self._channel()
+
+        registry_factory.owns_channels = True
+        self._registry_factory = registry_factory
 
     def _channel(self) -> grpc.Channel:
+        # A restarted registry at the same address is handled by gRPC's
+        # own reconnect (bounded by RECONNECT_OPTIONS); rotated TLS
+        # material or a changed address re-dials via the fingerprint.
         target = ep.parse(self.registry_address).grpc_target()
         if self.tls_loader is not None:
             tls = self.tls_loader().with_peer("component.registry")
-            return grpc.secure_channel(
-                target, tls.channel_credentials(), options=tls.channel_options()
+            return self._channels.get(
+                "registry",
+                (target, tls.ca_pem, tls.cert_pem, tls.key_pem),
+                lambda: grpc.secure_channel(
+                    target,
+                    tls.channel_credentials(),
+                    options=tls.channel_options() + RECONNECT_OPTIONS,
+                ),
             )
-        return grpc.insecure_channel(target)
+        return self._channels.get(
+            "registry",
+            (target, None),
+            lambda: grpc.insecure_channel(target, options=RECONNECT_OPTIONS),
+        )
 
     def _metadata(self) -> tuple:
         # Proxy routing key (≙ remote.go:78).
@@ -319,8 +344,9 @@ class RemoteBackend:
             return fn(channel)
         except grpc.RpcError as exc:
             raise VolumeError(exc.code(), exc.details()) from exc
-        finally:
-            channel.close()
+
+    def close(self) -> None:
+        self._channels.close()
 
     def provision(self, volume_id: str, chip_count: int) -> int:
         def run(channel):
@@ -429,7 +455,7 @@ class RemoteBackend:
                 timeout = min(timeout, max(deadline - time.monotonic(), 0.1))
             try:
                 placement = rendezvous.join(
-                    self._channel,
+                    self._registry_factory,
                     volume_id,
                     self.controller_id,
                     staged.coordinator_address,
@@ -453,4 +479,6 @@ class RemoteBackend:
             )
 
         self._call(run)
-        rendezvous.withdraw(self._channel, volume_id, self.controller_id)
+        rendezvous.withdraw(
+            self._registry_factory, volume_id, self.controller_id
+        )

@@ -131,3 +131,9 @@ class OIMDriver:
         )
         srv.start(*registrars)
         return srv
+
+    def close(self) -> None:
+        """Release backend resources (cached channels, agent sockets)."""
+        close = getattr(self.backend, "close", None)
+        if close is not None:
+            close()

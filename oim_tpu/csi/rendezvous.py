@@ -129,10 +129,15 @@ def join(
 ) -> Placement:
     """Publish this host's candidate and wait for all ``num_hosts`` peers.
 
-    ``channel_factory`` yields a fresh registry channel per dial (per-call
-    connections survive registry restarts mid-rendezvous, ≙ reference
-    remote.go:101-114); the publish re-runs every iteration so a restarted
-    in-memory registry is repopulated, not just re-dialed.
+    ``channel_factory`` yields a registry channel per iteration.  A plain
+    factory's channels are closed here after each iteration (per-call
+    connections, ≙ reference remote.go:101-114); a factory that manages
+    its own channels (oim_tpu.common.chancache) marks itself with
+    ``owns_channels = True`` and relies on gRPC reconnect (bounded by
+    chancache.RECONNECT_OPTIONS) across registry restarts — either way
+    rendezvous survives a restart mid-wait, and the publish re-runs
+    every iteration so a restarted in-memory registry is repopulated,
+    not just re-dialed.
 
     ``members``, when given (the volume's declared ``hosts`` parameter),
     fixes the membership: foreign or stale entries from hosts outside the
@@ -154,6 +159,7 @@ def join(
             f"host {host_id!r} is not in the volume's declared hosts "
             f"{sorted(members)}",
         )
+    factory_owns = getattr(channel_factory, "owns_channels", False)
     deadline = time.monotonic() + timeout
     cleared_stale = committed = False
     coordinator = ""
@@ -216,7 +222,8 @@ def join(
                 error=exc.code().name,
             )
         finally:
-            channel.close()
+            if not factory_owns:
+                channel.close()
         if time.monotonic() >= deadline:
             raise RendezvousError(
                 grpc.StatusCode.DEADLINE_EXCEEDED,
@@ -242,7 +249,8 @@ def withdraw(channel_factory, volume_id: str, host_id: str) -> None:
     """Remove this host's key on unstage; the last host out also clears the
     committed coordinator so the volume leaves no KV rows behind.
     Best-effort (the volume may already be gone, or the registry briefly
-    down — a later stage overwrites whatever remains)."""
+    down — a later stage overwrites whatever remains).  Factories marked
+    ``owns_channels`` keep their channel; plain factories' are closed."""
     if not host_id:
         return
     channel = channel_factory()
@@ -256,4 +264,5 @@ def withdraw(channel_factory, volume_id: str, host_id: str) -> None:
             "rendezvous withdraw failed", volume=volume_id, error=exc.code().name
         )
     finally:
-        channel.close()
+        if not getattr(channel_factory, "owns_channels", False):
+            channel.close()

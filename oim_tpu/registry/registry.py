@@ -21,6 +21,7 @@ import grpc
 from oim_tpu import log
 from oim_tpu.common import endpoint as ep
 from oim_tpu.common import pathutil
+from oim_tpu.common.chancache import ChannelCache, RECONNECT_OPTIONS
 from oim_tpu.common.interceptors import LogServerInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.tlsconfig import TLSConfig, peer_common_name
@@ -46,6 +47,11 @@ class Registry:
         self.db = db if db is not None else MemRegistryDB()
         self.tls = tls
         self.proxy_dial_timeout = proxy_dial_timeout
+        # Proxy channels are reused across calls keyed on the controller's
+        # *registered address* — a re-registration at a new address
+        # re-dials, so the reference's dial-per-call routing behavior
+        # (registry.go:186-210) is preserved without its handshake cost.
+        self._proxy_channels = ChannelCache()
 
     # -- KV service --------------------------------------------------------
 
@@ -143,12 +149,26 @@ class Registry:
                 f"no address registered for controller {controller_id!r}",
             )
         target = ep.parse(address).grpc_target()
+        # A moved controller re-registers at a new address → fingerprint
+        # change → re-dial; a *restarted* controller at the same address
+        # is handled by gRPC's own reconnect (bounded by
+        # RECONNECT_OPTIONS), so no invalidation path is needed.
         if self.tls is not None:
             tls = self.tls.with_peer(f"{CONTROLLER_CN_PREFIX}{controller_id}")
-            return grpc.secure_channel(
-                target, tls.channel_credentials(), options=tls.channel_options()
+            return self._proxy_channels.get(
+                controller_id,
+                (target, tls.ca_pem, tls.cert_pem, tls.key_pem),
+                lambda: grpc.secure_channel(
+                    target,
+                    tls.channel_credentials(),
+                    options=tls.channel_options() + RECONNECT_OPTIONS,
+                ),
             )
-        return grpc.insecure_channel(target)
+        return self._proxy_channels.get(
+            controller_id,
+            (target, None),
+            lambda: grpc.insecure_channel(target, options=RECONNECT_OPTIONS),
+        )
 
     def _proxy_behavior(self, method: str):
         def behavior(request_iterator, context) -> Iterator[bytes]:
@@ -163,24 +183,28 @@ class Registry:
             with log.with_fields(method=method, controllerid=controller_id):
                 log.current().debug("proxying")
                 channel = self._connect(controller_id, context)
+                call = channel.stream_stream(
+                    method,
+                    request_serializer=_ident,
+                    response_deserializer=_ident,
+                )(
+                    request_iterator,
+                    timeout=context.time_remaining(),
+                    metadata=context.invocation_metadata(),
+                )
                 try:
-                    call = channel.stream_stream(
-                        method,
-                        request_serializer=_ident,
-                        response_deserializer=_ident,
-                    )(
-                        request_iterator,
-                        timeout=context.time_remaining(),
-                        metadata=context.invocation_metadata(),
-                    )
                     yield from call
                 except grpc.RpcError as exc:
                     # Surface the controller's status verbatim to the caller.
                     context.abort(exc.code(), exc.details())
                 finally:
-                    # Per-call connection, released on completion
-                    # (≙ registry.go:206-210).
-                    channel.close()
+                    # No-op after normal completion; when the downstream
+                    # caller cancels or disconnects mid-stream (this
+                    # generator is closed), the in-flight upstream call
+                    # must not keep running against the controller.  The
+                    # per-call-channel version got this for free from
+                    # channel.close().
+                    call.cancel()
 
         return behavior
 
@@ -224,3 +248,8 @@ class Registry:
         )
         srv.start(self.registrar())
         return srv
+
+    def close(self) -> None:
+        """Release cached proxy channels (embedders that stop/start many
+        registries in one process; a daemon just exits)."""
+        self._proxy_channels.close()

@@ -1,0 +1,194 @@
+"""Channel cache: reuse fast-path + rotation/move/restart invalidation.
+
+The cache must keep the reference's dial-per-call *semantics* (rotated
+TLS material and re-registered controller addresses take effect without
+restarts, reference remote.go:101-114, registry.go:186-210) while
+dropping the per-call handshake."""
+
+import time
+
+import grpc
+import pytest
+
+from oim_tpu.common.chancache import ChannelCache
+
+
+class FakeChannel:
+    def __init__(self):
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+class TestChannelCache:
+    def test_reuse_on_same_fingerprint(self):
+        cache = ChannelCache()
+        dials = []
+
+        def dial():
+            ch = FakeChannel()
+            dials.append(ch)
+            return ch
+
+        a = cache.get("k", ("addr", b"cert"), dial)
+        b = cache.get("k", ("addr", b"cert"), dial)
+        assert a is b and len(dials) == 1
+        assert not a.closed
+
+    def test_fingerprint_change_redials_and_retires_old(self):
+        cache = ChannelCache(retire_grace_s=0.0)
+        a = cache.get("k", ("addr", b"cert-v1"), FakeChannel)
+        b = cache.get("k", ("addr", b"cert-v2"), FakeChannel)  # rotated
+        assert a is not b
+        # The old channel is retired, NOT closed out from under possible
+        # in-flight calls; it closes after the grace, on a later acquire.
+        assert not a.closed
+        time.sleep(0.01)
+        c = cache.get("k", ("addr2", b"cert-v2"), FakeChannel)  # moved
+        assert a.closed  # grace elapsed → reaped
+        assert b is not c and not c.closed
+
+    def test_keys_are_independent(self):
+        cache = ChannelCache()
+        a = cache.get("host-a", ("x",), FakeChannel)
+        b = cache.get("host-b", ("y",), FakeChannel)
+        assert a is not b
+        assert cache.get("host-a", ("x",), FakeChannel) is a
+
+    def test_invalidate_forces_redial(self):
+        cache = ChannelCache(retire_grace_s=0.0)
+        a = cache.get("k", ("x",), FakeChannel)
+        cache.invalidate("k")
+        b = cache.get("k", ("x",), FakeChannel)
+        assert b is not a
+
+    def test_in_flight_grace_before_close(self):
+        """Invalidated/evicted channels survive the grace window so
+        concurrent RPCs on them are not cancelled."""
+        cache = ChannelCache(retire_grace_s=10.0)
+        a = cache.get("k", ("x",), FakeChannel)
+        cache.invalidate("k")
+        cache.get("k", ("x",), FakeChannel)  # reap runs; grace not elapsed
+        assert not a.closed
+        cache.close()  # shutdown closes immediately
+        assert a.closed
+
+    def test_requested_key_idles_out_too(self):
+        """After a quiet period even the key being acquired re-dials —
+        the 'short-lived connections when infrequent' stance."""
+        cache = ChannelCache(max_idle_s=0.05, retire_grace_s=0.0)
+        a = cache.get("k", ("x",), FakeChannel)
+        time.sleep(0.1)
+        b = cache.get("k", ("x",), FakeChannel)
+        assert b is not a
+
+    def test_dial_race_with_different_fingerprint_prefers_ours(self):
+        """If a concurrent dial installed a channel built from different
+        (e.g. pre-rotation) material, the caller's freshly-loaded
+        material wins — it must never be answered on stale credentials."""
+        cache = ChannelCache()
+        seen = []
+
+        class RacingDial:
+            def __call__(self):
+                ch = FakeChannel()
+                seen.append(ch)
+                if len(seen) == 1:
+                    # Simulate the other thread winning the slot first,
+                    # with older material.
+                    cache._entries["k"] = (("old",), FakeChannel(), 0.0)
+                return ch
+
+        got = cache.get("k", ("new",), RacingDial())
+        assert got is seen[0]  # our channel, not the stale racer
+        assert cache.get("k", ("new",), FakeChannel) is got
+
+    def test_idle_channels_purged(self):
+        cache = ChannelCache(max_idle_s=0.05, retire_grace_s=0.0)
+        a = cache.get("idle", ("x",), FakeChannel)
+        time.sleep(0.1)
+        cache.get("busy", ("y",), FakeChannel)  # evicts "idle" → retired
+        time.sleep(0.01)
+        b = cache.get("busy", ("y",), FakeChannel)  # reaps the retiree
+        assert a.closed
+        assert not b.closed
+
+    def test_reaped_channels_close_even_when_dial_raises(self):
+        cache = ChannelCache(retire_grace_s=0.0)
+        a = cache.get("k", ("v1",), FakeChannel)
+        cache.invalidate("k")  # a → retired, ripe immediately
+        time.sleep(0.01)
+
+        def failing_dial():
+            raise RuntimeError("resolver exploded")
+
+        with pytest.raises(RuntimeError):
+            cache.get("k", ("v1",), failing_dial)
+        # The reap removed `a` from the retired list before the dial
+        # failed; it must still have been closed, not dropped.
+        assert a.closed
+
+    def test_close_closes_everything(self):
+        cache = ChannelCache()
+        a = cache.get("k1", ("x",), FakeChannel)
+        b = cache.get("k2", ("y",), FakeChannel)
+        cache.close()
+        assert a.closed and b.closed
+
+
+class TestProxyRedialsOnReregistration:
+    def test_proxy_follows_controller_address_change(self, tmp_path):
+        """A controller that re-registers at a new address must be reached
+        there by the very next proxied call (the cache key behavior the
+        heartbeat re-registration depends on)."""
+        from oim_tpu.agent import ChipStore, FakeAgentServer
+        from oim_tpu.controller import Controller
+        from oim_tpu.registry import Registry
+        from oim_tpu.spec import CONTROLLER, oim_pb2
+
+        registry = Registry()
+        reg_srv = registry.start_server("tcp://127.0.0.1:0")
+        store = ChipStore(mesh=(2,), device_dir=str(tmp_path))
+        agent = FakeAgentServer(store, str(tmp_path / "a.sock")).start()
+
+        def start_controller():
+            ctrl = Controller(
+                "mover", str(tmp_path / "a.sock"),
+                registry_address=str(reg_srv.addr()), registry_delay=30.0,
+            )
+            srv = ctrl.start_server("tcp://127.0.0.1:0")
+            ctrl.start(str(srv.addr()))
+            deadline = time.time() + 5
+            while registry.db.lookup("mover/address") != str(srv.addr()):
+                assert time.time() < deadline
+                time.sleep(0.01)
+            return ctrl, srv
+
+        def check_slice(channel):
+            CONTROLLER.stub(channel).CheckSlice(
+                oim_pb2.CheckSliceRequest(name="nope"),
+                metadata=(("controllerid", "mover"),),
+                timeout=5,
+            )
+
+        try:
+            ctrl1, srv1 = start_controller()
+            channel = grpc.insecure_channel(reg_srv.addr().grpc_target())
+            with pytest.raises(grpc.RpcError) as exc:
+                check_slice(channel)  # unknown slice → NOT_FOUND via proxy
+            assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+            # Controller moves: old server down, new one registers.
+            srv1.stop()
+            ctrl1.close()
+            ctrl2, srv2 = start_controller()
+            with pytest.raises(grpc.RpcError) as exc:
+                check_slice(channel)
+            assert exc.value.code() == grpc.StatusCode.NOT_FOUND  # reached!
+            srv2.stop()
+            ctrl2.close()
+            channel.close()
+        finally:
+            reg_srv.stop()
+            agent.stop()

@@ -351,8 +351,37 @@ def cluster(request, tmp_path_factory):
     try:
         _wait_for_unix_socket(agent_sock, procs)
         _wait_for_unix_socket(csi_sock, procs)
-        # Controller must have self-registered before CSI calls route.
-        time.sleep(1.0)
+        # Controller must have self-registered before CSI calls route;
+        # poll the registry through the admin CLI (as an operator would)
+        # instead of a fixed sleep.
+        deadline = time.time() + 20
+        while True:
+            listing = subprocess.run(
+                [
+                    sys.executable, "-m", "oim_tpu.cli.oimctl",
+                    "--registry", f"tcp://127.0.0.1:{registry_port}",
+                    "--ca", os.path.join(certs, "ca.crt"),
+                    "--cert", os.path.join(certs, "user.admin.crt"),
+                    "--key", os.path.join(certs, "user.admin.key"),
+                    "get",
+                ],
+                capture_output=True,
+                text=True,
+                env={**os.environ,
+                     "PYTHONPATH": os.path.dirname(os.path.dirname(__file__))},
+            )
+            if f"{NODE_NAME}/address" in listing.stdout:
+                break
+            for p in procs:
+                if p.proc.poll() is not None:
+                    raise AssertionError(
+                        f"{p.name} exited {p.proc.returncode}:\n{p.output()}"
+                    )
+            assert time.time() < deadline, (
+                f"controller never registered; oimctl said:\n"
+                f"{listing.stdout}\n{listing.stderr}"
+            )
+            time.sleep(0.2)
         yield {
             "csi_sock": csi_sock,
             "pods_dir": ds_vols["mountpoint-dir"],
@@ -468,16 +497,15 @@ class TestKubeletSim:
         # with the published volume at its mount path (via TPU_BOOTSTRAP,
         # since the sim has no mount namespace to remap /tpu).
         container = pod["spec"]["containers"][0]
+        # The pod's "tpu" volume (mountPath /tpu) IS the published dir —
+        # PodSim's mount rewriting resolves any /tpu path in the command.
         workload = PodSim(
             container,
-            {"tpu": os.path.dirname(pod_dir)},
+            {"tpu": pod_dir},
             {},
             {},
             cluster["root"],
         )
-        workload.argv = [
-            arg.replace("/tpu/", pod_dir + "/") for arg in workload.argv
-        ]
         workload.start(
             extra_env={
                 "TPU_BOOTSTRAP": bootstrap_path,

@@ -10,6 +10,7 @@ deterministically computes the same (coordinator, process_id) assignment.
 from __future__ import annotations
 
 import concurrent.futures
+import time
 
 import grpc
 import pytest
@@ -179,6 +180,58 @@ def test_permanent_registry_error_surfaces_immediately():
         assert time.monotonic() - t0 < 5
     finally:
         reg_srv.stop()
+
+
+def test_join_survives_registry_restart_on_cached_channel():
+    """A cache-owned channel (owns_channels, never re-dialed by join)
+    must ride out a registry restart at the same address via gRPC
+    reconnect — the property that replaced explicit invalidation."""
+    import threading
+
+    from oim_tpu.common.chancache import RECONNECT_OPTIONS
+
+    registry = Registry()
+    srv = registry.start_server("tcp://127.0.0.1:0")
+    target = srv.addr().grpc_target()
+    channel = grpc.insecure_channel(target, options=RECONNECT_OPTIONS)
+    factory = lambda: channel
+    factory.owns_channels = True
+    result, errors = {}, []
+
+    def joiner():
+        try:
+            result["p"] = rendezvous.join(
+                factory, "pvc-restart", "h1", "a:1", 2, timeout=30, poll=0.1
+            )
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    t = threading.Thread(target=joiner)
+    t.start()
+    try:
+        time.sleep(0.5)  # h1 published into the first registry
+        srv.stop()
+        registry.close()
+        # Restart at the SAME address with an empty in-memory DB; the
+        # joiner must reconnect on its cached channel AND re-publish.
+        registry2 = Registry()
+        srv2 = registry2.start_server(f"tcp://{target}")
+        try:
+            # Plain (non-owning) factory: join closes it per iteration.
+            rendezvous.join(
+                lambda: grpc.insecure_channel(target),
+                "pvc-restart", "h2", "b:1", 2, timeout=30, poll=0.1,
+            )
+            t.join(timeout=30)
+            assert not t.is_alive(), "joiner hung across registry restart"
+            assert not errors, errors
+            assert result["p"].coordinator_address in ("a:1", "b:1")
+        finally:
+            srv2.stop()
+            registry2.close()
+    finally:
+        channel.close()
+        t.join(timeout=5)
 
 
 def test_restage_overwrites_stale_key(cluster):
