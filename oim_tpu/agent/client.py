@@ -14,6 +14,7 @@ import threading
 from typing import Any
 
 from oim_tpu import log
+from oim_tpu.common import tracing
 
 
 class AgentError(Exception):
@@ -42,6 +43,13 @@ class Client:
         self._next_id = 0
 
     def invoke(self, method: str, params: dict[str, Any] | None = None) -> Any:
+        # The device-plane hop gets its own span (the JSON-RPC protocol
+        # itself stays unchanged — the C++ agent is trace-oblivious, like
+        # SPDK was to the reference's planned Jaeger spans).
+        with tracing.start_span(f"agent/{method}", transport="jsonrpc"):
+            return self._invoke(method, params)
+
+    def _invoke(self, method: str, params: dict[str, Any] | None = None) -> Any:
         with self._lock:
             self._next_id += 1
             request: dict[str, Any] = {

@@ -5,6 +5,7 @@ from __future__ import annotations
 import argparse
 
 from oim_tpu import log
+from oim_tpu.common import tracing
 from oim_tpu.common.tlsconfig import load_tls
 from oim_tpu.controller import Controller
 
@@ -41,9 +42,15 @@ def main(argv=None) -> int:
     parser.add_argument("--cert", help="cert (CN controller.<id>)")
     parser.add_argument("--key", help="key")
     parser.add_argument("--log-level", default="info")
+    parser.add_argument(
+        "--trace-file",
+        default="",
+        help="append spans as JSONL here (also $OIM_TRACE_FILE)",
+    )
     args = parser.parse_args(argv)
 
     log.init_from_string(args.log_level)
+    tracing.init("oim-controller", args.trace_file or None)
     tls = load_tls(args.ca, args.cert, args.key) if args.ca else None
     controller = Controller(
         args.id,

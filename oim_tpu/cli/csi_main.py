@@ -8,6 +8,7 @@ from __future__ import annotations
 import argparse
 
 from oim_tpu import log
+from oim_tpu.common import tracing
 from oim_tpu.common.tlsconfig import load_tls
 from oim_tpu.csi import OIMDriver
 from oim_tpu.csi.mounter import BindMounter, Mounter
@@ -44,9 +45,15 @@ def main(argv=None) -> int:
         "the one socket)",
     )
     parser.add_argument("--log-level", default="info")
+    parser.add_argument(
+        "--trace-file",
+        default="",
+        help="append spans as JSONL here (also $OIM_TRACE_FILE)",
+    )
     args = parser.parse_args(argv)
 
     log.init_from_string(args.log_level)
+    tracing.init("oim-csi-driver", args.trace_file or None)
     tls_loader = None
     if args.ca:
         # Reload key material on every dial so rotation needs no restart

@@ -4,6 +4,7 @@
     oimctl set PATH VALUE         write a value (empty VALUE deletes)
     oimctl map VOLUME --controller ID --chips N    ad-hoc MapVolume
     oimctl unmap VOLUME --controller ID
+    oimctl trace FILE [FILE...]   merge daemons' span files, print trees
 """
 
 from __future__ import annotations
@@ -14,6 +15,7 @@ import grpc
 
 from oim_tpu import log
 from oim_tpu.common import endpoint as ep
+from oim_tpu.common import tracing
 from oim_tpu.common.tlsconfig import load_tls
 from oim_tpu.spec import CONTROLLER, REGISTRY, oim_pb2
 
@@ -49,9 +51,26 @@ def main(argv=None) -> int:
     unmap = sub.add_parser("unmap")
     unmap.add_argument("volume")
     unmap.add_argument("--controller", required=True)
+    trace = sub.add_parser(
+        "trace", help="render cross-process traces from --trace-file JSONLs"
+    )
+    trace.add_argument("files", nargs="+")
+    trace.add_argument(
+        "--trace-id", default="", help="only this trace (prefix match)"
+    )
 
     args = parser.parse_args(argv)
     log.init_from_string(args.log_level)
+    if args.command == "trace":
+        try:
+            spans = tracing.load_jsonl(args.files)
+        except OSError as exc:
+            print(f"error: {exc}")
+            return 1
+        if args.trace_id:
+            spans = [s for s in spans if s.trace_id.startswith(args.trace_id)]
+        print(tracing.render_traces(spans))
+        return 0
     channel = _channel(args)
     try:
         if args.command == "get":

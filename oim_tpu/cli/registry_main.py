@@ -5,6 +5,7 @@ from __future__ import annotations
 import argparse
 
 from oim_tpu import log
+from oim_tpu.common import tracing
 from oim_tpu.common.tlsconfig import load_tls
 from oim_tpu.registry import (
     EtcdKVServer,
@@ -48,9 +49,16 @@ def main(argv=None) -> int:
         "their --db etcd:// at)",
     )
     parser.add_argument("--log-level", default="info")
+    parser.add_argument(
+        "--trace-file",
+        default="",
+        help="append spans as JSONL here (also $OIM_TRACE_FILE); merge "
+        "files from several daemons with `oimctl trace`",
+    )
     args = parser.parse_args(argv)
 
     log.init_from_string(args.log_level)
+    tracing.init("oim-registry", args.trace_file or None)
     tls = None
     if args.ca:
         # Accept any CA-trusted client; per-method CN checks happen inside

@@ -1,0 +1,457 @@
+"""Distributed tracing across the control plane.
+
+The reference *scaffolded* tracing but shipped it disabled: ``InitTracer``
+returns a nop closer and the Jaeger/OpenTracing gRPC interceptors exist
+only as commented-out code (reference pkg/oim-common/tracing.go:17-21,
+:153-214, grpc.go:57-62, server.go:79-84); what its README shows as a
+"trace" of one CreateVolume→NodePublish flow is correlated *logs*
+(reference README.md:455-495).  This module is the working version of
+that intent, kept dependency-free:
+
+- Span context travels in gRPC metadata as a W3C ``traceparent``
+  (``00-<32 hex trace-id>-<16 hex span-id>-01``), so one trace id links
+  kubelet-facing CSI calls, the registry proxy hop, and the controller.
+- ``TraceServerInterceptor`` opens a server span per handled RPC and tags
+  the context logger with the short trace id — log lines and spans
+  correlate the way the reference's method-tagged context logger lines
+  do (reference pkg/oim-common/tracing.go:134-140).
+- ``trace_channel`` wraps a client channel so outgoing calls open client
+  spans and inject the current context (what the commented-out
+  ``OpenTracingClientInterceptor`` would have done).
+- Spans land in a per-process in-memory ring and, when configured, an
+  append-only JSONL file.  Each daemon writes its own file; ``oimctl
+  trace`` merges files and renders the cross-process tree.
+
+Python's ``contextvars`` carries the active span the same way the logger
+travels (see oim_tpu.log), so nesting needs no explicit plumbing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import secrets
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+import grpc
+
+from oim_tpu import log
+
+TRACEPARENT_KEY = "traceparent"
+
+# ---------------------------------------------------------------------------
+# Span model + context propagation
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    trace_id: str  # 32 hex chars
+    span_id: str  # 16 hex chars
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+
+def parse_traceparent(value: str) -> SpanContext | None:
+    """Parse a W3C traceparent; None for anything malformed (a bad peer
+    header must never break the RPC it rode in on)."""
+    parts = value.split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, _flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return SpanContext(trace_id, span_id)
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: str  # "" for a root span
+    name: str  # operation, e.g. "/csi.v1.Node/NodeStageVolume"
+    component: str  # process/service, e.g. "oim-csi-driver"
+    start_ns: int
+    end_ns: int = 0
+    status: str = "ok"
+    attrs: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "component": self.component,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "status": self.status,
+            "attrs": self.attrs,
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict[str, Any]) -> "Span":
+        return cls(
+            trace_id=obj["trace_id"],
+            span_id=obj["span_id"],
+            parent_id=obj.get("parent_id", ""),
+            name=obj.get("name", "?"),
+            component=obj.get("component", "?"),
+            start_ns=obj.get("start_ns", 0),
+            end_ns=obj.get("end_ns", 0),
+            status=obj.get("status", "ok"),
+            attrs=obj.get("attrs", {}),
+        )
+
+
+_current: contextvars.ContextVar[SpanContext | None] = contextvars.ContextVar(
+    "oim_tpu_span", default=None
+)
+
+
+def current_context() -> SpanContext | None:
+    return _current.get()
+
+
+# ---------------------------------------------------------------------------
+# Collector
+
+
+class Collector:
+    """Per-process span sink: bounded in-memory ring + optional JSONL file.
+
+    The ring makes every process introspectable without configuration
+    (tests, embedders); the file is the cross-process story — one file
+    per daemon, merged offline by ``oimctl trace`` / ``load_jsonl``.
+    """
+
+    def __init__(self, component: str = "", path: str | None = None,
+                 capacity: int = 4096) -> None:
+        self.component = component
+        self._lock = threading.Lock()
+        self._ring: deque[Span] = deque(maxlen=capacity)
+        self._file = open(path, "a", buffering=1) if path else None
+
+    def record(self, span: Span) -> None:
+        # Serialize outside the lock: every RPC thread funnels through
+        # here, and only the ring append + write need the mutex.
+        line = json.dumps(span.to_json()) + "\n" if self._file else None
+        with self._lock:
+            self._ring.append(span)
+            if self._file is not None and line is not None:
+                self._file.write(line)
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+_collector = Collector()
+
+
+def init(component: str = "", path: str | None = None) -> Collector:
+    """Configure the process collector (``--trace-file`` / $OIM_TRACE_FILE).
+
+    ``path`` may also come from the environment so any daemon can be
+    traced without a flag change."""
+    global _collector
+    old = _collector
+    path = path or os.environ.get("OIM_TRACE_FILE") or None
+    _collector = Collector(component=component, path=path)
+    old.close()
+    return _collector
+
+
+def collector() -> Collector:
+    return _collector
+
+
+# ---------------------------------------------------------------------------
+# Span creation
+
+
+def _new_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def _new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+@contextlib.contextmanager
+def start_span(
+    name: str,
+    component: str = "",
+    parent: SpanContext | None = None,
+    **attrs: Any,
+) -> Iterator[Span]:
+    """Open a span as a child of ``parent`` (default: the context span,
+    else a new root), make it current for the block, record on exit.
+    An exception marks status=error and re-raises."""
+    parent = parent if parent is not None else _current.get()
+    trace_id = parent.trace_id if parent else _new_trace_id()
+    span = Span(
+        trace_id=trace_id,
+        span_id=_new_span_id(),
+        parent_id=parent.span_id if parent else "",
+        name=name,
+        component=component or _collector.component,
+        start_ns=time.time_ns(),
+        attrs=dict(attrs),
+    )
+    token = _current.set(SpanContext(trace_id, span.span_id))
+    try:
+        yield span
+    except BaseException as exc:
+        span.status = f"error: {type(exc).__name__}"
+        raise
+    finally:
+        _current.reset(token)
+        span.end_ns = time.time_ns()
+        _collector.record(span)
+
+
+def inject(
+    metadata=None, ctx: SpanContext | None = None
+) -> list[tuple[str, str]]:
+    """Metadata with the span context (default: the current one) appended
+    and any stale ``traceparent`` replaced — what proxies must do before
+    forwarding."""
+    out = [
+        (k, v) for k, v in (metadata or ()) if k.lower() != TRACEPARENT_KEY
+    ]
+    ctx = ctx if ctx is not None else _current.get()
+    if ctx is not None:
+        out.append((TRACEPARENT_KEY, ctx.traceparent()))
+    return out
+
+
+def extract(metadata) -> SpanContext | None:
+    for key, value in metadata or ():
+        if key.lower() == TRACEPARENT_KEY:
+            return parse_traceparent(value)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# gRPC server side
+
+
+class TraceServerInterceptor(grpc.ServerInterceptor):
+    """Opens a server span per RPC, parented on the caller's traceparent,
+    and tags the context logger with the short trace id so log lines and
+    spans correlate."""
+
+    def __init__(self, component: str = "") -> None:
+        self.component = component
+
+    def intercept_service(self, continuation, handler_call_details):
+        from oim_tpu.common.interceptors import _wrap_handler
+
+        handler = continuation(handler_call_details)
+        if handler is None:
+            return None
+        method = handler_call_details.method
+        parent = extract(handler_call_details.invocation_metadata)
+        component = self.component
+        streams_response = bool(handler.unary_stream or handler.stream_stream)
+
+        def wrap(behavior):
+            if streams_response:
+                def wrapped_stream(request_or_iterator, context):
+                    with start_span(
+                        method, component=component, parent=parent, kind="server"
+                    ) as span:
+                        with log.with_fields(trace=span.trace_id[:8]):
+                            yield from behavior(request_or_iterator, context)
+
+                return wrapped_stream
+
+            def wrapped(request_or_iterator, context):
+                with start_span(
+                    method, component=component, parent=parent, kind="server"
+                ) as span:
+                    with log.with_fields(trace=span.trace_id[:8]):
+                        return behavior(request_or_iterator, context)
+
+            return wrapped
+
+        return _wrap_handler(handler, wrap)
+
+
+# ---------------------------------------------------------------------------
+# gRPC client side
+
+
+class _CallDetails(grpc.ClientCallDetails):
+    def __init__(self, base, metadata):
+        self.method = base.method
+        self.timeout = base.timeout
+        self.metadata = metadata
+        self.credentials = base.credentials
+        self.wait_for_ready = getattr(base, "wait_for_ready", None)
+        self.compression = getattr(base, "compression", None)
+
+
+class TracingClientInterceptor(
+    grpc.UnaryUnaryClientInterceptor,
+    grpc.UnaryStreamClientInterceptor,
+    grpc.StreamUnaryClientInterceptor,
+    grpc.StreamStreamClientInterceptor,
+):
+    """Client half: a ``client`` span per outgoing call with the context
+    injected.  The span closes when the call completes (done-callback),
+    falling back to call initiation for transports that don't expose one."""
+
+    def __init__(self, component: str = "") -> None:
+        self.component = component
+
+    def _call(self, continuation, details, request_or_iterator):
+        parent = _current.get()
+        span = Span(
+            trace_id=parent.trace_id if parent else _new_trace_id(),
+            span_id=_new_span_id(),
+            parent_id=parent.span_id if parent else "",
+            name=details.method,
+            component=self.component or _collector.component,
+            start_ns=time.time_ns(),
+            attrs={"kind": "client"},
+        )
+        metadata = inject(
+            details.metadata, SpanContext(span.trace_id, span.span_id)
+        )
+        done = threading.Event()
+
+        def finish(call=None):
+            if done.is_set():
+                return
+            done.set()
+            span.end_ns = time.time_ns()
+            if call is not None:
+                try:
+                    if call.code() is not grpc.StatusCode.OK:
+                        span.status = f"error: {call.code().name}"
+                except Exception:
+                    pass
+            _collector.record(span)
+
+        try:
+            call = continuation(
+                _CallDetails(details, metadata), request_or_iterator
+            )
+        except BaseException as exc:
+            span.status = f"error: {type(exc).__name__}"
+            finish()
+            raise
+        add_done = getattr(call, "add_done_callback", None)
+        if add_done is not None:
+            add_done(finish)
+        else:  # pragma: no cover - grpc always exposes it today
+            finish()
+        return call
+
+    def intercept_unary_unary(self, continuation, client_call_details, request):
+        return self._call(continuation, client_call_details, request)
+
+    def intercept_unary_stream(self, continuation, client_call_details, request):
+        return self._call(continuation, client_call_details, request)
+
+    def intercept_stream_unary(
+        self, continuation, client_call_details, request_iterator
+    ):
+        return self._call(continuation, client_call_details, request_iterator)
+
+    def intercept_stream_stream(
+        self, continuation, client_call_details, request_iterator
+    ):
+        return self._call(continuation, client_call_details, request_iterator)
+
+
+def trace_channel(channel: grpc.Channel, component: str = "") -> grpc.Channel:
+    """Wrap a channel so calls through it are traced + propagated."""
+    return grpc.intercept_channel(channel, TracingClientInterceptor(component))
+
+
+# ---------------------------------------------------------------------------
+# Offline: merge + render (the ``oimctl trace`` backend)
+
+
+def load_jsonl(paths: list[str]) -> list[Span]:
+    spans: list[Span] = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    spans.append(Span.from_json(json.loads(line)))
+                except (ValueError, KeyError, TypeError):
+                    continue  # torn write at the file tail
+    return spans
+
+
+def render_traces(spans: list[Span]) -> str:
+    """ASCII tree per trace, children indented under parents, ordered by
+    start time — the working version of the cross-component trace the
+    reference README renders from correlated logs (README.md:455-495)."""
+    by_trace: dict[str, list[Span]] = {}
+    for span in spans:
+        by_trace.setdefault(span.trace_id, []).append(span)
+    out: list[str] = []
+    for trace_id in sorted(
+        by_trace, key=lambda t: min(s.start_ns for s in by_trace[t])
+    ):
+        members = by_trace[trace_id]
+        members.sort(key=lambda s: s.start_ns)
+        ids = {s.span_id for s in members}
+        children: dict[str, list[Span]] = {}
+        roots: list[Span] = []
+        for span in members:
+            if span.parent_id and span.parent_id in ids:
+                children.setdefault(span.parent_id, []).append(span)
+            else:
+                roots.append(span)
+        t0 = members[0].start_ns
+        out.append(f"trace {trace_id}")
+
+        def walk(span: Span, depth: int) -> None:
+            offset_ms = (span.start_ns - t0) / 1e6
+            status = "" if span.status == "ok" else f"  [{span.status}]"
+            out.append(
+                f"  {'  ' * depth}+{offset_ms:8.2f}ms "
+                f"{span.duration_ms:8.2f}ms  {span.component}  "
+                f"{span.name}{status}"
+            )
+            for child in children.get(span.span_id, ()):
+                walk(child, depth + 1)
+
+        for root in roots:
+            walk(root, 0)
+    return "\n".join(out)

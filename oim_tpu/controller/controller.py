@@ -28,6 +28,7 @@ from oim_tpu import log
 from oim_tpu.agent import Agent, AgentError
 from oim_tpu.agent import EBUSY, EEXIST, ENODEV, ENOSPC
 from oim_tpu.common import pci as pcilib
+from oim_tpu.common import tracing
 from oim_tpu.common.interceptors import LogServerInterceptor, PeerCheckInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.tlsconfig import TLSConfig
@@ -371,7 +372,10 @@ class Controller:
         """Serve the Controller service.  With TLS, only the registry's CN is
         accepted as a client (≙ the reference controller expecting
         component.registry)."""
-        interceptors: tuple = (LogServerInterceptor(),)
+        interceptors: tuple = (
+            tracing.TraceServerInterceptor("oim-controller"),
+            LogServerInterceptor(),
+        )
         if self.tls is not None and require_registry_peer:
             interceptors = (PeerCheckInterceptor(REGISTRY_CN),) + interceptors
         srv = NonBlockingGRPCServer(endpoint, tls=self.tls, interceptors=interceptors)

@@ -20,6 +20,7 @@ from oim_tpu import log
 from oim_tpu.agent import Agent, AgentError, ENODEV, ENOSPC, EEXIST
 from oim_tpu.common import endpoint as ep
 from oim_tpu.common import pci as pcilib
+from oim_tpu.common import tracing
 from oim_tpu.common.chancache import ChannelCache, RECONNECT_OPTIONS
 from oim_tpu.common.tlsconfig import TLSConfig
 from oim_tpu.csi import rendezvous
@@ -114,18 +115,19 @@ def wait_for_devices(paths: list[str], timeout: float, poll: float = 0.1) -> Non
     deadline (the reference used fsnotify + a 5s rescan tick; a poll loop has
     the same observable behavior for control-plane latencies).
     """
-    deadline = time.monotonic() + timeout
-    missing = list(paths)
-    while missing:
-        missing = [p for p in missing if not os.path.exists(p)]
-        if not missing:
-            return
-        if time.monotonic() >= deadline:
-            raise VolumeError(
-                grpc.StatusCode.DEADLINE_EXCEEDED,
-                f"device(s) never appeared: {missing}",
-            )
-        time.sleep(poll)
+    with tracing.start_span("device/wait", devices=len(paths)):
+        deadline = time.monotonic() + timeout
+        missing = list(paths)
+        while missing:
+            missing = [p for p in missing if not os.path.exists(p)]
+            if not missing:
+                return
+            if time.monotonic() >= deadline:
+                raise VolumeError(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    f"device(s) never appeared: {missing}",
+                )
+            time.sleep(poll)
 
 
 def _staged_from_reply(
@@ -322,16 +324,22 @@ class RemoteBackend:
             return self._channels.get(
                 "registry",
                 (target, tls.ca_pem, tls.cert_pem, tls.key_pem),
-                lambda: grpc.secure_channel(
-                    target,
-                    tls.channel_credentials(),
-                    options=tls.channel_options() + RECONNECT_OPTIONS,
+                lambda: tracing.trace_channel(
+                    grpc.secure_channel(
+                        target,
+                        tls.channel_credentials(),
+                        options=tls.channel_options() + RECONNECT_OPTIONS,
+                    ),
+                    "oim-csi-driver",
                 ),
             )
         return self._channels.get(
             "registry",
             (target, None),
-            lambda: grpc.insecure_channel(target, options=RECONNECT_OPTIONS),
+            lambda: tracing.trace_channel(
+                grpc.insecure_channel(target, options=RECONNECT_OPTIONS),
+                "oim-csi-driver",
+            ),
         )
 
     def _metadata(self) -> tuple:
@@ -454,15 +462,18 @@ class RemoteBackend:
                 # (≙ oim-driver_test.go:209-226's ctx-cancellation check).
                 timeout = min(timeout, max(deadline - time.monotonic(), 0.1))
             try:
-                placement = rendezvous.join(
-                    self._registry_factory,
-                    volume_id,
-                    self.controller_id,
-                    staged.coordinator_address,
-                    num_hosts,
-                    timeout=timeout,
-                    members=members,
-                )
+                with tracing.start_span(
+                    "rendezvous/join", volume=volume_id, num_hosts=num_hosts
+                ):
+                    placement = rendezvous.join(
+                        self._registry_factory,
+                        volume_id,
+                        self.controller_id,
+                        staged.coordinator_address,
+                        num_hosts,
+                        timeout=timeout,
+                        members=members,
+                    )
             except rendezvous.RendezvousError as exc:
                 raise VolumeError(exc.code, exc.message) from exc
             staged.num_processes = placement.num_processes

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Callable
 
+from oim_tpu.common import tracing
 from oim_tpu.common.interceptors import LogServerInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.tlsconfig import TLSConfig
@@ -127,7 +128,11 @@ class OIMDriver:
                 CSI0_NODE.registrar(NodeServer0(self.node)),
             ]
         srv = NonBlockingGRPCServer(
-            self.csi_endpoint, interceptors=(LogServerInterceptor(),)
+            self.csi_endpoint,
+            interceptors=(
+                tracing.TraceServerInterceptor("oim-csi-driver"),
+                LogServerInterceptor(),
+            ),
         )
         srv.start(*registrars)
         return srv

@@ -21,6 +21,7 @@ import grpc
 from oim_tpu import log
 from oim_tpu.common import endpoint as ep
 from oim_tpu.common import pathutil
+from oim_tpu.common import tracing
 from oim_tpu.common.chancache import ChannelCache, RECONNECT_OPTIONS
 from oim_tpu.common.interceptors import LogServerInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
@@ -158,16 +159,22 @@ class Registry:
             return self._proxy_channels.get(
                 controller_id,
                 (target, tls.ca_pem, tls.cert_pem, tls.key_pem),
-                lambda: grpc.secure_channel(
-                    target,
-                    tls.channel_credentials(),
-                    options=tls.channel_options() + RECONNECT_OPTIONS,
+                lambda: tracing.trace_channel(
+                    grpc.secure_channel(
+                        target,
+                        tls.channel_credentials(),
+                        options=tls.channel_options() + RECONNECT_OPTIONS,
+                    ),
+                    "oim-registry",
                 ),
             )
         return self._proxy_channels.get(
             controller_id,
             (target, None),
-            lambda: grpc.insecure_channel(target, options=RECONNECT_OPTIONS),
+            lambda: tracing.trace_channel(
+                grpc.insecure_channel(target, options=RECONNECT_OPTIONS),
+                "oim-registry",
+            ),
         )
 
     def _proxy_behavior(self, method: str):
@@ -244,7 +251,11 @@ class Registry:
         srv = NonBlockingGRPCServer(
             endpoint,
             tls=self.tls,
-            interceptors=interceptors or (LogServerInterceptor(),),
+            interceptors=interceptors
+            or (
+                tracing.TraceServerInterceptor("oim-registry"),
+                LogServerInterceptor(),
+            ),
         )
         srv.start(self.registrar())
         return srv
