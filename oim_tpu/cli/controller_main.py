@@ -5,7 +5,7 @@ from __future__ import annotations
 import argparse
 
 from oim_tpu import log
-from oim_tpu.common import tracing
+from oim_tpu.common import metrics, tracing
 from oim_tpu.common.tlsconfig import load_tls
 from oim_tpu.controller import Controller
 
@@ -47,10 +47,20 @@ def main(argv=None) -> int:
         default="",
         help="append spans as JSONL here (also $OIM_TRACE_FILE)",
     )
+    parser.add_argument(
+        "--metrics-endpoint",
+        default="",
+        help="serve Prometheus /metrics on this host:port "
+        "(\":9090\" binds all interfaces)",
+    )
     args = parser.parse_args(argv)
 
     log.init_from_string(args.log_level)
     tracing.init("oim-controller", args.trace_file or None)
+    metrics_server = None
+    if args.metrics_endpoint:
+        metrics_server = metrics.MetricsServer(args.metrics_endpoint).start()
+        log.current().info("metrics endpoint", port=metrics_server.port)
     tls = load_tls(args.ca, args.cert, args.key) if args.ca else None
     controller = Controller(
         args.id,
@@ -70,6 +80,9 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         controller.close()
         server.stop()
+    finally:
+        if metrics_server is not None:
+            metrics_server.stop()
     return 0
 
 

@@ -8,7 +8,7 @@ from __future__ import annotations
 import argparse
 
 from oim_tpu import log
-from oim_tpu.common import tracing
+from oim_tpu.common import metrics, tracing
 from oim_tpu.common.tlsconfig import load_tls
 from oim_tpu.csi import OIMDriver
 from oim_tpu.csi.mounter import BindMounter, Mounter
@@ -50,10 +50,20 @@ def main(argv=None) -> int:
         default="",
         help="append spans as JSONL here (also $OIM_TRACE_FILE)",
     )
+    parser.add_argument(
+        "--metrics-endpoint",
+        default="",
+        help="serve Prometheus /metrics on this host:port "
+        "(\":9090\" binds all interfaces)",
+    )
     args = parser.parse_args(argv)
 
     log.init_from_string(args.log_level)
     tracing.init("oim-csi-driver", args.trace_file or None)
+    metrics_server = None
+    if args.metrics_endpoint:
+        metrics_server = metrics.MetricsServer(args.metrics_endpoint).start()
+        log.current().info("metrics endpoint", port=metrics_server.port)
     tls_loader = None
     if args.ca:
         # Reload key material on every dial so rotation needs no restart
@@ -83,6 +93,8 @@ def main(argv=None) -> int:
         server.stop()
     finally:
         driver.close()
+        if metrics_server is not None:
+            metrics_server.stop()
     return 0
 
 

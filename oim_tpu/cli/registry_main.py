@@ -5,7 +5,7 @@ from __future__ import annotations
 import argparse
 
 from oim_tpu import log
-from oim_tpu.common import tracing
+from oim_tpu.common import metrics, tracing
 from oim_tpu.common.tlsconfig import load_tls
 from oim_tpu.registry import (
     EtcdKVServer,
@@ -55,10 +55,20 @@ def main(argv=None) -> int:
         help="append spans as JSONL here (also $OIM_TRACE_FILE); merge "
         "files from several daemons with `oimctl trace`",
     )
+    parser.add_argument(
+        "--metrics-endpoint",
+        default="",
+        help="serve Prometheus /metrics on this host:port "
+        "(\":9090\" binds all interfaces)",
+    )
     args = parser.parse_args(argv)
 
     log.init_from_string(args.log_level)
     tracing.init("oim-registry", args.trace_file or None)
+    metrics_server = None
+    if args.metrics_endpoint:
+        metrics_server = metrics.MetricsServer(args.metrics_endpoint).start()
+        log.current().info("metrics endpoint", port=metrics_server.port)
     tls = None
     if args.ca:
         # Accept any CA-trusted client; per-method CN checks happen inside
@@ -86,6 +96,8 @@ def main(argv=None) -> int:
             etcd_server.stop()
     finally:
         registry.close()
+        if metrics_server is not None:
+            metrics_server.stop()
     return 0
 
 

@@ -8,6 +8,7 @@ the gRPC method so nested calls show causality (≙ tracing.go:134-140).
 
 from __future__ import annotations
 
+import contextlib
 from typing import Callable
 
 import grpc
@@ -108,65 +109,86 @@ def _wrap_handler(handler: grpc.RpcMethodHandler, wrap: Callable):
     )
 
 
-class LogServerInterceptor(grpc.ServerInterceptor):
-    """Logs every call with the configured payload formatter and binds the
-    context logger with the method name for the duration of the handler."""
+class ObservingServerInterceptor(grpc.ServerInterceptor):
+    """Shared scaffold for behavior-wrapping server interceptors.
 
-    def __init__(self, formatter: Callable = strip_secrets_formatter) -> None:
-        self.formatter = formatter
+    grpc-python interceptors never see the ServicerContext, so logging,
+    tracing, and metrics all need the same plumbing: fetch the handler,
+    split unary- vs stream-response, wrap the behavior, and rebuild the
+    handler with its serializers (``_wrap_handler``).  Subclasses supply
+    only their observation as a context manager: ``observe`` runs around
+    the handler (including the full drain of a streaming response) and
+    may yield a callable that receives the unary response object.
+    """
+
+    def observe(self, method, handler_call_details, request_or_iterator, context):
+        raise NotImplementedError
 
     def intercept_service(self, continuation, handler_call_details):
         handler = continuation(handler_call_details)
         if handler is None:
             return None
         method = handler_call_details.method
-        fmt = self.formatter
-
         streams_response = bool(handler.unary_stream or handler.stream_stream)
-
-        def log_request(logger, request_or_iterator):
-            if hasattr(request_or_iterator, "DESCRIPTOR"):
-                logger.debug("request", payload=fmt(request_or_iterator))
-            else:
-                logger.debug("request", payload=f"<{type(request_or_iterator).__name__}>")
 
         def wrap(behavior):
             if streams_response:
-                # The behavior returns a generator that gRPC drains *after*
-                # the call below returns, so the method-tagged context and
-                # error capture must live for the whole iteration.
+                # The behavior returns a generator that gRPC drains
+                # *after* the call below returns, so the observation must
+                # live for the whole iteration.
                 def wrapped_stream(request_or_iterator, context):
-                    with log.with_fields(method=method):
-                        logger = log.current()
-                        log_request(logger, request_or_iterator)
-                        try:
-                            yield from behavior(request_or_iterator, context)
-                        except grpc.RpcError:
-                            raise
-                        except Exception as exc:
-                            logger.error("handler failed", error=str(exc))
-                            raise
+                    with self.observe(
+                        method, handler_call_details, request_or_iterator, context
+                    ):
+                        yield from behavior(request_or_iterator, context)
 
                 return wrapped_stream
 
             def wrapped(request_or_iterator, context):
-                with log.with_fields(method=method):
-                    logger = log.current()
-                    log_request(logger, request_or_iterator)
-                    try:
-                        response = behavior(request_or_iterator, context)
-                    except grpc.RpcError:
-                        raise
-                    except Exception as exc:
-                        logger.error("handler failed", error=str(exc))
-                        raise
-                    if hasattr(response, "DESCRIPTOR"):
-                        logger.debug("response", payload=fmt(response))
+                with self.observe(
+                    method, handler_call_details, request_or_iterator, context
+                ) as on_response:
+                    response = behavior(request_or_iterator, context)
+                    if on_response is not None:
+                        on_response(response)
                     return response
 
             return wrapped
 
         return _wrap_handler(handler, wrap)
+
+
+class LogServerInterceptor(ObservingServerInterceptor):
+    """Logs every call with the configured payload formatter and binds the
+    context logger with the method name for the duration of the handler."""
+
+    def __init__(self, formatter: Callable = strip_secrets_formatter) -> None:
+        self.formatter = formatter
+
+    @contextlib.contextmanager
+    def observe(self, method, handler_call_details, request_or_iterator, context):
+        fmt = self.formatter
+        with log.with_fields(method=method):
+            logger = log.current()
+            if hasattr(request_or_iterator, "DESCRIPTOR"):
+                logger.debug("request", payload=fmt(request_or_iterator))
+            else:
+                logger.debug(
+                    "request",
+                    payload=f"<{type(request_or_iterator).__name__}>",
+                )
+
+            def on_response(response):
+                if hasattr(response, "DESCRIPTOR"):
+                    logger.debug("response", payload=fmt(response))
+
+            try:
+                yield on_response
+            except grpc.RpcError:
+                raise
+            except Exception as exc:
+                logger.error("handler failed", error=str(exc))
+                raise
 
 
 class PeerCheckInterceptor(grpc.ServerInterceptor):

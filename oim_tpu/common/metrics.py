@@ -1,0 +1,379 @@
+"""Process metrics with Prometheus text exposition.
+
+The reference has **no** metrics: the only perf artifact it ships is the
+perfdash ``perftype`` schema vendored for the k8s e2e framework
+(reference test/e2e/perftype/perftype.go:26-53, and SURVEY.md §5 records
+"No Prometheus metrics in OIM").  This module supplies what operators of
+the reference had to live without, dependency-free: counters, gauges and
+histograms with labels, a per-process registry, a gRPC server
+interceptor recording per-method call counts and latencies, and a tiny
+stdlib HTTP endpoint serving the standard ``/metrics`` text format
+(Prometheus exposition format 0.0.4) that any scraper understands.
+
+Design notes:
+- Metric instruments are cheap under concurrency: one lock per metric,
+  plain dict of label-tuple → float.  No background threads.
+- Label values are escaped per the exposition format (backslash, quote,
+  newline).
+- The HTTP server is optional and per-daemon (``--metrics-endpoint``);
+  embedders can instead call ``render()`` and publish however they like.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import http.server
+import threading
+import time
+from typing import Callable, Iterable
+
+import grpc
+
+from oim_tpu.common.interceptors import ObservingServerInterceptor
+
+# ---------------------------------------------------------------------------
+# Instruments
+
+
+def _escape(value: str) -> str:
+    return (
+        value.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+    )
+
+
+def _labels_key(
+    names: tuple[str, ...], values: tuple[str, ...]
+) -> tuple[str, ...]:
+    if len(values) != len(names):
+        raise ValueError(f"expected labels {names}, got {values}")
+    return values
+
+
+def _render_labels(names: tuple[str, ...], values: tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{_escape(v)}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotonically increasing, optionally labelled."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = labels
+        self._lock = threading.Lock()
+        self._values: dict[tuple[str, ...], float] = {}
+
+    def inc(self, *label_values: str, by: float = 1.0) -> None:
+        key = _labels_key(self.label_names, label_values)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + by
+
+    def value(self, *label_values: str) -> float:
+        with self._lock:
+            return self._values.get(label_values, 0.0)
+
+    def render(self) -> Iterable[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        for values, count in items:
+            yield (
+                f"{self.name}{_render_labels(self.label_names, values)}"
+                f" {_format_float(count)}"
+            )
+
+
+class Gauge:
+    """Set/add-style instantaneous value."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = labels
+        self._lock = threading.Lock()
+        self._values: dict[tuple[str, ...], float] = {}
+        self._callbacks: dict[tuple[str, ...], Callable[[], float]] = {}
+
+    def set(self, value: float, *label_values: str) -> None:
+        key = _labels_key(self.label_names, label_values)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def add(self, delta: float, *label_values: str) -> None:
+        key = _labels_key(self.label_names, label_values)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+    def set_function(
+        self, fn: Callable[[], float], *label_values: str
+    ) -> None:
+        """Lazily evaluated at scrape time (e.g. 'chips free' asks the
+        allocator rather than mirroring it)."""
+        key = _labels_key(self.label_names, label_values)
+        with self._lock:
+            self._callbacks[key] = fn
+
+    def value(self, *label_values: str) -> float:
+        with self._lock:
+            cb = self._callbacks.get(label_values)
+        if cb is not None:
+            return float(cb())
+        with self._lock:
+            return self._values.get(label_values, 0.0)
+
+    def remove(self, *label_values: str, fn: Callable | None = None) -> None:
+        """Drop a series (a closed component deregisters itself).  With
+        ``fn``, remove only if that exact callback is still installed —
+        a newer instance that took over the series is left alone."""
+        key = _labels_key(self.label_names, label_values)
+        with self._lock:
+            if fn is not None and self._callbacks.get(key) is not fn:
+                return
+            self._callbacks.pop(key, None)
+            self._values.pop(key, None)
+
+    def render(self) -> Iterable[str]:
+        with self._lock:
+            items = dict(self._values)
+            callbacks = dict(self._callbacks)
+        for key, cb in callbacks.items():
+            try:
+                items[key] = float(cb())
+            except Exception:
+                continue  # a failing callback must not break the scrape
+        for values, v in sorted(items.items()):
+            yield (
+                f"{self.name}{_render_labels(self.label_names, values)}"
+                f" {_format_float(v)}"
+            )
+
+
+# Latency buckets suited to a control plane: 1ms .. 60s.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Histogram:
+    """Cumulative-bucket histogram (the Prometheus shape)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        labels: tuple[str, ...] = (),
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+    ):
+        self.name = name
+        self.help = help_
+        self.label_names = labels
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        # label values → (per-bucket counts, total count, sum)
+        self._series: dict[tuple[str, ...], list] = {}
+
+    def observe(self, value: float, *label_values: str) -> None:
+        key = _labels_key(self.label_names, label_values)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = [[0] * len(self.buckets), 0, 0.0]
+                self._series[key] = series
+            counts, _, _ = series
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+            series[1] += 1
+            series[2] += value
+
+    def count(self, *label_values: str) -> int:
+        with self._lock:
+            series = self._series.get(label_values)
+            return series[1] if series else 0
+
+    def render(self) -> Iterable[str]:
+        with self._lock:
+            items = sorted(
+                (k, (list(v[0]), v[1], v[2])) for k, v in self._series.items()
+            )
+        for values, (counts, total, sum_) in items:
+            for bound, count in zip(self.buckets, counts):
+                labels = _render_labels(
+                    self.label_names + ("le",),
+                    values + (_format_float(bound),),
+                )
+                yield f"{self.name}_bucket{labels} {count}"
+            inf_labels = _render_labels(
+                self.label_names + ("le",), values + ("+Inf",)
+            )
+            yield f"{self.name}_bucket{inf_labels} {total}"
+            plain = _render_labels(self.label_names, values)
+            yield f"{self.name}_sum{plain} {_format_float(sum_)}"
+            yield f"{self.name}_count{plain} {total}"
+
+
+def _format_float(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+# ---------------------------------------------------------------------------
+# Registry
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def register(self, metric):
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing  # idempotent by name (shared instruments)
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name, help_, labels=()):
+        return self.register(Counter(name, help_, labels))
+
+    def gauge(self, name, help_, labels=()):
+        return self.register(Gauge(name, help_, labels))
+
+    def histogram(self, name, help_, labels=(), buckets=DEFAULT_BUCKETS):
+        return self.register(Histogram(name, help_, labels, buckets))
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: list[str] = []
+        for metric in metrics:
+            lines.append(f"# HELP {metric.name} {metric.help}")
+            lines.append(f"# TYPE {metric.name} {metric.kind}")
+            lines.extend(metric.render())
+        return "\n".join(lines) + "\n"
+
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _registry
+
+
+# ---------------------------------------------------------------------------
+# gRPC server instrumentation
+
+
+class MetricsServerInterceptor(ObservingServerInterceptor):
+    """Counts and times every handled RPC:
+
+    - ``oim_rpc_handled_total{component,method,code}``
+    - ``oim_rpc_handling_seconds{component,method}`` histogram
+    """
+
+    def __init__(self, component: str, registry_: MetricsRegistry | None = None):
+        self.component = component
+        reg = registry_ or _registry
+        self.handled = reg.counter(
+            "oim_rpc_handled_total",
+            "RPCs handled, by gRPC method and status code.",
+            ("component", "method", "code"),
+        )
+        self.latency = reg.histogram(
+            "oim_rpc_handling_seconds",
+            "Server-side RPC handling latency.",
+            ("component", "method"),
+        )
+
+    @contextlib.contextmanager
+    def observe(self, method, handler_call_details, request_or_iterator, context):
+        def code_of(exc: BaseException | None) -> str:
+            code = getattr(context, "code", lambda: None)()
+            if code is None and exc is None:
+                return grpc.StatusCode.OK.name
+            if isinstance(code, grpc.StatusCode):
+                return code.name
+            return grpc.StatusCode.UNKNOWN.name
+
+        start = time.perf_counter()
+        try:
+            yield None
+        except BaseException as exc:
+            self.handled.inc(self.component, method, code_of(exc))
+            self.latency.observe(
+                time.perf_counter() - start, self.component, method
+            )
+            raise
+        self.handled.inc(self.component, method, code_of(None))
+        self.latency.observe(
+            time.perf_counter() - start, self.component, method
+        )
+
+
+# ---------------------------------------------------------------------------
+# /metrics HTTP exposition
+
+
+class MetricsServer:
+    """Minimal scrape endpoint: ``GET /metrics`` on a host:port."""
+
+    def __init__(
+        self, address: str = "127.0.0.1:0",
+        registry_: MetricsRegistry | None = None,
+    ):
+        host, _, port = address.rpartition(":")
+        reg = registry_ or _registry
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                    self.send_error(404)
+                    return
+                body = reg.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        # Go convention: an empty host (":9090") binds all interfaces.
+        self._httpd = http.server.ThreadingHTTPServer(
+            (host, int(port or 0)), Handler
+        )
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -42,6 +42,7 @@ from typing import Any, Iterator
 import grpc
 
 from oim_tpu import log
+from oim_tpu.common.interceptors import ObservingServerInterceptor
 
 TRACEPARENT_KEY = "traceparent"
 
@@ -263,7 +264,7 @@ def extract(metadata) -> SpanContext | None:
 # gRPC server side
 
 
-class TraceServerInterceptor(grpc.ServerInterceptor):
+class TraceServerInterceptor(ObservingServerInterceptor):
     """Opens a server span per RPC, parented on the caller's traceparent,
     and tags the context logger with the short trace id so log lines and
     spans correlate."""
@@ -271,38 +272,14 @@ class TraceServerInterceptor(grpc.ServerInterceptor):
     def __init__(self, component: str = "") -> None:
         self.component = component
 
-    def intercept_service(self, continuation, handler_call_details):
-        from oim_tpu.common.interceptors import _wrap_handler
-
-        handler = continuation(handler_call_details)
-        if handler is None:
-            return None
-        method = handler_call_details.method
+    @contextlib.contextmanager
+    def observe(self, method, handler_call_details, request_or_iterator, context):
         parent = extract(handler_call_details.invocation_metadata)
-        component = self.component
-        streams_response = bool(handler.unary_stream or handler.stream_stream)
-
-        def wrap(behavior):
-            if streams_response:
-                def wrapped_stream(request_or_iterator, context):
-                    with start_span(
-                        method, component=component, parent=parent, kind="server"
-                    ) as span:
-                        with log.with_fields(trace=span.trace_id[:8]):
-                            yield from behavior(request_or_iterator, context)
-
-                return wrapped_stream
-
-            def wrapped(request_or_iterator, context):
-                with start_span(
-                    method, component=component, parent=parent, kind="server"
-                ) as span:
-                    with log.with_fields(trace=span.trace_id[:8]):
-                        return behavior(request_or_iterator, context)
-
-            return wrapped
-
-        return _wrap_handler(handler, wrap)
+        with start_span(
+            method, component=self.component, parent=parent, kind="server"
+        ) as span:
+            with log.with_fields(trace=span.trace_id[:8]):
+                yield None
 
 
 # ---------------------------------------------------------------------------

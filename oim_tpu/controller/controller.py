@@ -28,7 +28,7 @@ from oim_tpu import log
 from oim_tpu.agent import Agent, AgentError
 from oim_tpu.agent import EBUSY, EEXIST, ENODEV, ENOSPC
 from oim_tpu.common import pci as pcilib
-from oim_tpu.common import tracing
+from oim_tpu.common import metrics, tracing
 from oim_tpu.common.interceptors import LogServerInterceptor, PeerCheckInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.tlsconfig import TLSConfig
@@ -74,6 +74,30 @@ class Controller:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._advertised_address = ""
+        # Chip occupancy, evaluated against the agent at scrape time (so
+        # the gauge can never drift from the allocator's truth).  Scrapes
+        # use their own short-timeout connection: a hung agent must stall
+        # the scrape for 2s, not block live MapVolume RPCs on the shared
+        # client's lock; a dead one is dropped so the next scrape
+        # re-dials instead of failing forever.
+        self._scrape_agent_conn: Agent | None = None
+        self._scrape_lock = threading.Lock()
+        self._chips_gauge = metrics.registry().gauge(
+            "oim_chips_total", "Chips the device-plane agent owns.",
+            ("controller",),
+        )
+        self._chips_cb = lambda: len(self._scrape(lambda a: a.get_chips()))
+        self._chips_gauge.set_function(self._chips_cb, controller_id)
+        self._allocated_gauge = metrics.registry().gauge(
+            "oim_chips_allocated", "Chips attached to mapped volumes.",
+            ("controller",),
+        )
+        self._allocated_cb = lambda: sum(
+            len(a.get("chips", ()))
+            for a in self._scrape(lambda ag: ag.get_allocations())
+            if a.get("attached")
+        )
+        self._allocated_gauge.set_function(self._allocated_cb, controller_id)
 
     # -- agent connection --------------------------------------------------
 
@@ -85,6 +109,28 @@ class Controller:
             if self._agent is None:
                 self._agent = Agent(self.agent_socket)
             return self._agent
+
+    def _scrape(self, fn):
+        """Run ``fn(agent)`` on the metrics-only connection, dropping it on
+        any failure so the next scrape starts from a fresh dial."""
+        try:
+            with self._scrape_lock:
+                if self._scrape_agent_conn is None:
+                    self._scrape_agent_conn = Agent(self.agent_socket, timeout=2.0)
+                conn = self._scrape_agent_conn
+            return fn(conn)
+        except BaseException:
+            self._drop_scrape_agent()
+            raise
+
+    def _drop_scrape_agent(self) -> None:
+        with self._scrape_lock:
+            if self._scrape_agent_conn is not None:
+                try:
+                    self._scrape_agent_conn.close()
+                except Exception:
+                    pass
+                self._scrape_agent_conn = None
 
     def _drop_agent(self) -> None:
         with self._agent_lock:
@@ -363,6 +409,13 @@ class Controller:
             self._thread.join(timeout=5)
             self._thread = None
         self._drop_agent()
+        self._drop_scrape_agent()
+        # Deregister the gauge series — but only if a newer controller
+        # with the same id hasn't already taken them over.
+        self._chips_gauge.remove(self.controller_id, fn=self._chips_cb)
+        self._allocated_gauge.remove(
+            self.controller_id, fn=self._allocated_cb
+        )
 
     # -- serving -----------------------------------------------------------
 
@@ -374,6 +427,7 @@ class Controller:
         component.registry)."""
         interceptors: tuple = (
             tracing.TraceServerInterceptor("oim-controller"),
+            metrics.MetricsServerInterceptor("oim-controller"),
             LogServerInterceptor(),
         )
         if self.tls is not None and require_registry_peer:

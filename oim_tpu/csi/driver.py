@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import Callable
 
-from oim_tpu.common import tracing
+from oim_tpu.common import metrics, tracing
 from oim_tpu.common.interceptors import LogServerInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.tlsconfig import TLSConfig
@@ -131,6 +131,7 @@ class OIMDriver:
             self.csi_endpoint,
             interceptors=(
                 tracing.TraceServerInterceptor("oim-csi-driver"),
+                metrics.MetricsServerInterceptor("oim-csi-driver"),
                 LogServerInterceptor(),
             ),
         )

@@ -20,8 +20,7 @@ import grpc
 
 from oim_tpu import log
 from oim_tpu.common import endpoint as ep
-from oim_tpu.common import pathutil
-from oim_tpu.common import tracing
+from oim_tpu.common import metrics, pathutil, tracing
 from oim_tpu.common.chancache import ChannelCache, RECONNECT_OPTIONS
 from oim_tpu.common.interceptors import LogServerInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
@@ -53,6 +52,16 @@ class Registry:
         # re-dials, so the reference's dial-per-call routing behavior
         # (registry.go:186-210) is preserved without its handshake cost.
         self._proxy_channels = ChannelCache()
+        self._proxied = metrics.registry().counter(
+            "oim_registry_proxied_total",
+            "Calls forwarded through the transparent proxy.",
+            ("controller",),
+        )
+        self._keys_gauge = metrics.registry().gauge(
+            "oim_registry_keys", "Rows in the registry KV store."
+        )
+        self._keys_cb = lambda: len(self.db.keys(""))
+        self._keys_gauge.set_function(self._keys_cb)
 
     # -- KV service --------------------------------------------------------
 
@@ -189,6 +198,7 @@ class Registry:
             self._proxy_authz(controller_id, context)
             with log.with_fields(method=method, controllerid=controller_id):
                 log.current().debug("proxying")
+                self._proxied.inc(controller_id)
                 channel = self._connect(controller_id, context)
                 call = channel.stream_stream(
                     method,
@@ -254,6 +264,7 @@ class Registry:
             interceptors=interceptors
             or (
                 tracing.TraceServerInterceptor("oim-registry"),
+                metrics.MetricsServerInterceptor("oim-registry"),
                 LogServerInterceptor(),
             ),
         )
@@ -261,6 +272,8 @@ class Registry:
         return srv
 
     def close(self) -> None:
-        """Release cached proxy channels (embedders that stop/start many
-        registries in one process; a daemon just exits)."""
+        """Release cached proxy channels and deregister gauges (embedders
+        that stop/start many registries in one process; a daemon just
+        exits)."""
         self._proxy_channels.close()
+        self._keys_gauge.remove(fn=self._keys_cb)
