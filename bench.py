@@ -13,6 +13,15 @@ path; see BASELINE.md).  Prints ONE JSON line on stdout:
 vs_baseline > 1 means faster than the target budget (TARGET_P50_MS, from
 BASELINE.md — the reference publishes no numbers).  Diagnostics go to stderr.
 
+Timing discipline (tunneled single-chip setup): ``block_until_ready`` on the
+axon backend returns WITHOUT waiting for device execution, and any scalar
+readback costs one ~70 ms tunnel RPC.  Every number here therefore (a) ends
+its timed region on a data-dependent readback, and (b) amortizes N
+iterations behind one dispatch (lax.scan train loop / back-to-back decode
+dispatches) with the measured readback rtt subtracted.  ``tunnel_rtt_ms``
+is reported so the p50 (which includes exactly one readback) is
+interpretable against a non-tunneled deployment.
+
 Resilience (the reference's graceful-degradation discipline,
 /root/reference/test/test.make:1-16):
 - stale fixture daemons from this repo are detected and killed up front (a
@@ -341,10 +350,26 @@ def _run(tmp: str, agent_sock: str, cleanups: list, extras: dict) -> int:
     cap.access_mode.mode = csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER
 
     # The "first PJRT op" a freshly-scheduled workload runs: compiled once
-    # per process (PJRT caches executables), executed per iteration.
+    # per process (PJRT caches executables), executed per iteration.  The
+    # op's result is READ BACK (float()) inside the timed region: on the
+    # tunneled backend block_until_ready does not actually wait for device
+    # execution, so only a data-dependent readback proves the op ran.
     first_op = jax.jit(lambda x: (x @ x).sum())
     warm = jnp.ones((128, 128), jnp.bfloat16)
-    first_op(warm).block_until_ready()
+    float(first_op(warm))
+    # One tunnel round-trip (readback of a computed-but-never-read scalar)
+    # so the p50 is interpretable: on this setup it dominates the first-op
+    # wait.  A fresh array each probe — jax caches the host value after the
+    # first float(), which would measure a dict lookup.
+    rtts = []
+    for i in range(5):
+        done = first_op(warm * (1.0 + i))
+        time.sleep(0.3)  # device finishes; only the RPC remains
+        t0 = time.perf_counter()
+        float(done)
+        rtts.append((time.perf_counter() - t0) * 1000)
+    extras["tunnel_rtt_ms"] = round(statistics.median(rtts), 1)
+    log(f"bench: tunnel readback rtt ~{extras['tunnel_rtt_ms']:.0f} ms")
 
     def one_cycle(i: int) -> float:
         volume = f"bench-{i}"
@@ -377,11 +402,12 @@ def _run(tmp: str, agent_sock: str, cleanups: list, extras: dict) -> int:
             ),
             timeout=30,
         )
-        # Pod starts: read the bootstrap, run the first accelerator op.
+        # Pod starts: read the bootstrap, run the first accelerator op and
+        # observe its result (see readback note above).
         with open(os.path.join(target, "tpu-bootstrap.json")) as f:
             bootstrap = json.load(f)
         assert len(bootstrap["chips"]) == 4
-        first_op(warm).block_until_ready()
+        float(first_op(warm))
         elapsed_ms = (time.perf_counter() - start) * 1000
         # Teardown outside the timed region.
         node.NodeUnpublishVolume(
@@ -452,13 +478,21 @@ def _flagship_cfg(on_tpu: bool):
 
 
 def _train_diagnostics(extras, on_tpu, cfg, batch, seq, params) -> None:
-    """Single-chip training throughput + MFU of the flagship model."""
+    """Single-chip training throughput + MFU of the flagship model.
+
+    Timing methodology: N steps ride ONE dispatch (``make_train_loop`` =
+    lax.scan inside jit) and the clock stops on a scalar readback of the
+    final metrics.  On the tunneled backend block_until_ready returns
+    without waiting and each readback is a ~70 ms RPC, so per-step
+    dispatch+readback timing would measure the tunnel, not the chip; the
+    measured readback rtt is subtracted from the loop total.
+    """
     try:
         import jax
         import jax.numpy as jnp
         import optax
 
-        from oim_tpu.models import make_train_step
+        from oim_tpu.models import make_train_loop
         from oim_tpu.models.train import TrainState, data_pspec, shard_state
         from oim_tpu.parallel import build_mesh
 
@@ -468,21 +502,26 @@ def _train_diagnostics(extras, on_tpu, cfg, batch, seq, params) -> None:
             x.size for x in jax.tree_util.tree_leaves(params)
         )
         state = shard_state(TrainState.create(params, optimizer), cfg, mesh)
-        step = make_train_step(cfg, mesh, optimizer)
-        tokens = jax.device_put(
+        loop = make_train_loop(cfg, mesh, optimizer)
+        n_iter = 20 if on_tpu else 4
+        tokens = (
             (jnp.arange(batch * seq) % cfg.vocab_size)
             .reshape(batch, seq)
-            .astype(jnp.int32),
-            jax.sharding.NamedSharding(mesh, data_pspec()),
+            .astype(jnp.int32)
         )
-        state, _ = step(state, tokens)  # compile
-        jax.block_until_ready(state.step)
+        batches = jax.device_put(
+            jnp.broadcast_to(tokens, (n_iter, batch, seq)),
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(None, *data_pspec())
+            ),
+        )
+        state, metrics = loop(state, batches)  # compile
+        float(metrics["ce"][-1])
+        rtt_s = extras.get("tunnel_rtt_ms", 0.0) / 1000.0
         t0 = time.perf_counter()
-        n_iter = 10
-        for _ in range(n_iter):
-            state, metrics = step(state, tokens)
-        jax.block_until_ready(metrics["ce"])
-        dt = (time.perf_counter() - t0) / n_iter
+        state, metrics = loop(state, batches)
+        float(metrics["ce"][-1])
+        dt = (time.perf_counter() - t0 - rtt_s) / n_iter
         tok_s = batch * seq / dt
         # Model FLOPs: 6·N per token (fwd 2N + bwd 4N), the standard
         # dense-transformer estimate; attention scores add
@@ -515,19 +554,25 @@ def _decode_diagnostics(extras, on_tpu, cfg, batch, params) -> None:
 
         from oim_tpu.models.decode import make_generate_fn
 
+        import numpy as np
+
         gen_fn = make_generate_fn(cfg)
         prompt = (
             jnp.arange(batch * 32).reshape(batch, 32) % cfg.vocab_size
         ).astype(jnp.int32)
         new_tokens = 64
-        out = gen_fn(params, prompt, max_new_tokens=new_tokens)
-        jax.block_until_ready(out)  # compile
+        np.asarray(gen_fn(params, prompt, max_new_tokens=new_tokens))  # compile
+        # N independent generations dispatched back-to-back; the device
+        # executes them in order, so materializing the last one (np.asarray
+        # — block_until_ready does not wait on the tunneled backend) bounds
+        # all N.  The tunnel readback rtt is subtracted once.
+        rtt_s = extras.get("tunnel_rtt_ms", 0.0) / 1000.0
         t0 = time.perf_counter()
-        n_iter = 3
+        n_iter = 4
         for _ in range(n_iter):
             out = gen_fn(params, prompt, max_new_tokens=new_tokens)
-        jax.block_until_ready(out)
-        dt = (time.perf_counter() - t0) / n_iter
+        np.asarray(out)
+        dt = (time.perf_counter() - t0 - rtt_s) / n_iter
         tok_s = batch * new_tokens / dt
         extras["decode_tok_per_s"] = round(tok_s)
         log(

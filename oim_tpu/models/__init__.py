@@ -13,7 +13,12 @@ from oim_tpu.models.transformer import (
     forward_local,
     param_pspecs,
 )
-from oim_tpu.models.train import TrainState, make_train_step, data_pspec
+from oim_tpu.models.train import (
+    TrainState,
+    data_pspec,
+    make_train_loop,
+    make_train_step,
+)
 from oim_tpu.models.decode import (
     KVCache,
     decode_step,
@@ -34,6 +39,7 @@ __all__ = [
     "forward_local",
     "param_pspecs",
     "TrainState",
+    "make_train_loop",
     "make_train_step",
     "data_pspec",
 ]

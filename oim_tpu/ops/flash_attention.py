@@ -246,14 +246,23 @@ def flash_attention(
     return out
 
 
-def _forward(q, k, v, causal, block_q, block_k):
-    b, t, h, d = q.shape
+def _resolve_blocks(t: int, block_q: int, block_k: int):
+    """(block_q, block_k) with 0 → auto, or None when the kernel can't tile t."""
     block_q = block_q or _auto_block(t, 512) or 1
     block_k = block_k or _auto_block(t, 1024) or 1
     if t % block_q or t % block_k or block_q < 8 or block_k < 128:
+        return None
+    return block_q, block_k
+
+
+def _forward(q, k, v, causal, block_q, block_k):
+    b, t, h, d = q.shape
+    blocks = _resolve_blocks(t, block_q, block_k)
+    if blocks is None:
         # Ragged tails: fall back to the reference (bench shapes are
         # block-aligned; correctness everywhere beats a padded kernel).
         return reference_attention(q, k, v, causal), None
+    block_q, block_k = blocks
     scale = 1.0 / (d**0.5)
     qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
     bh = b * h
@@ -301,6 +310,7 @@ def _bwd(causal, block_q, block_k, residuals, g):
         )
         return vjp(g)
     b, t, h, d = q.shape
+    block_q, block_k = _resolve_blocks(t, block_q, block_k)
     bh = b * h
     scale = 1.0 / (d**0.5)
     qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)

@@ -58,6 +58,30 @@ class TestTrainingMixes:
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0] * 0.9
 
+    def test_scanned_loop_matches_stepwise(self):
+        """make_train_loop (lax.scan, one dispatch) must produce the same
+        loss trajectory as N make_train_step dispatches."""
+        from oim_tpu.models import make_train_loop
+
+        cfg = TransformerConfig(**TINY)
+        mesh = build_mesh(devices=jax.devices()[:1])
+        stepwise = _run_steps(cfg, mesh, batch=4, steps=6)
+
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        optimizer = optax.adamw(1e-2)
+        state = shard_state(TrainState.create(params, optimizer), cfg, mesh)
+        loop = make_train_loop(cfg, mesh, optimizer)
+        tokens = jax.device_put(
+            _data(4, 16, cfg.vocab_size),
+            jax.sharding.NamedSharding(mesh, data_pspec()),
+        )
+        batches = jnp.broadcast_to(tokens, (6, *tokens.shape))
+        state, metrics = loop(state, batches)
+        np.testing.assert_allclose(
+            np.asarray(metrics["ce"]), np.asarray(stepwise), rtol=1e-4
+        )
+        assert int(state.step) == 6
+
     def test_dp_sp_mix(self):
         mesh = build_mesh(dp=2, sp=4)
         losses = _run_steps(TransformerConfig(**TINY), mesh)

@@ -115,6 +115,19 @@ class TestFlashAttention:
                 err_msg=f"d{name} mismatch",
             )
 
+    def test_gradients_auto_blocks(self):
+        """Default (auto-tuned) block sizes through the fused backward —
+        regression for the 0-sentinel reaching the bwd grid division."""
+        b, t, h, d = 1, 256, 2, 16
+        q = jax.random.normal(jax.random.PRNGKey(11), (b, t, h, d))
+        got = jax.grad(lambda q: jnp.sum(flash_attention(q, q, q) ** 2))(q)
+        want = jax.grad(
+            lambda q: jnp.sum(reference_attention(q, q, q) ** 2)
+        )(q)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+        )
+
     def test_forward_lse_matches_logsumexp(self):
         """The saved logsumexp (what the backward recomputes p from) must
         equal the true row logsumexp of the scaled, masked scores."""
