@@ -85,47 +85,13 @@ def kill_stale_daemons() -> list:
     later backend init hung.  The reference's device fixture force-kills
     its daemon's process group for the same reason
     (/root/reference/test/pkg/spdk/spdk.go:84-278); the bench additionally
-    refuses to measure with stale daemons alive.
+    refuses to measure with stale daemons alive.  Daemon matching and
+    killing live in tests/procutil (one definition of "our daemon" for the
+    bench, the suite leak check, and fixtures alike).
     """
-    daemon_markers = ("oim_tpu.cli", "oim_tpu/cli", "demo_cluster")
-    me = os.getpid()
-    killed = []
-    try:
-        out = subprocess.run(
-            ["ps", "-eo", "pid,ppid,args"], capture_output=True, text=True
-        ).stdout
-    except OSError:
-        return killed
-    for line in out.splitlines()[1:]:
-        parts = line.split(None, 2)
-        if len(parts) < 3:
-            continue
-        pid_s, ppid_s, cmd = parts
-        try:
-            pid, ppid = int(pid_s), int(ppid_s)
-        except ValueError:
-            continue
-        if pid in (me, os.getppid()) or ppid == me:
-            continue
-        # Only processes that ARE our daemons — judged by the executable,
-        # not by a substring anywhere in the command line (an editor or
-        # `tail -f` with a matching path must survive).
-        argv0 = os.path.basename(cmd.split()[0])
-        is_agent = argv0 == "tpu-agent"
-        is_python_daemon = argv0.startswith("python") and any(
-            m in cmd for m in daemon_markers
-        )
-        if not (is_agent or is_python_daemon):
-            continue
-        try:
-            pgid = os.getpgid(pid)
-            if pgid == os.getpgid(me):
-                os.kill(pid, signal.SIGKILL)
-            else:
-                os.killpg(pgid, signal.SIGKILL)
-            killed.append((pid, cmd[:100]))
-        except (ProcessLookupError, PermissionError, OSError):
-            pass
+    from tests import procutil
+
+    killed = procutil.kill_repo_daemons()
     for pid, cmd in killed:
         log(f"bench: killed stale daemon pid={pid} cmd={cmd!r}")
     if killed:

@@ -1,0 +1,167 @@
+"""Leak-proof subprocess discipline for every fixture that spawns a daemon.
+
+Round-1 postmortem (VERDICT.md Weak #3): fixtures Popen'd daemons without a
+guaranteed kill path; the image preloads JAX into every python process, so
+a leaked daemon held the single TPU for hours and wedged every later
+backend init.  The reference's device fixture force-kills the daemon's
+whole process group on Finalize and lockfile-serializes shared daemons
+(≙ reference test/pkg/spdk/spdk.go:84-278, test/pkg/qemu/qemu.go:65-88).
+This module is that discipline, shared by all spawning tests and tools:
+
+- ``spawn()`` starts the child in its OWN process group and registers it;
+- ``stop()`` kills the whole group (TERM, grace, KILL) and unregisters;
+- an ``atexit`` sweep kills anything still registered, so even a pytest
+  hard-crash mid-fixture cannot leak;
+- ``find_repo_daemons()`` + the conftest session finalizer fail the suite
+  loudly if any repo daemon survives teardown.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import signal
+import subprocess
+import time
+
+_LIVE: dict[int, subprocess.Popen] = {}
+# Every pid ever spawned through this module (they are their own group
+# leaders, so this doubles as the pgid history).  Leak attribution: a
+# surviving daemon counts as OUR leak only if it is, or belongs to the
+# group of, something we spawned — a concurrently running demo cluster or
+# second test session must not be blamed or killed.
+_SPAWNED_PGIDS: set[int] = set()
+
+# Processes that count as "this repo's daemons" for leak detection.  Judged
+# by the executable (argv0) plus a module marker — never by a substring
+# anywhere in the command line (an editor or a driver process quoting these
+# names must not match).
+_PY_MARKERS = ("oim_tpu.cli", "oim_tpu/cli", "demo_cluster")
+
+
+def spawn(argv, **popen_kwargs) -> subprocess.Popen:
+    """``subprocess.Popen`` in a fresh process group, registered for the
+    atexit sweep.  All keyword args pass through."""
+    popen_kwargs.setdefault("start_new_session", True)
+    proc = subprocess.Popen(argv, **popen_kwargs)
+    _LIVE[proc.pid] = proc
+    _SPAWNED_PGIDS.add(proc.pid)
+    return proc
+
+
+def stop_all(procs, timeout: float = 10.0) -> None:
+    """Stop many daemons with one SHARED grace period: TERM every group
+    first, then wait, then KILL stragglers — worst case ~timeout total,
+    not timeout × len(procs)."""
+    import signal as _signal
+
+    procs = [p for p in procs if p is not None]
+    for proc in procs:
+        _LIVE.pop(proc.pid, None)
+        if proc.poll() is None:
+            _killpg(proc.pid, _signal.SIGTERM)
+    deadline = time.time() + timeout
+    for proc in procs:
+        if proc.poll() is None:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                _killpg(proc.pid, _signal.SIGKILL)
+                proc.wait(timeout=5)
+
+
+def our_leaks() -> list[tuple[int, str]]:
+    """Surviving repo daemons attributable to THIS process's spawns: the
+    pid (or its process group) came through ``spawn()``."""
+    leaks = []
+    for pid, cmd in find_repo_daemons():
+        try:
+            pgid = os.getpgid(pid)
+        except (ProcessLookupError, OSError):
+            continue
+        if pid in _SPAWNED_PGIDS or pgid in _SPAWNED_PGIDS:
+            leaks.append((pid, cmd))
+    return leaks
+
+
+def stop(proc: subprocess.Popen, timeout: float = 10.0) -> None:
+    """Terminate the child's whole process group; escalate to SIGKILL."""
+    _LIVE.pop(proc.pid, None)
+    if proc.poll() is not None:
+        return
+    _killpg(proc.pid, signal.SIGTERM)
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        _killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=5)
+
+
+def _killpg(pid: int, sig: int) -> None:
+    try:
+        pgid = os.getpgid(pid)
+        if pgid != pid:
+            # Not a session/group leader — it shares a group with processes
+            # we did not spawn (a wrapper script, or pytest itself); a group
+            # kill would take innocents down with it.
+            os.kill(pid, sig)
+            return
+        os.killpg(pgid, sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            os.kill(pid, sig)
+        except (ProcessLookupError, OSError):
+            pass
+
+
+@atexit.register
+def _sweep() -> None:
+    for pid, proc in list(_LIVE.items()):
+        if proc.poll() is None:
+            _killpg(pid, signal.SIGKILL)
+        _LIVE.pop(pid, None)
+
+
+def find_repo_daemons(exclude_pids=()) -> list[tuple[int, str]]:
+    """(pid, cmdline) of every live repo daemon on the box — the processes
+    a clean teardown must have removed."""
+    me = os.getpid()
+    excluded = {me, os.getppid(), *exclude_pids}
+    found = []
+    try:
+        out = subprocess.run(
+            ["ps", "-eo", "pid,args"], capture_output=True, text=True
+        ).stdout
+    except OSError:
+        return found
+    for line in out.splitlines()[1:]:
+        parts = line.split(None, 1)
+        if len(parts) < 2:
+            continue
+        try:
+            pid = int(parts[0])
+        except ValueError:
+            continue
+        if pid in excluded:
+            continue
+        cmd = parts[1]
+        argv0 = os.path.basename(cmd.split()[0])
+        is_agent = argv0 == "tpu-agent"
+        is_python_daemon = argv0.startswith("python") and any(
+            m in cmd for m in _PY_MARKERS
+        )
+        if is_agent or is_python_daemon:
+            found.append((pid, cmd[:160]))
+    return found
+
+
+def kill_repo_daemons() -> list[tuple[int, str]]:
+    """Kill every stray repo daemon (process-group-wide); returns what was
+    killed.  Used by bench.py-style up-front hygiene and the conftest
+    finalizer's cleanup-after-report."""
+    victims = find_repo_daemons()
+    for pid, _ in victims:
+        _killpg(pid, signal.SIGKILL)
+    if victims:
+        time.sleep(0.5)
+    return victims

@@ -19,6 +19,7 @@ import pytest
 from oim_tpu import agent as agent_mod
 from oim_tpu.agent import Agent, AgentError, FakeAgentServer, ChipStore
 from oim_tpu.common.cmdmonitor import CmdMonitor
+from tests import procutil
 
 NATIVE_BINARY = "native/tpu-agent/tpu-agent"
 
@@ -50,7 +51,7 @@ def agent_socket(request, tmp_path, native_built):
         if not native_built:
             pytest.skip("native tpu-agent not built")
         monitor = CmdMonitor()
-        proc = subprocess.Popen(
+        proc = procutil.spawn(
             [
                 NATIVE_BINARY,
                 "--socket", sock,
@@ -75,8 +76,7 @@ def agent_socket(request, tmp_path, native_built):
             assert not monitor.dead(0.05), proc.stderr.read().decode()
             assert time.time() < deadline, "agent socket never came up"
         yield sock
-        proc.terminate()
-        proc.wait(timeout=10)
+        procutil.stop(proc)
 
 
 def test_topology_and_chips(agent_socket):

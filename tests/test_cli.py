@@ -12,13 +12,14 @@ import time
 import pytest
 
 from oim_tpu.cli import oimctl
+from tests import procutil
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _spawn(module: str, *args: str) -> subprocess.Popen:
     env = dict(os.environ, PYTHONPATH=REPO)
-    return subprocess.Popen(
+    return procutil.spawn(
         [sys.executable, "-m", module, *args],
         env=env,
         stdout=subprocess.PIPE,
@@ -78,10 +79,7 @@ def cluster(tmp_path):
         _wait_tcp(18998)
         yield "tcp://127.0.0.1:18999"
     finally:
-        for proc in procs:
-            proc.terminate()
-        for proc in procs:
-            proc.wait(timeout=10)
+        procutil.stop_all(procs)
 
 
 def _ctl(registry, *args):

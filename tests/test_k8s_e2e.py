@@ -35,6 +35,7 @@ import yaml
 
 from oim_tpu.common.ca import CertAuthority
 from oim_tpu.spec import CSI_CONTROLLER, CSI_IDENTITY, CSI_NODE, csi_pb2
+from tests import procutil
 from tests.test_agent_protocol import NATIVE_BINARY, _build_native
 
 DEPLOY = os.path.join(os.path.dirname(__file__), "..", "deploy", "kubernetes")
@@ -231,7 +232,9 @@ class PodSim:
         # deadlocks the child once it writes a pipe buffer's worth.
         self._log_path = os.path.join(self.cwd, f"{self.name}.log")
         self._log = open(self._log_path, "wb")
-        self.proc = subprocess.Popen(
+        # procutil: own process group + atexit sweep, so even a pytest
+        # hard-crash mid-fixture cannot leak this daemon (round-1 leak).
+        self.proc = procutil.spawn(
             self.argv,
             cwd=self.cwd,
             env=env,
@@ -241,19 +244,15 @@ class PodSim:
         return self
 
     def stop(self):
-        if self.proc and self.proc.poll() is None:
-            self.proc.terminate()
-            try:
-                self.proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                self.proc.kill()
         if self.proc:
+            procutil.stop(self.proc)
             self._log.close()
 
     def output(self):
         if not self.proc:
             return ""
-        self._log.flush()
+        if not self._log.closed:
+            self._log.flush()
         with open(self._log_path, "rb") as f:
             return f.read().decode(errors="replace")
 
@@ -319,36 +318,39 @@ def cluster(request, tmp_path_factory):
         return dirs
 
     procs = []
-
-    # -- registry Deployment
-    (reg_dep,) = by_kind(load_manifest("registry.yaml"), "Deployment")
-    reg_spec = reg_dep["spec"]["template"]["spec"]
-    reg_vols = materialize_volumes(reg_spec, "registry")
-    for container in reg_spec["containers"]:
-        procs.append(
-            PodSim(container, reg_vols, env, substitutions, str(root)).start()
-        )
-
-    # -- node DaemonSet (one simulated node)
-    (ds,) = by_kind(load_manifest("tpu-daemonset.yaml"), "DaemonSet")
-    ds_spec = ds["spec"]["template"]["spec"]
-    ds_vols = materialize_volumes(ds_spec, "node")
-    # The hostPath /dev of the simulated node: 4 fake accel device files
-    # (the reference substitutes hardware the same way: Malloc BDevs for
-    # real disks, spec.md:119-122).
-    for i in range(4):
-        with open(os.path.join(ds_vols["dev"], f"accel{i}"), "w") as f:
-            f.write(f"sim-chip {i}\n")
-    for container in ds_spec["containers"]:
-        if container["name"] in SIDECARS:
-            continue  # upstream images; their role is played by KubeletSim
-        procs.append(
-            PodSim(container, ds_vols, env, substitutions, str(root)).start()
-        )
-
-    csi_sock = os.path.join(ds_vols["csi-sock"], "csi.sock")
-    agent_sock = os.path.join(ds_vols["agent-sock"], "agent.sock")
     try:
+        # -- registry Deployment
+        (reg_dep,) = by_kind(load_manifest("registry.yaml"), "Deployment")
+        reg_spec = reg_dep["spec"]["template"]["spec"]
+        reg_vols = materialize_volumes(reg_spec, "registry")
+        for container in reg_spec["containers"]:
+            procs.append(
+                PodSim(
+                    container, reg_vols, env, substitutions, str(root)
+                ).start()
+            )
+
+        # -- node DaemonSet (one simulated node)
+        (ds,) = by_kind(load_manifest("tpu-daemonset.yaml"), "DaemonSet")
+        ds_spec = ds["spec"]["template"]["spec"]
+        ds_vols = materialize_volumes(ds_spec, "node")
+        # The hostPath /dev of the simulated node: 4 fake accel device
+        # files (the reference substitutes hardware the same way: Malloc
+        # BDevs for real disks, spec.md:119-122).
+        for i in range(4):
+            with open(os.path.join(ds_vols["dev"], f"accel{i}"), "w") as f:
+                f.write(f"sim-chip {i}\n")
+        for container in ds_spec["containers"]:
+            if container["name"] in SIDECARS:
+                continue  # upstream images; KubeletSim plays their role
+            procs.append(
+                PodSim(
+                    container, ds_vols, env, substitutions, str(root)
+                ).start()
+            )
+
+        csi_sock = os.path.join(ds_vols["csi-sock"], "csi.sock")
+        agent_sock = os.path.join(ds_vols["agent-sock"], "agent.sock")
         _wait_for_unix_socket(agent_sock, procs)
         _wait_for_unix_socket(csi_sock, procs)
         # Controller must have self-registered before CSI calls route;
@@ -390,8 +392,12 @@ def cluster(request, tmp_path_factory):
             "procs": procs,
         }
     finally:
+        # One shared grace period for all daemons (TERM all → wait → KILL),
+        # then close the log handles.
+        procutil.stop_all([p.proc for p in procs])
         for p in procs:
-            p.stop()
+            if p.proc:
+                p._log.close()
 
 
 @pytest.mark.usefixtures("cluster")
@@ -514,7 +520,10 @@ class TestKubeletSim:
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
             }
         )
-        assert workload.proc.wait(timeout=240) == 0, workload.output()
+        try:
+            assert workload.proc.wait(timeout=240) == 0, workload.output()
+        finally:
+            workload.stop()  # kills the group if the wait timed out
         out = workload.output()
         assert "gbps_per_chip" in out, out
 

@@ -14,6 +14,7 @@ import subprocess
 import pytest
 
 from oim_tpu.agent import Agent
+from tests import procutil
 from tests.test_agent_protocol import NATIVE_BINARY, _build_native
 
 TEST_PLUGIN = "native/tpu-agent/test_plugin/fake_pjrt.so"
@@ -41,7 +42,7 @@ def _spawn_agent(sock, extra_args):
     import socket as socket_mod
     import time
 
-    proc = subprocess.Popen(
+    proc = procutil.spawn(
         [NATIVE_BINARY, "--socket", sock, *extra_args],
         stderr=subprocess.PIPE,
     )
@@ -97,8 +98,7 @@ def test_chips_from_pjrt_enumeration(tmp_path, test_plugin):
             alloc = agent.create_allocation("vol-p", 4)
             assert alloc["mesh"] in ([1, 2, 2], [2, 2, 1], [2, 1, 2])
     finally:
-        proc.terminate()
-        proc.wait(timeout=10)
+        procutil.stop(proc)
 
 
 def test_pjrt_probe_without_client(tmp_path, test_plugin):
@@ -120,8 +120,7 @@ def test_pjrt_probe_without_client(tmp_path, test_plugin):
             assert info["api_version"]["major"] == 0
             assert "client" not in info  # no client without the flag
     finally:
-        proc.terminate()
-        proc.wait(timeout=10)
+        procutil.stop(proc)
 
 
 def test_pjrt_client_create_failure_is_soft(tmp_path, test_plugin):
@@ -143,8 +142,7 @@ def test_pjrt_client_create_failure_is_soft(tmp_path, test_plugin):
             assert "client creation failed by request" in info["error"]
             assert agent.get_topology()["chip_count"] == 2
     finally:
-        proc.terminate()
-        proc.wait(timeout=10)
+        procutil.stop(proc)
 
 
 def test_missing_plugin_is_soft(tmp_path, test_plugin):
@@ -164,8 +162,7 @@ def test_missing_plugin_is_soft(tmp_path, test_plugin):
             topo = agent.get_topology()
             assert "pjrt_version" not in topo
     finally:
-        proc.terminate()
-        proc.wait(timeout=10)
+        procutil.stop(proc)
 
 
 @pytest.mark.parametrize("plugin", REAL_PLUGINS)
@@ -192,5 +189,4 @@ def test_real_plugin_handshake(tmp_path, test_plugin, plugin):
             assert info["api_version"]["major"] == 0
             assert info["api_version"]["minor"] > 0
     finally:
-        proc.terminate()
-        proc.wait(timeout=10)
+        procutil.stop(proc)

@@ -39,7 +39,7 @@ NATIVE_AGENT = os.path.join(REPO, "native", "tpu-agent", "tpu-agent")
 ENV = dict(os.environ, PYTHONPATH=REPO)
 
 
-def _spawn(args: list[str], name: str) -> int:
+def _spawn(args: list[str], name: str, pids: dict[str, int]) -> int:
     log_path = os.path.join(WORK, f"{name}.log")
     with open(log_path, "w") as logf:
         proc = subprocess.Popen(
@@ -47,6 +47,13 @@ def _spawn(args: list[str], name: str) -> int:
             start_new_session=True,
         )
     print(f"  {name}: pid {proc.pid} (log {os.path.relpath(log_path, REPO)})")
+    # The pidfile is written after EVERY spawn, not once at the end: if a
+    # later daemon's socket never appears and start() raises, stop() can
+    # still find and kill what already came up (otherwise a failed start
+    # orphans JAX-preloading daemons — the round-1 wedged-TPU scenario).
+    pids[name] = proc.pid
+    with open(PIDFILE, "w") as f:
+        json.dump(pids, f)
     return proc.pid
 
 
@@ -85,6 +92,18 @@ def start() -> None:
     if any(_alive(p) for p in _load_pids().values()):
         raise SystemExit("demo cluster already running — `stop` first")
     os.makedirs(WORK, exist_ok=True)
+    try:
+        _start_daemons()
+    except BaseException:
+        # A failed bring-up must kill whatever it already spawned — past
+        # the already-running check above, every pidfile entry is ours
+        # (written incrementally by _spawn), so stop() cannot hit a
+        # pre-existing cluster.
+        _stop_if_running()
+        raise
+
+
+def _start_daemons() -> None:
 
     from oim_tpu.common.ca import CertAuthority
 
@@ -102,30 +121,30 @@ def start() -> None:
 
     pids = {}
     if os.path.exists(NATIVE_AGENT):
-        pids["tpu-agent"] = _spawn(
+        _spawn(
             [NATIVE_AGENT, "--socket", AGENT_SOCKET,
              "--fake-chips", "8", "--mesh", "2x2x2",
              "--state-dir", os.path.join(WORK, "dev")],
-            "tpu-agent",
+            "tpu-agent", pids,
         )
     else:
         print("  (native agent not built; using the Python fake)")
-        pids["tpu-agent"] = _spawn(
+        _spawn(
             [sys.executable, "-m", "oim_tpu.cli.agent_main",
              "--socket", AGENT_SOCKET, "--fake-chips", "8", "--mesh", "2x2x2",
              "--state-dir", os.path.join(WORK, "dev")],
-            "tpu-agent",
+            "tpu-agent", pids,
         )
     _wait_file(AGENT_SOCKET)
 
-    pids["oim-registry"] = _spawn(
+    _spawn(
         [sys.executable, "-m", "oim_tpu.cli.registry_main",
          "--endpoint", REGISTRY_ENDPOINT,
          "--db", os.path.join(WORK, "registry.db"),
          *_tls_args("component.registry")],
-        "oim-registry",
+        "oim-registry", pids,
     )
-    pids["oim-controller"] = _spawn(
+    _spawn(
         [sys.executable, "-m", "oim_tpu.cli.controller_main",
          "--id", CONTROLLER_ID,
          "--endpoint", CONTROLLER_ENDPOINT,
@@ -133,20 +152,18 @@ def start() -> None:
          "--registry", REGISTRY_ENDPOINT,
          "--registry-delay", "10",
          *_tls_args(f"controller.{CONTROLLER_ID}")],
-        "oim-controller",
+        "oim-controller", pids,
     )
-    pids["oim-csi-driver"] = _spawn(
+    _spawn(
         [sys.executable, "-m", "oim_tpu.cli.csi_main",
          "--endpoint", f"unix://{CSI_SOCKET}",
          "--node-id", "demo-node",
          "--registry", REGISTRY_ENDPOINT,
          "--controller-id", CONTROLLER_ID,
          *_tls_args(f"host.{CONTROLLER_ID}")],
-        "oim-csi-driver",
+        "oim-csi-driver", pids,
     )
     _wait_file(CSI_SOCKET)
-    with open(PIDFILE, "w") as f:
-        json.dump(pids, f)
     print(f"""
 demo cluster up.  Try:
   python -m oim_tpu.cli.oimctl --registry {REGISTRY_ENDPOINT} \\
@@ -201,9 +218,62 @@ def status() -> int:
 
 def demo() -> None:
     """CreateVolume → NodeStage → NodePublish → inspect → teardown, over
-    the real sockets (≙ reference README.md:432-449)."""
-    if status() != 0:
-        raise SystemExit("cluster not healthy — `start` first")
+    the real sockets (≙ reference README.md:432-449).
+
+    If the cluster is not already up, it is started for the demo and
+    stopped afterwards — even on failure.  A demo run must never leave
+    daemons behind: on this box a leaked JAX-preloaded process wedges the
+    single TPU for every later user (round-1 postmortem; the reference's
+    fixture kills its daemon's process group for the same reason,
+    test/pkg/spdk/spdk.go:84-278).
+    """
+    started_here = status() != 0
+    if started_here:
+        print("cluster not running — starting it for the demo")
+        import atexit
+
+        start()
+        # Registered only after start() succeeded: a partially-up cluster
+        # makes start() raise "already running", and tearing down the
+        # user's surviving daemons from atexit would destroy state they
+        # were likely inspecting.  Belt and braces from here on:
+        # ``finally`` covers exceptions, atexit covers SIGPIPE/interpreter
+        # teardown paths that skip it.
+        atexit.register(_stop_if_running)
+        try:
+            _wait_file(CSI_SOCKET, timeout=20)
+            import grpc
+
+            # The controller may not have self-registered yet (each daemon
+            # cold-starts a JAX-preloading interpreter); every RPC in the
+            # round trip is idempotent, so retry — but only on the status
+            # codes the registration race actually produces.
+            retryable = (
+                grpc.StatusCode.UNAVAILABLE,
+                grpc.StatusCode.NOT_FOUND,
+                grpc.StatusCode.FAILED_PRECONDITION,
+            )
+            deadline = time.time() + 60
+            while True:
+                try:
+                    _demo_roundtrip()
+                    break
+                except grpc.RpcError as err:
+                    if err.code() not in retryable or time.time() > deadline:
+                        raise
+                    time.sleep(0.5)
+        finally:
+            _stop_if_running()
+        return
+    _demo_roundtrip()
+
+
+def _stop_if_running() -> None:
+    if any(_alive(p) for p in _load_pids().values()):
+        stop()
+
+
+def _demo_roundtrip() -> None:
     import grpc
 
     from oim_tpu.spec import CSI_CONTROLLER, CSI_NODE, csi_pb2
