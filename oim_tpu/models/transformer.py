@@ -344,16 +344,15 @@ def forward_local(
             )
         mb = b // n_micro
         x_micro = x.reshape(n_micro, mb, t_local, cfg.d_model)
-
-        def stage_fn(sp, activation):
-            out, _ = run_stage(sp, activation)
-            return out
-
-        x = gpipe_spmd(stage_fn, stage_params, x_micro, "pp")
+        # Outputs are real only on the LAST stage (zeros elsewhere); the
+        # loss in models/train.py masks to the last stage, so the garbage
+        # logits other stages compute below are never counted.  The MoE
+        # aux loss is collected per (stage, microbatch) with bubble steps
+        # masked out inside the schedule.
+        x, aux = gpipe_spmd(
+            run_stage, stage_params, x_micro, "pp", stage_remat=cfg.remat
+        )
         x = x.reshape(b, t_local, cfg.d_model)
-        # Known limit: the MoE load-balancing aux loss is not collected
-        # under pipeline parallelism (reported as 0).
-        aux = jnp.zeros((), jnp.float32)
     else:
         x, aux = run_stage(stage_params, x)
         aux = jax.lax.psum(aux, "pp")  # no-op at size 1, keeps types uniform

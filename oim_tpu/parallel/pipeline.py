@@ -4,8 +4,16 @@ Written for per-device SPMD code (inside ``shard_map``): each pipeline stage
 holds its slice of the layer stack; activations hop stage→stage with
 ``ppermute`` while microbatches stream through, so at steady state every
 stage computes every step.  The backward pass falls out of JAX's transpose
-of the scan+ppermute (reverse schedule) — correct, and good enough until a
-hand-tuned 1F1B schedule lands.
+of the scan+ppermute (reverse schedule).
+
+Memory: with ``stage_remat`` (default) each schedule step stores only its
+stage *input* for the backward and recomputes the stage's layers — peak
+activation memory drops from O(steps · layers_per_stage) to O(steps)
+activations per device.  A hand-interleaved 1F1B schedule (forward and
+backward of different microbatches in the same tick) cannot be expressed
+through plain autodiff — it would require the pipeline to own its backward
+(explicit per-microbatch vjp with cotangents ppermuted stage→stage-1);
+planned future work.
 
 The schedule runs ``n_micro + n_stages - 1`` steps; device ``i`` works on
 microbatch ``step - i`` when that index is valid.
@@ -24,20 +32,27 @@ def gpipe_spmd(
     stage_params,
     x_microbatches: jax.Array,
     axis_name: str = "pp",
+    stage_remat: bool = True,
 ):
     """Run the pipeline inside shard_map.
 
     Args:
-      stage_fn: ``(stage_params, activation) -> activation`` for one stage's
-        layer stack; activation shape ``[mb, ...]`` must be preserved.
+      stage_fn: ``(stage_params, activation) -> (activation, aux)`` for one
+        stage's layer stack; activation shape ``[mb, ...]`` must be
+        preserved, ``aux`` is a scalar auxiliary loss (e.g. MoE load
+        balancing) summed over the stage's layers.
       stage_params: THIS stage's parameters (already sliced by shard_map).
       x_microbatches: ``[n_micro, mb, ...]`` — the stage-0 input stream
         (replicated over ``pp``; only stage 0 reads it).
       axis_name: the pipeline mesh axis.
+      stage_remat: rematerialize the stage in the backward instead of
+        storing every layer's activations per schedule step.
 
-    Returns ``[n_micro, mb, ...]`` final-stage outputs, replicated to every
-    stage via a single psum at the end (simple and correct; the heavier
-    broadcast is amortized over the whole step).
+    Returns ``(outputs, aux)``: outputs ``[n_micro, mb, ...]`` are REAL ONLY
+    ON THE LAST STAGE (zeros elsewhere — the caller's loss must mask to the
+    last stage, which also keeps replicated-param gradients single-sourced);
+    ``aux`` is the mean-over-microbatches auxiliary loss, psum'd over the
+    pipeline axis (bubble steps are masked out).
     """
     size = jax.lax.axis_size(axis_name)
     index = jax.lax.axis_index(axis_name)
@@ -45,13 +60,17 @@ def gpipe_spmd(
     mb_shape = x_microbatches.shape[1:]
     total_steps = n_micro + size - 1
 
+    if stage_remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
     perm = [(i, (i + 1) % size) for i in range(size)]
-    out_dtype = jax.eval_shape(
+    out_shape, _ = jax.eval_shape(
         lambda p, a: stage_fn(p, a), stage_params, x_microbatches[0]
-    ).dtype
+    )
+    out_dtype = out_shape.dtype
 
     def step(carry, step_idx):
-        state, outputs = carry
+        state, outputs, aux_sum = carry
         # Activation arriving from the previous stage.
         received = jax.lax.ppermute(state, axis_name, perm)
         feed_idx = jnp.clip(step_idx, 0, n_micro - 1)
@@ -59,7 +78,12 @@ def gpipe_spmd(
             x_microbatches, feed_idx, axis=0, keepdims=False
         ).astype(out_dtype)
         my_input = jnp.where(index == 0, stage0_in, received)
-        state = stage_fn(stage_params, my_input)
+        state, aux = stage_fn(stage_params, my_input)
+        # Bubble steps compute on garbage; count aux only when this stage
+        # holds a real microbatch (step - index ∈ [0, n_micro)).
+        mb_idx = step_idx - index
+        is_real = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
+        aux_sum = aux_sum + jnp.where(is_real, aux, 0.0)
         # The last stage emits microbatch (step - size + 1) when valid.
         out_idx = step_idx - (size - 1)
         is_valid = jnp.logical_and(index == size - 1, out_idx >= 0)
@@ -71,7 +95,7 @@ def gpipe_spmd(
         outputs = jax.lax.dynamic_update_index_in_dim(
             outputs, updated, write_idx, axis=0
         )
-        return (state, outputs), None
+        return (state, outputs, aux_sum), None
 
     # The carry varies per pipeline stage; mark the zero inits accordingly
     # (shard_map VMA typing).
@@ -83,10 +107,13 @@ def gpipe_spmd(
         (axis_name,),
         to="varying",
     )
-    (_, outputs), _ = jax.lax.scan(
-        step, (state0, outputs0), jnp.arange(total_steps)
+    aux0 = jax.lax.pcast(
+        jnp.zeros((), jnp.float32), (axis_name,), to="varying"
     )
-    # Only the last stage holds real outputs; share them with every stage so
-    # the loss (and its gradient) is computed identically everywhere.
-    mask = (index == size - 1).astype(outputs.dtype)
-    return jax.lax.psum(outputs * mask, axis_name)
+    (_, outputs, aux_sum), _ = jax.lax.scan(
+        step, (state0, outputs0, aux0), jnp.arange(total_steps)
+    )
+    # Each stage saw every microbatch once; aggregate the per-stage layer
+    # contributions and average over microbatches to match the non-pp path.
+    aux = jax.lax.psum(aux_sum, axis_name) / n_micro
+    return outputs, aux

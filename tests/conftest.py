@@ -55,7 +55,7 @@ def _no_daemon_leaks():
     # process group came through procutil.spawn) — kill and FAIL.
     leaked = procutil.our_leaks()
     for pid, _ in leaked:
-        procutil._killpg(pid, 9)
+        procutil.kill(pid)
     # New daemons we did NOT spawn (another terminal's demo cluster or a
     # concurrent run started mid-session): report, never kill — they are
     # someone else's state.

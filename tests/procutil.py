@@ -53,20 +53,18 @@ def stop_all(procs, timeout: float = 10.0) -> None:
     """Stop many daemons with one SHARED grace period: TERM every group
     first, then wait, then KILL stragglers — worst case ~timeout total,
     not timeout × len(procs)."""
-    import signal as _signal
-
     procs = [p for p in procs if p is not None]
     for proc in procs:
         _LIVE.pop(proc.pid, None)
         if proc.poll() is None:
-            _killpg(proc.pid, _signal.SIGTERM)
+            _killpg(proc.pid, signal.SIGTERM)
     deadline = time.time() + timeout
     for proc in procs:
         if proc.poll() is None:
             try:
                 proc.wait(timeout=max(0.1, deadline - time.time()))
             except subprocess.TimeoutExpired:
-                _killpg(proc.pid, _signal.SIGKILL)
+                _killpg(proc.pid, signal.SIGKILL)
                 proc.wait(timeout=5)
 
 
@@ -120,6 +118,12 @@ def _sweep() -> None:
         if proc.poll() is None:
             _killpg(pid, signal.SIGKILL)
         _LIVE.pop(pid, None)
+
+
+def kill(pid: int) -> None:
+    """SIGKILL a pid (group-wide when it leads its own group) — the public
+    entry for scavenged processes not spawned through this module."""
+    _killpg(pid, signal.SIGKILL)
 
 
 def find_repo_daemons(exclude_pids=()) -> list[tuple[int, str]]:

@@ -111,6 +111,74 @@ class TestTrainingMixes:
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0] * 0.9
 
+    def test_moe_aux_collected_under_pp(self):
+        """The MoE load-balance aux loss must not vanish under pipeline
+        parallelism (round-1 known limit).  pp=2/n_micro=2 routes the same
+        token groups as dp=2 (batch halves), so the full loss — ce AND aux
+        — must match between the two meshes."""
+        cfg_pp = TransformerConfig(
+            **{**TINY, "n_experts": 4, "expert_capacity_factor": 2.0},
+            n_stages=2, n_microbatches=2,
+        )
+        cfg_dp = TransformerConfig(
+            **{**TINY, "n_experts": 4, "expert_capacity_factor": 2.0}
+        )
+        from oim_tpu.models.train import AUX_LOSS_WEIGHT
+
+        def first_metrics(cfg, mesh):
+            params = init_params(jax.random.PRNGKey(0), cfg)
+            optimizer = optax.adamw(1e-2)
+            state = shard_state(TrainState.create(params, optimizer), cfg, mesh)
+            step_fn = make_train_step(cfg, mesh, optimizer)
+            tokens = jax.device_put(
+                _data(8, 16, cfg.vocab_size, seed=5),
+                jax.sharding.NamedSharding(mesh, data_pspec()),
+            )
+            _, metrics = step_fn(state, tokens)
+            return float(metrics["loss"]), float(metrics["ce"])
+
+        loss_pp, ce_pp = first_metrics(cfg_pp, build_mesh(pp=2))
+        loss_dp, ce_dp = first_metrics(cfg_dp, build_mesh(dp=2))
+        aux_pp = (loss_pp - ce_pp) / AUX_LOSS_WEIGHT
+        aux_dp = (loss_dp - ce_dp) / AUX_LOSS_WEIGHT
+        assert aux_pp > 0.5, f"aux under pp vanished: {aux_pp}"
+        np.testing.assert_allclose(ce_pp, ce_dp, rtol=1e-4)
+        np.testing.assert_allclose(aux_pp, aux_dp, rtol=1e-3)
+
+    def test_stage_remat_lowers_peak_memory(self):
+        """stage_remat must cut compiled peak temp memory vs storing every
+        layer activation per schedule step, at identical loss."""
+        from dataclasses import replace
+
+        from oim_tpu.models.train import _build_train_step
+
+        cfg = TransformerConfig(
+            **{**TINY, "n_layers": 4}, n_stages=2, n_microbatches=4
+        )
+        mesh = build_mesh(pp=2)
+        tokens = jax.device_put(
+            _data(8, 32, cfg.vocab_size),
+            jax.sharding.NamedSharding(mesh, data_pspec()),
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        optimizer = optax.adamw(1e-2)
+
+        def peak_and_loss(remat):
+            c = replace(cfg, remat=remat)
+            state = shard_state(TrainState.create(params, optimizer), c, mesh)
+            step = jax.jit(_build_train_step(c, mesh, optimizer))
+            compiled = step.lower(state, tokens).compile()
+            mem = compiled.memory_analysis()
+            _, metrics = compiled(state, tokens)
+            return mem.temp_size_in_bytes, float(metrics["loss"])
+
+        peak_remat, loss_remat = peak_and_loss(True)
+        peak_full, loss_full = peak_and_loss(False)
+        np.testing.assert_allclose(loss_remat, loss_full, rtol=1e-4)
+        assert peak_remat < peak_full, (
+            f"remat {peak_remat} !< full {peak_full}"
+        )
+
     def test_moe_ep(self):
         cfg = TransformerConfig(
             **{**TINY, "n_experts": 4, "expert_capacity_factor": 2.0}

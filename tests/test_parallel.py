@@ -230,14 +230,26 @@ class TestPipeline:
         def stage_fn(w, a):
             return jnp.tanh(a @ w)
 
-        piped = jax.shard_map(
-            lambda w, xm: gpipe_spmd(
-                lambda p, a: stage_fn(p[0], a), w, xm, "pp"
-            ),
+        def piped_fn(w, xm):
+            out, aux = gpipe_spmd(
+                lambda p, a: (stage_fn(p[0], a), jnp.float32(1.0)), w, xm,
+                "pp",
+            )
+            # Outputs are real only on the last stage; replicate them the
+            # way a loss would (masked psum) for comparison, and return the
+            # aux to check bubble masking: each stage contributes 1.0 per
+            # real microbatch -> sum/n_micro = n_stages.
+            idx = jax.lax.axis_index("pp")
+            mask = (idx == jax.lax.axis_size("pp") - 1).astype(out.dtype)
+            return jax.lax.psum(out * mask, "pp"), aux
+
+        piped, aux = jax.shard_map(
+            piped_fn,
             mesh=mesh,
             in_specs=(P("pp", None, None), P(None)),
-            out_specs=P(None),
+            out_specs=(P(None), P()),
         )(ws, x)
+        np.testing.assert_allclose(float(aux), n_stages, rtol=1e-6)
 
         expected = x
         for s in range(n_stages):
@@ -256,10 +268,17 @@ class TestPipeline:
             return jnp.tanh(a @ w)
 
         def loss_piped(ws):
+            def piped_fn(w, xm):
+                out, _ = gpipe_spmd(
+                    lambda p, a: (stage_fn(p[0], a), jnp.float32(0.0)),
+                    w, xm, "pp",
+                )
+                idx = jax.lax.axis_index("pp")
+                mask = (idx == jax.lax.axis_size("pp") - 1).astype(out.dtype)
+                return jax.lax.psum(out * mask, "pp")
+
             out = jax.shard_map(
-                lambda w, xm: gpipe_spmd(
-                    lambda p, a: stage_fn(p[0], a), w, xm, "pp"
-                ),
+                piped_fn,
                 mesh=mesh,
                 in_specs=(P("pp", None, None), P(None)),
                 out_specs=P(None),
