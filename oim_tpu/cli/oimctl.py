@@ -51,6 +51,10 @@ def main(argv=None) -> int:
     unmap = sub.add_parser("unmap")
     unmap.add_argument("volume")
     unmap.add_argument("--controller", required=True)
+    topo = sub.add_parser("topology", help="chip inventory of a controller")
+    topo.add_argument("--controller", required=True)
+    slices = sub.add_parser("slices", help="allocations on a controller")
+    slices.add_argument("--controller", required=True)
     trace = sub.add_parser(
         "trace", help="render cross-process traces from --trace-file JSONLs"
     )
@@ -110,6 +114,27 @@ def main(argv=None) -> int:
                 metadata=(("controllerid", args.controller),),
                 timeout=60,
             )
+        elif args.command == "topology":
+            reply = CONTROLLER.stub(channel).GetTopology(
+                oim_pb2.GetTopologyRequest(),
+                metadata=(("controllerid", args.controller),),
+                timeout=30,
+            )
+            print(
+                f"chips={reply.chip_count} free={reply.free_chips} "
+                f"mesh={list(reply.mesh.dims)} accel={reply.accel_type}"
+            )
+        elif args.command == "slices":
+            reply = CONTROLLER.stub(channel).ListSlices(
+                oim_pb2.ListSlicesRequest(),
+                metadata=(("controllerid", args.controller),),
+                timeout=30,
+            )
+            for s in reply.slices:
+                print(
+                    f"{s.name}: chips={s.chip_count} mesh={list(s.mesh.dims)}"
+                    f" provisioned={s.provisioned} attached={s.attached}"
+                )
     except grpc.RpcError as exc:
         print(f"error: {exc.code().name}: {exc.details()}")
         return 1

@@ -339,6 +339,32 @@ class Controller:
             )
         return oim_pb2.CheckSliceReply(chip_count=alloc["chip_count"])
 
+    def GetTopology(self, request: oim_pb2.GetTopologyRequest, context) -> oim_pb2.GetTopologyReply:
+        """Chip inventory for remote GetCapacity — the reference declared
+        remote capacity but never plumbed it (≙ controllerserver.go:150-159)."""
+        topo = self._call_agent(context, lambda a: a.get_topology())
+        return oim_pb2.GetTopologyReply(
+            chip_count=topo["chip_count"],
+            free_chips=topo["free_chips"],
+            mesh=oim_pb2.MeshShape(dims=topo["mesh"]),
+            accel_type=topo.get("accel_type", ""),
+        )
+
+    def ListSlices(self, request: oim_pb2.ListSlicesRequest, context) -> oim_pb2.ListSlicesReply:
+        """Allocation inventory for CSI ListVolumes
+        (≙ controllerserver.go:161, get_vhost_controllers)."""
+        allocs = self._call_agent(context, lambda a: a.get_allocations())
+        reply = oim_pb2.ListSlicesReply()
+        for alloc in allocs:
+            reply.slices.add(
+                name=alloc["name"],
+                chip_count=alloc["chip_count"],
+                mesh=oim_pb2.MeshShape(dims=alloc["mesh"]),
+                provisioned=alloc["provisioned"],
+                attached=alloc["attached"],
+            )
+        return reply
+
     # -- self-registration heartbeat ---------------------------------------
 
     def start(self, advertised_address: str) -> None:

@@ -221,6 +221,13 @@ class LocalBackend:
         with self._agent() as agent:
             return agent.get_topology()["free_chips"]
 
+    def list_volumes(self) -> list[dict]:
+        with self._agent() as agent:
+            return [
+                {"name": a["name"], "chip_count": a["chip_count"]}
+                for a in agent.get_allocations()
+            ]
+
     def volume_exists(self, volume_id: str) -> bool:
         """Any allocation counts — a statically provisioned volume staged
         on demand (provisioned=False) still exists for CSI purposes."""
@@ -383,10 +390,31 @@ class RemoteBackend:
         self._call(run)
 
     def capacity(self) -> int:
-        raise VolumeError(
-            grpc.StatusCode.UNIMPLEMENTED,
-            "capacity reporting requires local mode",
-        )
+        """Free chips on the mapped controller's device plane, through the
+        proxy (the reference left remote capacity UNIMPLEMENTED;
+        ≙ controllerserver.go:150-159 + this repo's GetTopology RPC)."""
+        def run(channel):
+            return CONTROLLER.stub(channel).GetTopology(
+                oim_pb2.GetTopologyRequest(),
+                metadata=self._metadata(),
+                timeout=30,
+            ).free_chips
+
+        return self._call(run)
+
+    def list_volumes(self) -> list[dict]:
+        def run(channel):
+            reply = CONTROLLER.stub(channel).ListSlices(
+                oim_pb2.ListSlicesRequest(),
+                metadata=self._metadata(),
+                timeout=30,
+            )
+            return [
+                {"name": s.name, "chip_count": s.chip_count}
+                for s in reply.slices
+            ]
+
+        return self._call(run)
 
     def volume_exists(self, volume_id: str) -> bool:
         def run(channel):

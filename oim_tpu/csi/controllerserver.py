@@ -160,12 +160,56 @@ class ControllerServer:
             self._abort(context, exc)
         return csi_pb2.GetCapacityResponse(available_capacity=free)
 
+    # ListVolumes pagination tokens: "n:<volume_id>" = resume after that
+    # name.  Name-based (not index-based) so a volume deleted between pages
+    # cannot shift later entries out of the listing.
+    _TOKEN_PREFIX = "n:"
+
+    def ListVolumes(self, request, context) -> csi_pb2.ListVolumesResponse:
+        """Allocations as CSI volumes, with CSI-standard token pagination
+        (the reference declared LIST_VOLUMES but returned UNIMPLEMENTED,
+        controllerserver.go:161)."""
+        if request.max_entries < 0:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "max_entries must be >= 0"
+            )
+        after = ""
+        if request.starting_token:
+            if not request.starting_token.startswith(self._TOKEN_PREFIX):
+                context.abort(
+                    grpc.StatusCode.ABORTED,
+                    f"invalid starting_token {request.starting_token!r}",
+                )
+            after = request.starting_token[len(self._TOKEN_PREFIX):]
+        try:
+            volumes = sorted(
+                self.backend.list_volumes(), key=lambda v: v["name"]
+            )
+        except VolumeError as exc:
+            self._abort(context, exc)
+        remaining = [v for v in volumes if v["name"] > after]
+        end = (
+            min(request.max_entries, len(remaining))
+            if request.max_entries
+            else len(remaining)
+        )
+        response = csi_pb2.ListVolumesResponse()
+        for vol in remaining[:end]:
+            entry = response.entries.add()
+            entry.volume.volume_id = vol["name"]
+            entry.volume.capacity_bytes = vol["chip_count"]
+            entry.volume.volume_context["chipCount"] = str(vol["chip_count"])
+        if end < len(remaining):
+            response.next_token = self._TOKEN_PREFIX + remaining[end - 1]["name"]
+        return response
+
     def ControllerGetCapabilities(
         self, request, context
     ) -> csi_pb2.ControllerGetCapabilitiesResponse:
         response = csi_pb2.ControllerGetCapabilitiesResponse()
         for rpc_type in (
             csi_pb2.ControllerServiceCapability.RPC.CREATE_DELETE_VOLUME,
+            csi_pb2.ControllerServiceCapability.RPC.LIST_VOLUMES,
             csi_pb2.ControllerServiceCapability.RPC.GET_CAPACITY,
         ):
             cap = response.capabilities.add()

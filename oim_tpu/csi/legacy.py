@@ -122,7 +122,11 @@ class ControllerServer0:
         )
         out = csi0_pb2.ControllerGetCapabilitiesResponse()
         for cap in reply.capabilities:
-            # RPC capability types share numbering across versions.
+            # RPC capability types share numbering across versions — but
+            # only advertise what this personality actually implements
+            # (no v0 ListVolumes shim exists).
+            if cap.rpc.type == csi_pb2.ControllerServiceCapability.RPC.LIST_VOLUMES:
+                continue
             out.capabilities.add().rpc.type = cap.rpc.type
         return out
 

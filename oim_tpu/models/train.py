@@ -54,11 +54,11 @@ def _local_loss(params, tokens, cfg: TransformerConfig):
     """Per-device loss over the local [b, t] token shard.
 
     The cross-entropy terms are masked to the *last* pipeline stage before
-    the psum: under pp the logits are psum-broadcast to every stage, and
-    counting each stage's identical copy would both scale the loss and send
-    head/final-norm gradient contributions to every stage — the mask keeps
-    exactly one contribution, so the later per-axis gradient psums in
-    ``make_train_step`` are uniform.
+    the psum: under pp only the last stage's pipeline outputs are real
+    (other stages hold zeros — see ``gpipe_spmd``), so the mask selects the
+    one stage whose logits mean anything, keeps the loss unscaled, and
+    sends head/final-norm gradient contributions from exactly one stage so
+    the later per-axis gradient psums in ``make_train_step`` are uniform.
     """
     sp_size = jax.lax.axis_size("sp")
     sp_index = jax.lax.axis_index("sp")

@@ -93,6 +93,8 @@ CONTROLLER = ServiceSpec(
             oim_pb2.ProvisionSliceReply,
         ),
         "CheckSlice": (oim_pb2.CheckSliceRequest, oim_pb2.CheckSliceReply),
+        "GetTopology": (oim_pb2.GetTopologyRequest, oim_pb2.GetTopologyReply),
+        "ListSlices": (oim_pb2.ListSlicesRequest, oim_pb2.ListSlicesReply),
     },
 )
 
@@ -120,6 +122,7 @@ CSI_CONTROLLER = ServiceSpec(
             csi_pb2.ValidateVolumeCapabilitiesRequest,
             csi_pb2.ValidateVolumeCapabilitiesResponse,
         ),
+        "ListVolumes": (csi_pb2.ListVolumesRequest, csi_pb2.ListVolumesResponse),
         "GetCapacity": (csi_pb2.GetCapacityRequest, csi_pb2.GetCapacityResponse),
         "ControllerGetCapabilities": (
             csi_pb2.ControllerGetCapabilitiesRequest,

@@ -193,6 +193,39 @@ def test_check_slice_ignores_on_demand(ctrl):
     assert err.value.code() == grpc.StatusCode.NOT_FOUND
 
 
+def test_get_topology(ctrl):
+    topo = ctrl.GetTopology(oim_pb2.GetTopologyRequest(), timeout=10)
+    assert topo.chip_count == 4
+    assert topo.free_chips == 4
+    assert list(topo.mesh.dims) == [2, 2, 1]
+    assert topo.accel_type == "v5p"
+    _map_slice(ctrl, "vol-t", 2)
+    assert (
+        ctrl.GetTopology(oim_pb2.GetTopologyRequest(), timeout=10).free_chips
+        == 2
+    )
+
+
+def test_list_slices(ctrl):
+    assert (
+        ctrl.ListSlices(oim_pb2.ListSlicesRequest(), timeout=10).slices == []
+    )
+    _map_slice(ctrl, "vol-a", 2)
+    ctrl.ProvisionSlice(
+        oim_pb2.ProvisionSliceRequest(name="vol-b", chip_count=1), timeout=10
+    )
+    slices = {
+        s.name: s
+        for s in ctrl.ListSlices(oim_pb2.ListSlicesRequest(), timeout=10).slices
+    }
+    assert set(slices) == {"vol-a", "vol-b"}
+    assert slices["vol-a"].chip_count == 2
+    assert slices["vol-a"].attached  # MapVolume attaches
+    assert not slices["vol-a"].provisioned
+    assert slices["vol-b"].provisioned
+    assert not slices["vol-b"].attached
+
+
 def test_agent_down_is_unavailable(tmp_path):
     controller = Controller("ctrl-1", str(tmp_path / "nope.sock"))
     srv = controller.start_server("tcp://127.0.0.1:0")

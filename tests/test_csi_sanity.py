@@ -237,12 +237,63 @@ def test_sanity_controller_capabilities_coherent(endpoint):
         ).capabilities
     }
     assert csi_pb2.ControllerServiceCapability.RPC.CREATE_DELETE_VOLUME in caps
-    if csi_pb2.ControllerServiceCapability.RPC.GET_CAPACITY in caps:
-        if mode == "local":
-            reply = controller.GetCapacity(
-                csi_pb2.GetCapacityRequest(), timeout=10
+    # Every advertised capability must work in BOTH modes — remote
+    # GetCapacity/ListVolumes ride the new GetTopology/ListSlices proxy RPCs
+    # (the reference left remote capacity UNIMPLEMENTED).
+    assert csi_pb2.ControllerServiceCapability.RPC.GET_CAPACITY in caps
+    reply = controller.GetCapacity(csi_pb2.GetCapacityRequest(), timeout=10)
+    assert reply.available_capacity == 4
+    assert csi_pb2.ControllerServiceCapability.RPC.LIST_VOLUMES in caps
+    listing = controller.ListVolumes(csi_pb2.ListVolumesRequest(), timeout=10)
+    assert listing.entries == []  # nothing provisioned yet in this fixture
+
+
+def test_sanity_list_volumes_pagination(endpoint):
+    """ListVolumes over both backends with CSI token pagination."""
+    channel, _, _ = endpoint
+    controller = CSI_CONTROLLER.stub(channel)
+    names = [f"lv-{i}" for i in range(3)]
+    for name in names:
+        controller.CreateVolume(
+            csi_pb2.CreateVolumeRequest(
+                name=name,
+                volume_capabilities=[_cap()],
+                parameters={"chipCount": "1"},
+            ),
+            timeout=10,
+        )
+    try:
+        page1 = controller.ListVolumes(
+            csi_pb2.ListVolumesRequest(max_entries=2), timeout=10
+        )
+        assert [e.volume.volume_id for e in page1.entries] == names[:2]
+        assert page1.entries[0].volume.capacity_bytes == 1
+        assert page1.next_token
+        # Name-based tokens stay stable under concurrent deletes: removing
+        # an already-listed volume must not shift later entries out.
+        controller.DeleteVolume(
+            csi_pb2.DeleteVolumeRequest(volume_id=names[0]), timeout=10
+        )
+        page2 = controller.ListVolumes(
+            csi_pb2.ListVolumesRequest(
+                max_entries=2, starting_token=page1.next_token
+            ),
+            timeout=10,
+        )
+        assert [e.volume.volume_id for e in page2.entries] == names[2:]
+        assert not page2.next_token
+        bad = _code(lambda: controller.ListVolumes(
+            csi_pb2.ListVolumesRequest(starting_token="nonsense"), timeout=10
+        ))
+        assert bad == grpc.StatusCode.ABORTED
+    finally:
+        for name in names:
+            controller.DeleteVolume(
+                csi_pb2.DeleteVolumeRequest(volume_id=name), timeout=10
             )
-            assert reply.available_capacity == 4
+    assert controller.ListVolumes(
+        csi_pb2.ListVolumesRequest(), timeout=10
+    ).entries == []
 
 
 # -- Node service -----------------------------------------------------------
