@@ -158,8 +158,20 @@ int main(int argc, char** argv) {
     Usage(argv[0]);
     return 2;
   }
+  // The three inventory modes are mutually exclusive; silently letting one
+  // win would hand an operator a different chip inventory than the flags
+  // they passed describe.
+  int inventory_modes = (fake_chips > 0 ? 1 : 0) +
+                        (devices_glob.empty() ? 0 : 1) +
+                        (chips_from_pjrt ? 1 : 0);
+  if (inventory_modes > 1) {
+    std::fprintf(stderr,
+                 "--fake-chips, --devices and --chips-from-pjrt are mutually "
+                 "exclusive inventory modes; pass exactly one\n");
+    return 2;
+  }
   // Real mode is the default: scan the standard TPU accel device nodes.
-  if (fake_chips <= 0 && devices_glob.empty() && !chips_from_pjrt) {
+  if (inventory_modes == 0) {
     devices_glob = "/dev/accel*";
   }
 
@@ -215,6 +227,12 @@ int main(int argc, char** argv) {
     // An explicit --mesh wins: keep the operator's topology, linear id
     // order (the product check below still validates it).
     bool coords_ordered = false;
+    if (have_coords && !mesh_spec.empty()) {
+      std::fprintf(stderr,
+                   "warning: --mesh overrides PJRT-reported torus coords; "
+                   "devices are ordered by id, which may not match the "
+                   "physical topology\n");
+    }
     if (have_coords && mesh_spec.empty()) {
       std::vector<int> bounds(coord_rank, 0);
       for (const PjrtDev& d : devs) {

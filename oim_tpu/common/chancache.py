@@ -45,6 +45,11 @@ class ChannelCache:
         # thread may still be mid-RPC on them, and grpc.Channel.close()
         # cancels in-flight calls.  Retired channels close once the grace
         # (longer than any control-plane call timeout) has passed.
+        # CONSTRAINT: idle timing runs from the last get(), not the last
+        # RPC — any single call (notably a proxied inbound stream) that
+        # outlives max_idle_s + retire_grace_s can have its channel closed
+        # mid-call by an unrelated acquire.  Keep the sum above the longest
+        # stream deadline the server allows, or raise retire_grace_s.
         self.retire_grace_s = retire_grace_s
         self._lock = threading.Lock()
         self._entries: dict[
@@ -124,12 +129,17 @@ class ChannelCache:
     def invalidate(self, key: Hashable) -> None:
         """Drop ``key`` so the next acquire re-dials.  The old channel is
         retired (closed after the grace), not cancelled out from under
-        concurrent calls."""
+        concurrent calls.  Ripe retirees are also reaped here, so traffic
+        stopping after an invalidation cannot strand sockets until some
+        future get()."""
         now = time.monotonic()
         with self._lock:
             entry = self._entries.pop(key, None)
             if entry is not None:
                 self._retire_locked(entry[1], now)
+            to_close = self._reap_locked(now)
+        for channel in to_close:
+            channel.close()
 
     def close(self) -> None:
         """Immediate close of everything — process/driver shutdown."""

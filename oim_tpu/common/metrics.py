@@ -327,6 +327,30 @@ class MetricsServerInterceptor(ObservingServerInterceptor):
 # /metrics HTTP exposition
 
 
+def _split_host_port(address: str) -> tuple[str, str]:
+    """``host:port`` → (host, port), IPv6-bracket-aware.
+
+    ``[::1]:9090`` yields ``::1`` (ThreadingHTTPServer rejects the
+    brackets); a bare ``9090`` (no colon) is an error rather than silently
+    binding all interfaces; ``:9090`` keeps the Go empty-host convention.
+    """
+    host, sep, port = address.rpartition(":")
+    if not sep:
+        raise ValueError(
+            f"metrics address {address!r} must be host:port (':{address}' "
+            "for all interfaces)"
+        )
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    elif ":" in host:
+        raise ValueError(
+            f"IPv6 metrics address must be bracketed: '[{host}]:{port}'"
+        )
+    if port and not port.isdigit():
+        raise ValueError(f"metrics address {address!r} has non-numeric port")
+    return host, port
+
+
 class MetricsServer:
     """Minimal scrape endpoint: ``GET /metrics`` on a host:port."""
 
@@ -334,7 +358,7 @@ class MetricsServer:
         self, address: str = "127.0.0.1:0",
         registry_: MetricsRegistry | None = None,
     ):
-        host, _, port = address.rpartition(":")
+        host, port = _split_host_port(address)
         reg = registry_ or _registry
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -355,9 +379,15 @@ class MetricsServer:
                 pass
 
         # Go convention: an empty host (":9090") binds all interfaces.
-        self._httpd = http.server.ThreadingHTTPServer(
-            (host, int(port or 0)), Handler
-        )
+        server_cls = http.server.ThreadingHTTPServer
+        if ":" in host:  # IPv6 literal (unbracketed by _split_host_port)
+            import socket as _socket
+
+            class _V6Server(http.server.ThreadingHTTPServer):
+                address_family = _socket.AF_INET6
+
+            server_cls = _V6Server
+        self._httpd = server_cls((host, int(port or 0)), Handler)
         self._thread: threading.Thread | None = None
 
     @property

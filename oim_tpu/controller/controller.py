@@ -21,6 +21,7 @@
 from __future__ import annotations
 
 import threading
+import time
 
 import grpc
 
@@ -82,20 +83,37 @@ class Controller:
         # re-dials instead of failing forever.
         self._scrape_agent_conn: Agent | None = None
         self._scrape_lock = threading.Lock()
+        # Gauge values are cached with a staleness bound so a wedged agent
+        # adds at most ONE 2s stall per TTL to /metrics renders (not 2s per
+        # series per scrape), and a scrape failure serves the last good
+        # value while oim_metrics_scrape_errors_total records that the
+        # series is stale instead of letting it silently vanish.
+        self._scrape_cache: dict[str, tuple[float, float]] = {}
+        self._scrape_cache_lock = threading.Lock()
+        self._scrape_errors = metrics.registry().counter(
+            "oim_metrics_scrape_errors_total",
+            "Agent scrape failures during /metrics renders (served stale).",
+            ("controller",),
+        )
         self._chips_gauge = metrics.registry().gauge(
             "oim_chips_total", "Chips the device-plane agent owns.",
             ("controller",),
         )
-        self._chips_cb = lambda: len(self._scrape(lambda a: a.get_chips()))
+        self._chips_cb = lambda: self._cached_scrape(
+            "chips", lambda: len(self._scrape(lambda a: a.get_chips()))
+        )
         self._chips_gauge.set_function(self._chips_cb, controller_id)
         self._allocated_gauge = metrics.registry().gauge(
             "oim_chips_allocated", "Chips attached to mapped volumes.",
             ("controller",),
         )
-        self._allocated_cb = lambda: sum(
-            len(a.get("chips", ()))
-            for a in self._scrape(lambda ag: ag.get_allocations())
-            if a.get("attached")
+        self._allocated_cb = lambda: self._cached_scrape(
+            "allocated",
+            lambda: sum(
+                len(a.get("chips", ()))
+                for a in self._scrape(lambda ag: ag.get_allocations())
+                if a.get("attached")
+            ),
         )
         self._allocated_gauge.set_function(self._allocated_cb, controller_id)
 
@@ -109,6 +127,31 @@ class Controller:
             if self._agent is None:
                 self._agent = Agent(self.agent_socket)
             return self._agent
+
+    SCRAPE_CACHE_TTL = 10.0
+
+    def _cached_scrape(self, name: str, fn):
+        """``fn()`` with a TTL cache; on failure serve the last good value
+        (bumping the scrape-error counter) rather than vanishing the
+        series.  One lock over check→scrape→stamp so concurrent renders
+        (ThreadingHTTPServer) cannot each pay the scrape stall."""
+        with self._scrape_cache_lock:
+            now = time.monotonic()
+            cached = self._scrape_cache.get(name)
+            if cached is not None and now - cached[1] < self.SCRAPE_CACHE_TTL:
+                return cached[0]
+            try:
+                value = float(fn())
+            except Exception:
+                self._scrape_errors.inc(self.controller_id)
+                if cached is not None:
+                    # Serve stale AND re-stamp: a wedged agent costs one
+                    # timeout per series per TTL, not one per render.
+                    self._scrape_cache[name] = (cached[0], now)
+                    return cached[0]
+                raise
+            self._scrape_cache[name] = (value, now)
+            return value
 
     def _scrape(self, fn):
         """Run ``fn(agent)`` on the metrics-only connection, dropping it on

@@ -14,9 +14,12 @@ SURVEY.md §2.3).  Design points, all TPU-driven:
 - **Static shapes.**  Every batch is exactly ``[batch_local, seq+1]``
   (inputs and shifted targets share the +1); ragged tails are dropped,
   never padded — a padded tail would recompile the train step.
-- **Zero-copy friendly.**  Sources are numpy arrays / memmaps; slicing
-  produces views; the device transfer happens in the prefetcher
-  (oim_tpu.data.prefetch), not here.
+- **Memmap-friendly.**  Sources are numpy arrays / memmaps and the
+  *source reads* are plain slices — but gathering a shuffled batch
+  necessarily copies each window into a freshly allocated batch array
+  (budget ~``batch·(seq+1)·itemsize`` per step, not corpus-sized).  The
+  device transfer happens in the prefetcher (oim_tpu.data.prefetch),
+  not here.
 """
 
 from __future__ import annotations
