@@ -29,6 +29,11 @@ agent:
 test:
 	$(PYTHON) -m pytest tests/ -x -q
 
+# Tier 3: the full stack driving a first op on the real accelerator
+# (≙ reference env-gated real-SPDK tests, test/test.make:1-16).
+test-real:
+	TEST_REAL_TPU=1 $(PYTHON) -m pytest tests/test_real_tpu.py -q
+
 # Interactive demo cluster (≙ reference test/start-stop.make).
 start:
 	$(PYTHON) tools/demo_cluster.py start

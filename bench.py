@@ -368,11 +368,20 @@ def _run(tmp: str, agent_sock: str, cleanups: list, extras: dict) -> int:
             ),
             timeout=30,
         )
-        # Pod starts: read the bootstrap, run the first accelerator op and
-        # observe its result (see readback note above).
+        # Pod starts: read the bootstrap, bind to the staged chips (a
+        # no-op when the agent stages fake chip files, as on this box —
+        # chip_binding_env returns {} unless the paths are real
+        # /dev/accelN or pjrt:N devices), run the first accelerator op
+        # and observe its result (see readback note above).
+        from oim_tpu.parallel import Bootstrap, chip_binding_env
+
         with open(os.path.join(target, "tpu-bootstrap.json")) as f:
             bootstrap = json.load(f)
         assert len(bootstrap["chips"]) == 4
+        binding = chip_binding_env(
+            Bootstrap(chips=bootstrap["chips"], mesh=bootstrap.get("mesh", []))
+        )
+        extras.setdefault("chip_binding", bool(binding))
         float(first_op(warm))
         elapsed_ms = (time.perf_counter() - start) * 1000
         # Teardown outside the timed region.

@@ -139,16 +139,22 @@ class Controller:
             now = time.monotonic()
             cached = self._scrape_cache.get(name)
             if cached is not None and now - cached[1] < self.SCRAPE_CACHE_TTL:
+                if cached[0] is None:  # recent failure, no good value yet
+                    raise RuntimeError(
+                        f"agent scrape {name!r} failing (cooling down)"
+                    )
                 return cached[0]
             try:
                 value = float(fn())
             except Exception:
                 self._scrape_errors.inc(self.controller_id)
-                if cached is not None:
-                    # Serve stale AND re-stamp: a wedged agent costs one
-                    # timeout per series per TTL, not one per render.
-                    self._scrape_cache[name] = (cached[0], now)
-                    return cached[0]
+                # Re-stamp stale value OR a failure sentinel: a wedged
+                # agent costs one timeout per series per TTL even before
+                # the first successful scrape, not one per render.
+                stale = cached[0] if cached is not None else None
+                self._scrape_cache[name] = (stale, now)
+                if stale is not None:
+                    return stale
                 raise
             self._scrape_cache[name] = (value, now)
             return value

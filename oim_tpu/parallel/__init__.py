@@ -17,8 +17,11 @@ from oim_tpu.parallel.sharding import (
 )
 from oim_tpu.parallel.coordinator import (
     Bootstrap,
-    load_bootstrap,
+    apply_chip_binding,
+    chip_binding_env,
+    initialize,
     initialize_distributed,
+    load_bootstrap,
 )
 from oim_tpu.parallel.ring_attention import ring_attention
 from oim_tpu.parallel.ulysses import ulysses_attention
@@ -34,8 +37,11 @@ __all__ = [
     "named_sharding",
     "constrain",
     "Bootstrap",
-    "load_bootstrap",
+    "apply_chip_binding",
+    "chip_binding_env",
+    "initialize",
     "initialize_distributed",
+    "load_bootstrap",
     "ring_attention",
     "ulysses_attention",
     "collectives",

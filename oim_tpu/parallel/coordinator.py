@@ -12,11 +12,30 @@ from __future__ import annotations
 
 import json
 import os
+import re
+import sys
 from dataclasses import dataclass, field
 
 from oim_tpu import log
 
 DEFAULT_BOOTSTRAP_PATH = "/tpu/tpu-bootstrap.json"
+
+_ACCEL_RE = re.compile(r"^/dev/accel(\d+)$")
+_PJRT_RE = re.compile(r"^pjrt:(\d+)$")
+
+
+def _jax_backends_initialized() -> bool:
+    """True iff a JAX backend is already live (binding would be too late).
+    jax being merely *imported* is fine — libtpu reads the env at backend
+    init, not import."""
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge.backends_are_initialized())
+    except Exception:
+        return True  # unknown internals: assume the worst, warn
 
 
 @dataclass
@@ -49,6 +68,69 @@ def load_bootstrap(path: str = "") -> Bootstrap:
     )
 
 
+def chip_binding_env(bootstrap: Bootstrap) -> dict[str, str]:
+    """libtpu/PJRT env restricting a JAX process to the volume's chips.
+
+    The reference's whole point was that the attach handed the workload
+    *its* device at a specific BDF (remote.go:249-290 waits for exactly
+    that device to appear); the TPU analog is the staged chip set — without
+    it, a pod on a multi-tenant host would initialize every chip on the
+    host.  Chip indices come from the staged device paths (``/dev/accelN``
+    in real mode, ``pjrt:N`` in chips-from-pjrt mode).  Returns ``{}`` for
+    fake/stub devices (CPU test fixtures), where there is nothing to bind.
+    """
+    indices = []
+    for chip in bootstrap.chips:
+        path = chip.get("device_path", "")
+        m = _ACCEL_RE.match(path) or _PJRT_RE.match(path)
+        if m is None:
+            return {}
+        indices.append(int(m.group(1)))
+    if not indices:
+        return {}
+    env = {
+        "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in sorted(indices)),
+    }
+    if bootstrap.mesh and bootstrap.num_processes <= 1:
+        # Single-process sub-host slice: tell libtpu the slice topology so
+        # it builds the allocation's mesh, not the host's.  Multi-host
+        # process layout is the distributed coordinator's job — the
+        # per-process bounds would be wrong to guess here.
+        dims = (list(bootstrap.mesh) + [1, 1, 1])[:3]
+        env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = ",".join(str(d) for d in dims)
+        env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+    return env
+
+
+def apply_chip_binding(bootstrap: Bootstrap) -> dict[str, str]:
+    """Export the binding env (must run BEFORE jax/libtpu initialize).
+
+    Returns what was applied ({} when the staged devices are fakes).  If
+    jax is already imported the binding may be too late to matter — that is
+    a workload bug, so it is warned about loudly rather than hidden.
+    """
+    env = chip_binding_env(bootstrap)
+    if not env:
+        log.current().debug(
+            "no chip binding applied (fake/stub device paths)",
+            volume=bootstrap.volume_id,
+        )
+        return env
+    if _jax_backends_initialized():
+        # Importing jax is fine (env is read at backend init, and this
+        # package itself imports jax) — an already-initialized backend is
+        # the genuinely-too-late case: libtpu has claimed its chips.
+        log.current().warning(
+            "apply_chip_binding after the JAX backend initialized: libtpu "
+            "already owns its chips; bind before the first device touch"
+        )
+    os.environ.update(env)
+    log.current().info(
+        "chip binding applied", volume=bootstrap.volume_id, **env
+    )
+    return env
+
+
 def initialize_distributed(bootstrap: Bootstrap) -> None:
     """Form the multi-host process group when the slice spans hosts.
 
@@ -75,11 +157,12 @@ def initialize_distributed(bootstrap: Bootstrap) -> None:
 
 
 def initialize(path: str = "", **mesh_kwargs):
-    """One-call workload entry: read bootstrap, join the process group,
-    return the logical mesh.  ``mesh_kwargs`` are the pp/sp/tp/ep sizes for
-    ``mesh_from_bootstrap``."""
+    """One-call workload entry: read bootstrap, bind to the staged chips,
+    join the process group, return the logical mesh.  ``mesh_kwargs`` are
+    the pp/sp/tp/ep sizes for ``mesh_from_bootstrap``."""
     from oim_tpu.parallel.mesh import mesh_from_bootstrap
 
     bootstrap = load_bootstrap(path)
+    apply_chip_binding(bootstrap)
     initialize_distributed(bootstrap)
     return mesh_from_bootstrap(bootstrap, **mesh_kwargs)

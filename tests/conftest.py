@@ -10,6 +10,15 @@ tiers without SPDK/QEMU, /root/reference/test/test.make:1-16).
 import os
 import sys
 
+# Stash the ambient accelerator env before forcing CPU, so the env-gated
+# real-TPU tier (tests/test_real_tpu.py) can hand subprocesses the
+# original values back.
+os.environ.setdefault(
+    "_OIM_ORIG_PALLAS_AXON_POOL_IPS", os.environ.get("PALLAS_AXON_POOL_IPS", "")
+)
+os.environ.setdefault(
+    "_OIM_ORIG_JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "")
+)
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")

@@ -120,6 +120,43 @@ def _sweep() -> None:
         _LIVE.pop(pid, None)
 
 
+def wait_unix_socket(
+    path: str, proc: subprocess.Popen | None = None, timeout: float = 10.0
+) -> None:
+    """Block until a Unix socket accepts connections.
+
+    Fails fast with the child's exit code + stderr when ``proc`` dies
+    before the socket appears (the shared replacement for the per-file
+    copies of this loop in the test fixtures and bench)."""
+    import socket
+
+    deadline = time.time() + timeout
+    while True:
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            probe.connect(path)
+            probe.close()
+            return
+        except OSError:
+            probe.close()
+        if proc is not None and proc.poll() is not None:
+            err = ""
+            if proc.stderr is not None:
+                try:
+                    err = proc.stderr.read()
+                    if isinstance(err, bytes):
+                        err = err.decode(errors="replace")
+                except Exception:
+                    pass
+            raise RuntimeError(
+                f"daemon exited rc={proc.returncode} before {path} came up"
+                + (f":\n{err}" if err else "")
+            )
+        if time.time() > deadline:
+            raise TimeoutError(f"{path} never accepted connections")
+        time.sleep(0.05)
+
+
 def kill(pid: int) -> None:
     """SIGKILL a pid (group-wide when it leads its own group) — the public
     entry for scavenged processes not spawned through this module."""

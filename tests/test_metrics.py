@@ -182,6 +182,29 @@ def test_chip_gauges_survive_agent_restart(tmp_path):
         agent_srv.stop()
 
 
+def test_scrape_failure_cooldown_without_prior_value(tmp_path):
+    """Agent down from controller startup: the first render pays the
+    scrape attempt, renders within the TTL fail fast (cooldown) with no
+    further agent dials."""
+    controller = Controller("cold-host", str(tmp_path / "nope.sock"))
+    reg = metrics.registry()
+    total = reg.gauge("oim_chips_total", "", ("controller",))
+    errors = reg.counter("oim_metrics_scrape_errors_total", "", ("controller",))
+    try:
+        with pytest.raises(Exception):
+            total.value("cold-host")
+        after_first = errors.value("cold-host")
+        import time as time_mod
+
+        t0 = time_mod.monotonic()
+        with pytest.raises(Exception):
+            total.value("cold-host")  # cooldown: no 2s dial, no new error
+        assert time_mod.monotonic() - t0 < 0.5
+        assert errors.value("cold-host") == after_first
+    finally:
+        controller.close()
+
+
 def test_close_deregisters_gauges_unless_taken_over(tmp_path):
     store = ChipStore(mesh=(2,), device_dir=str(tmp_path / "dev"))
     sock = str(tmp_path / "agent.sock")

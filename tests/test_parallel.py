@@ -5,6 +5,8 @@ collective wrappers, ring attention vs the O(T²) oracle, and the GPipe
 schedule vs a sequential forward.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -296,6 +298,66 @@ class TestPipeline:
         np.testing.assert_allclose(
             np.asarray(g_piped), np.asarray(g_seq), rtol=1e-4, atol=1e-4
         )
+
+
+class TestChipBinding:
+    def _bootstrap(self, paths, mesh=(1, 1, 2), num_processes=1):
+        from oim_tpu.parallel import Bootstrap
+
+        return Bootstrap(
+            volume_id="v",
+            chips=[{"device_path": p} for p in paths],
+            mesh=list(mesh),
+            num_processes=num_processes,
+        )
+
+    def test_real_accel_devices(self):
+        from oim_tpu.parallel import chip_binding_env
+
+        env = chip_binding_env(self._bootstrap(["/dev/accel5", "/dev/accel3"]))
+        assert env["TPU_VISIBLE_CHIPS"] == "3,5"
+        assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,2"
+        assert env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+
+    def test_pjrt_enumerated_devices(self):
+        from oim_tpu.parallel import chip_binding_env
+
+        env = chip_binding_env(self._bootstrap(["pjrt:0", "pjrt:1"]))
+        assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+
+    def test_multihost_skips_process_bounds(self):
+        """Multi-host slices: the process grid belongs to the distributed
+        coordinator; guessing per-process bounds here would be wrong."""
+        from oim_tpu.parallel import chip_binding_env
+
+        env = chip_binding_env(
+            self._bootstrap(["/dev/accel0"], num_processes=2)
+        )
+        assert env["TPU_VISIBLE_CHIPS"] == "0"
+        assert "TPU_PROCESS_BOUNDS" not in env
+
+    def test_fake_devices_no_binding(self):
+        from oim_tpu.parallel import chip_binding_env
+
+        assert chip_binding_env(self._bootstrap(["/tmp/x/accel0"])) == {}
+        # One fake path poisons the set: binding a partial slice would
+        # claim chips the volume does not own.
+        assert (
+            chip_binding_env(self._bootstrap(["/dev/accel0", "/tmp/stub"]))
+            == {}
+        )
+
+    def test_apply_exports_env(self, monkeypatch):
+        from oim_tpu.parallel import apply_chip_binding
+
+        monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)
+        applied = apply_chip_binding(self._bootstrap(["/dev/accel1"]))
+        try:
+            assert os.environ["TPU_VISIBLE_CHIPS"] == "1"
+            assert applied["TPU_VISIBLE_CHIPS"] == "1"
+        finally:
+            for key in applied:
+                os.environ.pop(key, None)
 
 
 def test_bootstrap_roundtrip(tmp_path):

@@ -1,0 +1,197 @@
+"""Tier-3 analog: the full control plane driving a REAL accelerator op.
+
+The reference's tier 3 runs the stack against the real device daemon when
+env vars opt in (reference test/test.make:1-16, test/pkg/spdk/spdk.go:84-278,
+pkg/oim-controller/controller_test.go:151-304).  Here, ``TEST_REAL_TPU=1``
+runs: C++ tpu-agent → controller → registry proxy → CSI driver →
+NodeStage/NodePublish → a WORKLOAD SUBPROCESS that loads the staged
+bootstrap, applies chip binding, and runs its first op on the real TPU
+backend (the suite itself stays CPU-forced; only the workload gets the
+ambient accelerator env back).
+
+On this box the chip sits behind a network tunnel with no ``/dev/accel*``
+nodes, so the agent stages fake chip files and the binding is a
+documented no-op — the tier still proves the end-to-end claim the bench
+measures: a freshly published volume's pod reaches the accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import grpc
+import pytest
+
+from oim_tpu.controller import Controller
+from oim_tpu.csi import OIMDriver
+from oim_tpu.registry import Registry
+from oim_tpu.spec import CSI_CONTROLLER, CSI_NODE, csi_pb2
+from tests.test_agent_protocol import NATIVE_BINARY, _build_native
+from tests import procutil
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("TEST_REAL_TPU") != "1",
+    reason="real-TPU tier is opt-in: TEST_REAL_TPU=1",
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKLOAD = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+from oim_tpu.parallel import apply_chip_binding, load_bootstrap
+
+bootstrap = load_bootstrap({bootstrap!r})
+assert bootstrap.chip_count == {chips}, bootstrap.chips
+applied = apply_chip_binding(bootstrap)  # no-op for fake device paths
+
+import jax
+import jax.numpy as jnp
+
+assert jax.default_backend() == "tpu", jax.default_backend()
+x = jnp.ones((128, 128), jnp.bfloat16)
+result = float((x @ x).sum())
+print(json.dumps({{
+    "backend": jax.default_backend(),
+    "n_devices": len(jax.devices()),
+    "first_op": result,
+    "binding": applied,
+}}))
+"""
+
+
+def _workload_env() -> dict:
+    """The pod's env: the suite's CPU forcing undone, accelerator restored."""
+    env = dict(os.environ)
+    # PREPEND to PYTHONPATH: the image loads its accelerator sitecustomize
+    # from an ambient PYTHONPATH entry — overwriting it would silently
+    # unregister the TPU platform in the child.
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PALLAS_AXON_POOL_IPS"] = env.get("_OIM_ORIG_PALLAS_AXON_POOL_IPS", "")
+    orig_platforms = env.get("_OIM_ORIG_JAX_PLATFORMS", "")
+    if orig_platforms:
+        env["JAX_PLATFORMS"] = orig_platforms
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    return env
+
+
+def test_stack_to_first_real_op(tmp_path):
+    if not _build_native():
+        pytest.skip("native toolchain unavailable")
+    agent_sock = str(tmp_path / "agent.sock")
+    agent = procutil.spawn(
+        [
+            os.path.abspath(NATIVE_BINARY),
+            "--socket", agent_sock,
+            "--fake-chips", "4",
+            "--mesh", "2x2x1",
+            "--state-dir", str(tmp_path / "dev"),
+        ],
+        stderr=subprocess.PIPE,
+    )
+    cleanups = [lambda: procutil.stop(agent)]
+    try:
+        import time
+
+        procutil.wait_unix_socket(agent_sock, agent)
+
+        registry = Registry()
+        reg_srv = registry.start_server("tcp://127.0.0.1:0")
+        cleanups += [registry.close, reg_srv.stop]
+        controller = Controller(
+            "real-host", agent_sock,
+            registry_address=str(reg_srv.addr()), registry_delay=30.0,
+        )
+        ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
+        cleanups += [controller.close, ctrl_srv.stop]
+        controller.start(str(ctrl_srv.addr()))
+        driver = OIMDriver(
+            csi_endpoint=f"unix://{tmp_path}/csi.sock",
+            registry_address=str(reg_srv.addr()),
+            controller_id="real-host",
+        )
+        csi_srv = driver.start_server()
+        cleanups += [driver.close, csi_srv.stop]
+        channel = grpc.insecure_channel(csi_srv.addr().grpc_target())
+        cleanups.append(channel.close)
+
+        deadline = time.time() + 10
+        while registry.db.lookup("real-host/address") == "":
+            assert time.time() < deadline, "controller never registered"
+            time.sleep(0.02)
+
+        cap = csi_pb2.VolumeCapability()
+        cap.mount.SetInParent()
+        cap.access_mode.mode = (
+            csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER
+        )
+        vol = CSI_CONTROLLER.stub(channel).CreateVolume(
+            csi_pb2.CreateVolumeRequest(
+                name="real-vol",
+                volume_capabilities=[cap],
+                parameters={"chipCount": "2"},
+            ),
+            timeout=30,
+        ).volume
+        node = CSI_NODE.stub(channel)
+        staging = str(tmp_path / "staging")
+        target = str(tmp_path / "pod" / "tpu")
+        node.NodeStageVolume(
+            csi_pb2.NodeStageVolumeRequest(
+                volume_id="real-vol",
+                staging_target_path=staging,
+                volume_capability=cap,
+                volume_context=dict(vol.volume_context),
+            ),
+            timeout=30,
+        )
+        node.NodePublishVolume(
+            csi_pb2.NodePublishVolumeRequest(
+                volume_id="real-vol",
+                staging_target_path=staging,
+                target_path=target,
+                volume_capability=cap,
+            ),
+            timeout=30,
+        )
+        bootstrap_path = os.path.join(target, "tpu-bootstrap.json")
+
+        # The pod: first accelerator op against the staged volume.
+        code = WORKLOAD.format(repo=REPO, bootstrap=bootstrap_path, chips=2)
+        run = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=300,
+            env=_workload_env(),
+        )
+        assert run.returncode == 0, (
+            f"head: {run.stderr[:1200]}\n...\ntail: {run.stderr[-1200:]}"
+        )
+        report = json.loads(run.stdout.strip().splitlines()[-1])
+        assert report["backend"] == "tpu"
+        assert report["first_op"] == 128.0 * 128 * 128
+
+        node.NodeUnpublishVolume(
+            csi_pb2.NodeUnpublishVolumeRequest(
+                volume_id="real-vol", target_path=target
+            ),
+            timeout=30,
+        )
+        node.NodeUnstageVolume(
+            csi_pb2.NodeUnstageVolumeRequest(
+                volume_id="real-vol", staging_target_path=staging
+            ),
+            timeout=30,
+        )
+        CSI_CONTROLLER.stub(channel).DeleteVolume(
+            csi_pb2.DeleteVolumeRequest(volume_id="real-vol"), timeout=30
+        )
+    finally:
+        for cleanup in reversed(cleanups):
+            try:
+                cleanup()
+            except Exception:
+                pass
