@@ -25,12 +25,12 @@ from oim_tpu.registry import Registry
 from oim_tpu.spec import oim_pb2
 
 
-@pytest.fixture
-def cluster(tmp_path):
-    """Insecure in-process registry + two single-host controllers, each with
-    its own fake agent — the smallest multi-host topology."""
-    registry = Registry()
-    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+def _spawn_hosts(
+    tmp_path, registry_address: str, registry_delay: float = 0.1
+) -> dict:
+    """Two single-host controllers, each with its own fake agent — the
+    smallest multi-host topology.  Each host gets a distinct coordinator
+    address: the candidate it publishes must be reachable from peers."""
     hosts = {}
     for i, host_id in enumerate(["host-a", "host-b"]):
         store = ChipStore(
@@ -42,32 +42,41 @@ def cluster(tmp_path):
         controller = Controller(
             host_id,
             agent.socket_path,
-            registry_address=str(reg_srv.addr()),
-            # Distinct per-host addresses: the coordinator candidate each
-            # host publishes must be reachable from its peers.
+            registry_address=registry_address,
             coordinator_host=f"10.0.0.{i + 1}",
-            registry_delay=0.1,
+            registry_delay=registry_delay,
         )
         ctrl_srv = controller.start_server(
             "tcp://127.0.0.1:0", require_registry_peer=False
         )
         controller.start(str(ctrl_srv.addr()))
         hosts[host_id] = (store, agent, controller, ctrl_srv)
-    # Wait for both self-registrations so proxy routing works.
-    import time
+    return hosts
 
-    deadline = time.time() + 5
-    while any(
-        registry.db.lookup(f"{h}/address") != str(hosts[h][3].addr())
-        for h in hosts
-    ):
+
+def _await_registrations(registry, hosts, timeout: float = 5.0) -> None:
+    deadline = time.time() + timeout
+    while any(registry.db.lookup(f"{h}/address") == "" for h in hosts):
         assert time.time() < deadline, "controllers never registered"
         time.sleep(0.02)
-    yield registry, reg_srv, hosts
+
+
+def _stop_hosts(hosts) -> None:
     for _, agent, controller, ctrl_srv in hosts.values():
         controller.close()
         ctrl_srv.stop()
         agent.stop()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """Insecure in-process registry + the two-host topology."""
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    hosts = _spawn_hosts(tmp_path, str(reg_srv.addr()))
+    _await_registrations(registry, hosts)
+    yield registry, reg_srv, hosts
+    _stop_hosts(hosts)
     reg_srv.stop()
 
 
@@ -360,3 +369,85 @@ def test_mesh_from_bootstrap_multiprocess():
     assert mesh.devices.size == 8
     assert mesh.shape["tp"] == 2
     assert mesh.shape["dp"] == 4
+
+
+def test_registry_failover_mid_rendezvous(tmp_path):
+    """Kill the registry while host-a waits in rendezvous; restart it on
+    the SAME port from the sqlite DB; host-b then joins and both converge.
+
+    ≙ the reference's registry-restart semantics (controller.go:425-443:
+    heartbeats repopulate a wiped registry) — here with the durable-DB
+    seam the reference only planned (README.md:131-135): the rendezvous
+    keys written before the crash SURVIVE the restart, so the stage that
+    was mid-wait completes instead of starting over.
+    """
+    from oim_tpu.registry import SqliteRegistryDB
+
+    db_path = str(tmp_path / "registry.db")
+    registry = Registry(db=SqliteRegistryDB(db_path))
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    port = reg_srv.addr().grpc_target().rsplit(":", 1)[1]
+    hosts = {}
+    try:
+        hosts = _spawn_hosts(
+            tmp_path, f"tcp://127.0.0.1:{port}", registry_delay=0.2
+        )
+        _await_registrations(registry, hosts)
+
+        params = {"chipCount": "2", "numHosts": "2"}
+        address = f"tcp://127.0.0.1:{port}"
+        with concurrent.futures.ThreadPoolExecutor(2) as pool:
+            fut_a = pool.submit(
+                RemoteBackend(
+                    address, "host-a", rendezvous_timeout=30
+                ).create_device,
+                "pvc-fo",
+                params,
+            )
+            # host-a must have published its rendezvous key (now durable).
+            deadline = time.time() + 10
+            while not registry.db.lookup("volumes/pvc-fo/hosts/host-a"):
+                assert time.time() < deadline, "host-a never published"
+                assert not fut_a.done(), fut_a.result()
+                time.sleep(0.02)
+
+            # Registry crashes mid-rendezvous.
+            reg_srv.stop()
+            registry.close()
+            time.sleep(0.5)  # host-a polls against a dead registry
+
+            # Operator restarts it on the same endpoint, same durable DB.
+            registry = Registry(db=SqliteRegistryDB(db_path))
+            reg_srv = registry.start_server(f"tcp://127.0.0.1:{port}")
+            # The pre-crash state survived the restart.
+            assert registry.db.lookup("volumes/pvc-fo/hosts/host-a")
+
+            # gRPC's shared subchannel to the target may still sit in
+            # refused-backoff from the outage; a CO retries UNAVAILABLE
+            # NodeStage per the CSI contract, so the test does the same.
+            deadline = time.time() + 15
+            while True:
+                try:
+                    staged_b = RemoteBackend(
+                        address, "host-b", rendezvous_timeout=30
+                    ).create_device("pvc-fo", params)
+                    break
+                except VolumeError as exc:
+                    if (
+                        exc.code != grpc.StatusCode.UNAVAILABLE
+                        or time.time() > deadline
+                    ):
+                        raise
+                    time.sleep(0.2)
+            staged_a = fut_a.result(timeout=30)
+
+        assert staged_a.num_processes == staged_b.num_processes == 2
+        assert staged_a.process_id == 0 and staged_b.process_id == 1
+        assert (
+            staged_a.coordinator_address == staged_b.coordinator_address
+        )
+        assert staged_a.coordinator_address.startswith("10.0.0.1:")
+    finally:
+        _stop_hosts(hosts)
+        reg_srv.stop()
+        registry.close()
