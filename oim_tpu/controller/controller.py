@@ -434,6 +434,8 @@ class Controller:
             try:
                 self.register()
             except grpc.RpcError as exc:
+                if self._stop.is_set():
+                    return  # shutting down: the failure is expected noise
                 log.current().warning(
                     "registration failed",
                     registry=self.registry_address,

@@ -122,7 +122,13 @@ class SimpleLogger(Logger):
         )
         line = f"{ts}{level.name[0]} {msg}{_format_fields(fields)}\n"
         with self._lock:
-            self.out.write(line)
+            try:
+                self.out.write(line)
+            except (ValueError, OSError):
+                # Stream closed/broken under us (interpreter teardown,
+                # pytest capture ending, a consumer pipe exiting) —
+                # logging must never crash the thread that called it.
+                pass
 
 
 class Record:

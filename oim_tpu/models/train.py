@@ -21,7 +21,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from oim_tpu.models.transformer import (
     TransformerConfig,
+    _rmsnorm,
+    _stage_layer_params,
     forward_local,
+    make_stage_fn,
     manual_pspecs,
     param_pspecs,
 )
@@ -50,6 +53,35 @@ def data_pspec() -> P:
     return P("dp", "sp")
 
 
+def _shifted_labels(tokens):
+    """Next-token labels + validity mask for a [b, t_local] sequence shard.
+
+    The last local position's label is the first token of the *next*
+    sequence shard (one neighbor ppermute hop over ``sp``); the global
+    final position of each sequence is masked out.  Returns
+    ``(labels [b, t], valid [b, t] bool, positions [t])`` — the one
+    definition of shard-boundary labeling, shared by the autodiff loss and
+    the 1F1B per-microbatch head.
+    """
+    sp_size = jax.lax.axis_size("sp")
+    sp_index = jax.lax.axis_index("sp")
+    b, t_local = tokens.shape
+    perm = [(i, (i - 1) % sp_size) for i in range(sp_size)]
+    next_first = jax.lax.ppermute(tokens[:, :1], "sp", perm)  # [b, 1]
+    labels = jnp.concatenate([tokens[:, 1:], next_first], axis=1)
+    positions = sp_index * t_local + jnp.arange(t_local)  # [t]
+    t_global = t_local * sp_size
+    valid = jnp.broadcast_to(positions < t_global - 1, (b, t_local))
+    return labels, valid, positions
+
+
+def _masked_ce_sum(logits, labels, valid):
+    """Σ of valid-position next-token NLL (no normalization)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return jnp.sum(-token_ll * valid), jnp.sum(valid.astype(jnp.float32))
+
+
 def _local_loss(params, tokens, cfg: TransformerConfig):
     """Per-device loss over the local [b, t] token shard.
 
@@ -60,31 +92,15 @@ def _local_loss(params, tokens, cfg: TransformerConfig):
     sends head/final-norm gradient contributions from exactly one stage so
     the later per-axis gradient psums in ``make_train_step`` are uniform.
     """
-    sp_size = jax.lax.axis_size("sp")
-    sp_index = jax.lax.axis_index("sp")
-    b, t_local = tokens.shape
-
     logits, aux = forward_local(params, tokens, cfg)
+    labels, valid, _ = _shifted_labels(tokens)
 
-    # Labels: next token.  The last local position's label is the first
-    # token of the *next* sequence shard (one neighbor hop); the global
-    # final position is masked out.
-    size = sp_size
-    perm = [(i, (i - 1) % size) for i in range(size)]
-    next_first = jax.lax.ppermute(tokens[:, :1], "sp", perm)  # [b, 1]
-    labels = jnp.concatenate([tokens[:, 1:], next_first], axis=1)
-
-    global_pos = sp_index * t_local + jnp.arange(t_local)  # [t]
-    t_global = t_local * size
-    valid = jnp.broadcast_to(global_pos < t_global - 1, (b, t_local))
-
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    token_ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    ce_sum, ce_count = _masked_ce_sum(logits, labels, valid)
     is_last_stage = (
         jax.lax.axis_index("pp") == jax.lax.axis_size("pp") - 1
     ).astype(jnp.float32)
-    local_sum = jnp.sum(-token_ll * valid) * is_last_stage
-    local_count = jnp.sum(valid).astype(jnp.float32) * is_last_stage
+    local_sum = ce_sum * is_last_stage
+    local_count = ce_count * is_last_stage
 
     total = jax.lax.psum(local_sum, ("dp", "sp", "pp"))
     count = jax.lax.psum(local_count, ("dp", "sp", "pp"))
@@ -160,10 +176,17 @@ def _build_train_step(
     )
     manual_specs = manual_pspecs(cfg)
 
-    def spmd_value_and_grad(params, tokens):
+    use_1f1b = cfg.pp_schedule == "1f1b" and cfg.n_stages > 1
+
+    def autodiff_value_and_grad(params, tokens):
         (loss, ce), grads = jax.value_and_grad(
             partial(_local_loss, cfg=cfg), has_aux=True
         )(params, tokens)
+        return loss, ce, grads
+
+    def spmd_value_and_grad(params, tokens):
+        vag = _1f1b_value_and_grad if use_1f1b else autodiff_value_and_grad
+        loss, ce, grads = vag(params, tokens)
         # Per-device grads are only each rank's local contribution — the
         # psum in the loss broadcasts cotangents, it does not sum parameter
         # gradients.  Reduce explicitly: stage-sharded params over data
@@ -177,6 +200,81 @@ def _build_train_step(
 
         grads = {name: reduce_grad(name, g) for name, g in grads.items()}
         return loss, ce, grads
+
+    def _1f1b_value_and_grad(params, tokens):
+        """Manual pipeline fwd+bwd (parallel/pipeline.py 1F1B schedule):
+        embedding and loss head are differentiated here, the layer stack's
+        gradients come back from the schedule itself."""
+        from oim_tpu.parallel.pipeline import pipeline_1f1b_value_and_grad
+
+        sp_size = jax.lax.axis_size("sp")
+        dp_size = jax.lax.axis_size("dp")
+        b, t_local = tokens.shape
+        dt = cfg.compute_dtype
+        n_micro = max(cfg.n_microbatches, 1)
+        if b % n_micro:
+            raise ValueError(
+                f"local batch {b} not divisible by n_microbatches={n_micro}"
+            )
+        mb = b // n_micro
+
+        labels, valid, positions = _shifted_labels(tokens)
+        labels_m = labels.reshape(n_micro, mb, t_local)
+        valid_m = valid.reshape(n_micro, mb, t_local)
+        # Static normalizer: every label position except each sequence's
+        # global last is counted, on every data shard.
+        c_global = float(b * dp_size * (t_local * sp_size - 1))
+
+        def embed(wte):
+            return (
+                wte.astype(dt)[tokens]
+                .reshape(n_micro, mb, t_local, cfg.d_model)
+            )
+
+        x_micro, embed_vjp = jax.vjp(embed, params["wte"])
+        stage_fn = make_stage_fn(cfg, positions, sp_size)
+        stage_params = _stage_layer_params(params, cfg)
+        head_params = {
+            "final_norm": params["final_norm"],
+            "wlm": params["wlm"],
+        }
+
+        def loss_fn(hp, y, m):
+            normed = _rmsnorm(y, hp["final_norm"], cfg)
+            logits = jnp.einsum(
+                "btd,dv->btv",
+                normed.astype(jnp.float32),
+                hp["wlm"].astype(jnp.float32),
+            )
+            lbl = jax.lax.dynamic_index_in_dim(labels_m, m, 0, keepdims=False)
+            val = jax.lax.dynamic_index_in_dim(valid_m, m, 0, keepdims=False)
+            ce_sum, _ = _masked_ce_sum(logits, lbl, val)
+            ce = ce_sum / c_global
+            return ce, ce
+
+        # d(total objective)/d(aux_{stage,m}): the aux term is
+        # AUX_LOSS_WEIGHT * pmean_{dp,sp}(psum_pp(Σ_m aux)/M).
+        aux_seed = AUX_LOSS_WEIGHT / (n_micro * dp_size * sp_size)
+        loss, ce, aux, d_sp, d_hp, dx = pipeline_1f1b_value_and_grad(
+            stage_fn,
+            loss_fn,
+            stage_params,
+            head_params,
+            x_micro,
+            aux_seed=aux_seed,
+            axis_name="pp",
+        )
+        (d_wte,) = embed_vjp(dx)
+        # Totals: ce is real on the last stage only; aux sums per stage.
+        ce_total = jax.lax.psum(ce, ("dp", "sp", "pp"))
+        aux_total = jax.lax.psum(aux, "pp") / n_micro
+        aux_total = jax.lax.pmean(aux_total, ("dp", "sp"))
+        loss_total = ce_total + AUX_LOSS_WEIGHT * aux_total
+        grads = {name: g[None] for name, g in d_sp.items()}  # restore pp dim
+        grads["wte"] = d_wte
+        grads["final_norm"] = d_hp["final_norm"]
+        grads["wlm"] = d_hp["wlm"]
+        return loss_total, ce_total, grads
 
     # NOTE: partial-manual shard_map (manual dp/sp/pp, auto tp/ep) with an
     # explicit mesh= only traces under jit — make_train_step returns the

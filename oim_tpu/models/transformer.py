@@ -67,12 +67,21 @@ class TransformerConfig:
     # for head shards with one all_to_all each way (fewer collective hops,
     # needs n_heads % sp == 0).  See oim_tpu/parallel/ulysses.py.
     attn_impl: str = "ring"
+    # Pipeline schedule over pp>1: "gpipe" (autodiff transpose, simple) or
+    # "1f1b" (interleaved fwd/bwd, min(M, 2S-1) in-flight activations and
+    # per-microbatch loss head — see parallel/pipeline.py).
+    pp_schedule: str = "gpipe"
 
     def __post_init__(self):
         if self.attn_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"unknown attn_impl {self.attn_impl!r}; "
                 "expected 'ring' or 'ulysses'"
+            )
+        if self.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pp_schedule {self.pp_schedule!r}; "
+                "expected 'gpipe' or '1f1b'"
             )
 
     @property
@@ -303,6 +312,25 @@ def _stage_layer_params(params: dict, cfg: TransformerConfig) -> dict:
     }
 
 
+def make_stage_fn(cfg: TransformerConfig, positions: jax.Array, sp_size: int):
+    """One pipeline stage's layer stack as ``(stage_params, act) -> (act,
+    aux)`` — the unit both pipeline schedules and the single-stage path
+    run.  ``positions`` broadcast over any (micro)batch size."""
+    layer_fn = partial(_layer, cfg=cfg, sp_size=sp_size)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def stage_fn(stage_params, activation):
+        (out, _, aux), _ = jax.lax.scan(
+            lambda carry, lw: layer_fn(carry, lw),
+            (activation, positions, jnp.zeros((), jnp.float32)),
+            stage_params,
+        )
+        return out, aux
+
+    return stage_fn
+
+
 def forward_local(
     params: dict, tokens: jax.Array, cfg: TransformerConfig
 ) -> tuple[jax.Array, jax.Array]:
@@ -322,19 +350,7 @@ def forward_local(
     positions = sp_index * t_local + jnp.arange(t_local)
 
     stage_params = _stage_layer_params(params, cfg)
-
-    layer_fn = partial(_layer, cfg=cfg, sp_size=sp_size)
-    if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
-
-    def run_stage(sp, activation):
-        def scan_body(carry, layer_weights):
-            return layer_fn(carry, layer_weights)
-
-        (out, _, aux), _ = jax.lax.scan(
-            scan_body, (activation, positions, jnp.zeros((), jnp.float32)), sp
-        )
-        return out, aux
+    run_stage = make_stage_fn(cfg, positions, sp_size)
 
     if pp_size > 1:
         n_micro = max(cfg.n_microbatches, 1)

@@ -9,14 +9,22 @@ of the scan+ppermute (reverse schedule).
 Memory: with ``stage_remat`` (default) each schedule step stores only its
 stage *input* for the backward and recomputes the stage's layers — peak
 activation memory drops from O(steps · layers_per_stage) to O(steps)
-activations per device.  A hand-interleaved 1F1B schedule (forward and
-backward of different microbatches in the same tick) cannot be expressed
-through plain autodiff — it would require the pipeline to own its backward
-(explicit per-microbatch vjp with cotangents ppermuted stage→stage-1);
-planned future work.
+activations per device.
 
-The schedule runs ``n_micro + n_stages - 1`` steps; device ``i`` works on
-microbatch ``step - i`` when that index is valid.
+``pipeline_1f1b_value_and_grad`` goes further: a hand-interleaved 1F1B
+schedule cannot be expressed through plain autodiff (JAX runs the whole
+forward, then the transposed backward — GPipe order by construction), so
+it OWNS its backward: each tick runs one microbatch-forward and one
+microbatch-backward (explicit ``jax.vjp`` recomputed from the stored stage
+*input*), activation cotangents ppermute stage→stage-1 while activations
+ppermute stage→stage+1, the per-microbatch loss is computed on the last
+stage inside the schedule, and parameter gradients accumulate in the
+carry.  In-flight stage inputs are bounded by ``min(M, 2·S-1)`` instead of
+``M + S - 1``, and the loss head sees one microbatch at a time (no [M]
+output buffer, no full-batch logits).
+
+The GPipe schedule runs ``n_micro + n_stages - 1`` steps; device ``i``
+works on microbatch ``step - i`` when that index is valid.
 """
 
 from __future__ import annotations
@@ -117,3 +125,171 @@ def gpipe_spmd(
     # contributions and average over microbatches to match the non-pp path.
     aux = jax.lax.psum(aux_sum, axis_name) / n_micro
     return outputs, aux
+
+
+def pipeline_1f1b_value_and_grad(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params,
+    head_params,
+    x_microbatches: jax.Array,
+    aux_seed: float = 0.0,
+    axis_name: str = "pp",
+):
+    """Interleaved 1F1B: forward AND backward inside one lockstep schedule.
+
+    Args:
+      stage_fn: ``(stage_params, activation) -> (activation, aux)``.
+      loss_fn: ``(head_params, activation, mb_index) -> (loss, ce)`` —
+        per-microbatch scalars, already weighted so that summing over
+        microbatches (last stage) yields the global objective's local
+        contribution.  Evaluated on every stage (SPMD lockstep) but only
+        the last stage's value/cotangent count.
+      stage_params: THIS stage's layer parameters.
+      head_params: the loss head's parameters (final norm / unembedding);
+        their gradient comes back nonzero only on the last stage.
+      x_microbatches: ``[M, mb, ...]`` stage-0 input stream.
+      aux_seed: cotangent for each (stage, microbatch) aux value — the
+        caller's aux-loss weight divided by whatever normalization it
+        applies across microbatches/devices.
+
+    Returns ``(loss, ce, aux, d_stage_params, d_head_params,
+    dx_microbatches)``: loss/ce are this device's summed contributions
+    (real on the last stage, zeros elsewhere — psum over the mesh
+    afterwards); aux is this stage's summed auxiliary loss over its real
+    microbatches (psum over the axis, divide by M for the mean);
+    dx_microbatches is real on stage 0 (the embedding cotangent).
+
+    Schedule: one F half-tick and one B half-tick per iteration, B lagging
+    F by S-1 ticks, for ``M + 2(S-1)`` iterations.  Stage ``i`` forwards
+    microbatch ``k - i`` and backwards microbatch ``k - 2(S-1) + i`` at
+    iteration ``k`` — the Megatron 1F1B timetable in SPMD lockstep form.
+    Each stage holds at most ``min(M, 2S-1)`` in-flight stage inputs; the
+    backward recomputes the stage (activation remat) from the stored
+    input, so no per-layer residuals persist across ticks.
+    """
+    size = jax.lax.axis_size(axis_name)
+    index = jax.lax.axis_index(axis_name)
+    n_micro = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+    lag = 2 * (size - 1)
+    total_ticks = n_micro + lag
+    ring = min(n_micro, 2 * size - 1)  # max in-flight inputs per stage
+
+    perm_fwd = [(i, (i + 1) % size) for i in range(size)]
+    perm_bwd = [(i, (i - 1) % size) for i in range(size)]
+
+    out_shape, _ = jax.eval_shape(
+        lambda p, a: stage_fn(p, a), stage_params, x_microbatches[0]
+    )
+    dtype = out_shape.dtype
+
+    def full(sp, hp, act):
+        y, aux = stage_fn(sp, act)
+        loss, ce = loss_fn(hp, y, _MB_INDEX.value)
+        return y, aux, loss, ce
+
+    # jax.vjp needs the microbatch index inside the traced function but it
+    # is a per-tick traced value; thread it via a tiny box the closure
+    # reads (the scan body rebinds it each tick — standard nonlocal-in-
+    # trace pattern, safe because tracing is single-threaded per body).
+    class _Box:
+        value = None
+
+    _MB_INDEX = _Box()
+
+    def tick(carry, k):
+        (
+            fwd_state, bwd_cot, acts, d_sp, d_hp, dx,
+            loss_acc, ce_acc, aux_acc,
+        ) = carry
+
+        # ---- F half-tick: stage i forwards microbatch k - i.
+        m_f = k - index
+        f_valid = jnp.logical_and(m_f >= 0, m_f < n_micro)
+        received = jax.lax.ppermute(fwd_state, axis_name, perm_fwd)
+        feed = jax.lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(m_f, 0, n_micro - 1), 0, keepdims=False
+        ).astype(dtype)
+        my_input = jnp.where(index == 0, feed, received)
+        slot_f = jnp.mod(m_f, ring)
+        stale = jax.lax.dynamic_index_in_dim(acts, slot_f, 0, keepdims=False)
+        acts = jax.lax.dynamic_update_index_in_dim(
+            acts, jnp.where(f_valid, my_input, stale), slot_f, 0
+        )
+        y, _ = stage_fn(stage_params, my_input)
+        fwd_state = y
+
+        # ---- B half-tick: stage i backwards microbatch k - 2(S-1) + i.
+        m_b = k - lag + index
+        b_valid = jnp.logical_and(m_b >= 0, m_b < n_micro)
+        received_cot = jax.lax.ppermute(bwd_cot, axis_name, perm_bwd)
+        slot_b = jnp.mod(m_b, ring)
+        act_in = jax.lax.dynamic_index_in_dim(acts, slot_b, 0, keepdims=False)
+        _MB_INDEX.value = jnp.clip(m_b, 0, n_micro - 1)
+        (y_b, _aux_b, loss_b, ce_b), vjp = jax.vjp(
+            full, stage_params, head_params, act_in
+        )
+        is_last = index == size - 1
+        # Seeds: activation cotangent from the next stage (zero on the
+        # last stage, whose output only feeds the loss), the aux weight,
+        # the loss itself on the last stage, ce never (metric only).
+        dy = jnp.where(is_last, jnp.zeros_like(received_cot), received_cot)
+        seed_loss = jnp.where(is_last, 1.0, 0.0).astype(loss_b.dtype)
+        g_sp, g_hp, g_act = vjp(
+            (dy, jnp.asarray(aux_seed, _aux_b.dtype), seed_loss,
+             jnp.zeros_like(ce_b))
+        )
+        keep = lambda new, old: jax.tree_util.tree_map(  # noqa: E731
+            lambda n, o: jnp.where(b_valid, o + n, o), new, old
+        )
+        d_sp = keep(g_sp, d_sp)
+        d_hp = keep(g_hp, d_hp)
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(b_valid, is_last), loss_b, 0.0
+        )
+        ce_acc = ce_acc + jnp.where(
+            jnp.logical_and(b_valid, is_last), ce_b, 0.0
+        )
+        aux_acc = aux_acc + jnp.where(b_valid, _aux_b, 0.0)
+        # Cotangent rides to stage i-1 (same microbatch there next tick);
+        # zero when invalid so bubbles cannot inject garbage.
+        bwd_cot = jnp.where(b_valid, g_act, jnp.zeros_like(g_act))
+        # Stage 0's activation cotangent is the embedding's.
+        dx_cur = jax.lax.dynamic_index_in_dim(dx, slot_b_full(m_b), 0,
+                                              keepdims=False)
+        dx = jax.lax.dynamic_update_index_in_dim(
+            dx,
+            jnp.where(
+                jnp.logical_and(b_valid, index == 0), g_act, dx_cur
+            ),
+            slot_b_full(m_b),
+            0,
+        )
+        return (
+            fwd_state, bwd_cot, acts, d_sp, d_hp, dx,
+            loss_acc, ce_acc, aux_acc,
+        ), None
+
+    def slot_b_full(m_b):
+        return jnp.clip(m_b, 0, n_micro - 1)
+
+    varying = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")  # noqa: E731
+    zeros_like_tree = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda x: varying(jnp.zeros(x.shape, x.dtype)), t
+    )
+    carry0 = (
+        varying(jnp.zeros(mb_shape, dtype)),                      # fwd_state
+        varying(jnp.zeros(mb_shape, dtype)),                      # bwd_cot
+        varying(jnp.zeros((ring, *mb_shape), dtype)),             # acts
+        zeros_like_tree(stage_params),                            # d_sp
+        zeros_like_tree(head_params),                             # d_hp
+        varying(jnp.zeros((n_micro, *mb_shape), dtype)),          # dx
+        varying(jnp.zeros((), jnp.float32)),                      # loss
+        varying(jnp.zeros((), jnp.float32)),                      # ce
+        varying(jnp.zeros((), jnp.float32)),                      # aux
+    )
+    (_, _, _, d_sp, d_hp, dx, loss, ce, aux), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(total_ticks)
+    )
+    return loss, ce, aux, d_sp, d_hp, dx

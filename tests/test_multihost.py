@@ -425,7 +425,7 @@ def test_registry_failover_mid_rendezvous(tmp_path):
             # gRPC's shared subchannel to the target may still sit in
             # refused-backoff from the outage; a CO retries UNAVAILABLE
             # NodeStage per the CSI contract, so the test does the same.
-            deadline = time.time() + 15
+            deadline = time.time() + 30
             while True:
                 try:
                     staged_b = RemoteBackend(
