@@ -36,6 +36,7 @@ from oim_tpu.models.transformer import (
     _dense_mlp,
     _rmsnorm,
     _switch_moe,
+    _unembed,
 )
 from oim_tpu.ops.rope import apply_rope
 
@@ -197,9 +198,7 @@ def _forward_cached(
 
     x, (new_k, new_v) = jax.lax.scan(layer_step, x, (flat, cache.k, cache.v))
     x = _rmsnorm(x, params["final_norm"], cfg)
-    logits = jnp.einsum(
-        "btd,dv->btv", x.astype(jnp.float32), params["wlm"].astype(jnp.float32)
-    )
+    logits = _unembed(x, params["wlm"], cfg)
     new_cache = KVCache(k=new_k, v=new_v, length=start + tokens.shape[1])
     return logits, new_cache
 

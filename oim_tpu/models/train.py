@@ -23,6 +23,7 @@ from oim_tpu.models.transformer import (
     TransformerConfig,
     _rmsnorm,
     _stage_layer_params,
+    _unembed,
     forward_local,
     make_stage_fn,
     manual_pspecs,
@@ -76,10 +77,17 @@ def _shifted_labels(tokens):
 
 
 def _masked_ce_sum(logits, labels, valid):
-    """Σ of valid-position next-token NLL (no normalization)."""
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    token_ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return jnp.sum(-token_ll * valid), jnp.sum(valid.astype(jnp.float32))
+    """Σ of valid-position next-token NLL (no normalization).
+
+    Gather-then-logsumexp instead of materializing the full [b, t, V]
+    log-softmax: NLL = logsumexp(logits) - logits[label], which reads the
+    logits once for the reduction and once for the gather rather than
+    writing a second vocab-sized tensor (the logits are the biggest
+    activation in the model at vocab 32k)."""
+    lse = jax.nn.logsumexp(logits, axis=-1)  # [b, t]
+    target = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = lse - target
+    return jnp.sum(nll * valid), jnp.sum(valid.astype(jnp.float32))
 
 
 def _local_loss(params, tokens, cfg: TransformerConfig):
@@ -241,11 +249,7 @@ def _build_train_step(
 
         def loss_fn(hp, y, m):
             normed = _rmsnorm(y, hp["final_norm"], cfg)
-            logits = jnp.einsum(
-                "btd,dv->btv",
-                normed.astype(jnp.float32),
-                hp["wlm"].astype(jnp.float32),
-            )
+            logits = _unembed(normed, hp["wlm"], cfg)
             lbl = jax.lax.dynamic_index_in_dim(labels_m, m, 0, keepdims=False)
             val = jax.lax.dynamic_index_in_dim(valid_m, m, 0, keepdims=False)
             ce_sum, _ = _masked_ce_sum(logits, lbl, val)

@@ -184,20 +184,6 @@ def pipeline_1f1b_value_and_grad(
     )
     dtype = out_shape.dtype
 
-    def full(sp, hp, act):
-        y, aux = stage_fn(sp, act)
-        loss, ce = loss_fn(hp, y, _MB_INDEX.value)
-        return y, aux, loss, ce
-
-    # jax.vjp needs the microbatch index inside the traced function but it
-    # is a per-tick traced value; thread it via a tiny box the closure
-    # reads (the scan body rebinds it each tick — standard nonlocal-in-
-    # trace pattern, safe because tracing is single-threaded per body).
-    class _Box:
-        value = None
-
-    _MB_INDEX = _Box()
-
     def tick(carry, k):
         (
             fwd_state, bwd_cot, acts, d_sp, d_hp, dx,
@@ -226,7 +212,13 @@ def pipeline_1f1b_value_and_grad(
         received_cot = jax.lax.ppermute(bwd_cot, axis_name, perm_bwd)
         slot_b = jnp.mod(m_b, ring)
         act_in = jax.lax.dynamic_index_in_dim(acts, slot_b, 0, keepdims=False)
-        _MB_INDEX.value = jnp.clip(m_b, 0, n_micro - 1)
+        mb_index = jnp.clip(m_b, 0, n_micro - 1)
+
+        def full(sp, hp, act):
+            y, aux = stage_fn(sp, act)
+            loss, ce = loss_fn(hp, y, mb_index)
+            return y, aux, loss, ce
+
         (y_b, _aux_b, loss_b, ce_b), vjp = jax.vjp(
             full, stage_params, head_params, act_in
         )
