@@ -422,9 +422,72 @@ def _run(tmp: str, agent_sock: str, cleanups: list, extras: dict) -> int:
         # Decode first: the train step donates the param buffers.
         _decode_diagnostics(extras, on_tpu, cfg, batch, params)
         _train_diagnostics(extras, on_tpu, cfg, batch, seq, params)
+    _flash_diagnostics(extras, on_tpu)
 
     emit(p50, extras)
     return 0
+
+
+def _flash_diagnostics(extras, on_tpu) -> None:
+    """Long-context kernel proof: flash vs unfused attention, T=8192
+    fwd+bwd on the real chip (interpret mode off-TPU would take minutes,
+    so the diagnostic only runs on hardware)."""
+    if not on_tpu:
+        return
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from oim_tpu.ops.flash_attention import (
+            flash_attention,
+            reference_attention,
+        )
+
+        b, t, h, d = 1, 8192, 8, 64
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (
+            jax.random.normal(key, (b, t, h, d), jnp.bfloat16) for key in keys
+        )
+
+        def timed(attn, n=5):
+            grad = jax.grad(
+                lambda q, k, v: jnp.sum(
+                    attn(q, k, v).astype(jnp.float32) ** 2
+                ),
+                (0, 1, 2),
+            )
+
+            @jax.jit
+            def loop(q, k, v):
+                def body(c, _):
+                    gq, gk, gv = grad(q + c.astype(q.dtype) * 1e-6, k, v)
+                    return (
+                        gq.astype(jnp.float32).sum()
+                        + gk.astype(jnp.float32).sum()
+                        + gv.astype(jnp.float32).sum()
+                    ), None
+
+                c, _ = jax.lax.scan(
+                    body, jnp.zeros((), jnp.float32), None, length=n
+                )
+                return c
+
+            float(loop(q, k, v))  # compile
+            rtt = extras.get("tunnel_rtt_ms", 0.0) / 1000.0
+            t0 = time.perf_counter()
+            float(loop(q, k, v))
+            return (time.perf_counter() - t0 - rtt) / n * 1000
+
+        flash_ms = timed(lambda q, k, v: flash_attention(q, k, v, True))
+        ref_ms = timed(lambda q, k, v: reference_attention(q, k, v, True))
+        extras["flash_t8192_fwdbwd_ms"] = round(flash_ms, 1)
+        extras["flash_vs_unfused"] = round(ref_ms / flash_ms, 2)
+        log(
+            f"bench: flash attention T=8192 fwd+bwd {flash_ms:.1f} ms vs "
+            f"unfused {ref_ms:.1f} ms ({ref_ms / flash_ms:.1f}x)"
+        )
+    except Exception as exc:  # pragma: no cover - diagnostics only
+        log(f"bench: flash diagnostic skipped: {exc}")
 
 
 def _flagship_cfg(on_tpu: bool):
