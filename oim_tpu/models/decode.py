@@ -230,13 +230,39 @@ def decode_step(
     return logits[:, -1, :], cache
 
 
-def sample_token(logits, temperature: float, key) -> jax.Array:
-    """Greedy at temperature 0 (or no key), else categorical."""
+def sample_token(
+    logits, temperature: float, key, top_k: int = 0, top_p: float = 1.0
+) -> jax.Array:
+    """Greedy at temperature 0 (or no key); else categorical over the
+    temperature-scaled logits, optionally truncated to the top-k tokens
+    and/or the top-p (nucleus) probability mass.  ``top_k``/``top_p`` are
+    static (jit-friendly: no data-dependent shapes — truncation is a
+    mask, not a gather)."""
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0 or top_k > logits.shape[-1]:
+        raise ValueError(
+            f"top_k must be in [0, vocab={logits.shape[-1]}], got {top_k}"
+        )
     if temperature == 0.0 or key is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(key, logits / temperature, axis=-1).astype(
-        jnp.int32
-    )
+    logits = logits / temperature
+    if top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]  # [b, 1]
+        logits = jnp.where(logits < kth, _NEG_BIG, logits)
+    if top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        # Exclusive cumulative mass: a token is cut iff the mass BEFORE it
+        # already reaches top_p (so the boundary token is kept and the set
+        # is never empty).
+        exclusive = jnp.cumsum(probs, axis=-1) - probs
+        cut = exclusive >= top_p
+        threshold = jnp.min(
+            jnp.where(cut, jnp.inf, sorted_desc), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < threshold, _NEG_BIG, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(
@@ -246,6 +272,8 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     key: jax.Array | None = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -266,12 +294,12 @@ def generate(
     if key is None:
         key = jax.random.PRNGKey(0)  # greedy path: key is never consumed
     first_key, key = jax.random.split(key)  # never reuse a consumed key
-    first = sample_token(logits[:, -1, :], temperature, first_key)
+    first = sample_token(logits[:, -1, :], temperature, first_key, top_k, top_p)
 
     def step(carry, step_key):
         cache, token = carry
         logits, cache = decode_step(params, cache, token[:, None], cfg)
-        next_token = sample_token(logits, temperature, step_key)
+        next_token = sample_token(logits, temperature, step_key, top_k, top_p)
         return (cache, next_token), token
 
     # `first` is generated token 1; the scan produces the remaining n-1.
@@ -290,5 +318,5 @@ def make_generate_fn(cfg: TransformerConfig):
     and GSPMD propagates head/tensor sharding from the param shardings."""
     return jax.jit(
         partial(generate, cfg=cfg),
-        static_argnames=("max_new_tokens", "temperature"),
+        static_argnames=("max_new_tokens", "temperature", "top_k", "top_p"),
     )

@@ -216,3 +216,56 @@ class TestShardedDecode:
         }
         out_flat = generate(flat_params, prompt, flat_cfg, max_new_tokens=3)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(out_flat))
+
+
+class TestSamplingTruncation:
+    def test_top_k_restricts_support(self):
+        from oim_tpu.models.decode import sample_token
+
+        logits = jnp.log(
+            jnp.array([[0.4, 0.3, 0.2, 0.05, 0.05]], jnp.float32)
+        )
+        keys = jax.random.split(jax.random.PRNGKey(0), 64)
+        samples = {
+            int(sample_token(logits, 1.0, k, top_k=2)[0]) for k in keys
+        }
+        assert samples <= {0, 1}
+        assert len(samples) == 2  # genuinely sampling, not argmax
+
+    def test_top_p_keeps_nucleus_only(self):
+        from oim_tpu.models.decode import sample_token
+
+        logits = jnp.log(
+            jnp.array([[0.5, 0.3, 0.1, 0.06, 0.04]], jnp.float32)
+        )
+        keys = jax.random.split(jax.random.PRNGKey(1), 64)
+        # p=0.7: mass before token1 is 0.5 < 0.7, before token2 is 0.8 —
+        # nucleus = {0, 1} (boundary token kept).
+        samples = {
+            int(sample_token(logits, 1.0, k, top_p=0.7)[0]) for k in keys
+        }
+        assert samples == {0, 1}
+
+    def test_tiny_top_p_is_greedy(self):
+        from oim_tpu.models.decode import sample_token
+
+        logits = jax.random.normal(jax.random.PRNGKey(2), (3, 17))
+        for i in range(8):
+            out = sample_token(
+                logits, 1.0, jax.random.PRNGKey(i), top_p=1e-6
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out), np.asarray(jnp.argmax(logits, axis=-1))
+            )
+
+    def test_generate_with_truncation(self):
+        cfg = TransformerConfig(**CFG)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((2, 4), jnp.int32)
+        out = generate(
+            params, prompt, cfg, max_new_tokens=6,
+            temperature=0.8, key=jax.random.PRNGKey(3),
+            top_k=8, top_p=0.9,
+        )
+        assert out.shape == (2, 10)
+        assert (out >= 0).all() and (out < cfg.vocab_size).all()
