@@ -12,7 +12,6 @@ from __future__ import annotations
 import itertools
 import json
 import os
-import socket
 import socketserver
 import threading
 from dataclasses import dataclass, field

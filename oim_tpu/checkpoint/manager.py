@@ -22,7 +22,7 @@ Design points, TPU-first:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable
+from typing import Callable
 
 import jax
 import orbax.checkpoint as ocp

@@ -10,7 +10,7 @@ server interceptor, see ``peer_check_interceptor``).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import grpc
 

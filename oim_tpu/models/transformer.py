@@ -23,7 +23,7 @@ are f32 with bf16 params/activations by default.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import partial
 
 import jax

@@ -108,6 +108,8 @@ def ring_attention(
 # The O(T²) correctness oracle lives in oim_tpu.ops (one canonical copy).
 from oim_tpu.ops.flash_attention import reference_attention  # noqa: E402
 
+__all__ = ["reference_attention", "ring_attention", "ring_attention_sharded"]
+
 
 def ring_attention_sharded(q, k, v, mesh, causal: bool = True, rules=None):
     """Convenience wrapper: global arrays in, global arrays out, with the

@@ -1,0 +1,125 @@
+"""Dependency-free static quality gates.
+
+≙ the reference's `make test` lint battery (gofmt + gometalinter + the
+"no glog in binaries" grep, reference test/test.make:24-56, :119-124).
+No linter ships in this image, so the gates are AST-level and exact:
+
+- every library module parses and carries a docstring;
+- no unused imports (the one lint class that reliably signals dead code);
+- no ``print()`` in library code — the structured logger is the output
+  surface (printing is the CLI's and tools' job);
+- no mutable default arguments.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "oim_tpu")
+
+# print() is the user interface of the CLI binaries and demo tools.
+PRINT_ALLOWED = ("oim_tpu/cli/",)
+
+
+def _library_files():
+    out = []
+    for root, _dirs, files in os.walk(LIB):
+        if f"{os.sep}gen{os.sep}" in root + os.sep:
+            continue  # generated protobuf bindings
+        for name in files:
+            if name.endswith(".py"):
+                out.append(os.path.join(root, name))
+    return sorted(out)
+
+
+FILES = _library_files()
+assert FILES, "library file discovery broke"
+
+
+def _parse(path):
+    with open(path) as f:
+        source = f.read()
+    return ast.parse(source, filename=path), source
+
+
+@pytest.mark.parametrize("path", FILES, ids=lambda p: os.path.relpath(p, REPO))
+def test_module_docstring(path):
+    tree, _ = _parse(path)
+    if os.path.basename(path) == "__init__.py" and not tree.body:
+        return  # empty package marker
+    assert ast.get_docstring(tree), "module lacks a docstring"
+
+
+@pytest.mark.parametrize("path", FILES, ids=lambda p: os.path.relpath(p, REPO))
+def test_no_unused_imports(path):
+    tree, source = _parse(path)
+    if os.path.basename(path) == "__init__.py":
+        pytest.skip("packages re-export")
+    imported: dict[str, ast.stmt] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                imported[name] = node
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue  # compiler directives, not names
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                imported[alias.asname or alias.name] = node
+    used = {
+        node.id for node in ast.walk(tree) if isinstance(node, ast.Name)
+    }
+    # Strings in __all__ count as uses (re-export surface).
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__all__"
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.List, ast.Tuple))
+        ):
+            used |= {
+                elt.value
+                for elt in node.value.elts
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            }
+    unused = sorted(name for name in imported if name not in used)
+    assert not unused, f"unused imports: {unused}"
+
+
+@pytest.mark.parametrize("path", FILES, ids=lambda p: os.path.relpath(p, REPO))
+def test_no_print_in_library(path):
+    rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+    if any(rel.startswith(prefix) for prefix in PRINT_ALLOWED):
+        pytest.skip("CLI surface prints deliberately")
+    tree, _ = _parse(path)
+    offenders = [
+        node.lineno
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "print"
+    ]
+    assert not offenders, (
+        f"print() at lines {offenders} — use oim_tpu.log (the reference "
+        "bans glog from its binaries the same way, test.make:119-124)"
+    )
+
+
+@pytest.mark.parametrize("path", FILES, ids=lambda p: os.path.relpath(p, REPO))
+def test_no_mutable_default_args(path):
+    tree, _ = _parse(path)
+    offenders = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in node.args.defaults + node.args.kw_defaults:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    offenders.append(f"{node.name}:{node.lineno}")
+    assert not offenders, f"mutable default arguments: {offenders}"
