@@ -14,6 +14,7 @@ No linter ships in this image, so the gates are AST-level and exact:
 from __future__ import annotations
 
 import ast
+import functools
 import os
 
 import pytest
@@ -28,7 +29,8 @@ PRINT_ALLOWED = ("oim_tpu/cli/",)
 def _library_files():
     out = []
     for root, _dirs, files in os.walk(LIB):
-        if f"{os.sep}gen{os.sep}" in root + os.sep:
+        rel = os.path.relpath(root, LIB)
+        if "gen" in rel.split(os.sep):
             continue  # generated protobuf bindings
         for name in files:
             if name.endswith(".py"):
@@ -40,8 +42,9 @@ FILES = _library_files()
 assert FILES, "library file discovery broke"
 
 
+@functools.lru_cache(maxsize=None)
 def _parse(path):
-    with open(path) as f:
+    with open(path, encoding="utf-8") as f:
         source = f.read()
     return ast.parse(source, filename=path), source
 
@@ -73,7 +76,9 @@ def test_no_unused_imports(path):
                     continue
                 imported[alias.asname or alias.name] = node
     used = {
-        node.id for node in ast.walk(tree) if isinstance(node, ast.Name)
+        node.id
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
     }
     # Strings in __all__ count as uses (re-export surface).
     for node in ast.walk(tree):
