@@ -12,6 +12,7 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import socket
 import socketserver
 import threading
 from dataclasses import dataclass, field
@@ -350,22 +351,40 @@ class FakeAgentServer:
         self.store = store
         self.socket_path = socket_path
         store_ref = store
+        live_connections: set = set()
+        conn_lock = threading.Lock()
+        self._live_connections = live_connections
+        self._conn_lock = conn_lock
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self) -> None:
-                while True:
-                    line = self.rfile.readline()
-                    if not line:
-                        return
-                    response = _dispatch_line(store_ref, line)
-                    self.wfile.write(
-                        (json.dumps(response, separators=(",", ":")) + "\n").encode()
-                    )
-                    self.wfile.flush()
+                try:
+                    while True:
+                        line = self.rfile.readline()
+                        if not line:
+                            return
+                        response = _dispatch_line(store_ref, line)
+                        self.wfile.write(
+                            (json.dumps(response, separators=(",", ":")) + "\n")
+                            .encode()
+                        )
+                        self.wfile.flush()
+                finally:
+                    with conn_lock:
+                        live_connections.discard(self.connection)
 
         class Server(socketserver.ThreadingUnixStreamServer):
             daemon_threads = True
             allow_reuse_address = True
+
+            def process_request(self, request, client_address):
+                # Register BEFORE the handler thread spawns (still in the
+                # accept loop): stop() snapshotting live_connections can
+                # then never miss a just-accepted connection and leave a
+                # stale handler serving the old store.
+                with conn_lock:
+                    live_connections.add(request)
+                super().process_request(request, client_address)
 
         parent = os.path.dirname(socket_path)
         if parent:
@@ -384,6 +403,17 @@ class FakeAgentServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # Sever established connections too: a crashed daemon takes its
+        # connections down with it, and restart-recovery tests rely on
+        # clients actually seeing the break (ThreadingMixIn handler
+        # threads would otherwise keep serving the OLD store forever).
+        with self._conn_lock:
+            conns = list(self._live_connections)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
 

@@ -113,7 +113,17 @@ def test_cli_cluster_roundtrip(cluster, capsys):
     assert "mesh=[1, 2, 1]" in out
     assert "coordinator=" in out
 
+    # Inventory views through the proxy.
+    assert _ctl(registry, "topology", "--controller", "cli-host") == 0
+    out = capsys.readouterr().out
+    assert "chips=4" in out and "free=2" in out and "mesh=[2, 2, 1]" in out
+    assert _ctl(registry, "slices", "--controller", "cli-host") == 0
+    out = capsys.readouterr().out
+    assert "vol-cli: chips=2" in out and "attached=True" in out
+
     assert _ctl(registry, "unmap", "vol-cli", "--controller", "cli-host") == 0
+    assert _ctl(registry, "slices", "--controller", "cli-host") == 0
+    assert "vol-cli" not in capsys.readouterr().out
 
     # Errors surface as exit code 1 with the gRPC status.
     assert _ctl(registry, "map", "vol-x", "--controller", "ghost") == 1

@@ -22,7 +22,14 @@ from oim_tpu.controller import Controller
 from oim_tpu.csi import OIMDriver
 from oim_tpu.csi.mounter import BOOTSTRAP_FILE
 from oim_tpu.registry import Registry, SqliteRegistryDB
-from oim_tpu.spec import CSI_CONTROLLER, CSI_IDENTITY, CSI_NODE, csi_pb2
+from oim_tpu.spec import (
+    CONTROLLER,
+    CSI_CONTROLLER,
+    CSI_IDENTITY,
+    CSI_NODE,
+    csi_pb2,
+    oim_pb2,
+)
 
 
 def test_full_stack(tmp_path):
@@ -157,3 +164,68 @@ def test_full_stack(tmp_path):
         ctrl_srv.stop()
         reg_srv.stop()
         agent_srv.stop()
+
+
+def test_agent_restart_semantics(tmp_path):
+    """Device-plane crash: allocations are volatile (≙ the reference's
+    Malloc BDevs, spec.md:119-122), and the control plane's idempotent
+    surface does the recovery — CheckSlice reports the loss, CreateVolume
+    re-provisions under the same name, NodeStage re-attaches.  ≙ the
+    reference's stance that the registry/controller reconstruct state
+    rather than persist it (controller.go:425-443)."""
+    store = ChipStore(mesh=(2, 1, 1), device_dir=str(tmp_path / "dev"))
+    sock = str(tmp_path / "agent.sock")
+    agent = FakeAgentServer(store, sock).start()
+    controller = Controller("rst-host", sock)
+    srv = controller.start_server("tcp://127.0.0.1:0")
+    channel = grpc.insecure_channel(srv.addr().grpc_target())
+    stub = CONTROLLER.stub(channel)
+    try:
+        stub.ProvisionSlice(
+            oim_pb2.ProvisionSliceRequest(name="vol-r", chip_count=2),
+            timeout=10,
+        )
+        assert stub.CheckSlice(
+            oim_pb2.CheckSliceRequest(name="vol-r"), timeout=10
+        ).chip_count == 2
+
+        # The device plane dies and comes back EMPTY (volatile state).
+        agent.stop()
+        agent = FakeAgentServer(
+            ChipStore(mesh=(2, 1, 1), device_dir=str(tmp_path / "dev")), sock
+        ).start()
+
+        # The controller's cached connection died with the daemon: the
+        # first call surfaces UNAVAILABLE (the CO retries), the retry
+        # re-dials and reports the loss honestly (NOT_FOUND).
+        codes = []
+        for _ in range(2):
+            try:
+                stub.CheckSlice(
+                    oim_pb2.CheckSliceRequest(name="vol-r"), timeout=10
+                )
+                codes.append(None)
+            except grpc.RpcError as exc:
+                codes.append(exc.code())
+        assert codes[0] in (
+            grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.NOT_FOUND
+        )
+        assert codes[1] == grpc.StatusCode.NOT_FOUND
+
+        # Idempotent re-provision under the same name heals the volume.
+        stub.ProvisionSlice(
+            oim_pb2.ProvisionSliceRequest(name="vol-r", chip_count=2),
+            timeout=10,
+        )
+        reply = stub.MapVolume(
+            oim_pb2.MapVolumeRequest(
+                volume_id="vol-r", provisioned=oim_pb2.ProvisionedParams()
+            ),
+            timeout=10,
+        )
+        assert len(reply.chips) == 2
+    finally:
+        channel.close()
+        srv.stop()
+        controller.close()
+        agent.stop()
