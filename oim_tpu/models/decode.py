@@ -58,7 +58,7 @@ class KVCache:
     def create(
         cls, cfg: TransformerConfig, batch: int, max_len: int
     ) -> "KVCache":
-        shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+        shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
         dt = cfg.compute_dtype
         return cls(
             k=jnp.zeros(shape, dt),
@@ -88,16 +88,18 @@ def _cached_attention(x, lp, k_cache, v_cache, start, cfg: TransformerConfig):
     """Attend x's tokens (global positions start..start+t) against the
     cache prefix plus themselves; returns (x_out, new_k_cache, new_v_cache).
 
-    x: [B, t, D]; k_cache/v_cache: [B, max_len, H, hd]; start: scalar.
+    x: [B, t, D]; k_cache/v_cache: [B, max_len, KVH, hd] (kv heads — GQA
+    keeps the cache kv-sized); start: scalar.
     """
     b, t, _ = x.shape
-    h, hd = cfg.n_heads, cfg.head_dim
+    h, hd, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
+    group = h // kvh
     max_len = k_cache.shape[1]
 
     normed = _rmsnorm(x, lp["attn_norm"], cfg)
     q = jnp.einsum("btd,dn->btn", normed, lp["wq"]).reshape(b, t, h, hd)
-    k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, h, hd)
-    v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, h, hd)
+    k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, kvh, hd)
+    v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, kvh, hd)
     positions = start + jnp.arange(t)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
@@ -109,8 +111,13 @@ def _cached_attention(x, lp, k_cache, v_cache, start, cfg: TransformerConfig):
         v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
     )
 
+    # GQA: group query heads per kv head; the cache stays kv-sized (the
+    # whole point — decode is cache-bandwidth-bound).
+    q_g = q.reshape(b, t, kvh, group, hd)
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+        "bqhgd,bkhd->bhgqk",
+        q_g.astype(jnp.float32),
+        k_cache.astype(jnp.float32),
     ) / (hd**0.5)
     # Causal over global positions; cache slots past start+t are invalid.
     q_pos = start + jnp.arange(t)[:, None]
@@ -118,7 +125,7 @@ def _cached_attention(x, lp, k_cache, v_cache, start, cfg: TransformerConfig):
     scores = jnp.where(k_pos <= q_pos, scores, _NEG_BIG)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
-        "bhqk,bkhd->bqhd", probs, v_cache.astype(jnp.float32)
+        "bhgqk,bkhd->bqhgd", probs, v_cache.astype(jnp.float32)
     ).astype(x.dtype)
     out = out.reshape(b, t, h * hd)
     return x + jnp.einsum("btn,nd->btd", out, lp["wo"]).astype(x.dtype), (

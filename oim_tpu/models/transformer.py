@@ -48,6 +48,11 @@ class TransformerConfig:
     d_model: int = 512
     n_layers: int = 4
     n_heads: int = 8
+    # Grouped-query attention: kv heads shared by groups of query heads
+    # (0 → n_heads, classic MHA).  Shrinks the decode KV cache and its
+    # bandwidth by n_heads/n_kv_heads; the flash kernel reads grouped K/V
+    # natively.
+    n_kv_heads: int = 0
     d_ff: int = 0  # 0 → 4 * d_model
     n_experts: int = 0  # 0 → dense SwiGLU
     expert_capacity_factor: float = 1.25
@@ -83,10 +88,21 @@ class TransformerConfig:
                 f"unknown pp_schedule {self.pp_schedule!r}; "
                 "expected 'gpipe' or '1f1b'"
             )
+        if self.n_kv_heads and (
+            self.n_kv_heads < 1 or self.n_heads % self.n_kv_heads
+        ):
+            raise ValueError(
+                f"n_kv_heads={self.n_kv_heads} must be a positive divisor "
+                f"of n_heads={self.n_heads}"
+            )
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
     @property
     def ff_dim(self) -> int:
@@ -114,6 +130,7 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
     """Truncated-normal init, stacked [n_stages, layers_per_stage, ...]."""
     pdt = jnp.dtype(cfg.param_dtype)
     d, n = cfg.d_model, cfg.n_heads * cfg.head_dim
+    kvn = cfg.kv_heads * cfg.head_dim
     f, s, l = cfg.ff_dim, cfg.n_stages, cfg.layers_per_stage
     keys = iter(jax.random.split(key, 16))
 
@@ -127,8 +144,8 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
         "wte": dense(next(keys), cfg.vocab_size, d, fan_in=d),
         "attn_norm": jnp.ones((s, l, d), pdt),
         "wq": dense(next(keys), s, l, d, n, fan_in=d),
-        "wk": dense(next(keys), s, l, d, n, fan_in=d),
-        "wv": dense(next(keys), s, l, d, n, fan_in=d),
+        "wk": dense(next(keys), s, l, d, kvn, fan_in=d),
+        "wv": dense(next(keys), s, l, d, kvn, fan_in=d),
         "wo": dense(next(keys), s, l, n, d, fan_in=n),
         "mlp_norm": jnp.ones((s, l, d), pdt),
         "final_norm": jnp.ones((d,), pdt),
@@ -220,14 +237,21 @@ def _rmsnorm(x, w, cfg: TransformerConfig):
 
 def _attention(x, lp, positions, cfg: TransformerConfig, sp_size):
     b, t, d = x.shape
-    h, hd = cfg.n_heads, cfg.head_dim
+    h, hd, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     normed = _rmsnorm(x, lp["attn_norm"], cfg)
     q = jnp.einsum("btd,dn->btn", normed, lp["wq"]).reshape(b, t, h, hd)
-    k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, h, hd)
-    v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, h, hd)
+    k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, kvh, hd)
+    v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, kvh, hd)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     if sp_size > 1:
+        # The sequence-parallel schemes shard/rotate K/V by full head
+        # count; broadcast the kv groups up front (GQA's memory win here
+        # would need grouped ring blocks — future work, the flash path
+        # below keeps it).
+        if kvh != h:
+            k = jnp.repeat(k, h // kvh, axis=2)
+            v = jnp.repeat(v, h // kvh, axis=2)
         if cfg.attn_impl == "ulysses":
             out = ulysses_attention(
                 q, k, v, "sp", causal=True, use_flash=cfg.use_pallas

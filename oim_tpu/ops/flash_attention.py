@@ -33,7 +33,13 @@ _ROW_LANES = 8
 
 
 def reference_attention(q, k, v, causal: bool = True):
+    """O(T²) oracle.  Supports grouped-query attention: k/v may carry
+    fewer heads than q (H % KVH == 0); they are broadcast per group."""
     d = q.shape[-1]
+    if k.shape[2] != q.shape[2]:
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) / (d**0.5)
@@ -161,18 +167,23 @@ def _dq_kernel(
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, *, causal, scale, block_q, block_k,
+    dk_scr, dv_scr, *, causal, scale, block_q, block_k, n_q,
 ):
-    ki, qi = pl.program_id(1), pl.program_id(2)
-    n_q = pl.num_programs(2)
+    # Grid: (b·kvh, n_k, group·n_q) — the innermost dim walks every
+    # (q-head-in-group, q-block) pair so each kv-head's dk/dv output block
+    # is visited contiguously (GQA: several q heads accumulate into one
+    # kv head; a non-contiguous revisit would flush the block early).
+    ki, j = pl.program_id(1), pl.program_id(2)
+    n_j = pl.num_programs(2)
+    qi = j % n_q
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     relevant = (
-        qi * block_q + block_q - 1 >= ki * block_k if causal else qi >= 0
+        qi * block_q + block_q - 1 >= ki * block_k if causal else j >= 0
     )
 
     @pl.when(relevant)
@@ -201,7 +212,7 @@ def _dkv_kernel(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(j == n_j - 1)
     def _finalize():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
@@ -255,8 +266,25 @@ def _resolve_blocks(t: int, block_q: int, block_k: int):
     return block_q, block_k
 
 
+def _gqa_group(q, k):
+    """q-heads per kv-head (grouped-query attention; 1 = classic MHA)."""
+    h, kvh = q.shape[2], k.shape[2]
+    if h % kvh:
+        raise ValueError(f"n_heads {h} not divisible by n_kv_heads {kvh}")
+    return h // kvh
+
+
+def _kv_row_map(h: int, kvh: int):
+    """Grid-row map q-head row → kv-head row ([b, h] row-major → [b, kvh]
+    row-major): THE one definition both forward and backward index maps
+    use, so their kv addressing can never desynchronize."""
+    group = h // kvh
+    return lambda g: (g // h) * kvh + (g % h) // group
+
+
 def _forward(q, k, v, causal, block_q, block_k):
     b, t, h, d = q.shape
+    group = _gqa_group(q, k)
     blocks = _resolve_blocks(t, block_q, block_k)
     if blocks is None:
         # Ragged tails: fall back to the reference (bench shapes are
@@ -266,6 +294,10 @@ def _forward(q, k, v, causal, block_q, block_k):
     scale = 1.0 / (d**0.5)
     qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
     bh = b * h
+    # The kv index map folds the GQA grouping: q-head row g reads kv-head
+    # row g // group (per batch: rows are [b, h] row-major, so the batch
+    # offset rescales from h-strides to kvh-strides).
+    kv_row = _kv_row_map(h, h // group)
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, causal=causal, scale=scale,
@@ -278,8 +310,8 @@ def _forward(q, k, v, causal, block_q, block_k):
         grid=(bh, t // block_q, t // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda g, qi, ki: (g, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g, qi, ki: (g, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g, qi, ki: (g, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g, qi, ki: (kv_row(g), ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g, qi, ki: (kv_row(g), ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda g, qi, ki: (g, qi, 0)),
@@ -310,9 +342,12 @@ def _bwd(causal, block_q, block_k, residuals, g):
         )
         return vjp(g)
     b, t, h, d = q.shape
+    kvh = k.shape[2]
+    group = h // kvh
     block_q, block_k = _resolve_blocks(t, block_q, block_k)
     bh = b * h
     scale = 1.0 / (d**0.5)
+    n_q, n_k = t // block_q, t // block_k
     qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
     doh = _heads_first(g)
     # delta_i = Σ_d dO·O per row — the softmax-normalization term of dS.
@@ -322,34 +357,44 @@ def _bwd(causal, block_q, block_k, residuals, g):
     delta = jnp.broadcast_to(delta[..., None], (bh, t, _ROW_LANES))
 
     common = dict(causal=causal, scale=scale, block_q=block_q, block_k=block_k)
+    # GQA: q-head row g reads kv-head row kv_row(g) (group size 1 = MHA).
+    kv_row = _kv_row_map(h, kvh)
     qspec = pl.BlockSpec((1, block_q, d), lambda g_, qi, ki: (g_, qi, 0))
-    kspec = pl.BlockSpec((1, block_k, d), lambda g_, qi, ki: (g_, ki, 0))
+    kspec = pl.BlockSpec(
+        (1, block_k, d), lambda g_, qi, ki: (kv_row(g_), ki, 0)
+    )
     rowspec = pl.BlockSpec(
         (1, block_q, _ROW_LANES), lambda g_, qi, ki: (g_, qi, 0)
     )
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, **common),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-        grid=(bh, t // block_q, t // block_k),
+        grid=(bh, n_q, n_k),
         in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(qh, kh, vh, doh, lse, delta)
 
-    # dk/dv accumulate over q blocks: q is the inner (sequential) grid dim.
-    qspec2 = pl.BlockSpec((1, block_q, d), lambda g_, ki, qi: (g_, qi, 0))
-    kspec2 = pl.BlockSpec((1, block_k, d), lambda g_, ki, qi: (g_, ki, 0))
+    # dk/dv accumulate per kv head over every (q-head-in-group, q-block):
+    # grid rows are kv heads; the innermost dim j walks group·n_q pairs so
+    # the output block (g, ki) is visited contiguously.
+    q_row = lambda g_, j: (g_ // kvh) * h + (g_ % kvh) * group + j // n_q  # noqa: E731
+    qspec2 = pl.BlockSpec(
+        (1, block_q, d), lambda g_, ki, j: (q_row(g_, j), j % n_q, 0)
+    )
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda g_, ki, j: (g_, ki, 0))
     rowspec2 = pl.BlockSpec(
-        (1, block_q, _ROW_LANES), lambda g_, ki, qi: (g_, qi, 0)
+        (1, block_q, _ROW_LANES),
+        lambda g_, ki, j: (q_row(g_, j), j % n_q, 0),
     )
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, **common),
+        functools.partial(_dkv_kernel, n_q=n_q, **common),
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+            jax.ShapeDtypeStruct((b * kvh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * kvh, t, d), v.dtype),
         ],
-        grid=(bh, t // block_k, t // block_q),
+        grid=(b * kvh, n_k, group * n_q),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
         out_specs=[kspec2, kspec2],
         scratch_shapes=[
@@ -360,8 +405,8 @@ def _bwd(causal, block_q, block_k, residuals, g):
     )(qh, kh, vh, doh, lse, delta)
     return (
         _heads_last(dq, b, h),
-        _heads_last(dk, b, h),
-        _heads_last(dv, b, h),
+        _heads_last(dk, b, kvh),
+        _heads_last(dv, b, kvh),
     )
 
 

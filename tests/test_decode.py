@@ -269,3 +269,40 @@ class TestSamplingTruncation:
         )
         assert out.shape == (2, 10)
         assert (out >= 0).all() and (out < cfg.vocab_size).all()
+
+
+class TestGQADecode:
+    def test_cache_is_kv_sized_and_matches_forward(self):
+        """The decode cache shrinks to kv heads, and incremental decode
+        reproduces the training forward's argmax predictions."""
+        cfg = TransformerConfig(
+            **{**CFG, "n_heads": 4, "n_kv_heads": 2}
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.arange(12)[None, :] % cfg.vocab_size
+        cache = KVCache.create(cfg, batch=1, max_len=16)
+        assert cache.k.shape[3] == 2  # kv heads, not q heads
+
+        logits_pre, cache = prefill(params, tokens[:, :8], cfg, max_len=16)
+        outs = [int(jnp.argmax(logits_pre[0, -1]))]
+        for i in range(8, 12):
+            step_logits, cache = decode_step(
+                params, cache, tokens[:, i : i + 1], cfg
+            )
+            outs.append(int(jnp.argmax(step_logits[0])))
+
+        mesh = build_mesh(devices=jax.devices()[:1])
+        from oim_tpu.models.transformer import manual_pspecs
+        from jax.sharding import PartitionSpec as PS
+
+        full_logits, _ = jax.jit(
+            jax.shard_map(
+                lambda p, t: forward_local(p, t, cfg),
+                mesh=mesh,
+                in_specs=(manual_pspecs(cfg), PS("dp", "sp")),
+                out_specs=(PS("dp", "sp"), PS()),
+                check_vma=False,
+            )
+        )(params, tokens)
+        want = [int(jnp.argmax(full_logits[0, i])) for i in range(7, 12)]
+        assert outs == want
