@@ -1,0 +1,239 @@
+"""oim-train: the end-to-end training binary.
+
+Composes the whole compute stack the way a workload pod would: CSI-staged
+bootstrap (or a local mesh) → 5-axis mesh → deterministic sharded token
+batches with device prefetch → jitted train step (GPipe or 1F1B under pp)
+→ async orbax checkpoints with exact data-cursor resume.  Re-running the
+same command after an interruption continues from the latest checkpoint —
+the trainer is idempotent the way every control-plane RPC is.
+
+The reference framework has no trainer (it is a storage control plane);
+this is the TPU build's user-facing surface for actually running work on
+the slices the control plane provisions (SURVEY.md §2.3 TPU-build column).
+
+Usage (smoke, CPU):
+    JAX_PLATFORMS=cpu python -m oim_tpu.cli.train_main \\
+        --synthetic 200000 --steps 50 --batch-global 8 --seq 128 \\
+        --d-model 64 --n-layers 2 --n-heads 4 --checkpoint-dir /tmp/ckpt
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+from oim_tpu import log
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="oim-train", description=__doc__)
+    data = p.add_mutually_exclusive_group(required=True)
+    data.add_argument(
+        "--corpus", help=".npy (or memmap-able) 1-D int32 token corpus"
+    )
+    data.add_argument(
+        "--synthetic", type=int, metavar="N_TOKENS",
+        help="deterministic synthetic corpus (smoke tests / benchmarks)",
+    )
+    p.add_argument("--steps", type=int, required=True)
+    p.add_argument("--batch-global", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--seed", type=int, default=0)
+    # Model geometry.
+    p.add_argument("--vocab-size", type=int, default=32768)
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--n-layers", type=int, default=4)
+    p.add_argument("--n-heads", type=int, default=8)
+    p.add_argument("--n-kv-heads", type=int, default=0)
+    p.add_argument("--d-ff", type=int, default=0)
+    p.add_argument("--n-experts", type=int, default=0)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--attn-impl", default="ring", choices=["ring", "ulysses"])
+    p.add_argument("--pp-schedule", default="gpipe", choices=["gpipe", "1f1b"])
+    p.add_argument("--n-microbatches", type=int, default=1)
+    # Mesh: explicit axes, or inferred from the CSI-staged bootstrap.
+    p.add_argument("--dp", type=int, default=0, help="0 = use all remaining")
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1)
+    p.add_argument(
+        "--bootstrap", default="",
+        help="tpu-bootstrap.json path (default: $TPU_BOOTSTRAP when set)",
+    )
+    # Optimization + lifecycle.
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--save-every", type=int, default=200)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--log-level", default="info")
+    return p
+
+
+def _load_corpus(args) -> np.ndarray:
+    if args.corpus:
+        tokens = np.load(args.corpus, mmap_mode="r")
+        return tokens
+    rng = np.random.default_rng(args.seed)
+    # Markov-ish ramp so the loss visibly falls on smoke runs.
+    base = rng.integers(0, args.vocab_size, size=args.synthetic // 8)
+    ramp = (base[:, None] + np.arange(8)[None, :]) % args.vocab_size
+    return ramp.reshape(-1).astype(np.int32)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    log.init_from_string(args.log_level)
+
+    import jax
+
+    from oim_tpu.data.loader import ShardSpec, TokenBatches
+    from oim_tpu.data.prefetch import device_prefetch
+    from oim_tpu.models import (
+        TrainState,
+        TransformerConfig,
+        init_params,
+        make_train_step,
+    )
+    from oim_tpu.models.train import data_pspec
+    from oim_tpu.parallel import build_mesh, mesh_from_bootstrap
+    from oim_tpu.parallel.coordinator import (
+        apply_chip_binding,
+        initialize_distributed,
+        load_bootstrap,
+    )
+
+    bootstrap_path = args.bootstrap or os.environ.get("TPU_BOOTSTRAP", "")
+    axes = dict(pp=args.pp, sp=args.sp, tp=args.tp, ep=args.ep)
+    if bootstrap_path:
+        bootstrap = load_bootstrap(bootstrap_path)
+        apply_chip_binding(bootstrap)
+        initialize_distributed(bootstrap)
+        log.current().info(
+            "bootstrap loaded", volume=bootstrap.volume_id,
+            chips=bootstrap.chip_count,
+            process=f"{bootstrap.process_id}/{bootstrap.num_processes}",
+        )
+        # mesh_from_bootstrap infers dp from the slice's chip count and
+        # errors on non-dividing axis products (no silently idle chips).
+        mesh = mesh_from_bootstrap(bootstrap, dp=args.dp, **axes)
+    else:
+        n = jax.device_count()
+        fixed = args.pp * args.sp * args.tp * args.ep
+        dp = args.dp or n // fixed
+        if not args.dp and dp * fixed != n:
+            # Inferred dp flooring would silently idle chips; make the
+            # operator choose.
+            raise SystemExit(
+                f"{n} devices not divisible by pp*sp*tp*ep={fixed}; pass "
+                "--dp explicitly (a sub-mesh is allowed when explicit)"
+            )
+        if dp * fixed < n:
+            log.current().warning(
+                "mesh uses a subset of devices",
+                used=dp * fixed, available=n,
+            )
+        mesh = build_mesh(dp=dp, **axes)
+    log.current().info("mesh", shape=str(dict(mesh.shape)))
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab_size,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
+        d_ff=args.d_ff,
+        n_experts=args.n_experts,
+        n_stages=args.pp,
+        n_microbatches=max(args.n_microbatches, 1),
+        dtype=args.dtype,
+        attn_impl=args.attn_impl,
+        pp_schedule=args.pp_schedule,
+    )
+
+    import optax
+
+    optimizer = optax.adamw(args.lr)
+
+    def init_fn() -> TrainState:
+        return TrainState.create(
+            init_params(jax.random.PRNGKey(args.seed), cfg), optimizer
+        )
+
+    start_step = 0
+    checkpointer = None
+    if args.checkpoint_dir:
+        from oim_tpu.checkpoint import Checkpointer, CheckpointerOptions
+
+        checkpointer = Checkpointer(
+            args.checkpoint_dir, cfg, mesh,
+            options=CheckpointerOptions(save_interval_steps=args.save_every),
+        )
+        state, data_state, resumed = checkpointer.restore_or_init(init_fn)
+        if resumed:
+            # The data cursor is authoritative for the token stream; it
+            # equals state.step in this trainer, but consuming it keeps the
+            # checkpoint package's resume contract honest.
+            start_step = int(
+                (data_state or {}).get(
+                    "next_step", jax.device_get(state.step)
+                )
+            )
+            log.current().info("resumed", step=start_step)
+    else:
+        from oim_tpu.models.train import shard_state
+
+        state, resumed = shard_state(init_fn(), cfg, mesh), False
+
+    tokens = _load_corpus(args)
+    shard = ShardSpec(jax.process_index(), jax.process_count())
+    batches = TokenBatches(
+        tokens, args.batch_global, args.seq, shard, seed=args.seed
+    )
+    sharding = jax.sharding.NamedSharding(mesh, data_pspec())
+
+    def batch_stream():
+        step = start_step
+        while step < args.steps:
+            # [b, seq+1] windows; the train step derives labels itself, so
+            # feed the first seq tokens (the +1 boundary token is the next
+            # window's first input — nothing is lost).
+            yield batches.batch_at(step)[:, : args.seq]
+            step += 1
+
+    step_fn = make_train_step(cfg, mesh, optimizer)
+    t0 = time.perf_counter()
+    window_tokens = 0
+    step = start_step
+    try:
+        for device_batch in device_prefetch(batch_stream(), sharding):
+            state, metrics = step_fn(state, device_batch)
+            step += 1
+            window_tokens += args.batch_global * args.seq
+            if step % args.log_every == 0 or step == args.steps:
+                loss = float(jax.device_get(metrics["loss"]))
+                dt = time.perf_counter() - t0
+                log.current().info(
+                    "step", step=step, loss=round(loss, 4),
+                    tok_per_s=round(window_tokens / max(dt, 1e-9)),
+                )
+                t0, window_tokens = time.perf_counter(), 0
+            # Gate host-side: Checkpointer.save device_gets state.step
+            # (a per-step host sync would serialize dispatch against the
+            # async prefetch for nothing on off-interval steps).
+            if checkpointer is not None and step % args.save_every == 0:
+                checkpointer.save(state, {"next_step": step})
+    finally:
+        if checkpointer is not None:
+            if checkpointer.latest_step() != step:
+                checkpointer.save(state, {"next_step": step}, force=True)
+            checkpointer.close()
+    log.current().info("done", steps=step)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -128,3 +128,32 @@ def test_cli_cluster_roundtrip(cluster, capsys):
     # Errors surface as exit code 1 with the gRPC status.
     assert _ctl(registry, "map", "vol-x", "--controller", "ghost") == 1
     assert "UNAVAILABLE" in capsys.readouterr().out
+
+
+def test_train_main_smoke_and_resume(tmp_path):
+    """The end-to-end trainer binary: fresh run checkpoints, re-running the
+    same command resumes from the latest step and continues."""
+    ckpt = str(tmp_path / "ckpt")
+    base = [
+        sys.executable, "-m", "oim_tpu.cli.train_main",
+        "--synthetic", "100000", "--batch-global", "8", "--seq", "32",
+        "--vocab-size", "128", "--d-model", "32", "--n-layers", "2",
+        "--n-heads", "4", "--dtype", "float32", "--dp", "2", "--sp", "2",
+        "--checkpoint-dir", ckpt, "--save-every", "3", "--log-every", "2",
+    ]
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    first = subprocess.run(
+        base + ["--steps", "4"], capture_output=True, text=True, env=env,
+        timeout=300,
+    )
+    assert first.returncode == 0, first.stderr[-2000:]
+    assert "done steps=4" in first.stderr
+
+    second = subprocess.run(
+        base + ["--steps", "6"], capture_output=True, text=True, env=env,
+        timeout=300,
+    )
+    assert second.returncode == 0, second.stderr[-2000:]
+    assert "resumed step=4" in second.stderr
+    assert "done steps=6" in second.stderr
