@@ -67,10 +67,20 @@ def build_parser() -> argparse.ArgumentParser:
     # Optimization + lifecycle.
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--checkpoint-dir", default="")
-    p.add_argument("--save-every", type=int, default=200)
+    p.add_argument(
+        "--save-every", type=_positive_int, default=200,
+        help="checkpoint interval in steps (>= 1)",
+    )
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--log-level", default="info")
     return p
+
+
+def _positive_int(value: str) -> int:
+    n = int(value)
+    if n < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
+    return n
 
 
 def _load_corpus(args) -> np.ndarray:
@@ -186,7 +196,7 @@ def main(argv=None) -> int:
     else:
         from oim_tpu.models.train import shard_state
 
-        state, resumed = shard_state(init_fn(), cfg, mesh), False
+        state = shard_state(init_fn(), cfg, mesh)
 
     tokens = _load_corpus(args)
     shard = ShardSpec(jax.process_index(), jax.process_count())
@@ -198,9 +208,10 @@ def main(argv=None) -> int:
     def batch_stream():
         step = start_step
         while step < args.steps:
-            # [b, seq+1] windows; the train step derives labels itself, so
-            # feed the first seq tokens (the +1 boundary token is the next
-            # window's first input — nothing is lost).
+            # [b, seq+1] windows; the train step derives labels itself
+            # from a [b, seq] input, so the window's +1 boundary token is
+            # dropped — its LABEL role is lost (1/seq of supervision), a
+            # deliberate trade for shard-divisible static shapes.
             yield batches.batch_at(step)[:, : args.seq]
             step += 1
 
@@ -228,9 +239,16 @@ def main(argv=None) -> int:
                 checkpointer.save(state, {"next_step": step})
     finally:
         if checkpointer is not None:
-            if checkpointer.latest_step() != step:
-                checkpointer.save(state, {"next_step": step}, force=True)
-            checkpointer.close()
+            try:
+                # The train step donates the state buffers: after an
+                # exception mid-step the arrays are deleted and a rescue
+                # save would mask the root cause — save only when alive.
+                leaves = jax.tree_util.tree_leaves(state)
+                alive = bool(leaves) and not leaves[0].is_deleted()
+                if alive and checkpointer.latest_step() != step:
+                    checkpointer.save(state, {"next_step": step}, force=True)
+            finally:
+                checkpointer.close()  # always await queued async saves
     log.current().info("done", steps=step)
     return 0
 

@@ -245,18 +245,19 @@ def _attention(x, lp, positions, cfg: TransformerConfig, sp_size):
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     if sp_size > 1:
-        # The sequence-parallel schemes shard/rotate K/V by full head
-        # count; broadcast the kv groups up front (GQA's memory win here
-        # would need grouped ring blocks — future work, the flash path
-        # below keeps it).
-        if kvh != h:
-            k = jnp.repeat(k, h // kvh, axis=2)
-            v = jnp.repeat(v, h // kvh, axis=2)
         if cfg.attn_impl == "ulysses":
+            # Ulysses trades sequence shards for HEAD shards via
+            # all_to_all; broadcast the kv groups so every shard gets a
+            # full head set (grouped head-sharding is future work).
+            if kvh != h:
+                k = jnp.repeat(k, h // kvh, axis=2)
+                v = jnp.repeat(v, h // kvh, axis=2)
             out = ulysses_attention(
                 q, k, v, "sp", causal=True, use_flash=cfg.use_pallas
             )
         else:  # "ring" (validated in __post_init__)
+            # The ring carries kv-sized blocks natively: GQA divides the
+            # rotation traffic by n_heads/n_kv_heads.
             out = ring_attention(q, k, v, "sp", causal=True)
     elif cfg.use_pallas:
         out = flash_attention(q, k, v, True)

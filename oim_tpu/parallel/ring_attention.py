@@ -27,22 +27,24 @@ _NEG_BIG = -1e30  # finite "-inf": keeps fully-masked rows NaN-free
 def _block_attention(q, k, v, m, l, o, q_offset, k_offset, causal, scale):
     """One flash-style accumulation step of local q against one k/v block.
 
-    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]
-    m, l: [B, H, Tq]; o: [B, Tq, H, D] (running max / denom / numerator)
+    Grouped-query form (classic MHA is group size 1):
+    q: [B, Tq, KVH, G, D]; k, v: [B, Tk, KVH, D]
+    m, l: [B, KVH, G, Tq]; o: [B, Tq, KVH, G, D]
+    (running max / denominator / numerator)
     """
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k) * scale
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         q_pos = q_offset + jnp.arange(tq)[:, None]
         k_pos = k_offset + jnp.arange(tk)[None, :]
         scores = jnp.where(q_pos >= k_pos, scores, _NEG_BIG)
-    block_max = jnp.max(scores, axis=-1)  # [B, H, Tq]
+    block_max = jnp.max(scores, axis=-1)  # [B, KVH, G, Tq]
     new_m = jnp.maximum(m, block_max)
     correction = jnp.exp(m - new_m)
-    p = jnp.exp(scores - new_m[..., None])  # [B, H, Tq, Tk]
+    p = jnp.exp(scores - new_m[..., None])  # [B, KVH, G, Tq, Tk]
     new_l = l * correction + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
-    new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+    pv = jnp.einsum("bhgqk,bkhd->bqhgd", p, v)
+    new_o = o * correction.transpose(0, 3, 1, 2)[..., None] + pv
     return new_m, new_l, new_o
 
 
@@ -67,17 +69,25 @@ def ring_attention(
     size = jax.lax.axis_size(axis_name)
     index = jax.lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
+    kvh = k.shape[2]
+    if h % kvh:
+        raise ValueError(f"n_heads {h} not divisible by n_kv_heads {kvh}")
+    group = h // kvh
     scale = 1.0 / (d**0.5)
     dtype = q.dtype
     # Accumulate in f32 regardless of input dtype (bf16-safe softmax).
-    qf, kf, vf = q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    # GQA: q is grouped per kv head and the RING CARRIES KV-SIZED BLOCKS —
+    # the rotation traffic shrinks by n_heads/n_kv_heads (classic MHA is
+    # simply group size 1 through the same path).
+    qf = q.astype(jnp.float32).reshape(b, t_local, kvh, group, d)
+    kf, vf = k.astype(jnp.float32), v.astype(jnp.float32)
 
     # Derive the accumulator inits from q (zeroed) rather than jnp.zeros:
     # under shard_map the carry must have the same varying-manual-axes type
     # as the loop outputs, and inheriting q's does that on any jax version.
-    zero_bht = jnp.swapaxes(qf, 1, 2)[..., 0] * 0.0  # [B, H, Tq]
-    m0 = zero_bht + _NEG_BIG
-    l0 = zero_bht
+    zero_stats = jnp.moveaxis(qf, 1, 3)[..., 0] * 0.0  # [B, KVH, G, Tq]
+    m0 = zero_stats + _NEG_BIG
+    l0 = zero_stats
     o0 = qf * 0.0
     q_offset = index * t_local
 
@@ -101,8 +111,8 @@ def ring_attention(
     )
     # Fully-masked rows (can only happen for non-causal degenerate inputs)
     # keep l == 0; guard the division.
-    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return (o / denom).astype(dtype)
+    denom = jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
+    return (o / denom).reshape(b, t_local, h, d).astype(dtype)
 
 
 # The O(T²) correctness oracle lives in oim_tpu.ops (one canonical copy).
