@@ -66,6 +66,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     # Optimization + lifecycle.
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument(
+        "--warmup-steps", type=int, default=0,
+        help="linear warmup; with --decay-steps forms warmup+cosine",
+    )
+    p.add_argument(
+        "--decay-steps", type=int, default=0,
+        help="cosine-decay horizon after warmup (0 = constant lr)",
+    )
+    p.add_argument(
+        "--grad-clip", type=float, default=0.0,
+        help="global-norm gradient clip (0 = off)",
+    )
+    p.add_argument("--weight-decay", type=float, default=1e-2)
     p.add_argument("--checkpoint-dir", default="")
     p.add_argument(
         "--save-every", type=_positive_int, default=200,
@@ -166,7 +179,22 @@ def main(argv=None) -> int:
 
     import optax
 
-    optimizer = optax.adamw(args.lr)
+    if args.decay_steps:
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=args.lr,
+            warmup_steps=max(args.warmup_steps, 1),
+            decay_steps=args.decay_steps,
+        )
+    elif args.warmup_steps:
+        lr = optax.linear_schedule(0.0, args.lr, args.warmup_steps)
+    else:
+        lr = args.lr
+    optimizer = optax.adamw(lr, weight_decay=args.weight_decay)
+    if args.grad_clip > 0:
+        optimizer = optax.chain(
+            optax.clip_by_global_norm(args.grad_clip), optimizer
+        )
 
     def init_fn() -> TrainState:
         return TrainState.create(
