@@ -67,18 +67,21 @@ def build_parser() -> argparse.ArgumentParser:
     # Optimization + lifecycle.
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument(
-        "--warmup-steps", type=int, default=0,
+        "--warmup-steps", type=_nonneg_int, default=0,
         help="linear warmup; with --decay-steps forms warmup+cosine",
     )
     p.add_argument(
-        "--decay-steps", type=int, default=0,
+        "--decay-steps", type=_nonneg_int, default=0,
         help="cosine-decay horizon after warmup (0 = constant lr)",
     )
     p.add_argument(
         "--grad-clip", type=float, default=0.0,
         help="global-norm gradient clip (0 = off)",
     )
-    p.add_argument("--weight-decay", type=float, default=1e-2)
+    p.add_argument(
+        "--weight-decay", type=float, default=1e-4,
+        help="adamw decay on matmul weights (norm gains are excluded)",
+    )
     p.add_argument("--checkpoint-dir", default="")
     p.add_argument(
         "--save-every", type=_positive_int, default=200,
@@ -93,6 +96,13 @@ def _positive_int(value: str) -> int:
     n = int(value)
     if n < 1:
         raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
+    return n
+
+
+def _nonneg_int(value: str) -> int:
+    n = int(value)
+    if n < 0:
+        raise argparse.ArgumentTypeError(f"must be >= 0, got {n}")
     return n
 
 
@@ -184,13 +194,22 @@ def main(argv=None) -> int:
             init_value=0.0,
             peak_value=args.lr,
             warmup_steps=max(args.warmup_steps, 1),
-            decay_steps=args.decay_steps,
+            # optax counts warmup INSIDE decay_steps; the flag's contract
+            # is "horizon after warmup".
+            decay_steps=args.warmup_steps + args.decay_steps,
         )
     elif args.warmup_steps:
         lr = optax.linear_schedule(0.0, args.lr, args.warmup_steps)
     else:
         lr = args.lr
-    optimizer = optax.adamw(lr, weight_decay=args.weight_decay)
+    optimizer = optax.adamw(
+        lr,
+        weight_decay=args.weight_decay,
+        # Standard practice: decay matmul weights, never norm gains.
+        mask=lambda params: {
+            name: not name.endswith("_norm") for name in params
+        },
+    )
     if args.grad_clip > 0:
         optimizer = optax.chain(
             optax.clip_by_global_norm(args.grad_clip), optimizer
