@@ -1,0 +1,144 @@
+"""oim-serve: the inference-serving binary.
+
+The serving counterpart of ``oim-train``: loads a checkpoint (or random
+weights for smoke tests), stands up the continuous-batching engine over a
+slot-based KV cache, and serves token-id generation over HTTP.  Like the
+trainer, it can take its accelerator binding from a CSI-staged bootstrap
+(the pod's ``tpu-bootstrap.json``) — the workload the control plane
+provisions slices *for*.
+
+The reference framework has no serving surface (it is a storage control
+plane); this is new work per SURVEY.md §2.3's TPU-build column.
+
+Usage (smoke, CPU):
+    JAX_PLATFORMS=cpu python -m oim_tpu.cli.serve_main \\
+        --vocab-size 256 --d-model 64 --n-layers 2 --n-heads 4 \\
+        --max-len 128 --port 8000
+Then:
+    curl -s localhost:8000/v1/generate -d \\
+        '{"tokens": [1,2,3], "max_new_tokens": 8}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from oim_tpu import log
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="oim-serve", description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000, help="0 = ephemeral")
+    # Model geometry (must match the checkpoint when one is given).
+    p.add_argument("--vocab-size", type=int, default=32768)
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--n-layers", type=int, default=4)
+    p.add_argument("--n-heads", type=int, default=8)
+    p.add_argument("--n-kv-heads", type=int, default=0)
+    p.add_argument("--d-ff", type=int, default=0)
+    p.add_argument("--n-experts", type=int, default=0)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument(
+        "--checkpoint-dir", default="",
+        help="orbax checkpoint dir from oim-train (empty = random init)",
+    )
+    # Engine shape.
+    p.add_argument("--n-slots", type=int, default=8)
+    p.add_argument("--max-len", type=int, default=1024)
+    p.add_argument("--chunk", type=int, default=8)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument(
+        "--bootstrap", default="",
+        help="tpu-bootstrap.json path (default: $TPU_BOOTSTRAP when set)",
+    )
+    p.add_argument(
+        "--no-warmup", action="store_true",
+        help="skip pre-compiling admit buckets + decode (first live "
+        "requests then pay the 20-40s TPU compiles)",
+    )
+    p.add_argument("--log-level", default="info")
+    return p
+
+
+def make_engine(args):
+    """Build the engine from parsed args (separated for tests)."""
+    import jax
+
+    from oim_tpu.models import TransformerConfig, init_params
+    from oim_tpu.serve import Engine
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab_size,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
+        d_ff=args.d_ff or 4 * args.d_model,
+        n_experts=args.n_experts,
+        dtype=args.dtype,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    if args.checkpoint_dir:
+        import optax
+
+        from oim_tpu.checkpoint import Checkpointer
+        from oim_tpu.models.train import TrainState
+
+        with Checkpointer(args.checkpoint_dir) as ckpt:
+            state, _ = ckpt.restore_or_init(
+                lambda: TrainState.create(params, optax.sgd(1e-3))
+            )
+        params = state.params
+        log.current().info(
+            "checkpoint restored", dir=args.checkpoint_dir,
+            step=int(state.step),
+        )
+    return Engine(
+        params,
+        cfg,
+        n_slots=args.n_slots,
+        max_len=args.max_len,
+        chunk=args.chunk,
+        top_k=args.top_k,
+        top_p=args.top_p,
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    log.init_from_string(args.log_level)
+
+    bootstrap_path = args.bootstrap or os.environ.get("TPU_BOOTSTRAP", "")
+    if bootstrap_path:
+        from oim_tpu.parallel import apply_chip_binding, load_bootstrap
+
+        applied = apply_chip_binding(load_bootstrap(bootstrap_path))
+        log.current().info("chip binding", path=bootstrap_path, applied=applied)
+
+    from oim_tpu.serve.server import ServeServer
+
+    engine = make_engine(args)
+    if not args.no_warmup:
+        log.current().info("warming up", buckets=list(engine.prompt_buckets))
+        engine.warmup()
+    server = ServeServer(engine, host=args.host, port=args.port).start()
+    log.current().info(
+        "oim-serve listening", host=server.host, port=server.port,
+        n_slots=args.n_slots, max_len=args.max_len,
+    )
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

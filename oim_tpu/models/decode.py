@@ -237,23 +237,18 @@ def decode_step(
     return logits[:, -1, :], cache
 
 
-def sample_token(
-    logits, temperature: float, key, top_k: int = 0, top_p: float = 1.0
-) -> jax.Array:
-    """Greedy at temperature 0 (or no key); else categorical over the
-    temperature-scaled logits, optionally truncated to the top-k tokens
-    and/or the top-p (nucleus) probability mass.  ``top_k``/``top_p`` are
-    static (jit-friendly: no data-dependent shapes — truncation is a
-    mask, not a gather)."""
+def _validate_truncation(top_k: int, top_p: float, vocab: int) -> None:
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    if top_k < 0 or top_k > logits.shape[-1]:
-        raise ValueError(
-            f"top_k must be in [0, vocab={logits.shape[-1]}], got {top_k}"
-        )
-    if temperature == 0.0 or key is None:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
+    if top_k < 0 or top_k > vocab:
+        raise ValueError(f"top_k must be in [0, vocab={vocab}], got {top_k}")
+
+
+def truncate_logits(logits, top_k: int = 0, top_p: float = 1.0) -> jax.Array:
+    """Mask logits outside the top-k tokens and/or the top-p (nucleus)
+    probability mass.  ``top_k``/``top_p`` are static (jit-friendly: no
+    data-dependent shapes — truncation is a mask, not a gather)."""
+    _validate_truncation(top_k, top_p, logits.shape[-1])
     if top_k:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]  # [b, 1]
         logits = jnp.where(logits < kth, _NEG_BIG, logits)
@@ -269,6 +264,19 @@ def sample_token(
             jnp.where(cut, jnp.inf, sorted_desc), axis=-1, keepdims=True
         )
         logits = jnp.where(logits < threshold, _NEG_BIG, logits)
+    return logits
+
+
+def sample_token(
+    logits, temperature: float, key, top_k: int = 0, top_p: float = 1.0
+) -> jax.Array:
+    """Greedy at temperature 0 (or no key); else categorical over the
+    temperature-scaled logits truncated by ``truncate_logits``."""
+    if temperature == 0.0 or key is None:
+        # Validate the static args even though greedy ignores them.
+        _validate_truncation(top_k, top_p, logits.shape[-1])
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = truncate_logits(logits / temperature, top_k, top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 

@@ -1,0 +1,11 @@
+"""Serving engine: continuous batching over a slot-based KV cache.
+
+New work for the TPU build (the reference is a storage control plane with
+no inference surface; SURVEY.md §2.3's TPU-build column).  The engine is
+the inference counterpart of ``cli/train_main.py``: it turns the decode
+path (``models/decode.py``) into a multi-request server.
+"""
+
+from oim_tpu.serve.engine import Engine, GenRequest, SlotCache
+
+__all__ = ["Engine", "GenRequest", "SlotCache"]

@@ -1,0 +1,598 @@
+"""Continuous-batching inference engine over a slot-based KV cache.
+
+TPU-first design (vs. the GPU-idiomatic "paged attention" approach of
+dynamic page tables + gather kernels):
+
+- **Slots, not pages.**  The cache is ``[n_layers, n_slots, max_len,
+  kv_heads, head_dim]`` — one contiguous region per request slot, with a
+  per-slot ``lengths`` vector doing the work of a page table.  Static
+  shapes mean XLA compiles exactly one decode program; admission and
+  completion never reshape anything.
+- **Continuous batching.**  New requests are admitted into free slots
+  while other slots keep decoding: ``admit`` prefills one slot's region
+  (prompt lengths bucketed to bound compiles), ``decode_chunk`` advances
+  every active slot.  The [B] ``starts`` vector generalizes
+  ``models/decode.py``'s scalar cache length — each slot attends only to
+  its own prefix.
+- **Chunked decode.**  ``decode_chunk`` runs ``chunk`` steps in one
+  ``lax.scan`` dispatch and returns ``[n_slots, chunk]`` tokens — one
+  host↔device round trip per chunk, not per token.  On a tunneled or
+  remote-host deployment (this box: ~70 ms/readback) that is the
+  difference between 14 tok/s and line rate; EOS detection lags by at
+  most one chunk, which costs bounded wasted compute, never correctness
+  (the host truncates at EOS before emitting).
+- **Exactness.**  A request decoded via the engine produces exactly the
+  tokens ``models.decode.generate`` produces for the same prompt (greedy;
+  verified in tests/test_serve.py) — batching composition cannot change
+  results because every slot's attention is masked to its own length.
+  One carve-out: MoE models (``n_experts > 0``) prefill with the train
+  path's capacity routing, where pad tokens count against expert
+  capacity — so MoE exactness holds at prompt-bucket boundaries only
+  (dense models are exact at every length).
+- **Per-request sampling streams.**  Every sampled token's PRNG key is
+  ``fold_in(PRNGKey(request.seed), token_index)`` — a function of the
+  request alone, so temperature>0 results are reproducible across runs
+  and invariant to slot assignment, batching composition, and chunk
+  size, the same property greedy gets for free.
+
+The engine itself is host-side Python (the analog of the reference's
+control-plane daemons); everything that touches the accelerator is a
+handful of jitted functions with donated cache buffers.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field, replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from oim_tpu.models.decode import (
+    _dense_mlp,
+    _flat_layer_params,
+    _moe_exact,
+    truncate_logits,
+)
+from oim_tpu.models.transformer import (
+    TransformerConfig,
+    _rmsnorm,
+    _switch_moe,
+    _unembed,
+)
+from oim_tpu.ops.rope import apply_rope
+
+_NEG_BIG = -1e30
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class SlotCache:
+    """KV cache with one region per request slot.
+
+    ``k``/``v``: [n_layers, n_slots, max_len, kv_heads, head_dim];
+    ``lengths``: [n_slots] int32 — valid positions per slot (the engine's
+    "page table": a slot attends to rows < its own length only).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array
+
+    @classmethod
+    def create(
+        cls, cfg: TransformerConfig, n_slots: int, max_len: int
+    ) -> "SlotCache":
+        shape = (cfg.n_layers, n_slots, max_len, cfg.kv_heads, cfg.head_dim)
+        return cls(
+            k=jnp.zeros(shape, cfg.compute_dtype),
+            v=jnp.zeros(shape, cfg.compute_dtype),
+            lengths=jnp.zeros((n_slots,), jnp.int32),
+        )
+
+    @property
+    def n_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def _slot_attention(x, lp, k_cache, v_cache, starts, cfg: TransformerConfig):
+    """Cached attention with per-slot start positions.
+
+    x: [B, t, D]; k_cache/v_cache: [B, max_len, KVH, hd]; starts: [B].
+    Generalizes ``decode._cached_attention`` (scalar start) to a vector —
+    the one primitive continuous batching needs.
+    """
+    b, t, _ = x.shape
+    h, hd, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
+    group = h // kvh
+    max_len = k_cache.shape[1]
+
+    normed = _rmsnorm(x, lp["attn_norm"], cfg)
+    q = jnp.einsum("btd,dn->btn", normed, lp["wq"]).reshape(b, t, h, hd)
+    k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, kvh, hd)
+    v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, kvh, hd)
+    positions = starts[:, None] + jnp.arange(t)  # [B, t] global positions
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+
+    write = lambda c, u, s: jax.lax.dynamic_update_slice(  # noqa: E731
+        c, u, (s, 0, 0)
+    )
+    k_cache = jax.vmap(write)(k_cache, k.astype(k_cache.dtype), starts)
+    v_cache = jax.vmap(write)(v_cache, v.astype(v_cache.dtype), starts)
+
+    q_g = q.reshape(b, t, kvh, group, hd)
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk",
+        q_g.astype(jnp.float32),
+        k_cache.astype(jnp.float32),
+    ) / (hd**0.5)
+    # Causal per slot: query at global position p attends to rows <= p of
+    # its own region; rows past the slot's frontier are invalid.
+    q_pos = positions[:, None, None, :, None]  # [B, 1, 1, t, 1]
+    k_pos = jnp.arange(max_len)[None, None, None, None, :]
+    scores = jnp.where(k_pos <= q_pos, scores, _NEG_BIG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", probs, v_cache.astype(jnp.float32)
+    ).astype(x.dtype)
+    out = out.reshape(b, t, h * hd)
+    return x + jnp.einsum("btn,nd->btd", out, lp["wo"]).astype(x.dtype), (
+        k_cache,
+        v_cache,
+    )
+
+
+def _forward_slots(params, tokens, k_all, v_all, starts, cfg, is_prefill):
+    """tokens [B, t] at per-slot positions ``starts`` → (logits, k, v).
+
+    k_all/v_all: [n_layers, B, max_len, KVH, hd].  MoE routing follows
+    ``models/decode.py``: capacity routing on prefill (exact agreement
+    with the training forward), drop-free argmax on incremental steps.
+    """
+    cfg = replace(cfg, use_pallas=False)
+    x = params["wte"].astype(cfg.compute_dtype)[tokens]
+    flat = _flat_layer_params(params, cfg)
+
+    def layer_step(x, scanned):
+        lp, k_cache, v_cache = scanned
+        x, (k_cache, v_cache) = _slot_attention(
+            x, lp, k_cache, v_cache, starts, cfg
+        )
+        if cfg.n_experts:
+            if is_prefill:
+                x, _ = _switch_moe(x, lp, cfg)
+            else:
+                x = _moe_exact(x, lp, cfg)
+        else:
+            x, _ = _dense_mlp(x, lp, cfg)
+        return x, (k_cache, v_cache)
+
+    x, (k_all, v_all) = jax.lax.scan(layer_step, x, (flat, k_all, v_all))
+    x = _rmsnorm(x, params["final_norm"], cfg)
+    return _unembed(x, params["wlm"], cfg), k_all, v_all
+
+
+def _sample_batched(logits, temps, keys, top_k, top_p):
+    """Per-slot temperature sampling with per-slot PRNG keys: greedy
+    where temp == 0, else categorical over temperature-scaled logits with
+    the engine's static top-k/top-p truncation (``truncate_logits`` — the
+    same masking the solo path uses)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = truncate_logits(
+        logits / jnp.maximum(temps, 1e-6)[:, None], top_k, top_p
+    )
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row)
+    )(keys, scaled).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
+def _admit(
+    params, cache: SlotCache, prompt, slot, true_len, temp, key,
+    *, cfg, top_k, top_p,
+):
+    """Prefill ``prompt`` [Lb] (padded to its bucket) into slot ``slot``
+    and sample the first generated token.  Returns (cache, first_token).
+
+    Pad positions past ``true_len`` are written but masked forever: the
+    slot's length stops at ``true_len`` and decode overwrites them one by
+    one, so padding never reaches attention.
+    """
+    k_slot = jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
+    v_slot = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
+    starts = jnp.zeros((1,), jnp.int32)
+    logits, k_slot, v_slot = _forward_slots(
+        params, prompt[None], k_slot, v_slot, starts, cfg, is_prefill=True
+    )
+    k_all = jax.lax.dynamic_update_slice_in_dim(cache.k, k_slot, slot, axis=1)
+    v_all = jax.lax.dynamic_update_slice_in_dim(cache.v, v_slot, slot, axis=1)
+    lengths = jax.lax.dynamic_update_slice(
+        cache.lengths, true_len[None], (slot,)
+    )
+    last = jax.lax.dynamic_index_in_dim(
+        logits[0], true_len - 1, axis=0, keepdims=False
+    )
+    first = _sample_batched(last[None], temp[None], key[None], top_k, top_p)[0]
+    return SlotCache(k_all, v_all, lengths), first
+
+
+def _decode_chunk(
+    params, cache: SlotCache, tokens, temps, active, bases, counts,
+    *, cfg, chunk, top_k, top_p,
+):
+    """Advance every active slot by ``chunk`` tokens in one dispatch.
+
+    tokens [S] (each slot's latest token), temps [S], active [S] bool,
+    bases [S] per-request PRNG base keys, counts [S] tokens already
+    generated per request.  Returns (cache, out [S, chunk]).
+
+    Step ``i`` samples slot ``s`` with ``fold_in(bases[s], counts[s]+i)``
+    — the key is a function of (request seed, absolute token index), so
+    chunking and batching are invisible to sampling.  Inactive or
+    budget-exhausted slots keep computing (the host truncates overshoot;
+    bounded waste, never a per-token readback) and their lengths clamp at
+    the cache edge — masking beats dynamic batch shapes on TPU.
+    """
+    max_len = cache.max_len
+
+    def one(carry, i):
+        k_all, v_all, lengths, tok = carry
+        logits, k_all, v_all = _forward_slots(
+            params, tok[:, None], k_all, v_all, lengths, cfg, is_prefill=False
+        )
+        keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
+        nxt = _sample_batched(logits[:, -1], temps, keys, top_k, top_p)
+        nxt = jnp.where(active, nxt, tok)
+        # Clamp: a slot decoding past its budget inside a chunk (host
+        # truncates after) must not index past the cache edge.
+        lengths = jnp.minimum(
+            lengths + active.astype(jnp.int32), max_len - 1
+        )
+        return (k_all, v_all, lengths, nxt), nxt
+
+    (k_all, v_all, lengths, _), out = jax.lax.scan(
+        one, (cache.k, cache.v, cache.lengths, tokens), jnp.arange(chunk)
+    )
+    return SlotCache(k_all, v_all, lengths), out.T
+
+
+@dataclass
+class GenRequest:
+    """One generation request.  ``tokens`` are prompt token ids (the
+    engine is tokenizer-agnostic, like the reference control plane is
+    filesystem-agnostic); sampling params are per-request except
+    top-k/top-p, which are engine-static (jit-friendly masks)."""
+
+    tokens: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+    eos_id: int | None = None
+
+
+@dataclass
+class _SlotState:
+    rid: int
+    req: GenRequest
+    base: jax.Array  # per-request PRNG base key (PRNGKey(req.seed))
+    emitted: list[int] = field(default_factory=list)
+    last_token: int = 0
+
+
+class Engine:
+    """Continuous-batching engine: submit → step/run → result.
+
+    Thread-safe for one driver thread calling ``step``/``run`` while any
+    number of threads call ``submit``/``result`` (the HTTP server's
+    usage).  Every decode dispatch runs exactly ``chunk`` steps — a slot
+    whose budget or EOS lands mid-chunk keeps computing and the host
+    truncates the overshoot (bounded waste; a shrinking chunk would
+    instead cost one ~70 ms readback per token for the *whole batch*
+    whenever any request nears completion).  Compile count: one decode
+    program + one admit per prompt bucket.
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg: TransformerConfig,
+        *,
+        n_slots: int = 4,
+        max_len: int = 1024,
+        chunk: int = 8,
+        prompt_buckets: tuple[int, ...] | None = None,
+        top_k: int = 0,
+        top_p: float = 1.0,
+    ):
+        if n_slots < 1 or max_len < 2 or chunk < 1:
+            raise ValueError(
+                f"need n_slots>=1, max_len>=2, chunk>=1; got "
+                f"{n_slots}, {max_len}, {chunk}"
+            )
+        self.params = params
+        self.cfg = cfg
+        self.chunk = chunk
+        if prompt_buckets is None:
+            prompt_buckets, b = [], 16
+            while b < max_len:
+                prompt_buckets.append(b)
+                b *= 2
+            prompt_buckets.append(max_len - 1)
+        self.prompt_buckets = tuple(sorted(set(prompt_buckets)))
+        bad_buckets = [
+            b for b in self.prompt_buckets if not 1 <= b <= max_len - 1
+        ]
+        if bad_buckets:
+            # Fail at construction, not as an XLA shape error inside the
+            # first admit (which would kill a server's driver thread).
+            raise ValueError(
+                f"prompt_buckets must fit 1..max_len-1={max_len - 1} "
+                f"(each admitted prompt needs >=1 generated token): "
+                f"{bad_buckets}"
+            )
+        self._cache = SlotCache.create(cfg, n_slots, max_len)
+        self._admit = jax.jit(
+            partial(_admit, cfg=cfg, top_k=top_k, top_p=top_p),
+            donate_argnums=(1,),
+        )
+        self._decode = jax.jit(
+            partial(_decode_chunk, cfg=cfg, chunk=chunk, top_k=top_k,
+                    top_p=top_p),
+            donate_argnums=(1,),
+        )
+        self._lock = threading.Lock()
+        self._queue: list[tuple[int, GenRequest]] = []
+        self._slots: dict[int, _SlotState] = {}  # slot index → state
+        self._free = list(range(n_slots))
+        self._results: dict[int, list[int]] = {}
+        self._events: dict[int, threading.Event] = {}
+        self._errors: dict[int, str] = {}
+        self._forgotten: set[int] = set()
+        self._next_rid = 0
+        self._step_count = 0
+        self.tokens_generated = 0
+
+    # -- submission / results (any thread) --------------------------------
+
+    def submit(self, req: GenRequest) -> int:
+        max_len = self._cache.max_len
+        if not req.tokens:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(req.tokens) > self.prompt_buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(req.tokens)} exceeds largest bucket "
+                f"{self.prompt_buckets[-1]}"
+            )
+        if len(req.tokens) + req.max_new_tokens > max_len:
+            raise ValueError(
+                f"prompt {len(req.tokens)} + max_new_tokens "
+                f"{req.max_new_tokens} exceeds max_len {max_len}"
+            )
+        bad = [t for t in req.tokens if not 0 <= t < self.cfg.vocab_size]
+        if bad:
+            # Without this, the embedding gather clamps silently and the
+            # client gets plausible-looking output for a garbage prompt.
+            raise ValueError(
+                f"token ids out of range [0, {self.cfg.vocab_size}): "
+                f"{bad[:5]}"
+            )
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            self._queue.append((rid, req))
+            self._events[rid] = threading.Event()
+        return rid
+
+    def result(self, rid: int, timeout: float | None = None) -> list[int]:
+        """Block until request ``rid`` completes; returns generated tokens
+        (prompt not included, truncated at EOS if one was set).
+
+        Fetching a result *consumes* it — a daemon engine must not retain
+        every historical request forever.  A second fetch raises KeyError.
+        ``run()`` returns (but does not consume) unfetched results.
+        Raises RuntimeError for a request failed by ``abort()``."""
+        try:
+            event = self._events[rid]
+        except KeyError:
+            raise KeyError(f"request {rid} unknown or already fetched")
+        if not event.wait(timeout):
+            raise TimeoutError(f"request {rid} not done")
+        with self._lock:
+            del self._events[rid]
+            if rid in self._errors:
+                raise RuntimeError(
+                    f"request {rid} aborted: {self._errors.pop(rid)}"
+                )
+            return self._results.pop(rid)
+
+    def forget(self, rid: int) -> None:
+        """Drop a request's future result (caller gave up, e.g. an HTTP
+        timeout): frees the stored tokens now or, if still in flight,
+        the moment it completes — nothing is retained either way."""
+        with self._lock:
+            if rid in self._results or rid in self._errors:
+                self._events.pop(rid, None)
+                self._results.pop(rid, None)
+                self._errors.pop(rid, None)
+            elif rid in self._events:
+                self._forgotten.add(rid)
+
+    def abort(self, message: str) -> None:
+        """Fail every queued and in-flight request (the server's driver
+        thread calls this when ``step`` raises, so blocked ``result()``
+        callers get a RuntimeError instead of waiting out their timeout)."""
+        with self._lock:
+            pending = [rid for rid, _ in self._queue]
+            pending += [s.rid for s in self._slots.values()]
+            self._queue.clear()
+            self._free += sorted(self._slots)
+            self._slots.clear()
+            for rid in pending:
+                if rid in self._forgotten:
+                    self._forgotten.discard(rid)
+                    self._events.pop(rid, None)
+                    continue
+                self._errors[rid] = message
+                if rid in self._events:
+                    self._events[rid].set()
+
+    # -- engine loop (one driver thread) ----------------------------------
+
+    def pending(self) -> bool:
+        with self._lock:
+            return bool(self._queue or self._slots)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "active_slots": len(self._slots),
+                "free_slots": len(self._free),
+                "queued": len(self._queue),
+                "steps": self._step_count,
+                "tokens_generated": self.tokens_generated,
+            }
+
+    def _bucket(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if n <= b:
+                return b
+        raise AssertionError("submit() bounds prompt length")
+
+    def _finish(self, slot: int, state: _SlotState) -> None:
+        # pop with default: a request finishing on its very first (admit)
+        # token was never registered in _slots.
+        self._slots.pop(slot, None)
+        self._free.append(slot)
+        if state.rid in self._forgotten:  # caller gave up; retain nothing
+            self._forgotten.discard(state.rid)
+            self._events.pop(state.rid, None)
+            return
+        self._results[state.rid] = state.emitted
+        self._events[state.rid].set()
+
+    def _emit(self, state: _SlotState, token: int) -> bool:
+        """Record one generated token; True when the request is done."""
+        if state.req.eos_id is not None and token == state.req.eos_id:
+            state.emitted.append(token)
+            return True
+        state.emitted.append(token)
+        state.last_token = token
+        return len(state.emitted) >= state.req.max_new_tokens
+
+    def step(self) -> None:
+        """Admit whatever fits, then decode one chunk for active slots."""
+        with self._lock:
+            admissions = []
+            while self._queue and self._free:
+                rid, req = self._queue.pop(0)
+                admissions.append((self._free.pop(0), rid, req))
+        for slot, rid, req in admissions:
+            bucket = self._bucket(len(req.tokens))
+            prompt = jnp.asarray(
+                req.tokens + [0] * (bucket - len(req.tokens)), jnp.int32
+            )
+            key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
+            self._cache, first = self._admit(
+                self.params,
+                self._cache,
+                prompt,
+                jnp.int32(slot),
+                jnp.int32(len(req.tokens)),
+                jnp.float32(req.temperature),
+                key,
+            )
+            state = _SlotState(
+                rid=rid, req=req, base=jax.random.PRNGKey(req.seed)
+            )
+            token = int(first)
+            self.tokens_generated += 1
+            with self._lock:
+                if self._emit(state, token):
+                    self._finish(slot, state)
+                else:
+                    self._slots[slot] = state
+
+        with self._lock:
+            if not self._slots:
+                return
+            slots = dict(self._slots)
+            n_slots = self._cache.n_slots
+
+        tokens = jnp.asarray(
+            [
+                slots[i].last_token if i in slots else 0
+                for i in range(n_slots)
+            ],
+            jnp.int32,
+        )
+        temps = jnp.asarray(
+            [
+                slots[i].req.temperature if i in slots else 0.0
+                for i in range(n_slots)
+            ],
+            jnp.float32,
+        )
+        active = jnp.asarray(
+            [i in slots for i in range(n_slots)], bool
+        )
+        zero_key = jax.random.PRNGKey(0)
+        bases = jnp.stack(
+            [slots[i].base if i in slots else zero_key for i in range(n_slots)]
+        )
+        counts = jnp.asarray(
+            [len(slots[i].emitted) if i in slots else 0 for i in range(n_slots)],
+            jnp.int32,
+        )
+        self._cache, out = self._decode(
+            self.params, self._cache, tokens, temps, active, bases, counts
+        )
+        out = jax.device_get(out)  # ONE readback per chunk
+        self._step_count += 1
+        with self._lock:
+            for slot, state in list(slots.items()):
+                done = False
+                for token in out[slot]:
+                    self.tokens_generated += 1
+                    if self._emit(state, int(token)):
+                        done = True
+                        break
+                if done and slot in self._slots:
+                    self._finish(slot, state)
+
+    def run(self) -> dict[int, list[int]]:
+        """Drain the queue and all active slots; returns {rid: tokens}."""
+        while self.pending():
+            self.step()
+        with self._lock:
+            return {rid: list(toks) for rid, toks in self._results.items()}
+
+    def warmup(self) -> "Engine":
+        """Pre-compile every admit bucket and the whole chunk ladder.
+
+        One dummy request per prompt bucket, sized so the chunk walks
+        down the full power-of-two ladder as requests drain.  Serving
+        deployments warm before going live: a TPU compile is 20-40 s and
+        must never land on live traffic (the control-plane analog is the
+        registry pre-dialing controllers it proxies for)."""
+        max_len = self._cache.max_len
+        rids = []
+        for b in self.prompt_buckets:
+            headroom = max_len - b
+            if headroom < 1:
+                continue
+            rids.append(self.submit(GenRequest(
+                tokens=[0] * b,
+                max_new_tokens=min(2 * self.chunk, headroom),
+            )))
+        self.run()
+        for rid in rids:  # consume the dummies; warmup must not retain
+            self.result(rid, timeout=0)
+        return self

@@ -1,0 +1,138 @@
+"""HTTP front end for the serving engine.
+
+Dependency-free (stdlib ``http.server``, same stance as
+``common/metrics.py``'s exposition server): POST /v1/generate with a JSON
+body, blocking until the generation completes; the engine loop runs in a
+background driver thread so concurrent requests batch onto slots.
+
+API:
+  POST /v1/generate   {"tokens": [int...], "max_new_tokens": N,
+                       "temperature": 0.0, "seed": 0, "eos_id": null}
+                    → {"tokens": [int...]}   (generated only, EOS included)
+  GET  /healthz      → {"ok": true}
+  GET  /v1/stats     → engine stats (slots, queue depth, tokens generated)
+
+The engine is tokenizer-agnostic by design — clients speak token ids, the
+same boundary the CSI driver keeps by speaking device paths rather than
+framework objects.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from oim_tpu.serve.engine import Engine, GenRequest
+
+
+class ServeServer:
+    """Owns the engine driver thread and the HTTP listener.
+
+    ``start()`` returns self; ``port`` is the bound port (0 → ephemeral,
+    the ``NonBlockingGRPCServer.addr()`` discovery pattern).
+    """
+
+    def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 0):
+        self.engine = engine
+        self.error: str | None = None  # set when the driver thread dies
+        self._stop = threading.Event()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # stderr noise → engine stats
+                pass
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    if outer.error is not None:
+                        # A dead driver thread must flip health, or the
+                        # orchestrator never restarts a wedged server.
+                        self._json(503, {"ok": False, "error": outer.error})
+                    else:
+                        self._json(200, {"ok": True})
+                elif self.path == "/v1/stats":
+                    self._json(200, outer.engine.stats())
+                else:
+                    self._json(404, {"error": f"no such path {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/v1/generate":
+                    self._json(404, {"error": f"no such path {self.path}"})
+                    return
+                if outer.error is not None:
+                    # No driver thread left to serve it; fail fast.
+                    self._json(503, {"error": outer.error})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    req = GenRequest(
+                        tokens=[int(t) for t in body["tokens"]],
+                        max_new_tokens=int(body.get("max_new_tokens", 16)),
+                        temperature=float(body.get("temperature", 0.0)),
+                        seed=int(body.get("seed", 0)),
+                        eos_id=(
+                            int(body["eos_id"])
+                            if body.get("eos_id") is not None
+                            else None
+                        ),
+                    )
+                    rid = outer.engine.submit(req)
+                except (KeyError, TypeError, ValueError) as exc:
+                    self._json(400, {"error": str(exc)})
+                    return
+                try:
+                    tokens = outer.engine.result(rid, timeout=600)
+                except TimeoutError:
+                    # Clean 503 instead of a dropped socket; forget() frees
+                    # the result whenever it does complete — a flaky client
+                    # must not grow the daemon's memory.
+                    outer.engine.forget(rid)
+                    self._json(503, {"error": f"request {rid} timed out"})
+                    return
+                except RuntimeError as exc:  # aborted: driver thread died
+                    self._json(500, {"error": str(exc)})
+                    return
+                self._json(200, {"tokens": tokens, "request_id": rid})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._driver_thread = threading.Thread(target=self._drive, daemon=True)
+
+    def _drive(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.engine.pending():
+                    self.engine.step()
+                else:
+                    time.sleep(0.005)
+            except Exception as exc:  # driver death = service death
+                self.error = f"{type(exc).__name__}: {exc}"
+                # Fail everything in flight so blocked result() callers
+                # get an immediate error, not a 600 s timeout.
+                self.engine.abort(self.error)
+                return
+
+    def start(self) -> "ServeServer":
+        self._http_thread.start()
+        self._driver_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._driver_thread.join(timeout=10)
