@@ -51,6 +51,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument(
+        "--kv-int8", action="store_true",
+        help="int8-quantized KV cache (half the cache bandwidth decode "
+        "pays; per-token/head scales)",
+    )
+    p.add_argument(
         "--bootstrap", default="",
         help="tpu-bootstrap.json path (default: $TPU_BOOTSTRAP when set)",
     )
@@ -104,6 +109,7 @@ def make_engine(args):
         chunk=args.chunk,
         top_k=args.top_k,
         top_p=args.top_p,
+        kv_int8=args.kv_int8,
     )
 
 

@@ -38,6 +38,11 @@ from oim_tpu.models.transformer import (
     _switch_moe,
     _unembed,
 )
+from oim_tpu.ops.quant import (
+    dequantize_int8,
+    make_kv_buffers,
+    quantize_int8,
+)
 from oim_tpu.ops.rope import apply_rope
 
 _NEG_BIG = -1e30
@@ -48,22 +53,31 @@ _NEG_BIG = -1e30
 class KVCache:
     """Per-layer key/value cache: ``k``, ``v`` are
     ``[n_layers, batch, max_len, heads, head_dim]``; ``length`` is the
-    number of valid positions (scalar int32, same on every layer)."""
+    number of valid positions (scalar int32, same on every layer).
+
+    With ``quantized=True`` the k/v values are int8 with per-(token,
+    head) f32 scales ``k_scale``/``v_scale`` [n_layers, batch, max_len,
+    heads] (``ops/quant.py``) — half the cache bytes, which is the
+    decode bottleneck; scales are None in the full-precision cache."""
 
     k: jax.Array
     v: jax.Array
     length: jax.Array
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
 
     @classmethod
     def create(
-        cls, cfg: TransformerConfig, batch: int, max_len: int
+        cls,
+        cfg: TransformerConfig,
+        batch: int,
+        max_len: int,
+        quantized: bool = False,
     ) -> "KVCache":
         shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
-        dt = cfg.compute_dtype
+        k, v, ks, vs = make_kv_buffers(shape, cfg.compute_dtype, quantized)
         return cls(
-            k=jnp.zeros(shape, dt),
-            v=jnp.zeros(shape, dt),
-            length=jnp.zeros((), jnp.int32),
+            k=k, v=v, length=jnp.zeros((), jnp.int32), k_scale=ks, v_scale=vs
         )
 
     @property
@@ -84,12 +98,39 @@ def _flat_layer_params(params: dict, cfg: TransformerConfig) -> dict:
     return out
 
 
-def _cached_attention(x, lp, k_cache, v_cache, start, cfg: TransformerConfig):
+def _store_kv(cache, scale, new, start):
+    """Write ``new`` [B, t, KVH, hd] into the cache at position ``start``
+    — quantizing when the cache is int8 (scale is not None)."""
+    if scale is None:
+        cache = jax.lax.dynamic_update_slice(
+            cache, new.astype(cache.dtype), (0, start, 0, 0)
+        )
+        return cache, None
+    q, s = quantize_int8(new)
+    cache = jax.lax.dynamic_update_slice(cache, q, (0, start, 0, 0))
+    scale = jax.lax.dynamic_update_slice(scale, s, (0, start, 0))
+    return cache, scale
+
+
+def _load_kv(cache, scale):
+    """Cache rows as f32 — dequantizing when int8.  XLA fuses the
+    convert+multiply into the consuming matmul's operand read, so the
+    HBM traffic is the int8 bytes (the point)."""
+    if scale is None:
+        return cache.astype(jnp.float32)
+    return dequantize_int8(cache, scale)
+
+
+def _cached_attention(
+    x, lp, k_cache, v_cache, k_scale, v_scale, start, cfg: TransformerConfig
+):
     """Attend x's tokens (global positions start..start+t) against the
-    cache prefix plus themselves; returns (x_out, new_k_cache, new_v_cache).
+    cache prefix plus themselves; returns
+    (x_out, (k_cache, v_cache, k_scale, v_scale)).
 
     x: [B, t, D]; k_cache/v_cache: [B, max_len, KVH, hd] (kv heads — GQA
-    keeps the cache kv-sized); start: scalar.
+    keeps the cache kv-sized); scales [B, max_len, KVH] or None
+    (int8 vs full-precision cache); start: scalar.
     """
     b, t, _ = x.shape
     h, hd, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
@@ -104,12 +145,8 @@ def _cached_attention(x, lp, k_cache, v_cache, start, cfg: TransformerConfig):
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
 
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
-    )
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
-    )
+    k_cache, k_scale = _store_kv(k_cache, k_scale, k, start)
+    v_cache, v_scale = _store_kv(v_cache, v_scale, v, start)
 
     # GQA: group query heads per kv head; the cache stays kv-sized (the
     # whole point — decode is cache-bandwidth-bound).
@@ -117,7 +154,7 @@ def _cached_attention(x, lp, k_cache, v_cache, start, cfg: TransformerConfig):
     scores = jnp.einsum(
         "bqhgd,bkhd->bhgqk",
         q_g.astype(jnp.float32),
-        k_cache.astype(jnp.float32),
+        _load_kv(k_cache, k_scale),
     ) / (hd**0.5)
     # Causal over global positions; cache slots past start+t are invalid.
     q_pos = start + jnp.arange(t)[:, None]
@@ -125,12 +162,14 @@ def _cached_attention(x, lp, k_cache, v_cache, start, cfg: TransformerConfig):
     scores = jnp.where(k_pos <= q_pos, scores, _NEG_BIG)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
-        "bhgqk,bkhd->bqhgd", probs, v_cache.astype(jnp.float32)
+        "bhgqk,bkhd->bqhgd", probs, _load_kv(v_cache, v_scale)
     ).astype(x.dtype)
     out = out.reshape(b, t, h * hd)
     return x + jnp.einsum("btn,nd->btd", out, lp["wo"]).astype(x.dtype), (
         k_cache,
         v_cache,
+        k_scale,
+        v_scale,
     )
 
 
@@ -190,9 +229,9 @@ def _forward_cached(
     flat = _flat_layer_params(params, cfg)
 
     def layer_step(x, scanned):
-        lp, k_cache, v_cache = scanned
-        x, (k_cache, v_cache) = _cached_attention(
-            x, lp, k_cache, v_cache, start, cfg
+        lp, k_cache, v_cache, k_scale, v_scale = scanned
+        x, (k_cache, v_cache, k_scale, v_scale) = _cached_attention(
+            x, lp, k_cache, v_cache, k_scale, v_scale, start, cfg
         )
         if cfg.n_experts:
             if is_prefill:  # train-path capacity routing, MXU dispatch
@@ -201,12 +240,19 @@ def _forward_cached(
                 x = _moe_exact(x, lp, cfg)
         else:
             x, _ = _dense_mlp(x, lp, cfg)
-        return x, (k_cache, v_cache)
+        return x, (k_cache, v_cache, k_scale, v_scale)
 
-    x, (new_k, new_v) = jax.lax.scan(layer_step, x, (flat, cache.k, cache.v))
+    # None scales (full-precision cache) are empty pytrees: lax.scan
+    # carries them through untouched.
+    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+        layer_step, x, (flat, cache.k, cache.v, cache.k_scale, cache.v_scale)
+    )
     x = _rmsnorm(x, params["final_norm"], cfg)
     logits = _unembed(x, params["wlm"], cfg)
-    new_cache = KVCache(k=new_k, v=new_v, length=start + tokens.shape[1])
+    new_cache = KVCache(
+        k=new_k, v=new_v, length=start + tokens.shape[1],
+        k_scale=new_ks, v_scale=new_vs,
+    )
     return logits, new_cache
 
 
@@ -215,17 +261,19 @@ def prefill(
     tokens: jax.Array,
     cfg: TransformerConfig,
     max_len: int,
+    kv_int8: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """Process the whole prompt in one pass.
 
     tokens: [batch, prompt_len] (all positions valid).  Returns the
     full-prompt logits ``[batch, prompt_len, vocab]`` and a cache of
-    capacity ``max_len`` holding the prompt's K/V.
+    capacity ``max_len`` holding the prompt's K/V (int8-quantized per
+    token/head when ``kv_int8`` — half the cache bandwidth decode pays).
     """
     b, t = tokens.shape
     if t > max_len:
         raise ValueError(f"prompt length {t} exceeds max_len {max_len}")
-    cache = KVCache.create(cfg, b, max_len)
+    cache = KVCache.create(cfg, b, max_len, quantized=kv_int8)
     return _forward_cached(params, tokens, cache, cfg, is_prefill=True)
 
 
@@ -289,6 +337,7 @@ def generate(
     key: jax.Array | None = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    kv_int8: bool = False,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -305,7 +354,7 @@ def generate(
             "default would make every call return identical samples"
         )
     max_len = t + max_new_tokens
-    logits, cache = prefill(params, prompt, cfg, max_len)
+    logits, cache = prefill(params, prompt, cfg, max_len, kv_int8=kv_int8)
     if key is None:
         key = jax.random.PRNGKey(0)  # greedy path: key is never consumed
     first_key, key = jax.random.split(key)  # never reuse a consumed key
@@ -333,5 +382,7 @@ def make_generate_fn(cfg: TransformerConfig):
     and GSPMD propagates head/tensor sharding from the param shardings."""
     return jax.jit(
         partial(generate, cfg=cfg),
-        static_argnames=("max_new_tokens", "temperature", "top_k", "top_p"),
+        static_argnames=(
+            "max_new_tokens", "temperature", "top_k", "top_p", "kv_int8",
+        ),
     )

@@ -1,0 +1,60 @@
+"""Int8 quantization for the KV cache (plain JAX — XLA fuses the
+dequantizing convert+multiply into the attention matmul's operand read).
+
+Decode is cache-bandwidth-bound (doc/compute.md), so shrinking cache
+bytes is a direct throughput lever, multiplicative with GQA's kv-head
+reduction.  Scheme: symmetric per-(token, head) max-abs scaling — one
+f32 scale (4 bytes) per stored [head_dim] int8 vector, so at head_dim
+64 the cache is 68 bytes per vector vs 128 for bf16 (0.53×; the scale
+is a 1/16 byte overhead on the int8 payload).  New work for the TPU
+build (the reference is a storage control plane; SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Symmetric int8 range; -128 is unused so the scale inverts exactly.
+_INT8_MAX = 127.0
+_EPS = 1e-8
+
+
+def quantize_int8(x):
+    """[..., d] float → (int8 values [..., d], f32 scales [...]).
+
+    Per-vector symmetric max-abs: scale = amax/127, q = round(x/scale).
+    A zero vector quantizes to zeros with a tiny scale (no NaN).
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax / _INT8_MAX, _EPS)
+    q = jnp.round(xf / scale[..., None]).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q, scale):
+    """Inverse of ``quantize_int8``: int8 [..., d] × f32 scales [...] →
+    f32 [..., d]."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def make_kv_buffers(shape, compute_dtype, quantized: bool):
+    """Zeroed (k, v, k_scale, v_scale) cache buffers for ``shape``
+    [..., max_len, kv_heads, head_dim] — THE one definition of the
+    quantized-cache layout, shared by the solo decode cache and the
+    serving slot cache so the two can never diverge.
+
+    Scales are distinct arrays (aliasing one buffer into both fields
+    breaks jit donation: "donate the same buffer twice") and None when
+    not quantized (an empty pytree — scan/tree.map pass it through).
+    """
+    dt = jnp.int8 if quantized else compute_dtype
+    mk_scale = lambda: (  # noqa: E731
+        jnp.ones(shape[:-1], jnp.float32) if quantized else None
+    )
+    return (
+        jnp.zeros(shape, dt),
+        jnp.zeros(shape, dt),
+        mk_scale(),
+        mk_scale(),
+    )

@@ -52,9 +52,11 @@ import jax.numpy as jnp
 from oim_tpu.models.decode import (
     _dense_mlp,
     _flat_layer_params,
+    _load_kv,
     _moe_exact,
     truncate_logits,
 )
+from oim_tpu.ops.quant import make_kv_buffers, quantize_int8
 from oim_tpu.models.transformer import (
     TransformerConfig,
     _rmsnorm,
@@ -74,21 +76,30 @@ class SlotCache:
     ``k``/``v``: [n_layers, n_slots, max_len, kv_heads, head_dim];
     ``lengths``: [n_slots] int32 — valid positions per slot (the engine's
     "page table": a slot attends to rows < its own length only).
+    ``k_scale``/``v_scale``: per-(token, head) f32 scales
+    [n_layers, n_slots, max_len, kv_heads] when the cache is int8
+    (``ops/quant.py`` — half the cache bandwidth decode pays), else None.
     """
 
     k: jax.Array
     v: jax.Array
     lengths: jax.Array
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
 
     @classmethod
     def create(
-        cls, cfg: TransformerConfig, n_slots: int, max_len: int
+        cls,
+        cfg: TransformerConfig,
+        n_slots: int,
+        max_len: int,
+        quantized: bool = False,
     ) -> "SlotCache":
         shape = (cfg.n_layers, n_slots, max_len, cfg.kv_heads, cfg.head_dim)
+        k, v, ks, vs = make_kv_buffers(shape, cfg.compute_dtype, quantized)
         return cls(
-            k=jnp.zeros(shape, cfg.compute_dtype),
-            v=jnp.zeros(shape, cfg.compute_dtype),
-            lengths=jnp.zeros((n_slots,), jnp.int32),
+            k=k, v=v, lengths=jnp.zeros((n_slots,), jnp.int32),
+            k_scale=ks, v_scale=vs,
         )
 
     @property
@@ -100,12 +111,32 @@ class SlotCache:
         return self.k.shape[2]
 
 
-def _slot_attention(x, lp, k_cache, v_cache, starts, cfg: TransformerConfig):
+def _slot_store(cache, scale, new, starts):
+    """Per-slot write of ``new`` [B, t, KVH, hd] at ``starts`` [B] —
+    quantizing when the cache is int8 (scale is not None)."""
+    write = lambda c, u, s: jax.lax.dynamic_update_slice(  # noqa: E731
+        c, u, (s, 0, 0)
+    )
+    if scale is None:
+        return jax.vmap(write)(cache, new.astype(cache.dtype), starts), None
+    q, s = quantize_int8(new)
+    cache = jax.vmap(write)(cache, q, starts)
+    scale = jax.vmap(
+        lambda c, u, st: jax.lax.dynamic_update_slice(c, u, (st, 0))
+    )(scale, s, starts)
+    return cache, scale
+
+
+def _slot_attention(
+    x, lp, k_cache, v_cache, k_scale, v_scale, starts,
+    cfg: TransformerConfig,
+):
     """Cached attention with per-slot start positions.
 
-    x: [B, t, D]; k_cache/v_cache: [B, max_len, KVH, hd]; starts: [B].
-    Generalizes ``decode._cached_attention`` (scalar start) to a vector —
-    the one primitive continuous batching needs.
+    x: [B, t, D]; k_cache/v_cache: [B, max_len, KVH, hd]; scales
+    [B, max_len, KVH] (int8 cache) or None; starts: [B].  Generalizes
+    ``decode._cached_attention`` (scalar start) to a vector — the one
+    primitive continuous batching needs.
     """
     b, t, _ = x.shape
     h, hd, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
@@ -120,17 +151,14 @@ def _slot_attention(x, lp, k_cache, v_cache, starts, cfg: TransformerConfig):
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
 
-    write = lambda c, u, s: jax.lax.dynamic_update_slice(  # noqa: E731
-        c, u, (s, 0, 0)
-    )
-    k_cache = jax.vmap(write)(k_cache, k.astype(k_cache.dtype), starts)
-    v_cache = jax.vmap(write)(v_cache, v.astype(v_cache.dtype), starts)
+    k_cache, k_scale = _slot_store(k_cache, k_scale, k, starts)
+    v_cache, v_scale = _slot_store(v_cache, v_scale, v, starts)
 
     q_g = q.reshape(b, t, kvh, group, hd)
     scores = jnp.einsum(
         "bqhgd,bkhd->bhgqk",
         q_g.astype(jnp.float32),
-        k_cache.astype(jnp.float32),
+        _load_kv(k_cache, k_scale),
     ) / (hd**0.5)
     # Causal per slot: query at global position p attends to rows <= p of
     # its own region; rows past the slot's frontier are invalid.
@@ -139,30 +167,34 @@ def _slot_attention(x, lp, k_cache, v_cache, starts, cfg: TransformerConfig):
     scores = jnp.where(k_pos <= q_pos, scores, _NEG_BIG)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
-        "bhgqk,bkhd->bqhgd", probs, v_cache.astype(jnp.float32)
+        "bhgqk,bkhd->bqhgd", probs, _load_kv(v_cache, v_scale)
     ).astype(x.dtype)
     out = out.reshape(b, t, h * hd)
     return x + jnp.einsum("btn,nd->btd", out, lp["wo"]).astype(x.dtype), (
         k_cache,
         v_cache,
+        k_scale,
+        v_scale,
     )
 
 
-def _forward_slots(params, tokens, k_all, v_all, starts, cfg, is_prefill):
-    """tokens [B, t] at per-slot positions ``starts`` → (logits, k, v).
+def _forward_slots(params, tokens, kv, starts, cfg, is_prefill):
+    """tokens [B, t] at per-slot positions ``starts`` → (logits, kv).
 
-    k_all/v_all: [n_layers, B, max_len, KVH, hd].  MoE routing follows
-    ``models/decode.py``: capacity routing on prefill (exact agreement
-    with the training forward), drop-free argmax on incremental steps.
+    ``kv`` = (k, v, k_scale, v_scale): [n_layers, B, max_len, KVH, hd]
+    values with per-(token, head) scales (or None when full-precision).
+    MoE routing follows ``models/decode.py``: capacity routing on prefill
+    (exact agreement with the training forward), drop-free argmax on
+    incremental steps.
     """
     cfg = replace(cfg, use_pallas=False)
     x = params["wte"].astype(cfg.compute_dtype)[tokens]
     flat = _flat_layer_params(params, cfg)
 
     def layer_step(x, scanned):
-        lp, k_cache, v_cache = scanned
-        x, (k_cache, v_cache) = _slot_attention(
-            x, lp, k_cache, v_cache, starts, cfg
+        lp, k_cache, v_cache, k_scale, v_scale = scanned
+        x, (k_cache, v_cache, k_scale, v_scale) = _slot_attention(
+            x, lp, k_cache, v_cache, k_scale, v_scale, starts, cfg
         )
         if cfg.n_experts:
             if is_prefill:
@@ -171,11 +203,12 @@ def _forward_slots(params, tokens, k_all, v_all, starts, cfg, is_prefill):
                 x = _moe_exact(x, lp, cfg)
         else:
             x, _ = _dense_mlp(x, lp, cfg)
-        return x, (k_cache, v_cache)
+        return x, (k_cache, v_cache, k_scale, v_scale)
 
-    x, (k_all, v_all) = jax.lax.scan(layer_step, x, (flat, k_all, v_all))
+    # None scales are empty pytrees: lax.scan carries them untouched.
+    x, kv = jax.lax.scan(layer_step, x, (flat, *kv))
     x = _rmsnorm(x, params["final_norm"], cfg)
-    return _unembed(x, params["wlm"], cfg), k_all, v_all
+    return _unembed(x, params["wlm"], cfg), kv
 
 
 def _sample_batched(logits, temps, keys, top_k, top_p):
@@ -204,14 +237,18 @@ def _admit(
     slot's length stops at ``true_len`` and decode overwrites them one by
     one, so padding never reaches attention.
     """
-    k_slot = jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
-    v_slot = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
-    starts = jnp.zeros((1,), jnp.int32)
-    logits, k_slot, v_slot = _forward_slots(
-        params, prompt[None], k_slot, v_slot, starts, cfg, is_prefill=True
+    kv_full = (cache.k, cache.v, cache.k_scale, cache.v_scale)
+    kv_slot = jax.tree.map(
+        lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), kv_full
     )
-    k_all = jax.lax.dynamic_update_slice_in_dim(cache.k, k_slot, slot, axis=1)
-    v_all = jax.lax.dynamic_update_slice_in_dim(cache.v, v_slot, slot, axis=1)
+    starts = jnp.zeros((1,), jnp.int32)
+    logits, kv_slot = _forward_slots(
+        params, prompt[None], kv_slot, starts, cfg, is_prefill=True
+    )
+    k_all, v_all, ks_all, vs_all = jax.tree.map(
+        lambda c, u: jax.lax.dynamic_update_slice_in_dim(c, u, slot, axis=1),
+        kv_full, kv_slot,
+    )
     lengths = jax.lax.dynamic_update_slice(
         cache.lengths, true_len[None], (slot,)
     )
@@ -219,7 +256,7 @@ def _admit(
         logits[0], true_len - 1, axis=0, keepdims=False
     )
     first = _sample_batched(last[None], temp[None], key[None], top_k, top_p)[0]
-    return SlotCache(k_all, v_all, lengths), first
+    return SlotCache(k_all, v_all, lengths, ks_all, vs_all), first
 
 
 def _decode_chunk(
@@ -242,9 +279,9 @@ def _decode_chunk(
     max_len = cache.max_len
 
     def one(carry, i):
-        k_all, v_all, lengths, tok = carry
-        logits, k_all, v_all = _forward_slots(
-            params, tok[:, None], k_all, v_all, lengths, cfg, is_prefill=False
+        kv, lengths, tok = carry
+        logits, kv = _forward_slots(
+            params, tok[:, None], kv, lengths, cfg, is_prefill=False
         )
         keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
         nxt = _sample_batched(logits[:, -1], temps, keys, top_k, top_p)
@@ -254,12 +291,13 @@ def _decode_chunk(
         lengths = jnp.minimum(
             lengths + active.astype(jnp.int32), max_len - 1
         )
-        return (k_all, v_all, lengths, nxt), nxt
+        return (kv, lengths, nxt), nxt
 
-    (k_all, v_all, lengths, _), out = jax.lax.scan(
-        one, (cache.k, cache.v, cache.lengths, tokens), jnp.arange(chunk)
+    kv0 = (cache.k, cache.v, cache.k_scale, cache.v_scale)
+    ((k_all, v_all, ks_all, vs_all), lengths, _), out = jax.lax.scan(
+        one, (kv0, cache.lengths, tokens), jnp.arange(chunk)
     )
-    return SlotCache(k_all, v_all, lengths), out.T
+    return SlotCache(k_all, v_all, lengths, ks_all, vs_all), out.T
 
 
 @dataclass
@@ -309,6 +347,7 @@ class Engine:
         prompt_buckets: tuple[int, ...] | None = None,
         top_k: int = 0,
         top_p: float = 1.0,
+        kv_int8: bool = False,
     ):
         if n_slots < 1 or max_len < 2 or chunk < 1:
             raise ValueError(
@@ -336,7 +375,9 @@ class Engine:
                 f"(each admitted prompt needs >=1 generated token): "
                 f"{bad_buckets}"
             )
-        self._cache = SlotCache.create(cfg, n_slots, max_len)
+        self._cache = SlotCache.create(
+            cfg, n_slots, max_len, quantized=kv_int8
+        )
         self._admit = jax.jit(
             partial(_admit, cfg=cfg, top_k=top_k, top_p=top_p),
             donate_argnums=(1,),

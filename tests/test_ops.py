@@ -236,3 +236,39 @@ class TestFlashAttentionGQA:
         q, k, v, _ = self._qkv(h=6, kvh=4)
         with pytest.raises(ValueError):
             flash_attention(q, k, v, True, 128, 128)
+
+
+class TestInt8Quant:
+    """ops/quant.py: per-vector symmetric int8 for the KV cache."""
+
+    def test_roundtrip_error_bounded(self):
+        import jax
+        import jax.numpy as jnp
+
+        from oim_tpu.ops.quant import dequantize_int8, quantize_int8
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 64))
+        q, scale = quantize_int8(x)
+        assert q.dtype == jnp.int8
+        assert scale.shape == (4, 16)
+        err = jnp.abs(dequantize_int8(q, scale) - x)
+        # Rounding error is at most half a quantization step per element.
+        assert float(jnp.max(err - scale[..., None] / 2)) <= 1e-6
+
+    def test_zero_vector_safe(self):
+        import jax.numpy as jnp
+
+        from oim_tpu.ops.quant import dequantize_int8, quantize_int8
+
+        q, scale = quantize_int8(jnp.zeros((2, 8)))
+        out = dequantize_int8(q, scale)
+        assert not bool(jnp.any(jnp.isnan(out)))
+        assert float(jnp.abs(out).max()) == 0.0
+
+    def test_extreme_values_use_full_range(self):
+        import jax.numpy as jnp
+
+        from oim_tpu.ops.quant import quantize_int8
+
+        q, _ = quantize_int8(jnp.asarray([[1000.0, -1000.0, 0.5]]))
+        assert int(q[0, 0]) == 127 and int(q[0, 1]) == -127

@@ -176,6 +176,28 @@ def test_sampling_reproducible_and_in_range(setup):
         assert all(0 <= t < cfg.vocab_size for t in toks)
 
 
+def test_kv_int8_engine_matches_solo_int8(setup):
+    """Quantization is per-vector and deterministic, so the continuous
+    batching invariant survives it: engine(kv_int8) output equals solo
+    generate(kv_int8) output exactly."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4, kv_int8=True)
+    assert engine._cache.k.dtype == jnp.int8
+    reqs = {
+        engine.submit(GenRequest(tokens=_prompt(s, 5 + s, cfg.vocab_size),
+                                 max_new_tokens=7)): s
+        for s in range(3)
+    }
+    results = engine.run()
+    for rid, s in reqs.items():
+        prompt = jnp.asarray(_prompt(s, 5 + s, cfg.vocab_size), jnp.int32)
+        want = np.asarray(
+            generate(params, prompt[None], cfg, max_new_tokens=7,
+                     kv_int8=True)
+        )[0, 5 + s:].tolist()
+        assert results[rid] == want
+
+
 def test_moe_engine(setup):
     cfg = TransformerConfig(**{**CFG, "n_experts": 2})
     params = init_params(jax.random.PRNGKey(0), cfg)

@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Long-context decode throughput: GQA × KV-cache dtype matrix.
+
+Decode is cache-bandwidth-bound (doc/compute.md), so its two levers are
+kv-head count (GQA) and cache element width (int8 quantization,
+ops/quant.py) — this tool measures the matrix on the real chip and
+prints one line per cell.  Timing discipline per BASELINE.md: N
+generations ride back-to-back dispatches, the clock stops on one
+materializing readback, and the measured tunnel rtt is subtracted.
+
+Usage (real TPU; ~2 min including compiles):
+    python tools/decode_bench.py [--prompt 1024] [--new 128] [--batch 8]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure_rtt(jnp):
+    import statistics
+
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    y = (x @ x).sum()
+    float(y)
+    rtts = []
+    for i in range(5):
+        done = ((x * (1.0 + i)) @ x).sum()
+        time.sleep(0.3)
+        t0 = time.perf_counter()
+        float(done)
+        rtts.append(time.perf_counter() - t0)
+    return statistics.median(rtts)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--prompt", type=int, default=1024)
+    p.add_argument("--new", type=int, default=128)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--iters", type=int, default=4)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from oim_tpu.models import TransformerConfig, init_params
+    from oim_tpu.models.decode import make_generate_fn
+
+    rtt = measure_rtt(jnp)
+    print(
+        f"backend={jax.default_backend()} rtt={rtt * 1e3:.0f}ms "
+        f"prompt={args.prompt} new={args.new} batch={args.batch}",
+        flush=True,
+    )
+
+    prompt = (
+        jnp.arange(args.batch * args.prompt).reshape(args.batch, args.prompt)
+        % 32768
+    ).astype(jnp.int32)
+
+    for n_kv in (0, 4, 2):  # 0 = MHA (16 heads)
+        cfg = TransformerConfig(
+            vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=n_kv, d_ff=4096, dtype="bfloat16",
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        gen = make_generate_fn(cfg)
+        for kv_int8 in (False, True):
+            out = gen(
+                params, prompt, max_new_tokens=args.new, kv_int8=kv_int8
+            )
+            np.asarray(out)  # compile + materialize
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = gen(
+                    params, prompt, max_new_tokens=args.new, kv_int8=kv_int8
+                )
+            np.asarray(out)
+            dt = (time.perf_counter() - t0 - rtt) / args.iters
+            tok_s = args.batch * args.new / dt
+            label = f"GQA-{n_kv}" if n_kv else "MHA"
+            print(
+                f"{label:6s} kv={'int8' if kv_int8 else 'bf16'}: "
+                f"{tok_s:8.0f} tok/s  ({dt * 1e3:.0f} ms for "
+                f"{args.batch}x{args.new})",
+                flush=True,
+            )
+        del params
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
