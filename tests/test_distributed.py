@@ -1,0 +1,207 @@
+"""Multi-host DCN tier: staged bootstraps → a REAL jax.distributed group.
+
+tests/test_multihost.py proves the control-plane rendezvous (N NodeStages
+converge on one coordinator assignment); this tier proves the thing the
+rendezvous exists FOR: two separate worker processes read their staged
+``tpu-bootstrap.json`` files, call ``coordinator.initialize()``, form one
+``jax.distributed`` process group at the controller-allocated coordinator
+address, build the global logical mesh, and run a cross-process
+collective whose result every process agrees on.  CPU analog of the DCN
+path (gloo collectives over a 2-process × 2-device global mesh) — the
+reference's tier-3 discipline of driving the real runtime, not a fake
+(reference test/test.make:1-16).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import os
+import subprocess
+import sys
+import time
+
+import grpc
+import pytest
+
+from oim_tpu.agent import ChipStore, FakeAgentServer
+from oim_tpu.controller import Controller
+from oim_tpu.csi import OIMDriver
+from oim_tpu.registry import Registry
+from oim_tpu.spec import CSI_CONTROLLER, CSI_NODE, csi_pb2
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from oim_tpu.parallel import coordinator
+
+mesh = coordinator.initialize({bootstrap!r})  # bind + join group + mesh
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+pid = jax.process_index()
+# Each process contributes its own shard of a dp-sharded global array;
+# the replicated sum forces a cross-process all-reduce over "DCN".
+local = np.full((2, 4), pid + 1, np.float32)
+x = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")), local, global_shape=(4, 4)
+)
+total = jax.jit(
+    lambda x: x.sum(), out_shardings=NamedSharding(mesh, P())
+)(x)
+print(json.dumps({{
+    "process": pid,
+    "num_processes": jax.process_count(),
+    "global_devices": len(jax.devices()),
+    "local_devices": len(jax.local_devices()),
+    "mesh_axes": {{k: int(v) for k, v in mesh.shape.items()}},
+    "sum": float(total),
+}}))
+"""
+
+
+def _worker_env() -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    # 2 local CPU devices per process → 4 global over the 2-process group.
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    return env
+
+
+def test_staged_bootstraps_form_real_process_group(tmp_path):
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    cleanups = [registry.close, reg_srv.stop]
+    channels = {}
+    try:
+        for host_id in ("host-a", "host-b"):
+            store = ChipStore(
+                mesh=(2, 1, 1), device_dir=str(tmp_path / host_id / "dev")
+            )
+            agent = FakeAgentServer(
+                store, str(tmp_path / host_id / "agent.sock")
+            ).start()
+            cleanups.append(agent.stop)
+            controller = Controller(
+                host_id,
+                agent.socket_path,
+                registry_address=str(reg_srv.addr()),
+                coordinator_host="127.0.0.1",
+                registry_delay=30.0,
+            )
+            ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
+            cleanups += [controller.close, ctrl_srv.stop]
+            controller.start(str(ctrl_srv.addr()))
+            driver = OIMDriver(
+                csi_endpoint=f"unix://{tmp_path}/{host_id}-csi.sock",
+                registry_address=str(reg_srv.addr()),
+                controller_id=host_id,
+            )
+            csi_srv = driver.start_server()
+            cleanups += [driver.close, csi_srv.stop]
+            channel = grpc.insecure_channel(csi_srv.addr().grpc_target())
+            cleanups.append(channel.close)
+            channels[host_id] = channel
+
+        deadline = time.time() + 10
+        while any(
+            registry.db.lookup(f"{h}/address") == "" for h in channels
+        ):
+            assert time.time() < deadline, "controllers never registered"
+            time.sleep(0.02)
+
+        cap = csi_pb2.VolumeCapability()
+        cap.mount.SetInParent()
+        cap.access_mode.mode = (
+            csi_pb2.VolumeCapability.AccessMode.MULTI_NODE_MULTI_WRITER
+        )
+        vol = CSI_CONTROLLER.stub(channels["host-a"]).CreateVolume(
+            csi_pb2.CreateVolumeRequest(
+                name="dist-vol",
+                volume_capabilities=[cap],
+                parameters={"chipCount": "2", "hosts": "host-a,host-b"},
+            ),
+            timeout=30,
+        ).volume
+
+        def stage(host_id: str) -> str:
+            staging = str(tmp_path / host_id / "staging")
+            target = str(tmp_path / host_id / "pod" / "tpu")
+            node = CSI_NODE.stub(channels[host_id])
+            node.NodeStageVolume(
+                csi_pb2.NodeStageVolumeRequest(
+                    volume_id="dist-vol",
+                    staging_target_path=staging,
+                    volume_capability=cap,
+                    volume_context=dict(vol.volume_context),
+                ),
+                timeout=60,
+            )
+            node.NodePublishVolume(
+                csi_pb2.NodePublishVolumeRequest(
+                    volume_id="dist-vol",
+                    staging_target_path=staging,
+                    target_path=target,
+                    volume_capability=cap,
+                ),
+                timeout=60,
+            )
+            return os.path.join(target, "tpu-bootstrap.json")
+
+        # Concurrent: the rendezvous blocks until both hosts join.
+        with concurrent.futures.ThreadPoolExecutor(2) as pool:
+            paths = list(pool.map(stage, ["host-a", "host-b"]))
+
+        boots = [json.load(open(p)) for p in paths]
+        assert {b["process_id"] for b in boots} == {0, 1}
+        assert all(b["num_processes"] == 2 for b in boots)
+        assert len({b["coordinator_address"] for b in boots}) == 1
+
+        # The workloads: one process per staged bootstrap, forming ONE
+        # jax.distributed group and agreeing on a global collective.
+        procs = []
+        for p in paths:
+            proc = subprocess.Popen(
+                [sys.executable, "-c", WORKER.format(repo=REPO, bootstrap=p)],
+                env=_worker_env(),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+            procs.append(proc)
+            # One worker failing must not leave its peer blocked in the
+            # jax.distributed rendezvous: kill both on any exit path.
+            cleanups.append(lambda proc=proc: (proc.kill(), proc.wait()))
+        reports = []
+        for proc in procs:
+            out, err = proc.communicate(timeout=300)
+            assert proc.returncode == 0, (
+                f"worker failed\nhead: {err[:1200]}\n...\ntail: {err[-1200:]}"
+            )
+            reports.append(json.loads(out.strip().splitlines()[-1]))
+
+        assert {r["process"] for r in reports} == {0, 1}
+        for r in reports:
+            assert r["num_processes"] == 2
+            assert r["global_devices"] == 4
+            assert r["local_devices"] == 2
+            assert r["mesh_axes"] == {"dp": 4, "pp": 1, "sp": 1, "tp": 1,
+                                      "ep": 1}
+            # 8 elements of 1.0 (process 0) + 8 of 2.0 (process 1).
+            assert r["sum"] == 24.0
+    finally:
+        for cleanup in reversed(cleanups):
+            try:
+                cleanup()
+            except Exception:
+                pass

@@ -42,6 +42,13 @@ def main() -> int:
     p.add_argument("--new", type=int, default=128)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--iters", type=int, default=4)
+    # Flagship geometry by default; shrink for CPU smoke runs.
+    p.add_argument("--vocab-size", type=int, default=32768)
+    p.add_argument("--d-model", type=int, default=1024)
+    p.add_argument("--n-layers", type=int, default=8)
+    p.add_argument("--n-heads", type=int, default=16)
+    p.add_argument("--d-ff", type=int, default=4096)
+    p.add_argument("--dtype", default="bfloat16")
     args = p.parse_args()
 
     import jax
@@ -63,10 +70,11 @@ def main() -> int:
         % 32768
     ).astype(jnp.int32)
 
-    for n_kv in (0, 4, 2):  # 0 = MHA (16 heads)
+    for n_kv in (0, 4, 2):  # 0 = MHA (n_heads kv heads)
         cfg = TransformerConfig(
-            vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
-            n_kv_heads=n_kv, d_ff=4096, dtype="bfloat16",
+            vocab_size=args.vocab_size, d_model=args.d_model,
+            n_layers=args.n_layers, n_heads=args.n_heads,
+            n_kv_heads=n_kv, d_ff=args.d_ff, dtype=args.dtype,
         )
         params = init_params(jax.random.PRNGKey(0), cfg)
         gen = make_generate_fn(cfg)
@@ -81,11 +89,23 @@ def main() -> int:
                     params, prompt, max_new_tokens=args.new, kv_int8=kv_int8
                 )
             np.asarray(out)
-            dt = (time.perf_counter() - t0 - rtt) / args.iters
-            tok_s = args.batch * args.new / dt
+            elapsed = time.perf_counter() - t0
             label = f"GQA-{n_kv}" if n_kv else "MHA"
+            kv_label = "int8" if kv_int8 else args.dtype
+            if elapsed <= rtt:
+                # The tunnel readback swamped the measurement; a negative
+                # dt would print nonsense tok/s.
+                print(
+                    f"{label:6s} kv={kv_label}: below noise floor "
+                    f"(elapsed {elapsed * 1e3:.0f} ms <= rtt "
+                    f"{rtt * 1e3:.0f} ms; raise --iters/--new)",
+                    flush=True,
+                )
+                continue
+            dt = (elapsed - rtt) / args.iters
+            tok_s = args.batch * args.new / dt
             print(
-                f"{label:6s} kv={'int8' if kv_int8 else 'bf16'}: "
+                f"{label:6s} kv={kv_label}: "
                 f"{tok_s:8.0f} tok/s  ({dt * 1e3:.0f} ms for "
                 f"{args.batch}x{args.new})",
                 flush=True,
