@@ -273,6 +273,22 @@ def registry() -> MetricsRegistry:
     return _registry
 
 
+EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def write_exposition(handler, registry_: MetricsRegistry | None = None) -> None:
+    """Write the Prometheus text exposition as the response to an
+    http.server request ``handler`` — THE one definition of the scrape
+    response, shared by MetricsServer and any component embedding
+    /metrics in its own HTTP surface (e.g. oim-serve)."""
+    body = (registry_ or _registry).render().encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type", EXPOSITION_CONTENT_TYPE)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
 # ---------------------------------------------------------------------------
 # gRPC server instrumentation
 
@@ -366,14 +382,7 @@ class MetricsServer:
                 if self.path.split("?", 1)[0] not in ("/", "/metrics"):
                     self.send_error(404)
                     return
-                body = reg.render().encode()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
-                )
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                write_exposition(self, reg)
 
             def log_message(self, *args):  # quiet
                 pass

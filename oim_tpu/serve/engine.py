@@ -43,11 +43,14 @@ handful of jitted functions with donated cache buffers.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field, replace
 from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+from oim_tpu.common import metrics as _metrics
 
 from oim_tpu.models.decode import (
     _dense_mlp,
@@ -319,6 +322,7 @@ class _SlotState:
     rid: int
     req: GenRequest
     base: jax.Array  # per-request PRNG base key (PRNGKey(req.seed))
+    t_submit: float
     emitted: list[int] = field(default_factory=list)
     last_token: int = 0
 
@@ -335,6 +339,9 @@ class Engine:
     whenever any request nears completion).  Compile count: one decode
     program + one admit per prompt bucket.
     """
+
+    _instance_lock = threading.Lock()
+    _instance_count = 0
 
     def __init__(
         self,
@@ -388,7 +395,7 @@ class Engine:
             donate_argnums=(1,),
         )
         self._lock = threading.Lock()
-        self._queue: list[tuple[int, GenRequest]] = []
+        self._queue: list[tuple[int, GenRequest, float]] = []
         self._slots: dict[int, _SlotState] = {}  # slot index → state
         self._free = list(range(n_slots))
         self._results: dict[int, list[int]] = {}
@@ -398,10 +405,53 @@ class Engine:
         self._next_rid = 0
         self._step_count = 0
         self.tokens_generated = 0
+        # Prometheus instruments (oim_tpu/common/metrics.py — shared with
+        # the control-plane components; idempotent by name).  Counters and
+        # histograms are cumulative so several engines in one process can
+        # share them; the point-in-time gauges carry a per-engine label so
+        # one engine's updates cannot stomp another's.
+        reg = _metrics.registry()
+        with Engine._instance_lock:
+            self._engine_label = str(Engine._instance_count)
+            Engine._instance_count += 1
+        self._m_requests = reg.counter(
+            "oim_serve_requests_total",
+            "Generation requests by outcome.",
+            ("outcome",),
+        )
+        self._m_tokens = reg.counter(
+            "oim_serve_tokens_total", "Tokens generated (after truncation)."
+        )
+        self._m_dispatches = reg.counter(
+            "oim_serve_decode_dispatches_total",
+            "Chunked decode dispatches (one device round trip each).",
+        )
+        self._m_latency = reg.histogram(
+            "oim_serve_request_seconds",
+            "Submit-to-completion latency per request.",
+            # Generation latencies, not control-plane RPCs: a queued
+            # 128-token request over a tunneled link legitimately takes
+            # minutes (the HTTP server waits up to 600 s).
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                     120.0, 300.0, 600.0),
+        )
+        self._m_active = reg.gauge(
+            "oim_serve_active_slots", "Slots currently decoding.",
+            ("engine",),
+        )
+        self._m_queued = reg.gauge(
+            "oim_serve_queued_requests", "Requests waiting for a slot.",
+            ("engine",),
+        )
+        # warmup() routes dummy requests through the normal paths; they
+        # must not pollute the cumulative request metrics (a fresh daemon
+        # would otherwise report phantom traffic and 20-40 s compile
+        # latencies in the histogram forever).
+        self._warming = False
 
     # -- submission / results (any thread) --------------------------------
 
-    def submit(self, req: GenRequest) -> int:
+    def _validate(self, req: GenRequest) -> None:
         max_len = self._cache.max_len
         if not req.tokens:
             raise ValueError("empty prompt")
@@ -425,11 +475,20 @@ class Engine:
                 f"token ids out of range [0, {self.cfg.vocab_size}): "
                 f"{bad[:5]}"
             )
+
+    def submit(self, req: GenRequest) -> int:
+        try:
+            self._validate(req)
+        except ValueError:
+            if not self._warming:
+                self._m_requests.inc("rejected")
+            raise
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
-            self._queue.append((rid, req))
+            self._queue.append((rid, req, time.monotonic()))
             self._events[rid] = threading.Event()
+            self._m_queued.set(float(len(self._queue)), self._engine_label)
         return rid
 
     def result(self, rid: int, timeout: float | None = None) -> list[int]:
@@ -471,12 +530,14 @@ class Engine:
         thread calls this when ``step`` raises, so blocked ``result()``
         callers get a RuntimeError instead of waiting out their timeout)."""
         with self._lock:
-            pending = [rid for rid, _ in self._queue]
+            pending = [rid for rid, _, _ in self._queue]
             pending += [s.rid for s in self._slots.values()]
             self._queue.clear()
             self._free += sorted(self._slots)
             self._slots.clear()
             for rid in pending:
+                if not self._warming:
+                    self._m_requests.inc("aborted")
                 if rid in self._forgotten:
                     self._forgotten.discard(rid)
                     self._events.pop(rid, None)
@@ -484,6 +545,8 @@ class Engine:
                 self._errors[rid] = message
                 if rid in self._events:
                     self._events[rid].set()
+            self._m_active.set(0.0, self._engine_label)
+            self._m_queued.set(0.0, self._engine_label)
 
     # -- engine loop (one driver thread) ----------------------------------
 
@@ -512,6 +575,11 @@ class Engine:
         # token was never registered in _slots.
         self._slots.pop(slot, None)
         self._free.append(slot)
+        if not self._warming:
+            self._m_requests.inc("completed")
+            self._m_tokens.inc(by=float(len(state.emitted)))
+            self._m_latency.observe(time.monotonic() - state.t_submit)
+        self._m_active.set(float(len(self._slots)), self._engine_label)
         if state.rid in self._forgotten:  # caller gave up; retain nothing
             self._forgotten.discard(state.rid)
             self._events.pop(state.rid, None)
@@ -533,9 +601,10 @@ class Engine:
         with self._lock:
             admissions = []
             while self._queue and self._free:
-                rid, req = self._queue.pop(0)
-                admissions.append((self._free.pop(0), rid, req))
-        for slot, rid, req in admissions:
+                rid, req, t_submit = self._queue.pop(0)
+                admissions.append((self._free.pop(0), rid, req, t_submit))
+            self._m_queued.set(float(len(self._queue)), self._engine_label)
+        for slot, rid, req, t_submit in admissions:
             bucket = self._bucket(len(req.tokens))
             prompt = jnp.asarray(
                 req.tokens + [0] * (bucket - len(req.tokens)), jnp.int32
@@ -551,7 +620,8 @@ class Engine:
                 key,
             )
             state = _SlotState(
-                rid=rid, req=req, base=jax.random.PRNGKey(req.seed)
+                rid=rid, req=req, base=jax.random.PRNGKey(req.seed),
+                t_submit=t_submit,
             )
             token = int(first)
             self.tokens_generated += 1
@@ -560,6 +630,7 @@ class Engine:
                     self._finish(slot, state)
                 else:
                     self._slots[slot] = state
+                    self._m_active.set(float(len(self._slots)), self._engine_label)
 
         with self._lock:
             if not self._slots:
@@ -597,6 +668,7 @@ class Engine:
         )
         out = jax.device_get(out)  # ONE readback per chunk
         self._step_count += 1
+        self._m_dispatches.inc()
         with self._lock:
             for slot, state in list(slots.items()):
                 done = False
@@ -624,16 +696,20 @@ class Engine:
         must never land on live traffic (the control-plane analog is the
         registry pre-dialing controllers it proxies for)."""
         max_len = self._cache.max_len
-        rids = []
-        for b in self.prompt_buckets:
-            headroom = max_len - b
-            if headroom < 1:
-                continue
-            rids.append(self.submit(GenRequest(
-                tokens=[0] * b,
-                max_new_tokens=min(2 * self.chunk, headroom),
-            )))
-        self.run()
-        for rid in rids:  # consume the dummies; warmup must not retain
-            self.result(rid, timeout=0)
+        self._warming = True  # dummies must not pollute request metrics
+        try:
+            rids = []
+            for b in self.prompt_buckets:
+                headroom = max_len - b
+                if headroom < 1:
+                    continue
+                rids.append(self.submit(GenRequest(
+                    tokens=[0] * b,
+                    max_new_tokens=min(2 * self.chunk, headroom),
+                )))
+            self.run()
+            for rid in rids:  # consume the dummies; warmup must not retain
+                self.result(rid, timeout=0)
+        finally:
+            self._warming = False
         return self

@@ -24,6 +24,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from oim_tpu.common import metrics
 from oim_tpu.serve.engine import Engine, GenRequest
 
 
@@ -53,6 +54,11 @@ class ServeServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path.split("?", 1)[0] == "/metrics":
+                    # Prometheus exposition, shared registry + response
+                    # format with the control plane (common/metrics.py).
+                    metrics.write_exposition(self)
+                    return
                 if self.path == "/healthz":
                     if outer.error is not None:
                         # A dead driver thread must flip health, or the

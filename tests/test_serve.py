@@ -298,6 +298,35 @@ def test_server_survives_driver_crash(setup):
         server.stop()
 
 
+def test_metrics_instrumented(setup):
+    """Engine outcomes land in the shared Prometheus registry."""
+    from oim_tpu.common import metrics as m
+
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=2)
+    requests = m.registry().counter(
+        "oim_serve_requests_total", "", ("outcome",)
+    )
+    tokens = m.registry().counter("oim_serve_tokens_total", "")
+    before_done = requests.value("completed")
+    before_rej = requests.value("rejected")
+    before_tok = tokens.value()
+    rid = engine.submit(GenRequest(tokens=[1, 2], max_new_tokens=4))
+    engine.run()
+    engine.result(rid, timeout=0)
+    with pytest.raises(ValueError):
+        engine.submit(GenRequest(tokens=[], max_new_tokens=1))
+    assert requests.value("completed") == before_done + 1
+    assert requests.value("rejected") == before_rej + 1
+    assert tokens.value() == before_tok + 4
+    # Abort path: queued request counts as aborted.
+    engine2 = Engine(params, cfg, n_slots=1, max_len=64, chunk=2)
+    before_abort = requests.value("aborted")
+    engine2.submit(GenRequest(tokens=[1], max_new_tokens=2))
+    engine2.abort("test")
+    assert requests.value("aborted") == before_abort + 1
+
+
 def test_bucket_validation(setup):
     cfg, params = setup
     with pytest.raises(ValueError, match="prompt_buckets"):
@@ -340,6 +369,10 @@ def test_http_server(setup):
         with urllib.request.urlopen(f"{base}/v1/stats", timeout=10) as r:
             stats = json.load(r)
         assert stats["tokens_generated"] >= 7
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            exposition = r.read().decode()
+        assert 'oim_serve_requests_total{outcome="completed"}' in exposition
+        assert "oim_serve_request_seconds_bucket" in exposition
         # Malformed request → 400, not a hung connection.
         bad = urllib.request.Request(
             f"{base}/v1/generate", data=b'{"max_new_tokens": 3}',
