@@ -39,6 +39,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-kv-heads", type=int, default=0)
     p.add_argument("--d-ff", type=int, default=0)
     p.add_argument("--n-experts", type=int, default=0)
+    p.add_argument("--moe-top-k", type=int, default=1)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument(
         "--checkpoint-dir", default="",
@@ -83,6 +84,7 @@ def make_engine(args):
         n_kv_heads=args.n_kv_heads,
         d_ff=args.d_ff or 4 * args.d_model,
         n_experts=args.n_experts,
+        moe_top_k=args.moe_top_k,
         dtype=args.dtype,
     )
     params = init_params(jax.random.PRNGKey(0), cfg)

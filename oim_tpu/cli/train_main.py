@@ -50,6 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-kv-heads", type=int, default=0)
     p.add_argument("--d-ff", type=int, default=0)
     p.add_argument("--n-experts", type=int, default=0)
+    p.add_argument("--moe-top-k", type=int, default=1)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--attn-impl", default="ring", choices=["ring", "ulysses"])
     p.add_argument("--pp-schedule", default="gpipe", choices=["gpipe", "1f1b"])
@@ -180,6 +181,7 @@ def main(argv=None) -> int:
         n_kv_heads=args.n_kv_heads,
         d_ff=args.d_ff,
         n_experts=args.n_experts,
+        moe_top_k=args.moe_top_k,
         n_stages=args.pp,
         n_microbatches=max(args.n_microbatches, 1),
         dtype=args.dtype,

@@ -35,6 +35,7 @@ from oim_tpu.models.transformer import (
     TransformerConfig,
     _dense_mlp,
     _rmsnorm,
+    _router_gates,
     _switch_moe,
     _unembed,
 )
@@ -175,25 +176,29 @@ def _cached_attention(
 
 def _moe_exact(x, lp, cfg: TransformerConfig):
     """Drop-free MoE for single-token decode steps: every token runs
-    through its argmax expert.  Computes all experts per token, which is
-    E× the needed FLOPs — acceptable only at t=1 scale, so *prefill*
-    (whole prompt) instead reuses the train-path ``_switch_moe`` (same
-    capacity semantics as the training forward, hence exact agreement
-    with it), and this path handles the incremental steps where capacity
-    bookkeeping over a 1-token call would misroute."""
+    through its top-k experts (k = ``cfg.moe_top_k``; gates per
+    ``transformer._router_gates``, matching the train path).  Computes
+    all experts per token, which is E× the needed FLOPs — acceptable
+    only at t=1 scale, so *prefill* (whole prompt) instead reuses the
+    train-path ``_switch_moe`` (same capacity semantics as the training
+    forward, hence exact agreement with it), and this path handles the
+    incremental steps where capacity bookkeeping over a 1-token call
+    would misroute."""
     b, t, d = x.shape
     normed = _rmsnorm(x, lp["mlp_norm"], cfg).reshape(b * t, d)
     router_logits = jnp.einsum(
         "gd,de->ge", normed.astype(jnp.float32), lp["router"].astype(jnp.float32)
     )
     probs = jax.nn.softmax(router_logits, axis=-1)  # [G, E]
-    assign = jax.nn.one_hot(jnp.argmax(probs, axis=-1), cfg.n_experts)
-    gate_w = jnp.max(probs, axis=-1)  # [G]
+    _, top_idx, gates = _router_gates(probs, cfg.moe_top_k)  # [G, K]
+    # Per-expert weight = the gate of whichever choice picked it.
+    assign = jax.nn.one_hot(top_idx, cfg.n_experts)  # [G, K, E]
+    weights = jnp.einsum("gke,gk->ge", assign, gates)
     normed_f = normed.astype(jnp.float32)
     up_gate = jax.nn.silu(jnp.einsum("gd,edf->gef", normed_f, lp["w_gate"]))
     up = jnp.einsum("gd,edf->gef", normed_f, lp["w_in"])
     expert_out = jnp.einsum("gef,efd->ged", up_gate * up, lp["w_out"])
-    out = jnp.einsum("ged,ge,g->gd", expert_out, assign, gate_w)
+    out = jnp.einsum("ged,ge->gd", expert_out, weights)
     return x + out.reshape(b, t, d).astype(x.dtype)
 
 
@@ -209,7 +214,8 @@ def _forward_cached(
 
     ``is_prefill`` selects MoE routing: prefill uses the train-path
     capacity routing (exact agreement with the training forward, even for
-    1-token prompts); incremental steps use drop-free argmax routing."""
+    1-token prompts); incremental steps use drop-free top-k routing
+    (``_moe_exact``, k = ``cfg.moe_top_k``)."""
     # Inference runs under GSPMD auto-partitioning where pallas (Mosaic)
     # kernels cannot sit (same constraint train.py gates on); XLA fuses
     # the reference rmsnorm anyway at t=1.

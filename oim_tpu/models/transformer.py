@@ -55,6 +55,10 @@ class TransformerConfig:
     n_kv_heads: int = 0
     d_ff: int = 0  # 0 → 4 * d_model
     n_experts: int = 0  # 0 → dense SwiGLU
+    # Experts chosen per token: 1 = switch routing (gate = router prob,
+    # per the switch transformer), >=2 = GShard-style top-k (gates
+    # normalized over the chosen experts).
+    moe_top_k: int = 1
     expert_capacity_factor: float = 1.25
     rope_theta: float = 10000.0
     n_stages: int = 1  # pipeline stages; must divide n_layers
@@ -94,6 +98,13 @@ class TransformerConfig:
             raise ValueError(
                 f"n_kv_heads={self.n_kv_heads} must be a positive divisor "
                 f"of n_heads={self.n_heads}"
+            )
+        if self.moe_top_k < 1 or (
+            self.n_experts and self.moe_top_k > self.n_experts
+        ):
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} must be in "
+                f"[1, n_experts={self.n_experts}]"
             )
 
     @property
@@ -275,32 +286,72 @@ def _dense_mlp(x, lp, cfg: TransformerConfig):
     return x + down.astype(x.dtype), jnp.zeros((), jnp.float32)
 
 
+def _router_gates(probs, top_k: int):
+    """(top-k probs [G, K], indices [G, K], gates [G, K]).
+
+    k=1: the gate is the raw router prob (switch transformer — keeps the
+    router differentiable through the scale of its own choice);
+    k>=2: gates renormalized over the chosen experts (GShard)."""
+    top_probs, top_idx = jax.lax.top_k(probs, top_k)
+    if top_k == 1:
+        return top_probs, top_idx, top_probs
+    return top_probs, top_idx, top_probs / jnp.sum(
+        top_probs, axis=-1, keepdims=True
+    )
+
+
+def _capacity_dispatch(top_idx, gates, e: int, capacity: int):
+    """Queue tokens into expert slots with choice-rank priority.
+
+    top_idx/gates: [G, K].  Returns (dispatch, combine), both
+    [G, E, capacity]: dispatch is the 0/1 slot assignment, combine is
+    dispatch scaled by the choice's gate.  Rank r tokens take positions
+    after every rank < r assignment to the same expert (first choices
+    never lose a slot to second choices); overflow rows are all-zero, so
+    dropped assignments fall back to the residual.  Pure function of the
+    routing — unit-tested directly in tests/test_model.py.
+    """
+    g, k = top_idx.shape
+    dispatch = jnp.zeros((g, e, capacity), jnp.float32)
+    combine = jnp.zeros((g, e, capacity), jnp.float32)
+    prior = jnp.zeros((e,), jnp.float32)  # per-expert count so far
+    for rank in range(k):
+        assign = jax.nn.one_hot(top_idx[:, rank], e, dtype=jnp.float32)
+        position = (jnp.cumsum(assign, axis=0) - 1.0 + prior[None, :]) * assign
+        position = jnp.where(assign > 0, position, -1.0)
+        prior = prior + jnp.sum(assign, axis=0)
+        keep = (position >= 0) & (position < capacity)
+        d_rank = jax.nn.one_hot(
+            jnp.where(keep, position, -1).astype(jnp.int32),
+            capacity,
+            dtype=jnp.float32,
+        )  # [G, E, C]
+        dispatch = dispatch + d_rank
+        combine = combine + d_rank * gates[:, rank, None, None]
+    return dispatch, combine
+
+
 def _switch_moe(x, lp, cfg: TransformerConfig):
-    """Top-1 switch routing with capacity, Mesh-TensorFlow style dispatch:
+    """Top-k expert routing with capacity, Mesh-TensorFlow style dispatch:
     the one-hot dispatch/combine einsums ride the MXU and GSPMD turns the
-    token→expert resharding into all-to-all over ``ep``."""
+    token→expert resharding into all-to-all over ``ep``.
+
+    k=1 is switch-transformer routing; k>=2 is GShard-style with
+    choice-rank priority (every token's first choice queues before any
+    token's second choice, so drops hit the lower-gate assignments
+    first)."""
     b, t, d = x.shape
-    e = cfg.n_experts
+    e, k = cfg.n_experts, cfg.moe_top_k
     g = b * t
-    capacity = max(int(cfg.expert_capacity_factor * g / e), 1)
+    capacity = max(int(cfg.expert_capacity_factor * k * g / e), 1)
     normed = _rmsnorm(x, lp["mlp_norm"], cfg).reshape(g, d)
 
     router_logits = jnp.einsum(
         "gd,de->ge", normed.astype(jnp.float32), lp["router"].astype(jnp.float32)
     )
     probs = jax.nn.softmax(router_logits, axis=-1)  # [G, E]
-    expert_idx = jnp.argmax(probs, axis=-1)  # [G]
-    expert_gate = jnp.max(probs, axis=-1)  # [G]
-    assign = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [G, E]
-    # Position of each token within its expert's queue; drop beyond capacity.
-    position = jnp.cumsum(assign, axis=0) * assign - 1.0  # [G, E]
-    keep = (position >= 0) & (position < capacity)
-    dispatch = jax.nn.one_hot(
-        jnp.where(keep, position, -1).astype(jnp.int32),
-        capacity,
-        dtype=jnp.float32,
-    )  # [G, E, C]
-    combine = dispatch * expert_gate[:, None, None]
+    _, top_idx, gates = _router_gates(probs, k)  # [G, K] each
+    dispatch, combine = _capacity_dispatch(top_idx, gates, e, capacity)
 
     expert_in = jnp.einsum("gec,gd->ecd", dispatch, normed.astype(jnp.float32))
     gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"]))
@@ -308,8 +359,9 @@ def _switch_moe(x, lp, cfg: TransformerConfig):
     expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["w_out"])
     out = jnp.einsum("gec,ecd->gd", combine, expert_out).reshape(b, t, d)
 
-    # Switch-transformer load-balancing auxiliary loss.
-    density = jnp.mean(assign, axis=0)  # fraction routed per expert
+    # Load-balancing auxiliary loss over first choices (switch/GShard).
+    first_assign = jax.nn.one_hot(top_idx[:, 0], e, dtype=jnp.float32)
+    density = jnp.mean(first_assign, axis=0)  # fraction routed per expert
     density_proxy = jnp.mean(probs, axis=0)
     aux = e * jnp.sum(density * density_proxy)
     return x + out.astype(x.dtype), aux

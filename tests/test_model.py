@@ -373,3 +373,103 @@ class TestGQA:
     def test_gqa_bad_group_rejected(self):
         with pytest.raises(ValueError):
             TransformerConfig(**{**TINY, "n_heads": 4, "n_kv_heads": 3})
+
+
+class TestTopKRouting:
+    """GShard-style top-k expert routing (moe_top_k >= 2)."""
+
+    def _layer_params(self, cfg, key):
+        d, f, e = cfg.d_model, cfg.d_ff, cfg.n_experts
+        keys = jax.random.split(key, 5)
+        return {
+            "mlp_norm": jnp.ones((d,), jnp.float32),
+            "router": jax.random.normal(keys[0], (d, e)) * 0.5,
+            "w_gate": jax.random.normal(keys[1], (e, d, f)) * 0.1,
+            "w_in": jax.random.normal(keys[2], (e, d, f)) * 0.1,
+            "w_out": jax.random.normal(keys[3], (e, f, d)) * 0.1,
+        }
+
+    def test_top_k_validation(self):
+        with pytest.raises(ValueError, match="moe_top_k"):
+            TransformerConfig(**TINY, n_experts=2, moe_top_k=3)
+        with pytest.raises(ValueError, match="moe_top_k"):
+            TransformerConfig(**TINY, moe_top_k=0)
+
+    def test_top2_drop_free_matches_exact_routing(self):
+        """With capacity high enough that nothing drops, the capacity
+        dispatch must agree with the drop-free per-token formulation —
+        the same equivalence the decode path relies on."""
+        from oim_tpu.models.decode import _moe_exact
+        from oim_tpu.models.transformer import _switch_moe
+
+        cfg = TransformerConfig(
+            **TINY, n_experts=4, moe_top_k=2, expert_capacity_factor=8.0,
+        )
+        lp = self._layer_params(cfg, jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, cfg.d_model))
+        switch_out, aux = _switch_moe(x, lp, cfg)
+        exact_out = _moe_exact(x, lp, cfg)
+        np.testing.assert_allclose(
+            np.asarray(switch_out), np.asarray(exact_out), atol=1e-5
+        )
+        assert float(aux) > 0
+
+    def test_top2_gates_normalized_top1_raw(self):
+        from oim_tpu.models.transformer import _router_gates
+
+        probs = jax.nn.softmax(
+            jax.random.normal(jax.random.PRNGKey(0), (6, 4)), axis=-1
+        )
+        _, _, g1 = _router_gates(probs, 1)
+        np.testing.assert_allclose(
+            np.asarray(g1[:, 0]), np.asarray(probs.max(axis=-1)), rtol=1e-6
+        )
+        _, _, g2 = _router_gates(probs, 2)
+        np.testing.assert_allclose(
+            np.asarray(g2.sum(axis=-1)), np.ones(6), rtol=1e-6
+        )
+
+    def test_top2_capacity_priority_drops_second_choices_first(self):
+        """Choice-rank priority, hand-computed: with capacity 2 and
+        4 tokens routing [first, second] = [0,1],[0,1],[1,0],[0,1]:
+        expert 0's slots go to tokens 0,1 (token 3's FIRST choice drops —
+        queue full); expert 1's slots go to token 2 (rank 0) then token 0
+        (rank 1); tokens 1,3 lose their second choice.  Inverting rank
+        priority would hand expert-1 slots to tokens 0,1 instead."""
+        from oim_tpu.models.transformer import _capacity_dispatch
+
+        top_idx = jnp.asarray([[0, 1], [0, 1], [1, 0], [0, 1]])
+        gates = jnp.full((4, 2), 0.5)
+        dispatch, combine = _capacity_dispatch(
+            top_idx, gates, e=2, capacity=2
+        )
+        got = np.asarray(dispatch)
+        # [token, expert, slot]
+        assert got[0, 0, 0] == 1 and got[1, 0, 1] == 1  # rank-0 keeps
+        assert got[2, 1, 0] == 1                        # rank-0 keeps
+        assert got[0, 1, 1] == 1                        # rank-1 fills slot
+        assert got[3].sum() == 0                        # fully dropped
+        assert got[1, 1].sum() == 0                     # 2nd choice dropped
+        assert got.sum() == 4                           # exactly 4 kept
+        # token 2's rank-1 pick (expert 0) must NOT displace rank-0 work:
+        assert got[2, 0].sum() == 0
+        np.testing.assert_allclose(np.asarray(combine).sum(), 4 * 0.5)
+
+    def test_top2_trains(self):
+        cfg = TransformerConfig(**TINY, n_experts=4, moe_top_k=2)
+        mesh = build_mesh(devices=jax.devices()[:1])
+        losses = _run_steps(cfg, mesh, steps=6)
+        assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+    def test_top2_generate(self):
+        from oim_tpu.models.decode import generate
+
+        cfg = TransformerConfig(
+            **TINY, n_experts=4, moe_top_k=2, expert_capacity_factor=8.0,
+            use_pallas=False,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.arange(2 * 6).reshape(2, 6) % cfg.vocab_size
+        out = generate(params, prompt, cfg, max_new_tokens=5)
+        assert out.shape == (2, 11)
+        assert np.asarray(out).max() < cfg.vocab_size
