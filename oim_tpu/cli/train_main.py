@@ -79,6 +79,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--grad-clip", type=float, default=0.0,
         help="global-norm gradient clip (0 = off)",
     )
+    # Held-out evaluation: the corpus tail is split off for validation.
+    p.add_argument(
+        "--eval-every", type=_nonneg_int, default=0,
+        help="run held-out eval every N steps (0 = off)",
+    )
+    p.add_argument(
+        "--eval-frac", type=float, default=0.05,
+        help="fraction of the corpus tail held out for eval",
+    )
+    p.add_argument(
+        "--eval-batches", type=_positive_int, default=4,
+        help="batches averaged per eval pass",
+    )
     p.add_argument(
         "--weight-decay", type=float, default=1e-4,
         help="adamw decay on matmul weights (norm gains are excluded)",
@@ -249,10 +262,61 @@ def main(argv=None) -> int:
 
     tokens = _load_corpus(args)
     shard = ShardSpec(jax.process_index(), jax.process_count())
+    sharding = jax.sharding.NamedSharding(mesh, data_pspec())
+
+    eval_fn = None
+    if args.eval_every:
+        from oim_tpu.data.loader import window_count
+        from oim_tpu.models import make_eval_step
+
+        if not 0.0 < args.eval_frac < 1.0:
+            raise SystemExit(
+                f"--eval-frac must be in (0, 1), got {args.eval_frac}"
+            )
+        n_eval = int(len(tokens) * args.eval_frac)
+        if window_count(n_eval, args.seq) < args.batch_global:
+            raise SystemExit(
+                f"eval split of {n_eval} tokens cannot fill one "
+                f"batch of {args.batch_global}x(seq+1); raise --eval-frac "
+                "or use a larger corpus"
+            )
+        # Tail split: train never sees the eval tokens.
+        eval_tokens, tokens = tokens[len(tokens) - n_eval:], tokens[
+            : len(tokens) - n_eval
+        ]
+        eval_batches = TokenBatches(
+            eval_tokens, args.batch_global, args.seq, shard,
+            seed=args.seed + 1,
+        )
+        eval_step = make_eval_step(cfg, mesh)
+        # Distinct windows only: reading past one epoch would re-average
+        # the same windows and misrepresent the batch count.
+        n_eval_batches = min(args.eval_batches, eval_batches.steps_per_epoch)
+        if n_eval_batches < args.eval_batches:
+            log.current().warning(
+                "eval split smaller than requested batches; clamping",
+                requested=args.eval_batches, used=n_eval_batches,
+            )
+
+        def eval_fn(params) -> float:
+            from oim_tpu.data.prefetch import to_global
+
+            ces = [
+                eval_step(
+                    params,
+                    # to_global, not device_put: each process holds only
+                    # its shard of the batch (same as the train path).
+                    to_global(
+                        eval_batches.batch_at(i)[:, : args.seq], sharding
+                    ),
+                )
+                for i in range(n_eval_batches)
+            ]
+            return float(np.mean([jax.device_get(c) for c in ces]))
+
     batches = TokenBatches(
         tokens, args.batch_global, args.seq, shard, seed=args.seed
     )
-    sharding = jax.sharding.NamedSharding(mesh, data_pspec())
 
     def batch_stream():
         step = start_step
@@ -281,6 +345,14 @@ def main(argv=None) -> int:
                     tok_per_s=round(window_tokens / max(dt, 1e-9)),
                 )
                 t0, window_tokens = time.perf_counter(), 0
+            if eval_fn is not None and (
+                step % args.eval_every == 0 or step == args.steps
+            ):
+                ce = eval_fn(state.params)
+                log.current().info(
+                    "eval", step=step, eval_ce=round(ce, 4),
+                    eval_ppl=round(float(np.exp(min(ce, 30.0))), 2),
+                )
             # Gate host-side: Checkpointer.save device_gets state.step
             # (a per-step host sync would serialize dispatch against the
             # async prefetch for nothing on off-interval steps).

@@ -16,6 +16,7 @@ from oim_tpu.models.transformer import (
 from oim_tpu.models.train import (
     TrainState,
     data_pspec,
+    make_eval_step,
     make_train_loop,
     make_train_step,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "forward_local",
     "param_pspecs",
     "TrainState",
+    "make_eval_step",
     "make_train_loop",
     "make_train_step",
     "data_pspec",

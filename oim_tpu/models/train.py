@@ -159,6 +159,59 @@ def make_train_loop(
     return jax.jit(loop, donate_argnums=(0,))
 
 
+def _manual_setup(cfg: TransformerConfig, mesh):
+    """(cfg, manual_axes) for a shard_mapped step on ``mesh`` — THE one
+    definition of the manual/auto axis split and the pallas gating, shared
+    by the train and eval builders so they can never compile differently.
+
+    Mosaic (pallas) kernels cannot run inside GSPMD-auto regions: when
+    tp == ep == 1 there is nothing to auto-partition, so every axis goes
+    manual and pallas stays on; with real tp/ep the model falls back to
+    XLA-fused reference ops and tp/ep stay automatic.
+    """
+    from dataclasses import replace as dc_replace
+
+    if mesh.shape["pp"] != cfg.n_stages:
+        raise ValueError(
+            f"mesh pp={mesh.shape['pp']} must equal cfg.n_stages="
+            f"{cfg.n_stages}; otherwise stages would be silently dropped"
+        )
+    fully_manual = mesh.shape["tp"] == 1 and mesh.shape["ep"] == 1
+    cfg = dc_replace(cfg, use_pallas=cfg.use_pallas and fully_manual)
+    manual_axes = (
+        {"dp", "sp", "pp", "tp", "ep"} if fully_manual else {"dp", "sp", "pp"}
+    )
+    return cfg, manual_axes
+
+
+def make_eval_step(cfg: TransformerConfig, mesh):
+    """Jitted forward-only ``(params, tokens) -> ce`` for held-out eval.
+
+    Shares ``_local_loss`` (and therefore the exact masking/normalization
+    the train step optimizes) but takes no grads, updates nothing, and
+    does NOT donate params — the same state is evaluated across batches.
+    Under pp>1 the forward runs the GPipe schedule regardless of
+    ``pp_schedule``: 1F1B exists to overlap the backward, which eval does
+    not have.  Returns the aux-free cross entropy (perplexity = exp(ce)).
+    """
+    cfg, manual_axes = _manual_setup(cfg, mesh)
+
+    def local_eval(params, tokens):
+        _, ce = _local_loss(params, tokens, cfg)
+        return ce
+
+    return jax.jit(
+        jax.shard_map(
+            local_eval,
+            mesh=mesh,
+            in_specs=(manual_pspecs(cfg), data_pspec()),
+            out_specs=P(),
+            axis_names=manual_axes,
+            check_vma=False,
+        )
+    )
+
+
 def _build_train_step(
     cfg: TransformerConfig,
     mesh,
@@ -166,22 +219,7 @@ def _build_train_step(
     learning_rate: float = 3e-4,
 ):
     optimizer = optimizer or optax.adamw(learning_rate)
-    if mesh.shape["pp"] != cfg.n_stages:
-        raise ValueError(
-            f"mesh pp={mesh.shape['pp']} must equal cfg.n_stages="
-            f"{cfg.n_stages}; otherwise stages would be silently dropped"
-        )
-    # Mosaic (pallas) kernels cannot run inside GSPMD-auto regions: when
-    # tp == ep == 1 there is nothing to auto-partition, so every axis goes
-    # manual and pallas stays on; with real tp/ep the model falls back to
-    # XLA-fused reference ops and tp/ep stay automatic.
-    fully_manual = mesh.shape["tp"] == 1 and mesh.shape["ep"] == 1
-    from dataclasses import replace as dc_replace
-
-    cfg = dc_replace(cfg, use_pallas=cfg.use_pallas and fully_manual)
-    manual_axes = (
-        {"dp", "sp", "pp", "tp", "ep"} if fully_manual else {"dp", "sp", "pp"}
-    )
+    cfg, manual_axes = _manual_setup(cfg, mesh)
     manual_specs = manual_pspecs(cfg)
 
     use_1f1b = cfg.pp_schedule == "1f1b" and cfg.n_stages > 1

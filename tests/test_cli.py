@@ -157,3 +157,38 @@ def test_train_main_smoke_and_resume(tmp_path):
     assert second.returncode == 0, second.stderr[-2000:]
     assert "resumed step=4" in second.stderr
     assert "done steps=6" in second.stderr
+
+
+def test_train_main_eval(tmp_path):
+    """Held-out eval: the trainer logs eval_ce/eval_ppl on the interval,
+    and the eval split never overlaps the training stream."""
+    run = subprocess.run(
+        [
+            sys.executable, "-m", "oim_tpu.cli.train_main",
+            "--synthetic", "100000", "--batch-global", "8", "--seq", "32",
+            "--vocab-size", "128", "--d-model", "32", "--n-layers", "2",
+            "--n-heads", "4", "--dtype", "float32", "--dp", "2",
+            "--steps", "4", "--eval-every", "2", "--eval-batches", "2",
+            "--log-every", "2",
+        ],
+        capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=REPO), timeout=300,
+    )
+    assert run.returncode == 0, run.stderr[-2000:]
+    evals = [ln for ln in run.stderr.splitlines() if " eval " in ln]
+    assert len(evals) == 2, run.stderr[-2000:]  # steps 2 and 4
+    assert "eval_ce=" in evals[0] and "eval_ppl=" in evals[0]
+
+    bad = subprocess.run(
+        [
+            sys.executable, "-m", "oim_tpu.cli.train_main",
+            "--synthetic", "1000", "--batch-global", "8", "--seq", "32",
+            "--vocab-size", "128", "--d-model", "32", "--n-layers", "2",
+            "--n-heads", "4", "--dtype", "float32", "--steps", "2",
+            "--eval-every", "1",
+        ],
+        capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=REPO), timeout=300,
+    )
+    assert bad.returncode != 0
+    assert "eval split" in bad.stderr

@@ -473,3 +473,45 @@ class TestTopKRouting:
         out = generate(params, prompt, cfg, max_new_tokens=5)
         assert out.shape == (2, 11)
         assert np.asarray(out).max() < cfg.vocab_size
+
+
+class TestEvalStep:
+    def test_eval_ce_matches_train_metric_pre_update(self):
+        """The eval step on the SAME params and batch must report exactly
+        the ce the train step computed before applying its update — they
+        share _local_loss."""
+        from oim_tpu.models import make_eval_step
+
+        cfg = TransformerConfig(**TINY)
+        mesh = build_mesh(dp=2, sp=2, devices=jax.devices()[:4])
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        optimizer = optax.adamw(1e-2)
+        state = shard_state(TrainState.create(params, optimizer), cfg, mesh)
+        tokens = jax.device_put(
+            _data(8, 16, cfg.vocab_size),
+            jax.sharding.NamedSharding(mesh, data_pspec()),
+        )
+        eval_step = make_eval_step(cfg, mesh)
+        eval_ce = float(eval_step(state.params, tokens))
+        step_fn = make_train_step(cfg, mesh, optimizer)
+        _, metrics = step_fn(state, tokens)
+        assert eval_ce == pytest.approx(float(metrics["ce"]), rel=1e-6)
+
+    def test_eval_under_pp(self):
+        from oim_tpu.models import make_eval_step
+
+        cfg = TransformerConfig(
+            **{**TINY, "n_layers": 4}, n_stages=2, n_microbatches=2,
+        )
+        mesh = build_mesh(dp=2, pp=2, devices=jax.devices()[:4])
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        state = shard_state(
+            TrainState.create(params, optax.sgd(1e-2)), cfg, mesh
+        )
+        eval_step = make_eval_step(cfg, mesh)
+        tokens = jax.device_put(
+            _data(8, 16, cfg.vocab_size),
+            jax.sharding.NamedSharding(mesh, data_pspec()),
+        )
+        ce = float(eval_step(state.params, tokens))
+        assert np.isfinite(ce) and ce > 0
