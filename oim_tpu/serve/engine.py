@@ -401,6 +401,7 @@ class Engine:
         self._results: dict[int, list[int]] = {}
         self._events: dict[int, threading.Event] = {}
         self._errors: dict[int, str] = {}
+        self._callbacks: dict[int, object] = {}  # rid → on_token
         self._forgotten: set[int] = set()
         self._next_rid = 0
         self._step_count = 0
@@ -476,7 +477,12 @@ class Engine:
                 f"{bad[:5]}"
             )
 
-    def submit(self, req: GenRequest) -> int:
+    def submit(self, req: GenRequest, on_token=None) -> int:
+        """Queue a request; returns its id.  ``on_token`` (optional)
+        streams the generation: called once per emitted token, in order,
+        then once with ``None`` as end-of-stream (completion OR abort).
+        Callbacks run on the engine driver thread and must not block —
+        hand off to a queue (the HTTP streaming handler's pattern)."""
         try:
             self._validate(req)
         except ValueError:
@@ -488,6 +494,8 @@ class Engine:
             self._next_rid += 1
             self._queue.append((rid, req, time.monotonic()))
             self._events[rid] = threading.Event()
+            if on_token is not None:
+                self._callbacks[rid] = on_token
             self._m_queued.set(float(len(self._queue)), self._engine_label)
         return rid
 
@@ -524,11 +532,13 @@ class Engine:
                 self._errors.pop(rid, None)
             elif rid in self._events:
                 self._forgotten.add(rid)
+            self._callbacks.pop(rid, None)  # streaming consumer left
 
     def abort(self, message: str) -> None:
         """Fail every queued and in-flight request (the server's driver
         thread calls this when ``step`` raises, so blocked ``result()``
         callers get a RuntimeError instead of waiting out their timeout)."""
+        ended = []
         with self._lock:
             pending = [rid for rid, _, _ in self._queue]
             pending += [s.rid for s in self._slots.values()]
@@ -538,6 +548,9 @@ class Engine:
             for rid in pending:
                 if not self._warming:
                     self._m_requests.inc("aborted")
+                cb = self._callbacks.pop(rid, None)
+                if cb is not None:
+                    ended.append(cb)
                 if rid in self._forgotten:
                     self._forgotten.discard(rid)
                     self._events.pop(rid, None)
@@ -547,6 +560,8 @@ class Engine:
                     self._events[rid].set()
             self._m_active.set(0.0, self._engine_label)
             self._m_queued.set(0.0, self._engine_label)
+        for cb in ended:  # end-of-stream for streaming consumers
+            cb(None)
 
     # -- engine loop (one driver thread) ----------------------------------
 
@@ -626,11 +641,20 @@ class Engine:
             token = int(first)
             self.tokens_generated += 1
             with self._lock:
-                if self._emit(state, token):
+                done = self._emit(state, token)
+                if done:
                     self._finish(slot, state)
                 else:
                     self._slots[slot] = state
                     self._m_active.set(float(len(self._slots)), self._engine_label)
+                cb = (
+                    self._callbacks.pop(rid, None) if done
+                    else self._callbacks.get(rid)
+                )
+            if cb is not None:  # stream outside the lock
+                cb(token)
+                if done:
+                    cb(None)
 
         with self._lock:
             if not self._slots:
@@ -669,16 +693,30 @@ class Engine:
         out = jax.device_get(out)  # ONE readback per chunk
         self._step_count += 1
         self._m_dispatches.inc()
+        notices = []  # (callback, tokens..., end?) fired outside the lock
         with self._lock:
             for slot, state in list(slots.items()):
                 done = False
+                fresh = []
                 for token in out[slot]:
                     self.tokens_generated += 1
+                    fresh.append(int(token))
                     if self._emit(state, int(token)):
                         done = True
                         break
+                cb = (
+                    self._callbacks.pop(state.rid, None) if done
+                    else self._callbacks.get(state.rid)
+                )
+                if cb is not None:
+                    notices.append((cb, fresh, done))
                 if done and slot in self._slots:
                     self._finish(slot, state)
+        for cb, fresh, done in notices:
+            for token in fresh:
+                cb(token)
+            if done:
+                cb(None)
 
     def run(self) -> dict[int, list[int]]:
         """Drain the queue and all active slots; returns {rid: tokens}."""

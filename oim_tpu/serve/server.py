@@ -7,10 +7,16 @@ background driver thread so concurrent requests batch onto slots.
 
 API:
   POST /v1/generate   {"tokens": [int...], "max_new_tokens": N,
-                       "temperature": 0.0, "seed": 0, "eos_id": null}
+                       "temperature": 0.0, "seed": 0, "eos_id": null,
+                       "stream": false}
                     → {"tokens": [int...]}   (generated only, EOS included)
+                    With "stream": true the response is NDJSON, one
+                    {"token": t} line per generated token as it decodes
+                    (tokens arrive in chunk-sized bursts), terminated by
+                    {"done": true, "tokens": [...]} or {"error": ...}.
   GET  /healthz      → {"ok": true}
   GET  /v1/stats     → engine stats (slots, queue depth, tokens generated)
+  GET  /metrics      → Prometheus exposition (shared registry)
 
 The engine is tokenizer-agnostic by design — clients speak token ids, the
 same boundary the CSI driver keeps by speaking device paths rather than
@@ -20,6 +26,7 @@ framework objects.
 from __future__ import annotations
 
 import json
+import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -71,6 +78,56 @@ class ServeServer:
                 else:
                     self._json(404, {"error": f"no such path {self.path}"})
 
+            def _stream(self, req: GenRequest) -> None:
+                """NDJSON token stream: the engine's on_token callback
+                feeds a queue (callbacks must not block the driver
+                thread); this handler drains it onto the socket.  A
+                client that disconnects mid-stream forfeits the result
+                (engine.forget) — generation itself runs to completion."""
+                tokens_q: queue.Queue = queue.Queue()
+                rid = outer.engine.submit(req, on_token=tokens_q.put)
+                try:
+                    # Headers inside the try: wfile is unbuffered, so a
+                    # client that disconnected right away raises HERE —
+                    # the result must still be forgotten, not retained.
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/x-ndjson")
+                    self.end_headers()  # HTTP/1.0: body ends on close
+                    while True:
+                        try:
+                            token = tokens_q.get(timeout=600)
+                        except queue.Empty:
+                            # Same situation the non-stream path answers
+                            # with 503; the protocol promises a
+                            # terminating error line.
+                            outer.engine.forget(rid)
+                            self.wfile.write(
+                                json.dumps(
+                                    {"error": f"request {rid} timed out"}
+                                ).encode() + b"\n"
+                            )
+                            return
+                        if token is None:
+                            break
+                        self.wfile.write(
+                            (json.dumps({"token": token}) + "\n").encode()
+                        )
+                        self.wfile.flush()
+                    try:
+                        tokens = outer.engine.result(rid, timeout=30)
+                        self.wfile.write(
+                            json.dumps(
+                                {"done": True, "tokens": tokens}
+                            ).encode() + b"\n"
+                        )
+                    except (RuntimeError, TimeoutError) as exc:
+                        outer.engine.forget(rid)
+                        self.wfile.write(
+                            json.dumps({"error": str(exc)}).encode() + b"\n"
+                        )
+                except (BrokenPipeError, ConnectionResetError):
+                    outer.engine.forget(rid)
+
             def do_POST(self):
                 if self.path != "/v1/generate":
                     self._json(404, {"error": f"no such path {self.path}"})
@@ -93,6 +150,9 @@ class ServeServer:
                             else None
                         ),
                     )
+                    if body.get("stream"):
+                        self._stream(req)
+                        return
                     rid = outer.engine.submit(req)
                 except (KeyError, TypeError, ValueError) as exc:
                     self._json(400, {"error": str(exc)})

@@ -298,6 +298,68 @@ def test_server_survives_driver_crash(setup):
         server.stop()
 
 
+def test_streaming_callback(setup):
+    """on_token streams every token in order, then a None sentinel; the
+    stream equals the final result."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+    streamed: list = []
+    tokens = _prompt(41, 6, cfg.vocab_size)
+    rid = engine.submit(
+        GenRequest(tokens=tokens, max_new_tokens=9), on_token=streamed.append
+    )
+    results = engine.run()
+    assert streamed[-1] is None
+    assert streamed[:-1] == results[rid] == _oracle(params, cfg, tokens, 9)
+
+
+def test_streaming_eos_and_abort_end_stream(setup):
+    cfg, params = setup
+    tokens = _prompt(5, 6, cfg.vocab_size)
+    full = _oracle(params, cfg, tokens, 12)
+    eos = full[3]
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=4)
+    streamed: list = []
+    engine.submit(
+        GenRequest(tokens=tokens, max_new_tokens=12, eos_id=eos),
+        on_token=streamed.append,
+    )
+    engine.run()
+    assert streamed[-1] is None
+    assert streamed[:-1] == full[: full.index(eos) + 1]
+    # Abort ends a queued stream with just the sentinel.
+    engine2 = Engine(params, cfg, n_slots=1, max_len=64, chunk=4)
+    streamed2: list = []
+    engine2.submit(
+        GenRequest(tokens=[1, 2], max_new_tokens=4),
+        on_token=streamed2.append,
+    )
+    engine2.abort("down")
+    assert streamed2 == [None]
+
+
+def test_http_streaming(setup):
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+    server = ServeServer(engine, port=0).start()
+    try:
+        tokens = _prompt(13, 5, cfg.vocab_size)
+        body = json.dumps(
+            {"tokens": tokens, "max_new_tokens": 6, "stream": True}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate", data=body
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers["Content-Type"] == "application/x-ndjson"
+            lines = [json.loads(ln) for ln in r.read().splitlines()]
+        want = _oracle(params, cfg, tokens, 6)
+        assert [ln["token"] for ln in lines[:-1]] == want
+        assert lines[-1] == {"done": True, "tokens": want}
+    finally:
+        server.stop()
+
+
 def test_metrics_instrumented(setup):
     """Engine outcomes land in the shared Prometheus registry."""
     from oim_tpu.common import metrics as m
