@@ -10,6 +10,10 @@ except here the store is orbax over a filesystem, sharding-aware and
 async so saves overlap the next train step.
 """
 
-from oim_tpu.checkpoint.manager import Checkpointer, CheckpointerOptions
+from oim_tpu.checkpoint.manager import (
+    Checkpointer,
+    CheckpointerOptions,
+    load_params,
+)
 
-__all__ = ["Checkpointer", "CheckpointerOptions"]
+__all__ = ["Checkpointer", "CheckpointerOptions", "load_params"]

@@ -28,7 +28,12 @@ import jax
 import orbax.checkpoint as ocp
 
 from oim_tpu import log
-from oim_tpu.models.train import TrainState, shard_state, state_shardings
+from oim_tpu.models.train import (
+    TrainState,
+    params_shardings,
+    shard_state,
+    state_shardings,
+)
 
 
 @dataclass(frozen=True)
@@ -146,6 +151,78 @@ class Checkpointer:
         state = shard_state(init_fn(), self._cfg, self._mesh)
         return state, None, False
 
+    def restore_params(
+        self, init_params_fn: Callable[[], dict], step: int | None = None
+    ) -> dict:
+        """Restore just the ``params`` subtree of a training checkpoint.
+
+        Serving needs the weights but neither has nor wants the optimizer
+        state — whose tree shape depends on the trainer's optimizer flags
+        (schedule, grad-clip chain), so a stand-in optimizer cannot
+        reconstruct it.  A partial PyTree restore sidesteps that whole
+        coupling.  ``init_params_fn`` is only traced for shapes/dtypes.
+        """
+        if step is None:
+            step = self._mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError("no checkpoint to restore")
+        abstract = {"params": self._abstract_params(init_params_fn)}
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                **{
+                    self.STATE: ocp.args.PyTreeRestore(
+                        item=abstract,
+                        # PyTreeRestore (unlike StandardRestore) does not
+                        # read ShapeDtypeStruct.sharding — without these
+                        # it falls back to the training topology's
+                        # sharding file.
+                        restore_args=ocp.checkpoint_utils.construct_restore_args(
+                            abstract
+                        ),
+                        partial_restore=True,
+                    )
+                }
+            ),
+        )
+        log.current().info("checkpoint params restored", step=step)
+        return restored[self.STATE]["params"]
+
+    def _abstract_params(self, init_params_fn: Callable[[], dict]) -> dict:
+        """ShapeDtypeStructs with THIS mesh's shardings attached — without
+        them orbax falls back to the sharding file saved by the *training*
+        topology, which is unsafe when restoring elsewhere."""
+        shape = jax.eval_shape(init_params_fn)
+        shardings = params_shardings(shape, self._cfg, self._mesh)
+        return jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            shape,
+            shardings,
+        )
+
+    # -- params-only export (serving) ---------------------------------------
+
+    def export_params(self, state: TrainState, directory) -> None:
+        """One-shot params-only export for serving.
+
+        The training checkpoint carries the optimizer state — for adamw,
+        2 extra copies of every parameter — which an inference server
+        never reads.  This writes just ``state.params`` (a standalone
+        orbax StandardSave, restored by ``load_params``), synchronously.
+        Params are passed as-is so orbax performs the sharded/collective
+        save on multi-host meshes (no host gather).  Refuses to overwrite
+        an existing export.
+        """
+        import os
+
+        if os.path.exists(os.fspath(directory)):
+            raise FileExistsError(
+                f"params export target exists: {directory}"
+            )
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(directory, state.params)
+        log.current().info("params exported", dir=str(directory))
+
     # -- lifecycle ----------------------------------------------------------
 
     def wait(self) -> None:
@@ -161,3 +238,26 @@ class Checkpointer:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def load_params(directory, abstract_params, cfg=None, mesh=None) -> dict:
+    """Restore a params-only export (``Checkpointer.export_params``).
+
+    ``abstract_params`` is the target pytree of ShapeDtypeStructs (e.g.
+    ``jax.eval_shape(lambda: init_params(key, cfg))``) or a concrete
+    pytree of the same structure.  Pass ``cfg`` and ``mesh`` to attach
+    this host's shardings to the target — without them orbax falls back
+    to the sharding file written by the exporting topology, which is
+    unsafe when restoring on a different one.
+    """
+    if cfg is not None and mesh is not None:
+        shardings = params_shardings(abstract_params, cfg, mesh)
+        abstract_params = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            abstract_params,
+            shardings,
+        )
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(directory, target=abstract_params)
+    log.current().info("params restored", dir=str(directory))
+    return restored

@@ -45,6 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--checkpoint-dir", default="",
         help="orbax checkpoint dir from oim-train (empty = random init)",
     )
+    p.add_argument(
+        "--params-dir", default="",
+        help="params-only export from oim-train --export-dir (loads a "
+        "third of the checkpoint bytes: no optimizer state)",
+    )
     # Engine shape.
     p.add_argument("--n-slots", type=int, default=8)
     p.add_argument("--max-len", type=int, default=1024)
@@ -87,22 +92,34 @@ def make_engine(args):
         moe_top_k=args.moe_top_k,
         dtype=args.dtype,
     )
-    params = init_params(jax.random.PRNGKey(0), cfg)
-    if args.checkpoint_dir:
-        import optax
+    if args.checkpoint_dir and args.params_dir:
+        raise SystemExit("--checkpoint-dir and --params-dir are exclusive")
+    if args.params_dir or args.checkpoint_dir:
+        from oim_tpu.parallel import build_mesh
 
-        from oim_tpu.checkpoint import Checkpointer
-        from oim_tpu.models.train import TrainState
-
-        with Checkpointer(args.checkpoint_dir) as ckpt:
-            state, _ = ckpt.restore_or_init(
-                lambda: TrainState.create(params, optax.sgd(1e-3))
-            )
-        params = state.params
-        log.current().info(
-            "checkpoint restored", dir=args.checkpoint_dir,
-            step=int(state.step),
+        # Shape/dtype template only — restoring immediately replaces it,
+        # so never materialize a full random init.
+        template = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), cfg)
         )
+        mesh = build_mesh(devices=jax.devices()[:1])
+        if args.params_dir:
+            from oim_tpu.checkpoint import load_params
+
+            params = load_params(args.params_dir, template, cfg, mesh)
+        else:
+            from oim_tpu.checkpoint import Checkpointer
+
+            with Checkpointer(args.checkpoint_dir, cfg, mesh) as ckpt:
+                # Partial restore of the params subtree only: the
+                # optimizer state's tree shape depends on the trainer's
+                # flags, which the server neither has nor needs.  A
+                # missing checkpoint fails loudly (FileNotFoundError) —
+                # a serving daemon must never silently serve random
+                # weights.
+                params = ckpt.restore_params(lambda: template)
+    else:
+        params = init_params(jax.random.PRNGKey(0), cfg)
     return Engine(
         params,
         cfg,

@@ -79,6 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--grad-clip", type=float, default=0.0,
         help="global-norm gradient clip (0 = off)",
     )
+    p.add_argument(
+        "--export-dir", default="",
+        help="after training, export params-only (no optimizer state) "
+        "for oim-serve --params-dir",
+    )
     # Held-out evaluation: the corpus tail is split off for validation.
     p.add_argument(
         "--eval-every", type=_nonneg_int, default=0,
@@ -134,6 +139,10 @@ def _load_corpus(args) -> np.ndarray:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     log.init_from_string(args.log_level)
+    if args.export_dir and not args.checkpoint_dir:
+        # Validate up front — discovering this after hours of training
+        # (or masking a mid-run exception from inside finally) is not ok.
+        raise SystemExit("--export-dir requires --checkpoint-dir")
 
     import jax
 
@@ -368,6 +377,18 @@ def main(argv=None) -> int:
                 alive = bool(leaves) and not leaves[0].is_deleted()
                 if alive and checkpointer.latest_step() != step:
                     checkpointer.save(state, {"next_step": step}, force=True)
+                if alive and args.export_dir and step >= args.steps:
+                    # Completed runs only: a crash mid-train must not
+                    # leave partial weights at the export path.  An
+                    # existing export means a prior completed run already
+                    # wrote it (orbax renames atomically): re-running the
+                    # same command must stay idempotent, not crashloop.
+                    if os.path.exists(args.export_dir):
+                        log.current().info(
+                            "export exists; skipping", dir=args.export_dir
+                        )
+                    else:
+                        checkpointer.export_params(state, args.export_dir)
             finally:
                 checkpointer.close()  # always await queued async saves
     log.current().info("done", steps=step)

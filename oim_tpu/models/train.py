@@ -346,6 +346,13 @@ def _build_train_step(
     return train_step
 
 
+def params_shardings(params: dict, cfg: TransformerConfig, mesh) -> dict:
+    """NamedShardings for a params dict by its logical axes — usable as a
+    restore target annotation (``params`` may be concrete or abstract)."""
+    pspecs = param_pspecs(cfg)
+    return {name: NamedSharding(mesh, pspecs[name]) for name in params}
+
+
 def state_shardings(state, cfg: TransformerConfig, mesh) -> TrainState:
     """A TrainState-shaped pytree of NamedShardings: params by their logical
     axes, optimizer moments mirroring the params (optax states are nested
@@ -353,12 +360,11 @@ def state_shardings(state, cfg: TransformerConfig, mesh) -> TrainState:
     same specs apply), everything else replicated.  ``state`` may be concrete
     or a ``jax.eval_shape`` pytree of ShapeDtypeStructs — only the tree
     structure is inspected."""
-    pspecs = param_pspecs(cfg)
     param_names = set(state.params.keys())
     replicated = NamedSharding(mesh, P())
 
     def spec_params(tree: dict) -> dict:
-        return {name: NamedSharding(mesh, pspecs[name]) for name in tree}
+        return params_shardings(tree, cfg, mesh)
 
     def mirror(node):
         if isinstance(node, dict) and set(node.keys()) == param_names:

@@ -192,3 +192,65 @@ def test_train_main_eval(tmp_path):
     )
     assert bad.returncode != 0
     assert "eval split" in bad.stderr
+
+
+def test_train_export_then_serve(tmp_path):
+    """The full workflow: train with --export-dir, then build the serving
+    engine from BOTH the full checkpoint and the params-only export —
+    identical generations (and the export is smaller on disk)."""
+    ckpt, export = str(tmp_path / "ckpt"), str(tmp_path / "params")
+    geometry = [
+        "--vocab-size", "128", "--d-model", "32", "--n-layers", "2",
+        "--n-heads", "4", "--dtype", "float32",
+    ]
+    run = subprocess.run(
+        [sys.executable, "-m", "oim_tpu.cli.train_main", "--synthetic",
+         "100000", "--steps", "3", "--dp", "2", "--save-every", "3",
+         "--batch-global", "8", "--seq", "32",
+         "--checkpoint-dir", ckpt, "--export-dir", export] + geometry,
+        capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=REPO), timeout=300,
+    )
+    assert run.returncode == 0, run.stderr[-2000:]
+    assert "params exported" in run.stderr
+
+    # Idempotent re-run: resumes at the final step, skips the existing
+    # export instead of crashing (the trainer's restart contract).
+    rerun = subprocess.run(
+        [sys.executable, "-m", "oim_tpu.cli.train_main", "--synthetic",
+         "100000", "--steps", "3", "--dp", "2", "--save-every", "3",
+         "--batch-global", "8", "--seq", "32",
+         "--checkpoint-dir", ckpt, "--export-dir", export] + geometry,
+        capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=REPO), timeout=300,
+    )
+    assert rerun.returncode == 0, rerun.stderr[-2000:]
+    assert "export exists; skipping" in rerun.stderr
+
+    def du(path):
+        total = 0
+        for root, _, files in os.walk(path):
+            total += sum(os.path.getsize(os.path.join(root, f)) for f in files)
+        return total
+
+    assert du(export) < du(ckpt) * 0.6, (du(export), du(ckpt))
+
+    from oim_tpu.cli.serve_main import build_parser, make_engine
+    from oim_tpu.serve import GenRequest
+
+    outs = []
+    for flags in (["--checkpoint-dir", ckpt], ["--params-dir", export]):
+        args = build_parser().parse_args(
+            geometry + ["--max-len", "64", "--n-slots", "1"] + flags
+        )
+        engine = make_engine(args)
+        rid = engine.submit(GenRequest(tokens=[3, 1, 4], max_new_tokens=6))
+        outs.append(engine.run()[rid])
+    assert outs[0] == outs[1], outs
+
+    # A missing checkpoint must refuse to serve, not serve random weights.
+    args = build_parser().parse_args(
+        geometry + ["--checkpoint-dir", str(tmp_path / "nope")]
+    )
+    with pytest.raises(FileNotFoundError):
+        make_engine(args)
