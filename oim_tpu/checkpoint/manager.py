@@ -43,6 +43,17 @@ class CheckpointerOptions:
     async_save: bool = True
 
 
+def _attach_shardings(abstract, cfg, mesh):
+    """ShapeDtypeStructs with NamedShardings attached — the one
+    definition of a sharding-annotated restore target."""
+    shardings = params_shardings(abstract, cfg, mesh)
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        abstract,
+        shardings,
+    )
+
+
 class Checkpointer:
     """Save/restore TrainState on a mesh, with an optional JSON side-car
     for data-iterator state."""
@@ -192,12 +203,8 @@ class Checkpointer:
         """ShapeDtypeStructs with THIS mesh's shardings attached — without
         them orbax falls back to the sharding file saved by the *training*
         topology, which is unsafe when restoring elsewhere."""
-        shape = jax.eval_shape(init_params_fn)
-        shardings = params_shardings(shape, self._cfg, self._mesh)
-        return jax.tree.map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-            shape,
-            shardings,
+        return _attach_shardings(
+            jax.eval_shape(init_params_fn), self._cfg, self._mesh
         )
 
     # -- params-only export (serving) ---------------------------------------
@@ -250,13 +257,10 @@ def load_params(directory, abstract_params, cfg=None, mesh=None) -> dict:
     to the sharding file written by the exporting topology, which is
     unsafe when restoring on a different one.
     """
-    if cfg is not None and mesh is not None:
-        shardings = params_shardings(abstract_params, cfg, mesh)
-        abstract_params = jax.tree.map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-            abstract_params,
-            shardings,
-        )
+    if (cfg is None) != (mesh is None):
+        raise ValueError("pass both cfg and mesh, or neither")
+    if cfg is not None:
+        abstract_params = _attach_shardings(abstract_params, cfg, mesh)
     with ocp.StandardCheckpointer() as ckptr:
         restored = ckptr.restore(directory, target=abstract_params)
     log.current().info("params restored", dir=str(directory))

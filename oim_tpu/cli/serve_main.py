@@ -66,6 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="tpu-bootstrap.json path (default: $TPU_BOOTSTRAP when set)",
     )
     p.add_argument(
+        "--trace-file", default="",
+        help="append spans as JSON lines (default: $OIM_TRACE_FILE)",
+    )
+    p.add_argument(
         "--no-warmup", action="store_true",
         help="skip pre-compiling admit buckets + decode (first live "
         "requests then pay the 20-40s TPU compiles)",
@@ -110,6 +114,13 @@ def make_engine(args):
         else:
             from oim_tpu.checkpoint import Checkpointer
 
+            # Pre-check: CheckpointManager mkdirs its directory, and a
+            # typo'd path must not leave a plausible-looking empty
+            # checkpoint dir behind (or hit mkdir on a read-only fs).
+            if not os.path.isdir(args.checkpoint_dir):
+                raise FileNotFoundError(
+                    f"no checkpoint directory at {args.checkpoint_dir}"
+                )
             with Checkpointer(args.checkpoint_dir, cfg, mesh) as ckpt:
                 # Partial restore of the params subtree only: the
                 # optimizer state's tree shape depends on the trainer's
@@ -135,6 +146,9 @@ def make_engine(args):
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     log.init_from_string(args.log_level)
+    from oim_tpu.common import tracing
+
+    tracing.init("oim-serve", args.trace_file or None)
 
     bootstrap_path = args.bootstrap or os.environ.get("TPU_BOOTSTRAP", "")
     if bootstrap_path:

@@ -31,7 +31,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from oim_tpu.common import metrics
+from oim_tpu.common import metrics, tracing
 from oim_tpu.serve.engine import Engine, GenRequest
 
 
@@ -78,7 +78,7 @@ class ServeServer:
                 else:
                     self._json(404, {"error": f"no such path {self.path}"})
 
-            def _stream(self, req: GenRequest) -> None:
+            def _stream(self, req: GenRequest, span) -> None:
                 """NDJSON token stream: the engine's on_token callback
                 feeds a queue (callbacks must not block the driver
                 thread); this handler drains it onto the socket.  A
@@ -101,6 +101,7 @@ class ServeServer:
                             # with 503; the protocol promises a
                             # terminating error line.
                             outer.engine.forget(rid)
+                            span.status = "error: timeout"
                             self.wfile.write(
                                 json.dumps(
                                     {"error": f"request {rid} timed out"}
@@ -115,6 +116,7 @@ class ServeServer:
                         self.wfile.flush()
                     try:
                         tokens = outer.engine.result(rid, timeout=30)
+                        span.attrs["generated"] = len(tokens)
                         self.wfile.write(
                             json.dumps(
                                 {"done": True, "tokens": tokens}
@@ -122,11 +124,13 @@ class ServeServer:
                         )
                     except (RuntimeError, TimeoutError) as exc:
                         outer.engine.forget(rid)
+                        span.status = "error: aborted"
                         self.wfile.write(
                             json.dumps({"error": str(exc)}).encode() + b"\n"
                         )
                 except (BrokenPipeError, ConnectionResetError):
                     outer.engine.forget(rid)
+                    span.status = "error: client disconnected"
 
             def do_POST(self):
                 if self.path != "/v1/generate":
@@ -136,6 +140,18 @@ class ServeServer:
                     # No driver thread left to serve it; fail fast.
                     self._json(503, {"error": outer.error})
                     return
+                # Join the caller's W3C trace (the same propagation the
+                # gRPC control plane does via metadata): a workload that
+                # traced CSI staging can trace its generations too.
+                parent = tracing.parse_traceparent(
+                    self.headers.get("traceparent", "")
+                )
+                with tracing.start_span(
+                    "serve.generate", component="oim-serve", parent=parent,
+                ) as span:
+                    self._generate(span)
+
+            def _generate(self, span) -> None:
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                     body = json.loads(self.rfile.read(length) or b"{}")
@@ -150,11 +166,17 @@ class ServeServer:
                             else None
                         ),
                     )
+                    span.attrs.update(
+                        prompt_tokens=len(req.tokens),
+                        max_new_tokens=req.max_new_tokens,
+                        stream=bool(body.get("stream")),
+                    )
                     if body.get("stream"):
-                        self._stream(req)
+                        self._stream(req, span)
                         return
                     rid = outer.engine.submit(req)
                 except (KeyError, TypeError, ValueError) as exc:
+                    span.status = "error: bad request"
                     self._json(400, {"error": str(exc)})
                     return
                 try:
@@ -164,12 +186,26 @@ class ServeServer:
                     # the result whenever it does complete — a flaky client
                     # must not grow the daemon's memory.
                     outer.engine.forget(rid)
+                    span.status = "error: timeout"
                     self._json(503, {"error": f"request {rid} timed out"})
                     return
                 except RuntimeError as exc:  # aborted: driver thread died
+                    span.status = "error: aborted"
                     self._json(500, {"error": str(exc)})
                     return
-                self._json(200, {"tokens": tokens, "request_id": rid})
+                span.attrs["generated"] = len(tokens)
+                self._json(
+                    200,
+                    {
+                        "tokens": tokens,
+                        "request_id": rid,
+                        # Echo the span so callers can correlate this
+                        # generation in the merged trace (oimctl trace).
+                        "traceparent": tracing.SpanContext(
+                            span.trace_id, span.span_id
+                        ).traceparent(),
+                    },
+                )
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]

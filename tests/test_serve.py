@@ -7,6 +7,7 @@ same prompt alone.  Greedy float32 comparisons are exact (per-row math is
 identical; only the batch packing differs)."""
 
 import json
+import time
 import urllib.request
 
 import jax
@@ -458,3 +459,58 @@ def test_serve_main_builds_engine(setup):
     engine = make_engine(args)
     rid = engine.submit(GenRequest(tokens=[1, 2, 3], max_new_tokens=4))
     assert len(engine.run()[rid]) == 4
+
+
+def test_tracing_spans(setup):
+    """A generate request joins the caller's W3C trace and records a span
+    with request attrs; the response echoes its traceparent."""
+    from oim_tpu.common import tracing
+
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=4)
+    server = ServeServer(engine, port=0).start()
+    collector = tracing.init("test-serve")
+    try:
+        parent = tracing.SpanContext("ab" * 16, "cd" * 8)
+        body = json.dumps({"tokens": [1, 2, 3], "max_new_tokens": 4}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate", data=body,
+            headers={"traceparent": parent.traceparent()},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            payload = json.load(r)
+
+        def spans_named(name, want):
+            # start_span records in its finally AFTER the response bytes
+            # hit the socket — poll briefly instead of racing the handler.
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                found = [s for s in collector.spans() if s.name == name]
+                if len(found) >= want:
+                    return found
+                time.sleep(0.01)
+            return [s for s in collector.spans() if s.name == name]
+
+        spans = spans_named("serve.generate", 1)
+        assert len(spans) == 1
+        span = spans[0]
+        assert span.trace_id == parent.trace_id  # joined the caller trace
+        assert span.parent_id == parent.span_id
+        assert span.attrs["prompt_tokens"] == 3
+        assert span.attrs["generated"] == 4
+        assert payload["traceparent"] == (
+            f"00-{span.trace_id}-{span.span_id}-01"
+        )
+        # Bad request still records an error-status span.
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate",
+            data=b'{"tokens": []}',
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad, timeout=10)
+        spans = spans_named("serve.generate", 2)
+        errors = [s for s in spans if s.status.startswith("error")]
+        assert errors and errors[-1].status == "error: bad request"
+    finally:
+        server.stop()
+        tracing.init("")  # reset global collector for other tests
