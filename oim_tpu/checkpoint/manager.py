@@ -41,6 +41,10 @@ class CheckpointerOptions:
     max_to_keep: int = 3
     save_interval_steps: int = 1
     async_save: bool = True
+    # False = read-only open (serving): never mkdir the directory, so a
+    # typo'd path cannot leave a plausible-looking empty checkpoint dir
+    # (and read-only filesystems don't hit a confusing mkdir error).
+    create: bool = True
 
 
 def _attach_shardings(abstract, cfg, mesh):
@@ -77,7 +81,7 @@ class Checkpointer:
                 max_to_keep=self._options.max_to_keep,
                 save_interval_steps=self._options.save_interval_steps,
                 enable_async_checkpointing=self._options.async_save,
-                create=True,
+                create=self._options.create,
             ),
         )
 

@@ -112,16 +112,15 @@ def make_engine(args):
 
             params = load_params(args.params_dir, template, cfg, mesh)
         else:
-            from oim_tpu.checkpoint import Checkpointer
+            from oim_tpu.checkpoint import Checkpointer, CheckpointerOptions
 
-            # Pre-check: CheckpointManager mkdirs its directory, and a
-            # typo'd path must not leave a plausible-looking empty
-            # checkpoint dir behind (or hit mkdir on a read-only fs).
-            if not os.path.isdir(args.checkpoint_dir):
-                raise FileNotFoundError(
-                    f"no checkpoint directory at {args.checkpoint_dir}"
-                )
-            with Checkpointer(args.checkpoint_dir, cfg, mesh) as ckpt:
+            # Read-only open (create=False): a typo'd path must not leave
+            # a plausible-looking empty checkpoint dir behind, and remote
+            # stores (gs://...) stay supported — orbax resolves the path.
+            with Checkpointer(
+                args.checkpoint_dir, cfg, mesh,
+                options=CheckpointerOptions(create=False),
+            ) as ckpt:
                 # Partial restore of the params subtree only: the
                 # optimizer state's tree shape depends on the trainer's
                 # flags, which the server neither has nor needs.  A

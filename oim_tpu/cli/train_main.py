@@ -80,6 +80,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="global-norm gradient clip (0 = off)",
     )
     p.add_argument(
+        "--grad-accum", type=_positive_int, default=1,
+        help="sequential microbatches averaged per optimizer step "
+        "(peak activation memory / N at the same global batch)",
+    )
+    p.add_argument(
         "--export-dir", default="",
         help="after training, export params-only (no optimizer state) "
         "for oim-serve --params-dir",
@@ -206,6 +211,7 @@ def main(argv=None) -> int:
         moe_top_k=args.moe_top_k,
         n_stages=args.pp,
         n_microbatches=max(args.n_microbatches, 1),
+        grad_accum=args.grad_accum,
         dtype=args.dtype,
         attn_impl=args.attn_impl,
         pp_schedule=args.pp_schedule,

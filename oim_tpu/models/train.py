@@ -330,8 +330,44 @@ def _build_train_step(
         check_vma=False,
     )
 
+    def value_and_grad_accum(params, tokens):
+        """Split the batch into ``grad_accum`` sequential microbatches and
+        average their grads — same math as the full batch (equal splits ⇒
+        equal per-microbatch label counts), peak activation memory ÷ N.
+        The scan re-runs the whole sharded fwd+bwd per microbatch, so the
+        only extra live memory is one grads-sized accumulator."""
+        accum = cfg.grad_accum
+        if accum == 1:
+            return sharded_vag(params, tokens)
+        b = tokens.shape[0]
+        if b % accum:
+            raise ValueError(
+                f"global batch {b} not divisible by grad_accum={accum}"
+            )
+        micro = tokens.reshape(accum, b // accum, *tokens.shape[1:])
+
+        def acc(carry, mtok):
+            loss_a, ce_a, grads_a = carry
+            loss, ce, grads = sharded_vag(params, mtok)
+            return (
+                loss_a + loss,
+                ce_a + ce,
+                jax.tree.map(jnp.add, grads_a, grads),
+            ), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (loss, ce, grads), _ = jax.lax.scan(
+            acc, (jnp.zeros(()), jnp.zeros(()), zeros), micro
+        )
+        scale = 1.0 / accum
+        return (
+            loss * scale,
+            ce * scale,
+            jax.tree.map(lambda g: g * scale, grads),
+        )
+
     def train_step(state: TrainState, tokens: jax.Array):
-        loss, ce, grads = sharded_vag(state.params, tokens)
+        loss, ce, grads = value_and_grad_accum(state.params, tokens)
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )

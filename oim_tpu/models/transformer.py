@@ -63,6 +63,13 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     n_stages: int = 1  # pipeline stages; must divide n_layers
     n_microbatches: int = 1
+    # Gradient accumulation: the per-device batch is split into this many
+    # sequential microbatches whose grads are averaged before the single
+    # optimizer update — same math as the full batch (equal splits, equal
+    # per-microbatch label counts), peak activation memory divided by N.
+    # Orthogonal to pp's n_microbatches (which pipelines within one
+    # forward/backward).
+    grad_accum: int = 1
     dtype: str = "bfloat16"  # activation/compute dtype
     param_dtype: str = "float32"
     remat: bool = True
@@ -99,6 +106,8 @@ class TransformerConfig:
                 f"n_kv_heads={self.n_kv_heads} must be a positive divisor "
                 f"of n_heads={self.n_heads}"
             )
+        if self.grad_accum < 1:
+            raise ValueError(f"grad_accum={self.grad_accum} must be >= 1")
         if self.moe_top_k < 1 or (
             self.n_experts and self.moe_top_k > self.n_experts
         ):

@@ -92,6 +92,15 @@ class ServeServer:
                     # the result must still be forgotten, not retained.
                     self.send_response(200)
                     self.send_header("Content-Type", "application/x-ndjson")
+                    # Echo the span as a header (the non-stream path puts
+                    # it in the JSON body) so streaming callers can
+                    # correlate in the merged trace too.
+                    self.send_header(
+                        "traceparent",
+                        tracing.SpanContext(
+                            span.trace_id, span.span_id
+                        ).traceparent(),
+                    )
                     self.end_headers()  # HTTP/1.0: body ends on close
                     while True:
                         try:

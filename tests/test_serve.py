@@ -353,6 +353,7 @@ def test_http_streaming(setup):
         )
         with urllib.request.urlopen(req, timeout=120) as r:
             assert r.headers["Content-Type"] == "application/x-ndjson"
+            assert r.headers["traceparent"].startswith("00-")
             lines = [json.loads(ln) for ln in r.read().splitlines()]
         want = _oracle(params, cfg, tokens, 6)
         assert [ln["token"] for ln in lines[:-1]] == want
