@@ -84,6 +84,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="sequential microbatches averaged per optimizer step "
         "(peak activation memory / N at the same global batch)",
     )
+    # LoRA fine-tuning: freeze a base params export, train adapters only.
+    p.add_argument(
+        "--lora-rank", type=_nonneg_int, default=0,
+        help="low-rank adapter rank over wq/wk/wv/wo (0 = full training)",
+    )
+    p.add_argument("--lora-alpha", type=float, default=16.0)
+    p.add_argument(
+        "--lora-base", default="",
+        help="frozen base weights: a params export (oim-train --export-dir)",
+    )
     p.add_argument(
         "--export-dir", default="",
         help="after training, export params-only (no optimizer state) "
@@ -148,6 +158,12 @@ def main(argv=None) -> int:
         # Validate up front — discovering this after hours of training
         # (or masking a mid-run exception from inside finally) is not ok.
         raise SystemExit("--export-dir requires --checkpoint-dir")
+    if args.lora_rank and not args.lora_base:
+        raise SystemExit("--lora-rank requires --lora-base (a params export)")
+    if args.lora_base and not args.lora_rank:
+        # Silently training from random init while the operator believes
+        # they are fine-tuning the given base would be hours wasted.
+        raise SystemExit("--lora-base requires --lora-rank >= 1")
 
     import jax
 
@@ -245,7 +261,28 @@ def main(argv=None) -> int:
             optax.clip_by_global_norm(args.grad_clip), optimizer
         )
 
+    lora_base = None
+    if args.lora_rank:
+        from oim_tpu.checkpoint import load_params
+        from oim_tpu.models.lora import init_lora
+
+        template = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), cfg)
+        )
+        lora_base = load_params(args.lora_base, template, cfg, mesh)
+        log.current().info(
+            "lora", rank=args.lora_rank, alpha=args.lora_alpha,
+            base=args.lora_base,
+        )
+
     def init_fn() -> TrainState:
+        if args.lora_rank:
+            return TrainState.create(
+                init_lora(
+                    jax.random.PRNGKey(args.seed), cfg, args.lora_rank
+                ),
+                optimizer,
+            )
         return TrainState.create(
             init_params(jax.random.PRNGKey(args.seed), cfg), optimizer
         )
@@ -343,7 +380,15 @@ def main(argv=None) -> int:
             yield batches.batch_at(step)[:, : args.seq]
             step += 1
 
-    step_fn = make_train_step(cfg, mesh, optimizer)
+    if args.lora_rank:
+        from oim_tpu.models.lora import make_lora_train_step
+
+        lora_step = make_lora_train_step(
+            cfg, mesh, optimizer, args.lora_alpha, args.lora_rank
+        )
+        step_fn = lambda state, batch: lora_step(state, lora_base, batch)  # noqa: E731
+    else:
+        step_fn = make_train_step(cfg, mesh, optimizer)
     t0 = time.perf_counter()
     window_tokens = 0
     step = start_step
@@ -363,7 +408,16 @@ def main(argv=None) -> int:
             if eval_fn is not None and (
                 step % args.eval_every == 0 or step == args.steps
             ):
-                ce = eval_fn(state.params)
+                if args.lora_rank:
+                    from oim_tpu.models.lora import merge_lora
+
+                    eval_params = merge_lora(
+                        lora_base, state.params, args.lora_alpha,
+                        args.lora_rank,
+                    )
+                else:
+                    eval_params = state.params
+                ce = eval_fn(eval_params)
                 log.current().info(
                     "eval", step=step, eval_ce=round(ce, 4),
                     eval_ppl=round(float(np.exp(min(ce, 30.0))), 2),
@@ -392,6 +446,22 @@ def main(argv=None) -> int:
                     if os.path.exists(args.export_dir):
                         log.current().info(
                             "export exists; skipping", dir=args.export_dir
+                        )
+                    elif args.lora_rank:
+                        # Export the MERGED weights: serving needs no LoRA
+                        # support, and downstream fine-tunes can re-base.
+                        from oim_tpu.models.lora import merge_lora
+
+                        checkpointer.export_params(
+                            TrainState(
+                                params=merge_lora(
+                                    lora_base, state.params,
+                                    args.lora_alpha, args.lora_rank,
+                                ),
+                                opt_state=None,
+                                step=state.step,
+                            ),
+                            args.export_dir,
                         )
                     else:
                         checkpointer.export_params(state, args.export_dir)

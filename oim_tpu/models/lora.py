@@ -1,0 +1,127 @@
+"""LoRA fine-tuning: low-rank adapters over the attention projections.
+
+Parameter-efficient fine-tune for the flagship transformer (new work —
+the reference is a storage control plane; SURVEY.md §2.3).  Design:
+
+- **Adapters, not forks.**  For each target weight ``W [.., din, dout]``
+  the trainable state is ``A [.., din, r]`` (truncated-normal) and
+  ``B [.., r, dout]`` (zeros — the adapted model starts exactly at the
+  base model).  The effective weight is ``W + (alpha/r)·A@B``.
+- **Merge-then-chain-rule.**  The train step materializes the merged
+  weights and reuses the UNCHANGED full train machinery (shard_map,
+  GPipe/1F1B, ring/ulysses, MoE — everything composes for free), then
+  converts the merged-weight grads to adapter grads analytically:
+  ``dA = s·dW@Bᵀ``, ``dB = s·Aᵀ@dW``.  The merge is one rank-r matmul
+  + add per target per step — negligible next to the forward — and the
+  real LoRA win is kept: the optimizer state (2 extra copies of every
+  weight for adamw) exists only for the adapters.
+- **Tiny checkpoints.**  The training state holds adapters only; the
+  frozen base rides outside.  ``merge_lora`` produces standard params
+  for ``oim-serve`` / ``export_params`` — serving needs no LoRA support.
+
+Targets are the attention projections (wq/wk/wv/wo) — the standard LoRA
+recipe; the mlp/expert weights stay frozen.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from oim_tpu.models.train import TrainState, _build_value_and_grad
+from oim_tpu.models.transformer import TransformerConfig, init_params
+
+LORA_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def init_lora(key: jax.Array, cfg: TransformerConfig, rank: int) -> dict:
+    """Adapter pytree: ``{<target>_a, <target>_b}`` per LoRA target,
+    stacked like the base weights ([n_stages, layers_per_stage, ...]).
+    B starts at zero so step 0 reproduces the base model exactly."""
+    if rank < 1:
+        raise ValueError(f"lora rank must be >= 1, got {rank}")
+    shapes = jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+    adapters = {}
+    keys = iter(jax.random.split(key, len(LORA_TARGETS)))
+    for name in LORA_TARGETS:
+        *lead, din, dout = shapes[name].shape
+        adapters[f"{name}_a"] = (
+            jax.random.truncated_normal(
+                next(keys), -2, 2, (*lead, din, rank), jnp.float32
+            )
+            / math.sqrt(din)
+        )
+        adapters[f"{name}_b"] = jnp.zeros((*lead, rank, dout), jnp.float32)
+    return adapters
+
+
+def merge_lora(params: dict, adapters: dict, alpha: float, rank: int) -> dict:
+    """Standard params with the adapters folded in:
+    ``W + (alpha/rank)·A@B`` per target (everything else passes through).
+    The output serves/exports like any other params pytree."""
+    scale = alpha / rank
+    merged = dict(params)
+    for name in LORA_TARGETS:
+        delta = jnp.einsum(
+            "...dr,...rn->...dn", adapters[f"{name}_a"], adapters[f"{name}_b"]
+        )
+        merged[name] = (params[name] + scale * delta).astype(
+            params[name].dtype
+        )
+    return merged
+
+
+def _adapter_grads(grads_w: dict, adapters: dict, alpha: float, rank: int):
+    """Chain rule from merged-weight grads to adapter grads:
+    W = Wb + s·A@B  ⇒  dL/dA = s·(dL/dW)@Bᵀ, dL/dB = s·Aᵀ@(dL/dW)."""
+    scale = alpha / rank
+    out = {}
+    for name in LORA_TARGETS:
+        dw = grads_w[name].astype(jnp.float32)
+        out[f"{name}_a"] = scale * jnp.einsum(
+            "...dn,...rn->...dr", dw, adapters[f"{name}_b"]
+        )
+        out[f"{name}_b"] = scale * jnp.einsum(
+            "...dr,...dn->...rn", adapters[f"{name}_a"], dw
+        )
+    return out
+
+
+def make_lora_train_step(
+    cfg: TransformerConfig,
+    mesh,
+    optimizer,
+    alpha: float,
+    rank: int,
+):
+    """Jitted ``(state, base_params, tokens) -> (state, metrics)``.
+
+    ``state.params`` are the adapters (the only thing optimized or
+    checkpointed); ``base_params`` stay frozen and undonated.  Internally
+    the full train step runs on the merged weights — every parallelism
+    mix works unchanged — and its weight grads are converted to adapter
+    grads analytically (module docstring).
+    """
+    sharded_vag = _build_value_and_grad(cfg, mesh)
+
+    def lora_step(state: TrainState, base_params, tokens):
+        merged = merge_lora(base_params, state.params, alpha, rank)
+        loss, ce, grads_w = sharded_vag(merged, tokens)
+        grads = _adapter_grads(grads_w, state.params, alpha, rank)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_adapters = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(
+                params=new_adapters,
+                opt_state=new_opt_state,
+                step=state.step + 1,
+            ),
+            {"loss": loss, "ce": ce},
+        )
+
+    return jax.jit(lora_step, donate_argnums=(0,))

@@ -212,13 +212,10 @@ def make_eval_step(cfg: TransformerConfig, mesh):
     )
 
 
-def _build_train_step(
-    cfg: TransformerConfig,
-    mesh,
-    optimizer=None,
-    learning_rate: float = 3e-4,
-):
-    optimizer = optimizer or optax.adamw(learning_rate)
+def _build_value_and_grad(cfg: TransformerConfig, mesh):
+    """``(params, tokens) -> (loss, ce, grads)`` — the sharded forward +
+    backward (GPipe or 1F1B, with gradient accumulation), no optimizer.
+    The seam shared by the standard and LoRA train steps."""
     cfg, manual_axes = _manual_setup(cfg, mesh)
     manual_specs = manual_pspecs(cfg)
 
@@ -366,8 +363,20 @@ def _build_train_step(
             jax.tree.map(lambda g: g * scale, grads),
         )
 
+    return value_and_grad_accum
+
+
+def _build_train_step(
+    cfg: TransformerConfig,
+    mesh,
+    optimizer=None,
+    learning_rate: float = 3e-4,
+):
+    optimizer = optimizer or optax.adamw(learning_rate)
+    value_and_grad = _build_value_and_grad(cfg, mesh)
+
     def train_step(state: TrainState, tokens: jax.Array):
-        loss, ce, grads = value_and_grad_accum(state.params, tokens)
+        loss, ce, grads = value_and_grad(state.params, tokens)
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
@@ -384,9 +393,21 @@ def _build_train_step(
 
 def params_shardings(params: dict, cfg: TransformerConfig, mesh) -> dict:
     """NamedShardings for a params dict by its logical axes — usable as a
-    restore target annotation (``params`` may be concrete or abstract)."""
+    restore target annotation (``params`` may be concrete or abstract).
+    LoRA adapter names (``*_a``/``*_b`` over a known target) replicate —
+    they are rank-r small by construction; any OTHER unknown name stays a
+    loud KeyError (a weight added to init_params but forgotten in
+    logical_axes must not silently replicate across the mesh)."""
     pspecs = param_pspecs(cfg)
-    return {name: NamedSharding(mesh, pspecs[name]) for name in params}
+
+    def spec(name):
+        if name not in pspecs and name[-2:] in ("_a", "_b") and (
+            name[:-2] in pspecs
+        ):
+            return P()
+        return pspecs[name]
+
+    return {name: NamedSharding(mesh, spec(name)) for name in params}
 
 
 def state_shardings(state, cfg: TransformerConfig, mesh) -> TrainState:

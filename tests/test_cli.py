@@ -254,3 +254,65 @@ def test_train_export_then_serve(tmp_path):
     )
     with pytest.raises(FileNotFoundError):
         make_engine(args)
+
+
+def test_lora_finetune_workflow(tmp_path):
+    """Pretrain -> export base -> LoRA fine-tune against the frozen base
+    (tiny adapter checkpoints) -> merged export -> servable."""
+    geometry = [
+        "--vocab-size", "128", "--d-model", "32", "--n-layers", "2",
+        "--n-heads", "4", "--dtype", "float32",
+    ]
+    common = [
+        sys.executable, "-m", "oim_tpu.cli.train_main", "--synthetic",
+        "100000", "--batch-global", "8", "--seq", "32", "--dp", "2",
+    ] + geometry
+    env = dict(os.environ, PYTHONPATH=REPO)
+    base_ckpt = str(tmp_path / "base-ckpt")
+    base_export = str(tmp_path / "base-params")
+    run = subprocess.run(
+        common + ["--steps", "2", "--save-every", "2",
+                  "--checkpoint-dir", base_ckpt,
+                  "--export-dir", base_export],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert run.returncode == 0, run.stderr[-2000:]
+
+    lora_ckpt = str(tmp_path / "lora-ckpt")
+    merged = str(tmp_path / "merged-params")
+    tune = subprocess.run(
+        common + ["--steps", "3", "--save-every", "3",
+                  "--lora-rank", "4", "--lora-base", base_export,
+                  "--checkpoint-dir", lora_ckpt,
+                  "--export-dir", merged, "--eval-every", "3"],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert tune.returncode == 0, tune.stderr[-2000:]
+    assert "lora" in tune.stderr and "eval_ce=" in tune.stderr
+
+    def du(path):
+        total = 0
+        for root, _, files in os.walk(path):
+            total += sum(os.path.getsize(os.path.join(root, f)) for f in files)
+        return total
+
+    # Adapter checkpoints are a fraction of the base checkpoint.
+    assert du(lora_ckpt) < du(base_ckpt) * 0.5, (du(lora_ckpt), du(base_ckpt))
+
+    from oim_tpu.cli.serve_main import build_parser, make_engine
+    from oim_tpu.serve import GenRequest
+
+    args = build_parser().parse_args(
+        geometry + ["--max-len", "64", "--n-slots", "1",
+                    "--params-dir", merged]
+    )
+    engine = make_engine(args)
+    rid = engine.submit(GenRequest(tokens=[5, 6, 7], max_new_tokens=5))
+    assert len(engine.run()[rid]) == 5
+
+    # Missing --lora-base fails fast.
+    bad = subprocess.run(
+        common + ["--steps", "1", "--lora-rank", "4"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert bad.returncode != 0 and "lora-base" in bad.stderr
