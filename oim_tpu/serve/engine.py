@@ -218,7 +218,10 @@ def _sample_batched(logits, temps, keys, top_k, top_p):
     """Per-slot temperature sampling with per-slot PRNG keys: greedy
     where temp == 0, else categorical over temperature-scaled logits with
     the engine's static top-k/top-p truncation (``truncate_logits`` — the
-    same masking the solo path uses)."""
+    same masking the solo path uses).  Returns ``(tokens [S],
+    logprobs [S])`` — the logprob is the chosen token's log-softmax under
+    the model's RAW distribution (temperature 1, untruncated), the
+    standard scoring convention."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = truncate_logits(
         logits / jnp.maximum(temps, 1e-6)[:, None], top_k, top_p
@@ -226,7 +229,14 @@ def _sample_batched(logits, temps, keys, top_k, top_p):
     sampled = jax.vmap(
         lambda key, row: jax.random.categorical(key, row)
     )(keys, scaled).astype(jnp.int32)
-    return jnp.where(temps > 0, sampled, greedy)
+    tokens = jnp.where(temps > 0, sampled, greedy)
+    # log_softmax[token] without materializing an [S, V] fp32 array:
+    # logit[token] - logsumexp(logits), fp32 only on the [S] outputs.
+    chosen = jnp.take_along_axis(logits, tokens[:, None], axis=-1)[:, 0]
+    logprobs = chosen.astype(jnp.float32) - jax.nn.logsumexp(
+        logits.astype(jnp.float32), axis=-1
+    )
+    return tokens, logprobs
 
 
 def _admit(
@@ -258,8 +268,14 @@ def _admit(
     last = jax.lax.dynamic_index_in_dim(
         logits[0], true_len - 1, axis=0, keepdims=False
     )
-    first = _sample_batched(last[None], temp[None], key[None], top_k, top_p)[0]
-    return SlotCache(k_all, v_all, lengths, ks_all, vs_all), first
+    first, first_lp = _sample_batched(
+        last[None], temp[None], key[None], top_k, top_p
+    )
+    return (
+        SlotCache(k_all, v_all, lengths, ks_all, vs_all),
+        first[0],
+        first_lp[0],
+    )
 
 
 def _decode_chunk(
@@ -287,20 +303,20 @@ def _decode_chunk(
             params, tok[:, None], kv, lengths, cfg, is_prefill=False
         )
         keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
-        nxt = _sample_batched(logits[:, -1], temps, keys, top_k, top_p)
+        nxt, lp = _sample_batched(logits[:, -1], temps, keys, top_k, top_p)
         nxt = jnp.where(active, nxt, tok)
         # Clamp: a slot decoding past its budget inside a chunk (host
         # truncates after) must not index past the cache edge.
         lengths = jnp.minimum(
             lengths + active.astype(jnp.int32), max_len - 1
         )
-        return (kv, lengths, nxt), nxt
+        return (kv, lengths, nxt), (nxt, lp)
 
     kv0 = (cache.k, cache.v, cache.k_scale, cache.v_scale)
-    ((k_all, v_all, ks_all, vs_all), lengths, _), out = jax.lax.scan(
+    ((k_all, v_all, ks_all, vs_all), lengths, _), (out, lps) = jax.lax.scan(
         one, (kv0, cache.lengths, tokens), jnp.arange(chunk)
     )
-    return SlotCache(k_all, v_all, lengths, ks_all, vs_all), out.T
+    return SlotCache(k_all, v_all, lengths, ks_all, vs_all), out.T, lps.T
 
 
 @dataclass
@@ -324,6 +340,7 @@ class _SlotState:
     base: jax.Array  # per-request PRNG base key (PRNGKey(req.seed))
     t_submit: float
     emitted: list[int] = field(default_factory=list)
+    logprobs: list[float] = field(default_factory=list)
     last_token: int = 0
 
 
@@ -479,10 +496,11 @@ class Engine:
 
     def submit(self, req: GenRequest, on_token=None) -> int:
         """Queue a request; returns its id.  ``on_token`` (optional)
-        streams the generation: called once per emitted token, in order,
-        then once with ``None`` as end-of-stream (completion OR abort).
-        Callbacks run on the engine driver thread and must not block —
-        hand off to a queue (the HTTP streaming handler's pattern)."""
+        streams the generation: called as ``on_token(token, logprob)``
+        once per emitted token, in order, then once with ``(None, None)``
+        as end-of-stream (completion OR abort).  Callbacks run on the
+        engine driver thread and must not block — hand off to a queue
+        (the HTTP streaming handler's pattern)."""
         try:
             self._validate(req)
         except ValueError:
@@ -507,6 +525,14 @@ class Engine:
         every historical request forever.  A second fetch raises KeyError.
         ``run()`` returns (but does not consume) unfetched results.
         Raises RuntimeError for a request failed by ``abort()``."""
+        return self.result_full(rid, timeout)[0]
+
+    def result_full(
+        self, rid: int, timeout: float | None = None
+    ) -> tuple[list[int], list[float]]:
+        """Like ``result`` but returns ``(tokens, logprobs)`` — the
+        logprob of each generated token under the model's raw
+        (temperature-1, untruncated) distribution."""
         try:
             event = self._events[rid]
         except KeyError:
@@ -561,7 +587,7 @@ class Engine:
             self._m_active.set(0.0, self._engine_label)
             self._m_queued.set(0.0, self._engine_label)
         for cb in ended:  # end-of-stream for streaming consumers
-            cb(None)
+            cb(None, None)
 
     # -- engine loop (one driver thread) ----------------------------------
 
@@ -599,15 +625,15 @@ class Engine:
             self._forgotten.discard(state.rid)
             self._events.pop(state.rid, None)
             return
-        self._results[state.rid] = state.emitted
+        self._results[state.rid] = (state.emitted, state.logprobs)
         self._events[state.rid].set()
 
-    def _emit(self, state: _SlotState, token: int) -> bool:
+    def _emit(self, state: _SlotState, token: int, logprob: float) -> bool:
         """Record one generated token; True when the request is done."""
-        if state.req.eos_id is not None and token == state.req.eos_id:
-            state.emitted.append(token)
-            return True
         state.emitted.append(token)
+        state.logprobs.append(logprob)
+        if state.req.eos_id is not None and token == state.req.eos_id:
+            return True
         state.last_token = token
         return len(state.emitted) >= state.req.max_new_tokens
 
@@ -625,7 +651,7 @@ class Engine:
                 req.tokens + [0] * (bucket - len(req.tokens)), jnp.int32
             )
             key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
-            self._cache, first = self._admit(
+            self._cache, first, first_lp = self._admit(
                 self.params,
                 self._cache,
                 prompt,
@@ -638,10 +664,12 @@ class Engine:
                 rid=rid, req=req, base=jax.random.PRNGKey(req.seed),
                 t_submit=t_submit,
             )
-            token = int(first)
+            # One combined readback (the chunk path's discipline).
+            token, lp = jax.device_get((first, first_lp))
+            token, lp = int(token), float(lp)
             self.tokens_generated += 1
             with self._lock:
-                done = self._emit(state, token)
+                done = self._emit(state, token, lp)
                 if done:
                     self._finish(slot, state)
                 else:
@@ -652,9 +680,9 @@ class Engine:
                     else self._callbacks.get(rid)
                 )
             if cb is not None:  # stream outside the lock
-                cb(token)
+                cb(token, lp)
                 if done:
-                    cb(None)
+                    cb(None, None)
 
         with self._lock:
             if not self._slots:
@@ -687,10 +715,10 @@ class Engine:
             [len(slots[i].emitted) if i in slots else 0 for i in range(n_slots)],
             jnp.int32,
         )
-        self._cache, out = self._decode(
+        self._cache, out, lps = self._decode(
             self.params, self._cache, tokens, temps, active, bases, counts
         )
-        out = jax.device_get(out)  # ONE readback per chunk
+        out, lps = jax.device_get((out, lps))  # ONE readback per chunk
         self._step_count += 1
         self._m_dispatches.inc()
         notices = []  # (callback, tokens..., end?) fired outside the lock
@@ -698,10 +726,10 @@ class Engine:
             for slot, state in list(slots.items()):
                 done = False
                 fresh = []
-                for token in out[slot]:
+                for token, lp in zip(out[slot], lps[slot]):
                     self.tokens_generated += 1
-                    fresh.append(int(token))
-                    if self._emit(state, int(token)):
+                    fresh.append((int(token), float(lp)))
+                    if self._emit(state, int(token), float(lp)):
                         done = True
                         break
                 cb = (
@@ -713,17 +741,19 @@ class Engine:
                 if done and slot in self._slots:
                     self._finish(slot, state)
         for cb, fresh, done in notices:
-            for token in fresh:
-                cb(token)
+            for token, lp in fresh:
+                cb(token, lp)
             if done:
-                cb(None)
+                cb(None, None)
 
     def run(self) -> dict[int, list[int]]:
         """Drain the queue and all active slots; returns {rid: tokens}."""
         while self.pending():
             self.step()
         with self._lock:
-            return {rid: list(toks) for rid, toks in self._results.items()}
+            return {
+                rid: list(toks) for rid, (toks, _) in self._results.items()
+            }
 
     def warmup(self) -> "Engine":
         """Pre-compile every admit bucket and the whole chunk ladder.

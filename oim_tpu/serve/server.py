@@ -8,12 +8,16 @@ background driver thread so concurrent requests batch onto slots.
 API:
   POST /v1/generate   {"tokens": [int...], "max_new_tokens": N,
                        "temperature": 0.0, "seed": 0, "eos_id": null,
-                       "stream": false}
-                    → {"tokens": [int...]}   (generated only, EOS included)
+                       "stream": false, "logprobs": false}
+                    → {"tokens": [int...]}   (generated only, EOS included;
+                    "logprobs": true adds each token's log-softmax under
+                    the model's raw temperature-1 distribution)
                     With "stream": true the response is NDJSON, one
                     {"token": t} line per generated token as it decodes
-                    (tokens arrive in chunk-sized bursts), terminated by
-                    {"done": true, "tokens": [...]} or {"error": ...}.
+                    (tokens arrive in chunk-sized bursts; with
+                    "logprobs": true each line adds "logprob"),
+                    terminated by {"done": true, "tokens": [...]} (plus
+                    "logprobs": [...] when requested) or {"error": ...}.
   GET  /healthz      → {"ok": true}
   GET  /v1/stats     → engine stats (slots, queue depth, tokens generated)
   GET  /metrics      → Prometheus exposition (shared registry)
@@ -85,7 +89,9 @@ class ServeServer:
                 client that disconnects mid-stream forfeits the result
                 (engine.forget) — generation itself runs to completion."""
                 tokens_q: queue.Queue = queue.Queue()
-                rid = outer.engine.submit(req, on_token=tokens_q.put)
+                rid = outer.engine.submit(
+                    req, on_token=lambda t, lp: tokens_q.put((t, lp))
+                )
                 try:
                     # Headers inside the try: wfile is unbuffered, so a
                     # client that disconnected right away raises HERE —
@@ -104,7 +110,7 @@ class ServeServer:
                     self.end_headers()  # HTTP/1.0: body ends on close
                     while True:
                         try:
-                            token = tokens_q.get(timeout=600)
+                            token, logprob = tokens_q.get(timeout=600)
                         except queue.Empty:
                             # Same situation the non-stream path answers
                             # with 503; the protocol promises a
@@ -119,17 +125,21 @@ class ServeServer:
                             return
                         if token is None:
                             break
+                        line = {"token": token}
+                        if self.want_logprobs:
+                            line["logprob"] = logprob
                         self.wfile.write(
-                            (json.dumps({"token": token}) + "\n").encode()
+                            (json.dumps(line) + "\n").encode()
                         )
                         self.wfile.flush()
                     try:
-                        tokens = outer.engine.result(rid, timeout=30)
+                        tokens, lps = outer.engine.result_full(rid, timeout=30)
                         span.attrs["generated"] = len(tokens)
+                        final = {"done": True, "tokens": tokens}
+                        if self.want_logprobs:
+                            final["logprobs"] = lps
                         self.wfile.write(
-                            json.dumps(
-                                {"done": True, "tokens": tokens}
-                            ).encode() + b"\n"
+                            json.dumps(final).encode() + b"\n"
                         )
                     except (RuntimeError, TimeoutError) as exc:
                         outer.engine.forget(rid)
@@ -180,6 +190,7 @@ class ServeServer:
                         max_new_tokens=req.max_new_tokens,
                         stream=bool(body.get("stream")),
                     )
+                    self.want_logprobs = bool(body.get("logprobs"))
                     if body.get("stream"):
                         self._stream(req, span)
                         return
@@ -189,7 +200,7 @@ class ServeServer:
                     self._json(400, {"error": str(exc)})
                     return
                 try:
-                    tokens = outer.engine.result(rid, timeout=600)
+                    tokens, lps = outer.engine.result_full(rid, timeout=600)
                 except TimeoutError:
                     # Clean 503 instead of a dropped socket; forget() frees
                     # the result whenever it does complete — a flaky client
@@ -203,18 +214,18 @@ class ServeServer:
                     self._json(500, {"error": str(exc)})
                     return
                 span.attrs["generated"] = len(tokens)
-                self._json(
-                    200,
-                    {
-                        "tokens": tokens,
-                        "request_id": rid,
-                        # Echo the span so callers can correlate this
-                        # generation in the merged trace (oimctl trace).
-                        "traceparent": tracing.SpanContext(
-                            span.trace_id, span.span_id
-                        ).traceparent(),
-                    },
-                )
+                payload = {
+                    "tokens": tokens,
+                    "request_id": rid,
+                    # Echo the span so callers can correlate this
+                    # generation in the merged trace (oimctl trace).
+                    "traceparent": tracing.SpanContext(
+                        span.trace_id, span.span_id
+                    ).traceparent(),
+                }
+                if self.want_logprobs:
+                    payload["logprobs"] = lps
+                self._json(200, payload)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]

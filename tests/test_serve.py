@@ -307,11 +307,15 @@ def test_streaming_callback(setup):
     streamed: list = []
     tokens = _prompt(41, 6, cfg.vocab_size)
     rid = engine.submit(
-        GenRequest(tokens=tokens, max_new_tokens=9), on_token=streamed.append
+        GenRequest(tokens=tokens, max_new_tokens=9),
+        on_token=lambda t, lp: streamed.append((t, lp)),
     )
     results = engine.run()
-    assert streamed[-1] is None
-    assert streamed[:-1] == results[rid] == _oracle(params, cfg, tokens, 9)
+    assert streamed[-1] == (None, None)
+    assert [t for t, _ in streamed[:-1]] == results[rid] == _oracle(
+        params, cfg, tokens, 9
+    )
+    assert all(lp < 0 for _, lp in streamed[:-1])  # log-probabilities
 
 
 def test_streaming_eos_and_abort_end_stream(setup):
@@ -323,7 +327,7 @@ def test_streaming_eos_and_abort_end_stream(setup):
     streamed: list = []
     engine.submit(
         GenRequest(tokens=tokens, max_new_tokens=12, eos_id=eos),
-        on_token=streamed.append,
+        on_token=lambda t, lp: streamed.append(t),
     )
     engine.run()
     assert streamed[-1] is None
@@ -333,7 +337,7 @@ def test_streaming_eos_and_abort_end_stream(setup):
     streamed2: list = []
     engine2.submit(
         GenRequest(tokens=[1, 2], max_new_tokens=4),
-        on_token=streamed2.append,
+        on_token=lambda t, lp: streamed2.append(t),
     )
     engine2.abort("down")
     assert streamed2 == [None]
@@ -515,3 +519,60 @@ def test_tracing_spans(setup):
     finally:
         server.stop()
         tracing.init("")  # reset global collector for other tests
+
+
+def test_logprobs(setup):
+    """result_full returns the chosen tokens' log-softmax under the raw
+    model distribution, matching a solo forward's log_softmax; HTTP
+    returns them when requested."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=4)
+    tokens = _prompt(17, 5, cfg.vocab_size)
+    rid = engine.submit(GenRequest(tokens=tokens, max_new_tokens=6))
+    engine.run()
+    toks, lps = engine.result_full(rid, timeout=0)
+    assert len(lps) == len(toks) == 6
+    # Oracle: greedy refeed computing log_softmax at each step.
+    from oim_tpu.models.decode import prefill, decode_step
+
+    logits, cache = prefill(
+        params, jnp.asarray(tokens, jnp.int32)[None], cfg, max_len=16
+    )
+    want = []
+    step_logits = logits[:, -1, :]
+    cur = None
+    for i in range(6):
+        lsm = jax.nn.log_softmax(step_logits.astype(jnp.float32), axis=-1)
+        tok = int(jnp.argmax(step_logits, axis=-1)[0])
+        assert tok == toks[i]
+        want.append(float(lsm[0, tok]))
+        cur = jnp.asarray([[tok]], jnp.int32)
+        step_logits, cache = decode_step(params, cache, cur, cfg)
+    np.testing.assert_allclose(lps, want, rtol=1e-5, atol=1e-6)
+
+    server = ServeServer(engine, port=0).start()
+    try:
+        body = json.dumps(
+            {"tokens": tokens, "max_new_tokens": 4, "logprobs": True}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate", data=body
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            payload = json.load(r)
+        assert len(payload["logprobs"]) == 4
+        assert all(lp < 0 for lp in payload["logprobs"])
+        # Streaming carries per-line logprobs when asked.
+        body = json.dumps(
+            {"tokens": tokens, "max_new_tokens": 3, "stream": True,
+             "logprobs": True}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate", data=body
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            lines = [json.loads(ln) for ln in r.read().splitlines()]
+        assert all("logprob" in ln for ln in lines[:-1])
+        assert lines[-1]["logprobs"] == [ln["logprob"] for ln in lines[:-1]]
+    finally:
+        server.stop()
