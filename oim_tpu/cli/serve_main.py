@@ -57,6 +57,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument(
+        "--prefix-cache", type=int, default=0, metavar="N",
+        help="cache up to N prompt-KV entries (requests marked "
+        "cache_prefix); later prompts sharing a cached prefix skip "
+        "re-prefilling it",
+    )
+    p.add_argument(
         "--kv-int8", action="store_true",
         help="int8-quantized KV cache (half the cache bandwidth decode "
         "pays; per-token/head scales)",
@@ -139,6 +145,7 @@ def make_engine(args):
         top_k=args.top_k,
         top_p=args.top_p,
         kv_int8=args.kv_int8,
+        prefix_cache_size=args.prefix_cache,
     )
 
 

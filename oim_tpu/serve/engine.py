@@ -240,33 +240,35 @@ def _sample_batched(logits, temps, keys, top_k, top_p):
 
 
 def _admit(
-    params, cache: SlotCache, prompt, slot, true_len, temp, key,
+    params, cache: SlotCache, prompt, slot, start, true_tail, temp, key,
     *, cfg, top_k, top_p,
 ):
-    """Prefill ``prompt`` [Lb] (padded to its bucket) into slot ``slot``
-    and sample the first generated token.  Returns (cache, first_token).
+    """Prefill the uncached ``prompt`` tail [Lb] (padded to its bucket)
+    into slot ``slot`` at positions ``start..`` and sample the first
+    generated token.  Returns (cache, first_token, first_logprob).
 
-    Pad positions past ``true_len`` are written but masked forever: the
-    slot's length stops at ``true_len`` and decode overwrites them one by
-    one, so padding never reaches attention.
+    ``start`` > 0 means rows 0..start-1 were injected from the prefix
+    cache (``_inject_prefix``) — the causal mask attends the tail to
+    them exactly as a full prefill would.  Pad positions past
+    ``start + true_tail`` are written but masked forever: the slot's
+    length stops there and decode overwrites them one by one.
     """
     kv_full = (cache.k, cache.v, cache.k_scale, cache.v_scale)
     kv_slot = jax.tree.map(
         lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), kv_full
     )
-    starts = jnp.zeros((1,), jnp.int32)
     logits, kv_slot = _forward_slots(
-        params, prompt[None], kv_slot, starts, cfg, is_prefill=True
+        params, prompt[None], kv_slot, start[None], cfg, is_prefill=True
     )
     k_all, v_all, ks_all, vs_all = jax.tree.map(
         lambda c, u: jax.lax.dynamic_update_slice_in_dim(c, u, slot, axis=1),
         kv_full, kv_slot,
     )
     lengths = jax.lax.dynamic_update_slice(
-        cache.lengths, true_len[None], (slot,)
+        cache.lengths, (start + true_tail)[None], (slot,)
     )
     last = jax.lax.dynamic_index_in_dim(
-        logits[0], true_len - 1, axis=0, keepdims=False
+        logits[0], true_tail - 1, axis=0, keepdims=False
     )
     first, first_lp = _sample_batched(
         last[None], temp[None], key[None], top_k, top_p
@@ -276,6 +278,32 @@ def _admit(
         first[0],
         first_lp[0],
     )
+
+
+def _extract_prefix(cache: SlotCache, slot, *, rows: int):
+    """Copy the first ``rows`` KV rows of ``slot`` out (a prefix-cache
+    entry): pytree (k, v, k_scale, v_scale) with the slot axis dropped."""
+    def cut(c):
+        sizes = (c.shape[0], 1, rows, *c.shape[3:])
+        start = (0, slot) + (0,) * (c.ndim - 2)
+        return jax.lax.dynamic_slice(c, start, sizes)[:, 0]
+
+    return jax.tree.map(
+        cut, (cache.k, cache.v, cache.k_scale, cache.v_scale)
+    )
+
+
+def _inject_prefix(cache: SlotCache, entry, slot):
+    """Write a prefix-cache entry's rows into the head of ``slot``'s
+    region (admit then continues at ``start`` = the usable prefix length;
+    rows past it are garbage until overwritten, and masked until then)."""
+    def put(c, u):
+        start = (0, slot) + (0,) * (c.ndim - 2)
+        return jax.lax.dynamic_update_slice(c, u[:, None], start)
+
+    kv_full = (cache.k, cache.v, cache.k_scale, cache.v_scale)
+    k, v, ks, vs = jax.tree.map(put, kv_full, entry)
+    return SlotCache(k, v, cache.lengths, ks, vs)
 
 
 def _decode_chunk(
@@ -331,6 +359,10 @@ class GenRequest:
     temperature: float = 0.0
     seed: int = 0
     eos_id: int | None = None
+    # Store this request's prompt KV in the engine's prefix cache after
+    # admission (mark system prompts); later prompts sharing the prefix
+    # skip re-prefilling it.
+    cache_prefix: bool = False
 
 
 @dataclass
@@ -372,11 +404,13 @@ class Engine:
         top_k: int = 0,
         top_p: float = 1.0,
         kv_int8: bool = False,
+        prefix_cache_size: int = 0,
     ):
-        if n_slots < 1 or max_len < 2 or chunk < 1:
+        if n_slots < 1 or max_len < 2 or chunk < 1 or prefix_cache_size < 0:
             raise ValueError(
-                f"need n_slots>=1, max_len>=2, chunk>=1; got "
-                f"{n_slots}, {max_len}, {chunk}"
+                f"need n_slots>=1, max_len>=2, chunk>=1, "
+                f"prefix_cache_size>=0; got {n_slots}, {max_len}, {chunk}, "
+                f"{prefix_cache_size}"
             )
         self.params = params
         self.cfg = cfg
@@ -406,6 +440,21 @@ class Engine:
             partial(_admit, cfg=cfg, top_k=top_k, top_p=top_p),
             donate_argnums=(1,),
         )
+        # Prefix cache: LRU of prompt-KV entries (tuple(tokens) →
+        # (kv pytree, true length)).  Each entry costs about one slot's
+        # worth of HBM at its bucket length.  Extraction/injection jit
+        # per bucket length.
+        from collections import OrderedDict
+
+        self.prefix_cache_size = prefix_cache_size
+        self._prefix_cache: OrderedDict = OrderedDict()
+        self._extract = {
+            b: jax.jit(partial(_extract_prefix, rows=b))
+            for b in (self.prompt_buckets if prefix_cache_size else ())
+        }
+        self._inject = jax.jit(_inject_prefix, donate_argnums=(0,))
+        self.prefix_hits = 0
+        self.prefix_misses = 0
         self._decode = jax.jit(
             partial(_decode_chunk, cfg=cfg, chunk=chunk, top_k=top_k,
                     top_p=top_p),
@@ -415,7 +464,8 @@ class Engine:
         self._queue: list[tuple[int, GenRequest, float]] = []
         self._slots: dict[int, _SlotState] = {}  # slot index → state
         self._free = list(range(n_slots))
-        self._results: dict[int, list[int]] = {}
+        # rid → (tokens, logprobs), consumed by result_full/result.
+        self._results: dict[int, tuple[list[int], list[float]]] = {}
         self._events: dict[int, threading.Event] = {}
         self._errors: dict[int, str] = {}
         self._callbacks: dict[int, object] = {}  # rid → on_token
@@ -603,6 +653,9 @@ class Engine:
                 "queued": len(self._queue),
                 "steps": self._step_count,
                 "tokens_generated": self.tokens_generated,
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_entries": len(self._prefix_cache),
             }
 
     def _bucket(self, n: int) -> int:
@@ -637,6 +690,49 @@ class Engine:
         state.last_token = token
         return len(state.emitted) >= state.req.max_new_tokens
 
+    def _try_prefix_inject(self, slot: int, req: GenRequest) -> int:
+        """Inject the longest cached prefix of ``req.tokens`` into
+        ``slot``; returns the start offset for the tail prefill (0 = no
+        usable entry).  Exact for dense models (a KV row depends only on
+        the tokens before it); under MoE a hit changes which tokens share
+        a capacity-routing group, the same class of variation as prompt
+        bucketing."""
+        if not self.prefix_cache_size:
+            return 0
+        best_key, best_usable = None, 0
+        with self._lock:
+            for key, (entry, true_len) in self._prefix_cache.items():
+                usable = min(true_len, len(req.tokens) - 1)
+                if usable <= best_usable:
+                    continue
+                if tuple(req.tokens[:usable]) == key[:usable]:
+                    # The tail, bucketed, must still fit the slot region.
+                    tail_bucket = self._bucket(len(req.tokens) - usable)
+                    if usable + tail_bucket <= self._cache.max_len:
+                        best_key, best_usable = key, usable
+            if best_key is None:
+                if not self._warming:
+                    self.prefix_misses += 1
+                return 0
+            self._prefix_cache.move_to_end(best_key)  # LRU touch
+            entry, _ = self._prefix_cache[best_key]
+            if not self._warming:
+                self.prefix_hits += 1
+        self._cache = self._inject(self._cache, entry, jnp.int32(slot))
+        return best_usable
+
+    def _store_prefix(self, slot: int, tokens: list[int]) -> None:
+        """Cache ``slot``'s freshly prefilled prompt KV (bucketed rows;
+        only the first len(tokens) are valid and only they are used)."""
+        bucket = self._bucket(len(tokens))
+        entry = self._extract[bucket](self._cache, jnp.int32(slot))
+        with self._lock:
+            key = tuple(tokens)
+            self._prefix_cache[key] = (entry, len(tokens))
+            self._prefix_cache.move_to_end(key)
+            while len(self._prefix_cache) > self.prefix_cache_size:
+                self._prefix_cache.popitem(last=False)
+
     def step(self) -> None:
         """Admit whatever fits, then decode one chunk for active slots."""
         with self._lock:
@@ -646,9 +742,11 @@ class Engine:
                 admissions.append((self._free.pop(0), rid, req, t_submit))
             self._m_queued.set(float(len(self._queue)), self._engine_label)
         for slot, rid, req, t_submit in admissions:
-            bucket = self._bucket(len(req.tokens))
+            start = self._try_prefix_inject(slot, req)
+            tail = req.tokens[start:]
+            bucket = self._bucket(len(tail))
             prompt = jnp.asarray(
-                req.tokens + [0] * (bucket - len(req.tokens)), jnp.int32
+                tail + [0] * (bucket - len(tail)), jnp.int32
             )
             key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
             self._cache, first, first_lp = self._admit(
@@ -656,10 +754,13 @@ class Engine:
                 self._cache,
                 prompt,
                 jnp.int32(slot),
-                jnp.int32(len(req.tokens)),
+                jnp.int32(start),
+                jnp.int32(len(tail)),
                 jnp.float32(req.temperature),
                 key,
             )
+            if req.cache_prefix and self.prefix_cache_size:
+                self._store_prefix(slot, req.tokens)
             state = _SlotState(
                 rid=rid, req=req, base=jax.random.PRNGKey(req.seed),
                 t_submit=t_submit,
@@ -774,10 +875,26 @@ class Engine:
                 rids.append(self.submit(GenRequest(
                     tokens=[0] * b,
                     max_new_tokens=min(2 * self.chunk, headroom),
+                    # With the prefix cache on, also compile its
+                    # extract path at every bucket.
+                    cache_prefix=bool(self.prefix_cache_size),
                 )))
             self.run()
+            if self.prefix_cache_size:
+                # Compile the inject path per entry bucket: one request
+                # extending each cached dummy by one token (its tail
+                # rides the smallest bucket, already compiled above).
+                for b in self.prompt_buckets:
+                    if b + self.prompt_buckets[0] > max_len - 1:
+                        continue
+                    rids.append(self.submit(GenRequest(
+                        tokens=[0] * (b + 1), max_new_tokens=1,
+                    )))
+                self.run()
             for rid in rids:  # consume the dummies; warmup must not retain
                 self.result(rid, timeout=0)
+            with self._lock:  # dummy prompts must not occupy live entries
+                self._prefix_cache.clear()
         finally:
             self._warming = False
         return self

@@ -8,7 +8,8 @@ background driver thread so concurrent requests batch onto slots.
 API:
   POST /v1/generate   {"tokens": [int...], "max_new_tokens": N,
                        "temperature": 0.0, "seed": 0, "eos_id": null,
-                       "stream": false, "logprobs": false}
+                       "stream": false, "logprobs": false,
+                       "cache_prefix": false}
                     → {"tokens": [int...]}   (generated only, EOS included;
                     "logprobs": true adds each token's log-softmax under
                     the model's raw temperature-1 distribution)
@@ -184,6 +185,7 @@ class ServeServer:
                             if body.get("eos_id") is not None
                             else None
                         ),
+                        cache_prefix=bool(body.get("cache_prefix")),
                     )
                     span.attrs.update(
                         prompt_tokens=len(req.tokens),

@@ -576,3 +576,85 @@ def test_logprobs(setup):
         assert lines[-1]["logprobs"] == [ln["logprob"] for ln in lines[:-1]]
     finally:
         server.stop()
+
+
+def test_prefix_cache_exact_and_lru(setup):
+    """A cached system prompt is reused for later prompts sharing it —
+    results stay bit-identical to solo generation (KV rows depend only on
+    preceding tokens) — and the LRU evicts at capacity."""
+    cfg, params = setup
+    engine = Engine(
+        params, cfg, n_slots=2, max_len=64, chunk=4, prefix_cache_size=2,
+    )
+    system = _prompt(71, 16, cfg.vocab_size)  # bucket-sized "system prompt"
+    rid = engine.submit(
+        GenRequest(tokens=system, max_new_tokens=4, cache_prefix=True)
+    )
+    engine.run()
+    engine.result(rid, timeout=0)
+    assert engine.stats()["prefix_entries"] == 1
+    assert engine.stats()["prefix_hits"] == 0
+
+    # Three requests sharing the system prefix, different suffixes.
+    reqs = {}
+    for s_ in range(3):
+        suffix = _prompt(80 + s_, 4 + s_, cfg.vocab_size)
+        rid = engine.submit(
+            GenRequest(tokens=system + suffix, max_new_tokens=6)
+        )
+        reqs[rid] = system + suffix
+    results = engine.run()
+    assert engine.stats()["prefix_hits"] == 3
+    for rid, tokens in reqs.items():
+        assert results[rid] == _oracle(params, cfg, tokens, 6), (
+            "prefix-cache hit changed the result"
+        )
+
+    # Unrelated prompt: miss.
+    rid = engine.submit(
+        GenRequest(tokens=_prompt(99, 20, cfg.vocab_size), max_new_tokens=3)
+    )
+    engine.run()
+    assert engine.stats()["prefix_misses"] >= 1
+
+    # LRU: two more cached prompts evict the oldest (capacity 2).
+    for s_ in (101, 102):
+        rid = engine.submit(GenRequest(
+            tokens=_prompt(s_, 16, cfg.vocab_size), max_new_tokens=2,
+            cache_prefix=True,
+        ))
+    engine.run()
+    assert engine.stats()["prefix_entries"] == 2
+
+
+def test_prefix_cache_int8(setup):
+    """Prefix caching composes with the int8 KV cache (scales ride the
+    entry pytree); hits stay exact vs solo int8 generation."""
+    cfg, params = setup
+    engine = Engine(
+        params, cfg, n_slots=1, max_len=64, chunk=4, kv_int8=True,
+        prefix_cache_size=1,
+    )
+    system = _prompt(5, 16, cfg.vocab_size)
+    engine.submit(GenRequest(tokens=system, max_new_tokens=2,
+                             cache_prefix=True))
+    engine.run()
+    tokens = system + _prompt(6, 5, cfg.vocab_size)
+    rid = engine.submit(GenRequest(tokens=tokens, max_new_tokens=5))
+    results = engine.run()
+    assert engine.stats()["prefix_hits"] == 1
+    want = np.asarray(
+        generate(params, jnp.asarray(tokens, jnp.int32)[None], cfg,
+                 max_new_tokens=5, kv_int8=True)
+    )[0, len(tokens):].tolist()
+    assert results[rid] == want
+
+
+def test_prefix_cache_off_by_default(setup):
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=2)
+    rid = engine.submit(GenRequest(tokens=[1, 2, 3], max_new_tokens=2,
+                                   cache_prefix=True))
+    engine.run()
+    assert engine.stats()["prefix_entries"] == 0
+    assert engine.stats()["prefix_hits"] == 0
