@@ -55,6 +55,19 @@ def main(argv=None) -> int:
     topo.add_argument("--controller", required=True)
     slices = sub.add_parser("slices", help="allocations on a controller")
     slices.add_argument("--controller", required=True)
+    generate = sub.add_parser(
+        "generate", help="send a generation request to an oim-serve daemon"
+    )
+    generate.add_argument("tokens", type=int, nargs="+", help="prompt token ids")
+    generate.add_argument("--serve", default="http://127.0.0.1:8000")
+    generate.add_argument("--max-new-tokens", type=int, default=16)
+    generate.add_argument("--temperature", type=float, default=0.0)
+    generate.add_argument("--seed", type=int, default=0)
+    generate.add_argument("--logprobs", action="store_true")
+    generate.add_argument(
+        "--stream", action="store_true",
+        help="print tokens as they decode (NDJSON lines)",
+    )
     trace = sub.add_parser(
         "trace", help="render cross-process traces from --trace-file JSONLs"
     )
@@ -65,6 +78,47 @@ def main(argv=None) -> int:
 
     args = parser.parse_args(argv)
     log.init_from_string(args.log_level)
+    if args.command == "generate":
+        import json as json_mod
+        import urllib.request
+
+        body = json_mod.dumps({
+            "tokens": args.tokens,
+            "max_new_tokens": args.max_new_tokens,
+            "temperature": args.temperature,
+            "seed": args.seed,
+            "logprobs": args.logprobs,
+            "stream": args.stream,
+        }).encode()
+        request = urllib.request.Request(
+            f"{args.serve.rstrip('/')}/v1/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(request, timeout=600) as response:
+                if args.stream:
+                    failed = False
+                    for line in response:
+                        text = line.decode().rstrip()
+                        print(text)
+                        try:
+                            failed = failed or "error" in json_mod.loads(text)
+                        except ValueError:
+                            pass
+                    if failed:  # scripted callers need the exit code
+                        return 1
+                else:
+                    reply = json_mod.load(response)
+                    print("tokens:", " ".join(str(t) for t in reply["tokens"]))
+                    if args.logprobs:
+                        print(
+                            "logprobs:",
+                            " ".join(f"{p:.3f}" for p in reply["logprobs"]),
+                        )
+        except urllib.error.URLError as exc:
+            print(f"error: {exc}")
+            return 1
+        return 0
     if args.command == "trace":
         try:
             spans = tracing.load_jsonl(args.files)

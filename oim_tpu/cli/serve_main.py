@@ -76,6 +76,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="append spans as JSON lines (default: $OIM_TRACE_FILE)",
     )
     p.add_argument(
+        "--warmup-embed", action="store_true",
+        help="also pre-compile the /v1/embed path at every bucket "
+        "(one forward compile per bucket; skip unless serving embeds)",
+    )
+    p.add_argument(
         "--no-warmup", action="store_true",
         help="skip pre-compiling admit buckets + decode (first live "
         "requests then pay the 20-40s TPU compiles)",
@@ -168,7 +173,7 @@ def main(argv=None) -> int:
     engine = make_engine(args)
     if not args.no_warmup:
         log.current().info("warming up", buckets=list(engine.prompt_buckets))
-        engine.warmup()
+        engine.warmup(embed=args.warmup_embed)
     server = ServeServer(engine, host=args.host, port=args.port).start()
     log.current().info(
         "oim-serve listening", host=server.host, port=server.port,

@@ -202,7 +202,7 @@ def _moe_exact(x, lp, cfg: TransformerConfig):
     return x + out.reshape(b, t, d).astype(x.dtype)
 
 
-def _forward_cached(
+def _hidden_cached(
     params,
     tokens,
     cache: KVCache,
@@ -210,7 +210,8 @@ def _forward_cached(
     is_prefill: bool = False,
 ):
     """Run ``tokens`` (global positions cache.length..+t) through all
-    layers, reading and extending the cache.  Returns (logits, cache).
+    layers, reading and extending the cache.  Returns the final-norm
+    hidden states ``(x [b, t, d], cache)`` (no unembedding).
 
     ``is_prefill`` selects MoE routing: prefill uses the train-path
     capacity routing (exact agreement with the training forward, even for
@@ -254,12 +255,46 @@ def _forward_cached(
         layer_step, x, (flat, cache.k, cache.v, cache.k_scale, cache.v_scale)
     )
     x = _rmsnorm(x, params["final_norm"], cfg)
-    logits = _unembed(x, params["wlm"], cfg)
     new_cache = KVCache(
         k=new_k, v=new_v, length=start + tokens.shape[1],
         k_scale=new_ks, v_scale=new_vs,
     )
-    return logits, new_cache
+    return x, new_cache
+
+
+def _forward_cached(
+    params,
+    tokens,
+    cache: KVCache,
+    cfg: TransformerConfig,
+    is_prefill: bool = False,
+):
+    """``_hidden_cached`` + the unembedding: (logits, cache)."""
+    x, new_cache = _hidden_cached(params, tokens, cache, cfg, is_prefill)
+    return _unembed(x, params["wlm"], cfg), new_cache
+
+
+def embed_tokens(params, tokens, true_lens, cfg: TransformerConfig):
+    """Mean-pooled, L2-normalized final hidden states: an embeddings
+    surface over the causal LM (standard last-layer mean pooling).
+
+    tokens [b, t] (right-padded); true_lens [b] valid lengths — pads sit
+    AFTER the valid positions, so causal attention keeps every valid
+    hidden state pad-independent and the masked mean is exact at any
+    padding bucket.  Returns f32 [b, d_model], unit-norm rows.
+    """
+    b, t = tokens.shape
+    cache = KVCache.create(cfg, b, t)
+    x, _ = _hidden_cached(params, tokens, cache, cfg, is_prefill=True)
+    mask = (
+        jnp.arange(t)[None, :] < true_lens[:, None]
+    ).astype(jnp.float32)[..., None]
+    pooled = jnp.sum(x.astype(jnp.float32) * mask, axis=1) / jnp.maximum(
+        jnp.sum(mask, axis=1), 1.0
+    )
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+    )
 
 
 def prefill(

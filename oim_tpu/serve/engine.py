@@ -57,6 +57,7 @@ from oim_tpu.models.decode import (
     _flat_layer_params,
     _load_kv,
     _moe_exact,
+    embed_tokens,
     truncate_logits,
 )
 from oim_tpu.ops.quant import make_kv_buffers, quantize_int8
@@ -455,6 +456,7 @@ class Engine:
         self._inject = jax.jit(_inject_prefix, donate_argnums=(0,))
         self.prefix_hits = 0
         self.prefix_misses = 0
+        self._embed = jax.jit(partial(embed_tokens, cfg=cfg))
         self._decode = jax.jit(
             partial(_decode_chunk, cfg=cfg, chunk=chunk, top_k=top_k,
                     top_p=top_p),
@@ -566,6 +568,24 @@ class Engine:
                 self._callbacks[rid] = on_token
             self._m_queued.set(float(len(self._queue)), self._engine_label)
         return rid
+
+    def embed(self, tokens: list[int]) -> list[float]:
+        """Mean-pooled, L2-normalized final hidden state of ``tokens`` —
+        the embeddings surface (models.decode.embed_tokens).  Stateless
+        and slot-free: safe to call from any thread concurrently with the
+        decode loop (it touches neither the cache nor the queue); one
+        compile per prompt bucket, absorbed by ``warmup``."""
+        self._validate(
+            GenRequest(tokens=tokens, max_new_tokens=1)
+        )
+        bucket = self._bucket(len(tokens))
+        padded = jnp.asarray(
+            [tokens + [0] * (bucket - len(tokens))], jnp.int32
+        )
+        vec = self._embed(
+            self.params, padded, jnp.asarray([len(tokens)], jnp.int32)
+        )
+        return [float(x) for x in jax.device_get(vec[0])]
 
     def result(self, rid: int, timeout: float | None = None) -> list[int]:
         """Block until request ``rid`` completes; returns generated tokens
@@ -856,7 +876,7 @@ class Engine:
                 rid: list(toks) for rid, (toks, _) in self._results.items()
             }
 
-    def warmup(self) -> "Engine":
+    def warmup(self, embed: bool = False) -> "Engine":
         """Pre-compile every admit bucket and the whole chunk ladder.
 
         One dummy request per prompt bucket, sized so the chunk walks
@@ -883,14 +903,23 @@ class Engine:
             if self.prefix_cache_size:
                 # Compile the inject path per entry bucket: one request
                 # extending each cached dummy by one token (its tail
-                # rides the smallest bucket, already compiled above).
+                # rides the smallest bucket, already compiled above; the
+                # extended prompt must itself still fit a bucket).
                 for b in self.prompt_buckets:
-                    if b + self.prompt_buckets[0] > max_len - 1:
+                    if (
+                        b + self.prompt_buckets[0] > max_len - 1
+                        or b + 1 > self.prompt_buckets[-1]
+                    ):
                         continue
                     rids.append(self.submit(GenRequest(
                         tokens=[0] * (b + 1), max_new_tokens=1,
                     )))
                 self.run()
+            if embed:
+                # Optional: one full-forward compile per bucket — only
+                # deployments that actually serve /v1/embed should pay it.
+                for b in self.prompt_buckets:
+                    self.embed([0] * min(b, max_len - 1))
             for rid in rids:  # consume the dummies; warmup must not retain
                 self.result(rid, timeout=0)
             with self._lock:  # dummy prompts must not occupy live entries

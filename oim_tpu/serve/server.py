@@ -19,6 +19,9 @@ API:
                     "logprobs": true each line adds "logprob"),
                     terminated by {"done": true, "tokens": [...]} (plus
                     "logprobs": [...] when requested) or {"error": ...}.
+  POST /v1/embed      {"tokens": [int...]} → {"embedding": [float...],
+                    "dim": d} — mean-pooled, L2-normalized final hidden
+                    state (the embeddings surface).
   GET  /healthz      → {"ok": true}
   GET  /v1/stats     → engine stats (slots, queue depth, tokens generated)
   GET  /metrics      → Prometheus exposition (shared registry)
@@ -153,6 +156,9 @@ class ServeServer:
                     span.status = "error: client disconnected"
 
             def do_POST(self):
+                if self.path == "/v1/embed":
+                    self._embed_request()
+                    return
                 if self.path != "/v1/generate":
                     self._json(404, {"error": f"no such path {self.path}"})
                     return
@@ -170,6 +176,18 @@ class ServeServer:
                     "serve.generate", component="oim-serve", parent=parent,
                 ) as span:
                     self._generate(span)
+
+            def _embed_request(self) -> None:
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    vec = outer.engine.embed(
+                        [int(t) for t in body["tokens"]]
+                    )
+                except (KeyError, TypeError, ValueError) as exc:
+                    self._json(400, {"error": str(exc)})
+                    return
+                self._json(200, {"embedding": vec, "dim": len(vec)})
 
             def _generate(self, span) -> None:
                 try:

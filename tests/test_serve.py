@@ -650,6 +650,19 @@ def test_prefix_cache_int8(setup):
     assert results[rid] == want
 
 
+def test_warmup_with_custom_buckets_and_prefix_cache(setup):
+    """Regression: the inject-compile probe extends each cached bucket by
+    one token — which must itself still fit a bucket (custom ladders
+    whose top bucket is far below max_len used to crash warmup)."""
+    cfg, params = setup
+    engine = Engine(
+        params, cfg, n_slots=1, max_len=64, chunk=2,
+        prompt_buckets=(16, 32), prefix_cache_size=1,
+    )
+    engine.warmup()
+    assert engine.stats()["prefix_entries"] == 0
+
+
 def test_prefix_cache_off_by_default(setup):
     cfg, params = setup
     engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=2)
@@ -658,3 +671,53 @@ def test_prefix_cache_off_by_default(setup):
     engine.run()
     assert engine.stats()["prefix_entries"] == 0
     assert engine.stats()["prefix_hits"] == 0
+
+
+def test_embed(setup):
+    """Embeddings: padding-bucket invariant, unit-norm, matches the
+    direct forward oracle, and served over HTTP."""
+    from oim_tpu.models.decode import embed_tokens
+
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=2)
+    tokens = _prompt(3, 7, cfg.vocab_size)
+    vec = engine.embed(tokens)
+    assert len(vec) == cfg.d_model
+    np.testing.assert_allclose(np.linalg.norm(vec), 1.0, rtol=1e-5)
+    # Oracle: direct unpadded call.
+    want = np.asarray(embed_tokens(
+        params, jnp.asarray([tokens], jnp.int32),
+        jnp.asarray([len(tokens)], jnp.int32), cfg,
+    ))[0]
+    np.testing.assert_allclose(vec, want, rtol=1e-5, atol=1e-6)
+    # Padding to a different bucket must not change the embedding.
+    engine_big = Engine(
+        params, cfg, n_slots=1, max_len=64, chunk=2, prompt_buckets=(32,),
+    )
+    np.testing.assert_allclose(
+        engine_big.embed(tokens), want, rtol=1e-5, atol=1e-6
+    )
+    # Similar prompts embed closer than dissimilar ones.
+    near = engine.embed(tokens[:-1] + [(tokens[-1] + 1) % cfg.vocab_size])
+    far = engine.embed(_prompt(44, 7, cfg.vocab_size))
+    assert np.dot(vec, near) > np.dot(vec, far)
+
+    server = ServeServer(engine, port=0).start()
+    try:
+        body = json.dumps({"tokens": tokens}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/embed", data=body
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            payload = json.load(r)
+        assert payload["dim"] == cfg.d_model
+        np.testing.assert_allclose(payload["embedding"], want, rtol=1e-5,
+                                   atol=1e-6)
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/embed", data=b"{}"
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(bad, timeout=10)
+        assert err.value.code == 400
+    finally:
+        server.stop()
