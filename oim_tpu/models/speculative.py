@@ -1,0 +1,156 @@
+"""Speculative decoding with prompt-lookup drafting (greedy, batch 1).
+
+Sequential greedy decode runs one bandwidth-bound forward per token.
+Speculation verifies ``draft_len`` guessed tokens in ONE forward over
+``draft_len + 1`` positions — accepted guesses cost a fraction of a
+step each; the worst case degrades to exactly sequential decode (one
+real token per forward), never to wrong output:
+
+- **Drafting is assistant-free** (prompt lookup): the draft for the next
+  tokens is whatever followed the most recent earlier occurrence of the
+  last ``ngram`` generated/prompt tokens.  Free to compute, surprisingly
+  effective on extraction/summarization/code where outputs echo inputs;
+  useless-but-harmless on novel text.
+- **TPU-friendly shapes.**  Every iteration runs the same static
+  ``[1, draft_len + 1]`` verify forward inside a ``lax.while_loop``;
+  the history ring, cache, and n-gram search are all fixed-size with
+  masking — one compile total.
+- **Exactly greedy.**  Accepted tokens are provably the tokens
+  sequential greedy would emit (each is argmax given a fully-verified
+  prefix); rejected drafts roll the cache length back, and the stale
+  rows past it are masked until overwritten (the same overshoot argument
+  the serving engine's slot cache uses).  Asserted token-for-token
+  against ``generate`` in tests/test_decode.py.
+
+New work for the TPU build (SURVEY.md §2.3; the reference is a storage
+control plane).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from oim_tpu.models.decode import KVCache, _forward_cached
+from oim_tpu.models.transformer import TransformerConfig
+
+
+def _draft_ngram(history, length, draft_len: int, ngram: int):
+    """Prompt-lookup draft: the ``draft_len`` tokens that followed the
+    most recent earlier occurrence of the last ``ngram`` tokens.
+
+    history [T] int32 ring (first ``length`` valid).  Returns
+    (draft [draft_len], found bool).  No match → zeros drafts (they
+    simply fail verification; one real token still decodes).
+    """
+    t = history.shape[0]
+    query = jax.lax.dynamic_slice(history, (length - ngram,), (ngram,))
+    # windows[p] = history[p : p + ngram] (clipped gather; out-of-range
+    # rows are masked below).
+    idx = jnp.arange(t)[:, None] + jnp.arange(ngram)[None, :]
+    windows = history[jnp.clip(idx, 0, t - 1)]
+    matches = jnp.all(windows == query[None, :], axis=1)
+    # A candidate window must end before the query starts (no
+    # self-match); that also guarantees at least ngram follower tokens.
+    positions = jnp.arange(t)
+    matches = matches & (positions + ngram < length - ngram + 1)
+    found = jnp.any(matches)
+    best = jnp.max(jnp.where(matches, positions, -1))
+    start = jnp.clip(best + ngram, 0, t - draft_len)
+    draft = jax.lax.dynamic_slice(history, (start,), (draft_len,))
+    return jnp.where(found, draft, jnp.zeros_like(draft)), found
+
+
+def _speculative(
+    params,
+    prompt,
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    draft_len: int,
+    ngram: int,
+):
+    b, t = prompt.shape
+    if b != 1:
+        raise ValueError("speculative decoding is batch-1 (latency mode)")
+    # History ring: prompt + generated (+ headroom for the final
+    # overshoot of up to draft_len extra accepted tokens).
+    t_buf = t + max_new_tokens + draft_len + 1
+    cache = KVCache.create(cfg, 1, t_buf)
+    history = jnp.zeros((t_buf,), jnp.int32)
+    history = jax.lax.dynamic_update_slice(history, prompt[0], (0,))
+
+    # Prefill: cache holds the prompt; the first greedy token is decided
+    # but not yet fed (the invariant: cache.length == length - 1, i.e.
+    # every decided token except the newest has K/V rows).
+    logits, cache = _forward_cached(params, prompt, cache, cfg, True)
+    first = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+    history = jax.lax.dynamic_update_slice(history, first[None], (t,))
+    length = jnp.int32(t + 1)
+
+    def cond(carry):
+        _, _, length, _, _ = carry
+        return length - t < max_new_tokens
+
+    def body(carry):
+        cache, history, length, iters, accepted_total = carry
+        draft, _ = _draft_ngram(history, length, draft_len, ngram)
+        # Verify forward over [newest token, draft...] at the cache
+        # frontier: logits_i = distribution AFTER consuming input i.
+        last = jax.lax.dynamic_slice(history, (length - 1,), (1,))
+        inputs = jnp.concatenate([last, draft])[None]
+        logits, cache = _forward_cached(params, inputs, cache, cfg, False)
+        greedy = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+        # draft[i] survives iff every earlier draft matched too.
+        match = jnp.cumprod(
+            (draft == greedy[:draft_len]).astype(jnp.int32)
+        )
+        accepted = jnp.sum(match)  # 0..draft_len
+        # Emit greedy[0..accepted]: accepted+1 real tokens.
+        emitted = accepted + 1
+        keep = jnp.arange(draft_len + 1) < emitted
+        patch = jnp.where(
+            keep, greedy, jax.lax.dynamic_slice(
+                history, (length,), (draft_len + 1,)
+            )
+        )
+        history = jax.lax.dynamic_update_slice(history, patch, (length,))
+        length = length + emitted
+        # Roll back the cache past the verified prefix: rows for rejected
+        # draft inputs are stale garbage, masked until overwritten.
+        cache = KVCache(
+            k=cache.k, v=cache.v, length=length - 1,
+            k_scale=cache.k_scale, v_scale=cache.v_scale,
+        )
+        return cache, history, length, iters + 1, accepted_total + accepted
+
+    carry = (cache, history, length, jnp.int32(0), jnp.int32(0))
+    _, history, length, iters, accepted_total = jax.lax.while_loop(
+        cond, body, carry
+    )
+    out = jax.lax.dynamic_slice(history, (0,), (t + max_new_tokens,))
+    return out[None], {
+        "iterations": iters,
+        "drafts_accepted": accepted_total,
+        "tokens": jnp.int32(max_new_tokens),
+    }
+
+
+def make_speculative_fn(
+    cfg: TransformerConfig, draft_len: int = 4, ngram: int = 2
+):
+    """Jitted greedy ``(params, prompt [1, t], max_new_tokens) ->
+    (tokens [1, t + max_new], stats)`` with prompt-lookup speculation.
+    ``stats['iterations']`` counts verify forwards — sequential decode
+    would use ``max_new_tokens - 1`` of them (prefill already decides
+    the first token); fewer means speculation paid.
+    """
+    if draft_len < 1 or ngram < 1:
+        raise ValueError(
+            f"need draft_len>=1, ngram>=1; got {draft_len}, {ngram}"
+        )
+    return jax.jit(
+        partial(_speculative, cfg=cfg, draft_len=draft_len, ngram=ngram),
+        static_argnames=("max_new_tokens",),
+    )
