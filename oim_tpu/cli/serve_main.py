@@ -63,6 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
         "re-prefilling it",
     )
     p.add_argument(
+        "--weights-int8", action="store_true",
+        help="weight-only int8 for the matmul weights (per-output-channel "
+        "scales) — halves weight bytes, the small-batch decode bottleneck",
+    )
+    p.add_argument(
         "--kv-int8", action="store_true",
         help="int8-quantized KV cache (half the cache bandwidth decode "
         "pays; per-token/head scales)",
@@ -141,6 +146,10 @@ def make_engine(args):
                 params = ckpt.restore_params(lambda: template)
     else:
         params = init_params(jax.random.PRNGKey(0), cfg)
+    if args.weights_int8:
+        from oim_tpu.ops.quant import quantize_params_int8
+
+        params = quantize_params_int8(params)
     return Engine(
         params,
         cfg,

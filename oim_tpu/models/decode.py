@@ -40,8 +40,11 @@ from oim_tpu.models.transformer import (
     _unembed,
 )
 from oim_tpu.ops.quant import (
+    WEIGHT_QUANT_TARGETS,
     dequantize_int8,
+    dequantize_named,
     make_kv_buffers,
+    maybe_dequantize_weights,
     quantize_int8,
 )
 from oim_tpu.ops.rope import apply_rope
@@ -92,6 +95,10 @@ def _flat_layer_params(params: dict, cfg: TransformerConfig) -> dict:
     training-throughput construct with no benefit at t=1."""
     layer_names = {"attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
                    "router", "w_gate", "w_in", "w_out"}
+    # Weight-only int8 scale companions (only quantizable names get one).
+    layer_names |= {
+        f"{n}_wscale" for n in layer_names if n in WEIGHT_QUANT_TARGETS
+    }
     out = {}
     for name, value in params.items():
         if name in layer_names:
@@ -237,6 +244,7 @@ def _hidden_cached(
 
     def layer_step(x, scanned):
         lp, k_cache, v_cache, k_scale, v_scale = scanned
+        lp = maybe_dequantize_weights(lp)  # weight-only int8 serving
         x, (k_cache, v_cache, k_scale, v_scale) = _cached_attention(
             x, lp, k_cache, v_cache, k_scale, v_scale, start, cfg
         )
@@ -271,7 +279,7 @@ def _forward_cached(
 ):
     """``_hidden_cached`` + the unembedding: (logits, cache)."""
     x, new_cache = _hidden_cached(params, tokens, cache, cfg, is_prefill)
-    return _unembed(x, params["wlm"], cfg), new_cache
+    return _unembed(x, dequantize_named(params, "wlm"), cfg), new_cache
 
 
 def embed_tokens(params, tokens, true_lens, cfg: TransformerConfig):

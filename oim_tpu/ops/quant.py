@@ -38,6 +38,75 @@ def dequantize_int8(q, scale):
     return q.astype(jnp.float32) * scale[..., None]
 
 
+def quantize_weight_int8(w):
+    """Weight-only int8: symmetric per-output-channel max-abs over the
+    reduction (second-to-last) axis.  ``w [..., din, dout]`` →
+    (int8 [..., din, dout], f32 scales [..., dout]); dequantize with
+    ``q.astype(f32) * scale[..., None, :]``."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2)
+    scale = jnp.maximum(amax / _INT8_MAX, _EPS)
+    q = jnp.round(wf / scale[..., None, :]).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_weight_int8(q, scale):
+    """Inverse of ``quantize_weight_int8`` (XLA fuses the convert+scale
+    into the consuming matmul's operand read — HBM traffic stays int8)."""
+    return q.astype(jnp.float32) * scale[..., None, :]
+
+
+WEIGHT_QUANT_TARGETS = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_in", "w_out", "wlm",
+)
+
+
+def quantize_params_int8(params: dict) -> dict:
+    """Weight-only int8 for inference params: each matmul weight in
+    ``WEIGHT_QUANT_TARGETS`` becomes int8 with a ``<name>_wscale``
+    companion (per-output-channel, ``quantize_weight_int8``).  The
+    embedding (a gather, not a matmul), norms, and the MoE router (small,
+    deliberately f32) pass through.  Inference-only: the training path
+    never sees quantized params."""
+    out = {}
+    for name, value in params.items():
+        if name in WEIGHT_QUANT_TARGETS:
+            q, scale = quantize_weight_int8(value)
+            out[name] = q
+            out[f"{name}_wscale"] = scale
+        else:
+            out[name] = value
+    return out
+
+
+def dequantize_named(tree: dict, name: str):
+    """``tree[name]`` dequantized iff its ``_wscale`` companion exists —
+    THE one definition both the solo decode path and the serving engine
+    use for the unembedding, so they cannot diverge."""
+    value = tree[name]
+    scale = tree.get(f"{name}_wscale")
+    return dequantize_weight_int8(value, scale) if scale is not None else value
+
+
+def maybe_dequantize_weights(tree: dict) -> dict:
+    """Undo ``quantize_params_int8`` on any dict holding quantized
+    weights (full params or a per-layer slice): int8 leaves with a
+    ``_wscale`` companion dequantize; everything else passes through.
+    A no-op (same dict) on unquantized trees."""
+    if not any(name.endswith("_wscale") for name in tree):
+        return tree
+    out = {}
+    for name, value in tree.items():
+        if name.endswith("_wscale"):
+            continue
+        scale = tree.get(f"{name}_wscale")
+        out[name] = (
+            dequantize_weight_int8(value, scale) if scale is not None
+            else value
+        )
+    return out
+
+
 def make_kv_buffers(shape, compute_dtype, quantized: bool):
     """Zeroed (k, v, k_scale, v_scale) cache buffers for ``shape``
     [..., max_len, kv_heads, head_dim] — THE one definition of the

@@ -60,7 +60,12 @@ from oim_tpu.models.decode import (
     embed_tokens,
     truncate_logits,
 )
-from oim_tpu.ops.quant import make_kv_buffers, quantize_int8
+from oim_tpu.ops.quant import (
+    dequantize_named,
+    make_kv_buffers,
+    maybe_dequantize_weights,
+    quantize_int8,
+)
 from oim_tpu.models.transformer import (
     TransformerConfig,
     _rmsnorm,
@@ -197,6 +202,7 @@ def _forward_slots(params, tokens, kv, starts, cfg, is_prefill):
 
     def layer_step(x, scanned):
         lp, k_cache, v_cache, k_scale, v_scale = scanned
+        lp = maybe_dequantize_weights(lp)  # weight-only int8 serving
         x, (k_cache, v_cache, k_scale, v_scale) = _slot_attention(
             x, lp, k_cache, v_cache, k_scale, v_scale, starts, cfg
         )
@@ -212,7 +218,7 @@ def _forward_slots(params, tokens, kv, starts, cfg, is_prefill):
     # None scales are empty pytrees: lax.scan carries them untouched.
     x, kv = jax.lax.scan(layer_step, x, (flat, *kv))
     x = _rmsnorm(x, params["final_norm"], cfg)
-    return _unembed(x, params["wlm"], cfg), kv
+    return _unembed(x, dequantize_named(params, "wlm"), cfg), kv
 
 
 def _sample_batched(logits, temps, keys, top_k, top_p):
