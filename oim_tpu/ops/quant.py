@@ -79,32 +79,35 @@ def quantize_params_int8(params: dict) -> dict:
     return out
 
 
-def dequantize_named(tree: dict, name: str):
+def dequantize_named(tree: dict, name: str, dtype=None):
     """``tree[name]`` dequantized iff its ``_wscale`` companion exists —
-    THE one definition both the solo decode path and the serving engine
-    use for the unembedding, so they cannot diverge."""
+    THE one definition of the companion-key rule, used by the layer path
+    (via ``maybe_dequantize_weights``) and the unembedding alike.
+
+    ``dtype`` casts the dequantized weight (pass the compute dtype: a
+    f32 operand against bf16 activations would promote the matmul to
+    half MXU rate — the same discipline train's _cast_matmul_weights
+    keeps for master weights)."""
     value = tree[name]
     scale = tree.get(f"{name}_wscale")
-    return dequantize_weight_int8(value, scale) if scale is not None else value
+    if scale is None:
+        return value
+    deq = dequantize_weight_int8(value, scale)
+    return deq if dtype is None else deq.astype(dtype)
 
 
-def maybe_dequantize_weights(tree: dict) -> dict:
+def maybe_dequantize_weights(tree: dict, dtype=None) -> dict:
     """Undo ``quantize_params_int8`` on any dict holding quantized
-    weights (full params or a per-layer slice): int8 leaves with a
-    ``_wscale`` companion dequantize; everything else passes through.
-    A no-op (same dict) on unquantized trees."""
+    weights (full params or a per-layer slice); everything else passes
+    through.  A no-op (same dict) on unquantized trees.  ``dtype`` as in
+    ``dequantize_named``."""
     if not any(name.endswith("_wscale") for name in tree):
         return tree
-    out = {}
-    for name, value in tree.items():
-        if name.endswith("_wscale"):
-            continue
-        scale = tree.get(f"{name}_wscale")
-        out[name] = (
-            dequantize_weight_int8(value, scale) if scale is not None
-            else value
-        )
-    return out
+    return {
+        name: dequantize_named(tree, name, dtype)
+        for name in tree
+        if not name.endswith("_wscale")
+    }
 
 
 def make_kv_buffers(shape, compute_dtype, quantized: bool):

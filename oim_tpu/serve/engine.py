@@ -202,7 +202,7 @@ def _forward_slots(params, tokens, kv, starts, cfg, is_prefill):
 
     def layer_step(x, scanned):
         lp, k_cache, v_cache, k_scale, v_scale = scanned
-        lp = maybe_dequantize_weights(lp)  # weight-only int8 serving
+        lp = maybe_dequantize_weights(lp, cfg.compute_dtype)  # weight-int8
         x, (k_cache, v_cache, k_scale, v_scale) = _slot_attention(
             x, lp, k_cache, v_cache, k_scale, v_scale, starts, cfg
         )

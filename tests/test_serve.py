@@ -721,3 +721,41 @@ def test_embed(setup):
         assert err.value.code == 400
     finally:
         server.stop()
+
+
+def test_randomized_stress_matches_oracle(setup):
+    """Randomized workload: arbitrary prompts/budgets/EOS over a small
+    slot pool with staggered submission — every greedy result must equal
+    its solo oracle.  One seeded run (deterministic, no flake) covering
+    interleavings the targeted tests don't enumerate."""
+    cfg, params = setup
+    rng = np.random.RandomState(1234)
+    engine = Engine(
+        params, cfg, n_slots=3, max_len=64, chunk=4, prefix_cache_size=2,
+    )
+    pending = {}
+    for i in range(12):
+        n = int(rng.randint(1, 30))
+        m = int(rng.randint(1, 16))
+        tokens = rng.randint(0, cfg.vocab_size, size=n).tolist()
+        req = GenRequest(
+            tokens=tokens, max_new_tokens=m,
+            eos_id=int(rng.randint(0, cfg.vocab_size))
+            if rng.rand() < 0.3 else None,
+            cache_prefix=bool(rng.rand() < 0.3),
+        )
+        pending[engine.submit(req)] = req
+        for _ in range(int(rng.randint(0, 3))):  # stagger admissions
+            if engine.pending():
+                engine.step()
+    results = engine.run()
+    assert set(results) == set(pending)
+    for rid, req in pending.items():
+        full = _oracle(params, cfg, req.tokens, req.max_new_tokens)
+        want = full
+        if req.eos_id is not None and req.eos_id in full:
+            want = full[: full.index(req.eos_id) + 1]
+        assert results[rid] == want, (
+            f"request {rid} diverged (eos={req.eos_id}, "
+            f"n={len(req.tokens)}, m={req.max_new_tokens})"
+        )

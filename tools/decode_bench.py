@@ -49,6 +49,10 @@ def main() -> int:
     p.add_argument("--n-heads", type=int, default=16)
     p.add_argument("--d-ff", type=int, default=4096)
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument(
+        "--weights-int8", action="store_true",
+        help="also measure with weight-only int8 matmul weights",
+    )
     args = p.parse_args()
 
     import jax
@@ -77,6 +81,10 @@ def main() -> int:
             n_kv_heads=n_kv, d_ff=args.d_ff, dtype=args.dtype,
         )
         params = init_params(jax.random.PRNGKey(0), cfg)
+        if args.weights_int8:
+            from oim_tpu.ops.quant import quantize_params_int8
+
+            params = quantize_params_int8(params)
         gen = make_generate_fn(cfg)
         for kv_int8 in (False, True):
             out = gen(
@@ -92,6 +100,8 @@ def main() -> int:
             elapsed = time.perf_counter() - t0
             label = f"GQA-{n_kv}" if n_kv else "MHA"
             kv_label = "int8" if kv_int8 else args.dtype
+            if args.weights_int8:
+                label += "+w8"
             if elapsed <= rtt:
                 # The tunnel readback swamped the measurement; a negative
                 # dt would print nonsense tok/s.
