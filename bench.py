@@ -689,6 +689,31 @@ def _decode_diagnostics(extras, on_tpu, cfg, batch, params) -> None:
             f"bench: flagship decode {tok_s:.0f} tok/s "
             f"(batch={batch}, {new_tokens} new tokens in {dt*1000:.0f} ms)"
         )
+        if on_tpu:
+            # Quantized variants: int8 KV cache and weight-only int8 —
+            # the two bandwidth levers documented in doc/compute.md.
+            from oim_tpu.ops.quant import quantize_params_int8
+
+            for label, p, kv in (
+                ("decode_tok_per_s_kvint8", params, True),
+                (
+                    "decode_tok_per_s_w8kv8",
+                    quantize_params_int8(params),
+                    True,
+                ),
+            ):
+                np.asarray(gen_fn(
+                    p, prompt, max_new_tokens=new_tokens, kv_int8=kv
+                ))  # compile
+                t0 = time.perf_counter()
+                for _ in range(n_iter):
+                    out = gen_fn(
+                        p, prompt, max_new_tokens=new_tokens, kv_int8=kv
+                    )
+                np.asarray(out)
+                dt_q = (time.perf_counter() - t0 - rtt_s) / n_iter
+                extras[label] = round(batch * new_tokens / dt_q)
+                log(f"bench: {label} = {extras[label]} tok/s")
     except Exception as exc:  # pragma: no cover - diagnostics only
         log(f"bench: decode diagnostic skipped: {exc}")
 
