@@ -340,6 +340,11 @@ int main(int argc, char** argv) {
   g_server = &server;
   ::signal(SIGINT, HandleSignal);
   ::signal(SIGTERM, HandleSignal);
+  // A client that disconnects before reading its response must cost one
+  // EPIPE write error on that connection, never the daemon: without this
+  // the default SIGPIPE disposition kills the whole device plane (found
+  // by tests/test_agent_protocol.py's fuzz storm).
+  ::signal(SIGPIPE, SIG_IGN);
   std::fprintf(stderr, "tpu-agent serving %zu %s chips on %s\n",
                device_paths.size(), accel_type.c_str(), socket_path.c_str());
   server.Serve();

@@ -363,6 +363,13 @@ class FakeAgentServer:
                         line = self.rfile.readline()
                         if not line:
                             return
+                        if line == b"\n":
+                            # A bare newline is keepalive-benign and
+                            # skipped — exactly the C++ daemon's
+                            # `if (line.empty()) continue` (whitespace
+                            # lines dispatch and get a parse error on
+                            # both implementations).
+                            continue
                         response = _dispatch_line(store_ref, line)
                         self.wfile.write(
                             (json.dumps(response, separators=(",", ":")) + "\n")
@@ -438,7 +445,7 @@ def _dispatch_line(store: ChipStore, line: bytes) -> dict[str, Any]:
             "id": req_id,
             "error": {"code": exc.code, "message": exc.message},
         }
-    except json.JSONDecodeError as exc:
+    except (json.JSONDecodeError, UnicodeDecodeError, RecursionError) as exc:
         return {
             "jsonrpc": "2.0",
             "id": req_id,

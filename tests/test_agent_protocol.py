@@ -10,6 +10,7 @@ This is the analog of the reference's SPDK client round-trip tests
 """
 
 import json
+import random
 import socket
 import subprocess
 import time
@@ -240,3 +241,77 @@ def test_get_pjrt_info_always_served(agent_socket):
         info = agent.get_pjrt_info()
         assert isinstance(info, dict)
         assert info == {}  # fixtures start without a PJRT plugin
+
+
+def test_fuzz_storm_never_kills_daemon(agent_socket):
+    """Fuzz hardening for the device-plane daemon: a storm of random
+    bytes, truncated frames, abrupt disconnects, oversized garbage, and
+    schema-violating JSON must never crash it — every well-formed line
+    gets an error response, and a clean request still works afterwards
+    (the reference's device daemon survives arbitrary socket abuse the
+    same way; its control socket is a root-owned attack surface)."""
+    rng = random.Random(20260730)
+
+    corpus = [
+        b"",                                   # empty line
+        b"\x00\xff\xfe\x01" * 16,              # binary garbage
+        b"{" * 512,                            # nested open braces
+        b'{"jsonrpc": "2.0"',                  # truncated JSON
+        b'{"jsonrpc": "2.0", "id": null, "method": 3}',
+        b'{"jsonrpc": "2.0", "id": [1], "method": "get_chips"}',
+        b'{"jsonrpc": "2.0", "id": 1, "method": "create_allocation", '
+        b'"params": {"chip_count": -5}}',
+        b'{"jsonrpc": "2.0", "id": 1, "method": "create_allocation", '
+        b'"params": {"chip_count": 999999999999}}',
+        b'{"jsonrpc": "2.0", "id": 1, "method": "attach_allocation", '
+        b'"params": {"name": "' + b"A" * 4096 + b'"}}',
+        json.dumps({"jsonrpc": "2.0", "id": 1, "method": "get_chips",
+                    "params": {"deep": [[[[[0] * 64]]]]}}).encode(),
+    ]
+    for _ in range(60):
+        corpus.append(bytes(rng.randrange(32, 127) for _ in range(
+            rng.randrange(1, 200))))
+
+    probe = (
+        b'{"jsonrpc": "2.0", "id": 777, "method": "get_chips"}\n'
+    )
+    for i, payload in enumerate(corpus):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(5)
+        s.connect(agent_socket)
+        s.sendall(payload + b"\n")
+        if rng.random() < 0.3:
+            s.close()  # abrupt disconnect mid-conversation
+            continue
+        # Liveness probe on the SAME connection: whatever the daemon did
+        # with the garbage (error reply or blank-line skip), the
+        # connection must stay up and answer a clean request — a daemon
+        # that hangs up or goes silent on garbage fails.
+        f = s.makefile("rb")
+        answered = False
+        try:
+            s.sendall(probe)
+            for line in f:  # garbage replies (if any), then the probe's
+                resp = json.loads(line)
+                assert "error" in resp or "result" in resp, (i, resp)
+                if resp.get("id") == 777:
+                    assert "result" in resp, (i, resp)
+                    answered = True
+                    break
+        except (TimeoutError, ConnectionResetError, BrokenPipeError) as exc:
+            raise AssertionError(
+                f"payload {i} wedged the connection "
+                f"({type(exc).__name__}): {payload[:60]!r}"
+            )
+        finally:
+            f.close()  # the makefile dups the fd; leaking it would keep
+            s.close()  # old connections alive server-side
+        assert answered, (
+            f"payload {i} made the daemon drop the connection without "
+            f"answering the probe: {payload[:60]!r}"
+        )
+
+    # The daemon survived the storm: a clean request round-trips.
+    with Agent(agent_socket) as agent:
+        chips = agent.get_chips()
+        assert len(chips) == 8
