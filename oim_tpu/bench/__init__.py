@@ -1,5 +1,5 @@
 """Benchmark harnesses emitting perfdash-style results (oim_tpu.perftype)."""
 
-from oim_tpu.bench.allreduce import allreduce_bench
+from oim_tpu.bench.allreduce import COLLECTIVES, allreduce_bench, collective_bench
 
-__all__ = ["allreduce_bench"]
+__all__ = ["COLLECTIVES", "allreduce_bench", "collective_bench"]

@@ -46,20 +46,76 @@ def _percentiles(samples_s: list[float]) -> dict[str, float]:
     }
 
 
-def allreduce_bench(
+# Per-collective definitions: the shard-local op, the NCCL-convention
+# bus-bandwidth factor (per-link traffic / algorithm bytes), and a
+# correctness check on the result.  algbw denominator = per-chip shard
+# bytes for all_reduce (the caller's buffer), total bytes for the
+# resharding collectives (their "message" is the whole array).
+COLLECTIVES = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all")
+
+
+def _collective_ops(jax, jnp, n: int, per_chip: int):
+    def all_reduce(x):
+        return jax.lax.psum(x, "x")
+
+    def all_gather(x):
+        return jax.lax.all_gather(x, "x", tiled=True)
+
+    def reduce_scatter(x):
+        return jax.lax.psum_scatter(x, "x", tiled=True)
+
+    def all_to_all(x):
+        return jax.lax.all_to_all(
+            x.reshape(n, per_chip // n), "x", 0, 0, tiled=True
+        ).reshape(-1)
+
+    return {
+        "all_reduce": (all_reduce, lambda a: a * 2 * (n - 1) / n),
+        "all_gather": (all_gather, lambda a: a * (n - 1) / n),
+        "reduce_scatter": (reduce_scatter, lambda a: a * (n - 1) / n),
+        "all_to_all": (all_to_all, lambda a: a * (n - 1) / n),
+    }
+
+
+def _check(op: str, x, out, n: int, per_chip: int):
+    """The timed collective must actually be the collective."""
+    xf = np.asarray(x, dtype=np.float32).reshape(n, per_chip)
+    got = np.asarray(out, dtype=np.float32)
+    if op == "all_reduce":
+        np.testing.assert_allclose(got[:per_chip], xf.sum(0), rtol=2e-2)
+    elif op == "all_gather":
+        # out_specs=P(None): the replicated global result IS the full
+        # gathered array.
+        np.testing.assert_allclose(got, xf.reshape(-1), rtol=2e-2)
+    elif op == "reduce_scatter":
+        np.testing.assert_allclose(got, xf.sum(0), rtol=2e-2)
+    elif op == "all_to_all":
+        want = (
+            xf.reshape(n, n, per_chip // n)
+            .transpose(1, 0, 2)
+            .reshape(-1)
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-2)
+
+
+def collective_bench(
     devices=None,
     sizes_mb=DEFAULT_SIZES_MB,
     dtype: str = "bfloat16",
     iters: int = 10,
     warmup: int = 3,
     line_rate_gbps: float = 0.0,
+    ops=("all_reduce",),
 ) -> PerfData:
-    """Time ``psum`` over a 1-D mesh of ``devices`` and report GB/s/chip.
+    """Time XLA collectives over a 1-D mesh and report GB/s/chip.
 
     Runs on any backend: the 8-virtual-device CPU mesh validates the
-    plumbing and the collective's correctness; on a TPU slice the same
+    plumbing and each collective's correctness; on a TPU slice the same
     code measures real ICI.  ``line_rate_gbps`` (per-direction ICI link
     rate) adds a ``BusBwFraction`` bucket for the ≥90 % target.
+    ``ops`` ⊆ COLLECTIVES selects the matrix (all-reduce is the headline;
+    all-gather/reduce-scatter are its halves; all-to-all is the Ulysses
+    sequence-parallel primitive).
     """
     import jax
     import jax.numpy as jnp
@@ -70,19 +126,13 @@ def allreduce_bench(
     n = len(devices)
     mesh = Mesh(np.asarray(devices), ("x",))
     jdtype = jnp.dtype(dtype)
-
-    def _reduce(x):
-        return jax.lax.psum(x, "x")
-
-    reduce_step = jax.jit(
-        jax.shard_map(
-            _reduce, mesh=mesh, in_specs=P("x"), out_specs=P("x"), check_vma=False
-        )
-    )
+    unknown = set(ops) - set(COLLECTIVES)
+    if unknown:
+        raise ValueError(f"unknown collectives {sorted(unknown)}")
 
     perf = PerfData(
         labels={
-            "benchmark": "ici-all-reduce",
+            "benchmark": "ici-collectives",
             "devices": str(n),
             "dtype": dtype,
             "backend": devices[0].platform,
@@ -90,46 +140,68 @@ def allreduce_bench(
     )
     for size_mb in sizes_mb:
         per_chip = int(size_mb * 2**20 // jdtype.itemsize)
+        if "all_to_all" in ops:
+            # all_to_all splits the shard by n; don't perturb the other
+            # collectives' buffer (the sizeMB label must stay accurate).
+            per_chip -= per_chip % max(n, 1)
+        table = _collective_ops(jax, jnp, n, per_chip)
         sharding = NamedSharding(mesh, P("x"))
         x = jax.device_put(
             jnp.arange(per_chip * n, dtype=jnp.float32).astype(jdtype),
             sharding,
         )
-        # Correctness first (the collective must actually reduce): compare
-        # one shard against the expected sum of n identical shards... each
-        # shard differs, so check the global invariant on a small slice.
-        reduced = reduce_step(x)
-        expected = np.asarray(
-            jnp.sum(
-                np.asarray(x, dtype=np.float32).reshape(n, per_chip), axis=0
-            ),
-            dtype=np.float32,
-        )
-        got = np.asarray(reduced, dtype=np.float32)[:per_chip]
-        np.testing.assert_allclose(got, expected, rtol=2e-2)
-
-        for _ in range(warmup):
-            reduce_step(x).block_until_ready()
-        samples = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            reduce_step(x).block_until_ready()
-            samples.append(time.perf_counter() - t0)
-        latency = _percentiles(samples)
-        best = min(samples)
-        bytes_per_chip = per_chip * jdtype.itemsize
-        algbw = bytes_per_chip / best / 1e9
-        busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
-        buckets = {
-            **latency,
-            "AlgBwGBps": algbw,
-            "BusBwGBps": busbw,
-        }
-        if line_rate_gbps > 0:
-            buckets["BusBwFraction"] = busbw / line_rate_gbps
-        perf.add(
-            unit="ms",
-            labels={"sizeMB": str(size_mb), "metricOf": "latency+bandwidth"},
-            **buckets,
-        )
+        for op in ops:
+            fn, bus_factor = table[op]
+            step = jax.jit(
+                jax.shard_map(
+                    fn, mesh=mesh, in_specs=P("x"),
+                    out_specs=P(None) if op == "all_gather" else P("x"),
+                    check_vma=False,
+                )
+            )
+            out = step(x)
+            _check(op, x, out, n, per_chip)
+            for _ in range(warmup):
+                step(x).block_until_ready()
+            samples = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                step(x).block_until_ready()
+                samples.append(time.perf_counter() - t0)
+            latency = _percentiles(samples)
+            best = min(samples)
+            shard_bytes = per_chip * jdtype.itemsize
+            # NCCL convention: the "message" is the per-rank buffer for
+            # all_reduce/reduce_scatter/all_to_all (each chip's input is
+            # one shard) and the total array for all_gather (whose output
+            # is n shards) — anything else inflates busbw past the line
+            # rate, which would mask the underperforming links the >=90%
+            # target exists to catch.
+            msg_bytes = (
+                shard_bytes * n if op == "all_gather" else shard_bytes
+            )
+            algbw = msg_bytes / best / 1e9
+            busbw = bus_factor(algbw) if n > 1 else algbw
+            buckets = {
+                **latency,
+                "AlgBwGBps": algbw,
+                "BusBwGBps": busbw,
+            }
+            if line_rate_gbps > 0:
+                buckets["BusBwFraction"] = busbw / line_rate_gbps
+            perf.add(
+                unit="ms",
+                labels={
+                    "sizeMB": str(size_mb),
+                    "collective": op,
+                    "metricOf": "latency+bandwidth",
+                },
+                **buckets,
+            )
     return perf
+
+
+def allreduce_bench(*args, **kwargs) -> PerfData:
+    """The headline metric (BASELINE.md): ``collective_bench`` restricted
+    to all-reduce."""
+    return collective_bench(*args, ops=("all_reduce",), **kwargs)

@@ -4,6 +4,8 @@ from __future__ import annotations
 
 import json
 
+import pytest
+
 from oim_tpu import perftype
 from oim_tpu.bench import allreduce_bench
 
@@ -55,4 +57,27 @@ def test_ici_bench_cli(capsys):
                      "--dtype", "float32"]) == 0
     out = capsys.readouterr().out
     results = perftype.parse(out)
-    assert results and results[0].labels["benchmark"] == "ici-all-reduce"
+    assert results and results[0].labels["benchmark"] == "ici-collectives"
+
+
+def test_collective_matrix_cpu_mesh():
+    """All four collectives run, verify their own semantics, and report
+    bandwidth buckets on the virtual CPU mesh."""
+    from oim_tpu.bench import COLLECTIVES, collective_bench
+
+    perf = collective_bench(
+        sizes_mb=(0.25,), dtype="float32", iters=2, warmup=1,
+        line_rate_gbps=100.0, ops=COLLECTIVES,
+    )
+    items = perf.to_json()["dataItems"]
+    assert {i["labels"]["collective"] for i in items} == set(COLLECTIVES)
+    for item in items:
+        assert item["data"]["BusBwGBps"] > 0
+        assert 0 < item["data"]["BusBwFraction"]
+
+
+def test_collective_unknown_op_rejected():
+    from oim_tpu.bench import collective_bench
+
+    with pytest.raises(ValueError, match="unknown collectives"):
+        collective_bench(sizes_mb=(0.25,), ops=("broadcastify",))

@@ -43,6 +43,11 @@ def main(argv=None) -> int:
         "bucket for the >=90%% target",
     )
     parser.add_argument(
+        "--ops", nargs="+", default=["all_reduce"],
+        help="collectives to measure (all_reduce all_gather "
+        "reduce_scatter all_to_all, or 'all')",
+    )
+    parser.add_argument(
         "--bootstrap",
         default="",
         help="path to a CSI-staged tpu-bootstrap.json; joins the slice's "
@@ -58,9 +63,11 @@ def main(argv=None) -> int:
 
         initialize_distributed(load_bootstrap(args.bootstrap))
 
-    from oim_tpu.bench import allreduce_bench
+    from oim_tpu.bench import COLLECTIVES, collective_bench
 
-    perf = allreduce_bench(
+    ops = tuple(COLLECTIVES) if args.ops == ["all"] else tuple(args.ops)
+    perf = collective_bench(
+        ops=ops,
         sizes_mb=tuple(args.sizes_mb),
         dtype=args.dtype,
         iters=args.iters,
