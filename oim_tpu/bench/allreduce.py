@@ -139,19 +139,19 @@ def collective_bench(
         }
     )
     for size_mb in sizes_mb:
-        per_chip = int(size_mb * 2**20 // jdtype.itemsize)
-        if "all_to_all" in ops:
-            # all_to_all splits the shard by n; don't perturb the other
-            # collectives' buffer (the sizeMB label must stay accurate).
-            per_chip -= per_chip % max(n, 1)
-        table = _collective_ops(jax, jnp, n, per_chip)
+        base_per_chip = int(size_mb * 2**20 // jdtype.itemsize)
         sharding = NamedSharding(mesh, P("x"))
-        x = jax.device_put(
-            jnp.arange(per_chip * n, dtype=jnp.float32).astype(jdtype),
-            sharding,
-        )
         for op in ops:
-            fn, bus_factor = table[op]
+            # all_to_all splits the shard by n; round ITS buffer only so
+            # the other collectives' sizeMB label stays exact.
+            per_chip = base_per_chip
+            if op == "all_to_all":
+                per_chip -= per_chip % max(n, 1)
+            x = jax.device_put(
+                jnp.arange(per_chip * n, dtype=jnp.float32).astype(jdtype),
+                sharding,
+            )
+            fn, bus_factor = _collective_ops(jax, jnp, n, per_chip)[op]
             step = jax.jit(
                 jax.shard_map(
                     fn, mesh=mesh, in_specs=P("x"),

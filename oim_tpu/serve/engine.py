@@ -366,6 +366,10 @@ class GenRequest:
     temperature: float = 0.0
     seed: int = 0
     eos_id: int | None = None
+    # Additional stop tokens: generation ends at the first token in this
+    # set (emitted, like eos_id).  For multi-token stop SEQUENCES do the
+    # matching client-side — the engine is tokenizer-agnostic.
+    stop_ids: tuple[int, ...] = ()
     # Store this request's prompt KV in the engine's prefix cache after
     # admission (mark system prompts); later prompts sharing the prefix
     # skip re-prefilling it.
@@ -711,7 +715,7 @@ class Engine:
         """Record one generated token; True when the request is done."""
         state.emitted.append(token)
         state.logprobs.append(logprob)
-        if state.req.eos_id is not None and token == state.req.eos_id:
+        if token == state.req.eos_id or token in state.req.stop_ids:
             return True
         state.last_token = token
         return len(state.emitted) >= state.req.max_new_tokens

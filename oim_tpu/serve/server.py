@@ -9,7 +9,7 @@ API:
   POST /v1/generate   {"tokens": [int...], "max_new_tokens": N,
                        "temperature": 0.0, "seed": 0, "eos_id": null,
                        "stream": false, "logprobs": false,
-                       "cache_prefix": false}
+                       "cache_prefix": false, "stop_ids": []}
                     → {"tokens": [int...]}   (generated only, EOS included;
                     "logprobs": true adds each token's log-softmax under
                     the model's raw temperature-1 distribution)
@@ -202,6 +202,9 @@ class ServeServer:
                             int(body["eos_id"])
                             if body.get("eos_id") is not None
                             else None
+                        ),
+                        stop_ids=tuple(
+                            int(t) for t in body.get("stop_ids", ())
                         ),
                         cache_prefix=bool(body.get("cache_prefix")),
                     )

@@ -759,3 +759,32 @@ def test_randomized_stress_matches_oracle(setup):
             f"request {rid} diverged (eos={req.eos_id}, "
             f"n={len(req.tokens)}, m={req.max_new_tokens})"
         )
+
+
+def test_stop_ids(setup):
+    """Generation ends at the first token in stop_ids (emitted, like
+    eos_id), whichever of the stop set or eos comes first."""
+    cfg, params = setup
+    tokens = _prompt(5, 6, cfg.vocab_size)
+    full = _oracle(params, cfg, tokens, 12)
+    stop = full[2]
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=4)
+    rid = engine.submit(GenRequest(
+        tokens=tokens, max_new_tokens=12, stop_ids=(stop, 100_000)
+    ))
+    results = engine.run()
+    assert results[rid] == full[: full.index(stop) + 1]
+
+    server = ServeServer(engine, port=0).start()
+    try:
+        body = json.dumps({
+            "tokens": tokens, "max_new_tokens": 12, "stop_ids": [stop],
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate", data=body
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            payload = json.load(r)
+        assert payload["tokens"] == full[: full.index(stop) + 1]
+    finally:
+        server.stop()

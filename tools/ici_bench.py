@@ -44,8 +44,9 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--ops", nargs="+", default=["all_reduce"],
-        help="collectives to measure (all_reduce all_gather "
-        "reduce_scatter all_to_all, or 'all')",
+        choices=["all_reduce", "all_gather", "reduce_scatter",
+                 "all_to_all", "all"],
+        help="collectives to measure ('all' = the whole matrix)",
     )
     parser.add_argument(
         "--bootstrap",
