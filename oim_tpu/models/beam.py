@@ -1,0 +1,144 @@
+"""Beam search decoding (batch 1, static shapes).
+
+The remaining decoding mode next to greedy/temperature/top-k/top-p
+(``models.decode``) and speculation (``models.speculative``): keep the
+``beam_size`` highest-scoring hypotheses, expanding all of them in one
+batched forward per step — TPU-friendly: the beams ARE the batch, the
+per-step reorder is a gather on the cache's batch axis, and the whole
+search is one ``lax.scan`` (one compile).
+
+EOS-aware: a beam that emits ``eos_id`` freezes (its score stops
+accumulating; it keeps competing in the running top-k), and the final
+pick applies GNMT length normalization ``score / ((5+len)/6)**alpha``
+so longer finished hypotheses aren't unfairly penalized.
+
+New work for the TPU build (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from oim_tpu.models.decode import (
+    _NEG_BIG,
+    KVCache,
+    _forward_cached,
+    prefill,
+)
+from oim_tpu.models.transformer import TransformerConfig
+
+
+def _gather_cache(cache: KVCache, parents) -> KVCache:
+    """Reorder the beam (batch) axis by ``parents`` [k]."""
+    take = lambda a: None if a is None else jnp.take(a, parents, axis=1)
+    return KVCache(
+        k=take(cache.k),
+        v=take(cache.v),
+        length=cache.length,
+        k_scale=take(cache.k_scale),
+        v_scale=take(cache.v_scale),
+    )
+
+
+def _beam(
+    params,
+    prompt,
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    beam_size: int,
+    alpha: float,
+    eos_id: int | None,
+):
+    b, t = prompt.shape
+    if b != 1:
+        raise ValueError("beam search is batch-1 (the beams are the batch)")
+    k = beam_size
+    max_len = t + max_new_tokens
+    vocab = cfg.vocab_size
+
+    logits, cache = prefill(params, prompt, cfg, max_len)
+    logp0 = jax.nn.log_softmax(logits[0, -1].astype(jnp.float32))
+    # Seed: top-k first tokens of the single prompt hypothesis.
+    scores, first = jax.lax.top_k(logp0, k)  # [k], [k]
+    # Replicate the prompt's cache across the beam axis.
+    cache = _gather_cache(cache, jnp.zeros((k,), jnp.int32))
+    seqs = jnp.zeros((k, max_new_tokens), jnp.int32)
+    seqs = seqs.at[:, 0].set(first)
+    finished = (
+        first == eos_id if eos_id is not None
+        else jnp.zeros((k,), bool)
+    )
+    lengths = jnp.ones((k,), jnp.int32)  # generated tokens per beam
+
+    def step(carry, i):
+        cache, seqs, scores, finished, lengths = carry
+        last = jnp.take_along_axis(seqs, (i - 1)[None, None], axis=1)  # [k,1]
+        logits, cache = _forward_cached(params, last, cache, cfg, False)
+        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [k,V]
+        if eos_id is not None:
+            # Frozen beams propose exactly one continuation (token 0) at
+            # no cost, so they keep competing without growing.  Static
+            # branch: without an eos nothing ever freezes and the mask
+            # would be a provable no-op XLA cannot fold (scan carry).
+            pad_row = jnp.full((vocab,), _NEG_BIG).at[0].set(0.0)
+            logp = jnp.where(finished[:, None], pad_row[None, :], logp)
+        total = scores[:, None] + logp  # [k, V]
+        scores, flat = jax.lax.top_k(total.reshape(-1), k)
+        parents = flat // vocab
+        tokens = flat % vocab
+        cache = _gather_cache(cache, parents)
+        seqs = jnp.take(seqs, parents, axis=0)
+        if eos_id is not None:
+            finished = jnp.take(finished, parents)
+            lengths = jnp.take(lengths, parents)
+            tokens = jnp.where(finished, 0, tokens)
+            lengths = lengths + (~finished).astype(jnp.int32)
+        else:
+            lengths = lengths + 1
+        seqs = seqs.at[:, i].set(tokens)
+        if eos_id is not None:
+            finished = finished | (tokens == eos_id)
+        return (cache, seqs, scores, finished, lengths), None
+
+    if max_new_tokens > 1:
+        (cache, seqs, scores, finished, lengths), _ = jax.lax.scan(
+            step,
+            (cache, seqs, scores, finished, lengths),
+            jnp.arange(1, max_new_tokens),
+        )
+    # GNMT length normalization over generated length.
+    norm = ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** alpha
+    best = jnp.argmax(scores / norm)
+    out = jnp.concatenate([prompt[0], seqs[best]])[None]
+    return out, {
+        "score": scores[best],
+        "normalized_score": (scores / norm)[best],
+        "length": lengths[best],
+    }
+
+
+def make_beam_search_fn(
+    cfg: TransformerConfig,
+    beam_size: int = 4,
+    alpha: float = 0.6,
+    eos_id: int | None = None,
+):
+    """Jitted ``(params, prompt [1, t], max_new_tokens) ->
+    (tokens [1, t + max_new], stats)``.  ``stats['score']`` is the best
+    hypothesis's total logprob; with ``eos_id`` set, tokens after a
+    beam's EOS are 0-padding and ``stats['length']`` bounds the real
+    generation."""
+    if not 1 <= beam_size <= cfg.vocab_size:
+        raise ValueError(
+            f"beam_size must be in [1, vocab_size={cfg.vocab_size}], "
+            f"got {beam_size}"
+        )
+    return jax.jit(
+        partial(
+            _beam, cfg=cfg, beam_size=beam_size, alpha=alpha, eos_id=eos_id
+        ),
+        static_argnames=("max_new_tokens",),
+    )
