@@ -13,6 +13,8 @@ from oim_tpu.models.transformer import (
     forward_local,
     param_pspecs,
 )
+from oim_tpu.models.beam import make_beam_search_fn
+from oim_tpu.models.speculative import make_speculative_fn
 from oim_tpu.models.train import (
     TrainState,
     data_pspec,
@@ -40,7 +42,9 @@ __all__ = [
     "forward_local",
     "param_pspecs",
     "TrainState",
+    "make_beam_search_fn",
     "make_eval_step",
+    "make_speculative_fn",
     "make_train_loop",
     "make_train_step",
     "data_pspec",
