@@ -43,9 +43,12 @@ def _prompt(seed: int, n: int, vocab: int) -> list[int]:
     return rng.randint(0, vocab, size=n).tolist()
 
 
-def _oracle(params, cfg, tokens: list[int], max_new: int) -> list[int]:
+def _oracle(
+    params, cfg, tokens: list[int], max_new: int, kv_int8: bool = False
+) -> list[int]:
     prompt = jnp.asarray(tokens, jnp.int32)[None]
-    out = generate(params, prompt, cfg, max_new_tokens=max_new)
+    out = generate(params, prompt, cfg, max_new_tokens=max_new,
+                   kv_int8=kv_int8)
     return np.asarray(out)[0, len(tokens):].tolist()
 
 
@@ -191,11 +194,9 @@ def test_kv_int8_engine_matches_solo_int8(setup):
     }
     results = engine.run()
     for rid, s in reqs.items():
-        prompt = jnp.asarray(_prompt(s, 5 + s, cfg.vocab_size), jnp.int32)
-        want = np.asarray(
-            generate(params, prompt[None], cfg, max_new_tokens=7,
-                     kv_int8=True)
-        )[0, 5 + s:].tolist()
+        want = _oracle(
+            params, cfg, _prompt(s, 5 + s, cfg.vocab_size), 7, kv_int8=True
+        )
         assert results[rid] == want
 
 
@@ -820,8 +821,6 @@ def test_randomized_stress_int8_and_sampling(setup):
     for req, got in zip(reqs, first):
         assert len(got) == req.max_new_tokens
         if req.temperature == 0.0:
-            want = np.asarray(generate(
-                params, jnp.asarray(req.tokens, jnp.int32)[None], cfg,
-                max_new_tokens=req.max_new_tokens, kv_int8=True,
-            ))[0, len(req.tokens):].tolist()
-            assert got == want
+            assert got == _oracle(
+                params, cfg, req.tokens, req.max_new_tokens, kv_int8=True
+            )
