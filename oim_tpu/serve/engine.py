@@ -38,6 +38,14 @@ dynamic page tables + gather kernels):
 The engine itself is host-side Python (the analog of the reference's
 control-plane daemons); everything that touches the accelerator is a
 handful of jitted functions with donated cache buffers.
+
+Also here: per-token logprobs (``result_full`` / the streaming
+callback), an LRU prompt-KV **prefix cache** for system prompts
+(``prefix_cache_size`` + ``GenRequest.cache_prefix`` — injected rows
+are exact for dense models), ``stop_ids``, a slot-free ``embed``
+surface, int8 KV (``kv_int8``) and weight-only int8 params (both
+preserve the exactness invariant), Prometheus instrumentation, and
+``warmup``/``abort``/``forget`` lifecycle discipline for daemon use.
 """
 
 from __future__ import annotations
