@@ -1,11 +1,15 @@
 """Training: loss, gradients, optimizer step over the full mesh.
 
 The train step wraps ``forward_local`` in ``shard_map`` (manual dp/sp/pp,
-auto tp/ep), computes the next-token loss with exact sequence-shard
-boundary handling (the label for a shard's last token is fetched from the
-next shard with a one-hop ppermute), takes per-device gradients — the
-collective transposes of pmean/psum/ppermute make them globally correct —
-and applies optax updates outside, where GSPMD keeps parameter math sharded.
+auto tp/ep) and differentiates a purely LOCAL objective with static
+normalizers (``_local_objective`` — no collective touches the loss
+scalar, because the psum transpose inside shard_map re-sums cotangents
+and would inflate per-device grads by the mesh size); the explicit
+per-axis psums in ``spmd_value_and_grad`` then reduce the per-device
+grads to the exact global gradient.  Next-token loss handles sequence-
+shard boundaries exactly (the label for a shard's last token is fetched
+from the next shard with a one-hop ppermute).  Optax updates apply
+outside, where GSPMD keeps parameter math sharded.
 """
 
 from __future__ import annotations
@@ -90,33 +94,61 @@ def _masked_ce_sum(logits, labels, valid):
     return jnp.sum(nll * valid), jnp.sum(valid.astype(jnp.float32))
 
 
-def _local_loss(params, tokens, cfg: TransformerConfig):
-    """Per-device loss over the local [b, t] token shard.
+def _global_metrics(obj, ce_sum, ce_count):
+    """Forward-only psums turning ``_local_objective``'s per-device terms
+    into the replicated (loss, ce) metrics.  Σ_mesh obj is the global
+    objective by construction (static normalizers)."""
+    loss = jax.lax.psum(obj, ("dp", "sp", "pp"))
+    ce = jax.lax.psum(ce_sum, ("dp", "sp", "pp")) / jax.lax.psum(
+        ce_count, ("dp", "sp", "pp")
+    )
+    return loss, ce
 
-    The cross-entropy terms are masked to the *last* pipeline stage before
-    the psum: under pp only the last stage's pipeline outputs are real
-    (other stages hold zeros — see ``gpipe_spmd``), so the mask selects the
-    one stage whose logits mean anything, keeps the loss unscaled, and
-    sends head/final-norm gradient contributions from exactly one stage so
-    the later per-axis gradient psums in ``make_train_step`` are uniform.
+
+def _local_loss(params, tokens, cfg: TransformerConfig):
+    """Globally-reduced (loss, ce) over the local [b, t] token shard —
+    the METRIC path (eval).  One definition with the grad path: the same
+    ``_local_objective`` terms, reduced by ``_global_metrics``."""
+    obj, (ce_sum, ce_count) = _local_objective(params, tokens, cfg)
+    return _global_metrics(obj, ce_sum, ce_count)
+
+
+def _local_objective(params, tokens, cfg: TransformerConfig):
+    """Per-device slice of the global training objective — what autodiff
+    differentiates.
+
+    NO collective touches the returned scalar, deliberately: inside
+    shard_map the transpose of ``psum`` re-sums cotangents across devices
+    (every device's backward seed becomes the sum of all devices'), so
+    differentiating an already-psum'd loss inflates the per-device
+    gradients by the psum'd axes' total size — and by *inconsistent*
+    factors when the CE psums over dp·sp·pp while the aux pmeans over
+    dp·sp, bending the gradient direction on MoE models.  Instead the
+    objective is purely local with STATIC normalizers: summing it over
+    the whole mesh equals ``_local_loss``'s value, and the per-device
+    autodiff grads psum to the exact global gradient
+    (finite-difference-checked in tests/test_parallel.py).
+
+    Returns ``(obj, (ce_sum, ce_count))`` with the CE terms masked to the
+    last pipeline stage (the one whose logits are real) so the caller can
+    reconstruct the ce metric with forward-only psums.
     """
     logits, aux = forward_local(params, tokens, cfg)
     labels, valid, _ = _shifted_labels(tokens)
-
     ce_sum, ce_count = _masked_ce_sum(logits, labels, valid)
     is_last_stage = (
         jax.lax.axis_index("pp") == jax.lax.axis_size("pp") - 1
     ).astype(jnp.float32)
-    local_sum = ce_sum * is_last_stage
-    local_count = ce_count * is_last_stage
-
-    total = jax.lax.psum(local_sum, ("dp", "sp", "pp"))
-    count = jax.lax.psum(local_count, ("dp", "sp", "pp"))
-    ce = total / count
-    # The MoE aux loss comes from per-device routing statistics; average it
-    # across data/sequence shards so every rank optimizes the same scalar.
-    aux = jax.lax.pmean(aux, ("dp", "sp"))
-    return ce + AUX_LOSS_WEIGHT * aux, ce
+    ce_sum = ce_sum * is_last_stage
+    ce_count = ce_count * is_last_stage
+    b, t_local = tokens.shape
+    dp_size = jax.lax.axis_size("dp")
+    sp_size = jax.lax.axis_size("sp")
+    # Every label position except each sequence's global last is valid, on
+    # every data shard — a static count (== psum(ce_count) over the mesh).
+    c_global = float(b * dp_size * (t_local * sp_size - 1))
+    obj = ce_sum / c_global + AUX_LOSS_WEIGHT * aux / (dp_size * sp_size)
+    return obj, (ce_sum, ce_count)
 
 
 def make_train_step(
@@ -222,9 +254,12 @@ def _build_value_and_grad(cfg: TransformerConfig, mesh):
     use_1f1b = cfg.pp_schedule == "1f1b" and cfg.n_stages > 1
 
     def autodiff_value_and_grad(params, tokens):
-        (loss, ce), grads = jax.value_and_grad(
-            partial(_local_loss, cfg=cfg), has_aux=True
+        (obj, (ce_sum, ce_count)), grads = jax.value_and_grad(
+            partial(_local_objective, cfg=cfg), has_aux=True
         )(params, tokens)
+        # Metric reductions happen OUTSIDE the differentiated scalar (see
+        # _local_objective on why a psum'd loss breaks the gradients).
+        loss, ce = _global_metrics(obj, ce_sum, ce_count)
         return loss, ce, grads
 
     def spmd_value_and_grad(params, tokens):

@@ -439,7 +439,10 @@ def forward_local(
     """Per-device forward: local token shard → local f32 logits + aux loss.
 
     tokens: [batch_local, seq_local].  Must run inside shard_map with
-    manual axes {'dp', 'sp', 'pp'}.
+    manual axes {'dp', 'sp', 'pp'}.  The returned aux is PER-DEVICE (this
+    pipeline stage's own layers only) — psum over ``pp`` for the global
+    value; keeping collectives out of it lets the train step differentiate
+    a purely local objective (models/train.py ``_local_objective``).
     """
     sp_size = jax.lax.axis_size("sp")
     sp_index = jax.lax.axis_index("sp")
@@ -473,7 +476,6 @@ def forward_local(
         x = x.reshape(b, t_local, cfg.d_model)
     else:
         x, aux = run_stage(stage_params, x)
-        aux = jax.lax.psum(aux, "pp")  # no-op at size 1, keeps types uniform
 
     x = _rmsnorm(x, params["final_norm"], cfg)
     logits = _unembed(x, params["wlm"], cfg)

@@ -59,8 +59,12 @@ def gpipe_spmd(
     Returns ``(outputs, aux)``: outputs ``[n_micro, mb, ...]`` are REAL ONLY
     ON THE LAST STAGE (zeros elsewhere — the caller's loss must mask to the
     last stage, which also keeps replicated-param gradients single-sourced);
-    ``aux`` is the mean-over-microbatches auxiliary loss, psum'd over the
-    pipeline axis (bubble steps are masked out).
+    ``aux`` is THIS stage's mean-over-microbatches auxiliary loss (bubble
+    steps masked out) — the caller psums over the pipeline axis for the
+    global value.  Kept per-stage deliberately: inside shard_map the
+    transpose of psum re-sums cotangents across devices, so a psum
+    buried in a differentiated loss inflates its gradients by the axis
+    size (see models/train.py ``_local_objective``).
     """
     size = jax.lax.axis_size(axis_name)
     index = jax.lax.axis_index(axis_name)
@@ -121,10 +125,9 @@ def gpipe_spmd(
     (_, outputs, aux_sum), _ = jax.lax.scan(
         step, (state0, outputs0, aux0), jnp.arange(total_steps)
     )
-    # Each stage saw every microbatch once; aggregate the per-stage layer
-    # contributions and average over microbatches to match the non-pp path.
-    aux = jax.lax.psum(aux_sum, axis_name) / n_micro
-    return outputs, aux
+    # Each stage saw every microbatch once; average over microbatches to
+    # match the non-pp path (per-stage — the caller psums over ``pp``).
+    return outputs, aux_sum / n_micro
 
 
 def pipeline_1f1b_value_and_grad(

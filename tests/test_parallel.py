@@ -238,12 +238,12 @@ class TestPipeline:
                 "pp",
             )
             # Outputs are real only on the last stage; replicate them the
-            # way a loss would (masked psum) for comparison, and return the
-            # aux to check bubble masking: each stage contributes 1.0 per
-            # real microbatch -> sum/n_micro = n_stages.
+            # way a loss would (masked psum) for comparison, and psum the
+            # per-stage aux to check bubble masking: each stage contributes
+            # 1.0 per real microbatch -> psum(sum/n_micro) = n_stages.
             idx = jax.lax.axis_index("pp")
             mask = (idx == jax.lax.axis_size("pp") - 1).astype(out.dtype)
-            return jax.lax.psum(out * mask, "pp"), aux
+            return jax.lax.psum(out * mask, "pp"), jax.lax.psum(aux, "pp")
 
         piped, aux = jax.shard_map(
             piped_fn,
@@ -465,6 +465,78 @@ class Test1F1B:
         np.testing.assert_allclose(
             np.asarray(d_x), np.asarray(ref_d_x), rtol=1e-4, atol=1e-5
         )
+
+
+class TestTrainGradients:
+    """Model-level gradient gates on the full sharded train step.
+
+    These exist because a loss-only agreement check (1e-2 on a ~5.0
+    loss) once hid an 8× gradient inflation: inside shard_map the
+    transpose of psum re-sums cotangents, so differentiating a psum'd
+    loss multiplies per-device grads by the mesh size (see
+    models/train.py ``_local_objective``).
+    """
+
+    def _setup(self, pp_schedule="gpipe"):
+        from oim_tpu.models import TransformerConfig, init_params
+        from oim_tpu.models.train import _build_value_and_grad, data_pspec
+
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=4, n_heads=4, d_ff=64,
+            n_stages=2, n_microbatches=2, dtype="float32",
+            pp_schedule=pp_schedule,
+        )
+        mesh = build_mesh(dp=2, pp=2, sp=2, devices=jax.devices()[:8])
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.device_put(
+            jax.random.randint(
+                jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size
+            ),
+            jax.sharding.NamedSharding(mesh, data_pspec()),
+        )
+        return cfg, mesh, params, tokens, jax.jit(
+            _build_value_and_grad(cfg, mesh)
+        )
+
+    def test_grads_match_finite_difference(self):
+        """<grad, R> equals the directional finite difference of the loss
+        — the absolute scale check no schedule-vs-schedule comparison can
+        provide (both could be wrong by the same factor)."""
+        _, _, params, tokens, vag = self._setup()
+        loss0, _, grads = vag(params, tokens)
+        for i, name in enumerate(("wlm", "wte", "wo")):
+            direction = jax.random.normal(
+                jax.random.fold_in(jax.random.PRNGKey(7), i),
+                params[name].shape,
+                jnp.float32,
+            )
+            eps = 1e-3
+            shifted = dict(params)
+            shifted[name] = params[name] + eps * direction
+            lo_p = float(vag(shifted, tokens)[0])
+            shifted[name] = params[name] - eps * direction
+            lo_m = float(vag(shifted, tokens)[0])
+            fd = (lo_p - lo_m) / (2 * eps)
+            analytic = float(jnp.vdot(grads[name], direction))
+            # 1% tolerance: fp32 loss readouts give the central difference
+            # a few-per-mille of noise; the failure mode this test guards
+            # against (per-axis-size gradient inflation) is ≥2×.
+            assert analytic == pytest.approx(fd, rel=1e-2, abs=1e-3), (
+                f"{name}: analytic {analytic} vs finite-diff {fd}"
+            )
+
+    def test_1f1b_grads_match_gpipe(self):
+        """The interleaved 1F1B schedule and the GPipe autodiff transpose
+        compute the same gradients (tree-wise, 1e-4 on fp32 CPU)."""
+        _, _, params, tokens, vag_g = self._setup("gpipe")
+        *_, vag_1 = self._setup("1f1b")
+        loss_g, ce_g, grads_g = vag_g(params, tokens)
+        loss_1, ce_1, grads_1 = vag_1(params, tokens)
+        assert float(loss_1) == pytest.approx(float(loss_g), abs=1e-5)
+        assert float(ce_1) == pytest.approx(float(ce_g), abs=1e-5)
+        for name in grads_g:
+            diff = float(jnp.max(jnp.abs(grads_g[name] - grads_1[name])))
+            assert diff < 1e-4, f"{name}: max abs grad diff {diff}"
 
 
 class TestRingAttentionGQA:
