@@ -76,7 +76,7 @@ def _beam(
     def step(carry, i):
         cache, seqs, scores, finished, lengths = carry
         last = jnp.take_along_axis(seqs, (i - 1)[None, None], axis=1)  # [k,1]
-        logits, cache = _forward_cached(params, last, cache, cfg, False)
+        logits, cache = _forward_cached(params, last, cache, cfg)
         logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [k,V]
         if eos_id is not None:
             # Frozen beams propose exactly one continuation (token 0) at

@@ -11,7 +11,9 @@ in SURVEY.md §2.3's TPU-build column).  TPU-first design decisions:
 - **Prefill and decode share one cached-attention primitive.**  Prefill
   writes the prompt's K/V into the cache in one shot (big MXU-friendly
   einsums over the whole prompt); each decode step appends one position
-  via ``dynamic_update_slice``.
+  via ``dynamic_update_slice``.  MoE routes drop-free per token on both
+  (``_moe_exact``) — inference results must not depend on batch packing
+  or padding, so capacity routing stays a train-path-only construct.
 - **GSPMD, not shard_map.**  Decode has no sequence axis to parallelize
   (t=1), so inference relies on sharding *propagation*: shard the params
   (and the prompt's batch over ``dp``) before calling and XLA propagates
@@ -36,7 +38,6 @@ from oim_tpu.models.transformer import (
     _dense_mlp,
     _rmsnorm,
     _router_gates,
-    _switch_moe,
     _unembed,
 )
 from oim_tpu.ops.quant import (
@@ -182,15 +183,19 @@ def _cached_attention(
 
 
 def _moe_exact(x, lp, cfg: TransformerConfig):
-    """Drop-free MoE for single-token decode steps: every token runs
-    through its top-k experts (k = ``cfg.moe_top_k``; gates per
-    ``transformer._router_gates``, matching the train path).  Computes
-    all experts per token, which is E× the needed FLOPs — acceptable
-    only at t=1 scale, so *prefill* (whole prompt) instead reuses the
-    train-path ``_switch_moe`` (same capacity semantics as the training
-    forward, hence exact agreement with it), and this path handles the
-    incremental steps where capacity bookkeeping over a 1-token call
-    would misroute."""
+    """Drop-free MoE for the ENTIRE inference path (prefill and decode):
+    every token runs through its top-k experts (k = ``cfg.moe_top_k``;
+    gates per ``transformer._router_gates``, matching the train path)
+    with no capacity bookkeeping.  Routing is per-token, so results are
+    independent of batch packing, padding, and prompt length — the
+    property the serving engine's exactness invariant needs (capacity
+    routing would count pad tokens against expert capacity, making
+    results depend on the prompt bucket).  Capacity drops are a
+    train-time load-balancing artifact; inference never drops.  Cost:
+    dense grouping computes all E experts per token (E/k× the routed
+    FLOPs) — fine at decode scale and acceptable at serving-prefill
+    scale for small E; a top-k gather dispatch is the optimization seam
+    if E grows."""
     b, t, d = x.shape
     normed = _rmsnorm(x, lp["mlp_norm"], cfg).reshape(b * t, d)
     router_logits = jnp.einsum(
@@ -214,16 +219,17 @@ def _hidden_cached(
     tokens,
     cache: KVCache,
     cfg: TransformerConfig,
-    is_prefill: bool = False,
 ):
     """Run ``tokens`` (global positions cache.length..+t) through all
     layers, reading and extending the cache.  Returns the final-norm
     hidden states ``(x [b, t, d], cache)`` (no unembedding).
 
-    ``is_prefill`` selects MoE routing: prefill uses the train-path
-    capacity routing (exact agreement with the training forward, even for
-    1-token prompts); incremental steps use drop-free top-k routing
-    (``_moe_exact``, k = ``cfg.moe_top_k``)."""
+    MoE uses drop-free per-token routing everywhere (``_moe_exact``) —
+    inference results must not depend on batch packing or padding, which
+    capacity routing would reintroduce (it counts pad tokens against
+    expert capacity).  Agreement with the *training* forward therefore
+    holds exactly when the train-path capacity drops nothing (ample
+    ``expert_capacity_factor``)."""
     # Inference runs under GSPMD auto-partitioning where pallas (Mosaic)
     # kernels cannot sit (same constraint train.py gates on); XLA fuses
     # the reference rmsnorm anyway at t=1.
@@ -249,10 +255,7 @@ def _hidden_cached(
             x, lp, k_cache, v_cache, k_scale, v_scale, start, cfg
         )
         if cfg.n_experts:
-            if is_prefill:  # train-path capacity routing, MXU dispatch
-                x, _ = _switch_moe(x, lp, cfg)
-            else:
-                x = _moe_exact(x, lp, cfg)
+            x = _moe_exact(x, lp, cfg)
         else:
             x, _ = _dense_mlp(x, lp, cfg)
         return x, (k_cache, v_cache, k_scale, v_scale)
@@ -275,10 +278,9 @@ def _forward_cached(
     tokens,
     cache: KVCache,
     cfg: TransformerConfig,
-    is_prefill: bool = False,
 ):
     """``_hidden_cached`` + the unembedding: (logits, cache)."""
-    x, new_cache = _hidden_cached(params, tokens, cache, cfg, is_prefill)
+    x, new_cache = _hidden_cached(params, tokens, cache, cfg)
     return _unembed(x, dequantize_named(params, "wlm"), cfg), new_cache
 
 
@@ -293,7 +295,7 @@ def embed_tokens(params, tokens, true_lens, cfg: TransformerConfig):
     """
     b, t = tokens.shape
     cache = KVCache.create(cfg, b, t)
-    x, _ = _hidden_cached(params, tokens, cache, cfg, is_prefill=True)
+    x, _ = _hidden_cached(params, tokens, cache, cfg)
     mask = (
         jnp.arange(t)[None, :] < true_lens[:, None]
     ).astype(jnp.float32)[..., None]
@@ -323,7 +325,7 @@ def prefill(
     if t > max_len:
         raise ValueError(f"prompt length {t} exceeds max_len {max_len}")
     cache = KVCache.create(cfg, b, max_len, quantized=kv_int8)
-    return _forward_cached(params, tokens, cache, cfg, is_prefill=True)
+    return _forward_cached(params, tokens, cache, cfg)
 
 
 def decode_step(

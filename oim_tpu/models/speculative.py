@@ -84,7 +84,7 @@ def _speculative(
     # Prefill: cache holds the prompt; the first greedy token is decided
     # but not yet fed (the invariant: cache.length == length - 1, i.e.
     # every decided token except the newest has K/V rows).
-    logits, cache = _forward_cached(params, prompt, cache, cfg, True)
+    logits, cache = _forward_cached(params, prompt, cache, cfg)
     first = jnp.argmax(logits[0, -1]).astype(jnp.int32)
     history = jax.lax.dynamic_update_slice(history, first[None], (t,))
     length = jnp.int32(t + 1)
@@ -100,7 +100,7 @@ def _speculative(
         # frontier: logits_i = distribution AFTER consuming input i.
         last = jax.lax.dynamic_slice(history, (length - 1,), (1,))
         inputs = jnp.concatenate([last, draft])[None]
-        logits, cache = _forward_cached(params, inputs, cache, cfg, False)
+        logits, cache = _forward_cached(params, inputs, cache, cfg)
         greedy = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
         # draft[i] survives iff every earlier draft matched too.
         match = jnp.cumprod(

@@ -24,11 +24,10 @@ dynamic page tables + gather kernels):
 - **Exactness.**  A request decoded via the engine produces exactly the
   tokens ``models.decode.generate`` produces for the same prompt (greedy;
   verified in tests/test_serve.py) — batching composition cannot change
-  results because every slot's attention is masked to its own length.
-  One carve-out: MoE models (``n_experts > 0``) prefill with the train
-  path's capacity routing, where pad tokens count against expert
-  capacity — so MoE exactness holds at prompt-bucket boundaries only
-  (dense models are exact at every length).
+  results because every slot's attention is masked to its own length and
+  MoE routing is drop-free per-token (``decode._moe_exact``) on prefill
+  and decode alike, so padding and bucket choice are invisible at every
+  prompt length, dense and MoE.
 - **Per-request sampling streams.**  Every sampled token's PRNG key is
   ``fold_in(PRNGKey(request.seed), token_index)`` — a function of the
   request alone, so temperature>0 results are reproducible across runs
@@ -42,7 +41,7 @@ handful of jitted functions with donated cache buffers.
 Also here: per-token logprobs (``result_full`` / the streaming
 callback), an LRU prompt-KV **prefix cache** for system prompts
 (``prefix_cache_size`` + ``GenRequest.cache_prefix`` — injected rows
-are exact for dense models), ``stop_ids``, a slot-free ``embed``
+are exact, dense and MoE alike), ``stop_ids``, a slot-free ``embed``
 surface, int8 KV (``kv_int8``) and weight-only int8 params (both
 preserve the exactness invariant), Prometheus instrumentation, and
 ``warmup``/``abort``/``forget`` lifecycle discipline for daemon use.
@@ -77,7 +76,6 @@ from oim_tpu.ops.quant import (
 from oim_tpu.models.transformer import (
     TransformerConfig,
     _rmsnorm,
-    _switch_moe,
     _unembed,
 )
 from oim_tpu.ops.rope import apply_rope
@@ -195,14 +193,15 @@ def _slot_attention(
     )
 
 
-def _forward_slots(params, tokens, kv, starts, cfg, is_prefill):
+def _forward_slots(params, tokens, kv, starts, cfg):
     """tokens [B, t] at per-slot positions ``starts`` → (logits, kv).
 
     ``kv`` = (k, v, k_scale, v_scale): [n_layers, B, max_len, KVH, hd]
     values with per-(token, head) scales (or None when full-precision).
-    MoE routing follows ``models/decode.py``: capacity routing on prefill
-    (exact agreement with the training forward), drop-free argmax on
-    incremental steps.
+    MoE routing follows ``models/decode.py``: drop-free per-token top-k
+    (``_moe_exact``) on prefill AND incremental steps — per-token routing
+    is what makes engine results independent of padding, batch packing,
+    and prompt length.
     """
     cfg = replace(cfg, use_pallas=False)
     x = params["wte"].astype(cfg.compute_dtype)[tokens]
@@ -215,10 +214,7 @@ def _forward_slots(params, tokens, kv, starts, cfg, is_prefill):
             x, lp, k_cache, v_cache, k_scale, v_scale, starts, cfg
         )
         if cfg.n_experts:
-            if is_prefill:
-                x, _ = _switch_moe(x, lp, cfg)
-            else:
-                x = _moe_exact(x, lp, cfg)
+            x = _moe_exact(x, lp, cfg)
         else:
             x, _ = _dense_mlp(x, lp, cfg)
         return x, (k_cache, v_cache, k_scale, v_scale)
@@ -273,7 +269,7 @@ def _admit(
         lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), kv_full
     )
     logits, kv_slot = _forward_slots(
-        params, prompt[None], kv_slot, start[None], cfg, is_prefill=True
+        params, prompt[None], kv_slot, start[None], cfg
     )
     k_all, v_all, ks_all, vs_all = jax.tree.map(
         lambda c, u: jax.lax.dynamic_update_slice_in_dim(c, u, slot, axis=1),
@@ -343,7 +339,7 @@ def _decode_chunk(
     def one(carry, i):
         kv, lengths, tok = carry
         logits, kv = _forward_slots(
-            params, tok[:, None], kv, lengths, cfg, is_prefill=False
+            params, tok[:, None], kv, lengths, cfg
         )
         keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
         nxt, lp = _sample_batched(logits[:, -1], temps, keys, top_k, top_p)
@@ -731,10 +727,10 @@ class Engine:
     def _try_prefix_inject(self, slot: int, req: GenRequest) -> int:
         """Inject the longest cached prefix of ``req.tokens`` into
         ``slot``; returns the start offset for the tail prefill (0 = no
-        usable entry).  Exact for dense models (a KV row depends only on
-        the tokens before it); under MoE a hit changes which tokens share
-        a capacity-routing group, the same class of variation as prompt
-        bucketing."""
+        usable entry).  Exact for dense AND MoE models: a KV row depends
+        only on the tokens before it, and MoE routing is per-token
+        (``_moe_exact``), so injected rows plus a tail prefill reproduce
+        a full prefill bit-for-bit."""
         if not self.prefix_cache_size:
             return 0
         best_key, best_usable = None, 0

@@ -143,16 +143,32 @@ class TestDecode:
             seq = jnp.concatenate([seq, nxt], axis=1)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
-    def test_moe_one_token_prompt_prefill_matches_forward(self):
-        """A 1-token prompt is still *prefill*: with tight capacity all
-        rows race for one expert's slots and the train-path routing must
-        apply (drop-free routing would diverge from forward_local)."""
+    def test_moe_prefill_is_batch_packing_independent(self):
+        """Inference MoE routes drop-free per token: prefill logits must
+        not change when the SAME row is packed with different batchmates
+        (capacity routing would make them race for expert slots).  With
+        tight train-path capacity, rows 8-at-a-time vs solo agree."""
         cfg = TransformerConfig(
             **{**CFG, "n_experts": 4, "expert_capacity_factor": 1.0}
         )
         params = init_params(jax.random.PRNGKey(0), cfg)
-        prompt = jnp.zeros((8, 1), jnp.int32)  # all rows identical → 1 expert
-        logits, _ = prefill(params, prompt, cfg, max_len=4)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (8, 4), 0, 101)
+        batched, _ = prefill(params, prompt, cfg, max_len=8)
+        solo, _ = prefill(params, prompt[:1], cfg, max_len=8)
+        np.testing.assert_allclose(
+            np.asarray(batched[:1]), np.asarray(solo), rtol=1e-5, atol=1e-6
+        )
+
+    def test_moe_prefill_matches_forward_when_nothing_drops(self):
+        """With capacity ample enough that the train path drops nothing,
+        drop-free inference routing and train-path capacity routing are
+        the same function — prefill logits match forward_local."""
+        cfg = TransformerConfig(
+            **{**CFG, "n_experts": 4, "expert_capacity_factor": 4.0}
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 6), 0, 101)
+        logits, _ = prefill(params, prompt, cfg, max_len=8)
         expected = _forward_logits(params, prompt, cfg)
         np.testing.assert_allclose(
             np.asarray(logits), np.asarray(expected), rtol=1e-4, atol=1e-4

@@ -200,17 +200,47 @@ def test_kv_int8_engine_matches_solo_int8(setup):
         assert results[rid] == want
 
 
-def test_moe_engine(setup):
+def test_moe_engine_exact_at_every_length(setup):
+    """MoE exactness has NO bucket carve-out: drop-free per-token routing
+    makes padding invisible, so engine == solo oracle at non-bucket
+    prompt lengths too (7, 13) and top-2 routing alike."""
+    for n_experts, top_k in ((2, 1), (4, 2)):
+        cfg = TransformerConfig(
+            **{**CFG, "n_experts": n_experts, "moe_top_k": top_k}
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+        reqs = {
+            engine.submit(
+                GenRequest(tokens=_prompt(s, n, cfg.vocab_size),
+                           max_new_tokens=m)
+            ): (s, n, m)
+            for s, n, m in [(7, 16, 6), (8, 7, 5), (9, 13, 8)]
+        }
+        results = engine.run()
+        for rid, (s, n, m) in reqs.items():
+            want = _oracle(params, cfg, _prompt(s, n, cfg.vocab_size), m)
+            assert results[rid] == want, (n_experts, top_k, n)
+
+
+def test_moe_engine_prefix_cache_exact(setup):
+    """Prefix-cache hits are exact for MoE too (per-token routing): a
+    request sharing a cached system prompt emits the same tokens as an
+    uncached engine."""
     cfg = TransformerConfig(**{**CFG, "n_experts": 2})
     params = init_params(jax.random.PRNGKey(0), cfg)
-    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
-    # Bucket-aligned prompt: MoE capacity routing counts pad tokens, so
-    # exactness vs the solo oracle holds at bucket boundaries (dense
-    # models are exact at every length — see engine docstring).
-    tokens = _prompt(7, 16, cfg.vocab_size)
-    rid = engine.submit(GenRequest(tokens=tokens, max_new_tokens=6))
-    results = engine.run()
-    assert results[rid] == _oracle(params, cfg, tokens, 6)
+    system = _prompt(30, 16, cfg.vocab_size)
+    tail = _prompt(31, 5, cfg.vocab_size)
+    cached = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                    prefix_cache_size=2)
+    r1 = cached.submit(GenRequest(tokens=system, max_new_tokens=1,
+                                  cache_prefix=True))
+    cached.run()
+    cached.result(r1)
+    r2 = cached.submit(GenRequest(tokens=system + tail, max_new_tokens=6))
+    got = cached.run()[r2]
+    assert cached.stats()["prefix_hits"] == 1
+    assert got == _oracle(params, cfg, system + tail, 6)
 
 
 def test_warmup_compiles_without_disturbing_results(setup):
