@@ -9,11 +9,12 @@ dynamic page tables + gather kernels):
   shapes mean XLA compiles exactly one decode program; admission and
   completion never reshape anything.
 - **Continuous batching.**  New requests are admitted into free slots
-  while other slots keep decoding: ``admit`` prefills one slot's region
-  (prompt lengths bucketed to bound compiles), ``decode_chunk`` advances
-  every active slot.  The [B] ``starts`` vector generalizes
-  ``models/decode.py``'s scalar cache length — each slot attends only to
-  its own prefix.
+  while other slots keep decoding: ``admit_batch`` prefills every
+  admission sharing a prompt bucket in ONE dispatch (buckets bound the
+  compile count; one combined readback covers all of a step's
+  admissions), ``decode_chunk`` advances every active slot.  The [B]
+  ``starts`` vector generalizes ``models/decode.py``'s scalar cache
+  length — each slot attends only to its own prefix.
 - **Chunked decode.**  ``decode_chunk`` runs ``chunk`` steps in one
   ``lax.scan`` dispatch and returns ``[n_slots, chunk]`` tokens — one
   host↔device round trip per chunk, not per token.  On a tunneled or
@@ -56,6 +57,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from oim_tpu.common import metrics as _metrics
 
@@ -193,8 +195,12 @@ def _slot_attention(
     )
 
 
-def _forward_slots(params, tokens, kv, starts, cfg):
-    """tokens [B, t] at per-slot positions ``starts`` → (logits, kv).
+def _hidden_slots(params, tokens, kv, starts, cfg):
+    """tokens [B, t] at per-slot positions ``starts`` → (final-norm
+    hidden states [B, t, D], kv) — no unembedding, so prefill callers
+    can unembed only the one position they sample from (the unembed is
+    ~20% of step FLOPs at vocab 32k and all-position prefill logits are
+    the largest activation there is).
 
     ``kv`` = (k, v, k_scale, v_scale): [n_layers, B, max_len, KVH, hd]
     values with per-(token, head) scales (or None when full-precision).
@@ -221,8 +227,7 @@ def _forward_slots(params, tokens, kv, starts, cfg):
 
     # None scales are empty pytrees: lax.scan carries them untouched.
     x, kv = jax.lax.scan(layer_step, x, (flat, *kv))
-    x = _rmsnorm(x, params["final_norm"], cfg)
-    return _unembed(x, dequantize_named(params, "wlm"), cfg), kv
+    return _rmsnorm(x, params["final_norm"], cfg), kv
 
 
 def _sample_batched(logits, temps, keys, top_k, top_p):
@@ -250,45 +255,50 @@ def _sample_batched(logits, temps, keys, top_k, top_p):
     return tokens, logprobs
 
 
-def _admit(
-    params, cache: SlotCache, prompt, slot, start, true_tail, temp, key,
-    *, cfg, top_k, top_p,
+def _admit_batch(
+    params, cache: SlotCache, prompts, slots, starts, true_tails, temps,
+    keys, *, cfg, top_k, top_p,
 ):
-    """Prefill the uncached ``prompt`` tail [Lb] (padded to its bucket)
-    into slot ``slot`` at positions ``start..`` and sample the first
-    generated token.  Returns (cache, first_token, first_logprob).
+    """Prefill a whole GROUP of admissions in one dispatch and sample
+    each one's first generated token.  Returns
+    (cache, first_tokens [S], first_logprobs [S]).
 
-    ``start`` > 0 means rows 0..start-1 were injected from the prefix
-    cache (``_inject_prefix``) — the causal mask attends the tail to
-    them exactly as a full prefill would.  Pad positions past
-    ``start + true_tail`` are written but masked forever: the slot's
-    length stops there and decode overwrites them one by one.
+    prompts [S, Lb]: each row's uncached prompt tail, padded to the
+    group's shared bucket; slots [S]: row → slot index, with the
+    OUT-OF-BOUNDS value ``n_slots`` marking inert padding rows (S is
+    always n_slots, so there is exactly one compile per prompt bucket);
+    starts [S]: first uncached position (> 0 after a prefix-cache
+    injection — the causal mask attends the tail to the injected rows
+    exactly as a full prefill would); true_tails [S]: valid tail
+    lengths; temps [S]; keys [S] per-request PRNG keys.
+
+    Padding rows gather the LAST slot's region, compute on garbage, and
+    vanish at the scatter (``mode="drop"`` on the out-of-bounds index) —
+    their FLOPs are the price of one static shape per bucket.  Pad positions
+    past ``start + true_tail`` are written but masked forever: the
+    slot's length stops there and decode overwrites them one by one.
     """
+    n_slots = cache.n_slots
     kv_full = (cache.k, cache.v, cache.k_scale, cache.v_scale)
-    kv_slot = jax.tree.map(
-        lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), kv_full
-    )
-    logits, kv_slot = _forward_slots(
-        params, prompt[None], kv_slot, start[None], cfg
-    )
+    row_src = jnp.minimum(slots, n_slots - 1)  # padding rows read slot-(-1)
+    kv_rows = jax.tree.map(lambda c: jnp.take(c, row_src, axis=1), kv_full)
+    x, kv_rows = _hidden_slots(params, prompts, kv_rows, starts, cfg)
     k_all, v_all, ks_all, vs_all = jax.tree.map(
-        lambda c, u: jax.lax.dynamic_update_slice_in_dim(c, u, slot, axis=1),
-        kv_full, kv_slot,
+        lambda c, u: c.at[:, slots].set(u, mode="drop"), kv_full, kv_rows
     )
-    lengths = jax.lax.dynamic_update_slice(
-        cache.lengths, (start + true_tail)[None], (slot,)
+    lengths = cache.lengths.at[slots].set(
+        starts + true_tails, mode="drop"
     )
-    last = jax.lax.dynamic_index_in_dim(
-        logits[0], true_tail - 1, axis=0, keepdims=False
-    )
-    first, first_lp = _sample_batched(
-        last[None], temp[None], key[None], top_k, top_p
-    )
-    return (
-        SlotCache(k_all, v_all, lengths, ks_all, vs_all),
-        first[0],
-        first_lp[0],
-    )
+    last_h = jax.vmap(
+        lambda row, t: jax.lax.dynamic_index_in_dim(
+            row, t - 1, axis=0, keepdims=False
+        )
+    )(x, true_tails)
+    logits = _unembed(
+        last_h[:, None], dequantize_named(params, "wlm"), cfg
+    )[:, 0]
+    first, first_lp = _sample_batched(logits, temps, keys, top_k, top_p)
+    return SlotCache(k_all, v_all, lengths, ks_all, vs_all), first, first_lp
 
 
 def _extract_prefix(cache: SlotCache, slot, *, rows: int):
@@ -338,9 +348,8 @@ def _decode_chunk(
 
     def one(carry, i):
         kv, lengths, tok = carry
-        logits, kv = _forward_slots(
-            params, tok[:, None], kv, lengths, cfg
-        )
+        x, kv = _hidden_slots(params, tok[:, None], kv, lengths, cfg)
+        logits = _unembed(x, dequantize_named(params, "wlm"), cfg)
         keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
         nxt, lp = _sample_batched(logits[:, -1], temps, keys, top_k, top_p)
         nxt = jnp.where(active, nxt, tok)
@@ -452,7 +461,7 @@ class Engine:
             cfg, n_slots, max_len, quantized=kv_int8
         )
         self._admit = jax.jit(
-            partial(_admit, cfg=cfg, top_k=top_k, top_p=top_p),
+            partial(_admit_batch, cfg=cfg, top_k=top_k, top_p=top_p),
             donate_argnums=(1,),
         )
         # Prefix cache: LRU of prompt-KV entries (tuple(tokens) →
@@ -480,6 +489,10 @@ class Engine:
         self._queue: list[tuple[int, GenRequest, float]] = []
         self._slots: dict[int, _SlotState] = {}  # slot index → state
         self._free = list(range(n_slots))
+        # rid → slot for admissions popped from _queue but not yet in
+        # _slots: abort() fails these too (and reclaims their slots), so
+        # a crash mid-admission can never strand a blocked result() call.
+        self._admitting: dict[int, int] = {}
         # rid → (tokens, logprobs), consumed by result_full/result.
         self._results: dict[int, tuple[list[int], list[float]]] = {}
         self._events: dict[int, threading.Event] = {}
@@ -651,10 +664,14 @@ class Engine:
         ended = []
         with self._lock:
             pending = [rid for rid, _, _ in self._queue]
+            pending += list(self._admitting)
             pending += [s.rid for s in self._slots.values()]
             self._queue.clear()
-            self._free += sorted(self._slots)
+            self._free += sorted(
+                set(self._slots) | set(self._admitting.values())
+            )
             self._slots.clear()
+            self._admitting.clear()
             for rid in pending:
                 if not self._warming:
                     self._m_requests.inc("aborted")
@@ -768,53 +785,104 @@ class Engine:
                 self._prefix_cache.popitem(last=False)
 
     def step(self) -> None:
-        """Admit whatever fits, then decode one chunk for active slots."""
+        """Admit whatever fits, then decode one chunk for active slots.
+
+        Admissions are BATCHED: one prefill dispatch per distinct prompt
+        bucket among this step's admissions (grouping keeps every row at
+        its own bucket, so a prefix-injected row can never overflow its
+        slot region the way padding everything to the step-max bucket
+        would), then ONE combined readback for all first tokens — on a
+        tunneled deployment (~70 ms/readback) this is the difference
+        between paying the tunnel once per step and once per request.
+        """
         with self._lock:
             admissions = []
             while self._queue and self._free:
                 rid, req, t_submit = self._queue.pop(0)
                 admissions.append((self._free.pop(0), rid, req, t_submit))
+            # Registered before any device work so abort() can fail these
+            # and reclaim their slots if an admission dispatch dies.
+            # update(), not assignment: entries stranded by a previous
+            # step() crash must survive until abort() reclaims them.
+            self._admitting.update(
+                {rid: slot for slot, rid, _, _ in admissions}
+            )
             self._m_queued.set(float(len(self._queue)), self._engine_label)
-        for slot, rid, req, t_submit in admissions:
-            start = self._try_prefix_inject(slot, req)
-            tail = req.tokens[start:]
-            bucket = self._bucket(len(tail))
-            prompt = jnp.asarray(
-                tail + [0] * (bucket - len(tail)), jnp.int32
-            )
-            key = jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
-            self._cache, first, first_lp = self._admit(
-                self.params,
-                self._cache,
-                prompt,
-                jnp.int32(slot),
-                jnp.int32(start),
-                jnp.int32(len(tail)),
-                jnp.float32(req.temperature),
-                key,
-            )
-            if req.cache_prefix and self.prefix_cache_size:
-                self._store_prefix(slot, req.tokens)
-            state = _SlotState(
-                rid=rid, req=req, base=jax.random.PRNGKey(req.seed),
-                t_submit=t_submit,
-            )
-            # One combined readback (the chunk path's discipline).
-            token, lp = jax.device_get((first, first_lp))
-            token, lp = int(token), float(lp)
-            self.tokens_generated += 1
-            with self._lock:
-                done = self._emit(state, token, lp)
-                if done:
-                    self._finish(slot, state)
-                else:
-                    self._slots[slot] = state
-                    self._m_active.set(float(len(self._slots)), self._engine_label)
-                cb = (
-                    self._callbacks.pop(rid, None) if done
-                    else self._callbacks.get(rid)
+
+        if admissions:
+            n_slots = self._cache.n_slots
+            rows = []  # (slot, rid, req, t_submit, start, tail, bucket)
+            for slot, rid, req, t_submit in admissions:
+                start = self._try_prefix_inject(slot, req)
+                tail = req.tokens[start:]
+                rows.append((slot, rid, req, t_submit, start, tail,
+                             self._bucket(len(tail))))
+            zero_key = jax.random.PRNGKey(0)
+            groups = []  # (group rows, first_tokens, first_logprobs)
+            for bucket in sorted({r[6] for r in rows}):
+                group = [r for r in rows if r[6] == bucket]
+                prompts = np.zeros((n_slots, bucket), np.int32)
+                slot_idx = np.full((n_slots,), n_slots, np.int32)  # inert
+                starts = np.zeros((n_slots,), np.int32)
+                tails = np.ones((n_slots,), np.int32)
+                temps = np.zeros((n_slots,), np.float32)
+                keys = [zero_key] * n_slots
+                for i, (slot, rid, req, _, start, tail, _) in enumerate(
+                    group
+                ):
+                    prompts[i, : len(tail)] = tail
+                    slot_idx[i] = slot
+                    starts[i] = start
+                    tails[i] = len(tail)
+                    temps[i] = req.temperature
+                    keys[i] = jax.random.fold_in(
+                        jax.random.PRNGKey(req.seed), 0
+                    )
+                self._cache, first, first_lp = self._admit(
+                    self.params,
+                    self._cache,
+                    jnp.asarray(prompts),
+                    jnp.asarray(slot_idx),
+                    jnp.asarray(starts),
+                    jnp.asarray(tails),
+                    jnp.asarray(temps),
+                    jnp.stack(keys),
                 )
-            if cb is not None:  # stream outside the lock
+                groups.append((group, first, first_lp))
+            for slot, rid, req, _, start, tail, _ in rows:
+                if req.cache_prefix and self.prefix_cache_size:
+                    self._store_prefix(slot, req.tokens)
+            # ONE combined readback for every admission this step.
+            fetched = jax.device_get([(f, lp) for _, f, lp in groups])
+            notices = []
+            with self._lock:
+                for (group, _, _), (f_host, lp_host) in zip(groups, fetched):
+                    for i, (slot, rid, req, t_submit, _, _, _) in enumerate(
+                        group
+                    ):
+                        token, lp = int(f_host[i]), float(lp_host[i])
+                        self.tokens_generated += 1
+                        state = _SlotState(
+                            rid=rid, req=req,
+                            base=jax.random.PRNGKey(req.seed),
+                            t_submit=t_submit,
+                        )
+                        done = self._emit(state, token, lp)
+                        self._admitting.pop(rid, None)
+                        if done:
+                            self._finish(slot, state)
+                        else:
+                            self._slots[slot] = state
+                        cb = (
+                            self._callbacks.pop(rid, None) if done
+                            else self._callbacks.get(rid)
+                        )
+                        if cb is not None:
+                            notices.append((cb, token, lp, done))
+                self._m_active.set(
+                    float(len(self._slots)), self._engine_label
+                )
+            for cb, token, lp, done in notices:  # stream outside the lock
                 cb(token, lp)
                 if done:
                     cb(None, None)

@@ -302,6 +302,57 @@ def test_abort_fails_queued_and_active(setup):
     assert sorted(engine._free) == [0]
 
 
+def test_abort_fails_requests_stranded_mid_admission(setup):
+    """If the admission dispatch dies, requests already popped from the
+    queue but not yet in a slot must still be failed by abort() — the
+    driver-crash path must never strand a blocked result() caller for
+    its full timeout."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=2)
+
+    def exploding_admit(*args, **kwargs):
+        raise RuntimeError("XLA fell over")
+
+    engine._admit = exploding_admit
+    r1 = engine.submit(GenRequest(tokens=[1, 2], max_new_tokens=4))
+    r2 = engine.submit(GenRequest(tokens=[3, 4, 5], max_new_tokens=4))
+    with pytest.raises(RuntimeError, match="XLA fell over"):
+        engine.step()  # both popped from _queue, neither reached _slots
+    engine.abort("driver died")  # what the serving driver thread does
+    for rid in (r1, r2):
+        with pytest.raises(RuntimeError, match="driver died"):
+            engine.result(rid, timeout=1)
+    assert not engine.pending()
+    assert sorted(engine._free) == [0, 1]  # slots reclaimed
+    assert engine._admitting == {}
+
+
+def test_mixed_bucket_admissions_in_one_step_match(setup):
+    """One step admitting prompts from DIFFERENT buckets (5→16, 20→32)
+    plus a prefix-injected tail dispatches one group per bucket; every
+    result must still equal the solo oracle."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=4, max_len=64, chunk=4,
+                    prefix_cache_size=2)
+    system = _prompt(40, 16, cfg.vocab_size)
+    r0 = engine.submit(GenRequest(tokens=system, max_new_tokens=1,
+                                  cache_prefix=True))
+    engine.run()
+    engine.result(r0)
+    reqs = {}
+    for s, n, m in [(41, 5, 6), (42, 20, 6)]:
+        reqs[engine.submit(
+            GenRequest(tokens=_prompt(s, n, cfg.vocab_size),
+                       max_new_tokens=m)
+        )] = _prompt(s, n, cfg.vocab_size)
+    shared = system + _prompt(43, 4, cfg.vocab_size)
+    reqs[engine.submit(GenRequest(tokens=shared, max_new_tokens=6))] = shared
+    results = engine.run()
+    assert engine.stats()["prefix_hits"] == 1
+    for rid, tokens in reqs.items():
+        assert results[rid] == _oracle(params, cfg, tokens, 6)
+
+
 def test_server_survives_driver_crash(setup):
     """A crashing engine step must flip /healthz, fail in-flight requests
     with a 500, and reject new ones with 503 — not hang clients."""
