@@ -51,6 +51,15 @@ def build_parser() -> argparse.ArgumentParser:
         "third of the checkpoint bytes: no optimizer state)",
     )
     # Engine shape.
+    p.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor-parallel ways: shard params + KV cache over the "
+        "first tp devices (ep below composes for MoE experts)",
+    )
+    p.add_argument(
+        "--ep", type=int, default=1,
+        help="expert-parallel ways for MoE serving (tp*ep devices total)",
+    )
     p.add_argument("--n-slots", type=int, default=8)
     p.add_argument("--max-len", type=int, default=1024)
     p.add_argument("--chunk", type=int, default=8)
@@ -114,6 +123,14 @@ def make_engine(args):
     )
     if args.checkpoint_dir and args.params_dir:
         raise SystemExit("--checkpoint-dir and --params-dir are exclusive")
+    serve_mesh = None
+    if args.tp > 1 or args.ep > 1:
+        from oim_tpu.parallel import build_mesh
+
+        serve_mesh = build_mesh(
+            tp=args.tp, ep=args.ep,
+            devices=jax.devices()[: args.tp * args.ep],
+        )
     if args.params_dir or args.checkpoint_dir:
         from oim_tpu.parallel import build_mesh
 
@@ -122,7 +139,10 @@ def make_engine(args):
         template = jax.eval_shape(
             lambda: init_params(jax.random.PRNGKey(0), cfg)
         )
-        mesh = build_mesh(devices=jax.devices()[:1])
+        # Restore SHARDED over the serving mesh when one is set: a model
+        # too large for one chip must never be materialized replicated
+        # on device 0 first (the whole point of --tp serving).
+        mesh = serve_mesh or build_mesh(devices=jax.devices()[:1])
         if args.params_dir:
             from oim_tpu.checkpoint import load_params
 
@@ -160,6 +180,7 @@ def make_engine(args):
         top_p=args.top_p,
         kv_int8=args.kv_int8,
         prefix_cache_size=args.prefix_cache,
+        mesh=serve_mesh,
     )
 
 

@@ -39,6 +39,15 @@ The engine itself is host-side Python (the analog of the reference's
 control-plane daemons); everything that touches the accelerator is a
 handful of jitted functions with donated cache buffers.
 
+- **Tensor-parallel serving.**  Pass ``mesh=`` (the canonical 5-axis
+  ``parallel.build_mesh`` mesh; tp>1, optionally ep>1 for MoE) and the
+  engine shards params by their logical axes and the KV cache over
+  kv-heads, then lets GSPMD propagate through the same jitted
+  admit/decode functions — models larger than one chip serve across
+  the slice the control plane's ``MapVolume`` hands out.  Slot
+  machinery stays host-side and identical; results are token-for-token
+  the single-device engine's (tests/test_serve.py).
+
 Also here: per-token logprobs (``result_full`` / the streaming
 callback), an LRU prompt-KV **prefix cache** for system prompts
 (``prefix_cache_size`` + ``GenRequest.cache_prefix`` — injected rows
@@ -79,10 +88,69 @@ from oim_tpu.models.transformer import (
     TransformerConfig,
     _rmsnorm,
     _unembed,
+    param_pspecs,
 )
 from oim_tpu.ops.rope import apply_rope
 
 _NEG_BIG = -1e30
+
+
+def serve_param_shardings(params: dict, cfg: TransformerConfig, mesh):
+    """NamedShardings for inference params by their logical axes
+    (heads/mlp/vocab → ``tp``, experts → ``ep`` per
+    ``parallel.sharding.DEFAULT_RULES``; the mesh's pp/dp/sp axes are
+    size-1 in a serving mesh, making those entries no-ops).  Extends
+    the training-side rule set with the inference-only names: a
+    ``<w>_wscale`` int8 companion is its weight's shape minus the
+    reduction (second-to-last) axis, so it drops that entry from the
+    weight's spec; LoRA ``_a``/``_b`` adapters replicate."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    pspecs = param_pspecs(cfg)
+
+    def spec(name):
+        if name.endswith("_wscale") and name[: -len("_wscale")] in pspecs:
+            base = pspecs[name[: -len("_wscale")]]
+            return P(*base[:-2], base[-1])
+        if name not in pspecs and name[-2:] in ("_a", "_b") and (
+            name[:-2] in pspecs
+        ):
+            return P()
+        return pspecs[name]
+
+    def fitted(value, sp):
+        # device_put shards exactly (no GSPMD padding): drop an axis from
+        # any dimension it doesn't divide (e.g. an odd vocab replicates
+        # wte/wlm while heads and mlp still shard).
+        return P(*(
+            a if a is not None and value.shape[i] % mesh.shape[a] == 0
+            else None
+            for i, a in enumerate(sp)
+        ))
+
+    return {
+        name: NamedSharding(mesh, fitted(value, spec(name)))
+        for name, value in params.items()
+    }
+
+
+def cache_shardings(cache: SlotCache, mesh):
+    """SlotCache-shaped NamedShardings: k/v (and their int8 scales)
+    sharded over ``tp`` on the kv-heads axis — attention is fully
+    head-parallel, so each tp shard owns its heads' cache rows and the
+    only tp collective in the decode path is the psum GSPMD inserts for
+    the wo/w_out contractions."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    kv = NamedSharding(mesh, P(None, None, None, "tp", None))
+    scale = NamedSharding(mesh, P(None, None, None, "tp"))
+    return SlotCache(
+        k=kv,
+        v=kv,
+        lengths=NamedSharding(mesh, P()),
+        k_scale=None if cache.k_scale is None else scale,
+        v_scale=None if cache.v_scale is None else scale,
+    )
 
 
 @jax.tree_util.register_dataclass
@@ -429,6 +497,7 @@ class Engine:
         top_p: float = 1.0,
         kv_int8: bool = False,
         prefix_cache_size: int = 0,
+        mesh=None,
     ):
         if n_slots < 1 or max_len < 2 or chunk < 1 or prefix_cache_size < 0:
             raise ValueError(
@@ -436,6 +505,31 @@ class Engine:
                 f"prefix_cache_size>=0; got {n_slots}, {max_len}, {chunk}, "
                 f"{prefix_cache_size}"
             )
+        if mesh is not None:
+            # Tensor-parallel serving: shard params by logical axes and
+            # the KV cache over kv-heads, commit both to the mesh, and
+            # let GSPMD propagate through the jitted admit/decode fns
+            # (decode has no manual-axis schedule — sharding propagation
+            # is the whole mechanism, models/decode.py module docstring).
+            tp = mesh.shape.get("tp", 1)
+            if cfg.n_heads % tp or cfg.kv_heads % tp:
+                raise ValueError(
+                    f"n_heads={cfg.n_heads} and kv_heads={cfg.kv_heads} "
+                    f"must divide by mesh tp={tp}"
+                )
+            ep = mesh.shape.get("ep", 1)
+            if ep > 1 and (not cfg.n_experts or cfg.n_experts % ep):
+                # Silently replicating every expert over ep devices would
+                # reserve chips for zero sharding; the misconfiguration
+                # must be as loud as the heads one.
+                raise ValueError(
+                    f"n_experts={cfg.n_experts} must be a positive "
+                    f"multiple of mesh ep={ep}"
+                )
+            params = jax.device_put(
+                params, serve_param_shardings(params, cfg, mesh)
+            )
+        self.mesh = mesh
         self.params = params
         self.cfg = cfg
         self.chunk = chunk
@@ -460,6 +554,10 @@ class Engine:
         self._cache = SlotCache.create(
             cfg, n_slots, max_len, quantized=kv_int8
         )
+        if mesh is not None:
+            self._cache = jax.device_put(
+                self._cache, cache_shardings(self._cache, mesh)
+            )
         self._admit = jax.jit(
             partial(_admit_batch, cfg=cfg, top_k=top_k, top_p=top_p),
             donate_argnums=(1,),
