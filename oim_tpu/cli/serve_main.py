@@ -60,6 +60,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--ep", type=int, default=1,
         help="expert-parallel ways for MoE serving (tp*ep devices total)",
     )
+    p.add_argument(
+        "--spec-decode", type=int, default=0,
+        help="speculative decoding draft length (0 = off): prompt-lookup "
+        "drafts verified draft_len+1 positions per slot per step, exact "
+        "for greedy and sampled output alike",
+    )
+    p.add_argument(
+        "--spec-ngram", type=int, default=2,
+        help="n-gram length the prompt-lookup drafter matches on",
+    )
     p.add_argument("--n-slots", type=int, default=8)
     p.add_argument("--max-len", type=int, default=1024)
     p.add_argument("--chunk", type=int, default=8)
@@ -181,6 +191,8 @@ def make_engine(args):
         kv_int8=args.kv_int8,
         prefix_cache_size=args.prefix_cache,
         mesh=serve_mesh,
+        spec_decode=args.spec_decode,
+        spec_ngram=args.spec_ngram,
     )
 
 

@@ -324,13 +324,20 @@ def _sample_batched(logits, temps, keys, top_k, top_p):
 
 
 def _admit_batch(
-    params, cache: SlotCache, prompts, slots, starts, true_tails, temps,
-    keys, *, cfg, top_k, top_p,
+    params, cache: SlotCache, history, full_rows, prompts, slots, starts,
+    true_tails, temps, keys, *, cfg, top_k, top_p, track_history,
 ):
     """Prefill a whole GROUP of admissions in one dispatch and sample
     each one's first generated token.  Returns
     (cache, first_tokens [S], first_logprobs [S]).
 
+    ``history`` [n_slots, max_len] is the engine's device-side token
+    record (speculative decoding's draft source); ``full_rows``
+    [S, max_len] holds each admission's FULL prompt (prefix-injected
+    tokens included) zero-padded, overwriting the admitted slots' rows.
+    With ``track_history=False`` (non-speculative engines — nothing
+    consumes the record) both pass through untouched and the caller
+    hands in dummies, skipping the per-admission host→device transfer.
     prompts [S, Lb]: each row's uncached prompt tail, padded to the
     group's shared bucket; slots [S]: row → slot index, with the
     OUT-OF-BOUNDS value ``n_slots`` marking inert padding rows (S is
@@ -347,6 +354,8 @@ def _admit_batch(
     slot's length stops there and decode overwrites them one by one.
     """
     n_slots = cache.n_slots
+    if track_history:
+        history = history.at[slots].set(full_rows, mode="drop")
     kv_full = (cache.k, cache.v, cache.k_scale, cache.v_scale)
     row_src = jnp.minimum(slots, n_slots - 1)  # padding rows read slot-(-1)
     kv_rows = jax.tree.map(lambda c: jnp.take(c, row_src, axis=1), kv_full)
@@ -366,7 +375,12 @@ def _admit_batch(
         last_h[:, None], dequantize_named(params, "wlm"), cfg
     )[:, 0]
     first, first_lp = _sample_batched(logits, temps, keys, top_k, top_p)
-    return SlotCache(k_all, v_all, lengths, ks_all, vs_all), first, first_lp
+    return (
+        SlotCache(k_all, v_all, lengths, ks_all, vs_all),
+        history,
+        first,
+        first_lp,
+    )
 
 
 def _extract_prefix(cache: SlotCache, slot, *, rows: int):
@@ -435,6 +449,123 @@ def _decode_chunk(
     return SlotCache(k_all, v_all, lengths, ks_all, vs_all), out.T, lps.T
 
 
+def _draft_lookup(hist, length, draft_len: int, ngram: int, max_len: int):
+    """Prompt-lookup drafting for one slot: find the most recent earlier
+    occurrence of the last ``ngram`` known tokens (ending at position
+    ``length``, where the newest decided token was just written) and
+    return the ``draft_len`` tokens that followed it.  No match → zeros;
+    a wrong draft is rejection-safe (verification emits the true token),
+    so garbage never affects results, only the acceptance rate."""
+    query_start = length - ngram + 1
+    query = hist[jnp.clip(query_start + jnp.arange(ngram), 0, max_len - 1)]
+    idx = jnp.arange(max_len)[:, None] + jnp.arange(ngram)[None, :]
+    windows = hist[jnp.clip(idx, 0, max_len - 1)]  # [max_len, ngram]
+    eq = jnp.all(windows == query[None, :], axis=1)
+    window_end = jnp.arange(max_len) + ngram - 1
+    cand = eq & (window_end < length) & (query_start >= 0)
+    w = jnp.max(jnp.where(cand, jnp.arange(max_len), -1))
+    drafts = hist[jnp.clip(w + ngram + jnp.arange(draft_len), 0, max_len - 1)]
+    return jnp.where(w >= 0, drafts, 0)
+
+
+def _decode_chunk_spec(
+    params, cache: SlotCache, history, tokens, temps, active, bases, counts,
+    *, cfg, chunk, draft_len, ngram, top_k, top_p,
+):
+    """``_decode_chunk`` with in-engine speculative decoding: each of the
+    ``chunk`` sub-steps drafts ``draft_len`` tokens per slot by prompt
+    lookup over the slot's device-side token ``history`` [S, max_len],
+    verifies all ``draft_len + 1`` positions in ONE forward, and emits
+    the longest accepted prefix plus the bonus token — decode is
+    KV-bandwidth-bound, so the (L+1)-token forward costs about one
+    step's wall time while emitting up to L+1 tokens.
+
+    Exactness: greedy emission is unchanged by construction — position
+    j's logits are conditioned on the draft prefix, which is only
+    consumed when verified equal to the true greedy continuation.
+    Sampled slots (temp > 0) take the position-0 logits and emit exactly
+    one token per sub-step with the same ``fold_in(base, counts + i)``
+    keys as the non-speculative path, so sampling results are identical
+    too.  Rejected draft rows (KV and history alike) sit past the slot's
+    length — dead until overwritten, exactly like admission pads.  The
+    engine reserves ``draft_len + 1`` rows of cache headroom so clamped
+    writes can never land on live rows.
+
+    Returns (cache, history, out [S, chunk, L+1], lps [S, chunk, L+1],
+    n_emit [S, chunk]) — the host consumes ``n_emit[s, i]`` tokens of
+    sub-step i's row.
+    """
+    max_len = cache.max_len
+    n_drafts = draft_len
+
+    def one(carry, i):
+        kv, lengths, tok, hist = carry
+        # Newest decided token enters the history at its position.
+        hist = jax.vmap(
+            lambda h, n, t: h.at[jnp.minimum(n, max_len - 1)].set(t)
+        )(hist, lengths, tok)
+        drafts = jax.vmap(
+            partial(_draft_lookup, draft_len=n_drafts, ngram=ngram,
+                    max_len=max_len)
+        )(hist, lengths)  # [S, L]
+        hist = jax.vmap(
+            lambda h, n, d: jax.lax.dynamic_update_slice(
+                h, d, (jnp.minimum(n + 1, max_len - n_drafts),)
+            )
+        )(hist, lengths, drafts)
+        inputs = jnp.concatenate([tok[:, None], drafts], axis=1)
+        x, kv = _hidden_slots(params, inputs, kv, lengths, cfg)
+        logits = _unembed(x, dequantize_named(params, "wlm"), cfg)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, L+1]
+        accepted = jnp.sum(
+            jnp.cumprod(
+                (drafts == greedy[:, :n_drafts]).astype(jnp.int32), axis=1
+            ),
+            axis=1,
+        )
+        keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
+        samp, samp_lp = _sample_batched(
+            logits[:, 0], temps, keys, top_k, top_p
+        )
+        is_greedy = temps <= 0.0
+        emitted = greedy.at[:, 0].set(
+            jnp.where(is_greedy, greedy[:, 0], samp)
+        )
+        chosen = jnp.take_along_axis(
+            logits, emitted[..., None], axis=-1
+        )[..., 0]
+        lps = chosen.astype(jnp.float32) - jax.nn.logsumexp(
+            logits.astype(jnp.float32), axis=-1
+        )
+        lps = lps.at[:, 0].set(jnp.where(is_greedy, lps[:, 0], samp_lp))
+        n_emit = jnp.where(
+            active, jnp.where(is_greedy, accepted + 1, 1), 0
+        ).astype(jnp.int32)
+        tok_next = jnp.where(
+            active,
+            jnp.take_along_axis(
+                emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+            )[:, 0],
+            tok,
+        )
+        lengths = jnp.minimum(lengths + n_emit, max_len - 1 - n_drafts)
+        return (kv, lengths, tok_next, hist), (emitted, lps, n_emit)
+
+    kv0 = (cache.k, cache.v, cache.k_scale, cache.v_scale)
+    ((k_all, v_all, ks_all, vs_all), lengths, _, history), (
+        out, lps, n_emit
+    ) = jax.lax.scan(
+        one, (kv0, cache.lengths, tokens, history), jnp.arange(chunk)
+    )
+    return (
+        SlotCache(k_all, v_all, lengths, ks_all, vs_all),
+        history,
+        out.transpose(1, 0, 2),
+        lps.transpose(1, 0, 2),
+        n_emit.T,
+    )
+
+
 @dataclass
 class GenRequest:
     """One generation request.  ``tokens`` are prompt token ids (the
@@ -498,12 +629,31 @@ class Engine:
         kv_int8: bool = False,
         prefix_cache_size: int = 0,
         mesh=None,
+        spec_decode: int = 0,
+        spec_ngram: int = 2,
     ):
         if n_slots < 1 or max_len < 2 or chunk < 1 or prefix_cache_size < 0:
             raise ValueError(
                 f"need n_slots>=1, max_len>=2, chunk>=1, "
                 f"prefix_cache_size>=0; got {n_slots}, {max_len}, {chunk}, "
                 f"{prefix_cache_size}"
+            )
+        if spec_decode < 0 or (spec_decode and spec_ngram < 1):
+            raise ValueError(
+                f"need spec_decode>=0 and spec_ngram>=1; got "
+                f"{spec_decode}, {spec_ngram}"
+            )
+        self.spec_decode = spec_decode
+        self.spec_ngram = spec_ngram
+        # Speculative mode reserves draft_len+1 cache rows per slot so a
+        # verify step's L+1 writes always fit inside the region even
+        # during post-EOS overshoot (clamped starts must never slide
+        # back over live rows).
+        self._usable_len = max_len - (spec_decode + 1 if spec_decode else 0)
+        if self._usable_len < 2:
+            raise ValueError(
+                f"max_len={max_len} leaves no usable room after the "
+                f"spec_decode={spec_decode} headroom reserve"
             )
         if mesh is not None:
             # Tensor-parallel serving: shard params by logical axes and
@@ -535,32 +685,43 @@ class Engine:
         self.chunk = chunk
         if prompt_buckets is None:
             prompt_buckets, b = [], 16
-            while b < max_len:
+            while b < self._usable_len:
                 prompt_buckets.append(b)
                 b *= 2
-            prompt_buckets.append(max_len - 1)
+            prompt_buckets.append(self._usable_len - 1)
         self.prompt_buckets = tuple(sorted(set(prompt_buckets)))
         bad_buckets = [
-            b for b in self.prompt_buckets if not 1 <= b <= max_len - 1
+            b for b in self.prompt_buckets
+            if not 1 <= b <= self._usable_len - 1
         ]
         if bad_buckets:
             # Fail at construction, not as an XLA shape error inside the
             # first admit (which would kill a server's driver thread).
             raise ValueError(
-                f"prompt_buckets must fit 1..max_len-1={max_len - 1} "
-                f"(each admitted prompt needs >=1 generated token): "
+                f"prompt_buckets must fit 1..{self._usable_len - 1} "
+                f"(each admitted prompt needs >=1 generated token, and "
+                f"speculative mode reserves spec_decode+1 rows): "
                 f"{bad_buckets}"
             )
         self._cache = SlotCache.create(
             cfg, n_slots, max_len, quantized=kv_int8
         )
+        # Device-side token record per slot (admission writes the full
+        # prompt; speculative decode appends) — the draft source.
+        self._history = jnp.zeros((n_slots, max_len), jnp.int32)
         if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
             self._cache = jax.device_put(
                 self._cache, cache_shardings(self._cache, mesh)
             )
+            self._history = jax.device_put(
+                self._history, NamedSharding(mesh, P())
+            )
         self._admit = jax.jit(
-            partial(_admit_batch, cfg=cfg, top_k=top_k, top_p=top_p),
-            donate_argnums=(1,),
+            partial(_admit_batch, cfg=cfg, top_k=top_k, top_p=top_p,
+                    track_history=bool(spec_decode)),
+            donate_argnums=(1, 2),
         )
         # Prefix cache: LRU of prompt-KV entries (tuple(tokens) →
         # (kv pytree, true length)).  Each entry costs about one slot's
@@ -578,11 +739,21 @@ class Engine:
         self.prefix_hits = 0
         self.prefix_misses = 0
         self._embed = jax.jit(partial(embed_tokens, cfg=cfg))
-        self._decode = jax.jit(
-            partial(_decode_chunk, cfg=cfg, chunk=chunk, top_k=top_k,
-                    top_p=top_p),
-            donate_argnums=(1,),
-        )
+        if spec_decode:
+            self._decode = jax.jit(
+                partial(_decode_chunk_spec, cfg=cfg, chunk=chunk,
+                        draft_len=spec_decode, ngram=spec_ngram,
+                        top_k=top_k, top_p=top_p),
+                donate_argnums=(1, 2),
+            )
+        else:
+            self._decode = jax.jit(
+                partial(_decode_chunk, cfg=cfg, chunk=chunk, top_k=top_k,
+                        top_p=top_p),
+                donate_argnums=(1,),
+            )
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         self._lock = threading.Lock()
         self._queue: list[tuple[int, GenRequest, float]] = []
         self._slots: dict[int, _SlotState] = {}  # slot index → state
@@ -647,7 +818,6 @@ class Engine:
     # -- submission / results (any thread) --------------------------------
 
     def _validate(self, req: GenRequest) -> None:
-        max_len = self._cache.max_len
         if not req.tokens:
             raise ValueError("empty prompt")
         if req.max_new_tokens < 1:
@@ -657,10 +827,16 @@ class Engine:
                 f"prompt length {len(req.tokens)} exceeds largest bucket "
                 f"{self.prompt_buckets[-1]}"
             )
-        if len(req.tokens) + req.max_new_tokens > max_len:
+        if len(req.tokens) + req.max_new_tokens > self._usable_len:
             raise ValueError(
                 f"prompt {len(req.tokens)} + max_new_tokens "
-                f"{req.max_new_tokens} exceeds max_len {max_len}"
+                f"{req.max_new_tokens} exceeds max_len "
+                f"{self._cache.max_len}"
+                + (
+                    f" minus the spec_decode+1={self.spec_decode + 1} "
+                    f"headroom reserve"
+                    if self.spec_decode else ""
+                )
             )
         bad = [t for t in req.tokens if not 0 <= t < self.cfg.vocab_size]
         if bad:
@@ -805,6 +981,8 @@ class Engine:
                 "prefix_hits": self.prefix_hits,
                 "prefix_misses": self.prefix_misses,
                 "prefix_entries": len(self._prefix_cache),
+                "spec_drafted": self.spec_drafted,
+                "spec_accepted": self.spec_accepted,
             }
 
     def _bucket(self, n: int) -> int:
@@ -916,10 +1094,17 @@ class Engine:
                 rows.append((slot, rid, req, t_submit, start, tail,
                              self._bucket(len(tail))))
             zero_key = jax.random.PRNGKey(0)
+            max_len = self._cache.max_len
             groups = []  # (group rows, first_tokens, first_logprobs)
             for bucket in sorted({r[6] for r in rows}):
                 group = [r for r in rows if r[6] == bucket]
                 prompts = np.zeros((n_slots, bucket), np.int32)
+                # Dummy when history isn't tracked: _admit_batch passes
+                # it through, so skip the [S, max_len] transfer.
+                full_rows = np.zeros(
+                    (n_slots, max_len) if self.spec_decode else (1, 1),
+                    np.int32,
+                )
                 slot_idx = np.full((n_slots,), n_slots, np.int32)  # inert
                 starts = np.zeros((n_slots,), np.int32)
                 tails = np.ones((n_slots,), np.int32)
@@ -929,6 +1114,8 @@ class Engine:
                     group
                 ):
                     prompts[i, : len(tail)] = tail
+                    if self.spec_decode:
+                        full_rows[i, : len(req.tokens)] = req.tokens
                     slot_idx[i] = slot
                     starts[i] = start
                     tails[i] = len(tail)
@@ -936,9 +1123,11 @@ class Engine:
                     keys[i] = jax.random.fold_in(
                         jax.random.PRNGKey(req.seed), 0
                     )
-                self._cache, first, first_lp = self._admit(
+                self._cache, self._history, first, first_lp = self._admit(
                     self.params,
                     self._cache,
+                    self._history,
+                    jnp.asarray(full_rows),
                     jnp.asarray(prompts),
                     jnp.asarray(slot_idx),
                     jnp.asarray(starts),
@@ -1016,10 +1205,23 @@ class Engine:
             [len(slots[i].emitted) if i in slots else 0 for i in range(n_slots)],
             jnp.int32,
         )
-        self._cache, out, lps = self._decode(
-            self.params, self._cache, tokens, temps, active, bases, counts
-        )
-        out, lps = jax.device_get((out, lps))  # ONE readback per chunk
+        if self.spec_decode:
+            (
+                self._cache, self._history, out3, lps3, n_emit
+            ) = self._decode(
+                self.params, self._cache, self._history, tokens, temps,
+                active, bases, counts,
+            )
+            # ONE readback per chunk, speculative or not.
+            out3, lps3, n_emit = jax.device_get((out3, lps3, n_emit))
+        else:
+            self._cache, out, lps = self._decode(
+                self.params, self._cache, tokens, temps, active, bases,
+                counts,
+            )
+            out, lps = jax.device_get((out, lps))
+            out3, lps3 = out[:, :, None], lps[:, :, None]
+            n_emit = np.ones(out3.shape[:2], np.int32)
         self._step_count += 1
         self._m_dispatches.inc()
         notices = []  # (callback, tokens..., end?) fired outside the lock
@@ -1027,11 +1229,25 @@ class Engine:
             for slot, state in list(slots.items()):
                 done = False
                 fresh = []
-                for token, lp in zip(out[slot], lps[slot]):
-                    self.tokens_generated += 1
-                    fresh.append((int(token), float(lp)))
-                    if self._emit(state, int(token), float(lp)):
-                        done = True
+                greedy = state.req.temperature <= 0.0
+                for i in range(out3.shape[1]):
+                    nem = int(n_emit[slot, i])
+                    if self.spec_decode and greedy:
+                        self.spec_drafted += self.spec_decode
+                    for j in range(nem):
+                        token = int(out3[slot, i, j])
+                        lp = float(lps3[slot, i, j])
+                        self.tokens_generated += 1
+                        fresh.append((token, lp))
+                        if self.spec_decode and greedy and j < nem - 1:
+                            # Accepted-AND-consumed drafts only, so the
+                            # acceptance-rate diagnostic stays honest at
+                            # request tails (host truncation).
+                            self.spec_accepted += 1
+                        if self._emit(state, token, lp):
+                            done = True
+                            break
+                    if done:
                         break
                 cb = (
                     self._callbacks.pop(state.rid, None) if done
@@ -1064,7 +1280,7 @@ class Engine:
         deployments warm before going live: a TPU compile is 20-40 s and
         must never land on live traffic (the control-plane analog is the
         registry pre-dialing controllers it proxies for)."""
-        max_len = self._cache.max_len
+        max_len = self._usable_len
         self._warming = True  # dummies must not pollute request metrics
         try:
             rids = []

@@ -416,6 +416,100 @@ def test_tp_engine_rejects_indivisible_heads(setup):
         Engine(params, cfg, n_slots=1, max_len=32, mesh=mesh)
 
 
+def _echo_prompt(n: int, vocab: int) -> list[int]:
+    """A repetitive prompt (cycle of 4 tokens) — prompt-lookup drafting's
+    best case; the continuation tends to repeat the cycle."""
+    pattern = [t % vocab for t in (7, 21, 40, 3)]
+    return (pattern * (n // len(pattern) + 1))[:n]
+
+
+def test_speculative_engine_exact(setup):
+    """In-engine speculative decoding must be invisible to results:
+    draft_len 2 and 4 engines emit exactly what the plain engine emits
+    on echo-heavy AND random prompts, greedy and sampled, int8 KV too."""
+    cfg, params = setup
+    cases = [
+        GenRequest(tokens=_echo_prompt(12, cfg.vocab_size),
+                   max_new_tokens=10),
+        GenRequest(tokens=_prompt(70, 9, cfg.vocab_size), max_new_tokens=7),
+        GenRequest(tokens=_prompt(71, 14, cfg.vocab_size), max_new_tokens=6,
+                   temperature=0.8, seed=11),
+    ]
+    from oim_tpu.parallel import build_mesh
+
+    tp_mesh = build_mesh(tp=2, devices=jax.devices()[:2])
+    for kv_int8 in (False, True):
+        baseline = None
+        for spec, mesh in ((0, None), (2, None), (4, None), (3, tp_mesh)):
+            engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                            kv_int8=kv_int8, spec_decode=spec, mesh=mesh)
+            rids = [engine.submit(r) for r in cases]
+            results = engine.run()
+            outs = [results[r] for r in rids]
+            if baseline is None:
+                baseline = outs
+            else:
+                assert outs == baseline, (
+                    f"spec_decode={spec} kv_int8={kv_int8} "
+                    f"mesh={mesh is not None} diverged"
+                )
+
+
+def test_speculative_accepts_on_echo_prompts(setup):
+    """The drafter must actually pay on repetitive content: acceptance
+    rate > 0 and fewer decode dispatches than the plain engine."""
+    cfg, params = setup
+    req = lambda: GenRequest(  # noqa: E731
+        tokens=_echo_prompt(16, cfg.vocab_size), max_new_tokens=24
+    )
+    plain = Engine(params, cfg, n_slots=1, max_len=64, chunk=4)
+    plain.submit(req())
+    plain.run()
+    spec = Engine(params, cfg, n_slots=1, max_len=64, chunk=4,
+                  spec_decode=4)
+    spec.submit(req())
+    spec.run()
+    stats = spec.stats()
+    assert stats["spec_accepted"] > 0, stats
+    assert stats["steps"] < plain.stats()["steps"], (
+        stats, plain.stats()
+    )
+
+
+def test_speculative_prefix_cache_and_streaming_exact(setup):
+    """Speculative mode composes with the prefix cache and streaming:
+    a cache-hit request streams exactly the oracle's tokens."""
+    cfg, params = setup
+    system = _prompt(75, 16, cfg.vocab_size)
+    tail = _prompt(76, 4, cfg.vocab_size)
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                    prefix_cache_size=2, spec_decode=3)
+    r1 = engine.submit(GenRequest(tokens=system, max_new_tokens=1,
+                                  cache_prefix=True))
+    engine.run()
+    engine.result(r1)
+    streamed = []
+    r2 = engine.submit(
+        GenRequest(tokens=system + tail, max_new_tokens=6),
+        on_token=lambda t, lp: streamed.append(t),
+    )
+    got = engine.run()[r2]
+    assert engine.stats()["prefix_hits"] == 1
+    assert got == _oracle(params, cfg, system + tail, 6)
+    assert streamed == got + [None]
+
+
+def test_speculative_headroom_validation(setup):
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=32, chunk=2,
+                    spec_decode=4)
+    # usable = 32 - 5 = 27: a request needing 28 rows must be rejected.
+    with pytest.raises(ValueError, match="headroom"):
+        engine.submit(GenRequest(tokens=[1] * 20, max_new_tokens=8))
+    engine.submit(GenRequest(tokens=[1] * 20, max_new_tokens=7))
+    engine.run()
+
+
 def test_server_survives_driver_crash(setup):
     """A crashing engine step must flip /healthz, fail in-flight requests
     with a 500, and reject new ones with 503 — not hang clients."""
