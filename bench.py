@@ -64,7 +64,11 @@ def log(msg: str) -> None:
 
 def emit(value_ms, extras: dict) -> None:
     """The one stdout JSON line the driver records.  Always called exactly
-    once, even on failure (value may then be None with an error field)."""
+    once, even on failure (value may then be None with an error field).
+    Every clean on-chip run additionally snapshots itself to
+    ``BENCH_LAST_GOOD.json`` (git SHA + timestamp) so a later outage can
+    never reduce the perf story to prose — the round-2 lesson, where the
+    pool died mid-round and took every measured number with it."""
     out = {
         "metric": METRIC,
         "value": round(value_ms, 2) if value_ms is not None else None,
@@ -75,6 +79,31 @@ def emit(value_ms, extras: dict) -> None:
     }
     out.update(extras)
     print(json.dumps(out), flush=True)
+    if value_ms is not None and "degraded" not in out and "error" not in out:
+        _write_last_good(out)
+
+
+def _write_last_good(payload: dict) -> None:
+    """Durable, committable evidence of the latest successful on-chip
+    run (≙ the artifact discipline of the reference's env-gated tiers,
+    /root/reference/test/test.make:1-16)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        snapshot = dict(payload)
+        snapshot["git_sha"] = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, cwd=repo,
+        ).stdout.strip()
+        snapshot["timestamp_utc"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
+        path = os.path.join(repo, "BENCH_LAST_GOOD.json")
+        with open(path, "w") as f:
+            json.dump(snapshot, f, indent=1, sort_keys=True)
+            f.write("\n")
+        log(f"bench: wrote {path} — commit it (outage-proof evidence)")
+    except Exception as exc:  # the stdout line already went out
+        log(f"bench: last-good snapshot failed: {exc}")
 
 
 def kill_stale_daemons() -> list:
@@ -635,22 +664,65 @@ def _serve_diagnostics(extras, on_tpu, cfg, params) -> None:
             engine.submit(GenRequest(tokens=p, max_new_tokens=new_tokens))
             for p in prompts
         ]
+        readbacks_before = engine.stats()["readbacks"]
         results = engine.run()
         dt = time.perf_counter() - t0
         assert all(len(results[r]) == new_tokens for r in rids)
         generated = n_req * new_tokens
-        # Readbacks: one per admission + one per engine step (chunked
-        # decode); subtracting them isolates device throughput from the
-        # tunnel (see module docstring).
+        # The engine counts its own readbacks (one per admission WAVE —
+        # admissions batch into one dispatch per bucket with a single
+        # combined readback — plus one per decode chunk); subtracting
+        # them isolates device throughput from the tunnel.
         steps = engine.stats()["steps"] - steps_before
+        readbacks = engine.stats()["readbacks"] - readbacks_before
         rtt_s = extras.get("tunnel_rtt_ms", 0.0) / 1000.0
-        adjusted = max(dt - (n_req + steps) * rtt_s, 1e-9)
+        adjusted = max(dt - readbacks * rtt_s, 1e-9)
         extras["serve_tok_per_s"] = round(generated / dt)
         extras["serve_tok_per_s_rtt_adj"] = round(generated / adjusted)
+        extras["serve_readbacks"] = readbacks
         log(
             f"bench: serving {generated / dt:.0f} tok/s raw, "
             f"{generated / adjusted:.0f} rtt-adjusted ({n_req} requests, "
-            f"8 slots, {new_tokens} new tokens each, {steps} chunk steps)"
+            f"8 slots, {new_tokens} new tokens each, {steps} chunk steps, "
+            f"{readbacks} readbacks)"
+        )
+
+        if not on_tpu:
+            return
+        # Speculative serving on echo-heavy prompts (prompt-lookup's
+        # home turf): exact greedy output, fewer chunks per request.
+        # Free the plain engine's KV cache first — two flagship-sized
+        # caches may not fit HBM together, and a swallowed OOM here
+        # would silently drop these extras.
+        del engine
+        pattern = [7, 21, 40, 3]
+        spec_engine = Engine(
+            params, cfg, n_slots=8, max_len=512, chunk=8,
+            prompt_buckets=(128,), spec_decode=4,
+        )
+        spec_engine.warmup()
+        echo_prompts = [
+            [t % cfg.vocab_size for t in (pattern * 32)[: 64 + 32 * (i % 3)]]
+            for i in range(n_req)
+        ]
+        t0 = time.perf_counter()
+        rids = [
+            spec_engine.submit(GenRequest(tokens=p, max_new_tokens=new_tokens))
+            for p in echo_prompts
+        ]
+        spec_results = spec_engine.run()
+        dt_spec = time.perf_counter() - t0
+        assert all(len(spec_results[r]) == new_tokens for r in rids)
+        stats = spec_engine.stats()
+        accept_pct = (
+            100.0 * stats["spec_accepted"] / max(stats["spec_drafted"], 1)
+        )
+        extras["serve_spec_tok_per_s"] = round(generated / dt_spec)
+        extras["serve_spec_accept_pct"] = round(accept_pct, 1)
+        log(
+            f"bench: speculative serving {generated / dt_spec:.0f} tok/s "
+            f"on echo prompts (accept {accept_pct:.0f}%, "
+            f"{stats['readbacks']} readbacks)"
         )
     except Exception as exc:  # pragma: no cover - diagnostics only
         log(f"bench: serving diagnostic skipped: {exc}")

@@ -754,6 +754,9 @@ class Engine:
             )
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # Host↔device readbacks (the tunnel-cost unit benchmarks account
+        # in): one per admission wave, one per decode chunk.
+        self.readbacks = 0
         self._lock = threading.Lock()
         self._queue: list[tuple[int, GenRequest, float]] = []
         self._slots: dict[int, _SlotState] = {}  # slot index → state
@@ -983,6 +986,7 @@ class Engine:
                 "prefix_entries": len(self._prefix_cache),
                 "spec_drafted": self.spec_drafted,
                 "spec_accepted": self.spec_accepted,
+                "readbacks": self.readbacks,
             }
 
     def _bucket(self, n: int) -> int:
@@ -1141,6 +1145,8 @@ class Engine:
                     self._store_prefix(slot, req.tokens)
             # ONE combined readback for every admission this step.
             fetched = jax.device_get([(f, lp) for _, f, lp in groups])
+            if not self._warming:
+                self.readbacks += 1
             notices = []
             with self._lock:
                 for (group, _, _), (f_host, lp_host) in zip(groups, fetched):
@@ -1214,12 +1220,16 @@ class Engine:
             )
             # ONE readback per chunk, speculative or not.
             out3, lps3, n_emit = jax.device_get((out3, lps3, n_emit))
+            if not self._warming:
+                self.readbacks += 1
         else:
             self._cache, out, lps = self._decode(
                 self.params, self._cache, tokens, temps, active, bases,
                 counts,
             )
             out, lps = jax.device_get((out, lps))
+            if not self._warming:
+                self.readbacks += 1
             out3, lps3 = out[:, :, None], lps[:, :, None]
             n_emit = np.ones(out3.shape[:2], np.int32)
         self._step_count += 1
@@ -1232,14 +1242,17 @@ class Engine:
                 greedy = state.req.temperature <= 0.0
                 for i in range(out3.shape[1]):
                     nem = int(n_emit[slot, i])
-                    if self.spec_decode and greedy:
+                    if self.spec_decode and greedy and not self._warming:
                         self.spec_drafted += self.spec_decode
                     for j in range(nem):
                         token = int(out3[slot, i, j])
                         lp = float(lps3[slot, i, j])
                         self.tokens_generated += 1
                         fresh.append((token, lp))
-                        if self.spec_decode and greedy and j < nem - 1:
+                        if (
+                            self.spec_decode and greedy and j < nem - 1
+                            and not self._warming
+                        ):
                             # Accepted-AND-consumed drafts only, so the
                             # acceptance-rate diagnostic stays honest at
                             # request tails (host truncation).
