@@ -117,7 +117,10 @@ def wait_for_devices(paths: list[str], timeout: float, poll: float = 0.1) -> Non
     """
     with tracing.start_span("device/wait", devices=len(paths)):
         deadline = time.monotonic() + timeout
-        missing = list(paths)
+        # ``pjrt:N`` ids (agent --chips-from-pjrt mode) are logical, not
+        # filesystem nodes: the PJRT enumeration that produced them already
+        # observed the live device, so there is nothing to wait for.
+        missing = [p for p in paths if not p.startswith("pjrt:")]
         while missing:
             missing = [p for p in missing if not os.path.exists(p)]
             if not missing:
