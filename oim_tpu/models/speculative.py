@@ -52,12 +52,22 @@ def _draft_ngram(history, length, draft_len: int, ngram: int):
     idx = jnp.arange(t)[:, None] + jnp.arange(ngram)[None, :]
     windows = history[jnp.clip(idx, 0, t - 1)]
     matches = jnp.all(windows == query[None, :], axis=1)
-    # A candidate window must end before the query starts (no
-    # self-match); that also guarantees at least ngram follower tokens.
+    # Prefer the most recent match whose continuation lies fully inside
+    # the decided region [0, length): rows at/past ``length`` are zeros
+    # (undecided), and a match ending near the edge drafts them —
+    # wasting the draft budget in exactly the self-repetition regime
+    # where lookup should accept everything.  Fall back to the freshest
+    # edge match (continuation clipped by the zero rows) when no
+    # fully-decided match exists yet.
     positions = jnp.arange(t)
-    matches = matches & (positions + ngram < length - ngram + 1)
-    found = jnp.any(matches)
-    best = jnp.max(jnp.where(matches, positions, -1))
+    ok = matches & (positions + ngram < length - ngram + 1)
+    best_full = jnp.max(
+        jnp.where(ok & (positions + ngram + draft_len <= length),
+                  positions, -1)
+    )
+    best_edge = jnp.max(jnp.where(ok, positions, -1))
+    best = jnp.where(best_full >= 0, best_full, best_edge)
+    found = best_edge >= 0
     start = jnp.clip(best + ngram, 0, t - draft_len)
     draft = jax.lax.dynamic_slice(history, (start,), (draft_len,))
     return jnp.where(found, draft, jnp.zeros_like(draft)), found

@@ -455,16 +455,36 @@ def _draft_lookup(hist, length, draft_len: int, ngram: int, max_len: int):
     ``length``, where the newest decided token was just written) and
     return the ``draft_len`` tokens that followed it.  No match → zeros;
     a wrong draft is rejection-safe (verification emits the true token),
-    so garbage never affects results, only the acceptance rate."""
+    so garbage never affects results, only the acceptance rate.
+
+    Candidate selection prefers the most recent match whose whole
+    continuation lies inside the decided region ``[0, length]`` — rows
+    past ``length`` hold the previous sub-step's rejected drafts (stale
+    garbage), and a match ending right at the edge drafts from them.
+    Without the preference, a slot in a repetition cycle always matched
+    at the edge and drafted ``[real, stale, stale, ...]``, capping
+    acceptance near ``1/draft_len`` in exactly the regime where prompt
+    lookup should accept everything.  When no fully-decided match
+    exists (early in a short history), fall back to the freshest edge
+    match with its undecided positions masked to 0 — a partial draft
+    still beats none."""
     query_start = length - ngram + 1
     query = hist[jnp.clip(query_start + jnp.arange(ngram), 0, max_len - 1)]
     idx = jnp.arange(max_len)[:, None] + jnp.arange(ngram)[None, :]
     windows = hist[jnp.clip(idx, 0, max_len - 1)]  # [max_len, ngram]
     eq = jnp.all(windows == query[None, :], axis=1)
     window_end = jnp.arange(max_len) + ngram - 1
-    cand = eq & (window_end < length) & (query_start >= 0)
-    w = jnp.max(jnp.where(cand, jnp.arange(max_len), -1))
-    drafts = hist[jnp.clip(w + ngram + jnp.arange(draft_len), 0, max_len - 1)]
+    positions = jnp.arange(max_len)
+    ok = eq & (query_start >= 0)
+    w_full = jnp.max(
+        jnp.where(ok & (window_end + draft_len <= length), positions, -1)
+    )
+    w_edge = jnp.max(jnp.where(ok & (window_end < length), positions, -1))
+    w = jnp.where(w_full >= 0, w_full, w_edge)
+    cont = w + ngram + jnp.arange(draft_len)
+    drafts = jnp.where(
+        cont <= length, hist[jnp.clip(cont, 0, max_len - 1)], 0
+    )
     return jnp.where(w >= 0, drafts, 0)
 
 

@@ -455,6 +455,40 @@ def test_speculative_engine_exact(setup):
                 )
 
 
+def test_draft_lookup_prefers_decided_continuation():
+    """The repetition-cycle regression: the most recent n-gram match ends
+    at the decided edge, so its continuation rows hold the PREVIOUS
+    sub-step's rejected drafts (stale garbage).  The lookup must prefer
+    an earlier match whose continuation is fully decided — otherwise a
+    slot emitting a cycle drafts [real, stale, stale, ...] and acceptance
+    caps near 1/draft_len exactly where it should approach 1."""
+    from oim_tpu.serve.engine import _draft_lookup
+
+    max_len = 16
+    # Decided region [0..9] is a repeating 9; rows 10.. are stale junk
+    # left by a rejected draft write.
+    hist = jnp.asarray(
+        [9] * 10 + [5, 4, 3, 2, 1, 0], jnp.int32
+    )
+    drafts = _draft_lookup(
+        hist, jnp.int32(9), draft_len=4, ngram=2, max_len=max_len
+    )
+    np.testing.assert_array_equal(np.asarray(drafts), [9, 9, 9, 9])
+
+    # Fallback tier: history too short for a fully-decided continuation
+    # (only one earlier occurrence, right at the edge) → edge match with
+    # undecided positions masked to 0, not stale reads.
+    hist2 = jnp.asarray(
+        [7, 8, 7, 8, 5, 4, 3, 2] + [0] * 8, jnp.int32
+    )
+    drafts2 = _draft_lookup(
+        hist2, jnp.int32(3), draft_len=4, ngram=2, max_len=max_len
+    )
+    # Query [7,8] at 2..3; only earlier match at 0..1; continuation rows
+    # 2,3 decided ([7,8]), rows 4+ undecided -> masked to 0.
+    np.testing.assert_array_equal(np.asarray(drafts2), [7, 8, 0, 0])
+
+
 def test_speculative_accepts_on_echo_prompts(setup):
     """The drafter must actually pay on repetitive content: acceptance
     rate > 0 and fewer decode dispatches than the plain engine."""
