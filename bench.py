@@ -564,6 +564,48 @@ def _flagship_cfg(on_tpu: bool):
     )
 
 
+def measure_train_step(cfg, params, b, t, n_iter, rtt_s, mesh=None,
+                       optimizer=None) -> float:
+    """Step seconds for a [b, t] geometry — the ONE timing harness (N
+    steps ride a single scan dispatch, readback-ended, rtt-subtracted;
+    r3 jitter lessons live here).  Shared by the bench diagnostics and
+    tools/roofline.py so the two cannot diverge.
+
+    The train loop DONATES its state buffers, so the state is built from
+    copies — handing ``params`` in directly would delete them for the
+    caller's next measurement."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from oim_tpu.models import make_train_loop
+    from oim_tpu.models.train import TrainState, data_pspec, shard_state
+    from oim_tpu.parallel import build_mesh
+
+    mesh = mesh or build_mesh(devices=jax.devices()[:1])
+    optimizer = optimizer or optax.adamw(1e-3)
+    state = shard_state(
+        TrainState.create(jax.tree.map(jnp.copy, params), optimizer),
+        cfg, mesh,
+    )
+    loop = make_train_loop(cfg, mesh, optimizer)
+    tokens = (
+        (jnp.arange(b * t) % cfg.vocab_size).reshape(b, t).astype(jnp.int32)
+    )
+    batches = jax.device_put(
+        jnp.broadcast_to(tokens, (n_iter, b, t)),
+        jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(None, *data_pspec())
+        ),
+    )
+    state, metrics = loop(state, batches)  # compile
+    float(metrics["ce"][-1])
+    t0 = time.perf_counter()
+    state, metrics = loop(state, batches)
+    float(metrics["ce"][-1])
+    return (time.perf_counter() - t0 - rtt_s) / n_iter
+
+
 def _train_diagnostics(extras, on_tpu, cfg, batch, seq, params) -> None:
     """Single-chip training throughput + MFU of the flagship model.
 
@@ -576,48 +618,29 @@ def _train_diagnostics(extras, on_tpu, cfg, batch, seq, params) -> None:
     """
     try:
         import jax
-        import jax.numpy as jnp
-        import optax
 
-        from oim_tpu.models import make_train_loop
-        from oim_tpu.models.train import TrainState, data_pspec, shard_state
-        from oim_tpu.parallel import build_mesh
-
-        mesh = build_mesh(devices=jax.devices()[:1])
-        optimizer = optax.adamw(1e-3)
         n_params = sum(
             x.size for x in jax.tree_util.tree_leaves(params)
         )
-        state = shard_state(TrainState.create(params, optimizer), cfg, mesh)
-        loop = make_train_loop(cfg, mesh, optimizer)
-        n_iter = 20 if on_tpu else 4
-        tokens = (
-            (jnp.arange(batch * seq) % cfg.vocab_size)
-            .reshape(batch, seq)
-            .astype(jnp.int32)
-        )
-        batches = jax.device_put(
-            jnp.broadcast_to(tokens, (n_iter, batch, seq)),
-            jax.sharding.NamedSharding(
-                mesh, jax.sharding.PartitionSpec(None, *data_pspec())
-            ),
-        )
-        state, metrics = loop(state, batches)  # compile
-        float(metrics["ce"][-1])
         rtt_s = extras.get("tunnel_rtt_ms", 0.0) / 1000.0
-        t0 = time.perf_counter()
-        state, metrics = loop(state, batches)
-        float(metrics["ce"][-1])
-        dt = (time.perf_counter() - t0 - rtt_s) / n_iter
-        tok_s = batch * seq / dt
-        # Model FLOPs: 6·N per token (fwd 2N + bwd 4N), the standard
-        # dense-transformer estimate; attention scores add
-        # 12·L·T·d per token (fwd+bwd qk+pv).
-        flops_per_tok = 6 * n_params + 12 * cfg.n_layers * seq * cfg.d_model
-        model_flops = flops_per_tok * batch * seq
         gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
         peak = PEAK_TFLOPS.get(gen) if on_tpu else None
-        mfu = (model_flops / dt) / (peak * 1e12) * 100 if peak else None
+
+        def measure(b, t, n_iter):
+            """(step seconds, MFU %|None) for a [b, t] batch geometry."""
+            dt = measure_train_step(cfg, params, b, t, n_iter, rtt_s)
+            # Model FLOPs: 6·N per token (fwd 2N + bwd 4N), the standard
+            # dense-transformer estimate; attention scores add
+            # 12·L·T·d per token (fwd+bwd qk+pv).
+            flops_tok = 6 * n_params + 12 * cfg.n_layers * t * cfg.d_model
+            mfu = (
+                (flops_tok * b * t / dt) / (peak * 1e12) * 100
+                if peak else None
+            )
+            return dt, mfu
+
+        dt, mfu = measure(batch, seq, 20 if on_tpu else 4)
+        tok_s = batch * seq / dt
         extras["train_step_ms"] = round(dt * 1000, 2)
         extras["train_tok_per_s"] = round(tok_s)
         extras["n_params"] = n_params
@@ -629,6 +652,22 @@ def _train_diagnostics(extras, on_tpu, cfg, batch, seq, params) -> None:
             + (f", MFU {mfu:.1f}% of {gen} peak {peak:.0f} TF)" if mfu is not None
                else ", MFU n/a off-TPU)")
         )
+
+        if on_tpu:
+            # Long-context: same model, batch 1 x 8192 — the flash
+            # kernel's training case (the unfused path's O(T^2) scores
+            # would dominate here).
+            t_long = 8192
+            dt_l, mfu_l = measure(1, t_long, 10)
+            extras["train_t8192_step_ms"] = round(dt_l * 1000, 2)
+            extras["train_t8192_tok_per_s"] = round(t_long / dt_l)
+            if mfu_l is not None:
+                extras["mfu_t8192_pct"] = round(mfu_l, 1)
+            log(
+                f"bench: long-context train step (1x{t_long}) "
+                f"{dt_l*1000:.1f} ms ({t_long/dt_l:.0f} tok/s"
+                + (f", MFU {mfu_l:.1f}%)" if mfu_l is not None else ")")
+            )
     except Exception as exc:  # pragma: no cover - diagnostics only
         log(f"bench: training diagnostic skipped: {exc}")
 

@@ -248,22 +248,44 @@ def _hidden_cached(
     start = cache.length
     flat = _flat_layer_params(params, cfg)
 
-    def layer_step(x, scanned):
-        lp, k_cache, v_cache, k_scale, v_scale = scanned
+    quantized = cache.k_scale is not None
+
+    def layer_step(carry, scanned):
+        x, k_all, v_all, ks_all, vs_all = carry
+        lp, layer = scanned
         lp = maybe_dequantize_weights(lp, cfg.compute_dtype)  # weight-int8
-        x, (k_cache, v_cache, k_scale, v_scale) = _cached_attention(
-            x, lp, k_cache, v_cache, k_scale, v_scale, start, cfg
+        # Slice THIS layer's cache out of the stacked carry and write the
+        # update back with dynamic_update_index_in_dim.  The stacked
+        # buffers ride the scan CARRY (not xs/ys): ys concatenation
+        # allocated a fresh [L, ...] cache stack and copied every layer's
+        # buffer on every decode step, which made per-step cost scale
+        # with the cache ALLOCATION (measured 1.32 -> 1.87 ms/step going
+        # max_len 96 -> 160); carried buffers update in place.
+        idx = lambda a: jax.lax.dynamic_index_in_dim(  # noqa: E731
+            a, layer, 0, keepdims=False
         )
+        put = lambda a, u: jax.lax.dynamic_update_index_in_dim(  # noqa: E731
+            a, u, layer, 0
+        )
+        x, (k_l, v_l, ks_l, vs_l) = _cached_attention(
+            x, lp, idx(k_all), idx(v_all),
+            idx(ks_all) if quantized else None,
+            idx(vs_all) if quantized else None,
+            start, cfg,
+        )
+        k_all, v_all = put(k_all, k_l), put(v_all, v_l)
+        if quantized:
+            ks_all, vs_all = put(ks_all, ks_l), put(vs_all, vs_l)
         if cfg.n_experts:
             x = _moe_exact(x, lp, cfg)
         else:
             x, _ = _dense_mlp(x, lp, cfg)
-        return x, (k_cache, v_cache, k_scale, v_scale)
+        return (x, k_all, v_all, ks_all, vs_all), None
 
-    # None scales (full-precision cache) are empty pytrees: lax.scan
-    # carries them through untouched.
-    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
-        layer_step, x, (flat, cache.k, cache.v, cache.k_scale, cache.v_scale)
+    (x, new_k, new_v, new_ks, new_vs), _ = jax.lax.scan(
+        layer_step,
+        (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
+        (flat, jnp.arange(cfg.n_layers)),
     )
     x = _rmsnorm(x, params["final_norm"], cfg)
     new_cache = KVCache(

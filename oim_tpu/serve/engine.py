@@ -280,22 +280,41 @@ def _hidden_slots(params, tokens, kv, starts, cfg):
     cfg = replace(cfg, use_pallas=False)
     x = params["wte"].astype(cfg.compute_dtype)[tokens]
     flat = _flat_layer_params(params, cfg)
+    quantized = kv[2] is not None
 
-    def layer_step(x, scanned):
-        lp, k_cache, v_cache, k_scale, v_scale = scanned
+    def layer_step(carry, scanned):
+        x, k_all, v_all, ks_all, vs_all = carry
+        lp, layer = scanned
         lp = maybe_dequantize_weights(lp, cfg.compute_dtype)  # weight-int8
-        x, (k_cache, v_cache, k_scale, v_scale) = _slot_attention(
-            x, lp, k_cache, v_cache, k_scale, v_scale, starts, cfg
+        # Stacked cache rides the CARRY with per-layer dynamic slicing —
+        # an xs/ys cache made lax.scan concatenate (allocate + copy) the
+        # whole stack every decode step, scaling per-step cost with the
+        # cache allocation (see models/decode.py:_hidden_cached).
+        idx = lambda a: jax.lax.dynamic_index_in_dim(  # noqa: E731
+            a, layer, 0, keepdims=False
         )
+        put = lambda a, u: jax.lax.dynamic_update_index_in_dim(  # noqa: E731
+            a, u, layer, 0
+        )
+        x, (k_l, v_l, ks_l, vs_l) = _slot_attention(
+            x, lp, idx(k_all), idx(v_all),
+            idx(ks_all) if quantized else None,
+            idx(vs_all) if quantized else None,
+            starts, cfg,
+        )
+        k_all, v_all = put(k_all, k_l), put(v_all, v_l)
+        if quantized:
+            ks_all, vs_all = put(ks_all, ks_l), put(vs_all, vs_l)
         if cfg.n_experts:
             x = _moe_exact(x, lp, cfg)
         else:
             x, _ = _dense_mlp(x, lp, cfg)
-        return x, (k_cache, v_cache, k_scale, v_scale)
+        return (x, k_all, v_all, ks_all, vs_all), None
 
-    # None scales are empty pytrees: lax.scan carries them untouched.
-    x, kv = jax.lax.scan(layer_step, x, (flat, *kv))
-    return _rmsnorm(x, params["final_norm"], cfg), kv
+    (x, *kv), _ = jax.lax.scan(
+        layer_step, (x, *kv), (flat, jnp.arange(cfg.n_layers))
+    )
+    return _rmsnorm(x, params["final_norm"], cfg), tuple(kv)
 
 
 def _sample_batched(logits, temps, keys, top_k, top_p):

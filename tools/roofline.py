@@ -27,15 +27,15 @@ from dataclasses import replace
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
-
-
 def analytic_split(cfg, seq: int) -> dict:
     """Executed matmul FLOPs per token, fwd+bwd (bwd = 2x fwd), by part."""
     d, L, V, ff = cfg.d_model, cfg.n_layers, cfg.vocab_size, cfg.d_ff
     fwd = {
         "attn_proj": L * 8 * d * d,          # q,k,v,o: 4 matmuls x 2d^2
-        "attn_scores": L * 4 * seq * d,      # qk^T + pv, causal avg ~T/2 each
+        # qk^T + pv: the causal flash kernel skips fully-masked blocks,
+        # so each matmul executes ~T/2 of the T positions per token:
+        # 2 matmuls x 2 FLOPs/MAC x (T/2)·d = 2·T·d.
+        "attn_scores": L * 2 * seq * d,
         "mlp": L * 6 * d * ff,               # SwiGLU: gate, up, down matmuls
         "unembed": 2 * d * V,
     }
@@ -43,37 +43,15 @@ def analytic_split(cfg, seq: int) -> dict:
 
 
 def _measure_step(cfg, batch, seq, n_iter, rtt_s) -> float:
-    """Seconds per train step, scan-fused, readback-ended, rtt-subtracted."""
+    """Seconds per train step — bench.py's ONE timing harness (scan-fused,
+    readback-ended, rtt-subtracted), fed a fresh model for this cfg."""
     import jax
-    import jax.numpy as jnp
 
-    from oim_tpu.models import init_params, make_train_loop
-    from oim_tpu.models.train import TrainState, data_pspec, shard_state
-    from oim_tpu.parallel import build_mesh
-    import optax
+    import bench
+    from oim_tpu.models import init_params
 
-    mesh = build_mesh(devices=jax.devices()[:1])
     params = init_params(jax.random.PRNGKey(0), cfg)
-    optimizer = optax.adamw(1e-3)
-    state = shard_state(TrainState.create(params, optimizer), cfg, mesh)
-    loop = make_train_loop(cfg, mesh, optimizer)
-    tokens = (
-        (jnp.arange(batch * seq) % cfg.vocab_size)
-        .reshape(batch, seq)
-        .astype(jnp.int32)
-    )
-    batches = jax.device_put(
-        jnp.broadcast_to(tokens, (n_iter, batch, seq)),
-        jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec(None, *data_pspec())
-        ),
-    )
-    state, metrics = loop(state, batches)  # compile + warm
-    float(metrics["ce"][-1])
-    t0 = time.perf_counter()
-    state, metrics = loop(state, batches)
-    float(metrics["ce"][-1])
-    return (time.perf_counter() - t0 - rtt_s) / n_iter
+    return bench.measure_train_step(cfg, params, batch, seq, n_iter, rtt_s)
 
 
 def main() -> int:
@@ -89,7 +67,7 @@ def main() -> int:
 
     on_tpu = jax.default_backend() not in ("cpu",)
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
-    peak = PEAK_TFLOPS.get(gen, 0.0) if on_tpu else 0.0
+    peak = bench.PEAK_TFLOPS.get(gen, 0.0) if on_tpu else 0.0
 
     # Tunnel rtt (one scalar readback) to subtract from timed regions —
     # median of 5: single samples on the tunnel jitter by tens of ms,
@@ -149,8 +127,11 @@ def main() -> int:
     import oim_tpu.models as m
 
     # eval_shape: sizes only, no device allocation (the measure steps
-    # above already materialized five full models on the chip).
-    shapes = jax.eval_shape(m.init_params, jax.random.PRNGKey(0), cfg)
+    # above already materialized five full models on the chip).  cfg is
+    # closed over, not passed — eval_shape would trace it.
+    shapes = jax.eval_shape(
+        lambda key: m.init_params(key, cfg), jax.random.PRNGKey(0)
+    )
     n_params = sum(p.size for p in jax.tree.leaves(shapes))
     six_n_tok = 6 * n_params + 12 * cfg.n_layers * seq * cfg.d_model
 
