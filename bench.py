@@ -564,8 +564,7 @@ def _flagship_cfg(on_tpu: bool):
     )
 
 
-def measure_train_step(cfg, params, b, t, n_iter, rtt_s, mesh=None,
-                       optimizer=None) -> float:
+def measure_train_step(cfg, params, b, t, n_iter, rtt_s) -> float:
     """Step seconds for a [b, t] geometry — the ONE timing harness (N
     steps ride a single scan dispatch, readback-ended, rtt-subtracted;
     r3 jitter lessons live here).  Shared by the bench diagnostics and
@@ -582,8 +581,8 @@ def measure_train_step(cfg, params, b, t, n_iter, rtt_s, mesh=None,
     from oim_tpu.models.train import TrainState, data_pspec, shard_state
     from oim_tpu.parallel import build_mesh
 
-    mesh = mesh or build_mesh(devices=jax.devices()[:1])
-    optimizer = optimizer or optax.adamw(1e-3)
+    mesh = build_mesh(devices=jax.devices()[:1])
+    optimizer = optax.adamw(1e-3)
     state = shard_state(
         TrainState.create(jax.tree.map(jnp.copy, params), optimizer),
         cfg, mesh,

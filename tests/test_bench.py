@@ -81,3 +81,37 @@ def test_collective_unknown_op_rejected():
 
     with pytest.raises(ValueError, match="unknown collectives"):
         collective_bench(sizes_mb=(0.25,), ops=("broadcastify",))
+
+
+def test_measure_train_step_preserves_params():
+    """The train loop donates its state buffers; the shared timing
+    harness must build state from COPIES so back-to-back geometries (the
+    flagship + long-context measurements, roofline ablations) can reuse
+    one model.  Regression: the r3 long-context row initially died with
+    'Array has been deleted' because params went in undonated."""
+    import importlib
+    import os
+    import sys
+
+    import jax
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    bench = importlib.import_module("bench")
+    from oim_tpu.models import TransformerConfig, init_params
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        dtype="float32",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    dt1 = bench.measure_train_step(cfg, params, 2, 8, 1, 0.0)
+    dt2 = bench.measure_train_step(cfg, params, 1, 16, 1, 0.0)  # reuse
+    assert dt1 > 0 and dt2 > 0
+    # The original params must still be alive: summing every leaf forces
+    # a real device read (a donated/deleted buffer raises here).
+    import jax.numpy as jnp
+
+    for x in jax.tree_util.tree_leaves(params):
+        float(jnp.sum(x.astype(jnp.float32)))

@@ -399,7 +399,7 @@ def test_registry_failover_mid_rendezvous(tmp_path):
         with concurrent.futures.ThreadPoolExecutor(2) as pool:
             fut_a = pool.submit(
                 RemoteBackend(
-                    address, "host-a", rendezvous_timeout=30
+                    address, "host-a", rendezvous_timeout=90
                 ).create_device,
                 "pvc-fo",
                 params,
@@ -425,11 +425,11 @@ def test_registry_failover_mid_rendezvous(tmp_path):
             # gRPC's shared subchannel to the target may still sit in
             # refused-backoff from the outage; a CO retries UNAVAILABLE
             # NodeStage per the CSI contract, so the test does the same.
-            deadline = time.time() + 30
+            deadline = time.time() + 60
             while True:
                 try:
                     staged_b = RemoteBackend(
-                        address, "host-b", rendezvous_timeout=30
+                        address, "host-b", rendezvous_timeout=90
                     ).create_device("pvc-fo", params)
                     break
                 except VolumeError as exc:
@@ -439,7 +439,7 @@ def test_registry_failover_mid_rendezvous(tmp_path):
                     ):
                         raise
                     time.sleep(0.2)
-            staged_a = fut_a.result(timeout=30)
+            staged_a = fut_a.result(timeout=90)
 
         assert staged_a.num_processes == staged_b.num_processes == 2
         assert staged_a.process_id == 0 and staged_b.process_id == 1
