@@ -89,16 +89,9 @@ def test_measure_train_step_preserves_params():
     flagship + long-context measurements, roofline ablations) can reuse
     one model.  Regression: the r3 long-context row initially died with
     'Array has been deleted' because params went in undonated."""
-    import importlib
-    import os
-    import sys
-
     import jax
 
-    sys.path.insert(
-        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    )
-    bench = importlib.import_module("bench")
+    import bench  # repo root is on sys.path via tests/conftest.py
     from oim_tpu.models import TransformerConfig, init_params
 
     cfg = TransformerConfig(
