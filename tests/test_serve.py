@@ -455,6 +455,34 @@ def test_speculative_engine_exact(setup):
                 )
 
 
+def test_gqa_engine_exact():
+    """GQA serving (n_kv_heads < n_heads): the engine's kv-sized slot
+    cache must be invisible to results — plain, int8-KV, and in-engine
+    speculative engines all emit exactly what the solo decode path emits
+    for the same GQA model.  (The serve matrix otherwise runs MHA only;
+    GQA is the long-context serving configuration, BASELINE.md.)"""
+    cfg = TransformerConfig(**{**CFG, "n_kv_heads": 2})
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    prompts = [_prompt(40 + i, 6 + 3 * i, cfg.vocab_size) for i in range(3)]
+    expected = [_oracle(params, cfg, p, 10) for p in prompts]
+    for kwargs in ({}, {"kv_int8": True}, {"spec_decode": 3}):
+        eng = Engine(
+            params, cfg, n_slots=2, max_len=64, chunk=4, **kwargs
+        )
+        rids = [
+            eng.submit(GenRequest(tokens=p, max_new_tokens=10))
+            for p in prompts
+        ]
+        results = eng.run()
+        if kwargs.get("kv_int8"):
+            want = [
+                _oracle(params, cfg, p, 10, kv_int8=True) for p in prompts
+            ]
+        else:
+            want = expected
+        assert [results[r] for r in rids] == want, f"GQA {kwargs} diverged"
+
+
 def test_draft_lookup_prefers_decided_continuation():
     """The repetition-cycle regression: the most recent n-gram match ends
     at the decided edge, so its continuation rows hold the PREVIOUS

@@ -53,6 +53,12 @@ def main() -> int:
         "--weights-int8", action="store_true",
         help="also measure with weight-only int8 matmul weights",
     )
+    p.add_argument(
+        "--record", action="store_true",
+        help="append the result matrix to BENCH_HISTORY.jsonl "
+             "(tool-tagged, git-SHA-stamped) so the BASELINE.md GQA row "
+             "is machine-backed like the bench.py extras",
+    )
     args = p.parse_args()
 
     import jax
@@ -73,6 +79,7 @@ def main() -> int:
         jnp.arange(args.batch * args.prompt).reshape(args.batch, args.prompt)
         % 32768
     ).astype(jnp.int32)
+    results = {}
 
     for n_kv in (0, 4, 2):  # 0 = MHA (n_heads kv heads)
         cfg = TransformerConfig(
@@ -114,6 +121,7 @@ def main() -> int:
                 continue
             dt = (elapsed - rtt) / args.iters
             tok_s = args.batch * args.new / dt
+            results[f"{label}_kv_{kv_label}"] = round(tok_s)
             print(
                 f"{label:6s} kv={kv_label}: "
                 f"{tok_s:8.0f} tok/s  ({dt * 1e3:.0f} ms for "
@@ -121,7 +129,39 @@ def main() -> int:
                 flush=True,
             )
         del params
+    if args.record and results:
+        _record(args, rtt, results)
     return 0
+
+
+def _record(args, rtt: float, results: dict) -> None:
+    """Append the matrix to BENCH_HISTORY.jsonl, tool-tagged and
+    git-SHA-stamped.  Never raises: the measurements already printed,
+    and a missing git binary or read-only checkout must not turn a
+    successful benchmark into a non-zero exit."""
+    try:
+        import json
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        entry = {
+            "tool": "decode_bench",
+            "prompt": args.prompt, "new": args.new, "batch": args.batch,
+            "tok_per_s": results,
+            "tunnel_rtt_ms": round(rtt * 1e3, 1),
+            "git_sha": subprocess.run(
+                ["git", "rev-parse", "HEAD"],
+                capture_output=True, text=True, cwd=repo,
+            ).stdout.strip(),
+            "timestamp_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+        }
+        with open(os.path.join(repo, "BENCH_HISTORY.jsonl"), "a") as f:
+            f.write(json.dumps(entry, sort_keys=True) + "\n")
+        print(f"recorded -> BENCH_HISTORY.jsonl ({len(results)} cells)")
+    except Exception as exc:
+        print(f"record failed (measurements above still stand): {exc}")
 
 
 if __name__ == "__main__":
