@@ -60,10 +60,24 @@ def main() -> int:
     parser.add_argument("--iters", type=int, default=20)
     args = parser.parse_args()
 
+    import bench
+
+    # Probe the accelerator in a SUBPROCESS before any in-process jax
+    # touch (bench.py's discipline): a wedged pool would otherwise hang
+    # this process at the first device op with no timeout possible.
+    if not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        if not bench.probe_backend(
+            float(os.environ.get("OIM_BENCH_PROBE_DEADLINE", "120"))
+        ):
+            print(
+                json.dumps({"error": "tpu_unavailable", "hint":
+                            "pool down or wedged; roofline needs the "
+                            "real chip — rerun when the probe passes"})
+            )
+            return 1
+
     import jax
     import jax.numpy as jnp
-
-    import bench
 
     on_tpu = jax.default_backend() not in ("cpu",)
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
