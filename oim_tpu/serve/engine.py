@@ -94,6 +94,12 @@ from oim_tpu.ops.rope import apply_rope
 
 _NEG_BIG = -1e30
 
+# Engine.beam server-side policy: beam-k replicates the KV cache k-fold,
+# and each distinct (beam_size, alpha, eos_id) is a fresh XLA compile —
+# both client-controlled on a public endpoint, both bounded here.
+_MAX_BEAM_SIZE = 32
+_MAX_BEAM_PROGRAMS = 8
+
 
 def serve_param_shardings(params: dict, cfg: TransformerConfig, mesh):
     """NamedShardings for inference params by their logical axes
@@ -807,6 +813,8 @@ class Engine:
         # rid → (tokens, logprobs), consumed by result_full/result.
         self._results: dict[int, tuple[list[int], list[float]]] = {}
         self._events: dict[int, threading.Event] = {}
+        # (beam_size, alpha, eos_id) → jitted beam program (Engine.beam).
+        self._beam_fns: dict[tuple, object] = {}
         self._errors: dict[int, str] = {}
         self._callbacks: dict[int, object] = {}  # rid → on_token
         self._forgotten: set[int] = set()
@@ -929,6 +937,82 @@ class Engine:
             self.params, padded, jnp.asarray([len(tokens)], jnp.int32)
         )
         return [float(x) for x in jax.device_get(vec[0])]
+
+    def beam(
+        self,
+        tokens: list[int],
+        max_new_tokens: int,
+        beam_size: int = 4,
+        alpha: float = 0.6,
+        eos_id: int | None = None,
+    ) -> tuple[list[int], float]:
+        """Latency-mode beam search on the engine's model: returns
+        (generated tokens of the best hypothesis, normalized score).
+
+        The slot engine continuous-batches greedy/sampled decoding;
+        beam-k maintains k interdependent hypotheses whose cache rows
+        reorder every step, so it runs as a dedicated jitted program
+        (models/beam.py — one compile per (beam_size, alpha, eos_id,
+        max_new_tokens) configuration, cached here) rather than through
+        the slot machinery.  Like ``embed``, it is stateless and
+        slot-free: safe to call from any thread concurrently with the
+        decode loop (device compute serializes; no cache/queue state is
+        touched).  Beam-1 reproduces the engine's greedy output exactly
+        (tests pin this).
+
+        Validation is beam-specific: the slot engine's prompt buckets
+        and spec-decode headroom do not apply (beam builds its own cache
+        of exactly ``len(tokens) + max_new_tokens`` rows), but the
+        engine's ``max_len`` still bounds the total as the server-side
+        memory policy, ``beam_size`` is capped (the cache replicates
+        across the beam axis), and the jitted-program cache is FIFO-
+        bounded — all three are client-facing knobs on a public
+        endpoint.
+        """
+        if not tokens:
+            raise ValueError("prompt must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"need max_new_tokens >= 1, got {max_new_tokens}"
+            )
+        bad = [t for t in tokens if not 0 <= t < self.cfg.vocab_size]
+        if bad:
+            raise ValueError(
+                f"token ids out of range [0, {self.cfg.vocab_size}): "
+                f"{bad[:5]}"
+            )
+        if len(tokens) + max_new_tokens > self._cache.max_len:
+            raise ValueError(
+                f"prompt {len(tokens)} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_len {self._cache.max_len}"
+            )
+        if not 1 <= beam_size <= _MAX_BEAM_SIZE:
+            raise ValueError(
+                f"beam_size must be in [1, {_MAX_BEAM_SIZE}], "
+                f"got {beam_size}"
+            )
+        from oim_tpu.models.beam import make_beam_search_fn
+
+        key = (beam_size, float(alpha), eos_id)
+        fn = self._beam_fns.get(key)
+        if fn is None:
+            while len(self._beam_fns) >= _MAX_BEAM_PROGRAMS:
+                # FIFO eviction: the key is client-controlled, and an
+                # unbounded cache of jitted programs is a memory leak an
+                # adversarial client can drive one compile at a time.
+                self._beam_fns.pop(next(iter(self._beam_fns)))
+            fn = self._beam_fns[key] = make_beam_search_fn(
+                self.cfg, beam_size=beam_size, alpha=alpha, eos_id=eos_id
+            )
+        prompt = jnp.asarray([tokens], jnp.int32)
+        out, stats = fn(self.params, prompt, max_new_tokens=max_new_tokens)
+        generated = [int(t) for t in jax.device_get(out[0])[len(tokens):]]
+        if eos_id is not None:
+            # Tokens past the winner's EOS are 0-padding; trim to the
+            # real generation (EOS itself included, matching GenRequest
+            # eos semantics).
+            generated = generated[: int(stats["length"])]
+        return generated, float(stats["normalized_score"])
 
     def result(self, rid: int, timeout: float | None = None) -> list[int]:
         """Block until request ``rid`` completes; returns generated tokens

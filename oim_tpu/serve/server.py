@@ -22,6 +22,12 @@ API:
   POST /v1/embed      {"tokens": [int...]} → {"embedding": [float...],
                     "dim": d} — mean-pooled, L2-normalized final hidden
                     state (the embeddings surface).
+  POST /v1/beam       {"tokens": [int...], "max_new_tokens": N,
+                    "beam_size": 4, "alpha": 0.6, "eos_id": null}
+                    → {"tokens": [int...], "score": float} — latency-mode
+                    beam search (EOS-aware, GNMT length-normalized) on
+                    the engine's model; beam_size 1 equals greedy
+                    /v1/generate output exactly.
   GET  /healthz      → {"ok": true}
   GET  /v1/stats     → engine stats (slots, queue depth, tokens generated)
   GET  /metrics      → Prometheus exposition (shared registry)
@@ -159,6 +165,9 @@ class ServeServer:
                 if self.path == "/v1/embed":
                     self._embed_request()
                     return
+                if self.path == "/v1/beam":
+                    self._beam_request()
+                    return
                 if self.path != "/v1/generate":
                     self._json(404, {"error": f"no such path {self.path}"})
                     return
@@ -188,6 +197,23 @@ class ServeServer:
                     self._json(400, {"error": str(exc)})
                     return
                 self._json(200, {"embedding": vec, "dim": len(vec)})
+
+            def _beam_request(self) -> None:
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    eos = body.get("eos_id")
+                    toks, score = outer.engine.beam(
+                        [int(t) for t in body["tokens"]],
+                        max_new_tokens=int(body.get("max_new_tokens", 16)),
+                        beam_size=int(body.get("beam_size", 4)),
+                        alpha=float(body.get("alpha", 0.6)),
+                        eos_id=None if eos is None else int(eos),
+                    )
+                except (KeyError, TypeError, ValueError) as exc:
+                    self._json(400, {"error": str(exc)})
+                    return
+                self._json(200, {"tokens": toks, "score": score})
 
             def _generate(self, span) -> None:
                 try:

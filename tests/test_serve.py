@@ -754,6 +754,92 @@ def test_http_server(setup):
         server.stop()
 
 
+def test_engine_beam(setup):
+    """Engine.beam: beam-1 reproduces the engine's greedy path exactly;
+    beam-4 returns a finite score and a full generation; EOS trims.
+    (No monotonicity claim: a wider beam's FINAL normalized score is not
+    guaranteed >= beam-1's — it can evict the greedy prefix for
+    momentarily-better prefixes with worse continuations.)"""
+    import math
+
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=4)
+    tokens = _prompt(11, 8, cfg.vocab_size)
+    b1, s1 = engine.beam(tokens, max_new_tokens=9, beam_size=1)
+    assert b1 == _oracle(params, cfg, tokens, 9)
+    b4, s4 = engine.beam(tokens, max_new_tokens=9, beam_size=4)
+    assert len(b4) == 9
+    assert math.isfinite(s4) and math.isfinite(s1)
+    # Same config reuses the cached program (no recompile churn).
+    assert len(engine._beam_fns) == 2
+    engine.beam(tokens, max_new_tokens=9, beam_size=4)
+    assert len(engine._beam_fns) == 2
+    # EOS-aware: an eos_id the greedy path emits trims the generation.
+    eos = b1[3]
+    be, _ = engine.beam(tokens, max_new_tokens=9, beam_size=1, eos_id=eos)
+    assert be == b1[:4]  # up to and including the EOS position
+    # Validation: beam-specific (NOT the slot engine's bucket rules).
+    with pytest.raises(ValueError):
+        engine.beam([cfg.vocab_size + 5], max_new_tokens=4)
+    with pytest.raises(ValueError):
+        engine.beam(tokens, max_new_tokens=60)  # 8 + 60 > max_len 64
+    with pytest.raises(ValueError):
+        engine.beam(tokens, max_new_tokens=4, beam_size=1000)
+    # The program cache is FIFO-bounded: distinct client-controlled
+    # configs must not grow it without limit.
+    from oim_tpu.serve.engine import _MAX_BEAM_PROGRAMS
+
+    for i in range(_MAX_BEAM_PROGRAMS + 3):
+        engine.beam(tokens, max_new_tokens=2, beam_size=1,
+                    alpha=0.5 + 0.01 * i)
+    assert len(engine._beam_fns) <= _MAX_BEAM_PROGRAMS
+
+
+def test_beam_ignores_slot_constraints(setup):
+    """A spec-decode engine reserves slot-cache headroom and buckets
+    prompts — neither applies to beam, which builds its own cache of
+    exactly prompt+max_new rows.  A request the SLOT path would reject
+    for headroom must still beam-serve (and match the plain engine's
+    beam output exactly)."""
+    cfg, params = setup
+    spec = Engine(params, cfg, n_slots=1, max_len=64, chunk=4,
+                  spec_decode=4, prompt_buckets=(16,))
+    tokens = _prompt(13, 20, cfg.vocab_size)  # > largest bucket (16)
+    out, score = spec.beam(tokens, max_new_tokens=40, beam_size=2)
+    plain = Engine(params, cfg, n_slots=1, max_len=64, chunk=4)
+    out2, score2 = plain.beam(tokens, max_new_tokens=40, beam_size=2)
+    assert out == out2 and score == score2
+
+
+def test_http_beam(setup):
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+    server = ServeServer(engine, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        tokens = _prompt(12, 6, cfg.vocab_size)
+        body = json.dumps(
+            {"tokens": tokens, "max_new_tokens": 6, "beam_size": 1}
+        ).encode()
+        req = urllib.request.Request(
+            f"{base}/v1/beam", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            payload = json.load(r)
+        assert payload["tokens"] == _oracle(params, cfg, tokens, 6)
+        assert isinstance(payload["score"], float)
+        bad = urllib.request.Request(
+            f"{base}/v1/beam", data=b'{"max_new_tokens": 3}',
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(bad, timeout=10)
+        assert err.value.code == 400
+    finally:
+        server.stop()
+
+
 def test_serve_main_builds_engine(setup):
     from oim_tpu.cli.serve_main import build_parser, make_engine
 
