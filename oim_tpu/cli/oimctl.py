@@ -68,6 +68,15 @@ def main(argv=None) -> int:
         "--stream", action="store_true",
         help="print tokens as they decode (NDJSON lines)",
     )
+    generate.add_argument(
+        "--beam", type=int, default=0, metavar="K",
+        help="beam-K search via /v1/beam (latency mode; excludes "
+             "--stream/--logprobs/--temperature)",
+    )
+    generate.add_argument(
+        "--eos-id", type=int, default=None,
+        help="EOS token id for --beam (trims the winning hypothesis)",
+    )
     trace = sub.add_parser(
         "trace", help="render cross-process traces from --trace-file JSONLs"
     )
@@ -82,18 +91,43 @@ def main(argv=None) -> int:
         import json as json_mod
         import urllib.request
 
-        body = json_mod.dumps({
+        def post_request(path: str, payload: dict):
+            return urllib.request.Request(
+                f"{args.serve.rstrip('/')}{path}",
+                data=json_mod.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+
+        if args.beam:
+            if args.stream or args.logprobs or args.temperature:
+                print("error: --beam excludes --stream/--logprobs/"
+                      "--temperature (beam is greedy latency mode)")
+                return 2
+            try:
+                with urllib.request.urlopen(
+                    post_request("/v1/beam", {
+                        "tokens": args.tokens,
+                        "max_new_tokens": args.max_new_tokens,
+                        "beam_size": args.beam,
+                        "eos_id": args.eos_id,
+                    }),
+                    timeout=600,
+                ) as resp:
+                    reply = json_mod.load(resp)
+                print("tokens:", " ".join(str(t) for t in reply["tokens"]))
+                print(f"score: {reply['score']:.4f}")
+            except urllib.error.URLError as exc:
+                print(f"error: {exc}")
+                return 1
+            return 0
+        request = post_request("/v1/generate", {
             "tokens": args.tokens,
             "max_new_tokens": args.max_new_tokens,
             "temperature": args.temperature,
             "seed": args.seed,
             "logprobs": args.logprobs,
             "stream": args.stream,
-        }).encode()
-        request = urllib.request.Request(
-            f"{args.serve.rstrip('/')}/v1/generate", data=body,
-            headers={"Content-Type": "application/json"},
-        )
+        })
         try:
             with urllib.request.urlopen(request, timeout=600) as response:
                 if args.stream:

@@ -95,10 +95,12 @@ from oim_tpu.ops.rope import apply_rope
 _NEG_BIG = -1e30
 
 # Engine.beam server-side policy: beam-k replicates the KV cache k-fold,
-# and each distinct (beam_size, alpha, eos_id) is a fresh XLA compile —
-# both client-controlled on a public endpoint, both bounded here.
+# each distinct (beam_size, alpha, eos_id) is a fresh XLA program, and
+# each distinct (prompt_len, max_new) is a fresh trace inside one — all
+# client-controlled on a public endpoint, all bounded here.
 _MAX_BEAM_SIZE = 32
 _MAX_BEAM_PROGRAMS = 8
+_MAX_BEAM_TRACES = 64
 
 
 def serve_param_shardings(params: dict, cfg: TransformerConfig, mesh):
@@ -813,8 +815,12 @@ class Engine:
         # rid → (tokens, logprobs), consumed by result_full/result.
         self._results: dict[int, tuple[list[int], list[float]]] = {}
         self._events: dict[int, threading.Event] = {}
-        # (beam_size, alpha, eos_id) → jitted beam program (Engine.beam).
+        # (beam_size, alpha, eos_id) → jitted beam program (Engine.beam);
+        # _beam_traces tracks every (config, prompt_len, max_new) trace
+        # for the total compile budget; one lock covers both.
         self._beam_fns: dict[tuple, object] = {}
+        self._beam_traces: set[tuple] = set()
+        self._beam_lock = threading.Lock()
         self._errors: dict[int, str] = {}
         self._callbacks: dict[int, object] = {}  # rid → on_token
         self._forgotten: set[int] = set()
@@ -965,10 +971,15 @@ class Engine:
         of exactly ``len(tokens) + max_new_tokens`` rows), but the
         engine's ``max_len`` still bounds the total as the server-side
         memory policy, ``beam_size`` is capped (the cache replicates
-        across the beam axis), and the jitted-program cache is FIFO-
-        bounded — all three are client-facing knobs on a public
-        endpoint.
+        across the beam axis), and compile growth is bounded two ways:
+        the program cache is FIFO-bounded over client-controlled
+        (beam_size, alpha, eos_id) configs, and each program's
+        per-(prompt_len, max_new) trace count is budgeted — when the
+        total crosses ``_MAX_BEAM_TRACES`` the cache is cleared, so a
+        client sweeping shapes costs recompiles, never unbounded memory.
         """
+        import math
+
         if not tokens:
             raise ValueError("prompt must be non-empty")
         if max_new_tokens < 1:
@@ -991,19 +1002,43 @@ class Engine:
                 f"beam_size must be in [1, {_MAX_BEAM_SIZE}], "
                 f"got {beam_size}"
             )
+        alpha = float(alpha)
+        if not math.isfinite(alpha):
+            # NaN would also poison the cache key (nan != nan -> every
+            # request becomes a fresh compile, defeating the DoS bound).
+            raise ValueError(f"alpha must be finite, got {alpha}")
         from oim_tpu.models.beam import make_beam_search_fn
 
-        key = (beam_size, float(alpha), eos_id)
-        fn = self._beam_fns.get(key)
-        if fn is None:
-            while len(self._beam_fns) >= _MAX_BEAM_PROGRAMS:
-                # FIFO eviction: the key is client-controlled, and an
-                # unbounded cache of jitted programs is a memory leak an
-                # adversarial client can drive one compile at a time.
-                self._beam_fns.pop(next(iter(self._beam_fns)))
-            fn = self._beam_fns[key] = make_beam_search_fn(
-                self.cfg, beam_size=beam_size, alpha=alpha, eos_id=eos_id
-            )
+        key = (beam_size, alpha, eos_id)
+        trace_key = (key, len(tokens), max_new_tokens)
+        with self._beam_lock:
+            # One lock for all cache bookkeeping (ThreadingHTTPServer
+            # calls beam() concurrently); the compile itself runs under
+            # the lock too — serializing concurrent first-compiles is
+            # the behavior a server wants anyway.
+            if len(self._beam_traces) >= _MAX_BEAM_TRACES:
+                # Shapes are client-controlled and each distinct
+                # (prompt_len, max_new) is a separate trace inside a
+                # cached program, invisible to the FIFO below — clear
+                # everything when the TOTAL trace budget is crossed.
+                self._beam_fns.clear()
+                self._beam_traces.clear()
+            fn = self._beam_fns.get(key)
+            if fn is None:
+                while len(self._beam_fns) >= _MAX_BEAM_PROGRAMS:
+                    # FIFO eviction: the key is client-controlled, and
+                    # an unbounded cache of jitted programs is a memory
+                    # leak an adversarial client can drive.
+                    evicted = next(iter(self._beam_fns))
+                    self._beam_fns.pop(evicted, None)
+                    self._beam_traces = {
+                        t for t in self._beam_traces if t[0] != evicted
+                    }
+                fn = self._beam_fns[key] = make_beam_search_fn(
+                    self.cfg, beam_size=beam_size, alpha=alpha,
+                    eos_id=eos_id,
+                )
+            self._beam_traces.add(trace_key)
         prompt = jnp.asarray([tokens], jnp.int32)
         out, stats = fn(self.params, prompt, max_new_tokens=max_new_tokens)
         generated = [int(t) for t in jax.device_get(out[0])[len(tokens):]]

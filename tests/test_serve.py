@@ -795,6 +795,28 @@ def test_engine_beam(setup):
     assert len(engine._beam_fns) <= _MAX_BEAM_PROGRAMS
 
 
+def test_beam_trace_budget(setup, monkeypatch):
+    """Client-controlled shapes are a compile channel: when the total
+    (config, prompt_len, max_new) trace count crosses the budget, the
+    cache clears instead of growing — shape sweeps cost recompiles,
+    never unbounded memory.  NaN alpha is rejected up front (it would
+    poison the cache key: nan != nan -> one compile per request)."""
+    import oim_tpu.serve.engine as engine_mod
+
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=4)
+    monkeypatch.setattr(engine_mod, "_MAX_BEAM_TRACES", 3)
+    for n in (3, 4, 5):
+        engine.beam(_prompt(20 + n, n, cfg.vocab_size), max_new_tokens=2,
+                    beam_size=1)
+    assert len(engine._beam_traces) == 3
+    engine.beam(_prompt(26, 6, cfg.vocab_size), max_new_tokens=2,
+                beam_size=1)
+    assert len(engine._beam_traces) == 1  # cleared, then this trace
+    with pytest.raises(ValueError):
+        engine.beam([1, 2], max_new_tokens=2, alpha=float("nan"))
+
+
 def test_beam_ignores_slot_constraints(setup):
     """A spec-decode engine reserves slot-cache headroom and buckets
     prompts — neither applies to beam, which builds its own cache of
@@ -836,6 +858,44 @@ def test_http_beam(setup):
         with pytest.raises(urllib.error.HTTPError) as err:
             urllib.request.urlopen(bad, timeout=10)
         assert err.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_oimctl_generate_client(setup, capsys):
+    """oimctl generate against a live serve server: plain greedy and
+    --beam both round-trip; --beam K=1 prints the greedy tokens."""
+    from oim_tpu.cli import oimctl
+
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+    server = ServeServer(engine, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        tokens = _prompt(14, 5, cfg.vocab_size)
+        want = _oracle(params, cfg, tokens, 5)
+        rc = oimctl.main([
+            "generate", *map(str, tokens),
+            "--serve", base, "--max-new-tokens", "5",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert f"tokens: {' '.join(map(str, want))}" in out
+
+        rc = oimctl.main([
+            "generate", *map(str, tokens),
+            "--serve", base, "--max-new-tokens", "5", "--beam", "1",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert f"tokens: {' '.join(map(str, want))}" in out
+        assert "score:" in out
+
+        # --beam excludes sampling/streaming flags: exit 2, no request.
+        rc = oimctl.main([
+            "generate", "1", "--serve", base, "--beam", "2", "--stream",
+        ])
+        assert rc == 2
     finally:
         server.stop()
 
