@@ -51,10 +51,14 @@ handful of jitted functions with donated cache buffers.
 Also here: per-token logprobs (``result_full`` / the streaming
 callback), an LRU prompt-KV **prefix cache** for system prompts
 (``prefix_cache_size`` + ``GenRequest.cache_prefix`` — injected rows
-are exact, dense and MoE alike), ``stop_ids``, a slot-free ``embed``
-surface, int8 KV (``kv_int8``) and weight-only int8 params (both
-preserve the exactness invariant), Prometheus instrumentation, and
-``warmup``/``abort``/``forget`` lifecycle discipline for daemon use.
+are exact, dense and MoE alike), ``stop_ids``, slot-free ``embed`` and
+latency-mode ``beam`` surfaces (beam-k runs as its own jitted program
+beside the slot engine; beam-1 == greedy exactly), in-engine
+speculative decoding (``spec_decode`` — prompt-lookup drafting,
+exactness preserved), int8 KV (``kv_int8``) and weight-only int8
+params (both preserve the exactness invariant), Prometheus
+instrumentation, and ``warmup``/``abort``/``forget`` lifecycle
+discipline for daemon use.
 """
 
 from __future__ import annotations
@@ -1047,6 +1051,10 @@ class Engine:
             # real generation (EOS itself included, matching GenRequest
             # eos semantics).
             generated = generated[: int(stats["length"])]
+        # Observability parity with the slot path: beam requests count in
+        # the same exposition, under their own outcome label.
+        self._m_requests.inc("beam")
+        self._m_tokens.inc(by=float(len(generated)))
         return generated, float(stats["normalized_score"])
 
     def result(self, rid: int, timeout: float | None = None) -> list[int]:
