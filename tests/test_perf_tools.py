@@ -1,0 +1,77 @@
+"""CPU smoke for the on-chip perf tools.
+
+tools/roofline.py and tools/decode_bench.py normally run on the real
+chip, which means a regression in them (an API drift, a bad import, a
+traced-config bug — all have happened) only surfaces during a scarce
+hardware window.  These smokes run their full code path on the CPU
+backend with tiny geometry so CI catches tool rot; the numbers they
+print are meaningless here and not asserted.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+
+def test_roofline_cpu_smoke(capsys):
+    import tools.roofline as roofline
+
+    assert roofline.main(["--json"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    payload = json.loads(out)
+    # Structure, not values: CPU timings are noise.
+    for key in (
+        "matmul_ceiling_tflops", "train_step_ms", "tok_per_s",
+        "analytic_flops_share_pct", "measured_component_ms",
+        "tunnel_rtt_ms",
+    ):
+        assert key in payload, key
+    assert payload["mfu_6n_pct"] is None  # off-TPU: no peak to divide by
+    shares = payload["analytic_flops_share_pct"]
+    assert set(shares) == {"attn_proj", "attn_scores", "mlp", "unembed"}
+    assert abs(sum(shares.values()) - 100.0) < 1.0
+
+
+def test_decode_bench_cpu_smoke(capsys):
+    import tools.decode_bench as db
+
+    # No --record: the smoke must never touch the real BENCH_HISTORY.
+    rc = db.main([
+        "--prompt", "8", "--new", "4", "--batch", "2", "--iters", "1",
+        "--vocab-size", "64", "--d-model", "16", "--n-layers", "1",
+        "--n-heads", "4", "--d-ff", "32", "--dtype", "float32",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # All six matrix cells either measured or below-noise-floor lines.
+    for label in ("MHA", "GQA-4", "GQA-2"):
+        assert label in out
+    assert "backend=cpu" in out
+
+
+def test_decode_bench_record_smoke(tmp_path, capsys):
+    """_record writes one tagged history line and never raises —
+    including when the append target is unwritable."""
+    import types
+
+    import tools.decode_bench as db
+
+    target = tmp_path / "BENCH_HISTORY.jsonl"
+    args = types.SimpleNamespace(prompt=8, new=4, batch=2)
+    db._record(args, 0.01, {"MHA_kv_float32": 123},
+               history_path=str(target))
+    entry = json.loads(target.read_text().strip())
+    assert entry["tool"] == "decode_bench"
+    assert entry["tok_per_s"] == {"MHA_kv_float32": 123}
+    assert "git_sha" in entry and "timestamp_utc" in entry
+
+    # Unwritable target: prints a warning, does not raise.
+    db._record(args, 0.01, {"MHA_kv_float32": 1},
+               history_path="/nonexistent-dir/x.jsonl")
+    assert "record failed" in capsys.readouterr().out
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -36,7 +36,7 @@ def measure_rtt(jnp):
     return statistics.median(rtts)
 
 
-def main() -> int:
+def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--prompt", type=int, default=1024)
     p.add_argument("--new", type=int, default=128)
@@ -59,7 +59,7 @@ def main() -> int:
              "(tool-tagged, git-SHA-stamped) so the BASELINE.md GQA row "
              "is machine-backed like the bench.py extras",
     )
-    args = p.parse_args()
+    args = p.parse_args(argv)
 
     import jax
     import jax.numpy as jnp
@@ -134,7 +134,8 @@ def main() -> int:
     return 0
 
 
-def _record(args, rtt: float, results: dict) -> None:
+def _record(args, rtt: float, results: dict,
+            history_path: str | None = None) -> None:
     """Append the matrix to BENCH_HISTORY.jsonl, tool-tagged and
     git-SHA-stamped.  Never raises: the measurements already printed,
     and a missing git binary or read-only checkout must not turn a
@@ -144,6 +145,8 @@ def _record(args, rtt: float, results: dict) -> None:
         import subprocess
 
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if history_path is None:
+            history_path = os.path.join(repo, "BENCH_HISTORY.jsonl")
         entry = {
             "tool": "decode_bench",
             "prompt": args.prompt, "new": args.new, "batch": args.batch,
@@ -157,7 +160,7 @@ def _record(args, rtt: float, results: dict) -> None:
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
             ),
         }
-        with open(os.path.join(repo, "BENCH_HISTORY.jsonl"), "a") as f:
+        with open(history_path, "a") as f:
             f.write(json.dumps(entry, sort_keys=True) + "\n")
         print(f"recorded -> BENCH_HISTORY.jsonl ({len(results)} cells)")
     except Exception as exc:

@@ -54,11 +54,11 @@ def _measure_step(cfg, batch, seq, n_iter, rtt_s) -> float:
     return bench.measure_train_step(cfg, params, batch, seq, n_iter, rtt_s)
 
 
-def main() -> int:
+def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--json", action="store_true")
     parser.add_argument("--iters", type=int, default=20)
-    args = parser.parse_args()
+    args = parser.parse_args(argv)
 
     import bench
 
