@@ -10,6 +10,7 @@ from oim_tpu.models.transformer import (
     TransformerConfig,
     init_params,
     logical_axes,
+    forward_hidden,
     forward_local,
     param_pspecs,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "TransformerConfig",
     "init_params",
     "logical_axes",
+    "forward_hidden",
     "forward_local",
     "param_pspecs",
     "TrainState",

@@ -28,6 +28,7 @@ from oim_tpu.models.transformer import (
     _rmsnorm,
     _stage_layer_params,
     _unembed,
+    forward_hidden,
     forward_local,
     make_stage_fn,
     manual_pspecs,
@@ -94,6 +95,21 @@ def _masked_ce_sum(logits, labels, valid):
     return jnp.sum(nll * valid), jnp.sum(valid.astype(jnp.float32))
 
 
+def _fused_ce_sum(hidden, wlm, labels, valid, cfg: TransformerConfig):
+    """``_masked_ce_sum`` over the fused unembed+CE kernel: takes the
+    final-norm hidden [b, t, D] instead of logits, so the [b, t, V]
+    logits never reach HBM in either pass (ops/fused_ce.py)."""
+    from oim_tpu.ops import fused_linear_ce
+
+    b, t, d = hidden.shape
+    nll = fused_linear_ce(
+        hidden.astype(cfg.compute_dtype).reshape(b * t, d),
+        wlm,
+        labels.reshape(b * t),
+    ).reshape(b, t)
+    return jnp.sum(nll * valid), jnp.sum(valid.astype(jnp.float32))
+
+
 def _global_metrics(obj, ce_sum, ce_count):
     """Forward-only psums turning ``_local_objective``'s per-device terms
     into the replicated (loss, ce) metrics.  Σ_mesh obj is the global
@@ -133,9 +149,15 @@ def _local_objective(params, tokens, cfg: TransformerConfig):
     last pipeline stage (the one whose logits are real) so the caller can
     reconstruct the ce metric with forward-only psums.
     """
-    logits, aux = forward_local(params, tokens, cfg)
     labels, valid, _ = _shifted_labels(tokens)
-    ce_sum, ce_count = _masked_ce_sum(logits, labels, valid)
+    if cfg.use_pallas and cfg.fused_ce:
+        hidden, aux = forward_hidden(params, tokens, cfg)
+        ce_sum, ce_count = _fused_ce_sum(
+            hidden, params["wlm"], labels, valid, cfg
+        )
+    else:
+        logits, aux = forward_local(params, tokens, cfg)
+        ce_sum, ce_count = _masked_ce_sum(logits, labels, valid)
     is_last_stage = (
         jax.lax.axis_index("pp") == jax.lax.axis_size("pp") - 1
     ).astype(jnp.float32)
@@ -319,10 +341,13 @@ def _build_value_and_grad(cfg: TransformerConfig, mesh):
 
         def loss_fn(hp, y, m):
             normed = _rmsnorm(y, hp["final_norm"], cfg)
-            logits = _unembed(normed, hp["wlm"], cfg)
             lbl = jax.lax.dynamic_index_in_dim(labels_m, m, 0, keepdims=False)
             val = jax.lax.dynamic_index_in_dim(valid_m, m, 0, keepdims=False)
-            ce_sum, _ = _masked_ce_sum(logits, lbl, val)
+            if cfg.use_pallas and cfg.fused_ce:
+                ce_sum, _ = _fused_ce_sum(normed, hp["wlm"], lbl, val, cfg)
+            else:
+                logits = _unembed(normed, hp["wlm"], cfg)
+                ce_sum, _ = _masked_ce_sum(logits, lbl, val)
             ce = ce_sum / c_global
             return ce, ce
 

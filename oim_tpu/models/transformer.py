@@ -78,6 +78,12 @@ class TransformerConfig:
     # manual (tp == ep == 1) and falls back to XLA-fused reference ops
     # otherwise.
     use_pallas: bool = True
+    # Fused unembed+CE (ops/fused_ce.py): the train loss streams vocab
+    # tiles through VMEM instead of materializing [b, t, V] logits in
+    # HBM.  Rides the use_pallas gate (off inside GSPMD-auto regions —
+    # under tp the vocab axis is sharded and the global logsumexp would
+    # need a cross-shard combine); decode/serving keep real logits.
+    fused_ce: bool = True
     # Sequence-parallel attention over sp>1: "ring" rotates K/V blocks via
     # ppermute (O(T/sp) memory, any head count); "ulysses" trades sequence
     # for head shards with one all_to_all each way (fewer collective hops,
@@ -444,6 +450,17 @@ def forward_local(
     value; keeping collectives out of it lets the train step differentiate
     a purely local objective (models/train.py ``_local_objective``).
     """
+    x, aux = forward_hidden(params, tokens, cfg)
+    return _unembed(x, params["wlm"], cfg), aux
+
+
+def forward_hidden(
+    params: dict, tokens: jax.Array, cfg: TransformerConfig
+) -> tuple[jax.Array, jax.Array]:
+    """``forward_local`` up to (and including) the final rmsnorm — the
+    [b, t, D] hidden the unembed consumes.  Split out so the fused
+    unembed+CE path (ops/fused_ce.py) can take the hidden directly and
+    never materialize the [b, t, V] logits; same shard_map contract."""
     sp_size = jax.lax.axis_size("sp")
     sp_index = jax.lax.axis_index("sp")
     pp_size = jax.lax.axis_size("pp")
@@ -478,8 +495,7 @@ def forward_local(
         x, aux = run_stage(stage_params, x)
 
     x = _rmsnorm(x, params["final_norm"], cfg)
-    logits = _unembed(x, params["wlm"], cfg)
-    return logits, aux
+    return x, aux
 
 
 def _unembed(x, wlm, cfg: TransformerConfig):

@@ -8,6 +8,7 @@ analog of the control plane's fake-device mode.
 
 from oim_tpu.ops.rmsnorm import rmsnorm, reference_rmsnorm
 from oim_tpu.ops.flash_attention import flash_attention, reference_attention
+from oim_tpu.ops.fused_ce import fused_linear_ce, reference_linear_ce
 from oim_tpu.ops.rope import apply_rope, rope_frequencies
 
 __all__ = [
@@ -15,6 +16,8 @@ __all__ = [
     "reference_rmsnorm",
     "flash_attention",
     "reference_attention",
+    "fused_linear_ce",
+    "reference_linear_ce",
     "apply_rope",
     "rope_frequencies",
 ]

@@ -1,0 +1,326 @@
+"""Fused unembed + cross-entropy (pallas): vocab-tiled, no logits in HBM.
+
+The softmax cross-entropy over a 32k vocabulary is the last big HBM
+consumer in the train step: the plain path materializes f32 logits
+[B·T, V] (0.5-1 GB at the flagship geometry), reads them for the
+logsumexp + target gather, and the backward writes a same-sized dlogits
+before the two unembed matmuls (BASELINE.md roofline: unembed + CE is
+~19 % of executed FLOPs but its ablation swings 6-18 ms of a ~101 ms
+step — the gap between those two numbers is this HBM traffic).
+
+This op never builds the logits tensor.  Forward streams ``wlm`` through
+VMEM in ``block_v`` tiles (the innermost, sequential grid dimension) and
+keeps the online-logsumexp running max/denominator and the target-logit
+accumulator in VMEM scratch across tiles — the same structure as the
+flash-attention forward (ops/flash_attention.py), with the vocab axis
+playing the role of the key axis.  Per token it emits only the
+logsumexp and the target logit: ``nll = lse - target``.
+
+The backward recomputes score tiles from (x, wlm, lse) — probability
+``p = exp(s - lse)`` needs no saved logits — and fuses the two unembed
+gradients into two kernels mirroring flash's dq/dkv split:
+
+- dx kernel, grid (rows, vocab):  dx  += (p - onehot)·g @ wlmᵀ
+- dw kernel, grid (vocab, rows):  dwᵀ += xᵀ @ (p - onehot)·g
+
+Each accumulates in an f32 VMEM scratch over its sequential inner axis
+and writes its output block once.  All matmuls ride the MXU with
+compute-dtype operands and f32 accumulation (the `_unembed` convention,
+models/transformer.py).  Off-TPU the kernels run interpreted; shapes
+the tiling cannot cover fall back to an XLA reference path (same
+discipline as flash's ragged fallback).
+
+Like every pallas op here, this must run inside fully-manual shard_map
+regions only (models/train.py ``_manual_setup`` gates it with
+``use_pallas``); under tp the vocab axis is sharded and the global
+logsumexp would need a cross-shard combine the XLA path gets for free.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -1e30
+_LANES = 128
+# Per-row outputs (lse, target) ride [rows, 8] tiles: 8 lanes is the
+# narrowest width the mosaic tiling rules allow while keeping rows on
+# sublanes (see flash_attention._ROW_LANES).
+_ROW_LANES = 8
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def reference_linear_ce(x, w, labels):
+    """XLA oracle/fallback: per-token NLL via materialized logits.
+
+    Same numerics contract as the kernel: compute-dtype operands, f32
+    accumulation (``preferred_element_type``), f32 log-softmax.
+    """
+    logits = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    target = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return lse - target
+
+
+def _block_n(n: int, want: int):
+    """Largest power-of-two row block ≤ want that divides n (≥ 8)."""
+    b = want
+    while b >= 8:
+        if b <= n and n % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+def _block_v(v: int, want: int):
+    """Largest lane-aligned (multiple-of-128) block ≤ want dividing v."""
+    best = None
+    b = _LANES
+    while b <= min(v, want):
+        if v % b == 0:
+            best = b
+        b += _LANES
+    return best
+
+
+def _fwd_kernel(x_ref, w_ref, lbl_ref, lse_ref, tgt_ref, m_scr, l_scr, t_scr,
+                *, block_v):
+    vi = pl.program_id(1)
+    n_v = pl.num_programs(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        t_scr[...] = jnp.zeros_like(t_scr)
+
+    # Compute-dtype operands on the MXU, f32 accumulator (the _unembed
+    # convention) — the cast-to-f32-first alternative would halve MXU rate.
+    scores = jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_prev, l_prev = m_scr[...], l_scr[...]
+    m_curr = jnp.max(scores, axis=1, keepdims=True)      # [bn, 1]
+    m_next = jnp.maximum(m_prev, m_curr)                 # [bn, 128]
+    alpha = jnp.exp(m_prev - m_next)
+    p = jnp.exp(scores - m_next[:, :1])
+    l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    m_scr[...] = m_next
+    # Target logit: exactly one vocab tile holds each row's label; a
+    # masked row-sum accumulates it without a gather (no dynamic indexing
+    # on the lane axis).
+    col = vi * block_v + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    match = col == lbl_ref[...][:, :1]
+    t_scr[...] += jnp.sum(
+        jnp.where(match, scores, 0.0), axis=1, keepdims=True
+    )
+
+    @pl.when(vi == n_v - 1)
+    def _finalize():
+        lse = m_scr[...] + jnp.log(jnp.maximum(l_scr[...], 1e-30))
+        lse_ref[...] = lse[:, :_ROW_LANES]
+        tgt_ref[...] = t_scr[...][:, :_ROW_LANES]
+
+
+def _dlogits_block(x_ref, w_ref, lbl_ref, lse_ref, g_ref, vi, block_v):
+    """Recomputed dlogits tile ``(p - onehot) · g`` in compute dtype —
+    THE one definition both backward kernels share, so the dx and dw
+    numerics can never diverge."""
+    scores = jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    p = jnp.exp(scores - lse_ref[...][:, :1])            # recomputed probs
+    col = vi * block_v + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    onehot = (col == lbl_ref[...][:, :1]).astype(jnp.float32)
+    return ((p - onehot) * g_ref[...][:, :1]).astype(x_ref.dtype)
+
+
+def _dx_kernel(x_ref, w_ref, lbl_ref, lse_ref, g_ref, dx_ref, dx_scr,
+               *, block_v):
+    vi = pl.program_id(1)
+    n_v = pl.num_programs(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        dx_scr[...] = jnp.zeros_like(dx_scr)
+
+    d = _dlogits_block(x_ref, w_ref, lbl_ref, lse_ref, g_ref, vi, block_v)
+    dx_scr[...] += jax.lax.dot_general(                  # d @ w.T
+        d, w_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(vi == n_v - 1)
+    def _finalize():
+        dx_ref[...] = dx_scr[...].astype(dx_ref.dtype)
+
+
+def _dw_kernel(x_ref, w_ref, lbl_ref, lse_ref, g_ref, dw_ref, dw_scr,
+               *, block_v):
+    # Grid (vocab, rows): rows are the sequential inner axis so each
+    # dw output block accumulates across every row block, then writes once.
+    vi, ni = pl.program_id(0), pl.program_id(1)
+    n_n = pl.num_programs(1)
+
+    @pl.when(ni == 0)
+    def _init():
+        dw_scr[...] = jnp.zeros_like(dw_scr)
+
+    d = _dlogits_block(x_ref, w_ref, lbl_ref, lse_ref, g_ref, vi, block_v)
+    dw_scr[...] += jax.lax.dot_general(                  # x.T @ d
+        x_ref[...], d, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ni == n_n - 1)
+    def _finalize():
+        dw_ref[...] = dw_scr[...].astype(dw_ref.dtype)
+
+
+def _row_tile(a):
+    """[N] per-row value → [N, _ROW_LANES] lane-replicated tile."""
+    return jnp.broadcast_to(a[:, None], (a.shape[0], _ROW_LANES))
+
+
+def _resolve(n, v, block_n, block_v):
+    """(block_n, block_v), auto-tuned where 0 — or None for the XLA
+    fallback.  Explicitly passed blocks are validated loudly: a block
+    that doesn't tile the array would silently skip rows/columns."""
+    for b, size, axis in ((block_n, n, "n"), (block_v, v, "v")):
+        if b and (size % b or (axis == "v" and b % _LANES) or (
+            axis == "n" and b < 8
+        )):
+            raise ValueError(
+                f"block_{axis}={b} cannot tile {axis}={size} "
+                f"(must divide it{'; multiple of 128' if axis == 'v' else '; >= 8'})"
+            )
+    bn = block_n or _block_n(n, 256)
+    bv = block_v or _block_v(v, 1280)
+    if bn is None or bv is None:
+        return None
+    return bn, bv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_ce(x, w, labels, block_n: int = 0, block_v: int = 0):
+    """Per-token NLL of ``softmax(x @ w)`` at ``labels`` — [N] f32.
+
+    x: [N, D] compute dtype; w: [D, V] (cast to x.dtype for the MXU);
+    labels: [N] int32 in [0, V).  Gradients flow to x and w; the logits
+    [N, V] never exist in HBM in either pass.  Zero block sizes
+    auto-tune; shapes the tiling cannot cover (row count without a ≥8
+    power-of-two divisor, vocab without a lane-aligned divisor) fall
+    back to the XLA reference path.
+    """
+    nll, _ = _fwd(x, w, labels, block_n, block_v)
+    return nll
+
+
+def _forward(x, w, labels, block_n, block_v):
+    n, d = x.shape
+    v = w.shape[1]
+    blocks = _resolve(n, v, block_n, block_v)
+    if blocks is None:
+        return reference_linear_ce(x, w.astype(x.dtype), labels), None
+    bn, bv = blocks
+    lbl = _row_tile(labels.astype(jnp.int32))
+    lse, tgt = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_v=bv),
+        out_shape=[
+            jax.ShapeDtypeStruct((n, _ROW_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((n, _ROW_LANES), jnp.float32),
+        ],
+        grid=(n // bn, v // bv),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda ni, vi: (ni, 0)),
+            pl.BlockSpec((d, bv), lambda ni, vi: (0, vi)),
+            pl.BlockSpec((bn, _ROW_LANES), lambda ni, vi: (ni, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, _ROW_LANES), lambda ni, vi: (ni, 0)),
+            pl.BlockSpec((bn, _ROW_LANES), lambda ni, vi: (ni, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bn, _LANES), jnp.float32),
+            pltpu.VMEM((bn, _LANES), jnp.float32),
+            pltpu.VMEM((bn, _LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, w.astype(x.dtype), lbl)
+    return lse[:, 0] - tgt[:, 0], lse
+
+
+def _fwd(x, w, labels, block_n, block_v):
+    nll, lse = _forward(x, w, labels, block_n, block_v)
+    return nll, (x, w, labels, lse)
+
+
+def _bwd(block_n, block_v, residuals, g):
+    x, w, labels, lse = residuals
+    if lse is None:  # ragged forward fell back to the reference path
+        _, vjp = jax.vjp(
+            lambda x_, w_: reference_linear_ce(
+                x_, w_.astype(x_.dtype), labels
+            ),
+            x, w,
+        )
+        dx, dw = vjp(g)
+        return dx, dw, np.zeros(labels.shape, jax.dtypes.float0)
+    n, d = x.shape
+    v = w.shape[1]
+    bn, bv = _resolve(n, v, block_n, block_v)
+    # The dw tile + its f32 scratch both live in VMEM; halve the vocab
+    # block (still a valid divisor: every block is a multiple-of-128
+    # divisor chain) when the default would crowd the ~16 MB budget.
+    bv_dw = bv if d * bv * 8 <= 8 * 2**20 else (_block_v(v, bv // 2) or bv)
+    wc = w.astype(x.dtype)
+    lbl = _row_tile(labels.astype(jnp.int32))
+    g_rows = _row_tile(g.astype(jnp.float32))
+    lse8 = lse  # residual is already the [n, _ROW_LANES] lane-replicated tile
+
+    dx = pl.pallas_call(
+        functools.partial(_dx_kernel, block_v=bv),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        grid=(n // bn, v // bv),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda ni, vi: (ni, 0)),
+            pl.BlockSpec((d, bv), lambda ni, vi: (0, vi)),
+            pl.BlockSpec((bn, _ROW_LANES), lambda ni, vi: (ni, 0)),
+            pl.BlockSpec((bn, _ROW_LANES), lambda ni, vi: (ni, 0)),
+            pl.BlockSpec((bn, _ROW_LANES), lambda ni, vi: (ni, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, d), lambda ni, vi: (ni, 0)),
+        scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
+        interpret=_interpret(),
+    )(x, wc, lbl, lse8, g_rows)
+
+    dw = pl.pallas_call(
+        functools.partial(_dw_kernel, block_v=bv_dw),
+        out_shape=jax.ShapeDtypeStruct((d, v), w.dtype),
+        grid=(v // bv_dw, n // bn),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda vi, ni: (ni, 0)),
+            pl.BlockSpec((d, bv_dw), lambda vi, ni: (0, vi)),
+            pl.BlockSpec((bn, _ROW_LANES), lambda vi, ni: (ni, 0)),
+            pl.BlockSpec((bn, _ROW_LANES), lambda vi, ni: (ni, 0)),
+            pl.BlockSpec((bn, _ROW_LANES), lambda vi, ni: (ni, 0)),
+        ],
+        out_specs=pl.BlockSpec((d, bv_dw), lambda vi, ni: (0, vi)),
+        scratch_shapes=[pltpu.VMEM((d, bv_dw), jnp.float32)],
+        interpret=_interpret(),
+    )(x, wc, lbl, lse8, g_rows)
+
+    return dx, dw, np.zeros(labels.shape, jax.dtypes.float0)
+
+
+fused_linear_ce.defvjp(_fwd, _bwd)

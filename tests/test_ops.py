@@ -272,3 +272,119 @@ class TestInt8Quant:
 
         q, _ = quantize_int8(jnp.asarray([[1000.0, -1000.0, 0.5]]))
         assert int(q[0, 0]) == 127 and int(q[0, 1]) == -127
+
+
+class TestFusedLinearCE:
+    """Vocab-tiled fused unembed+CE vs the materialized-logits oracle."""
+
+    def _data(self, n=64, d=128, v=384, dtype=jnp.bfloat16, seed=0):
+        from oim_tpu.ops import reference_linear_ce  # noqa: F401 (re-export)
+
+        x = jax.random.normal(jax.random.PRNGKey(seed), (n, d), dtype)
+        w = (
+            jax.random.normal(jax.random.PRNGKey(seed + 1), (d, v)) * 0.05
+        ).astype(jnp.float32)
+        labels = jax.random.randint(jax.random.PRNGKey(seed + 2), (n,), 0, v)
+        return x, w, labels
+
+    @pytest.mark.parametrize("n,v", [(64, 384), (32, 128), (256, 640)])
+    def test_forward_matches_oracle(self, n, v):
+        from oim_tpu.ops import fused_linear_ce, reference_linear_ce
+
+        x, w, labels = self._data(n=n, v=v)
+        nll = fused_linear_ce(x, w, labels)
+        ref = reference_linear_ce(x, w.astype(x.dtype), labels)
+        assert nll.dtype == jnp.float32
+        np.testing.assert_allclose(
+            np.asarray(nll), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_gradients_match_oracle(self):
+        from oim_tpu.ops import fused_linear_ce, reference_linear_ce
+
+        x, w, labels = self._data()
+        # Non-uniform per-token weights: the real loss masks invalid
+        # positions, so the vjp must honor a per-row cotangent.
+        rows = jax.random.uniform(jax.random.PRNGKey(9), (x.shape[0],))
+
+        def loss(fn, x_, w_):
+            return jnp.sum(fn(x_, w_, labels) * rows)
+
+        dx, dw = jax.grad(
+            lambda x_, w_: loss(fused_linear_ce, x_, w_), argnums=(0, 1)
+        )(x, w)
+        dxr, dwr = jax.grad(
+            lambda x_, w_: loss(
+                lambda a, b, l: reference_linear_ce(a, b.astype(a.dtype), l),
+                x_,
+                w_,
+            ),
+            argnums=(0, 1),
+        )(x, w)
+        assert dx.dtype == x.dtype and dw.dtype == w.dtype
+        # dx/dw ride bf16 MXU operands inside the kernel; the oracle's
+        # dlogits stay f32 — tolerance covers that rounding, nothing else.
+        np.testing.assert_allclose(
+            np.asarray(dx, np.float32),
+            np.asarray(dxr, np.float32),
+            atol=2e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(dw), np.asarray(dwr), atol=2e-3, rtol=1e-2
+        )
+
+    def test_ragged_falls_back(self):
+        """Shapes the tiling can't cover (vocab not a multiple of 128,
+        odd row counts) must still be exact via the XLA fallback."""
+        from oim_tpu.ops import fused_linear_ce, reference_linear_ce
+
+        x, w, labels = self._data(n=33, v=100)
+        nll = fused_linear_ce(x, w, labels)
+        ref = reference_linear_ce(x, w.astype(x.dtype), labels)
+        np.testing.assert_allclose(
+            np.asarray(nll), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+        dx, dw = jax.grad(
+            lambda x_, w_: jnp.sum(fused_linear_ce(x_, w_, labels)),
+            argnums=(0, 1),
+        )(x, w)
+        assert dx.shape == x.shape and dw.shape == w.shape
+
+    def test_label_on_tile_boundary(self):
+        """Labels at vocab-tile edges (0, block_v-1, block_v, V-1) hit the
+        masked-sum target accumulation exactly once each."""
+        from oim_tpu.ops import fused_linear_ce, reference_linear_ce
+
+        x, w, _ = self._data(n=8, v=384)
+        labels = jnp.asarray([0, 127, 128, 255, 256, 383, 1, 382])
+        nll = fused_linear_ce(x, w, labels, 8, 128)
+        ref = reference_linear_ce(x, w.astype(x.dtype), labels)
+        np.testing.assert_allclose(
+            np.asarray(nll), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_online_lse_extreme_scores(self):
+        """Large-magnitude logits: the online max/denominator must stay
+        finite where a naive sum-of-exp would overflow."""
+        from oim_tpu.ops import fused_linear_ce, reference_linear_ce
+
+        x, w, labels = self._data(n=16, v=256)
+        w = w * 400.0  # logits in the hundreds
+        nll = fused_linear_ce(x, w, labels, 16, 128)
+        ref = reference_linear_ce(x, w.astype(x.dtype), labels)
+        assert bool(jnp.all(jnp.isfinite(nll)))
+        np.testing.assert_allclose(
+            np.asarray(nll), np.asarray(ref), rtol=1e-4, atol=1e-3
+        )
+
+    def test_explicit_bad_blocks_rejected(self):
+        """Explicit block sizes that cannot tile the array must raise —
+        a silent grid truncation would skip rows/vocab columns."""
+        from oim_tpu.ops import fused_linear_ce
+
+        x, w, labels = self._data(n=33, v=384)
+        with pytest.raises(ValueError, match="block_n"):
+            fused_linear_ce(x, w, labels, 8, 128)  # 33 % 8 != 0
+        x, w, labels = self._data(n=32, v=384)
+        with pytest.raises(ValueError, match="block_v"):
+            fused_linear_ce(x, w, labels, 8, 100)  # not lane-aligned
