@@ -662,6 +662,30 @@ def _train_diagnostics(extras, on_tpu, cfg, batch, seq, params) -> None:
         )
 
         if on_tpu:
+            # Fused unembed+CE ablation: the same geometry with
+            # cfg.fused_ce off re-materializes the [B*T, 32k] logits in
+            # HBM both ways (ops/fused_ce.py) — recording both keeps the
+            # kernel's win a machine-written number, not prose.
+            from dataclasses import replace as dc_replace
+
+            try:
+                dt_u = measure_train_step(
+                    dc_replace(cfg, fused_ce=False), params, batch, seq,
+                    20, rtt_s,
+                )
+                extras["train_step_ms_unfused_ce"] = round(dt_u * 1000, 2)
+                extras["fused_ce_speedup"] = round(dt_u / dt, 3)
+                log(
+                    f"bench: unfused-CE control {dt_u*1000:.1f} ms "
+                    f"(fused-CE step speedup {dt_u/dt:.2f}x)"
+                )
+            except Exception as exc:
+                # The control intentionally re-materializes ~1 GB of
+                # logits; its failure must not cost the long-context
+                # rows below (the _flash_diagnostics discipline).
+                extras["train_step_ms_unfused_ce"] = "failed"
+                log(f"bench: unfused-CE control failed: {exc}")
+
             # Long-context: same model, batch 1 x 8192 — the flash
             # kernel's training case (the unfused path's O(T^2) scores
             # would dominate here).
