@@ -40,6 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--d-ff", type=int, default=0)
     p.add_argument("--n-experts", type=int, default=0)
     p.add_argument("--moe-top-k", type=int, default=1)
+    p.add_argument("--rope-theta", type=float, default=10000.0)
+    p.add_argument(
+        "--norm-eps", type=float, default=1e-6,
+        help="RMSNorm epsilon (imported HF Llama checkpoints use 1e-5)",
+    )
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument(
         "--checkpoint-dir", default="",
@@ -129,6 +134,8 @@ def make_engine(args):
         d_ff=args.d_ff or 4 * args.d_model,
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
+        rope_theta=args.rope_theta,
+        norm_eps=args.norm_eps,
         dtype=args.dtype,
     )
     if args.checkpoint_dir and args.params_dir:

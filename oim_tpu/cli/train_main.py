@@ -51,6 +51,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--d-ff", type=int, default=0)
     p.add_argument("--n-experts", type=int, default=0)
     p.add_argument("--moe-top-k", type=int, default=1)
+    p.add_argument("--rope-theta", type=float, default=10000.0)
+    p.add_argument(
+        "--norm-eps", type=float, default=1e-6,
+        help="RMSNorm epsilon (imported HF Llama checkpoints use 1e-5)",
+    )
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--attn-impl", default="ring", choices=["ring", "ulysses"])
     p.add_argument("--pp-schedule", default="gpipe", choices=["gpipe", "1f1b"])
@@ -225,6 +230,8 @@ def main(argv=None) -> int:
         d_ff=args.d_ff,
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
+        rope_theta=args.rope_theta,
+        norm_eps=args.norm_eps,
         n_stages=args.pp,
         n_microbatches=max(args.n_microbatches, 1),
         grad_accum=args.grad_accum,

@@ -1,0 +1,193 @@
+"""HF Llama-family checkpoint import: external weights, native layout.
+
+The flagship transformer is architecture-compatible with the Llama
+family (RMSNorm, RoPE, SwiGLU, GQA, untied or tied unembed), so a user
+can bring real open weights instead of training from scratch — the
+interchange surface the reference left to its storage backends
+(volumes carry whatever bytes the workload expects) becomes, for a
+compute framework, checkpoint compatibility with the de-facto public
+format (new work; SURVEY.md §2.3).
+
+Two deliberate conversion points, both proven by the parity tests
+(tests/test_hf_import.py runs ``transformers``' reference
+implementation on CPU and matches logits):
+
+- **Layout.** HF ``nn.Linear`` stores [out, in]; this framework stores
+  [in, out] (right-multiplication einsums) — every projection
+  transposes.  Per-layer tensors stack into the pipeline layout
+  [n_stages, layers_per_stage, ...].
+- **RoPE convention.** HF rotates (x[i], x[i + hd/2]) pairs
+  (rotate_half); ops/rope.py rotates interleaved (x[2i], x[2i+1])
+  pairs with the same frequency set.  The two are a fixed permutation
+  of head-dim coordinates, folded into the q/k projection COLUMNS at
+  import time (``_rope_perm``) — zero runtime cost, and v/o are
+  untouched because the permutation is internal to the q·k rotation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from oim_tpu.models.transformer import TransformerConfig
+
+def llama_config(hf_config, **overrides) -> TransformerConfig:
+    """TransformerConfig mirroring an HF ``LlamaConfig``-shaped object
+    (attribute access; a plain dict also works).  ``overrides`` pass
+    through to the dataclass (e.g. ``dtype=\"float32\"`` for parity
+    tests, ``n_stages`` for pipeline serving)."""
+    get = (
+        hf_config.get if isinstance(hf_config, dict)
+        else lambda k, d=None: getattr(hf_config, k, d)
+    )
+    if (get("hidden_act", "silu") or "silu") not in ("silu", "swish"):
+        raise ValueError(
+            f"unsupported hidden_act {get('hidden_act')!r} (SwiGLU only)"
+        )
+    if get("attention_bias", False) or get("mlp_bias", False):
+        raise ValueError("projection biases are not supported")
+    if get("sliding_window", None):
+        raise ValueError("sliding-window attention is not supported")
+    scaling = get("rope_scaling", None)
+    if scaling:
+        # Llama-3.1+ frequency scaling changes the rotation numerics;
+        # importing without applying it would serve silently-wrong
+        # logits — reject until ops/rope.py grows scaled frequencies.
+        raise ValueError(
+            f"rope_scaling {scaling!r} is not supported (plain RoPE only)"
+        )
+    d = int(get("hidden_size"))
+    h = int(get("num_attention_heads"))
+    explicit_hd = get("head_dim", None)
+    if explicit_hd and int(explicit_hd) != d // h:
+        raise ValueError(
+            f"head_dim {explicit_hd} != hidden_size/heads {d // h}"
+        )
+    kwargs = dict(
+        vocab_size=int(get("vocab_size")),
+        d_model=d,
+        n_layers=int(get("num_hidden_layers")),
+        n_heads=h,
+        n_kv_heads=int(get("num_key_value_heads", h) or h),
+        d_ff=int(get("intermediate_size")),
+        rope_theta=float(get("rope_theta", 10000.0) or 10000.0),
+        norm_eps=float(get("rms_norm_eps", 1e-6) or 1e-6),
+    )
+    kwargs.update(overrides)
+    return TransformerConfig(**kwargs)
+
+
+def _to_np(t) -> np.ndarray:
+    """Array-like → float32 numpy (torch tensors included, without
+    importing torch)."""
+    if hasattr(t, "detach"):  # torch.Tensor
+        t = t.detach().to("cpu").float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _rope_perm(head_dim: int) -> np.ndarray:
+    """Column permutation turning rotate_half coordinates into the
+    interleaved pairs ops/rope.py rotates: out[2i] = hf[i],
+    out[2i+1] = hf[i + hd/2]."""
+    half = head_dim // 2
+    perm = np.empty(head_dim, dtype=np.int64)
+    perm[0::2] = np.arange(half)
+    perm[1::2] = np.arange(half) + half
+    return perm
+
+
+def _proj(weight, heads: int, head_dim: int, permute: bool) -> np.ndarray:
+    """HF [heads·hd, d] projection → native [d, heads·hd], with the RoPE
+    coordinate permutation applied per head when ``permute``."""
+    w = _to_np(weight).T  # [d, heads*hd]
+    if not permute:
+        return w
+    d = w.shape[0]
+    w = w.reshape(d, heads, head_dim)[:, :, _rope_perm(head_dim)]
+    return w.reshape(d, heads * head_dim)
+
+
+def from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
+    """Native params pytree from an HF Llama ``state_dict``.
+
+    ``state_dict`` maps HF parameter names to array-likes (torch tensors
+    straight from ``model.state_dict()``, numpy arrays, or anything
+    ``np.asarray`` accepts).  Tied embeddings (no ``lm_head.weight``)
+    reuse the token embedding transposed.  Raises KeyError naming the
+    first missing tensor and ValueError on shape mismatches.
+    """
+    if cfg.n_experts:
+        raise ValueError("MoE import is not supported (dense Llama only)")
+    sd = dict(state_dict)
+    bias = [k for k in sd if k.endswith(".bias")]
+    if bias:
+        raise ValueError(f"projection biases are not supported: {bias[:3]}")
+
+    def take(name):
+        if name not in sd:
+            raise KeyError(f"HF checkpoint is missing {name!r}")
+        return sd[name]
+
+    h, kvh, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    per_layer = {
+        "attn_norm": [], "wq": [], "wk": [], "wv": [], "wo": [],
+        "mlp_norm": [], "w_gate": [], "w_in": [], "w_out": [],
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        per_layer["attn_norm"].append(_to_np(take(p + "input_layernorm.weight")))
+        per_layer["wq"].append(
+            _proj(take(p + "self_attn.q_proj.weight"), h, hd, True)
+        )
+        per_layer["wk"].append(
+            _proj(take(p + "self_attn.k_proj.weight"), kvh, hd, True)
+        )
+        per_layer["wv"].append(
+            _proj(take(p + "self_attn.v_proj.weight"), kvh, hd, False)
+        )
+        per_layer["wo"].append(_to_np(take(p + "self_attn.o_proj.weight")).T)
+        per_layer["mlp_norm"].append(
+            _to_np(take(p + "post_attention_layernorm.weight"))
+        )
+        per_layer["w_gate"].append(_to_np(take(p + "mlp.gate_proj.weight")).T)
+        per_layer["w_in"].append(_to_np(take(p + "mlp.up_proj.weight")).T)
+        per_layer["w_out"].append(_to_np(take(p + "mlp.down_proj.weight")).T)
+
+    wte = _to_np(take("model.embed_tokens.weight"))
+    wlm = (
+        _to_np(sd["lm_head.weight"]).T
+        if "lm_head.weight" in sd
+        else wte.T.copy()  # tied embeddings
+    )
+
+    import jax.numpy as jnp
+
+    pdt = jnp.dtype(cfg.param_dtype)
+    s, l = cfg.n_stages, cfg.layers_per_stage
+
+    def stack(name):
+        arr = np.stack(per_layer[name])  # [L, ...]
+        return jnp.asarray(
+            arr.reshape(s, l, *arr.shape[1:]), dtype=pdt
+        )
+
+    params = {name: stack(name) for name in per_layer}
+    params["wte"] = jnp.asarray(wte, dtype=pdt)
+    params["final_norm"] = jnp.asarray(
+        _to_np(take("model.norm.weight")), dtype=pdt
+    )
+    params["wlm"] = jnp.asarray(wlm, dtype=pdt)
+
+    expect = {
+        "wte": (cfg.vocab_size, cfg.d_model),
+        "wq": (s, l, cfg.d_model, h * hd),
+        "wk": (s, l, cfg.d_model, kvh * hd),
+        "wlm": (cfg.d_model, cfg.vocab_size),
+        "w_gate": (s, l, cfg.d_model, cfg.ff_dim),
+    }
+    for name, shape in expect.items():
+        if params[name].shape != shape:
+            raise ValueError(
+                f"{name}: checkpoint shape {params[name].shape} != "
+                f"config shape {shape} — config/checkpoint mismatch"
+            )
+    return params

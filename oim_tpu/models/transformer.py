@@ -61,6 +61,10 @@ class TransformerConfig:
     moe_top_k: int = 1
     expert_capacity_factor: float = 1.25
     rope_theta: float = 10000.0
+    # RMSNorm epsilon — configurable so imported checkpoints (HF Llama
+    # uses 1e-5) reproduce their source numerics exactly
+    # (models/hf.py); 1e-6 is this framework's native default.
+    norm_eps: float = 1e-6
     n_stages: int = 1  # pipeline stages; must divide n_layers
     n_microbatches: int = 1
     # Gradient accumulation: the per-device batch is split into this many
@@ -257,8 +261,8 @@ def manual_pspecs(cfg: TransformerConfig) -> dict:
 
 def _rmsnorm(x, w, cfg: TransformerConfig):
     if cfg.use_pallas:
-        return rmsnorm(x, w)
-    return reference_rmsnorm(x, w, 1e-6)
+        return rmsnorm(x, w, cfg.norm_eps)
+    return reference_rmsnorm(x, w, cfg.norm_eps)
 
 
 def _attention(x, lp, positions, cfg: TransformerConfig, sp_size):

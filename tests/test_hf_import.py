@@ -1,0 +1,213 @@
+"""HF Llama import parity: converted weights reproduce ``transformers``'
+reference logits.
+
+The strongest correctness oracle the model family has: an EXTERNAL
+implementation (HF's CPU LlamaForCausalLM) run on the same weights.  A
+layout transpose, RoPE-convention, GQA-grouping, or norm-eps mistake in
+either the importer (oim_tpu/models/hf.py) or the native forward shows
+up as a logit divergence here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from oim_tpu.models.hf import from_hf_llama, llama_config  # noqa: E402
+from oim_tpu.models.transformer import (  # noqa: E402
+    forward_local,
+    manual_pspecs,
+)
+from oim_tpu.parallel import build_mesh  # noqa: E402
+
+
+def _tiny_hf(vocab=128, d=64, layers=2, heads=4, kv_heads=4, ff=112,
+             tied=False, eps=1e-5, theta=10000.0, seed=0):
+    torch.manual_seed(seed)
+    config = transformers.LlamaConfig(
+        vocab_size=vocab,
+        hidden_size=d,
+        num_hidden_layers=layers,
+        num_attention_heads=heads,
+        num_key_value_heads=kv_heads,
+        intermediate_size=ff,
+        rms_norm_eps=eps,
+        rope_theta=theta,
+        tie_word_embeddings=tied,
+        attention_bias=False,
+        mlp_bias=False,
+    )
+    model = transformers.LlamaForCausalLM(config)
+    model.eval()
+    return model, config
+
+
+def _native_logits(params, tokens, cfg):
+    mesh = build_mesh(devices=jax.devices()[:1])
+    logits, _ = jax.jit(
+        jax.shard_map(
+            lambda p, t: forward_local(p, t, cfg),
+            mesh=mesh,
+            in_specs=(manual_pspecs(cfg), P("dp", "sp")),
+            out_specs=(P("dp", "sp"), P()),
+            check_vma=False,
+        )
+    )(params, jnp.asarray(tokens))
+    return np.asarray(logits, np.float32)
+
+
+def _parity(model, config, atol=2e-4):
+    cfg = llama_config(config, dtype="float32", use_pallas=False)
+    params = from_hf_llama(model.state_dict(), cfg)
+    tokens = np.arange(2 * 16).reshape(2, 16) % config.vocab_size
+    with torch.no_grad():
+        want = (
+            model(torch.as_tensor(tokens)).logits.float().numpy()
+        )
+    got = _native_logits(params, tokens, cfg)
+    np.testing.assert_allclose(got, want, atol=atol, rtol=1e-4)
+
+
+class TestLlamaImportParity:
+    def test_mha_untied(self):
+        self_model, config = _tiny_hf()
+        _parity(self_model, config)
+
+    def test_gqa(self):
+        """Grouped-query attention: 4 query heads over 2 kv heads — the
+        kv projection transpose and group broadcast must line up."""
+        model, config = _tiny_hf(kv_heads=2, seed=1)
+        _parity(model, config)
+
+    def test_tied_embeddings(self):
+        model, config = _tiny_hf(tied=True, seed=2)
+        _parity(model, config)
+
+    def test_nondefault_rope_and_eps(self):
+        """rope_theta and rms_norm_eps must flow from the HF config into
+        the native forward, not be silently defaulted."""
+        model, config = _tiny_hf(theta=50000.0, eps=1e-4, seed=3)
+        _parity(model, config)
+
+
+class TestLlamaImportValidation:
+    def test_config_mapping(self):
+        _, config = _tiny_hf(kv_heads=2)
+        cfg = llama_config(config)
+        assert cfg.vocab_size == 128 and cfg.d_model == 64
+        assert cfg.n_heads == 4 and cfg.kv_heads == 2
+        assert cfg.ff_dim == 112 and cfg.norm_eps == 1e-5
+
+    def test_missing_tensor_named(self):
+        model, config = _tiny_hf()
+        cfg = llama_config(config, dtype="float32")
+        sd = model.state_dict()
+        sd.pop("model.layers.1.mlp.up_proj.weight")
+        with pytest.raises(KeyError, match="up_proj"):
+            from_hf_llama(sd, cfg)
+
+    def test_shape_mismatch_rejected(self):
+        model, config = _tiny_hf()
+        cfg = llama_config(config, dtype="float32")
+        wrong = llama_config(config, dtype="float32", vocab_size=256)
+        with pytest.raises((ValueError, KeyError)):
+            from_hf_llama(model.state_dict(), wrong)
+
+    def test_bias_rejected(self):
+        model, config = _tiny_hf()
+        cfg = llama_config(config, dtype="float32")
+        sd = dict(model.state_dict())
+        sd["model.layers.0.self_attn.q_proj.bias"] = np.zeros(64)
+        with pytest.raises(ValueError, match="bias"):
+            from_hf_llama(sd, cfg)
+
+    def test_unsupported_act_rejected(self):
+        _, config = _tiny_hf()
+        config.hidden_act = "gelu"
+        with pytest.raises(ValueError, match="hidden_act"):
+            llama_config(config)
+
+
+class TestImportEndToEnd:
+    def test_cli_import_then_greedy_generation_matches_hf(self, tmp_path):
+        """Full bridge: save_pretrained → oim-import-hf CLI → load_params
+        → native greedy decode == transformers' greedy generate."""
+        from oim_tpu.checkpoint import load_params
+        from oim_tpu.cli.import_hf_main import main as import_main
+        from oim_tpu.models import init_params
+        from oim_tpu.models.decode import generate
+        from oim_tpu.models.hf import llama_config
+
+        model, config = _tiny_hf(seed=4)
+        hf_dir, out_dir = tmp_path / "hf", tmp_path / "native"
+        model.save_pretrained(hf_dir)
+
+        rc = import_main(
+            ["--hf-dir", str(hf_dir), "--out-dir", str(out_dir),
+             "--param-dtype", "float32"]
+        )
+        assert rc == 0
+
+        cfg = llama_config(config, dtype="float32", use_pallas=False)
+        template = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), cfg)
+        )
+        params = load_params(str(out_dir), template)
+
+        prompt = np.arange(2 * 8).reshape(2, 8) % config.vocab_size
+        got = np.asarray(
+            generate(params, jnp.asarray(prompt), cfg, max_new_tokens=12)
+        )
+        with torch.no_grad():
+            want = model.generate(
+                torch.as_tensor(prompt),
+                max_new_tokens=12,
+                do_sample=False,
+                pad_token_id=0,
+            ).numpy()
+        # Token-for-token agreement, except near-tie argmax flips: on a
+        # tiny random model HF's cached generate and HF's own full
+        # forward disagree at sub-1e-3 logit margins, so a strict match
+        # is noise-flaky.  At the first divergence, teacher-force the HF
+        # model on OUR prefix and require the two candidates' logits to
+        # be within that margin — proving our token was an argmax of
+        # logits indistinguishable from HF's own.
+        for row in range(got.shape[0]):
+            diff = np.nonzero(got[row] != want[row])[0]
+            if diff.size == 0:
+                continue
+            pos = int(diff[0])
+            with torch.no_grad():
+                lg = model(
+                    torch.as_tensor(got[row:row + 1, :pos].astype(np.int64))
+                ).logits[0, -1].float().numpy()
+            ours, theirs = int(got[row, pos]), int(want[row, pos])
+            margin = abs(lg[ours] - lg[theirs])
+            assert margin < 1e-3, (
+                f"row {row} pos {pos}: ours={ours} hf={theirs} "
+                f"logit margin {margin:.4f} — real divergence, not a tie"
+            )
+
+    def test_cli_refuses_overwrite(self, tmp_path):
+        from oim_tpu.cli.import_hf_main import main as import_main
+
+        (tmp_path / "exists").mkdir()
+        rc = import_main(
+            ["--hf-dir", str(tmp_path), "--out-dir",
+             str(tmp_path / "exists")]
+        )
+        assert rc == 1
+
+    def test_rope_scaling_rejected(self):
+        """Llama-3.1-style rope_scaling changes rotation numerics; the
+        importer must reject it rather than silently misconvert."""
+        from oim_tpu.models.hf import llama_config
+
+        _, config = _tiny_hf()
+        config.rope_scaling = {"rope_type": "llama3", "factor": 8.0}
+        with pytest.raises(ValueError, match="rope_scaling"):
+            llama_config(config)
