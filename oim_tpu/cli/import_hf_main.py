@@ -83,6 +83,10 @@ def main(argv=None) -> int:
         f"--n-layers {cfg.n_layers} --n-heads {cfg.n_heads} "
         f"--n-kv-heads {cfg.n_kv_heads} --d-ff {cfg.d_ff} "
         f"--rope-theta {cfg.rope_theta} --norm-eps {cfg.norm_eps}"
+        + (
+            " --rope-scaling " + " ".join(str(v) for v in cfg.rope_scaling)
+            if cfg.rope_scaling else ""
+        )
     )
     print(f"imported {args.hf_dir} -> {out_dir}")
     print(

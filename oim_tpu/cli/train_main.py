@@ -53,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-top-k", type=int, default=1)
     p.add_argument("--rope-theta", type=float, default=10000.0)
     p.add_argument(
+        "--rope-scaling", type=float, nargs=4, default=[],
+        metavar=("FACTOR", "LOW", "HIGH", "ORIG_MAX"),
+        help="Llama-3.1 RoPE frequency remap (factor low_freq_factor "
+        "high_freq_factor original_max_position); omit for plain RoPE",
+    )
+    p.add_argument(
         "--norm-eps", type=float, default=1e-6,
         help="RMSNorm epsilon (imported HF Llama checkpoints use 1e-5)",
     )
@@ -231,6 +237,7 @@ def main(argv=None) -> int:
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
         rope_theta=args.rope_theta,
+        rope_scaling=tuple(args.rope_scaling),
         norm_eps=args.norm_eps,
         n_stages=args.pp,
         n_microbatches=max(args.n_microbatches, 1),

@@ -151,8 +151,8 @@ def _cached_attention(
     k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, kvh, hd)
     v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, kvh, hd)
     positions = start + jnp.arange(t)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
     k_cache, k_scale = _store_kv(k_cache, k_scale, k, start)
     v_cache, v_scale = _store_kv(v_cache, v_scale, v, start)

@@ -48,13 +48,27 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
     if get("sliding_window", None):
         raise ValueError("sliding-window attention is not supported")
     scaling = get("rope_scaling", None)
+    rope_scaling = ()
     if scaling:
-        # Llama-3.1+ frequency scaling changes the rotation numerics;
-        # importing without applying it would serve silently-wrong
-        # logits — reject until ops/rope.py grows scaled frequencies.
-        raise ValueError(
-            f"rope_scaling {scaling!r} is not supported (plain RoPE only)"
-        )
+        # Llama-3.1 frequency remap maps onto ops/rope.py's piecewise
+        # rule; other rope_types (linear, dynamic, yarn) have different
+        # numerics and are rejected rather than silently misconverted.
+        kind = scaling.get("rope_type", scaling.get("type", ""))
+        if kind != "llama3":
+            raise ValueError(
+                f"unsupported rope_scaling type {kind!r} (llama3 only)"
+            )
+        try:
+            rope_scaling = (
+                float(scaling["factor"]),
+                float(scaling["low_freq_factor"]),
+                float(scaling["high_freq_factor"]),
+                float(scaling["original_max_position_embeddings"]),
+            )
+        except KeyError as exc:
+            raise ValueError(
+                f"llama3 rope_scaling is missing {exc.args[0]!r}: {scaling!r}"
+            ) from exc
     d = int(get("hidden_size"))
     h = int(get("num_attention_heads"))
     explicit_hd = get("head_dim", None)
@@ -70,6 +84,7 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
         n_kv_heads=int(get("num_key_value_heads", h) or h),
         d_ff=int(get("intermediate_size")),
         rope_theta=float(get("rope_theta", 10000.0) or 10000.0),
+        rope_scaling=rope_scaling,
         norm_eps=float(get("rms_norm_eps", 1e-6) or 1e-6),
     )
     kwargs.update(overrides)

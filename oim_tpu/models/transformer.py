@@ -61,6 +61,11 @@ class TransformerConfig:
     moe_top_k: int = 1
     expert_capacity_factor: float = 1.25
     rope_theta: float = 10000.0
+    # Llama-3.1 long-context RoPE frequency remap as (factor,
+    # low_freq_factor, high_freq_factor, original_max_position) — empty
+    # = plain RoPE.  A tuple (not a dict) so the config stays hashable
+    # for jit static args; ops/rope.py applies the piecewise rule.
+    rope_scaling: tuple = ()
     # RMSNorm epsilon — configurable so imported checkpoints (HF Llama
     # uses 1e-5) reproduce their source numerics exactly
     # (models/hf.py); 1e-6 is this framework's native default.
@@ -118,6 +123,23 @@ class TransformerConfig:
             )
         if self.grad_accum < 1:
             raise ValueError(f"grad_accum={self.grad_accum} must be >= 1")
+        if self.rope_scaling:
+            if len(self.rope_scaling) != 4:
+                raise ValueError(
+                    "rope_scaling must be empty or (factor, low_freq_factor, "
+                    f"high_freq_factor, original_max_position); "
+                    f"got {self.rope_scaling!r}"
+                )
+            factor, low, high, orig = self.rope_scaling
+            # Degenerate values produce inf frequencies / divide-by-zero
+            # smoothing — NaN logits with no error, the silent-wrong-
+            # numerics failure this validation exists to prevent.
+            if factor <= 0 or low <= 0 or orig <= 0 or low >= high:
+                raise ValueError(
+                    "rope_scaling needs factor>0, 0<low_freq_factor"
+                    f"<high_freq_factor, original_max>0; got "
+                    f"{self.rope_scaling!r}"
+                )
         if self.moe_top_k < 1 or (
             self.n_experts and self.moe_top_k > self.n_experts
         ):
@@ -272,8 +294,8 @@ def _attention(x, lp, positions, cfg: TransformerConfig, sp_size):
     q = jnp.einsum("btd,dn->btn", normed, lp["wq"]).reshape(b, t, h, hd)
     k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, kvh, hd)
     v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, kvh, hd)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     if sp_size > 1:
         if cfg.attn_impl == "ulysses":
             # Ulysses trades sequence shards for HEAD shards via

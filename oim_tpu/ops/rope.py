@@ -3,16 +3,45 @@ surrounding projections; a kernel would buy nothing)."""
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 
 
-def rope_frequencies(head_dim: int, theta: float = 10000.0) -> jnp.ndarray:
-    """Inverse frequencies for the rotated half-pairs: [head_dim // 2]."""
+def rope_frequencies(
+    head_dim: int, theta: float = 10000.0, scaling: tuple = ()
+) -> jnp.ndarray:
+    """Inverse frequencies for the rotated half-pairs: [head_dim // 2].
+
+    ``scaling`` is the Llama-3.1 long-context frequency remap as a
+    4-tuple ``(factor, low_freq_factor, high_freq_factor,
+    original_max_position)`` (empty = plain RoPE): wavelengths shorter
+    than ``original/high`` keep their frequency, longer than
+    ``original/low`` divide by ``factor``, and the band between
+    interpolates smoothly — the exact piecewise rule HF's reference
+    applies, so imported checkpoints reproduce their source numerics
+    (models/hf.py).
+    """
     exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
-    return 1.0 / (theta**exponent)
+    inv_freq = 1.0 / (theta**exponent)
+    if not scaling:
+        return inv_freq
+    factor, low_fac, high_fac, original_max = scaling
+    low_wavelen = original_max / low_fac
+    high_wavelen = original_max / high_fac
+    wavelen = 2.0 * math.pi / inv_freq
+    # smooth in [0, 1]: 0 at the long-wavelength edge, 1 at the short.
+    smooth = (original_max / wavelen - low_fac) / (high_fac - low_fac)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    blended = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    return jnp.where(
+        wavelen < high_wavelen,
+        inv_freq,
+        jnp.where(wavelen > low_wavelen, inv_freq / factor, blended),
+    )
 
 
-def apply_rope(x, positions, theta: float = 10000.0):
+def apply_rope(x, positions, theta: float = 10000.0, scaling: tuple = ()):
     """Rotate [..., T, H, D] by per-token ``positions`` [..., T].
 
     Positions are *global* sequence positions — under sequence parallelism
@@ -20,7 +49,7 @@ def apply_rope(x, positions, theta: float = 10000.0):
     exact across shard boundaries.
     """
     d = x.shape[-1]
-    freqs = rope_frequencies(d, theta)  # [D/2]
+    freqs = rope_frequencies(d, theta, scaling)  # [D/2]
     angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., T, D/2]
     cos = jnp.cos(angles)[..., :, None, :]  # [..., T, 1, D/2]
     sin = jnp.sin(angles)[..., :, None, :]

@@ -245,8 +245,8 @@ def _slot_attention(
     k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, kvh, hd)
     v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, kvh, hd)
     positions = starts[:, None] + jnp.arange(t)  # [B, t] global positions
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
     k_cache, k_scale = _slot_store(k_cache, k_scale, k, starts)
     v_cache, v_scale = _slot_store(v_cache, v_scale, v, starts)

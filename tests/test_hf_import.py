@@ -202,12 +202,62 @@ class TestImportEndToEnd:
         )
         assert rc == 1
 
-    def test_rope_scaling_rejected(self):
-        """Llama-3.1-style rope_scaling changes rotation numerics; the
-        importer must reject it rather than silently misconvert."""
+    def test_non_llama3_rope_scaling_rejected(self):
+        """linear/dynamic/yarn scaling have different numerics; the
+        importer must reject them rather than silently misconvert."""
+        from oim_tpu.models.hf import llama_config
+
+        _, config = _tiny_hf()
+        config.rope_scaling = {"rope_type": "yarn", "factor": 8.0}
+        with pytest.raises(ValueError, match="rope_scaling"):
+            llama_config(config)
+
+
+class TestRopeScalingParity:
+    def test_llama3_scaling_matches_hf(self):
+        """Llama-3.1 frequency remap: logits must match transformers'
+        reference with all three piecewise branches exercised (original
+        max 32 over head_dim-16 wavelengths spans keep / interpolate /
+        divide)."""
+        torch.manual_seed(11)
+        config = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=4,
+            intermediate_size=112, rms_norm_eps=1e-5,
+            rope_scaling={
+                "rope_type": "llama3", "factor": 8.0,
+                "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                "original_max_position_embeddings": 32,
+            },
+        )
+        model = transformers.LlamaForCausalLM(config)
+        model.eval()
+        _parity(model, config)
+
+    def test_scaling_config_mapping(self):
+        from oim_tpu.models.hf import llama_config
+
+        _, config = _tiny_hf()
+        config.rope_scaling = {
+            "rope_type": "llama3", "factor": 8.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192,
+        }
+        cfg = llama_config(config)
+        assert cfg.rope_scaling == (8.0, 1.0, 4.0, 8192.0)
+
+    def test_incomplete_llama3_scaling_rejected(self):
         from oim_tpu.models.hf import llama_config
 
         _, config = _tiny_hf()
         config.rope_scaling = {"rope_type": "llama3", "factor": 8.0}
         with pytest.raises(ValueError, match="rope_scaling"):
             llama_config(config)
+
+    def test_degenerate_scaling_values_rejected(self):
+        from oim_tpu.models import TransformerConfig
+
+        with pytest.raises(ValueError, match="factor"):
+            TransformerConfig(rope_scaling=(0.0, 1.0, 4.0, 8192.0))
+        with pytest.raises(ValueError, match="factor"):
+            TransformerConfig(rope_scaling=(8.0, 4.0, 4.0, 8192.0))
