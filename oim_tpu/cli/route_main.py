@@ -1,0 +1,98 @@
+"""oim-route: the serving router binary.
+
+Load-balances the oim-serve HTTP API over N backends, discovered
+statically (repeatable ``--backend``) and/or dynamically from the
+registry's ``serve/<id>/address`` keys (written by oim-serve
+``--serve-id`` self-registration).  See serve/router.py for the
+balancing/health/retry semantics.
+
+Usage (static, CPU smoke):
+    oim-route --backend http://127.0.0.1:8000 \\
+              --backend http://127.0.0.1:8001 --port 9000
+Usage (registry-discovered, mTLS):
+    oim-route --registry-address tcp://registry:8370 \\
+              --ca ca.crt --cert user.admin.crt --key user.admin.key
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from oim_tpu import log
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="oim-route", description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9000, help="0 = ephemeral")
+    p.add_argument(
+        "--backend", action="append", default=[],
+        help="static backend url (repeatable)",
+    )
+    p.add_argument(
+        "--registry-address", default="",
+        help="discover backends from serve/<id>/address registry keys",
+    )
+    p.add_argument("--ca", help="CA cert file (enables registry mTLS)")
+    p.add_argument("--cert", help="cert (e.g. CN user.admin)")
+    p.add_argument("--key", help="key")
+    p.add_argument("--health-interval", type=float, default=2.0)
+    p.add_argument("--discover-interval", type=float, default=5.0)
+    p.add_argument(
+        "--unhealthy-after", type=int, default=2,
+        help="consecutive failures before a backend is taken out",
+    )
+    p.add_argument(
+        "--request-timeout", type=float, default=600.0,
+        help="per-request backend timeout (matches oim-serve's result "
+        "timeout)",
+    )
+    p.add_argument("--log-level", default="info")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    log.init_from_string(args.log_level)
+
+    from oim_tpu.serve.router import Router
+
+    tls = None
+    if args.ca:
+        from oim_tpu.common.tlsconfig import load_tls
+
+        tls = load_tls(args.ca, args.cert, args.key)
+    try:
+        router = Router(
+            backends=tuple(args.backend),
+            registry_address=args.registry_address,
+            tls=tls,
+            host=args.host,
+            port=args.port,
+            health_interval=args.health_interval,
+            discover_interval=args.discover_interval,
+            unhealthy_after=args.unhealthy_after,
+            request_timeout=args.request_timeout,
+        ).start()
+    except ValueError as exc:
+        raise SystemExit(str(exc))
+    log.current().info(
+        "oim-route listening",
+        host=router.host,
+        port=router.port,
+        static_backends=len(args.backend),
+        registry=args.registry_address or "(none)",
+    )
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -120,6 +120,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="skip pre-compiling admit buckets + decode (first live "
         "requests then pay the 20-40s TPU compiles)",
     )
+    # Self-registration: announce serve/<id>/address to the registry so
+    # oim-route discovers this instance (serve/registration.py).
+    p.add_argument(
+        "--serve-id", default="",
+        help="register as serve/<id>/address in the registry (requires "
+        "--registry-address; cert CN serve.<id> under mTLS)",
+    )
+    p.add_argument("--registry-address", default="")
+    p.add_argument(
+        "--advertise", default="",
+        help="address to register (default http://<host>:<port>)",
+    )
+    p.add_argument(
+        "--registry-delay", type=float, default=60.0,
+        help="seconds between re-registrations",
+    )
+    p.add_argument("--ca", help="CA cert file (enables registry mTLS)")
+    p.add_argument("--cert", help="cert (CN serve.<id>)")
+    p.add_argument("--key", help="key")
     p.add_argument("--log-level", default="info")
     return p
 
@@ -213,6 +232,27 @@ def make_engine(args):
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     log.init_from_string(args.log_level)
+    # Registration misconfiguration must surface BEFORE the engine pays
+    # its multi-minute compiles: validate flags + id shape at parse time.
+    registration = None
+    if args.serve_id:
+        if not args.registry_address:
+            raise SystemExit("--serve-id requires --registry-address")
+        if not args.advertise and args.host in ("0.0.0.0", "::", ""):
+            raise SystemExit(
+                f"--host {args.host} binds a wildcard address; pass "
+                "--advertise with the routable URL to register"
+            )
+        from oim_tpu.common.tlsconfig import load_tls
+        from oim_tpu.serve.registration import ServeRegistration
+
+        registration = ServeRegistration(
+            args.serve_id,
+            args.registry_address,
+            args.advertise,  # filled in once the port is known
+            tls=load_tls(args.ca, args.cert, args.key) if args.ca else None,
+            delay=args.registry_delay,
+        )
     from oim_tpu.common import tracing
 
     tracing.init("oim-serve", args.trace_file or None)
@@ -235,6 +275,11 @@ def main(argv=None) -> int:
         "oim-serve listening", host=server.host, port=server.port,
         n_slots=args.n_slots, max_len=args.max_len,
     )
+    if registration is not None:
+        registration.advertised_address = (
+            args.advertise or f"http://{server.host}:{server.port}"
+        )
+        registration.start()
     try:
         import threading
 
@@ -242,6 +287,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if registration is not None:
+            registration.stop()
         server.stop()
     return 0
 

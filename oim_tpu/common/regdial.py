@@ -1,0 +1,35 @@
+"""Registry channel construction — THE one definition of how clients
+dial the registry (fresh per-operation channel, CN pinned to
+``component.registry`` under mTLS), shared by the controller heartbeat,
+the serve-instance heartbeat, and router discovery so their dialing can
+never diverge (≙ the per-operation connection discipline of
+/root/reference/pkg/oim-controller/controller.go:448-453)."""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+REGISTRY_CN = "component.registry"
+
+
+@contextmanager
+def registry_channel(registry_address: str, tls=None):
+    """Yield a fresh gRPC channel to the registry; closes on exit."""
+    import grpc
+
+    from oim_tpu.common import endpoint as ep
+
+    target = ep.parse(registry_address).grpc_target()
+    if tls is not None:
+        pinned = tls.with_peer(REGISTRY_CN)
+        channel = grpc.secure_channel(
+            target,
+            pinned.channel_credentials(),
+            options=pinned.channel_options(),
+        )
+    else:
+        channel = grpc.insecure_channel(target)
+    try:
+        yield channel
+    finally:
+        channel.close()

@@ -33,7 +33,6 @@ from oim_tpu.common import metrics, tracing
 from oim_tpu.common.interceptors import LogServerInterceptor, PeerCheckInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.tlsconfig import TLSConfig
-from oim_tpu.common import endpoint as ep
 from oim_tpu.controller.keymutex import KeyMutex
 from oim_tpu.spec import CONTROLLER, REGISTRY, oim_pb2
 
@@ -456,15 +455,9 @@ class Controller:
     def register(self) -> None:
         """One registration: fresh dial → SetValue → close (per-operation
         connections survive registry restarts, ≙ controller.go:448-468)."""
-        target = ep.parse(self.registry_address).grpc_target()
-        if self.tls is not None:
-            tls = self.tls.with_peer(REGISTRY_CN)
-            channel = grpc.secure_channel(
-                target, tls.channel_credentials(), options=tls.channel_options()
-            )
-        else:
-            channel = grpc.insecure_channel(target)
-        try:
+        from oim_tpu.common.regdial import registry_channel
+
+        with registry_channel(self.registry_address, self.tls) as channel:
             REGISTRY.stub(channel).SetValue(
                 oim_pb2.SetValueRequest(
                     value=oim_pb2.Value(
@@ -474,11 +467,9 @@ class Controller:
                 ),
                 timeout=10,
             )
-            log.current().debug(
-                "registered", id=self.controller_id, address=self._advertised_address
-            )
-        finally:
-            channel.close()
+        log.current().debug(
+            "registered", id=self.controller_id, address=self._advertised_address
+        )
 
     def close(self) -> None:
         self._stop.set()

@@ -31,6 +31,7 @@ from oim_tpu.spec import REGISTRY, oim_pb2
 ADMIN_CN = "user.admin"
 CONTROLLER_CN_PREFIX = "controller."
 HOST_CN_PREFIX = "host."
+SERVE_CN_PREFIX = "serve."
 
 _ident = lambda b: b
 
@@ -105,6 +106,17 @@ class Registry:
             context.abort(
                 grpc.StatusCode.PERMISSION_DENIED,
                 f"{cn!r} may only set {controller_id}/address",
+            )
+        if cn.startswith(SERVE_CN_PREFIX):
+            # A serving instance may publish only its own discovery key
+            # (serve/<id>/address) — the controller least-privilege
+            # shape, applied to the inference data plane (serve/router.py).
+            serve_id = cn[len(SERVE_CN_PREFIX):]
+            if path == f"serve/{serve_id}/address":
+                return
+            context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                f"{cn!r} may only set serve/{serve_id}/address",
             )
         if cn.startswith(HOST_CN_PREFIX):
             # A node agent may publish only its own multi-host rendezvous

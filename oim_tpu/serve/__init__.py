@@ -7,5 +7,7 @@ path (``models/decode.py``) into a multi-request server.
 """
 
 from oim_tpu.serve.engine import Engine, GenRequest, SlotCache
+from oim_tpu.serve.registration import ServeRegistration
+from oim_tpu.serve.router import Router
 
-__all__ = ["Engine", "GenRequest", "SlotCache"]
+__all__ = ["Engine", "GenRequest", "Router", "ServeRegistration", "SlotCache"]

@@ -1,0 +1,90 @@
+"""Serving self-registration: oim-serve announces itself to the registry.
+
+The controller heartbeat pattern
+(/root/reference/pkg/oim-controller/controller.go:425-468) applied to
+the serving plane: a background thread re-``SetValue``s
+``serve/<id>/address`` every ``delay`` seconds over a fresh
+per-operation connection, so the key survives registry DB loss and the
+instance survives registry restarts.  The router (serve/router.py)
+discovers these keys by prefix query.
+
+The CN contract is ``serve.<id>`` (registry authz allows exactly the
+instance's own key — registry/registry.py ``SERVE_CN_PREFIX``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from oim_tpu import log
+
+
+class ServeRegistration:
+    """Background ``serve/<id>/address`` heartbeat.  ``start()`` returns
+    self after the FIRST registration attempt (so a misconfigured
+    address fails fast in the caller's face, not silently in a
+    thread); subsequent re-registrations never raise."""
+
+    def __init__(
+        self,
+        serve_id: str,
+        registry_address: str,
+        advertised_address: str,
+        tls=None,
+        delay: float = 60.0,
+    ):
+        if not serve_id or "/" in serve_id:
+            raise ValueError(f"invalid serve id {serve_id!r}")
+        self.serve_id = serve_id
+        self.registry_address = registry_address
+        self.advertised_address = advertised_address
+        self.tls = tls
+        self.delay = delay
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def register(self) -> None:
+        """One registration: fresh dial → SetValue → close."""
+        from oim_tpu.common.regdial import registry_channel
+        from oim_tpu.spec import REGISTRY, oim_pb2
+
+        with registry_channel(self.registry_address, self.tls) as channel:
+            REGISTRY.stub(channel).SetValue(
+                oim_pb2.SetValueRequest(
+                    value=oim_pb2.Value(
+                        path=f"serve/{self.serve_id}/address",
+                        value=self.advertised_address,
+                    )
+                ),
+                timeout=10,
+            )
+        log.current().debug(
+            "serve registered",
+            id=self.serve_id,
+            address=self.advertised_address,
+        )
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.delay):
+            try:
+                self.register()
+            except Exception as exc:
+                # Never let the heartbeat die: transient failures must
+                # not permanently de-register the instance.
+                log.current().warning(
+                    "serve registration failed",
+                    registry=self.registry_address,
+                    error=str(exc),
+                )
+
+    def start(self) -> "ServeRegistration":
+        self.register()  # fail fast on misconfiguration
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

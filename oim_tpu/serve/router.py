@@ -1,0 +1,429 @@
+"""Serving router: registry-discovered load balancing over oim-serve.
+
+The reference's central routing idea — clients address components by ID
+through the registry, never by network address
+(/root/reference/pkg/oim-registry/registry.go:162-189) — applied to the
+inference data plane: N ``oim-serve`` backends self-register
+``serve/<id>/address`` keys (the controller heartbeat pattern,
+/root/reference/pkg/oim-controller/controller.go:425-443), and this
+router discovers them by prefix query, health-checks them, and
+least-active balances the HTTP serving API across them.
+
+Scope: the router is a *dispatcher*, not a batch merger — each request
+runs wholly on one backend (continuous batching happens inside the
+backend engine).  That keeps the router stateless and restartable, the
+same property the reference's transparent proxy has.
+
+Behavior:
+- Balancing: least active in-flight requests among healthy backends
+  (ties broken round-robin).
+- Health: GET /healthz per backend on an interval; a backend is out
+  after ``unhealthy_after`` consecutive failures and back on the first
+  success.  A request-level connection failure counts too, so a dead
+  backend stops receiving traffic immediately, not at the next probe.
+- Retry: a request that fails at the CONNECTION level before any
+  response byte is retried once on a different backend; once a backend
+  has begun answering, errors pass through (the request may have side
+  effects — generation is not idempotent under sampling seeds... it is
+  by seed, but the single-retry bound keeps tail latency sane anyway).
+- Streaming: NDJSON bodies are piped through chunk-by-chunk unchanged.
+
+Endpoints: the serving API (POST /v1/generate, /v1/beam, /v1/embed)
+proxied; GET /healthz (ok while ≥1 backend is healthy), /v1/stats
+(router counters + per-backend state), /metrics (Prometheus).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from oim_tpu import log
+from oim_tpu.common import metrics
+
+PROXIED = ("/v1/generate", "/v1/beam", "/v1/embed")
+
+
+@dataclass
+class Backend:
+    """One oim-serve instance as the router sees it."""
+
+    id: str
+    url: str  # http://host:port, no trailing slash
+    from_registry: bool = False
+    healthy: bool = True
+    active: int = 0
+    completed: int = 0
+    fails: int = 0  # consecutive health/connection failures
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+class Router:
+    """Owns the backend table, the health/discovery loops, and the HTTP
+    listener.  ``start()`` returns self; ``port`` is the bound port
+    (0 → ephemeral, the ``NonBlockingGRPCServer.addr()`` pattern)."""
+
+    def __init__(
+        self,
+        backends: tuple[str, ...] = (),
+        registry_address: str = "",
+        tls=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        health_interval: float = 2.0,
+        discover_interval: float = 5.0,
+        unhealthy_after: int = 2,
+        request_timeout: float = 600.0,
+    ):
+        if not backends and not registry_address:
+            raise ValueError(
+                "router needs static --backend urls or a registry address"
+            )
+        self._lock = threading.Lock()
+        self._backends: dict[str, Backend] = {
+            url.rstrip("/"): Backend(id=url.rstrip("/"), url=url.rstrip("/"))
+            for url in backends
+        }
+        self.registry_address = registry_address
+        self._tls = tls
+        self.health_interval = health_interval
+        self.discover_interval = discover_interval
+        self.unhealthy_after = unhealthy_after
+        self.request_timeout = request_timeout
+        self._stop = threading.Event()
+        self._rr = 0
+        self._requests = metrics.registry().counter(
+            "oim_route_requests_total",
+            "Requests proxied by the serving router",
+            labels=("backend", "outcome"),
+        )
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    metrics.write_exposition(self)
+                elif path == "/healthz":
+                    n = len(outer.healthy_backends())
+                    self._json(
+                        200 if n else 503,
+                        {"ok": bool(n), "healthy_backends": n},
+                    )
+                elif path == "/v1/stats":
+                    self._json(200, outer.stats())
+                else:
+                    self._json(404, {"error": f"no such path {path}"})
+
+            def do_POST(self):
+                if self.path not in PROXIED:
+                    self._json(404, {"error": f"no such path {self.path}"})
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length)
+                headers = {
+                    "Content-Type": "application/json",
+                }
+                # Propagate the caller's trace context through the hop,
+                # like every other component boundary here.
+                if self.headers.get("traceparent"):
+                    headers["traceparent"] = self.headers["traceparent"]
+                outer._proxy(self, self.path, body, headers)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True
+        )
+        self._discover_thread = (
+            threading.Thread(target=self._discover_loop, daemon=True)
+            if registry_address
+            else None
+        )
+
+    # -- backend table -----------------------------------------------------
+
+    def healthy_backends(self) -> list[Backend]:
+        with self._lock:
+            return [b for b in self._backends.values() if b.healthy]
+
+    def _pick(self, exclude: set[str] = frozenset()) -> Backend | None:
+        """Least-active healthy backend, round-robin among ties."""
+        with self._lock:
+            ready = [
+                b
+                for b in self._backends.values()
+                if b.healthy and b.id not in exclude
+            ]
+            if not ready:
+                return None
+            least = min(b.active for b in ready)
+            tied = [b for b in ready if b.active == least]
+            self._rr += 1
+            chosen = tied[self._rr % len(tied)]
+            chosen.active += 1
+            return chosen
+
+    def _release(self, backend: Backend, ok: bool) -> None:
+        with self._lock:
+            backend.active = max(0, backend.active - 1)
+            if ok:
+                backend.completed += 1
+                backend.fails = 0
+            # NOTE: HTTP-level errors (4xx/5xx) are NOT connection
+            # failures — only _connection_failed flips health.
+
+    def _connection_failed(self, backend: Backend) -> None:
+        """A connect-level failure counts against health immediately —
+        a dead backend must stop receiving traffic before the next
+        probe tick."""
+        with self._lock:
+            backend.fails += 1
+            if backend.fails >= self.unhealthy_after:
+                if backend.healthy:
+                    log.current().warning(
+                        "backend unhealthy", backend=backend.id
+                    )
+                backend.healthy = False
+
+    # -- proxying ----------------------------------------------------------
+
+    def _proxy(self, handler, path: str, body: bytes, headers: dict) -> None:
+        tried: set[str] = set()
+        while True:
+            backend = self._pick(exclude=tried)
+            if backend is None:
+                handler._json(
+                    503,
+                    {
+                        "error": "no healthy serving backend"
+                        + (f" (tried {sorted(tried)})" if tried else "")
+                    },
+                )
+                return
+            tried.add(backend.id)
+            req = urllib.request.Request(
+                backend.url + path, data=body, headers=headers
+            )
+            try:
+                resp = urllib.request.urlopen(
+                    req, timeout=self.request_timeout
+                )
+            except urllib.error.HTTPError as exc:
+                # The backend answered — pass its error through verbatim
+                # (its body is JSON already) and do not retry.
+                self._release(backend, ok=False)
+                self._requests.inc(backend.id, f"http_{exc.code}")
+                payload = exc.read()
+                handler.send_response(exc.code)
+                handler.send_header("Content-Type", "application/json")
+                handler.send_header("Content-Length", str(len(payload)))
+                handler.end_headers()
+                handler.wfile.write(payload)
+                return
+            except (urllib.error.URLError, OSError) as exc:
+                # Connection-level failure before any response byte:
+                # safe to retry once elsewhere.
+                self._release(backend, ok=False)
+                self._connection_failed(backend)
+                self._requests.inc(backend.id, "connect_error")
+                log.current().warning(
+                    "backend connect failed",
+                    backend=backend.id,
+                    error=str(getattr(exc, "reason", exc)),
+                )
+                continue
+            # Copy the response, attributing socket errors to the right
+            # side: resp.* errors are the BACKEND's (health penalty, no
+            # retry — bytes may already be with the client), wfile.*
+            # errors are OUR client leaving (backend is fine).
+            backend_died = client_gone = False
+            copied = 0
+            clen = resp.headers.get("Content-Length")
+            with resp:
+                try:
+                    handler.send_response(resp.status)
+                    handler.send_header(
+                        "Content-Type",
+                        resp.headers.get("Content-Type", "application/json"),
+                    )
+                    if clen is not None:
+                        handler.send_header("Content-Length", clen)
+                    if resp.headers.get("traceparent"):
+                        handler.send_header(
+                            "traceparent", resp.headers["traceparent"]
+                        )
+                    handler.end_headers()
+                except (BrokenPipeError, ConnectionResetError):
+                    client_gone = True
+                # Chunked copy keeps NDJSON streams streaming.
+                while not (backend_died or client_gone):
+                    try:
+                        chunk = resp.read(8192)
+                    except OSError:
+                        backend_died = True
+                        break
+                    if not chunk:
+                        break
+                    try:
+                        handler.wfile.write(chunk)
+                        handler.wfile.flush()
+                        copied += len(chunk)
+                    except (BrokenPipeError, ConnectionResetError):
+                        client_gone = True
+            # A backend killed mid-response often closes with a clean
+            # FIN, indistinguishable from end-of-body on close-delimited
+            # streams — but when Content-Length was declared, a short
+            # copy is proof of truncation.
+            if clen is not None and not client_gone and copied < int(clen):
+                backend_died = True
+            if backend_died:
+                self._release(backend, ok=False)
+                self._connection_failed(backend)
+                self._requests.inc(backend.id, "truncated")
+            elif client_gone:
+                self._release(backend, ok=True)
+                self._requests.inc(backend.id, "client_disconnected")
+            else:
+                self._release(backend, ok=True)
+                self._requests.inc(backend.id, "ok")
+            return
+
+    # -- health + discovery ------------------------------------------------
+
+    def _probe(self, backend: Backend) -> None:
+        try:
+            with urllib.request.urlopen(
+                backend.url + "/healthz", timeout=2
+            ) as resp:
+                ok = resp.status == 200
+        except OSError:
+            ok = False
+        with self._lock:
+            if ok:
+                if not backend.healthy:
+                    log.current().info(
+                        "backend recovered", backend=backend.id
+                    )
+                backend.healthy = True
+                backend.fails = 0
+            else:
+                backend.fails += 1
+                if backend.fails >= self.unhealthy_after:
+                    backend.healthy = False
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval):
+            with self._lock:
+                snapshot = list(self._backends.values())
+            for backend in snapshot:
+                self._probe(backend)
+
+    def _discover_loop(self) -> None:
+        while True:
+            try:
+                self._discover_once()
+            except Exception as exc:
+                # Discovery must outlive registry restarts (the
+                # controller heartbeat's never-die rule).
+                log.current().warning(
+                    "registry discovery failed",
+                    registry=self.registry_address,
+                    error=str(exc),
+                )
+            if self._stop.wait(self.discover_interval):
+                return
+
+    def _discover_once(self) -> None:
+        """Prefix-query ``serve/`` and reconcile the backend table:
+        registry-sourced entries come and go with their keys; static
+        ones are permanent."""
+        from oim_tpu.common.regdial import registry_channel
+        from oim_tpu.spec import REGISTRY, oim_pb2
+
+        with registry_channel(self.registry_address, self._tls) as channel:
+            reply = REGISTRY.stub(channel).GetValues(
+                oim_pb2.GetValuesRequest(path="serve"), timeout=10
+            )
+        found: dict[str, str] = {}
+        for value in reply.values:
+            parts = value.path.split("/")
+            if len(parts) == 3 and parts[0] == "serve" and (
+                parts[2] == "address"
+            ):
+                found[parts[1]] = value.value.rstrip("/")
+        with self._lock:
+            for sid, url in found.items():
+                existing = self._backends.get(sid)
+                if existing is None:
+                    log.current().info(
+                        "backend discovered", backend=sid, url=url
+                    )
+                    self._backends[sid] = Backend(
+                        id=sid, url=url, from_registry=True
+                    )
+                elif existing.url != url:
+                    # Same id, new address: the instance moved (the
+                    # channel-cache-era controller-move semantics).
+                    log.current().info(
+                        "backend moved", backend=sid, url=url
+                    )
+                    existing.url = url
+                    existing.healthy = True
+                    existing.fails = 0
+            for sid in list(self._backends):
+                b = self._backends[sid]
+                if b.from_registry and sid not in found:
+                    log.current().info("backend withdrawn", backend=sid)
+                    del self._backends[sid]
+
+    # -- stats / lifecycle ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "backends": {
+                    b.id: {
+                        "url": b.url,
+                        "healthy": b.healthy,
+                        "active": b.active,
+                        "completed": b.completed,
+                        "from_registry": b.from_registry,
+                    }
+                    for b in self._backends.values()
+                },
+            }
+
+    def start(self) -> "Router":
+        self._http_thread.start()
+        self._health_thread.start()
+        if self._discover_thread is not None:
+            self._discover_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        # shutdown() handshakes with serve_forever and deadlocks if the
+        # listener thread never started (constructed-but-unstarted
+        # routers are legal — unit tests, failed startups).
+        if self._http_thread.is_alive():
+            self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,379 @@
+"""Serving router: balancing, health, failover, discovery, authz.
+
+Tier-2 style (no hardware): real engines on tiny models behind real
+HTTP listeners, a real Router in front, plus unit-level checks on the
+backend table and the registry authz rule for ``serve.<id>`` CNs.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import grpc
+import jax
+import numpy as np
+import pytest
+
+from helpers import FakeAbort, FakeServicerContext
+
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.registry.registry import Registry
+from oim_tpu.serve import Engine, Router, ServeRegistration
+from oim_tpu.serve.server import ServeServer
+from oim_tpu.spec import oim_pb2
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+
+@pytest.fixture(scope="module")
+def backends():
+    """Two live oim-serve instances on the same tiny model."""
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    servers = [
+        ServeServer(
+            Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+        ).start()
+        for _ in range(2)
+    ]
+    yield servers
+    for server in servers:
+        server.stop()
+
+
+def _url(server: ServeServer) -> str:
+    return f"http://{server.host}:{server.port}"
+
+
+def _post(base: str, path: str, payload: dict, timeout=120):
+    req = urllib.request.Request(
+        base + path,
+        json.dumps(payload).encode(),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(base: str, path: str):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _prompt(seed: int, n: int) -> list[int]:
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG["vocab_size"], size=n).tolist()
+
+
+# ---------------------------------------------------------------------------
+# Backend table (unit level — router never started)
+
+
+def test_needs_backends_or_registry():
+    with pytest.raises(ValueError, match="registry"):
+        Router()
+
+
+def test_pick_least_active_with_round_robin_ties():
+    router = Router(backends=("http://a:1", "http://b:2"))
+    try:
+        a = router._backends["http://a:1"]
+        b = router._backends["http://b:2"]
+        first = router._pick()
+        second = router._pick()
+        # Ties broken across both; each pick increments active.
+        assert {first.id, second.id} == {a.id, b.id}
+        router._release(first, ok=True)
+        # a now has 0 active, b has 1 → least-active must pick a.
+        assert router._pick().id == first.id
+    finally:
+        router.stop()
+
+
+def test_connection_failures_flip_health():
+    router = Router(backends=("http://a:1", "http://b:2"), unhealthy_after=2)
+    try:
+        backend = router._backends["http://a:1"]
+        router._connection_failed(backend)
+        assert backend.healthy
+        router._connection_failed(backend)
+        assert not backend.healthy
+        assert [b.id for b in router.healthy_backends()] == ["http://b:2"]
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Proxying over live engines
+
+
+def test_routed_generation_matches_direct(backends):
+    router = Router(
+        backends=tuple(_url(s) for s in backends), health_interval=0.2
+    ).start()
+    try:
+        tokens = _prompt(1, 7)
+        payload = {"tokens": tokens, "max_new_tokens": 9}
+        base = f"http://{router.host}:{router.port}"
+        _, direct = _post(_url(backends[0]), "/v1/generate", payload)
+        _, routed = _post(base, "/v1/generate", payload)
+        assert routed["tokens"] == direct["tokens"]
+        status, health = _get(base, "/healthz")
+        assert status == 200 and health["healthy_backends"] == 2
+    finally:
+        router.stop()
+
+
+def test_concurrent_requests_spread_over_backends(backends):
+    router = Router(
+        backends=tuple(_url(s) for s in backends), health_interval=0.2
+    ).start()
+    try:
+        base = f"http://{router.host}:{router.port}"
+        results: list = []
+
+        def one(seed):
+            _, body = _post(
+                base,
+                "/v1/generate",
+                {"tokens": _prompt(seed, 6), "max_new_tokens": 6},
+            )
+            results.append(body["tokens"])
+
+        threads = [
+            threading.Thread(target=one, args=(seed,)) for seed in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert len(results) == 6
+        stats = router.stats()["backends"]
+        completed = [b["completed"] for b in stats.values()]
+        # Least-active balancing over 6 concurrent requests must not
+        # starve either backend.
+        assert all(c > 0 for c in completed), stats
+        assert sum(c for c in completed) == 6
+    finally:
+        router.stop()
+
+
+def test_streaming_passes_through(backends):
+    router = Router(backends=(_url(backends[0]),)).start()
+    try:
+        base = f"http://{router.host}:{router.port}"
+        req = urllib.request.Request(
+            base + "/v1/generate",
+            json.dumps(
+                {"tokens": _prompt(3, 5), "max_new_tokens": 5,
+                 "stream": True}
+            ).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert "ndjson" in resp.headers.get("Content-Type", "")
+            lines = [json.loads(l) for l in resp.read().splitlines()]
+        assert lines and lines[-1].get("done") is True
+        streamed = [l["token"] for l in lines if "token" in l]
+        assert streamed == lines[-1]["tokens"]
+    finally:
+        router.stop()
+
+
+def test_failover_routes_around_dead_backend(backends):
+    """A stopped backend gets marked out on its first connect failure
+    (retry path) and traffic keeps flowing to the survivor."""
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    doomed = ServeServer(
+        Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+    ).start()
+    router = Router(
+        backends=(_url(doomed), _url(backends[0])),
+        health_interval=30,  # too slow to help — the request path must
+        unhealthy_after=1,   # do the eviction itself
+    ).start()
+    try:
+        base = f"http://{router.host}:{router.port}"
+        doomed_url = _url(doomed)
+        doomed.stop()
+        payload = {"tokens": _prompt(4, 6), "max_new_tokens": 5}
+        for _ in range(3):  # every request must succeed via retry
+            status, body = _post(base, "/v1/generate", payload)
+            assert status == 200 and len(body["tokens"]) == 5
+        stats = router.stats()["backends"]
+        assert stats[doomed_url]["healthy"] is False
+        status, health = _get(base, "/healthz")
+        assert status == 200 and health["healthy_backends"] == 1
+    finally:
+        router.stop()
+
+
+def test_all_backends_down_is_clean_503(backends):
+    router = Router(
+        backends=("http://127.0.0.1:1",), unhealthy_after=1
+    ).start()
+    try:
+        base = f"http://{router.host}:{router.port}"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(base, "/v1/generate",
+                  {"tokens": [1, 2], "max_new_tokens": 2})
+        assert err.value.code == 503
+        assert "no healthy" in json.loads(err.value.read())["error"]
+    finally:
+        router.stop()
+
+
+def test_backend_http_errors_pass_through(backends):
+    """A 400 from the backend (bad request body) must reach the client
+    verbatim, not trigger retries or eat the error detail."""
+    router = Router(backends=(_url(backends[0]),)).start()
+    try:
+        base = f"http://{router.host}:{router.port}"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(base, "/v1/generate", {"max_new_tokens": 2})  # no tokens
+        assert err.value.code == 400
+        assert "error" in json.loads(err.value.read())
+        # The backend answered; it must still be healthy and unretried.
+        stats = router.stats()["backends"]
+        assert all(b["healthy"] for b in stats.values())
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Registry discovery + self-registration
+
+
+def test_discovery_add_move_withdraw(backends):
+    reg = Registry()
+    reg_srv = reg.start_server("tcp://127.0.0.1:0")
+    try:
+        addr = f"tcp://{reg_srv.addr().address}"
+
+        def set_key(path, value):
+            reg.SetValue(
+                oim_pb2.SetValueRequest(
+                    value=oim_pb2.Value(path=path, value=value)
+                ),
+                FakeServicerContext(),
+            )
+
+        set_key("serve/a/address", _url(backends[0]))
+        set_key("serve/ignored/other", "not-an-address-key")
+        router = Router(
+            registry_address=addr,
+            health_interval=0.2,
+            discover_interval=0.2,
+        ).start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and not router.healthy_backends():
+                time.sleep(0.05)
+            stats = router.stats()["backends"]
+            assert list(stats) == ["a"] and stats["a"]["from_registry"]
+
+            # Route a real request through the discovered backend.
+            _, body = _post(
+                f"http://{router.host}:{router.port}",
+                "/v1/generate",
+                {"tokens": _prompt(5, 5), "max_new_tokens": 4},
+            )
+            assert len(body["tokens"]) == 4
+
+            # Move: same id, new address (instance restarted elsewhere).
+            set_key("serve/a/address", _url(backends[1]))
+            deadline = time.time() + 10
+            while time.time() < deadline and (
+                router.stats()["backends"]["a"]["url"] != _url(backends[1])
+            ):
+                time.sleep(0.05)
+            assert router.stats()["backends"]["a"]["url"] == _url(backends[1])
+
+            # Withdraw: empty value deletes the key → backend leaves.
+            set_key("serve/a/address", "")
+            deadline = time.time() + 10
+            while time.time() < deadline and router.stats()["backends"]:
+                time.sleep(0.05)
+            assert router.stats()["backends"] == {}
+        finally:
+            router.stop()
+    finally:
+        reg_srv.stop()
+
+
+def test_serve_self_registration_heartbeat(backends):
+    reg = Registry()
+    reg_srv = reg.start_server("tcp://127.0.0.1:0")
+    try:
+        addr = f"tcp://{reg_srv.addr().address}"
+        registration = ServeRegistration(
+            "inst-1", addr, _url(backends[0]), delay=0.2
+        ).start()
+        try:
+            reply = reg.GetValues(
+                oim_pb2.GetValuesRequest(path="serve"),
+                FakeServicerContext(),
+            )
+            assert [(v.path, v.value) for v in reply.values] == [
+                ("serve/inst-1/address", _url(backends[0]))
+            ]
+            # DB wipe: the heartbeat restores the key (the controller
+            # re-registration behavior).
+            reg.SetValue(
+                oim_pb2.SetValueRequest(
+                    value=oim_pb2.Value(path="serve/inst-1/address", value="")
+                ),
+                FakeServicerContext(),
+            )
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                reply = reg.GetValues(
+                    oim_pb2.GetValuesRequest(path="serve"),
+                    FakeServicerContext(),
+                )
+                if reply.values:
+                    break
+                time.sleep(0.05)
+            assert reply.values, "heartbeat never re-registered"
+        finally:
+            registration.stop()
+    finally:
+        reg_srv.stop()
+
+
+def test_registration_invalid_id_rejected():
+    with pytest.raises(ValueError, match="serve id"):
+        ServeRegistration("a/b", "tcp://x:1", "http://y:2")
+
+
+def test_serve_cn_authz():
+    """serve.<id> may set exactly its own discovery key."""
+    reg = Registry()
+
+    def set_as(cn, path):
+        reg.SetValue(
+            oim_pb2.SetValueRequest(
+                value=oim_pb2.Value(path=path, value="http://x:1")
+            ),
+            FakeServicerContext(cn),
+        )
+
+    set_as("serve.inst-1", "serve/inst-1/address")
+    with pytest.raises(FakeAbort) as err:
+        set_as("serve.inst-1", "serve/inst-2/address")
+    assert err.value.code == grpc.StatusCode.PERMISSION_DENIED
+    with pytest.raises(FakeAbort):
+        set_as("serve.inst-1", "inst-1/address")  # controller namespace
+    with pytest.raises(FakeAbort):
+        set_as("serve.inst-1", "volumes/v/coordinator")
