@@ -53,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-top-k", type=int, default=1)
     p.add_argument("--rope-theta", type=float, default=10000.0)
     p.add_argument(
+        "--doc-sep-id", type=int, default=-1,
+        help="sequence packing: treat this token id as a document "
+        "separator (attention masked to same-document pairs, boundary "
+        "labels dropped); -1 = off",
+    )
+    p.add_argument(
         "--rope-scaling", type=float, nargs=4, default=[],
         metavar=("FACTOR", "LOW", "HIGH", "ORIG_MAX"),
         help="Llama-3.1 RoPE frequency remap (factor low_freq_factor "
@@ -239,6 +245,7 @@ def main(argv=None) -> int:
         rope_theta=args.rope_theta,
         rope_scaling=tuple(args.rope_scaling),
         norm_eps=args.norm_eps,
+        doc_sep_id=args.doc_sep_id,
         n_stages=args.pp,
         n_microbatches=max(args.n_microbatches, 1),
         grad_accum=args.grad_accum,

@@ -36,7 +36,7 @@ from oim_tpu.common.tlsconfig import TLSConfig
 from oim_tpu.controller.keymutex import KeyMutex
 from oim_tpu.spec import CONTROLLER, REGISTRY, oim_pb2
 
-REGISTRY_CN = "component.registry"
+from oim_tpu.common.regdial import REGISTRY_CN  # one definition
 DEFAULT_REGISTRY_DELAY = 60.0
 
 

@@ -8,6 +8,7 @@ keeps N batches committed to devices ahead of the train loop.
 from oim_tpu.data.loader import (
     ShardSpec,
     TokenBatches,
+    pack_documents,
     split_batch,
     window_count,
 )
@@ -16,6 +17,7 @@ from oim_tpu.data.prefetch import device_prefetch, to_global
 __all__ = [
     "ShardSpec",
     "TokenBatches",
+    "pack_documents",
     "split_batch",
     "window_count",
     "device_prefetch",

@@ -138,3 +138,50 @@ class TokenBatches:
 def split_batch(batch: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """``[B, seq+1]`` → (inputs ``[B, seq]``, targets ``[B, seq]``)."""
     return batch[:, :-1], batch[:, 1:]
+
+
+def pack_documents(
+    documents: "list[list[int]] | list[np.ndarray]",
+    seq: int,
+    sep_id: int,
+) -> np.ndarray:
+    """Greedy sequence packing: documents → ``[n_rows, seq]`` token rows.
+
+    Each document is prefixed with ``sep_id`` (BOS-style — the separator
+    opens the document it precedes, matching ``TransformerConfig.
+    doc_sep_id`` semantics) and rows are filled greedily in order; a
+    document that does not fit the remaining row starts a new one, and
+    documents longer than ``seq - 1`` are split into maximal chunks,
+    each re-prefixed with the separator (the continuation loses its
+    earlier context — the standard packing trade-off, traded against
+    zero padding waste).  Row tails pad with runs of ``sep_id``: every
+    extra separator opens an empty document, so padded positions attend
+    only to themselves and contribute nothing to the loss (separator
+    labels are masked — models/train.py ``_shifted_labels``).
+    """
+    if seq < 2:
+        raise ValueError(f"seq={seq} leaves no room for sep + token")
+    rows: list[list[int]] = []
+    current: list[int] = []
+    for doc in documents:
+        doc = [int(t) for t in doc]
+        if any(t == sep_id for t in doc):
+            raise ValueError(
+                f"document contains the separator id {sep_id}"
+            )
+        if not doc:
+            continue
+        for start in range(0, len(doc), seq - 1):
+            chunk = doc[start : start + seq - 1]
+            if len(current) + 1 + len(chunk) > seq:
+                rows.append(current)
+                current = []
+            current += [sep_id] + chunk
+    if current:
+        rows.append(current)
+    if not rows:
+        return np.empty((0, seq), np.int32)
+    out = np.full((len(rows), seq), sep_id, np.int32)
+    for i, row in enumerate(rows):
+        out[i, : len(row)] = row
+    return out

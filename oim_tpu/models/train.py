@@ -59,12 +59,15 @@ def data_pspec() -> P:
     return P("dp", "sp")
 
 
-def _shifted_labels(tokens):
+def _shifted_labels(tokens, doc_sep_id: int = -1):
     """Next-token labels + validity mask for a [b, t_local] sequence shard.
 
     The last local position's label is the first token of the *next*
     sequence shard (one neighbor ppermute hop over ``sp``); the global
-    final position of each sequence is masked out.  Returns
+    final position of each sequence is masked out.  With sequence packing
+    (``doc_sep_id`` >= 0) labels that ARE a separator drop out too: the
+    separator opens the next document (BOS-style), so predicting it would
+    cross the same boundary the attention mask isolates.  Returns
     ``(labels [b, t], valid [b, t] bool, positions [t])`` — the one
     definition of shard-boundary labeling, shared by the autodiff loss and
     the 1F1B per-microbatch head.
@@ -78,6 +81,8 @@ def _shifted_labels(tokens):
     positions = sp_index * t_local + jnp.arange(t_local)  # [t]
     t_global = t_local * sp_size
     valid = jnp.broadcast_to(positions < t_global - 1, (b, t_local))
+    if doc_sep_id >= 0:
+        valid = valid & (labels != doc_sep_id)
     return labels, valid, positions
 
 
@@ -149,7 +154,7 @@ def _local_objective(params, tokens, cfg: TransformerConfig):
     last pipeline stage (the one whose logits are real) so the caller can
     reconstruct the ce metric with forward-only psums.
     """
-    labels, valid, _ = _shifted_labels(tokens)
+    labels, valid, _ = _shifted_labels(tokens, cfg.doc_sep_id)
     if cfg.use_pallas and cfg.fused_ce:
         hidden, aux = forward_hidden(params, tokens, cfg)
         ce_sum, ce_count = _fused_ce_sum(
@@ -167,7 +172,10 @@ def _local_objective(params, tokens, cfg: TransformerConfig):
     dp_size = jax.lax.axis_size("dp")
     sp_size = jax.lax.axis_size("sp")
     # Every label position except each sequence's global last is valid, on
-    # every data shard — a static count (== psum(ce_count) over the mesh).
+    # every data shard — a static count (== psum(ce_count) over the mesh,
+    # except under sequence packing where separator labels drop out and
+    # the objective deliberately keeps the FIXED denominator: per-token
+    # weights must not depend on how many documents a batch packed).
     c_global = float(b * dp_size * (t_local * sp_size - 1))
     obj = ce_sum / c_global + AUX_LOSS_WEIGHT * aux / (dp_size * sp_size)
     return obj, (ce_sum, ce_count)

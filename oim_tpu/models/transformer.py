@@ -102,6 +102,12 @@ class TransformerConfig:
     # "1f1b" (interleaved fwd/bwd, min(M, 2S-1) in-flight activations and
     # per-microbatch loss head — see parallel/pipeline.py).
     pp_schedule: str = "gpipe"
+    # Sequence packing: >= 0 marks this token id as a document separator
+    # (BOS-style: the separator belongs to the document it opens).
+    # Attention is masked to same-document pairs (flash/ring/ulysses all
+    # carry segment ids) and labels crossing a boundary drop out of the
+    # loss, so a packed batch trains identically to per-document batches.
+    doc_sep_id: int = -1
 
     def __post_init__(self):
         if self.attn_impl not in ("ring", "ulysses"):
@@ -147,6 +153,20 @@ class TransformerConfig:
                 f"moe_top_k={self.moe_top_k} must be in "
                 f"[1, n_experts={self.n_experts}]"
             )
+        if self.doc_sep_id >= 0:
+            if self.doc_sep_id >= self.vocab_size:
+                raise ValueError(
+                    f"doc_sep_id={self.doc_sep_id} outside vocab "
+                    f"{self.vocab_size}"
+                )
+            if self.n_stages > 1:
+                # The pipeline schedules hand stage_fn per-microbatch
+                # activations without a microbatch index, so the
+                # closure-carried segment ids cannot be sliced to match.
+                raise ValueError(
+                    "sequence packing (doc_sep_id) is not supported with "
+                    "pipeline parallelism yet (n_stages > 1)"
+                )
 
     @property
     def head_dim(self) -> int:
@@ -287,7 +307,8 @@ def _rmsnorm(x, w, cfg: TransformerConfig):
     return reference_rmsnorm(x, w, cfg.norm_eps)
 
 
-def _attention(x, lp, positions, cfg: TransformerConfig, sp_size):
+def _attention(x, lp, positions, cfg: TransformerConfig, sp_size,
+               segments=None):
     b, t, d = x.shape
     h, hd, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     normed = _rmsnorm(x, lp["attn_norm"], cfg)
@@ -296,6 +317,12 @@ def _attention(x, lp, positions, cfg: TransformerConfig, sp_size):
     v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, kvh, hd)
     q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+    if segments is not None and segments.shape[0] != b:
+        # Microbatched pipeline stages see a slice of the batch; segments
+        # were built for the full local batch and broadcast over it.
+        raise ValueError(
+            f"segments batch {segments.shape[0]} != activation batch {b}"
+        )
     if sp_size > 1:
         if cfg.attn_impl == "ulysses":
             # Ulysses trades sequence shards for HEAD shards via
@@ -305,16 +332,18 @@ def _attention(x, lp, positions, cfg: TransformerConfig, sp_size):
                 k = jnp.repeat(k, h // kvh, axis=2)
                 v = jnp.repeat(v, h // kvh, axis=2)
             out = ulysses_attention(
-                q, k, v, "sp", causal=True, use_flash=cfg.use_pallas
+                q, k, v, "sp", causal=True, use_flash=cfg.use_pallas,
+                segments=segments,
             )
         else:  # "ring" (validated in __post_init__)
             # The ring carries kv-sized blocks natively: GQA divides the
             # rotation traffic by n_heads/n_kv_heads.
-            out = ring_attention(q, k, v, "sp", causal=True)
+            out = ring_attention(q, k, v, "sp", causal=True,
+                                 segments=segments)
     elif cfg.use_pallas:
-        out = flash_attention(q, k, v, True)
+        out = flash_attention(q, k, v, True, segments=segments)
     else:
-        out = reference_attention(q, k, v, True)
+        out = reference_attention(q, k, v, True, segments)
     out = out.reshape(b, t, h * hd)
     return x + jnp.einsum("btn,nd->btd", out, lp["wo"]).astype(x.dtype)
 
@@ -423,10 +452,10 @@ def _cast_matmul_weights(lp: dict, cfg: TransformerConfig) -> dict:
     return {k: v if k in keep else v.astype(dt) for k, v in lp.items()}
 
 
-def _layer(carry, lp, cfg: TransformerConfig, sp_size):
+def _layer(carry, lp, cfg: TransformerConfig, sp_size, segments=None):
     x, positions, aux = carry
     lp = _cast_matmul_weights(lp, cfg)
-    x = _attention(x, lp, positions, cfg, sp_size)
+    x = _attention(x, lp, positions, cfg, sp_size, segments)
     if cfg.n_experts:
         x, layer_aux = _switch_moe(x, lp, cfg)
     else:
@@ -446,11 +475,14 @@ def _stage_layer_params(params: dict, cfg: TransformerConfig) -> dict:
     }
 
 
-def make_stage_fn(cfg: TransformerConfig, positions: jax.Array, sp_size: int):
+def make_stage_fn(cfg: TransformerConfig, positions: jax.Array, sp_size: int,
+                  segments: jax.Array | None = None):
     """One pipeline stage's layer stack as ``(stage_params, act) -> (act,
     aux)`` — the unit both pipeline schedules and the single-stage path
-    run.  ``positions`` broadcast over any (micro)batch size."""
-    layer_fn = partial(_layer, cfg=cfg, sp_size=sp_size)
+    run.  ``positions`` broadcast over any (micro)batch size; ``segments``
+    [b_local, t_local] (sequence packing) ride the closure like cfg —
+    they are data-derived but constant across layers and stages."""
+    layer_fn = partial(_layer, cfg=cfg, sp_size=sp_size, segments=segments)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
 
@@ -463,6 +495,25 @@ def make_stage_fn(cfg: TransformerConfig, positions: jax.Array, sp_size: int):
         return out, aux
 
     return stage_fn
+
+
+def _doc_segments(tokens, cfg: TransformerConfig) -> jax.Array:
+    """Global document ids for a packed [b, t_local] token shard.
+
+    A separator opens a new document (BOS-style), so the id is the
+    inclusive running count of separators in GLOBAL sequence order:
+    local cumsum plus the preceding shards' totals (one ``all_gather``
+    of a [b]-vector over ``sp`` — negligible next to the ring's k/v
+    rotation).  Must run inside shard_map with the ``sp`` axis.
+    """
+    sep = (tokens == cfg.doc_sep_id).astype(jnp.int32)
+    local = jnp.cumsum(sep, axis=1)  # [b, t_local]
+    totals = jax.lax.all_gather(local[:, -1], "sp")  # [sp, b]
+    before = (
+        jnp.arange(totals.shape[0]) < jax.lax.axis_index("sp")
+    )[:, None]
+    offset = jnp.sum(jnp.where(before, totals, 0), axis=0)  # [b]
+    return local + offset[:, None]
 
 
 def forward_local(
@@ -497,8 +548,11 @@ def forward_hidden(
     # 1-D positions broadcast over any (micro)batch size.
     positions = sp_index * t_local + jnp.arange(t_local)
 
+    segments = (
+        _doc_segments(tokens, cfg) if cfg.doc_sep_id >= 0 else None
+    )
     stage_params = _stage_layer_params(params, cfg)
-    run_stage = make_stage_fn(cfg, positions, sp_size)
+    run_stage = make_stage_fn(cfg, positions, sp_size, segments)
 
     if pp_size > 1:
         n_micro = max(cfg.n_microbatches, 1)

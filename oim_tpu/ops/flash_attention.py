@@ -32,9 +32,11 @@ _LANES = 128
 _ROW_LANES = 8
 
 
-def reference_attention(q, k, v, causal: bool = True):
+def reference_attention(q, k, v, causal: bool = True, segments=None):
     """O(T²) oracle.  Supports grouped-query attention: k/v may carry
-    fewer heads than q (H % KVH == 0); they are broadcast per group."""
+    fewer heads than q (H % KVH == 0); they are broadcast per group.
+    ``segments`` [B, T] int restricts attention to same-segment pairs
+    (sequence packing: tokens never attend across document boundaries)."""
     d = q.shape[-1]
     if k.shape[2] != q.shape[2]:
         group = q.shape[2] // k.shape[2]
@@ -47,6 +49,9 @@ def reference_attention(q, k, v, causal: bool = True):
         tq, tk = q.shape[1], k.shape[1]
         mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
         scores = jnp.where(mask, scores, _NEG_BIG)
+    if segments is not None:
+        same = segments[:, :, None] == segments[:, None, :]  # [B, Tq, Tk]
+        scores = jnp.where(same[:, None, :, :], scores, _NEG_BIG)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(
         q.dtype
@@ -71,10 +76,23 @@ def _causal_mask(scores, qi, ki, block_q, block_k):
     return jnp.where(q_pos >= k_pos, scores, _NEG_BIG)
 
 
+def _segment_mask(scores, segq_ref, segk_ref):
+    """Mask cross-segment pairs (sequence packing).  seg_q rides a
+    [bq, 8] row tile, seg_k a transposed [8, bk] lane tile; their
+    [bq,1]==[1,bk] comparison broadcasts to the score block."""
+    seg_q = segq_ref[0][:, :1]          # [bq, 1]
+    seg_k = segk_ref[0][:1, :]          # [1, bk]
+    return jnp.where(seg_q == seg_k, scores, _NEG_BIG)
+
+
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, causal, scale, block_q, block_k,
+    q_ref, k_ref, v_ref, *rest,
+    causal, scale, block_q, block_k, segmented=False,
 ):
+    if segmented:
+        segq_ref, segk_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     qi, ki = pl.program_id(1), pl.program_id(2)
     n_k = pl.num_programs(2)
     d = q_ref.shape[-1]
@@ -101,6 +119,8 @@ def _fwd_kernel(
         )
         if causal:
             scores = _causal_mask(scores, qi, ki, block_q, block_k)
+        if segmented:
+            scores = _segment_mask(scores, segq_ref, segk_ref)
         m_prev, l_prev = m_scr[...], l_scr[...]
         m_curr = jnp.max(scores, axis=1, keepdims=True)  # [bq, 1]
         m_next = jnp.maximum(m_prev, m_curr)             # [bq, 128]
@@ -124,9 +144,13 @@ def _fwd_kernel(
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, causal, scale, block_q, block_k,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    causal, scale, block_q, block_k, segmented=False,
 ):
+    if segmented:
+        segq_ref, segk_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
     qi, ki = pl.program_id(1), pl.program_id(2)
     n_k = pl.num_programs(2)
 
@@ -151,6 +175,8 @@ def _dq_kernel(
         )
         if causal:
             scores = _causal_mask(scores, qi, ki, block_q, block_k)
+        if segmented:
+            scores = _segment_mask(scores, segq_ref, segk_ref)
         p = jnp.exp(scores - lse)                 # recomputed prob block
         dp = jax.lax.dot_general(                 # do @ v.T
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -166,9 +192,13 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, *, causal, scale, block_q, block_k, n_q,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    causal, scale, block_q, block_k, n_q, segmented=False,
 ):
+    if segmented:
+        segq_ref, segk_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     # Grid: (b·kvh, n_k, group·n_q) — the innermost dim walks every
     # (q-head-in-group, q-block) pair so each kv-head's dk/dv output block
     # is visited contiguously (GQA: several q heads accumulate into one
@@ -199,6 +229,8 @@ def _dkv_kernel(
         )
         if causal:
             scores = _causal_mask(scores, qi, ki, block_q, block_k)
+        if segmented:
+            scores = _segment_mask(scores, segq_ref, segk_ref)
         p = jnp.exp(scores - lse)
         dv_scr[...] += jax.lax.dot_general(       # p.T @ do
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -245,15 +277,18 @@ def _auto_block(t: int, want: int):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(
-    q, k, v, causal: bool = True, block_q: int = 0, block_k: int = 0
+    q, k, v, causal: bool = True, block_q: int = 0, block_k: int = 0,
+    segments=None,
 ):
     """Attention over [B, T, H, D] with blockwise online softmax.
 
     ``block_q``/``block_k`` of 0 auto-tune: measured on v5e, (512, 1024)
     blocks are ~6x faster than (128, 128) at T=8192 (bigger tiles amortize
     the per-block DMA + relayout overhead; VMEM still fits comfortably).
+    ``segments`` [B, T] int masks attention to same-segment pairs
+    (sequence packing); it rides the kernels as [*, 8]-lane tiles.
     """
-    out, _ = _forward(q, k, v, causal, block_q, block_k)
+    out, _ = _forward(q, k, v, causal, block_q, block_k, segments)
     return out
 
 
@@ -282,14 +317,23 @@ def _kv_row_map(h: int, kvh: int):
     return lambda g: (g // h) * kvh + (g % h) // group
 
 
-def _forward(q, k, v, causal, block_q, block_k):
+def _seg_tiles(segments):
+    """[B, T] segment ids → (row tile [B, T, 8], lane tile [B, 8, T])."""
+    seg = segments.astype(jnp.int32)
+    b, t = seg.shape
+    rows = jnp.broadcast_to(seg[:, :, None], (b, t, _ROW_LANES))
+    cols = jnp.broadcast_to(seg[:, None, :], (b, _ROW_LANES, t))
+    return rows, cols
+
+
+def _forward(q, k, v, causal, block_q, block_k, segments=None):
     b, t, h, d = q.shape
     group = _gqa_group(q, k)
     blocks = _resolve_blocks(t, block_q, block_k)
     if blocks is None:
         # Ragged tails: fall back to the reference (bench shapes are
         # block-aligned; correctness everywhere beats a padded kernel).
-        return reference_attention(q, k, v, causal), None
+        return reference_attention(q, k, v, causal, segments), None
     block_q, block_k = blocks
     scale = 1.0 / (d**0.5)
     qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
@@ -298,21 +342,35 @@ def _forward(q, k, v, causal, block_q, block_k):
     # row g // group (per batch: rows are [b, h] row-major, so the batch
     # offset rescales from h-strides to kvh-strides).
     kv_row = _kv_row_map(h, h // group)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda g, qi, ki: (g, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda g, qi, ki: (kv_row(g), ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda g, qi, ki: (kv_row(g), ki, 0)),
+    ]
+    operands = [qh, kh, vh]
+    if segments is not None:
+        seg_rows, seg_cols = _seg_tiles(segments)
+        in_specs += [
+            pl.BlockSpec(
+                (1, block_q, _ROW_LANES), lambda g, qi, ki: (g // h, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, _ROW_LANES, block_k), lambda g, qi, ki: (g // h, 0, ki)
+            ),
+        ]
+        operands += [seg_rows, seg_cols]
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, causal=causal, scale=scale,
             block_q=block_q, block_k=block_k,
+            segmented=segments is not None,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
             jax.ShapeDtypeStruct((bh, t, _ROW_LANES), jnp.float32),
         ],
         grid=(bh, t // block_q, t // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda g, qi, ki: (g, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g, qi, ki: (kv_row(g), ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g, qi, ki: (kv_row(g), ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda g, qi, ki: (g, qi, 0)),
             pl.BlockSpec(
@@ -325,22 +383,34 @@ def _forward(q, k, v, causal, block_q, block_k):
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qh, kh, vh)
+    )(*operands)
     return _heads_last(out, b, h), lse
 
 
-def _fwd(q, k, v, causal, block_q, block_k):
-    out, lse = _forward(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v, out, lse)
+def _fwd(q, k, v, causal, block_q, block_k, segments=None):
+    out, lse = _forward(q, k, v, causal, block_q, block_k, segments)
+    return out, (q, k, v, out, lse, segments)
+
+
+def _seg_grad(segments):
+    """float0 cotangent for the (integer) segment ids."""
+    if segments is None:
+        return None
+    import numpy as np
+
+    return np.zeros(segments.shape, jax.dtypes.float0)
 
 
 def _bwd(causal, block_q, block_k, residuals, g):
-    q, k, v, out, lse = residuals
+    q, k, v, out, lse, segments = residuals
     if lse is None:  # ragged forward fell back to the reference formula
         _, vjp = jax.vjp(
-            lambda q, k, v: reference_attention(q, k, v, causal), q, k, v
+            lambda q, k, v: reference_attention(
+                q, k, v, causal, segments
+            ),
+            q, k, v,
         )
-        return vjp(g)
+        return (*vjp(g), _seg_grad(segments))
     b, t, h, d = q.shape
     kvh = k.shape[2]
     group = h // kvh
@@ -366,15 +436,29 @@ def _bwd(causal, block_q, block_k, residuals, g):
     rowspec = pl.BlockSpec(
         (1, block_q, _ROW_LANES), lambda g_, qi, ki: (g_, qi, 0)
     )
+    segmented = segments is not None
+    dq_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
+    dq_operands = [qh, kh, vh, doh, lse, delta]
+    if segmented:
+        seg_rows, seg_cols = _seg_tiles(segments)
+        dq_specs += [
+            pl.BlockSpec(
+                (1, block_q, _ROW_LANES), lambda g_, qi, ki: (g_ // h, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, _ROW_LANES, block_k), lambda g_, qi, ki: (g_ // h, 0, ki)
+            ),
+        ]
+        dq_operands += [seg_rows, seg_cols]
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, **common),
+        functools.partial(_dq_kernel, segmented=segmented, **common),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         grid=(bh, n_q, n_k),
-        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        in_specs=dq_specs,
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(qh, kh, vh, doh, lse, delta)
+    )(*dq_operands)
 
     # dk/dv accumulate per kv head over every (q-head-in-group, q-block):
     # grid rows are kv heads; the innermost dim j walks group·n_q pairs so
@@ -388,25 +472,39 @@ def _bwd(causal, block_q, block_k, residuals, g):
         (1, block_q, _ROW_LANES),
         lambda g_, ki, j: (q_row(g_, j), j % n_q, 0),
     )
+    dkv_specs = [qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2]
+    dkv_operands = [qh, kh, vh, doh, lse, delta]
+    if segmented:
+        dkv_specs += [
+            pl.BlockSpec(
+                (1, block_q, _ROW_LANES),
+                lambda g_, ki, j: (q_row(g_, j) // h, j % n_q, 0),
+            ),
+            pl.BlockSpec(
+                (1, _ROW_LANES, block_k), lambda g_, ki, j: (g_ // kvh, 0, ki)
+            ),
+        ]
+        dkv_operands += [seg_rows, seg_cols]
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, n_q=n_q, **common),
+        functools.partial(_dkv_kernel, n_q=n_q, segmented=segmented, **common),
         out_shape=[
             jax.ShapeDtypeStruct((b * kvh, t, d), k.dtype),
             jax.ShapeDtypeStruct((b * kvh, t, d), v.dtype),
         ],
         grid=(b * kvh, n_k, group * n_q),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        in_specs=dkv_specs,
         out_specs=[kspec2, kspec2],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qh, kh, vh, doh, lse, delta)
+    )(*dkv_operands)
     return (
         _heads_last(dq, b, h),
         _heads_last(dk, b, kvh),
         _heads_last(dv, b, kvh),
+        _seg_grad(segments),
     )
 
 

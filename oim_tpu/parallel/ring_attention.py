@@ -24,13 +24,17 @@ import jax.numpy as jnp
 _NEG_BIG = -1e30  # finite "-inf": keeps fully-masked rows NaN-free
 
 
-def _block_attention(q, k, v, m, l, o, q_offset, k_offset, causal, scale):
+def _block_attention(
+    q, k, v, m, l, o, q_offset, k_offset, causal, scale,
+    seg_q=None, seg_k=None,
+):
     """One flash-style accumulation step of local q against one k/v block.
 
     Grouped-query form (classic MHA is group size 1):
     q: [B, Tq, KVH, G, D]; k, v: [B, Tk, KVH, D]
     m, l: [B, KVH, G, Tq]; o: [B, Tq, KVH, G, D]
     (running max / denominator / numerator)
+    seg_q [B, Tq] / seg_k [B, Tk] mask cross-segment pairs (packing).
     """
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k) * scale
     if causal:
@@ -38,6 +42,9 @@ def _block_attention(q, k, v, m, l, o, q_offset, k_offset, causal, scale):
         q_pos = q_offset + jnp.arange(tq)[:, None]
         k_pos = k_offset + jnp.arange(tk)[None, :]
         scores = jnp.where(q_pos >= k_pos, scores, _NEG_BIG)
+    if seg_q is not None:
+        same = seg_q[:, :, None] == seg_k[:, None, :]  # [B, Tq, Tk]
+        scores = jnp.where(same[:, None, None], scores, _NEG_BIG)
     block_max = jnp.max(scores, axis=-1)  # [B, KVH, G, Tq]
     new_m = jnp.maximum(m, block_max)
     correction = jnp.exp(m - new_m)
@@ -54,6 +61,7 @@ def ring_attention(
     v: jax.Array,
     axis_name: str,
     causal: bool = True,
+    segments: jax.Array | None = None,
 ) -> jax.Array:
     """Exact attention over a ring of sequence shards.
 
@@ -63,6 +71,9 @@ def ring_attention(
         axis-index order.
       axis_name: mesh axis carrying the sequence shards (``sp``).
       causal: standard causal masking in *global* positions.
+      segments: local ``[batch, seq_local]`` segment-id shard (sequence
+        packing) — rotates around the ring with its k/v block so
+        cross-document pairs are masked across shard boundaries too.
 
     Returns the local output shard ``[batch, seq_local, heads, head_dim]``.
     """
@@ -91,23 +102,32 @@ def ring_attention(
     o0 = qf * 0.0
     q_offset = index * t_local
 
+    seg_local = (
+        None if segments is None else segments.astype(jnp.int32)
+    )
+
     def step(carry, step_idx):
-        m, l, o, k_blk, v_blk = carry
+        m, l, o, k_blk, v_blk, seg_blk = carry
         # The k/v block currently held started at ring position
         # (index - step) mod size.
         k_owner = (index - step_idx) % size
         k_offset = k_owner * t_local
         m, l, o = _block_attention(
-            qf, k_blk, v_blk, m, l, o, q_offset, k_offset, causal, scale
+            qf, k_blk, v_blk, m, l, o, q_offset, k_offset, causal, scale,
+            seg_local, seg_blk,
         )
         # Rotate k/v one hop around the ring (neighbor traffic on ICI).
         perm = [(i, (i + 1) % size) for i in range(size)]
         k_next = jax.lax.ppermute(k_blk, axis_name, perm)
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (m, l, o, k_next, v_next), None
+        seg_next = (
+            None if seg_blk is None
+            else jax.lax.ppermute(seg_blk, axis_name, perm)
+        )
+        return (m, l, o, k_next, v_next, seg_next), None
 
-    (m, l, o, _, _), _ = jax.lax.scan(
-        step, (m0, l0, o0, kf, vf), jnp.arange(size)
+    (m, l, o, _, _, _), _ = jax.lax.scan(
+        step, (m0, l0, o0, kf, vf, seg_local), jnp.arange(size)
     )
     # Fully-masked rows (can only happen for non-causal degenerate inputs)
     # keep l == 0; guard the division.
@@ -121,16 +141,29 @@ from oim_tpu.ops.flash_attention import reference_attention  # noqa: E402
 __all__ = ["reference_attention", "ring_attention", "ring_attention_sharded"]
 
 
-def ring_attention_sharded(q, k, v, mesh, causal: bool = True, rules=None):
+def ring_attention_sharded(
+    q, k, v, mesh, causal: bool = True, rules=None, segments=None
+):
     """Convenience wrapper: global arrays in, global arrays out, with the
-    sequence dimension sharded over ``sp`` and batch over ``dp``."""
+    sequence dimension sharded over ``sp`` and batch over ``dp``
+    (``segments`` [B, T] shards the same way)."""
     from jax.sharding import PartitionSpec as P
 
     spec = P("dp", "sp", None, None)
+    if segments is None:
+        fn = jax.shard_map(
+            partial(ring_attention, axis_name="sp", causal=causal),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+        return fn(q, k, v)
     fn = jax.shard_map(
-        partial(ring_attention, axis_name="sp", causal=causal),
+        lambda q_, k_, v_, s_: ring_attention(
+            q_, k_, v_, "sp", causal=causal, segments=s_
+        ),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, P("dp", "sp")),
         out_specs=spec,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, segments)

@@ -32,6 +32,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 
 from oim_tpu.ops.flash_attention import flash_attention, reference_attention
 
@@ -43,6 +44,7 @@ def ulysses_attention(
     axis_name: str,
     causal: bool = True,
     use_flash: bool = True,
+    segments: jax.Array | None = None,
 ) -> jax.Array:
     """Exact attention over sequence shards via all-to-all resharding.
 
@@ -54,13 +56,18 @@ def ulysses_attention(
       causal: causal masking in global positions.
       use_flash: run the local attention through the pallas flash kernel
         (falls back to the reference path off-TPU / for ragged shapes).
+      segments: local ``[batch, seq_local]`` segment ids (sequence
+        packing) — all-gathered over ``axis_name`` since after the
+        all-to-all every device attends over the FULL sequence.
 
     Returns the local output shard ``[batch, seq_local, heads, head_dim]``.
     """
     size = jax.lax.axis_size(axis_name)
     if size == 1:
         attn = flash_attention if use_flash else reference_attention
-        return attn(q, k, v, causal)
+        if use_flash:
+            return attn(q, k, v, causal, segments=segments)
+        return attn(q, k, v, causal, segments)
     heads = q.shape[2]
     if heads % size != 0:
         raise ValueError(
@@ -86,9 +93,18 @@ def ulysses_attention(
     q_full = seq_to_heads(q)
     k_full = seq_to_heads(k)
     v_full = seq_to_heads(v)
+    seg_full = (
+        None if segments is None
+        else jax.lax.all_gather(
+            segments.astype(jnp.int32), axis_name, axis=1, tiled=True
+        )
+    )
 
     attn = flash_attention if use_flash else reference_attention
-    o_full = attn(q_full, k_full, v_full, causal)
+    if use_flash:
+        o_full = attn(q_full, k_full, v_full, causal, segments=seg_full)
+    else:
+        o_full = attn(q_full, k_full, v_full, causal, seg_full)
 
     return heads_to_seq(o_full)
 

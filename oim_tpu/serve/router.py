@@ -39,7 +39,7 @@ import json
 import threading
 import urllib.error
 import urllib.request
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu import log
@@ -59,7 +59,6 @@ class Backend:
     active: int = 0
     completed: int = 0
     fails: int = 0  # consecutive health/connection failures
-    lock: threading.Lock = field(default_factory=threading.Lock)
 
 
 class Router:
@@ -208,7 +207,7 @@ class Router:
 
     def _proxy(self, handler, path: str, body: bytes, headers: dict) -> None:
         tried: set[str] = set()
-        while True:
+        while len(tried) < 2:  # the documented single-retry bound
             backend = self._pick(exclude=tried)
             if backend is None:
                 handler._json(
@@ -306,6 +305,10 @@ class Router:
                 self._release(backend, ok=True)
                 self._requests.inc(backend.id, "ok")
             return
+        handler._json(
+            503,
+            {"error": f"no healthy serving backend (tried {sorted(tried)})"},
+        )
 
     # -- health + discovery ------------------------------------------------
 
