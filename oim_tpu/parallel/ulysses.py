@@ -109,17 +109,29 @@ def ulysses_attention(
     return heads_to_seq(o_full)
 
 
-def ulysses_attention_sharded(q, k, v, mesh, causal: bool = True):
+def ulysses_attention_sharded(
+    q, k, v, mesh, causal: bool = True, segments=None
+):
     """Convenience wrapper: global arrays in, global arrays out, sequence
     sharded over ``sp`` and batch over ``dp`` (mirror of
-    ``ring_attention_sharded``)."""
+    ``ring_attention_sharded``; ``segments`` [B, T] shards the same way)."""
     from jax.sharding import PartitionSpec as P
 
     spec = P("dp", "sp", None, None)
+    if segments is None:
+        fn = jax.shard_map(
+            partial(ulysses_attention, axis_name="sp", causal=causal),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+        return fn(q, k, v)
     fn = jax.shard_map(
-        partial(ulysses_attention, axis_name="sp", causal=causal),
+        lambda q_, k_, v_, s_: ulysses_attention(
+            q_, k_, v_, "sp", causal=causal, segments=s_
+        ),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, P("dp", "sp")),
         out_specs=spec,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, segments)

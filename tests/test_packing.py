@@ -218,3 +218,24 @@ class TestPackedExactness:
     def test_sep_outside_vocab_rejected(self):
         with pytest.raises(ValueError, match="vocab"):
             _cfg(doc_sep_id=101)
+
+
+class TestSegmentedUlysses:
+    def test_matches_global_oracle(self):
+        from oim_tpu.parallel.ulysses import ulysses_attention_sharded
+
+        mesh = build_mesh(sp=4)
+        ks = jax.random.split(jax.random.PRNGKey(5), 4)
+        b, t, h, d = 2, 32, 4, 16
+        q = jax.random.normal(ks[0], (b, t, h, d))
+        k = jax.random.normal(ks[1], (b, t, h, d))
+        v = jax.random.normal(ks[2], (b, t, h, d))
+        seg = jnp.cumsum(
+            jax.random.bernoulli(ks[3], 0.15, (b, t)).astype(jnp.int32),
+            axis=1,
+        )
+        out = ulysses_attention_sharded(q, k, v, mesh, segments=seg)
+        ref = reference_attention(q, k, v, True, seg)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
