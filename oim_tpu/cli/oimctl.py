@@ -62,6 +62,9 @@ def main(argv=None) -> int:
     generate.add_argument("--serve", default="http://127.0.0.1:8000")
     generate.add_argument("--max-new-tokens", type=int, default=16)
     generate.add_argument("--temperature", type=float, default=0.0)
+    generate.add_argument("--repetition-penalty", type=float, default=1.0)
+    generate.add_argument("--presence-penalty", type=float, default=0.0)
+    generate.add_argument("--frequency-penalty", type=float, default=0.0)
     generate.add_argument("--seed", type=int, default=0)
     generate.add_argument("--logprobs", action="store_true")
     generate.add_argument(
@@ -124,6 +127,9 @@ def main(argv=None) -> int:
             "tokens": args.tokens,
             "max_new_tokens": args.max_new_tokens,
             "temperature": args.temperature,
+            "repetition_penalty": args.repetition_penalty,
+            "presence_penalty": args.presence_penalty,
+            "frequency_penalty": args.frequency_penalty,
             "seed": args.seed,
             "logprobs": args.logprobs,
             "stream": args.stream,

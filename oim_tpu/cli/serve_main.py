@@ -98,6 +98,13 @@ def build_parser() -> argparse.ArgumentParser:
         "scales) — halves weight bytes, the small-batch decode bottleneck",
     )
     p.add_argument(
+        "--no-penalties", action="store_true",
+        help="disable sampling-penalty support (repetition/presence/"
+        "frequency): skips the per-slot [n_slots, vocab] occurrence "
+        "state - worth it at big vocab x many slots when no client "
+        "penalizes",
+    )
+    p.add_argument(
         "--kv-int8", action="store_true",
         help="int8-quantized KV cache (half the cache bandwidth decode "
         "pays; per-token/head scales)",
@@ -226,6 +233,7 @@ def make_engine(args):
         mesh=serve_mesh,
         spec_decode=args.spec_decode,
         spec_ngram=args.spec_ngram,
+        penalties=not args.no_penalties,
     )
 
 

@@ -388,6 +388,59 @@ def truncate_logits(logits, top_k: int = 0, top_p: float = 1.0) -> jax.Array:
     return logits
 
 
+def apply_penalties(
+    logits,
+    tok_counts,
+    gen_counts,
+    repetition_penalty=1.0,
+    presence_penalty=0.0,
+    frequency_penalty=0.0,
+):
+    """Sampling penalties over [..., V] logits.
+
+    - repetition (HF convention): logits of tokens that appeared in the
+      PROMPT OR the generation divide by the penalty when positive,
+      multiply when negative (> 1 discourages reuse).
+    - presence / frequency (OpenAI convention): flat / per-occurrence
+      subtraction for tokens already GENERATED.
+
+    ``tok_counts`` counts prompt+generated occurrences, ``gen_counts``
+    generated only (both [..., V] ints).  Penalty params broadcast over
+    the leading axes (scalar or per-row).  Neutral values (1, 0, 0)
+    return the logits bit-for-bit unchanged — the serving engine applies
+    this unconditionally and the existing exactness matrix relies on it.
+    """
+    rows = logits.shape[:-1]
+    rep = jnp.broadcast_to(
+        jnp.asarray(repetition_penalty, logits.dtype), rows
+    )[..., None]
+    pres = jnp.broadcast_to(
+        jnp.asarray(presence_penalty, logits.dtype), rows
+    )[..., None]
+    freq = jnp.broadcast_to(
+        jnp.asarray(frequency_penalty, logits.dtype), rows
+    )[..., None]
+    adjusted = jnp.where(logits > 0, logits / rep, logits * rep)
+    logits = jnp.where(tok_counts > 0, adjusted, logits)
+    return (
+        logits
+        - pres * (gen_counts > 0).astype(logits.dtype)
+        - freq * gen_counts.astype(logits.dtype)
+    )
+
+
+def token_counts(tokens, vocab: int) -> jax.Array:
+    """Occurrence counts per vocab id: [..., T] int tokens → [..., V].
+    Scatter-add, O(V) memory — a one_hot formulation would materialize
+    a [..., T, V] intermediate (gigabytes at long-prompt × big-vocab)."""
+    lead = tokens.shape[:-1]
+    flat = tokens.reshape(-1, tokens.shape[-1])
+    counts = jax.vmap(
+        lambda row: jnp.zeros((vocab,), jnp.int32).at[row].add(1)
+    )(flat)
+    return counts.reshape(*lead, vocab)
+
+
 def sample_token(
     logits, temperature: float, key, top_k: int = 0, top_p: float = 1.0
 ) -> jax.Array:
@@ -411,12 +464,18 @@ def generate(
     top_k: int = 0,
     top_p: float = 1.0,
     kv_int8: bool = False,
+    repetition_penalty: float = 1.0,
+    presence_penalty: float = 0.0,
+    frequency_penalty: float = 0.0,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     prompt: [batch, prompt_len] int32.  Returns
     ``[batch, prompt_len + max_new_tokens]``.  Jit-friendly: one prefill,
     then a ``lax.scan`` of single-token steps over static length.
+    Penalty params apply ``apply_penalties`` before each sampling step
+    (occurrence counts ride the scan carry); neutral defaults change
+    nothing — this is the serving engine's exactness oracle.
     """
     b, t = prompt.shape
     if max_new_tokens <= 0:
@@ -431,17 +490,39 @@ def generate(
     if key is None:
         key = jax.random.PRNGKey(0)  # greedy path: key is never consumed
     first_key, key = jax.random.split(key)  # never reuse a consumed key
-    first = sample_token(logits[:, -1, :], temperature, first_key, top_k, top_p)
+    tok_counts = token_counts(prompt, cfg.vocab_size)  # [b, V]
+    gen_counts = jnp.zeros_like(tok_counts)
+    penals = (repetition_penalty, presence_penalty, frequency_penalty)
+
+    def counted(counts, token):
+        return counts + jax.nn.one_hot(token, cfg.vocab_size, dtype=jnp.int32)
+
+    first = sample_token(
+        apply_penalties(logits[:, -1, :], tok_counts, gen_counts, *penals),
+        temperature, first_key, top_k, top_p,
+    )
+    tok_counts = counted(tok_counts, first)
+    gen_counts = counted(gen_counts, first)
 
     def step(carry, step_key):
-        cache, token = carry
+        cache, token, tok_counts, gen_counts = carry
         logits, cache = decode_step(params, cache, token[:, None], cfg)
-        next_token = sample_token(logits, temperature, step_key, top_k, top_p)
-        return (cache, next_token), token
+        next_token = sample_token(
+            apply_penalties(logits, tok_counts, gen_counts, *penals),
+            temperature, step_key, top_k, top_p,
+        )
+        return (
+            cache,
+            next_token,
+            counted(tok_counts, next_token),
+            counted(gen_counts, next_token),
+        ), token
 
     # `first` is generated token 1; the scan produces the remaining n-1.
     step_keys = jax.random.split(key, max_new_tokens - 1)
-    (_, last), generated = jax.lax.scan(step, (cache, first), step_keys)
+    (_, last, _, _), generated = jax.lax.scan(
+        step, (cache, first, tok_counts, gen_counts), step_keys
+    )
     # ys hold each step's *input* (tokens 1..n-1); the final carry is n.
     out = jnp.concatenate(
         [generated.swapaxes(0, 1), last[:, None]], axis=1
@@ -457,5 +538,6 @@ def make_generate_fn(cfg: TransformerConfig):
         partial(generate, cfg=cfg),
         static_argnames=(
             "max_new_tokens", "temperature", "top_k", "top_p", "kv_int8",
+            "repetition_penalty", "presence_penalty", "frequency_penalty",
         ),
     )

@@ -79,6 +79,7 @@ from oim_tpu.models.decode import (
     _flat_layer_params,
     _load_kv,
     _moe_exact,
+    apply_penalties,
     embed_tokens,
     truncate_logits,
 )
@@ -329,14 +330,21 @@ def _hidden_slots(params, tokens, kv, starts, cfg):
     return _rmsnorm(x, params["final_norm"], cfg), tuple(kv)
 
 
-def _sample_batched(logits, temps, keys, top_k, top_p):
+def _sample_batched(logits, temps, keys, top_k, top_p, penalties=None):
     """Per-slot temperature sampling with per-slot PRNG keys: greedy
     where temp == 0, else categorical over temperature-scaled logits with
     the engine's static top-k/top-p truncation (``truncate_logits`` — the
-    same masking the solo path uses).  Returns ``(tokens [S],
-    logprobs [S])`` — the logprob is the chosen token's log-softmax under
-    the model's RAW distribution (temperature 1, untruncated), the
-    standard scoring convention."""
+    same masking the solo path uses).  ``penalties`` = (rep [S], pres
+    [S], freq [S], tok_counts [S, V], gen_counts [S, V]) pre-adjusts the
+    logits (``apply_penalties``; neutral rows are bit-exact no-ops).
+    Returns ``(tokens [S], logprobs [S])`` — the logprob is the chosen
+    token's log-softmax under the (penalty-adjusted) temperature-1
+    untruncated distribution, the standard scoring convention."""
+    if penalties is not None:
+        rep, pres, freq, tok_counts, gen_counts = penalties
+        logits = apply_penalties(
+            logits, tok_counts, gen_counts, rep, pres, freq
+        )
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = truncate_logits(
         logits / jnp.maximum(temps, 1e-6)[:, None], top_k, top_p
@@ -355,8 +363,10 @@ def _sample_batched(logits, temps, keys, top_k, top_p):
 
 
 def _admit_batch(
-    params, cache: SlotCache, history, full_rows, prompts, slots, starts,
-    true_tails, temps, keys, *, cfg, top_k, top_p, track_history,
+    params, cache: SlotCache, history, tok_counts, gen_counts,
+    prompt_counts, full_rows, prompts, slots, starts,
+    true_tails, temps, reps, press, freqs, keys,
+    *, cfg, top_k, top_p, track_history, penalize,
 ):
     """Prefill a whole GROUP of admissions in one dispatch and sample
     each one's first generated token.  Returns
@@ -369,6 +379,12 @@ def _admit_batch(
     With ``track_history=False`` (non-speculative engines — nothing
     consumes the record) both pass through untouched and the caller
     hands in dummies, skipping the per-admission host→device transfer.
+    ``tok_counts``/``gen_counts`` [n_slots, V] are the engine's sampling-
+    penalty occurrence state; ``prompt_counts`` [S, V] (host-side
+    bincounts of each admission's FULL prompt) resets the admitted
+    slots' rows, and the first sampled token joins both counts.
+    ``reps``/``press``/``freqs`` [S] are the per-row penalty params
+    (neutral on padding rows).
     prompts [S, Lb]: each row's uncached prompt tail, padded to the
     group's shared bucket; slots [S]: row → slot index, with the
     OUT-OF-BOUNDS value ``n_slots`` marking inert padding rows (S is
@@ -405,10 +421,26 @@ def _admit_batch(
     logits = _unembed(
         last_h[:, None], dequantize_named(params, "wlm"), cfg
     )[:, 0]
-    first, first_lp = _sample_batched(logits, temps, keys, top_k, top_p)
+    if penalize:
+        gen_zero = jnp.zeros_like(prompt_counts)
+        first, first_lp = _sample_batched(
+            logits, temps, keys, top_k, top_p,
+            penalties=(reps, press, freqs, prompt_counts, gen_zero),
+        )
+        onehot = jax.nn.one_hot(
+            first, prompt_counts.shape[1], dtype=jnp.int32
+        )
+        tok_counts = tok_counts.at[slots].set(
+            prompt_counts + onehot, mode="drop"
+        )
+        gen_counts = gen_counts.at[slots].set(onehot, mode="drop")
+    else:
+        first, first_lp = _sample_batched(logits, temps, keys, top_k, top_p)
     return (
         SlotCache(k_all, v_all, lengths, ks_all, vs_all),
         history,
+        tok_counts,
+        gen_counts,
         first,
         first_lp,
     )
@@ -441,14 +473,17 @@ def _inject_prefix(cache: SlotCache, entry, slot):
 
 
 def _decode_chunk(
-    params, cache: SlotCache, tokens, temps, active, bases, counts,
-    *, cfg, chunk, top_k, top_p,
+    params, cache: SlotCache, tok_counts, gen_counts, tokens, temps,
+    reps, press, freqs, active, bases, counts,
+    *, cfg, chunk, top_k, top_p, penalize,
 ):
     """Advance every active slot by ``chunk`` tokens in one dispatch.
 
     tokens [S] (each slot's latest token), temps [S], active [S] bool,
     bases [S] per-request PRNG base keys, counts [S] tokens already
-    generated per request.  Returns (cache, out [S, chunk]).
+    generated per request; tok_counts/gen_counts [S, V] +
+    reps/press/freqs [S] drive the sampling penalties (neutral rows are
+    exact no-ops).  Returns (cache, tok_counts, gen_counts, out, lps).
 
     Step ``i`` samples slot ``s`` with ``fold_in(bases[s], counts[s]+i)``
     — the key is a function of (request seed, absolute token index), so
@@ -460,24 +495,47 @@ def _decode_chunk(
     max_len = cache.max_len
 
     def one(carry, i):
-        kv, lengths, tok = carry
+        kv, lengths, tok, tok_c, gen_c = carry
         x, kv = _hidden_slots(params, tok[:, None], kv, lengths, cfg)
         logits = _unembed(x, dequantize_named(params, "wlm"), cfg)
         keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
-        nxt, lp = _sample_batched(logits[:, -1], temps, keys, top_k, top_p)
-        nxt = jnp.where(active, nxt, tok)
+        if penalize:
+            nxt, lp = _sample_batched(
+                logits[:, -1], temps, keys, top_k, top_p,
+                penalties=(reps, press, freqs, tok_c, gen_c),
+            )
+            nxt = jnp.where(active, nxt, tok)
+            upd = active.astype(jnp.int32)[:, None] * jax.nn.one_hot(
+                nxt, tok_c.shape[1], dtype=jnp.int32
+            )
+            tok_c, gen_c = tok_c + upd, gen_c + upd
+        else:
+            nxt, lp = _sample_batched(
+                logits[:, -1], temps, keys, top_k, top_p
+            )
+            nxt = jnp.where(active, nxt, tok)
         # Clamp: a slot decoding past its budget inside a chunk (host
         # truncates after) must not index past the cache edge.
         lengths = jnp.minimum(
             lengths + active.astype(jnp.int32), max_len - 1
         )
-        return (kv, lengths, nxt), (nxt, lp)
+        return (kv, lengths, nxt, tok_c, gen_c), (nxt, lp)
 
     kv0 = (cache.k, cache.v, cache.k_scale, cache.v_scale)
-    ((k_all, v_all, ks_all, vs_all), lengths, _), (out, lps) = jax.lax.scan(
-        one, (kv0, cache.lengths, tokens), jnp.arange(chunk)
+    (
+        (k_all, v_all, ks_all, vs_all), lengths, _, tok_counts, gen_counts
+    ), (out, lps) = jax.lax.scan(
+        one,
+        (kv0, cache.lengths, tokens, tok_counts, gen_counts),
+        jnp.arange(chunk),
     )
-    return SlotCache(k_all, v_all, lengths, ks_all, vs_all), out.T, lps.T
+    return (
+        SlotCache(k_all, v_all, lengths, ks_all, vs_all),
+        tok_counts,
+        gen_counts,
+        out.T,
+        lps.T,
+    )
 
 
 def _draft_lookup(hist, length, draft_len: int, ngram: int, max_len: int):
@@ -633,6 +691,15 @@ class GenRequest:
     # set (emitted, like eos_id).  For multi-token stop SEQUENCES do the
     # matching client-side — the engine is tokenizer-agnostic.
     stop_ids: tuple[int, ...] = ()
+    # Sampling penalties (models/decode.py ``apply_penalties``):
+    # repetition (HF convention, over prompt+generated; 1.0 = off),
+    # presence/frequency (OpenAI convention, over generated; 0.0 = off).
+    # Neutral values are bit-exact no-ops; non-neutral values are
+    # rejected on speculative engines (draft verification would need
+    # within-block count evolution).
+    repetition_penalty: float = 1.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     # Store this request's prompt KV in the engine's prefix cache after
     # admission (mark system prompts); later prompts sharing the prefix
     # skip re-prefilling it.
@@ -682,6 +749,7 @@ class Engine:
         mesh=None,
         spec_decode: int = 0,
         spec_ngram: int = 2,
+        penalties: bool = True,
     ):
         if n_slots < 1 or max_len < 2 or chunk < 1 or prefix_cache_size < 0:
             raise ValueError(
@@ -760,6 +828,18 @@ class Engine:
         # Device-side token record per slot (admission writes the full
         # prompt; speculative decode appends) — the draft source.
         self._history = jnp.zeros((n_slots, max_len), jnp.int32)
+        # Sampling-penalty occurrence state: prompt+generated and
+        # generated-only counts per slot (models/decode.apply_penalties).
+        # With penalties disabled the state shrinks to [1, 1] dummies and
+        # the jitted paths skip the count math entirely (the
+        # track_history trace-time-gating precedent) — big-vocab many-
+        # slot deployments that never penalize pay nothing.
+        counts_shape = (
+            (n_slots, cfg.vocab_size) if penalties else (1, 1)
+        )
+        self.penalties = penalties
+        self._tok_counts = jnp.zeros(counts_shape, jnp.int32)
+        self._gen_counts = jnp.zeros(counts_shape, jnp.int32)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -769,10 +849,14 @@ class Engine:
             self._history = jax.device_put(
                 self._history, NamedSharding(mesh, P())
             )
+            self._tok_counts, self._gen_counts = jax.device_put(
+                (self._tok_counts, self._gen_counts),
+                NamedSharding(mesh, P()),
+            )
         self._admit = jax.jit(
             partial(_admit_batch, cfg=cfg, top_k=top_k, top_p=top_p,
-                    track_history=bool(spec_decode)),
-            donate_argnums=(1, 2),
+                    track_history=bool(spec_decode), penalize=penalties),
+            donate_argnums=(1, 2, 3, 4),
         )
         # Prefix cache: LRU of prompt-KV entries (tuple(tokens) →
         # (kv pytree, true length)).  Each entry costs about one slot's
@@ -800,8 +884,8 @@ class Engine:
         else:
             self._decode = jax.jit(
                 partial(_decode_chunk, cfg=cfg, chunk=chunk, top_k=top_k,
-                        top_p=top_p),
-                donate_argnums=(1,),
+                        top_p=top_p, penalize=penalties),
+                donate_argnums=(1, 2, 3),
             )
         self.spec_drafted = 0
         self.spec_accepted = 0
@@ -897,6 +981,30 @@ class Engine:
                     f"headroom reserve"
                     if self.spec_decode else ""
                 )
+            )
+        if req.repetition_penalty <= 0:
+            raise ValueError(
+                f"repetition_penalty must be > 0, got "
+                f"{req.repetition_penalty}"
+            )
+        wants_penalties = (
+            req.repetition_penalty != 1.0
+            or req.presence_penalty != 0.0
+            or req.frequency_penalty != 0.0
+        )
+        if self.spec_decode and wants_penalties:
+            # Draft verification samples draft_len+1 positions from ONE
+            # forward; penalties evolve the counts WITHIN that block, so
+            # exactness would need per-position count replay.  Reject
+            # rather than silently approximate.
+            raise ValueError(
+                "sampling penalties are not supported on a speculative "
+                "engine (start oim-serve without --spec-decode)"
+            )
+        if not self.penalties and wants_penalties:
+            raise ValueError(
+                "this engine was built with penalties=False "
+                "(oim-serve --no-penalties); restart without it"
             )
         bad = [t for t in req.tokens if not 0 <= t < self.cfg.vocab_size]
         if bad:
@@ -1279,6 +1387,17 @@ class Engine:
                 starts = np.zeros((n_slots,), np.int32)
                 tails = np.ones((n_slots,), np.int32)
                 temps = np.zeros((n_slots,), np.float32)
+                # [1, 1] dummy when penalties are off — _admit_batch
+                # passes the state through untouched (track_history's
+                # dead-transfer discipline).
+                prompt_counts = np.zeros(
+                    (n_slots, self.cfg.vocab_size) if self.penalties
+                    else (1, 1),
+                    np.int32,
+                )
+                reps = np.ones((n_slots,), np.float32)
+                press = np.zeros((n_slots,), np.float32)
+                freqs = np.zeros((n_slots,), np.float32)
                 keys = [zero_key] * n_slots
                 for i, (slot, rid, req, _, start, tail, _) in enumerate(
                     group
@@ -1290,19 +1409,36 @@ class Engine:
                     starts[i] = start
                     tails[i] = len(tail)
                     temps[i] = req.temperature
+                    if self.penalties:
+                        prompt_counts[i] = np.bincount(
+                            req.tokens, minlength=self.cfg.vocab_size
+                        )
+                    reps[i] = req.repetition_penalty
+                    press[i] = req.presence_penalty
+                    freqs[i] = req.frequency_penalty
                     keys[i] = jax.random.fold_in(
                         jax.random.PRNGKey(req.seed), 0
                     )
-                self._cache, self._history, first, first_lp = self._admit(
+                (
+                    self._cache, self._history,
+                    self._tok_counts, self._gen_counts,
+                    first, first_lp,
+                ) = self._admit(
                     self.params,
                     self._cache,
                     self._history,
+                    self._tok_counts,
+                    self._gen_counts,
+                    jnp.asarray(prompt_counts),
                     jnp.asarray(full_rows),
                     jnp.asarray(prompts),
                     jnp.asarray(slot_idx),
                     jnp.asarray(starts),
                     jnp.asarray(tails),
                     jnp.asarray(temps),
+                    jnp.asarray(reps),
+                    jnp.asarray(press),
+                    jnp.asarray(freqs),
                     jnp.stack(keys),
                 )
                 groups.append((group, first, first_lp))
@@ -1389,9 +1525,33 @@ class Engine:
             if not self._warming:
                 self.readbacks += 1
         else:
-            self._cache, out, lps = self._decode(
-                self.params, self._cache, tokens, temps, active, bases,
-                counts,
+            reps = jnp.asarray(
+                [
+                    slots[i].req.repetition_penalty if i in slots else 1.0
+                    for i in range(n_slots)
+                ],
+                jnp.float32,
+            )
+            press = jnp.asarray(
+                [
+                    slots[i].req.presence_penalty if i in slots else 0.0
+                    for i in range(n_slots)
+                ],
+                jnp.float32,
+            )
+            freqs = jnp.asarray(
+                [
+                    slots[i].req.frequency_penalty if i in slots else 0.0
+                    for i in range(n_slots)
+                ],
+                jnp.float32,
+            )
+            (
+                self._cache, self._tok_counts, self._gen_counts, out, lps
+            ) = self._decode(
+                self.params, self._cache, self._tok_counts,
+                self._gen_counts, tokens, temps, reps, press, freqs,
+                active, bases, counts,
             )
             out, lps = jax.device_get((out, lps))
             if not self._warming:

@@ -9,6 +9,8 @@ API:
   POST /v1/generate   {"tokens": [int...], "max_new_tokens": N,
                        "temperature": 0.0, "seed": 0, "eos_id": null,
                        "stream": false, "logprobs": false,
+                       "repetition_penalty": 1.0, "presence_penalty": 0.0,
+                       "frequency_penalty": 0.0,
                        "cache_prefix": false, "stop_ids": []}
                     → {"tokens": [int...]}   (generated only, EOS included;
                     "logprobs": true adds each token's log-softmax under
@@ -231,6 +233,15 @@ class ServeServer:
                         ),
                         stop_ids=tuple(
                             int(t) for t in body.get("stop_ids", ())
+                        ),
+                        repetition_penalty=float(
+                            body.get("repetition_penalty", 1.0)
+                        ),
+                        presence_penalty=float(
+                            body.get("presence_penalty", 0.0)
+                        ),
+                        frequency_penalty=float(
+                            body.get("frequency_penalty", 0.0)
                         ),
                         cache_prefix=bool(body.get("cache_prefix")),
                     )
