@@ -1,0 +1,111 @@
+"""oim-export-hf: native params export → HF Llama checkpoint directory.
+
+The inverse of ``oim-import-hf``: loads a params-only orbax export
+(``oim-train --export-dir`` / ``Checkpointer.export_params``), converts
+it to the HF Llama layout (oim_tpu/models/hf.py ``to_hf_llama``), and
+``save_pretrained``s a directory any ``transformers`` consumer loads —
+models trained or fine-tuned here can leave the framework.
+
+Geometry flags mirror oim-serve's (shapes alone cannot recover
+n_heads); the roundtrip import(export(params)) == params is pinned by
+tests/test_hf_import.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="oim-export-hf",
+        description="Convert a native params export to an HF Llama "
+        "checkpoint directory.",
+    )
+    p.add_argument("--params-dir", required=True)
+    p.add_argument(
+        "--out-dir", required=True, help="target HF directory (must not exist)"
+    )
+    p.add_argument("--vocab-size", type=int, required=True)
+    p.add_argument("--d-model", type=int, required=True)
+    p.add_argument("--n-layers", type=int, required=True)
+    p.add_argument("--n-heads", type=int, required=True)
+    p.add_argument("--n-kv-heads", type=int, default=0)
+    p.add_argument("--d-ff", type=int, default=0)
+    p.add_argument("--rope-theta", type=float, default=10000.0)
+    p.add_argument(
+        "--rope-scaling", type=float, nargs=4, default=[],
+        metavar=("FACTOR", "LOW", "HIGH", "ORIG_MAX"),
+    )
+    p.add_argument("--norm-eps", type=float, default=1e-6)
+    p.add_argument(
+        "--n-stages", type=int, default=1,
+        help="pipeline stages the params were exported with (oim-train "
+        "--pp); must match or the orbax restore shape-mismatches",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+    out_dir = os.path.abspath(args.out_dir)
+    if os.path.exists(out_dir):
+        print(f"refusing to overwrite {out_dir}", file=sys.stderr)
+        return 1
+
+    import jax
+    import torch
+    import transformers
+
+    from oim_tpu.checkpoint import load_params
+    from oim_tpu.models import TransformerConfig, init_params
+    from oim_tpu.models.hf import hf_llama_config_kwargs, to_hf_llama
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab_size,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
+        d_ff=args.d_ff,
+        rope_theta=args.rope_theta,
+        rope_scaling=tuple(args.rope_scaling),
+        norm_eps=args.norm_eps,
+        n_stages=args.n_stages,
+    )
+    template = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg)
+    )
+    params = load_params(args.params_dir, template)
+    sd = to_hf_llama(params, cfg)
+
+    config = transformers.LlamaConfig(**hf_llama_config_kwargs(cfg))
+    # Meta-device construction skips torch's random init and the
+    # duplicate full-precision allocation (assign=True adopts our
+    # tensors directly) — an 8B export would otherwise pay minutes of
+    # normal_() and 2x peak RAM for weights we immediately overwrite.
+    with torch.device("meta"):
+        model = transformers.LlamaForCausalLM(config)
+    missing, unexpected = model.load_state_dict(
+        {k: torch.as_tensor(v) for k, v in sd.items()},
+        strict=False, assign=True,
+    )
+    # rotary buffers etc. are derived, not loaded; real weights missing
+    # means the conversion broke — fail loudly, never write half a model.
+    real_missing = [m for m in missing if "rotary" not in m]
+    if real_missing or unexpected:
+        print(
+            f"state dict mismatch: missing={real_missing[:4]} "
+            f"unexpected={list(unexpected)[:4]}",
+            file=sys.stderr,
+        )
+        return 1
+    model.save_pretrained(out_dir)
+    print(f"exported {args.params_dir} -> {out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,7 @@ import numpy as np
 
 from oim_tpu.models.transformer import TransformerConfig
 
+
 def llama_config(hf_config, **overrides) -> TransformerConfig:
     """TransformerConfig mirroring an HF ``LlamaConfig``-shaped object
     (attribute access; a plain dict also works).  ``overrides`` pass
@@ -206,3 +207,104 @@ def from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
                 f"config shape {shape} — config/checkpoint mismatch"
             )
     return params
+
+
+def _inv_proj(weight, heads: int, head_dim: int, permute: bool) -> np.ndarray:
+    """Native [d, heads·hd] projection → HF [heads·hd, d], inverting the
+    RoPE coordinate permutation where ``_proj`` applied it."""
+    w = np.asarray(weight, dtype=np.float32)
+    if permute:
+        d = w.shape[0]
+        inv = np.argsort(_rope_perm(head_dim))
+        w = w.reshape(d, heads, head_dim)[:, :, inv].reshape(d, -1)
+    return w.T
+
+
+def to_hf_llama(params: dict, cfg: TransformerConfig) -> dict:
+    """HF Llama ``state_dict`` (numpy float32) from a native params
+    pytree — the exact inverse of ``from_hf_llama``: projections
+    transpose back to [out, in], the interleaved-RoPE q/k column
+    permutation inverts, and the [n_stages, layers_per_stage, ...]
+    stacking flattens to per-layer tensors.  Always exports an untied
+    ``lm_head``; MoE models are rejected (no HF Llama analog).
+    Roundtrip and logit parity are pinned by tests/test_hf_import.py.
+    """
+    if cfg.n_experts:
+        raise ValueError("MoE export is not supported (dense Llama only)")
+    h, kvh, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(
+            params["wte"], dtype=np.float32
+        ),
+        "model.norm.weight": np.asarray(
+            params["final_norm"], dtype=np.float32
+        ),
+        "lm_head.weight": np.asarray(params["wlm"], dtype=np.float32).T,
+    }
+
+    def layer(name, i):
+        s, l = divmod(i, cfg.layers_per_stage)
+        return params[name][s, l]
+
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = np.asarray(
+            layer("attn_norm", i), dtype=np.float32
+        )
+        sd[p + "self_attn.q_proj.weight"] = _inv_proj(
+            layer("wq", i), h, hd, True
+        )
+        sd[p + "self_attn.k_proj.weight"] = _inv_proj(
+            layer("wk", i), kvh, hd, True
+        )
+        sd[p + "self_attn.v_proj.weight"] = _inv_proj(
+            layer("wv", i), kvh, hd, False
+        )
+        sd[p + "self_attn.o_proj.weight"] = np.asarray(
+            layer("wo", i), dtype=np.float32
+        ).T
+        sd[p + "post_attention_layernorm.weight"] = np.asarray(
+            layer("mlp_norm", i), dtype=np.float32
+        )
+        sd[p + "mlp.gate_proj.weight"] = np.asarray(
+            layer("w_gate", i), dtype=np.float32
+        ).T
+        sd[p + "mlp.up_proj.weight"] = np.asarray(
+            layer("w_in", i), dtype=np.float32
+        ).T
+        sd[p + "mlp.down_proj.weight"] = np.asarray(
+            layer("w_out", i), dtype=np.float32
+        ).T
+    return sd
+
+
+def hf_llama_config_kwargs(cfg: TransformerConfig) -> dict:
+    """Kwargs for ``transformers.LlamaConfig`` mirroring ``cfg`` — the
+    inverse of ``llama_config`` (rope_scaling tuple → HF dict)."""
+    kwargs = dict(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.d_model,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.kv_heads,
+        intermediate_size=cfg.ff_dim,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.norm_eps,
+        tie_word_embeddings=False,
+        attention_bias=False,
+        mlp_bias=False,
+    )
+    if cfg.rope_scaling:
+        factor, low, high, orig = cfg.rope_scaling
+        kwargs["rope_scaling"] = {
+            "rope_type": "llama3",
+            "factor": factor,
+            "low_freq_factor": low,
+            "high_freq_factor": high,
+            "original_max_position_embeddings": int(orig),
+        }
+        # Without this, the exported config.json inherits transformers'
+        # 2048 default and downstream consumers cap context there
+        # despite the scaling dict implying factor x orig.
+        kwargs["max_position_embeddings"] = int(factor * orig)
+    return kwargs

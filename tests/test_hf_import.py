@@ -261,3 +261,89 @@ class TestRopeScalingParity:
             TransformerConfig(rope_scaling=(0.0, 1.0, 4.0, 8192.0))
         with pytest.raises(ValueError, match="factor"):
             TransformerConfig(rope_scaling=(8.0, 4.0, 4.0, 8192.0))
+
+
+class TestExport:
+    def test_roundtrip_identity(self):
+        """import(export(params)) must reproduce params exactly — the
+        two RoPE permutations and transposes are mutual inverses."""
+        from oim_tpu.models import TransformerConfig, init_params
+        from oim_tpu.models.hf import from_hf_llama, to_hf_llama
+
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=112, dtype="float32",
+        )
+        params = init_params(jax.random.PRNGKey(3), cfg)
+        back = from_hf_llama(to_hf_llama(params, cfg), cfg)
+        for name in params:
+            np.testing.assert_array_equal(
+                np.asarray(params[name]), np.asarray(back[name]),
+                err_msg=name,
+            )
+
+    def test_exported_model_matches_native_logits(self):
+        """transformers' forward on the exported weights == the native
+        forward — the outbound bridge is parity-proven like the inbound."""
+        from oim_tpu.models import TransformerConfig, init_params
+        from oim_tpu.models.hf import hf_llama_config_kwargs, to_hf_llama
+
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=112, dtype="float32", use_pallas=False,
+            norm_eps=1e-5,
+        )
+        params = init_params(jax.random.PRNGKey(4), cfg)
+        config = transformers.LlamaConfig(**hf_llama_config_kwargs(cfg))
+        model = transformers.LlamaForCausalLM(config)
+        model.load_state_dict(
+            {
+                k: torch.as_tensor(v)
+                for k, v in to_hf_llama(params, cfg).items()
+            },
+            strict=False,
+        )
+        model.eval()
+        tokens = np.arange(2 * 16).reshape(2, 16) % cfg.vocab_size
+        with torch.no_grad():
+            want = model(torch.as_tensor(tokens)).logits.float().numpy()
+        got = _native_logits(params, tokens, cfg)
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-4)
+
+    def test_export_cli_roundtrip(self, tmp_path):
+        """orbax params export → oim-export-hf → from_pretrained →
+        oim-import-hf → params equal."""
+        import orbax.checkpoint as ocp
+
+        from oim_tpu.cli.export_hf_main import main as export_main
+        from oim_tpu.cli.import_hf_main import main as import_main
+        from oim_tpu.checkpoint import load_params
+        from oim_tpu.models import TransformerConfig, init_params
+
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=112,
+            dtype="float32",
+        )
+        params = init_params(jax.random.PRNGKey(5), cfg)
+        native1 = tmp_path / "native1"
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(native1, params)
+        flags = ["--vocab-size", "128", "--d-model", "64", "--n-layers",
+                 "2", "--n-heads", "4", "--d-ff", "112"]
+        hf_dir, native2 = tmp_path / "hf", tmp_path / "native2"
+        assert export_main(
+            ["--params-dir", str(native1), "--out-dir", str(hf_dir), *flags]
+        ) == 0
+        assert import_main(
+            ["--hf-dir", str(hf_dir), "--out-dir", str(native2),
+             "--param-dtype", "float32"]
+        ) == 0
+        template = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), cfg)
+        )
+        back = load_params(str(native2), template)
+        for name in params:
+            np.testing.assert_array_equal(
+                np.asarray(params[name]), np.asarray(back[name]),
+                err_msg=name,
+            )
