@@ -41,6 +41,11 @@ def _parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--norm-eps", type=float, default=1e-6)
     p.add_argument(
+        "--max-position-embeddings", type=int, default=0,
+        help="context length to record in config.json (0 = derive from "
+        "rope-scaling, else transformers' default)",
+    )
+    p.add_argument(
         "--n-stages", type=int, default=1,
         help="pipeline stages the params were exported with (oim-train "
         "--pp); must match or the orbax restore shape-mismatches",
@@ -81,7 +86,11 @@ def main(argv=None) -> int:
     params = load_params(args.params_dir, template)
     sd = to_hf_llama(params, cfg)
 
-    config = transformers.LlamaConfig(**hf_llama_config_kwargs(cfg))
+    config = transformers.LlamaConfig(
+        **hf_llama_config_kwargs(
+            cfg, args.max_position_embeddings or None
+        )
+    )
     # Meta-device construction skips torch's random init and the
     # duplicate full-precision allocation (assign=True adopts our
     # tensors directly) — an 8B export would otherwise pay minutes of

@@ -62,6 +62,8 @@ def main(argv=None) -> int:
     generate.add_argument("--serve", default="http://127.0.0.1:8000")
     generate.add_argument("--max-new-tokens", type=int, default=16)
     generate.add_argument("--temperature", type=float, default=0.0)
+    generate.add_argument("--top-p", type=float, default=None)
+    generate.add_argument("--min-p", type=float, default=0.0)
     generate.add_argument("--repetition-penalty", type=float, default=1.0)
     generate.add_argument("--presence-penalty", type=float, default=0.0)
     generate.add_argument("--frequency-penalty", type=float, default=0.0)
@@ -127,6 +129,8 @@ def main(argv=None) -> int:
             "tokens": args.tokens,
             "max_new_tokens": args.max_new_tokens,
             "temperature": args.temperature,
+            "top_p": args.top_p,
+            "min_p": args.min_p,
             "repetition_penalty": args.repetition_penalty,
             "presence_penalty": args.presence_penalty,
             "frequency_penalty": args.frequency_penalty,

@@ -358,33 +358,61 @@ def decode_step(
     return logits[:, -1, :], cache
 
 
-def _validate_truncation(top_k: int, top_p: float, vocab: int) -> None:
+def _validate_truncation(
+    top_k: int, top_p: float, vocab: int, min_p: float = 0.0
+) -> None:
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if top_k < 0 or top_k > vocab:
         raise ValueError(f"top_k must be in [0, vocab={vocab}], got {top_k}")
+    if not 0.0 <= min_p < 1.0:
+        raise ValueError(f"min_p must be in [0, 1), got {min_p}")
 
 
-def truncate_logits(logits, top_k: int = 0, top_p: float = 1.0) -> jax.Array:
+def nucleus_min_p_mask(logits, top_p, min_p) -> jax.Array:
+    """Top-p (nucleus) + min-p masking with PER-ROW ``top_p``/``min_p``
+    (scalars or arrays broadcast over the leading axes) — jit-friendly:
+    dynamic VALUES, static shapes.  min-p keeps tokens whose probability
+    is at least ``min_p`` times the max probability (the modern
+    truncation that adapts to distribution peakedness); the argmax token
+    always survives both masks, so the set is never empty."""
+    rows = logits.shape[:-1]
+    top_p = jnp.broadcast_to(
+        jnp.asarray(top_p, jnp.float32), rows
+    )[..., None]
+    min_p = jnp.broadcast_to(
+        jnp.asarray(min_p, jnp.float32), rows
+    )[..., None]
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    sp = jax.nn.softmax(sorted_desc, axis=-1)
+    # Exclusive cumulative mass: a token is cut iff the mass BEFORE it
+    # already reaches top_p (so the boundary token is kept and the set
+    # is never empty).
+    exclusive = jnp.cumsum(sp, axis=-1) - sp
+    cut = exclusive >= top_p
+    threshold = jnp.min(
+        jnp.where(cut, jnp.inf, sorted_desc), axis=-1, keepdims=True
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    keep = (logits >= threshold) & (
+        probs >= min_p * jnp.max(probs, axis=-1, keepdims=True)
+    )
+    return jnp.where(keep, logits, _NEG_BIG)
+
+
+def truncate_logits(
+    logits, top_k: int = 0, top_p: float = 1.0, min_p: float = 0.0
+) -> jax.Array:
     """Mask logits outside the top-k tokens and/or the top-p (nucleus)
-    probability mass.  ``top_k``/``top_p`` are static (jit-friendly: no
-    data-dependent shapes — truncation is a mask, not a gather)."""
-    _validate_truncation(top_k, top_p, logits.shape[-1])
+    mass and/or below min-p.  All three are static here (the solo path;
+    the serving engine routes per-request values through
+    ``nucleus_min_p_mask``); truncation is a mask, not a gather."""
+    _validate_truncation(top_k, top_p, logits.shape[-1], min_p)
     if top_k:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]  # [b, 1]
         logits = jnp.where(logits < kth, _NEG_BIG, logits)
-    if top_p < 1.0:
-        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_desc, axis=-1)
-        # Exclusive cumulative mass: a token is cut iff the mass BEFORE it
-        # already reaches top_p (so the boundary token is kept and the set
-        # is never empty).
-        exclusive = jnp.cumsum(probs, axis=-1) - probs
-        cut = exclusive >= top_p
-        threshold = jnp.min(
-            jnp.where(cut, jnp.inf, sorted_desc), axis=-1, keepdims=True
-        )
-        logits = jnp.where(logits < threshold, _NEG_BIG, logits)
+    if top_p < 1.0 or min_p > 0.0:
+        logits = nucleus_min_p_mask(logits, top_p, min_p)
     return logits
 
 
@@ -442,15 +470,16 @@ def token_counts(tokens, vocab: int) -> jax.Array:
 
 
 def sample_token(
-    logits, temperature: float, key, top_k: int = 0, top_p: float = 1.0
+    logits, temperature: float, key, top_k: int = 0, top_p: float = 1.0,
+    min_p: float = 0.0,
 ) -> jax.Array:
     """Greedy at temperature 0 (or no key); else categorical over the
     temperature-scaled logits truncated by ``truncate_logits``."""
     if temperature == 0.0 or key is None:
         # Validate the static args even though greedy ignores them.
-        _validate_truncation(top_k, top_p, logits.shape[-1])
+        _validate_truncation(top_k, top_p, logits.shape[-1], min_p)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = truncate_logits(logits / temperature, top_k, top_p)
+    logits = truncate_logits(logits / temperature, top_k, top_p, min_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
@@ -464,6 +493,7 @@ def generate(
     top_k: int = 0,
     top_p: float = 1.0,
     kv_int8: bool = False,
+    min_p: float = 0.0,
     repetition_penalty: float = 1.0,
     presence_penalty: float = 0.0,
     frequency_penalty: float = 0.0,
@@ -499,7 +529,7 @@ def generate(
 
     first = sample_token(
         apply_penalties(logits[:, -1, :], tok_counts, gen_counts, *penals),
-        temperature, first_key, top_k, top_p,
+        temperature, first_key, top_k, top_p, min_p,
     )
     tok_counts = counted(tok_counts, first)
     gen_counts = counted(gen_counts, first)
@@ -509,7 +539,7 @@ def generate(
         logits, cache = decode_step(params, cache, token[:, None], cfg)
         next_token = sample_token(
             apply_penalties(logits, tok_counts, gen_counts, *penals),
-            temperature, step_key, top_k, top_p,
+            temperature, step_key, top_k, top_p, min_p,
         )
         return (
             cache,
@@ -538,6 +568,7 @@ def make_generate_fn(cfg: TransformerConfig):
         partial(generate, cfg=cfg),
         static_argnames=(
             "max_new_tokens", "temperature", "top_k", "top_p", "kv_int8",
+            "min_p",
             "repetition_penalty", "presence_penalty", "frequency_penalty",
         ),
     )

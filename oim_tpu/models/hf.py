@@ -278,9 +278,14 @@ def to_hf_llama(params: dict, cfg: TransformerConfig) -> dict:
     return sd
 
 
-def hf_llama_config_kwargs(cfg: TransformerConfig) -> dict:
+def hf_llama_config_kwargs(
+    cfg: TransformerConfig, max_position_embeddings: int | None = None
+) -> dict:
     """Kwargs for ``transformers.LlamaConfig`` mirroring ``cfg`` — the
-    inverse of ``llama_config`` (rope_scaling tuple → HF dict)."""
+    inverse of ``llama_config`` (rope_scaling tuple → HF dict).
+    ``max_position_embeddings`` should be the context the model was
+    trained/served at; when omitted it derives from rope_scaling
+    (factor × original) or falls back to transformers' default."""
     kwargs = dict(
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.d_model,
@@ -303,8 +308,12 @@ def hf_llama_config_kwargs(cfg: TransformerConfig) -> dict:
             "high_freq_factor": high,
             "original_max_position_embeddings": int(orig),
         }
-        # Without this, the exported config.json inherits transformers'
-        # 2048 default and downstream consumers cap context there
-        # despite the scaling dict implying factor x orig.
-        kwargs["max_position_embeddings"] = int(factor * orig)
+        if max_position_embeddings is None:
+            # Without this, the exported config.json inherits
+            # transformers' 2048 default and downstream consumers cap
+            # context there despite the scaling dict implying
+            # factor x orig.
+            max_position_embeddings = int(factor * orig)
+    if max_position_embeddings is not None:
+        kwargs["max_position_embeddings"] = int(max_position_embeddings)
     return kwargs

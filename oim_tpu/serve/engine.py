@@ -81,6 +81,7 @@ from oim_tpu.models.decode import (
     _moe_exact,
     apply_penalties,
     embed_tokens,
+    nucleus_min_p_mask,
     truncate_logits,
 )
 from oim_tpu.ops.quant import (
@@ -330,24 +331,38 @@ def _hidden_slots(params, tokens, kv, starts, cfg):
     return _rmsnorm(x, params["final_norm"], cfg), tuple(kv)
 
 
-def _sample_batched(logits, temps, keys, top_k, top_p, penalties=None):
+def _sample_batched(
+    logits, temps, keys, top_k, top_ps, min_ps, penalties=None
+):
     """Per-slot temperature sampling with per-slot PRNG keys: greedy
-    where temp == 0, else categorical over temperature-scaled logits with
-    the engine's static top-k/top-p truncation (``truncate_logits`` — the
-    same masking the solo path uses).  ``penalties`` = (rep [S], pres
-    [S], freq [S], tok_counts [S, V], gen_counts [S, V]) pre-adjusts the
-    logits (``apply_penalties``; neutral rows are bit-exact no-ops).
-    Returns ``(tokens [S], logprobs [S])`` — the logprob is the chosen
-    token's log-softmax under the (penalty-adjusted) temperature-1
-    untruncated distribution, the standard scoring convention."""
+    where temp == 0, else categorical over temperature-scaled logits
+    truncated by the engine-static top-k plus PER-SLOT top-p / min-p
+    ([S] arrays — dynamic values, static shapes;
+    ``nucleus_min_p_mask``).  The nucleus/min-p sort only runs when some
+    slot actually truncates (``lax.cond`` — default traffic never pays
+    the [S, V] sort on the decode hot path).  ``penalties`` = (rep [S],
+    pres [S], freq [S], tok_counts [S, V], gen_counts [S, V])
+    pre-adjusts the logits (``apply_penalties``; neutral rows are
+    bit-exact no-ops).  Returns ``(tokens [S], logprobs [S])`` — the
+    logprob is the chosen token's log-softmax under the
+    (penalty-adjusted) temperature-1 untruncated distribution, the
+    standard scoring convention."""
     if penalties is not None:
         rep, pres, freq, tok_counts, gen_counts = penalties
         logits = apply_penalties(
             logits, tok_counts, gen_counts, rep, pres, freq
         )
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # Static top-k through the solo path's truncate_logits (ONE mask
+    # definition); the dynamic per-slot masks follow.
     scaled = truncate_logits(
-        logits / jnp.maximum(temps, 1e-6)[:, None], top_k, top_p
+        logits / jnp.maximum(temps, 1e-6)[:, None], top_k
+    )
+    scaled = jax.lax.cond(
+        jnp.any((top_ps < 1.0) | (min_ps > 0.0)),
+        lambda x: nucleus_min_p_mask(x, top_ps, min_ps),
+        lambda x: x,
+        scaled,
     )
     sampled = jax.vmap(
         lambda key, row: jax.random.categorical(key, row)
@@ -365,8 +380,8 @@ def _sample_batched(logits, temps, keys, top_k, top_p, penalties=None):
 def _admit_batch(
     params, cache: SlotCache, history, tok_counts, gen_counts,
     prompt_counts, full_rows, prompts, slots, starts,
-    true_tails, temps, reps, press, freqs, keys,
-    *, cfg, top_k, top_p, track_history, penalize,
+    true_tails, temps, top_ps, min_ps, reps, press, freqs, keys,
+    *, cfg, top_k, track_history, penalize,
 ):
     """Prefill a whole GROUP of admissions in one dispatch and sample
     each one's first generated token.  Returns
@@ -424,7 +439,7 @@ def _admit_batch(
     if penalize:
         gen_zero = jnp.zeros_like(prompt_counts)
         first, first_lp = _sample_batched(
-            logits, temps, keys, top_k, top_p,
+            logits, temps, keys, top_k, top_ps, min_ps,
             penalties=(reps, press, freqs, prompt_counts, gen_zero),
         )
         onehot = jax.nn.one_hot(
@@ -435,7 +450,9 @@ def _admit_batch(
         )
         gen_counts = gen_counts.at[slots].set(onehot, mode="drop")
     else:
-        first, first_lp = _sample_batched(logits, temps, keys, top_k, top_p)
+        first, first_lp = _sample_batched(
+            logits, temps, keys, top_k, top_ps, min_ps
+        )
     return (
         SlotCache(k_all, v_all, lengths, ks_all, vs_all),
         history,
@@ -474,8 +491,8 @@ def _inject_prefix(cache: SlotCache, entry, slot):
 
 def _decode_chunk(
     params, cache: SlotCache, tok_counts, gen_counts, tokens, temps,
-    reps, press, freqs, active, bases, counts,
-    *, cfg, chunk, top_k, top_p, penalize,
+    top_ps, min_ps, reps, press, freqs, active, bases, counts,
+    *, cfg, chunk, top_k, penalize,
 ):
     """Advance every active slot by ``chunk`` tokens in one dispatch.
 
@@ -501,7 +518,7 @@ def _decode_chunk(
         keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
         if penalize:
             nxt, lp = _sample_batched(
-                logits[:, -1], temps, keys, top_k, top_p,
+                logits[:, -1], temps, keys, top_k, top_ps, min_ps,
                 penalties=(reps, press, freqs, tok_c, gen_c),
             )
             nxt = jnp.where(active, nxt, tok)
@@ -511,7 +528,7 @@ def _decode_chunk(
             tok_c, gen_c = tok_c + upd, gen_c + upd
         else:
             nxt, lp = _sample_batched(
-                logits[:, -1], temps, keys, top_k, top_p
+                logits[:, -1], temps, keys, top_k, top_ps, min_ps
             )
             nxt = jnp.where(active, nxt, tok)
         # Clamp: a slot decoding past its budget inside a chunk (host
@@ -578,8 +595,9 @@ def _draft_lookup(hist, length, draft_len: int, ngram: int, max_len: int):
 
 
 def _decode_chunk_spec(
-    params, cache: SlotCache, history, tokens, temps, active, bases, counts,
-    *, cfg, chunk, draft_len, ngram, top_k, top_p,
+    params, cache: SlotCache, history, tokens, temps, top_ps, min_ps,
+    active, bases, counts,
+    *, cfg, chunk, draft_len, ngram, top_k,
 ):
     """``_decode_chunk`` with in-engine speculative decoding: each of the
     ``chunk`` sub-steps drafts ``draft_len`` tokens per slot by prompt
@@ -634,7 +652,7 @@ def _decode_chunk_spec(
         )
         keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
         samp, samp_lp = _sample_batched(
-            logits[:, 0], temps, keys, top_k, top_p
+            logits[:, 0], temps, keys, top_k, top_ps, min_ps
         )
         is_greedy = temps <= 0.0
         emitted = greedy.at[:, 0].set(
@@ -691,6 +709,12 @@ class GenRequest:
     # set (emitted, like eos_id).  For multi-token stop SEQUENCES do the
     # matching client-side — the engine is tokenizer-agnostic.
     stop_ids: tuple[int, ...] = ()
+    # Per-request truncation: top_p (None → the engine's --top-p
+    # default) and min_p (keep tokens with at least min_p × the max
+    # probability).  Engine top_k stays engine-static (a dynamic k
+    # would be a gather, not a mask).
+    top_p: float | None = None
+    min_p: float = 0.0
     # Sampling penalties (models/decode.py ``apply_penalties``):
     # repetition (HF convention, over prompt+generated; 1.0 = off),
     # presence/frequency (OpenAI convention, over generated; 0.0 = off).
@@ -822,6 +846,13 @@ class Engine:
                 f"speculative mode reserves spec_decode+1 rows): "
                 f"{bad_buckets}"
             )
+        from oim_tpu.models.decode import _validate_truncation
+
+        # An out-of-range engine default (oim-serve --top-p 0.0) must
+        # fail at construction — inside the jitted path it would mask
+        # every logit and sample uniform garbage with no error.
+        _validate_truncation(top_k, top_p, cfg.vocab_size)
+        self.default_top_p = top_p
         self._cache = SlotCache.create(
             cfg, n_slots, max_len, quantized=kv_int8
         )
@@ -854,7 +885,7 @@ class Engine:
                 NamedSharding(mesh, P()),
             )
         self._admit = jax.jit(
-            partial(_admit_batch, cfg=cfg, top_k=top_k, top_p=top_p,
+            partial(_admit_batch, cfg=cfg, top_k=top_k,
                     track_history=bool(spec_decode), penalize=penalties),
             donate_argnums=(1, 2, 3, 4),
         )
@@ -878,13 +909,13 @@ class Engine:
             self._decode = jax.jit(
                 partial(_decode_chunk_spec, cfg=cfg, chunk=chunk,
                         draft_len=spec_decode, ngram=spec_ngram,
-                        top_k=top_k, top_p=top_p),
+                        top_k=top_k),
                 donate_argnums=(1, 2),
             )
         else:
             self._decode = jax.jit(
                 partial(_decode_chunk, cfg=cfg, chunk=chunk, top_k=top_k,
-                        top_p=top_p, penalize=penalties),
+                        penalize=penalties),
                 donate_argnums=(1, 2, 3),
             )
         self.spec_drafted = 0
@@ -982,6 +1013,10 @@ class Engine:
                     if self.spec_decode else ""
                 )
             )
+        if req.top_p is not None and not 0.0 < req.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {req.top_p}")
+        if not 0.0 <= req.min_p < 1.0:
+            raise ValueError(f"min_p must be in [0, 1), got {req.min_p}")
         if req.repetition_penalty <= 0:
             raise ValueError(
                 f"repetition_penalty must be > 0, got "
@@ -1387,6 +1422,8 @@ class Engine:
                 starts = np.zeros((n_slots,), np.int32)
                 tails = np.ones((n_slots,), np.int32)
                 temps = np.zeros((n_slots,), np.float32)
+                top_ps = np.ones((n_slots,), np.float32)
+                min_ps = np.zeros((n_slots,), np.float32)
                 # [1, 1] dummy when penalties are off — _admit_batch
                 # passes the state through untouched (track_history's
                 # dead-transfer discipline).
@@ -1409,6 +1446,10 @@ class Engine:
                     starts[i] = start
                     tails[i] = len(tail)
                     temps[i] = req.temperature
+                    top_ps[i] = (
+                        self.default_top_p if req.top_p is None else req.top_p
+                    )
+                    min_ps[i] = req.min_p
                     if self.penalties:
                         prompt_counts[i] = np.bincount(
                             req.tokens, minlength=self.cfg.vocab_size
@@ -1436,6 +1477,8 @@ class Engine:
                     jnp.asarray(starts),
                     jnp.asarray(tails),
                     jnp.asarray(temps),
+                    jnp.asarray(top_ps),
+                    jnp.asarray(min_ps),
                     jnp.asarray(reps),
                     jnp.asarray(press),
                     jnp.asarray(freqs),
@@ -1505,6 +1548,25 @@ class Engine:
         active = jnp.asarray(
             [i in slots for i in range(n_slots)], bool
         )
+        top_ps = jnp.asarray(
+            [
+                (
+                    self.default_top_p
+                    if slots[i].req.top_p is None
+                    else slots[i].req.top_p
+                )
+                if i in slots else 1.0
+                for i in range(n_slots)
+            ],
+            jnp.float32,
+        )
+        min_ps = jnp.asarray(
+            [
+                slots[i].req.min_p if i in slots else 0.0
+                for i in range(n_slots)
+            ],
+            jnp.float32,
+        )
         zero_key = jax.random.PRNGKey(0)
         bases = jnp.stack(
             [slots[i].base if i in slots else zero_key for i in range(n_slots)]
@@ -1518,7 +1580,7 @@ class Engine:
                 self._cache, self._history, out3, lps3, n_emit
             ) = self._decode(
                 self.params, self._cache, self._history, tokens, temps,
-                active, bases, counts,
+                top_ps, min_ps, active, bases, counts,
             )
             # ONE readback per chunk, speculative or not.
             out3, lps3, n_emit = jax.device_get((out3, lps3, n_emit))
@@ -1550,8 +1612,8 @@ class Engine:
                 self._cache, self._tok_counts, self._gen_counts, out, lps
             ) = self._decode(
                 self.params, self._cache, self._tok_counts,
-                self._gen_counts, tokens, temps, reps, press, freqs,
-                active, bases, counts,
+                self._gen_counts, tokens, temps, top_ps, min_ps,
+                reps, press, freqs, active, bases, counts,
             )
             out, lps = jax.device_get((out, lps))
             if not self._warming:

@@ -9,6 +9,7 @@ API:
   POST /v1/generate   {"tokens": [int...], "max_new_tokens": N,
                        "temperature": 0.0, "seed": 0, "eos_id": null,
                        "stream": false, "logprobs": false,
+                       "top_p": null, "min_p": 0.0,
                        "repetition_penalty": 1.0, "presence_penalty": 0.0,
                        "frequency_penalty": 0.0,
                        "cache_prefix": false, "stop_ids": []}
@@ -234,6 +235,12 @@ class ServeServer:
                         stop_ids=tuple(
                             int(t) for t in body.get("stop_ids", ())
                         ),
+                        top_p=(
+                            float(body["top_p"])
+                            if body.get("top_p") is not None
+                            else None
+                        ),
+                        min_p=float(body.get("min_p", 0.0)),
                         repetition_penalty=float(
                             body.get("repetition_penalty", 1.0)
                         ),

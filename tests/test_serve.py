@@ -1390,3 +1390,73 @@ class TestSamplingPenalties:
             engine.submit(GenRequest(
                 tokens=tokens, max_new_tokens=4, presence_penalty=0.5,
             ))
+
+
+class TestPerRequestTruncation:
+    """Per-request top_p / min_p ([S]-array masks, lax.cond-gated)."""
+
+    def test_min_p_one_is_greedy(self, setup):
+        """min_p ~ 1 keeps only the argmax: a sampled request must emit
+        exactly the greedy continuation — the sharpest truncation
+        exactness check available."""
+        cfg, params = setup
+        engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+        tokens = _prompt(31, 7, cfg.vocab_size)
+        r_greedy = engine.submit(GenRequest(tokens=tokens, max_new_tokens=10))
+        r_minp = engine.submit(GenRequest(
+            tokens=tokens, max_new_tokens=10, temperature=1.3, seed=7,
+            min_p=0.999,
+        ))
+        results = engine.run()
+        assert results[r_minp] == results[r_greedy]
+
+    def test_tiny_top_p_is_greedy(self, setup):
+        cfg, params = setup
+        engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+        tokens = _prompt(32, 6, cfg.vocab_size)
+        r_greedy = engine.submit(GenRequest(tokens=tokens, max_new_tokens=8))
+        r_topp = engine.submit(GenRequest(
+            tokens=tokens, max_new_tokens=8, temperature=1.0, seed=3,
+            top_p=1e-6,
+        ))
+        results = engine.run()
+        assert results[r_topp] == results[r_greedy]
+
+    def test_per_request_values_diverge(self, setup):
+        """Same seed, different top_p: the truncation must be per-slot,
+        not the engine default."""
+        cfg, params = setup
+        engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+        tokens = _prompt(33, 6, cfg.vocab_size)
+        r_wide = engine.submit(GenRequest(
+            tokens=tokens, max_new_tokens=16, temperature=1.5, seed=11,
+        ))
+        r_narrow = engine.submit(GenRequest(
+            tokens=tokens, max_new_tokens=16, temperature=1.5, seed=11,
+            top_p=0.05,
+        ))
+        results = engine.run()
+        assert results[r_wide] != results[r_narrow]
+
+    def test_validation(self, setup):
+        cfg, params = setup
+        engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+        with pytest.raises(ValueError, match="top_p"):
+            engine.submit(GenRequest(tokens=[1], max_new_tokens=1, top_p=0.0))
+        with pytest.raises(ValueError, match="min_p"):
+            engine.submit(GenRequest(tokens=[1], max_new_tokens=1, min_p=1.0))
+
+    def test_solo_min_p_matches_engine_contract(self, setup):
+        """models.decode.generate with min_p ~ 1 equals its own greedy —
+        the solo path shares nucleus_min_p_mask with the engine."""
+        cfg, params = setup
+        tokens = _prompt(34, 7, cfg.vocab_size)
+        prompt = jnp.asarray(tokens, jnp.int32)[None]
+        greedy = generate(params, prompt, cfg, max_new_tokens=8)
+        sampled = generate(
+            params, prompt, cfg, max_new_tokens=8, temperature=1.7,
+            key=jax.random.PRNGKey(5), min_p=0.999,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(greedy), np.asarray(sampled)
+        )
