@@ -82,6 +82,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="n-gram length the prompt-lookup drafter matches on",
     )
     p.add_argument("--n-slots", type=int, default=8)
+    p.add_argument(
+        "--max-queue", type=int, default=64,
+        help="admission queue bound (HTTP 429 beyond it; 0 = unbounded)",
+    )
+    p.add_argument(
+        "--drain-timeout", type=float, default=120.0,
+        help="seconds to let in-flight requests finish on SIGTERM before "
+        "exiting",
+    )
     p.add_argument("--max-len", type=int, default=1024)
     p.add_argument("--chunk", type=int, default=8)
     p.add_argument("--top-k", type=int, default=0)
@@ -234,6 +243,7 @@ def make_engine(args):
         spec_decode=args.spec_decode,
         spec_ngram=args.spec_ngram,
         penalties=not args.no_penalties,
+        max_queue=args.max_queue,
     )
 
 
@@ -288,10 +298,33 @@ def main(argv=None) -> int:
             args.advertise or f"http://{server.host}:{server.port}"
         )
         registration.start()
-    try:
-        import threading
+    import signal
+    import threading
+    import time as _time
 
-        threading.Event().wait()
+    stop_evt = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop_evt.set())
+    try:
+        stop_evt.wait()
+        # Graceful drain: deregister + stop admitting, let in-flight
+        # requests finish (bounded), then exit — a rolling restart never
+        # truncates a client's generation.
+        if registration is not None:
+            registration.stop()
+            registration = None
+        engine.drain()
+        log.current().info(
+            "draining", in_flight=engine.in_flight(),
+            timeout_s=args.drain_timeout,
+        )
+        deadline = _time.monotonic() + args.drain_timeout
+        while engine.in_flight() and _time.monotonic() < deadline:
+            _time.sleep(0.2)
+        # Settle: the last slot frees BEFORE its handler thread finishes
+        # writing the response; exiting on the instant of in_flight()==0
+        # would kill that daemon thread mid-delivery.
+        _time.sleep(min(2.0, args.drain_timeout))
+        log.current().info("drained", remaining=engine.in_flight())
     except KeyboardInterrupt:
         pass
     finally:

@@ -65,6 +65,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field, replace
 from functools import partial
 
@@ -730,6 +731,14 @@ class GenRequest:
     cache_prefix: bool = False
 
 
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity — back off and retry (HTTP 429)."""
+
+
+class DrainingError(RuntimeError):
+    """Engine is draining for shutdown — no new admissions (HTTP 503)."""
+
+
 @dataclass
 class _SlotState:
     rid: int
@@ -774,6 +783,7 @@ class Engine:
         spec_decode: int = 0,
         spec_ngram: int = 2,
         penalties: bool = True,
+        max_queue: int = 0,
     ):
         if n_slots < 1 or max_len < 2 or chunk < 1 or prefix_cache_size < 0:
             raise ValueError(
@@ -852,6 +862,12 @@ class Engine:
         # fail at construction — inside the jitted path it would mask
         # every logit and sample uniform garbage with no error.
         _validate_truncation(top_k, top_p, cfg.vocab_size)
+        if max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
+        # 0 = unbounded (tests, trusted callers).  A bound turns a
+        # flood into immediate backpressure (QueueFullError → HTTP 429)
+        # instead of unbounded host memory + 600 s client timeouts.
+        self.max_queue = max_queue
         self.default_top_p = top_p
         self._cache = SlotCache.create(
             cfg, n_slots, max_len, quantized=kv_int8
@@ -943,6 +959,10 @@ class Engine:
         self._errors: dict[int, str] = {}
         self._callbacks: dict[int, object] = {}  # rid → on_token
         self._forgotten: set[int] = set()
+        self._draining = False
+        # Slot-free work (beam/embed) runs outside the queue machinery
+        # but must still hold off a drain — counted here.
+        self._aux_active = 0
         self._next_rid = 0
         self._step_count = 0
         self.tokens_generated = 0
@@ -1064,6 +1084,19 @@ class Engine:
                 self._m_requests.inc("rejected")
             raise
         with self._lock:
+            if self._draining:
+                if not self._warming:
+                    self._m_requests.inc("rejected")
+                raise DrainingError("engine is draining; not admitting")
+            if (
+                self.max_queue
+                and not self._warming  # warmup's own dummies are exempt
+                and len(self._queue) >= self.max_queue
+            ):
+                self._m_requests.inc("rejected")
+                raise QueueFullError(
+                    f"admission queue full ({self.max_queue}); retry later"
+                )
             rid = self._next_rid
             self._next_rid += 1
             self._queue.append((rid, req, time.monotonic()))
@@ -1073,12 +1106,32 @@ class Engine:
             self._m_queued.set(float(len(self._queue)), self._engine_label)
         return rid
 
+    @contextmanager
+    def _aux_request(self):
+        """Drain-aware guard for slot-free work (beam/embed): rejected
+        while draining, counted in ``in_flight`` while running."""
+        with self._lock:
+            if self._draining:
+                if not self._warming:
+                    self._m_requests.inc("rejected")
+                raise DrainingError("engine is draining; not admitting")
+            self._aux_active += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._aux_active -= 1
+
     def embed(self, tokens: list[int]) -> list[float]:
         """Mean-pooled, L2-normalized final hidden state of ``tokens`` —
         the embeddings surface (models.decode.embed_tokens).  Stateless
         and slot-free: safe to call from any thread concurrently with the
         decode loop (it touches neither the cache nor the queue); one
         compile per prompt bucket, absorbed by ``warmup``."""
+        with self._aux_request():
+            return self._embed_inner(tokens)
+
+    def _embed_inner(self, tokens: list[int]) -> list[float]:
         self._validate(
             GenRequest(tokens=tokens, max_new_tokens=1)
         )
@@ -1125,6 +1178,14 @@ class Engine:
         total crosses ``_MAX_BEAM_TRACES`` the cache is cleared, so a
         client sweeping shapes costs recompiles, never unbounded memory.
         """
+        with self._aux_request():
+            return self._beam_inner(
+                tokens, max_new_tokens, beam_size, alpha, eos_id
+            )
+
+    def _beam_inner(
+        self, tokens, max_new_tokens, beam_size, alpha, eos_id
+    ) -> tuple[list[int], float]:
         import math
 
         if not tokens:
@@ -1672,6 +1733,26 @@ class Engine:
             return {
                 rid: list(toks) for rid, (toks, _) in self._results.items()
             }
+
+    def drain(self) -> None:
+        """Stop admitting (submit raises ``DrainingError``); already
+        queued and active requests run to completion.  The graceful-
+        shutdown half-step: the server calls this on SIGTERM, waits for
+        in-flight work, then stops — an orchestrator rolling the
+        deployment never truncates a client's generation."""
+        with self._lock:
+            self._draining = True
+
+    def in_flight(self) -> int:
+        """Queued + admitting + active + slot-free (beam/embed) request
+        count — what a drain waits on."""
+        with self._lock:
+            return (
+                len(self._queue)
+                + len(self._admitting)
+                + len(self._slots)
+                + self._aux_active
+            )
 
     def warmup(self, embed: bool = False) -> "Engine":
         """Pre-compile every admit bucket and the whole chunk ladder.

@@ -49,7 +49,12 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu.common import metrics, tracing
-from oim_tpu.serve.engine import Engine, GenRequest
+from oim_tpu.serve.engine import (
+    DrainingError,
+    Engine,
+    GenRequest,
+    QueueFullError,
+)
 
 
 class ServeServer:
@@ -102,9 +107,18 @@ class ServeServer:
                 client that disconnects mid-stream forfeits the result
                 (engine.forget) — generation itself runs to completion."""
                 tokens_q: queue.Queue = queue.Queue()
-                rid = outer.engine.submit(
-                    req, on_token=lambda t, lp: tokens_q.put((t, lp))
-                )
+                try:
+                    rid = outer.engine.submit(
+                        req, on_token=lambda t, lp: tokens_q.put((t, lp))
+                    )
+                except QueueFullError as exc:
+                    span.status = "error: queue full"
+                    self._json(429, {"error": str(exc)})
+                    return
+                except DrainingError as exc:
+                    span.status = "error: draining"
+                    self._json(503, {"error": str(exc)})
+                    return
                 try:
                     # Headers inside the try: wfile is unbuffered, so a
                     # client that disconnected right away raises HERE —
@@ -262,6 +276,14 @@ class ServeServer:
                         self._stream(req, span)
                         return
                     rid = outer.engine.submit(req)
+                except QueueFullError as exc:
+                    span.status = "error: queue full"
+                    self._json(429, {"error": str(exc)})
+                    return
+                except DrainingError as exc:
+                    span.status = "error: draining"
+                    self._json(503, {"error": str(exc)})
+                    return
                 except (KeyError, TypeError, ValueError) as exc:
                     span.status = "error: bad request"
                     self._json(400, {"error": str(exc)})

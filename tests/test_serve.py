@@ -1460,3 +1460,52 @@ class TestPerRequestTruncation:
         np.testing.assert_array_equal(
             np.asarray(greedy), np.asarray(sampled)
         )
+
+
+class TestBackpressureAndDrain:
+    def test_queue_bound_rejects(self, setup):
+        from oim_tpu.serve.engine import QueueFullError
+
+        cfg, params = setup
+        engine = Engine(
+            params, cfg, n_slots=2, max_len=64, chunk=4, max_queue=2,
+        )
+        for seed in range(2):
+            engine.submit(GenRequest(
+                tokens=_prompt(seed, 5, cfg.vocab_size), max_new_tokens=4,
+            ))
+        with pytest.raises(QueueFullError, match="retry"):
+            engine.submit(GenRequest(tokens=[1, 2], max_new_tokens=2))
+        # The queued work still completes normally.
+        results = engine.run()
+        assert len(results) == 2
+
+    def test_drain_stops_admissions_finishes_in_flight(self, setup):
+        from oim_tpu.serve.engine import DrainingError
+
+        cfg, params = setup
+        engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+        tokens = _prompt(41, 6, cfg.vocab_size)
+        rid = engine.submit(GenRequest(tokens=tokens, max_new_tokens=8))
+        engine.drain()
+        with pytest.raises(DrainingError):
+            engine.submit(GenRequest(tokens=[1], max_new_tokens=1))
+        results = engine.run()
+        assert results[rid] == _oracle(params, cfg, tokens, 8)
+        assert engine.in_flight() == 0
+
+    def test_invalid_max_queue_rejected(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="max_queue"):
+            Engine(params, cfg, n_slots=2, max_len=64, max_queue=-1)
+
+    def test_drain_rejects_beam_and_embed(self, setup):
+        from oim_tpu.serve.engine import DrainingError
+
+        cfg, params = setup
+        engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+        engine.drain()
+        with pytest.raises(DrainingError):
+            engine.embed([1, 2, 3])
+        with pytest.raises(DrainingError):
+            engine.beam([1, 2, 3], max_new_tokens=4)
