@@ -53,6 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-top-k", type=int, default=1)
     p.add_argument("--rope-theta", type=float, default=10000.0)
     p.add_argument(
+        "--sliding-window", type=int, default=0,
+        help="sliding-window attention: each token attends the last N "
+        "positions (0 = full causal); train-side only",
+    )
+    p.add_argument(
         "--doc-sep-id", type=int, default=-1,
         help="sequence packing: treat this token id as a document "
         "separator (attention masked to same-document pairs, boundary "
@@ -245,6 +250,7 @@ def main(argv=None) -> int:
         rope_theta=args.rope_theta,
         rope_scaling=tuple(args.rope_scaling),
         norm_eps=args.norm_eps,
+        sliding_window=args.sliding_window,
         doc_sep_id=args.doc_sep_id,
         n_stages=args.pp,
         n_microbatches=max(args.n_microbatches, 1),

@@ -343,6 +343,11 @@ def prefill(
     capacity ``max_len`` holding the prompt's K/V (int8-quantized per
     token/head when ``kv_int8`` — half the cache bandwidth decode pays).
     """
+    if cfg.sliding_window:
+        raise ValueError(
+            "sliding-window decode needs a rolling KV cache (not yet "
+            "implemented); train-side SWA only"
+        )
     b, t = tokens.shape
     if t > max_len:
         raise ValueError(f"prompt length {t} exceeds max_len {max_len}")

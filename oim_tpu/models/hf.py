@@ -47,7 +47,13 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
     if get("attention_bias", False) or get("mlp_bias", False):
         raise ValueError("projection biases are not supported")
     if get("sliding_window", None):
-        raise ValueError("sliding-window attention is not supported")
+        # Train-side SWA exists (cfg.sliding_window) but serving does
+        # not (no rolling KV cache yet) — importing would produce a
+        # checkpoint this framework cannot serve faithfully.
+        raise ValueError(
+            "sliding-window checkpoints are not importable yet "
+            "(train-side SWA only; serving needs a rolling KV cache)"
+        )
     scaling = get("rope_scaling", None)
     rope_scaling = ()
     if scaling:
@@ -231,6 +237,13 @@ def to_hf_llama(params: dict, cfg: TransformerConfig) -> dict:
     """
     if cfg.n_experts:
         raise ValueError("MoE export is not supported (dense Llama only)")
+    if cfg.sliding_window:
+        # Mirror of the import guard: the exported config would claim
+        # full attention over windowed-trained weights.
+        raise ValueError(
+            "sliding-window models are not exportable yet (the HF "
+            "config would misdescribe the attention pattern)"
+        )
     h, kvh, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     sd: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np.asarray(

@@ -102,6 +102,13 @@ class TransformerConfig:
     # "1f1b" (interleaved fwd/bwd, min(M, 2S-1) in-flight activations and
     # per-microbatch loss head — see parallel/pipeline.py).
     pp_schedule: str = "gpipe"
+    # Sliding-window attention (Mistral-style): each query attends the
+    # last `sliding_window` positions (0 = full causal attention).
+    # TRAIN-SIDE support: flash skips out-of-window blocks (O(T·W)),
+    # ring/ulysses mask in global positions.  The decode/serving paths
+    # reject windowed configs until a rolling KV cache lands — better
+    # loud than silently serving full-attention numerics.
+    sliding_window: int = 0
     # Sequence packing: >= 0 marks this token id as a document separator
     # (BOS-style: the separator belongs to the document it opens).
     # Attention is masked to same-document pairs (flash/ring/ulysses all
@@ -152,6 +159,10 @@ class TransformerConfig:
             raise ValueError(
                 f"moe_top_k={self.moe_top_k} must be in "
                 f"[1, n_experts={self.n_experts}]"
+            )
+        if self.sliding_window < 0:
+            raise ValueError(
+                f"sliding_window={self.sliding_window} must be >= 0"
             )
         if self.doc_sep_id >= 0:
             if self.doc_sep_id >= self.vocab_size:
@@ -333,17 +344,21 @@ def _attention(x, lp, positions, cfg: TransformerConfig, sp_size,
                 v = jnp.repeat(v, h // kvh, axis=2)
             out = ulysses_attention(
                 q, k, v, "sp", causal=True, use_flash=cfg.use_pallas,
-                segments=segments,
+                segments=segments, window=cfg.sliding_window,
             )
         else:  # "ring" (validated in __post_init__)
             # The ring carries kv-sized blocks natively: GQA divides the
             # rotation traffic by n_heads/n_kv_heads.
             out = ring_attention(q, k, v, "sp", causal=True,
-                                 segments=segments)
+                                 segments=segments,
+                                 window=cfg.sliding_window)
     elif cfg.use_pallas:
-        out = flash_attention(q, k, v, True, segments=segments)
+        out = flash_attention(q, k, v, True, window=cfg.sliding_window,
+                              segments=segments)
     else:
-        out = reference_attention(q, k, v, True, segments)
+        out = reference_attention(
+            q, k, v, True, segments, cfg.sliding_window
+        )
     out = out.reshape(b, t, h * hd)
     return x + jnp.einsum("btn,nd->btd", out, lp["wo"]).astype(x.dtype)
 

@@ -32,11 +32,14 @@ _LANES = 128
 _ROW_LANES = 8
 
 
-def reference_attention(q, k, v, causal: bool = True, segments=None):
+def reference_attention(
+    q, k, v, causal: bool = True, segments=None, window: int = 0
+):
     """O(T²) oracle.  Supports grouped-query attention: k/v may carry
     fewer heads than q (H % KVH == 0); they are broadcast per group.
     ``segments`` [B, T] int restricts attention to same-segment pairs
-    (sequence packing: tokens never attend across document boundaries)."""
+    (sequence packing); ``window`` > 0 restricts each query to the last
+    ``window`` positions (sliding-window attention, causal only)."""
     d = q.shape[-1]
     if k.shape[2] != q.shape[2]:
         group = q.shape[2] // k.shape[2]
@@ -48,7 +51,13 @@ def reference_attention(q, k, v, causal: bool = True, segments=None):
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        if window:
+            mask &= (
+                jnp.arange(tq)[:, None] - jnp.arange(tk)[None, :] < window
+            )
         scores = jnp.where(mask, scores, _NEG_BIG)
+    elif window:
+        raise ValueError("sliding window requires causal attention")
     if segments is not None:
         same = segments[:, :, None] == segments[:, None, :]  # [B, Tq, Tk]
         scores = jnp.where(same[:, None, :, :], scores, _NEG_BIG)
@@ -66,14 +75,17 @@ def _lanes(x, n):
     return pltpu.repeat(x, n // _LANES, axis=1)
 
 
-def _causal_mask(scores, qi, ki, block_q, block_k):
+def _causal_mask(scores, qi, ki, block_q, block_k, window=0):
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, scores.shape, 0
     )
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, scores.shape, 1
     )
-    return jnp.where(q_pos >= k_pos, scores, _NEG_BIG)
+    keep = q_pos >= k_pos
+    if window:
+        keep &= q_pos - k_pos < window
+    return jnp.where(keep, scores, _NEG_BIG)
 
 
 def _segment_mask(scores, segq_ref, segk_ref):
@@ -87,7 +99,7 @@ def _segment_mask(scores, segq_ref, segk_ref):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, *rest,
-    causal, scale, block_q, block_k, segmented=False,
+    causal, scale, block_q, block_k, segmented=False, window=0,
 ):
     if segmented:
         segq_ref, segk_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
@@ -103,11 +115,16 @@ def _fwd_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Blocks strictly above the causal diagonal contribute nothing: skip the
-    # compute (their DMA is wasted bandwidth but the MXU work dominates).
+    # Blocks strictly above the causal diagonal contribute nothing: skip
+    # the compute (their DMA is wasted bandwidth but the MXU work
+    # dominates).  A sliding window also skips blocks entirely BELOW it
+    # (q_min - k_max >= window) — at T >> window this is where the
+    # O(T·W) cost comes from.
     relevant = (
         ki * block_k <= qi * block_q + block_q - 1 if causal else ki >= 0
     )
+    if causal and window:
+        relevant &= qi * block_q - (ki * block_k + block_k - 1) < window
 
     @pl.when(relevant)
     def _compute():
@@ -118,7 +135,7 @@ def _fwd_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if causal:
-            scores = _causal_mask(scores, qi, ki, block_q, block_k)
+            scores = _causal_mask(scores, qi, ki, block_q, block_k, window)
         if segmented:
             scores = _segment_mask(scores, segq_ref, segk_ref)
         m_prev, l_prev = m_scr[...], l_scr[...]
@@ -145,7 +162,7 @@ def _fwd_kernel(
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    causal, scale, block_q, block_k, segmented=False,
+    causal, scale, block_q, block_k, segmented=False, window=0,
 ):
     if segmented:
         segq_ref, segk_ref, dq_ref, dq_scr = rest
@@ -161,6 +178,8 @@ def _dq_kernel(
     relevant = (
         ki * block_k <= qi * block_q + block_q - 1 if causal else ki >= 0
     )
+    if causal and window:
+        relevant &= qi * block_q - (ki * block_k + block_k - 1) < window
 
     @pl.when(relevant)
     def _compute():
@@ -174,7 +193,7 @@ def _dq_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if causal:
-            scores = _causal_mask(scores, qi, ki, block_q, block_k)
+            scores = _causal_mask(scores, qi, ki, block_q, block_k, window)
         if segmented:
             scores = _segment_mask(scores, segq_ref, segk_ref)
         p = jnp.exp(scores - lse)                 # recomputed prob block
@@ -193,7 +212,7 @@ def _dq_kernel(
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    causal, scale, block_q, block_k, n_q, segmented=False,
+    causal, scale, block_q, block_k, n_q, segmented=False, window=0,
 ):
     if segmented:
         segq_ref, segk_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
@@ -215,6 +234,8 @@ def _dkv_kernel(
     relevant = (
         qi * block_q + block_q - 1 >= ki * block_k if causal else j >= 0
     )
+    if causal and window:
+        relevant &= qi * block_q - (ki * block_k + block_k - 1) < window
 
     @pl.when(relevant)
     def _compute():
@@ -228,7 +249,7 @@ def _dkv_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if causal:
-            scores = _causal_mask(scores, qi, ki, block_q, block_k)
+            scores = _causal_mask(scores, qi, ki, block_q, block_k, window)
         if segmented:
             scores = _segment_mask(scores, segq_ref, segk_ref)
         p = jnp.exp(scores - lse)
@@ -275,20 +296,23 @@ def _auto_block(t: int, want: int):
     return None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(
     q, k, v, causal: bool = True, block_q: int = 0, block_k: int = 0,
-    segments=None,
+    window: int = 0, segments=None,
 ):
     """Attention over [B, T, H, D] with blockwise online softmax.
 
     ``block_q``/``block_k`` of 0 auto-tune: measured on v5e, (512, 1024)
     blocks are ~6x faster than (128, 128) at T=8192 (bigger tiles amortize
     the per-block DMA + relayout overhead; VMEM still fits comfortably).
+    ``window`` > 0 is sliding-window attention (causal only): each query
+    sees the last ``window`` keys, and blocks fully below the window are
+    SKIPPED — O(T·W) compute instead of O(T²/2).
     ``segments`` [B, T] int masks attention to same-segment pairs
     (sequence packing); it rides the kernels as [*, 8]-lane tiles.
     """
-    out, _ = _forward(q, k, v, causal, block_q, block_k, segments)
+    out, _ = _forward(q, k, v, causal, block_q, block_k, window, segments)
     return out
 
 
@@ -326,14 +350,18 @@ def _seg_tiles(segments):
     return rows, cols
 
 
-def _forward(q, k, v, causal, block_q, block_k, segments=None):
+def _forward(q, k, v, causal, block_q, block_k, window=0, segments=None):
     b, t, h, d = q.shape
     group = _gqa_group(q, k)
     blocks = _resolve_blocks(t, block_q, block_k)
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
     if blocks is None:
         # Ragged tails: fall back to the reference (bench shapes are
         # block-aligned; correctness everywhere beats a padded kernel).
-        return reference_attention(q, k, v, causal, segments), None
+        return (
+            reference_attention(q, k, v, causal, segments, window), None
+        )
     block_q, block_k = blocks
     scale = 1.0 / (d**0.5)
     qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
@@ -363,7 +391,7 @@ def _forward(q, k, v, causal, block_q, block_k, segments=None):
         functools.partial(
             _fwd_kernel, causal=causal, scale=scale,
             block_q=block_q, block_k=block_k,
-            segmented=segments is not None,
+            segmented=segments is not None, window=window,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
@@ -387,8 +415,8 @@ def _forward(q, k, v, causal, block_q, block_k, segments=None):
     return _heads_last(out, b, h), lse
 
 
-def _fwd(q, k, v, causal, block_q, block_k, segments=None):
-    out, lse = _forward(q, k, v, causal, block_q, block_k, segments)
+def _fwd(q, k, v, causal, block_q, block_k, window=0, segments=None):
+    out, lse = _forward(q, k, v, causal, block_q, block_k, window, segments)
     return out, (q, k, v, out, lse, segments)
 
 
@@ -401,12 +429,12 @@ def _seg_grad(segments):
     return np.zeros(segments.shape, jax.dtypes.float0)
 
 
-def _bwd(causal, block_q, block_k, residuals, g):
+def _bwd(causal, block_q, block_k, window, residuals, g):
     q, k, v, out, lse, segments = residuals
     if lse is None:  # ragged forward fell back to the reference formula
         _, vjp = jax.vjp(
             lambda q, k, v: reference_attention(
-                q, k, v, causal, segments
+                q, k, v, causal, segments, window
             ),
             q, k, v,
         )
@@ -426,7 +454,10 @@ def _bwd(causal, block_q, block_k, residuals, g):
     ).transpose(0, 2, 1).reshape(bh, t)
     delta = jnp.broadcast_to(delta[..., None], (bh, t, _ROW_LANES))
 
-    common = dict(causal=causal, scale=scale, block_q=block_q, block_k=block_k)
+    common = dict(
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        window=window,
+    )
     # GQA: q-head row g reads kv-head row kv_row(g) (group size 1 = MHA).
     kv_row = _kv_row_map(h, kvh)
     qspec = pl.BlockSpec((1, block_q, d), lambda g_, qi, ki: (g_, qi, 0))

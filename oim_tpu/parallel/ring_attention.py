@@ -26,7 +26,7 @@ _NEG_BIG = -1e30  # finite "-inf": keeps fully-masked rows NaN-free
 
 def _block_attention(
     q, k, v, m, l, o, q_offset, k_offset, causal, scale,
-    seg_q=None, seg_k=None,
+    seg_q=None, seg_k=None, window=0,
 ):
     """One flash-style accumulation step of local q against one k/v block.
 
@@ -41,7 +41,10 @@ def _block_attention(
         tq, tk = q.shape[1], k.shape[1]
         q_pos = q_offset + jnp.arange(tq)[:, None]
         k_pos = k_offset + jnp.arange(tk)[None, :]
-        scores = jnp.where(q_pos >= k_pos, scores, _NEG_BIG)
+        keep = q_pos >= k_pos
+        if window:
+            keep &= q_pos - k_pos < window
+        scores = jnp.where(keep, scores, _NEG_BIG)
     if seg_q is not None:
         same = seg_q[:, :, None] == seg_k[:, None, :]  # [B, Tq, Tk]
         scores = jnp.where(same[:, None, None], scores, _NEG_BIG)
@@ -62,6 +65,7 @@ def ring_attention(
     axis_name: str,
     causal: bool = True,
     segments: jax.Array | None = None,
+    window: int = 0,
 ) -> jax.Array:
     """Exact attention over a ring of sequence shards.
 
@@ -74,9 +78,15 @@ def ring_attention(
       segments: local ``[batch, seq_local]`` segment-id shard (sequence
         packing) — rotates around the ring with its k/v block so
         cross-document pairs are masked across shard boundaries too.
+      window: sliding-window attention (each query sees the last
+        ``window`` global positions; causal only).  The ring still
+        rotates every block — correctness first; skipping out-of-window
+        hops is a future optimization.
 
     Returns the local output shard ``[batch, seq_local, heads, head_dim]``.
     """
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
     size = jax.lax.axis_size(axis_name)
     index = jax.lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
@@ -114,7 +124,7 @@ def ring_attention(
         k_offset = k_owner * t_local
         m, l, o = _block_attention(
             qf, k_blk, v_blk, m, l, o, q_offset, k_offset, causal, scale,
-            seg_local, seg_blk,
+            seg_local, seg_blk, window,
         )
         # Rotate k/v one hop around the ring (neighbor traffic on ICI).
         perm = [(i, (i + 1) % size) for i in range(size)]
@@ -142,7 +152,8 @@ __all__ = ["reference_attention", "ring_attention", "ring_attention_sharded"]
 
 
 def ring_attention_sharded(
-    q, k, v, mesh, causal: bool = True, rules=None, segments=None
+    q, k, v, mesh, causal: bool = True, rules=None, segments=None,
+    window: int = 0,
 ):
     """Convenience wrapper: global arrays in, global arrays out, with the
     sequence dimension sharded over ``sp`` and batch over ``dp``
@@ -152,7 +163,8 @@ def ring_attention_sharded(
     spec = P("dp", "sp", None, None)
     if segments is None:
         fn = jax.shard_map(
-            partial(ring_attention, axis_name="sp", causal=causal),
+            partial(ring_attention, axis_name="sp", causal=causal,
+                    window=window),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
@@ -160,7 +172,7 @@ def ring_attention_sharded(
         return fn(q, k, v)
     fn = jax.shard_map(
         lambda q_, k_, v_, s_: ring_attention(
-            q_, k_, v_, "sp", causal=causal, segments=s_
+            q_, k_, v_, "sp", causal=causal, segments=s_, window=window
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec, P("dp", "sp")),

@@ -45,6 +45,7 @@ def ulysses_attention(
     causal: bool = True,
     use_flash: bool = True,
     segments: jax.Array | None = None,
+    window: int = 0,
 ) -> jax.Array:
     """Exact attention over sequence shards via all-to-all resharding.
 
@@ -64,10 +65,11 @@ def ulysses_attention(
     """
     size = jax.lax.axis_size(axis_name)
     if size == 1:
-        attn = flash_attention if use_flash else reference_attention
         if use_flash:
-            return attn(q, k, v, causal, segments=segments)
-        return attn(q, k, v, causal, segments)
+            return flash_attention(
+                q, k, v, causal, window=window, segments=segments
+            )
+        return reference_attention(q, k, v, causal, segments, window)
     heads = q.shape[2]
     if heads % size != 0:
         raise ValueError(
@@ -100,17 +102,21 @@ def ulysses_attention(
         )
     )
 
-    attn = flash_attention if use_flash else reference_attention
     if use_flash:
-        o_full = attn(q_full, k_full, v_full, causal, segments=seg_full)
+        o_full = flash_attention(
+            q_full, k_full, v_full, causal, window=window,
+            segments=seg_full,
+        )
     else:
-        o_full = attn(q_full, k_full, v_full, causal, seg_full)
+        o_full = reference_attention(
+            q_full, k_full, v_full, causal, seg_full, window
+        )
 
     return heads_to_seq(o_full)
 
 
 def ulysses_attention_sharded(
-    q, k, v, mesh, causal: bool = True, segments=None
+    q, k, v, mesh, causal: bool = True, segments=None, window: int = 0
 ):
     """Convenience wrapper: global arrays in, global arrays out, sequence
     sharded over ``sp`` and batch over ``dp`` (mirror of
@@ -120,7 +126,8 @@ def ulysses_attention_sharded(
     spec = P("dp", "sp", None, None)
     if segments is None:
         fn = jax.shard_map(
-            partial(ulysses_attention, axis_name="sp", causal=causal),
+            partial(ulysses_attention, axis_name="sp", causal=causal,
+                    window=window),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
@@ -128,7 +135,7 @@ def ulysses_attention_sharded(
         return fn(q, k, v)
     fn = jax.shard_map(
         lambda q_, k_, v_, s_: ulysses_attention(
-            q_, k_, v_, "sp", causal=causal, segments=s_
+            q_, k_, v_, "sp", causal=causal, segments=s_, window=window
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec, P("dp", "sp")),

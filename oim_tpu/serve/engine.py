@@ -791,6 +791,14 @@ class Engine:
                 f"prefix_cache_size>=0; got {n_slots}, {max_len}, {chunk}, "
                 f"{prefix_cache_size}"
             )
+        if cfg.sliding_window:
+            # The slot cache is full-length; serving a sliding-window-
+            # trained model with it would silently run full-attention
+            # numerics over windowed-trained weights.
+            raise ValueError(
+                "sliding-window serving needs a rolling KV cache (not "
+                "yet implemented); train-side SWA only"
+            )
         if spec_decode < 0 or (spec_decode and spec_ngram < 1):
             raise ValueError(
                 f"need spec_decode>=0 and spec_ngram>=1; got "

@@ -71,7 +71,7 @@ class TestSegmentedFlash:
     @pytest.mark.parametrize("causal", [True, False])
     def test_forward_matches_oracle(self, causal):
         q, k, v, seg = self._data()
-        out = flash_attention(q, k, v, causal, 128, 128, seg)
+        out = flash_attention(q, k, v, causal, 128, 128, segments=seg)
         ref = reference_attention(q, k, v, causal, seg)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
@@ -86,7 +86,7 @@ class TestSegmentedFlash:
             return vjp(g)
 
         got = run(
-            lambda a, b_, c: flash_attention(a, b_, c, True, 128, 128, seg)
+            lambda a, b_, c: flash_attention(a, b_, c, True, 128, 128, segments=seg)
         )
         want = run(
             lambda a, b_, c: reference_attention(a, b_, c, True, seg)
@@ -99,7 +99,7 @@ class TestSegmentedFlash:
 
     def test_gqa_segments(self):
         q, k, v, seg = self._data(h=4, kvh=2, seed=2)
-        out = flash_attention(q, k, v, True, 128, 128, seg)
+        out = flash_attention(q, k, v, True, 128, 128, segments=seg)
         ref = reference_attention(q, k, v, True, seg)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5

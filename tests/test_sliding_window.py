@@ -1,0 +1,224 @@
+"""Sliding-window attention (train-side): kernels vs oracle, SPMD paths.
+
+Window semantics: causal AND ``q_pos - k_pos < window`` — each query
+sees the last ``window`` positions including itself.  Decode/serving
+reject windowed configs (no rolling KV cache yet); the train path is
+the supported surface.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.models.train import _local_loss
+from oim_tpu.models.transformer import manual_pspecs
+from oim_tpu.ops import flash_attention, reference_attention
+from oim_tpu.parallel import build_mesh
+from oim_tpu.parallel.ring_attention import ring_attention_sharded
+from oim_tpu.parallel.ulysses import ulysses_attention_sharded
+
+
+def _qkv(b=2, t=256, h=2, kvh=2, d=32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(ks[0], (b, t, h, d)),
+        jax.random.normal(ks[1], (b, t, kvh, d)),
+        jax.random.normal(ks[2], (b, t, kvh, d)),
+    )
+
+
+class TestWindowedFlash:
+    @pytest.mark.parametrize("window", [64, 100, 200])
+    def test_forward_matches_oracle(self, window):
+        """Windows at, under, and across block boundaries (blocks 128):
+        the block-skip condition and the in-block mask must agree with
+        the O(T²) oracle."""
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, True, 128, 128, window)
+        ref = reference_attention(q, k, v, True, window=window)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_backward_matches_oracle(self):
+        q, k, v = _qkv(seed=1)
+        g = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+        def run(attn):
+            _, vjp = jax.vjp(lambda q_, k_, v_: attn(q_, k_, v_), q, k, v)
+            return vjp(g)
+
+        got = run(lambda a, b, c: flash_attention(a, b, c, True, 128, 128, 100))
+        want = run(
+            lambda a, b, c: reference_attention(a, b, c, True, window=100)
+        )
+        for name, x, y in zip("qkv", got, want):
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-4, atol=1e-4,
+                err_msg=f"d{name}",
+            )
+
+    def test_window_at_least_t_equals_full(self):
+        q, k, v = _qkv(seed=2)
+        windowed = flash_attention(q, k, v, True, 128, 128, q.shape[1])
+        full = flash_attention(q, k, v, True, 128, 128)
+        np.testing.assert_array_equal(
+            np.asarray(windowed), np.asarray(full)
+        )
+
+    def test_gqa_window(self):
+        q, k, v = _qkv(h=4, kvh=2, seed=3)
+        out = flash_attention(q, k, v, True, 128, 128, 96)
+        ref = reference_attention(q, k, v, True, window=96)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_window_composes_with_segments(self):
+        q, k, v = _qkv(seed=4)
+        seg = jnp.cumsum(
+            jax.random.bernoulli(
+                jax.random.PRNGKey(5), 0.02, q.shape[:2]
+            ).astype(jnp.int32),
+            axis=1,
+        )
+        out = flash_attention(q, k, v, True, 128, 128, 80, seg)
+        ref = reference_attention(q, k, v, True, seg, 80)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_noncausal_window_rejected(self):
+        q, k, v = _qkv(seed=6)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, False, 128, 128, 64)
+
+
+class TestWindowedSPMD:
+    def test_ring_matches_global_oracle(self):
+        mesh = build_mesh(dp=2, sp=4)
+        q, k, v = _qkv(t=32, h=4, kvh=4, d=16, seed=7)
+        out = ring_attention_sharded(q, k, v, mesh, window=10)
+        ref = reference_attention(q, k, v, True, window=10)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_ulysses_matches_global_oracle(self):
+        mesh = build_mesh(sp=4)
+        q, k, v = _qkv(t=32, h=4, kvh=4, d=16, seed=8)
+        out = ulysses_attention_sharded(q, k, v, mesh, window=10)
+        ref = reference_attention(q, k, v, True, window=10)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+class TestWindowedTraining:
+    def _cfg(self, **kw):
+        base = dict(
+            vocab_size=101, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+            dtype="float32", sliding_window=8,
+        )
+        base.update(kw)
+        return TransformerConfig(**base)
+
+    def _ce(self, params, tokens, cfg, mesh=None):
+        mesh = mesh or build_mesh(devices=jax.devices()[:1])
+        _, ce = jax.jit(
+            jax.shard_map(
+                lambda p, t: _local_loss(p, t, cfg),
+                mesh=mesh,
+                in_specs=(manual_pspecs(cfg), P("dp", "sp")),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )(params, jnp.asarray(tokens))
+        return float(ce)
+
+    def test_pallas_and_reference_paths_agree(self):
+        cfg_k = self._cfg(use_pallas=True)
+        cfg_r = self._cfg(use_pallas=False)
+        params = init_params(jax.random.PRNGKey(0), cfg_k)
+        tokens = np.arange(2 * 32).reshape(2, 32) % 101
+        np.testing.assert_allclose(
+            self._ce(params, tokens, cfg_k),
+            self._ce(params, tokens, cfg_r),
+            rtol=2e-5,
+        )
+
+    def test_window_changes_the_loss(self):
+        """The mask must actually restrict context: windowed CE differs
+        from full-attention CE on the same weights."""
+        cfg_w = self._cfg(use_pallas=False)
+        cfg_full = self._cfg(use_pallas=False, sliding_window=0)
+        params = init_params(jax.random.PRNGKey(1), cfg_w)
+        tokens = np.arange(2 * 32).reshape(2, 32) % 101
+        assert (
+            abs(
+                self._ce(params, tokens, cfg_w)
+                - self._ce(params, tokens, cfg_full)
+            )
+            > 1e-4
+        )
+
+    def test_sharded_matches_solo(self):
+        cfg = self._cfg(use_pallas=False)
+        params = init_params(jax.random.PRNGKey(2), cfg)
+        tokens = np.arange(2 * 32).reshape(2, 32) % 101
+        mesh = build_mesh(dp=2, sp=2)
+        np.testing.assert_allclose(
+            self._ce(params, tokens, cfg, mesh=mesh),
+            self._ce(params, tokens, cfg),
+            rtol=2e-5,
+        )
+
+    def test_decode_rejects_window(self):
+        from oim_tpu.models.decode import prefill
+
+        cfg = self._cfg()
+        params = init_params(jax.random.PRNGKey(3), cfg)
+        with pytest.raises(ValueError, match="rolling"):
+            prefill(params, jnp.zeros((1, 4), jnp.int32), cfg, 8)
+
+    def test_negative_window_rejected(self):
+        with pytest.raises(ValueError, match="sliding_window"):
+            self._cfg(sliding_window=-1)
+
+
+class TestWindowGuards:
+    """Every path that would silently run full attention over windowed-
+    trained weights must refuse instead."""
+
+    def test_engine_rejects_window(self):
+        from oim_tpu.serve import Engine
+
+        cfg = TransformerConfig(
+            vocab_size=101, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+            dtype="float32", sliding_window=8,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match="rolling"):
+            Engine(params, cfg, n_slots=2, max_len=64)
+
+    def test_export_rejects_window(self):
+        from oim_tpu.models.hf import to_hf_llama
+
+        cfg = TransformerConfig(
+            vocab_size=101, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+            dtype="float32", sliding_window=8,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match="export"):
+            to_hf_llama(params, cfg)
+
+    def test_ring_rejects_noncausal_window(self):
+        mesh = build_mesh(sp=4)
+        q, k, v = _qkv(t=32, h=4, kvh=4, d=16)
+        with pytest.raises(ValueError, match="causal"):
+            ring_attention_sharded(
+                q, k, v, mesh, causal=False, window=8
+            )
