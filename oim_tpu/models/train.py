@@ -25,6 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from oim_tpu.models.transformer import (
     TransformerConfig,
+    _doc_segments,
     _rmsnorm,
     _stage_layer_params,
     _unembed,
@@ -326,7 +327,7 @@ def _build_value_and_grad(cfg: TransformerConfig, mesh):
             )
         mb = b // n_micro
 
-        labels, valid, positions = _shifted_labels(tokens)
+        labels, valid, positions = _shifted_labels(tokens, cfg.doc_sep_id)
         labels_m = labels.reshape(n_micro, mb, t_local)
         valid_m = valid.reshape(n_micro, mb, t_local)
         # Static normalizer: every label position except each sequence's
@@ -340,7 +341,12 @@ def _build_value_and_grad(cfg: TransformerConfig, mesh):
             )
 
         x_micro, embed_vjp = jax.vjp(embed, params["wte"])
-        stage_fn = make_stage_fn(cfg, positions, sp_size)
+        segments = None
+        if cfg.doc_sep_id >= 0:
+            segments = _doc_segments(tokens, cfg).reshape(
+                n_micro, mb, t_local
+            )
+        stage_fn = make_stage_fn(cfg, positions, sp_size, segments)
         stage_params = _stage_layer_params(params, cfg)
         head_params = {
             "final_norm": params["final_norm"],
@@ -373,10 +379,22 @@ def _build_value_and_grad(cfg: TransformerConfig, mesh):
         )
         (d_wte,) = embed_vjp(dx)
         # Totals: ce is real on the last stage only; aux sums per stage.
-        ce_total = jax.lax.psum(ce, ("dp", "sp", "pp"))
+        obj_ce = jax.lax.psum(ce, ("dp", "sp", "pp"))  # Σ ce_sum/c_global
         aux_total = jax.lax.psum(aux, "pp") / n_micro
         aux_total = jax.lax.pmean(aux_total, ("dp", "sp"))
-        loss_total = ce_total + AUX_LOSS_WEIGHT * aux_total
+        loss_total = obj_ce + AUX_LOSS_WEIGHT * aux_total
+        # The CE METRIC divides by the DYNAMIC valid count (the autodiff
+        # path's psum(ce_sum)/psum(ce_count) contract): with sequence
+        # packing, separator labels drop out and the static c_global in
+        # the objective deliberately over-counts — the metric must not.
+        is_last = (
+            jax.lax.axis_index("pp") == jax.lax.axis_size("pp") - 1
+        ).astype(jnp.float32)
+        count = jnp.sum(valid.astype(jnp.float32)) * is_last
+        ce_total = (
+            obj_ce * c_global
+            / jax.lax.psum(count, ("dp", "sp", "pp"))
+        )
         grads = {name: g[None] for name, g in d_sp.items()}  # restore pp dim
         grads["wte"] = d_wte
         grads["final_norm"] = d_hp["final_norm"]

@@ -170,14 +170,6 @@ class TransformerConfig:
                     f"doc_sep_id={self.doc_sep_id} outside vocab "
                     f"{self.vocab_size}"
                 )
-            if self.n_stages > 1:
-                # The pipeline schedules hand stage_fn per-microbatch
-                # activations without a microbatch index, so the
-                # closure-carried segment ids cannot be sliced to match.
-                raise ValueError(
-                    "sequence packing (doc_sep_id) is not supported with "
-                    "pipeline parallelism yet (n_stages > 1)"
-                )
 
     @property
     def head_dim(self) -> int:
@@ -492,16 +484,33 @@ def _stage_layer_params(params: dict, cfg: TransformerConfig) -> dict:
 
 def make_stage_fn(cfg: TransformerConfig, positions: jax.Array, sp_size: int,
                   segments: jax.Array | None = None):
-    """One pipeline stage's layer stack as ``(stage_params, act) -> (act,
-    aux)`` — the unit both pipeline schedules and the single-stage path
-    run.  ``positions`` broadcast over any (micro)batch size; ``segments``
-    [b_local, t_local] (sequence packing) ride the closure like cfg —
-    they are data-derived but constant across layers and stages."""
-    layer_fn = partial(_layer, cfg=cfg, sp_size=sp_size, segments=segments)
-    if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+    """One pipeline stage's layer stack as ``(stage_params, act,
+    mb_idx=None) -> (act, aux)`` — the unit both pipeline schedules and
+    the single-stage path run.  ``positions`` broadcast over any
+    (micro)batch size.  ``segments`` (sequence packing) ride the closure
+    like cfg: [b_local, t_local] on the single-stage path, or
+    [n_micro, mb, t_local] under pipelining — the schedules pass their
+    current microbatch index and the stage slices its row (bubble steps
+    pass clipped indices; their garbage output is masked downstream
+    like every other bubble product)."""
+    base_layer_fn = partial(_layer, cfg=cfg, sp_size=sp_size)
 
-    def stage_fn(stage_params, activation):
+    def stage_fn(stage_params, activation, mb_idx=None):
+        seg = segments
+        if segments is not None and segments.ndim == 3:
+            if mb_idx is None:
+                raise ValueError(
+                    "microbatched segments need the schedule's mb_idx"
+                )
+            seg = jax.lax.dynamic_index_in_dim(
+                segments,
+                jnp.clip(mb_idx, 0, segments.shape[0] - 1),
+                0,
+                keepdims=False,
+            )
+        layer_fn = partial(base_layer_fn, segments=seg)
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn)
         (out, _, aux), _ = jax.lax.scan(
             lambda carry, lw: layer_fn(carry, lw),
             (activation, positions, jnp.zeros((), jnp.float32)),
@@ -567,7 +576,6 @@ def forward_hidden(
         _doc_segments(tokens, cfg) if cfg.doc_sep_id >= 0 else None
     )
     stage_params = _stage_layer_params(params, cfg)
-    run_stage = make_stage_fn(cfg, positions, sp_size, segments)
 
     if pp_size > 1:
         n_micro = max(cfg.n_microbatches, 1)
@@ -577,6 +585,11 @@ def forward_hidden(
             )
         mb = b // n_micro
         x_micro = x.reshape(n_micro, mb, t_local, cfg.d_model)
+        if segments is not None:
+            # Stage functions slice their current microbatch's row by
+            # the schedule-provided index (make_stage_fn).
+            segments = segments.reshape(n_micro, mb, t_local)
+        run_stage = make_stage_fn(cfg, positions, sp_size, segments)
         # Outputs are real only on the LAST stage (zeros elsewhere); the
         # loss in models/train.py masks to the last stage, so the garbage
         # logits other stages compute below are never counted.  The MoE
@@ -587,6 +600,7 @@ def forward_hidden(
         )
         x = x.reshape(b, t_local, cfg.d_model)
     else:
+        run_stage = make_stage_fn(cfg, positions, sp_size, segments)
         x, aux = run_stage(stage_params, x)
 
     x = _rmsnorm(x, params["final_norm"], cfg)

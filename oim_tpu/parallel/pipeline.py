@@ -45,10 +45,13 @@ def gpipe_spmd(
     """Run the pipeline inside shard_map.
 
     Args:
-      stage_fn: ``(stage_params, activation) -> (activation, aux)`` for one
-        stage's layer stack; activation shape ``[mb, ...]`` must be
-        preserved, ``aux`` is a scalar auxiliary loss (e.g. MoE load
-        balancing) summed over the stage's layers.
+      stage_fn: ``(stage_params, activation, mb_idx) -> (activation,
+        aux)`` for one stage's layer stack; activation shape ``[mb, ...]``
+        must be preserved, ``aux`` is a scalar auxiliary loss (e.g. MoE
+        load balancing) summed over the stage's layers.  ``mb_idx`` is
+        the (clipped) index of the microbatch being processed — consumed
+        by microbatch-dependent closures (packed segment ids); bubble
+        steps pass a clipped index and their output is masked anyway.
       stage_params: THIS stage's parameters (already sliced by shard_map).
       x_microbatches: ``[n_micro, mb, ...]`` — the stage-0 input stream
         (replicated over ``pp``; only stage 0 reads it).
@@ -77,7 +80,8 @@ def gpipe_spmd(
 
     perm = [(i, (i + 1) % size) for i in range(size)]
     out_shape, _ = jax.eval_shape(
-        lambda p, a: stage_fn(p, a), stage_params, x_microbatches[0]
+        lambda p, a: stage_fn(p, a, jnp.zeros((), jnp.int32)),
+        stage_params, x_microbatches[0],
     )
     out_dtype = out_shape.dtype
 
@@ -90,10 +94,12 @@ def gpipe_spmd(
             x_microbatches, feed_idx, axis=0, keepdims=False
         ).astype(out_dtype)
         my_input = jnp.where(index == 0, stage0_in, received)
-        state, aux = stage_fn(stage_params, my_input)
+        mb_idx = step_idx - index
+        state, aux = stage_fn(
+            stage_params, my_input, jnp.clip(mb_idx, 0, n_micro - 1)
+        )
         # Bubble steps compute on garbage; count aux only when this stage
         # holds a real microbatch (step - index ∈ [0, n_micro)).
-        mb_idx = step_idx - index
         is_real = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
         aux_sum = aux_sum + jnp.where(is_real, aux, 0.0)
         # The last stage emits microbatch (step - size + 1) when valid.
@@ -142,7 +148,8 @@ def pipeline_1f1b_value_and_grad(
     """Interleaved 1F1B: forward AND backward inside one lockstep schedule.
 
     Args:
-      stage_fn: ``(stage_params, activation) -> (activation, aux)``.
+      stage_fn: ``(stage_params, activation, mb_idx) -> (activation,
+        aux)`` (same contract as ``gpipe_spmd``).
       loss_fn: ``(head_params, activation, mb_index) -> (loss, ce)`` —
         per-microbatch scalars, already weighted so that summing over
         microbatches (last stage) yields the global objective's local
@@ -183,7 +190,8 @@ def pipeline_1f1b_value_and_grad(
     perm_bwd = [(i, (i - 1) % size) for i in range(size)]
 
     out_shape, _ = jax.eval_shape(
-        lambda p, a: stage_fn(p, a), stage_params, x_microbatches[0]
+        lambda p, a: stage_fn(p, a, jnp.zeros((), jnp.int32)),
+        stage_params, x_microbatches[0],
     )
     dtype = out_shape.dtype
 
@@ -206,7 +214,9 @@ def pipeline_1f1b_value_and_grad(
         acts = jax.lax.dynamic_update_index_in_dim(
             acts, jnp.where(f_valid, my_input, stale), slot_f, 0
         )
-        y, _ = stage_fn(stage_params, my_input)
+        y, _ = stage_fn(
+            stage_params, my_input, jnp.clip(m_f, 0, n_micro - 1)
+        )
         fwd_state = y
 
         # ---- B half-tick: stage i backwards microbatch k - 2(S-1) + i.
@@ -218,7 +228,7 @@ def pipeline_1f1b_value_and_grad(
         mb_index = jnp.clip(m_b, 0, n_micro - 1)
 
         def full(sp, hp, act):
-            y, aux = stage_fn(sp, act)
+            y, aux = stage_fn(sp, act, mb_index)
             loss, ce = loss_fn(hp, y, mb_index)
             return y, aux, loss, ce
 

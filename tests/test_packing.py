@@ -211,10 +211,6 @@ class TestPackedExactness:
         ce_solo = _ce(params, packed, cfg)
         np.testing.assert_allclose(ce_sharded, ce_solo, rtol=2e-5)
 
-    def test_packing_with_pp_rejected(self):
-        with pytest.raises(ValueError, match="packing"):
-            _cfg(n_layers=2, n_stages=2)
-
     def test_sep_outside_vocab_rejected(self):
         with pytest.raises(ValueError, match="vocab"):
             _cfg(doc_sep_id=101)
@@ -238,4 +234,70 @@ class TestSegmentedUlysses:
         ref = reference_attention(q, k, v, True, seg)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+class TestPackedPipeline:
+    """Packing under pipeline parallelism: segment ids ride the
+    schedules per microbatch; the exactness invariant must hold on
+    pp meshes under BOTH schedules."""
+
+    def _packed_and_percdoc(self, seed=9):
+        rng = np.random.RandomState(seed)
+        docs = [rng.randint(1, 101, size=n).tolist()
+                for n in (9, 6, 14, 11, 5, 13)]
+        packed = pack_documents(docs, 32, SEP)  # [2, 32]
+        return docs, packed
+
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_pp_train_step_matches_solo(self, schedule):
+        """First train-step loss on a pp2 mesh equals the pp1 loss on
+        the same packed batch (same weights, same math)."""
+        import optax
+
+        from oim_tpu.models import TrainState, make_train_step
+        from oim_tpu.models.train import shard_state
+
+        _, packed = self._packed_and_percdoc()
+        cfg_pp = TransformerConfig(
+            vocab_size=101, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+            dtype="float32", use_pallas=False, doc_sep_id=SEP,
+            n_stages=2, n_microbatches=2, pp_schedule=schedule,
+        )
+        cfg_solo = TransformerConfig(
+            vocab_size=101, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+            dtype="float32", use_pallas=False, doc_sep_id=SEP,
+        )
+        optimizer = optax.sgd(1e-3)
+        params = init_params(jax.random.PRNGKey(0), cfg_pp)
+        mesh_pp = build_mesh(pp=2)
+        state_pp = shard_state(
+            TrainState.create(jax.tree.map(jnp.copy, params), optimizer),
+            cfg_pp, mesh_pp,
+        )
+        _, metrics_pp = make_train_step(cfg_pp, mesh_pp, optimizer)(
+            state_pp, jnp.asarray(packed)
+        )
+        # Solo: same stacked weights flattened to one stage.
+        solo_params = {
+            name: (
+                value.reshape(1, -1, *value.shape[2:])
+                if name not in ("wte", "final_norm", "wlm")
+                else value
+            )
+            for name, value in params.items()
+        }
+        mesh_solo = build_mesh(devices=jax.devices()[:1])
+        state_solo = shard_state(
+            TrainState.create(solo_params, optimizer), cfg_solo, mesh_solo
+        )
+        _, metrics_solo = make_train_step(cfg_solo, mesh_solo, optimizer)(
+            state_solo, jnp.asarray(packed)
+        )
+        np.testing.assert_allclose(
+            float(metrics_pp["ce"]), float(metrics_solo["ce"]), rtol=2e-5
+        )
+        np.testing.assert_allclose(
+            float(metrics_pp["loss"]), float(metrics_solo["loss"]),
+            rtol=2e-5,
         )

@@ -234,7 +234,8 @@ class TestPipeline:
 
         def piped_fn(w, xm):
             out, aux = gpipe_spmd(
-                lambda p, a: (stage_fn(p[0], a), jnp.float32(1.0)), w, xm,
+                lambda p, a, m=None: (stage_fn(p[0], a), jnp.float32(1.0)),
+                w, xm,
                 "pp",
             )
             # Outputs are real only on the last stage; replicate them the
@@ -272,7 +273,7 @@ class TestPipeline:
         def loss_piped(ws):
             def piped_fn(w, xm):
                 out, _ = gpipe_spmd(
-                    lambda p, a: (stage_fn(p[0], a), jnp.float32(0.0)),
+                    lambda p, a, m=None: (stage_fn(p[0], a), jnp.float32(0.0)),
                     w, xm, "pp",
                 )
                 idx = jax.lax.axis_index("pp")
@@ -387,7 +388,7 @@ class Test1F1B:
         return ws, hp, x, tgt
 
     @staticmethod
-    def _stage(w, a):
+    def _stage(w, a, m=None):
         # w arrives [1, dim, dim] (shard_map-sliced stages dim).
         return jnp.tanh(a @ w[0]), jnp.sum(a.astype(jnp.float32) ** 2)
 
