@@ -87,6 +87,10 @@ def main(argv=None) -> int:
             " --rope-scaling " + " ".join(str(v) for v in cfg.rope_scaling)
             if cfg.rope_scaling else ""
         )
+        + (
+            f" --sliding-window {cfg.sliding_window}"
+            if cfg.sliding_window else ""
+        )
     )
     print(f"imported {args.hf_dir} -> {out_dir}")
     print(

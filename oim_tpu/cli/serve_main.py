@@ -42,6 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-top-k", type=int, default=1)
     p.add_argument("--rope-theta", type=float, default=10000.0)
     p.add_argument(
+        "--sliding-window", type=int, default=0,
+        help="sliding-window attention (Mistral-family); 0 = full causal",
+    )
+    p.add_argument(
         "--rope-scaling", type=float, nargs=4, default=[],
         metavar=("FACTOR", "LOW", "HIGH", "ORIG_MAX"),
         help="Llama-3.1 RoPE frequency remap (factor low_freq_factor "
@@ -177,6 +181,7 @@ def make_engine(args):
         moe_top_k=args.moe_top_k,
         rope_theta=args.rope_theta,
         rope_scaling=tuple(args.rope_scaling),
+        sliding_window=args.sliding_window,
         norm_eps=args.norm_eps,
         dtype=args.dtype,
     )

@@ -55,7 +55,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--sliding-window", type=int, default=0,
         help="sliding-window attention: each token attends the last N "
-        "positions (0 = full causal); train-side only",
+        "positions (0 = full causal); oim-serve honors the same window",
     )
     p.add_argument(
         "--doc-sep-id", type=int, default=-1,

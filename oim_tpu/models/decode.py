@@ -166,9 +166,15 @@ def _cached_attention(
         _load_kv(k_cache, k_scale),
     ) / (hd**0.5)
     # Causal over global positions; cache slots past start+t are invalid.
+    # Cache rows map 1:1 to global positions, so sliding-window masking
+    # is position arithmetic — no rolling buffer needed for exactness
+    # (a W-row ring buffer is the later memory optimization).
     q_pos = start + jnp.arange(t)[:, None]
     k_pos = jnp.arange(max_len)[None, :]
-    scores = jnp.where(k_pos <= q_pos, scores, _NEG_BIG)
+    keep = k_pos <= q_pos
+    if cfg.sliding_window:
+        keep &= q_pos - k_pos < cfg.sliding_window
+    scores = jnp.where(keep, scores, _NEG_BIG)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "bhgqk,bkhd->bqhgd", probs, _load_kv(v_cache, v_scale)
@@ -343,11 +349,6 @@ def prefill(
     capacity ``max_len`` holding the prompt's K/V (int8-quantized per
     token/head when ``kv_int8`` — half the cache bandwidth decode pays).
     """
-    if cfg.sliding_window:
-        raise ValueError(
-            "sliding-window decode needs a rolling KV cache (not yet "
-            "implemented); train-side SWA only"
-        )
     b, t = tokens.shape
     if t > max_len:
         raise ValueError(f"prompt length {t} exceeds max_len {max_len}")

@@ -1,7 +1,8 @@
 """HF Llama-family checkpoint import: external weights, native layout.
 
 The flagship transformer is architecture-compatible with the Llama
-family (RMSNorm, RoPE, SwiGLU, GQA, untied or tied unembed), so a user
+family — including Mistral-style sliding-window variants
+(RMSNorm, RoPE, SwiGLU, GQA, untied or tied unembed), so a user
 can bring real open weights instead of training from scratch — the
 interchange surface the reference left to its storage backends
 (volumes carry whatever bytes the workload expects) becomes, for a
@@ -46,14 +47,7 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
         )
     if get("attention_bias", False) or get("mlp_bias", False):
         raise ValueError("projection biases are not supported")
-    if get("sliding_window", None):
-        # Train-side SWA exists (cfg.sliding_window) but serving does
-        # not (no rolling KV cache yet) — importing would produce a
-        # checkpoint this framework cannot serve faithfully.
-        raise ValueError(
-            "sliding-window checkpoints are not importable yet "
-            "(train-side SWA only; serving needs a rolling KV cache)"
-        )
+
     scaling = get("rope_scaling", None)
     rope_scaling = ()
     if scaling:
@@ -92,6 +86,17 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
         d_ff=int(get("intermediate_size")),
         rope_theta=float(get("rope_theta", 10000.0) or 10000.0),
         rope_scaling=rope_scaling,
+        # Mistral-family sliding window: masked identically in train,
+        # solo decode, and the serving engine (cache rows are 1:1 with
+        # global positions); parity-tested vs transformers' reference.
+        # Qwen-style configs carry a window value but gate it off with
+        # use_sliding_window=false — honor the gate or full-attention-
+        # trained weights get silently windowed numerics.
+        sliding_window=(
+            int(get("sliding_window", 0) or 0)
+            if get("use_sliding_window", True)
+            else 0
+        ),
         norm_eps=float(get("rms_norm_eps", 1e-6) or 1e-6),
     )
     kwargs.update(overrides)

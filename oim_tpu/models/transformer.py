@@ -104,10 +104,10 @@ class TransformerConfig:
     pp_schedule: str = "gpipe"
     # Sliding-window attention (Mistral-style): each query attends the
     # last `sliding_window` positions (0 = full causal attention).
-    # TRAIN-SIDE support: flash skips out-of-window blocks (O(T·W)),
-    # ring/ulysses mask in global positions.  The decode/serving paths
-    # reject windowed configs until a rolling KV cache lands — better
-    # loud than silently serving full-attention numerics.
+    # Train: flash skips out-of-window blocks (O(T·W)), ring/ulysses
+    # mask in global positions.  Decode/serving mask the full-length
+    # cache by position arithmetic (rows are 1:1 with global positions)
+    # — exact today; a W-row ring buffer is the later memory win.
     sliding_window: int = 0
     # Sequence packing: >= 0 marks this token id as a document separator
     # (BOS-style: the separator belongs to the document it opens).

@@ -261,10 +261,15 @@ def _slot_attention(
         _load_kv(k_cache, k_scale),
     ) / (hd**0.5)
     # Causal per slot: query at global position p attends to rows <= p of
-    # its own region; rows past the slot's frontier are invalid.
+    # its own region; rows past the slot's frontier are invalid.  Rows
+    # map 1:1 to global positions, so the sliding window is the same
+    # position arithmetic as decode._cached_attention.
     q_pos = positions[:, None, None, :, None]  # [B, 1, 1, t, 1]
     k_pos = jnp.arange(max_len)[None, None, None, None, :]
-    scores = jnp.where(k_pos <= q_pos, scores, _NEG_BIG)
+    keep = k_pos <= q_pos
+    if cfg.sliding_window:
+        keep &= q_pos - k_pos < cfg.sliding_window
+    scores = jnp.where(keep, scores, _NEG_BIG)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "bhgqk,bkhd->bqhgd", probs, _load_kv(v_cache, v_scale)
@@ -790,14 +795,6 @@ class Engine:
                 f"need n_slots>=1, max_len>=2, chunk>=1, "
                 f"prefix_cache_size>=0; got {n_slots}, {max_len}, {chunk}, "
                 f"{prefix_cache_size}"
-            )
-        if cfg.sliding_window:
-            # The slot cache is full-length; serving a sliding-window-
-            # trained model with it would silently run full-attention
-            # numerics over windowed-trained weights.
-            raise ValueError(
-                "sliding-window serving needs a rolling KV cache (not "
-                "yet implemented); train-side SWA only"
             )
         if spec_decode < 0 or (spec_decode and spec_ngram < 1):
             raise ValueError(
