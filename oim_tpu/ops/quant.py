@@ -96,6 +96,13 @@ def dequantize_named(tree: dict, name: str, dtype=None):
     return deq if dtype is None else deq.astype(dtype)
 
 
+def has_int8_weights(params: dict) -> bool:
+    """True when ``params`` carries weight-only-int8 companion scales —
+    the one suffix rule, shared with ``dequantize_named`` so detection
+    can never diverge from dequantization."""
+    return any(name.endswith("_wscale") for name in params)
+
+
 def maybe_dequantize_weights(tree: dict, dtype=None) -> dict:
     """Undo ``quantize_params_int8`` on any dict holding quantized
     weights (full params or a per-layer slice); everything else passes

@@ -873,6 +873,15 @@ class Engine:
         # flood into immediate backpressure (QueueFullError → HTTP 429)
         # instead of unbounded host memory + 600 s client timeouts.
         self.max_queue = max_queue
+        self.top_k = top_k
+        self.kv_int8 = kv_int8
+        from oim_tpu.ops.quant import has_int8_weights
+
+        self.weights_int8 = has_int8_weights(params)
+        self.n_params = int(sum(
+            int(np.prod(v.shape)) for name, v in params.items()
+            if not name.endswith("_wscale")
+        ))
         self.default_top_p = top_p
         self._cache = SlotCache.create(
             cfg, n_slots, max_len, quantized=kv_int8
@@ -1347,6 +1356,48 @@ class Engine:
     def pending(self) -> bool:
         with self._lock:
             return bool(self._queue or self._slots)
+
+    def info(self) -> dict:
+        """Static engine/model description (GET /v1/info): what an
+        operator needs to know which replica serves what — geometry,
+        capacity shape, and which optional features are live.  Static
+        by construction: safe to cache client-side."""
+        cfg = self.cfg
+        return {
+            "model": {
+                "vocab_size": cfg.vocab_size,
+                "d_model": cfg.d_model,
+                "n_layers": cfg.n_layers,
+                "n_heads": cfg.n_heads,
+                "n_kv_heads": cfg.kv_heads,
+                "d_ff": cfg.ff_dim,
+                "n_experts": cfg.n_experts,
+                "moe_top_k": cfg.moe_top_k if cfg.n_experts else 0,
+                "rope_theta": cfg.rope_theta,
+                "rope_scaling": list(cfg.rope_scaling),
+                "sliding_window": cfg.sliding_window,
+                "norm_eps": cfg.norm_eps,
+                "dtype": cfg.dtype,
+                "n_params": self.n_params,
+            },
+            "engine": {
+                "n_slots": self._cache.n_slots,
+                "max_len": self._cache.max_len,
+                "usable_len": self._usable_len,
+                "chunk": self.chunk,
+                "prompt_buckets": list(self.prompt_buckets),
+                "max_queue": self.max_queue,
+                "top_k": self.top_k,
+                "default_top_p": self.default_top_p,
+                "kv_int8": self.kv_int8,
+                "weights_int8": self.weights_int8,
+                "spec_decode": self.spec_decode,
+                "penalties": self.penalties,
+                "prefix_cache_size": self.prefix_cache_size,
+                "tp": self.mesh.shape.get("tp", 1) if self.mesh else 1,
+                "ep": self.mesh.shape.get("ep", 1) if self.mesh else 1,
+            },
+        }
 
     def stats(self) -> dict:
         with self._lock:

@@ -33,6 +33,8 @@ API:
                     /v1/generate output exactly.
   GET  /healthz      → {"ok": true}
   GET  /v1/stats     → engine stats (slots, queue depth, tokens generated)
+  GET  /v1/info      → static model/engine description (geometry, params,
+                    capacity shape, live features) — cacheable
   GET  /metrics      → Prometheus exposition (shared registry)
 
 The engine is tokenizer-agnostic by design — clients speak token ids, the
@@ -97,6 +99,8 @@ class ServeServer:
                         self._json(200, {"ok": True})
                 elif self.path == "/v1/stats":
                     self._json(200, outer.engine.stats())
+                elif self.path == "/v1/info":
+                    self._json(200, outer.engine.info())
                 else:
                     self._json(404, {"error": f"no such path {self.path}"})
 

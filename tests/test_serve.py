@@ -1509,3 +1509,30 @@ class TestBackpressureAndDrain:
             engine.embed([1, 2, 3])
         with pytest.raises(DrainingError):
             engine.beam([1, 2, 3], max_new_tokens=4)
+
+
+def test_info_endpoint_and_engine_info(setup):
+    cfg, params = setup
+    engine = Engine(
+        params, cfg, n_slots=2, max_len=64, chunk=4, spec_decode=0,
+        max_queue=8,
+    )
+    info = engine.info()
+    assert info["model"]["vocab_size"] == cfg.vocab_size
+    assert info["model"]["n_params"] == sum(
+        int(np.prod(v.shape)) for v in params.values()
+    )
+    assert info["engine"]["n_slots"] == 2
+    assert info["engine"]["max_queue"] == 8
+    assert info["engine"]["penalties"] is True
+    server = ServeServer(engine).start()
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://{server.host}:{server.port}/v1/info", timeout=10
+        ) as resp:
+            body = json.loads(resp.read())
+        assert body == info  # static and JSON-round-trippable
+    finally:
+        server.stop()
