@@ -87,6 +87,7 @@ from oim_tpu.models.decode import (
 )
 from oim_tpu.ops.quant import (
     dequantize_named,
+    has_int8_weights,
     make_kv_buffers,
     maybe_dequantize_weights,
     quantize_int8,
@@ -875,8 +876,6 @@ class Engine:
         self.max_queue = max_queue
         self.top_k = top_k
         self.kv_int8 = kv_int8
-        from oim_tpu.ops.quant import has_int8_weights
-
         self.weights_int8 = has_int8_weights(params)
         self.n_params = int(sum(
             int(np.prod(v.shape)) for name, v in params.items()

@@ -118,6 +118,13 @@ class Router:
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
                     metrics.write_exposition(self)
+                elif path == "/v1/info":
+                    # Backends are homogeneous replicas of one model;
+                    # answer from any healthy one so clients behind the
+                    # router can introspect without backend addresses.
+                    # Full _proxy semantics apply: single retry,
+                    # error attribution, metrics.
+                    outer._proxy(self, "/v1/info", None, {})
                 elif path == "/healthz":
                     n = len(outer.healthy_backends())
                     self._json(
@@ -205,7 +212,11 @@ class Router:
 
     # -- proxying ----------------------------------------------------------
 
-    def _proxy(self, handler, path: str, body: bytes, headers: dict) -> None:
+    def _proxy(
+        self, handler, path: str, body: bytes | None, headers: dict
+    ) -> None:
+        """Proxy one request to a healthy backend (``body`` None = GET —
+        urllib's method selection; bytes = POST)."""
         tried: set[str] = set()
         while len(tried) < 2:  # the documented single-retry bound
             backend = self._pick(exclude=tried)

@@ -384,3 +384,15 @@ def test_serve_cn_authz():
         set_as("serve.inst-1", "inst-1/address")  # controller namespace
     with pytest.raises(FakeAbort):
         set_as("serve.inst-1", "volumes/v/coordinator")
+
+
+def test_info_proxied_through_router(backends):
+    router = Router(backends=(_url(backends[0]),)).start()
+    try:
+        base = f"http://{router.host}:{router.port}"
+        status, via_router = _get(base, "/v1/info")
+        assert status == 200
+        _, direct = _get(_url(backends[0]), "/v1/info")
+        assert via_router == direct
+    finally:
+        router.stop()
