@@ -104,8 +104,9 @@ class TransformerConfig:
     pp_schedule: str = "gpipe"
     # Sliding-window attention (Mistral-style): each query attends the
     # last `sliding_window` positions (0 = full causal attention).
-    # Train: flash skips out-of-window blocks (O(T·W)), ring/ulysses
-    # mask in global positions.  Decode/serving mask the full-length
+    # Train: flash and the sp ring both skip fully-masked blocks
+    # (O(T·W)); ulysses masks over its full-sequence view.  Decode/
+    # serving mask the full-length
     # cache by position arithmetic (rows are 1:1 with global positions)
     # — exact today; a W-row ring buffer is the later memory win.
     sliding_window: int = 0

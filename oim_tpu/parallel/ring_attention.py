@@ -79,9 +79,11 @@ def ring_attention(
         packing) — rotates around the ring with its k/v block so
         cross-document pairs are masked across shard boundaries too.
       window: sliding-window attention (each query sees the last
-        ``window`` global positions; causal only).  The ring still
-        rotates every block — correctness first; skipping out-of-window
-        hops is a future optimization.
+        ``window`` global positions; causal only).  The ring always
+        rotates, but hops whose block is fully masked (entirely in the
+        causal future, or entirely below the window) skip their matmuls
+        via ``lax.cond`` — windowed long-context training is O(T·W)
+        under sp too.
 
     Returns the local output shard ``[batch, seq_local, heads, head_dim]``.
     """
@@ -122,10 +124,36 @@ def ring_attention(
         # (index - step) mod size.
         k_owner = (index - step_idx) % size
         k_offset = k_owner * t_local
-        m, l, o = _block_attention(
-            qf, k_blk, v_blk, m, l, o, q_offset, k_offset, causal, scale,
-            seg_local, seg_blk, window,
-        )
+
+        def attend(operands):
+            m_, l_, o_, kb, vb, sb = operands
+            return _block_attention(
+                qf, kb, vb, m_, l_, o_, q_offset, k_offset, causal, scale,
+                seg_local, sb, window,
+            )
+
+        if causal:
+            # Hops whose k/v block is fully masked carry zero mass —
+            # keep rotating, skip the matmuls.  Entirely-future blocks
+            # are dead for any causal run (~half the hops on the ring);
+            # with a window, entirely-below-window blocks are too, which
+            # makes windowed long-context training O(T·W) under sp just
+            # like the flash kernel.  Hop 0 (the self block) is always
+            # attended, so the online softmax never starts on a skip.
+            relevant = k_offset <= q_offset + t_local - 1  # not future
+            if window:
+                relevant = jnp.logical_and(
+                    relevant,
+                    q_offset - (k_offset + t_local - 1) < window,
+                )
+            m, l, o = jax.lax.cond(
+                relevant,
+                attend,
+                lambda operands: operands[:3],
+                (m, l, o, k_blk, v_blk, seg_blk),
+            )
+        else:
+            m, l, o = attend((m, l, o, k_blk, v_blk, seg_blk))
         # Rotate k/v one hop around the ring (neighbor traffic on ICI).
         perm = [(i, (i + 1) % size) for i in range(size)]
         k_next = jax.lax.ppermute(k_blk, axis_name, perm)
