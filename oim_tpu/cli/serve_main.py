@@ -308,7 +308,16 @@ def main(argv=None) -> int:
     import time as _time
 
     stop_evt = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: stop_evt.set())
+
+    # Ctrl-C takes the same graceful-drain path as a rolling restart's
+    # SIGTERM; a second Ctrl-C reverts to the default handler, so an
+    # impatient operator can still hard-stop mid-drain.
+    def _request_stop(*_):
+        signal.signal(signal.SIGINT, signal.default_int_handler)
+        stop_evt.set()
+
+    signal.signal(signal.SIGTERM, _request_stop)
+    signal.signal(signal.SIGINT, _request_stop)
     try:
         stop_evt.wait()
         # Graceful drain: deregister + stop admitting, let in-flight

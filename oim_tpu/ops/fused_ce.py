@@ -267,7 +267,8 @@ def _fwd(x, w, labels, block_n, block_v):
 
 def _bwd(block_n, block_v, residuals, g):
     x, w, labels, lse = residuals
-    if lse is None:  # ragged forward fell back to the reference path
+
+    def _reference_bwd():
         _, vjp = jax.vjp(
             lambda x_, w_: reference_linear_ce(
                 x_, w_.astype(x_.dtype), labels
@@ -276,13 +277,26 @@ def _bwd(block_n, block_v, residuals, g):
         )
         dx, dw = vjp(g)
         return dx, dw, np.zeros(labels.shape, jax.dtypes.float0)
+
+    if lse is None:  # ragged forward fell back to the reference path
+        return _reference_bwd()
     n, d = x.shape
     v = w.shape[1]
     bn, bv = _resolve(n, v, block_n, block_v)
     # The dw tile + its f32 scratch both live in VMEM; halve the vocab
     # block (still a valid divisor: every block is a multiple-of-128
-    # divisor chain) when the default would crowd the ~16 MB budget.
-    bv_dw = bv if d * bv * 8 <= 8 * 2**20 else (_block_v(v, bv // 2) or bv)
+    # divisor chain) until the default no longer crowds the ~16 MB budget.
+    bv_dw = bv
+    while d * bv_dw * 8 > 8 * 2**20:
+        smaller = _block_v(v, bv_dw // 2)
+        if not smaller:
+            break
+        bv_dw = smaller
+    if d * bv_dw * 8 > 12 * 2**20:
+        # Even the minimum vocab block can't fit next to the weight tile
+        # (huge d_model): the kernel would fail at Mosaic compile time,
+        # so take the XLA path instead of an over-budget pallas_call.
+        return _reference_bwd()
     wc = w.astype(x.dtype)
     lbl = _row_tile(labels.astype(jnp.int32))
     g_rows = _row_tile(g.astype(jnp.float32))

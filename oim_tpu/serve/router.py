@@ -39,6 +39,7 @@ import json
 import threading
 import urllib.error
 import urllib.request
+from concurrent import futures
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -95,6 +96,10 @@ class Router:
         self.request_timeout = request_timeout
         self._stop = threading.Event()
         self._rr = 0
+        self._probing: set[str] = set()
+        self._probe_pool = futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="router-probe"
+        )
         self._requests = metrics.registry().counter(
             "oim_route_requests_total",
             "Requests proxied by the serving router",
@@ -123,8 +128,8 @@ class Router:
                     # answer from any healthy one so clients behind the
                     # router can introspect without backend addresses.
                     # Full _proxy semantics apply: single retry,
-                    # error attribution, metrics.
-                    outer._proxy(self, "/v1/info", None, {})
+                    # error attribution, metrics, trace propagation.
+                    outer._proxy(self, "/v1/info", None, self._fwd_headers())
                 elif path == "/healthz":
                     n = len(outer.healthy_backends())
                     self._json(
@@ -136,19 +141,24 @@ class Router:
                 else:
                     self._json(404, {"error": f"no such path {path}"})
 
+            def _fwd_headers(self, extra: dict | None = None) -> dict:
+                """Outbound headers for the backend hop: propagate the
+                caller's trace context, like every other component
+                boundary here."""
+                headers = dict(extra or {})
+                if self.headers.get("traceparent"):
+                    headers["traceparent"] = self.headers["traceparent"]
+                return headers
+
             def do_POST(self):
                 if self.path not in PROXIED:
                     self._json(404, {"error": f"no such path {self.path}"})
                     return
                 length = int(self.headers.get("Content-Length", "0"))
                 body = self.rfile.read(length)
-                headers = {
-                    "Content-Type": "application/json",
-                }
-                # Propagate the caller's trace context through the hop,
-                # like every other component boundary here.
-                if self.headers.get("traceparent"):
-                    headers["traceparent"] = self.headers["traceparent"]
+                headers = self._fwd_headers(
+                    {"Content-Type": "application/json"}
+                )
                 outer._proxy(self, self.path, body, headers)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
@@ -324,12 +334,19 @@ class Router:
     # -- health + discovery ------------------------------------------------
 
     def _probe(self, backend: Backend) -> None:
+        err: Exception | None = None
         try:
             with urllib.request.urlopen(
                 backend.url + "/healthz", timeout=2
             ) as resp:
                 ok = resp.status == 200
-        except OSError:
+        except Exception as exc:
+            # Any probe failure means unhealthy — including non-OSError
+            # ones like a malformed registry-advertised URL (ValueError);
+            # swallowing those silently would pin the backend healthy
+            # forever.  Logged below on the healthy→unhealthy transition
+            # only, never per-tick.
+            err = exc if not isinstance(exc, OSError) else None
             ok = False
         with self._lock:
             if ok:
@@ -342,14 +359,43 @@ class Router:
             else:
                 backend.fails += 1
                 if backend.fails >= self.unhealthy_after:
+                    if backend.healthy:
+                        log.current().warning(
+                            "backend unhealthy",
+                            backend=backend.id,
+                            error=str(err) if err else "probe failed",
+                        )
                     backend.healthy = False
 
     def _health_loop(self) -> None:
         while not self._stop.wait(self.health_interval):
             with self._lock:
-                snapshot = list(self._backends.values())
+                snapshot = [
+                    b
+                    for b in self._backends.values()
+                    if b.id not in self._probing
+                ]
+                self._probing.update(b.id for b in snapshot)
+            # Probe concurrently: N dead backends each eat their full
+            # 2 s connect timeout, and a serial sweep would stall the
+            # whole loop N× past health_interval, delaying both
+            # unhealthy detection and recovery of live backends.  The
+            # _probing guard means a stalled probe skips (not overlaps)
+            # its backend on later ticks, so results never go stale.
             for backend in snapshot:
-                self._probe(backend)
+                try:
+                    self._probe_pool.submit(self._probe_tracked, backend)
+                except RuntimeError:  # pool shut down mid-sweep (stop())
+                    with self._lock:
+                        self._probing.discard(backend.id)
+                    return
+
+    def _probe_tracked(self, backend: Backend) -> None:
+        try:
+            self._probe(backend)
+        finally:
+            with self._lock:
+                self._probing.discard(backend.id)
 
     def _discover_loop(self) -> None:
         while True:
@@ -441,3 +487,7 @@ class Router:
         if self._http_thread.is_alive():
             self._httpd.shutdown()
         self._httpd.server_close()
+        self._probe_pool.shutdown(wait=False, cancel_futures=True)
+        with self._lock:
+            # Cancelled futures never reach _probe_tracked's finally.
+            self._probing.clear()
