@@ -11,6 +11,18 @@ from contextlib import contextmanager
 
 REGISTRY_CN = "component.registry"
 
+# Long-lived streams (WatchValues) can sit idle for hours on a stable
+# fleet; HTTP/2 keepalive pings detect a middlebox silently dropping the
+# connection (NAT/conntrack idle eviction sends no RST), turning an
+# invisible freeze into an RpcError the reopen loop handles.  Harmless
+# on short-lived per-operation channels.
+KEEPALIVE_OPTIONS = (
+    ("grpc.keepalive_time_ms", 30_000),
+    ("grpc.keepalive_timeout_ms", 10_000),
+    ("grpc.keepalive_permit_without_calls", 1),
+    ("grpc.http2.max_pings_without_data", 0),
+)
+
 
 @contextmanager
 def registry_channel(registry_address: str, tls=None):
@@ -25,10 +37,10 @@ def registry_channel(registry_address: str, tls=None):
         channel = grpc.secure_channel(
             target,
             pinned.channel_credentials(),
-            options=pinned.channel_options(),
+            options=tuple(pinned.channel_options()) + KEEPALIVE_OPTIONS,
         )
     else:
-        channel = grpc.insecure_channel(target)
+        channel = grpc.insecure_channel(target, options=KEEPALIVE_OPTIONS)
     try:
         yield channel
     finally:

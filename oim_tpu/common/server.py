@@ -69,7 +69,15 @@ class NonBlockingGRPCServer:
         server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=self.max_workers),
             interceptors=list(self.interceptors),
-            options=list(self.options),
+            # Tolerate client keepalive pings on idle long-lived streams
+            # (WatchValues/etcd Watch clients ping every 30 s — see
+            # regdial.KEEPALIVE_OPTIONS); without this the server GOAWAYs
+            # them with ENHANCE_YOUR_CALM after two "unnecessary" pings.
+            options=[
+                ("grpc.http2.min_ping_interval_without_data_ms", 20_000),
+                ("grpc.keepalive_permit_without_calls", 1),
+            ]
+            + list(self.options),
         )
         for registrar in registrars:
             registrar(server)

@@ -463,7 +463,11 @@ class Controller:
                     value=oim_pb2.Value(
                         path=f"{self.controller_id}/address",
                         value=self._advertised_address,
-                    )
+                    ),
+                    # Lease-scoped liveness: a crashed controller's address
+                    # expires a few missed heartbeats after the last
+                    # refresh instead of surviving until overwritten.
+                    ttl_seconds=max(1, int(self.registry_delay * 3)),
                 ),
                 timeout=10,
             )

@@ -6,18 +6,45 @@ planned an etcd backend behind this seam but never implemented it (reference
 README.md:131-135); here the durable backend is SQLite (WAL mode), which the
 image ships, giving the registry crash-safe state for multi-host deployments
 (BASELINE.json config 5) without an external service.
+
+Beyond the reference's seam, every backend supports two liveness
+primitives the production HA story needs (and the etcd API was designed
+around):
+
+- ``watch(prefix, callback)`` — event-driven change notification; the
+  registry's WatchValues stream and the serving router's discovery ride
+  this instead of polling, so a deleted backend key propagates in
+  milliseconds, not at the next poll tick.
+- ``store(path, value, ttl=...)`` — leased keys: the key auto-deletes
+  ``ttl`` seconds after the last store that carried it.  Heartbeat
+  registration (controller/serve addresses) uses this so a crashed
+  writer's address *expires* with a watch event instead of surviving
+  until its slot is overwritten.
+
+The local backends (Mem/Sqlite) implement both in-process — correct
+because exactly one registry process owns the store (the SQLite file is
+registry-private state, not shared).  The etcd backend
+(registry/etcd.py) delegates to real etcd Watch/Lease, which extends the
+same semantics across registry replicas.
 """
 
 from __future__ import annotations
 
+import heapq
 import sqlite3
 import threading
-from typing import Protocol
+import time
+from typing import Callable, Protocol
+
+WatchCallback = Callable[[str, str], None]  # (path, value); "" = deleted
 
 
 class RegistryDB(Protocol):
-    def store(self, path: str, value: str) -> None:
-        """Set ``path`` to ``value``; an empty value deletes the key."""
+    def store(self, path: str, value: str, *, ttl: float | None = None) -> None:
+        """Set ``path`` to ``value``; an empty value deletes the key.
+        ``ttl`` (seconds) leases the key: it auto-deletes that long after
+        the LAST store that carried a ttl, unless refreshed; ``None``
+        makes the key persistent (and clears any prior lease)."""
         ...
 
     def lookup(self, path: str) -> str:
@@ -32,6 +59,13 @@ class RegistryDB(Protocol):
         """Sorted (path, value) pairs at or under ``prefix``, read atomically."""
         ...
 
+    def watch(self, prefix: str, callback: WatchCallback) -> Callable[[], None]:
+        """Invoke ``callback(path, value)`` on every mutation at or under
+        ``prefix`` (value "" = deletion, including lease expiry).  Returns
+        a cancel function.  Callbacks run on internal threads and must not
+        block."""
+        ...
+
 
 def _prefix_match(key: str, prefix: str) -> bool:
     if prefix == "":
@@ -43,19 +77,203 @@ def _like_escape(s: str) -> str:
     return s.replace("\\", "\\\\").replace("%", "\\%").replace("_", "\\_")
 
 
+class _EventHub:
+    """Watch fan-out for the single-process backends.
+
+    Delivery ORDER equals commit order: the owner enqueues under ITS
+    data lock (``enqueue``), so the queue sequence is the mutation
+    sequence, and a single drainer at a time (``dispatch``) delivers.
+    Without this, two racing stores to one key could reach watchers
+    reversed — and with event-driven discovery there is no steady-state
+    poll left to heal a diverged watcher view.  Callbacks run outside
+    the owner's data lock (a callback may re-enter the DB) and must not
+    block."""
+
+    def __init__(self) -> None:
+        self._sub_lock = threading.Lock()
+        self._subs: dict[int, tuple[str, WatchCallback]] = {}
+        self._next = 0
+        self._q_lock = threading.Lock()
+        self._queue: list[tuple[str, str]] = []
+        self._draining = False
+
+    def subscribe(
+        self, prefix: str, callback: WatchCallback
+    ) -> Callable[[], None]:
+        with self._sub_lock:
+            sid = self._next
+            self._next += 1
+            self._subs[sid] = (prefix, callback)
+
+        def cancel() -> None:
+            with self._sub_lock:
+                self._subs.pop(sid, None)
+
+        return cancel
+
+    def enqueue(self, path: str, value: str) -> None:
+        """Record one mutation; MUST be called while holding the owner's
+        data lock so queue order is commit order."""
+        with self._q_lock:
+            self._queue.append((path, value))
+
+    def dispatch(self) -> None:
+        """Deliver queued events; call AFTER releasing the data lock.
+        One drainer at a time — a concurrent (or re-entrant, via a
+        callback that stores) dispatch returns immediately and an
+        active or subsequent drainer picks its events up, preserving
+        order.  The outer loop re-checks after releasing the draining
+        flag, so an event enqueued while the flag was still set can
+        never strand."""
+        while True:
+            with self._q_lock:
+                if self._draining or not self._queue:
+                    return
+                self._draining = True
+            try:
+                while True:
+                    with self._q_lock:
+                        if not self._queue:
+                            break
+                        path, value = self._queue.pop(0)
+                    with self._sub_lock:
+                        targets = [
+                            cb
+                            for pfx, cb in self._subs.values()
+                            if _prefix_match(path, pfx)
+                        ]
+                    for cb in targets:
+                        cb(path, value)
+            finally:
+                with self._q_lock:
+                    self._draining = False
+
+
+class _LeaseSweeper:
+    """One lazy daemon thread expiring leased keys at their deadlines.
+
+    ``arm(path, deadline)`` schedules (or re-schedules) a key;
+    ``disarm(path)`` makes it persistent again.  Both bump the path's
+    SEQUENCE; at a deadline the sweeper calls ``expire(path, seq)`` with
+    the sequence captured at arm time.  The owner must re-check
+    ``still_current(path, seq)`` under ITS OWN data lock before deleting
+    — that closes the refresh race end-to-end: a store that completed
+    after the deadline fired bumped the sequence (arm/disarm run under
+    the owner's data lock), so the stale expiry is a no-op, and a store
+    blocked on the data lock runs after the expiry and rewrites the key.
+
+    Lock order everywhere: owner data lock → sweeper condition.  The
+    sweeper itself calls ``expire`` holding NEITHER.
+    """
+
+    def __init__(self, expire: Callable[[str, int], None]) -> None:
+        self._expire = expire
+        self._cond = threading.Condition()
+        self._seq: dict[str, int] = {}
+        self._entries: dict[str, tuple[float, int]] = {}
+        self._heap: list[tuple[float, str, int]] = []
+        self._thread: threading.Thread | None = None
+        self._closed = False
+
+    def arm(self, path: str, deadline: float) -> None:
+        with self._cond:
+            seq = self._seq.get(path, 0) + 1
+            self._seq[path] = seq
+            self._entries[path] = (deadline, seq)
+            heapq.heappush(self._heap, (deadline, path, seq))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="registry-lease-sweep"
+                )
+                self._thread.start()
+            self._cond.notify()
+
+    def disarm(self, path: str) -> None:
+        with self._cond:
+            if path in self._entries or path in self._seq:
+                self._seq[path] = self._seq.get(path, 0) + 1
+            self._entries.pop(path, None)
+            # Stale heap entries are skipped in _run (seq mismatch).
+
+    def still_current(self, path: str, seq: int) -> bool:
+        """True iff no arm/disarm happened since ``seq`` was issued.
+        Call under the owner's data lock to make the expiry decision
+        atomic with the owner's mutations."""
+        with self._cond:
+            return self._seq.get(path) == seq
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            thread = self._thread
+            self._cond.notify()
+        # Join OUTSIDE the condition so an in-flight expire (which
+        # re-enters the owner's store and may need the condition for
+        # still_current) can finish; only then may the owner release its
+        # own resources (e.g. close the SQLite connection).
+        if thread is not None:
+            thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                due: list[tuple[str, int]] = []
+                while self._heap and self._heap[0][0] <= now:
+                    deadline, path, seq = heapq.heappop(self._heap)
+                    # Only the CURRENT entry counts: refreshed/disarmed
+                    # keys leave stale heap entries behind.
+                    if self._entries.get(path) == (deadline, seq):
+                        del self._entries[path]
+                        due.append((path, seq))
+                if not due:
+                    wait = (
+                        self._heap[0][0] - now if self._heap else None
+                    )
+                    self._cond.wait(timeout=wait)
+                    continue
+            for path, seq in due:  # outside the lock: expire re-enters store
+                self._expire(path, seq)
+
+
 class MemRegistryDB:
     """In-memory backend (≙ memRegistryDB, reference memdb.go:21-52)."""
 
     def __init__(self) -> None:
         self._data: dict[str, str] = {}
         self._lock = threading.Lock()
+        self._hub = _EventHub()
+        self._sweeper = _LeaseSweeper(self._expire)
 
-    def store(self, path: str, value: str) -> None:
+    def store(self, path: str, value: str, *, ttl: float | None = None) -> None:
         with self._lock:
             if value == "":
-                self._data.pop(path, None)
+                existed = self._data.pop(path, None) is not None
+                changed = existed
             else:
                 self._data[path] = value
+                changed = True
+            # Arm/disarm under the data lock: the sequence bump is what
+            # defeats a stale expiry racing this store (see _LeaseSweeper).
+            if value == "" or ttl is None:
+                self._sweeper.disarm(path)
+            else:
+                self._sweeper.arm(path, time.monotonic() + ttl)
+            # Enqueue under the lock too: event order = commit order.
+            if changed:
+                self._hub.enqueue(path, value)
+        self._hub.dispatch()
+
+    def _expire(self, path: str, seq: int) -> None:
+        with self._lock:
+            if not self._sweeper.still_current(path, seq):
+                return  # a store since the deadline fired wins
+            existed = self._data.pop(path, None) is not None
+            if existed:
+                self._hub.enqueue(path, "")
+        self._hub.dispatch()
 
     def lookup(self, path: str) -> str:
         with self._lock:
@@ -71,31 +289,81 @@ class MemRegistryDB:
                 (k, v) for k, v in self._data.items() if _prefix_match(k, prefix)
             )
 
+    def watch(self, prefix: str, callback: WatchCallback) -> Callable[[], None]:
+        return self._hub.subscribe(prefix, callback)
+
+    def close(self) -> None:
+        self._sweeper.close()
+
 
 class SqliteRegistryDB:
-    """Durable backend filling the seam the reference reserved for etcd."""
+    """Durable backend filling the seam the reference reserved for etcd.
+
+    Leases survive a registry restart: deadlines are stored as an
+    absolute wall-clock column and re-armed on open, so a key whose
+    writer died while the registry was down still expires.  Watch events
+    are in-process (exactly one registry process owns the file)."""
 
     def __init__(self, path: str) -> None:
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
+        self._hub = _EventHub()
+        self._sweeper = _LeaseSweeper(self._expire)
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS kv (path TEXT PRIMARY KEY, value TEXT)"
             )
+            cols = [
+                r[1]
+                for r in self._conn.execute("PRAGMA table_info(kv)").fetchall()
+            ]
+            if "expires_at" not in cols:  # pre-lease schema migration
+                self._conn.execute("ALTER TABLE kv ADD COLUMN expires_at REAL")
             self._conn.commit()
+            rows = self._conn.execute(
+                "SELECT path, expires_at FROM kv WHERE expires_at IS NOT NULL"
+            ).fetchall()
+        now_wall, now_mono = time.time(), time.monotonic()
+        for key, expires_at in rows:
+            self._sweeper.arm(key, now_mono + max(0.0, expires_at - now_wall))
 
-    def store(self, path: str, value: str) -> None:
+    def store(self, path: str, value: str, *, ttl: float | None = None) -> None:
+        expires_at = time.time() + ttl if ttl is not None else None
         with self._lock:
             if value == "":
-                self._conn.execute("DELETE FROM kv WHERE path = ?", (path,))
+                cur = self._conn.execute(
+                    "DELETE FROM kv WHERE path = ?", (path,)
+                )
+                changed = cur.rowcount > 0
             else:
                 self._conn.execute(
-                    "INSERT INTO kv (path, value) VALUES (?, ?) "
-                    "ON CONFLICT(path) DO UPDATE SET value = excluded.value",
-                    (path, value),
+                    "INSERT INTO kv (path, value, expires_at) VALUES (?, ?, ?) "
+                    "ON CONFLICT(path) DO UPDATE SET value = excluded.value, "
+                    "expires_at = excluded.expires_at",
+                    (path, value, expires_at),
                 )
+                changed = True
             self._conn.commit()
+            # Under the data lock — see MemRegistryDB.store.
+            if value == "" or ttl is None:
+                self._sweeper.disarm(path)
+            else:
+                self._sweeper.arm(path, time.monotonic() + ttl)
+            if changed:
+                self._hub.enqueue(path, value)
+        self._hub.dispatch()
+
+    def _expire(self, path: str, seq: int) -> None:
+        with self._lock:
+            if not self._sweeper.still_current(path, seq):
+                return  # a store since the deadline fired wins
+            cur = self._conn.execute("DELETE FROM kv WHERE path = ?", (path,))
+            existed = cur.rowcount > 0
+            self._conn.commit()
+            if existed:
+                self._hub.enqueue(path, "")
+        self._hub.dispatch()
 
     def lookup(self, path: str) -> str:
         with self._lock:
@@ -121,6 +389,10 @@ class SqliteRegistryDB:
                 ).fetchall()
         return [(r[0], r[1]) for r in rows]
 
+    def watch(self, prefix: str, callback: WatchCallback) -> Callable[[], None]:
+        return self._hub.subscribe(prefix, callback)
+
     def close(self) -> None:
+        self._sweeper.close()
         with self._lock:
             self._conn.close()

@@ -3,11 +3,23 @@
 Fills the seam the reference reserved for etcd but never implemented
 (reference pkg/oim-registry/registry.go:31-41 — "behind the RegistryDB
 interface"; README.md:131-135).  ``EtcdRegistryDB`` is a client of the
-etcd v3 KV gRPC API (proto/etcd/rpc.proto, the Range/Put/DeleteRange
-subset), so a production registry can point at a real etcd cluster for
-replicated durable state (BASELINE.json config 5: N controllers behind an
-etcd-backed registry).  ``EtcdKVServer`` serves the same wire subset from
-a local ``RegistryDB`` — the test double, and a single-binary option.
+etcd v3 gRPC API (proto/etcd/rpc.proto: the KV Range/Put/DeleteRange
+subset plus Watch and Lease Grant/Revoke/KeepAlive), so a production
+registry can point at a real etcd cluster for replicated durable state
+(BASELINE.json config 5: N controllers behind an etcd-backed registry).
+``EtcdKVServer`` serves the same wire subset from a local ``RegistryDB``
+— the test double, and a single-binary option.
+
+Liveness semantics (the production HA story):
+
+- ``store(path, value, ttl=N)`` grants a fresh N-second lease and
+  attaches the key to it; the heartbeat refresh is the next leased
+  store.  A crashed writer's key is deleted by etcd when its last lease
+  expires — with a DELETE watch event — instead of its stale address
+  surviving until overwritten.
+- ``watch(prefix, callback)`` opens a Watch stream and invokes the
+  callback per event; the stream auto-reopens after transient failures
+  (same never-die stance as the controller heartbeat).
 
 Registry paths map to etcd keys as ``<namespace><path>`` (default
 namespace ``/oim/``).  Prefix queries use etcd's range convention
@@ -17,15 +29,23 @@ byte prefix also matches sibling keys like ``foo-bar`` for prefix ``foo``.
 
 from __future__ import annotations
 
+import math
 import threading
-from typing import Callable
+import time
+from typing import Callable, Iterator
 
 import grpc
 
 from oim_tpu import log
-from oim_tpu.registry.db import MemRegistryDB, RegistryDB, _prefix_match
+from oim_tpu.registry.db import (
+    MemRegistryDB,
+    RegistryDB,
+    WatchCallback,
+    _LeaseSweeper,
+    _prefix_match,
+)
 from oim_tpu.spec.gen.etcd import rpc_pb2
-from oim_tpu.spec.rpc import ServiceSpec
+from oim_tpu.spec.rpc import BIDI_STREAM, ServiceSpec
 
 ETCD_KV = ServiceSpec(
     "etcdserverpb.KV",
@@ -33,6 +53,26 @@ ETCD_KV = ServiceSpec(
         "Range": (rpc_pb2.RangeRequest, rpc_pb2.RangeResponse),
         "Put": (rpc_pb2.PutRequest, rpc_pb2.PutResponse),
         "DeleteRange": (rpc_pb2.DeleteRangeRequest, rpc_pb2.DeleteRangeResponse),
+    },
+)
+
+ETCD_WATCH = ServiceSpec(
+    "etcdserverpb.Watch",
+    {
+        "Watch": (rpc_pb2.WatchRequest, rpc_pb2.WatchResponse, BIDI_STREAM),
+    },
+)
+
+ETCD_LEASE = ServiceSpec(
+    "etcdserverpb.Lease",
+    {
+        "LeaseGrant": (rpc_pb2.LeaseGrantRequest, rpc_pb2.LeaseGrantResponse),
+        "LeaseRevoke": (rpc_pb2.LeaseRevokeRequest, rpc_pb2.LeaseRevokeResponse),
+        "LeaseKeepAlive": (
+            rpc_pb2.LeaseKeepAliveRequest,
+            rpc_pb2.LeaseKeepAliveResponse,
+            BIDI_STREAM,
+        ),
     },
 )
 
@@ -70,20 +110,31 @@ class EtcdRegistryDB:
         self._channel_factory = channel_factory or self._dial
         self._lock = threading.Lock()
         self._channel: grpc.Channel | None = None
+        self._closed = False
+        self._watch_cancels: set = set()
 
     def _dial(self) -> grpc.Channel:
         from oim_tpu.common import endpoint as ep
+        from oim_tpu.common.regdial import KEEPALIVE_OPTIONS
 
         target = ep.parse(self.endpoint).grpc_target()
+        # Keepalive: the Watch stream idles for hours on a quiet fleet;
+        # pings surface silently-dropped connections as RpcErrors the
+        # reopen loop can handle.
         if self._credentials is not None:
-            return grpc.secure_channel(target, self._credentials)
-        return grpc.insecure_channel(target)
+            return grpc.secure_channel(
+                target, self._credentials, options=KEEPALIVE_OPTIONS
+            )
+        return grpc.insecure_channel(target, options=KEEPALIVE_OPTIONS)
 
-    def _stub(self):
+    def _channel_get(self) -> grpc.Channel:
+        """THE lazy-create path for the shared persistent channel."""
         with self._lock:
+            if self._closed:
+                raise RuntimeError("EtcdRegistryDB is closed")
             if self._channel is None:
                 self._channel = self._channel_factory()
-            return ETCD_KV.stub(self._channel)
+            return self._channel
 
     def _reset(self) -> None:
         with self._lock:
@@ -95,8 +146,9 @@ class EtcdRegistryDB:
                 self._channel = None
 
     def _call(self, fn):
+        """Run ``fn(channel)`` with one reconnect retry on UNAVAILABLE."""
         try:
-            return fn(self._stub())
+            return fn(self._channel_get())
         except grpc.RpcError as exc:
             if exc.code() != grpc.StatusCode.UNAVAILABLE:
                 raise
@@ -104,32 +156,213 @@ class EtcdRegistryDB:
                 "etcd unavailable; redialing", endpoint=self.endpoint
             )
             self._reset()
-            return fn(self._stub())
+            return fn(self._channel_get())
 
     def _key(self, path: str) -> bytes:
         return (self.namespace + path).encode()
 
     # -- RegistryDB --------------------------------------------------------
 
-    def store(self, path: str, value: str) -> None:
+    def store(self, path: str, value: str, *, ttl: float | None = None) -> None:
         if value == "":
             self._call(
-                lambda s: s.DeleteRange(
+                lambda ch: ETCD_KV.stub(ch).DeleteRange(
                     rpc_pb2.DeleteRangeRequest(key=self._key(path)),
                     timeout=self.timeout,
                 )
             )
-        else:
-            self._call(
-                lambda s: s.Put(
-                    rpc_pb2.PutRequest(key=self._key(path), value=value.encode()),
-                    timeout=self.timeout,
-                )
+            return
+        lease_id = 0
+        if ttl is not None:
+            # A fresh lease per leased store: the heartbeat's next store
+            # re-attaches the key to a new lease, and the old, now-empty
+            # lease expires harmlessly.  This keeps the liveness contract
+            # ("key gone TTL after the last refresh") with zero client
+            # state — no keepalive stream to babysit across reconnects.
+            grant = self._grant(ttl)
+            lease_id = grant.ID
+        self._call(
+            lambda ch: ETCD_KV.stub(ch).Put(
+                rpc_pb2.PutRequest(
+                    key=self._key(path), value=value.encode(), lease=lease_id
+                ),
+                timeout=self.timeout,
             )
+        )
+
+    # -- Lease helpers -----------------------------------------------------
+
+    def _grant(self, ttl: float) -> rpc_pb2.LeaseGrantResponse:
+        return self._call(
+            lambda ch: ETCD_LEASE.stub(ch).LeaseGrant(
+                rpc_pb2.LeaseGrantRequest(TTL=max(1, math.ceil(ttl))),
+                timeout=self.timeout,
+            )
+        )
+
+    def keepalive_once(self, lease_id: int) -> int:
+        """One keep-alive round-trip; returns the remaining TTL (0 = the
+        lease no longer exists).  Exposed for embedders that manage a
+        long-lived lease themselves rather than re-storing."""
+
+        def call(channel):
+            replies = ETCD_LEASE.stub(channel).LeaseKeepAlive(
+                iter([rpc_pb2.LeaseKeepAliveRequest(ID=lease_id)]),
+                timeout=self.timeout,
+            )
+            for reply in replies:
+                return reply.TTL
+            return 0
+
+        return self._call(call)
+
+    # -- Watch -------------------------------------------------------------
+
+    def watch(self, prefix: str, callback: WatchCallback) -> Callable[[], None]:
+        """Watch ``prefix`` via an etcd Watch stream on a background
+        thread.  The stream re-opens after transient failures until
+        cancelled; events are re-filtered on path-segment boundaries like
+        ``items``."""
+        stop = threading.Event()
+        ready = threading.Event()  # set at the create confirmation
+        ns = len(self.namespace)
+        start = self._key(prefix) if prefix else self.namespace.encode()
+
+        state: dict = {"call": None}
+
+        # Last-known state under the prefix, maintained by the watch
+        # thread: the reopen RESYNC diffs a fresh Range against it and
+        # synthesizes the PUT/DELETE events the outage swallowed.
+        # Without this, a deregistration during an etcd blip would be
+        # lost forever — the stream comes back healthy, so no
+        # subscriber-side reconcile would ever fire again.
+        known: dict[str, str] = {}
+        seeded = False
+
+        def safe_callback(path: str, value: str) -> None:
+            try:
+                callback(path, value)
+            except Exception as exc:
+                # A broken subscriber must not kill the watch for
+                # every future event.
+                log.current().error(
+                    "watch callback failed", path=path, error=str(exc)
+                )
+
+        def resync() -> None:
+            nonlocal seeded
+            snapshot = dict(self.items(prefix))
+            if not seeded:
+                # First open: subscribers take their own initial
+                # snapshot (e.g. WatchValues send_initial); just seed.
+                known.update(snapshot)
+                seeded = True
+                return
+            for path in list(known):
+                if path not in snapshot:
+                    known.pop(path)
+                    safe_callback(path, "")
+            for path, value in snapshot.items():
+                if known.get(path) != value:
+                    known[path] = value
+                    safe_callback(path, value)
+
+        def deliver(reply) -> None:
+            for event in reply.events:
+                try:
+                    path = event.kv.key.decode()[ns:]
+                except UnicodeDecodeError:
+                    continue  # foreign binary key in the namespace
+                if not _prefix_match(path, prefix):
+                    continue
+                deleted = event.type == rpc_pb2.Event.DELETE
+                value = "" if deleted else event.kv.value.decode()
+                if deleted:
+                    known.pop(path, None)
+                else:
+                    known[path] = value
+                safe_callback(path, value)
+
+        def run() -> None:
+            # Exponential reopen backoff, reset on any received reply;
+            # only the FIRST failure after a healthy stream logs at
+            # warning (an etcd outage must not flood the log at the
+            # retry cadence).  The loop survives ANY exception — the
+            # never-die heartbeat stance; only cancel/close end it.
+            backoff, healthy = 0.5, True
+            while not stop.is_set():
+                try:
+                    stub = ETCD_WATCH.stub(self._channel_get())
+                    create = rpc_pb2.WatchRequest(
+                        create_request=rpc_pb2.WatchCreateRequest(
+                            key=start, range_end=_successor(start)
+                        )
+                    )
+                    call = stub.Watch(iter([create]))
+                    state["call"] = call
+                    synced = False
+                    for reply in call:
+                        backoff, healthy = 0.5, True
+                        if not synced:
+                            # The create confirmation arrived: the
+                            # stream is live, so a Range here + the
+                            # events after it misses nothing.
+                            resync()
+                            synced = True
+                        ready.set()
+                        deliver(reply)
+                    # Clean end-of-stream (server shutdown): back off
+                    # before reopening, same as the error path.
+                    stop.wait(backoff)
+                except RuntimeError:
+                    return  # db closed
+                except Exception as exc:
+                    is_rpc = isinstance(exc, grpc.RpcError)
+                    if stop.is_set() or (
+                        is_rpc and exc.code() == grpc.StatusCode.CANCELLED
+                    ):
+                        return
+                    logger = (
+                        log.current().warning if healthy else log.current().debug
+                    )
+                    logger(
+                        "etcd watch interrupted; reopening",
+                        endpoint=self.endpoint,
+                        error=exc.code().name if is_rpc else repr(exc),
+                        retry_in=backoff,
+                    )
+                    healthy = False
+                    if is_rpc:
+                        self._reset()
+                    stop.wait(backoff)
+                    backoff = min(backoff * 2, 15.0)
+
+        thread = threading.Thread(
+            target=run, daemon=True, name=f"etcd-watch-{prefix or '*'}"
+        )
+        thread.start()
+        # Don't return until the watch is live (the create confirmation
+        # arrived): a caller that stores immediately after watch() must
+        # see the event.  Bounded — an unreachable etcd degrades to the
+        # reopen loop rather than blocking the caller forever.
+        ready.wait(timeout=self.timeout)
+
+        def cancel() -> None:
+            with self._lock:
+                self._watch_cancels.discard(cancel)
+            stop.set()
+            call = state.get("call")
+            if call is not None:
+                call.cancel()
+            thread.join(timeout=5)
+
+        with self._lock:
+            self._watch_cancels.add(cancel)
+        return cancel
 
     def lookup(self, path: str) -> str:
         reply = self._call(
-            lambda s: s.Range(
+            lambda ch: ETCD_KV.stub(ch).Range(
                 rpc_pb2.RangeRequest(key=self._key(path)), timeout=self.timeout
             )
         )
@@ -138,7 +371,7 @@ class EtcdRegistryDB:
     def items(self, prefix: str) -> list[tuple[str, str]]:
         start = self._key(prefix) if prefix else self.namespace.encode()
         reply = self._call(
-            lambda s: s.Range(
+            lambda ch: ETCD_KV.stub(ch).Range(
                 rpc_pb2.RangeRequest(
                     key=start,
                     range_end=_successor(start),
@@ -162,32 +395,134 @@ class EtcdRegistryDB:
         return [k for k, _ in self.items(prefix)]
 
     def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            cancels = list(self._watch_cancels)
+            self._watch_cancels.clear()
+        for cancel in cancels:  # ends the watch threads for real —
+            cancel()  # a closed DB must not keep redialing etcd
         self._reset()
 
 
+def _range_contains(key: bytes, start: bytes, range_end: bytes) -> bool:
+    """etcd range membership: no range_end = exact key; "\\0" = all keys
+    >= start; otherwise [start, range_end)."""
+    if not range_end:
+        return key == start
+    if range_end == b"\0":
+        return key >= start
+    return start <= key < range_end
+
+
+class _WatchSession:
+    """One Watch RPC: its outbound queue plus the watches multiplexed on
+    it (watch_id → (key, range_end))."""
+
+    def __init__(self) -> None:
+        import queue
+
+        self.queue: "queue.Queue[rpc_pb2.WatchResponse | None]" = queue.Queue()
+        self.watches: dict[int, tuple[bytes, bytes]] = {}
+        self.lock = threading.Lock()
+        self.next_id = 1
+
+
 class EtcdKVServer:
-    """etcdserverpb.KV servicer over a local RegistryDB store.
+    """etcdserverpb KV/Watch/Lease servicer over a local RegistryDB store.
 
     The test double for EtcdRegistryDB — and, served from
     ``registry_main --etcd-listen``, a single-binary stand-in where a real
     etcd cluster is overkill.  Implements the Range/Put/DeleteRange subset
-    with a monotonically increasing revision, enough for any client using
-    etcd as a plain KV (prefix ranges, single-key gets, deletes).
+    with a monotonically increasing revision, Watch (create/cancel
+    multiplexing, PUT/DELETE events), and Lease (grant/revoke/keepalive
+    with real expiry: an expired lease deletes its attached keys and
+    emits DELETE events) — enough for any client using etcd as a plain
+    KV with liveness, which is exactly what EtcdRegistryDB is.
     """
 
     def __init__(self, db: RegistryDB | None = None) -> None:
         self.db = db if db is not None else MemRegistryDB()
         self._revision = 1
         self._lock = threading.Lock()
-
-    def _bump(self) -> int:
-        with self._lock:
-            self._revision += 1
-            return self._revision
+        self._sessions: set[_WatchSession] = set()
+        self._sessions_lock = threading.Lock()
+        self._event_q: list[tuple[str, str, bool, int]] = []
+        self._event_lock = threading.Lock()
+        self._ev_draining = False
+        # Lease state: id → attached keys; key → owning lease.  The
+        # sweeper expires by stringified lease id.
+        self._leases: dict[int, set[str]] = {}
+        self._lease_ttl: dict[int, int] = {}
+        self._key_lease: dict[str, int] = {}
+        self._next_lease = int(time.time()) << 16
+        self._lease_sweeper = _LeaseSweeper(self._expire_lease)
 
     def _header(self) -> rpc_pb2.ResponseHeader:
         with self._lock:
             return rpc_pb2.ResponseHeader(revision=self._revision)
+
+    # -- Watch fan-out -----------------------------------------------------
+    #
+    # Ordering contract: mutators append to _event_q while HOLDING
+    # self._lock (queue order = revision order) and call
+    # _dispatch_events after releasing it; one drainer at a time fans
+    # out to sessions, so two racing mutations of one key can never
+    # reach a watcher reversed (the _EventHub discipline, server-side).
+
+    def _enqueue_event(self, key: str, value: str, deleted: bool) -> None:
+        """Call while holding self._lock (after the revision bump) —
+        that is what makes queue order equal revision order."""
+        with self._event_lock:
+            self._event_q.append((key, value, deleted, self._revision))
+
+    def _dispatch_events(self) -> None:
+        while True:
+            with self._event_lock:
+                if self._ev_draining or not self._event_q:
+                    return
+                self._ev_draining = True
+            try:
+                while True:
+                    with self._event_lock:
+                        if not self._event_q:
+                            break
+                        key, value, deleted, revision = self._event_q.pop(0)
+                    self._fan_out(key, value, deleted, revision)
+            finally:
+                with self._event_lock:
+                    self._ev_draining = False
+
+    def _fan_out(
+        self, key: str, value: str, deleted: bool, revision: int
+    ) -> None:
+        kb = key.encode()
+        with self._sessions_lock:
+            sessions = list(self._sessions)
+        for session in sessions:
+            with session.lock:
+                matched = [
+                    wid
+                    for wid, (start, range_end) in session.watches.items()
+                    if _range_contains(kb, start, range_end)
+                ]
+            for wid in matched:
+                event = rpc_pb2.Event(
+                    type=(
+                        rpc_pb2.Event.DELETE if deleted else rpc_pb2.Event.PUT
+                    ),
+                    kv=rpc_pb2.KeyValue(
+                        key=kb,
+                        value=b"" if deleted else value.encode(),
+                        mod_revision=revision,
+                    ),
+                )
+                session.queue.put(
+                    rpc_pb2.WatchResponse(
+                        header=rpc_pb2.ResponseHeader(revision=revision),
+                        watch_id=wid,
+                        events=[event],
+                    )
+                )
 
     # Stored keys are raw (namespace included); this server does not
     # interpret paths, exactly like etcd.
@@ -200,11 +535,13 @@ class EtcdKVServer:
             if value:
                 reply.kvs.add(key=request.key, value=value.encode())
         else:
-            end = request.range_end.decode()
-            # db.items("") is every key; range-filter client-side.  The
-            # in-process store is small by construction.
+            # db.items("") is every key; range-filter client-side with
+            # the same membership rule watches use.  The in-process
+            # store is small by construction.
             for path, value in self.db.items(""):
-                if key <= path < end or request.range_end == b"\0":
+                if _range_contains(
+                    path.encode(), request.key, request.range_end
+                ):
                     reply.kvs.add(key=path.encode(), value=value.encode())
             if request.sort_order == rpc_pb2.RangeRequest.DESCEND:
                 reversed_kvs = list(reversed(reply.kvs))
@@ -219,30 +556,228 @@ class EtcdKVServer:
     def Put(self, request, context) -> rpc_pb2.PutResponse:
         if not request.key:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "key required")
-        self.db.store(request.key.decode(), request.value.decode())
-        self._bump()
+        key = request.key.decode()
+        value = request.value.decode()
+        # Lease check, store, and attach are ONE critical section: a
+        # lease expiring mid-Put either beats the check (NOT_FOUND, the
+        # heartbeat retries with a fresh lease) or waits for the whole
+        # Put and then deletes the attached key — never a key stored
+        # persistent because its lease vanished between two lock takes.
+        with self._lock:
+            if request.lease and request.lease not in self._leases:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    "etcdserverpb: requested lease not found",
+                )
+            self.db.store(key, value)
+            # Re-attaching a key moves it between leases (etcd semantics:
+            # a key belongs to the lease of its LAST put; a put without a
+            # lease makes it persistent).
+            old = self._key_lease.pop(key, None)
+            if old is not None and old in self._leases:
+                self._leases[old].discard(key)
+            if request.lease:
+                self._leases[request.lease].add(key)
+                self._key_lease[key] = request.lease
+            self._revision += 1
+            self._enqueue_event(key, value, deleted=False)
+        self._dispatch_events()
         return rpc_pb2.PutResponse(header=self._header())
+
+    def _delete_locked(self, key: str) -> bool:
+        """Delete + detach under self._lock; caller notifies after
+        releasing it (``_notify`` re-takes the lock for the header)."""
+        if not self.db.lookup(key):
+            return False
+        self.db.store(key, "")
+        lease = self._key_lease.pop(key, None)
+        if lease is not None and lease in self._leases:
+            self._leases[lease].discard(key)
+        return True
 
     def DeleteRange(self, request, context) -> rpc_pb2.DeleteRangeResponse:
         key = request.key.decode()
-        deleted = 0
-        if not request.range_end:
-            if self.db.lookup(key):
-                self.db.store(key, "")
-                deleted = 1
-        else:
-            end = request.range_end.decode()
-            for path, _ in self.db.items(""):
-                if key <= path < end or request.range_end == b"\0":
-                    self.db.store(path, "")
-                    deleted += 1
-        if deleted:
-            self._bump()
-        return rpc_pb2.DeleteRangeResponse(header=self._header(), deleted=deleted)
+        deleted: list[str] = []
+        with self._lock:
+            if not request.range_end:
+                candidates = [key]
+            else:
+                candidates = [
+                    path
+                    for path, _ in self.db.items("")
+                    if _range_contains(
+                        path.encode(), request.key, request.range_end
+                    )
+                ]
+            for path in candidates:
+                if self._delete_locked(path):
+                    deleted.append(path)
+            if deleted:
+                self._revision += 1
+            for path in deleted:
+                self._enqueue_event(path, "", deleted=True)
+        self._dispatch_events()
+        return rpc_pb2.DeleteRangeResponse(
+            header=self._header(), deleted=len(deleted)
+        )
 
-    def start_server(self, endpoint: str, tls=None):
+    # -- Watch service -----------------------------------------------------
+
+    def Watch(self, request_iterator, context) -> Iterator[rpc_pb2.WatchResponse]:
+        session = _WatchSession()
+        with self._sessions_lock:
+            self._sessions.add(session)
+
+        def read_requests() -> None:
+            try:
+                for request in request_iterator:
+                    which = request.WhichOneof("request_union")
+                    if which == "create_request":
+                        create = request.create_request
+                        with session.lock:
+                            wid = create.watch_id or session.next_id
+                            session.next_id = max(session.next_id, wid) + 1
+                            session.watches[wid] = (
+                                bytes(create.key),
+                                bytes(create.range_end),
+                            )
+                        session.queue.put(
+                            rpc_pb2.WatchResponse(
+                                header=self._header(),
+                                watch_id=wid,
+                                created=True,
+                            )
+                        )
+                    elif which == "cancel_request":
+                        wid = request.cancel_request.watch_id
+                        with session.lock:
+                            session.watches.pop(wid, None)
+                        session.queue.put(
+                            rpc_pb2.WatchResponse(
+                                header=self._header(),
+                                watch_id=wid,
+                                canceled=True,
+                            )
+                        )
+            except Exception:
+                pass  # client hung up mid-read; the RPC callback ends us
+            # NOTE: request-stream exhaustion (client half-close) does NOT
+            # end the watch — events keep flowing until the RPC terminates,
+            # matching etcd.
+
+        reader = threading.Thread(target=read_requests, daemon=True)
+        reader.start()
+        # End the response loop when the RPC terminates (client cancel,
+        # disconnect, server shutdown).  add_callback returns False when
+        # the RPC already terminated — the callback will never fire, so
+        # enqueue the sentinel ourselves or the worker blocks forever.
+        if not context.add_callback(lambda: session.queue.put(None)):
+            session.queue.put(None)
+        try:
+            while True:
+                response = session.queue.get()
+                if response is None:
+                    return
+                yield response
+        finally:
+            with self._sessions_lock:
+                self._sessions.discard(session)
+
+    # -- Lease service -----------------------------------------------------
+
+    def LeaseGrant(self, request, context) -> rpc_pb2.LeaseGrantResponse:
+        ttl = max(1, int(request.TTL))
+        with self._lock:
+            lease_id = request.ID or self._next_lease
+            self._next_lease = max(self._next_lease, lease_id) + 1
+            if request.ID and request.ID in self._leases:
+                return rpc_pb2.LeaseGrantResponse(
+                    header=self._header(),
+                    error="lease already exists",
+                )
+            self._leases[lease_id] = set()
+            self._lease_ttl[lease_id] = ttl
+            self._lease_sweeper.arm(str(lease_id), time.monotonic() + ttl)
+        return rpc_pb2.LeaseGrantResponse(
+            header=self._header(), ID=lease_id, TTL=ttl
+        )
+
+    def _expire_lease(self, lease_id_str: str, seq: int) -> None:
+        self._revoke(int(lease_id_str), seq=seq)
+
+    def _revoke(self, lease_id: int, seq: int | None = None) -> bool:
+        """Revoke + delete attached keys atomically (one critical
+        section, like etcd's raft-applied revoke).  ``seq`` set = expiry
+        path: a keep-alive that re-armed since this deadline fired wins
+        (``still_current`` checked under the same lock the keep-alive
+        arms under)."""
+        deleted: list[str] = []
+        with self._lock:
+            if seq is not None and not self._lease_sweeper.still_current(
+                str(lease_id), seq
+            ):
+                return False
+            keys = self._leases.pop(lease_id, None)
+            self._lease_ttl.pop(lease_id, None)
+            self._lease_sweeper.disarm(str(lease_id))
+            if keys is None:
+                return False
+            for key in keys:
+                # Only keys still attached to THIS lease die with it.
+                if self._key_lease.get(key) == lease_id:
+                    self._key_lease.pop(key, None)
+                    if self.db.lookup(key):
+                        self.db.store(key, "")
+                        deleted.append(key)
+            if deleted:
+                self._revision += 1
+            for key in deleted:
+                self._enqueue_event(key, "", deleted=True)
+        self._dispatch_events()
+        return True
+
+    def LeaseRevoke(self, request, context) -> rpc_pb2.LeaseRevokeResponse:
+        if not self._revoke(request.ID):
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                "etcdserverpb: requested lease not found",
+            )
+        return rpc_pb2.LeaseRevokeResponse(header=self._header())
+
+    def LeaseKeepAlive(
+        self, request_iterator, context
+    ) -> Iterator[rpc_pb2.LeaseKeepAliveResponse]:
+        for request in request_iterator:
+            with self._lock:
+                known = request.ID in self._leases
+                ttl = self._lease_ttl.get(request.ID, 0)
+                if known:
+                    self._lease_sweeper.arm(
+                        str(request.ID), time.monotonic() + ttl
+                    )
+            yield rpc_pb2.LeaseKeepAliveResponse(
+                header=self._header(),
+                ID=request.ID,
+                TTL=ttl if known else 0,
+            )
+
+    def close(self) -> None:
+        self._lease_sweeper.close()
+
+    def start_server(self, endpoint: str, tls=None, max_workers: int = 64):
         from oim_tpu.common.server import NonBlockingGRPCServer
 
-        srv = NonBlockingGRPCServer(endpoint, tls=tls)
-        srv.start(ETCD_KV.registrar(self))
+        # Each Watch RPC pins a worker for its lifetime (sync gRPC), so
+        # the pool must dwarf the expected watcher count or watchers
+        # starve Put/Range — including the heartbeats whose leases then
+        # expire fleet-wide.  Same sizing rationale as
+        # Registry.start_server.
+        srv = NonBlockingGRPCServer(endpoint, tls=tls, max_workers=max_workers)
+
+        def register(server):
+            ETCD_KV.registrar(self)(server)
+            ETCD_WATCH.registrar(self)(server)
+            ETCD_LEASE.registrar(self)(server)
+
+        srv.start(register)
         return srv

@@ -14,6 +14,7 @@
 
 from __future__ import annotations
 
+import threading
 from typing import Iterator
 
 import grpc
@@ -44,10 +45,14 @@ class Registry:
         db: RegistryDB | None = None,
         tls: TLSConfig | None = None,
         proxy_dial_timeout: float = 10.0,
+        max_watchers: int = 32,
     ) -> None:
         self.db = db if db is not None else MemRegistryDB()
         self.tls = tls
         self.proxy_dial_timeout = proxy_dial_timeout
+        self.max_watchers = max_watchers
+        self._watchers = 0
+        self._watchers_lock = threading.Lock()
         # Proxy channels are reused across calls keyed on the controller's
         # *registered address* — a re-registration at a new address
         # re-dials, so the reference's dial-per-call routing behavior
@@ -63,6 +68,23 @@ class Registry:
         )
         self._keys_cb = lambda: len(self.db.keys(""))
         self._keys_gauge.set_function(self._keys_cb)
+        # Event-driven proxy invalidation: when a controller's address key
+        # changes or expires, drop its cached channel immediately so the
+        # next proxied call re-resolves — a dead controller's channel no
+        # longer lingers until its address slot is overwritten.  (A watch
+        # on the local DB, not gRPC: the registry owns its store.)
+        self._cancel_watch = None
+        if hasattr(self.db, "watch"):
+            self._cancel_watch = self.db.watch("", self._on_address_event)
+
+    def _on_address_event(self, path: str, value: str) -> None:
+        # Only deletions (explicit or lease expiry) invalidate: an address
+        # CHANGE already re-dials via the cache's fingerprint key, and a
+        # heartbeat re-put of the same address must NOT churn a healthy
+        # cached channel.
+        parts = path.split("/")
+        if len(parts) == 2 and parts[1] == "address" and value == "":
+            self._proxy_channels.invalidate(parts[0])
 
     # -- KV service --------------------------------------------------------
 
@@ -72,9 +94,20 @@ class Registry:
         except ValueError as exc:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
         self._check_set_allowed(path, context)
-        self.db.store(path, request.value.value)
+        if request.ttl_seconds < 0:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "ttl_seconds must be >= 0"
+            )
+        self.db.store(
+            path,
+            request.value.value,
+            ttl=request.ttl_seconds if request.ttl_seconds > 0 else None,
+        )
         log.current().info(
-            "registry set", path=path, deleted=request.value.value == ""
+            "registry set",
+            path=path,
+            deleted=request.value.value == "",
+            ttl=request.ttl_seconds or None,
         )
         return oim_pb2.SetValueReply()
 
@@ -89,6 +122,60 @@ class Registry:
         for key, value in self.db.items(prefix):
             reply.values.add(path=key, value=value)
         return reply
+
+    def WatchValues(
+        self, request: oim_pb2.WatchValuesRequest, context
+    ) -> Iterator[oim_pb2.WatchValuesReply]:
+        """Stream mutations under a prefix (value "" = deleted).  Bridges
+        the DB's watch callback into the response stream via a queue; the
+        subscription is registered BEFORE the initial snapshot, and the
+        snapshot ends with an ``initial_done`` marker, so a client that
+        reconciles at the marker and applies every later event misses
+        nothing (a duplicate reply is possible and harmless — watchers
+        are reconcilers, not counters).
+
+        Each stream pins one server worker thread (sync gRPC), so
+        concurrent watchers are capped: beyond ``max_watchers`` the call
+        fails RESOURCE_EXHAUSTED and the client degrades to GetValues
+        polling — discovery gets slower, the registry stays alive."""
+        import queue as _queue
+
+        prefix = ""
+        if request.path:
+            try:
+                prefix = pathutil.clean_path(request.path)
+            except ValueError as exc:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        with self._watchers_lock:
+            if self._watchers >= self.max_watchers:
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"watcher limit ({self.max_watchers}) reached; "
+                    "poll GetValues instead",
+                )
+            self._watchers += 1
+        events: "_queue.Queue[tuple[str, str]]" = _queue.Queue()
+        cancel = self.db.watch(prefix, lambda p, v: events.put((p, v)))
+        context.add_callback(cancel)
+        try:
+            if request.send_initial:
+                for key, value in self.db.items(prefix):
+                    yield oim_pb2.WatchValuesReply(
+                        value=oim_pb2.Value(path=key, value=value)
+                    )
+                yield oim_pb2.WatchValuesReply(initial_done=True)
+            while context.is_active():
+                try:
+                    path, value = events.get(timeout=0.5)
+                except _queue.Empty:
+                    continue
+                yield oim_pb2.WatchValuesReply(
+                    value=oim_pb2.Value(path=path, value=value)
+                )
+        finally:
+            cancel()
+            with self._watchers_lock:
+                self._watchers -= 1
 
     def _check_set_allowed(self, path: str, context) -> None:
         """CN-based write authorization (≙ registry.go:100-109).
@@ -279,6 +366,10 @@ class Registry:
                 metrics.MetricsServerInterceptor("oim-registry"),
                 LogServerInterceptor(),
             ),
+            # Each WatchValues stream pins a worker for its lifetime
+            # (sync gRPC); size the pool so a full house of watchers
+            # still leaves headroom for KV calls and proxied traffic.
+            max_workers=self.max_watchers + 16,
         )
         srv.start(self.registrar())
         return srv
@@ -287,5 +378,7 @@ class Registry:
         """Release cached proxy channels and deregister gauges (embedders
         that stop/start many registries in one process; a daemon just
         exits)."""
+        if self._cancel_watch is not None:
+            self._cancel_watch()
         self._proxy_channels.close()
         self._keys_gauge.remove(fn=self._keys_cb)

@@ -44,7 +44,9 @@ class ServeRegistration:
         self._thread: threading.Thread | None = None
 
     def register(self) -> None:
-        """One registration: fresh dial → SetValue → close."""
+        """One registration: fresh dial → SetValue → close.  The key is
+        leased (3× the heartbeat delay): a crashed instance's address
+        expires with a watch event instead of lingering."""
         from oim_tpu.common.regdial import registry_channel
         from oim_tpu.spec import REGISTRY, oim_pb2
 
@@ -54,7 +56,8 @@ class ServeRegistration:
                     value=oim_pb2.Value(
                         path=f"serve/{self.serve_id}/address",
                         value=self.advertised_address,
-                    )
+                    ),
+                    ttl_seconds=max(1, int(self.delay * 3)),
                 ),
                 timeout=10,
             )
@@ -63,6 +66,30 @@ class ServeRegistration:
             id=self.serve_id,
             address=self.advertised_address,
         )
+
+    def deregister(self) -> None:
+        """Best-effort immediate removal of the discovery key (graceful
+        drain): routers watching ``serve/`` stop sending new requests at
+        the DELETE event rather than at lease expiry."""
+        from oim_tpu.common.regdial import registry_channel
+        from oim_tpu.spec import REGISTRY, oim_pb2
+
+        try:
+            with registry_channel(self.registry_address, self.tls) as channel:
+                REGISTRY.stub(channel).SetValue(
+                    oim_pb2.SetValueRequest(
+                        value=oim_pb2.Value(
+                            path=f"serve/{self.serve_id}/address", value=""
+                        )
+                    ),
+                    timeout=5,
+                )
+        except Exception as exc:
+            # The lease still expires the key; deregistration only
+            # accelerates it.
+            log.current().warning(
+                "serve deregistration failed", error=str(exc)
+            )
 
     def _loop(self) -> None:
         while not self._stop.wait(self.delay):
@@ -83,8 +110,10 @@ class ServeRegistration:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, deregister: bool = True) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if deregister:
+            self.deregister()

@@ -78,7 +78,13 @@ class Router:
         discover_interval: float = 5.0,
         unhealthy_after: int = 2,
         request_timeout: float = 600.0,
+        ssl_context=None,
+        client_ssl_context=None,
     ):
+        """``ssl_context`` wraps the router's own listener in mTLS;
+        ``client_ssl_context`` authenticates the router to mTLS
+        backends (httptls module — the reference's mTLS-everywhere
+        stance on the serving data plane)."""
         if not backends and not registry_address:
             raise ValueError(
                 "router needs static --backend urls or a registry address"
@@ -100,6 +106,11 @@ class Router:
         self._probe_pool = futures.ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="router-probe"
         )
+        self._watch_call = None  # in-flight WatchValues stream, for stop()
+        from oim_tpu.serve.httptls import opener as _tls_opener
+
+        self._client_ssl = client_ssl_context
+        self._opener = _tls_opener(client_ssl_context)
         self._requests = metrics.registry().counter(
             "oim_route_requests_total",
             "Requests proxied by the serving router",
@@ -161,7 +172,15 @@ class Router:
                 )
                 outer._proxy(self, self.path, body, headers)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        if ssl_context is not None:
+            from oim_tpu.serve.httptls import TLSThreadingHTTPServer
+
+            self._httpd = TLSThreadingHTTPServer(
+                (host, port), Handler, ssl_context
+            )
+        else:
+            self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.tls = ssl_context is not None
         self.host, self.port = self._httpd.server_address[:2]
         self._http_thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
@@ -244,9 +263,7 @@ class Router:
                 backend.url + path, data=body, headers=headers
             )
             try:
-                resp = urllib.request.urlopen(
-                    req, timeout=self.request_timeout
-                )
+                resp = self._opener.open(req, timeout=self.request_timeout)
             except urllib.error.HTTPError as exc:
                 # The backend answered — pass its error through verbatim
                 # (its body is JSON already) and do not retry.
@@ -336,7 +353,7 @@ class Router:
     def _probe(self, backend: Backend) -> None:
         err: Exception | None = None
         try:
-            with urllib.request.urlopen(
+            with self._opener.open(
                 backend.url + "/healthz", timeout=2
             ) as resp:
                 ok = resp.status == 200
@@ -346,7 +363,7 @@ class Router:
             # swallowing those silently would pin the backend healthy
             # forever.  Logged below on the healthy→unhealthy transition
             # only, never per-tick.
-            err = exc if not isinstance(exc, OSError) else None
+            err = exc
             ok = False
         with self._lock:
             if ok:
@@ -398,24 +415,117 @@ class Router:
                 self._probing.discard(backend.id)
 
     def _discover_loop(self) -> None:
-        while True:
+        """Event-driven discovery: hold a registry WatchValues stream on
+        the ``serve/`` prefix and apply each mutation as it happens — a
+        deregistered or lease-expired backend leaves the table at the
+        DELETE event, in milliseconds, not at the next poll tick.  On
+        stream failure, back off ``discover_interval`` and reconnect
+        (the controller heartbeat's never-die rule); each reconnect
+        starts with a full reconcile, so missed events can't strand a
+        stale backend."""
+        while not self._stop.is_set():
             try:
-                self._discover_once()
+                self._watch_discover()
             except Exception as exc:
-                # Discovery must outlive registry restarts (the
-                # controller heartbeat's never-die rule).
+                if self._stop.is_set():
+                    return
                 log.current().warning(
-                    "registry discovery failed",
+                    "registry watch discovery failed; polling this tick",
                     registry=self.registry_address,
                     error=str(exc),
                 )
+                # Degrade to poll cadence while the watch path is broken
+                # (old server, watcher cap RESOURCE_EXHAUSTED, registry
+                # bounce): slower discovery beats none.
+                try:
+                    self._discover_once()
+                except Exception:
+                    pass
             if self._stop.wait(self.discover_interval):
                 return
 
+    def _watch_discover(self) -> None:
+        """One watch session.  ``send_initial`` snapshot → reconcile at
+        the ``initial_done`` marker → apply live events.  The server
+        subscribes BEFORE snapshotting, so nothing falls between the
+        snapshot and the event stream (doc/spec.md WatchValuesReply)."""
+        from oim_tpu.common.regdial import registry_channel
+        from oim_tpu.spec import REGISTRY, oim_pb2
+
+        with registry_channel(self.registry_address, self._tls) as channel:
+            stub = REGISTRY.stub(channel)
+            call = stub.WatchValues(
+                oim_pb2.WatchValuesRequest(path="serve", send_initial=True)
+            )
+            self._watch_call = call
+            try:
+                snapshot: dict[str, str] = {}
+                in_snapshot = True
+                for event in call:
+                    if self._stop.is_set():
+                        return
+                    if in_snapshot:
+                        if event.initial_done:
+                            self._reconcile(snapshot)
+                            in_snapshot = False
+                            continue
+                        sid = self._serve_id(event.value.path)
+                        if sid is not None and event.value.value:
+                            snapshot[sid] = event.value.value.rstrip("/")
+                        continue
+                    self._apply_event(event.value.path, event.value.value)
+            finally:
+                self._watch_call = None
+                call.cancel()
+
+    @staticmethod
+    def _serve_id(path: str) -> str | None:
+        parts = path.split("/")
+        if len(parts) == 3 and parts[0] == "serve" and parts[2] == "address":
+            return parts[1]
+        return None
+
+    def _apply_event(self, path: str, value: str) -> None:
+        sid = self._serve_id(path)
+        if sid is None:
+            return
+        with self._lock:
+            if value == "":
+                b = self._backends.get(sid)
+                if b is not None and b.from_registry:
+                    log.current().info("backend withdrawn", backend=sid)
+                    del self._backends[sid]
+                return
+            self._upsert_locked(sid, value.rstrip("/"))
+
+    def _upsert_locked(self, sid: str, url: str) -> None:
+        existing = self._backends.get(sid)
+        if existing is None:
+            log.current().info("backend discovered", backend=sid, url=url)
+            self._backends[sid] = Backend(id=sid, url=url, from_registry=True)
+        elif existing.url != url:
+            # Same id, new address: the instance moved (the
+            # channel-cache-era controller-move semantics).
+            log.current().info("backend moved", backend=sid, url=url)
+            existing.url = url
+            existing.healthy = True
+            existing.fails = 0
+
+    def _reconcile(self, found: dict[str, str]) -> None:
+        """Full-state reconcile: registry-sourced entries come and go
+        with their keys; static ones are permanent."""
+        with self._lock:
+            for sid, url in found.items():
+                self._upsert_locked(sid, url)
+            for sid in list(self._backends):
+                b = self._backends[sid]
+                if b.from_registry and sid not in found:
+                    log.current().info("backend withdrawn", backend=sid)
+                    del self._backends[sid]
+
     def _discover_once(self) -> None:
-        """Prefix-query ``serve/`` and reconcile the backend table:
-        registry-sourced entries come and go with their keys; static
-        ones are permanent."""
+        """One-shot poll + reconcile (kept for embedders and tests; the
+        running router uses the watch stream)."""
         from oim_tpu.common.regdial import registry_channel
         from oim_tpu.spec import REGISTRY, oim_pb2
 
@@ -425,35 +535,10 @@ class Router:
             )
         found: dict[str, str] = {}
         for value in reply.values:
-            parts = value.path.split("/")
-            if len(parts) == 3 and parts[0] == "serve" and (
-                parts[2] == "address"
-            ):
-                found[parts[1]] = value.value.rstrip("/")
-        with self._lock:
-            for sid, url in found.items():
-                existing = self._backends.get(sid)
-                if existing is None:
-                    log.current().info(
-                        "backend discovered", backend=sid, url=url
-                    )
-                    self._backends[sid] = Backend(
-                        id=sid, url=url, from_registry=True
-                    )
-                elif existing.url != url:
-                    # Same id, new address: the instance moved (the
-                    # channel-cache-era controller-move semantics).
-                    log.current().info(
-                        "backend moved", backend=sid, url=url
-                    )
-                    existing.url = url
-                    existing.healthy = True
-                    existing.fails = 0
-            for sid in list(self._backends):
-                b = self._backends[sid]
-                if b.from_registry and sid not in found:
-                    log.current().info("backend withdrawn", backend=sid)
-                    del self._backends[sid]
+            sid = self._serve_id(value.path)
+            if sid:
+                found[sid] = value.value.rstrip("/")
+        self._reconcile(found)
 
     # -- stats / lifecycle ---------------------------------------------------
 
@@ -481,6 +566,9 @@ class Router:
 
     def stop(self) -> None:
         self._stop.set()
+        call = self._watch_call
+        if call is not None:
+            call.cancel()  # unblock the discover thread's stream iteration
         # shutdown() handshakes with serve_forever and deadlocks if the
         # listener thread never started (constructed-but-unstarted
         # routers are legal — unit tests, failed startups).

@@ -18,8 +18,31 @@ from oim_tpu.spec.gen.csi.v1 import csi_pb2
 from oim_tpu.spec.gen.oim.v1 import oim_pb2
 
 
+# Method kinds.  The 2-tuple (req, reply) form of a method entry means
+# UNARY; streaming methods use a 3-tuple (req, reply, kind).
+UNARY = "unary"
+SERVER_STREAM = "server_stream"  # unary request → stream of replies
+BIDI_STREAM = "bidi_stream"  # stream of requests → stream of replies
+
+_HANDLER_FACTORY = {
+    UNARY: grpc.unary_unary_rpc_method_handler,
+    SERVER_STREAM: grpc.unary_stream_rpc_method_handler,
+    BIDI_STREAM: grpc.stream_stream_rpc_method_handler,
+}
+
+
+def _parse_entry(entry):
+    if len(entry) == 2:
+        req_cls, reply_cls = entry
+        return req_cls, reply_cls, UNARY
+    req_cls, reply_cls, kind = entry
+    if kind not in _HANDLER_FACTORY:
+        raise ValueError(f"unknown method kind {kind!r}")
+    return req_cls, reply_cls, kind
+
+
 class ServiceSpec:
-    def __init__(self, full_name: str, methods: dict[str, tuple[type, type]]):
+    def __init__(self, full_name: str, methods: dict[str, tuple]):
         self.full_name = full_name
         self.methods = methods
 
@@ -33,13 +56,16 @@ class ServiceSpec:
 
     def registrar(self, servicer: object) -> Callable[[grpc.Server], None]:
         """A registrar adding ``servicer`` (an object with one method per RPC
-        name, ``(request, context) -> reply``) to a server."""
+        name — ``(request, context) -> reply`` for unary, a generator of
+        replies for server-streaming, ``(request_iterator, context)`` for
+        bidi) to a server."""
         handlers = {}
-        for name, (req_cls, reply_cls) in self.methods.items():
+        for name, entry in self.methods.items():
             behavior = getattr(servicer, name, None)
             if behavior is None:
                 continue
-            handlers[name] = grpc.unary_unary_rpc_method_handler(
+            req_cls, reply_cls, kind = _parse_entry(entry)
+            handlers[name] = _HANDLER_FACTORY[kind](
                 behavior,
                 request_deserializer=req_cls.FromString,
                 response_serializer=reply_cls.SerializeToString,
@@ -61,13 +87,21 @@ class Stub:
 
     ``stub.MapVolume(request, timeout=..., metadata=...)`` — metadata is how
     proxied calls carry the ``controllerid`` routing key (≙ reference
-    pkg/oim-csi-driver/remote.go:78).
+    pkg/oim-csi-driver/remote.go:78).  Streaming methods mint the matching
+    channel callable: server-streaming stubs return a response iterator,
+    bidi stubs take a request iterator and return a response iterator.
     """
 
     def __init__(self, spec: ServiceSpec, channel: grpc.Channel):
         self._spec = spec
-        for name, (req_cls, reply_cls) in spec.methods.items():
-            callable_ = channel.unary_unary(
+        for name, entry in spec.methods.items():
+            req_cls, reply_cls, kind = _parse_entry(entry)
+            factory = {
+                UNARY: channel.unary_unary,
+                SERVER_STREAM: channel.unary_stream,
+                BIDI_STREAM: channel.stream_stream,
+            }[kind]
+            callable_ = factory(
                 spec.method_path(name),
                 request_serializer=req_cls.SerializeToString,
                 response_deserializer=reply_cls.FromString,
@@ -80,6 +114,11 @@ REGISTRY = ServiceSpec(
     {
         "SetValue": (oim_pb2.SetValueRequest, oim_pb2.SetValueReply),
         "GetValues": (oim_pb2.GetValuesRequest, oim_pb2.GetValuesReply),
+        "WatchValues": (
+            oim_pb2.WatchValuesRequest,
+            oim_pb2.WatchValuesReply,
+            SERVER_STREAM,
+        ),
     },
 )
 

@@ -80,3 +80,17 @@ class MockController:
         self.requests.append(request)
         self._maybe_fail(context)
         return oim_pb2.CheckSliceReply(chip_count=1)
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02):
+    """Poll ``predicate`` until truthy or ``timeout`` elapses; returns
+    the final evaluation.  The shared helper for liveness assertions
+    (watch events, lease expiry, process readiness)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()

@@ -223,3 +223,111 @@ def test_registry_main_db_spec():
     db = make_db("etcd://127.0.0.1:2379")
     assert isinstance(db, EtcdRegistryDB)
     assert db.endpoint == "tcp://127.0.0.1:2379"
+
+
+# ---------------------------------------------------------------------------
+# Watch + Lease over the etcd v3 wire (the liveness layer; ≙ the etcd
+# semantics the reference's RegistryDB seam was reserved for)
+
+
+from helpers import wait_for as _wait_for
+
+
+def test_watch_put_delete_events(etcd):
+    _, _, db = etcd
+    events: list[tuple[str, str]] = []
+    cancel = db.watch("c1", lambda p, v: events.append((p, v)))
+    try:
+        db.store("c1/address", "tcp://a:1")
+        db.store("c1-sibling/address", "tcp://b:2")  # byte-prefix overmatch
+        assert _wait_for(lambda: ("c1/address", "tcp://a:1") in events)
+        db.store("c1/address", "")
+        assert _wait_for(lambda: ("c1/address", "") in events)
+        # Segment scoping: the sibling key never arrives.
+        assert all(p.startswith("c1/") for p, _ in events), events
+    finally:
+        cancel()
+    n = len(events)
+    db.store("c1/pci", "x")
+    time.sleep(0.3)
+    assert len(events) == n  # cancelled watch delivers nothing
+
+
+def test_leased_key_expires_with_event(etcd):
+    _, _, db = etcd
+    events: list[tuple[str, str]] = []
+    cancel = db.watch("c9", lambda p, v: events.append((p, v)))
+    try:
+        db.store("c9/address", "tcp://x:1", ttl=1)
+        assert db.lookup("c9/address") == "tcp://x:1"
+        # No refresh → the lease expires and etcd deletes the key,
+        # emitting the DELETE watch event a crashed writer can't.
+        assert _wait_for(lambda: db.lookup("c9/address") == "", timeout=15)
+        assert _wait_for(lambda: ("c9/address", "") in events)
+    finally:
+        cancel()
+
+
+def test_leased_key_survives_when_refreshed(etcd):
+    _, _, db = etcd
+    db.store("c8/address", "tcp://x:1", ttl=2)
+    for _ in range(3):
+        time.sleep(1.0)
+        db.store("c8/address", "tcp://x:1", ttl=2)  # heartbeat refresh
+    assert db.lookup("c8/address") == "tcp://x:1"
+    db.store("c8/address", "")
+
+
+def test_lease_grant_and_keepalive(etcd):
+    _, _, db = etcd
+    grant = db._grant(5)
+    assert grant.ID != 0 and grant.TTL >= 5
+    assert db.keepalive_once(grant.ID) >= 1
+    # Unknown lease: keep-alive reports TTL 0 (etcd semantics).
+    assert db.keepalive_once(987654321) == 0
+
+
+def test_lease_revoke_deletes_attached_keys(etcd):
+    from oim_tpu.registry.etcd import ETCD_LEASE
+    from oim_tpu.spec.gen.etcd import rpc_pb2
+
+    _, _, db = etcd
+    grant = db._grant(60)
+    from oim_tpu.registry.etcd import ETCD_KV
+
+    db._call(
+        lambda ch: ETCD_KV.stub(ch).Put(
+            rpc_pb2.PutRequest(
+                key=db._key("c7/address"), value=b"tcp://y:1", lease=grant.ID
+            ),
+            timeout=5,
+        )
+    )
+    assert db.lookup("c7/address") == "tcp://y:1"
+    events: list[tuple[str, str]] = []
+    cancel = db.watch("c7", lambda p, v: events.append((p, v)))
+    try:
+        stub = ETCD_LEASE.stub(db._channel_get())
+        stub.LeaseRevoke(rpc_pb2.LeaseRevokeRequest(ID=grant.ID), timeout=5)
+        assert _wait_for(lambda: db.lookup("c7/address") == "")
+        assert _wait_for(lambda: ("c7/address", "") in events)
+    finally:
+        cancel()
+
+
+def test_put_with_unknown_lease_rejected(etcd):
+    from oim_tpu.spec.gen.etcd import rpc_pb2
+
+    _, _, db = etcd
+    with pytest.raises(grpc.RpcError) as err:
+        from oim_tpu.registry.etcd import ETCD_KV
+
+        db._call(
+            lambda ch: ETCD_KV.stub(ch).Put(
+                rpc_pb2.PutRequest(
+                    key=db._key("c6/x"), value=b"v", lease=123456789
+                ),
+                timeout=5,
+            )
+        )
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND

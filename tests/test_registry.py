@@ -245,3 +245,139 @@ def test_secure_proxy_host_authz(secure_ca):
     finally:
         reg_srv.stop()
         ctrl_srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Watch + leases (the liveness layer: event-driven discovery, expiring keys
+# — the production HA semantics the reference's etcd seam was reserved for,
+# reference pkg/oim-registry/registry.go:31-41)
+
+import threading
+import time
+
+
+from helpers import wait_for as _wait_for
+
+
+@pytest.mark.parametrize("make_db", [MemRegistryDB, None], ids=["mem", "sqlite"])
+def test_db_watch_events(make_db, tmp_path):
+    db = make_db() if make_db else SqliteRegistryDB(str(tmp_path / "reg.db"))
+    events: list[tuple[str, str]] = []
+    cancel = db.watch("ctrl-1", lambda p, v: events.append((p, v)))
+    db.store("ctrl-1/address", "tcp://a:1")
+    db.store("ctrl-10/address", "tcp://b:2")  # sibling: segment-scoped out
+    db.store("ctrl-1/address", "")
+    assert events == [("ctrl-1/address", "tcp://a:1"), ("ctrl-1/address", "")]
+    # Deleting an absent key is not a mutation.
+    db.store("ctrl-1/address", "")
+    assert len(events) == 2
+    cancel()
+    db.store("ctrl-1/pci", "x")
+    assert len(events) == 2
+
+
+@pytest.mark.parametrize("make_db", [MemRegistryDB, None], ids=["mem", "sqlite"])
+def test_db_ttl_expiry_emits_delete(make_db, tmp_path):
+    db = make_db() if make_db else SqliteRegistryDB(str(tmp_path / "reg.db"))
+    events: list[tuple[str, str]] = []
+    db.watch("c", lambda p, v: events.append((p, v)))
+    db.store("c/address", "tcp://a:1", ttl=0.15)
+    assert db.lookup("c/address") == "tcp://a:1"
+    assert _wait_for(lambda: db.lookup("c/address") == "")
+    assert ("c/address", "") in events
+    db.close()
+
+
+@pytest.mark.parametrize("make_db", [MemRegistryDB, None], ids=["mem", "sqlite"])
+def test_db_ttl_refresh_and_unlease(make_db, tmp_path):
+    db = make_db() if make_db else SqliteRegistryDB(str(tmp_path / "reg.db"))
+    # A later persistent store clears the lease.
+    db.store("c/address", "v1", ttl=0.15)
+    db.store("c/address", "v2")
+    time.sleep(0.4)
+    assert db.lookup("c/address") == "v2"
+    # Refreshing with a new ttl restarts the clock from the last store.
+    db.store("d/address", "v", ttl=0.4)
+    time.sleep(0.25)
+    db.store("d/address", "v", ttl=0.4)
+    time.sleep(0.25)  # 0.5s after the FIRST store, 0.25 after the refresh
+    assert db.lookup("d/address") == "v"
+    assert _wait_for(lambda: db.lookup("d/address") == "")
+    db.close()
+
+
+def test_sqlite_lease_survives_restart(tmp_path):
+    path = str(tmp_path / "reg.db")
+    db = SqliteRegistryDB(path)
+    db.store("c/address", "v", ttl=0.3)
+    db.close()
+    # Reopen re-arms the persisted deadline: the writer died while the
+    # registry was down, so the key must still expire.
+    db2 = SqliteRegistryDB(path)
+    assert db2.lookup("c/address") == "v"
+    assert _wait_for(lambda: db2.lookup("c/address") == "")
+    db2.close()
+
+
+def test_watch_values_stream_and_set_value_ttl():
+    """End-to-end over gRPC: WatchValues delivers the initial snapshot,
+    live mutations, and the lease-expiry deletion of a TTL'd SetValue."""
+    reg = Registry()
+    srv = reg.start_server("tcp://127.0.0.1:0")
+    channel = grpc.insecure_channel(srv.addr().grpc_target())
+    stub = REGISTRY.stub(channel)
+    got: list[tuple[str, str]] = []
+    try:
+        reg.db.store("serve/a/address", "http://a")
+        call = stub.WatchValues(
+            oim_pb2.WatchValuesRequest(path="serve", send_initial=True)
+        )
+
+        def drain():
+            try:
+                for reply in call:
+                    got.append((reply.value.path, reply.value.value))
+            except grpc.RpcError:
+                pass  # cancelled at test end
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        assert _wait_for(lambda: ("serve/a/address", "http://a") in got)
+        # A TTL'd registration: PUT event now, DELETE at expiry.
+        stub.SetValue(
+            oim_pb2.SetValueRequest(
+                value=oim_pb2.Value(path="serve/b/address", value="http://b"),
+                ttl_seconds=1,
+            ),
+            timeout=5,
+        )
+        assert _wait_for(lambda: ("serve/b/address", "http://b") in got)
+        assert _wait_for(
+            lambda: ("serve/b/address", "") in got, timeout=5.0
+        ), got
+        # The expired key is gone from reads too.
+        reply = stub.GetValues(
+            oim_pb2.GetValuesRequest(path="serve/b"), timeout=5
+        )
+        assert len(reply.values) == 0
+        call.cancel()
+        t.join(timeout=5)
+    finally:
+        channel.close()
+        srv.stop()
+        reg.close()
+
+
+def test_proxy_channel_invalidated_on_address_delete(monkeypatch):
+    """A deleted (or lease-expired) controller address drops the cached
+    proxy channel at the event, not at the next failed dial."""
+    reg = Registry()
+    invalidated: list[str] = []
+    monkeypatch.setattr(
+        reg._proxy_channels, "invalidate", lambda key: invalidated.append(key)
+    )
+    reg.db.store("ctrl-1/address", "tcp://a:1")
+    assert invalidated == []  # a put must NOT churn the channel
+    reg.db.store("ctrl-1/address", "")
+    assert invalidated == ["ctrl-1"]
+    reg.close()
