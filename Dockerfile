@@ -1,0 +1,44 @@
+# oim-tpu:latest — the single image every deploy/kubernetes manifest runs
+# (≙ the reference shipping static binaries + a reviewed runtime-deps
+# allowlist, reference Makefile:50 + test/test.make:139-156).
+#
+# Two stages: the builder compiles the C++ tpu-agent and wheels the
+# Python control plane; the runtime stage carries only the agent binary,
+# the wheel, and the allowlisted runtime deps (runtime-deps.csv — the
+# gate in tests/test_packaging.py keeps that file honest against the
+# import graph).
+#
+# Build:  make image   (docker build -t oim-tpu:latest .)
+# The kind e2e tier (tests/test_kind_e2e.py, TEST_KIND=1) builds this
+# image and lets a real kubelet + CSI sidecars exec its entry points.
+
+FROM python:3.12-slim AS builder
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY native/ native/
+RUN make -C native/tpu-agent
+COPY pyproject.toml ./
+COPY oim_tpu/ oim_tpu/
+RUN pip wheel --no-deps --wheel-dir /wheels .
+
+FROM python:3.12-slim
+# Required runtime deps only (runtime-deps.csv, scope=required): the HF
+# interop extras (torch/transformers) are deliberately NOT in the image —
+# oim-import-hf runs where the checkpoints live, not in the cluster.
+RUN pip install --no-cache-dir \
+        grpcio \
+        protobuf \
+        cryptography \
+        numpy \
+        "jax[tpu]" \
+        optax \
+        orbax-checkpoint
+COPY --from=builder /src/native/tpu-agent/tpu-agent /usr/local/bin/tpu-agent
+COPY --from=builder /wheels/*.whl /tmp/wheels/
+RUN pip install --no-cache-dir --no-deps /tmp/wheels/*.whl && rm -rf /tmp/wheels
+# Entry points (console scripts): oim-registry, oim-controller,
+# oim-csi-driver, oimctl, oim-train, oim-serve, oim-route, plus
+# /usr/local/bin/tpu-agent.  The manifests pick per-container commands.
+ENTRYPOINT ["oim-registry"]
