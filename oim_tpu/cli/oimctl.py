@@ -96,6 +96,22 @@ def main(argv=None) -> int:
         import json as json_mod
         import urllib.request
 
+        # https --serve targets use the same --ca/--cert/--key as the
+        # gRPC plane: the serving API is mTLS when deployed that way.
+        if args.serve.startswith("https://"):
+            if not args.ca:
+                print("error: https --serve requires --ca (and usually "
+                      "--cert/--key for mTLS servers)")
+                return 2
+            from oim_tpu.serve.httptls import client_ssl_context, opener
+
+            _opener = opener(
+                client_ssl_context(args.ca, args.cert, args.key)
+            )
+            urlopen = _opener.open
+        else:
+            urlopen = urllib.request.urlopen
+
         def post_request(path: str, payload: dict):
             return urllib.request.Request(
                 f"{args.serve.rstrip('/')}{path}",
@@ -109,7 +125,7 @@ def main(argv=None) -> int:
                       "--temperature (beam is greedy latency mode)")
                 return 2
             try:
-                with urllib.request.urlopen(
+                with urlopen(
                     post_request("/v1/beam", {
                         "tokens": args.tokens,
                         "max_new_tokens": args.max_new_tokens,
@@ -139,7 +155,7 @@ def main(argv=None) -> int:
             "stream": args.stream,
         })
         try:
-            with urllib.request.urlopen(request, timeout=600) as response:
+            with urlopen(request, timeout=600) as response:
                 if args.stream:
                     failed = False
                     for line in response:

@@ -47,6 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="per-request backend timeout (matches oim-serve's result "
         "timeout)",
     )
+    p.add_argument(
+        "--http-tls", action="store_true",
+        help="mTLS on the data plane with the same --ca/--cert/--key: "
+        "the router's own listener requires client certs AND the router "
+        "authenticates itself to mTLS backends",
+    )
     p.add_argument("--log-level", default="info")
     return p
 
@@ -62,6 +68,17 @@ def main(argv=None) -> int:
         from oim_tpu.common.tlsconfig import load_tls
 
         tls = load_tls(args.ca, args.cert, args.key)
+    ssl_context = client_ctx = None
+    if args.http_tls:
+        if not (args.ca and args.cert and args.key):
+            raise SystemExit("--http-tls requires --ca/--cert/--key")
+        from oim_tpu.serve.httptls import (
+            client_ssl_context,
+            server_ssl_context,
+        )
+
+        ssl_context = server_ssl_context(args.ca, args.cert, args.key)
+        client_ctx = client_ssl_context(args.ca, args.cert, args.key)
     try:
         router = Router(
             backends=tuple(args.backend),
@@ -73,6 +90,8 @@ def main(argv=None) -> int:
             discover_interval=args.discover_interval,
             unhealthy_after=args.unhealthy_after,
             request_timeout=args.request_timeout,
+            ssl_context=ssl_context,
+            client_ssl_context=client_ctx,
         ).start()
     except ValueError as exc:
         raise SystemExit(str(exc))

@@ -159,6 +159,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ca", help="CA cert file (enables registry mTLS)")
     p.add_argument("--cert", help="cert (CN serve.<id>)")
     p.add_argument("--key", help="key")
+    p.add_argument(
+        "--http-tls", action="store_true",
+        help="serve the HTTP API over mTLS with the same --ca/--cert/"
+        "--key: clients (oim-route, oimctl) must hold a deployment-CA "
+        "cert or the handshake fails (the gRPC plane's mTLS-everywhere "
+        "stance, on the data plane)",
+    )
     p.add_argument("--log-level", default="info")
     return p
 
@@ -293,14 +300,24 @@ def main(argv=None) -> int:
     if not args.no_warmup:
         log.current().info("warming up", buckets=list(engine.prompt_buckets))
         engine.warmup(embed=args.warmup_embed)
-    server = ServeServer(engine, host=args.host, port=args.port).start()
+    ssl_context = None
+    if args.http_tls:
+        if not (args.ca and args.cert and args.key):
+            raise SystemExit("--http-tls requires --ca/--cert/--key")
+        from oim_tpu.serve.httptls import server_ssl_context
+
+        ssl_context = server_ssl_context(args.ca, args.cert, args.key)
+    server = ServeServer(
+        engine, host=args.host, port=args.port, ssl_context=ssl_context
+    ).start()
     log.current().info(
         "oim-serve listening", host=server.host, port=server.port,
-        n_slots=args.n_slots, max_len=args.max_len,
+        n_slots=args.n_slots, max_len=args.max_len, mtls=server.tls,
     )
     if registration is not None:
+        scheme = "https" if ssl_context is not None else "http"
         registration.advertised_address = (
-            args.advertise or f"http://{server.host}:{server.port}"
+            args.advertise or f"{scheme}://{server.host}:{server.port}"
         )
         registration.start()
     import signal

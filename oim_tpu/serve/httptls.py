@@ -1,0 +1,132 @@
+"""mTLS for the HTTP serving data plane.
+
+≙ the reference's mTLS-everywhere stance (reference README.md:84-120:
+every connection authenticates both ends against the deployment CA).
+The gRPC control plane already lives by it (common/tlsconfig.py); this
+module extends the same CA tree to the serving surface — the one
+OUTWARD-facing API in the system, which previously had *less* protection
+than any internal gRPC endpoint:
+
+    client ⇄ oim-route ⇄ oim-serve      (HTTPS, client certs required)
+
+Identity model: the deployment CA is PRIVATE and closed-world — holding
+any CA-signed cert IS the authorization to speak to the data plane, the
+same trust stance as the gRPC plane (where a cert's CN then scopes
+WRITES; the serving API has no writes to scope).  Hostname checking is
+disabled on purpose: components dial each other by registry-discovered
+IP:port, and the cert's CN (``serve.<id>``, available to handlers via
+``peer_common_name``) is the identity, not the network address —
+exactly how the gRPC plane pins ``component.registry`` instead of a
+hostname.
+
+Servers wrap the LISTENING socket, so the TLS handshake happens on
+accept in the serving threads; a client presenting no cert or a cert
+from a different CA fails the handshake before a single byte of the
+HTTP request is read.
+"""
+
+from __future__ import annotations
+
+import ssl
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+
+def server_ssl_context(
+    ca_file: str, cert_file: str, key_file: str,
+    require_client_cert: bool = True,
+) -> ssl.SSLContext:
+    """TLS context for a serving listener: presents ``cert_file`` and
+    (by default) REQUIRES a peer cert signed by ``ca_file`` — mTLS."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_file, key_file)
+    ctx.load_verify_locations(ca_file)
+    if require_client_cert:
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_ssl_context(
+    ca_file: str, cert_file: str | None = None, key_file: str | None = None
+) -> ssl.SSLContext:
+    """TLS context for dialing a serving endpoint: verifies the server
+    chains to OUR CA (not the system roots), presents a client cert when
+    given (required by mTLS servers).  See the module docstring for why
+    ``check_hostname`` is off."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    ctx.load_verify_locations(ca_file)
+    if cert_file:
+        ctx.load_cert_chain(cert_file, key_file)
+    return ctx
+
+
+def opener(
+    context: ssl.SSLContext | None,
+) -> urllib.request.OpenerDirector:
+    """urllib opener sending requests through ``context`` (plain HTTP
+    opener when ``None`` — the no-TLS deployments keep working)."""
+    if context is None:
+        return urllib.request.build_opener()
+    return urllib.request.build_opener(
+        urllib.request.HTTPSHandler(context=context)
+    )
+
+
+def peer_common_name(handler) -> str | None:
+    """CN of the authenticated client driving ``handler``'s request, or
+    None on a plain-HTTP server (the gRPC plane's ``peer_common_name``
+    contract, for HTTP handlers)."""
+    getpeercert = getattr(handler.connection, "getpeercert", None)
+    if getpeercert is None:
+        return None
+    cert = getpeercert()
+    if not cert:
+        return None
+    for rdn in cert.get("subject", ()):
+        for key, value in rdn:
+            if key == "commonName":
+                return value
+    return None
+
+
+class TLSThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose accepted sockets speak TLS.
+
+    The listener socket itself is wrapped with
+    ``do_handshake_on_connect=False``, so the handshake happens lazily
+    on the first I/O in the per-connection handler thread — a slow or
+    hostile client cannot block the accept loop.  Handshake failures
+    (wrong CA, no client cert) are an expected hostile-input event:
+    counted per server instance, not tracebacked.  Anything that is NOT
+    a TLS/connection-teardown error still goes through the default
+    handler — a handler-side bug must stay loud.
+    """
+
+    def __init__(self, addr, handler_cls, ssl_context: ssl.SSLContext):
+        super().__init__(addr, handler_cls)
+        self.handshake_failures = 0
+        self.socket = ssl_context.wrap_socket(
+            self.socket, server_side=True, do_handshake_on_connect=False
+        )
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(
+            exc,
+            (
+                ssl.SSLError,
+                ConnectionResetError,
+                BrokenPipeError,
+                ConnectionAbortedError,
+                TimeoutError,
+            ),
+        ):
+            # Failed handshakes / client teardown: the mTLS gate doing
+            # its job, or a client hanging up — not a server bug.
+            self.handshake_failures += 1
+            return
+        super().handle_error(request, client_address)

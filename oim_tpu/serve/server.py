@@ -66,7 +66,18 @@ class ServeServer:
     the ``NonBlockingGRPCServer.addr()`` discovery pattern).
     """
 
-    def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        engine: Engine,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ssl_context=None,
+    ):
+        """``ssl_context`` (from ``httptls.server_ssl_context``) wraps
+        the listener in mTLS: clients must hold a deployment-CA cert or
+        the handshake fails before the request is read (the reference's
+        mTLS-everywhere stance applied to the serving data plane,
+        reference README.md:84-120)."""
         self.engine = engine
         self.error: str | None = None  # set when the driver thread dies
         self._stop = threading.Event()
@@ -320,7 +331,15 @@ class ServeServer:
                     payload["logprobs"] = lps
                 self._json(200, payload)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        if ssl_context is not None:
+            from oim_tpu.serve.httptls import TLSThreadingHTTPServer
+
+            self._httpd = TLSThreadingHTTPServer(
+                (host, port), Handler, ssl_context
+            )
+        else:
+            self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.tls = ssl_context is not None
         self.host, self.port = self._httpd.server_address[:2]
         self._http_thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True

@@ -214,3 +214,170 @@ def test_evil_registry_mitm(world):
         assert evil_registry.db.lookup("ctrl-1/address") == ""
     finally:
         evil_srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Serving data plane (HTTP) — the same matrix applied to /v1/generate
+# end-to-end: client → oim-route → oim-serve, every hop mTLS
+# (≙ the reference's mTLS-everywhere stance, reference README.md:84-120,
+# extended to the one outward-facing API).
+
+import json
+import ssl
+import time
+import urllib.request
+
+
+@pytest.fixture(scope="module")
+def serving_world(tmp_path_factory):
+    """mTLS backend + mTLS router discovered statically, plus cert trees."""
+    import jax
+
+    from oim_tpu.models import TransformerConfig, init_params
+    from oim_tpu.serve import Engine, Router
+    from oim_tpu.serve.httptls import client_ssl_context, server_ssl_context
+    from oim_tpu.serve.server import ServeServer
+
+    tmp = tmp_path_factory.mktemp("servetls")
+    ca = CertAuthority("GOOD CA")
+    evil = CertAuthority("EVIL CA")
+
+    def certfiles(authority, cn, trust=None):
+        cred = authority.issue(cn)
+        cafile = tmp / f"{id(authority)}.ca.crt"
+        cafile.write_bytes((trust or authority).ca_pem)
+        crt = tmp / f"{cn}.{id(authority)}.crt"
+        key = tmp / f"{cn}.{id(authority)}.key"
+        crt.write_bytes(cred.cert_pem)
+        key.write_bytes(cred.key_pem)
+        return str(cafile), str(crt), str(key)
+
+    cfg = TransformerConfig(
+        vocab_size=101, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        dtype="float32", use_pallas=False,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+
+    b_ca, b_crt, b_key = certfiles(ca, "serve.a")
+    backend = ServeServer(
+        engine,
+        ssl_context=server_ssl_context(b_ca, b_crt, b_key),
+    ).start()
+
+    r_ca, r_crt, r_key = certfiles(ca, "route.r1")
+    router = Router(
+        backends=(f"https://127.0.0.1:{backend.port}",),
+        health_interval=0.2,
+        unhealthy_after=2,
+        ssl_context=server_ssl_context(r_ca, r_crt, r_key),
+        client_ssl_context=client_ssl_context(r_ca, r_crt, r_key),
+    ).start()
+    deadline = time.time() + 30
+    while time.time() < deadline and not router.healthy_backends():
+        time.sleep(0.05)
+    assert router.healthy_backends(), "mTLS router↔backend health failed"
+
+    yield {
+        "ca": ca,
+        "evil": evil,
+        "tmp": tmp,
+        "certfiles": certfiles,
+        "backend_port": backend.port,
+        "router_port": router.port,
+    }
+    router.stop()
+    backend.stop()
+
+
+def _serving_request(port, context, path="/v1/generate", timeout=30):
+    from oim_tpu.serve.httptls import opener
+
+    req = urllib.request.Request(
+        f"https://127.0.0.1:{port}{path}",
+        data=json.dumps({"tokens": [1, 2, 3], "max_new_tokens": 2}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with opener(context).open(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_serving_mtls_good_client_end_to_end(serving_world):
+    """A deployment-CA client generates through router → backend, every
+    hop authenticated."""
+    from oim_tpu.serve.httptls import client_ssl_context
+
+    w = serving_world
+    ca_f, crt, key = w["certfiles"](w["ca"], "user.admin")
+    out = _serving_request(
+        w["router_port"], client_ssl_context(ca_f, crt, key)
+    )
+    assert len(out["tokens"]) == 2
+
+
+@pytest.mark.parametrize("target", ["router", "backend"])
+def test_serving_mtls_rejects_certless_client(serving_world, target):
+    """No client cert → handshake failure before any request is read, on
+    BOTH the router and the backend listener."""
+    from oim_tpu.serve.httptls import client_ssl_context
+
+    w = serving_world
+    ca_f, _, _ = w["certfiles"](w["ca"], "user.nobody")
+    port = w[f"{target}_port"]
+    with pytest.raises((ssl.SSLError, urllib.error.URLError, OSError)):
+        _serving_request(port, client_ssl_context(ca_f), timeout=10)
+
+
+@pytest.mark.parametrize("target", ["router", "backend"])
+def test_serving_mtls_rejects_evil_ca_client(serving_world, target):
+    """A client whose cert chains to a DIFFERENT CA is refused at the
+    handshake — holding a cert is not enough, it must be OUR CA."""
+    from oim_tpu.serve.httptls import client_ssl_context
+
+    w = serving_world
+    # Evil-issued client cert, but trusting the good CA for the server
+    # side (the strongest attacker: knows the real CA's public half).
+    ca_f, crt, key = w["certfiles"](w["evil"], "user.admin", trust=w["ca"])
+    port = w[f"{target}_port"]
+    with pytest.raises((ssl.SSLError, urllib.error.URLError, OSError)):
+        _serving_request(port, client_ssl_context(ca_f, crt, key), timeout=10)
+
+
+def test_serving_client_rejects_evil_server(serving_world, tmp_path):
+    """The CLIENT side of the matrix: a client pinned to the deployment
+    CA refuses a server presenting an evil-CA cert (MITM)."""
+    from oim_tpu.serve.httptls import (
+        client_ssl_context,
+        server_ssl_context,
+    )
+
+    w = serving_world
+    evil_ca_f, evil_crt, evil_key = w["certfiles"](w["evil"], "serve.mitm")
+
+    import http.server
+    import threading
+
+    class Quiet(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+    from oim_tpu.serve.httptls import TLSThreadingHTTPServer
+
+    mitm = TLSThreadingHTTPServer(
+        ("127.0.0.1", 0), Quiet,
+        server_ssl_context(
+            evil_ca_f, evil_crt, evil_key, require_client_cert=False
+        ),
+    )
+    threading.Thread(target=mitm.serve_forever, daemon=True).start()
+    try:
+        good_ca_f, crt, key = w["certfiles"](w["ca"], "user.admin")
+        with pytest.raises((ssl.SSLError, urllib.error.URLError, OSError)):
+            _serving_request(
+                mitm.server_address[1],
+                client_ssl_context(good_ca_f, crt, key),
+                timeout=10,
+            )
+    finally:
+        mitm.shutdown()
+        mitm.server_close()
