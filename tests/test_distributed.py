@@ -205,3 +205,179 @@ def test_staged_bootstraps_form_real_process_group(tmp_path):
                 cleanup()
             except Exception:
                 pass
+
+
+WORKER_N = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from oim_tpu.parallel import coordinator
+
+mesh = coordinator.initialize({bootstrap!r})
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+pid = jax.process_index()
+local = np.full((2, 4), pid + 1, np.float32)
+x = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")),
+    local,
+    global_shape=(2 * jax.process_count(), 4),
+)
+total = jax.jit(
+    lambda x: x.sum(), out_shardings=NamedSharding(mesh, P())
+)(x)
+print(json.dumps({{
+    "process": pid,
+    "num_processes": jax.process_count(),
+    "global_devices": len(jax.devices()),
+    "mesh_axes": {{k: int(v) for k, v in mesh.shape.items()}},
+    "sum": float(total),
+}}))
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("TEST_MULTIHOST4") != "1",
+    reason="4-process DCN tier is opt-in: TEST_MULTIHOST4=1 (heavy: 4 jax "
+    "subprocesses; the 2-process tier above always runs)",
+)
+def test_four_hosts_etcd_registry_group(tmp_path):
+    """VERDICT r3 #8: the 2-process tier, scaled to FOUR processes with
+    the rendezvous through a registry backed by the REAL etcd wire
+    (EtcdRegistryDB → in-process EtcdKVServer): 4 controllers register
+    (leased), 4 NodeStages converge on one coordinator through etcd-backed
+    state, and 4 worker processes form one jax.distributed group (2 CPU
+    devices each → 8 global) agreeing on a cross-process collective."""
+    from oim_tpu.registry import EtcdKVServer, EtcdRegistryDB
+
+    kv = EtcdKVServer()
+    kv_srv = kv.start_server("tcp://127.0.0.1:0")
+    db = EtcdRegistryDB(str(kv_srv.addr()))
+    registry = Registry(db=db)
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    cleanups = [registry.close, reg_srv.stop, db.close, kv.close, kv_srv.stop]
+    channels = {}
+    hosts = [f"host-{i}" for i in range(4)]
+    try:
+        for host_id in hosts:
+            store = ChipStore(
+                mesh=(2, 1, 1), device_dir=str(tmp_path / host_id / "dev")
+            )
+            agent = FakeAgentServer(
+                store, str(tmp_path / host_id / "agent.sock")
+            ).start()
+            cleanups.append(agent.stop)
+            controller = Controller(
+                host_id,
+                agent.socket_path,
+                registry_address=str(reg_srv.addr()),
+                coordinator_host="127.0.0.1",
+                registry_delay=30.0,
+            )
+            ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
+            cleanups += [controller.close, ctrl_srv.stop]
+            controller.start(str(ctrl_srv.addr()))
+            driver = OIMDriver(
+                csi_endpoint=f"unix://{tmp_path}/{host_id}-csi.sock",
+                registry_address=str(reg_srv.addr()),
+                controller_id=host_id,
+            )
+            csi_srv = driver.start_server()
+            cleanups += [driver.close, csi_srv.stop]
+            channel = grpc.insecure_channel(csi_srv.addr().grpc_target())
+            cleanups.append(channel.close)
+            channels[host_id] = channel
+
+        deadline = time.time() + 15
+        while any(
+            registry.db.lookup(f"{h}/address") == "" for h in channels
+        ):
+            assert time.time() < deadline, "controllers never registered"
+            time.sleep(0.02)
+
+        cap = csi_pb2.VolumeCapability()
+        cap.mount.SetInParent()
+        cap.access_mode.mode = (
+            csi_pb2.VolumeCapability.AccessMode.MULTI_NODE_MULTI_WRITER
+        )
+        vol = CSI_CONTROLLER.stub(channels["host-0"]).CreateVolume(
+            csi_pb2.CreateVolumeRequest(
+                name="dist4-vol",
+                volume_capabilities=[cap],
+                parameters={"chipCount": "2", "hosts": ",".join(hosts)},
+            ),
+            timeout=30,
+        ).volume
+
+        def stage(host_id: str) -> str:
+            staging = str(tmp_path / host_id / "staging")
+            target = str(tmp_path / host_id / "pod" / "tpu")
+            node = CSI_NODE.stub(channels[host_id])
+            node.NodeStageVolume(
+                csi_pb2.NodeStageVolumeRequest(
+                    volume_id="dist4-vol",
+                    staging_target_path=staging,
+                    volume_capability=cap,
+                    volume_context=dict(vol.volume_context),
+                ),
+                timeout=120,
+            )
+            node.NodePublishVolume(
+                csi_pb2.NodePublishVolumeRequest(
+                    volume_id="dist4-vol",
+                    staging_target_path=staging,
+                    target_path=target,
+                    volume_capability=cap,
+                ),
+                timeout=120,
+            )
+            return os.path.join(target, "tpu-bootstrap.json")
+
+        with concurrent.futures.ThreadPoolExecutor(4) as pool:
+            paths = list(pool.map(stage, hosts))
+
+        boots = [json.load(open(p)) for p in paths]
+        assert {b["process_id"] for b in boots} == {0, 1, 2, 3}
+        assert all(b["num_processes"] == 4 for b in boots)
+        assert len({b["coordinator_address"] for b in boots}) == 1
+
+        procs = []
+        for p in paths:
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-c",
+                    WORKER_N.format(repo=REPO, bootstrap=p),
+                ],
+                env=_worker_env(),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+            procs.append(proc)
+            cleanups.append(lambda proc=proc: (proc.kill(), proc.wait()))
+        reports = []
+        for proc in procs:
+            out, err = proc.communicate(timeout=600)
+            assert proc.returncode == 0, (
+                f"worker failed\nhead: {err[:1200]}\n...\ntail: {err[-1200:]}"
+            )
+            reports.append(json.loads(out.strip().splitlines()[-1]))
+
+        assert {r["process"] for r in reports} == {0, 1, 2, 3}
+        for r in reports:
+            assert r["num_processes"] == 4
+            assert r["global_devices"] == 8
+            # 8 rows of 4: (1+2+3+4) * 2 rows * 4 cols = 80.
+            assert r["sum"] == 80.0
+    finally:
+        for cleanup in reversed(cleanups):
+            try:
+                cleanup()
+            except Exception:
+                pass
