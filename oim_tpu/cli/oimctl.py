@@ -1,7 +1,9 @@
 """oimctl: operator tool for the registry (≙ reference cmd/oimctl).
 
     oimctl get [PATH]             read registry values
-    oimctl set PATH VALUE         write a value (empty VALUE deletes)
+    oimctl set PATH VALUE         write a value (empty VALUE deletes;
+                                  --ttl N leases it)
+    oimctl watch [PATH]           stream changes (snapshot, then live)
     oimctl map VOLUME --controller ID --chips N    ad-hoc MapVolume
     oimctl unmap VOLUME --controller ID
     oimctl trace FILE [FILE...]   merge daemons' span files, print trees
@@ -44,6 +46,22 @@ def main(argv=None) -> int:
     set_ = sub.add_parser("set")
     set_.add_argument("path")
     set_.add_argument("value")
+    set_.add_argument(
+        "--ttl", type=int, default=0,
+        help="lease the key: auto-deletes this many seconds after the "
+        "last set that carried a ttl (0 = persistent)",
+    )
+    watch = sub.add_parser(
+        "watch",
+        help="stream registry changes at or below a path prefix "
+        "(snapshot first, then one line per mutation; '=' with no value "
+        "means deleted/expired) until interrupted",
+    )
+    watch.add_argument("path", nargs="?", default="")
+    watch.add_argument(
+        "--no-initial", action="store_true",
+        help="skip the snapshot; print only live changes",
+    )
     map_ = sub.add_parser("map")
     map_.add_argument("volume")
     map_.add_argument("--controller", required=True)
@@ -200,10 +218,31 @@ def main(argv=None) -> int:
         elif args.command == "set":
             REGISTRY.stub(channel).SetValue(
                 oim_pb2.SetValueRequest(
-                    value=oim_pb2.Value(path=args.path, value=args.value)
+                    value=oim_pb2.Value(path=args.path, value=args.value),
+                    ttl_seconds=args.ttl,
                 ),
                 timeout=30,
             )
+        elif args.command == "watch":
+            call = REGISTRY.stub(channel).WatchValues(
+                oim_pb2.WatchValuesRequest(
+                    path=args.path, send_initial=not args.no_initial
+                )
+            )
+            try:
+                for reply in call:
+                    if reply.initial_done:
+                        print("-- initial snapshot complete --", flush=True)
+                        continue
+                    print(
+                        f"{reply.value.path}={reply.value.value}", flush=True
+                    )
+            except KeyboardInterrupt:
+                call.cancel()
+            except grpc.RpcError as exc:
+                if exc.code() != grpc.StatusCode.CANCELLED:
+                    print(f"error: {exc.code().name}: {exc.details()}")
+                    return 1
         elif args.command == "map":
             request = oim_pb2.MapVolumeRequest(volume_id=args.volume)
             if args.chips > 0:

@@ -316,3 +316,58 @@ def test_lora_finetune_workflow(tmp_path):
         capture_output=True, text=True, env=env, timeout=120,
     )
     assert bad.returncode != 0 and "lora-base" in bad.stderr
+
+
+def test_oimctl_watch_and_leased_set(cluster, capsys):
+    """`oimctl watch` streams snapshot + live changes; `oimctl set --ttl`
+    writes a key that expires on its own (the lease liveness primitive,
+    operator-visible)."""
+    import threading
+
+    import grpc as _grpc
+
+    from oim_tpu.spec import REGISTRY as _REG
+    from oim_tpu.spec import oim_pb2 as _pb
+
+    registry = cluster
+    assert _ctl(registry, "set", "w/x", "1") == 0
+    # Drive WatchValues directly on a thread (oimctl watch runs the same
+    # stream; the CLI loop never returns, so exercise the RPC + print
+    # the lines it would).
+    channel = _grpc.insecure_channel(registry.replace("tcp://", ""))
+    call = _REG.stub(channel).WatchValues(
+        _pb.WatchValuesRequest(path="w", send_initial=True)
+    )
+    lines: list[tuple[str, str, bool]] = []
+    done = threading.Event()
+
+    def drain():
+        try:
+            for reply in call:
+                lines.append(
+                    (reply.value.path, reply.value.value, reply.initial_done)
+                )
+                if len(lines) >= 3:
+                    done.set()
+        except _grpc.RpcError:
+            pass
+
+    threading.Thread(target=drain, daemon=True).start()
+    # Leased write: expires without further action.
+    assert _ctl(registry, "set", "w/leased", "v", "--ttl", "1") == 0
+    assert done.wait(timeout=20), lines
+    assert ("w/x", "1", False) in lines  # snapshot
+    assert ("", "", True) in lines  # initial_done marker
+    assert ("w/leased", "v", False) in lines  # live PUT
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if ("w/leased", "", False) in lines:  # lease-expiry DELETE
+            break
+        time.sleep(0.2)
+    assert ("w/leased", "", False) in lines, lines
+    call.cancel()
+    channel.close()
+    # And the read side agrees the key is gone.
+    assert _ctl(registry, "get", "w") == 0
+    out = capsys.readouterr().out
+    assert "w/x=1" in out and "leased" not in out

@@ -331,3 +331,75 @@ def test_put_with_unknown_lease_rejected(etcd):
             )
         )
     assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_two_registry_replicas_share_etcd_watch(etcd):
+    """BASELINE config 5 (HA): two Registry replicas on ONE etcd — a
+    SetValue through replica A reaches a WatchValues subscriber on
+    replica B via the etcd Watch stream, and a leased key written
+    through A expires for B's watchers too.  This is what makes the
+    registry horizontally scalable: watchers may connect to any
+    replica."""
+    import threading
+
+    from oim_tpu.registry import EtcdRegistryDB, Registry
+    from oim_tpu.spec import REGISTRY, oim_pb2
+
+    server, srv, _db = etcd
+    endpoint = str(srv.addr())
+    db_a, db_b = EtcdRegistryDB(endpoint), EtcdRegistryDB(endpoint)
+    reg_a, reg_b = Registry(db=db_a), Registry(db=db_b)
+    srv_a = reg_a.start_server("tcp://127.0.0.1:0")
+    srv_b = reg_b.start_server("tcp://127.0.0.1:0")
+    chan_a = grpc.insecure_channel(srv_a.addr().grpc_target())
+    chan_b = grpc.insecure_channel(srv_b.addr().grpc_target())
+    got: list[tuple[str, str]] = []
+    try:
+        call = REGISTRY.stub(chan_b).WatchValues(
+            oim_pb2.WatchValuesRequest(path="ha", send_initial=True)
+        )
+        ready = threading.Event()
+
+        def drain():
+            try:
+                for reply in call:
+                    if reply.initial_done:
+                        # The marker proves B's server-side subscription
+                        # (and its etcd watch underneath) is LIVE — the
+                        # only race-free "now write" signal.
+                        ready.set()
+                        continue
+                    got.append((reply.value.path, reply.value.value))
+            except grpc.RpcError:
+                pass
+
+        threading.Thread(target=drain, daemon=True).start()
+        assert ready.wait(timeout=20), "B's watch stream never settled"
+        REGISTRY.stub(chan_a).SetValue(
+            oim_pb2.SetValueRequest(
+                value=oim_pb2.Value(path="ha/x/address", value="tcp://x:1"),
+                ttl_seconds=1,
+            ),
+            timeout=5,
+        )
+        assert _wait_for(lambda: ("ha/x/address", "tcp://x:1") in got), got
+        # The lease (held in etcd, not in either replica) expires the
+        # key; B's watcher sees the DELETE without A doing anything.
+        assert _wait_for(
+            lambda: ("ha/x/address", "") in got, timeout=15
+        ), got
+        # Reads through either replica agree.
+        reply = REGISTRY.stub(chan_a).GetValues(
+            oim_pb2.GetValuesRequest(path="ha"), timeout=5
+        )
+        assert len(reply.values) == 0
+        call.cancel()
+    finally:
+        chan_a.close()
+        chan_b.close()
+        srv_a.stop()
+        srv_b.stop()
+        reg_a.close()
+        reg_b.close()
+        db_a.close()
+        db_b.close()
