@@ -2,14 +2,19 @@
 
 tests/test_multihost.py proves the control-plane rendezvous (N NodeStages
 converge on one coordinator assignment); this tier proves the thing the
-rendezvous exists FOR: two separate worker processes read their staged
+rendezvous exists FOR: N separate worker processes read their staged
 ``tpu-bootstrap.json`` files, call ``coordinator.initialize()``, form one
 ``jax.distributed`` process group at the controller-allocated coordinator
 address, build the global logical mesh, and run a cross-process
 collective whose result every process agrees on.  CPU analog of the DCN
-path (gloo collectives over a 2-process × 2-device global mesh) — the
+path (gloo collectives over N processes × 2 devices each) — the
 reference's tier-3 discipline of driving the real runtime, not a fake
 (reference test/test.make:1-16).
+
+The always-on case runs 2 processes on the in-memory registry; the
+env-gated ``TEST_MULTIHOST4=1`` case runs 4 processes with the
+rendezvous through an etcd-backed registry (EtcdRegistryDB → in-process
+EtcdKVServer over the real v3 wire) — BASELINE config 5's shape.
 """
 
 from __future__ import annotations
@@ -52,7 +57,9 @@ pid = jax.process_index()
 # the replicated sum forces a cross-process all-reduce over "DCN".
 local = np.full((2, 4), pid + 1, np.float32)
 x = jax.make_array_from_process_local_data(
-    NamedSharding(mesh, P("dp")), local, global_shape=(4, 4)
+    NamedSharding(mesh, P("dp")),
+    local,
+    global_shape=(2 * jax.process_count(), 4),
 )
 total = jax.jit(
     lambda x: x.sum(), out_shardings=NamedSharding(mesh, P())
@@ -73,123 +80,137 @@ def _worker_env() -> dict:
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["JAX_PLATFORMS"] = "cpu"
-    # 2 local CPU devices per process → 4 global over the 2-process group.
+    # 2 local CPU devices per process → 2N global over the N-process group.
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     return env
+
+
+def _build_hosts(tmp_path, hosts, registry, reg_addr, cleanups):
+    """One fake agent + controller + remote CSI driver per host, all
+    registered against one registry.  Returns host_id → CSI channel."""
+    channels = {}
+    for host_id in hosts:
+        store = ChipStore(
+            mesh=(2, 1, 1), device_dir=str(tmp_path / host_id / "dev")
+        )
+        agent = FakeAgentServer(
+            store, str(tmp_path / host_id / "agent.sock")
+        ).start()
+        cleanups.append(agent.stop)
+        controller = Controller(
+            host_id,
+            agent.socket_path,
+            registry_address=reg_addr,
+            coordinator_host="127.0.0.1",
+            registry_delay=30.0,
+        )
+        ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
+        cleanups += [controller.close, ctrl_srv.stop]
+        controller.start(str(ctrl_srv.addr()))
+        driver = OIMDriver(
+            csi_endpoint=f"unix://{tmp_path}/{host_id}-csi.sock",
+            registry_address=reg_addr,
+            controller_id=host_id,
+        )
+        csi_srv = driver.start_server()
+        cleanups += [driver.close, csi_srv.stop]
+        channel = grpc.insecure_channel(csi_srv.addr().grpc_target())
+        cleanups.append(channel.close)
+        channels[host_id] = channel
+
+    deadline = time.time() + 15
+    while any(registry.db.lookup(f"{h}/address") == "" for h in channels):
+        assert time.time() < deadline, "controllers never registered"
+        time.sleep(0.02)
+    return channels
+
+
+def _stage_and_run_group(tmp_path, channels, volume, cleanups):
+    """CreateVolume across all hosts, stage concurrently (the rendezvous
+    blocks until every host joins), then run one worker process per
+    staged bootstrap and return their reports."""
+    hosts = list(channels)
+    cap = csi_pb2.VolumeCapability()
+    cap.mount.SetInParent()
+    cap.access_mode.mode = (
+        csi_pb2.VolumeCapability.AccessMode.MULTI_NODE_MULTI_WRITER
+    )
+    vol = CSI_CONTROLLER.stub(channels[hosts[0]]).CreateVolume(
+        csi_pb2.CreateVolumeRequest(
+            name=volume,
+            volume_capabilities=[cap],
+            parameters={"chipCount": "2", "hosts": ",".join(hosts)},
+        ),
+        timeout=30,
+    ).volume
+
+    def stage(host_id: str) -> str:
+        staging = str(tmp_path / host_id / "staging")
+        target = str(tmp_path / host_id / "pod" / "tpu")
+        node = CSI_NODE.stub(channels[host_id])
+        node.NodeStageVolume(
+            csi_pb2.NodeStageVolumeRequest(
+                volume_id=volume,
+                staging_target_path=staging,
+                volume_capability=cap,
+                volume_context=dict(vol.volume_context),
+            ),
+            timeout=120,
+        )
+        node.NodePublishVolume(
+            csi_pb2.NodePublishVolumeRequest(
+                volume_id=volume,
+                staging_target_path=staging,
+                target_path=target,
+                volume_capability=cap,
+            ),
+            timeout=120,
+        )
+        return os.path.join(target, "tpu-bootstrap.json")
+
+    with concurrent.futures.ThreadPoolExecutor(len(hosts)) as pool:
+        paths = list(pool.map(stage, hosts))
+
+    boots = [json.load(open(p)) for p in paths]
+    assert {b["process_id"] for b in boots} == set(range(len(hosts)))
+    assert all(b["num_processes"] == len(hosts) for b in boots)
+    assert len({b["coordinator_address"] for b in boots}) == 1
+
+    procs = []
+    for p in paths:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", WORKER.format(repo=REPO, bootstrap=p)],
+            env=_worker_env(),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        procs.append(proc)
+        # One worker failing must not leave its peers blocked in the
+        # jax.distributed rendezvous: kill all on any exit path.
+        cleanups.append(lambda proc=proc: (proc.kill(), proc.wait()))
+    reports = []
+    for proc in procs:
+        out, err = proc.communicate(timeout=600)
+        assert proc.returncode == 0, (
+            f"worker failed\nhead: {err[:1200]}\n...\ntail: {err[-1200:]}"
+        )
+        reports.append(json.loads(out.strip().splitlines()[-1]))
+    return reports
 
 
 def test_staged_bootstraps_form_real_process_group(tmp_path):
     registry = Registry()
     reg_srv = registry.start_server("tcp://127.0.0.1:0")
     cleanups = [registry.close, reg_srv.stop]
-    channels = {}
     try:
-        for host_id in ("host-a", "host-b"):
-            store = ChipStore(
-                mesh=(2, 1, 1), device_dir=str(tmp_path / host_id / "dev")
-            )
-            agent = FakeAgentServer(
-                store, str(tmp_path / host_id / "agent.sock")
-            ).start()
-            cleanups.append(agent.stop)
-            controller = Controller(
-                host_id,
-                agent.socket_path,
-                registry_address=str(reg_srv.addr()),
-                coordinator_host="127.0.0.1",
-                registry_delay=30.0,
-            )
-            ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
-            cleanups += [controller.close, ctrl_srv.stop]
-            controller.start(str(ctrl_srv.addr()))
-            driver = OIMDriver(
-                csi_endpoint=f"unix://{tmp_path}/{host_id}-csi.sock",
-                registry_address=str(reg_srv.addr()),
-                controller_id=host_id,
-            )
-            csi_srv = driver.start_server()
-            cleanups += [driver.close, csi_srv.stop]
-            channel = grpc.insecure_channel(csi_srv.addr().grpc_target())
-            cleanups.append(channel.close)
-            channels[host_id] = channel
-
-        deadline = time.time() + 10
-        while any(
-            registry.db.lookup(f"{h}/address") == "" for h in channels
-        ):
-            assert time.time() < deadline, "controllers never registered"
-            time.sleep(0.02)
-
-        cap = csi_pb2.VolumeCapability()
-        cap.mount.SetInParent()
-        cap.access_mode.mode = (
-            csi_pb2.VolumeCapability.AccessMode.MULTI_NODE_MULTI_WRITER
+        channels = _build_hosts(
+            tmp_path, ["host-a", "host-b"], registry, str(reg_srv.addr()),
+            cleanups,
         )
-        vol = CSI_CONTROLLER.stub(channels["host-a"]).CreateVolume(
-            csi_pb2.CreateVolumeRequest(
-                name="dist-vol",
-                volume_capabilities=[cap],
-                parameters={"chipCount": "2", "hosts": "host-a,host-b"},
-            ),
-            timeout=30,
-        ).volume
-
-        def stage(host_id: str) -> str:
-            staging = str(tmp_path / host_id / "staging")
-            target = str(tmp_path / host_id / "pod" / "tpu")
-            node = CSI_NODE.stub(channels[host_id])
-            node.NodeStageVolume(
-                csi_pb2.NodeStageVolumeRequest(
-                    volume_id="dist-vol",
-                    staging_target_path=staging,
-                    volume_capability=cap,
-                    volume_context=dict(vol.volume_context),
-                ),
-                timeout=60,
-            )
-            node.NodePublishVolume(
-                csi_pb2.NodePublishVolumeRequest(
-                    volume_id="dist-vol",
-                    staging_target_path=staging,
-                    target_path=target,
-                    volume_capability=cap,
-                ),
-                timeout=60,
-            )
-            return os.path.join(target, "tpu-bootstrap.json")
-
-        # Concurrent: the rendezvous blocks until both hosts join.
-        with concurrent.futures.ThreadPoolExecutor(2) as pool:
-            paths = list(pool.map(stage, ["host-a", "host-b"]))
-
-        boots = [json.load(open(p)) for p in paths]
-        assert {b["process_id"] for b in boots} == {0, 1}
-        assert all(b["num_processes"] == 2 for b in boots)
-        assert len({b["coordinator_address"] for b in boots}) == 1
-
-        # The workloads: one process per staged bootstrap, forming ONE
-        # jax.distributed group and agreeing on a global collective.
-        procs = []
-        for p in paths:
-            proc = subprocess.Popen(
-                [sys.executable, "-c", WORKER.format(repo=REPO, bootstrap=p)],
-                env=_worker_env(),
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-            )
-            procs.append(proc)
-            # One worker failing must not leave its peer blocked in the
-            # jax.distributed rendezvous: kill both on any exit path.
-            cleanups.append(lambda proc=proc: (proc.kill(), proc.wait()))
-        reports = []
-        for proc in procs:
-            out, err = proc.communicate(timeout=300)
-            assert proc.returncode == 0, (
-                f"worker failed\nhead: {err[:1200]}\n...\ntail: {err[-1200:]}"
-            )
-            reports.append(json.loads(out.strip().splitlines()[-1]))
-
+        reports = _stage_and_run_group(
+            tmp_path, channels, "dist-vol", cleanups
+        )
         assert {r["process"] for r in reports} == {0, 1}
         for r in reports:
             assert r["num_processes"] == 2
@@ -207,41 +228,6 @@ def test_staged_bootstraps_form_real_process_group(tmp_path):
                 pass
 
 
-WORKER_N = """
-import json, os, sys
-sys.path.insert(0, {repo!r})
-import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
-
-from oim_tpu.parallel import coordinator
-
-mesh = coordinator.initialize({bootstrap!r})
-
-import numpy as np
-import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-pid = jax.process_index()
-local = np.full((2, 4), pid + 1, np.float32)
-x = jax.make_array_from_process_local_data(
-    NamedSharding(mesh, P("dp")),
-    local,
-    global_shape=(2 * jax.process_count(), 4),
-)
-total = jax.jit(
-    lambda x: x.sum(), out_shardings=NamedSharding(mesh, P())
-)(x)
-print(json.dumps({{
-    "process": pid,
-    "num_processes": jax.process_count(),
-    "global_devices": len(jax.devices()),
-    "mesh_axes": {{k: int(v) for k, v in mesh.shape.items()}},
-    "sum": float(total),
-}}))
-"""
-
-
 @pytest.mark.skipif(
     os.environ.get("TEST_MULTIHOST4") != "1",
     reason="4-process DCN tier is opt-in: TEST_MULTIHOST4=1 (heavy: 4 jax "
@@ -250,10 +236,10 @@ print(json.dumps({{
 def test_four_hosts_etcd_registry_group(tmp_path):
     """VERDICT r3 #8: the 2-process tier, scaled to FOUR processes with
     the rendezvous through a registry backed by the REAL etcd wire
-    (EtcdRegistryDB → in-process EtcdKVServer): 4 controllers register
-    (leased), 4 NodeStages converge on one coordinator through etcd-backed
-    state, and 4 worker processes form one jax.distributed group (2 CPU
-    devices each → 8 global) agreeing on a cross-process collective."""
+    (EtcdRegistryDB → in-process EtcdKVServer): 4 controllers register,
+    4 NodeStages converge on one coordinator through etcd-backed state,
+    and 4 worker processes form one jax.distributed group (2 CPU devices
+    each → 8 global) agreeing on a cross-process collective."""
     from oim_tpu.registry import EtcdKVServer, EtcdRegistryDB
 
     kv = EtcdKVServer()
@@ -262,118 +248,19 @@ def test_four_hosts_etcd_registry_group(tmp_path):
     registry = Registry(db=db)
     reg_srv = registry.start_server("tcp://127.0.0.1:0")
     cleanups = [registry.close, reg_srv.stop, db.close, kv.close, kv_srv.stop]
-    channels = {}
-    hosts = [f"host-{i}" for i in range(4)]
     try:
-        for host_id in hosts:
-            store = ChipStore(
-                mesh=(2, 1, 1), device_dir=str(tmp_path / host_id / "dev")
-            )
-            agent = FakeAgentServer(
-                store, str(tmp_path / host_id / "agent.sock")
-            ).start()
-            cleanups.append(agent.stop)
-            controller = Controller(
-                host_id,
-                agent.socket_path,
-                registry_address=str(reg_srv.addr()),
-                coordinator_host="127.0.0.1",
-                registry_delay=30.0,
-            )
-            ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
-            cleanups += [controller.close, ctrl_srv.stop]
-            controller.start(str(ctrl_srv.addr()))
-            driver = OIMDriver(
-                csi_endpoint=f"unix://{tmp_path}/{host_id}-csi.sock",
-                registry_address=str(reg_srv.addr()),
-                controller_id=host_id,
-            )
-            csi_srv = driver.start_server()
-            cleanups += [driver.close, csi_srv.stop]
-            channel = grpc.insecure_channel(csi_srv.addr().grpc_target())
-            cleanups.append(channel.close)
-            channels[host_id] = channel
-
-        deadline = time.time() + 15
-        while any(
-            registry.db.lookup(f"{h}/address") == "" for h in channels
-        ):
-            assert time.time() < deadline, "controllers never registered"
-            time.sleep(0.02)
-
-        cap = csi_pb2.VolumeCapability()
-        cap.mount.SetInParent()
-        cap.access_mode.mode = (
-            csi_pb2.VolumeCapability.AccessMode.MULTI_NODE_MULTI_WRITER
+        channels = _build_hosts(
+            tmp_path, [f"host-{i}" for i in range(4)], registry,
+            str(reg_srv.addr()), cleanups,
         )
-        vol = CSI_CONTROLLER.stub(channels["host-0"]).CreateVolume(
-            csi_pb2.CreateVolumeRequest(
-                name="dist4-vol",
-                volume_capabilities=[cap],
-                parameters={"chipCount": "2", "hosts": ",".join(hosts)},
-            ),
-            timeout=30,
-        ).volume
-
-        def stage(host_id: str) -> str:
-            staging = str(tmp_path / host_id / "staging")
-            target = str(tmp_path / host_id / "pod" / "tpu")
-            node = CSI_NODE.stub(channels[host_id])
-            node.NodeStageVolume(
-                csi_pb2.NodeStageVolumeRequest(
-                    volume_id="dist4-vol",
-                    staging_target_path=staging,
-                    volume_capability=cap,
-                    volume_context=dict(vol.volume_context),
-                ),
-                timeout=120,
-            )
-            node.NodePublishVolume(
-                csi_pb2.NodePublishVolumeRequest(
-                    volume_id="dist4-vol",
-                    staging_target_path=staging,
-                    target_path=target,
-                    volume_capability=cap,
-                ),
-                timeout=120,
-            )
-            return os.path.join(target, "tpu-bootstrap.json")
-
-        with concurrent.futures.ThreadPoolExecutor(4) as pool:
-            paths = list(pool.map(stage, hosts))
-
-        boots = [json.load(open(p)) for p in paths]
-        assert {b["process_id"] for b in boots} == {0, 1, 2, 3}
-        assert all(b["num_processes"] == 4 for b in boots)
-        assert len({b["coordinator_address"] for b in boots}) == 1
-
-        procs = []
-        for p in paths:
-            proc = subprocess.Popen(
-                [
-                    sys.executable, "-c",
-                    WORKER_N.format(repo=REPO, bootstrap=p),
-                ],
-                env=_worker_env(),
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-            )
-            procs.append(proc)
-            cleanups.append(lambda proc=proc: (proc.kill(), proc.wait()))
-        reports = []
-        for proc in procs:
-            out, err = proc.communicate(timeout=600)
-            assert proc.returncode == 0, (
-                f"worker failed\nhead: {err[:1200]}\n...\ntail: {err[-1200:]}"
-            )
-            reports.append(json.loads(out.strip().splitlines()[-1]))
-
+        reports = _stage_and_run_group(
+            tmp_path, channels, "dist4-vol", cleanups
+        )
         assert {r["process"] for r in reports} == {0, 1, 2, 3}
         for r in reports:
             assert r["num_processes"] == 4
             assert r["global_devices"] == 8
-            # 8 rows of 4: (1+2+3+4) * 2 rows * 4 cols = 80.
+            # 2 rows per process of (pid+1): (1+2+3+4) * 2 rows * 4 cols.
             assert r["sum"] == 80.0
     finally:
         for cleanup in reversed(cleanups):
