@@ -486,10 +486,16 @@ def _run(tmp: str, agent_sock: str, cleanups: list, extras: dict) -> int:
         log(f"bench: flagship init skipped: {exc}")
         params = None
     if params is not None:
-        # Decode/serve first: the train step donates the param buffers.
+        # Train FIRST: mfu_pct (with fused CE) and fused_ce_speedup are
+        # the round's priority numbers, and pool windows can be short —
+        # a wedge mid-run must cost the serving rows, not these.  Safe
+        # ordering-wise: measure_train_step builds its donated state
+        # from COPIES and preserves params
+        # (tests/test_bench.py::test_measure_train_step_preserves_params),
+        # so decode/serve reuse the same model after it.
+        _train_diagnostics(extras, on_tpu, cfg, batch, seq, params)
         _decode_diagnostics(extras, on_tpu, cfg, batch, params)
         _serve_diagnostics(extras, on_tpu, cfg, params)
-        _train_diagnostics(extras, on_tpu, cfg, batch, seq, params)
     _flash_diagnostics(extras, on_tpu)
     # Last: it opens a SECOND PJRT client against the pool (the staged
     # agent); a wedge here must not cost the numbers above.

@@ -108,3 +108,76 @@ def test_measure_train_step_preserves_params():
 
     for x in jax.tree_util.tree_leaves(params):
         float(jnp.sum(x.astype(jnp.float32)))
+
+
+def test_spec_margin_check_on_cpu():
+    """Exercise bench._spec_margin_check off-chip: a fabricated
+    plain/spec divergence on a tiny model must produce a finite margin
+    and the near-tie/violation verdicts must track eps.  This is the one
+    new on-chip-only bench path — a crash here would burn a pool window."""
+    import os
+    import sys
+
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench as bench_mod
+
+    from oim_tpu.models import TransformerConfig, init_params
+    from oim_tpu.models.decode import prefill
+
+    cfg = TransformerConfig(
+        vocab_size=101, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        dtype="float32", use_pallas=False,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    # Agreed prefix of 3 tokens, then a fabricated divergence: pick the
+    # true top-2 tokens at the divergence position so the margin equals
+    # the model's own top-2 gap.
+    import numpy as np
+
+    agreed = prompt + [7, 7, 7]  # prompt + the 3 agreed generated tokens
+    logits, _ = prefill(params, jax.numpy.asarray([agreed]), cfg, 16)
+    row = np.asarray(logits[0, len(agreed) - 1], dtype=np.float32)
+    top2 = np.argsort(row)[-2:]
+    t_spec, t_plain = int(top2[0]), int(top2[1])
+    gap = float(row[t_plain] - row[t_spec])
+
+    plain = {10: [7, 7, 7, t_plain, 1]}
+    spec = {20: [7, 7, 7, t_spec, 2]}
+    extras = {}
+    bench_mod._spec_margin_check(
+        extras, cfg, params,
+        echo_prompts=[prompt],
+        plain_results=plain, spec_results=spec,
+        rids=[10], rids2=[20],
+        first_mismatch=[3], new_tokens=5,
+    )
+    assert extras["serve_spec_margin_checked"] == 1
+    assert abs(extras["serve_spec_margin_max"] - round(gap, 4)) < 1e-3
+    # Verdict tracks eps: generous eps → near-tie, tiny eps → violation.
+    if gap >= 0.05:
+        assert "serve_spec_margin_violation" in extras
+    extras2 = {}
+    import os as _os
+
+    _os.environ["OIM_BENCH_SPEC_MARGIN_EPS"] = str(gap + 1.0)
+    try:
+        bench_mod._spec_margin_check(
+            extras2, cfg, params,
+            echo_prompts=[prompt],
+            plain_results=plain, spec_results=spec,
+            rids=[10], rids2=[20],
+            first_mismatch=[3], new_tokens=5,
+        )
+    finally:
+        _os.environ.pop("OIM_BENCH_SPEC_MARGIN_EPS", None)
+    assert "serve_spec_margin_violation" not in extras2
+
+    # No divergence → no-op, no extras.
+    extras3 = {}
+    bench_mod._spec_margin_check(
+        extras3, cfg, params, [prompt], plain, spec, [10], [20], [5], 5,
+    )
+    assert extras3 == {}
