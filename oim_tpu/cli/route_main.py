@@ -48,6 +48,17 @@ def build_parser() -> argparse.ArgumentParser:
         "timeout)",
     )
     p.add_argument(
+        "--affinity-prefix-tokens", type=int, default=32,
+        help="route /v1/generate requests sharing this many leading "
+        "token ids to one backend (its prefix cache holds them); 0 "
+        "disables affinity",
+    )
+    p.add_argument(
+        "--affinity-slack", type=int, default=2,
+        help="max extra in-flight requests the affine backend may carry "
+        "over the least-loaded one before affinity yields to balance",
+    )
+    p.add_argument(
         "--http-tls", action="store_true",
         help="mTLS on the data plane with the same --ca/--cert/--key: "
         "the router's own listener requires client certs AND the router "
@@ -92,6 +103,8 @@ def main(argv=None) -> int:
             request_timeout=args.request_timeout,
             ssl_context=ssl_context,
             client_ssl_context=client_ctx,
+            affinity_prefix_tokens=args.affinity_prefix_tokens,
+            affinity_slack=args.affinity_slack,
         ).start()
     except ValueError as exc:
         raise SystemExit(str(exc))

@@ -16,7 +16,10 @@ same property the reference's transparent proxy has.
 
 Behavior:
 - Balancing: least active in-flight requests among healthy backends
-  (ties broken round-robin).
+  (ties broken round-robin).  Generate requests sharing a long prompt
+  prefix prefer one rendezvous-hashed backend (whose prefix cache
+  holds that prefix) unless it is overloaded — cache locality without
+  hot-prefix starvation.
 - Health: GET /healthz per backend on an interval; a backend is out
   after ``unhealthy_after`` consecutive failures and back on the first
   success.  A request-level connection failure counts too, so a dead
@@ -35,6 +38,7 @@ proxied; GET /healthz (ok while ≥1 backend is healthy), /v1/stats
 
 from __future__ import annotations
 
+import hashlib
 import json
 import threading
 import urllib.error
@@ -60,6 +64,12 @@ class Backend:
     active: int = 0
     completed: int = 0
     fails: int = 0  # consecutive health/connection failures
+    # From the backend's /v1/info (fetched once at the first successful
+    # probe): whether its engine runs a prompt-prefix cache.  Affinity
+    # routing only applies to cache-running backends — pinning a hot
+    # prefix to one backend is pure load skew if nothing caches it.
+    prefix_cache: bool = False
+    info_fetched: bool = False
 
 
 class Router:
@@ -80,6 +90,8 @@ class Router:
         request_timeout: float = 600.0,
         ssl_context=None,
         client_ssl_context=None,
+        affinity_prefix_tokens: int = 32,
+        affinity_slack: int = 2,
     ):
         """``ssl_context`` wraps the router's own listener in mTLS;
         ``client_ssl_context`` authenticates the router to mTLS
@@ -100,6 +112,8 @@ class Router:
         self.discover_interval = discover_interval
         self.unhealthy_after = unhealthy_after
         self.request_timeout = request_timeout
+        self.affinity_prefix_tokens = affinity_prefix_tokens
+        self.affinity_slack = affinity_slack
         self._stop = threading.Event()
         self._rr = 0
         self._probing: set[str] = set()
@@ -200,8 +214,20 @@ class Router:
         with self._lock:
             return [b for b in self._backends.values() if b.healthy]
 
-    def _pick(self, exclude: set[str] = frozenset()) -> Backend | None:
-        """Least-active healthy backend, round-robin among ties."""
+    def _pick(
+        self,
+        exclude: set[str] = frozenset(),
+        affinity_key: str | None = None,
+    ) -> Backend | None:
+        """Least-active healthy backend, round-robin among ties.
+
+        ``affinity_key`` biases the choice: the key's rendezvous-hash
+        winner (stable under backend churn, no shared state) is taken
+        as long as it isn't overloaded — more than ``affinity_slack``
+        in-flight requests above the least-active backend.  This is how
+        per-backend prompt-prefix caches stay useful behind the router:
+        requests sharing a prefix land on the backend whose cache holds
+        it, but a hot prefix cannot starve the fleet."""
         with self._lock:
             ready = [
                 b
@@ -211,6 +237,17 @@ class Router:
             if not ready:
                 return None
             least = min(b.active for b in ready)
+            cacheable = [b for b in ready if b.prefix_cache]
+            if affinity_key is not None and cacheable:
+                affine = max(
+                    cacheable,
+                    key=lambda b: hashlib.sha256(
+                        f"{affinity_key}|{b.id}".encode()
+                    ).digest(),
+                )
+                if affine.active <= least + self.affinity_slack:
+                    affine.active += 1
+                    return affine
             tied = [b for b in ready if b.active == least]
             self._rr += 1
             chosen = tied[self._rr % len(tied)]
@@ -241,14 +278,35 @@ class Router:
 
     # -- proxying ----------------------------------------------------------
 
+    def _affinity_key(self, path: str, body: bytes | None) -> str | None:
+        """Prompt-prefix affinity for /v1/generate: requests whose first
+        ``affinity_prefix_tokens`` token ids match should share a
+        backend (that backend's prefix cache holds their prefix).  Any
+        parse problem means no affinity — never an error."""
+        if (
+            self.affinity_prefix_tokens <= 0
+            or path != "/v1/generate"
+            or not body
+        ):
+            return None
+        try:
+            tokens = json.loads(body)["tokens"]
+            prefix = tokens[: self.affinity_prefix_tokens]
+            if len(prefix) < self.affinity_prefix_tokens:
+                return None  # short prompts: cheaper to balance freely
+            return ",".join(str(int(t)) for t in prefix)
+        except Exception:
+            return None
+
     def _proxy(
         self, handler, path: str, body: bytes | None, headers: dict
     ) -> None:
         """Proxy one request to a healthy backend (``body`` None = GET —
         urllib's method selection; bytes = POST)."""
         tried: set[str] = set()
+        affinity_key = self._affinity_key(path, body)
         while len(tried) < 2:  # the documented single-retry bound
-            backend = self._pick(exclude=tried)
+            backend = self._pick(exclude=tried, affinity_key=affinity_key)
             if backend is None:
                 handler._json(
                     503,
@@ -357,6 +415,8 @@ class Router:
                 backend.url + "/healthz", timeout=2
             ) as resp:
                 ok = resp.status == 200
+            if ok and not backend.info_fetched:
+                self._fetch_info(backend)
         except Exception as exc:
             # Any probe failure means unhealthy — including non-OSError
             # ones like a malformed registry-advertised URL (ValueError);
@@ -383,6 +443,23 @@ class Router:
                             error=str(err) if err else "probe failed",
                         )
                     backend.healthy = False
+
+    def _fetch_info(self, backend: Backend) -> None:
+        """One-time /v1/info fetch for affinity capability (the payload
+        is static by contract).  Failure leaves info_fetched False, so
+        the next probe retries."""
+        try:
+            with self._opener.open(
+                backend.url + "/v1/info", timeout=2
+            ) as resp:
+                info = json.loads(resp.read())
+        except Exception:
+            return
+        with self._lock:
+            backend.prefix_cache = bool(
+                info.get("engine", {}).get("prefix_cache_size", 0)
+            )
+            backend.info_fetched = True
 
     def _health_loop(self) -> None:
         while not self._stop.wait(self.health_interval):

@@ -115,13 +115,9 @@ def test_spec_margin_check_on_cpu():
     plain/spec divergence on a tiny model must produce a finite margin
     and the near-tie/violation verdicts must track eps.  This is the one
     new on-chip-only bench path — a crash here would burn a pool window."""
-    import os
-    import sys
-
     import jax
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    import bench as bench_mod
+    import bench as bench_mod  # repo root is on sys.path via conftest
 
     from oim_tpu.models import TransformerConfig, init_params
     from oim_tpu.models.decode import prefill
@@ -159,9 +155,9 @@ def test_spec_margin_check_on_cpu():
     # Verdict tracks eps: generous eps → near-tie, tiny eps → violation.
     if gap >= 0.05:
         assert "serve_spec_margin_violation" in extras
-    extras2 = {}
     import os as _os
 
+    extras2 = {}
     _os.environ["OIM_BENCH_SPEC_MARGIN_EPS"] = str(gap + 1.0)
     try:
         bench_mod._spec_margin_check(

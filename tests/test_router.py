@@ -486,3 +486,98 @@ def test_registration_stop_deregisters(backends):
         assert reg.db.lookup("serve/inst-5/address") == ""
     finally:
         reg_srv.stop()
+
+
+class TestPrefixAffinity:
+    """Prompt-prefix affinity in _pick/_affinity_key (unit level — no
+    HTTP needed; the routed-request path is the same _pick call)."""
+
+    def _router(self, caching=True, **kw):
+        r = Router(backends=("http://a:1", "http://b:2", "http://c:3"), **kw)
+        if caching:
+            # As the one-time /v1/info fetch would discover on a fleet
+            # running --prefix-cache.
+            for b in r._backends.values():
+                b.prefix_cache = True
+        return r
+
+    def test_same_prefix_same_backend(self):
+        router = self._router()
+        key = router._affinity_key(
+            "/v1/generate",
+            json.dumps({"tokens": list(range(64)), "max_new_tokens": 4}).encode(),
+        )
+        assert key is not None
+        picks = set()
+        for _ in range(12):
+            b = router._pick(affinity_key=key)
+            picks.add(b.id)
+            router._release(b, ok=True)
+        assert len(picks) == 1  # all 12 landed on the rendezvous winner
+
+    def test_different_prefixes_spread(self):
+        router = self._router()
+        picks = set()
+        for i in range(40):
+            key = router._affinity_key(
+                "/v1/generate",
+                json.dumps({"tokens": [i] * 64}).encode(),
+            )
+            b = router._pick(affinity_key=key)
+            picks.add(b.id)
+            router._release(b, ok=True)
+        assert len(picks) == 3  # hashing spreads distinct prefixes
+
+    def test_affinity_yields_under_load(self):
+        router = self._router(affinity_slack=2)
+        key = router._affinity_key(
+            "/v1/generate", json.dumps({"tokens": [7] * 64}).encode()
+        )
+        affine = router._pick(affinity_key=key)
+        # Pin the affine backend 3 in-flight above the others.
+        affine.active = 3
+        other = router._pick(affinity_key=key)
+        assert other.id != affine.id, "overloaded affine backend not bypassed"
+
+    def test_no_affinity_cases(self):
+        router = self._router()
+        short = json.dumps({"tokens": [1, 2, 3]}).encode()
+        assert router._affinity_key("/v1/generate", short) is None
+        assert router._affinity_key("/v1/embed", b'{"tokens": [1]}') is None
+        assert router._affinity_key("/v1/generate", b"not json") is None
+        off = self._router(affinity_prefix_tokens=0)
+        assert off._affinity_key(
+            "/v1/generate", json.dumps({"tokens": [1] * 64}).encode()
+        ) is None
+
+    def test_affinity_skips_excluded_and_unhealthy(self):
+        router = self._router()
+        key = router._affinity_key(
+            "/v1/generate", json.dumps({"tokens": [9] * 64}).encode()
+        )
+        affine = router._pick(affinity_key=key)
+        router._release(affine, ok=True)
+        # Retry path: the affine backend just failed → excluded.
+        b2 = router._pick(exclude={affine.id}, affinity_key=key)
+        assert b2 is not None and b2.id != affine.id
+        router._release(b2, ok=True)
+        # Unhealthy path: the affine backend is down → never picked.
+        affine.healthy = False
+        for _ in range(6):
+            b3 = router._pick(affinity_key=key)
+            assert b3.id != affine.id
+            router._release(b3, ok=True)
+
+    def test_no_affinity_without_caching_backends(self):
+        """A fleet that runs no prefix cache must balance freely —
+        pinning a hot prefix there is pure skew with zero cache win."""
+        router = self._router(caching=False)
+        key = router._affinity_key(
+            "/v1/generate", json.dumps({"tokens": [4] * 64}).encode()
+        )
+        picks = set()
+        for _ in range(9):
+            b = router._pick(affinity_key=key)
+            picks.add(b.id)
+            router._release(b, ok=True)
+        assert len(picks) == 3  # plain round-robin among equals
