@@ -979,6 +979,35 @@ def _serve_diagnostics(extras, on_tpu, cfg, params) -> None:
             f"{readbacks} readbacks)"
         )
 
+        # Swing diagnosis (BASELINE r3: dense serving read 665 vs 1112
+        # tok/s across runs at the SAME rtt — unexplained).  Repeat the
+        # identical measurement in THIS process: tight repeats separate
+        # intra-process variance (pool contention, tunnel hiccups) from
+        # whatever differs across bench invocations.  serve_tok_per_s
+        # stays the FIRST measurement (comparable with history); the
+        # repeats land in serve_tok_per_s_runs.
+        repeats = int(os.environ.get("OIM_BENCH_SERVE_REPEAT", "2" if on_tpu else "0"))
+        if repeats > 0:
+            runs = [extras["serve_tok_per_s"]]
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                rids_r = [
+                    engine.submit(
+                        GenRequest(tokens=p, max_new_tokens=new_tokens)
+                    )
+                    for p in prompts
+                ]
+                results_r = engine.run()
+                dt_r = time.perf_counter() - t0
+                assert all(len(results_r[r]) == new_tokens for r in rids_r)
+                runs.append(round(generated / dt_r))
+            extras["serve_tok_per_s_runs"] = runs
+            spread = (max(runs) - min(runs)) / max(runs)
+            log(
+                f"bench: serving repeats {runs} tok/s "
+                f"(intra-process spread {100 * spread:.0f}%)"
+            )
+
         if not on_tpu:
             return
         # Speculative serving on echo-heavy prompts (prompt-lookup's

@@ -582,11 +582,14 @@ class Router:
             self._backends[sid] = Backend(id=sid, url=url, from_registry=True)
         elif existing.url != url:
             # Same id, new address: the instance moved (the
-            # channel-cache-era controller-move semantics).
+            # channel-cache-era controller-move semantics).  A restart
+            # may change capabilities too — re-fetch /v1/info.
             log.current().info("backend moved", backend=sid, url=url)
             existing.url = url
             existing.healthy = True
             existing.fails = 0
+            existing.info_fetched = False
+            existing.prefix_cache = False
 
     def _reconcile(self, found: dict[str, str]) -> None:
         """Full-state reconcile: registry-sourced entries come and go

@@ -403,3 +403,59 @@ def test_two_registry_replicas_share_etcd_watch(etcd):
         reg_b.close()
         db_a.close()
         db_b.close()
+
+
+def test_watch_storm_converges_over_wire(etcd):
+    """The in-process storm (tests/test_registry.py), through the etcd
+    v3 wire: 4 writer threads × stores/deletes/leases while a client
+    watch replays events into a view that must converge to the final KV
+    state.  Exercises the server-side event queue ordering AND the
+    client watch delivery path under real concurrency."""
+    import random
+    import threading
+
+    _, _, db = etcd
+    view: dict[str, str] = {}
+    view_lock = threading.Lock()
+
+    def replay(path: str, value: str) -> None:
+        with view_lock:
+            if value == "":
+                view.pop(path, None)
+            else:
+                view[path] = value
+
+    cancel = db.watch("storm", replay)
+    keys = [f"storm/k{i}/address" for i in range(4)]
+    try:
+        def worker(seed: int) -> None:
+            rng = random.Random(seed)
+            for n in range(40):
+                key = rng.choice(keys)
+                op = rng.random()
+                if op < 0.55:
+                    db.store(key, f"v{seed}-{n}")
+                elif op < 0.8:
+                    db.store(key, "")
+                else:
+                    db.store(key, f"leased{seed}-{n}", ttl=1)
+
+        threads = [
+            threading.Thread(target=worker, args=(s,)) for s in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        def converged() -> bool:
+            state = dict(db.items("storm"))
+            with view_lock:  # replay() still fires on lease expiries
+                return state == view
+
+        assert _wait_for(converged, timeout=20), (
+            f"db={dict(db.items('storm'))}\nview={view}"
+        )
+    finally:
+        cancel()
+        for key in keys:
+            db.store(key, "")

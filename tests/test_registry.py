@@ -381,3 +381,64 @@ def test_proxy_channel_invalidated_on_address_delete(monkeypatch):
     reg.db.store("ctrl-1/address", "")
     assert invalidated == ["ctrl-1"]
     reg.close()
+
+
+@pytest.mark.parametrize("make_db", [MemRegistryDB, None], ids=["mem", "sqlite"])
+def test_watch_storm_converges(make_db, tmp_path):
+    """Concurrency storm over the watch/lease machinery: 8 threads
+    hammer overlapping keys with stores, deletes, and short leases while
+    a watcher REPLAYS every event into its own view.  Because delivery
+    order equals commit order (the _EventHub contract), the replayed
+    view must equal the DB exactly once quiescent — a single reordered
+    or lost event would leave them permanently diverged, which is
+    precisely the failure event-driven discovery cannot self-heal."""
+    import random
+    import threading
+
+    db = make_db() if make_db else SqliteRegistryDB(str(tmp_path / "reg.db"))
+    view: dict[str, str] = {}
+    view_lock = threading.Lock()
+
+    def replay(path: str, value: str) -> None:
+        with view_lock:
+            if value == "":
+                view.pop(path, None)
+            else:
+                view[path] = value
+
+    cancel = db.watch("", replay)
+    keys = [f"k{i}/address" for i in range(6)]
+
+    def worker(seed: int) -> None:
+        rng = random.Random(seed)
+        for n in range(120):
+            key = rng.choice(keys)
+            op = rng.random()
+            if op < 0.5:
+                db.store(key, f"v{seed}-{n}")
+            elif op < 0.75:
+                db.store(key, "")
+            else:
+                db.store(key, f"leased{seed}-{n}", ttl=0.05)
+
+    threads = [
+        threading.Thread(target=worker, args=(seed,)) for seed in range(8)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        def converged() -> bool:
+            state = dict(db.items(""))
+            with view_lock:  # replay() still fires on lease expiries
+                return state == view
+
+        # Quiescence: every short lease has fired and drained.
+        assert _wait_for(converged, timeout=10), (
+            f"db={dict(db.items(''))}\nview={view}"
+        )
+    finally:
+        cancel()
+        db.close()
