@@ -92,12 +92,34 @@ def main(argv=None) -> int:
             if cfg.sliding_window else ""
         )
     )
+    # Carry the model's tokenizer over (a sibling dir — the orbax
+    # checkpoint tree must stay exactly what StandardCheckpointer
+    # wrote): oim-serve --tokenizer-dir enables the text API with it.
+    tok_dir = ""
+    tok_files = [
+        f
+        for f in (
+            "tokenizer.json", "tokenizer_config.json",
+            "special_tokens_map.json", "tokenizer.model", "vocab.json",
+            "merges.txt",
+        )
+        if os.path.exists(os.path.join(args.hf_dir, f))
+    ]
+    if tok_files:
+        import shutil
+
+        tok_dir = out_dir + "-tokenizer"
+        os.makedirs(tok_dir, exist_ok=True)
+        for f in tok_files:
+            shutil.copy2(os.path.join(args.hf_dir, f), tok_dir)
+
     print(f"imported {args.hf_dir} -> {out_dir}")
     print(
         f"train flags: {flags} --pp {cfg.n_stages} --params-dir {out_dir}"
     )
+    tok_flag = f" --tokenizer-dir {tok_dir}" if tok_dir else ""
     if cfg.n_stages == 1:
-        print(f"serve flags: {flags} --params-dir {out_dir}")
+        print(f"serve flags: {flags} --params-dir {out_dir}{tok_flag}")
     else:
         print(
             "serve: restack with --n-stages 1 first (oim-serve runs "

@@ -76,7 +76,14 @@ def main(argv=None) -> int:
     generate = sub.add_parser(
         "generate", help="send a generation request to an oim-serve daemon"
     )
-    generate.add_argument("tokens", type=int, nargs="+", help="prompt token ids")
+    generate.add_argument(
+        "tokens", type=int, nargs="*", help="prompt token ids"
+    )
+    generate.add_argument(
+        "--text", default=None,
+        help="prompt as text instead of token ids (the serve instance "
+        "must run --tokenizer-dir); the reply prints decoded text too",
+    )
     generate.add_argument("--serve", default="http://127.0.0.1:8000")
     generate.add_argument("--max-new-tokens", type=int, default=16)
     generate.add_argument("--temperature", type=float, default=0.0)
@@ -137,6 +144,13 @@ def main(argv=None) -> int:
                 headers={"Content-Type": "application/json"},
             )
 
+        if (args.text is None) == (not args.tokens):
+            print("error: give either prompt token ids or --text")
+            return 2
+        prompt = (
+            {"text": args.text} if args.text is not None
+            else {"tokens": args.tokens}
+        )
         if args.beam:
             if args.stream or args.logprobs or args.temperature:
                 print("error: --beam excludes --stream/--logprobs/"
@@ -145,7 +159,7 @@ def main(argv=None) -> int:
             try:
                 with urlopen(
                     post_request("/v1/beam", {
-                        "tokens": args.tokens,
+                        **prompt,
                         "max_new_tokens": args.max_new_tokens,
                         "beam_size": args.beam,
                         "eos_id": args.eos_id,
@@ -154,13 +168,15 @@ def main(argv=None) -> int:
                 ) as resp:
                     reply = json_mod.load(resp)
                 print("tokens:", " ".join(str(t) for t in reply["tokens"]))
+                if reply.get("text") is not None:
+                    print("text:", reply["text"])
                 print(f"score: {reply['score']:.4f}")
             except urllib.error.URLError as exc:
                 print(f"error: {exc}")
                 return 1
             return 0
         request = post_request("/v1/generate", {
-            "tokens": args.tokens,
+            **prompt,
             "max_new_tokens": args.max_new_tokens,
             "temperature": args.temperature,
             "top_p": args.top_p,
@@ -188,6 +204,8 @@ def main(argv=None) -> int:
                 else:
                     reply = json_mod.load(response)
                     print("tokens:", " ".join(str(t) for t in reply["tokens"]))
+                    if reply.get("text") is not None:
+                        print("text:", reply["text"])
                     if args.logprobs:
                         print(
                             "logprobs:",

@@ -160,6 +160,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cert", help="cert (CN serve.<id>)")
     p.add_argument("--key", help="key")
     p.add_argument(
+        "--tokenizer-dir", default="",
+        help="HF tokenizer directory (oim-import-hf copies it next to "
+        "the weights): enables {'text': ...} requests and decoded-text "
+        "replies on the HTTP API; without it this instance speaks "
+        "token ids only",
+    )
+    p.add_argument(
         "--http-tls", action="store_true",
         help="serve the HTTP API over mTLS with the same --ca/--cert/"
         "--key: clients (oim-route, oimctl) must hold a deployment-CA "
@@ -296,10 +303,8 @@ def main(argv=None) -> int:
 
     from oim_tpu.serve.server import ServeServer
 
-    engine = make_engine(args)
-    if not args.no_warmup:
-        log.current().info("warming up", buckets=list(engine.prompt_buckets))
-        engine.warmup(embed=args.warmup_embed)
+    # Cheap config pieces FIRST: a bad cert path or tokenizer dir must
+    # surface before the engine pays its multi-minute compiles.
     ssl_context = None
     if args.http_tls:
         if not (args.ca and args.cert and args.key):
@@ -307,8 +312,19 @@ def main(argv=None) -> int:
         from oim_tpu.serve.httptls import server_ssl_context
 
         ssl_context = server_ssl_context(args.ca, args.cert, args.key)
+    tokenizer = None
+    if args.tokenizer_dir:
+        from oim_tpu.serve.texttok import TextTokenizer
+
+        tokenizer = TextTokenizer(args.tokenizer_dir)
+        log.current().info("tokenizer loaded", path=args.tokenizer_dir)
+    engine = make_engine(args)
+    if not args.no_warmup:
+        log.current().info("warming up", buckets=list(engine.prompt_buckets))
+        engine.warmup(embed=args.warmup_embed)
     server = ServeServer(
-        engine, host=args.host, port=args.port, ssl_context=ssl_context
+        engine, host=args.host, port=args.port, ssl_context=ssl_context,
+        tokenizer=tokenizer,
     ).start()
     log.current().info(
         "oim-serve listening", host=server.host, port=server.port,

@@ -290,11 +290,22 @@ class Router:
         ):
             return None
         try:
-            tokens = json.loads(body)["tokens"]
-            prefix = tokens[: self.affinity_prefix_tokens]
-            if len(prefix) < self.affinity_prefix_tokens:
-                return None  # short prompts: cheaper to balance freely
-            return ",".join(str(int(t)) for t in prefix)
+            payload = json.loads(body)
+            if "tokens" in payload:
+                prefix = payload["tokens"][: self.affinity_prefix_tokens]
+                if len(prefix) < self.affinity_prefix_tokens:
+                    return None  # short prompts: balance freely
+                return ",".join(str(int(t)) for t in prefix)
+            # Text surface: the router has no tokenizer, so the leading
+            # CHARACTERS proxy the token prefix (~4 chars/token).  Same
+            # shared-prefix requests → same key → same backend cache.
+            text = payload.get("text")
+            if isinstance(text, str):
+                n_chars = 4 * self.affinity_prefix_tokens
+                if len(text) < n_chars:
+                    return None
+                return "txt:" + text[:n_chars]
+            return None
         except Exception:
             return None
 

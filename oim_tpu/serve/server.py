@@ -39,7 +39,12 @@ API:
 
 The engine is tokenizer-agnostic by design — clients speak token ids, the
 same boundary the CSI driver keeps by speaking device paths rather than
-framework objects.
+framework objects.  With ``--tokenizer-dir`` (serve/texttok.py) the HTTP
+layer — not the engine — additionally accepts ``{"text": ...}`` in place
+of ``tokens`` on generate/beam/embed, defaults text requests' EOS to the
+tokenizer's, and adds decoded ``text`` to replies (streaming lines carry
+incremental ``text`` deltas whose concatenation equals the final
+decode).
 """
 
 from __future__ import annotations
@@ -72,13 +77,19 @@ class ServeServer:
         host: str = "127.0.0.1",
         port: int = 0,
         ssl_context=None,
+        tokenizer=None,
     ):
         """``ssl_context`` (from ``httptls.server_ssl_context``) wraps
         the listener in mTLS: clients must hold a deployment-CA cert or
         the handshake fails before the request is read (the reference's
         mTLS-everywhere stance applied to the serving data plane,
-        reference README.md:84-120)."""
+        reference README.md:84-120).  ``tokenizer`` (a
+        ``texttok.TextTokenizer``) enables the text surface: requests
+        may send ``{"text": ...}`` instead of ``tokens`` and replies
+        carry the decoded ``text`` — the engine itself stays
+        tokenizer-agnostic."""
         self.engine = engine
+        self.tokenizer = tokenizer
         self.error: str | None = None  # set when the driver thread dies
         self._stop = threading.Event()
         outer = self
@@ -111,7 +122,13 @@ class ServeServer:
                 elif self.path == "/v1/stats":
                     self._json(200, outer.engine.stats())
                 elif self.path == "/v1/info":
-                    self._json(200, outer.engine.info())
+                    info = outer.engine.info()
+                    # Server-level addition: whether the text surface is
+                    # live (the engine itself is tokenizer-agnostic).
+                    info["tokenizer"] = (
+                        outer.tokenizer.path if outer.tokenizer else None
+                    )
+                    self._json(200, info)
                 else:
                     self._json(404, {"error": f"no such path {self.path}"})
 
@@ -122,6 +139,11 @@ class ServeServer:
                 client that disconnects mid-stream forfeits the result
                 (engine.forget) — generation itself runs to completion."""
                 tokens_q: queue.Queue = queue.Queue()
+                decoder = (
+                    outer.tokenizer.stream_decoder()
+                    if outer.tokenizer is not None
+                    else None
+                )
                 try:
                     rid = outer.engine.submit(
                         req, on_token=lambda t, lp: tokens_q.put((t, lp))
@@ -168,6 +190,8 @@ class ServeServer:
                         if token is None:
                             break
                         line = {"token": token}
+                        if decoder is not None:
+                            line["text"] = decoder.push(token)
                         if self.want_logprobs:
                             line["logprob"] = logprob
                         self.wfile.write(
@@ -178,6 +202,10 @@ class ServeServer:
                         tokens, lps = outer.engine.result_full(rid, timeout=30)
                         span.attrs["generated"] = len(tokens)
                         final = {"done": True, "tokens": tokens}
+                        if decoder is not None:
+                            tail = decoder.flush()
+                            if tail:
+                                final["text"] = tail
                         if self.want_logprobs:
                             final["logprobs"] = lps
                         self.wfile.write(
@@ -223,7 +251,7 @@ class ServeServer:
                     length = int(self.headers.get("Content-Length", "0"))
                     body = json.loads(self.rfile.read(length) or b"{}")
                     vec = outer.engine.embed(
-                        [int(t) for t in body["tokens"]]
+                        self._prompt_tokens(body)
                     )
                 except (KeyError, TypeError, ValueError) as exc:
                     self._json(400, {"error": str(exc)})
@@ -234,33 +262,56 @@ class ServeServer:
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                     body = json.loads(self.rfile.read(length) or b"{}")
-                    eos = body.get("eos_id")
                     toks, score = outer.engine.beam(
-                        [int(t) for t in body["tokens"]],
+                        self._prompt_tokens(body),
                         max_new_tokens=int(body.get("max_new_tokens", 16)),
                         beam_size=int(body.get("beam_size", 4)),
                         alpha=float(body.get("alpha", 0.6)),
-                        eos_id=None if eos is None else int(eos),
+                        eos_id=self._default_eos(body),
                     )
                 except (KeyError, TypeError, ValueError) as exc:
                     self._json(400, {"error": str(exc)})
                     return
-                self._json(200, {"tokens": toks, "score": score})
+                payload = {"tokens": toks, "score": score}
+                if outer.tokenizer is not None:
+                    payload["text"] = outer.tokenizer.decode(toks)
+                self._json(200, payload)
+
+            def _prompt_tokens(self, body: dict) -> list[int]:
+                """Prompt ids from ``tokens`` or (with a tokenizer)
+                ``text`` — exactly one of the two."""
+                if "text" in body and "tokens" in body:
+                    raise ValueError("send either 'tokens' or 'text', not both")
+                if "text" in body:
+                    if outer.tokenizer is None:
+                        raise ValueError(
+                            "'text' needs a server-side tokenizer "
+                            "(oim-serve --tokenizer-dir); this instance "
+                            "speaks token ids only"
+                        )
+                    return outer.tokenizer.encode(str(body["text"]))
+                return [int(t) for t in body["tokens"]]
+
+            def _default_eos(self, body: dict) -> int | None:
+                """Explicit eos_id wins; text-mode requests default to
+                the tokenizer's EOS (a text caller means "a model turn",
+                not "exactly max_new_tokens")."""
+                if body.get("eos_id") is not None:
+                    return int(body["eos_id"])
+                if "text" in body and outer.tokenizer is not None:
+                    return outer.tokenizer.eos_id
+                return None
 
             def _generate(self, span) -> None:
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                     body = json.loads(self.rfile.read(length) or b"{}")
                     req = GenRequest(
-                        tokens=[int(t) for t in body["tokens"]],
+                        tokens=self._prompt_tokens(body),
                         max_new_tokens=int(body.get("max_new_tokens", 16)),
                         temperature=float(body.get("temperature", 0.0)),
                         seed=int(body.get("seed", 0)),
-                        eos_id=(
-                            int(body["eos_id"])
-                            if body.get("eos_id") is not None
-                            else None
-                        ),
+                        eos_id=self._default_eos(body),
                         stop_ids=tuple(
                             int(t) for t in body.get("stop_ids", ())
                         ),
@@ -327,6 +378,8 @@ class ServeServer:
                         span.trace_id, span.span_id
                     ).traceparent(),
                 }
+                if outer.tokenizer is not None:
+                    payload["text"] = outer.tokenizer.decode(tokens)
                 if self.want_logprobs:
                     payload["logprobs"] = lps
                 self._json(200, payload)

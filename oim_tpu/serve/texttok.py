@@ -1,0 +1,113 @@
+"""Text surface for the serving API: an HF-tokenizer wrapper.
+
+The engine is tokenizer-agnostic by design (token ids in, token ids
+out — the same stance as the reference control plane being
+filesystem-agnostic), but a deployment serving an imported HF
+checkpoint (cli/import_hf_main.py) has the model's tokenizer sitting
+right next to the weights.  ``--tokenizer-dir`` loads it here and the
+HTTP layer gains ``{"text": ...}`` requests and decoded-text replies —
+the engine itself never sees a string.
+
+Incremental decoding: token-at-a-time ``decode`` is wrong for BPE
+(multi-byte/multi-token characters), so streaming uses
+``StreamDecoder`` — decode the full generated-so-far sequence, emit the
+suffix, and hold back while the tail ends in an incomplete UTF-8
+replacement char.
+"""
+
+from __future__ import annotations
+
+
+class TextTokenizer:
+    """Lazy wrapper over ``transformers.AutoTokenizer``.
+
+    transformers is an OPTIONAL runtime dep (runtime-deps.csv: the HF
+    interop scope); constructing this without it raises a clear error
+    naming the missing piece rather than an ImportError five frames
+    deep.
+    """
+
+    def __init__(self, path: str):
+        try:
+            from transformers import AutoTokenizer
+        except ImportError as exc:  # pragma: no cover - env-dependent
+            raise RuntimeError(
+                "--tokenizer-dir needs the 'transformers' package "
+                "(optional dep; the token-id API works without it)"
+            ) from exc
+        self.path = path
+        self._tok = AutoTokenizer.from_pretrained(path)
+
+    def encode(self, text: str) -> list[int]:
+        return list(self._tok(text).input_ids)
+
+    def decode(self, token_ids: list[int]) -> str:
+        # clean_up_tokenization_spaces rewrites EARLIER text when later
+        # tokens arrive (' .' → '.'), which would break the streaming
+        # invariant (concatenated deltas == final decode) — so cleanup
+        # is off for BOTH this and the stream path, keeping decode
+        # prefix-stable.
+        return self._tok.decode(
+            token_ids,
+            skip_special_tokens=True,
+            clean_up_tokenization_spaces=False,
+        )
+
+    @property
+    def eos_id(self) -> int | None:
+        return self._tok.eos_token_id
+
+    def stream_decoder(self) -> "StreamDecoder":
+        return StreamDecoder(self)
+
+
+class StreamDecoder:
+    """Emit text deltas as tokens arrive; concatenated deltas (plus the
+    final ``flush``) equal ``decode(all_tokens)`` exactly.
+
+    Each push re-decodes the full sequence: O(total²) over a stream,
+    but total is bounded by the engine's ``max_len`` and a Rust decode
+    of even 8k ids is ~100 µs — the whole stream's decode overhead is
+    milliseconds against minutes of generation, and anchored suffix
+    decoding would reopen the sentencepiece leading-space bugs that
+    plague chunked decoders.  Cleanup-off decode (see ``decode``) makes
+    the full string prefix-stable; the guard below covers any exotic
+    tokenizer that rewrites anyway (deltas pause, ``flush`` trues up).
+    """
+
+    def __init__(self, tokenizer: TextTokenizer):
+        self._tokenizer = tokenizer
+        self._tokens: list[int] = []
+        self._emitted = ""
+
+    def push(self, token: int) -> str:
+        """The new text this token completes ("" while mid-character)."""
+        self._tokens.append(token)
+        full = self._tokenizer.decode(self._tokens)
+        # An incomplete multi-byte sequence decodes to U+FFFD at the
+        # tail; hold those bytes back until the next token completes it.
+        while full.endswith("�"):
+            full = full[:-1]
+        if not full.startswith(self._emitted):
+            # Non-prefix-stable rewrite (shouldn't happen with cleanup
+            # off): hold everything; flush() emits the authoritative
+            # remainder.
+            return ""
+        delta = full[len(self._emitted):]
+        self._emitted = full
+        return delta
+
+    def flush(self) -> str:
+        """Anything still held back (sequence ended mid-character)."""
+        full = self._tokenizer.decode(self._tokens)
+        if not full.startswith(self._emitted):
+            # Rewrite fallback: emit from the divergence point so the
+            # concatenation still ends in the right final text.
+            import os as _os
+
+            common = _os.path.commonprefix([full, self._emitted])
+            delta = full[len(common):]
+        else:
+            delta = full[len(self._emitted):]
+        self._emitted = full
+        return delta

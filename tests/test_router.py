@@ -581,3 +581,23 @@ class TestPrefixAffinity:
             picks.add(b.id)
             router._release(b, ok=True)
         assert len(picks) == 3  # plain round-robin among equals
+
+    def test_text_requests_get_affinity_too(self):
+        """The text surface routes by leading characters (the router has
+        no tokenizer; ~4 chars/token proxies the token prefix)."""
+        router = self._router()
+        long_text = "a" * 200
+        key = router._affinity_key(
+            "/v1/generate", json.dumps({"text": long_text}).encode()
+        )
+        assert key is not None and key.startswith("txt:")
+        picks = set()
+        for _ in range(9):
+            b = router._pick(affinity_key=key)
+            picks.add(b.id)
+            router._release(b, ok=True)
+        assert len(picks) == 1
+        # Short text: balance freely.
+        assert router._affinity_key(
+            "/v1/generate", json.dumps({"text": "short"}).encode()
+        ) is None
