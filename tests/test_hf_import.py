@@ -347,3 +347,96 @@ class TestExport:
                 np.asarray(params[name]), np.asarray(back[name]),
                 err_msg=name,
             )
+
+
+class TestTokenizerCarryOver:
+    def test_import_copies_tokenizer_and_text_serving_matches_hf(
+        self, tmp_path, capsys
+    ):
+        """A checkpoint dir with a tokenizer → oim-import-hf copies it to
+        a sibling dir and prints --tokenizer-dir; a text request through
+        the serving stack then tokenizes exactly as HF does."""
+        from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+        from transformers import PreTrainedTokenizerFast
+
+        from oim_tpu.checkpoint import load_params
+        from oim_tpu.cli.import_hf_main import main as import_main
+        from oim_tpu.models import init_params
+        from oim_tpu.models.hf import llama_config
+        from oim_tpu.serve import Engine
+        from oim_tpu.serve.server import ServeServer
+        from oim_tpu.serve.texttok import TextTokenizer
+
+        model, config = _tiny_hf(seed=9)
+        hf_dir, out_dir = tmp_path / "hf", tmp_path / "native"
+        model.save_pretrained(hf_dir)
+        letters = "abcdefghij "
+        vocab = {ch: i for i, ch in enumerate(letters)}
+        vocab["</s>"] = len(vocab)
+        tok = Tokenizer(models.BPE(vocab=vocab, merges=[]))
+        tok.pre_tokenizer = pre_tokenizers.Split("", "isolated")
+        tok.decoder = decoders.Fuse()
+        hf_tok = PreTrainedTokenizerFast(
+            tokenizer_object=tok, eos_token="</s>"
+        )
+        hf_tok.save_pretrained(str(hf_dir))
+
+        rc = import_main(
+            ["--hf-dir", str(hf_dir), "--out-dir", str(out_dir),
+             "--param-dtype", "float32"]
+        )
+        assert rc == 0
+        import os as _os
+
+        printed = capsys.readouterr().out
+        tok_dir = str(out_dir) + "-tokenizer"
+        assert f"--tokenizer-dir {tok_dir}" in printed
+        assert _os.path.exists(_os.path.join(tok_dir, "tokenizer.json"))
+
+        cfg = llama_config(config, dtype="float32", use_pallas=False)
+        template = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), cfg)
+        )
+        params = load_params(str(out_dir), template)
+        engine = Engine(params, cfg, n_slots=1, max_len=32, chunk=4)
+        srv = ServeServer(engine, tokenizer=TextTokenizer(tok_dir)).start()
+        try:
+            import json as json_mod
+            import urllib.request
+
+            body = json_mod.dumps(
+                {"text": "abc abd", "max_new_tokens": 3, "eos_id": -1}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://{srv.host}:{srv.port}/v1/generate",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                by_text = json_mod.loads(resp.read())
+            # The served tokenization is exactly HF's.
+            ids = list(hf_tok("abc abd").input_ids)
+            req2 = urllib.request.Request(
+                f"http://{srv.host}:{srv.port}/v1/generate",
+                data=json_mod.dumps(
+                    {"tokens": ids, "max_new_tokens": 3, "eos_id": -1}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req2, timeout=60) as resp:
+                by_ids = json_mod.loads(resp.read())
+            assert by_text["tokens"] == by_ids["tokens"]
+        finally:
+            srv.stop()
+
+    def test_import_without_tokenizer_prints_no_flag(self, tmp_path, capsys):
+        from oim_tpu.cli.import_hf_main import main as import_main
+
+        model, _ = _tiny_hf(seed=10)
+        hf_dir, out_dir = tmp_path / "hf", tmp_path / "native"
+        model.save_pretrained(hf_dir)
+        assert import_main(
+            ["--hf-dir", str(hf_dir), "--out-dir", str(out_dir),
+             "--param-dtype", "float32"]
+        ) == 0
+        assert "--tokenizer-dir" not in capsys.readouterr().out
