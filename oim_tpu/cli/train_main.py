@@ -51,6 +51,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--d-ff", type=int, default=0)
     p.add_argument("--n-experts", type=int, default=0)
     p.add_argument("--moe-top-k", type=int, default=1)
+    p.add_argument(
+        "--router-z-loss", type=float, default=0.0,
+        help="ST-MoE router z-loss coefficient (paper value 1e-3); "
+        "keeps router logits small on long MoE runs (0 = off)",
+    )
     p.add_argument("--rope-theta", type=float, default=10000.0)
     p.add_argument(
         "--sliding-window", type=int, default=0,
@@ -247,6 +252,7 @@ def main(argv=None) -> int:
         d_ff=args.d_ff,
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
+        router_z_loss=args.router_z_loss,
         rope_theta=args.rope_theta,
         rope_scaling=tuple(args.rope_scaling),
         norm_eps=args.norm_eps,

@@ -24,6 +24,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from oim_tpu.models.transformer import (
+    AUX_LOSS_WEIGHT,
     TransformerConfig,
     _doc_segments,
     _rmsnorm,
@@ -36,7 +37,6 @@ from oim_tpu.models.transformer import (
     param_pspecs,
 )
 
-AUX_LOSS_WEIGHT = 0.01
 
 
 @jax.tree_util.register_dataclass

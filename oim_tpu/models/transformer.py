@@ -42,6 +42,13 @@ from oim_tpu.parallel.ring_attention import ring_attention
 from oim_tpu.parallel.ulysses import ulysses_attention
 
 
+# Weight on the MoE auxiliary channel (load-balance + router z-loss) in
+# the train objective — the switch-transformer value.  Lives here so the
+# layer code (which folds per-layer terms into the channel) and the
+# objective (which scales it once) can't disagree.
+AUX_LOSS_WEIGHT = 0.01
+
+
 @dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
@@ -60,6 +67,12 @@ class TransformerConfig:
     # normalized over the chosen experts).
     moe_top_k: int = 1
     expert_capacity_factor: float = 1.25
+    # Router z-loss coefficient (ST-MoE, arXiv:2202.08906 §2.2):
+    # penalizes mean(logsumexp(router_logits)^2), keeping router logits
+    # small so the f32 softmax stays in its well-conditioned range —
+    # the standard stabilizer for large-scale MoE training.  0 = off
+    # (bit-identical to before); the paper's value is 1e-3.
+    router_z_loss: float = 0.0
     rope_theta: float = 10000.0
     # Llama-3.1 long-context RoPE frequency remap as (factor,
     # low_freq_factor, high_freq_factor, original_max_position) — empty
@@ -442,6 +455,14 @@ def _switch_moe(x, lp, cfg: TransformerConfig):
     density = jnp.mean(first_assign, axis=0)  # fraction routed per expert
     density_proxy = jnp.mean(probs, axis=0)
     aux = e * jnp.sum(density * density_proxy)
+    if cfg.router_z_loss:
+        # ST-MoE router z-loss: mean squared logsumexp of the router
+        # logits, folded into the shared aux channel.  The train
+        # objective scales aux by AUX_LOSS_WEIGHT, so the coefficient
+        # is pre-divided — the effective term is exactly
+        # router_z_loss * mean(z²).
+        z = jax.nn.logsumexp(router_logits, axis=-1)  # [G]
+        aux = aux + (cfg.router_z_loss / AUX_LOSS_WEIGHT) * jnp.mean(z * z)
     return x + out.astype(x.dtype), aux
 
 

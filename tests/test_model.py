@@ -691,3 +691,67 @@ class TestLoRA:
             losses.append(float(metrics["ce"]))
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]
+
+
+class TestRouterZLoss:
+    def _objective(self, cfg, zero_router=False):
+        """One train step's objective; fresh params per run (the step
+        donates its buffers).  zero_router zeroes every router weight —
+        logits become exactly 0, so each layer's z-loss term is exactly
+        log(n_experts)² (hand-computable, data-independent)."""
+        mesh = build_mesh()
+        optimizer = optax.adamw(1e-2)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        if zero_router:
+            params = jax.tree_util.tree_map_with_path(
+                lambda path, leaf: (
+                    jnp.zeros_like(leaf)
+                    if any(
+                        getattr(k, "key", None) == "router" for k in path
+                    )
+                    else leaf
+                ),
+                params,
+            )
+        tokens = _data(4, 16, cfg.vocab_size, seed=7)
+        state = shard_state(TrainState.create(params, optimizer), cfg, mesh)
+        _, metrics = make_train_step(cfg, mesh, optimizer)(
+            state,
+            jax.device_put(
+                tokens, jax.sharding.NamedSharding(mesh, data_pspec())
+            ),
+        )
+        return float(metrics["loss"])
+
+    def _cfg(self, coef):
+        return TransformerConfig(
+            **{**TINY, "n_experts": 4, "expert_capacity_factor": 2.0},
+            router_z_loss=coef,
+        )
+
+    def test_z_loss_exact_scale_by_hand(self):
+        """With zeroed routers every logit is 0, logsumexp = log(E), and
+        the objective must exceed the coef=0 run by EXACTLY
+        coef · n_layers · log(E)² — an absolute hand computation that a
+        constant-factor scale bug (e.g. a wrong AUX_LOSS_WEIGHT
+        pre-division) cannot pass.  The coef=0 side doubles as the
+        off-is-off guard: its delta contribution must be zero."""
+        import math
+
+        coef = 1e-2
+        base = self._objective(self._cfg(0.0), zero_router=True)
+        withz = self._objective(self._cfg(coef), zero_router=True)
+        expected = coef * TINY["n_layers"] * math.log(4) ** 2
+        assert abs((withz - base) - expected) < 1e-6, (
+            withz - base, expected
+        )
+
+    def test_z_loss_linear_on_real_routers(self):
+        """On real (random) router weights the term must be exactly
+        coefficient-linear."""
+        coef = 1e-2
+        base = self._objective(self._cfg(0.0))
+        d1 = self._objective(self._cfg(coef)) - base
+        d2 = self._objective(self._cfg(2 * coef)) - base
+        assert d1 > 0
+        assert abs(d2 - 2 * d1) < 1e-5 * max(1.0, abs(d2)), (d1, d2)
