@@ -72,6 +72,12 @@ class TransformerConfig:
     # small so the f32 softmax stays in its well-conditioned range —
     # the standard stabilizer for large-scale MoE training.  0 = off
     # (bit-identical to before); the paper's value is 1e-3.
+    # Trade-off: the term shares the single aux channel with the
+    # load-balance loss (pre-divided so the objective scale is exact),
+    # so with z-loss ON, (loss - ce)/AUX_LOSS_WEIGHT reads balance PLUS
+    # the scaled z term — expert-imbalance monitoring should compare
+    # against a z-only baseline, or run with coef 0.  A second channel
+    # through both pipeline schedules wasn't worth that diagnostic.
     router_z_loss: float = 0.0
     rope_theta: float = 10000.0
     # Llama-3.1 long-context RoPE frequency remap as (factor,

@@ -742,7 +742,11 @@ class TestRouterZLoss:
         base = self._objective(self._cfg(0.0), zero_router=True)
         withz = self._objective(self._cfg(coef), zero_router=True)
         expected = coef * TINY["n_layers"] * math.log(4) ** 2
-        assert abs((withz - base) - expected) < 1e-6, (
+        # Relative tolerance: the two f32 objectives round independently
+        # through the mesh psum, so a couple of ulps (~1e-6 at this
+        # magnitude) of absolute error is legitimate; a constant-factor
+        # scale bug is orders of magnitude, not 1e-4 relative.
+        assert abs((withz - base) - expected) < 1e-4 * expected, (
             withz - base, expected
         )
 

@@ -1,0 +1,81 @@
+#!/usr/bin/env bash
+# Pool-window playbook: the moment the axon TPU pool answers, capture
+# every on-chip number the round needs, in priority order, and commit
+# the evidence after each stage — windows can close mid-run (BASELINE
+# pool-status notes; round-3 lost the fused-CE number to exactly that).
+#
+#   ./tools/pool_window.sh            probe once; run the playbook if up
+#   ./tools/pool_window.sh --loop     probe every ~17 min until a window
+#
+# Stages (each is independently useful evidence):
+#   1. bench.py      — train/MFU + fused CE first (reordered), then
+#                      decode, serving (+ repeats for the swing), spec
+#                      margin check, MoE, flash, chip-binding tier.
+#                      Auto-writes BENCH_LAST_GOOD.json + history.
+#   2. bench.py #2   — the >=2-same-code-runs requirement for every
+#                      headline row (VERDICT r3 weak #1/#2).
+#   3. real tiers    — TEST_REAL_TPU (binding) + TEST_REAL_PJRT_CLIENT
+#                      (agent on the live plugin), serialized with the
+#                      chip.
+#   4. GQA matrix    — tools/decode_bench.py --record appends to history.
+set -u
+cd "$(dirname "$0")/.."
+
+probe() {
+    timeout 70 python - <<'EOF'
+import subprocess, sys
+try:
+    r = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"], timeout=60,
+        capture_output=True,
+    )
+    sys.exit(r.returncode)
+except subprocess.TimeoutExpired:
+    sys.exit(3)
+EOF
+}
+
+commit_evidence() {
+    git add BENCH_LAST_GOOD.json BENCH_HISTORY.jsonl 2>/dev/null
+    git diff --cached --quiet 2>/dev/null || git commit -m "$1"
+}
+
+run_window() {
+    echo "=== pool window open: $(date -u) ==="
+    echo "--- stage 1: bench run A"
+    python bench.py; rc=$?
+    commit_evidence "On-chip evidence: bench run A ($(date -u +%H:%MZ))"
+    [ $rc -ne 0 ] && echo "bench A failed rc=$rc (continuing)"
+
+    echo "--- stage 2: bench run B (same code)"
+    python bench.py
+    commit_evidence "On-chip evidence: bench run B, same code ($(date -u +%H:%MZ))"
+
+    echo "--- stage 3: real-device tiers"
+    TEST_REAL_PJRT_CLIENT=1 python -m pytest \
+        tests/test_pjrt_loader.py -q -k real || true
+    TEST_REAL_TPU=1 python -m pytest tests/test_real_tpu.py -q || true
+
+    echo "--- stage 4: GQA decode matrix"
+    python tools/decode_bench.py --iters 6 --record || true
+    commit_evidence "On-chip evidence: GQA decode matrix ($(date -u +%H:%MZ))"
+    echo "=== window playbook complete: $(date -u) ==="
+}
+
+if [ "${1:-}" = "--loop" ]; then
+    while true; do
+        if probe; then
+            run_window
+            exit 0
+        fi
+        echo "pool down ($(date -u +%H:%M:%SZ)); next probe in ~17 min"
+        sleep 1020
+    done
+else
+    if probe; then
+        run_window
+    else
+        echo "pool down"
+        exit 3
+    fi
+fi
