@@ -1009,6 +1009,13 @@ class Engine:
             buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
                      120.0, 300.0, 600.0),
         )
+        self._m_ttft = reg.histogram(
+            "oim_serve_ttft_seconds",
+            "Submit-to-first-token latency per request (queue wait + "
+            "admission + prefill).",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0),
+        )
         self._m_active = reg.gauge(
             "oim_serve_active_slots", "Slots currently decoding.",
             ("engine",),
@@ -1439,6 +1446,11 @@ class Engine:
 
     def _emit(self, state: _SlotState, token: int, logprob: float) -> bool:
         """Record one generated token; True when the request is done."""
+        if not state.emitted and not self._warming:
+            # Time to first token: the interactive-latency number
+            # (queue wait + admission + prefill), vs the throughputy
+            # submit-to-completion histogram.
+            self._m_ttft.observe(time.monotonic() - state.t_submit)
         state.emitted.append(token)
         state.logprobs.append(logprob)
         if token == state.req.eos_id or token in state.req.stop_ids:

@@ -742,6 +742,14 @@ def test_http_server(setup):
             exposition = r.read().decode()
         assert 'oim_serve_requests_total{outcome="completed"}' in exposition
         assert "oim_serve_request_seconds_bucket" in exposition
+        # TTFT observed for the completed request (warmup excluded).
+        assert "oim_serve_ttft_seconds_bucket" in exposition
+        import re as _re
+
+        m = _re.search(
+            r"oim_serve_ttft_seconds_count (\d+)", exposition
+        )
+        assert m and int(m.group(1)) >= 1, exposition[-800:]
         # Malformed request → 400, not a hung connection.
         bad = urllib.request.Request(
             f"{base}/v1/generate", data=b'{"max_new_tokens": 3}',
