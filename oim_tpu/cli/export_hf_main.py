@@ -50,6 +50,13 @@ def _parser() -> argparse.ArgumentParser:
         help="pipeline stages the params were exported with (oim-train "
         "--pp); must match or the orbax restore shape-mismatches",
     )
+    p.add_argument(
+        "--tokenizer-dir", default="",
+        help="tokenizer files to copy into the HF directory (e.g. the "
+        "<ckpt>-tokenizer dir oim-import-hf created), so the export "
+        "loads as a complete transformers checkpoint; default: the "
+        "params dir's sibling <params-dir>-tokenizer when it exists",
+    )
     return p
 
 
@@ -58,6 +65,15 @@ def main(argv=None) -> int:
     out_dir = os.path.abspath(args.out_dir)
     if os.path.exists(out_dir):
         print(f"refusing to overwrite {out_dir}", file=sys.stderr)
+        return 1
+    if args.tokenizer_dir and not os.path.isdir(args.tokenizer_dir):
+        # Validate the cheap flag BEFORE minutes of restore/convert/save
+        # (failing after would also leave out_dir populated, blocking
+        # the corrected rerun on the overwrite guard above).
+        print(
+            f"tokenizer dir not found: {args.tokenizer_dir}",
+            file=sys.stderr,
+        )
         return 1
 
     import jax
@@ -112,6 +128,26 @@ def main(argv=None) -> int:
         )
         return 1
     model.save_pretrained(out_dir)
+    # Tokenizer symmetry with oim-import-hf: a complete HF checkpoint
+    # carries its tokenizer, so downstream `AutoTokenizer.from_pretrained`
+    # works on the export directly.  Same filename whitelist as the
+    # import side — a user pointing --tokenizer-dir at a full HF
+    # checkpoint must not clobber the just-written model files.
+    tok_dir = args.tokenizer_dir or (
+        args.params_dir.rstrip("/") + "-tokenizer"
+    )
+    if os.path.isdir(tok_dir):
+        import shutil
+
+        from oim_tpu.models.hf import TOKENIZER_FILES
+
+        copied = 0
+        for name in TOKENIZER_FILES:
+            src = os.path.join(tok_dir, name)
+            if os.path.isfile(src):
+                shutil.copy2(src, out_dir)
+                copied += 1
+        print(f"tokenizer: copied {copied} files from {tok_dir}")
     print(f"exported {args.params_dir} -> {out_dir}")
     return 0
 

@@ -96,13 +96,11 @@ def main(argv=None) -> int:
     # checkpoint tree must stay exactly what StandardCheckpointer
     # wrote): oim-serve --tokenizer-dir enables the text API with it.
     tok_dir = ""
+    from oim_tpu.models.hf import TOKENIZER_FILES
+
     tok_files = [
         f
-        for f in (
-            "tokenizer.json", "tokenizer_config.json",
-            "special_tokens_map.json", "tokenizer.model", "vocab.json",
-            "merges.txt",
-        )
+        for f in TOKENIZER_FILES
         if os.path.exists(os.path.join(args.hf_dir, f))
     ]
     if tok_files:

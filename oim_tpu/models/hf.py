@@ -32,6 +32,20 @@ import numpy as np
 from oim_tpu.models.transformer import TransformerConfig
 
 
+
+# Tokenizer artifacts a complete HF checkpoint carries — the whitelist
+# both CLI directions copy (import: checkpoint → sibling dir next to the
+# orbax tree; export: back into the HF directory).  A whitelist, not a
+# dir copy: pointing at a full checkpoint must never drag model files.
+TOKENIZER_FILES = (
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "special_tokens_map.json",
+    "tokenizer.model",
+    "vocab.json",
+    "merges.txt",
+)
+
 def llama_config(hf_config, **overrides) -> TransformerConfig:
     """TransformerConfig mirroring an HF ``LlamaConfig``-shaped object
     (attribute access; a plain dict also works).  ``overrides`` pass

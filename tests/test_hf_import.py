@@ -440,3 +440,76 @@ class TestTokenizerCarryOver:
              "--param-dtype", "float32"]
         ) == 0
         assert "--tokenizer-dir" not in capsys.readouterr().out
+
+
+class TestTokenizerExportSymmetry:
+    def test_export_carries_tokenizer_back(self, tmp_path):
+        """import (tokenizer copied to sibling dir) → export picks that
+        sibling up by default → AutoTokenizer loads from the export and
+        encodes identically — the full HF↔native round trip is
+        checkpoint-complete in both directions."""
+        import os as _os
+
+        from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+        from transformers import AutoTokenizer, PreTrainedTokenizerFast
+
+        from oim_tpu.cli.export_hf_main import main as export_main
+        from oim_tpu.cli.import_hf_main import main as import_main
+
+        model, config = _tiny_hf(seed=11)
+        hf_dir, native = tmp_path / "hf", tmp_path / "native"
+        model.save_pretrained(hf_dir)
+        letters = "abcdef "
+        vocab = {ch: i for i, ch in enumerate(letters)}
+        vocab["</s>"] = len(vocab)
+        tok = Tokenizer(models.BPE(vocab=vocab, merges=[]))
+        tok.pre_tokenizer = pre_tokenizers.Split("", "isolated")
+        tok.decoder = decoders.Fuse()
+        PreTrainedTokenizerFast(
+            tokenizer_object=tok, eos_token="</s>"
+        ).save_pretrained(str(hf_dir))
+
+        assert import_main(
+            ["--hf-dir", str(hf_dir), "--out-dir", str(native),
+             "--param-dtype", "float32"]
+        ) == 0
+        out_hf = tmp_path / "hf2"
+        flags = [
+            "--vocab-size", str(config.vocab_size),
+            "--d-model", str(config.hidden_size),
+            "--n-layers", str(config.num_hidden_layers),
+            "--n-heads", str(config.num_attention_heads),
+            "--n-kv-heads", str(config.num_key_value_heads),
+            "--d-ff", str(config.intermediate_size),
+        ]
+        assert export_main(
+            ["--params-dir", str(native), "--out-dir", str(out_hf), *flags]
+        ) == 0
+        assert _os.path.exists(out_hf / "tokenizer.json")
+        reloaded = AutoTokenizer.from_pretrained(str(out_hf))
+        assert list(reloaded("ab cd").input_ids) == list(
+            PreTrainedTokenizerFast(
+                tokenizer_object=tok, eos_token="</s>"
+            )("ab cd").input_ids
+        )
+
+    def test_export_missing_explicit_tokenizer_dir_fails(self, tmp_path):
+        import orbax.checkpoint as ocp
+
+        from oim_tpu.cli.export_hf_main import main as export_main
+        from oim_tpu.models import TransformerConfig, init_params
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+            dtype="float32",
+        )
+        native = tmp_path / "native"
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(native, init_params(jax.random.PRNGKey(0), cfg))
+        rc = export_main(
+            ["--params-dir", str(native), "--out-dir", str(tmp_path / "o"),
+             "--vocab-size", "64", "--d-model", "32", "--n-layers", "1",
+             "--n-heads", "2", "--d-ff", "64",
+             "--tokenizer-dir", str(tmp_path / "nope")]
+        )
+        assert rc == 1
