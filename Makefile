@@ -84,3 +84,8 @@ test-kind:
 # in plain `make test`).
 test-multihost4:
 	TEST_MULTIHOST4=1 $(PYTHON) -m pytest tests/test_distributed.py -q
+
+# Serving-plane demo: 2 tiny oim-serve instances behind oim-route, one
+# routed generation via oimctl (CPU; self-contained, auto-teardown).
+demo-serve:
+	$(PYTHON) tools/demo_cluster.py demo-serve

@@ -66,15 +66,25 @@ def main(argv=None) -> int:
     if os.path.exists(out_dir):
         print(f"refusing to overwrite {out_dir}", file=sys.stderr)
         return 1
-    if args.tokenizer_dir and not os.path.isdir(args.tokenizer_dir):
+    if args.tokenizer_dir:
         # Validate the cheap flag BEFORE minutes of restore/convert/save
         # (failing after would also leave out_dir populated, blocking
-        # the corrected rerun on the overwrite guard above).
-        print(
-            f"tokenizer dir not found: {args.tokenizer_dir}",
-            file=sys.stderr,
-        )
-        return 1
+        # the corrected rerun on the overwrite guard above).  An explicit
+        # dir must actually CONTAIN tokenizer files — an empty match
+        # would silently produce the tokenizer-less checkpoint the user
+        # specifically asked to avoid.
+        from oim_tpu.models.hf import TOKENIZER_FILES
+
+        if not any(
+            os.path.isfile(os.path.join(args.tokenizer_dir, name))
+            for name in TOKENIZER_FILES
+        ):
+            print(
+                f"no tokenizer files in {args.tokenizer_dir} "
+                f"(looked for {', '.join(TOKENIZER_FILES[:3])}, ...)",
+                file=sys.stderr,
+            )
+            return 1
 
     import jax
     import torch

@@ -11,7 +11,7 @@ demo).
 State lives under ``_work/demo`` (CA tree, sockets, pidfile, logs), like
 the reference's ``_work``.
 
-Usage:  python tools/demo_cluster.py start|stop|status|demo
+Usage:  python tools/demo_cluster.py start|stop|status|demo|demo-serve
 """
 
 from __future__ import annotations
@@ -338,9 +338,108 @@ def _demo_roundtrip() -> None:
     print("demo round trip OK")
 
 
+def demo_serve() -> None:
+    """The serving data plane, end to end on one machine: two tiny
+    oim-serve instances (CPU, ~15 s warmup each) behind oim-route, one
+    routed generation via oimctl, teardown.  Self-contained like
+    ``demo`` — never leaves daemons behind."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import urllib.request
+
+    import procutil
+
+    env = dict(
+        ENV, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu"
+    )  # a demo must not squat the real chip
+    model = [
+        "--vocab-size", "101", "--d-model", "32", "--n-layers", "2",
+        "--n-heads", "4", "--d-ff", "64", "--dtype", "float32",
+        "--max-len", "64", "--n-slots", "2", "--chunk", "4",
+    ]
+    os.makedirs(WORK, exist_ok=True)
+    procs = []
+
+    def spawn_py(name, argv):
+        logf = open(os.path.join(WORK, f"{name}.log"), "w")
+        return procutil.spawn(
+            [sys.executable, "-m", argv[0], *argv[1:]],
+            env=env, stdout=logf, stderr=logf,
+        )
+
+    try:
+        a = spawn_py("demo-serve-a", [
+            "oim_tpu.cli.serve_main", *model, "--port", "8975"])
+        b = spawn_py("demo-serve-b", [
+            "oim_tpu.cli.serve_main", *model, "--port", "8976"])
+        procs += [a, b]
+        for proc, port in ((a, 8975), (b, 8976)):
+            # A stale listener answering on the port would make the demo
+            # proceed against the WRONG process; owning the port is part
+            # of readiness.
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"serve :{port} exited at startup (rc={proc.returncode}"
+                    f"; port in use by a stale demo?) — see {WORK}"
+                )
+            deadline = time.time() + 90
+            while True:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=2
+                    ):
+                        break
+                except OSError:
+                    if proc.poll() is not None:
+                        raise RuntimeError(
+                            f"serve :{port} died during warmup "
+                            f"(rc={proc.returncode}) — see {WORK}"
+                        )
+                    if time.time() > deadline:
+                        raise RuntimeError(f"serve :{port} never came up")
+                    time.sleep(0.5)
+            print(f"oim-serve :{port} healthy")
+        router = spawn_py("demo-route", [
+            "oim_tpu.cli.route_main",
+            "--backend", "http://127.0.0.1:8975",
+            "--backend", "http://127.0.0.1:8976",
+            "--port", "8977", "--health-interval", "1"])
+        procs.append(router)
+        deadline = time.time() + 30
+        while True:
+            try:
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:8977/healthz", timeout=2
+                ) as r:
+                    if json.loads(r.read())["healthy_backends"] == 2:
+                        break
+            except OSError:
+                pass
+            if router.poll() is not None:
+                raise RuntimeError(
+                    f"router exited (rc={router.returncode}) — see {WORK}"
+                )
+            if time.time() > deadline:
+                raise RuntimeError("router never saw both backends")
+            time.sleep(0.5)
+        print("oim-route :8977 balancing 2 backends")
+        out = subprocess.run(
+            [sys.executable, "-m", "oim_tpu.cli.oimctl", "generate",
+             "1", "2", "3", "--serve", "http://127.0.0.1:8977",
+             "--max-new-tokens", "8"],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(f"oimctl generate failed: {out.stderr[-500:]}")
+        print("routed generation:", out.stdout.strip())
+        print("serving demo OK")
+    finally:
+        procutil.stop_all(procs)
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    if len(argv) != 1 or argv[0] not in ("start", "stop", "status", "demo"):
+    commands = ("start", "stop", "status", "demo", "demo-serve")
+    if len(argv) != 1 or argv[0] not in commands:
         print(__doc__)
         return 2
     if argv[0] == "start":
@@ -349,6 +448,8 @@ def main(argv=None) -> int:
         stop()
     elif argv[0] == "status":
         return status()
+    elif argv[0] == "demo-serve":
+        demo_serve()
     else:
         demo()
     return 0
