@@ -931,6 +931,14 @@ def _serve_diagnostics(extras, on_tpu, cfg, params) -> None:
     try:
         from oim_tpu.serve import Engine, GenRequest
 
+        # Swing-diagnosis context: serving throughput is the one number
+        # with host work between device dispatches (admission waves,
+        # queue handling), so host CPU contention hits it while the
+        # single-dispatch decode/train loops shrug — the leading
+        # explanation for BASELINE's 665↔1112 tok/s cross-run swing at
+        # identical rtt (the 03:50 run's chip was FASTER on decode).
+        # Record 1-minute load so the next window can confirm.
+        extras["loadavg_1m"] = round(os.getloadavg()[0], 1)
         n_req, new_tokens = (12, 128) if on_tpu else (3, 8)
         engine = Engine(
             params, cfg, n_slots=8, max_len=512,
