@@ -12,11 +12,14 @@ registry can point at a real etcd cluster for replicated durable state
 
 Liveness semantics (the production HA story):
 
-- ``store(path, value, ttl=N)`` grants a fresh N-second lease and
-  attaches the key to it; the heartbeat refresh is the next leased
-  store.  A crashed writer's key is deleted by etcd when its last lease
-  expires — with a DELETE watch event — instead of its stale address
-  surviving until overwritten.
+- ``store(path, value, ttl=N)`` attaches the key to ONE cached
+  N-second lease per (key, ttl); each heartbeat refreshes that lease
+  with a LeaseKeepAlive round-trip and re-Puts, re-granting only when
+  the keepalive reports the lease gone (the etcd-recommended pattern —
+  a grant is a raft write, so per-heartbeat grants would be lease churn
+  in raft state).  A crashed writer's key is deleted by etcd when its
+  lease expires — with a DELETE watch event — instead of its stale
+  address surviving until overwritten.
 - ``watch(prefix, callback)`` opens a Watch stream and invokes the
   callback per event; the stream auto-reopens after transient failures
   (same never-die stance as the controller heartbeat).
@@ -112,6 +115,12 @@ class EtcdRegistryDB:
         self._channel: grpc.Channel | None = None
         self._closed = False
         self._watch_cancels: set = set()
+        # (path, ttl_seconds) → live lease id.  Leased stores refresh this
+        # lease via LeaseKeepAlive instead of granting a new one per
+        # heartbeat — against a real etcd cluster a grant is a raft write,
+        # so per-heartbeat grants are ttl-proportional lease churn in raft
+        # state (the etcd-recommended pattern is one lease + KeepAlive).
+        self._lease_cache: dict[tuple[str, int], int] = {}
 
     def _dial(self) -> grpc.Channel:
         from oim_tpu.common import endpoint as ep
@@ -165,6 +174,9 @@ class EtcdRegistryDB:
 
     def store(self, path: str, value: str, *, ttl: float | None = None) -> None:
         if value == "":
+            with self._lock:
+                for ck in [k for k in self._lease_cache if k[0] == path]:
+                    del self._lease_cache[ck]
             self._call(
                 lambda ch: ETCD_KV.stub(ch).DeleteRange(
                     rpc_pb2.DeleteRangeRequest(key=self._key(path)),
@@ -174,13 +186,26 @@ class EtcdRegistryDB:
             return
         lease_id = 0
         if ttl is not None:
-            # A fresh lease per leased store: the heartbeat's next store
-            # re-attaches the key to a new lease, and the old, now-empty
-            # lease expires harmlessly.  This keeps the liveness contract
-            # ("key gone TTL after the last refresh") with zero client
-            # state — no keepalive stream to babysit across reconnects.
-            grant = self._grant(ttl)
-            lease_id = grant.ID
+            # One lease per (key, ttl), refreshed with LeaseKeepAlive on
+            # every heartbeat; re-grant only when the keepalive reports the
+            # lease gone (TTL 0 — expired during a partition, or server
+            # restart).  The liveness contract is unchanged ("key gone TTL
+            # after the last refresh") but a steady-state heartbeat is one
+            # keepalive + one Put, with zero lease churn in raft state.
+            ttl_s = max(1, math.ceil(ttl))
+            cache_key = (path, ttl_s)
+            with self._lock:
+                lease_id = self._lease_cache.get(cache_key, 0)
+            if lease_id:
+                try:
+                    if self.keepalive_once(lease_id) <= 0:
+                        lease_id = 0
+                except grpc.RpcError:
+                    lease_id = 0
+            if not lease_id:
+                lease_id = self._grant(ttl).ID
+                with self._lock:
+                    self._lease_cache[cache_key] = lease_id
         self._call(
             lambda ch: ETCD_KV.stub(ch).Put(
                 rpc_pb2.PutRequest(

@@ -74,6 +74,61 @@ def opener(
     )
 
 
+# CN prefixes that carry a serving-plane identity.  Holding ANY
+# deployment-CA cert opens the TLS handshake (closed-world CA, module
+# docstring); this pin additionally requires the cert to BE a serving
+# identity — a controller's controller.* or the registry's
+# component.registry cert can no longer call the serving API or
+# impersonate a backend to a router, matching the gRPC plane which pins
+# CNs beyond the CA check
+# (common/tlsconfig.py, registry CN authorization).
+SERVING_CN_PREFIXES = ("serve.", "route.", "user.")
+
+
+def authorize_serving_peer(handler) -> bool:
+    """True when ``handler``'s peer may speak the serving data plane:
+    plain HTTP (no identities to pin), or a TLS peer whose cert CN is a
+    serving-plane identity (``serve.*`` backend, ``route.*`` router,
+    ``user.*`` client).  Defense-in-depth over the CA gate."""
+    getpeercert = getattr(handler.connection, "getpeercert", None)
+    if getpeercert is None:
+        return True
+    cert = getpeercert()
+    if not cert:
+        # TLS without a client cert: the listener deliberately ran with
+        # require_client_cert=False — nothing to pin.
+        return True
+    cn = _cert_common_name(cert)
+    return cn is not None and cn.startswith(SERVING_CN_PREFIXES)
+
+
+def check_serving_peer(handler) -> bool:
+    """``authorize_serving_peer`` plus the 403 every serving handler
+    sends on failure — call first in each do_GET/do_POST so both the
+    router and backend reject non-serving identities identically."""
+    import json
+
+    if authorize_serving_peer(handler):
+        return True
+    body = json.dumps(
+        {"error": "peer CN is not a serving-plane identity"}
+    ).encode()
+    handler.send_response(403)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+    return False
+
+
+def _cert_common_name(cert) -> str | None:
+    for rdn in cert.get("subject", ()):
+        for key, value in rdn:
+            if key == "commonName":
+                return value
+    return None
+
+
 def peer_common_name(handler) -> str | None:
     """CN of the authenticated client driving ``handler``'s request, or
     None on a plain-HTTP server (the gRPC plane's ``peer_common_name``
@@ -84,11 +139,7 @@ def peer_common_name(handler) -> str | None:
     cert = getpeercert()
     if not cert:
         return None
-    for rdn in cert.get("subject", ()):
-        for key, value in rdn:
-            if key == "commonName":
-                return value
-    return None
+    return _cert_common_name(cert)
 
 
 class TLSThreadingHTTPServer(ThreadingHTTPServer):

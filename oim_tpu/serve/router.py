@@ -49,6 +49,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu import log
 from oim_tpu.common import metrics
+from oim_tpu.serve.httptls import check_serving_peer
 
 PROXIED = ("/v1/generate", "/v1/beam", "/v1/embed")
 
@@ -145,6 +146,11 @@ class Router:
                 self.wfile.write(body)
 
             def do_GET(self):
+                # Serving-plane CN pinning (httptls module docstring):
+                # under mTLS the peer must carry a serve./route./user.
+                # identity, not merely any deployment-CA cert.
+                if not check_serving_peer(self):
+                    return
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
                     metrics.write_exposition(self)
@@ -176,6 +182,8 @@ class Router:
                 return headers
 
             def do_POST(self):
+                if not check_serving_peer(self):
+                    return
                 if self.path not in PROXIED:
                     self._json(404, {"error": f"no such path {self.path}"})
                     return
@@ -546,6 +554,15 @@ class Router:
                 oim_pb2.WatchValuesRequest(path="serve", send_initial=True)
             )
             self._watch_call = call
+            # stop() sets _stop BEFORE reading _watch_call; if it ran in
+            # the window before the assignment above it found None and
+            # cancelled nothing — re-check here so the discover thread
+            # cannot block forever in the stream iteration on a quiet
+            # registry.
+            if self._stop.is_set():
+                call.cancel()
+                self._watch_call = None
+                return
             try:
                 snapshot: dict[str, str] = {}
                 in_snapshot = True

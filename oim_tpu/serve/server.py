@@ -56,6 +56,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu.common import metrics, tracing
+from oim_tpu.serve.httptls import check_serving_peer
 from oim_tpu.serve.engine import (
     DrainingError,
     Engine,
@@ -107,6 +108,12 @@ class ServeServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                # Serving-plane CN pinning (httptls module docstring):
+                # under mTLS the peer must carry a serve./route./user.
+                # identity, not merely any deployment-CA cert — parity
+                # with the gRPC plane's CN authorization.
+                if not check_serving_peer(self):
+                    return
                 if self.path.split("?", 1)[0] == "/metrics":
                     # Prometheus exposition, shared registry + response
                     # format with the control plane (common/metrics.py).
@@ -222,6 +229,8 @@ class ServeServer:
                     span.status = "error: client disconnected"
 
             def do_POST(self):
+                if not check_serving_peer(self):
+                    return
                 if self.path == "/v1/embed":
                     self._embed_request()
                     return

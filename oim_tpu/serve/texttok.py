@@ -79,14 +79,33 @@ class StreamDecoder:
         self._tokenizer = tokenizer
         self._tokens: list[int] = []
         self._emitted = ""
+        self._prev_full = ""
 
     def push(self, token: int) -> str:
         """The new text this token completes ("" while mid-character)."""
         self._tokens.append(token)
         full = self._tokenizer.decode(self._tokens)
         # An incomplete multi-byte sequence decodes to U+FFFD at the
-        # tail; hold those bytes back until the next token completes it.
-        while full.endswith("�"):
+        # tail; hold those back until the next token completes them.
+        # But only the NEWEST token's U+FFFDs are tentative: an
+        # incomplete tail resolves by REWRITING its U+FFFD positions
+        # (the completing bytes merge into one char), never by growing
+        # PAST them — so a trailing U+FFFD the previous decode had is
+        # confirmed real (byte-fallback on invalid bytes) once the text
+        # strictly extends beyond it, and must stream, not stall until
+        # flush.  Strictness matters: a growing incomplete prefix can
+        # decode to the SAME single U+FFFD ('\xe2' and '\xe2\x88' both
+        # → '�'), so an unchanged decode stays tentative.
+        floor = (
+            len(self._prev_full)
+            if (
+                len(full) > len(self._prev_full)
+                and full.startswith(self._prev_full)
+            )
+            else len(self._emitted)
+        )
+        self._prev_full = full
+        while full.endswith("�") and len(full) > floor:
             full = full[:-1]
         if not full.startswith(self._emitted):
             # Non-prefix-stable rewrite (shouldn't happen with cleanup
@@ -101,8 +120,11 @@ class StreamDecoder:
         """Anything still held back (sequence ended mid-character)."""
         full = self._tokenizer.decode(self._tokens)
         if not full.startswith(self._emitted):
-            # Rewrite fallback: emit from the divergence point so the
-            # concatenation still ends in the right final text.
+            # Rewrite fallback — BEST-EFFORT: when a non-prefix-stable
+            # rewrite occurred mid-stream, emitting from the divergence
+            # point means the concatenated deltas may not exactly equal
+            # decode(all_tokens) (the already-emitted prefix can't be
+            # unsent); the final text is right from the divergence on.
             import os as _os
 
             common = _os.path.commonprefix([full, self._emitted])

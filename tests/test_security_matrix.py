@@ -329,6 +329,25 @@ def test_serving_mtls_rejects_certless_client(serving_world, target):
 
 
 @pytest.mark.parametrize("target", ["router", "backend"])
+def test_serving_mtls_rejects_non_serving_cn(serving_world, target):
+    """CN pinning beyond the CA gate: a GOOD-CA cert whose CN is not a
+    serving-plane identity (a controller's ctrl.*) passes the TLS
+    handshake but is refused 403 by router AND backend — a compromised
+    control-plane component cannot call the serving API or impersonate
+    a backend to a router (gRPC-plane parity, httptls module)."""
+    from oim_tpu.serve.httptls import client_ssl_context
+
+    w = serving_world
+    ca_f, crt, key = w["certfiles"](w["ca"], "controller.ctrl-1")
+    port = w[f"{target}_port"]
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        _serving_request(
+            port, client_ssl_context(ca_f, crt, key), timeout=10
+        )
+    assert exc_info.value.code == 403
+
+
+@pytest.mark.parametrize("target", ["router", "backend"])
 def test_serving_mtls_rejects_evil_ca_client(serving_world, target):
     """A client whose cert chains to a DIFFERENT CA is refused at the
     handshake — holding a cert is not enough, it must be OUR CA."""

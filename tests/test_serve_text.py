@@ -141,6 +141,61 @@ def test_streaming_text_deltas_concatenate(server):
     assert [t for t, _ in pieces] == final["tokens"]
 
 
+class _ByteTok:
+    """Byte-table tokenizer double for StreamDecoder unit cases: id →
+    raw bytes, decoded with errors='replace' like a byte-fallback
+    tokenizer.  StreamDecoder only calls .decode, so this drives its
+    real logic."""
+
+    def __init__(self, table):
+        self.table = table
+
+    def decode(self, ids):
+        return b"".join(self.table[i] for i in ids).decode(
+            "utf-8", errors="replace"
+        )
+
+
+def _stream(table, ids):
+    from oim_tpu.serve.texttok import StreamDecoder
+
+    tok = _ByteTok(table)
+    dec = StreamDecoder(tok)
+    deltas = [dec.push(t) for t in ids]
+    return deltas, "".join(deltas) + dec.flush(), tok.decode(ids)
+
+
+def test_stream_decoder_multibyte_split_three_ways():
+    """A char split across 3+ tokens must NOT leak a U+FFFD mid-way:
+    '\\xe2' and '\\xe2\\x88' both decode to the SAME single U+FFFD, so
+    an unchanged decode stays tentative (only strict growth past a
+    trailing U+FFFD confirms it as real)."""
+    sqrt = "√".encode()  # e2 88 9a
+    table = {0: sqrt[:1], 1: sqrt[1:2], 2: sqrt[2:], 3: b"b"}
+    deltas, streamed, full = _stream(table, [0, 1, 2, 3])
+    assert streamed == full == "√b"
+    assert "�" not in "".join(deltas), f"tentative U+FFFD leaked: {deltas!r}"
+
+
+def test_stream_decoder_legit_replacement_chars_flow():
+    """Genuine U+FFFDs (invalid bytes from a byte-fallback tokenizer)
+    must stream with at most a one-token lag, not stall until flush."""
+    table = {0: b"a", 1: b"\xff"}
+    deltas, streamed, full = _stream(table, [0, 1, 1, 1, 1])
+    assert streamed == full == "a����"
+    assert any("�" in d for d in deltas[:-1]), (
+        f"legit U+FFFDs stalled until flush: {deltas!r}"
+    )
+
+
+def test_stream_decoder_incomplete_tail_then_invalid():
+    """An incomplete tail that is INVALIDATED (not completed) by the
+    next byte is final at that point and streams as U+FFFD."""
+    table = {0: b"\xe2", 1: b"\xff", 2: b"c"}
+    deltas, streamed, full = _stream(table, [0, 1, 2])
+    assert streamed == full == "��c"
+
+
 def test_beam_and_embed_accept_text(server):
     srv, _, _, _ = server
     tok = srv.tokenizer
