@@ -26,7 +26,7 @@ from oim_tpu.common.chancache import ChannelCache, RECONNECT_OPTIONS
 from oim_tpu.common.interceptors import LogServerInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.tlsconfig import TLSConfig, peer_common_name
-from oim_tpu.registry.db import MemRegistryDB, RegistryDB
+from oim_tpu.registry.db import MemRegistryDB, RegistryDB, _prefix_match
 from oim_tpu.spec import REGISTRY, oim_pb2
 
 ADMIN_CN = "user.admin"
@@ -45,7 +45,7 @@ class Registry:
         db: RegistryDB | None = None,
         tls: TLSConfig | None = None,
         proxy_dial_timeout: float = 10.0,
-        max_watchers: int = 32,
+        max_watchers: int = 256,
     ) -> None:
         self.db = db if db is not None else MemRegistryDB()
         self.tls = tls
@@ -68,14 +68,26 @@ class Registry:
         )
         self._keys_cb = lambda: len(self.db.keys(""))
         self._keys_gauge.set_function(self._keys_cb)
-        # Event-driven proxy invalidation: when a controller's address key
-        # changes or expires, drop its cached channel immediately so the
-        # next proxied call re-resolves — a dead controller's channel no
-        # longer lingers until its address slot is overwritten.  (A watch
-        # on the local DB, not gRPC: the registry owns its store.)
+        # ONE watch on the local DB feeds everything event-driven in this
+        # process: proxy-channel invalidation AND every WatchValues
+        # stream's queue (the shared dispatcher below).  Per-watcher DB
+        # subscriptions would mean N etcd Watch streams for N gRPC
+        # watchers on an etcd-backed registry; the dispatcher keeps that
+        # at exactly one no matter the fleet size.
+        self._subs_lock = threading.Lock()
+        self._subs: dict[int, tuple[str, object]] = {}  # id → (prefix, queue)
+        self._sub_seq = 0
         self._cancel_watch = None
         if hasattr(self.db, "watch"):
-            self._cancel_watch = self.db.watch("", self._on_address_event)
+            self._cancel_watch = self.db.watch("", self._on_db_event)
+
+    def _on_db_event(self, path: str, value: str) -> None:
+        self._on_address_event(path, value)
+        with self._subs_lock:
+            subs = list(self._subs.values())
+        for prefix, events in subs:
+            if _prefix_match(path, prefix):
+                events.put((path, value))
 
     def _on_address_event(self, path: str, value: str) -> None:
         # Only deletions (explicit or lease expiry) invalidate: an address
@@ -126,18 +138,25 @@ class Registry:
     def WatchValues(
         self, request: oim_pb2.WatchValuesRequest, context
     ) -> Iterator[oim_pb2.WatchValuesReply]:
-        """Stream mutations under a prefix (value "" = deleted).  Bridges
-        the DB's watch callback into the response stream via a queue; the
-        subscription is registered BEFORE the initial snapshot, and the
-        snapshot ends with an ``initial_done`` marker, so a client that
-        reconciles at the marker and applies every later event misses
-        nothing (a duplicate reply is possible and harmless — watchers
-        are reconcilers, not counters).
+        """Stream mutations under a prefix (value "" = deleted).  All
+        streams share ONE DB watch (the dispatcher registered in
+        ``__init__``) that fans events out to per-stream queues — N gRPC
+        watchers cost the backing store exactly one subscription (one
+        etcd Watch stream on an etcd-backed registry, not N).  The
+        stream's queue is subscribed BEFORE the initial snapshot, and
+        the snapshot ends with an ``initial_done`` marker, so a client
+        that reconciles at the marker and applies every later event
+        misses nothing (a duplicate reply is possible and harmless —
+        watchers are reconcilers, not counters).
 
-        Each stream pins one server worker thread (sync gRPC), so
-        concurrent watchers are capped: beyond ``max_watchers`` the call
-        fails RESOURCE_EXHAUSTED and the client degrades to GetValues
-        polling — discovery gets slower, the registry stays alive."""
+        Each stream still pins one server worker thread for its
+        lifetime (sync gRPC consumes the response generator on a pool
+        thread), so concurrent watchers are capped: the server pool is
+        sized ``max_watchers + 16`` and beyond ``max_watchers`` the
+        call fails RESOURCE_EXHAUSTED and the client degrades to
+        GetValues polling — discovery gets slower, the registry stays
+        alive.  Threads are the bound and they are configuration-bounded,
+        not fleet-bounded."""
         import queue as _queue
 
         prefix = ""
@@ -146,6 +165,11 @@ class Registry:
                 prefix = pathutil.clean_path(request.path)
             except ValueError as exc:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        if self._cancel_watch is None:
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "registry database does not support watch",
+            )
         with self._watchers_lock:
             if self._watchers >= self.max_watchers:
                 context.abort(
@@ -154,10 +178,23 @@ class Registry:
                     "poll GetValues instead",
                 )
             self._watchers += 1
-        events: "_queue.Queue[tuple[str, str]]" = _queue.Queue()
-        cancel = self.db.watch(prefix, lambda p, v: events.put((p, v)))
-        context.add_callback(cancel)
+        # From here on every early exit (including an exception while
+        # subscribing or snapshotting) must release the watcher slot —
+        # a leaked slot is permanent and eventually forces the whole
+        # fleet to RESOURCE_EXHAUSTED polling.
+        sub_id = None
         try:
+            events: "_queue.Queue[tuple[str, str]]" = _queue.Queue()
+            with self._subs_lock:
+                sub_id = self._sub_seq
+                self._sub_seq += 1
+                self._subs[sub_id] = (prefix, events)
+
+            def unsubscribe(sid=sub_id):
+                with self._subs_lock:
+                    self._subs.pop(sid, None)
+
+            context.add_callback(unsubscribe)
             if request.send_initial:
                 for key, value in self.db.items(prefix):
                     yield oim_pb2.WatchValuesReply(
@@ -173,7 +210,9 @@ class Registry:
                     value=oim_pb2.Value(path=path, value=value)
                 )
         finally:
-            cancel()
+            if sub_id is not None:
+                with self._subs_lock:
+                    self._subs.pop(sub_id, None)
             with self._watchers_lock:
                 self._watchers -= 1
 

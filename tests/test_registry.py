@@ -383,6 +383,142 @@ def test_proxy_channel_invalidated_on_address_delete(monkeypatch):
     reg.close()
 
 
+def test_watch_fleet_200_streams_one_db_subscription():
+    """Fleet-scale watch fan-out: 200 concurrent WatchValues streams on
+    one registry must (a) cost the backing DB exactly ONE subscription
+    (the shared dispatcher — on an etcd-backed registry that is one etcd
+    Watch stream, not 200), (b) all converge on a mutation sub-second,
+    and (c) stay inside the configured thread bound (server pool =
+    max_watchers + 16; threads are configuration-bounded, not
+    fleet-bounded).  Round-4 review weak #6: the old per-stream
+    ``db.watch`` + 32-stream cap made watcher #33 silently degrade to
+    polling; 200 is the fleet shape (hundreds of serve replicas +
+    routers)."""
+    import queue as _queue
+    import threading
+    import time
+
+    n_watchers = 200
+    reg = Registry()  # default max_watchers=256
+    assert reg.max_watchers >= n_watchers
+    srv = reg.start_server("tcp://127.0.0.1:0")
+    target = srv.addr().grpc_target()
+    # Spread streams over a few channels: HTTP/2 caps concurrent streams
+    # per connection well below 200.
+    channels = [grpc.insecure_channel(target) for _ in range(8)]
+    baseline_threads = threading.active_count()
+    calls, threads = [], []
+    ready = _queue.Queue()
+    n_rounds = 3
+    seen = [
+        [threading.Event() for _ in range(n_rounds)]
+        for _ in range(n_watchers)
+    ]
+    try:
+        reg.db.store("fleet/seed/address", "http://seed")
+
+        def drain(idx, call):
+            try:
+                for reply in call:
+                    if reply.initial_done:
+                        ready.put(idx)
+                    elif reply.value.path == "fleet/go/address":
+                        # Value encodes the round: a straggler from an
+                        # earlier round cannot satisfy a later one.
+                        r = int(reply.value.value.rsplit("-", 1)[1])
+                        seen[idx][r].set()
+            except grpc.RpcError:
+                pass
+
+        for i in range(n_watchers):
+            call = REGISTRY.stub(channels[i % len(channels)]).WatchValues(
+                oim_pb2.WatchValuesRequest(path="fleet", send_initial=True)
+            )
+            calls.append(call)
+            t = threading.Thread(target=drain, args=(i, call), daemon=True)
+            t.start()
+            threads.append(t)
+        deadline = time.time() + 30
+        got_ready = 0
+        while got_ready < n_watchers and time.time() < deadline:
+            try:
+                ready.get(timeout=1.0)
+                got_ready += 1
+            except _queue.Empty:
+                pass
+        assert got_ready == n_watchers, f"only {got_ready} streams ready"
+
+        # (a) one DB-level subscription for all 200 streams.
+        assert len(reg.db._hub._subs) == 1, len(reg.db._hub._subs)
+        assert reg._watchers == n_watchers
+
+        # (b) one mutation reaches every stream sub-second.  Three
+        # rounds, best-of: a transient GC/scheduler hiccup on a loaded
+        # CI host must not fail a bound the fan-out meets functionally
+        # (each round is an independent full 200-stream delivery).
+        rounds = []
+        for r in range(n_rounds):
+            t0 = time.monotonic()
+            reg.db.store("fleet/go/address", f"http://go-{r}")
+            for per_stream in seen:
+                assert per_stream[r].wait(timeout=10), (
+                    "stream missed the event"
+                )
+            rounds.append(time.monotonic() - t0)
+        assert min(rounds) < 1.0, (
+            f"200-watcher convergence rounds: {[f'{x:.2f}' for x in rounds]}"
+        )
+
+        # (c) thread growth is bounded by configuration: at most the
+        # server pool (max_watchers + 16) beyond our own client threads.
+        growth = threading.active_count() - baseline_threads - len(threads)
+        assert growth <= reg.max_watchers + 16 + 8, growth
+    finally:
+        for call in calls:
+            call.cancel()
+        for t in threads:
+            t.join(timeout=5)
+        for ch in channels:
+            ch.close()
+        srv.stop()
+        reg.close()
+    # Slots drain after cancellation: the fleet can reconnect.
+    assert _wait_for(lambda: reg._watchers == 0, timeout=10)
+    assert len(reg._subs) == 0
+
+
+def test_watcher_cap_and_slot_release_on_failure():
+    """Beyond max_watchers → RESOURCE_EXHAUSTED (client falls back to
+    polling); and a stream that dies during setup must release its slot
+    (round-4 advisor: a slot leaked on a raise before the finally would
+    permanently shrink the fleet's watch capacity)."""
+    reg = Registry(max_watchers=2)
+    srv = reg.start_server("tcp://127.0.0.1:0")
+    channel = grpc.insecure_channel(srv.addr().grpc_target())
+    stub = REGISTRY.stub(channel)
+    try:
+        c1 = stub.WatchValues(oim_pb2.WatchValuesRequest(path="a"))
+        c2 = stub.WatchValues(oim_pb2.WatchValuesRequest(path="a"))
+        assert _wait_for(lambda: reg._watchers == 2, timeout=10)
+        c3 = stub.WatchValues(oim_pb2.WatchValuesRequest(path="a"))
+        with pytest.raises(grpc.RpcError) as err:
+            next(iter(c3))
+        assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        # Cancel one → slot released → a new watcher fits.
+        c1.cancel()
+        assert _wait_for(lambda: reg._watchers == 1, timeout=10)
+        c4 = stub.WatchValues(
+            oim_pb2.WatchValuesRequest(path="a", send_initial=True)
+        )
+        assert next(iter(c4)).initial_done
+        c4.cancel()
+        c2.cancel()
+    finally:
+        channel.close()
+        srv.stop()
+        reg.close()
+
+
 @pytest.mark.parametrize("make_db", [MemRegistryDB, None], ids=["mem", "sqlite"])
 def test_watch_storm_converges(make_db, tmp_path):
     """Concurrency storm over the watch/lease machinery: 8 threads
