@@ -125,24 +125,36 @@ def _build_hosts(tmp_path, hosts, registry, reg_addr, cleanups):
     return channels
 
 
-def _stage_and_run_group(tmp_path, channels, volume, cleanups):
-    """CreateVolume across all hosts, stage concurrently (the rendezvous
-    blocks until every host joins), then run one worker process per
-    staged bootstrap and return their reports."""
-    hosts = list(channels)
+def _mk_cap():
     cap = csi_pb2.VolumeCapability()
     cap.mount.SetInParent()
     cap.access_mode.mode = (
         csi_pb2.VolumeCapability.AccessMode.MULTI_NODE_MULTI_WRITER
     )
+    return cap
+
+
+def _create_volume(channels, volume):
+    hosts = list(channels)
     vol = CSI_CONTROLLER.stub(channels[hosts[0]]).CreateVolume(
         csi_pb2.CreateVolumeRequest(
             name=volume,
-            volume_capabilities=[cap],
+            volume_capabilities=[_mk_cap()],
             parameters={"chipCount": "2", "hosts": ",".join(hosts)},
         ),
         timeout=30,
     ).volume
+    return dict(vol.volume_context)
+
+
+def _stage_group(tmp_path, channels, volume, context=None):
+    """Stage + publish concurrently on every host (the rendezvous blocks
+    until every host joins); creates the volume when no ``context`` is
+    given.  Returns the per-host bootstrap paths, process-id-ordered."""
+    hosts = list(channels)
+    cap = _mk_cap()
+    if context is None:
+        context = _create_volume(channels, volume)
 
     def stage(host_id: str) -> str:
         staging = str(tmp_path / host_id / "staging")
@@ -153,7 +165,7 @@ def _stage_and_run_group(tmp_path, channels, volume, cleanups):
                 volume_id=volume,
                 staging_target_path=staging,
                 volume_capability=cap,
-                volume_context=dict(vol.volume_context),
+                volume_context=context,
             ),
             timeout=120,
         )
@@ -175,6 +187,36 @@ def _stage_and_run_group(tmp_path, channels, volume, cleanups):
     assert {b["process_id"] for b in boots} == set(range(len(hosts)))
     assert all(b["num_processes"] == len(hosts) for b in boots)
     assert len({b["coordinator_address"] for b in boots}) == 1
+    order = sorted(range(len(paths)), key=lambda i: boots[i]["process_id"])
+    return [paths[i] for i in order]
+
+
+def _unstage_group(tmp_path, channels, volume):
+    """NodeUnpublish + NodeUnstage on every host — the last host out
+    clears the volume's rendezvous record, so a later re-stage re-forms
+    the coordinator from scratch."""
+    for host_id, channel in channels.items():
+        node = CSI_NODE.stub(channel)
+        node.NodeUnpublishVolume(
+            csi_pb2.NodeUnpublishVolumeRequest(
+                volume_id=volume,
+                target_path=str(tmp_path / host_id / "pod" / "tpu"),
+            ),
+            timeout=60,
+        )
+        node.NodeUnstageVolume(
+            csi_pb2.NodeUnstageVolumeRequest(
+                volume_id=volume,
+                staging_target_path=str(tmp_path / host_id / "staging"),
+            ),
+            timeout=60,
+        )
+
+
+def _stage_and_run_group(tmp_path, channels, volume, cleanups):
+    """Stage across all hosts, then run one worker process per staged
+    bootstrap and return their reports."""
+    paths = _stage_group(tmp_path, channels, volume)
 
     procs = []
     for p in paths:
@@ -220,6 +262,166 @@ def test_staged_bootstraps_form_real_process_group(tmp_path):
                                       "ep": 1}
             # 8 elements of 1.0 (process 0) + 8 of 2.0 (process 1).
             assert r["sum"] == 24.0
+    finally:
+        for cleanup in reversed(cleanups):
+            try:
+                cleanup()
+            except Exception:
+                pass
+
+
+TRAIN_FLAGS = [
+    "--synthetic", "20000", "--batch-global", "4", "--seq", "32",
+    "--vocab-size", "64", "--d-model", "32", "--n-layers", "2",
+    "--n-heads", "4", "--d-ff", "64", "--dtype", "float32",
+    "--log-every", "1", "--save-every", "1", "--seed", "3",
+]
+
+
+def _train_env() -> dict:
+    # 2 CPU devices per process, matching the 2-chips-per-host slice so
+    # mesh_from_bootstrap's dp inference (local × num_processes = 4) is
+    # the device count.
+    env = _worker_env()
+    env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+    return env
+
+
+def _spawn_trainers(paths, ckpt_dir, steps, tag, tmp_path):
+    """One oim-train process per bootstrap, logs to files (a SIGKILLed
+    worker's partial log must survive for trajectory comparison)."""
+    procs = []
+    for i, p in enumerate(paths):
+        logf = open(tmp_path / f"{tag}-w{i}.log", "w")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "oim_tpu.cli.train_main",
+                "--bootstrap", p, "--checkpoint-dir", str(ckpt_dir),
+                "--steps", str(steps), *TRAIN_FLAGS,
+            ],
+            env=_train_env(),
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        procs.append((proc, logf))
+    return procs
+
+
+def _parse_losses(log_path) -> dict[int, float]:
+    import re
+
+    out = {}
+    with open(log_path) as f:
+        for m in re.finditer(r"loss=([0-9.]+) step=(\d+)", f.read()):
+            out[int(m.group(2))] = float(m.group(1))
+    return out
+
+
+def _finalized_steps(ckpt_dir) -> set[int]:
+    try:
+        return {int(d) for d in os.listdir(ckpt_dir) if d.isdigit()}
+    except FileNotFoundError:
+        return set()
+
+
+def test_elastic_recovery_resumes_identical_trajectory(tmp_path):
+    """Elastic recovery END TO END (round-4 VERDICT next #3): the pieces
+    — heartbeat, leases, checkpoint, rendezvous — compose into the story
+    they exist for.  A 2-process training gang is SIGKILLed mid-run
+    (worker 1 first, then its orphaned peer — gang semantics), the
+    volume is fully unstaged and re-staged so the CSI rendezvous
+    re-forms the coordinator from scratch, and the restarted gang
+    resumes from the checkpoint + data cursor.  The resumed loss
+    trajectory must be IDENTICAL (same logged 4-decimal values) to an
+    uninterrupted run's — fp32 CPU with deterministic data makes any
+    resume drift (lost optimizer state, misaligned cursor) visible
+    (≙ reference recovery stance, controller.go:425-443 +
+    cmdmonitor.go:23-51)."""
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    cleanups = [registry.close, reg_srv.stop]
+    steps = 8
+    try:
+        channels = _build_hosts(
+            tmp_path, ["host-a", "host-b"], registry, str(reg_srv.addr()),
+            cleanups,
+        )
+        context = _create_volume(channels, "elastic-vol")
+
+        # --- Reference: uninterrupted 2-process run to `steps`.
+        paths = _stage_group(tmp_path, channels, "elastic-vol", context)
+        ref_procs = _spawn_trainers(
+            paths, tmp_path / "ck-ref", steps, "ref", tmp_path
+        )
+        cleanups += [
+            (lambda pr=pr: (pr.kill(), pr.wait())) for pr, _ in ref_procs
+        ]
+        for proc, logf in ref_procs:
+            assert proc.wait(timeout=600) == 0, open(logf.name).read()[-1500:]
+            logf.close()
+        ref = _parse_losses(tmp_path / "ref-w0.log")
+        assert set(ref) == set(range(1, steps + 1)), ref
+        _unstage_group(tmp_path, channels, "elastic-vol")
+
+        # --- Interrupted run: same seed/args, fresh checkpoint dir.
+        paths = _stage_group(tmp_path, channels, "elastic-vol", context)
+        ck = tmp_path / "ck-elastic"
+        gang = _spawn_trainers(paths, ck, steps, "int", tmp_path)
+        cleanups += [
+            (lambda pr=pr: (pr.kill(), pr.wait())) for pr, _ in gang
+        ]
+        # Wait until a checkpoint at step >= 2 is durable, then SIGKILL
+        # worker 1 mid-training; the peer dies with its gang.  Tight
+        # 5 ms poll: the kill must land inside the remaining steps'
+        # runway on a fast host (steps is sized to leave several
+        # checkpoint round-trips of margin after the trigger).
+        deadline = time.time() + 300
+        while not any(s >= 2 for s in _finalized_steps(ck)):
+            assert time.time() < deadline, "no checkpoint appeared"
+            assert all(pr.poll() is None for pr, _ in gang), (
+                "worker died before the kill: "
+                + open(gang[0][1].name).read()[-800:]
+                + open(gang[1][1].name).read()[-800:]
+            )
+            time.sleep(0.005)
+        gang[1][0].kill()
+        gang[0][0].kill()
+        for proc, logf in gang:
+            proc.wait(timeout=60)
+            logf.close()
+        interrupted = _parse_losses(tmp_path / "int-w0.log")
+        saved = max(_finalized_steps(ck))
+        assert saved < steps, "gang finished before the kill landed"
+
+        # --- Recover: full unstage → re-stage (the rendezvous allocates
+        # a fresh coordinator), restart the gang on the SAME checkpoint
+        # dir; it must resume from the data cursor and finish.
+        _unstage_group(tmp_path, channels, "elastic-vol")
+        paths = _stage_group(tmp_path, channels, "elastic-vol", context)
+        resumed_procs = _spawn_trainers(
+            paths, ck, steps, "res", tmp_path
+        )
+        cleanups += [
+            (lambda pr=pr: (pr.kill(), pr.wait()))
+            for pr, _ in resumed_procs
+        ]
+        for proc, logf in resumed_procs:
+            assert proc.wait(timeout=600) == 0, open(logf.name).read()[-1500:]
+            logf.close()
+        res_log = open(tmp_path / "res-w0.log").read()
+        assert f"resumed step={saved}" in res_log, res_log[-800:]
+        resumed = _parse_losses(tmp_path / "res-w0.log")
+
+        # The composed trajectory equals the uninterrupted one: every
+        # pre-kill step the interrupted gang logged, and every post-resume
+        # step, matches the reference exactly.
+        assert set(resumed) == set(range(saved + 1, steps + 1)), resumed
+        for step, loss in {**interrupted, **resumed}.items():
+            assert loss == ref[step], (
+                f"step {step}: {loss} != reference {ref[step]} "
+                f"(interrupted={interrupted}, resumed={resumed}, ref={ref})"
+            )
     finally:
         for cleanup in reversed(cleanups):
             try:
