@@ -1000,6 +1000,13 @@ class Engine:
             "oim_serve_decode_dispatches_total",
             "Chunked decode dispatches (one device round trip each).",
         )
+        self._m_prefix = reg.counter(
+            "oim_serve_prefix_cache_total",
+            "Prompt-prefix cache lookups by outcome (hit = injected KV "
+            "rows replaced prefill work).  The affinity router exists "
+            "to raise the hit rate; watch this to see it working.",
+            ("outcome",),
+        )
         self._m_latency = reg.histogram(
             "oim_serve_request_seconds",
             "Submit-to-completion latency per request.",
@@ -1481,11 +1488,13 @@ class Engine:
             if best_key is None:
                 if not self._warming:
                     self.prefix_misses += 1
+                    self._m_prefix.inc("miss")
                 return 0
             self._prefix_cache.move_to_end(best_key)  # LRU touch
             entry, _ = self._prefix_cache[best_key]
             if not self._warming:
                 self.prefix_hits += 1
+                self._m_prefix.inc("hit")
         self._cache = self._inject(self._cache, entry, jnp.int32(slot))
         return best_usable
 

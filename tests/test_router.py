@@ -582,6 +582,97 @@ class TestPrefixAffinity:
             router._release(b, ok=True)
         assert len(picks) == 3  # plain round-robin among equals
 
+    def _measure_hit_rate(self, servers, router_kwargs, seed_base):
+        """Drive a shared-prefix workload through a fresh Router over
+        ``servers`` and return the fleet prefix-cache hit rate as delta
+        hits / delta lookups (the engines' cumulative /v1/stats counters
+        are snapshotted around the run)."""
+
+        def fleet_counts():
+            hits = misses = 0
+            for s in servers:
+                _, stats = _get(_url(s), "/v1/stats")
+                hits += stats["prefix_hits"]
+                misses += stats["prefix_misses"]
+            return hits, misses
+
+        router = Router(
+            backends=tuple(_url(s) for s in servers),
+            health_interval=0.2,
+            **router_kwargs,
+        ).start()
+        try:
+            deadline = time.time() + 30
+            while time.time() < deadline and not (
+                len(router.healthy_backends()) == len(servers)
+                and all(
+                    b.prefix_cache for b in router._backends.values()
+                )
+            ):
+                time.sleep(0.05)
+            assert len(router.healthy_backends()) == len(servers)
+            h0, m0 = fleet_counts()
+            base = f"http://{router.host}:{router.port}"
+            for group in range(4):
+                prefix = _prompt(seed_base + group, 16)
+                status, _ = _post(
+                    base, "/v1/generate",
+                    {"tokens": prefix, "max_new_tokens": 2,
+                     "cache_prefix": True},
+                )
+                assert status == 200
+                for follower in range(5):
+                    status, _ = _post(
+                        base, "/v1/generate",
+                        {"tokens": prefix + _prompt(
+                            seed_base + 100 + group * 8 + follower, 4
+                        ), "max_new_tokens": 2},
+                    )
+                    assert status == 200
+            h1, m1 = fleet_counts()
+            lookups = (h1 - h0) + (m1 - m0)
+            assert lookups == 24, lookups  # every request looked up once
+            return (h1 - h0) / lookups
+        finally:
+            router.stop()
+
+    def test_affinity_routing_raises_fleet_hit_rate(self):
+        """The POINT of prefix affinity, measured (round-4 VERDICT next
+        #8): on a shared-prefix workload over two prefix-caching
+        backends, affinity routing must deliver a materially higher
+        fleet cache hit rate than affinity-off least-active balancing —
+        requests sharing a prefix land where their KV lives, instead of
+        missing on whichever backend the balancer spread them to."""
+        cfg = TransformerConfig(**CFG)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        servers = [
+            ServeServer(
+                Engine(
+                    params, cfg, n_slots=2, max_len=64, chunk=4,
+                    prefix_cache_size=4,
+                )
+            ).start()
+            for _ in range(2)
+        ]
+        try:
+            affinity_rate = self._measure_hit_rate(
+                servers, {"affinity_prefix_tokens": 8}, seed_base=9000
+            )
+            balanced_rate = self._measure_hit_rate(
+                servers, {"affinity_prefix_tokens": 0}, seed_base=9500
+            )
+        finally:
+            for s in servers:
+                s.stop()
+        # Affinity: all 6 requests of a group land on one backend → the
+        # 5 followers all hit (20/24).  Balanced: followers spread over
+        # both backends and only those landing beside the cached entry
+        # hit (~10/24).
+        assert affinity_rate >= 0.7, affinity_rate
+        assert affinity_rate > balanced_rate + 0.2, (
+            f"affinity {affinity_rate:.2f} vs balanced {balanced_rate:.2f}"
+        )
+
     def test_text_requests_get_affinity_too(self):
         """The text surface routes by leading characters (the router has
         no tokenizer; ~4 chars/token proxies the token prefix)."""
