@@ -85,6 +85,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--spec-ngram", type=int, default=2,
         help="n-gram length the prompt-lookup drafter matches on",
     )
+    p.add_argument(
+        "--draft-params-dir", default="",
+        help="params-only export of a small DRAFT model (oim-train "
+        "--export-dir): model-drafted speculation instead of prompt "
+        "lookup (requires --spec-decode and the --draft-* geometry)",
+    )
+    p.add_argument("--draft-n-layers", type=int, default=0)
+    p.add_argument("--draft-d-model", type=int, default=0)
+    p.add_argument("--draft-n-heads", type=int, default=0)
+    p.add_argument("--draft-n-kv-heads", type=int, default=0)
+    p.add_argument("--draft-d-ff", type=int, default=0)
     p.add_argument("--n-slots", type=int, default=8)
     p.add_argument(
         "--max-queue", type=int, default=64,
@@ -248,6 +259,35 @@ def make_engine(args):
         from oim_tpu.ops.quant import quantize_params_int8
 
         params = quantize_params_int8(params)
+    draft_params = draft_cfg = None
+    if args.draft_params_dir:
+        from oim_tpu.checkpoint import load_params
+        from oim_tpu.parallel import build_mesh
+
+        if not (args.draft_n_layers and args.draft_d_model
+                and args.draft_n_heads):
+            raise SystemExit(
+                "--draft-params-dir needs --draft-n-layers, "
+                "--draft-d-model and --draft-n-heads"
+            )
+        draft_cfg = TransformerConfig(
+            vocab_size=args.vocab_size,
+            d_model=args.draft_d_model,
+            n_layers=args.draft_n_layers,
+            n_heads=args.draft_n_heads,
+            n_kv_heads=args.draft_n_kv_heads,
+            d_ff=args.draft_d_ff,
+            rope_theta=args.rope_theta,
+            norm_eps=args.norm_eps,
+            dtype=args.dtype,
+        )
+        draft_template = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), draft_cfg)
+        )
+        draft_params = load_params(
+            args.draft_params_dir, draft_template, draft_cfg,
+            serve_mesh or build_mesh(devices=jax.devices()[:1]),
+        )
     return Engine(
         params,
         cfg,
@@ -261,6 +301,8 @@ def make_engine(args):
         mesh=serve_mesh,
         spec_decode=args.spec_decode,
         spec_ngram=args.spec_ngram,
+        draft_params=draft_params,
+        draft_cfg=draft_cfg,
         penalties=not args.no_penalties,
         max_queue=args.max_queue,
     )

@@ -54,8 +54,10 @@ callback), an LRU prompt-KV **prefix cache** for system prompts
 are exact, dense and MoE alike), ``stop_ids``, slot-free ``embed`` and
 latency-mode ``beam`` surfaces (beam-k runs as its own jitted program
 beside the slot engine; beam-1 == greedy exactly), in-engine
-speculative decoding (``spec_decode`` — prompt-lookup drafting,
-exactness preserved), int8 KV (``kv_int8``) and weight-only int8
+speculative decoding (``spec_decode`` — prompt-lookup drafting, or a
+trained draft model via ``draft_params``/``draft_cfg`` for workloads
+whose continuations are not in the prompt; exactness preserved either
+way), int8 KV (``kv_int8``) and weight-only int8
 params (both preserve the exactness invariant), Prometheus
 instrumentation, and ``warmup``/``abort``/``forget`` lifecycle
 discipline for daemon use.
@@ -601,6 +603,55 @@ def _draft_lookup(hist, length, draft_len: int, ngram: int, max_len: int):
     return jnp.where(w >= 0, drafts, 0)
 
 
+def _verify_emit(
+    params, kv, lengths, tok, drafts, temps, top_ps, min_ps, active,
+    bases, counts, i, *, cfg, top_k, max_len, n_drafts,
+):
+    """The exactness-critical verify+emit core shared by BOTH drafting
+    sources (prompt lookup and draft model): one (L+1)-position target
+    forward over [tok, drafts], longest-accepted-prefix emission with
+    the non-speculative path's per-sub-step ``fold_in(base, counts+i)``
+    sampling keys, and the headroom-clamped length update.  Returns
+    (kv, lengths, tok_next, emitted, lps, n_emit)."""
+    inputs = jnp.concatenate([tok[:, None], drafts], axis=1)
+    x, kv = _hidden_slots(params, inputs, kv, lengths, cfg)
+    logits = _unembed(x, dequantize_named(params, "wlm"), cfg)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, L+1]
+    accepted = jnp.sum(
+        jnp.cumprod(
+            (drafts == greedy[:, :n_drafts]).astype(jnp.int32), axis=1
+        ),
+        axis=1,
+    )
+    keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
+    samp, samp_lp = _sample_batched(
+        logits[:, 0], temps, keys, top_k, top_ps, min_ps
+    )
+    is_greedy = temps <= 0.0
+    emitted = greedy.at[:, 0].set(
+        jnp.where(is_greedy, greedy[:, 0], samp)
+    )
+    chosen = jnp.take_along_axis(
+        logits, emitted[..., None], axis=-1
+    )[..., 0]
+    lps = chosen.astype(jnp.float32) - jax.nn.logsumexp(
+        logits.astype(jnp.float32), axis=-1
+    )
+    lps = lps.at[:, 0].set(jnp.where(is_greedy, lps[:, 0], samp_lp))
+    n_emit = jnp.where(
+        active, jnp.where(is_greedy, accepted + 1, 1), 0
+    ).astype(jnp.int32)
+    tok_next = jnp.where(
+        active,
+        jnp.take_along_axis(
+            emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+        )[:, 0],
+        tok,
+    )
+    lengths = jnp.minimum(lengths + n_emit, max_len - 1 - n_drafts)
+    return kv, lengths, tok_next, emitted, lps, n_emit
+
+
 def _decode_chunk_spec(
     params, cache: SlotCache, history, tokens, temps, top_ps, min_ps,
     active, bases, counts,
@@ -647,42 +698,11 @@ def _decode_chunk_spec(
                 h, d, (jnp.minimum(n + 1, max_len - n_drafts),)
             )
         )(hist, lengths, drafts)
-        inputs = jnp.concatenate([tok[:, None], drafts], axis=1)
-        x, kv = _hidden_slots(params, inputs, kv, lengths, cfg)
-        logits = _unembed(x, dequantize_named(params, "wlm"), cfg)
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, L+1]
-        accepted = jnp.sum(
-            jnp.cumprod(
-                (drafts == greedy[:, :n_drafts]).astype(jnp.int32), axis=1
-            ),
-            axis=1,
+        kv, lengths, tok_next, emitted, lps, n_emit = _verify_emit(
+            params, kv, lengths, tok, drafts, temps, top_ps, min_ps,
+            active, bases, counts, i, cfg=cfg, top_k=top_k,
+            max_len=max_len, n_drafts=n_drafts,
         )
-        keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
-        samp, samp_lp = _sample_batched(
-            logits[:, 0], temps, keys, top_k, top_ps, min_ps
-        )
-        is_greedy = temps <= 0.0
-        emitted = greedy.at[:, 0].set(
-            jnp.where(is_greedy, greedy[:, 0], samp)
-        )
-        chosen = jnp.take_along_axis(
-            logits, emitted[..., None], axis=-1
-        )[..., 0]
-        lps = chosen.astype(jnp.float32) - jax.nn.logsumexp(
-            logits.astype(jnp.float32), axis=-1
-        )
-        lps = lps.at[:, 0].set(jnp.where(is_greedy, lps[:, 0], samp_lp))
-        n_emit = jnp.where(
-            active, jnp.where(is_greedy, accepted + 1, 1), 0
-        ).astype(jnp.int32)
-        tok_next = jnp.where(
-            active,
-            jnp.take_along_axis(
-                emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
-            )[:, 0],
-            tok,
-        )
-        lengths = jnp.minimum(lengths + n_emit, max_len - 1 - n_drafts)
         return (kv, lengths, tok_next, hist), (emitted, lps, n_emit)
 
     kv0 = (cache.k, cache.v, cache.k_scale, cache.v_scale)
@@ -694,6 +714,115 @@ def _decode_chunk_spec(
     return (
         SlotCache(k_all, v_all, lengths, ks_all, vs_all),
         history,
+        out.transpose(1, 0, 2),
+        lps.transpose(1, 0, 2),
+        n_emit.T,
+    )
+
+
+def _admit_draft(
+    draft_params, dcache: SlotCache, full_rows, slots, new_lengths,
+    *, dcfg,
+):
+    """Prefill the DRAFT model's slot cache for a batch of admissions.
+
+    ``full_rows`` [S, bucket] is each admission's FULL prompt padded to
+    the group's full-prompt bucket (one compile per bucket, like the
+    target's admit), so the draft cache is exact from position 0
+    regardless of any target-side prefix-cache injection (the prompt-KV
+    cache stores TARGET rows only).  ``new_lengths`` [S] is the
+    target's post-admission length per row; both caches track ONE
+    shared length (``_decode_chunk_spec_model``'s invariant).  Padding
+    rows (slot index ``n_slots``) drop at the scatter; pad positions
+    past a row's length are garbage above the length watermark until
+    decode overwrites them — the target admit's discipline.
+    """
+    n_slots = dcache.n_slots
+    kv_full = (dcache.k, dcache.v, dcache.k_scale, dcache.v_scale)
+    row_src = jnp.minimum(slots, n_slots - 1)
+    kv_rows = jax.tree.map(lambda c: jnp.take(c, row_src, axis=1), kv_full)
+    zeros = jnp.zeros_like(new_lengths)
+    _, kv_rows = _hidden_slots(draft_params, full_rows, kv_rows, zeros, dcfg)
+    k_all, v_all, ks_all, vs_all = jax.tree.map(
+        lambda c, u: c.at[:, slots].set(u, mode="drop"), kv_full, kv_rows
+    )
+    lengths = dcache.lengths.at[slots].set(new_lengths, mode="drop")
+    return SlotCache(k_all, v_all, lengths, ks_all, vs_all)
+
+
+def _decode_chunk_spec_model(
+    params, draft_params, cache: SlotCache, dcache: SlotCache,
+    tokens, temps, top_ps, min_ps, active, bases, counts,
+    *, cfg, dcfg, chunk, draft_len, top_k,
+):
+    """``_decode_chunk_spec`` with a TRAINED DRAFT MODEL instead of
+    prompt lookup: each sub-step runs ``draft_len`` sequential greedy
+    forwards of the small draft model from its own slot cache, then the
+    target verifies all ``draft_len + 1`` positions in one forward.
+    Prompt lookup accepts ~0 when the continuation is not in the prompt;
+    a distilled draft drafts from the same learned distribution as the
+    target, so acceptance follows model agreement, not prompt echo.
+
+    Cache discipline (both caches share ONE lengths vector): at sub-step
+    start, ``tok`` is the newest decided token with NO cache row yet in
+    EITHER cache.  The draft scan runs ``draft_len + 1`` forwards —
+    inputs [tok, d1..dL] — writing L+1 draft rows at positions
+    lengths..lengths+L, exactly the rows the target's verify forward
+    writes; the last forward exists only for its row (its output token
+    is discarded), so an all-accepted sub-step leaves no gap at
+    position lengths+L.  Rows past the accepted prefix are stale in
+    both caches identically and are overwritten before they can be
+    attended (next sub-step writes L+1 rows from the new length).
+    Exactness: identical emission rule to ``_decode_chunk_spec`` —
+    greedy output is verified equal to the target's own continuation,
+    sampled slots emit one token from position-0 logits with the same
+    fold_in keys.
+    """
+    max_len = cache.max_len
+    n_drafts = draft_len
+
+    def one(carry, i):
+        kv, dkv, lengths, tok = carry
+
+        # One draft forward per position (the write position advances
+        # with j); the final forward exists only to write d_L's cache
+        # row — its output token is discarded.
+        def dstep(c, j):
+            dkv_c, cur = c
+            x, dkv_c = _hidden_slots(
+                draft_params, cur[:, None], dkv_c, lengths + j, dcfg
+            )
+            lg = _unembed(
+                x, dequantize_named(draft_params, "wlm"), dcfg
+            )
+            nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+            return (dkv_c, nxt), nxt
+
+        (dkv, _), drafted = jax.lax.scan(
+            dstep, (dkv, tok), jnp.arange(n_drafts + 1)
+        )
+        drafts = drafted[:n_drafts].T  # [S, L]
+
+        kv, lengths, tok_next, emitted, lps, n_emit = _verify_emit(
+            params, kv, lengths, tok, drafts, temps, top_ps, min_ps,
+            active, bases, counts, i, cfg=cfg, top_k=top_k,
+            max_len=max_len, n_drafts=n_drafts,
+        )
+        return (kv, dkv, lengths, tok_next), (emitted, lps, n_emit)
+
+    kv0 = (cache.k, cache.v, cache.k_scale, cache.v_scale)
+    dkv0 = (dcache.k, dcache.v, dcache.k_scale, dcache.v_scale)
+    (
+        (k_all, v_all, ks_all, vs_all),
+        (dk, dv, dks, dvs),
+        lengths,
+        _,
+    ), (out, lps, n_emit) = jax.lax.scan(
+        one, (kv0, dkv0, cache.lengths, tokens), jnp.arange(chunk)
+    )
+    return (
+        SlotCache(k_all, v_all, lengths, ks_all, vs_all),
+        SlotCache(dk, dv, lengths, dks, dvs),
         out.transpose(1, 0, 2),
         lps.transpose(1, 0, 2),
         n_emit.T,
@@ -788,6 +917,8 @@ class Engine:
         mesh=None,
         spec_decode: int = 0,
         spec_ngram: int = 2,
+        draft_params=None,
+        draft_cfg: TransformerConfig | None = None,
         penalties: bool = True,
         max_queue: int = 0,
     ):
@@ -802,8 +933,24 @@ class Engine:
                 f"need spec_decode>=0 and spec_ngram>=1; got "
                 f"{spec_decode}, {spec_ngram}"
             )
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError(
+                "draft_params and draft_cfg come together or not at all"
+            )
+        if draft_cfg is not None:
+            if not spec_decode:
+                raise ValueError(
+                    "a draft model needs spec_decode >= 1 (draft length)"
+                )
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target "
+                    f"vocab {cfg.vocab_size}"
+                )
         self.spec_decode = spec_decode
         self.spec_ngram = spec_ngram
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
         # Speculative mode reserves draft_len+1 cache rows per slot so a
         # verify step's L+1 writes always fit inside the region even
         # during post-EOS overshoot (clamped starts must never slide
@@ -885,9 +1032,24 @@ class Engine:
         self._cache = SlotCache.create(
             cfg, n_slots, max_len, quantized=kv_int8
         )
+        # Model-drafted speculation: the draft model keeps its OWN slot
+        # cache (full precision — it is small) in lockstep with the
+        # target's lengths; prompt lookup's device-side history is then
+        # unused and shrinks to a dummy.
+        self._draft_cache = (
+            SlotCache.create(draft_cfg, n_slots, max_len, quantized=False)
+            if draft_cfg is not None
+            else None
+        )
         # Device-side token record per slot (admission writes the full
-        # prompt; speculative decode appends) — the draft source.
-        self._history = jnp.zeros((n_slots, max_len), jnp.int32)
+        # prompt; speculative decode appends) — the draft source for
+        # prompt-lookup speculation.
+        self._history = jnp.zeros(
+            (n_slots, max_len)
+            if (spec_decode and draft_cfg is None)
+            else (1, 1),
+            jnp.int32,
+        )
         # Sampling-penalty occurrence state: prompt+generated and
         # generated-only counts per slot (models/decode.apply_penalties).
         # With penalties disabled the state shrinks to [1, 1] dummies and
@@ -909,14 +1071,32 @@ class Engine:
             self._history = jax.device_put(
                 self._history, NamedSharding(mesh, P())
             )
+            if self._draft_cache is not None:
+                # The draft model is small by design: replicate it and
+                # its cache rather than sharding (no collective traffic
+                # on the draft's sequential forwards).
+                self.draft_params = jax.device_put(
+                    self.draft_params, NamedSharding(mesh, P())
+                )
+                self._draft_cache = jax.device_put(
+                    self._draft_cache, NamedSharding(mesh, P())
+                )
             self._tok_counts, self._gen_counts = jax.device_put(
                 (self._tok_counts, self._gen_counts),
                 NamedSharding(mesh, P()),
             )
         self._admit = jax.jit(
             partial(_admit_batch, cfg=cfg, top_k=top_k,
-                    track_history=bool(spec_decode), penalize=penalties),
+                    track_history=bool(spec_decode) and draft_cfg is None,
+                    penalize=penalties),
             donate_argnums=(1, 2, 3, 4),
+        )
+        self._admit_d = (
+            jax.jit(
+                partial(_admit_draft, dcfg=draft_cfg), donate_argnums=(1,)
+            )
+            if draft_cfg is not None
+            else None
         )
         # Prefix cache: LRU of prompt-KV entries (tuple(tokens) →
         # (kv pytree, true length)).  Each entry costs about one slot's
@@ -934,7 +1114,13 @@ class Engine:
         self.prefix_hits = 0
         self.prefix_misses = 0
         self._embed = jax.jit(partial(embed_tokens, cfg=cfg))
-        if spec_decode:
+        if spec_decode and draft_cfg is not None:
+            self._decode = jax.jit(
+                partial(_decode_chunk_spec_model, cfg=cfg, dcfg=draft_cfg,
+                        chunk=chunk, draft_len=spec_decode, top_k=top_k),
+                donate_argnums=(2, 3),
+            )
+        elif spec_decode:
             self._decode = jax.jit(
                 partial(_decode_chunk_spec, cfg=cfg, chunk=chunk,
                         draft_len=spec_decode, ngram=spec_ngram,
@@ -1405,6 +1591,13 @@ class Engine:
                 "kv_int8": self.kv_int8,
                 "weights_int8": self.weights_int8,
                 "spec_decode": self.spec_decode,
+                "spec_draft_model": self.draft_cfg is not None,
+                "draft_n_layers": (
+                    self.draft_cfg.n_layers if self.draft_cfg else 0
+                ),
+                "draft_d_model": (
+                    self.draft_cfg.d_model if self.draft_cfg else 0
+                ),
                 "penalties": self.penalties,
                 "prefix_cache_size": self.prefix_cache_size,
                 "tp": self.mesh.shape.get("tp", 1) if self.mesh else 1,
@@ -1608,7 +1801,12 @@ class Engine:
                     self._tok_counts,
                     self._gen_counts,
                     jnp.asarray(prompt_counts),
-                    jnp.asarray(full_rows),
+                    # Draft mode jits _admit with track_history=False:
+                    # the [S, max_len] transfer would be dead there.
+                    jnp.asarray(
+                        full_rows if self._admit_d is None
+                        else np.zeros((1, 1), np.int32)
+                    ),
                     jnp.asarray(prompts),
                     jnp.asarray(slot_idx),
                     jnp.asarray(starts),
@@ -1621,6 +1819,21 @@ class Engine:
                     jnp.asarray(freqs),
                     jnp.stack(keys),
                 )
+                if self._admit_d is not None:
+                    # Draft prefill from position 0 over the FULL prompt
+                    # (prefix-cache injection is target-rows-only), then
+                    # lock the draft cache to the target's new lengths.
+                    # Bucketed like the target's prefill: a 50-token
+                    # prompt must not pay an O(max_len^2)-attention
+                    # draft forward (one _admit_d compile per bucket).
+                    full_b = self._bucket(int(np.max(starts + tails)))
+                    self._draft_cache = self._admit_d(
+                        self.draft_params,
+                        self._draft_cache,
+                        jnp.asarray(full_rows[:, :full_b]),
+                        jnp.asarray(slot_idx),
+                        jnp.asarray(starts + tails),
+                    )
                 groups.append((group, first, first_lp))
             for slot, rid, req, _, start, tail, _ in rows:
                 if req.cache_prefix and self.prefix_cache_size:
@@ -1712,7 +1925,18 @@ class Engine:
             [len(slots[i].emitted) if i in slots else 0 for i in range(n_slots)],
             jnp.int32,
         )
-        if self.spec_decode:
+        if self.spec_decode and self._draft_cache is not None:
+            (
+                self._cache, self._draft_cache, out3, lps3, n_emit
+            ) = self._decode(
+                self.params, self.draft_params, self._cache,
+                self._draft_cache, tokens, temps, top_ps, min_ps, active,
+                bases, counts,
+            )
+            out3, lps3, n_emit = jax.device_get((out3, lps3, n_emit))
+            if not self._warming:
+                self.readbacks += 1
+        elif self.spec_decode:
             (
                 self._cache, self._history, out3, lps3, n_emit
             ) = self._decode(

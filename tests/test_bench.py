@@ -177,3 +177,20 @@ def test_spec_margin_check_on_cpu():
         extras3, cfg, params, [prompt], plain, spec, [10], [20], [5], 5,
     )
     assert extras3 == {}
+
+
+def test_spec_model_diagnostics_small_mode(monkeypatch):
+    """Exercise bench._spec_model_diagnostics end to end off-chip (the
+    OIM_BENCH_SPEC_MODEL_SMALL=1 path runs the identical code with tiny
+    geometry): both models train, the draft accepts a majority on the
+    non-echo ramp workload, outputs are exact, and the margin check
+    records no violation — a crash here would burn a pool window."""
+    import bench as bench_mod
+
+    monkeypatch.setenv("OIM_BENCH_SPEC_MODEL_SMALL", "1")
+    extras = {"tunnel_rtt_ms": 0.0}
+    bench_mod._spec_model_diagnostics(extras, on_tpu=False)
+    assert "serve_spec_model_error" not in extras, extras
+    assert extras["serve_spec_model_accept_pct"] > 50.0, extras
+    assert extras["serve_spec_model_exact_req_pct"] == 100.0, extras
+    assert "serve_spec_model_margin_violation" not in extras, extras

@@ -455,6 +455,125 @@ def test_speculative_engine_exact(setup):
                 )
 
 
+def test_draft_model_engine_exact(setup):
+    """Model-drafted speculation must be invisible to results: a draft
+    model of ANY quality (here: random init, wrong geometry) changes
+    nothing about what the engine emits — echo and random prompts,
+    greedy and sampled, int8 KV, prefix cache, and a tp mesh."""
+    cfg, params = setup
+    dcfg = TransformerConfig(**{**CFG, "d_model": 16, "n_layers": 1,
+                                "d_ff": 32, "n_heads": 2})
+    dparams = init_params(jax.random.PRNGKey(7), dcfg)
+    cases = [
+        GenRequest(tokens=_echo_prompt(12, cfg.vocab_size),
+                   max_new_tokens=10),
+        GenRequest(tokens=_prompt(80, 9, cfg.vocab_size), max_new_tokens=7),
+        GenRequest(tokens=_prompt(81, 14, cfg.vocab_size), max_new_tokens=6,
+                   temperature=0.8, seed=11),
+    ]
+    from oim_tpu.parallel import build_mesh
+
+    tp_mesh = build_mesh(tp=2, devices=jax.devices()[:2])
+    for kv_int8 in (False, True):
+        baseline = None
+        for extra in (
+            {},
+            {"spec_decode": 3, "draft_params": dparams, "draft_cfg": dcfg},
+            {"spec_decode": 2, "draft_params": dparams, "draft_cfg": dcfg,
+             "prefix_cache_size": 2},
+            {"spec_decode": 3, "draft_params": dparams, "draft_cfg": dcfg,
+             "mesh": tp_mesh},
+        ):
+            engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                            kv_int8=kv_int8, **extra)
+            rids = [engine.submit(r) for r in cases]
+            results = engine.run()
+            outs = [results[r] for r in rids]
+            if baseline is None:
+                baseline = outs
+            else:
+                assert outs == baseline, f"{extra} kv_int8={kv_int8}"
+
+
+def test_draft_model_acceptance_follows_agreement(setup):
+    """The acceptance path itself: a draft that IS the target must
+    accept essentially every drafted token on arbitrary (non-echo)
+    prompts — acceptance follows model agreement, not prompt echo."""
+    cfg, params = setup
+    eng = Engine(params, cfg, n_slots=1, max_len=96, chunk=4,
+                 spec_decode=4, draft_params=params, draft_cfg=cfg)
+    rid = eng.submit(GenRequest(tokens=_prompt(90, 16, cfg.vocab_size),
+                                max_new_tokens=32, eos_id=-1))
+    eng.run()
+    stats = eng.stats()
+    assert stats["spec_drafted"] > 0
+    accept = stats["spec_accepted"] / stats["spec_drafted"]
+    # < 1.0 only by chunk-overshoot accounting: sub-steps after the
+    # budget lands mid-chunk still count their drafts (the plain spec
+    # engine counts identically), not by any model disagreement.
+    assert accept > 0.8, stats
+
+
+def _ramp_windows(vocab: int, seq: int, n: int, seed: int) -> np.ndarray:
+    """The bench's non-echo spec-model workload — ONE shared definition
+    (bench.ramp_windows), so this test and the on-chip measurement pin
+    the same distribution."""
+    import bench
+
+    return bench.ramp_windows(vocab, seq, n, seed)
+
+
+def _train_lm(cfg, steps: int, seed: int):
+    """Train a tiny LM on ramp data; returns trained params."""
+    import bench
+
+    params, _loss = bench.train_tiny_lm(cfg, steps, seed)
+    return params
+
+
+def test_trained_draft_beats_prompt_lookup_off_echo():
+    """Round-4 VERDICT next #6, the CPU-measurable half: on a workload
+    whose continuation is NOT in the prompt, prompt-lookup drafting
+    accepts ~nothing while a small TRAINED draft model accepts most
+    drafts — with identical (exact) outputs from both engines.  Both
+    models train on the same deterministic-successor distribution (the
+    trainer's own synthetic ramp); the draft has ~1/4 the layers/width."""
+    cfg = TransformerConfig(**{**CFG, "vocab_size": 64})
+    dcfg = TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        dtype="float32", use_pallas=False,
+    )
+    params = _train_lm(cfg, steps=120, seed=0)
+    dparams = _train_lm(dcfg, steps=120, seed=1)
+
+    prompts = [
+        [int(t) for t in row]
+        for row in _ramp_windows(64, 12, 3, seed=77)
+    ]
+
+    def run(extra):
+        eng = Engine(params, cfg, n_slots=2, max_len=96, chunk=4, **extra)
+        rids = [
+            eng.submit(GenRequest(tokens=p, max_new_tokens=24, eos_id=-1))
+            for p in prompts
+        ]
+        results = eng.run()
+        return [results[r] for r in rids], eng.stats()
+
+    plain, _ = run({})
+    lookup_out, lookup = run({"spec_decode": 4})
+    draft_out, draft = run(
+        {"spec_decode": 4, "draft_params": dparams, "draft_cfg": dcfg}
+    )
+    # Exactness on both speculative paths.
+    assert lookup_out == plain
+    assert draft_out == plain
+    lookup_rate = lookup["spec_accepted"] / max(1, lookup["spec_drafted"])
+    draft_rate = draft["spec_accepted"] / max(1, draft["spec_drafted"])
+    assert draft_rate > 0.5, (draft_rate, draft)
+    assert draft_rate > lookup_rate + 0.3, (draft_rate, lookup_rate)
+
+
 def test_gqa_engine_exact():
     """GQA serving (n_kv_heads < n_heads): the engine's kv-sized slot
     cache must be invisible to results — plain, int8-KV, and in-engine
