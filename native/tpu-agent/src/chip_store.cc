@@ -116,7 +116,12 @@ bool ChipStore::FindChips(int n, const std::vector<int>& topology,
   }
   std::vector<std::vector<int>> shapes;
   if (!topology.empty()) {
-    shapes.push_back(topology);
+    // TPU topology convention: a lower-rank request is implicitly
+    // trailing-1-padded ("2x2" on a 2x2x1 host means 2x2x1) — the
+    // gke-tpu dialect writes 2D topologies against 3D host meshes.
+    std::vector<int> padded = topology;
+    while (padded.size() < mesh_.size()) padded.push_back(1);
+    shapes.push_back(padded);
   } else {
     shapes = SubBoxes(n, mesh_);
   }

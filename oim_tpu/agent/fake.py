@@ -150,11 +150,15 @@ class ChipStore:
         free = {cid for cid, c in self.chips.items() if not c.allocation}
         if n > len(free):
             raise RpcAppError(ENOSPC, f"need {n} chips, {len(free)} free")
-        shapes = (
-            [topology]
-            if topology
-            else _sub_boxes(n, self.mesh) or []
-        )
+        if topology:
+            # TPU topology convention (mirrors chip_store.cc): a
+            # lower-rank request is trailing-1-padded — "2x2" on a
+            # 2x2x1 host means 2x2x1 (the gke-tpu dialect writes 2D
+            # topologies against 3D host meshes).
+            padded = tuple(topology) + (1,) * (len(self.mesh) - len(topology))
+            shapes = [padded]
+        else:
+            shapes = _sub_boxes(n, self.mesh) or []
         for shape in shapes:
             if len(shape) != len(self.mesh):
                 continue

@@ -73,27 +73,55 @@ class ControllerServer:
         if request.capacity_range.required_bytes > 0:
             # Orchestrators that size PVCs in "bytes" get 1 chip per unit.
             chip_count = max(chip_count, int(request.capacity_range.required_bytes))
-        with self._mutex.locked(request.name):
-            if num_hosts > 1:
-                # Multi-host slices allocate on-demand on each member host
-                # at NodeStage (≙ the reference's Ceph path, created at
-                # MapVolume time, controller.go:280-297); pre-provisioning
-                # on the one controller this server happens to route to
-                # would reserve chips on the wrong host.
-                provisioned = chip_count * num_hosts
-            else:
-                try:
-                    provisioned = self.backend.provision(request.name, chip_count)
-                except VolumeError as exc:
-                    self._abort(context, exc)
+        map_params = getattr(self.backend, "map_params", None)
+        if map_params is not None:
+            # Emulated foreign driver: the translation hook decides chip
+            # count AND topology, and allocation happens at NodeStage
+            # where that request is issued (≙ the reference's ceph path,
+            # created at MapVolume time, controller.go:280-297).
+            # Pre-provisioning a flat chipCount here would conflict with
+            # the topology-shaped MapVolume the stage performs.
+            try:
+                translated = map_params(params)
+            except ValueError as exc:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+            provisioned = translated.slice.chip_count
+            if int(request.capacity_range.required_bytes) > provisioned:
+                # The dialect's topology decides the size; a PVC asking
+                # for more must fail HERE, not bind a too-small PV.
+                context.abort(
+                    grpc.StatusCode.OUT_OF_RANGE,
+                    f"requested {request.capacity_range.required_bytes} "
+                    f"chips but the translated topology provides "
+                    f"{provisioned}",
+                )
+        else:
+            with self._mutex.locked(request.name):
+                if num_hosts > 1:
+                    # Multi-host slices allocate on-demand on each member
+                    # host at NodeStage (≙ the reference's Ceph path,
+                    # created at MapVolume time, controller.go:280-297);
+                    # pre-provisioning on the one controller this server
+                    # happens to route to would reserve chips on the
+                    # wrong host.
+                    provisioned = chip_count * num_hosts
+                else:
+                    try:
+                        provisioned = self.backend.provision(
+                            request.name, chip_count
+                        )
+                    except VolumeError as exc:
+                        self._abort(context, exc)
         response = csi_pb2.CreateVolumeResponse()
         response.volume.volume_id = request.name
         response.volume.capacity_bytes = provisioned
-        # volume_context chipCount is what each host's NodeStage maps
-        # (per-host chips), not the volume total.
-        response.volume.volume_context["chipCount"] = str(
-            chip_count if num_hosts > 1 else provisioned
-        )
+        if map_params is None:
+            # volume_context chipCount is what each host's NodeStage maps
+            # (per-host chips), not the volume total.  Emulated volumes
+            # carry the foreign dialect's own keys instead.
+            response.volume.volume_context["chipCount"] = str(
+                chip_count if num_hosts > 1 else provisioned
+            )
         for key, value in request.parameters.items():
             response.volume.volume_context.setdefault(key, value)
         if self.controller_id:
@@ -126,11 +154,11 @@ class ControllerServer:
             # Malformed membership context: treat as the single-host default
             # for both the existence check and the allowed-modes check below.
             num_hosts = 1
-        if num_hosts <= 1:
-            # Multi-host volumes allocate per-host at NodeStage (see
+        if num_hosts <= 1 and getattr(self.backend, "map_params", None) is None:
+            # Multi-host AND emulated volumes allocate at NodeStage (see
             # CreateVolume) — this controller has no backend state to
-            # consult, so the CSI NOT_FOUND check applies only to
-            # single-host volumes.
+            # consult for them, so the CSI NOT_FOUND check applies only
+            # to single-host native volumes.
             try:
                 exists = self.backend.volume_exists(request.volume_id)
             except VolumeError as exc:

@@ -43,10 +43,20 @@ def _gke_tpu_params(params: dict) -> oim_pb2.MapVolumeRequest:
     topology_spec = params.get("google.com/tpu-topology", "")
     count = int(params.get("google.com/tpu-count", "0") or "0")
     dims = [int(d) for d in topology_spec.split("x") if d] if topology_spec else []
-    if dims and not count:
-        count = 1
+    if dims:
+        product = 1
         for d in dims:
-            count *= d
+            product *= d
+        if count and count != product:
+            # Contradictory parameters must fail where the hook first
+            # runs (CreateVolume), not strand the pod in
+            # ContainerCreating when every NodeStage hits the agent's
+            # product check.
+            raise ValueError(
+                f"google.com/tpu-count {count} contradicts topology "
+                f"{topology_spec} ({product} chips)"
+            )
+        count = product
     if not count:
         raise ValueError(
             "gke-tpu emulation requires google.com/tpu-count or "

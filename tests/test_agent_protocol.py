@@ -181,6 +181,23 @@ def test_explicit_topology(agent_socket):
         assert err.value.code == -32602
 
 
+def test_topology_rank_padding(agent_socket):
+    """TPU topology convention: a lower-rank topology request is
+    trailing-1-padded against the host mesh — "2x2" on a 2x2x2 host
+    allocates a 2x2x1 sub-mesh (the gke-tpu dialect writes 2D
+    topologies; ≙ chip_store.cc / fake.py padding)."""
+    with Agent(agent_socket) as a:
+        alloc = a.create_allocation("vol-2d", 4, topology=[2, 2])
+        assert alloc["mesh"] == [2, 2, 1]
+        assert len(alloc["chips"]) == 4
+        # Still a real contiguity constraint: an impossible padded shape
+        # ([3] → 3x1x1 does not fit a 2-wide axis) fails ENOSPC, not
+        # silently linear.
+        with pytest.raises(AgentError) as err:
+            a.create_allocation("vol-3d-bad", 3, topology=[3])
+        assert err.value.code == -28
+
+
 def test_fragmentation_fallback(agent_socket):
     with Agent(agent_socket) as a:
         # Pin two chips so no 2x2x2-box-free region of 4 in one plane exists.

@@ -361,6 +361,67 @@ def test_remote_emulation_gke(remote_csi):
     assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
 
 
+def test_remote_emulation_create_volume_semantics(remote_csi):
+    """Provisioning in the foreign dialect (the gke-tpu-emulation deploy
+    mode): CreateVolume defers allocation to NodeStage but must still
+    honor CSI semantics — capacity over the topology's size is
+    OUT_OF_RANGE, contradictory count/topology is INVALID_ARGUMENT at
+    provisioning (not a stuck pod at every stage attempt), and
+    ValidateVolumeCapabilities succeeds on a not-yet-staged volume."""
+    factory, _, store, _ = remote_csi
+    stubs = factory(emulate="gke-tpu")
+    params = {"google.com/tpu-topology": "2x2"}
+    created = stubs.controller.CreateVolume(
+        csi_pb2.CreateVolumeRequest(
+            name="pvc-emu",
+            parameters=params,
+            capacity_range=csi_pb2.CapacityRange(required_bytes=4),
+            volume_capabilities=_caps(),
+        ),
+        timeout=10,
+    )
+    assert created.volume.capacity_bytes == 4
+    assert "pvc-emu" not in store.allocations  # allocated at NodeStage
+
+    # A CO validating the just-created (unstaged) volume must not get
+    # NOT_FOUND — there is no backend record by design.
+    confirmed = stubs.controller.ValidateVolumeCapabilities(
+        csi_pb2.ValidateVolumeCapabilitiesRequest(
+            volume_id="pvc-emu",
+            volume_context=dict(created.volume.volume_context),
+            volume_capabilities=_caps(),
+        ),
+        timeout=10,
+    )
+    assert confirmed.confirmed.volume_capabilities
+
+    with pytest.raises(grpc.RpcError) as err:
+        stubs.controller.CreateVolume(
+            csi_pb2.CreateVolumeRequest(
+                name="pvc-too-big",
+                parameters=params,
+                capacity_range=csi_pb2.CapacityRange(required_bytes=8),
+                volume_capabilities=_caps(),
+            ),
+            timeout=10,
+        )
+    assert err.value.code() == grpc.StatusCode.OUT_OF_RANGE
+
+    with pytest.raises(grpc.RpcError) as err:
+        stubs.controller.CreateVolume(
+            csi_pb2.CreateVolumeRequest(
+                name="pvc-contradiction",
+                parameters={
+                    "google.com/tpu-topology": "2x2",
+                    "google.com/tpu-count": "8",
+                },
+                volume_capabilities=_caps(),
+            ),
+            timeout=10,
+        )
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
 def test_stage_timeout_when_device_never_appears(tmp_path):
     """≙ the reference's deliberate NodeStage timeout test
     (oim-driver_test.go:209-226): the controller maps a volume whose device

@@ -28,6 +28,7 @@ import socket
 import subprocess
 import sys
 import time
+from contextlib import contextmanager
 
 import grpc
 import pytest
@@ -277,11 +278,11 @@ def _wait_for_unix_socket(path, procs, timeout=15):
     raise AssertionError(f"{path} never came up")
 
 
-@pytest.fixture(scope="class")
-def cluster(request, tmp_path_factory):
-    if not _build_native():
-        pytest.skip("native toolchain unavailable")
-    root = tmp_path_factory.mktemp("k8s-sim")
+@contextmanager
+def _sim_cluster(root, ds_manifest="tpu-daemonset.yaml"):
+    """Materialize registry + one node of ``ds_manifest`` as local
+    processes (the kubelet-sim).  Shared by the standard and the
+    gke-tpu-emulation deploy modes — both are REAL manifests."""
     registry_port = _free_port()
     controller_port = _free_port()
 
@@ -331,7 +332,7 @@ def cluster(request, tmp_path_factory):
             )
 
         # -- node DaemonSet (one simulated node)
-        (ds,) = by_kind(load_manifest("tpu-daemonset.yaml"), "DaemonSet")
+        (ds,) = by_kind(load_manifest(ds_manifest), "DaemonSet")
         ds_spec = ds["spec"]["template"]["spec"]
         ds_vols = materialize_volumes(ds_spec, "node")
         # The hostPath /dev of the simulated node: 4 fake accel device
@@ -398,6 +399,26 @@ def cluster(request, tmp_path_factory):
         for p in procs:
             if p.proc:
                 p._log.close()
+
+
+@pytest.fixture(scope="class")
+def cluster(request, tmp_path_factory):
+    if not _build_native():
+        pytest.skip("native toolchain unavailable")
+    root = tmp_path_factory.mktemp("k8s-sim")
+    with _sim_cluster(root) as c:
+        yield c
+
+
+@pytest.fixture(scope="class")
+def emu_cluster(request, tmp_path_factory):
+    if not _build_native():
+        pytest.skip("native toolchain unavailable")
+    root = tmp_path_factory.mktemp("k8s-emu")
+    with _sim_cluster(
+        root, "gke-tpu-emulation/gke-tpu-daemonset.yaml"
+    ) as c:
+        yield c
 
 
 @pytest.mark.usefixtures("cluster")
@@ -543,6 +564,114 @@ class TestKubeletSim:
             csi_pb2.DeleteVolumeRequest(volume_id=volume_id)
         )
         # external-provisioner retries are idempotent:
+        controller.DeleteVolume(
+            csi_pb2.DeleteVolumeRequest(volume_id=volume_id)
+        )
+
+
+@pytest.mark.usefixtures("emu_cluster")
+class TestGkeTpuEmulationSim:
+    """The SECOND deploy mode, driven: the emulation daemonset's real
+    manifests boot a node whose CSI driver masquerades as gke-tpu, and
+    the kubelet call sequence provisions a slice from the FOREIGN
+    dialect's StorageClass parameters (google.com/tpu-topology) —
+    ≙ the reference's ceph-csi deploy mode driven by its tier-4 e2e."""
+
+    @pytest.fixture(autouse=True)
+    def _attach(self, emu_cluster):
+        self.cluster = emu_cluster
+        self.channel = grpc.insecure_channel(
+            f"unix:{emu_cluster['csi_sock']}"
+        )
+        yield
+        self.channel.close()
+
+    def test_emulated_lifecycle(self):
+        identity = CSI_IDENTITY.stub(self.channel)
+        info = identity.GetPluginInfo(csi_pb2.GetPluginInfoRequest())
+        assert info.name == "gke-tpu"  # the masquerade, end to end
+
+        (sc,) = by_kind(
+            load_manifest("gke-tpu-emulation/storageclass.yaml"),
+            "StorageClass",
+        )
+        docs = load_manifest("gke-tpu-emulation/example-workload.yaml")
+        (pvc,) = by_kind(docs, "PersistentVolumeClaim")
+        controller = CSI_CONTROLLER.stub(self.channel)
+        node = CSI_NODE.stub(self.channel)
+
+        volume_name = f"pvc-{pvc['metadata']['name']}"
+        capability = csi_pb2.VolumeCapability(
+            mount=csi_pb2.VolumeCapability.MountVolume(),
+            access_mode=csi_pb2.VolumeCapability.AccessMode(
+                mode=csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER
+            ),
+        )
+        created = controller.CreateVolume(
+            csi_pb2.CreateVolumeRequest(
+                name=volume_name,
+                parameters=sc["parameters"],
+                capacity_range=csi_pb2.CapacityRange(
+                    required_bytes=int(
+                        pvc["spec"]["resources"]["requests"]["storage"]
+                    )
+                ),
+                volume_capabilities=[capability],
+            )
+        )
+        volume_id = created.volume.volume_id
+        # The foreign dialect rode into the volume context.
+        assert (
+            created.volume.volume_context["google.com/tpu-topology"]
+            == "2x2"
+        )
+
+        staging = os.path.join(
+            self.cluster["plugins_dir"], volume_id, "globalmount"
+        )
+        os.makedirs(staging, exist_ok=True)
+        node.NodeStageVolume(
+            csi_pb2.NodeStageVolumeRequest(
+                volume_id=volume_id,
+                staging_target_path=staging,
+                volume_capability=capability,
+                volume_context=created.volume.volume_context,
+            )
+        )
+        bootstrap = json.load(
+            open(os.path.join(staging, "tpu-bootstrap.json"))
+        )
+        # 2x2 topology translated by the emulation hook → 4 chips.
+        assert len(bootstrap["chips"]) == 4
+
+        pod_dir = os.path.join(
+            self.cluster["pods_dir"],
+            "pod-uid-emu",
+            "volumes",
+            "kubernetes.io~csi",
+            volume_name,
+            "mount",
+        )
+        node.NodePublishVolume(
+            csi_pb2.NodePublishVolumeRequest(
+                volume_id=volume_id,
+                staging_target_path=staging,
+                target_path=pod_dir,
+                volume_capability=capability,
+                volume_context=created.volume.volume_context,
+            )
+        )
+        assert os.path.exists(os.path.join(pod_dir, "tpu-bootstrap.json"))
+        node.NodeUnpublishVolume(
+            csi_pb2.NodeUnpublishVolumeRequest(
+                volume_id=volume_id, target_path=pod_dir
+            )
+        )
+        node.NodeUnstageVolume(
+            csi_pb2.NodeUnstageVolumeRequest(
+                volume_id=volume_id, staging_target_path=staging
+            )
+        )
         controller.DeleteVolume(
             csi_pb2.DeleteVolumeRequest(volume_id=volume_id)
         )

@@ -103,7 +103,7 @@ def _manifest_commands() -> set[str]:
     """First element of every container ``command:`` across the deploy
     manifests (minimal YAML scrape — the manifests are plain lists)."""
     commands: set[str] = set()
-    for path in (REPO / "deploy" / "kubernetes").glob("*.yaml"):
+    for path in (REPO / "deploy" / "kubernetes").rglob("*.yaml"):
         lines = path.read_text().splitlines()
         for i, line in enumerate(lines):
             if line.strip() == "command:" and i + 1 < len(lines):
@@ -179,3 +179,26 @@ def test_image_buildable_when_docker_present():
         timeout=60,
     )
     assert out.returncode == 0, "oim-tpu:latest not built"
+
+
+def test_emulation_manifests_coherent():
+    """The gke-tpu-emulation deploy mode (≙ the reference's ceph-csi
+    mode) must agree with the code: the daemonset's --emulate name is a
+    registered emulated driver, and the CSIDriver object, StorageClass
+    provisioner, and kubelet plugin paths all carry that same name."""
+    import re
+
+    from oim_tpu.csi.emulation import emulated_driver
+
+    emu = REPO / "deploy" / "kubernetes" / "gke-tpu-emulation"
+    ds = (emu / "gke-tpu-daemonset.yaml").read_text()
+    m = re.search(r"--emulate=(\S+)", ds)
+    assert m, "daemonset must pass --emulate"
+    name = m.group(1)
+    assert emulated_driver(name) is not None, name
+    assert f"/var/lib/kubelet/plugins/{name}/csi.sock" in ds
+    assert f"name: {name}" in (emu / "csi-driver.yaml").read_text()
+    sc = (emu / "storageclass.yaml").read_text()
+    assert f"provisioner: {name}" in sc
+    # The StorageClass speaks the foreign dialect the hook translates.
+    assert "google.com/tpu-topology" in sc
