@@ -1467,15 +1467,26 @@ def _decode_diagnostics(extras, on_tpu, cfg, batch, params) -> None:
             f"(batch={batch}, {new_tokens} new tokens in {dt*1000:.0f} ms)"
         )
         if on_tpu:
-            # Quantized variants: int8 KV cache and weight-only int8 —
-            # the two bandwidth levers documented in doc/compute.md.
-            from oim_tpu.ops.quant import quantize_params_int8
+            # Quantized variants: int8 KV cache, weight-only int8, and
+            # weight-only int4 (group-wise) — the bandwidth ladder
+            # documented in doc/compute.md.  int4's value is an open
+            # measurement: it wins only if XLA keeps the operand packed
+            # in HBM on this backend.
+            from oim_tpu.ops.quant import (
+                quantize_params_int4,
+                quantize_params_int8,
+            )
 
             for label, p, kv in (
                 ("decode_tok_per_s_kvint8", params, True),
                 (
                     "decode_tok_per_s_w8kv8",
                     quantize_params_int8(params),
+                    True,
+                ),
+                (
+                    "decode_tok_per_s_w4kv8",
+                    quantize_params_int4(params),
                     True,
                 ),
             ):

@@ -56,6 +56,53 @@ def dequantize_weight_int8(q, scale):
     return q.astype(jnp.float32) * scale[..., None, :]
 
 
+# Symmetric int4 range; -8 unused so the scale inverts exactly.
+_INT4_MAX = 7.0
+
+
+def _int4_group(din: int, group: int) -> int:
+    """Effective group size: the largest divisor of ``din`` ≤ the
+    requested group (gcd), so any layer geometry quantizes — a d_ff not
+    divisible by the requested group degrades to a finer group, never
+    an error at serve time."""
+    import math
+
+    return max(1, math.gcd(din, group))
+
+
+def quantize_weight_int4(w, group: int = 64):
+    """Weight-only int4 with GROUP-WISE scales along the reduction axis:
+    ``w [..., din, dout]`` → (int4 [..., din, dout], f32 scales
+    [..., din/g, dout]).  Per-channel int4 loses too much range on real
+    weight distributions; a g-row group keeps the max-abs local.  HBM
+    cost: 0.5 bytes/weight + 4/g bytes of scale (≈0.56 at g=64) vs
+    int8's ~1.03 — decode is weight-bandwidth-bound at small batch once
+    GQA+int8 shrink the KV cache, so this is the next decode lever
+    (BASELINE.md decode rows; measured by tools/decode_bench.py)."""
+    wf = w.astype(jnp.float32)
+    din = wf.shape[-2]
+    g = _int4_group(din, group)
+    grouped = wf.reshape(*wf.shape[:-2], din // g, g, wf.shape[-1])
+    amax = jnp.max(jnp.abs(grouped), axis=-2)
+    scale = jnp.maximum(amax / _INT4_MAX, _EPS)
+    q = jnp.clip(
+        jnp.round(grouped / scale[..., None, :]), -_INT4_MAX, _INT4_MAX
+    ).astype(jnp.int4)
+    return q.reshape(wf.shape), scale
+
+
+def dequantize_weight_int4(q, scale):
+    """Inverse of ``quantize_weight_int4`` (XLA keeps the int4 operand
+    packed in HBM on TPU and fuses convert+scale into the matmul read)."""
+    din = q.shape[-2]
+    n_groups = scale.shape[-2]
+    g = din // n_groups
+    grouped = q.astype(jnp.float32).reshape(
+        *q.shape[:-2], n_groups, g, q.shape[-1]
+    )
+    return (grouped * scale[..., None, :]).reshape(q.shape)
+
+
 WEIGHT_QUANT_TARGETS = (
     "wq", "wk", "wv", "wo", "w_gate", "w_in", "w_out", "wlm",
 )
@@ -79,10 +126,28 @@ def quantize_params_int8(params: dict) -> dict:
     return out
 
 
+def quantize_params_int4(params: dict, group: int = 64) -> dict:
+    """Weight-only int4 (group-wise) for inference params — the int8
+    scheme's shape (``quantize_params_int8``) with int4 payloads; the
+    VALUE dtype selects the dequant path, so the ``_wscale`` companion
+    rule and every consumer stay unchanged."""
+    out = {}
+    for name, value in params.items():
+        if name in WEIGHT_QUANT_TARGETS:
+            q, scale = quantize_weight_int4(value, group)
+            out[name] = q
+            out[f"{name}_wscale"] = scale
+        else:
+            out[name] = value
+    return out
+
+
 def dequantize_named(tree: dict, name: str, dtype=None):
     """``tree[name]`` dequantized iff its ``_wscale`` companion exists —
     THE one definition of the companion-key rule, used by the layer path
-    (via ``maybe_dequantize_weights``) and the unembedding alike.
+    (via ``maybe_dequantize_weights``) and the unembedding alike.  The
+    value's dtype selects the scheme: int4 payloads carry group-wise
+    scales, int8 per-output-channel.
 
     ``dtype`` casts the dequantized weight (pass the compute dtype: a
     f32 operand against bf16 activations would promote the matmul to
@@ -92,15 +157,21 @@ def dequantize_named(tree: dict, name: str, dtype=None):
     scale = tree.get(f"{name}_wscale")
     if scale is None:
         return value
-    deq = dequantize_weight_int8(value, scale)
+    if value.dtype == jnp.int4:
+        deq = dequantize_weight_int4(value, scale)
+    else:
+        deq = dequantize_weight_int8(value, scale)
     return deq if dtype is None else deq.astype(dtype)
 
 
-def has_int8_weights(params: dict) -> bool:
-    """True when ``params`` carries weight-only-int8 companion scales —
-    the one suffix rule, shared with ``dequantize_named`` so detection
-    can never diverge from dequantization."""
-    return any(name.endswith("_wscale") for name in params)
+def weight_quant_mode(params: dict) -> str:
+    """'' (unquantized) | 'int8' | 'int4' — decided by the payload dtype
+    of any scaled weight, same dispatch as ``dequantize_named``."""
+    for name in params:
+        if name.endswith("_wscale"):
+            value = params[name[: -len("_wscale")]]
+            return "int4" if value.dtype == jnp.int4 else "int8"
+    return ""
 
 
 def maybe_dequantize_weights(tree: dict, dtype=None) -> dict:

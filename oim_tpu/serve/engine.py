@@ -89,10 +89,10 @@ from oim_tpu.models.decode import (
 )
 from oim_tpu.ops.quant import (
     dequantize_named,
-    has_int8_weights,
     make_kv_buffers,
     maybe_dequantize_weights,
     quantize_int8,
+    weight_quant_mode,
 )
 from oim_tpu.models.transformer import (
     TransformerConfig,
@@ -1023,7 +1023,8 @@ class Engine:
         self.max_queue = max_queue
         self.top_k = top_k
         self.kv_int8 = kv_int8
-        self.weights_int8 = has_int8_weights(params)
+        self.weight_quant = weight_quant_mode(params)
+        self.weights_int8 = self.weight_quant == "int8"
         self.n_params = int(sum(
             int(np.prod(v.shape)) for name, v in params.items()
             if not name.endswith("_wscale")
@@ -1590,6 +1591,7 @@ class Engine:
                 "default_top_p": self.default_top_p,
                 "kv_int8": self.kv_int8,
                 "weights_int8": self.weights_int8,
+                "weight_quant": self.weight_quant,
                 "spec_decode": self.spec_decode,
                 "spec_draft_model": self.draft_cfg is not None,
                 "draft_n_layers": (

@@ -514,6 +514,77 @@ class TestWeightInt8:
         assert results[rid] == want
 
 
+class TestWeightInt4:
+    def test_roundtrip_groups_and_bytes(self, setup):
+        from oim_tpu.ops.quant import (
+            WEIGHT_QUANT_TARGETS,
+            dequantize_weight_int4,
+            quantize_params_int4,
+            weight_quant_mode,
+        )
+
+        cfg, params, _ = setup
+        qparams = quantize_params_int4(params, group=16)
+        assert weight_quant_mode(qparams) == "int4"
+        for name in WEIGHT_QUANT_TARGETS:
+            if name not in params:
+                continue
+            assert qparams[name].dtype == jnp.int4
+            scale = np.asarray(qparams[f"{name}_wscale"])
+            din = params[name].shape[-2]
+            g = din // scale.shape[-2]
+            err = np.abs(
+                np.asarray(dequantize_weight_int4(
+                    qparams[name], qparams[f"{name}_wscale"]
+                ))
+                - np.asarray(params[name], dtype=np.float32)
+            )
+            # Each weight lands within half a quantization step of its
+            # group's scale.
+            step = np.repeat(scale, g, axis=-2)
+            assert (err <= step / 2 + 1e-6).all(), name
+
+    def test_group_gcd_clamps_to_geometry(self):
+        from oim_tpu.ops.quant import quantize_weight_int4
+
+        w = jnp.ones((24, 8), jnp.float32)
+        q, scale = quantize_weight_int4(w, group=64)  # gcd(24, 64) = 8
+        assert scale.shape == (3, 8)
+        assert q.dtype == jnp.int4
+
+    def test_generate_close_and_engine_exact(self, setup):
+        """int4 is coarser than int8 but the fused engine path must
+        still EXACTLY match the solo decode on the same quantized
+        params — the exactness invariant is about shared dequant, not
+        about precision."""
+        from oim_tpu.ops.quant import quantize_params_int4
+        from oim_tpu.serve import Engine, GenRequest
+
+        cfg, params, _ = setup
+        qparams = quantize_params_int4(params, group=16)
+        prompt = jnp.arange(2 * 8).reshape(2, 8) % cfg.vocab_size
+        logits_fp, _ = prefill(params, prompt, cfg, max_len=16)
+        logits_q, _ = prefill(qparams, prompt, cfg, max_len=16)
+        # Group-wise int4 through 2 layers: bounded, looser than int8.
+        np.testing.assert_allclose(
+            np.asarray(logits_q), np.asarray(logits_fp), atol=0.8, rtol=0.5
+        )
+        eng_cfg = TransformerConfig(**CFG)
+        eng_params = quantize_params_int4(
+            init_params(jax.random.PRNGKey(0), eng_cfg), group=16
+        )
+        engine = Engine(eng_params, eng_cfg, n_slots=2, max_len=64, chunk=4)
+        assert engine.weight_quant == "int4"
+        p = [3, 1, 4, 1, 5]
+        rid = engine.submit(GenRequest(tokens=p, max_new_tokens=6))
+        results = engine.run()
+        want = np.asarray(generate(
+            eng_params, jnp.asarray(p, jnp.int32)[None], eng_cfg,
+            max_new_tokens=6,
+        ))[0, 5:].tolist()
+        assert results[rid] == want
+
+
 class TestBeamSearch:
     def test_beam1_equals_greedy(self, setup):
         from oim_tpu.models.beam import make_beam_search_fn

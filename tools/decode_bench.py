@@ -49,9 +49,14 @@ def main(argv=None) -> int:
     p.add_argument("--n-heads", type=int, default=16)
     p.add_argument("--d-ff", type=int, default=4096)
     p.add_argument("--dtype", default="bfloat16")
-    p.add_argument(
+    quant = p.add_mutually_exclusive_group()
+    quant.add_argument(
         "--weights-int8", action="store_true",
         help="also measure with weight-only int8 matmul weights",
+    )
+    quant.add_argument(
+        "--weights-int4", action="store_true",
+        help="also measure with weight-only int4 (group-wise scales)",
     )
     p.add_argument(
         "--record", action="store_true",
@@ -92,6 +97,10 @@ def main(argv=None) -> int:
             from oim_tpu.ops.quant import quantize_params_int8
 
             params = quantize_params_int8(params)
+        elif args.weights_int4:
+            from oim_tpu.ops.quant import quantize_params_int4
+
+            params = quantize_params_int4(params)
         gen = make_generate_fn(cfg)
         for kv_int8 in (False, True):
             out = gen(
@@ -109,6 +118,8 @@ def main(argv=None) -> int:
             kv_label = "int8" if kv_int8 else args.dtype
             if args.weights_int8:
                 label += "+w8"
+            elif args.weights_int4:
+                label += "+w4"
             if elapsed <= rtt:
                 # The tunnel readback swamped the measurement; a negative
                 # dt would print nonsense tok/s.
