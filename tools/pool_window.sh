@@ -59,6 +59,10 @@ run_window() {
     echo "--- stage 4: GQA decode matrix"
     python tools/decode_bench.py --iters 6 --record || true
     commit_evidence "On-chip evidence: GQA decode matrix ($(date -u +%H:%MZ))"
+
+    echo "--- stage 5: int4-weights decode matrix (round-5 lever)"
+    python tools/decode_bench.py --iters 6 --record --weights-int4 || true
+    commit_evidence "On-chip evidence: int4 decode matrix ($(date -u +%H:%MZ))"
     echo "=== window playbook complete: $(date -u) ==="
 }
 
