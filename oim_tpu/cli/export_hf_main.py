@@ -36,6 +36,10 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--d-ff", type=int, default=0)
     p.add_argument("--rope-theta", type=float, default=10000.0)
     p.add_argument(
+        "--attn-bias", action="store_true",
+        help="q/k/v projection biases (Qwen2-family)",
+    )
+    p.add_argument(
         "--rope-scaling", type=float, nargs=4, default=[],
         metavar=("FACTOR", "LOW", "HIGH", "ORIG_MAX"),
     )
@@ -100,6 +104,7 @@ def main(argv=None) -> int:
         n_layers=args.n_layers,
         n_heads=args.n_heads,
         n_kv_heads=args.n_kv_heads,
+        attn_bias=args.attn_bias,
         d_ff=args.d_ff,
         rope_theta=args.rope_theta,
         rope_scaling=tuple(args.rope_scaling),
@@ -112,17 +117,27 @@ def main(argv=None) -> int:
     params = load_params(args.params_dir, template)
     sd = to_hf_llama(params, cfg)
 
-    config = transformers.LlamaConfig(
-        **hf_llama_config_kwargs(
-            cfg, args.max_position_embeddings or None
-        )
+    kwargs = hf_llama_config_kwargs(
+        cfg, args.max_position_embeddings or None
     )
+    if cfg.attn_bias:
+        # qkv-bias-on/o-bias-off is exactly Qwen2's hardwired shape; a
+        # LlamaConfig(attention_bias=True) model would also build an
+        # o_proj bias this framework never carries, so the export MUST
+        # be a Qwen2ForCausalLM (the family the weights came from).
+        kwargs.pop("attention_bias", None)
+        kwargs.pop("mlp_bias", None)
+        config = transformers.Qwen2Config(**kwargs)
+        model_cls = transformers.Qwen2ForCausalLM
+    else:
+        config = transformers.LlamaConfig(**kwargs)
+        model_cls = transformers.LlamaForCausalLM
     # Meta-device construction skips torch's random init and the
     # duplicate full-precision allocation (assign=True adopts our
     # tensors directly) — an 8B export would otherwise pay minutes of
     # normal_() and 2x peak RAM for weights we immediately overwrite.
     with torch.device("meta"):
-        model = transformers.LlamaForCausalLM(config)
+        model = model_cls(config)
     missing, unexpected = model.load_state_dict(
         {k: torch.as_tensor(v) for k, v in sd.items()},
         strict=False, assign=True,

@@ -91,6 +91,7 @@ def main(argv=None) -> int:
             f" --sliding-window {cfg.sliding_window}"
             if cfg.sliding_window else ""
         )
+        + (" --attn-bias" if cfg.attn_bias else "")
     )
     # Carry the model's tokenizer over (a sibling dir — the orbax
     # checkpoint tree must stay exactly what StandardCheckpointer

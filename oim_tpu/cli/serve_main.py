@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--norm-eps", type=float, default=1e-6,
         help="RMSNorm epsilon (imported HF Llama checkpoints use 1e-5)",
     )
+    p.add_argument(
+        "--attn-bias", action="store_true",
+        help="q/k/v projection biases (Qwen2-family imports)",
+    )
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument(
         "--checkpoint-dir", default="",
@@ -213,6 +217,7 @@ def make_engine(args):
         n_layers=args.n_layers,
         n_heads=args.n_heads,
         n_kv_heads=args.n_kv_heads,
+        attn_bias=args.attn_bias,
         d_ff=args.d_ff or 4 * args.d_model,
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,

@@ -95,7 +95,7 @@ def _flat_layer_params(params: dict, cfg: TransformerConfig) -> dict:
     to [n_layers, ...] — decode scans plain layers; pipeline staging is a
     training-throughput construct with no benefit at t=1."""
     layer_names = {"attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
-                   "router", "w_gate", "w_in", "w_out"}
+                   "router", "w_gate", "w_in", "w_out", "bq", "bk", "bv"}
     # Weight-only int8 scale companions (only quantizable names get one).
     layer_names |= {
         f"{n}_wscale" for n in layer_names if n in WEIGHT_QUANT_TARGETS
@@ -147,9 +147,18 @@ def _cached_attention(
     max_len = k_cache.shape[1]
 
     normed = _rmsnorm(x, lp["attn_norm"], cfg)
-    q = jnp.einsum("btd,dn->btn", normed, lp["wq"]).reshape(b, t, h, hd)
-    k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, kvh, hd)
-    v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, kvh, hd)
+    q = jnp.einsum("btd,dn->btn", normed, lp["wq"])
+    k = jnp.einsum("btd,dn->btn", normed, lp["wk"])
+    v = jnp.einsum("btd,dn->btn", normed, lp["wv"])
+    if "bq" in lp:  # Qwen-style qkv biases (cfg.attn_bias)
+        # Cast to the activation dtype: an f32 bias against bf16
+        # activations would promote everything downstream.
+        q = q + lp["bq"].astype(q.dtype)
+        k = k + lp["bk"].astype(k.dtype)
+        v = v + lp["bv"].astype(v.dtype)
+    q = q.reshape(b, t, h, hd)
+    k = k.reshape(b, t, kvh, hd)
+    v = v.reshape(b, t, kvh, hd)
     positions = start + jnp.arange(t)
     q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)

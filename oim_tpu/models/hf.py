@@ -59,8 +59,8 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
         raise ValueError(
             f"unsupported hidden_act {get('hidden_act')!r} (SwiGLU only)"
         )
-    if get("attention_bias", False) or get("mlp_bias", False):
-        raise ValueError("projection biases are not supported")
+    if get("mlp_bias", False):
+        raise ValueError("MLP biases are not supported")
 
     scaling = get("rope_scaling", None)
     rope_scaling = ()
@@ -112,6 +112,14 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
             else 0
         ),
         norm_eps=float(get("rms_norm_eps", 1e-6) or 1e-6),
+        # Qwen2-style q/k/v biases: Qwen2Config carries no
+        # attention_bias attribute (its implementation hardwires qkv
+        # biases on, o bias off), so the model_type decides; Llama-like
+        # configs say it explicitly.  Llama's attention_bias=True also
+        # biases o_proj, which no target family uses — from_hf_llama
+        # rejects such checkpoints loudly.
+        attn_bias=bool(get("attention_bias", False))
+        or get("model_type", "") == "qwen2",
     )
     kwargs.update(overrides)
     return TransformerConfig(**kwargs)
@@ -147,6 +155,17 @@ def _proj(weight, heads: int, head_dim: int, permute: bool) -> np.ndarray:
     return w.reshape(d, heads * head_dim)
 
 
+def _bias(vec, heads: int, head_dim: int, permute: bool) -> np.ndarray:
+    """HF [heads·hd] projection bias → native layout, with the same
+    per-head RoPE coordinate permutation ``_proj`` applies to the
+    weight columns (the bias adds BEFORE rotation, so its coordinates
+    must move with the weight's)."""
+    b = _to_np(vec)
+    if not permute:
+        return b
+    return b.reshape(heads, head_dim)[:, _rope_perm(head_dim)].reshape(-1)
+
+
 def from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
     """Native params pytree from an HF Llama ``state_dict``.
 
@@ -159,9 +178,19 @@ def from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
     if cfg.n_experts:
         raise ValueError("MoE import is not supported (dense Llama only)")
     sd = dict(state_dict)
-    bias = [k for k in sd if k.endswith(".bias")]
+    qkv_bias_names = {"q_proj.bias", "k_proj.bias", "v_proj.bias"}
+    bias = [
+        k for k in sd
+        if k.endswith(".bias")
+        and k.rsplit("self_attn.", 1)[-1] not in qkv_bias_names
+    ]
     if bias:
-        raise ValueError(f"projection biases are not supported: {bias[:3]}")
+        raise ValueError(f"unsupported projection biases: {bias[:3]}")
+    if not cfg.attn_bias and any(k.endswith(".bias") for k in sd):
+        raise ValueError(
+            "checkpoint carries q/k/v biases but cfg.attn_bias is off "
+            "(llama_config reads attention_bias from the HF config)"
+        )
 
     def take(name):
         if name not in sd:
@@ -173,6 +202,8 @@ def from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
         "attn_norm": [], "wq": [], "wk": [], "wv": [], "wo": [],
         "mlp_norm": [], "w_gate": [], "w_in": [], "w_out": [],
     }
+    if cfg.attn_bias:
+        per_layer.update({"bq": [], "bk": [], "bv": []})
     for i in range(cfg.n_layers):
         p = f"model.layers.{i}."
         per_layer["attn_norm"].append(_to_np(take(p + "input_layernorm.weight")))
@@ -185,6 +216,16 @@ def from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
         per_layer["wv"].append(
             _proj(take(p + "self_attn.v_proj.weight"), kvh, hd, False)
         )
+        if cfg.attn_bias:
+            per_layer["bq"].append(
+                _bias(take(p + "self_attn.q_proj.bias"), h, hd, True)
+            )
+            per_layer["bk"].append(
+                _bias(take(p + "self_attn.k_proj.bias"), kvh, hd, True)
+            )
+            per_layer["bv"].append(
+                _bias(take(p + "self_attn.v_proj.bias"), kvh, hd, False)
+            )
         per_layer["wo"].append(_to_np(take(p + "self_attn.o_proj.weight")).T)
         per_layer["mlp_norm"].append(
             _to_np(take(p + "post_attention_layernorm.weight"))
@@ -292,6 +333,15 @@ def to_hf_llama(params: dict, cfg: TransformerConfig) -> dict:
         sd[p + "self_attn.v_proj.weight"] = _inv_proj(
             layer("wv", i), kvh, hd, False
         )
+        if cfg.attn_bias:
+            inv = np.argsort(_rope_perm(hd))
+            bq = np.asarray(layer("bq", i), np.float32).reshape(h, hd)
+            bk = np.asarray(layer("bk", i), np.float32).reshape(kvh, hd)
+            sd[p + "self_attn.q_proj.bias"] = bq[:, inv].reshape(-1)
+            sd[p + "self_attn.k_proj.bias"] = bk[:, inv].reshape(-1)
+            sd[p + "self_attn.v_proj.bias"] = np.asarray(
+                layer("bv", i), np.float32
+            )
         sd[p + "self_attn.o_proj.weight"] = np.asarray(
             layer("wo", i), dtype=np.float32
         ).T
@@ -328,7 +378,7 @@ def hf_llama_config_kwargs(
         rope_theta=cfg.rope_theta,
         rms_norm_eps=cfg.norm_eps,
         tie_word_embeddings=False,
-        attention_bias=False,
+        attention_bias=cfg.attn_bias,
         mlp_bias=False,
     )
     if cfg.rope_scaling:

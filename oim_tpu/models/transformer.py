@@ -60,6 +60,10 @@ class TransformerConfig:
     # bandwidth by n_heads/n_kv_heads; the flash kernel reads grouped K/V
     # natively.
     n_kv_heads: int = 0
+    # Biases on the q/k/v projections (the Qwen2 family; Llama has
+    # none).  o/MLP biases stay unsupported — no target family uses
+    # them.
+    attn_bias: bool = False
     d_ff: int = 0  # 0 → 4 * d_model
     n_experts: int = 0  # 0 → dense SwiGLU
     # Experts chosen per token: 1 = switch routing (gate = router prob,
@@ -246,6 +250,14 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
         "final_norm": jnp.ones((d,), pdt),
         "wlm": dense(next(keys), d, cfg.vocab_size, fan_in=d),
     }
+    if cfg.attn_bias:
+        params.update(
+            {
+                "bq": jnp.zeros((s, l, n), pdt),
+                "bk": jnp.zeros((s, l, kvn), pdt),
+                "bv": jnp.zeros((s, l, kvn), pdt),
+            }
+        )
     if cfg.n_experts:
         e = cfg.n_experts
         params.update(
@@ -280,6 +292,14 @@ def logical_axes(cfg: TransformerConfig) -> dict:
         "final_norm": (None,),
         "wlm": ("model", "vocab"),
     }
+    if cfg.attn_bias:
+        axes.update(
+            {
+                "bq": ("stages", None, "heads"),
+                "bk": ("stages", None, "heads"),
+                "bv": ("stages", None, "heads"),
+            }
+        )
     if cfg.n_experts:
         axes.update(
             {
@@ -335,9 +355,18 @@ def _attention(x, lp, positions, cfg: TransformerConfig, sp_size,
     b, t, d = x.shape
     h, hd, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     normed = _rmsnorm(x, lp["attn_norm"], cfg)
-    q = jnp.einsum("btd,dn->btn", normed, lp["wq"]).reshape(b, t, h, hd)
-    k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, kvh, hd)
-    v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, kvh, hd)
+    q = jnp.einsum("btd,dn->btn", normed, lp["wq"])
+    k = jnp.einsum("btd,dn->btn", normed, lp["wk"])
+    v = jnp.einsum("btd,dn->btn", normed, lp["wv"])
+    if "bq" in lp:  # Qwen-style qkv biases (cfg.attn_bias)
+        # Cast to the activation dtype: an f32 bias against bf16
+        # activations would promote everything downstream.
+        q = q + lp["bq"].astype(q.dtype)
+        k = k + lp["bk"].astype(k.dtype)
+        v = v + lp["bv"].astype(v.dtype)
+    q = q.reshape(b, t, h, hd)
+    k = k.reshape(b, t, kvh, hd)
+    v = v.reshape(b, t, kvh, hd)
     q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
     if segments is not None and segments.shape[0] != b:
@@ -502,7 +531,7 @@ def _stage_layer_params(params: dict, cfg: TransformerConfig) -> dict:
     """This pp-rank's stacked layer weights (leading dim layers_per_stage).
     Under shard_map the ``stages`` dim arrived pre-sliced to size 1."""
     layer_names = {"attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
-                   "router", "w_gate", "w_in", "w_out"}
+                   "router", "w_gate", "w_in", "w_out", "bq", "bk", "bv"}
     return {
         name: value[0]
         for name, value in params.items()

@@ -126,9 +126,14 @@ def serve_param_shardings(params: dict, cfg: TransformerConfig, mesh):
 
     pspecs = param_pspecs(cfg)
 
-    def spec(name):
+    def spec(name, value):
         if name.endswith("_wscale") and name[: -len("_wscale")] in pspecs:
             base = pspecs[name[: -len("_wscale")]]
+            if len(value.shape) == len(base):
+                # int4 group-wise scale: a groups axis replaces the
+                # reduction axis (replicated); the output axis keeps the
+                # weight's sharding.
+                return P(*base[:-2], None, base[-1])
             return P(*base[:-2], base[-1])
         if name not in pspecs and name[-2:] in ("_a", "_b") and (
             name[:-2] in pspecs
@@ -147,7 +152,7 @@ def serve_param_shardings(params: dict, cfg: TransformerConfig, mesh):
         ))
 
     return {
-        name: NamedSharding(mesh, fitted(value, spec(name)))
+        name: NamedSharding(mesh, fitted(value, spec(name, value)))
         for name, value in params.items()
     }
 
@@ -247,9 +252,18 @@ def _slot_attention(
     max_len = k_cache.shape[1]
 
     normed = _rmsnorm(x, lp["attn_norm"], cfg)
-    q = jnp.einsum("btd,dn->btn", normed, lp["wq"]).reshape(b, t, h, hd)
-    k = jnp.einsum("btd,dn->btn", normed, lp["wk"]).reshape(b, t, kvh, hd)
-    v = jnp.einsum("btd,dn->btn", normed, lp["wv"]).reshape(b, t, kvh, hd)
+    q = jnp.einsum("btd,dn->btn", normed, lp["wq"])
+    k = jnp.einsum("btd,dn->btn", normed, lp["wk"])
+    v = jnp.einsum("btd,dn->btn", normed, lp["wv"])
+    if "bq" in lp:  # Qwen-style qkv biases (cfg.attn_bias)
+        # Cast to the activation dtype: an f32 bias against bf16
+        # activations would promote everything downstream.
+        q = q + lp["bq"].astype(q.dtype)
+        k = k + lp["bk"].astype(k.dtype)
+        v = v + lp["bv"].astype(v.dtype)
+    q = q.reshape(b, t, h, hd)
+    k = k.reshape(b, t, kvh, hd)
+    v = v.reshape(b, t, kvh, hd)
     positions = starts[:, None] + jnp.arange(t)  # [B, t] global positions
     q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)

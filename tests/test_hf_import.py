@@ -26,9 +26,10 @@ from oim_tpu.parallel import build_mesh  # noqa: E402
 
 
 def _tiny_hf(vocab=128, d=64, layers=2, heads=4, kv_heads=4, ff=112,
-             tied=False, eps=1e-5, theta=10000.0, seed=0):
+             tied=False, eps=1e-5, theta=10000.0, seed=0,
+             qwen=False):
     torch.manual_seed(seed)
-    config = transformers.LlamaConfig(
+    common = dict(
         vocab_size=vocab,
         hidden_size=d,
         num_hidden_layers=layers,
@@ -38,10 +39,25 @@ def _tiny_hf(vocab=128, d=64, layers=2, heads=4, kv_heads=4, ff=112,
         rms_norm_eps=eps,
         rope_theta=theta,
         tie_word_embeddings=tied,
-        attention_bias=False,
-        mlp_bias=False,
     )
-    model = transformers.LlamaForCausalLM(config)
+    if qwen:
+        # The real qkv-bias family: Qwen2 hardwires q/k/v biases on
+        # (o off) with no attention_bias config attribute.
+        config = transformers.Qwen2Config(**common)
+        model = transformers.Qwen2ForCausalLM(config)
+        # HF initializes projection biases to zero — a zero bias would
+        # vacuously pass any mapping test; randomize them.
+        with torch.no_grad():
+            for layer in model.model.layers:
+                for proj in (layer.self_attn.q_proj,
+                             layer.self_attn.k_proj,
+                             layer.self_attn.v_proj):
+                    proj.bias.normal_(0.0, 0.5)
+    else:
+        config = transformers.LlamaConfig(
+            **common, attention_bias=False, mlp_bias=False
+        )
+        model = transformers.LlamaForCausalLM(config)
     model.eval()
     return model, config
 
@@ -92,6 +108,33 @@ class TestLlamaImportParity:
         the native forward, not be silently defaulted."""
         model, config = _tiny_hf(theta=50000.0, eps=1e-4, seed=3)
         _parity(model, config)
+
+    def test_qwen_style_attention_bias(self):
+        """Qwen2ForCausalLM as the oracle: randomized q/k/v biases must
+        ride the same per-head RoPE permutation as the weights — a bias
+        mapped without it diverges immediately."""
+        model, config = _tiny_hf(kv_heads=2, seed=4, qwen=True)
+        _parity(model, config)
+
+    def test_attention_bias_engine_matches_solo(self):
+        """The bias flows through all three projection sites (train
+        forward, solo decode, serving engine): engine output on imported
+        bias weights == solo generate on the same params."""
+        from oim_tpu.models.decode import generate
+        from oim_tpu.serve import Engine, GenRequest
+
+        model, config = _tiny_hf(kv_heads=2, seed=5, qwen=True)
+        cfg = llama_config(config, dtype="float32", use_pallas=False)
+        assert cfg.attn_bias
+        params = from_hf_llama(model.state_dict(), cfg)
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        want = np.asarray(generate(
+            params, jnp.asarray(prompt, jnp.int32)[None], cfg,
+            max_new_tokens=8,
+        ))[0, len(prompt):].tolist()
+        engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+        rid = engine.submit(GenRequest(tokens=prompt, max_new_tokens=8))
+        assert engine.run()[rid] == want
 
 
 class TestLlamaImportValidation:
@@ -270,17 +313,32 @@ class TestExport:
         from oim_tpu.models import TransformerConfig, init_params
         from oim_tpu.models.hf import from_hf_llama, to_hf_llama
 
-        cfg = TransformerConfig(
-            vocab_size=128, d_model=64, n_layers=2, n_heads=4,
-            n_kv_heads=2, d_ff=112, dtype="float32",
-        )
-        params = init_params(jax.random.PRNGKey(3), cfg)
-        back = from_hf_llama(to_hf_llama(params, cfg), cfg)
-        for name in params:
-            np.testing.assert_array_equal(
-                np.asarray(params[name]), np.asarray(back[name]),
-                err_msg=name,
+        for attn_bias in (False, True):
+            cfg = TransformerConfig(
+                vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, d_ff=112, dtype="float32",
+                attn_bias=attn_bias,
             )
+            params = init_params(jax.random.PRNGKey(3), cfg)
+            if attn_bias:
+                # Zero-init biases would roundtrip vacuously.
+                params = {
+                    name: (
+                        jax.random.normal(
+                            jax.random.PRNGKey(hash(name) % 1000),
+                            value.shape,
+                        )
+                        if name in ("bq", "bk", "bv")
+                        else value
+                    )
+                    for name, value in params.items()
+                }
+            back = from_hf_llama(to_hf_llama(params, cfg), cfg)
+            for name in params:
+                np.testing.assert_array_equal(
+                    np.asarray(params[name]), np.asarray(back[name]),
+                    err_msg=name,
+                )
 
     def test_exported_model_matches_native_logits(self):
         """transformers' forward on the exported weights == the native
@@ -310,9 +368,13 @@ class TestExport:
         got = _native_logits(params, tokens, cfg)
         np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-4)
 
-    def test_export_cli_roundtrip(self, tmp_path):
+    @pytest.mark.parametrize("attn_bias", [False, True],
+                             ids=["llama", "qwen"])
+    def test_export_cli_roundtrip(self, tmp_path, attn_bias):
         """orbax params export → oim-export-hf → from_pretrained →
-        oim-import-hf → params equal."""
+        oim-import-hf → params equal.  attn_bias models must export as
+        Qwen2ForCausalLM (qkv-bias-on/o-bias-off is Qwen2's shape; a
+        Llama config cannot represent it)."""
         import orbax.checkpoint as ocp
 
         from oim_tpu.cli.export_hf_main import main as export_main
@@ -322,18 +384,33 @@ class TestExport:
 
         cfg = TransformerConfig(
             vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=112,
-            dtype="float32",
+            dtype="float32", attn_bias=attn_bias,
         )
         params = init_params(jax.random.PRNGKey(5), cfg)
+        if attn_bias:
+            params = {
+                name: (
+                    jax.random.normal(jax.random.PRNGKey(i), value.shape)
+                    if name in ("bq", "bk", "bv")
+                    else value
+                )
+                for i, (name, value) in enumerate(params.items())
+            }
         native1 = tmp_path / "native1"
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(native1, params)
         flags = ["--vocab-size", "128", "--d-model", "64", "--n-layers",
                  "2", "--n-heads", "4", "--d-ff", "112"]
+        if attn_bias:
+            flags.append("--attn-bias")
         hf_dir, native2 = tmp_path / "hf", tmp_path / "native2"
         assert export_main(
             ["--params-dir", str(native1), "--out-dir", str(hf_dir), *flags]
         ) == 0
+        loaded = transformers.AutoModelForCausalLM.from_pretrained(hf_dir)
+        assert type(loaded).__name__ == (
+            "Qwen2ForCausalLM" if attn_bias else "LlamaForCausalLM"
+        )
         assert import_main(
             ["--hf-dir", str(hf_dir), "--out-dir", str(native2),
              "--param-dtype", "float32"]
