@@ -968,10 +968,16 @@ def _serve_diagnostics(extras, on_tpu, cfg, params) -> None:
         # admissions batch into one dispatch per bucket with a single
         # combined readback — plus one per decode chunk); subtracting
         # them isolates device throughput from the tunnel.
-        steps = engine.stats()["steps"] - steps_before
-        readbacks = engine.stats()["readbacks"] - readbacks_before
+        st = engine.stats()  # one snapshot: consistent deltas
+        steps = st["steps"] - steps_before
+        readbacks = st["readbacks"] - readbacks_before
         rtt_s = extras.get("tunnel_rtt_ms", 0.0) / 1000.0
         adjusted = dt - readbacks * rtt_s
+        # Swing forensics: the wall split between host-side step() work
+        # and device/tunnel waits (engine-accumulated).  A slow run with
+        # fat host_s and flat readback_s convicts host contention.
+        extras["serve_host_s"] = st["host_seconds"]
+        extras["serve_readback_s"] = st["readback_seconds"]
         extras["serve_tok_per_s"] = round(generated / dt)
         if adjusted > 0:
             # Guard against rtt drift past the once-measured value: a

@@ -1153,6 +1153,14 @@ class Engine:
         # Host↔device readbacks (the tunnel-cost unit benchmarks account
         # in): one per admission wave, one per decode chunk.
         self.readbacks = 0
+        # Swing forensics (BASELINE 665↔1112 tok/s, host-contention
+        # hypothesis): wall time inside device_get readbacks vs the
+        # rest of step() (host-side array building, queue bookkeeping,
+        # emission).  A slow run with flat readback_seconds and fat
+        # host_seconds is host contention; the reverse is the chip/
+        # tunnel.  Accumulated per engine, exported via stats().
+        self.host_seconds = 0.0
+        self.readback_seconds = 0.0
         self._lock = threading.Lock()
         self._queue: list[tuple[int, GenRequest, float]] = []
         self._slots: dict[int, _SlotState] = {}  # slot index → state
@@ -1635,6 +1643,8 @@ class Engine:
                 "spec_drafted": self.spec_drafted,
                 "spec_accepted": self.spec_accepted,
                 "readbacks": self.readbacks,
+                "host_seconds": round(self.host_seconds, 4),
+                "readback_seconds": round(self.readback_seconds, 4),
             }
 
     def _bucket(self, n: int) -> int:
@@ -1719,7 +1729,33 @@ class Engine:
             while len(self._prefix_cache) > self.prefix_cache_size:
                 self._prefix_cache.popitem(last=False)
 
+    @staticmethod
+    def _fetch(tree, acc: list):
+        """jax.device_get with the wait attributed to the caller's
+        readback accumulator (device execution + tunnel rtt);
+        everything else in step() is host time.  The split adjudicates
+        the serving swing.  ``acc`` is step()'s PER-CALL accumulator —
+        local state, so a second concurrent step() cannot corrupt the
+        attribution."""
+        t0 = time.monotonic()
+        out = jax.device_get(tree)
+        acc[0] += time.monotonic() - t0
+        return out
+
     def step(self) -> None:
+        """Admit whatever fits, then decode one chunk for active slots
+        (the full contract is on ``_step_inner``), accumulating the
+        host-vs-readback wall split for the swing forensics."""
+        t0 = time.monotonic()
+        acc = [0.0]
+        try:
+            self._step_inner(acc)
+        finally:
+            if not self._warming:
+                self.readback_seconds += acc[0]
+                self.host_seconds += time.monotonic() - t0 - acc[0]
+
+    def _step_inner(self, acc: list) -> None:
         """Admit whatever fits, then decode one chunk for active slots.
 
         Admissions are BATCHED: one prefill dispatch per distinct prompt
@@ -1855,7 +1891,7 @@ class Engine:
                 if req.cache_prefix and self.prefix_cache_size:
                     self._store_prefix(slot, req.tokens)
             # ONE combined readback for every admission this step.
-            fetched = jax.device_get([(f, lp) for _, f, lp in groups])
+            fetched = self._fetch([(f, lp) for _, f, lp in groups], acc)
             if not self._warming:
                 self.readbacks += 1
             notices = []
@@ -1949,7 +1985,7 @@ class Engine:
                 self._draft_cache, tokens, temps, top_ps, min_ps, active,
                 bases, counts,
             )
-            out3, lps3, n_emit = jax.device_get((out3, lps3, n_emit))
+            out3, lps3, n_emit = self._fetch((out3, lps3, n_emit), acc)
             if not self._warming:
                 self.readbacks += 1
         elif self.spec_decode:
@@ -1960,7 +1996,7 @@ class Engine:
                 top_ps, min_ps, active, bases, counts,
             )
             # ONE readback per chunk, speculative or not.
-            out3, lps3, n_emit = jax.device_get((out3, lps3, n_emit))
+            out3, lps3, n_emit = self._fetch((out3, lps3, n_emit), acc)
             if not self._warming:
                 self.readbacks += 1
         else:
@@ -1992,7 +2028,7 @@ class Engine:
                 self._gen_counts, tokens, temps, top_ps, min_ps,
                 reps, press, freqs, active, bases, counts,
             )
-            out, lps = jax.device_get((out, lps))
+            out, lps = self._fetch((out, lps), acc)
             if not self._warming:
                 self.readbacks += 1
             out3, lps3 = out[:, :, None], lps[:, :, None]
