@@ -34,6 +34,8 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--n-heads", type=int, required=True)
     p.add_argument("--n-kv-heads", type=int, default=0)
     p.add_argument("--d-ff", type=int, default=0)
+    p.add_argument("--n-experts", type=int, default=0)
+    p.add_argument("--moe-top-k", type=int, default=1)
     p.add_argument("--rope-theta", type=float, default=10000.0)
     p.add_argument(
         "--attn-bias", action="store_true",
@@ -104,6 +106,8 @@ def main(argv=None) -> int:
         n_layers=args.n_layers,
         n_heads=args.n_heads,
         n_kv_heads=args.n_kv_heads,
+        n_experts=args.n_experts,
+        moe_top_k=args.moe_top_k,
         attn_bias=args.attn_bias,
         d_ff=args.d_ff,
         rope_theta=args.rope_theta,
@@ -120,7 +124,12 @@ def main(argv=None) -> int:
     kwargs = hf_llama_config_kwargs(
         cfg, args.max_position_embeddings or None
     )
-    if cfg.attn_bias:
+    if cfg.n_experts:
+        # Native MoE == Mixtral's block-sparse layout (renormalized
+        # top-k gates, SwiGLU experts) — export as the family itself.
+        config = transformers.MixtralConfig(**kwargs)
+        model_cls = transformers.MixtralForCausalLM
+    elif cfg.attn_bias:
         # qkv-bias-on/o-bias-off is exactly Qwen2's hardwired shape; a
         # LlamaConfig(attention_bias=True) model would also build an
         # o_proj bias this framework never carries, so the export MUST

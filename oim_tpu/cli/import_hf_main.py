@@ -92,6 +92,10 @@ def main(argv=None) -> int:
             if cfg.sliding_window else ""
         )
         + (" --attn-bias" if cfg.attn_bias else "")
+        + (
+            f" --n-experts {cfg.n_experts} --moe-top-k {cfg.moe_top_k}"
+            if cfg.n_experts else ""
+        )
     )
     # Carry the model's tokenizer over (a sibling dir — the orbax
     # checkpoint tree must stay exactly what StandardCheckpointer

@@ -1,7 +1,8 @@
 """HF Llama-family checkpoint import: external weights, native layout.
 
 The flagship transformer is architecture-compatible with the Llama
-family — including Mistral-style sliding-window variants
+family — including Mistral-style sliding-window variants, Qwen2's
+q/k/v projection biases, and Mixtral's block-sparse MoE
 (RMSNorm, RoPE, SwiGLU, GQA, untied or tied unembed), so a user
 can bring real open weights instead of training from scratch — the
 interchange surface the reference left to its storage backends
@@ -91,6 +92,19 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
         raise ValueError(
             f"head_dim {explicit_hd} != hidden_size/heads {d // h}"
         )
+    # Mixtral: block-sparse MoE layers.  The native drop-free top-k
+    # inference routing IS Mixtral's rule (softmax over all router
+    # logits, keep top-k, renormalize — transformer._router_gates k>=2).
+    n_experts = int(get("num_local_experts", 0) or 0)
+    moe_top_k = int(get("num_experts_per_tok", 1) or 1)
+    if n_experts and moe_top_k < 2:
+        # The native k=1 gate is the switch rule (raw router prob);
+        # HF Mixtral renormalizes over the chosen experts (gate 1.0 at
+        # k=1) — importing would silently scale every MoE layer wrong.
+        raise ValueError(
+            f"Mixtral import needs num_experts_per_tok >= 2 "
+            f"(renormalized-gate rule); got {moe_top_k}"
+        )
     kwargs = dict(
         vocab_size=int(get("vocab_size")),
         d_model=d,
@@ -112,6 +126,8 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
             else 0
         ),
         norm_eps=float(get("rms_norm_eps", 1e-6) or 1e-6),
+        n_experts=n_experts,
+        moe_top_k=moe_top_k if n_experts else 1,
         # Qwen2-style q/k/v biases: Qwen2Config carries no
         # attention_bias attribute (its implementation hardwires qkv
         # biases on, o bias off), so the model_type decides; Llama-like
@@ -172,11 +188,12 @@ def from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
     ``state_dict`` maps HF parameter names to array-likes (torch tensors
     straight from ``model.state_dict()``, numpy arrays, or anything
     ``np.asarray`` accepts).  Tied embeddings (no ``lm_head.weight``)
-    reuse the token embedding transposed.  Raises KeyError naming the
-    first missing tensor and ValueError on shape mismatches.
+    reuse the token embedding transposed.  ``cfg.n_experts > 0`` reads
+    the Mixtral layout (``block_sparse_moe.gate`` + per-expert
+    ``w1``/``w2``/``w3`` SwiGLU experts) into the native stacked MoE
+    weights.  Raises KeyError naming the first missing tensor and
+    ValueError on shape mismatches.
     """
-    if cfg.n_experts:
-        raise ValueError("MoE import is not supported (dense Llama only)")
     sd = dict(state_dict)
     qkv_bias_names = {"q_proj.bias", "k_proj.bias", "v_proj.bias"}
     bias = [
@@ -204,6 +221,8 @@ def from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
     }
     if cfg.attn_bias:
         per_layer.update({"bq": [], "bk": [], "bv": []})
+    if cfg.n_experts:
+        per_layer["router"] = []
     for i in range(cfg.n_layers):
         p = f"model.layers.{i}."
         per_layer["attn_norm"].append(_to_np(take(p + "input_layernorm.weight")))
@@ -230,9 +249,32 @@ def from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
         per_layer["mlp_norm"].append(
             _to_np(take(p + "post_attention_layernorm.weight"))
         )
-        per_layer["w_gate"].append(_to_np(take(p + "mlp.gate_proj.weight")).T)
-        per_layer["w_in"].append(_to_np(take(p + "mlp.up_proj.weight")).T)
-        per_layer["w_out"].append(_to_np(take(p + "mlp.down_proj.weight")).T)
+        if cfg.n_experts:
+            # Mixtral experts are SwiGLU with w1=gate, w3=up, w2=down;
+            # stacked over the expert axis for the native layout.
+            per_layer["router"].append(
+                _to_np(take(p + "block_sparse_moe.gate.weight")).T
+            )
+            per_layer["w_gate"].append(np.stack([
+                _to_np(take(p + f"block_sparse_moe.experts.{e}.w1.weight")).T
+                for e in range(cfg.n_experts)
+            ]))
+            per_layer["w_in"].append(np.stack([
+                _to_np(take(p + f"block_sparse_moe.experts.{e}.w3.weight")).T
+                for e in range(cfg.n_experts)
+            ]))
+            per_layer["w_out"].append(np.stack([
+                _to_np(take(p + f"block_sparse_moe.experts.{e}.w2.weight")).T
+                for e in range(cfg.n_experts)
+            ]))
+        else:
+            per_layer["w_gate"].append(
+                _to_np(take(p + "mlp.gate_proj.weight")).T
+            )
+            per_layer["w_in"].append(_to_np(take(p + "mlp.up_proj.weight")).T)
+            per_layer["w_out"].append(
+                _to_np(take(p + "mlp.down_proj.weight")).T
+            )
 
     wte = _to_np(take("model.embed_tokens.weight"))
     wlm = (
@@ -264,8 +306,14 @@ def from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
         "wq": (s, l, cfg.d_model, h * hd),
         "wk": (s, l, cfg.d_model, kvh * hd),
         "wlm": (cfg.d_model, cfg.vocab_size),
-        "w_gate": (s, l, cfg.d_model, cfg.ff_dim),
+        "w_gate": (
+            (s, l, cfg.n_experts, cfg.d_model, cfg.ff_dim)
+            if cfg.n_experts
+            else (s, l, cfg.d_model, cfg.ff_dim)
+        ),
     }
+    if cfg.n_experts:
+        expect["router"] = (s, l, cfg.d_model, cfg.n_experts)
     for name, shape in expect.items():
         if params[name].shape != shape:
             raise ValueError(
@@ -292,11 +340,25 @@ def to_hf_llama(params: dict, cfg: TransformerConfig) -> dict:
     transpose back to [out, in], the interleaved-RoPE q/k column
     permutation inverts, and the [n_stages, layers_per_stage, ...]
     stacking flattens to per-layer tensors.  Always exports an untied
-    ``lm_head``; MoE models are rejected (no HF Llama analog).
+    ``lm_head``.  MoE models (k >= 2) export in the Mixtral
+    block-sparse layout; switch-routed (k=1) models are rejected —
+    their raw-prob gate has no HF analog.
     Roundtrip and logit parity are pinned by tests/test_hf_import.py.
     """
-    if cfg.n_experts:
-        raise ValueError("MoE export is not supported (dense Llama only)")
+    if cfg.n_experts and cfg.attn_bias:
+        # Mixtral's layout has no projection biases; a Qwen2-MoE-style
+        # geometry has no exportable HF analog here.
+        raise ValueError(
+            "MoE export with attn_bias has no HF Mixtral analog"
+        )
+    if cfg.n_experts and cfg.moe_top_k < 2:
+        # Mixtral's layout requires the renormalized-top-k rule shared
+        # with _router_gates k>=2; a switch-routed (k=1) model has no
+        # HF analog with matching numerics.
+        raise ValueError(
+            "MoE export needs moe_top_k >= 2 (Mixtral layout); "
+            f"got {cfg.moe_top_k}"
+        )
     if cfg.sliding_window:
         # Mirror of the import guard: the exported config would claim
         # full attention over windowed-trained weights.
@@ -348,15 +410,31 @@ def to_hf_llama(params: dict, cfg: TransformerConfig) -> dict:
         sd[p + "post_attention_layernorm.weight"] = np.asarray(
             layer("mlp_norm", i), dtype=np.float32
         )
-        sd[p + "mlp.gate_proj.weight"] = np.asarray(
-            layer("w_gate", i), dtype=np.float32
-        ).T
-        sd[p + "mlp.up_proj.weight"] = np.asarray(
-            layer("w_in", i), dtype=np.float32
-        ).T
-        sd[p + "mlp.down_proj.weight"] = np.asarray(
-            layer("w_out", i), dtype=np.float32
-        ).T
+        if cfg.n_experts:
+            sd[p + "block_sparse_moe.gate.weight"] = np.asarray(
+                layer("router", i), dtype=np.float32
+            ).T
+            for e in range(cfg.n_experts):
+                q = f"{p}block_sparse_moe.experts.{e}."
+                sd[q + "w1.weight"] = np.asarray(
+                    layer("w_gate", i)[e], dtype=np.float32
+                ).T
+                sd[q + "w3.weight"] = np.asarray(
+                    layer("w_in", i)[e], dtype=np.float32
+                ).T
+                sd[q + "w2.weight"] = np.asarray(
+                    layer("w_out", i)[e], dtype=np.float32
+                ).T
+        else:
+            sd[p + "mlp.gate_proj.weight"] = np.asarray(
+                layer("w_gate", i), dtype=np.float32
+            ).T
+            sd[p + "mlp.up_proj.weight"] = np.asarray(
+                layer("w_in", i), dtype=np.float32
+            ).T
+            sd[p + "mlp.down_proj.weight"] = np.asarray(
+                layer("w_out", i), dtype=np.float32
+            ).T
     return sd
 
 
@@ -381,6 +459,13 @@ def hf_llama_config_kwargs(
         attention_bias=cfg.attn_bias,
         mlp_bias=False,
     )
+    if cfg.n_experts:
+        # Mixtral keys; the consumer (oim-export-hf) builds a
+        # MixtralConfig, whose ctor takes neither bias flag.
+        kwargs.pop("attention_bias")
+        kwargs.pop("mlp_bias")
+        kwargs["num_local_experts"] = cfg.n_experts
+        kwargs["num_experts_per_tok"] = cfg.moe_top_k
     if cfg.rope_scaling:
         factor, low, high, orig = cfg.rope_scaling
         kwargs["rope_scaling"] = {

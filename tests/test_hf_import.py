@@ -116,6 +116,74 @@ class TestLlamaImportParity:
         model, config = _tiny_hf(kv_heads=2, seed=4, qwen=True)
         _parity(model, config)
 
+    def test_mixtral_moe_parity(self):
+        """MixtralForCausalLM as the oracle for the MoE path: the native
+        drop-free top-k routing (softmax over all router logits, keep
+        top-k, renormalize) must reproduce HF's block-sparse forward on
+        the same weights — router transpose, expert w1/w3/w2 mapping,
+        and gate normalization all on the line."""
+        torch.manual_seed(6)
+        config = transformers.MixtralConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=48, num_local_experts=4,
+            num_experts_per_tok=2, rms_norm_eps=1e-5,
+            tie_word_embeddings=False,
+        )
+        model = transformers.MixtralForCausalLM(config)
+        model.eval()
+        cfg = llama_config(config, dtype="float32", use_pallas=False)
+        assert cfg.n_experts == 4 and cfg.moe_top_k == 2
+        # HF Mixtral inference routes DROP-FREE; the native analog is
+        # the inference path (_moe_exact via prefill), not the train
+        # forward whose capacity routing legitimately drops overflow.
+        from oim_tpu.models.decode import prefill
+
+        params = from_hf_llama(model.state_dict(), cfg)
+        tokens = np.arange(2 * 16).reshape(2, 16) % config.vocab_size
+        with torch.no_grad():
+            want = model(torch.as_tensor(tokens)).logits.float().numpy()
+        logits, _ = prefill(
+            params, jnp.asarray(tokens, jnp.int32), cfg, max_len=16
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits, np.float32), want, atol=5e-4, rtol=1e-4
+        )
+        # And the train forward matches too once capacity is drop-free
+        # (factor 8 ≈ no overflow at this size) — the two native paths
+        # agree with each other and with HF.
+        from dataclasses import replace as dc_replace
+
+        cfg_nodrop = dc_replace(cfg, expert_capacity_factor=8.0)
+        got = _native_logits(params, tokens, cfg_nodrop)
+        np.testing.assert_allclose(got, want, atol=5e-4, rtol=1e-4)
+
+    def test_mixtral_engine_matches_solo(self):
+        """Imported Mixtral weights through the serving engine == solo
+        generate (the _moe_exact per-token routing on both paths)."""
+        from oim_tpu.models.decode import generate
+        from oim_tpu.serve import Engine, GenRequest
+
+        torch.manual_seed(7)
+        config = transformers.MixtralConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=48, num_local_experts=4,
+            num_experts_per_tok=2, rms_norm_eps=1e-5,
+            tie_word_embeddings=False,
+        )
+        model = transformers.MixtralForCausalLM(config)
+        cfg = llama_config(config, dtype="float32", use_pallas=False)
+        params = from_hf_llama(model.state_dict(), cfg)
+        prompt = [3, 1, 4, 1, 5, 9]
+        want = np.asarray(generate(
+            params, jnp.asarray(prompt, jnp.int32)[None], cfg,
+            max_new_tokens=8,
+        ))[0, len(prompt):].tolist()
+        engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+        rid = engine.submit(GenRequest(tokens=prompt, max_new_tokens=8))
+        assert engine.run()[rid] == want
+
     def test_attention_bias_engine_matches_solo(self):
         """The bias flows through all three projection sites (train
         forward, solo decode, serving engine): engine output on imported
@@ -368,13 +436,21 @@ class TestExport:
         got = _native_logits(params, tokens, cfg)
         np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-4)
 
-    @pytest.mark.parametrize("attn_bias", [False, True],
-                             ids=["llama", "qwen"])
-    def test_export_cli_roundtrip(self, tmp_path, attn_bias):
+    @pytest.mark.parametrize(
+        "attn_bias,n_experts,hf_cls",
+        [
+            (False, 0, "LlamaForCausalLM"),
+            (True, 0, "Qwen2ForCausalLM"),
+            (False, 4, "MixtralForCausalLM"),
+        ],
+        ids=["llama", "qwen", "mixtral"],
+    )
+    def test_export_cli_roundtrip(self, tmp_path, attn_bias, n_experts,
+                                  hf_cls):
         """orbax params export → oim-export-hf → from_pretrained →
-        oim-import-hf → params equal.  attn_bias models must export as
-        Qwen2ForCausalLM (qkv-bias-on/o-bias-off is Qwen2's shape; a
-        Llama config cannot represent it)."""
+        oim-import-hf → params equal.  The export picks the HF family
+        the geometry belongs to: attn_bias → Qwen2 (qkv-on/o-off bias
+        is its hardwired shape), MoE → Mixtral (block-sparse layout)."""
         import orbax.checkpoint as ocp
 
         from oim_tpu.cli.export_hf_main import main as export_main
@@ -384,7 +460,8 @@ class TestExport:
 
         cfg = TransformerConfig(
             vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=112,
-            dtype="float32", attn_bias=attn_bias,
+            dtype="float32", attn_bias=attn_bias, n_experts=n_experts,
+            moe_top_k=2 if n_experts else 1,
         )
         params = init_params(jax.random.PRNGKey(5), cfg)
         if attn_bias:
@@ -403,14 +480,14 @@ class TestExport:
                  "2", "--n-heads", "4", "--d-ff", "112"]
         if attn_bias:
             flags.append("--attn-bias")
+        if n_experts:
+            flags += ["--n-experts", str(n_experts), "--moe-top-k", "2"]
         hf_dir, native2 = tmp_path / "hf", tmp_path / "native2"
         assert export_main(
             ["--params-dir", str(native1), "--out-dir", str(hf_dir), *flags]
         ) == 0
         loaded = transformers.AutoModelForCausalLM.from_pretrained(hf_dir)
-        assert type(loaded).__name__ == (
-            "Qwen2ForCausalLM" if attn_bias else "LlamaForCausalLM"
-        )
+        assert type(loaded).__name__ == hf_cls
         assert import_main(
             ["--hf-dir", str(hf_dir), "--out-dir", str(native2),
              "--param-dtype", "float32"]
