@@ -92,6 +92,9 @@ def main(argv=None) -> int:
             if cfg.sliding_window else ""
         )
         + (" --attn-bias" if cfg.attn_bias else "")
+        + (f" --mlp-act {cfg.mlp_act}" if cfg.mlp_act != "silu" else "")
+        + (" --norm-offset" if cfg.norm_offset else "")
+        + (" --embed-scale" if cfg.embed_scale else "")
         + (
             f" --n-experts {cfg.n_experts} --moe-top-k {cfg.moe_top_k}"
             if cfg.n_experts else ""

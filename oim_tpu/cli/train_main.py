@@ -82,6 +82,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--attn-bias", action="store_true",
         help="q/k/v projection biases (Qwen2-family imports)",
     )
+    p.add_argument(
+        "--mlp-act", default="silu", choices=["silu", "gelu_tanh"],
+        help="MLP gate activation (gelu_tanh = Gemma GeGLU)",
+    )
+    p.add_argument(
+        "--norm-offset", action="store_true",
+        help="RMSNorm scales by (1 + weight) (Gemma family)",
+    )
+    p.add_argument(
+        "--embed-scale", action="store_true",
+        help="scale embeddings by sqrt(d_model) (Gemma family)",
+    )
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--attn-impl", default="ring", choices=["ring", "ulysses"])
     p.add_argument("--pp-schedule", default="gpipe", choices=["gpipe", "1f1b"])
@@ -254,6 +266,9 @@ def main(argv=None) -> int:
         n_heads=args.n_heads,
         n_kv_heads=args.n_kv_heads,
         attn_bias=args.attn_bias,
+        mlp_act=args.mlp_act,
+        norm_offset=args.norm_offset,
+        embed_scale=args.embed_scale,
         d_ff=args.d_ff,
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from oim_tpu.models.transformer import (
     TransformerConfig,
     _dense_mlp,
+    _mlp_act,
+    embed_lookup,
     _rmsnorm,
     _router_gates,
     _unembed,
@@ -222,7 +224,9 @@ def _moe_exact(x, lp, cfg: TransformerConfig):
     assign = jax.nn.one_hot(top_idx, cfg.n_experts)  # [G, K, E]
     weights = jnp.einsum("gke,gk->ge", assign, gates)
     normed_f = normed.astype(jnp.float32)
-    up_gate = jax.nn.silu(jnp.einsum("gd,edf->gef", normed_f, lp["w_gate"]))
+    up_gate = _mlp_act(
+        jnp.einsum("gd,edf->gef", normed_f, lp["w_gate"]), cfg
+    )
     up = jnp.einsum("gd,edf->gef", normed_f, lp["w_in"])
     expert_out = jnp.einsum("gef,efd->ged", up_gate * up, lp["w_out"])
     out = jnp.einsum("ged,ge->gd", expert_out, weights)
@@ -258,8 +262,7 @@ def _hidden_cached(
                 f"cache overflow: length {int(cache.length)} + "
                 f"{tokens.shape[1]} new tokens > max_len {cache.max_len}"
             )
-    dt = cfg.compute_dtype
-    x = params["wte"].astype(dt)[tokens]
+    x = embed_lookup(params["wte"], tokens, cfg)
     start = cache.length
     flat = _flat_layer_params(params, cfg)
 

@@ -56,10 +56,30 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
         hf_config.get if isinstance(hf_config, dict)
         else lambda k, d=None: getattr(hf_config, k, d)
     )
-    if (get("hidden_act", "silu") or "silu") not in ("silu", "swish"):
+    # Gemma (v1) differs from the Llama family in three numerics —
+    # GeGLU (tanh gelu), (1 + weight) RMSNorm, sqrt(d) embedding scale —
+    # all carried as config flags so the one forward serves both.
+    model_type = get("model_type", "") or ""
+    gemma = model_type == "gemma"
+    if model_type.startswith("gemma") and not gemma:
+        # Gemma 2/3 add pre/post-FFN norms and logit soft-capping this
+        # forward does not model; importing would silently produce wrong
+        # logits (their act check alone would pass).
         raise ValueError(
-            f"unsupported hidden_act {get('hidden_act')!r} (SwiGLU only)"
+            f"unsupported model_type {model_type!r} (gemma v1 only)"
         )
+    act = get("hidden_act", "silu") or "silu"
+    if get("hidden_activation", None):  # GemmaConfig's preferred field
+        act = get("hidden_activation")
+    if act in ("silu", "swish"):
+        mlp_act = "silu"
+    elif act == "gelu_pytorch_tanh" or (act == "gelu" and gemma):
+        # HF Gemma's historical "gelu" configs are RUN as tanh-gelu by
+        # transformers (the well-known config mislabel) — Gemma only; a
+        # non-Gemma "gelu" really is erf-gelu there and stays rejected.
+        mlp_act = "gelu_tanh"
+    else:
+        raise ValueError(f"unsupported hidden_act {act!r}")
     if get("mlp_bias", False):
         raise ValueError("MLP biases are not supported")
 
@@ -128,6 +148,9 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
         norm_eps=float(get("rms_norm_eps", 1e-6) or 1e-6),
         n_experts=n_experts,
         moe_top_k=moe_top_k if n_experts else 1,
+        mlp_act=mlp_act,
+        norm_offset=gemma,
+        embed_scale=gemma,
         # Qwen2-style q/k/v biases: Qwen2Config carries no
         # attention_bias attribute (its implementation hardwires qkv
         # biases on, o bias off), so the model_type decides; Llama-like
@@ -345,6 +368,14 @@ def to_hf_llama(params: dict, cfg: TransformerConfig) -> dict:
     their raw-prob gate has no HF analog.
     Roundtrip and logit parity are pinned by tests/test_hf_import.py.
     """
+    if cfg.norm_offset or cfg.embed_scale or cfg.mlp_act != "silu":
+        # Gemma-numerics models import and serve, but the export side
+        # (always-untied lm_head here vs Gemma's always-tied) is not
+        # wired — reject loudly rather than write a checkpoint
+        # transformers would misload.
+        raise ValueError(
+            "Gemma-family export is not supported (import/serve only)"
+        )
     if cfg.n_experts and cfg.attn_bias:
         # Mixtral's layout has no projection biases; a Qwen2-MoE-style
         # geometry has no exportable HF analog here.

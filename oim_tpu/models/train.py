@@ -335,9 +335,10 @@ def _build_value_and_grad(cfg: TransformerConfig, mesh):
         c_global = float(b * dp_size * (t_local * sp_size - 1))
 
         def embed(wte):
-            return (
-                wte.astype(dt)[tokens]
-                .reshape(n_micro, mb, t_local, cfg.d_model)
+            from oim_tpu.models.transformer import embed_lookup
+
+            return embed_lookup(wte, tokens, cfg).reshape(
+                n_micro, mb, t_local, cfg.d_model
             )
 
         x_micro, embed_vjp = jax.vjp(embed, params["wte"])

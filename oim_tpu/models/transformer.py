@@ -64,6 +64,13 @@ class TransformerConfig:
     # none).  o/MLP biases stay unsupported — no target family uses
     # them.
     attn_bias: bool = False
+    # MLP gate activation: "silu" (Llama/Mistral/Qwen/Mixtral) or
+    # "gelu_tanh" (Gemma's GeGLU — torch's tanh-approximated gelu).
+    mlp_act: str = "silu"
+    # Gemma-family numerics: RMSNorm scales by (1 + weight) and the
+    # token embedding is multiplied by sqrt(d_model) after lookup.
+    norm_offset: bool = False
+    embed_scale: bool = False
     d_ff: int = 0  # 0 → 4 * d_model
     n_experts: int = 0  # 0 → dense SwiGLU
     # Experts chosen per token: 1 = switch routing (gate = router prob,
@@ -141,6 +148,11 @@ class TransformerConfig:
     doc_sep_id: int = -1
 
     def __post_init__(self):
+        if self.mlp_act not in ("silu", "gelu_tanh"):
+            raise ValueError(
+                f"unknown mlp_act {self.mlp_act!r}; "
+                "expected 'silu' or 'gelu_tanh'"
+            )
         if self.attn_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"unknown attn_impl {self.attn_impl!r}; "
@@ -345,9 +357,35 @@ def manual_pspecs(cfg: TransformerConfig) -> dict:
 
 
 def _rmsnorm(x, w, cfg: TransformerConfig):
+    if cfg.norm_offset:
+        # Gemma convention: the learned scale is a residual around 1 —
+        # formed and KEPT in f32 (both norm impls compute in f32; a
+        # round back to bf16 would shave the learned scale's precision
+        # where HF's GemmaRMSNorm keeps it).
+        w = 1.0 + w.astype(jnp.float32)
     if cfg.use_pallas:
         return rmsnorm(x, w, cfg.norm_eps)
     return reference_rmsnorm(x, w, cfg.norm_eps)
+
+
+def embed_lookup(wte, tokens, cfg: TransformerConfig):
+    """THE token-embedding lookup (train, solo decode, and the serving
+    engine all route here so Gemma's sqrt(d_model) scale cannot be
+    applied in some paths and missed in others — the scale rounds
+    through the compute dtype, matching HF)."""
+    dt = cfg.compute_dtype
+    x = wte.astype(dt)[tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), dt)
+    return x
+
+
+def _mlp_act(x, cfg: TransformerConfig):
+    """The gate activation: silu (Llama family) or Gemma's GeGLU
+    (torch gelu(approximate="tanh"))."""
+    if cfg.mlp_act == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
 
 
 def _attention(x, lp, positions, cfg: TransformerConfig, sp_size,
@@ -406,7 +444,7 @@ def _attention(x, lp, positions, cfg: TransformerConfig, sp_size,
 
 def _dense_mlp(x, lp, cfg: TransformerConfig):
     normed = _rmsnorm(x, lp["mlp_norm"], cfg)
-    gate = jax.nn.silu(jnp.einsum("btd,df->btf", normed, lp["w_gate"]))
+    gate = _mlp_act(jnp.einsum("btd,df->btf", normed, lp["w_gate"]), cfg)
     up = jnp.einsum("btd,df->btf", normed, lp["w_in"])
     down = jnp.einsum("btf,fd->btd", gate * up, lp["w_out"])
     return x + down.astype(x.dtype), jnp.zeros((), jnp.float32)
@@ -480,7 +518,9 @@ def _switch_moe(x, lp, cfg: TransformerConfig):
     dispatch, combine = _capacity_dispatch(top_idx, gates, e, capacity)
 
     expert_in = jnp.einsum("gec,gd->ecd", dispatch, normed.astype(jnp.float32))
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"]))
+    gate = _mlp_act(
+        jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"]), cfg
+    )
     up = jnp.einsum("ecd,edf->ecf", expert_in, lp["w_in"])
     expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["w_out"])
     out = jnp.einsum("gec,ecd->gd", combine, expert_out).reshape(b, t, d)
@@ -625,7 +665,7 @@ def forward_hidden(
     b, t_local = tokens.shape
     dt = cfg.compute_dtype
 
-    x = params["wte"].astype(dt)[tokens]  # [b, t, D]
+    x = embed_lookup(params["wte"], tokens, cfg)  # [b, t, D]
     # 1-D positions broadcast over any (micro)batch size.
     positions = sp_index * t_local + jnp.arange(t_local)
 

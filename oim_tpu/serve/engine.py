@@ -98,6 +98,7 @@ from oim_tpu.models.transformer import (
     TransformerConfig,
     _rmsnorm,
     _unembed,
+    embed_lookup,
     param_pspecs,
 )
 from oim_tpu.ops.rope import apply_rope
@@ -315,7 +316,7 @@ def _hidden_slots(params, tokens, kv, starts, cfg):
     and prompt length.
     """
     cfg = replace(cfg, use_pallas=False)
-    x = params["wte"].astype(cfg.compute_dtype)[tokens]
+    x = embed_lookup(params["wte"], tokens, cfg)
     flat = _flat_layer_params(params, cfg)
     quantized = kv[2] is not None
 

@@ -116,6 +116,51 @@ class TestLlamaImportParity:
         model, config = _tiny_hf(kv_heads=2, seed=4, qwen=True)
         _parity(model, config)
 
+    def test_gemma_parity(self):
+        """GemmaForCausalLM as the oracle for the Gemma numerics: GeGLU
+        (tanh gelu), (1 + weight) RMSNorm, sqrt(d) embedding scale, and
+        always-tied embeddings — all three flags must flow from the HF
+        config or the logits diverge at the first layer."""
+        torch.manual_seed(8)
+        config = transformers.GemmaConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, intermediate_size=112, rms_norm_eps=1e-5,
+            hidden_activation="gelu_pytorch_tanh",
+        )
+        model = transformers.GemmaForCausalLM(config)
+        model.eval()
+        cfg = llama_config(config, dtype="float32", use_pallas=False)
+        assert cfg.mlp_act == "gelu_tanh"
+        assert cfg.norm_offset and cfg.embed_scale
+        _parity(model, config, atol=5e-4)
+
+    def test_gemma_engine_matches_solo(self):
+        """Imported Gemma weights through the serving engine == solo
+        generate (embed scale + norm offset + GeGLU on the cached
+        decode path too)."""
+        from oim_tpu.models.decode import generate
+        from oim_tpu.serve import Engine, GenRequest
+
+        torch.manual_seed(9)
+        config = transformers.GemmaConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, intermediate_size=112, rms_norm_eps=1e-5,
+            hidden_activation="gelu_pytorch_tanh",
+        )
+        model = transformers.GemmaForCausalLM(config)
+        cfg = llama_config(config, dtype="float32", use_pallas=False)
+        params = from_hf_llama(model.state_dict(), cfg)
+        prompt = [3, 1, 4, 1, 5, 9]
+        want = np.asarray(generate(
+            params, jnp.asarray(prompt, jnp.int32)[None], cfg,
+            max_new_tokens=8,
+        ))[0, len(prompt):].tolist()
+        engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+        rid = engine.submit(GenRequest(tokens=prompt, max_new_tokens=8))
+        assert engine.run()[rid] == want
+
     def test_mixtral_moe_parity(self):
         """MixtralForCausalLM as the oracle for the MoE path: the native
         drop-free top-k routing (softmax over all router logits, keep
@@ -238,7 +283,7 @@ class TestLlamaImportValidation:
 
     def test_unsupported_act_rejected(self):
         _, config = _tiny_hf()
-        config.hidden_act = "gelu"
+        config.hidden_act = "relu"  # gelu now maps to Gemma's tanh-gelu
         with pytest.raises(ValueError, match="hidden_act"):
             llama_config(config)
 

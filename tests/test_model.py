@@ -812,3 +812,57 @@ class TestAttnBias:
         zero = fwd(params)
         biased = fwd({**params, "bq": params["bq"] + 0.5})
         assert np.abs(biased - zero).max() > 1e-3
+
+
+class TestGemmaNumerics:
+    def test_train_and_inference_paths_agree(self):
+        """The Gemma flags (GeGLU, (1+w) norm, sqrt(d) embed scale) must
+        be live in BOTH forwards: the train-path eval CE equals the CE
+        computed from the inference path's (prefill) logits.  Round-5
+        review caught the train pipeline path silently dropping
+        embed_scale — this is the invariant that makes that loud."""
+        from oim_tpu.models import make_eval_step
+        from oim_tpu.models.decode import prefill
+
+        cfg = TransformerConfig(
+            **TINY, mlp_act="gelu_tanh", norm_offset=True,
+            embed_scale=True, use_pallas=False,
+        )
+        mesh = build_mesh(devices=jax.devices()[:1])
+        params = init_params(jax.random.PRNGKey(2), cfg)
+        tokens = np.asarray(_data(2, 16, cfg.vocab_size, seed=3))
+        ce_train = float(make_eval_step(cfg, mesh)(params, tokens))
+        logits, _ = prefill(
+            params, jnp.asarray(tokens, jnp.int32), cfg, max_len=16
+        )
+        lp = jax.nn.log_softmax(
+            np.asarray(logits, np.float32), axis=-1
+        )
+        labels = tokens[:, 1:]
+        picked = np.take_along_axis(
+            np.asarray(lp)[:, :-1], labels[..., None], axis=-1
+        )[..., 0]
+        ce_infer = float(-picked.mean())
+        assert abs(ce_train - ce_infer) < 1e-4, (ce_train, ce_infer)
+
+    def test_pipeline_path_carries_embed_scale(self):
+        """The pp>1 train path has its own embedding closure
+        (models/train.py); with embed_scale on, its loss must match the
+        pp=1 path's on the same weights — a dropped scale in either
+        diverges immediately."""
+        from oim_tpu.models import make_eval_step
+
+        tokens = np.asarray(_data(4, 16, TINY["vocab_size"], seed=4))
+        ces = []
+        for stages in (1, 2):
+            cfg = TransformerConfig(
+                **TINY, mlp_act="gelu_tanh", norm_offset=True,
+                embed_scale=True, use_pallas=False,
+                n_stages=stages, n_microbatches=stages,
+            )
+            mesh = build_mesh(
+                pp=stages, devices=jax.devices()[: max(1, stages)]
+            )
+            params = init_params(jax.random.PRNGKey(2), cfg)
+            ces.append(float(make_eval_step(cfg, mesh)(params, tokens)))
+        assert abs(ces[0] - ces[1]) < 1e-4, ces
