@@ -2,7 +2,8 @@
 
 The flagship transformer is architecture-compatible with the Llama
 family — including Mistral-style sliding-window variants, Qwen2's
-q/k/v projection biases, and Mixtral's block-sparse MoE
+q/k/v projection biases, Mixtral's block-sparse MoE, Gemma v1's
+GeGLU/norm-offset/embed-scale numerics, and Phi-3's fused projections
 (RMSNorm, RoPE, SwiGLU, GQA, untied or tied unembed), so a user
 can bring real open weights instead of training from scratch — the
 interchange surface the reference left to its storage backends
@@ -107,6 +108,15 @@ def llama_config(hf_config, **overrides) -> TransformerConfig:
             ) from exc
     d = int(get("hidden_size"))
     h = int(get("num_attention_heads"))
+    partial = float(get("partial_rotary_factor", 1.0) or 1.0)
+    if partial != 1.0:
+        # Phi-4-mini-style partial rotary: transformers rotates only a
+        # fraction of the head dim; the native RoPE rotates all of it —
+        # importing would be silently wrong on every token.
+        raise ValueError(
+            f"partial_rotary_factor={partial} is not supported "
+            "(full-head-dim RoPE only)"
+        )
     explicit_hd = get("head_dim", None)
     if explicit_hd and int(explicit_hd) != d // h:
         raise ValueError(
@@ -249,15 +259,27 @@ def from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
     for i in range(cfg.n_layers):
         p = f"model.layers.{i}."
         per_layer["attn_norm"].append(_to_np(take(p + "input_layernorm.weight")))
-        per_layer["wq"].append(
-            _proj(take(p + "self_attn.q_proj.weight"), h, hd, True)
-        )
-        per_layer["wk"].append(
-            _proj(take(p + "self_attn.k_proj.weight"), kvh, hd, True)
-        )
-        per_layer["wv"].append(
-            _proj(take(p + "self_attn.v_proj.weight"), kvh, hd, False)
-        )
+        if p + "self_attn.qkv_proj.weight" in sd:
+            # Phi-3 fuses q/k/v into one projection, rows ordered
+            # [q (h·hd), k (kvh·hd), v (kvh·hd)] (Phi3Attention's
+            # split); unfuse to the native per-projection layout.
+            qkv = _to_np(take(p + "self_attn.qkv_proj.weight"))
+            q_rows, kv_rows = h * hd, kvh * hd
+            q_w = qkv[:q_rows]
+            k_w = qkv[q_rows:q_rows + kv_rows]
+            v_w = qkv[q_rows + kv_rows:]
+            if v_w.shape[0] != kv_rows:
+                raise ValueError(
+                    f"qkv_proj rows {qkv.shape[0]} != q {q_rows} + "
+                    f"2x kv {kv_rows}"
+                )
+        else:
+            q_w = take(p + "self_attn.q_proj.weight")
+            k_w = take(p + "self_attn.k_proj.weight")
+            v_w = take(p + "self_attn.v_proj.weight")
+        per_layer["wq"].append(_proj(q_w, h, hd, True))
+        per_layer["wk"].append(_proj(k_w, kvh, hd, True))
+        per_layer["wv"].append(_proj(v_w, kvh, hd, False))
         if cfg.attn_bias:
             per_layer["bq"].append(
                 _bias(take(p + "self_attn.q_proj.bias"), h, hd, True)
@@ -290,6 +312,20 @@ def from_hf_llama(state_dict, cfg: TransformerConfig) -> dict:
                 _to_np(take(p + f"block_sparse_moe.experts.{e}.w2.weight")).T
                 for e in range(cfg.n_experts)
             ]))
+        elif p + "mlp.gate_up_proj.weight" in sd:
+            # Phi-3 fuses gate/up: rows [gate (f), up (f)] (Phi3MLP's
+            # chunk(2) split).
+            gu = _to_np(take(p + "mlp.gate_up_proj.weight"))
+            if gu.shape[0] != 2 * cfg.ff_dim:
+                raise ValueError(
+                    f"gate_up_proj rows {gu.shape[0]} != 2x d_ff "
+                    f"{cfg.ff_dim}"
+                )
+            per_layer["w_gate"].append(gu[: cfg.ff_dim].T)
+            per_layer["w_in"].append(gu[cfg.ff_dim:].T)
+            per_layer["w_out"].append(
+                _to_np(take(p + "mlp.down_proj.weight")).T
+            )
         else:
             per_layer["w_gate"].append(
                 _to_np(take(p + "mlp.gate_proj.weight")).T

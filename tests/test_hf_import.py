@@ -116,6 +116,21 @@ class TestLlamaImportParity:
         model, config = _tiny_hf(kv_heads=2, seed=4, qwen=True)
         _parity(model, config)
 
+    def test_phi3_fused_projections(self):
+        """Phi3ForCausalLM as the oracle: the fused qkv_proj and
+        gate_up_proj must unfuse in the exact row order HF splits them
+        ([q, k, v] and [gate, up])."""
+        torch.manual_seed(10)
+        config = transformers.Phi3Config(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=112, rms_norm_eps=1e-5,
+            tie_word_embeddings=False, pad_token_id=0,
+        )
+        model = transformers.Phi3ForCausalLM(config)
+        model.eval()
+        _parity(model, config)
+
     def test_gemma_parity(self):
         """GemmaForCausalLM as the oracle for the Gemma numerics: GeGLU
         (tanh gelu), (1 + weight) RMSNorm, sqrt(d) embedding scale, and
