@@ -31,8 +31,8 @@ Behavior:
   by seed, but the single-retry bound keeps tail latency sane anyway).
 - Streaming: NDJSON bodies are piped through chunk-by-chunk unchanged.
 
-Endpoints: the serving API (POST /v1/generate, /v1/beam, /v1/embed)
-proxied; GET /healthz (ok while ≥1 backend is healthy), /v1/stats
+Endpoints: the serving API (POST /v1/generate, /v1/beam, /v1/embed,
+and the OpenAI-compatible /v1/completions) proxied; GET /healthz (ok while ≥1 backend is healthy), /v1/stats
 (router counters + per-backend state), /metrics (Prometheus).
 """
 
@@ -51,7 +51,7 @@ from oim_tpu import log
 from oim_tpu.common import metrics
 from oim_tpu.serve.httptls import check_serving_peer
 
-PROXIED = ("/v1/generate", "/v1/beam", "/v1/embed")
+PROXIED = ("/v1/generate", "/v1/beam", "/v1/embed", "/v1/completions")
 
 
 @dataclass
@@ -287,27 +287,37 @@ class Router:
     # -- proxying ----------------------------------------------------------
 
     def _affinity_key(self, path: str, body: bytes | None) -> str | None:
-        """Prompt-prefix affinity for /v1/generate: requests whose first
-        ``affinity_prefix_tokens`` token ids match should share a
-        backend (that backend's prefix cache holds their prefix).  Any
-        parse problem means no affinity — never an error."""
+        """Prompt-prefix affinity for the generation endpoints
+        (/v1/generate and the OpenAI-compatible /v1/completions):
+        requests whose first ``affinity_prefix_tokens`` token ids match
+        should share a backend (that backend's prefix cache holds their
+        prefix).  Any parse problem means no affinity — never an
+        error."""
         if (
             self.affinity_prefix_tokens <= 0
-            or path != "/v1/generate"
+            or path not in ("/v1/generate", "/v1/completions")
             or not body
         ):
             return None
         try:
             payload = json.loads(body)
-            if "tokens" in payload:
-                prefix = payload["tokens"][: self.affinity_prefix_tokens]
+            ids = payload.get("tokens")
+            text = payload.get("text")
+            if path == "/v1/completions":
+                # OpenAI field: prompt is a string or a token list.
+                prompt = payload.get("prompt")
+                if isinstance(prompt, list):
+                    ids = prompt
+                elif isinstance(prompt, str):
+                    text = prompt
+            if ids is not None:
+                prefix = ids[: self.affinity_prefix_tokens]
                 if len(prefix) < self.affinity_prefix_tokens:
                     return None  # short prompts: balance freely
                 return ",".join(str(int(t)) for t in prefix)
             # Text surface: the router has no tokenizer, so the leading
             # CHARACTERS proxy the token prefix (~4 chars/token).  Same
             # shared-prefix requests → same key → same backend cache.
-            text = payload.get("text")
             if isinstance(text, str):
                 n_chars = 4 * self.affinity_prefix_tokens
                 if len(text) < n_chars:

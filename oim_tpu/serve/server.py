@@ -31,6 +31,15 @@ API:
                     beam search (EOS-aware, GNMT length-normalized) on
                     the engine's model; beam_size 1 equals greedy
                     /v1/generate output exactly.
+  POST /v1/completions  OpenAI-compatible completions: {"prompt":
+                    str|[int...], "max_tokens": N, "temperature", "top_p",
+                    "n", "seed", "stop": str|[str...], "stream": false}
+                    → {"object": "text_completion", "choices": [...],
+                    "usage": {...}}.  String prompts/stops and SSE
+                    streaming need --tokenizer-dir; token-list prompts
+                    work anywhere (choices carry "tokens").  stream=true
+                    answers Server-Sent Events chunks ending in
+                    "data: [DONE]".
   GET  /healthz      → {"ok": true}
   GET  /v1/stats     → engine stats (slots, queue depth, tokens generated)
   GET  /v1/info      → static model/engine description (geometry, params,
@@ -237,6 +246,14 @@ class ServeServer:
                 if self.path == "/v1/beam":
                     self._beam_request()
                     return
+                if self.path == "/v1/completions":
+                    if outer.error is not None:
+                        # No driver thread left; fail fast like
+                        # /v1/generate instead of a 600 s hang.
+                        self._json(503, {"error": {"message": outer.error}})
+                        return
+                    self._completions_request()
+                    return
                 if self.path != "/v1/generate":
                     self._json(404, {"error": f"no such path {self.path}"})
                     return
@@ -254,6 +271,224 @@ class ServeServer:
                     "serve.generate", component="oim-serve", parent=parent,
                 ) as span:
                     self._generate(span)
+
+            def _completions_request(self) -> None:
+                """OpenAI-compatible ``/v1/completions``: the shape the
+                ecosystem's clients speak, mapped onto the native
+                engine.  String prompts/stops need the server-side
+                tokenizer (--tokenizer-dir); token-list prompts work on
+                any instance.  ``n`` choices run as n engine requests
+                (seeds seed+i); ``stream`` is SSE with OpenAI chunk
+                objects and a final ``data: [DONE]``.  Stop strings are
+                applied by post-hoc truncation of the decoded text —
+                exact for completed responses; streaming rejects
+                ``stop`` rather than emit text past the boundary."""
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    prompt = body.get("prompt", "")
+                    if isinstance(prompt, list):
+                        tokens = [int(t) for t in prompt]
+                    else:
+                        if outer.tokenizer is None:
+                            raise ValueError(
+                                "string prompts need a server-side "
+                                "tokenizer (oim-serve --tokenizer-dir); "
+                                "send a token-id list instead"
+                            )
+                        tokens = outer.tokenizer.encode(str(prompt))
+                    stops = body.get("stop") or []
+                    if isinstance(stops, str):
+                        stops = [stops]
+                    if stops and outer.tokenizer is None:
+                        raise ValueError(
+                            "stop strings need a server-side tokenizer"
+                        )
+                    n = int(body.get("n", 1))
+                    if not 1 <= n <= 8:
+                        raise ValueError("n must be in [1, 8]")
+                    stream = bool(body.get("stream"))
+                    if stream and (stops or n != 1):
+                        raise ValueError(
+                            "stream=true supports neither stop strings "
+                            "nor n > 1"
+                        )
+                    if stream and outer.tokenizer is None:
+                        # Bare token ids concatenated into the OpenAI
+                        # text field would be unparseable.
+                        raise ValueError(
+                            "stream=true needs a server-side tokenizer "
+                            "(oim-serve --tokenizer-dir)"
+                        )
+                    temperature = float(body.get("temperature", 1.0))
+                    seed = int(body.get("seed", 0))
+
+                    def req_for(i):
+                        return GenRequest(
+                            tokens=tokens,
+                            max_new_tokens=int(body.get("max_tokens", 16)),
+                            temperature=temperature,
+                            seed=seed + i,
+                            eos_id=(
+                                outer.tokenizer.eos_id
+                                if outer.tokenizer is not None
+                                else None
+                            ),
+                            top_p=(
+                                float(body["top_p"])
+                                if body.get("top_p") is not None
+                                else None
+                            ),
+                            presence_penalty=float(
+                                body.get("presence_penalty", 0.0)
+                            ),
+                            frequency_penalty=float(
+                                body.get("frequency_penalty", 0.0)
+                            ),
+                        )
+
+                    rids = []
+                    if stream:
+                        self._completions_stream(req_for(0), body)
+                        return
+                    for i in range(n):
+                        rids.append(outer.engine.submit(req_for(i)))
+                except QueueFullError as exc:
+                    self._forget_all(rids)
+                    self._json(429, {"error": {"message": str(exc)}})
+                    return
+                except DrainingError as exc:
+                    self._forget_all(rids)
+                    self._json(503, {"error": {"message": str(exc)}})
+                    return
+                except (KeyError, TypeError, ValueError) as exc:
+                    self._json(400, {"error": {"message": str(exc)}})
+                    return
+                choices = []
+                completion_tokens = 0
+                for i, rid in enumerate(rids):
+                    try:
+                        out = outer.engine.result(rid, timeout=600)
+                    except TimeoutError:
+                        self._forget_all(rids[i:])
+                        self._json(
+                            503,
+                            {"error": {"message": f"{rid} timed out"}},
+                        )
+                        return
+                    except RuntimeError as exc:
+                        self._forget_all(rids[i + 1:])
+                        self._json(500, {"error": {"message": str(exc)}})
+                        return
+                    completion_tokens += len(out)
+                    finish = (
+                        "length"
+                        if len(out) >= int(body.get("max_tokens", 16))
+                        else "stop"
+                    )
+                    choice = {
+                        "index": i,
+                        "finish_reason": finish,
+                        "logprobs": None,
+                    }
+                    if outer.tokenizer is not None:
+                        text = outer.tokenizer.decode(out)
+                        for s in stops:
+                            cut = text.find(s)
+                            if cut >= 0:
+                                text = text[:cut]
+                                choice["finish_reason"] = "stop"
+                        choice["text"] = text
+                    else:
+                        choice["text"] = ""
+                        choice["tokens"] = out
+                    choices.append(choice)
+                self._json(200, {
+                    "id": f"cmpl-{rids[0]}",
+                    "object": "text_completion",
+                    "created": int(time.time()),
+                    "model": body.get("model", "oim-tpu"),
+                    "choices": choices,
+                    "usage": {
+                        "prompt_tokens": len(tokens),
+                        "completion_tokens": completion_tokens,
+                        "total_tokens": len(tokens) + completion_tokens,
+                    },
+                })
+
+            def _forget_all(self, rids) -> None:
+                """Release engine results for every rid in ``rids`` —
+                an n>1 request failing partway must not strand the
+                other choices' results in the daemon forever."""
+                for rid in rids:
+                    outer.engine.forget(rid)
+
+            def _completions_stream(self, req: GenRequest, body) -> None:
+                """SSE stream of OpenAI completion chunks."""
+                tokens_q: queue.Queue = queue.Queue()
+                decoder = outer.tokenizer.stream_decoder()  # required
+                rid = outer.engine.submit(
+                    req, on_token=lambda t, lp: tokens_q.put((t, lp))
+                )
+                created = int(time.time())
+
+                def chunk(text, finish=None):
+                    return (
+                        "data: " + json.dumps({
+                            "id": f"cmpl-{rid}",
+                            "object": "text_completion",
+                            "created": created,
+                            "model": body.get("model", "oim-tpu"),
+                            "choices": [{
+                                "index": 0,
+                                "text": text,
+                                "finish_reason": finish,
+                                "logprobs": None,
+                            }],
+                        }) + "\n\n"
+                    ).encode()
+
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
+                    emitted = 0
+                    while True:
+                        token, _lp = tokens_q.get(timeout=600)
+                        if token is None:
+                            tail = decoder.flush()
+                            final = (
+                                "length"
+                                if emitted >= req.max_new_tokens
+                                else "stop"
+                            )
+                            if tail:
+                                self.wfile.write(chunk(tail))
+                            self.wfile.write(chunk("", finish=final))
+                            self.wfile.write(b"data: [DONE]\n\n")
+                            return
+                        emitted += 1
+                        delta = decoder.push(token)
+                        if delta:
+                            self.wfile.write(chunk(delta))
+                except queue.Empty:
+                    # Same situation the non-stream path answers with
+                    # 503: emit a terminal error event — a silent close
+                    # would be indistinguishable from completion.
+                    outer.engine.forget(rid)
+                    try:
+                        self.wfile.write(
+                            b'data: ' + json.dumps(
+                                {"error": {
+                                    "message": f"request {rid} timed out"
+                                }}
+                            ).encode() + b"\n\n"
+                        )
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+                except (BrokenPipeError, ConnectionResetError):
+                    outer.engine.forget(rid)
 
             def _embed_request(self) -> None:
                 try:

@@ -692,3 +692,29 @@ class TestPrefixAffinity:
         assert router._affinity_key(
             "/v1/generate", json.dumps({"text": "short"}).encode()
         ) is None
+
+
+def test_completions_proxied_through_router(backends):
+    """OpenAI-compatible /v1/completions rides the same proxy path;
+    tokenizer-less backends accept token-list prompts and return the
+    raw ids."""
+    router = Router(
+        backends=tuple(_url(s) for s in backends), health_interval=0.2
+    ).start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and not router.healthy_backends():
+            time.sleep(0.05)
+        base = f"http://{router.host}:{router.port}"
+        status, reply = _post(base, "/v1/completions", {
+            "prompt": _prompt(3, 6),
+            "max_tokens": 4,
+            "temperature": 0.0,
+        })
+        assert status == 200
+        assert reply["object"] == "text_completion"
+        (choice,) = reply["choices"]
+        assert len(choice["tokens"]) <= 4
+        assert reply["usage"]["prompt_tokens"] == 6
+    finally:
+        router.stop()

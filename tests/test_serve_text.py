@@ -245,3 +245,112 @@ def test_info_reports_tokenizer(server):
         f"http://{srv.host}:{srv.port}/v1/info", timeout=10
     ) as resp:
         assert json.loads(resp.read())["tokenizer"] == srv.tokenizer.path
+
+
+class TestOpenAICompletions:
+    """OpenAI-compatible /v1/completions mapped onto the native engine."""
+
+    def test_basic_shape_and_greedy_match(self, server):
+        srv, _, _, _ = server
+        status, native = _post(
+            srv, "/v1/generate",
+            {"text": "abab", "max_new_tokens": 6, "eos_id": -1},
+        )
+        assert status == 200
+        status, reply = _post(
+            srv, "/v1/completions",
+            {"prompt": "abab", "max_tokens": 6, "temperature": 0.0},
+        )
+        assert status == 200
+        assert reply["object"] == "text_completion"
+        (choice,) = reply["choices"]
+        # Greedy completions equal the native surface's decode (the
+        # completions path defaults EOS to the tokenizer's, so compare
+        # against prefix — eos may end it early).
+        assert native["text"].startswith(choice["text"]) or (
+            choice["text"] == native["text"]
+        )
+        usage = reply["usage"]
+        assert usage["prompt_tokens"] > 0
+        assert usage["total_tokens"] == (
+            usage["prompt_tokens"] + usage["completion_tokens"]
+        )
+        assert choice["finish_reason"] in ("stop", "length")
+
+    def test_stop_string_truncates(self, server):
+        srv, _, _, _ = server
+        status, full = _post(
+            srv, "/v1/completions",
+            {"prompt": "abab", "max_tokens": 8, "temperature": 0.0},
+        )
+        assert status == 200
+        text = full["choices"][0]["text"]
+        if len(text) < 2:
+            pytest.skip("generation too short to split a stop out of")
+        stop = text[1]
+        status, cut = _post(
+            srv, "/v1/completions",
+            {"prompt": "abab", "max_tokens": 8, "temperature": 0.0,
+             "stop": stop},
+        )
+        assert status == 200
+        (choice,) = cut["choices"]
+        assert stop not in choice["text"]
+        assert choice["finish_reason"] == "stop"
+        assert text.startswith(choice["text"])
+
+    def test_n_choices(self, server):
+        srv, _, _, _ = server
+        status, reply = _post(
+            srv, "/v1/completions",
+            {"prompt": "ab", "max_tokens": 4, "temperature": 0.9,
+             "seed": 7, "n": 2},
+        )
+        assert status == 200
+        assert [c["index"] for c in reply["choices"]] == [0, 1]
+
+    def test_sse_stream_matches_nonstream(self, server):
+        srv, _, _, _ = server
+        status, want = _post(
+            srv, "/v1/completions",
+            {"prompt": "abab", "max_tokens": 6, "temperature": 0.0},
+        )
+        assert status == 200
+        req = urllib.request.Request(
+            f"http://{srv.host}:{srv.port}/v1/completions",
+            data=json.dumps(
+                {"prompt": "abab", "max_tokens": 6, "temperature": 0.0,
+                 "stream": True}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        deltas, done, finish = [], False, None
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            for line in resp:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    done = True
+                    break
+                obj = json.loads(payload)
+                assert obj["object"] == "text_completion"
+                deltas.append(obj["choices"][0]["text"])
+                if obj["choices"][0]["finish_reason"]:
+                    finish = obj["choices"][0]["finish_reason"]
+        assert done
+        assert "".join(deltas) == want["choices"][0]["text"]
+        assert finish in ("stop", "length")
+
+    def test_stream_rejects_stop_and_n(self, server):
+        srv, _, _, _ = server
+        for extra in ({"stop": "x"}, {"n": 2}):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(
+                    srv, "/v1/completions",
+                    {"prompt": "ab", "max_tokens": 2, "stream": True,
+                     **extra},
+                )
+            assert err.value.code == 400
