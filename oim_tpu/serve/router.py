@@ -51,7 +51,13 @@ from oim_tpu import log
 from oim_tpu.common import metrics
 from oim_tpu.serve.httptls import check_serving_peer
 
-PROXIED = ("/v1/generate", "/v1/beam", "/v1/embed", "/v1/completions")
+PROXIED = (
+    "/v1/generate",
+    "/v1/beam",
+    "/v1/embed",
+    "/v1/completions",
+    "/v1/chat/completions",
+)
 
 
 @dataclass
@@ -295,7 +301,9 @@ class Router:
         error."""
         if (
             self.affinity_prefix_tokens <= 0
-            or path not in ("/v1/generate", "/v1/completions")
+            or path not in (
+                "/v1/generate", "/v1/completions", "/v1/chat/completions"
+            )
             or not body
         ):
             return None
@@ -310,6 +318,18 @@ class Router:
                     ids = prompt
                 elif isinstance(prompt, str):
                     text = prompt
+            elif path == "/v1/chat/completions":
+                # Chat requests sharing a system prompt share their
+                # leading messages; the serialized role:content stream
+                # proxies the templated token prefix (the router has no
+                # tokenizer or template).
+                messages = payload.get("messages")
+                if isinstance(messages, list):
+                    text = "".join(
+                        f"{m.get('role', '')}:{m.get('content', '')};"
+                        for m in messages
+                        if isinstance(m, dict)
+                    )
             if ids is not None:
                 prefix = ids[: self.affinity_prefix_tokens]
                 if len(prefix) < self.affinity_prefix_tokens:

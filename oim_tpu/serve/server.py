@@ -40,6 +40,13 @@ API:
                     work anywhere (choices carry "tokens").  stream=true
                     answers Server-Sent Events chunks ending in
                     "data: [DONE]".
+  POST /v1/chat/completions  OpenAI chat shape: {"messages": [{"role",
+                    "content"}...], ...same params} rendered through the
+                    tokenizer's OWN chat template (tokenizer_config.json
+                    next to imported weights; refused with a clear error
+                    when the tokenizer carries none) → choices carry
+                    {"message": {"role": "assistant", "content": ...}};
+                    stream=true sends chat.completion.chunk deltas.
   GET  /healthz      → {"ok": true}
   GET  /v1/stats     → engine stats (slots, queue depth, tokens generated)
   GET  /v1/info      → static model/engine description (geometry, params,
@@ -246,13 +253,15 @@ class ServeServer:
                 if self.path == "/v1/beam":
                     self._beam_request()
                     return
-                if self.path == "/v1/completions":
+                if self.path in ("/v1/completions", "/v1/chat/completions"):
                     if outer.error is not None:
                         # No driver thread left; fail fast like
                         # /v1/generate instead of a 600 s hang.
                         self._json(503, {"error": {"message": outer.error}})
                         return
-                    self._completions_request()
+                    self._completions_request(
+                        chat=self.path.endswith("chat/completions")
+                    )
                     return
                 if self.path != "/v1/generate":
                     self._json(404, {"error": f"no such path {self.path}"})
@@ -272,7 +281,7 @@ class ServeServer:
                 ) as span:
                     self._generate(span)
 
-            def _completions_request(self) -> None:
+            def _completions_request(self, chat: bool = False) -> None:
                 """OpenAI-compatible ``/v1/completions``: the shape the
                 ecosystem's clients speak, mapped onto the native
                 engine.  String prompts/stops need the server-side
@@ -286,17 +295,33 @@ class ServeServer:
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                     body = json.loads(self.rfile.read(length) or b"{}")
-                    prompt = body.get("prompt", "")
-                    if isinstance(prompt, list):
-                        tokens = [int(t) for t in prompt]
-                    else:
+                    if chat:
+                        # /v1/chat/completions: messages rendered
+                        # through the tokenizer's OWN chat template
+                        # (imported next to the weights).
                         if outer.tokenizer is None:
                             raise ValueError(
-                                "string prompts need a server-side "
-                                "tokenizer (oim-serve --tokenizer-dir); "
-                                "send a token-id list instead"
+                                "chat completions need a server-side "
+                                "tokenizer (oim-serve --tokenizer-dir)"
                             )
-                        tokens = outer.tokenizer.encode(str(prompt))
+                        messages = body.get("messages")
+                        if not isinstance(messages, list) or not messages:
+                            raise ValueError("messages must be a non-empty list")
+                        tokens = outer.tokenizer.apply_chat_template(
+                            messages
+                        )
+                    else:
+                        prompt = body.get("prompt", "")
+                        if isinstance(prompt, list):
+                            tokens = [int(t) for t in prompt]
+                        else:
+                            if outer.tokenizer is None:
+                                raise ValueError(
+                                    "string prompts need a server-side "
+                                    "tokenizer (oim-serve --tokenizer-dir); "
+                                    "send a token-id list instead"
+                                )
+                            tokens = outer.tokenizer.encode(str(prompt))
                     stops = body.get("stop") or []
                     if isinstance(stops, str):
                         stops = [stops]
@@ -349,7 +374,7 @@ class ServeServer:
 
                     rids = []
                     if stream:
-                        self._completions_stream(req_for(0), body)
+                        self._completions_stream(req_for(0), body, chat)
                         return
                     for i in range(n):
                         rids.append(outer.engine.submit(req_for(i)))
@@ -398,14 +423,21 @@ class ServeServer:
                             if cut >= 0:
                                 text = text[:cut]
                                 choice["finish_reason"] = "stop"
-                        choice["text"] = text
+                        if chat:
+                            choice["message"] = {
+                                "role": "assistant", "content": text,
+                            }
+                        else:
+                            choice["text"] = text
                     else:
                         choice["text"] = ""
                         choice["tokens"] = out
                     choices.append(choice)
                 self._json(200, {
-                    "id": f"cmpl-{rids[0]}",
-                    "object": "text_completion",
+                    "id": f"{'chatcmpl' if chat else 'cmpl'}-{rids[0]}",
+                    "object": (
+                        "chat.completion" if chat else "text_completion"
+                    ),
                     "created": int(time.time()),
                     "model": body.get("model", "oim-tpu"),
                     "choices": choices,
@@ -423,8 +455,11 @@ class ServeServer:
                 for rid in rids:
                     outer.engine.forget(rid)
 
-            def _completions_stream(self, req: GenRequest, body) -> None:
-                """SSE stream of OpenAI completion chunks."""
+            def _completions_stream(
+                self, req: GenRequest, body, chat: bool = False
+            ) -> None:
+                """SSE stream of OpenAI completion (or chat-completion
+                delta) chunks."""
                 tokens_q: queue.Queue = queue.Queue()
                 decoder = outer.tokenizer.stream_decoder()  # required
                 rid = outer.engine.submit(
@@ -433,18 +468,32 @@ class ServeServer:
                 created = int(time.time())
 
                 def chunk(text, finish=None):
+                    if chat:
+                        choice = {
+                            "index": 0,
+                            "delta": (
+                                {"role": "assistant", "content": text}
+                                if text or finish is None else {}
+                            ),
+                            "finish_reason": finish,
+                        }
+                    else:
+                        choice = {
+                            "index": 0,
+                            "text": text,
+                            "finish_reason": finish,
+                            "logprobs": None,
+                        }
                     return (
                         "data: " + json.dumps({
-                            "id": f"cmpl-{rid}",
-                            "object": "text_completion",
+                            "id": f"{'chatcmpl' if chat else 'cmpl'}-{rid}",
+                            "object": (
+                                "chat.completion.chunk"
+                                if chat else "text_completion"
+                            ),
                             "created": created,
                             "model": body.get("model", "oim-tpu"),
-                            "choices": [{
-                                "index": 0,
-                                "text": text,
-                                "finish_reason": finish,
-                                "logprobs": None,
-                            }],
+                            "choices": [choice],
                         }) + "\n\n"
                     ).encode()
 

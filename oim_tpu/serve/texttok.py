@@ -57,6 +57,34 @@ class TextTokenizer:
     def eos_id(self) -> int | None:
         return self._tok.eos_token_id
 
+    def apply_chat_template(self, messages: list[dict]) -> list[int]:
+        """Render an OpenAI-style ``messages`` list through the
+        tokenizer's own chat template into prompt ids (the template
+        ships in tokenizer_config.json next to imported weights).
+        Raises ValueError when the tokenizer carries no template — a
+        silently wrong fallback format would produce degraded output
+        with no diagnostic."""
+        if not getattr(self._tok, "chat_template", None):
+            raise ValueError(
+                "this tokenizer has no chat template; use "
+                "/v1/completions with a raw prompt instead"
+            )
+        try:
+            return list(
+                self._tok.apply_chat_template(
+                    messages, tokenize=True, add_generation_prompt=True
+                )
+            )
+        except ValueError:
+            raise
+        except Exception as exc:
+            # Real templates raise jinja2.TemplateError for unknown
+            # roles / malformed content; surface it as the 400-mapped
+            # ValueError, not a handler-killing 500.
+            raise ValueError(
+                f"chat template rendering failed: {exc}"
+            ) from exc
+
     def stream_decoder(self) -> "StreamDecoder":
         return StreamDecoder(self)
 

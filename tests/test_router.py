@@ -718,3 +718,29 @@ def test_completions_proxied_through_router(backends):
         assert reply["usage"]["prompt_tokens"] == 6
     finally:
         router.stop()
+
+
+def test_chat_completions_affinity_key():
+    """Chat requests sharing leading messages (system prompt) route to
+    one rendezvous-hashed backend like /v1/generate prompts do."""
+    router = Router(backends=("http://a:1", "http://b:2", "http://c:3"),
+                    affinity_prefix_tokens=8)
+    try:
+        for b in router._backends.values():
+            b.prefix_cache = True
+        body = json.dumps({
+            "messages": [
+                {"role": "system", "content": "x" * 64},
+                {"role": "user", "content": "hi"},
+            ]
+        }).encode()
+        key = router._affinity_key("/v1/chat/completions", body)
+        assert key is not None and key.startswith("txt:")
+        picks = set()
+        for _ in range(9):
+            b = router._pick(affinity_key=key)
+            picks.add(b.id)
+            router._release(b, ok=True)
+        assert len(picks) == 1
+    finally:
+        router.stop()

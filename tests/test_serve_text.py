@@ -47,6 +47,11 @@ def tokenizer_dir(tmp_path_factory):
     tok.pre_tokenizer = pre_tokenizers.Split("", "isolated")
     tok.decoder = decoders.Fuse()  # char tokens concatenate verbatim
     fast = PreTrainedTokenizerFast(tokenizer_object=tok, eos_token="</s>")
+    # A minimal chat template (saved into tokenizer_config.json like
+    # any imported model's) so /v1/chat/completions is testable.
+    fast.chat_template = (
+        "{% for m in messages %}{{ m['content'] }}{% endfor %}"
+    )
     out = tmp_path_factory.mktemp("tok")
     fast.save_pretrained(str(out))
     return str(out)
@@ -354,3 +359,84 @@ class TestOpenAICompletions:
                      **extra},
                 )
             assert err.value.code == 400
+
+
+class TestOpenAIChatCompletions:
+    def test_chat_equals_completions_on_rendered_prompt(self, server):
+        """Chat renders messages through the tokenizer's own template;
+        with this fixture's concatenating template, the chat answer must
+        equal a /v1/completions call on the rendered string."""
+        srv, _, _, _ = server
+        messages = [
+            {"role": "user", "content": "ab"},
+            {"role": "assistant", "content": "ba"},
+            {"role": "user", "content": "ab"},
+        ]
+        status, chat = _post(
+            srv, "/v1/chat/completions",
+            {"messages": messages, "max_tokens": 6, "temperature": 0.0},
+        )
+        assert status == 200
+        assert chat["object"] == "chat.completion"
+        (choice,) = chat["choices"]
+        assert choice["message"]["role"] == "assistant"
+        status, plain = _post(
+            srv, "/v1/completions",
+            {"prompt": "abbaab", "max_tokens": 6, "temperature": 0.0},
+        )
+        assert status == 200
+        assert choice["message"]["content"] == plain["choices"][0]["text"]
+
+    def test_chat_stream_deltas(self, server):
+        srv, _, _, _ = server
+        messages = [{"role": "user", "content": "abab"}]
+        status, want = _post(
+            srv, "/v1/chat/completions",
+            {"messages": messages, "max_tokens": 6, "temperature": 0.0},
+        )
+        assert status == 200
+        req = urllib.request.Request(
+            f"http://{srv.host}:{srv.port}/v1/chat/completions",
+            data=json.dumps(
+                {"messages": messages, "max_tokens": 6,
+                 "temperature": 0.0, "stream": True}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        deltas, done = [], False
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            for line in resp:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    done = True
+                    break
+                obj = json.loads(payload)
+                assert obj["object"] == "chat.completion.chunk"
+                deltas.append(
+                    obj["choices"][0]["delta"].get("content", "")
+                )
+        assert done
+        assert "".join(deltas) == want["choices"][0]["message"]["content"]
+
+    def test_chat_requires_messages_and_template(self, server):
+        srv, _, _, _ = server
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(srv, "/v1/chat/completions", {"max_tokens": 2})
+        assert err.value.code == 400
+        # A tokenizer without a template must refuse, not guess a format.
+        tok = srv.tokenizer
+        saved = tok._tok.chat_template
+        try:
+            tok._tok.chat_template = None
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(
+                    srv, "/v1/chat/completions",
+                    {"messages": [{"role": "user", "content": "a"}],
+                     "max_tokens": 2},
+                )
+            assert err.value.code == 400
+        finally:
+            tok._tok.chat_template = saved
