@@ -42,6 +42,18 @@ def _parser() -> argparse.ArgumentParser:
         help="q/k/v projection biases (Qwen2-family)",
     )
     p.add_argument(
+        "--mlp-act", default="silu", choices=["silu", "gelu_tanh"],
+        help="MLP gate activation (gelu_tanh = Gemma GeGLU)",
+    )
+    p.add_argument(
+        "--norm-offset", action="store_true",
+        help="RMSNorm scales by (1 + weight) (Gemma family)",
+    )
+    p.add_argument(
+        "--embed-scale", action="store_true",
+        help="scale embeddings by sqrt(d_model) (Gemma family)",
+    )
+    p.add_argument(
         "--rope-scaling", type=float, nargs=4, default=[],
         metavar=("FACTOR", "LOW", "HIGH", "ORIG_MAX"),
     )
@@ -109,6 +121,9 @@ def main(argv=None) -> int:
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
         attn_bias=args.attn_bias,
+        mlp_act=args.mlp_act,
+        norm_offset=args.norm_offset,
+        embed_scale=args.embed_scale,
         d_ff=args.d_ff,
         rope_theta=args.rope_theta,
         rope_scaling=tuple(args.rope_scaling),
@@ -129,6 +144,9 @@ def main(argv=None) -> int:
         # top-k gates, SwiGLU experts) — export as the family itself.
         config = transformers.MixtralConfig(**kwargs)
         model_cls = transformers.MixtralForCausalLM
+    elif cfg.gemma_numerics:
+        config = transformers.GemmaConfig(**kwargs)
+        model_cls = transformers.GemmaForCausalLM
     elif cfg.attn_bias:
         # qkv-bias-on/o-bias-off is exactly Qwen2's hardwired shape; a
         # LlamaConfig(attention_bias=True) model would also build an
@@ -151,9 +169,22 @@ def main(argv=None) -> int:
         {k: torch.as_tensor(v) for k, v in sd.items()},
         strict=False, assign=True,
     )
-    # rotary buffers etc. are derived, not loaded; real weights missing
+    if getattr(config, "tie_word_embeddings", False):
+        # assign=True replaced embed_tokens.weight with a fresh tensor,
+        # severing the lm_head tie (which still points at the meta
+        # param); re-tie so save_pretrained never sees a meta tensor.
+        model.tie_weights()
+    # rotary buffers etc. are derived, not loaded, and a tied lm_head is
+    # deliberately absent from the exported dict; real weights missing
     # means the conversion broke — fail loudly, never write half a model.
-    real_missing = [m for m in missing if "rotary" not in m]
+    real_missing = [
+        m for m in missing
+        if "rotary" not in m
+        and not (
+            m == "lm_head.weight"
+            and getattr(config, "tie_word_embeddings", False)
+        )
+    ]
     if real_missing or unexpected:
         print(
             f"state dict mismatch: missing={real_missing[:4]} "

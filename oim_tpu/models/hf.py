@@ -398,19 +398,45 @@ def to_hf_llama(params: dict, cfg: TransformerConfig) -> dict:
     pytree — the exact inverse of ``from_hf_llama``: projections
     transpose back to [out, in], the interleaved-RoPE q/k column
     permutation inverts, and the [n_stages, layers_per_stage, ...]
-    stacking flattens to per-layer tensors.  Always exports an untied
-    ``lm_head``.  MoE models (k >= 2) export in the Mixtral
-    block-sparse layout; switch-routed (k=1) models are rejected —
-    their raw-prob gate has no HF analog.
+    stacking flattens to per-layer tensors.  Exports an untied
+    ``lm_head`` — except Gemma-numerics models, which HF always ties:
+    those export WITHOUT lm_head and require wlm == wte.T (true for any
+    imported-then-fine-tuned-tied checkpoint; an untied-trained wlm has
+    no Gemma analog and is rejected).  MoE models (k >= 2) export in
+    the Mixtral block-sparse layout; switch-routed (k=1) models are
+    rejected — their raw-prob gate has no HF analog.
     Roundtrip and logit parity are pinned by tests/test_hf_import.py.
     """
-    if cfg.norm_offset or cfg.embed_scale or cfg.mlp_act != "silu":
-        # Gemma-numerics models import and serve, but the export side
-        # (always-untied lm_head here vs Gemma's always-tied) is not
-        # wired — reject loudly rather than write a checkpoint
-        # transformers would misload.
+    any_gemma = (
+        cfg.norm_offset or cfg.embed_scale or cfg.mlp_act != "silu"
+    )
+    gemma = cfg.gemma_numerics
+    if any_gemma and not gemma:
+        # GemmaModel applies ALL THREE numerics unconditionally; a
+        # partial combination would export to a model that silently
+        # applies numerics this checkpoint never trained with.
         raise ValueError(
-            "Gemma-family export is not supported (import/serve only)"
+            "partial Gemma numerics (mlp_act/norm_offset/embed_scale "
+            "not all set) have no HF analog; export needs all three "
+            "or none"
+        )
+    if gemma and cfg.n_experts:
+        raise ValueError(
+            "Gemma-numerics MoE export has no HF analog (Mixtral runs "
+            "silu experts without Gemma numerics)"
+        )
+    if gemma and cfg.attn_bias:
+        raise ValueError(
+            "Gemma export with attn_bias has no HF analog"
+        )
+    if gemma and not np.allclose(
+        np.asarray(params["wlm"], np.float32),
+        np.asarray(params["wte"], np.float32).T,
+    ):
+        raise ValueError(
+            "Gemma export requires tied embeddings (wlm == wte.T); "
+            "this model's unembedding diverged from the embedding "
+            "and GemmaForCausalLM cannot represent that"
         )
     if cfg.n_experts and cfg.attn_bias:
         # Mixtral's layout has no projection biases; a Qwen2-MoE-style
@@ -441,8 +467,11 @@ def to_hf_llama(params: dict, cfg: TransformerConfig) -> dict:
         "model.norm.weight": np.asarray(
             params["final_norm"], dtype=np.float32
         ),
-        "lm_head.weight": np.asarray(params["wlm"], dtype=np.float32).T,
     }
+    if not gemma:
+        sd["lm_head.weight"] = np.asarray(
+            params["wlm"], dtype=np.float32
+        ).T
 
     def layer(name, i):
         s, l = divmod(i, cfg.layers_per_stage)
@@ -533,6 +562,16 @@ def hf_llama_config_kwargs(
         kwargs.pop("mlp_bias")
         kwargs["num_local_experts"] = cfg.n_experts
         kwargs["num_experts_per_tok"] = cfg.moe_top_k
+    if cfg.gemma_numerics:
+        # Gemma keys: always-tied embeddings, explicit head_dim, and
+        # the activation under its canonical name.
+        kwargs.pop("attention_bias", None)
+        kwargs.pop("mlp_bias", None)
+        kwargs["tie_word_embeddings"] = True
+        kwargs["head_dim"] = cfg.head_dim
+        kwargs["hidden_activation"] = (
+            "gelu_pytorch_tanh" if cfg.mlp_act == "gelu_tanh" else "silu"
+        )
     if cfg.rope_scaling:
         factor, low, high, orig = cfg.rope_scaling
         kwargs["rope_scaling"] = {

@@ -147,6 +147,18 @@ class TransformerConfig:
     # loss, so a packed batch trains identically to per-document batches.
     doc_sep_id: int = -1
 
+    @property
+    def gemma_numerics(self) -> bool:
+        """All three Gemma-family numerics on (GeGLU + (1+w) RMSNorm +
+        sqrt(d) embed scale) — THE exportable-as-Gemma predicate shared
+        by hf.py and the export CLI (GemmaModel applies all three
+        unconditionally, so partial combos have no HF analog)."""
+        return (
+            self.mlp_act == "gelu_tanh"
+            and self.norm_offset
+            and self.embed_scale
+        )
+
     def __post_init__(self):
         if self.mlp_act not in ("silu", "gelu_tanh"):
             raise ValueError(

@@ -497,16 +497,17 @@ class TestExport:
         np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-4)
 
     @pytest.mark.parametrize(
-        "attn_bias,n_experts,hf_cls",
+        "attn_bias,n_experts,gemma,hf_cls",
         [
-            (False, 0, "LlamaForCausalLM"),
-            (True, 0, "Qwen2ForCausalLM"),
-            (False, 4, "MixtralForCausalLM"),
+            (False, 0, False, "LlamaForCausalLM"),
+            (True, 0, False, "Qwen2ForCausalLM"),
+            (False, 4, False, "MixtralForCausalLM"),
+            (False, 0, True, "GemmaForCausalLM"),
         ],
-        ids=["llama", "qwen", "mixtral"],
+        ids=["llama", "qwen", "mixtral", "gemma"],
     )
     def test_export_cli_roundtrip(self, tmp_path, attn_bias, n_experts,
-                                  hf_cls):
+                                  gemma, hf_cls):
         """orbax params export → oim-export-hf → from_pretrained →
         oim-import-hf → params equal.  The export picks the HF family
         the geometry belongs to: attn_bias → Qwen2 (qkv-on/o-off bias
@@ -522,8 +523,13 @@ class TestExport:
             vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=112,
             dtype="float32", attn_bias=attn_bias, n_experts=n_experts,
             moe_top_k=2 if n_experts else 1,
+            mlp_act="gelu_tanh" if gemma else "silu",
+            norm_offset=gemma, embed_scale=gemma,
         )
         params = init_params(jax.random.PRNGKey(5), cfg)
+        if gemma:
+            # Gemma exports tied: wlm must equal wte.T.
+            params = {**params, "wlm": params["wte"].T}
         if attn_bias:
             params = {
                 name: (
@@ -542,6 +548,9 @@ class TestExport:
             flags.append("--attn-bias")
         if n_experts:
             flags += ["--n-experts", str(n_experts), "--moe-top-k", "2"]
+        if gemma:
+            flags += ["--mlp-act", "gelu_tanh", "--norm-offset",
+                      "--embed-scale"]
         hf_dir, native2 = tmp_path / "hf", tmp_path / "native2"
         assert export_main(
             ["--params-dir", str(native1), "--out-dir", str(hf_dir), *flags]
@@ -727,3 +736,39 @@ class TestTokenizerExportSymmetry:
              "--tokenizer-dir", str(tmp_path / "nope")]
         )
         assert rc == 1
+
+
+def test_gemma_export_guards():
+    """Partial Gemma numerics, Gemma+MoE, Gemma+bias, and untied Gemma
+    all reject loudly at conversion — never a silently-wrong or
+    late-crashing export."""
+    from oim_tpu.models import TransformerConfig, init_params
+    from oim_tpu.models.hf import to_hf_llama
+
+    base = dict(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        dtype="float32",
+    )
+    full = dict(mlp_act="gelu_tanh", norm_offset=True, embed_scale=True)
+    # Partial combos: each flag alone.
+    for partial in (
+        {"mlp_act": "gelu_tanh"},
+        {"norm_offset": True},
+        {"embed_scale": True},
+    ):
+        cfg = TransformerConfig(**base, **partial)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match="partial Gemma"):
+            to_hf_llama(params, cfg)
+    cfg = TransformerConfig(**base, **full, n_experts=4, moe_top_k=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="MoE"):
+        to_hf_llama(params, cfg)
+    cfg = TransformerConfig(**base, **full, attn_bias=True)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="attn_bias"):
+        to_hf_llama(params, cfg)
+    cfg = TransformerConfig(**base, **full)
+    params = init_params(jax.random.PRNGKey(0), cfg)  # untied wlm
+    with pytest.raises(ValueError, match="tied"):
+        to_hf_llama(params, cfg)
