@@ -127,6 +127,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument(
+        "--prefill-chunk", type=int, default=0, metavar="T",
+        help="chunked prefill: admit long prompts in T-token KV-write "
+        "segments, capping peak admission activations at [slots, T, d] "
+        "(0 = one-shot prefill)",
+    )
+    p.add_argument(
         "--prefix-cache", type=int, default=0, metavar="N",
         help="cache up to N prompt-KV entries (requests marked "
         "cache_prefix); later prompts sharing a cached prefix skip "
@@ -341,6 +347,7 @@ def make_engine(args):
         draft_cfg=draft_cfg,
         penalties=not args.no_penalties,
         max_queue=args.max_queue,
+        prefill_chunk=args.prefill_chunk,
     )
 
 

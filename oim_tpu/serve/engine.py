@@ -936,6 +936,7 @@ class Engine:
         draft_cfg: TransformerConfig | None = None,
         penalties: bool = True,
         max_queue: int = 0,
+        prefill_chunk: int = 0,
     ):
         if n_slots < 1 or max_len < 2 or chunk < 1 or prefix_cache_size < 0:
             raise ValueError(
@@ -1011,6 +1012,24 @@ class Engine:
                 b *= 2
             prompt_buckets.append(self._usable_len - 1)
         self.prompt_buckets = tuple(sorted(set(prompt_buckets)))
+        # Chunked prefill: admissions whose (post-injection) tail
+        # exceeds this run extra KV-write-only dispatches of this
+        # length first, capping peak admission activations at
+        # [S, chunk, d] regardless of prompt length (0 = one-shot).
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {prefill_chunk}"
+            )
+        if prefill_chunk and prefill_chunk not in self.prompt_buckets:
+            # A bucket-exact chunk keeps every non-final segment's
+            # bucketed KV-write window exactly [p, p + chunk) — no
+            # padding past the next segment's start, so only the FINAL
+            # window needs the fit check in the admission loop.
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk} must be one of the "
+                f"prompt buckets {self.prompt_buckets}"
+            )
+        self.prefill_chunk = prefill_chunk
         bad_buckets = [
             b for b in self.prompt_buckets
             if not 1 <= b <= self._usable_len - 1
@@ -1625,6 +1644,7 @@ class Engine:
                 ),
                 "penalties": self.penalties,
                 "prefix_cache_size": self.prefix_cache_size,
+                "prefill_chunk": self.prefill_chunk,
                 "tp": self.mesh.shape.get("tp", 1) if self.mesh else 1,
                 "ep": self.mesh.shape.get("ep", 1) if self.mesh else 1,
             },
@@ -1730,6 +1750,65 @@ class Engine:
             while len(self._prefix_cache) > self.prefix_cache_size:
                 self._prefix_cache.popitem(last=False)
 
+    def _prefill_segment(self, slot: int, req, seg, start: int) -> None:
+        """One non-final chunked-prefill dispatch: write ``seg``'s KV
+        rows for ``slot`` at position ``start`` through the SAME jitted
+        admit program (one active row, padding rows inert) and discard
+        the sampled token — the final segment's normal group dispatch
+        samples for real and overwrites the penalty/length bookkeeping
+        this call touches (idempotent by construction).  No readback:
+        the discarded sample is never fetched."""
+        n_slots = self._cache.n_slots
+        max_len = self._cache.max_len
+        bucket = self._bucket(len(seg))
+        prompts = np.zeros((n_slots, bucket), np.int32)
+        prompts[0, : len(seg)] = seg
+        full_rows = np.zeros(
+            (n_slots, max_len)
+            if (self.spec_decode and self.draft_cfg is None)
+            else (1, 1),
+            np.int32,
+        )
+        if self.spec_decode and self.draft_cfg is None:
+            full_rows[0, : len(req.tokens)] = req.tokens
+        slot_idx = np.full((n_slots,), n_slots, np.int32)
+        slot_idx[0] = slot
+        starts = np.zeros((n_slots,), np.int32)
+        starts[0] = start
+        tails = np.ones((n_slots,), np.int32)
+        tails[0] = len(seg)
+        counts_shape = (
+            (n_slots, self.cfg.vocab_size) if self.penalties else (1, 1)
+        )
+        zero_key = jax.random.PRNGKey(0)
+        (
+            self._cache, self._history,
+            self._tok_counts, self._gen_counts,
+            _first, _lp,
+        ) = self._admit(
+            self.params,
+            self._cache,
+            self._history,
+            self._tok_counts,
+            self._gen_counts,
+            jnp.asarray(np.zeros(counts_shape, np.int32)),
+            jnp.asarray(
+                full_rows if self._admit_d is None
+                else np.zeros((1, 1), np.int32)
+            ),
+            jnp.asarray(prompts),
+            jnp.asarray(slot_idx),
+            jnp.asarray(starts),
+            jnp.asarray(tails),
+            jnp.zeros((n_slots,), jnp.float32),   # temps
+            jnp.ones((n_slots,), jnp.float32),    # top_ps
+            jnp.zeros((n_slots,), jnp.float32),   # min_ps
+            jnp.ones((n_slots,), jnp.float32),    # reps
+            jnp.zeros((n_slots,), jnp.float32),   # press
+            jnp.zeros((n_slots,), jnp.float32),   # freqs
+            jnp.stack([zero_key] * n_slots),
+        )
+
     @staticmethod
     def _fetch(tree, acc: list):
         """jax.device_get with the wait attributed to the caller's
@@ -1787,6 +1866,36 @@ class Engine:
             for slot, rid, req, t_submit in admissions:
                 start = self._try_prefix_inject(slot, req)
                 tail = req.tokens[start:]
+                # Chunked prefill (long-context admission): write the
+                # prompt's KV in prefill_chunk-sized segments so peak
+                # admission activations are [S, chunk, d] instead of
+                # [S, prompt, d]; only the FINAL segment (the normal
+                # group path below) samples the first token.  Exact by
+                # the same argument as prefix-cache injection: a KV row
+                # depends only on the tokens before it, and each
+                # segment attends its predecessors through ``starts``.
+                if self.prefill_chunk and len(tail) > self.prefill_chunk:
+                    segs = []
+                    while len(tail) > self.prefill_chunk:
+                        segs.append(tail[: self.prefill_chunk])
+                        tail = tail[self.prefill_chunk:]
+                    # The FINAL dispatch pads its tail to a bucket;
+                    # dynamic_update_slice CLAMPS an out-of-range start,
+                    # which would silently overwrite earlier live rows.
+                    # Un-chunk from the back (pure list surgery — these
+                    # segments were not dispatched yet) until the final
+                    # bucketed window fits the cache; worst case this
+                    # degenerates to the always-fitting one-shot.
+                    fstart = start + len(segs) * self.prefill_chunk
+                    while segs and (
+                        fstart + self._bucket(len(tail))
+                        > self._cache.max_len
+                    ):
+                        tail = segs.pop() + tail
+                        fstart -= self.prefill_chunk
+                    for seg in segs:
+                        self._prefill_segment(slot, req, seg, start)
+                        start += len(seg)
                 rows.append((slot, rid, req, t_submit, start, tail,
                              self._bucket(len(tail))))
             zero_key = jax.random.PRNGKey(0)

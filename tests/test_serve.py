@@ -1665,3 +1665,101 @@ def test_info_endpoint_and_engine_info(setup):
         assert body == {**info, "tokenizer": None}
     finally:
         server.stop()
+
+
+class TestChunkedPrefill:
+    def test_exactness_across_variants(self, setup):
+        """Chunked prefill is invisible to results: long prompts
+        admitted in 8-token KV segments emit exactly what the one-shot
+        engine emits — greedy, sampled, penalties, int8 KV, prefix
+        cache, and prompt-lookup speculation alike."""
+        cfg, params = setup
+        long_prompt = _prompt(60, 37, cfg.vocab_size)
+        cases = [
+            GenRequest(tokens=long_prompt, max_new_tokens=8),
+            GenRequest(tokens=long_prompt, max_new_tokens=6,
+                       temperature=0.8, seed=5),
+            GenRequest(tokens=long_prompt, max_new_tokens=5,
+                       repetition_penalty=1.3, frequency_penalty=0.2),
+            GenRequest(tokens=_prompt(61, 5, cfg.vocab_size),
+                       max_new_tokens=4),  # short: no chunking path
+        ]
+        dcfg = TransformerConfig(**{**CFG, "d_model": 16, "n_layers": 1,
+                                    "d_ff": 32, "n_heads": 2})
+        dparams = init_params(jax.random.PRNGKey(7), dcfg)
+        for extra in (
+            {},
+            {"kv_int8": True},
+            {"spec_decode": 3},
+            {"spec_decode": 2, "draft_params": dparams,
+             "draft_cfg": dcfg},
+            {"prefix_cache_size": 2},
+        ):
+            variant_cases = (
+                [c for c in cases if c.repetition_penalty == 1.0]
+                if extra.get("spec_decode")  # spec rejects penalties
+                else cases
+            )
+            baseline = None
+            for chunk in (0, 16):
+                eng = Engine(params, cfg, n_slots=2, max_len=96,
+                             chunk=4, prefill_chunk=chunk, **extra)
+                rids = [eng.submit(r) for r in variant_cases]
+                results = eng.run()
+                outs = [results[r] for r in rids]
+                if baseline is None:
+                    baseline = outs
+                else:
+                    assert outs == baseline, (extra, chunk)
+
+    def test_chunked_prefill_with_prefix_injection(self, setup):
+        """Injection start + chunk segments compose: a cached prefix
+        shortens the tail and the remaining segments continue from the
+        injected offset."""
+        cfg, params = setup
+        prefix = _prompt(70, 16, cfg.vocab_size)
+        long_tail = _prompt(71, 24, cfg.vocab_size)
+        outs = []
+        for chunk in (0, 16):
+            eng = Engine(params, cfg, n_slots=2, max_len=96, chunk=4,
+                         prefix_cache_size=2, prefill_chunk=chunk)
+            r1 = eng.submit(GenRequest(tokens=prefix, max_new_tokens=2,
+                                       cache_prefix=True))
+            eng.run()
+            r2 = eng.submit(GenRequest(tokens=prefix + long_tail,
+                                       max_new_tokens=6))
+            outs.append(eng.run()[r2])
+            assert eng.stats()["prefix_hits"] == 1
+        assert outs[0] == outs[1]
+
+    def test_validation(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            Engine(params, cfg, n_slots=1, max_len=64,
+                   prefill_chunk=-1)
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            Engine(params, cfg, n_slots=1, max_len=64,
+                   prefill_chunk=4096)
+        with pytest.raises(ValueError, match="prompt buckets"):
+            Engine(params, cfg, n_slots=1, max_len=64,
+                   prefill_chunk=9)  # not bucket-aligned
+
+    def test_near_max_len_boundary(self, setup):
+        """The clamp hazard: a near-max_len prompt whose final chunked
+        segment's BUCKET window would overrun the cache must un-chunk
+        until it fits — dynamic_update_slice clamps out-of-range starts
+        and would silently corrupt earlier KV rows (round-5 review
+        finding).  Exactness vs one-shot at the boundary proves it."""
+        cfg, params = setup
+        for plen in (85, 88, 89):
+            prompt = _prompt(80 + plen, plen, cfg.vocab_size)
+            outs = []
+            for chunk in (0, 16):
+                eng = Engine(params, cfg, n_slots=2, max_len=96,
+                             chunk=4, prefill_chunk=chunk)
+                rid = eng.submit(
+                    GenRequest(tokens=prompt, max_new_tokens=5,
+                               eos_id=-1)
+                )
+                outs.append(eng.run()[rid])
+            assert outs[0] == outs[1], plen
