@@ -71,9 +71,14 @@ class Checkpointer:
         cfg,
         mesh,
         options: CheckpointerOptions | None = None,
+        zero1: bool = False,
     ):
         self._cfg = cfg
         self._mesh = mesh
+        # ZeRO-1 restore target: moments restore dp-sharded so a resumed
+        # run keeps the sharded-optimizer placement (values are placement-
+        # independent — a zero1 checkpoint restores fine either way).
+        self._zero1 = zero1
         self._options = options or CheckpointerOptions()
         self._mgr = ocp.CheckpointManager(
             directory,
@@ -118,7 +123,9 @@ class Checkpointer:
 
     def _abstract_state(self, init_fn: Callable[[], TrainState]) -> TrainState:
         shape = jax.eval_shape(init_fn)
-        shardings = state_shardings(shape, self._cfg, self._mesh)
+        shardings = state_shardings(
+            shape, self._cfg, self._mesh, zero1=self._zero1
+        )
         return jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
             shape,
@@ -163,7 +170,9 @@ class Checkpointer:
         if step is not None:
             state, data = self.restore(init_fn, step)
             return state, data, True
-        state = shard_state(init_fn(), self._cfg, self._mesh)
+        state = shard_state(
+            init_fn(), self._cfg, self._mesh, zero1=self._zero1
+        )
         return state, None, False
 
     def restore_params(

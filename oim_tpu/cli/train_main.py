@@ -123,6 +123,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="global-norm gradient clip (0 = off)",
     )
     p.add_argument(
+        "--zero1", action="store_true",
+        help="ZeRO-1: shard adamw moments over the dp axis (optimizer "
+        "memory / dp; math unchanged — the update all-gathers params)",
+    )
+    p.add_argument(
         "--grad-accum", type=_positive_int, default=1,
         help="sequential microbatches averaged per optimizer step "
         "(peak activation memory / N at the same global batch)",
@@ -348,6 +353,7 @@ def main(argv=None) -> int:
         checkpointer = Checkpointer(
             args.checkpoint_dir, cfg, mesh,
             options=CheckpointerOptions(save_interval_steps=args.save_every),
+            zero1=args.zero1,
         )
         state, data_state, resumed = checkpointer.restore_or_init(init_fn)
         if resumed:
@@ -363,7 +369,7 @@ def main(argv=None) -> int:
     else:
         from oim_tpu.models.train import shard_state
 
-        state = shard_state(init_fn(), cfg, mesh)
+        state = shard_state(init_fn(), cfg, mesh, zero1=args.zero1)
 
     tokens = _load_corpus(args)
     shard = ShardSpec(jax.process_index(), jax.process_count())

@@ -497,22 +497,50 @@ def params_shardings(params: dict, cfg: TransformerConfig, mesh) -> dict:
     return {name: NamedSharding(mesh, spec(name)) for name in params}
 
 
-def state_shardings(state, cfg: TransformerConfig, mesh) -> TrainState:
+def state_shardings(
+    state, cfg: TransformerConfig, mesh, zero1: bool = False
+) -> TrainState:
     """A TrainState-shaped pytree of NamedShardings: params by their logical
     axes, optimizer moments mirroring the params (optax states are nested
     namedtuples whose moment pytrees share the params' dict structure, so the
     same specs apply), everything else replicated.  ``state`` may be concrete
     or a ``jax.eval_shape`` pytree of ShapeDtypeStructs — only the tree
-    structure is inspected."""
+    structure is inspected.
+
+    ``zero1=True`` additionally shards the optimizer MOMENTS over the
+    ``dp`` axis (ZeRO stage 1): each moment leaf takes its param's spec
+    plus ``dp`` on the first still-unsharded dimension the axis
+    divides.  Because the optax update runs OUTSIDE the manual
+    shard_map region (at GSPMD level, ``_build_train_step``), this is
+    purely a placement change — XLA computes each dp shard's slice of
+    the elementwise update and all-gathers the new params, the ZeRO-1
+    exchange — and adamw's m+v (2x params in f32, the largest state in
+    training) shrink per-device by the dp degree.  Math unchanged
+    (elementwise; proven by trajectory-equality tests)."""
     param_names = set(state.params.keys())
     replicated = NamedSharding(mesh, P())
+    dp_size = mesh.shape.get("dp", 1)
 
     def spec_params(tree: dict) -> dict:
         return params_shardings(tree, cfg, mesh)
 
+    def zero1_specs(tree: dict) -> dict:
+        base = params_shardings(tree, cfg, mesh)
+        out = {}
+        for name, sharding in base.items():
+            spec = list(sharding.spec) if sharding.spec else []
+            shape = tree[name].shape
+            spec += [None] * (len(shape) - len(spec))
+            for i, (axis, dim) in enumerate(zip(spec, shape)):
+                if axis is None and dp_size > 1 and dim % dp_size == 0:
+                    spec[i] = "dp"
+                    break
+            out[name] = NamedSharding(mesh, P(*spec))
+        return out
+
     def mirror(node):
         if isinstance(node, dict) and set(node.keys()) == param_names:
-            return spec_params(node)
+            return zero1_specs(node) if zero1 else spec_params(node)
         if hasattr(node, "_fields"):  # optax namedtuple states
             return type(node)(*(mirror(getattr(node, f)) for f in node._fields))
         if isinstance(node, (list, tuple)):
@@ -528,7 +556,9 @@ def state_shardings(state, cfg: TransformerConfig, mesh) -> TrainState:
     )
 
 
-def shard_state(state: TrainState, cfg: TransformerConfig, mesh) -> TrainState:
+def shard_state(
+    state: TrainState, cfg: TransformerConfig, mesh, zero1: bool = False
+) -> TrainState:
     """Place params — and the optimizer state mirroring them — onto the mesh
     by logical axes (see ``state_shardings``)."""
-    return jax.device_put(state, state_shardings(state, cfg, mesh))
+    return jax.device_put(state, state_shardings(state, cfg, mesh, zero1))
