@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -28,6 +28,15 @@ agent:
 
 test:
 	$(PYTHON) -m pytest tests/ -x -q
+
+# Fleet health & fault management: the fault-injection suite (health
+# marker), hard-capped at 60s — a hung drain/eviction loop is itself a
+# failure.  Slow soak variants (marked slow) stay out of this target AND
+# out of the tier-1 `-m 'not slow'` run; invoke them explicitly with
+# `pytest -m 'health and slow'`.
+test-health:
+	timeout -k 10 60 $(PYTHON) -m pytest tests/test_health.py -q \
+	  -m "health and not slow" -p no:cacheprovider
 
 # Tier 3: the full stack driving a first op on the real accelerator
 # (≙ reference env-gated real-SPDK tests, test/test.make:1-16).

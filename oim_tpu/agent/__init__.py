@@ -23,3 +23,6 @@ EEXIST = -17
 ENODEV = -19
 ENOSPC = -28
 EBUSY = -16
+# JSON-RPC: method not served (how a health-oblivious daemon answers
+# get_health; the HealthReporter degrades to get_chips on it).
+METHOD_NOT_FOUND = -32601

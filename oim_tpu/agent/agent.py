@@ -33,6 +33,24 @@ class Agent:
         when the daemon was started without one."""
         return self.client.invoke("get_pjrt_info")
 
+    def get_health(self) -> list[dict[str, Any]]:
+        """Per-chip health snapshot: ``{chip_id, health, ici_link_errors,
+        allocation}`` per chip.  Servers without health telemetry raise
+        METHOD_NOT_FOUND (-32601); callers that can degrade should (the
+        HealthReporter synthesizes OK states from get_chips then)."""
+        return self.client.invoke("get_health")
+
+    def inject_fault(
+        self, chip_id: int, kind: str, after_n_calls: int = 0
+    ) -> dict[str, Any]:
+        """Schedule a deterministic fault (fake/test agents only):
+        ``failed``/``degraded``/``link_errors``/``clear``, optionally
+        deferred until the Nth subsequent get_health call."""
+        params: dict[str, Any] = {"chip_id": chip_id, "kind": kind}
+        if after_n_calls:
+            params["after_n_calls"] = after_n_calls
+        return self.client.invoke("inject_fault", params)
+
     def find_allocation(self, name: str) -> dict[str, Any] | None:
         found = self.get_allocations(name)
         return found[0] if found else None

@@ -47,6 +47,13 @@ class Chip:
     accel_type: str
     phys_coord: tuple[int, ...]
     allocation: str = ""
+    # Device health telemetry (oim_tpu/health): OK / DEGRADED / FAILED plus
+    # a cumulative ICI-link error counter.  Not part of the chip's wire
+    # shape (to_json) — health travels through get_health only, so the
+    # shared protocol suite's chip-object assertions hold for both
+    # implementations unchanged.
+    health: str = "OK"
+    ici_link_errors: int = 0
 
     def to_json(self, coord: tuple[int, ...] | None = None) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -140,6 +147,83 @@ class ChipStore:
                 phys_coord=coords[i],
             )
         self._coord_to_id = {c.phys_coord: c.chip_id for c in self.chips.values()}
+        # Scripted faults: (calls_remaining, chip_id, kind).  Each
+        # get_health decrements every pending counter and applies the
+        # faults that reach zero — deterministic ("the Nth scrape sees the
+        # failure"), no wall clock involved.
+        self._pending_faults: list[list] = []
+
+    # -- health ------------------------------------------------------------
+
+    _FAULT_KINDS = ("degraded", "failed", "link_errors", "clear")
+
+    def inject_fault(
+        self, chip_id: int, kind: str, after_n_calls: int = 0
+    ) -> dict[str, Any]:
+        """Schedule a deterministic fault on one chip.
+
+        ``kind``: ``failed``/``degraded`` set the health state,
+        ``link_errors`` bumps the ICI error counter, ``clear`` restores the
+        chip to pristine OK.  With ``after_n_calls`` > 0 the fault
+        manifests only after that many subsequent ``get_health`` calls, so
+        tests can script "the reporter's Nth scrape sees it"."""
+        if kind not in self._FAULT_KINDS:
+            raise RpcAppError(
+                INVALID_PARAMS,
+                f"kind must be one of {'/'.join(self._FAULT_KINDS)}",
+            )
+        with self._lock:
+            chip = self.chips.get(int(chip_id))
+            if chip is None:
+                raise RpcAppError(ENODEV, f"no chip {chip_id}")
+            if after_n_calls > 0:
+                self._pending_faults.append([int(after_n_calls), chip.chip_id, kind])
+            else:
+                self._apply_fault(chip, kind)
+            return {"chip_id": chip.chip_id, "health": chip.health,
+                    "pending": after_n_calls > 0}
+
+    def _apply_fault(self, chip: Chip, kind: str) -> None:
+        """Mutate chip health; caller holds the lock."""
+        if kind == "failed":
+            chip.health = "FAILED"
+        elif kind == "degraded":
+            # A FAILED chip never un-fails by a mere degradation report.
+            if chip.health != "FAILED":
+                chip.health = "DEGRADED"
+        elif kind == "link_errors":
+            chip.ici_link_errors += 1
+        elif kind == "clear":
+            chip.health = "OK"
+            chip.ici_link_errors = 0
+            self._pending_faults = [
+                p for p in self._pending_faults if p[1] != chip.chip_id
+            ]
+
+    def get_health(self) -> list[dict[str, Any]]:
+        """Per-chip health snapshot; applies any due scripted faults."""
+        with self._lock:
+            due = []
+            for pending in self._pending_faults:
+                pending[0] -= 1
+                if pending[0] <= 0:
+                    due.append(pending)
+            self._pending_faults = [
+                p for p in self._pending_faults if p not in due
+            ]
+            for _, chip_id, kind in due:
+                chip = self.chips.get(chip_id)
+                if chip is not None:
+                    self._apply_fault(chip, kind)
+            return [
+                {
+                    "chip_id": c.chip_id,
+                    "health": c.health,
+                    "ici_link_errors": c.ici_link_errors,
+                    "allocation": c.allocation,
+                }
+                for c in self.chips.values()
+            ]
 
     # -- allocator ---------------------------------------------------------
 
@@ -311,6 +395,16 @@ class ChipStore:
         if method == "get_chips":
             with self._lock:
                 return [c.to_json() for c in self.chips.values()]
+        if method == "get_health":
+            return self.get_health()
+        if method == "inject_fault":
+            if "chip_id" not in params:
+                raise RpcAppError(INVALID_PARAMS, "chip_id required")
+            return self.inject_fault(
+                int(params["chip_id"]),
+                str(params.get("kind", "")),
+                int(params.get("after_n_calls", 0)),
+            )
         if method == "get_allocations":
             name = params.get("name")
             with self._lock:

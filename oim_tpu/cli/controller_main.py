@@ -34,6 +34,13 @@ def main(argv=None) -> int:
         help="seconds between re-registrations",
     )
     parser.add_argument(
+        "--health-interval",
+        type=float,
+        default=5.0,
+        help="seconds between chip-health reports to the registry "
+        "(leased health/<id>/<chip> keys; 0 disables)",
+    )
+    parser.add_argument(
         "--coordinator-host",
         default="127.0.0.1",
         help="host part of the JAX coordinator address handed to workloads",
@@ -69,6 +76,7 @@ def main(argv=None) -> int:
         tls=tls,
         registry_delay=args.registry_delay,
         coordinator_host=args.coordinator_host,
+        health_interval=args.health_interval,
     )
     server = controller.start_server(args.endpoint)
     controller.start(args.advertised_endpoint or str(server.addr()))

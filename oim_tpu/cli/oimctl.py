@@ -6,12 +6,18 @@
     oimctl watch [PATH]           stream changes (snapshot, then live)
     oimctl map VOLUME --controller ID --chips N    ad-hoc MapVolume
     oimctl unmap VOLUME --controller ID
+    oimctl health                 fleet chip health, cordons, evictions
+    oimctl drain ID [--reason R]  cordon a controller (evicts its volumes)
+    oimctl uncordon ID            lift a cordon
+    oimctl remap VOLUME --controller ID --chips N  clear eviction + map
     oimctl trace FILE [FILE...]   merge daemons' span files, print trees
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import time
 
 import grpc
 
@@ -19,6 +25,7 @@ from oim_tpu import log
 from oim_tpu.common import endpoint as ep
 from oim_tpu.common import tracing
 from oim_tpu.common.tlsconfig import load_tls
+from oim_tpu.health import states as health_states
 from oim_tpu.spec import CONTROLLER, REGISTRY, oim_pb2
 
 
@@ -30,6 +37,29 @@ def _channel(args):
             target, tls.channel_credentials(), options=tls.channel_options()
         )
     return grpc.insecure_channel(target)
+
+
+def _map_and_print(channel, volume: str, controller: str, chips: int) -> None:
+    """One MapVolume through the proxy + the human-readable assignment —
+    shared by `map` and `remap` so their request shape and output can
+    never drift."""
+    request = oim_pb2.MapVolumeRequest(volume_id=volume)
+    if chips > 0:
+        request.slice.chip_count = chips
+    else:
+        request.provisioned.SetInParent()
+    reply = CONTROLLER.stub(channel).MapVolume(
+        request,
+        metadata=(("controllerid", controller),),
+        timeout=60,
+    )
+    print(f"mesh={list(reply.mesh.dims)}")
+    print(f"coordinator={reply.coordinator_address}")
+    for chip in reply.chips:
+        print(
+            f"chip {chip.chip_id}: {chip.device_path} "
+            f"coord={list(chip.coord.coords)}"
+        )
 
 
 def main(argv=None) -> int:
@@ -69,6 +99,32 @@ def main(argv=None) -> int:
     unmap = sub.add_parser("unmap")
     unmap.add_argument("volume")
     unmap.add_argument("--controller", required=True)
+    sub.add_parser(
+        "health",
+        help="fleet health: chips by state (from health/ telemetry), "
+        "cordoned controllers, evicted volumes",
+    )
+    drain = sub.add_parser(
+        "drain",
+        help="cordon a controller: the fleet monitor evicts its "
+        "allocations so they can be remapped elsewhere",
+    )
+    drain.add_argument("controller_id")
+    drain.add_argument("--reason", default="operator drain")
+    uncordon = sub.add_parser("uncordon", help="lift a drain cordon")
+    uncordon.add_argument("controller_id")
+    remap = sub.add_parser(
+        "remap",
+        help="clear a volume's eviction mark and map it on a (healthy) "
+        "controller",
+    )
+    remap.add_argument("volume")
+    remap.add_argument("--controller", required=True)
+    remap.add_argument("--chips", type=int, default=0, help="0 = provisioned")
+    remap.add_argument(
+        "--force", action="store_true",
+        help="ignore the eviction policy's remap backoff window",
+    )
     topo = sub.add_parser("topology", help="chip inventory of a controller")
     topo.add_argument("--controller", required=True)
     slices = sub.add_parser("slices", help="allocations on a controller")
@@ -262,29 +318,132 @@ def main(argv=None) -> int:
                     print(f"error: {exc.code().name}: {exc.details()}")
                     return 1
         elif args.command == "map":
-            request = oim_pb2.MapVolumeRequest(volume_id=args.volume)
-            if args.chips > 0:
-                request.slice.chip_count = args.chips
-            else:
-                request.provisioned.SetInParent()
-            reply = CONTROLLER.stub(channel).MapVolume(
-                request,
-                metadata=(("controllerid", args.controller),),
-                timeout=60,
-            )
-            print(f"mesh={list(reply.mesh.dims)}")
-            print(f"coordinator={reply.coordinator_address}")
-            for chip in reply.chips:
-                print(
-                    f"chip {chip.chip_id}: {chip.device_path} "
-                    f"coord={list(chip.coord.coords)}"
-                )
+            _map_and_print(channel, args.volume, args.controller, args.chips)
         elif args.command == "unmap":
             CONTROLLER.stub(channel).UnmapVolume(
                 oim_pb2.UnmapVolumeRequest(volume_id=args.volume),
                 metadata=(("controllerid", args.controller),),
                 timeout=60,
             )
+        elif args.command == "health":
+            stub = REGISTRY.stub(channel)
+            rows = []
+            for value in stub.GetValues(
+                oim_pb2.GetValuesRequest(path=health_states.HEALTH_PREFIX),
+                timeout=30,
+            ).values:
+                parsed = health_states.parse_health_path(value.path)
+                report = health_states.decode_report(value.value)
+                if parsed is None or report is None:
+                    continue
+                rows.append((parsed[0], parsed[1], report))
+            if rows:
+                print(
+                    f"{'CONTROLLER':<16} {'CHIP':<6} {'STATE':<10} "
+                    f"{'LINK_ERRS':<10} ALLOCATION"
+                )
+                for cid, chip, report in sorted(
+                    rows,
+                    key=lambda r: (
+                        r[0],
+                        (0, int(r[1]), "") if r[1].isdigit() else (1, 0, r[1]),
+                    ),
+                ):
+                    print(
+                        f"{cid:<16} {chip:<6} {report['state']:<10} "
+                        f"{report['link_errors']:<10} {report['allocation']}"
+                    )
+            else:
+                print("no health telemetry (no reporting controllers)")
+            for value in stub.GetValues(
+                oim_pb2.GetValuesRequest(path=health_states.DRAIN_PREFIX),
+                timeout=30,
+            ).values:
+                cid = health_states.parse_drain_path(value.path)
+                if cid is not None and value.value:
+                    print(f"cordoned: {cid} ({value.value})")
+            for value in stub.GetValues(
+                oim_pb2.GetValuesRequest(path=health_states.EVICTIONS_PREFIX),
+                timeout=30,
+            ).values:
+                volume = health_states.parse_eviction_path(value.path)
+                if volume is not None and value.value:
+                    print(f"evicted: {volume} {value.value}")
+        elif args.command == "drain":
+            REGISTRY.stub(channel).SetValue(
+                oim_pb2.SetValueRequest(
+                    value=oim_pb2.Value(
+                        path=health_states.drain_key(args.controller_id),
+                        value=args.reason,
+                    )
+                ),
+                timeout=30,
+            )
+            print(f"cordoned {args.controller_id}")
+        elif args.command == "uncordon":
+            REGISTRY.stub(channel).SetValue(
+                oim_pb2.SetValueRequest(
+                    value=oim_pb2.Value(
+                        path=health_states.drain_key(args.controller_id),
+                        value="",
+                    )
+                ),
+                timeout=30,
+            )
+            print(f"uncordoned {args.controller_id}")
+        elif args.command == "remap":
+            stub = REGISTRY.stub(channel)
+            path = health_states.eviction_key(args.volume)
+            record = None
+            for value in stub.GetValues(
+                oim_pb2.GetValuesRequest(path=path), timeout=30
+            ).values:
+                if value.path == path and value.value:
+                    try:
+                        record = json.loads(value.value)
+                    except ValueError:
+                        record = {}
+            if record is not None and not args.force:
+                remap_after = float(record.get("remap_after") or 0.0)
+                wait = remap_after - time.time()
+                if wait > 0:
+                    print(
+                        f"error: {args.volume!r} is in its remap backoff "
+                        f"for another {wait:.1f}s (use --force to override)"
+                    )
+                    return 1
+            # Release the old placement first so the faulted controller's
+            # chips free up and its telemetry stops claiming the volume
+            # (idempotent; a DEAD controller is expected to be
+            # unreachable — controller-dead evictions have nothing left
+            # to unmap).
+            old = (record or {}).get("controller", "")
+            if old:
+                try:
+                    CONTROLLER.stub(channel).UnmapVolume(
+                        oim_pb2.UnmapVolumeRequest(volume_id=args.volume),
+                        metadata=(("controllerid", old),),
+                        timeout=15,
+                    )
+                except grpc.RpcError as exc:
+                    print(
+                        f"note: unmap on old controller {old!r} failed "
+                        f"({exc.code().name}); continuing"
+                    )
+            # Map BEFORE clearing the eviction mark: if the new placement
+            # fails (ENOSPC, dead controller) the volume must stay
+            # evicted, or a retried NodeStage would land it right back on
+            # the faulted slice.
+            print(f"remapping {args.volume} onto {args.controller}")
+            _map_and_print(channel, args.volume, args.controller, args.chips)
+            if record is not None:
+                stub.SetValue(
+                    oim_pb2.SetValueRequest(
+                        value=oim_pb2.Value(path=path, value="")
+                    ),
+                    timeout=30,
+                )
+            print(f"remapped {args.volume} onto {args.controller}")
         elif args.command == "topology":
             reply = CONTROLLER.stub(channel).GetTopology(
                 oim_pb2.GetTopologyRequest(),

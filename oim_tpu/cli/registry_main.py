@@ -48,6 +48,28 @@ def main(argv=None) -> int:
         "in-process etcd stand-in other registry replicas can point "
         "their --db etcd:// at)",
     )
+    parser.add_argument(
+        "--fleet-monitor",
+        action="store_true",
+        help="run the fleet health monitor next to the registry: watch "
+        "health/ telemetry and controller leases, evict allocations on "
+        "chip failure / controller death / operator drain "
+        "(oim_tpu.health.FleetMonitor)",
+    )
+    parser.add_argument(
+        "--degraded-grace",
+        type=float,
+        default=30.0,
+        help="seconds a chip must stay DEGRADED before its allocation "
+        "is drained (with --fleet-monitor)",
+    )
+    parser.add_argument(
+        "--remap-backoff",
+        type=float,
+        default=0.0,
+        help="seconds an evicted volume must wait before `oimctl remap` "
+        "(with --fleet-monitor)",
+    )
     parser.add_argument("--log-level", default="info")
     parser.add_argument(
         "--trace-file",
@@ -86,6 +108,22 @@ def main(argv=None) -> int:
         )
         db = EtcdRegistryDB(str(etcd_server.addr()))
     registry = Registry(db=db, tls=tls)
+    monitor = None
+    if args.fleet_monitor:
+        from oim_tpu.health import EvictionPolicy, FleetMonitor
+
+        monitor = FleetMonitor(
+            db,
+            policy=EvictionPolicy(
+                degraded_grace_s=args.degraded_grace,
+                remap_backoff_s=args.remap_backoff,
+            ),
+        ).start()
+        log.current().info(
+            "fleet monitor running",
+            degraded_grace=args.degraded_grace,
+            remap_backoff=args.remap_backoff,
+        )
     server = registry.start_server(args.endpoint)
     log.current().info("oim-registry running", endpoint=str(server.addr()))
     try:
@@ -95,6 +133,8 @@ def main(argv=None) -> int:
         if etcd_server is not None:
             etcd_server.stop()
     finally:
+        if monitor is not None:
+            monitor.close()
         registry.close()
         if metrics_server is not None:
             metrics_server.stop()

@@ -56,6 +56,13 @@ class ChannelCache:
             Hashable, tuple[Hashable, grpc.Channel, float]
         ] = {}
         self._retired: list[tuple[grpc.Channel, float]] = []
+        # Churn counter: bumps every time a LIVE cached channel is torn
+        # down (invalidate of an existing entry, fingerprint-change
+        # re-dial, or idle eviction).  Regression guard for "a heartbeat
+        # re-put of an unchanged address must not churn the proxy
+        # channel" (registry._on_address_event) — reuse is free, churn
+        # is observable.
+        self.churn = 0
 
     def _retire_locked(self, channel: grpc.Channel, now: float) -> None:
         self._retired.append((channel, now))
@@ -88,6 +95,7 @@ class ChannelCache:
                 if now - used > self.max_idle_s
             ]:
                 self._retire_locked(self._entries.pop(k)[1], now)
+                self.churn += 1
             to_close = self._reap_locked(now)
             hit = None
             entry = self._entries.get(key)
@@ -99,6 +107,7 @@ class ChannelCache:
                 else:
                     self._retire_locked(channel, now)
                     del self._entries[key]
+                    self.churn += 1
         # Reaped channels must close even if dial() below raises — they
         # are already off the retired list, so this is their only close.
         try:
@@ -137,6 +146,7 @@ class ChannelCache:
             entry = self._entries.pop(key, None)
             if entry is not None:
                 self._retire_locked(entry[1], now)
+                self.churn += 1
             to_close = self._reap_locked(now)
         for channel in to_close:
             channel.close()

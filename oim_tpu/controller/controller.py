@@ -60,6 +60,7 @@ class Controller:
         tls: TLSConfig | None = None,
         registry_delay: float = DEFAULT_REGISTRY_DELAY,
         coordinator_host: str = "127.0.0.1",
+        health_interval: float = 0.0,
     ) -> None:
         self.controller_id = controller_id
         self.agent_socket = agent_socket
@@ -67,12 +68,17 @@ class Controller:
         self.tls = tls
         self.registry_delay = registry_delay
         self.coordinator_host = coordinator_host
+        # > 0 starts a HealthReporter next to the address heartbeat
+        # (oim_tpu/health): leased health/<id>/<chip> keys each interval.
+        self.health_interval = health_interval
         self._mutex = KeyMutex()
         self._agent: Agent | None = None
         self._agent_lock = threading.Lock()
         # Heartbeat state (Start/Close).
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._health_reporter = None
+        self._closed = False
         self._advertised_address = ""
         # Chip occupancy, evaluated against the agent at scrape time (so
         # the gauge can never drift from the allocator's truth).  Scrapes
@@ -423,10 +429,23 @@ class Controller:
             return
         self._advertised_address = advertised_address
         self._stop.clear()
+        self._closed = False
         self._thread = threading.Thread(
             target=self._register_loop, daemon=True, name="controller-register"
         )
         self._thread.start()
+        if self.health_interval > 0:
+            # Chip-health telemetry rides the same lease discipline as the
+            # address heartbeat (oim_tpu/health/reporter.py).
+            from oim_tpu.health import HealthReporter
+
+            self._health_reporter = HealthReporter(
+                self.controller_id,
+                self.agent_socket,
+                self.registry_address,
+                tls=self.tls,
+                interval=self.health_interval,
+            ).start()
 
     def _register_loop(self) -> None:
         while True:
@@ -476,10 +495,20 @@ class Controller:
         )
 
     def close(self) -> None:
+        """Stop the heartbeat, the health reporter, and agent connections.
+        Idempotent: `close(); close()` neither raises nor leaks threads —
+        every shutdown step either guards on state it nulls out or is a
+        no-op the second time."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._health_reporter is not None:
+            self._health_reporter.close()
+            self._health_reporter = None
+        if self._closed:
+            return
+        self._closed = True
         self._drop_agent()
         self._drop_scrape_agent()
         # Deregister the gauge series — but only if a newer controller

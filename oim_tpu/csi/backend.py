@@ -437,6 +437,25 @@ class RemoteBackend:
 
         return self._call(run)
 
+    def _check_not_evicted(self, channel, volume_id: str) -> None:
+        """Refuse to stage a volume the fault-management loop has marked
+        evicted (oim_tpu/health): FAILED_PRECONDITION until an operator
+        remaps it (``oimctl remap``) — staging onto a faulted slice would
+        hand the workload dead chips."""
+        from oim_tpu.health import states as health_states
+
+        path = health_states.eviction_key(volume_id)
+        reply = REGISTRY.stub(channel).GetValues(
+            oim_pb2.GetValuesRequest(path=path), timeout=30
+        )
+        for value in reply.values:
+            if value.path == path and value.value:
+                raise VolumeError(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"volume {volume_id!r} is evicted ({value.value}); "
+                    "remap it with `oimctl remap` before staging",
+                )
+
     def default_pci(self, channel) -> str:
         """Registry-stored PCI default for this controller
         (≙ remote.go:129-145)."""
@@ -453,6 +472,7 @@ class RemoteBackend:
         self, volume_id: str, params: dict, deadline: float | None = None
     ) -> StagedDevice:
         def run(channel):
+            self._check_not_evicted(channel, volume_id)
             default_pci = self.default_pci(channel)
             if self.map_params is not None:
                 # Emulation hook: translate a foreign driver's parameters

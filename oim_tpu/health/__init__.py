@@ -1,0 +1,31 @@
+"""Fleet health & fault management (new subsystem; no reference analog —
+the reference assumes a healthy device plane and lets dead controllers'
+registrations rot, reference registry.go lease-free SetValue).
+
+Four layers, built entirely on the registry's existing lease + watch
+primitives:
+
+- device plane: per-chip health + deterministic fault injection
+  (``oim_tpu.agent.fake`` / ``Agent.get_health``/``inject_fault``)
+- controller: :class:`HealthReporter` publishes leased
+  ``health/<controller>/<chip>`` keys each interval
+- registry side: :class:`FleetMonitor` classifies events (chip-failed,
+  chip-degraded, controller-dead, operator drain) and drives the
+  :class:`EvictionEngine`, which marks ``evictions/<volume>`` so the CSI
+  RemoteBackend refuses to stage the volume until ``oimctl remap``
+- operator surface: ``oimctl health`` / ``drain`` / ``uncordon`` /
+  ``remap`` plus ``oim_health_*`` and ``oim_evictions_total`` metrics
+"""
+
+from oim_tpu.health import states
+from oim_tpu.health.monitor import EvictionEngine, EvictionPolicy, FleetMonitor
+from oim_tpu.health.reporter import DEFAULT_HEALTH_INTERVAL, HealthReporter
+
+__all__ = [
+    "DEFAULT_HEALTH_INTERVAL",
+    "EvictionEngine",
+    "EvictionPolicy",
+    "FleetMonitor",
+    "HealthReporter",
+    "states",
+]

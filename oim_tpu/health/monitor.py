@@ -1,0 +1,450 @@
+"""Registry-side fault management: watch health keys, classify, evict.
+
+Runs next to the registry (sharing its ``RegistryDB``), fully event-driven:
+the only subscription is one ``db.watch`` — the same primitive the
+WatchValues dispatcher and the serving router ride — so when no monitor is
+attached nothing polls, and when one is, detection latency is the event
+hub's, not a poll tick's.
+
+Event classification:
+
+- ``health/<cid>/<chip>`` set → chip telemetry.  FAILED evicts the owning
+  allocation immediately; DEGRADED arms a drain grace timer (cancelled if
+  the chip recovers before it fires); OK disarms.
+- ``<cid>/address`` deleted (explicit or lease expiry) → controller-dead:
+  every allocation last seen on that controller is evicted **without any
+  RPC to the dead controller** — the monitor's knowledge comes entirely
+  from past health reports, so detection is bounded by lease TTL + one
+  sweep interval, never by a connect timeout to a dead host.
+- ``drain/<cid>`` set (``oimctl drain``) → operator cordon: evict the
+  controller's allocations at the operator's request.
+
+Eviction marks the volume in the registry (``evictions/<volume_id>``); the
+CSI RemoteBackend refuses to stage a marked volume and ``oimctl remap``
+clears the mark (after the policy's remap backoff) to place the volume on
+a healthy controller.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Hashable
+
+from oim_tpu import log
+from oim_tpu.common import metrics
+from oim_tpu.health import states
+
+
+@dataclass
+class EvictionPolicy:
+    """Knobs for the fault-management loop.
+
+    - ``degraded_grace_s``: a DEGRADED chip is drained only after staying
+      degraded this long (transient blips recover for free).
+    - ``remap_backoff_s``: an evicted volume may be remapped only this long
+      after eviction (lets in-flight teardown settle before the slice is
+      rebuilt elsewhere); ``oimctl remap --force`` overrides.
+    """
+
+    degraded_grace_s: float = 30.0
+    remap_backoff_s: float = 0.0
+
+
+class _GraceTimer:
+    """Lazy one-thread deadline scheduler (the _LeaseSweeper shape, for
+    monitor grace periods).  ``arm(key, deadline)`` schedules,
+    ``disarm(key)`` cancels; the callback fires OFF the caller's locks.
+    No thread exists until the first arm; an idle timer waits on its
+    condition, it does not poll."""
+
+    def __init__(self, fire: Callable[[Hashable], None]) -> None:
+        self._fire = fire
+        self._cond = threading.Condition()
+        self._seq: dict[Hashable, int] = {}
+        self._armed: dict[Hashable, tuple[float, int]] = {}
+        self._heap: list[tuple[float, int, Hashable]] = []
+        self._thread: threading.Thread | None = None
+        self._closed = False
+
+    def arm(self, key: Hashable, deadline: float) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            seq = self._seq.get(key, 0) + 1
+            self._seq[key] = seq
+            self._armed[key] = (deadline, seq)
+            heapq.heappush(self._heap, (deadline, seq, key))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="fleet-grace-timer"
+                )
+                self._thread.start()
+            self._cond.notify()
+
+    def armed(self, key: Hashable) -> bool:
+        with self._cond:
+            return key in self._armed
+
+    def disarm(self, key: Hashable) -> None:
+        with self._cond:
+            if key in self._armed:
+                self._seq[key] = self._seq.get(key, 0) + 1
+                del self._armed[key]  # stale heap entries skip on seq
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._armed.clear()
+            thread = self._thread
+            self._cond.notify()
+        if thread is not None:
+            thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                due: list[Hashable] = []
+                while self._heap and self._heap[0][0] <= now:
+                    deadline, seq, key = heapq.heappop(self._heap)
+                    if self._armed.get(key) == (deadline, seq):
+                        del self._armed[key]
+                        due.append(key)
+                if not due:
+                    wait = self._heap[0][0] - now if self._heap else None
+                    self._cond.wait(timeout=wait)
+                    continue
+            for key in due:  # outside the condition: fire may re-arm
+                try:
+                    self._fire(key)
+                except Exception as exc:
+                    # A failed drain must cost ONE deadline, not the
+                    # timer thread — arm() never respawns a dead one.
+                    log.current().error(
+                        "grace-timer callback failed",
+                        key=str(key),
+                        error=str(exc),
+                    )
+
+
+class EvictionEngine:
+    """Marks allocations evicted in the registry, once, with metrics."""
+
+    def __init__(self, db, policy: EvictionPolicy | None = None) -> None:
+        self.db = db
+        self.policy = policy or EvictionPolicy()
+        self._lock = threading.Lock()
+        self._evictions = metrics.registry().counter(
+            "oim_evictions_total",
+            "Allocations marked evicted by the fault-management loop.",
+            ("reason",),
+        )
+        self._detect = metrics.registry().histogram(
+            "oim_health_detect_seconds",
+            "Fault publish → eviction decision latency.",
+        )
+
+    def evict(
+        self,
+        volume_id: str,
+        controller_id: str,
+        reason: str,
+        detail: str = "",
+        reported_ts: float | None = None,
+    ) -> bool:
+        """Mark ``volume_id`` evicted; returns False if already marked
+        (idempotent — a flapping health key must not inflate the counter)."""
+        key = states.eviction_key(volume_id)
+        now = time.time()
+        with self._lock:  # lookup→store must be atomic across threads
+            if self.db.lookup(key):
+                return False
+            self.db.store(
+                key,
+                json.dumps(
+                    {
+                        "state": "evicted",
+                        "controller": controller_id,
+                        "reason": reason,
+                        "detail": detail,
+                        "ts": now,
+                        "remap_after": now + self.policy.remap_backoff_s,
+                    },
+                    separators=(",", ":"),
+                ),
+            )
+        self._evictions.inc(reason)
+        if reported_ts:
+            self._detect.observe(max(0.0, now - reported_ts))
+        log.current().warning(
+            "allocation evicted",
+            volume=volume_id,
+            controller=controller_id,
+            reason=reason,
+            detail=detail,
+        )
+        return True
+
+    def clear(self, volume_id: str) -> None:
+        """Lift an eviction mark (the in-process analog of ``oimctl
+        remap``'s SetValue delete)."""
+        self.db.store(states.eviction_key(volume_id), "")
+
+
+class FleetMonitor:
+    """Watches the registry DB and drives the EvictionEngine."""
+
+    def __init__(
+        self,
+        db,
+        policy: EvictionPolicy | None = None,
+        engine: EvictionEngine | None = None,
+    ) -> None:
+        self.db = db
+        self.policy = policy or EvictionPolicy()
+        self.engine = engine or EvictionEngine(db, self.policy)
+        # RLock: an eviction store can re-dispatch events on this thread.
+        self._lock = threading.RLock()
+        self._live: dict[tuple[str, str], dict] = {}  # (cid, chip) → report
+        # Last-known chip → allocation per controller.  Survives health-key
+        # lease expiry (a dying controller's health keys may expire BEFORE
+        # its address does); cleared only once the controller-dead eviction
+        # has consumed it.
+        self._allocs: dict[str, dict[str, str]] = {}
+        self._controllers: set[str] = set()
+        self._cordoned: set[str] = set()  # drain/<cid> present
+        # volume → wall-clock time its eviction mark was last cleared
+        # (oimctl remap).  Telemetry PUBLISHED before the clear must not
+        # re-evict the freshly remapped volume — the old controller's
+        # in-flight report still names it until its next scrape.
+        self._cleared: dict[str, float] = {}
+        self._timer = _GraceTimer(self._grace_fired)
+        self._cancel_watch: Callable[[], None] | None = None
+        self._chips_gauge = metrics.registry().gauge(
+            "oim_health_chips",
+            "Chips by reported health state.",
+            ("controller", "state"),
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FleetMonitor":
+        if self._cancel_watch is not None:
+            return self
+        # Subscribe BEFORE the snapshot so no event between the two is
+        # lost; handlers are idempotent, so a duplicate is harmless (the
+        # WatchValues reconcile discipline).
+        self._cancel_watch = self.db.watch("", self._on_event)
+        for path, value in self.db.items(""):
+            self._on_event(path, value)
+        return self
+
+    def close(self) -> None:
+        if self._cancel_watch is not None:
+            self._cancel_watch()
+            self._cancel_watch = None
+        self._timer.close()
+        with self._lock:
+            controllers = list(self._controllers)
+            self._controllers.clear()
+            self._live.clear()
+            self._allocs.clear()
+        for cid in controllers:
+            for state in states.HEALTH_STATES:
+                self._chips_gauge.remove(cid, state)
+
+    # -- observability -----------------------------------------------------
+
+    def chip_states(self) -> dict[tuple[str, str], str]:
+        """(controller, chip) → state snapshot (oimctl/tests)."""
+        with self._lock:
+            return {k: r["state"] for k, r in self._live.items()}
+
+    def _claimed_elsewhere(self, volume: str, cid: str) -> bool:
+        """True when another controller's telemetry currently claims
+        ``volume``.  Defense in depth behind the registry authz: a buggy
+        or compromised controller can write only its own ``health/<id>/*``
+        subtree, so without this check one spoofed report naming a
+        foreign volume would evict it fleet-wide."""
+        with self._lock:
+            for other, chips in self._allocs.items():
+                if other != cid and volume in chips.values():
+                    return True
+        return False
+
+    def _evict_from_report(
+        self, volume: str, cid: str, reason: str, detail: str,
+        reported_ts: float | None = None,
+    ) -> None:
+        if reported_ts:
+            with self._lock:
+                cleared_at = self._cleared.get(volume, 0.0)
+            if reported_ts <= cleared_at:
+                # Telemetry published before the operator's remap cleared
+                # the mark: the pre-remap state, not news.
+                return
+        if self._claimed_elsewhere(volume, cid):
+            log.current().warning(
+                "ignoring eviction for foreign volume",
+                volume=volume,
+                controller=cid,
+                reason=reason,
+            )
+            return
+        self.engine.evict(
+            volume, cid, reason, detail=detail, reported_ts=reported_ts
+        )
+
+    def _update_gauge(self, cid: str) -> None:
+        with self._lock:
+            counts = {s: 0 for s in states.HEALTH_STATES}
+            for (rcid, _), report in self._live.items():
+                if rcid == cid:
+                    counts[report["state"]] += 1
+        for state, n in counts.items():
+            self._chips_gauge.set(n, cid, state)
+
+    # -- event classification ----------------------------------------------
+
+    def _on_event(self, path: str, value: str) -> None:
+        """Classify one registry mutation.  Never raises: this runs
+        inside the DB's watch dispatch, on whatever thread committed the
+        mutation — an exception here would propagate into the lease
+        sweeper (killing ALL expiry for the registry) or abort a
+        client's SetValue RPC."""
+        try:
+            self._classify(path, value)
+        except Exception as exc:
+            log.current().error(
+                "fleet monitor event failed", path=path, error=str(exc)
+            )
+
+    def _classify(self, path: str, value: str) -> None:
+        health = states.parse_health_path(path)
+        if health is not None:
+            self._on_health(health[0], health[1], value)
+            return
+        cid = states.parse_address_path(path)
+        if cid is not None and value == "":
+            self._on_controller_dead(cid)
+            return
+        cid = states.parse_drain_path(path)
+        if cid is not None:
+            if value != "":
+                self._on_drain(cid, value)
+            else:
+                with self._lock:
+                    self._cordoned.discard(cid)
+            return
+        volume = states.parse_eviction_path(path)
+        if volume is not None and value == "":
+            with self._lock:
+                self._cleared[volume] = time.time()
+                if len(self._cleared) > 4096:  # bound the remap history
+                    oldest = min(self._cleared, key=self._cleared.get)
+                    del self._cleared[oldest]
+
+    def _on_health(self, cid: str, chip: str, value: str) -> None:
+        key = (cid, chip)
+        if value == "":
+            # Key expired/deleted: the chip stops counting toward live
+            # state but its last-known allocation is retained for the
+            # controller-dead path.
+            with self._lock:
+                self._live.pop(key, None)
+            self._timer.disarm(key)
+            self._update_gauge(cid)
+            return
+        report = states.decode_report(value)
+        if report is None:
+            return  # malformed/foreign value: never kill the watcher
+        with self._lock:
+            prev = self._live.get(key)
+            self._live[key] = report
+            self._controllers.add(cid)
+            if report["allocation"]:
+                self._allocs.setdefault(cid, {})[chip] = report["allocation"]
+            else:
+                self._allocs.get(cid, {}).pop(chip, None)
+        self._update_gauge(cid)
+        state = report["state"]
+        with self._lock:
+            cordoned = cid in self._cordoned
+        if cordoned and report["allocation"]:
+            # An allocation surfacing on a cordoned controller is evicted
+            # on sight — the drain stays in force until uncordon, even
+            # across a monitor restart (the cordon set is rebuilt from
+            # the drain/ snapshot).
+            self._evict_from_report(
+                report["allocation"], cid, "drained", f"chip {chip}",
+                reported_ts=report["ts"],
+            )
+            return
+        if state == states.FAILED:
+            self._timer.disarm(key)
+            if report["allocation"]:
+                self._evict_from_report(
+                    report["allocation"],
+                    cid,
+                    "chip-failed",
+                    f"chip {chip}",
+                    reported_ts=report["ts"],
+                )
+        elif state == states.DEGRADED:
+            # Arm on the transition INTO degraded (refreshes of a
+            # still-degraded chip must not push the drain deadline out
+            # forever) — and ALSO when the chip's allocation changed: a
+            # volume placed onto an already-degraded chip after an
+            # earlier grace fired gets its own full grace, not a free
+            # pass.
+            fresh = (
+                prev is None
+                or prev["state"] != states.DEGRADED
+                or prev.get("allocation", "") != report["allocation"]
+            )
+            if fresh and not self._timer.armed(key):
+                self._timer.arm(
+                    key, time.monotonic() + self.policy.degraded_grace_s
+                )
+        else:  # OK — recovery cancels a pending drain
+            self._timer.disarm(key)
+
+    def _grace_fired(self, key) -> None:
+        cid, chip = key
+        with self._lock:
+            report = self._live.get(key)
+            alloc = (
+                (report or {}).get("allocation")
+                or self._allocs.get(cid, {}).get(chip, "")
+            )
+        if report is not None and report["state"] == states.DEGRADED and alloc:
+            self._evict_from_report(
+                alloc,
+                cid,
+                "chip-degraded",
+                f"chip {chip} degraded > {self.policy.degraded_grace_s}s",
+                reported_ts=report["ts"],
+            )
+
+    def _on_controller_dead(self, cid: str) -> None:
+        with self._lock:
+            allocs = sorted(set(self._allocs.pop(cid, {}).values()))
+            for key in [k for k in self._live if k[0] == cid]:
+                del self._live[key]
+                self._timer.disarm(key)
+        for volume in allocs:
+            self._evict_from_report(volume, cid, "controller-dead", "")
+        self._update_gauge(cid)
+
+    def _on_drain(self, cid: str, value: str) -> None:
+        with self._lock:
+            self._cordoned.add(cid)
+            allocs = sorted(set(self._allocs.get(cid, {}).values()))
+        for volume in allocs:
+            self._evict_from_report(volume, cid, "drained", value)

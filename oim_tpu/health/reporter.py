@@ -1,0 +1,175 @@
+"""Controller-side health telemetry: agent chip health → leased registry keys.
+
+One thread per controller, started next to the ``_register_loop`` address
+heartbeat (Controller.start) and stopped with it (Controller.close).  Each
+interval it scrapes the device plane's ``get_health`` and re-publishes one
+leased key per chip (``health/<controller_id>/<chip_id>``), so:
+
+- a state change propagates within one interval (the FleetMonitor watches,
+  nothing polls), and
+- a crashed controller's whole health subtree *expires* a few missed
+  intervals later — the same lease discipline as the address key, which is
+  what lets the registry side declare a controller dead without ever
+  dialing it.
+
+Scrapes use their own short-timeout agent connection (the Controller's RPC
+path must never block behind a wedged telemetry scrape), re-dialed after
+any failure.  A daemon that does not serve ``get_health`` (the C++ agent
+today) degrades to ``get_chips`` with every chip reported OK — allocation
+occupancy and liveness still flow; only the state channel is flat.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from oim_tpu import log
+from oim_tpu.agent import Agent, METHOD_NOT_FOUND, is_agent_error
+from oim_tpu.common import metrics
+from oim_tpu.common.regdial import registry_channel
+from oim_tpu.health import states
+from oim_tpu.spec import REGISTRY, oim_pb2
+
+DEFAULT_HEALTH_INTERVAL = 5.0
+
+
+class HealthReporter:
+    """Scrape-and-publish loop for one controller's chip health."""
+
+    def __init__(
+        self,
+        controller_id: str,
+        agent_socket: str,
+        registry_address: str,
+        tls=None,
+        interval: float = DEFAULT_HEALTH_INTERVAL,
+        scrape_timeout: float = 2.0,
+    ) -> None:
+        self.controller_id = controller_id
+        self.agent_socket = agent_socket
+        self.registry_address = registry_address
+        self.tls = tls
+        self.interval = interval
+        self.scrape_timeout = scrape_timeout
+        self._agent: Agent | None = None
+        self._agent_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._reports = metrics.registry().counter(
+            "oim_health_reports_total",
+            "Health report publish cycles, by outcome.",
+            ("controller", "outcome"),
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "HealthReporter":
+        """Idempotent: a second start while running is a no-op."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="controller-health"
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Idempotent stop; joins the loop and drops the scrape connection."""
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+            self._thread = None
+        self._drop_agent()
+
+    def _run(self) -> None:
+        while True:
+            try:
+                self.report_once()
+                self._reports.inc(self.controller_id, "ok")
+            except Exception as exc:
+                # Telemetry must never die: a transient agent or registry
+                # failure costs one interval, not the whole channel.
+                self._reports.inc(self.controller_id, "error")
+                if not self._stop.is_set():
+                    log.current().warning(
+                        "health report failed",
+                        controller=self.controller_id,
+                        error=str(exc),
+                    )
+            if self._stop.wait(self.interval):
+                return
+
+    # -- one cycle ---------------------------------------------------------
+
+    def scrape(self) -> list[dict]:
+        """Chip health from the device plane, on the telemetry-only
+        connection (dropped and re-dialed after any failure)."""
+        try:
+            agent = self._get_agent()
+            try:
+                return agent.get_health()
+            except Exception as exc:
+                if is_agent_error(exc, METHOD_NOT_FOUND):
+                    # Health-oblivious daemon: liveness + occupancy only.
+                    return [
+                        {
+                            "chip_id": c["chip_id"],
+                            "health": states.OK,
+                            "ici_link_errors": 0,
+                            "allocation": c.get("allocation", ""),
+                        }
+                        for c in agent.get_chips()
+                    ]
+                raise
+        except BaseException:
+            self._drop_agent()
+            raise
+
+    def report_once(self) -> int:
+        """Scrape and publish every chip's health key; returns the number
+        of keys written.  Lease TTL = 3 intervals, matching the address
+        heartbeat's missed-beats-then-expire discipline."""
+        chips = self.scrape()
+        ttl = max(1, int(self.interval * 3))
+        now = time.time()
+        with registry_channel(self.registry_address, self.tls) as channel:
+            stub = REGISTRY.stub(channel)
+            for chip in chips:
+                stub.SetValue(
+                    oim_pb2.SetValueRequest(
+                        value=oim_pb2.Value(
+                            path=states.health_key(
+                                self.controller_id, chip["chip_id"]
+                            ),
+                            value=states.encode_report(
+                                chip.get("health", states.OK),
+                                chip.get("ici_link_errors", 0),
+                                chip.get("allocation", ""),
+                                now,
+                            ),
+                        ),
+                        ttl_seconds=ttl,
+                    ),
+                    timeout=10,
+                )
+        return len(chips)
+
+    def _get_agent(self) -> Agent:
+        with self._agent_lock:
+            if self._agent is None:
+                self._agent = Agent(
+                    self.agent_socket, timeout=self.scrape_timeout
+                )
+            return self._agent
+
+    def _drop_agent(self) -> None:
+        with self._agent_lock:
+            if self._agent is not None:
+                try:
+                    self._agent.close()
+                except Exception:
+                    pass
+                self._agent = None

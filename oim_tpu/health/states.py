@@ -1,0 +1,101 @@
+"""Health-state vocabulary and registry key/value schema.
+
+The fleet health loop is three registry keyspaces (all plain KV, so every
+existing primitive — leases, watch, oimctl, authz — applies unchanged):
+
+- ``health/<controller_id>/<chip_id>`` — one leased key per chip, refreshed
+  by the controller's HealthReporter each interval; the value is a JSON
+  report (state, ICI link errors, owning allocation, publish timestamp).
+  Lease expiry (controller death) deletes the key with a watch event.
+- ``drain/<controller_id>`` — operator cordon mark (``oimctl drain``);
+  deleting it (``oimctl uncordon``) lifts the cordon.
+- ``evictions/<volume_id>`` — set by the EvictionEngine; while present the
+  CSI RemoteBackend refuses to stage the volume and ``oimctl remap`` is the
+  operator path back to a healthy controller.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+OK = "OK"
+DEGRADED = "DEGRADED"
+FAILED = "FAILED"
+HEALTH_STATES = (OK, DEGRADED, FAILED)
+
+HEALTH_PREFIX = "health"
+DRAIN_PREFIX = "drain"
+EVICTIONS_PREFIX = "evictions"
+
+
+def health_key(controller_id: str, chip_id: int | str) -> str:
+    return f"{HEALTH_PREFIX}/{controller_id}/{chip_id}"
+
+
+def drain_key(controller_id: str) -> str:
+    return f"{DRAIN_PREFIX}/{controller_id}"
+
+
+def eviction_key(volume_id: str) -> str:
+    return f"{EVICTIONS_PREFIX}/{volume_id}"
+
+
+def parse_health_path(path: str) -> tuple[str, str] | None:
+    """``health/<cid>/<chip>`` → (cid, chip), else None."""
+    parts = path.split("/")
+    if len(parts) == 3 and parts[0] == HEALTH_PREFIX:
+        return parts[1], parts[2]
+    return None
+
+
+def parse_drain_path(path: str) -> str | None:
+    parts = path.split("/")
+    if len(parts) == 2 and parts[0] == DRAIN_PREFIX:
+        return parts[1]
+    return None
+
+
+def parse_eviction_path(path: str) -> str | None:
+    parts = path.split("/")
+    if len(parts) == 2 and parts[0] == EVICTIONS_PREFIX:
+        return parts[1]
+    return None
+
+
+def parse_address_path(path: str) -> str | None:
+    """``<cid>/address`` → cid, else None (``serve/<id>/address`` and other
+    deeper keys are different planes and excluded)."""
+    parts = path.split("/")
+    if len(parts) == 2 and parts[1] == "address":
+        return parts[0]
+    return None
+
+
+def encode_report(
+    state: str, link_errors: int, allocation: str, ts: float
+) -> str:
+    return json.dumps(
+        {
+            "state": state,
+            "link_errors": int(link_errors),
+            "allocation": allocation,
+            "ts": ts,
+        },
+        separators=(",", ":"),
+    )
+
+
+def decode_report(value: str) -> dict[str, Any] | None:
+    """Parse a health report value; None for malformed/foreign values (a
+    watcher must never die on one bad key)."""
+    try:
+        report = json.loads(value)
+    except ValueError:
+        return None
+    if not isinstance(report, dict) or report.get("state") not in HEALTH_STATES:
+        return None
+    report.setdefault("link_errors", 0)
+    report.setdefault("allocation", "")
+    report.setdefault("ts", 0.0)
+    return report

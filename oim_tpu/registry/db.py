@@ -36,7 +36,19 @@ import threading
 import time
 from typing import Callable, Protocol
 
+from oim_tpu.common import metrics
+
 WatchCallback = Callable[[str, str], None]  # (path, value); "" = deleted
+
+# Lease expiries were invisible before this counter: a fleet where
+# controllers silently drop off (addresses expiring, health subtrees
+# vanishing) now shows up on /metrics instead of only in effect.  Counts
+# keys actually deleted by the sweep — a stale expiry losing the refresh
+# race does not count.
+LEASE_EXPIRATIONS = metrics.registry().counter(
+    "oim_registry_lease_expirations_total",
+    "Leased registry keys deleted by the lease sweep (TTL ran out).",
+)
 
 
 class RegistryDB(Protocol):
@@ -273,6 +285,8 @@ class MemRegistryDB:
             existed = self._data.pop(path, None) is not None
             if existed:
                 self._hub.enqueue(path, "")
+        if existed:
+            LEASE_EXPIRATIONS.inc()
         self._hub.dispatch()
 
     def lookup(self, path: str) -> str:
@@ -363,6 +377,8 @@ class SqliteRegistryDB:
             self._conn.commit()
             if existed:
                 self._hub.enqueue(path, "")
+        if existed:
+            LEASE_EXPIRATIONS.inc()
         self._hub.dispatch()
 
     def lookup(self, path: str) -> str:

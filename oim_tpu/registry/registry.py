@@ -229,9 +229,22 @@ class Registry:
             controller_id = cn[len(CONTROLLER_CN_PREFIX):]
             if path == f"{controller_id}/address":
                 return
+            # A controller may also publish its OWN chip-health telemetry
+            # (health/<id>/<chip>, oim_tpu/health) — the same
+            # least-privilege shape as the address key: never another
+            # controller's subtree, never drain/eviction marks (those are
+            # operator/monitor writes).
+            parts = path.split("/")
+            if (
+                len(parts) == 3
+                and parts[0] == "health"
+                and parts[1] == controller_id
+            ):
+                return
             context.abort(
                 grpc.StatusCode.PERMISSION_DENIED,
-                f"{cn!r} may only set {controller_id}/address",
+                f"{cn!r} may only set {controller_id}/address "
+                f"or health/{controller_id}/*",
             )
         if cn.startswith(SERVE_CN_PREFIX):
             # A serving instance may publish only its own discovery key

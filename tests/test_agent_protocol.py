@@ -222,6 +222,67 @@ def test_linear_fallback_when_no_box_fits(agent_socket):
         assert [c["chip_id"] for c in alloc["chips"]] == [1, 2, 3]
 
 
+class TestFindChipsTopologyPadding:
+    """Direct ChipStore coverage of the `_find_chips` trailing-1 padding
+    (`padded = topology + (1,)*...`) — the placement arithmetic itself,
+    below the wire (Python implementation; the shared socket suite above
+    holds both daemons to the observable behavior)."""
+
+    def test_2d_request_on_3d_host_pads_trailing_one(self):
+        store = ChipStore(mesh=(2, 2, 2))
+        ids, mesh = store._find_chips(4, (2, 2))
+        assert mesh == (2, 2, 1)  # padded to host rank
+        # The padded box is contiguous at the origin, in mesh order.
+        assert ids == [0, 2, 4, 6]  # coords (x,y,0) for x,y in {0,1}
+        coords = [store.chips[i].phys_coord for i in ids]
+        assert coords == [(0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0)]
+
+    def test_1d_request_on_3d_host(self):
+        store = ChipStore(mesh=(2, 2, 2))
+        ids, mesh = store._find_chips(2, (2,))
+        assert mesh == (2, 1, 1)
+        assert [store.chips[i].phys_coord for i in ids] == [
+            (0, 0, 0), (1, 0, 0),
+        ]
+
+    def test_padded_shape_exceeding_an_axis_is_enospc(self):
+        store = ChipStore(mesh=(2, 2, 2))
+        with pytest.raises(Exception) as err:
+            store._find_chips(3, (3,))  # 3x1x1 cannot fit a 2-wide axis
+        assert getattr(err.value, "code", None) == agent_mod.ENOSPC
+
+    def test_fragmented_free_set_is_enospc_for_explicit_topology(self):
+        """Diagonal fragmentation: two chips free but no contiguous
+        padded sub-mesh.  An EXPLICIT topology must fail ENOSPC (the
+        caller asked for that ICI shape — no silent linear fallback),
+        while the same free set still satisfies a shapeless request via
+        the fallback."""
+        store = ChipStore(mesh=(2, 2, 1))
+        # Occupy the (0,0,0)/(1,1,0) diagonal: free = chips 1,2 — every
+        # x-pair (0-2, 1-3) and y-pair (0-1, 2-3) has one chip taken.
+        store.chips[0].allocation = "pin"
+        store.chips[3].allocation = "pin"
+        for topo in ((2,), (1, 2), (2, 1)):
+            with pytest.raises(Exception) as err:
+                store._find_chips(2, topo)
+            assert getattr(err.value, "code", None) == agent_mod.ENOSPC, topo
+            assert "sub-mesh" in str(err.value)
+        # Shapeless request, same free set: linear fallback succeeds.
+        ids, mesh = store._find_chips(2, None)
+        assert ids == [1, 2]
+        assert mesh == (2,)
+
+    def test_padding_noop_on_full_rank_and_oversized_rank(self):
+        store = ChipStore(mesh=(2, 2, 1))
+        ids, mesh = store._find_chips(4, (2, 2, 1))
+        assert mesh == (2, 2, 1) and len(ids) == 4
+        # A topology of HIGHER rank than the host mesh can never match a
+        # candidate shape → ENOSPC (not a crash, not silent truncation).
+        with pytest.raises(Exception) as err:
+            store._find_chips(4, (2, 2, 1, 1))
+        assert getattr(err.value, "code", None) == agent_mod.ENOSPC
+
+
 def test_wire_errors(agent_socket):
     """Raw-socket probes of the framing layer."""
     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)

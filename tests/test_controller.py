@@ -249,6 +249,41 @@ def test_agent_down_is_unavailable(tmp_path):
 # Self-registration heartbeat (≙ controller_test.go:88-148)
 
 
+def test_close_is_idempotent_and_leaks_no_threads(agent_sock):
+    """`close(); close()` must neither raise nor leak the heartbeat or
+    health-reporter threads (the double-close risk surface: daemons close
+    on KeyboardInterrupt AND in finally blocks)."""
+    import threading
+
+    reg = Registry()
+    reg_srv = reg.start_server("tcp://127.0.0.1:0")
+    try:
+        controller = Controller(
+            "ctrl-dc",
+            agent_sock,
+            registry_address=str(reg_srv.addr()),
+            registry_delay=0.1,
+            health_interval=0.05,
+        )
+        controller.start("tcp://10.0.0.7:1")
+        assert controller._thread is not None
+        assert controller._health_reporter is not None
+        controller.close()
+        controller.close()  # second close: no raise, no new threads
+        for name in ("controller-register", "controller-health"):
+            assert not [
+                t for t in threading.enumerate()
+                if t.name == name and t.is_alive()
+            ], f"leaked {name} thread"
+        # close() before start() (never-started controller) is also safe.
+        never_started = Controller("ctrl-ns", agent_sock)
+        never_started.close()
+        never_started.close()
+    finally:
+        reg_srv.stop()
+        reg.close()
+
+
 def test_registration_heartbeat(agent_sock):
     reg = Registry()
     reg_srv = reg.start_server("tcp://127.0.0.1:0")
