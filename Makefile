@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -37,6 +37,13 @@ test:
 test-health:
 	timeout -k 10 60 $(PYTHON) -m pytest tests/test_health.py -q \
 	  -m "health and not slow" -p no:cacheprovider
+
+# Control-plane resilience: retry/breaker units plus fast chaos rounds
+# (chaos marker), hard-capped at 60s.  The 200-cycle soak is marked slow
+# (out of this target AND tier-1); run it with `pytest -m 'chaos and slow'`.
+test-resilience:
+	timeout -k 10 60 $(PYTHON) -m pytest tests/test_resilience.py -q \
+	  -m "chaos and not slow" -p no:cacheprovider
 
 # Tier 3: the full stack driving a first op on the real accelerator
 # (≙ reference env-gated real-SPDK tests, test/test.make:1-16).

@@ -13,8 +13,12 @@ from oim_tpu.agent.client import Client
 
 
 class Agent:
-    def __init__(self, socket_path: str, timeout: float = 60.0) -> None:
-        self.client = Client(socket_path, timeout=timeout)
+    def __init__(
+        self, socket_path: str, timeout: float = 60.0, retry=None
+    ) -> None:
+        # ``retry`` (a resilience.RetryPolicy) tunes transport-level
+        # reconnect/retry; None takes the env-configured default.
+        self.client = Client(socket_path, timeout=timeout, retry=retry)
 
     # -- queries -----------------------------------------------------------
 
@@ -49,6 +53,34 @@ class Agent:
         params: dict[str, Any] = {"chip_id": chip_id, "kind": kind}
         if after_n_calls:
             params["after_n_calls"] = after_n_calls
+        return self.client.invoke("inject_fault", params)
+
+    def inject_chaos(
+        self,
+        kind: str,
+        rate: float = 1.0,
+        seed: int | None = None,
+        delay_s: float | None = None,
+        error_code: int | None = None,
+        methods: list[str] | None = None,
+        count: int | None = None,
+    ) -> dict[str, Any]:
+        """Arm transport-fault injection on a fake/test agent:
+        ``chaos_drop``/``chaos_delay``/``chaos_error``/``chaos_disconnect``
+        afflict a ``rate`` fraction of subsequent requests (seeded RNG for
+        reproducibility); ``chaos_clear`` heals.  See
+        doc/agent-protocol.md."""
+        params: dict[str, Any] = {"kind": kind, "rate": rate}
+        if seed is not None:
+            params["seed"] = seed
+        if delay_s is not None:
+            params["delay_s"] = delay_s
+        if error_code is not None:
+            params["error_code"] = error_code
+        if methods is not None:
+            params["methods"] = methods
+        if count is not None:
+            params["count"] = count
         return self.client.invoke("inject_fault", params)
 
     def find_allocation(self, name: str) -> dict[str, Any] | None:

@@ -3,7 +3,16 @@
 ≙ reference pkg/spdk/client.go: a small line-oriented JSON-RPC client over a
 Unix stream socket with full wire logging (client.go:230-262) and errors
 surfaced as typed exceptions matchable by code (≙ ``IsJSONError``,
-client.go:70-85).  Deliberately standalone: depends only on oim_tpu.log.
+client.go:70-85).
+
+Transport resilience (oim_tpu.common.resilience): a broken socket no
+longer poisons the client forever — EPIPE/ECONNRESET/EOF during a call
+drops the connection and re-dials under the shared RetryPolicy, so an
+agent daemon restart costs the caller one backoff, not a new Client.
+Request ids stay monotonically increasing across reconnects (every
+attempt takes a fresh id), so a stale response line can never be matched
+to a newer request.  Application errors (AgentError) are the daemon's
+*answer* and are never retried.
 """
 
 from __future__ import annotations
@@ -14,7 +23,7 @@ import threading
 from typing import Any
 
 from oim_tpu import log
-from oim_tpu.common import tracing
+from oim_tpu.common import resilience, tracing
 
 
 class AgentError(Exception):
@@ -33,14 +42,49 @@ def is_agent_error(exc: BaseException, code: int) -> bool:
 class Client:
     """One connection to a tpu-agent socket; thread-safe request/response."""
 
-    def __init__(self, path: str, timeout: float = 60.0) -> None:
+    def __init__(
+        self,
+        path: str,
+        timeout: float = 60.0,
+        retry: resilience.RetryPolicy | None = None,
+    ) -> None:
         self.path = path
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.settimeout(timeout)
-        self._sock.connect(path)
-        self._file = self._sock.makefile("rb")
+        self.timeout = timeout
+        self.retry = retry if retry is not None else resilience.RetryPolicy.from_env()
         self._lock = threading.Lock()
         self._next_id = 0
+        self._closed = False
+        self._sock: socket.socket | None = None
+        self._file = None
+        # Connect eagerly so a missing/unserved socket fails in the
+        # caller's face (LocalBackend maps the OSError to UNAVAILABLE).
+        self._connect()
+
+    def _connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            sock.settimeout(self.timeout)
+            sock.connect(self.path)
+            file = sock.makefile("rb")
+        except BaseException:
+            # A failed connect must not leak the half-built socket.
+            sock.close()
+            raise
+        self._sock = sock
+        self._file = file
+
+    def _drop_connection(self) -> None:
+        """Close the (possibly dead) transport; caller holds the lock.
+        The next attempt re-dials."""
+        file, sock = self._file, self._sock
+        self._file = None
+        self._sock = None
+        for closable in (file, sock):
+            if closable is not None:
+                try:
+                    closable.close()
+                except OSError:
+                    pass
 
     def invoke(self, method: str, params: dict[str, Any] | None = None) -> Any:
         # The device-plane hop gets its own span (the JSON-RPC protocol
@@ -50,39 +94,95 @@ class Client:
             return self._invoke(method, params)
 
     def _invoke(self, method: str, params: dict[str, Any] | None = None) -> Any:
-        with self._lock:
-            self._next_id += 1
-            request: dict[str, Any] = {
-                "jsonrpc": "2.0",
-                "id": self._next_id,
-                "method": method,
-            }
-            # params omitted when empty (≙ reference client.go:104-126).
-            if params:
-                request["params"] = params
-            wire = json.dumps(request, separators=(",", ":")) + "\n"
-            logger = log.current()
-            logger.debug("agent request", data=wire.rstrip())
-            self._sock.sendall(wire.encode())
-            line = self._file.readline()
-            if not line:
-                raise ConnectionError(f"agent at {self.path} closed connection")
-            logger.debug("agent response", data=line.decode().rstrip())
-            response = json.loads(line)
-        if response.get("id") != request["id"]:
-            raise ConnectionError(
-                f"agent response id {response.get('id')} != {request['id']}"
-            )
+        # The lock spans ONE roundtrip, not the whole ladder: pairing on
+        # the stream stays atomic, but a failing call's backoff sleeps
+        # must not serialize every other thread behind it.  Every failure
+        # path in _roundtrip drops the connection before raising, so the
+        # next attempt (any thread's) starts from a fresh dial;
+        # retryable_dial additionally treats a missing socket file
+        # (daemon mid-restart) as a hop failure.
+        def one_attempt(attempt):
+            with self._lock:
+                return self._roundtrip(method, params, attempt.timeout)
+
+        response = resilience.call_with_retry(
+            one_attempt,
+            self.retry,
+            component="agent-client",
+            op=method,
+            classify=resilience.retryable_dial,
+        )
         if "error" in response:
             err = response["error"]
             raise AgentError(int(err.get("code", 0)), str(err.get("message", "")))
         return response.get("result")
 
-    def close(self) -> None:
+    def _roundtrip(
+        self,
+        method: str,
+        params: dict[str, Any] | None,
+        budget: float | None = None,
+    ):
+        """One attempt: (re)connect if needed, send, read the reply line.
+        Raises ConnectionError/OSError on transport breaks — the
+        retryable class — after dropping the connection, so the next
+        attempt starts from a fresh dial.  ``budget`` (the retry ladder's
+        remaining overall deadline, if any) tightens the socket timeout
+        so a HANGING daemon cannot stall one attempt past it."""
+        if self._closed:
+            # Latched: a closed client must not silently resurrect its
+            # connection (nobody would ever close the new socket).
+            raise RuntimeError(f"agent client for {self.path} is closed")
+        if self._sock is None:
+            self._connect()
+        self._sock.settimeout(
+            self.timeout if budget is None
+            else min(self.timeout, max(budget, 0.05))
+        )
+        self._next_id += 1
+        request: dict[str, Any] = {
+            "jsonrpc": "2.0",
+            "id": self._next_id,
+            "method": method,
+        }
+        # params omitted when empty (≙ reference client.go:104-126).
+        if params:
+            request["params"] = params
+        wire = json.dumps(request, separators=(",", ":")) + "\n"
+        logger = log.current()
+        logger.debug("agent request", data=wire.rstrip())
         try:
-            self._file.close()
-        finally:
-            self._sock.close()
+            self._sock.sendall(wire.encode())
+            line = self._file.readline()
+        except OSError:
+            self._drop_connection()
+            raise
+        if not line:
+            self._drop_connection()
+            raise ConnectionError(f"agent at {self.path} closed connection")
+        logger.debug("agent response", data=line.decode().rstrip())
+        try:
+            response = json.loads(line)
+        except ValueError as exc:
+            # A torn mid-line write from a dying daemon is a transport
+            # break, not an answer.
+            self._drop_connection()
+            raise ConnectionError(
+                f"agent at {self.path} sent unparseable frame: {exc}"
+            ) from exc
+        if response.get("id") != request["id"]:
+            self._drop_connection()
+            raise ConnectionError(
+                f"agent response id {response.get('id')} != {request['id']}"
+            )
+        return response
+
+    def close(self) -> None:
+        """Idempotent; safe on a client whose connect failed.  Latches:
+        later invokes fail with RuntimeError instead of reconnecting."""
+        with self._lock:
+            self._closed = True
+            self._drop_connection()
 
     def __enter__(self) -> "Client":
         return self

@@ -12,9 +12,11 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import random
 import socket
 import socketserver
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -37,6 +39,40 @@ class RpcAppError(Exception):
         super().__init__(message)
         self.code = code
         self.message = message
+
+
+# Default JSON-RPC error code for injected ``chaos_error`` faults
+# (server-defined range -32000..-32099, doc/agent-protocol.md).
+CHAOS_ERROR_CODE = -32050
+
+
+@dataclass
+class ChaosConfig:
+    """Transport-fault injection state (doc/agent-protocol.md, chaos_*).
+
+    Armed via ``inject_fault`` with a ``chaos_*`` kind; every subsequent
+    request (except ``inject_fault`` itself — the healing path must stay
+    reachable) rolls ``rng.random() < rate`` and, on a hit, suffers:
+
+    - ``drop``: the connection is severed WITHOUT executing the request
+      (the client sees EOF; the operation never happened),
+    - ``disconnect``: the request IS executed, then the connection is
+      severed before the reply — the ambiguous "executed, reply lost"
+      window that makes idempotency keys load-bearing,
+    - ``delay``: the reply is held for ``delay_s`` (deadline pressure),
+    - ``error``: a JSON-RPC error with ``error_code`` is returned.
+
+    Seeded RNG: the same (seed, request sequence) always faults the same
+    calls, so soak failures replay deterministically.
+    """
+
+    mode: str = ""
+    rate: float = 1.0
+    delay_s: float = 0.05
+    error_code: int = CHAOS_ERROR_CODE
+    methods: frozenset[str] | None = None  # None = every method
+    count: int = 0  # > 0: disarm after this many hits (exact-N scripting)
+    rng: random.Random = field(default_factory=random.Random)
 
 
 @dataclass
@@ -152,6 +188,8 @@ class ChipStore:
         # faults that reach zero — deterministic ("the Nth scrape sees the
         # failure"), no wall clock involved.
         self._pending_faults: list[list] = []
+        # Transport chaos (chaos_* inject_fault kinds): None = healthy.
+        self._chaos: ChaosConfig | None = None
 
     # -- health ------------------------------------------------------------
 
@@ -199,6 +237,72 @@ class ChipStore:
             self._pending_faults = [
                 p for p in self._pending_faults if p[1] != chip.chip_id
             ]
+
+    # -- transport chaos ---------------------------------------------------
+
+    _CHAOS_KINDS = (
+        "chaos_drop", "chaos_delay", "chaos_error", "chaos_disconnect",
+        "chaos_clear",
+    )
+
+    def inject_chaos(self, kind: str, params: dict[str, Any]) -> dict[str, Any]:
+        """Arm (or clear) transport-fault injection; see ChaosConfig."""
+        if kind not in self._CHAOS_KINDS:
+            raise RpcAppError(
+                INVALID_PARAMS,
+                f"kind must be one of {'/'.join(self._CHAOS_KINDS)}",
+            )
+        with self._lock:
+            if kind == "chaos_clear":
+                self._chaos = None
+                return {"chaos": ""}
+            try:
+                rate = float(params.get("rate", 1.0))
+                delay_s = float(params.get("delay_s", 0.05))
+                error_code = int(params.get("error_code", CHAOS_ERROR_CODE))
+                count = int(params.get("count", 0))
+                rng = (
+                    random.Random(params["seed"]) if "seed" in params
+                    else random.Random()
+                )
+            except (TypeError, ValueError):
+                # A bad knob must get a JSON-RPC answer, not a severed
+                # connection indistinguishable from armed chaos.
+                raise RpcAppError(
+                    INVALID_PARAMS,
+                    "rate/delay_s must be floats, error_code/count ints, "
+                    "seed hashable",
+                ) from None
+            if not 0.0 <= rate <= 1.0:
+                raise RpcAppError(INVALID_PARAMS, f"rate {rate} not in [0, 1]")
+            methods = params.get("methods")
+            self._chaos = ChaosConfig(
+                mode=kind[len("chaos_"):],
+                rate=rate,
+                delay_s=delay_s,
+                error_code=error_code,
+                methods=frozenset(methods) if methods else None,
+                count=count,
+                rng=rng,
+            )
+            return {"chaos": self._chaos.mode, "rate": rate}
+
+    def chaos_action(self, method: str) -> ChaosConfig | None:
+        """Roll the dice for one request; the armed config on a hit.
+        ``inject_fault`` is exempt so tests can always heal the agent."""
+        with self._lock:
+            cfg = self._chaos
+            if cfg is None or method == "inject_fault":
+                return None
+            if cfg.methods is not None and method not in cfg.methods:
+                return None
+            if cfg.rng.random() >= cfg.rate:
+                return None
+            if cfg.count > 0:
+                cfg.count -= 1
+                if cfg.count == 0:
+                    self._chaos = None  # budget spent: healthy again
+            return cfg
 
     def get_health(self) -> list[dict[str, Any]]:
         """Per-chip health snapshot; applies any due scripted faults."""
@@ -398,11 +502,15 @@ class ChipStore:
         if method == "get_health":
             return self.get_health()
         if method == "inject_fault":
+            kind = str(params.get("kind", ""))
+            if kind.startswith("chaos_"):
+                # Transport chaos is store-wide; no chip_id involved.
+                return self.inject_chaos(kind, params)
             if "chip_id" not in params:
                 raise RpcAppError(INVALID_PARAMS, "chip_id required")
             return self.inject_fault(
                 int(params["chip_id"]),
-                str(params.get("kind", "")),
+                kind,
                 int(params.get("after_n_calls", 0)),
             )
         if method == "get_allocations":
@@ -468,12 +576,23 @@ class FakeAgentServer:
                             # lines dispatch and get a parse error on
                             # both implementations).
                             continue
-                        response = _dispatch_line(store_ref, line)
-                        self.wfile.write(
-                            (json.dumps(response, separators=(",", ":")) + "\n")
-                            .encode()
-                        )
-                        self.wfile.flush()
+                        response, sever = _dispatch_line(store_ref, line)
+                        if response is not None:
+                            self.wfile.write(
+                                (json.dumps(response, separators=(",", ":"))
+                                 + "\n").encode()
+                            )
+                            self.wfile.flush()
+                        if sever:
+                            # Injected drop/disconnect: kill THIS
+                            # connection like a crashing daemon would —
+                            # the client's next read sees EOF/RST and its
+                            # resilience layer re-dials.
+                            try:
+                                self.connection.shutdown(socket.SHUT_RDWR)
+                            except OSError:
+                                pass
+                            return
                 finally:
                     with conn_lock:
                         live_connections.discard(self.connection)
@@ -523,7 +642,15 @@ class FakeAgentServer:
             os.unlink(self.socket_path)
 
 
-def _dispatch_line(store: ChipStore, line: bytes) -> dict[str, Any]:
+def _dispatch_line(
+    store: ChipStore, line: bytes
+) -> tuple[dict[str, Any] | None, bool]:
+    """One request → (response-or-None, sever-connection?).
+
+    A ``None`` response with ``sever`` means injected chaos ate the reply
+    (drop: before execution; disconnect: after) — the transport break the
+    client-side resilience layer exists to absorb.
+    """
     req_id = None
     try:
         request = json.loads(line)
@@ -535,17 +662,28 @@ def _dispatch_line(store: ChipStore, line: bytes) -> dict[str, Any]:
         params = request.get("params") or {}
         if not isinstance(params, dict):
             raise RpcAppError(INVALID_PARAMS, "params must be an object")
-        result = store.handle(request["method"], params)
-        return {"jsonrpc": "2.0", "id": req_id, "result": result}
+        method = request["method"]
+        chaos = store.chaos_action(method)
+        if chaos is not None:
+            if chaos.mode == "drop":
+                return None, True  # never executed
+            if chaos.mode == "error":
+                raise RpcAppError(chaos.error_code, "injected chaos error")
+            if chaos.mode == "delay":
+                time.sleep(chaos.delay_s)
+        result = store.handle(method, params)
+        if chaos is not None and chaos.mode == "disconnect":
+            return None, True  # executed; reply lost — the ambiguous window
+        return {"jsonrpc": "2.0", "id": req_id, "result": result}, False
     except RpcAppError as exc:
         return {
             "jsonrpc": "2.0",
             "id": req_id,
             "error": {"code": exc.code, "message": exc.message},
-        }
+        }, False
     except (json.JSONDecodeError, UnicodeDecodeError, RecursionError) as exc:
         return {
             "jsonrpc": "2.0",
             "id": req_id,
             "error": {"code": PARSE_ERROR, "message": str(exc)},
-        }
+        }, False

@@ -24,6 +24,7 @@ import grpc
 from oim_tpu import log
 from oim_tpu.common import endpoint as ep
 from oim_tpu.common import tracing
+from oim_tpu.common import resilience
 from oim_tpu.common.tlsconfig import load_tls
 from oim_tpu.health import states as health_states
 from oim_tpu.spec import CONTROLLER, REGISTRY, oim_pb2
@@ -39,20 +40,23 @@ def _channel(args):
     return grpc.insecure_channel(target)
 
 
-def _map_and_print(channel, volume: str, controller: str, chips: int) -> None:
+def _map_and_print(
+    channel, volume: str, controller: str, chips: int, rpc=lambda f: f()
+) -> None:
     """One MapVolume through the proxy + the human-readable assignment —
     shared by `map` and `remap` so their request shape and output can
-    never drift."""
+    never drift.  ``rpc`` is the retry wrapper (safe: controller MapVolume
+    is volume_id-keyed idempotent)."""
     request = oim_pb2.MapVolumeRequest(volume_id=volume)
     if chips > 0:
         request.slice.chip_count = chips
     else:
         request.provisioned.SetInParent()
-    reply = CONTROLLER.stub(channel).MapVolume(
+    reply = rpc(lambda: CONTROLLER.stub(channel).MapVolume(
         request,
         metadata=(("controllerid", controller),),
         timeout=60,
-    )
+    ))
     print(f"mesh={list(reply.mesh.dims)}")
     print(f"coordinator={reply.coordinator_address}")
     for chip in reply.chips:
@@ -69,6 +73,11 @@ def main(argv=None) -> int:
     parser.add_argument("--cert", help="client cert (CN user.admin)")
     parser.add_argument("--key")
     parser.add_argument("--log-level", default="warning")
+    parser.add_argument(
+        "--max-attempts", type=int, default=0,
+        help="transient-failure retries per RPC (0 = the OIM_RETRY_* env "
+        "defaults; 1 disables retries)",
+    )
     sub = parser.add_subparsers(dest="command", required=True)
 
     get = sub.add_parser("get")
@@ -282,21 +291,39 @@ def main(argv=None) -> int:
         print(tracing.render_traces(spans))
         return 0
     channel = _channel(args)
+    # Operator CLI resilience: UNAVAILABLE/DEADLINE_EXCEEDED retried with
+    # backoff under the shared policy.  Streaming `watch` is exempt — a
+    # broken stream is surfaced, not silently resumed (resuming would
+    # replay the snapshot and double-print events).
+    policy = (
+        resilience.RetryPolicy.from_env()
+        if args.max_attempts <= 0
+        else resilience.RetryPolicy.from_env(max_attempts=args.max_attempts)
+    )
+
+    def rpc(call):
+        return resilience.call_with_retry(
+            lambda _attempt: call(),
+            policy,
+            component="oimctl",
+            op=args.command,
+        )
+
     try:
         if args.command == "get":
-            reply = REGISTRY.stub(channel).GetValues(
+            reply = rpc(lambda: REGISTRY.stub(channel).GetValues(
                 oim_pb2.GetValuesRequest(path=args.path), timeout=30
-            )
+            ))
             for value in reply.values:
                 print(f"{value.path}={value.value}")
         elif args.command == "set":
-            REGISTRY.stub(channel).SetValue(
+            rpc(lambda: REGISTRY.stub(channel).SetValue(
                 oim_pb2.SetValueRequest(
                     value=oim_pb2.Value(path=args.path, value=args.value),
                     ttl_seconds=args.ttl,
                 ),
                 timeout=30,
-            )
+            ))
         elif args.command == "watch":
             call = REGISTRY.stub(channel).WatchValues(
                 oim_pb2.WatchValuesRequest(
@@ -314,24 +341,26 @@ def main(argv=None) -> int:
             except KeyboardInterrupt:
                 call.cancel()
             except grpc.RpcError as exc:
-                if exc.code() != grpc.StatusCode.CANCELLED:
-                    print(f"error: {exc.code().name}: {exc.details()}")
+                if resilience.status_of(exc) != grpc.StatusCode.CANCELLED:
+                    print(f"error: {resilience.error_text(exc)}")
                     return 1
         elif args.command == "map":
-            _map_and_print(channel, args.volume, args.controller, args.chips)
+            _map_and_print(
+                channel, args.volume, args.controller, args.chips, rpc=rpc
+            )
         elif args.command == "unmap":
-            CONTROLLER.stub(channel).UnmapVolume(
+            rpc(lambda: CONTROLLER.stub(channel).UnmapVolume(
                 oim_pb2.UnmapVolumeRequest(volume_id=args.volume),
                 metadata=(("controllerid", args.controller),),
                 timeout=60,
-            )
+            ))
         elif args.command == "health":
             stub = REGISTRY.stub(channel)
             rows = []
-            for value in stub.GetValues(
+            for value in rpc(lambda: stub.GetValues(
                 oim_pb2.GetValuesRequest(path=health_states.HEALTH_PREFIX),
                 timeout=30,
-            ).values:
+            )).values:
                 parsed = health_states.parse_health_path(value.path)
                 report = health_states.decode_report(value.value)
                 if parsed is None or report is None:
@@ -355,22 +384,22 @@ def main(argv=None) -> int:
                     )
             else:
                 print("no health telemetry (no reporting controllers)")
-            for value in stub.GetValues(
+            for value in rpc(lambda: stub.GetValues(
                 oim_pb2.GetValuesRequest(path=health_states.DRAIN_PREFIX),
                 timeout=30,
-            ).values:
+            )).values:
                 cid = health_states.parse_drain_path(value.path)
                 if cid is not None and value.value:
                     print(f"cordoned: {cid} ({value.value})")
-            for value in stub.GetValues(
+            for value in rpc(lambda: stub.GetValues(
                 oim_pb2.GetValuesRequest(path=health_states.EVICTIONS_PREFIX),
                 timeout=30,
-            ).values:
+            )).values:
                 volume = health_states.parse_eviction_path(value.path)
                 if volume is not None and value.value:
                     print(f"evicted: {volume} {value.value}")
         elif args.command == "drain":
-            REGISTRY.stub(channel).SetValue(
+            rpc(lambda: REGISTRY.stub(channel).SetValue(
                 oim_pb2.SetValueRequest(
                     value=oim_pb2.Value(
                         path=health_states.drain_key(args.controller_id),
@@ -378,10 +407,10 @@ def main(argv=None) -> int:
                     )
                 ),
                 timeout=30,
-            )
+            ))
             print(f"cordoned {args.controller_id}")
         elif args.command == "uncordon":
-            REGISTRY.stub(channel).SetValue(
+            rpc(lambda: REGISTRY.stub(channel).SetValue(
                 oim_pb2.SetValueRequest(
                     value=oim_pb2.Value(
                         path=health_states.drain_key(args.controller_id),
@@ -389,15 +418,15 @@ def main(argv=None) -> int:
                     )
                 ),
                 timeout=30,
-            )
+            ))
             print(f"uncordoned {args.controller_id}")
         elif args.command == "remap":
             stub = REGISTRY.stub(channel)
             path = health_states.eviction_key(args.volume)
             record = None
-            for value in stub.GetValues(
+            for value in rpc(lambda: stub.GetValues(
                 oim_pb2.GetValuesRequest(path=path), timeout=30
-            ).values:
+            )).values:
                 if value.path == path and value.value:
                     try:
                         record = json.loads(value.value)
@@ -428,45 +457,49 @@ def main(argv=None) -> int:
                 except grpc.RpcError as exc:
                     print(
                         f"note: unmap on old controller {old!r} failed "
-                        f"({exc.code().name}); continuing"
+                        f"({resilience.status_of(exc).name}); continuing"
                     )
             # Map BEFORE clearing the eviction mark: if the new placement
             # fails (ENOSPC, dead controller) the volume must stay
             # evicted, or a retried NodeStage would land it right back on
             # the faulted slice.
             print(f"remapping {args.volume} onto {args.controller}")
-            _map_and_print(channel, args.volume, args.controller, args.chips)
+            _map_and_print(
+                channel, args.volume, args.controller, args.chips, rpc=rpc
+            )
             if record is not None:
-                stub.SetValue(
+                rpc(lambda: stub.SetValue(
                     oim_pb2.SetValueRequest(
                         value=oim_pb2.Value(path=path, value="")
                     ),
                     timeout=30,
-                )
+                ))
             print(f"remapped {args.volume} onto {args.controller}")
         elif args.command == "topology":
-            reply = CONTROLLER.stub(channel).GetTopology(
+            reply = rpc(lambda: CONTROLLER.stub(channel).GetTopology(
                 oim_pb2.GetTopologyRequest(),
                 metadata=(("controllerid", args.controller),),
                 timeout=30,
-            )
+            ))
             print(
                 f"chips={reply.chip_count} free={reply.free_chips} "
                 f"mesh={list(reply.mesh.dims)} accel={reply.accel_type}"
             )
         elif args.command == "slices":
-            reply = CONTROLLER.stub(channel).ListSlices(
+            reply = rpc(lambda: CONTROLLER.stub(channel).ListSlices(
                 oim_pb2.ListSlicesRequest(),
                 metadata=(("controllerid", args.controller),),
                 timeout=30,
-            )
+            ))
             for s in reply.slices:
                 print(
                     f"{s.name}: chips={s.chip_count} mesh={list(s.mesh.dims)}"
                     f" provisioned={s.provisioned} attached={s.attached}"
                 )
     except grpc.RpcError as exc:
-        print(f"error: {exc.code().name}: {exc.details()}")
+        # error_text is None-code-safe (a locally raised RpcError would
+        # otherwise crash the formatting here).
+        print(f"error: {resilience.error_text(exc)}")
         return 1
     finally:
         channel.close()

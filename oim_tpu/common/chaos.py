@@ -1,0 +1,204 @@
+"""Chaos-injection harness for the control plane.
+
+Two fault surfaces, one seeded-RNG discipline (tests replay exactly):
+
+- ``FlakyChannel``: wraps a ``grpc.Channel`` and injects transport
+  failures into unary calls — *before* the call (``error``: the request
+  never reached the peer), *after* it (``disconnect``: executed, reply
+  lost — the ambiguous window idempotency keys exist for, named to match
+  the fake agent's ``chaos_disconnect``), or around it (``delay``).
+  Exercises client-side retry/breaker logic against a live in-process
+  server without touching the server.
+- ``FlakyAgent``: arms the fake tpu-agent's ``chaos_*`` ``inject_fault``
+  knobs (oim_tpu/agent/fake.py) for a scope — whole-stack chaos at the
+  device-plane hop, where drops surface to the CSI plane as UNAVAILABLE
+  through the controller.
+
+Both are product-adjacent test infrastructure (importable from tests and
+from `oimctl`-driven game days), not production code paths: nothing in
+the daemons imports this module.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable
+
+import grpc
+
+from oim_tpu.agent import Agent
+
+
+class InjectedRpcError(grpc.RpcError):
+    """A synthetic RpcError carrying a chosen status (``code=None``
+    reproduces the locally-raised-error shape whose formatting crash the
+    status classifier guards against)."""
+
+    def __init__(
+        self,
+        code: grpc.StatusCode | None = grpc.StatusCode.UNAVAILABLE,
+        details: str = "injected fault",
+    ):
+        super().__init__(details)
+        self._code = code
+        self._details = details
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._details
+
+
+class _FlakyMulticallable:
+    def __init__(self, channel: "FlakyChannel", inner, path: str):
+        self._channel = channel
+        self._inner = inner
+        self._path = path
+
+    def __call__(self, request, **kwargs):
+        hit = self._channel._roll(self._path)
+        if hit:
+            mode = self._channel.mode
+            if mode == "error":
+                raise InjectedRpcError(self._channel.code)
+            if mode == "delay":
+                time.sleep(self._channel.delay_s)
+            elif mode not in ("disconnect", "none_code"):
+                raise ValueError(f"unknown chaos mode {mode!r}")
+            if mode == "none_code":
+                raise InjectedRpcError(None, "locally raised injected fault")
+        reply = self._inner(request, **kwargs)
+        if hit and self._channel.mode == "disconnect":
+            # Executed server-side; the reply is eaten.
+            raise InjectedRpcError(
+                grpc.StatusCode.UNAVAILABLE, "injected reply drop"
+            )
+        return reply
+
+
+class FlakyChannel:
+    """grpc.Channel wrapper injecting faults into unary calls.
+
+    ``mode``: ``error`` (fail before the peer sees it, status ``code``),
+    ``disconnect`` (execute, then eat the reply as UNAVAILABLE — the
+    executed-but-reply-lost window, same word as the fake agent's
+    ``chaos_disconnect``), ``delay`` (sleep ``delay_s`` first),
+    ``none_code`` (raise an RpcError whose ``code()`` is None — the
+    local-error regression shape).
+
+    ``rate`` + ``seed`` pick victims reproducibly; ``fail_next(n)``
+    overrides the dice for exactly the next ``n`` calls (deterministic
+    unit-test scripting).  Streaming calls pass through unwrapped.
+    """
+
+    def __init__(
+        self,
+        inner: grpc.Channel,
+        mode: str = "error",
+        rate: float = 1.0,
+        seed: int = 0,
+        code: grpc.StatusCode = grpc.StatusCode.UNAVAILABLE,
+        delay_s: float = 0.05,
+    ):
+        self._inner = inner
+        self.mode = mode
+        self.rate = rate
+        self.code = code
+        self.delay_s = delay_s
+        self._rng = random.Random(seed)
+        self._forced = 0
+        self.calls = 0
+        self.injected = 0
+
+    def fail_next(self, n: int = 1) -> None:
+        self._forced += n
+
+    def _roll(self, _path: str) -> bool:
+        self.calls += 1
+        if self._forced > 0:
+            self._forced -= 1
+            self.injected += 1
+            return True
+        if self._rng.random() < self.rate:
+            self.injected += 1
+            return True
+        return False
+
+    def unary_unary(self, path, **kwargs):
+        return _FlakyMulticallable(
+            self, self._inner.unary_unary(path, **kwargs), path
+        )
+
+    def unary_stream(self, path, **kwargs):
+        return self._inner.unary_stream(path, **kwargs)
+
+    def stream_stream(self, path, **kwargs):
+        return self._inner.stream_stream(path, **kwargs)
+
+    def subscribe(self, callback, try_to_connect=False):
+        return self._inner.subscribe(callback, try_to_connect)
+
+    def unsubscribe(self, callback):
+        return self._inner.unsubscribe(callback)
+
+    def close(self):
+        return self._inner.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class FlakyAgent:
+    """Scoped ``chaos_*`` arming of a fake tpu-agent.
+
+    >>> with FlakyAgent(sock, "chaos_disconnect", rate=0.2, seed=7):
+    ...     soak()  # 20% of device-plane requests lose their reply
+    ...             # (after executing), severing the connection
+    """
+
+    def __init__(
+        self,
+        socket_path: str,
+        kind: str,
+        rate: float = 1.0,
+        seed: int | None = 0,
+        delay_s: float | None = None,
+        error_code: int | None = None,
+        methods: list[str] | None = None,
+        connect: Callable[[str], Agent] = Agent,
+    ):
+        self.socket_path = socket_path
+        self.kind = kind
+        self.rate = rate
+        self.seed = seed
+        self.delay_s = delay_s
+        self.error_code = error_code
+        self.methods = methods
+        self._connect = connect
+
+    def arm(self) -> None:
+        with self._connect(self.socket_path) as agent:
+            agent.inject_chaos(
+                self.kind,
+                rate=self.rate,
+                seed=self.seed,
+                delay_s=self.delay_s,
+                error_code=self.error_code,
+                methods=self.methods,
+            )
+
+    def heal(self) -> None:
+        with self._connect(self.socket_path) as agent:
+            agent.inject_chaos("chaos_clear")
+
+    def __enter__(self) -> "FlakyAgent":
+        self.arm()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.heal()

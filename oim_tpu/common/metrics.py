@@ -273,6 +273,36 @@ def registry() -> MetricsRegistry:
     return _registry
 
 
+# ---------------------------------------------------------------------------
+# Shared resilience instruments (used by oim_tpu.common.resilience): defined
+# here, on the process registry, so every daemon that touches the retry
+# layer exports identical series names — the incident-time queries in
+# doc/operations.md depend on these exact shapes.
+
+RPC_ATTEMPTS = _registry.counter(
+    "oim_rpc_attempts_total",
+    "Client-side RPC attempts through the shared retry layer, by outcome "
+    "(ok / retryable / fatal).",
+    ("component", "op", "outcome"),
+)
+RPC_RETRIES = _registry.counter(
+    "oim_rpc_retries_total",
+    "Re-attempts issued after a retryable failure.",
+    ("component", "op"),
+)
+RPC_LATENCY = _registry.histogram(
+    "oim_rpc_latency_seconds",
+    "Whole-operation client latency through the retry layer (all attempts "
+    "plus backoff sleeps).",
+    ("component", "op"),
+)
+BREAKER_TRANSITIONS = _registry.counter(
+    "oim_breaker_transitions_total",
+    "Circuit-breaker state transitions, by target and entered state.",
+    ("target", "state"),
+)
+
+
 EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 

@@ -1,0 +1,514 @@
+"""Shared control-plane resilience: retries, deadlines, circuit breaking.
+
+The reference design routes every control operation over a registry/proxy
+hop (host ↔ card cannot talk directly, reference spec.md:33-56), which
+makes transient RPC failure the *normal* failure mode rather than the
+exceptional one.  This module is THE one definition of how the control
+plane reacts to it, shared by the CSI remote backend, the agent JSON-RPC
+client, the controller/serve registry heartbeats, and the health
+reporter, so their behavior under faults can never diverge:
+
+- ``RetryPolicy``: declarative exponential backoff with full jitter
+  (AWS-style ``uniform(0, min(cap, base*mult^n))``), a per-attempt
+  timeout and an overall deadline.  Clock, sleep and RNG are injectable
+  so tests are deterministic — no wall time, no flakes.
+- ``retryable(exc)``: the status classifier.  UNAVAILABLE and
+  DEADLINE_EXCEEDED mean "the hop failed, the operation may not have";
+  INVALID_ARGUMENT / FAILED_PRECONDITION / ALREADY_EXISTS mean the
+  *request* is wrong and retrying can only repeat the answer.
+  Transport-level breaks (EPIPE/ECONNRESET/refused dial) are retryable.
+- ``CircuitBreaker``: per-target closed → open after N consecutive
+  failures → half-open probe after a cooldown.  An open breaker fails
+  fast instead of hammering a dead peer with full retry ladders.
+- ``call_with_retry``: the loop tying the three together, emitting
+  ``oim_rpc_attempts_total`` / ``oim_rpc_retries_total`` /
+  ``oim_rpc_latency_seconds`` (instrument definitions live in
+  oim_tpu.common.metrics so every daemon exports the same series).
+
+Retrying a mutation is only safe against an idempotent server; the
+controller's MapVolume/UnmapVolume are volume_id-keyed idempotent
+(oim_tpu/controller/controller.py) precisely so this layer may re-send
+them after an ambiguous failure (request executed, reply lost).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from typing import Callable
+
+import grpc
+
+from oim_tpu import log
+from oim_tpu.common import metrics
+
+# Status codes where the *hop* failed (peer unreachable, deadline blown)
+# and a retry can plausibly land: the request itself was never judged.
+RETRYABLE_STATUS = frozenset(
+    {grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED}
+)
+
+# Transport errnos that mean "the connection died, not the request":
+# broken pipe / reset (peer restarted mid-call), refused dial.
+_RETRYABLE_ERRNOS = frozenset(
+    {
+        errno.EPIPE,
+        errno.ECONNRESET,
+        errno.ECONNREFUSED,
+        errno.ECONNABORTED,
+    }
+)
+
+# Additionally retryable when the caller is DIALING a unix socket it owns
+# the lifecycle relationship with: a missing socket file just means the
+# daemon is mid-restart (it unlinks on stop, binds on start).  NOT part
+# of the general classifier — an ENOENT from, say, a mistyped TLS cert
+# path is a deterministic misconfiguration that must surface immediately,
+# not be retried into a flaky-looking ladder.
+_DIAL_RETRYABLE_ERRNOS = _RETRYABLE_ERRNOS | {errno.ENOENT, errno.EAGAIN}
+
+
+def _raw_code(exc: BaseException) -> grpc.StatusCode | None:
+    """``exc.code()`` if it yields a real StatusCode, else None — the
+    crash-proof primitive under status_of/peer_judged."""
+    code = None
+    code_fn = getattr(exc, "code", None)
+    if callable(code_fn):
+        try:
+            code = code_fn()
+        except Exception:
+            code = None
+    return code if isinstance(code, grpc.StatusCode) else None
+
+
+def status_of(exc: BaseException) -> grpc.StatusCode:
+    """The gRPC status of an exception, None-safe.
+
+    A *locally* raised RpcError (channel torn down mid-call, interceptor
+    failure) can return ``None`` from ``exc.code()``; classifying — and
+    formatting — must not crash on it, so it maps to UNKNOWN.
+    """
+    if isinstance(exc, grpc.RpcError):
+        return _raw_code(exc) or grpc.StatusCode.UNKNOWN
+    return grpc.StatusCode.UNKNOWN
+
+
+def details_of(exc: BaseException) -> str:
+    """Human-readable detail for an RpcError, None-details-safe (a
+    locally raised RpcError may have no ``details`` or return None)."""
+    try:
+        details = getattr(exc, "details", lambda: None)()
+    except Exception:
+        details = None
+    return str(details or exc or "RPC failed")
+
+
+def error_text(exc: BaseException) -> str:
+    """``STATUS: details`` for an RpcError, None-code/None-details-safe —
+    THE one formatter for surfacing gRPC failures to humans (a locally
+    raised RpcError crashes the naive ``exc.code().name`` pattern)."""
+    return f"{status_of(exc).name}: {details_of(exc)}"
+
+
+def peer_judged(exc: BaseException) -> bool:
+    """Did the peer actually answer ``exc``?  True for application-level
+    errors and server-judged gRPC statuses; False for a *locally* raised
+    RpcError (raw ``code()`` is None — the channel died before any
+    answer) and for transport errors, which prove nothing about the peer
+    being alive."""
+    if isinstance(exc, grpc.RpcError):
+        return _raw_code(exc) is not None
+    return not isinstance(exc, (ConnectionError, TimeoutError, OSError))
+
+
+def retryable(exc: BaseException) -> bool:
+    """Should the shared policy re-send after ``exc``?
+
+    gRPC: only hop-failure statuses (RETRYABLE_STATUS).  Transport:
+    connection breaks and timeouts.  Everything else — including
+    application errors like AgentError — is the peer's *answer* and is
+    final.
+    """
+    if isinstance(exc, grpc.RpcError):
+        return status_of(exc) in RETRYABLE_STATUS
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    if isinstance(exc, OSError):
+        return exc.errno in _RETRYABLE_ERRNOS
+    return False
+
+
+def retryable_dial(exc: BaseException) -> bool:
+    """``retryable`` widened for clients that re-dial a unix socket each
+    attempt (the agent client): an absent socket file is the daemon
+    restarting, so ENOENT/EAGAIN are hop failures there."""
+    if isinstance(exc, OSError) and not isinstance(
+        exc, (ConnectionError, TimeoutError)
+    ):
+        return exc.errno in _DIAL_RETRYABLE_ERRNOS
+    return retryable(exc)
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        log.current().warning("invalid env knob", name=name, value=raw)
+        return default
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Declarative bounded-retry policy.
+
+    ``max_attempts`` counts the first try: 1 disables retries entirely
+    (the chaos suite proves the soak *fails* at 1 — retries, not luck).
+    ``overall_deadline_s`` caps the whole ladder from the first attempt;
+    backoff sleeps are truncated so the ladder never overshoots it.
+    ``clock``/``sleep``/``rng`` are injectable for deterministic tests.
+    """
+
+    max_attempts: int = 4
+    initial_backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    multiplier: float = 2.0
+    per_attempt_timeout_s: float | None = None
+    overall_deadline_s: float | None = None
+    clock: Callable[[], float] = time.monotonic
+    sleep: Callable[[float], None] = time.sleep
+    rng: random.Random = field(default_factory=random.Random)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "RetryPolicy":
+        """Operator knobs (doc/operations.md): OIM_RETRY_MAX_ATTEMPTS,
+        OIM_RETRY_INITIAL_BACKOFF_S, OIM_RETRY_MAX_BACKOFF_S,
+        OIM_RETRY_MULTIPLIER, OIM_RETRY_DEADLINE_S (0 = unbounded)."""
+        deadline = _env_float("OIM_RETRY_DEADLINE_S", 0.0)
+        policy = cls(
+            max_attempts=max(1, int(_env_float("OIM_RETRY_MAX_ATTEMPTS", 4))),
+            initial_backoff_s=_env_float("OIM_RETRY_INITIAL_BACKOFF_S", 0.05),
+            max_backoff_s=_env_float("OIM_RETRY_MAX_BACKOFF_S", 2.0),
+            multiplier=_env_float("OIM_RETRY_MULTIPLIER", 2.0),
+            overall_deadline_s=deadline if deadline > 0 else None,
+        )
+        return replace(policy, **overrides) if overrides else policy
+
+    @classmethod
+    def one_shot(cls) -> "RetryPolicy":
+        """No retries — the pre-resilience behavior, kept constructible
+        so the chaos suite can prove retries are what saves the soak."""
+        return cls(max_attempts=1)
+
+    @classmethod
+    def for_heartbeat(cls, period_s: float) -> "RetryPolicy":
+        """Env-tuned policy for a periodic beat: the whole ladder is
+        capped at 80% of the period so one slow ladder can never pile
+        onto the next beat — shared by the controller/serve address
+        heartbeats and the health publish loop."""
+        return cls.from_env(overall_deadline_s=max(period_s * 0.8, 0.1))
+
+    def base_backoff(self, attempt: int) -> float:
+        """Pre-jitter ceiling before retry ``attempt`` (1 = first retry):
+        ``min(max, initial * multiplier**(attempt-1))``."""
+        raw = self.initial_backoff_s * (self.multiplier ** (attempt - 1))
+        return min(self.max_backoff_s, raw)
+
+    def backoff(self, attempt: int) -> float:
+        """Full-jitter sleep before retry ``attempt``: uniform over
+        ``[0, base_backoff(attempt)]`` (decorrelates a thundering herd of
+        hosts all retrying the same dead registry)."""
+        return self.rng.uniform(0.0, self.base_backoff(attempt))
+
+    def attempt_timeout(self, deadline: float | None) -> float | None:
+        """Per-attempt RPC timeout, truncated to the overall deadline."""
+        remaining = None
+        if deadline is not None:
+            remaining = max(deadline - self.clock(), 0.001)
+        if self.per_attempt_timeout_s is None:
+            return remaining
+        if remaining is None:
+            return self.per_attempt_timeout_s
+        return min(self.per_attempt_timeout_s, remaining)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class BreakerOpenError(Exception):
+    """Fail-fast rejection: the breaker for ``target`` is open.  Not
+    retryable by design — the point is to STOP hammering the peer."""
+
+    def __init__(self, target: str, retry_in_s: float):
+        super().__init__(
+            f"circuit breaker for {target!r} is open "
+            f"(probe in {retry_in_s:.1f}s)"
+        )
+        self.target = target
+        self.retry_in_s = retry_in_s
+
+
+class CircuitBreaker:
+    """Per-target consecutive-failure breaker.
+
+    closed --N consecutive failures--> open --cooldown--> half-open
+    (exactly one probe admitted) --success--> closed / --failure--> open.
+
+    Counts *operations* (a whole retry ladder), not attempts: callers
+    record once per call_with_retry outcome, so the threshold reads as
+    "N straight failed operations", independent of the retry budget.
+    Transitions are observable via
+    ``oim_breaker_transitions_total{target,state}``.
+    """
+
+    def __init__(
+        self,
+        target: str,
+        failure_threshold: int = 5,
+        reset_timeout_s: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.target = target
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+        # Generation token: bumps on every state transition.  allow()
+        # hands it to the operation; record_* with a stale token is
+        # ignored, so an operation admitted under an old state (e.g. one
+        # that hung through close→open→half-open) can neither steal nor
+        # resolve a later probe's slot, nor re-open a breaker on evidence
+        # that predates it.
+        self._generation = 0
+
+    @classmethod
+    def from_env(cls, target: str, **overrides) -> "CircuitBreaker":
+        """Operator knobs: OIM_BREAKER_FAILURES, OIM_BREAKER_RESET_S."""
+        kwargs = dict(
+            failure_threshold=max(1, int(_env_float("OIM_BREAKER_FAILURES", 5))),
+            reset_timeout_s=_env_float("OIM_BREAKER_RESET_S", 10.0),
+        )
+        kwargs.update(overrides)
+        return cls(target, **kwargs)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _transition_locked(self, state: str) -> None:
+        if self._state == state:
+            return
+        self._state = state
+        self._generation += 1
+        metrics.BREAKER_TRANSITIONS.inc(self.target, state)
+        log.current().info(
+            "breaker transition", target=self.target, state=state
+        )
+
+    def allow(self) -> int:
+        """Gate one operation; raises BreakerOpenError when open (and
+        while a half-open probe is already in flight).  Returns the
+        generation token to pass back to ``record_*`` so a stale
+        operation cannot corrupt later probe accounting."""
+        with self._lock:
+            if self._state == CLOSED:
+                return self._generation
+            now = self.clock()
+            if self._state == OPEN:
+                elapsed = now - self._opened_at
+                if elapsed < self.reset_timeout_s:
+                    raise BreakerOpenError(
+                        self.target, self.reset_timeout_s - elapsed
+                    )
+                self._transition_locked(HALF_OPEN)
+                self._probing = True
+                return self._generation
+            # HALF_OPEN: exactly one in-flight probe.
+            if self._probing:
+                raise BreakerOpenError(self.target, self.reset_timeout_s)
+            self._probing = True
+            return self._generation
+
+    def _stale_locked(self, token: int | None) -> bool:
+        return token is not None and token != self._generation
+
+    def record_success(self, token: int | None = None) -> None:
+        with self._lock:
+            if self._stale_locked(token):
+                return
+            self._failures = 0
+            self._probing = False
+            self._transition_locked(CLOSED)
+
+    def record_abandoned(self, token: int | None = None) -> None:
+        """The operation ended without a verdict on the peer (interrupt,
+        shutdown): release an in-flight half-open probe slot but change
+        no state — neither evidence of life nor of death."""
+        with self._lock:
+            if self._stale_locked(token):
+                return
+            self._probing = False
+
+    def record_failure(self, token: int | None = None) -> None:
+        with self._lock:
+            if self._stale_locked(token):
+                return
+            self._probing = False
+            if self._state == HALF_OPEN:
+                self._opened_at = self.clock()
+                self._transition_locked(OPEN)
+                return
+            self._failures += 1
+            if self._state == CLOSED and self._failures >= self.failure_threshold:
+                self._opened_at = self.clock()
+                self._transition_locked(OPEN)
+
+
+# ---------------------------------------------------------------------------
+# The retry loop
+
+
+@dataclass(frozen=True)
+class Attempt:
+    """What one attempt of ``call_with_retry`` hands the callable."""
+
+    number: int  # 1-based
+    timeout: float | None  # per-attempt RPC timeout (None = caller default)
+
+    def clamped(self, default: float = 10.0, floor: float = 0.1) -> float:
+        """Per-attempt RPC timeout as a concrete number: the ladder's
+        remaining budget clamped to [floor, default] — THE one clamp the
+        heartbeat/publish hops share, so a hanging peer can never stall
+        an operation past the deadline its policy promises."""
+        if self.timeout is None:
+            return default
+        return min(default, max(self.timeout, floor))
+
+    def budget_clamp(
+        self, clock: Callable[[], float] = time.monotonic
+    ) -> Callable[..., float]:
+        """For attempts that issue SEVERAL RPCs: a ``t(default)`` callable
+        that re-derives the remaining budget at each call, so the whole
+        attempt — not each RPC — fits the ladder deadline (N hanging
+        RPCs must not each burn the full per-attempt clamp).  Pass the
+        policy's clock so fake-clock tests stay deterministic."""
+        deadline = None if self.timeout is None else clock() + self.timeout
+
+        def clamp(default: float = 10.0, floor: float = 0.1) -> float:
+            if deadline is None:
+                return default
+            return min(default, max(deadline - clock(), floor))
+
+        return clamp
+
+
+def call_with_retry(
+    fn: Callable[[Attempt], object],
+    policy: RetryPolicy,
+    *,
+    component: str,
+    op: str,
+    classify: Callable[[BaseException], bool] = retryable,
+    breaker: CircuitBreaker | None = None,
+    on_retry: Callable[[BaseException, int], None] | None = None,
+):
+    """Run ``fn(attempt)`` under ``policy``; returns its result or raises
+    the final error.
+
+    - ``classify(exc)`` decides retryability (default: the shared
+      classifier).  Non-retryable errors propagate immediately.
+    - ``breaker`` (optional) gates the whole operation: an open breaker
+      raises BreakerOpenError with NO attempt made, and the operation's
+      outcome feeds back exactly once.
+    - ``on_retry(exc, attempt)`` runs before each re-attempt — the hook
+      where the CSI backend invalidates its cached channel so the retry
+      re-dials instead of reusing a dead socket.
+    """
+    token = breaker.allow() if breaker is not None else None
+    start = policy.clock()
+    deadline = (
+        start + policy.overall_deadline_s
+        if policy.overall_deadline_s is not None
+        else None
+    )
+    attempt = 0
+    try:
+        while True:
+            attempt += 1
+            try:
+                result = fn(Attempt(attempt, policy.attempt_timeout(deadline)))
+            except Exception as exc:
+                now = policy.clock()
+                if not classify(exc):
+                    metrics.RPC_ATTEMPTS.inc(component, op, "fatal")
+                    metrics.RPC_LATENCY.observe(now - start, component, op)
+                    if breaker is not None:
+                        # A non-retryable *answer* proves the peer is
+                        # alive and judging requests — but only if the
+                        # peer actually answered: a locally raised
+                        # RpcError (code()=None) is hop death and feeds
+                        # the failure streak instead.
+                        if peer_judged(exc):
+                            breaker.record_success(token)
+                        else:
+                            breaker.record_failure(token)
+                    raise
+                metrics.RPC_ATTEMPTS.inc(component, op, "retryable")
+                out_of_budget = (
+                    attempt >= policy.max_attempts
+                    or (deadline is not None and now >= deadline)
+                )
+                if out_of_budget:
+                    metrics.RPC_LATENCY.observe(now - start, component, op)
+                    if breaker is not None:
+                        breaker.record_failure(token)
+                    raise
+                delay = policy.backoff(attempt)
+                if deadline is not None:
+                    delay = min(delay, max(deadline - now, 0.0))
+                log.current().debug(
+                    "retrying",
+                    component=component,
+                    op=op,
+                    attempt=attempt,
+                    delay=round(delay, 4),
+                    error=str(exc),
+                )
+                metrics.RPC_RETRIES.inc(component, op)
+                if on_retry is not None:
+                    on_retry(exc, attempt)
+                if delay > 0:
+                    policy.sleep(delay)
+                continue
+            metrics.RPC_ATTEMPTS.inc(component, op, "ok")
+            metrics.RPC_LATENCY.observe(policy.clock() - start, component, op)
+            if breaker is not None:
+                breaker.record_success(token)
+            return result
+    except BaseException as exc:
+        # Interrupt/exit — from the attempt, the backoff sleep, or an
+        # on_retry hook: no verdict on the peer, but a half-open probe
+        # slot must not stay claimed forever.
+        if breaker is not None and not isinstance(exc, Exception):
+            breaker.record_abandoned(token)
+        raise

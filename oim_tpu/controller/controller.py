@@ -29,7 +29,7 @@ from oim_tpu import log
 from oim_tpu.agent import Agent, AgentError
 from oim_tpu.agent import EBUSY, EEXIST, ENODEV, ENOSPC
 from oim_tpu.common import pci as pcilib
-from oim_tpu.common import metrics, tracing
+from oim_tpu.common import metrics, resilience, tracing
 from oim_tpu.common.interceptors import LogServerInterceptor, PeerCheckInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.tlsconfig import TLSConfig
@@ -72,6 +72,20 @@ class Controller:
         # (oim_tpu/health): leased health/<id>/<chip> keys each interval.
         self.health_interval = health_interval
         self._mutex = KeyMutex()
+        # MapVolume idempotency cache, volume_id-keyed: the last successful
+        # reply (+ whether the allocation was pre-provisioned).  A retried
+        # MapVolume that lands AFTER its first attempt succeeded — the
+        # ambiguous "request executed, reply lost" window the shared retry
+        # layer creates on purpose — returns the original placement from
+        # here instead of re-driving the agent (or ENOSPC-ing a second
+        # allocation).  Entries die on UnmapVolume / ProvisionSlice-delete,
+        # so the cache can never outlive the mapping it describes.
+        self._idem_replies: dict[str, tuple[oim_pb2.MapVolumeReply, bool]] = {}
+        # Registry-hop retry policy: bounded well below the heartbeat
+        # period so one slow ladder can never pile onto the next beat.
+        self._registry_retry = resilience.RetryPolicy.for_heartbeat(
+            registry_delay
+        )
         self._agent: Agent | None = None
         self._agent_lock = threading.Lock()
         # Heartbeat state (Start/Close).
@@ -217,6 +231,30 @@ class Controller:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "volume_id required")
         which = request.WhichOneof("params")
         with self._mutex.locked(volume_id):
+            cached = self._idem_replies.get(volume_id)
+            if cached is not None and self._idem_compatible(request, *cached):
+                # Retry after a lost reply: hand back the original
+                # placement — but only after checking it against the
+                # device plane, because a restarted agent comes back
+                # EMPTY (volatile allocations) and the cache must never
+                # outlive the allocation it describes.  An *unreachable*
+                # agent is the one case the cache answers alone: that is
+                # exactly the mid-recovery window a duplicate of an
+                # already-acknowledged request arrives in.
+                try:
+                    alloc = self.agent().find_allocation(volume_id)
+                except (ConnectionError, OSError):
+                    self._drop_agent()
+                    return cached[0]
+                except AgentError:
+                    # The agent is up but answered with an application
+                    # error: fall through and let the normal path map it
+                    # to a precise status (_call_agent), not UNKNOWN.
+                    pass
+                else:
+                    if alloc is not None:
+                        return cached[0]
+                    self._idem_replies.pop(volume_id, None)  # wiped
             alloc = self._call_agent(
                 context, lambda a: a.find_allocation(volume_id)
             )
@@ -276,7 +314,33 @@ class Controller:
                 )
             except AgentError as exc:
                 context.abort(_agent_error_to_status(exc), str(exc))
-        return self._reply_from_allocation(attached)
+            reply = self._reply_from_allocation(attached)
+            self._idem_replies[volume_id] = (reply, attached["provisioned"])
+        return reply
+
+    @staticmethod
+    def _idem_compatible(
+        request: oim_pb2.MapVolumeRequest,
+        reply: oim_pb2.MapVolumeReply,
+        provisioned: bool,
+    ) -> bool:
+        """Is ``request`` a re-send of the mapping ``reply`` answered?
+        Incompatible requests fall through to the agent-backed path,
+        which produces the precise error (ALREADY_EXISTS / NOT_FOUND)."""
+        which = request.WhichOneof("params")
+        if which == "provisioned":
+            return provisioned
+        if which == "slice":
+            if request.slice.chip_count and (
+                request.slice.chip_count != len(reply.chips)
+            ):
+                return False
+            requested_topology = list(request.slice.topology.dims)
+            if requested_topology and requested_topology != list(reply.mesh.dims):
+                return False
+            return True
+        # No params: "whatever is already mapped" — any cached reply fits.
+        return True
 
     def _reply_from_allocation(self, alloc: dict) -> oim_pb2.MapVolumeReply:
         reply = oim_pb2.MapVolumeReply(
@@ -315,6 +379,10 @@ class Controller:
         if not volume_id:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "volume_id required")
         with self._mutex.locked(volume_id):
+            # Invalidate BEFORE driving the agent: even a half-failed
+            # unmap means the old placement may no longer be truthful, so
+            # a later Map retry must re-derive it from the device plane.
+            self._idem_replies.pop(volume_id, None)
             alloc = self._call_agent(
                 context, lambda a: a.find_allocation(volume_id)
             )
@@ -342,6 +410,12 @@ class Controller:
         if not name:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "name required")
         with self._mutex.locked(name):
+            # Either branch changes (or re-derives) what the name maps
+            # to, so any cached MapVolume placement for it is suspect:
+            # a re-provision after an agent wipe lands on different
+            # chips, and the cache must never outlive the allocation it
+            # describes.
+            self._idem_replies.pop(name, None)
             if request.chip_count > 0:
                 try:
                     alloc = self._call_agent(
@@ -457,7 +531,9 @@ class Controller:
                 log.current().warning(
                     "registration failed",
                     registry=self.registry_address,
-                    error=exc.code().name,
+                    # None-code-safe: a locally raised RpcError must cost
+                    # one beat, not kill the heartbeat thread.
+                    error=resilience.error_text(exc),
                 )
             except Exception as exc:
                 # Never let the heartbeat thread die: a transient local
@@ -473,23 +549,40 @@ class Controller:
 
     def register(self) -> None:
         """One registration: fresh dial → SetValue → close (per-operation
-        connections survive registry restarts, ≙ controller.go:448-468)."""
+        connections survive registry restarts, ≙ controller.go:448-468).
+        Bounded retries under the shared policy: a registry hiccup inside
+        one beat heals within the beat instead of waiting a whole
+        ``registry_delay`` for the next one — which matters because the
+        address lease is only 3 beats deep."""
         from oim_tpu.common.regdial import registry_channel
 
-        with registry_channel(self.registry_address, self.tls) as channel:
-            REGISTRY.stub(channel).SetValue(
-                oim_pb2.SetValueRequest(
-                    value=oim_pb2.Value(
-                        path=f"{self.controller_id}/address",
-                        value=self._advertised_address,
+        def beat(attempt):
+            # Per-attempt timeout shrinks to the ladder's remaining
+            # budget: a hanging registry cannot stall a beat past the
+            # deadline the policy promises.
+            timeout = attempt.clamped()
+            with registry_channel(self.registry_address, self.tls) as channel:
+                REGISTRY.stub(channel).SetValue(
+                    oim_pb2.SetValueRequest(
+                        value=oim_pb2.Value(
+                            path=f"{self.controller_id}/address",
+                            value=self._advertised_address,
+                        ),
+                        # Lease-scoped liveness: a crashed controller's
+                        # address expires a few missed heartbeats after
+                        # the last refresh instead of surviving until
+                        # overwritten.
+                        ttl_seconds=max(1, int(self.registry_delay * 3)),
                     ),
-                    # Lease-scoped liveness: a crashed controller's address
-                    # expires a few missed heartbeats after the last
-                    # refresh instead of surviving until overwritten.
-                    ttl_seconds=max(1, int(self.registry_delay * 3)),
-                ),
-                timeout=10,
-            )
+                    timeout=timeout,
+                )
+
+        resilience.call_with_retry(
+            beat,
+            self._registry_retry,
+            component="oim-controller",
+            op="Register",
+        )
         log.current().debug(
             "registered", id=self.controller_id, address=self._advertised_address
         )

@@ -20,7 +20,7 @@ from oim_tpu import log
 from oim_tpu.agent import Agent, AgentError, ENODEV, ENOSPC, EEXIST
 from oim_tpu.common import endpoint as ep
 from oim_tpu.common import pci as pcilib
-from oim_tpu.common import tracing
+from oim_tpu.common import resilience, tracing
 from oim_tpu.common.chancache import ChannelCache, RECONNECT_OPTIONS
 from oim_tpu.common.tlsconfig import TLSConfig
 from oim_tpu.csi import rendezvous
@@ -305,6 +305,8 @@ class RemoteBackend:
         tls_loader: Callable[[], TLSConfig] | None = None,
         map_params: Callable[[dict], oim_pb2.MapVolumeRequest] | None = None,
         rendezvous_timeout: float = 60.0,
+        retry: resilience.RetryPolicy | None = None,
+        breaker: resilience.CircuitBreaker | None = None,
     ) -> None:
         self.registry_address = registry_address
         self.controller_id = controller_id
@@ -315,6 +317,20 @@ class RemoteBackend:
         # TLS CN ``host.<id>`` pins, so the registry authz lines up).
         self.rendezvous_timeout = rendezvous_timeout
         self._channels = ChannelCache()
+        # Proxy-hop resilience: bounded retries (safe — controller
+        # map/unmap are volume_id-keyed idempotent) plus a breaker so a
+        # dead registry/controller gets probed, not hammered.  Retrying
+        # MapVolume can double-allocate ONLY if the controller forgot the
+        # first success; the idempotency cache there is what makes this
+        # policy sound.
+        self.retry = retry if retry is not None else resilience.RetryPolicy.from_env()
+        self.breaker = (
+            breaker
+            if breaker is not None
+            else resilience.CircuitBreaker.from_env(
+                f"{controller_id}@{registry_address}"
+            )
+        )
 
         # Rendezvous channel factory: cache-backed, so rendezvous must not
         # close what it yields (see rendezvous.join's ownership contract).
@@ -356,78 +372,109 @@ class RemoteBackend:
         # Proxy routing key (≙ remote.go:78).
         return (("controllerid", self.controller_id),)
 
-    def _call(self, fn):
-        channel = self._channel()
+    def _call(self, fn, op: str = "call"):
+        """Run ``fn(channel, attempt)`` under the shared retry policy +
+        breaker; ``attempt.clamped(...)`` gives each RPC a timeout that
+        respects the ladder's remaining overall-deadline budget.
+
+        On UNAVAILABLE the cached registry channel is invalidated before
+        the re-attempt, so the retry re-dials instead of reusing a dead
+        cached channel (gRPC's own reconnect can lag a registry restart
+        at a *new* address — the fingerprint only changes when the TLS
+        material or target does).
+        """
+
+        def attempt(att):
+            return fn(self._channel(), att)
+
+        def on_retry(exc: BaseException, _attempt: int) -> None:
+            if resilience.status_of(exc) == grpc.StatusCode.UNAVAILABLE:
+                self._channels.invalidate("registry")
+
         try:
-            return fn(channel)
+            return resilience.call_with_retry(
+                attempt,
+                self.retry,
+                component="oim-csi-driver",
+                op=op,
+                breaker=self.breaker,
+                on_retry=on_retry,
+            )
         except grpc.RpcError as exc:
-            raise VolumeError(exc.code(), exc.details()) from exc
+            # status_of/details_of default a locally raised RpcError's
+            # None code/details safely instead of crashing CSI formatting.
+            raise VolumeError(
+                resilience.status_of(exc), resilience.details_of(exc)
+            ) from exc
+        except resilience.BreakerOpenError as exc:
+            raise VolumeError(grpc.StatusCode.UNAVAILABLE, str(exc)) from exc
 
     def close(self) -> None:
         self._channels.close()
 
     def provision(self, volume_id: str, chip_count: int) -> int:
-        def run(channel):
+        def run(channel, attempt):
             stub = CONTROLLER.stub(channel)
+            clamp = attempt.budget_clamp(self.retry.clock)
             stub.ProvisionSlice(
                 oim_pb2.ProvisionSliceRequest(name=volume_id, chip_count=chip_count),
                 metadata=self._metadata(),
-                timeout=30,
+                timeout=clamp(30.0),
             )
             return stub.CheckSlice(
                 oim_pb2.CheckSliceRequest(name=volume_id),
                 metadata=self._metadata(),
-                timeout=30,
+                timeout=clamp(30.0),
             ).chip_count
 
-        return self._call(run)
+        return self._call(run, op="ProvisionSlice")
 
     def delete(self, volume_id: str) -> None:
-        def run(channel):
+        def run(channel, attempt):
             CONTROLLER.stub(channel).ProvisionSlice(
                 oim_pb2.ProvisionSliceRequest(name=volume_id, chip_count=0),
                 metadata=self._metadata(),
-                timeout=30,
+                timeout=attempt.clamped(default=30.0),
             )
 
-        self._call(run)
+        self._call(run, op="DeleteSlice")
 
     def capacity(self) -> int:
         """Free chips on the mapped controller's device plane, through the
         proxy (the reference left remote capacity UNIMPLEMENTED;
         ≙ controllerserver.go:150-159 + this repo's GetTopology RPC)."""
-        def run(channel):
+        def run(channel, attempt):
             return CONTROLLER.stub(channel).GetTopology(
                 oim_pb2.GetTopologyRequest(),
                 metadata=self._metadata(),
-                timeout=30,
+                timeout=attempt.clamped(default=30.0),
             ).free_chips
 
-        return self._call(run)
+        return self._call(run, op="GetTopology")
 
     def list_volumes(self) -> list[dict]:
-        def run(channel):
+        def run(channel, attempt):
             reply = CONTROLLER.stub(channel).ListSlices(
                 oim_pb2.ListSlicesRequest(),
                 metadata=self._metadata(),
-                timeout=30,
+                timeout=attempt.clamped(default=30.0),
             )
             return [
                 {"name": s.name, "chip_count": s.chip_count}
                 for s in reply.slices
             ]
 
-        return self._call(run)
+        return self._call(run, op="ListSlices")
 
     def volume_exists(self, volume_id: str) -> bool:
-        def run(channel):
+        def run(channel, attempt):
             try:
                 CONTROLLER.stub(channel).CheckSlice(
                     oim_pb2.CheckSliceRequest(
                         name=volume_id, include_unprovisioned=True
                     ),
                     metadata=self._metadata(),
-                    timeout=30,
+                    timeout=attempt.clamped(default=30.0),
                 )
                 return True
             except grpc.RpcError as exc:
@@ -435,9 +482,11 @@ class RemoteBackend:
                     return False
                 raise
 
-        return self._call(run)
+        return self._call(run, op="CheckSlice")
 
-    def _check_not_evicted(self, channel, volume_id: str) -> None:
+    def _check_not_evicted(
+        self, channel, volume_id: str, timeout: float = 30.0
+    ) -> None:
         """Refuse to stage a volume the fault-management loop has marked
         evicted (oim_tpu/health): FAILED_PRECONDITION until an operator
         remaps it (``oimctl remap``) — staging onto a faulted slice would
@@ -446,7 +495,7 @@ class RemoteBackend:
 
         path = health_states.eviction_key(volume_id)
         reply = REGISTRY.stub(channel).GetValues(
-            oim_pb2.GetValuesRequest(path=path), timeout=30
+            oim_pb2.GetValuesRequest(path=path), timeout=timeout
         )
         for value in reply.values:
             if value.path == path and value.value:
@@ -456,12 +505,12 @@ class RemoteBackend:
                     "remap it with `oimctl remap` before staging",
                 )
 
-    def default_pci(self, channel) -> str:
+    def default_pci(self, channel, timeout: float = 30.0) -> str:
         """Registry-stored PCI default for this controller
         (≙ remote.go:129-145)."""
         reply = REGISTRY.stub(channel).GetValues(
             oim_pb2.GetValuesRequest(path=f"{self.controller_id}/pci"),
-            timeout=30,
+            timeout=timeout,
         )
         for value in reply.values:
             if value.path == f"{self.controller_id}/pci":
@@ -471,9 +520,10 @@ class RemoteBackend:
     def create_device(
         self, volume_id: str, params: dict, deadline: float | None = None
     ) -> StagedDevice:
-        def run(channel):
-            self._check_not_evicted(channel, volume_id)
-            default_pci = self.default_pci(channel)
+        def run(channel, attempt):
+            clamp = attempt.budget_clamp(self.retry.clock)
+            self._check_not_evicted(channel, volume_id, clamp(30.0))
+            default_pci = self.default_pci(channel, clamp(30.0))
             if self.map_params is not None:
                 # Emulation hook: translate a foreign driver's parameters
                 # (≙ emulation via MapVolumeParams, remote.go:156-164).
@@ -492,11 +542,13 @@ class RemoteBackend:
                 else:
                     request.provisioned.SetInParent()
             reply = CONTROLLER.stub(channel).MapVolume(
-                request, metadata=self._metadata(), timeout=60
+                request,
+                metadata=self._metadata(),
+                timeout=clamp(60.0),
             )
             return _staged_from_reply(volume_id, reply, default_pci)
 
-        staged = self._call(run)
+        staged = self._call(run, op="MapVolume")
         num_hosts, members = _parse_membership(params)
         if num_hosts > 1:
             # Converge with the volume's other hosts on one coordinator and
@@ -533,14 +585,14 @@ class RemoteBackend:
         return staged
 
     def destroy_device(self, volume_id: str) -> None:
-        def run(channel):
+        def run(channel, attempt):
             CONTROLLER.stub(channel).UnmapVolume(
                 oim_pb2.UnmapVolumeRequest(volume_id=volume_id),
                 metadata=self._metadata(),
-                timeout=60,
+                timeout=attempt.clamped(default=60.0),
             )
 
-        self._call(run)
+        self._call(run, op="UnmapVolume")
         rendezvous.withdraw(
             self._registry_factory, volume_id, self.controller_id
         )

@@ -26,7 +26,7 @@ import time
 
 from oim_tpu import log
 from oim_tpu.agent import Agent, METHOD_NOT_FOUND, is_agent_error
-from oim_tpu.common import metrics
+from oim_tpu.common import metrics, resilience
 from oim_tpu.common.regdial import registry_channel
 from oim_tpu.health import states
 from oim_tpu.spec import REGISTRY, oim_pb2
@@ -45,6 +45,7 @@ class HealthReporter:
         tls=None,
         interval: float = DEFAULT_HEALTH_INTERVAL,
         scrape_timeout: float = 2.0,
+        retry: resilience.RetryPolicy | None = None,
     ) -> None:
         self.controller_id = controller_id
         self.agent_socket = agent_socket
@@ -52,6 +53,15 @@ class HealthReporter:
         self.tls = tls
         self.interval = interval
         self.scrape_timeout = scrape_timeout
+        # Publish-hop retries bounded to one interval: losing one beat of
+        # a 3-beat lease to a registry blip is exactly what the lease
+        # budget is for, but losing TWO beats risks a false
+        # controller-dead eviction — retry within the beat instead.
+        self.retry = (
+            retry
+            if retry is not None
+            else resilience.RetryPolicy.for_heartbeat(interval)
+        )
         self._agent: Agent | None = None
         self._agent_lock = threading.Lock()
         self._stop = threading.Event()
@@ -135,33 +145,55 @@ class HealthReporter:
         chips = self.scrape()
         ttl = max(1, int(self.interval * 3))
         now = time.time()
-        with registry_channel(self.registry_address, self.tls) as channel:
-            stub = REGISTRY.stub(channel)
-            for chip in chips:
-                stub.SetValue(
-                    oim_pb2.SetValueRequest(
-                        value=oim_pb2.Value(
-                            path=states.health_key(
-                                self.controller_id, chip["chip_id"]
+
+        def publish(attempt):
+            # Re-publishing every key on retry is safe: SetValue of the
+            # same report is idempotent and re-arms the lease.  Each
+            # SetValue re-derives the ladder's remaining budget (one
+            # clamp shared by N chips would let a hanging registry burn
+            # N x clamp per attempt and stall the beat past the deadline
+            # the policy promises).
+            clamp = attempt.budget_clamp(self.retry.clock)
+            with registry_channel(self.registry_address, self.tls) as channel:
+                stub = REGISTRY.stub(channel)
+                for chip in chips:
+                    stub.SetValue(
+                        oim_pb2.SetValueRequest(
+                            value=oim_pb2.Value(
+                                path=states.health_key(
+                                    self.controller_id, chip["chip_id"]
+                                ),
+                                value=states.encode_report(
+                                    chip.get("health", states.OK),
+                                    chip.get("ici_link_errors", 0),
+                                    chip.get("allocation", ""),
+                                    now,
+                                ),
                             ),
-                            value=states.encode_report(
-                                chip.get("health", states.OK),
-                                chip.get("ici_link_errors", 0),
-                                chip.get("allocation", ""),
-                                now,
-                            ),
+                            ttl_seconds=ttl,
                         ),
-                        ttl_seconds=ttl,
-                    ),
-                    timeout=10,
-                )
+                        timeout=clamp(10.0),
+                    )
+
+        resilience.call_with_retry(
+            publish,
+            self.retry,
+            component="oim-controller",
+            op="PublishHealth",
+        )
         return len(chips)
 
     def _get_agent(self) -> Agent:
         with self._agent_lock:
             if self._agent is None:
+                # One-shot: the scrape hop must stay bounded to ~one
+                # scrape_timeout per cycle (the reporter's own loop IS
+                # the retry — next interval, fresh dial); an env-default
+                # ladder here could outlast the whole beat.
                 self._agent = Agent(
-                    self.agent_socket, timeout=self.scrape_timeout
+                    self.agent_socket,
+                    timeout=self.scrape_timeout,
+                    retry=resilience.RetryPolicy.one_shot(),
                 )
             return self._agent
 

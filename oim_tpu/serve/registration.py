@@ -17,6 +17,7 @@ from __future__ import annotations
 import threading
 
 from oim_tpu import log
+from oim_tpu.common import resilience
 
 
 class ServeRegistration:
@@ -32,6 +33,7 @@ class ServeRegistration:
         advertised_address: str,
         tls=None,
         delay: float = 60.0,
+        retry=None,
     ):
         if not serve_id or "/" in serve_id:
             raise ValueError(f"invalid serve id {serve_id!r}")
@@ -40,27 +42,44 @@ class ServeRegistration:
         self.advertised_address = advertised_address
         self.tls = tls
         self.delay = delay
+        # Shared bounded-retry policy (oim_tpu.common.resilience), capped
+        # below the heartbeat period so ladders never overlap beats.
+        if retry is None:
+            retry = resilience.RetryPolicy.for_heartbeat(delay)
+        self.retry = retry
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
-    def register(self) -> None:
+    def register(self, retry=None) -> None:
         """One registration: fresh dial → SetValue → close.  The key is
         leased (3× the heartbeat delay): a crashed instance's address
-        expires with a watch event instead of lingering."""
+        expires with a watch event instead of lingering.  Retried under
+        the shared policy (or ``retry`` when given): a registry blip
+        must not cost a whole beat of a 3-beat lease."""
         from oim_tpu.common.regdial import registry_channel
         from oim_tpu.spec import REGISTRY, oim_pb2
 
-        with registry_channel(self.registry_address, self.tls) as channel:
-            REGISTRY.stub(channel).SetValue(
-                oim_pb2.SetValueRequest(
-                    value=oim_pb2.Value(
-                        path=f"serve/{self.serve_id}/address",
-                        value=self.advertised_address,
+        policy = retry if retry is not None else self.retry
+
+        def beat(attempt):
+            # Per-attempt timeout shrinks to the remaining ladder budget
+            # (a hanging registry must not stall the beat past it).
+            timeout = attempt.clamped()
+            with registry_channel(self.registry_address, self.tls) as channel:
+                REGISTRY.stub(channel).SetValue(
+                    oim_pb2.SetValueRequest(
+                        value=oim_pb2.Value(
+                            path=f"serve/{self.serve_id}/address",
+                            value=self.advertised_address,
+                        ),
+                        ttl_seconds=max(1, int(self.delay * 3)),
                     ),
-                    ttl_seconds=max(1, int(self.delay * 3)),
-                ),
-                timeout=10,
-            )
+                    timeout=timeout,
+                )
+
+        resilience.call_with_retry(
+            beat, policy, component="oim-serve", op="Register"
+        )
         log.current().debug(
             "serve registered",
             id=self.serve_id,
@@ -105,7 +124,11 @@ class ServeRegistration:
                 )
 
     def start(self) -> "ServeRegistration":
-        self.register()  # fail fast on misconfiguration
+        # Fail FAST on misconfiguration: one bounded attempt, no ladder —
+        # a typo'd registry address should surface in seconds, not after
+        # 80% of a 60s heartbeat period of retries.  The background loop
+        # keeps the full beat-bounded policy for transient blips.
+        self.register(retry=resilience.RetryPolicy.one_shot())
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self

@@ -340,3 +340,67 @@ def test_rpc_and_chip_metrics_through_full_stack(tmp_path):
         reg_srv.stop()
         registry.close()
         agent_srv.stop()
+
+
+def test_resilience_instruments_record_and_render():
+    """The shared retry/breaker layer's instruments (defined in
+    oim_tpu/common/metrics.py, driven by oim_tpu/common/resilience.py):
+    attempts by outcome, retry count, whole-operation latency, and
+    breaker transitions, all in standard exposition text."""
+    from oim_tpu.common import resilience
+
+    policy = resilience.RetryPolicy(
+        max_attempts=3, initial_backoff_s=0.0, sleep=lambda s: None
+    )
+    state = {"n": 0}
+
+    def flaky(_attempt):
+        state["n"] += 1
+        if state["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    breaker = resilience.CircuitBreaker(
+        "metrics-demo", failure_threshold=1, reset_timeout_s=60.0
+    )
+    assert (
+        resilience.call_with_retry(
+            flaky, policy, component="metrics-demo", op="Demo",
+            breaker=breaker,
+        )
+        == "ok"
+    )
+    assert metrics.RPC_ATTEMPTS.value("metrics-demo", "Demo", "ok") == 1
+    assert metrics.RPC_ATTEMPTS.value("metrics-demo", "Demo", "retryable") == 2
+    assert metrics.RPC_RETRIES.value("metrics-demo", "Demo") == 2
+    assert metrics.RPC_LATENCY.count("metrics-demo", "Demo") == 1
+
+    # A one-failure breaker opens on the next (unretried) failure...
+    with pytest.raises(ConnectionError):
+        resilience.call_with_retry(
+            lambda _a: (_ for _ in ()).throw(ConnectionError("down")),
+            resilience.RetryPolicy.one_shot(),
+            component="metrics-demo",
+            op="Demo",
+            breaker=breaker,
+        )
+    assert metrics.BREAKER_TRANSITIONS.value("metrics-demo", "open") == 1
+
+    text = metrics.registry().render()
+    assert "# TYPE oim_rpc_attempts_total counter" in text
+    assert (
+        'oim_rpc_attempts_total{component="metrics-demo",op="Demo",'
+        'outcome="ok"} 1' in text
+    )
+    assert (
+        'oim_rpc_retries_total{component="metrics-demo",op="Demo"} 2' in text
+    )
+    assert "# TYPE oim_rpc_latency_seconds histogram" in text
+    assert (
+        'oim_rpc_latency_seconds_count{component="metrics-demo",op="Demo"} '
+        in text
+    )
+    assert (
+        'oim_breaker_transitions_total{target="metrics-demo",state="open"} 1'
+        in text
+    )

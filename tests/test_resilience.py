@@ -1,0 +1,866 @@
+"""Control-plane resilience: retries, deadlines, circuit breaking, chaos.
+
+The reference's registry/proxy topology makes transient RPC failure the
+*normal* failure mode; this suite holds the shared resilience layer
+(oim_tpu/common/resilience.py) and every hop threaded through it to the
+ISSUE's acceptance bar — including the chaos soak proving that map/unmap
+under 20% injected transport failure leaks no placements and
+double-allocates nothing, and that the same soak FAILS with retries
+disabled (resilience, not luck).
+"""
+
+from __future__ import annotations
+
+import random
+import socket as socket_mod
+import threading
+import time
+
+import grpc
+import pytest
+
+from oim_tpu.agent import (
+    Agent,
+    AgentError,
+    ChipStore,
+    Client,
+    FakeAgentServer,
+)
+from oim_tpu.common import metrics, resilience
+from oim_tpu.common.chaos import FlakyAgent, FlakyChannel, InjectedRpcError
+from oim_tpu.controller import Controller
+from oim_tpu.csi.backend import RemoteBackend, VolumeError
+from oim_tpu.registry import Registry
+from oim_tpu.spec import oim_pb2
+from tests.helpers import FakeAbort, FakeServicerContext, wait_for
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    """Deterministic monotonic clock + recorded sleeps that advance it."""
+
+    def __init__(self) -> None:
+        self.now = 100.0
+        self.sleeps: list[float] = []
+
+    def __call__(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.now += seconds
+
+
+class MaxJitterRng(random.Random):
+    """uniform(a, b) → b: turns full jitter into its deterministic
+    ceiling so backoff sequences are exactly assertable."""
+
+    def uniform(self, a: float, b: float) -> float:
+        return b
+
+
+def _policy(clock: FakeClock, **kw) -> resilience.RetryPolicy:
+    kw.setdefault("rng", MaxJitterRng())
+    return resilience.RetryPolicy(clock=clock, sleep=clock.sleep, **kw)
+
+
+def _fail_times(n: int, exc_factory, result=42):
+    """A fn(attempt) that fails its first ``n`` calls."""
+    calls = []
+
+    def fn(_attempt):
+        calls.append(1)
+        if len(calls) <= n:
+            raise exc_factory()
+        return result
+
+    fn.calls = calls
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+
+
+class TestRetryPolicy:
+    def test_backoff_sequence_is_capped_exponential(self):
+        clock = FakeClock()
+        policy = _policy(
+            clock,
+            max_attempts=6,
+            initial_backoff_s=0.05,
+            multiplier=2.0,
+            max_backoff_s=0.3,
+        )
+        fn = _fail_times(5, lambda: ConnectionError("boom"))
+        assert resilience.call_with_retry(
+            fn, policy, component="t", op="seq"
+        ) == 42
+        # Ceiling jitter: exactly initial * 2^n, capped at max_backoff_s.
+        assert clock.sleeps == [0.05, 0.1, 0.2, 0.3, 0.3]
+
+    def test_jitter_bounds_full_jitter(self):
+        policy = resilience.RetryPolicy(
+            initial_backoff_s=0.1, max_backoff_s=1.0, rng=random.Random(7)
+        )
+        for attempt in range(1, 8):
+            for _ in range(50):
+                delay = policy.backoff(attempt)
+                assert 0.0 <= delay <= policy.base_backoff(attempt)
+
+    def test_deadline_truncates_backoff_and_stops_ladder(self):
+        clock = FakeClock()
+        policy = _policy(
+            clock,
+            max_attempts=100,
+            initial_backoff_s=4.0,
+            max_backoff_s=60.0,
+            overall_deadline_s=10.0,
+        )
+        fn = _fail_times(1000, lambda: ConnectionError("down"))
+        with pytest.raises(ConnectionError):
+            resilience.call_with_retry(fn, policy, component="t", op="dl")
+        # 4s + 8s-truncated-to-6s exhausts the 10s budget: 3 attempts, and
+        # no sleep ever pushed the clock past the deadline.
+        assert clock.sleeps == [4.0, 6.0]
+        assert len(fn.calls) == 3
+        assert clock.now - 100.0 <= 10.0
+
+    def test_non_retryable_short_circuits(self):
+        clock = FakeClock()
+        policy = _policy(clock, max_attempts=5)
+        fn = _fail_times(
+            5,
+            lambda: InjectedRpcError(
+                grpc.StatusCode.INVALID_ARGUMENT, "bad request"
+            ),
+        )
+        with pytest.raises(grpc.RpcError):
+            resilience.call_with_retry(fn, policy, component="t", op="nr")
+        assert len(fn.calls) == 1
+        assert clock.sleeps == []
+
+    def test_max_attempts_exhaustion_raises_last_error(self):
+        clock = FakeClock()
+        policy = _policy(clock, max_attempts=3)
+        fn = _fail_times(99, lambda: ConnectionError("still down"))
+        with pytest.raises(ConnectionError, match="still down"):
+            resilience.call_with_retry(fn, policy, component="t", op="mx")
+        assert len(fn.calls) == 3
+
+    def test_one_shot_never_retries(self):
+        fn = _fail_times(1, lambda: ConnectionError("x"))
+        with pytest.raises(ConnectionError):
+            resilience.call_with_retry(
+                fn,
+                resilience.RetryPolicy.one_shot(),
+                component="t",
+                op="os",
+            )
+        assert len(fn.calls) == 1
+
+    def test_attempt_timeout_truncated_by_deadline(self):
+        clock = FakeClock()
+        policy = _policy(
+            clock, per_attempt_timeout_s=30.0, overall_deadline_s=5.0
+        )
+        seen = []
+        resilience.call_with_retry(
+            lambda attempt: seen.append(attempt.timeout),
+            policy,
+            component="t",
+            op="to",
+        )
+        assert seen == [5.0]
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("OIM_RETRY_MAX_ATTEMPTS", "7")
+        monkeypatch.setenv("OIM_RETRY_INITIAL_BACKOFF_S", "0.5")
+        monkeypatch.setenv("OIM_RETRY_DEADLINE_S", "12")
+        policy = resilience.RetryPolicy.from_env()
+        assert policy.max_attempts == 7
+        assert policy.initial_backoff_s == 0.5
+        assert policy.overall_deadline_s == 12.0
+        monkeypatch.setenv("OIM_RETRY_MAX_ATTEMPTS", "not-a-number")
+        assert resilience.RetryPolicy.from_env().max_attempts == 4  # default
+        assert resilience.RetryPolicy.from_env(max_attempts=2).max_attempts == 2
+
+
+class TestClassifier:
+    @pytest.mark.parametrize(
+        "code,want",
+        [
+            (grpc.StatusCode.UNAVAILABLE, True),
+            (grpc.StatusCode.DEADLINE_EXCEEDED, True),
+            (grpc.StatusCode.INVALID_ARGUMENT, False),
+            (grpc.StatusCode.FAILED_PRECONDITION, False),
+            (grpc.StatusCode.ALREADY_EXISTS, False),
+            (grpc.StatusCode.NOT_FOUND, False),
+        ],
+    )
+    def test_grpc_statuses(self, code, want):
+        assert resilience.retryable(InjectedRpcError(code)) is want
+
+    def test_none_code_maps_to_unknown_and_is_final(self):
+        exc = InjectedRpcError(None, "locally raised")
+        assert resilience.status_of(exc) == grpc.StatusCode.UNKNOWN
+        assert not resilience.retryable(exc)
+
+    def test_transport_errors(self):
+        import errno
+
+        assert resilience.retryable(ConnectionError("eof"))
+        assert resilience.retryable(BrokenPipeError())
+        assert resilience.retryable(ConnectionResetError())
+        assert resilience.retryable(TimeoutError())
+        assert resilience.retryable(OSError(errno.EPIPE, "pipe"))
+        assert not resilience.retryable(OSError(errno.EACCES, "denied"))
+        # ENOENT is NOT generally retryable (a mistyped TLS cert path is
+        # deterministic misconfiguration)...
+        assert not resilience.retryable(OSError(errno.ENOENT, "missing"))
+        # ...but IS for unix-socket dialers: the daemon unlinks its
+        # socket on stop and binds on start, so absence = mid-restart.
+        assert resilience.retryable_dial(OSError(errno.ENOENT, "missing"))
+        assert resilience.retryable_dial(ConnectionError("eof"))
+        assert not resilience.retryable_dial(OSError(errno.EACCES, "no"))
+        assert not resilience.retryable_dial(AgentError(-28, "no space"))
+
+    def test_application_answers_are_final(self):
+        assert not resilience.retryable(AgentError(-28, "no space"))
+        assert not resilience.retryable(ValueError("bad"))
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+
+
+class TestCircuitBreaker:
+    def _breaker(self, clock, **kw):
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("reset_timeout_s", 5.0)
+        return resilience.CircuitBreaker("test-target", clock=clock, **kw)
+
+    def test_opens_after_consecutive_failures(self):
+        clock = FakeClock()
+        breaker = self._breaker(clock)
+        for _ in range(2):
+            breaker.allow()
+            breaker.record_failure()
+        assert breaker.state == resilience.CLOSED
+        breaker.allow()
+        breaker.record_failure()
+        assert breaker.state == resilience.OPEN
+        with pytest.raises(resilience.BreakerOpenError):
+            breaker.allow()
+
+    def test_success_resets_the_streak(self):
+        clock = FakeClock()
+        breaker = self._breaker(clock)
+        for _ in range(2):
+            breaker.record_failure()
+        breaker.record_success()
+        for _ in range(2):
+            breaker.record_failure()
+        assert breaker.state == resilience.CLOSED
+
+    def test_half_open_probe_closes_on_success(self):
+        clock = FakeClock()
+        breaker = self._breaker(clock)
+        for _ in range(3):
+            breaker.record_failure()
+        clock.now += 5.1
+        breaker.allow()  # the probe
+        assert breaker.state == resilience.HALF_OPEN
+        # A second caller while the probe is in flight is rejected.
+        with pytest.raises(resilience.BreakerOpenError):
+            breaker.allow()
+        breaker.record_success()
+        assert breaker.state == resilience.CLOSED
+        breaker.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        clock = FakeClock()
+        breaker = self._breaker(clock)
+        for _ in range(3):
+            breaker.record_failure()
+        clock.now += 5.1
+        breaker.allow()
+        breaker.record_failure()
+        assert breaker.state == resilience.OPEN
+        with pytest.raises(resilience.BreakerOpenError):
+            breaker.allow()
+        # The cooldown re-armed from the probe failure.
+        clock.now += 5.1
+        breaker.allow()
+        breaker.record_success()
+        assert breaker.state == resilience.CLOSED
+
+    def test_non_retryable_answer_counts_as_liveness(self):
+        """A peer answering INVALID_ARGUMENT is alive: the breaker must
+        not open on application-level rejections."""
+        clock = FakeClock()
+        breaker = self._breaker(clock, failure_threshold=2)
+        policy = _policy(clock, max_attempts=1)
+        for _ in range(5):
+            with pytest.raises(grpc.RpcError):
+                resilience.call_with_retry(
+                    _fail_times(
+                        9, lambda: InjectedRpcError(
+                            grpc.StatusCode.INVALID_ARGUMENT
+                        )
+                    ),
+                    policy,
+                    component="t",
+                    op="alive",
+                    breaker=breaker,
+                )
+        assert breaker.state == resilience.CLOSED
+
+    def test_local_rpc_error_counts_as_hop_failure(self):
+        """A locally raised RpcError (code()=None) proves nothing about
+        the peer — it must feed the failure streak (the channel is
+        dying), not reset it like a server-judged answer would."""
+        clock = FakeClock()
+        breaker = self._breaker(clock, failure_threshold=2)
+        policy = _policy(clock, max_attempts=1)
+        for _ in range(2):
+            with pytest.raises(grpc.RpcError):
+                resilience.call_with_retry(
+                    _fail_times(9, lambda: InjectedRpcError(None, "local")),
+                    policy,
+                    component="t",
+                    op="local",
+                    breaker=breaker,
+                )
+        assert breaker.state == resilience.OPEN
+        assert resilience.peer_judged(AgentError(-28, "no space"))
+        assert resilience.peer_judged(
+            InjectedRpcError(grpc.StatusCode.INVALID_ARGUMENT)
+        )
+        assert not resilience.peer_judged(InjectedRpcError(None))
+        assert not resilience.peer_judged(ConnectionError("eof"))
+
+    def test_stale_operation_cannot_corrupt_probe_accounting(self):
+        """An operation admitted while CLOSED that finishes late — after
+        the breaker opened and a half-open probe was admitted — must not
+        re-open the breaker or steal the probe slot."""
+        clock = FakeClock()
+        breaker = self._breaker(clock, failure_threshold=3)
+        stale_token = breaker.allow()  # admitted while CLOSED, hangs...
+        for _ in range(3):
+            token = breaker.allow()
+            breaker.record_failure(token)
+        assert breaker.state == resilience.OPEN
+        clock.now += 5.1
+        probe_token = breaker.allow()
+        assert breaker.state == resilience.HALF_OPEN
+        # The stale op's late verdicts are ignored wholesale.
+        breaker.record_failure(stale_token)
+        assert breaker.state == resilience.HALF_OPEN
+        breaker.record_success(stale_token)
+        assert breaker.state == resilience.HALF_OPEN
+        breaker.record_abandoned(stale_token)
+        # The probe slot is still held: a second probe is rejected.
+        with pytest.raises(resilience.BreakerOpenError):
+            breaker.allow()
+        breaker.record_success(probe_token)
+        assert breaker.state == resilience.CLOSED
+
+    def test_transitions_metric(self):
+        counter = metrics.BREAKER_TRANSITIONS
+        target = "metric-target"
+        clock = FakeClock()
+        breaker = resilience.CircuitBreaker(
+            target, failure_threshold=1, reset_timeout_s=1.0, clock=clock
+        )
+        breaker.record_failure()
+        clock.now += 1.1
+        breaker.allow()
+        breaker.record_success()
+        assert counter.value(target, resilience.OPEN) == 1
+        assert counter.value(target, resilience.HALF_OPEN) == 1
+        assert counter.value(target, resilience.CLOSED) == 1
+
+
+# ---------------------------------------------------------------------------
+# Agent client: reconnect, leak-free failed connect, idempotent close
+
+
+@pytest.fixture
+def agent_stack(tmp_path):
+    store = ChipStore(mesh=(2, 2, 1), device_dir=str(tmp_path / "dev"))
+    server = FakeAgentServer(store, str(tmp_path / "agent.sock")).start()
+    yield store, server
+    server.stop()
+
+
+def _fast_retry(**kw):
+    kw.setdefault("max_attempts", 4)
+    kw.setdefault("initial_backoff_s", 0.005)
+    kw.setdefault("max_backoff_s", 0.02)
+    return resilience.RetryPolicy(**kw)
+
+
+class TestClientResilience:
+    def test_reconnects_after_disconnect_preserving_id_monotonicity(
+        self, agent_stack
+    ):
+        store, server = agent_stack
+        with Client(server.socket_path, retry=_fast_retry()) as client:
+            assert client.invoke("get_topology")["chip_count"] == 4
+            id_before = client._next_id
+            # Exactly one executed-but-severed request (reply lost).
+            client.invoke(
+                "inject_fault", {"kind": "chaos_disconnect", "count": 1}
+            )
+            topo = client.invoke("get_topology")
+            assert topo["chip_count"] == 4  # retried over a fresh dial
+            # The severed attempt and its retry each took a fresh,
+            # monotonically increasing id.
+            assert client._next_id >= id_before + 3
+
+    def test_drop_mode_never_executes(self, agent_stack):
+        store, server = agent_stack
+        with Client(server.socket_path, retry=_fast_retry()) as client:
+            client.invoke(
+                "inject_fault", {"kind": "chaos_drop", "count": 1}
+            )
+            client.invoke(
+                "create_allocation", {"name": "once", "chip_count": 1}
+            )
+            # The dropped first send did not create anything extra; the
+            # retry created exactly one allocation.
+            assert list(store.allocations) == ["once"]
+
+    def test_exhausted_retries_surface_transport_error(self, agent_stack):
+        store, server = agent_stack
+        client = Client(
+            server.socket_path, retry=_fast_retry(max_attempts=2)
+        )
+        server.stop()
+        with pytest.raises(OSError):
+            client.invoke("get_topology")
+        client.close()
+
+    def test_agent_errors_are_not_retried(self, agent_stack):
+        store, server = agent_stack
+        before = metrics.RPC_RETRIES.value("agent-client", "nonsense")
+        with Client(server.socket_path, retry=_fast_retry()) as client:
+            with pytest.raises(AgentError):
+                client.invoke("nonsense")
+            # Still connected and usable after the application error.
+            assert client.invoke("get_topology")["chip_count"] == 4
+        assert metrics.RPC_RETRIES.value("agent-client", "nonsense") == before
+
+    def test_failed_connect_leaks_no_socket(self, tmp_path, monkeypatch):
+        created = []
+        real_socket = socket_mod.socket
+
+        class RecordingSocket(real_socket):
+            def __init__(self, *args, **kw):
+                super().__init__(*args, **kw)
+                created.append(self)
+
+        monkeypatch.setattr(socket_mod, "socket", RecordingSocket)
+        with pytest.raises(OSError):
+            Client(str(tmp_path / "no-such.sock"))
+        assert created, "constructor never built a socket?"
+        assert all(sock.fileno() == -1 for sock in created)  # all closed
+
+    def test_close_is_idempotent_and_latches(self, agent_stack):
+        store, server = agent_stack
+        client = Client(server.socket_path)
+        client.close()
+        client.close()
+        # A closed client must not silently resurrect its connection.
+        with pytest.raises(RuntimeError, match="closed"):
+            client.invoke("get_topology")
+
+
+# ---------------------------------------------------------------------------
+# CSI RemoteBackend: None-code regression, redial-on-UNAVAILABLE, breaker
+
+
+def _backend(address="tcp://127.0.0.1:1", **kw) -> RemoteBackend:
+    kw.setdefault("retry", _fast_retry())
+    kw.setdefault(
+        "breaker",
+        resilience.CircuitBreaker(
+            "unit-backend", failure_threshold=1000, reset_timeout_s=0.1
+        ),
+    )
+    return RemoteBackend(address, "c0", **kw)
+
+
+class TestRemoteBackendResilience:
+    def test_none_code_rpc_error_becomes_unknown(self):
+        """Regression: a locally raised RpcError with ``code() is None``
+        used to crash VolumeError formatting; it must classify as UNKNOWN
+        (and not be retried)."""
+        backend = _backend()
+        try:
+            attempts = []
+
+            def fn(_channel, _attempt):
+                attempts.append(1)
+                raise InjectedRpcError(None, "torn down locally")
+
+            with pytest.raises(VolumeError) as err:
+                backend._call(fn, op="NoneCode")
+            assert err.value.code == grpc.StatusCode.UNKNOWN
+            assert "torn down locally" in err.value.message
+            assert len(attempts) == 1
+        finally:
+            backend.close()
+
+    def test_unavailable_invalidates_cached_channel_and_redials(self):
+        backend = _backend()
+        try:
+            seen = []
+
+            def fn(channel, _attempt):
+                seen.append(channel)
+                if len(seen) == 1:
+                    raise InjectedRpcError(
+                        grpc.StatusCode.UNAVAILABLE, "registry gone"
+                    )
+                return "ok"
+
+            assert backend._call(fn, op="Redial") == "ok"
+            assert len(seen) == 2
+            # The retry re-dialed: a different channel object, and the
+            # cache recorded the churn of the invalidated entry.
+            assert seen[0] is not seen[1]
+            assert backend._channels.churn == 1
+        finally:
+            backend.close()
+
+    def test_breaker_open_maps_to_unavailable_volume_error(self):
+        breaker = resilience.CircuitBreaker(
+            "dead-registry", failure_threshold=1, reset_timeout_s=60.0
+        )
+        backend = _backend(breaker=breaker, retry=_fast_retry(max_attempts=1))
+        try:
+            def fn(_channel, _attempt):
+                raise InjectedRpcError(grpc.StatusCode.UNAVAILABLE)
+
+            with pytest.raises(VolumeError):
+                backend._call(fn, op="Dead")
+            with pytest.raises(VolumeError) as err:
+                backend._call(fn, op="Dead")
+            assert err.value.code == grpc.StatusCode.UNAVAILABLE
+            assert "circuit breaker" in err.value.message
+        finally:
+            backend.close()
+
+
+# ---------------------------------------------------------------------------
+# Controller MapVolume idempotency (volume_id-keyed)
+
+
+@pytest.fixture
+def idem_stack(tmp_path):
+    store = ChipStore(mesh=(2, 2, 1), device_dir=str(tmp_path / "dev"))
+    server = FakeAgentServer(store, str(tmp_path / "agent.sock")).start()
+    controller = Controller("h0", server.socket_path)
+    yield store, server, controller
+    controller.close()
+    server.stop()
+
+
+def _map_request(volume_id: str, chips: int = 0) -> oim_pb2.MapVolumeRequest:
+    request = oim_pb2.MapVolumeRequest(volume_id=volume_id)
+    if chips > 0:
+        request.slice.chip_count = chips
+    else:
+        request.provisioned.SetInParent()
+    return request
+
+
+class TestMapIdempotency:
+    def test_retry_after_success_returns_original_placement(self, idem_stack):
+        """The ambiguous window: MapVolume executed, reply lost, retry
+        lands later.  The controller answers from the idempotency cache —
+        same placement, no second allocation, not even an agent
+        round-trip (the device plane may itself be mid-recovery)."""
+        store, server, controller = idem_stack
+        ctx = FakeServicerContext()
+        first = controller.MapVolume(_map_request("vol-idem", 4), ctx)
+        assert len(first.chips) == 4  # the whole mesh: a re-alloc ENOSPCs
+        server.stop()  # cache hits must not need the agent
+        again = controller.MapVolume(_map_request("vol-idem", 4), ctx)
+        assert again is first or again == first
+        assert [c.chip_id for c in again.chips] == [
+            c.chip_id for c in first.chips
+        ]
+        assert len(store.allocations) == 1
+
+    def test_unmap_invalidates_the_cache(self, idem_stack):
+        store, server, controller = idem_stack
+        ctx = FakeServicerContext()
+        controller.MapVolume(_map_request("vol-u", 2), ctx)
+        controller.UnmapVolume(
+            oim_pb2.UnmapVolumeRequest(volume_id="vol-u"), ctx
+        )
+        assert store.allocations == {}
+        # A fresh map re-derives from the device plane (it must not
+        # resurrect the cached placement of the unmapped volume).
+        reply = controller.MapVolume(_map_request("vol-u", 2), ctx)
+        assert len(reply.chips) == 2
+        assert store.allocations["vol-u"].attached
+
+    def test_agent_wipe_invalidates_cache(self, idem_stack, tmp_path):
+        """A restarted agent comes back EMPTY: the cache must not serve
+        the dead placement once the device plane is reachable again —
+        the Map re-creates on the live store instead."""
+        store, server, controller = idem_stack
+        ctx = FakeServicerContext()
+        controller.MapVolume(_map_request("vol-w", 2), ctx)
+        server.stop()
+        fresh = ChipStore(mesh=(2, 2, 1), device_dir=str(tmp_path / "dev2"))
+        revived = FakeAgentServer(fresh, server.socket_path).start()
+        try:
+            reply = controller.MapVolume(_map_request("vol-w", 2), ctx)
+            assert len(reply.chips) == 2
+            assert fresh.allocations["vol-w"].attached  # re-derived truth
+        finally:
+            revived.stop()
+
+    def test_incompatible_retry_still_rejected(self, idem_stack):
+        store, server, controller = idem_stack
+        ctx = FakeServicerContext()
+        controller.MapVolume(_map_request("vol-i", 2), ctx)
+        with pytest.raises(FakeAbort) as err:
+            controller.MapVolume(_map_request("vol-i", 3), ctx)
+        assert err.value.code == grpc.StatusCode.ALREADY_EXISTS
+        # provisioned-mode map of an on-demand volume stays NOT_FOUND.
+        with pytest.raises(FakeAbort) as err:
+            controller.MapVolume(_map_request("vol-i"), ctx)
+        assert err.value.code == grpc.StatusCode.NOT_FOUND
+
+
+# ---------------------------------------------------------------------------
+# Full-stack: breaker against a dead device plane, chaos soaks
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    """fake agent → controller → registry proxy → CSI remote backend,
+    insecure, with fast retry policies."""
+    store = ChipStore(mesh=(2, 2, 1), device_dir=str(tmp_path / "dev"))
+    agent_srv = FakeAgentServer(store, str(tmp_path / "agent.sock")).start()
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    controller = Controller(
+        "h0",
+        agent_srv.socket_path,
+        registry_address=str(reg_srv.addr()),
+        registry_delay=0.2,
+    )
+    ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
+    controller.start(str(ctrl_srv.addr()))
+    assert wait_for(lambda: registry.db.lookup("h0/address") != "")
+    yield store, agent_srv, registry, reg_srv, controller
+    controller.close()
+    ctrl_srv.stop()
+    reg_srv.stop()
+    registry.close()
+    agent_srv.stop()
+
+
+def test_breaker_stops_hammering_dead_agent_and_recovers(fleet, chaos_env):
+    """ISSUE acceptance: consecutive failures open the breaker (bounded
+    attempts, observable via oim_breaker_transitions_total); once the
+    fake agent heals, the half-open probe closes it again.  chaos_env
+    keeps the failing ladders well inside the breaker cooldown."""
+    store, agent_srv, registry, reg_srv, controller = fleet
+    target = "acceptance-breaker"
+    breaker = resilience.CircuitBreaker(
+        target, failure_threshold=2, reset_timeout_s=1.0
+    )
+    backend = RemoteBackend(
+        str(reg_srv.addr()),
+        "h0",
+        retry=_fast_retry(max_attempts=2),
+        breaker=breaker,
+    )
+    try:
+        assert backend.capacity() == 4
+        agent_srv.stop()  # device plane dies; the proxy hop stays up
+        for _ in range(2):
+            with pytest.raises(VolumeError):
+                backend.capacity()
+        assert breaker.state == resilience.OPEN
+        assert metrics.BREAKER_TRANSITIONS.value(target, resilience.OPEN) == 1
+
+        # Open = fail fast: no attempts reach the wire.
+        attempts = metrics.RPC_ATTEMPTS
+        before = attempts.value("oim-csi-driver", "GetTopology", "retryable")
+        for _ in range(5):
+            with pytest.raises(VolumeError) as err:
+                backend.capacity()
+            assert err.value.code == grpc.StatusCode.UNAVAILABLE
+        assert (
+            attempts.value("oim-csi-driver", "GetTopology", "retryable")
+            == before
+        )
+
+        # Heal the device plane; after the cooldown the half-open probe
+        # closes the breaker and traffic flows again.
+        revived = FakeAgentServer(store, agent_srv.socket_path).start()
+        try:
+            time.sleep(1.05)
+            assert backend.capacity() == 4
+            assert breaker.state == resilience.CLOSED
+            assert (
+                metrics.BREAKER_TRANSITIONS.value(
+                    target, resilience.HALF_OPEN
+                )
+                == 1
+            )
+        finally:
+            revived.stop()
+    finally:
+        backend.close()
+
+
+def _soak(backend, store, cycles: int, chips: int = 2) -> None:
+    total = len(store.chips)
+    for i in range(cycles):
+        vol = f"soak-{i}"
+        staged = backend.create_device(vol, {"chipCount": str(chips)}, None)
+        # Zero double-allocations: the placement is exactly one
+        # allocation of exactly the requested chips.
+        assert len(staged.chips) == chips
+        alloc = store.allocations.get(vol)
+        assert alloc is not None and len(alloc.chip_ids) == chips
+        assert len(store.allocations) == 1
+        backend.destroy_device(vol)
+        # Zero placement leaks: every chip is free again.
+        free = sum(1 for c in store.chips.values() if not c.allocation)
+        assert free == total, f"cycle {i} leaked {total - free} chips"
+        assert store.allocations == {}
+
+
+@pytest.fixture
+def chaos_env(monkeypatch):
+    """Fast env-derived retry ladders for every layer the soak crosses
+    (controller's agent client, heartbeats) — soak time stays bounded."""
+    monkeypatch.setenv("OIM_RETRY_MAX_ATTEMPTS", "5")
+    monkeypatch.setenv("OIM_RETRY_INITIAL_BACKOFF_S", "0.004")
+    monkeypatch.setenv("OIM_RETRY_MAX_BACKOFF_S", "0.02")
+
+
+def test_chaos_soak_short(fleet, chaos_env):
+    """Tier-1-sized soak: 40 map/unmap cycles at 20% injected
+    executed-but-reply-lost failure, zero leaks, zero double-allocs."""
+    store, agent_srv, registry, reg_srv, controller = fleet
+    backend = RemoteBackend(
+        str(reg_srv.addr()), "h0", retry=_fast_retry(max_attempts=5)
+    )
+    try:
+        with FlakyAgent(
+            agent_srv.socket_path, "chaos_disconnect", rate=0.2, seed=1729
+        ):
+            _soak(backend, store, cycles=40)
+    finally:
+        backend.close()
+
+
+@pytest.mark.slow
+def test_chaos_soak_200_cycles(fleet, chaos_env):
+    """ISSUE acceptance: 200 cycles at 20% injected transport failure —
+    mixed drop (never executed) and disconnect (executed, reply lost)
+    rounds — complete with zero chip-placement leaks and zero
+    double-allocations."""
+    store, agent_srv, registry, reg_srv, controller = fleet
+    backend = RemoteBackend(
+        str(reg_srv.addr()), "h0", retry=_fast_retry(max_attempts=5)
+    )
+    try:
+        with FlakyAgent(
+            agent_srv.socket_path, "chaos_disconnect", rate=0.2, seed=99
+        ):
+            _soak(backend, store, cycles=100)
+        with FlakyAgent(
+            agent_srv.socket_path, "chaos_drop", rate=0.2, seed=100
+        ):
+            _soak(backend, store, cycles=100)
+    finally:
+        backend.close()
+
+
+def test_chaos_soak_fails_without_retries(fleet, monkeypatch):
+    """The control: the same soak with resilience disabled everywhere
+    (max_attempts=1) demonstrably fails — the soak passes because of
+    retries, not luck."""
+    store, agent_srv, registry, reg_srv, controller = fleet
+    monkeypatch.setenv("OIM_RETRY_MAX_ATTEMPTS", "1")
+    # The controller's lazy agent client must also be one-shot: drop the
+    # existing connection so the next dial picks up the env.
+    controller._drop_agent()
+    backend = RemoteBackend(
+        str(reg_srv.addr()),
+        "h0",
+        retry=resilience.RetryPolicy.one_shot(),
+        breaker=resilience.CircuitBreaker(
+            "no-retry-control", failure_threshold=10_000
+        ),
+    )
+    try:
+        with FlakyAgent(
+            agent_srv.socket_path, "chaos_disconnect", rate=0.2, seed=1729
+        ):
+            with pytest.raises((VolumeError, AssertionError)):
+                _soak(backend, store, cycles=40)
+    finally:
+        backend.close()
+        # Clean up whatever the failed soak left behind.
+        for name in list(store.allocations):
+            alloc = store.allocations[name]
+            alloc.attached = False
+            store.delete_allocation(name)
+
+
+# ---------------------------------------------------------------------------
+# FlakyChannel (unit-level chaos): drop-after-execute exercises the
+# idempotent server contract without a fake agent
+
+
+def test_flaky_channel_disconnect_executes_then_loses_reply(fleet):
+    store, agent_srv, registry, reg_srv, controller = fleet
+    from oim_tpu.common.regdial import registry_channel
+    from oim_tpu.spec import REGISTRY
+
+    with registry_channel(str(reg_srv.addr())) as inner:
+        flaky = FlakyChannel(inner, mode="disconnect", rate=1.0)
+        stub = REGISTRY.stub(flaky)
+        with pytest.raises(grpc.RpcError) as err:
+            stub.SetValue(
+                oim_pb2.SetValueRequest(
+                    value=oim_pb2.Value(path="chaos/key", value="v1")
+                ),
+                timeout=5,
+            )
+        assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+        # The write happened server-side: the reply was what got eaten.
+        assert registry.db.lookup("chaos/key") == "v1"
+        assert flaky.injected == 1
+
+
+def test_flaky_channel_fail_next_is_deterministic(fleet):
+    store, agent_srv, registry, reg_srv, controller = fleet
+    from oim_tpu.common.regdial import registry_channel
+    from oim_tpu.spec import REGISTRY
+
+    with registry_channel(str(reg_srv.addr())) as inner:
+        flaky = FlakyChannel(inner, mode="error", rate=0.0)
+        stub = REGISTRY.stub(flaky)
+        request = oim_pb2.GetValuesRequest(path="h0/address")
+        assert stub.GetValues(request, timeout=5).values  # dice say pass
+        flaky.fail_next(2)
+        for _ in range(2):
+            with pytest.raises(grpc.RpcError):
+                stub.GetValues(request, timeout=5)
+        assert stub.GetValues(request, timeout=5).values
