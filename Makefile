@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability lint-metrics agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -44,6 +44,18 @@ test-health:
 test-resilience:
 	timeout -k 10 60 $(PYTHON) -m pytest tests/test_resilience.py -q \
 	  -m "chaos and not slow" -p no:cacheprovider
+
+# Observability: flight-recorder events, tracing, metrics exposition —
+# hard-capped at 60s (tier-1-safe; the suites contain no slow soaks).
+test-observability:
+	timeout -k 10 60 $(PYTHON) -m pytest tests/test_events.py \
+	  tests/test_tracing.py tests/test_metrics.py -q -m "not slow" \
+	  -p no:cacheprovider
+
+# Metrics hygiene gate: every registered series oim_-prefixed with
+# non-empty HELP (AST source scan + runtime registry check, stdlib-only).
+lint-metrics:
+	$(PYTHON) tools/check_metrics.py
 
 # Tier 3: the full stack driving a first op on the real accelerator
 # (≙ reference env-gated real-SPDK tests, test/test.make:1-16).

@@ -23,7 +23,7 @@ import threading
 from typing import Any
 
 from oim_tpu import log
-from oim_tpu.common import resilience, tracing
+from oim_tpu.common import events, resilience, tracing
 
 
 class AgentError(Exception):
@@ -105,12 +105,28 @@ class Client:
             with self._lock:
                 return self._roundtrip(method, params, attempt.timeout)
 
+        def on_retry(exc: BaseException, attempt: int) -> None:
+            # Flight-recorder breadcrumb: every re-dial of the device
+            # plane is a state transition worth a timeline row (a daemon
+            # restart shows up as a burst of these, trace-linked to the
+            # RPC that rode through it).
+            events.emit(
+                "agent.reconnect",
+                component="agent-client",
+                severity=events.WARNING,
+                subject=self.path,
+                method=method,
+                attempt=attempt,
+                error=str(exc),
+            )
+
         response = resilience.call_with_retry(
             one_attempt,
             self.retry,
             component="agent-client",
             op=method,
             classify=resilience.retryable_dial,
+            on_retry=on_retry,
         )
         if "error" in response:
             err = response["error"]

@@ -21,6 +21,7 @@ Design points, TPU-first:
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Callable
 
@@ -28,12 +29,37 @@ import jax
 import orbax.checkpoint as ocp
 
 from oim_tpu import log
+from oim_tpu.common import metrics
 from oim_tpu.models.train import (
     TrainState,
     params_shardings,
     shard_state,
     state_shardings,
 )
+
+# Checkpoint observability (the manager touched metrics nowhere): save
+# latency here is the *enqueue + device snapshot* for async saves — the
+# part that blocks the train loop — not the filesystem write.
+_CKPT_SECONDS = metrics.registry().histogram(
+    "oim_checkpoint_seconds",
+    "Checkpoint operation latency by op (save = async enqueue + device "
+    "snapshot, i.e. the train-loop stall; restore = full read).",
+    ("op",),
+)
+_CKPT_BYTES = metrics.registry().counter(
+    "oim_checkpoint_bytes_total",
+    "Array bytes moved through the checkpoint manager, by op.",
+    ("op",),
+)
+
+
+def _tree_bytes(tree) -> float:
+    try:
+        return float(
+            sum(getattr(leaf, "nbytes", 0) for leaf in jax.tree.leaves(tree))
+        )
+    except Exception:
+        return 0.0  # observability must never break a save/restore
 
 
 @dataclass(frozen=True)
@@ -106,10 +132,13 @@ class Checkpointer:
             # Always present so restore can unconditionally ask for it.
             self.DATA: ocp.args.JsonSave(data_state or {}),
         }
+        t0 = time.perf_counter()
         saved = self._mgr.save(
             step, args=ocp.args.Composite(**items), force=force
         )
         if saved:
+            _CKPT_SECONDS.observe(time.perf_counter() - t0, "save")
+            _CKPT_BYTES.inc("save", by=_tree_bytes(state))
             log.current().debug("checkpoint queued", step=step)
         return saved
 
@@ -144,6 +173,7 @@ class Checkpointer:
             step = self._mgr.latest_step()
             if step is None:
                 raise FileNotFoundError("no checkpoint to restore")
+        t0 = time.perf_counter()
         restored = self._mgr.restore(
             step,
             args=ocp.args.Composite(
@@ -155,6 +185,8 @@ class Checkpointer:
                 }
             ),
         )
+        _CKPT_SECONDS.observe(time.perf_counter() - t0, "restore")
+        _CKPT_BYTES.inc("restore", by=_tree_bytes(restored[self.STATE]))
         data = restored.get(self.DATA)
         log.current().info("checkpoint restored", step=step)
         return restored[self.STATE], data

@@ -5,7 +5,7 @@ from __future__ import annotations
 import argparse
 
 from oim_tpu import log
-from oim_tpu.common import metrics, tracing
+from oim_tpu.common import events, metrics, tracing
 from oim_tpu.common.tlsconfig import load_tls
 from oim_tpu.controller import Controller
 
@@ -64,6 +64,8 @@ def main(argv=None) -> int:
 
     log.init_from_string(args.log_level)
     tracing.init("oim-controller", args.trace_file or None)
+    events.init("oim-controller")
+    events.install_crash_hook()
     metrics_server = None
     if args.metrics_endpoint:
         metrics_server = metrics.MetricsServer(args.metrics_endpoint).start()

@@ -8,7 +8,7 @@ from __future__ import annotations
 import argparse
 
 from oim_tpu import log
-from oim_tpu.common import metrics, tracing
+from oim_tpu.common import events, metrics, tracing
 from oim_tpu.common.tlsconfig import load_tls
 from oim_tpu.csi import OIMDriver
 from oim_tpu.csi.mounter import BindMounter, Mounter
@@ -60,6 +60,9 @@ def main(argv=None) -> int:
 
     log.init_from_string(args.log_level)
     tracing.init("oim-csi-driver", args.trace_file or None)
+    events.init("oim-csi-driver")
+    events.install_crash_hook()
+    event_publisher = None
     metrics_server = None
     if args.metrics_endpoint:
         metrics_server = metrics.MetricsServer(args.metrics_endpoint).start()
@@ -85,6 +88,13 @@ def main(argv=None) -> int:
             ("1.0", "0.3") if args.csi_version == "both" else (args.csi_version,)
         ),
     )
+    if args.registry and args.controller_id:
+        # Durable WARNING+ publication under the node identity (TLS CN
+        # host.<controller-id> — the registry's events/ authz subtree);
+        # tls_loader passes through so rotation applies per publish dial.
+        event_publisher = events.RegistryEventPublisher(
+            f"host.{args.controller_id}", args.registry, tls=tls_loader
+        ).start()
     server = driver.start_server()
     log.current().info("oim-csi-driver running", endpoint=str(server.addr()))
     try:
@@ -92,6 +102,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         server.stop()
     finally:
+        if event_publisher is not None:
+            event_publisher.close()
         driver.close()
         if metrics_server is not None:
             metrics_server.stop()

@@ -11,6 +11,9 @@
     oimctl uncordon ID            lift a cordon
     oimctl remap VOLUME --controller ID --chips N  clear eviction + map
     oimctl trace FILE [FILE...]   merge daemons' span files, print trees
+    oimctl events [--volume X] [--component C] [--follow]
+                                  flight-recorder timeline (registry
+                                  events/ keys, /debugz URLs, dump files)
 """
 
 from __future__ import annotations
@@ -179,6 +182,33 @@ def main(argv=None) -> int:
     trace.add_argument(
         "--trace-id", default="", help="only this trace (prefix match)"
     )
+    evt = sub.add_parser(
+        "events",
+        help="render the flight-recorder event timeline: durable WARNING+ "
+        "events from the registry (default), a daemon's live ring "
+        "(--debugz URL), or crash-dump files (--file)",
+    )
+    evt.add_argument(
+        "--volume", default="", help="only events about this volume/subject"
+    )
+    evt.add_argument(
+        "--component", default="", help="only events from this component"
+    )
+    evt.add_argument("--kind", default="", help="event-kind prefix filter")
+    evt.add_argument(
+        "--follow", action="store_true",
+        help="stream live events from the registry (snapshot, then one "
+        "line per new event) until interrupted",
+    )
+    evt.add_argument(
+        "--debugz", action="append", default=[], metavar="URL",
+        help="read a daemon's live ring from its metrics endpoint "
+        "(http://host:port[/debugz]); repeatable",
+    )
+    evt.add_argument(
+        "--file", action="append", default=[], metavar="PATH",
+        help="read a flight-recorder dump file; repeatable",
+    )
 
     args = parser.parse_args(argv)
     log.init_from_string(args.log_level)
@@ -289,6 +319,34 @@ def main(argv=None) -> int:
         if args.trace_id:
             spans = [s for s in spans if s.trace_id.startswith(args.trace_id)]
         print(tracing.render_traces(spans))
+        return 0
+    if args.command == "events" and (args.file or args.debugz):
+        # Offline/sideband sources need no registry connection.
+        if args.follow:
+            print("error: --follow streams from the registry and excludes "
+                  "--file/--debugz")
+            return 2
+        from oim_tpu.common import events as events_mod
+
+        evts = []
+        try:
+            for path in args.file:
+                evts.extend(events_mod.load_dump(path))
+            for url in args.debugz:
+                import urllib.request
+
+                full = url.rstrip("/")
+                if not full.endswith("/debugz"):
+                    full += "/debugz"
+                with urllib.request.urlopen(full, timeout=10) as resp:
+                    evts.extend(events_mod.events_from_doc(json.load(resp)))
+        except (OSError, ValueError) as exc:
+            print(f"error: {exc}")
+            return 1
+        print(events_mod.render_timeline(
+            evts, volume=args.volume, component=args.component,
+            kind=args.kind,
+        ))
         return 0
     channel = _channel(args)
     # Operator CLI resilience: UNAVAILABLE/DEADLINE_EXCEEDED retried with
@@ -475,6 +533,57 @@ def main(argv=None) -> int:
                     timeout=30,
                 ))
             print(f"remapped {args.volume} onto {args.controller}")
+        elif args.command == "events":
+            # Registry-backed: the durable WARNING+ copies every daemon's
+            # publisher mirrored under leased events/<source>/<seq> keys.
+            from oim_tpu.common import events as events_mod
+
+            def decode(value):
+                if events_mod.parse_event_path(value.path) is None:
+                    return None
+                if not value.value:
+                    return None  # deleted/TTL-expired
+                try:
+                    return events_mod.Event.from_json(json.loads(value.value))
+                except (ValueError, TypeError):
+                    return None  # foreign/torn value: skip, never crash
+
+            def wanted(event):
+                return event is not None and events_mod.filter_events(
+                    [event], volume=args.volume,
+                    component=args.component, kind=args.kind,
+                )
+
+            if args.follow:
+                call = REGISTRY.stub(channel).WatchValues(
+                    oim_pb2.WatchValuesRequest(
+                        path=events_mod.EVENTS_PREFIX, send_initial=True
+                    )
+                )
+                try:
+                    for reply in call:
+                        if reply.initial_done:
+                            print("-- initial snapshot complete --", flush=True)
+                            continue
+                        event = decode(reply.value)
+                        if wanted(event):
+                            print(events_mod.render_event(event), flush=True)
+                except KeyboardInterrupt:
+                    call.cancel()
+                except grpc.RpcError as exc:
+                    if resilience.status_of(exc) != grpc.StatusCode.CANCELLED:
+                        print(f"error: {resilience.error_text(exc)}")
+                        return 1
+            else:
+                reply = rpc(lambda: REGISTRY.stub(channel).GetValues(
+                    oim_pb2.GetValuesRequest(path=events_mod.EVENTS_PREFIX),
+                    timeout=30,
+                ))
+                evts = [e for e in map(decode, reply.values) if e is not None]
+                print(events_mod.render_timeline(
+                    evts, volume=args.volume, component=args.component,
+                    kind=args.kind,
+                ))
         elif args.command == "topology":
             reply = rpc(lambda: CONTROLLER.stub(channel).GetTopology(
                 oim_pb2.GetTopologyRequest(),

@@ -87,6 +87,10 @@ def main(argv=None) -> int:
 
     log.init_from_string(args.log_level)
     tracing.init("oim-registry", args.trace_file or None)
+    from oim_tpu.common import events
+
+    events.init("oim-registry")
+    events.install_crash_hook()
     metrics_server = None
     if args.metrics_endpoint:
         metrics_server = metrics.MetricsServer(args.metrics_endpoint).start()
@@ -124,6 +128,12 @@ def main(argv=None) -> int:
             degraded_grace=args.degraded_grace,
             remap_backoff=args.remap_backoff,
         )
+    # Durable flight-recorder publication for the registry process
+    # itself (fleet-monitor evictions, breaker transitions, crashes of
+    # its own threads): stores straight into the local db — no RPC.
+    event_publisher = events.RegistryEventPublisher(
+        "component.registry", db=db
+    ).start()
     server = registry.start_server(args.endpoint)
     log.current().info("oim-registry running", endpoint=str(server.addr()))
     try:
@@ -133,6 +143,7 @@ def main(argv=None) -> int:
         if etcd_server is not None:
             etcd_server.stop()
     finally:
+        event_publisher.close()
         if monitor is not None:
             monitor.close()
         registry.close()

@@ -375,9 +375,11 @@ def main(argv=None) -> int:
             tls=load_tls(args.ca, args.cert, args.key) if args.ca else None,
             delay=args.registry_delay,
         )
-    from oim_tpu.common import tracing
+    from oim_tpu.common import events, tracing
 
     tracing.init("oim-serve", args.trace_file or None)
+    events.init("oim-serve")
+    events.install_crash_hook()
 
     bootstrap_path = args.bootstrap or os.environ.get("TPU_BOOTSTRAP", "")
     if bootstrap_path:
@@ -415,12 +417,20 @@ def main(argv=None) -> int:
         "oim-serve listening", host=server.host, port=server.port,
         n_slots=args.n_slots, max_len=args.max_len, mtls=server.tls,
     )
+    event_publisher = None
     if registration is not None:
         scheme = "https" if ssl_context is not None else "http"
         registration.advertised_address = (
             args.advertise or f"{scheme}://{server.host}:{server.port}"
         )
         registration.start()
+        # Durable WARNING+ publication under the serving identity (TLS
+        # CN serve.<id> — the registry's events/ authz subtree).
+        event_publisher = events.RegistryEventPublisher(
+            f"serve.{args.serve_id}",
+            args.registry_address,
+            tls=registration.tls,
+        ).start()
     import signal
     import threading
     import time as _time
@@ -460,6 +470,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if event_publisher is not None:
+            event_publisher.close()
         if registration is not None:
             registration.stop()
         server.stop()

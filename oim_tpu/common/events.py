@@ -1,0 +1,691 @@
+"""Flight recorder: structured, trace-correlated event journal.
+
+The reference had no event record at all — state transitions lived in
+unstructured logs and vanished when they scrolled (its README "traces"
+are correlated log lines, reference README.md:455-495).  This module is
+the third observability pillar next to the working traces
+(oim_tpu.common.tracing) and metrics (oim_tpu.common.metrics): a durable,
+queryable answer to the incident question *"what happened to volume X
+between map and stage, and when?"*
+
+Design:
+
+- **Typed events.**  Every event carries ``component`` (emitting daemon),
+  ``kind`` (dotted vocabulary, e.g. ``volume.map`` / ``breaker.transition``),
+  ``severity``, ``subject`` (the volume/chip/controller it is about), the
+  ``trace_id`` captured from the active span (so events join the trace
+  tree for free), a per-recorder monotonic ``seq``, wall-clock ``ts`` and
+  free-form key/value ``fields``.
+- **Flight recorder.**  One bounded in-memory ring per component
+  (drop-oldest, counted by ``oim_events_dropped_total``): every process
+  is introspectable with zero configuration via ``/debugz`` on its
+  MetricsServer, and a crash hook dumps all rings to a JSON file on a
+  fatal error — the black box survives the incident that needed it.
+- **Durable WARNING+ publication.**  A ``RegistryEventPublisher`` mirrors
+  WARNING/ERROR events into the registry under leased
+  ``events/<source>/<seq>`` keys (TTL-GC'd by the lease sweeper;
+  authz-scoped like ``health/`` — a component may only write its own
+  subtree), so ``oimctl events`` sees the fleet's recent anomalies
+  without dialing every daemon.
+- **Volume-lifecycle SLOs.**  ``phase()``/``begin_e2e()``/``end_e2e()``
+  feed ``oim_volume_lifecycle_seconds{phase=map|stage|publish|e2e}`` and
+  emit the per-phase events ``oimctl events --volume X`` renders as an
+  ordered, trace-linked timeline with durations.
+
+Emission is cheap (one lock, one deque append, a counter bump) so the
+control plane can narrate itself unconditionally; sinks run outside the
+ring lock and a failing sink costs one log line, never the caller.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+from oim_tpu import log
+from oim_tpu.common import metrics, tracing
+
+# ---------------------------------------------------------------------------
+# Vocabulary
+
+DEBUG = "DEBUG"
+INFO = "INFO"
+WARNING = "WARNING"
+ERROR = "ERROR"
+SEVERITIES = (DEBUG, INFO, WARNING, ERROR)
+_SEVERITY_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+EVENTS_PREFIX = "events"
+
+DEFAULT_CAPACITY = 512
+
+
+def severity_at_least(severity: str, floor: str) -> bool:
+    return _SEVERITY_RANK.get(severity, 0) >= _SEVERITY_RANK.get(floor, 0)
+
+
+def event_key(source: str, seq: int | str) -> str:
+    """Registry key for a durably published event — the ``health/``-shaped
+    keyspace: ``events/<source>/<seq>``, where ``source`` is the writer's
+    TLS CommonName (``controller.<id>``, ``serve.<id>``, ...) so the
+    registry can authz-scope each component to its own subtree."""
+    return f"{EVENTS_PREFIX}/{source}/{seq}"
+
+
+def parse_event_path(path: str) -> tuple[str, str] | None:
+    parts = path.split("/")
+    if len(parts) == 3 and parts[0] == EVENTS_PREFIX:
+        return parts[1], parts[2]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Instruments (process registry — every daemon exports identical series)
+
+EVENTS_TOTAL = metrics.registry().counter(
+    "oim_events_total",
+    "Flight-recorder events emitted, by component, kind and severity.",
+    ("component", "kind", "severity"),
+)
+EVENTS_DROPPED = metrics.registry().counter(
+    "oim_events_dropped_total",
+    "Events evicted from a full flight-recorder ring (drop-oldest).",
+    ("component",),
+)
+EVENTS_PUBLISHED = metrics.registry().counter(
+    "oim_events_published_total",
+    "Durable WARNING+ event publications to the registry, by outcome "
+    "(ok / error / dropped — dropped means the publish queue overflowed).",
+    ("source", "outcome"),
+)
+LIFECYCLE = metrics.registry().histogram(
+    "oim_volume_lifecycle_seconds",
+    "Volume lifecycle phase latency: map (the MapVolume hop inside "
+    "NodeStage), stage (whole NodeStageVolume), publish "
+    "(NodePublishVolume), e2e (stage begin through publish done).",
+    ("phase",),
+)
+
+
+# ---------------------------------------------------------------------------
+# Event model
+
+
+@dataclass(frozen=True)
+class Event:
+    component: str
+    kind: str
+    severity: str
+    subject: str
+    trace_id: str
+    seq: int
+    ts: float  # wall clock (UNIX seconds)
+    fields: dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "component": self.component,
+            "kind": self.kind,
+            "severity": self.severity,
+            "subject": self.subject,
+            "trace_id": self.trace_id,
+            "seq": self.seq,
+            "ts": self.ts,
+            "fields": self.fields,
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict[str, Any]) -> "Event":
+        if not isinstance(obj, dict):
+            # Callers catch (TypeError, ValueError): a foreign file whose
+            # entries are not objects must yield a skip, never a crash.
+            raise TypeError(f"event must be a JSON object, got {type(obj)}")
+        return cls(
+            component=str(obj.get("component", "?")),
+            kind=str(obj.get("kind", "?")),
+            severity=str(obj.get("severity", INFO)),
+            subject=str(obj.get("subject", "")),
+            trace_id=str(obj.get("trace_id", "")),
+            seq=int(obj.get("seq", 0)),
+            ts=float(obj.get("ts", 0.0)),
+            fields=obj.get("fields", {}) if isinstance(obj.get("fields"), dict) else {},
+        )
+
+
+# ---------------------------------------------------------------------------
+# Recorders
+
+_sinks_lock = threading.Lock()
+_sinks: list[Callable[[Event], None]] = []
+
+
+class FlightRecorder:
+    """Bounded per-component event ring (the "flight recorder")."""
+
+    def __init__(self, component: str, capacity: int = DEFAULT_CAPACITY):
+        self.component = component
+        self._lock = threading.Lock()
+        self._ring: deque[Event] = deque(maxlen=capacity)
+        self._seq = 0
+
+    def emit(
+        self,
+        kind: str,
+        severity: str = INFO,
+        subject: str = "",
+        **fields: Any,
+    ) -> Event:
+        ctx = tracing.current_context()
+        with self._lock:
+            self._seq += 1
+            event = Event(
+                component=self.component,
+                kind=kind,
+                severity=severity,
+                subject=subject,
+                trace_id=ctx.trace_id if ctx is not None else "",
+                seq=self._seq,
+                ts=time.time(),
+                fields=fields,
+            )
+            dropped = len(self._ring) == self._ring.maxlen
+            self._ring.append(event)
+        EVENTS_TOTAL.inc(self.component, kind, severity)
+        if dropped:
+            EVENTS_DROPPED.inc(self.component)
+        with _sinks_lock:
+            sinks = list(_sinks)
+        for sink in sinks:  # outside the ring lock: sinks may do IO
+            try:
+                sink(event)
+            except Exception as exc:
+                log.current().error(
+                    "event sink failed", kind=kind, error=str(exc)
+                )
+        return event
+
+    def events(self) -> list[Event]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+_recorders_lock = threading.Lock()
+_recorders: dict[str, FlightRecorder] = {}
+_default_component = [""]
+
+
+def recorder(component: str = "") -> FlightRecorder:
+    """The process recorder for ``component`` (created on first use).
+    Empty means the process default set by ``init()``."""
+    name = component or _default_component[0]
+    with _recorders_lock:
+        rec = _recorders.get(name)
+        if rec is None:
+            rec = _recorders[name] = FlightRecorder(name)
+        return rec
+
+
+def init(component: str) -> FlightRecorder:
+    """Set the process-default component (each daemon main calls this
+    next to ``tracing.init``) and return its recorder."""
+    _default_component[0] = component
+    return recorder(component)
+
+
+def emit(
+    kind: str,
+    component: str = "",
+    severity: str = INFO,
+    subject: str = "",
+    **fields: Any,
+) -> Event:
+    """Emit on the component's recorder (default: the process default)."""
+    return recorder(component).emit(kind, severity=severity, subject=subject, **fields)
+
+
+def add_sink(fn: Callable[[Event], None]) -> None:
+    with _sinks_lock:
+        if fn not in _sinks:
+            _sinks.append(fn)
+
+
+def remove_sink(fn: Callable[[Event], None]) -> None:
+    with _sinks_lock:
+        if fn in _sinks:
+            _sinks.remove(fn)
+
+
+def all_events() -> list[Event]:
+    """Every recorder's ring, merged in wall-clock order."""
+    with _recorders_lock:
+        recs = list(_recorders.values())
+    merged: list[Event] = []
+    for rec in recs:
+        merged.extend(rec.events())
+    merged.sort(key=lambda e: (e.ts, e.component, e.seq))
+    return merged
+
+
+def clear_all() -> None:
+    """Empty every ring (test isolation; recorders stay registered)."""
+    with _recorders_lock:
+        recs = list(_recorders.values())
+    for rec in recs:
+        rec.clear()
+
+
+# ---------------------------------------------------------------------------
+# Snapshots: /debugz + crash dump share one JSON shape
+
+
+def snapshot() -> dict[str, Any]:
+    """The live flight-recorder contents as one JSON document — served by
+    ``/debugz`` on the MetricsServer and written by the crash hook, so
+    ``oimctl events`` reads both with one loader."""
+    return {
+        "generated_at": time.time(),
+        "pid": os.getpid(),
+        "events": [e.to_json() for e in all_events()],
+    }
+
+
+def dump(path: str) -> str:
+    """Write the snapshot to ``path`` atomically-ish (tmp + rename)."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(snapshot(), f, separators=(",", ":"))
+    os.replace(tmp, path)
+    return path
+
+
+def events_from_doc(doc: Any) -> list[Event]:
+    """Events from a parsed snapshot document (``dump()`` file or a
+    ``/debugz`` response body).  Tolerant of foreign/partial content —
+    an operator pointing ``oimctl events`` at the wrong file or URL gets
+    an empty timeline, not a stack trace.  THE one parser for both
+    sources, so their tolerance can never drift."""
+    entries = doc.get("events") if isinstance(doc, dict) else None
+    out: list[Event] = []
+    for obj in entries if isinstance(entries, list) else []:
+        try:
+            out.append(Event.from_json(obj))
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def load_dump(path: str) -> list[Event]:
+    """Events from a crash dump / ``/debugz`` capture file."""
+    with open(path) as f:
+        return events_from_doc(json.load(f))
+
+
+# ---------------------------------------------------------------------------
+# Crash hook
+
+_crash_lock = threading.Lock()
+_crash_state: dict[str, Any] = {"installed": False, "dir": "", "prev": None, "prev_threading": None}
+
+
+def crash_dump_path() -> str:
+    directory = (
+        _crash_state["dir"]
+        or os.environ.get("OIM_FLIGHT_DIR")
+        or "/tmp"
+    )
+    return os.path.join(
+        directory, f"oim-flight-{os.getpid()}-{int(time.time())}.json"
+    )
+
+
+def _dump_on_crash(exc_type, exc_value) -> str | None:
+    if exc_type is not None and issubclass(
+        exc_type, (KeyboardInterrupt, SystemExit)
+    ):
+        return None  # operator stop, not a crash
+    try:
+        emit(
+            "crash",
+            severity=ERROR,
+            error=f"{getattr(exc_type, '__name__', exc_type)}: {exc_value}",
+        )
+        path = crash_dump_path()
+        dump(path)
+        log.current().error("flight recorder dumped", path=path)
+        return path
+    except Exception:
+        return None  # the dump must never mask the original crash
+
+
+def install_crash_hook(directory: str = "") -> None:
+    """Dump every ring to a JSON file on an uncaught exception (main
+    thread AND worker threads), then chain to the previous hooks.
+    ``directory`` defaults to ``$OIM_FLIGHT_DIR`` or /tmp.  Idempotent."""
+    with _crash_lock:
+        if directory:
+            _crash_state["dir"] = directory
+        if _crash_state["installed"]:
+            return
+        prev_sys = sys.excepthook
+        prev_threading = threading.excepthook
+        _crash_state["prev"] = prev_sys
+        _crash_state["prev_threading"] = prev_threading
+
+        def hook(exc_type, exc_value, exc_tb):
+            _dump_on_crash(exc_type, exc_value)
+            prev_sys(exc_type, exc_value, exc_tb)
+
+        def thread_hook(args):
+            _dump_on_crash(args.exc_type, args.exc_value)
+            prev_threading(args)
+
+        sys.excepthook = hook
+        threading.excepthook = thread_hook
+        _crash_state["installed"] = True
+
+
+def uninstall_crash_hook() -> None:
+    """Restore the pre-install hooks (test hygiene)."""
+    with _crash_lock:
+        if not _crash_state["installed"]:
+            return
+        sys.excepthook = _crash_state["prev"]
+        threading.excepthook = _crash_state["prev_threading"]
+        _crash_state["installed"] = False
+
+
+# ---------------------------------------------------------------------------
+# Volume-lifecycle SLO timeline
+
+_e2e_lock = threading.Lock()
+_e2e_starts: dict[str, float] = {}  # volume → monotonic stage-begin
+_E2E_BOUND = 4096  # a leak of abandoned stages must stay bounded
+
+
+@contextlib.contextmanager
+def phase(volume: str, phase_name: str, component: str = ""):
+    """Time one lifecycle phase: observes
+    ``oim_volume_lifecycle_seconds{phase=...}`` and emits the
+    ``volume.<phase>`` event (with ``duration_ms``) the timeline
+    renderer shows.  An exception emits ``volume.<phase>.failed`` at
+    ERROR instead and re-raises."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    except BaseException as exc:
+        emit(
+            f"volume.{phase_name}.failed",
+            component=component,
+            severity=ERROR,
+            subject=volume,
+            phase=phase_name,
+            duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
+            error=str(exc),
+        )
+        raise
+    dt = time.perf_counter() - t0
+    LIFECYCLE.observe(dt, phase_name)
+    emit(
+        f"volume.{phase_name}",
+        component=component,
+        subject=volume,
+        phase=phase_name,
+        duration_ms=round(dt * 1e3, 3),
+    )
+
+
+def begin_e2e(volume: str) -> None:
+    """Mark the start of a volume's map→stage→publish flow (NodeStage
+    entry).  Re-staging restarts the clock."""
+    with _e2e_lock:
+        if len(_e2e_starts) >= _E2E_BOUND and volume not in _e2e_starts:
+            oldest = min(_e2e_starts, key=_e2e_starts.get)
+            del _e2e_starts[oldest]
+        _e2e_starts[volume] = time.perf_counter()
+
+
+def end_e2e(volume: str, component: str = "") -> None:
+    """Complete the flow (publish done): observes ``phase="e2e"`` and
+    emits ``volume.e2e``.  No-op when no stage began (idempotent
+    re-publish)."""
+    with _e2e_lock:
+        t0 = _e2e_starts.pop(volume, None)
+    if t0 is None:
+        return
+    dt = time.perf_counter() - t0
+    LIFECYCLE.observe(dt, "e2e")
+    emit(
+        "volume.e2e",
+        component=component,
+        subject=volume,
+        phase="e2e",
+        duration_ms=round(dt * 1e3, 3),
+    )
+
+
+def abandon_e2e(volume: str) -> None:
+    """Forget a flow that will never publish (unstage/teardown)."""
+    with _e2e_lock:
+        _e2e_starts.pop(volume, None)
+
+
+# ---------------------------------------------------------------------------
+# Durable publication: WARNING+ → leased registry keys
+
+
+class RegistryEventPublisher:
+    """Mirrors WARNING+ events into ``events/<source>/<seq>`` leased
+    registry keys, on its own thread so emission never blocks on the
+    registry hop.  Best-effort durability: the queue is bounded
+    (drop-oldest, counted), a failed publish drops its batch — the ring
+    stays the source of truth, the registry copy is the fleet-wide view.
+
+    ``source`` must be the publisher's TLS CommonName (e.g.
+    ``controller.<id>``): the registry's authz allows each identity to
+    write only its own ``events/<cn>/*`` subtree (the ``health/``
+    least-privilege shape).  ``tls`` may be the config or a zero-arg
+    loader (the CSI driver reloads material per dial).  The registry
+    process itself passes ``db`` instead of an address and publishes by
+    storing directly — no RPC, no self-dial, same key shape and TTL."""
+
+    def __init__(
+        self,
+        source: str,
+        registry_address: str = "",
+        tls=None,
+        min_severity: str = WARNING,
+        ttl_seconds: float = 900.0,
+        capacity: int = 256,
+        db=None,
+    ) -> None:
+        if bool(registry_address) == (db is not None):
+            raise ValueError("pass exactly one of registry_address / db")
+        self.source = source
+        self.registry_address = registry_address
+        self.tls = tls
+        self.min_severity = min_severity
+        self.ttl_seconds = ttl_seconds
+        self.db = db
+        self._cond = threading.Condition()
+        self._queue: deque[Event] = deque(maxlen=capacity)
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        # Publication counter, NOT the event's per-recorder seq (two
+        # recorders' event #5 must land under distinct keys) — seeded
+        # from the wall clock so a restarted daemon's keys continue
+        # after its previous run's instead of overwriting records still
+        # inside their TTL.
+        self._pub_seq = time.time_ns()
+
+    # -- sink side (any emitting thread) -----------------------------------
+
+    def _sink(self, event: Event) -> None:
+        if not severity_at_least(event.severity, self.min_severity):
+            return
+        with self._cond:
+            if self._stop:
+                return
+            if len(self._queue) == self._queue.maxlen:
+                EVENTS_PUBLISHED.inc(self.source, "dropped")
+            self._queue.append(event)
+            self._cond.notify()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "RegistryEventPublisher":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop = False
+        add_sink(self._sink)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"events-publish-{self.source}"
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Idempotent: detach the sink, wake and join the drain thread."""
+        remove_sink(self._sink)
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+            self._thread = None
+
+    # -- drain thread ------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait()
+                batch = list(self._queue)
+                self._queue.clear()
+                stopping = self._stop
+            if batch:
+                self._publish(batch)
+            if stopping:
+                return
+
+    def _publish(self, batch: list[Event]) -> None:
+        try:
+            if self.db is not None:
+                for event in batch:
+                    self._pub_seq += 1
+                    self.db.store(
+                        event_key(self.source, self._pub_seq),
+                        json.dumps(event.to_json(), separators=(",", ":")),
+                        ttl=max(1, int(self.ttl_seconds)),
+                    )
+                    EVENTS_PUBLISHED.inc(self.source, "ok")
+                return
+            from oim_tpu.common.regdial import registry_channel
+            from oim_tpu.spec import REGISTRY, oim_pb2
+
+            tls = self.tls() if callable(self.tls) else self.tls
+            with registry_channel(self.registry_address, tls) as channel:
+                stub = REGISTRY.stub(channel)
+                for event in batch:
+                    self._pub_seq += 1
+                    stub.SetValue(
+                        oim_pb2.SetValueRequest(
+                            value=oim_pb2.Value(
+                                path=event_key(self.source, self._pub_seq),
+                                value=json.dumps(
+                                    event.to_json(), separators=(",", ":")
+                                ),
+                            ),
+                            ttl_seconds=max(1, int(self.ttl_seconds)),
+                        ),
+                        timeout=5,
+                    )
+                    EVENTS_PUBLISHED.inc(self.source, "ok")
+        except Exception as exc:
+            # One failed hop costs this batch, never the daemon: the
+            # events are still in the ring + /debugz + crash dump.
+            EVENTS_PUBLISHED.inc(self.source, "error")
+            log.current().warning(
+                "event publish failed",
+                source=self.source,
+                batch=len(batch),
+                error=str(exc),
+            )
+
+
+# ---------------------------------------------------------------------------
+# Timeline rendering (the ``oimctl events`` backend)
+
+
+def _match(event: Event, volume: str, component: str, kind: str) -> bool:
+    if volume and event.subject != volume:
+        return False
+    if component and event.component != component:
+        return False
+    if kind and not event.kind.startswith(kind):
+        return False
+    return True
+
+
+def filter_events(
+    evts: Iterable[Event],
+    volume: str = "",
+    component: str = "",
+    kind: str = "",
+) -> list[Event]:
+    out = [e for e in evts if _match(e, volume, component, kind)]
+    out.sort(key=lambda e: (e.ts, e.component, e.seq))
+    return out
+
+
+def render_event(event: Event) -> str:
+    """One event as one line (the ``oimctl events --follow`` format)."""
+    try:
+        dur = f"{float(event.fields.get('duration_ms')):9.2f}ms"
+    except (TypeError, ValueError):
+        # A foreign/hand-written event with a junk duration must cost
+        # its duration column, not the whole timeline.
+        dur = " " * 11
+    extras = " ".join(
+        f"{k}={v}"
+        for k, v in sorted(event.fields.items())
+        if k not in ("duration_ms", "phase")
+    )
+    trace = f" trace={event.trace_id[:8]}" if event.trace_id else ""
+    return (
+        f"{dur} {event.severity:<7} {event.component:<16} "
+        f"{event.kind:<28} {event.subject:<16}{trace}"
+        + (f"  {extras}" if extras else "")
+    )
+
+
+def render_timeline(
+    evts: Iterable[Event],
+    volume: str = "",
+    component: str = "",
+    kind: str = "",
+) -> str:
+    """The merged, ordered timeline: offset from the first matching
+    event, per-phase duration when the event carries one, severity,
+    component, kind, subject, short trace id and the remaining fields —
+    the flight-recorder answer to "what happened to volume X, and
+    when"."""
+    matched = filter_events(evts, volume=volume, component=component, kind=kind)
+    if not matched:
+        return "(no matching events)"
+    t0 = matched[0].ts
+    return "\n".join(
+        f"+{event.ts - t0:9.3f}s {render_event(event)}" for event in matched
+    )

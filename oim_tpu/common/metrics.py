@@ -162,6 +162,16 @@ DEFAULT_BUCKETS = (
     5.0, 10.0, 30.0, 60.0,
 )
 
+# Sub-millisecond buckets for the data plane and per-token latencies:
+# DEFAULT_BUCKETS' 1ms floor lumps everything faster into one bucket,
+# which hides exactly the distributions that matter on a TPU host
+# (batch assembly, prefetch waits, per-token decode are all tens to
+# hundreds of microseconds when healthy).  50µs .. 10s.
+FAST_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 1.0, 2.5, 10.0,
+)
+
 
 class Histogram:
     """Cumulative-bucket histogram (the Prometheus shape)."""
@@ -398,7 +408,10 @@ def _split_host_port(address: str) -> tuple[str, str]:
 
 
 class MetricsServer:
-    """Minimal scrape endpoint: ``GET /metrics`` on a host:port."""
+    """Minimal scrape endpoint: ``GET /metrics`` on a host:port, plus
+    ``GET /debugz`` — the live flight-recorder rings as JSON
+    (oim_tpu.common.events), so any daemon's recent event history is one
+    curl away during an incident."""
 
     def __init__(
         self, address: str = "127.0.0.1:0",
@@ -409,7 +422,21 @@ class MetricsServer:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API)
-                if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                path = self.path.split("?", 1)[0]
+                if path == "/debugz":
+                    # Imported lazily: events imports this module.
+                    import json as json_mod
+
+                    from oim_tpu.common import events as events_mod
+
+                    body = json_mod.dumps(events_mod.snapshot()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path not in ("/", "/metrics"):
                     self.send_error(404)
                     return
                 write_exposition(self, reg)

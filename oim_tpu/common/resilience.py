@@ -318,12 +318,29 @@ class CircuitBreaker:
     def _transition_locked(self, state: str) -> None:
         if self._state == state:
             return
+        prev = self._state
         self._state = state
         self._generation += 1
         metrics.BREAKER_TRANSITIONS.inc(self.target, state)
         log.current().info(
             "breaker transition", target=self.target, state=state
         )
+        # resilience → events bridge: breaker transitions are exactly the
+        # state changes an incident timeline needs (opening = the moment
+        # a peer was judged dead).  Imported lazily — events sits above
+        # this module in the layering.
+        try:
+            from oim_tpu.common import events
+
+            events.emit(
+                "breaker.transition",
+                component="resilience",
+                severity=events.WARNING if state == OPEN else events.INFO,
+                subject=self.target,
+                **{"from": prev, "to": state},
+            )
+        except Exception:  # the journal must never break the breaker
+            pass
 
     def allow(self) -> int:
         """Gate one operation; raises BreakerOpenError when open (and

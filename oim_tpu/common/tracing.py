@@ -42,9 +42,19 @@ from typing import Any, Iterator
 import grpc
 
 from oim_tpu import log
+from oim_tpu.common import metrics
 from oim_tpu.common.interceptors import ObservingServerInterceptor
 
 TRACEPARENT_KEY = "traceparent"
+
+# Ring evictions: a long-lived daemon's collector is bounded (drop-oldest),
+# and silent truncation would read as "nothing happened before X" during
+# an incident — the counter makes the loss visible per component.
+SPANS_DROPPED = metrics.registry().counter(
+    "oim_trace_spans_dropped_total",
+    "Spans evicted from a full collector ring (drop-oldest).",
+    ("component",),
+)
 
 # ---------------------------------------------------------------------------
 # Span model + context propagation
@@ -154,9 +164,12 @@ class Collector:
         # here, and only the ring append + write need the mutex.
         line = json.dumps(span.to_json()) + "\n" if self._file else None
         with self._lock:
+            dropped = len(self._ring) == self._ring.maxlen
             self._ring.append(span)
             if self._file is not None and line is not None:
                 self._file.write(line)
+        if dropped:
+            SPANS_DROPPED.inc(self.component)
 
     def spans(self) -> list[Span]:
         with self._lock:

@@ -29,7 +29,7 @@ from oim_tpu import log
 from oim_tpu.agent import Agent, AgentError
 from oim_tpu.agent import EBUSY, EEXIST, ENODEV, ENOSPC
 from oim_tpu.common import pci as pcilib
-from oim_tpu.common import metrics, resilience, tracing
+from oim_tpu.common import events, metrics, resilience, tracing
 from oim_tpu.common.interceptors import LogServerInterceptor, PeerCheckInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.tlsconfig import TLSConfig
@@ -92,6 +92,7 @@ class Controller:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._health_reporter = None
+        self._event_publisher = None
         self._closed = False
         self._advertised_address = ""
         # Chip occupancy, evaluated against the agent at scrape time (so
@@ -230,9 +231,21 @@ class Controller:
         if not volume_id:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "volume_id required")
         which = request.WhichOneof("params")
+        t0 = time.perf_counter()
         with self._mutex.locked(volume_id):
             cached = self._idem_replies.get(volume_id)
             if cached is not None and self._idem_compatible(request, *cached):
+                def cache_hit() -> oim_pb2.MapVolumeReply:
+                    # Emitted only on the paths that actually ANSWER from
+                    # the cache — a stale entry that falls through to the
+                    # agent must not leave a misleading cache-hit row.
+                    events.emit(
+                        "volume.map.cache-hit",
+                        component="oim-controller",
+                        subject=volume_id,
+                        controller=self.controller_id,
+                    )
+                    return cached[0]
                 # Retry after a lost reply: hand back the original
                 # placement — but only after checking it against the
                 # device plane, because a restarted agent comes back
@@ -245,7 +258,7 @@ class Controller:
                     alloc = self.agent().find_allocation(volume_id)
                 except (ConnectionError, OSError):
                     self._drop_agent()
-                    return cached[0]
+                    return cache_hit()
                 except AgentError:
                     # The agent is up but answered with an application
                     # error: fall through and let the normal path map it
@@ -253,7 +266,7 @@ class Controller:
                     pass
                 else:
                     if alloc is not None:
-                        return cached[0]
+                        return cache_hit()
                     self._idem_replies.pop(volume_id, None)  # wiped
             alloc = self._call_agent(
                 context, lambda a: a.find_allocation(volume_id)
@@ -271,6 +284,15 @@ class Controller:
                             ),
                         )
                     except AgentError as exc:
+                        events.emit(
+                            "volume.map.alloc-failed",
+                            component="oim-controller",
+                            severity=events.ERROR,
+                            subject=volume_id,
+                            controller=self.controller_id,
+                            code=_agent_error_to_status(exc).name,
+                            error=str(exc),
+                        )
                         context.abort(_agent_error_to_status(exc), str(exc))
                 elif which == "provisioned":
                     # Pre-provisioned allocations must already exist
@@ -316,6 +338,14 @@ class Controller:
                 context.abort(_agent_error_to_status(exc), str(exc))
             reply = self._reply_from_allocation(attached)
             self._idem_replies[volume_id] = (reply, attached["provisioned"])
+        events.emit(
+            "volume.map",
+            component="oim-controller",
+            subject=volume_id,
+            controller=self.controller_id,
+            chips=len(reply.chips),
+            duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
+        )
         return reply
 
     @staticmethod
@@ -403,6 +433,12 @@ class Controller:
             except AgentError as exc:
                 if exc.code != ENODEV:
                     context.abort(_agent_error_to_status(exc), str(exc))
+        events.emit(
+            "volume.unmap",
+            component="oim-controller",
+            subject=volume_id,
+            controller=self.controller_id,
+        )
         return oim_pb2.UnmapVolumeReply()
 
     def ProvisionSlice(self, request: oim_pb2.ProvisionSliceRequest, context) -> oim_pb2.ProvisionSliceReply:
@@ -452,6 +488,13 @@ class Controller:
                 except AgentError as exc:
                     if exc.code != ENODEV:
                         context.abort(_agent_error_to_status(exc), str(exc))
+        events.emit(
+            "slice.provision" if request.chip_count > 0 else "slice.delete",
+            component="oim-controller",
+            subject=name,
+            controller=self.controller_id,
+            chips=request.chip_count,
+        )
         return oim_pb2.ProvisionSliceReply()
 
     def CheckSlice(self, request: oim_pb2.CheckSliceRequest, context) -> oim_pb2.CheckSliceReply:
@@ -508,6 +551,14 @@ class Controller:
             target=self._register_loop, daemon=True, name="controller-register"
         )
         self._thread.start()
+        # Durable flight-recorder publication: WARNING+ events mirror to
+        # leased events/controller.<id>/<seq> keys (the source doubles as
+        # the TLS CN, matching the registry's events/ authz subtree).
+        self._event_publisher = events.RegistryEventPublisher(
+            f"controller.{self.controller_id}",
+            self.registry_address,
+            tls=self.tls,
+        ).start()
         if self.health_interval > 0:
             # Chip-health telemetry rides the same lease discipline as the
             # address heartbeat (oim_tpu/health/reporter.py).
@@ -599,6 +650,9 @@ class Controller:
         if self._health_reporter is not None:
             self._health_reporter.close()
             self._health_reporter = None
+        if self._event_publisher is not None:
+            self._event_publisher.close()
+            self._event_publisher = None
         if self._closed:
             return
         self._closed = True

@@ -20,7 +20,7 @@ from oim_tpu import log
 from oim_tpu.agent import Agent, AgentError, ENODEV, ENOSPC, EEXIST
 from oim_tpu.common import endpoint as ep
 from oim_tpu.common import pci as pcilib
-from oim_tpu.common import resilience, tracing
+from oim_tpu.common import events, resilience, tracing
 from oim_tpu.common.chancache import ChannelCache, RECONNECT_OPTIONS
 from oim_tpu.common.tlsconfig import TLSConfig
 from oim_tpu.csi import rendezvous
@@ -499,6 +499,14 @@ class RemoteBackend:
         )
         for value in reply.values:
             if value.path == path and value.value:
+                events.emit(
+                    "volume.stage.refused-evicted",
+                    component="oim-csi-driver",
+                    severity=events.WARNING,
+                    subject=volume_id,
+                    controller=self.controller_id,
+                    eviction=value.value,
+                )
                 raise VolumeError(
                     grpc.StatusCode.FAILED_PRECONDITION,
                     f"volume {volume_id!r} is evicted ({value.value}); "

@@ -19,12 +19,15 @@ import time
 import grpc
 
 from oim_tpu import log
+from oim_tpu.common import events
 from oim_tpu.controller.keymutex import KeyMutex
 from oim_tpu.csi.backend import VolumeError, wait_for_devices
 from oim_tpu.csi.mounter import Mounter
 from oim_tpu.spec import csi_pb2
 
 DEFAULT_DEVICE_TIMEOUT = 60.0
+
+_COMPONENT = "oim-csi-driver"
 
 
 class NodeServer:
@@ -59,29 +62,49 @@ class NodeServer:
         with self._mutex.locked(request.volume_id):
             if self.mounter.is_staged(request.staging_target_path):
                 return csi_pb2.NodeStageVolumeResponse()  # idempotent
+            # Lifecycle clock: stage begin opens the volume's e2e window
+            # (closed by NodePublish); the map and stage phases feed
+            # oim_volume_lifecycle_seconds and the event timeline.
+            events.begin_e2e(request.volume_id)
+            staged_ok = False
             try:
-                # Respect the caller's deadline like the reference's
-                # ctx-cancellation-aware device wait
-                # (oim-driver_test.go:209-226) — for both the multi-host
-                # rendezvous inside create_device and the device wait.
-                remaining = context.time_remaining()
-                deadline = (
-                    time.monotonic() + remaining - 1.0
-                    if remaining is not None
-                    else None
-                )
-                staged = self.backend.create_device(
-                    request.volume_id, dict(request.volume_context), deadline
-                )
-                timeout = self.device_timeout
-                if remaining is not None:
-                    timeout = min(timeout, max(remaining - 1.0, 0.1))
-                wait_for_devices(
-                    [chip["device_path"] for chip in staged.chips], timeout
-                )
+                with events.phase(request.volume_id, "stage", _COMPONENT):
+                    # Respect the caller's deadline like the reference's
+                    # ctx-cancellation-aware device wait
+                    # (oim-driver_test.go:209-226) — for both the
+                    # multi-host rendezvous inside create_device and the
+                    # device wait.
+                    remaining = context.time_remaining()
+                    deadline = (
+                        time.monotonic() + remaining - 1.0
+                        if remaining is not None
+                        else None
+                    )
+                    with events.phase(request.volume_id, "map", _COMPONENT):
+                        staged = self.backend.create_device(
+                            request.volume_id,
+                            dict(request.volume_context),
+                            deadline,
+                        )
+                    timeout = self.device_timeout
+                    if remaining is not None:
+                        timeout = min(timeout, max(remaining - 1.0, 0.1))
+                    wait_for_devices(
+                        [chip["device_path"] for chip in staged.chips], timeout
+                    )
+                    self.mounter.stage(
+                        request.staging_target_path, staged.bootstrap()
+                    )
+                staged_ok = True
             except VolumeError as exc:
                 context.abort(exc.code, exc.message)
-            self.mounter.stage(request.staging_target_path, staged.bootstrap())
+            finally:
+                # ANY failed stage abandons the e2e window — a mounter
+                # OSError (not just VolumeError) must not strand an
+                # entry in the bounded start table, where it could later
+                # evict a live flow's clock.
+                if not staged_ok:
+                    events.abandon_e2e(request.volume_id)
         log.current().info(
             "NodeStageVolume done",
             volume=request.volume_id,
@@ -96,11 +119,15 @@ class NodeServer:
                 "volume_id and staging_target_path required",
             )
         with self._mutex.locked(request.volume_id):
+            events.abandon_e2e(request.volume_id)
             self.mounter.unstage(request.staging_target_path)
             try:
                 self.backend.destroy_device(request.volume_id)
             except VolumeError as exc:
                 context.abort(exc.code, exc.message)
+        events.emit(
+            "volume.unstage", component=_COMPONENT, subject=request.volume_id
+        )
         return csi_pb2.NodeUnstageVolumeResponse()
 
     def NodePublishVolume(self, request, context) -> csi_pb2.NodePublishVolumeResponse:
@@ -121,9 +148,15 @@ class NodeServer:
                     f"volume {request.volume_id!r} is not staged at "
                     f"{request.staging_target_path!r}",
                 )
-            self.mounter.publish(
-                request.staging_target_path, request.target_path, request.readonly
-            )
+            with events.phase(request.volume_id, "publish", _COMPONENT):
+                self.mounter.publish(
+                    request.staging_target_path,
+                    request.target_path,
+                    request.readonly,
+                )
+            # Publish completes the map→stage→publish flow: close the
+            # e2e window opened at stage begin.
+            events.end_e2e(request.volume_id, _COMPONENT)
         return csi_pb2.NodePublishVolumeResponse()
 
     def NodeUnpublishVolume(
@@ -136,6 +169,9 @@ class NodeServer:
             )
         with self._mutex.locked(request.volume_id):
             self.mounter.unpublish(request.target_path)
+        events.emit(
+            "volume.unpublish", component=_COMPONENT, subject=request.volume_id
+        )
         return csi_pb2.NodeUnpublishVolumeResponse()
 
     def NodeGetCapabilities(

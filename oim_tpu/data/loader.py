@@ -24,10 +24,28 @@ SURVEY.md §2.3).  Design points, all TPU-driven:
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Iterator
 
 import numpy as np
+
+from oim_tpu.common import metrics
+
+# Data-plane instruments: the training input pipeline touched metrics
+# nowhere, so a host-bound feed was invisible until step time regressed.
+# Assembly is sub-millisecond when healthy — FAST_BUCKETS, not the 1ms-
+# floor control-plane buckets.
+_BATCHES = metrics.registry().counter(
+    "oim_data_batches_total",
+    "Token batches assembled by the input pipeline.",
+)
+_ASSEMBLY = metrics.registry().histogram(
+    "oim_data_batch_assembly_seconds",
+    "Host-side batch gather latency (shuffled windows to one batch "
+    "array), per batch.",
+    buckets=metrics.FAST_BUCKETS,
+)
 
 
 @dataclass(frozen=True)
@@ -110,6 +128,7 @@ class TokenBatches:
     def batch_at(self, step: int) -> np.ndarray:
         """The local batch for a global step (any step, random access —
         this is the resume path: no iterator state to restore)."""
+        t0 = time.perf_counter()
         epoch, within = divmod(step, self.steps_per_epoch)
         order = self._epoch_order(epoch)
         start = within * self.batch_global
@@ -121,6 +140,8 @@ class TokenBatches:
         out = np.empty((self.batch_local, self.seq + 1), np.int32)
         for i, w in enumerate(rows):
             out[i] = self.tokens[w * self.seq : w * self.seq + self.seq + 1]
+        _ASSEMBLY.observe(time.perf_counter() - t0)
+        _BATCHES.inc()
         return out
 
     def __iter__(self) -> Iterator[np.ndarray]:

@@ -18,10 +18,28 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterable, Iterator
 
 import jax
 import numpy as np
+
+from oim_tpu.common import metrics
+
+# Prefetch observability: depth says whether the buffer is doing its job
+# (pinned at 0 = the host cannot keep up; pinned at buffer_size = device-
+# bound, all good), wait time says what that costs the train step.
+_DEPTH = metrics.registry().gauge(
+    "oim_data_prefetch_depth",
+    "Batches ready in the host-to-device prefetch buffer at the last "
+    "consumer wakeup.",
+)
+_WAIT = metrics.registry().histogram(
+    "oim_data_batch_wait_seconds",
+    "Time the consumer blocked waiting for the next prefetched batch "
+    "(sustained milliseconds here = input-pipeline-bound training).",
+    buckets=metrics.FAST_BUCKETS,
+)
 
 
 class _Stop:
@@ -84,7 +102,10 @@ def device_prefetch(
         thread.start()
         try:
             while True:
+                t0 = time.perf_counter()
                 item = buf.get()
+                _WAIT.observe(time.perf_counter() - t0)
+                _DEPTH.set(buf.qsize())
                 if isinstance(item, _Stop):
                     return
                 if isinstance(item, BaseException):

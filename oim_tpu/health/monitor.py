@@ -35,7 +35,7 @@ from dataclasses import dataclass
 from typing import Callable, Hashable
 
 from oim_tpu import log
-from oim_tpu.common import metrics
+from oim_tpu.common import events, metrics
 from oim_tpu.health import states
 
 
@@ -182,6 +182,15 @@ class EvictionEngine:
         self._evictions.inc(reason)
         if reported_ts:
             self._detect.observe(max(0.0, now - reported_ts))
+        events.emit(
+            "health.eviction",
+            component="fleet-monitor",
+            severity=events.WARNING,
+            subject=volume_id,
+            controller=controller_id,
+            reason=reason,
+            detail=detail,
+        )
         log.current().warning(
             "allocation evicted",
             volume=volume_id,
@@ -357,7 +366,15 @@ class FleetMonitor:
             # state but its last-known allocation is retained for the
             # controller-dead path.
             with self._lock:
-                self._live.pop(key, None)
+                known = self._live.pop(key, None)
+            if known is not None:
+                events.emit(
+                    "health.lease-expired",
+                    component="fleet-monitor",
+                    subject=f"{cid}/{chip}",
+                    controller=cid,
+                    chip=chip,
+                )
             self._timer.disarm(key)
             self._update_gauge(cid)
             return
@@ -438,6 +455,14 @@ class FleetMonitor:
             for key in [k for k in self._live if k[0] == cid]:
                 del self._live[key]
                 self._timer.disarm(key)
+        if allocs:
+            events.emit(
+                "health.controller-dead",
+                component="fleet-monitor",
+                severity=events.ERROR,
+                subject=cid,
+                volumes=len(allocs),
+            )
         for volume in allocs:
             self._evict_from_report(volume, cid, "controller-dead", "")
         self._update_gauge(cid)
@@ -446,5 +471,13 @@ class FleetMonitor:
         with self._lock:
             self._cordoned.add(cid)
             allocs = sorted(set(self._allocs.get(cid, {}).values()))
+        events.emit(
+            "health.drain",
+            component="fleet-monitor",
+            severity=events.WARNING,
+            subject=cid,
+            reason=value,
+            volumes=len(allocs),
+        )
         for volume in allocs:
             self._evict_from_report(volume, cid, "drained", value)

@@ -225,6 +225,13 @@ class Registry:
         cn = peer_common_name(context)
         if cn is None or cn == ADMIN_CN:
             return
+        parts = path.split("/")
+        # Any authenticated component may publish its OWN flight-recorder
+        # events (events/<cn>/<seq>, oim_tpu/common/events) — the
+        # health/-shaped least-privilege rule: never another identity's
+        # subtree, so one compromised daemon cannot forge fleet history.
+        if len(parts) == 3 and parts[0] == "events" and parts[1] == cn:
+            return
         if cn.startswith(CONTROLLER_CN_PREFIX):
             controller_id = cn[len(CONTROLLER_CN_PREFIX):]
             if path == f"{controller_id}/address":
@@ -243,8 +250,8 @@ class Registry:
                 return
             context.abort(
                 grpc.StatusCode.PERMISSION_DENIED,
-                f"{cn!r} may only set {controller_id}/address "
-                f"or health/{controller_id}/*",
+                f"{cn!r} may only set {controller_id}/address, "
+                f"health/{controller_id}/* or events/{cn}/*",
             )
         if cn.startswith(SERVE_CN_PREFIX):
             # A serving instance may publish only its own discovery key

@@ -17,7 +17,7 @@ from __future__ import annotations
 import threading
 
 from oim_tpu import log
-from oim_tpu.common import resilience
+from oim_tpu.common import events, resilience
 
 
 class ServeRegistration:
@@ -103,6 +103,9 @@ class ServeRegistration:
                     ),
                     timeout=5,
                 )
+            events.emit(
+                "serve.deregister", component="oim-serve", subject=self.serve_id
+            )
         except Exception as exc:
             # The lease still expires the key; deregistration only
             # accelerates it.
@@ -117,6 +120,13 @@ class ServeRegistration:
             except Exception as exc:
                 # Never let the heartbeat die: transient failures must
                 # not permanently de-register the instance.
+                events.emit(
+                    "serve.register.failed",
+                    component="oim-serve",
+                    severity=events.WARNING,
+                    subject=self.serve_id,
+                    error=str(exc),
+                )
                 log.current().warning(
                     "serve registration failed",
                     registry=self.registry_address,
@@ -129,6 +139,15 @@ class ServeRegistration:
         # 80% of a 60s heartbeat period of retries.  The background loop
         # keeps the full beat-bounded policy for transient blips.
         self.register(retry=resilience.RetryPolicy.one_shot())
+        # One timeline row per registration epoch (the first successful
+        # beat), not one per heartbeat — churn shows as register /
+        # deregister pairs, a flapping registry as register.failed rows.
+        events.emit(
+            "serve.register",
+            component="oim-serve",
+            subject=self.serve_id,
+            address=self.advertised_address,
+        )
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self

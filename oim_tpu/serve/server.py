@@ -52,6 +52,7 @@ API:
   GET  /v1/info      → static model/engine description (geometry, params,
                     capacity shape, live features) — cacheable
   GET  /metrics      → Prometheus exposition (shared registry)
+  GET  /debugz       → live flight-recorder event rings (common/events.py)
 
 The engine is tokenizer-agnostic by design — clients speak token ids, the
 same boundary the CSI driver keeps by speaking device paths rather than
@@ -134,6 +135,13 @@ class ServeServer:
                     # Prometheus exposition, shared registry + response
                     # format with the control plane (common/metrics.py).
                     metrics.write_exposition(self)
+                    return
+                if self.path.split("?", 1)[0] == "/debugz":
+                    # Live flight-recorder rings (common/events.py) —
+                    # the same surface MetricsServer gives gRPC daemons.
+                    from oim_tpu.common import events as events_mod
+
+                    self._json(200, events_mod.snapshot())
                     return
                 if self.path == "/healthz":
                     if outer.error is not None:

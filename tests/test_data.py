@@ -151,6 +151,35 @@ class TestDevicePrefetch:
         # Producer stopped: nothing new after close settles.
         assert len(produced) == n_after_close < 10_000
 
+    def test_data_plane_is_instrumented(self):
+        """The input pipeline exports metrics (it touched none before):
+        batches-served counter, sub-ms assembly histogram (FAST_BUCKETS),
+        prefetch-queue depth gauge and consumer wait histogram."""
+        from oim_tpu.common import metrics
+
+        reg = metrics.registry()
+        batches = reg.counter("oim_data_batches_total", "")
+        assembly = reg.histogram("oim_data_batch_assembly_seconds", "")
+        wait = reg.histogram("oim_data_batch_wait_seconds", "")
+        depth = reg.gauge("oim_data_prefetch_depth", "")
+        assert assembly.buckets[0] == metrics.FAST_BUCKETS[0]  # sub-ms floor
+        b0, a0, w0 = batches.value(), assembly.count(), wait.count()
+        # Sentinel: the consumer sets the depth gauge at every wakeup,
+        # so consumption must overwrite this (>= 0) — a deleted set()
+        # call would leave it at -1.
+        depth.set(-1.0)
+
+        tb = TokenBatches(_corpus(2000), batch_global=8, seq=16, epochs=1)
+        consumed = 0
+        for _ in device_prefetch(iter(tb), self._sharding()):
+            consumed += 1
+        assert consumed == tb.steps_per_epoch
+        assert batches.value() == b0 + consumed
+        assert assembly.count() == a0 + consumed
+        # The consumer measured one wait per item (+ the end marker).
+        assert wait.count() >= w0 + consumed
+        assert depth.value() >= 0  # sentinel overwritten at a wakeup
+
     def test_feeds_train_loop(self):
         """End-to-end: prefetched batches drive the real train step."""
         import optax

@@ -1,0 +1,605 @@
+"""Flight recorder: structured events, trace correlation, lifecycle SLOs.
+
+The reference had no event record at all — state transitions lived in
+logs and scrolled away (SURVEY.md §5).  This suite holds the third
+observability pillar (oim_tpu/common/events.py) to its contract: typed
+trace-linked events in bounded rings, durable WARNING+ publication under
+authz-scoped leased registry keys, the crash-dump hook, the
+``oim_volume_lifecycle_seconds`` SLO histogram, and the ``oimctl
+events`` timeline — including the full ProvisionSlice → MapVolume →
+NodeStageVolume acceptance flow.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from oim_tpu.agent import Agent, ChipStore, FakeAgentServer
+from oim_tpu.common import events, metrics, resilience, tracing
+from oim_tpu.controller import Controller
+from oim_tpu.csi import OIMDriver
+from oim_tpu.registry import Registry
+from oim_tpu.spec import CONTROLLER, CSI_NODE, csi_pb2, oim_pb2
+
+from tests.helpers import FakeAbort, FakeServicerContext, wait_for
+
+
+# ---------------------------------------------------------------------------
+# Unit: event model + recorder
+
+
+class TestEventModel:
+    def test_json_roundtrip(self):
+        event = events.Event(
+            component="c", kind="k.x", severity=events.WARNING,
+            subject="vol-1", trace_id="ab" * 16, seq=7, ts=123.5,
+            fields={"a": 1},
+        )
+        assert events.Event.from_json(event.to_json()) == event
+
+    def test_from_json_tolerates_junk(self):
+        event = events.Event.from_json({"fields": "not-a-dict"})
+        assert event.kind == "?"
+        assert event.fields == {}
+        with pytest.raises(TypeError):
+            events.Event.from_json("not-an-object")
+
+    def test_load_dump_tolerates_foreign_files(self, tmp_path):
+        """Pointing oimctl at the wrong file must yield an empty/partial
+        timeline, never a stack trace."""
+        good = events.Event("c", "k", events.INFO, "s", "", 1, 1.0, {}).to_json()
+        cases = {
+            "array.json": [1, 2],
+            "junk-entries.json": {"events": ["junk", None, good]},
+            "events-not-list.json": {"events": "nope"},
+        }
+        for name, doc in cases.items():
+            (tmp_path / name).write_text(json.dumps(doc))
+        assert events.load_dump(str(tmp_path / "array.json")) == []
+        assert events.load_dump(str(tmp_path / "events-not-list.json")) == []
+        loaded = events.load_dump(str(tmp_path / "junk-entries.json"))
+        assert len(loaded) == 1 and loaded[0].kind == "k"
+
+    def test_render_tolerates_junk_duration(self):
+        event = events.Event(
+            "c", "k", events.INFO, "s", "", 1, 1.0, {"duration_ms": "n/a"}
+        )
+        line = events.render_event(event)
+        assert "k" in line  # rendered, duration column blank
+        assert "n/a" not in line.split()[0]
+
+    def test_key_roundtrip(self):
+        path = events.event_key("controller.h0", 42)
+        assert path == "events/controller.h0/42"
+        assert events.parse_event_path(path) == ("controller.h0", "42")
+        assert events.parse_event_path("health/h0/0") is None
+        assert events.parse_event_path("events/too/deep/key") is None
+
+    def test_severity_order(self):
+        assert events.severity_at_least(events.ERROR, events.WARNING)
+        assert events.severity_at_least(events.WARNING, events.WARNING)
+        assert not events.severity_at_least(events.INFO, events.WARNING)
+
+
+class TestFlightRecorder:
+    def test_emit_captures_active_trace(self):
+        rec = events.FlightRecorder("trace-test")
+        with tracing.start_span("op") as span:
+            event = rec.emit("thing.happened", subject="s")
+        assert event.trace_id == span.trace_id
+        outside = rec.emit("thing.happened")
+        assert outside.trace_id == ""
+
+    def test_seq_monotonic_and_ring_bounded_with_drop_counter(self):
+        rec = events.FlightRecorder("ring-test", capacity=4)
+        before = events.EVENTS_DROPPED.value("ring-test")
+        emitted = [rec.emit("e", n=i) for i in range(6)]
+        assert [e.seq for e in emitted] == [1, 2, 3, 4, 5, 6]
+        kept = rec.events()
+        assert len(kept) == 4  # drop-oldest
+        assert [e.fields["n"] for e in kept] == [2, 3, 4, 5]
+        assert events.EVENTS_DROPPED.value("ring-test") == before + 2
+        assert events.EVENTS_TOTAL.value("ring-test", "e", events.INFO) >= 6
+
+    def test_failing_sink_never_breaks_emit(self):
+        def bad_sink(_event):
+            raise RuntimeError("sink boom")
+
+        events.add_sink(bad_sink)
+        try:
+            event = events.recorder("sink-test").emit("ok.anyway")
+        finally:
+            events.remove_sink(bad_sink)
+        assert event.kind == "ok.anyway"
+
+    def test_emit_routes_by_component_and_default(self):
+        events.emit("routed", component="router-a", subject="x")
+        assert any(
+            e.kind == "routed" for e in events.recorder("router-a").events()
+        )
+        merged = events.all_events()
+        assert any(
+            e.kind == "routed" and e.component == "router-a" for e in merged
+        )
+
+
+# ---------------------------------------------------------------------------
+# Crash hook
+
+
+class TestCrashHook:
+    def test_fatal_dumps_ring_and_chains(self, tmp_path):
+        crash_dir = str(tmp_path / "crash")
+        os.makedirs(crash_dir)
+        events.recorder("crash-test").emit("before.the.end", subject="v9")
+        seen = []
+        prev = sys.excepthook
+        sys.excepthook = lambda *args: seen.append(args)
+        try:
+            events.install_crash_hook(crash_dir)
+            sys.excepthook(RuntimeError, RuntimeError("injected fatal"), None)
+        finally:
+            events.uninstall_crash_hook()
+            sys.excepthook = prev
+        assert seen, "previous excepthook was not chained"
+        dumps = glob.glob(os.path.join(crash_dir, "oim-flight-*.json"))
+        assert dumps, "no flight-recorder dump written"
+        loaded = events.load_dump(dumps[0])
+        assert any(e.kind == "before.the.end" and e.subject == "v9" for e in loaded)
+        assert any(
+            e.kind == "crash" and "injected fatal" in str(e.fields.get("error"))
+            for e in loaded
+        )
+
+    def test_operator_interrupt_is_not_a_crash(self, tmp_path):
+        crash_dir = str(tmp_path / "quiet")
+        os.makedirs(crash_dir)
+        prev = sys.excepthook
+        sys.excepthook = lambda *args: None
+        try:
+            events.install_crash_hook(crash_dir)
+            sys.excepthook(KeyboardInterrupt, KeyboardInterrupt(), None)
+        finally:
+            events.uninstall_crash_hook()
+            sys.excepthook = prev
+        assert not glob.glob(os.path.join(crash_dir, "oim-flight-*.json"))
+
+
+# ---------------------------------------------------------------------------
+# /debugz
+
+
+def test_debugz_serves_live_ring():
+    marker = f"debugz-{os.getpid()}"
+    events.recorder("debugz-test").emit("debugz.probe", subject=marker)
+    srv = metrics.MetricsServer("127.0.0.1:0").start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debugz", timeout=5
+        ) as resp:
+            assert resp.status == 200
+            doc = json.load(resp)
+        assert any(
+            e["kind"] == "debugz.probe" and e["subject"] == marker
+            for e in doc["events"]
+        )
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Bridges: breaker + agent reconnect
+
+
+def test_breaker_transition_emits_event():
+    breaker = resilience.CircuitBreaker(
+        "events-breaker-demo", failure_threshold=1, reset_timeout_s=60.0
+    )
+    breaker.allow()
+    breaker.record_failure()
+    transitions = [
+        e
+        for e in events.recorder("resilience").events()
+        if e.kind == "breaker.transition"
+        and e.subject == "events-breaker-demo"
+    ]
+    assert transitions
+    assert transitions[-1].severity == events.WARNING
+    assert transitions[-1].fields["to"] == "open"
+
+
+def test_agent_reconnect_emits_event(tmp_path):
+    store = ChipStore(mesh=(2,), device_dir=str(tmp_path / "dev"))
+    sock = str(tmp_path / "agent.sock")
+    srv = FakeAgentServer(store, sock).start()
+    agent = Agent(sock)
+    try:
+        agent.get_chips()
+        # Daemon restart: the established connection dies, the client
+        # re-dials under the shared policy and leaves a timeline row.
+        srv.stop()
+        srv = FakeAgentServer(store, sock).start()
+        agent.get_chips()
+        reconnects = [
+            e
+            for e in events.recorder("agent-client").events()
+            if e.kind == "agent.reconnect" and e.subject == sock
+        ]
+        assert reconnects
+        assert reconnects[-1].severity == events.WARNING
+    finally:
+        agent.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Durable publication + authz
+
+
+def test_publisher_mirrors_warnings_to_leased_keys(tmp_path, capsys):
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    publisher = events.RegistryEventPublisher(
+        "controller.pub-0", str(reg_srv.addr()), ttl_seconds=60
+    ).start()
+    try:
+        events.recorder("pub-test").emit("calm.info", subject="not-published")
+        events.recorder("pub-test").emit(
+            "loud.warning", severity=events.WARNING, subject="vol-pub"
+        )
+
+        def published():
+            return [
+                (k, registry.db.lookup(k))
+                for k in registry.db.keys("events/controller.pub-0")
+            ]
+
+        assert wait_for(lambda: len(published()) == 1, timeout=10)
+        (path, value), = published()
+        assert events.parse_event_path(path)[0] == "controller.pub-0"
+        event = events.Event.from_json(json.loads(value))
+        assert event.kind == "loud.warning"
+        assert event.subject == "vol-pub"
+        # INFO stayed local-only.
+        assert all(
+            events.Event.from_json(json.loads(v)).kind != "calm.info"
+            for _, v in published()
+        )
+        # The registry-backed oimctl path renders the durable copy.
+        from oim_tpu.cli import oimctl
+
+        assert oimctl.main([
+            "--registry", str(reg_srv.addr()), "events", "--volume", "vol-pub",
+        ]) == 0
+        out = capsys.readouterr().out
+        assert "loud.warning" in out
+        assert "calm.info" not in out
+        # A restarted publisher must CONTINUE the keyspace, not
+        # overwrite records still inside their TTL (seq is seeded from
+        # the wall clock, not reset to 0).
+        publisher.close()
+        second = events.RegistryEventPublisher(
+            "controller.pub-0", str(reg_srv.addr()), ttl_seconds=60
+        ).start()
+        try:
+            events.recorder("pub-test").emit(
+                "post.restart", severity=events.WARNING, subject="vol-pub2"
+            )
+            assert wait_for(lambda: len(published()) == 2, timeout=10)
+            kinds = {
+                events.Event.from_json(json.loads(v)).kind
+                for _, v in published()
+            }
+            assert kinds == {"loud.warning", "post.restart"}
+        finally:
+            second.close()
+    finally:
+        publisher.close()
+        publisher.close()  # idempotent
+        reg_srv.stop()
+        registry.close()
+
+
+def test_events_keyspace_authz_scoped_like_health():
+    registry = Registry()
+
+    def set_value(cn, path):
+        registry.SetValue(
+            oim_pb2.SetValueRequest(
+                value=oim_pb2.Value(path=path, value="{}"), ttl_seconds=60
+            ),
+            FakeServicerContext(cn),
+        )
+
+    # Own subtree: allowed for every authenticated identity class.
+    set_value("controller.h1", "events/controller.h1/1")
+    set_value("serve.s1", "events/serve.s1/1")
+    set_value("host.h1", "events/host.h1/1")
+    set_value("user.admin", "events/anything/1")
+    # A foreign subtree is denied — fleet history cannot be forged.
+    with pytest.raises(FakeAbort) as exc:
+        set_value("controller.h1", "events/controller.h2/1")
+    assert exc.value.code == grpc.StatusCode.PERMISSION_DENIED
+    with pytest.raises(FakeAbort):
+        set_value("serve.s1", "events/controller.h1/1")
+    registry.close()
+
+
+# ---------------------------------------------------------------------------
+# Timeline rendering
+
+
+def test_render_timeline_filters_and_orders():
+    evts = [
+        events.Event("csi", "volume.stage", events.INFO, "vol-a", "ff" * 16,
+                     2, 100.5, {"duration_ms": 12.25, "phase": "stage"}),
+        events.Event("ctl", "volume.map", events.INFO, "vol-a", "ff" * 16,
+                     1, 100.0, {"duration_ms": 4.5, "phase": "map"}),
+        events.Event("ctl", "volume.map", events.INFO, "vol-b", "aa" * 16,
+                     3, 99.0, {}),
+    ]
+    out = events.render_timeline(evts, volume="vol-a")
+    lines = out.splitlines()
+    assert len(lines) == 2
+    assert "volume.map" in lines[0] and "+    0.000s" in lines[0]
+    assert "volume.stage" in lines[1] and "12.25ms" in lines[1]
+    assert "trace=ffffffff" in lines[0]
+    assert "vol-b" not in out
+    assert events.render_timeline([], volume="x") == "(no matching events)"
+    assert "vol-b" in events.render_timeline(evts, kind="volume.map",
+                                             component="ctl")
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: ProvisionSlice → MapVolume → NodeStage/Publish end-to-end
+
+
+def test_volume_lifecycle_end_to_end(tmp_path, capsys):
+    store = ChipStore(mesh=(2, 2, 1), device_dir=str(tmp_path / "dev"))
+    agent_srv = FakeAgentServer(store, str(tmp_path / "agent.sock")).start()
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    controller = Controller(
+        "evt-host",
+        agent_srv.socket_path,
+        registry_address=str(reg_srv.addr()),
+        registry_delay=30.0,
+    )
+    ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
+    controller.start(str(ctrl_srv.addr()))
+    driver = OIMDriver(
+        csi_endpoint=f"unix://{tmp_path}/csi.sock",
+        registry_address=str(reg_srv.addr()),
+        controller_id="evt-host",
+    )
+    csi_srv = driver.start_server()
+    reg_channel = grpc.insecure_channel(reg_srv.addr().grpc_target())
+    csi_channel = grpc.insecure_channel(csi_srv.addr().grpc_target())
+    debug_srv = metrics.MetricsServer("127.0.0.1:0").start()
+    vid = "vol-lifecycle"
+    try:
+        assert wait_for(
+            lambda: registry.db.lookup("evt-host/address")
+            == str(ctrl_srv.addr())
+        ), "controller never self-registered"
+        e2e_before = events.LIFECYCLE.count("e2e")
+        map_before = events.LIFECYCLE.count("map")
+
+        # 1. ProvisionSlice through the transparent proxy.
+        CONTROLLER.stub(reg_channel).ProvisionSlice(
+            oim_pb2.ProvisionSliceRequest(name=vid, chip_count=2),
+            metadata=(("controllerid", "evt-host"),),
+            timeout=15,
+        )
+        # 2+3. NodeStage (MapVolume rides inside) then NodePublish.
+        cap = csi_pb2.VolumeCapability()
+        cap.mount.SetInParent()
+        cap.access_mode.mode = (
+            csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER
+        )
+        staging = str(tmp_path / "staging")
+        target = str(tmp_path / "pods" / "p" / "tpu")
+        node = CSI_NODE.stub(csi_channel)
+        node.NodeStageVolume(
+            csi_pb2.NodeStageVolumeRequest(
+                volume_id=vid,
+                staging_target_path=staging,
+                volume_capability=cap,
+            ),
+            timeout=15,
+        )
+        node.NodePublishVolume(
+            csi_pb2.NodePublishVolumeRequest(
+                volume_id=vid,
+                staging_target_path=staging,
+                target_path=target,
+                volume_capability=cap,
+            ),
+            timeout=15,
+        )
+
+        # -- one trace id spans the flow: the controller-side MapVolume
+        # event and the CSI-side stage/map phase events correlate.
+        mine = [e for e in events.all_events() if e.subject == vid]
+        kinds = {(e.component, e.kind) for e in mine}
+        assert ("oim-controller", "slice.provision") in kinds
+        assert ("oim-controller", "volume.map") in kinds
+        assert ("oim-csi-driver", "volume.map") in kinds
+        assert ("oim-csi-driver", "volume.stage") in kinds
+        assert ("oim-csi-driver", "volume.publish") in kinds
+        assert ("oim-csi-driver", "volume.e2e") in kinds
+        stage_evt = next(e for e in mine if e.kind == "volume.stage")
+        ctrl_map = next(
+            e for e in mine
+            if e.kind == "volume.map" and e.component == "oim-controller"
+        )
+        csi_map = next(
+            e for e in mine
+            if e.kind == "volume.map" and e.component == "oim-csi-driver"
+        )
+        assert stage_evt.trace_id, "stage event lost its trace"
+        assert stage_evt.trace_id == ctrl_map.trace_id == csi_map.trace_id
+        # Per-phase durations ride on the events.
+        assert stage_evt.fields["duration_ms"] >= csi_map.fields["duration_ms"]
+
+        # -- the SLO histogram observed every phase, e2e included.
+        assert events.LIFECYCLE.count("e2e") == e2e_before + 1
+        assert events.LIFECYCLE.count("map") >= map_before + 1
+        assert events.LIFECYCLE.count("stage") >= 1
+        assert events.LIFECYCLE.count("publish") >= 1
+        rendered = metrics.registry().render()
+        assert 'oim_volume_lifecycle_seconds_count{phase="e2e"}' in rendered
+
+        # -- oimctl events renders the ordered, trace-linked timeline.
+        from oim_tpu.cli import oimctl
+
+        assert oimctl.main([
+            "events", "--volume", vid,
+            "--debugz", f"http://127.0.0.1:{debug_srv.port}",
+        ]) == 0
+        out = capsys.readouterr().out
+        assert "volume.map" in out
+        assert "volume.stage" in out
+        assert "volume.publish" in out
+        assert "volume.e2e" in out
+        assert f"trace={stage_evt.trace_id[:8]}" in out
+        assert "ms" in out  # per-phase durations rendered
+        # Ordered: map cannot render after publish.
+        assert out.index("volume.map") < out.index("volume.publish")
+
+        # -- an injected fatal dumps the flight-recorder ring to disk.
+        crash_dir = str(tmp_path / "crash")
+        os.makedirs(crash_dir)
+        prev = sys.excepthook
+        sys.excepthook = lambda *args: None
+        try:
+            events.install_crash_hook(crash_dir)
+            sys.excepthook(RuntimeError, RuntimeError("injected fatal"), None)
+        finally:
+            events.uninstall_crash_hook()
+            sys.excepthook = prev
+        dumps = glob.glob(os.path.join(crash_dir, "oim-flight-*.json"))
+        assert dumps, "fatal did not dump the ring"
+        loaded = events.load_dump(dumps[0])
+        assert any(
+            e.kind == "volume.e2e" and e.subject == vid for e in loaded
+        )
+
+        # -- the controller's publisher mirrors WARNING+ durably.
+        events.emit(
+            "acceptance.warning",
+            component="anywhere",
+            severity=events.WARNING,
+            subject=vid,
+        )
+        assert wait_for(
+            lambda: any(
+                "acceptance.warning" in (registry.db.lookup(k) or "")
+                for k in registry.db.keys("events/controller.evt-host")
+            ),
+            timeout=10,
+        ), "WARNING event never reached the registry"
+    finally:
+        debug_srv.stop()
+        csi_channel.close()
+        reg_channel.close()
+        csi_srv.stop()
+        driver.close()
+        ctrl_srv.stop()
+        controller.close()
+        reg_srv.stop()
+        registry.close()
+        agent_srv.stop()
+
+
+def test_evicted_refusal_and_idempotent_hit_leave_timeline_rows(tmp_path):
+    """The two controller/CSI decision points the ISSUE names: an
+    idempotency-cache hit and an evicted-volume staging refusal both
+    become events."""
+    store = ChipStore(mesh=(2,), device_dir=str(tmp_path / "dev"))
+    agent_srv = FakeAgentServer(store, str(tmp_path / "agent.sock")).start()
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    controller = Controller(
+        "refuse-host",
+        agent_srv.socket_path,
+        registry_address=str(reg_srv.addr()),
+        registry_delay=30.0,
+    )
+    ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
+    controller.start(str(ctrl_srv.addr()))
+    driver = OIMDriver(
+        csi_endpoint=f"unix://{tmp_path}/csi.sock",
+        registry_address=str(reg_srv.addr()),
+        controller_id="refuse-host",
+    )
+    csi_srv = driver.start_server()
+    channel = grpc.insecure_channel(csi_srv.addr().grpc_target())
+    reg_channel = grpc.insecure_channel(reg_srv.addr().grpc_target())
+    try:
+        assert wait_for(
+            lambda: registry.db.lookup("refuse-host/address")
+            == str(ctrl_srv.addr())
+        )
+        stub = CONTROLLER.stub(reg_channel)
+        request = oim_pb2.MapVolumeRequest(volume_id="vol-idem")
+        request.slice.chip_count = 1
+        meta = (("controllerid", "refuse-host"),)
+        stub.MapVolume(request, metadata=meta, timeout=15)
+        stub.MapVolume(request, metadata=meta, timeout=15)  # cache hit
+        assert any(
+            e.kind == "volume.map.cache-hit" and e.subject == "vol-idem"
+            for e in events.recorder("oim-controller").events()
+        )
+
+        # Mark a volume evicted, then try to stage it.
+        from oim_tpu.health import states as health_states
+
+        registry.db.store(health_states.eviction_key("vol-gone"), "chip-failed")
+        cap = csi_pb2.VolumeCapability()
+        cap.mount.SetInParent()
+        cap.access_mode.mode = (
+            csi_pb2.VolumeCapability.AccessMode.SINGLE_NODE_WRITER
+        )
+        with pytest.raises(grpc.RpcError) as exc:
+            CSI_NODE.stub(channel).NodeStageVolume(
+                csi_pb2.NodeStageVolumeRequest(
+                    volume_id="vol-gone",
+                    staging_target_path=str(tmp_path / "stg"),
+                    volume_capability=cap,
+                    volume_context={"chipCount": "1"},
+                ),
+                timeout=15,
+            )
+        assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        refusals = [
+            e
+            for e in events.recorder("oim-csi-driver").events()
+            if e.kind == "volume.stage.refused-evicted"
+            and e.subject == "vol-gone"
+        ]
+        assert refusals and refusals[-1].severity == events.WARNING
+        # The failed stage also left an ERROR phase row, trace-linked.
+        assert any(
+            e.kind == "volume.stage.failed" and e.subject == "vol-gone"
+            and e.trace_id
+            for e in events.recorder("oim-csi-driver").events()
+        )
+    finally:
+        reg_channel.close()
+        channel.close()
+        csi_srv.stop()
+        driver.close()
+        ctrl_srv.stop()
+        controller.close()
+        reg_srv.stop()
+        registry.close()
+        agent_srv.stop()

@@ -79,8 +79,67 @@ class TestInstruments:
         b = reg.counter("same", "x", ("l",))
         assert a is b
 
+    def test_label_escaping_round_trips(self):
+        """Escaped label values must parse back to the original — a
+        scraper seeing ``\\n`` where a newline was (or vice versa) would
+        corrupt every query on that series."""
+        nasty = 'a"b\\c\nd\\ne'
+        reg = metrics.MetricsRegistry()
+        reg.counter("odd_rt", "x", ("v",)).inc(nasty)
+        (line,) = [
+            l for l in reg.render().splitlines() if l.startswith("odd_rt{")
+        ]
+        quoted = line[line.index('v="') + 2 : line.rindex('"') + 1]
+
+        def unescape(s: str) -> str:
+            out, i = [], 1  # strip quotes
+            while i < len(s) - 1:
+                if s[i] == "\\" and i + 1 < len(s) - 1:
+                    out.append(
+                        {"n": "\n", "\\": "\\", '"': '"'}[s[i + 1]]
+                    )
+                    i += 2
+                else:
+                    out.append(s[i])
+                    i += 1
+            return "".join(out)
+
+        assert unescape(quoted) == nasty
+
+    def test_fast_buckets_resolve_sub_millisecond(self):
+        """FAST_BUCKETS exist for the data plane / per-token latencies:
+        DEFAULT_BUCKETS' 1ms floor lumps a 60µs and a 900µs observation
+        into one bucket; FAST_BUCKETS keep them apart."""
+        assert metrics.FAST_BUCKETS[0] == 0.00005
+        reg = metrics.MetricsRegistry()
+        h = reg.histogram("oim_fast_demo_seconds", "x",
+                          buckets=metrics.FAST_BUCKETS)
+        h.observe(0.00006)
+        h.observe(0.0009)
+        text = reg.render()
+        assert 'oim_fast_demo_seconds_bucket{le="0.0001"} 1' in text
+        assert 'oim_fast_demo_seconds_bucket{le="0.001"} 2' in text
+
 
 class TestHTTPExposition:
+    def test_failing_gauge_callback_does_not_break_http_scrape(self):
+        """A raising scrape-time callback must cost its own series only:
+        the HTTP response stays 200 and every healthy series renders."""
+        reg = metrics.MetricsRegistry()
+        reg.gauge("bad_http", "x").set_function(lambda: 1 / 0)
+        reg.counter("good_http", "y").inc()
+        srv = metrics.MetricsServer("127.0.0.1:0", reg).start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+            )
+            assert body.status == 200
+            text = body.read().decode()
+            assert "good_http 1" in text
+            assert "\nbad_http " not in text  # series absent, scrape alive
+        finally:
+            srv.stop()
+
     def test_scrape(self):
         reg = metrics.MetricsRegistry()
         reg.counter("hits", "x").inc()

@@ -80,6 +80,36 @@ class TestSpans:
             twice = tracing.inject(metadata)
         assert len([k for k, _ in twice if k == "traceparent"]) == 1
 
+    def test_ring_bounded_drop_oldest_with_counter(self):
+        """A long-lived daemon's collector must stay bounded: the ring
+        drops oldest and the loss is visible via
+        oim_trace_spans_dropped_total (silent truncation would read as
+        'nothing happened before X' during an incident)."""
+        from oim_tpu.common import metrics
+
+        collector = tracing.Collector(component="ring-unit", capacity=4)
+        dropped = metrics.registry().counter(
+            "oim_trace_spans_dropped_total", "", ("component",)
+        )
+        before = dropped.value("ring-unit")
+
+        def span(i):
+            return tracing.Span(
+                trace_id="ab" * 16, span_id=f"{i:016x}", parent_id="",
+                name=f"s{i}", component="ring-unit", start_ns=i,
+            )
+
+        for i in range(6):
+            collector.record(span(i))
+        kept = collector.spans()
+        assert len(kept) == 4
+        assert [s.name for s in kept] == ["s2", "s3", "s4", "s5"]
+        assert dropped.value("ring-unit") == before + 2
+        # Under capacity nothing is counted.
+        collector.clear()
+        collector.record(span(99))
+        assert dropped.value("ring-unit") == before + 2
+
     def test_jsonl_sink_and_load(self, tmp_path):
         path = str(tmp_path / "spans.jsonl")
         old = tracing.collector()
