@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability lint-metrics agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability lint lint-metrics agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -26,7 +26,10 @@ check-gen:
 agent:
 	$(MAKE) -C native/tpu-agent
 
-test:
+# Lint first: the analyzer is seconds, the suite is minutes — fail on a
+# missing authz grant or an unjoined thread before spending the pytest
+# budget (≙ the reference running `go vet` ahead of its test tiers).
+test: lint
 	$(PYTHON) -m pytest tests/ -x -q
 
 # Fleet health & fault management: the fault-injection suite (health
@@ -52,10 +55,19 @@ test-observability:
 	  tests/test_tracing.py tests/test_metrics.py -q -m "not slow" \
 	  -p no:cacheprovider
 
-# Metrics hygiene gate: every registered series oim_-prefixed with
-# non-empty HELP (AST source scan + runtime registry check, stdlib-only).
+# oimvet: the multi-pass control-plane static analyzer (tools/oimlint —
+# lock-discipline, resource-lifecycle, authz-coverage, protocol-drift,
+# deadline-hygiene, metrics).  Exits nonzero on any finding not in
+# tools/oimlint/baseline.txt; see doc/development.md for the waiver and
+# baseline workflow.  Stdlib-only AST walk, well under the 30s budget.
+lint:
+	$(PYTHON) -m tools.oimlint
+
+# Thin alias kept for existing workflows/docs: the metrics hygiene gate
+# (every registered series oim_-prefixed with non-empty HELP) is now
+# oimlint's `metrics` pass.
 lint-metrics:
-	$(PYTHON) tools/check_metrics.py
+	$(PYTHON) -m tools.oimlint --passes metrics
 
 # Tier 3: the full stack driving a first op on the real accelerator
 # (≙ reference env-gated real-SPDK tests, test/test.make:1-16).

@@ -627,6 +627,14 @@ class FakeAgentServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # Join the accept loop: a stop() that returns while serve_forever
+        # is still winding down can race a same-socket-path restart
+        # (test fixtures do exactly that) into two servers briefly
+        # owning one path.  shutdown() has already handshaken, so the
+        # join is bounded.
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
         # Sever established connections too: a crashed daemon takes its
         # connections down with it, and restart-recovery tests rely on
         # clients actually seeing the break (ThreadingMixIn handler

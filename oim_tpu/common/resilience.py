@@ -529,3 +529,78 @@ def call_with_retry(
         if breaker is not None and not isinstance(exc, Exception):
             breaker.record_abandoned(token)
         raise
+
+
+class ConnCache:
+    """One lazily dialed, droppable, close-latched connection.
+
+    The dial-outside-the-lock discipline, in one place, for every
+    component that caches a single eagerly-connecting client (the
+    controller's agent/scrape connections, the health reporter's
+    telemetry connection): ``get()`` reads the cached connection under
+    the lock but runs ``dial`` OUTSIDE it, so a wedged peer costs the
+    dialing thread its socket timeout — never ``close()`` or other
+    threads contending for the cache.  Racing dialers are resolved
+    under the lock (the first installed wins; the loser's connection
+    is closed).  ``close()`` latches: a dial that was in flight when
+    the cache closed is closed on arrival instead of being installed,
+    so shutdown cannot leak the late connection — and later ``get()``
+    calls raise instead of silently re-dialing (the same latch
+    discipline as the agent ``Client``).
+    """
+
+    def __init__(self, dial: Callable):
+        self._dial = dial
+        self._lock = threading.Lock()
+        self._conn = None
+        self._closed = False
+
+    def _swallow_close(self, conn) -> None:
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def get(self):
+        """The cached connection, dialing one if absent.  Raises
+        RuntimeError once the cache is closed."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("connection cache is closed")
+            conn = self._conn
+        if conn is not None:
+            return conn
+        fresh = self._dial()
+        loser = None
+        with self._lock:
+            if self._closed:
+                loser = fresh
+            elif self._conn is None:
+                conn = self._conn = fresh
+            else:
+                loser, conn = fresh, self._conn
+        if loser is not None:
+            self._swallow_close(loser)
+        if conn is None:
+            raise RuntimeError("connection cache is closed")
+        return conn
+
+    def peek(self):
+        """The cached connection or None — never dials (fault-injection
+        tests use this to reach the live connection and sever it)."""
+        with self._lock:
+            return self._conn
+
+    def drop(self) -> None:
+        """Close and forget the cached connection; the next ``get()``
+        starts from a fresh dial."""
+        with self._lock:
+            conn, self._conn = self._conn, None
+        if conn is not None:
+            self._swallow_close(conn)
+
+    def close(self) -> None:
+        """Idempotent: latch closed, then drop whatever is cached."""
+        with self._lock:
+            self._closed = True
+        self.drop()

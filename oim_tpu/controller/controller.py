@@ -86,8 +86,9 @@ class Controller:
         self._registry_retry = resilience.RetryPolicy.for_heartbeat(
             registry_delay
         )
-        self._agent: Agent | None = None
-        self._agent_lock = threading.Lock()
+        self._agent_cache = resilience.ConnCache(
+            lambda: Agent(self.agent_socket)
+        )
         # Heartbeat state (Start/Close).
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -101,8 +102,9 @@ class Controller:
         # the scrape for 2s, not block live MapVolume RPCs on the shared
         # client's lock; a dead one is dropped so the next scrape
         # re-dials instead of failing forever.
-        self._scrape_agent_conn: Agent | None = None
-        self._scrape_lock = threading.Lock()
+        self._scrape_conn = resilience.ConnCache(
+            lambda: Agent(self.agent_socket, timeout=2.0)
+        )
         # Gauge values are cached with a staleness bound so a wedged agent
         # adds at most ONE 2s stall per TTL to /metrics renders (not 2s per
         # series per scrape), and a scrape failure serves the last good
@@ -142,11 +144,11 @@ class Controller:
     def agent(self) -> Agent:
         """Lazy, auto-reconnecting agent connection (the reference connects
         to SPDK at New() time, controller.go:379-408; lazy lets the daemon
-        and controller start in any order)."""
-        with self._agent_lock:
-            if self._agent is None:
-                self._agent = Agent(self.agent_socket)
-            return self._agent
+        and controller start in any order).  The dial-outside-the-lock /
+        close-latch discipline lives in resilience.ConnCache: a wedged
+        daemon costs the dialing thread its socket timeout, never
+        close() or other RPC threads."""
+        return self._agent_cache.get()
 
     SCRAPE_CACHE_TTL = 10.0
 
@@ -181,34 +183,17 @@ class Controller:
 
     def _scrape(self, fn):
         """Run ``fn(agent)`` on the metrics-only connection, dropping it on
-        any failure so the next scrape starts from a fresh dial."""
+        any failure so the next scrape starts from a fresh dial (same
+        ConnCache discipline as ``agent()``: a wedged daemon costs this
+        scrape its 2s timeout, never close() or other renders)."""
         try:
-            with self._scrape_lock:
-                if self._scrape_agent_conn is None:
-                    self._scrape_agent_conn = Agent(self.agent_socket, timeout=2.0)
-                conn = self._scrape_agent_conn
-            return fn(conn)
+            return fn(self._scrape_conn.get())
         except BaseException:
-            self._drop_scrape_agent()
+            self._scrape_conn.drop()
             raise
 
-    def _drop_scrape_agent(self) -> None:
-        with self._scrape_lock:
-            if self._scrape_agent_conn is not None:
-                try:
-                    self._scrape_agent_conn.close()
-                except Exception:
-                    pass
-                self._scrape_agent_conn = None
-
     def _drop_agent(self) -> None:
-        with self._agent_lock:
-            if self._agent is not None:
-                try:
-                    self._agent.close()
-                except Exception:
-                    pass
-                self._agent = None
+        self._agent_cache.drop()
 
     def _call_agent(self, context, fn, *args, **kwargs):
         """Invoke an agent method, mapping transport failures to UNAVAILABLE
@@ -656,8 +641,11 @@ class Controller:
         if self._closed:
             return
         self._closed = True
-        self._drop_agent()
-        self._drop_scrape_agent()
+        # Latched: a dial that was in flight when close() ran is closed
+        # on arrival instead of installed (resilience.ConnCache), so
+        # shutdown cannot leak a late connection.
+        self._agent_cache.close()
+        self._scrape_conn.close()
         # Deregister the gauge series — but only if a newer controller
         # with the same id hasn't already taken them over.
         self._chips_gauge.remove(self.controller_id, fn=self._chips_cb)

@@ -62,8 +62,19 @@ class HealthReporter:
             if retry is not None
             else resilience.RetryPolicy.for_heartbeat(interval)
         )
-        self._agent: Agent | None = None
-        self._agent_lock = threading.Lock()
+        # One-shot dial policy: the scrape hop must stay bounded to ~one
+        # scrape_timeout per cycle (the reporter's own loop IS the retry
+        # — next interval, fresh dial); an env-default ladder here could
+        # outlast the whole beat.  ConnCache dials outside its lock, so
+        # close() never stalls behind a wedged daemon's connect, and
+        # latches on close so a late-landing dial cannot leak.
+        self._agent_cache = resilience.ConnCache(
+            lambda: Agent(
+                self.agent_socket,
+                timeout=self.scrape_timeout,
+                retry=resilience.RetryPolicy.one_shot(),
+            )
+        )
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._reports = metrics.registry().counter(
@@ -86,13 +97,15 @@ class HealthReporter:
         return self
 
     def close(self) -> None:
-        """Idempotent stop; joins the loop and drops the scrape connection."""
+        """Idempotent stop; joins the loop and drops the scrape connection
+        (latched — a dial in flight when close() ran is closed on
+        arrival, not installed)."""
         self._stop.set()
         thread = self._thread
         if thread is not None:
             thread.join(timeout=5)
             self._thread = None
-        self._drop_agent()
+        self._agent_cache.close()
 
     def _run(self) -> None:
         while True:
@@ -184,24 +197,7 @@ class HealthReporter:
         return len(chips)
 
     def _get_agent(self) -> Agent:
-        with self._agent_lock:
-            if self._agent is None:
-                # One-shot: the scrape hop must stay bounded to ~one
-                # scrape_timeout per cycle (the reporter's own loop IS
-                # the retry — next interval, fresh dial); an env-default
-                # ladder here could outlast the whole beat.
-                self._agent = Agent(
-                    self.agent_socket,
-                    timeout=self.scrape_timeout,
-                    retry=resilience.RetryPolicy.one_shot(),
-                )
-            return self._agent
+        return self._agent_cache.get()
 
     def _drop_agent(self) -> None:
-        with self._agent_lock:
-            if self._agent is not None:
-                try:
-                    self._agent.close()
-                except Exception:
-                    pass
-                self._agent = None
+        self._agent_cache.drop()

@@ -1,0 +1,115 @@
+"""Declarative registry write-authorization: ONE table, two consumers.
+
+The reference encoded who-may-set-what as inline conditionals
+(reference pkg/oim-registry/registry.go:100-109); as this repo grew
+health/, events/, serve/ and volumes/ keyspaces, those conditionals
+became the de-facto security policy of the whole control plane — and
+nothing machine-checked that every code path *writing* a key actually
+had a grant here.  This module makes the policy a data table:
+
+- ``Registry._check_set_allowed`` (registry.py) drives its allow/deny
+  decision off :func:`set_allowed`, so enforcement IS the table;
+- the ``authz-coverage`` pass of ``tools/oimlint`` cross-checks every
+  registry-write site in the tree against :data:`AUTHZ_GRANTS`, so a
+  new ``put`` path without a grant fails lint before it fails with
+  PERMISSION_DENIED in production.
+
+Pattern language (one segment per ``/``):
+
+- a literal segment matches itself;
+- ``*`` matches any single segment;
+- ``{id}`` matches the identity captured from the CN pattern's
+  ``{id}`` (e.g. CN ``controller.c7`` → ``{id}`` = ``c7``);
+- ``{cn}`` matches the peer's full CommonName;
+- the special path ``**`` matches everything (the admin grant).
+
+CN patterns are either a literal CN (``user.admin``), ``*`` (any
+authenticated peer), or ``<prefix>{id}`` (captures the identity).
+Stdlib-only and import-light on purpose: the lint pass loads it from
+an AST-scanning tool that must stay fast.
+"""
+
+from __future__ import annotations
+
+ADMIN_CN = "user.admin"
+CONTROLLER_CN_PREFIX = "controller."
+HOST_CN_PREFIX = "host."
+SERVE_CN_PREFIX = "serve."
+
+# (cn_pattern, path_pattern) — additive: any matching row allows the
+# write.  Least-privilege shape throughout: every component may touch
+# only its own subtree, so one compromised daemon cannot forge another
+# identity's address, health, discovery or flight-recorder history.
+AUTHZ_GRANTS: tuple[tuple[str, str], ...] = (
+    # The operator writes anything: drain/<cid> cordons,
+    # evictions/<vol> remap-clears, <cid>/pci defaults, test fixtures.
+    (ADMIN_CN, "**"),
+    # Any authenticated component may publish its OWN flight-recorder
+    # events (events/<cn>/<seq>, oim_tpu/common/events).
+    ("*", "events/{cn}/*"),
+    # A controller registers its own address and publishes its own
+    # chip-health telemetry — never drain/eviction marks (operator or
+    # registry-side monitor writes).
+    (CONTROLLER_CN_PREFIX + "{id}", "{id}/address"),
+    (CONTROLLER_CN_PREFIX + "{id}", "health/{id}/*"),
+    # A serving instance announces only its own discovery key.
+    (SERVE_CN_PREFIX + "{id}", "serve/{id}/address"),
+    # A node agent publishes its own multi-host rendezvous entry; any
+    # staging host may commit the volume's coordinator (the protocol
+    # lets only the sort-first one actually do it, but the registry
+    # cannot know the sort without reading volume state).
+    (HOST_CN_PREFIX + "{id}", "volumes/*/hosts/{id}"),
+    (HOST_CN_PREFIX + "{id}", "volumes/*/coordinator"),
+)
+
+_NO_MATCH = object()
+
+
+def _cn_identity(pattern: str, cn: str):
+    """The identity ``{id}`` captures for ``cn`` under ``pattern``, or
+    ``_NO_MATCH``.  Literal patterns and ``*`` capture no identity
+    (return None on match)."""
+    if pattern == "*":
+        return None
+    if "{id}" in pattern:
+        prefix = pattern[: pattern.index("{id}")]
+        if cn.startswith(prefix) and len(cn) > len(prefix):
+            return cn[len(prefix):]
+        return _NO_MATCH
+    return None if pattern == cn else _NO_MATCH
+
+
+def _path_matches(pattern: str, path: str, ident, cn: str) -> bool:
+    if pattern == "**":
+        return True
+    pat_segs = pattern.split("/")
+    segs = path.split("/")
+    if len(pat_segs) != len(segs):
+        return False
+    for pat, seg in zip(pat_segs, segs):
+        if pat == "*":
+            continue
+        if pat == "{id}":
+            if ident is None or seg != ident:
+                return False
+        elif pat == "{cn}":
+            if seg != cn:
+                return False
+        elif pat != seg:
+            return False
+    return True
+
+
+def set_allowed(cn: str | None, path: str) -> bool:
+    """May the peer named ``cn`` write ``path``?  ``cn is None`` means an
+    unauthenticated (insecure, e.g. test) server: no restrictions,
+    matching the reference's behavior without TLS configured."""
+    if cn is None:
+        return True
+    for cn_pattern, path_pattern in AUTHZ_GRANTS:
+        ident = _cn_identity(cn_pattern, cn)
+        if ident is _NO_MATCH:
+            continue
+        if _path_matches(path_pattern, path, ident, cn):
+            return True
+    return False

@@ -26,13 +26,15 @@ from oim_tpu.common.chancache import ChannelCache, RECONNECT_OPTIONS
 from oim_tpu.common.interceptors import LogServerInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.tlsconfig import TLSConfig, peer_common_name
+from oim_tpu.registry import authz
+from oim_tpu.registry.authz import (  # noqa: F401 (re-exported API)
+    ADMIN_CN,
+    CONTROLLER_CN_PREFIX,
+    HOST_CN_PREFIX,
+    SERVE_CN_PREFIX,
+)
 from oim_tpu.registry.db import MemRegistryDB, RegistryDB, _prefix_match
 from oim_tpu.spec import REGISTRY, oim_pb2
-
-ADMIN_CN = "user.admin"
-CONTROLLER_CN_PREFIX = "controller."
-HOST_CN_PREFIX = "host."
-SERVE_CN_PREFIX = "serve."
 
 _ident = lambda b: b
 
@@ -219,69 +221,32 @@ class Registry:
     def _check_set_allowed(self, path: str, context) -> None:
         """CN-based write authorization (≙ registry.go:100-109).
 
-        Unauthenticated (insecure server, e.g. tests) means no restrictions,
-        matching the reference's behavior without TLS configured.
+        The allow/deny decision is the declarative grant table in
+        oim_tpu/registry/authz.py — the same table the ``authz-coverage``
+        lint pass checks every write site against, so enforcement and the
+        static gate can never drift.  Unauthenticated (insecure server,
+        e.g. tests) means no restrictions, matching the reference's
+        behavior without TLS configured.  Only the denial *messages* live
+        here, phrased per identity class.
         """
         cn = peer_common_name(context)
-        if cn is None or cn == ADMIN_CN:
-            return
-        parts = path.split("/")
-        # Any authenticated component may publish its OWN flight-recorder
-        # events (events/<cn>/<seq>, oim_tpu/common/events) — the
-        # health/-shaped least-privilege rule: never another identity's
-        # subtree, so one compromised daemon cannot forge fleet history.
-        if len(parts) == 3 and parts[0] == "events" and parts[1] == cn:
+        if authz.set_allowed(cn, path):
             return
         if cn.startswith(CONTROLLER_CN_PREFIX):
             controller_id = cn[len(CONTROLLER_CN_PREFIX):]
-            if path == f"{controller_id}/address":
-                return
-            # A controller may also publish its OWN chip-health telemetry
-            # (health/<id>/<chip>, oim_tpu/health) — the same
-            # least-privilege shape as the address key: never another
-            # controller's subtree, never drain/eviction marks (those are
-            # operator/monitor writes).
-            parts = path.split("/")
-            if (
-                len(parts) == 3
-                and parts[0] == "health"
-                and parts[1] == controller_id
-            ):
-                return
             context.abort(
                 grpc.StatusCode.PERMISSION_DENIED,
                 f"{cn!r} may only set {controller_id}/address, "
                 f"health/{controller_id}/* or events/{cn}/*",
             )
         if cn.startswith(SERVE_CN_PREFIX):
-            # A serving instance may publish only its own discovery key
-            # (serve/<id>/address) — the controller least-privilege
-            # shape, applied to the inference data plane (serve/router.py).
             serve_id = cn[len(SERVE_CN_PREFIX):]
-            if path == f"serve/{serve_id}/address":
-                return
             context.abort(
                 grpc.StatusCode.PERMISSION_DENIED,
                 f"{cn!r} may only set serve/{serve_id}/address",
             )
         if cn.startswith(HOST_CN_PREFIX):
-            # A node agent may publish only its own multi-host rendezvous
-            # key (volumes/<vid>/hosts/<host_id>) — the same least-privilege
-            # shape as controllers setting only their own address.
             host_id = cn[len(HOST_CN_PREFIX):]
-            parts = path.split("/")
-            if (
-                len(parts) == 4
-                and parts[0] == "volumes"
-                and parts[2] == "hosts"
-                and parts[3] == host_id
-            ):
-                return
-            # Any staging host may commit the volume's coordinator (the
-            # protocol lets only the sort-first one actually do it, but the
-            # registry cannot know the sort without reading volume state).
-            if len(parts) == 3 and parts[0] == "volumes" and parts[2] == "coordinator":
-                return
             context.abort(
                 grpc.StatusCode.PERMISSION_DENIED,
                 f"{cn!r} may only set volumes/*/hosts/{host_id} "

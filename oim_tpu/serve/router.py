@@ -713,6 +713,18 @@ class Router:
         if self._http_thread.is_alive():
             self._httpd.shutdown()
         self._httpd.server_close()
+        # Join the loops before tearing down what they touch: an
+        # unjoined health/discover thread can fire one more probe or
+        # reconcile against the closed probe pool after stop() returns
+        # (and a stopped-then-restarted test registry would see a ghost
+        # watcher from the previous router).  Bounded: both loops
+        # observe _stop within one wait() tick and the watch call is
+        # already cancelled.
+        for thread in (
+            self._http_thread, self._health_thread, self._discover_thread
+        ):
+            if thread is not None and thread.is_alive():
+                thread.join(timeout=5)
         self._probe_pool.shutdown(wait=False, cancel_futures=True)
         with self._lock:
             # Cancelled futures never reach _probe_tracked's finally.

@@ -723,4 +723,10 @@ class ServeServer:
         self._stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
+        # Join the listener as well as the driver: shutdown() handshakes
+        # with serve_forever, but returning before the loop actually
+        # exits lets a quick rebind of the same port race the old
+        # listener (rolling-restart tests bind back-to-back).
+        if self._http_thread.is_alive():
+            self._http_thread.join(timeout=10)
         self._driver_thread.join(timeout=10)

@@ -1,0 +1,31 @@
+"""oimlint fixture: a controller-CN writer stepping outside its grants
+(see lock_bad.py for the ``oimlint-expect`` marker convention)."""
+
+
+class BadPublisher:
+    def __init__(self, controller_id, stub, oim_pb2):
+        self.controller_id = controller_id
+        self.stub = stub
+        self.oim_pb2 = oim_pb2
+
+    def publish(self, peer_id, chip):
+        # Writes ANOTHER controller's health subtree.
+        self.stub.SetValue(
+            self.oim_pb2.SetValueRequest(
+                value=self.oim_pb2.Value(  # oimlint-expect: authz-coverage
+                    path=f"health/{peer_id}/{chip}", value="FAILED"
+                )
+            ),
+            timeout=5,
+        )
+
+    def cordon(self):
+        # drain/ is operator-only: no controller grant.
+        self.stub.SetValue(
+            self.oim_pb2.SetValueRequest(
+                value=self.oim_pb2.Value(  # oimlint-expect: authz-coverage
+                    path=f"drain/{self.controller_id}", value="self-cordon"
+                )
+            ),
+            timeout=5,
+        )

@@ -1,0 +1,34 @@
+"""oimlint fixture: a controller-CN writer inside its grants."""
+
+PREFIX = "health"
+
+
+def health_key(cid, chip):
+    return f"{PREFIX}/{cid}/{chip}"
+
+
+class GoodPublisher:
+    def __init__(self, controller_id, stub, oim_pb2):
+        self.controller_id = controller_id
+        self.stub = stub
+        self.oim_pb2 = oim_pb2
+
+    def publish(self, chip):
+        self.stub.SetValue(
+            self.oim_pb2.SetValueRequest(
+                value=self.oim_pb2.Value(
+                    path=health_key(self.controller_id, chip), value="OK"
+                )
+            ),
+            timeout=5,
+        )
+
+    def register(self, address):
+        self.stub.SetValue(
+            self.oim_pb2.SetValueRequest(
+                value=self.oim_pb2.Value(
+                    path=f"{self.controller_id}/address", value=address
+                )
+            ),
+            timeout=5,
+        )

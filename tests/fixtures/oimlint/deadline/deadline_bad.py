@@ -1,0 +1,8 @@
+"""oimlint fixture: deadline-hygiene violations (see lock_bad.py for
+the ``oimlint-expect`` marker convention)."""
+
+
+def forgetful(channel, REGISTRY, request):
+    stub = REGISTRY.stub(channel)
+    stub.SetValue(request)  # oimlint-expect: deadline-hygiene
+    REGISTRY.stub(channel).GetValues(request)  # oimlint-expect: deadline-hygiene

@@ -1,0 +1,9 @@
+"""oimlint fixture: deadlines everywhere they belong."""
+
+
+def bounded(channel, REGISTRY, request, attempt):
+    stub = REGISTRY.stub(channel)
+    stub.SetValue(request, timeout=5)
+    REGISTRY.stub(channel).GetValues(request, timeout=attempt.clamped())
+    call = stub.WatchValues(request)  # streaming: exempt by contract
+    return call

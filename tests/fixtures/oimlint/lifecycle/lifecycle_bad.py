@@ -1,0 +1,33 @@
+"""oimlint fixture: resource-lifecycle violations (see lock_bad.py for
+the ``oimlint-expect`` marker convention)."""
+import socket
+import threading
+
+
+class LeakyLoop:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run)  # oimlint-expect: resource-lifecycle
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        pass  # forgot the join
+
+
+class NoTeardown:  # oimlint-expect: resource-lifecycle
+    def __init__(self):
+        self._sock = socket.socket()
+
+
+class ForgottenSocket:
+    def __init__(self):
+        self._sock = socket.socket()  # oimlint-expect: resource-lifecycle
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._thread.join(timeout=1)  # joins the thread, forgets the socket

@@ -1,0 +1,20 @@
+"""oimlint fixture: resource lifecycle done right."""
+import socket
+import threading
+
+
+class CleanLoop:
+    def __init__(self):
+        sock = socket.socket()
+        self._sock = sock
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+        self._sock.close()

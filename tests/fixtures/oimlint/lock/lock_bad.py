@@ -1,0 +1,30 @@
+"""oimlint fixture: lock-discipline violations (NOT imported by tests).
+
+``# oimlint-expect: <pass-id>`` marks the exact line a finding must
+anchor to; tests/test_oimlint.py compares findings against the markers.
+"""
+import threading
+import time
+
+
+class BadWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+        self.counter = 0
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self.counter += 1  # oimlint-expect: lock-discipline
+
+    def reset(self):
+        self.counter = 0  # oimlint-expect: lock-discipline
+
+    def slow_peek(self):
+        with self._lock:
+            time.sleep(1.0)  # oimlint-expect: lock-discipline
+            return self.counter

@@ -1,0 +1,28 @@
+"""oimlint fixture: the same shape, correctly guarded."""
+import threading
+import time
+
+
+class GoodWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+        self.counter = 0
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.counter += 1
+
+    def reset(self):
+        with self._lock:
+            self.counter = 0
+
+    def slow_peek(self):
+        time.sleep(1.0)  # blocking OUTSIDE the lock is fine
+        with self._lock:
+            return self.counter

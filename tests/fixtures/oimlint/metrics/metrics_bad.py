@@ -1,0 +1,8 @@
+"""oimlint fixture: metrics-hygiene violations (see lock_bad.py for
+the ``oimlint-expect`` marker convention)."""
+
+
+def register(registry):
+    registry.counter("requests_total", "Missing the oim_ prefix.")  # oimlint-expect: metrics
+    registry.gauge("oim_empty_help", "")  # oimlint-expect: metrics
+    registry.histogram("oim_no_help")  # oimlint-expect: metrics

@@ -1,0 +1,18 @@
+"""oimlint fixture: a tiny agent client for protocol-drift tests (see
+lock_bad.py for the ``oimlint-expect`` marker convention; ``mystery``
+is implemented-but-undocumented, ``not_served`` has no fake
+implementation AND no doc row, so its line carries two markers)."""
+
+
+class MiniClient:
+    def __init__(self, client):
+        self.client = client
+
+    def ping(self):
+        return self.client.invoke("ping")  # implemented + documented
+
+    def undocumented(self):
+        return self.client.invoke("mystery")  # oimlint-expect: protocol-drift
+
+    def vaporware(self):
+        return self.client.invoke("not_served")  # oimlint-expect: protocol-drift, protocol-drift

@@ -1,0 +1,10 @@
+"""oimlint fixture: a tiny fake agent for protocol-drift tests."""
+
+
+class MiniStore:
+    def handle(self, method, params):
+        if method == "ping":
+            return "pong"
+        if method == "mystery":  # oimlint-expect: protocol-drift
+            return 42
+        raise KeyError(method)

@@ -1,0 +1,31 @@
+"""oimlint fixture: violations suppressed by waiver comments (the one
+WITHOUT a waiver carries the ``oimlint-expect`` marker)."""
+import threading
+import time
+
+
+class IntentionallySerial:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def tick(self):
+        with self._lock:
+            # Serializing on purpose: fixture for the same-line waiver.
+            time.sleep(0.1)  # oimlint: disable=lock-discipline
+
+    def tock(self):
+        with self._lock:
+            # oimlint: disable=lock-discipline
+            time.sleep(0.2)
+
+    def unwaived(self):
+        with self._lock:
+            time.sleep(0.3)  # oimlint-expect: lock-discipline

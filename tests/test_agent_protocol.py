@@ -393,3 +393,16 @@ def test_fuzz_storm_never_kills_daemon(agent_socket):
     with Agent(agent_socket) as agent:
         chips = agent.get_chips()
         assert len(chips) == 8
+
+
+def test_stop_joins_accept_loop(tmp_path):
+    """stop() joins the accept loop (oimlint resource-lifecycle harvest):
+    returning while serve_forever is still winding down raced same-path
+    restarts into two servers briefly owning one socket path."""
+    store = ChipStore(mesh=(2, 1, 1), device_dir=str(tmp_path))
+    server = FakeAgentServer(store, str(tmp_path / "join.sock")).start()
+    thread = server._thread
+    assert thread is not None and thread.is_alive()
+    server.stop()
+    assert server._thread is None
+    assert not thread.is_alive()

@@ -319,3 +319,53 @@ def test_registration_heartbeat(agent_sock):
     finally:
         controller.close()
         reg_srv.stop()
+
+
+def test_wedged_agent_dial_never_blocks_close(tmp_path, monkeypatch):
+    """Controller.agent() dials outside the connection-cache lock
+    (oimlint lock-discipline harvest, resilience.ConnCache): a wedged
+    daemon costs the dialing thread its socket timeout, never close().
+    And close() latches: the dial that was in flight when close() ran
+    is closed on arrival, not installed — no leaked socket."""
+    import threading
+
+    from oim_tpu.controller import controller as controller_mod
+
+    entered = threading.Event()
+    release = threading.Event()
+    closed = []
+
+    class WedgedAgent:
+        def __init__(self, socket_path, **kwargs):
+            entered.set()
+            release.wait(timeout=10)
+
+        def close(self):
+            closed.append(self)
+
+    monkeypatch.setattr(controller_mod, "Agent", WedgedAgent)
+    controller = Controller("ctrl-lk", str(tmp_path / "none.sock"))
+
+    def dial():
+        try:
+            controller.agent()
+        except RuntimeError:
+            pass  # the latched cache refusing the late dial — expected
+
+    dialer = threading.Thread(target=dial, daemon=True)
+    dialer.start()
+    try:
+        assert entered.wait(timeout=5)
+        # close() must return promptly while the dial is still blocked.
+        t0 = time.monotonic()
+        controller.close()
+        assert time.monotonic() - t0 < 2, "close() stalled behind the dial"
+        assert not closed  # the wedged connection hasn't landed yet
+    finally:
+        release.set()
+        dialer.join(timeout=5)
+    # The late-landing connection was closed on arrival, not leaked ...
+    assert len(closed) == 1
+    # ... and the latched cache refuses to dial again.
+    with pytest.raises(RuntimeError, match="closed"):
+        controller.agent()

@@ -191,6 +191,53 @@ class TestHealthReporter:
         reporter.close()
         reporter.close()
 
+    def test_wedged_scrape_dial_never_blocks_close(
+        self, tmp_path, monkeypatch
+    ):
+        """_get_agent dials outside the connection-cache lock (oimlint
+        lock-discipline harvest, resilience.ConnCache): a wedged daemon
+        must cost a scrape its timeout, never stall close().  close()
+        latches, so the dial in flight is closed on arrival — no leak."""
+        import threading
+
+        from oim_tpu.health import reporter as reporter_mod
+
+        entered = threading.Event()
+        release = threading.Event()
+        closed = []
+
+        class WedgedAgent:
+            def __init__(self, *args, **kwargs):
+                entered.set()
+                release.wait(timeout=10)
+
+            def close(self):
+                closed.append(self)
+
+        monkeypatch.setattr(reporter_mod, "Agent", WedgedAgent)
+        reporter = HealthReporter(
+            "h-lk", str(tmp_path / "none.sock"), "tcp://127.0.0.1:1"
+        )
+        def dial():
+            try:
+                reporter._get_agent()
+            except RuntimeError:
+                pass  # the latched cache refusing the late dial
+
+        dialer = threading.Thread(target=dial, daemon=True)
+        dialer.start()
+        try:
+            assert entered.wait(timeout=5)
+            t0 = time.monotonic()
+            reporter.close()
+            assert time.monotonic() - t0 < 2, "close() stalled behind dial"
+            assert not closed
+        finally:
+            release.set()
+            dialer.join(timeout=5)
+        # Closed on arrival, not installed into the closed cache.
+        assert len(closed) == 1
+
 
 # ---------------------------------------------------------------------------
 # Registry side: FleetMonitor + EvictionEngine (pure-DB, no gRPC)

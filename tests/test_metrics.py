@@ -220,7 +220,7 @@ def test_chip_gauges_survive_agent_restart(tmp_path):
         # established connection — do that part ourselves.
         import socket as socketlib
 
-        controller._scrape_agent_conn.client._sock.shutdown(socketlib.SHUT_RDWR)
+        controller._scrape_conn.peek().client._sock.shutdown(socketlib.SHUT_RDWR)
         _expire_cache(controller)  # force past the TTL, keep last-good
         # Stale value served; staleness is visible via the error counter.
         assert total.value("restart-host") == 2

@@ -1,0 +1,324 @@
+"""oimvet analyzer tests: fixture snippets per pass + live-tree gates.
+
+Fixture files under ``tests/fixtures/oimlint/`` carry
+``# oimlint-expect: <pass-id>`` markers on the exact line each finding
+must anchor to (two comma-separated ids when one line yields two
+findings); every per-pass test runs ONE pass over ONE fixture directory
+and requires the findings to equal the markers exactly — same files,
+same lines, same pass ids, nothing extra.  Known-good twins live in the
+same directories, so "no finding on the clean variant" is part of the
+same equality.
+
+The live-tree tests are the gate the Makefile ships: the real
+``oim_tpu`` tree must be clean against the checked-in baseline (and the
+baseline must carry no stale entries), and the CLI must exit nonzero
+the moment a violation exists.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from tools.oimlint import core, runner
+from tools.oimlint.core import Finding, SourceTree
+from tools.oimlint.passes import ALL_PASSES, authz, metricspass, protocol
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "oimlint")
+
+# Matches both Python (#) and markdown (<!-- -->) marker comments.
+_EXPECT_RE = re.compile(
+    r"(?:#|<!--)\s*oimlint-expect:\s*"
+    r"([a-z][a-z0-9-]*(?:\s*,\s*[a-z][a-z0-9-]*)*)"
+)
+
+
+def expected_markers(sub: str) -> dict[tuple[str, int], list[str]]:
+    """{(rel_file, line): sorted pass ids} from oimlint-expect markers."""
+    root = os.path.join(FIXTURES, sub)
+    out: dict[tuple[str, int], list[str]] = {}
+    for name in sorted(os.listdir(root)):
+        path = os.path.join(root, name)
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                m = _EXPECT_RE.search(line)
+                if m:
+                    out[(name, lineno)] = sorted(
+                        p.strip() for p in m.group(1).split(",") if p.strip()
+                    )
+    assert out, f"fixture dir {sub!r} has no oimlint-expect markers"
+    return out
+
+
+def fixture_tree(sub: str) -> SourceTree:
+    return SourceTree(repo=os.path.join(FIXTURES, sub), roots=(".",))
+
+
+def by_location(findings) -> dict[tuple[str, int], list[str]]:
+    out: dict[tuple[str, int], list[str]] = {}
+    for f in findings:
+        out.setdefault((f.file, f.line), []).append(f.pass_id)
+    return {k: sorted(v) for k, v in out.items()}
+
+
+class TestPassesOnFixtures:
+    """Each pass against its known-bad/known-good snippets: findings
+    must equal the expect markers exactly (pass id + file + line)."""
+
+    def test_lock_discipline(self):
+        found = runner.run_passes(fixture_tree("lock"), ["lock-discipline"])
+        assert by_location(found) == expected_markers("lock")
+
+    def test_resource_lifecycle(self):
+        found = runner.run_passes(
+            fixture_tree("lifecycle"), ["resource-lifecycle"]
+        )
+        assert by_location(found) == expected_markers("lifecycle")
+
+    def test_deadline_hygiene(self):
+        found = runner.run_passes(
+            fixture_tree("deadline"), ["deadline-hygiene"]
+        )
+        assert by_location(found) == expected_markers("deadline")
+
+    def test_metrics(self):
+        # Fixture repo != real repo, so the runtime-registry sub-check
+        # self-disables and only the AST scan runs.
+        found = metricspass.run(fixture_tree("metrics"))
+        assert by_location(found) == expected_markers("metrics")
+
+    def test_authz_coverage(self):
+        """Fixture writers run as controller CNs against the REAL grant
+        table: stepping outside health/{id}/* + {id}/address is drift."""
+        writer = authz.Writer("controller.{id}", ("self.controller_id",))
+        found = authz.run(
+            fixture_tree("authz"),
+            writers={"writer_bad.py": writer, "writer_good.py": writer},
+        )
+        assert by_location(found) == expected_markers("authz")
+
+    def test_protocol_drift(self):
+        found = protocol.run(
+            fixture_tree("protocol"),
+            client_files=("mini_client.py",),
+            fake_file="mini_fake.py",
+            doc_file="mini_doc.md",
+        )
+        assert by_location(found) == expected_markers("protocol")
+
+    def test_authz_mutually_recursive_forwarders_dont_crash(self, tmp_path):
+        """Path parameters forwarded in a cycle must resolve to an
+        'unresolvable' finding via the depth cap, never a RecursionError
+        that kills the whole lint run."""
+        (tmp_path / "loop.py").write_text(
+            '"""tmp fixture."""\n'
+            "def _put(stub, oim_pb2, path, n):\n"
+            "    if n:\n"
+            "        return _retry_put(stub, oim_pb2, path, n - 1)\n"
+            "    stub.SetValue(oim_pb2.SetValueRequest(\n"
+            "        value=oim_pb2.Value(path=path, value='x')), timeout=5)\n"
+            "def _retry_put(stub, oim_pb2, path, n):\n"
+            "    return _put(stub, oim_pb2, path, n)\n"
+        )
+        tree = SourceTree(repo=str(tmp_path), roots=(".",))
+        found = authz.run(
+            tree, writers={"loop.py": authz.Writer("controller.{id}")}
+        )
+        assert found and all(
+            "unresolvable" in f.message for f in found
+        )
+
+    def test_authz_unknown_writer_is_a_finding(self):
+        """A registry write in a module with no WRITERS entry must be
+        flagged — new writers are declared deliberately, not silently."""
+        found = authz.run(fixture_tree("authz"), writers={})
+        assert found and all(
+            "no WRITERS entry" in f.message for f in found
+        )
+        assert {f.file for f in found} == {"writer_bad.py", "writer_good.py"}
+
+
+class TestWaivers:
+    def test_waiver_same_line_and_line_above(self):
+        """Both waiver placements suppress; the unwaived sibling still
+        fires — exactly the one expect marker in the fixture."""
+        found = runner.run_passes(fixture_tree("waiver"), ["lock-discipline"])
+        assert by_location(found) == expected_markers("waiver")
+
+    def test_disable_all(self, tmp_path):
+        src = (
+            '"""tmp fixture."""\n'
+            "def f(stub, req):\n"
+            "    stub.SetValue(req)  # oimlint: disable=all\n"
+        )
+        (tmp_path / "snippet.py").write_text(src)
+        tree = SourceTree(repo=str(tmp_path), roots=(".",))
+        assert runner.run_passes(tree, ["deadline-hygiene"]) == []
+
+    def test_unparseable_file_is_a_finding(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def f(:\n")
+        tree = SourceTree(repo=str(tmp_path), roots=(".",))
+        found = runner.run_passes(tree, ["deadline-hygiene"])
+        assert [f.pass_id for f in found] == ["parse"]
+        assert "unparseable" in found[0].message
+
+
+class TestBaseline:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "baseline.txt")
+        findings = [
+            Finding("lock-discipline", "a.py", 10, "msg one"),
+            Finding("metrics", "b.py", 3, "msg two"),
+        ]
+        core.write_baseline(path, findings)
+        assert core.load_baseline(path) == {f.key() for f in findings}
+        # Comments and blanks are ignored; a missing file is empty.
+        assert core.load_baseline(str(tmp_path / "absent.txt")) == set()
+
+    def test_keys_are_line_number_free(self):
+        """An edit that shifts a grandfathered finding must not break
+        the gate: the key has no line number in it."""
+        a = Finding("metrics", "a.py", 10, "same message")
+        b = Finding("metrics", "a.py", 99, "same message")
+        assert a.key() == b.key()
+
+    def test_gate_splits_new_and_stale(self):
+        known = Finding("metrics", "a.py", 1, "grandfathered")
+        fresh = Finding("metrics", "a.py", 2, "brand new")
+        baseline = {known.key(), "metrics gone.py: since fixed"}
+        new, stale = runner.gate([known, fresh], baseline)
+        assert new == [fresh]
+        assert stale == {"metrics gone.py: since fixed"}
+
+    def test_baseline_suppresses_fixture_findings(self):
+        findings = runner.run_passes(fixture_tree("lock"), ["lock-discipline"])
+        assert findings  # the fixture is known-bad
+        new, stale = runner.gate(findings, {f.key() for f in findings})
+        assert new == [] and stale == set()
+
+
+class TestLiveTree:
+    """The gates `make lint` actually runs, in-process."""
+
+    def test_real_tree_is_clean_against_baseline(self):
+        findings = runner.run_passes()
+        baseline = core.load_baseline(core.DEFAULT_BASELINE)
+        new, stale = runner.gate(findings, baseline)
+        assert not new, "new findings:\n" + "\n".join(
+            f.render() for f in new
+        )
+        assert not stale, f"stale baseline entries (run --update-baseline): {stale}"
+
+    def test_all_six_passes_registered(self):
+        assert set(ALL_PASSES) == {
+            "lock-discipline",
+            "resource-lifecycle",
+            "authz-coverage",
+            "protocol-drift",
+            "deadline-hygiene",
+            "metrics",
+        }
+
+    def test_protocol_sources_nonempty(self):
+        """The three protocol sources of truth must all parse non-empty
+        on the real tree — an empty side would make the drift diff
+        vacuously green."""
+        tree = SourceTree()
+        used = protocol._invoked_methods(tree, protocol.CLIENT_FILES)
+        implemented = protocol._implemented_methods(tree, protocol.FAKE_FILE)
+        documented = protocol._documented_methods(tree, protocol.DOC_FILE)
+        assert used and implemented and documented
+        # Spot-check the core verbs every daemon must serve.
+        for name in ("get_chips", "create_allocation", "delete_allocation"):
+            assert name in implemented and name in documented
+
+
+class TestCLI:
+    def test_unknown_pass_rejected(self):
+        with pytest.raises(SystemExit, match="unknown pass"):
+            runner.run_passes(fixture_tree("lock"), ["no-such-pass"])
+
+    def test_list_passes(self, capsys):
+        assert runner.main(["--list-passes"]) == 0
+        out = capsys.readouterr().out
+        for pass_id in ALL_PASSES:
+            assert pass_id in out
+
+    def test_pass_subset_keeps_foreign_baseline_entries(
+        self, tmp_path, capsys
+    ):
+        """--passes metrics must not report the authz baseline entry as
+        stale: the baseline is scoped to the passes that ran."""
+        baseline = str(tmp_path / "baseline.txt")
+        with open(baseline, "w") as f:
+            f.write("authz-coverage x.py: some grandfathered finding\n")
+        assert (
+            runner.main(["--passes", "metrics", "--baseline", baseline]) == 0
+        )
+        assert "no longer found" not in capsys.readouterr().out
+
+    def test_cli_exit_zero_on_clean_baseline(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.oimlint", "-q"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_cli_exit_nonzero_on_violation(self):
+        """Pointed at a known-bad fixture tree, the same CLI trips."""
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "tools.oimlint",
+                "--repo",
+                os.path.join(FIXTURES, "lock"),
+                "--roots",
+                ".",
+                "--passes",
+                "lock-discipline",
+                "--no-baseline",
+                "-q",
+            ],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "lock-discipline" in proc.stdout
+
+    def test_update_baseline_then_clean(self, tmp_path):
+        """--update-baseline on a dirty tree grandfathers everything;
+        the very next gate run is green."""
+        baseline = str(tmp_path / "baseline.txt")
+        args = [
+            "--repo", os.path.join(FIXTURES, "lock"),
+            "--roots", ".",
+            "--passes", "lock-discipline",
+            "--baseline", baseline,
+            "-q",
+        ]
+        assert runner.main(args) == 1
+        assert runner.main(args + ["--update-baseline"]) == 0
+        assert core.load_baseline(baseline)
+        assert runner.main(args) == 0
+
+    def test_check_metrics_alias(self):
+        """tools/check_metrics.py stays a working entry point (thin
+        alias over the metrics pass) so `make lint-metrics` and older
+        docs keep functioning."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join("tools", "check_metrics.py")],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -864,3 +864,104 @@ def test_flaky_channel_fail_next_is_deterministic(fleet):
             with pytest.raises(grpc.RpcError):
                 stub.GetValues(request, timeout=5)
         assert stub.GetValues(request, timeout=5).values
+
+
+class TestConnCache:
+    """The shared dial-outside-the-lock discipline (resilience.ConnCache)
+    behind Controller.agent/_scrape and HealthReporter._get_agent."""
+
+    class FakeConn:
+        def __init__(self):
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    def test_caches_one_dial(self):
+        dials = []
+
+        def dial():
+            conn = self.FakeConn()
+            dials.append(conn)
+            return conn
+
+        cache = resilience.ConnCache(dial)
+        assert cache.get() is cache.get()
+        assert len(dials) == 1
+
+    def test_drop_rediales_and_closes_old(self):
+        cache = resilience.ConnCache(self.FakeConn)
+        first = cache.get()
+        cache.drop()
+        assert first.closed
+        assert cache.get() is not first
+
+    def test_racing_dialers_loser_closed(self):
+        """Two threads dial concurrently: exactly one connection is
+        installed and the loser's is closed, with the dial itself never
+        run under the cache lock (a wedged dial can't serialize)."""
+        barrier = threading.Barrier(2, timeout=10)
+        dials = []
+
+        def dial():
+            conn = self.FakeConn()
+            dials.append(conn)
+            barrier.wait()  # both dials in flight at once
+            return conn
+
+        cache = resilience.ConnCache(dial)
+        results = []
+        threads = [
+            threading.Thread(target=lambda: results.append(cache.get()))
+            for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(dials) == 2
+        assert results[0] is results[1]
+        assert sum(c.closed for c in dials) == 1
+        assert not results[0].closed
+
+    def test_close_latches_late_dial(self):
+        """A dial in flight when close() runs is closed on arrival and
+        never installed; later get() raises instead of re-dialing."""
+        entered = threading.Event()
+        release = threading.Event()
+        dials = []
+
+        def dial():
+            conn = self.FakeConn()
+            dials.append(conn)
+            entered.set()
+            release.wait(timeout=10)
+            return conn
+
+        cache = resilience.ConnCache(dial)
+        errors = []
+
+        def get():
+            try:
+                cache.get()
+            except RuntimeError as exc:
+                errors.append(exc)
+
+        dialer = threading.Thread(target=get, daemon=True)
+        dialer.start()
+        assert entered.wait(timeout=5)
+        cache.close()  # returns promptly: the dial holds no cache lock
+        assert not dials[0].closed  # not landed yet
+        release.set()
+        dialer.join(timeout=5)
+        assert dials[0].closed  # closed on arrival, not leaked
+        assert len(errors) == 1
+        with pytest.raises(RuntimeError, match="closed"):
+            cache.get()
+
+    def test_close_idempotent(self):
+        cache = resilience.ConnCache(self.FakeConn)
+        conn = cache.get()
+        cache.close()
+        cache.close()
+        assert conn.closed

@@ -744,3 +744,30 @@ def test_chat_completions_affinity_key():
         assert len(picks) == 1
     finally:
         router.stop()
+
+
+def test_stop_joins_loop_threads():
+    """Router.stop() joins its loops (oimlint resource-lifecycle
+    harvest): an unjoined health thread could fire one more probe into
+    the already-shutdown probe pool after stop() returned, and a
+    stopped-then-restarted registry would see a ghost watcher."""
+    router = Router(backends=("http://a:1",)).start()
+    router.stop()
+    assert not router._http_thread.is_alive()
+    assert not router._health_thread.is_alive()
+    assert router._discover_thread is None  # static backends: no watcher
+
+
+def test_serve_stop_joins_listener():
+    """ServeServer.stop() joins the HTTP listener as well as the driver
+    (oimlint resource-lifecycle harvest): shutdown() handshakes with
+    serve_forever, but returning before the loop actually exits raced
+    back-to-back rebinds of the same port in rolling restarts."""
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = ServeServer(
+        Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+    ).start()
+    server.stop()
+    assert not server._http_thread.is_alive()
+    assert not server._driver_thread.is_alive()

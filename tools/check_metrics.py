@@ -1,112 +1,24 @@
 #!/usr/bin/env python3
-"""Metrics lint: every registered series must be ``oim_``-prefixed with
-non-empty HELP.
+"""Thin alias: the metrics lint is now oimlint's ``metrics`` pass.
 
-Two passes, both fast and stdlib-only:
+Kept so existing workflows (``make lint-metrics``, scripts invoking
+``tools/check_metrics.py`` directly) don't break; the implementation —
+the same AST source scan plus runtime-registry check — lives in
+``tools/oimlint/passes/metricspass.py`` so there is ONE analyzer (see
+doc/development.md "The oimvet static analyzer").
 
-1. **Source scan** (AST): every ``.counter("name", "help", ...)`` /
-   ``.gauge(...)`` / ``.histogram(...)`` call under ``oim_tpu/`` whose
-   name is a string literal is checked — this catches instruments
-   registered at instance-construction time, which a runtime import can
-   never see.
-2. **Runtime check**: import the always-importable metrics-defining
-   modules (no jax required) and validate what actually landed in the
-   process registry — this catches dynamically built names the AST pass
-   skips.
-
-Exit 1 with one line per violation; silent success otherwise.  Invoked
-by ``make lint-metrics``.
+Exit 1 with one line per violation; exit 0 otherwise — same contract
+as before the fold.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "oim_tpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-REGISTER_METHODS = {"counter", "gauge", "histogram"}
-
-
-def scan_file(path: str) -> list[str]:
-    with open(path) as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        return [f"{path}: unparseable: {exc}"]
-    rel = os.path.relpath(path, REPO)
-    problems: list[str] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        if not (isinstance(func, ast.Attribute) and func.attr in REGISTER_METHODS):
-            continue
-        if not node.args:
-            continue
-        name_node = node.args[0]
-        if not (isinstance(name_node, ast.Constant) and isinstance(name_node.value, str)):
-            continue  # dynamic name: left to the runtime pass
-        name = name_node.value
-        where = f"{rel}:{node.lineno}"
-        if not name.startswith("oim_"):
-            problems.append(
-                f"{where}: series {name!r} is not 'oim_'-prefixed"
-            )
-        help_node = node.args[1] if len(node.args) > 1 else None
-        if isinstance(help_node, ast.Constant) and isinstance(help_node.value, str):
-            if not help_node.value.strip():
-                problems.append(f"{where}: series {name!r} has empty HELP")
-        elif isinstance(help_node, ast.JoinedStr):
-            pass  # f-string help: non-empty by construction
-        elif help_node is None and "help_" not in {
-            kw.arg for kw in node.keywords
-        }:
-            problems.append(f"{where}: series {name!r} has no HELP argument")
-    return problems
-
-
-def scan_sources() -> list[str]:
-    problems: list[str] = []
-    for root, _dirs, files in os.walk(PACKAGE):
-        if os.path.basename(root) == "gen":
-            continue  # generated proto bindings
-        for name in sorted(files):
-            if name.endswith(".py"):
-                problems.extend(scan_file(os.path.join(root, name)))
-    return problems
-
-
-def check_runtime() -> list[str]:
-    sys.path.insert(0, REPO)
-    # The jax-free metrics definers; jax-importing modules (data,
-    # checkpoint, serve engine) are covered by the source scan.
-    import oim_tpu.common.events  # noqa: F401
-    import oim_tpu.common.metrics as metrics
-    import oim_tpu.common.resilience  # noqa: F401
-    import oim_tpu.common.tracing  # noqa: F401
-
-    problems: list[str] = []
-    for name, metric in sorted(metrics.registry()._metrics.items()):
-        if not name.startswith("oim_"):
-            problems.append(f"runtime registry: series {name!r} not 'oim_'-prefixed")
-        if not str(getattr(metric, "help", "")).strip():
-            problems.append(f"runtime registry: series {name!r} has empty HELP")
-    return problems
-
-
-def main() -> int:
-    problems = scan_sources() + check_runtime()
-    for problem in problems:
-        print(problem)
-    if problems:
-        print(f"lint-metrics: {len(problems)} problem(s)")
-        return 1
-    return 0
-
+from tools.oimlint.runner import main  # noqa: E402
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    raise SystemExit(main(["--passes", "metrics", "--quiet"]))

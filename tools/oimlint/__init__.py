@@ -1,0 +1,19 @@
+"""oimvet — the OIM-TPU control-plane static analyzer.
+
+``python -m tools.oimlint`` / ``make lint`` runs six AST-level passes
+over ``oim_tpu/`` (lock-discipline, resource-lifecycle, authz-coverage,
+protocol-drift, deadline-hygiene, metrics) and fails on any finding
+that is neither waived in code (``# oimlint: disable=<pass>``) nor
+grandfathered in ``tools/oimlint/baseline.txt``.  See
+doc/development.md "The oimvet static analyzer".
+"""
+
+from tools.oimlint.core import (  # noqa: F401
+    DEFAULT_BASELINE,
+    Finding,
+    SourceTree,
+    apply_waivers,
+    load_baseline,
+    write_baseline,
+)
+from tools.oimlint.runner import gate, main, run_passes  # noqa: F401

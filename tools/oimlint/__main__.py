@@ -1,0 +1,15 @@
+"""``python -m tools.oimlint`` entry point (also ``make lint``)."""
+
+import os
+import sys
+
+# Runnable from anywhere: the repo root is two levels up.
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+from tools.oimlint.runner import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

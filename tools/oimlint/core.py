@@ -1,0 +1,220 @@
+"""oimvet core: Finding, source-tree cache, waivers, baseline.
+
+The framework half of ``tools/oimlint`` (the passes live under
+``tools/oimlint/passes``).  Design mirrors the reference's compiler-grade
+CI gates (``go vet`` + the spec↔proto drift diff, reference
+Makefile:85-103) translated to a Python control plane:
+
+- every pass walks the **AST** (plus two documents: the agent protocol
+  spec and the authz grant table), so the gate needs no accelerators, no
+  network, and finishes well inside the 30 s ``make lint`` budget;
+- findings are suppressed either **in code** (a
+  ``# oimlint: disable=<pass>`` comment on the offending line or the
+  line above — for violations that are *intentional and documented*) or
+  **in the checked-in baseline** (``tools/oimlint/baseline.txt`` — for
+  grandfathered findings that should be burned down over time).  The
+  gate fails only on findings that are in neither set, so it can be
+  adopted on an imperfect tree and still catch every NEW violation.
+
+Baseline keys deliberately omit line numbers: an unrelated edit that
+shifts a grandfathered finding must not break the gate.  The message
+text (which names the class/attribute/method/pattern involved) is the
+stable identity.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ERROR = "error"
+WARNING = "warning"
+
+_WAIVER_RE = re.compile(r"#\s*oimlint:\s*disable=([\w\-, ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation: ``file:line``, the pass that found it, a message."""
+
+    pass_id: str
+    file: str  # repo-relative, '/'-separated
+    line: int
+    message: str
+    severity: str = ERROR
+
+    def key(self) -> str:
+        """Line-number-free identity used for baseline matching."""
+        return f"{self.pass_id} {self.file}: {self.message}"
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+@dataclass
+class SourceTree:
+    """Parsed-AST cache over the scanned tree.
+
+    ``roots`` are repo-relative directories walked for ``*.py`` (the
+    generated proto bindings under ``spec/gen`` are skipped); any other
+    repo-relative file (docs, fixtures) is reachable through
+    :meth:`text` / :meth:`tree` on demand, which is how the
+    protocol-drift pass reads ``doc/agent-protocol.md`` and how tests
+    point passes at fixture snippets.
+    """
+
+    repo: str = REPO
+    roots: tuple[str, ...] = ("oim_tpu",)
+    _files: list[str] | None = None
+    _sources: dict = field(default_factory=dict)
+    _trees: dict = field(default_factory=dict)
+    parse_errors: list[Finding] = field(default_factory=list)
+
+    def files(self) -> list[str]:
+        if self._files is None:
+            out = []
+            for root in self.roots:
+                base = os.path.join(self.repo, root)
+                for dirpath, dirnames, filenames in os.walk(base):
+                    dirnames[:] = [
+                        d for d in sorted(dirnames)
+                        if d not in ("__pycache__", "gen")
+                    ]
+                    for name in sorted(filenames):
+                        if name.endswith(".py"):
+                            rel = os.path.relpath(
+                                os.path.join(dirpath, name), self.repo
+                            )
+                            out.append(rel.replace(os.sep, "/"))
+            self._files = out
+        return self._files
+
+    def text(self, rel: str) -> str:
+        if rel not in self._sources:
+            with open(os.path.join(self.repo, rel)) as f:
+                self._sources[rel] = f.read()
+        return self._sources[rel]
+
+    def lines(self, rel: str) -> list[str]:
+        return self.text(rel).splitlines()
+
+    def tree(self, rel: str) -> ast.Module | None:
+        if rel not in self._trees:
+            try:
+                self._trees[rel] = ast.parse(self.text(rel), filename=rel)
+            except SyntaxError as exc:
+                self._trees[rel] = None
+                self.parse_errors.append(
+                    Finding("parse", rel, exc.lineno or 0, f"unparseable: {exc}")
+                )
+        return self._trees[rel]
+
+
+def waived_passes(tree: SourceTree, rel: str, line: int) -> set[str]:
+    """Pass ids disabled at ``rel:line`` by a waiver comment on that line
+    or the line above (``# oimlint: disable=pass-a,pass-b`` / ``=all``)."""
+    out: set[str] = set()
+    lines = tree.lines(rel)
+    for idx in (line - 1, line - 2):  # the line itself, then the one above
+        if 0 <= idx < len(lines):
+            m = _WAIVER_RE.search(lines[idx])
+            if m:
+                out.update(p.strip() for p in m.group(1).split(","))
+    return out
+
+
+def apply_waivers(
+    tree: SourceTree, findings: list[Finding]
+) -> tuple[list[Finding], list[Finding]]:
+    """Split findings into (kept, waived) by in-code waiver comments."""
+    kept: list[Finding] = []
+    waived: list[Finding] = []
+    for finding in findings:
+        try:
+            disabled = waived_passes(tree, finding.file, finding.line)
+        except OSError:
+            disabled = set()
+        if finding.pass_id in disabled or "all" in disabled:
+            waived.append(finding)
+        else:
+            kept.append(finding)
+    return kept, waived
+
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.txt")
+
+
+def load_baseline(path: str) -> set[str]:
+    """Baseline keys from ``path``; a missing file is an empty baseline."""
+    try:
+        with open(path) as f:
+            return {
+                line.strip()
+                for line in f
+                if line.strip() and not line.lstrip().startswith("#")
+            }
+    except FileNotFoundError:
+        return set()
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    keys = sorted({f.key() for f in findings})
+    with open(path, "w") as f:
+        f.write(
+            "# oimlint baseline: grandfathered findings (the gate fails only\n"
+            "# on findings NOT listed here).  Regenerate with\n"
+            "#   python -m tools.oimlint --update-baseline\n"
+            "# after fixing entries; never add new violations here without\n"
+            "# a review — prefer fixing, or an in-code waiver comment with\n"
+            "# a justification.  Keys are line-number-free on purpose.\n"
+        )
+        for key in keys:
+            f.write(key + "\n")
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Dotted name of a call's callee (``threading.Thread`` for
+    ``threading.Thread(...)``), else None."""
+    return dotted(node.func)
+
+
+def keyword_arg(node: ast.Call, name: str) -> ast.expr | None:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def class_methods(cls: ast.ClassDef) -> dict[str, ast.FunctionDef]:
+    return {
+        node.name: node
+        for node in cls.body
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def module_classes(tree: ast.Module):
+    """Top-level classes plus classes nested in top-level functions/classes
+    (the fake agent defines handler classes inside ``__init__``)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            yield node

@@ -1,0 +1,27 @@
+"""oimvet pass registry.  A pass module exports ``PASS_ID``,
+``DESCRIPTION`` and ``run(tree: SourceTree) -> list[Finding]``; adding a
+pass = adding a module here and one line to ``ALL_PASSES`` (see
+doc/development.md "The oimvet static analyzer")."""
+
+from __future__ import annotations
+
+from tools.oimlint.passes import (
+    authz,
+    deadline,
+    lifecycle,
+    lockdiscipline,
+    metricspass,
+    protocol,
+)
+
+ALL_PASSES = {
+    mod.PASS_ID: mod
+    for mod in (
+        lockdiscipline,
+        lifecycle,
+        authz,
+        protocol,
+        deadline,
+        metricspass,
+    )
+}

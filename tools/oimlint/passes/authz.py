@@ -1,0 +1,478 @@
+"""authz-coverage: every registry write path must have an authz grant.
+
+The registry's write authorization is the declarative table
+``oim_tpu.registry.authz.AUTHZ_GRANTS`` (which also drives enforcement,
+so it cannot drift from the server).  This pass finds every *write
+site* in the tree — ``SetValue`` payload construction
+(``oim_pb2.Value(path=..., ...)``) and registry-embedded direct stores
+(``self.db.store(path, ...)``) — resolves the path expression into a
+segment pattern, and checks it against the grants for the identity the
+writing module runs as.  A new ``put`` path without a grant fails lint
+before it fails with PERMISSION_DENIED in production.
+
+Path resolution (all static, tuned to this tree's idioms):
+
+- f-strings: interpolations of the writer's own-identity expression
+  (e.g. ``self.controller_id``) become ``{own}``; anything else becomes
+  ``*`` (one segment);
+- ``Name`` parts resolve through local ``x = "..."``/``x = f"..."``
+  assignments and module-level string constants;
+- calls to key-helper functions (``states.health_key(...)``,
+  ``event_key(...)``, ``hosts_path(...)``) are inlined — including
+  across modules resolved via the importing file's ``import``
+  statements — with call arguments substituted for parameters;
+- a path that is a bare function *parameter* (``def _set(channel,
+  path, value)``) is resolved at that function's call sites instead.
+
+Writers are declared in :data:`WRITERS` below: the CN template the
+module authenticates as and which expressions are its own identity.
+``ADMIN`` writers (operator CLI) match the admin ``**`` grant;
+``REGISTRY_SIDE`` writers run inside the registry process and store
+directly into the DB below the authz layer.  A registry write in a
+module with no entry is itself a finding — add the writer (and a
+grant) deliberately, not by accident.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.oimlint.core import Finding, SourceTree, dotted
+
+PASS_ID = "authz-coverage"
+DESCRIPTION = "registry write paths must match an AUTHZ_GRANTS row"
+
+OWN = "{own}"
+STAR = "*"
+UNKNOWN = "{?}"
+
+ADMIN = "admin"
+REGISTRY_SIDE = "registry-side"
+
+
+class Writer:
+    def __init__(self, cn: str, own: tuple[str, ...] = ()):
+        self.cn = cn  # CN template ("controller.{id}") or ADMIN/REGISTRY_SIDE
+        self.own = set(own)  # dotted exprs that denote the writer's identity
+
+
+# Module → the identity its TLS client cert carries when it writes.
+WRITERS: dict[str, Writer] = {
+    "oim_tpu/controller/controller.py": Writer(
+        "controller.{id}", ("self.controller_id",)
+    ),
+    "oim_tpu/health/reporter.py": Writer(
+        "controller.{id}", ("self.controller_id",)
+    ),
+    "oim_tpu/serve/registration.py": Writer("serve.{id}", ("self.serve_id",)),
+    "oim_tpu/csi/rendezvous.py": Writer("host.{id}", ("host_id",)),
+    # The event publisher's ``source`` IS its CommonName (events.py
+    # docstring): controller.<id>, serve.<id>, ... each writing its own
+    # events/<cn>/* subtree.  Its db-direct branch is the registry
+    # process publishing its own events below the authz layer — the
+    # key shape is identical, so it is checked the same way.
+    "oim_tpu/common/events.py": Writer("{cn}", ("self.source",)),
+    # Operator CLI: authenticates as user.admin (grant "**").
+    "oim_tpu/cli/oimctl.py": Writer(ADMIN),
+    # Fault-management runs registry-side, sharing the registry's DB:
+    # its evictions/<vol> stores never cross the authz boundary.
+    "oim_tpu/health/monitor.py": Writer(REGISTRY_SIDE),
+}
+
+# The registry package itself stores below the authz layer.
+_SKIP_PREFIXES = ("oim_tpu/registry/", "oim_tpu/spec/")
+
+_DB_RECEIVERS = {"db", "self.db", "self._db"}
+
+
+def _load_grants():
+    from oim_tpu.registry.authz import AUTHZ_GRANTS
+
+    return AUTHZ_GRANTS
+
+
+# -- path-expression resolution ---------------------------------------------
+
+
+class _Resolver:
+    """Resolve a path expression to one or more segment-pattern strings."""
+
+    MAX_DEPTH = 6
+
+    def __init__(self, tree: SourceTree, rel: str, own: set[str]):
+        self.tree = tree
+        self.rel = rel
+        self.own = own
+
+    def resolve(
+        self, expr: ast.expr, fn: ast.FunctionDef | None, subst: dict
+    ) -> list[str]:
+        return self._expr(expr, fn, subst, self.rel, 0)
+
+    def _expr(self, expr, fn, subst, rel, depth) -> list[str]:
+        if depth > self.MAX_DEPTH:
+            return [UNKNOWN]
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return [expr.value]
+        name = dotted(expr)
+        if name is not None:
+            if name in self.own:
+                return [OWN]
+            if name in subst:
+                sub_expr, sub_fn, sub_subst, sub_rel = subst[name]
+                return self._expr(
+                    sub_expr, sub_fn, sub_subst, sub_rel, depth + 1
+                )
+            resolved = self._name_binding(name, fn, rel)
+            if resolved is not None:
+                return self._expr(resolved, fn, subst, rel, depth + 1)
+            return [STAR]
+        if isinstance(expr, ast.JoinedStr):
+            return self._joined(expr, fn, subst, rel, depth)
+        if isinstance(expr, ast.IfExp):
+            return self._expr(expr.body, fn, subst, rel, depth + 1) + self._expr(
+                expr.orelse, fn, subst, rel, depth + 1
+            )
+        if isinstance(expr, ast.Call):
+            return self._call(expr, fn, subst, rel, depth)
+        return [STAR]
+
+    def _joined(self, expr: ast.JoinedStr, fn, subst, rel, depth) -> list[str]:
+        results = [""]
+        for value in expr.values:
+            if isinstance(value, ast.Constant):
+                parts = [str(value.value)]
+            elif isinstance(value, ast.FormattedValue):
+                parts = self._expr(value.value, fn, subst, rel, depth + 1)
+                # An interpolation that resolved stays; an unresolvable
+                # one is one wildcard segment.
+                parts = [STAR if p == UNKNOWN else p for p in parts]
+            else:
+                parts = [STAR]
+            results = [r + p for r in results for p in parts]
+        return results
+
+    def _name_binding(self, name: str, fn, rel) -> ast.expr | None:
+        """Nearest ``name = <str expr>`` binding: function-local first,
+        then module-level constant."""
+        if fn is not None:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name) and target.id == name:
+                            if isinstance(
+                                node.value, (ast.Constant, ast.JoinedStr)
+                            ):
+                                return node.value
+        mod = self.tree.tree(rel)
+        if mod is not None:
+            for node in mod.body:
+                if isinstance(node, ast.Assign):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name) and target.id == name:
+                            if isinstance(node.value, ast.Constant):
+                                return node.value
+        return None
+
+    def _call(self, expr: ast.Call, fn, subst, rel, depth) -> list[str]:
+        callee = dotted(expr.func)
+        if callee is None:
+            return [STAR]
+        target = self._find_function(callee, rel)
+        if target is None:
+            return [STAR]
+        target_fn, target_rel = target
+        new_subst = dict(subst)
+        params = [a.arg for a in target_fn.args.args]
+        for i, arg in enumerate(expr.args):
+            if i < len(params):
+                new_subst[params[i]] = (arg, fn, subst, rel)
+        for kw in expr.keywords:
+            if kw.arg:
+                new_subst[kw.arg] = (kw.value, fn, subst, rel)
+        # Unpassed defaulted params substitute their default value.
+        defaults = target_fn.args.defaults
+        if defaults:
+            for param, default in zip(params[-len(defaults):], defaults):
+                new_subst.setdefault(param, (default, target_fn, {}, target_rel))
+        out: list[str] = []
+        for node in ast.walk(target_fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                out.extend(
+                    self._expr(node.value, target_fn, new_subst, target_rel, depth + 1)
+                )
+        return out or [UNKNOWN]
+
+    def _find_function(self, callee: str, rel):
+        """A module-local ``def`` or an imported ``module.func`` resolved
+        through this file's oim_tpu imports."""
+        parts = callee.split(".")
+        mod = self.tree.tree(rel)
+        if mod is None:
+            return None
+        if len(parts) == 1:
+            for node in mod.body:
+                if isinstance(node, ast.FunctionDef) and node.name == parts[0]:
+                    return node, rel
+            # from X import func
+            for node in mod.body:
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    for alias in node.names:
+                        if (alias.asname or alias.name) == parts[0]:
+                            target_rel = self._module_rel(node.module)
+                            if target_rel is not None:
+                                found = self._module_function(
+                                    target_rel, alias.name
+                                )
+                                if found is not None:
+                                    return found, target_rel
+            # function-local imports (from X import func inside a def)
+            for node in ast.walk(mod):
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    for alias in node.names:
+                        if (alias.asname or alias.name) == parts[0]:
+                            target_rel = self._module_rel(node.module)
+                            if target_rel is not None:
+                                found = self._module_function(
+                                    target_rel, alias.name
+                                )
+                                if found is not None:
+                                    return found, target_rel
+            return None
+        if len(parts) == 2:
+            mod_alias, func_name = parts
+            for node in ast.walk(mod):
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    for alias in node.names:
+                        if (alias.asname or alias.name) == mod_alias:
+                            target_rel = self._module_rel(
+                                f"{node.module}.{alias.name}"
+                            )
+                            if target_rel is not None:
+                                found = self._module_function(
+                                    target_rel, func_name
+                                )
+                                if found is not None:
+                                    return found, target_rel
+                elif isinstance(node, ast.Import):
+                    for alias in node.names:
+                        if (alias.asname or alias.name) == mod_alias:
+                            target_rel = self._module_rel(alias.name)
+                            if target_rel is not None:
+                                found = self._module_function(
+                                    target_rel, func_name
+                                )
+                                if found is not None:
+                                    return found, target_rel
+        return None
+
+    def _module_rel(self, module: str) -> str | None:
+        rel = module.replace(".", "/") + ".py"
+        try:
+            self.tree.text(rel)
+        except OSError:
+            return None
+        return rel
+
+    def _module_function(self, rel: str, name: str) -> ast.FunctionDef | None:
+        mod = self.tree.tree(rel)
+        if mod is None:
+            return None
+        for node in mod.body:
+            if isinstance(node, ast.FunctionDef) and node.name == name:
+                return node
+        return None
+
+
+# -- write-site collection ---------------------------------------------------
+
+
+def _enclosing_functions(mod: ast.Module):
+    """(function, node) pairs mapping every node to its innermost def."""
+    mapping: dict[int, ast.FunctionDef] = {}
+
+    def visit(node, current):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            current = node
+        mapping[id(node)] = current
+        for child in ast.iter_child_nodes(node):
+            visit(child, current)
+
+    visit(mod, None)
+    return mapping
+
+
+def _write_sites(mod: ast.Module):
+    """Yield (path_expr, call_node) for registry-write shapes."""
+    for node in ast.walk(mod):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted(node.func) or ""
+        short = callee.split(".")[-1]
+        if short == "Value":
+            for kw in node.keywords:
+                if kw.arg == "path":
+                    yield kw.value, node
+        elif short == "store" and ".".join(callee.split(".")[:-1]) in _DB_RECEIVERS:
+            if node.args:
+                yield node.args[0], node
+
+
+def _param_of(expr: ast.expr, fn: ast.FunctionDef | None) -> str | None:
+    if fn is None or not isinstance(expr, ast.Name):
+        return None
+    params = {a.arg for a in fn.args.args}
+    return expr.id if expr.id in params else None
+
+
+def _call_sites(mod: ast.Module, func_name: str):
+    for node in ast.walk(mod):
+        if isinstance(node, ast.Call):
+            callee = dotted(node.func)
+            if callee == func_name or (
+                callee and callee.split(".")[-1] == func_name
+            ):
+                yield node
+
+
+# -- grant matching ----------------------------------------------------------
+
+
+def _grant_covers(grants, writer_cn: str, pattern: str) -> bool:
+    segs = pattern.split("/")
+    for cn_pat, path_pat in grants:
+        if path_pat == "**":
+            if cn_pat == writer_cn:
+                return True
+            continue
+        if cn_pat != "*" and cn_pat != writer_cn:
+            continue
+        psegs = path_pat.split("/")
+        if len(psegs) != len(segs):
+            continue
+        ok = True
+        for pat, seg in zip(psegs, segs):
+            if pat == STAR:
+                continue
+            if pat == "{id}":
+                if seg != OWN:
+                    ok = False
+                    break
+            elif pat == "{cn}":
+                # {cn} is the peer's FULL CommonName: it matches the
+                # writer's own-identity hole only when the writer's CN
+                # template IS the bare identity ("{cn}" writers).
+                if not (seg == OWN and writer_cn == "{cn}"):
+                    ok = False
+                    break
+            elif pat != seg:
+                ok = False
+                break
+        if ok:
+            return True
+    return False
+
+
+# -- the pass ----------------------------------------------------------------
+
+
+def run(
+    tree: SourceTree,
+    grants=None,
+    writers: dict[str, Writer] | None = None,
+) -> list[Finding]:
+    if grants is None:
+        grants = _load_grants()
+    if writers is None:
+        writers = WRITERS
+    findings: list[Finding] = []
+    for rel in tree.files():
+        if rel.startswith(_SKIP_PREFIXES):
+            continue
+        mod = tree.tree(rel)
+        if mod is None:
+            continue
+        sites = list(_write_sites(mod))
+        if not sites:
+            continue
+        writer = writers.get(rel)
+        if writer is None:
+            for _, call in sites:
+                findings.append(
+                    Finding(
+                        PASS_ID,
+                        rel,
+                        call.lineno,
+                        "registry write in a module with no WRITERS entry — "
+                        "declare its identity in tools/oimlint/passes/"
+                        "authz.py and grant it in oim_tpu/registry/authz.py",
+                    )
+                )
+            continue
+        if writer.cn in (ADMIN, REGISTRY_SIDE):
+            continue
+        enclosing = _enclosing_functions(mod)
+        resolver = _Resolver(tree, rel, writer.own)
+        for expr, call in sites:
+            fn = enclosing.get(id(call))
+            patterns = _resolve_site(resolver, mod, expr, fn, rel)
+            for pattern in sorted(set(patterns)):
+                if UNKNOWN in pattern.split("/") or pattern == UNKNOWN:
+                    findings.append(
+                        Finding(
+                            PASS_ID,
+                            rel,
+                            call.lineno,
+                            "unresolvable registry write path — refactor to "
+                            "an f-string/key-helper the analyzer can read, "
+                            "or waive with a justification",
+                        )
+                    )
+                    continue
+                if not _grant_covers(grants, writer.cn, pattern):
+                    findings.append(
+                        Finding(
+                            PASS_ID,
+                            rel,
+                            call.lineno,
+                            f"path pattern '{pattern}' written as "
+                            f"{writer.cn} has no matching grant in "
+                            "oim_tpu/registry/authz.py AUTHZ_GRANTS",
+                        )
+                    )
+    return findings
+
+
+def _resolve_site(resolver, mod, expr, fn, rel, depth: int = 0) -> list[str]:
+    """Resolve a write site; a bare-parameter path is resolved at the
+    enclosing function's intra-module call sites instead.  Depth-capped
+    like the expression resolver: mutually recursive forwarders resolve
+    to UNKNOWN (an 'unresolvable path' finding), never a RecursionError
+    that would kill the whole lint run."""
+    if depth > _Resolver.MAX_DEPTH:
+        return [UNKNOWN]
+    param = _param_of(expr, fn)
+    if param is None:
+        return resolver.resolve(expr, fn, {})
+    index = [a.arg for a in fn.args.args].index(param)
+    patterns: list[str] = []
+    enclosing = _enclosing_functions(mod)
+    for call in _call_sites(mod, fn.name):
+        arg = None
+        if index < len(call.args):
+            arg = call.args[index]
+        else:
+            for kw in call.keywords:
+                if kw.arg == param:
+                    arg = kw.value
+        if arg is None:
+            continue
+        caller_fn = enclosing.get(id(call))
+        nested_param = _param_of(arg, caller_fn)
+        if nested_param is not None and caller_fn is not fn:
+            patterns.extend(
+                _resolve_site(resolver, mod, arg, caller_fn, rel, depth + 1)
+            )
+        else:
+            patterns.extend(resolver.resolve(arg, caller_fn, {}))
+    return patterns or [UNKNOWN]

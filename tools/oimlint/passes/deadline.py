@@ -1,0 +1,87 @@
+"""deadline-hygiene: every unary RPC call site carries a timeout.
+
+An RPC without a deadline turns a hung peer into a hung caller — and in
+this control plane callers are heartbeat loops, CSI node operations and
+gRPC handlers whose worker threads are a bounded pool.  Every unary
+call on a generated stub must pass ``timeout=`` (a constant, or the
+retry ladder's ``attempt.clamped(...)`` budget — both satisfy the
+check).  Streaming watches (``WatchValues``) are exempt: an open-ended
+watch is the contract, and cancellation is the caller's job.
+
+Two detection shapes, matching how stubs are used in this tree:
+
+- chained: ``REGISTRY.stub(channel).SetValue(req)``;
+- named:   ``stub = REGISTRY.stub(channel); ...; stub.SetValue(req)``
+  (any local assigned from a ``.stub(...)`` call);
+- plus any call whose method name is a known unary RPC of oim.v1
+  (catches helper-wrapped stubs).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.oimlint.core import Finding, SourceTree, dotted
+
+PASS_ID = "deadline-hygiene"
+DESCRIPTION = "unary RPC call sites must pass timeout="
+
+# oim.v1 unary methods (doc/spec.md); WatchValues is a server stream.
+UNARY_RPCS = {
+    "SetValue", "GetValues", "MapVolume", "UnmapVolume", "ProvisionSlice",
+    "CheckSlice", "GetTopology", "ListSlices",
+}
+# WatchValues (oim.v1) and Watch (etcd v3) are open-ended streams by
+# contract; cancellation, not a deadline, bounds them.
+STREAMING_RPCS = {"WatchValues", "Watch"}
+
+
+def _has_timeout(node: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in node.keywords)
+
+
+def _stub_locals(fn: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = dotted(node.value.func) or ""
+            if callee.split(".")[-1] == "stub":
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        out.add(target.id)
+    return out
+
+
+def run(tree: SourceTree) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel in tree.files():
+        mod = tree.tree(rel)
+        if mod is None:
+            continue
+        stub_names = _stub_locals(mod)
+        for node in ast.walk(mod):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                continue
+            method = node.func.attr
+            if method in STREAMING_RPCS:
+                continue
+            recv = node.func.value
+            chained_stub = (
+                isinstance(recv, ast.Call)
+                and (dotted(recv.func) or "").split(".")[-1] == "stub"
+            )
+            named_stub = isinstance(recv, ast.Name) and recv.id in stub_names
+            known_rpc = method in UNARY_RPCS
+            if not (chained_stub or named_stub or known_rpc):
+                continue
+            if not _has_timeout(node):
+                findings.append(
+                    Finding(
+                        PASS_ID,
+                        rel,
+                        node.lineno,
+                        f"RPC {method}(...) without timeout= (pass a "
+                        "constant or attempt.clamped(...))",
+                    )
+                )
+    return findings

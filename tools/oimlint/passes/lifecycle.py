@@ -1,0 +1,229 @@
+"""resource-lifecycle: threads/sockets/channels need a teardown path.
+
+A class that stores a ``threading.Thread``, a raw socket, or a grpc
+channel on ``self`` owns that resource for its whole lifetime, so:
+
+1. it must define a teardown method (``close``/``stop``/``shutdown``/
+   ``__exit__``) — a daemon that cannot be shut down cleanly cannot be
+   embedded, restarted in-process, or soak-tested without leaking;
+2. every ``self``-stored thread must be ``join()``-ed somewhere in the
+   class (directly or through a local alias) — an unjoined loop thread
+   keeps running against torn-down state after ``stop()`` returns,
+   which is exactly how "stopped" routers kept probing dead backends;
+   non-daemon threads additionally block interpreter exit;
+3. every ``self``-stored socket/channel must at least be *touched* by
+   the teardown path (loaded somewhere reachable from it), the weakest
+   check that still catches a close() that plain forgot the resource.
+
+Aliases are followed one level (``t = self._thread; t.join()`` and the
+tuple form ``a, b = self._x, self._y`` both count).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.oimlint.core import (
+    Finding,
+    SourceTree,
+    call_name,
+    class_methods,
+    dotted,
+    keyword_arg,
+    module_classes,
+)
+
+PASS_ID = "resource-lifecycle"
+DESCRIPTION = "thread/socket/channel owners need close(); threads joined"
+
+_TEARDOWN = ("close", "stop", "shutdown", "__exit__", "__del__")
+
+_RESOURCE_CTORS = {
+    "Thread": "thread",
+    "socket": "socket",
+    "secure_channel": "grpc channel",
+    "insecure_channel": "grpc channel",
+}
+
+
+def _self_attr(target: ast.AST) -> str | None:
+    name = dotted(target)
+    if name and name.startswith("self.") and name.count(".") == 1:
+        return name.split(".", 1)[1]
+    return None
+
+
+def _resource_kind(value: ast.AST) -> tuple[str, bool] | None:
+    """(kind, daemon) when ``value`` contains a resource constructor call
+    anywhere (covers ``x if cond else None`` wrappers)."""
+    for node in ast.walk(value):
+        if isinstance(node, ast.Call):
+            name = (call_name(node) or "").split(".")[-1]
+            kind = _RESOURCE_CTORS.get(name)
+            if kind is None:
+                continue
+            daemon = False
+            if kind == "thread":
+                arg = keyword_arg(node, "daemon")
+                daemon = isinstance(arg, ast.Constant) and arg.value is True
+            return kind, daemon
+    return None
+
+
+def _collect_resources(cls: ast.ClassDef) -> dict[str, tuple[str, bool, int]]:
+    """attr -> (kind, daemon, line).  Tracks one level of local aliasing
+    (``sock = socket.socket(); ...; self._sock = sock``)."""
+    resources: dict[str, tuple[str, bool, int]] = {}
+    for fn in class_methods(cls).values():
+        local_kinds: dict[str, tuple[str, bool]] = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            kind = _resource_kind(node.value)
+            for target in node.targets:
+                attr = _self_attr(target)
+                if kind is not None:
+                    if attr is not None:
+                        resources.setdefault(
+                            attr, (kind[0], kind[1], node.lineno)
+                        )
+                    elif isinstance(target, ast.Name):
+                        local_kinds[target.id] = kind
+                elif attr is not None and isinstance(node.value, ast.Name):
+                    aliased = local_kinds.get(node.value.id)
+                    if aliased is not None:
+                        resources.setdefault(
+                            attr, (aliased[0], aliased[1], node.lineno)
+                        )
+    return resources
+
+
+def _alias_map(fn: ast.FunctionDef) -> dict[str, set[str]]:
+    """local name -> self attrs it may alias: ``t = self._x``, tuple
+    unpacks, and ``for t in (self._x, self._y):`` loops."""
+    aliases: dict[str, set[str]] = {}
+
+    def alias(name: str, value: ast.AST) -> None:
+        attr = _self_attr(value)
+        if attr is not None:
+            aliases.setdefault(name, set()).add(attr)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    alias(target.id, node.value)
+                elif isinstance(target, (ast.Tuple, ast.List)) and isinstance(
+                    node.value, (ast.Tuple, ast.List)
+                ):
+                    for t, v in zip(target.elts, node.value.elts):
+                        if isinstance(t, ast.Name):
+                            alias(t.id, v)
+        elif isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            if isinstance(node.iter, (ast.Tuple, ast.List)):
+                for v in node.iter.elts:
+                    alias(node.target.id, v)
+    return aliases
+
+
+def _joined_attrs(cls: ast.ClassDef) -> set[str]:
+    """Attrs with ``self.A.join(...)`` or alias ``t.join(...)`` anywhere."""
+    joined: set[str] = set()
+    for fn in class_methods(cls).values():
+        aliases = _alias_map(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ) and node.func.attr == "join":
+                recv = node.func.value
+                attr = _self_attr(recv)
+                if attr is not None:
+                    joined.add(attr)
+                elif isinstance(recv, ast.Name) and recv.id in aliases:
+                    joined.update(aliases[recv.id])
+    return joined
+
+
+def _teardown_reachable_loads(cls: ast.ClassDef) -> set[str]:
+    """Self attrs loaded in methods reachable from any teardown method."""
+    methods = class_methods(cls)
+    frontier = [n for n in _TEARDOWN if n in methods]
+    seen: set[str] = set()
+    loads: set[str] = set()
+    while frontier:
+        name = frontier.pop()
+        if name in seen or name not in methods:
+            continue
+        seen.add(name)
+        for node in ast.walk(methods[name]):
+            dn = dotted(node) if isinstance(node, ast.Attribute) else None
+            if dn and dn.startswith("self."):
+                parts = dn.split(".")
+                loads.add(parts[1])
+                if len(parts) == 2 or parts[2:] == ["close"]:
+                    pass
+            if isinstance(node, ast.Call):
+                cn = dotted(node.func)
+                if cn and cn.startswith("self.") and cn.count(".") == 1:
+                    frontier.append(cn.split(".", 1)[1])
+    return loads
+
+
+def run(tree: SourceTree) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel in tree.files():
+        mod = tree.tree(rel)
+        if mod is None:
+            continue
+        for cls in module_classes(mod):
+            findings.extend(_check_class(rel, cls))
+    return findings
+
+
+def _check_class(rel: str, cls: ast.ClassDef) -> list[Finding]:
+    resources = _collect_resources(cls)
+    if not resources:
+        return []
+    findings: list[Finding] = []
+    methods = class_methods(cls)
+    has_teardown = any(n in methods for n in _TEARDOWN)
+    if not has_teardown:
+        kinds = ", ".join(
+            f"self.{attr} ({kind})"
+            for attr, (kind, _, _) in sorted(resources.items())
+        )
+        findings.append(
+            Finding(
+                PASS_ID,
+                rel,
+                cls.lineno,
+                f"class {cls.name} owns {kinds} but defines no "
+                "close()/stop()/shutdown()",
+            )
+        )
+    joined = _joined_attrs(cls)
+    teardown_loads = _teardown_reachable_loads(cls) if has_teardown else set()
+    for attr, (kind, daemon, line) in sorted(resources.items()):
+        if kind == "thread":
+            if attr not in joined:
+                tag = "" if daemon else " (non-daemon!)"
+                findings.append(
+                    Finding(
+                        PASS_ID,
+                        rel,
+                        line,
+                        f"class {cls.name} stores a thread in self.{attr} "
+                        f"but never joins it{tag}",
+                    )
+                )
+        elif has_teardown and attr not in teardown_loads:
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    rel,
+                    line,
+                    f"class {cls.name}: self.{attr} ({kind}) is never "
+                    "released on the close()/stop() path",
+                )
+            )
+    return findings

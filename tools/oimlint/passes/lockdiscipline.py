@@ -1,0 +1,309 @@
+"""lock-discipline: shared-attribute and hold-while-blocking checks.
+
+Two rules, both scoped to what this codebase actually does with
+threads (background heartbeat/drain/watch loops inside classes whose
+public methods are called from gRPC/HTTP worker pools):
+
+1. **Unguarded shared attribute** — in any class that spawns a
+   ``threading.Thread`` targeting one of its own methods, an instance
+   attribute mutated BOTH on the thread path (the target method and
+   everything it calls through ``self``) AND in some other method is
+   shared mutable state; every mutation site must hold one of the
+   class's locks (an attribute assigned ``threading.Lock()`` /
+   ``RLock()`` / ``Condition()``).  Methods named ``*_locked`` are
+   treated as guarded by convention (they document the caller holds
+   the lock).
+
+2. **Blocking call while holding a lock** — inside a ``with
+   self.<lock>:`` block, a call that can block on the network or the
+   clock (``time.sleep``, socket ``connect``/``sendall``/``recv``/
+   ``readline``, JSON-RPC ``.invoke``, dialing an ``Agent``/``Client``,
+   unary registry/controller RPCs) serializes every other thread
+   contending for that lock behind a peer's latency.  ``Condition
+   .wait`` is exempt (it releases the lock).  Intentional cases (e.g. a
+   client that serializes one roundtrip per connection by design) carry
+   a ``# oimlint: disable=lock-discipline`` waiver with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.oimlint.core import (
+    Finding,
+    SourceTree,
+    call_name,
+    class_methods,
+    dotted,
+    keyword_arg,
+    module_classes,
+)
+
+PASS_ID = "lock-discipline"
+DESCRIPTION = "shared attrs need locks; no blocking calls while locked"
+
+_LOCK_CTORS = ("Lock", "RLock", "Condition")
+_MUTATORS = {
+    "append", "appendleft", "add", "insert", "extend", "update", "pop",
+    "popleft", "popitem", "clear", "remove", "discard", "setdefault",
+}
+# Calls that can block on a peer or the clock (tuned to this tree).
+_BLOCKING_DOTTED = {"time.sleep", "select.select"}
+_BLOCKING_ATTRS = {
+    "sendall", "recv", "recvfrom", "readline", "connect", "accept",
+    "invoke",
+}
+_BLOCKING_CTORS = {"Agent", "Client"}
+_BLOCKING_RPCS = {
+    "SetValue", "GetValues", "MapVolume", "UnmapVolume", "ProvisionSlice",
+    "CheckSlice", "GetTopology", "ListSlices",
+}
+# Waits that RELEASE the lock they are called under.
+_EXEMPT_ATTRS = {"wait", "wait_for"}
+
+_LIFECYCLE_SKIP = {"__init__", "__new__", "__post_init__"}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    locks: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = call_name(node.value) or ""
+            if name.split(".")[-1] in _LOCK_CTORS:
+                for target in node.targets:
+                    t = dotted(target)
+                    if t and t.startswith("self.") and t.count(".") == 1:
+                        locks.add(t.split(".", 1)[1])
+    return locks
+
+
+def _thread_targets(cls: ast.ClassDef) -> set[str]:
+    """Names of ``self`` methods used as ``threading.Thread`` targets."""
+    targets: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            name = call_name(node) or ""
+            if name.split(".")[-1] != "Thread":
+                continue
+            target = keyword_arg(node, "target")
+            t = dotted(target) if target is not None else None
+            if t and t.startswith("self.") and t.count(".") == 1:
+                targets.add(t.split(".", 1)[1])
+    return targets
+
+
+def _self_calls(fn: ast.FunctionDef) -> set[str]:
+    out: set[str] = set()
+    for node in _walk_scope(fn):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name and name.startswith("self.") and name.count(".") == 1:
+                out.add(name.split(".", 1)[1])
+    return out
+
+
+def _walk_scope(fn: ast.AST):
+    """Walk a method body without descending into nested classes (whose
+    ``self`` is a different object); nested functions/lambdas close over
+    the outer ``self`` and ARE descended into."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Mutations of ``self.X`` and blocking calls, with lock-held state."""
+
+    def __init__(self, locks: set[str]):
+        self.locks = locks
+        self.held: list[str] = []
+        # attr -> list[(line, guarded)]
+        self.mutations: dict[str, list[tuple[int, bool]]] = {}
+        # (line, description, lock) blocking calls under a held lock
+        self.blocking: list[tuple[int, str, str]] = []
+
+    # -- helpers -----------------------------------------------------------
+
+    def _mutate(self, target: ast.AST, line: int) -> None:
+        name = dotted(target)
+        if name and name.startswith("self.") and name.count(".") == 1:
+            attr = name.split(".", 1)[1]
+            if attr in self.locks:
+                return
+            self.mutations.setdefault(attr, []).append(
+                (line, bool(self.held))
+            )
+
+    # -- scope fencing -----------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        pass  # different ``self``
+
+    # -- lock tracking -----------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        entered = []
+        for item in node.items:
+            expr = item.context_expr
+            name = dotted(expr)
+            if name is None and isinstance(expr, ast.Call):
+                name = dotted(expr.func)  # with self._lock.acquire_timeout()
+            if (
+                name
+                and name.startswith("self.")
+                and name.split(".")[1] in self.locks
+            ):
+                entered.append(name.split(".")[1])
+            self.visit(expr)
+        self.held.extend(entered)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - len(entered):]
+
+    # -- mutation sites ----------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    self._mutate_store(elt, node.lineno)
+            else:
+                self._mutate_store(target, node.lineno)
+        self.visit(node.value)
+
+    def _mutate_store(self, target: ast.AST, line: int) -> None:
+        if isinstance(target, ast.Subscript):
+            self._mutate(target.value, line)  # self.X[k] = v mutates X
+        else:
+            self._mutate(target, line)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._mutate_store(node.target, node.lineno)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._mutate_store(node.target, node.lineno)
+            self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            self._mutate_store(target, node.lineno)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted(node.func) or ""
+        parts = name.split(".")
+        # self.X.append(...) mutates X
+        if (
+            len(parts) == 3
+            and parts[0] == "self"
+            and parts[2] in _MUTATORS
+        ):
+            self._mutate(node.func.value, node.lineno)
+        if self.held:
+            desc = self._blocking_desc(node, name, parts)
+            if desc:
+                self.blocking.append((node.lineno, desc, self.held[-1]))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _blocking_desc(node: ast.Call, name: str, parts: list[str]) -> str | None:
+        if name in _BLOCKING_DOTTED:
+            return f"{name}(...)"
+        last = parts[-1]
+        if last in _EXEMPT_ATTRS:
+            return None
+        if len(parts) > 1 and last in _BLOCKING_ATTRS:
+            return f"{name}(...)"
+        if len(parts) > 1 and last in _BLOCKING_RPCS:
+            return f"{name}(...) RPC"
+        if len(parts) == 1 and last in _BLOCKING_CTORS:
+            return f"{last}(...) dial"
+        return None
+
+
+def run(tree: SourceTree) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel in tree.files():
+        mod = tree.tree(rel)
+        if mod is None:
+            continue
+        for cls in module_classes(mod):
+            findings.extend(_check_class(rel, cls))
+    return findings
+
+
+def _check_class(rel: str, cls: ast.ClassDef) -> list[Finding]:
+    locks = _lock_attrs(cls)
+    methods = class_methods(cls)
+    targets = _thread_targets(cls) & set(methods)
+
+    # Thread-path closure over self-calls.
+    thread_methods: set[str] = set()
+    frontier = list(targets)
+    while frontier:
+        name = frontier.pop()
+        if name in thread_methods or name not in methods:
+            continue
+        thread_methods.add(name)
+        frontier.extend(_self_calls(methods[name]))
+
+    findings: list[Finding] = []
+    per_method: dict[str, _MethodScan] = {}
+    for name, fn in methods.items():
+        scan = _MethodScan(locks)
+        for stmt in fn.body:
+            scan.visit(stmt)
+        per_method[name] = scan
+        for line, desc, lock in scan.blocking:
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    rel,
+                    line,
+                    f"{cls.name}.{name}: blocking call {desc} while "
+                    f"holding self.{lock}",
+                )
+            )
+
+    if not targets:
+        return findings
+
+    # Attributes mutated on the thread path AND elsewhere.
+    def mutated_attrs(names: set[str]) -> dict[str, list[tuple[str, int, bool]]]:
+        out: dict[str, list[tuple[str, int, bool]]] = {}
+        for name in names:
+            if name in _LIFECYCLE_SKIP:
+                continue
+            guarded_by_convention = name.endswith("_locked")
+            for attr, sites in per_method[name].mutations.items():
+                for line, guarded in sites:
+                    out.setdefault(attr, []).append(
+                        (name, line, guarded or guarded_by_convention)
+                    )
+        return out
+
+    on_thread = mutated_attrs(thread_methods)
+    elsewhere = mutated_attrs(set(methods) - thread_methods)
+    for attr in sorted(set(on_thread) & set(elsewhere)):
+        sites = on_thread[attr] + elsewhere[attr]
+        unguarded = [(m, line) for m, line, guarded in sites if not guarded]
+        if not unguarded:
+            continue
+        thread_side = ", ".join(sorted({m for m, _, _ in on_thread[attr]}))
+        for method, line in sorted(unguarded, key=lambda s: s[1]):
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    rel,
+                    line,
+                    f"{cls.name}.{method}: shared attribute self.{attr} "
+                    f"mutated without a class lock (also mutated on the "
+                    f"thread path: {thread_side})",
+                )
+            )
+    return findings

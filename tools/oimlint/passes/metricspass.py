@@ -1,0 +1,113 @@
+"""metrics: every registered series ``oim_``-prefixed with non-empty HELP.
+
+The former ``tools/check_metrics.py``, folded into oimlint so there is
+one analyzer (``tools/check_metrics.py`` remains as a thin alias).  Two
+sub-checks, both fast and stdlib-only:
+
+1. **Source scan** (AST): every ``.counter("name", "help", ...)`` /
+   ``.gauge(...)`` / ``.histogram(...)`` call whose name is a string
+   literal — catches instruments registered at instance-construction
+   time, which a runtime import can never see.
+2. **Runtime check**: import the always-importable metrics-defining
+   modules (no jax required) and validate what actually landed in the
+   process registry — catches dynamically built names the AST pass
+   skips.  Skipped when the scanned tree is not the real repo (fixture
+   runs).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.oimlint.core import REPO, Finding, SourceTree
+
+PASS_ID = "metrics"
+DESCRIPTION = "metric series are oim_-prefixed with non-empty HELP"
+
+REGISTER_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _scan_file(tree: SourceTree, rel: str) -> list[Finding]:
+    mod = tree.tree(rel)
+    if mod is None:
+        return []
+    problems: list[Finding] = []
+    for node in ast.walk(mod):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr in REGISTER_METHODS):
+            continue
+        if not node.args:
+            continue
+        name_node = node.args[0]
+        if not (isinstance(name_node, ast.Constant) and isinstance(name_node.value, str)):
+            continue  # dynamic name: left to the runtime check
+        name = name_node.value
+        if not name.startswith("oim_"):
+            problems.append(
+                Finding(
+                    PASS_ID, rel, node.lineno,
+                    f"series {name!r} is not 'oim_'-prefixed",
+                )
+            )
+        help_node = node.args[1] if len(node.args) > 1 else None
+        if isinstance(help_node, ast.Constant) and isinstance(help_node.value, str):
+            if not help_node.value.strip():
+                problems.append(
+                    Finding(
+                        PASS_ID, rel, node.lineno,
+                        f"series {name!r} has empty HELP",
+                    )
+                )
+        elif isinstance(help_node, ast.JoinedStr):
+            pass  # f-string help: non-empty by construction
+        elif help_node is None and "help_" not in {
+            kw.arg for kw in node.keywords
+        }:
+            problems.append(
+                Finding(
+                    PASS_ID, rel, node.lineno,
+                    f"series {name!r} has no HELP argument",
+                )
+            )
+    return problems
+
+
+def _check_runtime() -> list[Finding]:
+    # The jax-free metrics definers; jax-importing modules (data,
+    # checkpoint, serve engine) are covered by the source scan.
+    import oim_tpu.common.events  # noqa: F401
+    import oim_tpu.common.metrics as metrics
+    import oim_tpu.common.resilience  # noqa: F401
+    import oim_tpu.common.tracing  # noqa: F401
+
+    problems: list[Finding] = []
+    for name, metric in sorted(metrics.registry()._metrics.items()):
+        if not name.startswith("oim_"):
+            problems.append(
+                Finding(
+                    PASS_ID, "(runtime registry)", 0,
+                    f"series {name!r} not 'oim_'-prefixed",
+                )
+            )
+        if not str(getattr(metric, "help", "")).strip():
+            problems.append(
+                Finding(
+                    PASS_ID, "(runtime registry)", 0,
+                    f"series {name!r} has empty HELP",
+                )
+            )
+    return problems
+
+
+def run(tree: SourceTree, runtime: bool | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel in tree.files():
+        findings.extend(_scan_file(tree, rel))
+    if runtime is None:
+        runtime = os.path.abspath(tree.repo) == os.path.abspath(REPO)
+    if runtime:
+        findings.extend(_check_runtime())
+    return findings

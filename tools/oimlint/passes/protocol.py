@@ -1,0 +1,132 @@
+"""protocol-drift: client ↔ fake agent ↔ protocol doc agreement.
+
+The reference kept its wire contract honest with a CI job that
+extracted protobuf from ``spec.md`` and diffed it against ``oim.proto``
+(reference Makefile:85-103).  The tpu-agent's JSON-RPC protocol has no
+proto to diff, so this pass rebuilds the same gate from its three
+sources of truth:
+
+- **used**: every method name the Python client invokes
+  (``Client``/``Agent`` string literals passed to ``.invoke``);
+- **implemented**: every method the in-process fake serves
+  (``method == "..."`` dispatch comparisons in ``ChipStore.handle`` —
+  the fake is the protocol's reference implementation, and the shared
+  suite holds the C++ daemon to it);
+- **documented**: every method row in ``doc/agent-protocol.md``'s
+  Methods table (``| `name` | ...``).
+
+Any one-sided name is drift: a client call the daemon will answer
+METHOD_NOT_FOUND, an implemented-but-undocumented method the C++ side
+will never learn about, or a documented method nobody serves.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.oimlint.core import Finding, SourceTree
+
+PASS_ID = "protocol-drift"
+DESCRIPTION = "agent client / fake agent / doc method tables must agree"
+
+CLIENT_FILES = ("oim_tpu/agent/agent.py", "oim_tpu/agent/client.py")
+FAKE_FILE = "oim_tpu/agent/fake.py"
+DOC_FILE = "doc/agent-protocol.md"
+
+_DOC_ROW = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`\s*\|")
+
+
+def _tree_or_none(tree: SourceTree, rel: str):
+    """A parsed module, or None when ``rel`` is absent from the scanned
+    tree (fixture runs point the pass at a subset of the three files)."""
+    try:
+        return tree.tree(rel)
+    except OSError:
+        return None
+
+
+def _invoked_methods(tree: SourceTree, files) -> dict[str, tuple[str, int]]:
+    out: dict[str, tuple[str, int]] = {}
+    for rel in files:
+        mod = _tree_or_none(tree, rel)
+        if mod is None:
+            continue
+        for node in ast.walk(mod):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "invoke"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                out.setdefault(node.args[0].value, (rel, node.lineno))
+    return out
+
+
+def _implemented_methods(tree: SourceTree, rel: str) -> dict[str, tuple[str, int]]:
+    """Names compared against a variable called ``method`` (the fake's
+    dispatch convention)."""
+    out: dict[str, tuple[str, int]] = {}
+    mod = _tree_or_none(tree, rel)
+    if mod is None:
+        return out
+    for node in ast.walk(mod):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        names = [
+            s.id for s in sides if isinstance(s, ast.Name)
+        ]
+        if "method" not in names:
+            continue
+        for side in sides:
+            if isinstance(side, ast.Constant) and isinstance(side.value, str):
+                if re.fullmatch(r"[a-z_][a-z0-9_]*", side.value):
+                    out.setdefault(side.value, (rel, side.lineno))
+    return out
+
+
+def _documented_methods(tree: SourceTree, rel: str) -> dict[str, tuple[str, int]]:
+    out: dict[str, tuple[str, int]] = {}
+    try:
+        lines = tree.lines(rel)
+    except OSError:
+        return out
+    for lineno, line in enumerate(lines, 1):
+        m = _DOC_ROW.match(line.strip())
+        if m:
+            out.setdefault(m.group(1), (rel, lineno))
+    return out
+
+
+def run(
+    tree: SourceTree,
+    client_files=CLIENT_FILES,
+    fake_file: str = FAKE_FILE,
+    doc_file: str = DOC_FILE,
+) -> list[Finding]:
+    used = _invoked_methods(tree, client_files)
+    implemented = _implemented_methods(tree, fake_file)
+    documented = _documented_methods(tree, doc_file)
+    findings: list[Finding] = []
+
+    def drift(missing_from: str, have: dict, lack: dict, what: str) -> None:
+        for name in sorted(set(have) - set(lack)):
+            rel, line = have[name]
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    rel,
+                    line,
+                    f"agent method {name!r} {what} but is missing from "
+                    f"{missing_from}",
+                )
+            )
+
+    drift(fake_file, used, implemented, "is invoked by the client")
+    drift(doc_file, used, documented, "is invoked by the client")
+    drift(doc_file, implemented, documented, "is served by the fake agent")
+    drift(fake_file, documented, implemented, "is documented")
+    return findings

@@ -1,0 +1,136 @@
+"""oimvet runner: pass orchestration, the baseline gate, the CLI."""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from tools.oimlint import core
+from tools.oimlint.core import Finding, SourceTree
+from tools.oimlint.passes import ALL_PASSES
+
+
+def run_passes(
+    tree: SourceTree | None = None, pass_ids: list[str] | None = None
+) -> list[Finding]:
+    """All (or the selected) passes over ``tree``; waivers applied,
+    parse errors included as findings."""
+    if tree is None:
+        tree = SourceTree()
+    ids = pass_ids if pass_ids is not None else list(ALL_PASSES)
+    findings: list[Finding] = []
+    for pass_id in ids:
+        if pass_id not in ALL_PASSES:
+            raise SystemExit(
+                f"oimlint: unknown pass {pass_id!r} "
+                f"(known: {', '.join(ALL_PASSES)})"
+            )
+        findings.extend(ALL_PASSES[pass_id].run(tree))
+    findings.extend(tree.parse_errors)
+    kept, _waived = core.apply_waivers(tree, findings)
+    return kept
+
+
+def gate(
+    findings: list[Finding], baseline: set[str]
+) -> tuple[list[Finding], set[str]]:
+    """(new findings, stale baseline keys)."""
+    keys = {f.key() for f in findings}
+    new = [f for f in findings if f.key() not in baseline]
+    stale = baseline - keys
+    return new, stale
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.oimlint",
+        description="oimvet: OIM-TPU control-plane static analyzer",
+    )
+    parser.add_argument(
+        "--passes",
+        help="comma-separated pass ids (default: all)",
+    )
+    parser.add_argument(
+        "--repo",
+        default=core.REPO,
+        help="tree root to scan (default: this repo; used by the "
+        "analyzer's own tests to point passes at fixture snippets)",
+    )
+    parser.add_argument(
+        "--roots",
+        default="oim_tpu",
+        help="comma-separated repo-relative directories to walk "
+        "(default: oim_tpu)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=core.DEFAULT_BASELINE,
+        help="baseline file (default: tools/oimlint/baseline.txt)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline to the current findings and exit 0",
+    )
+    parser.add_argument(
+        "--list-passes", action="store_true", help="list passes and exit"
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true", help="suppress the summary line"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for pass_id, mod in ALL_PASSES.items():
+            print(f"{pass_id:<20} {mod.DESCRIPTION}")
+        return 0
+
+    t0 = time.monotonic()
+    pass_ids = (
+        [p.strip() for p in args.passes.split(",") if p.strip()]
+        if args.passes
+        else None
+    )
+    roots = tuple(r for r in (s.strip() for s in args.roots.split(",")) if r)
+    tree = SourceTree(repo=args.repo, roots=roots or ("oim_tpu",))
+    findings = run_passes(tree, pass_ids=pass_ids)
+
+    if args.update_baseline:
+        core.write_baseline(args.baseline, findings)
+        print(
+            f"oimlint: baseline updated with {len(findings)} finding(s) "
+            f"→ {args.baseline}"
+        )
+        return 0
+
+    baseline = set() if args.no_baseline else core.load_baseline(args.baseline)
+    # A pass subset must not treat the other passes' baseline entries as
+    # stale — scope the baseline to the passes that actually ran.
+    if pass_ids is not None:
+        baseline = {
+            k for k in baseline if k.split(" ", 1)[0] in set(pass_ids)
+        }
+    new, stale = gate(findings, baseline)
+    for finding in sorted(new, key=lambda f: (f.file, f.line)):
+        print(finding.render())
+    if stale and not args.quiet:
+        for key in sorted(stale):
+            print(f"oimlint: note: baseline entry no longer found: {key}")
+        print(
+            "oimlint: run --update-baseline to drop "
+            f"{len(stale)} fixed entr{'y' if len(stale) == 1 else 'ies'}"
+        )
+    if not args.quiet:
+        dt = time.monotonic() - t0
+        print(
+            f"oimlint: {len(new)} new finding(s), "
+            f"{len(findings) - len(new)} baselined, "
+            f"{len(ALL_PASSES) if pass_ids is None else len(pass_ids)} "
+            f"pass(es) in {dt:.1f}s"
+        )
+    return 1 if new else 0
