@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability lint lint-metrics agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve lint lint-metrics agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -54,6 +54,21 @@ test-observability:
 	timeout -k 10 60 $(PYTHON) -m pytest tests/test_events.py \
 	  tests/test_tracing.py tests/test_metrics.py -q -m "not slow" \
 	  -p no:cacheprovider
+
+# Serving pipeline: the pipelined-vs-serial exactness matrix, the
+# drain/abort-with-chunk-in-flight regressions, and the readback
+# attribution asserts.  Nominal runtime is ~40-55s (five engine
+# variants' compiles dominate); the cap carries headroom over that
+# because the reference box's CPU quota swings 2-3x on seconds
+# timescales — a 60s cap flaked at full green.
+# Also runs the oimlint lock-discipline + resource-lifecycle passes over
+# the serve plane so the engine's in-flight-handle/driver-thread
+# ownership stays clean in the analyzer, not grandfathered in baseline.
+test-serve:
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,resource-lifecycle --roots oim_tpu/serve
+	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_serve_pipeline.py -q -m "not slow" -p no:cacheprovider
 
 # oimvet: the multi-pass control-plane static analyzer (tools/oimlint —
 # lock-discipline, resource-lifecycle, authz-coverage, protocol-drift,

@@ -124,6 +124,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--max-len", type=int, default=1024)
     p.add_argument("--chunk", type=int, default=8)
+    p.add_argument(
+        "--pipeline-depth", type=int, default=2, choices=(1, 2),
+        help="decode pipeline depth: 2 (default) dispatches chunk N+1 "
+        "before chunk N's readback so device compute overlaps host "
+        "emission; 1 is the serial dispatch-then-readback loop (A/B "
+        "control; see doc/operations.md 'Serving pipeline tuning')",
+    )
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument(
@@ -348,6 +355,7 @@ def make_engine(args):
         penalties=not args.no_penalties,
         max_queue=args.max_queue,
         prefill_chunk=args.prefill_chunk,
+        pipeline_depth=args.pipeline_depth,
     )
 
 

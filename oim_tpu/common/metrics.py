@@ -312,6 +312,36 @@ BREAKER_TRANSITIONS = _registry.counter(
     ("target", "state"),
 )
 
+# ---------------------------------------------------------------------------
+# Serving-pipeline instruments (used by oim_tpu.serve.engine): the decode
+# pipeline's health triad, defined here like the resilience set so the
+# doc/operations.md "Serving pipeline tuning" queries see identical series
+# names from every engine in the fleet.  Per-engine label: several engines
+# can share one process (tests, multi-model hosts).
+
+SERVE_PIPELINE_DEPTH = _registry.gauge(
+    "oim_serve_pipeline_depth",
+    "Configured decode pipeline depth: 1 = serial dispatch-then-readback, "
+    "2 = dispatch-ahead double buffering (chunk N+1 dispatched before "
+    "chunk N's readback).",
+    ("engine",),
+)
+SERVE_DEVICE_IDLE = _registry.counter(
+    "oim_serve_device_idle_seconds_total",
+    "Estimated accelerator idle wall time: gaps between a completed "
+    "readback and the next dispatch with nothing queued on the device.  "
+    "Grows steadily on a serial engine; near-flat when the pipeline "
+    "keeps the device fed.",
+    ("engine",),
+)
+SERVE_OVERLAP_RATIO = _registry.gauge(
+    "oim_serve_overlap_ratio",
+    "Fraction of decode-readback wall time spent while another chunk was "
+    "already dispatched (readback the device computed through).  0 on a "
+    "serial engine; approaches 1 when the pipeline is winning.",
+    ("engine",),
+)
+
 
 EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 

@@ -564,18 +564,23 @@ def _decode_chunk(
 
     kv0 = (cache.k, cache.v, cache.k_scale, cache.v_scale)
     (
-        (k_all, v_all, ks_all, vs_all), lengths, _, tok_counts, gen_counts
+        (k_all, v_all, ks_all, vs_all), lengths, last_tok, tok_counts,
+        gen_counts,
     ), (out, lps) = jax.lax.scan(
         one,
         (kv0, cache.lengths, tokens, tok_counts, gen_counts),
         jnp.arange(chunk),
     )
+    # ``last_tok`` [S] (each slot's post-chunk latest token) stays on
+    # device: the pipelined engine feeds it straight into the NEXT
+    # dispatch so chunk N+1 never waits on chunk N's readback.
     return (
         SlotCache(k_all, v_all, lengths, ks_all, vs_all),
         tok_counts,
         gen_counts,
         out.T,
         lps.T,
+        last_tok,
     )
 
 
@@ -721,7 +726,7 @@ def _decode_chunk_spec(
         return (kv, lengths, tok_next, hist), (emitted, lps, n_emit)
 
     kv0 = (cache.k, cache.v, cache.k_scale, cache.v_scale)
-    ((k_all, v_all, ks_all, vs_all), lengths, _, history), (
+    ((k_all, v_all, ks_all, vs_all), lengths, last_tok, history), (
         out, lps, n_emit
     ) = jax.lax.scan(
         one, (kv0, cache.lengths, tokens, history), jnp.arange(chunk)
@@ -732,6 +737,7 @@ def _decode_chunk_spec(
         out.transpose(1, 0, 2),
         lps.transpose(1, 0, 2),
         n_emit.T,
+        last_tok,
     )
 
 
@@ -831,7 +837,7 @@ def _decode_chunk_spec_model(
         (k_all, v_all, ks_all, vs_all),
         (dk, dv, dks, dvs),
         lengths,
-        _,
+        last_tok,
     ), (out, lps, n_emit) = jax.lax.scan(
         one, (kv0, dkv0, cache.lengths, tokens), jnp.arange(chunk)
     )
@@ -841,6 +847,7 @@ def _decode_chunk_spec_model(
         out.transpose(1, 0, 2),
         lps.transpose(1, 0, 2),
         n_emit.T,
+        last_tok,
     )
 
 
@@ -900,6 +907,36 @@ class _SlotState:
     last_token: int = 0
 
 
+@dataclass
+class _InFlightChunk:
+    """One dispatched-but-unread decode chunk — the pipeline's unit.
+
+    ``handles`` are the device futures the host will fetch (out/lps[/
+    n_emit]); ``next_tok`` is the [S] device array of each slot's
+    post-chunk latest token, which a CHAINED dispatch feeds straight
+    back in so chunk N+1 never waits on chunk N's readback;
+    ``counts`` is the host-side per-slot generated-token count fed to
+    THIS dispatch (a chained dispatch sends ``counts + chunk`` — exact
+    for every slot whose sampling keys matter, see
+    ``_dispatch_chunk``); ``inputs`` are the per-slot host sampling
+    arrays, reused verbatim by a chained dispatch (a slot that
+    finished meanwhile keeps computing garbage the host truncates —
+    the EOS-lags-one-chunk contract extended by one pipeline stage);
+    ``snapshot`` maps slot → the state that OWNED it at dispatch time,
+    so processing can never attribute a chunk's tokens to a later
+    occupant.  The engine holds at most one (pipeline depth 2); it is
+    consumed by ``_process_chunk`` or dropped unread by
+    ``abort``/the all-slots-finished tail."""
+
+    kind: str  # "plain" | "spec" | "spec_model"
+    snapshot: dict[int, _SlotState]
+    handles: tuple
+    next_tok: jax.Array
+    counts: np.ndarray
+    inputs: tuple
+    t_dispatch: float
+
+
 class Engine:
     """Continuous-batching engine: submit → step/run → result.
 
@@ -911,6 +948,23 @@ class Engine:
     instead cost one ~70 ms readback per token for the *whole batch*
     whenever any request nears completion).  Compile count: one decode
     program + one admit per prompt bucket.
+
+    **Pipelined decode** (``pipeline_depth=2``, the default): the step
+    loop is a two-deep pipeline — chunk N+1 is dispatched against the
+    donated cache BEFORE chunk N's readback, so device compute overlaps
+    host readback, EOS truncation, and streaming emission (JAX arrays
+    are futures; the chained dispatch consumes the previous chunk's
+    device-side token carry, never a host value).  Semantically safe by
+    the engine's own design: EOS detection already lags by at most one
+    chunk — pipelining extends that lag by exactly one more dispatch of
+    bounded wasted compute, never wrong tokens, and output is
+    token-for-token identical to ``pipeline_depth=1`` (the serial A/B
+    control) for greedy, sampled, speculative, and prefix-cache-injected
+    requests alike (tests/test_serve_pipeline.py pins the matrix).
+    Admissions join at pipeline boundaries: a step with queued requests
+    completes the in-flight chunk before re-prefilling freed slots, and
+    ``drain``/``abort`` quiesce the in-flight dispatch (processed to
+    completion or dropped unread, never leaking a slot).
     """
 
     _instance_lock = threading.Lock()
@@ -937,7 +991,13 @@ class Engine:
         penalties: bool = True,
         max_queue: int = 0,
         prefill_chunk: int = 0,
+        pipeline_depth: int = 2,
     ):
+        if pipeline_depth not in (1, 2):
+            raise ValueError(
+                f"pipeline_depth must be 1 (serial) or 2 (dispatch-ahead "
+                f"double buffering), got {pipeline_depth}"
+            )
         if n_slots < 1 or max_len < 2 or chunk < 1 or prefix_cache_size < 0:
             raise ValueError(
                 f"need n_slots>=1, max_len>=2, chunk>=1, "
@@ -1181,6 +1241,45 @@ class Engine:
         # tunnel.  Accumulated per engine, exported via stats().
         self.host_seconds = 0.0
         self.readback_seconds = 0.0
+        # Pipelined decode (dispatch-ahead double buffering): at depth 2
+        # the engine dispatches chunk N+1 against the donated cache
+        # BEFORE reading back chunk N, so device compute overlaps host
+        # readback + EOS truncation + emission.  Depth 1 is the serial
+        # dispatch→readback→emit loop (the A/B control).
+        self.pipeline_depth = pipeline_depth
+        self._inflight: _InFlightChunk | None = None
+        # The readback split: dispatch_seconds is wall time spent
+        # ENQUEUEING jitted work (donation/queue backpressure shows up
+        # here), readback_seconds is wall time blocked in device_get
+        # (device execution + tunnel rtt), and overlap_seconds is the
+        # part of readback_seconds that ran while another chunk was
+        # already dispatched — readback the device did NOT idle
+        # through.  device_idle_seconds estimates the converse: wall
+        # time between a completed fetch and the next dispatch with
+        # nothing queued on the device.
+        self.dispatch_seconds = 0.0
+        self.overlap_seconds = 0.0
+        self.device_idle_seconds = 0.0
+        # overlap_ratio's denominator: step()'s fetch-wait only.
+        # readback_seconds also absorbs embed/beam (_fetch_aux) — right
+        # for the tunnel-cost forensics, but those fetches can never
+        # overlap a decode dispatch, so counting them would report a
+        # healthy pipelined replica as serial under embed-heavy traffic.
+        self.decode_readback_seconds = 0.0
+        # Chained dispatches elided because the in-flight chunk was
+        # already guaranteed (by token budget alone) to finish every
+        # active slot — each elision is one whole chunk of device
+        # compute the pipeline did NOT waste at a batch tail.
+        self.tail_elisions = 0
+        self._t_device_free: float | None = None
+        # When the previous chunk's processing finished (driver-thread
+        # only): the per-token latency histogram clips each chunk's
+        # dispatch-to-emission window to this, so a pipelined chunk
+        # that sat dispatched-but-unread while its predecessor was
+        # emitted reports its MARGINAL wall, not the deliberate
+        # one-chunk pipeline lag (which would read as a 2x latency
+        # regression at depth 2 with no hardware change).
+        self._t_last_chunk_done: float | None = None
         self._lock = threading.Lock()
         self._queue: list[tuple[int, GenRequest, float]] = []
         self._slots: dict[int, _SlotState] = {}  # slot index → state
@@ -1254,8 +1353,10 @@ class Engine:
         )
         self._m_token_latency = reg.histogram(
             "oim_serve_token_seconds",
-            "Per-token decode latency: one dispatch's wall time (device "
-            "step + readback) amortized over the tokens it emitted — "
+            "Per-token decode latency: one chunk's marginal wall time "
+            "(dispatch-to-emission, clipped to the previous chunk's "
+            "completion so pipelined dispatch-ahead lag is not "
+            "double-counted) amortized over the tokens it emitted — "
             "sub-millisecond on a healthy chip, so FAST_BUCKETS.",
             buckets=_metrics.FAST_BUCKETS,
         )
@@ -1266,6 +1367,15 @@ class Engine:
         self._m_queued = reg.gauge(
             "oim_serve_queued_requests", "Requests waiting for a slot.",
             ("engine",),
+        )
+        # Pipeline health triad — shared definitions (common/metrics.py,
+        # the resilience-instrument pattern) so fleet-wide queries see
+        # one series shape.
+        self._m_pipeline_depth = _metrics.SERVE_PIPELINE_DEPTH
+        self._m_device_idle = _metrics.SERVE_DEVICE_IDLE
+        self._m_overlap = _metrics.SERVE_OVERLAP_RATIO
+        self._m_pipeline_depth.set(
+            float(pipeline_depth), self._engine_label
         )
         # warmup() routes dummy requests through the normal paths; they
         # must not pollute the cumulative request metrics (a fresh daemon
@@ -1405,7 +1515,10 @@ class Engine:
         vec = self._embed(
             self.params, padded, jnp.asarray([len(tokens)], jnp.int32)
         )
-        return [float(x) for x in jax.device_get(vec[0])]
+        # Through the readback accumulator, not raw device_get: embed
+        # pays the same tunnel rtt as a decode chunk and must show in
+        # readbacks/readback_seconds or the swing forensics undercount.
+        return [float(x) for x in self._fetch_aux(vec[0])]
 
     def beam(
         self,
@@ -1512,7 +1625,12 @@ class Engine:
             self._beam_traces.add(trace_key)
         prompt = jnp.asarray([tokens], jnp.int32)
         out, stats = fn(self.params, prompt, max_new_tokens=max_new_tokens)
-        generated = [int(t) for t in jax.device_get(out[0])[len(tokens):]]
+        # ONE accounted readback for tokens + stats (the decode-chunk
+        # attribution contract: beam pays the same tunnel rtt and must
+        # show in readbacks/readback_seconds, not bypass them via raw
+        # device_get).
+        out_h, stats = self._fetch_aux((out[0], stats))
+        generated = [int(t) for t in out_h[len(tokens):]]
         if eos_id is not None:
             # Tokens past the winner's EOS are 0-padding; trim to the
             # real generation (EOS itself included, matching GenRequest
@@ -1573,6 +1691,16 @@ class Engine:
         callers get a RuntimeError instead of waiting out their timeout)."""
         ended = []
         with self._lock:
+            # Quiesce the pipeline: an in-flight dispatch references
+            # only requests failed right here, so its handle is dropped
+            # unread (the device completes the work; nothing consumes
+            # it; the cache future in self._cache stays consistent).
+            # The idle clock resets too — after an abort the engine is
+            # out of work by fiat, and the lull until the next request
+            # is light load, not host-induced chip stall (the
+            # _clear_idle_clock_if_drained contract).
+            self._inflight = None
+            self._t_device_free = None
             pending = [rid for rid, _, _ in self._queue]
             pending += list(self._admitting)
             pending += [s.rid for s in self._slots.values()]
@@ -1652,6 +1780,7 @@ class Engine:
                 "penalties": self.penalties,
                 "prefix_cache_size": self.prefix_cache_size,
                 "prefill_chunk": self.prefill_chunk,
+                "pipeline_depth": self.pipeline_depth,
                 "tp": self.mesh.shape.get("tp", 1) if self.mesh else 1,
                 "ep": self.mesh.shape.get("ep", 1) if self.mesh else 1,
             },
@@ -1659,6 +1788,10 @@ class Engine:
 
     def stats(self) -> dict:
         with self._lock:
+            # Decode fetch-wait only: embed/beam readbacks (counted in
+            # readback_seconds for the tunnel forensics) can never
+            # overlap a decode dispatch and must not dilute the ratio.
+            total = self.decode_readback_seconds
             return {
                 "active_slots": len(self._slots),
                 "free_slots": len(self._free),
@@ -1673,7 +1806,39 @@ class Engine:
                 "readbacks": self.readbacks,
                 "host_seconds": round(self.host_seconds, 4),
                 "readback_seconds": round(self.readback_seconds, 4),
+                # Pipeline forensics: the dispatch-wait vs fetch-wait
+                # split plus how much fetch-wait the device computed
+                # through (doc/operations.md "Serving pipeline tuning").
+                "dispatch_seconds": round(self.dispatch_seconds, 4),
+                "overlap_seconds": round(self.overlap_seconds, 4),
+                "overlap_ratio": round(
+                    self.overlap_seconds / total if total > 0 else 0.0, 4
+                ),
+                "device_idle_seconds": round(self.device_idle_seconds, 4),
+                "tail_elisions": self.tail_elisions,
+                "pipeline_depth": self.pipeline_depth,
+                "inflight_dispatches": int(self._inflight is not None),
             }
+
+    def set_pipeline_depth(self, depth: int) -> None:
+        """Switch between serial (1) and dispatch-ahead (2) decode on a
+        WARM engine — the bench's A/B lever (same compiled programs,
+        only the step loop's overlap changes).  Only legal at a
+        pipeline boundary: call while the engine is idle (no chunk in
+        flight), e.g. between ``run()`` batches or before the driver
+        thread starts."""
+        if depth not in (1, 2):
+            raise ValueError(
+                f"pipeline_depth must be 1 or 2, got {depth}"
+            )
+        with self._lock:
+            if self._inflight is not None:
+                raise RuntimeError(
+                    "set_pipeline_depth needs an idle engine (a decode "
+                    "chunk is in flight; drain or finish run() first)"
+                )
+            self.pipeline_depth = depth
+        self._m_pipeline_depth.set(float(depth), self._engine_label)
 
     def _bucket(self, n: int) -> int:
         for b in self.prompt_buckets:
@@ -1816,34 +1981,197 @@ class Engine:
             jnp.stack([zero_key] * n_slots),
         )
 
-    @staticmethod
-    def _fetch(tree, acc: list):
+    def _fetch(self, tree, acc: list):
         """jax.device_get with the wait attributed to the caller's
         readback accumulator (device execution + tunnel rtt);
-        everything else in step() is host time.  The split adjudicates
-        the serving swing.  ``acc`` is step()'s PER-CALL accumulator —
-        local state, so a second concurrent step() cannot corrupt the
-        attribution."""
+        everything else in step() is host time minus the dispatch-wait
+        split.  The split adjudicates the serving swing.  ``acc`` is
+        step()'s PER-CALL accumulator — local state, so a second
+        concurrent step() cannot corrupt the attribution.  A fetch that
+        runs while another chunk is already dispatched also counts
+        toward ``overlap_seconds`` — readback wall time the device
+        computed through rather than idled through — and a fetch with
+        NOTHING dispatched starts the device-idle clock the next
+        dispatch stops."""
+        overlapped = self._inflight is not None
         t0 = time.monotonic()
         out = jax.device_get(tree)
-        acc[0] += time.monotonic() - t0
+        t1 = time.monotonic()
+        acc[0] += t1 - t0
+        if not self._warming:
+            if overlapped:
+                # Deferred to step()'s finally (same lock-held commit as
+                # the fetch-wait denominator) so a stats() scrape never
+                # sees the numerator ahead of it — overlap_ratio stays
+                # a [0,1] fraction even mid-step.
+                acc[2] += t1 - t0
+            else:
+                self._t_device_free = t1
         return out
+
+    def _fetch_aux(self, tree):
+        """Readback accounting for the slot-free surfaces (embed/beam):
+        same accumulators as step()'s ``_fetch`` — a tunneled
+        deployment pays the same rtt for these, so hiding them from
+        ``readbacks``/``readback_seconds`` skewed the swing forensics —
+        but lock-guarded, because embed/beam run on server handler
+        threads concurrent with the driver."""
+        t0 = time.monotonic()
+        out = jax.device_get(tree)
+        dt = time.monotonic() - t0
+        if not self._warming:
+            with self._lock:
+                self.readbacks += 1
+                self.readback_seconds += dt
+        return out
+
+    def _mark_dispatch(self, t0: float, acc: list) -> None:
+        """Close one jitted-enqueue window: wall time since ``t0`` is
+        dispatch-wait, and any open device-idle window ends at ``t0``
+        (the device has work again)."""
+        now = time.monotonic()
+        acc[1] += now - t0
+        if self._t_device_free is not None:
+            if not self._warming:
+                idle = max(0.0, t0 - self._t_device_free)
+                self.device_idle_seconds += idle
+                self._m_device_idle.inc(self._engine_label, by=idle)
+            self._t_device_free = None
+
+    def _clear_idle_clock_if_drained(self) -> None:
+        """Out of work entirely (no active slots, nothing queued, no
+        chunk in flight): the chip is idle because there is nothing to
+        run, not because the host held it up.  Stop the device-idle
+        clock so the next admission's ``_mark_dispatch`` doesn't book a
+        no-traffic lull as wasted chip time — ``device_idle_seconds``
+        must rank replicas by host-induced stall, not by light load."""
+        if self._inflight is not None:
+            return
+        with self._lock:
+            drained = not self._slots and not self._queue
+        if drained:
+            self._t_device_free = None
 
     def step(self) -> None:
         """Admit whatever fits, then decode one chunk for active slots
         (the full contract is on ``_step_inner``), accumulating the
-        host-vs-readback wall split for the swing forensics."""
+        host / dispatch-wait / fetch-wait wall split for the swing
+        forensics."""
         t0 = time.monotonic()
-        acc = [0.0]
+        acc = [0.0, 0.0, 0.0]  # [fetch-wait, dispatch-wait, overlapped]
         try:
             self._step_inner(acc)
         finally:
             if not self._warming:
-                self.readback_seconds += acc[0]
-                self.host_seconds += time.monotonic() - t0 - acc[0]
+                # Lock-held: _fetch_aux (embed/beam on server handler
+                # threads) adds to readback_seconds concurrently, and an
+                # unlocked += here would lose its increment.
+                with self._lock:
+                    self.readback_seconds += acc[0]
+                    self.decode_readback_seconds += acc[0]
+                    self.dispatch_seconds += acc[1]
+                    self.overlap_seconds += acc[2]
+                    self.host_seconds += (
+                        time.monotonic() - t0 - acc[0] - acc[1]
+                    )
+                    total = self.decode_readback_seconds
+                    ratio = (
+                        self.overlap_seconds / total if total > 0 else 0.0
+                    )
+                self._m_overlap.set(ratio, self._engine_label)
 
     def _step_inner(self, acc: list) -> None:
-        """Admit whatever fits, then decode one chunk for active slots.
+        """One engine step: reconcile the pipeline, admit, dispatch,
+        emit.
+
+        At ``pipeline_depth`` 2 (the default) the step dispatches chunk
+        N+1 against the donated cache BEFORE reading back chunk N, so
+        device compute for the next chunk overlaps host readback, EOS
+        truncation, detokenization, and streaming emission for the
+        previous one.  Exactness is preserved by construction: a
+        chained dispatch takes its tokens from the device-side carry
+        (``next_tok``) and its PRNG counts from ``counts + chunk`` —
+        both identical to what the serial engine would send for every
+        slot whose output is consumed (a slot that finished meanwhile
+        keeps computing inside its own cache region and the host
+        truncates, the engine's existing EOS-lags-one-chunk contract
+        extended by exactly one pipeline stage).
+
+        Admissions join at PIPELINE BOUNDARIES: a slot freed by chunk
+        N's EOS may only be re-prefilled after the in-flight chunk that
+        still references it completes, so a step with queued work AND a
+        slot to put it in first completes the outstanding dispatch,
+        then admits.  Queued work with no free slot does NOT force a
+        boundary — a saturated engine would otherwise run fully serial
+        exactly when the overlap matters most; the step that frees a
+        slot makes the next step a boundary, costing one chunk of
+        admission latency instead.  Depth 1 is the serial loop (every
+        step is a boundary).
+
+        TAIL ELISION: when every active slot's remaining token budget
+        is covered by the chunk already in flight (each dispatch
+        delivers at least ``chunk`` tokens per slot — plain decode
+        exactly ``chunk``, speculative at least one per sub-step), the
+        chained dispatch would be 100% guaranteed waste: the in-flight
+        chunk finishes every slot before its output could ever be
+        consumed.  Force a boundary instead — process the in-flight
+        chunk, then dispatch fresh only if admissions refilled the
+        batch.  EOS-truncated waste stays bounded-and-unpredictable as
+        before; budget exhaustion is host-deterministic, so this waste
+        is simply never dispatched.
+        """
+        with self._lock:
+            elide_tail = (
+                self._inflight is not None
+                and self.pipeline_depth >= 2
+                and all(
+                    state.req.max_new_tokens - len(state.emitted)
+                    <= self.chunk
+                    for state in self._slots.values()
+                )
+            )
+            admit_boundary = bool(self._queue) and bool(self._free)
+            boundary = (
+                admit_boundary or self.pipeline_depth < 2 or elide_tail
+            )
+            if elide_tail and not admit_boundary and not self._warming:
+                # Only count when elision is the REASON for the
+                # boundary — an admission boundary never chains anyway.
+                self.tail_elisions += 1
+        if boundary and self._inflight is not None:
+            prev, self._inflight = self._inflight, None
+            self._process_chunk(prev, acc)
+        self._admit_wave(acc)
+        with self._lock:
+            have_slots = bool(self._slots)
+        if not have_slots:
+            # Every live request finished while a chunk was still in
+            # flight: that chunk references only finished slots
+            # (admissions join at boundaries), so drop the handle
+            # unread — no emission, no readback, bounded wasted
+            # compute.
+            self._inflight = None
+            self._clear_idle_clock_if_drained()
+            return
+        prev = self._inflight
+        handle = self._dispatch_chunk(acc, prev)
+        if self.pipeline_depth >= 2:
+            self._inflight = handle
+            if prev is not None:
+                # Chunk N's readback + emission run while the device
+                # works on chunk N+1 — the overlap this pipeline
+                # exists for.
+                self._process_chunk(prev, acc)
+            with self._lock:
+                empty = not self._slots
+            if empty:
+                self._inflight = None  # tail chunk: dead slots only
+        else:
+            self._process_chunk(handle, acc)
+        self._clear_idle_clock_if_drained()
+
+    def _admit_wave(self, acc: list) -> None:
+        """Admit whatever fits into free slots.
 
         Admissions are BATCHED: one prefill dispatch per distinct prompt
         bucket among this step's admissions (grouping keeps every row at
@@ -1852,7 +2180,17 @@ class Engine:
         would), then ONE combined readback for all first tokens — on a
         tunneled deployment (~70 ms/readback) this is the difference
         between paying the tunnel once per step and once per request.
+        Only admits with no chunk in flight (the pipeline-boundary
+        rule): a submit() that lands between _step_inner's boundary
+        check and this call must wait one step — the in-flight chunk
+        still references every slot, including any freed since its
+        dispatch, so admitting here would chain the new occupant onto
+        the OLD occupant's token carry and sampling params.  The next
+        step with a free slot for the queued work sees the boundary,
+        completes the in-flight chunk, and admits.
         """
+        if self._inflight is not None:
+            return
         with self._lock:
             admissions = []
             while self._queue and self._free:
@@ -1959,6 +2297,7 @@ class Engine:
                     keys[i] = jax.random.fold_in(
                         jax.random.PRNGKey(req.seed), 0
                     )
+                t_disp = time.monotonic()
                 (
                     self._cache, self._history,
                     self._tok_counts, self._gen_counts,
@@ -2003,6 +2342,7 @@ class Engine:
                         jnp.asarray(slot_idx),
                         jnp.asarray(starts + tails),
                     )
+                self._mark_dispatch(t_disp, acc)
                 groups.append((group, first, first_lp))
             for slot, rid, req, _, start, tail, _ in rows:
                 if req.cache_prefix and self.prefix_cache_size:
@@ -2010,7 +2350,8 @@ class Engine:
             # ONE combined readback for every admission this step.
             fetched = self._fetch([(f, lp) for _, f, lp in groups], acc)
             if not self._warming:
-                self.readbacks += 1
+                with self._lock:  # vs _fetch_aux on handler threads
+                    self.readbacks += 1
             notices = []
             with self._lock:
                 for (group, _, _), (f_host, lp_host) in zip(groups, fetched):
@@ -2044,124 +2385,194 @@ class Engine:
                 if done:
                     cb(None, None)
 
+    def _dispatch_chunk(
+        self, acc: list, chained: _InFlightChunk | None
+    ) -> _InFlightChunk:
+        """Dispatch one decode chunk; returns its in-flight handle
+        WITHOUT reading anything back.
+
+        Fresh (``chained is None``, always the dispatch right after a
+        pipeline boundary): every input is built from host slot state,
+        exactly the serial engine's arrays.  Chained (a dispatch while
+        the previous chunk is still unread): ``tokens`` is the previous
+        dispatch's device-side ``next_tok`` carry and ``counts``
+        advances by ``chunk`` — exact because every consumed slot
+        either emitted exactly ``chunk`` tokens in the unread chunk
+        (plain decode; speculative sampled slots emit one per sub-step,
+        so their key indices advance by ``chunk`` too) or finished and
+        is truncated by the snapshot check in ``_process_chunk``
+        (greedy slots never consume the keys at all).  The per-slot
+        sampling arrays are reused verbatim: slots that finished while
+        the previous chunk was in flight stay marked active and compute
+        garbage confined to their own cache region — bounded waste,
+        never wrong tokens, and never visible (their states are gone
+        from ``_slots`` by processing time and admissions only join at
+        boundaries).
+        """
         with self._lock:
-            if not self._slots:
-                return
             slots = dict(self._slots)
             n_slots = self._cache.n_slots
 
-        tokens = jnp.asarray(
-            [
-                slots[i].last_token if i in slots else 0
-                for i in range(n_slots)
-            ],
-            jnp.int32,
-        )
-        temps = jnp.asarray(
-            [
-                slots[i].req.temperature if i in slots else 0.0
-                for i in range(n_slots)
-            ],
-            jnp.float32,
-        )
-        active = jnp.asarray(
-            [i in slots for i in range(n_slots)], bool
-        )
-        top_ps = jnp.asarray(
-            [
-                (
-                    self.default_top_p
-                    if slots[i].req.top_p is None
-                    else slots[i].req.top_p
+        if chained is not None:
+            temps_etc = chained.inputs
+            tokens = chained.next_tok
+            counts = chained.counts + np.int32(self.chunk)
+        else:
+            temps = jnp.asarray(
+                [
+                    slots[i].req.temperature if i in slots else 0.0
+                    for i in range(n_slots)
+                ],
+                jnp.float32,
+            )
+            active = jnp.asarray(
+                [i in slots for i in range(n_slots)], bool
+            )
+            top_ps = jnp.asarray(
+                [
+                    (
+                        self.default_top_p
+                        if slots[i].req.top_p is None
+                        else slots[i].req.top_p
+                    )
+                    if i in slots else 1.0
+                    for i in range(n_slots)
+                ],
+                jnp.float32,
+            )
+            min_ps = jnp.asarray(
+                [
+                    slots[i].req.min_p if i in slots else 0.0
+                    for i in range(n_slots)
+                ],
+                jnp.float32,
+            )
+            zero_key = jax.random.PRNGKey(0)
+            bases = jnp.stack(
+                [
+                    slots[i].base if i in slots else zero_key
+                    for i in range(n_slots)
+                ]
+            )
+            if self.spec_decode:
+                temps_etc = (temps, top_ps, min_ps, active, bases)
+            else:
+                reps = jnp.asarray(
+                    [
+                        slots[i].req.repetition_penalty
+                        if i in slots else 1.0
+                        for i in range(n_slots)
+                    ],
+                    jnp.float32,
                 )
-                if i in slots else 1.0
-                for i in range(n_slots)
-            ],
-            jnp.float32,
-        )
-        min_ps = jnp.asarray(
-            [
-                slots[i].req.min_p if i in slots else 0.0
-                for i in range(n_slots)
-            ],
-            jnp.float32,
-        )
-        zero_key = jax.random.PRNGKey(0)
-        bases = jnp.stack(
-            [slots[i].base if i in slots else zero_key for i in range(n_slots)]
-        )
-        counts = jnp.asarray(
-            [len(slots[i].emitted) if i in slots else 0 for i in range(n_slots)],
-            jnp.int32,
-        )
+                press = jnp.asarray(
+                    [
+                        slots[i].req.presence_penalty
+                        if i in slots else 0.0
+                        for i in range(n_slots)
+                    ],
+                    jnp.float32,
+                )
+                freqs = jnp.asarray(
+                    [
+                        slots[i].req.frequency_penalty
+                        if i in slots else 0.0
+                        for i in range(n_slots)
+                    ],
+                    jnp.float32,
+                )
+                temps_etc = (
+                    temps, top_ps, min_ps, reps, press, freqs, active,
+                    bases,
+                )
+            tokens = jnp.asarray(
+                [
+                    slots[i].last_token if i in slots else 0
+                    for i in range(n_slots)
+                ],
+                jnp.int32,
+            )
+            counts = np.asarray(
+                [
+                    len(slots[i].emitted) if i in slots else 0
+                    for i in range(n_slots)
+                ],
+                np.int32,
+            )
+
         t_dispatch = time.monotonic()
         if self.spec_decode and self._draft_cache is not None:
+            temps, top_ps, min_ps, active, bases = temps_etc
             (
-                self._cache, self._draft_cache, out3, lps3, n_emit
+                self._cache, self._draft_cache, out3, lps3, n_emit,
+                next_tok,
             ) = self._decode(
                 self.params, self.draft_params, self._cache,
                 self._draft_cache, tokens, temps, top_ps, min_ps, active,
-                bases, counts,
+                bases, jnp.asarray(counts),
             )
-            out3, lps3, n_emit = self._fetch((out3, lps3, n_emit), acc)
-            if not self._warming:
-                self.readbacks += 1
+            kind, handles = "spec_model", (out3, lps3, n_emit)
         elif self.spec_decode:
+            temps, top_ps, min_ps, active, bases = temps_etc
             (
-                self._cache, self._history, out3, lps3, n_emit
+                self._cache, self._history, out3, lps3, n_emit, next_tok
             ) = self._decode(
                 self.params, self._cache, self._history, tokens, temps,
-                top_ps, min_ps, active, bases, counts,
+                top_ps, min_ps, active, bases, jnp.asarray(counts),
             )
-            # ONE readback per chunk, speculative or not.
-            out3, lps3, n_emit = self._fetch((out3, lps3, n_emit), acc)
-            if not self._warming:
-                self.readbacks += 1
+            kind, handles = "spec", (out3, lps3, n_emit)
         else:
-            reps = jnp.asarray(
-                [
-                    slots[i].req.repetition_penalty if i in slots else 1.0
-                    for i in range(n_slots)
-                ],
-                jnp.float32,
-            )
-            press = jnp.asarray(
-                [
-                    slots[i].req.presence_penalty if i in slots else 0.0
-                    for i in range(n_slots)
-                ],
-                jnp.float32,
-            )
-            freqs = jnp.asarray(
-                [
-                    slots[i].req.frequency_penalty if i in slots else 0.0
-                    for i in range(n_slots)
-                ],
-                jnp.float32,
+            temps, top_ps, min_ps, reps, press, freqs, active, bases = (
+                temps_etc
             )
             (
-                self._cache, self._tok_counts, self._gen_counts, out, lps
+                self._cache, self._tok_counts, self._gen_counts, out,
+                lps, next_tok,
             ) = self._decode(
                 self.params, self._cache, self._tok_counts,
                 self._gen_counts, tokens, temps, top_ps, min_ps,
-                reps, press, freqs, active, bases, counts,
+                reps, press, freqs, active, bases, jnp.asarray(counts),
             )
-            out, lps = self._fetch((out, lps), acc)
-            if not self._warming:
-                self.readbacks += 1
-            out3, lps3 = out[:, :, None], lps[:, :, None]
-            n_emit = np.ones(out3.shape[:2], np.int32)
+            kind, handles = "plain", (out, lps)
+        self._mark_dispatch(t_dispatch, acc)
         self._step_count += 1
         self._m_dispatches.inc()
+        return _InFlightChunk(
+            kind=kind,
+            snapshot=slots,
+            handles=handles,
+            next_tok=next_tok,
+            counts=counts,
+            inputs=temps_etc,
+            t_dispatch=t_dispatch,
+        )
+
+    def _process_chunk(self, handle: _InFlightChunk, acc: list) -> None:
+        """Fetch one dispatched chunk's tokens and emit them: ONE
+        readback per chunk, speculative or not, then EOS/stop/budget
+        truncation, completion bookkeeping, and streaming callbacks (in
+        submission order per request — the driver thread is the only
+        emitter, so pipelining cannot reorder a stream)."""
+        if handle.kind == "plain":
+            out, lps = self._fetch(handle.handles, acc)
+            out3, lps3 = out[:, :, None], lps[:, :, None]
+            n_emit = np.ones(out3.shape[:2], np.int32)
+        else:
+            out3, lps3, n_emit = self._fetch(handle.handles, acc)
         if not self._warming:
-            emitted = sum(int(n_emit[slot].sum()) for slot in slots)
-            if emitted:
-                self._m_token_latency.observe(
-                    (time.monotonic() - t_dispatch) / emitted
-                )
+            with self._lock:  # vs _fetch_aux on handler threads
+                self.readbacks += 1
+        t_done = time.monotonic()
+        emitted_total = 0
         notices = []  # (callback, tokens..., end?) fired outside the lock
         with self._lock:
-            for slot, state in list(slots.items()):
+            for slot, state in list(handle.snapshot.items()):
+                if self._slots.get(slot) is not state:
+                    # The request finished in an earlier chunk while
+                    # this one was in flight (pipeline lag): its rows
+                    # here are post-EOS garbage — emit nothing.
+                    continue
+                emitted_total += int(n_emit[slot].sum())
                 done = False
                 fresh = []
                 greedy = state.req.temperature <= 0.0
@@ -2195,6 +2606,12 @@ class Engine:
                     notices.append((cb, fresh, done))
                 if done and slot in self._slots:
                     self._finish(slot, state)
+        if not self._warming and emitted_total:
+            start = handle.t_dispatch
+            if self._t_last_chunk_done is not None:
+                start = max(start, self._t_last_chunk_done)
+            self._m_token_latency.observe((t_done - start) / emitted_total)
+        self._t_last_chunk_done = t_done
         for cb, fresh, done in notices:
             for token, lp in fresh:
                 cb(token, lp)

@@ -76,6 +76,12 @@ class Backend:
     # routing only applies to cache-running backends — pinning a hot
     # prefix to one backend is pure load skew if nothing caches it.
     prefix_cache: bool = False
+    # Also from /v1/info: the engine's decode pipeline depth (2 =
+    # dispatch-ahead double buffering).  Surfaced in the router's
+    # /v1/stats so a fleet operator can spot a replica accidentally
+    # running serial (pipeline_depth 1) — roughly a 2x throughput skew
+    # on tunneled deployments — without curling every backend.
+    pipeline_depth: int = 0
     info_fetched: bool = False
 
 
@@ -508,6 +514,9 @@ class Router:
             backend.prefix_cache = bool(
                 info.get("engine", {}).get("prefix_cache_size", 0)
             )
+            backend.pipeline_depth = int(
+                info.get("engine", {}).get("pipeline_depth", 0)
+            )
             backend.info_fetched = True
 
     def _health_loop(self) -> None:
@@ -690,6 +699,8 @@ class Router:
                         "active": b.active,
                         "completed": b.completed,
                         "from_registry": b.from_registry,
+                        # 0 until the first /v1/info fetch succeeds.
+                        "pipeline_depth": b.pipeline_depth,
                     }
                     for b in self._backends.values()
                 },

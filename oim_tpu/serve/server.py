@@ -48,7 +48,12 @@ API:
                     {"message": {"role": "assistant", "content": ...}};
                     stream=true sends chat.completion.chunk deltas.
   GET  /healthz      → {"ok": true}
-  GET  /v1/stats     → engine stats (slots, queue depth, tokens generated)
+  GET  /v1/stats     → engine stats (slots, queue depth, tokens
+                    generated, and the decode-pipeline forensics:
+                    pipeline_depth, dispatch_seconds vs readback_seconds
+                    — the dispatch-wait/fetch-wait split — plus
+                    overlap_ratio and device_idle_seconds; see
+                    doc/operations.md "Serving pipeline tuning")
   GET  /v1/info      → static model/engine description (geometry, params,
                     capacity shape, live features) — cacheable
   GET  /metrics      → Prometheus exposition (shared registry)
@@ -168,7 +173,13 @@ class ServeServer:
                 feeds a queue (callbacks must not block the driver
                 thread); this handler drains it onto the socket.  A
                 client that disconnects mid-stream forfeits the result
-                (engine.forget) — generation itself runs to completion."""
+                (engine.forget) — generation itself runs to completion.
+                Ordering holds under the pipelined engine too: chunks
+                are processed in dispatch order on the one driver
+                thread, so per-request callbacks (and the terminating
+                ``(None, None)``) arrive exactly as the serial engine
+                would deliver them — tokens merely land one chunk
+                later."""
                 tokens_q: queue.Queue = queue.Queue()
                 decoder = (
                     outer.tokenizer.stream_decoder()

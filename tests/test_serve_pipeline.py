@@ -1,0 +1,434 @@
+"""Pipelined decode: dispatch-ahead double buffering must be invisible.
+
+The load-bearing property (ISSUE 5): an engine at ``pipeline_depth=2``
+— chunk N+1 dispatched before chunk N's readback — emits exactly the
+tokens the serial (``pipeline_depth=1``) engine emits, for greedy,
+sampled, speculative, and prefix-cache-injected requests, dense and
+MoE, admissions mid-stream included.  ``set_pipeline_depth`` flips one
+warm engine between the modes, so every A/B below compares the SAME
+compiled programs and only the step loop's overlap differs.
+
+Also here: drain/abort with a chunk in flight (the quiesce contract —
+nothing emitted past EOS, no slot leaked), the readback-attribution
+fix for embed/beam (they must hit ``readbacks``/``readback_seconds``,
+not bypass the accumulator via raw device_get), and the overlap /
+device-idle accounting the "Serving pipeline tuning" runbook reads.
+
+Kept deliberately lean: engines are shared per model config and
+prompts stay in one small bucket — this file backs ``make test-serve``
+(<60 s cap).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from oim_tpu.common import metrics as _metrics
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.models.decode import generate
+from oim_tpu.serve import Engine, GenRequest
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def dense_engine(setup):
+    cfg, params = setup
+    # One bucket (prompts stay <= 16) bounds the compile count; the
+    # prefix cache is on so the matrix's injected-rows variant runs on
+    # this same engine.
+    return Engine(params, cfg, n_slots=3, max_len=64, chunk=4,
+                  prompt_buckets=(16,), prefix_cache_size=2)
+
+
+def _prompt(seed: int, n: int, vocab: int) -> list[int]:
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, vocab, size=n).tolist()
+
+
+def _echo_prompt(n: int, vocab: int) -> list[int]:
+    pattern = [7, 21, 40, 3]
+    return [t % vocab for t in (pattern * ((n // 4) + 1))[:n]]
+
+
+def _oracle(params, cfg, tokens, max_new) -> list[int]:
+    prompt = jax.numpy.asarray(tokens, jax.numpy.int32)[None]
+    out = generate(params, prompt, cfg, max_new_tokens=max_new)
+    return np.asarray(out)[0, len(tokens):].tolist()
+
+
+def _matrix_workload(engine, vocab, system):
+    """The exactness-matrix traffic shape on one engine: more requests
+    than slots (queue pressure), greedy + sampled rows, a
+    cache_prefix-marked system prompt plus a request sharing it (a
+    prefix-cache hit once the entry exists), and a mid-stream admission
+    wave landing while chunks are in flight."""
+    specs = [
+        # (tokens, max_new, temperature, seed, cache_prefix)
+        (system, 8, 0.0, 0, True),
+        (_prompt(21, 9, vocab), 10, 0.8, 7, False),
+        (_prompt(22, 5, vocab), 6, 0.0, 0, False),
+    ]
+    rids = [
+        engine.submit(GenRequest(
+            tokens=t, max_new_tokens=m, temperature=temp, seed=s,
+            cache_prefix=c,
+        ))
+        for t, m, temp, s, c in specs
+    ]
+    engine.step()
+    engine.step()
+    # Mid-stream: a prefix-cache candidate (shares the system prompt)
+    # and one more sampled request join while slots are busy.
+    late = [
+        (system + _prompt(23, 4, vocab), 7, 0.0, 0, False),
+        (_prompt(24, 6, vocab), 5, 0.5, 3, False),
+    ]
+    rids += [
+        engine.submit(GenRequest(
+            tokens=t, max_new_tokens=m, temperature=temp, seed=s,
+            cache_prefix=c,
+        ))
+        for t, m, temp, s, c in late
+    ]
+    results = engine.run()
+    return [results[r] for r in rids], [s[:2] for s in specs + late]
+
+
+def test_exactness_matrix_dense(setup, dense_engine):
+    """Pipelined == serial, token for token, on the dense engine across
+    greedy / sampled / prefix-cache / mid-stream admission — and the
+    greedy rows equal the solo oracle, so BOTH modes are exact, not
+    merely identical."""
+    cfg, params = setup
+    engine = dense_engine
+    system = _prompt(20, 10, cfg.vocab_size)
+
+    engine.set_pipeline_depth(1)
+    serial, shapes = _matrix_workload(engine, cfg.vocab_size, system)
+    hits_before = engine.stats()["prefix_hits"]
+    engine.set_pipeline_depth(2)
+    pipelined, _ = _matrix_workload(engine, cfg.vocab_size, system)
+
+    assert pipelined == serial
+    # The pipelined pass really exercised the injection path (the
+    # serial pass populated the cache).
+    assert engine.stats()["prefix_hits"] > hits_before
+    # Greedy rows against the solo oracle (rows 0 and 2 are temp=0).
+    for idx in (0, 2):
+        tokens, max_new = shapes[idx]
+        assert serial[idx] == _oracle(params, cfg, tokens, max_new)
+
+
+def test_exactness_matrix_moe(setup):
+    """Same matrix on a MoE model: drop-free per-token routing keeps
+    pipelining invisible there too (padding/batching independence is
+    routing-exactness, ISSUE matrix × {dense, MoE})."""
+    cfg = TransformerConfig(**{**CFG, "n_experts": 2})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    engine = Engine(params, cfg, n_slots=3, max_len=64, chunk=4,
+                    prompt_buckets=(16,), prefix_cache_size=2)
+    system = _prompt(40, 10, cfg.vocab_size)
+    engine.set_pipeline_depth(1)
+    serial, shapes = _matrix_workload(engine, cfg.vocab_size, system)
+    engine.set_pipeline_depth(2)
+    pipelined, _ = _matrix_workload(engine, cfg.vocab_size, system)
+    assert pipelined == serial
+    tokens, max_new = shapes[0]
+    assert serial[0] == _oracle(params, cfg, tokens, max_new)
+
+
+def test_exactness_spec_decode(setup):
+    """Speculative engine (prompt-lookup drafting): pipelined == serial
+    on echo prompts (high acceptance — multi-token emission rows) and a
+    sampled request (the fold_in(base, counts+i) key-index chaining the
+    pipelined dispatch must reproduce)."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                    prompt_buckets=(16,), spec_decode=2)
+
+    def workload():
+        rids = [
+            engine.submit(GenRequest(
+                tokens=_echo_prompt(12, cfg.vocab_size), max_new_tokens=10,
+            )),
+            engine.submit(GenRequest(
+                tokens=_prompt(50, 9, cfg.vocab_size), max_new_tokens=7,
+                temperature=0.8, seed=11,
+            )),
+        ]
+        engine.step()
+        rids.append(engine.submit(GenRequest(
+            tokens=_echo_prompt(8, cfg.vocab_size), max_new_tokens=6,
+        )))
+        results = engine.run()
+        return [results[r] for r in rids]
+
+    engine.set_pipeline_depth(1)
+    serial = workload()
+    engine.set_pipeline_depth(2)
+    assert workload() == serial
+    # Greedy echo row must equal the solo oracle through BOTH layers of
+    # lag (speculative rejection + pipeline).
+    assert serial[0] == _oracle(
+        params, cfg, _echo_prompt(12, cfg.vocab_size), 10
+    )
+
+
+def test_exactness_spec_draft_model(setup):
+    """Model-drafted speculation: the chained dispatch threads the
+    draft cache's shared-lengths discipline too."""
+    cfg, params = setup
+    draft_cfg = TransformerConfig(**{**CFG, "d_model": 16, "n_layers": 1,
+                                     "n_heads": 2, "d_ff": 32})
+    draft_params = init_params(jax.random.PRNGKey(1), draft_cfg)
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=2,
+                    prompt_buckets=(16,), spec_decode=2,
+                    draft_params=draft_params, draft_cfg=draft_cfg)
+    req = dict(tokens=_prompt(60, 7, cfg.vocab_size), max_new_tokens=6)
+    engine.set_pipeline_depth(1)
+    rid0 = engine.submit(GenRequest(**req))
+    serial = engine.run()[rid0]
+    engine.set_pipeline_depth(2)
+    rid = engine.submit(GenRequest(**req))
+    assert engine.run()[rid] == serial == _oracle(
+        params, cfg, req["tokens"], req["max_new_tokens"]
+    )
+
+
+def test_abort_quiesces_inflight_chunk(setup, dense_engine):
+    """abort() with a chunk in flight: the in-flight handle is dropped,
+    every request fails with the abort message, no slot leaks, and the
+    engine keeps working afterwards (the donated-cache future stays
+    consistent)."""
+    cfg, params = setup
+    engine = dense_engine
+    rids = [
+        engine.submit(GenRequest(
+            tokens=_prompt(80 + i, 5, cfg.vocab_size), max_new_tokens=12,
+        ))
+        for i in range(2)
+    ]
+    engine.step()
+    assert engine.stats()["inflight_dispatches"] == 1
+    engine.abort("test abort")
+    assert engine.stats()["inflight_dispatches"] == 0
+    for rid in rids:
+        with pytest.raises(RuntimeError, match="test abort"):
+            engine.result(rid, timeout=0)
+    assert engine.in_flight() == 0
+    assert engine.stats()["free_slots"] == 3
+    # Post-abort exactness: the engine is still serving correctly.
+    tokens = _prompt(85, 6, cfg.vocab_size)
+    rid = engine.submit(GenRequest(tokens=tokens, max_new_tokens=5))
+    assert engine.run()[rid] == _oracle(params, cfg, tokens, 5)
+
+
+def test_streaming_order_under_pipeline(setup, dense_engine):
+    """Streaming callbacks stay ordered and complete under pipelining:
+    per-token calls arrive in emission order, then exactly one
+    (None, None) terminator, and the stream equals the stored result."""
+    cfg, params = setup
+    engine = dense_engine
+    seen = []
+    rid = engine.submit(
+        GenRequest(tokens=_prompt(90, 7, cfg.vocab_size),
+                   max_new_tokens=9),
+        on_token=lambda t, lp: seen.append(t),
+    )
+    result = engine.run()[rid]
+    assert seen == result + [None]
+    engine.result(rid, timeout=0)  # consume
+
+
+def test_embed_and_beam_hit_readback_accumulator(setup, dense_engine):
+    """The attribution-leak fix: _embed_inner and _beam_inner route
+    their readbacks through the accumulator, so a tunneled deployment's
+    swing forensics see them in readbacks/readback_seconds."""
+    cfg, params = setup
+    engine = dense_engine
+    before = engine.stats()["readbacks"]
+    engine.embed(_prompt(91, 6, cfg.vocab_size))
+    assert engine.stats()["readbacks"] == before + 1
+    before_s = engine.stats()["readback_seconds"]
+    engine.beam(_prompt(92, 5, cfg.vocab_size), max_new_tokens=3,
+                beam_size=2)
+    st = engine.stats()
+    assert st["readbacks"] == before + 2
+    assert st["readback_seconds"] >= before_s
+
+
+def test_overlap_and_idle_accounting(setup, dense_engine):
+    """The runbook's split, delta-measured on the shared (already warm,
+    already used) engine: a serial phase accrues zero NEW overlap and
+    positive device idle; flipped back to depth 2 the same engine
+    accrues overlapped readback, the stats ratio stays positive, and
+    the shared Prometheus gauges track the depth per engine."""
+    cfg, params = setup
+    engine = dense_engine
+    label = engine._engine_label
+
+    engine.set_pipeline_depth(1)
+    before = engine.stats()
+    rid = engine.submit(GenRequest(tokens=_prompt(95, 6, cfg.vocab_size),
+                                   max_new_tokens=16))
+    engine.run()
+    st = engine.stats()
+    assert st["overlap_seconds"] == before["overlap_seconds"]  # no new
+    assert st["device_idle_seconds"] > before["device_idle_seconds"]
+    assert st["pipeline_depth"] == 1
+    assert _metrics.SERVE_PIPELINE_DEPTH.value(label) == 1.0
+    assert st["dispatch_seconds"] > 0.0  # the dispatch-wait split exists
+    assert st["readback_seconds"] > before["readback_seconds"]
+
+    engine.set_pipeline_depth(2)
+    rid2 = engine.submit(GenRequest(tokens=_prompt(96, 6, cfg.vocab_size),
+                                    max_new_tokens=16))
+    results = engine.run()
+    st2 = engine.stats()
+    assert st2["overlap_seconds"] > st["overlap_seconds"]
+    assert st2["overlap_ratio"] > 0.0
+    assert st2["pipeline_depth"] == 2
+    assert _metrics.SERVE_PIPELINE_DEPTH.value(label) == 2.0
+    assert _metrics.SERVE_OVERLAP_RATIO.value(label) > 0.0
+    # Both runs' results intact (run() retains unfetched results).
+    assert len(results[rid]) == 16 and len(results[rid2]) == 16
+
+
+def test_no_admission_while_chunk_in_flight(setup, dense_engine):
+    """The pipeline-boundary rule enforced inside _admit_wave: a
+    submit() landing AFTER _step_inner's boundary check (empty queue
+    seen, chunk left in flight) must wait one step rather than admit —
+    the in-flight chunk still references every slot, and admitting
+    into one would chain the new request onto the old occupant's token
+    carry.  Simulated deterministically by calling _admit_wave directly
+    with a chunk in flight, exactly the raced interleaving."""
+    cfg, params = setup
+    engine = dense_engine
+    rid_a = engine.submit(GenRequest(tokens=_prompt(97, 6, cfg.vocab_size),
+                                     max_new_tokens=12))
+    engine.step()  # admit A, dispatch chunk 1, keep it in flight
+    assert engine.in_flight() == 1
+    rid_b = engine.submit(GenRequest(tokens=_prompt(98, 7, cfg.vocab_size),
+                                     max_new_tokens=8))
+    before = engine.stats()
+    engine._admit_wave([0.0, 0.0])  # the raced post-boundary admit
+    st = engine.stats()
+    assert st["queued"] == before["queued"]  # B still queued
+    assert st["active_slots"] == before["active_slots"]
+    results = engine.run()  # next boundary admits B normally
+    # Exactness vs the serial engine (same compiled programs — no
+    # fresh oracle compile inside test-serve's 60 s budget).
+    engine.set_pipeline_depth(1)
+    rid_a2 = engine.submit(GenRequest(
+        tokens=_prompt(97, 6, cfg.vocab_size), max_new_tokens=12))
+    rid_b2 = engine.submit(GenRequest(
+        tokens=_prompt(98, 7, cfg.vocab_size), max_new_tokens=8))
+    sync = engine.run()
+    engine.set_pipeline_depth(2)
+    assert results[rid_a] == sync[rid_a2]
+    assert results[rid_b] == sync[rid_b2]
+
+
+def test_aux_readbacks_do_not_dilute_overlap_ratio(setup, dense_engine):
+    """embed/beam fetch-wait lands in readback_seconds (the tunnel
+    forensics) but NOT in overlap_ratio's denominator: an embed-heavy
+    replica's ratio keeps reflecting its decode pipeline."""
+    cfg, params = setup
+    engine = dense_engine
+    engine.submit(GenRequest(tokens=_prompt(99, 6, cfg.vocab_size),
+                             max_new_tokens=12))
+    engine.run()
+    before = engine.stats()
+    assert before["overlap_ratio"] > 0.0
+    for i in range(3):
+        engine.embed(_prompt(100 + i, 6, cfg.vocab_size))
+    st = engine.stats()
+    assert st["readback_seconds"] > before["readback_seconds"]
+    assert st["overlap_ratio"] == before["overlap_ratio"]
+
+
+def test_pipeline_depth_validation(setup, dense_engine):
+    cfg, params = setup
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        Engine(params, cfg, n_slots=1, max_len=16, pipeline_depth=3)
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        dense_engine.set_pipeline_depth(0)
+    assert dense_engine.info()["engine"]["pipeline_depth"] == 2
+
+
+def test_tail_elision_skips_guaranteed_waste(setup, dense_engine):
+    """When the chunk in flight already covers every active slot's
+    remaining token budget, the chained dispatch would be 100%
+    guaranteed waste (budget exhaustion is host-deterministic, unlike
+    EOS) — the engine forces a boundary instead: same dispatch count
+    as the serial engine, ``tail_elisions`` counts the skip, and the
+    output is unchanged."""
+    cfg, params = setup
+    engine = dense_engine
+    tokens = _prompt(110, 6, cfg.vocab_size)
+
+    engine.set_pipeline_depth(1)
+    before = engine.stats()
+    rid_s = engine.submit(GenRequest(tokens=tokens, max_new_tokens=6))
+    serial = engine.run()[rid_s]
+    mid = engine.stats()
+    assert mid["tail_elisions"] == before["tail_elisions"]  # serial: never
+    steps_serial = mid["steps"] - before["steps"]
+
+    engine.set_pipeline_depth(2)
+    rid_p = engine.submit(GenRequest(tokens=tokens, max_new_tokens=6))
+    pipelined = engine.run()[rid_p]
+    st = engine.stats()
+    assert pipelined == serial
+    assert st["tail_elisions"] == mid["tail_elisions"] + 1
+    # The elided dispatch is the whole point: without it the pipelined
+    # run would cost one extra (wasted) chunk dispatch at the tail.
+    assert st["steps"] - mid["steps"] == steps_serial
+
+
+def test_drain_completes_inflight_chunk(setup, dense_engine):
+    """drain() with a chunk in flight: the dispatch completes, nothing
+    past EOS is emitted, and no slot leaks (in_flight() == 0, all slots
+    free).  LAST in the module on purpose — draining is terminal, and
+    reusing the shared engine here saves a compile set (make
+    test-serve's 60 s budget)."""
+    cfg, params = setup
+    engine = dense_engine
+    tokens = _prompt(70, 6, cfg.vocab_size)
+    oracle = _oracle(params, cfg, tokens, 12)
+    # EOS at the oracle's 5th token: lands mid-chunk, and with the
+    # pipeline's one-chunk lag the engine decodes a full extra chunk
+    # past it that must all be truncated.
+    eos = oracle[4]
+    rid = engine.submit(GenRequest(tokens=tokens, max_new_tokens=12,
+                                   eos_id=eos))
+    engine.step()  # admit + dispatch chunk 1; nothing processed yet
+    assert engine.stats()["inflight_dispatches"] == 1
+    engine.drain()
+    with pytest.raises(Exception):  # DrainingError
+        engine.submit(GenRequest(tokens=tokens, max_new_tokens=1))
+    while engine.pending():
+        engine.step()
+    got = engine.result(rid, timeout=0)
+    assert got == oracle[:5] and got[-1] == eos  # EOS included, nothing past
+    assert engine.in_flight() == 0
+    st = engine.stats()
+    assert st["active_slots"] == 0
+    assert st["free_slots"] == engine._cache.n_slots
+    assert st["inflight_dispatches"] == 0
