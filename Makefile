@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve lint lint-metrics agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-chaos lint lint-metrics agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -69,6 +69,26 @@ test-serve:
 	  --passes lock-discipline,resource-lifecycle --roots oim_tpu/serve
 	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_serve_pipeline.py -q -m "not slow" -p no:cacheprovider
+
+# Serve-plane fault tolerance (chaos marker): the splice-failover soak
+# (backend killed mid-stream at 20% over 40+ cycles, token-identical
+# greedy streams), deadline/shedding/brownout, client-disconnect
+# cancellation, the driver-crash waiter latch, and the stall watchdog.
+# Nominal runtime ~55s; the cap carries the same 2-3x CPU-quota
+# headroom as test-serve (a 60s cap flaked at full green there).
+# Also runs the oimlint lock-discipline + resource-lifecycle passes over
+# the serve plane (and the chaos/metrics modules this suite leans on)
+# so watchdog/error-latch thread ownership stays clean in the analyzer,
+# not grandfathered in baseline.
+test-serve-chaos:
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,resource-lifecycle --roots oim_tpu/serve
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,resource-lifecycle,metrics \
+	  --roots oim_tpu/common
+	timeout -k 10 150 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_serve_chaos.py -q -m "chaos and not slow" \
+	  -p no:cacheprovider
 
 # oimvet: the multi-pass control-plane static analyzer (tools/oimlint —
 # lock-discipline, resource-lifecycle, authz-coverage, protocol-drift,

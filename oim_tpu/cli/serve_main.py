@@ -118,6 +118,30 @@ def build_parser() -> argparse.ArgumentParser:
         help="admission queue bound (HTTP 429 beyond it; 0 = unbounded)",
     )
     p.add_argument(
+        "--brownout-max-tokens", type=int, default=0, metavar="N",
+        help="brownout: under sustained queue pressure (queue >= 75%% of "
+        "--max-queue for over a second), clamp incoming requests' "
+        "max_new_tokens to N instead of letting the backlog grow to the "
+        "hard 429 — degraded answers beat errors (0 = off)",
+    )
+    p.add_argument(
+        "--watchdog-interval", type=float, default=1.0, metavar="S",
+        help="stall-watchdog poll interval: a decode chunk blocking the "
+        "driver past max(--stall-floor, --stall-multiplier x its EWMA "
+        "wall) fails in-flight requests fast and flips /healthz "
+        "(0 = watchdog off)",
+    )
+    p.add_argument(
+        "--stall-multiplier", type=float, default=8.0,
+        help="stall verdict: device wait exceeding this multiple of the "
+        "chunk-wall EWMA",
+    )
+    p.add_argument(
+        "--stall-floor", type=float, default=30.0, metavar="S",
+        help="never call a stall before this many seconds of device "
+        "wait (headroom for one-off recompiles)",
+    )
+    p.add_argument(
         "--drain-timeout", type=float, default=120.0,
         help="seconds to let in-flight requests finish on SIGTERM before "
         "exiting",
@@ -356,6 +380,7 @@ def make_engine(args):
         max_queue=args.max_queue,
         prefill_chunk=args.prefill_chunk,
         pipeline_depth=args.pipeline_depth,
+        brownout_max_tokens=args.brownout_max_tokens,
     )
 
 
@@ -420,6 +445,9 @@ def main(argv=None) -> int:
     server = ServeServer(
         engine, host=args.host, port=args.port, ssl_context=ssl_context,
         tokenizer=tokenizer,
+        watchdog_interval=args.watchdog_interval,
+        stall_multiplier=args.stall_multiplier,
+        stall_floor_s=args.stall_floor,
     ).start()
     log.current().info(
         "oim-serve listening", host=server.host, port=server.port,
@@ -431,6 +459,10 @@ def main(argv=None) -> int:
         registration.advertised_address = (
             args.advertise or f"{scheme}://{server.host}:{server.port}"
         )
+        # Health-gated heartbeat: a latched driver death or decode
+        # stall actively WITHDRAWS the discovery key (one watch event)
+        # instead of waiting out probe failures + lease expiry.
+        registration.health = lambda: server.error is None
         registration.start()
         # Durable WARNING+ publication under the serving identity (TLS
         # CN serve.<id> — the registry's events/ authz subtree).

@@ -1,6 +1,6 @@
-"""Chaos-injection harness for the control plane.
+"""Chaos-injection harness for the control AND serve planes.
 
-Two fault surfaces, one seeded-RNG discipline (tests replay exactly):
+Three fault surfaces, one seeded-RNG discipline (tests replay exactly):
 
 - ``FlakyChannel``: wraps a ``grpc.Channel`` and injects transport
   failures into unary calls — *before* the call (``error``: the request
@@ -13,8 +13,13 @@ Two fault surfaces, one seeded-RNG discipline (tests replay exactly):
   knobs (oim_tpu/agent/fake.py) for a scope — whole-stack chaos at the
   device-plane hop, where drops surface to the CSI plane as UNAVAILABLE
   through the controller.
+- ``FlakyHTTPBackend``: an HTTP proxy in front of a real ``oim-serve``
+  backend that kills responses mid-stream (the backend-process-death
+  signature the router's stream-splice failover exists for), truncates
+  buffered bodies short of their declared Content-Length, flakes its
+  ``/healthz``, and slow-walks chunks — the serve-plane soak surface.
 
-Both are product-adjacent test infrastructure (importable from tests and
+All are product-adjacent test infrastructure (importable from tests and
 from `oimctl`-driven game days), not production code paths: nothing in
 the daemons imports this module.
 """
@@ -22,7 +27,11 @@ the daemons imports this module.
 from __future__ import annotations
 
 import random
+import threading
 import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 import grpc
@@ -151,6 +160,198 @@ class FlakyChannel:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class FlakyHTTPBackend:
+    """Serve-plane chaos: an HTTP proxy in front of a real oim-serve
+    instance.
+
+    Faults (seeded like ``FlakyChannel``; ``fail_next(n)`` scripts the
+    next ``n`` POSTs deterministically):
+
+    - ``kill_rate``: probability a proxied POST's response is severed
+      mid-body.  Close-delimited NDJSON streams are cut after
+      ``kill_after_lines`` COMPLETE lines (clean FIN, no terminal
+      done/error line — exactly what a killed backend process looks
+      like to the router); Content-Length bodies are cut at half their
+      declared length (truncation proof).
+    - ``healthz_error_rate``: probability a GET /healthz answers an
+      injected 503 — the health-flapping surface.
+    - ``delay_s``: sleep per response chunk (slow backend).
+
+    ``start()`` returns self; point the router at ``.url``.
+    """
+
+    def __init__(
+        self,
+        backend_url: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        kill_rate: float = 0.0,
+        kill_after_lines: int = 1,
+        healthz_error_rate: float = 0.0,
+        delay_s: float = 0.0,
+        seed: int = 0,
+    ):
+        self.backend_url = backend_url.rstrip("/")
+        self.kill_rate = kill_rate
+        self.kill_after_lines = kill_after_lines
+        self.healthz_error_rate = healthz_error_rate
+        self.delay_s = delay_s
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._forced = 0
+        self.requests = 0
+        self.kills = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz" and outer._roll(
+                    outer.healthz_error_rate
+                ):
+                    body = b'{"ok": false, "error": "injected"}'
+                    self.send_response(503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                outer._forward(self, None)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                outer._forward(self, self.rfile.read(length))
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def fail_next(self, n: int = 1) -> None:
+        with self._lock:
+            self._forced += n
+
+    def _roll(self, rate: float) -> bool:
+        with self._lock:
+            return self._rng.random() < rate
+
+    def _kill_roll(self) -> bool:
+        """Decide whether THIS POST should be killed.  ``kills`` is
+        counted at execution (_count_kill), not here — a roll whose
+        response turns out to be an HTTP error, or a stream shorter
+        than ``kill_after_lines``, injects nothing, and the soak
+        assertions must count real injections only."""
+        with self._lock:
+            self.requests += 1
+            if self._forced > 0:
+                self._forced -= 1
+                return True
+            return self._rng.random() < self.kill_rate
+
+    def _count_kill(self) -> None:
+        with self._lock:
+            self.kills += 1
+
+    def _forward(self, handler, body: bytes | None) -> None:
+        """Proxy one request; POSTs are kill-eligible."""
+        kill = body is not None and self._kill_roll()
+        req = urllib.request.Request(
+            self.backend_url + handler.path,
+            data=body,
+            headers=(
+                {"Content-Type": "application/json"} if body is not None
+                else {}
+            ),
+        )
+        try:
+            resp = urllib.request.urlopen(req, timeout=600)
+        except urllib.error.HTTPError as exc:
+            payload = exc.read()
+            handler.send_response(exc.code)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(payload)))
+            retry_after = exc.headers.get("Retry-After")
+            if retry_after:
+                handler.send_header("Retry-After", retry_after)
+            handler.end_headers()
+            handler.wfile.write(payload)
+            return
+        except (urllib.error.URLError, OSError):
+            handler.connection.close()  # look as dead as the backend
+            return
+        with resp:
+            clen = resp.headers.get("Content-Length")
+            handler.send_response(resp.status)
+            handler.send_header(
+                "Content-Type",
+                resp.headers.get("Content-Type", "application/json"),
+            )
+            if clen is not None:
+                # Declared even when killing: a short body under a
+                # declared length is the truncation proof the router's
+                # buffered-resubmit path keys on.
+                handler.send_header("Content-Length", clen)
+            if resp.headers.get("traceparent"):
+                handler.send_header(
+                    "traceparent", resp.headers["traceparent"]
+                )
+            handler.end_headers()
+            if clen is not None:
+                data = resp.read()
+                if kill:
+                    self._count_kill()
+                    handler.wfile.write(data[: len(data) // 2])
+                    handler.wfile.flush()
+                    handler.connection.close()
+                    return
+                handler.wfile.write(data)
+                return
+            # Close-delimited stream: forward COMPLETE lines only, so a
+            # kill always lands between lines (a real process death can
+            # land mid-line; the router discards partial lines either
+            # way, this just makes soak token counts deterministic).
+            lines = 0
+            buf = b""
+            while True:
+                if self.delay_s:
+                    time.sleep(self.delay_s)
+                chunk = resp.read(256)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    handler.wfile.write(line + b"\n")
+                    handler.wfile.flush()
+                    lines += 1
+                    if kill and lines >= self.kill_after_lines:
+                        self._count_kill()
+                        handler.connection.close()
+                        return
+            if buf:
+                handler.wfile.write(buf)
+
+    def start(self) -> "FlakyHTTPBackend":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=10)
 
 
 class FlakyAgent:

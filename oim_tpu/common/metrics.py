@@ -342,6 +342,46 @@ SERVE_OVERLAP_RATIO = _registry.gauge(
     ("engine",),
 )
 
+# ---------------------------------------------------------------------------
+# Serve-plane fault-tolerance instruments (engine sheds/deadlines, the
+# driver-side stall watchdog, and the router's stream-splice failover):
+# shared definitions like the pipeline triad above, so the incident
+# queries in doc/operations.md "Serving failure modes" see one series
+# shape across the fleet.
+
+SERVE_STALLS = _registry.counter(
+    "oim_serve_stalls_total",
+    "Decode stalls detected by the driver-side watchdog: a dispatched "
+    "chunk exceeded a multiple of its EWMA wall time (device hang / XLA "
+    "wedge).  Each one failed the in-flight requests fast and flipped "
+    "/healthz unhealthy.",
+    ("engine",),
+)
+SERVE_SHED = _registry.counter(
+    "oim_serve_shed_total",
+    "Requests shed (or clamped) by overload protection, by reason: "
+    "queue_full = admission queue at capacity (HTTP 429), deadline = "
+    "request deadline expired before it touched a slot, brownout = "
+    "max_tokens clamped under sustained queue pressure (served, not "
+    "rejected).",
+    ("reason",),
+)
+SERVE_FAILOVERS = _registry.counter(
+    "oim_serve_failovers_total",
+    "Router failovers after a backend died mid-request, by outcome: "
+    "spliced = the remainder of an in-flight stream was re-decoded on "
+    "another backend and spliced into the same client stream, "
+    "resubmitted = a buffered (non-stream) request re-ran whole on "
+    "another backend, gave_up = no healthy backend could finish it.",
+    ("outcome",),
+)
+SERVE_DEADLINE_EXPIRED = _registry.counter(
+    "oim_serve_deadline_expired_total",
+    "Requests failed because their deadline expired — shed from the "
+    "admission queue or reaped mid-decode (slot freed at the next "
+    "pipeline boundary).",
+)
+
 
 EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 

@@ -886,6 +886,12 @@ class GenRequest:
     # admission (mark system prompts); later prompts sharing the prefix
     # skip re-prefilling it.
     cache_prefix: bool = False
+    # Absolute deadline (time.monotonic() clock), None = none.  Expired
+    # in the queue → shed before touching a slot (HTTP 429 +
+    # Retry-After); expired mid-decode → the slot is freed at the next
+    # pipeline boundary and the waiter gets a RequestFailedError with
+    # kind "deadline" (HTTP 504).
+    deadline: float | None = None
 
 
 class QueueFullError(RuntimeError):
@@ -894,6 +900,41 @@ class QueueFullError(RuntimeError):
 
 class DrainingError(RuntimeError):
     """Engine is draining for shutdown — no new admissions (HTTP 503)."""
+
+
+class DeadlineExpiredError(RuntimeError):
+    """Request deadline already expired at submission — shed without
+    touching the queue (HTTP 429 + Retry-After)."""
+
+
+class EngineFailedError(RuntimeError):
+    """The engine latched a driver-thread crash (``step`` raised) — no
+    new work is accepted until the process restarts (HTTP 503)."""
+
+
+_KIND_TEXT = {
+    "aborted": "aborted",
+    "cancelled": "cancelled",
+    "deadline": "deadline exceeded",
+    "deadline_queue": "shed (deadline expired in queue)",
+    "stalled": "stalled",
+}
+
+
+class RequestFailedError(RuntimeError):
+    """One request failed without a result.  ``kind`` tells the HTTP
+    layer which status to answer: "aborted" (driver died, 500),
+    "cancelled" (client went away), "deadline" (expired mid-decode,
+    504), "deadline_queue" (shed before a slot, 429 + Retry-After),
+    "stalled" (watchdog failed it fast, 503 + Retry-After — retryable
+    on another replica)."""
+
+    def __init__(self, rid: int, kind: str, message: str):
+        super().__init__(
+            f"request {rid} {_KIND_TEXT.get(kind, kind)}: {message}"
+        )
+        self.rid = rid
+        self.kind = kind
 
 
 @dataclass
@@ -992,6 +1033,9 @@ class Engine:
         max_queue: int = 0,
         prefill_chunk: int = 0,
         pipeline_depth: int = 2,
+        brownout_max_tokens: int = 0,
+        brownout_queue_fraction: float = 0.75,
+        brownout_hold_s: float = 1.0,
     ):
         if pipeline_depth not in (1, 2):
             raise ValueError(
@@ -1115,6 +1159,24 @@ class Engine:
         # flood into immediate backpressure (QueueFullError → HTTP 429)
         # instead of unbounded host memory + 600 s client timeouts.
         self.max_queue = max_queue
+        # Brownout: under SUSTAINED queue pressure (queue ≥ fraction of
+        # max_queue continuously for hold_s), clamp incoming requests'
+        # max_new_tokens to brownout_max_tokens instead of letting the
+        # backlog grow until the hard 429 — degraded answers beat
+        # errors.  0 = off; needs max_queue (pressure is measured
+        # against the bound).
+        if brownout_max_tokens < 0 or not 0.0 < brownout_queue_fraction <= 1.0:
+            raise ValueError(
+                f"need brownout_max_tokens>=0 and brownout_queue_fraction "
+                f"in (0, 1]; got {brownout_max_tokens}, "
+                f"{brownout_queue_fraction}"
+            )
+        self.brownout_max_tokens = brownout_max_tokens
+        self.brownout_hold_s = brownout_hold_s
+        self._brownout_at = max(
+            1, int(round(max_queue * brownout_queue_fraction))
+        ) if max_queue else 0
+        self._pressure_since: float | None = None
         self.top_k = top_k
         self.kv_int8 = kv_int8
         self.weight_quant = weight_quant_mode(params)
@@ -1297,10 +1359,32 @@ class Engine:
         self._beam_fns: dict[tuple, object] = {}
         self._beam_traces: set[tuple] = set()
         self._beam_lock = threading.Lock()
-        self._errors: dict[int, str] = {}
+        # rid → (kind, message); result_full raises RequestFailedError.
+        self._errors: dict[int, tuple[str, str]] = {}
         self._callbacks: dict[int, object] = {}  # rid → on_token
         self._forgotten: set[int] = set()
+        # rids cancelled via cancel() (client disconnect) but still
+        # queued / admitting / active — reaped on the driver thread at
+        # the next step so the slot machinery stays single-writer.
+        self._cancelled: set[int] = set()
         self._draining = False
+        # Latched by step() on a driver-thread crash: every later
+        # submit fails fast (EngineFailedError) instead of queueing
+        # work nothing will ever drive — and result() waiters were
+        # already failed by the latch, so nobody blocks forever.
+        self._fatal: str | None = None
+        # Stall-watchdog hooks (driver thread writes, watchdog thread
+        # reads — both under self._lock): when the driver is blocked in
+        # a device dispatch or readback, _device_wait_since holds the
+        # monotonic instant the wait began; _chunk_wall_ewma tracks the
+        # typical dispatch-to-fetch wall of a decode chunk, the
+        # baseline a wedged chunk is judged against.
+        self._device_wait_since: float | None = None
+        self._chunk_wall_ewma: float | None = None
+        # Observed marginal token rate (tokens/s EWMA over processed
+        # chunks) — the denominator Retry-After hints are computed
+        # from.
+        self._token_rate_ewma: float | None = None
         # Slot-free work (beam/embed) runs outside the queue machinery
         # but must still hold off a drain — counted here.
         self._aux_active = 0
@@ -1374,6 +1458,11 @@ class Engine:
         self._m_pipeline_depth = _metrics.SERVE_PIPELINE_DEPTH
         self._m_device_idle = _metrics.SERVE_DEVICE_IDLE
         self._m_overlap = _metrics.SERVE_OVERLAP_RATIO
+        # Fault-tolerance instruments (shared definitions, like the
+        # pipeline triad): sheds/clamps, deadline expirations, stalls.
+        self._m_shed = _metrics.SERVE_SHED
+        self._m_deadline = _metrics.SERVE_DEADLINE_EXPIRED
+        self._m_stalls = _metrics.SERVE_STALLS
         self._m_pipeline_depth.set(
             float(pipeline_depth), self._engine_label
         )
@@ -1456,7 +1545,21 @@ class Engine:
             if not self._warming:
                 self._m_requests.inc("rejected")
             raise
+        now = time.monotonic()
+        if req.deadline is not None and now >= req.deadline:
+            # Dead on arrival: shed before it costs anything.
+            if not self._warming:
+                self._m_requests.inc("rejected")
+                self._m_shed.inc("deadline")
+                self._m_deadline.inc()
+            raise DeadlineExpiredError(
+                "request deadline already expired at submission"
+            )
         with self._lock:
+            if self._fatal is not None:
+                if not self._warming:
+                    self._m_requests.inc("rejected")
+                raise EngineFailedError(f"engine failed: {self._fatal}")
             if self._draining:
                 if not self._warming:
                     self._m_requests.inc("rejected")
@@ -1467,9 +1570,30 @@ class Engine:
                 and len(self._queue) >= self.max_queue
             ):
                 self._m_requests.inc("rejected")
+                self._m_shed.inc("queue_full")
                 raise QueueFullError(
                     f"admission queue full ({self.max_queue}); retry later"
                 )
+            if self.max_queue and not self._warming:
+                # Brownout bookkeeping: pressure is "queue at or above
+                # the threshold", sustained across submissions.  Clamp
+                # only once pressure has held for brownout_hold_s — a
+                # momentary burst should not degrade answers.
+                if len(self._queue) >= self._brownout_at:
+                    if self._pressure_since is None:
+                        self._pressure_since = now
+                else:
+                    self._pressure_since = None
+                if (
+                    self.brownout_max_tokens
+                    and self._pressure_since is not None
+                    and now - self._pressure_since >= self.brownout_hold_s
+                    and req.max_new_tokens > self.brownout_max_tokens
+                ):
+                    req = replace(
+                        req, max_new_tokens=self.brownout_max_tokens
+                    )
+                    self._m_shed.inc("brownout")
             rid = self._next_rid
             self._next_rid += 1
             self._queue.append((rid, req, time.monotonic()))
@@ -1484,6 +1608,10 @@ class Engine:
         """Drain-aware guard for slot-free work (beam/embed): rejected
         while draining, counted in ``in_flight`` while running."""
         with self._lock:
+            if self._fatal is not None:
+                if not self._warming:
+                    self._m_requests.inc("rejected")
+                raise EngineFailedError(f"engine failed: {self._fatal}")
             if self._draining:
                 if not self._warming:
                     self._m_requests.inc("rejected")
@@ -1667,9 +1795,8 @@ class Engine:
         with self._lock:
             del self._events[rid]
             if rid in self._errors:
-                raise RuntimeError(
-                    f"request {rid} aborted: {self._errors.pop(rid)}"
-                )
+                kind, message = self._errors.pop(rid)
+                raise RequestFailedError(rid, kind, message)
             return self._results.pop(rid)
 
     def forget(self, rid: int) -> None:
@@ -1685,10 +1812,59 @@ class Engine:
                 self._forgotten.add(rid)
             self._callbacks.pop(rid, None)  # streaming consumer left
 
-    def abort(self, message: str) -> None:
+    def cancel(self, rid: int, message: str = "cancelled by client") -> bool:
+        """Cancel ONE request (client disconnect): a queued entry is
+        failed on the spot; an admitting or active one is marked and
+        reaped by the driver thread at the next pipeline boundary (its
+        slot freed, its chip time stops burning).  Safe from any
+        thread; returns False when ``rid`` is unknown or already done.
+        The waiter (if any) gets a RequestFailedError with kind
+        "cancelled"; an abandoned stream just ends."""
+        ended = None
+        with self._lock:
+            if rid in self._results or rid in self._errors:
+                return False  # already finished; result() will see it
+            for i, (qrid, _req, _t) in enumerate(self._queue):
+                if qrid == rid:
+                    self._queue.pop(i)
+                    self._fail_locked(rid, "cancelled", message)
+                    ended = self._callbacks.pop(rid, None)
+                    self._m_queued.set(
+                        float(len(self._queue)), self._engine_label
+                    )
+                    break
+            else:
+                if rid in self._admitting or any(
+                    s.rid == rid for s in self._slots.values()
+                ):
+                    self._cancelled.add(rid)
+                else:
+                    return False
+        if ended is not None:
+            ended(None, None)  # end-of-stream outside the lock
+        return True
+
+    def _fail_locked(self, rid: int, kind: str, message: str) -> None:
+        """Record a failed request's error and wake its waiter (lock
+        held; streaming callbacks are the CALLER's to end — outside the
+        lock)."""
+        if not self._warming:
+            self._m_requests.inc(kind)
+        self._cancelled.discard(rid)
+        if rid in self._forgotten:
+            self._forgotten.discard(rid)
+            self._events.pop(rid, None)
+            return
+        self._errors[rid] = (kind, message)
+        if rid in self._events:
+            self._events[rid].set()
+
+    def abort(self, message: str, *, kind: str = "aborted") -> None:
         """Fail every queued and in-flight request (the server's driver
         thread calls this when ``step`` raises, so blocked ``result()``
-        callers get a RuntimeError instead of waiting out their timeout)."""
+        callers get a RuntimeError instead of waiting out their
+        timeout; the stall watchdog calls it with kind="stalled" so
+        those failures answer 503-retryable, not 500)."""
         ended = []
         with self._lock:
             # Quiesce the pipeline: an in-flight dispatch references
@@ -1711,18 +1887,11 @@ class Engine:
             self._slots.clear()
             self._admitting.clear()
             for rid in pending:
-                if not self._warming:
-                    self._m_requests.inc("aborted")
                 cb = self._callbacks.pop(rid, None)
                 if cb is not None:
                     ended.append(cb)
-                if rid in self._forgotten:
-                    self._forgotten.discard(rid)
-                    self._events.pop(rid, None)
-                    continue
-                self._errors[rid] = message
-                if rid in self._events:
-                    self._events[rid].set()
+                self._fail_locked(rid, kind, message)
+            self._cancelled.clear()
             self._m_active.set(0.0, self._engine_label)
             self._m_queued.set(0.0, self._engine_label)
         for cb in ended:  # end-of-stream for streaming consumers
@@ -1818,6 +1987,21 @@ class Engine:
                 "tail_elisions": self.tail_elisions,
                 "pipeline_depth": self.pipeline_depth,
                 "inflight_dispatches": int(self._inflight is not None),
+                # Fault-tolerance forensics: the watchdog baseline, the
+                # Retry-After denominator, brownout state, and the
+                # fatal latch (non-null = this engine is dead).
+                "chunk_wall_ewma": round(self._chunk_wall_ewma or 0.0, 6),
+                "token_rate": round(self._token_rate_ewma or 0.0, 2),
+                # Live pressure, not the last submit's view: with
+                # traffic stopped, _pressure_since only resets on the
+                # next submission — forensics must not read a drained
+                # queue as still browning out.
+                "brownout_active": bool(
+                    self.brownout_max_tokens
+                    and self._pressure_since is not None
+                    and len(self._queue) >= self._brownout_at
+                ),
+                "fatal": self._fatal,
             }
 
     def set_pipeline_depth(self, depth: int) -> None:
@@ -1851,6 +2035,11 @@ class Engine:
         # token was never registered in _slots.
         self._slots.pop(slot, None)
         self._free.append(slot)
+        # A cancel() that raced this completion (landed after _reap but
+        # before the finishing chunk processed) must not leave its mark
+        # behind: a stale _cancelled entry would defeat _reap's early
+        # exit on every future step.
+        self._cancelled.discard(state.rid)
         if not self._warming:
             self._m_requests.inc("completed")
             self._m_tokens.inc(by=float(len(state.emitted)))
@@ -1994,9 +2183,11 @@ class Engine:
         NOTHING dispatched starts the device-idle clock the next
         dispatch stops."""
         overlapped = self._inflight is not None
+        self._watch_begin()
         t0 = time.monotonic()
         out = jax.device_get(tree)
         t1 = time.monotonic()
+        self._watch_end()
         acc[0] += t1 - t0
         if not self._warming:
             if overlapped:
@@ -2024,6 +2215,51 @@ class Engine:
                 self.readbacks += 1
                 self.readback_seconds += dt
         return out
+
+    def _watch_begin(self) -> None:
+        """Open a device-wait window for the stall watchdog: the driver
+        thread is about to block handing work to (or fetching from) the
+        device.  The watchdog thread reads the instant under the same
+        lock; a window left open past a multiple of the chunk-wall EWMA
+        is a stall (device hang / XLA wedge)."""
+        with self._lock:
+            self._device_wait_since = time.monotonic()
+
+    def _watch_end(self) -> None:
+        with self._lock:
+            self._device_wait_since = None
+
+    def watchdog_state(self) -> tuple[float | None, float | None]:
+        """(seconds the driver has been blocked in the current device
+        wait — None when not blocked, typical chunk wall EWMA — None
+        until the first chunk completes).  The stall watchdog's whole
+        read surface; safe from any thread."""
+        now = time.monotonic()
+        with self._lock:
+            since = self._device_wait_since
+            return (
+                None if since is None else max(0.0, now - since),
+                self._chunk_wall_ewma,
+            )
+
+    def retry_after_s(self) -> int:
+        """Back-off hint for 429/503 responses: estimated seconds until
+        the current backlog (queued + active remaining token budgets)
+        drains at the observed marginal token rate.  Conservative
+        default of 5 s before any chunk has been processed; clamped to
+        [1, 120] so a cold or wedged engine never tells clients to go
+        away for an hour."""
+        with self._lock:
+            backlog = sum(
+                req.max_new_tokens for _, req, _ in self._queue
+            ) + sum(
+                max(0, s.req.max_new_tokens - len(s.emitted))
+                for s in self._slots.values()
+            )
+            rate = self._token_rate_ewma
+        if rate is None or rate <= 0.0:
+            return 5
+        return max(1, min(120, int(backlog / rate) + 1))
 
     def _mark_dispatch(self, t0: float, acc: list) -> None:
         """Close one jitted-enqueue window: wall time since ``t0`` is
@@ -2061,6 +2297,24 @@ class Engine:
         acc = [0.0, 0.0, 0.0]  # [fetch-wait, dispatch-wait, overlapped]
         try:
             self._step_inner(acc)
+        except Exception as exc:
+            # Latch the crash and fail everything NOW: a result() waiter
+            # must never depend on whoever owns the driver thread
+            # remembering to call abort() — a direct embedder's crashed
+            # loop would otherwise strand waiters forever.  Later
+            # submits fail fast with EngineFailedError.
+            message = f"driver step failed: {type(exc).__name__}: {exc}"
+            # The raise may have escaped from inside an open watchdog
+            # window (_fetch / an admit or decode dispatch): close it,
+            # or the watchdog would read an ever-growing device wait
+            # from a call that already returned (by raising) and file a
+            # bogus stall verdict on top of the real crash.
+            self._watch_end()
+            with self._lock:
+                if self._fatal is None:
+                    self._fatal = message
+            self.abort(message)
+            raise
         finally:
             if not self._warming:
                 # Lock-held: _fetch_aux (embed/beam on server handler
@@ -2120,6 +2374,7 @@ class Engine:
         before; budget exhaustion is host-deterministic, so this waste
         is simply never dispatched.
         """
+        self._reap()
         with self._lock:
             elide_tail = (
                 self._inflight is not None
@@ -2169,6 +2424,72 @@ class Engine:
         else:
             self._process_chunk(handle, acc)
         self._clear_idle_clock_if_drained()
+
+    def _reap(self) -> None:
+        """Fail deadline-expired and cancelled requests (driver thread,
+        start of every step).  Queued entries are shed before they ever
+        touch a slot (kind "deadline_queue" → HTTP 429 + Retry-After);
+        active slots are freed right here, which IS the next pipeline
+        boundary from the request's point of view — the in-flight
+        chunk's snapshot check already skips slots whose state is gone,
+        so a freed slot's post-reap garbage is never emitted, and
+        admissions can only re-prefill it after that chunk completes."""
+        now = time.monotonic()
+        ended = []
+        with self._lock:
+            if not (
+                self._cancelled
+                or any(req.deadline is not None for _, req, _ in self._queue)
+                or any(
+                    s.req.deadline is not None for s in self._slots.values()
+                )
+            ):
+                return
+            keep = []
+            for rid, req, t_sub in self._queue:
+                if rid in self._cancelled:
+                    self._fail_locked(rid, "cancelled", "client went away")
+                elif req.deadline is not None and now >= req.deadline:
+                    if not self._warming:
+                        self._m_shed.inc("deadline")
+                        self._m_deadline.inc()
+                    self._fail_locked(
+                        rid, "deadline_queue",
+                        f"expired after {now - t_sub:.1f}s queued",
+                    )
+                else:
+                    keep.append((rid, req, t_sub))
+                    continue
+                cb = self._callbacks.pop(rid, None)
+                if cb is not None:
+                    ended.append(cb)
+            if len(keep) != len(self._queue):
+                self._queue[:] = keep
+                self._m_queued.set(
+                    float(len(self._queue)), self._engine_label
+                )
+            for slot, state in list(self._slots.items()):
+                if state.rid in self._cancelled:
+                    kind, msg = "cancelled", "client went away mid-decode"
+                elif (
+                    state.req.deadline is not None
+                    and now >= state.req.deadline
+                ):
+                    kind = "deadline"
+                    msg = f"expired after {len(state.emitted)} tokens"
+                    if not self._warming:
+                        self._m_deadline.inc()
+                else:
+                    continue
+                self._slots.pop(slot)
+                self._free.append(slot)
+                self._fail_locked(state.rid, kind, msg)
+                cb = self._callbacks.pop(state.rid, None)
+                if cb is not None:
+                    ended.append(cb)
+            self._m_active.set(float(len(self._slots)), self._engine_label)
+        for cb in ended:  # end-of-stream outside the lock
+            cb(None, None)
 
     def _admit_wave(self, acc: list) -> None:
         """Admit whatever fits into free slots.
@@ -2298,6 +2619,7 @@ class Engine:
                         jax.random.PRNGKey(req.seed), 0
                     )
                 t_disp = time.monotonic()
+                self._watch_begin()
                 (
                     self._cache, self._history,
                     self._tok_counts, self._gen_counts,
@@ -2342,6 +2664,7 @@ class Engine:
                         jnp.asarray(slot_idx),
                         jnp.asarray(starts + tails),
                     )
+                self._watch_end()
                 self._mark_dispatch(t_disp, acc)
                 groups.append((group, first, first_lp))
             for slot, rid, req, _, start, tail, _ in rows:
@@ -2358,6 +2681,16 @@ class Engine:
                     for i, (slot, rid, req, t_submit, _, _, _) in enumerate(
                         group
                     ):
+                        if rid not in self._admitting:
+                            # abort() (watchdog stall verdict on a live
+                            # driver) landed while this admission was
+                            # wedged in dispatch/readback: the rid is
+                            # already failed, its callback ended, and
+                            # the slot already returned to _free —
+                            # registering the ghost state here would
+                            # double-assign that slot to whoever takes
+                            # it next.
+                            continue
                         token, lp = int(f_host[i]), float(lp_host[i])
                         self.tokens_generated += 1
                         state = _SlotState(
@@ -2365,6 +2698,20 @@ class Engine:
                             base=jax.random.PRNGKey(req.seed),
                             t_submit=t_submit,
                         )
+                        if rid in self._cancelled:
+                            # cancel() landed while this admission was
+                            # mid-dispatch: reclaim the slot now, end
+                            # the stream, never register the state.
+                            self._admitting.pop(rid, None)
+                            self._free.append(slot)
+                            self._fail_locked(
+                                rid, "cancelled",
+                                "client went away during admission",
+                            )
+                            cb = self._callbacks.pop(rid, None)
+                            if cb is not None:
+                                notices.append((cb, None, None, False))
+                            continue
                         done = self._emit(state, token, lp)
                         self._admitting.pop(rid, None)
                         if done:
@@ -2501,6 +2848,7 @@ class Engine:
             )
 
         t_dispatch = time.monotonic()
+        self._watch_begin()
         if self.spec_decode and self._draft_cache is not None:
             temps, top_ps, min_ps, active, bases = temps_etc
             (
@@ -2534,6 +2882,7 @@ class Engine:
                 reps, press, freqs, active, bases, jnp.asarray(counts),
             )
             kind, handles = "plain", (out, lps)
+        self._watch_end()
         self._mark_dispatch(t_dispatch, acc)
         self._step_count += 1
         self._m_dispatches.inc()
@@ -2606,11 +2955,32 @@ class Engine:
                     notices.append((cb, fresh, done))
                 if done and slot in self._slots:
                     self._finish(slot, state)
+        start = handle.t_dispatch
+        if self._t_last_chunk_done is not None:
+            start = max(start, self._t_last_chunk_done)
         if not self._warming and emitted_total:
-            start = handle.t_dispatch
-            if self._t_last_chunk_done is not None:
-                start = max(start, self._t_last_chunk_done)
-            self._m_token_latency.observe((t_done - start) / emitted_total)
+            # emitted_total == 0 means nobody consumed this chunk — a
+            # tail chunk whose slots an abort/reap already cleared,
+            # INCLUDING a transient stall's wedged chunk.  Folding that
+            # wall into the EWMA would inflate the watchdog threshold
+            # by the stall's own duration and blind it to a re-wedge.
+            wall = t_done - handle.t_dispatch
+            with self._lock:
+                # Stall-watchdog baseline (typical chunk wall) and the
+                # marginal token rate Retry-After hints divide by.
+                self._chunk_wall_ewma = (
+                    wall if self._chunk_wall_ewma is None
+                    else 0.7 * self._chunk_wall_ewma + 0.3 * wall
+                )
+                if t_done > start:
+                    rate = emitted_total / (t_done - start)
+                    self._token_rate_ewma = (
+                        rate if self._token_rate_ewma is None
+                        else 0.7 * self._token_rate_ewma + 0.3 * rate
+                    )
+            self._m_token_latency.observe(
+                (t_done - start) / emitted_total
+            )
         self._t_last_chunk_done = t_done
         for cb, fresh, done in notices:
             for token, lp in fresh:

@@ -34,6 +34,7 @@ class ServeRegistration:
         tls=None,
         delay: float = 60.0,
         retry=None,
+        health=None,
     ):
         if not serve_id or "/" in serve_id:
             raise ValueError(f"invalid serve id {serve_id!r}")
@@ -42,6 +43,16 @@ class ServeRegistration:
         self.advertised_address = advertised_address
         self.tls = tls
         self.delay = delay
+        # Optional health gate (callable → bool), consulted each beat:
+        # unhealthy → the key is actively WITHDRAWN (routers watching
+        # ``serve/`` drop this instance at the DELETE event — faster
+        # than unhealthy_after probe failures) and re-registration
+        # pauses until health returns.  oim-serve wires this to "the
+        # server has no latched error" (driver death, decode stall).
+        # Mutable attribute: serve_main assigns it once the server
+        # exists.
+        self.health = health
+        self._withdrawn = False
         # Shared bounded-retry policy (oim_tpu.common.resilience), capped
         # below the heartbeat period so ladders never overlap beats.
         if retry is None:
@@ -116,7 +127,35 @@ class ServeRegistration:
     def _loop(self) -> None:
         while not self._stop.wait(self.delay):
             try:
+                if self.health is not None and not self.health():
+                    if not self._withdrawn:
+                        # One withdrawal per unhealthy episode; the
+                        # lease would expire the key anyway, this gets
+                        # routers off the instance in one watch event.
+                        events.emit(
+                            "serve.withdraw.unhealthy",
+                            component="oim-serve",
+                            severity=events.WARNING,
+                            subject=self.serve_id,
+                        )
+                        log.current().warning(
+                            "serve unhealthy; withdrawing registration",
+                            id=self.serve_id,
+                        )
+                        self.deregister()
+                        self._withdrawn = True
+                    continue
+                restored = self._withdrawn
+                self._withdrawn = False
                 self.register()
+                if restored:
+                    events.emit(
+                        "serve.register",
+                        component="oim-serve",
+                        subject=self.serve_id,
+                        address=self.advertised_address,
+                        recovered=True,
+                    )
             except Exception as exc:
                 # Never let the heartbeat die: transient failures must
                 # not permanently de-register the instance.

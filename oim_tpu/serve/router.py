@@ -24,12 +24,32 @@ Behavior:
   after ``unhealthy_after`` consecutive failures and back on the first
   success.  A request-level connection failure counts too, so a dead
   backend stops receiving traffic immediately, not at the next probe.
-- Retry: a request that fails at the CONNECTION level before any
-  response byte is retried once on a different backend; once a backend
-  has begun answering, errors pass through (the request may have side
-  effects — generation is not idempotent under sampling seeds... it is
-  by seed, but the single-retry bound keeps tail latency sane anyway).
-- Streaming: NDJSON bodies are piped through chunk-by-chunk unchanged.
+- Retry & failover: a backend that fails at the CONNECTION level — or
+  dies mid-response — is excluded for the REQUEST'S LIFETIME and the
+  work moves to another healthy backend (bounded: each backend is
+  tried at most once per request).  Non-stream responses are buffered
+  before forwarding, so a backend death mid-body resubmits the whole
+  request with zero client-visible damage (generation is deterministic
+  by seed, so the re-run answers identically).  An HTTP-level error
+  from a backend that answered passes through verbatim (with its
+  Retry-After header, when present).
+- Stream-splice failover: for NDJSON ``/v1/generate`` streams with a
+  token-list prompt, the router records the tokens each backend has
+  emitted; when the backend dies mid-stream (EOF before a terminal
+  done/error line), it resubmits to another healthy backend as
+  prompt + emitted-tokens continuation and SPLICES the remainder into
+  the same client stream — token-identical for greedy decoding (the
+  engine's exactness invariant: a continuation prefill reproduces the
+  original KV bit-for-bit), best-effort for sampled requests (the
+  continuation's PRNG key indices restart relative to the new prompt;
+  still deterministic given the fault point, documented in
+  doc/operations.md "Serving failure modes").  Text-prompt streams and
+  SSE completions streams cannot be spliced (the router has no
+  tokenizer to rebuild the prompt) and keep the old
+  bytes-already-with-the-client → terminal-error behavior.
+- Streaming: NDJSON bodies are piped through chunk-by-chunk; only
+  complete lines are forwarded, so a mid-line backend death never
+  corrupts client framing.
 
 Endpoints: the serving API (POST /v1/generate, /v1/beam, /v1/embed,
 and the OpenAI-compatible /v1/completions) proxied; GET /healthz (ok while ≥1 backend is healthy), /v1/stats
@@ -39,8 +59,10 @@ and the OpenAI-compatible /v1/completions) proxied; GET /healthz (ok while ≥1 
 from __future__ import annotations
 
 import hashlib
+import http.client
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from concurrent import futures
@@ -83,6 +105,94 @@ class Backend:
     # on tunneled deployments — without curling every backend.
     pipeline_depth: int = 0
     info_fetched: bool = False
+
+
+class _SpliceState:
+    """Failover state for one spliceable NDJSON generate stream.
+
+    Spliceable = ``POST /v1/generate`` with ``"stream": true`` and a
+    token-list prompt: the router can then rebuild the prompt for a
+    continuation (prompt + tokens already emitted) without a tokenizer.
+    ``prior_tokens``/``prior_lps`` hold what DEAD backends emitted;
+    the live attempt's tokens ride local lists and fold in only on
+    death, so the terminal done line (whose ``tokens`` field is the
+    serving backend's own full generation) is never double-counted."""
+
+    def __init__(self, payload: dict, body: bytes):
+        self.payload = payload
+        self._orig_body = body
+        self.t0 = time.monotonic()  # for continuation deadline_ms decay
+        self.orig_tokens = [int(t) for t in payload["tokens"]]
+        # Mirrors the server-side default (server.py _generate).
+        self.orig_max_new = int(payload.get("max_new_tokens", 16))
+        self.eos_id = payload.get("eos_id")
+        self.stop_ids = {int(t) for t in payload.get("stop_ids", ())}
+        self.want_logprobs = bool(payload.get("logprobs"))
+        self.prior_tokens: list[int] = []
+        self.prior_lps: list[float] = []
+        self.started = False  # response headers sent to our client
+
+    @staticmethod
+    def plan(path: str, body: bytes | None) -> "_SpliceState | None":
+        """A state when this request is spliceable, else None (any
+        parse problem means no splice — never an error)."""
+        if path != "/v1/generate" or not body:
+            return None
+        try:
+            payload = json.loads(body)
+            if not payload.get("stream"):
+                return None
+            tokens = payload.get("tokens")
+            if not isinstance(tokens, list) or not tokens:
+                return None
+            return _SpliceState(payload, body)
+        except Exception:
+            return None
+
+    def request_body(self) -> bytes:
+        """The next attempt's body: the original bytes verbatim until a
+        failover, then prompt + emitted-tokens continuation with the
+        budget reduced by what the client already has.  ``cache_prefix``
+        is dropped from continuations (a one-off spliced prompt must
+        not evict real entries from the new backend's prefix cache)."""
+        if not self.prior_tokens:
+            return self._orig_body
+        payload = dict(self.payload)
+        payload["tokens"] = self.orig_tokens + self.prior_tokens
+        payload["max_new_tokens"] = (
+            self.orig_max_new - len(self.prior_tokens)
+        )
+        payload.pop("cache_prefix", None)
+        try:
+            ms = float(payload.get("deadline_ms", 0))
+            if ms > 0:
+                # The continuation inherits only the REMAINING budget —
+                # a failover must not restart the client's deadline.
+                elapsed_ms = (time.monotonic() - self.t0) * 1000.0
+                payload["deadline_ms"] = max(1, int(ms - elapsed_ms))
+        except (TypeError, ValueError):
+            pass
+        return json.dumps(payload).encode()
+
+    def finished(self) -> str | None:
+        """Non-None when the emitted prefix already ended the request
+        (budget exhausted / EOS / stop token emitted) — there is
+        nothing left to decode, so the final line can be synthesized
+        locally instead of resubmitting a zero-token continuation."""
+        if len(self.prior_tokens) >= self.orig_max_new:
+            return "length"
+        if self.prior_tokens and (
+            self.prior_tokens[-1] == self.eos_id
+            or self.prior_tokens[-1] in self.stop_ids
+        ):
+            return "stop"
+        return None
+
+    def final_line(self) -> bytes:
+        final: dict = {"done": True, "tokens": self.prior_tokens}
+        if self.want_logprobs:
+            final["logprobs"] = self.prior_lps
+        return json.dumps(final).encode() + b"\n"
 
 
 class Router:
@@ -149,11 +259,16 @@ class Router:
             def log_message(self, *args):
                 pass
 
-            def _json(self, code: int, payload: dict) -> None:
+            def _json(
+                self, code: int, payload: dict,
+                headers: dict | None = None,
+            ) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -178,6 +293,7 @@ class Router:
                     self._json(
                         200 if n else 503,
                         {"ok": bool(n), "healthy_backends": n},
+                        None if n else outer._retry_after_headers(),
                     )
                 elif path == "/v1/stats":
                     self._json(200, outer.stats())
@@ -187,10 +303,13 @@ class Router:
             def _fwd_headers(self, extra: dict | None = None) -> dict:
                 """Outbound headers for the backend hop: propagate the
                 caller's trace context, like every other component
-                boundary here."""
+                boundary here, and the per-request deadline budget —
+                the fleet entry point must not silently strip the
+                header-based deadline knob."""
                 headers = dict(extra or {})
-                if self.headers.get("traceparent"):
-                    headers["traceparent"] = self.headers["traceparent"]
+                for name in ("traceparent", "x-oim-deadline-ms"):
+                    if self.headers.get(name):
+                        headers[name] = self.headers[name]
                 return headers
 
             def do_POST(self):
@@ -353,45 +472,133 @@ class Router:
         except Exception:
             return None
 
+    def _retry_after_headers(self) -> dict:
+        """Retry-After for router-level 503s: by the next health-probe
+        tick a dead backend may be back (or a recovered one restored),
+        so hint two probe intervals."""
+        return {
+            "Retry-After": str(max(1, int(self.health_interval * 2)))
+        }
+
     def _proxy(
         self, handler, path: str, body: bytes | None, headers: dict
     ) -> None:
         """Proxy one request to a healthy backend (``body`` None = GET —
-        urllib's method selection; bytes = POST)."""
-        tried: set[str] = set()
+        urllib's method selection; bytes = POST).
+
+        Failure policy (module docstring "Retry & failover"): every
+        backend that connection-fails OR dies mid-response stays in
+        ``excluded`` for this request's lifetime — the loop can never
+        hand the request back to a backend that just dropped it, and it
+        terminates because each iteration either returns or excludes
+        one more backend.  Work moves, it is not lost: buffered bodies
+        resubmit whole, spliceable streams continue from the last
+        emitted token on the next backend."""
+        excluded: set[str] = set()
+        failovers = 0  # backend deaths this request survived so far
         affinity_key = self._affinity_key(path, body)
-        while len(tried) < 2:  # the documented single-retry bound
-            backend = self._pick(exclude=tried, affinity_key=affinity_key)
+        splice = _SpliceState.plan(path, body)
+        # Track the relative x-oim-deadline-ms budget as an ABSOLUTE
+        # instant here, and hand each attempt only what remains — a
+        # failover must not restart the client's deadline from scratch
+        # on the next backend.  (Body deadline_ms is the backend's to
+        # enforce; through the router it is per-attempt — splice
+        # continuations rewrite it, buffered resubmits do not.  Prefer
+        # the header for routed traffic; doc/operations.md.)
+        deadline_abs = None
+        try:
+            ms = float(headers.get("x-oim-deadline-ms", ""))
+            if ms > 0:
+                deadline_abs = time.monotonic() + ms / 1000.0
+        except ValueError:
+            pass
+        while True:
+            if deadline_abs is not None:
+                remaining_ms = (deadline_abs - time.monotonic()) * 1000.0
+                if remaining_ms <= 0:
+                    if failovers:
+                        metrics.SERVE_FAILOVERS.inc("gave_up")
+                    if splice is not None and splice.started:
+                        self._write_client(
+                            handler,
+                            json.dumps({
+                                "error": "deadline exceeded across "
+                                "failover attempts"
+                            }).encode() + b"\n",
+                        )
+                    else:
+                        handler._json(504, {
+                            "error": "deadline exceeded across "
+                            "failover attempts"
+                        })
+                    return
+                headers = dict(
+                    headers,
+                    **{"x-oim-deadline-ms": str(max(1, int(remaining_ms)))},
+                )
+            backend = self._pick(exclude=excluded, affinity_key=affinity_key)
             if backend is None:
+                if failovers:
+                    metrics.SERVE_FAILOVERS.inc("gave_up")
+                if splice is not None and splice.started:
+                    # Bytes are already with the client: the protocol's
+                    # terminal error line is all that is left to send.
+                    self._write_client(
+                        handler,
+                        json.dumps({
+                            "error": "no healthy serving backend to "
+                            f"splice onto (tried {sorted(excluded)})"
+                        }).encode() + b"\n",
+                    )
+                    return
                 handler._json(
                     503,
                     {
                         "error": "no healthy serving backend"
-                        + (f" (tried {sorted(tried)})" if tried else "")
+                        + (
+                            f" (tried {sorted(excluded)})" if excluded
+                            else ""
+                        )
                     },
+                    self._retry_after_headers(),
                 )
                 return
-            tried.add(backend.id)
+            excluded.add(backend.id)
+            req_body = body if splice is None else splice.request_body()
             req = urllib.request.Request(
-                backend.url + path, data=body, headers=headers
+                backend.url + path, data=req_body, headers=headers
             )
             try:
                 resp = self._opener.open(req, timeout=self.request_timeout)
             except urllib.error.HTTPError as exc:
-                # The backend answered — pass its error through verbatim
-                # (its body is JSON already) and do not retry.
                 self._release(backend, ok=False)
                 self._requests.inc(backend.id, f"http_{exc.code}")
+                if splice is not None and splice.started:
+                    # A continuation resubmit was refused (429/503/...):
+                    # the client stream cannot carry a status line, so
+                    # try the remaining backends for the splice.
+                    log.current().warning(
+                        "splice resubmit refused",
+                        backend=backend.id, code=exc.code,
+                    )
+                    continue
+                # The backend answered — pass its error through verbatim
+                # (its body is JSON already, and its Retry-After backoff
+                # hint must reach the client) and do not retry.
                 payload = exc.read()
                 handler.send_response(exc.code)
                 handler.send_header("Content-Type", "application/json")
                 handler.send_header("Content-Length", str(len(payload)))
+                retry_after = exc.headers.get("Retry-After")
+                if retry_after:
+                    handler.send_header("Retry-After", retry_after)
                 handler.end_headers()
                 handler.wfile.write(payload)
                 return
             except (urllib.error.URLError, OSError) as exc:
-                # Connection-level failure before any response byte:
-                # safe to retry once elsewhere.
+                # Connection-level failure before any response byte: the
+                # backend is excluded above for the request's lifetime;
+                # move on.
                 self._release(backend, ok=False)
                 self._connection_failed(backend)
                 self._requests.inc(backend.id, "connect_error")
@@ -401,65 +608,245 @@ class Router:
                     error=str(getattr(exc, "reason", exc)),
                 )
                 continue
-            # Copy the response, attributing socket errors to the right
-            # side: resp.* errors are the BACKEND's (health penalty, no
-            # retry — bytes may already be with the client), wfile.*
-            # errors are OUR client leaving (backend is fine).
-            backend_died = client_gone = False
-            copied = 0
+            if splice is not None:
+                outcome = self._pipe_spliced(handler, backend, resp, splice)
+                if outcome == "died":
+                    failovers += 1
+                    final = splice.finished()
+                    if final is not None:
+                        # The emitted prefix already hit EOS/stop/budget:
+                        # nothing left to decode — synthesize the final
+                        # line instead of resubmitting zero tokens.
+                        self._write_client(handler, splice.final_line())
+                        metrics.SERVE_FAILOVERS.inc("spliced")
+                        return
+                    continue  # resubmit the remainder elsewhere
+                if outcome == "done" and failovers:
+                    metrics.SERVE_FAILOVERS.inc("spliced")
+                return
             clen = resp.headers.get("Content-Length")
+            if clen is None:
+                # Close-delimited stream the router cannot splice
+                # (text-prompt NDJSON, SSE completions): pass-through;
+                # bytes already with the client on death means give up.
+                self._pipe_stream(handler, backend, resp)
+                return
+            # Bounded JSON body: buffer it FULLY before forwarding, so a
+            # backend death mid-body is invisible to the client — the
+            # whole request simply resubmits on another backend.
+            data = None
             with resp:
                 try:
-                    handler.send_response(resp.status)
-                    handler.send_header(
-                        "Content-Type",
-                        resp.headers.get("Content-Type", "application/json"),
-                    )
-                    if clen is not None:
-                        handler.send_header("Content-Length", clen)
-                    if resp.headers.get("traceparent"):
-                        handler.send_header(
-                            "traceparent", resp.headers["traceparent"]
-                        )
-                    handler.end_headers()
-                except (BrokenPipeError, ConnectionResetError):
-                    client_gone = True
-                # Chunked copy keeps NDJSON streams streaming.
-                while not (backend_died or client_gone):
-                    try:
-                        chunk = resp.read(8192)
-                    except OSError:
-                        backend_died = True
-                        break
-                    if not chunk:
-                        break
-                    try:
-                        handler.wfile.write(chunk)
-                        handler.wfile.flush()
-                        copied += len(chunk)
-                    except (BrokenPipeError, ConnectionResetError):
-                        client_gone = True
-            # A backend killed mid-response often closes with a clean
-            # FIN, indistinguishable from end-of-body on close-delimited
-            # streams — but when Content-Length was declared, a short
-            # copy is proof of truncation.
-            if clen is not None and not client_gone and copied < int(clen):
-                backend_died = True
-            if backend_died:
+                    data = resp.read()
+                except (OSError, http.client.HTTPException):
+                    # IncompleteRead (a declared length cut short) is
+                    # the killed-backend signature on buffered bodies.
+                    data = None
+            if data is None or len(data) < int(clen):
                 self._release(backend, ok=False)
                 self._connection_failed(backend)
                 self._requests.inc(backend.id, "truncated")
-            elif client_gone:
-                self._release(backend, ok=True)
-                self._requests.inc(backend.id, "client_disconnected")
-            else:
+                failovers += 1
+                log.current().warning(
+                    "backend died mid-response; resubmitting",
+                    backend=backend.id, path=path,
+                )
+                continue
+            if self._send_resp_headers(
+                handler, resp, clen=clen
+            ) and self._write_client(handler, data):
                 self._release(backend, ok=True)
                 self._requests.inc(backend.id, "ok")
+            else:
+                self._release(backend, ok=True)
+                self._requests.inc(backend.id, "client_disconnected")
+            if failovers:
+                metrics.SERVE_FAILOVERS.inc("resubmitted")
             return
-        handler._json(
-            503,
-            {"error": f"no healthy serving backend (tried {sorted(tried)})"},
-        )
+
+    @staticmethod
+    def _write_client(handler, data: bytes) -> bool:
+        """Best-effort write to our client; False when it left."""
+        try:
+            handler.wfile.write(data)
+            handler.wfile.flush()
+            return True
+        except (BrokenPipeError, ConnectionResetError):
+            return False
+
+    @staticmethod
+    def _send_resp_headers(
+        handler, resp, default_ctype: str = "application/json",
+        clen: str | None = None,
+    ) -> bool:
+        """Forward one backend response's status + headers to our
+        client (the one place every proxy path shares, so a new header
+        to propagate is added once); False when the client left."""
+        try:
+            handler.send_response(resp.status)
+            handler.send_header(
+                "Content-Type",
+                resp.headers.get("Content-Type", default_ctype),
+            )
+            if clen is not None:
+                handler.send_header("Content-Length", clen)
+            if resp.headers.get("traceparent"):
+                handler.send_header(
+                    "traceparent", resp.headers["traceparent"]
+                )
+            handler.end_headers()
+            return True
+        except (BrokenPipeError, ConnectionResetError):
+            return False
+
+    def _pipe_stream(self, handler, backend, resp) -> None:
+        """Legacy pass-through for close-delimited streams the router
+        cannot splice: chunk-by-chunk copy, socket errors attributed to
+        the right side (resp.* = backend's, wfile.* = our client
+        leaving)."""
+        backend_died = client_gone = False
+        ctype = resp.headers.get("Content-Type", "")
+        with resp:
+            if not self._send_resp_headers(handler, resp):
+                client_gone = True
+            while not (backend_died or client_gone):
+                try:
+                    chunk = resp.read(8192)
+                except (OSError, http.client.HTTPException):
+                    backend_died = True
+                    break
+                if not chunk:
+                    break
+                if not self._write_client(handler, chunk):
+                    client_gone = True
+        if backend_died:
+            self._release(backend, ok=False)
+            self._connection_failed(backend)
+            self._requests.inc(backend.id, "truncated")
+            # The docstring's promised terminal error line: this stream
+            # cannot be spliced, but a detectable mid-read death must
+            # not end it indistinguishable from completion.  (A killed
+            # backend closing with a clean FIN is inherently
+            # undetectable on a close-delimited stream — best effort.)
+            # Framed per the stream's own protocol: SSE parsers discard
+            # non-`data:` lines, so a bare JSON line would be invisible
+            # to completions clients.
+            payload = json.dumps({
+                "error": "backend died mid-stream (unspliceable)"
+            }).encode()
+            self._write_client(
+                handler,
+                b"data: " + payload + b"\n\n"
+                if "text/event-stream" in ctype else payload + b"\n",
+            )
+        elif client_gone:
+            self._release(backend, ok=True)
+            self._requests.inc(backend.id, "client_disconnected")
+        else:
+            self._release(backend, ok=True)
+            self._requests.inc(backend.id, "ok")
+
+    def _pipe_spliced(
+        self, handler, backend, resp, splice: "_SpliceState"
+    ) -> str:
+        """Forward one backend's NDJSON generate stream line-by-line,
+        recording emitted tokens so a mid-stream death can resume on
+        another backend.  Returns "done" (terminal line delivered),
+        "died" (EOF/socket error before a terminal line — the caller
+        splices the remainder elsewhere; this attempt's tokens are
+        folded into ``splice``), or "client_gone".
+
+        Only COMPLETE lines are forwarded: a mid-line death discards
+        the partial line (never forwarded, so client framing survives)
+        and the continuation re-emits from the last complete token.
+        The terminal done line is rewritten so its ``tokens`` (and
+        ``logprobs``) span the WHOLE generation across every backend
+        that served a part of it."""
+        cur_tokens: list[int] = []
+        cur_lps: list[float] = []
+        buf = b""
+        outcome = None
+        with resp:
+            if not splice.started:
+                if self._send_resp_headers(
+                    handler, resp, default_ctype="application/x-ndjson"
+                ):
+                    splice.started = True
+                else:
+                    outcome = "client_gone"
+            while outcome is None:
+                try:
+                    chunk = resp.read(8192)
+                except (OSError, http.client.HTTPException):
+                    outcome = "died"
+                    break
+                if not chunk:
+                    # Clean FIN without a terminal done/error line: the
+                    # backend was killed mid-stream (close-delimited
+                    # streams end-of-body and death look identical —
+                    # the PROTOCOL's terminal line is the truncation
+                    # proof).
+                    outcome = "died"
+                    break
+                buf += chunk
+                while b"\n" in buf and outcome is None:
+                    line, buf = buf.split(b"\n", 1)
+                    outcome = self._splice_line(
+                        handler, splice, line, cur_tokens, cur_lps
+                    )
+        if outcome == "died":
+            splice.prior_tokens += cur_tokens
+            splice.prior_lps += cur_lps
+            self._release(backend, ok=False)
+            self._connection_failed(backend)
+            self._requests.inc(backend.id, "truncated")
+        elif outcome == "client_gone":
+            self._release(backend, ok=True)
+            self._requests.inc(backend.id, "client_disconnected")
+        else:
+            self._release(backend, ok=True)
+            self._requests.inc(backend.id, "ok")
+        return "done" if outcome == "done" else outcome
+
+    def _splice_line(
+        self, handler, splice: "_SpliceState", line: bytes,
+        cur_tokens: list, cur_lps: list,
+    ) -> str | None:
+        """Handle ONE complete NDJSON line: record tokens, rewrite the
+        terminal done line to span all attempts, forward.  Returns the
+        stream outcome when this line ends it, else None."""
+        if not line.strip():
+            return None
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            obj = None
+        if obj is None:
+            return (
+                None if self._write_client(handler, line + b"\n")
+                else "client_gone"
+            )
+        if obj.get("done"):
+            obj["tokens"] = splice.prior_tokens + [
+                int(t) for t in obj.get("tokens", ())
+            ]
+            if "logprobs" in obj:
+                obj["logprobs"] = splice.prior_lps + list(obj["logprobs"])
+            ok = self._write_client(
+                handler, json.dumps(obj).encode() + b"\n"
+            )
+            return "done" if ok else "client_gone"
+        if "token" in obj:
+            cur_tokens.append(int(obj["token"]))
+            if "logprob" in obj:
+                cur_lps.append(obj["logprob"])
+        forwarded = self._write_client(handler, line + b"\n")
+        if not forwarded:
+            return "client_gone"
+        # An {"error": ...} line is terminal per protocol: the backend
+        # ANSWERED (it is alive; the request failed server-side), so it
+        # passes through — failover is for backends that died.
+        return "done" if "error" in obj else None
 
     # -- health + discovery ------------------------------------------------
 

@@ -47,7 +47,9 @@ API:
                     when the tokenizer carries none) → choices carry
                     {"message": {"role": "assistant", "content": ...}};
                     stream=true sends chat.completion.chunk deltas.
-  GET  /healthz      → {"ok": true}
+  GET  /healthz      → {"ok": true} (503 after a driver-thread death OR
+                    a watchdog-detected decode stall — the router
+                    routes around either)
   GET  /v1/stats     → engine stats (slots, queue depth, tokens
                     generated, and the decode-pipeline forensics:
                     pipeline_depth, dispatch_seconds vs readback_seconds
@@ -58,6 +60,19 @@ API:
                     capacity shape, live features) — cacheable
   GET  /metrics      → Prometheus exposition (shared registry)
   GET  /debugz       → live flight-recorder event rings (common/events.py)
+
+Fault tolerance (doc/operations.md "Serving failure modes"): every
+generation endpoint takes a relative deadline budget — ``deadline_ms``
+in the body or the ``x-oim-deadline-ms`` header — enforced in the
+admission queue (expired entries shed with 429 + Retry-After before
+touching a slot) and mid-decode (504, slot freed at the next pipeline
+boundary).  All 429/503 sheds carry a ``Retry-After`` header computed
+from the engine's observed marginal token rate.  A client that
+disconnects mid-stream cancels its request (the slot stops burning).
+With ``watchdog_interval`` > 0 a ``StallWatchdog`` detects a wedged
+device (a decode chunk exceeding a multiple of its EWMA wall), fails
+in-flight requests fast (503, retryable elsewhere), and flips
+/healthz.
 
 The engine is tokenizer-agnostic by design — clients speak token ids, the
 same boundary the CSI driver keeps by speaking device paths rather than
@@ -80,11 +95,113 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from oim_tpu.common import metrics, tracing
 from oim_tpu.serve.httptls import check_serving_peer
 from oim_tpu.serve.engine import (
+    DeadlineExpiredError,
     DrainingError,
     Engine,
+    EngineFailedError,
     GenRequest,
     QueueFullError,
+    RequestFailedError,
 )
+
+
+class StallWatchdog:
+    """Driver-side decode stall detector.
+
+    Polls ``engine.watchdog_state()`` every ``interval`` seconds: when
+    the driver thread has been blocked in ONE device dispatch/readback
+    for longer than ``max(floor_s, multiplier × chunk-wall EWMA)``, the
+    device is presumed wedged (TPU init hang, XLA deadlock — exactly
+    the BENCH_r05 failure mode, where a hung chip stalled the driver
+    silently forever).  On detection: ``on_stall(message)`` runs (the
+    server fails in-flight requests fast with kind "stalled" → HTTP 503
+    + Retry-After, and flips /healthz unhealthy so the router routes
+    around this backend within its probe window), a flight-recorder
+    ERROR event is emitted, and ``oim_serve_stalls_total`` counts it.
+
+    No verdict is possible before the first decode chunk completes
+    (the EWMA is None) — a cold engine's 20-40 s TPU compiles can never
+    false-positive.  If the wait later resolves (transient wedge),
+    ``on_clear`` fires once so the server can restore /healthz.
+    """
+
+    def __init__(
+        self,
+        engine: Engine,
+        on_stall,
+        on_clear=None,
+        interval: float = 1.0,
+        multiplier: float = 8.0,
+        floor_s: float = 10.0,
+    ):
+        if interval <= 0 or multiplier <= 0 or floor_s <= 0:
+            raise ValueError(
+                f"need interval, multiplier, floor_s > 0; got "
+                f"{interval}, {multiplier}, {floor_s}"
+            )
+        self.engine = engine
+        self.on_stall = on_stall
+        self.on_clear = on_clear
+        self.interval = interval
+        self.multiplier = multiplier
+        self.floor_s = floor_s
+        self.stalls = 0
+        self._fired = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def check(self) -> bool:
+        """One poll; returns True while a stall verdict stands.
+        Callable directly (tests drive it synchronously)."""
+        wait, ewma = self.engine.watchdog_state()
+        if wait is None:
+            if self._fired:
+                # The wedged call returned after all: the stall was
+                # transient (preemption burp, one pathological compile).
+                self._fired = False
+                if self.on_clear is not None:
+                    self.on_clear()
+            return False
+        if ewma is None or self._fired:
+            return self._fired
+        limit = max(self.floor_s, self.multiplier * ewma)
+        if wait <= limit:
+            return False
+        self._fired = True
+        self.stalls += 1
+        message = (
+            f"decode stall: device wait {wait:.1f}s exceeds "
+            f"{limit:.1f}s (chunk EWMA {ewma:.4f}s x {self.multiplier:g}, "
+            f"floor {self.floor_s:g}s) — device hang or XLA wedge"
+        )
+        metrics.SERVE_STALLS.inc(self.engine._engine_label)
+        from oim_tpu.common import events
+
+        events.emit(
+            "serve.stall",
+            component="oim-serve",
+            severity=events.ERROR,
+            wait_s=round(wait, 1),
+            limit_s=round(limit, 1),
+            chunk_ewma_s=round(ewma, 4),
+        )
+        self.on_stall(message)
+        return True
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.check()
+
+    def start(self) -> "StallWatchdog":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
 
 
 class ServeServer:
@@ -101,6 +218,9 @@ class ServeServer:
         port: int = 0,
         ssl_context=None,
         tokenizer=None,
+        watchdog_interval: float = 0.0,
+        stall_multiplier: float = 8.0,
+        stall_floor_s: float = 10.0,
     ):
         """``ssl_context`` (from ``httptls.server_ssl_context``) wraps
         the listener in mTLS: clients must hold a deployment-CA cert or
@@ -110,24 +230,75 @@ class ServeServer:
         ``texttok.TextTokenizer``) enables the text surface: requests
         may send ``{"text": ...}`` instead of ``tokens`` and replies
         carry the decoded ``text`` — the engine itself stays
-        tokenizer-agnostic."""
+        tokenizer-agnostic.  ``watchdog_interval`` > 0 runs a
+        ``StallWatchdog`` beside the driver (oim-serve turns it on;
+        embedders/tests opt in): a wedged device fails in-flight
+        requests fast and flips /healthz instead of stalling silently."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.error: str | None = None  # set when the driver thread dies
+        # Guards error transitions: the watchdog thread (stall set /
+        # clear) races the driver thread (death), and the clear must
+        # never clobber a driver-death error that landed between its
+        # check and its store.  Bare reads (handlers, the registration
+        # health gate) stay lock-free — a reference read is atomic.
+        self._error_lock = threading.Lock()
+        # True while self.error came from a stall verdict (clearable);
+        # a driver-death error is permanent and must survive a clear.
+        self._stall_error = False
         self._stop = threading.Event()
+        self.watchdog = (
+            StallWatchdog(
+                engine,
+                on_stall=self._on_stall,
+                on_clear=self._on_stall_clear,
+                interval=watchdog_interval,
+                multiplier=stall_multiplier,
+                floor_s=stall_floor_s,
+            )
+            if watchdog_interval > 0
+            else None
+        )
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):  # stderr noise → engine stats
                 pass
 
-            def _json(self, code: int, payload: dict) -> None:
+            def _json(
+                self, code: int, payload: dict,
+                headers: dict | None = None,
+            ) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _retry_after(self) -> dict:
+                """Retry-After for 429/503 sheds, from the engine's
+                observed marginal token rate (how long the current
+                backlog takes to drain)."""
+                return {"Retry-After": str(outer.engine.retry_after_s())}
+
+            def _deadline(self, body: dict) -> float | None:
+                """Per-request deadline knob: ``deadline_ms`` in the
+                body (wins) or the ``x-oim-deadline-ms`` header — a
+                RELATIVE millisecond budget, converted to the engine's
+                absolute monotonic clock here so client/server clock
+                skew never matters."""
+                ms = body.get("deadline_ms")
+                if ms is None:
+                    ms = self.headers.get("x-oim-deadline-ms")
+                if ms is None:
+                    return None
+                ms = float(ms)
+                if ms <= 0:
+                    raise ValueError(f"deadline_ms must be > 0, got {ms}")
+                return time.monotonic() + ms / 1000.0
 
             def do_GET(self):
                 # Serving-plane CN pinning (httptls module docstring):
@@ -172,8 +343,10 @@ class ServeServer:
                 """NDJSON token stream: the engine's on_token callback
                 feeds a queue (callbacks must not block the driver
                 thread); this handler drains it onto the socket.  A
-                client that disconnects mid-stream forfeits the result
-                (engine.forget) — generation itself runs to completion.
+                client that disconnects mid-stream cancels the request
+                (engine.cancel — the slot is freed at the next pipeline
+                boundary, abandoned streams stop burning chip time) and
+                forfeits the result (engine.forget).
                 Ordering holds under the pipelined engine too: chunks
                 are processed in dispatch order on the one driver
                 thread, so per-request callbacks (and the terminating
@@ -190,13 +363,13 @@ class ServeServer:
                     rid = outer.engine.submit(
                         req, on_token=lambda t, lp: tokens_q.put((t, lp))
                     )
-                except QueueFullError as exc:
-                    span.status = "error: queue full"
-                    self._json(429, {"error": str(exc)})
+                except (QueueFullError, DeadlineExpiredError) as exc:
+                    span.status = "error: shed"
+                    self._json(429, {"error": str(exc)}, self._retry_after())
                     return
-                except DrainingError as exc:
-                    span.status = "error: draining"
-                    self._json(503, {"error": str(exc)})
+                except (DrainingError, EngineFailedError) as exc:
+                    span.status = "error: unavailable"
+                    self._json(503, {"error": str(exc)}, self._retry_after())
                     return
                 try:
                     # Headers inside the try: wfile is unbuffered, so a
@@ -220,7 +393,10 @@ class ServeServer:
                         except queue.Empty:
                             # Same situation the non-stream path answers
                             # with 503; the protocol promises a
-                            # terminating error line.
+                            # terminating error line.  Cancel first:
+                            # nobody is listening, so the slot must stop
+                            # burning chip time.
+                            outer.engine.cancel(rid, "stream timed out")
                             outer.engine.forget(rid)
                             span.status = "error: timeout"
                             self.wfile.write(
@@ -260,11 +436,27 @@ class ServeServer:
                             json.dumps({"error": str(exc)}).encode() + b"\n"
                         )
                 except (BrokenPipeError, ConnectionResetError):
+                    # Client disconnect propagates to the engine: the
+                    # request is cancelled (its slot freed at the next
+                    # pipeline boundary) instead of decoding to
+                    # completion for nobody.
+                    outer.engine.cancel(rid, "client disconnected")
                     outer.engine.forget(rid)
                     span.status = "error: client disconnected"
 
             def do_POST(self):
                 if not check_serving_peer(self):
+                    return
+                if outer.error is not None:
+                    # Dead driver thread OR a live stall verdict: fail
+                    # fast instead of queueing work nothing will drive.
+                    # Checked before EVERY engine-touching path — embed
+                    # and beam dispatch on the HANDLER thread, so on a
+                    # wedged device they would block inside the device
+                    # call itself, beyond even the result() timeout.
+                    self._json(
+                        503, {"error": outer.error}, self._retry_after()
+                    )
                     return
                 if self.path == "/v1/embed":
                     self._embed_request()
@@ -273,21 +465,12 @@ class ServeServer:
                     self._beam_request()
                     return
                 if self.path in ("/v1/completions", "/v1/chat/completions"):
-                    if outer.error is not None:
-                        # No driver thread left; fail fast like
-                        # /v1/generate instead of a 600 s hang.
-                        self._json(503, {"error": {"message": outer.error}})
-                        return
                     self._completions_request(
                         chat=self.path.endswith("chat/completions")
                     )
                     return
                 if self.path != "/v1/generate":
                     self._json(404, {"error": f"no such path {self.path}"})
-                    return
-                if outer.error is not None:
-                    # No driver thread left to serve it; fail fast.
-                    self._json(503, {"error": outer.error})
                     return
                 # Join the caller's W3C trace (the same propagation the
                 # gRPC control plane does via metadata): a workload that
@@ -366,6 +549,7 @@ class ServeServer:
                         )
                     temperature = float(body.get("temperature", 1.0))
                     seed = int(body.get("seed", 0))
+                    deadline = self._deadline(body)
 
                     def req_for(i):
                         return GenRequest(
@@ -373,6 +557,7 @@ class ServeServer:
                             max_new_tokens=int(body.get("max_tokens", 16)),
                             temperature=temperature,
                             seed=seed + i,
+                            deadline=deadline,
                             eos_id=(
                                 outer.tokenizer.eos_id
                                 if outer.tokenizer is not None
@@ -397,13 +582,19 @@ class ServeServer:
                         return
                     for i in range(n):
                         rids.append(outer.engine.submit(req_for(i)))
-                except QueueFullError as exc:
-                    self._forget_all(rids)
-                    self._json(429, {"error": {"message": str(exc)}})
+                except (QueueFullError, DeadlineExpiredError) as exc:
+                    self._forget_all(rids, cancel="batch sibling shed")
+                    self._json(
+                        429, {"error": {"message": str(exc)}},
+                        self._retry_after(),
+                    )
                     return
-                except DrainingError as exc:
-                    self._forget_all(rids)
-                    self._json(503, {"error": {"message": str(exc)}})
+                except (DrainingError, EngineFailedError) as exc:
+                    self._forget_all(rids, cancel="batch sibling shed")
+                    self._json(
+                        503, {"error": {"message": str(exc)}},
+                        self._retry_after(),
+                    )
                     return
                 except (KeyError, TypeError, ValueError) as exc:
                     self._json(400, {"error": {"message": str(exc)}})
@@ -414,14 +605,35 @@ class ServeServer:
                     try:
                         out = outer.engine.result(rid, timeout=600)
                     except TimeoutError:
-                        self._forget_all(rids[i:])
+                        self._forget_all(
+                            rids[i:], cancel="client wait timed out"
+                        )
                         self._json(
                             503,
                             {"error": {"message": f"{rid} timed out"}},
                         )
                         return
+                    except RequestFailedError as exc:
+                        self._forget_all(
+                            rids[i + 1:], cancel="batch sibling failed"
+                        )
+                        code = {
+                            "deadline_queue": 429,
+                            "deadline": 504,
+                            "stalled": 503,
+                        }.get(exc.kind, 500)
+                        headers = (
+                            self._retry_after()
+                            if code in (429, 503) else None
+                        )
+                        self._json(
+                            code, {"error": {"message": str(exc)}}, headers
+                        )
+                        return
                     except RuntimeError as exc:
-                        self._forget_all(rids[i + 1:])
+                        self._forget_all(
+                            rids[i + 1:], cancel="batch sibling failed"
+                        )
                         self._json(500, {"error": {"message": str(exc)}})
                         return
                     completion_tokens += len(out)
@@ -467,11 +679,17 @@ class ServeServer:
                     },
                 })
 
-            def _forget_all(self, rids) -> None:
+            def _forget_all(self, rids, cancel: str | None = None) -> None:
                 """Release engine results for every rid in ``rids`` —
                 an n>1 request failing partway must not strand the
-                other choices' results in the daemon forever."""
+                other choices' results in the daemon forever.  With
+                ``cancel``, each rid is cancelled first: the client is
+                getting an error for the whole batch, so siblings still
+                queued or decoding must stop burning chip time, not
+                run to completion for nobody."""
                 for rid in rids:
+                    if cancel is not None:
+                        outer.engine.cancel(rid, cancel)
                     outer.engine.forget(rid)
 
             def _completions_stream(
@@ -544,6 +762,7 @@ class ServeServer:
                     # Same situation the non-stream path answers with
                     # 503: emit a terminal error event — a silent close
                     # would be indistinguishable from completion.
+                    outer.engine.cancel(rid, "stream timed out")
                     outer.engine.forget(rid)
                     try:
                         self.wfile.write(
@@ -556,6 +775,7 @@ class ServeServer:
                     except (BrokenPipeError, ConnectionResetError):
                         pass
                 except (BrokenPipeError, ConnectionResetError):
+                    outer.engine.cancel(rid, "client disconnected")
                     outer.engine.forget(rid)
 
             def _embed_request(self) -> None:
@@ -643,6 +863,7 @@ class ServeServer:
                             body.get("frequency_penalty", 0.0)
                         ),
                         cache_prefix=bool(body.get("cache_prefix")),
+                        deadline=self._deadline(body),
                     )
                     span.attrs.update(
                         prompt_tokens=len(req.tokens),
@@ -654,13 +875,15 @@ class ServeServer:
                         self._stream(req, span)
                         return
                     rid = outer.engine.submit(req)
-                except QueueFullError as exc:
-                    span.status = "error: queue full"
-                    self._json(429, {"error": str(exc)})
+                except (QueueFullError, DeadlineExpiredError) as exc:
+                    # Shed: queue at capacity, or the deadline budget
+                    # was already gone — 429 with a drain-rate hint.
+                    span.status = "error: shed"
+                    self._json(429, {"error": str(exc)}, self._retry_after())
                     return
-                except DrainingError as exc:
-                    span.status = "error: draining"
-                    self._json(503, {"error": str(exc)})
+                except (DrainingError, EngineFailedError) as exc:
+                    span.status = "error: unavailable"
+                    self._json(503, {"error": str(exc)}, self._retry_after())
                     return
                 except (KeyError, TypeError, ValueError) as exc:
                     span.status = "error: bad request"
@@ -669,12 +892,32 @@ class ServeServer:
                 try:
                     tokens, lps = outer.engine.result_full(rid, timeout=600)
                 except TimeoutError:
-                    # Clean 503 instead of a dropped socket; forget() frees
-                    # the result whenever it does complete — a flaky client
-                    # must not grow the daemon's memory.
+                    # Clean 503 instead of a dropped socket; cancel stops
+                    # the slot burning for a client that stopped waiting,
+                    # forget() frees the result if it lands anyway — a
+                    # flaky client must not grow the daemon's memory.
+                    outer.engine.cancel(rid, "server-side wait timed out")
                     outer.engine.forget(rid)
                     span.status = "error: timeout"
                     self._json(503, {"error": f"request {rid} timed out"})
+                    return
+                except RequestFailedError as exc:
+                    span.status = f"error: {exc.kind}"
+                    if exc.kind == "deadline_queue":
+                        # Shed before touching a slot: retryable, cheap.
+                        self._json(
+                            429, {"error": str(exc)}, self._retry_after()
+                        )
+                    elif exc.kind == "deadline":
+                        self._json(504, {"error": str(exc)})
+                    elif exc.kind == "stalled":
+                        # Watchdog failed it fast; another replica can
+                        # serve it — distinct from a driver-death 500.
+                        self._json(
+                            503, {"error": str(exc)}, self._retry_after()
+                        )
+                    else:  # aborted / cancelled
+                        self._json(500, {"error": str(exc)})
                     return
                 except RuntimeError as exc:  # aborted: driver thread died
                     span.status = "error: aborted"
@@ -711,6 +954,26 @@ class ServeServer:
         )
         self._driver_thread = threading.Thread(target=self._drive, daemon=True)
 
+    def _on_stall(self, message: str) -> None:
+        """Watchdog verdict: fail in-flight requests fast with the
+        retryable "stalled" kind (HTTP 503 + Retry-After) and flip
+        /healthz unhealthy so the router routes around this backend."""
+        with self._error_lock:
+            if self.error is None:
+                self.error = message
+                self._stall_error = True
+        self.engine.abort(message, kind="stalled")
+
+    def _on_stall_clear(self) -> None:
+        """The wedged device call returned: restore /healthz (only if
+        the stall was what broke it — a dead driver thread stays dead;
+        the explicit flag, not the message text, is what distinguishes
+        the two)."""
+        with self._error_lock:
+            if self._stall_error:
+                self.error = None
+                self._stall_error = False
+
     def _drive(self) -> None:
         while not self._stop.is_set():
             try:
@@ -719,19 +982,28 @@ class ServeServer:
                 else:
                     time.sleep(0.005)
             except Exception as exc:  # driver death = service death
-                self.error = f"{type(exc).__name__}: {exc}"
-                # Fail everything in flight so blocked result() callers
-                # get an immediate error, not a 600 s timeout.
-                self.engine.abort(self.error)
+                message = f"{type(exc).__name__}: {exc}"
+                with self._error_lock:
+                    # Overwrite even a stall verdict: a dead driver is
+                    # the stronger (and permanent) condition.
+                    self.error = message
+                    self._stall_error = False
+                # The engine already latched the crash and failed every
+                # waiter inside step(); this abort is a no-op backstop.
+                self.engine.abort(message)
                 return
 
     def start(self) -> "ServeServer":
         self._http_thread.start()
         self._driver_thread.start()
+        if self.watchdog is not None:
+            self.watchdog.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self.watchdog is not None:
+            self.watchdog.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
         # Join the listener as well as the driver: shutdown() handshakes

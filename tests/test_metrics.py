@@ -463,3 +463,40 @@ def test_resilience_instruments_record_and_render():
         'oim_breaker_transitions_total{target="metrics-demo",state="open"} 1'
         in text
     )
+
+
+def test_serve_fault_tolerance_instruments_render():
+    """The serve-plane fault-tolerance instruments (PR 6: stalls,
+    sheds by reason, failovers by outcome, deadline expirations) are
+    shared definitions in oim_tpu/common/metrics.py — one series shape
+    fleet-wide — and render in standard exposition text."""
+    # Deltas, not absolutes: these are process-global counters other
+    # suites in the same run may legitimately have driven.
+    before = {
+        "shed": metrics.SERVE_SHED.value("queue_full"),
+        "failover": metrics.SERVE_FAILOVERS.value("spliced"),
+        "deadline": metrics.SERVE_DEADLINE_EXPIRED.value(),
+    }
+    metrics.SERVE_STALLS.inc("metrics-demo")
+    metrics.SERVE_SHED.inc("queue_full")
+    metrics.SERVE_SHED.inc("brownout")
+    metrics.SERVE_FAILOVERS.inc("spliced")
+    metrics.SERVE_FAILOVERS.inc("gave_up")
+    metrics.SERVE_DEADLINE_EXPIRED.inc()
+    assert metrics.SERVE_STALLS.value("metrics-demo") == 1
+    assert metrics.SERVE_SHED.value("queue_full") == before["shed"] + 1
+    assert (
+        metrics.SERVE_FAILOVERS.value("spliced") == before["failover"] + 1
+    )
+    assert (
+        metrics.SERVE_DEADLINE_EXPIRED.value() == before["deadline"] + 1
+    )
+    text = metrics.registry().render()
+    assert "# TYPE oim_serve_stalls_total counter" in text
+    assert 'oim_serve_stalls_total{engine="metrics-demo"} 1' in text
+    assert 'oim_serve_shed_total{reason="queue_full"}' in text
+    assert 'oim_serve_shed_total{reason="brownout"}' in text
+    assert 'oim_serve_failovers_total{outcome="spliced"}' in text
+    assert 'oim_serve_failovers_total{outcome="gave_up"}' in text
+    assert "# TYPE oim_serve_deadline_expired_total counter" in text
+    assert "oim_serve_deadline_expired_total" in text

@@ -5,6 +5,7 @@ HTTP listeners, a real Router in front, plus unit-level checks on the
 backend table and the registry authz rule for ``serve.<id>`` CNs.
 """
 
+import http.server
 import json
 import threading
 import time
@@ -111,6 +112,59 @@ def test_connection_failures_flip_health():
         assert [b.id for b in router.healthy_backends()] == ["http://b:2"]
     finally:
         router.stop()
+
+
+def test_health_flapping_boundary():
+    """The exact ``unhealthy_after`` contract under probe flapping:
+    N-1 consecutive probe failures keep the backend in rotation, the
+    Nth removes it, and a SINGLE success restores it (and zeroes the
+    failure streak, so a fresh flap needs N failures again — a backend
+    on a lossy link doesn't ratchet out on scattered misses)."""
+    healthz_ok = threading.Event()
+    healthz_ok.set()
+
+    class Stub(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            body = b'{"ok": true}'
+            self.send_response(200 if healthz_ok.is_set() else 503)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    port = httpd.server_address[1]
+    stub_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    stub_thread.start()
+    router = Router(
+        backends=(f"http://127.0.0.1:{port}",),
+        unhealthy_after=3,
+        health_interval=3600,  # probes driven by hand below
+    )
+    try:
+        (backend,) = router._backends.values()
+        healthz_ok.clear()
+        for i in range(2):  # N-1 failures: still in rotation
+            router._probe(backend)
+            assert backend.healthy, f"left rotation after {i + 1} < N fails"
+            assert router.healthy_backends() == [backend]
+        router._probe(backend)  # the Nth removes it
+        assert not backend.healthy
+        assert router.healthy_backends() == []
+        healthz_ok.set()  # first success restores — and resets the streak
+        router._probe(backend)
+        assert backend.healthy and backend.fails == 0
+        assert router.healthy_backends() == [backend]
+        healthz_ok.clear()  # a fresh flap needs N failures again
+        router._probe(backend)
+        assert backend.healthy
+    finally:
+        router.stop()
+        httpd.shutdown()
+        httpd.server_close()
+        stub_thread.join(timeout=5)
 
 
 # ---------------------------------------------------------------------------
@@ -362,6 +416,48 @@ def test_serve_self_registration_heartbeat(backends):
 def test_registration_invalid_id_rejected():
     with pytest.raises(ValueError, match="serve id"):
         ServeRegistration("a/b", "tcp://x:1", "http://y:2")
+
+
+def test_registration_health_gate_withdraws_and_restores(backends):
+    """The health-gated heartbeat (PR 6): an unhealthy beat actively
+    WITHDRAWS the discovery key (routers drop the instance on one watch
+    DELETE event — faster than probe failures + lease expiry) and
+    pauses re-registration; the first healthy beat restores the key."""
+    reg = Registry()
+    reg_srv = reg.start_server("tcp://127.0.0.1:0")
+    try:
+        addr = f"tcp://{reg_srv.addr().address}"
+        healthy = threading.Event()
+        healthy.set()
+        registration = ServeRegistration(
+            "inst-hg", addr, _url(backends[0]), delay=0.1,
+            health=healthy.is_set,
+        ).start()
+        try:
+            key = "serve/inst-hg/address"
+            deadline = time.time() + 10
+            while time.time() < deadline and not reg.db.lookup(key):
+                time.sleep(0.02)
+            assert reg.db.lookup(key) == _url(backends[0])
+
+            healthy.clear()  # stall/driver death: withdraw, don't wait
+            deadline = time.time() + 10
+            while time.time() < deadline and reg.db.lookup(key):
+                time.sleep(0.02)
+            assert reg.db.lookup(key) == ""
+            # Stays withdrawn across beats while unhealthy.
+            time.sleep(0.3)
+            assert reg.db.lookup(key) == ""
+
+            healthy.set()  # recovered: next beat re-registers
+            deadline = time.time() + 10
+            while time.time() < deadline and not reg.db.lookup(key):
+                time.sleep(0.02)
+            assert reg.db.lookup(key) == _url(backends[0])
+        finally:
+            registration.stop()
+    finally:
+        reg_srv.stop()
 
 
 def test_serve_cn_authz():
@@ -744,6 +840,51 @@ def test_chat_completions_affinity_key():
         assert len(picks) == 1
     finally:
         router.stop()
+
+
+def test_router_forwards_deadline_header():
+    """The fleet entry point must not strip the x-oim-deadline-ms knob
+    — and it hands each backend attempt the REMAINING budget (≤ what
+    the client sent), so failovers can't restart the deadline."""
+    seen = {}
+
+    class Stub(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_POST(self):
+            seen["deadline"] = self.headers.get("x-oim-deadline-ms")
+            self.rfile.read(int(self.headers.get("Content-Length", "0")))
+            body = b'{"tokens": [1]}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    port = httpd.server_address[1]
+    stub_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    stub_thread.start()
+    router = Router(
+        backends=(f"http://127.0.0.1:{port}",), health_interval=60.0,
+    ).start()
+    try:
+        req = urllib.request.Request(
+            f"http://{router.host}:{router.port}/v1/generate",
+            json.dumps({"tokens": [1], "max_new_tokens": 2}).encode(),
+            {"Content-Type": "application/json",
+             "x-oim-deadline-ms": "30000"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+        assert seen["deadline"] is not None, "deadline header stripped"
+        assert 0 < int(seen["deadline"]) <= 30000
+    finally:
+        router.stop()
+        httpd.shutdown()
+        httpd.server_close()
+        stub_thread.join(timeout=5)
 
 
 def test_stop_joins_loop_threads():

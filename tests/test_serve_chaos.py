@@ -1,0 +1,757 @@
+"""Serve-plane fault tolerance: deadlines, cancellation, stream-splice
+failover, overload shedding/brownout, and the stall watchdog.
+
+PR 2's chaos discipline (seeded injection, soak loops asserting zero
+leaks every cycle) applied to the serve plane: real engines on tiny
+models behind real HTTP listeners, a real Router in front, and
+``FlakyHTTPBackend`` proxies injecting the faults — backend killed
+mid-stream, truncated bodies, flaky /healthz.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import numpy as np
+import pytest
+
+from oim_tpu.common import metrics
+from oim_tpu.common.chaos import FlakyHTTPBackend
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.serve import Engine, GenRequest, Router
+from oim_tpu.serve.engine import (
+    DeadlineExpiredError,
+    EngineFailedError,
+    RequestFailedError,
+)
+from oim_tpu.serve.server import ServeServer, StallWatchdog
+
+pytestmark = pytest.mark.chaos
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def backends(setup):
+    """Two live oim-serve instances sharing one tiny model (greedy
+    output is therefore identical across them — the splice-exactness
+    oracle)."""
+    cfg, params = setup
+    servers = [
+        ServeServer(
+            Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+        ).start()
+        for _ in range(2)
+    ]
+    yield servers
+    for server in servers:
+        server.stop()
+
+
+def _url(server: ServeServer) -> str:
+    return f"http://{server.host}:{server.port}"
+
+
+def _post(base: str, path: str, payload: dict, timeout=120):
+    req = urllib.request.Request(
+        base + path,
+        json.dumps(payload).encode(),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _stream_lines(base: str, payload: dict, timeout=120) -> list[dict]:
+    """POST a streaming generate and return every NDJSON line parsed."""
+    req = urllib.request.Request(
+        base + "/v1/generate",
+        json.dumps(dict(payload, stream=True)).encode(),
+        {"Content-Type": "application/json"},
+    )
+    lines = []
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        for raw in resp:
+            raw = raw.strip()
+            if raw:
+                lines.append(json.loads(raw))
+    return lines
+
+
+def _prompt(seed: int, n: int) -> list[int]:
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG["vocab_size"], size=n).tolist()
+
+
+def _quiesce(engines, deadline_s: float = 10.0) -> None:
+    """Wait until no engine holds active slots / queued work — then
+    assert the zero-leak invariant (slots, waiters, pipeline)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        stats = [e.stats() for e in engines]
+        if all(
+            s["active_slots"] == 0 and s["queued"] == 0
+            and s["inflight_dispatches"] == 0
+            for s in stats
+        ):
+            break
+        time.sleep(0.05)
+    for engine in engines:
+        s = engine.stats()
+        assert s["active_slots"] == 0, s
+        assert s["queued"] == 0, s
+        assert s["free_slots"] == engine._cache.n_slots, s
+        assert s["inflight_dispatches"] == 0, s
+
+
+def _assert_no_hung_waiters(engines, deadline_s: float = 5.0) -> None:
+    """Every result event either consumed or resolved: nothing blocked
+    forever (the handler threads consume results within the window)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        if all(
+            all(ev.is_set() for ev in e._events.values()) or not e._events
+            for e in engines
+        ):
+            return
+        time.sleep(0.05)
+    for engine in engines:
+        unset = [r for r, ev in engine._events.items() if not ev.is_set()]
+        assert not unset, f"hung waiters: {unset}"
+
+
+# ---------------------------------------------------------------------------
+# Tentpole acceptance: stream-splice failover under kill-mid-stream chaos
+
+
+def test_splice_failover_soak_greedy_token_identical(backends):
+    """THE acceptance soak: one backend killed mid-stream at 20%
+    injection over 40+ streamed request cycles — every greedy stream
+    completes token-identical to an unfaulted run via splice failover,
+    with zero leaked slots and zero hung waiters."""
+    flaky = FlakyHTTPBackend(
+        _url(backends[0]), kill_rate=0.2, kill_after_lines=2, seed=11,
+    ).start()
+    router = Router(
+        backends=(flaky.url, _url(backends[1])),
+        # The flaky backend must STAY in rotation for the whole soak —
+        # this test injects per-request deaths, not backend removal.
+        unhealthy_after=10_000,
+        health_interval=60.0,
+    ).start()
+    base = f"http://{router.host}:{router.port}"
+    spliced_before = metrics.SERVE_FAILOVERS.value("spliced")
+    gave_up_before = metrics.SERVE_FAILOVERS.value("gave_up")
+    try:
+        cycles = 44
+        oracles: dict = {}
+        for i in range(cycles):
+            prompt = _prompt(i % 7, 4 + (i % 5))
+            max_new = 6 + (i % 3)
+            # Unfaulted oracle: straight to the non-proxied backend
+            # (same params → greedy output is the same everywhere).
+            key = (tuple(prompt), max_new)
+            if key not in oracles:
+                _, oracles[key] = _post(
+                    _url(backends[1]), "/v1/generate",
+                    {"tokens": prompt, "max_new_tokens": max_new},
+                )
+            direct = oracles[key]
+            lines = _stream_lines(
+                base, {"tokens": prompt, "max_new_tokens": max_new}
+            )
+            assert lines, f"cycle {i}: empty stream"
+            final = lines[-1]
+            assert final.get("done"), f"cycle {i}: no terminal line: {final}"
+            assert final["tokens"] == direct["tokens"], f"cycle {i}"
+            streamed = [ln["token"] for ln in lines[:-1] if "token" in ln]
+            assert streamed == direct["tokens"], f"cycle {i}"
+        assert flaky.kills >= 4, (
+            f"soak injected too few kills ({flaky.kills}) to prove "
+            f"anything — reseed"
+        )
+        assert (
+            metrics.SERVE_FAILOVERS.value("spliced") - spliced_before
+            >= flaky.kills * 0.5
+        )
+        assert metrics.SERVE_FAILOVERS.value("gave_up") == gave_up_before
+    finally:
+        router.stop()
+        flaky.stop()
+    engines = [s.engine for s in backends]
+    _quiesce(engines)
+    _assert_no_hung_waiters(engines)
+
+
+def test_splice_synthesizes_done_when_prefix_already_finished(backends):
+    """Backend killed AFTER every token line but before the done line:
+    nothing is left to decode, so the router synthesizes the terminal
+    line locally instead of resubmitting a zero-token continuation."""
+    max_new = 5
+    flaky = FlakyHTTPBackend(
+        _url(backends[0]), kill_after_lines=max_new, seed=3,
+    ).start()
+    router = Router(
+        backends=(flaky.url,), unhealthy_after=10_000,
+        health_interval=60.0,
+    ).start()
+    base = f"http://{router.host}:{router.port}"
+    try:
+        flaky.fail_next(1)
+        prompt = _prompt(2, 5)
+        _, direct = _post(
+            _url(backends[0]), "/v1/generate",
+            {"tokens": prompt, "max_new_tokens": max_new},
+        )
+        lines = _stream_lines(
+            base, {"tokens": prompt, "max_new_tokens": max_new}
+        )
+        assert lines[-1].get("done")
+        assert lines[-1]["tokens"] == direct["tokens"]
+    finally:
+        router.stop()
+        flaky.stop()
+
+
+def test_stream_exclusion_is_for_request_lifetime(backends):
+    """Both backends kill every stream: the router tries each EXACTLY
+    once (a connection-failed/died backend is excluded for the
+    request's lifetime), then ends the stream with a terminal error
+    line — bounded attempts, clean give-up, gave_up counted."""
+    flakies = [
+        FlakyHTTPBackend(
+            _url(s), kill_rate=1.0, kill_after_lines=1, seed=i,
+        ).start()
+        for i, s in enumerate(backends)
+    ]
+    router = Router(
+        backends=tuple(f.url for f in flakies),
+        unhealthy_after=10_000, health_interval=60.0,
+    ).start()
+    base = f"http://{router.host}:{router.port}"
+    gave_up_before = metrics.SERVE_FAILOVERS.value("gave_up")
+    try:
+        lines = _stream_lines(
+            base, {"tokens": _prompt(5, 4), "max_new_tokens": 6}
+        )
+        assert "error" in lines[-1], lines
+        assert metrics.SERVE_FAILOVERS.value("gave_up") == gave_up_before + 1
+        # Each backend saw exactly ONE generate POST: no re-picks of a
+        # backend that already dropped this request.
+        assert [f.requests for f in flakies] == [1, 1]
+    finally:
+        router.stop()
+        for f in flakies:
+            f.stop()
+    _quiesce([s.engine for s in backends])
+
+
+def test_buffered_resubmit_and_flaky_healthz_soak(backends):
+    """Non-stream responses are buffered and resubmitted whole on
+    truncation, while /healthz flaps at 50%: every request still
+    answers 200 with exact tokens (the router retries around both
+    fault kinds)."""
+    flaky = FlakyHTTPBackend(
+        _url(backends[0]), kill_rate=0.25, healthz_error_rate=0.5,
+        seed=7,
+    ).start()
+    router = Router(
+        backends=(flaky.url, _url(backends[1])),
+        unhealthy_after=2, health_interval=0.1,
+    ).start()
+    base = f"http://{router.host}:{router.port}"
+    resubmitted_before = metrics.SERVE_FAILOVERS.value("resubmitted")
+    try:
+        for i in range(20):
+            prompt = _prompt(i % 5, 5)
+            _, direct = _post(
+                _url(backends[1]), "/v1/generate",
+                {"tokens": prompt, "max_new_tokens": 5},
+            )
+            status, reply = _post(
+                base, "/v1/generate",
+                {"tokens": prompt, "max_new_tokens": 5},
+            )
+            assert status == 200
+            assert reply["tokens"] == direct["tokens"], f"cycle {i}"
+        assert flaky.kills >= 2
+        assert (
+            metrics.SERVE_FAILOVERS.value("resubmitted")
+            > resubmitted_before
+        )
+    finally:
+        router.stop()
+        flaky.stop()
+    _quiesce([s.engine for s in backends])
+
+
+# ---------------------------------------------------------------------------
+# Deadlines & shedding (engine + HTTP)
+
+
+def test_deadline_expired_at_submit_is_shed(setup):
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=2)
+    before = metrics.SERVE_DEADLINE_EXPIRED.value()
+    with pytest.raises(DeadlineExpiredError):
+        engine.submit(GenRequest(
+            tokens=[1, 2], max_new_tokens=4,
+            deadline=time.monotonic() - 0.01,
+        ))
+    assert metrics.SERVE_DEADLINE_EXPIRED.value() == before + 1
+    assert metrics.SERVE_SHED.value("deadline") >= 1
+
+
+def test_deadline_expired_in_queue_sheds_before_slot(setup):
+    """A queued entry whose deadline lapses is shed without ever
+    touching a slot — kind deadline_queue, the 429 + Retry-After
+    path."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=2)
+    # Occupy the only slot so the second request must queue.
+    long_rid = engine.submit(GenRequest(tokens=[1, 2], max_new_tokens=30))
+    engine.step()
+    shed_rid = engine.submit(GenRequest(
+        tokens=[3, 4], max_new_tokens=4,
+        deadline=time.monotonic() + 0.02,
+    ))
+    time.sleep(0.05)
+    dispatches_before = engine._step_count
+    while engine.pending():
+        engine.step()
+    with pytest.raises(RequestFailedError) as err:
+        engine.result(shed_rid, timeout=1)
+    assert err.value.kind == "deadline_queue"
+    # The long request still completed normally.
+    assert len(engine.result(long_rid, timeout=1)) == 30
+    assert engine._step_count > dispatches_before
+
+
+def test_deadline_mid_decode_frees_slot(setup):
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+    engine.submit(GenRequest(tokens=[1, 2, 3], max_new_tokens=4))
+    engine.run()  # warm the compile so decode pace is real
+    rid = engine.submit(GenRequest(
+        tokens=[1, 2], max_new_tokens=50,
+        deadline=time.monotonic() + 0.05,
+    ))
+    while engine.pending():
+        engine.step()
+        time.sleep(0.005)
+    with pytest.raises(RequestFailedError) as err:
+        engine.result(rid, timeout=1)
+    assert err.value.kind == "deadline"
+    stats = engine.stats()
+    assert stats["active_slots"] == 0 and stats["free_slots"] == 2
+    # The engine stays fully usable after the reap.
+    rid2 = engine.submit(GenRequest(tokens=[5, 6], max_new_tokens=3))
+    engine.run()
+    assert len(engine.result(rid2)) == 3
+
+
+def test_http_deadline_and_retry_after_headers(setup):
+    """deadline_ms knob over HTTP: a queued request whose budget lapses
+    answers 429 with a Retry-After header; queue-full sheds carry one
+    too."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=2,
+                    max_queue=1)
+    server = ServeServer(engine, port=0).start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        results = {}
+
+        def bg(name, payload):
+            req = urllib.request.Request(
+                base + "/v1/generate", json.dumps(payload).encode()
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    results[name] = (resp.status, dict(resp.headers))
+            except urllib.error.HTTPError as exc:
+                results[name] = (exc.code, dict(exc.headers))
+
+        t1 = threading.Thread(target=bg, args=(
+            "long", {"tokens": [1, 2], "max_new_tokens": 40},
+        ))
+        t1.start()
+        time.sleep(0.2)  # the long request occupies the only slot
+        t2 = threading.Thread(target=bg, args=(
+            "deadlined",
+            {"tokens": [3], "max_new_tokens": 4, "deadline_ms": 1},
+        ))
+        t2.start()
+        t2.join(timeout=30)
+        assert results["deadlined"][0] == 429
+        assert int(results["deadlined"][1]["Retry-After"]) >= 1
+        t1.join(timeout=60)
+        assert results["long"][0] == 200
+        # Queue-full shed: wedge the decode so the slot and the 1-deep
+        # queue stay deterministically occupied, then bounce a third.
+        release = threading.Event()
+        real_decode = engine._decode
+
+        def wedged(*args, **kwargs):
+            release.wait(timeout=30)
+            return real_decode(*args, **kwargs)
+
+        engine._decode = wedged
+        fill1 = engine.submit(GenRequest(tokens=[1, 2], max_new_tokens=8))
+        deadline = time.monotonic() + 10
+        while (
+            engine.stats()["active_slots"] < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        fill2 = engine.submit(GenRequest(tokens=[3, 4], max_new_tokens=8))
+        bg("bounced", {"tokens": [4], "max_new_tokens": 4})
+        assert results["bounced"][0] == 429
+        assert int(results["bounced"][1]["Retry-After"]) >= 1
+        release.set()
+        assert len(engine.result(fill1, timeout=30)) == 8
+        assert len(engine.result(fill2, timeout=30)) == 8
+    finally:
+        server.stop()
+
+
+def test_brownout_clamps_max_tokens_under_pressure(setup):
+    """Sustained queue pressure clamps incoming max_new_tokens instead
+    of hard-failing — the request is served degraded, and counted."""
+    cfg, params = setup
+    engine = Engine(
+        params, cfg, n_slots=1, max_len=64, chunk=2,
+        max_queue=8, brownout_max_tokens=3, brownout_hold_s=0.0,
+    )
+    before = metrics.SERVE_SHED.value("brownout")
+    rids = [
+        engine.submit(GenRequest(tokens=[i + 1], max_new_tokens=10))
+        for i in range(6)
+    ]
+    # Threshold is ceil(0.75 * 8) = 6: submits 1-6 saw queue depths
+    # 0-5 (unclamped); the 7th sees 6 → pressure + zero hold → clamp.
+    clamped = engine.submit(GenRequest(tokens=[9], max_new_tokens=10))
+    assert metrics.SERVE_SHED.value("brownout") == before + 1
+    engine.run()
+    assert len(engine.result(clamped)) == 3
+    for rid in rids:
+        assert len(engine.result(rid)) == 10
+
+
+# ---------------------------------------------------------------------------
+# Cancellation (client disconnect)
+
+
+def test_client_disconnect_mid_stream_frees_slot(setup):
+    """A streaming client that hangs up propagates to Engine.cancel:
+    the slot frees long before the 400-token budget would complete —
+    abandoned streams stop burning chip time."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=512, chunk=2)
+    server = ServeServer(engine, port=0).start()
+    cancelled_before = metrics.registry().counter(
+        "oim_serve_requests_total", "", ("outcome",)
+    ).value("cancelled")
+    try:
+        body = json.dumps({
+            "tokens": [1, 2, 3], "max_new_tokens": 400, "stream": True,
+        }).encode()
+        sock = socket.create_connection(
+            ("127.0.0.1", server.port), timeout=10
+        )
+        sock.sendall(
+            b"POST /v1/generate HTTP/1.1\r\n"
+            b"Host: x\r\nContent-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n"
+            + body
+        )
+        sock.recv(512)  # headers + the first token lines have flowed
+        sock.close()    # client walks away mid-stream
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            s = engine.stats()
+            if (
+                s["active_slots"] == 0 and s["queued"] == 0
+                and s["free_slots"] == 1
+            ):
+                break
+            time.sleep(0.02)
+        s = engine.stats()
+        assert s["active_slots"] == 0 and s["free_slots"] == 1
+        # Cancelled well short of the budget: the slot did not decode
+        # 400 tokens for nobody.
+        assert s["tokens_generated"] < 300
+        assert metrics.registry().counter(
+            "oim_serve_requests_total", "", ("outcome",)
+        ).value("cancelled") == cancelled_before + 1
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Driver-crash latch (satellite bugfix)
+
+
+def test_driver_crash_wakes_waiters_and_latches(setup):
+    """Engine.result() waiters must never hang when the driver thread
+    dies: step() latches the crash and re-raises to all waiters; later
+    submits fail fast."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=2)
+    rid = engine.submit(GenRequest(tokens=[1, 2], max_new_tokens=8))
+    woke: dict = {}
+
+    def waiter():
+        try:
+            engine.result(rid)  # NO timeout: pre-fix this hung forever
+        except RuntimeError as exc:
+            woke["error"] = str(exc)
+
+    thread = threading.Thread(target=waiter)
+    thread.start()
+
+    def boom(acc):
+        raise RuntimeError("synthetic device failure")
+
+    engine._step_inner = boom
+    with pytest.raises(RuntimeError, match="synthetic device failure"):
+        engine.step()
+    thread.join(timeout=5)
+    assert not thread.is_alive(), "waiter still blocked after driver crash"
+    assert "synthetic device failure" in woke["error"]
+    with pytest.raises(EngineFailedError):
+        engine.submit(GenRequest(tokens=[1], max_new_tokens=1))
+    with pytest.raises(EngineFailedError):
+        engine.embed([1, 2])
+    assert engine.stats()["fatal"] is not None
+
+
+def test_abort_during_wedged_admission_registers_no_ghost(setup):
+    """abort() fired by the stall watchdog while the driver is wedged
+    INSIDE an admission dispatch (the live-driver abort path PR 6
+    introduced): when the wedged call finally returns, the resumed
+    driver must not register slot state for rids abort already failed
+    — the slot is in _free by then, and a ghost registration would
+    double-assign it to the next admission."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=2)
+    entered, release = threading.Event(), threading.Event()
+    real_admit = engine._admit
+
+    def wedged_admit(*args, **kwargs):
+        entered.set()
+        release.wait(timeout=30)  # the hung device, mid-prefill
+        return real_admit(*args, **kwargs)
+
+    engine._admit = wedged_admit
+    rid = engine.submit(GenRequest(tokens=[1, 2], max_new_tokens=8))
+    stepper = threading.Thread(target=engine.step)
+    stepper.start()
+    assert entered.wait(timeout=30)
+    engine.abort("decode stall (test)", kind="stalled")
+    with pytest.raises(RequestFailedError) as err:
+        engine.result(rid, timeout=5)
+    assert err.value.kind == "stalled"
+    release.set()  # transient wedge resolves; the driver resumes
+    stepper.join(timeout=30)
+    assert not stepper.is_alive()
+    stats = engine.stats()
+    assert stats["active_slots"] == 0, "ghost slot state registered"
+    assert sorted(engine._free) == [0, 1], engine._free  # no dupes
+    # The engine serves normally afterwards (same slots, no cross-talk).
+    engine._admit = real_admit
+    rid2 = engine.submit(GenRequest(tokens=[5, 6], max_new_tokens=4))
+    engine.run()
+    assert len(engine.result(rid2)) == 4
+
+
+# ---------------------------------------------------------------------------
+# Stall watchdog
+
+
+class _FakeEngine:
+    _engine_label = "fake-watchdog"
+
+    def __init__(self):
+        self.wait = None
+        self.ewma = None
+
+    def watchdog_state(self):
+        return (self.wait, self.ewma)
+
+
+def test_watchdog_verdict_logic():
+    """No verdict before the first chunk (EWMA None — cold compiles
+    can't false-positive), fire once past max(floor, mult × EWMA),
+    clear when the wait resolves."""
+    fake = _FakeEngine()
+    stalls, clears = [], []
+    wd = StallWatchdog(
+        fake, on_stall=stalls.append, on_clear=lambda: clears.append(1),
+        multiplier=4.0, floor_s=1.0,
+    )
+    before = metrics.SERVE_STALLS.value(fake._engine_label)
+    fake.wait = 100.0  # huge wait but no EWMA yet: cold compile
+    assert wd.check() is False
+    fake.ewma = 0.1
+    fake.wait = 0.5  # below the 1 s floor
+    assert wd.check() is False
+    fake.wait = 1.5  # above floor AND 4×EWMA
+    assert wd.check() is True
+    assert len(stalls) == 1 and "decode stall" in stalls[0]
+    assert wd.check() is True  # latched: no re-fire spam
+    assert len(stalls) == 1
+    assert metrics.SERVE_STALLS.value(fake._engine_label) == before + 1
+    fake.wait = None  # the wedged call returned
+    assert wd.check() is False
+    assert clears == [1]
+    # EWMA-scaled limit: a slow-but-moving chunk below mult×EWMA is
+    # not a stall even past the floor.
+    fake.ewma = 10.0
+    fake.wait = 20.0
+    assert wd.check() is False
+
+
+def test_stall_watchdog_fails_inflight_and_flips_healthz(setup):
+    """Integration acceptance: a wedged decode dispatch is detected
+    within ~one watchdog interval — in-flight requests fail fast with
+    the distinct "stalled" status, /healthz flips to 503 (so the
+    router routes around this backend), and the stall is counted."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=2)
+    server = ServeServer(
+        engine, port=0,
+        watchdog_interval=0.05, stall_multiplier=2.0, stall_floor_s=0.2,
+    ).start()
+    base = f"http://127.0.0.1:{server.port}"
+    release = threading.Event()
+    real_decode = engine._decode
+
+    def wedged_decode(*args, **kwargs):
+        release.wait(timeout=30)  # the hung device
+        return real_decode(*args, **kwargs)
+
+    try:
+        # Warm: establish a real chunk-wall EWMA first.
+        warm = engine.submit(GenRequest(tokens=[1, 2], max_new_tokens=4))
+        deadline = time.monotonic() + 30
+        while engine.pending() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(engine.result(warm, timeout=10)) == 4
+        stalls_before = metrics.SERVE_STALLS.value(engine._engine_label)
+        engine._decode = wedged_decode
+        rid = engine.submit(GenRequest(tokens=[3, 4], max_new_tokens=8))
+        with pytest.raises(RequestFailedError) as err:
+            engine.result(rid, timeout=15)
+        assert err.value.kind == "stalled"
+        assert metrics.SERVE_STALLS.value(engine._engine_label) == (
+            stalls_before + 1
+        )
+        with pytest.raises(urllib.error.HTTPError) as herr:
+            urllib.request.urlopen(base + "/healthz", timeout=10)
+        assert herr.value.code == 503
+        assert "stall" in json.loads(herr.value.read())["error"]
+        # New submissions fail fast (503 via the server error check).
+        with pytest.raises(urllib.error.HTTPError) as gerr:
+            _post(base, "/v1/generate",
+                  {"tokens": [1], "max_new_tokens": 2}, timeout=10)
+        assert gerr.value.code == 503
+        # The wedge resolves: the watchdog clears, /healthz recovers,
+        # and the engine serves again (transient stall, no restart).
+        release.set()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    base + "/healthz", timeout=5
+                ) as resp:
+                    if resp.status == 200:
+                        break
+            except urllib.error.HTTPError:
+                time.sleep(0.05)
+        status, reply = _post(
+            base, "/v1/generate", {"tokens": [5], "max_new_tokens": 3},
+        )
+        assert status == 200 and len(reply["tokens"]) == 3
+    finally:
+        release.set()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Router Retry-After plumbing (satellite)
+
+
+def test_router_503_carries_retry_after():
+    router = Router(
+        backends=("http://127.0.0.1:1",),  # nothing listens there
+        health_interval=60.0,
+    ).start()
+    base = f"http://{router.host}:{router.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(base, "/v1/generate",
+                  {"tokens": [1], "max_new_tokens": 2}, timeout=10)
+        assert err.value.code == 503
+        assert int(err.value.headers["Retry-After"]) >= 1
+    finally:
+        router.stop()
+
+
+def test_router_passes_backend_retry_after_through():
+    """A backend's 429 Retry-After hint must reach the client through
+    the router's error pass-through."""
+
+    class Stub(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", "0")))
+            body = b'{"error": "full"}'
+            self.send_response(429)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Retry-After", "7")
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    port = httpd.server_address[1]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    router = Router(
+        backends=(f"http://127.0.0.1:{port}",), health_interval=60.0,
+    ).start()
+    base = f"http://{router.host}:{router.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(base, "/v1/generate",
+                  {"tokens": [1], "max_new_tokens": 2}, timeout=10)
+        assert err.value.code == 429
+        assert err.value.headers["Retry-After"] == "7"
+    finally:
+        router.stop()
+        httpd.shutdown()
+        httpd.server_close()
+        thread.join(timeout=5)
