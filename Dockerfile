@@ -32,6 +32,7 @@ RUN pip install --no-cache-dir \
         protobuf \
         cryptography \
         numpy \
+        ml-dtypes \
         "jax[tpu]" \
         optax \
         orbax-checkpoint

@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-chaos lint lint-metrics agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-chaos test-autoscale lint lint-metrics agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -88,6 +88,24 @@ test-serve-chaos:
 	  --roots oim_tpu/common
 	timeout -k 10 150 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_serve_chaos.py -q -m "chaos and not slow" \
+	  -p no:cacheprovider
+
+# Fleet autoscaler (autoscale marker): policy-boundary units (watermark
+# edges, anti-flap projection, cooldown expiry, ENOSPC clamp+backoff),
+# the deterministic simulation harness (ramp idle→max→down, kill-and-
+# replace, restart-idempotency), the 20%-failure chaos soak against a
+# real controller (zero leaked slices / double-provisions), and the
+# load-telemetry + peer-weight-fetch serving seams.  Nominal ~15s; the
+# cap carries the box's 2-3x CPU-quota headroom.  Also runs the oimlint
+# lock-discipline/resource-lifecycle/authz passes over the new package
+# so its thread and registry-write hygiene is analyzer-clean, not
+# grandfathered in baseline.
+test-autoscale:
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,resource-lifecycle,authz-coverage \
+	  --roots oim_tpu/autoscale
+	timeout -k 10 60 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_autoscale.py -q -m "autoscale and not slow" \
 	  -p no:cacheprovider
 
 # oimvet: the multi-pass control-plane static analyzer (tools/oimlint —

@@ -1,0 +1,70 @@
+"""Fleet autoscaler: traffic-driven scale-out/in for the serving plane.
+
+Closes the control↔serve loop (ROADMAP item 3, ISSUE 8): serve
+backends publish live load beside their discovery heartbeat
+(:mod:`~oim_tpu.autoscale.load`), a policy engine turns the fleet's
+utilization into replica-count decisions
+(:mod:`~oim_tpu.autoscale.policy`), and the autoscaler actuates them
+through the same idempotent control-plane RPCs the CSI plane uses
+(:mod:`~oim_tpu.autoscale.actuator`) plus a pluggable process launcher
+(:mod:`~oim_tpu.autoscale.launcher`).  The daemon entry point is
+``oim-autoscale`` (oim_tpu/cli/autoscale_main.py).
+"""
+
+from oim_tpu.autoscale.actuator import (
+    Actuator,
+    ControllerActuator,
+    PoolExhaustedError,
+)
+from oim_tpu.autoscale.autoscaler import (
+    Autoscaler,
+    ReplicaRecord,
+    parse_replica_record_path,
+    replica_record_key,
+)
+from oim_tpu.autoscale.launcher import (
+    InProcessLauncher,
+    Launcher,
+    SubprocessLauncher,
+)
+from oim_tpu.autoscale.load import (
+    LoadPublisher,
+    decode_load,
+    encode_load,
+    load_key,
+    parse_load_path,
+)
+from oim_tpu.autoscale.policy import (
+    SCALE_IN,
+    SCALE_OUT,
+    AutoscalePolicy,
+    Decision,
+    FleetSnapshot,
+    PolicyState,
+    decide,
+)
+
+__all__ = [
+    "Actuator",
+    "ControllerActuator",
+    "PoolExhaustedError",
+    "Autoscaler",
+    "ReplicaRecord",
+    "replica_record_key",
+    "parse_replica_record_path",
+    "Launcher",
+    "InProcessLauncher",
+    "SubprocessLauncher",
+    "LoadPublisher",
+    "load_key",
+    "parse_load_path",
+    "encode_load",
+    "decode_load",
+    "AutoscalePolicy",
+    "FleetSnapshot",
+    "Decision",
+    "PolicyState",
+    "decide",
+    "SCALE_OUT",
+    "SCALE_IN",
+]

@@ -1,0 +1,149 @@
+"""Device-plane actuation for the autoscaler: slices in, slices out.
+
+A replica's accelerator footprint is one *provisioned* allocation named
+after the replica id, provisioned and mapped through the same
+registry-proxied controller RPCs the CSI plane uses — and therefore
+with the same guarantees the autoscaler's crash-safety leans on:
+
+- ``ProvisionSlice`` is idempotent by name (controller.py): an
+  autoscaler that crashed between decision and actuation re-derives the
+  same replica id from registry state on restart and re-issues the
+  call; the second provision finds the first's allocation instead of
+  allocating twice.
+- ``MapVolume`` is volume_id-keyed idempotent behind the controller's
+  placement cache (PR 2), so a retried map returns the original chips.
+- Every hop runs under the shared retry policy + breaker
+  (``csi.backend.RemoteBackend`` carries both), so 20% injected
+  transport failure costs retries, not leaked slices — the chaos soak
+  in tests/test_autoscale.py pins this end-to-end.
+
+``ENOSPC`` from the chip pool surfaces as :class:`PoolExhaustedError`
+after every candidate controller declined; the policy layer answers
+with clamp + backoff, never a crash-loop (ISSUE 8).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Protocol
+
+import grpc
+
+from oim_tpu import log
+from oim_tpu.common import resilience
+
+
+class PoolExhaustedError(RuntimeError):
+    """No candidate controller could place the slice (chip pool full)."""
+
+
+class Actuator(Protocol):
+    def provision(self, replica_id: str, chip_count: int) -> dict:
+        """Provision + map a slice named ``replica_id``; returns the
+        placement (tpu-bootstrap-shaped dict, with the chosen
+        controller id under ``controller``).  Raises
+        :class:`PoolExhaustedError` when the pool cannot hold it."""
+        ...
+
+    def deprovision(self, replica_id: str, controller_id: str) -> None:
+        """Unmap and delete the replica's slice; idempotent."""
+        ...
+
+    def close(self) -> None: ...
+
+
+class ControllerActuator:
+    """Drives real controllers through the registry proxy.
+
+    One ``RemoteBackend`` per candidate controller (lazily dialed,
+    cached — each carries its own breaker so one dead controller fails
+    fast while the others stay usable).  Scale-out walks the candidate
+    list in order and takes the first placement; RESOURCE_EXHAUSTED
+    (the chip pool's ENOSPC) moves to the next candidate, any other
+    error propagates (the caller's retry/backoff owns it).
+    """
+
+    def __init__(
+        self,
+        registry_address: str,
+        controller_ids: list[str],
+        tls_loader=None,
+        retry: resilience.RetryPolicy | None = None,
+    ):
+        if not controller_ids:
+            raise ValueError("need at least one candidate controller id")
+        self.registry_address = registry_address
+        self.controller_ids = list(controller_ids)
+        self.tls_loader = tls_loader
+        self.retry = retry
+        self._lock = threading.Lock()
+        self._backends: dict[str, object] = {}
+
+    def _backend(self, controller_id: str):
+        from oim_tpu.csi.backend import RemoteBackend
+
+        with self._lock:
+            backend = self._backends.get(controller_id)
+            if backend is None:
+                backend = RemoteBackend(
+                    self.registry_address,
+                    controller_id,
+                    tls_loader=self.tls_loader,
+                    retry=self.retry,
+                )
+                self._backends[controller_id] = backend
+        return backend
+
+    def provision(self, replica_id: str, chip_count: int) -> dict:
+        from oim_tpu.csi.backend import VolumeError
+
+        last_enospc: VolumeError | None = None
+        for cid in self.controller_ids:
+            backend = self._backend(cid)
+            try:
+                backend.provision(replica_id, chip_count)
+                # Provisioned-mode map: attach the allocation just
+                # created (idempotent re-attach on retry/restart).
+                staged = backend.create_device(replica_id, {})
+            except VolumeError as exc:
+                if exc.code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    log.current().info(
+                        "controller pool full; trying next candidate",
+                        replica=replica_id,
+                        controller=cid,
+                    )
+                    last_enospc = exc
+                    continue
+                raise
+            placement = staged.bootstrap()
+            placement["controller"] = cid
+            return placement
+        raise PoolExhaustedError(
+            f"no controller could place {chip_count} chips for "
+            f"{replica_id!r}: {last_enospc}"
+        )
+
+    def deprovision(self, replica_id: str, controller_id: str) -> None:
+        from oim_tpu.csi.backend import VolumeError
+
+        backend = self._backend(controller_id)
+        try:
+            backend.destroy_device(replica_id)
+        except VolumeError as exc:
+            # NOT_FOUND = already gone (a retried teardown); anything
+            # else must surface so the replica record is kept and the
+            # next evaluation retries the teardown.
+            if exc.code != grpc.StatusCode.NOT_FOUND:
+                raise
+        try:
+            backend.delete(replica_id)
+        except VolumeError as exc:
+            if exc.code != grpc.StatusCode.NOT_FOUND:
+                raise
+
+    def close(self) -> None:
+        with self._lock:
+            backends = list(self._backends.values())
+            self._backends.clear()
+        for backend in backends:
+            backend.close()

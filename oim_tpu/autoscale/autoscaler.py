@@ -1,0 +1,759 @@
+"""The fleet autoscaler: one registry watch in, slices + replicas out.
+
+Closes the control↔serve loop (ROADMAP item 3): the serving plane
+already *publishes* everything a capacity controller needs — discovery
+keys (``serve/<id>/address``), live load (``load/serve.<id>``,
+autoscale/load.py), eviction marks (``evictions/<vol>``) and chip
+health — and the control plane already *offers* idempotent actuation
+(ProvisionSlice / MapVolume under the shared retry layer).  This module
+is the loop between them, built on the FleetMonitor architecture: ONE
+``db.watch`` subscription mirrors all four keyspaces into memory, a
+periodic evaluation turns the mirror into a :class:`~.policy.Decision`,
+and an actuator/launcher pair applies it.
+
+Crash-safety is registry-mediated, like everything else in this tree:
+
+- Every managed replica has a durable record at
+  ``autoscale/replicas/<rid>`` whose ``state`` walks
+  ``provisioning → up → draining``; a restarted autoscaler re-drives
+  half-done records instead of forgetting them.
+- Replica ids are derived from *observed registry state* (lowest free
+  index), never from an in-memory counter — so a restart between
+  decision and actuation re-picks the same id, and ProvisionSlice's
+  name-keyed idempotency makes the re-issued call find the first
+  call's slice instead of allocating twice (the chaos-soak acceptance
+  in tests/test_autoscale.py).
+
+Replacement is not a band decision: an eviction mark or controller
+death on a managed replica's slice, or the DELETE of an up replica's
+discovery key (process death → lease expiry), triggers replacement at
+the next evaluation regardless of utilization, cooldowns or the
+ENOSPC backoff.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from time import monotonic, time as _wall
+from typing import Callable
+
+from oim_tpu import log
+from oim_tpu.autoscale import policy as policy_mod
+from oim_tpu.autoscale.actuator import Actuator, PoolExhaustedError
+from oim_tpu.autoscale.launcher import Launcher
+from oim_tpu.autoscale.load import decode_load, parse_load_path
+from oim_tpu.common import events, metrics
+from oim_tpu.health import states as health_states
+
+REPLICA_PREFIX = "autoscale/replicas"
+
+PROVISIONING = "provisioning"
+UP = "up"
+DRAINING = "draining"
+
+
+def replica_record_key(replica_id: str) -> str:
+    return f"{REPLICA_PREFIX}/{replica_id}"
+
+
+def parse_replica_record_path(path: str) -> str | None:
+    parts = path.split("/")
+    if len(parts) == 3 and "/".join(parts[:2]) == REPLICA_PREFIX and parts[2]:
+        return parts[2]
+    return None
+
+
+@dataclass
+class ReplicaRecord:
+    """Durable managed-replica state (``autoscale/replicas/<rid>``)."""
+
+    replica_id: str
+    state: str = PROVISIONING
+    chips: int = 1
+    controller: str = ""
+    placement: dict = field(default_factory=dict)
+    ts: float = 0.0
+
+    def encode(self) -> str:
+        return json.dumps(
+            {
+                "state": self.state,
+                "chips": self.chips,
+                "controller": self.controller,
+                "placement": self.placement,
+                "ts": self.ts,
+            },
+            separators=(",", ":"),
+        )
+
+    @classmethod
+    def decode(cls, replica_id: str, value: str) -> "ReplicaRecord | None":
+        try:
+            doc = json.loads(value)
+        except ValueError:
+            return None
+        if not isinstance(doc, dict) or doc.get("state") not in (
+            PROVISIONING,
+            UP,
+            DRAINING,
+        ):
+            return None
+        return cls(
+            replica_id=replica_id,
+            state=doc["state"],
+            chips=int(doc.get("chips", 1)),
+            controller=str(doc.get("controller", "")),
+            placement=doc.get("placement") or {},
+            ts=float(doc.get("ts", 0.0)),
+        )
+
+
+class Autoscaler:
+    """Watch → policy → actuate.  ``start()`` subscribes before the
+    snapshot (the WatchValues reconcile discipline), re-drives
+    half-done replica records, and runs the evaluation loop on a
+    background thread; tests drive :meth:`evaluate_once` directly with
+    an injected ``clock`` instead.
+    """
+
+    def __init__(
+        self,
+        db,
+        policy: policy_mod.AutoscalePolicy,
+        actuator: Actuator,
+        launcher: Launcher,
+        *,
+        replica_prefix: str = "asr-",
+        clock: Callable[[], float] = monotonic,
+        wall: Callable[[], float] = _wall,
+        monitor=None,
+    ):
+        self.db = db
+        self.policy = policy
+        self.actuator = actuator
+        self.launcher = launcher
+        self.replica_prefix = replica_prefix
+        self.clock = clock
+        self.wall = wall
+        self._state = policy_mod.PolicyState(policy)
+        # One lock over all mirrors: watch callbacks (registry threads),
+        # monitor listeners, and the evaluation thread all touch them.
+        # Actuation (RPCs, launcher) ALWAYS runs outside it.  RLock for
+        # the FleetMonitor reason: our own db.store calls re-dispatch
+        # watch events on this thread.
+        self._lock = threading.RLock()
+        self._serve: dict[str, str] = {}  # sid → advertised url
+        self._load: dict[str, dict] = {}  # cn → decoded load snapshot
+        self._replicas: dict[str, ReplicaRecord] = {}
+        # Volume ids with live eviction marks: never reused for a fresh
+        # replica (the CSI plane refuses evicted volumes; the mark is
+        # the operator's post-mortem record).
+        self._evicted_ids: set[str] = set()
+        # Backends whose fleet-view gauge series we currently export —
+        # a departed backend's series is removed, not left exporting
+        # its last pressure forever (the FleetMonitor gauge pattern).
+        self._gauged: set[str] = set()
+        self._need_replace: dict[str, str] = {}  # rid → reason
+        self._cancel_watch: Callable[[], None] | None = None
+        self._remove_listener: Callable[[], None] | None = None
+        self._monitor = monitor
+        self._cond = threading.Condition()
+        self._wake = False
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        self._m_desired = metrics.AUTOSCALE_DESIRED
+        self._m_actions = metrics.AUTOSCALE_ACTIONS
+        self._m_queue = metrics.SERVE_QUEUE_DEPTH
+        self._m_active = metrics.SERVE_ACTIVE_SLOTS
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, run_loop: bool = True) -> "Autoscaler":
+        if self._cancel_watch is not None:
+            return self
+        # Subscribe BEFORE the snapshot so no event between the two is
+        # lost; handlers are idempotent so duplicates are harmless.
+        self._cancel_watch = self.db.watch("", self._on_event)
+        for path, value in self.db.items(""):
+            self._on_event(path, value)
+        # A record left "up" by a previous incarnation whose discovery
+        # key is already gone will get no DELETE event now — mark it
+        # for replacement from the snapshot delta.
+        with self._lock:
+            for rid, record in self._replicas.items():
+                if record.state == UP and rid not in self._serve:
+                    self._need_replace.setdefault(rid, "missing-after-restart")
+        if self._monitor is not None:
+            self.attach_monitor(self._monitor)
+        if run_loop:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="oim-autoscale-eval"
+            )
+            self._thread.start()
+        return self
+
+    def attach_monitor(self, monitor) -> None:
+        """Subscribe to FleetMonitor's classification directly (same
+        process) instead of re-deriving it from raw watch events —
+        eviction-driven replacement then rides the monitor's grace
+        timers and spoof checks for free."""
+        if self._remove_listener is not None:
+            return
+        self._remove_listener = monitor.add_listener(
+            on_eviction=self._on_monitor_eviction,
+            on_controller_dead=self._on_monitor_controller_dead,
+        )
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        if self._remove_listener is not None:
+            self._remove_listener()
+            self._remove_listener = None
+        if self._cancel_watch is not None:
+            self._cancel_watch()
+            self._cancel_watch = None
+
+    # -- observation (watch + monitor threads) -----------------------------
+
+    def _on_event(self, path: str, value: str) -> None:
+        """Classify one registry mutation; never raises (runs inside
+        the DB's watch dispatch — the FleetMonitor rule)."""
+        try:
+            self._classify(path, value)
+        except Exception as exc:
+            log.current().error(
+                "autoscaler event failed", path=path, error=str(exc)
+            )
+
+    def _classify(self, path: str, value: str) -> None:
+        parts = path.split("/")
+        if len(parts) == 3 and parts[0] == "serve" and parts[2] == "address":
+            self._on_serve(parts[1], value)
+            return
+        cn = parse_load_path(path)
+        if cn is not None:
+            with self._lock:
+                if value == "":
+                    self._load.pop(cn, None)
+                else:
+                    decoded = decode_load(value)
+                    if decoded is not None:
+                        self._load[cn] = decoded
+            return
+        volume = health_states.parse_eviction_path(path)
+        if volume is not None:
+            if value != "":
+                with self._lock:
+                    self._evicted_ids.add(volume)
+                self._on_evicted(volume, "evicted")
+            else:
+                with self._lock:
+                    self._evicted_ids.discard(volume)
+            return
+        rid = parse_replica_record_path(path)
+        if rid is not None:
+            with self._lock:
+                if value == "":
+                    self._replicas.pop(rid, None)
+                else:
+                    record = ReplicaRecord.decode(rid, value)
+                    if record is not None:
+                        self._replicas[rid] = record
+
+    def _on_serve(self, sid: str, value: str) -> None:
+        wake = False
+        with self._lock:
+            if value == "":
+                self._serve.pop(sid, None)
+                record = self._replicas.get(sid)
+                if record is not None and record.state == UP:
+                    # An up replica's discovery key vanished (process
+                    # death → lease expiry, or active withdrawal we did
+                    # not initiate): replace it.  Draining replicas lose
+                    # their key BY DESIGN (scale-in withdraws first).
+                    self._need_replace.setdefault(sid, "discovery-lost")
+                    wake = True
+            else:
+                self._serve[sid] = value.rstrip("/")
+        if wake:
+            self._notify()
+
+    def _on_evicted(self, volume: str, reason: str) -> None:
+        wake = False
+        with self._lock:
+            record = self._replicas.get(volume)
+            if record is not None and record.state != DRAINING:
+                # Eviction invalidates the SLICE: relaunching on it
+                # would hand the replica dead chips, so the replacement
+                # must tear down and re-provision fresh.
+                self._need_replace[volume] = reason
+                wake = True
+        if wake:
+            self._notify()
+
+    def _on_monitor_eviction(
+        self, volume: str, controller_id: str, reason: str
+    ) -> None:
+        self._on_evicted(volume, f"evicted:{reason}")
+
+    def _on_monitor_controller_dead(self, controller_id: str) -> None:
+        wake = False
+        with self._lock:
+            for rid, record in self._replicas.items():
+                if (
+                    record.controller == controller_id
+                    and record.state != DRAINING
+                ):
+                    self._need_replace[rid] = "controller-dead"
+                    wake = True
+        if wake:
+            self._notify()
+
+    def _notify(self) -> None:
+        with self._cond:
+            self._wake = True
+            self._cond.notify()
+
+    # -- evaluation --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                if not self._wake:
+                    self._cond.wait(timeout=self.policy.eval_period_s)
+                if self._stop:
+                    return
+                self._wake = False
+            try:
+                self.evaluate_once()
+            except Exception as exc:
+                # The loop must survive anything an evaluation throws —
+                # a dead evaluator is a fleet frozen at its last size.
+                log.current().error(
+                    "autoscale evaluation failed", error=str(exc)
+                )
+
+    def fleet_snapshot(self) -> policy_mod.FleetSnapshot:
+        """Assemble the policy inputs from the watch mirror.  A backend
+        with no (fresh) load key contributes default capacity and zero
+        busy — booting replicas dilute utilization, they never spike
+        it."""
+        now_wall = self.wall()
+        with self._lock:
+            live = set(self._serve)
+            for rid, record in self._replicas.items():
+                if record.state in (PROVISIONING, UP):
+                    live.add(rid)
+                elif record.state == DRAINING:
+                    live.discard(rid)
+            busy = 0.0
+            capacity = 0.0
+            gauged: set[str] = set()
+            for sid in live:
+                snap = self._load.get(f"serve.{sid}")
+                if snap is not None and self.policy.stale_load_s > 0:
+                    if now_wall - snap["ts"] > self.policy.stale_load_s:
+                        snap = None
+                if snap is None or snap["total_slots"] <= 0:
+                    capacity += self.policy.slots_per_replica
+                    continue
+                busy += snap["queue_depth"] + snap["active_slots"]
+                capacity += snap["total_slots"]
+                self._m_queue.set(float(snap["queue_depth"]), sid)
+                self._m_active.set(float(snap["active_slots"]), sid)
+                gauged.add(sid)
+            # Departed backends stop exporting: a scaled-in replica's
+            # last queue depth must not read as live fleet pressure.
+            for sid in self._gauged - gauged:
+                self._m_queue.remove(sid)
+                self._m_active.remove(sid)
+            self._gauged = gauged
+        return policy_mod.FleetSnapshot(
+            replicas=len(live), busy=busy, capacity=capacity
+        )
+
+    def evaluate_once(self) -> policy_mod.Decision:
+        """One full control-loop turn: replacements first (band- and
+        cooldown-independent), then re-drive half-done records, then
+        the band decision.  Returns the band decision (tests assert on
+        it)."""
+        self._replace_pending()
+        self._redrive_records()
+        snapshot = self.fleet_snapshot()
+        decision = policy_mod.decide(self.policy, snapshot)
+        now = self.clock()
+        desired = snapshot.replicas
+        if decision.direction == policy_mod.SCALE_OUT:
+            desired = snapshot.replicas + decision.count
+            if self._state.enospc_blocks(now):
+                log.current().debug("scale-out held: ENOSPC backoff")
+            elif self._state.cooldown_blocks(policy_mod.SCALE_OUT, now):
+                log.current().debug("scale-out held: cooldown")
+            else:
+                self._scale_out(decision)
+        elif decision.direction == policy_mod.SCALE_IN:
+            desired = snapshot.replicas - decision.count
+            if self._state.cooldown_blocks(policy_mod.SCALE_IN, now):
+                log.current().debug("scale-in held: cooldown")
+            else:
+                self._scale_in(decision)
+        self._m_desired.set(float(desired))
+        return decision
+
+    # -- actuation helpers (never called under self._lock) ------------------
+
+    def _store_record(self, record: ReplicaRecord) -> None:
+        record.ts = self.wall()
+        with self._lock:
+            self._replicas[record.replica_id] = record
+        self.db.store(
+            replica_record_key(record.replica_id), record.encode()
+        )
+
+    def _drop_record(self, replica_id: str) -> None:
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+            self._need_replace.pop(replica_id, None)
+        self.db.store(replica_record_key(replica_id), "")
+
+    def _next_replica_id(self) -> str:
+        """Lowest free index over BOTH the replica records and the
+        discovery table — derived from observed registry state so a
+        restarted autoscaler re-picks the id a crashed incarnation was
+        about to provision (ProvisionSlice then finds the existing
+        slice: exactly one allocation)."""
+        with self._lock:
+            taken = set(self._replicas) | set(self._serve) | self._evicted_ids
+        k = 0
+        while f"{self.replica_prefix}{k}" in taken:
+            k += 1
+        return f"{self.replica_prefix}{k}"
+
+    def _provision_and_launch(self, record: ReplicaRecord) -> bool:
+        """Drive one replica from its record to UP; returns False on
+        pool exhaustion (the caller clamps + backs off)."""
+        rid = record.replica_id
+        placement = self.actuator.provision(rid, record.chips)
+        record.controller = placement.get("controller", record.controller)
+        record.placement = placement
+        self.launcher.launch(rid, placement)
+        record.state = UP
+        self._store_record(record)
+        return True
+
+    def _scale_out(self, decision: policy_mod.Decision) -> None:
+        launched = 0
+        for _ in range(decision.count):
+            rid = self._next_replica_id()
+            record = ReplicaRecord(
+                replica_id=rid,
+                state=PROVISIONING,
+                chips=self.policy.chips_per_replica,
+            )
+            self._store_record(record)
+            try:
+                self._provision_and_launch(record)
+            except PoolExhaustedError as exc:
+                self._clamped(rid, decision, str(exc))
+                self._drop_record(rid)
+                return
+            except Exception as exc:
+                # Transient actuation failure: the PROVISIONING record
+                # stays and the next evaluation re-drives it (all the
+                # RPCs behind it are idempotent).
+                self._m_actions.inc(policy_mod.SCALE_OUT, "failed")
+                log.current().warning(
+                    "scale-out actuation failed; will re-drive",
+                    replica=rid,
+                    error=str(exc),
+                )
+                return
+            launched += 1
+            self._m_actions.inc(policy_mod.SCALE_OUT, "ok")
+            events.emit(
+                "autoscale.scale_out",
+                component="oim-autoscale",
+                subject=rid,
+                utilization=round(decision.utilization, 3),
+                reason=decision.reason,
+            )
+            log.current().info(
+                "scaled out", replica=rid, reason=decision.reason
+            )
+        if launched:
+            self._state.note_action(policy_mod.SCALE_OUT, self.clock())
+
+    def _clamped(
+        self, rid: str, decision: policy_mod.Decision, error: str
+    ) -> None:
+        """ENOSPC: clamp desire to what the pool holds and back off —
+        a full pool is re-probed after enospc_backoff_s, not hammered
+        every evaluation (and never crash-looped on)."""
+        self._state.note_enospc(self.clock())
+        self._m_actions.inc(policy_mod.SCALE_OUT, "clamped")
+        events.emit(
+            "autoscale.clamped",
+            component="oim-autoscale",
+            severity=events.WARNING,
+            subject=rid,
+            utilization=round(decision.utilization, 3),
+            backoff_s=self.policy.enospc_backoff_s,
+            error=error,
+        )
+        log.current().warning(
+            "scale-out clamped: chip pool exhausted",
+            replica=rid,
+            backoff_s=self.policy.enospc_backoff_s,
+            error=error,
+        )
+
+    def _least_loaded(self, count: int) -> list[ReplicaRecord]:
+        with self._lock:
+            candidates = [
+                r
+                for r in self._replicas.values()
+                if r.state == UP and r.replica_id not in self._need_replace
+            ]
+            loads = {
+                r.replica_id: self._load.get(f"serve.{r.replica_id}")
+                for r in candidates
+            }
+        def busy(record: ReplicaRecord) -> float:
+            snap = loads.get(record.replica_id)
+            if snap is None:
+                return 0.0
+            return float(snap["queue_depth"] + snap["active_slots"])
+
+        candidates.sort(key=lambda r: (busy(r), r.replica_id))
+        return candidates[:count]
+
+    def _scale_in(self, decision: policy_mod.Decision) -> None:
+        victims = self._least_loaded(decision.count)
+        if not victims:
+            log.current().info(
+                "scale-in wanted but no managed replica to remove "
+                "(static backends are never scaled in)"
+            )
+            return
+        removed = 0
+        for record in victims:
+            try:
+                self._retire(record)
+            except Exception as exc:
+                # Keep the DRAINING record: the next evaluation's
+                # re-drive finishes the teardown (idempotent hops).
+                self._m_actions.inc(policy_mod.SCALE_IN, "failed")
+                log.current().warning(
+                    "scale-in teardown failed; will re-drive",
+                    replica=record.replica_id,
+                    error=str(exc),
+                )
+                continue
+            removed += 1
+            self._m_actions.inc(policy_mod.SCALE_IN, "ok")
+            events.emit(
+                "autoscale.scale_in",
+                component="oim-autoscale",
+                subject=record.replica_id,
+                utilization=round(decision.utilization, 3),
+                reason=decision.reason,
+            )
+            log.current().info(
+                "scaled in", replica=record.replica_id, reason=decision.reason
+            )
+        if removed:
+            self._state.note_action(policy_mod.SCALE_IN, self.clock())
+
+    def _retire(self, record: ReplicaRecord) -> None:
+        """The scale-in drain sequence (doc/serving.md): (1) mark the
+        record DRAINING so the discovery DELETE below is not read as a
+        death, (2) withdraw the discovery key — routers stop sending
+        within one watch event, (3) drain + stop the process — in-
+        flight requests finish, (4) unmap + delete the slice, (5) drop
+        the record."""
+        rid = record.replica_id
+        record.state = DRAINING
+        self._store_record(record)
+        self.db.store(f"serve/{rid}/address", "")
+        self.launcher.stop(rid, drain=True)
+        # Withdraw AGAIN after the stop: the victim's own heartbeat may
+        # have re-published the key in the window between the first
+        # withdraw and its SIGTERM handler (oim-serve's graceful path
+        # deregisters itself, but a launcher without that courtesy — or
+        # a beat racing the signal — must not leave a zombie key to age
+        # out on its lease).  Idempotent: deleting an absent key is a
+        # no-op.
+        self.db.store(f"serve/{rid}/address", "")
+        if record.controller:
+            self.actuator.deprovision(rid, record.controller)
+        self._drop_record(rid)
+
+    def _redrive_records(self) -> None:
+        """Finish what a crashed (or transiently failed) incarnation
+        started: PROVISIONING records re-run the provision+launch path,
+        DRAINING records re-run the teardown — both end-to-end
+        idempotent."""
+        with self._lock:
+            pending = [
+                ReplicaRecord(**vars(r))
+                for r in self._replicas.values()
+                if r.state in (PROVISIONING, DRAINING)
+            ]
+        for record in pending:
+            try:
+                if record.state == PROVISIONING:
+                    self._provision_and_launch(record)
+                else:
+                    self._retire(record)
+            except PoolExhaustedError as exc:
+                self._state.note_enospc(self.clock())
+                log.current().warning(
+                    "re-drive held: chip pool exhausted",
+                    replica=record.replica_id,
+                    error=str(exc),
+                )
+            except Exception as exc:
+                log.current().warning(
+                    "replica re-drive failed; will retry",
+                    replica=record.replica_id,
+                    state=record.state,
+                    error=str(exc),
+                )
+
+    def _replace_pending(self) -> None:
+        """Replace dead/evicted replicas — independent of the band,
+        cooldowns and the ENOSPC backoff (capacity the fleet already
+        had is restored, not grown)."""
+        with self._lock:
+            pending = {
+                rid: reason
+                for rid, reason in self._need_replace.items()
+                if rid in self._replicas
+            }
+            # Entries whose record vanished (raced teardown) are stale.
+            for rid in list(self._need_replace):
+                if rid not in pending:
+                    del self._need_replace[rid]
+        for rid, reason in pending.items():
+            with self._lock:
+                record = self._replicas.get(rid)
+            if record is None:
+                continue
+            try:
+                if reason.startswith("evicted") or reason == "controller-dead":
+                    self._replace_on_fresh_slice(record, reason)
+                else:
+                    self._relaunch(record, reason)
+            except PoolExhaustedError as exc:
+                self._state.note_enospc(self.clock())
+                self._m_actions.inc("replace", "clamped")
+                log.current().warning(
+                    "replacement held: chip pool exhausted",
+                    replica=rid,
+                    error=str(exc),
+                )
+            except Exception as exc:
+                self._m_actions.inc("replace", "failed")
+                log.current().warning(
+                    "replacement failed; will retry",
+                    replica=rid,
+                    reason=reason,
+                    error=str(exc),
+                )
+
+    def _relaunch(self, record: ReplicaRecord, reason: str) -> None:
+        """The process died but its slice is healthy: restart on the
+        recorded placement (no control-plane round trip at all)."""
+        rid = record.replica_id
+        self.launcher.stop(rid, drain=False)  # clear any remnant
+        self.launcher.launch(rid, record.placement)
+        with self._lock:
+            self._need_replace.pop(rid, None)
+        self._m_actions.inc("replace", "ok")
+        events.emit(
+            "autoscale.replace",
+            component="oim-autoscale",
+            severity=events.WARNING,
+            subject=rid,
+            reason=reason,
+            fresh_slice=False,
+        )
+        log.current().warning("replica relaunched", replica=rid, reason=reason)
+
+    def _replace_on_fresh_slice(
+        self, record: ReplicaRecord, reason: str
+    ) -> None:
+        """The slice itself is bad (chip failure / dead controller):
+        tear the old replica down best-effort and bring capacity back
+        on a NEW replica id — the evicted volume id stays retired (the
+        CSI plane refuses evicted volumes by design, and the eviction
+        mark remains for the operator's post-mortem)."""
+        rid = record.replica_id
+        with self._lock:
+            # Retire the id even WITHOUT an eviction mark (controller
+            # death leaves none): a dead controller may still hold an
+            # allocation under this name, and re-using it would alias
+            # two slices to one volume id when the controller recovers.
+            self._evicted_ids.add(rid)
+        self.launcher.stop(rid, drain=False)
+        if record.controller:
+            try:
+                self.actuator.deprovision(rid, record.controller)
+            except Exception as exc:
+                # A dead controller cannot tear down its own slice; the
+                # eviction mark + operator remap own that cleanup.
+                log.current().warning(
+                    "deprovision of evicted replica failed",
+                    replica=rid,
+                    controller=record.controller,
+                    error=str(exc),
+                )
+        self._drop_record(rid)
+        fresh = ReplicaRecord(
+            replica_id=self._next_replica_id(),
+            state=PROVISIONING,
+            chips=record.chips or self.policy.chips_per_replica,
+        )
+        self._store_record(fresh)
+        self._provision_and_launch(fresh)
+        self._m_actions.inc("replace", "ok")
+        events.emit(
+            "autoscale.replace",
+            component="oim-autoscale",
+            severity=events.WARNING,
+            subject=rid,
+            replacement=fresh.replica_id,
+            reason=reason,
+            fresh_slice=True,
+        )
+        log.current().warning(
+            "replica replaced on a fresh slice",
+            replica=rid,
+            replacement=fresh.replica_id,
+            reason=reason,
+        )
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "backends": dict(self._serve),
+                "replicas": {
+                    rid: {
+                        "state": r.state,
+                        "chips": r.chips,
+                        "controller": r.controller,
+                    }
+                    for rid, r in self._replicas.items()
+                },
+                "pending_replacements": dict(self._need_replace),
+                "load": {cn: dict(s) for cn, s in self._load.items()},
+            }

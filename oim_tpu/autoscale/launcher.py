@@ -1,0 +1,231 @@
+"""Replica bring-up/teardown seam for the autoscaler.
+
+The actuator (actuator.py) owns the *device* side of a replica — the
+provisioned slice and its mapping; a :class:`Launcher` owns the
+*process* side — starting an oim-serve instance on that placement and
+stopping it again.  Keeping the seam this narrow is what makes the
+simulation harness deterministic: tests plug a fake that flips registry
+keys, deployments plug :class:`SubprocessLauncher` which execs the real
+binary, and embedders plug :class:`InProcessLauncher` with a factory.
+
+The launcher does NOT register the replica: a launched backend
+announces itself (`oim-serve --serve-id`), exactly like an
+operator-started one — the autoscaler observes its arrival through the
+same ``serve/`` watch as the router, so a replica's lifecycle looks
+identical regardless of who started it.
+
+``stop(drain=True)`` is the scale-in path: the launcher must let
+in-flight requests finish (SIGTERM → oim-serve's graceful drain; an
+in-process server's ``engine.drain()`` + bounded wait).  ``drain=False``
+is the replacement path for a replica already presumed dead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Callable, Protocol
+
+from oim_tpu import log
+
+
+class Launcher(Protocol):
+    def launch(self, replica_id: str, placement: dict) -> None:
+        """Bring up a serving backend for ``replica_id`` on
+        ``placement`` (a tpu-bootstrap-shaped dict from the actuator).
+        Idempotent per id: launching an id that is already up restarts
+        it."""
+        ...
+
+    def stop(self, replica_id: str, drain: bool = True) -> None:
+        """Tear the backend down; idempotent (unknown ids no-op)."""
+        ...
+
+    def close(self) -> None:
+        """Stop everything this launcher started (daemon shutdown)."""
+        ...
+
+
+class InProcessLauncher:
+    """Factory-driven launcher for tests, demos and embedders: the
+    factory returns a handle; ``stop`` calls ``handle.stop()`` (and
+    ``handle.drain()`` first when asked and available)."""
+
+    def __init__(self, factory: Callable[[str, dict], object]):
+        self._factory = factory
+        self._lock = threading.Lock()
+        self._handles: dict[str, object] = {}
+
+    def launch(self, replica_id: str, placement: dict) -> None:
+        self.stop(replica_id, drain=False)
+        handle = self._factory(replica_id, placement)
+        with self._lock:
+            self._handles[replica_id] = handle
+
+    def stop(self, replica_id: str, drain: bool = True) -> None:
+        with self._lock:
+            handle = self._handles.pop(replica_id, None)
+        if handle is None:
+            return
+        if drain and hasattr(handle, "drain"):
+            try:
+                handle.drain()
+            except Exception as exc:
+                log.current().warning(
+                    "replica drain failed", replica=replica_id, error=str(exc)
+                )
+        if hasattr(handle, "stop"):
+            handle.stop()
+
+    def close(self) -> None:
+        with self._lock:
+            ids = list(self._handles)
+        for rid in ids:
+            self.stop(rid, drain=False)
+
+
+class SubprocessLauncher:
+    """Launches each replica as an oim-serve subprocess.
+
+    ``argv_template`` is the command line with ``{id}`` substituted per
+    replica (e.g. ``["python", "-m", "oim_tpu.cli.serve_main",
+    "--serve-id", "{id}", "--registry-address", "tcp://...", ...]``).
+    The placement is written to ``<state_dir>/<id>/tpu-bootstrap.json``
+    and exported as ``TPU_BOOTSTRAP`` — the same chip-binding contract
+    the CSI plane hands pods (doc/compute.md).
+
+    ``stop(drain=True)`` sends SIGTERM and waits ``drain_timeout_s``
+    (oim-serve's own --drain-timeout bounds the inner wait), then
+    escalates to SIGKILL — a wedged replica must not wedge the
+    autoscaler's scale-in.
+    """
+
+    def __init__(
+        self,
+        argv_template: list[str],
+        state_dir: str,
+        env: dict | None = None,
+        drain_timeout_s: float = 150.0,
+    ):
+        if not argv_template:
+            raise ValueError("argv_template must not be empty")
+        self.argv_template = list(argv_template)
+        self.state_dir = state_dir
+        self.env = dict(env) if env else {}
+        self.drain_timeout_s = drain_timeout_s
+        self._lock = threading.Lock()
+        self._procs: dict[str, subprocess.Popen] = {}
+
+    def _argv(self, replica_id: str) -> list[str]:
+        return [arg.format(id=replica_id) for arg in self.argv_template]
+
+    def _pidfile(self, replica_id: str) -> str:
+        return os.path.join(self.state_dir, replica_id, "pid")
+
+    def launch(self, replica_id: str, placement: dict) -> None:
+        self.stop(replica_id, drain=False)
+        replica_dir = os.path.join(self.state_dir, replica_id)
+        os.makedirs(replica_dir, exist_ok=True)
+        bootstrap = os.path.join(replica_dir, "tpu-bootstrap.json")
+        with open(bootstrap, "w") as fh:
+            json.dump(placement, fh)
+        env = dict(os.environ)
+        env.update(self.env)
+        env["TPU_BOOTSTRAP"] = bootstrap
+        proc = subprocess.Popen(self._argv(replica_id), env=env)
+        with self._lock:
+            self._procs[replica_id] = proc
+        # Durable pid: replicas deliberately OUTLIVE the autoscaler
+        # daemon (its shutdown must not be a fleet outage), so a
+        # RESTARTED daemon holds no Popen handle for them — the pidfile
+        # is how its scale-in still reaches the orphaned process.
+        with open(self._pidfile(replica_id), "w") as fh:
+            fh.write(str(proc.pid))
+        log.current().info(
+            "replica launched", replica=replica_id, pid=proc.pid
+        )
+
+    def _orphan_pid(self, replica_id: str) -> int | None:
+        try:
+            with open(self._pidfile(replica_id)) as fh:
+                pid = int(fh.read().strip())
+        except (OSError, ValueError):
+            return None
+        try:
+            os.kill(pid, 0)
+        except (ProcessLookupError, PermissionError):
+            return None
+        return pid
+
+    def _drop_pidfile(self, replica_id: str) -> None:
+        try:
+            os.unlink(self._pidfile(replica_id))
+        except OSError:
+            pass
+
+    def stop(self, replica_id: str, drain: bool = True) -> None:
+        with self._lock:
+            proc = self._procs.pop(replica_id, None)
+        if proc is None or proc.poll() is not None:
+            self._stop_orphan(replica_id, drain)
+            return
+        try:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=self.drain_timeout_s if drain else 5.0)
+        except subprocess.TimeoutExpired:
+            log.current().warning(
+                "replica did not exit on SIGTERM; killing",
+                replica=replica_id,
+                pid=proc.pid,
+            )
+            proc.kill()
+            proc.wait(timeout=10.0)
+        except ProcessLookupError:
+            pass
+        self._drop_pidfile(replica_id)
+        log.current().info("replica stopped", replica=replica_id)
+
+    def _stop_orphan(self, replica_id: str, drain: bool) -> None:
+        """Stop a replica launched by a PREVIOUS daemon incarnation
+        (known only through its pidfile)."""
+        pid = self._orphan_pid(replica_id)
+        if pid is None:
+            self._drop_pidfile(replica_id)
+            return
+        try:
+            os.kill(pid, signal.SIGTERM)
+            deadline = time.monotonic() + (
+                self.drain_timeout_s if drain else 5.0
+            )
+            while time.monotonic() < deadline:
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    break
+                time.sleep(0.2)
+            else:
+                log.current().warning(
+                    "orphan replica did not exit on SIGTERM; killing",
+                    replica=replica_id,
+                    pid=pid,
+                )
+                os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        self._drop_pidfile(replica_id)
+        log.current().info(
+            "orphan replica stopped", replica=replica_id, pid=pid
+        )
+
+    def close(self) -> None:
+        """Release handles WITHOUT stopping the replicas: a graceful
+        autoscaler shutdown must not be a fleet outage.  The replicas
+        keep serving; the restarted daemon converges from the durable
+        records and reaches them through their pidfiles.  Stopping the
+        fleet is scale-in's job (or the operator's, explicitly)."""
+        with self._lock:
+            self._procs.clear()

@@ -1,0 +1,140 @@
+"""Serving-load telemetry: the ``load/<cn>`` registry keyspace.
+
+The autoscaler's observation plane.  Each serving instance publishes ONE
+leased key, ``load/<its full TLS CommonName>`` (``load/serve.<id>`` for
+oim-serve), beside its ``serve/<id>/address`` discovery heartbeat.  The
+value is a compact JSON snapshot of the engine's live pressure — queue
+depth, busy/total slots, the marginal token-rate EWMA, shed counters,
+the brownout flag — exactly the fields ``GET /v1/info`` mirrors under
+``load`` for the router.  The lease (3x the heartbeat period, like the
+address key) means a crashed instance's stale load expires with a watch
+event instead of pinning the fleet's utilization estimate forever.
+
+Authorization follows the flight-recorder precedent (``events/{cn}/*``):
+any authenticated peer may write exactly its own ``load/<cn>`` key —
+one compromised backend can lie about its *own* pressure but cannot
+forge a sibling's (registry/authz.py ``AUTHZ_GRANTS``).
+
+Schema discipline matches health/states.py: ``decode_load`` never
+raises on malformed or foreign values — a watcher must not die on one
+bad key — and fills defaults so consumers index fields unconditionally.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+LOAD_PREFIX = "load"
+
+# Fields every decoded snapshot carries (and their defaults): consumers
+# (the autoscaler's utilization math, the router's /v1/stats) index
+# these unconditionally.
+_DEFAULTS: dict[str, Any] = {
+    "queue_depth": 0,
+    "active_slots": 0,
+    "total_slots": 0,
+    "token_rate": 0.0,
+    "shed_queue_full": 0,
+    "shed_deadline": 0,
+    "shed_brownout": 0,
+    "brownout": False,
+    "ts": 0.0,
+}
+
+
+def load_key(cn: str) -> str:
+    return f"{LOAD_PREFIX}/{cn}"
+
+
+def parse_load_path(path: str) -> str | None:
+    """``load/<cn>`` → cn, else None."""
+    parts = path.split("/")
+    if len(parts) == 2 and parts[0] == LOAD_PREFIX and parts[1]:
+        return parts[1]
+    return None
+
+
+def encode_load(snapshot: dict) -> str:
+    out = dict(_DEFAULTS)
+    out.update({k: snapshot[k] for k in _DEFAULTS if k in snapshot})
+    return json.dumps(out, separators=(",", ":"))
+
+
+def decode_load(value: str) -> dict[str, Any] | None:
+    """Parse a load value; None for malformed/foreign values."""
+    try:
+        doc = json.loads(value)
+    except ValueError:
+        return None
+    if not isinstance(doc, dict):
+        return None
+    out = dict(_DEFAULTS)
+    for key, default in _DEFAULTS.items():
+        val = doc.get(key, default)
+        if not isinstance(val, type(default)) and not (
+            isinstance(default, float) and isinstance(val, (int, float))
+        ):
+            return None
+        out[key] = val
+    return out
+
+
+class LoadPublisher:
+    """Publishes one identity's ``load/<cn>`` key over per-operation
+    registry connections (the heartbeat dialing discipline,
+    common/regdial.py).  ``cn`` is the publisher's full CommonName —
+    what its client cert carries under mTLS, and the one path segment
+    the authz grant lets it write."""
+
+    def __init__(
+        self,
+        cn: str,
+        registry_address: str,
+        tls=None,
+        ttl_seconds: float = 180.0,
+    ):
+        if not cn or "/" in cn:
+            raise ValueError(f"invalid load publisher CN {cn!r}")
+        self.cn = cn
+        self.registry_address = registry_address
+        self.tls = tls
+        self.ttl_seconds = ttl_seconds
+
+    def publish(self, snapshot: dict, timeout: float = 5.0) -> None:
+        """One leased SetValue of the snapshot.  Single attempt by
+        design: the caller is a heartbeat loop that already survives
+        (and logs) failures, and a missed load beat just ages the key
+        toward its 3-beat lease."""
+        from oim_tpu.common.regdial import registry_channel
+        from oim_tpu.spec import REGISTRY, oim_pb2
+
+        with registry_channel(self.registry_address, self.tls) as channel:
+            REGISTRY.stub(channel).SetValue(
+                oim_pb2.SetValueRequest(
+                    value=oim_pb2.Value(
+                        path=load_key(self.cn),
+                        value=encode_load(snapshot),
+                    ),
+                    ttl_seconds=max(1, int(self.ttl_seconds)),
+                ),
+                timeout=timeout,
+            )
+
+    def withdraw(self, timeout: float = 5.0) -> None:
+        """Best-effort immediate delete (graceful shutdown): the
+        autoscaler drops this instance from its utilization estimate at
+        the watch DELETE instead of at lease expiry."""
+        from oim_tpu.common.regdial import registry_channel
+        from oim_tpu.spec import REGISTRY, oim_pb2
+
+        try:
+            with registry_channel(self.registry_address, self.tls) as channel:
+                REGISTRY.stub(channel).SetValue(
+                    oim_pb2.SetValueRequest(
+                        value=oim_pb2.Value(path=load_key(self.cn), value="")
+                    ),
+                    timeout=timeout,
+                )
+        except Exception:
+            pass  # the lease expires the key anyway

@@ -1,0 +1,209 @@
+"""Autoscaling policy: utilization band → replica-count decisions.
+
+Pure decision logic, deliberately separated from the watch/actuation
+machinery (autoscaler.py) the way EvictionPolicy is separate from the
+FleetMonitor: every boundary condition here — watermark edges, the
+anti-flap projection, min/max clamps, step bounds, cooldown expiry —
+is a unit-testable function of explicit inputs, never of wall time.
+
+The control law:
+
+- **Utilization** is fleet busy work over fleet slot capacity, where
+  busy counts queued requests as well as decoding slots (a deep queue
+  on a full fleet must read as >1.0, not saturate at 1.0).
+- **Band with hysteresis**: scale OUT only when utilization exceeds
+  ``high_watermark`` *strictly*; scale IN only when it is *strictly*
+  below ``low_watermark``.  Load sitting exactly on a watermark takes
+  no action — the flap tests pin this.
+- **Anti-flap projection**: a scale-in is only allowed if the fleet's
+  utilization *after* removing the replicas stays strictly below the
+  high watermark; otherwise the very next evaluation would scale back
+  out.  Under load oscillating at the band edge this is what makes
+  ramp-down converge instead of ringing.
+- **Cooldowns** are per-direction and live in :class:`PolicyState`
+  (the only time-dependent piece, fed an explicit ``now`` from the
+  autoscaler's injectable clock).  An action is allowed again once
+  ``now - last >= cooldown`` — the expiry instant itself is allowed.
+- **ENOSPC backoff**: a chip-pool-exhausted scale-out clamps desire
+  and blocks further scale-OUT attempts for ``enospc_backoff_s`` so a
+  full pool is probed, not hammered (the circuit-breaker stance
+  applied to capacity).  Scale-in and replacement stay allowed.
+
+Replacement of a dead/evicted replica is *not* a band decision and
+does not pass through here: the autoscaler replaces unconditionally,
+ignoring band and cooldowns (ISSUE 8 tentpole).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+SCALE_OUT = "out"
+SCALE_IN = "in"
+
+
+@dataclass(frozen=True)
+class AutoscalePolicy:
+    """Knobs for the control loop (doc/operations.md "Autoscaling")."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    chips_per_replica: int = 1
+    slots_per_replica: int = 8
+    high_watermark: float = 0.8
+    low_watermark: float = 0.3
+    max_step: int = 1
+    scale_out_cooldown_s: float = 30.0
+    scale_in_cooldown_s: float = 120.0
+    eval_period_s: float = 10.0
+    enospc_backoff_s: float = 60.0
+    # A load key older than this (by its own ts field vs the caller's
+    # wall clock) is treated as absent: capacity still counts, busy
+    # does not — a wedged backend must not pin utilization high
+    # forever.  0 disables staleness checks (deterministic sims).
+    stale_load_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}, {self.max_replicas}"
+            )
+        if not 0.0 < self.low_watermark < self.high_watermark:
+            raise ValueError(
+                f"need 0 < low_watermark < high_watermark, got "
+                f"{self.low_watermark}, {self.high_watermark}"
+            )
+        if self.max_step < 1 or self.chips_per_replica < 1:
+            raise ValueError(
+                f"need max_step >= 1 and chips_per_replica >= 1, got "
+                f"{self.max_step}, {self.chips_per_replica}"
+            )
+        if self.slots_per_replica < 1:
+            raise ValueError(
+                f"need slots_per_replica >= 1, got {self.slots_per_replica}"
+            )
+
+
+@dataclass(frozen=True)
+class FleetSnapshot:
+    """One evaluation's inputs, assembled by the autoscaler from its
+    watch mirror: ``replicas`` is the live backend count (managed +
+    static), ``busy`` the fleet-wide active slots + queued requests,
+    ``capacity`` the fleet-wide slot total.  Backends that have not
+    published load yet contribute ``slots_per_replica`` of capacity
+    and zero busy — a booting replica must dilute utilization, not
+    spike it."""
+
+    replicas: int
+    busy: float
+    capacity: float
+
+    @property
+    def utilization(self) -> float:
+        if self.capacity > 0:
+            return self.busy / self.capacity
+        return float("inf") if self.busy > 0 else 0.0
+
+
+@dataclass(frozen=True)
+class Decision:
+    direction: str | None  # SCALE_OUT / SCALE_IN / None
+    count: int
+    utilization: float
+    reason: str
+
+
+def decide(policy: AutoscalePolicy, snapshot: FleetSnapshot) -> Decision:
+    """The band decision for one evaluation — pure: no clocks, no
+    cooldowns (PolicyState gates those), no actuation."""
+    util = snapshot.utilization
+    replicas = snapshot.replicas
+    # Floor/ceiling enforcement precedes the band: an empty fleet must
+    # bootstrap to min_replicas with no traffic at all, and a fleet
+    # above max (an operator added static backends) sheds managed
+    # replicas regardless of load.
+    if replicas < policy.min_replicas:
+        return Decision(
+            SCALE_OUT,
+            min(policy.max_step, policy.min_replicas - replicas),
+            util,
+            f"fleet below min_replicas={policy.min_replicas}",
+        )
+    if replicas > policy.max_replicas:
+        return Decision(
+            SCALE_IN,
+            min(policy.max_step, replicas - policy.max_replicas),
+            util,
+            f"fleet above max_replicas={policy.max_replicas}",
+        )
+    if util > policy.high_watermark:
+        want = min(policy.max_step, policy.max_replicas - replicas)
+        if want <= 0:
+            return Decision(
+                None, 0, util,
+                f"utilization {util:.2f} > {policy.high_watermark} but "
+                f"already at max_replicas={policy.max_replicas}",
+            )
+        return Decision(
+            SCALE_OUT, want, util,
+            f"utilization {util:.2f} > {policy.high_watermark}",
+        )
+    if util < policy.low_watermark and replicas > policy.min_replicas:
+        # Largest step whose projected post-removal utilization stays
+        # strictly inside the band (anti-flap projection).
+        count = min(policy.max_step, replicas - policy.min_replicas)
+        while count > 0:
+            remaining = snapshot.capacity - count * policy.slots_per_replica
+            if remaining > 0 and (
+                snapshot.busy / remaining < policy.high_watermark
+            ):
+                return Decision(
+                    SCALE_IN, count, util,
+                    f"utilization {util:.2f} < {policy.low_watermark}",
+                )
+            count -= 1
+        return Decision(
+            None, 0, util,
+            f"utilization {util:.2f} < {policy.low_watermark} but any "
+            f"removal would project past the {policy.high_watermark} "
+            "high watermark",
+        )
+    return Decision(None, 0, util, "inside the band")
+
+
+class PolicyState:
+    """The time-dependent half of the policy: per-direction cooldowns
+    and the ENOSPC backoff.  Every method takes an explicit ``now``
+    (the autoscaler's injectable clock) so the boundary tests are
+    exact, not sleep-based."""
+
+    def __init__(self, policy: AutoscalePolicy):
+        self.policy = policy
+        self._last: dict[str, float | None] = {SCALE_OUT: None, SCALE_IN: None}
+        self._backoff_until: float | None = None
+
+    def cooldown_blocks(self, direction: str, now: float) -> bool:
+        last = self._last[direction]
+        if last is None:
+            return False
+        cooldown = (
+            self.policy.scale_out_cooldown_s
+            if direction == SCALE_OUT
+            else self.policy.scale_in_cooldown_s
+        )
+        # Blocked strictly inside the window; the expiry instant is
+        # allowed (the cooldown-edge test pins this).
+        return now - last < cooldown
+
+    def enospc_blocks(self, now: float) -> bool:
+        return self._backoff_until is not None and now < self._backoff_until
+
+    def note_action(self, direction: str, now: float) -> None:
+        self._last[direction] = now
+        if direction == SCALE_OUT:
+            # A successful scale-out proves the pool has room again.
+            self._backoff_until = None
+
+    def note_enospc(self, now: float) -> None:
+        self._backoff_until = now + self.policy.enospc_backoff_s

@@ -14,6 +14,12 @@ from oim_tpu.checkpoint.manager import (
     Checkpointer,
     CheckpointerOptions,
     load_params,
+    load_params_from_peer,
 )
 
-__all__ = ["Checkpointer", "CheckpointerOptions", "load_params"]
+__all__ = [
+    "Checkpointer",
+    "CheckpointerOptions",
+    "load_params",
+    "load_params_from_peer",
+]

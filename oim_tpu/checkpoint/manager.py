@@ -292,6 +292,140 @@ class Checkpointer:
         self.close()
 
 
+def _read_exact(resp, n: int) -> bytes:
+    """Read exactly ``n`` bytes from an HTTP response stream (short
+    reads mean the peer died mid-stream — fail loudly, never restore a
+    truncated tensor)."""
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        chunk = resp.read(min(remaining, 8 << 20))
+        if not chunk:
+            raise IOError(
+                f"peer weight stream truncated: wanted {n} bytes, "
+                f"short by {remaining}"
+            )
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def _np_dtype(name: str):
+    """numpy dtype for a manifest dtype name, including the ml_dtypes
+    extension types (bfloat16 & friends) numpy itself cannot parse."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def load_params_from_peer(
+    url: str,
+    abstract_params=None,
+    cfg=None,
+    mesh=None,
+    *,
+    ssl_context=None,
+    timeout: float = 600.0,
+) -> dict:
+    """Restore a params tree from a serving sibling's streamed
+    ``GET /v1/weights`` endpoint (serve/server.py) — the scale-out
+    fast path: a new replica pulls weights over the pod network from
+    an instance that already holds them instead of re-reading blob
+    storage, so bring-up is bounded by network bandwidth, not
+    checkpoint cold-start (ISSUE 8 tentpole, ROADMAP item 3).
+
+    ``abstract_params`` (a flat name → ShapeDtypeStruct dict, e.g.
+    ``jax.eval_shape(lambda: init_params(key, cfg))``) validates the
+    peer's manifest against THIS replica's expected geometry — a peer
+    serving a different model fails with a clear error, never a shape
+    error mid-decode.  Pass ``cfg`` and ``mesh`` to place leaves
+    sharded exactly like ``load_params`` would; without them leaves
+    land on the default device.
+
+    Quantized serving params round-trip too: the manifest carries raw
+    dtypes (int8 payloads + their ``*_wscale`` scale leaves), so a
+    ``--weights-int8`` sibling hands over its quantized form directly.
+    """
+    import json as _json
+    import struct
+    import urllib.request
+
+    import numpy as np
+
+    if (cfg is None) != (mesh is None):
+        raise ValueError("pass both cfg and mesh, or neither")
+    request = urllib.request.Request(url.rstrip("/") + "/v1/weights")
+    kwargs = {"context": ssl_context} if ssl_context is not None else {}
+    t0 = time.perf_counter()
+    leaves: dict = {}
+    with urllib.request.urlopen(request, timeout=timeout, **kwargs) as resp:
+        (manifest_len,) = struct.unpack(">Q", _read_exact(resp, 8))
+        manifest = _json.loads(_read_exact(resp, manifest_len))
+        if abstract_params is not None:
+            # Validate on the MANIFEST, before a byte of payload moves:
+            # a mismatched peer (wrong geometry, quantized vs not) must
+            # fail in milliseconds, not after a multi-GB transfer.
+            want = {
+                name: (tuple(leaf.shape), str(leaf.dtype))
+                for name, leaf in abstract_params.items()
+            }
+            got = {
+                entry["name"]: (
+                    tuple(int(d) for d in entry["shape"]),
+                    entry["dtype"],
+                )
+                for entry in manifest
+            }
+            if want != got:
+                diff = sorted(
+                    set(want.items()) ^ set(got.items()),
+                    key=lambda item: item[0],
+                )
+                raise ValueError(
+                    f"peer {url} serves a different model geometry; "
+                    f"first mismatches: {diff[:4]}"
+                )
+        for entry in manifest:
+            dtype = _np_dtype(entry["dtype"])
+            shape = tuple(int(d) for d in entry["shape"])
+            count = 1
+            for dim in shape:
+                count *= dim
+            raw = _read_exact(resp, count * dtype.itemsize)
+            leaves[entry["name"]] = np.frombuffer(raw, dtype=dtype).reshape(
+                shape
+            )
+    if cfg is not None:
+        placed = _attach_shardings(
+            jax.tree.map(
+                lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
+                leaves,
+            ),
+            cfg,
+            mesh,
+        )
+        restored = {
+            name: jax.device_put(leaf, placed[name].sharding)
+            for name, leaf in leaves.items()
+        }
+    else:
+        restored = {name: jax.device_put(leaf) for name, leaf in leaves.items()}
+    _CKPT_SECONDS.observe(time.perf_counter() - t0, "restore-peer")
+    _CKPT_BYTES.inc("restore-peer", by=_tree_bytes(restored))
+    log.current().info(
+        "params restored from peer",
+        peer=url,
+        leaves=len(restored),
+        seconds=round(time.perf_counter() - t0, 2),
+    )
+    return restored
+
+
 def load_params(directory, abstract_params, cfg=None, mesh=None) -> dict:
     """Restore a params-only export (``Checkpointer.export_params``).
 

@@ -1,0 +1,245 @@
+"""oim-autoscale: the fleet autoscaler daemon.
+
+Watches the serving plane's load and health through the registry
+(``serve/``, ``load/``, ``evictions/``, controller leases) and actuates
+replica-count decisions through the controller's idempotent
+ProvisionSlice / MapVolume RPCs plus a replica launcher — the
+control↔serve loop closed (oim_tpu/autoscale, doc/operations.md
+"Autoscaling").
+
+State access is the FleetMonitor's: the autoscaler rides a RegistryDB.
+Run it beside the registry on the registry's own store, or point
+``--db etcd://host:port`` at a registry's ``--etcd-listen`` stand-in
+(the replica-peering plane) to run it as a separate process:
+
+    oim-registry --db state.sqlite --etcd-listen tcp://127.0.0.1:8380 &
+    oim-autoscale --db etcd://127.0.0.1:8380 \\
+        --registry-address tcp://127.0.0.1:8999 \\
+        --controller c0 --controller c1 \\
+        --min-replicas 1 --max-replicas 4 --chips-per-replica 2 \\
+        --launch-arg python --launch-arg -m \\
+        --launch-arg oim_tpu.cli.serve_main \\
+        --launch-arg --serve-id --launch-arg '{id}' \\
+        --launch-arg --registry-address \\
+        --launch-arg tcp://127.0.0.1:8999 ...
+
+Launched replicas self-register exactly like operator-started ones;
+scale-in drains them through oim-serve's SIGTERM path before unmapping
+the slice.  Use ``--params-peer`` style launch args pointing at a
+serving sibling for network-bounded bring-up.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from oim_tpu import log
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="oim-autoscale", description=__doc__)
+    p.add_argument(
+        "--db",
+        default="",
+        help="registry state: empty = in-memory (tests only — the "
+        "autoscaler must see the registry's real keyspace), "
+        "etcd://host:port = a registry's --etcd-listen stand-in or real "
+        "etcd, else a sqlite path (ONLY when embedded beside the "
+        "registry that owns it)",
+    )
+    p.add_argument(
+        "--registry-address",
+        required=True,
+        help="registry gRPC endpoint (the controller proxy hop the "
+        "actuator dials)",
+    )
+    p.add_argument(
+        "--controller",
+        action="append",
+        default=[],
+        required=True,
+        help="candidate controller id for slice placement (repeatable; "
+        "tried in order, ENOSPC moves to the next)",
+    )
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=4)
+    p.add_argument("--chips-per-replica", type=int, default=1)
+    p.add_argument(
+        "--slots-per-replica", type=int, default=8,
+        help="engine slot capacity assumed for backends that have not "
+        "published load yet (match oim-serve --n-slots)",
+    )
+    p.add_argument("--high-watermark", type=float, default=0.8)
+    p.add_argument("--low-watermark", type=float, default=0.3)
+    p.add_argument("--max-step", type=int, default=1)
+    p.add_argument("--scale-out-cooldown", type=float, default=30.0)
+    p.add_argument("--scale-in-cooldown", type=float, default=120.0)
+    p.add_argument("--eval-period", type=float, default=10.0)
+    p.add_argument("--enospc-backoff", type=float, default=60.0)
+    p.add_argument(
+        "--stale-load",
+        type=float,
+        default=0.0,
+        help="ignore load keys older than this many seconds (0 = never; "
+        "set to ~3x the serve fleet's --registry-delay)",
+    )
+    p.add_argument(
+        "--replica-prefix", default="asr-",
+        help="managed replica ids are <prefix><k>; also the slice/volume "
+        "name",
+    )
+    p.add_argument(
+        "--launch-arg",
+        action="append",
+        default=[],
+        help="one argv element of the replica launch command "
+        "(repeatable, '{id}' substitutes the replica id); empty = "
+        "actuate slices only and let an external supervisor run the "
+        "processes",
+    )
+    p.add_argument(
+        "--state-dir", default="_work/autoscale",
+        help="per-replica bootstrap files for launched subprocesses",
+    )
+    p.add_argument(
+        "--drain-timeout", type=float, default=150.0,
+        help="seconds to wait for a scale-in victim's SIGTERM drain "
+        "before SIGKILL",
+    )
+    p.add_argument(
+        "--fleet-monitor",
+        action="store_true",
+        help="run a FleetMonitor on the same DB and wire its "
+        "eviction/controller-dead classification into replacement "
+        "directly (skip when the registry already runs one in-process "
+        "with the autoscaler)",
+    )
+    p.add_argument("--ca", help="CA cert file (enables mTLS to the proxy)")
+    p.add_argument("--cert", help="client cert (CN user.admin)")
+    p.add_argument("--key", help="key")
+    p.add_argument(
+        "--metrics-endpoint",
+        default="",
+        help="serve Prometheus /metrics (+ /debugz) on this host:port",
+    )
+    p.add_argument("--trace-file", default="")
+    p.add_argument("--log-level", default="info")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    log.init_from_string(args.log_level)
+
+    from oim_tpu.autoscale import (
+        Autoscaler,
+        AutoscalePolicy,
+        ControllerActuator,
+        InProcessLauncher,
+        SubprocessLauncher,
+    )
+    from oim_tpu.cli.registry_main import make_db
+    from oim_tpu.common import events, metrics, tracing
+
+    tracing.init("oim-autoscale", args.trace_file or None)
+    events.init("oim-autoscale")
+    events.install_crash_hook()
+    metrics_server = None
+    if args.metrics_endpoint:
+        metrics_server = metrics.MetricsServer(args.metrics_endpoint).start()
+        log.current().info("metrics endpoint", port=metrics_server.port)
+
+    try:
+        policy = AutoscalePolicy(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            chips_per_replica=args.chips_per_replica,
+            slots_per_replica=args.slots_per_replica,
+            high_watermark=args.high_watermark,
+            low_watermark=args.low_watermark,
+            max_step=args.max_step,
+            scale_out_cooldown_s=args.scale_out_cooldown,
+            scale_in_cooldown_s=args.scale_in_cooldown,
+            eval_period_s=args.eval_period,
+            enospc_backoff_s=args.enospc_backoff,
+            stale_load_s=args.stale_load,
+        )
+    except ValueError as exc:
+        raise SystemExit(str(exc))
+
+    tls_loader = None
+    if args.ca:
+        from oim_tpu.common.tlsconfig import load_tls
+
+        def tls_loader():  # reloaded per call: rotation-safe
+            return load_tls(args.ca, args.cert, args.key)
+
+    db = make_db(args.db)
+    actuator = ControllerActuator(
+        args.registry_address, args.controller, tls_loader=tls_loader
+    )
+    if args.launch_arg:
+        launcher = SubprocessLauncher(
+            args.launch_arg,
+            args.state_dir,
+            drain_timeout_s=args.drain_timeout,
+        )
+    else:
+        # Slice-only actuation: an external supervisor (k8s, systemd)
+        # owns the processes; launch/stop become no-ops it observes
+        # through the registry.
+        launcher = InProcessLauncher(lambda rid, placement: object())
+    monitor = None
+    if args.fleet_monitor:
+        from oim_tpu.health import FleetMonitor
+
+        monitor = FleetMonitor(db).start()
+        log.current().info("fleet monitor running (embedded)")
+
+    autoscaler = Autoscaler(
+        db,
+        policy,
+        actuator,
+        launcher,
+        replica_prefix=args.replica_prefix,
+        monitor=monitor,
+    ).start()
+    log.current().info(
+        "oim-autoscale running",
+        controllers=",".join(args.controller),
+        min=args.min_replicas,
+        max=args.max_replicas,
+        eval_period=args.eval_period,
+    )
+
+    import signal
+    import threading
+
+    stop_evt = threading.Event()
+
+    def _request_stop(*_):
+        signal.signal(signal.SIGINT, signal.default_int_handler)
+        stop_evt.set()
+
+    signal.signal(signal.SIGTERM, _request_stop)
+    signal.signal(signal.SIGINT, _request_stop)
+    try:
+        stop_evt.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        autoscaler.close()
+        if monitor is not None:
+            monitor.close()
+        launcher.close()
+        actuator.close()
+        close = getattr(db, "close", None)
+        if close is not None:
+            close()
+        if metrics_server is not None:
+            metrics_server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

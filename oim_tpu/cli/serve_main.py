@@ -81,6 +81,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="params-only export from oim-train --export-dir (loads a "
         "third of the checkpoint bytes: no optimizer state)",
     )
+    p.add_argument(
+        "--params-peer", default="", metavar="URL",
+        help="restore weights from a serving sibling's streamed "
+        "GET /v1/weights instead of storage (scale-out fast bring-up: "
+        "bounded by network, not checkpoint cold-start); validated "
+        "against this instance's --vocab-size/--d-model/... geometry",
+    )
     # Engine shape.
     p.add_argument(
         "--tp", type=int, default=1,
@@ -279,8 +286,12 @@ def make_engine(args):
         norm_eps=args.norm_eps,
         dtype=args.dtype,
     )
-    if args.checkpoint_dir and args.params_dir:
-        raise SystemExit("--checkpoint-dir and --params-dir are exclusive")
+    if sum(bool(s) for s in (
+        args.checkpoint_dir, args.params_dir, args.params_peer
+    )) > 1:
+        raise SystemExit(
+            "--checkpoint-dir, --params-dir and --params-peer are exclusive"
+        )
     serve_mesh = None
     if args.tp > 1 or args.ep > 1:
         from oim_tpu.parallel import build_mesh
@@ -289,7 +300,51 @@ def make_engine(args):
             tp=args.tp, ep=args.ep,
             devices=jax.devices()[: args.tp * args.ep],
         )
-    if args.params_dir or args.checkpoint_dir:
+    peer_restored = False
+    if args.params_peer:
+        from oim_tpu.checkpoint import load_params_from_peer
+        from oim_tpu.parallel import build_mesh
+        from oim_tpu.serve.httptls import client_ssl_context
+
+        def peer_template():
+            # The sibling streams whatever IT serves — a quantized
+            # sibling hands over int8 payloads + scale leaves directly
+            # (no requantization on this side), so the validation
+            # template must carry the same transform.
+            params = init_params(jax.random.PRNGKey(0), cfg)
+            if args.weights_int8:
+                from oim_tpu.ops.quant import quantize_params_int8
+
+                return quantize_params_int8(params)
+            if args.weights_int4:
+                from oim_tpu.ops.quant import quantize_params_int4
+
+                return quantize_params_int4(params, group=args.int4_group)
+            return params
+
+        template = jax.eval_shape(peer_template)
+        peer_ctx = None
+        if args.params_peer.startswith("https://"):
+            if not (args.ca and args.cert and args.key):
+                raise SystemExit(
+                    "an https --params-peer needs --ca/--cert/--key"
+                )
+            peer_ctx = client_ssl_context(args.ca, args.cert, args.key)
+        quantized = args.weights_int8 or args.weights_int4
+        params = load_params_from_peer(
+            args.params_peer,
+            template,
+            # Quantized trees carry scale leaves the training-sharding
+            # map does not know; the Engine re-places them with its own
+            # serve shardings on construction.
+            None if quantized else cfg,
+            None if quantized else (
+                serve_mesh or build_mesh(devices=jax.devices()[:1])
+            ),
+            ssl_context=peer_ctx,
+        )
+        peer_restored = True
+    elif args.params_dir or args.checkpoint_dir:
         from oim_tpu.parallel import build_mesh
 
         # Shape/dtype template only — restoring immediately replaces it,
@@ -324,11 +379,11 @@ def make_engine(args):
                 params = ckpt.restore_params(lambda: template)
     else:
         params = init_params(jax.random.PRNGKey(0), cfg)
-    if args.weights_int8:
+    if args.weights_int8 and not peer_restored:
         from oim_tpu.ops.quant import quantize_params_int8
 
         params = quantize_params_int8(params)
-    elif args.weights_int4:
+    elif args.weights_int4 and not peer_restored:
         from oim_tpu.ops.quant import quantize_params_int4
 
         params = quantize_params_int4(params, group=args.int4_group)
@@ -463,6 +518,11 @@ def main(argv=None) -> int:
         # stall actively WITHDRAWS the discovery key (one watch event)
         # instead of waiting out probe failures + lease expiry.
         registration.health = lambda: server.error is None
+        # Load telemetry beside the address beat: the leased
+        # load/serve.<id> key the autoscaler's utilization rides on
+        # (freshness = --registry-delay; lower it on autoscaled fleets,
+        # doc/operations.md "Autoscaling").
+        registration.load = engine.load
         registration.start()
         # Durable WARNING+ publication under the serving identity (TLS
         # CN serve.<id> — the registry's events/ authz subtree).

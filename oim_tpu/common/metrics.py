@@ -382,6 +382,45 @@ SERVE_DEADLINE_EXPIRED = _registry.counter(
     "pipeline boundary).",
 )
 
+# ---------------------------------------------------------------------------
+# Fleet-load and autoscaler instruments (ISSUE 8): the serving plane's
+# live pressure as the autoscaler sees it, and the control loop's own
+# decisions/actions.  Defined here (not in the engine or the autoscaler)
+# so the metrics lint covers them and every exporter shares one series
+# shape.  The `engine` label key is kept from the original per-engine
+# gauges (oim_serve_active_slots predates this module — a silent label
+# rename would blank existing dashboards): its value is the engine's
+# per-process label when the engine itself exports, and the serve
+# backend id when the autoscaler's fleet view does.
+
+SERVE_ACTIVE_SLOTS = _registry.gauge(
+    "oim_serve_active_slots",
+    "Slots currently decoding, per serving instance (engine label = "
+    "in-process engine index, or the backend id in the autoscaler's "
+    "fleet view).",
+    ("engine",),
+)
+SERVE_QUEUE_DEPTH = _registry.gauge(
+    "oim_serve_queue_depth",
+    "Requests waiting for a slot, per serving instance (the admission "
+    "backlog the autoscaler's utilization counts as busy work).",
+    ("engine",),
+)
+AUTOSCALE_DESIRED = _registry.gauge(
+    "oim_autoscale_desired_replicas",
+    "Replica count the autoscaler's last evaluation wanted the fleet "
+    "at (current size +/- the decided step, before cooldown/backoff "
+    "gates).  Diverging from the live backend count = actuation is "
+    "failing or clamped; see oim_autoscale_actions_total.",
+)
+AUTOSCALE_ACTIONS = _registry.counter(
+    "oim_autoscale_actions_total",
+    "Autoscaler actions by direction (out / in / replace) and outcome "
+    "(ok / clamped / failed).  clamped = the chip pool was exhausted "
+    "(ENOSPC) and the autoscaler backed off instead of crash-looping.",
+    ("direction", "outcome"),
+)
+
 
 EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 

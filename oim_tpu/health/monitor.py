@@ -233,6 +233,12 @@ class FleetMonitor:
         # re-evict the freshly remapped volume — the old controller's
         # in-flight report still names it until its next scrape.
         self._cleared: dict[str, float] = {}
+        # Programmatic fault subscription (add_listener): consumers —
+        # the autoscaler's replacement trigger — ride THIS monitor's
+        # classification instead of running a second registry watch and
+        # re-deriving grace timers / spoof checks from raw events.
+        self._listeners: dict[int, tuple[Callable | None, Callable | None]] = {}
+        self._next_listener = 0
         self._timer = _GraceTimer(self._grace_fired)
         self._cancel_watch: Callable[[], None] | None = None
         self._chips_gauge = metrics.registry().gauge(
@@ -267,6 +273,46 @@ class FleetMonitor:
         for cid in controllers:
             for state in states.HEALTH_STATES:
                 self._chips_gauge.remove(cid, state)
+
+    # -- programmatic subscription -----------------------------------------
+
+    def add_listener(
+        self,
+        on_eviction: Callable[[str, str, str], None] | None = None,
+        on_controller_dead: Callable[[str], None] | None = None,
+    ) -> Callable[[], None]:
+        """Subscribe to the monitor's classification.  ``on_eviction``
+        fires as ``(volume_id, controller_id, reason)`` once per FRESH
+        eviction (the EvictionEngine's idempotency dedupes a flapping
+        health key before listeners see it); ``on_controller_dead``
+        fires as ``(controller_id,)`` on every address-loss event.
+        Returns a remove function.  Callbacks run on whatever thread
+        classified the event and must not block; an exception in one
+        never reaches the watch dispatch (or other listeners)."""
+        with self._lock:
+            lid = self._next_listener
+            self._next_listener += 1
+            self._listeners[lid] = (on_eviction, on_controller_dead)
+
+        def remove() -> None:
+            with self._lock:
+                self._listeners.pop(lid, None)
+
+        return remove
+
+    def _fire_listeners(self, index: int, *args) -> None:
+        with self._lock:
+            callbacks = [
+                fns[index] for fns in self._listeners.values()
+                if fns[index] is not None
+            ]
+        for callback in callbacks:  # outside the lock: may re-enter us
+            try:
+                callback(*args)
+            except Exception as exc:
+                log.current().error(
+                    "fleet-monitor listener failed", error=str(exc)
+                )
 
     # -- observability -----------------------------------------------------
 
@@ -306,9 +352,12 @@ class FleetMonitor:
                 reason=reason,
             )
             return
-        self.engine.evict(
+        if self.engine.evict(
             volume, cid, reason, detail=detail, reported_ts=reported_ts
-        )
+        ):
+            # Fresh evictions only: the engine's idempotent mark is the
+            # dedupe, so a flapping health key costs one notification.
+            self._fire_listeners(0, volume, cid, reason)
 
     def _update_gauge(self, cid: str) -> None:
         with self._lock:
@@ -465,6 +514,11 @@ class FleetMonitor:
             )
         for volume in allocs:
             self._evict_from_report(volume, cid, "controller-dead", "")
+        # After the evictions so a listener reacting to the death sees
+        # the marks already placed; fired even with zero live
+        # allocations — a consumer may track resources (serve replicas)
+        # the health telemetry does not.
+        self._fire_listeners(1, cid)
         self._update_gauge(cid)
 
     def _on_drain(self, cid: str, value: str) -> None:

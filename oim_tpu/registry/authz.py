@@ -47,6 +47,10 @@ AUTHZ_GRANTS: tuple[tuple[str, str], ...] = (
     # Any authenticated component may publish its OWN flight-recorder
     # events (events/<cn>/<seq>, oim_tpu/common/events).
     ("*", "events/{cn}/*"),
+    # ... and its OWN load telemetry (load/<cn>, oim_tpu/autoscale/load):
+    # a serving instance reports exactly its own pressure — the
+    # autoscaler's observation plane — and cannot forge a sibling's.
+    ("*", "load/{cn}"),
     # A controller registers its own address and publishes its own
     # chip-health telemetry — never drain/eviction marks (operator or
     # registry-side monitor writes).

@@ -1444,14 +1444,12 @@ class Engine:
             "sub-millisecond on a healthy chip, so FAST_BUCKETS.",
             buckets=_metrics.FAST_BUCKETS,
         )
-        self._m_active = reg.gauge(
-            "oim_serve_active_slots", "Slots currently decoding.",
-            ("engine",),
-        )
-        self._m_queued = reg.gauge(
-            "oim_serve_queued_requests", "Requests waiting for a slot.",
-            ("engine",),
-        )
+        # Fleet-load gauges — shared definitions (common/metrics.py,
+        # the resilience-instrument pattern) so the autoscaler's fleet
+        # view and every engine export one series shape; the instance
+        # label is this engine's per-process label.
+        self._m_active = _metrics.SERVE_ACTIVE_SLOTS
+        self._m_queued = _metrics.SERVE_QUEUE_DEPTH
         # Pipeline health triad — shared definitions (common/metrics.py,
         # the resilience-instrument pattern) so fleet-wide queries see
         # one series shape.
@@ -1463,6 +1461,11 @@ class Engine:
         self._m_shed = _metrics.SERVE_SHED
         self._m_deadline = _metrics.SERVE_DEADLINE_EXPIRED
         self._m_stalls = _metrics.SERVE_STALLS
+        # Host-side shed counters beside the shared counter metric:
+        # the load/<cn> snapshot (load(), /v1/info "load") needs THIS
+        # engine's totals, and the process-wide metric cannot be read
+        # back per engine.
+        self._shed_counts = {"queue_full": 0, "deadline": 0, "brownout": 0}
         self._m_pipeline_depth.set(
             float(pipeline_depth), self._engine_label
         )
@@ -1552,6 +1555,8 @@ class Engine:
                 self._m_requests.inc("rejected")
                 self._m_shed.inc("deadline")
                 self._m_deadline.inc()
+                with self._lock:
+                    self._shed_counts["deadline"] += 1
             raise DeadlineExpiredError(
                 "request deadline already expired at submission"
             )
@@ -1571,6 +1576,7 @@ class Engine:
             ):
                 self._m_requests.inc("rejected")
                 self._m_shed.inc("queue_full")
+                self._shed_counts["queue_full"] += 1
                 raise QueueFullError(
                     f"admission queue full ({self.max_queue}); retry later"
                 )
@@ -1594,6 +1600,7 @@ class Engine:
                         req, max_new_tokens=self.brownout_max_tokens
                     )
                     self._m_shed.inc("brownout")
+                    self._shed_counts["brownout"] += 1
             rid = self._next_rid
             self._next_rid += 1
             self._queue.append((rid, req, time.monotonic()))
@@ -2002,6 +2009,29 @@ class Engine:
                     and len(self._queue) >= self._brownout_at
                 ),
                 "fatal": self._fatal,
+            }
+
+    def load(self) -> dict:
+        """Compact live-pressure snapshot — the ``load/<cn>`` registry
+        value (oim_tpu/autoscale/load.py) and the ``load`` section of
+        ``GET /v1/info``.  A strict subset of stats(), shaped for the
+        autoscaler's utilization math: busy work is
+        ``queue_depth + active_slots`` over ``total_slots`` capacity."""
+        with self._lock:
+            return {
+                "queue_depth": len(self._queue),
+                "active_slots": len(self._slots),
+                "total_slots": self._cache.n_slots,
+                "token_rate": round(self._token_rate_ewma or 0.0, 2),
+                "shed_queue_full": self._shed_counts["queue_full"],
+                "shed_deadline": self._shed_counts["deadline"],
+                "shed_brownout": self._shed_counts["brownout"],
+                "brownout": bool(
+                    self.brownout_max_tokens
+                    and self._pressure_since is not None
+                    and len(self._queue) >= self._brownout_at
+                ),
+                "ts": time.time(),
             }
 
     def set_pipeline_depth(self, depth: int) -> None:
@@ -2453,6 +2483,7 @@ class Engine:
                     if not self._warming:
                         self._m_shed.inc("deadline")
                         self._m_deadline.inc()
+                        self._shed_counts["deadline"] += 1
                     self._fail_locked(
                         rid, "deadline_queue",
                         f"expired after {now - t_sub:.1f}s queued",

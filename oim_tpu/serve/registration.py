@@ -35,6 +35,7 @@ class ServeRegistration:
         delay: float = 60.0,
         retry=None,
         health=None,
+        load=None,
     ):
         if not serve_id or "/" in serve_id:
             raise ValueError(f"invalid serve id {serve_id!r}")
@@ -43,6 +44,13 @@ class ServeRegistration:
         self.advertised_address = advertised_address
         self.tls = tls
         self.delay = delay
+        # Optional load telemetry (callable → dict, the Engine.load()
+        # shape): published each beat beside the address key as the
+        # leased ``load/serve.<id>`` value — the autoscaler's
+        # observation plane (oim_tpu/autoscale/load.py).  Mutable like
+        # ``health``: serve_main assigns it once the engine exists.
+        self.load = load
+        self._load_publisher = None
         # Optional health gate (callable → bool), consulted each beat:
         # unhealthy → the key is actively WITHDRAWN (routers watching
         # ``serve/`` drop this instance at the DELETE event — faster
@@ -91,11 +99,34 @@ class ServeRegistration:
         resilience.call_with_retry(
             beat, policy, component="oim-serve", op="Register"
         )
+        self._publish_load()
         log.current().debug(
             "serve registered",
             id=self.serve_id,
             address=self.advertised_address,
         )
+
+    def _publish_load(self) -> None:
+        """Best-effort load beat after a successful registration: a
+        missed one just ages the leased key toward its 3-beat expiry,
+        so it must never fail the address heartbeat it rides on."""
+        if self.load is None:
+            return
+        if self._load_publisher is None:
+            from oim_tpu.autoscale.load import LoadPublisher
+
+            self._load_publisher = LoadPublisher(
+                f"serve.{self.serve_id}",
+                self.registry_address,
+                tls=self.tls,
+                ttl_seconds=max(1.0, self.delay * 3),
+            )
+        try:
+            self._load_publisher.publish(self.load())
+        except Exception as exc:
+            log.current().warning(
+                "load publication failed", id=self.serve_id, error=str(exc)
+            )
 
     def deregister(self) -> None:
         """Best-effort immediate removal of the discovery key (graceful
@@ -117,6 +148,11 @@ class ServeRegistration:
             events.emit(
                 "serve.deregister", component="oim-serve", subject=self.serve_id
             )
+            if self._load_publisher is not None:
+                # Drop the load key with the address: a withdrawn
+                # instance must leave the fleet's utilization estimate
+                # at the same watch event, not at lease expiry.
+                self._load_publisher.withdraw()
         except Exception as exc:
             # The lease still expires the key; deregistration only
             # accelerates it.

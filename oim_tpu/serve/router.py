@@ -66,7 +66,7 @@ import time
 import urllib.error
 import urllib.request
 from concurrent import futures
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu import log
@@ -105,6 +105,13 @@ class Backend:
     # on tunneled deployments — without curling every backend.
     pipeline_depth: int = 0
     info_fetched: bool = False
+    # The backend's live load snapshot (the /v1/info "load" section =
+    # its load/<cn> registry value), refreshed every successful health
+    # probe — queue depth, busy/total slots, token rate, shed counters,
+    # brownout.  Surfaced per backend in the router's /v1/stats so an
+    # operator (or the autoscaler runbook's incident queries) sees the
+    # whole fleet's pressure from one endpoint.
+    load: dict = field(default_factory=dict)
 
 
 class _SpliceState:
@@ -857,7 +864,11 @@ class Router:
                 backend.url + "/healthz", timeout=2
             ) as resp:
                 ok = resp.status == 200
-            if ok and not backend.info_fetched:
+            if ok:
+                # Every tick, not just the first: the capability fields
+                # are fetch-once (static by contract) but the "load"
+                # section is the backend's live pressure and must track
+                # the probe cadence.
                 self._fetch_info(backend)
         except Exception as exc:
             # Any probe failure means unhealthy — including non-OSError
@@ -887,8 +898,9 @@ class Router:
                     backend.healthy = False
 
     def _fetch_info(self, backend: Backend) -> None:
-        """One-time /v1/info fetch for affinity capability (the payload
-        is static by contract).  Failure leaves info_fetched False, so
+        """Per-probe /v1/info fetch: the capability fields (static by
+        contract) land once, the live "load" section lands every time.
+        Failure leaves the previous values, and info_fetched False, so
         the next probe retries."""
         try:
             with self._opener.open(
@@ -904,6 +916,9 @@ class Router:
             backend.pipeline_depth = int(
                 info.get("engine", {}).get("pipeline_depth", 0)
             )
+            load = info.get("load")
+            if isinstance(load, dict):
+                backend.load = load
             backend.info_fetched = True
 
     def _health_loop(self) -> None:
@@ -1088,6 +1103,9 @@ class Router:
                         "from_registry": b.from_registry,
                         # 0 until the first /v1/info fetch succeeds.
                         "pipeline_depth": b.pipeline_depth,
+                        # {} until the first probe-tick info fetch; then
+                        # the backend's live load/<cn> snapshot.
+                        "load": dict(b.load),
                     }
                     for b in self._backends.values()
                 },

@@ -57,9 +57,21 @@ API:
                     overlap_ratio and device_idle_seconds; see
                     doc/operations.md "Serving pipeline tuning")
   GET  /v1/info      → static model/engine description (geometry, params,
-                    capacity shape, live features) — cacheable
+                    capacity shape, live features) — cacheable, EXCEPT
+                    the "load" section, which mirrors the live
+                    ``load/<cn>`` registry snapshot (queue depth,
+                    busy/total slots, token rate, shed counters,
+                    brownout) for the router and the autoscaler
+  GET  /v1/weights   → streamed weight fetch for peer bring-up: an
+                    8-byte big-endian manifest length, a JSON manifest
+                    ([{"name", "dtype", "shape"}...]) and each leaf's
+                    raw bytes in manifest order.  A scaling-out replica
+                    restores from a serving sibling over this
+                    (checkpoint.load_params_from_peer) instead of
+                    re-reading blob storage — bring-up bounded by
+                    network, not checkpoint cold-start
   GET  /metrics      → Prometheus exposition (shared registry)
-  GET  /debugz       → live flight-recorder event rings (common/events.py)
+  GET  /debugz      → live flight-recorder event rings (common/events.py)
 
 Fault tolerance (doc/operations.md "Serving failure modes"): every
 generation endpoint takes a relative deadline budget — ``deadline_ms``
@@ -335,7 +347,13 @@ class ServeServer:
                     info["tokenizer"] = (
                         outer.tokenizer.path if outer.tokenizer else None
                     )
+                    # Live-load mirror of the load/<cn> registry key —
+                    # the router refreshes this each probe tick and
+                    # surfaces it in its own /v1/stats.
+                    info["load"] = outer.engine.load()
                     self._json(200, info)
+                elif self.path == "/v1/weights":
+                    outer._stream_weights(self)
                 else:
                     self._json(404, {"error": f"no such path {self.path}"})
 
@@ -973,6 +991,66 @@ class ServeServer:
             if self._stall_error:
                 self.error = None
                 self._stall_error = False
+
+    def _stream_weights(self, handler) -> None:
+        """Stream the engine's params over HTTP for peer bring-up
+        (``GET /v1/weights``): 8-byte big-endian manifest length, JSON
+        manifest, then each leaf's raw bytes in manifest order.  Leaves
+        are pulled off the device one at a time while streaming, so
+        host memory holds one array, not the model.  Refused (503)
+        while the server's error is latched: a device_get against a
+        wedged device would hang this handler thread inside the device
+        call."""
+        import struct
+
+        import numpy as np
+
+        if self.error is not None:
+            handler._json(
+                503, {"error": f"weights unavailable: {self.error}"}
+            )
+            return
+        params = self.engine.params
+        names = sorted(params)
+        manifest = []
+        total = 0
+        for name in names:
+            arr = params[name]
+            manifest.append(
+                {
+                    "name": name,
+                    "dtype": str(arr.dtype),
+                    "shape": [int(d) for d in arr.shape],
+                }
+            )
+            total += int(arr.nbytes)
+        manifest_bytes = json.dumps(manifest, separators=(",", ":")).encode()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/octet-stream")
+        handler.send_header(
+            "Content-Length", str(8 + len(manifest_bytes) + total)
+        )
+        handler.end_headers()
+        try:
+            handler.wfile.write(struct.pack(">Q", len(manifest_bytes)))
+            handler.wfile.write(manifest_bytes)
+            chunk = 4 << 20
+            for name in names:
+                # ascontiguousarray: the byte order must match the
+                # manifest's C-order shape contract regardless of any
+                # device-side layout; the uint8 reinterpret-view then
+                # streams those bytes with ZERO extra host copies
+                # (tobytes would double the transient footprint per
+                # leaf — and the big leaves are model-embedding sized).
+                host = np.ascontiguousarray(np.asarray(params[name]))
+                flat = host.reshape(-1).view(np.uint8)
+                for off in range(0, flat.size, chunk):
+                    handler.wfile.write(flat[off:off + chunk].data)
+            handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            # Peer gave up mid-fetch (its own retry re-pulls); nothing
+            # here holds state worth cleaning up.
+            return
 
     def _drive(self) -> None:
         while not self._stop.is_set():

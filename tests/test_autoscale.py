@@ -1,0 +1,1040 @@
+"""Fleet autoscaler: policy boundaries, deterministic simulation, chaos.
+
+Three tiers, all hardware-free:
+
+- **Policy units**: the band decision and its edges — exact-watermark
+  no-flap, the anti-flap projection, min/max clamps, step bounds,
+  cooldown-expiry instants, ENOSPC backoff — as pure functions of
+  explicit inputs (oim_tpu/autoscale/policy.py).
+- **Simulation harness** (ISSUE 8 acceptance): a MemRegistryDB, a fake
+  actuator/launcher pair that flips the same registry keys real
+  components would, an injectable clock, and a synthetic load
+  generator.  Ramp-to-overload converges idle→max in a bounded number
+  of evaluation periods; ramp-down converges with zero flap cycles
+  under oscillating load at the band edge; a killed backend is
+  replaced without operator action; an eviction replaces onto a FRESH
+  slice; restarting the autoscaler between decision and actuation
+  provisions exactly one slice.
+- **Chaos soak**: the autoscaler driving a REAL Controller + fake
+  agent through the registry proxy at 20% injected transport failure
+  (the PR 2 harness) — zero leaked slices, zero double-provisions.
+
+Plus the serving-plane integration seams: Engine.load(), the
+load/<cn> registry contract end-to-end through ServeRegistration, the
+router's per-backend load surface, and the streamed weight-fetch /
+restore-from-peer bring-up path.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from oim_tpu.agent import ChipStore, FakeAgentServer
+from oim_tpu.autoscale import (
+    SCALE_IN,
+    SCALE_OUT,
+    Autoscaler,
+    AutoscalePolicy,
+    ControllerActuator,
+    FleetSnapshot,
+    InProcessLauncher,
+    PolicyState,
+    PoolExhaustedError,
+    ReplicaRecord,
+    decide,
+    decode_load,
+    encode_load,
+    load_key,
+    parse_load_path,
+)
+from oim_tpu.autoscale.autoscaler import PROVISIONING, replica_record_key
+from oim_tpu.common import events, metrics, resilience
+from oim_tpu.common.chaos import FlakyAgent
+from oim_tpu.controller import Controller
+from oim_tpu.health import FleetMonitor, states
+from oim_tpu.registry import MemRegistryDB, Registry
+from tests.helpers import FakeServicerContext, wait_for
+
+pytestmark = pytest.mark.autoscale
+
+
+# ---------------------------------------------------------------------------
+# Policy units: the band decision's exact boundaries
+
+
+def _policy(**kw):
+    defaults = dict(
+        min_replicas=1,
+        max_replicas=4,
+        slots_per_replica=4,
+        high_watermark=0.8,
+        low_watermark=0.3,
+        max_step=1,
+        scale_out_cooldown_s=10.0,
+        scale_in_cooldown_s=20.0,
+        enospc_backoff_s=30.0,
+    )
+    defaults.update(kw)
+    return AutoscalePolicy(**defaults)
+
+
+class TestPolicy:
+    def test_scale_out_above_high(self):
+        d = decide(_policy(), FleetSnapshot(replicas=2, busy=7, capacity=8))
+        assert d.direction == SCALE_OUT and d.count == 1
+
+    def test_scale_in_below_low(self):
+        d = decide(_policy(), FleetSnapshot(replicas=2, busy=1, capacity=8))
+        assert d.direction == SCALE_IN and d.count == 1
+
+    def test_exact_high_watermark_holds(self):
+        """Load exactly AT the high watermark takes no action — the
+        band is strict, so watermark-exact load cannot flap."""
+        d = decide(
+            _policy(), FleetSnapshot(replicas=2, busy=0.8 * 8, capacity=8)
+        )
+        assert d.direction is None
+
+    def test_exact_low_watermark_holds(self):
+        d = decide(
+            _policy(), FleetSnapshot(replicas=2, busy=0.3 * 8, capacity=8)
+        )
+        assert d.direction is None
+
+    def test_projection_blocks_flapping_scale_in(self):
+        """Below the low watermark but removing a replica would project
+        utilization past the HIGH watermark: stay put (the very next
+        evaluation would otherwise scale back out — a flap cycle)."""
+        policy = _policy(low_watermark=0.45)
+        # util = 3.4/8 = 0.425 < 0.45; projected = 3.4/4 = 0.85 > 0.8.
+        d = decide(policy, FleetSnapshot(replicas=2, busy=3.4, capacity=8))
+        assert d.direction is None
+        assert "project" in d.reason
+
+    def test_projection_allows_safe_scale_in(self):
+        policy = _policy(low_watermark=0.45)
+        # util = 1.4/8 = 0.175; projected = 1.4/4 = 0.35 < 0.8: safe.
+        d = decide(policy, FleetSnapshot(replicas=2, busy=1.4, capacity=8))
+        assert d.direction == SCALE_IN
+
+    def test_max_replicas_clamp(self):
+        d = decide(_policy(), FleetSnapshot(replicas=4, busy=16, capacity=16))
+        assert d.direction is None
+        assert "max_replicas" in d.reason
+
+    def test_min_replicas_clamp(self):
+        d = decide(_policy(), FleetSnapshot(replicas=1, busy=0, capacity=4))
+        assert d.direction is None
+
+    def test_bootstrap_below_min(self):
+        """An empty fleet bootstraps to min_replicas with zero load."""
+        d = decide(_policy(), FleetSnapshot(replicas=0, busy=0, capacity=0))
+        assert d.direction == SCALE_OUT and d.count == 1
+        assert "min_replicas" in d.reason
+
+    def test_above_max_sheds(self):
+        d = decide(
+            _policy(max_step=2),
+            FleetSnapshot(replicas=7, busy=20, capacity=28),
+        )
+        assert d.direction == SCALE_IN and d.count == 2
+
+    def test_max_step_bounds_scale_out(self):
+        policy = _policy(max_step=2)
+        d = decide(policy, FleetSnapshot(replicas=1, busy=40, capacity=4))
+        assert d.direction == SCALE_OUT and d.count == 2
+
+    def test_zero_capacity_with_backlog_is_overload(self):
+        snap = FleetSnapshot(replicas=1, busy=3, capacity=0)
+        assert snap.utilization == float("inf")
+
+    def test_cooldown_blocks_then_expiry_instant_allows(self):
+        state = PolicyState(_policy(scale_out_cooldown_s=10.0))
+        state.note_action(SCALE_OUT, now=100.0)
+        assert state.cooldown_blocks(SCALE_OUT, now=109.999)
+        # The expiry instant itself is allowed (>=, not >).
+        assert not state.cooldown_blocks(SCALE_OUT, now=110.0)
+
+    def test_cooldowns_are_per_direction(self):
+        state = PolicyState(_policy())
+        state.note_action(SCALE_OUT, now=100.0)
+        assert state.cooldown_blocks(SCALE_OUT, now=101.0)
+        assert not state.cooldown_blocks(SCALE_IN, now=101.0)
+
+    def test_enospc_backoff_blocks_until_expiry(self):
+        state = PolicyState(_policy(enospc_backoff_s=30.0))
+        state.note_enospc(now=50.0)
+        assert state.enospc_blocks(now=79.9)
+        assert not state.enospc_blocks(now=80.0)
+
+    def test_successful_scale_out_clears_backoff(self):
+        state = PolicyState(_policy(enospc_backoff_s=1000.0))
+        state.note_enospc(now=50.0)
+        state.note_action(SCALE_OUT, now=60.0)
+        assert not state.enospc_blocks(now=61.0)
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(low_watermark=0.9, high_watermark=0.8)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(max_step=0)
+
+
+# ---------------------------------------------------------------------------
+# Load schema
+
+
+class TestLoadSchema:
+    def test_round_trip(self):
+        snap = {
+            "queue_depth": 3,
+            "active_slots": 2,
+            "total_slots": 8,
+            "token_rate": 41.5,
+            "shed_queue_full": 1,
+            "shed_deadline": 0,
+            "shed_brownout": 2,
+            "brownout": True,
+            "ts": 123.5,
+        }
+        assert decode_load(encode_load(snap)) == snap
+
+    def test_malformed_values_decode_none(self):
+        assert decode_load("not json") is None
+        assert decode_load("[1,2]") is None
+        assert decode_load(json.dumps({"queue_depth": "nan"})) is None
+
+    def test_missing_fields_default(self):
+        decoded = decode_load("{}")
+        assert decoded["queue_depth"] == 0 and decoded["total_slots"] == 0
+
+    def test_path_helpers(self):
+        assert load_key("serve.a") == "load/serve.a"
+        assert parse_load_path("load/serve.a") == "serve.a"
+        assert parse_load_path("load/serve.a/x") is None
+        assert parse_load_path("serve/a/address") is None
+
+    def test_registry_authz_grants_own_key_only(self):
+        registry = Registry()
+        try:
+            assert (
+                registry._check_set_allowed(
+                    "load/serve.a1", FakeServicerContext("serve.a1")
+                )
+                is None
+            )
+            from tests.helpers import FakeAbort
+
+            with pytest.raises(FakeAbort) as err:
+                registry._check_set_allowed(
+                    "load/serve.b2", FakeServicerContext("serve.a1")
+                )
+            assert err.value.code == grpc.StatusCode.PERMISSION_DENIED
+        finally:
+            registry.close()
+
+
+# ---------------------------------------------------------------------------
+# Deterministic simulation harness (fake actuator/launcher + fake clock)
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0):
+        self.t = start
+
+    def monotonic(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeActuator:
+    """Registry-of-record for fake slices.  Mimics the controller's
+    idempotency: provisioning an id that already holds a slice returns
+    the existing placement (exactly what ProvisionSlice + the MapVolume
+    cache guarantee), so double-provision bugs show up as
+    ``provisioned`` growing, not silently re-placing."""
+
+    def __init__(self, pool_chips: int | None = None):
+        self.pool_chips = pool_chips
+        self.provisioned: dict[str, int] = {}
+        self.provision_calls: list[str] = []
+        self.sequence: list[tuple[str, str]] = []
+
+    def provision(self, replica_id: str, chip_count: int) -> dict:
+        self.provision_calls.append(replica_id)
+        if replica_id not in self.provisioned:
+            used = sum(self.provisioned.values())
+            if (
+                self.pool_chips is not None
+                and used + chip_count > self.pool_chips
+            ):
+                raise PoolExhaustedError(
+                    f"pool full: {used}/{self.pool_chips} chips used"
+                )
+            self.provisioned[replica_id] = chip_count
+        self.sequence.append(("provision", replica_id))
+        return {
+            "volume_id": replica_id,
+            "chips": [{"chip_id": i} for i in range(chip_count)],
+            "mesh": [chip_count, 1, 1],
+            "controller": "c0",
+        }
+
+    def deprovision(self, replica_id: str, controller_id: str) -> None:
+        self.provisioned.pop(replica_id, None)
+        self.sequence.append(("deprovision", replica_id))
+
+    def close(self) -> None:
+        pass
+
+
+class FakeLauncher:
+    """Flips the same registry keys a real launched oim-serve would:
+    launch registers ``serve/<id>/address``, stop deregisters.  Load
+    keys are the test's synthetic load generator's job."""
+
+    def __init__(self, db):
+        self.db = db
+        self.running: dict[str, dict] = {}
+        self.stops: list[tuple[str, bool]] = []
+        self.launches: list[str] = []
+
+    def launch(self, replica_id: str, placement: dict) -> None:
+        self.launches.append(replica_id)
+        self.running[replica_id] = placement
+        self.db.store(f"serve/{replica_id}/address", f"http://{replica_id}")
+
+    def stop(self, replica_id: str, drain: bool = True) -> None:
+        self.stops.append((replica_id, drain))
+        self.running.pop(replica_id, None)
+        self.db.store(f"serve/{replica_id}/address", "")
+        self.db.store(load_key(f"serve.{replica_id}"), "")
+
+    def close(self) -> None:
+        for rid in list(self.running):
+            self.stop(rid, drain=False)
+
+
+def set_load(db, sid: str, queue: int, active: int, total: int) -> None:
+    db.store(
+        load_key(f"serve.{sid}"),
+        encode_load(
+            {
+                "queue_depth": queue,
+                "active_slots": active,
+                "total_slots": total,
+                "token_rate": 10.0,
+                "ts": time.time(),
+            }
+        ),
+    )
+
+
+class Sim:
+    """One deterministic autoscaler universe."""
+
+    def __init__(self, policy: AutoscalePolicy, pool_chips=None):
+        self.db = MemRegistryDB()
+        self.actuator = FakeActuator(pool_chips=pool_chips)
+        self.launcher = FakeLauncher(self.db)
+        self.clock = FakeClock()
+        self.autoscaler = Autoscaler(
+            self.db,
+            policy,
+            self.actuator,
+            self.launcher,
+            clock=self.clock.monotonic,
+        )
+        self.autoscaler.start(run_loop=False)
+
+    def offer(self, busy_per_backend: float) -> None:
+        """Synthetic load generator: spread ``busy_per_backend`` over
+        every RUNNING backend (queue beyond the slot capacity)."""
+        policy = self.autoscaler.policy
+        for rid in list(self.launcher.running):
+            total = policy.slots_per_replica
+            active = min(int(busy_per_backend), total)
+            queue = max(0, int(busy_per_backend) - total)
+            set_load(self.db, rid, queue, active, total)
+
+    def tick(self, busy_per_backend: float | None = None):
+        if busy_per_backend is not None:
+            self.offer(busy_per_backend)
+        decision = self.autoscaler.evaluate_once()
+        self.clock.advance(self.autoscaler.policy.eval_period_s)
+        return decision
+
+    def replica_count(self) -> int:
+        return len(self.launcher.running)
+
+    def close(self) -> None:
+        self.autoscaler.close()
+        self.db.close()
+
+
+@pytest.fixture
+def sim():
+    sims: list[Sim] = []
+
+    def make(policy=None, pool_chips=None) -> Sim:
+        if policy is None:
+            policy = _policy(
+                scale_out_cooldown_s=5.0,
+                scale_in_cooldown_s=5.0,
+                eval_period_s=10.0,
+            )
+        instance = Sim(policy, pool_chips=pool_chips)
+        sims.append(instance)
+        return instance
+
+    yield make
+    for instance in sims:
+        instance.close()
+
+
+def _action_kinds() -> list[str]:
+    return [
+        e.kind
+        for e in events.all_events()
+        if e.kind.startswith("autoscale.")
+    ]
+
+
+class TestSimulation:
+    def test_bootstrap_to_min_with_no_traffic(self, sim):
+        s = sim()
+        s.tick()
+        assert s.replica_count() == 1
+        assert "asr-0" in s.launcher.running
+
+    def test_ramp_to_overload_converges_to_max_bounded(self, sim):
+        """ISSUE acceptance: idle → sustained overload scales min → max
+        within a bounded number of evaluation periods (one step per
+        period once the cooldown is inside the period), and never past
+        max."""
+        s = sim()
+        s.tick()  # bootstrap to min
+        policy = s.autoscaler.policy
+        budget = (policy.max_replicas - policy.min_replicas) + 2
+        periods = 0
+        while s.replica_count() < policy.max_replicas and periods < budget:
+            s.tick(busy_per_backend=20)  # every backend drowning
+            periods += 1
+        assert s.replica_count() == policy.max_replicas, (
+            f"did not reach max in {periods} periods"
+        )
+        # Sustained overload past max: clamped, never exceeded.
+        for _ in range(3):
+            s.tick(busy_per_backend=20)
+        assert s.replica_count() == policy.max_replicas
+        assert metrics.AUTOSCALE_DESIRED.value() == policy.max_replicas
+
+    def test_ramp_down_zero_flap_under_band_edge_oscillation(self, sim):
+        """ISSUE acceptance: after the ramp ends, load oscillating at
+        the low-watermark edge converges down with ZERO flap cycles
+        (no scale-out ever follows a scale-in)."""
+        events.clear_all()
+        s = sim()
+        s.tick()
+        # Ramp to max.
+        for _ in range(6):
+            s.tick(busy_per_backend=20)
+        assert s.replica_count() == s.autoscaler.policy.max_replicas
+        # Oscillate fleet-wide busy around the low watermark edge:
+        # util alternates just above/below 0.3 while capacity shrinks.
+        fleet_busy = [4.6, 5.0, 4.6, 5.0, 4.6, 5.0, 4.6, 5.0, 4.6, 5.0]
+        for busy in fleet_busy:
+            s.tick(busy_per_backend=busy / max(1, s.replica_count()))
+        kinds = _action_kinds()
+        first_in = kinds.index("autoscale.scale_in")
+        assert "autoscale.scale_out" not in kinds[first_in:], (
+            f"flap cycle detected: {kinds}"
+        )
+        # Converged to a size where the oscillation sits inside the
+        # band, and stays there.
+        settled = s.replica_count()
+        for busy in fleet_busy:
+            s.tick(busy_per_backend=busy / max(1, s.replica_count()))
+        assert s.replica_count() == settled
+
+    def test_killed_backend_replaced_without_operator_action(self, sim):
+        """ISSUE acceptance: a killed backend (discovery key lost while
+        its record says up) is relaunched on its recorded placement —
+        no operator, no control-plane round trip."""
+        events.clear_all()
+        s = sim()
+        s.tick()
+        assert "asr-0" in s.launcher.running
+        provisions_before = len(s.actuator.provision_calls)
+        # Kill: the process dies, its leased discovery key expires.
+        s.launcher.running.pop("asr-0")
+        s.db.store("serve/asr-0/address", "")
+        s.tick(busy_per_backend=1)
+        assert "asr-0" in s.launcher.running, "not relaunched"
+        assert s.db.lookup("serve/asr-0/address") != ""
+        # Same slice: replacement took zero provision calls.
+        assert len(s.actuator.provision_calls) == provisions_before
+        assert "autoscale.replace" in _action_kinds()
+        assert metrics.AUTOSCALE_ACTIONS.value("replace", "ok") >= 1
+
+    def test_eviction_replaces_on_fresh_slice(self, sim):
+        """A chip-failure eviction invalidates the slice: the old
+        replica is torn down, the evicted volume id is retired, and
+        capacity returns on a NEW id with a new slice."""
+        s = sim()
+        s.tick()
+        assert s.actuator.provisioned == {"asr-0": 1}
+        s.db.store(
+            states.eviction_key("asr-0"),
+            json.dumps({"state": "evicted", "reason": "chip-failed"}),
+        )
+        s.tick(busy_per_backend=1)
+        assert "asr-0" not in s.actuator.provisioned
+        assert "asr-0" not in s.launcher.running
+        assert "asr-1" in s.launcher.running  # never reuses an evicted id
+        assert s.actuator.provisioned == {"asr-1": 1}
+        record = s.db.lookup(replica_record_key("asr-1"))
+        assert json.loads(record)["state"] == "up"
+
+    def test_monitor_listener_drives_replacement(self, sim):
+        """Satellite: the autoscaler wired through FleetMonitor's
+        listener API — a FAILED chip report classifying to an eviction
+        replaces the replica with no second registry watch."""
+        s = sim()
+        monitor = FleetMonitor(s.db).start()
+        try:
+            s.autoscaler.attach_monitor(monitor)
+            s.tick()
+            assert "asr-0" in s.launcher.running
+            s.db.store(
+                states.health_key("h0", "0"),
+                states.encode_report("FAILED", 0, "asr-0", time.time()),
+            )
+            assert wait_for(
+                lambda: s.db.lookup(states.eviction_key("asr-0")) != ""
+            )
+            s.tick(busy_per_backend=1)
+            assert "asr-0" not in s.launcher.running
+            assert "asr-1" in s.launcher.running
+        finally:
+            monitor.close()
+
+    def test_enospc_clamps_backs_off_and_recovers(self, sim):
+        """Satellite: desire beyond the chip pool clamps with a
+        WARNING event and a backoff — no crash-loop hammering — and
+        the pool is re-probed once the backoff expires."""
+        events.clear_all()
+        policy = _policy(
+            min_replicas=1,
+            max_replicas=4,
+            scale_out_cooldown_s=5.0,
+            enospc_backoff_s=25.0,
+            eval_period_s=10.0,
+        )
+        s = sim(policy=policy, pool_chips=2)
+        s.tick()
+        s.tick(busy_per_backend=20)
+        assert s.replica_count() == 2
+        calls_at_full = len(s.actuator.provision_calls)
+        s.tick(busy_per_backend=20)  # pool full → clamp + backoff
+        assert s.replica_count() == 2
+        assert "autoscale.clamped" in _action_kinds()
+        clamp_event = [
+            e for e in events.all_events() if e.kind == "autoscale.clamped"
+        ][-1]
+        assert clamp_event.severity == events.WARNING
+        assert metrics.AUTOSCALE_ACTIONS.value("out", "clamped") >= 1
+        # Inside the backoff: no provisioning attempts at all.
+        calls_after_clamp = len(s.actuator.provision_calls)
+        s.tick(busy_per_backend=20)
+        assert len(s.actuator.provision_calls) == calls_after_clamp
+        # Pool grows (operator added chips); past the backoff the next
+        # evaluation probes again and succeeds.
+        s.actuator.pool_chips = 4
+        s.clock.advance(30.0)
+        s.tick(busy_per_backend=20)
+        assert s.replica_count() == 3
+        assert len(s.actuator.provision_calls) > calls_at_full
+
+    def test_restart_between_decision_and_actuation_single_slice(self, sim):
+        """ISSUE acceptance: an autoscaler that crashed after recording
+        its decision (PROVISIONING) but before/amid actuation re-drives
+        on restart and the fleet ends with EXACTLY one slice for the
+        replica — ProvisionSlice's name-keyed idempotency, surfaced
+        through deterministic id derivation."""
+        s = sim()
+        # Incarnation A decides (durable record) and half-actuates:
+        # the slice lands but the launch never happens.
+        record = ReplicaRecord(
+            replica_id="asr-0", state=PROVISIONING, chips=1
+        )
+        s.db.store(replica_record_key("asr-0"), record.encode())
+        s.actuator.provision("asr-0", 1)
+        s.autoscaler.close()
+        # Incarnation B: fresh autoscaler, same registry.
+        b = Autoscaler(
+            s.db,
+            s.autoscaler.policy,
+            s.actuator,
+            s.launcher,
+            clock=s.clock.monotonic,
+        ).start(run_loop=False)
+        try:
+            b.evaluate_once()
+            assert s.launcher.running.keys() == {"asr-0"}
+            assert s.actuator.provisioned == {"asr-0": 1}, "slice leaked"
+            assert (
+                json.loads(s.db.lookup(replica_record_key("asr-0")))["state"]
+                == "up"
+            )
+            # And the next id derivation never collides with it.
+            assert b._next_replica_id() == "asr-1"
+        finally:
+            b.close()
+
+    def test_scale_in_drain_sequence_and_least_loaded_pick(self, sim):
+        """The scale-in contract (doc/serving.md): discovery withdrawn
+        BEFORE the drain-stop, unmap after, record dropped last — and
+        the victim is the least-loaded backend."""
+        s = sim()
+        s.tick()
+        for _ in range(2):
+            s.tick(busy_per_backend=20)
+        assert s.replica_count() == 3
+        withdrawn_at_stop = {}
+        original_stop = s.launcher.stop
+
+        def asserting_stop(rid, drain=True):
+            withdrawn_at_stop[rid] = s.db.lookup(f"serve/{rid}/address")
+            original_stop(rid, drain)
+
+        s.launcher.stop = asserting_stop
+        # asr-1 is the least loaded.
+        set_load(s.db, "asr-0", 0, 2, 4)
+        set_load(s.db, "asr-1", 0, 0, 4)
+        set_load(s.db, "asr-2", 0, 1, 4)
+        s.autoscaler.evaluate_once()
+        assert "asr-1" not in s.launcher.running
+        assert {"asr-0", "asr-2"} <= set(s.launcher.running)
+        # Withdraw-before-stop: by stop time the key was already gone.
+        assert withdrawn_at_stop == {"asr-1": ""}
+        assert ("asr-1", True) in s.launcher.stops  # drained, not killed
+        assert "asr-1" not in s.actuator.provisioned  # unmapped + deleted
+        assert s.db.lookup(replica_record_key("asr-1")) == ""
+
+    def test_static_backends_never_scaled_in(self, sim):
+        """Operator-provisioned backends participate in utilization but
+        are never scale-in victims; with no managed replica to remove
+        the autoscaler logs and holds."""
+        s = sim(policy=_policy(min_replicas=1, max_replicas=4,
+                               scale_out_cooldown_s=5.0,
+                               scale_in_cooldown_s=5.0))
+        s.db.store("serve/static-a/address", "http://static-a")
+        s.db.store("serve/static-b/address", "http://static-b")
+        set_load(s.db, "static-a", 0, 0, 4)
+        set_load(s.db, "static-b", 0, 0, 4)
+        decision = s.autoscaler.evaluate_once()
+        # 2 live backends, idle: the band wants 1, but nothing managed
+        # exists to remove.
+        assert decision.direction == SCALE_IN
+        assert s.db.lookup("serve/static-a/address") != ""
+        assert s.db.lookup("serve/static-b/address") != ""
+        assert not s.launcher.stops
+
+    def test_transient_actuation_failure_redrives(self, sim):
+        """A provision that dies mid-flight (non-ENOSPC) leaves the
+        durable PROVISIONING record; the next evaluation re-drives it
+        to completion instead of forgetting the replica."""
+        s = sim()
+        boom = {"armed": True}
+        original = s.actuator.provision
+
+        def flaky_provision(rid, chips):
+            if boom.pop("armed", False):
+                raise ConnectionError("proxy hop died")
+            return original(rid, chips)
+
+        s.actuator.provision = flaky_provision
+        s.tick()  # bootstrap attempt fails mid-actuation
+        assert s.replica_count() == 0
+        assert metrics.AUTOSCALE_ACTIONS.value("out", "failed") >= 1
+        s.tick()  # re-drive completes
+        assert s.replica_count() == 1
+        assert s.actuator.provisioned == {"asr-0": 1}
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak: the real control plane at 20% injected transport failure
+
+
+@pytest.fixture
+def control_plane(tmp_path):
+    """fake agent → controller → registry proxy (the PR 2 fleet
+    fixture), with the registry's own DB doubling as the autoscaler's
+    observation plane (the embedded deployment)."""
+    store = ChipStore(mesh=(2, 2, 1), device_dir=str(tmp_path / "dev"))
+    agent_srv = FakeAgentServer(store, str(tmp_path / "agent.sock")).start()
+    registry = Registry()
+    reg_srv = registry.start_server("tcp://127.0.0.1:0")
+    controller = Controller(
+        "h0",
+        agent_srv.socket_path,
+        registry_address=str(reg_srv.addr()),
+        registry_delay=0.2,
+    )
+    ctrl_srv = controller.start_server("tcp://127.0.0.1:0")
+    controller.start(str(ctrl_srv.addr()))
+    assert wait_for(lambda: registry.db.lookup("h0/address") != "")
+    yield store, agent_srv, registry, reg_srv
+    controller.close()
+    ctrl_srv.stop()
+    reg_srv.stop()
+    registry.close()
+    agent_srv.stop()
+
+
+@pytest.mark.chaos
+def test_chaos_soak_no_leaks_no_double_provision(control_plane, monkeypatch):
+    """ISSUE acceptance: 20% injected control-plane failure across a
+    scale-out/in soak leaks no slices and never double-provisions —
+    every settle point the device plane holds EXACTLY one allocation
+    per managed replica, every chip accounted."""
+    monkeypatch.setenv("OIM_RETRY_MAX_ATTEMPTS", "6")
+    monkeypatch.setenv("OIM_RETRY_INITIAL_BACKOFF_S", "0.004")
+    monkeypatch.setenv("OIM_RETRY_MAX_BACKOFF_S", "0.02")
+    store, agent_srv, registry, reg_srv = control_plane
+    actuator = ControllerActuator(
+        str(reg_srv.addr()),
+        ["h0"],
+        retry=resilience.RetryPolicy.from_env(),
+    )
+    launcher = FakeLauncher(registry.db)
+    clock = FakeClock()
+    policy = _policy(
+        min_replicas=1,
+        max_replicas=3,
+        chips_per_replica=1,
+        scale_out_cooldown_s=1.0,
+        scale_in_cooldown_s=1.0,
+        eval_period_s=10.0,
+    )
+    autoscaler = Autoscaler(
+        registry.db, policy, actuator, launcher, clock=clock.monotonic
+    ).start(run_loop=False)
+
+    def settle(target: int, busy: float, budget: int = 40) -> None:
+        def settled() -> bool:
+            # Target reached AND no half-done record pending re-drive:
+            # a chaos-failed teardown must finish before the invariant
+            # check reads the device plane.
+            records = autoscaler.stats()["replicas"]
+            return len(launcher.running) == target and all(
+                rec["state"] == "up" for rec in records.values()
+            )
+
+        for _ in range(budget):
+            for rid in list(launcher.running):
+                total = policy.slots_per_replica
+                active = min(int(busy), total)
+                set_load(
+                    registry.db, rid, max(0, int(busy) - total), active, total
+                )
+            autoscaler.evaluate_once()
+            clock.advance(policy.eval_period_s)
+            if settled():
+                break
+        assert settled(), (
+            f"did not settle at {target}: running={sorted(launcher.running)} "
+            f"records={autoscaler.stats()['replicas']}"
+        )
+
+    def assert_invariants() -> None:
+        managed = {
+            rid
+            for rid, rec in autoscaler.stats()["replicas"].items()
+            if rec["state"] == "up"
+        }
+        allocs = {
+            name: alloc
+            for name, alloc in store.allocations.items()
+            if name.startswith("asr-")
+        }
+        assert set(allocs) == managed, (
+            f"slice/replica drift: allocs={sorted(allocs)} "
+            f"managed={sorted(managed)}"
+        )
+        for name, alloc in allocs.items():
+            assert len(alloc.chip_ids) == policy.chips_per_replica, (
+                f"{name} double-provisioned: {len(alloc.chip_ids)} chips"
+            )
+
+    try:
+        with FlakyAgent(
+            agent_srv.socket_path, "chaos_disconnect", rate=0.2, seed=1729
+        ):
+            for cycle in range(4):
+                settle(3, busy=20)
+                assert_invariants()
+                settle(1, busy=0)
+                assert_invariants()
+        # Final settle with chaos off: nothing stranded mid-teardown.
+        settle(1, busy=0)
+        assert_invariants()
+    finally:
+        autoscaler.close()
+        actuator.close()
+
+
+# ---------------------------------------------------------------------------
+# Serving-plane seams: Engine.load, registration, router, peer weights
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+
+    from oim_tpu.models import TransformerConfig, init_params
+
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def serving(tiny_model):
+    from oim_tpu.serve import Engine
+    from oim_tpu.serve.server import ServeServer
+
+    cfg, params = tiny_model
+    server = ServeServer(
+        Engine(params, cfg, n_slots=2, max_len=64, chunk=4)
+    ).start()
+    yield server
+    server.stop()
+
+
+def _get(url: str, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+class TestServingSeams:
+    def test_engine_load_shape_and_shed_counters(self, tiny_model):
+        from oim_tpu.serve import Engine
+        from oim_tpu.serve.engine import GenRequest, QueueFullError
+
+        cfg, params = tiny_model
+        # No warmup/step: submit only queues, so this engine never
+        # compiles — cheap enough to build per test.
+        engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=4,
+                        max_queue=1)
+        load = engine.load()
+        assert load["queue_depth"] == 0 and load["active_slots"] == 0
+        assert load["total_slots"] == 1 and load["ts"] > 0
+        engine.submit(GenRequest(tokens=[1, 2], max_new_tokens=2))
+        with pytest.raises(QueueFullError):
+            engine.submit(GenRequest(tokens=[1, 2], max_new_tokens=2))
+        load = engine.load()
+        assert load["queue_depth"] == 1
+        assert load["shed_queue_full"] == 1
+        assert decode_load(encode_load(load)) == decode_load(
+            encode_load(load)
+        )
+
+    def test_v1_info_mirrors_load(self, serving):
+        info = _get(f"http://{serving.host}:{serving.port}/v1/info")
+        assert "load" in info
+        assert info["load"]["total_slots"] == 2
+        assert set(info["load"]) >= {
+            "queue_depth",
+            "active_slots",
+            "token_rate",
+            "brownout",
+            "shed_queue_full",
+        }
+
+    def test_registration_publishes_and_withdraws_load(self, serving):
+        registry = Registry()
+        srv = registry.start_server("tcp://127.0.0.1:0")
+        try:
+            from oim_tpu.serve import ServeRegistration
+
+            reg = ServeRegistration(
+                "lt1",
+                str(srv.addr()),
+                f"http://{serving.host}:{serving.port}",
+                delay=0.1,
+                load=serving.engine.load,
+            )
+            reg.start()
+            try:
+                assert wait_for(
+                    lambda: registry.db.lookup("load/serve.lt1") != ""
+                )
+                decoded = decode_load(registry.db.lookup("load/serve.lt1"))
+                assert decoded is not None
+                assert decoded["total_slots"] == 2
+            finally:
+                reg.stop()
+            # Deregistration withdraws BOTH keys in one beat.
+            assert registry.db.lookup("serve/lt1/address") == ""
+            assert registry.db.lookup("load/serve.lt1") == ""
+        finally:
+            srv.stop()
+            registry.close()
+
+    def test_router_stats_surface_backend_load(self, serving):
+        from oim_tpu.serve import Router
+
+        router = Router(
+            backends=(f"http://{serving.host}:{serving.port}",),
+            health_interval=0.1,
+        ).start()
+        try:
+            def loaded():
+                stats = _get(
+                    f"http://{router.host}:{router.port}/v1/stats", timeout=5
+                )
+                backends = list(stats["backends"].values())
+                return backends and backends[0]["load"]
+
+            assert wait_for(loaded, timeout=15)
+            stats = _get(f"http://{router.host}:{router.port}/v1/stats")
+            load = next(iter(stats["backends"].values()))["load"]
+            assert load["total_slots"] == 2
+            assert "queue_depth" in load and "token_rate" in load
+        finally:
+            router.stop()
+
+    def test_weight_fetch_restores_identical_params(self, serving, tiny_model):
+        import jax
+        import numpy as np
+
+        from oim_tpu.checkpoint import load_params_from_peer
+        from oim_tpu.models import init_params
+
+        cfg, params = tiny_model
+        template = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), cfg)
+        )
+        restored = load_params_from_peer(
+            f"http://{serving.host}:{serving.port}", template
+        )
+        assert set(restored) == set(params)
+        for name in params:
+            assert restored[name].dtype == params[name].dtype
+            assert np.array_equal(
+                np.asarray(restored[name]), np.asarray(params[name])
+            ), f"leaf {name} differs"
+
+    def test_weight_fetch_rejects_geometry_mismatch(self, serving, tiny_model):
+        import jax
+
+        from oim_tpu.checkpoint import load_params_from_peer
+        from oim_tpu.models import TransformerConfig, init_params
+
+        wrong = TransformerConfig(**{**CFG, "d_model": 64, "n_heads": 8})
+        template = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), wrong)
+        )
+        with pytest.raises(ValueError, match="different model geometry"):
+            load_params_from_peer(
+                f"http://{serving.host}:{serving.port}", template
+            )
+
+    def test_peer_restored_engine_generates_identically(
+        self, serving, tiny_model
+    ):
+        """The bring-up claim end-to-end: an engine built from
+        peer-fetched weights produces token-identical greedy output."""
+        import jax
+
+        from oim_tpu.checkpoint import load_params_from_peer
+        from oim_tpu.models import init_params
+        from oim_tpu.serve import Engine
+        from oim_tpu.serve.engine import GenRequest
+
+        cfg, params = tiny_model
+        template = jax.eval_shape(
+            lambda: init_params(jax.random.PRNGKey(0), cfg)
+        )
+        restored = load_params_from_peer(
+            f"http://{serving.host}:{serving.port}", template
+        )
+        req = dict(tokens=[3, 1, 4, 1, 5], max_new_tokens=8)
+        sibling = Engine(restored, cfg, n_slots=1, max_len=64, chunk=4)
+        rid = sibling.submit(GenRequest(**req))
+        want = sibling.run()[rid]
+        via_http = _post_generate(serving, req)
+        assert via_http == want
+
+    def test_serve_main_params_peer_flag(self, serving):
+        """make_engine's --params-peer branch end-to-end: an engine
+        built by the CLI path from a sibling's /v1/weights."""
+        from oim_tpu.cli.serve_main import build_parser, make_engine
+
+        geometry = [
+            "--vocab-size", str(CFG["vocab_size"]),
+            "--d-model", str(CFG["d_model"]),
+            "--n-layers", str(CFG["n_layers"]),
+            "--n-heads", str(CFG["n_heads"]),
+            "--d-ff", str(CFG["d_ff"]),
+            "--dtype", CFG["dtype"],
+            "--max-len", "64", "--n-slots", "1",
+        ]
+        with pytest.raises(SystemExit, match="exclusive"):
+            make_engine(build_parser().parse_args(
+                geometry + ["--params-dir", "/x", "--params-peer", "http://y"]
+            ))
+        args = build_parser().parse_args(
+            geometry
+            + ["--params-peer", f"http://{serving.host}:{serving.port}"]
+        )
+        engine = make_engine(args)
+        load = engine.load()
+        assert load["total_slots"] == 1
+
+    def test_autoscale_metrics_registered(self):
+        """Satellite: the fleet gauges + action counter render through
+        the shared registry (the metrics lint's runtime half)."""
+        metrics.AUTOSCALE_DESIRED.set(2.0)
+        metrics.AUTOSCALE_ACTIONS.inc("out", "ok", by=0)
+        metrics.SERVE_QUEUE_DEPTH.set(1.0, "t0")
+        metrics.SERVE_ACTIVE_SLOTS.set(1.0, "t0")
+        text = metrics.registry().render()
+        for name in (
+            "oim_autoscale_desired_replicas",
+            "oim_autoscale_actions_total",
+            "oim_serve_queue_depth",
+            "oim_serve_active_slots",
+        ):
+            assert name in text, f"{name} missing from exposition"
+
+
+def _post_generate(server, payload: dict) -> list[int]:
+    req = urllib.request.Request(
+        f"http://{server.host}:{server.port}/v1/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())["tokens"]

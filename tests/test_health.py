@@ -345,6 +345,60 @@ class TestFleetMonitor:
         finally:
             monitor.close()
 
+    def test_listener_api_eviction_and_controller_dead(self, db):
+        """Regression (ISSUE 8 satellite): FleetMonitor classifies
+        faults but offered no programmatic subscription — a consumer
+        (the autoscaler's replacement trigger) had to run a SECOND
+        registry watch.  add_listener delivers the classification
+        directly: one eviction notification per FRESH mark (flapping
+        dedupes through the EvictionEngine), controller-death as its
+        own callback, and remove() unsubscribes."""
+        monitor = FleetMonitor(db).start()
+        evictions: list[tuple[str, str, str]] = []
+        deaths: list[str] = []
+        remove = monitor.add_listener(
+            on_eviction=lambda vol, cid, reason: evictions.append(
+                (vol, cid, reason)
+            ),
+            on_controller_dead=deaths.append,
+        )
+        try:
+            report(db, "h0", "0", states.FAILED, alloc="vol-l")
+            assert evictions == [("vol-l", "h0", "chip-failed")]
+            # Flapping re-reports: the mark already exists, no repeat.
+            report(db, "h0", "0", states.FAILED, alloc="vol-l")
+            assert len(evictions) == 1
+            db.store("h0/address", "tcp://10.0.0.9:1")
+            db.store("h0/address", "")  # lease expiry
+            assert deaths == ["h0"]
+            # Unsubscribed: later classifications are not delivered.
+            remove()
+            report(db, "h0", "1", states.FAILED, alloc="vol-m")
+            db.store("h1/address", "x")
+            db.store("h1/address", "")
+            assert len(evictions) == 1 and deaths == ["h0"]
+        finally:
+            monitor.close()
+
+    def test_listener_exception_never_kills_classification(self, db):
+        """A broken listener costs its own notification, never the
+        watch dispatch or the other listeners."""
+        monitor = FleetMonitor(db).start()
+        seen: list[str] = []
+
+        def broken(vol, cid, reason):
+            raise RuntimeError("listener bug")
+
+        monitor.add_listener(on_eviction=broken)
+        monitor.add_listener(on_eviction=lambda vol, *_: seen.append(vol))
+        try:
+            report(db, "h0", "0", states.FAILED, alloc="vol-x")
+            assert seen == ["vol-x"]
+            # The eviction itself landed despite the broken listener.
+            assert db.lookup(states.eviction_key("vol-x")) != ""
+        finally:
+            monitor.close()
+
     def test_serve_address_deletion_is_not_controller_death(self, db):
         monitor = FleetMonitor(db).start()
         try:
