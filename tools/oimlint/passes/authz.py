@@ -72,11 +72,20 @@ WRITERS: dict[str, Writer] = {
     # process publishing its own events below the authz layer — the
     # key shape is identical, so it is checked the same way.
     "oim_tpu/common/events.py": Writer("{cn}", ("self.source",)),
+    # The load publisher's ``cn`` IS its CommonName (serve.<id> for
+    # oim-serve), writing exactly its own load/<cn> key — the events.py
+    # shape applied to the autoscaler's observation plane.
+    "oim_tpu/autoscale/load.py": Writer("{cn}", ("self.cn",)),
     # Operator CLI: authenticates as user.admin (grant "**").
     "oim_tpu/cli/oimctl.py": Writer(ADMIN),
     # Fault-management runs registry-side, sharing the registry's DB:
     # its evictions/<vol> stores never cross the authz boundary.
     "oim_tpu/health/monitor.py": Writer(REGISTRY_SIDE),
+    # The autoscaler shares the registry's DB the same way (embedded
+    # beside it, or attached through the etcd stand-in replica plane):
+    # its autoscale/replicas/* records and serve/<id>/address
+    # withdrawals store below the authz boundary.
+    "oim_tpu/autoscale/autoscaler.py": Writer(REGISTRY_SIDE),
 }
 
 # The registry package itself stores below the authz layer.
