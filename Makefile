@@ -48,12 +48,16 @@ test-resilience:
 	timeout -k 10 60 $(PYTHON) -m pytest tests/test_resilience.py -q \
 	  -m "chaos and not slow" -p no:cacheprovider
 
-# Observability: flight-recorder events, tracing, metrics exposition —
-# hard-capped at 60s (tier-1-safe; the suites contain no slow soaks).
+# Observability: flight-recorder events, tracing, metrics exposition,
+# and the request-forensics suite (engine phase spans, the completed-
+# request ring, tenant SLO histograms, router /v1/requests, splice-
+# failover trace propagation) — hard-capped at 60s (tier-1-safe; the
+# suites contain no slow soaks; the forensics suite compiles two tiny
+# CPU engines, ~10s).
 test-observability:
-	timeout -k 10 60 $(PYTHON) -m pytest tests/test_events.py \
-	  tests/test_tracing.py tests/test_metrics.py -q -m "not slow" \
-	  -p no:cacheprovider
+	timeout -k 10 60 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_events.py tests/test_tracing.py tests/test_metrics.py \
+	  tests/test_request_obs.py -q -m "not slow" -p no:cacheprovider
 
 # Serving pipeline: the pipelined-vs-serial exactness matrix, the
 # drain/abort-with-chunk-in-flight regressions, and the readback

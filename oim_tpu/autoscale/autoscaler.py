@@ -390,19 +390,45 @@ class Autoscaler:
         decision = policy_mod.decide(self.policy, snapshot)
         now = self.clock()
         desired = snapshot.replicas
+        held = ""
         if decision.direction == policy_mod.SCALE_OUT:
             desired = snapshot.replicas + decision.count
             if self._state.enospc_blocks(now):
+                held = "enospc_backoff"
                 log.current().debug("scale-out held: ENOSPC backoff")
             elif self._state.cooldown_blocks(policy_mod.SCALE_OUT, now):
+                held = "cooldown"
                 log.current().debug("scale-out held: cooldown")
-            else:
-                self._scale_out(decision)
         elif decision.direction == policy_mod.SCALE_IN:
             desired = snapshot.replicas - decision.count
             if self._state.cooldown_blocks(policy_mod.SCALE_IN, now):
+                held = "cooldown"
                 log.current().debug("scale-in held: cooldown")
-            else:
+        if decision.direction is not None:
+            # Decision journal (ISSUE 9): every evaluation that wants
+            # to act — whether it proceeds, is held by a cooldown/
+            # backoff gate, or ends clamped inside the action — leaves
+            # one flight-recorder row carrying the snapshot it decided
+            # on, so "why did (or didn't) it scale?" is answerable from
+            # `oimctl events --kind autoscale` alone.
+            events.emit(
+                "autoscale.decision",
+                component="oim-autoscale",
+                direction=decision.direction,
+                count=decision.count,
+                reason=decision.reason,
+                utilization=round(decision.utilization, 3),
+                busy=round(snapshot.busy, 2),
+                capacity=round(snapshot.capacity, 2),
+                replicas=snapshot.replicas,
+                high_watermark=self.policy.high_watermark,
+                low_watermark=self.policy.low_watermark,
+                held=held,
+            )
+        if not held:
+            if decision.direction == policy_mod.SCALE_OUT:
+                self._scale_out(decision)
+            elif decision.direction == policy_mod.SCALE_IN:
                 self._scale_in(decision)
         self._m_desired.set(float(desired))
         return decision

@@ -14,6 +14,16 @@
     oimctl events [--volume X] [--component C] [--follow]
                                   flight-recorder timeline (registry
                                   events/ keys, /debugz URLs, dump files)
+    oimctl requests [--slow N] [--tenant CN] [--errors]
+                                  per-request latency breakdowns (queue/
+                                  prefill/decode/stream + trace ids)
+                                  from a router's /v1/requests or a
+                                  backend's /debugz/requests
+    oimctl top [--router URL] [--watch S]
+                                  fleet load summary: per-backend queue/
+                                  slots/token-rate/shed counters from the
+                                  router's /v1/stats, or straight off the
+                                  registry load/ keys when no router runs
 """
 
 from __future__ import annotations
@@ -67,6 +77,122 @@ def _map_and_print(
             f"chip {chip.chip_id}: {chip.device_path} "
             f"coord={list(chip.coord.coords)}"
         )
+
+
+def _serve_urlopen(args, base: str):
+    """urlopen for the serving HTTP plane: https targets reuse the
+    gRPC plane's --ca/--cert/--key (mTLS, the `generate` command's
+    convention).  Returns None (after printing) on misconfiguration."""
+    import urllib.request
+
+    if base.startswith("https://"):
+        if not args.ca:
+            print("error: https targets require --ca (and usually "
+                  "--cert/--key for mTLS servers)")
+            return None
+        from oim_tpu.serve.httptls import client_ssl_context, opener
+
+        return opener(client_ssl_context(args.ca, args.cert, args.key)).open
+    return urllib.request.urlopen
+
+
+def _render_requests(entries: list[dict], dropped: int) -> None:
+    """The latency-breakdown table: per-phase milliseconds + the trace
+    id prefix (16 hex chars — enough for `oimctl trace --trace-id`)."""
+    def ms(value) -> str:
+        return f"{float(value or 0.0) * 1000:9.1f}"
+
+    print(
+        f"{'RID':>5} {'BACKEND':<22} {'TENANT':<12} {'OUTCOME':<14} "
+        f"{'E2E_MS':>9} {'QUEUE':>9} {'ADMIT':>9} {'PREFILL':>9} "
+        f"{'DECODE':>9} {'STREAM':>9} {'CHUNKS':>6} {'TOK i/o':>9}  TRACE"
+    )
+    for e in entries:
+        tok = f"{e.get('tokens_in', 0)}/{e.get('tokens_out', 0)}"
+        print(
+            f"{e.get('rid', -1):>5} "
+            f"{str(e.get('backend', '-'))[:22]:<22} "
+            f"{str(e.get('tenant', ''))[:12]:<12} "
+            f"{str(e.get('outcome', '?'))[:14]:<14} "
+            f"{ms(e.get('e2e_s'))} {ms(e.get('queue_s'))} "
+            f"{ms(e.get('admit_s'))} "
+            f"{ms(e.get('prefill_s'))} {ms(e.get('decode_s'))} "
+            f"{ms(e.get('stream_s'))} {e.get('chunks', 0):>6} "
+            f"{tok:>9}  {str(e.get('trace', ''))[:16]}"
+        )
+    if dropped:
+        print(f"({dropped} older entries evicted from the ring)")
+
+
+class _TopUnavailable(Exception):
+    """Transient fleet-view fetch failure: fatal for a one-shot `top`,
+    printed-and-retried under --watch (the standing incident view must
+    not die on one dropped connection)."""
+
+
+def _run_top(watch_s: float, fetch) -> int:
+    """Shared `oimctl top` scaffold for both modes: ``fetch`` returns
+    (rows, autoscale_line) or raises ``_TopUnavailable``.  One frame
+    without --watch; with it, a flushed frame every ``watch_s`` seconds
+    until interrupted."""
+    while True:
+        if watch_s > 0:
+            print(f"-- {time.strftime('%H:%M:%S')} --", flush=True)
+        try:
+            rows, line = fetch()
+        except KeyboardInterrupt:
+            # Ctrl-C lands mid-fetch as often as mid-sleep (the fetch
+            # is where an outage loop spends its time): exit clean.
+            return 0
+        except _TopUnavailable as exc:
+            if watch_s <= 0:
+                print(f"error: {exc}")
+                return 1
+            print(f"error: {exc} (retrying)", flush=True)
+        else:
+            _print_top(rows, line)
+            print("", end="", flush=True)  # frame out before the sleep
+        if watch_s <= 0:
+            return 0
+        try:
+            time.sleep(watch_s)
+        except KeyboardInterrupt:
+            return 0
+
+
+def _print_top(
+    rows: list[tuple[str, bool, dict]], autoscale_line: str = ""
+) -> None:
+    """One fleet-summary frame: per-backend pressure + the fleet
+    utilization the autoscaler's band policy acts on."""
+    print(
+        f"{'BACKEND':<28} {'HEALTHY':<8} {'QUEUE':>6} {'ACTIVE':>7} "
+        f"{'SLOTS':>6} {'TOK/S':>9} {'SHED q/d/b':>12} BROWNOUT"
+    )
+    busy = capacity = 0.0
+    for bid, healthy, load in rows:
+        q = load.get("queue_depth", 0)
+        a = load.get("active_slots", 0)
+        s = load.get("total_slots", 0)
+        busy += q + a
+        capacity += s
+        shed = (
+            f"{load.get('shed_queue_full', 0)}/"
+            f"{load.get('shed_deadline', 0)}/"
+            f"{load.get('shed_brownout', 0)}"
+        )
+        print(
+            f"{bid[:28]:<28} {'yes' if healthy else 'NO':<8} {q:>6} "
+            f"{a:>7} {s:>6} {load.get('token_rate', 0.0):>9.1f} "
+            f"{shed:>12} {'yes' if load.get('brownout') else '-'}"
+        )
+    util = busy / capacity if capacity else 0.0
+    print(
+        f"fleet: {len(rows)} backends, util {util:.2f} "
+        f"(busy {busy:g} / capacity {capacity:g})"
+    )
+    if autoscale_line:
+        print(autoscale_line)
 
 
 def main(argv=None) -> int:
@@ -209,6 +335,48 @@ def main(argv=None) -> int:
         "--file", action="append", default=[], metavar="PATH",
         help="read a flight-recorder dump file; repeatable",
     )
+    reqs = sub.add_parser(
+        "requests",
+        help="render the recently-completed-request ring: one row per "
+        "request with its per-phase latency breakdown and trace id "
+        "(join with `oimctl trace --trace-id`)",
+    )
+    reqs.add_argument(
+        "--serve", default="http://127.0.0.1:9000",
+        help="router url (fleet-merged /v1/requests) or a single "
+        "backend url (its /debugz/requests)",
+    )
+    reqs.add_argument(
+        "--slow", type=int, default=0, metavar="N",
+        help="the N slowest requests by e2e latency (default: newest)",
+    )
+    reqs.add_argument(
+        "--tenant", default="", help="only this tenant CN's requests"
+    )
+    reqs.add_argument(
+        "--errors", action="store_true",
+        help="only failed requests (outcome != ok)",
+    )
+    reqs.add_argument(
+        "--limit", type=int, default=30,
+        help="rows to show without --slow (newest last)",
+    )
+    top = sub.add_parser(
+        "top",
+        help="one-shot (or --watch) fleet load summary: per-backend "
+        "queue depth, busy/total slots, token rate, shed/brownout "
+        "state; registry mode (no --router) also prints the "
+        "autoscaler's desired-vs-live line when replica records exist",
+    )
+    top.add_argument(
+        "--router", default="",
+        help="read the fleet through this router's /v1/stats instead "
+        "of the registry's load/ keys",
+    )
+    top.add_argument(
+        "--watch", type=float, default=0.0, metavar="S",
+        help="refresh every S seconds until interrupted (0 = one shot)",
+    )
 
     args = parser.parse_args(argv)
     log.init_from_string(args.log_level)
@@ -217,20 +385,10 @@ def main(argv=None) -> int:
         import urllib.request
 
         # https --serve targets use the same --ca/--cert/--key as the
-        # gRPC plane: the serving API is mTLS when deployed that way.
-        if args.serve.startswith("https://"):
-            if not args.ca:
-                print("error: https --serve requires --ca (and usually "
-                      "--cert/--key for mTLS servers)")
-                return 2
-            from oim_tpu.serve.httptls import client_ssl_context, opener
-
-            _opener = opener(
-                client_ssl_context(args.ca, args.cert, args.key)
-            )
-            urlopen = _opener.open
-        else:
-            urlopen = urllib.request.urlopen
+        # gRPC plane (the shared _serve_urlopen convention).
+        urlopen = _serve_urlopen(args, args.serve)
+        if urlopen is None:
+            return 2
 
         def post_request(path: str, payload: dict):
             return urllib.request.Request(
@@ -348,6 +506,75 @@ def main(argv=None) -> int:
             kind=args.kind,
         ))
         return 0
+    if args.command == "requests":
+        import urllib.error
+
+        base = args.serve.rstrip("/")
+        urlopen = _serve_urlopen(args, base)
+        if urlopen is None:
+            return 2
+        doc = None
+        # A router serves the fleet-merged /v1/requests; a single
+        # backend serves /debugz/requests — accept either target.
+        for path in ("/v1/requests", "/debugz/requests"):
+            try:
+                with urlopen(base + path, timeout=30) as resp:
+                    doc = json.load(resp)
+                break
+            except urllib.error.HTTPError as exc:
+                if exc.code == 404:
+                    continue
+                print(f"error: {exc}")
+                return 1
+            except (urllib.error.URLError, OSError, ValueError) as exc:
+                print(f"error: {exc}")
+                return 1
+        if doc is None:
+            print(f"error: neither /v1/requests nor /debugz/requests "
+                  f"answered at {base}")
+            return 1
+        entries = [
+            e for e in doc.get("requests", []) if isinstance(e, dict)
+        ]
+        if args.tenant:
+            entries = [
+                e for e in entries if e.get("tenant") == args.tenant
+            ]
+        if args.errors:
+            entries = [e for e in entries if e.get("outcome") != "ok"]
+        if args.slow > 0:
+            entries.sort(
+                key=lambda e: -float(e.get("e2e_s", 0.0) or 0.0)
+            )
+            entries = entries[: args.slow]
+        else:
+            entries = entries[-args.limit:]
+        for bid, err in sorted((doc.get("errors") or {}).items()):
+            print(f"note: backend {bid} unreadable: {err}")
+        _render_requests(entries, int(doc.get("dropped", 0) or 0))
+        return 0
+    if args.command == "top" and args.router:
+        import urllib.error
+
+        base = args.router.rstrip("/")
+        urlopen = _serve_urlopen(args, base)
+        if urlopen is None:
+            return 2
+
+        def fetch_router_top():
+            try:
+                with urlopen(base + "/v1/stats", timeout=30) as resp:
+                    stats = json.load(resp)
+            except (urllib.error.URLError, OSError, ValueError) as exc:
+                raise _TopUnavailable(str(exc))
+            return [
+                (bid, bool(b.get("healthy", True)), b.get("load") or {})
+                for bid, b in sorted(
+                    (stats.get("backends") or {}).items()
+                )
+            ], ""
+
+        return _run_top(args.watch, fetch_router_top)
     channel = _channel(args)
     # Operator CLI resilience: UNAVAILABLE/DEADLINE_EXCEEDED retried with
     # backoff under the shared policy.  Streaming `watch` is exempt — a
@@ -584,6 +811,85 @@ def main(argv=None) -> int:
                     evts, volume=args.volume, component=args.component,
                     kind=args.kind,
                 ))
+        elif args.command == "top":
+            # Registry mode (no router running): the same load/<cn>
+            # keys the autoscaler's watch mirrors, plus serve/ for the
+            # live backend set and autoscale/replicas/ for desired.
+            from oim_tpu.autoscale.autoscaler import (
+                REPLICA_PREFIX,
+                ReplicaRecord,
+                parse_replica_record_path,
+            )
+            from oim_tpu.autoscale.load import (
+                LOAD_PREFIX,
+                decode_load,
+                parse_load_path,
+            )
+
+            stub = REGISTRY.stub(channel)
+
+            def fetch_registry_top():
+                loads: dict[str, dict] = {}
+                live: set[str] = set()
+                records = []
+                try:
+                    for value in rpc(lambda: stub.GetValues(
+                        oim_pb2.GetValuesRequest(path=LOAD_PREFIX),
+                        timeout=30,
+                    )).values:
+                        cn = parse_load_path(value.path)
+                        if cn is None or not value.value:
+                            continue
+                        snap = decode_load(value.value)
+                        if snap is not None:
+                            loads[cn] = snap
+                    for value in rpc(lambda: stub.GetValues(
+                        oim_pb2.GetValuesRequest(path="serve"), timeout=30
+                    )).values:
+                        parts = value.path.split("/")
+                        if (len(parts) == 3 and parts[0] == "serve"
+                                and parts[2] == "address" and value.value):
+                            live.add(f"serve.{parts[1]}")
+                    for value in rpc(lambda: stub.GetValues(
+                        oim_pb2.GetValuesRequest(path=REPLICA_PREFIX),
+                        timeout=30,
+                    )).values:
+                        rid = parse_replica_record_path(value.path)
+                        if rid is None or not value.value:
+                            continue
+                        record = ReplicaRecord.decode(rid, value.value)
+                        if record is not None:
+                            records.append(record)
+                except grpc.RpcError as exc:
+                    raise _TopUnavailable(resilience.error_text(exc))
+                # HEALTHY = the discovery key exists: a health-withdrawn
+                # backend (PR 6 gate) loses serve/<id>/address first
+                # while its leased load key ages out — exactly the
+                # backend being triaged must not print healthy.
+                rows = [
+                    (cn, cn in live, loads.get(cn, {}))
+                    for cn in sorted(live | set(loads))
+                ]
+                line = ""
+                if records:
+                    states: dict[str, int] = {}
+                    for record in records:
+                        states[record.state] = (
+                            states.get(record.state, 0) + 1
+                        )
+                    desired = sum(
+                        n for s, n in states.items() if s != "draining"
+                    )
+                    detail = " ".join(
+                        f"{s}={n}" for s, n in sorted(states.items())
+                    )
+                    line = (
+                        f"autoscaler: desired {desired} vs live "
+                        f"{len(live)} ({detail})"
+                    )
+                return rows, line
+
+            return _run_top(args.watch, fetch_registry_top)
         elif args.command == "topology":
             reply = rpc(lambda: CONTROLLER.stub(channel).GetTopology(
                 oim_pb2.GetTopologyRequest(),

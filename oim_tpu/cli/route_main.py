@@ -64,6 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
         "the router's own listener requires client certs AND the router "
         "authenticates itself to mTLS backends",
     )
+    p.add_argument(
+        "--trace-file", default="",
+        help="append spans to this JSONL (also $OIM_TRACE_FILE): the "
+        "router span joins client→route→serve→engine traces in "
+        "`oimctl trace`",
+    )
     p.add_argument("--log-level", default="info")
     return p
 
@@ -71,6 +77,14 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     log.init_from_string(args.log_level)
+
+    from oim_tpu.common import events, tracing
+
+    # Observability parity with every other daemon (PR 3): named span
+    # collector, flight-recorder ring behind GET /debugz, crash dump.
+    tracing.init("oim-route", args.trace_file or None)
+    events.init("oim-route")
+    events.install_crash_hook()
 
     from oim_tpu.serve.router import Router
 

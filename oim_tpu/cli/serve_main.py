@@ -132,6 +132,13 @@ def build_parser() -> argparse.ArgumentParser:
         "hard 429 — degraded answers beat errors (0 = off)",
     )
     p.add_argument(
+        "--request-ring", type=int, default=256, metavar="N",
+        help="recently-completed-request ring size: per-request phase "
+        "breakdowns (queue/admit/prefill/decode/stream) served at "
+        "GET /debugz/requests and merged fleet-wide by the router at "
+        "/v1/requests (`oimctl requests`); drop-oldest beyond N",
+    )
+    p.add_argument(
         "--watchdog-interval", type=float, default=1.0, metavar="S",
         help="stall-watchdog poll interval: a decode chunk blocking the "
         "driver past max(--stall-floor, --stall-multiplier x its EWMA "
@@ -436,6 +443,7 @@ def make_engine(args):
         prefill_chunk=args.prefill_chunk,
         pipeline_depth=args.pipeline_depth,
         brownout_max_tokens=args.brownout_max_tokens,
+        request_ring=args.request_ring,
     )
 
 

@@ -267,13 +267,18 @@ class FlakyHTTPBackend:
     def _forward(self, handler, body: bytes | None) -> None:
         """Proxy one request; POSTs are kill-eligible."""
         kill = body is not None and self._kill_roll()
+        headers = (
+            {"Content-Type": "application/json"} if body is not None
+            else {}
+        )
+        # A transparent proxy must not strip the observability/deadline
+        # headers: the splice-trace tests assert both failover attempts
+        # share the router span's trace id THROUGH this proxy.
+        for name in ("traceparent", "x-oim-deadline-ms"):
+            if handler.headers.get(name):
+                headers[name] = handler.headers[name]
         req = urllib.request.Request(
-            self.backend_url + handler.path,
-            data=body,
-            headers=(
-                {"Content-Type": "application/json"} if body is not None
-                else {}
-            ),
+            self.backend_url + handler.path, data=body, headers=headers
         )
         try:
             resp = urllib.request.urlopen(req, timeout=600)

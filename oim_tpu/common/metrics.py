@@ -383,6 +383,52 @@ SERVE_DEADLINE_EXPIRED = _registry.counter(
 )
 
 # ---------------------------------------------------------------------------
+# Per-tenant SLO attribution histograms (ISSUE 9): the engine's phase
+# clock (queue → admit → prefill → decode → stream) keyed by the mTLS
+# tenant CN the HTTP layer hands in with each request.  Shared
+# definitions like the fault-tolerance set so the whole fleet exports
+# one series shape; the tenant label value is the peer cert's CN (or
+# "anon" on a plain-HTTP deployment).  Phase sums reconcile against
+# oim_serve_e2e_seconds by construction (tests assert it): the phases
+# partition the request's submit-to-finalize window.
+
+SERVE_QUEUE_WAIT = _registry.histogram(
+    "oim_serve_queue_wait_seconds",
+    "Submit-to-admission wait per request, by tenant CN: time spent in "
+    "the admission queue before a slot opened.  The growing phase under "
+    "fleet saturation — compare with oim_serve_prefill_seconds to split "
+    "'engine is busy' from 'prefill is slow'.",
+    ("tenant",),
+)
+SERVE_PREFILL = _registry.histogram(
+    "oim_serve_prefill_seconds",
+    "Prefill latency per request, by tenant CN: first device dispatch "
+    "(prefix-cache injection / chunked-prefill segments included) to "
+    "first-token readback.  Scales with prompt length; the phase the "
+    "prefill/decode disaggregation split will move off decode backends.",
+    ("tenant",),
+)
+SERVE_TPOT = _registry.histogram(
+    "oim_serve_tpot_seconds",
+    "Time per output token after the first, by tenant CN (decode-phase "
+    "wall over tokens-1) — the streaming cadence a client experiences "
+    "once tokens flow, vs oim_serve_ttft_seconds for the wait before "
+    "them.  Sub-chunk-wall on a healthy chip, so FAST_BUCKETS.",
+    ("tenant",),
+    buckets=FAST_BUCKETS,
+)
+SERVE_E2E = _registry.histogram(
+    "oim_serve_e2e_seconds",
+    "Submit-to-finalize latency per request, by tenant CN and outcome "
+    "(ok / deadline / deadline_queue / cancelled / stalled / aborted).  "
+    "The per-tenant SLO series; per-phase breakdowns for any slow "
+    "request live in GET /debugz/requests and `oimctl requests`.",
+    ("tenant", "outcome"),
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+             300.0, 600.0),
+)
+
+# ---------------------------------------------------------------------------
 # Fleet-load and autoscaler instruments (ISSUE 8): the serving plane's
 # live pressure as the autoscaler sees it, and the control loop's own
 # decisions/actions.  Defined here (not in the engine or the autoscaler)

@@ -218,6 +218,46 @@ def _new_span_id() -> str:
     return secrets.token_hex(8)
 
 
+def new_trace_id() -> str:
+    """Public id mint for callers recording spans manually."""
+    return _new_trace_id()
+
+
+def record_span(
+    name: str,
+    *,
+    component: str = "",
+    trace_id: str = "",
+    parent_id: str = "",
+    start_ns: int,
+    end_ns: int,
+    status: str = "ok",
+    **attrs: Any,
+) -> Span:
+    """Record one already-measured interval as a span.
+
+    ``start_span`` models the common case — a span whose lifetime IS a
+    ``with`` block on one thread.  Phase spans measured from host-side
+    timestamps (the serve engine's queue/admit/prefill/decode phases,
+    which begin and end across driver-loop iterations) cannot ride a
+    context manager; they are reconstructed after the fact from their
+    recorded boundaries and handed in whole here.  An empty
+    ``trace_id`` mints a fresh trace (the span becomes a root)."""
+    span = Span(
+        trace_id=trace_id or _new_trace_id(),
+        span_id=_new_span_id(),
+        parent_id=parent_id,
+        name=name,
+        component=component or _collector.component,
+        start_ns=start_ns,
+        end_ns=end_ns,
+        status=status,
+        attrs=dict(attrs),
+    )
+    _collector.record(span)
+    return span
+
+
 @contextlib.contextmanager
 def start_span(
     name: str,

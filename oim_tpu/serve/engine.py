@@ -67,6 +67,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field, replace
 from functools import partial
@@ -76,6 +77,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from oim_tpu.common import metrics as _metrics
+from oim_tpu.common import tracing as _tracing
 
 from oim_tpu.models.decode import (
     _dense_mlp,
@@ -892,6 +894,16 @@ class GenRequest:
     # pipeline boundary and the waiter gets a RequestFailedError with
     # kind "deadline" (HTTP 504).
     deadline: float | None = None
+    # Caller's span context (tracing.SpanContext): the engine records
+    # this request's phase spans (queue/admit/prefill/decode/stream)
+    # as its children, so `oimctl trace` renders
+    # router→server→engine as one tree.  None mints a fresh trace —
+    # the phase record (ring + histograms) exists either way.
+    span: "_tracing.SpanContext | None" = None
+    # mTLS tenant identity (the HTTP layer's peer cert CN): labels the
+    # per-tenant SLO histograms and the completed-request ring.  Empty
+    # = unauthenticated deployment; exported as "anon".
+    tenant: str = ""
 
 
 class QueueFullError(RuntimeError):
@@ -938,6 +950,43 @@ class RequestFailedError(RuntimeError):
 
 
 @dataclass
+class _PhaseTrace:
+    """Host-side per-request phase clock (monotonic timestamps) — the
+    substrate for engine phase spans, the completed-request ring, and
+    the per-tenant SLO histograms.  Pure bookkeeping on timestamps the
+    step loop already takes (or cheap host clock reads beside them):
+    recording never touches the device, so tracing cannot perturb the
+    dispatch-ahead pipeline or add a sync.
+
+    The boundaries PARTITION the request's lifetime: queue =
+    [t_submit, t_admitted], admit = [t_admitted, t_prefill] (the
+    wave-scheduling slice between queue exit and the wave's first
+    device work — near-zero by design on this engine; per-row host
+    prep like prefix-cache lookups and prompt-array building
+    interleaves with the wave's device dispatches and books into
+    prefill alongside them), prefill = [t_prefill, t_first] (wave
+    device work starts → first-token readback processed), decode =
+    one interval per chunk
+    the slot participated in (marginal: clipped to the previous
+    chunk's completion, the oim_serve_token_seconds convention), and
+    stream = [last chunk done, finalize] (tail emission +
+    end-of-stream callbacks).  Summing the phases therefore reconciles
+    with the e2e span up to inter-chunk host gaps (tests assert the
+    tolerance)."""
+
+    t_submit: float
+    t_admitted: float = 0.0
+    t_prefill: float = 0.0
+    t_first: float = 0.0
+    # One record per decode chunk this request consumed tokens from:
+    # (chunk seq, span start, done, tokens, dispatch_wait_s,
+    # fetch_wait_s) — dispatch-wait vs fetch-wait from the step loop's
+    # accumulator split, per chunk.  Bounded by
+    # ceil(max_new_tokens / chunk).
+    chunks: list[tuple] = field(default_factory=list)
+
+
+@dataclass
 class _SlotState:
     rid: int
     req: GenRequest
@@ -946,6 +995,7 @@ class _SlotState:
     emitted: list[int] = field(default_factory=list)
     logprobs: list[float] = field(default_factory=list)
     last_token: int = 0
+    phases: _PhaseTrace | None = None
 
 
 @dataclass
@@ -976,6 +1026,12 @@ class _InFlightChunk:
     counts: np.ndarray
     inputs: tuple
     t_dispatch: float
+    # Phase-attribution fields (ISSUE 9): the chunk's sequence number
+    # (monotonic per engine) and its dispatch-wait wall — recorded at
+    # dispatch so _process_chunk can stamp per-request decode spans
+    # with the dispatch-wait vs fetch-wait split without re-measuring.
+    seq: int = 0
+    dispatch_wall: float = 0.0
 
 
 class Engine:
@@ -1036,6 +1092,7 @@ class Engine:
         brownout_max_tokens: int = 0,
         brownout_queue_fraction: float = 0.75,
         brownout_hold_s: float = 1.0,
+        request_ring: int = 256,
     ):
         if pipeline_depth not in (1, 2):
             raise ValueError(
@@ -1343,6 +1400,32 @@ class Engine:
         # regression at depth 2 with no hardware change).
         self._t_last_chunk_done: float | None = None
         self._lock = threading.Lock()
+        # Recently-completed-request ring (ISSUE 9): one compact record
+        # per finalized request — rid, tenant CN, trace id, per-phase
+        # durations, token counts, outcome (ok / deadline / cancelled /
+        # stalled / ...).  Bounded drop-oldest with the drop counted
+        # (ring_dropped; the flight-recorder discipline — silent
+        # truncation would read as "nothing slow happened").  Served as
+        # GET /debugz/requests and merged fleet-wide by the router at
+        # /v1/requests.
+        if request_ring < 0:
+            raise ValueError(
+                f"request_ring must be >= 0, got {request_ring}"
+            )
+        self._ring: deque[dict] = deque(maxlen=request_ring)
+        self.ring_dropped = 0
+        # Own lock, not self._lock: finalization (driver thread, after
+        # the engine lock is released) appends while /debugz handler
+        # threads read — and keeping it separate means ring access
+        # never nests inside the engine lock in either order.
+        self._ring_lock = threading.Lock()
+        # Failure-path finalizations queued under self._lock and
+        # drained OUTSIDE it (the `ended`-callbacks pattern): span
+        # serialization + trace-file writes + histogram observes must
+        # not run with the engine lock held — a deadline-shed storm
+        # reaped on the driver thread would otherwise block every
+        # submit() behind per-victim disk I/O.
+        self._fail_obs: list[tuple] = []
         self._queue: list[tuple[int, GenRequest, float]] = []
         self._slots: dict[int, _SlotState] = {}  # slot index → state
         self._free = list(range(n_slots))
@@ -1461,6 +1544,13 @@ class Engine:
         self._m_shed = _metrics.SERVE_SHED
         self._m_deadline = _metrics.SERVE_DEADLINE_EXPIRED
         self._m_stalls = _metrics.SERVE_STALLS
+        # Per-tenant SLO attribution (shared definitions, ISSUE 9):
+        # the request's phase clock keyed by the mTLS tenant CN the
+        # HTTP layer hands in on GenRequest.tenant.
+        self._m_queue_wait = _metrics.SERVE_QUEUE_WAIT
+        self._m_prefill = _metrics.SERVE_PREFILL
+        self._m_tpot = _metrics.SERVE_TPOT
+        self._m_e2e = _metrics.SERVE_E2E
         # Host-side shed counters beside the shared counter metric:
         # the load/<cn> snapshot (load(), /v1/info "load") needs THIS
         # engine's totals, and the process-wide metric cannot be read
@@ -1831,10 +1921,12 @@ class Engine:
         with self._lock:
             if rid in self._results or rid in self._errors:
                 return False  # already finished; result() will see it
-            for i, (qrid, _req, _t) in enumerate(self._queue):
+            for i, (qrid, qreq, qt) in enumerate(self._queue):
                 if qrid == rid:
                     self._queue.pop(i)
-                    self._fail_locked(rid, "cancelled", message)
+                    self._fail_locked(
+                        rid, "cancelled", message, req=qreq, t_submit=qt
+                    )
                     ended = self._callbacks.pop(rid, None)
                     self._m_queued.set(
                         float(len(self._queue)), self._engine_label
@@ -1847,16 +1939,46 @@ class Engine:
                     self._cancelled.add(rid)
                 else:
                     return False
+        self._drain_fail_obs()
         if ended is not None:
             ended(None, None)  # end-of-stream outside the lock
         return True
 
-    def _fail_locked(self, rid: int, kind: str, message: str) -> None:
+    def _fail_locked(
+        self,
+        rid: int,
+        kind: str,
+        message: str,
+        *,
+        req: GenRequest | None = None,
+        t_submit: float | None = None,
+        state: "_SlotState | None" = None,
+    ) -> None:
         """Record a failed request's error and wake its waiter (lock
         held; streaming callbacks are the CALLER's to end — outside the
-        lock)."""
+        lock).  ``req``/``t_submit`` (queued entries) or ``state``
+        (slotted requests) carry what the caller knows about the
+        request so the forensics record — ring entry, e2e{outcome}
+        observation, partial phase spans — exists for failures too;
+        all-None (abort of a mid-admission rid) records a minimal
+        entry."""
         if not self._warming:
             self._m_requests.inc(kind)
+            if state is not None:
+                req = state.req
+                phases = state.phases or _PhaseTrace(
+                    t_submit=state.t_submit
+                )
+                tokens_out = len(state.emitted)
+            else:
+                phases = (
+                    _PhaseTrace(t_submit=t_submit)
+                    if t_submit is not None else None
+                )
+                tokens_out = 0
+            # Queued, not finalized here: the caller drains via
+            # _drain_fail_obs() after releasing the lock.
+            self._fail_obs.append((rid, req, phases, kind, tokens_out))
         self._cancelled.discard(rid)
         if rid in self._forgotten:
             self._forgotten.discard(rid)
@@ -1884,23 +2006,35 @@ class Engine:
             # _clear_idle_clock_if_drained contract).
             self._inflight = None
             self._t_device_free = None
-            pending = [rid for rid, _, _ in self._queue]
-            pending += list(self._admitting)
-            pending += [s.rid for s in self._slots.values()]
+            # (rid, req, t_submit, state): what each failure site knows
+            # about its request, threaded through so the forensics ring
+            # records every abort victim with whatever phase clock it
+            # had accumulated.
+            pending: list[tuple] = [
+                (rid, req, t, None) for rid, req, t in self._queue
+            ]
+            pending += [(rid, None, None, None) for rid in self._admitting]
+            pending += [
+                (s.rid, None, None, s) for s in self._slots.values()
+            ]
             self._queue.clear()
             self._free += sorted(
                 set(self._slots) | set(self._admitting.values())
             )
             self._slots.clear()
             self._admitting.clear()
-            for rid in pending:
+            for rid, req, t_sub, state in pending:
                 cb = self._callbacks.pop(rid, None)
                 if cb is not None:
                     ended.append(cb)
-                self._fail_locked(rid, kind, message)
+                self._fail_locked(
+                    rid, kind, message,
+                    req=req, t_submit=t_sub, state=state,
+                )
             self._cancelled.clear()
             self._m_active.set(0.0, self._engine_label)
             self._m_queued.set(0.0, self._engine_label)
+        self._drain_fail_obs()
         for cb in ended:  # end-of-stream for streaming consumers
             cb(None, None)
 
@@ -2009,6 +2143,10 @@ class Engine:
                     and len(self._queue) >= self._brownout_at
                 ),
                 "fatal": self._fatal,
+                # Completed-request ring health: entries evicted
+                # drop-oldest (int read is atomic; the ring itself is
+                # under its own lock).
+                "ring_dropped": self.ring_dropped,
             }
 
     def load(self) -> dict:
@@ -2059,6 +2197,176 @@ class Engine:
             if n <= b:
                 return b
         raise AssertionError("submit() bounds prompt length")
+
+    # -- request forensics (ISSUE 9) --------------------------------------
+
+    def requests(self) -> dict:
+        """The recently-completed-request ring (``GET /debugz/requests``
+        on oim-serve; merged fleet-wide by the router at
+        ``/v1/requests``): oldest→newest entries with per-phase
+        durations, plus the drop-oldest eviction count."""
+        with self._ring_lock:
+            return {
+                "requests": [dict(e) for e in self._ring],
+                "dropped": self.ring_dropped,
+            }
+
+    def _finalize_request(
+        self,
+        rid: int,
+        req: GenRequest | None,
+        phases: _PhaseTrace | None,
+        outcome: str,
+        tokens_out: int,
+        t_end: float,
+    ) -> None:
+        """Record one request's full observability story: phase spans
+        (children of the caller's ``GenRequest.span`` so the trace tree
+        reads router→server→engine), the completed-request ring entry,
+        and the per-tenant SLO histograms.  NEVER call this with the
+        engine lock held (span serialization + the trace-file write
+        must not block submit()): the success path runs it on the
+        driver thread after the final callbacks fired (so the stream
+        phase covers the real tail emission), and the failure funnel
+        queues contexts under the lock (``_fail_locked`` →
+        ``_fail_obs``) for ``_drain_fail_obs`` to finalize outside it
+        with ``t_end = now``.  Everything here is host-side clock
+        arithmetic — no device work, no sync.
+
+        Span budget: exactly 1 request span + ≤4 phase spans + one
+        decode span per chunk participated in (the regression test's
+        "spans per request ≤ phases + chunks" bound)."""
+        if self._warming:
+            return
+        t_fin = time.monotonic()
+        tenant = (req.tenant if req is not None else "") or "anon"
+        parent = req.span if req is not None else None
+        # Phase boundaries ride the monotonic clock (immune to wall
+        # steps mid-request); one offset sampled here converts them to
+        # the wall-clock nanoseconds the span format carries.
+        off = time.time_ns() - int(time.monotonic() * 1e9)
+
+        def ns(t: float) -> int:
+            return int(t * 1e9) + off
+
+        t0 = phases.t_submit if phases is not None else t_fin
+        root = _tracing.record_span(
+            "engine.request",
+            component="engine",
+            trace_id=parent.trace_id if parent else "",
+            parent_id=parent.span_id if parent else "",
+            start_ns=ns(t0),
+            end_ns=ns(t_fin),
+            status="ok" if outcome == "ok" else f"error: {outcome}",
+            rid=rid,
+            tenant=tenant,
+            outcome=outcome,
+            tokens_in=len(req.tokens) if req is not None else 0,
+            tokens_out=tokens_out,
+        )
+
+        def child(name: str, a: float, b: float, **attrs) -> None:
+            _tracing.record_span(
+                name, component="engine", trace_id=root.trace_id,
+                parent_id=root.span_id, start_ns=ns(a), end_ns=ns(b),
+                **attrs,
+            )
+
+        queue_s = admit_s = prefill_s = decode_s = stream_s = 0.0
+        chunk_count = 0
+        if phases is not None:
+            t_admit = phases.t_admitted or t_fin
+            queue_s = max(0.0, t_admit - phases.t_submit)
+            child("engine.queue", phases.t_submit, t_admit)
+            if phases.t_admitted and phases.t_prefill:
+                admit_s = max(0.0, phases.t_prefill - phases.t_admitted)
+                child("engine.admit", phases.t_admitted, phases.t_prefill)
+            if phases.t_prefill and phases.t_first:
+                prefill_s = max(0.0, phases.t_first - phases.t_prefill)
+                child("engine.prefill", phases.t_prefill, phases.t_first)
+            for seq, a, b, ntok, disp, fetch in phases.chunks:
+                decode_s += max(0.0, b - a)
+                child(
+                    "engine.decode", a, b, chunk=seq, tokens=ntok,
+                    dispatch_wait_s=round(disp, 6),
+                    fetch_wait_s=round(fetch, 6),
+                )
+            chunk_count = len(phases.chunks)
+            if outcome == "ok" and phases.t_first:
+                # Tail emission: last chunk processed → end-of-stream
+                # callbacks delivered.  Mid-request detok/callback time
+                # is attributed inside the following chunk's span (the
+                # callbacks fire between chunk boundaries).
+                stream_s = max(0.0, t_fin - t_end)
+                child("engine.stream", t_end, t_fin)
+        e2e_s = max(0.0, t_fin - t0)
+        entry = {
+            "rid": rid,
+            "tenant": tenant,
+            "trace": root.trace_id,
+            "outcome": outcome,
+            "queue_s": round(queue_s, 6),
+            "admit_s": round(admit_s, 6),
+            "prefill_s": round(prefill_s, 6),
+            "decode_s": round(decode_s, 6),
+            "stream_s": round(stream_s, 6),
+            "e2e_s": round(e2e_s, 6),
+            "chunks": chunk_count,
+            "tokens_in": len(req.tokens) if req is not None else 0,
+            "tokens_out": tokens_out,
+            "ts": time.time(),
+        }
+        with self._ring_lock:
+            if self._ring.maxlen == 0:
+                self.ring_dropped += 1
+            else:
+                if len(self._ring) == self._ring.maxlen:
+                    self.ring_dropped += 1
+                self._ring.append(entry)
+        self._m_e2e.observe(e2e_s, tenant, outcome)
+        if phases is not None and phases.t_admitted:
+            self._m_queue_wait.observe(queue_s, tenant)
+        if prefill_s > 0.0:
+            self._m_prefill.observe(prefill_s, tenant)
+        if chunk_count and tokens_out > 1:
+            self._m_tpot.observe(decode_s / (tokens_out - 1), tenant)
+
+    def _drain_fail_obs(self) -> None:
+        """Finalize failure records queued by ``_fail_locked`` — called
+        by every failure site right after it releases the engine lock
+        (cancel / abort / _reap / the admission-cancel path)."""
+        with self._lock:
+            if not self._fail_obs:
+                return
+            pending, self._fail_obs = self._fail_obs, []
+        now = time.monotonic()
+        for rid, req, phases, kind, tokens_out in pending:
+            self._finalize_request(rid, req, phases, kind, tokens_out, now)
+
+    def _finalize_done(self, finished: list[_SlotState]) -> None:
+        """Success-path finalization for this step's completed requests
+        — called after their callbacks fired so the stream phase is the
+        real tail-emission window.
+
+        Runs on the driver thread but OFF the engine lock, and the cost
+        is per-REQUEST (≤ 5 + chunks span records, amortized over the
+        whole generation), not per-token.  The one I/O is the span
+        collector's line-buffered --trace-file append; deployments
+        tracing to slow storage pay that per completed request — keep
+        trace files on local disk (the collector is the shared sink
+        every component writes from its own hot threads already)."""
+        for state in finished:
+            phases = state.phases
+            t_end = 0.0
+            if phases is not None:
+                t_end = (
+                    phases.chunks[-1][2] if phases.chunks
+                    else phases.t_first
+                ) or phases.t_submit
+            self._finalize_request(
+                state.rid, state.req, phases, "ok",
+                len(state.emitted), t_end,
+            )
 
     def _finish(self, slot: int, state: _SlotState) -> None:
         # pop with default: a request finishing on its very first (admit)
@@ -2478,7 +2786,10 @@ class Engine:
             keep = []
             for rid, req, t_sub in self._queue:
                 if rid in self._cancelled:
-                    self._fail_locked(rid, "cancelled", "client went away")
+                    self._fail_locked(
+                        rid, "cancelled", "client went away",
+                        req=req, t_submit=t_sub,
+                    )
                 elif req.deadline is not None and now >= req.deadline:
                     if not self._warming:
                         self._m_shed.inc("deadline")
@@ -2487,6 +2798,7 @@ class Engine:
                     self._fail_locked(
                         rid, "deadline_queue",
                         f"expired after {now - t_sub:.1f}s queued",
+                        req=req, t_submit=t_sub,
                     )
                 else:
                     keep.append((rid, req, t_sub))
@@ -2514,11 +2826,12 @@ class Engine:
                     continue
                 self._slots.pop(slot)
                 self._free.append(slot)
-                self._fail_locked(state.rid, kind, msg)
+                self._fail_locked(state.rid, kind, msg, state=state)
                 cb = self._callbacks.pop(state.rid, None)
                 if cb is not None:
                     ended.append(cb)
             self._m_active.set(float(len(self._slots)), self._engine_label)
+        self._drain_fail_obs()
         for cb in ended:  # end-of-stream outside the lock
             cb(None, None)
 
@@ -2558,8 +2871,22 @@ class Engine:
             self._m_queued.set(float(len(self._queue)), self._engine_label)
 
         if admissions:
+            # Phase clock: every admission in this wave left the queue
+            # at the pop above — one boundary instant serves the wave.
+            t_admitted = time.monotonic()
             n_slots = self._cache.n_slots
-            rows = []  # (slot, rid, req, t_submit, start, tail, bucket)
+            # (slot, rid, req, t_submit, start, tail, bucket, t_prefill)
+            rows = []
+            # The wave's prefill work (prefix-cache injections,
+            # chunked-prefill segments, host array building, the group
+            # dispatches below — host and device interleave per row)
+            # starts here.  ONE boundary for the whole wave: a per-row
+            # stamp taken inside the loop would book an earlier
+            # wave-mate's prefill dispatches into later rows' admit
+            # phase.  The admit phase is therefore the near-zero
+            # scheduling slice between pop and wave start — by design;
+            # admission overhead being ~0 is itself a signal.
+            t_pf = time.monotonic()
             for slot, rid, req, t_submit in admissions:
                 start = self._try_prefix_inject(slot, req)
                 tail = req.tokens[start:]
@@ -2594,7 +2921,7 @@ class Engine:
                         self._prefill_segment(slot, req, seg, start)
                         start += len(seg)
                 rows.append((slot, rid, req, t_submit, start, tail,
-                             self._bucket(len(tail))))
+                             self._bucket(len(tail)), t_pf))
             zero_key = jax.random.PRNGKey(0)
             max_len = self._cache.max_len
             groups = []  # (group rows, first_tokens, first_logprobs)
@@ -2625,7 +2952,7 @@ class Engine:
                 press = np.zeros((n_slots,), np.float32)
                 freqs = np.zeros((n_slots,), np.float32)
                 keys = [zero_key] * n_slots
-                for i, (slot, rid, req, _, start, tail, _) in enumerate(
+                for i, (slot, rid, req, _, start, tail, _, _) in enumerate(
                     group
                 ):
                     prompts[i, : len(tail)] = tail
@@ -2698,20 +3025,24 @@ class Engine:
                 self._watch_end()
                 self._mark_dispatch(t_disp, acc)
                 groups.append((group, first, first_lp))
-            for slot, rid, req, _, start, tail, _ in rows:
+            for slot, rid, req, _, start, tail, _, _ in rows:
                 if req.cache_prefix and self.prefix_cache_size:
                     self._store_prefix(slot, req.tokens)
             # ONE combined readback for every admission this step.
             fetched = self._fetch([(f, lp) for _, f, lp in groups], acc)
+            # First-token instant for the whole wave (the combined
+            # readback IS each admission's first-token arrival).
+            t_first = time.monotonic()
             if not self._warming:
                 with self._lock:  # vs _fetch_aux on handler threads
                     self.readbacks += 1
             notices = []
+            finished: list[_SlotState] = []
             with self._lock:
                 for (group, _, _), (f_host, lp_host) in zip(groups, fetched):
-                    for i, (slot, rid, req, t_submit, _, _, _) in enumerate(
-                        group
-                    ):
+                    for i, (
+                        slot, rid, req, t_submit, _, _, _, t_pf
+                    ) in enumerate(group):
                         if rid not in self._admitting:
                             # abort() (watchdog stall verdict on a live
                             # driver) landed while this admission was
@@ -2728,6 +3059,12 @@ class Engine:
                             rid=rid, req=req,
                             base=jax.random.PRNGKey(req.seed),
                             t_submit=t_submit,
+                            phases=_PhaseTrace(
+                                t_submit=t_submit,
+                                t_admitted=t_admitted,
+                                t_prefill=t_pf,
+                                t_first=t_first,
+                            ),
                         )
                         if rid in self._cancelled:
                             # cancel() landed while this admission was
@@ -2738,6 +3075,7 @@ class Engine:
                             self._fail_locked(
                                 rid, "cancelled",
                                 "client went away during admission",
+                                state=state,
                             )
                             cb = self._callbacks.pop(rid, None)
                             if cb is not None:
@@ -2747,6 +3085,7 @@ class Engine:
                         self._admitting.pop(rid, None)
                         if done:
                             self._finish(slot, state)
+                            finished.append(state)
                         else:
                             self._slots[slot] = state
                         cb = (
@@ -2762,6 +3101,8 @@ class Engine:
                 cb(token, lp)
                 if done:
                     cb(None, None)
+            self._finalize_done(finished)
+            self._drain_fail_obs()  # admission-cancelled rids
 
     def _dispatch_chunk(
         self, acc: list, chained: _InFlightChunk | None
@@ -2925,6 +3266,8 @@ class Engine:
             counts=counts,
             inputs=temps_etc,
             t_dispatch=t_dispatch,
+            seq=self._step_count,
+            dispatch_wall=time.monotonic() - t_dispatch,
         )
 
     def _process_chunk(self, handle: _InFlightChunk, acc: list) -> None:
@@ -2933,6 +3276,7 @@ class Engine:
         truncation, completion bookkeeping, and streaming callbacks (in
         submission order per request — the driver thread is the only
         emitter, so pipelining cannot reorder a stream)."""
+        t_fetch0 = time.monotonic()
         if handle.kind == "plain":
             out, lps = self._fetch(handle.handles, acc)
             out3, lps3 = out[:, :, None], lps[:, :, None]
@@ -2943,8 +3287,13 @@ class Engine:
             with self._lock:  # vs _fetch_aux on handler threads
                 self.readbacks += 1
         t_done = time.monotonic()
+        # Per-chunk fetch-wait for the decode-span attribution: the
+        # wall this processing blocked in device_get (the per-call
+        # slice of the step accumulator's fetch-wait total).
+        fetch_wall = t_done - t_fetch0
         emitted_total = 0
         notices = []  # (callback, tokens..., end?) fired outside the lock
+        finished: list[_SlotState] = []
         with self._lock:
             for slot, state in list(handle.snapshot.items()):
                 if self._slots.get(slot) is not state:
@@ -2978,6 +3327,24 @@ class Engine:
                             break
                     if done:
                         break
+                if state.phases is not None:
+                    # One decode record per chunk this slot consumed
+                    # tokens from, marginal (clipped to the previous
+                    # chunk's completion — the pipelined dispatch-ahead
+                    # lag must not double-count) with the dispatch-wait
+                    # vs fetch-wait split riding along.
+                    prev_end = (
+                        state.phases.chunks[-1][2]
+                        if state.phases.chunks
+                        else state.phases.t_first
+                    )
+                    span_start = max(
+                        handle.t_dispatch, prev_end or handle.t_dispatch
+                    )
+                    state.phases.chunks.append((
+                        handle.seq, span_start, t_done, len(fresh),
+                        handle.dispatch_wall, fetch_wall,
+                    ))
                 cb = (
                     self._callbacks.pop(state.rid, None) if done
                     else self._callbacks.get(state.rid)
@@ -2986,6 +3353,7 @@ class Engine:
                     notices.append((cb, fresh, done))
                 if done and slot in self._slots:
                     self._finish(slot, state)
+                    finished.append(state)
         start = handle.t_dispatch
         if self._t_last_chunk_done is not None:
             start = max(start, self._t_last_chunk_done)
@@ -3018,6 +3386,7 @@ class Engine:
                 cb(token, lp)
             if done:
                 cb(None, None)
+        self._finalize_done(finished)
 
     def run(self) -> dict[int, list[int]]:
         """Drain the queue and all active slots; returns {rid: tokens}."""

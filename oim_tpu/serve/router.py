@@ -53,7 +53,17 @@ Behavior:
 
 Endpoints: the serving API (POST /v1/generate, /v1/beam, /v1/embed,
 and the OpenAI-compatible /v1/completions) proxied; GET /healthz (ok while ≥1 backend is healthy), /v1/stats
-(router counters + per-backend state), /metrics (Prometheus).
+(router counters + per-backend state), /v1/requests (fleet-merged
+completed-request forensics: every backend's /debugz/requests ring,
+entries stamped with their backend id — `oimctl requests` reads this),
+/debugz (the router's own flight-recorder rings), /metrics (Prometheus).
+
+Tracing: every proxied request gets a router span (parented on the
+client's ``traceparent`` when present), and every attempt — original
+and failover alike — forwards the ROUTER span's context, so all server
+spans and the engine phase spans below them share one trace id
+(`oimctl trace` renders router→server→engine as one tree, spliced
+failovers included).
 """
 
 from __future__ import annotations
@@ -70,7 +80,7 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu import log
-from oim_tpu.common import metrics
+from oim_tpu.common import metrics, tracing
 from oim_tpu.serve.httptls import check_serving_peer
 
 PROXIED = (
@@ -304,6 +314,18 @@ class Router:
                     )
                 elif path == "/v1/stats":
                     self._json(200, outer.stats())
+                elif path == "/v1/requests":
+                    # Fleet-merged completed-request ring: every
+                    # healthy backend's /debugz/requests in one reply
+                    # (the /v1/stats load-aggregation pattern) — the
+                    # `oimctl requests` data source.
+                    self._json(200, outer.fleet_requests())
+                elif path == "/debugz":
+                    # Flight-recorder parity with every other daemon
+                    # (PR 3): the router's own live event rings.
+                    from oim_tpu.common import events as events_mod
+
+                    self._json(200, events_mod.snapshot())
                 else:
                     self._json(404, {"error": f"no such path {path}"})
 
@@ -490,6 +512,31 @@ class Router:
     def _proxy(
         self, handler, path: str, body: bytes | None, headers: dict
     ) -> None:
+        """Open the router span for one proxied request, then run the
+        failover loop under it (``_proxy_attempts``).
+
+        Every attempt — the original AND each failover — carries the
+        ROUTER span's context in its ``traceparent``, so the backends'
+        server spans (and through them the engine phase spans) land
+        under one trace id: a spliced failover renders as two server
+        subtrees in a single ``oimctl trace`` tree, and a client that
+        sent its own traceparent sees the router span join its trace."""
+        parent = tracing.parse_traceparent(
+            headers.get(tracing.TRACEPARENT_KEY, "") or ""
+        )
+        with tracing.start_span(
+            f"route{path}", component="oim-route", parent=parent,
+        ) as span:
+            headers = dict(headers)
+            headers[tracing.TRACEPARENT_KEY] = tracing.SpanContext(
+                span.trace_id, span.span_id
+            ).traceparent()
+            self._proxy_attempts(handler, path, body, headers, span)
+
+    def _proxy_attempts(
+        self, handler, path: str, body: bytes | None, headers: dict,
+        span,
+    ) -> None:
         """Proxy one request to a healthy backend (``body`` None = GET —
         urllib's method selection; bytes = POST).
 
@@ -523,6 +570,7 @@ class Router:
             if deadline_abs is not None:
                 remaining_ms = (deadline_abs - time.monotonic()) * 1000.0
                 if remaining_ms <= 0:
+                    span.status = "error: deadline"
                     if failovers:
                         metrics.SERVE_FAILOVERS.inc("gave_up")
                     if splice is not None and splice.started:
@@ -545,6 +593,7 @@ class Router:
                 )
             backend = self._pick(exclude=excluded, affinity_key=affinity_key)
             if backend is None:
+                span.status = "error: no healthy backend"
                 if failovers:
                     metrics.SERVE_FAILOVERS.inc("gave_up")
                 if splice is not None and splice.started:
@@ -571,6 +620,10 @@ class Router:
                 )
                 return
             excluded.add(backend.id)
+            # The last attempt wins the attr — with failovers, the
+            # count says how many backends it took.
+            span.attrs["backend"] = backend.id
+            span.attrs["failovers"] = failovers
             req_body = body if splice is None else splice.request_body()
             req = urllib.request.Request(
                 backend.url + path, data=req_body, headers=headers
@@ -1090,6 +1143,60 @@ class Router:
         self._reconcile(found)
 
     # -- stats / lifecycle ---------------------------------------------------
+
+    def fleet_requests(self) -> dict:
+        """Fleet-merged completed-request forensics (``GET
+        /v1/requests``): every known backend's ``/debugz/requests``
+        ring in one reply, each entry stamped with its backend id,
+        sorted oldest→newest by completion wall time.  A backend that
+        fails the fetch is reported in ``errors`` rather than silently
+        missing — partial forensics must say they are partial."""
+        def fetch(backend: Backend):
+            try:
+                with self._opener.open(
+                    backend.url + "/debugz/requests", timeout=5
+                ) as resp:
+                    return backend.id, json.loads(resp.read()), None
+            except Exception as exc:
+                return backend.id, None, str(getattr(exc, "reason", exc))
+
+        # ALL backends, not just healthy ones: a stalled backend (the
+        # watchdog flipped /healthz, the router routed around it) is
+        # exactly the one whose outcome=stalled ring entries the triage
+        # needs, and its HTTP listener usually still answers /debugz —
+        # a truly dead one lands in ``errors`` via its connect failure.
+        with self._lock:
+            backends = list(self._backends.values())
+        # Concurrent fetches on a per-call pool sized to the fleet
+        # (capped): a serial sweep would make /v1/requests O(fleet)
+        # with one hung-but-listening backend adding its whole 5s
+        # timeout — exactly during the incident the endpoint exists to
+        # triage — and borrowing the shared 8-worker probe pool would
+        # both re-serialize past 8 backends and starve health probes
+        # of workers.  The ``with`` joins all fetches before returning.
+        merged: list[dict] = []
+        dropped = 0
+        errors: dict[str, str] = {}
+        with futures.ThreadPoolExecutor(
+            max_workers=max(1, min(32, len(backends))),
+            thread_name_prefix="router-forensics",
+        ) as pool:
+            pending = [(b.id, pool.submit(fetch, b)) for b in backends]
+            for queued_id, future in pending:
+                try:
+                    bid, doc, err = future.result()
+                except futures.CancelledError:  # pragma: no cover
+                    errors[queued_id] = "fetch cancelled"
+                    continue
+                if doc is None:
+                    errors[bid] = err
+                    continue
+                for entry in doc.get("requests", ()):
+                    if isinstance(entry, dict):
+                        merged.append(dict(entry, backend=bid))
+                dropped += int(doc.get("dropped", 0) or 0)
+        merged.sort(key=lambda e: float(e.get("ts", 0.0) or 0.0))
+        return {"requests": merged, "dropped": dropped, "errors": errors}
 
     def stats(self) -> dict:
         with self._lock:

@@ -72,6 +72,13 @@ API:
                     network, not checkpoint cold-start
   GET  /metrics      → Prometheus exposition (shared registry)
   GET  /debugz      → live flight-recorder event rings (common/events.py)
+  GET  /debugz/requests → the recently-completed-request ring: one
+                    record per finalized request (rid, tenant CN, trace
+                    id, per-phase durations queue/admit/prefill/decode/
+                    stream, token counts, outcome) plus the drop-oldest
+                    eviction count — the slow-request forensics surface
+                    (doc/operations.md "Request forensics"); the router
+                    merges these fleet-wide at /v1/requests
 
 Fault tolerance (doc/operations.md "Serving failure modes"): every
 generation endpoint takes a relative deadline budget — ``deadline_ms``
@@ -105,7 +112,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu.common import metrics, tracing
-from oim_tpu.serve.httptls import check_serving_peer
+from oim_tpu.serve.httptls import check_serving_peer, peer_common_name
 from oim_tpu.serve.engine import (
     DeadlineExpiredError,
     DrainingError,
@@ -331,6 +338,15 @@ class ServeServer:
 
                     self._json(200, events_mod.snapshot())
                     return
+                if self.path.split("?", 1)[0] == "/debugz/requests":
+                    # Recently-completed-request ring: per-request
+                    # phase breakdowns (queue/admit/prefill/decode/
+                    # stream), trace ids, tenant CNs, outcomes — the
+                    # slow-request forensics surface (doc/operations.md
+                    # "Request forensics").  Merged fleet-wide by the
+                    # router at /v1/requests.
+                    self._json(200, outer.engine.requests())
+                    return
                 if self.path == "/healthz":
                     if outer.error is not None:
                         # A dead driver thread must flip health, or the
@@ -483,9 +499,20 @@ class ServeServer:
                     self._beam_request()
                     return
                 if self.path in ("/v1/completions", "/v1/chat/completions"):
-                    self._completions_request(
-                        chat=self.path.endswith("chat/completions")
+                    # Same trace-join contract as /v1/generate: the
+                    # OpenAI surface gets a server span and the engine
+                    # phases parent under it.
+                    parent = tracing.parse_traceparent(
+                        self.headers.get("traceparent", "")
                     )
+                    with tracing.start_span(
+                        "serve.completions", component="oim-serve",
+                        parent=parent,
+                    ) as span:
+                        self._completions_request(
+                            chat=self.path.endswith("chat/completions"),
+                            span=span,
+                        )
                     return
                 if self.path != "/v1/generate":
                     self._json(404, {"error": f"no such path {self.path}"})
@@ -501,7 +528,9 @@ class ServeServer:
                 ) as span:
                     self._generate(span)
 
-            def _completions_request(self, chat: bool = False) -> None:
+            def _completions_request(
+                self, chat: bool = False, span=None
+            ) -> None:
                 """OpenAI-compatible ``/v1/completions``: the shape the
                 ecosystem's clients speak, mapped onto the native
                 engine.  String prompts/stops need the server-side
@@ -576,6 +605,13 @@ class ServeServer:
                             temperature=temperature,
                             seed=seed + i,
                             deadline=deadline,
+                            span=(
+                                tracing.SpanContext(
+                                    span.trace_id, span.span_id
+                                )
+                                if span is not None else None
+                            ),
+                            tenant=peer_common_name(self) or "",
                             eos_id=(
                                 outer.tokenizer.eos_id
                                 if outer.tokenizer is not None
@@ -882,6 +918,13 @@ class ServeServer:
                         ),
                         cache_prefix=bool(body.get("cache_prefix")),
                         deadline=self._deadline(body),
+                        # The engine parents its phase spans on the
+                        # server span: one trace id from the router's
+                        # ingress down to per-chunk decode spans.
+                        span=tracing.SpanContext(
+                            span.trace_id, span.span_id
+                        ),
+                        tenant=peer_common_name(self) or "",
                     )
                     span.attrs.update(
                         prompt_tokens=len(req.tokens),

@@ -601,6 +601,49 @@ class TestSimulation:
         finally:
             b.close()
 
+    def test_decision_journal_records_acts_and_holds(self, sim):
+        """ISSUE 9 satellite: every evaluation that wants to act leaves
+        an `autoscale.decision` flight-recorder row carrying the
+        FleetSnapshot it decided on — including evaluations HELD by a
+        cooldown gate, so "why did (or didn't) it scale?" is
+        answerable from `oimctl events` alone."""
+        events.clear_all()
+        s = sim()
+
+        def decisions():
+            return [
+                e for e in events.all_events()
+                if e.kind == "autoscale.decision"
+            ]
+
+        s.tick()  # bootstrap to min — itself a journaled decision
+        n0 = len(decisions())
+        s.tick(busy_per_backend=20)  # acts: scale out
+        acted = decisions()[n0:]
+        assert any(
+            e.fields["direction"] == "out" and e.fields["held"] == ""
+            for e in acted
+        ), [e.fields for e in acted]
+        row = acted[-1].fields
+        for key in ("count", "reason", "utilization", "busy",
+                    "capacity", "replicas", "high_watermark",
+                    "low_watermark"):
+            assert key in row, row
+        assert row["utilization"] > row["high_watermark"]
+        # Act once more (tick advanced the clock past the cooldown),
+        # then re-evaluate WITHOUT advancing it: still overloaded, but
+        # the fresh scale-out cooldown holds the action — journaled as
+        # held.
+        s.offer(20)
+        s.autoscaler.evaluate_once()
+        n1 = len(decisions())
+        s.offer(20)
+        s.autoscaler.evaluate_once()
+        held = decisions()[n1:]
+        assert any(e.fields["held"] == "cooldown" for e in held), (
+            [e.fields for e in held]
+        )
+
     def test_scale_in_drain_sequence_and_least_loaded_pick(self, sim):
         """The scale-in contract (doc/serving.md): discovery withdrawn
         BEFORE the drain-stop, unmap after, record dropped last — and
