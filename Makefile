@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-chaos test-autoscale lint lint-metrics agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-autoscale lint lint-metrics agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -73,6 +73,22 @@ test-serve:
 	  --passes lock-discipline,resource-lifecycle --roots oim_tpu/serve
 	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_serve_pipeline.py -q -m "not slow" -p no:cacheprovider
+
+# Paged KV cache (ISSUE 10): the paged-vs-dense token-identical
+# exactness matrix (greedy/sampled/spec-decode/draft-model/prefix-hit/
+# mid-stream admission x dense/MoE, pipeline depth 1 and 2), the block
+# allocator's refcount/CoW units, shared-block-immutability witnesses,
+# OOM-of-blocks backpressure, and the zero-leaked-blocks chaos cycles.
+# Nominal ~30s; the cap carries the box's 2-3x CPU-quota headroom.
+# Also runs the oimlint lock-discipline + resource-lifecycle passes
+# over the serve plane AND ops/ (the paged gather/scatter helpers) so
+# the allocator's lock ownership stays analyzer-clean.
+test-serve-paged:
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,resource-lifecycle \
+	  --roots oim_tpu/serve,oim_tpu/ops
+	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_serve_paged.py -q -m "not slow" -p no:cacheprovider
 
 # Serve-plane fault tolerance (chaos marker): the splice-failover soak
 # (backend killed mid-stream at 20% over 40+ cycles, token-identical

@@ -1022,21 +1022,26 @@ def _serve_diagnostics(extras, on_tpu, cfg, params) -> None:
             os.environ.get("OIM_BENCH_SERVE_AB_PAIRS", "1" if on_tpu else "3")
         ))
 
-        def _leg(depth):
-            """One A/B leg: the identical workload at the given
-            pipeline depth on the same warm engine; returns (ordered
-            per-request token lists, tok/s)."""
-            engine.set_pipeline_depth(depth)
+        def _engine_leg(e):
+            """One timed leg of the standard workload on a warm
+            engine; returns (ordered per-request token lists, tok/s).
+            Shared by the pipeline A/B (via _leg) and the paged-vs-
+            dense A/B below, so the two comparisons measure with ONE
+            harness."""
             t0 = time.perf_counter()
             rids_l = [
-                engine.submit(
-                    GenRequest(tokens=p, max_new_tokens=new_tokens)
-                )
+                e.submit(GenRequest(tokens=p, max_new_tokens=new_tokens))
                 for p in prompts
             ]
-            results_l = engine.run()
+            results_l = e.run()
             dt_l = time.perf_counter() - t0
             return [results_l[r] for r in rids_l], round(generated / dt_l)
+
+        def _leg(depth):
+            """One pipeline-A/B leg: the identical workload at the
+            given pipeline depth on the same warm engine."""
+            engine.set_pipeline_depth(depth)
+            return _engine_leg(engine)
 
         # Exactness, checked on the real flagship model too: every
         # pipelined and serial leg must agree token-for-token (greedy)
@@ -1126,6 +1131,81 @@ def _serve_diagnostics(extras, on_tpu, cfg, params) -> None:
                 f"bench: serving repeats {runs} tok/s "
                 f"(intra-process spread {100 * spread:.0f}%)"
             )
+
+        # Paged-KV cache A/B (ISSUE 10): the same workload through a
+        # paged engine at EQUAL concurrency, interleaved with dense
+        # control legs on the still-warm plain engine (the pipeline
+        # A/B's median discipline — single back-to-back pairs measure
+        # the box's CPU-quota swings, not the gather).  Throughput
+        # parity is the bar here; the paged WIN is the capacity probe
+        # below (more live slots per fixed HBM), per the CPU-backend
+        # caveat in doc/operations.md.
+        paged_engine = Engine(
+            params, cfg, n_slots=8, max_len=512,
+            chunk=32 if on_tpu else 4,
+            prompt_buckets=(128,), kv_block=64,
+        )
+        paged_engine.warmup()
+        paged_runs, dense_runs, paged_mismatch = [], [], 0
+        for _ in range(ab_pairs):
+            toks_pg, tps_pg = _engine_leg(paged_engine)
+            toks_dn, tps_dn = _engine_leg(engine)
+            paged_runs.append(tps_pg)
+            dense_runs.append(tps_dn)
+            paged_mismatch += sum(
+                a != b for a, b in zip(toks_pg, toks_dn)
+            )
+        del paged_engine
+        extras["serve_tok_per_s_paged"] = round(
+            statistics.median(paged_runs)
+        )
+        extras["serve_tok_per_s_paged_dense_ctl"] = round(
+            statistics.median(dense_runs)
+        )
+        extras["serve_paged_mismatch_reqs"] = paged_mismatch
+        log(
+            f"bench: paged serving {extras['serve_tok_per_s_paged']} "
+            f"tok/s median vs dense control "
+            f"{extras['serve_tok_per_s_paged_dense_ctl']} "
+            f"({ab_pairs} interleaved pair(s), {paged_mismatch} "
+            f"mismatched requests)"
+        )
+
+        # The capacity lever: max concurrent slots at a FIXED
+        # cache-memory budget.  The paged pool here holds exactly what
+        # a 4-slot dense cache holds (4 x 512 rows); requests reserve
+        # their worst case block-rounded (~128 rows), so one admission
+        # wave seats 4x the dense count — the number BENCH_* tracks
+        # (more live slots per chip = more users per fleet), where
+        # tok/s alone would miss the win entirely.  Untimed, so no
+        # warmup: the probe counts slots, not seconds.
+        dense_equiv_slots = 4
+        cap_engine = Engine(
+            params, cfg, n_slots=16, max_len=512,
+            chunk=32 if on_tpu else 4, prompt_buckets=(128,),
+            kv_block=64, kv_blocks=dense_equiv_slots * (512 // 64),
+        )
+        cap_rids = [
+            cap_engine.submit(GenRequest(
+                tokens=[(3 * i + j) % cfg.vocab_size for j in range(64)],
+                max_new_tokens=8,
+            ))
+            for i in range(16)
+        ]
+        cap_engine.step()  # one admission wave against the block pool
+        extras["serve_kv_capacity_slots"] = (
+            cap_engine.stats()["active_slots"]
+        )
+        extras["serve_kv_capacity_slots_dense"] = dense_equiv_slots
+        cap_results = cap_engine.run()  # drain through backpressure
+        assert all(len(cap_results[r]) == 8 for r in cap_rids)
+        assert cap_engine.stats()["kv_blocks_used"] == 0  # zero leaks
+        del cap_engine
+        log(
+            f"bench: paged capacity {extras['serve_kv_capacity_slots']} "
+            f"concurrent slots vs {dense_equiv_slots} dense at the same "
+            f"cache budget (4 x 512 rows)"
+        )
 
         if not on_tpu:
             return

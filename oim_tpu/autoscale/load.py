@@ -34,6 +34,13 @@ _DEFAULTS: dict[str, Any] = {
     "queue_depth": 0,
     "active_slots": 0,
     "total_slots": 0,
+    # Paged-KV headroom (ISSUE 10; zeros from dense engines and from
+    # publishers predating the fields — the tolerant-decode defaults):
+    # which replica is out of CACHE, not just out of slots.
+    "kv_blocks_total": 0,
+    "kv_blocks_free": 0,
+    "kv_blocks_shared": 0,
+    "kv_fragmentation": 0.0,
     "token_rate": 0.0,
     "shed_queue_full": 0,
     "shed_deadline": 0,

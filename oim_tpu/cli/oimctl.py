@@ -167,7 +167,8 @@ def _print_top(
     utilization the autoscaler's band policy acts on."""
     print(
         f"{'BACKEND':<28} {'HEALTHY':<8} {'QUEUE':>6} {'ACTIVE':>7} "
-        f"{'SLOTS':>6} {'TOK/S':>9} {'SHED q/d/b':>12} BROWNOUT"
+        f"{'SLOTS':>6} {'TOK/S':>9} {'KV f/s/t':>12} {'SHED q/d/b':>12} "
+        f"BROWNOUT"
     )
     busy = capacity = 0.0
     for bid, healthy, load in rows:
@@ -176,6 +177,17 @@ def _print_top(
         s = load.get("total_slots", 0)
         busy += q + a
         capacity += s
+        kv_total = load.get("kv_blocks_total", 0)
+        # free/shared/total paged-KV blocks + fragmentation % — the
+        # replica's cache headroom (admissions defer on exhaustion) and
+        # how much of it is allocated-but-idle tail; dense engines
+        # report no pool.
+        kv = (
+            f"{load.get('kv_blocks_free', 0)}/"
+            f"{load.get('kv_blocks_shared', 0)}/{kv_total} "
+            f"{load.get('kv_fragmentation', 0.0):.0%}"
+            if kv_total else "-"
+        )
         shed = (
             f"{load.get('shed_queue_full', 0)}/"
             f"{load.get('shed_deadline', 0)}/"
@@ -184,7 +196,7 @@ def _print_top(
         print(
             f"{bid[:28]:<28} {'yes' if healthy else 'NO':<8} {q:>6} "
             f"{a:>7} {s:>6} {load.get('token_rate', 0.0):>9.1f} "
-            f"{shed:>12} {'yes' if load.get('brownout') else '-'}"
+            f"{kv:>12} {shed:>12} {'yes' if load.get('brownout') else '-'}"
         )
     util = busy / capacity if capacity else 0.0
     print(

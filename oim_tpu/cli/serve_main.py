@@ -213,6 +213,21 @@ def build_parser() -> argparse.ArgumentParser:
         "pays; per-token/head scales)",
     )
     p.add_argument(
+        "--kv-block", type=int, default=0, metavar="T",
+        help="paged KV cache with T-token blocks (0 = dense per-slot "
+        "regions): HBM is reserved per request's worst case instead of "
+        "n_slots x max_len, prefix-cache hits alias blocks copy-free "
+        "across concurrent requests, and admission backpressures on "
+        "block exhaustion — raise --n-slots above the dense-equivalent "
+        "count to cash the capacity in (doc/serving.md 'Paged KV "
+        "cache'); T must divide --max-len",
+    )
+    p.add_argument(
+        "--kv-blocks", type=int, default=0, metavar="N",
+        help="paged pool size in blocks (0 = the dense cache's "
+        "footprint, n_slots x max_len / --kv-block)",
+    )
+    p.add_argument(
         "--bootstrap", default="",
         help="tpu-bootstrap.json path (default: $TPU_BOOTSTRAP when set)",
     )
@@ -444,6 +459,8 @@ def make_engine(args):
         pipeline_depth=args.pipeline_depth,
         brownout_max_tokens=args.brownout_max_tokens,
         request_ring=args.request_ring,
+        kv_block=args.kv_block,
+        kv_blocks=args.kv_blocks,
     )
 
 

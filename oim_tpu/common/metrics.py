@@ -452,6 +452,24 @@ SERVE_QUEUE_DEPTH = _registry.gauge(
     "backlog the autoscaler's utilization counts as busy work).",
     ("engine",),
 )
+SERVE_KV_BLOCKS = _registry.gauge(
+    "oim_serve_kv_blocks",
+    "Paged-KV pool occupancy by block state: free = allocatable now "
+    "(the engine's real admission headroom — admissions defer, not "
+    "crash, when a request's worst case exceeds it), used = held by at "
+    "least one slot or prefix-cache entry, shared = aliased by more "
+    "than one owner (HBM the fleet would otherwise hold in duplicate). "
+    "Absent on dense (non-paged) engines.",
+    ("engine", "state"),
+)
+SERVE_PREFIX_BYTES_SAVED = _registry.counter(
+    "oim_serve_prefix_bytes_saved_total",
+    "KV bytes prefix-cache hits ALIASED instead of copying (paged "
+    "engines: full blocks shared copy-free into the admitted slot's "
+    "table).  The copy-on-write duplicate of a partially-covered last "
+    "block is a real copy and does not count.",
+    ("engine",),
+)
 AUTOSCALE_DESIRED = _registry.gauge(
     "oim_autoscale_desired_replicas",
     "Replica count the autoscaler's last evaluation wanted the fleet "

@@ -1,0 +1,103 @@
+"""Paged-KV gather/scatter primitives (the vLLM PagedAttention layout).
+
+The serving engine's paged cache is a global pool of fixed-size blocks
+``[n_layers, n_blocks, block_size, kv_heads, head_dim]`` plus a
+host-managed per-slot block table: logical position ``p`` of slot ``s``
+lives at pool row ``table[s, p // block_size] * block_size +
+p % block_size``.  These helpers are the only code that knows that
+mapping on the device side:
+
+- ``paged_store`` scatters freshly-projected K/V rows into the pool
+  through a block table (quantizing when the cache is int8), with the
+  OUT-OF-BOUNDS sentinel block id ``n_blocks`` dropping the write —
+  padding rows and freed slots write nowhere instead of corrupting a
+  reallocated block.
+- ``paged_view`` gathers one contiguous per-slot view
+  ``[B, n_tables * block_size, kv_heads, head_dim]`` back out, which is
+  exactly the dense slot region shape when ``n_tables * block_size ==
+  max_len`` — the engine's attention math then runs unchanged on either
+  layout, which is what makes paged output token-identical to dense.
+
+Static shapes throughout (XLA compiles one program regardless of which
+blocks a slot owns); allocation policy — refcounts, copy-on-write,
+prefix aliasing — is host-side bookkeeping in the engine, never traced.
+
+No Pallas kernel yet: on the XLA backends this targets, the gather
+materializes the same bytes attention was going to read anyway, and the
+engine keeps the dense path selectable for the regimes where the gather
+loses (ROADMAP pairs this layout with a flash-decode kernel over paged
+blocks as the follow-up).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from oim_tpu.ops.quant import quantize_int8
+
+
+def _flat_indices(tables, starts, t: int, block_size: int):
+    """Pool-flat row index for ``t`` consecutive logical positions per
+    row: tables [B, n_tables] (sentinel entry = n_blocks), starts [B]
+    → flat [B, t] into the block-flattened pool.  Sentinel blocks map
+    past the pool edge, so a ``mode="drop"`` scatter discards them."""
+    pos = starts[:, None] + jnp.arange(t)[None, :]  # [B, t]
+    blk = jnp.take_along_axis(tables, pos // block_size, axis=1)
+    return blk * block_size + pos % block_size
+
+
+def paged_store(cache, scale, new, tables, starts):
+    """Write ``new`` [B, t, KVH, hd] at logical positions ``starts``
+    [B] .. ``starts + t - 1`` through ``tables`` [B, n_tables] into the
+    one-layer pool ``cache`` [n_blocks, block_size, KVH, hd] —
+    quantizing when the cache is int8 (``scale`` [n_blocks, block_size,
+    KVH] is not None).  Rows whose table entry is the sentinel
+    ``n_blocks`` (padding admissions, freed slots) index past the pool
+    and are dropped.  The paged counterpart of the engine's
+    ``_slot_store``."""
+    n_blocks, block_size = cache.shape[0], cache.shape[1]
+    flat = _flat_indices(tables, starts, new.shape[1], block_size)
+    rows = cache.reshape(n_blocks * block_size, *cache.shape[2:])
+    if scale is None:
+        rows = rows.at[flat].set(new.astype(cache.dtype), mode="drop")
+        return rows.reshape(cache.shape), None
+    q, s = quantize_int8(new)
+    rows = rows.at[flat].set(q, mode="drop")
+    srows = scale.reshape(n_blocks * block_size, *scale.shape[2:])
+    srows = srows.at[flat].set(s, mode="drop")
+    return rows.reshape(cache.shape), srows.reshape(scale.shape)
+
+
+def paged_view(cache, scale, tables):
+    """Gather each row's blocks into one contiguous per-slot view:
+    cache [n_blocks, block_size, ...] + tables [B, n_tables] →
+    [B, n_tables * block_size, ...] (plus the matching scale view, or
+    None).  Logical position ``p`` of row ``b`` lands at view row
+    ``p`` — the dense slot-region layout — so the engine's causal mask
+    and score math apply verbatim.  Sentinel entries clamp to the last
+    pool block; the rows they produce are garbage PAST every row's
+    frontier, masked by the same ``k_pos <= q_pos`` test that masks
+    dense garbage."""
+    n_blocks = cache.shape[0]
+    b, n_tables = tables.shape
+    idx = jnp.minimum(tables, n_blocks - 1)
+    view = jnp.take(cache, idx, axis=0).reshape(
+        b, n_tables * cache.shape[1], *cache.shape[2:]
+    )
+    if scale is None:
+        return view, None
+    sview = jnp.take(scale, idx, axis=0).reshape(
+        b, n_tables * scale.shape[1], *scale.shape[2:]
+    )
+    return view, sview
+
+
+def copy_block(pool, src, dst):
+    """Copy one block of a stacked pool [n_layers, n_blocks, ...] —
+    the device half of copy-on-write: the allocator picks ``dst`` fresh
+    and the engine repoints the diverging slot's table at it, so the
+    shared ``src`` is never written again.  ``src``/``dst`` are traced
+    scalars (one compile covers every block pair)."""
+    row = jax.lax.dynamic_index_in_dim(pool, src, 1, keepdims=False)
+    return jax.lax.dynamic_update_index_in_dim(pool, row, dst, 1)

@@ -6,8 +6,22 @@ the inference counterpart of ``cli/train_main.py``: it turns the decode
 path (``models/decode.py``) into a multi-request server.
 """
 
-from oim_tpu.serve.engine import Engine, GenRequest, SlotCache
+from oim_tpu.serve.engine import (
+    BlockAllocator,
+    Engine,
+    GenRequest,
+    PagedCache,
+    SlotCache,
+)
 from oim_tpu.serve.registration import ServeRegistration
 from oim_tpu.serve.router import Router
 
-__all__ = ["Engine", "GenRequest", "Router", "ServeRegistration", "SlotCache"]
+__all__ = [
+    "BlockAllocator",
+    "Engine",
+    "GenRequest",
+    "PagedCache",
+    "Router",
+    "ServeRegistration",
+    "SlotCache",
+]

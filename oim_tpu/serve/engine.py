@@ -1,13 +1,20 @@
 """Continuous-batching inference engine over a slot-based KV cache.
 
-TPU-first design (vs. the GPU-idiomatic "paged attention" approach of
-dynamic page tables + gather kernels):
+TPU-first design:
 
-- **Slots, not pages.**  The cache is ``[n_layers, n_slots, max_len,
-  kv_heads, head_dim]`` — one contiguous region per request slot, with a
-  per-slot ``lengths`` vector doing the work of a page table.  Static
-  shapes mean XLA compiles exactly one decode program; admission and
-  completion never reshape anything.
+- **Slots — dense or paged.**  The dense cache is ``[n_layers,
+  n_slots, max_len, kv_heads, head_dim]`` — one contiguous region per
+  request slot, with a per-slot ``lengths`` vector doing the work of a
+  page table.  Static shapes mean XLA compiles exactly one decode
+  program; admission and completion never reshape anything.
+  ``kv_block > 0`` switches to a **paged** cache (``PagedCache``): a
+  global pool of fixed-size blocks plus a host-side refcounted
+  allocator and per-slot block table — same static shapes, same
+  attention math on a gathered view, token-identical output — so HBM
+  is reserved per request instead of per slot × max_len and
+  prefix-cache entries alias their blocks copy-free across concurrent
+  requests (copy-on-write on the first divergent write).  The capacity
+  lever: more live slots per chip at the same cache budget.
 - **Continuous batching.**  New requests are admitted into free slots
   while other slots keep decoding: ``admit_batch`` prefills every
   admission sharing a prompt bucket in ONE dispatch (buckets bound the
@@ -89,6 +96,7 @@ from oim_tpu.models.decode import (
     nucleus_min_p_mask,
     truncate_logits,
 )
+from oim_tpu.ops.paged import copy_block, paged_store, paged_view
 from oim_tpu.ops.quant import (
     dequantize_named,
     make_kv_buffers,
@@ -160,17 +168,21 @@ def serve_param_shardings(params: dict, cfg: TransformerConfig, mesh):
     }
 
 
-def cache_shardings(cache: SlotCache, mesh):
-    """SlotCache-shaped NamedShardings: k/v (and their int8 scales)
+def cache_shardings(cache, mesh):
+    """Cache-shaped NamedShardings: k/v (and their int8 scales)
     sharded over ``tp`` on the kv-heads axis — attention is fully
     head-parallel, so each tp shard owns its heads' cache rows and the
     only tp collective in the decode path is the psum GSPMD inserts for
-    the wo/w_out contractions."""
+    the wo/w_out contractions.  The kv-heads axis sits at index 3 in
+    both layouts ([L, slots, max_len, KVH, hd] dense, [L, blocks,
+    block_size, KVH, hd] paged), so one spec serves either; only the
+    wrapper type differs."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     kv = NamedSharding(mesh, P(None, None, None, "tp", None))
     scale = NamedSharding(mesh, P(None, None, None, "tp"))
-    return SlotCache(
+    cls = type(cache)
+    return cls(
         k=kv,
         v=kv,
         lengths=NamedSharding(mesh, P()),
@@ -222,6 +234,151 @@ class SlotCache:
         return self.k.shape[2]
 
 
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class PagedCache:
+    """Paged KV cache: a global pool of fixed-size blocks shared by
+    every slot (the vLLM PagedAttention layout, ISSUE 10).
+
+    ``k``/``v``: [n_layers, n_blocks, block_size, kv_heads, head_dim];
+    ``lengths``: [n_slots] int32 — valid positions per slot, exactly
+    the dense cache's frontier semantics.  ``k_scale``/``v_scale``:
+    per-(token, head) f32 scales [n_layers, n_blocks, block_size,
+    kv_heads] when int8, else None.  Which pool blocks belong to which
+    slot lives OUTSIDE this pytree: the engine's host-side
+    ``BlockAllocator`` + block table, pushed to the device as a
+    [n_slots, n_tables] int32 array each dispatch (sentinel entry
+    ``n_blocks`` = unallocated).  Memory is therefore reserved per
+    REQUEST (rounded up to blocks), not per slot × max_len — the
+    capacity lever: a pool sized like a 4-slot dense cache admits as
+    many concurrent slots as actually fit, and prefix-cache entries
+    alias their blocks into every concurrent reader copy-free.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
+
+    @classmethod
+    def create(
+        cls,
+        cfg: TransformerConfig,
+        n_slots: int,
+        n_blocks: int,
+        block_size: int,
+        quantized: bool = False,
+    ) -> "PagedCache":
+        shape = (
+            cfg.n_layers, n_blocks, block_size, cfg.kv_heads, cfg.head_dim
+        )
+        k, v, ks, vs = make_kv_buffers(shape, cfg.compute_dtype, quantized)
+        return cls(
+            k=k, v=v, lengths=jnp.zeros((n_slots,), jnp.int32),
+            k_scale=ks, v_scale=vs,
+        )
+
+    @property
+    def n_slots(self) -> int:
+        return self.lengths.shape[0]
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+
+class BlockAllocator:
+    """Host-side refcounted allocator over the paged pool's block ids.
+
+    Pure bookkeeping (never traced): the engine calls it under its own
+    lock, so there is no lock here.  ``alloc`` is all-or-nothing — an
+    admission either gets every block its worst case needs or stays
+    queued (OOM-of-blocks is queue backpressure, never a crash or a
+    partially-allocated slot).  Refcounts implement copy-free sharing:
+    a prefix-cache entry and every slot aliasing it each hold one ref
+    on the shared blocks, and the last ``decref`` returns a block to
+    the free list.  Copy-on-write is the engine's job (pick a fresh
+    block, device-copy, repoint the table); the allocator only
+    guarantees a shared block (ref > 1) is never on the free list.
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 1:
+            raise ValueError(f"need n_blocks >= 1, got {n_blocks}")
+        self.n_blocks = n_blocks
+        self._free: list[int] = list(range(n_blocks - 1, -1, -1))
+        self._refs = np.zeros((n_blocks,), np.int64)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.n_blocks - len(self._free)
+
+    @property
+    def shared_blocks(self) -> int:
+        """Blocks aliased by more than one owner (ref > 1) — each is
+        HBM the fleet would otherwise hold in duplicate."""
+        return int(np.sum(self._refs > 1))
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` fresh blocks at ref 1, or None (all-or-nothing)."""
+        if n < 0:
+            raise ValueError(f"need n >= 0, got {n}")
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._refs[ids] += 1
+        return ids
+
+    def exclusive(self, ids) -> int:
+        """How many of ``ids`` have exactly one owner — the blocks a
+        single decref would actually return to the pool (the eviction
+        policy's is-it-worth-dropping test)."""
+        return int(sum(1 for b in ids if self._refs[b] == 1))
+
+    def incref(self, ids) -> None:
+        for b in ids:
+            if self._refs[b] <= 0:
+                raise ValueError(f"incref of free block {b}")
+            self._refs[b] += 1
+
+    def decref(self, ids) -> int:
+        """Drop one ref per id; blocks hitting zero return to the free
+        list.  Returns how many were freed."""
+        freed = 0
+        for b in ids:
+            if self._refs[b] <= 0:
+                raise ValueError(f"decref of free block {b}")
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                self._free.append(int(b))
+                freed += 1
+        return freed
+
+
+def _cow_block(cache: PagedCache, src, dst):
+    """Device half of copy-on-write: duplicate block ``src`` into the
+    freshly-allocated ``dst`` across every pool (k/v and, when int8,
+    their scales).  The host repoints the diverging slot's table row
+    at ``dst`` and the shared ``src`` — still referenced by the prefix
+    cache and any concurrent readers — is never written again."""
+    cp = lambda pool: (  # noqa: E731
+        None if pool is None else copy_block(pool, src, dst)
+    )
+    return PagedCache(
+        cp(cache.k), cp(cache.v), cache.lengths,
+        cp(cache.k_scale), cp(cache.v_scale),
+    )
+
+
 def _slot_store(cache, scale, new, starts):
     """Per-slot write of ``new`` [B, t, KVH, hd] at ``starts`` [B] —
     quantizing when the cache is int8 (scale is not None)."""
@@ -240,7 +397,7 @@ def _slot_store(cache, scale, new, starts):
 
 def _slot_attention(
     x, lp, k_cache, v_cache, k_scale, v_scale, starts,
-    cfg: TransformerConfig,
+    cfg: TransformerConfig, tables=None,
 ):
     """Cached attention with per-slot start positions.
 
@@ -248,11 +405,21 @@ def _slot_attention(
     [B, max_len, KVH] (int8 cache) or None; starts: [B].  Generalizes
     ``decode._cached_attention`` (scalar start) to a vector — the one
     primitive continuous batching needs.
+
+    With ``tables`` [B, n_tables] (the paged layout), k_cache/v_cache
+    are instead the ONE-LAYER POOL [n_blocks, block_size, KVH, hd]
+    (scales [n_blocks, block_size, KVH]): the store scatters through
+    the table (sentinel entries drop — padding rows and freed slots
+    write nowhere) and attention runs on the gathered per-row view,
+    which has exactly the dense region shape because the engine pins
+    ``n_tables * block_size == max_len``.  Score math, masking, and
+    softmax are shared code on either layout — the paged engine's
+    token-identical-to-dense property is by construction, not by a
+    parallel implementation.
     """
     b, t, _ = x.shape
     h, hd, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     group = h // kvh
-    max_len = k_cache.shape[1]
 
     normed = _rmsnorm(x, lp["attn_norm"], cfg)
     q = jnp.einsum("btd,dn->btn", normed, lp["wq"])
@@ -271,14 +438,23 @@ def _slot_attention(
     q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
     k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
-    k_cache, k_scale = _slot_store(k_cache, k_scale, k, starts)
-    v_cache, v_scale = _slot_store(v_cache, v_scale, v, starts)
+    if tables is None:
+        k_cache, k_scale = _slot_store(k_cache, k_scale, k, starts)
+        v_cache, v_scale = _slot_store(v_cache, v_scale, v, starts)
+        k_view, ks_view = k_cache, k_scale
+        v_view, vs_view = v_cache, v_scale
+    else:
+        k_cache, k_scale = paged_store(k_cache, k_scale, k, tables, starts)
+        v_cache, v_scale = paged_store(v_cache, v_scale, v, tables, starts)
+        k_view, ks_view = paged_view(k_cache, k_scale, tables)
+        v_view, vs_view = paged_view(v_cache, v_scale, tables)
+    max_len = k_view.shape[1]
 
     q_g = q.reshape(b, t, kvh, group, hd)
     scores = jnp.einsum(
         "bqhgd,bkhd->bhgqk",
         q_g.astype(jnp.float32),
-        _load_kv(k_cache, k_scale),
+        _load_kv(k_view, ks_view),
     ) / (hd**0.5)
     # Causal per slot: query at global position p attends to rows <= p of
     # its own region; rows past the slot's frontier are invalid.  Rows
@@ -292,7 +468,7 @@ def _slot_attention(
     scores = jnp.where(keep, scores, _NEG_BIG)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
-        "bhgqk,bkhd->bqhgd", probs, _load_kv(v_cache, v_scale)
+        "bhgqk,bkhd->bqhgd", probs, _load_kv(v_view, vs_view)
     ).astype(x.dtype)
     out = out.reshape(b, t, h * hd)
     return x + jnp.einsum("btn,nd->btd", out, lp["wo"]).astype(x.dtype), (
@@ -312,6 +488,10 @@ def _hidden_slots(params, tokens, kv, starts, cfg):
 
     ``kv`` = (k, v, k_scale, v_scale): [n_layers, B, max_len, KVH, hd]
     values with per-(token, head) scales (or None when full-precision).
+    A FIVE-tuple (k, v, k_scale, v_scale, tables) is the paged layout:
+    pools [n_layers, n_blocks, block_size, KVH, hd] plus the per-row
+    block table [B, n_tables], threaded through the scan untouched —
+    ``_slot_attention`` scatters/gathers through it per layer.
     MoE routing follows ``models/decode.py``: drop-free per-token top-k
     (``_moe_exact``) on prefill AND incremental steps — per-token routing
     is what makes engine results independent of padding, batch packing,
@@ -320,10 +500,12 @@ def _hidden_slots(params, tokens, kv, starts, cfg):
     cfg = replace(cfg, use_pallas=False)
     x = embed_lookup(params["wte"], tokens, cfg)
     flat = _flat_layer_params(params, cfg)
+    paged = len(kv) == 5
     quantized = kv[2] is not None
 
     def layer_step(carry, scanned):
-        x, k_all, v_all, ks_all, vs_all = carry
+        x, k_all, v_all, ks_all, vs_all = carry[:5]
+        tables = carry[5] if paged else None
         lp, layer = scanned
         lp = maybe_dequantize_weights(lp, cfg.compute_dtype)  # weight-int8
         # Stacked cache rides the CARRY with per-layer dynamic slicing —
@@ -340,7 +522,7 @@ def _hidden_slots(params, tokens, kv, starts, cfg):
             x, lp, idx(k_all), idx(v_all),
             idx(ks_all) if quantized else None,
             idx(vs_all) if quantized else None,
-            starts, cfg,
+            starts, cfg, tables=tables,
         )
         k_all, v_all = put(k_all, k_l), put(v_all, v_l)
         if quantized:
@@ -349,7 +531,8 @@ def _hidden_slots(params, tokens, kv, starts, cfg):
             x = _moe_exact(x, lp, cfg)
         else:
             x, _ = _dense_mlp(x, lp, cfg)
-        return (x, k_all, v_all, ks_all, vs_all), None
+        out = (x, k_all, v_all, ks_all, vs_all)
+        return (out + (tables,) if paged else out), None
 
     (x, *kv), _ = jax.lax.scan(
         layer_step, (x, *kv), (flat, jnp.arange(cfg.n_layers))
@@ -404,7 +587,7 @@ def _sample_batched(
 
 
 def _admit_batch(
-    params, cache: SlotCache, history, tok_counts, gen_counts,
+    params, cache, row_tables, history, tok_counts, gen_counts,
     prompt_counts, full_rows, prompts, slots, starts,
     true_tails, temps, top_ps, min_ps, reps, press, freqs, keys,
     *, cfg, top_k, track_history, penalize,
@@ -412,6 +595,15 @@ def _admit_batch(
     """Prefill a whole GROUP of admissions in one dispatch and sample
     each one's first generated token.  Returns
     (cache, first_tokens [S], first_logprobs [S]).
+
+    ``cache`` is a SlotCache or a PagedCache (different pytree
+    structures → separate traces; the branch below is trace-time
+    static).  ``row_tables`` [S, n_tables] is the paged layout's
+    per-admission block table — the target slot's freshly-built row
+    for live admissions, all-sentinel for padding rows (their writes
+    drop at the pool edge, the paged twin of the dense scatter's
+    out-of-bounds slot index) — and an unused [1, 1] dummy on dense
+    engines.
 
     ``history`` [n_slots, max_len] is the engine's device-side token
     record (speculative decoding's draft source); ``full_rows``
@@ -444,16 +636,35 @@ def _admit_batch(
     n_slots = cache.n_slots
     if track_history:
         history = history.at[slots].set(full_rows, mode="drop")
-    kv_full = (cache.k, cache.v, cache.k_scale, cache.v_scale)
-    row_src = jnp.minimum(slots, n_slots - 1)  # padding rows read slot-(-1)
-    kv_rows = jax.tree.map(lambda c: jnp.take(c, row_src, axis=1), kv_full)
-    x, kv_rows = _hidden_slots(params, prompts, kv_rows, starts, cfg)
-    k_all, v_all, ks_all, vs_all = jax.tree.map(
-        lambda c, u: c.at[:, slots].set(u, mode="drop"), kv_full, kv_rows
-    )
-    lengths = cache.lengths.at[slots].set(
-        starts + true_tails, mode="drop"
-    )
+    if isinstance(cache, PagedCache):
+        # No per-slot row extraction: every row reads and writes the
+        # GLOBAL pool through its own table (aliased prefix blocks are
+        # read copy-free by however many rows share them; writes land
+        # only in each row's freshly-allocated blocks — the host
+        # allocator never hands a shared block to a writer).
+        kv = (cache.k, cache.v, cache.k_scale, cache.v_scale, row_tables)
+        x, kv = _hidden_slots(params, prompts, kv, starts, cfg)
+        k_all, v_all, ks_all, vs_all = kv[:4]
+        lengths = cache.lengths.at[slots].set(
+            starts + true_tails, mode="drop"
+        )
+        new_cache = PagedCache(k_all, v_all, lengths, ks_all, vs_all)
+    else:
+        kv_full = (cache.k, cache.v, cache.k_scale, cache.v_scale)
+        # padding rows read slot-(-1)
+        row_src = jnp.minimum(slots, n_slots - 1)
+        kv_rows = jax.tree.map(
+            lambda c: jnp.take(c, row_src, axis=1), kv_full
+        )
+        x, kv_rows = _hidden_slots(params, prompts, kv_rows, starts, cfg)
+        k_all, v_all, ks_all, vs_all = jax.tree.map(
+            lambda c, u: c.at[:, slots].set(u, mode="drop"),
+            kv_full, kv_rows,
+        )
+        lengths = cache.lengths.at[slots].set(
+            starts + true_tails, mode="drop"
+        )
+        new_cache = SlotCache(k_all, v_all, lengths, ks_all, vs_all)
     last_h = jax.vmap(
         lambda row, t: jax.lax.dynamic_index_in_dim(
             row, t - 1, axis=0, keepdims=False
@@ -480,7 +691,7 @@ def _admit_batch(
             logits, temps, keys, top_k, top_ps, min_ps
         )
     return (
-        SlotCache(k_all, v_all, lengths, ks_all, vs_all),
+        new_cache,
         history,
         tok_counts,
         gen_counts,
@@ -516,9 +727,9 @@ def _inject_prefix(cache: SlotCache, entry, slot):
 
 
 def _decode_chunk(
-    params, cache: SlotCache, tok_counts, gen_counts, tokens, temps,
+    params, cache, tables, tok_counts, gen_counts, tokens, temps,
     top_ps, min_ps, reps, press, freqs, active, bases, counts,
-    *, cfg, chunk, top_k, penalize,
+    *, cfg, chunk, top_k, penalize, max_len,
 ):
     """Advance every active slot by ``chunk`` tokens in one dispatch.
 
@@ -527,6 +738,13 @@ def _decode_chunk(
     generated per request; tok_counts/gen_counts [S, V] +
     reps/press/freqs [S] drive the sampling penalties (neutral rows are
     exact no-ops).  Returns (cache, tok_counts, gen_counts, out, lps).
+    ``cache`` is a SlotCache or PagedCache; ``tables`` [n_slots,
+    n_tables] is the paged per-slot block table (a freed slot's
+    all-sentinel row drops its post-EOS garbage writes at the pool
+    edge, the paged twin of dense garbage staying confined to its own
+    region) and an unused dummy on dense engines.  ``max_len`` is the
+    logical per-slot capacity — a static partial kwarg because the
+    paged pool's shape no longer encodes it.
 
     Step ``i`` samples slot ``s`` with ``fold_in(bases[s], counts[s]+i)``
     — the key is a function of (request seed, absolute token index), so
@@ -535,7 +753,7 @@ def _decode_chunk(
     bounded waste, never a per-token readback) and their lengths clamp at
     the cache edge — masking beats dynamic batch shapes on TPU.
     """
-    max_len = cache.max_len
+    paged = isinstance(cache, PagedCache)
 
     def one(carry, i):
         kv, lengths, tok, tok_c, gen_c = carry
@@ -565,19 +783,23 @@ def _decode_chunk(
         return (kv, lengths, nxt, tok_c, gen_c), (nxt, lp)
 
     kv0 = (cache.k, cache.v, cache.k_scale, cache.v_scale)
+    if paged:
+        kv0 = kv0 + (tables,)
     (
-        (k_all, v_all, ks_all, vs_all), lengths, last_tok, tok_counts,
+        kv_out, lengths, last_tok, tok_counts,
         gen_counts,
     ), (out, lps) = jax.lax.scan(
         one,
         (kv0, cache.lengths, tokens, tok_counts, gen_counts),
         jnp.arange(chunk),
     )
+    k_all, v_all, ks_all, vs_all = kv_out[:4]
+    cls = PagedCache if paged else SlotCache
     # ``last_tok`` [S] (each slot's post-chunk latest token) stays on
     # device: the pipelined engine feeds it straight into the NEXT
     # dispatch so chunk N+1 never waits on chunk N's readback.
     return (
-        SlotCache(k_all, v_all, lengths, ks_all, vs_all),
+        cls(k_all, v_all, lengths, ks_all, vs_all),
         tok_counts,
         gen_counts,
         out.T,
@@ -675,9 +897,9 @@ def _verify_emit(
 
 
 def _decode_chunk_spec(
-    params, cache: SlotCache, history, tokens, temps, top_ps, min_ps,
+    params, cache, tables, history, tokens, temps, top_ps, min_ps,
     active, bases, counts,
-    *, cfg, chunk, draft_len, ngram, top_k,
+    *, cfg, chunk, draft_len, ngram, top_k, max_len,
 ):
     """``_decode_chunk`` with in-engine speculative decoding: each of the
     ``chunk`` sub-steps drafts ``draft_len`` tokens per slot by prompt
@@ -700,9 +922,11 @@ def _decode_chunk_spec(
 
     Returns (cache, history, out [S, chunk, L+1], lps [S, chunk, L+1],
     n_emit [S, chunk]) — the host consumes ``n_emit[s, i]`` tokens of
-    sub-step i's row.
+    sub-step i's row.  ``tables``/``max_len`` follow the
+    ``_decode_chunk`` contract (paged block table / static logical
+    capacity).
     """
-    max_len = cache.max_len
+    paged = isinstance(cache, PagedCache)
     n_drafts = draft_len
 
     def one(carry, i):
@@ -728,13 +952,17 @@ def _decode_chunk_spec(
         return (kv, lengths, tok_next, hist), (emitted, lps, n_emit)
 
     kv0 = (cache.k, cache.v, cache.k_scale, cache.v_scale)
-    ((k_all, v_all, ks_all, vs_all), lengths, last_tok, history), (
+    if paged:
+        kv0 = kv0 + (tables,)
+    (kv_out, lengths, last_tok, history), (
         out, lps, n_emit
     ) = jax.lax.scan(
         one, (kv0, cache.lengths, tokens, history), jnp.arange(chunk)
     )
+    k_all, v_all, ks_all, vs_all = kv_out[:4]
+    cls = PagedCache if paged else SlotCache
     return (
-        SlotCache(k_all, v_all, lengths, ks_all, vs_all),
+        cls(k_all, v_all, lengths, ks_all, vs_all),
         history,
         out.transpose(1, 0, 2),
         lps.transpose(1, 0, 2),
@@ -774,9 +1002,9 @@ def _admit_draft(
 
 
 def _decode_chunk_spec_model(
-    params, draft_params, cache: SlotCache, dcache: SlotCache,
+    params, draft_params, cache, dcache: SlotCache, tables,
     tokens, temps, top_ps, min_ps, active, bases, counts,
-    *, cfg, dcfg, chunk, draft_len, top_k,
+    *, cfg, dcfg, chunk, draft_len, top_k, max_len,
 ):
     """``_decode_chunk_spec`` with a TRAINED DRAFT MODEL instead of
     prompt lookup: each sub-step runs ``draft_len`` sequential greedy
@@ -800,8 +1028,14 @@ def _decode_chunk_spec_model(
     greedy output is verified equal to the target's own continuation,
     sampled slots emit one token from position-0 logits with the same
     fold_in keys.
+
+    The TARGET cache may be paged (``tables``/``max_len`` per the
+    ``_decode_chunk`` contract); the draft cache stays dense always —
+    it is small by design (a fraction of the target's layers × width),
+    so paging it would spend table-management complexity on the one
+    cache that is not the capacity bottleneck.
     """
-    max_len = cache.max_len
+    paged = isinstance(cache, PagedCache)
     n_drafts = draft_len
 
     def one(carry, i):
@@ -834,17 +1068,21 @@ def _decode_chunk_spec_model(
         return (kv, dkv, lengths, tok_next), (emitted, lps, n_emit)
 
     kv0 = (cache.k, cache.v, cache.k_scale, cache.v_scale)
+    if paged:
+        kv0 = kv0 + (tables,)
     dkv0 = (dcache.k, dcache.v, dcache.k_scale, dcache.v_scale)
     (
-        (k_all, v_all, ks_all, vs_all),
+        kv_out,
         (dk, dv, dks, dvs),
         lengths,
         last_tok,
     ), (out, lps, n_emit) = jax.lax.scan(
         one, (kv0, dkv0, cache.lengths, tokens), jnp.arange(chunk)
     )
+    k_all, v_all, ks_all, vs_all = kv_out[:4]
+    cls = PagedCache if paged else SlotCache
     return (
-        SlotCache(k_all, v_all, lengths, ks_all, vs_all),
+        cls(k_all, v_all, lengths, ks_all, vs_all),
         SlotCache(dk, dv, lengths, dks, dvs),
         out.transpose(1, 0, 2),
         lps.transpose(1, 0, 2),
@@ -1093,6 +1331,8 @@ class Engine:
         brownout_queue_fraction: float = 0.75,
         brownout_hold_s: float = 1.0,
         request_ring: int = 256,
+        kv_block: int = 0,
+        kv_blocks: int = 0,
     ):
         if pipeline_depth not in (1, 2):
             raise ValueError(
@@ -1105,6 +1345,44 @@ class Engine:
                 f"prefix_cache_size>=0; got {n_slots}, {max_len}, {chunk}, "
                 f"{prefix_cache_size}"
             )
+        # Paged KV cache (ISSUE 10): kv_block > 0 switches the cache
+        # from one contiguous max_len region per slot to a global pool
+        # of kv_block-token blocks + a host-side per-slot block table.
+        # max_len must divide into blocks exactly: the gathered per-row
+        # view is then the SAME [B, max_len, ...] shape the dense
+        # attention math sees, which is what keeps paged output
+        # token-identical to dense (bit-equal masked scores, not a
+        # parallel code path).  kv_blocks sizes the pool; 0 = the dense
+        # cache's footprint (n_slots × max_len rows) — the capacity win
+        # comes from raising n_slots above what that pool could hold at
+        # full length, since admissions reserve only each request's
+        # worst case (prompt + budget + spec headroom), block-rounded.
+        if kv_block < 0 or kv_blocks < 0:
+            raise ValueError(
+                f"need kv_block>=0 and kv_blocks>=0; got {kv_block}, "
+                f"{kv_blocks}"
+            )
+        self.paged = kv_block > 0
+        self.kv_block = kv_block
+        if self.paged:
+            if max_len % kv_block:
+                raise ValueError(
+                    f"kv_block={kv_block} must divide max_len={max_len} "
+                    f"(the block table covers the region exactly)"
+                )
+            self._n_tables = max_len // kv_block
+            if not kv_blocks:
+                kv_blocks = n_slots * self._n_tables
+            # A pool SMALLER than one full-length slot is legal (a
+            # short-request deployment can cap per-request length well
+            # under max_len); per-request fit is enforced in
+            # _validate, so an impossible request rejects at submit
+            # instead of deadlocking the queue.
+            if kv_blocks < 1:
+                raise ValueError(f"need kv_blocks >= 1, got {kv_blocks}")
+        elif kv_blocks:
+            raise ValueError("kv_blocks needs kv_block > 0")
+        self.kv_blocks = kv_blocks if self.paged else 0
         if spec_decode < 0 or (spec_decode and spec_ngram < 1):
             raise ValueError(
                 f"need spec_decode>=0 and spec_ngram>=1; got "
@@ -1243,9 +1521,49 @@ class Engine:
             if not name.endswith("_wscale")
         ))
         self.default_top_p = top_p
-        self._cache = SlotCache.create(
-            cfg, n_slots, max_len, quantized=kv_int8
-        )
+        self.max_len = max_len
+        if self.paged:
+            self._cache = PagedCache.create(
+                cfg, n_slots, self.kv_blocks, kv_block, quantized=kv_int8
+            )
+            # Host-side paging state, all mutated under self._lock: the
+            # refcounted allocator, the per-slot block table (sentinel
+            # kv_blocks = unallocated — OOB on the device, so a freed
+            # slot's garbage writes drop at the pool edge), and the
+            # dirty flag that rebuilds the device copy lazily at the
+            # next dispatch.
+            self._alloc = BlockAllocator(self.kv_blocks)
+            self._tables_host = np.full(
+                (n_slots, self._n_tables), self.kv_blocks, np.int32
+            )
+            self._tables_dirty = True
+            self._tables_dev = None
+            # Copy-on-write: one compile copies any (src, dst) block
+            # pair across all four pools (k/v and their scales).
+            self._cow = jax.jit(_cow_block, donate_argnums=(0,))
+            # Bytes of one KV row (k + v + scales, all layers): the
+            # unit the prefix-aliasing bytes-saved accounting counts.
+            itemsize = 1 if kv_int8 else jnp.dtype(
+                cfg.compute_dtype
+            ).itemsize
+            self._kv_row_bytes = 2 * cfg.n_layers * cfg.kv_heads * (
+                cfg.head_dim * itemsize + (4 if kv_int8 else 0)
+            )
+        else:
+            self._cache = SlotCache.create(
+                cfg, n_slots, max_len, quantized=kv_int8
+            )
+            self._alloc = None
+            self._tables_host = None
+            self._kv_row_bytes = 0
+        # Dense engines pass this inert dummy where the paged layout
+        # passes its block table (one jit signature for both).
+        self._tables_dummy = jnp.zeros((1, 1), jnp.int32)
+        # Prefix-aliasing + backpressure accounting (host-side, under
+        # self._lock like the hit/miss counters).
+        self.prefix_injects = 0
+        self.prefix_bytes_saved = 0
+        self.kv_admit_deferrals = 0
         # Model-drafted speculation: the draft model keeps its OWN slot
         # cache (full precision — it is small) in lockstep with the
         # target's lengths; prompt lookup's device-side history is then
@@ -1303,7 +1621,9 @@ class Engine:
             partial(_admit_batch, cfg=cfg, top_k=top_k,
                     track_history=bool(spec_decode) and draft_cfg is None,
                     penalize=penalties),
-            donate_argnums=(1, 2, 3, 4),
+            # cache, history, tok_counts, gen_counts (row_tables at 2
+            # is NOT donated: dense engines pass a shared dummy).
+            donate_argnums=(1, 3, 4, 5),
         )
         self._admit_d = (
             jax.jit(
@@ -1319,10 +1639,19 @@ class Engine:
         from collections import OrderedDict
 
         self.prefix_cache_size = prefix_cache_size
+        # Entry value: dense = (kv pytree copy, true rows); paged =
+        # (tuple of pool block ids the entry holds one ref each on,
+        # true rows — always block-aligned).  Paged entries cost no
+        # extra HBM at all: the blocks ARE the slot's prefilled blocks,
+        # kept alive by the refcount, aliased read-only into every
+        # later slot that shares the prefix.
         self._prefix_cache: OrderedDict = OrderedDict()
         self._extract = {
             b: jax.jit(partial(_extract_prefix, rows=b))
-            for b in (self.prompt_buckets if prefix_cache_size else ())
+            for b in (
+                self.prompt_buckets
+                if prefix_cache_size and not self.paged else ()
+            )
         }
         self._inject = jax.jit(_inject_prefix, donate_argnums=(0,))
         self.prefix_hits = 0
@@ -1331,21 +1660,22 @@ class Engine:
         if spec_decode and draft_cfg is not None:
             self._decode = jax.jit(
                 partial(_decode_chunk_spec_model, cfg=cfg, dcfg=draft_cfg,
-                        chunk=chunk, draft_len=spec_decode, top_k=top_k),
-                donate_argnums=(2, 3),
+                        chunk=chunk, draft_len=spec_decode, top_k=top_k,
+                        max_len=max_len),
+                donate_argnums=(2, 3),  # target + draft caches
             )
         elif spec_decode:
             self._decode = jax.jit(
                 partial(_decode_chunk_spec, cfg=cfg, chunk=chunk,
                         draft_len=spec_decode, ngram=spec_ngram,
-                        top_k=top_k),
-                donate_argnums=(1, 2),
+                        top_k=top_k, max_len=max_len),
+                donate_argnums=(1, 3),  # cache + history
             )
         else:
             self._decode = jax.jit(
                 partial(_decode_chunk, cfg=cfg, chunk=chunk, top_k=top_k,
-                        penalize=penalties),
-                donate_argnums=(1, 2, 3),
+                        penalize=penalties, max_len=max_len),
+                donate_argnums=(1, 3, 4),  # cache + the penalty counts
             )
         self.spec_drafted = 0
         self.spec_accepted = 0
@@ -1497,9 +1827,14 @@ class Engine:
         )
         self._m_prefix = reg.counter(
             "oim_serve_prefix_cache_total",
-            "Prompt-prefix cache lookups by outcome (hit = injected KV "
-            "rows replaced prefill work).  The affinity router exists "
-            "to raise the hit rate; watch this to see it working.",
+            "Prompt-prefix cache activity by outcome: hit/miss are "
+            "LOOKUPS at admission (hit = cached rows replaced prefill "
+            "work — copied in dense mode, block-aliased copy-free in "
+            "paged; hit rate = hit / (hit + miss)); inject counts "
+            "entry STORES (cache_prefix requests populating the "
+            "cache), a separate event stream.  The affinity router "
+            "exists to raise the hit rate; watch this to see it "
+            "working.",
             ("outcome",),
         )
         self._m_latency = reg.histogram(
@@ -1533,6 +1868,15 @@ class Engine:
         # label is this engine's per-process label.
         self._m_active = _metrics.SERVE_ACTIVE_SLOTS
         self._m_queued = _metrics.SERVE_QUEUE_DEPTH
+        # Paged-KV occupancy (shared definitions): the capacity the
+        # fleet actually has left, by block state, plus the bytes
+        # prefix aliasing did NOT copy (the copy-free-reuse win).
+        self._m_kv_blocks = _metrics.SERVE_KV_BLOCKS
+        self._m_prefix_bytes = _metrics.SERVE_PREFIX_BYTES_SAVED
+        if self.paged:
+            # Constructor is single-threaded; the _locked suffix is the
+            # call-site contract for every later caller.
+            self._update_kv_gauges_locked()
         # Pipeline health triad — shared definitions (common/metrics.py,
         # the resilience-instrument pattern) so fleet-wide queries see
         # one series shape.
@@ -1581,13 +1925,29 @@ class Engine:
             raise ValueError(
                 f"prompt {len(req.tokens)} + max_new_tokens "
                 f"{req.max_new_tokens} exceeds max_len "
-                f"{self._cache.max_len}"
+                f"{self.max_len}"
                 + (
                     f" minus the spec_decode+1={self.spec_decode + 1} "
                     f"headroom reserve"
                     if self.spec_decode else ""
                 )
             )
+        if self.paged:
+            # A request whose WORST case (no prefix hit: full bucketed
+            # prefill plus the whole token budget and spec headroom)
+            # cannot fit the pool even when it is completely free must
+            # be rejected here — queued, it would deadlock admissions
+            # forever (backpressure only helps requests that fit).
+            need = self._pool_blocks_needed(
+                len(req.tokens), req.max_new_tokens
+            )
+            if need > self.kv_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks worst-case "
+                    f"(prompt {len(req.tokens)} + max_new_tokens "
+                    f"{req.max_new_tokens}) but the pool holds only "
+                    f"{self.kv_blocks} blocks of {self.kv_block}"
+                )
         if req.top_p is not None and not 0.0 < req.top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {req.top_p}")
         if not 0.0 <= req.min_p < 1.0:
@@ -1801,10 +2161,10 @@ class Engine:
                 f"token ids out of range [0, {self.cfg.vocab_size}): "
                 f"{bad[:5]}"
             )
-        if len(tokens) + max_new_tokens > self._cache.max_len:
+        if len(tokens) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt {len(tokens)} + max_new_tokens {max_new_tokens} "
-                f"exceeds max_len {self._cache.max_len}"
+                f"exceeds max_len {self.max_len}"
             )
         if not 1 <= beam_size <= _MAX_BEAM_SIZE:
             raise ValueError(
@@ -2018,9 +2378,12 @@ class Engine:
                 (s.rid, None, None, s) for s in self._slots.values()
             ]
             self._queue.clear()
-            self._free += sorted(
+            reclaimed = sorted(
                 set(self._slots) | set(self._admitting.values())
             )
+            self._free += reclaimed
+            for slot in reclaimed:
+                self._release_slot_blocks_locked(slot)
             self._slots.clear()
             self._admitting.clear()
             for rid, req, t_sub, state in pending:
@@ -2069,7 +2432,7 @@ class Engine:
             },
             "engine": {
                 "n_slots": self._cache.n_slots,
-                "max_len": self._cache.max_len,
+                "max_len": self.max_len,
                 "usable_len": self._usable_len,
                 "chunk": self.chunk,
                 "prompt_buckets": list(self.prompt_buckets),
@@ -2091,6 +2454,9 @@ class Engine:
                 "prefix_cache_size": self.prefix_cache_size,
                 "prefill_chunk": self.prefill_chunk,
                 "pipeline_depth": self.pipeline_depth,
+                "paged": self.paged,
+                "kv_block": self.kv_block,
+                "kv_blocks": self.kv_blocks,
                 "tp": self.mesh.shape.get("tp", 1) if self.mesh else 1,
                 "ep": self.mesh.shape.get("ep", 1) if self.mesh else 1,
             },
@@ -2110,7 +2476,27 @@ class Engine:
                 "tokens_generated": self.tokens_generated,
                 "prefix_hits": self.prefix_hits,
                 "prefix_misses": self.prefix_misses,
+                "prefix_injects": self.prefix_injects,
                 "prefix_entries": len(self._prefix_cache),
+                # Copy-free prefix reuse + paged-pool occupancy (ISSUE
+                # 10; all zeros on a dense engine).  Fragmentation is
+                # the allocated-but-idle fraction of used block rows —
+                # approximate under sharing (an aliased row counts once
+                # per reader), an operator signal not an invariant.
+                "prefix_bytes_saved": self.prefix_bytes_saved,
+                "kv_block_size": self.kv_block,
+                "kv_blocks_total": self.kv_blocks,
+                "kv_blocks_free": (
+                    self._alloc.free_blocks if self.paged else 0
+                ),
+                "kv_blocks_used": (
+                    self._alloc.used_blocks if self.paged else 0
+                ),
+                "kv_blocks_shared": (
+                    self._alloc.shared_blocks if self.paged else 0
+                ),
+                "kv_fragmentation": self._kv_fragmentation_locked(),
+                "kv_admit_deferrals": self.kv_admit_deferrals,
                 "spec_drafted": self.spec_drafted,
                 "spec_accepted": self.spec_accepted,
                 "readbacks": self.readbacks,
@@ -2149,17 +2535,64 @@ class Engine:
                 "ring_dropped": self.ring_dropped,
             }
 
+    def _worst_case_rows(
+        self, n_tokens: int, max_new: int, start: int = 0
+    ) -> int:
+        """Worst-case slot rows a request can touch: the bucketed
+        prefill window from ``start`` (0 = no prefix hit) vs prompt +
+        token budget + spec headroom.  THE one definition of the paged
+        reservation's upper bound — submit-time rejection (_validate),
+        warmup dummy sizing, and the admission planner all call this,
+        so they can never disagree about what fits the pool."""
+        headroom = self.spec_decode + 1 if self.spec_decode else 0
+        return min(self.max_len, max(
+            start + self._bucket(n_tokens - start),
+            n_tokens + max_new + headroom,
+        ))
+
+    def _pool_blocks_needed(self, n_tokens: int, max_new: int) -> int:
+        """Worst-case (prefix-free) block reservation — the one
+        ceil-divide shared by _validate's submit-time rejection and
+        warmup's dummy sizing."""
+        return -(-self._worst_case_rows(n_tokens, max_new)
+                 // self.kv_block)
+
+    def _kv_fragmentation_locked(self) -> float:
+        """Allocated-but-idle fraction of used pool rows (lock held):
+        0.0 = every used block row holds live KV, 1.0 = all padding.
+        Approximate under sharing (aliased rows count once per reader
+        slot) — block-size tuning signal, not an invariant."""
+        if not self.paged or not self._alloc.used_blocks:
+            return 0.0
+        live = sum(
+            len(s.req.tokens) + len(s.emitted)
+            for s in self._slots.values()
+        ) + sum(rows for _, rows in self._prefix_cache.values())
+        used_rows = self._alloc.used_blocks * self.kv_block
+        return round(max(0.0, 1.0 - live / used_rows), 4)
+
     def load(self) -> dict:
         """Compact live-pressure snapshot — the ``load/<cn>`` registry
         value (oim_tpu/autoscale/load.py) and the ``load`` section of
         ``GET /v1/info``.  A strict subset of stats(), shaped for the
         autoscaler's utilization math: busy work is
-        ``queue_depth + active_slots`` over ``total_slots`` capacity."""
+        ``queue_depth + active_slots`` over ``total_slots`` capacity;
+        the kv_blocks_* triple is per-backend KV headroom (zeros on a
+        dense engine) so the fleet view can see WHICH replica is out of
+        cache, not just out of slots."""
         with self._lock:
             return {
                 "queue_depth": len(self._queue),
                 "active_slots": len(self._slots),
                 "total_slots": self._cache.n_slots,
+                "kv_blocks_total": self.kv_blocks,
+                "kv_blocks_free": (
+                    self._alloc.free_blocks if self.paged else 0
+                ),
+                "kv_blocks_shared": (
+                    self._alloc.shared_blocks if self.paged else 0
+                ),
+                "kv_fragmentation": self._kv_fragmentation_locked(),
                 "token_rate": round(self._token_rate_ewma or 0.0, 2),
                 "shed_queue_full": self._shed_counts["queue_full"],
                 "shed_deadline": self._shed_counts["deadline"],
@@ -2373,6 +2806,10 @@ class Engine:
         # token was never registered in _slots.
         self._slots.pop(slot, None)
         self._free.append(slot)
+        # Paged: the request's blocks go back to the pool (prefix-cache
+        # entries keep their own refs on any shared run) — the free
+        # that makes admission backpressure drain.
+        self._release_slot_blocks_locked(slot)
         # A cancel() that raced this completion (landed after _reap but
         # before the finishing chunk processed) must not leave its mark
         # behind: a stale _cancelled entry would defeat _reap's early
@@ -2404,26 +2841,36 @@ class Engine:
         state.last_token = token
         return len(state.emitted) >= state.req.max_new_tokens
 
+    def _best_prefix_locked(self, req: GenRequest) -> tuple:
+        """Longest cached prefix usable for ``req`` (lock held): returns
+        (key, usable rows) or (None, 0).  Shared by the dense inject
+        path and the paged aliasing planner — ONE matching rule, so the
+        two layouts hit on exactly the same traffic."""
+        best_key, best_usable = None, 0
+        for key, (entry, true_len) in self._prefix_cache.items():
+            usable = min(true_len, len(req.tokens) - 1)
+            if usable <= best_usable:
+                continue
+            if tuple(req.tokens[:usable]) == key[:usable]:
+                # The tail, bucketed, must still fit the slot region.
+                tail_bucket = self._bucket(len(req.tokens) - usable)
+                if usable + tail_bucket <= self.max_len:
+                    best_key, best_usable = key, usable
+        return best_key, best_usable
+
     def _try_prefix_inject(self, slot: int, req: GenRequest) -> int:
         """Inject the longest cached prefix of ``req.tokens`` into
         ``slot``; returns the start offset for the tail prefill (0 = no
         usable entry).  Exact for dense AND MoE models: a KV row depends
         only on the tokens before it, and MoE routing is per-token
         (``_moe_exact``), so injected rows plus a tail prefill reproduce
-        a full prefill bit-for-bit."""
+        a full prefill bit-for-bit.  Dense engines only — the paged
+        layout aliases blocks instead of copying rows
+        (``_plan_paged_admission_locked``)."""
         if not self.prefix_cache_size:
             return 0
-        best_key, best_usable = None, 0
         with self._lock:
-            for key, (entry, true_len) in self._prefix_cache.items():
-                usable = min(true_len, len(req.tokens) - 1)
-                if usable <= best_usable:
-                    continue
-                if tuple(req.tokens[:usable]) == key[:usable]:
-                    # The tail, bucketed, must still fit the slot region.
-                    tail_bucket = self._bucket(len(req.tokens) - usable)
-                    if usable + tail_bucket <= self._cache.max_len:
-                        best_key, best_usable = key, usable
+            best_key, best_usable = self._best_prefix_locked(req)
             if best_key is None:
                 if not self._warming:
                     self.prefix_misses += 1
@@ -2438,8 +2885,45 @@ class Engine:
         return best_usable
 
     def _store_prefix(self, slot: int, tokens: list[int]) -> None:
-        """Cache ``slot``'s freshly prefilled prompt KV (bucketed rows;
-        only the first len(tokens) are valid and only they are used)."""
+        """Cache ``slot``'s freshly prefilled prompt KV.
+
+        Dense: copy the bucketed rows out (only the first len(tokens)
+        are valid and only they are used).  Paged: NO copy — take one
+        ref on the slot's blocks that the prompt FULLY covers and
+        remember their ids.  Only full blocks are shareable: the
+        prompt's partial last block is the very block this slot's
+        decode writes next, so sharing it would mutate the entry under
+        its readers (the shared-block-immutability invariant the CoW
+        tests pin).  The refcount keeps entry blocks alive after the
+        slot frees; LRU eviction drops the ref."""
+        if self.paged:
+            full = len(tokens) // self.kv_block
+            if full == 0:
+                return  # nothing block-aligned to share
+            with self._lock:
+                blocks = tuple(
+                    int(b) for b in self._tables_host[slot][:full]
+                )
+                if any(b >= self.kv_blocks for b in blocks):
+                    # abort() on another thread reclaimed this slot
+                    # mid-wave (sentinel row): nothing left to share.
+                    return
+                key = tuple(tokens)
+                old = self._prefix_cache.pop(key, None)
+                if old is not None:
+                    self._alloc.decref(old[0])
+                self._alloc.incref(blocks)
+                self._prefix_cache[key] = (blocks, full * self.kv_block)
+                while len(self._prefix_cache) > self.prefix_cache_size:
+                    _, (ev_blocks, _) = self._prefix_cache.popitem(
+                        last=False
+                    )
+                    self._alloc.decref(ev_blocks)
+                if not self._warming:
+                    self.prefix_injects += 1
+                    self._m_prefix.inc("inject")
+                self._update_kv_gauges_locked()
+            return
         bucket = self._bucket(len(tokens))
         entry = self._extract[bucket](self._cache, jnp.int32(slot))
         with self._lock:
@@ -2448,18 +2932,223 @@ class Engine:
             self._prefix_cache.move_to_end(key)
             while len(self._prefix_cache) > self.prefix_cache_size:
                 self._prefix_cache.popitem(last=False)
+            if not self._warming:
+                self.prefix_injects += 1
+                self._m_prefix.inc("inject")
 
-    def _prefill_segment(self, slot: int, req, seg, start: int) -> None:
+    def _clear_prefix_cache_locked(self) -> None:
+        """Drop every prefix entry (lock held) — paged entries release
+        their block refs (warmup's dummy prompts must not pin pool
+        blocks forever)."""
+        if self.paged:
+            for _, (blocks, _) in self._prefix_cache.items():
+                self._alloc.decref(blocks)
+            self._update_kv_gauges_locked()
+        self._prefix_cache.clear()
+
+    # -- paged-KV host machinery (ISSUE 10) --------------------------------
+
+    def _device_tables(self):
+        """The block table as the device array the next dispatch needs
+        (rebuilt lazily when admissions/frees dirtied the host copy;
+        replicated over the mesh under tp — the table is tiny and every
+        shard gathers its own heads' rows through it)."""
+        if not self.paged:
+            return self._tables_dummy
+        with self._lock:
+            if self._tables_dirty:
+                tables = jnp.asarray(self._tables_host)
+                if self.mesh is not None:
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as P
+
+                    tables = jax.device_put(
+                        tables, NamedSharding(self.mesh, P())
+                    )
+                self._tables_dev = tables
+                self._tables_dirty = False
+            return self._tables_dev
+
+    def _release_slot_blocks_locked(self, slot: int) -> None:
+        """Return ``slot``'s block refs to the allocator and reset its
+        table row to the sentinel (lock held; called by every path that
+        frees a slot — finish, reap, abort, admission-cancel).  The
+        sentinel row makes any still-in-flight chunk's writes for this
+        slot drop at the pool edge from the NEXT dispatch on; a chunk
+        already dispatched against the old table can only write into
+        these exact blocks, which the single device stream orders
+        before any prefill that reuses them."""
+        if not self.paged:
+            return
+        row = self._tables_host[slot]
+        live = row[row < self.kv_blocks]
+        if live.size:
+            self._alloc.decref(live.tolist())
+        row[:] = self.kv_blocks
+        self._tables_dirty = True
+        self._update_kv_gauges_locked()
+
+    def _update_kv_gauges_locked(self) -> None:
+        if not self.paged:
+            return
+        self._m_kv_blocks.set(
+            float(self._alloc.free_blocks), self._engine_label, "free"
+        )
+        self._m_kv_blocks.set(
+            float(self._alloc.used_blocks), self._engine_label, "used"
+        )
+        self._m_kv_blocks.set(
+            float(self._alloc.shared_blocks), self._engine_label, "shared"
+        )
+
+    def _plan_paged_admission_locked(self, req: GenRequest, idle: bool):
+        """Reserve everything ``req``'s admission needs from the pool
+        (lock held): alias the longest cached prefix's full blocks
+        copy-free (one incref each), plan a copy-on-write duplicate of
+        the entry's last block when the usable prefix ends mid-block
+        (the tail prefill writes into that block — the divergent
+        write), and allocate fresh blocks for the rest of the request's
+        worst case.  All-or-nothing: returns None when the pool cannot
+        cover it, after evicting idle prefix entries LRU-first (cached
+        prompts must never starve live admissions) — the caller leaves
+        the request QUEUED (OOM-of-blocks is backpressure, not a
+        crash).
+
+        ``idle`` = no active or admitting slot anywhere (nothing will
+        EVER free a block except prefix entries): when even the aliased
+        plan cannot be covered then, the MATCHED entry itself is
+        pinning the pool shut — evict it too and re-plan prefix-free,
+        which the submit-time fit check guarantees succeeds on an empty
+        pool.  Without this fallback, a request that fits the pool but
+        not the pool-minus-its-own-matched-entry would wedge the queue
+        forever (a copy-free hit is never worth a deadlock); with slots
+        active the shortage is transient and the entry is kept."""
+        bs = self.kv_block
+        best_key, usable = (None, 0)
+        if self.prefix_cache_size:
+            best_key, usable = self._best_prefix_locked(req)
+        aliased: list[int] = []
+        cow_src = None
+        if best_key is not None and usable:
+            entry_blocks, _ = self._prefix_cache[best_key]
+            full = usable // bs
+            aliased = list(entry_blocks[:full])
+            if usable % bs:
+                cow_src = entry_blocks[full]
+        start = usable
+        needed_rows = self._worst_case_rows(
+            len(req.tokens), req.max_new_tokens, start
+        )
+        total_blocks = -(-needed_rows // bs)
+        fresh_needed = total_blocks - len(aliased)
+        if fresh_needed > self._alloc.free_blocks:
+            # Evict idle prefix entries LRU-first (never the matched
+            # one) — but ONLY when eviction can cover the shortfall
+            # now: entries whose blocks are still aliased by running
+            # slots (or by a sibling entry) free nothing, and flushing
+            # the cache without admitting anyone trades future hits
+            # for zero blocks — the head-of-line request retries every
+            # step, which would otherwise empty the whole cache on one
+            # transient shortage.  The exclusive-count sum undercounts
+            # mutually-aliased entry SETS (evicting both would free
+            # what neither frees alone) — conservative by design; the
+            # idle fallback below covers that case when it matters.
+            victims = [
+                (key, blocks)
+                for key, (blocks, _) in self._prefix_cache.items()
+                if key != best_key
+            ]
+            reclaimable = self._alloc.free_blocks + sum(
+                self._alloc.exclusive(blocks) for _, blocks in victims
+            )
+            if reclaimable >= fresh_needed:
+                for key, blocks in victims:
+                    if fresh_needed <= self._alloc.free_blocks:
+                        break
+                    if not self._alloc.exclusive(blocks):
+                        continue
+                    self._prefix_cache.pop(key)
+                    self._alloc.decref(blocks)
+        fresh = self._alloc.alloc(fresh_needed)
+        if fresh is None and idle and self._prefix_cache:
+            # Permanent shortage: the engine is empty of work, so ONLY
+            # prefix entries hold blocks — possibly a mutually-aliased
+            # set no per-entry exclusivity test can free, possibly the
+            # matched entry itself.  Drop the whole cache and re-plan
+            # prefix-free: _validate guarantees that bound fits an
+            # empty pool, so the queue can never wedge on cached
+            # prompts (no refs were taken above).
+            self._clear_prefix_cache_locked()
+            best_key, usable, aliased, cow_src = None, 0, [], None
+            start = 0
+            total_blocks = fresh_needed = self._pool_blocks_needed(
+                len(req.tokens), req.max_new_tokens
+            )  # start=0: exactly the bound _validate admitted on
+            fresh = self._alloc.alloc(fresh_needed)
+        if fresh is None:
+            if not self._warming:
+                self.kv_admit_deferrals += 1
+            return None
+        self._alloc.incref(aliased)
+        if best_key is not None:
+            self._prefix_cache.move_to_end(best_key)  # LRU touch
+        if not self._warming:
+            if usable:
+                self.prefix_hits += 1
+                self._m_prefix.inc("hit")
+                # Copy-free reuse accounting: the aliased full blocks
+                # are KV bytes a dense engine would have COPIED into
+                # the slot's region (and, pre-prefix-cache, recomputed
+                # outright).  The CoW'd partial block is a real copy,
+                # so it does not count.
+                saved = len(aliased) * bs * self._kv_row_bytes
+                self.prefix_bytes_saved += saved
+                self._m_prefix_bytes.inc(
+                    self._engine_label, by=float(saved)
+                )
+            elif self.prefix_cache_size:
+                self.prefix_misses += 1
+                self._m_prefix.inc("miss")
+        # Table row order IS the position map: entry i covers rows
+        # [i*bs, (i+1)*bs).  The CoW destination is fresh[0] — the
+        # first block after the aliased run, exactly where the partial
+        # entry block's copy must sit.
+        return {
+            "start": start,
+            "blocks": aliased + fresh,
+            "cow": None if cow_src is None else (cow_src, fresh[0]),
+        }
+
+    def _commit_plan_locked(self, slot: int, plan: dict) -> None:
+        row = self._tables_host[slot]
+        row[:] = self.kv_blocks
+        row[: len(plan["blocks"])] = plan["blocks"]
+        self._tables_dirty = True
+        self._update_kv_gauges_locked()
+
+    def _prefill_segment(
+        self, slot: int, req, seg, start: int, plan: dict | None = None,
+    ) -> None:
         """One non-final chunked-prefill dispatch: write ``seg``'s KV
         rows for ``slot`` at position ``start`` through the SAME jitted
         admit program (one active row, padding rows inert) and discard
         the sampled token — the final segment's normal group dispatch
         samples for real and overwrites the penalty/length bookkeeping
         this call touches (idempotent by construction).  No readback:
-        the discarded sample is never fetched."""
+        the discarded sample is never fetched.  ``plan`` (paged) holds
+        the slot's reserved blocks; every segment's window lies inside
+        them (needed_rows covers the final bucket end)."""
         n_slots = self._cache.n_slots
-        max_len = self._cache.max_len
+        max_len = self.max_len
         bucket = self._bucket(len(seg))
+        if plan is not None:
+            seg_tables = np.full(
+                (n_slots, self._n_tables), self.kv_blocks, np.int32
+            )
+            seg_tables[0, : len(plan["blocks"])] = plan["blocks"]
+            seg_tables = jnp.asarray(seg_tables)
+        else:
+            seg_tables = self._tables_dummy
         prompts = np.zeros((n_slots, bucket), np.int32)
         prompts[0, : len(seg)] = seg
         full_rows = np.zeros(
@@ -2487,6 +3176,7 @@ class Engine:
         ) = self._admit(
             self.params,
             self._cache,
+            seg_tables,
             self._history,
             self._tok_counts,
             self._gen_counts,
@@ -2826,6 +3516,7 @@ class Engine:
                     continue
                 self._slots.pop(slot)
                 self._free.append(slot)
+                self._release_slot_blocks_locked(slot)
                 self._fail_locked(state.rid, kind, msg, state=state)
                 cb = self._callbacks.pop(state.rid, None)
                 if cb is not None:
@@ -2859,14 +3550,43 @@ class Engine:
         with self._lock:
             admissions = []
             while self._queue and self._free:
-                rid, req, t_submit = self._queue.pop(0)
-                admissions.append((self._free.pop(0), rid, req, t_submit))
+                rid, req, t_submit = self._queue[0]
+                plan = None
+                if self.paged:
+                    # Reserve blocks (aliasing the cached prefix) BEFORE
+                    # taking the request off the queue: a pool that
+                    # cannot cover the head-of-line request's worst
+                    # case leaves it QUEUED — admission backpressure,
+                    # exactly like a fleet with no free slot — and the
+                    # blocks freed by finishing requests admit it on a
+                    # later wave.  FIFO head-of-line by design: the
+                    # queue's ordering promise beats opportunistically
+                    # admitting a smaller latecomer forever.
+                    plan = self._plan_paged_admission_locked(
+                        req,
+                        # Nothing running, nothing admitted earlier in
+                        # THIS wave: only prefix entries can ever free
+                        # blocks, so the planner may sacrifice even the
+                        # matched one rather than wedge the queue.
+                        idle=(
+                            not self._slots
+                            and not self._admitting
+                            and not admissions
+                        ),
+                    )
+                    if plan is None:
+                        break
+                self._queue.pop(0)
+                slot = self._free.pop(0)
+                if plan is not None:
+                    self._commit_plan_locked(slot, plan)
+                admissions.append((slot, rid, req, t_submit, plan))
             # Registered before any device work so abort() can fail these
             # and reclaim their slots if an admission dispatch dies.
             # update(), not assignment: entries stranded by a previous
             # step() crash must survive until abort() reclaims them.
             self._admitting.update(
-                {rid: slot for slot, rid, _, _ in admissions}
+                {rid: slot for slot, rid, _, _, _ in admissions}
             )
             self._m_queued.set(float(len(self._queue)), self._engine_label)
 
@@ -2875,7 +3595,8 @@ class Engine:
             # at the pop above — one boundary instant serves the wave.
             t_admitted = time.monotonic()
             n_slots = self._cache.n_slots
-            # (slot, rid, req, t_submit, start, tail, bucket, t_prefill)
+            # (slot, rid, req, t_submit, start, tail, bucket, t_prefill,
+            #  plan)
             rows = []
             # The wave's prefill work (prefix-cache injections,
             # chunked-prefill segments, host array building, the group
@@ -2887,8 +3608,21 @@ class Engine:
             # scheduling slice between pop and wave start — by design;
             # admission overhead being ~0 is itself a signal.
             t_pf = time.monotonic()
-            for slot, rid, req, t_submit in admissions:
-                start = self._try_prefix_inject(slot, req)
+            for slot, rid, req, t_submit, plan in admissions:
+                if plan is not None:
+                    # Paged: the prefix was aliased (copy-free) at plan
+                    # time; the one device copy is the CoW duplicate of
+                    # a partially-covered entry block, chained through
+                    # self._cache BEFORE the prefill dispatch below so
+                    # the device stream orders copy → tail writes.
+                    if plan["cow"] is not None:
+                        src, dst = plan["cow"]
+                        self._cache = self._cow(
+                            self._cache, jnp.int32(src), jnp.int32(dst)
+                        )
+                    start = plan["start"]
+                else:
+                    start = self._try_prefix_inject(slot, req)
                 tail = req.tokens[start:]
                 # Chunked prefill (long-context admission): write the
                 # prompt's KV in prefill_chunk-sized segments so peak
@@ -2913,17 +3647,17 @@ class Engine:
                     fstart = start + len(segs) * self.prefill_chunk
                     while segs and (
                         fstart + self._bucket(len(tail))
-                        > self._cache.max_len
+                        > self.max_len
                     ):
                         tail = segs.pop() + tail
                         fstart -= self.prefill_chunk
                     for seg in segs:
-                        self._prefill_segment(slot, req, seg, start)
+                        self._prefill_segment(slot, req, seg, start, plan)
                         start += len(seg)
                 rows.append((slot, rid, req, t_submit, start, tail,
-                             self._bucket(len(tail)), t_pf))
+                             self._bucket(len(tail)), t_pf, plan))
             zero_key = jax.random.PRNGKey(0)
-            max_len = self._cache.max_len
+            max_len = self.max_len
             groups = []  # (group rows, first_tokens, first_logprobs)
             for bucket in sorted({r[6] for r in rows}):
                 group = [r for r in rows if r[6] == bucket]
@@ -2952,9 +3686,30 @@ class Engine:
                 press = np.zeros((n_slots,), np.float32)
                 freqs = np.zeros((n_slots,), np.float32)
                 keys = [zero_key] * n_slots
-                for i, (slot, rid, req, _, start, tail, _, _) in enumerate(
-                    group
-                ):
+                # Paged: per-ROW block tables for the group dispatch —
+                # live rows carry their plan's blocks, padding rows
+                # stay all-sentinel so their writes drop at the pool
+                # edge (the paged twin of the dense scatter's
+                # out-of-bounds slot index).  Built from the PLAN, not
+                # _tables_host: an abort() on another thread may
+                # sentinel the host row mid-wave, and this dispatch's
+                # writes must still land in the blocks the plan owns
+                # (they are released, garbage, and device-ordered
+                # before any reuse either way).
+                row_tables = (
+                    np.full(
+                        (n_slots, self._n_tables), self.kv_blocks,
+                        np.int32,
+                    )
+                    if self.paged else None
+                )
+                for i, (
+                    slot, rid, req, _, start, tail, _, _, plan
+                ) in enumerate(group):
+                    if row_tables is not None:
+                        row_tables[i, : len(plan["blocks"])] = plan[
+                            "blocks"
+                        ]
                     prompts[i, : len(tail)] = tail
                     if self.spec_decode:
                         full_rows[i, : len(req.tokens)] = req.tokens
@@ -2985,6 +3740,10 @@ class Engine:
                 ) = self._admit(
                     self.params,
                     self._cache,
+                    (
+                        self._tables_dummy if row_tables is None
+                        else jnp.asarray(row_tables)
+                    ),
                     self._history,
                     self._tok_counts,
                     self._gen_counts,
@@ -3025,7 +3784,7 @@ class Engine:
                 self._watch_end()
                 self._mark_dispatch(t_disp, acc)
                 groups.append((group, first, first_lp))
-            for slot, rid, req, _, start, tail, _, _ in rows:
+            for slot, rid, req, _, start, tail, _, _, _ in rows:
                 if req.cache_prefix and self.prefix_cache_size:
                     self._store_prefix(slot, req.tokens)
             # ONE combined readback for every admission this step.
@@ -3041,7 +3800,7 @@ class Engine:
             with self._lock:
                 for (group, _, _), (f_host, lp_host) in zip(groups, fetched):
                     for i, (
-                        slot, rid, req, t_submit, _, _, _, t_pf
+                        slot, rid, req, t_submit, _, _, _, t_pf, _
                     ) in enumerate(group):
                         if rid not in self._admitting:
                             # abort() (watchdog stall verdict on a live
@@ -3072,6 +3831,7 @@ class Engine:
                             # the stream, never register the state.
                             self._admitting.pop(rid, None)
                             self._free.append(slot)
+                            self._release_slot_blocks_locked(slot)
                             self._fail_locked(
                                 rid, "cancelled",
                                 "client went away during admission",
@@ -3219,6 +3979,12 @@ class Engine:
                 np.int32,
             )
 
+        # CURRENT device tables every dispatch (fresh or chained): a
+        # slot freed since the last dispatch has a sentinel row by now,
+        # so its post-EOS garbage writes drop at the pool edge instead
+        # of landing in blocks the allocator may hand to the next
+        # admission.
+        tables = self._device_tables()
         t_dispatch = time.monotonic()
         self._watch_begin()
         if self.spec_decode and self._draft_cache is not None:
@@ -3228,8 +3994,8 @@ class Engine:
                 next_tok,
             ) = self._decode(
                 self.params, self.draft_params, self._cache,
-                self._draft_cache, tokens, temps, top_ps, min_ps, active,
-                bases, jnp.asarray(counts),
+                self._draft_cache, tables, tokens, temps, top_ps, min_ps,
+                active, bases, jnp.asarray(counts),
             )
             kind, handles = "spec_model", (out3, lps3, n_emit)
         elif self.spec_decode:
@@ -3237,8 +4003,8 @@ class Engine:
             (
                 self._cache, self._history, out3, lps3, n_emit, next_tok
             ) = self._decode(
-                self.params, self._cache, self._history, tokens, temps,
-                top_ps, min_ps, active, bases, jnp.asarray(counts),
+                self.params, self._cache, tables, self._history, tokens,
+                temps, top_ps, min_ps, active, bases, jnp.asarray(counts),
             )
             kind, handles = "spec", (out3, lps3, n_emit)
         else:
@@ -3249,7 +4015,7 @@ class Engine:
                 self._cache, self._tok_counts, self._gen_counts, out,
                 lps, next_tok,
             ) = self._decode(
-                self.params, self._cache, self._tok_counts,
+                self.params, self._cache, tables, self._tok_counts,
                 self._gen_counts, tokens, temps, top_ps, min_ps,
                 reps, press, freqs, active, bases, jnp.asarray(counts),
             )
@@ -3427,11 +4193,25 @@ class Engine:
         registry pre-dialing controllers it proxies for)."""
         max_len = self._usable_len
         self._warming = True  # dummies must not pollute request metrics
+
+        def fits_pool(tokens: int, max_new: int) -> bool:
+            # A small paged pool (legal: short-request deployments) may
+            # not hold the largest buckets' worst case — skip those
+            # dummies rather than trip the submit-time fit check; live
+            # requests that large are rejected the same way.
+            if not self.paged:
+                return True
+            return self._pool_blocks_needed(tokens, max_new) <= (
+                self.kv_blocks
+            )
+
         try:
             rids = []
             for b in self.prompt_buckets:
                 headroom = max_len - b
                 if headroom < 1:
+                    continue
+                if not fits_pool(b, min(2 * self.chunk, headroom)):
                     continue
                 rids.append(self.submit(GenRequest(
                     tokens=[0] * b,
@@ -3450,6 +4230,7 @@ class Engine:
                     if (
                         b + self.prompt_buckets[0] > max_len - 1
                         or b + 1 > self.prompt_buckets[-1]
+                        or not fits_pool(b + 1, 1)
                     ):
                         continue
                     rids.append(self.submit(GenRequest(
@@ -3464,7 +4245,7 @@ class Engine:
             for rid in rids:  # consume the dummies; warmup must not retain
                 self.result(rid, timeout=0)
             with self._lock:  # dummy prompts must not occupy live entries
-                self._prefix_cache.clear()
+                self._clear_prefix_cache_locked()
         finally:
             self._warming = False
         return self

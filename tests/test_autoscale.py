@@ -197,6 +197,10 @@ class TestLoadSchema:
             "queue_depth": 3,
             "active_slots": 2,
             "total_slots": 8,
+            "kv_blocks_total": 64,
+            "kv_blocks_free": 16,
+            "kv_blocks_shared": 4,
+            "kv_fragmentation": 0.25,
             "token_rate": 41.5,
             "shed_queue_full": 1,
             "shed_deadline": 0,

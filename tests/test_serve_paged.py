@@ -1,0 +1,647 @@
+"""Paged KV cache: block sharing must be invisible, capacity real.
+
+The load-bearing properties (ISSUE 10):
+
+- **Token-identical to dense.**  A paged engine (global block pool +
+  per-slot block tables) emits exactly the tokens the dense per-slot
+  engine emits — greedy, sampled, speculative (prompt-lookup AND draft
+  model), prefix-cache hits, mid-stream admissions, dense and MoE
+  models, pipeline depth 1 and 2.  Not approximately: the paged view
+  is gathered into the dense region shape and the attention math is
+  the SAME code, so the matrix below asserts strict equality.
+- **Copy-free prefix sharing.**  Concurrent requests sharing a cached
+  prefix alias its full blocks (refcounts prove single residency, the
+  bytes-saved counter proves no copy), and a shared block's pool
+  contents are bit-identical before and after concurrent readers — it
+  is never mutated in place.  Divergence (the tail prefill writing
+  into a partially-covered entry block) goes through copy-on-write.
+- **OOM-of-blocks is backpressure.**  A pool too small for the
+  offered load defers admissions (requests stay queued and complete
+  as blocks free); a request whose worst case cannot EVER fit rejects
+  at submit; abort/deadline-reap/cancel all return blocks; the chaos
+  soak asserts zero leaked blocks every cycle.
+
+Engines are shared per model config (the test-serve compile-budget
+discipline); this file backs ``make test-serve-paged`` (120 s cap).
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from oim_tpu.common import metrics as _metrics
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.models.decode import generate
+from oim_tpu.serve import Engine, GenRequest
+from oim_tpu.serve.engine import BlockAllocator, RequestFailedError
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def dense_engine(setup):
+    cfg, params = setup
+    return Engine(params, cfg, n_slots=3, max_len=64, chunk=4,
+                  prompt_buckets=(16, 32), prefix_cache_size=2)
+
+
+@pytest.fixture(scope="module")
+def paged_engine(setup):
+    cfg, params = setup
+    # Same geometry, paged: 8-token blocks, default pool (= the dense
+    # cache's footprint) so exactness runs are never block-constrained.
+    return Engine(params, cfg, n_slots=3, max_len=64, chunk=4,
+                  prompt_buckets=(16, 32), prefix_cache_size=2,
+                  kv_block=8)
+
+
+def _prompt(seed: int, n: int, vocab: int) -> list[int]:
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, vocab, size=n).tolist()
+
+
+def _echo_prompt(n: int, vocab: int) -> list[int]:
+    pattern = [7, 21, 40, 3]
+    return [t % vocab for t in (pattern * ((n // 4) + 1))[:n]]
+
+
+def _oracle(params, cfg, tokens, max_new) -> list[int]:
+    prompt = jax.numpy.asarray(tokens, jax.numpy.int32)[None]
+    out = generate(params, prompt, cfg, max_new_tokens=max_new)
+    return np.asarray(out)[0, len(tokens):].tolist()
+
+
+def _clear_prefix(engine):
+    with engine._lock:
+        engine._clear_prefix_cache_locked()
+
+
+def _matrix_workload(engine, vocab, system):
+    """test_serve_pipeline's exactness-matrix traffic shape: queue
+    pressure, greedy + sampled rows, a cache_prefix system prompt plus
+    a request sharing it, and a mid-stream admission wave."""
+    specs = [
+        (system, 8, 0.0, 0, True),
+        (_prompt(21, 9, vocab), 10, 0.8, 7, False),
+        (_prompt(22, 5, vocab), 6, 0.0, 0, False),
+    ]
+    rids = [
+        engine.submit(GenRequest(
+            tokens=t, max_new_tokens=m, temperature=temp, seed=s,
+            cache_prefix=c,
+        ))
+        for t, m, temp, s, c in specs
+    ]
+    engine.step()
+    engine.step()
+    late = [
+        (system + _prompt(23, 4, vocab), 7, 0.0, 0, False),
+        (_prompt(24, 6, vocab), 5, 0.5, 3, False),
+    ]
+    rids += [
+        engine.submit(GenRequest(
+            tokens=t, max_new_tokens=m, temperature=temp, seed=s,
+            cache_prefix=c,
+        ))
+        for t, m, temp, s, c in late
+    ]
+    results = engine.run()
+    return [results[r] for r in rids], [s[:2] for s in specs + late]
+
+
+# ---------------------------------------------------------------------------
+# Allocator units
+
+
+def test_allocator_refcounts():
+    a = BlockAllocator(4)
+    ids = a.alloc(2)
+    assert sorted(ids) == [0, 1] and a.free_blocks == 2
+    assert a.used_blocks == 2 and a.shared_blocks == 0
+    a.incref(ids)  # a second owner
+    assert a.shared_blocks == 2
+    assert a.decref(ids) == 0  # first deref frees nothing
+    assert a.free_blocks == 2 and a.shared_blocks == 0
+    assert a.decref(ids) == 2  # free-on-last-deref
+    assert a.free_blocks == 4 and a.used_blocks == 0
+
+
+def test_allocator_all_or_nothing_and_errors():
+    a = BlockAllocator(3)
+    assert a.alloc(4) is None  # all-or-nothing: nothing reserved
+    assert a.free_blocks == 3
+    ids = a.alloc(3)
+    assert a.alloc(1) is None
+    a.decref(ids)
+    with pytest.raises(ValueError):
+        a.decref([0])  # double free
+    with pytest.raises(ValueError):
+        a.incref([0])  # incref of a free block
+    with pytest.raises(ValueError):
+        BlockAllocator(0)
+
+
+# ---------------------------------------------------------------------------
+# The exactness matrix: paged == dense, token for token
+
+
+def test_exactness_matrix_dense_model(setup, dense_engine, paged_engine):
+    """Paged == dense across greedy / sampled / prefix-cache /
+    mid-stream admission, under pipeline depth 1 AND 2 — and the
+    greedy rows equal the solo oracle, so both layouts are exact, not
+    merely identical."""
+    cfg, params = setup
+    system = _prompt(20, 10, cfg.vocab_size)
+
+    dense_engine.set_pipeline_depth(1)
+    reference, shapes = _matrix_workload(
+        dense_engine, cfg.vocab_size, system
+    )
+    for depth in (1, 2):
+        _clear_prefix(paged_engine)  # same cold-then-warm hit pattern
+        paged_engine.set_pipeline_depth(depth)
+        hits_before = paged_engine.stats()["prefix_hits"]
+        got, _ = _matrix_workload(paged_engine, cfg.vocab_size, system)
+        assert got == reference, f"paged depth {depth} diverged"
+        # The run really exercised block aliasing, not just prefill.
+        assert paged_engine.stats()["prefix_hits"] > hits_before
+    dense_engine.set_pipeline_depth(2)
+    for idx in (0, 2):  # greedy rows vs the solo oracle
+        tokens, max_new = shapes[idx]
+        assert reference[idx] == _oracle(params, cfg, tokens, max_new)
+
+
+def test_exactness_matrix_moe(setup):
+    """Same matrix on a MoE model: drop-free per-token routing keeps
+    the paged gather invisible there too."""
+    cfg = TransformerConfig(**{**CFG, "n_experts": 2})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    dense = Engine(params, cfg, n_slots=3, max_len=64, chunk=4,
+                   prompt_buckets=(16,), prefix_cache_size=2)
+    paged = Engine(params, cfg, n_slots=3, max_len=64, chunk=4,
+                   prompt_buckets=(16,), prefix_cache_size=2, kv_block=8)
+    system = _prompt(40, 10, cfg.vocab_size)
+    reference, shapes = _matrix_workload(dense, cfg.vocab_size, system)
+    got, _ = _matrix_workload(paged, cfg.vocab_size, system)
+    assert got == reference
+    tokens, max_new = shapes[0]
+    assert reference[0] == _oracle(params, cfg, tokens, max_new)
+
+
+def test_exactness_kv_int8(setup):
+    """int8 KV over the paged layout: the scale pools ride the same
+    scatter/gather (paged_store/paged_view) and CoW copies them too —
+    paged int8 output must equal dense int8, including a prefix hit
+    whose mid-block divergence exercises the int8 CoW path."""
+    cfg, params = setup
+    kwargs = dict(n_slots=2, max_len=64, chunk=4, prompt_buckets=(16,),
+                  kv_int8=True, prefix_cache_size=2)
+    dense = Engine(params, cfg, **kwargs)
+    paged = Engine(params, cfg, kv_block=8, **kwargs)
+
+    def workload(engine):
+        system = _prompt(55, 16, cfg.vocab_size)
+        rid = engine.submit(GenRequest(tokens=system, max_new_tokens=2,
+                                       cache_prefix=True))
+        out = [engine.run()[rid]]
+        engine.result(rid, timeout=0)
+        # Identical prompt resubmitted: usable = len-1 ends mid-block
+        # → int8 CoW on the paged engine.
+        rid = engine.submit(GenRequest(tokens=system, max_new_tokens=6))
+        out.append(engine.run()[rid])
+        rid = engine.submit(GenRequest(
+            tokens=_prompt(56, 9, cfg.vocab_size), max_new_tokens=8,
+            temperature=0.7, seed=5,
+        ))
+        out.append(engine.run()[rid])
+        return out
+
+    assert workload(paged) == workload(dense)
+    assert paged.stats()["prefix_hits"] >= 1  # the CoW hit really ran
+
+
+def test_exactness_spec_decode(setup):
+    """Speculative engine (prompt-lookup drafting) over a paged target
+    cache: multi-token verify emission and the fold_in key chaining
+    survive the block-table layout."""
+    cfg, params = setup
+
+    def workload(engine):
+        rids = [
+            engine.submit(GenRequest(
+                tokens=_echo_prompt(12, cfg.vocab_size), max_new_tokens=10,
+            )),
+            engine.submit(GenRequest(
+                tokens=_prompt(50, 9, cfg.vocab_size), max_new_tokens=7,
+                temperature=0.8, seed=11,
+            )),
+        ]
+        engine.step()
+        rids.append(engine.submit(GenRequest(
+            tokens=_echo_prompt(8, cfg.vocab_size), max_new_tokens=6,
+        )))
+        results = engine.run()
+        return [results[r] for r in rids]
+
+    dense = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                   prompt_buckets=(16,), spec_decode=2)
+    paged = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                   prompt_buckets=(16,), spec_decode=2, kv_block=16)
+    reference = workload(dense)
+    assert workload(paged) == reference
+    assert reference[0] == _oracle(
+        params, cfg, _echo_prompt(12, cfg.vocab_size), 10
+    )
+
+
+def test_exactness_spec_draft_model(setup):
+    """Model-drafted speculation: paged target cache + dense draft
+    cache share one lengths vector through the block-table layout."""
+    cfg, params = setup
+    draft_cfg = TransformerConfig(**{**CFG, "d_model": 16, "n_layers": 1,
+                                     "n_heads": 2, "d_ff": 32})
+    draft_params = init_params(jax.random.PRNGKey(1), draft_cfg)
+    kwargs = dict(n_slots=2, max_len=64, chunk=2, prompt_buckets=(16,),
+                  spec_decode=2, draft_params=draft_params,
+                  draft_cfg=draft_cfg)
+    dense = Engine(params, cfg, **kwargs)
+    paged = Engine(params, cfg, kv_block=8, **kwargs)
+    req = dict(tokens=_prompt(60, 7, cfg.vocab_size), max_new_tokens=6)
+    rid = dense.submit(GenRequest(**req))
+    reference = dense.run()[rid]
+    rid = paged.submit(GenRequest(**req))
+    assert paged.run()[rid] == reference == _oracle(
+        params, cfg, req["tokens"], req["max_new_tokens"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Copy-free sharing, refcounts, copy-on-write
+
+
+def _pool_blocks(engine, block_ids):
+    """Fetch the pool contents of ``block_ids`` (k and v, all layers)
+    — the mutation witness for the shared-block-immutability tests."""
+    k = np.asarray(jax.device_get(engine._cache.k[:, list(block_ids)]))
+    v = np.asarray(jax.device_get(engine._cache.v[:, list(block_ids)]))
+    return k, v
+
+
+def test_prefix_blocks_shared_once_across_concurrent_readers(
+    setup, paged_engine
+):
+    """Two concurrent requests over one cached prefix consume its
+    blocks ONCE: refcounts show entry + both slots on the same block
+    ids, the shared gauge and bytes-saved counter advance, and the
+    shared blocks' pool contents are bit-identical before vs after the
+    concurrent run (never mutated in place)."""
+    cfg, params = setup
+    engine = paged_engine
+    _clear_prefix(engine)
+    label = engine._engine_label
+    system = _prompt(30, 16, cfg.vocab_size)  # 2 full 8-token blocks
+
+    rid = engine.submit(GenRequest(tokens=system, max_new_tokens=2,
+                                   cache_prefix=True))
+    engine.run()
+    engine.result(rid, timeout=0)
+    with engine._lock:
+        (entry_blocks, entry_rows), = [
+            v for v in engine._prefix_cache.values()
+        ]
+    assert entry_rows == 16 and len(entry_blocks) == 2
+    before_k, before_v = _pool_blocks(engine, entry_blocks)
+    saved_before = engine.stats()["prefix_bytes_saved"]
+
+    # Both admitted in ONE wave: concurrent readers of the same blocks.
+    reqs = [system + _prompt(31 + i, 3, cfg.vocab_size) for i in range(2)]
+    rids = [
+        engine.submit(GenRequest(tokens=t, max_new_tokens=5))
+        for t in reqs
+    ]
+    engine.step()
+    st = engine.stats()
+    with engine._lock:
+        refs = [int(engine._alloc._refs[b]) for b in entry_blocks]
+    assert refs == [3, 3]  # entry + two aliasing slots
+    assert st["kv_blocks_shared"] >= 2
+    assert _metrics.SERVE_KV_BLOCKS.value(label, "shared") >= 2
+    results = engine.run()
+    for rid, tokens in zip(rids, reqs):
+        assert results[rid] == _oracle(params, cfg, tokens, 5)
+
+    after_k, after_v = _pool_blocks(engine, entry_blocks)
+    np.testing.assert_array_equal(before_k, after_k)
+    np.testing.assert_array_equal(before_v, after_v)
+    st = engine.stats()
+    assert st["prefix_bytes_saved"] > saved_before
+    assert st["prefix_injects"] >= 1
+    with engine._lock:  # readers gone: entry holds the last ref
+        assert [int(engine._alloc._refs[b]) for b in entry_blocks] == [1, 1]
+
+
+def test_cow_divergence_never_mutates_shared_block(setup, paged_engine):
+    """Resubmitting the cached prompt itself makes the usable prefix
+    end mid-block (len - 1): the tail prefill would write into the
+    entry's last block, so admission copy-on-writes it — the entry
+    block's contents stay bit-identical and the output still matches
+    the oracle."""
+    cfg, params = setup
+    engine = paged_engine
+    _clear_prefix(engine)
+    system = _prompt(33, 16, cfg.vocab_size)
+    rid = engine.submit(GenRequest(tokens=system, max_new_tokens=2,
+                                   cache_prefix=True))
+    engine.run()
+    engine.result(rid, timeout=0)
+    with engine._lock:
+        (entry_blocks, _), = [v for v in engine._prefix_cache.values()]
+    before_k, before_v = _pool_blocks(engine, entry_blocks)
+
+    rid = engine.submit(GenRequest(tokens=system, max_new_tokens=4))
+    result = engine.run()[rid]
+    assert result == _oracle(params, cfg, system, 4)
+    after_k, after_v = _pool_blocks(engine, entry_blocks)
+    np.testing.assert_array_equal(before_k, after_k)
+    np.testing.assert_array_equal(before_v, after_v)
+
+
+# ---------------------------------------------------------------------------
+# Block exhaustion, release paths, leaks
+
+
+def test_oom_of_blocks_is_admission_backpressure(setup):
+    """A pool holding 2 blocks against 6 one-block requests: waves
+    defer (kv_admit_deferrals counts them), everything completes as
+    finishing requests free blocks, nothing crashes or leaks."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=4, max_len=64, chunk=4,
+                    prompt_buckets=(16,), kv_block=16, kv_blocks=2)
+    rids = [
+        engine.submit(GenRequest(
+            tokens=_prompt(70 + i, 9, cfg.vocab_size), max_new_tokens=4,
+        ))
+        for i in range(6)
+    ]
+    results = engine.run()
+    assert all(len(results[r]) == 4 for r in rids)
+    st = engine.stats()
+    assert st["kv_admit_deferrals"] > 0
+    assert st["kv_blocks_free"] == 2 and st["kv_blocks_used"] == 0
+
+    # A request whose WORST case exceeds the whole pool can never be
+    # admitted: reject at submit, don't deadlock the queue.
+    with pytest.raises(ValueError, match="KV blocks"):
+        engine.submit(GenRequest(
+            tokens=_prompt(76, 9, cfg.vocab_size), max_new_tokens=50,
+        ))
+
+
+def test_matched_entry_pinning_pool_is_evicted_not_deadlocked(setup):
+    """Review regression: a request that fits the pool but NOT the
+    pool minus its own matched prefix entry must not wedge the queue.
+    Entry pins 3 of 4 blocks; the sharing request's aliased plan needs
+    2 fresh against 1 free, every other entry is already gone, and no
+    slot will ever free anything — the planner must sacrifice the
+    matched entry and re-plan prefix-free."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=2, max_len=256, chunk=4,
+                    prompt_buckets=(64, 128, 255), prefix_cache_size=2,
+                    kv_block=64, kv_blocks=4)
+    system = _prompt(120, 200, cfg.vocab_size)  # entry: 3 full blocks
+    rid = engine.submit(GenRequest(tokens=system, max_new_tokens=2,
+                                   cache_prefix=True))
+    engine.run()
+    engine.result(rid, timeout=0)
+    assert engine.stats()["kv_blocks_used"] == 3
+
+    rid = engine.submit(GenRequest(tokens=system[:128] + [5],
+                                   max_new_tokens=100))
+    for _ in range(200):  # bounded: pre-fix this spun forever
+        if not engine.pending():
+            break
+        engine.step()
+    assert not engine.pending(), "queue wedged on the pinned entry"
+    assert len(engine.result(rid, timeout=0)) == 100
+    st = engine.stats()
+    assert st["prefix_entries"] == 0  # the matched entry was sacrificed
+    assert st["kv_blocks_used"] == 0 and st["kv_blocks_free"] == 4
+
+
+def test_mutually_aliased_entries_cleared_not_deadlocked(setup):
+    """Review regression (round 2): two prefix entries sharing the
+    SAME block set leave every block at ref 2, so no per-entry
+    exclusivity test can free anything — an unrelated request that
+    fits the pool but not pool-minus-the-pinned-set must still admit
+    (the idle fallback clears the whole cache) instead of wedging the
+    queue on an idle engine."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                    prompt_buckets=(16, 32, 48), prefix_cache_size=2,
+                    kv_block=16, kv_blocks=4)
+    base = _prompt(130, 32, cfg.vocab_size)  # 2 full blocks
+    for tokens in (base, base + _prompt(131, 7, cfg.vocab_size)):
+        rid = engine.submit(GenRequest(tokens=tokens, max_new_tokens=2,
+                                       cache_prefix=True))
+        engine.run()
+        engine.result(rid, timeout=0)
+    with engine._lock:
+        sets = [tuple(b) for b, _ in engine._prefix_cache.values()]
+    assert len(sets) == 2 and sets[0] == sets[1]  # same blocks, ref 2
+    assert engine.stats()["kv_blocks_shared"] == 2
+
+    # Unrelated request: worst case 3 blocks vs 2 free.
+    rid = engine.submit(GenRequest(
+        tokens=_prompt(132, 20, cfg.vocab_size), max_new_tokens=25,
+    ))
+    for _ in range(100):  # bounded: pre-fix this spun forever
+        if not engine.pending():
+            break
+        engine.step()
+    assert not engine.pending(), "queue wedged on mutually-aliased set"
+    assert len(engine.result(rid, timeout=0)) == 25
+    st = engine.stats()
+    assert st["prefix_entries"] == 0 and st["kv_blocks_used"] == 0
+
+
+def test_transient_shortage_keeps_unreclaimable_entries(setup):
+    """Review regression (round 2): with slots RUNNING, a shortage
+    that eviction cannot cover must not flush the prefix cache — the
+    entries' future hits are worth more than zero freed blocks."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=3, max_len=64, chunk=4,
+                    prompt_buckets=(16, 32, 48), prefix_cache_size=2,
+                    kv_block=16, kv_blocks=4)
+    system = _prompt(140, 32, cfg.vocab_size)
+    rid = engine.submit(GenRequest(tokens=system, max_new_tokens=2,
+                                   cache_prefix=True))
+    engine.run()
+    engine.result(rid, timeout=0)
+    # A long-running request sharing the entry: the entry's blocks are
+    # aliased by a LIVE slot (exclusive = 0), one fresh block in use.
+    long_rid = engine.submit(GenRequest(tokens=system + [3],
+                                        max_new_tokens=12))
+    engine.step()
+    # Head-of-line request needs 2 fresh blocks; free == 1 and the
+    # entry is unreclaimable — must defer WITHOUT evicting it.
+    short_rid = engine.submit(GenRequest(
+        tokens=_prompt(141, 16, cfg.vocab_size), max_new_tokens=12,
+    ))
+    engine.step()
+    st = engine.stats()
+    assert st["kv_admit_deferrals"] >= 1
+    assert st["prefix_entries"] == 1, "transient shortage flushed cache"
+    results = engine.run()  # the long request frees; short admits
+    assert len(results[long_rid]) == 12 and len(results[short_rid]) == 12
+
+
+def test_abort_and_deadline_reap_release_blocks(setup, paged_engine):
+    """The two failure funnels give their blocks back: abort() with a
+    chunk in flight, and a deadline reaped mid-decode."""
+    cfg, params = setup
+    engine = paged_engine
+    _clear_prefix(engine)
+
+    rids = [
+        engine.submit(GenRequest(
+            tokens=_prompt(80 + i, 5, cfg.vocab_size), max_new_tokens=12,
+        ))
+        for i in range(2)
+    ]
+    engine.step()
+    assert engine.stats()["kv_blocks_used"] > 0
+    engine.abort("test abort")
+    st = engine.stats()
+    assert st["kv_blocks_used"] == 0 and st["kv_blocks_free"] == 24
+    for rid in rids:
+        with pytest.raises(RuntimeError, match="test abort"):
+            engine.result(rid, timeout=0)
+
+    rid = engine.submit(GenRequest(
+        tokens=_prompt(82, 5, cfg.vocab_size), max_new_tokens=40,
+        deadline=time.monotonic() + 0.2,
+    ))
+    engine.step()
+    assert engine.stats()["kv_blocks_used"] > 0
+    time.sleep(0.25)
+    while engine.pending():  # _reap frees the slot at a step boundary
+        engine.step()
+    assert engine.stats()["kv_blocks_used"] == 0
+    with pytest.raises(RequestFailedError, match="deadline"):
+        engine.result(rid, timeout=0)
+
+
+def test_chaos_soak_zero_leaked_blocks(setup, paged_engine):
+    """Mixed traffic (greedy/sampled/prefix-marked), client cancels,
+    and a mid-flight abort every third cycle: after every cycle the
+    allocator's books balance — used blocks are exactly the prefix
+    cache's holdings, free + used == total."""
+    cfg, params = setup
+    engine = paged_engine
+    _clear_prefix(engine)
+    rng = np.random.RandomState(7)
+
+    for cycle in range(6):
+        rids = []
+        for i in range(4):
+            rids.append(engine.submit(GenRequest(
+                tokens=_prompt(100 + 10 * cycle + i,
+                               int(rng.randint(4, 14)), cfg.vocab_size),
+                max_new_tokens=int(rng.randint(2, 10)),
+                temperature=0.8 if i % 2 else 0.0, seed=i,
+                cache_prefix=(i == 0),
+            )))
+        engine.step()
+        engine.cancel(rids[int(rng.randint(0, 4))])
+        if cycle % 3 == 2:
+            engine.step()
+            engine.abort("chaos")
+        else:
+            engine.run()
+        for rid in rids:
+            try:
+                engine.result(rid, timeout=0)
+            except (RuntimeError, KeyError, TimeoutError):
+                pass
+        st = engine.stats()
+        with engine._lock:
+            entry_held = sum(
+                len(blocks) for blocks, _ in engine._prefix_cache.values()
+            )
+        assert st["kv_blocks_used"] == entry_held, f"cycle {cycle} leaked"
+        assert st["kv_blocks_free"] + st["kv_blocks_used"] == 24
+    assert engine.in_flight() == 0
+
+
+# ---------------------------------------------------------------------------
+# Observability surfaces
+
+
+def test_stats_info_load_surface_kv_occupancy(setup, paged_engine,
+                                              dense_engine):
+    cfg, params = setup
+    st = paged_engine.stats()
+    assert st["kv_block_size"] == 8 and st["kv_blocks_total"] == 24
+    assert set(st) >= {
+        "kv_blocks_free", "kv_blocks_used", "kv_blocks_shared",
+        "kv_fragmentation", "kv_admit_deferrals", "prefix_bytes_saved",
+        "prefix_injects",
+    }
+    info = paged_engine.info()["engine"]
+    assert info["paged"] is True and info["kv_block"] == 8
+    assert info["kv_blocks"] == 24
+    load = paged_engine.load()
+    assert load["kv_blocks_total"] == 24
+    assert {"kv_blocks_free", "kv_blocks_shared"} <= set(load)
+    # Dense engines export the same schema, zeroed.
+    dst = dense_engine.stats()
+    assert dst["kv_block_size"] == 0 and dst["kv_blocks_total"] == 0
+    assert dense_engine.info()["engine"]["paged"] is False
+    assert dense_engine.load()["kv_blocks_total"] == 0
+
+
+def test_fragmentation_reflects_block_rounding(setup, paged_engine):
+    """A 5-token prompt + 3-token budget reserves 2 whole 8-token
+    blocks (prefill bucket 16): mid-flight fragmentation is the
+    allocated-but-idle tail, and it returns to the prefix-entries-only
+    baseline once the request completes."""
+    cfg, params = setup
+    engine = paged_engine
+    _clear_prefix(engine)
+    rid = engine.submit(GenRequest(
+        tokens=_prompt(90, 5, cfg.vocab_size), max_new_tokens=3,
+    ))
+    engine.step()
+    st = engine.stats()
+    assert st["kv_blocks_used"] == 2  # bucket 16 rows -> 2 blocks
+    assert 0.0 < st["kv_fragmentation"] < 1.0
+    engine.run()
+    engine.result(rid, timeout=0)
+    assert engine.stats()["kv_fragmentation"] == 0.0
+
+
+def test_paged_engine_validation(setup):
+    cfg, params = setup
+    with pytest.raises(ValueError, match="divide"):
+        Engine(params, cfg, n_slots=1, max_len=64, kv_block=7)
+    with pytest.raises(ValueError, match="kv_blocks needs"):
+        Engine(params, cfg, n_slots=1, max_len=64, kv_blocks=4)
+    with pytest.raises(ValueError, match="kv_block"):
+        Engine(params, cfg, n_slots=1, max_len=64, kv_block=-1)
