@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-autoscale lint lint-metrics agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-autoscale test-jit-guard lint lint-metrics lint-jax agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -70,7 +70,8 @@ test-observability:
 # ownership stays clean in the analyzer, not grandfathered in baseline.
 test-serve:
 	$(PYTHON) -m tools.oimlint \
-	  --passes lock-discipline,resource-lifecycle --roots oim_tpu/serve
+	  --passes lock-discipline,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
+	  --roots oim_tpu/serve
 	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_serve_pipeline.py -q -m "not slow" -p no:cacheprovider
 
@@ -85,7 +86,7 @@ test-serve:
 # the allocator's lock ownership stays analyzer-clean.
 test-serve-paged:
 	$(PYTHON) -m tools.oimlint \
-	  --passes lock-discipline,resource-lifecycle \
+	  --passes lock-discipline,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
 	  --roots oim_tpu/serve,oim_tpu/ops
 	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_serve_paged.py -q -m "not slow" -p no:cacheprovider
@@ -141,6 +142,28 @@ lint:
 # oimlint's `metrics` pass.
 lint-metrics:
 	$(PYTHON) -m tools.oimlint --passes metrics
+
+# The jaxvet family standalone (ISSUE 11): donation-safety,
+# host-sync-discipline, retrace-risk over the whole tree — the JAX
+# hot-path hygiene slice of `make lint`, for the edit-compile loop on
+# engine/kernel code (<10 s; the full lint is also fast, this is
+# faster).
+lint-jax:
+	$(PYTHON) -m tools.oimlint \
+	  --passes donation-safety,host-sync-discipline,retrace-risk
+
+# Steady-state recompile guard (ISSUE 11): a WARM engine must pay ZERO
+# XLA compiles under live traffic — N decode chunks + a mid-stream
+# admission + a CoW-triggering prefix hit, {dense, paged} x {pipeline
+# depth 1, 2} — counted via jax.monitoring's per-compile event, with
+# negative controls proving the counter trips.  The runtime complement
+# of the static retrace-risk pass (which cannot see shape-dependent
+# recompiles).  Nominal ~15 s; 60 s cap carries the box's CPU-quota
+# swings.
+test-jit-guard:
+	timeout -k 10 60 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_jit_guard.py -q -m "jit_guard and not slow" \
+	  -p no:cacheprovider
 
 # Tier 3: the full stack driving a first op on the real accelerator
 # (≙ reference env-gated real-SPDK tests, test/test.make:1-16).

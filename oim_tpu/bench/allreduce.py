@@ -152,7 +152,10 @@ def collective_bench(
                 sharding,
             )
             fn, bus_factor = _collective_ops(jax, jnp, n, per_chip)[op]
-            step = jax.jit(
+            # Each (size, op) point benchmarks a DIFFERENT program — a
+            # fresh jit per iteration is the measurement, not a leak,
+            # and the warmup loop below pays its compile before timing.
+            step = jax.jit(  # oimlint: disable=retrace-risk
                 jax.shard_map(
                     fn, mesh=mesh, in_specs=P("x"),
                     out_specs=P(None) if op == "all_gather" else P("x"),

@@ -1,0 +1,21 @@
+"""oimlint fixture: serve-plane HTTP/socket calls without deadlines."""
+
+import http.client
+import socket
+import urllib.request
+
+
+def leaky_http(opener, url, req, urlopen):
+    urllib.request.urlopen(url)  # oimlint-expect: deadline-hygiene
+    urlopen(req)  # oimlint-expect: deadline-hygiene
+    opener.open(req)  # oimlint-expect: deadline-hygiene
+    socket.create_connection(("backend", 80))  # oimlint-expect: deadline-hygiene
+    http.client.HTTPSConnection("backend")  # oimlint-expect: deadline-hygiene
+
+
+def leaky_chained(build_opener, req):
+    my_opener(build_opener).open(req)  # oimlint-expect: deadline-hygiene
+
+
+def my_opener(factory):
+    return factory()

@@ -1,0 +1,18 @@
+"""oimlint fixture: serve-plane HTTP/socket calls, all bounded."""
+
+import http.client
+import socket
+import urllib.request
+
+
+def bounded_http(opener, url, req, urlopen, attempt):
+    urllib.request.urlopen(url, timeout=5)
+    urllib.request.urlopen(url, None, 5)  # positional timeout (3rd)
+    opener.open(req, None, 5)  # positional timeout (3rd)
+    urlopen(req, timeout=attempt.clamped())
+    opener.open(req, timeout=2)
+    socket.create_connection(("backend", 80), 3)  # positional timeout
+    socket.create_connection(("backend", 80), timeout=3)
+    http.client.HTTPSConnection("backend", timeout=4)
+    http.client.HTTPConnection("backend", 80, 5)  # positional timeout
+    open("/tmp/scratch")  # plain file open: never an HTTP finding

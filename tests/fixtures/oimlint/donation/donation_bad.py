@@ -1,0 +1,44 @@
+"""oimlint fixture: donation-safety violations (see lock_bad.py for
+the ``oimlint-expect`` marker convention)."""
+
+from functools import partial
+
+import jax
+
+
+def _step(cache, tokens, *, cfg):
+    return cache, tokens
+
+
+def _merge(left, right):
+    return left
+
+
+class LeakyEngine:
+    """Donates its cache and then touches the corpse."""
+
+    def __init__(self, cfg):
+        self._step = jax.jit(partial(_step, cfg=cfg), donate_argnums=(0,))
+        self._merge = jax.jit(_merge, donate_argnums=(0, 1))
+
+    def use_after_donate(self, cache, tokens):
+        out = self._step(cache, tokens)
+        return cache.sum() + out[1]  # oimlint-expect: donation-safety
+
+    def read_before_rebind(self, cache, tokens):
+        self._step(cache, tokens)
+        cache = cache + 1  # oimlint-expect: donation-safety
+        return cache
+
+    def double_donation(self, buf):
+        return self._merge(buf, buf)  # oimlint-expect: donation-safety
+
+
+def factory_use_after_donate(make_step, state, batch):
+    step = make_step()
+    step(state, batch)
+    return state  # oimlint-expect: donation-safety
+
+
+def make_step():
+    return jax.jit(_merge, donate_argnums=(0,))

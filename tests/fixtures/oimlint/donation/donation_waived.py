@@ -1,0 +1,27 @@
+"""oimlint fixture: waiver placement for donation-safety — same-line
+and line-above waivers suppress; the unwaived sibling still fires."""
+
+import jax
+
+
+def _consume(buf, extra):
+    return buf
+
+
+class WaivedEngine:
+    def __init__(self):
+        self._consume = jax.jit(_consume, donate_argnums=(0,))
+
+    def waived_same_line(self, buf, extra):
+        self._consume(buf, extra)
+        # The device aliasing here is intentional and test-covered.
+        return buf.sum()  # oimlint: disable=donation-safety
+
+    def waived_line_above(self, buf, extra):
+        self._consume(buf, extra)
+        # oimlint: disable=donation-safety
+        return buf.sum()
+
+    def unwaived_sibling(self, buf, extra):
+        self._consume(buf, extra)
+        return buf.sum()  # oimlint-expect: donation-safety

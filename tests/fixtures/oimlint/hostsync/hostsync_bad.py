@@ -1,0 +1,43 @@
+"""oimlint fixture: host-sync-discipline violations on a marked hot
+path (see lock_bad.py for the ``oimlint-expect`` marker convention)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _kernel(x):
+    return x
+
+
+class HotEngine:
+    def __init__(self):
+        self._kern = jax.jit(_kernel)
+
+    # oimlint: hotpath
+    def bad_chunk(self, x):
+        y = self._kern(x)
+        n = float(y)  # oimlint-expect: host-sync-discipline
+        z = y.item()  # oimlint-expect: host-sync-discipline
+        t = y.tolist()  # oimlint-expect: host-sync-discipline
+        h = jax.device_get(y)  # oimlint-expect: host-sync-discipline
+        w = np.asarray(y)  # oimlint-expect: host-sync-discipline
+        return n, z, t, h, w
+
+    # oimlint: hotpath
+    def bad_derived(self, x):
+        y = jnp.exp(x)
+        part = y[0] + 1  # subscript + arithmetic keep the taint
+        return int(part)  # oimlint-expect: host-sync-discipline
+
+    # oimlint: hotpath
+    def bad_blocking(self, x):
+        y = self._kern(x)
+        y.block_until_ready()  # oimlint-expect: host-sync-discipline
+        return y
+
+    # oimlint: hotpath
+    def bad_const_rebuild(self, x):
+        key = jax.random.PRNGKey(0)  # oimlint-expect: host-sync-discipline
+        filler = jnp.zeros((4,), jnp.float32)  # oimlint-expect: host-sync-discipline
+        return self._kern(x), key, filler

@@ -1,0 +1,18 @@
+"""oimlint fixture: a hot-path function with NO in-line marker — only
+the per-module table (``HOTPATH_TABLE`` / the ``table=`` parameter)
+designates it, so the default fixture run finds nothing here and the
+table-designation unit test finds exactly one sync."""
+
+import jax
+
+
+def _kernel(x):
+    return x
+
+
+STEP = jax.jit(_kernel)
+
+
+def table_hot(x):
+    y = STEP(x)
+    return float(y)  # flagged only when the table marks table_hot

@@ -1,0 +1,33 @@
+"""oimlint fixture: waiver placement for host-sync-discipline."""
+
+import jax
+
+
+def _kernel(x):
+    return x
+
+
+class WaivedEngine:
+    def __init__(self):
+        self._kern = jax.jit(_kernel)
+
+    # oimlint: hotpath
+    def waived_sync(self, x):
+        y = self._kern(x)
+        # Shutdown barrier: this sync is deliberate and documented.
+        y.block_until_ready()  # oimlint: disable=host-sync-discipline
+        # oimlint: disable=host-sync-discipline
+        host = jax.device_get(y)
+        return float(y)  # oimlint-expect: host-sync-discipline
+
+    # oimlint: hotpath
+    def table_designated(self, x):
+        # No marker needed when HOTPATH_TABLE names the function — this
+        # one has a marker anyway; hostsync_table.py carries the
+        # table-only twin.
+        y = self._kern(x)
+        return host_only(y)
+
+
+def host_only(y):
+    return y

@@ -1,0 +1,41 @@
+"""oimlint fixture: retrace-risk violations (see lock_bad.py for the
+``oimlint-expect`` marker convention)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _branchy(x, flag, *, mode):
+    if mode:  # static (keyword-only config): fine
+        x = x + 1
+    if flag:  # oimlint-expect: retrace-risk
+        x = x * 2
+    while flag:  # oimlint-expect: retrace-risk
+        x = x - 1
+    return x
+
+
+STEP = jax.jit(partial(_branchy, mode=True))
+
+
+def scalar_feeder(xs):
+    n = len(xs)
+    a = STEP(jnp.zeros((4,)), len(xs))  # oimlint-expect: retrace-risk
+    b = STEP(jnp.zeros((4,)), n)  # oimlint-expect: retrace-risk
+    return a, b
+
+
+def rebuilt_in_loop(batches):
+    out = []
+    for batch in batches:
+        f = jax.jit(_branchy)  # oimlint-expect: retrace-risk
+        out.append(f)
+    return out
+
+
+# oimlint: hotpath
+def rebuilt_on_hot_path(x):
+    g = jax.jit(lambda v: v + 1)  # oimlint-expect: retrace-risk
+    return g(x)

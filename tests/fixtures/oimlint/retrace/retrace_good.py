@@ -1,0 +1,51 @@
+"""oimlint fixture: trace-stable jit usage — no findings anywhere in
+this file."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _stable(x, flag, *, mode):
+    if mode:  # partial-bound keyword: trace-time constant
+        x = x + 1
+    if x.shape[0] > 2:  # shape is static under trace
+        x = x * 2
+    if flag is None:  # type-level dispatch: trace-static
+        x = x - 1
+    if isinstance(x, tuple):  # isinstance dispatch: trace-static
+        x = x[0]
+    paged = isinstance(x, tuple)
+    if paged:  # local from isinstance: trace-static in practice
+        x = x[0]
+    return jnp.where(flag, x, -x)  # data-dependent select, no retrace
+
+
+CLEAN = jax.jit(partial(_stable, mode=1), static_argnums=(1,))
+PLAIN = jax.jit(partial(_stable, mode=1))
+
+
+def static_scalar_ok(xs):
+    # Position 1 is static by declaration: a varying python scalar
+    # there is a deliberate compile-per-value choice.
+    n = len(xs)
+    return CLEAN(jnp.zeros((4,)), n)
+
+
+def wrapped_scalar_ok(xs):
+    # Wrapping the scalar makes it a device value: no cache-key churn.
+    return PLAIN(jnp.zeros((4,)), jnp.asarray(len(xs)))
+
+
+def build_table_once(buckets):
+    # The engine's per-bucket jit table: a comprehension in __init__ is
+    # build-once, not per-step — exempt from the loop rule.
+    return {b: jax.jit(partial(_stable, mode=b)) for b in buckets}
+
+
+def waived_rebuild(shapes):
+    for shape in shapes:
+        # Each shape IS a different program here — a bench-style sweep.
+        f = jax.jit(_stable)  # oimlint: disable=retrace-risk
+        yield f, shape

@@ -1,0 +1,190 @@
+"""Steady-state recompile guard (ISSUE 11): a WARM serve engine pays
+zero XLA compiles under live traffic.
+
+The static retrace-risk pass catches the statically-visible recompile
+shapes (python branches on traced params, scalar cache-key churn, jit
+rebuilt per step); this suite is the runtime complement for everything
+it cannot see — shape-dependent recompiles, weak-type promotion, an
+unwarmed code path reached first by live traffic.  It counts backend
+compiles via ``jax.monitoring``'s per-compile duration event around a
+warmed engine driving the steady-state traffic mix the serve plane
+actually runs:
+
+    N decode chunks + one mid-stream admission + one prefix hit
+    (CoW-triggering on paged), across {dense, paged} x {pipeline
+    depth 1, depth 2}
+
+and pins the count at **zero**.  Negative controls prove the counter
+works: a fresh jit trips it, and a cold (never-warmed) engine trips it
+from the very first admission.
+
+On a TPU one stray compile is 20-40 s of dead air mid-stream; on the
+CPU CI backend the same event is milliseconds — which is exactly why
+this is pinned by COUNT, not by latency.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.monitoring
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.serve import Engine, GenRequest
+
+pytestmark = pytest.mark.jit_guard
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+# One backend-compile duration event fires per XLA compilation; the
+# steady-state assertion is "no NEW events", so a process-wide counter
+# plus deltas is race-free within the (single-threaded) test.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compiles = [0]
+
+
+def _on_duration(event: str, duration: float, **kwargs) -> None:
+    if event == _COMPILE_EVENT:
+        _compiles[0] += 1
+
+
+jax.monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+class compile_delta:
+    """``with compile_delta() as d: ...; d.count`` — compiles inside."""
+
+    def __enter__(self):
+        self._start = _compiles[0]
+        return self
+
+    def __exit__(self, *exc):
+        self.count = _compiles[0] - self._start
+        return False
+
+    @property
+    def so_far(self) -> int:
+        return _compiles[0] - self._start
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _prompt(seed: int, n: int, vocab: int) -> list[int]:
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, vocab, size=n).tolist()
+
+
+def _make_engine(setup, *, paged: bool, depth: int) -> Engine:
+    cfg, params = setup
+    kwargs = dict(
+        n_slots=3, max_len=64, chunk=4, prompt_buckets=(16, 32),
+        prefix_cache_size=2, pipeline_depth=depth,
+    )
+    if paged:
+        kwargs["kv_block"] = 8
+    return Engine(params, cfg, **kwargs)
+
+
+def _steady_traffic(engine: Engine, vocab: int) -> dict:
+    """The serve plane's steady-state mix: a cached system prompt, a
+    few decode chunks, a mid-stream admission joining at a pipeline
+    boundary, and a prefix hit whose length is deliberately NOT
+    block-aligned (12 tokens, kv_block 8) so the paged planner takes
+    the copy-on-write path too."""
+    system = _prompt(1, 12, vocab)
+    r1 = engine.submit(GenRequest(
+        tokens=system, max_new_tokens=10, cache_prefix=True,
+    ))
+    engine.step()
+    engine.step()
+    # Mid-stream admission: r1 still decoding, r2 joins at a boundary.
+    r2 = engine.submit(GenRequest(
+        tokens=_prompt(2, 6, vocab), max_new_tokens=6,
+        temperature=0.8, seed=7,
+    ))
+    engine.step()
+    # Prefix hit: shares the cached system prompt, adds a tail.
+    r3 = engine.submit(GenRequest(
+        tokens=system + _prompt(3, 5, vocab), max_new_tokens=5,
+    ))
+    results = engine.run()
+    assert len(results[r1]) == 10
+    assert len(results[r2]) == 6
+    assert len(results[r3]) == 5
+    return results
+
+
+@pytest.mark.parametrize(
+    "paged,depth",
+    [(False, 1), (False, 2), (True, 1), (True, 2)],
+    ids=["dense-d1", "dense-d2", "paged-d1", "paged-d2"],
+)
+def test_warm_engine_steady_state_compiles_zero(setup, paged, depth):
+    """THE pin: {dense, paged} x {depth 1, 2}, zero compiles after
+    warmup across decode chunks, a mid-stream admission, and a prefix
+    hit (CoW-triggering on paged)."""
+    engine = _make_engine(setup, paged=paged, depth=depth)
+    engine.warmup()
+    with compile_delta() as d:
+        _steady_traffic(engine, CFG["vocab_size"])
+    assert d.count == 0, (
+        f"steady state recompiled {d.count}x (paged={paged}, "
+        f"depth={depth}) — a live TPU pays 20-40s of dead air per event"
+    )
+
+
+def test_prefix_hit_is_copy_free_reuse(setup):
+    """The zero-compile run above must actually have exercised the
+    prefix machinery (a vacuous guard would pass on any engine)."""
+    engine = _make_engine(setup, paged=True, depth=2)
+    engine.warmup()
+    before = engine.prefix_hits + engine.prefix_injects
+    _steady_traffic(engine, CFG["vocab_size"])
+    assert engine.prefix_hits + engine.prefix_injects > before
+
+
+def test_negative_control_fresh_jit_trips_counter():
+    """The counter counts: a brand-new jit program is one compile."""
+    with compile_delta() as d:
+        jax.jit(lambda x: x * 2 + 1)(jnp.arange(7))
+    assert d.count >= 1
+
+
+def test_negative_control_cold_engine_trips_guard(setup):
+    """The deliberate-retrace injection: the same traffic on a NEVER
+    warmed engine compiles on the spot — the guard assertion would
+    fail, proving it can."""
+    engine = _make_engine(setup, paged=False, depth=2)
+    with compile_delta() as d:
+        _steady_traffic(engine, CFG["vocab_size"])
+    assert d.count >= 1, "cold engine compiled nothing — counter broken"
+
+
+def test_negative_control_unwarmed_surface_trips_guard(setup):
+    """A subtler injected retrace: warm the engine WITHOUT the embed
+    surface (``warmup(embed=False)``, the default), then hit
+    ``engine.embed`` — an unwarmed program, so the guard counts its
+    compile.  This is the exact failure mode the guard exists for: a
+    surface the warmup recipe forgot, found by count instead of by a
+    20-40s TPU stall on live traffic."""
+    cfg, _params = setup
+    engine = _make_engine(setup, paged=False, depth=1)
+    engine.warmup()
+    with compile_delta() as d:
+        engine.embed(_prompt(5, 6, cfg.vocab_size))
+    assert d.count >= 1, "unwarmed embed surface compiled nothing"

@@ -24,7 +24,15 @@ import pytest
 
 from tools.oimlint import core, runner
 from tools.oimlint.core import Finding, SourceTree
-from tools.oimlint.passes import ALL_PASSES, authz, metricspass, protocol
+from tools.oimlint.passes import (
+    ALL_PASSES,
+    JAX_PASSES,
+    authz,
+    hostsync,
+    jaxsites,
+    metricspass,
+    protocol,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "oimlint")
@@ -109,6 +117,33 @@ class TestPassesOnFixtures:
         )
         assert by_location(found) == expected_markers("protocol")
 
+    def test_donation_safety(self):
+        found = runner.run_passes(
+            fixture_tree("donation"), ["donation-safety"]
+        )
+        assert by_location(found) == expected_markers("donation")
+
+    def test_host_sync_discipline(self):
+        found = runner.run_passes(
+            fixture_tree("hostsync"), ["host-sync-discipline"]
+        )
+        assert by_location(found) == expected_markers("hostsync")
+
+    def test_retrace_risk(self):
+        found = runner.run_passes(fixture_tree("retrace"), ["retrace-risk"])
+        assert by_location(found) == expected_markers("retrace")
+
+    def test_hotpath_table_designation(self):
+        """A function named only in the per-module table (no in-line
+        marker) is hot-path too: hostsync_table.py yields exactly its
+        one sync under the table and nothing without it."""
+        tree = fixture_tree("hostsync")
+        found = hostsync.run(
+            tree, table={"hostsync_table.py": ("table_hot",)}
+        )
+        table_hits = [f for f in found if f.file == "hostsync_table.py"]
+        assert len(table_hits) == 1 and "float()" in table_hits[0].message
+
     def test_authz_mutually_recursive_forwarders_dont_crash(self, tmp_path):
         """Path parameters forwarded in a cycle must resolve to an
         'unresolvable' finding via the depth cap, never a RecursionError
@@ -139,6 +174,157 @@ class TestPassesOnFixtures:
             "no WRITERS entry" in f.message for f in found
         )
         assert {f.file for f in found} == {"writer_bad.py", "writer_good.py"}
+
+
+class TestJitSiteResolver:
+    """The shared jaxvet resolver: binding shapes, donate/static
+    parsing, partial unwrapping, factories, arity disambiguation."""
+
+    def _resolve(self, tmp_path, src):
+        (tmp_path / "mod.py").write_text('"""tmp fixture."""\n' + src)
+        tree = SourceTree(repo=str(tmp_path), roots=(".",))
+        facts = jaxsites.tree_factories(tree)
+        return jaxsites.resolve(tree, "mod.py", facts)
+
+    def test_attribute_binding_with_partial(self, tmp_path):
+        sites = self._resolve(tmp_path, (
+            "import jax\n"
+            "from functools import partial\n"
+            "def _decode(params, cache, toks, *, cfg, chunk):\n"
+            "    return cache, toks\n"
+            "class Engine:\n"
+            "    def __init__(self, cfg):\n"
+            "        self._decode = jax.jit(\n"
+            "            partial(_decode, cfg=cfg, chunk=4),\n"
+            "            donate_argnums=(1,),\n"
+            "        )\n"
+        ))
+        (site,) = sites.by_binding["self._decode"]
+        assert site.target == "_decode"
+        assert site.donate == (1,)
+        assert set(site.bound_kwargs) == {"cfg", "chunk"}
+        assert site.target_arity == 3
+
+    def test_donate_and_static_interaction(self, tmp_path):
+        """donate and static argnums both index the ORIGINAL positional
+        signature; the resolver must keep them separate."""
+        sites = self._resolve(tmp_path, (
+            "import jax\n"
+            "def _step(mode, cache, toks):\n"
+            "    return cache\n"
+            "step = jax.jit(_step, static_argnums=(0,),"
+            " donate_argnums=(1,))\n"
+        ))
+        (site,) = sites.by_binding["step"]
+        assert site.static == (0,) and site.donate == (1,)
+        assert site.target_arity == 3
+
+    def test_conditional_binding_variants_kept(self, tmp_path):
+        """if/else rebinding records BOTH variants; arity picks the one
+        a call site can reach (the engine's _decode idiom)."""
+        sites = self._resolve(tmp_path, (
+            "import jax\n"
+            "def _plain(params, cache, toks):\n"
+            "    return cache\n"
+            "def _spec(params, draft, cache, toks, hist):\n"
+            "    return cache\n"
+            "class Engine:\n"
+            "    def __init__(self, spec):\n"
+            "        if spec:\n"
+            "            self._decode = jax.jit(_spec,"
+            " donate_argnums=(2, 4))\n"
+            "        else:\n"
+            "            self._decode = jax.jit(_plain,"
+            " donate_argnums=(1,))\n"
+        ))
+        variants = sites.by_binding["self._decode"]
+        assert {v.target_arity for v in variants} == {3, 5}
+        plain = jaxsites.sites_for_call(variants, 3)
+        assert [s.donate for s in plain] == [(1,)]
+        spec = jaxsites.sites_for_call(variants, 5)
+        assert [s.donate for s in spec] == [(2, 4)]
+        # Unknown arity: every variant stays in play.
+        assert len(jaxsites.sites_for_call(variants, 9)) == 2
+
+    def test_factory_binding_cross_module(self, tmp_path):
+        (tmp_path / "factory.py").write_text(
+            '"""tmp fixture."""\n'
+            "import jax\n"
+            "def make_step(cfg):\n"
+            "    def step(state, batch):\n"
+            "        return state\n"
+            "    return jax.jit(step, donate_argnums=(0,))\n"
+        )
+        (tmp_path / "user.py").write_text(
+            '"""tmp fixture."""\n'
+            "from factory import make_step\n"
+            "step_fn = make_step(None)\n"
+        )
+        tree = SourceTree(repo=str(tmp_path), roots=(".",))
+        facts = jaxsites.tree_factories(tree)
+        assert facts["make_step"].donate == (0,)
+        sites = jaxsites.resolve(tree, "user.py", facts)
+        (site,) = sites.by_binding["step_fn"]
+        assert site.donate == (0,) and site.target == "step"
+
+    def test_donate_argnames_are_donated_not_static(self, tmp_path):
+        """donate_argnames params are DONATED (and traced): a
+        use-after-donate through one must be found, positionally or by
+        keyword, and retrace-risk must still flag a branch on one."""
+        (tmp_path / "m.py").write_text(
+            '"""tmp fixture."""\n'
+            "import jax\n"
+            "def _step(cache, n):\n"
+            "    if n:\n"
+            "        cache = cache * 2\n"
+            "    return cache\n"
+            "step = jax.jit(_step, donate_argnames=('cache',))\n"
+            "def use_positional(cache, n):\n"
+            "    step(cache, n)\n"
+            "    return cache + 1\n"
+            "def use_keyword(cache, n):\n"
+            "    step(n=n, cache=cache)\n"
+            "    return cache + 1\n"
+        )
+        tree = SourceTree(repo=str(tmp_path), roots=(".",))
+        donation_found = runner.run_passes(tree, ["donation-safety"])
+        assert len(donation_found) == 2 and all(
+            "use-after-donate" in f.message for f in donation_found
+        )
+        retrace_found = runner.run_passes(tree, ["retrace-risk"])
+        assert len(retrace_found) == 1 and "'n'" in retrace_found[0].message
+
+    def test_dual_wrapping_checks_each_static_signature(self, tmp_path):
+        """The same function wrapped twice — once with static_argnums,
+        once without — must be body-checked under BOTH signatures: the
+        unstatic wrapping's branch-on-param is a retrace the static one
+        hides.  Identical findings still dedupe to one."""
+        (tmp_path / "m.py").write_text(
+            '"""tmp fixture."""\n'
+            "import jax\n"
+            "def f(mode, x):\n"
+            "    if mode:\n"
+            "        x = x + 1\n"
+            "    return x\n"
+            "fast = jax.jit(f, static_argnums=(0,))\n"
+            "slow = jax.jit(f)\n"
+        )
+        tree = SourceTree(repo=str(tmp_path), roots=(".",))
+        found = runner.run_passes(tree, ["retrace-risk"])
+        assert len(found) == 1 and "mode" in found[0].message
+
+    def test_computed_argnums_resolve_empty(self, tmp_path):
+        """Non-literal donate_argnums degrade to () — silence beats a
+        wrong guess (documented under-approximation)."""
+        sites = self._resolve(tmp_path, (
+            "import jax\n"
+            "DONATE = (0,)\n"
+            "def _f(x):\n"
+            "    return x\n"
+            "g = jax.jit(_f, donate_argnums=DONATE)\n"
+        ))
+        (site,) = sites.by_binding["g"]
+        assert site.donate == ()
 
 
 class TestWaivers:
@@ -212,7 +398,7 @@ class TestLiveTree:
         )
         assert not stale, f"stale baseline entries (run --update-baseline): {stale}"
 
-    def test_all_six_passes_registered(self):
+    def test_all_nine_passes_registered(self):
         assert set(ALL_PASSES) == {
             "lock-discipline",
             "resource-lifecycle",
@@ -220,7 +406,28 @@ class TestLiveTree:
             "protocol-drift",
             "deadline-hygiene",
             "metrics",
+            "donation-safety",
+            "host-sync-discipline",
+            "retrace-risk",
         }
+        assert set(JAX_PASSES) == {
+            "donation-safety",
+            "host-sync-discipline",
+            "retrace-risk",
+        }
+
+    def test_engine_hotpath_spine_is_marked(self):
+        """The serve engine's pipeline spine must STAY designated
+        hot-path — removing a marker silently exempts the function from
+        the host-sync gate."""
+        tree = SourceTree()
+        hot = set(jaxsites.hotpath_functions(tree, "oim_tpu/serve/engine.py"))
+        assert {
+            "_step_inner", "_admit_wave", "_dispatch_chunk",
+            "_process_chunk", "_prefill_segment", "_device_tables",
+            "_admit_batch", "_decode_chunk", "_decode_chunk_spec",
+            "_decode_chunk_spec_model", "_admit_draft",
+        } <= hot
 
     def test_protocol_sources_nonempty(self):
         """The three protocol sources of truth must all parse non-empty
@@ -234,6 +441,65 @@ class TestLiveTree:
         # Spot-check the core verbs every daemon must serve.
         for name in ("get_chips", "create_allocation", "delete_allocation"):
             assert name in implemented and name in documented
+
+
+class TestJaxHarvestRegressions:
+    """One pin per ISSUE 11 harvest fix: the constants the hostsync
+    pass flagged on the engine's hot path stay hoisted.  The passes
+    themselves enforce "no NEW violations"; these pins name the exact
+    fixes so a revert fails with a message, not a generic lint diff."""
+
+    def _engine_fn(self, name):
+        import ast as _ast
+
+        tree = SourceTree()
+        mod = tree.tree("oim_tpu/serve/engine.py")
+        for node in _ast.walk(mod):
+            if isinstance(node, _ast.FunctionDef) and node.name == name:
+                return node, _ast
+        raise AssertionError(f"engine function {name} not found")
+
+    def _const_prngkeys(self, fn, _ast):
+        from tools.oimlint.core import dotted as _dotted
+
+        return [
+            n for n in _ast.walk(fn)
+            if isinstance(n, _ast.Call)
+            and _dotted(n.func) == "jax.random.PRNGKey"
+            and all(isinstance(a, _ast.Constant) for a in n.args)
+        ]
+
+    def test_dispatch_chunk_prngkey_hoisted(self):
+        fn, _ast = self._engine_fn("_dispatch_chunk")
+        assert not self._const_prngkeys(fn, _ast)
+        src = _ast.unparse(fn)
+        assert "self._zero_key" in src
+
+    def test_admit_wave_prngkey_hoisted(self):
+        fn, _ast = self._engine_fn("_admit_wave")
+        assert not self._const_prngkeys(fn, _ast)
+        src = _ast.unparse(fn)
+        assert "self._zero_key" in src
+        # Per-request keys (seeded) are NOT constants and must stay.
+        assert "fold_in" in src
+
+    def test_prefill_segment_constants_hoisted(self):
+        fn, _ast = self._engine_fn("_prefill_segment")
+        assert not self._const_prngkeys(fn, _ast)
+        src = _ast.unparse(fn)
+        # The per-segment neutral sampling rows, zero counts, and key
+        # stack all come from __init__ now.
+        for hoisted in (
+            "self._seg_sampling", "self._seg_zero_counts",
+            "self._zero_keys",
+        ):
+            assert hoisted in src, hoisted
+
+    def test_live_tree_clean_under_jax_passes(self):
+        """The jaxvet family finds nothing on the live tree — fixes
+        applied, nothing grandfathered (`make lint-jax`)."""
+        found = runner.run_passes(SourceTree(), list(JAX_PASSES))
+        assert not found, "\n".join(f.render() for f in found)
 
 
 class TestCLI:

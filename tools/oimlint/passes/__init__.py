@@ -8,10 +8,13 @@ from __future__ import annotations
 from tools.oimlint.passes import (
     authz,
     deadline,
+    donation,
+    hostsync,
     lifecycle,
     lockdiscipline,
     metricspass,
     protocol,
+    retrace,
 )
 
 ALL_PASSES = {
@@ -23,5 +26,12 @@ ALL_PASSES = {
         protocol,
         deadline,
         metricspass,
+        donation,
+        hostsync,
+        retrace,
     )
 }
+
+# The jaxvet family (ISSUE 11): the three JAX hot-path hygiene passes,
+# runnable standalone via `make lint-jax` / `--passes` with this list.
+JAX_PASSES = (donation.PASS_ID, hostsync.PASS_ID, retrace.PASS_ID)

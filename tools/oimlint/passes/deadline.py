@@ -15,6 +15,23 @@ Two detection shapes, matching how stubs are used in this tree:
   (any local assigned from a ``.stub(...)`` call);
 - plus any call whose method name is a known unary RPC of oim.v1
   (catches helper-wrapped stubs).
+
+The serve plane's HTTP clients carry the same obligation (ISSUE 11):
+the router probes backends and splices failover streams with urllib
+openers, the autoscaler streams peer weights, and oimctl drives both —
+a urllib/socket call without a timeout turns a hung backend into a
+hung router thread.  Flagged without ``timeout=``:
+
+- ``urlopen(...)`` (bare, ``urllib.request.urlopen``, or any dotted
+  ``*.urlopen`` — oimctl's ``_serve_urlopen`` wrapper binds the name
+  ``urlopen`` locally, so the bare spelling is load-bearing);
+- ``<opener>.open(...)`` — any receiver whose name contains "opener"
+  (``self._opener.open``, ``opener(ctx).open``); plain file ``open``
+  never matches;
+- ``socket.create_connection(...)`` (positional timeout accepted: it
+  is the second parameter);
+- ``http.client.HTTPConnection(...)`` / ``HTTPSConnection(...)``
+  constructors.
 """
 
 from __future__ import annotations
@@ -40,6 +57,53 @@ def _has_timeout(node: ast.Call) -> bool:
     return any(kw.arg == "timeout" for kw in node.keywords)
 
 
+def _http_violation(node: ast.Call) -> str | None:
+    """The serve-plane HTTP rule: description of an unbounded HTTP/
+    socket call, or None."""
+    name = dotted(node.func) or ""
+    parts = name.split(".")
+    last = (
+        node.func.attr
+        if isinstance(node.func, ast.Attribute)
+        else parts[-1]
+    )
+    desc = name or f"(...).{last}"
+    # urlopen(url, data, timeout) / OpenerDirector.open(url, data,
+    # timeout): the 3rd positional IS the timeout — honor it like the
+    # create_connection branch honors its 2nd positional.
+    url_bounded = _has_timeout(node) or len(node.args) >= 3
+    if last == "urlopen":
+        return None if url_bounded else f"{desc}(...)"
+    if (
+        last == "open"
+        and len(parts) > 1
+        and "opener" in parts[-2].lower()
+    ):
+        return None if url_bounded else f"{desc}(...)"
+    # opener(ctx).open(...) — chained off a call whose callee mentions
+    # an opener factory.
+    if (
+        last == "open"
+        and isinstance(node.func, ast.Attribute)
+        and isinstance(node.func.value, ast.Call)
+        and "opener" in (dotted(node.func.value.func) or "").lower()
+    ):
+        return None if url_bounded else f"{desc}(...)"
+    if last == "create_connection" and parts[0] in ("socket", "create_connection"):
+        bounded = _has_timeout(node) or len(node.args) >= 2
+        return None if bounded else f"{desc}(...)"
+    if last == "HTTPConnection":
+        # HTTPConnection(host, port, timeout): 3rd positional IS the
+        # timeout.
+        bounded = _has_timeout(node) or len(node.args) >= 3
+        return None if bounded else f"{desc}(...)"
+    if last == "HTTPSConnection":
+        # Keyword only: the 3rd positional was key_file before 3.12 and
+        # is rejected after it — a positional there never bounds.
+        return None if _has_timeout(node) else f"{desc}(...)"
+    return None
+
+
 def _stub_locals(fn: ast.AST) -> set[str]:
     out: set[str] = set()
     for node in ast.walk(fn):
@@ -60,7 +124,21 @@ def run(tree: SourceTree) -> list[Finding]:
             continue
         stub_names = _stub_locals(mod)
         for node in ast.walk(mod):
-            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            if not isinstance(node, ast.Call):
+                continue
+            http_desc = _http_violation(node)
+            if http_desc is not None:
+                findings.append(
+                    Finding(
+                        PASS_ID,
+                        rel,
+                        node.lineno,
+                        f"HTTP/socket call {http_desc} without timeout= "
+                        "(a hung peer becomes a hung caller thread)",
+                    )
+                )
+                continue
+            if not isinstance(node.func, ast.Attribute):
                 continue
             method = node.func.attr
             if method in STREAMING_RPCS:

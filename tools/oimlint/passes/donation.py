@@ -1,0 +1,270 @@
+"""donation-safety: no use-after-donate at jitted call sites.
+
+``jax.jit(..., donate_argnums=...)`` hands the donated buffer's memory
+to XLA: after the call, the Python reference points at freed (or
+reused) storage, and touching it raises — or worse, on some backends
+silently reads garbage.  The serve engine leans on donation for every
+cache buffer (six of its nine jitted functions donate), always in the
+rebind idiom::
+
+    self._cache, out = self._step(self._cache, ...)   # clean: rebound
+
+This pass finds the two ways that idiom breaks:
+
+1. **use-after-donate** — a variable passed at a donated position is
+   read later in the same function without having been rebound (by the
+   call's own result, or by any intervening assignment);
+2. **double donation** — the same variable passed at two donated
+   positions of one call: XLA would alias both parameters to one
+   buffer and the second write clobbers the first.
+
+Call sites are matched through the shared jit-site resolver
+(``jaxsites``): direct bindings, ``self._x``-attribute bindings,
+``partial(...)`` wrappings, and cross-module jit factories
+(``step = make_train_step(...)``).
+
+Scope model: every function (nested defs included) is analyzed as its
+own scope, and lambda bodies are skipped entirely — a lambda cannot
+rebind, so the forwarding idiom ``step_fn = lambda s, b: jit_step(s,
+base, b)`` must not leak its shadowing params into the enclosing
+scope's donated-name tracking.  A binding assigned different jit
+wrappings in mutually-exclusive branches (the engine's plain/spec/
+spec-model ``self._decode``) is disambiguated at each call site by the
+wrapped function's positional arity.
+
+Over-approximations, documented: statement order stands in for
+execution order, so a donate in an ``if`` arm and a read in the
+``else`` arm reads as use-after-donate (waive it); reads *before* an
+un-rebound donating call inside a loop body are missed (they re-execute
+after the donation on iteration two), as are closure reads from a
+sibling nested function.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.oimlint.core import Finding, SourceTree, dotted
+from tools.oimlint.passes import jaxsites
+
+PASS_ID = "donation-safety"
+DESCRIPTION = "donated jit buffers must be rebound, never re-read"
+
+
+def _functions(mod: ast.Module):
+    """Every function scope — module-level, methods, and nested defs,
+    each analyzed on its own (a nested def's params shadow the outer
+    names, and its body does not execute in statement order relative to
+    the enclosing function)."""
+    for node in ast.walk(mod):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _statements(fn: ast.AST):
+    """``fn``'s own statements in document order, NOT descending into
+    nested function/class scopes (those are separate scopes yielded by
+    ``_functions``)."""
+    out = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue
+            if isinstance(child, ast.stmt):
+                out.append(child)
+            visit(child)
+
+    visit(fn)
+    return out
+
+
+def _own_nodes(stmt: ast.stmt):
+    """Walk one statement's own expressions WITHOUT descending into
+    child statements (an ``if``'s body statements appear separately in
+    the document-order list; re-walking them here would double-count
+    every nested load and call) and WITHOUT descending into lambda
+    bodies (lambda params shadow; a lambda cannot rebind a donated
+    buffer, and its forwarding calls belong to no statement order)."""
+    stack: list[ast.AST] = []
+    for child in ast.iter_child_nodes(stmt):
+        if not isinstance(child, ast.stmt):
+            stack.append(child)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Lambda):
+            continue
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, ast.stmt):
+                stack.append(child)
+
+
+_META_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _loads_stores(stmt: ast.stmt):
+    """(loads, stores) dotted names of one statement's own expressions.
+    Pure metadata chains (``buf.shape``/``.ndim``/``.dtype``/``.size``)
+    are NOT loads — array metadata survives donation by design, so
+    reading it off a donated buffer is legal."""
+    loads: list[tuple[str, int]] = []
+    stores: list[tuple[str, int]] = []
+
+    def walk(node: ast.AST) -> None:
+        if isinstance(node, ast.Lambda):
+            return  # shadowing scope, skipped (see _own_nodes)
+        if isinstance(node, ast.stmt) and node is not stmt:
+            return  # child statements are separate entries
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in _META_ATTRS
+            and isinstance(node.ctx, ast.Load)
+            and dotted(node.value) is not None
+        ):
+            return  # metadata read: survives donation
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            name = dotted(node)
+            if name is not None:
+                if isinstance(node.ctx, ast.Store):
+                    stores.append((name, node.lineno))
+                elif isinstance(node.ctx, (ast.Load, ast.Del)):
+                    loads.append((name, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(stmt)
+    return loads, stores
+
+
+def _donated_names(
+    call: ast.Call, matched: list[jaxsites.JitSite]
+) -> list[tuple[object, str]]:
+    """(position-or-kwarg, dotted name) for donated args that are plain
+    variables (literals/fresh expressions at donated positions have no
+    later readers by construction).  Covers donate_argnums, donate
+    _argnames resolved through the wrapped signature, and keyword call
+    sites matching a donate_argnames entry."""
+    positions = sorted({
+        pos for site in matched for pos in site.donated_positions()
+    })
+    by_name = {n for site in matched for n in site.donate_names}
+    out: list[tuple[object, str]] = []
+    for pos in positions:
+        if pos < len(call.args):
+            name = dotted(call.args[pos])
+            if name:
+                out.append((pos, name))
+    for kw in call.keywords:
+        if kw.arg in by_name:
+            name = dotted(kw.value)
+            if name:
+                out.append((f"{kw.arg}=", name))
+    return out
+
+
+def _rebound_targets(stmt: ast.stmt, call: ast.Call) -> set[str]:
+    """Names the statement containing ``call`` rebinds from the call's
+    result (the ``cache, out = self._step(cache, ...)`` idiom)."""
+    if isinstance(stmt, ast.Assign) and stmt.value is call:
+        out: set[str] = set()
+        for target in stmt.targets:
+            elts = (
+                target.elts
+                if isinstance(target, (ast.Tuple, ast.List))
+                else [target]
+            )
+            for elt in elts:
+                if isinstance(elt, ast.Starred):
+                    elt = elt.value
+                name = dotted(elt)
+                if name:
+                    out.add(name)
+        return out
+    if (
+        isinstance(stmt, ast.AnnAssign)
+        and stmt.value is call
+        and (name := dotted(stmt.target))
+    ):
+        return {name}
+    return set()
+
+
+def _check_function(
+    rel: str, fn: ast.AST, donating: dict[str, list[jaxsites.JitSite]]
+) -> list[Finding]:
+    findings: list[Finding] = []
+    stmts = _statements(fn)
+    per_stmt = [_loads_stores(s) for s in stmts]
+
+    for idx, stmt in enumerate(stmts):
+        for call in _own_nodes(stmt):
+            if not isinstance(call, ast.Call):
+                continue
+            callee = dotted(call.func)
+            variants = donating.get(callee or "")
+            if not variants:
+                continue
+            matched = jaxsites.sites_for_call(variants, len(call.args))
+            names = _donated_names(call, matched)
+
+            seen: dict[str, object] = {}
+            for pos, name in names:
+                if name in seen:
+                    findings.append(Finding(
+                        PASS_ID, rel, call.lineno,
+                        f"{callee}(...): variable '{name}' passed at two "
+                        f"donated positions ({seen[name]} and {pos}) — "
+                        "XLA aliases both to one buffer",
+                    ))
+                else:
+                    seen[name] = pos
+
+            rebound = _rebound_targets(stmt, call)
+            for _pos, name in names:
+                if name in rebound:
+                    continue
+                flagged = False
+                for later_idx in range(idx + 1, len(stmts)):
+                    loads, stores = per_stmt[later_idx]
+                    for load_name, load_line in loads:
+                        if load_name == name and not flagged:
+                            findings.append(Finding(
+                                PASS_ID, rel, load_line,
+                                f"use-after-donate: '{name}' was donated "
+                                f"to {callee}(...) at line {call.lineno} "
+                                "and read again without being rebound "
+                                "(rebind it from the call's result)",
+                            ))
+                            flagged = True
+                    if any(s == name for s, _ in stores):
+                        break
+                    if flagged:
+                        break
+    return findings
+
+
+def run(tree: SourceTree) -> list[Finding]:
+    findings: list[Finding] = []
+    factories = jaxsites.tree_factories(tree)
+    for rel in tree.files():
+        mod = tree.tree(rel)
+        if mod is None:
+            continue
+        donating = resolve_donating(tree, rel, factories)
+        if not donating:
+            continue
+        for fn in _functions(mod):
+            findings.extend(_check_function(rel, fn, donating))
+    return findings
+
+
+def resolve_donating(
+    tree: SourceTree, rel: str, factories: dict[str, jaxsites.JitSite]
+) -> dict[str, list[jaxsites.JitSite]]:
+    """Bindings in ``rel`` wrapping a donating jit (shared with the
+    analyzer tests)."""
+    return jaxsites.resolve(tree, rel, factories).donating_bindings()

@@ -1,0 +1,306 @@
+"""host-sync-discipline: no hidden device→host syncs on the hot path.
+
+Every device→host fetch in the serve engine goes through the
+``_fetch``/``_fetch_aux`` readback accumulators (PR 5): the wall time
+blocked in ``jax.device_get`` is *attributed* — dispatch-wait vs
+fetch-wait — which is what keeps the serving-swing forensics truthful.
+An implicit sync (``float()`` on a jit result, ``.item()``,
+``np.asarray`` on a device value, a raw ``device_get``) blocks the
+driver thread the same way but books the wait as host time, *and*
+serializes the pipelined dispatch-ahead overlap the bench trajectory is
+built on.
+
+Hot-path functions are designated with a ``# oimlint: hotpath`` marker
+on (or above) the ``def`` line, or via ``jaxsites.HOTPATH_TABLE``.
+Inside them this pass flags:
+
+1. raw ``jax.device_get(...)`` / ``x.block_until_ready()`` — every
+   readback must ride the accumulator (``self._fetch`` /
+   ``self._fetch_aux``), which is exempt by construction because the
+   accumulators themselves are not hot-path-marked;
+2. ``float()/int()/bool()`` on a *device value* — a value produced by a
+   jitted binding (shared resolver) or a ``jnp.*``/``jax.random.*``/
+   ``jax.lax.*`` call, tracked through assignments, tuple unpacking,
+   subscripts, and arithmetic; values from the accumulators,
+   ``np.*``, or plain Python stay host-side and are never flagged;
+3. ``.item()`` / ``.tolist()`` / ``np.asarray()/np.array()`` on a
+   device value — same sync, different spelling;
+4. a **constant device array rebuilt per call** — ``jax.random.
+   PRNGKey(0)``, ``jnp.zeros/ones/full/arange/asarray`` with all-literal
+   arguments: each call re-dispatches the same tiny host→device
+   transfer every chunk; hoist it to ``__init__``.  Suppressed inside
+   jit-wrapped bodies, where the constant folds into the trace and
+   costs nothing per call.
+
+Taint deliberately does NOT flow through arbitrary calls (``zip``,
+helper methods): a device value laundered through one is missed
+(under-approximation) rather than poisoning everything it touches
+(false positives on the fetched-value paths the engine is full of).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.oimlint.core import Finding, SourceTree, dotted
+from tools.oimlint.passes import jaxsites
+
+PASS_ID = "host-sync-discipline"
+DESCRIPTION = "hot-path device readbacks must ride the _fetch accumulator"
+
+# The sanctioned readback accumulators: calls to these produce HOST
+# values and are the only legal device_get spelling on the hot path.
+ACCUMULATORS = {"self._fetch", "self._fetch_aux"}
+
+_RAW_SYNCS = {"jax.device_get", "jax.block_until_ready"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NP_SYNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_CASTS = {"float", "int", "bool"}
+
+_DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "jax.random.", "jax.lax.")
+_CONST_BUILDERS = {
+    "jax.random.PRNGKey", "jnp.zeros", "jnp.ones", "jnp.full",
+    "jnp.arange", "jnp.asarray", "jnp.array",
+}
+# Attribute reads that stay host-side even on a device value.
+_HOST_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+
+def _is_const_expr(node: ast.expr) -> bool:
+    """Literal-only expression (ints, floats, strings, tuples/lists of
+    them), plus dtype attributes (``jnp.int32``) — everything whose
+    value cannot change between calls."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_const_expr(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_const_expr(node.operand)
+    if isinstance(node, ast.Attribute):
+        root = dotted(node) or ""
+        return root.split(".")[0] in ("jnp", "np", "numpy", "jax")
+    return False
+
+
+class _Taint:
+    """Per-function device-value taint over dotted names."""
+
+    def __init__(self, jit_bindings: set[str]):
+        self.jit_bindings = jit_bindings
+        self.tainted: set[str] = set()
+
+    def expr_tainted(self, node: ast.expr) -> bool:
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in _HOST_ATTRS
+            ):
+                return False
+            name = dotted(node)
+            if name in self.tainted:
+                return True
+            # self._cache.k is device iff self._cache is.
+            if isinstance(node, ast.Attribute):
+                return self.expr_tainted(node.value)
+            return False
+        if isinstance(node, ast.Call):
+            callee = dotted(node.func) or ""
+            if callee in ACCUMULATORS or callee in _RAW_SYNCS:
+                return False  # device_get result is host-side
+            if callee in self.jit_bindings:
+                return True
+            if callee.startswith(_DEVICE_PREFIXES) or callee in (
+                "jax.device_put",
+            ):
+                return True
+            return False  # arbitrary calls do not propagate taint
+        if isinstance(node, ast.Subscript):
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.expr_tainted(node.left) or self.expr_tainted(
+                node.right
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_tainted(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr_tainted(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.expr_tainted(node.body) or self.expr_tainted(
+                node.orelse
+            )
+        if isinstance(node, ast.Starred):
+            return self.expr_tainted(node.value)
+        return False
+
+    def assign(self, targets: list[ast.expr], value: ast.expr) -> None:
+        tainted = self.expr_tainted(value)
+        for target in targets:
+            elts = (
+                target.elts
+                if isinstance(target, (ast.Tuple, ast.List))
+                else [target]
+            )
+            for elt in elts:
+                if isinstance(elt, ast.Starred):
+                    elt = elt.value
+                name = dotted(elt)
+                if name is None:
+                    continue
+                if tainted:
+                    self.tainted.add(name)
+                else:
+                    self.tainted.discard(name)
+
+
+def _check_hot_function(
+    rel: str,
+    fn: ast.FunctionDef,
+    jit_bindings: set[str],
+    in_jit_body: bool,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    taint = _Taint(jit_bindings)
+
+    def scan(node: ast.AST) -> None:
+        for stmt in node.body if hasattr(node, "body") else []:
+            visit(stmt)
+
+    def visit(stmt: ast.stmt) -> None:
+        for expr in _own_exprs(stmt):
+            if isinstance(expr, ast.Call):
+                check_call(expr)
+        if isinstance(stmt, ast.Assign):
+            taint.assign(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            taint.assign([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            if taint.expr_tainted(stmt.value):
+                name = dotted(stmt.target)
+                if name:
+                    taint.tainted.add(name)
+        elif isinstance(stmt, ast.For):
+            if taint.expr_tainted(stmt.iter):
+                taint.assign([stmt.target], stmt.iter)
+        # Recurse into child statements in document order.
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                visit(child)
+            elif hasattr(child, "body") and isinstance(
+                child, (ast.ExceptHandler,)
+            ):
+                for s in child.body:
+                    visit(s)
+
+    def check_call(call: ast.Call) -> None:
+        callee = dotted(call.func) or ""
+        last = callee.split(".")[-1]
+
+        if callee in _RAW_SYNCS or (
+            last == "block_until_ready" and callee not in ACCUMULATORS
+        ):
+            findings.append(Finding(
+                PASS_ID, rel, call.lineno,
+                f"{fn.name}: raw device→host sync {last}(...) on the hot "
+                "path bypasses the _fetch/_fetch_aux readback accumulator "
+                "(dispatch-wait vs fetch-wait attribution breaks)",
+            ))
+            return
+
+        if (
+            callee in _CASTS
+            and len(call.args) == 1
+            and taint.expr_tainted(call.args[0])
+        ):
+            findings.append(Finding(
+                PASS_ID, rel, call.lineno,
+                f"{fn.name}: {callee}() on a device value forces an "
+                "implicit blocking sync — fetch it through "
+                "self._fetch/_fetch_aux first",
+            ))
+            return
+
+        if (
+            last in _SYNC_METHODS
+            and isinstance(call.func, ast.Attribute)
+            and taint.expr_tainted(call.func.value)
+        ):
+            findings.append(Finding(
+                PASS_ID, rel, call.lineno,
+                f"{fn.name}: .{last}() on a device value forces an "
+                "implicit blocking sync — fetch it through "
+                "self._fetch/_fetch_aux first",
+            ))
+            return
+
+        if (
+            callee in _NP_SYNCS
+            and call.args
+            and taint.expr_tainted(call.args[0])
+        ):
+            findings.append(Finding(
+                PASS_ID, rel, call.lineno,
+                f"{fn.name}: {callee}() on a device value forces an "
+                "implicit blocking sync — fetch it through "
+                "self._fetch/_fetch_aux first",
+            ))
+            return
+
+        if (
+            not in_jit_body
+            and callee in _CONST_BUILDERS
+            and call.args
+            and all(_is_const_expr(a) for a in call.args)
+            and all(
+                kw.value is not None and _is_const_expr(kw.value)
+                for kw in call.keywords
+            )
+        ):
+            findings.append(Finding(
+                PASS_ID, rel, call.lineno,
+                f"{fn.name}: constant device array {callee}(...) rebuilt "
+                "on every hot-path call — hoist it to __init__ and reuse",
+            ))
+
+    scan(fn)
+    return findings
+
+
+def _own_exprs(stmt: ast.stmt):
+    """Expression nodes of one statement, not descending into child
+    statements (those are visited separately, in order, with the taint
+    state they actually execute under)."""
+    stack: list[ast.AST] = [
+        c for c in ast.iter_child_nodes(stmt) if not isinstance(c, ast.stmt)
+    ]
+    while stack:
+        node = stack.pop()
+        yield node
+        stack.extend(
+            c for c in ast.iter_child_nodes(node)
+            if not isinstance(c, ast.stmt)
+        )
+
+
+def run(
+    tree: SourceTree,
+    table: dict[str, tuple[str, ...]] | None = None,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    factories = jaxsites.tree_factories(tree)
+    for rel in tree.files():
+        mod = tree.tree(rel)
+        if mod is None:
+            continue
+        hot = jaxsites.hotpath_functions(tree, rel, table)
+        if not hot:
+            continue
+        sites = jaxsites.resolve(tree, rel, factories)
+        jit_bindings = set(sites.by_binding)
+        jit_targets = {
+            s.target for s in sites.all_sites if s.target
+        }
+        for name, fn in hot.items():
+            findings.extend(_check_hot_function(
+                rel, fn, jit_bindings, in_jit_body=name in jit_targets
+            ))
+    return findings

@@ -1,0 +1,353 @@
+"""Shared jit-site resolver for the jaxvet pass family.
+
+The three JAX hot-path passes (``donation-safety``,
+``host-sync-discipline``, ``retrace-risk``) all need the same map: which
+variables in a module are bound to a ``jax.jit``-wrapped callable, what
+the wrapped function is, and which argument positions are donated or
+static.  This module builds that map once per file, handling the
+binding shapes this tree actually uses:
+
+- direct:        ``self._cow = jax.jit(_cow_block, donate_argnums=(0,))``
+- partial-wrapped: ``self._decode = jax.jit(partial(_decode_chunk,
+  cfg=cfg, ...), donate_argnums=(1, 3, 4))`` — the partial's keywords
+  are trace-time constants and count as static;
+- conditional:   ``self._admit_d = (jax.jit(...) if draft else None)``;
+- factory:       ``def make_train_step(...): return jax.jit(step,
+  donate_argnums=(0,))`` — a *jit factory*; a later
+  ``step = make_train_step(cfg, mesh)`` (in any scanned module) binds a
+  jit site with the factory's donation/static signature.
+
+Known over-approximations, deliberate (baseline/waiver material, never
+silent): attribute bindings (``self._decode``) are resolved module-wide
+— two classes in one module binding the same attribute to different jit
+signatures would be merged; factories are matched by bare function name
+across modules without import tracking.  Neither shape exists in this
+tree today, and the resolver tests pin the supported ones.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from dataclasses import dataclass, field
+
+from tools.oimlint.core import SourceTree, dotted
+
+# Callee spellings that construct a jitted callable.
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+@dataclass(frozen=True)
+class JitSite:
+    """One resolved jit wrapping: what it wraps and how."""
+
+    binding: str | None  # "self._decode" / "step_fn"; None when unbound
+    target: str | None   # bare name of the wrapped callable, if resolvable
+    donate: tuple[int, ...] = ()
+    static: tuple[int, ...] = ()
+    static_names: tuple[str, ...] = ()
+    # donate_argnames params: DONATED (and traced) — never static.
+    donate_names: tuple[str, ...] = ()
+    bound_kwargs: tuple[str, ...] = ()  # partial(...) keywords: static
+    line: int = 0
+    # Positional parameters of the wrapped callable when its def is in
+    # the same module — how call sites disambiguate a binding that is
+    # assigned different jit wrappings in mutually-exclusive branches
+    # (the engine's ``self._decode`` is plain/spec/spec-model depending
+    # on config, with different arities and donate tuples), and how
+    # donate_argnames resolve to positional indices.
+    target_arity: int | None = None
+    target_params: tuple[str, ...] = ()
+
+    def donated_positions(self) -> tuple[int, ...]:
+        """donate_argnums plus donate_argnames resolved through the
+        wrapped signature (names without a known signature stay
+        name-matched at keyword call sites only)."""
+        out = set(self.donate)
+        for name in self.donate_names:
+            if name in self.target_params:
+                out.add(self.target_params.index(name))
+        return tuple(sorted(out))
+
+
+@dataclass
+class ModuleSites:
+    """All jit sites of one module, indexed for the passes.
+
+    ``by_binding`` maps each bound name to EVERY site assigned to it —
+    conditional rebinding (``self._decode = jax.jit(A) ... else
+    jax.jit(B)``) is the engine's idiom, and a pass picks the variant
+    whose ``target_arity`` matches the call site."""
+
+    by_binding: dict[str, list[JitSite]] = field(default_factory=dict)
+    factories: dict[str, JitSite] = field(default_factory=dict)
+    all_sites: list[JitSite] = field(default_factory=list)
+
+    def donating_bindings(self) -> dict[str, list[JitSite]]:
+        out = {
+            b: [s for s in sites if s.donate or s.donate_names]
+            for b, sites in self.by_binding.items()
+        }
+        return {b: sites for b, sites in out.items() if sites}
+
+
+def sites_for_call(sites: list[JitSite], n_args: int) -> list[JitSite]:
+    """The binding variants a call with ``n_args`` positional args can
+    reach: exact arity matches when any variant's arity is known and
+    matches, every variant otherwise (over-approximation beats silence
+    when the wrapped def lives in another module)."""
+    matched = [s for s in sites if s.target_arity == n_args]
+    return matched or sites
+
+
+def _int_tuple(node: ast.expr | None) -> tuple[int, ...]:
+    """Literal ``donate_argnums``/``static_argnums`` value; non-literal
+    (computed) values resolve to () — an under-approximation the passes
+    accept over guessing."""
+    if node is None:
+        return ()
+    try:
+        value = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return ()
+    if isinstance(value, int):
+        return (value,)
+    if isinstance(value, (tuple, list)) and all(
+        isinstance(v, int) for v in value
+    ):
+        return tuple(value)
+    return ()
+
+
+def _str_tuple(node: ast.expr | None) -> tuple[str, ...]:
+    if node is None:
+        return ()
+    try:
+        value = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return ()
+    if isinstance(value, str):
+        return (value,)
+    if isinstance(value, (tuple, list)) and all(
+        isinstance(v, str) for v in value
+    ):
+        return tuple(value)
+    return ()
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and (dotted(node.func) or "") in _JIT_NAMES
+    )
+
+
+def parse_jit_call(node: ast.Call, binding: str | None) -> JitSite:
+    """One ``jax.jit(...)`` call → a :class:`JitSite` (partial unwrapped,
+    argnums parsed when literal)."""
+    donate = static = ()
+    static_names: tuple[str, ...] = ()
+    donate_names: tuple[str, ...] = ()
+    for kw in node.keywords:
+        if kw.arg == "donate_argnums":
+            donate = _int_tuple(kw.value)
+        elif kw.arg == "static_argnums":
+            static = _int_tuple(kw.value)
+        elif kw.arg == "static_argnames":
+            static_names = static_names + _str_tuple(kw.value)
+        elif kw.arg == "donate_argnames":
+            donate_names = donate_names + _str_tuple(kw.value)
+    target = None
+    bound_kwargs: tuple[str, ...] = ()
+    if node.args:
+        wrapped = node.args[0]
+        if (
+            isinstance(wrapped, ast.Call)
+            and (dotted(wrapped.func) or "") in _PARTIAL_NAMES
+        ):
+            bound_kwargs = tuple(
+                kw.arg for kw in wrapped.keywords if kw.arg
+            )
+            wrapped = wrapped.args[0] if wrapped.args else wrapped
+        name = dotted(wrapped)
+        if name:
+            target = name.split(".")[-1]
+    return JitSite(
+        binding=binding,
+        target=target,
+        donate=donate,
+        static=static,
+        static_names=static_names,
+        donate_names=donate_names,
+        bound_kwargs=bound_kwargs,
+        line=node.lineno,
+    )
+
+
+def _jit_value(node: ast.expr) -> ast.Call | None:
+    """The jit call inside an assignment RHS: direct, or either arm of a
+    conditional expression (``jax.jit(...) if draft else None``)."""
+    if is_jit_call(node):
+        return node  # type: ignore[return-value]
+    if isinstance(node, ast.IfExp):
+        for arm in (node.body, node.orelse):
+            if is_jit_call(arm):
+                return arm  # type: ignore[return-value]
+    return None
+
+
+def _pos_params(fn: ast.FunctionDef) -> tuple[str, ...]:
+    return tuple(a.arg for a in fn.args.posonlyargs + fn.args.args)
+
+
+def collect_module_sites(mod: ast.Module) -> ModuleSites:
+    """Every jit site in ``mod``: bound (assignments), factory
+    (functions returning a jit), and unbound (the rest)."""
+    sites = ModuleSites()
+    bound_calls: set[int] = set()
+    params_map = {
+        node.name: _pos_params(node)
+        for node in ast.walk(mod)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+    def parsed(call: ast.Call, binding: str | None) -> JitSite:
+        site = parse_jit_call(call, binding=binding)
+        if site.target in params_map:
+            params = params_map[site.target]
+            site = dataclasses.replace(
+                site, target_arity=len(params), target_params=params
+            )
+        return site
+
+    for node in ast.walk(mod):
+        if isinstance(node, ast.Assign):
+            call = _jit_value(node.value)
+            if call is None:
+                continue
+            bound_calls.add(id(call))
+            for target in node.targets:
+                name = dotted(target)
+                if name is None:
+                    continue
+                site = parsed(call, binding=name)
+                sites.by_binding.setdefault(name, []).append(site)
+                sites.all_sites.append(site)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for child in ast.walk(node):
+                if isinstance(child, ast.Return) and child.value is not None:
+                    call = _jit_value(child.value)
+                    if call is not None:
+                        bound_calls.add(id(call))
+                        site = parsed(call, binding=None)
+                        sites.factories[node.name] = site
+                        sites.all_sites.append(site)
+
+    for node in ast.walk(mod):
+        if is_jit_call(node) and id(node) not in bound_calls:
+            sites.all_sites.append(parsed(node, binding=None))  # type: ignore[arg-type]
+    return sites
+
+
+def tree_factories(tree: SourceTree) -> dict[str, JitSite]:
+    """Jit factories across every scanned file, by bare function name —
+    the cross-module half of the resolver (``step =
+    make_train_step(...)`` in one module, the factory in another).
+    Memoized on the tree instance: all three jaxvet passes call this
+    per run, and the full-tree walk must be paid once, not three times
+    (the same pattern as the tree's own AST cache)."""
+    cached = getattr(tree, "_jaxsites_factories", None)
+    if cached is not None:
+        return cached
+    out: dict[str, JitSite] = {}
+    for rel in tree.files():
+        mod = tree.tree(rel)
+        if mod is None:
+            continue
+        out.update(_module_sites_cached(tree, rel).factories)
+    tree._jaxsites_factories = out  # type: ignore[attr-defined]
+    return out
+
+
+def _module_sites_cached(tree: SourceTree, rel: str) -> ModuleSites:
+    cache = getattr(tree, "_jaxsites_modules", None)
+    if cache is None:
+        cache = {}
+        tree._jaxsites_modules = cache  # type: ignore[attr-defined]
+    if rel not in cache:
+        mod = tree.tree(rel)
+        cache[rel] = (
+            ModuleSites() if mod is None else collect_module_sites(mod)
+        )
+    return cache[rel]
+
+
+def resolve(
+    tree: SourceTree, rel: str, factories: dict[str, JitSite] | None = None
+) -> ModuleSites:
+    """``rel``'s jit sites, with bindings assigned from a known factory
+    (``fn = make_train_step(...)``) folded in when ``factories`` (from
+    :func:`tree_factories`) is supplied."""
+    mod = tree.tree(rel)
+    sites = ModuleSites()
+    if mod is None:
+        return sites
+    sites = _module_sites_cached(tree, rel)
+    if factories:
+        for node in ast.walk(mod):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            callee = (dotted(node.value.func) or "").split(".")[-1]
+            if callee not in factories:
+                continue
+            proto = factories[callee]
+            for target in node.targets:
+                name = dotted(target)
+                if name is None or name in sites.by_binding:
+                    continue
+                site = dataclasses.replace(
+                    proto, binding=name, line=node.lineno
+                )
+                sites.by_binding[name] = [site]
+                sites.all_sites.append(site)
+    return sites
+
+
+# -- shared hot-path designation --------------------------------------------
+
+HOTPATH_MARKER = "# oimlint: hotpath"
+
+# Per-module fallback table for hot-path functions in files that cannot
+# carry markers (generated code, vendored snippets).  repo-relative path
+# → function names.  Empty today: the serve engine declares its spine
+# in-line with markers, which keeps the declaration next to the code it
+# governs.
+HOTPATH_TABLE: dict[str, tuple[str, ...]] = {}
+
+
+def hotpath_functions(
+    tree: SourceTree, rel: str, table: dict[str, tuple[str, ...]] | None = None
+) -> dict[str, ast.FunctionDef]:
+    """Functions in ``rel`` designated hot-path: a ``# oimlint: hotpath``
+    marker on the ``def`` line or the line above, or a HOTPATH_TABLE
+    entry.  Returns {name: FunctionDef}."""
+    mod = tree.tree(rel)
+    if mod is None:
+        return {}
+    lines = tree.lines(rel)
+    names = set((table if table is not None else HOTPATH_TABLE).get(rel, ()))
+    out: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(mod):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        marked = node.name in names
+        for idx in (node.lineno - 1, node.lineno - 2):
+            if 0 <= idx < len(lines) and HOTPATH_MARKER in lines[idx]:
+                marked = True
+        if marked:
+            out[node.name] = node  # type: ignore[assignment]
+    return out

@@ -1,0 +1,254 @@
+"""retrace-risk: jit signatures that recompile under live traffic.
+
+An XLA compile is 20-40 s on a TPU; the serve engine's contract is that
+``warmup()`` pays every compile before traffic lands and steady state
+pays zero.  Three statically-visible ways code breaks that contract:
+
+1. **python branch on a traced parameter** — inside a jit-wrapped
+   function body, ``if``/``while`` on a parameter that is not static
+   (``static_argnums``/``static_argnames``, a ``partial(...)``-bound
+   keyword, or a keyword-only config param — the tree's idiom for
+   trace-time constants).  Passed an array it raises at trace time;
+   passed a Python scalar it silently compiles one variant per value.
+   ``isinstance(...)`` dispatch, ``is None`` checks, and
+   ``.shape/.ndim/.dtype`` reads are trace-stable and exempt.
+2. **varying python scalar at a traced position** — a call site of a
+   jitted binding feeding ``len(...)`` (or a local assigned from
+   ``len(...)``) at a non-static position: the scalar is hashed into
+   the jit cache key by value, so every distinct length is a fresh
+   compile.  Wrap it (``np.int32(...)``/``jnp.asarray``) or make the
+   position static.
+3. **jit constructed per iteration** — ``jax.jit(...)`` inside a
+   ``for``/``while`` body or inside a hot-path function: each
+   construction starts a brand-new trace cache, so the "cached" compile
+   is paid every step.  Build-once tables (dict comprehensions in
+   ``__init__``) are exempt.
+
+The static passes cannot see every retrace (shape-dependent
+recompiles, weak-type promotion); the runtime complement is the
+steady-state recompile guard (``tests/test_jit_guard.py``, ``make
+test-jit-guard``), which counts XLA compiles around a warm engine.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.oimlint.core import Finding, SourceTree, dotted
+from tools.oimlint.passes import jaxsites
+
+PASS_ID = "retrace-risk"
+DESCRIPTION = "jit bodies/call sites must not recompile at steady state"
+
+_TRACE_STABLE_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _find_function(mod: ast.Module, name: str) -> ast.FunctionDef | None:
+    for node in ast.walk(mod):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == name
+        ):
+            return node  # type: ignore[return-value]
+    return None
+
+
+def _traced_params(fn: ast.FunctionDef, site: jaxsites.JitSite) -> set[str]:
+    """Positional parameter names that are traced (not static) under
+    ``site``.  Keyword-only params are the tree's config idiom and are
+    treated as static, as are partial-bound keywords and
+    static_argnums/argnames."""
+    pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static = {
+        pos[i] for i in site.static if i < len(pos)
+    } | set(site.static_names) | set(site.bound_kwargs)
+    return {p for p in pos if p not in static}
+
+
+def _branch_params(test: ast.expr, traced: set[str]) -> set[str]:
+    """Traced params a branch test's outcome depends on, minus the
+    trace-stable readings (isinstance dispatch, ``is None``,
+    ``.shape``-family attributes)."""
+    out: set[str] = set()
+
+    def walk(node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            callee = dotted(node.func) or ""
+            if callee.split(".")[-1] == "isinstance":
+                return  # type dispatch is trace-static
+            if isinstance(node.func, ast.Attribute):
+                walk(node.func.value)
+            for arg in node.args:
+                walk(arg)
+            return
+        if isinstance(node, ast.Attribute):
+            if node.attr in _TRACE_STABLE_ATTRS:
+                return
+            walk(node.value)
+            return
+        if isinstance(node, ast.Compare):
+            ops_are_identity = all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            )
+            if ops_are_identity:
+                return  # ``x is None`` — a type-level, trace-static test
+        if isinstance(node, ast.Name) and node.id in traced:
+            out.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(test)
+    return out
+
+
+def _check_jit_body(
+    rel: str, mod: ast.Module, site: jaxsites.JitSite
+) -> list[Finding]:
+    fn = _find_function(mod, site.target or "")
+    if fn is None:
+        return []
+    traced = _traced_params(fn, site)
+    findings: list[Finding] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While)):
+            for param in sorted(_branch_params(node.test, traced)):
+                findings.append(Finding(
+                    PASS_ID, rel, node.lineno,
+                    f"jit-wrapped {fn.name}: python-level branch on "
+                    f"traced parameter '{param}' — an array raises at "
+                    "trace time, a python scalar compiles one variant "
+                    "per value (use lax.cond/jnp.where, or make it "
+                    "static)",
+                ))
+    return findings
+
+
+def _len_locals(fn: ast.AST) -> set[str]:
+    """Locals assigned directly from ``len(...)``."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and (dotted(node.value.func) or "") == "len"
+        ):
+            for target in node.targets:
+                name = dotted(target)
+                if name:
+                    out.add(name)
+    return out
+
+
+def _is_len_expr(node: ast.expr, len_locals: set[str]) -> bool:
+    if isinstance(node, ast.Call) and (dotted(node.func) or "") == "len":
+        return True
+    if isinstance(node, ast.Name) and node.id in len_locals:
+        return True
+    if isinstance(node, ast.BinOp):
+        return _is_len_expr(node.left, len_locals) or _is_len_expr(
+            node.right, len_locals
+        )
+    return False
+
+
+def _check_call_sites(
+    rel: str, mod: ast.Module,
+    bindings: dict[str, list[jaxsites.JitSite]],
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn in ast.walk(mod):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        len_locals = _len_locals(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            variants = bindings.get(dotted(node.func) or "")
+            if not variants:
+                continue
+            matched = jaxsites.sites_for_call(variants, len(node.args))
+            static = {
+                pos for site in matched for pos in site.static
+            }
+            binding = matched[0].binding
+            for pos, arg in enumerate(node.args):
+                if pos in static:
+                    continue
+                if _is_len_expr(arg, len_locals):
+                    findings.append(Finding(
+                        PASS_ID, rel, arg.lineno,
+                        f"{binding}(...): python scalar from len() "
+                        f"at traced position {pos} — every distinct "
+                        "value is a fresh compile (wrap in "
+                        "np.int32/jnp.asarray, or mark the position "
+                        "static)",
+                    ))
+    return findings
+
+
+def _check_jit_in_loops(
+    tree: SourceTree, rel: str, mod: ast.Module,
+    table: dict[str, tuple[str, ...]] | None,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[int] = set()  # a jit under nested loops flags ONCE
+    for node in ast.walk(mod):
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            for child in ast.walk(node):
+                if (
+                    child is not node
+                    and jaxsites.is_jit_call(child)
+                    and id(child) not in seen
+                ):
+                    seen.add(id(child))
+                    findings.append(Finding(
+                        PASS_ID, rel, child.lineno,
+                        "jax.jit(...) constructed inside a loop — each "
+                        "construction is a fresh trace cache, so the "
+                        "compile is paid every iteration (hoist it)",
+                    ))
+    hot = jaxsites.hotpath_functions(tree, rel, table)
+    flagged = {f.line for f in findings}
+    for name, fn in hot.items():
+        for child in ast.walk(fn):
+            if jaxsites.is_jit_call(child) and child.lineno not in flagged:
+                findings.append(Finding(
+                    PASS_ID, rel, child.lineno,
+                    f"{name}: jax.jit(...) constructed inside a hot-path "
+                    "function — the per-call construction discards the "
+                    "trace cache (hoist it to __init__)",
+                ))
+    return findings
+
+
+def run(
+    tree: SourceTree,
+    table: dict[str, tuple[str, ...]] | None = None,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    factories = jaxsites.tree_factories(tree)
+    for rel in tree.files():
+        mod = tree.tree(rel)
+        if mod is None:
+            continue
+        sites = jaxsites.resolve(tree, rel, factories)
+        # Dedupe bodies per STATIC SIGNATURE, not per target name: the
+        # same function wrapped twice (once with static_argnums, once
+        # without) traces differently, and only the unstatic wrapping
+        # may branch-retrace.  Findings dedupe by line so the common
+        # case (identical re-wrappings) still reports once.
+        seen: set[tuple] = set()
+        body_findings: dict[tuple[int, str], Finding] = {}
+        for site in sites.all_sites:
+            key = (
+                site.target, site.static, site.static_names,
+                site.bound_kwargs,
+            )
+            if site.target and key not in seen:
+                seen.add(key)
+                for f in _check_jit_body(rel, mod, site):
+                    body_findings.setdefault((f.line, f.message), f)
+        findings.extend(body_findings.values())
+        findings.extend(_check_call_sites(rel, mod, sites.by_binding))
+        findings.extend(_check_jit_in_loops(tree, rel, mod, table))
+    return findings
