@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-autoscale test-jit-guard lint lint-metrics lint-jax agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-autoscale test-jit-guard lint lint-metrics lint-jax agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -109,6 +109,25 @@ test-serve-chaos:
 	  --roots oim_tpu/common
 	timeout -k 10 150 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_serve_chaos.py -q -m "chaos and not slow" \
+	  -p no:cacheprovider
+
+# Disaggregated prefill/decode (ISSUE 12, serve_disagg marker): the
+# engine-level KV export/import roundtrips (token-identical, int8
+# scales, geometry/capacity/dense guards, TTL leak-freedom), the
+# routed prefill→ship→decode exactness matrix vs a mixed backend at
+# pipeline depth {1, 2}, the chaos kill-mid-ship fallback with zero
+# leaked blocks, the one-trace forensics assertion, pool-role
+# surfaces + authz, and the per-pool autoscaler sim.  Nominal ~25s;
+# the cap carries the box's 2-3x CPU-quota headroom.  Also runs the
+# oimlint lock-discipline/resource-lifecycle/jaxvet passes over the
+# serve plane so the new hold/import state stays analyzer-clean, not
+# grandfathered in baseline.
+test-serve-disagg:
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
+	  --roots oim_tpu/serve
+	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_serve_disagg.py -q -m "serve_disagg and not slow" \
 	  -p no:cacheprovider
 
 # Fleet autoscaler (autoscale marker): policy-boundary units (watermark

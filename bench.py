@@ -496,6 +496,7 @@ def _run(tmp: str, agent_sock: str, cleanups: list, extras: dict) -> int:
         _train_diagnostics(extras, on_tpu, cfg, batch, seq, params)
         _decode_diagnostics(extras, on_tpu, cfg, batch, params)
         _serve_diagnostics(extras, on_tpu, cfg, params)
+        _disagg_diagnostics(extras, on_tpu, cfg, params)
         _spec_model_diagnostics(extras, on_tpu)
     _flash_diagnostics(extras, on_tpu)
     # Last: it opens a SECOND PJRT client against the pool (the staged
@@ -1471,6 +1472,175 @@ def train_tiny_lm(cfg, steps: int, seed: int, mesh=None):
         batch = ramp_windows(cfg.vocab_size, 129, 8, 1000 + i)[:, :128]
         state, m = step_fn(state, jnp.asarray(batch, jnp.int32))
     return jax.device_get(state.params), float(jax.device_get(m["loss"]))
+
+
+def _disagg_diagnostics(extras, on_tpu, cfg, params) -> None:
+    """Disaggregated prefill/decode headline (ISSUE 12): TTFT and tok/s
+    for a mixed long-prompt/short-prompt workload through a 1P+1D
+    partitioned fleet vs the SAME two backends serving mixed — the
+    interleaved-median A/B discipline with a mismatch counter (greedy:
+    the two configurations must agree token-for-token).  On the CPU
+    backend this is a PARITY CONTROL per the documented caveat
+    (doc/operations.md "CPU-backend caveat"): prefill dispatches run
+    synchronously and the pool link is loopback, so the TTFT win lands
+    on the TPU rows when the device returns — the CPU row's job is
+    zero mismatches and a sane ship path."""
+    try:
+        from oim_tpu.serve import Engine
+        from oim_tpu.serve.server import ServeServer
+
+        n_long, n_short = (4, 4) if on_tpu else (2, 2)
+        new_tokens = 64 if on_tpu else 8
+        chunk = 32 if on_tpu else 4
+
+        def mk_server():
+            e = Engine(
+                params, cfg, n_slots=8, max_len=512, chunk=chunk,
+                prompt_buckets=(64, 256), kv_block=64,
+            )
+            e.warmup()
+            return ServeServer(e).start()
+
+        servers = [mk_server(), mk_server()]
+        try:
+            _disagg_legs(extras, on_tpu, cfg, n_long, n_short,
+                         new_tokens, servers)
+        finally:
+            # finally, not the success path: a mismatch assert or a
+            # wedged leg must not leak two live servers (driver
+            # threads + warmed engine caches) into the measurements
+            # the rest of the bench still has to take.
+            for server in servers:
+                server.stop()
+    except Exception as exc:  # pragma: no cover - diagnostics only
+        log(f"bench: disagg serving diagnostics skipped: {exc}")
+
+
+def _disagg_legs(
+    extras, on_tpu, cfg, n_long, n_short, new_tokens, servers
+) -> None:
+    """The timed A/B body of `_disagg_diagnostics` (split out so
+    server teardown rides ONE finally around it)."""
+    import concurrent.futures as _futures
+    import urllib.request
+
+    from oim_tpu.serve import Router
+
+    urls = [f"http://{s.host}:{s.port}" for s in servers]
+    long_prompts = [
+        [(5 * i + j) % cfg.vocab_size for j in range(192)]
+        for i in range(n_long)
+    ]
+    short_prompts = [
+        [(11 * i + j) % cfg.vocab_size for j in range(48)]
+        for i in range(n_short)
+    ]
+
+    def one_stream(base, tokens):
+        """(ttft_s, token list) for one streamed request."""
+        req = urllib.request.Request(
+            base + "/v1/generate",
+            json.dumps({
+                "tokens": tokens, "max_new_tokens": new_tokens,
+                "stream": True,
+            }).encode(),
+            {"Content-Type": "application/json"},
+        )
+        t0 = time.perf_counter()
+        ttft = None
+        out = []
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            for line in resp:
+                obj = json.loads(line)
+                assert "error" not in obj, obj
+                if obj.get("done"):
+                    out = obj["tokens"]
+                elif ttft is None:
+                    ttft = time.perf_counter() - t0
+        return ttft, out
+
+    def leg(router):
+        """One timed leg of the mixed workload; returns
+        (median long-prompt TTFT s, tok/s, ordered token lists)."""
+        base = f"http://{router.host}:{router.port}"
+        t0 = time.perf_counter()
+        with _futures.ThreadPoolExecutor(
+            max_workers=n_long + n_short
+        ) as pool:
+            longs = [
+                pool.submit(one_stream, base, p) for p in long_prompts
+            ]
+            shorts = [
+                pool.submit(one_stream, base, p)
+                for p in short_prompts
+            ]
+            results = [f.result() for f in longs + shorts]
+        dt = time.perf_counter() - t0
+        ttfts = sorted(t for t, _ in results[:n_long])
+        toks = [out for _, out in results]
+        total = sum(len(t) for t in toks)
+        return ttfts[len(ttfts) // 2], total / dt, toks
+
+    def router_for(pools, disagg):
+        for server, pool in zip(servers, pools):
+            server.pool = pool
+        router = Router(
+            backends=tuple(urls),
+            health_interval=60.0,
+            disagg_prompt_tokens=96 if disagg else 0,
+        ).start()
+        for b in list(router._backends.values()):
+            router._probe(b)  # pool/info fetch before traffic
+        return router
+
+    ab_pairs = max(1, int(os.environ.get(
+        "OIM_BENCH_DISAGG_AB_PAIRS", "1" if on_tpu else "3"
+    )))
+    d_ttft, d_tps, m_ttft, m_tps = [], [], [], []
+    mismatches = 0
+    ref_toks = None
+    for _ in range(ab_pairs):
+        router = router_for(("prefill", "decode"), disagg=True)
+        try:
+            ttft, tps, toks = leg(router)
+            ships = router.stats()["disagg"]["shipped"]
+        finally:
+            router.stop()
+        d_ttft.append(ttft)
+        d_tps.append(tps)
+        if ref_toks is None:
+            ref_toks = toks
+        mismatches += sum(a != b for a, b in zip(toks, ref_toks))
+        router = router_for(("mixed", "mixed"), disagg=False)
+        try:
+            ttft, tps, toks = leg(router)
+        finally:
+            router.stop()
+        m_ttft.append(ttft)
+        m_tps.append(tps)
+        mismatches += sum(a != b for a, b in zip(toks, ref_toks))
+    extras["serve_disagg_ttft_long_ms"] = round(
+        statistics.median(d_ttft) * 1000, 1
+    )
+    extras["serve_disagg_ttft_long_ms_mixed_ctl"] = round(
+        statistics.median(m_ttft) * 1000, 1
+    )
+    extras["serve_disagg_tok_per_s"] = round(statistics.median(d_tps))
+    extras["serve_disagg_tok_per_s_mixed_ctl"] = round(
+        statistics.median(m_tps)
+    )
+    extras["serve_disagg_mismatch_reqs"] = mismatches
+    extras["serve_disagg_ships_per_leg"] = ships
+    log(
+        f"bench: disagg 1P+1D long-prompt TTFT "
+        f"{extras['serve_disagg_ttft_long_ms']} ms / "
+        f"{extras['serve_disagg_tok_per_s']} tok/s vs mixed "
+        f"{extras['serve_disagg_ttft_long_ms_mixed_ctl']} ms / "
+        f"{extras['serve_disagg_tok_per_s_mixed_ctl']} tok/s "
+        f"({ab_pairs} interleaved pair(s), {ships} ships/leg, "
+        f"{mismatches} mismatched requests"
+        + ("" if on_tpu else "; CPU = parity control") + ")"
+    )
 
 
 def _spec_model_diagnostics(extras, on_tpu) -> None:

@@ -42,6 +42,7 @@ from oim_tpu.autoscale.policy import (
     FleetSnapshot,
     PolicyState,
     decide,
+    decide_pools,
 )
 
 __all__ = [
@@ -65,6 +66,7 @@ __all__ = [
     "Decision",
     "PolicyState",
     "decide",
+    "decide_pools",
     "SCALE_OUT",
     "SCALE_IN",
 ]

@@ -67,7 +67,13 @@ def parse_replica_record_path(path: str) -> str | None:
 
 @dataclass
 class ReplicaRecord:
-    """Durable managed-replica state (``autoscale/replicas/<rid>``)."""
+    """Durable managed-replica state (``autoscale/replicas/<rid>``).
+    ``pool`` is the disaggregation pool the replica was scaled out FOR
+    (ISSUE 12; empty on single-pool fleets and records written by
+    older incarnations — tolerant decode, like the load schema): a
+    replacement must restore capacity to the same pool, and the
+    per-pool snapshots count booting replicas against their own
+    watermarks."""
 
     replica_id: str
     state: str = PROVISIONING
@@ -75,6 +81,7 @@ class ReplicaRecord:
     controller: str = ""
     placement: dict = field(default_factory=dict)
     ts: float = 0.0
+    pool: str = ""
 
     def encode(self) -> str:
         return json.dumps(
@@ -84,6 +91,7 @@ class ReplicaRecord:
                 "controller": self.controller,
                 "placement": self.placement,
                 "ts": self.ts,
+                "pool": self.pool,
             },
             separators=(",", ":"),
         )
@@ -107,6 +115,7 @@ class ReplicaRecord:
             controller=str(doc.get("controller", "")),
             placement=doc.get("placement") or {},
             ts=float(doc.get("ts", 0.0)),
+            pool=str(doc.get("pool", "")),
         )
 
 
@@ -121,23 +130,51 @@ class Autoscaler:
     def __init__(
         self,
         db,
-        policy: policy_mod.AutoscalePolicy,
+        policy: policy_mod.AutoscalePolicy | None,
         actuator: Actuator,
         launcher: Launcher,
         *,
+        pool_policies: dict[str, policy_mod.AutoscalePolicy] | None = None,
         replica_prefix: str = "asr-",
         clock: Callable[[], float] = monotonic,
         wall: Callable[[], float] = _wall,
         monitor=None,
     ):
+        # ONE policy governs the whole fleet (the pre-disaggregation
+        # shape), OR ``pool_policies`` gives each disaggregation pool
+        # its own watermarks/cooldowns/bounds (ISSUE 12): prefill and
+        # decode replica counts then move independently on their own
+        # pools' utilization.  Internally the single-policy fleet IS a
+        # pool set with one unnamed pool — one evaluation path, no
+        # mode flag threading.
+        if pool_policies:
+            if policy is not None:
+                raise ValueError(
+                    "give either policy or pool_policies, not both"
+                )
+            for pool in pool_policies:
+                if not pool or "/" in pool or "-" in pool:
+                    raise ValueError(f"invalid pool name {pool!r}")
+            self._pool_policies = dict(pool_policies)
+        else:
+            if policy is None:
+                raise ValueError("need a policy (or pool_policies)")
+            self._pool_policies = {"": policy}
         self.db = db
-        self.policy = policy
+        # Legacy accessor + the source of fleet-wide knobs (staleness,
+        # default slot capacity for pool-less backends): the single
+        # policy, or an arbitrary-but-stable member of the pool set.
+        self.policy = policy or next(iter(self._pool_policies.values()))
         self.actuator = actuator
         self.launcher = launcher
         self.replica_prefix = replica_prefix
         self.clock = clock
         self.wall = wall
-        self._state = policy_mod.PolicyState(policy)
+        self._states = {
+            pool: policy_mod.PolicyState(p)
+            for pool, p in self._pool_policies.items()
+        }
+        self._state = next(iter(self._states.values()))
         # One lock over all mirrors: watch callbacks (registry threads),
         # monitor listeners, and the evaluation thread all touch them.
         # Actuation (RPCs, launcher) ALWAYS runs outside it.  RLock for
@@ -327,7 +364,10 @@ class Autoscaler:
         while True:
             with self._cond:
                 if not self._wake:
-                    self._cond.wait(timeout=self.policy.eval_period_s)
+                    self._cond.wait(timeout=min(
+                        p.eval_period_s
+                        for p in self._pool_policies.values()
+                    ))
                 if self._stop:
                     return
                 self._wake = False
@@ -340,68 +380,136 @@ class Autoscaler:
                     "autoscale evaluation failed", error=str(exc)
                 )
 
-    def fleet_snapshot(self) -> policy_mod.FleetSnapshot:
+    def _pool_of_locked(self, sid: str) -> str:
+        """Which disaggregation pool a live backend belongs to (lock
+        held): the managed record's pool wins (it covers booting
+        replicas with no load key yet), then the backend's own load
+        snapshot, then "mixed" — the pre-disaggregation default."""
+        record = self._replicas.get(sid)
+        if record is not None and record.pool:
+            return record.pool
+        snap = self._load.get(f"serve.{sid}")
+        if snap is not None:
+            return str(snap.get("pool") or "mixed")
+        return "mixed"
+
+    def _live_locked(self, pool: str | None) -> set[str]:
+        live = set(self._serve)
+        for rid, record in self._replicas.items():
+            if record.state in (PROVISIONING, UP):
+                live.add(rid)
+            elif record.state == DRAINING:
+                live.discard(rid)
+        if pool:
+            live = {
+                sid for sid in live if self._pool_of_locked(sid) == pool
+            }
+        return live
+
+    def fleet_snapshot(
+        self,
+        pool: str | None = None,
+        policy: policy_mod.AutoscalePolicy | None = None,
+    ) -> policy_mod.FleetSnapshot:
         """Assemble the policy inputs from the watch mirror.  A backend
         with no (fresh) load key contributes default capacity and zero
         busy — booting replicas dilute utilization, they never spike
-        it."""
+        it.  ``pool`` restricts the snapshot to one disaggregation
+        pool's members (per-pool watermarks, ISSUE 12); the fleet-view
+        gauges update only on the unrestricted call so a per-pool
+        evaluation never drops a sibling pool's series."""
+        policy = policy or self.policy
         now_wall = self.wall()
         with self._lock:
-            live = set(self._serve)
-            for rid, record in self._replicas.items():
-                if record.state in (PROVISIONING, UP):
-                    live.add(rid)
-                elif record.state == DRAINING:
-                    live.discard(rid)
+            live = self._live_locked(pool)
             busy = 0.0
             capacity = 0.0
             gauged: set[str] = set()
             for sid in live:
                 snap = self._load.get(f"serve.{sid}")
-                if snap is not None and self.policy.stale_load_s > 0:
-                    if now_wall - snap["ts"] > self.policy.stale_load_s:
+                if snap is not None and policy.stale_load_s > 0:
+                    if now_wall - snap["ts"] > policy.stale_load_s:
                         snap = None
                 if snap is None or snap["total_slots"] <= 0:
-                    capacity += self.policy.slots_per_replica
+                    capacity += policy.slots_per_replica
                     continue
                 busy += snap["queue_depth"] + snap["active_slots"]
                 capacity += snap["total_slots"]
-                self._m_queue.set(float(snap["queue_depth"]), sid)
-                self._m_active.set(float(snap["active_slots"]), sid)
-                gauged.add(sid)
-            # Departed backends stop exporting: a scaled-in replica's
-            # last queue depth must not read as live fleet pressure.
-            for sid in self._gauged - gauged:
-                self._m_queue.remove(sid)
-                self._m_active.remove(sid)
-            self._gauged = gauged
+                if pool is None:
+                    self._m_queue.set(float(snap["queue_depth"]), sid)
+                    self._m_active.set(float(snap["active_slots"]), sid)
+                    gauged.add(sid)
+            if pool is None:
+                # Departed backends stop exporting: a scaled-in
+                # replica's last queue depth must not read as live
+                # fleet pressure.
+                for sid in self._gauged - gauged:
+                    self._m_queue.remove(sid)
+                    self._m_active.remove(sid)
+                self._gauged = gauged
         return policy_mod.FleetSnapshot(
             replicas=len(live), busy=busy, capacity=capacity
         )
 
-    def evaluate_once(self) -> policy_mod.Decision:
+    def evaluate_once(self):
         """One full control-loop turn: replacements first (band- and
         cooldown-independent), then re-drive half-done records, then
-        the band decision.  Returns the band decision (tests assert on
-        it)."""
+        the band decision — per POOL when pool policies are configured
+        (ISSUE 12: prefill and decode watermarks evaluate against
+        their own pools' utilization, hold their own cooldowns, and
+        actuate independently).  Returns the band decision (tests
+        assert on it); a pooled autoscaler returns {pool: Decision}."""
         self._replace_pending()
         self._redrive_records()
-        snapshot = self.fleet_snapshot()
-        decision = policy_mod.decide(self.policy, snapshot)
+        pooled = "" not in self._pool_policies
+        if pooled:
+            # Gauge refresh rides the unrestricted snapshot; per-pool
+            # snapshots below skip it (a pool view must never drop a
+            # sibling pool's series).
+            self.fleet_snapshot()
+        snapshots = {
+            pool: self.fleet_snapshot(pool or None, policy)
+            for pool, policy in self._pool_policies.items()
+        }
+        # ONE band-decision path for single- and multi-pool fleets:
+        # policy.decide_pools is what runs here, not a parallel
+        # implementation beside it.
+        band = policy_mod.decide_pools(self._pool_policies, snapshots)
+        decisions: dict[str, policy_mod.Decision] = {}
+        desired_total = 0
+        for pool, policy in self._pool_policies.items():
+            decisions[pool], desired = self._evaluate_pool(
+                pool, policy, snapshots[pool], band[pool]
+            )
+            desired_total += desired
+        self._m_desired.set(float(desired_total))
+        return decisions if pooled else decisions[""]
+
+    def _evaluate_pool(
+        self,
+        pool: str,
+        policy: policy_mod.AutoscalePolicy,
+        snapshot: policy_mod.FleetSnapshot,
+        decision: policy_mod.Decision,
+    ) -> tuple[policy_mod.Decision, int]:
+        """Gate + actuate one pool's band decision; returns (decision,
+        the replica count this evaluation wants the pool at — the
+        fleet desired gauge's summand)."""
+        state = self._states[pool]
         now = self.clock()
         desired = snapshot.replicas
         held = ""
         if decision.direction == policy_mod.SCALE_OUT:
             desired = snapshot.replicas + decision.count
-            if self._state.enospc_blocks(now):
+            if state.enospc_blocks(now):
                 held = "enospc_backoff"
                 log.current().debug("scale-out held: ENOSPC backoff")
-            elif self._state.cooldown_blocks(policy_mod.SCALE_OUT, now):
+            elif state.cooldown_blocks(policy_mod.SCALE_OUT, now):
                 held = "cooldown"
                 log.current().debug("scale-out held: cooldown")
         elif decision.direction == policy_mod.SCALE_IN:
             desired = snapshot.replicas - decision.count
-            if self._state.cooldown_blocks(policy_mod.SCALE_IN, now):
+            if state.cooldown_blocks(policy_mod.SCALE_IN, now):
                 held = "cooldown"
                 log.current().debug("scale-in held: cooldown")
         if decision.direction is not None:
@@ -421,17 +529,17 @@ class Autoscaler:
                 busy=round(snapshot.busy, 2),
                 capacity=round(snapshot.capacity, 2),
                 replicas=snapshot.replicas,
-                high_watermark=self.policy.high_watermark,
-                low_watermark=self.policy.low_watermark,
+                high_watermark=policy.high_watermark,
+                low_watermark=policy.low_watermark,
+                pool=pool,
                 held=held,
             )
         if not held:
             if decision.direction == policy_mod.SCALE_OUT:
-                self._scale_out(decision)
+                self._scale_out(decision, pool, policy, state)
             elif decision.direction == policy_mod.SCALE_IN:
-                self._scale_in(decision)
-        self._m_desired.set(float(desired))
-        return decision
+                self._scale_in(decision, pool, state)
+        return decision, desired
 
     # -- actuation helpers (never called under self._lock) ------------------
 
@@ -449,18 +557,31 @@ class Autoscaler:
             self._need_replace.pop(replica_id, None)
         self.db.store(replica_record_key(replica_id), "")
 
-    def _next_replica_id(self) -> str:
+    def _state_for(self, pool: str) -> policy_mod.PolicyState:
+        """The cooldown/backoff state a record's pool evaluates under
+        (replacement/re-drive paths — a record whose pool has no
+        configured policy, e.g. after a reconfiguration, degrades to
+        an arbitrary-but-stable state rather than crashing)."""
+        return self._states.get(pool, self._state)
+
+    def _next_replica_id(self, pool: str = "") -> str:
         """Lowest free index over BOTH the replica records and the
         discovery table — derived from observed registry state so a
         restarted autoscaler re-picks the id a crashed incarnation was
         about to provision (ProvisionSlice then finds the existing
-        slice: exactly one allocation)."""
+        slice: exactly one allocation).  Pooled replicas carry their
+        pool in the id (``asr-prefill-0``) so an operator reading
+        `oimctl top` sees the partition at a glance."""
+        prefix = (
+            f"{self.replica_prefix}{pool}-" if pool
+            else self.replica_prefix
+        )
         with self._lock:
             taken = set(self._replicas) | set(self._serve) | self._evicted_ids
         k = 0
-        while f"{self.replica_prefix}{k}" in taken:
+        while f"{prefix}{k}" in taken:
             k += 1
-        return f"{self.replica_prefix}{k}"
+        return f"{prefix}{k}"
 
     def _provision_and_launch(self, record: ReplicaRecord) -> bool:
         """Drive one replica from its record to UP; returns False on
@@ -469,25 +590,46 @@ class Autoscaler:
         placement = self.actuator.provision(rid, record.chips)
         record.controller = placement.get("controller", record.controller)
         record.placement = placement
-        self.launcher.launch(rid, placement)
+        self._launch(record)
         record.state = UP
         self._store_record(record)
         return True
 
-    def _scale_out(self, decision: policy_mod.Decision) -> None:
+    def _launch(self, record: ReplicaRecord) -> None:
+        """One launcher hand-off: the pool rides INTO the launcher
+        beside the placement (the SubprocessLauncher template turns it
+        into --pool; the record, not the placement, is its durable
+        home) — shared by provision-and-launch AND relaunch so a
+        replacement can never strip the replica's pool."""
+        self.launcher.launch(
+            record.replica_id,
+            dict(record.placement, pool=record.pool) if record.pool
+            else record.placement,
+        )
+
+    def _scale_out(
+        self,
+        decision: policy_mod.Decision,
+        pool: str = "",
+        policy: policy_mod.AutoscalePolicy | None = None,
+        state: policy_mod.PolicyState | None = None,
+    ) -> None:
+        policy = policy or self.policy
+        state = state or self._state
         launched = 0
         for _ in range(decision.count):
-            rid = self._next_replica_id()
+            rid = self._next_replica_id(pool)
             record = ReplicaRecord(
                 replica_id=rid,
                 state=PROVISIONING,
-                chips=self.policy.chips_per_replica,
+                chips=policy.chips_per_replica,
+                pool=pool,
             )
             self._store_record(record)
             try:
                 self._provision_and_launch(record)
             except PoolExhaustedError as exc:
-                self._clamped(rid, decision, str(exc))
+                self._clamped(rid, decision, str(exc), policy, state)
                 self._drop_record(rid)
                 return
             except Exception as exc:
@@ -509,20 +651,27 @@ class Autoscaler:
                 subject=rid,
                 utilization=round(decision.utilization, 3),
                 reason=decision.reason,
+                pool=pool,
             )
             log.current().info(
                 "scaled out", replica=rid, reason=decision.reason
             )
         if launched:
-            self._state.note_action(policy_mod.SCALE_OUT, self.clock())
+            state.note_action(policy_mod.SCALE_OUT, self.clock())
 
     def _clamped(
-        self, rid: str, decision: policy_mod.Decision, error: str
+        self,
+        rid: str,
+        decision: policy_mod.Decision,
+        error: str,
+        policy: policy_mod.AutoscalePolicy | None = None,
+        state: policy_mod.PolicyState | None = None,
     ) -> None:
         """ENOSPC: clamp desire to what the pool holds and back off —
         a full pool is re-probed after enospc_backoff_s, not hammered
         every evaluation (and never crash-looped on)."""
-        self._state.note_enospc(self.clock())
+        policy = policy or self.policy
+        (state or self._state).note_enospc(self.clock())
         self._m_actions.inc(policy_mod.SCALE_OUT, "clamped")
         events.emit(
             "autoscale.clamped",
@@ -530,22 +679,25 @@ class Autoscaler:
             severity=events.WARNING,
             subject=rid,
             utilization=round(decision.utilization, 3),
-            backoff_s=self.policy.enospc_backoff_s,
+            backoff_s=policy.enospc_backoff_s,
             error=error,
         )
         log.current().warning(
             "scale-out clamped: chip pool exhausted",
             replica=rid,
-            backoff_s=self.policy.enospc_backoff_s,
+            backoff_s=policy.enospc_backoff_s,
             error=error,
         )
 
-    def _least_loaded(self, count: int) -> list[ReplicaRecord]:
+    def _least_loaded(
+        self, count: int, pool: str = ""
+    ) -> list[ReplicaRecord]:
         with self._lock:
             candidates = [
                 r
                 for r in self._replicas.values()
                 if r.state == UP and r.replica_id not in self._need_replace
+                and (not pool or r.pool == pool)
             ]
             loads = {
                 r.replica_id: self._load.get(f"serve.{r.replica_id}")
@@ -560,8 +712,14 @@ class Autoscaler:
         candidates.sort(key=lambda r: (busy(r), r.replica_id))
         return candidates[:count]
 
-    def _scale_in(self, decision: policy_mod.Decision) -> None:
-        victims = self._least_loaded(decision.count)
+    def _scale_in(
+        self,
+        decision: policy_mod.Decision,
+        pool: str = "",
+        state: policy_mod.PolicyState | None = None,
+    ) -> None:
+        state = state or self._state
+        victims = self._least_loaded(decision.count, pool)
         if not victims:
             log.current().info(
                 "scale-in wanted but no managed replica to remove "
@@ -590,12 +748,13 @@ class Autoscaler:
                 subject=record.replica_id,
                 utilization=round(decision.utilization, 3),
                 reason=decision.reason,
+                pool=pool,
             )
             log.current().info(
                 "scaled in", replica=record.replica_id, reason=decision.reason
             )
         if removed:
-            self._state.note_action(policy_mod.SCALE_IN, self.clock())
+            state.note_action(policy_mod.SCALE_IN, self.clock())
 
     def _retire(self, record: ReplicaRecord) -> None:
         """The scale-in drain sequence (doc/serving.md): (1) mark the
@@ -639,7 +798,7 @@ class Autoscaler:
                 else:
                     self._retire(record)
             except PoolExhaustedError as exc:
-                self._state.note_enospc(self.clock())
+                self._state_for(record.pool).note_enospc(self.clock())
                 log.current().warning(
                     "re-drive held: chip pool exhausted",
                     replica=record.replica_id,
@@ -678,7 +837,7 @@ class Autoscaler:
                 else:
                     self._relaunch(record, reason)
             except PoolExhaustedError as exc:
-                self._state.note_enospc(self.clock())
+                self._state_for(record.pool).note_enospc(self.clock())
                 self._m_actions.inc("replace", "clamped")
                 log.current().warning(
                     "replacement held: chip pool exhausted",
@@ -699,7 +858,7 @@ class Autoscaler:
         recorded placement (no control-plane round trip at all)."""
         rid = record.replica_id
         self.launcher.stop(rid, drain=False)  # clear any remnant
-        self.launcher.launch(rid, record.placement)
+        self._launch(record)
         with self._lock:
             self._need_replace.pop(rid, None)
         self._m_actions.inc("replace", "ok")
@@ -743,9 +902,10 @@ class Autoscaler:
                 )
         self._drop_record(rid)
         fresh = ReplicaRecord(
-            replica_id=self._next_replica_id(),
+            replica_id=self._next_replica_id(record.pool),
             state=PROVISIONING,
             chips=record.chips or self.policy.chips_per_replica,
+            pool=record.pool,
         )
         self._store_record(fresh)
         self._provision_and_launch(fresh)
@@ -777,6 +937,7 @@ class Autoscaler:
                         "state": r.state,
                         "chips": r.chips,
                         "controller": r.controller,
+                        "pool": r.pool,
                     }
                     for rid, r in self._replicas.items()
                 },

@@ -120,8 +120,23 @@ class SubprocessLauncher:
         self._lock = threading.Lock()
         self._procs: dict[str, subprocess.Popen] = {}
 
-    def _argv(self, replica_id: str) -> list[str]:
-        return [arg.format(id=replica_id) for arg in self.argv_template]
+    def _argv(self, replica_id: str, pool: str = "") -> list[str]:
+        """The replica's command line: ``{id}``/``{pool}`` substituted
+        from the template, and — when the autoscaler hands a pool role
+        down (per-pool policies, ISSUE 12) and the template claims it
+        nowhere — ``--pool <role>`` appended, so a pooled scale-out
+        launches a replica that actually REGISTERS in its pool (the
+        router partitions on what the replica itself reports, not on
+        what the autoscaler intended)."""
+        argv = [
+            arg.format(id=replica_id, pool=pool or "mixed")
+            for arg in self.argv_template
+        ]
+        if pool and "--pool" not in self.argv_template and not any(
+            "{pool}" in arg for arg in self.argv_template
+        ):
+            argv += ["--pool", pool]
+        return argv
 
     def _pidfile(self, replica_id: str) -> str:
         return os.path.join(self.state_dir, replica_id, "pid")
@@ -131,12 +146,18 @@ class SubprocessLauncher:
         replica_dir = os.path.join(self.state_dir, replica_id)
         os.makedirs(replica_dir, exist_ok=True)
         bootstrap = os.path.join(replica_dir, "tpu-bootstrap.json")
+        # The pool role rides IN the placement dict from the autoscaler
+        # (Launcher's two-arg seam predates it) but is not a
+        # chip-binding field: it reaches the process as --pool, not
+        # through the bootstrap.
+        placement = dict(placement)
+        pool = str(placement.pop("pool", "") or "")
         with open(bootstrap, "w") as fh:
             json.dump(placement, fh)
         env = dict(os.environ)
         env.update(self.env)
         env["TPU_BOOTSTRAP"] = bootstrap
-        proc = subprocess.Popen(self._argv(replica_id), env=env)
+        proc = subprocess.Popen(self._argv(replica_id, pool), env=env)
         with self._lock:
             self._procs[replica_id] = proc
         # Durable pid: replicas deliberately OUTLIVE the autoscaler

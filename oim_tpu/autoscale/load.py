@@ -41,6 +41,16 @@ _DEFAULTS: dict[str, Any] = {
     "kv_blocks_free": 0,
     "kv_blocks_shared": 0,
     "kv_fragmentation": 0.0,
+    # Disaggregated prefill/decode (ISSUE 12; "mixed"/zeros from
+    # pre-disaggregation publishers via the tolerant-decode defaults):
+    # which POOL this backend serves, and its share of the fleet's
+    # KV-ship traffic (exports served / ingests staged / bytes both
+    # ways) — the per-pool watermark policy and `oimctl top`'s pool
+    # column both key on these.
+    "pool": "mixed",
+    "kv_exports": 0,
+    "kv_imports": 0,
+    "kv_ship_bytes": 0,
     "token_rate": 0.0,
     "shed_queue_full": 0,
     "shed_deadline": 0,

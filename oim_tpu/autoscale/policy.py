@@ -172,6 +172,25 @@ def decide(policy: AutoscalePolicy, snapshot: FleetSnapshot) -> Decision:
     return Decision(None, 0, util, "inside the band")
 
 
+def decide_pools(
+    policies: dict[str, AutoscalePolicy],
+    snapshots: dict[str, FleetSnapshot],
+) -> dict[str, Decision]:
+    """Per-pool band decisions for a disaggregated fleet (ISSUE 12):
+    each pool — prefill, decode — evaluates its OWN watermarks against
+    its OWN members' utilization, so the two replica counts move
+    independently (a prompt-heavy hour grows the prefill pool while
+    decode holds, and vice versa).  Pure like :func:`decide`; a pool
+    with no snapshot evaluates empty (bootstrap to min_replicas).  The
+    autoscaler holds per-pool :class:`PolicyState` cooldowns beside
+    these."""
+    empty = FleetSnapshot(replicas=0, busy=0.0, capacity=0.0)
+    return {
+        pool: decide(policy, snapshots.get(pool, empty))
+        for pool, policy in policies.items()
+    }
+
+
 class PolicyState:
     """The time-dependent half of the policy: per-direction cooldowns
     and the ENOSPC backoff.  Every method takes an explicit ``now``

@@ -166,9 +166,9 @@ def _print_top(
     """One fleet-summary frame: per-backend pressure + the fleet
     utilization the autoscaler's band policy acts on."""
     print(
-        f"{'BACKEND':<28} {'HEALTHY':<8} {'QUEUE':>6} {'ACTIVE':>7} "
-        f"{'SLOTS':>6} {'TOK/S':>9} {'KV f/s/t':>12} {'SHED q/d/b':>12} "
-        f"BROWNOUT"
+        f"{'BACKEND':<28} {'HEALTHY':<8} {'POOL':<8} {'QUEUE':>6} "
+        f"{'ACTIVE':>7} {'SLOTS':>6} {'TOK/S':>9} {'KV f/s/t':>12} "
+        f"{'SHIP e/i':>9} {'SHED q/d/b':>12} BROWNOUT"
     )
     busy = capacity = 0.0
     for bid, healthy, load in rows:
@@ -188,15 +188,24 @@ def _print_top(
             f"{load.get('kv_fragmentation', 0.0):.0%}"
             if kv_total else "-"
         )
+        # KV-ship participation (disaggregated fleets): exports served
+        # (prefill side) / ingests staged (decode side).
+        ship = (
+            f"{load.get('kv_exports', 0)}/{load.get('kv_imports', 0)}"
+            if load.get("kv_exports") or load.get("kv_imports")
+            else "-"
+        )
         shed = (
             f"{load.get('shed_queue_full', 0)}/"
             f"{load.get('shed_deadline', 0)}/"
             f"{load.get('shed_brownout', 0)}"
         )
         print(
-            f"{bid[:28]:<28} {'yes' if healthy else 'NO':<8} {q:>6} "
+            f"{bid[:28]:<28} {'yes' if healthy else 'NO':<8} "
+            f"{str(load.get('pool') or 'mixed')[:8]:<8} {q:>6} "
             f"{a:>7} {s:>6} {load.get('token_rate', 0.0):>9.1f} "
-            f"{kv:>12} {shed:>12} {'yes' if load.get('brownout') else '-'}"
+            f"{kv:>12} {ship:>9} {shed:>12} "
+            f"{'yes' if load.get('brownout') else '-'}"
         )
     util = busy / capacity if capacity else 0.0
     print(

@@ -59,6 +59,30 @@ def build_parser() -> argparse.ArgumentParser:
         "over the least-loaded one before affinity yields to balance",
     )
     p.add_argument(
+        "--disagg-prompt-tokens", type=int, default=0, metavar="N",
+        help="disaggregated prefill/decode (doc/serving.md): streamed "
+        "token-list /v1/generate requests with at least N prompt "
+        "tokens run prefill on a --pool prefill backend, ship the KV "
+        "blocks to a --pool decode backend, and continue the stream "
+        "there; 0 (default) disables.  Takes effect only while both "
+        "pools have a healthy member; every ship failure falls back "
+        "to the splice-recompute continuation (token-identical "
+        "greedy)",
+    )
+    p.add_argument(
+        "--disagg-first-tokens", type=int, default=1, metavar="K",
+        help="token budget of the disaggregated prefill leg (the "
+        "max_new_tokens clamp): K tokens stream from the prefill "
+        "backend while the ship is in flight; keep it at/below the "
+        "backend decode chunk",
+    )
+    p.add_argument(
+        "--disagg-ship-timeout", type=float, default=30.0, metavar="S",
+        help="per-leg timeout for the KV ship (GET /v1/kv + PUT "
+        "/v1/kv); a slow ship falls back to recompute rather than "
+        "stalling the client stream",
+    )
+    p.add_argument(
         "--http-tls", action="store_true",
         help="mTLS on the data plane with the same --ca/--cert/--key: "
         "the router's own listener requires client certs AND the router "
@@ -119,6 +143,9 @@ def main(argv=None) -> int:
             client_ssl_context=client_ctx,
             affinity_prefix_tokens=args.affinity_prefix_tokens,
             affinity_slack=args.affinity_slack,
+            disagg_prompt_tokens=args.disagg_prompt_tokens,
+            disagg_first_tokens=args.disagg_first_tokens,
+            disagg_ship_timeout=args.disagg_ship_timeout,
         ).start()
     except ValueError as exc:
         raise SystemExit(str(exc))

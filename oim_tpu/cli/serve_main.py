@@ -228,6 +228,18 @@ def build_parser() -> argparse.ArgumentParser:
         "footprint, n_slots x max_len / --kv-block)",
     )
     p.add_argument(
+        "--pool", default="mixed", choices=("prefill", "decode", "mixed"),
+        help="disaggregation pool role (doc/serving.md 'Disaggregated "
+        "prefill/decode'): prefill = take long-prompt admissions and "
+        "serve GET /v1/kv exports (pair with --kv-block; a dense "
+        "prefill backend makes every ship fall back to recompute), "
+        "decode = ingest shipped KV (PUT /v1/kv) and stream "
+        "continuations, mixed (default) = serve everything, no ships; "
+        "surfaced via /v1/info, load/serve.<id>, and the leased "
+        "serve/<id>/pool registry key so oim-route partitions the "
+        "fleet",
+    )
+    p.add_argument(
         "--bootstrap", default="",
         help="tpu-bootstrap.json path (default: $TPU_BOOTSTRAP when set)",
     )
@@ -487,6 +499,7 @@ def main(argv=None) -> int:
             args.advertise,  # filled in once the port is known
             tls=load_tls(args.ca, args.cert, args.key) if args.ca else None,
             delay=args.registry_delay,
+            pool=args.pool,
         )
     from oim_tpu.common import events, tracing
 
@@ -528,6 +541,7 @@ def main(argv=None) -> int:
         watchdog_interval=args.watchdog_interval,
         stall_multiplier=args.stall_multiplier,
         stall_floor_s=args.stall_floor,
+        pool=args.pool,
     ).start()
     log.current().info(
         "oim-serve listening", host=server.host, port=server.port,
@@ -546,8 +560,10 @@ def main(argv=None) -> int:
         # Load telemetry beside the address beat: the leased
         # load/serve.<id> key the autoscaler's utilization rides on
         # (freshness = --registry-delay; lower it on autoscaled fleets,
-        # doc/operations.md "Autoscaling").
-        registration.load = engine.load
+        # doc/operations.md "Autoscaling").  The server's snapshot, not
+        # the engine's: it adds the pool role the per-pool watermarks
+        # partition on.
+        registration.load = server.load_snapshot
         registration.start()
         # Durable WARNING+ publication under the serving identity (TLS
         # CN serve.<id> — the registry's events/ authz subtree).

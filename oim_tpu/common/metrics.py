@@ -383,6 +383,36 @@ SERVE_DEADLINE_EXPIRED = _registry.counter(
 )
 
 # ---------------------------------------------------------------------------
+# Disaggregated prefill/decode instruments (serve/disagg.py): the
+# router's KV-ship health.  Shared definitions like the fault-tolerance
+# set so the doc/operations.md incident queries see one series shape.
+
+SERVE_KV_SHIP_SECONDS = _registry.histogram(
+    "oim_serve_kv_ship_seconds",
+    "Wall time of one KV ship (GET /v1/kv off the prefill backend + "
+    "PUT /v1/kv into the decode backend), observed by the router.  "
+    "Growing tails here eat the TTFT win disaggregation exists for — "
+    "compare against oim_serve_prefill_seconds before raising the "
+    "prompt threshold.",
+)
+SERVE_KV_SHIP_BYTES = _registry.counter(
+    "oim_serve_kv_ship_bytes_total",
+    "Bytes of KV block payload shipped between pools (manifest + raw "
+    "leaves), router-observed — the disaggregation path's network "
+    "cost.",
+)
+SERVE_DISAGG = _registry.counter(
+    "oim_serve_disagg_requests_total",
+    "Disaggregated generate requests by outcome: shipped = prefill -> "
+    "KV ship -> decode continuation completed the planned way, "
+    "fell_back = any step failed and the request finished via the "
+    "splice-recompute continuation (token-identical, prefill paid "
+    "again), prefill_only = EOS landed inside the first chunk so "
+    "nothing needed shipping.",
+    ("outcome",),
+)
+
+# ---------------------------------------------------------------------------
 # Per-tenant SLO attribution histograms (ISSUE 9): the engine's phase
 # clock (queue → admit → prefill → decode → stream) keyed by the mTLS
 # tenant CN the HTTP layer hands in with each request.  Shared

@@ -101,3 +101,14 @@ def copy_block(pool, src, dst):
     scalars (one compile covers every block pair)."""
     row = jax.lax.dynamic_index_in_dim(pool, src, 1, keepdims=False)
     return jax.lax.dynamic_update_index_in_dim(pool, row, dst, 1)
+
+
+def write_block(pool, row, dst):
+    """Write one block's rows ``row`` [n_layers, block_size, ...] at
+    block ``dst`` of a stacked pool [n_layers, n_blocks, ...] — the
+    ingest half of a KV ship (serve/disagg.py): the engine reserves
+    ``dst`` fresh from its allocator and lands the shipped bytes there
+    before the continuation's tail prefill dispatches, so the single
+    device stream orders import → decode.  ``dst`` is traced (one
+    compile covers every destination block)."""
+    return jax.lax.dynamic_update_index_in_dim(pool, row, dst, 1)

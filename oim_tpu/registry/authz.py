@@ -56,8 +56,12 @@ AUTHZ_GRANTS: tuple[tuple[str, str], ...] = (
     # registry-side monitor writes).
     (CONTROLLER_CN_PREFIX + "{id}", "{id}/address"),
     (CONTROLLER_CN_PREFIX + "{id}", "health/{id}/*"),
-    # A serving instance announces only its own discovery key.
+    # A serving instance announces only its own discovery key and its
+    # own disaggregation pool role (serve/registration.py — the
+    # router/autoscaler partition the fleet on it; forging a sibling's
+    # role would mis-route its traffic class).
     (SERVE_CN_PREFIX + "{id}", "serve/{id}/address"),
+    (SERVE_CN_PREFIX + "{id}", "serve/{id}/pool"),
     # A node agent publishes its own multi-host rendezvous entry; any
     # staging host may commit the volume's coordinator (the protocol
     # lets only the sort-first one actually do it, but the registry

@@ -1,0 +1,303 @@
+"""Disaggregated prefill/decode serving: the paged-KV block-transfer
+protocol and its router-side ship client.
+
+The serving-plane analogue of the control plane's registry-routed
+resource handoff (SURVEY §1, §7): a request's KV state — which the
+paged cache (ISSUE 10) already makes an enumerable set of refcounted
+fixed-size blocks — ships between TPU backends over HTTP, so a fleet
+can split into a **prefill pool** (long-prompt admission, TTFT-bound)
+and a **decode pool** (steady token streaming, bandwidth-bound) that
+scale independently.  The flow (doc/serving.md "Disaggregated
+prefill/decode"):
+
+1. The router admits a long prompt to a prefill backend with
+   ``max_new_tokens`` clamped to the first chunk and ``hold_kv`` set —
+   on completion the engine RETAINS the request's blocks (one incref
+   each) instead of freeing them, keyed by rid with a TTL.
+2. ``GET /v1/kv?rid=N`` on the prefill backend streams the held state:
+   an 8-byte big-endian manifest length, a JSON manifest (geometry,
+   valid rows, prompt + emitted tokens, sampling state, leaf table),
+   then each leaf's raw bytes in manifest order — byte-for-byte the
+   ``GET /v1/weights`` framing (PR 7), applied to KV blocks.
+3. The router POSTs the same bytes to a decode backend's
+   ``PUT /v1/kv`` ingest, which geometry-validates the manifest,
+   reserves fresh pool blocks (all-or-nothing: exhaustion answers 429
+   — capacity backpressure, never a partial import), and stages the
+   payload host-side; the continuation request (``kv_import``)
+   scatter-writes the blocks on the driver thread and resumes decode
+   at the shipped frontier — no recompute of the prefill.
+4. Any failure — dense (non-paged) backend, geometry mismatch, ship
+   killed mid-body, ingest capacity — falls back to the router's
+   splice-recompute continuation (PR 6): token-identical greedy, the
+   same exactness contract, just paying the prefill again.
+
+Exactness: both backends serve the same checkpoint, so shipped KV rows
+are bit-identical to what the decode backend would have computed — the
+continuation is token-identical to the same request on one mixed
+backend (tests/test_serve_disagg.py pins the matrix).
+
+This module owns the WIRE protocol (manifest codec + framing), the
+error taxonomy, the hold/import bookkeeping records, and the
+router-side ship client; engine-side state (refcounts, block tables,
+the staged-import write) lives in ``serve/engine.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+import numpy as np
+
+# Pool roles (oim-serve --pool): "prefill" backends take long-prompt
+# admissions and serve /v1/kv exports; "decode" backends ingest shipped
+# KV and stream the continuation; "mixed" (the default) does both and
+# never participates in a ship.
+POOLS = ("prefill", "decode", "mixed")
+
+# Hold/import bounds: a KV hold (prefill side) or staged import (decode
+# side) pins pool blocks, so both are TTL'd and count-capped — an
+# orchestrator that died mid-ship leaks nothing past the TTL, and a
+# flood of ingests cannot pin the pool shut (oldest evicted first).
+KV_HOLD_TTL_S = 60.0
+KV_HOLD_MAX = 8
+KV_IMPORT_TTL_S = 60.0
+KV_IMPORT_MAX = 8
+
+MANIFEST_KIND = "oim-kv"
+MANIFEST_VERSION = 1
+
+
+class KvTransferError(RuntimeError):
+    """Base: this backend cannot serve/accept the requested transfer."""
+
+
+class KvIneligibleError(KvTransferError):
+    """Dense (non-paged) engine, or no such hold — the dense-ineligible
+    guard: the router falls back to splice recompute (HTTP 409/404)."""
+
+
+class KvGeometryError(KvTransferError):
+    """Manifest geometry does not match this engine (layer count, KV
+    heads, head dim, block size, quantization, dtype) — shipping
+    between heterogeneous replicas is refused, never coerced (HTTP
+    409)."""
+
+
+class KvCapacityError(KvTransferError):
+    """The ingest pool cannot reserve the shipped blocks right now —
+    capacity backpressure (HTTP 429 + Retry-After), the admission
+    planner's OOM-of-blocks stance applied to imports."""
+
+
+@dataclass
+class KvHold:
+    """Prefill-side retained KV: the completed request's block ids
+    (one extra ref each, taken at finish), the valid row frontier, and
+    the full token record (prompt + emitted) the continuation must
+    extend.  Host bookkeeping only — block contents live in the pool,
+    kept alive by the refs."""
+
+    rid: int
+    blocks: tuple[int, ...]
+    rows: int
+    prompt_tokens: list[int]
+    tokens: list[int]  # emitted
+    sampling: dict
+    t_created: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class KvImport:
+    """Decode-side staged ingest: freshly reserved block ids (ref 1
+    each), the shipped frontier, the token record the continuation
+    request must match, and the host-side leaf payload the driver
+    thread scatter-writes at admission."""
+
+    import_id: int
+    blocks: tuple[int, ...]
+    rows: int
+    tokens: list[int]  # prompt + emitted, the continuation's prompt
+    data: dict  # leaf name → np array [n_layers, n_ship, bs, kvh, hd]
+    t_created: float = field(default_factory=time.monotonic)
+
+
+def _np_dtype(name: str):
+    """numpy dtype for a manifest dtype name, including the ml_dtypes
+    names (bfloat16) numpy itself does not know — the checkpoint
+    manifest convention (checkpoint/manager.py).  An unknown name is a
+    malformed manifest (:class:`KvGeometryError`), never an escaping
+    AttributeError — the PUT handler must answer a clean 4xx."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError, TypeError) as exc:
+        raise KvGeometryError(f"unknown leaf dtype {name!r}") from exc
+
+
+def build_manifest(
+    *,
+    geometry: dict,
+    rows: int,
+    prompt_tokens: list[int],
+    tokens: list[int],
+    sampling: dict,
+    leaves: list[dict],
+) -> dict:
+    return {
+        "kind": MANIFEST_KIND,
+        "version": MANIFEST_VERSION,
+        "geometry": geometry,
+        "rows": rows,
+        "prompt_tokens": list(prompt_tokens),
+        "tokens": list(tokens),
+        "sampling": dict(sampling),
+        "leaves": leaves,
+    }
+
+
+def pack_transfer(manifest: dict, arrays: list[np.ndarray]) -> bytes:
+    """One transfer as bytes: 8-byte big-endian manifest length, the
+    JSON manifest, each leaf's raw bytes in manifest order (the
+    /v1/weights framing).  Small transfers only ride this helper
+    (tests, the ingest response path); the export endpoint streams
+    leaf-by-leaf instead of materializing the whole body."""
+    mb = json.dumps(manifest, separators=(",", ":")).encode()
+    parts = [struct.pack(">Q", len(mb)), mb]
+    for arr in arrays:
+        parts.append(np.ascontiguousarray(arr).tobytes())
+    return b"".join(parts)
+
+
+def unpack_transfer(body: bytes) -> tuple[dict, dict]:
+    """Parse one transfer body → (manifest, {leaf name: np array}).
+    Raises KvGeometryError on any framing/shape problem — a torn or
+    foreign body must refuse cleanly, never ingest garbage."""
+    try:
+        if len(body) < 8:
+            raise ValueError("short header")
+        (mlen,) = struct.unpack(">Q", body[:8])
+        if mlen > len(body) - 8:
+            raise ValueError("manifest length exceeds body")
+        manifest = json.loads(body[8:8 + mlen])
+        if (
+            not isinstance(manifest, dict)
+            or manifest.get("kind") != MANIFEST_KIND
+        ):
+            raise ValueError(f"not a {MANIFEST_KIND} manifest")
+        off = 8 + mlen
+        data: dict[str, np.ndarray] = {}
+        for leaf in manifest["leaves"]:
+            dtype = _np_dtype(leaf["dtype"])
+            shape = tuple(int(d) for d in leaf["shape"])
+            count = int(np.prod(shape)) if shape else 1
+            nbytes = count * dtype.itemsize
+            if off + nbytes > len(body):
+                raise ValueError(f"leaf {leaf['name']} truncated")
+            data[leaf["name"]] = np.frombuffer(
+                body, dtype=dtype, count=count, offset=off
+            ).reshape(shape)
+            off += nbytes
+        if off != len(body):
+            raise ValueError(f"{len(body) - off} trailing bytes")
+        return manifest, data
+    except KvGeometryError:
+        raise
+    except (KeyError, TypeError, ValueError, struct.error) as exc:
+        raise KvGeometryError(f"malformed KV transfer: {exc}") from exc
+
+
+def validate_geometry(manifest: dict, geometry: dict) -> None:
+    """Refuse a manifest whose geometry does not match this engine's
+    (``geometry`` = the engine's own dict, same keys).  Checked on the
+    MANIFEST before any payload is staged — the weight-fetch
+    discipline (PR 7 review)."""
+    theirs = manifest.get("geometry")
+    if not isinstance(theirs, dict):
+        raise KvGeometryError("manifest carries no geometry")
+    for key, want in geometry.items():
+        got = theirs.get(key)
+        if got != want:
+            raise KvGeometryError(
+                f"geometry mismatch on {key}: peer has {got!r}, "
+                f"this engine has {want!r}"
+            )
+    rows = manifest.get("rows")
+    n_tok = len(manifest.get("prompt_tokens", ())) + len(
+        manifest.get("tokens", ())
+    )
+    if not isinstance(rows, int) or rows < 1 or rows != n_tok - 1:
+        raise KvGeometryError(
+            f"rows {rows!r} inconsistent with {n_tok} tokens "
+            f"(valid rows must be tokens - 1)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Router-side ship client
+
+
+def ship_kv(
+    opener,
+    prefill_url: str,
+    rid: int,
+    decode_url: str,
+    timeout: float = 30.0,
+) -> tuple[int, int, int]:
+    """Move one held KV state: GET it off the prefill backend, PUT it
+    into the decode backend's ingest.  Returns (import_id, rows,
+    bytes shipped).  Raises on ANY failure — short read (a backend
+    killed mid-ship), HTTP error, unparseable ingest reply — and the
+    caller falls back to splice recompute; this function performs no
+    cleanup (the caller releases the hold either way).
+
+    The body is relayed verbatim (the decode backend validates the
+    manifest itself); the router never parses leaves."""
+    with opener(
+        f"{prefill_url}/v1/kv?rid={int(rid)}", timeout=timeout
+    ) as resp:
+        clen = int(resp.headers.get("Content-Length", "0"))
+        body = resp.read()
+    if clen and len(body) != clen:
+        raise OSError(
+            f"KV fetch truncated: {len(body)} of {clen} bytes "
+            f"(prefill backend died mid-ship)"
+        )
+    req = urllib.request.Request(
+        f"{decode_url}/v1/kv",
+        data=body,
+        headers={"Content-Type": "application/octet-stream"},
+        method="PUT",
+    )
+    with opener(req, timeout=timeout) as resp:
+        reply = json.loads(resp.read())
+    return int(reply["import_id"]), int(reply["rows"]), len(body)
+
+
+def release_kv(
+    opener, url: str, *, rid: int | None = None,
+    import_id: int | None = None, timeout: float = 5.0,
+) -> None:
+    """Best-effort DELETE of a hold (prefill side) or a staged import
+    (decode side): the TTL expires either anyway, this just returns
+    the blocks at the ship's own cadence instead of seconds later."""
+    query = (
+        f"rid={int(rid)}" if rid is not None
+        else f"import={int(import_id)}"
+    )
+    req = urllib.request.Request(
+        f"{url}/v1/kv?{query}", method="DELETE"
+    )
+    try:
+        with opener(req, timeout=timeout):
+            pass
+    except Exception:
+        pass  # the TTL sweep owns the backstop

@@ -96,7 +96,20 @@ from oim_tpu.models.decode import (
     nucleus_min_p_mask,
     truncate_logits,
 )
-from oim_tpu.ops.paged import copy_block, paged_store, paged_view
+from oim_tpu.ops.paged import copy_block, paged_store, paged_view, write_block
+from oim_tpu.serve.disagg import (
+    KV_HOLD_MAX,
+    KV_HOLD_TTL_S,
+    KV_IMPORT_MAX,
+    KV_IMPORT_TTL_S,
+    KvCapacityError,
+    KvGeometryError,
+    KvHold,
+    KvImport,
+    KvIneligibleError,
+    build_manifest,
+    validate_geometry,
+)
 from oim_tpu.ops.quant import (
     dequantize_named,
     make_kv_buffers,
@@ -376,6 +389,24 @@ def _cow_block(cache: PagedCache, src, dst):
     return PagedCache(
         cp(cache.k), cp(cache.v), cache.lengths,
         cp(cache.k_scale), cp(cache.v_scale),
+    )
+
+
+def _ingest_block(cache: PagedCache, kb, vb, ksb, vsb, dst):
+    """Device half of a KV-ship ingest (serve/disagg.py): write one
+    shipped block's rows into every pool at block ``dst`` — k/v and,
+    when int8, their scales (the scale args are unused [1] dummies on
+    a full-precision cache; the branch is trace-time static on the
+    pytree).  ``dst`` is traced, so ONE compile covers every
+    destination block; the engine chains these through ``self._cache``
+    before the continuation's prefill dispatch, device-stream-ordered
+    like copy-on-write."""
+    put = lambda pool, row: (  # noqa: E731
+        None if pool is None else write_block(pool, row, dst)
+    )
+    return PagedCache(
+        put(cache.k, kb), put(cache.v, vb), cache.lengths,
+        put(cache.k_scale, ksb), put(cache.v_scale, vsb),
     )
 
 
@@ -1147,6 +1178,17 @@ class GenRequest:
     # per-tenant SLO histograms and the completed-request ring.  Empty
     # = unauthenticated deployment; exported as "anon".
     tenant: str = ""
+    # Disaggregated prefill/decode (serve/disagg.py).  ``hold_kv``:
+    # retain this request's KV blocks after completion (one incref
+    # each, TTL'd) for a ``GET /v1/kv`` export — the prefill leg of a
+    # ship.  A no-op on dense engines (the dense-ineligible guard: the
+    # later export 404s and the router falls back to splice recompute).
+    hold_kv: bool = False
+    # ``kv_import``: admit from a staged ingest (``PUT /v1/kv``) —
+    # the continuation resumes decode at the shipped frontier instead
+    # of re-prefilling.  An expired/unknown import falls back to a
+    # normal (recompute) admission, token-identical either way.
+    kv_import: int | None = None
 
 
 class QueueFullError(RuntimeError):
@@ -1546,6 +1588,9 @@ class Engine:
             # Copy-on-write: one compile copies any (src, dst) block
             # pair across all four pools (k/v and their scales).
             self._cow = jax.jit(_cow_block, donate_argnums=(0,))
+            # KV-ship ingest: one compile writes any shipped block into
+            # the pool (serve/disagg.py; traced dst like _cow's pair).
+            self._ingest = jax.jit(_ingest_block, donate_argnums=(0,))
             # Bytes of one KV row (k + v + scales, all layers): the
             # unit the prefix-aliasing bytes-saved accounting counts.
             itemsize = 1 if kv_int8 else jnp.dtype(
@@ -1569,6 +1614,21 @@ class Engine:
         self.prefix_injects = 0
         self.prefix_bytes_saved = 0
         self.kv_admit_deferrals = 0
+        # Disaggregated prefill/decode state (serve/disagg.py), all
+        # under self._lock: completed hold_kv requests' retained blocks
+        # (rid → KvHold, one extra ref per block) and staged ingests
+        # (import id → KvImport, freshly reserved blocks + host
+        # payload the driver writes at admission).  Both TTL'd and
+        # count-capped so a ship that died mid-flight leaks nothing.
+        self._kv_holds: dict[int, KvHold] = {}
+        self._kv_imports: dict[int, KvImport] = {}
+        self._next_import_id = 0
+        # Per-engine transfer counters for load()/stats(): this
+        # backend's share of the fleet's ship traffic (exports served,
+        # ingests staged, bytes both ways).
+        self.kv_exports = 0
+        self.kv_imports_total = 0
+        self.kv_ship_bytes = 0
         # Model-drafted speculation: the draft model keeps its OWN slot
         # cache (full precision — it is small) in lockstep with the
         # target's lengths; prompt lookup's device-side history is then
@@ -1973,6 +2033,27 @@ class Engine:
                     f"(prompt {len(req.tokens)} + max_new_tokens "
                     f"{req.max_new_tokens}) but the pool holds only "
                     f"{self.kv_blocks} blocks of {self.kv_block}"
+                )
+        if req.kv_import is not None:
+            if not self.paged:
+                raise ValueError(
+                    "kv_import needs a paged engine (oim-serve "
+                    "--kv-block); this engine runs the dense cache"
+                )
+            with self._lock:
+                imp = self._kv_imports.get(req.kv_import)
+            # The import may legitimately TTL-expire before admission
+            # (the planner then falls back to a recompute prefill), but
+            # a PRESENT import whose token record is not a prefix of
+            # this request's prompt would decode against someone else's
+            # KV — reject loudly.
+            if imp is not None and (
+                len(imp.tokens) > len(req.tokens)
+                or list(req.tokens[: len(imp.tokens)]) != list(imp.tokens)
+            ):
+                raise ValueError(
+                    f"kv_import {req.kv_import} token record does not "
+                    f"prefix this request's prompt"
                 )
         if req.top_p is not None and not 0.0 < req.top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {req.top_p}")
@@ -2523,6 +2604,13 @@ class Engine:
                 ),
                 "kv_fragmentation": self._kv_fragmentation_locked(),
                 "kv_admit_deferrals": self.kv_admit_deferrals,
+                # Disaggregated-serving transfer state (serve/disagg.py;
+                # zeros on a dense engine).
+                "kv_holds": len(self._kv_holds),
+                "kv_imports_staged": len(self._kv_imports),
+                "kv_exports": self.kv_exports,
+                "kv_imports": self.kv_imports_total,
+                "kv_ship_bytes": self.kv_ship_bytes,
                 "spec_drafted": self.spec_drafted,
                 "spec_accepted": self.spec_accepted,
                 "readbacks": self.readbacks,
@@ -2619,6 +2707,12 @@ class Engine:
                     self._alloc.shared_blocks if self.paged else 0
                 ),
                 "kv_fragmentation": self._kv_fragmentation_locked(),
+                # KV-transfer counters (serve/disagg.py): this
+                # backend's share of the fleet's ship traffic, for the
+                # router's /v1/stats and `oimctl top` pool columns.
+                "kv_exports": self.kv_exports,
+                "kv_imports": self.kv_imports_total,
+                "kv_ship_bytes": self.kv_ship_bytes,
                 "token_rate": round(self._token_rate_ewma or 0.0, 2),
                 "shed_queue_full": self._shed_counts["queue_full"],
                 "shed_deadline": self._shed_counts["deadline"],
@@ -2832,6 +2926,11 @@ class Engine:
         # token was never registered in _slots.
         self._slots.pop(slot, None)
         self._free.append(slot)
+        # Disaggregated prefill (serve/disagg.py): a hold_kv request's
+        # blocks are retained for export BEFORE the slot release below
+        # decrefs them — the hold's own incref keeps them alive.
+        if state.req.hold_kv and self.paged and not self._warming:
+            self._hold_kv_locked(slot, state)
         # Paged: the request's blocks go back to the pool (prefix-cache
         # entries keep their own refs on any shared run) — the free
         # that makes admission backpressure drain.
@@ -3068,33 +3167,7 @@ class Engine:
         total_blocks = -(-needed_rows // bs)
         fresh_needed = total_blocks - len(aliased)
         if fresh_needed > self._alloc.free_blocks:
-            # Evict idle prefix entries LRU-first (never the matched
-            # one) — but ONLY when eviction can cover the shortfall
-            # now: entries whose blocks are still aliased by running
-            # slots (or by a sibling entry) free nothing, and flushing
-            # the cache without admitting anyone trades future hits
-            # for zero blocks — the head-of-line request retries every
-            # step, which would otherwise empty the whole cache on one
-            # transient shortage.  The exclusive-count sum undercounts
-            # mutually-aliased entry SETS (evicting both would free
-            # what neither frees alone) — conservative by design; the
-            # idle fallback below covers that case when it matters.
-            victims = [
-                (key, blocks)
-                for key, (blocks, _) in self._prefix_cache.items()
-                if key != best_key
-            ]
-            reclaimable = self._alloc.free_blocks + sum(
-                self._alloc.exclusive(blocks) for _, blocks in victims
-            )
-            if reclaimable >= fresh_needed:
-                for key, blocks in victims:
-                    if fresh_needed <= self._alloc.free_blocks:
-                        break
-                    if not self._alloc.exclusive(blocks):
-                        continue
-                    self._prefix_cache.pop(key)
-                    self._alloc.decref(blocks)
+            self._evict_prefix_for_locked(fresh_needed, keep_key=best_key)
         fresh = self._alloc.alloc(fresh_needed)
         if fresh is None and idle and self._prefix_cache:
             # Permanent shortage: the engine is empty of work, so ONLY
@@ -3145,12 +3218,357 @@ class Engine:
             "cow": None if cow_src is None else (cow_src, fresh[0]),
         }
 
+    def _evict_prefix_for_locked(
+        self, fresh_needed: int, keep_key=None
+    ) -> None:
+        """Evict idle prefix entries LRU-first (never ``keep_key``) —
+        but ONLY when eviction can cover the shortfall now: entries
+        whose blocks are still aliased by running slots (or by a
+        sibling entry) free nothing, and flushing the cache without
+        admitting anyone trades future hits for zero blocks — the
+        head-of-line request retries every step, which would otherwise
+        empty the whole cache on one transient shortage.  The
+        exclusive-count sum undercounts mutually-aliased entry SETS
+        (evicting both would free what neither frees alone) —
+        conservative by design; the admission planner's idle fallback
+        covers that case when it matters.  Lock held; shared by the
+        prefix planner and the KV-import planner."""
+        victims = [
+            (key, blocks)
+            for key, (blocks, _) in self._prefix_cache.items()
+            if key != keep_key
+        ]
+        reclaimable = self._alloc.free_blocks + sum(
+            self._alloc.exclusive(blocks) for _, blocks in victims
+        )
+        if reclaimable < fresh_needed:
+            return
+        for key, blocks in victims:
+            if fresh_needed <= self._alloc.free_blocks:
+                break
+            if not self._alloc.exclusive(blocks):
+                continue
+            self._prefix_cache.pop(key)
+            self._alloc.decref(blocks)
+
     def _commit_plan_locked(self, slot: int, plan: dict) -> None:
         row = self._tables_host[slot]
         row[:] = self.kv_blocks
         row[: len(plan["blocks"])] = plan["blocks"]
         self._tables_dirty = True
         self._update_kv_gauges_locked()
+
+    # -- disaggregated prefill/decode: KV export/ingest (ISSUE 12) --------
+
+    def kv_geometry(self) -> dict:
+        """The geometry contract a KV ship must match exactly
+        (serve/disagg.py ``validate_geometry``): shipping between
+        heterogeneous replicas is refused at the manifest, before any
+        payload moves."""
+        return {
+            "n_layers": self.cfg.n_layers,
+            "kv_heads": self.cfg.kv_heads,
+            "head_dim": self.cfg.head_dim,
+            "block_size": self.kv_block,
+            "kv_int8": self.kv_int8,
+            "dtype": str(self._cache.k.dtype),
+        }
+
+    def _hold_kv_locked(self, slot: int, state: _SlotState) -> None:
+        """Retain a finishing hold_kv request's KV for export (lock
+        held, called by _finish BEFORE the slot's blocks release): one
+        extra ref on every block the valid rows cover, recorded under
+        the rid with a TTL.  The frontier is ``tokens - 1`` rows — the
+        last emitted token has no cache row yet, exactly the state a
+        continuation prefill expects to extend."""
+        tokens = list(state.req.tokens) + list(state.emitted)
+        rows = len(tokens) - 1
+        if rows < 1:
+            return
+        n_ship = -(-rows // self.kv_block)
+        row = self._tables_host[slot]
+        blocks = tuple(int(b) for b in row[:n_ship])
+        if any(b >= self.kv_blocks for b in blocks):
+            return  # abort() sentineled the row mid-wave: nothing held
+        now = time.monotonic()
+        self._sweep_kv_holds_locked(now)
+        while len(self._kv_holds) >= KV_HOLD_MAX:
+            # Oldest evicted first: a flood of prefill legs must never
+            # pin the pool shut waiting on ships that may never come.
+            _, old = min(
+                self._kv_holds.items(), key=lambda kv: kv[1].t_created
+            )
+            self._release_kv_hold_locked(old.rid)
+        self._alloc.incref(blocks)
+        req = state.req
+        self._kv_holds[state.rid] = KvHold(
+            rid=state.rid,
+            blocks=blocks,
+            rows=rows,
+            prompt_tokens=list(req.tokens),
+            tokens=list(state.emitted),
+            sampling={
+                "seed": req.seed,
+                "temperature": req.temperature,
+                "top_p": req.top_p,
+                "min_p": req.min_p,
+            },
+            t_created=now,
+        )
+        self._update_kv_gauges_locked()
+
+    def _release_kv_hold_locked(self, rid: int) -> bool:
+        hold = self._kv_holds.pop(rid, None)
+        if hold is None:
+            return False
+        self._alloc.decref(hold.blocks)
+        self._update_kv_gauges_locked()
+        return True
+
+    def _sweep_kv_holds_locked(self, now: float) -> None:
+        for rid in [
+            r for r, h in self._kv_holds.items()
+            if now - h.t_created > KV_HOLD_TTL_S
+        ]:
+            self._release_kv_hold_locked(rid)
+
+    def _sweep_kv_imports_locked(self, now: float) -> None:
+        for iid in [
+            i for i, imp in self._kv_imports.items()
+            if now - imp.t_created > KV_IMPORT_TTL_S
+        ]:
+            self._release_kv_import_locked(iid)
+
+    def _release_kv_import_locked(self, import_id: int) -> bool:
+        imp = self._kv_imports.pop(import_id, None)
+        if imp is None:
+            return False
+        self._alloc.decref(imp.blocks)
+        self._update_kv_gauges_locked()
+        return True
+
+    def release_kv_hold(self, rid: int) -> bool:
+        """Drop a held export (the router's post-ship release, or the
+        DELETE /v1/kv handler); idempotent."""
+        if not self.paged:
+            return False
+        with self._lock:
+            return self._release_kv_hold_locked(rid)
+
+    def release_kv_import(self, import_id: int) -> bool:
+        """Drop a staged ingest nobody will consume; idempotent."""
+        if not self.paged:
+            return False
+        with self._lock:
+            return self._release_kv_import_locked(import_id)
+
+    def export_kv(self, rid: int):
+        """One held request's KV as (manifest, leaf arrays in manifest
+        order) — the ``GET /v1/kv`` payload (serve/disagg.py framing).
+
+        Safe from any thread: held blocks belong to no slot and are
+        never written after the hold was taken, so their contents are
+        IDENTICAL in every generation of the donated cache — the read
+        retries through a donation race (the driver consuming
+        ``self._cache`` mid-gather) by simply re-snapshotting the
+        current cache.  Raises ``KvIneligibleError`` on a dense engine
+        (the dense-ineligible guard) or an unknown/expired rid."""
+        if not self.paged:
+            raise KvIneligibleError(
+                "KV export needs a paged engine (oim-serve --kv-block)"
+            )
+        with self._lock:
+            self._sweep_kv_holds_locked(time.monotonic())
+            hold = self._kv_holds.get(rid)
+            if hold is None:
+                raise KvIneligibleError(f"no held KV for request {rid}")
+            cache = self._cache
+        ids = jnp.asarray(hold.blocks, jnp.int32)
+        names = ["k", "v"] + (
+            ["k_scale", "v_scale"] if self.kv_int8 else []
+        )
+        data = None
+        for attempt in range(8):
+            pools = [getattr(cache, name) for name in names]
+            try:
+                data = self._fetch_aux(
+                    [jnp.take(pool, ids, axis=1) for pool in pools]
+                )
+                break
+            except RuntimeError:
+                # The driver donated this cache generation away while
+                # the gather was being built; held-block contents are
+                # invariant across generations, so re-snap and retry.
+                with self._lock:
+                    cache = self._cache
+        else:
+            raise RuntimeError(
+                f"KV export for {rid} lost the donation race 8 times"
+            )
+        arrays = [np.asarray(a) for a in data]
+        leaves = [
+            {
+                "name": name,
+                "dtype": str(arr.dtype),
+                "shape": [int(d) for d in arr.shape],
+            }
+            for name, arr in zip(names, arrays)
+        ]
+        manifest = build_manifest(
+            geometry=self.kv_geometry(),
+            rows=hold.rows,
+            prompt_tokens=hold.prompt_tokens,
+            tokens=hold.tokens,
+            sampling=hold.sampling,
+            leaves=leaves,
+        )
+        total = sum(int(a.nbytes) for a in arrays)
+        with self._lock:
+            self.kv_exports += 1
+            self.kv_ship_bytes += total
+        return manifest, arrays
+
+    def import_kv(self, manifest: dict, data: dict) -> tuple[int, int]:
+        """Stage one shipped KV state for a continuation (``PUT
+        /v1/kv``): geometry-validate the manifest, reserve the shipped
+        block count from the pool (all-or-nothing —
+        ``KvCapacityError`` is capacity backpressure, HTTP 429), and
+        keep the host payload for the driver thread to scatter-write
+        at the continuation's admission.  Returns (import_id, rows).
+        Safe from handler threads: nothing here touches the device —
+        the single-writer cache discipline stays with the driver."""
+        if not self.paged:
+            raise KvIneligibleError(
+                "KV ingest needs a paged engine (oim-serve --kv-block)"
+            )
+        validate_geometry(manifest, self.kv_geometry())
+        rows = int(manifest["rows"])
+        tokens = [int(t) for t in manifest["prompt_tokens"]] + [
+            int(t) for t in manifest["tokens"]
+        ]
+        n_ship = -(-rows // self.kv_block)
+        if rows >= self.max_len:
+            raise KvGeometryError(
+                f"shipped rows {rows} exceed max_len {self.max_len}"
+            )
+        # FULL leaf validation — exact shape AND dtype, not just the
+        # leading dims: anything less reaches the jitted ingest write
+        # on the DRIVER thread at admission, where a mis-shaped update
+        # is a crash that latches the whole backend's error state.  A
+        # bad transfer must die HERE, as the 409 the protocol promises.
+        from oim_tpu.serve.disagg import _np_dtype
+
+        cfg = self.cfg
+        kv_shape = (
+            cfg.n_layers, n_ship, self.kv_block, cfg.kv_heads,
+            cfg.head_dim,
+        )
+        pool_dtype = _np_dtype(str(self._cache.k.dtype))
+        want = {"k": (kv_shape, pool_dtype), "v": (kv_shape, pool_dtype)}
+        if self.kv_int8:
+            scale_shape = kv_shape[:-1]
+            want["k_scale"] = (scale_shape, np.dtype(np.float32))
+            want["v_scale"] = (scale_shape, np.dtype(np.float32))
+        names = list(want)
+        for name, (shape, dtype) in want.items():
+            arr = data.get(name)
+            if (
+                arr is None
+                or tuple(arr.shape) != shape
+                or arr.dtype != dtype
+            ):
+                raise KvGeometryError(
+                    f"leaf {name} missing or mis-shaped/typed: want "
+                    f"{shape} {dtype}, got "
+                    + (
+                        "nothing" if arr is None
+                        else f"{tuple(arr.shape)} {arr.dtype}"
+                    )
+                )
+        total = sum(int(data[name].nbytes) for name in names)
+        with self._lock:
+            now = time.monotonic()
+            self._sweep_kv_imports_locked(now)
+            while len(self._kv_imports) >= KV_IMPORT_MAX:
+                _, old = min(
+                    self._kv_imports.items(),
+                    key=lambda kv: kv[1].t_created,
+                )
+                self._release_kv_import_locked(old.import_id)
+            blocks = self._alloc.alloc(n_ship)
+            if blocks is None:
+                raise KvCapacityError(
+                    f"pool cannot reserve {n_ship} blocks for the "
+                    f"shipped KV ({self._alloc.free_blocks} free) — "
+                    f"retry or fall back to recompute"
+                )
+            import_id = self._next_import_id
+            self._next_import_id += 1
+            self._kv_imports[import_id] = KvImport(
+                import_id=import_id,
+                blocks=tuple(blocks),
+                rows=rows,
+                tokens=tokens,
+                data={name: data[name] for name in names},
+                t_created=now,
+            )
+            self.kv_imports_total += 1
+            self.kv_ship_bytes += total
+            self._update_kv_gauges_locked()
+        return import_id, rows
+
+    def _plan_import_admission_locked(self, req: GenRequest, imp: KvImport):
+        """Admission plan for a staged-import continuation (lock
+        held): the shipped blocks become the slot's leading table
+        entries (refs transfer — no aliasing, no CoW: the import owns
+        them exclusively), the tail prefill starts at the shipped
+        frontier, and fresh blocks cover the rest of the worst case.
+        All-or-nothing like the prefix planner: a shortfall leaves the
+        request QUEUED with the import still staged (its TTL bounds
+        how long it can pin the pool)."""
+        bs = self.kv_block
+        start = imp.rows
+        needed_rows = self._worst_case_rows(
+            len(req.tokens), req.max_new_tokens, start
+        )
+        fresh_needed = max(0, -(-needed_rows // bs) - len(imp.blocks))
+        if fresh_needed > self._alloc.free_blocks:
+            self._evict_prefix_for_locked(fresh_needed)
+        fresh = self._alloc.alloc(fresh_needed)
+        if fresh is None:
+            if not self._warming:
+                self.kv_admit_deferrals += 1
+            return None
+        # Consumed: the slot's release path owns the decrefs from here.
+        self._kv_imports.pop(imp.import_id, None)
+        return {
+            "start": start,
+            "blocks": list(imp.blocks) + fresh,
+            "cow": None,
+            "ingest": imp,
+        }
+
+    def _write_import_blocks(self, imp: KvImport) -> None:
+        """Land a consumed import's payload in the pool (driver
+        thread, admission path): one jitted write per shipped block,
+        chained through ``self._cache`` BEFORE the continuation's
+        prefill dispatch so the single device stream orders
+        import → tail prefill → decode (the CoW chaining pattern)."""
+        dummy = jnp.zeros((1,), jnp.float32)
+        for j, dst in enumerate(imp.blocks):
+            kb = jnp.asarray(imp.data["k"][:, j])
+            vb = jnp.asarray(imp.data["v"][:, j])
+            ksb = (
+                jnp.asarray(imp.data["k_scale"][:, j])
+                if self.kv_int8 else dummy
+            )
+            vsb = (
+                jnp.asarray(imp.data["v_scale"][:, j])
+                if self.kv_int8 else dummy
+            )
+            self._cache = self._ingest(
+                self._cache, kb, vb, ksb, vsb, jnp.int32(dst)
+            )
 
     # oimlint: hotpath
     def _prefill_segment(
@@ -3487,6 +3905,12 @@ class Engine:
         now = time.monotonic()
         ended = []
         with self._lock:
+            if self.paged and (self._kv_holds or self._kv_imports):
+                # Drive the KV-transfer TTLs from the step loop too: a
+                # ship whose orchestrator died must return its blocks
+                # without waiting for the next export/ingest call.
+                self._sweep_kv_holds_locked(now)
+                self._sweep_kv_imports_locked(now)
             if not (
                 self._cancelled
                 or any(req.deadline is not None for _, req, _ in self._queue)
@@ -3584,18 +4008,30 @@ class Engine:
                     # later wave.  FIFO head-of-line by design: the
                     # queue's ordering promise beats opportunistically
                     # admitting a smaller latecomer forever.
-                    plan = self._plan_paged_admission_locked(
-                        req,
-                        # Nothing running, nothing admitted earlier in
-                        # THIS wave: only prefix entries can ever free
-                        # blocks, so the planner may sacrifice even the
-                        # matched one rather than wedge the queue.
-                        idle=(
-                            not self._slots
-                            and not self._admitting
-                            and not admissions
-                        ),
+                    imp = (
+                        self._kv_imports.get(req.kv_import)
+                        if req.kv_import is not None else None
                     )
+                    if imp is not None:
+                        # KV-ship continuation: resume at the shipped
+                        # frontier.  An expired import (imp is None)
+                        # falls through to the normal plan below — a
+                        # recompute prefill, token-identical output.
+                        plan = self._plan_import_admission_locked(req, imp)
+                    else:
+                        plan = self._plan_paged_admission_locked(
+                            req,
+                            # Nothing running, nothing admitted earlier
+                            # in THIS wave: only prefix entries can
+                            # ever free blocks, so the planner may
+                            # sacrifice even the matched one rather
+                            # than wedge the queue.
+                            idle=(
+                                not self._slots
+                                and not self._admitting
+                                and not admissions
+                            ),
+                        )
                     if plan is None:
                         break
                 self._queue.pop(0)
@@ -3636,7 +4072,12 @@ class Engine:
                     # time; the one device copy is the CoW duplicate of
                     # a partially-covered entry block, chained through
                     # self._cache BEFORE the prefill dispatch below so
-                    # the device stream orders copy → tail writes.
+                    # the device stream orders copy → tail writes.  A
+                    # KV-ship continuation lands its imported blocks
+                    # here the same way (import → tail prefill order).
+                    ingest = plan.pop("ingest", None)
+                    if ingest is not None:
+                        self._write_import_blocks(ingest)
                     if plan["cow"] is not None:
                         src, dst = plan["cow"]
                         self._cache = self._cow(
@@ -4272,6 +4713,30 @@ class Engine:
                 # one compile covers every live (src, dst) pair.
                 self._cache = self._cow(
                     self._cache, jnp.int32(0), jnp.int32(0)
+                )
+            if self.paged:
+                # Compile the KV-ship ingest write too (ONE program, dst
+                # traced): the first PUT /v1/kv continuation must not
+                # pay a mid-stream compile — the CoW-precompile rule
+                # applied to disaggregation.  Pool contents here are
+                # warmup dummies (cleared below), so zeroing block 0 is
+                # inert.
+                zk = jnp.zeros(
+                    (self.cfg.n_layers, self.kv_block, self.cfg.kv_heads,
+                     self.cfg.head_dim),
+                    self._cache.k.dtype,
+                )
+                zs = (
+                    jnp.zeros(
+                        (self.cfg.n_layers, self.kv_block,
+                         self.cfg.kv_heads),
+                        jnp.float32,
+                    )
+                    if self.kv_int8
+                    else jnp.zeros((1,), jnp.float32)
+                )
+                self._cache = self._ingest(
+                    self._cache, zk, zk, zs, zs, jnp.int32(0)
                 )
             if embed:
                 # Optional: one full-forward compile per bucket — only

@@ -36,6 +36,7 @@ class ServeRegistration:
         retry=None,
         health=None,
         load=None,
+        pool: str = "",
     ):
         if not serve_id or "/" in serve_id:
             raise ValueError(f"invalid serve id {serve_id!r}")
@@ -44,6 +45,14 @@ class ServeRegistration:
         self.advertised_address = advertised_address
         self.tls = tls
         self.delay = delay
+        # Disaggregation pool role (oim-serve --pool): published beside
+        # the address as leased ``serve/<id>/pool`` so pool membership
+        # is registry-discoverable (the autoscaler's per-pool
+        # watermarks and `oimctl top` read it without an HTTP hop; the
+        # router reads the same role from /v1/info).  Empty = not
+        # published (pre-disaggregation deployments stay byte-
+        # identical on the wire).
+        self.pool = pool
         # Optional load telemetry (callable → dict, the Engine.load()
         # shape): published each beat beside the address key as the
         # leased ``load/serve.<id>`` value — the autoscaler's
@@ -84,17 +93,32 @@ class ServeRegistration:
             # Per-attempt timeout shrinks to the remaining ladder budget
             # (a hanging registry must not stall the beat past it).
             timeout = attempt.clamped()
+            ttl = max(1, int(self.delay * 3))
             with registry_channel(self.registry_address, self.tls) as channel:
-                REGISTRY.stub(channel).SetValue(
+                stub = REGISTRY.stub(channel)
+                stub.SetValue(
                     oim_pb2.SetValueRequest(
                         value=oim_pb2.Value(
                             path=f"serve/{self.serve_id}/address",
                             value=self.advertised_address,
                         ),
-                        ttl_seconds=max(1, int(self.delay * 3)),
+                        ttl_seconds=ttl,
                     ),
                     timeout=timeout,
                 )
+                if self.pool:
+                    # Same lease as the address: pool membership and
+                    # reachability expire together.
+                    stub.SetValue(
+                        oim_pb2.SetValueRequest(
+                            value=oim_pb2.Value(
+                                path=f"serve/{self.serve_id}/pool",
+                                value=self.pool,
+                            ),
+                            ttl_seconds=ttl,
+                        ),
+                        timeout=timeout,
+                    )
 
         resilience.call_with_retry(
             beat, policy, component="oim-serve", op="Register"
@@ -137,7 +161,8 @@ class ServeRegistration:
 
         try:
             with registry_channel(self.registry_address, self.tls) as channel:
-                REGISTRY.stub(channel).SetValue(
+                stub = REGISTRY.stub(channel)
+                stub.SetValue(
                     oim_pb2.SetValueRequest(
                         value=oim_pb2.Value(
                             path=f"serve/{self.serve_id}/address", value=""
@@ -145,6 +170,16 @@ class ServeRegistration:
                     ),
                     timeout=5,
                 )
+                if self.pool:
+                    stub.SetValue(
+                        oim_pb2.SetValueRequest(
+                            value=oim_pb2.Value(
+                                path=f"serve/{self.serve_id}/pool",
+                                value="",
+                            )
+                        ),
+                        timeout=5,
+                    )
             events.emit(
                 "serve.deregister", component="oim-serve", subject=self.serve_id
             )

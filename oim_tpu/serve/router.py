@@ -50,6 +50,18 @@ Behavior:
 - Streaming: NDJSON bodies are piped through chunk-by-chunk; only
   complete lines are forwarded, so a mid-line backend death never
   corrupts client framing.
+- Disaggregated prefill/decode (serve/disagg.py, ``--disagg-prompt-
+  tokens``): when the fleet is partitioned into pools (oim-serve
+  ``--pool prefill|decode``), spliceable generate streams whose prompt
+  reaches the threshold run their prefill on a prefill-pool backend
+  (``max_new_tokens`` clamped to the first chunk, KV retained), the
+  written KV ships as paged blocks to a decode-pool backend
+  (``GET /v1/kv`` → ``PUT /v1/kv``), and the stream continues there —
+  TTFT stops queueing behind decode chunks and the two phases scale
+  independently.  Every failure along the ship falls back to the
+  splice-recompute continuation above: token-identical greedy, just
+  paying the prefill again.  Regular traffic avoids prefill-pool
+  backends while any non-prefill backend is healthy.
 
 Endpoints: the serving API (POST /v1/generate, /v1/beam, /v1/embed,
 and the OpenAI-compatible /v1/completions) proxied; GET /healthz (ok while ≥1 backend is healthy), /v1/stats
@@ -80,7 +92,8 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu import log
-from oim_tpu.common import metrics, tracing
+from oim_tpu.common import events, metrics, tracing
+from oim_tpu.serve.disagg import release_kv, ship_kv
 from oim_tpu.serve.httptls import check_serving_peer
 
 PROXIED = (
@@ -114,6 +127,14 @@ class Backend:
     # running serial (pipeline_depth 1) — roughly a 2x throughput skew
     # on tunneled deployments — without curling every backend.
     pipeline_depth: int = 0
+    # Disaggregation pool role (/v1/info "pool", oim-serve --pool):
+    # "prefill" backends take long-prompt admissions and serve /v1/kv
+    # exports, "decode" backends ingest shipped KV and stream the
+    # continuation, "mixed" (the default) stays outside the ship path.
+    # Regular traffic avoids prefill-pool backends whenever any
+    # non-prefill backend is healthy (the partition's whole point:
+    # TTFT work must not queue behind decode chunks, and vice versa).
+    pool: str = "mixed"
     info_fetched: bool = False
     # The backend's live load snapshot (the /v1/info "load" section =
     # its load/<cn> registry value), refreshed every successful health
@@ -148,6 +169,11 @@ class _SpliceState:
         self.prior_tokens: list[int] = []
         self.prior_lps: list[float] = []
         self.started = False  # response headers sent to our client
+        # The disaggregation path's captured terminal line: a prefill
+        # leg's done object (suppressed from the client — the stream
+        # continues on a decode backend), carrying the request_id that
+        # addresses the held KV.
+        self.captured_done: dict | None = None
 
     @staticmethod
     def plan(path: str, body: bytes | None) -> "_SpliceState | None":
@@ -166,12 +192,25 @@ class _SpliceState:
         except Exception:
             return None
 
-    def request_body(self) -> bytes:
+    def prefill_body(self, first_tokens: int) -> bytes:
+        """The disaggregation prefill leg's body: the original request
+        with ``max_new_tokens`` clamped to the first chunk and
+        ``hold_kv`` set — the backend retains the written KV for the
+        ship instead of freeing it (doc/serving.md "Disaggregated
+        prefill/decode")."""
+        payload = dict(self.payload)
+        payload["max_new_tokens"] = min(self.orig_max_new, first_tokens)
+        payload["hold_kv"] = True
+        return json.dumps(payload).encode()
+
+    def request_body(self, extra: dict | None = None) -> bytes:
         """The next attempt's body: the original bytes verbatim until a
         failover, then prompt + emitted-tokens continuation with the
         budget reduced by what the client already has.  ``cache_prefix``
         is dropped from continuations (a one-off spliced prompt must
-        not evict real entries from the new backend's prefix cache)."""
+        not evict real entries from the new backend's prefix cache).
+        ``extra`` fields (the disaggregation path's ``kv_import``)
+        merge into a continuation body."""
         if not self.prior_tokens:
             return self._orig_body
         payload = dict(self.payload)
@@ -180,6 +219,9 @@ class _SpliceState:
             self.orig_max_new - len(self.prior_tokens)
         )
         payload.pop("cache_prefix", None)
+        payload.pop("hold_kv", None)
+        if extra:
+            payload.update(extra)
         try:
             ms = float(payload.get("deadline_ms", 0))
             if ms > 0:
@@ -232,6 +274,9 @@ class Router:
         client_ssl_context=None,
         affinity_prefix_tokens: int = 32,
         affinity_slack: int = 2,
+        disagg_prompt_tokens: int = 0,
+        disagg_first_tokens: int = 1,
+        disagg_ship_timeout: float = 30.0,
     ):
         """``ssl_context`` wraps the router's own listener in mTLS;
         ``client_ssl_context`` authenticates the router to mTLS
@@ -254,6 +299,26 @@ class Router:
         self.request_timeout = request_timeout
         self.affinity_prefix_tokens = affinity_prefix_tokens
         self.affinity_slack = affinity_slack
+        # Disaggregated prefill/decode (serve/disagg.py): spliceable
+        # generate streams whose prompt reaches disagg_prompt_tokens
+        # run prefill on a prefill-pool backend (max_new_tokens clamped
+        # to disagg_first_tokens, KV held), ship the KV blocks to a
+        # decode-pool backend, and continue the stream there.  0 = off.
+        if disagg_first_tokens < 1:
+            raise ValueError(
+                f"disagg_first_tokens must be >= 1, got "
+                f"{disagg_first_tokens}"
+            )
+        self.disagg_prompt_tokens = disagg_prompt_tokens
+        self.disagg_first_tokens = disagg_first_tokens
+        self.disagg_ship_timeout = disagg_ship_timeout
+        # Ship-outcome counters for /v1/stats (the shared Prometheus
+        # instruments ride beside them; these are the router's own
+        # lifetime view, lock-protected like the backend table).
+        self._disagg = {
+            "shipped": 0, "fell_back": 0, "prefill_only": 0,
+            "ship_bytes": 0, "ship_seconds": 0.0,
+        }
         self._stop = threading.Event()
         self._rr = 0
         self._probing: set[str] = set()
@@ -386,6 +451,7 @@ class Router:
         self,
         exclude: set[str] = frozenset(),
         affinity_key: str | None = None,
+        pool: str | None = None,
     ) -> Backend | None:
         """Least-active healthy backend, round-robin among ties.
 
@@ -395,13 +461,26 @@ class Router:
         in-flight requests above the least-active backend.  This is how
         per-backend prompt-prefix caches stay useful behind the router:
         requests sharing a prefix land on the backend whose cache holds
-        it, but a hot prefix cannot starve the fleet."""
+        it, but a hot prefix cannot starve the fleet.
+
+        ``pool`` partitions a disaggregated fleet: "prefill"/"decode"
+        picks strictly within that pool (the ship path's legs); None —
+        regular traffic — avoids prefill-pool backends whenever any
+        non-prefill backend is healthy, so decode chunks never queue
+        behind long-prompt admissions (and degrades to the whole fleet
+        rather than 503 when only prefill backends survive)."""
         with self._lock:
             ready = [
                 b
                 for b in self._backends.values()
                 if b.healthy and b.id not in exclude
             ]
+            if pool is not None:
+                ready = [b for b in ready if b.pool == pool]
+            else:
+                ready = [
+                    b for b in ready if b.pool != "prefill"
+                ] or ready
             if not ready:
                 return None
             least = min(b.active for b in ready)
@@ -566,6 +645,19 @@ class Router:
                 deadline_abs = time.monotonic() + ms / 1000.0
         except ValueError:
             pass
+        if self._disagg_applicable(splice):
+            # Disaggregated prefill/decode (serve/disagg.py): prefill
+            # leg on a prefill-pool backend, KV blocks shipped to a
+            # decode-pool backend, stream continued there.  "fallback"
+            # lands in the ordinary loop below with the prefill leg's
+            # tokens already in splice.prior_tokens — the splice
+            # continuation (recompute prefill, token-identical greedy)
+            # IS the fallback contract.
+            outcome = self._disagg_attempt(
+                handler, splice, headers, span, deadline_abs, excluded
+            )
+            if outcome != "fallback":
+                return
         while True:
             if deadline_abs is not None:
                 remaining_ms = (deadline_abs - time.monotonic()) * 1000.0
@@ -724,6 +816,242 @@ class Router:
                 metrics.SERVE_FAILOVERS.inc("resubmitted")
             return
 
+    # -- disaggregated prefill/decode (serve/disagg.py) --------------------
+
+    def _disagg_applicable(self, splice: "_SpliceState | None") -> bool:
+        """Take the disaggregation path only for spliceable streams
+        whose prompt reaches the threshold, whose budget extends past
+        the first chunk, and while BOTH pools have a healthy member —
+        a half-partitioned fleet serves everything mixed."""
+        if splice is None or self.disagg_prompt_tokens <= 0:
+            return False
+        if len(splice.orig_tokens) < self.disagg_prompt_tokens:
+            return False
+        if splice.orig_max_new <= self.disagg_first_tokens:
+            return False
+        with self._lock:
+            pools = {
+                b.pool for b in self._backends.values() if b.healthy
+            }
+        return "prefill" in pools and "decode" in pools
+
+    def _leg_headers(
+        self, headers: dict, deadline_abs: float | None
+    ) -> dict | None:
+        """Per-leg outbound headers: the remaining deadline budget, the
+        _proxy_attempts convention.  None = budget exhausted (the
+        caller falls back; the ordinary loop answers the 504)."""
+        if deadline_abs is None:
+            return dict(headers)
+        remaining_ms = (deadline_abs - time.monotonic()) * 1000.0
+        if remaining_ms <= 0:
+            return None
+        return dict(
+            headers,
+            **{"x-oim-deadline-ms": str(max(1, int(remaining_ms)))},
+        )
+
+    def _disagg_fallback(
+        self, reason: str, prefill: str = "", decode: str = ""
+    ) -> None:
+        """One ship gave up: count it, journal it, and let the caller
+        drop into the splice-recompute continuation — the exactness-
+        preserving fallback (PR 6 contract)."""
+        with self._lock:
+            self._disagg["fell_back"] += 1
+        metrics.SERVE_DISAGG.inc("fell_back")
+        events.emit(
+            "disagg.fallback",
+            component="oim-route",
+            severity=events.WARNING,
+            reason=reason,
+            prefill=prefill,
+            decode=decode,
+        )
+        log.current().warning(
+            "KV ship fell back to splice recompute",
+            reason=reason, prefill=prefill, decode=decode,
+        )
+
+    def _disagg_attempt(
+        self, handler, splice: "_SpliceState", headers: dict, span,
+        deadline_abs: float | None, excluded: set[str],
+    ) -> str:
+        """One disaggregated attempt: prefill leg → KV ship →
+        continuation on the decode backend.  Returns "done" /
+        "client_gone" (request over either way) or "fallback" (the
+        ordinary splice loop finishes the request; any tokens the
+        prefill leg emitted are already recorded).  Every failure
+        releases what it reserved — held KV, staged imports, picked
+        backends — so a ship that dies at any step leaks nothing."""
+        backend = self._pick(pool="prefill")
+        if backend is None:
+            return "fallback"
+        hdrs = self._leg_headers(headers, deadline_abs)
+        if hdrs is None:
+            # Counted like every other abandonment: the outcome
+            # counters must sum to the disaggregation attempts, or the
+            # fell_back-vs-shipped triage query reads healthy while
+            # work is being thrown away.
+            self._release(backend, ok=True)
+            self._disagg_fallback("deadline exhausted before prefill leg")
+            return "fallback"
+        span.attrs["backend"] = backend.id
+        req = urllib.request.Request(
+            backend.url + "/v1/generate",
+            data=splice.prefill_body(self.disagg_first_tokens),
+            headers=hdrs,
+        )
+        try:
+            resp = self._opener.open(req, timeout=self.request_timeout)
+        except urllib.error.HTTPError as exc:
+            # The prefill backend answered an error (shed, draining):
+            # serve the whole request mixed instead of passing the
+            # error through — disaggregation is an optimization, never
+            # a new failure mode.
+            self._release(backend, ok=False)
+            self._requests.inc(backend.id, f"http_{exc.code}")
+            self._disagg_fallback(
+                f"prefill refused (HTTP {exc.code})", prefill=backend.id
+            )
+            return "fallback"
+        except (urllib.error.URLError, OSError) as exc:
+            self._release(backend, ok=False)
+            self._connection_failed(backend)
+            self._requests.inc(backend.id, "connect_error")
+            excluded.add(backend.id)
+            self._disagg_fallback(
+                f"prefill connect failed "
+                f"({getattr(exc, 'reason', exc)})",
+                prefill=backend.id,
+            )
+            return "fallback"
+        outcome = self._pipe_spliced(
+            handler, backend, resp, splice, capture_done=True
+        )
+        if outcome in ("done", "client_gone"):
+            # A terminal error line passed through, or our client left
+            # — the request is over without a ship either way.
+            return outcome
+        if outcome == "died":
+            excluded.add(backend.id)
+            self._disagg_fallback(
+                "prefill backend died mid-leg", prefill=backend.id
+            )
+            return "fallback"
+        # outcome == "captured": the prefill leg completed its clamped
+        # budget; its tokens are with the client AND in prior_tokens.
+        done = splice.captured_done or {}
+        rid = done.get("request_id")
+        final = splice.finished()
+        if final is not None:
+            # EOS/stop landed inside the first chunk: nothing left to
+            # decode — synthesize the terminal line; no ship happened.
+            if rid is not None:
+                release_kv(self._opener.open, backend.url, rid=rid)
+            with self._lock:
+                self._disagg["prefill_only"] += 1
+            metrics.SERVE_DISAGG.inc("prefill_only")
+            ok = self._write_client(handler, splice.final_line())
+            return "done" if ok else "client_gone"
+        if rid is None:
+            self._disagg_fallback(
+                "prefill leg carried no request_id", prefill=backend.id
+            )
+            return "fallback"
+        decode_b = self._pick(pool="decode")
+        if decode_b is None:
+            release_kv(self._opener.open, backend.url, rid=rid)
+            self._disagg_fallback(
+                "no healthy decode backend", prefill=backend.id
+            )
+            return "fallback"
+        t0 = time.monotonic()
+        try:
+            import_id, rows, nbytes = ship_kv(
+                self._opener.open, backend.url, rid, decode_b.url,
+                timeout=self.disagg_ship_timeout,
+            )
+        except Exception as exc:
+            self._release(decode_b, ok=False)
+            release_kv(self._opener.open, backend.url, rid=rid)
+            self._disagg_fallback(
+                f"ship failed ({type(exc).__name__}: {exc})",
+                prefill=backend.id, decode=decode_b.id,
+            )
+            return "fallback"
+        dt = time.monotonic() - t0
+        # The decode side owns its copy now: release the prefill hold
+        # at ship cadence instead of leaving it to the TTL sweep.
+        release_kv(self._opener.open, backend.url, rid=rid)
+        metrics.SERVE_KV_SHIP_SECONDS.observe(dt)
+        metrics.SERVE_KV_SHIP_BYTES.inc(by=float(nbytes))
+        with self._lock:
+            self._disagg["ship_bytes"] += nbytes
+            self._disagg["ship_seconds"] += dt
+        events.emit(
+            "disagg.ship",
+            component="oim-route",
+            prefill=backend.id,
+            decode=decode_b.id,
+            bytes=nbytes,
+            rows=rows,
+            ms=round(dt * 1000.0, 1),
+        )
+        hdrs = self._leg_headers(headers, deadline_abs)
+        if hdrs is None:
+            self._release(decode_b, ok=True)
+            release_kv(
+                self._opener.open, decode_b.url, import_id=import_id
+            )
+            self._disagg_fallback(
+                "deadline exhausted after ship",
+                prefill=backend.id, decode=decode_b.id,
+            )
+            return "fallback"  # the loop answers the 504
+        span.attrs["backend"] = decode_b.id
+        req = urllib.request.Request(
+            decode_b.url + "/v1/generate",
+            data=splice.request_body({"kv_import": import_id}),
+            headers=hdrs,
+        )
+        try:
+            resp = self._opener.open(req, timeout=self.request_timeout)
+        except urllib.error.HTTPError as exc:
+            self._release(decode_b, ok=False)
+            self._requests.inc(decode_b.id, f"http_{exc.code}")
+            release_kv(
+                self._opener.open, decode_b.url, import_id=import_id
+            )
+            self._disagg_fallback(
+                f"continuation refused (HTTP {exc.code})",
+                prefill=backend.id, decode=decode_b.id,
+            )
+            return "fallback"
+        except (urllib.error.URLError, OSError) as exc:
+            self._release(decode_b, ok=False)
+            self._connection_failed(decode_b)
+            self._requests.inc(decode_b.id, "connect_error")
+            excluded.add(decode_b.id)
+            self._disagg_fallback(
+                f"continuation connect failed "
+                f"({getattr(exc, 'reason', exc)})",
+                prefill=backend.id, decode=decode_b.id,
+            )
+            return "fallback"
+        with self._lock:
+            self._disagg["shipped"] += 1
+        metrics.SERVE_DISAGG.inc("shipped")
+        span.attrs["disagg"] = "shipped"
+        outcome = self._pipe_spliced(handler, decode_b, resp, splice)
+        if outcome == "died":
+            # The ship itself succeeded; a decode-backend death
+            # mid-continuation is the ordinary splice failover's to
+            # finish (recompute on a surviving backend).
+            excluded.add(decode_b.id)
+            return "fallback"
+        return outcome
+
     @staticmethod
     def _write_client(handler, data: bytes) -> bool:
         """Best-effort write to our client; False when it left."""
@@ -807,14 +1135,20 @@ class Router:
             self._requests.inc(backend.id, "ok")
 
     def _pipe_spliced(
-        self, handler, backend, resp, splice: "_SpliceState"
+        self, handler, backend, resp, splice: "_SpliceState",
+        capture_done: bool = False,
     ) -> str:
         """Forward one backend's NDJSON generate stream line-by-line,
         recording emitted tokens so a mid-stream death can resume on
         another backend.  Returns "done" (terminal line delivered),
         "died" (EOF/socket error before a terminal line — the caller
         splices the remainder elsewhere; this attempt's tokens are
-        folded into ``splice``), or "client_gone".
+        folded into ``splice``), "client_gone", or — with
+        ``capture_done`` (the disaggregation prefill leg) —
+        "captured": the done line was SUPPRESSED from the client (the
+        stream continues on a decode backend), its tokens folded into
+        ``splice.prior_tokens`` and the object parked on
+        ``splice.captured_done`` for the ship.
 
         Only COMPLETE lines are forwarded: a mid-line death discards
         the partial line (never forwarded, so client framing survives)
@@ -852,7 +1186,8 @@ class Router:
                 while b"\n" in buf and outcome is None:
                     line, buf = buf.split(b"\n", 1)
                     outcome = self._splice_line(
-                        handler, splice, line, cur_tokens, cur_lps
+                        handler, splice, line, cur_tokens, cur_lps,
+                        capture_done=capture_done,
                     )
         if outcome == "died":
             splice.prior_tokens += cur_tokens
@@ -866,11 +1201,11 @@ class Router:
         else:
             self._release(backend, ok=True)
             self._requests.inc(backend.id, "ok")
-        return "done" if outcome == "done" else outcome
+        return outcome
 
     def _splice_line(
         self, handler, splice: "_SpliceState", line: bytes,
-        cur_tokens: list, cur_lps: list,
+        cur_tokens: list, cur_lps: list, capture_done: bool = False,
     ) -> str | None:
         """Handle ONE complete NDJSON line: record tokens, rewrite the
         terminal done line to span all attempts, forward.  Returns the
@@ -887,6 +1222,17 @@ class Router:
                 else "client_gone"
             )
         if obj.get("done"):
+            if capture_done:
+                # Disaggregation prefill leg: the stream is NOT over —
+                # park the done object (tokens + the request_id that
+                # addresses the held KV) and fold its tokens into the
+                # prior record the continuation extends.
+                splice.captured_done = obj
+                splice.prior_tokens += [
+                    int(t) for t in obj.get("tokens", ())
+                ]
+                splice.prior_lps += list(obj.get("logprobs") or ())
+                return "captured"
             obj["tokens"] = splice.prior_tokens + [
                 int(t) for t in obj.get("tokens", ())
             ]
@@ -969,6 +1315,7 @@ class Router:
             backend.pipeline_depth = int(
                 info.get("engine", {}).get("pipeline_depth", 0)
             )
+            backend.pool = str(info.get("pool") or "mixed")
             load = info.get("load")
             if isinstance(load, dict):
                 backend.load = load
@@ -1210,11 +1557,28 @@ class Router:
                         "from_registry": b.from_registry,
                         # 0 until the first /v1/info fetch succeeds.
                         "pipeline_depth": b.pipeline_depth,
+                        # Disaggregation pool role ("mixed" until the
+                        # first /v1/info fetch).
+                        "pool": b.pool,
                         # {} until the first probe-tick info fetch; then
                         # the backend's live load/<cn> snapshot.
                         "load": dict(b.load),
                     }
                     for b in self._backends.values()
+                },
+                # KV-ship outcomes (serve/disagg.py): shipped /
+                # fell_back / prefill_only counts plus the shipped
+                # bytes and wall seconds — the fleet's disaggregation
+                # health at a glance (doc/operations.md incident
+                # queries).
+                "disagg": {
+                    **{k: self._disagg[k] for k in (
+                        "shipped", "fell_back", "prefill_only",
+                        "ship_bytes",
+                    )},
+                    "ship_seconds": round(
+                        self._disagg["ship_seconds"], 4
+                    ),
                 },
             }
 

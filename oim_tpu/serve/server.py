@@ -70,6 +70,21 @@ API:
                     (checkpoint.load_params_from_peer) instead of
                     re-reading blob storage — bring-up bounded by
                     network, not checkpoint cold-start
+  GET  /v1/kv?rid=N  → streamed KV export for disaggregated
+                    prefill/decode (serve/disagg.py): a completed
+                    ``hold_kv`` request's paged-KV blocks as manifest
+                    + raw leaves (the /v1/weights framing).  404 when
+                    nothing is held for that rid, 409 on a dense
+                    (non-paged) engine — the router falls back to
+                    splice recompute on either.
+  PUT  /v1/kv        ← stage a shipped KV state for a continuation
+                    request's ``kv_import``: geometry-validated
+                    against this engine (409 on mismatch), block
+                    reservation all-or-nothing (429 + Retry-After on
+                    pool exhaustion — capacity backpressure).
+  DELETE /v1/kv?rid=N|import=N → release a KV hold / staged import
+                    (the router's post-ship cleanup; the TTL sweep is
+                    the backstop when the orchestrator died mid-ship)
   GET  /metrics      → Prometheus exposition (shared registry)
   GET  /debugz      → live flight-recorder event rings (common/events.py)
   GET  /debugz/requests → the recently-completed-request ring: one
@@ -112,6 +127,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu.common import metrics, tracing
+from oim_tpu.serve import disagg
 from oim_tpu.serve.httptls import check_serving_peer, peer_common_name
 from oim_tpu.serve.engine import (
     DeadlineExpiredError,
@@ -240,6 +256,7 @@ class ServeServer:
         watchdog_interval: float = 0.0,
         stall_multiplier: float = 8.0,
         stall_floor_s: float = 10.0,
+        pool: str = "mixed",
     ):
         """``ssl_context`` (from ``httptls.server_ssl_context``) wraps
         the listener in mTLS: clients must hold a deployment-CA cert or
@@ -252,7 +269,16 @@ class ServeServer:
         tokenizer-agnostic.  ``watchdog_interval`` > 0 runs a
         ``StallWatchdog`` beside the driver (oim-serve turns it on;
         embedders/tests opt in): a wedged device fails in-flight
-        requests fast and flips /healthz instead of stalling silently."""
+        requests fast and flips /healthz instead of stalling silently.
+        ``pool`` is this instance's disaggregation role
+        (prefill/decode/mixed, oim-serve --pool): surfaced in /v1/info
+        and the load/serve.<id> snapshot so the router partitions the
+        fleet (doc/serving.md "Disaggregated prefill/decode")."""
+        if pool not in disagg.POOLS:
+            raise ValueError(
+                f"pool must be one of {disagg.POOLS}, got {pool!r}"
+            )
+        self.pool = pool
         self.engine = engine
         self.tokenizer = tokenizer
         self.error: str | None = None  # set when the driver thread dies
@@ -363,15 +389,65 @@ class ServeServer:
                     info["tokenizer"] = (
                         outer.tokenizer.path if outer.tokenizer else None
                     )
+                    # ... and this instance's disaggregation pool role
+                    # (the router partitions the fleet on it).
+                    info["pool"] = outer.pool
                     # Live-load mirror of the load/<cn> registry key —
                     # the router refreshes this each probe tick and
                     # surfaces it in its own /v1/stats.
-                    info["load"] = outer.engine.load()
+                    info["load"] = outer.load_snapshot()
                     self._json(200, info)
                 elif self.path == "/v1/weights":
                     outer._stream_weights(self)
+                elif self.path.split("?", 1)[0] == "/v1/kv":
+                    outer._stream_kv(self)
                 else:
                     self._json(404, {"error": f"no such path {self.path}"})
+
+            def do_PUT(self):
+                # KV-ship ingest (serve/disagg.py): the decode side of
+                # disaggregated prefill/decode.  Stages host-side only
+                # (no device work on handler threads), so it runs even
+                # while the queue is deep — but not past a latched
+                # error (nothing will ever admit the continuation).
+                if not check_serving_peer(self):
+                    return
+                if self.path.split("?", 1)[0] != "/v1/kv":
+                    self._json(404, {"error": f"no such path {self.path}"})
+                    return
+                if outer.error is not None:
+                    self._json(
+                        503, {"error": outer.error}, self._retry_after()
+                    )
+                    return
+                outer._ingest_kv(self)
+
+            def do_DELETE(self):
+                # Release a KV hold (prefill side) or staged import
+                # (decode side) — the router's post-ship cleanup.
+                # Idempotent: unknown ids answer ok=false, never error
+                # (the TTL may have swept first).
+                if not check_serving_peer(self):
+                    return
+                path, _, query = self.path.partition("?")
+                if path != "/v1/kv":
+                    self._json(404, {"error": f"no such path {self.path}"})
+                    return
+                from urllib.parse import parse_qs
+
+                params = parse_qs(query)
+                if "rid" in params:
+                    ok = outer.engine.release_kv_hold(
+                        int(params["rid"][0])
+                    )
+                elif "import" in params:
+                    ok = outer.engine.release_kv_import(
+                        int(params["import"][0])
+                    )
+                else:
+                    self._json(400, {"error": "need ?rid= or ?import="})
+                    return
+                self._json(200, {"ok": bool(ok)})
 
             def _stream(self, req: GenRequest, span) -> None:
                 """NDJSON token stream: the engine's on_token callback
@@ -453,7 +529,13 @@ class ServeServer:
                     try:
                         tokens, lps = outer.engine.result_full(rid, timeout=30)
                         span.attrs["generated"] = len(tokens)
-                        final = {"done": True, "tokens": tokens}
+                        # request_id rides the done line so the router's
+                        # disaggregation path can address this request's
+                        # held KV (GET /v1/kv?rid=...) after the stream.
+                        final = {
+                            "done": True, "tokens": tokens,
+                            "request_id": rid,
+                        }
                         if decoder is not None:
                             tail = decoder.flush()
                             if tail:
@@ -917,6 +999,16 @@ class ServeServer:
                             body.get("frequency_penalty", 0.0)
                         ),
                         cache_prefix=bool(body.get("cache_prefix")),
+                        # Disaggregated prefill/decode (serve/disagg.py):
+                        # hold_kv marks a prefill leg (KV retained for
+                        # GET /v1/kv), kv_import a decode continuation
+                        # (resume from a staged PUT /v1/kv ingest).
+                        hold_kv=bool(body.get("hold_kv")),
+                        kv_import=(
+                            int(body["kv_import"])
+                            if body.get("kv_import") is not None
+                            else None
+                        ),
                         deadline=self._deadline(body),
                         # The engine parents its phase spans on the
                         # server span: one trace id from the router's
@@ -1094,6 +1186,88 @@ class ServeServer:
             # Peer gave up mid-fetch (its own retry re-pulls); nothing
             # here holds state worth cleaning up.
             return
+
+    def load_snapshot(self) -> dict:
+        """The ``load/serve.<id>`` value: the engine's live pressure
+        plus the server-level pool role (the engine is pool-agnostic
+        the way it is tokenizer-agnostic).  Published each heartbeat
+        by ServeRegistration and mirrored under /v1/info "load"."""
+        return dict(self.engine.load(), pool=self.pool)
+
+    def _stream_kv(self, handler) -> None:
+        """Stream one held request's KV state (``GET /v1/kv?rid=N``,
+        serve/disagg.py): the /v1/weights framing — 8-byte big-endian
+        manifest length, JSON manifest, raw leaves in manifest order —
+        applied to paged-KV blocks.  Refused 503 while the error latch
+        stands (the weights rule: no device reads against a wedged
+        chip), 404/409 when there is nothing eligible to export (the
+        router falls back to splice recompute)."""
+        import struct
+        from urllib.parse import parse_qs
+
+        import numpy as np
+
+        if self.error is not None:
+            handler._json(
+                503, {"error": f"KV export unavailable: {self.error}"}
+            )
+            return
+        params = parse_qs(handler.path.partition("?")[2])
+        try:
+            rid = int(params["rid"][0])
+        except (KeyError, ValueError):
+            handler._json(400, {"error": "need ?rid=<request id>"})
+            return
+        try:
+            manifest, arrays = self.engine.export_kv(rid)
+        except disagg.KvIneligibleError as exc:
+            code = 404 if "no held KV" in str(exc) else 409
+            handler._json(code, {"error": str(exc)})
+            return
+        manifest_bytes = json.dumps(
+            manifest, separators=(",", ":")
+        ).encode()
+        total = sum(int(a.nbytes) for a in arrays)
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/octet-stream")
+        handler.send_header(
+            "Content-Length", str(8 + len(manifest_bytes) + total)
+        )
+        handler.end_headers()
+        try:
+            handler.wfile.write(struct.pack(">Q", len(manifest_bytes)))
+            handler.wfile.write(manifest_bytes)
+            for arr in arrays:
+                # Zero-copy uint8 reinterpret view, the weights-stream
+                # discipline — KV for a long prompt is MBs per ship.
+                flat = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+                handler.wfile.write(flat.data)
+            handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            return  # the router's ship fallback owns recovery
+
+    def _ingest_kv(self, handler) -> None:
+        """Stage one shipped KV state (``PUT /v1/kv``): parse the
+        transfer, geometry-validate, reserve pool blocks — answering
+        409 on mismatch (never coerce) and 429 + Retry-After on block
+        exhaustion (capacity backpressure, the admission planner's
+        stance).  Replies {"import_id", "rows"} for the continuation's
+        ``kv_import`` field."""
+        try:
+            length = int(handler.headers.get("Content-Length", "0"))
+            body = handler.rfile.read(length)
+            manifest, data = disagg.unpack_transfer(body)
+            import_id, rows = self.engine.import_kv(manifest, data)
+        except disagg.KvCapacityError as exc:
+            handler._json(429, {"error": str(exc)}, handler._retry_after())
+            return
+        except (disagg.KvGeometryError, disagg.KvIneligibleError) as exc:
+            handler._json(409, {"error": str(exc)})
+            return
+        except (KeyError, TypeError, ValueError) as exc:
+            handler._json(400, {"error": str(exc)})
+            return
+        handler._json(200, {"import_id": import_id, "rows": rows})
 
     def _drive(self) -> None:
         while not self._stop.is_set():

@@ -201,6 +201,12 @@ class TestLoadSchema:
             "kv_blocks_free": 16,
             "kv_blocks_shared": 4,
             "kv_fragmentation": 0.25,
+            # Disaggregation fields (ISSUE 12): pool role + this
+            # backend's share of the fleet's KV-ship traffic.
+            "pool": "prefill",
+            "kv_exports": 5,
+            "kv_imports": 2,
+            "kv_ship_bytes": 4096,
             "token_rate": 41.5,
             "shed_queue_full": 1,
             "shed_deadline": 0,

@@ -500,3 +500,38 @@ def test_serve_fault_tolerance_instruments_render():
     assert 'oim_serve_failovers_total{outcome="gave_up"}' in text
     assert "# TYPE oim_serve_deadline_expired_total counter" in text
     assert "oim_serve_deadline_expired_total" in text
+
+
+def test_serve_disagg_instruments_render():
+    """The disaggregated prefill/decode instruments (ISSUE 12: ship
+    latency/bytes, request outcomes) are shared definitions in
+    oim_tpu/common/metrics.py and render in standard exposition text."""
+    before = {
+        "shipped": metrics.SERVE_DISAGG.value("shipped"),
+        "fell_back": metrics.SERVE_DISAGG.value("fell_back"),
+        "bytes": metrics.SERVE_KV_SHIP_BYTES.value(),
+        "ships": metrics.SERVE_KV_SHIP_SECONDS.count(),
+    }
+    metrics.SERVE_DISAGG.inc("shipped")
+    metrics.SERVE_DISAGG.inc("fell_back")
+    metrics.SERVE_KV_SHIP_BYTES.inc(by=4096.0)
+    metrics.SERVE_KV_SHIP_SECONDS.observe(0.05)
+    assert metrics.SERVE_DISAGG.value("shipped") == before["shipped"] + 1
+    assert (
+        metrics.SERVE_DISAGG.value("fell_back")
+        == before["fell_back"] + 1
+    )
+    assert (
+        metrics.SERVE_KV_SHIP_BYTES.value() == before["bytes"] + 4096.0
+    )
+    assert (
+        metrics.SERVE_KV_SHIP_SECONDS.count() == before["ships"] + 1
+    )
+    text = metrics.registry().render()
+    assert "# TYPE oim_serve_disagg_requests_total counter" in text
+    assert 'oim_serve_disagg_requests_total{outcome="shipped"}' in text
+    assert 'oim_serve_disagg_requests_total{outcome="fell_back"}' in text
+    assert "# TYPE oim_serve_kv_ship_bytes_total counter" in text
+    assert "# TYPE oim_serve_kv_ship_seconds histogram" in text
+    assert "oim_serve_kv_ship_seconds_bucket" in text
+    assert "oim_serve_kv_ship_seconds_count" in text

@@ -783,7 +783,13 @@ def test_http_streaming(setup):
             lines = [json.loads(ln) for ln in r.read().splitlines()]
         want = _oracle(params, cfg, tokens, 6)
         assert [ln["token"] for ln in lines[:-1]] == want
-        assert lines[-1] == {"done": True, "tokens": want}
+        # The done line carries the backend-local request id too (the
+        # router's disaggregation path addresses held KV with it).
+        assert lines[-1] == {
+            "done": True, "tokens": want,
+            "request_id": lines[-1]["request_id"],
+        }
+        assert isinstance(lines[-1]["request_id"], int)
     finally:
         server.stop()
 
@@ -1668,9 +1674,12 @@ def test_info_endpoint_and_engine_info(setup):
         # is the one part that may change between reads, so compare it
         # structurally rather than by value.
         load = body.pop("load")
-        assert set(load) == set(engine.load())
+        # The server adds the pool role to the engine's snapshot
+        # (load_snapshot — the load/<cn> value under disaggregation).
+        assert set(load) == set(engine.load()) | {"pool"}
         assert load["total_slots"] == 2
-        assert body == {**info, "tokenizer": None}
+        assert load["pool"] == "mixed"
+        assert body == {**info, "tokenizer": None, "pool": "mixed"}
     finally:
         server.stop()
 

@@ -1,0 +1,826 @@
+"""Disaggregated prefill/decode serving (ISSUE 12): KV ships must be
+invisible, fallbacks exact, pools independent.
+
+The load-bearing properties:
+
+- **Token-identical to a mixed backend.**  A long-prompt stream routed
+  prefill → KV-ship → decode emits exactly the tokens the same request
+  emits on one mixed backend — greedy, across pipeline depth {1, 2} —
+  because the shipped blocks are bit-identical to what the decode
+  backend would have computed (same checkpoint) and the continuation
+  resumes at the shipped frontier.
+- **Every failure falls back exactly.**  A dense prefill backend (the
+  dense-ineligible guard), a ship killed mid-body (chaos), a geometry
+  mismatch, ingest capacity exhaustion — all land in the router's
+  splice-recompute continuation (PR 6 contract): same tokens, prefill
+  paid again, and ZERO leaked blocks on either backend.
+- **One trace.**  The decode-side continuation parents its engine
+  spans on the original router trace (PR 9 contract):
+  prefill → ship → decode renders as one tree.
+- **Pools scale independently.**  Per-pool watermark policies move the
+  prefill and decode replica counts on their own pools' utilization in
+  the deterministic sim harness.
+
+Engines are shared per config (the test-serve compile-budget
+discipline); this file backs ``make test-serve-disagg`` (120 s cap).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import grpc
+import jax
+import numpy as np
+import pytest
+
+from helpers import FakeAbort, FakeServicerContext, wait_for
+from test_autoscale import FakeActuator, FakeClock, FakeLauncher
+
+from oim_tpu.autoscale import (
+    Autoscaler,
+    AutoscalePolicy,
+    FleetSnapshot,
+    decide_pools,
+    encode_load,
+    load_key,
+)
+from oim_tpu.common import metrics, tracing
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.registry import MemRegistryDB
+from oim_tpu.registry.registry import Registry
+from oim_tpu.serve import Engine, GenRequest, Router, ServeRegistration
+from oim_tpu.serve import disagg
+from oim_tpu.serve.server import ServeServer
+from oim_tpu.spec import oim_pb2
+
+pytestmark = pytest.mark.serve_disagg
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _paged_engine(setup, **kw):
+    cfg, params = setup
+    args = dict(n_slots=2, max_len=64, chunk=4, prompt_buckets=(16, 32),
+                kv_block=8)
+    args.update(kw)
+    return Engine(params, cfg, **args)
+
+
+@pytest.fixture(scope="module")
+def fleet(setup):
+    """One disaggregated fleet (prefill + decode pools, both paged) and
+    one mixed control backend on the same params — the exactness
+    oracle."""
+    servers = {
+        pool: ServeServer(_paged_engine(setup), pool=pool).start()
+        for pool in ("prefill", "decode", "mixed")
+    }
+    yield servers
+    for server in servers.values():
+        server.stop()
+
+
+def _url(server) -> str:
+    return f"http://{server.host}:{server.port}"
+
+
+def _router(*urls, **kw):
+    kw.setdefault("health_interval", 60.0)  # tests probe explicitly
+    kw.setdefault("disagg_prompt_tokens", 8)
+    router = Router(backends=urls, **kw).start()
+    for b in list(router._backends.values()):
+        router._probe(b)  # immediate pool/info fetch
+    return router
+
+
+def _prompt(seed: int, n: int) -> list[int]:
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG["vocab_size"], size=n).tolist()
+
+
+def _stream(base: str, payload: dict, headers=None):
+    """Stream one /v1/generate; returns (token lines, done object)."""
+    req = urllib.request.Request(
+        base + "/v1/generate",
+        json.dumps(payload).encode(),
+        {"Content-Type": "application/json", **(headers or {})},
+    )
+    tokens, done = [], None
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        for line in resp:
+            obj = json.loads(line)
+            assert "error" not in obj, obj
+            if obj.get("done"):
+                done = obj
+            elif "token" in obj:
+                tokens.append(obj["token"])
+    assert done is not None, "stream ended without a done line"
+    return tokens, done
+
+
+def _zero_blocks(server) -> bool:
+    return server.engine.stats()["kv_blocks_used"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Engine-level export/import units
+
+
+def test_export_import_roundtrip_token_identical(setup):
+    """The core exactness contract, API-level: hold → export → pack →
+    unpack → import → continuation equals the uninterrupted run."""
+    a, b, oracle_e = (
+        _paged_engine(setup), _paged_engine(setup), _paged_engine(setup)
+    )
+    prompt = _prompt(1, 20)
+    rid = oracle_e.submit(GenRequest(tokens=prompt, max_new_tokens=12))
+    oracle = oracle_e.run()[rid]
+
+    rid = a.submit(GenRequest(tokens=prompt, max_new_tokens=1,
+                              hold_kv=True))
+    first = a.run()[rid]
+    assert a.stats()["kv_holds"] == 1
+    manifest, arrays = a.export_kv(rid)
+    assert manifest["rows"] == len(prompt) + len(first) - 1
+    body = disagg.pack_transfer(manifest, arrays)
+    import_id, rows = b.import_kv(*disagg.unpack_transfer(body))
+    assert rows == manifest["rows"]
+
+    crid = b.submit(GenRequest(
+        tokens=prompt + first, max_new_tokens=12 - len(first),
+        kv_import=import_id,
+    ))
+    cont = b.run()[crid]
+    assert first + cont == oracle
+    # Zero leaks once the hold releases and the slots free.
+    assert a.release_kv_hold(rid)
+    assert a.stats()["kv_blocks_used"] == 0
+    assert b.stats()["kv_blocks_used"] == 0
+    assert a.stats()["kv_exports"] == 1
+    assert b.stats()["kv_imports"] == 1
+
+
+def test_export_import_roundtrip_kv_int8(setup):
+    """int8 KV ships its scale leaves too — quantized pools stay
+    token-identical across a ship."""
+    mk = lambda: _paged_engine(setup, kv_int8=True)  # noqa: E731
+    a, b, oracle_e = mk(), mk(), mk()
+    prompt = _prompt(2, 18)
+    rid = oracle_e.submit(GenRequest(tokens=prompt, max_new_tokens=10))
+    oracle = oracle_e.run()[rid]
+    rid = a.submit(GenRequest(tokens=prompt, max_new_tokens=1,
+                              hold_kv=True))
+    first = a.run()[rid]
+    manifest, arrays = a.export_kv(rid)
+    assert {l["name"] for l in manifest["leaves"]} == {
+        "k", "v", "k_scale", "v_scale"
+    }
+    import_id, _ = b.import_kv(
+        *disagg.unpack_transfer(disagg.pack_transfer(manifest, arrays))
+    )
+    crid = b.submit(GenRequest(
+        tokens=prompt + first, max_new_tokens=10 - len(first),
+        kv_import=import_id,
+    ))
+    assert first + b.run()[crid] == oracle
+
+
+def test_geometry_and_capacity_guards(setup):
+    """Heterogeneous ships refuse at the manifest; a full pool answers
+    capacity backpressure, never a partial import."""
+    a = _paged_engine(setup)
+    prompt = _prompt(3, 20)
+    rid = a.submit(GenRequest(tokens=prompt, max_new_tokens=1,
+                              hold_kv=True))
+    a.run()
+    manifest, arrays = a.export_kv(rid)
+    bad = dict(manifest, geometry=dict(manifest["geometry"],
+                                       block_size=16))
+    with pytest.raises(disagg.KvGeometryError, match="block_size"):
+        a.import_kv(bad, dict(zip(
+            [l["name"] for l in manifest["leaves"]], arrays
+        )))
+    # A geometry-PASSING manifest with a mis-typed or mis-shaped leaf
+    # must 409 at the ingest, never reach the driver thread's jitted
+    # write (where it would crash the backend and latch its error).
+    leaves = dict(zip([l["name"] for l in manifest["leaves"]], arrays))
+    with pytest.raises(disagg.KvGeometryError, match="leaf k"):
+        a.import_kv(manifest, dict(
+            leaves, k=leaves["k"].astype(np.float64)
+        ))
+    with pytest.raises(disagg.KvGeometryError, match="leaf v"):
+        a.import_kv(manifest, dict(leaves, v=leaves["v"][:, :, :4]))
+    # An unknown leaf dtype name is a malformed manifest (clean 4xx),
+    # not an escaping AttributeError from the dtype resolver.
+    with pytest.raises(disagg.KvGeometryError, match="dtype"):
+        bad_leaf = dict(manifest)
+        bad_leaf["leaves"] = [
+            dict(manifest["leaves"][0], dtype="float99")
+        ] + manifest["leaves"][1:]
+        disagg.unpack_transfer(
+            disagg.pack_transfer(bad_leaf, arrays)
+        )
+    tiny = _paged_engine(setup, kv_blocks=2)
+    with pytest.raises(disagg.KvCapacityError, match="fall back"):
+        tiny.import_kv(manifest, dict(zip(
+            [l["name"] for l in manifest["leaves"]], arrays
+        )))
+    # Dense-ineligible guard: no paged pool, no export/ingest.
+    cfg, params = setup
+    dense = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                   prompt_buckets=(16, 32))
+    with pytest.raises(disagg.KvIneligibleError):
+        dense.export_kv(0)
+    with pytest.raises(disagg.KvIneligibleError):
+        dense.import_kv(manifest, {})
+    a.release_kv_hold(rid)
+
+
+def test_hold_ttl_and_cap_release_blocks(setup, monkeypatch):
+    """Abandoned holds/imports return their blocks: the TTL sweep (a
+    ship whose orchestrator died) and the count cap (a flood of
+    prefill legs) both decref — zero leaks without any cleanup call."""
+    e = _paged_engine(setup, n_slots=1)
+    prompt = _prompt(4, 20)
+    rid = e.submit(GenRequest(tokens=prompt, max_new_tokens=1,
+                              hold_kv=True))
+    e.run()
+    assert e.stats()["kv_holds"] == 1
+    assert e.stats()["kv_blocks_used"] > 0
+    monkeypatch.setattr(
+        "oim_tpu.serve.engine.KV_HOLD_TTL_S", 0.0
+    )
+    with e._lock:
+        e._sweep_kv_holds_locked(time.monotonic())
+    st = e.stats()
+    assert st["kv_holds"] == 0 and st["kv_blocks_used"] == 0
+
+
+def test_expired_import_falls_back_to_recompute(setup):
+    """A continuation whose staged import vanished (TTL raced the
+    admission) re-prefills instead of failing — token-identical either
+    way."""
+    a, b = _paged_engine(setup), _paged_engine(setup)
+    prompt = _prompt(5, 20)
+    rid = a.submit(GenRequest(tokens=prompt, max_new_tokens=1,
+                              hold_kv=True))
+    first = a.run()[rid]
+    manifest, arrays = a.export_kv(rid)
+    import_id, _ = b.import_kv(
+        *disagg.unpack_transfer(disagg.pack_transfer(manifest, arrays))
+    )
+    oracle_rid = a.submit(GenRequest(tokens=prompt, max_new_tokens=11))
+    oracle = a.run()[oracle_rid]
+    assert b.release_kv_import(import_id)  # expire it out from under
+    crid = b.submit(GenRequest(
+        tokens=prompt + first, max_new_tokens=10, kv_import=import_id,
+    ))
+    cont = b.run()[crid]
+    assert first + cont == oracle
+    assert b.stats()["kv_blocks_used"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Routed end-to-end: prefill → ship → decode
+
+
+def test_disagg_routed_token_identical_depth_matrix(setup, fleet):
+    """THE acceptance matrix: a long-prompt stream through the
+    partitioned fleet equals the same request on the mixed backend,
+    at pipeline depth 1 and 2, with a real ship each time and zero
+    leaked blocks afterward."""
+    router = _router(_url(fleet["prefill"]), _url(fleet["decode"]))
+    try:
+        base = f"http://{router.host}:{router.port}"
+        for depth in (1, 2):
+            for server in fleet.values():
+                server.engine.set_pipeline_depth(depth)
+            payload = {
+                "tokens": _prompt(10 + depth, 20),
+                "max_new_tokens": 12, "stream": True,
+            }
+            mixed_toks, mixed_done = _stream(_url(fleet["mixed"]), payload)
+            toks, done = _stream(base, payload)
+            assert done["tokens"] == mixed_done["tokens"]
+            assert toks == mixed_toks
+        stats = router.stats()
+        assert stats["disagg"]["shipped"] == 2
+        assert stats["disagg"]["fell_back"] == 0
+        assert stats["disagg"]["ship_bytes"] > 0
+        assert stats["backends"][_url(fleet["prefill"])]["pool"] == (
+            "prefill"
+        )
+        assert wait_for(lambda: _zero_blocks(fleet["prefill"]))
+        assert wait_for(lambda: _zero_blocks(fleet["decode"]))
+        # Short prompts never disaggregate — and regular traffic avoids
+        # the prefill pool entirely (the decode backend serves it).
+        short = {"tokens": _prompt(30, 4), "max_new_tokens": 4,
+                 "stream": True}
+        _stream(base, short)
+        assert router.stats()["disagg"]["shipped"] == 2
+    finally:
+        router.stop()
+    for server in fleet.values():
+        server.engine.set_pipeline_depth(2)
+
+
+def test_disagg_logprobs_and_sampled_stream(setup, fleet):
+    """Logprobs ride the splice across the ship, and a sampled stream
+    completes through the disagg path (best-effort exactness, the
+    splice contract — asserted well-formed, not token-pinned)."""
+    router = _router(_url(fleet["prefill"]), _url(fleet["decode"]))
+    try:
+        base = f"http://{router.host}:{router.port}"
+        payload = {
+            "tokens": _prompt(40, 16), "max_new_tokens": 8,
+            "stream": True, "logprobs": True,
+        }
+        mixed_toks, mixed_done = _stream(_url(fleet["mixed"]), payload)
+        toks, done = _stream(base, payload)
+        assert done["tokens"] == mixed_done["tokens"]
+        assert len(done["logprobs"]) == len(done["tokens"])
+        sampled = {
+            "tokens": _prompt(41, 16), "max_new_tokens": 6,
+            "stream": True, "temperature": 0.9, "seed": 3,
+        }
+        toks, done = _stream(base, sampled)
+        assert len(done["tokens"]) == 6 and toks == done["tokens"]
+    finally:
+        router.stop()
+
+
+def test_dense_prefill_pool_falls_back_exactly(setup, fleet):
+    """The dense-ineligible guard end-to-end: a prefill-pool backend
+    without a paged cache cannot export — the ship 404s and the
+    request finishes via splice recompute, token-identical."""
+    cfg, params = setup
+    dense_prefill = ServeServer(
+        Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+               prompt_buckets=(16, 32)),
+        pool="prefill",
+    ).start()
+    router = _router(_url(dense_prefill), _url(fleet["decode"]))
+    try:
+        base = f"http://{router.host}:{router.port}"
+        payload = {"tokens": _prompt(50, 20), "max_new_tokens": 10,
+                   "stream": True}
+        _, mixed_done = _stream(_url(fleet["mixed"]), payload)
+        toks, done = _stream(base, payload)
+        assert done["tokens"] == mixed_done["tokens"]
+        assert toks == done["tokens"]
+        stats = router.stats()["disagg"]
+        assert stats["fell_back"] == 1 and stats["shipped"] == 0
+        assert wait_for(lambda: _zero_blocks(fleet["decode"]))
+    finally:
+        router.stop()
+        dense_prefill.stop()
+
+
+class _TruncatingKvProxy:
+    """Chaos: a transparent proxy in front of a prefill backend that
+    severs GET /v1/kv responses at half their declared length — the
+    killed-mid-ship signature (the FlakyHTTPBackend truncation rule
+    applied to the ship surface).  Everything else forwards verbatim,
+    so the prefill leg itself succeeds."""
+
+    def __init__(self, target_url: str):
+        self.target = target_url.rstrip("/")
+        self.kv_kills = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *args):
+                pass
+
+            def _forward(self, method, body=None):
+                req = urllib.request.Request(
+                    outer.target + self.path, data=body, method=method,
+                    headers={
+                        k: v for k, v in self.headers.items()
+                        if k.lower() not in ("host", "content-length")
+                    },
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        payload, status = resp.read(), resp.status
+                        ctype = resp.headers.get("Content-Type", "")
+                except urllib.error.HTTPError as exc:
+                    payload, status = exc.read(), exc.code
+                    ctype = exc.headers.get("Content-Type", "")
+                truncate = (
+                    method == "GET"
+                    and self.path.startswith("/v1/kv")
+                    and status == 200
+                )
+                self.send_response(status)
+                if ctype:
+                    self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                if truncate:
+                    outer.kv_kills += 1
+                    self.wfile.write(payload[: len(payload) // 2])
+                    self.wfile.flush()
+                    self.connection.close()  # mid-body FIN
+                    return
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._forward("GET")
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                self._forward("POST", self.rfile.read(length))
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                self._forward("PUT", self.rfile.read(length))
+
+            def do_DELETE(self):
+                self._forward("DELETE")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def test_ship_killed_midway_falls_back_zero_leaks(setup, fleet):
+    """Chaos kill mid-ship: the KV fetch dies at half its bytes — the
+    router detects the short read, falls back to splice recompute
+    (token-identical), and both backends end with zero leaked blocks
+    (the router releases the hold through the same proxy)."""
+    proxy = _TruncatingKvProxy(_url(fleet["prefill"]))
+    router = _router(proxy.url, _url(fleet["decode"]))
+    try:
+        base = f"http://{router.host}:{router.port}"
+        payload = {"tokens": _prompt(60, 20), "max_new_tokens": 10,
+                   "stream": True}
+        _, mixed_done = _stream(_url(fleet["mixed"]), payload)
+        _, done = _stream(base, payload)
+        assert done["tokens"] == mixed_done["tokens"]
+        assert proxy.kv_kills == 1
+        stats = router.stats()["disagg"]
+        assert stats["fell_back"] == 1 and stats["shipped"] == 0
+        assert wait_for(lambda: _zero_blocks(fleet["prefill"]))
+        assert wait_for(lambda: _zero_blocks(fleet["decode"]))
+    finally:
+        router.stop()
+        proxy.stop()
+
+
+def test_eos_in_first_chunk_completes_without_ship(setup, fleet):
+    """A prompt whose generation ends inside the prefill leg's clamped
+    budget synthesizes the final line locally — no ship, no decode
+    leg, hold released."""
+    router = _router(
+        _url(fleet["prefill"]), _url(fleet["decode"]),
+        disagg_first_tokens=2,
+    )
+    try:
+        base = f"http://{router.host}:{router.port}"
+        prompt = _prompt(70, 16)
+        # Find what the model emits first and stop exactly there (over
+        # HTTP: the server's driver thread owns the mixed engine).
+        _, probe = _stream(
+            _url(fleet["mixed"]),
+            {"tokens": prompt, "max_new_tokens": 1, "stream": True},
+        )
+        first = probe["tokens"]
+        payload = {
+            "tokens": prompt, "max_new_tokens": 10, "stream": True,
+            "stop_ids": [first[0]],
+        }
+        toks, done = _stream(base, payload)
+        assert done["tokens"] == first
+        stats = router.stats()["disagg"]
+        assert stats["prefill_only"] == 1 and stats["shipped"] == 0
+        assert wait_for(lambda: _zero_blocks(fleet["prefill"]))
+    finally:
+        router.stop()
+
+
+def test_one_trace_prefill_ship_decode(setup, fleet):
+    """Request-forensics continuity (PR 9 contract): the prefill leg's
+    AND the decode continuation's engine spans parent under the ONE
+    router trace — `oimctl trace` renders prefill → ship → decode as a
+    single tree."""
+    router = _router(_url(fleet["prefill"]), _url(fleet["decode"]))
+    try:
+        base = f"http://{router.host}:{router.port}"
+        trace_id = f"{0xD15A66:032x}"
+        header = {"traceparent": f"00-{trace_id}-ab12cd34ef56ab78-01"}
+        payload = {"tokens": _prompt(80, 20), "max_new_tokens": 10,
+                   "stream": True}
+        _stream(base, payload, headers=header)
+        assert router.stats()["disagg"]["shipped"] == 1
+
+        def spans():
+            return [
+                s for s in tracing.collector().spans()
+                if s.trace_id == trace_id
+            ]
+
+        assert wait_for(
+            lambda: len(
+                [s for s in spans() if s.name == "engine.request"]
+            ) >= 2,
+            timeout=10,
+        ), [(s.component, s.name) for s in spans()]
+        tree = spans()
+        route = [s for s in tree if s.name == "route/v1/generate"]
+        assert len(route) == 1
+        serve_spans = [s for s in tree if s.name == "serve.generate"]
+        # Prefill leg + decode continuation, both under the route span.
+        assert len(serve_spans) == 2
+        assert all(s.parent_id == route[0].span_id for s in serve_spans)
+        engine_spans = [s for s in tree if s.name == "engine.request"]
+        assert {s.parent_id for s in engine_spans} == {
+            s.span_id for s in serve_spans
+        }
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Pool-role surfaces
+
+
+def test_pool_surfaced_info_load_stats(setup, fleet):
+    """The pool role reaches every surface the router/autoscaler/
+    operator read: /v1/info, the load snapshot (with the KV-transfer
+    counters), and the router's /v1/stats."""
+    with urllib.request.urlopen(
+        _url(fleet["prefill"]) + "/v1/info", timeout=10
+    ) as resp:
+        info = json.loads(resp.read())
+    assert info["pool"] == "prefill"
+    assert info["load"]["pool"] == "prefill"
+    assert {"kv_exports", "kv_imports", "kv_ship_bytes"} <= set(
+        info["load"]
+    )
+    snap = fleet["decode"].load_snapshot()
+    assert snap["pool"] == "decode"
+    from oim_tpu.autoscale.load import decode_load
+
+    decoded = decode_load(encode_load(snap))
+    assert decoded["pool"] == "decode"
+    # Pre-disaggregation publishers decode to "mixed" (tolerant schema).
+    assert decode_load(encode_load({"queue_depth": 1}))["pool"] == "mixed"
+
+
+def test_pool_registry_key_published_and_authz(setup, fleet):
+    """Registration publishes the leased serve/<id>/pool key beside
+    the address; authz lets a serve CN write exactly its own."""
+    reg = Registry()
+    reg_srv = reg.start_server("tcp://127.0.0.1:0")
+    try:
+        addr = f"tcp://{reg_srv.addr().address}"
+        registration = ServeRegistration(
+            "dg-1", addr, _url(fleet["prefill"]), delay=60.0,
+            pool="prefill",
+        ).start()
+        try:
+            assert reg.db.lookup("serve/dg-1/address")
+            assert reg.db.lookup("serve/dg-1/pool") == "prefill"
+        finally:
+            registration.stop()
+        assert reg.db.lookup("serve/dg-1/pool") == ""  # withdrawn
+
+        def set_as(cn, path):
+            reg.SetValue(
+                oim_pb2.SetValueRequest(
+                    value=oim_pb2.Value(path=path, value="prefill")
+                ),
+                FakeServicerContext(cn),
+            )
+
+        set_as("serve.dg-1", "serve/dg-1/pool")
+        with pytest.raises(FakeAbort) as err:
+            set_as("serve.dg-1", "serve/dg-2/pool")
+        assert err.value.code == grpc.StatusCode.PERMISSION_DENIED
+    finally:
+        reg_srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Per-pool autoscaling
+
+
+def _pool_policy(**kw):
+    base = dict(
+        min_replicas=1, max_replicas=4, slots_per_replica=4,
+        high_watermark=0.8, low_watermark=0.3, max_step=1,
+        scale_out_cooldown_s=5.0, scale_in_cooldown_s=5.0,
+        eval_period_s=10.0,
+    )
+    base.update(kw)
+    return AutoscalePolicy(**base)
+
+
+def _set_pool_load(db, sid, pool, queue, active, total):
+    db.store(
+        load_key(f"serve.{sid}"),
+        encode_load({
+            "queue_depth": queue, "active_slots": active,
+            "total_slots": total, "pool": pool, "token_rate": 10.0,
+            "ts": time.time(),
+        }),
+    )
+
+
+class _PoolSim:
+    """The test_autoscale sim harness with per-pool policies."""
+
+    def __init__(self, policies: dict):
+        self.db = MemRegistryDB()
+        self.actuator = FakeActuator()
+        self.launcher = FakeLauncher(self.db)
+        self.clock = FakeClock()
+        self.autoscaler = Autoscaler(
+            self.db, None, self.actuator, self.launcher,
+            pool_policies=policies, clock=self.clock.monotonic,
+        ).start(run_loop=False)
+
+    def offer(self, busy_by_pool: dict) -> None:
+        for rid, placement in list(self.launcher.running.items()):
+            pool = placement.get("pool", "")
+            busy = busy_by_pool.get(pool, 0)
+            total = 4
+            _set_pool_load(
+                self.db, rid, pool,
+                queue=max(0, busy - total), active=min(busy, total),
+                total=total,
+            )
+
+    def tick(self, busy_by_pool=None):
+        if busy_by_pool is not None:
+            self.offer(busy_by_pool)
+        decisions = self.autoscaler.evaluate_once()
+        self.clock.advance(10.0)
+        return decisions
+
+    def pool_counts(self) -> dict:
+        counts: dict[str, int] = {}
+        for placement in self.launcher.running.values():
+            pool = placement.get("pool", "")
+            counts[pool] = counts.get(pool, 0) + 1
+        return counts
+
+    def close(self):
+        self.autoscaler.close()
+        self.db.close()
+
+
+def test_per_pool_autoscaler_scales_independently(setup):
+    """THE per-pool acceptance: prefill and decode replica counts move
+    on their own pools' utilization — heavy prefill traffic grows only
+    the prefill pool, a later decode surge grows only decode, and an
+    idle prefill pool drains back to its floor while decode holds."""
+    sim = _PoolSim({
+        "prefill": _pool_policy(),
+        "decode": _pool_policy(max_replicas=3),
+    })
+    try:
+        sim.tick()  # bootstrap both pools to min_replicas
+        assert sim.pool_counts() == {"prefill": 1, "decode": 1}
+        assert set(sim.launcher.running) == {
+            "asr-prefill-0", "asr-decode-0"
+        }
+        # Prefill-heavy hour: only the prefill pool grows.
+        for _ in range(6):
+            sim.tick({"prefill": 12, "decode": 1})
+        assert sim.pool_counts()["prefill"] == 4  # its own max
+        assert sim.pool_counts()["decode"] == 1
+        # Decode surge: decode grows to ITS max while prefill holds.
+        for _ in range(6):
+            sim.tick({"prefill": 12, "decode": 12})
+        assert sim.pool_counts() == {"prefill": 4, "decode": 3}
+        # Prefill idles: it drains toward min while decode stays busy.
+        for _ in range(10):
+            sim.tick({"prefill": 0, "decode": 12})
+        assert sim.pool_counts() == {"prefill": 1, "decode": 3}
+        # Replica records carry their pool durably.
+        stats = sim.autoscaler.stats()
+        assert all(
+            record["pool"] in ("prefill", "decode")
+            for record in stats["replicas"].values()
+        )
+    finally:
+        sim.close()
+
+
+def test_per_pool_replacement_restores_same_pool(setup):
+    """A killed decode replica is replaced INTO the decode pool —
+    replacement restores the partition, not just the count."""
+    sim = _PoolSim({
+        "prefill": _pool_policy(),
+        "decode": _pool_policy(),
+    })
+    try:
+        sim.tick()
+        assert sim.pool_counts() == {"prefill": 1, "decode": 1}
+        # Kill the decode replica (process death → discovery DELETE).
+        sim.launcher.running.pop("asr-decode-0")
+        sim.db.store("serve/asr-decode-0/address", "")
+        sim.tick()
+        assert sim.pool_counts() == {"prefill": 1, "decode": 1}
+        assert "asr-decode-0" in sim.launcher.launches[-1:]
+    finally:
+        sim.close()
+
+
+def test_subprocess_launcher_delivers_pool_flag(tmp_path):
+    """A pooled scale-out must launch a replica that REGISTERS in its
+    pool: SubprocessLauncher turns the placement's pool into --pool
+    (appended when the template doesn't claim it, substituted via
+    {pool} when it does), and keeps it out of the bootstrap JSON —
+    pool is a serving role, not a chip-binding field."""
+    from oim_tpu.autoscale import SubprocessLauncher
+
+    plain = SubprocessLauncher(
+        ["serve", "--serve-id", "{id}"], str(tmp_path)
+    )
+    assert plain._argv("asr-prefill-0", "prefill") == [
+        "serve", "--serve-id", "asr-prefill-0", "--pool", "prefill",
+    ]
+    assert plain._argv("asr-0", "") == ["serve", "--serve-id", "asr-0"]
+    templated = SubprocessLauncher(
+        ["serve", "--serve-id", "{id}", "--pool", "{pool}"],
+        str(tmp_path),
+    )
+    assert templated._argv("r", "decode") == [
+        "serve", "--serve-id", "r", "--pool", "decode",
+    ]
+    # A template that hardcodes --pool (per-pool launchers) is left
+    # alone; unpooled replicas substitute the mixed default.
+    hardcoded = SubprocessLauncher(
+        ["serve", "--pool", "prefill"], str(tmp_path)
+    )
+    assert hardcoded._argv("r", "decode") == [
+        "serve", "--pool", "prefill",
+    ]
+    assert templated._argv("r", "") == [
+        "serve", "--serve-id", "r", "--pool", "mixed",
+    ]
+
+
+def test_decide_pools_pure_helper():
+    policies = {
+        "prefill": _pool_policy(),
+        "decode": _pool_policy(),
+    }
+    decisions = decide_pools(policies, {
+        "prefill": FleetSnapshot(replicas=2, busy=8.0, capacity=8.0),
+        "decode": FleetSnapshot(replicas=2, busy=1.0, capacity=8.0),
+    })
+    assert decisions["prefill"].direction == "out"
+    assert decisions["decode"].direction == "in"
+    # A pool with no snapshot bootstraps.
+    decisions = decide_pools(policies, {})
+    assert all(d.direction == "out" for d in decisions.values())
+
+
+def test_disagg_metrics_counters_move(setup, fleet):
+    """The shared instruments move with a ship (exposition rendering
+    itself is asserted in test_metrics)."""
+    before = metrics.SERVE_DISAGG.value("shipped")
+    bytes_before = metrics.SERVE_KV_SHIP_BYTES.value()
+    router = _router(_url(fleet["prefill"]), _url(fleet["decode"]))
+    try:
+        base = f"http://{router.host}:{router.port}"
+        _stream(base, {"tokens": _prompt(90, 16), "max_new_tokens": 6,
+                       "stream": True})
+        assert metrics.SERVE_DISAGG.value("shipped") == before + 1
+        assert metrics.SERVE_KV_SHIP_BYTES.value() > bytes_before
+        assert metrics.SERVE_KV_SHIP_SECONDS.count() >= 1
+    finally:
+        router.stop()
