@@ -77,19 +77,26 @@ test-serve:
 
 # Paged KV cache (ISSUE 10): the paged-vs-dense token-identical
 # exactness matrix (greedy/sampled/spec-decode/draft-model/prefix-hit/
-# mid-stream admission x dense/MoE, pipeline depth 1 and 2), the block
-# allocator's refcount/CoW units, shared-block-immutability witnesses,
-# OOM-of-blocks backpressure, and the zero-leaked-blocks chaos cycles.
-# Nominal ~30s; the cap carries the box's 2-3x CPU-quota headroom.
-# Also runs the oimlint lock-discipline + resource-lifecycle passes
-# over the serve plane AND ops/ (the paged gather/scatter helpers) so
-# the allocator's lock ownership stays analyzer-clean.
+# mid-stream admission x dense/MoE, pipeline depth 1 and 2), the
+# flash-decode kernel exactness matrix (kernel == gather == dense
+# oracle across {fp, kv_int8, kv_int4} x depth, ISSUE 13) plus the
+# sentinel-clamp leak regressions, the block allocator's refcount/CoW
+# units, shared-block-immutability witnesses, OOM-of-blocks
+# backpressure, and the zero-leaked-blocks chaos cycles — and the
+# steady-state recompile guard (test_jit_guard.py), whose kernel rows
+# pin the warm kernel engine at zero compiles.  Nominal ~70s; the cap
+# carries the box's 2-3x CPU-quota headroom.  Also runs the oimlint
+# lock-discipline + resource-lifecycle + jaxvet passes over the serve
+# plane AND ops/ (paged gather/scatter + the pallas kernel) so the
+# allocator's lock ownership and the kernel entry points stay
+# analyzer-clean.
 test-serve-paged:
 	$(PYTHON) -m tools.oimlint \
 	  --passes lock-discipline,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
 	  --roots oim_tpu/serve,oim_tpu/ops
-	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
-	  tests/test_serve_paged.py -q -m "not slow" -p no:cacheprovider
+	timeout -k 10 210 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_serve_paged.py tests/test_jit_guard.py -q -m "not slow" \
+	  -p no:cacheprovider
 
 # Serve-plane fault tolerance (chaos marker): the splice-failover soak
 # (backend killed mid-stream at 20% over 40+ cycles, token-identical

@@ -1044,6 +1044,44 @@ def _serve_diagnostics(extras, on_tpu, cfg, params) -> None:
             engine.set_pipeline_depth(depth)
             return _engine_leg(engine)
 
+        def _ab_legs(eng_a, eng_b):
+            """Interleaved A/B over two warm engines: ``ab_pairs``
+            (A-leg, B-leg) pairs of the standard workload → (A tok/s
+            runs, B tok/s runs, mismatched-request count).  THE one
+            harness for every engine-vs-engine comparison below
+            (paged vs dense, kernel vs gather, kv4 kernel vs kv4
+            gather) — the mismatch accounting lives in one place."""
+            runs_a, runs_b, mismatch = [], [], 0
+            for _ in range(ab_pairs):
+                toks_a, tps_a = _engine_leg(eng_a)
+                toks_b, tps_b = _engine_leg(eng_b)
+                runs_a.append(tps_a)
+                runs_b.append(tps_b)
+                mismatch += sum(x != y for x, y in zip(toks_a, toks_b))
+            return runs_a, runs_b, mismatch
+
+        def _capacity_probe(cap_engine, n_cap_req=16):
+            """Seat one admission wave of ``n_cap_req`` 64-token
+            requests against ``cap_engine``'s block pool and return the
+            concurrent slot count; drains through backpressure and
+            asserts completion + zero leaked blocks.  Untimed — the
+            probe counts slots, not seconds."""
+            cap_rids = [
+                cap_engine.submit(GenRequest(
+                    tokens=[
+                        (3 * i + j) % cfg.vocab_size for j in range(64)
+                    ],
+                    max_new_tokens=8,
+                ))
+                for i in range(n_cap_req)
+            ]
+            cap_engine.step()  # one admission wave against the pool
+            seated = cap_engine.stats()["active_slots"]
+            cap_results = cap_engine.run()  # drain through backpressure
+            assert all(len(cap_results[r]) == 8 for r in cap_rids)
+            assert cap_engine.stats()["kv_blocks_used"] == 0  # no leaks
+            return seated
+
         # Exactness, checked on the real flagship model too: every
         # pipelined and serial leg must agree token-for-token (greedy)
         # — the serving-correctness contract the CPU test matrix pins
@@ -1147,16 +1185,9 @@ def _serve_diagnostics(extras, on_tpu, cfg, params) -> None:
             prompt_buckets=(128,), kv_block=64,
         )
         paged_engine.warmup()
-        paged_runs, dense_runs, paged_mismatch = [], [], 0
-        for _ in range(ab_pairs):
-            toks_pg, tps_pg = _engine_leg(paged_engine)
-            toks_dn, tps_dn = _engine_leg(engine)
-            paged_runs.append(tps_pg)
-            dense_runs.append(tps_dn)
-            paged_mismatch += sum(
-                a != b for a, b in zip(toks_pg, toks_dn)
-            )
-        del paged_engine
+        paged_runs, dense_runs, paged_mismatch = _ab_legs(
+            paged_engine, engine
+        )
         extras["serve_tok_per_s_paged"] = round(
             statistics.median(paged_runs)
         )
@@ -1170,6 +1201,81 @@ def _serve_diagnostics(extras, on_tpu, cfg, params) -> None:
             f"{extras['serve_tok_per_s_paged_dense_ctl']} "
             f"({ab_pairs} interleaved pair(s), {paged_mismatch} "
             f"mismatched requests)"
+        )
+
+        # Flash-decode kernel A/B (ISSUE 13): the paged engine again
+        # with attention reading K/V straight from the block pool
+        # (ops/paged_attention.py), interleaved against the still-warm
+        # GATHER engine at equal concurrency — the exact A/B the
+        # --paged-kernel flag switches.  The mismatch counter is the
+        # triage handle (doc/operations.md: nonzero → run the fleet
+        # with the kernel off).  On this CPU backend the kernel runs
+        # INTERPRETED, so these legs are a parity/correctness control
+        # only (the per-layer gather the kernel deletes is an HBM
+        # round-trip the CPU never pays; the win is the TPU rows when
+        # the device returns — same caveat as the pipeline A/B).
+        kernel_engine = Engine(
+            params, cfg, n_slots=8, max_len=512,
+            chunk=32 if on_tpu else 4,
+            prompt_buckets=(128,), kv_block=64, paged_kernel=True,
+        )
+        kernel_engine.warmup()
+        kernel_runs, gather_runs, kernel_mismatch = _ab_legs(
+            kernel_engine, paged_engine
+        )
+        del kernel_engine
+        del paged_engine
+        extras["serve_tok_per_s_paged_kernel"] = round(
+            statistics.median(kernel_runs)
+        )
+        extras["serve_tok_per_s_paged_kernel_gather_ctl"] = round(
+            statistics.median(gather_runs)
+        )
+        extras["serve_paged_kernel_mismatch_reqs"] = kernel_mismatch
+        log(
+            f"bench: paged flash-decode kernel "
+            f"{extras['serve_tok_per_s_paged_kernel']} tok/s median vs "
+            f"gather control "
+            f"{extras['serve_tok_per_s_paged_kernel_gather_ctl']} "
+            f"({ab_pairs} interleaved pair(s), {kernel_mismatch} "
+            f"mismatched requests; CPU legs are parity controls — the "
+            f"gather the kernel deletes is HBM traffic the CPU backend "
+            f"never pays)"
+        )
+
+        # kv4 rung (int4 KV, per-block scales fused into the kernel's
+        # operand read): kernel vs gather at the SAME quant — int4
+        # tokens legitimately differ from fp tokens, so the exactness
+        # bar is kernel == gather, never kv4 == fp.  Same CPU-parity
+        # caveat as above.
+        kv4_kwargs = dict(
+            n_slots=8, max_len=512, chunk=32 if on_tpu else 4,
+            prompt_buckets=(128,), kv_block=64, kv_int4=True,
+        )
+        kv4_kernel = Engine(params, cfg, paged_kernel=True, **kv4_kwargs)
+        kv4_kernel.warmup()
+        kv4_gather = Engine(params, cfg, paged_kernel=False, **kv4_kwargs)
+        kv4_gather.warmup()
+        kv4_runs, kv4_ctl_runs, kv4_mismatch = _ab_legs(
+            kv4_kernel, kv4_gather
+        )
+        kv4_row_bytes = kv4_kernel._kv_row_bytes
+        del kv4_kernel
+        del kv4_gather
+        extras["serve_tok_per_s_paged_kernel_kv4"] = round(
+            statistics.median(kv4_runs)
+        )
+        extras["serve_tok_per_s_paged_gather_kv4_ctl"] = round(
+            statistics.median(kv4_ctl_runs)
+        )
+        extras["serve_paged_kv4_mismatch_reqs"] = kv4_mismatch
+        log(
+            f"bench: kv4 kernel "
+            f"{extras['serve_tok_per_s_paged_kernel_kv4']} tok/s median "
+            f"vs kv4 gather control "
+            f"{extras['serve_tok_per_s_paged_gather_kv4_ctl']} "
+            f"({ab_pairs} interleaved pair(s), {kv4_mismatch} mismatched "
+            f"requests; CPU legs are parity controls)"
         )
 
         # The capacity lever: max concurrent slots at a FIXED
@@ -1186,26 +1292,43 @@ def _serve_diagnostics(extras, on_tpu, cfg, params) -> None:
             chunk=32 if on_tpu else 4, prompt_buckets=(128,),
             kv_block=64, kv_blocks=dense_equiv_slots * (512 // 64),
         )
-        cap_rids = [
-            cap_engine.submit(GenRequest(
-                tokens=[(3 * i + j) % cfg.vocab_size for j in range(64)],
-                max_new_tokens=8,
-            ))
-            for i in range(16)
-        ]
-        cap_engine.step()  # one admission wave against the block pool
-        extras["serve_kv_capacity_slots"] = (
-            cap_engine.stats()["active_slots"]
-        )
+        extras["serve_kv_capacity_slots"] = _capacity_probe(cap_engine)
         extras["serve_kv_capacity_slots_dense"] = dense_equiv_slots
-        cap_results = cap_engine.run()  # drain through backpressure
-        assert all(len(cap_results[r]) == 8 for r in cap_rids)
-        assert cap_engine.stats()["kv_blocks_used"] == 0  # zero leaks
         del cap_engine
         log(
             f"bench: paged capacity {extras['serve_kv_capacity_slots']} "
             f"concurrent slots vs {dense_equiv_slots} dense at the same "
             f"cache budget (4 x 512 rows)"
+        )
+
+        # The kv4 capacity row: same probe, but the budget is measured
+        # in BYTES and the pool runs int4 — a row costs
+        # head_dim/2 + 4 scale bytes per k/v vector vs head_dim x
+        # itemsize at full precision (doc/operations.md "kv4 capacity
+        # math"), so ONE dense slot's HBM holds a multi-slot kv4 pool.
+        # Untimed like the probe above: the row counts slots, not
+        # seconds (the tok/s story is the kernel A/B).
+        fp_itemsize = {"float32": 4, "bfloat16": 2, "float16": 2}.get(
+            cfg.dtype, 2
+        )
+        fp_row_bytes = (
+            2 * cfg.n_layers * cfg.kv_heads * cfg.head_dim * fp_itemsize
+        )
+        one_dense_slot_bytes = 512 * fp_row_bytes
+        kv4_blocks = max(1, one_dense_slot_bytes // (64 * kv4_row_bytes))
+        kv4_cap = Engine(
+            params, cfg, n_slots=16, max_len=512,
+            chunk=32 if on_tpu else 4, prompt_buckets=(128,),
+            kv_block=64, kv_blocks=int(kv4_blocks), kv_int4=True,
+        )
+        extras["serve_kv_capacity_slots_kv4"] = _capacity_probe(kv4_cap)
+        extras["serve_kv4_blocks_per_dense_slot"] = int(kv4_blocks)
+        del kv4_cap
+        log(
+            f"bench: kv4 capacity "
+            f"{extras['serve_kv_capacity_slots_kv4']} concurrent slots "
+            f"inside ONE dense slot's HBM (512 x {fp_row_bytes} B -> "
+            f"{kv4_blocks} int4 blocks at {kv4_row_bytes} B/row)"
         )
 
         if not on_tpu:

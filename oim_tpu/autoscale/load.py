@@ -41,6 +41,13 @@ _DEFAULTS: dict[str, Any] = {
     "kv_blocks_free": 0,
     "kv_blocks_shared": 0,
     "kv_fragmentation": 0.0,
+    # Fast-path discovery (ISSUE 13; False from publishers predating
+    # the fields): whether the backend decodes through the paged
+    # flash kernel, and whether its cache runs the kv4 quant rung —
+    # `oimctl top` and the router surface both so an operator can see
+    # which replicas run the fast path.
+    "paged_kernel": False,
+    "kv_int4": False,
     # Disaggregated prefill/decode (ISSUE 12; "mixed"/zeros from
     # pre-disaggregation publishers via the tolerant-decode defaults):
     # which POOL this backend serves, and its share of the fleet's

@@ -168,7 +168,7 @@ def _print_top(
     print(
         f"{'BACKEND':<28} {'HEALTHY':<8} {'POOL':<8} {'QUEUE':>6} "
         f"{'ACTIVE':>7} {'SLOTS':>6} {'TOK/S':>9} {'KV f/s/t':>12} "
-        f"{'SHIP e/i':>9} {'SHED q/d/b':>12} BROWNOUT"
+        f"{'PATH':>10} {'SHIP e/i':>9} {'SHED q/d/b':>12} BROWNOUT"
     )
     busy = capacity = 0.0
     for bid, healthy, load in rows:
@@ -188,6 +188,17 @@ def _print_top(
             f"{load.get('kv_fragmentation', 0.0):.0%}"
             if kv_total else "-"
         )
+        # Which decode path the replica runs (ISSUE 13): the paged
+        # flash kernel ("kernel", "+kv4" on the int4 rung) vs the
+        # gather control ("gather") — the fast-path visibility the
+        # kernel-mismatch triage in doc/operations.md keys on.  Dense
+        # engines have neither.
+        if kv_total:
+            path = "kernel" if load.get("paged_kernel") else "gather"
+            if load.get("kv_int4"):
+                path += "+kv4"
+        else:
+            path = "-"
         # KV-ship participation (disaggregated fleets): exports served
         # (prefill side) / ingests staged (decode side).
         ship = (
@@ -204,7 +215,7 @@ def _print_top(
             f"{bid[:28]:<28} {'yes' if healthy else 'NO':<8} "
             f"{str(load.get('pool') or 'mixed')[:8]:<8} {q:>6} "
             f"{a:>7} {s:>6} {load.get('token_rate', 0.0):>9.1f} "
-            f"{kv:>12} {ship:>9} {shed:>12} "
+            f"{kv:>12} {path:>10} {ship:>9} {shed:>12} "
             f"{'yes' if load.get('brownout') else '-'}"
         )
     util = busy / capacity if capacity else 0.0

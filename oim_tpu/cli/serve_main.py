@@ -207,10 +207,29 @@ def build_parser() -> argparse.ArgumentParser:
         "state - worth it at big vocab x many slots when no client "
         "penalizes",
     )
-    p.add_argument(
+    kvq = p.add_mutually_exclusive_group()
+    kvq.add_argument(
         "--kv-int8", action="store_true",
         help="int8-quantized KV cache (half the cache bandwidth decode "
         "pays; per-token/head scales)",
+    )
+    kvq.add_argument(
+        "--kv-int4", action="store_true",
+        help="int4-quantized KV cache (kv4: half int8's cache bytes "
+        "again, per-block scales fused into the paged flash-decode "
+        "kernel's operand read) — requires --kv-block; dense layouts "
+        "reject it because only the paged pool carries the block "
+        "scales (doc/serving.md 'Paged KV cache')",
+    )
+    p.add_argument(
+        "--paged-kernel", choices=("auto", "on", "off"), default="auto",
+        help="block-table-aware Pallas flash-decode kernel for paged "
+        "engines (reads K/V straight from the block pool — no dense "
+        "gather per layer per chunk): auto (default) = on when the "
+        "backend is a TPU, on = force (interpret mode off-TPU, the "
+        "exactness-matrix configuration), off = the gather path (the "
+        "A/B control; flip here if the paged-vs-dense mismatch counter "
+        "fires, doc/operations.md)",
     )
     p.add_argument(
         "--kv-block", type=int, default=0, metavar="T",
@@ -459,6 +478,7 @@ def make_engine(args):
         top_k=args.top_k,
         top_p=args.top_p,
         kv_int8=args.kv_int8,
+        kv_int4=args.kv_int4,
         prefix_cache_size=args.prefix_cache,
         mesh=serve_mesh,
         spec_decode=args.spec_decode,
@@ -473,6 +493,11 @@ def make_engine(args):
         request_ring=args.request_ring,
         kv_block=args.kv_block,
         kv_blocks=args.kv_blocks,
+        # auto = TPU-paged engines only (the Engine resolves the
+        # backend); on/off are the explicit A/B handles.
+        paged_kernel={"auto": None, "on": True, "off": False}[
+            args.paged_kernel
+        ],
     )
 
 

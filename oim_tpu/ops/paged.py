@@ -22,11 +22,34 @@ Static shapes throughout (XLA compiles one program regardless of which
 blocks a slot owns); allocation policy — refcounts, copy-on-write,
 prefix aliasing — is host-side bookkeeping in the engine, never traced.
 
-No Pallas kernel yet: on the XLA backends this targets, the gather
-materializes the same bytes attention was going to read anyway, and the
-engine keeps the dense path selectable for the regimes where the gather
-loses (ROADMAP pairs this layout with a flash-decode kernel over paged
-blocks as the follow-up).
+**The sentinel-clamp invariant.**  ``paged_view`` clamps sentinel table
+entries to the LAST POOL BLOCK (``n_blocks - 1``) — a gather index must
+be in range, and the last block is as good a donor as any.  The rows it
+produces are therefore whatever that block currently holds, very much
+including another live slot's KV after the block was freed and
+reallocated.  That is safe because of a contract every consumer of the
+view must uphold: **a sentinel entry only ever covers logical positions
+strictly past its row's frontier**, and the shared causal mask
+(``k_pos <= q_pos``) assigns those positions weight
+``exp(-1e30 - max) == 0`` exactly.  Two corollaries: (1) pool contents
+must stay FINITE — the mask zeroes the *weight*, but ``0 × NaN`` in the
+probs·V contraction would still poison the output, so nothing may ever
+write NaN/Inf into a pool block; (2) the engine must reset a freed
+slot's table row to the sentinel BEFORE the block can be handed to a
+new owner (``_release_slot_blocks_locked`` does), so an in-flight
+chunk's writes for the freed slot drop at the pool edge rather than
+landing in the new owner's data.  The flash-decode kernel
+(``ops/paged_attention.py``) upholds the same contract the symmetric
+way: a sentinel entry's block is never read at all — its grid step
+contributes exactly nothing to the online softmax.  Both halves are
+pinned by ``tests/test_serve_paged.py``'s freed-and-reallocated
+last-block regressions.
+
+Decode can skip the gather entirely: ``ops/paged_attention.py`` is the
+Pallas flash-decode kernel that reads K/V straight from the pool
+through the block table (``Engine(paged_kernel=True)``, auto-on for TPU
+paged engines) — the gather path below stays as the A/B control and
+the prefill path.
 """
 
 from __future__ import annotations
@@ -34,7 +57,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from oim_tpu.ops.quant import quantize_int8
+from oim_tpu.ops.quant import quantize_int4, quantize_int8
 
 
 def _flat_indices(tables, starts, t: int, block_size: int):
@@ -51,8 +74,10 @@ def paged_store(cache, scale, new, tables, starts):
     """Write ``new`` [B, t, KVH, hd] at logical positions ``starts``
     [B] .. ``starts + t - 1`` through ``tables`` [B, n_tables] into the
     one-layer pool ``cache`` [n_blocks, block_size, KVH, hd] —
-    quantizing when the cache is int8 (``scale`` [n_blocks, block_size,
-    KVH] is not None).  Rows whose table entry is the sentinel
+    quantizing when the cache is quantized (``scale`` [n_blocks,
+    block_size, KVH] is not None; the pool's dtype selects the scheme,
+    int8 or int4 — the kv4 rung stores half the payload bytes behind
+    the same scale plumbing).  Rows whose table entry is the sentinel
     ``n_blocks`` (padding admissions, freed slots) index past the pool
     and are dropped.  The paged counterpart of the engine's
     ``_slot_store``."""
@@ -62,7 +87,8 @@ def paged_store(cache, scale, new, tables, starts):
     if scale is None:
         rows = rows.at[flat].set(new.astype(cache.dtype), mode="drop")
         return rows.reshape(cache.shape), None
-    q, s = quantize_int8(new)
+    quantize = quantize_int4 if cache.dtype == jnp.int4 else quantize_int8
+    q, s = quantize(new)
     rows = rows.at[flat].set(q, mode="drop")
     srows = scale.reshape(n_blocks * block_size, *scale.shape[2:])
     srows = srows.at[flat].set(s, mode="drop")
@@ -76,9 +102,11 @@ def paged_view(cache, scale, tables):
     None).  Logical position ``p`` of row ``b`` lands at view row
     ``p`` — the dense slot-region layout — so the engine's causal mask
     and score math apply verbatim.  Sentinel entries clamp to the last
-    pool block; the rows they produce are garbage PAST every row's
-    frontier, masked by the same ``k_pos <= q_pos`` test that masks
-    dense garbage."""
+    pool block; the rows they produce are whatever that block holds
+    NOW (possibly another slot's live, reallocated KV), which is safe
+    only under the sentinel-clamp invariant in the module docstring:
+    sentinel-covered positions lie strictly past the row's frontier,
+    so the causal mask gives them exactly zero weight."""
     n_blocks = cache.shape[0]
     b, n_tables = tables.shape
     idx = jnp.minimum(tables, n_blocks - 1)

@@ -1,0 +1,250 @@
+"""Paged flash-decode (pallas): attention straight off the block pool.
+
+The gather path (``ops/paged.py``) pays an extra HBM round-trip per
+layer per decode chunk: ``paged_view`` materializes every slot's blocks
+into a dense [B, max_len, KVH, hd] region that the shared attention
+code then reads AGAIN.  Decode is cache-bandwidth-bound
+(doc/compute.md), so on the TPU that doubles the dominant cost of the
+step.  This kernel is the vLLM-PagedAttention move fused with the
+FlashAttention online-softmax tiling already proven by the
+training-side kernel (``ops/flash_attention.py``, 6.3x vs unfused per
+BENCH_LAST_GOOD): the grid tiles over **(slot, kv-head, block)** and
+each step DMAs ONE pool block into VMEM through the per-slot block
+table — K/V bytes cross HBM exactly once, there is no dense
+intermediate, and the [max_len] score row never exists in memory.
+
+Contract (mirrors the gather path bit-for-bit where floating point
+allows, token-identically where it does not):
+
+- **Block table indirection in the index map.**  ``tables`` [B,
+  n_tables] rides scalar prefetch; the K/V BlockSpec index maps read
+  ``tables[b, j]`` to pick which pool block grid step (b, h, j) DMAs.
+  Sentinel entries (``n_blocks`` — padding admissions, freed slots)
+  contribute **nothing**: the whole compute body is predicated off, so
+  a freed-and-reallocated block is never read at all — the symmetric
+  (and strictly stronger) form of the gather path's sentinel-clamp
+  invariant (``ops/paged.py`` module docstring; the index map still
+  clamps to ``n_blocks - 1`` so the prefetched DMA address stays in
+  range, but the fetched bytes are dead).
+- **Online softmax across a slot's blocks.**  The innermost grid
+  dimension walks the table sequentially; running max / denominator /
+  accumulator live in VMEM scratch, exactly the forward flash kernel's
+  scheme.  The ``-1e30`` mask constant and the ``scores / sqrt(hd)``
+  scaling reproduce the gather path's arithmetic so the two paths are
+  token-identical across the serve exactness matrix
+  (tests/test_serve_paged.py pins kernel == gather == dense oracle).
+- **GQA head grouping.**  Grid rows are KV heads; the q operand is
+  pre-folded to [B, KVH, t·group, hd] so one block read serves every
+  query head in the group — same ratio of K/V traffic to q heads as
+  the training kernel's ``_kv_row_map``.
+- **Fused dequant at the operand read.**  int8 AND int4 pools
+  dequantize inside the kernel (``astype(f32) * scale``, the
+  ``_load_kv`` formula, scales gathered per block through the same
+  index map) — HBM traffic stays the quantized payload.  kv4 halves
+  int8's cache bytes again; it exists only on the paged layout because
+  only the pool carries the block-structured scale arrays this kernel
+  gathers (dense engines reject ``kv_int4`` at construction).
+- **Interpret off-TPU** (the ``_interpret()`` pattern), so the whole
+  exactness matrix runs in tier-1 on CPU; the HBM win is claimed by
+  the TPU bench rows (doc/operations.md "CPU-backend caveat").
+
+Decode-only by design: admission prefill keeps the gather (prefill is
+compute-bound — the dense intermediate it materializes is the bytes the
+MXU was going to stream anyway), which also keeps this kernel's q tile
+small ([t·group, hd], t = 1 or spec_decode+1).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# One definition of the lane tiling, mask constant, and off-TPU
+# interpret policy for BOTH flash kernels — a divergence here would be
+# a silent numerics split between training and serving attention.
+from oim_tpu.ops.flash_attention import _LANES, _NEG_BIG, _interpret, _lanes
+
+
+def supported_block_size(block_size: int, head_dim: int) -> bool:
+    """Whether the kernel's lane tiling covers this geometry: the
+    ``_lanes`` broadcast needs each of block_size and head_dim to be
+    ≤ 128 or a multiple of 128.  The engine checks this at
+    construction (a clear ValueError beats an AssertionError out of
+    the first decode trace); the gather path has no such constraint."""
+    return all(n <= _LANES or n % _LANES == 0 for n in (block_size, head_dim))
+
+
+def _decode_kernel(
+    tables_ref, starts_ref, q_ref, k_ref, v_ref, *rest,
+    block_size, n_blocks, group, window, quantized,
+):
+    """One grid step = one (slot b, kv-head h, table entry j): fold
+    pool block ``tables[b, j]`` into row b's online softmax.  Scratch
+    (m, l, acc) persists across j — the innermost, sequential grid
+    dimension — and the output block is written at the last j."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    b, j = pl.program_id(0), pl.program_id(2)
+    n_j = pl.num_programs(2)
+    hd = q_ref.shape[-1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Sentinel entries contribute NOTHING (the OOB-drop contract of
+    # paged_store, upheld on the read side): the block's bytes were
+    # DMA'd (clamped index — the prefetch address must be in range)
+    # but the compute never touches them, so a freed-and-reallocated
+    # block cannot leak into this row even transiently.
+    @pl.when(tables_ref[b, j] < n_blocks)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [t·G, hd]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)    # [bs, hd]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quantized:
+            # The _load_kv dequant formula (astype · scale), applied at
+            # the operand read — int8 and int4 payloads alike, so HBM
+            # carried only the quantized bytes.
+            k = k * ks_ref[0, :, 0][:, None]
+            v = v * vs_ref[0, :, 0][:, None]
+        scores = jax.lax.dot_general(  # q @ k.T on the MXU
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) / (hd ** 0.5)
+        # Causal per slot, identical position arithmetic to the gather
+        # path: query row r (= position index r // group within the
+        # chunk) sits at global position starts[b] + r // group; block
+        # j's columns are global positions j·bs .. j·bs + bs - 1.
+        q_pos = starts_ref[b] + (
+            jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) // group
+        )
+        k_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        )
+        keep = k_pos <= q_pos
+        if window:
+            keep &= q_pos - k_pos < window
+        scores = jnp.where(keep, scores, _NEG_BIG)
+        # Online softmax: an all-masked block transiently contributes
+        # exp(0) rows, annihilated exactly (alpha == 0.0) when the
+        # first real score arrives — the flash forward's property.
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_curr = jnp.max(scores, axis=1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_curr)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(scores - _lanes(m_next, scores.shape[1]))
+        l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[...] = m_next
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[...] = acc_scr[...] * _lanes(alpha, hd) + pv
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        # A row with NO valid block (all-sentinel table: an inactive
+        # slot) has l == 0: clamp and emit zeros — garbage the host
+        # never reads, like the gather path's uniform-garbage rows.
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0, 0] = acc_scr[...] / _lanes(l, hd)
+
+
+# oimlint: hotpath
+def paged_flash_decode(
+    q, k_pool, v_pool, k_scale, v_scale, tables, starts, *, window: int = 0
+):
+    """Attention for decode-sized q straight off the paged pool.
+
+    q: [B, t, H, hd] (t small: 1 plain, spec_decode+1 verify);
+    k_pool/v_pool: [n_blocks, block_size, KVH, hd] (fp, int8, or int4);
+    k_scale/v_scale: [n_blocks, block_size, KVH] f32 or None;
+    tables: [B, n_tables] int32, sentinel entry == n_blocks;
+    starts: [B] int32 — q row i of slot b sits at global position
+    ``starts[b] + i`` and attends rows ``<= that`` (minus ``window``).
+    Returns [B, t, H, hd] float32 — the gather path's pre-``wo``
+    attention output, position for position.
+
+    One compile covers every block-table content (tables/starts are
+    data, not trace constants); the caller keeps shapes static exactly
+    as it does for the gather.
+    """
+    b, t, h, hd = q.shape
+    n_blocks, block_size, kvh, _ = k_pool.shape
+    n_tables = tables.shape[1]
+    if h % kvh:
+        raise ValueError(f"n_heads {h} not divisible by kv_heads {kvh}")
+    if not supported_block_size(block_size, hd):
+        raise ValueError(
+            f"paged_flash_decode needs block_size and head_dim each "
+            f"<= {_LANES} or a multiple of {_LANES} (the lane-tiling "
+            f"constraint); got block_size={block_size}, head_dim={hd} "
+            f"— use the gather path (paged_kernel=False) for this "
+            f"geometry"
+        )
+    group = h // kvh
+    tg = t * group
+    quantized = k_scale is not None
+    # Fold GQA into the row axis: [B, t, KVH, G, hd] → [B, KVH, t·G, hd]
+    # so one (b, h) grid row reads its kv head's blocks once for every
+    # query head in the group.
+    qh = q.reshape(b, t, kvh, group, hd).transpose(0, 2, 1, 3, 4).reshape(
+        b, kvh, tg, hd
+    )
+
+    def kv_map(b_, h_, j_, tables_ref, starts_ref):
+        # The paged indirection lives HERE: entry j of slot b_ names
+        # the pool block this grid step DMAs.  Clamped so a sentinel
+        # still prefetches an in-range (dead) address; the kernel body
+        # predicates its compute off instead.
+        return (jnp.minimum(tables_ref[b_, j_], n_blocks - 1), 0, h_, 0)
+
+    def scale_map(b_, h_, j_, tables_ref, starts_ref):
+        return (jnp.minimum(tables_ref[b_, j_], n_blocks - 1), 0, h_)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, tg, hd), lambda b_, h_, j_, *_: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, block_size, 1, hd), kv_map),
+        pl.BlockSpec((1, block_size, 1, hd), kv_map),
+    ]
+    operands = [qh, k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, block_size, 1), scale_map),
+            pl.BlockSpec((1, block_size, 1), scale_map),
+        ]
+        operands += [k_scale, v_scale]
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel,
+            block_size=block_size, n_blocks=n_blocks, group=group,
+            window=window, quantized=quantized,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, kvh, n_tables),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, 1, tg, hd), lambda b_, h_, j_, *_: (b_, h_, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((tg, _LANES), jnp.float32),
+                pltpu.VMEM((tg, _LANES), jnp.float32),
+                pltpu.VMEM((tg, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kvh, tg, hd), jnp.float32),
+        interpret=_interpret(),
+    )(tables.astype(jnp.int32), starts.astype(jnp.int32), *operands)
+    return out.reshape(b, kvh, t, group, hd).transpose(
+        0, 2, 1, 3, 4
+    ).reshape(b, t, h, hd)

@@ -60,6 +60,24 @@ def dequantize_weight_int8(q, scale):
 _INT4_MAX = 7.0
 
 
+def quantize_int4(x):
+    """[..., d] float → (int4 values [..., d], f32 scales [...]) — the
+    KV-cache int4 scheme (``--kv-int4``): per-vector symmetric max-abs,
+    exactly ``quantize_int8`` with the int4 range.  Dequantize with
+    ``dequantize_int8`` (it only does ``astype(f32) * scale``, so the
+    payload dtype is free to be int4) — one dequant definition for the
+    whole KV quant ladder.  Paged-pool only: dense layouts reject int4
+    KV because only the block pool carries the per-block scale arrays
+    the fused kernel gathers (``ops/paged_attention.py``)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax / _INT4_MAX, _EPS)
+    q = jnp.clip(
+        jnp.round(xf / scale[..., None]), -_INT4_MAX, _INT4_MAX
+    ).astype(jnp.int4)
+    return q, scale
+
+
 def _int4_group(din: int, group: int) -> int:
     """Effective group size: the largest divisor of ``din`` ≤ the
     requested group (gcd), so any layer geometry quantizes — a d_ff not
@@ -188,17 +206,26 @@ def maybe_dequantize_weights(tree: dict, dtype=None) -> dict:
     }
 
 
-def make_kv_buffers(shape, compute_dtype, quantized: bool):
+def make_kv_buffers(shape, compute_dtype, quantized):
     """Zeroed (k, v, k_scale, v_scale) cache buffers for ``shape``
     [..., max_len, kv_heads, head_dim] — THE one definition of the
     quantized-cache layout, shared by the solo decode cache and the
     serving slot cache so the two can never diverge.
 
+    ``quantized`` is the KV quant mode: falsy = full precision, truthy
+    (``True``/``"int8"``) = int8, ``"int4"`` = int4 payloads (the kv4
+    rung of the ladder; same f32 per-(token, head) scale arrays — the
+    payload dtype alone selects the scheme everywhere downstream).
+
     Scales are distinct arrays (aliasing one buffer into both fields
     breaks jit donation: "donate the same buffer twice") and None when
     not quantized (an empty pytree — scan/tree.map pass it through).
     """
-    dt = jnp.int8 if quantized else compute_dtype
+    dt = (
+        jnp.int4 if quantized == "int4"
+        else jnp.int8 if quantized
+        else compute_dtype
+    )
     mk_scale = lambda: (  # noqa: E731
         jnp.ones(shape[:-1], jnp.float32) if quantized else None
     )

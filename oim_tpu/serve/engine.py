@@ -65,7 +65,11 @@ speculative decoding (``spec_decode`` — prompt-lookup drafting, or a
 trained draft model via ``draft_params``/``draft_cfg`` for workloads
 whose continuations are not in the prompt; exactness preserved either
 way), int8 KV (``kv_int8``) and weight-only int8
-params (both preserve the exactness invariant), Prometheus
+params (both preserve the exactness invariant), int4 KV (``kv_int4``,
+paged-only — per-block scale arrays ride the pool), the paged
+flash-decode kernel (``paged_kernel`` — decode attention reads K/V
+straight from the block pool, ``ops/paged_attention.py``; auto-on for
+TPU paged engines, token-identical to the gather path), Prometheus
 instrumentation, and ``warmup``/``abort``/``forget`` lifecycle
 discipline for daemon use.
 """
@@ -97,6 +101,7 @@ from oim_tpu.models.decode import (
     truncate_logits,
 )
 from oim_tpu.ops.paged import copy_block, paged_store, paged_view, write_block
+from oim_tpu.ops.paged_attention import paged_flash_decode
 from oim_tpu.serve.disagg import (
     KV_HOLD_MAX,
     KV_HOLD_TTL_S,
@@ -257,7 +262,8 @@ class PagedCache:
     ``lengths``: [n_slots] int32 — valid positions per slot, exactly
     the dense cache's frontier semantics.  ``k_scale``/``v_scale``:
     per-(token, head) f32 scales [n_layers, n_blocks, block_size,
-    kv_heads] when int8, else None.  Which pool blocks belong to which
+    kv_heads] when quantized (int8 or int4 payloads — the pool dtype
+    selects the scheme), else None.  Which pool blocks belong to which
     slot lives OUTSIDE this pytree: the engine's host-side
     ``BlockAllocator`` + block table, pushed to the device as a
     [n_slots, n_tables] int32 array each dispatch (sentinel entry
@@ -281,7 +287,7 @@ class PagedCache:
         n_slots: int,
         n_blocks: int,
         block_size: int,
-        quantized: bool = False,
+        quantized: bool | str = False,
     ) -> "PagedCache":
         shape = (
             cfg.n_layers, n_blocks, block_size, cfg.kv_heads, cfg.head_dim
@@ -428,7 +434,7 @@ def _slot_store(cache, scale, new, starts):
 
 def _slot_attention(
     x, lp, k_cache, v_cache, k_scale, v_scale, starts,
-    cfg: TransformerConfig, tables=None,
+    cfg: TransformerConfig, tables=None, paged_kernel: bool = False,
 ):
     """Cached attention with per-slot start positions.
 
@@ -447,6 +453,17 @@ def _slot_attention(
     softmax are shared code on either layout — the paged engine's
     token-identical-to-dense property is by construction, not by a
     parallel implementation.
+
+    ``paged_kernel`` (trace-time static, paged only) swaps the
+    gather-then-attend lower half for the Pallas flash-decode kernel
+    (``ops/paged_attention.py``): attention reads K/V straight from
+    the pool through the block table — no dense intermediate, one HBM
+    pass over the cache bytes, sentinel entries contributing nothing
+    and int8/int4 dequant fused at the operand read.  The engine
+    enables it on decode chunks only (prefill keeps the gather); the
+    store half and the qkv/rope/wo math above and below are shared
+    either way, so the kernel path's output is pinned token-identical
+    to the gather path's by tests/test_serve_paged.py.
     """
     b, t, _ = x.shape
     h, hd, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
@@ -477,6 +494,20 @@ def _slot_attention(
     else:
         k_cache, k_scale = paged_store(k_cache, k_scale, k, tables, starts)
         v_cache, v_scale = paged_store(v_cache, v_scale, v, tables, starts)
+        if paged_kernel:
+            # Flash-decode path: no gather, no dense view — the kernel
+            # walks the block table itself.  Output matches the shared
+            # math below position for position (pinned token-identical
+            # by the exactness matrix), so the wo projection and the
+            # residual are common code again immediately after.
+            out = paged_flash_decode(
+                q, k_cache, v_cache, k_scale, v_scale, tables, starts,
+                window=cfg.sliding_window,
+            ).astype(x.dtype)
+            out = out.reshape(b, t, h * hd)
+            return x + jnp.einsum(
+                "btn,nd->btd", out, lp["wo"]
+            ).astype(x.dtype), (k_cache, v_cache, k_scale, v_scale)
         k_view, ks_view = paged_view(k_cache, k_scale, tables)
         v_view, vs_view = paged_view(v_cache, v_scale, tables)
     max_len = k_view.shape[1]
@@ -510,7 +541,7 @@ def _slot_attention(
     )
 
 
-def _hidden_slots(params, tokens, kv, starts, cfg):
+def _hidden_slots(params, tokens, kv, starts, cfg, paged_kernel=False):
     """tokens [B, t] at per-slot positions ``starts`` → (final-norm
     hidden states [B, t, D], kv) — no unembedding, so prefill callers
     can unembed only the one position they sample from (the unembed is
@@ -522,7 +553,9 @@ def _hidden_slots(params, tokens, kv, starts, cfg):
     A FIVE-tuple (k, v, k_scale, v_scale, tables) is the paged layout:
     pools [n_layers, n_blocks, block_size, KVH, hd] plus the per-row
     block table [B, n_tables], threaded through the scan untouched —
-    ``_slot_attention`` scatters/gathers through it per layer.
+    ``_slot_attention`` scatters/gathers through it per layer
+    (``paged_kernel`` — trace-time static — flips that layer read to
+    the flash-decode kernel; ignored on the dense layout).
     MoE routing follows ``models/decode.py``: drop-free per-token top-k
     (``_moe_exact``) on prefill AND incremental steps — per-token routing
     is what makes engine results independent of padding, batch packing,
@@ -553,7 +586,7 @@ def _hidden_slots(params, tokens, kv, starts, cfg):
             x, lp, idx(k_all), idx(v_all),
             idx(ks_all) if quantized else None,
             idx(vs_all) if quantized else None,
-            starts, cfg, tables=tables,
+            starts, cfg, tables=tables, paged_kernel=paged_kernel,
         )
         k_all, v_all = put(k_all, k_l), put(v_all, v_l)
         if quantized:
@@ -762,7 +795,7 @@ def _inject_prefix(cache: SlotCache, entry, slot):
 def _decode_chunk(
     params, cache, tables, tok_counts, gen_counts, tokens, temps,
     top_ps, min_ps, reps, press, freqs, active, bases, counts,
-    *, cfg, chunk, top_k, penalize, max_len,
+    *, cfg, chunk, top_k, penalize, max_len, paged_kernel=False,
 ):
     """Advance every active slot by ``chunk`` tokens in one dispatch.
 
@@ -790,7 +823,10 @@ def _decode_chunk(
 
     def one(carry, i):
         kv, lengths, tok, tok_c, gen_c = carry
-        x, kv = _hidden_slots(params, tok[:, None], kv, lengths, cfg)
+        x, kv = _hidden_slots(
+            params, tok[:, None], kv, lengths, cfg,
+            paged_kernel=paged_kernel,
+        )
         logits = _unembed(x, dequantize_named(params, "wlm"), cfg)
         keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
         if penalize:
@@ -883,6 +919,7 @@ def _draft_lookup(hist, length, draft_len: int, ngram: int, max_len: int):
 def _verify_emit(
     params, kv, lengths, tok, drafts, temps, top_ps, min_ps, active,
     bases, counts, i, *, cfg, top_k, max_len, n_drafts,
+    paged_kernel=False,
 ):
     """The exactness-critical verify+emit core shared by BOTH drafting
     sources (prompt lookup and draft model): one (L+1)-position target
@@ -891,7 +928,9 @@ def _verify_emit(
     sampling keys, and the headroom-clamped length update.  Returns
     (kv, lengths, tok_next, emitted, lps, n_emit)."""
     inputs = jnp.concatenate([tok[:, None], drafts], axis=1)
-    x, kv = _hidden_slots(params, inputs, kv, lengths, cfg)
+    x, kv = _hidden_slots(
+        params, inputs, kv, lengths, cfg, paged_kernel=paged_kernel
+    )
     logits = _unembed(x, dequantize_named(params, "wlm"), cfg)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, L+1]
     accepted = jnp.sum(
@@ -933,7 +972,7 @@ def _verify_emit(
 def _decode_chunk_spec(
     params, cache, tables, history, tokens, temps, top_ps, min_ps,
     active, bases, counts,
-    *, cfg, chunk, draft_len, ngram, top_k, max_len,
+    *, cfg, chunk, draft_len, ngram, top_k, max_len, paged_kernel=False,
 ):
     """``_decode_chunk`` with in-engine speculative decoding: each of the
     ``chunk`` sub-steps drafts ``draft_len`` tokens per slot by prompt
@@ -981,7 +1020,7 @@ def _decode_chunk_spec(
         kv, lengths, tok_next, emitted, lps, n_emit = _verify_emit(
             params, kv, lengths, tok, drafts, temps, top_ps, min_ps,
             active, bases, counts, i, cfg=cfg, top_k=top_k,
-            max_len=max_len, n_drafts=n_drafts,
+            max_len=max_len, n_drafts=n_drafts, paged_kernel=paged_kernel,
         )
         return (kv, lengths, tok_next, hist), (emitted, lps, n_emit)
 
@@ -1040,7 +1079,7 @@ def _admit_draft(
 def _decode_chunk_spec_model(
     params, draft_params, cache, dcache: SlotCache, tables,
     tokens, temps, top_ps, min_ps, active, bases, counts,
-    *, cfg, dcfg, chunk, draft_len, top_k, max_len,
+    *, cfg, dcfg, chunk, draft_len, top_k, max_len, paged_kernel=False,
 ):
     """``_decode_chunk_spec`` with a TRAINED DRAFT MODEL instead of
     prompt lookup: each sub-step runs ``draft_len`` sequential greedy
@@ -1099,7 +1138,7 @@ def _decode_chunk_spec_model(
         kv, lengths, tok_next, emitted, lps, n_emit = _verify_emit(
             params, kv, lengths, tok, drafts, temps, top_ps, min_ps,
             active, bases, counts, i, cfg=cfg, top_k=top_k,
-            max_len=max_len, n_drafts=n_drafts,
+            max_len=max_len, n_drafts=n_drafts, paged_kernel=paged_kernel,
         )
         return (kv, dkv, lengths, tok_next), (emitted, lps, n_emit)
 
@@ -1364,6 +1403,7 @@ class Engine:
         top_k: int = 0,
         top_p: float = 1.0,
         kv_int8: bool = False,
+        kv_int4: bool = False,
         prefix_cache_size: int = 0,
         mesh=None,
         spec_decode: int = 0,
@@ -1380,6 +1420,7 @@ class Engine:
         request_ring: int = 256,
         kv_block: int = 0,
         kv_blocks: int = 0,
+        paged_kernel: bool | None = None,
     ):
         if pipeline_depth not in (1, 2):
             raise ValueError(
@@ -1430,6 +1471,48 @@ class Engine:
         elif kv_blocks:
             raise ValueError("kv_blocks needs kv_block > 0")
         self.kv_blocks = kv_blocks if self.paged else 0
+        # KV quant ladder: int8 everywhere, int4 (kv4) on the paged
+        # layout only — the fused-dequant kernel gathers per-block
+        # scale tiles straight from the pool, and the dense layout has
+        # no block-structured scale arrays to carry them (kv4's whole
+        # point is halving PAGED cache bytes again; a dense deployment
+        # wanting deeper quant should go paged first).
+        if kv_int8 and kv_int4:
+            raise ValueError("kv_int8 and kv_int4 are mutually exclusive")
+        if kv_int4 and not self.paged:
+            raise ValueError(
+                "kv_int4 needs the paged cache (kv_block > 0): only the "
+                "block pool carries the per-block scales the fused "
+                "dequant reads"
+            )
+        self.kv_quant = "int4" if kv_int4 else ("int8" if kv_int8 else "")
+        # Paged flash-decode kernel (ops/paged_attention.py): None =
+        # auto (on for TPU paged engines, where the gather's extra HBM
+        # round-trip per layer per chunk is the cost; CPU XLA gathers
+        # are cheap and interpret-mode pallas is not, so auto stays
+        # off there).  Explicit True runs the kernel anywhere —
+        # interpret mode off-TPU, which is how the exactness matrix
+        # executes in tier-1.  False = today's gather, the A/B control.
+        if paged_kernel and not self.paged:
+            raise ValueError("paged_kernel needs a paged cache (kv_block)")
+        self.paged_kernel = bool(self.paged) and (
+            paged_kernel if paged_kernel is not None
+            else jax.default_backend() == "tpu"
+        )
+        if self.paged_kernel:
+            from oim_tpu.ops.paged_attention import supported_block_size
+
+            # Fail at construction with the constraint named — not as
+            # an assertion out of the first decode trace on the driver
+            # thread (which would latch the server's error state).
+            if not supported_block_size(kv_block, cfg.head_dim):
+                raise ValueError(
+                    f"paged_kernel needs kv_block and head_dim each "
+                    f"<= 128 or a multiple of 128 (lane tiling); got "
+                    f"kv_block={kv_block}, head_dim={cfg.head_dim} — "
+                    f"run this geometry with the gather path "
+                    f"(paged_kernel=False / --paged-kernel off)"
+                )
         if spec_decode < 0 or (spec_decode and spec_ngram < 1):
             raise ValueError(
                 f"need spec_decode>=0 and spec_ngram>=1; got "
@@ -1561,6 +1644,7 @@ class Engine:
         self._pressure_since: float | None = None
         self.top_k = top_k
         self.kv_int8 = kv_int8
+        self.kv_int4 = kv_int4
         self.weight_quant = weight_quant_mode(params)
         self.weights_int8 = self.weight_quant == "int8"
         self.n_params = int(sum(
@@ -1571,7 +1655,8 @@ class Engine:
         self.max_len = max_len
         if self.paged:
             self._cache = PagedCache.create(
-                cfg, n_slots, self.kv_blocks, kv_block, quantized=kv_int8
+                cfg, n_slots, self.kv_blocks, kv_block,
+                quantized=self.kv_quant,
             )
             # Host-side paging state, all mutated under self._lock: the
             # refcounted allocator, the per-slot block table (sentinel
@@ -1593,11 +1678,16 @@ class Engine:
             self._ingest = jax.jit(_ingest_block, donate_argnums=(0,))
             # Bytes of one KV row (k + v + scales, all layers): the
             # unit the prefix-aliasing bytes-saved accounting counts.
-            itemsize = 1 if kv_int8 else jnp.dtype(
-                cfg.compute_dtype
-            ).itemsize
+            # Per-vector payload bits: 4 for kv4, 8 for int8, else the
+            # compute dtype's width; quantized rows add a 4-byte f32
+            # scale per (token, head).
+            if self.kv_quant:
+                payload_bits = 4 if kv_int4 else 8
+            else:
+                payload_bits = 8 * jnp.dtype(cfg.compute_dtype).itemsize
             self._kv_row_bytes = 2 * cfg.n_layers * cfg.kv_heads * (
-                cfg.head_dim * itemsize + (4 if kv_int8 else 0)
+                (cfg.head_dim * payload_bits) // 8
+                + (4 if self.kv_quant else 0)
             )
         else:
             self._cache = SlotCache.create(
@@ -1747,20 +1837,22 @@ class Engine:
             self._decode = jax.jit(
                 partial(_decode_chunk_spec_model, cfg=cfg, dcfg=draft_cfg,
                         chunk=chunk, draft_len=spec_decode, top_k=top_k,
-                        max_len=max_len),
+                        max_len=max_len, paged_kernel=self.paged_kernel),
                 donate_argnums=(2, 3),  # target + draft caches
             )
         elif spec_decode:
             self._decode = jax.jit(
                 partial(_decode_chunk_spec, cfg=cfg, chunk=chunk,
                         draft_len=spec_decode, ngram=spec_ngram,
-                        top_k=top_k, max_len=max_len),
+                        top_k=top_k, max_len=max_len,
+                        paged_kernel=self.paged_kernel),
                 donate_argnums=(1, 3),  # cache + history
             )
         else:
             self._decode = jax.jit(
                 partial(_decode_chunk, cfg=cfg, chunk=chunk, top_k=top_k,
-                        penalize=penalties, max_len=max_len),
+                        penalize=penalties, max_len=max_len,
+                        paged_kernel=self.paged_kernel),
                 donate_argnums=(1, 3, 4),  # cache + the penalty counts
             )
         self.spec_drafted = 0
@@ -2547,6 +2639,8 @@ class Engine:
                 "top_k": self.top_k,
                 "default_top_p": self.default_top_p,
                 "kv_int8": self.kv_int8,
+                "kv_int4": self.kv_int4,
+                "kv_quant": self.kv_quant,
                 "weights_int8": self.weights_int8,
                 "weight_quant": self.weight_quant,
                 "spec_decode": self.spec_decode,
@@ -2564,6 +2658,7 @@ class Engine:
                 "paged": self.paged,
                 "kv_block": self.kv_block,
                 "kv_blocks": self.kv_blocks,
+                "paged_kernel": self.paged_kernel,
                 "tp": self.mesh.shape.get("tp", 1) if self.mesh else 1,
                 "ep": self.mesh.shape.get("ep", 1) if self.mesh else 1,
             },
@@ -2604,6 +2699,11 @@ class Engine:
                 ),
                 "kv_fragmentation": self._kv_fragmentation_locked(),
                 "kv_admit_deferrals": self.kv_admit_deferrals,
+                # Which decode path and quant rung this engine runs
+                # (the A/B triage handles in doc/operations.md:
+                # mismatches → restart with the kernel off).
+                "paged_kernel": self.paged_kernel,
+                "kv_quant": self.kv_quant,
                 # Disaggregated-serving transfer state (serve/disagg.py;
                 # zeros on a dense engine).
                 "kv_holds": len(self._kv_holds),
@@ -2707,6 +2807,14 @@ class Engine:
                     self._alloc.shared_blocks if self.paged else 0
                 ),
                 "kv_fragmentation": self._kv_fragmentation_locked(),
+                # Fast-path discovery (ISSUE 13): whether this backend
+                # decodes through the paged flash kernel and whether
+                # its cache runs the kv4 rung — `oimctl top` and the
+                # router surface these so an operator can see which
+                # replicas run the fast path (and which to bounce when
+                # the mismatch counter says the kernel misbehaves).
+                "paged_kernel": self.paged_kernel,
+                "kv_int4": self.kv_int4,
                 # KV-transfer counters (serve/disagg.py): this
                 # backend's share of the fleet's ship traffic, for the
                 # router's /v1/stats and `oimctl top` pool columns.
@@ -3281,6 +3389,8 @@ class Engine:
         the rid with a TTL.  The frontier is ``tokens - 1`` rows — the
         last emitted token has no cache row yet, exactly the state a
         continuation prefill expects to extend."""
+        if self.kv_int4:
+            return  # kv4 pools don't ship: holding would pin for nothing
         tokens = list(state.req.tokens) + list(state.emitted)
         rows = len(tokens) - 1
         if rows < 1:
@@ -3377,6 +3487,12 @@ class Engine:
             raise KvIneligibleError(
                 "KV export needs a paged engine (oim-serve --kv-block)"
             )
+        if self.kv_int4:
+            # kv4 pools don't ship: int4 has no stable numpy wire dtype
+            # for the manifest framing, and a mixed-quant fleet would
+            # refuse the geometry anyway.  The router's recompute
+            # fallback covers the continuation, token-identically.
+            raise KvIneligibleError("KV export unsupported on kv_int4")
         with self._lock:
             self._sweep_kv_holds_locked(time.monotonic())
             hold = self._kv_holds.get(rid)
@@ -3441,6 +3557,8 @@ class Engine:
             raise KvIneligibleError(
                 "KV ingest needs a paged engine (oim-serve --kv-block)"
             )
+        if self.kv_int4:
+            raise KvIneligibleError("KV ingest unsupported on kv_int4")
         validate_geometry(manifest, self.kv_geometry())
         rows = int(manifest["rows"])
         tokens = [int(t) for t in manifest["prompt_tokens"]] + [
@@ -4714,13 +4832,14 @@ class Engine:
                 self._cache = self._cow(
                     self._cache, jnp.int32(0), jnp.int32(0)
                 )
-            if self.paged:
+            if self.paged and not self.kv_int4:
                 # Compile the KV-ship ingest write too (ONE program, dst
                 # traced): the first PUT /v1/kv continuation must not
                 # pay a mid-stream compile — the CoW-precompile rule
                 # applied to disaggregation.  Pool contents here are
                 # warmup dummies (cleared below), so zeroing block 0 is
-                # inert.
+                # inert.  kv4 engines skip it: their ships are refused
+                # at import/export, so the program never runs.
                 zk = jnp.zeros(
                     (self.cfg.n_layers, self.kv_block, self.cfg.kv_heads,
                      self.cfg.head_dim),

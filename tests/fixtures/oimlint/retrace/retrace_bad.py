@@ -39,3 +39,15 @@ def rebuilt_in_loop(batches):
 def rebuilt_on_hot_path(x):
     g = jax.jit(lambda v: v + 1)  # oimlint-expect: retrace-risk
     return g(x)
+
+
+def _kernel_body(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def kernel_rebuilt_in_loop(pl, batches):
+    out = []
+    for batch in batches:
+        f = pl.pallas_call(_kernel_body, out_shape=None)  # oimlint-expect: retrace-risk
+        out.append(f(batch))
+    return out

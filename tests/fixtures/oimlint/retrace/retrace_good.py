@@ -49,3 +49,24 @@ def waived_rebuild(shapes):
         # Each shape IS a different program here — a bench-style sweep.
         f = jax.jit(_stable)  # oimlint: disable=retrace-risk
         yield f, shape
+
+
+def _kernel_body(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+# oimlint: hotpath
+def kernel_wrapper(pl, x):
+    # The kernel-wrapper idiom (ops/paged_attention.py): the
+    # pallas_call is constructed per invocation, but this function only
+    # ever runs under an enclosing jit trace — construction is
+    # trace-time, cached by the outer program.  Hot-path marking does
+    # NOT flag it; only a python-loop rebuild does.
+    return pl.pallas_call(_kernel_body, out_shape=None)(x)
+
+
+def waived_kernel_sweep(pl, shapes):
+    for shape in shapes:
+        # A bench-style sweep where each shape is its own kernel.
+        f = pl.pallas_call(_kernel_body, out_shape=None)  # oimlint: disable=retrace-risk
+        yield f, shape

@@ -201,6 +201,10 @@ class TestLoadSchema:
             "kv_blocks_free": 16,
             "kv_blocks_shared": 4,
             "kv_fragmentation": 0.25,
+            # Fast-path discovery (ISSUE 13): flash-decode kernel +
+            # kv4 quant rung flags ride the same tolerant schema.
+            "paged_kernel": True,
+            "kv_int4": False,
             # Disaggregation fields (ISSUE 12): pool role + this
             # backend's share of the fleet's KV-ship traffic.
             "pool": "prefill",

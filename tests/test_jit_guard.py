@@ -89,7 +89,9 @@ def _prompt(seed: int, n: int, vocab: int) -> list[int]:
     return rng.randint(0, vocab, size=n).tolist()
 
 
-def _make_engine(setup, *, paged: bool, depth: int) -> Engine:
+def _make_engine(
+    setup, *, paged: bool, depth: int, kernel: bool = False
+) -> Engine:
     cfg, params = setup
     kwargs = dict(
         n_slots=3, max_len=64, chunk=4, prompt_buckets=(16, 32),
@@ -97,6 +99,8 @@ def _make_engine(setup, *, paged: bool, depth: int) -> Engine:
     )
     if paged:
         kwargs["kv_block"] = 8
+    if kernel:
+        kwargs["paged_kernel"] = True  # interpret-mode pallas on CPU
     return Engine(params, cfg, **kwargs)
 
 
@@ -130,21 +134,33 @@ def _steady_traffic(engine: Engine, vocab: int) -> dict:
 
 
 @pytest.mark.parametrize(
-    "paged,depth",
-    [(False, 1), (False, 2), (True, 1), (True, 2)],
-    ids=["dense-d1", "dense-d2", "paged-d1", "paged-d2"],
+    "paged,depth,kernel",
+    [
+        (False, 1, False), (False, 2, False),
+        (True, 1, False), (True, 2, False),
+        # The paged flash-decode kernel (ISSUE 13): the pallas call is
+        # traced into the decode programs, so a warm kernel engine
+        # must hold the same zero — an unwarmed kernel variant would
+        # be a 20-40s mid-stream stall on a live TPU.
+        (True, 1, True), (True, 2, True),
+    ],
+    ids=[
+        "dense-d1", "dense-d2", "paged-d1", "paged-d2",
+        "paged-kernel-d1", "paged-kernel-d2",
+    ],
 )
-def test_warm_engine_steady_state_compiles_zero(setup, paged, depth):
-    """THE pin: {dense, paged} x {depth 1, 2}, zero compiles after
-    warmup across decode chunks, a mid-stream admission, and a prefix
-    hit (CoW-triggering on paged)."""
-    engine = _make_engine(setup, paged=paged, depth=depth)
+def test_warm_engine_steady_state_compiles_zero(setup, paged, depth, kernel):
+    """THE pin: {dense, paged, paged+kernel} x {depth 1, 2}, zero
+    compiles after warmup across decode chunks, a mid-stream
+    admission, and a prefix hit (CoW-triggering on paged)."""
+    engine = _make_engine(setup, paged=paged, depth=depth, kernel=kernel)
     engine.warmup()
     with compile_delta() as d:
         _steady_traffic(engine, CFG["vocab_size"])
     assert d.count == 0, (
         f"steady state recompiled {d.count}x (paged={paged}, "
-        f"depth={depth}) — a live TPU pays 20-40s of dead air per event"
+        f"depth={depth}, kernel={kernel}) — a live TPU pays 20-40s of "
+        f"dead air per event"
     )
 
 

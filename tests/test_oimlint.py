@@ -294,6 +294,31 @@ class TestJitSiteResolver:
         retrace_found = runner.run_passes(tree, ["retrace-risk"])
         assert len(retrace_found) == 1 and "'n'" in retrace_found[0].message
 
+    def test_pallas_call_in_loop_flagged_wrapper_clean(self, tmp_path):
+        """A ``pl.pallas_call`` rebuilt per loop iteration is the
+        jit-in-loop failure shape (fresh wrapped kernel each pass);
+        the kernel-wrapper idiom — pallas_call inside a hot-path
+        function that only runs under an enclosing jit — is clean,
+        because construction there is trace-time and cached by the
+        outer program (ops/paged_attention.py)."""
+        (tmp_path / "m.py").write_text(
+            '"""tmp fixture."""\n'
+            "from jax.experimental import pallas as pl\n"
+            "def _body(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...]\n"
+            "def per_step(batches):\n"
+            "    for b in batches:\n"
+            "        f = pl.pallas_call(_body, out_shape=None)\n"
+            "        yield f(b)\n"
+            "# oimlint: hotpath\n"
+            "def wrapper(x):\n"
+            "    return pl.pallas_call(_body, out_shape=None)(x)\n"
+        )
+        tree = SourceTree(repo=str(tmp_path), roots=(".",))
+        found = runner.run_passes(tree, ["retrace-risk"])
+        assert len(found) == 1 and "pallas_call" in found[0].message
+        assert found[0].line == 7
+
     def test_dual_wrapping_checks_each_static_signature(self, tmp_path):
         """The same function wrapped twice — once with static_argnums,
         once without — must be body-checked under BOTH signatures: the

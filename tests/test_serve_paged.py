@@ -21,20 +21,45 @@ The load-bearing properties (ISSUE 10):
   at submit; abort/deadline-reap/cancel all return blocks; the chaos
   soak asserts zero leaked blocks every cycle.
 
+And the flash-decode kernel's (ISSUE 13):
+
+- **Kernel == gather == dense, token for token.**  The Pallas
+  flash-decode kernel (``ops/paged_attention.py``, interpret mode on
+  this CPU backend) replaces the per-layer dense gather on decode
+  chunks; its output is pinned token-identical across {greedy,
+  temp>0, spec-decode, prefix-cache hit with CoW, mid-stream
+  admission} × {fp, kv_int8, kv_int4} × pipeline depth {1, 2}.  The
+  oracle is the dense engine where one exists (fp, int8); kv4 exists
+  only on the paged layout, so its oracle is the gather path at the
+  same quant — kernel-vs-gather is exactly the A/B the serve flag
+  (``--paged-kernel``) switches.
+- **The sentinel-clamp contract, both ways.**  The gather clamps
+  sentinel table entries to the LAST pool block and relies on the
+  causal mask to zero whatever that block now holds — including
+  another slot's live KV after a free-and-reallocate.  The kernel
+  upholds the same contract by never reading a sentinel block at all.
+  Both regressions below watch a freed-then-reallocated last block
+  while a sentinel-holding slot keeps decoding.
+
 Engines are shared per model config (the test-serve compile-budget
-discipline); this file backs ``make test-serve-paged`` (120 s cap).
+discipline); this file backs ``make test-serve-paged`` (together with
+``tests/test_jit_guard.py``; ~70 s nominal, 210 s cap).
 """
 
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from oim_tpu.common import metrics as _metrics
 from oim_tpu.models import TransformerConfig, init_params
 from oim_tpu.models.decode import generate
+from oim_tpu.ops.paged import paged_view
+from oim_tpu.ops.paged_attention import paged_flash_decode
 from oim_tpu.serve import Engine, GenRequest
+from oim_tpu.serve.disagg import KvIneligibleError
 from oim_tpu.serve.engine import BlockAllocator, RequestFailedError
 
 CFG = dict(
@@ -70,6 +95,16 @@ def paged_engine(setup):
     return Engine(params, cfg, n_slots=3, max_len=64, chunk=4,
                   prompt_buckets=(16, 32), prefix_cache_size=2,
                   kv_block=8)
+
+
+@pytest.fixture(scope="module")
+def kernel_engine(setup):
+    cfg, params = setup
+    # The paged engine again, decoding through the flash-decode kernel
+    # (interpret mode on CPU — the exactness-matrix configuration).
+    return Engine(params, cfg, n_slots=3, max_len=64, chunk=4,
+                  prompt_buckets=(16, 32), prefix_cache_size=2,
+                  kv_block=8, paged_kernel=True)
 
 
 def _prompt(seed: int, n: int, vocab: int) -> list[int]:
@@ -290,6 +325,240 @@ def test_exactness_spec_draft_model(setup):
     assert paged.run()[rid] == reference == _oracle(
         params, cfg, req["tokens"], req["max_new_tokens"]
     )
+
+
+# ---------------------------------------------------------------------------
+# The flash-decode kernel exactness matrix (ISSUE 13): kernel == gather
+# == dense oracle across {greedy, temp>0, spec-decode, prefix-cache hit
+# with CoW, mid-stream admission} × {fp, kv_int8, kv_int4} × pipeline
+# depth {1, 2}.  (_matrix_workload carries the traffic shape: its
+# system prompt is 10 tokens against kv_block 8, so the prefix hit ends
+# mid-block and the paged planner takes the copy-on-write path.)
+
+
+def test_kernel_exactness_matrix_fp(setup, dense_engine, kernel_engine):
+    """Full-precision rung: the kernel engine's matrix output equals
+    the dense engine's at both pipeline depths, and the greedy rows
+    equal the solo oracle."""
+    cfg, params = setup
+    system = _prompt(200, 10, cfg.vocab_size)
+    dense_engine.set_pipeline_depth(1)
+    reference, shapes = _matrix_workload(
+        dense_engine, cfg.vocab_size, system
+    )
+    dense_engine.set_pipeline_depth(2)
+    for depth in (1, 2):
+        _clear_prefix(kernel_engine)
+        kernel_engine.set_pipeline_depth(depth)
+        hits_before = kernel_engine.stats()["prefix_hits"]
+        got, _ = _matrix_workload(kernel_engine, cfg.vocab_size, system)
+        assert got == reference, f"kernel depth {depth} diverged"
+        # The run really decoded through aliased + CoW'd blocks.
+        assert kernel_engine.stats()["prefix_hits"] > hits_before
+    kernel_engine.set_pipeline_depth(2)
+    tokens, max_new = shapes[0]
+    assert reference[0] == _oracle(params, cfg, tokens, max_new)
+
+
+def test_kernel_exactness_kv_int8(setup):
+    """int8 rung: kernel(kv_int8) == dense(kv_int8) — the scale pools
+    ride the kernel's fused dequant instead of the gathered view."""
+    cfg, params = setup
+    kwargs = dict(n_slots=3, max_len=64, chunk=4, prompt_buckets=(16, 32),
+                  kv_int8=True, prefix_cache_size=2)
+    dense = Engine(params, cfg, **kwargs)
+    kernel = Engine(params, cfg, kv_block=8, paged_kernel=True, **kwargs)
+    system = _prompt(210, 10, cfg.vocab_size)
+    reference, _ = _matrix_workload(dense, cfg.vocab_size, system)
+    for depth in (1, 2):
+        _clear_prefix(kernel)
+        kernel.set_pipeline_depth(depth)
+        got, _ = _matrix_workload(kernel, cfg.vocab_size, system)
+        assert got == reference, f"kernel int8 depth {depth} diverged"
+
+
+def test_kernel_exactness_kv_int4(setup):
+    """kv4 rung: kernel(kv_int4) == gather(kv_int4).  int4 KV exists
+    only on the paged layout (dense engines reject it — no block
+    scales), so the gather path at the same quant IS the oracle here:
+    exactly the A/B ``--paged-kernel on/off`` switches in production."""
+    cfg, params = setup
+    kwargs = dict(n_slots=3, max_len=64, chunk=4, prompt_buckets=(16, 32),
+                  kv_block=8, kv_int4=True, prefix_cache_size=2)
+    gather = Engine(params, cfg, paged_kernel=False, **kwargs)
+    kernel = Engine(params, cfg, paged_kernel=True, **kwargs)
+    system = _prompt(220, 10, cfg.vocab_size)
+    reference, _ = _matrix_workload(gather, cfg.vocab_size, system)
+    for depth in (1, 2):
+        _clear_prefix(kernel)
+        kernel.set_pipeline_depth(depth)
+        got, _ = _matrix_workload(kernel, cfg.vocab_size, system)
+        assert got == reference, f"kernel int4 depth {depth} diverged"
+    # The int4 pool really is int4 — the capacity math in
+    # doc/operations.md rests on the payload dtype.
+    assert kernel._cache.k.dtype == jnp.int4
+
+
+def test_kernel_exactness_spec_decode(setup):
+    """Speculative rung: the verify forward's multi-token q tile goes
+    through the kernel too (t = draft_len + 1 > 1)."""
+    cfg, params = setup
+
+    def workload(engine):
+        rids = [
+            engine.submit(GenRequest(
+                tokens=_echo_prompt(12, cfg.vocab_size), max_new_tokens=10,
+            )),
+            engine.submit(GenRequest(
+                tokens=_prompt(230, 9, cfg.vocab_size), max_new_tokens=7,
+                temperature=0.8, seed=11,
+            )),
+        ]
+        engine.step()
+        rids.append(engine.submit(GenRequest(
+            tokens=_echo_prompt(8, cfg.vocab_size), max_new_tokens=6,
+        )))
+        results = engine.run()
+        return [results[r] for r in rids]
+
+    dense = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                   prompt_buckets=(16,), spec_decode=2)
+    kernel = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                    prompt_buckets=(16,), spec_decode=2, kv_block=16,
+                    paged_kernel=True)
+    assert workload(kernel) == workload(dense)
+
+
+def test_kernel_ops_unit_matches_gather_and_ignores_sentinels(setup):
+    """Ops-level pin: paged_flash_decode over a hand-built pool equals
+    the gathered-view reference within fp tolerance, and scrambling a
+    block only sentinels reach changes NOTHING (bit-equal outputs) —
+    the zero-contribution half of the sentinel-clamp contract."""
+    rng = np.random.RandomState(3)
+    b, t, h, kvh, hd = 2, 1, 4, 2, 8
+    n_blocks, bs, n_tables = 6, 8, 4
+    q = jnp.asarray(rng.randn(b, t, h, hd).astype(np.float32))
+    k_pool = jnp.asarray(rng.randn(n_blocks, bs, kvh, hd).astype(np.float32))
+    v_pool = jnp.asarray(rng.randn(n_blocks, bs, kvh, hd).astype(np.float32))
+    # Row 0 owns 3 blocks; row 1 owns 1; the rest are sentinels.
+    tables = jnp.asarray(
+        [[0, 1, 2, n_blocks], [3, n_blocks, n_blocks, n_blocks]], jnp.int32
+    )
+    starts = jnp.asarray([20, 5], jnp.int32)
+    got = paged_flash_decode(
+        q, k_pool, v_pool, None, None, tables, starts
+    )
+
+    def reference(kp, vp):
+        k_view, _ = paged_view(kp, None, tables)
+        v_view, _ = paged_view(vp, None, tables)
+        group = h // kvh
+        q_g = q.reshape(b, t, kvh, group, hd)
+        scores = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", q_g.astype(jnp.float32),
+            k_view.astype(jnp.float32),
+        ) / (hd ** 0.5)
+        positions = starts[:, None] + jnp.arange(t)
+        keep = (
+            jnp.arange(k_view.shape[1])[None, None, None, None, :]
+            <= positions[:, None, None, :, None]
+        )
+        scores = jnp.where(keep, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum(
+            "bhgqk,bkhd->bqhgd", probs, v_view.astype(jnp.float32)
+        ).reshape(b, t, h, hd)
+
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(reference(k_pool, v_pool)),
+        rtol=1e-5, atol=1e-5,
+    )
+    # Scramble the LAST pool block — the one every sentinel entry
+    # clamps to on the gather side — plus an unreferenced block.
+    k2 = k_pool.at[n_blocks - 1].set(100.0).at[4].set(-50.0)
+    v2 = v_pool.at[n_blocks - 1].set(100.0).at[4].set(-50.0)
+    got2 = paged_flash_decode(q, k2, v2, None, None, tables, starts)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(got2))
+
+
+@pytest.mark.parametrize("use_kernel", [False, True],
+                         ids=["gather", "kernel"])
+def test_sentinel_reallocated_last_block_never_leaks(setup, use_kernel):
+    """THE sentinel-clamp hazard regression (ops/paged.py module
+    docstring): slot C decodes with sentinel table entries while the
+    LAST pool block — which every sentinel clamps to on the gather
+    path — is freed by a finished request and reallocated to a new
+    one that fills it with live KV.  C's masked region now gathers
+    another slot's real data; the causal mask must hide every byte of
+    it.  Run symmetrically through the gather (clamp + mask) and the
+    kernel (never reads the block at all)."""
+    cfg, params = setup
+    engine = Engine(params, cfg, n_slots=3, max_len=64, chunk=4,
+                    prompt_buckets=(16,), kv_block=8, kv_blocks=6,
+                    paged_kernel=use_kernel)
+    c_tokens = _prompt(240, 5, cfg.vocab_size)
+    a_tokens = _prompt(241, 5, cfg.vocab_size)
+    b_tokens = _prompt(242, 5, cfg.vocab_size)
+    rid_c = engine.submit(GenRequest(tokens=c_tokens, max_new_tokens=20))
+    rid_a = engine.submit(GenRequest(tokens=a_tokens, max_new_tokens=2))
+    engine.step()  # one wave admits both: C → [0..3], A → [4, 5]
+    with engine._lock:
+        slot_c, = [s for s, st in engine._slots.items() if st.rid == rid_c]
+        row_c = engine._tables_host[slot_c].copy()
+    assert (row_c[4:] == 6).all(), "C's table should end in sentinels"
+    for _ in range(20):  # drive until A completes and frees its blocks
+        with engine._lock:
+            if rid_a in engine._results:
+                break
+        engine.step()
+    # B reallocates A's freed blocks — the LAST pool block first (the
+    # allocator's free list is LIFO) — and fills them with its KV
+    # while C keeps decoding against its sentinel-padded table.
+    rid_b = engine.submit(GenRequest(tokens=b_tokens, max_new_tokens=2))
+    engine.step()
+    with engine._lock:
+        slot_b, = [s for s, st in engine._slots.items() if st.rid == rid_b]
+        row_b = engine._tables_host[slot_b]
+        assert 5 in row_b.tolist(), "B should hold the last pool block"
+    results = engine.run()
+    assert results[rid_c] == _oracle(params, cfg, c_tokens, 20)
+    assert results[rid_b] == _oracle(params, cfg, b_tokens, 2)
+
+
+def test_kv_int4_validation_and_ship_refusal(setup):
+    """kv4 is paged-only and never ships: dense layouts have no block
+    scales to carry it, and the manifest framing has no stable numpy
+    int4 wire dtype — export/import refuse (KvIneligibleError → the
+    router's recompute fallback), and holds are never taken."""
+    cfg, params = setup
+    with pytest.raises(ValueError, match="kv_int4 needs the paged"):
+        Engine(params, cfg, n_slots=1, max_len=64, kv_int4=True)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        Engine(params, cfg, n_slots=1, max_len=64, kv_block=8,
+               kv_int8=True, kv_int4=True)
+    with pytest.raises(ValueError, match="paged_kernel needs"):
+        Engine(params, cfg, n_slots=1, max_len=64, paged_kernel=True)
+    # A block size the kernel's lane tiling cannot cover (>128 and not
+    # a multiple of 128) must fail AT CONSTRUCTION with the constraint
+    # named — the gather path accepts the same geometry.
+    with pytest.raises(ValueError, match="lane tiling"):
+        Engine(params, cfg, n_slots=1, max_len=960, kv_block=192,
+               paged_kernel=True)
+    Engine(params, cfg, n_slots=1, max_len=960, kv_block=192)  # gather ok
+    engine = Engine(params, cfg, n_slots=1, max_len=64, chunk=4,
+                    prompt_buckets=(16,), kv_block=8, kv_int4=True)
+    rid = engine.submit(GenRequest(
+        tokens=_prompt(250, 5, cfg.vocab_size), max_new_tokens=2,
+        hold_kv=True,
+    ))
+    engine.run()
+    with pytest.raises(KvIneligibleError, match="kv_int4"):
+        engine.export_kv(rid)
+    with pytest.raises(KvIneligibleError, match="kv_int4"):
+        engine.import_kv({}, {})
+    # hold_kv was a no-op: nothing pinned once the request finished.
+    engine.result(rid, timeout=0)
+    assert engine.stats()["kv_blocks_used"] == 0
 
 
 # ---------------------------------------------------------------------------
@@ -595,7 +864,7 @@ def test_chaos_soak_zero_leaked_blocks(setup, paged_engine):
 
 
 def test_stats_info_load_surface_kv_occupancy(setup, paged_engine,
-                                              dense_engine):
+                                              dense_engine, kernel_engine):
     cfg, params = setup
     st = paged_engine.stats()
     assert st["kv_block_size"] == 8 and st["kv_blocks_total"] == 24
@@ -610,11 +879,22 @@ def test_stats_info_load_surface_kv_occupancy(setup, paged_engine,
     load = paged_engine.load()
     assert load["kv_blocks_total"] == 24
     assert {"kv_blocks_free", "kv_blocks_shared"} <= set(load)
+    # Fast-path flags (ISSUE 13) on all three surfaces: the gather
+    # engine reports the kernel off (CPU auto-resolution), the kernel
+    # engine on; kv quant rung rides beside them.
+    assert info["paged_kernel"] is False and info["kv_int4"] is False
+    assert st["paged_kernel"] is False and st["kv_quant"] == ""
+    assert load["paged_kernel"] is False and load["kv_int4"] is False
+    kinfo = kernel_engine.info()["engine"]
+    assert kinfo["paged_kernel"] is True
+    assert kernel_engine.stats()["paged_kernel"] is True
+    assert kernel_engine.load()["paged_kernel"] is True
     # Dense engines export the same schema, zeroed.
     dst = dense_engine.stats()
     assert dst["kv_block_size"] == 0 and dst["kv_blocks_total"] == 0
     assert dense_engine.info()["engine"]["paged"] is False
     assert dense_engine.load()["kv_blocks_total"] == 0
+    assert dense_engine.load()["paged_kernel"] is False
 
 
 def test_fragmentation_reflects_block_rounding(setup, paged_engine):

@@ -35,6 +35,10 @@ from tools.oimlint.core import SourceTree, dotted
 
 # Callee spellings that construct a jitted callable.
 _JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+# Pallas kernel invocations: each pl.pallas_call(...) constructs a
+# fresh wrapped callable (its own trace cache), exactly like jax.jit —
+# the retrace pass flags per-iteration construction.
+_PALLAS_NAMES = {"pl.pallas_call", "pallas_call", "pallas.pallas_call"}
 _PARTIAL_NAMES = {"partial", "functools.partial"}
 
 
@@ -140,6 +144,20 @@ def is_jit_call(node: ast.AST) -> bool:
     return (
         isinstance(node, ast.Call)
         and (dotted(node.func) or "") in _JIT_NAMES
+    )
+
+
+def is_pallas_call(node: ast.AST) -> bool:
+    """A ``pl.pallas_call(...)`` construction site.  Like ``jax.jit``,
+    each construction is a brand-new callable with its own trace
+    cache — safe at module level, inside ``__init__`` tables, or in a
+    function body that itself only runs under an enclosing jit trace
+    (the kernel-wrapper idiom: ``ops/flash_attention.py``,
+    ``ops/paged_attention.py``), but a per-iteration rebuild in a
+    python loop pays the lowering every pass."""
+    return (
+        isinstance(node, ast.Call)
+        and (dotted(node.func) or "") in _PALLAS_NAMES
     )
 
 

@@ -22,7 +22,12 @@ pays zero.  Three statically-visible ways code breaks that contract:
    ``for``/``while`` body or inside a hot-path function: each
    construction starts a brand-new trace cache, so the "cached" compile
    is paid every step.  Build-once tables (dict comprehensions in
-   ``__init__``) are exempt.
+   ``__init__``) are exempt.  ``pl.pallas_call(...)`` in a loop is the
+   same failure shape (every construction is a fresh wrapped kernel)
+   and is flagged too — but NOT in hot-path functions, because the
+   kernel-wrapper idiom (``ops/paged_attention.py``) constructs the
+   call inside a function that only runs under an enclosing jit, where
+   construction is trace-time and the outer program caches it.
 
 The static passes cannot see every retrace (shape-dependent
 recompiles, weak-type promotion); the runtime complement is the
@@ -195,18 +200,35 @@ def _check_jit_in_loops(
     for node in ast.walk(mod):
         if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
             for child in ast.walk(node):
-                if (
-                    child is not node
-                    and jaxsites.is_jit_call(child)
-                    and id(child) not in seen
-                ):
-                    seen.add(id(child))
-                    findings.append(Finding(
-                        PASS_ID, rel, child.lineno,
-                        "jax.jit(...) constructed inside a loop — each "
-                        "construction is a fresh trace cache, so the "
-                        "compile is paid every iteration (hoist it)",
-                    ))
+                if child is not node and id(child) not in seen:
+                    if jaxsites.is_jit_call(child):
+                        seen.add(id(child))
+                        findings.append(Finding(
+                            PASS_ID, rel, child.lineno,
+                            "jax.jit(...) constructed inside a loop — "
+                            "each construction is a fresh trace cache, "
+                            "so the compile is paid every iteration "
+                            "(hoist it)",
+                        ))
+                    elif jaxsites.is_pallas_call(child):
+                        # Same failure shape as jit-in-loop: every
+                        # pallas_call(...) is a new wrapped kernel with
+                        # its own trace cache.  NOT flagged in hot-path
+                        # functions below: the kernel-wrapper idiom
+                        # (flash_attention/paged_attention) constructs
+                        # the call inside a function that only ever
+                        # runs under an enclosing jit trace, where
+                        # construction is trace-time and cached by the
+                        # outer program.
+                        seen.add(id(child))
+                        findings.append(Finding(
+                            PASS_ID, rel, child.lineno,
+                            "pl.pallas_call(...) constructed inside a "
+                            "loop — each construction re-lowers the "
+                            "kernel, so the compile is paid every "
+                            "iteration (hoist it, or wrap the call in "
+                            "a jitted function)",
+                        ))
     hot = jaxsites.hotpath_functions(tree, rel, table)
     flagged = {f.line for f in findings}
     for name, fn in hot.items():
