@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-autoscale test-jit-guard lint lint-metrics lint-jax agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-autoscale test-jit-guard lint lint-metrics lint-jax agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -96,6 +96,26 @@ test-serve-paged:
 	  --roots oim_tpu/serve,oim_tpu/ops
 	timeout -k 10 210 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_serve_paged.py tests/test_jit_guard.py -q -m "not slow" \
+	  -p no:cacheprovider
+
+# Fleet prefix residency (ISSUE 14, serve_prefix marker): prefix
+# digest summaries (hotness cap, tolerant load decode), the
+# export/import prefix roundtrip exactness matrix {greedy, temp>0,
+# spec-decode} x {fp, kv_int8} x depth {1, 2} with kv4/dense/capacity
+# refusals, the chaos kill-mid-fetch zero-leak pins, residency-aware
+# vs -blind routing + the router-orchestrated sibling→target ship,
+# the --params-peer pre-warm leg (failure degrades to normal
+# bring-up), and the warm-engine zero-compile pin through a prefix
+# import.  Nominal ~45s; the cap carries the box's 2-3x CPU-quota
+# headroom.  Also runs the oimlint lock-discipline/resource-lifecycle/
+# jaxvet passes over the serve plane + ops so the new digest/install
+# state stays analyzer-clean, not grandfathered in baseline.
+test-serve-prefix:
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
+	  --roots oim_tpu/serve,oim_tpu/ops
+	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_serve_prefix.py -q -m "serve_prefix and not slow" \
 	  -p no:cacheprovider
 
 # Serve-plane fault tolerance (chaos marker): the splice-failover soak

@@ -497,6 +497,7 @@ def _run(tmp: str, agent_sock: str, cleanups: list, extras: dict) -> int:
         _decode_diagnostics(extras, on_tpu, cfg, batch, params)
         _serve_diagnostics(extras, on_tpu, cfg, params)
         _disagg_diagnostics(extras, on_tpu, cfg, params)
+        _prefix_residency_diagnostics(extras, on_tpu, cfg, params)
         _spec_model_diagnostics(extras, on_tpu)
     _flash_diagnostics(extras, on_tpu)
     # Last: it opens a SECOND PJRT client against the pool (the staged
@@ -1762,6 +1763,219 @@ def _disagg_legs(
         f"{extras['serve_disagg_tok_per_s_mixed_ctl']} tok/s "
         f"({ab_pairs} interleaved pair(s), {ships} ships/leg, "
         f"{mismatches} mismatched requests"
+        + ("" if on_tpu else "; CPU = parity control") + ")"
+    )
+
+
+def _prefix_residency_diagnostics(extras, on_tpu, cfg, params) -> None:
+    """Fleet prefix residency headline (ISSUE 14): fleet prefix-hit
+    rate and long-prompt TTFT under a Zipf-distributed system-prompt
+    workload on a 2-backend fleet, residency-aware routing (+ the
+    sibling→target prefix fetch) vs the residency-blind control
+    (rendezvous affinity only — the pre-ISSUE-14 router) — the
+    interleaved-median A/B discipline with a mismatch counter (greedy:
+    both configurations must agree token-for-token).  On the CPU
+    backend this is a PARITY CONTROL per the documented caveat
+    (doc/operations.md "CPU-backend caveat"): prefills run
+    synchronously and the fetch link is loopback, so the TTFT win
+    lands on the TPU rows — the CPU row's job is zero mismatches, a
+    live ship path, and the hit-rate delta (which IS meaningful: it
+    counts prefills never recomputed, not wall clock)."""
+    try:
+        from oim_tpu.serve import Engine
+        from oim_tpu.serve.server import ServeServer
+
+        n_requests = 12 if on_tpu else 8
+        new_tokens = 32 if on_tpu else 8
+        chunk = 32 if on_tpu else 4
+
+        def mk_server():
+            e = Engine(
+                params, cfg, n_slots=8, max_len=512, chunk=chunk,
+                prompt_buckets=(64, 256), kv_block=64,
+                prefix_cache_size=8,
+            )
+            e.warmup()
+            return ServeServer(e).start()
+
+        servers = [mk_server(), mk_server()]
+        try:
+            _prefix_residency_legs(
+                extras, on_tpu, cfg, n_requests, new_tokens, servers
+            )
+        finally:
+            for server in servers:
+                server.stop()
+    except Exception as exc:  # pragma: no cover - diagnostics only
+        log(f"bench: prefix residency diagnostics skipped: {exc}")
+
+
+def _prefix_residency_legs(
+    extras, on_tpu, cfg, n_requests, new_tokens, servers
+) -> None:
+    """The timed A/B body of `_prefix_residency_diagnostics` (split
+    out so server teardown rides ONE finally around it)."""
+    import concurrent.futures as _futures
+    import urllib.request
+
+    from oim_tpu.serve import Router
+
+    urls = [f"http://{s.host}:{s.port}" for s in servers]
+    # A handful of shared system prompts, Zipf-weighted (rank^-1): the
+    # millions-of-users shape — most traffic extends the head prompt.
+    sys_prompts = [
+        [(97 * k + j) % cfg.vocab_size for j in range(128)]
+        for k in range(4)
+    ]
+    weights = [1.0 / (k + 1) for k in range(len(sys_prompts))]
+    total_w = sum(weights)
+    picks = []
+    acc = 0.0
+    for i in range(n_requests):
+        # Deterministic low-discrepancy pick over the Zipf weights —
+        # both legs replay the identical request sequence.
+        x = ((i * 0.6180339887) % 1.0) * total_w
+        acc, k = 0.0, 0
+        for k, w in enumerate(weights):
+            acc += w
+            if x < acc:
+                break
+        picks.append(k)
+    prompts = [
+        sys_prompts[k] + [(31 * i + j) % cfg.vocab_size for j in range(8)]
+        for i, k in enumerate(picks)
+    ]
+
+    def one_stream(base, tokens, cache_prefix=False):
+        payload = {
+            "tokens": tokens, "max_new_tokens": new_tokens,
+            "stream": True,
+        }
+        if cache_prefix:
+            payload["cache_prefix"] = True
+        req = urllib.request.Request(
+            base + "/v1/generate",
+            json.dumps(payload).encode(),
+            {"Content-Type": "application/json"},
+        )
+        t0 = time.perf_counter()
+        ttft = None
+        out = []
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            for line in resp:
+                obj = json.loads(line)
+                assert "error" not in obj, obj
+                if obj.get("done"):
+                    out = obj["tokens"]
+                elif ttft is None:
+                    ttft = time.perf_counter() - t0
+        return ttft, out
+
+    def fleet_hits():
+        hits = misses = 0
+        for s in servers:
+            st = s.engine.stats()
+            hits += st["prefix_hits"]
+            misses += st["prefix_misses"]
+        return hits, misses
+
+    def reset_caches():
+        # Cold caches per leg: residency earned in one leg must not
+        # leak into the other's hit rate (the engines stay warm — only
+        # the prefix entries and their digests drop).
+        for s in servers:
+            with s.engine._lock:
+                s.engine._clear_prefix_cache_locked()
+
+    def leg(aware):
+        reset_caches()
+        h0, m0 = fleet_hits()
+        router = Router(
+            backends=tuple(urls),
+            health_interval=60.0,
+            residency_aware=aware,
+            prefix_fetch=aware,
+        ).start()
+        try:
+            for b in list(router._backends.values()):
+                router._probe(b)
+            base = f"http://{router.host}:{router.port}"
+            # Seed each system prompt once (cache_prefix) — the cohort
+            # head's injection, routed like any live request.
+            for sp in sys_prompts:
+                one_stream(base, sp, cache_prefix=True)
+            for b in list(router._backends.values()):
+                router._probe(b)  # residency map sees the seeds
+            t0 = time.perf_counter()
+            with _futures.ThreadPoolExecutor(max_workers=4) as pool:
+                results = [
+                    f.result() for f in [
+                        pool.submit(one_stream, base, p)
+                        for p in prompts
+                    ]
+                ]
+            dt = time.perf_counter() - t0
+            fetched = router.stats()["prefix"]["fetched"]
+        finally:
+            router.stop()
+        h1, m1 = fleet_hits()
+        hits, misses = h1 - h0, m1 - m0
+        rate = hits / (hits + misses) if hits + misses else 0.0
+        ttfts = sorted(t for t, _ in results if t is not None)
+        toks = [out for _, out in results]
+        tps = sum(len(t) for t in toks) / dt
+        return ttfts[len(ttfts) // 2], tps, rate, fetched, toks
+
+    ab_pairs = max(1, int(os.environ.get(
+        "OIM_BENCH_PREFIX_AB_PAIRS", "1" if on_tpu else "2"
+    )))
+    a_ttft, a_tps, a_rate, b_ttft, b_tps, b_rate = ([] for _ in range(6))
+    fetched_total = 0
+    mismatches = 0
+    ref_toks = None
+    for _ in range(ab_pairs):
+        ttft, tps, rate, fetched, toks = leg(aware=True)
+        a_ttft.append(ttft)
+        a_tps.append(tps)
+        a_rate.append(rate)
+        fetched_total += fetched
+        if ref_toks is None:
+            ref_toks = toks
+        mismatches += sum(x != y for x, y in zip(toks, ref_toks))
+        ttft, tps, rate, _, toks = leg(aware=False)
+        b_ttft.append(ttft)
+        b_tps.append(tps)
+        b_rate.append(rate)
+        mismatches += sum(x != y for x, y in zip(toks, ref_toks))
+    extras["serve_prefix_hit_rate_aware"] = round(
+        statistics.median(a_rate), 3
+    )
+    extras["serve_prefix_hit_rate_blind_ctl"] = round(
+        statistics.median(b_rate), 3
+    )
+    extras["serve_prefix_ttft_long_ms_aware"] = round(
+        statistics.median(a_ttft) * 1000, 1
+    )
+    extras["serve_prefix_ttft_long_ms_blind_ctl"] = round(
+        statistics.median(b_ttft) * 1000, 1
+    )
+    extras["serve_prefix_tok_per_s_aware"] = round(
+        statistics.median(a_tps)
+    )
+    extras["serve_prefix_tok_per_s_blind_ctl"] = round(
+        statistics.median(b_tps)
+    )
+    extras["serve_prefix_fetches"] = fetched_total
+    extras["serve_prefix_mismatch_reqs"] = mismatches
+    log(
+        f"bench: prefix residency (Zipf system prompts, 2 backends) "
+        f"hit rate {extras['serve_prefix_hit_rate_aware']:.0%} aware "
+        f"vs {extras['serve_prefix_hit_rate_blind_ctl']:.0%} blind, "
+        f"long-prompt TTFT "
+        f"{extras['serve_prefix_ttft_long_ms_aware']} ms vs "
+        f"{extras['serve_prefix_ttft_long_ms_blind_ctl']} ms "
+        f"({ab_pairs} interleaved pair(s), {fetched_total} prefix "
+        f"fetches, {mismatches} mismatched requests"
         + ("" if on_tpu else "; CPU = parity control") + ")"
     )
 

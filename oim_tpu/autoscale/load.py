@@ -58,6 +58,16 @@ _DEFAULTS: dict[str, Any] = {
     "kv_exports": 0,
     "kv_imports": 0,
     "kv_ship_bytes": 0,
+    # Fleet prefix residency (ISSUE 14; empty/zeros from publishers
+    # predating the fields — tolerant-decode defaults): the capped,
+    # hottest-first resident-digest summary the router's residency map
+    # and the autoscaler's bring-up pre-warm read, plus the hit/miss
+    # counters the fleet prefix-hit rate aggregates.  The summary is
+    # truncated at the ENGINE (disagg.PREFIX_DIGEST_CAP) so this
+    # leased value stays small however large the cache grows.
+    "prefix_digests": [],
+    "prefix_hits": 0,
+    "prefix_misses": 0,
     "token_rate": 0.0,
     "shed_queue_full": 0,
     "shed_deadline": 0,

@@ -105,7 +105,8 @@ def _render_requests(entries: list[dict], dropped: int) -> None:
     print(
         f"{'RID':>5} {'BACKEND':<22} {'TENANT':<12} {'OUTCOME':<14} "
         f"{'E2E_MS':>9} {'QUEUE':>9} {'ADMIT':>9} {'PREFILL':>9} "
-        f"{'DECODE':>9} {'STREAM':>9} {'CHUNKS':>6} {'TOK i/o':>9}  TRACE"
+        f"{'DECODE':>9} {'STREAM':>9} {'CHUNKS':>6} {'TOK i/o':>9} "
+        f"{'PREFIX':<10} TRACE"
     )
     for e in entries:
         tok = f"{e.get('tokens_in', 0)}/{e.get('tokens_out', 0)}"
@@ -118,7 +119,13 @@ def _render_requests(entries: list[dict], dropped: int) -> None:
             f"{ms(e.get('admit_s'))} "
             f"{ms(e.get('prefill_s'))} {ms(e.get('decode_s'))} "
             f"{ms(e.get('stream_s'))} {e.get('chunks', 0):>6} "
-            f"{tok:>9}  {str(e.get('trace', ''))[:16]}"
+            f"{tok:>9} "
+            # Which path produced the leading KV rows (ISSUE 14):
+            # local/fetched prefix hit vs recomputed prefill — a slow
+            # request whose cohort-mates say "fetched" while it says
+            # "recomputed" is a residency miss worth triaging.
+            f"{str(e.get('prefix', 'recomputed'))[:10]:<10} "
+            f"{str(e.get('trace', ''))[:16]}"
         )
     if dropped:
         print(f"({dropped} older entries evicted from the ring)")
@@ -168,7 +175,8 @@ def _print_top(
     print(
         f"{'BACKEND':<28} {'HEALTHY':<8} {'POOL':<8} {'QUEUE':>6} "
         f"{'ACTIVE':>7} {'SLOTS':>6} {'TOK/S':>9} {'KV f/s/t':>12} "
-        f"{'PATH':>10} {'SHIP e/i':>9} {'SHED q/d/b':>12} BROWNOUT"
+        f"{'PATH':>10} {'PFX':>9} {'SHIP e/i':>9} {'SHED q/d/b':>12} "
+        f"BROWNOUT"
     )
     busy = capacity = 0.0
     for bid, healthy, load in rows:
@@ -206,6 +214,16 @@ def _print_top(
             if load.get("kv_exports") or load.get("kv_imports")
             else "-"
         )
+        # Fleet prefix residency (ISSUE 14): resident digests and this
+        # backend's own hit rate — which replicas actually HOLD the
+        # hot prompts, vs recomputing them every request.
+        n_digests = len(load.get("prefix_digests") or ())
+        p_hits = load.get("prefix_hits", 0)
+        p_total = p_hits + load.get("prefix_misses", 0)
+        pfx = (
+            f"{n_digests} {p_hits / p_total:.0%}" if p_total
+            else (f"{n_digests} -" if n_digests else "-")
+        )
         shed = (
             f"{load.get('shed_queue_full', 0)}/"
             f"{load.get('shed_deadline', 0)}/"
@@ -215,7 +233,7 @@ def _print_top(
             f"{bid[:28]:<28} {'yes' if healthy else 'NO':<8} "
             f"{str(load.get('pool') or 'mixed')[:8]:<8} {q:>6} "
             f"{a:>7} {s:>6} {load.get('token_rate', 0.0):>9.1f} "
-            f"{kv:>12} {path:>10} {ship:>9} {shed:>12} "
+            f"{kv:>12} {path:>10} {pfx:>9} {ship:>9} {shed:>12} "
             f"{'yes' if load.get('brownout') else '-'}"
         )
     util = busy / capacity if capacity else 0.0
@@ -599,12 +617,36 @@ def main(argv=None) -> int:
                     stats = json.load(resp)
             except (urllib.error.URLError, OSError, ValueError) as exc:
                 raise _TopUnavailable(str(exc))
+            # Fleet prefix-residency summary from the router's own
+            # /v1/stats (the per-backend PFX column shows who HOLDS
+            # what; this line is the fleet-level outcome).
+            prefix = stats.get("prefix") or {}
+            line = ""
+            if prefix:
+                total = (
+                    prefix.get("fleet_hits", 0)
+                    + prefix.get("fleet_misses", 0)
+                )
+                rate = (
+                    f"{prefix.get('fleet_hits', 0) / total:.0%}"
+                    if total else "-"
+                )
+                line = (
+                    f"prefix: {prefix.get('residency_digests', 0)} "
+                    f"resident digests, fleet hit rate {rate}, "
+                    f"fetched {prefix.get('fetched', 0)}, "
+                    f"fell_back {prefix.get('fell_back', 0)}"
+                    + (
+                        "" if prefix.get("residency_aware", True)
+                        else " (residency-blind)"
+                    )
+                )
             return [
                 (bid, bool(b.get("healthy", True)), b.get("load") or {})
                 for bid, b in sorted(
                     (stats.get("backends") or {}).items()
                 )
-            ], ""
+            ], line
 
         return _run_top(args.watch, fetch_router_top)
     channel = _channel(args)

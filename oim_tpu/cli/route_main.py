@@ -83,6 +83,30 @@ def build_parser() -> argparse.ArgumentParser:
         "stalling the client stream",
     )
     p.add_argument(
+        "--no-residency", action="store_true",
+        help="disable residency-aware routing (doc/serving.md 'Fleet "
+        "prefix residency'): prompt-prefix affinity falls back to "
+        "pure rendezvous, blind to where prefixes are actually "
+        "resident — the bench's A/B control",
+    )
+    p.add_argument(
+        "--no-prefix-fetch", action="store_true",
+        help="never ship a resident prefix sibling→target on a miss; "
+        "residency-aware ROUTING stays on, misses just recompute "
+        "their prefill locally",
+    )
+    p.add_argument(
+        "--prefix-fetch-timeout", type=float, default=10.0, metavar="S",
+        help="per-ship timeout for a prefix fetch (GET /v1/kv?prefix= "
+        "+ PUT /v1/kv); a slow fetch falls back to recompute",
+    )
+    p.add_argument(
+        "--prefix-fetch-min-tokens", type=int, default=0, metavar="N",
+        help="only fetch prefixes covering at least N tokens (0 = "
+        "any): below the ship-vs-recompute crossover "
+        "(doc/serving.md), recomputing is cheaper than shipping",
+    )
+    p.add_argument(
         "--http-tls", action="store_true",
         help="mTLS on the data plane with the same --ca/--cert/--key: "
         "the router's own listener requires client certs AND the router "
@@ -146,6 +170,10 @@ def main(argv=None) -> int:
             disagg_prompt_tokens=args.disagg_prompt_tokens,
             disagg_first_tokens=args.disagg_first_tokens,
             disagg_ship_timeout=args.disagg_ship_timeout,
+            residency_aware=not args.no_residency,
+            prefix_fetch=not args.no_prefix_fetch,
+            prefix_fetch_timeout=args.prefix_fetch_timeout,
+            prefix_fetch_min_tokens=args.prefix_fetch_min_tokens,
         ).start()
     except ValueError as exc:
         raise SystemExit(str(exc))

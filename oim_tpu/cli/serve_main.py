@@ -88,6 +88,16 @@ def build_parser() -> argparse.ArgumentParser:
         "bounded by network, not checkpoint cold-start); validated "
         "against this instance's --vocab-size/--d-model/... geometry",
     )
+    p.add_argument(
+        "--prefix-prewarm", type=int, default=4, metavar="K",
+        help="with --params-peer and --prefix-cache: also pull the "
+        "weight-donor's K hottest resident prefix entries "
+        "(GET /v1/kv?prefix=) and install them before serving, so the "
+        "replica joins the fleet with its cohort's system prompts "
+        "already resident (doc/serving.md 'Fleet prefix residency'); "
+        "strictly best-effort — any failure degrades to normal "
+        "bring-up (0 = off)",
+    )
     # Engine shape.
     p.add_argument(
         "--tp", type=int, default=1,
@@ -560,6 +570,37 @@ def main(argv=None) -> int:
     if not args.no_warmup:
         log.current().info("warming up", buckets=list(engine.prompt_buckets))
         engine.warmup(embed=args.warmup_embed)
+    if args.params_peer and args.prefix_prewarm > 0 and args.prefix_cache:
+        # The --params-peer bring-up path's prefix leg (ISSUE 14):
+        # pre-warm the weight-donor's hottest resident prefixes so the
+        # replica's first requests hit instead of re-prefilling what
+        # the fleet already computed.  AFTER warmup (the ingest write
+        # is precompiled, the cache is clear of dummies), BEFORE the
+        # serve loop starts (this thread is still the device writer).
+        # Best-effort by contract: pre-warm failure must never block
+        # replica readiness — log and serve cold.
+        from oim_tpu.serve.disagg import prewarm_from_peer
+        from oim_tpu.serve.httptls import opener as _peer_opener
+
+        peer_ctx = None
+        if args.params_peer.startswith("https://"):
+            from oim_tpu.serve.httptls import client_ssl_context
+
+            peer_ctx = client_ssl_context(args.ca, args.cert, args.key)
+        try:
+            n = prewarm_from_peer(
+                engine, args.params_peer.rstrip("/"),
+                args.prefix_prewarm,
+                opener=_peer_opener(peer_ctx).open,
+            )
+            log.current().info(
+                "prefix pre-warm", peer=args.params_peer, installed=n
+            )
+        except Exception as exc:
+            log.current().warning(
+                "prefix pre-warm failed; serving cold",
+                peer=args.params_peer, error=str(exc),
+            )
     server = ServeServer(
         engine, host=args.host, port=args.port, ssl_context=ssl_context,
         tokenizer=tokenizer,

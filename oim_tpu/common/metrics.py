@@ -494,11 +494,44 @@ SERVE_KV_BLOCKS = _registry.gauge(
 )
 SERVE_PREFIX_BYTES_SAVED = _registry.counter(
     "oim_serve_prefix_bytes_saved_total",
-    "KV bytes prefix-cache hits ALIASED instead of copying (paged "
-    "engines: full blocks shared copy-free into the admitted slot's "
-    "table).  The copy-on-write duplicate of a partially-covered last "
-    "block is a real copy and does not count.",
-    ("engine",),
+    "KV bytes prefix-cache hits reused instead of recomputing, by "
+    "savings path: source=alias = a locally stored entry's full "
+    "blocks shared copy-free into the admitted slot's table (the PR "
+    "10 path), source=fetched = the hit rode an entry installed from "
+    "a sibling's exported prefix (ISSUE 14) — bytes this backend "
+    "never prefilled at all.  The copy-on-write duplicate of a "
+    "partially-covered last block is a real copy and does not count "
+    "under either source.",
+    ("engine", "source"),
+)
+SERVE_PREFIX_FETCH_SECONDS = _registry.histogram(
+    "oim_serve_prefix_fetch_seconds",
+    "Wall time of one router-orchestrated prefix ship (GET "
+    "/v1/kv?prefix= off the resident sibling + PUT /v1/kv into the "
+    "routed target).  Compare against the donor's "
+    "oim_serve_prefill_seconds: a fetch slower than the recompute it "
+    "replaces means the crossover guidance in doc/serving.md 'Fleet "
+    "prefix residency' wants a higher minimum entry size.",
+)
+SERVE_PREFIX_FETCH = _registry.counter(
+    "oim_serve_prefix_fetch_total",
+    "Router-orchestrated prefix ships by outcome: fetched = the "
+    "entry landed on the routed target before forwarding, fell_back "
+    "= the ship failed and the request recomputed its prefill "
+    "(token-identical either way), ineligible = the residency map "
+    "advertised an unfetchable entry (dense/kv4 source, no prefix "
+    "cache on the target) — persistent ineligible growth means the "
+    "fleet mixes layouts the ship protocol refuses.",
+    ("outcome",),
+)
+ROUTE_RESIDENCY_DIGESTS = _registry.gauge(
+    "oim_route_residency_digests",
+    "Distinct prefix digests in the router's fleet residency map "
+    "(union over per-backend load/serve.<id> digest summaries, "
+    "refreshed every probe tick).  Zero with prefix caches enabled = "
+    "the load schema is not reaching the router (stale publishers, "
+    "probe failures); see doc/operations.md 'Fleet prefix residency "
+    "incidents'.",
 )
 AUTOSCALE_DESIRED = _registry.gauge(
     "oim_autoscale_desired_replicas",

@@ -44,6 +44,7 @@ the staged-import write) lives in ``serve/engine.py``.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import struct
 import time
@@ -67,8 +68,29 @@ KV_HOLD_MAX = 8
 KV_IMPORT_TTL_S = 60.0
 KV_IMPORT_MAX = 8
 
+# Fleet prefix residency (ISSUE 14): staged prefix installs pin pool
+# blocks exactly like staged imports, same TTL/cap stance; the digest
+# summary published in load/serve.<id> is truncated to the hottest
+# PREFIX_DIGEST_CAP entries so the leased registry value stays small
+# (a 4k-entry cache must not ship 4k digests every heartbeat).
+PREFIX_IMPORT_TTL_S = 60.0
+PREFIX_IMPORT_MAX = 8
+PREFIX_DIGEST_CAP = 32
+
 MANIFEST_KIND = "oim-kv"
 MANIFEST_VERSION = 1
+
+
+def prefix_digest(tokens) -> str:
+    """Stable content digest of a prefix-cache entry: the hash of the
+    token ids it covers.  THE fleet-wide identity of a resident prefix
+    — the engine stamps it on every entry, load/serve.<id> publishes
+    the summary, and the router recomputes it over a request's leading
+    tokens to find which backend already holds that prefill.  16 hex
+    chars: collision-safe at fleet scale (2^64) and short enough for
+    registry values and log lines."""
+    payload = ",".join(str(int(t)) for t in tokens).encode()
+    return hashlib.sha256(b"oim-pfx:" + payload).hexdigest()[:16]
 
 
 class KvTransferError(RuntimeError):
@@ -234,7 +256,32 @@ def validate_geometry(manifest: dict, geometry: dict) -> None:
     n_tok = len(manifest.get("prompt_tokens", ())) + len(
         manifest.get("tokens", ())
     )
-    if not isinstance(rows, int) or rows < 1 or rows != n_tok - 1:
+    if manifest.get("prefix"):
+        # A prefix-entry transfer (GET /v1/kv?prefix=<digest>) ships a
+        # block-aligned prompt-KV entry: every covered token has a row
+        # (there is no pending emitted token), and the digest must be
+        # the hash of exactly those tokens — a manifest whose digest
+        # and token record disagree is torn or forged, refuse it.
+        if manifest.get("tokens"):
+            # Conforming exporters always ship tokens=[]: a nonempty
+            # emitted record would let rows exceed what the digest
+            # hashes (it covers prompt_tokens only) — an entry keyed
+            # by fewer tokens than the rows it pins.
+            raise KvGeometryError(
+                "a prefix transfer must not carry emitted tokens"
+            )
+        if not isinstance(rows, int) or rows < 1 or rows != n_tok:
+            raise KvGeometryError(
+                f"prefix rows {rows!r} inconsistent with {n_tok} "
+                f"tokens (a prefix entry has one row per covered token)"
+            )
+        want = prefix_digest(manifest.get("prompt_tokens", ()))
+        if manifest["prefix"] != want:
+            raise KvGeometryError(
+                f"prefix digest {manifest['prefix']!r} does not match "
+                f"the shipped token record ({want})"
+            )
+    elif not isinstance(rows, int) or rows < 1 or rows != n_tok - 1:
         raise KvGeometryError(
             f"rows {rows!r} inconsistent with {n_tok} tokens "
             f"(valid rows must be tokens - 1)"
@@ -280,6 +327,94 @@ def ship_kv(
     with opener(req, timeout=timeout) as resp:
         reply = json.loads(resp.read())
     return int(reply["import_id"]), int(reply["rows"]), len(body)
+
+
+def ship_prefix(
+    opener,
+    src_url: str,
+    digest: str,
+    dst_url: str,
+    timeout: float = 30.0,
+) -> tuple[int, int]:
+    """Move one resident prefix entry between backends: GET it off the
+    backend whose cache holds ``digest``, PUT it into the target's
+    ingest, which installs it as a refcounted prefix-cache entry.
+    Returns (rows, bytes shipped).  Raises on ANY failure — the caller
+    (the router's residency-aware miss path, the autoscaler's bring-up
+    pre-warm) falls back to recompute prefill, which is always
+    token-identical; like :func:`ship_kv` this performs no cleanup
+    (nothing is held on the source — entries are cache-managed — and a
+    staged-but-never-installed target side TTL-expires)."""
+    with opener(
+        f"{src_url}/v1/kv?prefix={digest}", timeout=timeout
+    ) as resp:
+        clen = int(resp.headers.get("Content-Length", "0"))
+        body = resp.read()
+    if clen and len(body) != clen:
+        raise OSError(
+            f"prefix fetch truncated: {len(body)} of {clen} bytes "
+            f"(source backend died mid-ship)"
+        )
+    req = urllib.request.Request(
+        f"{dst_url}/v1/kv",
+        data=body,
+        headers={"Content-Type": "application/octet-stream"},
+        method="PUT",
+    )
+    with opener(req, timeout=timeout) as resp:
+        reply = json.loads(resp.read())
+    return int(reply["rows"]), len(body)
+
+
+def prewarm_from_peer(
+    engine,
+    peer_url: str,
+    top_k: int,
+    opener=None,
+    timeout: float = 30.0,
+) -> int:
+    """The ``--params-peer`` bring-up path's prefix leg (ISSUE 14): pull
+    the weight-donor sibling's ``top_k`` hottest resident prefixes and
+    install them locally, so a scale-out replica joins the fleet with
+    the system prompts its cohort shares already resident — its first
+    requests hit instead of re-prefilling what the whole fleet already
+    computed.  Returns the number of entries installed.
+
+    Strictly best-effort, by contract: ANY failure (peer gone, dense
+    peer, geometry mismatch, capacity) degrades to normal bring-up —
+    pre-warming must never block replica readiness, the same stance as
+    a failed KV ship falling back to recompute.  The caller owns the
+    driver-thread discipline: call BEFORE the serve loop starts (the
+    install writes pool blocks through the engine's jitted ingest)."""
+    if top_k <= 0 or not getattr(engine, "paged", False):
+        return 0
+    if opener is None:
+        opener = urllib.request.urlopen
+    try:
+        with opener(f"{peer_url}/v1/info", timeout=timeout) as resp:
+            info = json.loads(resp.read())
+    except Exception:
+        return 0  # peer gone/unreadable: serve cold, never block
+    digests = (info.get("load") or {}).get("prefix_digests") or []
+    installed = 0
+    for entry in digests[: max(0, int(top_k))]:
+        digest = entry.get("digest") if isinstance(entry, dict) else None
+        if not digest:
+            continue
+        try:
+            with opener(
+                f"{peer_url}/v1/kv?prefix={digest}", timeout=timeout
+            ) as resp:
+                body = resp.read()
+            engine.import_kv_prefix(*unpack_transfer(body))
+            installed += 1
+        except Exception:
+            continue  # best-effort per entry; the rest may still land
+    if installed:
+        # Land the staged payloads in the pool now — no driver thread
+        # runs yet, so the caller's thread IS the device writer.
+        engine.install_prefix_imports()
+    return installed
 
 
 def release_kv(

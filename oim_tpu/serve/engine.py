@@ -107,12 +107,16 @@ from oim_tpu.serve.disagg import (
     KV_HOLD_TTL_S,
     KV_IMPORT_MAX,
     KV_IMPORT_TTL_S,
+    PREFIX_DIGEST_CAP,
+    PREFIX_IMPORT_MAX,
+    PREFIX_IMPORT_TTL_S,
     KvCapacityError,
     KvGeometryError,
     KvHold,
     KvImport,
     KvIneligibleError,
     build_manifest,
+    prefix_digest,
     validate_geometry,
 )
 from oim_tpu.ops.quant import (
@@ -1302,6 +1306,10 @@ class _PhaseTrace:
     t_admitted: float = 0.0
     t_prefill: float = 0.0
     t_first: float = 0.0
+    # Which path produced the leading KV rows (ISSUE 14): "local" /
+    # "fetched" prefix-cache hit, or "recomputed" prefill — stamped at
+    # admission, surfaced in the request ring (`oimctl requests`).
+    prefix_source: str = "recomputed"
     # One record per decode chunk this request consumed tokens from:
     # (chunk seq, span start, done, tokens, dispatch_wait_s,
     # fetch_wait_s) — dispatch-wait vs fetch-wait from the step loop's
@@ -1822,6 +1830,24 @@ class Engine:
         # kept alive by the refcount, aliased read-only into every
         # later slot that shares the prefix.
         self._prefix_cache: OrderedDict = OrderedDict()
+        # Fleet residency metadata, one record per entry (same key,
+        # same lock): the stable content digest (disagg.prefix_digest
+        # over the covered tokens — the entry's fleet-wide identity),
+        # covered rows, hit count, last-hit instant, and origin
+        # ("local" = stored from this engine's own traffic, "fetched"
+        # = installed from a sibling's exported entry) — the substrate
+        # for prefix_digest_summary() and the per-request
+        # fetched-vs-local-vs-recomputed attribution.
+        self._prefix_meta: dict[tuple, dict] = {}
+        # Staged prefix installs (import_kv_prefix): (digest, KvImport)
+        # pairs — freshly reserved blocks + host payload, landed in the
+        # pool by the DRIVER thread (install_prefix_imports) at the
+        # next admission boundary — the single-writer cache discipline,
+        # exactly like staged KV-ship imports.  TTL'd and count-capped
+        # the same way.
+        self._prefix_installs: list[tuple[str, KvImport]] = []
+        self.prefix_fetch_installs = 0
+        self.prefix_exports = 0
         self._extract = {
             b: jax.jit(partial(_extract_prefix, rows=b))
             for b in (
@@ -2604,7 +2630,12 @@ class Engine:
 
     def pending(self) -> bool:
         with self._lock:
-            return bool(self._queue or self._slots)
+            # Staged prefix installs count as pending work: the serve
+            # loop's idle path must call step() so the driver thread
+            # lands them at the next admission boundary.
+            return bool(
+                self._queue or self._slots or self._prefix_installs
+            )
 
     def info(self) -> dict:
         """Static engine/model description (GET /v1/info): what an
@@ -2686,6 +2717,16 @@ class Engine:
                 # approximate under sharing (an aliased row counts once
                 # per reader), an operator signal not an invariant.
                 "prefix_bytes_saved": self.prefix_bytes_saved,
+                # Fleet prefix residency (ISSUE 14): the resident
+                # digest summary (hottest-first, capped), the count of
+                # entries installed from sibling exports, exports
+                # served, and installs still staged for the driver.
+                "prefix_digests": self._prefix_digest_summary_locked(
+                    PREFIX_DIGEST_CAP
+                ),
+                "prefix_fetch_installs": self.prefix_fetch_installs,
+                "prefix_exports": self.prefix_exports,
+                "prefix_installs_staged": len(self._prefix_installs),
                 "kv_block_size": self.kv_block,
                 "kv_blocks_total": self.kv_blocks,
                 "kv_blocks_free": (
@@ -2821,6 +2862,17 @@ class Engine:
                 "kv_exports": self.kv_exports,
                 "kv_imports": self.kv_imports_total,
                 "kv_ship_bytes": self.kv_ship_bytes,
+                # Fleet prefix residency (ISSUE 14): the capped digest
+                # summary the router's residency map and the pre-warm
+                # donor pick ride on, plus the hit/miss counters the
+                # fleet prefix-hit rate aggregates — all through the
+                # same leased load/serve.<id> value the probe tick
+                # already refetches.
+                "prefix_digests": self._prefix_digest_summary_locked(
+                    PREFIX_DIGEST_CAP
+                ),
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
                 "token_rate": round(self._token_rate_ewma or 0.0, 2),
                 "shed_queue_full": self._shed_counts["queue_full"],
                 "shed_deadline": self._shed_counts["deadline"],
@@ -2975,6 +3027,12 @@ class Engine:
             "chunks": chunk_count,
             "tokens_in": len(req.tokens) if req is not None else 0,
             "tokens_out": tokens_out,
+            # fetched-vs-local-vs-recomputed prefix attribution
+            # (`oimctl requests` PREFIX column).
+            "prefix": (
+                phases.prefix_source if phases is not None
+                else "recomputed"
+            ),
             "ts": time.time(),
         }
         with self._ring_lock:
@@ -3091,31 +3149,36 @@ class Engine:
                     best_key, best_usable = key, usable
         return best_key, best_usable
 
-    def _try_prefix_inject(self, slot: int, req: GenRequest) -> int:
+    def _try_prefix_inject(
+        self, slot: int, req: GenRequest
+    ) -> tuple[int, str]:
         """Inject the longest cached prefix of ``req.tokens`` into
-        ``slot``; returns the start offset for the tail prefill (0 = no
-        usable entry).  Exact for dense AND MoE models: a KV row depends
+        ``slot``; returns (start offset for the tail prefill, prefix
+        source) — start 0 / "recomputed" when no usable entry, else the
+        hit entry's origin ("local"/"fetched") for the request-ring
+        attribution.  Exact for dense AND MoE models: a KV row depends
         only on the tokens before it, and MoE routing is per-token
         (``_moe_exact``), so injected rows plus a tail prefill reproduce
         a full prefill bit-for-bit.  Dense engines only — the paged
         layout aliases blocks instead of copying rows
         (``_plan_paged_admission_locked``)."""
         if not self.prefix_cache_size:
-            return 0
+            return 0, "recomputed"
         with self._lock:
             best_key, best_usable = self._best_prefix_locked(req)
             if best_key is None:
                 if not self._warming:
                     self.prefix_misses += 1
                     self._m_prefix.inc("miss")
-                return 0
+                return 0, "recomputed"
             self._prefix_cache.move_to_end(best_key)  # LRU touch
             entry, _ = self._prefix_cache[best_key]
+            source = self._touch_prefix_meta_locked(best_key)
             if not self._warming:
                 self.prefix_hits += 1
                 self._m_prefix.inc("hit")
         self._cache = self._inject(self._cache, entry, jnp.int32(slot))
-        return best_usable
+        return best_usable, source
 
     def _store_prefix(self, slot: int, tokens: list[int]) -> None:
         """Cache ``slot``'s freshly prefilled prompt KV.
@@ -3147,10 +3210,14 @@ class Engine:
                     self._alloc.decref(old[0])
                 self._alloc.incref(blocks)
                 self._prefix_cache[key] = (blocks, full * self.kv_block)
+                self._set_prefix_meta_locked(
+                    key, full * self.kv_block, "local"
+                )
                 while len(self._prefix_cache) > self.prefix_cache_size:
-                    _, (ev_blocks, _) = self._prefix_cache.popitem(
+                    ev_key, (ev_blocks, _) = self._prefix_cache.popitem(
                         last=False
                     )
+                    self._prefix_meta.pop(ev_key, None)
                     self._alloc.decref(ev_blocks)
                 if not self._warming:
                     self.prefix_injects += 1
@@ -3163,8 +3230,10 @@ class Engine:
             key = tuple(tokens)
             self._prefix_cache[key] = (entry, len(tokens))
             self._prefix_cache.move_to_end(key)
+            self._set_prefix_meta_locked(key, len(tokens), "local")
             while len(self._prefix_cache) > self.prefix_cache_size:
-                self._prefix_cache.popitem(last=False)
+                ev_key, _ = self._prefix_cache.popitem(last=False)
+                self._prefix_meta.pop(ev_key, None)
             if not self._warming:
                 self.prefix_injects += 1
                 self._m_prefix.inc("inject")
@@ -3178,6 +3247,69 @@ class Engine:
                 self._alloc.decref(blocks)
             self._update_kv_gauges_locked()
         self._prefix_cache.clear()
+        self._prefix_meta.clear()
+
+    def _set_prefix_meta_locked(
+        self, key: tuple, covered: int, origin: str
+    ) -> None:
+        """Create/refresh one entry's residency record (lock held).
+        The digest hashes the COVERED tokens only — for paged entries
+        the block-aligned prefix, which is exactly what an export
+        ships and what the router must recompute over a request's
+        leading tokens to match."""
+        self._prefix_meta[key] = {
+            "digest": prefix_digest(key[:covered]),
+            "covered": covered,
+            "hits": 0,
+            "last_hit": time.monotonic(),
+            "origin": origin,
+        }
+
+    def _touch_prefix_meta_locked(self, key: tuple) -> str:
+        """Record one hit on an entry (lock held); returns its origin
+        ("local"/"fetched") for the per-request attribution."""
+        meta = self._prefix_meta.get(key)
+        if meta is None:
+            return "local"
+        meta["hits"] += 1
+        meta["last_hit"] = time.monotonic()
+        return meta["origin"]
+
+    def prefix_digest_summary(self, cap: int = PREFIX_DIGEST_CAP) -> list:
+        """Compact resident-prefix summary for ``load/serve.<id>`` and
+        ``stats()``: the ``cap`` hottest entries (most recent hit
+        first — the pre-warm donor's "top-K hottest digests" order),
+        each as {digest, tokens covered, block count, age since last
+        hit, hits, origin}.  Truncation keeps the leased registry
+        value small no matter how large the cache grows."""
+        with self._lock:
+            return self._prefix_digest_summary_locked(cap)
+
+    def _prefix_digest_summary_locked(self, cap: int) -> list:
+        now = time.monotonic()
+        entries = []
+        for key, (entry, true_len) in self._prefix_cache.items():
+            meta = self._prefix_meta.get(key)
+            if meta is None:
+                continue
+            entries.append((meta["last_hit"], meta["hits"], {
+                "digest": meta["digest"],
+                "tokens": meta["covered"],
+                # Dense entries report 0 blocks: still routable (the
+                # residency map is layout-agnostic) but not fetchable
+                # (export is paged-only; the router's fetch path reads
+                # this as ineligible without a wasted roundtrip).
+                "blocks": len(entry) if self.paged else 0,
+                "age_s": round(now - meta["last_hit"], 1),
+                "hits": meta["hits"],
+                "origin": meta["origin"],
+            }))
+        # Hottest first on the RAW last-hit instant (the rounded age_s
+        # ties at 0.0 for anything hit in the same tenth of a second —
+        # sorting on it would fall back to dict order, not hotness),
+        # hit count breaking exact ties.
+        entries.sort(key=lambda e: (-e[0], -e[1]))
+        return [doc for _, _, doc in entries[: max(0, cap)]]
 
     # -- paged-KV host machinery (ISSUE 10) --------------------------------
 
@@ -3297,8 +3429,11 @@ class Engine:
                 self.kv_admit_deferrals += 1
             return None
         self._alloc.incref(aliased)
+        source = "recomputed"
         if best_key is not None:
             self._prefix_cache.move_to_end(best_key)  # LRU touch
+            if usable:
+                source = self._touch_prefix_meta_locked(best_key)
         if not self._warming:
             if usable:
                 self.prefix_hits += 1
@@ -3307,11 +3442,18 @@ class Engine:
                 # are KV bytes a dense engine would have COPIED into
                 # the slot's region (and, pre-prefix-cache, recomputed
                 # outright).  The CoW'd partial block is a real copy,
-                # so it does not count.
+                # so it does not count.  Source label splits the two
+                # savings paths: "alias" = a locally stored entry,
+                # "fetched" = an entry installed from a sibling's
+                # export — without the split, a fleet whose hits all
+                # ride fetched installs reads identically to one whose
+                # router affinity alone is doing the work (ISSUE 14).
                 saved = len(aliased) * bs * self._kv_row_bytes
                 self.prefix_bytes_saved += saved
                 self._m_prefix_bytes.inc(
-                    self._engine_label, by=float(saved)
+                    self._engine_label,
+                    "fetched" if source == "fetched" else "alias",
+                    by=float(saved),
                 )
             elif self.prefix_cache_size:
                 self.prefix_misses += 1
@@ -3324,6 +3466,7 @@ class Engine:
             "start": start,
             "blocks": aliased + fresh,
             "cow": None if cow_src is None else (cow_src, fresh[0]),
+            "source": source,
         }
 
     def _evict_prefix_for_locked(
@@ -3357,6 +3500,7 @@ class Engine:
             if not self._alloc.exclusive(blocks):
                 continue
             self._prefix_cache.pop(key)
+            self._prefix_meta.pop(key, None)
             self._alloc.decref(blocks)
 
     def _commit_plan_locked(self, slot: int, plan: dict) -> None:
@@ -3472,6 +3616,38 @@ class Engine:
         with self._lock:
             return self._release_kv_import_locked(import_id)
 
+    def _gather_blocks(self, blocks, what: str = "") -> tuple[list, list]:
+        """Read ``blocks`` out of the pool as host arrays — (leaf
+        names, arrays), the shared payload read for KV-hold AND
+        prefix-entry exports.  Safe from any thread: the caller
+        guarantees the blocks are referenced and never written (a
+        hold's own ref, a pinned prefix entry), so their contents are
+        IDENTICAL in every generation of the donated cache — the read
+        retries through a donation race (the driver consuming
+        ``self._cache`` mid-gather) by re-snapshotting the current
+        cache."""
+        with self._lock:
+            cache = self._cache
+        ids = jnp.asarray(blocks, jnp.int32)
+        names = ["k", "v"] + (
+            ["k_scale", "v_scale"] if self.kv_int8 else []
+        )
+        for attempt in range(8):
+            pools = [getattr(cache, name) for name in names]
+            try:
+                data = self._fetch_aux(
+                    [jnp.take(pool, ids, axis=1) for pool in pools]
+                )
+                return names, [np.asarray(a) for a in data]
+            except RuntimeError:
+                # The driver donated this cache generation away while
+                # the gather was being built; re-snap and retry.
+                with self._lock:
+                    cache = self._cache
+        raise RuntimeError(
+            f"KV export for {what} lost the donation race 8 times"
+        )
+
     def export_kv(self, rid: int):
         """One held request's KV as (manifest, leaf arrays in manifest
         order) — the ``GET /v1/kv`` payload (serve/disagg.py framing).
@@ -3498,30 +3674,7 @@ class Engine:
             hold = self._kv_holds.get(rid)
             if hold is None:
                 raise KvIneligibleError(f"no held KV for request {rid}")
-            cache = self._cache
-        ids = jnp.asarray(hold.blocks, jnp.int32)
-        names = ["k", "v"] + (
-            ["k_scale", "v_scale"] if self.kv_int8 else []
-        )
-        data = None
-        for attempt in range(8):
-            pools = [getattr(cache, name) for name in names]
-            try:
-                data = self._fetch_aux(
-                    [jnp.take(pool, ids, axis=1) for pool in pools]
-                )
-                break
-            except RuntimeError:
-                # The driver donated this cache generation away while
-                # the gather was being built; held-block contents are
-                # invariant across generations, so re-snap and retry.
-                with self._lock:
-                    cache = self._cache
-        else:
-            raise RuntimeError(
-                f"KV export for {rid} lost the donation race 8 times"
-            )
-        arrays = [np.asarray(a) for a in data]
+        names, arrays = self._gather_blocks(hold.blocks, what=f"rid {rid}")
         leaves = [
             {
                 "name": name,
@@ -3569,40 +3722,7 @@ class Engine:
             raise KvGeometryError(
                 f"shipped rows {rows} exceed max_len {self.max_len}"
             )
-        # FULL leaf validation — exact shape AND dtype, not just the
-        # leading dims: anything less reaches the jitted ingest write
-        # on the DRIVER thread at admission, where a mis-shaped update
-        # is a crash that latches the whole backend's error state.  A
-        # bad transfer must die HERE, as the 409 the protocol promises.
-        from oim_tpu.serve.disagg import _np_dtype
-
-        cfg = self.cfg
-        kv_shape = (
-            cfg.n_layers, n_ship, self.kv_block, cfg.kv_heads,
-            cfg.head_dim,
-        )
-        pool_dtype = _np_dtype(str(self._cache.k.dtype))
-        want = {"k": (kv_shape, pool_dtype), "v": (kv_shape, pool_dtype)}
-        if self.kv_int8:
-            scale_shape = kv_shape[:-1]
-            want["k_scale"] = (scale_shape, np.dtype(np.float32))
-            want["v_scale"] = (scale_shape, np.dtype(np.float32))
-        names = list(want)
-        for name, (shape, dtype) in want.items():
-            arr = data.get(name)
-            if (
-                arr is None
-                or tuple(arr.shape) != shape
-                or arr.dtype != dtype
-            ):
-                raise KvGeometryError(
-                    f"leaf {name} missing or mis-shaped/typed: want "
-                    f"{shape} {dtype}, got "
-                    + (
-                        "nothing" if arr is None
-                        else f"{tuple(arr.shape)} {arr.dtype}"
-                    )
-                )
+        names = self._validate_ship_leaves(data, n_ship)
         total = sum(int(data[name].nbytes) for name in names)
         with self._lock:
             now = time.monotonic()
@@ -3634,6 +3754,238 @@ class Engine:
             self.kv_ship_bytes += total
             self._update_kv_gauges_locked()
         return import_id, rows
+
+    def _validate_ship_leaves(self, data: dict, n_ship: int) -> list[str]:
+        """FULL leaf validation — exact shape AND dtype, not just the
+        leading dims: anything less reaches the jitted ingest write on
+        the DRIVER thread, where a mis-shaped update is a crash that
+        latches the whole backend's error state.  A bad transfer must
+        die HERE, as the 409 the protocol promises.  Shared by the
+        KV-ship and prefix-entry ingests; returns the leaf names in
+        manifest order."""
+        from oim_tpu.serve.disagg import _np_dtype
+
+        cfg = self.cfg
+        kv_shape = (
+            cfg.n_layers, n_ship, self.kv_block, cfg.kv_heads,
+            cfg.head_dim,
+        )
+        pool_dtype = _np_dtype(str(self._cache.k.dtype))
+        want = {"k": (kv_shape, pool_dtype), "v": (kv_shape, pool_dtype)}
+        if self.kv_int8:
+            scale_shape = kv_shape[:-1]
+            want["k_scale"] = (scale_shape, np.dtype(np.float32))
+            want["v_scale"] = (scale_shape, np.dtype(np.float32))
+        for name, (shape, dtype) in want.items():
+            arr = data.get(name)
+            if (
+                arr is None
+                or tuple(arr.shape) != shape
+                or arr.dtype != dtype
+            ):
+                raise KvGeometryError(
+                    f"leaf {name} missing or mis-shaped/typed: want "
+                    f"{shape} {dtype}, got "
+                    + (
+                        "nothing" if arr is None
+                        else f"{tuple(arr.shape)} {arr.dtype}"
+                    )
+                )
+        return list(want)
+
+    # -- fleet prefix residency: prefix-entry export/ingest (ISSUE 14) ----
+
+    def export_kv_prefix(self, digest: str):
+        """One RESIDENT PREFIX ENTRY's KV as (manifest, leaf arrays) —
+        the ``GET /v1/kv?prefix=<digest>`` payload: the block-aligned
+        entry a sibling can install without recomputing the prefill.
+        The entry's blocks are pinned (one extra ref) for the gather's
+        duration — LRU eviction or an admission shortage decref'ing
+        them mid-read must not free pool blocks under the fetch.
+        Raises ``KvIneligibleError`` on dense/kv4 engines (the
+        ship-ineligible taxonomy) or an unknown digest — the router's
+        recompute path is the unconditional fallback."""
+        if not self.paged:
+            raise KvIneligibleError(
+                "prefix export needs a paged engine (oim-serve "
+                "--kv-block)"
+            )
+        if self.kv_int4:
+            raise KvIneligibleError(
+                "prefix export unsupported on kv_int4"
+            )
+        with self._lock:
+            for key, (blocks, _) in self._prefix_cache.items():
+                meta = self._prefix_meta.get(key)
+                if meta is not None and meta["digest"] == digest:
+                    covered = meta["covered"]
+                    entry_blocks = tuple(blocks)
+                    tokens = [int(t) for t in key[:covered]]
+                    break
+            else:
+                raise KvIneligibleError(
+                    f"no resident prefix {digest!r}"
+                )
+            self._alloc.incref(entry_blocks)  # pin for the gather
+        try:
+            names, arrays = self._gather_blocks(
+                entry_blocks, what=f"prefix {digest}"
+            )
+        finally:
+            with self._lock:
+                self._alloc.decref(entry_blocks)
+                self._update_kv_gauges_locked()
+        leaves = [
+            {
+                "name": name,
+                "dtype": str(arr.dtype),
+                "shape": [int(d) for d in arr.shape],
+            }
+            for name, arr in zip(names, arrays)
+        ]
+        manifest = build_manifest(
+            geometry=self.kv_geometry(),
+            rows=covered,
+            prompt_tokens=tokens,
+            tokens=[],
+            sampling={},
+            leaves=leaves,
+        )
+        manifest["prefix"] = digest
+        total = sum(int(a.nbytes) for a in arrays)
+        with self._lock:
+            self.prefix_exports += 1
+            self.kv_ship_bytes += total
+        return manifest, arrays
+
+    def import_kv_prefix(self, manifest: dict, data: dict) -> tuple[str, int]:
+        """Stage one shipped PREFIX ENTRY for installation (``PUT
+        /v1/kv`` with a prefix manifest): geometry-validate (the digest
+        ↔ token-record consistency rides ``validate_geometry``),
+        reserve the entry's blocks all-or-nothing (``KvCapacityError``
+        = 429 backpressure, idle entries evicted first like every
+        planner), and keep the payload host-side for the DRIVER thread
+        to land (``install_prefix_imports``) — the single-writer cache
+        discipline, exactly like KV-ship ingests.  Returns (digest,
+        rows); rows 0 = already resident (idempotent: re-shipping a
+        resident prefix is success, not an error).  kv4 pools keep
+        refusing ships."""
+        if not self.paged:
+            raise KvIneligibleError(
+                "prefix ingest needs a paged engine (oim-serve "
+                "--kv-block)"
+            )
+        if self.kv_int4:
+            raise KvIneligibleError(
+                "prefix ingest unsupported on kv_int4"
+            )
+        if not self.prefix_cache_size:
+            raise KvIneligibleError(
+                "no prefix cache on this backend (oim-serve "
+                "--prefix-cache)"
+            )
+        digest = manifest.get("prefix")
+        if not digest:
+            raise KvGeometryError("manifest is not a prefix transfer")
+        validate_geometry(manifest, self.kv_geometry())
+        rows = int(manifest["rows"])
+        if rows % self.kv_block:
+            raise KvGeometryError(
+                f"prefix rows {rows} not block-aligned "
+                f"(block_size {self.kv_block})"
+            )
+        if rows >= self.max_len:
+            raise KvGeometryError(
+                f"shipped rows {rows} exceed max_len {self.max_len}"
+            )
+        tokens = [int(t) for t in manifest["prompt_tokens"]]
+        n_ship = rows // self.kv_block
+        names = self._validate_ship_leaves(data, n_ship)
+        total = sum(int(data[name].nbytes) for name in names)
+        with self._lock:
+            key = tuple(tokens)
+            if key in self._prefix_cache or any(
+                tuple(st.tokens) == key for _, st in self._prefix_installs
+            ):
+                return digest, 0  # already resident/staged: idempotent
+            now = time.monotonic()
+            self._sweep_prefix_installs_locked(now)
+            while len(self._prefix_installs) >= PREFIX_IMPORT_MAX:
+                _, old = self._prefix_installs.pop(0)  # oldest first
+                self._alloc.decref(old.blocks)
+            if n_ship > self._alloc.free_blocks:
+                self._evict_prefix_for_locked(n_ship)
+            blocks = self._alloc.alloc(n_ship)
+            if blocks is None:
+                raise KvCapacityError(
+                    f"pool cannot reserve {n_ship} blocks for the "
+                    f"shipped prefix ({self._alloc.free_blocks} free) "
+                    f"— retry or fall back to recompute"
+                )
+            self._prefix_installs.append((digest, KvImport(
+                import_id=-1,  # prefix installs are digest-addressed
+                blocks=tuple(blocks),
+                rows=rows,
+                tokens=tokens,
+                data={name: data[name] for name in names},
+                t_created=now,
+            )))
+            self.kv_ship_bytes += total
+            self._update_kv_gauges_locked()
+        return digest, rows
+
+    def _sweep_prefix_installs_locked(self, now: float) -> None:
+        """TTL the staged prefix installs (lock held): an orchestrator
+        that died between PUT and the next admission boundary leaks
+        zero blocks past the TTL."""
+        keep = []
+        for digest, st in self._prefix_installs:
+            if now - st.t_created > PREFIX_IMPORT_TTL_S:
+                self._alloc.decref(st.blocks)
+            else:
+                keep.append((digest, st))
+        if len(keep) != len(self._prefix_installs):
+            self._prefix_installs = keep
+            self._update_kv_gauges_locked()
+
+    def install_prefix_imports(self) -> int:
+        """Land every staged prefix payload in the pool and make the
+        entries visible — returns the number installed.  MUST run on
+        the thread that owns the device cache (the driver thread's
+        admission boundary in ``_admit_wave``; or the bring-up thread
+        before the serve loop starts — the pre-warm path): each block
+        writes through the warmup-precompiled ``_ingest`` program,
+        chained through ``self._cache`` so the device stream orders
+        install → any later prefill that aliases the entry.  Zero
+        steady-state compiles by construction (the jit-guard pin)."""
+        if not self.paged:
+            return 0
+        with self._lock:
+            if not self._prefix_installs:
+                return 0
+            staged, self._prefix_installs = self._prefix_installs, []
+        installed = 0
+        for digest, st in staged:
+            self._write_import_blocks(st)
+            with self._lock:
+                key = tuple(st.tokens)
+                if key in self._prefix_cache:
+                    # A local store for the same prompt raced the ship:
+                    # keep the resident entry, return the staged blocks.
+                    self._alloc.decref(st.blocks)
+                else:
+                    self._prefix_cache[key] = (tuple(st.blocks), st.rows)
+                    self._set_prefix_meta_locked(key, st.rows, "fetched")
+                    while len(self._prefix_cache) > self.prefix_cache_size:
+                        ev_key, (ev_entry, _) = self._prefix_cache.popitem(
+                            last=False
+                        )
+                        self._prefix_meta.pop(ev_key, None)
+                        self._alloc.decref(ev_entry)
+                    self.prefix_fetch_installs += 1
+                    installed += 1
+                self._update_kv_gauges_locked()
+        return installed
 
     def _plan_import_admission_locked(self, req: GenRequest, imp: KvImport):
         """Admission plan for a staged-import continuation (lock
@@ -4023,12 +4375,16 @@ class Engine:
         now = time.monotonic()
         ended = []
         with self._lock:
-            if self.paged and (self._kv_holds or self._kv_imports):
+            if self.paged and (
+                self._kv_holds or self._kv_imports
+                or self._prefix_installs
+            ):
                 # Drive the KV-transfer TTLs from the step loop too: a
                 # ship whose orchestrator died must return its blocks
                 # without waiting for the next export/ingest call.
                 self._sweep_kv_holds_locked(now)
                 self._sweep_kv_imports_locked(now)
+                self._sweep_prefix_installs_locked(now)
             if not (
                 self._cancelled
                 or any(req.deadline is not None for _, req, _ in self._queue)
@@ -4111,6 +4467,10 @@ class Engine:
         """
         if self._inflight is not None:
             return
+        # Admission boundary = the device-write window: land any staged
+        # prefix installs first, so a request admitted in THIS wave can
+        # already alias the just-shipped entry.
+        self.install_prefix_imports()
         with self._lock:
             admissions = []
             while self._queue and self._free:
@@ -4184,6 +4544,10 @@ class Engine:
             # scheduling slice between pop and wave start — by design;
             # admission overhead being ~0 is itself a signal.
             t_pf = time.monotonic()
+            # Per-rid prefix attribution for the request ring: which
+            # path produced this admission's leading KV rows —
+            # "local"/"fetched" entry hit, or "recomputed" prefill.
+            prefix_sources: dict[int, str] = {}
             for slot, rid, req, t_submit, plan in admissions:
                 if plan is not None:
                     # Paged: the prefix was aliased (copy-free) at plan
@@ -4202,8 +4566,13 @@ class Engine:
                             self._cache, jnp.int32(src), jnp.int32(dst)
                         )
                     start = plan["start"]
+                    prefix_sources[rid] = plan.get(
+                        "source", "recomputed"
+                    )
                 else:
-                    start = self._try_prefix_inject(slot, req)
+                    start, prefix_sources[rid] = self._try_prefix_inject(
+                        slot, req
+                    )
                 tail = req.tokens[start:]
                 # Chunked prefill (long-context admission): write the
                 # prompt's KV in prefill_chunk-sized segments so peak
@@ -4404,6 +4773,9 @@ class Engine:
                                 t_admitted=t_admitted,
                                 t_prefill=t_pf,
                                 t_first=t_first,
+                                prefix_source=prefix_sources.get(
+                                    rid, "recomputed"
+                                ),
                             ),
                         )
                         if rid in self._cancelled:

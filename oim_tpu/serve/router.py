@@ -20,6 +20,18 @@ Behavior:
   prefix prefer one rendezvous-hashed backend (whose prefix cache
   holds that prefix) unless it is overloaded — cache locality without
   hot-prefix starvation.
+- Fleet prefix residency (doc/serving.md "Fleet prefix residency"):
+  backends advertise a capped summary of their RESIDENT prefix-cache
+  entries (content digest + covered tokens) through the per-tick
+  ``/v1/info`` load refetch; token-list generate traffic then routes
+  to the backend whose digest set covers the longest prompt prefix
+  (the rendezvous affinity's load-slack guard kept — residency-AWARE,
+  not residency-blind).  On a miss where a sibling holds the
+  best-covering digest, the router ships that entry sibling→target
+  first (``GET /v1/kv?prefix=`` → ``PUT /v1/kv``) so the target
+  aliases the fetched blocks instead of recomputing the prefill;
+  every ship failure falls back to recompute — token-identical either
+  way, a ship can slow a request but never fail it.
 - Health: GET /healthz per backend on an interval; a backend is out
   after ``unhealthy_after`` consecutive failures and back on the first
   success.  A request-level connection failure counts too, so a dead
@@ -93,7 +105,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu import log
 from oim_tpu.common import events, metrics, tracing
-from oim_tpu.serve.disagg import release_kv, ship_kv
+from oim_tpu.serve.disagg import (
+    prefix_digest,
+    release_kv,
+    ship_kv,
+    ship_prefix,
+)
 from oim_tpu.serve.httptls import check_serving_peer
 
 PROXIED = (
@@ -277,6 +294,10 @@ class Router:
         disagg_prompt_tokens: int = 0,
         disagg_first_tokens: int = 1,
         disagg_ship_timeout: float = 30.0,
+        residency_aware: bool = True,
+        prefix_fetch: bool = True,
+        prefix_fetch_timeout: float = 10.0,
+        prefix_fetch_min_tokens: int = 0,
     ):
         """``ssl_context`` wraps the router's own listener in mTLS;
         ``client_ssl_context`` authenticates the router to mTLS
@@ -319,6 +340,35 @@ class Router:
             "shipped": 0, "fell_back": 0, "prefill_only": 0,
             "ship_bytes": 0, "ship_seconds": 0.0,
         }
+        # Fleet prefix residency (ISSUE 14): with residency_aware on,
+        # generate traffic with a token-list prompt routes to the
+        # backend whose resident-digest set (from the per-tick load
+        # refetch) covers the longest prompt prefix — the load-slack
+        # guard kept, so a hot prefix still cannot starve the fleet.
+        # With prefix_fetch on, a miss where a sibling holds the
+        # best-covering digest first ships that entry sibling→target
+        # (GET /v1/kv?prefix= → PUT /v1/kv); the recompute prefill is
+        # the unconditional fallback — a failed ship can slow a
+        # request, never fail it.  residency_aware False is the
+        # bench's residency-blind A/B control.
+        self.residency_aware = residency_aware
+        self.prefix_fetch = prefix_fetch
+        self.prefix_fetch_timeout = prefix_fetch_timeout
+        self.prefix_fetch_min_tokens = prefix_fetch_min_tokens
+        self._prefix_counts = {
+            "fetched": 0, "fell_back": 0, "ineligible": 0,
+            "routed_resident": 0,
+        }
+        # (digest, target id) → monotonic instant of the last failed
+        # ship: a persistently failing pair must not re-pay the fetch
+        # timeout on every request (cooldown, not a blacklist).
+        self._prefix_fetch_failed: dict[tuple, float] = {}
+        # Ships currently in flight: a concurrent cohort burst must
+        # not race N duplicate fetches of the same entry onto the same
+        # target (the install is idempotent, but the duplicate wire
+        # transfers and fetched-counter inflation are not free) — the
+        # racers forward immediately and recompute, one ship lands.
+        self._prefix_fetch_inflight: set = set()
         self._stop = threading.Event()
         self._rr = 0
         self._probing: set[str] = set()
@@ -452,16 +502,29 @@ class Router:
         exclude: set[str] = frozenset(),
         affinity_key: str | None = None,
         pool: str | None = None,
+        residency: dict | None = None,
     ) -> Backend | None:
         """Least-active healthy backend, round-robin among ties.
+
+        ``residency`` (the ``_residency_info`` result) upgrades
+        prompt-prefix affinity from pure rendezvous to
+        residency-AWARE: among backends whose advertised digest set
+        covers the longest prefix of this prompt, take the least
+        active — the prefill those digests represent is already
+        resident there, so routing anywhere else recomputes it.  The
+        same load-slack guard as rendezvous affinity applies (more
+        than ``affinity_slack`` in-flight above the fleet's least
+        active = overloaded, balance freely): a hot system prompt
+        still cannot starve the fleet.  Rendezvous stays the
+        tie-breaker and the fallback for traffic the router cannot
+        hash (text surfaces) or prefixes nobody holds yet — it is
+        what CREATES residency by steering a new prefix's cohort onto
+        one backend.
 
         ``affinity_key`` biases the choice: the key's rendezvous-hash
         winner (stable under backend churn, no shared state) is taken
         as long as it isn't overloaded — more than ``affinity_slack``
-        in-flight requests above the least-active backend.  This is how
-        per-backend prompt-prefix caches stay useful behind the router:
-        requests sharing a prefix land on the backend whose cache holds
-        it, but a hot prefix cannot starve the fleet.
+        in-flight requests above the least-active backend.
 
         ``pool`` partitions a disaggregated fleet: "prefill"/"decode"
         picks strictly within that pool (the ship path's legs); None —
@@ -484,6 +547,19 @@ class Router:
             if not ready:
                 return None
             least = min(b.active for b in ready)
+            if residency is not None:
+                coverage = residency.get("coverage") or {}
+                covered = [b for b in ready if coverage.get(b.id, 0) > 0]
+                if covered:
+                    top = max(coverage[b.id] for b in covered)
+                    resident = min(
+                        (b for b in covered if coverage[b.id] == top),
+                        key=lambda b: b.active,
+                    )
+                    if resident.active <= least + self.affinity_slack:
+                        resident.active += 1
+                        self._prefix_counts["routed_resident"] += 1
+                        return resident
             cacheable = [b for b in ready if b.prefix_cache]
             if affinity_key is not None and cacheable:
                 affine = max(
@@ -580,6 +656,95 @@ class Router:
         except Exception:
             return None
 
+    @staticmethod
+    def _prompt_tokens(path: str, body: bytes | None) -> list[int] | None:
+        """The request's token-id prompt, when it has one the router
+        can hash (residency is digest-addressed, and digests hash
+        token ids — the text/chat surfaces stay on rendezvous
+        affinity).  Any parse problem means no tokens — never an
+        error."""
+        if body is None or path not in ("/v1/generate", "/v1/completions"):
+            return None
+        try:
+            payload = json.loads(body)
+            ids = payload.get("tokens")
+            if path == "/v1/completions" and ids is None:
+                prompt = payload.get("prompt")
+                if isinstance(prompt, list):
+                    ids = prompt
+            if not isinstance(ids, list) or not ids:
+                return None
+            return [int(t) for t in ids]
+        except Exception:
+            return None
+
+    def _residency_info(self, tokens: list[int] | None) -> dict | None:
+        """Match the request's prompt against the fleet residency map
+        (every healthy backend's advertised digest summary, refreshed
+        each probe tick).  Returns None when residency routing is off
+        or nothing matches; else::
+
+            {"coverage": {backend id: covered tokens},
+             "digest": best-covering digest, "tokens": its length,
+             "holders": {ids holding it},
+             "fetchable": {holder ids whose entry is paged (blocks>0)}}
+
+        The router recomputes the digest over the prompt's leading n
+        tokens for each distinct advertised length — a per-request
+        memo keeps that to one hash per length, and the engine-side
+        summary cap bounds the lengths."""
+        if not self.residency_aware or not tokens or len(tokens) < 2:
+            return None
+        memo: dict[int, str] = {}
+
+        def dig(n: int) -> str:
+            if n not in memo:
+                memo[n] = prefix_digest(tokens[:n])
+            return memo[n]
+
+        max_n = len(tokens) - 1  # the engine needs >= 1 tail token
+        coverage: dict[str, int] = {}
+        best_digest, best_n = None, 0
+        holders: set[str] = set()
+        fetchable: set[str] = set()
+        with self._lock:
+            summaries = [
+                (b.id, list(b.load.get("prefix_digests") or ()))
+                for b in self._backends.values()
+                if b.healthy
+            ]
+        for bid, digests in summaries:
+            cov = 0
+            for entry in digests:
+                if not isinstance(entry, dict):
+                    continue
+                try:
+                    n = int(entry.get("tokens", 0))
+                    blocks = int(entry.get("blocks", 0))
+                except (TypeError, ValueError):
+                    continue
+                if n < 1 or n > max_n or entry.get("digest") != dig(n):
+                    continue
+                cov = max(cov, n)
+                if n > best_n:
+                    best_digest, best_n = entry["digest"], n
+                    holders, fetchable = set(), set()
+                if n == best_n:
+                    holders.add(bid)
+                    if blocks > 0:
+                        fetchable.add(bid)
+            if cov:
+                coverage[bid] = cov
+        if not coverage:
+            return None
+        return {
+            "coverage": coverage,
+            "digest": best_digest,
+            "tokens": best_n,
+            "holders": holders,
+            "fetchable": fetchable,
+        }
+
     def _retry_after_headers(self) -> dict:
         """Retry-After for router-level 503s: by the next health-probe
         tick a dead backend may be back (or a recovered one restored),
@@ -631,6 +796,11 @@ class Router:
         failovers = 0  # backend deaths this request survived so far
         affinity_key = self._affinity_key(path, body)
         splice = _SpliceState.plan(path, body)
+        # Fleet prefix residency (computed once per request — one
+        # digest per advertised length, memoized): routes onto the
+        # longest-covering backend and, on a miss a sibling could fix,
+        # drives the pre-forward prefix ship below.
+        residency = self._residency_info(self._prompt_tokens(path, body))
         # Track the relative x-oim-deadline-ms budget as an ABSOLUTE
         # instant here, and hand each attempt only what remains — a
         # failover must not restart the client's deadline from scratch
@@ -683,7 +853,10 @@ class Router:
                     headers,
                     **{"x-oim-deadline-ms": str(max(1, int(remaining_ms)))},
                 )
-            backend = self._pick(exclude=excluded, affinity_key=affinity_key)
+            backend = self._pick(
+                exclude=excluded, affinity_key=affinity_key,
+                residency=residency,
+            )
             if backend is None:
                 span.status = "error: no healthy backend"
                 if failovers:
@@ -716,6 +889,23 @@ class Router:
             # count says how many backends it took.
             span.attrs["backend"] = backend.id
             span.attrs["failovers"] = failovers
+            if failovers == 0 and len(excluded) == 1:
+                # First attempt only: a failover's priority is getting
+                # the request served, not optimizing its prefill.
+                self._maybe_fetch_prefix(backend, residency, deadline_abs)
+                if deadline_abs is not None:
+                    # The ship spent wall time AFTER the deadline
+                    # header was stamped above: re-stamp with what
+                    # actually remains, or the backend reads a budget
+                    # the client no longer has.
+                    remaining_ms = (
+                        deadline_abs - time.monotonic()
+                    ) * 1000.0
+                    headers = dict(headers, **{
+                        "x-oim-deadline-ms": str(
+                            max(1, int(remaining_ms))
+                        ),
+                    })
             req_body = body if splice is None else splice.request_body()
             req = urllib.request.Request(
                 backend.url + path, data=req_body, headers=headers
@@ -815,6 +1005,134 @@ class Router:
             if failovers:
                 metrics.SERVE_FAILOVERS.inc("resubmitted")
             return
+
+    # -- fleet prefix residency (serve/disagg.py, ISSUE 14) ----------------
+
+    def _maybe_fetch_prefix(
+        self, backend: Backend, residency, deadline_abs: float | None = None,
+    ) -> None:
+        """Turn a remote prefix hit into a block fetch instead of a
+        prefill recompute: when the routed ``backend`` does NOT hold
+        the request's best-covering digest but a sibling does, ship
+        that entry sibling→target before forwarding (GET /v1/kv?prefix=
+        → PUT /v1/kv).  Strictly best-effort — every failure counts,
+        journals, and falls through to the recompute prefill the
+        engine performs anyway (token-identical either way; a failed
+        ship can slow a request, never fail it).  A (digest, target)
+        pair that just failed cools down instead of re-paying the
+        timeout per request.  A request whose remaining deadline
+        budget could be eaten by the ship skips it outright: the
+        fetch exists to save time, never to spend the client's."""
+        if residency is None or not self.prefix_fetch:
+            return
+        if deadline_abs is not None and (
+            deadline_abs - time.monotonic() <= self.prefix_fetch_timeout
+        ):
+            return
+        digest, n = residency["digest"], residency["tokens"]
+        if digest is None or n < max(1, self.prefix_fetch_min_tokens):
+            return
+        if residency["coverage"].get(backend.id, 0) >= n:
+            return  # the target already holds the best cover: a hit
+        holder_ids = residency["fetchable"] - {backend.id}
+        # Target eligibility without a roundtrip: the ship installs
+        # into a paged prefix cache, both advertised via /v1/info.
+        with self._lock:
+            target_ok = backend.prefix_cache and int(
+                backend.load.get("kv_blocks_total") or 0
+            ) > 0
+            holders = [
+                b for b in self._backends.values()
+                if b.id in holder_ids and b.healthy
+            ]
+            holder = (
+                min(holders, key=lambda b: b.active) if holders else None
+            )
+        if holder is None or not target_ok:
+            with self._lock:
+                self._prefix_counts["ineligible"] += 1
+            metrics.SERVE_PREFIX_FETCH.inc("ineligible")
+            return
+        now = time.monotonic()
+        with self._lock:
+            t_failed = self._prefix_fetch_failed.get((digest, backend.id))
+            if t_failed is not None and now - t_failed < 30.0:
+                return  # cooling down; counted when it failed
+            if (digest, backend.id) in self._prefix_fetch_inflight:
+                return  # a sibling request's ship is already moving it
+            self._prefix_fetch_inflight.add((digest, backend.id))
+        t0 = time.monotonic()
+        try:
+            rows, nbytes = ship_prefix(
+                self._opener.open, holder.url, digest, backend.url,
+                timeout=self.prefix_fetch_timeout,
+            )
+        except Exception as exc:
+            code = getattr(exc, "code", None)
+            outcome = (
+                "ineligible" if code in (404, 409) else "fell_back"
+            )
+            with self._lock:
+                self._prefix_fetch_inflight.discard((digest, backend.id))
+                self._prefix_counts[outcome] += 1
+                self._prefix_fetch_failed[(digest, backend.id)] = now
+                if len(self._prefix_fetch_failed) > 1024:
+                    # Bounded: drop the stalest cooldown records.
+                    for key in sorted(
+                        self._prefix_fetch_failed,
+                        key=self._prefix_fetch_failed.get,
+                    )[:512]:
+                        self._prefix_fetch_failed.pop(key, None)
+            metrics.SERVE_PREFIX_FETCH.inc(outcome)
+            events.emit(
+                "prefix.fallback",
+                component="oim-route",
+                severity=events.WARNING,
+                reason=f"{type(exc).__name__}: {exc}",
+                digest=digest,
+                src=holder.id,
+                dst=backend.id,
+            )
+            log.current().warning(
+                "prefix fetch fell back to recompute",
+                digest=digest, src=holder.id, dst=backend.id,
+                error=str(exc),
+            )
+            return
+        dt = time.monotonic() - t0
+        metrics.SERVE_PREFIX_FETCH.inc("fetched")
+        metrics.SERVE_PREFIX_FETCH_SECONDS.observe(dt)
+        with self._lock:
+            self._prefix_fetch_inflight.discard((digest, backend.id))
+            self._prefix_counts["fetched"] += 1
+            self._prefix_fetch_failed.pop((digest, backend.id), None)
+            # Optimistic map update so the cohort's next request reads
+            # the target as covered NOW, not at the next probe tick
+            # (the tick's refetch replaces this with the engine's own
+            # summary; blocks>0 = fetchable onward).
+            summary = list(backend.load.get("prefix_digests") or ())
+            if not any(
+                isinstance(e, dict) and e.get("digest") == digest
+                for e in summary
+            ):
+                summary.append({
+                    "digest": digest, "tokens": n,
+                    "blocks": 1, "age_s": 0.0, "hits": 0,
+                    "origin": "fetched",
+                })
+                backend.load = dict(
+                    backend.load, prefix_digests=summary
+                )
+        events.emit(
+            "prefix.fetch",
+            component="oim-route",
+            digest=digest,
+            src=holder.id,
+            dst=backend.id,
+            rows=rows,
+            bytes=nbytes,
+            ms=round(dt * 1000.0, 1),
+        )
 
     # -- disaggregated prefill/decode (serve/disagg.py) --------------------
 
@@ -1320,6 +1638,22 @@ class Router:
             if isinstance(load, dict):
                 backend.load = load
             backend.info_fetched = True
+            # Residency-map size gauge: distinct digests across the
+            # fleet's advertised summaries, refreshed with the load
+            # that feeds the map itself.
+            metrics.ROUTE_RESIDENCY_DIGESTS.set(
+                float(len(self._residency_digests_locked()))
+            )
+
+    def _residency_digests_locked(self) -> set:
+        """Distinct advertised prefix digests fleet-wide (lock held) —
+        the residency map's size, for the gauge and /v1/stats."""
+        digests: set[str] = set()
+        for b in self._backends.values():
+            for entry in b.load.get("prefix_digests") or ():
+                if isinstance(entry, dict) and entry.get("digest"):
+                    digests.add(entry["digest"])
+        return digests
 
     def _health_loop(self) -> None:
         while not self._stop.wait(self.health_interval):
@@ -1578,6 +1912,27 @@ class Router:
                     )},
                     "ship_seconds": round(
                         self._disagg["ship_seconds"], 4
+                    ),
+                },
+                # Fleet prefix residency (ISSUE 14): the residency
+                # map's size, the router's ship outcomes, how many
+                # requests routed onto a resident backend, and the
+                # FLEET prefix-hit rate (per-backend engine counters
+                # summed from the load snapshots) — `oimctl top`'s PFX
+                # summary and the staleness incident queries read this.
+                "prefix": {
+                    "residency_digests": len(
+                        self._residency_digests_locked()
+                    ),
+                    "residency_aware": self.residency_aware,
+                    **dict(self._prefix_counts),
+                    "fleet_hits": sum(
+                        int(b.load.get("prefix_hits") or 0)
+                        for b in self._backends.values()
+                    ),
+                    "fleet_misses": sum(
+                        int(b.load.get("prefix_misses") or 0)
+                        for b in self._backends.values()
                     ),
                 },
             }

@@ -77,11 +77,21 @@ API:
                     nothing is held for that rid, 409 on a dense
                     (non-paged) engine — the router falls back to
                     splice recompute on either.
-  PUT  /v1/kv        ← stage a shipped KV state for a continuation
-                    request's ``kv_import``: geometry-validated
-                    against this engine (409 on mismatch), block
-                    reservation all-or-nothing (429 + Retry-After on
-                    pool exhaustion — capacity backpressure).
+  GET  /v1/kv?prefix=D → streamed export of the resident prefix-cache
+                    entry whose content digest is D (fleet prefix
+                    residency, doc/serving.md): the entry's
+                    block-aligned KV in the same framing, installable
+                    on a sibling without recomputing the prefill.
+                    404 on an unknown digest, 409 on dense/kv4 —
+                    the fetcher's recompute path is the fallback.
+  PUT  /v1/kv        ← stage a shipped KV state: a request-hold
+                    transfer becomes a continuation ``kv_import``; a
+                    prefix transfer installs a refcounted
+                    prefix-cache entry (idempotent when already
+                    resident).  Both geometry-validated against this
+                    engine (409 on mismatch), block reservation
+                    all-or-nothing (429 + Retry-After on pool
+                    exhaustion — capacity backpressure).
   DELETE /v1/kv?rid=N|import=N → release a KV hold / staged import
                     (the router's post-ship cleanup; the TTL sweep is
                     the backstop when the orchestrator died mid-ship)
@@ -1195,13 +1205,14 @@ class ServeServer:
         return dict(self.engine.load(), pool=self.pool)
 
     def _stream_kv(self, handler) -> None:
-        """Stream one held request's KV state (``GET /v1/kv?rid=N``,
+        """Stream one held request's KV state (``GET /v1/kv?rid=N``) or
+        one resident prefix entry (``GET /v1/kv?prefix=<digest>``,
         serve/disagg.py): the /v1/weights framing — 8-byte big-endian
         manifest length, JSON manifest, raw leaves in manifest order —
         applied to paged-KV blocks.  Refused 503 while the error latch
         stands (the weights rule: no device reads against a wedged
         chip), 404/409 when there is nothing eligible to export (the
-        router falls back to splice recompute)."""
+        router falls back to splice/prefill recompute)."""
         import struct
         from urllib.parse import parse_qs
 
@@ -1213,15 +1224,26 @@ class ServeServer:
             )
             return
         params = parse_qs(handler.path.partition("?")[2])
+        prefix = (params.get("prefix") or [""])[0]
+        if not prefix:
+            try:
+                rid = int(params["rid"][0])
+            except (KeyError, ValueError):
+                handler._json(
+                    400,
+                    {"error": "need ?rid=<request id> or "
+                              "?prefix=<digest>"},
+                )
+                return
         try:
-            rid = int(params["rid"][0])
-        except (KeyError, ValueError):
-            handler._json(400, {"error": "need ?rid=<request id>"})
-            return
-        try:
-            manifest, arrays = self.engine.export_kv(rid)
+            if prefix:
+                manifest, arrays = self.engine.export_kv_prefix(prefix)
+            else:
+                manifest, arrays = self.engine.export_kv(rid)
         except disagg.KvIneligibleError as exc:
-            code = 404 if "no held KV" in str(exc) else 409
+            code = 404 if (
+                "no held KV" in str(exc) or "no resident prefix" in str(exc)
+            ) else 409
             handler._json(code, {"error": str(exc)})
             return
         manifest_bytes = json.dumps(
@@ -1251,12 +1273,21 @@ class ServeServer:
         transfer, geometry-validate, reserve pool blocks — answering
         409 on mismatch (never coerce) and 429 + Retry-After on block
         exhaustion (capacity backpressure, the admission planner's
-        stance).  Replies {"import_id", "rows"} for the continuation's
-        ``kv_import`` field."""
+        stance).  A request-hold transfer replies {"import_id",
+        "rows"} for the continuation's ``kv_import`` field; a PREFIX
+        transfer (manifest carries "prefix", ISSUE 14) installs a
+        refcounted prefix-cache entry instead and replies {"prefix",
+        "rows"} (rows 0 = already resident, idempotent)."""
         try:
             length = int(handler.headers.get("Content-Length", "0"))
             body = handler.rfile.read(length)
             manifest, data = disagg.unpack_transfer(body)
+            if manifest.get("prefix"):
+                digest, rows = self.engine.import_kv_prefix(
+                    manifest, data
+                )
+                handler._json(200, {"prefix": digest, "rows": rows})
+                return
             import_id, rows = self.engine.import_kv(manifest, data)
         except disagg.KvCapacityError as exc:
             handler._json(429, {"error": str(exc)}, handler._retry_after())

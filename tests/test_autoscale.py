@@ -211,6 +211,15 @@ class TestLoadSchema:
             "kv_exports": 5,
             "kv_imports": 2,
             "kv_ship_bytes": 4096,
+            # Fleet prefix residency (ISSUE 14): the capped resident-
+            # digest summary + the hit/miss counters the router's
+            # fleet prefix-hit rate sums.
+            "prefix_digests": [
+                {"digest": "ab12", "tokens": 128, "blocks": 2,
+                 "age_s": 1.5, "hits": 3, "origin": "local"},
+            ],
+            "prefix_hits": 3,
+            "prefix_misses": 1,
             "token_rate": 41.5,
             "shed_queue_full": 1,
             "shed_deadline": 0,

@@ -535,3 +535,59 @@ def test_serve_disagg_instruments_render():
     assert "# TYPE oim_serve_kv_ship_seconds histogram" in text
     assert "oim_serve_kv_ship_seconds_bucket" in text
     assert "oim_serve_kv_ship_seconds_count" in text
+
+
+def test_prefix_residency_instruments_render():
+    """The fleet prefix-residency instruments (ISSUE 14: ship latency,
+    fetch outcomes, residency-map size, the source-labeled bytes-saved
+    split) are shared definitions in oim_tpu/common/metrics.py and
+    render in standard exposition text."""
+    before = {
+        "fetched": metrics.SERVE_PREFIX_FETCH.value("fetched"),
+        "fell_back": metrics.SERVE_PREFIX_FETCH.value("fell_back"),
+        "ineligible": metrics.SERVE_PREFIX_FETCH.value("ineligible"),
+        "fetches": metrics.SERVE_PREFIX_FETCH_SECONDS.count(),
+    }
+    metrics.SERVE_PREFIX_FETCH.inc("fetched")
+    metrics.SERVE_PREFIX_FETCH.inc("fell_back")
+    metrics.SERVE_PREFIX_FETCH.inc("ineligible")
+    metrics.SERVE_PREFIX_FETCH_SECONDS.observe(0.02)
+    metrics.ROUTE_RESIDENCY_DIGESTS.set(3.0)
+    # The savings split: alias (local entry) vs fetched (installed
+    # from a sibling's export) must be distinct series — the ISSUE 14
+    # accounting-gap fix.
+    metrics.SERVE_PREFIX_BYTES_SAVED.inc("e0", "alias", by=1024.0)
+    metrics.SERVE_PREFIX_BYTES_SAVED.inc("e0", "fetched", by=2048.0)
+    assert (
+        metrics.SERVE_PREFIX_FETCH.value("fetched")
+        == before["fetched"] + 1
+    )
+    assert (
+        metrics.SERVE_PREFIX_FETCH.value("fell_back")
+        == before["fell_back"] + 1
+    )
+    assert (
+        metrics.SERVE_PREFIX_FETCH.value("ineligible")
+        == before["ineligible"] + 1
+    )
+    assert (
+        metrics.SERVE_PREFIX_FETCH_SECONDS.count()
+        == before["fetches"] + 1
+    )
+    text = metrics.registry().render()
+    assert "# TYPE oim_serve_prefix_fetch_total counter" in text
+    assert 'oim_serve_prefix_fetch_total{outcome="fetched"}' in text
+    assert 'oim_serve_prefix_fetch_total{outcome="fell_back"}' in text
+    assert 'oim_serve_prefix_fetch_total{outcome="ineligible"}' in text
+    assert "# TYPE oim_serve_prefix_fetch_seconds histogram" in text
+    assert "oim_serve_prefix_fetch_seconds_bucket" in text
+    assert "# TYPE oim_route_residency_digests gauge" in text
+    assert "oim_route_residency_digests 3" in text
+    assert (
+        'oim_serve_prefix_bytes_saved_total{engine="e0",source="alias"}'
+        in text
+    )
+    assert (
+        'oim_serve_prefix_bytes_saved_total{engine="e0",'
+        'source="fetched"}' in text
+    )

@@ -1,0 +1,761 @@
+"""Fleet prefix residency (ISSUE 14): digests must be stable, ships
+exact, fallbacks leak-free, and routing residency-AWARE.
+
+The load-bearing properties:
+
+- **Token-identical via a fetched prefix.**  A request served by
+  aliasing a prefix entry INSTALLED from a sibling's export emits
+  exactly the tokens the same request emits via local recompute —
+  greedy, sampled, and speculative, fp and kv_int8, pipeline depth
+  {1, 2} — because the shipped blocks are bit-identical to what the
+  target would have prefilled (same checkpoint) and aliasing is the
+  PR 10 copy-free path either way.  kv4 pools cleanly refuse
+  (recompute fallback), dense pools too.
+- **Zero leaked blocks on every failure.**  A fetch killed mid-body
+  (chaos), a capacity refusal, a staged-but-never-installed import —
+  the source's entry stays exactly its own refs, the target stages
+  nothing or TTL-expires it.
+- **Residency-aware routing.**  The router routes a prompt onto the
+  backend whose advertised digest set covers its longest prefix
+  (load-slack guard kept), and on a miss ships sibling→target before
+  forwarding — the recompute path unconditionally underneath.
+- **Pre-warm never blocks bring-up.**  A replica pre-warms its
+  donor's top-K hottest digests before traffic; a dead donor degrades
+  to normal (cold) bring-up.
+- **Zero steady-state compiles.**  A warm engine takes a prefix
+  import + install + hit without a single new XLA compile (the
+  warmup-precompiled ingest program, the jit-guard stance).
+
+Engines are shared per config where possible (the test-serve
+compile-budget discipline); this file backs ``make test-serve-prefix``
+(120 s cap).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import numpy as np
+import pytest
+
+from helpers import wait_for
+from test_jit_guard import compile_delta
+
+from oim_tpu.autoscale import decode_load, encode_load
+from oim_tpu.autoscale.launcher import InProcessLauncher
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.serve import Engine, GenRequest, Router
+from oim_tpu.serve import disagg
+from oim_tpu.serve.server import ServeServer
+
+pytestmark = pytest.mark.serve_prefix
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _engine(setup, **kw):
+    cfg, params = setup
+    args = dict(n_slots=2, max_len=64, chunk=4, prompt_buckets=(16, 32),
+                kv_block=8, prefix_cache_size=4)
+    args.update(kw)
+    return Engine(params, cfg, **args)
+
+
+def _prompt(seed: int, n: int) -> list[int]:
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG["vocab_size"], size=n).tolist()
+
+
+def _store(engine, tokens, served=False) -> str:
+    """Run one cache_prefix request; returns the stored entry's
+    digest.  ``served=True`` = a started ServeServer's driver thread
+    owns step() — the test must only WAIT, never drive (two drivers
+    race the donated cache)."""
+    rid = engine.submit(GenRequest(
+        tokens=tokens, max_new_tokens=2, cache_prefix=True,
+    ))
+    if served:
+        engine.result(rid, timeout=30)
+    else:
+        engine.run()
+        engine.result(rid, timeout=0)
+    summary = engine.prefix_digest_summary()
+    digest = disagg.prefix_digest(tokens)
+    assert any(e["digest"] == digest for e in summary)
+    return digest
+
+
+def _served_gen(engine, tokens, max_new=2) -> list:
+    """One request through a SERVER-driven engine (wait, don't step)."""
+    rid = engine.submit(GenRequest(tokens=tokens, max_new_tokens=max_new))
+    return engine.result(rid, timeout=30)
+
+
+def _transfer(engine, digest) -> bytes:
+    return disagg.pack_transfer(*engine.export_kv_prefix(digest))
+
+
+def _url(server) -> str:
+    return f"http://{server.host}:{server.port}"
+
+
+def _gen(base: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        base + "/v1/generate", json.dumps(payload).encode(),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# Digests + summary
+
+
+def test_digest_stable_and_summary_shape(setup):
+    """The digest is a pure function of the covered token ids — two
+    engines storing the same prompt advertise the SAME identity (the
+    whole point: fleet-wide matching with zero coordination)."""
+    a, b = _engine(setup), _engine(setup)
+    sys_prompt = _prompt(1, 24)
+    da, db = _store(a, sys_prompt), _store(b, sys_prompt)
+    assert da == db
+    entry = a.prefix_digest_summary()[0]
+    # Paged entries are block-aligned: 24 tokens at block 8 = 3 blocks.
+    assert entry["tokens"] == 24 and entry["blocks"] == 3
+    assert entry["origin"] == "local" and entry["hits"] == 0
+    assert da == disagg.prefix_digest(sys_prompt)
+    # Dense entries advertise blocks=0: routable but not fetchable.
+    cfg, params = setup
+    dense = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                   prompt_buckets=(16, 32), prefix_cache_size=4)
+    _store(dense, sys_prompt)
+    assert dense.prefix_digest_summary()[0]["blocks"] == 0
+
+
+def test_summary_capped_by_hotness(setup, monkeypatch):
+    """The load()/stats() summary truncates to the cap, hottest
+    (most-recently-hit) first — the leased registry value must stay
+    small however large the cache grows."""
+    import oim_tpu.serve.engine as engine_mod
+
+    engine = _engine(setup, kv_blocks=64)
+    prompts = [_prompt(10 + i, 16) for i in range(3)]
+    digests = [_store(engine, p) for p in prompts]
+    # Hit the OLDEST entry so hotness order diverges from store order.
+    rid = engine.submit(GenRequest(
+        tokens=prompts[0] + _prompt(99, 4), max_new_tokens=2,
+    ))
+    engine.run()
+    engine.result(rid, timeout=0)
+    monkeypatch.setattr(engine_mod, "PREFIX_DIGEST_CAP", 2)
+    load = engine.load()
+    assert len(load["prefix_digests"]) == 2  # cap asserted
+    assert load["prefix_digests"][0]["digest"] == digests[0]  # hottest
+    assert load["prefix_digests"][0]["hits"] == 1
+    # Full stats() view honors the same cap.
+    assert len(engine.stats()["prefix_digests"]) == 2
+
+
+def test_load_schema_tolerant_decode_old_publishers():
+    """A pre-ISSUE-14 publisher's value (no digest summary) must still
+    decode, with the new fields defaulted — schema upgrades never
+    break a mixed-version fleet."""
+    old = json.dumps({
+        "queue_depth": 1, "active_slots": 2, "total_slots": 8,
+        "token_rate": 10.0, "ts": 1.0,
+    })
+    decoded = decode_load(old)
+    assert decoded is not None
+    assert decoded["prefix_digests"] == []
+    assert decoded["prefix_hits"] == 0 and decoded["prefix_misses"] == 0
+    # And the new summary round-trips through encode/decode.
+    snap = {"prefix_digests": [
+        {"digest": "ab", "tokens": 16, "blocks": 2, "age_s": 0.1,
+         "hits": 1, "origin": "fetched"},
+    ], "prefix_hits": 4, "prefix_misses": 2}
+    out = decode_load(encode_load(snap))
+    assert out["prefix_digests"] == snap["prefix_digests"]
+    assert out["prefix_hits"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Export / import exactness matrix
+
+
+@pytest.mark.parametrize("kv_int8", [False, True], ids=["fp", "kv8"])
+@pytest.mark.parametrize("spec", [0, 2], ids=["plain", "spec"])
+def test_fetched_prefix_token_identical_matrix(setup, kv_int8, spec):
+    """THE exactness pin: a request served by aliasing a FETCHED
+    prefix entry equals the same request via local recompute — greedy
+    AND sampled, across pipeline depth {1, 2}, for fp/kv_int8 and
+    plain/speculative decoding.  The oracle is the same engine with
+    its cache cleared (recompute prefill), so the comparison isolates
+    exactly the fetched-install path."""
+    donor = _engine(setup, kv_int8=kv_int8)
+    target = _engine(setup, kv_int8=kv_int8, spec_decode=spec)
+    sys_prompt = _prompt(2, 24)
+    digest = _store(donor, sys_prompt)
+    body = _transfer(donor, digest)
+
+    def serve(prompt, sampled, install):
+        if install:
+            d, rows = target.import_kv_prefix(
+                *disagg.unpack_transfer(body)
+            )
+            assert (d, rows) == (digest, 24)
+        kw = dict(tokens=prompt, max_new_tokens=8)
+        if sampled:
+            kw.update(temperature=0.8, seed=7)
+        rid = target.submit(GenRequest(**kw))
+        out = target.run()[rid]
+        target.result(rid, timeout=0)
+        return out
+
+    for depth in (1, 2):
+        target.set_pipeline_depth(depth)
+        for sampled in (False, True):
+            prompt = sys_prompt + _prompt(50 + depth, 5)
+            fetched = serve(prompt, sampled, install=True)
+            assert (
+                target.requests()["requests"][-1]["prefix"] == "fetched"
+            )
+            with target._lock:
+                target._clear_prefix_cache_locked()
+            recomputed = serve(prompt, sampled, install=False)
+            assert (
+                target.requests()["requests"][-1]["prefix"]
+                == "recomputed"
+            )
+            assert fetched == recomputed, (depth, sampled)
+            with target._lock:
+                target._clear_prefix_cache_locked()
+    # Zero leaks once everything clears.
+    assert target.stats()["kv_blocks_used"] == 0
+
+
+def test_kv4_dense_capacity_and_geometry_refusals(setup):
+    """The ship-refusal taxonomy holds for prefix transfers: kv4 pools
+    refuse both directions, dense engines refuse, a full pool answers
+    capacity backpressure (nothing staged), a torn digest refuses at
+    the manifest, and a prefix-cache-less target refuses ingest."""
+    cfg, params = setup
+    donor = _engine(setup)
+    digest = _store(donor, _prompt(3, 24))
+    manifest, arrays = donor.export_kv_prefix(digest)
+    body = disagg.pack_transfer(manifest, arrays)
+
+    kv4 = _engine(setup, kv_int4=True)
+    with pytest.raises(disagg.KvIneligibleError, match="kv_int4"):
+        kv4.export_kv_prefix(digest)
+    with pytest.raises(disagg.KvIneligibleError, match="kv_int4"):
+        kv4.import_kv_prefix(*disagg.unpack_transfer(body))
+
+    dense = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                   prompt_buckets=(16, 32), prefix_cache_size=4)
+    with pytest.raises(disagg.KvIneligibleError, match="paged"):
+        dense.export_kv_prefix(digest)
+    with pytest.raises(disagg.KvIneligibleError, match="paged"):
+        dense.import_kv_prefix(*disagg.unpack_transfer(body))
+
+    no_cache = _engine(setup, prefix_cache_size=0)
+    with pytest.raises(disagg.KvIneligibleError, match="prefix cache"):
+        no_cache.import_kv_prefix(*disagg.unpack_transfer(body))
+
+    tiny = _engine(setup, kv_blocks=2)
+    used_before = tiny.stats()["kv_blocks_used"]
+    with pytest.raises(disagg.KvCapacityError, match="fall back"):
+        tiny.import_kv_prefix(*disagg.unpack_transfer(body))
+    assert tiny.stats()["kv_blocks_used"] == used_before  # nothing staged
+
+    # A manifest whose digest does not hash its own token record is
+    # torn/forged: refused at validate_geometry, before any staging.
+    bad = dict(manifest, prefix="0" * 16)
+    with pytest.raises(disagg.KvGeometryError, match="digest"):
+        disagg.validate_geometry(bad, donor.kv_geometry())
+    # A prefix manifest smuggling an emitted-token record would pin
+    # more rows than its digest hashes (the digest covers
+    # prompt_tokens only) — refused outright (review finding).
+    smuggled = dict(
+        manifest,
+        prompt_tokens=manifest["prompt_tokens"][:-1],
+        tokens=[manifest["prompt_tokens"][-1]],
+    )
+    with pytest.raises(disagg.KvGeometryError, match="emitted"):
+        disagg.validate_geometry(smuggled, donor.kv_geometry())
+
+    # Unknown digest: ineligible (404 at the HTTP layer), not an error.
+    with pytest.raises(disagg.KvIneligibleError, match="no resident"):
+        donor.export_kv_prefix("f" * 16)
+
+
+def test_staged_install_ttl_releases_blocks(setup, monkeypatch):
+    """A staged prefix import whose orchestrator died (install never
+    ran) returns its blocks at the TTL — zero leaks."""
+    donor, target = _engine(setup), _engine(setup)
+    digest = _store(donor, _prompt(4, 24))
+    body = _transfer(donor, digest)
+    target.import_kv_prefix(*disagg.unpack_transfer(body))
+    assert target.stats()["prefix_installs_staged"] == 1
+    staged_blocks = target.stats()["kv_blocks_used"]
+    assert staged_blocks == 3
+    monkeypatch.setattr(
+        "oim_tpu.serve.engine.PREFIX_IMPORT_TTL_S", 0.0
+    )
+    with target._lock:
+        target._sweep_prefix_installs_locked(time.monotonic() + 1.0)
+    assert target.stats()["prefix_installs_staged"] == 0
+    assert target.stats()["kv_blocks_used"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Router: residency-aware routing + the fetch path
+
+
+def _router(*urls, **kw):
+    kw.setdefault("health_interval", 60.0)  # tests probe explicitly
+    router = Router(backends=urls, **kw).start()
+    _reprobe(router)
+    return router
+
+
+def _reprobe(router):
+    for b in list(router._backends.values()):
+        router._probe(b)
+
+
+@pytest.fixture()
+def pair(setup):
+    servers = [ServeServer(_engine(setup)).start() for _ in range(2)]
+    yield servers
+    for s in servers:
+        s.stop()
+
+
+def test_residency_aware_routing_and_fetch(setup, pair):
+    """The routing decision order end-to-end: (1) a resident backend
+    wins the pick (load-slack guard allowing); (2) when it is
+    overloaded, the router ships the entry to the spillover target
+    BEFORE forwarding, and the request is served token-identically by
+    the fetched entry."""
+    sa, sb = pair
+    sys_prompt = _prompt(5, 24)
+    _store(sa.engine, sys_prompt, served=True)
+    router = _router(_url(sa), _url(sb))
+    try:
+        assert router.stats()["prefix"]["residency_digests"] == 1
+        base = f"http://{router.host}:{router.port}"
+        prompt = sys_prompt + _prompt(51, 5)
+        out1 = _gen(base, {"tokens": prompt, "max_new_tokens": 6})
+        # Routed onto the resident backend: a local hit, no fetch.
+        assert sa.engine.stats()["prefix_hits"] == 1
+        assert router.stats()["prefix"]["routed_resident"] == 1
+        assert router.stats()["prefix"]["fetched"] == 0
+        # Overload the resident winner past the slack guard: the pick
+        # spills to B, and the miss becomes a fetch, not a recompute.
+        with router._lock:
+            next(
+                b for b in router._backends.values()
+                if b.url == _url(sa)
+            ).active = 10
+        out2 = _gen(base, {"tokens": prompt, "max_new_tokens": 6})
+        assert out2["tokens"] == out1["tokens"]
+        assert router.stats()["prefix"]["fetched"] == 1
+        assert sb.engine.stats()["prefix_fetch_installs"] == 1
+        assert sb.engine.stats()["prefix_hits"] == 1
+        assert wait_for(  # finalize lands a hair after the response
+            lambda: bool(sb.engine.requests()["requests"])
+            and sb.engine.requests()["requests"][-1]["prefix"]
+            == "fetched"
+        )
+        # Fleet-rate surfaces after the next probe tick.
+        _reprobe(router)
+        prefix = router.stats()["prefix"]
+        assert prefix["fleet_hits"] == 2
+        assert prefix["residency_digests"] == 1  # same digest, 2 holders
+    finally:
+        router.stop()
+
+
+def test_residency_blind_control_never_fetches(setup, pair):
+    """The bench's A/B control: residency_aware=False reverts to
+    rendezvous-only affinity — same tokens, zero residency routing,
+    zero ships."""
+    sa, sb = pair
+    sys_prompt = _prompt(6, 24)
+    _store(sa.engine, sys_prompt, served=True)
+    router = _router(_url(sa), _url(sb), residency_aware=False,
+                     prefix_fetch=False)
+    try:
+        base = f"http://{router.host}:{router.port}"
+        prompt = sys_prompt + _prompt(52, 5)
+        _gen(base, {"tokens": prompt, "max_new_tokens": 6})
+        prefix = router.stats()["prefix"]
+        assert prefix["routed_resident"] == 0
+        assert prefix["fetched"] == 0
+    finally:
+        router.stop()
+
+
+class _TruncatingPrefixProxy:
+    """Chaos: sever GET /v1/kv?prefix= responses at half their
+    declared length — the killed-mid-fetch signature.  Everything
+    else forwards verbatim."""
+
+    def __init__(self, target_url: str):
+        self.target = target_url.rstrip("/")
+        self.kills = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *args):
+                pass
+
+            def _forward(self, method, body=None):
+                req = urllib.request.Request(
+                    outer.target + self.path, data=body, method=method,
+                    headers={
+                        k: v for k, v in self.headers.items()
+                        if k.lower() not in ("host", "content-length")
+                    },
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        payload, status = resp.read(), resp.status
+                        ctype = resp.headers.get("Content-Type", "")
+                except urllib.error.HTTPError as exc:
+                    payload, status = exc.read(), exc.code
+                    ctype = exc.headers.get("Content-Type", "")
+                truncate = (
+                    method == "GET"
+                    and self.path.startswith("/v1/kv?prefix=")
+                    and status == 200
+                )
+                self.send_response(status)
+                if ctype:
+                    self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                if truncate:
+                    outer.kills += 1
+                    self.wfile.write(payload[: len(payload) // 2])
+                    self.wfile.flush()
+                    self.connection.close()
+                    return
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._forward("GET")
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                self._forward("POST", self.rfile.read(length))
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                self._forward("PUT", self.rfile.read(length))
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def test_fetch_killed_midway_recomputes_zero_leaks(setup, pair):
+    """Chaos kill mid-fetch: the prefix GET dies at half its bytes —
+    the router detects the short read, counts fell_back, and the
+    request recomputes token-identically; ZERO leaked blocks on both
+    sides (the source entry keeps exactly its own refs, the target
+    staged nothing)."""
+    sa, sb = pair
+    sys_prompt = _prompt(8, 24)
+    _store(sa.engine, sys_prompt, served=True)
+    oracle = _engine(setup)
+    prompt = sys_prompt + _prompt(53, 5)
+    orid = oracle.submit(GenRequest(tokens=prompt, max_new_tokens=6))
+    expect = oracle.run()[orid]
+    proxy = _TruncatingPrefixProxy(_url(sa))
+    router = _router(proxy.url, _url(sb))
+    try:
+        base = f"http://{router.host}:{router.port}"
+        with router._lock:
+            next(
+                b for b in router._backends.values()
+                if b.url == proxy.url
+            ).active = 10
+        out = _gen(base, {"tokens": prompt, "max_new_tokens": 6})
+        assert out["tokens"] == expect
+        assert proxy.kills == 1
+        prefix = router.stats()["prefix"]
+        assert prefix["fell_back"] == 1 and prefix["fetched"] == 0
+        assert wait_for(  # finalize lands a hair after the response
+            lambda: bool(sb.engine.requests()["requests"])
+            and sb.engine.requests()["requests"][-1]["prefix"]
+            == "recomputed"
+        )
+        # Source: exactly the entry's own blocks (the gather pin was
+        # released); target: nothing staged, nothing resident.
+        assert wait_for(
+            lambda: sa.engine.stats()["kv_blocks_used"] == 3
+        )
+        assert wait_for(
+            lambda: sb.engine.stats()["kv_blocks_used"] == 0
+        )
+        assert sb.engine.stats()["prefix_installs_staged"] == 0
+        # The failed (digest, target) pair cools down: the next
+        # request does not re-pay the fetch.
+        _gen(base, {"tokens": prompt, "max_new_tokens": 6})
+        assert router.stats()["prefix"]["fell_back"] == 1
+        assert proxy.kills == 1
+    finally:
+        router.stop()
+        proxy.stop()
+
+
+def test_fetch_skipped_when_deadline_cannot_afford_it(setup, pair):
+    """A request whose remaining x-oim-deadline-ms budget could be
+    eaten by the ship must skip the fetch and recompute (review
+    finding: the fetch exists to save time, never to spend the
+    client's) — and the deadline the backend receives reflects the
+    wall time actually left."""
+    sa, sb = pair
+    sys_prompt = _prompt(7, 24)
+    _store(sa.engine, sys_prompt, served=True)
+    router = _router(_url(sa), _url(sb), prefix_fetch_timeout=10.0)
+    try:
+        base = f"http://{router.host}:{router.port}"
+        with router._lock:
+            next(
+                b for b in router._backends.values()
+                if b.url == _url(sa)
+            ).active = 10
+        req = urllib.request.Request(
+            base + "/v1/generate",
+            json.dumps({
+                "tokens": sys_prompt + _prompt(55, 5),
+                "max_new_tokens": 4,
+            }).encode(),
+            {
+                "Content-Type": "application/json",
+                # 5s budget < the 10s fetch timeout: shipping could
+                # eat the client's whole deadline.
+                "x-oim-deadline-ms": "5000",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = json.loads(resp.read())
+        assert len(out["tokens"]) == 4
+        prefix = router.stats()["prefix"]
+        assert prefix["fetched"] == 0 and prefix["fell_back"] == 0
+        assert sb.engine.stats()["prefix_fetch_installs"] == 0
+    finally:
+        router.stop()
+
+
+def test_ineligible_counted_without_roundtrip(setup):
+    """A dense holder (blocks=0 in its summary) is routable but not
+    fetchable: a spillover miss counts ineligible WITHOUT a wasted
+    ship roundtrip, and the request recomputes."""
+    cfg, params = setup
+    dense = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                   prompt_buckets=(16, 32), prefix_cache_size=4)
+    sa = ServeServer(dense).start()
+    sb_engine = _engine(setup)
+    sb = ServeServer(sb_engine).start()
+    sys_prompt = _prompt(9, 24)
+    _store(dense, sys_prompt, served=True)
+    router = _router(_url(sa), _url(sb))
+    try:
+        base = f"http://{router.host}:{router.port}"
+        with router._lock:
+            next(
+                b for b in router._backends.values()
+                if b.url == _url(sa)
+            ).active = 10
+        prompt = sys_prompt + _prompt(54, 5)
+        out = _gen(base, {"tokens": prompt, "max_new_tokens": 6})
+        assert out["tokens"]
+        prefix = router.stats()["prefix"]
+        assert prefix["ineligible"] == 1 and prefix["fetched"] == 0
+        assert sb_engine.stats()["prefix_fetch_installs"] == 0
+    finally:
+        router.stop()
+        sa.stop()
+        sb.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+
+
+def test_http_prefix_export_import_surface(setup, pair):
+    """GET /v1/kv?prefix= and the PUT prefix branch speak the wire
+    protocol end-to-end: 404 on unknown digests, 409 on geometry,
+    {"prefix", "rows"} on success, rows 0 on re-ship (idempotent)."""
+    sa, sb = pair
+    digest = _store(sa.engine, _prompt(11, 24), served=True)
+    with urllib.request.urlopen(
+        _url(sa) + f"/v1/kv?prefix={digest}", timeout=30
+    ) as resp:
+        body = resp.read()
+    manifest, _ = disagg.unpack_transfer(body)
+    assert manifest["prefix"] == digest and manifest["rows"] == 24
+
+    def put(target, data):
+        req = urllib.request.Request(
+            target + "/v1/kv", data=data,
+            headers={"Content-Type": "application/octet-stream"},
+            method="PUT",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    reply = put(_url(sb), body)
+    assert reply == {"prefix": digest, "rows": 24}
+    assert wait_for(
+        lambda: sb.engine.stats()["prefix_fetch_installs"] == 1
+    )
+    # Idempotent re-ship.
+    assert put(_url(sb), body)["rows"] == 0
+    # Unknown digest: 404 (the fetcher's recompute fallback signal).
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(
+            _url(sa) + "/v1/kv?prefix=" + "0" * 16, timeout=30
+        )
+    assert exc_info.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# Pre-warm (the --params-peer prefix leg)
+
+
+def test_prewarm_installs_donor_top_k_before_traffic(setup):
+    """The bring-up sim: a scale-out replica launched with a pre-warm
+    factory comes up with its donor's top-K hottest digests RESIDENT
+    before receiving any traffic, and its first cohort request hits
+    (prefix=fetched) token-identically."""
+    donor_engine = _engine(setup, kv_blocks=64, prefix_cache_size=8)
+    donor = ServeServer(donor_engine).start()
+    prompts = [_prompt(20 + i, 16) for i in range(3)]
+    digests = [_store(donor_engine, p, served=True) for p in prompts]
+    # Heat the LAST two entries so "top-K hottest" is a real ordering.
+    for p in prompts[1:]:
+        _served_gen(donor_engine, p + _prompt(98, 4))
+
+    launched = {}
+
+    def factory(replica_id, placement):
+        engine = _engine(setup, kv_blocks=64, prefix_cache_size=8)
+        installed = disagg.prewarm_from_peer(
+            engine, _url(donor), top_k=2
+        )
+        server = ServeServer(engine).start()
+        launched[replica_id] = (engine, server, installed)
+        return server
+
+    launcher = InProcessLauncher(factory)
+    try:
+        launcher.launch("asr-0", {})
+        engine, server, installed = launched["asr-0"]
+        assert installed == 2
+        resident = {
+            e["digest"] for e in engine.prefix_digest_summary()
+        }
+        assert resident == set(digests[1:])  # the two hottest
+        assert all(
+            e["origin"] == "fetched"
+            for e in engine.prefix_digest_summary()
+        )
+        # First traffic hits the pre-warmed entry, token-identically.
+        prompt = prompts[1] + _prompt(97, 5)
+        expect = _served_gen(donor_engine, prompt, max_new=6)
+        out = _gen(_url(server), {"tokens": prompt, "max_new_tokens": 6})
+        assert out["tokens"] == expect
+        # The ring entry lands on the driver thread's finalize, a
+        # hair after the HTTP response: wait, don't race it.
+        assert wait_for(
+            lambda: bool(engine.requests()["requests"])
+            and engine.requests()["requests"][-1]["prefix"] == "fetched"
+        )
+    finally:
+        launcher.close()
+        donor.stop()
+
+
+def test_prewarm_failure_degrades_to_cold_bringup(setup):
+    """A dead/unreachable donor must never block replica readiness:
+    prewarm returns 0, the replica comes up cold and serves."""
+    engine = _engine(setup)
+    assert disagg.prewarm_from_peer(
+        engine, "http://127.0.0.1:9", top_k=4, timeout=1.0
+    ) == 0
+    assert engine.prefix_digest_summary() == []
+    server = ServeServer(engine).start()
+    try:
+        out = _gen(_url(server), {
+            "tokens": _prompt(30, 12), "max_new_tokens": 4,
+        })
+        assert len(out["tokens"]) == 4
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Recompile guard
+
+
+def test_warm_engine_zero_compiles_through_prefix_import(setup):
+    """A WARM engine takes export → import → install → hit without a
+    single new XLA compile: the install writes ride the
+    warmup-precompiled ingest program, and the hit is the ordinary
+    aliasing plan (the jit-guard stance, applied to the fetch path)."""
+    donor = _engine(setup)
+    target = _engine(setup)
+    target.warmup()
+    sys_prompt = _prompt(12, 24)
+    digest = _store(donor, sys_prompt)
+    body = _transfer(donor, digest)
+    # One request first so every decode/admit program is live.
+    rid = target.submit(GenRequest(
+        tokens=sys_prompt + _prompt(96, 5), max_new_tokens=6,
+    ))
+    target.run()
+    target.result(rid, timeout=0)
+    with compile_delta() as d:
+        target.import_kv_prefix(*disagg.unpack_transfer(body))
+        assert target.install_prefix_imports() == 1
+        rid = target.submit(GenRequest(
+            tokens=sys_prompt + _prompt(95, 5), max_new_tokens=6,
+        ))
+        target.run()
+        target.result(rid, timeout=0)
+    assert target.requests()["requests"][-1]["prefix"] == "fetched"
+    assert d.count == 0, f"{d.count} steady-state compiles"
