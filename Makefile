@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-autoscale test-jit-guard lint lint-metrics lint-jax agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-serve-overflow test-autoscale test-jit-guard lint lint-metrics lint-jax agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -116,6 +116,27 @@ test-serve-prefix:
 	  --roots oim_tpu/serve,oim_tpu/ops
 	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_serve_prefix.py -q -m "serve_prefix and not slow" \
+	  -p no:cacheprovider
+
+# Host-RAM KV overflow tier (ISSUE 15, serve_overflow marker): the
+# demote→promote exactness matrix ({greedy, temp>0, spec-decode,
+# prefix-CoW hit, mid-stream admission} × {fp, kv_int8, kv_int4} ×
+# pipeline depth {1, 2} token-identical to the never-swapped oracle),
+# exact slot parking/restore + its reap/cancel/abort leak-freedom in
+# BOTH tiers, the budget-exhausted and promote-shortfall degrade
+# paths, the demote-vs-evict accounting split, the handler-thread
+# demote donation-race soak, and the warm-machinery zero-compile pin.
+# Nominal ~30s; the cap carries the box's 2-3x CPU-quota headroom.
+# Also runs the oimlint lock-discipline/resource-lifecycle/jaxvet
+# passes over the serve plane + ops so the tier's lock and hot-path
+# fetch discipline (accumulator-routed device_get, no raw host syncs
+# on the spine) stays analyzer-clean, not grandfathered in baseline.
+test-serve-overflow:
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
+	  --roots oim_tpu/serve,oim_tpu/ops
+	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_serve_overflow.py -q -m "serve_overflow and not slow" \
 	  -p no:cacheprovider
 
 # Serve-plane fault tolerance (chaos marker): the splice-failover soak

@@ -498,6 +498,7 @@ def _run(tmp: str, agent_sock: str, cleanups: list, extras: dict) -> int:
         _serve_diagnostics(extras, on_tpu, cfg, params)
         _disagg_diagnostics(extras, on_tpu, cfg, params)
         _prefix_residency_diagnostics(extras, on_tpu, cfg, params)
+        _overflow_diagnostics(extras, on_tpu, cfg, params)
         _spec_model_diagnostics(extras, on_tpu)
     _flash_diagnostics(extras, on_tpu)
     # Last: it opens a SECOND PJRT client against the pool (the staged
@@ -1978,6 +1979,192 @@ def _prefix_residency_legs(
         f"fetches, {mismatches} mismatched requests"
         + ("" if on_tpu else "; CPU = parity control") + ")"
     )
+
+
+def _overflow_diagnostics(extras, on_tpu, cfg, params) -> None:
+    """Host-RAM KV overflow tier headline (ISSUE 15): the fixed-HBM
+    capacity probe — host-tier engine vs HBM-only control at an
+    IDENTICAL device pool (N full-length slots' worth of blocks),
+    interleaved-median A/B per the PR 5 protocol, under the PR 14
+    Zipf system-prompt workload.  Reported: concurrent slots admitted
+    at fixed HBM (the host engine must sustain ≥ 2×N), the
+    prefix-hit rate AFTER capacity pressure (the tier's whole point:
+    the host engine's pressured entries come back as promotions, the
+    control's are recomputed — its hit-rate collapses), promote p50
+    wall, and a mismatch counter that must read zero.  The CPU leg is
+    a PARITY CONTROL per the documented caveat (doc/operations.md
+    "CPU-backend caveat"): loopback-host copies cost nothing like a
+    real HBM↔DRAM move, so the wall-clock rows are noise controls —
+    the slot counts, hit rates, and mismatch counter are meaningful
+    everywhere."""
+    try:
+        from oim_tpu.serve import Engine, GenRequest
+
+        chunk = 32 if on_tpu else 4
+        new_tokens = 32 if on_tpu else 8
+        n_cap_slots = 4  # N: the pool is N full-length slots' worth
+        bs = 64
+        max_len = 512
+        n_blocks = n_cap_slots * (max_len // bs)
+        mk = dict(
+            n_slots=16, max_len=max_len, chunk=chunk,
+            prompt_buckets=(64, 256), kv_block=bs, kv_blocks=n_blocks,
+            prefix_cache_size=8,
+        )
+        host_engine = Engine(
+            params, cfg, **mk, kv_host_bytes=256 << 20,
+        ).warmup()
+        ctl_engine = Engine(params, cfg, **mk).warmup()
+
+        # The PR 14 Zipf shape: 4 shared 128-token system prompts,
+        # rank^-1 weighted, deterministic low-discrepancy picks —
+        # every leg replays the identical sequence.
+        sys_prompts = [
+            [(97 * k + j) % cfg.vocab_size for j in range(128)]
+            for k in range(4)
+        ]
+        weights = [1.0 / (k + 1) for k in range(len(sys_prompts))]
+        total_w = sum(weights)
+        n_requests = 12
+
+        def picks(offset):
+            out = []
+            for i in range(n_requests):
+                x = (((i + offset) * 0.6180339887) % 1.0) * total_w
+                acc = 0.0
+                for k, w in enumerate(weights):
+                    acc += w
+                    if x < acc:
+                        break
+                out.append(k)
+            return out
+
+        def leg(e):
+            """Seed → pressure wave (fills the fixed pool) → hit wave
+            (reads back what pressure did to the entries); returns
+            (ordered tokens, tok/s, peak concurrent slots, hit rate
+            of the post-pressure wave)."""
+            # Cold caches per leg, warm engine (the reset_caches
+            # discipline from the residency probe, host tier
+            # included).
+            e._warming = True
+            try:
+                with e._lock:
+                    e._clear_prefix_cache_locked()
+                    e._flush_host_tier_locked()
+            finally:
+                e._warming = False
+            t0 = time.perf_counter()
+            for sp in sys_prompts:
+                rid = e.submit(GenRequest(
+                    tokens=sp, max_new_tokens=2, cache_prefix=True,
+                ))
+                e.run()
+                e.result(rid, timeout=0)
+            toks = []
+            # PRESSURE wave: unique full-length prompts (no shared
+            # prefix to alias) — their worst cases overrun the fixed
+            # pool, so the planner must demote (tiered) or evict
+            # (control) the seeded entries to keep admitting.
+            rids = [
+                e.submit(GenRequest(
+                    tokens=[
+                        (31 * i + j + 7) % cfg.vocab_size
+                        for j in range(136)
+                    ],
+                    max_new_tokens=new_tokens,
+                ))
+                for i in range(n_requests)
+            ]
+            # Peak concurrency over the first admission waves (one
+            # wave can finish whole requests on fast backends).
+            e.step()
+            seated = e.stats()["active_slots"]
+            e.step()
+            seated = max(seated, e.stats()["active_slots"])
+            results = e.run()
+            toks += [results[r] for r in rids]
+            h0 = e.stats()["prefix_hits"]
+            m0 = e.stats()["prefix_misses"]
+            rids = [
+                e.submit(GenRequest(
+                    tokens=sys_prompts[k]
+                    + [(53 * i + j) % cfg.vocab_size for j in range(8)],
+                    max_new_tokens=new_tokens,
+                ))
+                for i, k in enumerate(picks(5))
+            ]
+            results = e.run()
+            toks += [results[r] for r in rids]
+            dt = time.perf_counter() - t0
+            s = e.stats()
+            hits = s["prefix_hits"] - h0
+            misses = s["prefix_misses"] - m0
+            rate = hits / (hits + misses) if hits + misses else 0.0
+            generated = 4 * 2 + 2 * n_requests * new_tokens
+            return toks, round(generated / dt), seated, rate
+
+        ab_pairs = max(1, int(os.environ.get(
+            "OIM_BENCH_SERVE_AB_PAIRS", "1" if on_tpu else "3"
+        )))
+        h_tps, c_tps, h_rate, c_rate, h_seated = [], [], [], [], []
+        mismatches = 0
+        for _ in range(ab_pairs):
+            toks_h, tps, seated, rate = leg(host_engine)
+            h_tps.append(tps)
+            h_rate.append(rate)
+            h_seated.append(seated)
+            toks_c, tps, _, rate = leg(ctl_engine)
+            c_tps.append(tps)
+            c_rate.append(rate)
+            mismatches += sum(x != y for x, y in zip(toks_h, toks_c))
+        s = host_engine.stats()
+        # Zero leaked blocks in either tier: live traffic drained, so
+        # device blocks belong to resident entries only and host
+        # blocks to demoted entries only.
+        assert s["active_slots"] == 0 and s["parked_slots"] == 0
+        assert s["kv_blocks_used"] <= s["prefix_entries"] * (
+            -(-256 // bs)
+        )
+        assert s["kv_host_blocks_used"] <= s["host_prefix_entries"] * (
+            -(-256 // bs)
+        )
+        extras["serve_kv_overflow_slots"] = int(
+            statistics.median(h_seated)
+        )
+        extras["serve_kv_overflow_slots_floor"] = 2 * n_cap_slots
+        extras["serve_overflow_hit_rate"] = round(
+            statistics.median(h_rate), 3
+        )
+        extras["serve_overflow_hit_rate_ctl"] = round(
+            statistics.median(c_rate), 3
+        )
+        extras["serve_overflow_tok_per_s"] = round(
+            statistics.median(h_tps)
+        )
+        extras["serve_overflow_tok_per_s_ctl"] = round(
+            statistics.median(c_tps)
+        )
+        extras["serve_overflow_promote_p50_ms"] = round(
+            s["kv_promote_wall_p50"] * 1000, 2
+        )
+        extras["serve_overflow_mismatch_reqs"] = mismatches
+        log(
+            f"bench: host-RAM KV overflow tier at fixed HBM "
+            f"({n_cap_slots} slots' blocks): "
+            f"{extras['serve_kv_overflow_slots']} concurrent slots "
+            f"(floor {2 * n_cap_slots}), post-pressure hit rate "
+            f"{extras['serve_overflow_hit_rate']:.0%} tiered vs "
+            f"{extras['serve_overflow_hit_rate_ctl']:.0%} HBM-only, "
+            f"promote p50 "
+            f"{extras['serve_overflow_promote_p50_ms']} ms, "
+            f"{mismatches} mismatched requests ({ab_pairs} "
+            f"interleaved pair(s)"
+            + ("" if on_tpu else "; CPU wall rows = parity control")
+            + ")"
+        )
+    except Exception as exc:  # pragma: no cover - diagnostics only
+        log(f"bench: overflow tier diagnostics skipped: {exc}")
 
 
 def _spec_model_diagnostics(extras, on_tpu) -> None:

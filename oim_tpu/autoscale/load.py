@@ -68,6 +68,22 @@ _DEFAULTS: dict[str, Any] = {
     "prefix_digests": [],
     "prefix_hits": 0,
     "prefix_misses": 0,
+    # Host-RAM KV overflow tier (ISSUE 15; zeros from dense engines,
+    # tier-less engines, and publishers predating the fields — the
+    # tolerant-decode defaults): the second capacity tier's headroom,
+    # the demote/promote movement counters (`oimctl top`'s PROMO
+    # column; promote ≈ demote at high kv_fragmentation is the thrash
+    # signature), parked-slot count, and the demote-vs-evict split so
+    # a capacity incident can tell "moved to host" from "lost
+    # forever".
+    "kv_host_blocks_total": 0,
+    "kv_host_blocks_free": 0,
+    "kv_host_fragmentation": 0.0,
+    "kv_demotions": 0,
+    "kv_promotions": 0,
+    "parked_slots": 0,
+    "prefix_demotions": 0,
+    "prefix_evictions": 0,
     "token_rate": 0.0,
     "shed_queue_full": 0,
     "shed_deadline": 0,

@@ -174,9 +174,9 @@ def _print_top(
     utilization the autoscaler's band policy acts on."""
     print(
         f"{'BACKEND':<28} {'HEALTHY':<8} {'POOL':<8} {'QUEUE':>6} "
-        f"{'ACTIVE':>7} {'SLOTS':>6} {'TOK/S':>9} {'KV f/s/t':>12} "
-        f"{'PATH':>10} {'PFX':>9} {'SHIP e/i':>9} {'SHED q/d/b':>12} "
-        f"BROWNOUT"
+        f"{'ACTIVE':>7} {'SLOTS':>6} {'TOK/S':>9} {'KV f/s/t+host':>26} "
+        f"{'PATH':>10} {'PFX':>9} {'PROMO p/d':>10} {'SHIP e/i':>9} "
+        f"{'SHED q/d/b':>12} BROWNOUT"
     )
     busy = capacity = 0.0
     for bid, healthy, load in rows:
@@ -189,13 +189,27 @@ def _print_top(
         # free/shared/total paged-KV blocks + fragmentation % — the
         # replica's cache headroom (admissions defer on exhaustion) and
         # how much of it is allocated-but-idle tail; dense engines
-        # report no pool.
-        kv = (
-            f"{load.get('kv_blocks_free', 0)}/"
-            f"{load.get('kv_blocks_shared', 0)}/{kv_total} "
-            f"{load.get('kv_fragmentation', 0.0):.0%}"
-            if kv_total else "-"
-        )
+        # report no pool.  With the host overflow tier (ISSUE 15), the
+        # host tier's used/total blocks + its own frag % ride along —
+        # the replica's SECOND capacity tier, where warm prefixes and
+        # parked slots wait out HBM pressure.
+        if kv_total:
+            kv = (
+                f"{load.get('kv_blocks_free', 0)}/"
+                f"{load.get('kv_blocks_shared', 0)}/{kv_total} "
+                f"{load.get('kv_fragmentation', 0.0):.0%}"
+            )
+            host_total = load.get("kv_host_blocks_total", 0)
+            if host_total:
+                host_used = host_total - load.get(
+                    "kv_host_blocks_free", 0
+                )
+                kv += (
+                    f"+{host_used}/{host_total} "
+                    f"{load.get('kv_host_fragmentation', 0.0):.0%}"
+                )
+        else:
+            kv = "-"
         # Which decode path the replica runs (ISSUE 13): the paged
         # flash kernel ("kernel", "+kv4" on the int4 rung) vs the
         # gather control ("gather") — the fast-path visibility the
@@ -214,6 +228,17 @@ def _print_top(
             if load.get("kv_exports") or load.get("kv_imports")
             else "-"
         )
+        # Host-tier movement (ISSUE 15): promoted / demoted blocks —
+        # promote ≈ demote at high KV frag is the thrash signature
+        # (doc/operations.md 'Host-tier capacity incidents'); a parked
+        # count marks replicas currently swapping live slots.
+        promo = (
+            f"{load.get('kv_promotions', 0)}/{load.get('kv_demotions', 0)}"
+            if load.get("kv_promotions") or load.get("kv_demotions")
+            else "-"
+        )
+        if load.get("parked_slots"):
+            promo += f" P{load.get('parked_slots')}"
         # Fleet prefix residency (ISSUE 14): resident digests and this
         # backend's own hit rate — which replicas actually HOLD the
         # hot prompts, vs recomputing them every request.
@@ -233,8 +258,8 @@ def _print_top(
             f"{bid[:28]:<28} {'yes' if healthy else 'NO':<8} "
             f"{str(load.get('pool') or 'mixed')[:8]:<8} {q:>6} "
             f"{a:>7} {s:>6} {load.get('token_rate', 0.0):>9.1f} "
-            f"{kv:>12} {path:>10} {pfx:>9} {ship:>9} {shed:>12} "
-            f"{'yes' if load.get('brownout') else '-'}"
+            f"{kv:>26} {path:>10} {pfx:>9} {promo:>10} {ship:>9} "
+            f"{shed:>12} {'yes' if load.get('brownout') else '-'}"
         )
     util = busy / capacity if capacity else 0.0
     print(

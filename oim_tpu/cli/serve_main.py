@@ -257,6 +257,23 @@ def build_parser() -> argparse.ArgumentParser:
         "footprint, n_slots x max_len / --kv-block)",
     )
     p.add_argument(
+        "--kv-host-bytes", type=int, default=0, metavar="B",
+        help="host-RAM KV overflow tier budget in bytes (0 = off; "
+        "requires --kv-block): prefix shortfalls DEMOTE idle entries "
+        "to host RAM instead of destroying them (a later hit promotes "
+        "the blocks back — no recompute prefill), and admissions that "
+        "cannot fit can park the coldest slot's table there and "
+        "restore it exactly when blocks free (doc/serving.md "
+        "'Host-RAM KV overflow tier')",
+    )
+    p.add_argument(
+        "--no-kv-park", action="store_true",
+        help="with --kv-host-bytes: disable swap-based slot parking "
+        "(demote/promote of idle prefix entries stays on) — parking "
+        "trades a mid-stream victim's latency for the head-of-line "
+        "admission, which latency-floor deployments may not want",
+    )
+    p.add_argument(
         "--pool", default="mixed", choices=("prefill", "decode", "mixed"),
         help="disaggregation pool role (doc/serving.md 'Disaggregated "
         "prefill/decode'): prefill = take long-prompt admissions and "
@@ -503,6 +520,8 @@ def make_engine(args):
         request_ring=args.request_ring,
         kv_block=args.kv_block,
         kv_blocks=args.kv_blocks,
+        kv_host_bytes=args.kv_host_bytes,
+        kv_park=not args.no_kv_park,
         # auto = TPU-paged engines only (the Engine resolves the
         # backend); on/off are the explicit A/B handles.
         paged_kernel={"auto": None, "on": True, "off": False}[

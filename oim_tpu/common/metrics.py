@@ -488,9 +488,35 @@ SERVE_KV_BLOCKS = _registry.gauge(
     "(the engine's real admission headroom — admissions defer, not "
     "crash, when a request's worst case exceeds it), used = held by at "
     "least one slot or prefix-cache entry, shared = aliased by more "
-    "than one owner (HBM the fleet would otherwise hold in duplicate). "
-    "Absent on dense (non-paged) engines.",
+    "than one owner (HBM the fleet would otherwise hold in duplicate), "
+    "host = resident in the host-RAM overflow tier (ISSUE 15: demoted "
+    "prefix entries + parked slots — KV preserved beyond HBM, promoted "
+    "back on a hit instead of recomputed).  Absent on dense "
+    "(non-paged) engines; the host state is absent without "
+    "--kv-host-bytes.",
     ("engine", "state"),
+)
+SERVE_KV_TIER_MOVES = _registry.counter(
+    "oim_serve_kv_tier_moves_total",
+    "Blocks moved between the HBM pool and the host-RAM overflow tier "
+    "by direction: demote = device → host (prefix shortfall / LRU "
+    "pressure / slot parking), promote = host → device (prefix hit on "
+    "a demoted entry / slot restore).  A promote rate tracking the "
+    "demote rate at high kv_fragmentation is the host-tier THRASH "
+    "signature (doc/operations.md) — the budget is moving the same "
+    "blocks in circles instead of holding working set.",
+    ("op",),
+)
+SERVE_KV_TIER_SECONDS = _registry.counter(
+    "oim_serve_kv_tier_seconds_total",
+    "Wall seconds spent moving blocks between tiers, by direction "
+    "(demote = the batched read_block fetch + host pool write, off "
+    "the driver's critical path; promote = the host → device ingest "
+    "writes ahead of the tail prefill).  Divide by the matching "
+    "oim_serve_kv_tier_moves_total rate for per-block cost; compare "
+    "promote cost against oim_serve_prefill_seconds for the "
+    "promote-vs-recompute break-even (doc/serving.md).",
+    ("op",),
 )
 SERVE_PREFIX_BYTES_SAVED = _registry.counter(
     "oim_serve_prefix_bytes_saved_total",

@@ -140,3 +140,16 @@ def write_block(pool, row, dst):
     device stream orders import → decode.  ``dst`` is traced (one
     compile covers every destination block)."""
     return jax.lax.dynamic_update_index_in_dim(pool, row, dst, 1)
+
+
+def read_block(pool, src):
+    """Read one block's rows [n_layers, block_size, ...] out of a
+    stacked pool [n_layers, n_blocks, ...] — the demotion half of the
+    host-RAM overflow tier (ISSUE 15): the engine dispatches this for
+    each block it moves to host RAM, then releases the device block;
+    the single device stream orders the read before any later prefill
+    that reuses the freed block, so the fetched bytes are always the
+    pre-reuse contents.  ``src`` is traced (one compile covers every
+    source block — the demote path stays compile-free at steady
+    state, unlike a shape-varying ``jnp.take`` gather)."""
+    return jax.lax.dynamic_index_in_dim(pool, src, 1, keepdims=False)

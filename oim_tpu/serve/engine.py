@@ -76,6 +76,7 @@ discipline for daemon use.
 
 from __future__ import annotations
 
+import statistics
 import threading
 import time
 from collections import deque
@@ -100,7 +101,13 @@ from oim_tpu.models.decode import (
     nucleus_min_p_mask,
     truncate_logits,
 )
-from oim_tpu.ops.paged import copy_block, paged_store, paged_view, write_block
+from oim_tpu.ops.paged import (
+    copy_block,
+    paged_store,
+    paged_view,
+    read_block,
+    write_block,
+)
 from oim_tpu.ops.paged_attention import paged_flash_decode
 from oim_tpu.serve.disagg import (
     KV_HOLD_MAX,
@@ -385,6 +392,126 @@ class BlockAllocator:
                 self._free.append(int(b))
                 freed += 1
         return freed
+
+
+class HostBlockPool:
+    """Host-RAM overflow tier for the paged pool (ISSUE 15): the same
+    ``[n_layers, block, block_size, kv_heads, head_dim]`` geometry as
+    the device pool (including the int8/int4 scale planes) in plain
+    numpy, plus its own refcounted ``BlockAllocator``.  Warm prefix
+    entries and parked slot tables live here instead of being
+    destroyed when HBM runs short — a later hit PROMOTES the blocks
+    back through the warmup-precompiled ingest program instead of
+    recomputing the prefill.
+
+    Pure host state: every byte that lands here arrived via a
+    stream-ordered ``read_block`` fetch, and every byte that leaves
+    goes back up through ``write_block`` — the pool itself is never
+    traced.  Mutated only under the engine lock (allocator) or by the
+    completion path that owns the pending write (array rows), so there
+    is no lock here — the ``BlockAllocator`` single-owner contract."""
+
+    def __init__(self, cache: "PagedCache", n_blocks: int):
+        if n_blocks < 1:
+            raise ValueError(f"need n_blocks >= 1, got {n_blocks}")
+        shape = (cache.k.shape[0], n_blocks) + cache.k.shape[2:]
+        # np.zeros accepts the device pool's dtype directly (bfloat16 /
+        # int4 are ml_dtypes-registered numpy dtypes) — the host copy
+        # is bit-identical to the device block, quantized payloads and
+        # all, which is what makes demote→promote exact by
+        # construction.
+        self.k = np.zeros(shape, cache.k.dtype)
+        self.v = np.zeros(shape, cache.v.dtype)
+        if cache.k_scale is not None:
+            sshape = (cache.k_scale.shape[0], n_blocks) + (
+                cache.k_scale.shape[2:]
+            )
+            self.k_scale = np.zeros(sshape, cache.k_scale.dtype)
+            self.v_scale = np.zeros(sshape, cache.v_scale.dtype)
+        else:
+            self.k_scale = self.v_scale = None
+        self.alloc = BlockAllocator(n_blocks)
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+    def pools(self) -> list[tuple[str, "np.ndarray"]]:
+        """(leaf name, host array) pairs, scale planes included when
+        quantized — the one leaf-name order demote writes and promote
+        reads share."""
+        out = [("k", self.k), ("v", self.v)]
+        if self.k_scale is not None:
+            out += [("k_scale", self.k_scale), ("v_scale", self.v_scale)]
+        return out
+
+
+@dataclass
+class _HostWrite:
+    """One dispatched-but-unfetched tier demotion: the device-side
+    ``read_block`` futures for each moved block plus where their bytes
+    land in the host pool.  ``kind`` "prefix" registers a host prefix
+    entry on completion; "park" marks the parked slot restorable.  The
+    device futures were dispatched BEFORE the source blocks were
+    decref'd, so the single device stream guarantees they carry the
+    pre-reuse contents no matter who reallocates the blocks next."""
+
+    kind: str  # "prefix" | "park"
+    host_blocks: tuple[int, ...]
+    # One entry per moved block: list of per-leaf device arrays in
+    # HostBlockPool.pools() order.
+    dev: list
+    key: tuple = ()  # prefix: the covered-token entry key
+    rows: int = 0  # prefix: covered rows
+    meta: dict | None = None  # prefix: residency record to carry over
+    rid: int = -1  # park: the parked request
+
+
+@dataclass
+class _ParkedSlot:
+    """A mid-stream request swapped out to the host tier: its full
+    host slot state plus the host blocks holding its KV through the
+    frontier.  ``ready`` flips when the demote fetch lands;
+    ``n_live`` is the original reservation's block count, so restore
+    re-reserves exactly what admission planned."""
+
+    state: "_SlotState"
+    host_blocks: tuple[int, ...]
+    n_cov: int  # leading blocks that carry live rows (the payload)
+    n_live: int  # total blocks the original plan reserved
+    rows: int  # valid KV rows (len(prompt) + len(emitted) - 1)
+    ready: bool = False
+    # True while _unpark_wave holds the lock released for the restore's
+    # device writes: the record stays in _parked (visible to cancel/
+    # reap/abort/in_flight the whole time) and whoever POPS it owns the
+    # host-block decref — the restore's commit detects a concurrent
+    # abort by the pop coming back empty.
+    restoring: bool = False
+    t_parked: float = field(default_factory=time.monotonic)
+
+
+def _restore_slot(
+    cache: PagedCache, history, tok_counts, gen_counts,
+    slot, length, hist_row, tok_row, gen_row,
+    *, track_history: bool, penalize: bool,
+):
+    """Device half of un-parking: put one slot's per-slot device state
+    back — the cache frontier (``lengths[slot]``), the spec-decode
+    token history row, and the sampling-penalty occurrence rows — all
+    reconstructed from HOST truth (prompt + emitted tokens), so the
+    restored slot is indistinguishable from one that never left.
+    ``slot``/``length`` are traced: ONE compile covers every restore
+    (the demote/promote steady state stays recompile-free)."""
+    lengths = cache.lengths.at[slot].set(length)
+    cache = PagedCache(
+        cache.k, cache.v, lengths, cache.k_scale, cache.v_scale
+    )
+    if track_history:
+        history = history.at[slot].set(hist_row)
+    if penalize:
+        tok_counts = tok_counts.at[slot].set(tok_row)
+        gen_counts = gen_counts.at[slot].set(gen_row)
+    return cache, history, tok_counts, gen_counts
 
 
 def _cow_block(cache: PagedCache, src, dst):
@@ -1328,6 +1455,11 @@ class _SlotState:
     logprobs: list[float] = field(default_factory=list)
     last_token: int = 0
     phases: _PhaseTrace | None = None
+    # Host-tier parking (ISSUE 15): set when this slot was just
+    # restored from the host tier, cleared at its next emitted token —
+    # a restored slot must make progress before it can be parked
+    # again, or a saturated admission queue ping-pongs one victim.
+    park_immune: bool = False
 
 
 @dataclass
@@ -1429,6 +1561,8 @@ class Engine:
         kv_block: int = 0,
         kv_blocks: int = 0,
         paged_kernel: bool | None = None,
+        kv_host_bytes: int = 0,
+        kv_park: bool = True,
     ):
         if pipeline_depth not in (1, 2):
             raise ValueError(
@@ -1704,6 +1838,96 @@ class Engine:
             self._alloc = None
             self._tables_host = None
             self._kv_row_bytes = 0
+        # Host-RAM overflow tier (ISSUE 15): a second, host-side block
+        # pool under a byte budget.  Prefix shortfalls DEMOTE idle
+        # entries here (batched stream-ordered read_block fetches off
+        # the driver's critical path) instead of destroying them, a
+        # later hit PROMOTES them back through the staged-install path
+        # (warmup-precompiled ingest, double-buffered ahead of the tail
+        # prefill), and an admission that cannot fit can PARK the
+        # coldest idle slot's table here and restore it exactly when
+        # blocks free — the swap mechanism QoS preemption will drive.
+        if kv_host_bytes < 0:
+            raise ValueError(
+                f"kv_host_bytes must be >= 0, got {kv_host_bytes}"
+            )
+        if kv_host_bytes and not self.paged:
+            raise ValueError(
+                "kv_host_bytes needs the paged cache (kv_block > 0): "
+                "only the block pool has a block-granular unit to "
+                "demote/promote"
+            )
+        self.kv_host_bytes = kv_host_bytes
+        if kv_host_bytes:
+            block_bytes = self._kv_row_bytes * kv_block
+            n_host = kv_host_bytes // block_bytes
+            if n_host < 1:
+                raise ValueError(
+                    f"kv_host_bytes={kv_host_bytes} holds no block "
+                    f"(one {kv_block}-token block is {block_bytes} "
+                    f"bytes here)"
+                )
+            self._host = HostBlockPool(self._cache, n_host)
+            # One compile per pool leaf shape: the kv pools share one
+            # read program, the scale planes another (traced src).
+            self._read_block = jax.jit(read_block)
+            # Fixed-shape filler for the restore program's unused rows
+            # (track_history/penalize off): non-donated, safe to reuse.
+            self._restore_dummy_row = jnp.zeros((1,), jnp.int32)
+            self._restore = jax.jit(
+                partial(
+                    _restore_slot,
+                    track_history=(
+                        bool(spec_decode) and draft_cfg is None
+                    ),
+                    penalize=penalties,
+                ),
+                donate_argnums=(0, 1, 2, 3),
+            )
+        else:
+            self._host = None
+            self._read_block = None
+            self._restore = None
+        # Slot parking needs the host tier and a per-slot state that is
+        # fully host-reconstructible: the draft model's slot cache is
+        # device-derived state a restore cannot rebuild without a
+        # draft prefill, so draft-model engines refuse to park
+        # (demote/promote of prefix entries still works there).
+        self.kv_park = bool(
+            self._host is not None and kv_park and draft_cfg is None
+        )
+        # Host-tier state, all under self._lock like the device
+        # allocator: demoted prefix entries (covered-token key →
+        # (host block ids, rows)), their residency metadata, parked
+        # slots (rid → _ParkedSlot, FIFO restore order), and tier
+        # movements dispatched but not yet fetched.
+        from collections import OrderedDict as _OD
+
+        self._host_prefix: "_OD[tuple, tuple]" = _OD()
+        self._host_meta: dict[tuple, dict] = {}
+        self._parked: "_OD[int, _ParkedSlot]" = _OD()
+        self._pending_host_writes: list[_HostWrite] = []
+        # Promotions planned (device blocks reserved, host blocks
+        # pinned) but whose payload copy is still running off-lock:
+        # the submit-time idempotency guard, so a cohort burst stages
+        # one install per entry, not one per request.
+        self._promote_staging: set[tuple] = set()
+        # Tier accounting (stats()/load(); the shared metric twins are
+        # SERVE_KV_TIER_MOVES / SERVE_KV_TIER_SECONDS).
+        self.kv_demotions = 0  # blocks moved device → host
+        self.kv_promotions = 0  # blocks moved host → device
+        self.kv_parks = 0  # slots swapped out
+        self.kv_unparks = 0  # slots restored
+        self.kv_demote_seconds = 0.0
+        self.kv_promote_seconds = 0.0
+        # Prefix-shortage outcome split (ISSUE 15 satellite): an entry
+        # moved to the host tier is recoverable; one destroyed — no
+        # host tier, host budget exhausted, or host-LRU pressure — is
+        # prefill lost forever.  Capacity incidents must tell the two
+        # apart.
+        self.prefix_demotions = 0
+        self.prefix_evictions = 0
+        self._promote_walls: deque[float] = deque(maxlen=64)
         # Dense engines pass this inert dummy where the paged layout
         # passes its block table (one jit signature for both).
         self._tables_dummy = jnp.zeros((1, 1), jnp.int32)
@@ -1839,13 +2063,17 @@ class Engine:
         # for prefix_digest_summary() and the per-request
         # fetched-vs-local-vs-recomputed attribution.
         self._prefix_meta: dict[tuple, dict] = {}
-        # Staged prefix installs (import_kv_prefix): (digest, KvImport)
-        # pairs — freshly reserved blocks + host payload, landed in the
-        # pool by the DRIVER thread (install_prefix_imports) at the
-        # next admission boundary — the single-writer cache discipline,
+        # Staged prefix installs (import_kv_prefix + the host tier's
+        # promote path): (digest, KvImport, promote_key) triples —
+        # freshly reserved blocks + host payload, landed in the pool by
+        # the DRIVER thread (install_prefix_imports) at the next
+        # admission boundary — the single-writer cache discipline,
         # exactly like staged KV-ship imports.  TTL'd and count-capped
-        # the same way.
-        self._prefix_installs: list[tuple[str, KvImport]] = []
+        # the same way.  promote_key is None for sibling-shipped
+        # installs; for a host-tier promotion it is the demoted entry's
+        # key, cleared (entry freed back to the host budget) once the
+        # install lands.
+        self._prefix_installs: list[tuple[str, KvImport, tuple | None]] = []
         self.prefix_fetch_installs = 0
         self.prefix_exports = 0
         self._extract = {
@@ -2036,9 +2264,14 @@ class Engine:
             "work — copied in dense mode, block-aliased copy-free in "
             "paged; hit rate = hit / (hit + miss)); inject counts "
             "entry STORES (cache_prefix requests populating the "
-            "cache), a separate event stream.  The affinity router "
-            "exists to raise the hit rate; watch this to see it "
-            "working.",
+            "cache), a separate event stream.  Capacity pressure "
+            "splits by recoverability (ISSUE 15): demote = the entry "
+            "moved to the host-RAM overflow tier (a later hit "
+            "promotes it back, no prefill lost), evict = the entry "
+            "was destroyed (no host tier, host budget exhausted, or "
+            "host-LRU pressure — that prefill is lost forever).  The "
+            "affinity router exists to raise the hit rate; watch "
+            "this to see it working.",
             ("outcome",),
         )
         self._m_latency = reg.histogram(
@@ -2077,6 +2310,10 @@ class Engine:
         # prefix aliasing did NOT copy (the copy-free-reuse win).
         self._m_kv_blocks = _metrics.SERVE_KV_BLOCKS
         self._m_prefix_bytes = _metrics.SERVE_PREFIX_BYTES_SAVED
+        # Host-tier movement counters (ISSUE 15): blocks and wall
+        # seconds per direction, shared definitions like the KV gauges.
+        self._m_tier_moves = _metrics.SERVE_KV_TIER_MOVES
+        self._m_tier_seconds = _metrics.SERVE_KV_TIER_SECONDS
         if self.paged:
             # Constructor is single-threaded; the _locked suffix is the
             # call-site contract for every later caller.
@@ -2279,10 +2516,24 @@ class Engine:
             rid = self._next_rid
             self._next_rid += 1
             self._queue.append((rid, req, time.monotonic()))
+            promote_plan = (
+                # Host-tier promotion (ISSUE 15): if a demoted entry
+                # covers this prompt better than anything resident,
+                # reserve its install NOW (the payload copy runs after
+                # the lock drops) so it is back on the device by this
+                # request's admission boundary — double-buffered ahead
+                # of the tail prefill, recompute the unconditional
+                # fallback.
+                self._plan_promote_locked(req)
+                if self._host is not None and not self._warming
+                else None
+            )
             self._events[rid] = threading.Event()
             if on_token is not None:
                 self._callbacks[rid] = on_token
             self._m_queued.set(float(len(self._queue)), self._engine_label)
+        if promote_plan is not None:
+            self._stage_promote(promote_plan)  # the copy, off-lock
         return rid
 
     @contextmanager
@@ -2518,8 +2769,10 @@ class Engine:
                     )
                     break
             else:
-                if rid in self._admitting or any(
-                    s.rid == rid for s in self._slots.values()
+                if (
+                    rid in self._admitting
+                    or rid in self._parked  # reaped at the next step
+                    or any(s.rid == rid for s in self._slots.values())
                 ):
                     self._cancelled.add(rid)
                 else:
@@ -2602,6 +2855,15 @@ class Engine:
             pending += [
                 (s.rid, None, None, s) for s in self._slots.values()
             ]
+            # Parked requests die with everyone else (their host
+            # blocks return to the tier budget; an in-flight swap-out
+            # fetch finds its rid gone and self-cleans).
+            pending += [
+                (p.state.rid, None, None, p.state)
+                for p in self._parked.values()
+            ]
+            for rid in list(self._parked):
+                self._drop_parked_locked(rid)
             self._queue.clear()
             reclaimed = sorted(
                 set(self._slots) | set(self._admitting.values())
@@ -2630,11 +2892,14 @@ class Engine:
 
     def pending(self) -> bool:
         with self._lock:
-            # Staged prefix installs count as pending work: the serve
-            # loop's idle path must call step() so the driver thread
-            # lands them at the next admission boundary.
+            # Staged prefix installs, parked slots, and in-flight tier
+            # writes count as pending work: the serve loop's idle path
+            # must call step() so the driver thread lands installs,
+            # completes demote fetches, and restores parked slots at
+            # the next admission boundary.
             return bool(
                 self._queue or self._slots or self._prefix_installs
+                or self._parked or self._pending_host_writes
             )
 
     def info(self) -> dict:
@@ -2689,6 +2954,11 @@ class Engine:
                 "paged": self.paged,
                 "kv_block": self.kv_block,
                 "kv_blocks": self.kv_blocks,
+                "kv_host_bytes": self.kv_host_bytes,
+                "kv_host_blocks": (
+                    self._host.n_blocks if self._host else 0
+                ),
+                "kv_park": self.kv_park,
                 "paged_kernel": self.paged_kernel,
                 "tp": self.mesh.shape.get("tp", 1) if self.mesh else 1,
                 "ep": self.mesh.shape.get("ep", 1) if self.mesh else 1,
@@ -2740,6 +3010,40 @@ class Engine:
                 ),
                 "kv_fragmentation": self._kv_fragmentation_locked(),
                 "kv_admit_deferrals": self.kv_admit_deferrals,
+                # Host-RAM overflow tier (ISSUE 15; zeros without
+                # --kv-host-bytes): the second capacity tier's
+                # occupancy, the demote/promote movement counters +
+                # wall seconds (the thrash signature is promote rate ≈
+                # demote rate at high kv_fragmentation), the
+                # park/restore counts, and the demote-vs-evict split —
+                # "moved to host" vs "lost forever".
+                "kv_host_bytes": self.kv_host_bytes,
+                "kv_host_blocks_total": (
+                    self._host.n_blocks if self._host else 0
+                ),
+                "kv_host_blocks_free": (
+                    self._host.alloc.free_blocks if self._host else 0
+                ),
+                "kv_host_blocks_used": (
+                    self._host.alloc.used_blocks if self._host else 0
+                ),
+                "kv_host_fragmentation": (
+                    self._kv_host_fragmentation_locked()
+                ),
+                "host_prefix_entries": len(self._host_prefix),
+                "parked_slots": len(self._parked),
+                "kv_park": self.kv_park,
+                "kv_demotions": self.kv_demotions,
+                "kv_promotions": self.kv_promotions,
+                "kv_parks": self.kv_parks,
+                "kv_unparks": self.kv_unparks,
+                "kv_demote_seconds": round(self.kv_demote_seconds, 4),
+                "kv_promote_seconds": round(self.kv_promote_seconds, 4),
+                "kv_promote_wall_p50": round(
+                    statistics.median(self._promote_walls), 6
+                ) if self._promote_walls else 0.0,
+                "prefix_demotions": self.prefix_demotions,
+                "prefix_evictions": self.prefix_evictions,
                 # Which decode path and quant rung this engine runs
                 # (the A/B triage handles in doc/operations.md:
                 # mismatches → restart with the kernel off).
@@ -2826,6 +3130,21 @@ class Engine:
         used_rows = self._alloc.used_blocks * self.kv_block
         return round(max(0.0, 1.0 - live / used_rows), 4)
 
+    def _kv_host_fragmentation_locked(self) -> float:
+        """Allocated-but-idle fraction of HOST-tier block rows (lock
+        held) — the device definition applied to the overflow tier:
+        live rows are demoted entries' covered rows plus parked
+        frontiers; the rest of each allocated block is padding tail.
+        An operator signal for block-size tuning, like its device
+        twin."""
+        if self._host is None or not self._host.alloc.used_blocks:
+            return 0.0
+        live = sum(
+            rows for _, rows in self._host_prefix.values()
+        ) + sum(p.rows for p in self._parked.values())
+        used_rows = self._host.alloc.used_blocks * self.kv_block
+        return round(max(0.0, 1.0 - live / used_rows), 4)
+
     def load(self) -> dict:
         """Compact live-pressure snapshot — the ``load/<cn>`` registry
         value (oim_tpu/autoscale/load.py) and the ``load`` section of
@@ -2848,6 +3167,27 @@ class Engine:
                     self._alloc.shared_blocks if self.paged else 0
                 ),
                 "kv_fragmentation": self._kv_fragmentation_locked(),
+                # Host-RAM overflow tier (ISSUE 15; zeros from dense
+                # engines, tier-less engines, and publishers predating
+                # the fields): the second capacity tier's headroom and
+                # movement counters, plus the demote-vs-evict split —
+                # `oimctl top`'s host column and PROMO p/d column, and
+                # the capacity-incident queries in doc/operations.md,
+                # all read these off the same leased load key.
+                "kv_host_blocks_total": (
+                    self._host.n_blocks if self._host else 0
+                ),
+                "kv_host_blocks_free": (
+                    self._host.alloc.free_blocks if self._host else 0
+                ),
+                "kv_host_fragmentation": (
+                    self._kv_host_fragmentation_locked()
+                ),
+                "kv_demotions": self.kv_demotions,
+                "kv_promotions": self.kv_promotions,
+                "parked_slots": len(self._parked),
+                "prefix_demotions": self.prefix_demotions,
+                "prefix_evictions": self.prefix_evictions,
                 # Fast-path discovery (ISSUE 13): whether this backend
                 # decodes through the paged flash kernel and whether
                 # its cache runs the kv4 rung — `oimctl top` and the
@@ -3127,18 +3467,36 @@ class Engine:
             self._m_ttft.observe(time.monotonic() - state.t_submit)
         state.emitted.append(token)
         state.logprobs.append(logprob)
+        state.park_immune = False  # progress made: parkable again
         if token == state.req.eos_id or token in state.req.stop_ids:
             return True
         state.last_token = token
         return len(state.emitted) >= state.req.max_new_tokens
 
-    def _best_prefix_locked(self, req: GenRequest) -> tuple:
-        """Longest cached prefix usable for ``req`` (lock held): returns
-        (key, usable rows) or (None, 0).  Shared by the dense inject
-        path and the paged aliasing planner — ONE matching rule, so the
-        two layouts hit on exactly the same traffic."""
+    def _flush_host_tier_locked(self) -> None:
+        """Drop every demoted entry from the host tier (lock held,
+        counter-silent): warmup's post-dummy cleanup and the bench's
+        per-leg cache reset — ONE definition of host-tier teardown, so
+        the two cannot drift.  Blocks pinned by an in-flight promotion
+        snapshot survive their entry's removal (the pin holds its own
+        ref) and free when the snapshot completes."""
+        if self._host is None:
+            return
+        for _, (blocks, _) in self._host_prefix.items():
+            self._host.alloc.decref(blocks)
+        self._host_prefix.clear()
+        self._host_meta.clear()
+        self._update_kv_gauges_locked()
+
+    def _best_match_locked(self, entries, req: GenRequest) -> tuple:
+        """THE prefix matching rule (lock held): longest entry among
+        ``entries`` — (key, (payload, true rows)) pairs — usable for
+        ``req``, as (key, usable rows) or (None, 0).  One definition
+        shared by the dense inject path, the paged aliasing planner,
+        and the host tier's promotion pick, so every tier and layout
+        hits on exactly the same traffic."""
         best_key, best_usable = None, 0
-        for key, (entry, true_len) in self._prefix_cache.items():
+        for key, (entry, true_len) in entries:
             usable = min(true_len, len(req.tokens) - 1)
             if usable <= best_usable:
                 continue
@@ -3148,6 +3506,11 @@ class Engine:
                 if usable + tail_bucket <= self.max_len:
                     best_key, best_usable = key, usable
         return best_key, best_usable
+
+    def _best_prefix_locked(self, req: GenRequest) -> tuple:
+        """Longest DEVICE-resident cached prefix usable for ``req``
+        (lock held)."""
+        return self._best_match_locked(self._prefix_cache.items(), req)
 
     def _try_prefix_inject(
         self, slot: int, req: GenRequest
@@ -3214,11 +3577,15 @@ class Engine:
                     key, full * self.kv_block, "local"
                 )
                 while len(self._prefix_cache) > self.prefix_cache_size:
-                    ev_key, (ev_blocks, _) = self._prefix_cache.popitem(
-                        last=False
+                    # LRU size cap: demote to the host tier when
+                    # configured (ISSUE 15) — a cache sized for hot
+                    # entries keeps its warm tail promotable instead
+                    # of recomputing it on the next hit.
+                    ev_key = next(iter(self._prefix_cache))
+                    ev_blocks, ev_rows = self._prefix_cache[ev_key]
+                    self._retire_prefix_entry_locked(
+                        ev_key, ev_blocks, ev_rows
                     )
-                    self._prefix_meta.pop(ev_key, None)
-                    self._alloc.decref(ev_blocks)
                 if not self._warming:
                     self.prefix_injects += 1
                     self._m_prefix.inc("inject")
@@ -3234,17 +3601,35 @@ class Engine:
             while len(self._prefix_cache) > self.prefix_cache_size:
                 ev_key, _ = self._prefix_cache.popitem(last=False)
                 self._prefix_meta.pop(ev_key, None)
+                if not self._warming:
+                    # Dense entries have no block tier to demote to:
+                    # an LRU drop is a true eviction.
+                    self.prefix_evictions += 1
+                    self._m_prefix.inc("evict")
             if not self._warming:
                 self.prefix_injects += 1
                 self._m_prefix.inc("inject")
 
-    def _clear_prefix_cache_locked(self) -> None:
+    def _clear_prefix_cache_locked(self, demote: bool = False) -> None:
         """Drop every prefix entry (lock held) — paged entries release
         their block refs (warmup's dummy prompts must not pin pool
-        blocks forever)."""
+        blocks forever).  ``demote=True`` (the admission planner's
+        idle fallback) moves each entry to the host tier first when it
+        can — the permanent-shortage flush stops burning the whole
+        cache's prefill, it just pages it out."""
         if self.paged:
-            for _, (blocks, _) in self._prefix_cache.items():
+            for key, (blocks, rows) in self._prefix_cache.items():
+                demoted = demote and self._demote_entry_locked(
+                    key, blocks, rows
+                )
                 self._alloc.decref(blocks)
+                if not self._warming:
+                    if demoted:
+                        self.prefix_demotions += 1
+                        self._m_prefix.inc("demote")
+                    else:
+                        self.prefix_evictions += 1
+                        self._m_prefix.inc("evict")
             self._update_kv_gauges_locked()
         self._prefix_cache.clear()
         self._prefix_meta.clear()
@@ -3365,6 +3750,14 @@ class Engine:
         self._m_kv_blocks.set(
             float(self._alloc.shared_blocks), self._engine_label, "shared"
         )
+        if self._host is not None:
+            # The third tier state (ISSUE 15): blocks resident in host
+            # RAM — demoted prefix entries, parked slots, and
+            # in-flight tier writes.
+            self._m_kv_blocks.set(
+                float(self._host.alloc.used_blocks),
+                self._engine_label, "host",
+            )
 
     def _plan_paged_admission_locked(self, req: GenRequest, idle: bool):
         """Reserve everything ``req``'s admission needs from the pool
@@ -3416,8 +3809,10 @@ class Engine:
             # matched entry itself.  Drop the whole cache and re-plan
             # prefix-free: _validate guarantees that bound fits an
             # empty pool, so the queue can never wedge on cached
-            # prompts (no refs were taken above).
-            self._clear_prefix_cache_locked()
+            # prompts (no refs were taken above).  With the host tier
+            # on, the flush DEMOTES what it can first — the shortage
+            # clears either way, but the prefill survives.
+            self._clear_prefix_cache_locked(demote=True)
             best_key, usable, aliased, cow_src = None, 0, [], None
             start = 0
             total_blocks = fresh_needed = self._pool_blocks_needed(
@@ -3472,36 +3867,62 @@ class Engine:
     def _evict_prefix_for_locked(
         self, fresh_needed: int, keep_key=None
     ) -> None:
-        """Evict idle prefix entries LRU-first (never ``keep_key``) —
-        but ONLY when eviction can cover the shortfall now: entries
-        whose blocks are still aliased by running slots (or by a
-        sibling entry) free nothing, and flushing the cache without
-        admitting anyone trades future hits for zero blocks — the
-        head-of-line request retries every step, which would otherwise
-        empty the whole cache on one transient shortage.  The
-        exclusive-count sum undercounts mutually-aliased entry SETS
-        (evicting both would free what neither frees alone) —
-        conservative by design; the admission planner's idle fallback
+        """Reclaim pool blocks from idle prefix entries LRU-first
+        (never ``keep_key``) — but ONLY when that can cover the
+        shortfall now: entries whose blocks are still aliased by
+        running slots (or by a sibling entry) free nothing, and
+        flushing the cache without admitting anyone trades future hits
+        for zero blocks — the head-of-line request retries every step,
+        which would otherwise empty the whole cache on one transient
+        shortage.  The exclusive-count sum undercounts mutually-aliased
+        entry SETS (evicting both would free what neither frees alone)
+        — conservative by design; the admission planner's idle fallback
         covers that case when it matters.  Lock held; shared by the
-        prefix planner and the KV-import planner."""
+        prefix planner and the KV-import planner.
+
+        With the host tier configured (ISSUE 15), each victim is
+        DEMOTED — its block contents dispatched to host RAM before the
+        refs drop, so a later hit promotes instead of recomputing —
+        and destroyed only when the host tier cannot take it (no tier,
+        budget exhausted after host-LRU pressure).  Either way the
+        device blocks free right here; the two outcomes split into
+        prefix_demotions vs prefix_evictions."""
         victims = [
-            (key, blocks)
-            for key, (blocks, _) in self._prefix_cache.items()
+            (key, blocks, rows)
+            for key, (blocks, rows) in self._prefix_cache.items()
             if key != keep_key
         ]
         reclaimable = self._alloc.free_blocks + sum(
-            self._alloc.exclusive(blocks) for _, blocks in victims
+            self._alloc.exclusive(blocks) for _, blocks, _ in victims
         )
         if reclaimable < fresh_needed:
             return
-        for key, blocks in victims:
+        for key, blocks, rows in victims:
             if fresh_needed <= self._alloc.free_blocks:
                 break
             if not self._alloc.exclusive(blocks):
                 continue
-            self._prefix_cache.pop(key)
-            self._prefix_meta.pop(key, None)
-            self._alloc.decref(blocks)
+            self._retire_prefix_entry_locked(key, blocks, rows)
+
+    def _retire_prefix_entry_locked(
+        self, key: tuple, blocks, rows: int
+    ) -> None:
+        """Remove one prefix entry from the device cache, demoting its
+        blocks to the host tier when possible and destroying them
+        otherwise (lock held; the one retirement path shared by the
+        shortfall planners and the LRU size cap, so the
+        demote-vs-evict accounting cannot drift between call sites)."""
+        demoted = self._demote_entry_locked(key, blocks, rows)
+        self._prefix_cache.pop(key, None)
+        self._prefix_meta.pop(key, None)
+        self._alloc.decref(blocks)
+        if not self._warming:
+            if demoted:
+                self.prefix_demotions += 1
+                self._m_prefix.inc("demote")
+            else:
+                self.prefix_evictions += 1
+                self._m_prefix.inc("evict")
 
     def _commit_plan_locked(self, slot: int, plan: dict) -> None:
         row = self._tables_host[slot]
@@ -3509,6 +3930,501 @@ class Engine:
         row[: len(plan["blocks"])] = plan["blocks"]
         self._tables_dirty = True
         self._update_kv_gauges_locked()
+
+    # -- host-RAM KV overflow tier (ISSUE 15) ------------------------------
+
+    def _read_blocks_dispatch(self, blocks) -> list | None:
+        """Dispatch a ``read_block`` per pool leaf for each of
+        ``blocks`` against the CURRENT cache generation (lock held,
+        any thread) — returns per-block lists of device futures in
+        ``HostBlockPool.pools()`` leaf order, or None after losing the
+        donation race repeatedly.  On the driver thread the race
+        cannot happen (the driver is the only donor); a handler-thread
+        caller (a KV-ingest shortfall demoting entries) retries by
+        re-snapshotting ``self._cache``, the ``_gather_blocks``
+        pattern.  The reads are stream-ordered BEFORE any dispatch
+        that reuses the blocks, so the fetched bytes are always the
+        pre-reuse contents — the caller may decref immediately after
+        this returns."""
+        for _ in range(8):
+            cache = self._cache
+            pools = [
+                getattr(cache, name) for name, _ in self._host.pools()
+            ]
+            try:
+                return [
+                    [
+                        self._read_block(pool, jnp.int32(b))
+                        for pool in pools
+                    ]
+                    for b in blocks
+                ]
+            except (RuntimeError, ValueError):
+                # Donated mid-build (the dispatch surfaces a deleted
+                # buffer as INVALID_ARGUMENT ValueError, unlike the
+                # fetch path's RuntimeError): re-snapshot and retry.
+                continue
+        return None
+
+    def _demote_entry_locked(self, key: tuple, blocks, rows: int) -> bool:
+        """Move one idle prefix entry's block contents to the host
+        tier (lock held): allocate host blocks (evicting host-LRU
+        entries under budget pressure), dispatch the stream-ordered
+        reads, and queue the fetch for ``_complete_host_writes`` —
+        the entry becomes promotable only once the bytes land.
+        Returns False (caller falls back to true eviction) when the
+        host tier is off, cannot make room, or the read dispatch lost
+        the donation race out."""
+        if self._host is None or not blocks:
+            return False
+        host_key = tuple(key[:rows])
+        n = len(blocks)
+        if n > self._host.alloc.free_blocks:
+            self._evict_host_for_locked(n)
+        host_blocks = self._host.alloc.alloc(n)
+        if host_blocks is None:
+            return False
+        dev = self._read_blocks_dispatch(blocks)
+        if dev is None:
+            self._host.alloc.decref(host_blocks)
+            return False
+        meta = self._prefix_meta.get(key)
+        self._pending_host_writes.append(_HostWrite(
+            kind="prefix",
+            host_blocks=tuple(host_blocks),
+            dev=dev,
+            key=host_key,
+            rows=rows,
+            meta=dict(meta) if meta else None,
+        ))
+        if not self._warming:
+            self.kv_demotions += n
+            self._m_tier_moves.inc("demote", by=float(n))
+        self._update_kv_gauges_locked()
+        return True
+
+    def _evict_host_for_locked(self, need: int) -> None:
+        """Drop demoted entries host-LRU-first until ``need`` host
+        blocks are free (lock held) — but ONLY when eviction can
+        actually cover the need (the device-side reclaimable
+        precheck): flushing resident entries for a demotion or park
+        that cannot fit the budget anyway trades promotable prefill
+        for nothing.  Refcount-aware like its device twin: an entry
+        whose blocks are PINNED by an in-flight promotion snapshot
+        (``_plan_promote_locked``'s off-lock memcpy window) frees
+        nothing on decref, so it neither counts as reclaimable nor
+        gets destroyed for zero gained capacity.  A host eviction is
+        prefill lost forever — the tier's own budget pressure — so it
+        counts under prefix_evictions beside the device-side
+        destroys."""
+        victims = list(self._host_prefix.items())
+        reclaimable = self._host.alloc.free_blocks + sum(
+            self._host.alloc.exclusive(blocks)
+            for _, (blocks, _) in victims
+        )
+        if reclaimable < need:
+            return
+        for key, (blocks, _) in victims:
+            if self._host.alloc.free_blocks >= need:
+                break
+            if not self._host.alloc.exclusive(blocks):
+                continue  # pinned by an in-flight promote: skip
+            self._host_prefix.pop(key)
+            self._host_meta.pop(key, None)
+            self._host.alloc.decref(blocks)
+            if not self._warming:
+                self.prefix_evictions += 1
+                self._m_prefix.inc("evict")
+        self._update_kv_gauges_locked()
+
+    def _best_host_prefix_locked(self, req: GenRequest) -> tuple:
+        """Longest DEMOTED prefix usable for ``req`` (lock held) —
+        the host-tier view of the one matching rule, so promotion and
+        aliasing hit on exactly the same traffic."""
+        return self._best_match_locked(self._host_prefix.items(), req)
+
+    def _plan_promote_locked(self, req: GenRequest) -> dict | None:
+        """If a demoted entry covers more of ``req`` than anything
+        device-resident, reserve its promotion (lock held, submit
+        path, any thread): device blocks from FREE space only (a tier
+        under enough pressure to have demoted must not thrash entries
+        back and forth — budget exhausted degrades to recompute) and
+        one pin ref on the host blocks, so ``_stage_promote`` can
+        snapshot the payload OFF the engine lock — a multi-MB host
+        memcpy must not stall the driver's step behind a submit."""
+        if (
+            self._host is None
+            or not self.prefix_cache_size
+            or not self._host_prefix
+        ):
+            return None
+        host_key, host_usable = self._best_host_prefix_locked(req)
+        if host_key is None:
+            return None
+        _, dev_usable = self._best_prefix_locked(req)
+        if host_usable <= dev_usable:
+            return None  # the device tier already covers as much
+        if (
+            host_key in self._prefix_cache
+            or host_key in self._promote_staging
+            or any(
+                tuple(st.tokens) == host_key
+                for _, st, _ in self._prefix_installs
+            )
+        ):
+            return None  # already resident or staged (a cohort burst)
+        blocks, rows = self._host_prefix[host_key]
+        n = len(blocks)
+        self._sweep_prefix_installs_locked(time.monotonic())
+        if (
+            len(self._prefix_installs) >= PREFIX_IMPORT_MAX
+            or n > self._alloc.free_blocks
+        ):
+            return None
+        dev_blocks = self._alloc.alloc(n)
+        if dev_blocks is None:
+            return None
+        # Pin the host blocks for the off-lock copy: a host-LRU
+        # eviction may drop the ENTRY meanwhile, but the pinned rows
+        # cannot be reallocated (and only the driver's completion path
+        # ever writes pool rows), so the snapshot stays coherent.
+        self._host.alloc.incref(blocks)
+        self._promote_staging.add(host_key)
+        return {
+            "key": host_key,
+            "digest": self._host_meta.get(host_key, {}).get(
+                "digest", prefix_digest(host_key)
+            ),
+            "host_blocks": tuple(blocks),
+            "dev_blocks": tuple(dev_blocks),
+            "rows": rows,
+        }
+
+    def _stage_promote(self, plan: dict) -> None:
+        """Snapshot a planned promotion's payload (lock NOT held — the
+        copy is the expensive part) and stage it as a prefix install
+        for the driver's next admission boundary.  The host entry
+        stays resident and LRU-evictable while the install is staged:
+        a TTL'd or capacity-dropped install loses only the staged
+        copy, never the entry."""
+        try:
+            data = {
+                name: np.ascontiguousarray(
+                    pool[:, list(plan["host_blocks"])]
+                )
+                for name, pool in self._host.pools()
+            }
+        except BaseException:
+            with self._lock:
+                self._promote_staging.discard(plan["key"])
+                self._host.alloc.decref(plan["host_blocks"])
+                self._alloc.decref(plan["dev_blocks"])
+                self._update_kv_gauges_locked()
+            raise
+        with self._lock:
+            self._promote_staging.discard(plan["key"])
+            self._host.alloc.decref(plan["host_blocks"])
+            self._prefix_installs.append((
+                plan["digest"],
+                KvImport(
+                    import_id=-1,
+                    blocks=plan["dev_blocks"],
+                    rows=plan["rows"],
+                    tokens=list(plan["key"]),
+                    data=data,
+                    t_created=time.monotonic(),
+                ),
+                plan["key"],  # promote tag: clears the host entry
+            ))
+            self._update_kv_gauges_locked()
+
+    # oimlint: hotpath
+    def _complete_host_writes(self) -> None:
+        """Land every dispatched tier demotion in the host pool (one
+        BATCHED fetch through the readback accumulator — never a raw
+        device_get on the driver's spine) and make the results
+        visible: prefix entries become promotable, parked slots
+        become restorable.  Driver thread (or the serve loop's idle
+        path via step()); safe to call with nothing pending."""
+        with self._lock:
+            if not self._pending_host_writes:
+                return
+            staged, self._pending_host_writes = (
+                self._pending_host_writes, []
+            )
+        t0 = time.monotonic()
+        fetched = self._fetch_aux([w.dev for w in staged])
+        moved = 0
+        with self._lock:
+            for w, host_arrs in zip(staged, fetched):
+                pools = [pool for _, pool in self._host.pools()]
+                for hb, leaves in zip(w.host_blocks, host_arrs):
+                    for pool, arr in zip(pools, leaves):
+                        pool[:, hb] = np.asarray(arr)
+                moved += len(w.host_blocks)
+                if w.kind == "prefix":
+                    old = self._host_prefix.pop(w.key, None)
+                    if old is not None:
+                        # Re-demotion of a re-stored entry: same
+                        # contents, keep the fresh copy.
+                        self._host.alloc.decref(old[0])
+                    self._host_prefix[w.key] = (w.host_blocks, w.rows)
+                    meta = w.meta or {
+                        "digest": prefix_digest(w.key),
+                        "covered": w.rows,
+                        "hits": 0,
+                        "last_hit": time.monotonic(),
+                        "origin": "local",
+                    }
+                    self._host_meta[w.key] = meta
+                elif w.rid in self._parked:
+                    self._parked[w.rid].ready = True
+                else:
+                    # The parked request was reaped/cancelled/aborted
+                    # while its swap-out was in flight: nothing left
+                    # to restore, return the host blocks.
+                    self._host.alloc.decref(w.host_blocks)
+            if not self._warming:
+                dt = time.monotonic() - t0
+                self.kv_demote_seconds += dt
+                self._m_tier_seconds.inc("demote", by=dt)
+            self._update_kv_gauges_locked()
+
+    def _pick_park_victim_locked(self):
+        """The coldest idle slot (lock held, admission boundary — no
+        chunk in flight, so every active slot is between chunks): the
+        one with the largest remaining token budget, ties to the
+        youngest stream.  It will pin pool blocks longest, so swapping
+        it buys the most capacity per byte moved; QoS preemption will
+        later override this pick with tenant priority.  Slots that
+        have not emitted since their own restore are immune — a
+        restored slot must make progress before it can be parked
+        again, or a saturated queue ping-pongs one victim forever."""
+        best, best_key = None, None
+        for slot, state in self._slots.items():
+            if state.park_immune:
+                continue
+            rem = state.req.max_new_tokens - len(state.emitted)
+            if rem < 1:
+                continue  # finishing this chunk anyway
+            key = (rem, state.t_submit)
+            if best_key is None or key > best_key:
+                best, best_key = (slot, state.rid, state), key
+        return best
+
+    def _try_park_locked(self, req: GenRequest) -> bool:
+        """Park the coldest idle slot to make room for ``req``'s
+        admission (lock held, driver thread): copy its live blocks to
+        the host tier, free its device blocks AND its slot, and
+        remember everything a later restore needs.  Returns True when
+        a victim was parked (the caller re-plans against the freed
+        blocks).  The victim's stream simply pauses — its waiters and
+        callbacks stay registered, its deadline keeps running (a
+        parked request can still be reaped), and restore is exact:
+        block contents are bit-copies and every other per-slot input
+        is rebuilt from host truth."""
+        if not self.kv_park or self._host is None:
+            return False
+        pick = self._pick_park_victim_locked()
+        if pick is None:
+            return False
+        slot, rid, state = pick
+        rows = len(state.req.tokens) + len(state.emitted) - 1
+        if rows < 1:
+            return False
+        bs = self.kv_block
+        n_cov = -(-rows // bs)
+        row = self._tables_host[slot]
+        live = row[row < self.kv_blocks]
+        n_live = int(live.size)
+        if n_cov > n_live:
+            return False  # abort/reap raced: nothing coherent to park
+        cov = [int(b) for b in row[:n_cov]]
+        if n_cov > self._host.alloc.free_blocks:
+            self._evict_host_for_locked(n_cov)
+        host_blocks = self._host.alloc.alloc(n_cov)
+        if host_blocks is None:
+            return False
+        dev = self._read_blocks_dispatch(cov)
+        if dev is None:
+            self._host.alloc.decref(host_blocks)
+            return False
+        self._pending_host_writes.append(_HostWrite(
+            kind="park",
+            host_blocks=tuple(host_blocks),
+            dev=dev,
+            rid=rid,
+        ))
+        self._parked[rid] = _ParkedSlot(
+            state=state,
+            host_blocks=tuple(host_blocks),
+            n_cov=n_cov,
+            n_live=n_live,
+            rows=rows,
+        )
+        self._slots.pop(slot)
+        self._free.append(slot)
+        self._release_slot_blocks_locked(slot)
+        if not self._warming:
+            self.kv_parks += 1
+            self.kv_demotions += n_cov
+            self._m_tier_moves.inc("demote", by=float(n_cov))
+        self._m_active.set(float(len(self._slots)), self._engine_label)
+        return True
+
+    def _drop_parked_locked(self, rid: int) -> "_ParkedSlot | None":
+        """Forget one parked request and return its host blocks (lock
+        held) — the reap/cancel/abort path for a request that dies
+        while swapped out.  An in-flight swap-out fetch for this rid
+        finds it gone and returns the blocks itself."""
+        parked = self._parked.pop(rid, None)
+        if parked is None:
+            return None
+        if parked.ready:
+            # Not yet landed = the pending-write completion owns the
+            # decref (the blocks are its write target until then).
+            self._host.alloc.decref(parked.host_blocks)
+        self._update_kv_gauges_locked()
+        return parked
+
+    def _unpark_wave(self) -> None:
+        """Restore parked slots whose KV fits the pool again (driver
+        thread, admission boundary, lock NOT held): FIFO over parked
+        requests — the oldest victim gets its capacity back first —
+        stopping at the first that does not fit (restore order is a
+        fairness promise, not best-fit packing).  Restores never park
+        other slots; they only reclaim idle prefix blocks, so a
+        restore cannot cascade."""
+        while True:
+            with self._lock:
+                target = None
+                for rid, parked in self._parked.items():
+                    if parked.ready:
+                        target = (rid, parked)
+                    break  # FIFO: only ever consider the oldest
+                if target is None:
+                    return
+                rid, parked = target
+                if not self._free:
+                    return
+                if parked.n_live > self._alloc.free_blocks:
+                    self._evict_prefix_for_locked(parked.n_live)
+                blocks = self._alloc.alloc(parked.n_live)
+                if blocks is None and not (
+                    self._slots or self._admitting or self._queue
+                ):
+                    # The engine is otherwise idle, so ONLY prefix
+                    # entries hold blocks — possibly a mutually-
+                    # aliased set no per-entry exclusivity test can
+                    # free (the admission planner's idle-fallback
+                    # case).  Flush the cache (demoting what fits the
+                    # host budget) rather than spin on a restore that
+                    # can never fit: the parked reservation fit this
+                    # pool once, so an empty pool must cover it.
+                    self._clear_prefix_cache_locked(demote=True)
+                    blocks = self._alloc.alloc(parked.n_live)
+                if blocks is None:
+                    return
+                parked.restoring = True  # stays in _parked: visible to
+                slot = self._free.pop(0)  # cancel/reap/abort/in_flight
+                state = parked.state
+            # Device writes outside the lock (driver thread owns the
+            # cache): land the covered payload, then rebuild the
+            # per-slot device state from host truth.  Stream order
+            # ingest → restore → next dispatch keeps it exact.  The
+            # host pool rows are stable through this window — only the
+            # driver thread (us) ever writes them, and the record's
+            # continued _parked membership means nothing freed them.
+            t0 = time.monotonic()
+            self._write_host_payload(
+                parked.host_blocks, blocks[: parked.n_cov]
+            )
+            self._restore_slot_state(slot, state, parked.rows)
+            with self._lock:
+                if self._parked.pop(rid, None) is None:
+                    # abort() landed during the device writes: the
+                    # request is already failed and the host blocks
+                    # already returned by whoever popped the record —
+                    # unwind our reservation and move on.
+                    self._alloc.decref(blocks)
+                    self._free.append(slot)
+                    self._update_kv_gauges_locked()
+                    continue
+                row = self._tables_host[slot]
+                row[:] = self.kv_blocks
+                row[: parked.n_live] = blocks
+                self._tables_dirty = True
+                self._host.alloc.decref(parked.host_blocks)
+                state.park_immune = True
+                self._slots[slot] = state
+                if not self._warming:
+                    dt = time.monotonic() - t0
+                    self.kv_unparks += 1
+                    self.kv_promotions += parked.n_cov
+                    self.kv_promote_seconds += dt
+                    self._promote_walls.append(dt)
+                    self._m_tier_moves.inc(
+                        "promote", by=float(parked.n_cov)
+                    )
+                    self._m_tier_seconds.inc("promote", by=dt)
+                self._update_kv_gauges_locked()
+                self._m_active.set(
+                    float(len(self._slots)), self._engine_label
+                )
+
+    def _write_host_payload(self, host_blocks, dev_blocks) -> None:
+        """Write host-tier blocks back into the device pool (driver
+        thread): one warmup-precompiled ``_ingest`` per block, chained
+        through ``self._cache`` so the device stream orders the
+        promote ahead of everything dispatched after it."""
+        dummy = jnp.zeros((1,), jnp.float32)
+        quant = self._host.k_scale is not None
+        for hb, dst in zip(host_blocks, dev_blocks):
+            self._cache = self._ingest(
+                self._cache,
+                jnp.asarray(self._host.k[:, hb]),
+                jnp.asarray(self._host.v[:, hb]),
+                jnp.asarray(self._host.k_scale[:, hb]) if quant else dummy,
+                jnp.asarray(self._host.v_scale[:, hb]) if quant else dummy,
+                jnp.int32(dst),
+            )
+
+    def _restore_slot_state(
+        self, slot: int, state: "_SlotState", rows: int
+    ) -> None:
+        """Rebuild one restored slot's per-slot DEVICE state from host
+        truth (driver thread): the cache frontier, the spec-decode
+        history row, and the penalty occurrence rows — everything the
+        next fresh dispatch reads besides the KV blocks themselves.
+        Sampling needs nothing: the PRNG base is PRNGKey(req.seed) and
+        the key index is the host-side emitted count, so a restored
+        sampled stream continues exactly where it paused."""
+        tokens = list(state.req.tokens) + list(state.emitted)
+        track = bool(self.spec_decode) and self.draft_cfg is None
+        if track:
+            hist = np.zeros((self.max_len,), np.int32)
+            hist[: len(tokens)] = tokens
+            hist_row = jnp.asarray(hist)
+        else:
+            hist_row = self._restore_dummy_row
+        if self.penalties:
+            tok_row = jnp.asarray(np.bincount(
+                tokens, minlength=self.cfg.vocab_size
+            ).astype(np.int32))
+            gen_row = jnp.asarray(np.bincount(
+                state.emitted, minlength=self.cfg.vocab_size
+            ).astype(np.int32))
+        else:
+            tok_row = gen_row = self._restore_dummy_row
+        (
+            self._cache, self._history,
+            self._tok_counts, self._gen_counts,
+        ) = self._restore(
+            self._cache, self._history,
+            self._tok_counts, self._gen_counts,
+            jnp.int32(slot), jnp.int32(rows),
+            hist_row, tok_row, gen_row,
+        )
 
     # -- disaggregated prefill/decode: KV export/ingest (ISSUE 12) --------
 
@@ -3905,13 +4821,14 @@ class Engine:
         with self._lock:
             key = tuple(tokens)
             if key in self._prefix_cache or any(
-                tuple(st.tokens) == key for _, st in self._prefix_installs
+                tuple(st.tokens) == key
+                for _, st, _ in self._prefix_installs
             ):
                 return digest, 0  # already resident/staged: idempotent
             now = time.monotonic()
             self._sweep_prefix_installs_locked(now)
             while len(self._prefix_installs) >= PREFIX_IMPORT_MAX:
-                _, old = self._prefix_installs.pop(0)  # oldest first
+                _, old, _ = self._prefix_installs.pop(0)  # oldest first
                 self._alloc.decref(old.blocks)
             if n_ship > self._alloc.free_blocks:
                 self._evict_prefix_for_locked(n_ship)
@@ -3929,7 +4846,7 @@ class Engine:
                 tokens=tokens,
                 data={name: data[name] for name in names},
                 t_created=now,
-            )))
+            ), None))
             self.kv_ship_bytes += total
             self._update_kv_gauges_locked()
         return digest, rows
@@ -3939,11 +4856,13 @@ class Engine:
         that died between PUT and the next admission boundary leaks
         zero blocks past the TTL."""
         keep = []
-        for digest, st in self._prefix_installs:
+        for digest, st, promote_key in self._prefix_installs:
             if now - st.t_created > PREFIX_IMPORT_TTL_S:
+                # A TTL'd PROMOTE loses only its staged copy — the
+                # demoted entry is still resident in the host tier.
                 self._alloc.decref(st.blocks)
             else:
-                keep.append((digest, st))
+                keep.append((digest, st, promote_key))
         if len(keep) != len(self._prefix_installs):
             self._prefix_installs = keep
             self._update_kv_gauges_locked()
@@ -3965,7 +4884,8 @@ class Engine:
                 return 0
             staged, self._prefix_installs = self._prefix_installs, []
         installed = 0
-        for digest, st in staged:
+        for digest, st, promote_key in staged:
+            t0 = time.monotonic()
             self._write_import_blocks(st)
             with self._lock:
                 key = tuple(st.tokens)
@@ -3975,15 +4895,43 @@ class Engine:
                     self._alloc.decref(st.blocks)
                 else:
                     self._prefix_cache[key] = (tuple(st.blocks), st.rows)
-                    self._set_prefix_meta_locked(key, st.rows, "fetched")
-                    while len(self._prefix_cache) > self.prefix_cache_size:
-                        ev_key, (ev_entry, _) = self._prefix_cache.popitem(
-                            last=False
+                    origin = "fetched"
+                    if promote_key is not None:
+                        # Host-tier promotion: the entry keeps its
+                        # original origin — a promoted local entry is
+                        # still local traffic's prefill, not a sibling
+                        # ship.
+                        origin = (
+                            self._host_meta.get(promote_key, {})
+                            .get("origin", "local")
                         )
-                        self._prefix_meta.pop(ev_key, None)
-                        self._alloc.decref(ev_entry)
-                    self.prefix_fetch_installs += 1
+                    self._set_prefix_meta_locked(key, st.rows, origin)
+                    while len(self._prefix_cache) > self.prefix_cache_size:
+                        ev_key = next(iter(self._prefix_cache))
+                        ev_entry, ev_rows = self._prefix_cache[ev_key]
+                        self._retire_prefix_entry_locked(
+                            ev_key, ev_entry, ev_rows
+                        )
+                    if promote_key is None:
+                        self.prefix_fetch_installs += 1
                     installed += 1
+                if promote_key is not None:
+                    # Promotion landed (or lost a race to a local
+                    # store, same outcome — the prefix is device-
+                    # resident): the host copy is redundant now, so
+                    # its budget frees for the next demotion.
+                    host = self._host_prefix.pop(promote_key, None)
+                    if host is not None:
+                        self._host_meta.pop(promote_key, None)
+                        self._host.alloc.decref(host[0])
+                    if not self._warming:
+                        dt = time.monotonic() - t0
+                        n = len(st.blocks)
+                        self.kv_promotions += n
+                        self.kv_promote_seconds += dt
+                        self._promote_walls.append(dt)
+                        self._m_tier_moves.inc("promote", by=float(n))
+                        self._m_tier_seconds.inc("promote", by=dt)
                 self._update_kv_gauges_locked()
         return installed
 
@@ -4025,16 +4973,21 @@ class Engine:
         prefill dispatch so the single device stream orders
         import → tail prefill → decode (the CoW chaining pattern)."""
         dummy = jnp.zeros((1,), jnp.float32)
+        # Scales ride whenever the pool carries them: int8 for KV-ship
+        # ingests, int8 OR int4 for host-tier promotions (kv4 never
+        # ships, but it demotes/promotes locally — same process, no
+        # wire dtype to worry about).
+        quant = self._cache.k_scale is not None
         for j, dst in enumerate(imp.blocks):
             kb = jnp.asarray(imp.data["k"][:, j])
             vb = jnp.asarray(imp.data["v"][:, j])
             ksb = (
                 jnp.asarray(imp.data["k_scale"][:, j])
-                if self.kv_int8 else dummy
+                if quant else dummy
             )
             vsb = (
                 jnp.asarray(imp.data["v_scale"][:, j])
-                if self.kv_int8 else dummy
+                if quant else dummy
             )
             self._cache = self._ingest(
                 self._cache, kb, vb, ksb, vsb, jnp.int32(dst)
@@ -4193,6 +5146,9 @@ class Engine:
             ) + sum(
                 max(0, s.req.max_new_tokens - len(s.emitted))
                 for s in self._slots.values()
+            ) + sum(
+                max(0, p.state.req.max_new_tokens - len(p.state.emitted))
+                for p in self._parked.values()
             )
             rate = self._token_rate_ewma
         if rate is None or rate <= 0.0:
@@ -4312,6 +5268,11 @@ class Engine:
         before; budget exhaustion is host-deterministic, so this waste
         is simply never dispatched.
         """
+        # Land tier demotions dispatched on earlier steps (one batched
+        # accumulator fetch): demoted entries become promotable and
+        # parked slots restorable before this step's admission
+        # boundary looks at either.
+        self._complete_host_writes()
         self._reap()
         with self._lock:
             elide_tail = (
@@ -4391,6 +5352,10 @@ class Engine:
                 or any(
                     s.req.deadline is not None for s in self._slots.values()
                 )
+                or any(
+                    p.state.req.deadline is not None
+                    for p in self._parked.values()
+                )
             ):
                 return
             keep = []
@@ -4441,6 +5406,32 @@ class Engine:
                 cb = self._callbacks.pop(state.rid, None)
                 if cb is not None:
                     ended.append(cb)
+            # Parked requests (ISSUE 15) keep their deadlines running —
+            # a swap-out is invisible to the failure taxonomy, so a
+            # parked victim expires/cancels exactly like an active one
+            # (its host blocks return to the tier budget).
+            for rid in list(self._parked):
+                state = self._parked[rid].state
+                if rid in self._cancelled:
+                    kind, msg = "cancelled", "client went away while parked"
+                elif (
+                    state.req.deadline is not None
+                    and now >= state.req.deadline
+                ):
+                    kind = "deadline"
+                    msg = (
+                        f"expired after {len(state.emitted)} tokens "
+                        f"(parked in the host tier)"
+                    )
+                    if not self._warming:
+                        self._m_deadline.inc()
+                else:
+                    continue
+                self._drop_parked_locked(rid)
+                self._fail_locked(rid, kind, msg, state=state)
+                cb = self._callbacks.pop(rid, None)
+                if cb is not None:
+                    ended.append(cb)
             self._m_active.set(float(len(self._slots)), self._engine_label)
         self._drain_fail_obs()
         for cb in ended:  # end-of-stream outside the lock
@@ -4468,9 +5459,15 @@ class Engine:
         if self._inflight is not None:
             return
         # Admission boundary = the device-write window: land any staged
-        # prefix installs first, so a request admitted in THIS wave can
-        # already alias the just-shipped entry.
+        # prefix installs first (sibling ships AND host-tier
+        # promotions), so a request admitted in THIS wave can already
+        # alias the just-shipped entry.
         self.install_prefix_imports()
+        # Parked slots restore BEFORE new admissions (ISSUE 15): the
+        # victim was admitted first, and restore-priority is what
+        # bounds how long a swap-out lasts once capacity returns.
+        if self._parked:
+            self._unpark_wave()
         with self._lock:
             admissions = []
             while self._queue and self._free:
@@ -4510,6 +5507,17 @@ class Engine:
                                 and not admissions
                             ),
                         )
+                        if plan is None and self._try_park_locked(req):
+                            # Swap-based parking (ISSUE 15): the
+                            # coldest idle slot's table moved to the
+                            # host tier, freeing its blocks AND its
+                            # slot — re-plan once against them.  One
+                            # victim per wave per head request keeps
+                            # pressure gradual; the next step can park
+                            # another if the shortage persists.
+                            plan = self._plan_paged_admission_locked(
+                                req, idle=False,
+                            )
                     if plan is None:
                         break
                 self._queue.pop(0)
@@ -5128,13 +6136,15 @@ class Engine:
             self._draining = True
 
     def in_flight(self) -> int:
-        """Queued + admitting + active + slot-free (beam/embed) request
-        count — what a drain waits on."""
+        """Queued + admitting + active + parked + slot-free (beam/
+        embed) request count — what a drain waits on (a parked request
+        still owes its client tokens)."""
         with self._lock:
             return (
                 len(self._queue)
                 + len(self._admitting)
                 + len(self._slots)
+                + len(self._parked)
                 + self._aux_active
             )
 
@@ -5204,14 +6214,16 @@ class Engine:
                 self._cache = self._cow(
                     self._cache, jnp.int32(0), jnp.int32(0)
                 )
-            if self.paged and not self.kv_int4:
+            if self.paged and (not self.kv_int4 or self._host is not None):
                 # Compile the KV-ship ingest write too (ONE program, dst
                 # traced): the first PUT /v1/kv continuation must not
                 # pay a mid-stream compile — the CoW-precompile rule
                 # applied to disaggregation.  Pool contents here are
                 # warmup dummies (cleared below), so zeroing block 0 is
-                # inert.  kv4 engines skip it: their ships are refused
-                # at import/export, so the program never runs.
+                # inert.  kv4 engines skip it UNLESS the host tier is
+                # on: their ships are refused at import/export, but
+                # host-tier promotions ride the same program locally
+                # (ISSUE 15) and must not compile mid-stream either.
                 zk = jnp.zeros(
                     (self.cfg.n_layers, self.kv_block, self.cfg.kv_heads,
                      self.cfg.head_dim),
@@ -5223,12 +6235,36 @@ class Engine:
                          self.cfg.kv_heads),
                         jnp.float32,
                     )
-                    if self.kv_int8
+                    if self.kv_quant
                     else jnp.zeros((1,), jnp.float32)
                 )
                 self._cache = self._ingest(
                     self._cache, zk, zk, zs, zs, jnp.int32(0)
                 )
+            if self._host is not None:
+                # Compile the host tier's whole device surface (ISSUE
+                # 15) so warm demote/promote/park cycles run at zero
+                # steady-state compiles (the jit-guard pin): the
+                # per-leaf read_block programs (one per pool leaf
+                # shape — k/v share, scales share) and the slot-restore
+                # scatter.  Reads of block 0 and a self-restore of
+                # slot 0's current state are inert; the fetch below
+                # also compiles nothing (device_get is not a program).
+                reads = [
+                    self._read_block(getattr(self._cache, name),
+                                     jnp.int32(0))
+                    for name, _ in self._host.pools()
+                ]
+                self._fetch_aux(reads)
+                if self.kv_park:
+                    dummy_state = _SlotState(
+                        rid=-1,
+                        req=GenRequest(tokens=[0], max_new_tokens=1),
+                        base=self._zero_key,
+                        t_submit=time.monotonic(),
+                        emitted=[0],
+                    )
+                    self._restore_slot_state(0, dummy_state, 0)
             if embed:
                 # Optional: one full-forward compile per bucket — only
                 # deployments that actually serve /v1/embed should pay it.
@@ -5238,6 +6274,11 @@ class Engine:
                 self.result(rid, timeout=0)
             with self._lock:  # dummy prompts must not occupy live entries
                 self._clear_prefix_cache_locked()
+                # Warmup pressure may have DEMOTED dummy entries
+                # (exercising the tier is fine — precompiled paths are
+                # the point) but they must not squat in the host
+                # budget after: flush the host tier too.
+                self._flush_host_tier_locked()
         finally:
             self._warming = False
         return self

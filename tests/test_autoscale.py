@@ -220,6 +220,17 @@ class TestLoadSchema:
             ],
             "prefix_hits": 3,
             "prefix_misses": 1,
+            # Host-RAM KV overflow tier (ISSUE 15): second-tier
+            # headroom, demote/promote movement, parked slots, and
+            # the demote-vs-evict split.
+            "kv_host_blocks_total": 128,
+            "kv_host_blocks_free": 100,
+            "kv_host_fragmentation": 0.1,
+            "kv_demotions": 6,
+            "kv_promotions": 4,
+            "parked_slots": 1,
+            "prefix_demotions": 3,
+            "prefix_evictions": 1,
             "token_rate": 41.5,
             "shed_queue_full": 1,
             "shed_deadline": 0,

@@ -1,0 +1,641 @@
+"""Host-RAM KV overflow tier (ISSUE 15): demote→promote must be
+invisible to exactness, both tiers leak-free on every failure path,
+and the warm tier machinery compile-free.
+
+The load-bearing properties:
+
+- **Token-identical across a demote→promote cycle.**  A request whose
+  prefix was demoted to host RAM and promoted back emits exactly the
+  tokens the same request emits via recompute prefill — greedy,
+  sampled, speculative, CoW-triggering partial hits, and mid-stream
+  admissions, across {fp, kv_int8, kv_int4} × pipeline depth {1, 2} —
+  because the host copy is a bit-copy of the pool blocks (quantized
+  payloads and scale planes included) and promotion rides the same
+  ingest program a KV ship uses.
+- **Exact slot parking.**  A mid-stream request swapped out by an
+  admission it could not coexist with resumes, after restore, with
+  the same tokens a never-parked run emits (the PRNG key is a function
+  of seed + absolute token index; every other per-slot input is
+  rebuilt from host truth).
+- **Zero leaked blocks in either tier.**  Finish, deadline reap,
+  cancel, and abort all return device AND host blocks; a parked
+  request that dies mid-swap self-cleans.
+- **Degrade = today's behavior.**  No tier, budget exhausted, or a
+  full device pool at promote time → recompute/evict exactly as
+  before, with the demote-vs-evict split telling "moved to host" from
+  "lost forever".
+- **Zero steady-state compiles.**  A warm engine demotes, promotes,
+  parks, and restores without a single new XLA compile (the
+  warmup-precompiled read/ingest/restore programs — the jit-guard
+  stance).
+
+Engines are shared per quant config with pipeline depth switched on
+the warm engine (the PR 5 A/B lever), the test-serve compile-budget
+discipline; this file backs ``make test-serve-overflow`` (210 s cap).
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from test_jit_guard import compile_delta
+
+from oim_tpu.autoscale import decode_load, encode_load
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.serve import Engine, GenRequest
+
+pytestmark = pytest.mark.serve_overflow
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+HOST_BYTES = 1 << 20
+
+# One engine per quant × {plain, spec} config, warmed once and shared
+# by every scenario (pipeline depth is a runtime A/B on the warm
+# engine).  kv_blocks=10 with 5-block worst cases is the pressure
+# geometry: one resident 2-block entry + two concurrent requests
+# overflow the pool by exactly enough that the planner must demote.
+BASE = dict(
+    n_slots=4, max_len=64, chunk=4, prompt_buckets=(16, 32),
+    kv_block=8, kv_blocks=10, prefix_cache_size=2,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+_ENGINES: dict = {}
+
+
+def _engine(setup, **kw):
+    cfg, params = setup
+    key = tuple(sorted(kw.items()))
+    if key not in _ENGINES:
+        args = dict(BASE)
+        args.update(kw)
+        _ENGINES[key] = Engine(
+            params, cfg, kv_host_bytes=HOST_BYTES, **args
+        ).warmup()
+    return _ENGINES[key]
+
+
+def _prompt(seed: int, n: int) -> list[int]:
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG["vocab_size"], size=n).tolist()
+
+
+def _flush_tiers(e: Engine) -> None:
+    """Drop every prefix entry in BOTH tiers (idle engine) so the next
+    run of the same request takes the recompute path — the oracle
+    reset.  Counter-silent (warming guard), so tests can assert on the
+    demote/evict split without subtracting flush noise."""
+    e._warming = True
+    try:
+        with e._lock:
+            e._clear_prefix_cache_locked()
+            e._flush_host_tier_locked()
+    finally:
+        e._warming = False
+
+
+def _gen(e: Engine, tokens, mn=4, **kw) -> list[int]:
+    rid = e.submit(GenRequest(tokens=tokens, max_new_tokens=mn, **kw))
+    e.run()
+    return e.result(rid, timeout=0)
+
+
+def _store_entry(e: Engine, tokens) -> None:
+    rid = e.submit(GenRequest(
+        tokens=tokens, max_new_tokens=2, cache_prefix=True,
+    ))
+    e.run()
+    e.result(rid, timeout=0)
+
+
+def _pressure(e: Engine, spec: bool) -> None:
+    """Three concurrent worst-case admissions against the 10-block
+    pool: the resident entry's blocks are the shortfall, so the
+    planner demotes it (the reclaimable precheck holds — the entry is
+    idle and exclusive)."""
+    mn = 20 if spec else 24  # 5 worst-case blocks either way
+    rids = [
+        e.submit(GenRequest(tokens=_prompt(100 + i, 16), max_new_tokens=mn))
+        for i in range(3)
+    ]
+    e.run()
+    for rid in rids:
+        e.result(rid, timeout=0)
+
+
+def _no_leaks(e: Engine) -> None:
+    """Device blocks = resident entries' refs only; host blocks =
+    demoted entries + parked slots only (both tiers drained of
+    transient owners)."""
+    s = e.stats()
+    assert s["active_slots"] == 0 and s["queued"] == 0
+    assert s["parked_slots"] == 0
+    with e._lock:
+        entry_blocks = set()
+        for blocks, _ in e._prefix_cache.values():
+            entry_blocks.update(blocks)
+        assert e._alloc.used_blocks == len(entry_blocks), (
+            e._alloc.used_blocks, entry_blocks,
+        )
+        host_blocks = set()
+        for blocks, _ in e._host_prefix.values():
+            host_blocks.update(blocks)
+        assert e._host.alloc.used_blocks == len(host_blocks), (
+            e._host.alloc.used_blocks, host_blocks,
+        )
+
+
+# ---------------------------------------------------------------------------
+# The demote→promote exactness matrix:
+# {greedy, temp>0, spec-decode, prefix-CoW hit, mid-stream admission}
+# × {fp, kv_int8, kv_int4} × pipeline depth {1, 2}, token-identical to
+# the never-swapped oracle (same engine, both tiers flushed).
+
+QUANTS = [
+    {},
+    {"kv_int8": True},
+    {"kv_int4": True},
+]
+
+
+def _demote_promote_cycle(e, spec, hit_tokens, depth, **gkw):
+    """Seed an entry, demote it under pressure, then serve
+    ``hit_tokens`` (which promotes + hits) — returns (tokens, oracle
+    tokens from the recompute path)."""
+    e.set_pipeline_depth(depth)
+    _flush_tiers(e)
+    oracle = _gen(e, hit_tokens, **gkw)
+    _flush_tiers(e)
+    base = _prompt(1, 16)
+    _store_entry(e, base)
+    d0 = e.stats()["prefix_demotions"]
+    _pressure(e, spec)
+    s = e.stats()
+    assert s["prefix_demotions"] > d0, "pressure did not demote"
+    assert s["host_prefix_entries"] >= 1
+    p0 = e.stats()["kv_promotions"]
+    h0 = e.stats()["prefix_hits"]
+    out = _gen(e, hit_tokens, **gkw)
+    s = e.stats()
+    assert s["kv_promotions"] > p0, "hit did not promote"
+    assert s["prefix_hits"] > h0, "promoted entry did not hit"
+    return out, oracle
+
+
+@pytest.mark.parametrize("quant", QUANTS, ids=["fp", "kv8", "kv4"])
+@pytest.mark.parametrize("depth", [1, 2])
+def test_demote_promote_greedy(setup, quant, depth):
+    e = _engine(setup, **quant)
+    hit = _prompt(1, 16) + _prompt(2, 8)  # block-aligned extension
+    out, oracle = _demote_promote_cycle(e, False, hit, depth)
+    assert out == oracle
+    _no_leaks(e)
+
+
+@pytest.mark.parametrize("quant", QUANTS, ids=["fp", "kv8", "kv4"])
+@pytest.mark.parametrize("depth", [1, 2])
+def test_demote_promote_sampled(setup, quant, depth):
+    e = _engine(setup, **quant)
+    hit = _prompt(1, 16) + _prompt(3, 8)
+    out, oracle = _demote_promote_cycle(
+        e, False, hit, depth, temperature=0.8, seed=11,
+    )
+    assert out == oracle
+    _no_leaks(e)
+
+
+@pytest.mark.parametrize("quant", QUANTS, ids=["fp", "kv8", "kv4"])
+@pytest.mark.parametrize("depth", [1, 2])
+def test_demote_promote_cow_hit(setup, quant, depth):
+    # The hit extends the promoted entry by a NON-block-aligned tail:
+    # the partial entry block copy-on-writes right after the promote
+    # ingest, device-stream-ordered before the tail prefill.
+    e = _engine(setup, **quant)
+    hit = _prompt(1, 16) + _prompt(4, 3)
+    out, oracle = _demote_promote_cycle(e, False, hit, depth)
+    assert out == oracle
+    _no_leaks(e)
+
+
+@pytest.mark.parametrize("quant", QUANTS, ids=["fp", "kv8", "kv4"])
+@pytest.mark.parametrize("depth", [1, 2])
+def test_demote_promote_spec_decode(setup, quant, depth):
+    e = _engine(setup, spec_decode=2, **quant)
+    hit = _prompt(1, 16) + _prompt(5, 8)
+    out, oracle = _demote_promote_cycle(e, True, hit, depth)
+    assert out == oracle
+    _no_leaks(e)
+
+
+@pytest.mark.parametrize("quant", QUANTS, ids=["fp", "kv8", "kv4"])
+@pytest.mark.parametrize("depth", [1, 2])
+def test_demote_promote_mid_stream_admission(setup, quant, depth):
+    """The promoted hit admits MID-STREAM beside an active request —
+    the promote's staged install lands at the admission boundary the
+    pipelined step loop grants, not on an idle engine."""
+    e = _engine(setup, **quant)
+    e.set_pipeline_depth(depth)
+    hit = _prompt(1, 16) + _prompt(6, 8)
+    _flush_tiers(e)
+    oracle = _gen(e, hit)
+    _flush_tiers(e)
+    _store_entry(e, _prompt(1, 16))
+    _pressure(e, False)
+    assert e.stats()["host_prefix_entries"] >= 1
+    long_rid = e.submit(GenRequest(tokens=_prompt(7, 16),
+                                   max_new_tokens=24))
+    e.step()  # long request admitted + first chunks in flight
+    e.step()
+    rid = e.submit(GenRequest(tokens=hit, max_new_tokens=4))
+    e.run()
+    assert e.result(rid, timeout=0) == oracle
+    assert len(e.result(long_rid, timeout=0)) == 24
+    assert e.stats()["prefix_hits"] > 0
+    _no_leaks(e)
+
+
+# ---------------------------------------------------------------------------
+# Swap-based slot parking: restore is exact, lifecycle paths leak-free.
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+@pytest.mark.parametrize("sampled", [False, True], ids=["greedy", "temp"])
+def test_park_restore_token_identical(setup, depth, sampled):
+    e = _engine(setup, kv_blocks=8, prefix_cache_size=0)
+    e.set_pipeline_depth(depth)
+    gkw = dict(temperature=0.8) if sampled else {}
+    # Solo oracles first (same engine, nothing else running).
+    pA, pB = _prompt(20, 16), _prompt(21, 16)
+    oA = _gen(e, pA, mn=30, seed=7, **gkw)
+    oB = _gen(e, pB, mn=30, seed=9, **gkw)
+    # Concurrent: 6-block worst cases cannot coexist in the 8-block
+    # pool — B's admission parks A, restore resumes A exactly.
+    ra = e.submit(GenRequest(tokens=pA, max_new_tokens=30, seed=7, **gkw))
+    rb = e.submit(GenRequest(tokens=pB, max_new_tokens=30, seed=9, **gkw))
+    e.run()
+    s = e.stats()
+    assert s["kv_parks"] > 0 and s["kv_unparks"] == s["kv_parks"]
+    assert e.result(ra, timeout=0) == oA
+    assert e.result(rb, timeout=0) == oB
+    _no_leaks(e)
+
+
+def test_park_spec_ngram_restore(setup):
+    # n-gram speculative state (device history row) is rebuilt from
+    # host truth on restore.
+    e = _engine(setup, kv_blocks=8, prefix_cache_size=0, spec_decode=2)
+    pA, pB = _prompt(22, 16), _prompt(23, 16)
+    oA = _gen(e, pA, mn=26, seed=7)
+    oB = _gen(e, pB, mn=26, seed=9)
+    ra = e.submit(GenRequest(tokens=pA, max_new_tokens=26, seed=7))
+    rb = e.submit(GenRequest(tokens=pB, max_new_tokens=26, seed=9))
+    e.run()
+    assert e.stats()["kv_parks"] > 0
+    assert e.result(ra, timeout=0) == oA
+    assert e.result(rb, timeout=0) == oB
+    _no_leaks(e)
+
+
+def test_parked_deadline_reaped(setup):
+    e = _engine(setup, kv_blocks=8, prefix_cache_size=0)
+    pA = _prompt(24, 16)
+    ra = e.submit(GenRequest(
+        tokens=pA, max_new_tokens=30,
+        deadline=time.monotonic() + 0.25,
+    ))
+    rb = e.submit(GenRequest(tokens=_prompt(25, 16), max_new_tokens=30))
+    # A admits in wave 1; B's admission parks A at the next boundary.
+    for _ in range(8):
+        e.step()
+        if e.stats()["parked_slots"]:
+            break
+    assert e.stats()["parked_slots"] == 1
+    # Expire A WHILE parked: the reap must fail it and return its
+    # host blocks — a swap-out is invisible to the failure taxonomy.
+    time.sleep(0.3)
+    e.run()
+    from oim_tpu.serve.engine import RequestFailedError
+
+    assert len(e.result(rb, timeout=0)) == 30
+    with pytest.raises(RequestFailedError, match="parked"):
+        e.result_full(ra, timeout=0)
+    _no_leaks(e)
+
+
+def test_parked_cancel_and_abort(setup):
+    e = _engine(setup, kv_blocks=8, prefix_cache_size=0)
+    from oim_tpu.serve.engine import RequestFailedError
+
+    # cancel() a parked request: reaped at the next step, blocks home.
+    ra = e.submit(GenRequest(tokens=_prompt(26, 16), max_new_tokens=30))
+    rb = e.submit(GenRequest(tokens=_prompt(27, 16), max_new_tokens=30))
+    for _ in range(3):
+        e.step()  # admit A, park A for B, B decoding
+    if e.stats()["parked_slots"]:
+        assert e.cancel(ra)
+        e.run()
+        with pytest.raises(RequestFailedError):
+            e.result_full(ra, timeout=0)
+        assert len(e.result(rb, timeout=0)) == 30
+    else:  # scheduling landed differently: still drain clean
+        e.run()
+    _no_leaks(e)
+    # abort() with a slot parked AND a swap-out in flight: everything
+    # fails, both tiers drain.
+    ra = e.submit(GenRequest(tokens=_prompt(28, 16), max_new_tokens=30))
+    rb = e.submit(GenRequest(tokens=_prompt(29, 16), max_new_tokens=30))
+    for _ in range(2):
+        e.step()
+    e.abort("test abort")
+    for rid in (ra, rb):
+        with pytest.raises((RequestFailedError, RuntimeError)):
+            e.result_full(rid, timeout=0)
+    e.run()  # drains the in-flight host write, if any
+    _no_leaks(e)
+
+
+def test_cancel_during_restore_window_not_dropped(setup):
+    """A cancel() landing while _unpark_wave has the lock released for
+    the restore's device writes must still take effect: the record
+    stays in _parked (restoring=True) through the window, so the
+    cancel marks it and the next reap fails the restored slot —
+    instead of returning False and streaming to a dead client."""
+    e = _engine(setup, kv_blocks=8, prefix_cache_size=0)
+    from oim_tpu.serve.engine import RequestFailedError
+
+    ra = e.submit(GenRequest(tokens=_prompt(33, 16), max_new_tokens=30))
+    rb = e.submit(GenRequest(tokens=_prompt(34, 16), max_new_tokens=30))
+    orig = e._write_host_payload
+    cancelled = []
+
+    def mid_restore(host_blocks, dev_blocks):
+        # First restore write = ra coming back: cancel it right here,
+        # inside the lock-released device-write window.
+        if not cancelled:
+            cancelled.append(e.cancel(ra))
+        orig(host_blocks, dev_blocks)
+
+    e._write_host_payload = mid_restore
+    try:
+        e.run()
+    finally:
+        e._write_host_payload = orig
+    assert cancelled == [True]  # visible mid-window, not "unknown"
+    with pytest.raises(RequestFailedError):
+        e.result_full(ra, timeout=0)
+    assert len(e.result(rb, timeout=0)) == 30
+    _no_leaks(e)
+
+
+def test_draft_model_engine_refuses_parking(setup):
+    cfg, params = setup
+    draft_cfg = TransformerConfig(**{**CFG, "n_layers": 1})
+    draft_params = init_params(jax.random.PRNGKey(1), draft_cfg)
+    e = Engine(
+        params, cfg, **{**BASE, "prefix_cache_size": 0},
+        kv_host_bytes=HOST_BYTES, spec_decode=2,
+        draft_params=draft_params, draft_cfg=draft_cfg,
+    )
+    # The draft slot cache is device-derived state restore cannot
+    # rebuild — parking stays off, demote/promote stays available.
+    assert not e.kv_park
+    assert e._host is not None
+
+
+# ---------------------------------------------------------------------------
+# Degrade paths and accounting.
+
+
+def test_no_tier_still_evicts(setup):
+    cfg, params = setup
+    e = Engine(params, cfg, **BASE).warmup()
+    _store_entry(e, _prompt(1, 16))
+    ev0 = e.stats()["prefix_evictions"]
+    _pressure(e, False)
+    s = e.stats()
+    assert s["prefix_evictions"] > ev0  # today's behavior, now counted
+    assert s["prefix_demotions"] == 0
+    assert s["kv_host_blocks_total"] == 0
+
+
+def test_host_budget_exhausted_evicts_lru(setup):
+    cfg, params = setup
+    # Budget = 2 blocks: exactly one demoted entry fits; the second
+    # demotion host-LRU-evicts the first (lost forever → eviction
+    # counter), never leaks, never wedges.
+    row_bytes = Engine(
+        params, cfg, **BASE, kv_host_bytes=HOST_BYTES
+    )._kv_row_bytes
+    e = Engine(
+        params, cfg, **BASE, kv_host_bytes=2 * 8 * row_bytes,
+    ).warmup()
+    assert e.stats()["kv_host_blocks_total"] == 2
+    _store_entry(e, _prompt(1, 16))
+    _pressure(e, False)
+    assert e.stats()["host_prefix_entries"] == 1
+    _store_entry(e, _prompt(40, 16))
+    ev0 = e.stats()["prefix_evictions"]
+    _pressure(e, False)
+    s = e.stats()
+    assert s["host_prefix_entries"] == 1  # LRU replaced, not grown
+    assert s["prefix_evictions"] > ev0
+    _no_leaks(e)
+
+
+def test_host_evict_skips_pinned_entries(setup):
+    """A host entry pinned by an in-flight promotion snapshot frees
+    nothing on decref: the host-LRU evictor must neither count it as
+    reclaimable nor destroy it for zero gained capacity (the
+    refcount-aware precheck, mirroring the device twin)."""
+    e = _engine(setup)
+    _flush_tiers(e)
+    _store_entry(e, _prompt(1, 16))
+    _pressure(e, False)
+    with e._lock:
+        assert e._host_prefix
+        key, (blocks, _) = next(iter(e._host_prefix.items()))
+        e._host.alloc.incref(blocks)  # the promote snapshot's pin
+        free0 = e._host.alloc.free_blocks
+        ev0 = e.prefix_evictions
+        e._evict_host_for_locked(free0 + 1)
+        # Pinned: survives, nothing counted, nothing freed.
+        assert key in e._host_prefix
+        assert e.prefix_evictions == ev0
+        assert e._host.alloc.free_blocks == free0
+        e._host.alloc.decref(blocks)  # pin released
+        e._evict_host_for_locked(free0 + 1)
+        # Exclusive again: LRU eviction proceeds and covers the need.
+        assert key not in e._host_prefix
+        assert e.prefix_evictions == ev0 + 1
+        assert e._host.alloc.free_blocks > free0
+    _flush_tiers(e)
+    _no_leaks(e)
+
+
+def test_promote_capacity_shortfall_recomputes(setup):
+    """A demoted entry whose promotion cannot reserve device blocks
+    degrades to recompute — token-identical, entry retained in the
+    host tier for a later promote."""
+    e = _engine(setup)
+    _flush_tiers(e)
+    base = _prompt(1, 16)
+    hit = base + _prompt(8, 8)
+    oracle = _gen(e, hit)
+    _flush_tiers(e)
+    _store_entry(e, base)
+    _pressure(e, False)
+    assert e.stats()["host_prefix_entries"] >= 1
+    # Pin the device pool nearly shut so the promote staging's
+    # free-space-only reservation fails.
+    with e._lock:
+        pinned = e._alloc.alloc(e._alloc.free_blocks - 1)
+    p0 = e.stats()["kv_promotions"]
+    try:
+        rid = e.submit(GenRequest(tokens=hit, max_new_tokens=4))
+        with e._lock:  # promote must NOT have been staged
+            assert not e._prefix_installs
+    finally:
+        with e._lock:
+            e._alloc.decref(pinned)
+            e._update_kv_gauges_locked()
+    e.run()
+    assert e.result(rid, timeout=0) == oracle
+    s = e.stats()
+    assert s["kv_promotions"] == p0
+    assert s["host_prefix_entries"] >= 1  # retained for later
+    _no_leaks(e)
+
+
+def test_demote_evict_split_surfaces(setup):
+    e = _engine(setup)
+    _flush_tiers(e)
+    _store_entry(e, _prompt(1, 16))
+    _pressure(e, False)
+    s = e.stats()
+    for k in (
+        "kv_demotions", "kv_promotions", "kv_demote_seconds",
+        "kv_promote_seconds", "kv_host_blocks_total",
+        "kv_host_blocks_free", "prefix_demotions", "prefix_evictions",
+        "parked_slots", "kv_park", "kv_promote_wall_p50",
+    ):
+        assert k in s
+    assert s["kv_demote_seconds"] >= 0.0
+    load = e.load()
+    snap = decode_load(encode_load(load))
+    assert snap["kv_host_blocks_total"] == s["kv_host_blocks_total"]
+    assert snap["kv_demotions"] == s["kv_demotions"]
+    assert snap["prefix_demotions"] == s["prefix_demotions"]
+    info = e.info()["engine"]
+    assert info["kv_host_bytes"] == HOST_BYTES
+    assert info["kv_host_blocks"] == s["kv_host_blocks_total"]
+    assert info["kv_park"] is True
+    # The shared metric carries the demote|evict outcomes and the
+    # tier gauge the host state.
+    from oim_tpu.common import metrics as _metrics
+
+    text = _metrics.registry().render()
+    assert 'oim_serve_prefix_cache_total{outcome="demote"}' in text
+    assert "oim_serve_kv_tier_moves_total" in text
+    assert 'state="host"' in text
+
+
+def test_validation_guards(setup):
+    cfg, params = setup
+    with pytest.raises(ValueError, match="paged cache"):
+        Engine(params, cfg, n_slots=2, max_len=64,
+               kv_host_bytes=HOST_BYTES)
+    with pytest.raises(ValueError, match="holds no block"):
+        Engine(params, cfg, **BASE, kv_host_bytes=8)
+    with pytest.raises(ValueError, match=">= 0"):
+        Engine(params, cfg, **BASE, kv_host_bytes=-1)
+
+
+def test_concurrent_ingest_demote_thread_safety(setup):
+    """A handler-thread demotion (the KV-ingest shortfall path) racing
+    the driver's donating dispatches must retry through the donation
+    race and never corrupt either tier — the _read_blocks_dispatch
+    re-snapshot contract."""
+    e = _engine(setup)
+    _flush_tiers(e)
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                with e._lock:
+                    # free+1 makes any idle exclusive entry the
+                    # shortfall's cover: demote it (handler-thread
+                    # read_block dispatches racing the driver).
+                    e._evict_prefix_for_locked(
+                        e._alloc.free_blocks + 1
+                    )
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        for i in range(6):
+            _store_entry(e, _prompt(1, 16))
+            _gen(e, _prompt(60 + i, 16), mn=8)
+    finally:
+        stop.set()
+        t.join()
+    assert not errors
+    e.run()
+    _no_leaks(e)
+
+
+# ---------------------------------------------------------------------------
+# The recompile guard row: warm demote/promote/park at ZERO compiles.
+
+
+def test_warm_tier_machinery_zero_compiles(setup):
+    e = _engine(setup)
+    e.set_pipeline_depth(2)
+    _flush_tiers(e)
+    # Prime every path once (entries, pressure shapes) on the warm
+    # engine, then pin the second full cycle at zero.
+    base = _prompt(1, 16)
+    for _ in range(2):
+        delta = compile_delta()
+        with delta:
+            _store_entry(e, base)
+            _pressure(e, False)
+            assert e.stats()["host_prefix_entries"] >= 1
+            out = _gen(e, base + _prompt(9, 8))
+            assert e.stats()["kv_promotions"] > 0
+            ra = e.submit(GenRequest(tokens=_prompt(30, 16),
+                                     max_new_tokens=30))
+            rb = e.submit(GenRequest(tokens=_prompt(31, 16),
+                                     max_new_tokens=30))
+            rc = e.submit(GenRequest(tokens=_prompt(32, 16),
+                                     max_new_tokens=30))
+            e.run()
+            assert out  # streams completed
+        _flush_tiers(e)
+    assert delta.count == 0, (
+        f"{delta.count} steady-state compile(s) in the warm "
+        f"demote/promote/park cycle"
+    )
+    _no_leaks(e)
