@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-serve-overflow test-autoscale test-jit-guard lint lint-metrics lint-jax agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-serve-overflow test-qos test-autoscale test-jit-guard lint lint-metrics lint-jax agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -137,6 +137,24 @@ test-serve-overflow:
 	  --roots oim_tpu/serve,oim_tpu/ops
 	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_serve_overflow.py -q -m "serve_overflow and not slow" \
+	  -p no:cacheprovider
+
+# Multi-tenant QoS (ISSUE 16, qos marker): weighted fair-share
+# admission convergence from a skewed backlog, router-side quota/rate
+# 429s with per-tenant Retry-After, priority preemption park/restore
+# token-identical to the never-preempted oracle across sampling and
+# KV-quant rungs, premium prefix pinning against demotion, the anon/
+# x-oim-tenant identity rules, zero leaked blocks/slots in both tiers,
+# and the warm preemption cycle's zero-compile pin.  Also runs the
+# oimlint lock/lifecycle/jaxvet passes over the qos package and the
+# serve plane so the new policy plumbing stays analyzer-clean, not
+# grandfathered in baseline.
+test-qos:
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
+	  --roots oim_tpu/qos,oim_tpu/serve
+	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_serve_qos.py -q -m "qos and not slow" \
 	  -p no:cacheprovider
 
 # Serve-plane fault tolerance (chaos marker): the splice-failover soak

@@ -499,6 +499,7 @@ def _run(tmp: str, agent_sock: str, cleanups: list, extras: dict) -> int:
         _disagg_diagnostics(extras, on_tpu, cfg, params)
         _prefix_residency_diagnostics(extras, on_tpu, cfg, params)
         _overflow_diagnostics(extras, on_tpu, cfg, params)
+        _qos_diagnostics(extras, on_tpu, cfg, params)
         _spec_model_diagnostics(extras, on_tpu)
     _flash_diagnostics(extras, on_tpu)
     # Last: it opens a SECOND PJRT client against the pool (the staged
@@ -2165,6 +2166,133 @@ def _overflow_diagnostics(extras, on_tpu, cfg, params) -> None:
         )
     except Exception as exc:  # pragma: no cover - diagnostics only
         log(f"bench: overflow tier diagnostics skipped: {exc}")
+
+
+def _qos_diagnostics(extras, on_tpu, cfg, params) -> None:
+    """Multi-tenant QoS headline (ISSUE 16): premium TTFT under a
+    best-effort flood.  A QoS engine (premium preempts, fair share
+    admits) and an unbounded no-QoS control run the IDENTICAL flood,
+    interleaved-median A/B per the PR 5 protocol; each leg also
+    measures its own unloaded premium TTFT, so the headline is a
+    ratio — premium-under-flood over premium-unloaded — per engine.
+    The QoS target is ≤ 1.5× (the premium request parks a victim and
+    admits at the next boundary instead of waiting out the flood's
+    full streams); the control ratio shows what FIFO does to the same
+    arrival.  Premium outputs must be token-identical to a solo run
+    of the same request (preemption is a swap, never a kill) and both
+    tiers must drain leak-free.  Wall-clock rows on CPU follow the
+    documented parity-control caveat; the RATIO is meaningful
+    everywhere (both numerator and denominator ride the same
+    backend)."""
+    try:
+        from oim_tpu.qos.policy import QosPolicy, TenantPolicy
+        from oim_tpu.serve import Engine, GenRequest
+
+        chunk = 32 if on_tpu else 4
+        flood_new = 64 if on_tpu else 24
+        policy = QosPolicy(tenants={
+            "user.gold": TenantPolicy(tenant="user.gold", tier="premium"),
+            "user.lead": TenantPolicy(
+                tenant="user.lead", tier="best_effort",
+            ),
+        })
+        mk = dict(
+            n_slots=2, max_len=128 if on_tpu else 64, chunk=chunk,
+            prompt_buckets=(16, 32), kv_block=8,
+            kv_blocks=32 if on_tpu else 16, prefix_cache_size=0,
+            kv_host_bytes=64 << 20,
+        )
+        qos_engine = Engine(params, cfg, **mk, qos=policy).warmup()
+        ctl_engine = Engine(params, cfg, **mk).warmup()
+
+        def prompt(seed):
+            return [(37 * seed + j) % cfg.vocab_size for j in range(16)]
+
+        def premium_ttft(e, flood):
+            """TTFT of one premium request, via the first-token
+            callback; with ``flood``, four best-effort streams are
+            seated and backlogged first."""
+            first = []
+            rids = []
+            if flood:
+                rids = [
+                    e.submit(GenRequest(
+                        tokens=prompt(10 + i), max_new_tokens=flood_new,
+                        tenant="user.lead",
+                    ))
+                    for i in range(4)
+                ]
+                e.step()  # both slots seated, two more backlogged
+                e.step()
+            rid = e.submit(
+                GenRequest(
+                    tokens=prompt(3), max_new_tokens=8,
+                    tenant="user.gold",
+                ),
+                on_token=lambda tok, lp: first.append(
+                    time.perf_counter()
+                ) if not first else None,
+            )
+            t0 = time.perf_counter()
+            e.run()
+            out = e.result(rid, timeout=0)
+            for r in rids:
+                e.result(r, timeout=0)
+            return first[0] - t0, out
+
+        ab_pairs = max(1, int(os.environ.get(
+            "OIM_BENCH_SERVE_AB_PAIRS", "1" if on_tpu else "3"
+        )))
+        q_ratio, c_ratio, q_ttft, q_unloaded = [], [], [], []
+        mismatches = 0
+        p0 = qos_engine.qos_preemptions
+        for _ in range(ab_pairs):
+            base_q, oracle = premium_ttft(qos_engine, flood=False)
+            load_q, out = premium_ttft(qos_engine, flood=True)
+            mismatches += out != oracle
+            q_ratio.append(load_q / max(base_q, 1e-9))
+            q_ttft.append(load_q)
+            q_unloaded.append(base_q)
+            base_c, oracle = premium_ttft(ctl_engine, flood=False)
+            load_c, out = premium_ttft(ctl_engine, flood=True)
+            mismatches += out != oracle
+            c_ratio.append(load_c / max(base_c, 1e-9))
+        preempts = qos_engine.qos_preemptions - p0
+        # Leak-free drain in both tiers on both engines (no prefix
+        # cache here, so every block must be home).
+        for e in (qos_engine, ctl_engine):
+            s = e.stats()
+            assert s["active_slots"] == 0 and s["parked_slots"] == 0
+            assert s["kv_blocks_used"] == 0
+            assert s.get("kv_host_blocks_used", 0) == 0
+        extras["serve_qos_premium_ttft_ms"] = round(
+            statistics.median(q_ttft) * 1000, 2
+        )
+        extras["serve_qos_premium_ttft_unloaded_ms"] = round(
+            statistics.median(q_unloaded) * 1000, 2
+        )
+        # p99 over a handful of pairs = the worst observed ratio.
+        extras["serve_qos_ttft_p99_ratio"] = round(max(q_ratio), 2)
+        extras["serve_qos_ttft_p99_ratio_ctl"] = round(max(c_ratio), 2)
+        extras["serve_qos_ttft_ratio_target"] = 1.5
+        extras["serve_qos_preemptions"] = preempts
+        extras["serve_qos_mismatch_reqs"] = mismatches
+        log(
+            f"bench: multi-tenant QoS under best-effort flood: "
+            f"premium TTFT "
+            f"{extras['serve_qos_premium_ttft_ms']} ms loaded vs "
+            f"{extras['serve_qos_premium_ttft_unloaded_ms']} ms "
+            f"unloaded — p99 ratio "
+            f"{extras['serve_qos_ttft_p99_ratio']}x under QoS "
+            f"(target ≤1.5x) vs "
+            f"{extras['serve_qos_ttft_p99_ratio_ctl']}x FIFO control, "
+            f"{preempts} preemption(s), {mismatches} mismatched "
+            f"premium request(s) ({ab_pairs} interleaved pair(s)"
+            + ("" if on_tpu else "; CPU wall rows = parity control")
+            + ")"
+        )
+    except Exception as exc:  # pragma: no cover - diagnostics only
+        log(f"bench: QoS diagnostics skipped: {exc}")
 
 
 def _spec_model_diagnostics(extras, on_tpu) -> None:

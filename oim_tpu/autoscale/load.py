@@ -89,6 +89,15 @@ _DEFAULTS: dict[str, Any] = {
     "shed_deadline": 0,
     "shed_brownout": 0,
     "brownout": False,
+    # Multi-tenant QoS (ISSUE 16; empty/zeros from publishers predating
+    # the fields — tolerant-decode defaults): per-tenant queue/active/
+    # parked pressure plus admission and preemption cumulatives (the
+    # router merges these fleet-wide for `oimctl tenants`), and the
+    # engine's priority-preemption total.  Tenant count is capped at
+    # the engine (its row table prunes idle tenants), so this leased
+    # value stays bounded however many CNs pass through.
+    "tenants": {},
+    "qos_preemptions": 0,
     "ts": 0.0,
 }
 

@@ -24,6 +24,10 @@
                                   slots/token-rate/shed counters from the
                                   router's /v1/stats, or straight off the
                                   registry load/ keys when no router runs
+    oimctl tenants [--router URL] per-tenant QoS view: tier, fair-share
+                                  weight, quota pressure and throttles,
+                                  live queue/active/parked counts, and
+                                  the preemption ledger
 """
 
 from __future__ import annotations
@@ -103,7 +107,8 @@ def _render_requests(entries: list[dict], dropped: int) -> None:
         return f"{float(value or 0.0) * 1000:9.1f}"
 
     print(
-        f"{'RID':>5} {'BACKEND':<22} {'TENANT':<12} {'OUTCOME':<14} "
+        f"{'RID':>5} {'BACKEND':<22} {'TENANT':<12} {'TIER':<11} "
+        f"{'OUTCOME':<14} "
         f"{'E2E_MS':>9} {'QUEUE':>9} {'ADMIT':>9} {'PREFILL':>9} "
         f"{'DECODE':>9} {'STREAM':>9} {'CHUNKS':>6} {'TOK i/o':>9} "
         f"{'PREFIX':<10} TRACE"
@@ -114,6 +119,9 @@ def _render_requests(entries: list[dict], dropped: int) -> None:
             f"{e.get('rid', -1):>5} "
             f"{str(e.get('backend', '-'))[:22]:<22} "
             f"{str(e.get('tenant', ''))[:12]:<12} "
+            # QoS tier the request ran under (ISSUE 16; '-' from rings
+            # predating the field).
+            f"{str(e.get('tier') or '-')[:11]:<11} "
             f"{str(e.get('outcome', '?'))[:14]:<14} "
             f"{ms(e.get('e2e_s'))} {ms(e.get('queue_s'))} "
             f"{ms(e.get('admit_s'))} "
@@ -436,6 +444,17 @@ def main(argv=None) -> int:
         "--limit", type=int, default=30,
         help="rows to show without --slow (newest last)",
     )
+    tenants = sub.add_parser(
+        "tenants",
+        help="per-tenant QoS view through a router's /v1/stats: tier, "
+        "fair-share weight, quota pressure (tokens charged, "
+        "throttles), live queue/active/parked counts, and the "
+        "preemption ledger (doc/serving.md 'Multi-tenant QoS')",
+    )
+    tenants.add_argument(
+        "--router", default="http://127.0.0.1:9000",
+        help="router url (fleet-merged tenant rows from /v1/stats)",
+    )
     top = sub.add_parser(
         "top",
         help="one-shot (or --watch) fleet load summary: per-backend "
@@ -627,6 +646,70 @@ def main(argv=None) -> int:
         for bid, err in sorted((doc.get("errors") or {}).items()):
             print(f"note: backend {bid} unreadable: {err}")
         _render_requests(entries, int(doc.get("dropped", 0) or 0))
+        return 0
+    if args.command == "tenants":
+        import urllib.error
+
+        base = args.router.rstrip("/")
+        urlopen = _serve_urlopen(args, base)
+        if urlopen is None:
+            return 2
+        try:
+            with urlopen(base + "/v1/stats", timeout=30) as resp:
+                stats = json.load(resp)
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            print(f"error: {exc}")
+            return 1
+        qos = stats.get("qos") or {}
+        rows = qos.get("tenants") or {}
+        if not isinstance(rows, dict) or not rows:
+            print("no tenant activity recorded"
+                  + ("" if qos.get("enabled") else " (QoS off)"))
+            return 0
+        print(
+            f"{'TENANT':<20} {'TIER':<11} {'WEIGHT':>6} {'QUEUED':>6} "
+            f"{'ACTIVE':>6} {'PARKED':>6} {'ADMIT':>7} {'THROTTLE':>8} "
+            f"{'PREEMPT':>7} {'VICTIM':>6} {'REQS':>7} {'TOK_OUT':>10} "
+            f"{'QUOTA':>16}"
+        )
+        # Premium first, then by traffic: the starvation-diagnosis
+        # read order (doc/operations.md) — is the top tier actually
+        # getting served, and who is it displacing.
+        tier_rank = {"premium": 0, "standard": 1, "best_effort": 2}
+        for name in sorted(
+            rows,
+            key=lambda n: (
+                tier_rank.get(rows[n].get("tier"), 1),
+                -int(rows[n].get("requests", 0) or 0),
+                n,
+            ),
+        ):
+            r = rows[name]
+            # Quota column: what the router charged vs the refill rate
+            # ("-" = no quota configured for the tenant).
+            rps = float(r.get("rate_rps", 0.0) or 0.0)
+            tps = float(r.get("tokens_per_s", 0.0) or 0.0)
+            if rps or tps:
+                quota = (
+                    f"{r.get('tokens_charged', 0)}@"
+                    + (f"{tps:g}t/s" if tps else f"{rps:g}r/s")
+                )
+            else:
+                quota = "-"
+            print(
+                f"{str(name)[:20]:<20} "
+                f"{str(r.get('tier', '-'))[:11]:<11} "
+                f"{float(r.get('weight', 0.0) or 0.0):>6.1f} "
+                f"{r.get('queued', 0):>6} {r.get('active', 0):>6} "
+                f"{r.get('parked', 0):>6} {r.get('admitted', 0):>7} "
+                f"{r.get('throttled', 0):>8} {r.get('preempted', 0):>7} "
+                f"{r.get('parked_victim', 0):>6} {r.get('requests', 0):>7} "
+                f"{r.get('tokens_out', 0):>10} {quota:>16}"
+            )
+        print(
+            f"qos: {'on' if qos.get('enabled') else 'off'}, "
+            f"fleet preemptions {qos.get('fleet_preemptions', 0)}"
+        )
         return 0
     if args.command == "top" and args.router:
         import urllib.error

@@ -107,6 +107,15 @@ def build_parser() -> argparse.ArgumentParser:
         "(doc/serving.md), recomputing is cheaper than shipping",
     )
     p.add_argument(
+        "--qos-policy", default="", metavar="FILE",
+        help="tenant QoS policy JSON (doc/serving.md 'Multi-tenant "
+        "QoS'): per-tenant tiers, weights, request-rate and "
+        "generated-token quotas.  The router becomes the quota layer "
+        "(429 + per-tenant Retry-After on exhaustion).  With "
+        "--registry-address and no file, the policy is fetched from "
+        "the registry's qos/tenants key instead; neither = quotas off",
+    )
+    p.add_argument(
         "--http-tls", action="store_true",
         help="mTLS on the data plane with the same --ca/--cert/--key: "
         "the router's own listener requires client certs AND the router "
@@ -152,6 +161,26 @@ def main(argv=None) -> int:
 
         ssl_context = server_ssl_context(args.ca, args.cert, args.key)
         client_ctx = client_ssl_context(args.ca, args.cert, args.key)
+    qos = None
+    if args.qos_policy:
+        from oim_tpu.qos.policy import load_policy_file
+
+        qos = load_policy_file(args.qos_policy)
+    elif args.registry_address:
+        # No file given but a registry is: pull the operator-published
+        # qos/tenants document.  Tolerant end to end — an absent key or
+        # an unreachable registry at boot means quotas off, never a
+        # dead router.
+        try:
+            from oim_tpu.common.regdial import registry_channel
+            from oim_tpu.qos.publish import fetch_policy
+
+            with registry_channel(args.registry_address, tls) as channel:
+                fetched = fetch_policy(channel)
+            if fetched.tenants:
+                qos = fetched
+        except Exception:
+            qos = None
     try:
         router = Router(
             backends=tuple(args.backend),
@@ -174,6 +203,7 @@ def main(argv=None) -> int:
             prefix_fetch=not args.no_prefix_fetch,
             prefix_fetch_timeout=args.prefix_fetch_timeout,
             prefix_fetch_min_tokens=args.prefix_fetch_min_tokens,
+            qos=qos,
         ).start()
     except ValueError as exc:
         raise SystemExit(str(exc))

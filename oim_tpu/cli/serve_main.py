@@ -336,6 +336,14 @@ def build_parser() -> argparse.ArgumentParser:
         "cert or the handshake fails (the gRPC plane's mTLS-everywhere "
         "stance, on the data plane)",
     )
+    p.add_argument(
+        "--qos-policy", default="", metavar="FILE",
+        help="tenant QoS policy JSON (doc/serving.md 'Multi-tenant "
+        "QoS'): the engine admits by weighted fair share instead of "
+        "FIFO and may preempt (park, never kill) a lower-tier tenant's "
+        "slot for a higher-priority admission; empty = QoS off (pure "
+        "FIFO, the pre-QoS behavior)",
+    )
     p.add_argument("--log-level", default="info")
     return p
 
@@ -345,6 +353,14 @@ def make_engine(args):
     import jax
 
     from oim_tpu.models import TransformerConfig, init_params
+
+    qos = None
+    if getattr(args, "qos_policy", ""):
+        from oim_tpu.qos.policy import load_policy_file
+
+        # Tolerant load (defaults on a missing/torn file): a bad policy
+        # document must degrade to FIFO, never block serving bring-up.
+        qos = load_policy_file(args.qos_policy)
     from oim_tpu.serve import Engine
 
     cfg = TransformerConfig(
@@ -527,6 +543,7 @@ def make_engine(args):
         paged_kernel={"auto": None, "on": True, "off": False}[
             args.paged_kernel
         ],
+        qos=qos,
     )
 
 

@@ -363,7 +363,9 @@ SERVE_SHED = _registry.counter(
     "queue_full = admission queue at capacity (HTTP 429), deadline = "
     "request deadline expired before it touched a slot, brownout = "
     "max_tokens clamped under sustained queue pressure (served, not "
-    "rejected).",
+    "rejected), quota = router-side per-tenant rate/token bucket "
+    "exhausted (HTTP 429 with a per-tenant Retry-After; the tenant "
+    "breakdown lives in oim_serve_qos_total).",
     ("reason",),
 )
 SERVE_FAILOVERS = _registry.counter(
@@ -456,6 +458,35 @@ SERVE_E2E = _registry.histogram(
     ("tenant", "outcome"),
     buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
              300.0, 600.0),
+)
+
+# ---------------------------------------------------------------------------
+# Multi-tenant QoS instruments (ISSUE 16): the enforcement actions the
+# policy layer takes, labeled by the TENANT TIER they acted for/against
+# (tier, not tenant, to bound cardinality — per-tenant detail lives in
+# /v1/stats and `oimctl tenants`), plus the one per-tenant series cheap
+# enough to carry the raw CN: generated-token totals, the series quota
+# billing and fair-share verification both read.
+
+SERVE_QOS = _registry.counter(
+    "oim_serve_qos_total",
+    "QoS enforcement actions by tenant tier: admitted = an engine "
+    "admission the fair-share scheduler granted, throttled = a request "
+    "shed at the router by the tenant's rate/token bucket (shed reason "
+    "quota), preempted = an admission that had to park a lower-tier "
+    "victim to fit (labeled with the PREEMPTOR's tier), parked_victim "
+    "= the other side of that preemption (labeled with the VICTIM's "
+    "tier; the slot swaps to host RAM and restores later — never "
+    "killed, see oim_serve_kv_tier_moves_total).",
+    ("tenant_tier", "action"),
+)
+SERVE_TENANT_TOKENS = _registry.counter(
+    "oim_serve_tenant_tokens_total",
+    "Generated (output) tokens per tenant CN, counted at request "
+    "finalize.  The consumption series behind token quotas and the "
+    "ground truth a fair-share convergence check compares against "
+    "policy weights.",
+    ("tenant",),
 )
 
 # ---------------------------------------------------------------------------

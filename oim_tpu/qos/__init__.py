@@ -1,0 +1,26 @@
+"""Multi-tenant QoS: tenant tiers, quotas, fair share, preemption.
+
+The policy layer that turns tenant identity (mTLS peer CN, PR 9) and
+the host-RAM park/swap substrate (PR 15) into actual isolation:
+
+- :mod:`oim_tpu.qos.policy` — the declarative tenant-policy model
+  (tiers, weighted shares, token quotas, rate limits, preemption
+  priority), its tolerant decode, and the ``qos/tenants`` registry key;
+- :mod:`oim_tpu.qos.publish` — read/write that key as the operator.
+
+Enforcement lives where the resources live: the router (rate limits +
+token quotas → 429/Retry-After), the engine's admission wave (weighted
+fair share + priority preemption via slot parking), and the KV tiers
+(premium prefixes pin against demotion).  See doc/serving.md
+"Multi-tenant QoS".
+"""
+
+from oim_tpu.qos.policy import (  # noqa: F401
+    QOS_TENANTS_KEY,
+    TIERS,
+    QosPolicy,
+    TenantPolicy,
+    decode_policy,
+    encode_policy,
+    load_policy_file,
+)

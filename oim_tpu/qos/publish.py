@@ -1,0 +1,43 @@
+"""Read/write the ``qos/tenants`` registry key.
+
+The policy document is operator-owned: only ``user.admin`` may write
+it (registry/authz.py carries an explicit grant so the QoS key is
+visible policy, not an accident of the admin wildcard).  Every serving
+component READS it — reads are unrestricted on the registry plane —
+and decodes tolerantly, so a half-rolled-out schema change degrades to
+defaults instead of taking the data plane down.
+"""
+
+from __future__ import annotations
+
+from oim_tpu.qos.policy import QOS_TENANTS_KEY, QosPolicy, decode_policy
+
+
+def fetch_policy(channel, timeout: float = 10.0) -> QosPolicy:
+    """The currently-published policy, or the all-defaults policy when
+    the key is absent/torn.  ``channel`` is an open registry gRPC
+    channel (``common.regdial.registry_channel``)."""
+    from oim_tpu.spec import REGISTRY, oim_pb2
+
+    reply = REGISTRY.stub(channel).GetValues(
+        oim_pb2.GetValuesRequest(path=QOS_TENANTS_KEY), timeout=timeout
+    )
+    for value in reply.values:
+        if value.path == QOS_TENANTS_KEY and value.value:
+            return decode_policy(value.value)
+    return decode_policy("")
+
+
+def publish_policy(channel, text: str, timeout: float = 10.0) -> None:
+    """Write the policy document (already-encoded JSON text; callers
+    validate with ``decode_policy`` first if they care).  Runs as the
+    operator identity — the mTLS client cert on ``channel`` must be
+    ``user.admin``."""
+    from oim_tpu.spec import REGISTRY, oim_pb2
+
+    REGISTRY.stub(channel).SetValue(
+        oim_pb2.SetValueRequest(
+            value=oim_pb2.Value(path=QOS_TENANTS_KEY, value=text)
+        ),
+        timeout=timeout,
+    )

@@ -62,6 +62,13 @@ AUTHZ_GRANTS: tuple[tuple[str, str], ...] = (
     # role would mis-route its traffic class).
     (SERVE_CN_PREFIX + "{id}", "serve/{id}/address"),
     (SERVE_CN_PREFIX + "{id}", "serve/{id}/pool"),
+    # The multi-tenant QoS policy document (qos/tenants,
+    # oim_tpu/qos/publish.py): operator-owned.  Redundant with the
+    # admin ** wildcard TODAY, but explicit on purpose — the QoS key is
+    # fleet-wide security policy (who may consume what), so it gets a
+    # named row the wildcard could someday narrow around, and the row
+    # the authz-coverage lint pins the publisher module against.
+    (ADMIN_CN, "qos/tenants"),
     # A node agent publishes its own multi-host rendezvous entry; any
     # staging host may commit the volume's coordinator (the protocol
     # lets only the sort-first one actually do it, but the registry

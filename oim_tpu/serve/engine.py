@@ -88,8 +88,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from oim_tpu.common import events as _events
 from oim_tpu.common import metrics as _metrics
 from oim_tpu.common import tracing as _tracing
+from oim_tpu.qos.policy import (
+    DEFAULT_POLICY as _QOS_DEFAULT,
+    TIER_PRIORITY as _QOS_TIER_PRIORITY,
+)
 
 from oim_tpu.models.decode import (
     _dense_mlp,
@@ -151,6 +156,12 @@ _NEG_BIG = -1e30
 _MAX_BEAM_SIZE = 32
 _MAX_BEAM_PROGRAMS = 8
 _MAX_BEAM_TRACES = 64
+
+# Per-tenant QoS accounting rows are client-controlled cardinality
+# (one per distinct CN / x-oim-tenant value): bound them like the beam
+# caps above.  Evicted rows lose stats() history only — the shared
+# Prometheus counters keep theirs.
+_MAX_TENANT_ROWS = 256
 
 
 def serve_param_shardings(params: dict, cfg: TransformerConfig, mesh):
@@ -1563,6 +1574,7 @@ class Engine:
         paged_kernel: bool | None = None,
         kv_host_bytes: int = 0,
         kv_park: bool = True,
+        qos=None,
     ):
         if pipeline_depth not in (1, 2):
             raise ValueError(
@@ -2344,6 +2356,19 @@ class Engine:
         self._m_pipeline_depth.set(
             float(pipeline_depth), self._engine_label
         )
+        # Multi-tenant QoS (ISSUE 16).  ``qos`` is a
+        # ``oim_tpu.qos.policy.QosPolicy`` or None; None means QoS is
+        # OFF — admission stays pure FIFO and nothing preempts, the
+        # exact pre-QoS behavior (the bench's A/B control and every
+        # policy-less deployment).  Tenant ACCOUNTING runs either way:
+        # per-tenant rows (virtual admission time for the stride
+        # scheduler, cumulative tokens, enforcement counters) under
+        # self._lock, mirrored into stats()/load()/info().
+        self._qos_policy = qos
+        self._tenants: dict[str, dict] = {}
+        self.qos_preemptions = 0  # admissions that parked a victim
+        self._m_qos = _metrics.SERVE_QOS
+        self._m_tenant_tokens = _metrics.SERVE_TENANT_TOKENS
         # warmup() routes dummy requests through the normal paths; they
         # must not pollute the cumulative request metrics (a fresh daemon
         # would otherwise report phantom traffic and 20-40 s compile
@@ -2960,6 +2985,9 @@ class Engine:
                 ),
                 "kv_park": self.kv_park,
                 "paged_kernel": self.paged_kernel,
+                # Whether a tenant policy is loaded (ISSUE 16): with
+                # False, admission is FIFO and nothing preempts.
+                "qos": self._qos_policy is not None,
                 "tp": self.mesh.shape.get("tp", 1) if self.mesh else 1,
                 "ep": self.mesh.shape.get("ep", 1) if self.mesh else 1,
             },
@@ -3092,6 +3120,13 @@ class Engine:
                 # drop-oldest (int read is atomic; the ring itself is
                 # under its own lock).
                 "ring_dropped": self.ring_dropped,
+                # Multi-tenant QoS (ISSUE 16): whether a policy is
+                # enforced, how many admissions parked a victim, and
+                # the per-tenant live/cumulative rows (`oimctl
+                # tenants` reads these through the router).
+                "qos": self._qos_policy is not None,
+                "qos_preemptions": self.qos_preemptions,
+                "tenants": self._tenant_snapshot_locked(),
             }
 
     def _worst_case_rows(
@@ -3217,6 +3252,13 @@ class Engine:
                 "shed_queue_full": self._shed_counts["queue_full"],
                 "shed_deadline": self._shed_counts["deadline"],
                 "shed_brownout": self._shed_counts["brownout"],
+                # Multi-tenant QoS (ISSUE 16): per-tenant queue/active
+                # pressure + enforcement counters, mirrored through
+                # the same leased load key (tolerant decode: absent
+                # from publishers predating the fields), and the
+                # engine-total preemption count.
+                "tenants": self._tenant_snapshot_locked(),
+                "qos_preemptions": self.qos_preemptions,
                 "brownout": bool(
                     self.brownout_max_tokens
                     and self._pressure_since is not None
@@ -3356,6 +3398,7 @@ class Engine:
         entry = {
             "rid": rid,
             "tenant": tenant,
+            "tier": self._qos_lookup(tenant).tier,
             "trace": root.trace_id,
             "outcome": outcome,
             "queue_s": round(queue_s, 6),
@@ -3383,6 +3426,15 @@ class Engine:
                     self.ring_dropped += 1
                 self._ring.append(entry)
         self._m_e2e.observe(e2e_s, tenant, outcome)
+        # Per-tenant consumption (ISSUE 16): the series token quotas
+        # bill against and fair-share convergence checks read.
+        if tokens_out:
+            self._m_tenant_tokens.inc(tenant, by=float(tokens_out))
+        with self._lock:
+            row = self._tenant_row_locked(tenant)
+            row["requests"] += 1
+            row["tokens_out"] += tokens_out
+            row["ts"] = time.time()
         if phases is not None and phases.t_admitted:
             self._m_queue_wait.observe(queue_s, tenant)
         if prefill_s > 0.0:
@@ -3543,7 +3595,9 @@ class Engine:
         self._cache = self._inject(self._cache, entry, jnp.int32(slot))
         return best_usable, source
 
-    def _store_prefix(self, slot: int, tokens: list[int]) -> None:
+    def _store_prefix(
+        self, slot: int, tokens: list[int], tenant: str = ""
+    ) -> None:
         """Cache ``slot``'s freshly prefilled prompt KV.
 
         Dense: copy the bucketed rows out (only the first len(tokens)
@@ -3574,7 +3628,7 @@ class Engine:
                 self._alloc.incref(blocks)
                 self._prefix_cache[key] = (blocks, full * self.kv_block)
                 self._set_prefix_meta_locked(
-                    key, full * self.kv_block, "local"
+                    key, full * self.kv_block, "local", tenant=tenant
                 )
                 while len(self._prefix_cache) > self.prefix_cache_size:
                     # LRU size cap: demote to the host tier when
@@ -3597,7 +3651,9 @@ class Engine:
             key = tuple(tokens)
             self._prefix_cache[key] = (entry, len(tokens))
             self._prefix_cache.move_to_end(key)
-            self._set_prefix_meta_locked(key, len(tokens), "local")
+            self._set_prefix_meta_locked(
+                key, len(tokens), "local", tenant=tenant
+            )
             while len(self._prefix_cache) > self.prefix_cache_size:
                 ev_key, _ = self._prefix_cache.popitem(last=False)
                 self._prefix_meta.pop(ev_key, None)
@@ -3635,19 +3691,31 @@ class Engine:
         self._prefix_meta.clear()
 
     def _set_prefix_meta_locked(
-        self, key: tuple, covered: int, origin: str
+        self, key: tuple, covered: int, origin: str, tenant: str = ""
     ) -> None:
         """Create/refresh one entry's residency record (lock held).
         The digest hashes the COVERED tokens only — for paged entries
         the block-aligned prefix, which is exactly what an export
         ships and what the router must recompute over a request's
-        leading tokens to match."""
+        leading tokens to match.  ``tenant`` is the CN whose request
+        prefilled the entry ("" when unknown — fetched/promoted
+        entries); its QoS tier decides the entry's demotion rank."""
+        policy = self._qos_policy or _QOS_DEFAULT
         self._prefix_meta[key] = {
             "digest": prefix_digest(key[:covered]),
             "covered": covered,
             "hits": 0,
             "last_hit": time.monotonic(),
             "origin": origin,
+            "tenant": tenant,
+            # An unknown owner ranks at the DEFAULT tier, not anon's:
+            # a fetched entry is usually a hot fleet prefix, and
+            # punishing it to best-effort would churn exactly the
+            # entries residency routing works to keep resident.
+            "tier": (
+                policy.lookup(tenant).tier if tenant
+                else policy.default_tier
+            ),
         }
 
     def _touch_prefix_meta_locked(self, key: tuple) -> str:
@@ -3886,12 +3954,27 @@ class Engine:
         and destroyed only when the host tier cannot take it (no tier,
         budget exhausted after host-LRU pressure).  Either way the
         device blocks free right here; the two outcomes split into
-        prefix_demotions vs prefix_evictions."""
+        prefix_demotions vs prefix_evictions.
+
+        Under a QoS policy (ISSUE 16) the victim order is TIER-then-
+        LRU: best-effort entries go first, premium last — a premium
+        tenant's warm prefix effectively pins against demotion for as
+        long as any lower-tier entry can cover the shortfall.  A soft
+        pin on purpose: when only premium entries remain they still
+        retire (the reclaimable precheck's no-wedge guarantee beats
+        the pin — an unadmittable queue serves no tier)."""
         victims = [
             (key, blocks, rows)
             for key, (blocks, rows) in self._prefix_cache.items()
             if key != keep_key
         ]
+        if self._qos_policy is not None and len(victims) > 1:
+            victims.sort(key=lambda item: _QOS_TIER_PRIORITY.get(
+                (self._prefix_meta.get(item[0]) or {}).get(
+                    "tier", "standard"
+                ),
+                0,
+            ))  # stable: LRU order preserved within a tier
         reclaimable = self._alloc.free_blocks + sum(
             self._alloc.exclusive(blocks) for _, blocks, _ in victims
         )
@@ -4190,14 +4273,203 @@ class Engine:
                 self._m_tier_seconds.inc("demote", by=dt)
             self._update_kv_gauges_locked()
 
-    def _pick_park_victim_locked(self):
-        """The coldest idle slot (lock held, admission boundary — no
-        chunk in flight, so every active slot is between chunks): the
-        one with the largest remaining token budget, ties to the
-        youngest stream.  It will pin pool blocks longest, so swapping
-        it buys the most capacity per byte moved; QoS preemption will
-        later override this pick with tenant priority.  Slots that
-        have not emitted since their own restore are immune — a
+    # -- multi-tenant QoS (ISSUE 16) ---------------------------------------
+
+    def set_qos_policy(self, policy) -> None:
+        """Swap the tenant policy (None turns QoS off).  Existing
+        accounting rows re-resolve their tier/weight; virtual times
+        carry over — a policy reload must not reset the fairness
+        ledger mid-backlog."""
+        with self._lock:
+            self._qos_policy = policy
+            for name, row in self._tenants.items():
+                pol = self._qos_lookup(name)
+                row["tier"] = pol.tier
+                row["weight"] = pol.effective_weight
+
+    def _qos_lookup(self, tenant: str):
+        return (self._qos_policy or _QOS_DEFAULT).lookup(tenant)
+
+    def _tenant_row_locked(self, tenant: str) -> dict:
+        """The accounting row for ``tenant`` (lock held), created on
+        first contact.  Newcomers start their virtual time at the
+        fleet minimum — starting at zero would hand any tenant that
+        merely stayed idle unbounded catch-up credit."""
+        row = self._tenants.get(tenant)
+        if row is None:
+            pol = self._qos_lookup(tenant)
+            floor = min(
+                (r["vtime"] for r in self._tenants.values()), default=0.0
+            )
+            if len(self._tenants) >= _MAX_TENANT_ROWS:
+                # Advisory accounting must not become a cardinality
+                # leak: drop the least-recently-touched row.  Its
+                # cumulative counters vanish from stats() (the shared
+                # Prometheus series keep the history).
+                stale = min(
+                    self._tenants, key=lambda t: self._tenants[t]["ts"]
+                )
+                del self._tenants[stale]
+            row = {
+                "tier": pol.tier,
+                "weight": pol.effective_weight,
+                "vtime": floor,
+                "admitted": 0,
+                "preempted": 0,
+                "parked_victim": 0,
+                "requests": 0,
+                "tokens_out": 0,
+                "ts": time.time(),
+            }
+            self._tenants[tenant] = row
+        return row
+
+    def _qos_head_locked(self) -> int:
+        """Index of the next admission candidate in ``self._queue``.
+
+        QoS off → 0 (pure FIFO, the pre-QoS contract).  QoS on →
+        deficit-weighted fair share via stride scheduling: each
+        tenant's requests stay FIFO among themselves, and the tenant
+        whose virtual time lags most admits next (ties to arrival
+        order).  Head-of-line backpressure is PRESERVED on the chosen
+        head — the admission loop still blocks on ITS plan rather
+        than skipping to a smaller latecomer, it just gets to choose
+        whose head that is."""
+        if self._qos_policy is None or len(self._queue) < 2:
+            return 0
+        best_i, best_key = 0, None
+        seen: set[str] = set()
+        for i, (rid, req, t_sub) in enumerate(self._queue):
+            tenant = req.tenant or "anon"
+            if tenant in seen:
+                continue  # only each tenant's own head competes
+            seen.add(tenant)
+            row = self._tenant_row_locked(tenant)
+            key = (row["vtime"], t_sub, rid)
+            if best_key is None or key < best_key:
+                best_i, best_key = i, key
+        return best_i
+
+    def _qos_charge_locked(self, req: GenRequest) -> None:
+        """Account one granted admission (lock held, queue already
+        popped).  The stride charge is the request's worst-case token
+        footprint over the tenant's weight, so token throughput — not
+        request count — converges to the weight ratio.  The vtime
+        floor clamp forgives debt accrued while a tenant had no
+        backlog (standard virtual-time hygiene: an idle tenant must
+        not bank unbounded credit, nor carry unpayable debt)."""
+        if self._warming:
+            # Warmup's dummy admissions must not seed an anon
+            # accounting row or skew the fairness ledger.
+            return
+        tenant = req.tenant or "anon"
+        row = self._tenant_row_locked(tenant)
+        if self._qos_policy is not None:
+            backlog = {r.tenant or "anon" for _, r, _ in self._queue}
+            floor = min(
+                (
+                    self._tenants[t]["vtime"]
+                    for t in backlog if t in self._tenants
+                ),
+                default=row["vtime"],
+            )
+            charge = float(max(1, len(req.tokens) + req.max_new_tokens))
+            row["vtime"] = (
+                max(row["vtime"], floor) + charge / max(row["weight"], 1e-9)
+            )
+        row["admitted"] += 1
+        row["ts"] = time.time()
+        if not self._warming:
+            self._m_qos.inc(row["tier"], "admitted")
+
+    def _qos_preempt_pending_locked(self) -> bool:
+        """Would the slot-shortage preemption path act right now?  The
+        pipeline-boundary predicate asks this (``_step_inner``):
+        queued work with no free slot normally does NOT force a
+        boundary — but when the fair-share head could preempt, the
+        admission wave must actually RUN, or a saturated engine would
+        pipeline straight past every preemption opportunity and the
+        premium tenant would wait out the flood's full streams anyway.
+        Pure read: same checks as ``_qos_preempt_locked`` minus the
+        park itself."""
+        if self._qos_policy is None or not self._queue or self._free:
+            return False
+        if not self.kv_park or self._host is None:
+            return False
+        _, req, _ = self._queue[self._qos_head_locked()]
+        prio = self._qos_lookup(req.tenant or "anon").priority
+        if prio <= 0:
+            return False
+        return self._pick_park_victim_locked(prio) is not None
+
+    def _qos_preempt_locked(self) -> bool:
+        """Slot-shortage priority preemption (lock held, admission
+        boundary, no free slot): when the fair-share head belongs to
+        a tenant with preemption priority above some running slot's,
+        park one STRICTLY-lower-priority victim so the admission loop
+        can run at all.  Strictly lower only — equal tiers never
+        preempt each other, which is what makes a policy-less fleet
+        (everyone standard) behave exactly as before this PR and
+        keeps two premium tenants from ping-ponging one slot.
+        Returns True when a victim was parked (a slot and its blocks
+        freed)."""
+        if not self._queue or self._free:
+            return False
+        _, req, _ = self._queue[self._qos_head_locked()]
+        prio = self._qos_lookup(req.tenant or "anon").priority
+        if prio <= 0:
+            return False
+        return self._try_park_locked(req, below_priority=prio)
+
+    def _tenant_snapshot_locked(self) -> dict:
+        """Per-tenant live + cumulative view (lock held) for
+        stats()/load()/info: queued/active/parked counted from ground
+        truth (the queue, the slot table, the parked set — no
+        increment/decrement bookkeeping to leak), counters from the
+        accounting rows."""
+        queued: dict[str, int] = {}
+        for _, req, _ in self._queue:
+            t = req.tenant or "anon"
+            queued[t] = queued.get(t, 0) + 1
+        active: dict[str, int] = {}
+        for state in self._slots.values():
+            t = state.req.tenant or "anon"
+            active[t] = active.get(t, 0) + 1
+        parked: dict[str, int] = {}
+        for rec in self._parked.values():
+            t = rec.state.req.tenant or "anon"
+            parked[t] = parked.get(t, 0) + 1
+        out: dict[str, dict] = {}
+        for name in (
+            set(self._tenants) | set(queued) | set(active) | set(parked)
+        ):
+            row = self._tenants.get(name, {})
+            pol = self._qos_lookup(name)
+            out[name] = {
+                "tier": pol.tier,
+                "weight": pol.effective_weight,
+                "queued": queued.get(name, 0),
+                "active": active.get(name, 0),
+                "parked": parked.get(name, 0),
+                "admitted": row.get("admitted", 0),
+                "preempted": row.get("preempted", 0),
+                "parked_victim": row.get("parked_victim", 0),
+                "requests": row.get("requests", 0),
+                "tokens_out": row.get("tokens_out", 0),
+            }
+        return out
+
+    def _pick_park_victim_locked(self, below_priority: int | None = None):
+        """The best park victim (lock held, admission boundary — no
+        chunk in flight, so every active slot is between chunks):
+        lowest QoS preemption priority first (with no policy every
+        tenant is standard, so this term is inert), then the largest
+        remaining token budget, ties to the youngest stream — the
+        tier-then-coldest order.  The coldest slot will pin pool
+        blocks longest, so swapping it buys the most capacity per
+        byte moved.  ``below_priority`` (the slot-shortage preemption
+        path) admits only victims of STRICTLY lower priority.  Slots
+        that have not emitted since their own restore are immune — a
         restored slot must make progress before it can be parked
         again, or a saturated queue ping-pongs one victim forever."""
         best, best_key = None, None
@@ -4207,12 +4479,17 @@ class Engine:
             rem = state.req.max_new_tokens - len(state.emitted)
             if rem < 1:
                 continue  # finishing this chunk anyway
-            key = (rem, state.t_submit)
+            prio = self._qos_lookup(state.req.tenant or "anon").priority
+            if below_priority is not None and prio >= below_priority:
+                continue
+            key = (-prio, rem, state.t_submit)
             if best_key is None or key > best_key:
                 best, best_key = (slot, state.rid, state), key
         return best
 
-    def _try_park_locked(self, req: GenRequest) -> bool:
+    def _try_park_locked(
+        self, req: GenRequest, below_priority: int | None = None
+    ) -> bool:
         """Park the coldest idle slot to make room for ``req``'s
         admission (lock held, driver thread): copy its live blocks to
         the host tier, free its device blocks AND its slot, and
@@ -4225,7 +4502,7 @@ class Engine:
         is rebuilt from host truth."""
         if not self.kv_park or self._host is None:
             return False
-        pick = self._pick_park_victim_locked()
+        pick = self._pick_park_victim_locked(below_priority)
         if pick is None:
             return False
         slot, rid, state = pick
@@ -4269,6 +4546,33 @@ class Engine:
             self.kv_parks += 1
             self.kv_demotions += n_cov
             self._m_tier_moves.inc("demote", by=float(n_cov))
+            if self._qos_policy is not None:
+                # Under a policy every park IS a QoS decision (the
+                # victim order came from tenant tiers): count both
+                # sides and leave a flight-recorder trail.  WARNING
+                # severity — preemptions are rare, operator-visible
+                # capacity events (throttles, the high-volume cousin,
+                # stay INFO at the router).
+                preemptor = req.tenant or "anon"
+                victim = state.req.tenant or "anon"
+                prow = self._tenant_row_locked(preemptor)
+                vrow = self._tenant_row_locked(victim)
+                prow["preempted"] += 1
+                vrow["parked_victim"] += 1
+                self.qos_preemptions += 1
+                self._m_qos.inc(prow["tier"], "preempted")
+                self._m_qos.inc(vrow["tier"], "parked_victim")
+                _events.emit(
+                    "qos.preempt",
+                    component="oim-serve",
+                    severity=_events.WARNING,
+                    subject=victim,
+                    preemptor=preemptor,
+                    preemptor_tier=prow["tier"],
+                    victim_tier=vrow["tier"],
+                    victim_rid=rid,
+                    blocks=n_cov,
+                )
         self._m_active.set(float(len(self._slots)), self._engine_label)
         return True
 
@@ -5284,7 +5588,14 @@ class Engine:
                     for state in self._slots.values()
                 )
             )
-            admit_boundary = bool(self._queue) and bool(self._free)
+            admit_boundary = bool(self._queue) and (
+                bool(self._free)
+                # A pending priority preemption is an admission
+                # opportunity too (ISSUE 16): the wave's pre-pass will
+                # park a lower-tier victim to MAKE the free slot, so
+                # the boundary must happen for it to run at all.
+                or self._qos_preempt_pending_locked()
+            )
             boundary = (
                 admit_boundary or self.pipeline_depth < 2 or elide_tail
             )
@@ -5470,8 +5781,22 @@ class Engine:
             self._unpark_wave()
         with self._lock:
             admissions = []
+            # Slot-shortage priority preemption (ISSUE 16): with every
+            # slot busy the loop below cannot even START, so a
+            # latency-sensitive tenant would wait out a best-effort
+            # flood's full streams.  Park one strictly-lower-priority
+            # victim (swap, never kill — PR 15 semantics) so the
+            # fair-share head gets a slot this wave.  One victim per
+            # wave, mirroring the block-shortage path's gradualism.
+            if (
+                self._qos_policy is not None
+                and self._queue
+                and not self._free
+            ):
+                self._qos_preempt_locked()
             while self._queue and self._free:
-                rid, req, t_submit = self._queue[0]
+                qi = self._qos_head_locked()
+                rid, req, t_submit = self._queue[qi]
                 plan = None
                 if self.paged:
                     # Reserve blocks (aliasing the cached prefix) BEFORE
@@ -5480,8 +5805,10 @@ class Engine:
                     # case leaves it QUEUED — admission backpressure,
                     # exactly like a fleet with no free slot — and the
                     # blocks freed by finishing requests admit it on a
-                    # later wave.  FIFO head-of-line by design: the
-                    # queue's ordering promise beats opportunistically
+                    # later wave.  Head-of-line by design: the
+                    # scheduler's ordering promise (FIFO, or the QoS
+                    # fair-share pick above, which only chooses WHOSE
+                    # head is at the line) beats opportunistically
                     # admitting a smaller latecomer forever.
                     imp = (
                         self._kv_imports.get(req.kv_import)
@@ -5520,7 +5847,8 @@ class Engine:
                             )
                     if plan is None:
                         break
-                self._queue.pop(0)
+                self._queue.pop(qi)
+                self._qos_charge_locked(req)
                 slot = self._free.pop(0)
                 if plan is not None:
                     self._commit_plan_locked(slot, plan)
@@ -5744,7 +6072,9 @@ class Engine:
                 groups.append((group, first, first_lp))
             for slot, rid, req, _, start, tail, _, _, _ in rows:
                 if req.cache_prefix and self.prefix_cache_size:
-                    self._store_prefix(slot, req.tokens)
+                    self._store_prefix(
+                        slot, req.tokens, tenant=req.tenant or "anon"
+                    )
             # ONE combined readback for every admission this step.
             fetched = self._fetch([(f, lp) for _, f, lp in groups], acc)
             # First-token instant for the whole wave (the combined

@@ -105,13 +105,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu import log
 from oim_tpu.common import events, metrics, tracing
+from oim_tpu.qos.policy import DEFAULT_POLICY as _QOS_DEFAULT
 from oim_tpu.serve.disagg import (
     prefix_digest,
     release_kv,
     ship_kv,
     ship_prefix,
 )
-from oim_tpu.serve.httptls import check_serving_peer
+from oim_tpu.serve.httptls import check_serving_peer, peer_common_name
 
 PROXIED = (
     "/v1/generate",
@@ -120,6 +121,13 @@ PROXIED = (
     "/v1/completions",
     "/v1/chat/completions",
 )
+
+# Per-tenant QoS state rows the router keeps (token buckets + throttle
+# counters).  Tenant names are client-influenced (the x-oim-tenant
+# header on a plain-HTTP perimeter), so the table is capped: at the
+# limit the longest-idle row is dropped — its bucket restarts full,
+# which errs toward admitting, never toward wedging a tenant out.
+_MAX_TENANT_ROWS = 256
 
 
 @dataclass
@@ -298,6 +306,7 @@ class Router:
         prefix_fetch: bool = True,
         prefix_fetch_timeout: float = 10.0,
         prefix_fetch_min_tokens: int = 0,
+        qos=None,
     ):
         """``ssl_context`` wraps the router's own listener in mTLS;
         ``client_ssl_context`` authenticates the router to mTLS
@@ -359,6 +368,16 @@ class Router:
             "fetched": 0, "fell_back": 0, "ineligible": 0,
             "routed_resident": 0,
         }
+        # Multi-tenant QoS (ISSUE 16): with a QosPolicy loaded, the
+        # router is the quota layer — per-tenant token buckets
+        # (request rate + generated-token budget) shed over-quota
+        # traffic with 429 + a per-tenant Retry-After (shed reason
+        # "quota", composing with the PR 6 taxonomy) BEFORE it ever
+        # holds an engine slot.  None = quotas off; tenant resolution
+        # and the x-oim-tenant forward still run, so backends can
+        # fair-share even when the router doesn't throttle.
+        self.qos = qos
+        self._qos_tenants: dict[str, dict] = {}
         # (digest, target id) → monotonic instant of the last failed
         # ship: a persistently failing pair must not re-pay the fetch
         # timeout on every request (cooldown, not a blacklist).
@@ -464,8 +483,52 @@ class Router:
                     return
                 length = int(self.headers.get("Content-Length", "0"))
                 body = self.rfile.read(length)
+                # Tenant QoS (ISSUE 16): resolve the tenant, charge its
+                # token buckets, and shed over-quota traffic here —
+                # before the request costs a backend connection, a
+                # queue position, or an engine slot.
+                tenant = outer._resolve_tenant(self)
+                wait_s = outer._qos_throttle(
+                    tenant, outer._request_tokens(self.path, body)
+                )
+                if wait_s is not None:
+                    tier = (outer.qos or _QOS_DEFAULT).lookup(tenant).tier
+                    metrics.SERVE_SHED.inc("quota")
+                    metrics.SERVE_QOS.inc(tier, "throttled")
+                    events.emit(
+                        "qos.throttle",
+                        component="oim-route",
+                        severity=events.INFO,
+                        subject=tenant,
+                        tier=tier,
+                        path=self.path,
+                        retry_after_s=round(wait_s, 3),
+                    )
+                    self._json(
+                        429,
+                        {
+                            "error": "tenant quota exhausted",
+                            "tenant": tenant,
+                            "tier": tier,
+                            "retry_after_s": round(wait_s, 3),
+                        },
+                        # Per-TENANT Retry-After: when THIS bucket
+                        # refills enough for one request, not the
+                        # fleet-health hint the 503 path uses.
+                        {"Retry-After": str(max(1, int(wait_s + 0.999)))},
+                    )
+                    return
+                # Forward the RESOLVED tenant, never the raw client
+                # header: an mTLS backend re-derives the tenant from
+                # the router's cert chain anyway, while a plain-HTTP
+                # backend (trusted perimeter behind this router)
+                # honors the forwarded identity instead of collapsing
+                # everything into "anon".
                 headers = self._fwd_headers(
-                    {"Content-Type": "application/json"}
+                    {
+                        "Content-Type": "application/json",
+                        "x-oim-tenant": tenant,
+                    }
                 )
                 outer._proxy(self, self.path, body, headers)
 
@@ -752,6 +815,198 @@ class Router:
         return {
             "Retry-After": str(max(1, int(self.health_interval * 2)))
         }
+
+    # -- tenant QoS (ISSUE 16) ---------------------------------------------
+
+    def _resolve_tenant(self, handler) -> str:
+        """The requesting tenant's name: the mTLS peer CN when the
+        router terminates TLS; else the ``x-oim-tenant`` header —
+        honored ONLY on a plain-HTTP listener, where the deployment
+        has already declared the perimeter trusted (doc/serving.md);
+        else ``anon``.  Never raises: identity resolution failing open
+        to the anonymous best-effort tier beats 500ing the data
+        plane."""
+        cn = peer_common_name(handler)
+        if cn:
+            return cn
+        if not self.tls:
+            claimed = (handler.headers.get("x-oim-tenant") or "").strip()
+            if claimed:
+                # Bounded: the name keys a capped state table and a
+                # Prometheus label; a hostile megabyte header must not.
+                return claimed[:128]
+        return "anon"
+
+    @staticmethod
+    def _request_tokens(path: str, body: bytes | None) -> int:
+        """Estimated token cost for quota charging: prompt tokens plus
+        the decode budget (max_new_tokens — the engine's fair-share
+        charge uses the same estimate, so router quota and engine
+        share agree on what a request costs).  Token-id prompts count
+        exactly; text prompts estimate ~4 chars/token.  Any parse
+        problem charges 0 — malformed bodies are the backend
+        validator's 4xx to issue, never a quota decision."""
+        if body is None:
+            return 0
+        try:
+            payload = json.loads(body)
+            if not isinstance(payload, dict):
+                return 0
+            prompt = 0
+            ids = payload.get("tokens")
+            if ids is None and path == "/v1/completions":
+                ids = payload.get("prompt")
+            if isinstance(ids, list):
+                prompt = len(ids)
+            elif isinstance(payload.get("prompt"), str):
+                prompt = len(payload["prompt"]) // 4
+            elif isinstance(payload.get("text"), str):
+                prompt = len(payload["text"]) // 4
+            elif isinstance(payload.get("messages"), list):
+                prompt = sum(
+                    len(m.get("content", "")) // 4
+                    for m in payload["messages"]
+                    if isinstance(m, dict)
+                    and isinstance(m.get("content"), str)
+                )
+            new = payload.get("max_new_tokens", payload.get("max_tokens"))
+            new = int(new) if isinstance(new, int) and new > 0 else 0
+            return max(0, prompt) + new
+        except Exception:
+            return 0
+
+    def _qos_throttle(self, tenant: str, want_tokens: int) -> float | None:
+        """Charge ``tenant``'s token buckets for one request costing
+        ``want_tokens``; None = admitted, else the seconds until the
+        drier bucket refills enough (the 429's Retry-After).  Two
+        buckets per tenant, both classic leaky refill: request rate
+        (rate_rps/effective_rate_burst) and generated-token budget
+        (tokens_per_s/effective_token_burst).  A tenant whose policy
+        sets neither is never throttled — quotas are opt-in per
+        tenant, not a default tax."""
+        policy = self.qos
+        if policy is None:
+            return None
+        tp = policy.lookup(tenant)
+        if tp.rate_rps <= 0.0 and tp.tokens_per_s <= 0.0:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            row = self._qos_tenants.get(tenant)
+            if row is None:
+                if len(self._qos_tenants) >= _MAX_TENANT_ROWS:
+                    idle = min(
+                        self._qos_tenants,
+                        key=lambda t: self._qos_tenants[t]["ts"],
+                    )
+                    del self._qos_tenants[idle]
+                row = self._qos_tenants[tenant] = {
+                    "rate_level": tp.effective_rate_burst,
+                    "token_level": tp.effective_token_burst,
+                    "t": now,
+                    "admitted": 0,
+                    "throttled": 0,
+                    "tokens_charged": 0,
+                    "ts": time.time(),
+                }
+            dt = max(0.0, now - row["t"])
+            row["t"] = now
+            row["ts"] = time.time()
+            if tp.rate_rps > 0.0:
+                row["rate_level"] = min(
+                    tp.effective_rate_burst,
+                    row["rate_level"] + dt * tp.rate_rps,
+                )
+            if tp.tokens_per_s > 0.0:
+                row["token_level"] = min(
+                    tp.effective_token_burst,
+                    row["token_level"] + dt * tp.tokens_per_s,
+                )
+            waits = []
+            if tp.rate_rps > 0.0 and row["rate_level"] < 1.0:
+                waits.append((1.0 - row["rate_level"]) / tp.rate_rps)
+            if (
+                tp.tokens_per_s > 0.0
+                and want_tokens > 0
+                and row["token_level"] < float(want_tokens)
+            ):
+                waits.append(
+                    (float(want_tokens) - row["token_level"])
+                    / tp.tokens_per_s
+                )
+            if waits:
+                row["throttled"] += 1
+                return max(waits)
+            if tp.rate_rps > 0.0:
+                row["rate_level"] -= 1.0
+            if tp.tokens_per_s > 0.0 and want_tokens > 0:
+                row["token_level"] -= float(want_tokens)
+                row["tokens_charged"] += want_tokens
+            row["admitted"] += 1
+            return None
+
+    def _tenant_stats_locked(self) -> dict:
+        """Fleet-merged per-tenant view for /v1/stats: the router's own
+        quota rows joined with every healthy backend's engine-side
+        tenant rows (queued/active/parked live counts, admission and
+        preemption cumulatives, summed across the fleet) — the
+        ``oimctl tenants`` data source.  Tolerant of backends
+        predating the load-snapshot fields."""
+        policy = self.qos or _QOS_DEFAULT
+        rows: dict[str, dict] = {}
+
+        def row(name: str) -> dict:
+            if name not in rows:
+                tp = policy.lookup(name)
+                rows[name] = {
+                    "tier": tp.tier,
+                    "weight": tp.effective_weight,
+                    "rate_rps": tp.rate_rps,
+                    "tokens_per_s": tp.tokens_per_s,
+                    "throttled": 0,
+                    "tokens_charged": 0,
+                    "queued": 0,
+                    "active": 0,
+                    "parked": 0,
+                    "admitted": 0,
+                    "preempted": 0,
+                    "parked_victim": 0,
+                    "requests": 0,
+                    "tokens_out": 0,
+                }
+            return rows[name]
+
+        for name, qrow in self._qos_tenants.items():
+            merged = row(name)
+            merged["throttled"] = qrow["throttled"]
+            merged["tokens_charged"] = qrow["tokens_charged"]
+        for b in self._backends.values():
+            fleet = b.load.get("tenants")
+            if not isinstance(fleet, dict):
+                continue
+            for name, erow in fleet.items():
+                if not isinstance(erow, dict):
+                    continue
+                merged = row(str(name))
+                if self.qos is None and isinstance(
+                    erow.get("tier"), str
+                ):
+                    # No router policy: trust the engine's tier/weight
+                    # labels rather than default-tiering everyone.
+                    merged["tier"] = erow["tier"]
+                    if isinstance(erow.get("weight"), (int, float)):
+                        merged["weight"] = float(erow["weight"])
+                for key in (
+                    "queued", "active", "parked", "admitted",
+                    "preempted", "parked_victim", "requests",
+                    "tokens_out",
+                ):
+                    value = erow.get(key, 0)
+                    if isinstance(value, int) and not isinstance(
+                        value, bool
+                    ):
+                        merged[key] += value
+        return rows
 
     def _proxy(
         self, handler, path: str, body: bytes | None, headers: dict
@@ -1932,6 +2187,19 @@ class Router:
                     ),
                     "fleet_misses": sum(
                         int(b.load.get("prefix_misses") or 0)
+                        for b in self._backends.values()
+                    ),
+                },
+                # Multi-tenant QoS (ISSUE 16): whether the router
+                # enforces quotas, the fleet-merged per-tenant rows
+                # (`oimctl tenants`), and the fleet preemption total
+                # (engine-side priority parks, summed from the load
+                # snapshots).
+                "qos": {
+                    "enabled": self.qos is not None,
+                    "tenants": self._tenant_stats_locked(),
+                    "fleet_preemptions": sum(
+                        int(b.load.get("qos_preemptions") or 0)
                         for b in self._backends.values()
                     ),
                 },

@@ -355,6 +355,29 @@ class ServeServer:
                     raise ValueError(f"deadline_ms must be > 0, got {ms}")
                 return time.monotonic() + ms / 1000.0
 
+            def _tenant(self) -> str:
+                """The requesting tenant for QoS accounting: the mTLS
+                peer CN when this server terminates TLS; on a
+                plain-HTTP server (trusted perimeter — typically
+                behind the router, which resolves and forwards the
+                real identity) the ``x-oim-tenant`` header.  Before
+                ISSUE 16 every non-mTLS request collapsed into the
+                one anonymous tenant, which made fair-share blind
+                behind a router; anon is now an explicit tenant with
+                its own (best-effort) tier.  Under TLS the header is
+                IGNORED — a cert-bearing client must not re-badge
+                itself as someone else's quota."""
+                cn = peer_common_name(self)
+                if cn:
+                    return cn
+                if not outer.tls:
+                    claimed = (
+                        self.headers.get("x-oim-tenant") or ""
+                    ).strip()
+                    if claimed:
+                        return claimed[:128]
+                return ""
+
             def do_GET(self):
                 # Serving-plane CN pinning (httptls module docstring):
                 # under mTLS the peer must carry a serve./route./user.
@@ -703,7 +726,7 @@ class ServeServer:
                                 )
                                 if span is not None else None
                             ),
-                            tenant=peer_common_name(self) or "",
+                            tenant=self._tenant(),
                             eos_id=(
                                 outer.tokenizer.eos_id
                                 if outer.tokenizer is not None
@@ -1026,7 +1049,7 @@ class ServeServer:
                         span=tracing.SpanContext(
                             span.trace_id, span.span_id
                         ),
-                        tenant=peer_common_name(self) or "",
+                        tenant=self._tenant(),
                     )
                     span.attrs.update(
                         prompt_tokens=len(req.tokens),

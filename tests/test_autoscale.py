@@ -236,6 +236,18 @@ class TestLoadSchema:
             "shed_deadline": 0,
             "shed_brownout": 2,
             "brownout": True,
+            # Multi-tenant QoS (ISSUE 16): per-tenant pressure rows +
+            # the engine's priority-preemption total, merged fleet-wide
+            # by the router for `oimctl tenants`.
+            "tenants": {
+                "user.gold": {
+                    "tier": "premium", "weight": 8.0, "queued": 1,
+                    "active": 1, "parked": 0, "admitted": 9,
+                    "preempted": 2, "parked_victim": 0, "requests": 8,
+                    "tokens_out": 512,
+                },
+            },
+            "qos_preemptions": 2,
             "ts": 123.5,
         }
         assert decode_load(encode_load(snap)) == snap
@@ -248,6 +260,9 @@ class TestLoadSchema:
     def test_missing_fields_default(self):
         decoded = decode_load("{}")
         assert decoded["queue_depth"] == 0 and decoded["total_slots"] == 0
+        # Publishers predating the QoS fields (ISSUE 16) decode to
+        # empty tenant tables, not errors.
+        assert decoded["tenants"] == {} and decoded["qos_preemptions"] == 0
 
     def test_path_helpers(self):
         assert load_key("serve.a") == "load/serve.a"

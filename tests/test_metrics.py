@@ -537,6 +537,70 @@ def test_serve_disagg_instruments_render():
     assert "oim_serve_kv_ship_seconds_count" in text
 
 
+def test_qos_instruments_render():
+    """The multi-tenant QoS instruments (ISSUE 16: enforcement actions
+    by tenant tier, generated tokens by tenant CN) are shared
+    definitions in oim_tpu/common/metrics.py and render in standard
+    exposition text — including the new shed reason `quota` on the
+    PR 6 taxonomy."""
+    before = {
+        "admitted": metrics.SERVE_QOS.value("premium", "admitted"),
+        "throttled": metrics.SERVE_QOS.value("best_effort", "throttled"),
+        "preempted": metrics.SERVE_QOS.value("premium", "preempted"),
+        "victim": metrics.SERVE_QOS.value("best_effort", "parked_victim"),
+        "tokens": metrics.SERVE_TENANT_TOKENS.value("user.gold"),
+        "quota": metrics.SERVE_SHED.value("quota"),
+    }
+    metrics.SERVE_QOS.inc("premium", "admitted")
+    metrics.SERVE_QOS.inc("best_effort", "throttled")
+    metrics.SERVE_QOS.inc("premium", "preempted")
+    metrics.SERVE_QOS.inc("best_effort", "parked_victim")
+    metrics.SERVE_TENANT_TOKENS.inc("user.gold", by=128.0)
+    metrics.SERVE_SHED.inc("quota")
+    assert (
+        metrics.SERVE_QOS.value("premium", "admitted")
+        == before["admitted"] + 1
+    )
+    assert (
+        metrics.SERVE_QOS.value("best_effort", "throttled")
+        == before["throttled"] + 1
+    )
+    assert (
+        metrics.SERVE_QOS.value("premium", "preempted")
+        == before["preempted"] + 1
+    )
+    assert (
+        metrics.SERVE_QOS.value("best_effort", "parked_victim")
+        == before["victim"] + 1
+    )
+    assert (
+        metrics.SERVE_TENANT_TOKENS.value("user.gold")
+        == before["tokens"] + 128.0
+    )
+    assert metrics.SERVE_SHED.value("quota") == before["quota"] + 1
+    text = metrics.registry().render()
+    assert "# TYPE oim_serve_qos_total counter" in text
+    assert (
+        'oim_serve_qos_total{tenant_tier="premium",action="admitted"}'
+        in text
+    )
+    assert (
+        'oim_serve_qos_total{tenant_tier="best_effort",'
+        'action="throttled"}' in text
+    )
+    assert (
+        'oim_serve_qos_total{tenant_tier="premium",action="preempted"}'
+        in text
+    )
+    assert (
+        'oim_serve_qos_total{tenant_tier="best_effort",'
+        'action="parked_victim"}' in text
+    )
+    assert "# TYPE oim_serve_tenant_tokens_total counter" in text
+    assert 'oim_serve_tenant_tokens_total{tenant="user.gold"}' in text
+    assert 'oim_serve_shed_total{reason="quota"}' in text
+
+
 def test_prefix_residency_instruments_render():
     """The fleet prefix-residency instruments (ISSUE 14: ship latency,
     fetch outcomes, residency-map size, the source-labeled bytes-saved

@@ -1,0 +1,676 @@
+"""Multi-tenant QoS (ISSUE 16): quotas, weighted fair share, and
+priority preemption over the park/swap substrate.
+
+The load-bearing properties:
+
+- **Fair share converges.**  From a skewed backlog (one tenant queues
+  everything first) equal-weight tenants interleave instead of
+  draining FIFO — the stride scheduler picks the lagging tenant's
+  head, and head-of-line backpressure is preserved on the CHOSEN head.
+- **Quotas shed at the door.**  Router-side token buckets answer 429
+  with a per-tenant Retry-After (shed reason ``quota``) before any
+  accelerator state is touched; tenants without quota config are never
+  throttled, and one tenant's flood cannot consume another's bucket.
+- **Preemption is a swap, never a kill.**  A premium admission against
+  a saturated engine parks a strictly-lower-priority victim via the
+  PR 15 park machinery; both the preemptor and every victim emit
+  exactly the tokens a never-preempted solo run emits, across
+  {greedy, temp>0, spec} × {fp, kv8} × pipeline depth {1, 2}, with
+  zero leaked blocks in either tier.
+- **Premium prefixes pin.**  Under pool pressure the demotion victim
+  order is tier-then-LRU: a best-effort entry goes before a premium
+  one even when the premium entry is older.
+- **Identity is resolved, not claimed.**  ``x-oim-tenant`` is honored
+  only on a plain-HTTP listener (the trusted perimeter behind the
+  router); anon is an explicit best-effort tenant, not an accounting
+  hole.
+- **Zero steady-state compiles.**  A warm engine runs a full
+  preempt→park→restore cycle without a single new XLA compile.
+
+Engines are shared per config and warmed once (the test-serve
+compile-budget discipline); this file backs ``make test-qos`` (120 s
+cap).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from test_jit_guard import compile_delta
+
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.qos.policy import (
+    DEFAULT_POLICY,
+    QOS_TENANTS_KEY,
+    QosPolicy,
+    TenantPolicy,
+    decode_policy,
+    encode_policy,
+)
+from oim_tpu.serve import Engine, GenRequest, Router
+from oim_tpu.serve.server import ServeServer
+
+pytestmark = pytest.mark.qos
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+HOST_BYTES = 1 << 20
+
+# Two slots is the preemption geometry: a best-effort pair saturates
+# the engine, so a premium arrival finds no free slot and must park a
+# victim.  kv_blocks=16 holds two 7-block worst cases plus the
+# preemptor once a victim's blocks swap out.
+BASE = dict(
+    n_slots=2, max_len=64, chunk=4, prompt_buckets=(16, 32),
+    kv_block=8, kv_blocks=16, prefix_cache_size=2,
+)
+
+# The module policy: gold is premium (preempts, pins prefixes), lead
+# is best-effort (the preemption victim tier), ``tin`` carries a tiny
+# request-rate bucket and ``tok`` a tiny token budget (the router
+# throttle tests).  Unlisted CNs fall to standard; anon to
+# best-effort.
+POLICY = QosPolicy(tenants={
+    "user.gold": TenantPolicy(tenant="user.gold", tier="premium"),
+    "user.lead": TenantPolicy(tenant="user.lead", tier="best_effort"),
+    "tin": TenantPolicy(
+        tenant="tin", tier="best_effort", rate_rps=0.5, rate_burst=2.0,
+    ),
+    "tok": TenantPolicy(
+        tenant="tok", tier="best_effort", tokens_per_s=1.0, token_burst=8.0,
+    ),
+})
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+_ENGINES: dict = {}
+
+
+def _engine(setup, **kw):
+    """Shared warmed engines, every one carrying the module POLICY
+    (the policy object itself stays out of the cache key — a frozen
+    dataclass with a dict field is unhashable)."""
+    cfg, params = setup
+    key = tuple(sorted(kw.items()))
+    if key not in _ENGINES:
+        args = dict(BASE)
+        args.update(kw)
+        _ENGINES[key] = Engine(
+            params, cfg, kv_host_bytes=HOST_BYTES, qos=POLICY, **args
+        ).warmup()
+    return _ENGINES[key]
+
+
+def _prompt(seed: int, n: int) -> list[int]:
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG["vocab_size"], size=n).tolist()
+
+
+def _gen(e: Engine, tokens, mn=4, **kw) -> list[int]:
+    rid = e.submit(GenRequest(tokens=tokens, max_new_tokens=mn, **kw))
+    e.run()
+    return e.result(rid, timeout=0)
+
+
+def _no_leaks(e: Engine) -> None:
+    """Device blocks = resident prefix entries' refs only; host blocks
+    = demoted entries + parked slots only (both tiers drained of
+    transient owners) — the test_serve_overflow invariant, asserted
+    after every preemption path here."""
+    s = e.stats()
+    assert s["active_slots"] == 0 and s["queued"] == 0
+    assert s["parked_slots"] == 0
+    with e._lock:
+        entry_blocks = set()
+        for blocks, _ in e._prefix_cache.values():
+            entry_blocks.update(blocks)
+        assert e._alloc.used_blocks == len(entry_blocks), (
+            e._alloc.used_blocks, entry_blocks,
+        )
+        host_blocks = set()
+        for blocks, _ in e._host_prefix.values():
+            host_blocks.update(blocks)
+        assert e._host.alloc.used_blocks == len(host_blocks), (
+            e._host.alloc.used_blocks, host_blocks,
+        )
+
+
+def _flush_prefixes(e: Engine) -> None:
+    e._warming = True
+    try:
+        with e._lock:
+            e._clear_prefix_cache_locked()
+            e._flush_host_tier_locked()
+    finally:
+        e._warming = False
+
+
+def _post(base, path, payload, headers=None, timeout=120):
+    """POST returning (status, body-dict, response-headers) — unlike
+    test_router's helper this one surfaces 4xx instead of raising, so
+    the quota tests can read the 429 body and Retry-After."""
+    req = urllib.request.Request(
+        base + path,
+        json.dumps(payload).encode(),
+        {"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        body = exc.read()
+        try:
+            decoded = json.loads(body)
+        except ValueError:
+            decoded = {"raw": body.decode(errors="replace")}
+        return exc.code, decoded, dict(exc.headers)
+
+
+def _url(server) -> str:
+    return f"http://{server.host}:{server.port}"
+
+
+# ---------------------------------------------------------------------------
+# Policy model: tolerant decode, tier fallbacks, round trip.
+
+
+def test_policy_decode_tolerant():
+    # Torn/foreign/wrong-shaped values degrade to the default policy —
+    # a bad publish must read as "no QoS", never crash the data plane.
+    for garbage in (None, "", b"\xff\xfe", "not json", "[1, 2]", "42"):
+        assert decode_policy(garbage) == DEFAULT_POLICY
+    doc = {
+        "default_tier": "premium",
+        "anon_tier": "nonsense",  # unknown tier → best_effort default
+        "future_field": {"ignored": True},
+        "tenants": {
+            "user.gold": {"tier": "PREMIUM", "weight": 9},  # int ok
+            "user.dash": {"tier": "best-effort"},  # dash normalized
+            "user.bad": {"tier": 7, "weight": "lots", "rate_rps": True},
+            "user.rate": {"rate_rps": 2.5, "tokens_per_s": 100},
+            "": {"tier": "premium"},  # empty name dropped
+            "user.torn": "not a dict",
+        },
+    }
+    pol = decode_policy(json.dumps(doc))
+    assert pol.default_tier == "premium"
+    assert pol.anon_tier == "best_effort"
+    assert "" not in pol.tenants
+    gold = pol.lookup("user.gold")
+    assert gold.tier == "premium" and gold.effective_weight == 9.0
+    assert gold.priority == 2 and gold.pin_prefix
+    assert pol.lookup("user.dash").tier == "best_effort"
+    bad = pol.lookup("user.bad")
+    # Per-field damage falls back per field: tier → default_tier,
+    # wrong-typed numerics → 0 (tier default weight, unlimited rate).
+    assert bad.tier == "premium" and bad.weight == 0.0
+    assert bad.rate_rps == 0.0
+    rate = pol.lookup("user.rate")
+    assert rate.rate_rps == 2.5 and rate.effective_rate_burst == 2.5
+    assert rate.tokens_per_s == 100.0
+    assert rate.effective_token_burst == 1600.0
+    assert pol.lookup("user.torn").tier == "premium"
+    # Unlisted CN → default_tier; anon → anon_tier.
+    assert pol.lookup("user.unknown").tier == "premium"
+    assert pol.lookup("").tenant == "anon"
+    assert pol.lookup("").tier == "best_effort"
+    # encode→decode round-trips the resolved rows.
+    again = decode_policy(encode_policy(pol))
+    assert again.lookup("user.gold") == gold
+    assert again.lookup("user.rate") == rate
+    assert again.default_tier == "premium"
+    assert QOS_TENANTS_KEY == "qos/tenants"
+
+
+def test_default_policy_tiers():
+    # The policy a fleet runs with when nothing was published: every
+    # CN standard (priority 1), anon best-effort (priority 0) — so
+    # the default-on engine path never preempts between equals.
+    assert DEFAULT_POLICY.lookup("user.any").tier == "standard"
+    assert DEFAULT_POLICY.lookup("user.any").priority == 1
+    assert DEFAULT_POLICY.lookup("").tier == "best_effort"
+    assert DEFAULT_POLICY.lookup("anon").priority == 0
+
+
+# ---------------------------------------------------------------------------
+# Engine fair share: skewed backlog converges instead of draining FIFO.
+
+
+def test_fair_share_interleaves_skewed_backlog(setup):
+    """user.x queues 6 requests, THEN user.y queues 6 (both standard,
+    equal weight).  FIFO would finish all of x before any y; the
+    stride scheduler must interleave — each tenant lands at least two
+    of the first six finishers (~50/50 convergence)."""
+    e = _engine(setup)
+    rids = []
+    for i in range(6):
+        rids.append(e.submit(GenRequest(
+            tokens=_prompt(30 + i, 8), max_new_tokens=8, tenant="user.x",
+        )))
+    for i in range(6):
+        rids.append(e.submit(GenRequest(
+            tokens=_prompt(40 + i, 8), max_new_tokens=8, tenant="user.y",
+        )))
+    e.run()
+    for rid in rids:
+        assert len(e.result(rid, timeout=0)) == 8
+    with e._ring_lock:
+        tail = [dict(entry) for entry in e._ring][-12:]
+    finishers = [entry["tenant"] for entry in tail]
+    assert sorted(set(finishers)) == ["user.x", "user.y"], finishers
+    first_half = finishers[:6]
+    assert first_half.count("user.x") >= 2, finishers
+    assert first_half.count("user.y") >= 2, finishers
+    # Both tenants resolved to equal-weight standard rows.
+    tenants = e.stats()["tenants"]
+    assert tenants["user.x"]["tier"] == "standard"
+    assert tenants["user.x"]["weight"] == tenants["user.y"]["weight"]
+    assert tenants["user.x"]["admitted"] >= 6
+    assert tenants["user.x"]["tokens_out"] >= 48
+    _no_leaks(e)
+
+
+def test_qos_off_is_pure_fifo(setup):
+    """qos=None is the pre-QoS engine: strict FIFO admission even
+    from a skewed two-tenant backlog, and nothing ever preempts."""
+    cfg, params = setup
+    e = Engine(params, cfg, kv_host_bytes=HOST_BYTES, **BASE).warmup()
+    rids = []
+    for i in range(4):
+        rids.append(e.submit(GenRequest(
+            tokens=_prompt(50 + i, 8), max_new_tokens=6, tenant="user.x",
+        )))
+    rids.append(e.submit(GenRequest(
+        tokens=_prompt(60, 8), max_new_tokens=6, tenant="user.gold",
+    )))
+    e.run()
+    for rid in rids:
+        assert len(e.result(rid, timeout=0)) == 6
+    with e._ring_lock:
+        finishers = [entry["tenant"] for entry in e._ring][-5:]
+    # The premium CN queued last and finished last — no policy, no
+    # priority, no reordering.
+    assert finishers[-1] == "user.gold", finishers
+    assert e.qos_preemptions == 0
+    assert e.stats()["qos"] is False
+    _no_leaks(e)
+
+
+# ---------------------------------------------------------------------------
+# Priority preemption: park the victim, never kill it — exactness
+# matrix vs never-preempted solo oracles.
+
+MODES = [
+    ("greedy", {}, {}),
+    ("temp", {}, dict(temperature=0.8)),
+    ("spec", dict(spec_decode=2), {}),
+]
+
+
+def _preempt_cycle(e: Engine, depth: int, gkw: dict):
+    """Two best-effort streams saturate both slots; a premium arrival
+    parks one victim.  Returns result lists + solo oracles."""
+    e.set_pipeline_depth(depth)
+    pA, pB = _prompt(70, 16), _prompt(71, 16)
+    pP = _prompt(72, 16)
+    oA = _gen(e, pA, mn=40, seed=7, tenant="user.lead", **gkw)
+    oB = _gen(e, pB, mn=40, seed=9, tenant="user.lead", **gkw)
+    oP = _gen(e, pP, mn=6, seed=3, tenant="user.gold", **gkw)
+    n0 = e.qos_preemptions
+    ra = e.submit(GenRequest(
+        tokens=pA, max_new_tokens=40, seed=7, tenant="user.lead", **gkw,
+    ))
+    rb = e.submit(GenRequest(
+        tokens=pB, max_new_tokens=40, seed=9, tenant="user.lead", **gkw,
+    ))
+    for _ in range(4):
+        e.step()  # both best-effort streams admitted and decoding
+    rp = e.submit(GenRequest(
+        tokens=pP, max_new_tokens=6, seed=3, tenant="user.gold", **gkw,
+    ))
+    e.run()
+    return (
+        e.result(ra, timeout=0), e.result(rb, timeout=0),
+        e.result(rp, timeout=0), oA, oB, oP, e.qos_preemptions - n0,
+    )
+
+
+@pytest.mark.parametrize("quant", [{}, {"kv_int8": True}], ids=["fp", "kv8"])
+@pytest.mark.parametrize("depth", [1, 2])
+@pytest.mark.parametrize("mode", MODES, ids=[m[0] for m in MODES])
+def test_preemption_token_identical(setup, quant, depth, mode):
+    _, ekw, gkw = mode
+    e = _engine(setup, **quant, **ekw)
+    outA, outB, outP, oA, oB, oP, preempts = _preempt_cycle(e, depth, gkw)
+    assert preempts >= 1, "premium admission did not preempt"
+    # The preemptor AND both victims are token-identical to their
+    # never-preempted solo runs — preemption is a swap, not a kill.
+    assert outP == oP
+    assert outA == oA
+    assert outB == oB
+    _no_leaks(e)
+
+
+def test_preemption_accounting_rows(setup):
+    e = _engine(setup)
+    tenants0 = e.stats()["tenants"]
+    pre0 = tenants0.get("user.gold", {}).get("preempted", 0)
+    vic0 = tenants0.get("user.lead", {}).get("parked_victim", 0)
+    *_, preempts = _preempt_cycle(e, 1, {})
+    assert preempts >= 1
+    tenants = e.stats()["tenants"]
+    assert tenants["user.gold"]["tier"] == "premium"
+    assert tenants["user.lead"]["tier"] == "best_effort"
+    # Preemptor rows count preempted; victim rows count parked_victim.
+    assert tenants["user.gold"]["preempted"] == pre0 + preempts
+    assert tenants["user.lead"]["parked_victim"] == vic0 + preempts
+    s = e.stats()
+    assert s["qos"] is True
+    assert s["qos_preemptions"] == e.qos_preemptions
+    _no_leaks(e)
+
+
+def test_equal_tier_never_preempts(setup):
+    """Strictly-lower-priority only: a premium arrival against two
+    PREMIUM streams queues behind them instead of ping-ponging a
+    slot."""
+    e = _engine(setup)
+    e.set_pipeline_depth(1)
+    n0 = e.qos_preemptions
+    ra = e.submit(GenRequest(
+        tokens=_prompt(75, 16), max_new_tokens=24, tenant="user.gold",
+    ))
+    rb = e.submit(GenRequest(
+        tokens=_prompt(76, 16), max_new_tokens=24, tenant="user.gold",
+    ))
+    for _ in range(4):
+        e.step()
+    rc = e.submit(GenRequest(
+        tokens=_prompt(77, 16), max_new_tokens=6, tenant="user.gold",
+    ))
+    e.run()
+    for rid in (ra, rb, rc):
+        assert len(e.result(rid, timeout=0)) > 0
+    assert e.qos_preemptions == n0
+    _no_leaks(e)
+
+
+def test_warm_preemption_cycle_zero_compiles(setup):
+    """A warm engine preempts, parks, and restores compile-free: the
+    first cycle warms every program variant, the second must reuse
+    them — the jit-guard stance extended to the QoS path."""
+    e = _engine(setup)
+    *_, preempts = _preempt_cycle(e, 2, {})  # warm the full cycle
+    assert preempts >= 1
+    with compile_delta() as delta:
+        *_, preempts = _preempt_cycle(e, 2, {})
+    assert preempts >= 1
+    assert delta.count == 0, (
+        f"{delta.count} XLA compiles in a warm preempt/park/restore "
+        f"cycle"
+    )
+    _no_leaks(e)
+
+
+# ---------------------------------------------------------------------------
+# Premium prefix pinning: tier-then-LRU demotion order.
+
+
+def test_premium_prefix_pins_against_demotion(setup):
+    """Two resident entries — premium stored FIRST (the older, i.e.
+    the LRU victim absent QoS), best-effort second.  Pool pressure
+    that demotes exactly one entry must take the best-effort one."""
+    e = _engine(setup)
+    e.set_pipeline_depth(1)
+    _flush_prefixes(e)
+    gold_tokens, lead_tokens = _prompt(80, 16), _prompt(81, 16)
+    for tokens, tenant in (
+        (gold_tokens, "user.gold"), (lead_tokens, "user.lead"),
+    ):
+        rid = e.submit(GenRequest(
+            tokens=tokens, max_new_tokens=2, cache_prefix=True,
+            tenant=tenant,
+        ))
+        e.run()
+        e.result(rid, timeout=0)
+    with e._lock:
+        tiers = sorted(
+            m.get("tier") for m in e._prefix_meta.values()
+        )
+    assert tiers == ["best_effort", "premium"], tiers
+    # Two 7-block worst cases against 16 blocks with 4 held by the
+    # entries: shortfall of exactly one 2-block entry.
+    d0 = e.stats()["prefix_demotions"]
+    rids = [
+        e.submit(GenRequest(tokens=_prompt(85 + i, 16), max_new_tokens=40))
+        for i in range(2)
+    ]
+    e.run()
+    for rid in rids:
+        assert len(e.result(rid, timeout=0)) == 40
+    s = e.stats()
+    assert s["prefix_demotions"] > d0, "pressure did not demote"
+    with e._lock:
+        left = [m.get("tier") for m in e._prefix_meta.values()]
+    # The premium entry is still device-resident; the best-effort one
+    # went to the host tier despite being the LRU-younger entry.
+    assert left == ["premium"], left
+    assert s["host_prefix_entries"] >= 1
+    _flush_prefixes(e)
+    _no_leaks(e)
+
+
+# ---------------------------------------------------------------------------
+# Router quotas: 429 + per-tenant Retry-After at the door.
+
+
+@pytest.fixture(scope="module")
+def backend(setup):
+    """One live oim-serve on a QoS engine (plain HTTP — the trusted
+    perimeter, so x-oim-tenant is honored)."""
+    cfg, params = setup
+    server = ServeServer(Engine(
+        params, cfg, n_slots=2, max_len=64, chunk=4, qos=POLICY,
+    )).start()
+    yield server
+    server.stop()
+
+
+def test_router_rate_quota_429_retry_after(backend):
+    router = Router(
+        backends=(_url(backend),), health_interval=0.2, qos=POLICY,
+    ).start()
+    try:
+        base = f"http://{router.host}:{router.port}"
+        payload = {"tokens": _prompt(1, 6), "max_new_tokens": 2}
+        # tin: rate_rps=0.5, burst 2 — a rapid burst of 5 must shed at
+        # least once (the first two always pass on a fresh bucket).
+        results = [
+            _post(base, "/v1/generate", payload,
+                  headers={"x-oim-tenant": "tin"})
+            for _ in range(5)
+        ]
+        statuses = [status for status, _, _ in results]
+        assert statuses[0] == 200 and statuses[1] == 200, statuses
+        assert 429 in statuses, statuses
+        shed = next(r for r in results if r[0] == 429)
+        _, body, headers = shed
+        assert body["error"] == "tenant quota exhausted"
+        assert body["tenant"] == "tin"
+        assert body["tier"] == "best_effort"
+        assert body["retry_after_s"] > 0
+        retry_after = int(headers["Retry-After"])
+        assert retry_after >= 1
+        # Per-tenant isolation: tin's empty bucket throttles NOBODY
+        # else — another CN and anon both pass.
+        status, _, _ = _post(base, "/v1/generate", payload,
+                             headers={"x-oim-tenant": "user.x"})
+        assert status == 200
+        status, _, _ = _post(base, "/v1/generate", payload)
+        assert status == 200
+        stats = router.stats()["qos"]
+        assert stats["enabled"] is True
+        tin = stats["tenants"]["tin"]
+        assert tin["throttled"] >= 1
+        assert tin["tier"] == "best_effort"
+        assert tin["rate_rps"] == 0.5
+    finally:
+        router.stop()
+
+
+def test_router_token_quota_429(backend):
+    router = Router(
+        backends=(_url(backend),), health_interval=0.2, qos=POLICY,
+    ).start()
+    try:
+        base = f"http://{router.host}:{router.port}"
+        # tok: token_burst=8 — a 6+2 request fits once, a 16+32
+        # request can never fit the bucket and sheds immediately.
+        status, _, _ = _post(
+            base, "/v1/generate",
+            {"tokens": _prompt(2, 6), "max_new_tokens": 2},
+            headers={"x-oim-tenant": "tok"},
+        )
+        assert status == 200
+        status, body, headers = _post(
+            base, "/v1/generate",
+            {"tokens": _prompt(3, 16), "max_new_tokens": 32},
+            headers={"x-oim-tenant": "tok"},
+        )
+        assert status == 429
+        assert body["error"] == "tenant quota exhausted"
+        assert int(headers["Retry-After"]) >= 1
+        # Tenants with no quota config are never throttled: user.gold
+        # has neither rate nor token caps.
+        for _ in range(4):
+            status, _, _ = _post(
+                base, "/v1/generate",
+                {"tokens": _prompt(4, 6), "max_new_tokens": 2},
+                headers={"x-oim-tenant": "user.gold"},
+            )
+            assert status == 200
+    finally:
+        router.stop()
+
+
+def test_router_forwards_resolved_tenant(backend):
+    """The router forwards the RESOLVED tenant downstream, so the
+    backend engine accounts requests under the right CN and tier —
+    `oimctl tenants` merges both sides of that ledger."""
+    router = Router(
+        backends=(_url(backend),), health_interval=0.2, qos=POLICY,
+    ).start()
+    try:
+        base = f"http://{router.host}:{router.port}"
+        for _ in range(2):
+            status, _, _ = _post(
+                base, "/v1/generate",
+                {"tokens": _prompt(5, 6), "max_new_tokens": 3},
+                headers={"x-oim-tenant": "user.gold"},
+            )
+            assert status == 200
+        engine = backend.engine
+        tenants = engine.stats()["tenants"]
+        assert tenants["user.gold"]["requests"] >= 2
+        assert tenants["user.gold"]["tokens_out"] >= 6
+        assert tenants["user.gold"]["tier"] == "premium"
+        # The merged router view picks the backend rows up after a
+        # load probe refreshes the backend table.
+        for b in router._backends.values():
+            router._probe(b)
+        merged = router.stats()["qos"]["tenants"]
+        assert merged["user.gold"]["requests"] >= 2
+        assert merged["user.gold"]["tokens_out"] >= 6
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Identity resolution (the satellite-2 regression): anon is an
+# explicit best-effort tenant; x-oim-tenant only without TLS.
+
+
+def test_anon_is_explicit_best_effort(backend):
+    engine = backend.engine
+    status, _, _ = _post(
+        _url(backend), "/v1/generate",
+        {"tokens": _prompt(6, 6), "max_new_tokens": 2},
+    )
+    assert status == 200
+    tenants = engine.stats()["tenants"]
+    assert tenants["anon"]["tier"] == "best_effort"
+    assert tenants["anon"]["requests"] >= 1
+    with engine._ring_lock:
+        entry = [dict(e) for e in engine._ring][-1]
+    assert entry["tenant"] == "anon"
+    assert entry["tier"] == "best_effort"
+
+
+def test_plain_http_honors_tenant_header(backend):
+    """Behind the router the backend listener is the trusted
+    perimeter: the forwarded x-oim-tenant header IS the identity."""
+    engine = backend.engine
+    status, _, _ = _post(
+        _url(backend), "/v1/generate",
+        {"tokens": _prompt(7, 6), "max_new_tokens": 2},
+        headers={"x-oim-tenant": "user.lead"},
+    )
+    assert status == 200
+    with engine._ring_lock:
+        entry = [dict(e) for e in engine._ring][-1]
+    assert entry["tenant"] == "user.lead"
+    assert entry["tier"] == "best_effort"
+    # Oversized claims are capped, not trusted verbatim.
+    status, _, _ = _post(
+        _url(backend), "/v1/generate",
+        {"tokens": _prompt(8, 6), "max_new_tokens": 2},
+        headers={"x-oim-tenant": "x" * 400},
+    )
+    assert status == 200
+    with engine._ring_lock:
+        entry = [dict(e) for e in engine._ring][-1]
+    assert entry["tenant"] == "x" * 128
+
+
+def test_tls_ignores_tenant_header():
+    """Under TLS the header is IGNORED — a cert-bearing client must
+    not re-badge itself as someone else's quota.  Unit-level on the
+    router's resolver (the server handler shares the precedence:
+    CN > header-iff-not-tls > anon)."""
+
+    class _Handler:
+        connection = object()  # no getpeercert: plain socket, no CN
+        headers = {"x-oim-tenant": "user.gold"}
+
+    from oim_tpu.serve.httptls import peer_common_name
+
+    assert peer_common_name(_Handler()) is None
+    router = Router(backends=("http://a:1",), qos=POLICY)
+    try:
+        # TLS listener, no peer CN: the claimed header must NOT leak
+        # through — the request is anon, not user.gold.
+        router.tls = True
+        assert router._resolve_tenant(_Handler()) == "anon"
+        # Plain-HTTP listener (trusted perimeter): header honored.
+        router.tls = False
+        assert router._resolve_tenant(_Handler()) == "user.gold"
+    finally:
+        router.stop()

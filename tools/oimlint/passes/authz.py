@@ -78,6 +78,11 @@ WRITERS: dict[str, Writer] = {
     "oim_tpu/autoscale/load.py": Writer("{cn}", ("self.cn",)),
     # Operator CLI: authenticates as user.admin (grant "**").
     "oim_tpu/cli/oimctl.py": Writer(ADMIN),
+    # The QoS policy publisher also runs as user.admin, but declares
+    # the LITERAL CN instead of the ADMIN sentinel so the pass actually
+    # resolves its qos/tenants write against the explicit grant row
+    # (ADMIN-sentinel writers are skipped wholesale).
+    "oim_tpu/qos/publish.py": Writer("user.admin"),
     # Fault-management runs registry-side, sharing the registry's DB:
     # its evictions/<vol> stores never cross the authz boundary.
     "oim_tpu/health/monitor.py": Writer(REGISTRY_SIDE),
