@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-serve-overflow test-qos test-autoscale test-jit-guard lint lint-metrics lint-jax agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-serve-overflow test-serve-migrate test-qos test-autoscale test-jit-guard lint lint-metrics lint-jax agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -194,6 +194,27 @@ test-serve-disagg:
 	  --roots oim_tpu/serve
 	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_serve_disagg.py -q -m "serve_disagg and not slow" \
+	  -p no:cacheprovider
+
+# Live slot migration (ISSUE 17, serve_migrate marker): the engine
+# suspend/export/import roundtrip matrix ({greedy, sampled, spec} x
+# {fp, kv8} x pipeline depth {1, 2}, parked slots included) vs an
+# undisturbed solo oracle, the routed drain-mid-stream handoff
+# (token-identical, KV shipped not rebuilt), the chaos kill-mid-ship
+# recompute fallback with zero leaked blocks/holds on either side,
+# the >=20-cycle migrate/kill soak pinning the outcome-counter
+# invariant, the autoscaler migrate-out retire sequence, and the
+# draining-visibility seams (load schema, router routing, oimctl).
+# Nominal ~40s; the cap carries the box's 2-3x CPU-quota headroom.
+# The oimlint prelude sweeps BOTH planes the drain rewires — serve
+# and autoscale — so the new slot-record lifecycle and the retire
+# path's HTTP hop stay analyzer-clean, not grandfathered in baseline.
+test-serve-migrate:
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
+	  --roots oim_tpu/serve,oim_tpu/autoscale
+	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_serve_migrate.py -q -m "serve_migrate and not slow" \
 	  -p no:cacheprovider
 
 # Fleet autoscaler (autoscale marker): policy-boundary units (watermark

@@ -36,7 +36,7 @@ from __future__ import annotations
 import json
 import threading
 from dataclasses import dataclass, field
-from time import monotonic, time as _wall
+from time import monotonic, sleep as _sleep, time as _wall
 from typing import Callable
 
 from oim_tpu import log
@@ -139,6 +139,7 @@ class Autoscaler:
         clock: Callable[[], float] = monotonic,
         wall: Callable[[], float] = _wall,
         monitor=None,
+        migrate_grace_s: float = 5.0,
     ):
         # ONE policy governs the whole fleet (the pre-disaggregation
         # shape), OR ``pool_policies`` gives each disaggregation pool
@@ -170,6 +171,12 @@ class Autoscaler:
         self.replica_prefix = replica_prefix
         self.clock = clock
         self.wall = wall
+        # Live migration drain window (ISSUE 17): after POSTing
+        # /v1/drain to a victim, how long to wait for its in-flight
+        # count to hit zero (slots suspended + shipped to siblings by
+        # the router) before tearing it down anyway.  0 = fire the
+        # drain and proceed immediately.
+        self.migrate_grace_s = migrate_grace_s
         self._states = {
             pool: policy_mod.PolicyState(p)
             for pool, p in self._pool_policies.items()
@@ -760,13 +767,27 @@ class Autoscaler:
         """The scale-in drain sequence (doc/serving.md): (1) mark the
         record DRAINING so the discovery DELETE below is not read as a
         death, (2) withdraw the discovery key — routers stop sending
-        within one watch event, (3) drain + stop the process — in-
-        flight requests finish, (4) unmap + delete the slice, (5) drop
-        the record."""
+        within one watch event, (3) MIGRATE OUT (ISSUE 17): POST
+        /v1/drain so the victim suspends its in-flight slots for the
+        router to ship to siblings, and wait up to ``migrate_grace_s``
+        for its in-flight count to reach zero, (4) drain + stop the
+        process, (5) unmap + delete the slice, (6) drop the record.
+        Step 3 is best-effort by construction — it must never raise
+        (``_scale_in`` keeps DRAINING records for re-drive on
+        exception, and a teardown must not wedge on an unreachable
+        victim)."""
         rid = record.replica_id
         record.state = DRAINING
         self._store_record(record)
+        # Capture the advertised url BEFORE withdrawing the discovery
+        # key: the withdraw round-trips through our own registry watch
+        # (``_on_serve`` pops ``self._serve[rid]``), so a lookup after
+        # the store would always come up empty and silently skip the
+        # migrate-out step.
+        with self._lock:
+            url = self._serve.get(rid, "")
         self.db.store(f"serve/{rid}/address", "")
+        self._migrate_out(rid, url=url)
         self.launcher.stop(rid, drain=True)
         # Withdraw AGAIN after the stop: the victim's own heartbeat may
         # have re-published the key in the window between the first
@@ -779,6 +800,60 @@ class Autoscaler:
         if record.controller:
             self.actuator.deprovision(rid, record.controller)
         self._drop_record(rid)
+
+    def _migrate_out(self, rid: str, url: str | None = None) -> None:
+        """Best-effort live-migration kick for one victim (ISSUE 17):
+        POST its ``/v1/drain`` (the serve endpoint is idempotent and
+        replies the current in-flight count), then poll the same
+        endpoint until in-flight hits zero — every suspended slot
+        shipped to a sibling by the router — or ``migrate_grace_s``
+        expires.  Swallows EVERYTHING: an unreachable, mTLS-guarded,
+        or pre-migration victim degrades to the old wait-for-drain
+        teardown, never to a wedged autoscaler.  ``url`` lets a caller
+        that already withdrew the victim's discovery key (``_retire``)
+        pass the address it captured first."""
+        if url is None:
+            with self._lock:
+                url = self._serve.get(rid, "")
+        url = url.rstrip("/")
+        if not url:
+            return
+        import urllib.request
+
+        def drain_once() -> int | None:
+            req = urllib.request.Request(
+                url + "/v1/drain", data=b"{}",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                reply = json.loads(resp.read())
+            return int(reply.get("in_flight", 0))
+        try:
+            in_flight = drain_once()
+        except Exception as exc:
+            log.current().info(
+                "migrate-out drain unreachable; plain teardown",
+                replica=rid, error=f"{type(exc).__name__}: {exc}",
+            )
+            return
+        events.emit(
+            "autoscale.migrate_out",
+            component="oim-autoscale",
+            subject=rid,
+            in_flight=in_flight,
+        )
+        deadline = self.clock() + max(0.0, self.migrate_grace_s)
+        while in_flight and self.clock() < deadline:
+            _sleep(0.05)
+            try:
+                in_flight = drain_once()
+            except Exception:
+                return  # victim already gone; teardown proceeds
+        if in_flight:
+            log.current().warning(
+                "migrate-out grace expired with work in flight",
+                replica=rid, in_flight=in_flight,
+            )
 
     def _redrive_records(self) -> None:
         """Finish what a crashed (or transiently failed) incarnation
@@ -887,6 +962,12 @@ class Autoscaler:
             # allocation under this name, and re-using it would alias
             # two slices to one volume id when the controller recovers.
             self._evicted_ids.add(rid)
+        # Eviction/controller-death replacement (ISSUE 17): when the
+        # victim's daemon is still reachable (the SLICE is doomed, the
+        # process often is not yet), migrate its in-flight slots out
+        # before the teardown destroys them.  Best-effort — a dead
+        # process just skips this.
+        self._migrate_out(rid)
         self.launcher.stop(rid, drain=False)
         if record.controller:
             try:

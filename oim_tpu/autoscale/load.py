@@ -98,6 +98,13 @@ _DEFAULTS: dict[str, Any] = {
     # value stays bounded however many CNs pass through.
     "tenants": {},
     "qos_preemptions": 0,
+    # Live migration drain state (ISSUE 17; False from publishers
+    # predating the field — tolerant-decode default): the backend has
+    # entered migrate-out drain.  The router stops routing NEW work to
+    # it (while /v1/kv + /v1/slot pulls keep flowing), the drain-flip
+    # triggers the prefix demote-to-peer sweep, and `oimctl top`
+    # shows the DRAIN marker.
+    "draining": False,
     "ts": 0.0,
 }
 

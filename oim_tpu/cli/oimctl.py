@@ -262,8 +262,16 @@ def _print_top(
             f"{load.get('shed_deadline', 0)}/"
             f"{load.get('shed_brownout', 0)}"
         )
+        # Migrate-out drain (ISSUE 17): a draining backend is still
+        # healthy (it serves /v1/kv + /v1/slot pulls) but takes no new
+        # work — the HEALTHY cell says so instead of a misleading
+        # plain "yes".
+        if load.get("draining"):
+            health_cell = "DRAIN"
+        else:
+            health_cell = "yes" if healthy else "NO"
         print(
-            f"{bid[:28]:<28} {'yes' if healthy else 'NO':<8} "
+            f"{bid[:28]:<28} {health_cell:<8} "
             f"{str(load.get('pool') or 'mixed')[:8]:<8} {q:>6} "
             f"{a:>7} {s:>6} {load.get('token_rate', 0.0):>9.1f} "
             f"{kv:>26} {path:>10} {pfx:>9} {promo:>10} {ship:>9} "

@@ -697,7 +697,12 @@ def main(argv=None) -> int:
         if registration is not None:
             registration.stop()
             registration = None
-        engine.drain()
+        # Migrate-out drain (ISSUE 17): beyond stop-admitting, suspend
+        # in-flight slots into /v1/slot records so the router ships
+        # them to siblings instead of waiting out (or truncating)
+        # their decode — the in_flight() wait below then clears as
+        # soon as the slots are suspended, not when they finish.
+        server.begin_drain()
         log.current().info(
             "draining", in_flight=engine.in_flight(),
             timeout_s=args.drain_timeout,

@@ -178,6 +178,15 @@ class FlakyHTTPBackend:
     - ``healthz_error_rate``: probability a GET /healthz answers an
       injected 503 — the health-flapping surface.
     - ``delay_s``: sleep per response chunk (slow backend).
+    - ``fail_next_get(n, path_prefix)``: scripts the next ``n`` GETs
+      whose path starts with ``path_prefix`` to be severed mid-body —
+      the kill-mid-slot-ship surface (ISSUE 17): a ``GET /v1/slot``
+      export cut at half its declared Content-Length is exactly what
+      a source dying mid-migration looks like to the router's
+      ``ship_slot`` (short read → fall back to splice recompute).
+
+    PUT and DELETE forward transparently (the migration wire's ingest
+    and release verbs), POST-kill-eligible like POSTs are.
 
     ``start()`` returns self; point the router at ``.url``.
     """
@@ -201,6 +210,8 @@ class FlakyHTTPBackend:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._forced = 0
+        self._forced_get = 0
+        self._forced_get_prefix = ""
         self.requests = 0
         self.kills = 0
         outer = self
@@ -223,11 +234,20 @@ class FlakyHTTPBackend:
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                outer._forward(self, None)
+                outer._forward(self, None, kill=outer._get_kill(path))
 
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", "0"))
                 outer._forward(self, self.rfile.read(length))
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                outer._forward(
+                    self, self.rfile.read(length), method="PUT"
+                )
+
+            def do_DELETE(self):
+                outer._forward(self, None, method="DELETE")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
@@ -242,6 +262,26 @@ class FlakyHTTPBackend:
     def fail_next(self, n: int = 1) -> None:
         with self._lock:
             self._forced += n
+
+    def fail_next_get(self, n: int = 1, path_prefix: str = "/v1/slot") -> None:
+        """Script the next ``n`` matching GETs to be severed mid-body
+        (kill-mid-slot-ship, ISSUE 17).  GETs are otherwise never
+        kill-eligible — health probes and info fetches must stay
+        honest while the scripted ship kill lands deterministically."""
+        with self._lock:
+            self._forced_get += n
+            self._forced_get_prefix = path_prefix
+
+    def _get_kill(self, path: str) -> bool:
+        with self._lock:
+            if (
+                self._forced_get > 0
+                and path.startswith(self._forced_get_prefix)
+            ):
+                self._forced_get -= 1
+                self.requests += 1
+                return True
+            return False
 
     def _roll(self, rate: float) -> bool:
         with self._lock:
@@ -264,9 +304,14 @@ class FlakyHTTPBackend:
         with self._lock:
             self.kills += 1
 
-    def _forward(self, handler, body: bytes | None) -> None:
-        """Proxy one request; POSTs are kill-eligible."""
-        kill = body is not None and self._kill_roll()
+    def _forward(
+        self, handler, body: bytes | None, kill: bool = False,
+        method: str | None = None,
+    ) -> None:
+        """Proxy one request; POST/PUTs are kill-eligible by roll,
+        GETs only by ``fail_next_get`` scripting (the ``kill``
+        argument)."""
+        kill = kill or (body is not None and self._kill_roll())
         headers = (
             {"Content-Type": "application/json"} if body is not None
             else {}
@@ -278,7 +323,8 @@ class FlakyHTTPBackend:
             if handler.headers.get(name):
                 headers[name] = handler.headers[name]
         req = urllib.request.Request(
-            self.backend_url + handler.path, data=body, headers=headers
+            self.backend_url + handler.path, data=body, headers=headers,
+            method=method,
         )
         try:
             resp = urllib.request.urlopen(req, timeout=600)

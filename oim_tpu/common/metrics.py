@@ -413,6 +413,19 @@ SERVE_DISAGG = _registry.counter(
     "nothing needed shipping.",
     ("outcome",),
 )
+SERVE_MIGRATIONS = _registry.counter(
+    "oim_serve_migrations_total",
+    "Live slot migrations (drain/scale-in/eviction, ISSUE 17) by "
+    "outcome: migrated = the suspended slot shipped to a sibling and "
+    "the stream resumed from its KV (zero recompute of decoded "
+    "tokens), fell_back = any step failed and the request finished "
+    "via the splice-recompute continuation (token-identical greedy, "
+    "prefill paid again), gave_up = no sibling existed to take the "
+    "state — the one outcome that loses work.  The outcomes sum to "
+    "migrate markers received; a nonzero gave_up during a planned "
+    "drain means the fleet was drained below N=2.",
+    ("outcome",),
+)
 
 # ---------------------------------------------------------------------------
 # Per-tenant SLO attribution histograms (ISSUE 9): the engine's phase

@@ -77,6 +77,12 @@ PREFIX_IMPORT_TTL_S = 60.0
 PREFIX_IMPORT_MAX = 8
 PREFIX_DIGEST_CAP = 32
 
+# Live slot migration (ISSUE 17): a draining backend's suspended-slot
+# records pin their captured blocks like holds do, so they carry the
+# same TTL — but no count cap: records are only ever minted from live
+# slots and parked requests, so engine capacity already bounds them.
+MIGRATE_TTL_S = 60.0
+
 MANIFEST_KIND = "oim-kv"
 MANIFEST_VERSION = 1
 
@@ -144,6 +150,29 @@ class KvImport:
     rows: int
     tokens: list[int]  # prompt + emitted, the continuation's prompt
     data: dict  # leaf name → np array [n_layers, n_ship, bs, kvh, hd]
+    t_created: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class SlotRecord:
+    """Draining-side suspended live slot (ISSUE 17): everything a
+    sibling needs to resume the request exactly.  EITHER ``blocks``
+    (device ids, one extra ref each — an active slot captured
+    hold-style at the migrate wave) OR ``host_blocks`` (a parked
+    request's host-tier payload, ownership transferred from the parked
+    record) is set, never both.  ``meta`` becomes the manifest's
+    ``"slot"`` branch: the position-indexed sampling offset
+    (``sample_base``), deadline remainder, tenant/tier, and trace
+    context."""
+
+    rid: int
+    blocks: tuple[int, ...]
+    host_blocks: tuple[int, ...]
+    rows: int
+    prompt_tokens: list[int]
+    tokens: list[int]  # emitted on this backend
+    sampling: dict
+    meta: dict
     t_created: float = field(default_factory=time.monotonic)
 
 
@@ -286,6 +315,25 @@ def validate_geometry(manifest: dict, geometry: dict) -> None:
             f"rows {rows!r} inconsistent with {n_tok} tokens "
             f"(valid rows must be tokens - 1)"
         )
+    slot = manifest.get("slot")
+    if slot is not None:
+        # A live-slot transfer (GET /v1/slot) is hold-shaped — it rode
+        # the rows == tokens - 1 check above — plus a "slot" branch
+        # whose sampling offset the continuation depends on for
+        # sampled exactness: refuse a torn/forged branch here, before
+        # anything is staged.
+        if manifest.get("prefix"):
+            raise KvGeometryError(
+                "a transfer cannot be both a prefix entry and a slot"
+            )
+        base = slot.get("sample_base") if isinstance(slot, dict) else None
+        if not isinstance(base, int) or base < len(
+            manifest.get("tokens", ())
+        ):
+            raise KvGeometryError(
+                f"slot sample_base {base!r} inconsistent with "
+                f"{len(manifest.get('tokens', ()))} emitted tokens"
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -327,6 +375,65 @@ def ship_kv(
     with opener(req, timeout=timeout) as resp:
         reply = json.loads(resp.read())
     return int(reply["import_id"]), int(reply["rows"]), len(body)
+
+
+def ship_slot(
+    opener,
+    src_url: str,
+    rid: int,
+    dst_url: str,
+    timeout: float = 30.0,
+) -> tuple[int, int, dict, int]:
+    """Move one suspended live slot (ISSUE 17): GET it off the
+    draining backend, PUT it into the migration target's staging
+    ingest.  Returns (import_id, rows, slot branch, bytes shipped).
+    Raises on ANY failure — short read (the source died mid-ship),
+    HTTP error (404 record expired, 409 geometry, 429 capacity),
+    unparseable reply — and the caller falls back to the
+    splice-recompute continuation; like :func:`ship_kv` this performs
+    no cleanup (the caller releases the source record either way, and
+    a staged-but-never-consumed target side TTL-expires)."""
+    with opener(
+        f"{src_url}/v1/slot?rid={int(rid)}", timeout=timeout
+    ) as resp:
+        clen = int(resp.headers.get("Content-Length", "0"))
+        body = resp.read()
+    if clen and len(body) != clen:
+        raise OSError(
+            f"slot fetch truncated: {len(body)} of {clen} bytes "
+            f"(draining backend died mid-ship)"
+        )
+    req = urllib.request.Request(
+        f"{dst_url}/v1/slot",
+        data=body,
+        headers={"Content-Type": "application/octet-stream"},
+        method="PUT",
+    )
+    with opener(req, timeout=timeout) as resp:
+        reply = json.loads(resp.read())
+    slot = reply.get("slot")
+    return (
+        int(reply["import_id"]),
+        int(reply["rows"]),
+        slot if isinstance(slot, dict) else {},
+        len(body),
+    )
+
+
+def release_slot(
+    opener, url: str, rid: int, timeout: float = 5.0
+) -> None:
+    """Best-effort DELETE of a suspended-slot record on the draining
+    source — same stance as :func:`release_kv` (the TTL sweep owns the
+    backstop; a torn-down source needs nothing released at all)."""
+    req = urllib.request.Request(
+        f"{url}/v1/slot?rid={int(rid)}", method="DELETE"
+    )
+    try:
+        with opener(req, timeout=timeout):
+            pass
+    except Exception:
+        pass  # the TTL sweep (or the teardown itself) owns the backstop
 
 
 def ship_prefix(

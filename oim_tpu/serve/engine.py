@@ -119,6 +119,7 @@ from oim_tpu.serve.disagg import (
     KV_HOLD_TTL_S,
     KV_IMPORT_MAX,
     KV_IMPORT_TTL_S,
+    MIGRATE_TTL_S,
     PREFIX_DIGEST_CAP,
     PREFIX_IMPORT_MAX,
     PREFIX_IMPORT_TTL_S,
@@ -127,6 +128,7 @@ from oim_tpu.serve.disagg import (
     KvHold,
     KvImport,
     KvIneligibleError,
+    SlotRecord,
     build_manifest,
     prefix_digest,
     validate_geometry,
@@ -1370,6 +1372,15 @@ class GenRequest:
     # of re-prefilling.  An expired/unknown import falls back to a
     # normal (recompute) admission, token-identical either way.
     kv_import: int | None = None
+    # Sampling-key offset for continuations (ISSUE 17): every sampled
+    # token's PRNG key is ``fold_in(PRNGKey(seed), i)`` where ``i`` is
+    # the token's GLOBAL emission index.  A fresh request starts at 0;
+    # a migrated/spliced continuation sets this to the count of tokens
+    # the client already received, so its key indices line up with the
+    # undisturbed stream's — that is what makes a continuation
+    # sampled-exact, not just greedy-exact.  Host-side data only: no
+    # jit signature changes, no recompiles.
+    sample_base: int = 0
 
 
 class QueueFullError(RuntimeError):
@@ -1396,6 +1407,7 @@ _KIND_TEXT = {
     "deadline": "deadline exceeded",
     "deadline_queue": "shed (deadline expired in queue)",
     "stalled": "stalled",
+    "migrated": "suspended for migration (resume on a sibling)",
 }
 
 
@@ -1405,7 +1417,9 @@ class RequestFailedError(RuntimeError):
     "cancelled" (client went away), "deadline" (expired mid-decode,
     504), "deadline_queue" (shed before a slot, 429 + Retry-After),
     "stalled" (watchdog failed it fast, 503 + Retry-After — retryable
-    on another replica)."""
+    on another replica), "migrated" (suspended by a migrate-out drain —
+    the stream layer hands the rid to the router, which resumes the
+    request on a sibling; non-stream callers see 503 + Retry-After)."""
 
     def __init__(self, rid: int, kind: str, message: str):
         super().__init__(
@@ -1963,6 +1977,18 @@ class Engine:
         self.kv_exports = 0
         self.kv_imports_total = 0
         self.kv_ship_bytes = 0
+        # Live slot migration (ISSUE 17): suspended-slot records minted
+        # by the migrate wave (rid → SlotRecord — captured device
+        # blocks hold-style, or a parked request's transferred host
+        # payload), served by GET /v1/slot until shipped, released, or
+        # TTL-swept.  ``_migrate_out`` latches begin_migrate_out(): the
+        # driver suspends everything at the next step boundary and
+        # keeps the wave armed for parked slots whose tier write is
+        # still in flight.
+        self._migrated: dict[int, SlotRecord] = {}
+        self._migrate_out = False
+        self.slot_exports = 0
+        self.slot_imports = 0
         # Model-drafted speculation: the draft model keeps its OWN slot
         # cache (full precision — it is small) in lockstep with the
         # target's lengths; prompt lookup's device-side history is then
@@ -2382,6 +2408,8 @@ class Engine:
             raise ValueError("empty prompt")
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if req.sample_base < 0:
+            raise ValueError("sample_base must be >= 0")
         if len(req.tokens) > self.prompt_buckets[-1]:
             raise ValueError(
                 f"prompt length {len(req.tokens)} exceeds largest bucket "
@@ -3084,6 +3112,13 @@ class Engine:
                 "kv_exports": self.kv_exports,
                 "kv_imports": self.kv_imports_total,
                 "kv_ship_bytes": self.kv_ship_bytes,
+                # Live slot migration (ISSUE 17): suspended-slot
+                # records still awaiting a /v1/slot pickup (each pins
+                # its KV blocks until shipped, released, or TTL-swept)
+                # plus this backend's lifetime export/import counts.
+                "migrated_slots": len(self._migrated),
+                "slot_exports": self.slot_exports,
+                "slot_imports": self.slot_imports,
                 "spec_drafted": self.spec_drafted,
                 "spec_accepted": self.spec_accepted,
                 "readbacks": self.readbacks,
@@ -3259,6 +3294,12 @@ class Engine:
                 # engine-total preemption count.
                 "tenants": self._tenant_snapshot_locked(),
                 "qos_preemptions": self.qos_preemptions,
+                # Migrate-out drain state (ISSUE 17, tolerant decode:
+                # absent from publishers predating the field): the
+                # router stops routing NEW work at a draining backend
+                # while /v1/kv and /v1/slot pulls keep flowing, and
+                # `oimctl top` renders the DRAIN marker off it.
+                "draining": bool(self._draining),
                 "brownout": bool(
                     self.brownout_max_tokens
                     and self._pressure_since is not None
@@ -5239,6 +5280,304 @@ class Engine:
                 self._update_kv_gauges_locked()
         return installed
 
+    # -- live slot migration: suspend/export/import (ISSUE 17) ------------
+
+    def begin_migrate_out(self) -> None:
+        """Enter migrate-out drain: stop admitting (``submit`` raises
+        DrainingError, like ``drain()``) AND have the driver suspend
+        every queued, active, and parked request at the next step
+        boundary into "migrated" failures — active slots leaving a
+        SlotRecord behind for ``GET /v1/slot`` so the router can
+        resume them on a sibling with zero recompute.  Idempotent;
+        safe from any thread (the wave itself runs on the driver)."""
+        with self._lock:
+            self._draining = True
+            self._migrate_out = True
+
+    def _slot_meta_locked(self, state: "_SlotState", now: float) -> dict:
+        """The manifest's ``"slot"`` branch for one suspended request
+        (lock held): the GLOBAL sampling offset (this backend's
+        emitted count on top of whatever offset the request already
+        carried — a re-migrated continuation accumulates), the
+        deadline remainder in ms, tenant/tier, and trace context.
+        Spec-decode history needs no field: the admission path
+        rebuilds it from the full token record the manifest already
+        carries."""
+        req = state.req
+        tenant = req.tenant or "anon"
+        return {
+            "sample_base": len(state.emitted) + req.sample_base,
+            "deadline_ms": (
+                int(max(0.0, req.deadline - now) * 1000)
+                if req.deadline is not None else None
+            ),
+            "tenant": tenant,
+            "tier": self._qos_lookup(tenant).tier,
+            "trace": req.span.traceparent() if req.span else None,
+        }
+
+    def _capture_slot_locked(
+        self, slot: int, state: "_SlotState", now: float
+    ) -> bool:
+        """Mint one active slot's migration record (lock held, driver
+        thread, BEFORE the slot's blocks release): one extra ref on
+        every block the valid rows cover — ``_hold_kv_locked``'s
+        frontier shape (rows = tokens - 1) and its in-flight-chunk
+        safety argument verbatim: a chained chunk only writes rows at
+        or beyond this frontier, the refs keep the blocks from
+        reallocation, and the importer masks garbage beyond ``rows``.
+        Returns False on ineligible state (dense, kv4, a sentineled
+        table, nothing decoded yet) — the router's splice-recompute
+        fallback covers those, so no capture is ever load-bearing."""
+        if not self.paged or self.kv_int4:
+            return False
+        rows = len(state.req.tokens) + len(state.emitted) - 1
+        if rows < 1:
+            return False
+        n_ship = -(-rows // self.kv_block)
+        row = self._tables_host[slot]
+        blocks = tuple(int(b) for b in row[:n_ship])
+        if any(b >= self.kv_blocks for b in blocks):
+            return False  # abort() sentineled the row mid-wave
+        self._alloc.incref(blocks)
+        self._migrated[state.rid] = SlotRecord(
+            rid=state.rid,
+            blocks=blocks,
+            host_blocks=(),
+            rows=rows,
+            prompt_tokens=list(state.req.tokens),
+            tokens=list(state.emitted),
+            sampling={
+                "seed": state.req.seed,
+                "temperature": state.req.temperature,
+                "top_p": state.req.top_p,
+                "min_p": state.req.min_p,
+            },
+            meta=self._slot_meta_locked(state, now),
+            t_created=now,
+        )
+        self._update_kv_gauges_locked()
+        return True
+
+    def _migrate_wave(self) -> None:
+        """Suspend everything for migrate-out (driver thread, step
+        start, right after ``_reap`` — the same pop/fail/collect-
+        callbacks shape).  Queued entries fail "migrated" with no
+        record (nothing is admitted yet; the router's fallback
+        resubmits from scratch, token-identical).  Active slots are
+        captured premium-first (the QoS migration order: the router
+        sees premium migrate markers first and ships them first),
+        then freed and failed.  Ready parked slots transfer their
+        host payload to a record wholesale — no device traffic at
+        all; a parked slot whose tier write is still in flight stays
+        parked, and the armed wave takes it on a later step once
+        ``_complete_host_writes`` marks it ready."""
+        ended = []
+        now = time.monotonic()
+        with self._lock:
+            if not self._migrate_out:
+                return
+            if not (self._queue or self._slots or self._parked):
+                return
+            for rid, req, t_sub in self._queue:
+                self._fail_locked(
+                    rid, "migrated",
+                    "backend draining before admission",
+                    req=req, t_submit=t_sub,
+                )
+                cb = self._callbacks.pop(rid, None)
+                if cb is not None:
+                    ended.append(cb)
+            if self._queue:
+                self._queue.clear()
+                self._m_queued.set(0.0, self._engine_label)
+            order = sorted(
+                self._slots.items(),
+                key=lambda kv: (
+                    -self._qos_lookup(
+                        kv[1].req.tenant or "anon"
+                    ).priority,
+                    kv[1].t_submit,
+                ),
+            )
+            for slot, state in order:
+                captured = self._capture_slot_locked(slot, state, now)
+                self._slots.pop(slot)
+                self._free.append(slot)
+                self._release_slot_blocks_locked(slot)
+                self._fail_locked(
+                    state.rid, "migrated",
+                    (
+                        f"suspended after {len(state.emitted)} tokens "
+                        f"(KV captured for /v1/slot)"
+                        if captured else
+                        f"suspended after {len(state.emitted)} tokens "
+                        f"(no capture: recompute on a sibling)"
+                    ),
+                    state=state,
+                )
+                cb = self._callbacks.pop(state.rid, None)
+                if cb is not None:
+                    ended.append(cb)
+            for rid in [
+                r for r, p in self._parked.items()
+                if p.ready and not p.restoring
+            ]:
+                parked = self._parked.pop(rid)
+                state = parked.state
+                if not self.kv_int4:
+                    # Ownership transfer, not a copy: the record now
+                    # owns the parked host blocks and their refs —
+                    # export reads them straight off the host pool.
+                    self._migrated[rid] = SlotRecord(
+                        rid=rid,
+                        blocks=(),
+                        host_blocks=parked.host_blocks,
+                        rows=parked.rows,
+                        prompt_tokens=list(state.req.tokens),
+                        tokens=list(state.emitted),
+                        sampling={
+                            "seed": state.req.seed,
+                            "temperature": state.req.temperature,
+                            "top_p": state.req.top_p,
+                            "min_p": state.req.min_p,
+                        },
+                        meta=self._slot_meta_locked(state, now),
+                        t_created=now,
+                    )
+                    msg = (
+                        f"suspended while parked "
+                        f"({len(state.emitted)} tokens; host payload "
+                        f"captured for /v1/slot)"
+                    )
+                else:
+                    # kv4 never ships (no wire dtype): return the host
+                    # blocks and let the fallback recompute.
+                    self._host.alloc.decref(parked.host_blocks)
+                    msg = (
+                        f"suspended while parked "
+                        f"({len(state.emitted)} tokens; kv4 payload "
+                        f"not shippable — recompute on a sibling)"
+                    )
+                self._fail_locked(rid, "migrated", msg, state=state)
+                cb = self._callbacks.pop(rid, None)
+                if cb is not None:
+                    ended.append(cb)
+            self._update_kv_gauges_locked()
+            self._m_active.set(float(len(self._slots)), self._engine_label)
+        self._drain_fail_obs()
+        for cb in ended:  # end-of-stream outside the lock
+            cb(None, None)
+
+    def _sweep_migrated_locked(self, now: float) -> None:
+        for rid in [
+            r for r, rec in self._migrated.items()
+            if now - rec.t_created > MIGRATE_TTL_S
+        ]:
+            self._release_migrated_locked(rid)
+
+    def _release_migrated_locked(self, rid: int) -> bool:
+        rec = self._migrated.pop(rid, None)
+        if rec is None:
+            return False
+        if rec.blocks:
+            self._alloc.decref(rec.blocks)
+        if rec.host_blocks and self._host is not None:
+            self._host.alloc.decref(rec.host_blocks)
+        self._update_kv_gauges_locked()
+        return True
+
+    def release_migrated(self, rid: int) -> bool:
+        """Drop a suspended-slot record (the router's post-ship
+        release, or the DELETE /v1/slot handler); idempotent."""
+        if not self.paged:
+            return False
+        with self._lock:
+            return self._release_migrated_locked(rid)
+
+    def export_slot(self, rid: int):
+        """One suspended slot's full request state as (manifest, leaf
+        arrays in manifest order) — the ``GET /v1/slot`` payload:
+        the PR 12 KV framing plus the ``"slot"`` manifest branch.
+        Device-captured records gather through ``_gather_blocks``
+        (safe from handler threads: the record's refs pin the blocks,
+        and any in-flight writes land beyond ``rows`` — masked by the
+        importer); parked records read the host pool directly, no
+        device traffic at all.  Raises ``KvIneligibleError`` on a
+        dense/kv4 engine or an unknown/expired rid."""
+        if not self.paged:
+            raise KvIneligibleError(
+                "slot export needs a paged engine (oim-serve --kv-block)"
+            )
+        if self.kv_int4:
+            raise KvIneligibleError("slot export unsupported on kv_int4")
+        with self._lock:
+            self._sweep_migrated_locked(time.monotonic())
+            rec = self._migrated.get(rid)
+            if rec is None:
+                raise KvIneligibleError(
+                    f"no migrated slot for request {rid}"
+                )
+        if rec.host_blocks:
+            names = ["k", "v"] + (
+                ["k_scale", "v_scale"] if self.kv_int8 else []
+            )
+            ids = list(rec.host_blocks)
+            # The host pool mirrors the device leaf layout (axis 1 =
+            # blocks), so the gather lands in the exact wire shape
+            # [n_layers, n_ship, bs, kvh, hd].  Rows are stable: only
+            # the driver writes host blocks, and this record's refs
+            # (transferred from the parked slot) keep them allocated.
+            arrays = [
+                np.ascontiguousarray(
+                    np.take(getattr(self._host, name), ids, axis=1)
+                )
+                for name in names
+            ]
+        else:
+            names, arrays = self._gather_blocks(
+                rec.blocks, what=f"slot rid {rid}"
+            )
+        leaves = [
+            {
+                "name": name,
+                "dtype": str(arr.dtype),
+                "shape": [int(d) for d in arr.shape],
+            }
+            for name, arr in zip(names, arrays)
+        ]
+        manifest = build_manifest(
+            geometry=self.kv_geometry(),
+            rows=rec.rows,
+            prompt_tokens=rec.prompt_tokens,
+            tokens=rec.tokens,
+            sampling=rec.sampling,
+            leaves=leaves,
+        )
+        manifest["slot"] = dict(rec.meta)
+        total = sum(int(a.nbytes) for a in arrays)
+        with self._lock:
+            self.slot_exports += 1
+            self.kv_ship_bytes += total
+        return manifest, arrays
+
+    def import_slot(self, manifest: dict, data: dict):
+        """Stage one shipped slot state (``PUT /v1/slot``): the
+        ``import_kv`` staging path verbatim — the continuation
+        consumes it through the same ``kv_import`` admission, tail
+        prefill, and warmup-precompiled ingest writes, so migration
+        costs zero steady-state compiles — plus the slot-branch
+        check.  Returns (import_id, rows, slot branch)."""
+        slot_meta = manifest.get("slot")
+        if not isinstance(slot_meta, dict):
+            raise KvGeometryError(
+                "not a slot manifest (no slot branch)"
+            )
+        import_id, rows = self.import_kv(manifest, data)
+        with self._lock:
+            self.slot_imports += 1
+        return import_id, rows, slot_meta
+
     def _plan_import_admission_locked(self, req: GenRequest, imp: KvImport):
         """Admission plan for a staged-import continuation (lock
         held): the shipped blocks become the slot's leading table
@@ -5578,6 +5917,7 @@ class Engine:
         # boundary looks at either.
         self._complete_host_writes()
         self._reap()
+        self._migrate_wave()
         with self._lock:
             elide_tail = (
                 self._inflight is not None
@@ -5649,7 +5989,7 @@ class Engine:
         with self._lock:
             if self.paged and (
                 self._kv_holds or self._kv_imports
-                or self._prefix_installs
+                or self._prefix_installs or self._migrated
             ):
                 # Drive the KV-transfer TTLs from the step loop too: a
                 # ship whose orchestrator died must return its blocks
@@ -5657,6 +5997,7 @@ class Engine:
                 self._sweep_kv_holds_locked(now)
                 self._sweep_kv_imports_locked(now)
                 self._sweep_prefix_installs_locked(now)
+                self._sweep_migrated_locked(now)
             if not (
                 self._cancelled
                 or any(req.deadline is not None for _, req, _ in self._queue)
@@ -6014,8 +6355,12 @@ class Engine:
                     reps[i] = req.repetition_penalty
                     press[i] = req.presence_penalty
                     freqs[i] = req.frequency_penalty
+                    # First-token key at the request's GLOBAL emission
+                    # index: 0 for fresh requests, the already-emitted
+                    # count for migrated/spliced continuations — what
+                    # keeps a continuation sampled-exact (ISSUE 17).
                     keys[i] = jax.random.fold_in(
-                        jax.random.PRNGKey(req.seed), 0
+                        jax.random.PRNGKey(req.seed), req.sample_base
                     )
                 t_disp = time.monotonic()
                 self._watch_begin()
@@ -6265,7 +6610,12 @@ class Engine:
             )
             counts = np.asarray(
                 [
-                    len(slots[i].emitted) if i in slots else 0
+                    # Global emission index, not the slot-local count:
+                    # a continuation's sample_base offsets every key
+                    # to where the undisturbed stream's would be
+                    # (fresh requests carry 0 — bit-identical then).
+                    len(slots[i].emitted) + slots[i].req.sample_base
+                    if i in slots else 0
                     for i in range(n_slots)
                 ],
                 np.int32,

@@ -109,8 +109,10 @@ from oim_tpu.qos.policy import DEFAULT_POLICY as _QOS_DEFAULT
 from oim_tpu.serve.disagg import (
     prefix_digest,
     release_kv,
+    release_slot,
     ship_kv,
     ship_prefix,
+    ship_slot,
 )
 from oim_tpu.serve.httptls import check_serving_peer, peer_common_name
 
@@ -128,6 +130,13 @@ PROXIED = (
 # limit the longest-idle row is dropped — its bucket restarts full,
 # which errs toward admitting, never toward wedging a tenant out.
 _MAX_TENANT_ROWS = 256
+
+# Prefix demote-to-peer on drain (ROADMAP item 5, ISSUE 17): how many
+# of a draining backend's hottest exportable prefix entries the router
+# ships to a sibling before teardown destroys its cache working set.
+# Small on purpose — demotion races the drain grace, and the hottest
+# handful carries most of the fleet's hit rate.
+DRAIN_DEMOTE_ENTRIES = 4
 
 
 @dataclass
@@ -168,6 +177,10 @@ class Backend:
     # operator (or the autoscaler runbook's incident queries) sees the
     # whole fleet's pressure from one endpoint.
     load: dict = field(default_factory=dict)
+    # Latched once per drain (ISSUE 17): the prefix demote-to-peer
+    # sweep ran for this backend's current draining episode.  Reset
+    # when the load flag clears (restart), so a re-drain demotes again.
+    drain_demoted: bool = False
 
 
 class _SpliceState:
@@ -199,6 +212,11 @@ class _SpliceState:
         # continues on a decode backend), carrying the request_id that
         # addresses the held KV.
         self.captured_done: dict | None = None
+        # Live slot migration (ISSUE 17): a migrate marker line sets
+        # the rid addressing the suspended slot (GET /v1/slot?rid=)
+        # and _pipe_spliced records which backend suspended it.
+        self.migrate_rid: int | None = None
+        self.migrate_src: "Backend | None" = None
 
     @staticmethod
     def plan(path: str, body: bytes | None) -> "_SpliceState | None":
@@ -234,14 +252,24 @@ class _SpliceState:
         budget reduced by what the client already has.  ``cache_prefix``
         is dropped from continuations (a one-off spliced prompt must
         not evict real entries from the new backend's prefix cache).
-        ``extra`` fields (the disaggregation path's ``kv_import``)
-        merge into a continuation body."""
-        if not self.prior_tokens:
+        ``extra`` fields (the disaggregation/migration paths'
+        ``kv_import``) merge into a continuation body — and force the
+        continuation form even with nothing emitted yet, so a slot
+        migrated before its first token still resumes from shipped KV
+        instead of resubmitting the original body sans import."""
+        if not self.prior_tokens and not extra:
             return self._orig_body
         payload = dict(self.payload)
         payload["tokens"] = self.orig_tokens + self.prior_tokens
         payload["max_new_tokens"] = (
             self.orig_max_new - len(self.prior_tokens)
+        )
+        # Global emission index of the continuation's first sampled
+        # token (ISSUE 17): keeps per-position PRNG keys identical to
+        # an undisturbed solo run, so SAMPLED continuations are exact
+        # like greedy ones (a no-op for greedy decode).
+        payload["sample_base"] = (
+            int(payload.get("sample_base") or 0) + len(self.prior_tokens)
         )
         payload.pop("cache_prefix", None)
         payload.pop("hold_kv", None)
@@ -302,6 +330,7 @@ class Router:
         disagg_prompt_tokens: int = 0,
         disagg_first_tokens: int = 1,
         disagg_ship_timeout: float = 30.0,
+        migrate_timeout: float = 30.0,
         residency_aware: bool = True,
         prefix_fetch: bool = True,
         prefix_fetch_timeout: float = 10.0,
@@ -349,6 +378,17 @@ class Router:
             "shipped": 0, "fell_back": 0, "prefill_only": 0,
             "ship_bytes": 0, "ship_seconds": 0.0,
         }
+        # Live slot migration (ISSUE 17): wall-clock budget for one
+        # slot ship (GET /v1/slot → PUT /v1/slot), and the router's
+        # lifetime outcome view for /v1/stats.  The invariant the soak
+        # pins: migrated + fell_back + gave_up == attempts — every
+        # migrate marker a backend emits resolves to exactly one
+        # outcome, or work is being thrown away silently.
+        self.migrate_timeout = migrate_timeout
+        self._migrations = {
+            "attempts": 0, "migrated": 0, "fell_back": 0, "gave_up": 0,
+            "ship_bytes": 0, "ship_seconds": 0.0,
+        }
         # Fleet prefix residency (ISSUE 14): with residency_aware on,
         # generate traffic with a token-list prompt routes to the
         # backend whose resident-digest set (from the per-tick load
@@ -366,7 +406,7 @@ class Router:
         self.prefix_fetch_min_tokens = prefix_fetch_min_tokens
         self._prefix_counts = {
             "fetched": 0, "fell_back": 0, "ineligible": 0,
-            "routed_resident": 0,
+            "routed_resident": 0, "demoted": 0, "demote_failed": 0,
         }
         # Multi-tenant QoS (ISSUE 16): with a QosPolicy loaded, the
         # router is the quota layer — per-tenant token buckets
@@ -600,6 +640,11 @@ class Router:
                 b
                 for b in self._backends.values()
                 if b.healthy and b.id not in exclude
+                # A draining backend (load flag, ISSUE 17) takes no NEW
+                # work — it is migrating its slots out — while /v1/kv
+                # and /v1/slot pulls (opener-direct, not _pick-routed)
+                # keep flowing from it until teardown.
+                and not (b.load or {}).get("draining")
             ]
             if pool is not None:
                 ready = [b for b in ready if b.pool == pool]
@@ -1207,6 +1252,27 @@ class Router:
                 continue
             if splice is not None:
                 outcome = self._pipe_spliced(handler, backend, resp, splice)
+                while outcome == "migrated":
+                    # Live slot migration (ISSUE 17): the backend
+                    # suspended this request for a migrate-out drain.
+                    # Ship its slot to a sibling and splice the
+                    # continuation there — already-decoded tokens
+                    # resume from shipped KV, not a recompute.  The
+                    # loop handles the target itself draining
+                    # mid-continuation; "fallback" drops into the
+                    # ordinary splice-recompute below, the
+                    # unconditional contract: a failed migration can
+                    # slow a request, never lose it.
+                    outcome = self._migrate_attempt(
+                        handler, splice, headers, span, deadline_abs,
+                        excluded,
+                    )
+                if outcome == "fallback":
+                    final = splice.finished()
+                    if final is not None:
+                        self._write_client(handler, splice.final_line())
+                        return
+                    continue  # recompute the remainder elsewhere
                 if outcome == "died":
                     failovers += 1
                     final = splice.finished()
@@ -1506,6 +1572,11 @@ class Router:
             # A terminal error line passed through, or our client left
             # — the request is over without a ship either way.
             return outcome
+        if outcome == "migrated":
+            self._abandon_migrate_marker(
+                splice, excluded, "during disagg prefill leg"
+            )
+            return "fallback"
         if outcome == "died":
             excluded.add(backend.id)
             self._disagg_fallback(
@@ -1622,6 +1693,205 @@ class Router:
             # mid-continuation is the ordinary splice failover's to
             # finish (recompute on a surviving backend).
             excluded.add(decode_b.id)
+            return "fallback"
+        if outcome == "migrated":
+            self._abandon_migrate_marker(
+                splice, excluded, "during disagg continuation"
+            )
+            return "fallback"
+        return outcome
+
+    # -- live slot migration (serve/disagg.py, ISSUE 17) -------------------
+
+    def _migrate_fallback(
+        self, reason: str, src: str = "", target: str = ""
+    ) -> None:
+        """One migration gave up: count it, journal it, and let the
+        caller drop into the splice-recompute continuation — the PR 6
+        contract is the *unconditional* fallback (token-identical
+        greedy), so a failed migration can slow a request, never fail
+        it."""
+        with self._lock:
+            self._migrations["fell_back"] += 1
+        metrics.SERVE_MIGRATIONS.inc("fell_back")
+        events.emit(
+            "migrate.fallback",
+            component="oim-route",
+            severity=events.WARNING,
+            reason=reason,
+            src=src,
+            target=target,
+        )
+        log.current().warning(
+            "slot migration fell back to splice recompute",
+            reason=reason, src=src, target=target,
+        )
+
+    def _abandon_migrate_marker(
+        self, splice: "_SpliceState", excluded: set, where: str
+    ) -> None:
+        """A migrate marker arrived on a leg that cannot take the
+        slot-ship path (the disaggregation legs own their own
+        fallback): release the suspended record, count the attempt as
+        fell_back, and let splice recompute finish the request."""
+        src, rid = splice.migrate_src, splice.migrate_rid
+        splice.migrate_src = splice.migrate_rid = None
+        with self._lock:
+            self._migrations["attempts"] += 1
+        if src is not None:
+            excluded.add(src.id)
+            if rid is not None:
+                release_slot(self._opener.open, src.url, rid)
+        self._migrate_fallback(
+            f"migrate marker {where}", src=src.id if src else ""
+        )
+
+    def _migrate_attempt(
+        self, handler, splice: "_SpliceState", headers: dict, span,
+        deadline_abs: float | None, excluded: set[str],
+    ) -> str:
+        """One live-migration attempt after a migrate marker
+        (``_splice_line``): ship the suspended slot off the draining
+        ``splice.migrate_src`` to a sibling (GET /v1/slot → PUT
+        /v1/slot) and splice the continuation there with
+        ``kv_import`` — already-decoded tokens resume from shipped KV
+        blocks, zero recompute.  Returns "done"/"client_gone" (request
+        over), "migrated" (the TARGET began draining too — the caller
+        loops back in), or "fallback" (the ordinary splice loop
+        recomputes the remainder).  Every failure path releases what
+        it reserved — the source's slot record, the target's staged
+        import, picked backends — so a migration that dies at any
+        step leaks nothing on either side."""
+        src = splice.migrate_src
+        rid = splice.migrate_rid
+        splice.migrate_src = None
+        splice.migrate_rid = None
+        with self._lock:
+            self._migrations["attempts"] += 1
+        if src is not None:
+            # Draining: no new work there.  The load flag catches it
+            # at the next probe tick; this request must not wait for
+            # one.
+            excluded.add(src.id)
+        if src is None or rid is None:
+            self._migrate_fallback("migrate marker carried no rid/source")
+            return "fallback"
+        # QoS time pressure (ISSUE 16 composition): a best-effort
+        # tenant with less remaining budget than one ship timeout
+        # recomputes instead of paying the ship + continuation round
+        # trips; premium/standard always try the ship (their slots
+        # were also suspended FIRST, engine-side premium-first order).
+        tenant = headers.get("x-oim-tenant") or "anon"
+        tier = (self.qos or _QOS_DEFAULT).lookup(tenant).tier
+        if (
+            tier == "best_effort"
+            and deadline_abs is not None
+            and deadline_abs - time.monotonic() < self.migrate_timeout
+        ):
+            release_slot(self._opener.open, src.url, rid)
+            self._migrate_fallback(
+                "best-effort tenant under deadline pressure", src=src.id
+            )
+            return "fallback"
+        target = self._pick(exclude=excluded)
+        if target is None:
+            # No sibling at all: nothing can take the shipped state —
+            # and the recompute loop will find nothing either.  The
+            # one outcome that is genuinely lost work.
+            release_slot(self._opener.open, src.url, rid)
+            with self._lock:
+                self._migrations["gave_up"] += 1
+            metrics.SERVE_MIGRATIONS.inc("gave_up")
+            events.emit(
+                "migrate.fallback",
+                component="oim-route",
+                severity=events.WARNING,
+                reason="no sibling backend",
+                src=src.id,
+            )
+            return "fallback"
+        t0 = time.monotonic()
+        try:
+            import_id, rows, slot_meta, nbytes = ship_slot(
+                self._opener.open, src.url, rid, target.url,
+                timeout=self.migrate_timeout,
+            )
+        except Exception as exc:
+            self._release(target, ok=False)
+            release_slot(self._opener.open, src.url, rid)
+            self._migrate_fallback(
+                f"slot ship failed ({type(exc).__name__}: {exc})",
+                src=src.id, target=target.id,
+            )
+            return "fallback"
+        dt = time.monotonic() - t0
+        # The target owns the copy now: release the source's record at
+        # ship cadence instead of leaving it to the TTL sweep.
+        release_slot(self._opener.open, src.url, rid)
+        metrics.SERVE_KV_SHIP_SECONDS.observe(dt)
+        metrics.SERVE_KV_SHIP_BYTES.inc(by=float(nbytes))
+        with self._lock:
+            self._migrations["ship_bytes"] += nbytes
+            self._migrations["ship_seconds"] += dt
+        hdrs = self._leg_headers(headers, deadline_abs)
+        if hdrs is None:
+            self._release(target, ok=True)
+            release_kv(self._opener.open, target.url, import_id=import_id)
+            self._migrate_fallback(
+                "deadline exhausted after slot ship",
+                src=src.id, target=target.id,
+            )
+            return "fallback"  # the loop answers the 504
+        span.attrs["backend"] = target.id
+        req = urllib.request.Request(
+            target.url + "/v1/generate",
+            data=splice.request_body({"kv_import": import_id}),
+            headers=hdrs,
+        )
+        try:
+            resp = self._opener.open(req, timeout=self.request_timeout)
+        except urllib.error.HTTPError as exc:
+            self._release(target, ok=False)
+            self._requests.inc(target.id, f"http_{exc.code}")
+            release_kv(self._opener.open, target.url, import_id=import_id)
+            self._migrate_fallback(
+                f"continuation refused (HTTP {exc.code})",
+                src=src.id, target=target.id,
+            )
+            return "fallback"
+        except (urllib.error.URLError, OSError) as exc:
+            self._release(target, ok=False)
+            self._connection_failed(target)
+            self._requests.inc(target.id, "connect_error")
+            excluded.add(target.id)
+            self._migrate_fallback(
+                f"continuation connect failed "
+                f"({getattr(exc, 'reason', exc)})",
+                src=src.id, target=target.id,
+            )
+            return "fallback"
+        with self._lock:
+            self._migrations["migrated"] += 1
+        metrics.SERVE_MIGRATIONS.inc("migrated")
+        span.attrs["migrated"] = True
+        events.emit(
+            "migrate.out",
+            component="oim-route",
+            src=src.id,
+            target=target.id,
+            rid=rid,
+            rows=rows,
+            bytes=nbytes,
+            ms=round(dt * 1000.0, 1),
+            tier=tier,
+            sample_base=slot_meta.get("sample_base"),
+        )
+        outcome = self._pipe_spliced(handler, target, resp, splice)
+        if outcome == "died":
+            # The ship succeeded; a target death mid-continuation is
+            # the ordinary splice failover's to finish (recompute on a
+            # surviving backend).
+            excluded.add(target.id)
             return "fallback"
         return outcome
 
@@ -1768,6 +2038,16 @@ class Router:
             self._release(backend, ok=False)
             self._connection_failed(backend)
             self._requests.inc(backend.id, "truncated")
+        elif outcome == "migrated":
+            # The backend ANSWERED (alive, draining — not a death):
+            # fold this attempt's tokens like a death so the
+            # continuation resumes after them, but health and request
+            # accounting read "served".
+            splice.prior_tokens += cur_tokens
+            splice.prior_lps += cur_lps
+            splice.migrate_src = backend
+            self._release(backend, ok=True)
+            self._requests.inc(backend.id, "migrated")
         elif outcome == "client_gone":
             self._release(backend, ok=True)
             self._requests.inc(backend.id, "client_disconnected")
@@ -1794,6 +2074,18 @@ class Router:
                 None if self._write_client(handler, line + b"\n")
                 else "client_gone"
             )
+        if obj.get("migrate") and "error" in obj:
+            # Live-migration marker (ISSUE 17): the backend suspended
+            # this request for a drain.  NOT forwarded — the client
+            # sees tokens, never the suspension; the router resumes
+            # the stream on a sibling (or via splice recompute).  A
+            # malformed marker falls through as the terminal error
+            # line it otherwise is.
+            try:
+                splice.migrate_rid = int(obj["request_id"])
+                return "migrated"
+            except (KeyError, TypeError, ValueError):
+                pass
         if obj.get("done"):
             if capture_done:
                 # Disaggregation prefill leg: the stream is NOT over —
@@ -1881,6 +2173,7 @@ class Router:
                 info = json.loads(resp.read())
         except Exception:
             return
+        demote = False
         with self._lock:
             backend.prefix_cache = bool(
                 info.get("engine", {}).get("prefix_cache_size", 0)
@@ -1892,12 +2185,94 @@ class Router:
             load = info.get("load")
             if isinstance(load, dict):
                 backend.load = load
+                # Drain flip (ISSUE 17): the first probe tick that sees
+                # the draining flag runs the prefix demote-to-peer
+                # sweep — once per draining episode, outside the lock
+                # (it ships HTTP).
+                if load.get("draining"):
+                    if not backend.drain_demoted:
+                        backend.drain_demoted = True
+                        demote = self.prefix_fetch
+                else:
+                    backend.drain_demoted = False
             backend.info_fetched = True
             # Residency-map size gauge: distinct digests across the
             # fleet's advertised summaries, refreshed with the load
             # that feeds the map itself.
             metrics.ROUTE_RESIDENCY_DIGESTS.set(
                 float(len(self._residency_digests_locked()))
+            )
+        if demote:
+            self._demote_prefixes(backend)
+
+    def _demote_prefixes(self, backend: Backend) -> None:
+        """Prefix demote-to-peer on drain (ROADMAP item 5, ISSUE 17):
+        when a backend's load flips to draining, ship its hottest
+        exportable prefix entries (PR 14 ``export_kv_prefix`` /
+        ``import_kv_prefix`` wire) to the least-loaded non-draining
+        sibling before teardown destroys the fleet's cache working
+        set.  Best-effort on the probe worker: a failed ship costs
+        nothing but the attempt (the entry dies with the backend
+        either way), counted per entry on the prefix-fetch counter so
+        the cache-health triage sees fetches and demotions in one
+        place."""
+        entries = [
+            e for e in (backend.load.get("prefix_digests") or ())
+            if isinstance(e, dict) and e.get("digest")
+            and int(e.get("blocks", 0) or 0) > 0
+        ]
+        if not entries:
+            return
+        # Hottest first: hits when the advertised summary carries
+        # them; tokens (longest prefix = most prefill saved) as the
+        # tie-breaker and the fallback sort key.
+        entries.sort(
+            key=lambda e: (
+                int(e.get("hits", 0) or 0), int(e.get("tokens", 0) or 0)
+            ),
+            reverse=True,
+        )
+        with self._lock:
+            ready = [
+                b for b in self._backends.values()
+                if b.healthy and b.id != backend.id and b.prefix_cache
+                and not (b.load or {}).get("draining")
+            ]
+            target = min(ready, key=lambda b: b.active) if ready else None
+        if target is None:
+            return
+        for entry in entries[:DRAIN_DEMOTE_ENTRIES]:
+            digest = str(entry["digest"])
+            try:
+                rows, nbytes = ship_prefix(
+                    self._opener.open, backend.url, digest, target.url,
+                    timeout=self.prefix_fetch_timeout,
+                )
+            except Exception as exc:
+                with self._lock:
+                    self._prefix_counts["demote_failed"] += 1
+                metrics.SERVE_PREFIX_FETCH.inc("demote_failed")
+                events.emit(
+                    "prefix.demote",
+                    component="oim-route",
+                    severity=events.WARNING,
+                    src=backend.id,
+                    target=target.id,
+                    digest=digest[:16],
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+                continue
+            with self._lock:
+                self._prefix_counts["demoted"] += 1
+            metrics.SERVE_PREFIX_FETCH.inc("demoted")
+            events.emit(
+                "prefix.demote",
+                component="oim-route",
+                src=backend.id,
+                target=target.id,
+                digest=digest[:16],
+                rows=rows,
+                bytes=nbytes,
             )
 
     def _residency_digests_locked(self) -> set:
@@ -2188,6 +2563,22 @@ class Router:
                     "fleet_misses": sum(
                         int(b.load.get("prefix_misses") or 0)
                         for b in self._backends.values()
+                    ),
+                },
+                # Live slot migration (ISSUE 17): marker attempts and
+                # their outcomes (migrated + fell_back + gave_up ==
+                # attempts — the soak's invariant), plus shipped bytes
+                # and wall seconds.  The drain runbook's triage query:
+                # fell_back climbing = ships failing (capacity,
+                # geometry, chaos); gave_up nonzero = drains with no
+                # sibling — work IS being lost.
+                "migrations": {
+                    **{k: self._migrations[k] for k in (
+                        "attempts", "migrated", "fell_back", "gave_up",
+                        "ship_bytes",
+                    )},
+                    "ship_seconds": round(
+                        self._migrations["ship_seconds"], 4
                     ),
                 },
                 # Multi-tenant QoS (ISSUE 16): whether the router

@@ -434,6 +434,10 @@ class ServeServer:
                     outer._stream_weights(self)
                 elif self.path.split("?", 1)[0] == "/v1/kv":
                     outer._stream_kv(self)
+                elif self.path.split("?", 1)[0] == "/v1/slot":
+                    # Live slot migration (ISSUE 17): a suspended
+                    # request's full state, served while draining.
+                    outer._stream_slot(self)
                 else:
                     self._json(404, {"error": f"no such path {self.path}"})
 
@@ -445,7 +449,8 @@ class ServeServer:
                 # error (nothing will ever admit the continuation).
                 if not check_serving_peer(self):
                     return
-                if self.path.split("?", 1)[0] != "/v1/kv":
+                path = self.path.split("?", 1)[0]
+                if path not in ("/v1/kv", "/v1/slot"):
                     self._json(404, {"error": f"no such path {self.path}"})
                     return
                 if outer.error is not None:
@@ -453,7 +458,12 @@ class ServeServer:
                         503, {"error": outer.error}, self._retry_after()
                     )
                     return
-                outer._ingest_kv(self)
+                if path == "/v1/slot":
+                    # Migration target side (ISSUE 17): stage a shipped
+                    # slot for its continuation's kv_import admission.
+                    outer._ingest_slot(self)
+                else:
+                    outer._ingest_kv(self)
 
             def do_DELETE(self):
                 # Release a KV hold (prefill side) or staged import
@@ -463,13 +473,24 @@ class ServeServer:
                 if not check_serving_peer(self):
                     return
                 path, _, query = self.path.partition("?")
-                if path != "/v1/kv":
+                if path not in ("/v1/kv", "/v1/slot"):
                     self._json(404, {"error": f"no such path {self.path}"})
                     return
                 from urllib.parse import parse_qs
 
                 params = parse_qs(query)
-                if "rid" in params:
+                if path == "/v1/slot" and "rid" in params:
+                    # Suspended-slot record release (ISSUE 17): the
+                    # router's post-ship cleanup on the draining side.
+                    # A staged slot import on the TARGET is a plain
+                    # staged KV import — released via /v1/kv?import=.
+                    ok = outer.engine.release_migrated(
+                        int(params["rid"][0])
+                    )
+                elif path == "/v1/slot":
+                    self._json(400, {"error": "need ?rid="})
+                    return
+                elif "rid" in params:
                     ok = outer.engine.release_kv_hold(
                         int(params["rid"][0])
                     )
@@ -578,6 +599,32 @@ class ServeServer:
                         self.wfile.write(
                             json.dumps(final).encode() + b"\n"
                         )
+                    except RequestFailedError as exc:
+                        # Must precede the RuntimeError clause below —
+                        # RequestFailedError subclasses RuntimeError and
+                        # the migrate marker would otherwise be swallowed
+                        # into a plain terminal error line.
+                        outer.engine.forget(rid)
+                        if exc.kind == "migrated":
+                            # Migrate-out drain (ISSUE 17): hand the rid
+                            # to the router, which ships this request's
+                            # /v1/slot record to a sibling and splices
+                            # the continuation onto this client stream.
+                            span.status = "migrated"
+                            self.wfile.write(
+                                json.dumps({
+                                    "error": str(exc),
+                                    "migrate": True,
+                                    "request_id": rid,
+                                }).encode() + b"\n"
+                            )
+                        else:
+                            span.status = "error: aborted"
+                            self.wfile.write(
+                                json.dumps(
+                                    {"error": str(exc)}
+                                ).encode() + b"\n"
+                            )
                     except (RuntimeError, TimeoutError) as exc:
                         outer.engine.forget(rid)
                         span.status = "error: aborted"
@@ -595,6 +642,21 @@ class ServeServer:
 
             def do_POST(self):
                 if not check_serving_peer(self):
+                    return
+                if self.path == "/v1/drain":
+                    # Migrate-out drain (ISSUE 17): stop admitting and
+                    # suspend in-flight work into /v1/slot records.
+                    # BEFORE the error latch — draining a wedged
+                    # backend is legal and idempotent (everything was
+                    # already failed; there is just nothing to
+                    # migrate), and the autoscaler's retire path must
+                    # never be refused here.
+                    outer.begin_drain()
+                    self._json(200, {
+                        "ok": True,
+                        "draining": True,
+                        "in_flight": outer.engine.in_flight(),
+                    })
                     return
                 if outer.error is not None:
                     # Dead driver thread OR a live stall verdict: fail
@@ -1042,6 +1104,11 @@ class ServeServer:
                             if body.get("kv_import") is not None
                             else None
                         ),
+                        # Global emission index of this leg's first
+                        # sampled token: a migrated/spliced continuation
+                        # passes len(already-emitted) so its PRNG keys
+                        # line up with an undisturbed solo run.
+                        sample_base=int(body.get("sample_base", 0)),
                         deadline=self._deadline(body),
                         # The engine parents its phase spans on the
                         # server span: one trace id from the router's
@@ -1099,6 +1166,15 @@ class ServeServer:
                     elif exc.kind == "stalled":
                         # Watchdog failed it fast; another replica can
                         # serve it — distinct from a driver-death 500.
+                        self._json(
+                            503, {"error": str(exc)}, self._retry_after()
+                        )
+                    elif exc.kind == "migrated":
+                        # Suspended by a migrate-out drain.  Stream
+                        # splicing is where live handoff happens;
+                        # non-stream callers just retry — the router's
+                        # failover resubmits on a sibling, which is
+                        # token-identical from scratch (same seed).
                         self._json(
                             503, {"error": str(exc)}, self._retry_after()
                         )
@@ -1290,6 +1366,90 @@ class ServeServer:
             handler.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
             return  # the router's ship fallback owns recovery
+
+    def begin_drain(self) -> None:
+        """Enter migrate-out drain (``POST /v1/drain``, ISSUE 17):
+        stop admitting new work and have the driver suspend every
+        in-flight request into a ``/v1/slot`` record at the next step
+        boundary.  Idempotent; any thread."""
+        self.engine.begin_migrate_out()
+
+    def _stream_slot(self, handler) -> None:
+        """Export one suspended slot (``GET /v1/slot?rid=``, ISSUE 17)
+        over the PR 12 wire framing: 8-byte BE manifest length, JSON
+        manifest (with the ``"slot"`` continuation branch), raw leaves
+        in manifest order.  Refused 503 while the error latch stands;
+        404 when the rid has no migrated record (released, TTL-swept,
+        or never suspended here), 409 when it exists but cannot ship
+        (kv4/dense) — the router falls back to splice-recompute."""
+        import struct
+        from urllib.parse import parse_qs
+
+        import numpy as np
+
+        if self.error is not None:
+            handler._json(
+                503, {"error": f"slot export unavailable: {self.error}"}
+            )
+            return
+        params = parse_qs(handler.path.partition("?")[2])
+        try:
+            rid = int(params["rid"][0])
+        except (KeyError, ValueError):
+            handler._json(400, {"error": "need ?rid=<request id>"})
+            return
+        try:
+            manifest, arrays = self.engine.export_slot(rid)
+        except disagg.KvIneligibleError as exc:
+            code = 404 if "no migrated slot" in str(exc) else 409
+            handler._json(code, {"error": str(exc)})
+            return
+        manifest_bytes = json.dumps(
+            manifest, separators=(",", ":")
+        ).encode()
+        total = sum(int(a.nbytes) for a in arrays)
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/octet-stream")
+        handler.send_header(
+            "Content-Length", str(8 + len(manifest_bytes) + total)
+        )
+        handler.end_headers()
+        try:
+            handler.wfile.write(struct.pack(">Q", len(manifest_bytes)))
+            handler.wfile.write(manifest_bytes)
+            for arr in arrays:
+                flat = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+                handler.wfile.write(flat.data)
+            handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            return  # the router's migrate fallback owns recovery
+
+    def _ingest_slot(self, handler) -> None:
+        """Stage one shipped slot (``PUT /v1/slot``, ISSUE 17): the
+        KV payload rides the ordinary staged-import path (same
+        geometry/capacity ladder as ``PUT /v1/kv`` — 409 mismatch,
+        429 + Retry-After exhaustion), and the manifest's ``"slot"``
+        branch is echoed back so the router can build the
+        continuation request: {"import_id", "rows", "slot"}."""
+        try:
+            length = int(handler.headers.get("Content-Length", "0"))
+            body = handler.rfile.read(length)
+            manifest, data = disagg.unpack_transfer(body)
+            import_id, rows, slot_meta = self.engine.import_slot(
+                manifest, data
+            )
+        except disagg.KvCapacityError as exc:
+            handler._json(429, {"error": str(exc)}, handler._retry_after())
+            return
+        except (disagg.KvGeometryError, disagg.KvIneligibleError) as exc:
+            handler._json(409, {"error": str(exc)})
+            return
+        except (KeyError, TypeError, ValueError) as exc:
+            handler._json(400, {"error": str(exc)})
+            return
+        handler._json(
+            200, {"import_id": import_id, "rows": rows, "slot": slot_meta}
+        )
 
     def _ingest_kv(self, handler) -> None:
         """Stage one shipped KV state (``PUT /v1/kv``): parse the

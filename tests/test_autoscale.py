@@ -248,6 +248,9 @@ class TestLoadSchema:
                 },
             },
             "qos_preemptions": 2,
+            # Live migration (ISSUE 17): drain state so the router's
+            # _pick can exclude backends mid-migration.
+            "draining": True,
             "ts": 123.5,
         }
         assert decode_load(encode_load(snap)) == snap

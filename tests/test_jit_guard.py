@@ -204,3 +204,64 @@ def test_negative_control_unwarmed_surface_trips_guard(setup):
     with compile_delta() as d:
         engine.embed(_prompt(5, 6, cfg.vocab_size))
     assert d.count >= 1, "unwarmed embed surface compiled nothing"
+
+
+def test_warm_migrate_export_import_resume_compiles_zero(setup):
+    """Live migration on the serving hot path (ISSUE 17): suspending
+    a warm source, exporting its slot, staging it on a warm target,
+    and resuming the continuation through ``kv_import`` must all ride
+    warmup-precompiled programs — the export gathers through the
+    prefix ship path, the import writes through the precompiled
+    ingest, and the continuation's tail prefill lands in an existing
+    bucket.  A compile here would stall BOTH backends of a drain
+    mid-migration, exactly when the fleet is short one replica."""
+    from oim_tpu.serve import disagg
+    from oim_tpu.serve.engine import RequestFailedError
+
+    cfg, _params = setup
+    src = _make_engine(setup, paged=True, depth=2)
+    dst = _make_engine(setup, paged=True, depth=2)
+    src.warmup()
+    dst.warmup()
+
+    def cycle(seed: int) -> None:
+        got: list = []
+        rid = src.submit(
+            GenRequest(tokens=_prompt(seed, 12, cfg.vocab_size),
+                       max_new_tokens=10),
+            on_token=lambda t, lp: got.append(t) if t is not None
+            else None,
+        )
+        for _ in range(40):
+            src.step()
+            if got:
+                break
+        src.begin_migrate_out()
+        src.run()
+        with pytest.raises(RequestFailedError):
+            src.result(rid, timeout=5)
+        manifest, arrays = src.export_slot(rid)
+        body = disagg.pack_transfer(manifest, arrays)
+        import_id, _rows, slot = dst.import_slot(
+            *disagg.unpack_transfer(body)
+        )
+        crid = dst.submit(GenRequest(
+            tokens=list(manifest["prompt_tokens"])
+            + list(manifest["tokens"]),
+            max_new_tokens=10 - len(manifest["tokens"]),
+            kv_import=import_id,
+            sample_base=slot["sample_base"],
+        ))
+        dst.run()
+        assert dst.result(crid, timeout=5)
+        src.release_migrated(rid)
+        src._draining = False
+        src._migrate_out = False
+
+    cycle(31)  # shake out any first-use program
+    with compile_delta() as d:
+        cycle(32)
+    assert d.count == 0, (
+        f"warm migrate cycle recompiled {d.count}x — export, import, "
+        f"or the kv_import continuation missed the warmup recipe"
+    )

@@ -1,0 +1,995 @@
+"""Live slot migration (ISSUE 17): zero-loss serving across drains,
+scale-in, and eviction.
+
+The load-bearing properties:
+
+- **Token-identical resumption.**  A draining backend suspends each
+  in-flight request into a ``/v1/slot`` record (KV blocks + full
+  request state); the router ships it to a sibling and splices the
+  continuation there — and the client's stream equals an undisturbed
+  solo run exactly, across {greedy, sampled, spec} x {fp, kv8} x
+  pipeline depth {1, 2}, including a slot suspended while PARKED in
+  the host tier.  Sampled exactness is positional: every sampled
+  token's PRNG key is ``fold_in(PRNGKey(seed), global_index)``, and
+  the shipped ``sample_base`` keeps the indices aligned.
+- **Zero recompute of decoded tokens.**  The sibling resumes decode
+  from the shipped KV frontier (``slot_exports``/``slot_imports``
+  move; the continuation admits through ``kv_import``), not by
+  re-prefilling what the victim already computed.
+- **Every failure falls back exactly.**  A ship killed mid-body
+  (chaos), a missing record, no sibling at all — every path lands in
+  the router's splice-recompute continuation: same tokens, prefill
+  paid again, ZERO leaked blocks/records/imports on either side, and
+  ``migrated + fell_back + gave_up == attempts`` always.
+- **The autoscaler drives it.**  Scale-in retire and eviction
+  replacement POST ``/v1/drain`` and wait for in-flight to hit zero
+  before teardown — in-flight requests survive the victim's death.
+
+This file backs ``make test-serve-migrate`` (120 s cap).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import numpy as np
+import pytest
+
+from helpers import wait_for
+from test_autoscale import FakeActuator, FakeLauncher
+
+from oim_tpu.autoscale import Autoscaler, AutoscalePolicy, encode_load
+from oim_tpu.autoscale.autoscaler import ReplicaRecord
+from oim_tpu.autoscale.load import decode_load
+from oim_tpu.common import metrics
+from oim_tpu.common.chaos import FlakyHTTPBackend
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.registry import MemRegistryDB
+from oim_tpu.serve import Engine, GenRequest, Router
+from oim_tpu.serve import disagg
+from oim_tpu.serve.engine import DrainingError, RequestFailedError
+from oim_tpu.serve.router import _SpliceState
+from oim_tpu.serve.server import ServeServer
+
+pytestmark = pytest.mark.serve_migrate
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+BASE = dict(
+    n_slots=2, max_len=64, chunk=4, prompt_buckets=(16, 32), kv_block=8
+)
+
+# Engines shared per config across the matrix (the test-serve
+# compile-budget discipline): one (source, target) pair per
+# {quant} x {plain, spec} combination — pipeline depth is a runtime
+# A/B on the same engines.
+_ENGINES: dict = {}
+
+
+def _pair(setup, **kw) -> tuple[Engine, Engine]:
+    cfg, params = setup
+    key = tuple(sorted(kw.items()))
+    if key not in _ENGINES:
+        args = dict(BASE)
+        args.update(kw)
+        _ENGINES[key] = (
+            Engine(params, cfg, **args), Engine(params, cfg, **args)
+        )
+    return _ENGINES[key]
+
+
+def _prompt(seed: int, n: int) -> list[int]:
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG["vocab_size"], size=n).tolist()
+
+
+def _gen(e: Engine, tokens, mn, **kw) -> list[int]:
+    rid = e.submit(GenRequest(tokens=tokens, max_new_tokens=mn, **kw))
+    e.run()
+    return e.result(rid, timeout=0)
+
+
+def _suspend_midstream(e: Engine, req: GenRequest) -> tuple[int, list[int]]:
+    """Submit, decode a little, then migrate-out drain: returns the
+    rid and the tokens emitted BEFORE suspension (the client-visible
+    prefix a continuation must extend)."""
+    got: list[int] = []
+    rid = e.submit(
+        req,
+        on_token=lambda t, lp: got.append(t) if t is not None else None,
+    )
+    for _ in range(40):
+        e.step()
+        if got:
+            break
+    e.begin_migrate_out()
+    e.run()
+    with pytest.raises(RequestFailedError) as err:
+        e.result(rid, timeout=5)
+    assert err.value.kind == "migrated", err.value
+    return rid, got
+
+
+def _undrain(e: Engine) -> None:
+    e._draining = False
+    e._migrate_out = False
+
+
+def _roundtrip(src: Engine, dst: Engine, rid: int):
+    """Ship one suspended slot src → dst through the real wire codec;
+    returns (import_id, manifest)."""
+    manifest, arrays = src.export_slot(rid)
+    body = disagg.pack_transfer(manifest, arrays)
+    import_id, rows, slot = dst.import_slot(*disagg.unpack_transfer(body))
+    assert rows == manifest["rows"]
+    assert slot == manifest["slot"]
+    return import_id, manifest
+
+
+# ---------------------------------------------------------------------------
+# Engine-level export/import: THE exactness matrix
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+@pytest.mark.parametrize("quant", ["fp", "kv8"])
+@pytest.mark.parametrize("mode", ["greedy", "sampled", "spec"])
+def test_migrate_roundtrip_matrix(setup, mode, quant, depth):
+    """The acceptance matrix: suspend mid-stream on A, ship the slot,
+    resume on B — prefix + continuation equals the undisturbed solo
+    run, across {greedy, sampled, spec} x {fp, kv8} x depth {1, 2},
+    with zero recompute of decoded rows (the continuation admits
+    through ``kv_import`` at the shipped frontier) and zero leaked
+    blocks on either side."""
+    kw = {}
+    if quant == "kv8":
+        kw["kv_int8"] = True
+    if mode == "spec":
+        kw["spec_decode"] = 2
+    a, b = _pair(setup, **kw)
+    _undrain(a)
+    for e in (a, b):
+        e.set_pipeline_depth(depth)
+    gkw = dict(seed=5)
+    if mode == "sampled":
+        gkw["temperature"] = 0.9
+    mn = 24
+    prompt = _prompt(ord(mode[0]) + depth, 16)
+    oracle = _gen(b, prompt, mn, **gkw)
+
+    rid, prior = _suspend_midstream(
+        a, GenRequest(tokens=prompt, max_new_tokens=mn, **gkw)
+    )
+    assert 0 < len(prior) < mn, "suspension must land mid-stream"
+    import_id, manifest = _roundtrip(a, b, rid)
+    assert manifest["tokens"] == prior
+    assert manifest["rows"] == len(prompt) + len(prior) - 1
+    # The positional sampling offset: exactly the emitted count (a
+    # first-hop migration started from base 0).
+    assert manifest["slot"]["sample_base"] == len(prior)
+    crid = b.submit(GenRequest(
+        tokens=prompt + prior,
+        max_new_tokens=mn - len(prior),
+        kv_import=import_id,
+        sample_base=manifest["slot"]["sample_base"],
+        **gkw,
+    ))
+    b.run()
+    cont = b.result(crid, timeout=5)
+    assert prior + cont == oracle, (
+        f"{mode}/{quant}/d{depth}: continuation diverged"
+    )
+    assert a.release_migrated(rid)
+    assert not a.release_migrated(rid)  # idempotent
+    assert a.stats()["kv_blocks_used"] == 0
+    assert b.stats()["kv_blocks_used"] == 0
+    assert a.slot_exports >= 1 and b.slot_imports >= 1
+
+
+def test_parked_slot_migrates_from_host_tier(setup):
+    """A slot suspended while PARKED ships its host-tier payload
+    directly (ownership transfer, no device traffic) and resumes
+    token-identical — and the concurrently-active slot migrates off
+    the device in the same wave."""
+    cfg, params = setup
+    a = Engine(
+        params, cfg, n_slots=4, max_len=64, chunk=4,
+        prompt_buckets=(16, 32), kv_block=8, kv_blocks=8,
+        prefix_cache_size=0, kv_host_bytes=1 << 20,
+    )
+    _, b = _pair(setup)
+    _undrain(b)
+    b.set_pipeline_depth(2)
+    pA, pB = _prompt(20, 16), _prompt(21, 16)
+    oracles = {
+        tuple(pA): _gen(b, pA, 30, seed=7),
+        tuple(pB): _gen(b, pB, 30, seed=9),
+    }
+    # 6-block worst cases cannot coexist in the 8-block pool: the
+    # second admission parks the first into the host tier.
+    ra = a.submit(GenRequest(tokens=pA, max_new_tokens=30, seed=7))
+    rb = a.submit(GenRequest(tokens=pB, max_new_tokens=30, seed=9))
+    for _ in range(16):
+        a.step()
+        if a.stats()["parked_slots"]:
+            break
+    assert a.stats()["parked_slots"] == 1, "pressure geometry off"
+    a.begin_migrate_out()
+    a.run()
+    recs = {}
+    for rid in (ra, rb):
+        with pytest.raises(RequestFailedError) as err:
+            a.result(rid, timeout=5)
+        assert err.value.kind == "migrated"
+        recs[rid] = a._migrated[rid]
+    # Exactly one record rode the host tier (the parked slot).
+    assert sorted(bool(r.host_blocks) for r in recs.values()) == [
+        False, True,
+    ]
+    for rid in (ra, rb):
+        import_id, manifest = _roundtrip(a, b, rid)
+        prompt = list(manifest["prompt_tokens"])
+        prior = list(manifest["tokens"])
+        seed = manifest["sampling"]["seed"]
+        crid = b.submit(GenRequest(
+            tokens=prompt + prior, max_new_tokens=30 - len(prior),
+            kv_import=import_id,
+            sample_base=manifest["slot"]["sample_base"], seed=seed,
+        ))
+        b.run()
+        cont = b.result(crid, timeout=5)
+        assert prior + cont == oracles[tuple(prompt)]
+        a.release_migrated(rid)
+    s = a.stats()
+    assert s["kv_blocks_used"] == 0
+    assert s["kv_host_blocks_used"] == 0
+    assert b.stats()["kv_blocks_used"] == 0
+
+
+def test_queued_dense_and_sweep_lifecycle(setup, monkeypatch):
+    """The non-capture paths: a QUEUED request fails "migrated" with
+    no record (the router resubmits from scratch); a dense engine
+    suspends without capture and refuses export; an abandoned record
+    TTL-sweeps its blocks home; submit during drain refuses."""
+    cfg, params = setup
+    a, _ = _pair(setup)
+    _undrain(a)
+    a.set_pipeline_depth(2)
+    # Three submissions against two slots: one stays queued.
+    rids = [
+        a.submit(GenRequest(tokens=_prompt(30 + i, 16),
+                            max_new_tokens=20))
+        for i in range(3)
+    ]
+    a.step()
+    a.begin_migrate_out()
+    with pytest.raises(DrainingError):
+        a.submit(GenRequest(tokens=_prompt(40, 16), max_new_tokens=2))
+    a.run()
+    kinds = {}
+    for rid in rids:
+        with pytest.raises(RequestFailedError) as err:
+            a.result(rid, timeout=5)
+        kinds[rid] = err.value.kind
+    assert set(kinds.values()) == {"migrated"}
+    # The queued one left no record — its export 404-shapes.
+    recorded = set(a._migrated)
+    queued = [r for r in rids if r not in recorded]
+    assert queued, "expected at least one queued suspension"
+    with pytest.raises(disagg.KvIneligibleError, match="no migrated"):
+        a.export_slot(queued[0])
+    # TTL sweep: abandoned records decref their blocks without any
+    # release call (the orchestrator died mid-ship).
+    assert a.stats()["migrated_slots"] > 0
+    assert a.stats()["kv_blocks_used"] > 0
+    monkeypatch.setattr("oim_tpu.serve.engine.MIGRATE_TTL_S", 0.0)
+    with a._lock:
+        a._sweep_migrated_locked(time.monotonic())
+    s = a.stats()
+    assert s["migrated_slots"] == 0 and s["kv_blocks_used"] == 0
+    _undrain(a)
+    # Dense engines suspend (the stream marker still fires) but never
+    # capture — export refuses, the fallback recomputes.
+    dense = Engine(params, cfg, n_slots=2, max_len=64, chunk=4,
+                   prompt_buckets=(16, 32))
+    rid = dense.submit(GenRequest(tokens=_prompt(41, 16),
+                                  max_new_tokens=20))
+    for _ in range(3):
+        dense.step()
+    dense.begin_migrate_out()
+    dense.run()
+    with pytest.raises(RequestFailedError) as err:
+        dense.result(rid, timeout=5)
+    assert err.value.kind == "migrated"
+    with pytest.raises(disagg.KvIneligibleError, match="paged"):
+        dense.export_slot(rid)
+    assert not dense.release_migrated(rid)
+
+
+def test_slot_manifest_validation(setup):
+    """The slot wire branch refuses torn/forged manifests at the
+    boundary: no slot branch, slot+prefix co-occurrence, and a
+    ``sample_base`` below the emitted count (which would silently
+    break sampled exactness) all 409-shape before staging."""
+    a, b = _pair(setup)
+    _undrain(a)
+    rid, prior = _suspend_midstream(
+        a, GenRequest(tokens=_prompt(50, 16), max_new_tokens=20)
+    )
+    manifest, arrays = a.export_slot(rid)
+    data = dict(zip([l["name"] for l in manifest["leaves"]], arrays))
+    plain = {k: v for k, v in manifest.items() if k != "slot"}
+    with pytest.raises(disagg.KvGeometryError, match="no slot branch"):
+        b.import_slot(plain, data)
+    both = dict(manifest, prefix=disagg.prefix_digest(
+        manifest["prompt_tokens"]
+    ))
+    with pytest.raises(disagg.KvGeometryError, match="prefix"):
+        disagg.validate_geometry(both, b.kv_geometry())
+    low = dict(manifest, slot=dict(manifest["slot"],
+                                   sample_base=len(prior) - 1))
+    with pytest.raises(disagg.KvGeometryError, match="sample_base"):
+        disagg.validate_geometry(low, b.kv_geometry())
+    torn = dict(manifest, slot=dict(manifest["slot"], sample_base="x"))
+    with pytest.raises(disagg.KvGeometryError, match="sample_base"):
+        disagg.validate_geometry(torn, b.kv_geometry())
+    a.release_migrated(rid)
+    assert a.stats()["kv_blocks_used"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The HTTP wire: /v1/drain, GET/PUT/DELETE /v1/slot
+
+
+@pytest.fixture(scope="module")
+def fleet(setup):
+    """Two live paged oim-serve instances on one tiny model — the
+    migration fleet for every routed scenario (drained state is reset
+    between tests via ``_reset_fleet``)."""
+    cfg, params = setup
+    servers = [
+        ServeServer(
+            Engine(params, cfg, prefix_cache_size=2, **BASE)
+        ).start()
+        for _ in range(2)
+    ]
+    yield servers
+    for server in servers:
+        server.stop()
+
+
+def _url(server: ServeServer) -> str:
+    return f"http://{server.host}:{server.port}"
+
+
+def _post(base: str, path: str, payload: dict, timeout=120):
+    req = urllib.request.Request(
+        base + path,
+        json.dumps(payload).encode(),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _stream_lines(base: str, payload: dict, timeout=120) -> list[dict]:
+    """POST a streaming generate; returns every NDJSON line parsed
+    (terminal error/migrate lines included — callers assert)."""
+    req = urllib.request.Request(
+        base + "/v1/generate",
+        json.dumps(dict(payload, stream=True)).encode(),
+        {"Content-Type": "application/json"},
+    )
+    lines = []
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        for raw in resp:
+            raw = raw.strip()
+            if raw:
+                lines.append(json.loads(raw))
+    return lines
+
+
+def _reset_fleet(router: Router | None, servers) -> None:
+    """Clear drain state on every engine and refresh the router's
+    load view so the next cycle starts from a clean fleet."""
+    for s in servers:
+        _undrain(s.engine)
+    if router is not None:
+        for b in list(router._backends.values()):
+            router._probe(b)
+
+
+def _zero_leaks(servers) -> None:
+    for s in servers:
+        def settled(srv=s):
+            st = srv.engine.stats()
+            return (
+                st["active_slots"] == 0 and st["queued"] == 0
+                and st["migrated_slots"] == 0
+                and st["kv_blocks_used"] == 0
+                and st["kv_imports_staged"] == 0 and st["kv_holds"] == 0
+            )
+        assert wait_for(settled), s.engine.stats()
+
+
+def test_drain_endpoint_and_slot_wire(setup, fleet):
+    """The wire end-to-end WITHOUT a router: POST /v1/drain suspends a
+    live stream (idempotent, replies in_flight), the direct client
+    sees the migrate marker, GET /v1/slot exports the record, PUT
+    /v1/slot stages it on the sibling (echoing the slot branch), the
+    continuation resumes token-identical, and DELETE /v1/slot is
+    idempotent."""
+    src, dst = fleet
+    # prompt + emitted must stay inside the 32-token prompt bucket:
+    # the continuation (and the splice fallback) resubmits
+    # prompt+prior as its prompt.
+    prompt = _prompt(60, 8)
+    mn = 24
+    _, oracle = _post(_url(dst), "/v1/generate",
+                      {"tokens": prompt, "max_new_tokens": mn})
+    for attempt in range(5):  # the drain can lose the race to "done"
+        _reset_fleet(None, fleet)
+        lines: list = []
+        t = threading.Thread(
+            target=lambda: lines.extend(_stream_lines(
+                _url(src), {"tokens": prompt, "max_new_tokens": mn}
+            )),
+            daemon=True,
+        )
+        t.start()
+        assert wait_for(
+            lambda: src.engine.stats()["active_slots"] > 0,
+            interval=0.002,
+        )
+        status, reply = _post(_url(src), "/v1/drain", {})
+        assert status == 200 and reply["draining"] is True
+        status, again = _post(_url(src), "/v1/drain", {})  # idempotent
+        assert status == 200 and again["draining"] is True
+        t.join(timeout=30)
+        assert not t.is_alive()
+        if lines and lines[-1].get("migrate") is True:
+            break
+    assert lines and lines[-1].get("migrate") is True, lines[-1:]
+    rid = int(lines[-1]["request_id"])
+    prior = [ln["token"] for ln in lines if "token" in ln]
+    assert 0 < len(prior) < mn
+
+    # GET /v1/slot: 400 without rid, 404 on an unknown one.
+    for path, code in (("/v1/slot", 400), ("/v1/slot?rid=999999", 404)):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(_url(src) + path, timeout=10)
+        assert err.value.code == code
+    with urllib.request.urlopen(
+        _url(src) + f"/v1/slot?rid={rid}", timeout=30
+    ) as resp:
+        body = resp.read()
+        assert len(body) == int(resp.headers["Content-Length"])
+    manifest, _data = disagg.unpack_transfer(body)
+    assert manifest["tokens"] == prior
+    put = urllib.request.Request(
+        _url(dst) + "/v1/slot", body,
+        {"Content-Type": "application/octet-stream"}, method="PUT",
+    )
+    with urllib.request.urlopen(put, timeout=30) as resp:
+        staged = json.loads(resp.read())
+    assert staged["rows"] == manifest["rows"]
+    assert staged["slot"]["sample_base"] == len(prior)
+    _, done = _post(_url(dst), "/v1/generate", {
+        "tokens": prompt + prior,
+        "max_new_tokens": mn - len(prior),
+        "kv_import": staged["import_id"],
+        "sample_base": staged["slot"]["sample_base"],
+    })
+    assert prior + done["tokens"] == oracle["tokens"]
+    # DELETE /v1/slot: releases once, idempotent after.
+    for want in (True, False):
+        req = urllib.request.Request(
+            _url(src) + f"/v1/slot?rid={rid}", method="DELETE"
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read())["ok"] is want
+    _reset_fleet(None, fleet)
+    _zero_leaks(fleet)
+
+
+def test_drain_fails_nonstream_retryable(setup, fleet):
+    """A NON-stream request caught by a drain answers 503 +
+    Retry-After (the router's failover resubmits it from scratch on a
+    sibling — same seed, token-identical)."""
+    src = fleet[0]
+    for attempt in range(5):  # the drain can lose the race to "done"
+        _reset_fleet(None, fleet)
+        result: list = []
+
+        def call():
+            try:
+                result.append(_post(
+                    _url(src), "/v1/generate",
+                    {"tokens": _prompt(61, 8), "max_new_tokens": 24},
+                ))
+            except urllib.error.HTTPError as exc:
+                result.append(exc)
+        t = threading.Thread(target=call, daemon=True)
+        t.start()
+        assert wait_for(
+            lambda: src.engine.stats()["active_slots"] > 0,
+            interval=0.002,
+        )
+        _post(_url(src), "/v1/drain", {})
+        t.join(timeout=30)
+        assert not t.is_alive()
+        if isinstance(result[0], urllib.error.HTTPError):
+            break
+    assert isinstance(result[0], urllib.error.HTTPError), result
+    assert result[0].code == 503
+    assert result[0].headers.get("Retry-After")
+    # No router saw this drain, so nothing ships or releases the
+    # suspended record — drop it the way DELETE /v1/slot would.
+    for rid in list(src.engine._migrated):
+        src.engine.release_migrated(rid)
+    _reset_fleet(None, fleet)
+    _zero_leaks(fleet)
+
+
+# ---------------------------------------------------------------------------
+# Routed end-to-end: drain mid-stream → ship → resume on the sibling
+
+
+def _router(*urls, **kw) -> Router:
+    kw.setdefault("health_interval", 60.0)  # tests probe explicitly
+    kw.setdefault("unhealthy_after", 10_000)
+    router = Router(backends=urls, **kw).start()
+    for b in list(router._backends.values()):
+        router._probe(b)
+    return router
+
+
+def _steer(router: Router, server: ServeServer, draining: bool) -> None:
+    """Flip one engine's drain flag and refresh every router probe —
+    the deterministic way to steer the next admission: ``_pick``
+    skips draining backends, so pre-draining the non-victim forces
+    the stream onto the victim regardless of round-robin parity."""
+    server.engine._draining = draining
+    for b in list(router._backends.values()):
+        router._probe(b)
+
+
+def _drain_cycle(
+    router: Router, servers, payload: dict, victim: ServeServer,
+    kill_flaky: FlakyHTTPBackend | None = None,
+) -> list[dict]:
+    """One migration cycle: steer ``payload`` onto ``victim``, drain
+    it as soon as its slot is active (arming a mid-ship kill first
+    when ``kill_flaky`` is given), and return the stream lines."""
+    other = next(s for s in servers if s is not victim)
+    _steer(router, other, True)
+    base = f"http://{router.host}:{router.port}"
+    lines: list = []
+    t = threading.Thread(
+        target=lambda: lines.extend(_stream_lines(base, payload)),
+        daemon=True,
+    )
+    t.start()
+    assert wait_for(
+        lambda: victim.engine.stats()["active_slots"] > 0
+        or not t.is_alive(),
+        interval=0.002,
+    )
+    # The sibling must be back before the migrate marker needs it.
+    _steer(router, other, False)
+    if kill_flaky is not None:
+        kill_flaky.fail_next_get(1, "/v1/slot")
+    _post(_url(victim), "/v1/drain", {})
+    t.join(timeout=60)
+    assert not t.is_alive(), "stream never terminated"
+    return lines
+
+
+def _assert_stream(lines: list[dict], oracle: list[int], tag="") -> None:
+    assert lines, f"{tag}: empty stream"
+    final = lines[-1]
+    assert final.get("done"), f"{tag}: no terminal line: {final}"
+    assert final["tokens"] == oracle, f"{tag}: diverged"
+    streamed = [ln["token"] for ln in lines[:-1] if "token" in ln]
+    assert streamed == oracle, f"{tag}: streamed prefix diverged"
+
+
+@pytest.mark.parametrize("sampled", [False, True], ids=["greedy", "temp"])
+def test_routed_drain_midstream_token_identical(setup, fleet, sampled):
+    """THE routed acceptance: a backend drained mid-stream hands its
+    request to the sibling through a real slot ship, the client's
+    stream completes token-identical to an undisturbed solo run, and
+    the decoded prefix was NOT recomputed (the target imported the
+    slot; the source exported exactly once)."""
+    router = _router(*[_url(s) for s in fleet])
+    payload = {"tokens": _prompt(70 + sampled, 8), "max_new_tokens": 24}
+    if sampled:
+        payload.update(temperature=0.9, seed=11)
+    _, oracle = _post(_url(fleet[1]), "/v1/generate", payload)
+    migrated_before = metrics.SERVE_MIGRATIONS.value("migrated")
+    victim, sibling = fleet[0], fleet[1]
+    try:
+        for attempt in range(4):
+            _reset_fleet(router, fleet)
+            exports = victim.engine.slot_exports
+            imports = sibling.engine.slot_imports
+            lines = _drain_cycle(router, fleet, payload, victim)
+            _assert_stream(lines, oracle["tokens"], f"attempt {attempt}")
+            stats = router.stats()["migrations"]
+            if stats["migrated"] >= 1:
+                break
+        assert stats["migrated"] >= 1, (
+            f"no cycle migrated mid-stream: {stats}"
+        )
+        assert stats["fell_back"] == 0 and stats["gave_up"] == 0
+        assert stats["ship_bytes"] > 0
+        # Zero recompute: the ship moved the KV, both sides counted.
+        assert victim.engine.slot_exports == exports + 1
+        assert sibling.engine.slot_imports == imports + 1
+        assert (
+            metrics.SERVE_MIGRATIONS.value("migrated")
+            > migrated_before
+        )
+    finally:
+        router.stop()
+        _reset_fleet(None, fleet)
+    _zero_leaks(fleet)
+
+
+def test_chaos_kill_mid_ship_falls_back_exactly(setup, fleet):
+    """Chaos kill mid-ship: the GET /v1/slot export is severed at half
+    its declared bytes — the router detects the short read, falls back
+    to splice-recompute on the sibling (token-identical greedy), and
+    both sides end with zero leaked blocks, records, or staged
+    imports."""
+    flaky = FlakyHTTPBackend(_url(fleet[0]), seed=17).start()
+    router = _router(flaky.url, _url(fleet[1]))
+    payload = {"tokens": _prompt(80, 8), "max_new_tokens": 24}
+    _, oracle = _post(_url(fleet[1]), "/v1/generate", payload)
+    fell_back_before = metrics.SERVE_MIGRATIONS.value("fell_back")
+    try:
+        for attempt in range(4):
+            _reset_fleet(router, fleet)
+            with flaky._lock:
+                flaky._forced_get = 0  # disarm a missed cycle's kill
+            lines = _drain_cycle(
+                router, fleet, payload, fleet[0], kill_flaky=flaky
+            )
+            _assert_stream(lines, oracle["tokens"], f"attempt {attempt}")
+            stats = router.stats()["migrations"]
+            if stats["fell_back"] >= 1:
+                break
+        assert stats["fell_back"] >= 1, (
+            f"kill never landed on the ship: {stats}"
+        )
+        assert stats["migrated"] == 0, stats
+        assert stats["gave_up"] == 0
+        assert (
+            metrics.SERVE_MIGRATIONS.value("fell_back")
+            > fell_back_before
+        )
+    finally:
+        router.stop()
+        flaky.stop()
+        _reset_fleet(None, fleet)
+    _zero_leaks(fleet)
+
+
+def test_migration_soak_chaos_invariants(setup, fleet):
+    """The ISSUE 17 soak: 24 cycles alternating clean migrate and
+    chaos kill-mid-ship, every cycle token-identical with zero leaks
+    on both sides, and the outcome counters summing EXACTLY to the
+    attempts (``migrated + fell_back + gave_up == attempts``)."""
+    flaky = FlakyHTTPBackend(_url(fleet[0]), seed=23).start()
+    router = _router(flaky.url, _url(fleet[1]))
+    prompts = [_prompt(90 + i, 8) for i in range(3)]
+    oracles = {}
+    for p in prompts:
+        _, done = _post(_url(fleet[1]), "/v1/generate",
+                        {"tokens": p, "max_new_tokens": 24})
+        oracles[tuple(p)] = done["tokens"]
+    try:
+        for i in range(24):
+            _reset_fleet(router, fleet)
+            with flaky._lock:
+                flaky._forced_get = 0
+            p = prompts[i % 3]
+            payload = {"tokens": p, "max_new_tokens": 24}
+            # Deterministic schedule: the victim alternates; every
+            # other flaky-side cycle is killed mid-ship (i % 4 == 2,
+            # always the flaky-fronted backend).
+            kill = i % 4 == 2
+            lines = _drain_cycle(
+                router, fleet, payload, fleet[0 if kill else i % 2],
+                kill_flaky=flaky if kill else None,
+            )
+            _assert_stream(lines, oracles[tuple(p)], f"cycle {i}")
+            _zero_leaks(fleet)
+        s = router.stats()["migrations"]
+        assert s["attempts"] == (
+            s["migrated"] + s["fell_back"] + s["gave_up"]
+        ), s
+        assert s["migrated"] >= 2, s
+        assert s["fell_back"] >= 1, s
+        assert s["gave_up"] == 0, s
+    finally:
+        router.stop()
+        flaky.stop()
+        _reset_fleet(None, fleet)
+
+
+def test_migrate_marker_bookkeeping_units(setup, fleet):
+    """The counter edges the soak cannot pin one-by-one: a marker
+    with no source falls back; a marker whose only sibling is
+    excluded is the one genuinely-lost outcome (gave_up)."""
+    router = _router(*[_url(s) for s in fleet])
+    try:
+        splice = _SpliceState({"tokens": [1], "max_new_tokens": 2}, b"{}")
+        out = router._migrate_attempt(None, splice, {}, None, None, set())
+        assert out == "fallback"
+        s = router.stats()["migrations"]
+        assert s["attempts"] == 1 and s["fell_back"] == 1
+        splice = _SpliceState({"tokens": [1], "max_new_tokens": 2}, b"{}")
+        backends = list(router._backends.values())
+        splice.migrate_src = backends[0]
+        splice.migrate_rid = 424242
+        out = router._migrate_attempt(
+            None, splice, {}, None, None, {b.id for b in backends}
+        )
+        assert out == "fallback"
+        s = router.stats()["migrations"]
+        assert s["attempts"] == 2 and s["gave_up"] == 1
+        assert s["attempts"] == (
+            s["migrated"] + s["fell_back"] + s["gave_up"]
+        )
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# Draining visibility: load schema, routing, oimctl, prefix demote
+
+
+def test_draining_load_schema_and_pick_exclusion(setup, fleet):
+    """The drain flag survives the registry codec (tolerant decode:
+    absent from old publishers), and a draining backend stops
+    receiving NEW work while staying reachable for pulls."""
+    assert decode_load(encode_load({"draining": True}))["draining"] is True
+    assert decode_load(encode_load({"queue_depth": 1}))["draining"] is False
+    assert fleet[0].engine.load()["draining"] is False
+    router = _router(*[_url(s) for s in fleet])
+    try:
+        fleet[0].engine._draining = True
+        _reset_fleet(router, [fleet[1]])  # refresh probes, keep 0 drained
+        for b in list(router._backends.values()):
+            router._probe(b)
+        ids = {router._pick().id for _ in range(8)}
+        assert ids == {_url(fleet[1])}, ids
+        # The drained backend still answers its pull surfaces.
+        with urllib.request.urlopen(
+            _url(fleet[0]) + "/v1/info", timeout=10
+        ) as resp:
+            assert json.loads(resp.read())["load"]["draining"] is True
+    finally:
+        router.stop()
+        _reset_fleet(None, fleet)
+
+
+def test_oimctl_top_renders_drain_marker(capsys):
+    from oim_tpu.cli.oimctl import _print_top
+
+    _print_top([
+        ("b-drain", True, {"draining": True, "total_slots": 2}),
+        ("b-live", True, {"total_slots": 2}),
+        ("b-dead", False, {}),
+    ])
+    out = capsys.readouterr().out
+    rows = {ln.split()[0]: ln for ln in out.splitlines() if ln}
+    assert "DRAIN" in rows["b-drain"]
+    assert "yes" in rows["b-live"]
+    assert "NO" in rows["b-dead"]
+
+
+def test_prefix_demote_to_peer_on_drain(setup, fleet):
+    """ROADMAP item 5: the probe tick that first sees a backend
+    draining ships its hottest resident prefix entries to the
+    least-loaded sibling (best-effort, counted), exactly once per
+    draining episode."""
+    src, dst = fleet
+    sys_prompt = _prompt(95, 16)
+    _post(_url(src), "/v1/generate", {
+        "tokens": sys_prompt, "max_new_tokens": 2, "cache_prefix": True,
+    })
+    assert wait_for(
+        lambda: src.engine.stats()["prefix_entries"] >= 1
+    )
+    router = _router(*[_url(s) for s in fleet])
+    try:
+        installs = dst.engine.stats()["prefix_fetch_installs"]
+        demoted = router.stats()["prefix"]["demoted"]
+        src.engine._draining = True
+        src_backend = router._backends[_url(src)]
+        router._probe(src_backend)
+        assert router.stats()["prefix"]["demoted"] > demoted
+        assert wait_for(
+            lambda: dst.engine.stats()["prefix_fetch_installs"] > installs
+        )
+        # Once per episode: a second probe with the flag still up must
+        # not re-ship.
+        after = router.stats()["prefix"]["demoted"]
+        router._probe(src_backend)
+        assert router.stats()["prefix"]["demoted"] == after
+        # Flag clears → latch resets → a new episode demotes again.
+        src.engine._draining = False
+        router._probe(src_backend)
+        assert src_backend.drain_demoted is False
+    finally:
+        router.stop()
+        _reset_fleet(None, fleet)
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler: scale-in/eviction drive the drain
+
+
+class _DrainStub:
+    """A fake serve daemon answering only POST /v1/drain with a
+    scripted in-flight countdown."""
+
+    def __init__(self, replies: list[int]):
+        self.replies = list(replies)
+        self.calls = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                outer.calls += 1
+                n = outer.replies[min(outer.calls - 1,
+                                      len(outer.replies) - 1)]
+                body = json.dumps({
+                    "ok": True, "draining": True, "in_flight": n,
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def _mini_autoscaler(**kw) -> Autoscaler:
+    db = MemRegistryDB()
+    policy = AutoscalePolicy(
+        min_replicas=1, max_replicas=4, slots_per_replica=4,
+        high_watermark=0.8, low_watermark=0.3, max_step=1,
+        scale_out_cooldown_s=5.0, scale_in_cooldown_s=5.0,
+        eval_period_s=10.0,
+    )
+    return Autoscaler(
+        db, policy, FakeActuator(), FakeLauncher(db), **kw
+    ).start(run_loop=False)
+
+
+def test_autoscaler_migrate_out_polls_drain(setup):
+    """``_migrate_out`` POSTs /v1/drain and polls the countdown to
+    zero; an unreachable victim and an expired grace both degrade to
+    the plain teardown — never an exception, never a wedge."""
+    stub = _DrainStub([2, 1, 0])
+    a = _mini_autoscaler(migrate_grace_s=3.0)
+    try:
+        with a._lock:
+            a._serve["victim"] = stub.url
+            a._serve["ghost"] = "http://127.0.0.1:1"
+        a._migrate_out("victim")
+        assert stub.calls >= 3, stub.calls  # initial + polls to zero
+        a._migrate_out("ghost")     # unreachable: swallowed
+        a._migrate_out("unknown")   # no advertised url: no-op
+        slow = _DrainStub([5])      # never drains
+        with a._lock:
+            a._serve["stuck"] = slow.url
+        a.migrate_grace_s = 0.3
+        t0 = time.monotonic()
+        a._migrate_out("stuck")     # grace expires, returns
+        assert time.monotonic() - t0 < 3.0
+        slow.stop()
+    finally:
+        a.close()
+        stub.stop()
+
+
+def test_scale_in_e2e_inflight_survives_teardown(setup):
+    """THE autoscaler acceptance sim: a streamed request in flight on
+    the scale-in victim survives the retire — ``_retire`` withdraws
+    discovery, POSTs /v1/drain, waits for in-flight zero; the router
+    ships the suspended slot to the sibling; the victim process then
+    dies and the client's stream still equals the solo oracle."""
+    cfg, params = setup
+    servers = [
+        ServeServer(Engine(params, cfg, **BASE)).start()
+        for _ in range(2)
+    ]
+    router = _router(*[_url(s) for s in servers])
+    a = _mini_autoscaler(migrate_grace_s=5.0)
+    victim, sibling = servers
+    try:
+        payload = {"tokens": _prompt(99, 8), "max_new_tokens": 24}
+        _, oracle = _post(_url(sibling), "/v1/generate", payload)
+        # Steer the stream onto the victim (the sibling reads as
+        # draining for the admission pick, then comes right back).
+        _steer(router, sibling, True)
+        base = f"http://{router.host}:{router.port}"
+        lines: list = []
+        t = threading.Thread(
+            target=lambda: lines.extend(_stream_lines(base, payload)),
+            daemon=True,
+        )
+        t.start()
+        assert wait_for(
+            lambda: victim.engine.stats()["active_slots"] > 0,
+            interval=0.002,
+        ), "stream never admitted on the victim"
+        _steer(router, sibling, False)
+        record = ReplicaRecord(replica_id="asr-victim")
+        a.launcher.launch("asr-victim", {})
+        with a._lock:
+            a._serve["asr-victim"] = _url(victim)
+            a._replicas["asr-victim"] = record
+        a._retire(record)  # withdraw → migrate-out → stop → deprovision
+        assert a.db.lookup("serve/asr-victim/address") == ""
+        assert ("asr-victim", True) in a.launcher.stops
+        # The ship completed (or fell back) — either way the victim
+        # holds nothing; NOW the process dies.
+        assert wait_for(
+            lambda: victim.engine.stats()["migrated_slots"] == 0
+            and victim.engine.in_flight() == 0
+        )
+        victim.stop()
+        t.join(timeout=60)
+        _assert_stream(lines, oracle["tokens"], "scale-in")
+        s = router.stats()["migrations"]
+        assert s["attempts"] >= 1
+        assert s["attempts"] == (
+            s["migrated"] + s["fell_back"] + s["gave_up"]
+        )
+        assert s["gave_up"] == 0, s
+    finally:
+        a.close()
+        router.stop()
+        for s in servers:
+            if s is not victim:
+                s.stop()
