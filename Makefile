@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-serve-overflow test-serve-migrate test-qos test-autoscale test-jit-guard lint lint-metrics lint-jax agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-serve-overflow test-serve-migrate test-qos test-autoscale test-jit-guard test-perf-obs lint lint-metrics lint-jax agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -269,6 +269,30 @@ lint-jax:
 test-jit-guard:
 	timeout -k 10 60 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_jit_guard.py -q -m "jit_guard and not slow" \
+	  -p no:cacheprovider
+
+# Performance forensics (ISSUE 18, perf_obs marker): the runtime
+# recompile sentinel (silent across the warm decode/admission/CoW/
+# migrate matrix, fires WITH request context on a forced fresh
+# compile), the /debugz/profile on-demand device-profiling endpoint +
+# `oimctl profile` download path, tail-latency auto-capture artifacts
+# (phase sums reconciling with the ring entry, rate limiting), the
+# KV-tier flow telemetry from engine counters through load/serve.<id>
+# to `oimctl kv` (old-schema publishers tolerated), error-latch
+# survivability of the forensics endpoints, and the process
+# self-telemetry gauges.  Also runs the oimlint lock-discipline/
+# resource-lifecycle/jaxvet passes over the touched serve + common
+# modules so the sentinel/profile thread ownership stays analyzer-
+# clean.  Nominal ~20 s; 60 s cap carries the box's CPU-quota swings.
+test-perf-obs:
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
+	  --roots oim_tpu/serve
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,resource-lifecycle,metrics \
+	  --roots oim_tpu/common
+	timeout -k 10 60 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_perf_obs.py -q -m "perf_obs and not slow" \
 	  -p no:cacheprovider
 
 # Tier 3: the full stack driving a first op on the real accelerator

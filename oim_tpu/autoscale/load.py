@@ -84,6 +84,17 @@ _DEFAULTS: dict[str, Any] = {
     "parked_slots": 0,
     "prefix_demotions": 0,
     "prefix_evictions": 0,
+    # KV-tier flow telemetry (ISSUE 18; zeros from publishers predating
+    # the fields — tolerant-decode defaults): park/restore counts plus
+    # per-direction wall seconds and bytes, so the fleet view (`oimctl
+    # kv`) and cache-aware autoscaling (ROADMAP item 5) can read tier
+    # bandwidth and thrash rates off the same leased load key.
+    "kv_parks": 0,
+    "kv_unparks": 0,
+    "kv_demote_seconds": 0.0,
+    "kv_promote_seconds": 0.0,
+    "kv_demote_bytes": 0,
+    "kv_promote_bytes": 0,
     "token_rate": 0.0,
     "shed_queue_full": 0,
     "shed_deadline": 0,

@@ -28,6 +28,18 @@
                                   weight, quota pressure and throttles,
                                   live queue/active/parked counts, and
                                   the preemption ledger
+    oimctl profile [--serve URL | --router URL --backend ID]
+                   [--seconds N] [--out DIR]
+                                  capture an on-demand device profiler
+                                  trace from a live backend (POST
+                                  /debugz/profile, poll, download the
+                                  .tar.gz artifact)
+    oimctl kv [--router URL | --serve URL] [--watch S]
+                                  fleet KV-tier view: per-backend
+                                  device/host occupancy, demote/promote
+                                  flow (blocks, bytes, bandwidth),
+                                  park/restore counts, hottest resident
+                                  digest
 """
 
 from __future__ import annotations
@@ -145,11 +157,12 @@ class _TopUnavailable(Exception):
     not die on one dropped connection)."""
 
 
-def _run_top(watch_s: float, fetch) -> int:
+def _run_top(watch_s: float, fetch, render=None) -> int:
     """Shared `oimctl top` scaffold for both modes: ``fetch`` returns
     (rows, autoscale_line) or raises ``_TopUnavailable``.  One frame
     without --watch; with it, a flushed frame every ``watch_s`` seconds
-    until interrupted."""
+    until interrupted.  ``render`` swaps the frame body (`oimctl kv`
+    reuses the whole watch-loop contract with its own table)."""
     while True:
         if watch_s > 0:
             print(f"-- {time.strftime('%H:%M:%S')} --", flush=True)
@@ -165,7 +178,7 @@ def _run_top(watch_s: float, fetch) -> int:
                 return 1
             print(f"error: {exc} (retrying)", flush=True)
         else:
-            _print_top(rows, line)
+            (render or _print_top)(rows, line)
             print("", end="", flush=True)  # frame out before the sleep
         if watch_s <= 0:
             return 0
@@ -284,6 +297,70 @@ def _print_top(
     )
     if autoscale_line:
         print(autoscale_line)
+
+
+def _mib(n: float) -> str:
+    return f"{float(n or 0) / (1024 * 1024):.1f}M"
+
+
+def _print_kv(
+    rows: list[tuple[str, bool, dict]], fleet_line: str = ""
+) -> None:
+    """One KV-tier frame (`oimctl kv`): per-backend tier occupancy and
+    demote/promote flow.  Every field via .get() with a zero default —
+    an old-schema publisher in a mixed fleet renders as zeros/dashes,
+    never a crash (the tolerant-decode contract)."""
+    print(
+        f"{'BACKEND':<28} {'HEALTHY':<8} {'DEV u/t':>13} "
+        f"{'HOST u/t':>13} {'PARKED':>6} {'PARK/UN':>9} "
+        f"{'DEMOTE blk/MiB/bw':>19} {'PROMOTE blk/MiB/bw':>19} "
+        f"HOT DIGEST"
+    )
+    for bid, healthy, load in rows:
+        dev_total = load.get("kv_blocks_total", 0) or 0
+        dev = (
+            f"{dev_total - (load.get('kv_blocks_free', 0) or 0)}"
+            f"/{dev_total}"
+            if dev_total else "-"
+        )
+        host_total = load.get("kv_host_blocks_total", 0) or 0
+        host = (
+            f"{host_total - (load.get('kv_host_blocks_free', 0) or 0)}"
+            f"/{host_total}"
+            if host_total else "-"
+        )
+
+        def flow(blocks_key: str, bytes_key: str, secs_key: str) -> str:
+            blocks = load.get(blocks_key, 0) or 0
+            n_bytes = load.get(bytes_key, 0) or 0
+            seconds = load.get(secs_key, 0.0) or 0.0
+            if not blocks:
+                return "-"
+            bw = (
+                f"{n_bytes / seconds / (1024 * 1024):.0f}MiB/s"
+                if seconds > 0 and n_bytes else "-"
+            )
+            return f"{blocks}/{_mib(n_bytes)}/{bw}"
+
+        digests = load.get("prefix_digests") or ()
+        hot = "-"
+        if digests and isinstance(digests[0], dict):
+            hot = (
+                f"{str(digests[0].get('digest', ''))[:12]} "
+                f"({digests[0].get('hits', 0)} hits)"
+            )
+        print(
+            f"{bid[:28]:<28} {('yes' if healthy else 'NO'):<8} "
+            f"{dev:>13} {host:>13} "
+            f"{load.get('parked_slots', 0) or 0:>6} "
+            f"{load.get('kv_parks', 0) or 0}/"
+            f"{load.get('kv_unparks', 0) or 0:<4} "
+            f"{flow('kv_demotions', 'kv_demote_bytes', 'kv_demote_seconds'):>19} "
+            f"{flow('kv_promotions', 'kv_promote_bytes', 'kv_promote_seconds'):>19} "
+            f"{hot}"
+        )
+    if fleet_line:
+        print(fleet_line)
 
 
 def main(argv=None) -> int:
@@ -476,6 +553,52 @@ def main(argv=None) -> int:
         "of the registry's load/ keys",
     )
     top.add_argument(
+        "--watch", type=float, default=0.0, metavar="S",
+        help="refresh every S seconds until interrupted (0 = one shot)",
+    )
+    profile = sub.add_parser(
+        "profile",
+        help="capture a bounded on-demand device profiler trace from a "
+        "live backend and download it as a .tar.gz (doc/operations.md "
+        "'Performance forensics')",
+    )
+    profile.add_argument(
+        "--serve", default="",
+        help="backend url (direct POST /debugz/profile)",
+    )
+    profile.add_argument(
+        "--router", default="",
+        help="router url: fans the capture out to --backend",
+    )
+    profile.add_argument(
+        "--backend", default="",
+        help="backend id (or url) to trace when going through --router",
+    )
+    profile.add_argument(
+        "--seconds", type=float, default=2.0, metavar="N",
+        help="capture window (clamped to 0.05..60 by the backend)",
+    )
+    profile.add_argument(
+        "--out", default=".", metavar="DIR",
+        help="directory to write the trace tarball into",
+    )
+    kv = sub.add_parser(
+        "kv",
+        help="one-shot (or --watch) fleet KV-tier view: per-backend "
+        "device/host tier occupancy, demote/promote flow rates and "
+        "bytes, park/restore counts, hottest resident digest "
+        "(doc/operations.md 'KV-tier flow incidents')",
+    )
+    kv.add_argument(
+        "--router", default="http://127.0.0.1:9000",
+        help="router url (per-backend load snapshots from /v1/stats)",
+    )
+    kv.add_argument(
+        "--serve", default="",
+        help="single-backend mode: read one engine's /v1/info load "
+        "instead of a router fleet view",
+    )
+    kv.add_argument(
         "--watch", type=float, default=0.0, metavar="S",
         help="refresh every S seconds until interrupted (0 = one shot)",
     )
@@ -765,6 +888,145 @@ def main(argv=None) -> int:
             ], line
 
         return _run_top(args.watch, fetch_router_top)
+    if args.command == "profile":
+        import json as json_mod
+        import os
+        import urllib.error
+        import urllib.parse
+        import urllib.request as urlreq
+
+        if bool(args.serve) == bool(args.router):
+            print("error: give exactly one of --serve URL (direct) or "
+                  "--router URL --backend ID")
+            return 2
+        if args.router and not args.backend:
+            print("error: --router mode needs --backend ID (the "
+                  "profiler is per-backend state)")
+            return 2
+        base = (args.serve or args.router).rstrip("/")
+        urlopen = _serve_urlopen(args, base)
+        if urlopen is None:
+            return 2
+        qs = (
+            f"backend={urllib.parse.quote(args.backend)}"
+            if args.router else ""
+        )
+        start_url = base + "/debugz/profile" + (f"?{qs}" if qs else "")
+        download_url = base + "/debugz/profile?" + (
+            f"{qs}&" if qs else ""
+        ) + "download=1"
+        try:
+            with urlopen(urlreq.Request(
+                start_url,
+                data=json_mod.dumps({"seconds": args.seconds}).encode(),
+                headers={"Content-Type": "application/json"},
+            ), timeout=30) as resp:
+                started = json_mod.load(resp)
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode(errors="replace")[:300]
+            print(f"error: starting profile failed: {exc.code} {detail}")
+            return 1
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            print(f"error: starting profile failed: {exc}")
+            return 1
+        doc = started.get("profile") or {}
+        print(
+            f"capturing {doc.get('seconds', args.seconds)}s trace "
+            f"into {doc.get('dir', '?')} ..."
+        )
+        deadline = time.monotonic() + float(args.seconds) + 30.0
+        state = str(doc.get("state", "running"))
+        while state == "running" and time.monotonic() < deadline:
+            time.sleep(0.25)
+            try:
+                with urlopen(start_url, timeout=10) as resp:
+                    doc = json_mod.load(resp).get("profile") or {}
+            except (urllib.error.URLError, OSError, ValueError):
+                continue  # transient poll failure; the deadline bounds us
+            state = str(doc.get("state", ""))
+        if state != "done":
+            err = str(doc.get("error") or "")
+            print(
+                f"error: profile did not finish: state={state or '?'}"
+                + (f" ({err})" if err else "")
+            )
+            return 1
+        try:
+            with urlopen(download_url, timeout=120) as resp:
+                data = resp.read()
+                cdisp = resp.headers.get("Content-Disposition", "")
+        except (urllib.error.URLError, OSError) as exc:
+            print(f"error: downloading trace failed: {exc}")
+            return 1
+        name = ""
+        if 'filename="' in cdisp:
+            name = cdisp.split('filename="', 1)[1].split('"', 1)[0]
+        name = (
+            name
+            or os.path.basename(str(doc.get("tar") or ""))
+            or "oim-profile.tar.gz"
+        )
+        os.makedirs(args.out, exist_ok=True)
+        path = os.path.join(args.out, name)
+        with open(path, "wb") as f:
+            f.write(data)
+        print(f"wrote {path} ({len(data)} bytes)")
+        return 0
+    if args.command == "kv":
+        import urllib.error
+
+        base = (args.serve or args.router).rstrip("/")
+        urlopen = _serve_urlopen(args, base)
+        if urlopen is None:
+            return 2
+
+        if args.serve:
+            # Single-backend mode: the engine's live load snapshot off
+            # /v1/info — same fields the router's fleet view merges.
+            def fetch_kv():
+                try:
+                    with urlopen(base + "/v1/info", timeout=30) as resp:
+                        info = json.load(resp)
+                except (urllib.error.URLError, OSError, ValueError) as exc:
+                    raise _TopUnavailable(str(exc))
+                return [(base, True, info.get("load") or {})], ""
+        else:
+            def fetch_kv():
+                try:
+                    with urlopen(base + "/v1/stats", timeout=30) as resp:
+                        stats = json.load(resp)
+                except (urllib.error.URLError, OSError, ValueError) as exc:
+                    raise _TopUnavailable(str(exc))
+                fleet = stats.get("kv") or {}
+                line = ""
+                if fleet:
+                    line = (
+                        "fleet: demoted "
+                        f"{fleet.get('kv_demotions', 0)} blk "
+                        f"({_mib(fleet.get('kv_demote_bytes', 0))}), "
+                        f"promoted {fleet.get('kv_promotions', 0)} blk "
+                        f"({_mib(fleet.get('kv_promote_bytes', 0))}), "
+                        f"parks {fleet.get('kv_parks', 0)}/"
+                        f"{fleet.get('kv_unparks', 0)}, parked "
+                        f"{fleet.get('parked_slots', 0)}, device free "
+                        f"{fleet.get('kv_blocks_free', 0)}/"
+                        f"{fleet.get('kv_blocks_total', 0)} blk, "
+                        f"host free "
+                        f"{fleet.get('kv_host_blocks_free', 0)}/"
+                        f"{fleet.get('kv_host_blocks_total', 0)} blk"
+                    )
+                return [
+                    (
+                        bid,
+                        bool(b.get("healthy", True)),
+                        b.get("load") or {},
+                    )
+                    for bid, b in sorted(
+                        (stats.get("backends") or {}).items()
+                    )
+                ], line
+
+        return _run_top(args.watch, fetch_kv, render=_print_kv)
     channel = _channel(args)
     # Operator CLI resilience: UNAVAILABLE/DEADLINE_EXCEEDED retried with
     # backoff under the shared policy.  Streaming `watch` is exempt — a

@@ -142,6 +142,11 @@ def main(argv=None) -> int:
     tracing.init("oim-route", args.trace_file or None)
     events.init("oim-route")
     events.install_crash_hook()
+    # Process self-telemetry (ISSUE 18): RSS/CPU/threads/GC gauges on
+    # the same registry the router's MetricsServer renders.
+    from oim_tpu.common import metrics as _metrics_mod
+
+    _metrics_mod.install_process_metrics()
 
     from oim_tpu.serve.router import Router
 

@@ -149,6 +149,26 @@ def build_parser() -> argparse.ArgumentParser:
         "/v1/requests (`oimctl requests`); drop-oldest beyond N",
     )
     p.add_argument(
+        "--slow-capture-e2e", type=float, default=0.0, metavar="S",
+        help="tail-latency auto-capture: any request whose end-to-end "
+        "latency reaches S seconds dumps its full phase trace, an "
+        "engine stats snapshot, and the ring neighborhood to the "
+        "flight dir ($OIM_FLIGHT_DIR) as a `serve.slow_capture` "
+        "artifact (0 = off)",
+    )
+    p.add_argument(
+        "--slow-capture-tpot-mult", type=float, default=0.0, metavar="M",
+        help="relative slow-capture trigger: capture when a request's "
+        "time-per-output-token exceeds M times the engine's token-rate "
+        "EWMA — catches regressions without an absolute threshold "
+        "(0 = off)",
+    )
+    p.add_argument(
+        "--slow-capture-interval", type=float, default=60.0, metavar="S",
+        help="minimum seconds between slow-capture dumps (rate limit: "
+        "one bad burst must not fill the flight dir)",
+    )
+    p.add_argument(
         "--watchdog-interval", type=float, default=1.0, metavar="S",
         help="stall-watchdog poll interval: a decode chunk blocking the "
         "driver past max(--stall-floor, --stall-multiplier x its EWMA "
@@ -544,6 +564,9 @@ def make_engine(args):
             args.paged_kernel
         ],
         qos=qos,
+        slow_capture_e2e_s=args.slow_capture_e2e,
+        slow_capture_tpot_mult=args.slow_capture_tpot_mult,
+        slow_capture_interval_s=args.slow_capture_interval,
     )
 
 
@@ -577,6 +600,17 @@ def main(argv=None) -> int:
     tracing.init("oim-serve", args.trace_file or None)
     events.init("oim-serve")
     events.install_crash_hook()
+    # Performance forensics (ISSUE 18): the recompile sentinel's
+    # process-global jax.monitoring listener must be registered BEFORE
+    # the engine's warmup compiles so the warmup suppression bracket
+    # sees every backend_compile event, and the process self-telemetry
+    # gauges (RSS/CPU/threads/GC) ride the same metrics registry the
+    # MetricsServer below renders.
+    from oim_tpu.common import metrics as _metrics_mod
+    from oim_tpu.serve import sentinel as _sentinel
+
+    _sentinel.install()
+    _metrics_mod.install_process_metrics()
 
     bootstrap_path = args.bootstrap or os.environ.get("TPU_BOOTSTRAP", "")
     if bootstrap_path:

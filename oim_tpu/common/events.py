@@ -338,14 +338,21 @@ _crash_lock = threading.Lock()
 _crash_state: dict[str, Any] = {"installed": False, "dir": "", "prev": None, "prev_threading": None}
 
 
-def crash_dump_path() -> str:
-    directory = (
+def flight_dir() -> str:
+    """THE directory for forensic artifacts (crash dumps, slow-capture
+    dumps, on-demand profiler traces): the crash hook's configured dir,
+    else ``$OIM_FLIGHT_DIR``, else /tmp.  One resolution order so an
+    operator who set the flight dir finds every artifact kind in it."""
+    return (
         _crash_state["dir"]
         or os.environ.get("OIM_FLIGHT_DIR")
         or "/tmp"
     )
+
+
+def crash_dump_path() -> str:
     return os.path.join(
-        directory, f"oim-flight-{os.getpid()}-{int(time.time())}.json"
+        flight_dir(), f"oim-flight-{os.getpid()}-{int(time.time())}.json"
     )
 
 

@@ -617,6 +617,160 @@ AUTOSCALE_ACTIONS = _registry.counter(
     "(ENOSPC) and the autoscaler backed off instead of crash-looping.",
     ("direction", "outcome"),
 )
+XLA_COMPILES = _registry.counter(
+    "oim_xla_compiles_total",
+    "XLA backend compilations in this process, counted via the "
+    "jax.monitoring per-compile duration event (installed by "
+    "oim_tpu.serve.sentinel at daemon init).  The count is expected "
+    "to plateau after warmup; any increase on a serving daemon after "
+    "the steady-state latch armed also emits a serve.recompile "
+    "flight-recorder event with the active request context — see "
+    "doc/operations.md 'Performance forensics'.",
+)
+XLA_COMPILE_SECONDS = _registry.histogram(
+    "oim_xla_compile_seconds",
+    "Wall time of each XLA backend compilation.  Milliseconds on the "
+    "CPU CI backend, 20-40 s per program on a real TPU — which is why "
+    "a single post-warm bucket increment here is a mid-stream stall "
+    "worth paging on, not a latency curiosity.",
+)
+SERVE_REQUEST_RING_DROPPED = _registry.counter(
+    "oim_serve_request_ring_dropped_total",
+    "Completed requests whose forensic ring entry displaced the "
+    "oldest entry (ring full) or was dropped outright.  A steadily "
+    "rising rate means the --request-ring window is shorter than the "
+    "incident-response lag and slow-request forensics will be missing "
+    "their neighborhood; size the ring per doc/operations.md.",
+    ("engine",),
+)
+SERVE_KV_TIER_BYTES = _registry.counter(
+    "oim_serve_kv_tier_bytes_total",
+    "KV bytes moved between the HBM and host tiers, by op (demote = "
+    "HBM→host including park evictions, promote = host→HBM including "
+    "unpark restores).  Pair with oim_serve_kv_tier_moves_total for "
+    "per-block cost and with oim_serve_kv_tier_seconds for bandwidth; "
+    "a demote rate approaching the PCIe budget means the host tier is "
+    "thrashing — see doc/operations.md 'KV-tier flow incidents'.",
+    ("op",),
+)
+SERVE_KV_TIER_RESIDENT = _registry.gauge(
+    "oim_serve_kv_tier_resident_bytes",
+    "KV bytes currently resident per tier (device = HBM block pool "
+    "in use, host = overflow/park tier in use).  The fleet sum over "
+    "backends is the 'one hierarchical KV store' occupancy that "
+    "cache-aware autoscaling consumes (ROADMAP item 5); per backend "
+    "it is the denominator for demote/promote flow rates.",
+    ("engine", "tier"),
+)
+SERVE_SLOW_CAPTURES = _registry.counter(
+    "oim_serve_slow_captures_total",
+    "Tail-latency auto-captures written to the flight dir, by trigger "
+    "(e2e = absolute end-to-end threshold, tpot = marginal per-token "
+    "EWMA multiple).  Each increment corresponds to one "
+    "serve.slow_capture event naming the artifact path; captures are "
+    "rate-limited, so this undercounts slow requests — it counts "
+    "dumped artifacts.",
+    ("engine", "trigger"),
+)
+PROCESS_RSS = _registry.gauge(
+    "oim_process_resident_bytes",
+    "Resident set size of this daemon process (from /proc/self/statm; "
+    "ru_maxrss high-water fallback where /proc is unavailable).  On a "
+    "serving host this is dominated by host-tier KV and the runtime "
+    "heap, NOT device HBM — compare with "
+    "oim_serve_kv_tier_resident_bytes{tier=\"host\"} to attribute "
+    "growth.",
+)
+PROCESS_CPU_SECONDS = _registry.gauge(
+    "oim_process_cpu_seconds",
+    "Cumulative user+system CPU seconds consumed by this process "
+    "(os.times).  Exposed as a gauge because the value is read, not "
+    "accumulated, at scrape time; rate() over it still yields CPU "
+    "utilisation.",
+)
+PROCESS_THREADS = _registry.gauge(
+    "oim_process_threads",
+    "Live Python threads in this process.  A serving daemon has a "
+    "small, stable set (driver, HTTP, streamers, host-tier flusher); "
+    "unbounded growth means a leaked per-request or per-capture "
+    "thread.",
+)
+PROCESS_GC_PAUSE_SECONDS = _registry.counter(
+    "oim_process_gc_pause_seconds_total",
+    "Cumulative wall time spent inside CPython garbage collections "
+    "(gc.callbacks start→stop).  GC pauses on the driver thread are "
+    "invisible to per-phase request tracing but show up as TPOT "
+    "outliers — correlate spikes here with serve.slow_capture events.",
+)
+PROCESS_GC_COLLECTIONS = _registry.counter(
+    "oim_process_gc_collections_total",
+    "CPython garbage collections observed via gc.callbacks, by "
+    "generation.",
+    ("generation",),
+)
+
+
+_process_metrics_state = {"installed": False}
+_process_metrics_lock = threading.Lock()
+
+
+def install_process_metrics() -> bool:
+    """Bind the ``oim_process_*`` self-telemetry gauges to this process
+    (RSS, CPU seconds, thread count, GC pauses) — idempotent, stdlib
+    only.  Called by every daemon at init (MetricsServer.start() calls
+    it too, so any daemon with a scrape endpoint gets it for free);
+    returns False when already installed."""
+    with _process_metrics_lock:
+        if _process_metrics_state["installed"]:
+            return False
+        _process_metrics_state["installed"] = True
+
+    import gc
+    import os
+
+    try:
+        page = os.sysconf("SC_PAGE_SIZE")
+    except (AttributeError, ValueError, OSError):
+        page = 4096
+
+    def _rss() -> float:
+        try:
+            with open("/proc/self/statm") as f:
+                return float(int(f.read().split()[1]) * page)
+        except (OSError, ValueError, IndexError):
+            try:
+                import resource
+
+                # ru_maxrss is a KiB high-water mark, not instantaneous
+                # RSS — good enough as a fallback ceiling.
+                return float(
+                    resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+                )
+            except Exception:
+                return 0.0
+
+    def _cpu() -> float:
+        t = os.times()
+        return float(t.user + t.system)
+
+    PROCESS_RSS.set_function(_rss)
+    PROCESS_CPU_SECONDS.set_function(_cpu)
+    PROCESS_THREADS.set_function(lambda: float(threading.active_count()))
+
+    # gc.callbacks run synchronously on the collecting thread while it
+    # holds the GIL, and "start"/"stop" for one collection cannot
+    # interleave with another — a single shared t0 slot is race-free.
+    gc_t0 = [0.0]
+
+    def _gc_callback(phase: str, info: dict) -> None:
+        if phase == "start":
+            gc_t0[0] = time.perf_counter()
+        elif phase == "stop":
+            PROCESS_GC_PAUSE_SECONDS.inc(by=time.perf_counter() - gc_t0[0])
+            PROCESS_GC_COLLECTIONS.inc(str(info.get("generation", "")))
+
+    gc.callbacks.append(_gc_callback)
+    return True
 
 
 EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -767,6 +921,9 @@ class MetricsServer:
         return self._httpd.server_address[1]
 
     def start(self) -> "MetricsServer":
+        # Any daemon exposing a scrape endpoint gets the oim_process_*
+        # self-telemetry series for free (idempotent per process).
+        install_process_metrics()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )

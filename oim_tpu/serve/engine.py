@@ -76,6 +76,8 @@ discipline for daemon use.
 
 from __future__ import annotations
 
+import json
+import os
 import statistics
 import threading
 import time
@@ -91,6 +93,7 @@ import numpy as np
 from oim_tpu.common import events as _events
 from oim_tpu.common import metrics as _metrics
 from oim_tpu.common import tracing as _tracing
+from oim_tpu.serve import sentinel as _sentinel
 from oim_tpu.qos.policy import (
     DEFAULT_POLICY as _QOS_DEFAULT,
     TIER_PRIORITY as _QOS_TIER_PRIORITY,
@@ -1589,6 +1592,9 @@ class Engine:
         kv_host_bytes: int = 0,
         kv_park: bool = True,
         qos=None,
+        slow_capture_e2e_s: float = 0.0,
+        slow_capture_tpot_mult: float = 0.0,
+        slow_capture_interval_s: float = 60.0,
     ):
         if pipeline_depth not in (1, 2):
             raise ValueError(
@@ -1946,6 +1952,12 @@ class Engine:
         self.kv_unparks = 0  # slots restored
         self.kv_demote_seconds = 0.0
         self.kv_promote_seconds = 0.0
+        # Byte twins of the block counters (ISSUE 18 fleet KV-tier
+        # flow telemetry): blocks * _block_bytes at each move site, so
+        # the fleet view and oim_serve_kv_tier_bytes_total speak
+        # bandwidth, not just block counts.
+        self.kv_demote_bytes = 0
+        self.kv_promote_bytes = 0
         # Prefix-shortage outcome split (ISSUE 15 satellite): an entry
         # moved to the host tier is recoverable; one destroyed — no
         # host tier, host budget exhausted, or host-LRU pressure — is
@@ -2400,6 +2412,38 @@ class Engine:
         # would otherwise report phantom traffic and 20-40 s compile
         # latencies in the histogram forever).
         self._warming = False
+        # -- performance forensics (ISSUE 18) --------------------------
+        # Recompile sentinel: warmup()'s final act is sentinel.arm(self);
+        # the listener reads _sentinel_ctx WITHOUT any lock (it can fire
+        # on the driver thread mid-dispatch, engine lock held), so the
+        # driver REPLACES the dict wholesale at phase boundaries and
+        # never mutates it in place.
+        self._sentinel_ctx: dict = {"phase": "idle"}
+        self.recompiles = 0  # post-warm compiles attributed to this engine
+        # Tail-latency auto-capture: absolute e2e threshold and/or
+        # marginal-TPOT EWMA multiple (either 0.0 = that trigger off),
+        # rate-limited to one artifact per interval.
+        if (slow_capture_e2e_s < 0 or slow_capture_tpot_mult < 0
+                or slow_capture_interval_s < 0):
+            raise ValueError(
+                "slow-capture knobs must be >= 0; got "
+                f"e2e={slow_capture_e2e_s}, mult={slow_capture_tpot_mult}, "
+                f"interval={slow_capture_interval_s}"
+            )
+        self._slow_e2e_s = float(slow_capture_e2e_s)
+        self._slow_tpot_mult = float(slow_capture_tpot_mult)
+        self._slow_interval_s = float(slow_capture_interval_s)
+        self._slow_last_capture = 0.0  # monotonic; 0 = never
+        self.slow_captures = 0
+        self._m_slow_captures = _metrics.SERVE_SLOW_CAPTURES
+        # Shared twins for the ring-drop counter and tier byte/residency
+        # flow (common/metrics.py definitions; ISSUE 18 satellites).
+        self._m_ring_dropped = _metrics.SERVE_REQUEST_RING_DROPPED
+        self._m_tier_bytes = _metrics.SERVE_KV_TIER_BYTES
+        self._m_tier_resident = _metrics.SERVE_KV_TIER_RESIDENT
+        # Bytes in one paged block (0 on dense): the tier-flow byte
+        # counters are blocks * this at every move site.
+        self._block_bytes = self._kv_row_bytes * self.kv_block
 
     # -- submission / results (any thread) --------------------------------
 
@@ -3155,6 +3199,13 @@ class Engine:
                 # drop-oldest (int read is atomic; the ring itself is
                 # under its own lock).
                 "ring_dropped": self.ring_dropped,
+                # Performance forensics (ISSUE 18): tier flow in bytes,
+                # post-warm compiles the sentinel attributed to this
+                # engine, and tail-latency artifacts dumped.
+                "kv_demote_bytes": self.kv_demote_bytes,
+                "kv_promote_bytes": self.kv_promote_bytes,
+                "recompiles": self.recompiles,
+                "slow_captures": self.slow_captures,
                 # Multi-tenant QoS (ISSUE 16): whether a policy is
                 # enforced, how many admissions parked a victim, and
                 # the per-tenant live/cumulative rows (`oimctl
@@ -3258,6 +3309,18 @@ class Engine:
                 "parked_slots": len(self._parked),
                 "prefix_demotions": self.prefix_demotions,
                 "prefix_evictions": self.prefix_evictions,
+                # KV-tier flow telemetry (ISSUE 18, tolerant decode:
+                # zeros from publishers predating the fields): park /
+                # restore counts and per-direction wall seconds and
+                # bytes — `oimctl kv`'s flow-rate columns and the
+                # cache-aware autoscaling input (ROADMAP item 5) read
+                # these off the same leased load key.
+                "kv_parks": self.kv_parks,
+                "kv_unparks": self.kv_unparks,
+                "kv_demote_seconds": round(self.kv_demote_seconds, 6),
+                "kv_promote_seconds": round(self.kv_promote_seconds, 6),
+                "kv_demote_bytes": self.kv_demote_bytes,
+                "kv_promote_bytes": self.kv_promote_bytes,
                 # Fast-path discovery (ISSUE 13): whether this backend
                 # decodes through the paged flash kernel and whether
                 # its cache runs the kv4 rung — `oimctl top` and the
@@ -3462,9 +3525,11 @@ class Engine:
         with self._ring_lock:
             if self._ring.maxlen == 0:
                 self.ring_dropped += 1
+                self._m_ring_dropped.inc(self._engine_label)
             else:
                 if len(self._ring) == self._ring.maxlen:
                     self.ring_dropped += 1
+                    self._m_ring_dropped.inc(self._engine_label)
                 self._ring.append(entry)
         self._m_e2e.observe(e2e_s, tenant, outcome)
         # Per-tenant consumption (ISSUE 16): the series token quotas
@@ -3482,6 +3547,94 @@ class Engine:
             self._m_prefill.observe(prefill_s, tenant)
         if chunk_count and tokens_out > 1:
             self._m_tpot.observe(decode_s / (tokens_out - 1), tenant)
+        # Tail-latency auto-capture (ISSUE 18): runs here, after every
+        # metric/ring write and with NO locks held, so a slow dump can
+        # never stall the driver's next step or a submit().
+        self._maybe_slow_capture(entry, phases)
+
+    def _maybe_slow_capture(
+        self, entry: dict, phases: "_PhaseTrace | None"
+    ) -> None:
+        """Dump the full forensic story of a slow request to the flight
+        dir BEFORE anyone asks: the ring entry, its per-chunk phase
+        trace, a stats()/KV-occupancy snapshot, and the ring
+        neighborhood it completed among.  Triggers: absolute e2e
+        threshold, or marginal TPOT above an EWMA multiple of the
+        engine's live token rate.  Rate-limited (one artifact per
+        interval) and best-effort — a full disk must not fail the
+        request that was merely slow."""
+        trigger = ""
+        if self._slow_e2e_s and entry["e2e_s"] >= self._slow_e2e_s:
+            trigger = "e2e"
+        elif self._slow_tpot_mult and entry["chunks"]:
+            tokens_out = entry["tokens_out"]
+            rate = self._token_rate_ewma or 0.0
+            if tokens_out > 1 and rate > 0.0:
+                tpot = entry["decode_s"] / (tokens_out - 1)
+                if tpot * rate >= self._slow_tpot_mult:
+                    trigger = "tpot"
+        if not trigger:
+            return
+        now = time.monotonic()
+        if (self._slow_last_capture
+                and now - self._slow_last_capture < self._slow_interval_s):
+            return
+        self._slow_last_capture = now
+        with self._ring_lock:
+            neighborhood = list(self._ring)[-16:]
+        artifact = {
+            "kind": "slow_capture",
+            "trigger": trigger,
+            "thresholds": {
+                "e2e_s": self._slow_e2e_s,
+                "tpot_mult": self._slow_tpot_mult,
+                "token_rate_ewma": round(self._token_rate_ewma or 0.0, 2),
+            },
+            "entry": entry,
+            # Per-chunk decode forensics: (seq, start, end, tokens,
+            # dispatch-wait, fetch-wait) — the spans' raw material, so
+            # the artifact's chunk sums reconcile with entry.decode_s.
+            "chunks": [
+                {
+                    "seq": seq,
+                    "wall_s": round(max(0.0, b - a), 6),
+                    "tokens": ntok,
+                    "dispatch_wait_s": round(disp, 6),
+                    "fetch_wait_s": round(fetch, 6),
+                }
+                for seq, a, b, ntok, disp, fetch in (
+                    phases.chunks if phases is not None else ()
+                )
+            ],
+            "stats": self.stats(),
+            "ring": neighborhood,
+        }
+        path = os.path.join(
+            _events.flight_dir(),
+            f"oim-slowcap-{os.getpid()}-{entry['rid']}-"
+            f"{int(time.time())}.json",
+        )
+        try:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(artifact, f, indent=1)
+            os.replace(tmp, path)
+        except OSError:
+            return  # best-effort: no flight dir is not a request failure
+        self.slow_captures += 1
+        self._m_slow_captures.inc(self._engine_label, trigger)
+        _events.emit(
+            "serve.slow_capture",
+            component="serve",
+            severity=_events.WARNING,
+            subject=str(entry["rid"]),
+            trigger=trigger,
+            tenant=entry["tenant"],
+            e2e_s=entry["e2e_s"],
+            decode_s=entry["decode_s"],
+            tokens_out=entry["tokens_out"],
+            path=path,
+        )
 
     def _drain_fail_obs(self) -> None:
         """Finalize failure records queued by ``_fail_locked`` — called
@@ -3867,6 +4020,21 @@ class Engine:
                 float(self._host.alloc.used_blocks),
                 self._engine_label, "host",
             )
+        # Per-tier resident BYTES (ISSUE 18): the block gauges times
+        # block bytes, so fleet dashboards read hierarchical-KV-store
+        # occupancy without knowing each engine's block geometry.
+        # Module-level instrument (not the self._m_* alias): the ctor
+        # calls this before the forensics aliases exist.
+        block_bytes = float(self._kv_row_bytes * self.kv_block)
+        _metrics.SERVE_KV_TIER_RESIDENT.set(
+            self._alloc.used_blocks * block_bytes,
+            self._engine_label, "device",
+        )
+        if self._host is not None:
+            _metrics.SERVE_KV_TIER_RESIDENT.set(
+                self._host.alloc.used_blocks * block_bytes,
+                self._engine_label, "host",
+            )
 
     def _plan_paged_admission_locked(self, req: GenRequest, idle: bool):
         """Reserve everything ``req``'s admission needs from the pool
@@ -4123,7 +4291,11 @@ class Engine:
         ))
         if not self._warming:
             self.kv_demotions += n
+            self.kv_demote_bytes += n * self._block_bytes
             self._m_tier_moves.inc("demote", by=float(n))
+            self._m_tier_bytes.inc(
+                "demote", by=float(n * self._block_bytes)
+            )
         self._update_kv_gauges_locked()
         return True
 
@@ -4586,7 +4758,11 @@ class Engine:
         if not self._warming:
             self.kv_parks += 1
             self.kv_demotions += n_cov
+            self.kv_demote_bytes += n_cov * self._block_bytes
             self._m_tier_moves.inc("demote", by=float(n_cov))
+            self._m_tier_bytes.inc(
+                "demote", by=float(n_cov * self._block_bytes)
+            )
             if self._qos_policy is not None:
                 # Under a policy every park IS a QoS decision (the
                 # victim order came from tenant tiers): count both
@@ -4706,10 +4882,17 @@ class Engine:
                     dt = time.monotonic() - t0
                     self.kv_unparks += 1
                     self.kv_promotions += parked.n_cov
+                    self.kv_promote_bytes += (
+                        parked.n_cov * self._block_bytes
+                    )
                     self.kv_promote_seconds += dt
                     self._promote_walls.append(dt)
                     self._m_tier_moves.inc(
                         "promote", by=float(parked.n_cov)
+                    )
+                    self._m_tier_bytes.inc(
+                        "promote",
+                        by=float(parked.n_cov * self._block_bytes),
                     )
                     self._m_tier_seconds.inc("promote", by=dt)
                 self._update_kv_gauges_locked()
@@ -5273,9 +5456,13 @@ class Engine:
                         dt = time.monotonic() - t0
                         n = len(st.blocks)
                         self.kv_promotions += n
+                        self.kv_promote_bytes += n * self._block_bytes
                         self.kv_promote_seconds += dt
                         self._promote_walls.append(dt)
                         self._m_tier_moves.inc("promote", by=float(n))
+                        self._m_tier_bytes.inc(
+                            "promote", by=float(n * self._block_bytes)
+                        )
                         self._m_tier_seconds.inc("promote", by=dt)
                 self._update_kv_gauges_locked()
         return installed
@@ -6204,6 +6391,14 @@ class Engine:
             self._m_queued.set(float(len(self._queue)), self._engine_label)
 
         if admissions:
+            # Sentinel context (ISSUE 18): replaced wholesale, never
+            # mutated — the compile listener reads it lock-free, so a
+            # recompile during this wave's prefill dispatches names the
+            # admitted requests.
+            self._sentinel_ctx = {
+                "phase": "admit",
+                "rids": tuple(rid for _, rid, _, _, _ in admissions),
+            }
             # Phase clock: every admission in this wave left the queue
             # at the pop above — one boundary instant serves the wave.
             t_admitted = time.monotonic()
@@ -6529,6 +6724,14 @@ class Engine:
             slots = dict(self._slots)
             n_slots = self._cache.n_slots
 
+        # Sentinel context (ISSUE 18): a recompile during this chunk's
+        # dispatch names the slots' live requests (replaced wholesale;
+        # the compile listener reads it lock-free).
+        self._sentinel_ctx = {
+            "phase": "decode",
+            "rids": tuple(sorted(s.rid for s in slots.values())),
+        }
+
         if chained is not None:
             temps_etc = chained.inputs
             tokens = chained.next_tok
@@ -6838,6 +7041,11 @@ class Engine:
         registry pre-dialing controllers it proxies for)."""
         max_len = self._usable_len
         self._warming = True  # dummies must not pollute request metrics
+        # The recompile sentinel (serve/sentinel.py) must stay quiet
+        # for warmup's own legitimate compiles — including when ANOTHER
+        # already-armed engine shares this process (tests, multi-engine
+        # embedders): begin/end bracket the whole recipe.
+        _sentinel.begin_warmup()
 
         def fits_pool(tokens: int, max_new: int) -> bool:
             # A small paged pool (legal: short-request deployments) may
@@ -6961,4 +7169,11 @@ class Engine:
                 self._flush_host_tier_locked()
         finally:
             self._warming = False
+            _sentinel.end_warmup()
+        # Steady-state latch (ISSUE 18): every surface above is now
+        # precompiled, so from here on any XLA compile in this process
+        # is a production incident — arm the sentinel (inert unless the
+        # daemon installed the listener) so it fires a serve.recompile
+        # WARNING with this engine's live request context.
+        _sentinel.arm(self)
         return self

@@ -98,6 +98,7 @@ import json
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from concurrent import futures
 from dataclasses import dataclass, field
@@ -500,6 +501,10 @@ class Router:
                     from oim_tpu.common import events as events_mod
 
                     self._json(200, events_mod.snapshot())
+                elif path == "/debugz/profile":
+                    # On-demand device profiling (ISSUE 18): status /
+                    # tarball passthrough to ONE named backend.
+                    outer._profile_proxy(self, None)
                 else:
                     self._json(404, {"error": f"no such path {path}"})
 
@@ -517,6 +522,14 @@ class Router:
 
             def do_POST(self):
                 if not check_serving_peer(self):
+                    return
+                if self.path.split("?", 1)[0] == "/debugz/profile":
+                    # On-demand device profiling (ISSUE 18): start a
+                    # capture on ONE named backend (?backend=<id>).
+                    # Not a PROXIED generation path — no tenant QoS
+                    # charge, no pick-a-backend retry semantics.
+                    length = int(self.headers.get("Content-Length", "0"))
+                    outer._profile_proxy(self, self.rfile.read(length))
                     return
                 if self.path not in PROXIED:
                     self._json(404, {"error": f"no such path {self.path}"})
@@ -2509,6 +2522,88 @@ class Router:
         merged.sort(key=lambda e: float(e.get("ts", 0.0) or 0.0))
         return {"requests": merged, "dropped": dropped, "errors": errors}
 
+    def _profile_proxy(self, handler, body: bytes | None) -> None:
+        """Fan ``/debugz/profile`` out to ONE named backend
+        (``?backend=<id>``, backend URL accepted too): the profiler is
+        per-process device state, so a fleet-wide capture makes no
+        sense — ``oimctl profile --router URL --backend ID`` names the
+        replica to trace.  ``body`` None = GET passthrough (status /
+        ``?download=1`` tarball), bytes = POST start."""
+        parts = urllib.parse.urlsplit(handler.path)
+        query = urllib.parse.parse_qs(parts.query)
+        name = (query.get("backend") or [""])[0]
+        if not name:
+            handler._json(400, {
+                "error": "missing ?backend=<id> — the profiler is "
+                         "per-backend state; pick one replica",
+            })
+            return
+        with self._lock:
+            backend = self._backends.get(name)
+            if backend is None:
+                for b in self._backends.values():
+                    if b.url == name:
+                        backend = b
+                        break
+            known = sorted(self._backends)
+        if backend is None:
+            handler._json(404, {
+                "error": f"no such backend {name!r}",
+                "backends": known,
+            })
+            return
+        passthrough = urllib.parse.urlencode(
+            {k: v for k, v in query.items() if k != "backend"},
+            doseq=True,
+        )
+        url = backend.url + "/debugz/profile" + (
+            "?" + passthrough if passthrough else ""
+        )
+        req = urllib.request.Request(
+            url,
+            data=body,
+            headers=(
+                {"Content-Type": "application/json"}
+                if body is not None else {}
+            ),
+            method="POST" if body is not None else "GET",
+        )
+        try:
+            # Generous timeout: a capture window is ≤60s and the POST
+            # returns immediately (202) — only the download of a large
+            # tarball approaches it.
+            with self._opener.open(req, timeout=75) as resp:
+                payload = resp.read()
+                code = resp.status
+                ctype = resp.headers.get(
+                    "Content-Type", "application/json"
+                )
+                cdisp = resp.headers.get("Content-Disposition", "")
+        except urllib.error.HTTPError as exc:
+            # Backend verdicts (409 capture-in-progress, 404 nothing to
+            # download) pass through verbatim — the router adds routing,
+            # not policy.
+            payload = exc.read()
+            code = exc.code
+            ctype = (
+                exc.headers.get("Content-Type", "application/json")
+                if exc.headers else "application/json"
+            )
+            cdisp = ""
+        except Exception as exc:
+            handler._json(502, {
+                "error": f"backend {backend.id} unreachable: "
+                         f"{getattr(exc, 'reason', exc)}",
+            })
+            return
+        handler.send_response(code)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(payload)))
+        if cdisp:
+            handler.send_header("Content-Disposition", cdisp)
+        handler.end_headers()
+        handler.wfile.write(payload)
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -2593,6 +2688,24 @@ class Router:
                         int(b.load.get("qos_preemptions") or 0)
                         for b in self._backends.values()
                     ),
+                },
+                # Fleet KV-tier flow (ISSUE 18): the hierarchical-KV-
+                # store totals summed from the per-backend load
+                # snapshots (`oimctl kv`'s fleet line and the ROADMAP
+                # item 5 autoscaling input).  .get() throughout:
+                # old-schema publishers simply contribute zeros.
+                "kv": {
+                    key: sum(
+                        int(b.load.get(key) or 0)
+                        for b in self._backends.values()
+                    )
+                    for key in (
+                        "kv_demotions", "kv_promotions",
+                        "kv_demote_bytes", "kv_promote_bytes",
+                        "kv_parks", "kv_unparks", "parked_slots",
+                        "kv_blocks_total", "kv_blocks_free",
+                        "kv_host_blocks_total", "kv_host_blocks_free",
+                    )
                 },
             }
 

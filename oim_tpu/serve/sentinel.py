@@ -1,0 +1,136 @@
+"""Runtime recompile sentinel (ISSUE 18): the jit-guard invariant
+enforced in production.
+
+``tests/test_jit_guard.py`` proves the mechanism at test time — one
+``jax.monitoring`` backend-compile duration event fires per XLA
+compilation, and a warm serve engine pays zero of them under live
+traffic.  This module installs the same listener in a *serving daemon*
+so the invariant is watched on every live backend instead of only in
+CI:
+
+- every compile increments ``oim_xla_compiles_total`` and observes
+  ``oim_xla_compile_seconds`` (warmup compiles included — the plateau
+  after warmup IS the signal);
+- after an engine's warmup finishes it **arms** itself here
+  (``Engine.warmup`` calls :func:`arm`), and from then on any compile
+  emits a ``serve.recompile`` WARNING flight-recorder event carrying
+  the engine's active request/phase context — on a real TPU that
+  compile is 20-40 s of dead air mid-stream, and the event names the
+  request that was on the device when it happened.
+
+The listener runs on whatever thread XLA compiles on — possibly the
+engine driver thread itself, mid-dispatch, while it holds the engine
+lock.  It must therefore never take any engine lock: the request
+context is read through ``engine._sentinel_ctx``, a small dict the
+driver *replaces* (never mutates) at phase boundaries, so a plain
+attribute read is always a consistent snapshot.
+
+Process-global by necessity (``jax.monitoring`` listeners are
+process-global and cannot be unregistered): :func:`install` is
+idempotent, arming is per-engine via a WeakSet, and warmups anywhere in
+the process suppress event emission (a second engine warming in the
+same process legitimately compiles; its compiles are not another
+engine's recompiles).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+from oim_tpu.common import events as _events
+from oim_tpu.common import metrics as _metrics
+
+# One event per XLA backend compilation (same constant the jit-guard
+# suite pins against).
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_state = {"installed": False}
+_armed: "weakref.WeakSet" = weakref.WeakSet()
+# Engines currently inside warmup() anywhere in this process; while
+# nonzero, compiles are counted but serve.recompile stays quiet.
+_warming = [0]
+
+
+def install() -> bool:
+    """Register the backend-compile listener (idempotent — listeners
+    cannot be unregistered, so exactly one is ever installed).  Called
+    at daemon init by oim-serve; tests call it directly.  Without this,
+    :func:`arm` is inert — an embedder that never installs the sentinel
+    sees zero behavior change."""
+    with _lock:
+        if _state["installed"]:
+            return False
+        # Deferred so importing this module (e.g. for arm/disarm from
+        # the engine) never forces jax extension state to initialise.
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        _state["installed"] = True
+        return True
+
+
+def installed() -> bool:
+    with _lock:
+        return _state["installed"]
+
+
+def arm(engine) -> None:
+    """Latch steady state for ``engine``: from now on, any XLA compile
+    in this process emits a ``serve.recompile`` WARNING with the
+    engine's active request context.  ``Engine.warmup`` calls this as
+    its final act; held weakly, so a dropped engine disarms itself."""
+    with _lock:
+        _armed.add(engine)
+
+
+def disarm(engine) -> None:
+    with _lock:
+        _armed.discard(engine)
+
+
+def armed(engine) -> bool:
+    with _lock:
+        return engine in _armed
+
+
+def begin_warmup() -> None:
+    """Engine.warmup() brackets its body with begin/end so a second
+    engine warming in an already-armed process (tests, multi-engine
+    embedders) does not spray serve.recompile events for its own
+    legitimate first compiles."""
+    with _lock:
+        _warming[0] += 1
+
+
+def end_warmup() -> None:
+    with _lock:
+        _warming[0] = max(0, _warming[0] - 1)
+
+
+def _on_duration(event: str, duration: float, **kwargs) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    _metrics.XLA_COMPILES.inc()
+    _metrics.XLA_COMPILE_SECONDS.observe(duration)
+    with _lock:
+        if _warming[0] > 0:
+            return
+        engines = list(_armed)
+    for engine in engines:
+        # Lock-free context read: the driver replaces _sentinel_ctx
+        # wholesale at phase boundaries (atomic under the GIL).
+        ctx = getattr(engine, "_sentinel_ctx", None) or {}
+        try:
+            engine.recompiles += 1
+        except Exception:
+            pass
+        _events.emit(
+            "serve.recompile",
+            component="serve",
+            severity=_events.WARNING,
+            subject=str(getattr(engine, "_engine_label", "")),
+            duration_s=round(float(duration), 6),
+            **ctx,
+        )

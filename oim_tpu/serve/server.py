@@ -104,6 +104,15 @@ API:
                     eviction count — the slow-request forensics surface
                     (doc/operations.md "Request forensics"); the router
                     merges these fleet-wide at /v1/requests
+  POST /debugz/profile {"seconds": S} → start a bounded on-demand
+                    ``jax.profiler`` trace into the flight dir
+                    (one-at-a-time guarded: 409 while one runs; served
+                    BEFORE the error latch — forensics must work on a
+                    wedged backend)
+  GET  /debugz/profile → profiler status JSON; ``?download=1`` streams
+                    the finished trace directory as a .tar.gz
+                    (``oimctl profile`` drives the full cycle; the
+                    router fans out to a named backend)
 
 Fault tolerance (doc/operations.md "Serving failure modes"): every
 generation endpoint takes a relative deadline budget — ``deadline_ms``
@@ -131,12 +140,15 @@ decode).
 from __future__ import annotations
 
 import json
+import os
 import queue
+import tarfile
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from oim_tpu.common import metrics, tracing
+from oim_tpu.common import events, metrics, tracing
 from oim_tpu.serve import disagg
 from oim_tpu.serve.httptls import check_serving_peer, peer_common_name
 from oim_tpu.serve.engine import (
@@ -302,6 +314,13 @@ class ServeServer:
         # a driver-death error is permanent and must survive a clear.
         self._stall_error = False
         self._stop = threading.Event()
+        # On-demand device profiling (ISSUE 18): state dict + worker
+        # thread under their OWN lock — /debugz/profile must never
+        # touch the engine lock or the error latch, so it stays
+        # servable while the backend is wedged.
+        self._profile_lock = threading.Lock()
+        self._profile: dict | None = None
+        self._profile_thread: threading.Thread | None = None
         self.watchdog = (
             StallWatchdog(
                 engine,
@@ -405,6 +424,12 @@ class ServeServer:
                     # "Request forensics").  Merged fleet-wide by the
                     # router at /v1/requests.
                     self._json(200, outer.engine.requests())
+                    return
+                if self.path.split("?", 1)[0] == "/debugz/profile":
+                    # Profiler status / tarball download — own lock
+                    # only, BEFORE the error latch like its forensics
+                    # siblings above.
+                    outer._profile_get(self)
                     return
                 if self.path == "/healthz":
                     if outer.error is not None:
@@ -657,6 +682,34 @@ class ServeServer:
                         "draining": True,
                         "in_flight": outer.engine.in_flight(),
                     })
+                    return
+                if self.path.split("?", 1)[0] == "/debugz/profile":
+                    # On-demand device profiling (ISSUE 18) — BEFORE
+                    # the error latch: capturing a trace from a wedged
+                    # backend is precisely the forensic use case.
+                    try:
+                        length = int(
+                            self.headers.get("Content-Length") or 0
+                        )
+                        doc = (
+                            json.loads(self.rfile.read(length))
+                            if length else {}
+                        )
+                    except ValueError:
+                        self._json(400, {"error": "malformed JSON body"})
+                        return
+                    seconds = doc.get("seconds", 2.0)
+                    if (
+                        not isinstance(seconds, (int, float))
+                        or isinstance(seconds, bool)
+                        or not seconds > 0
+                    ):
+                        self._json(400, {
+                            "error": "seconds must be a positive number"
+                        })
+                        return
+                    code, payload = outer.start_profile(float(seconds))
+                    self._json(code, payload)
                     return
                 if outer.error is not None:
                     # Dead driver thread OR a live stall verdict: fail
@@ -1483,6 +1536,125 @@ class ServeServer:
             return
         handler._json(200, {"import_id": import_id, "rows": rows})
 
+    # -- on-demand device profiling (ISSUE 18) -----------------------------
+
+    def start_profile(self, seconds: float) -> tuple[int, dict]:
+        """Start a bounded ``jax.profiler`` trace into the flight dir;
+        returns (http_code, payload).  One at a time: 409 while a
+        capture runs.  The worker thread tars the trace directory when
+        the window closes so GET ?download=1 can stream one artifact.
+        Own lock only — never the engine lock or the error latch."""
+        seconds = max(0.05, min(float(seconds), 60.0))
+        with self._profile_lock:
+            if (
+                self._profile is not None
+                and self._profile.get("state") == "running"
+            ):
+                return 409, {
+                    "error": "a profile capture is already running",
+                    "profile": dict(self._profile),
+                }
+            out_dir = os.path.join(
+                events.flight_dir(),
+                f"oim-profile-{os.getpid()}-{int(time.time() * 1000)}",
+            )
+            self._profile = {
+                "state": "running",
+                "dir": out_dir,
+                "seconds": seconds,
+                "started_ts": time.time(),
+                "tar": "",
+                "tar_bytes": 0,
+                "error": "",
+            }
+            # Old worker (if any) has finished — its state says so;
+            # join it before replacing the handle so stop() only ever
+            # has one thread to reap.
+            if self._profile_thread is not None:
+                self._profile_thread.join(timeout=5)
+            self._profile_thread = threading.Thread(
+                target=self._run_profile,
+                args=(out_dir, seconds),
+                name="serve-profile",
+                daemon=True,
+            )
+            self._profile_thread.start()
+            return 202, {"ok": True, "profile": dict(self._profile)}
+
+    def _run_profile(self, out_dir: str, seconds: float) -> None:
+        try:
+            # Deferred import: the profiler drags in TensorBoard-ish
+            # machinery that a serving daemon should only pay for when
+            # an operator actually asks for a trace.
+            import jax.profiler as _profiler
+
+            os.makedirs(out_dir, exist_ok=True)
+            _profiler.start_trace(out_dir)
+            try:
+                # Server shutdown aborts the window early rather than
+                # holding stop() hostage for the full duration.
+                self._stop.wait(seconds)
+            finally:
+                _profiler.stop_trace()
+            tar_path = out_dir + ".tar.gz"
+            with tarfile.open(tar_path, "w:gz") as tar:
+                tar.add(out_dir, arcname=os.path.basename(out_dir))
+            size = os.path.getsize(tar_path)
+            with self._profile_lock:
+                if self._profile is not None:
+                    self._profile.update(
+                        state="done", tar=tar_path, tar_bytes=size,
+                    )
+            events.emit(
+                "serve.profile",
+                component="serve",
+                subject=os.path.basename(tar_path),
+                seconds=seconds,
+                path=tar_path,
+                bytes=size,
+            )
+        except Exception as exc:
+            with self._profile_lock:
+                if self._profile is not None:
+                    self._profile.update(
+                        state="failed",
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
+
+    def _profile_get(self, handler) -> None:
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(handler.path).query
+        )
+        with self._profile_lock:
+            doc = dict(self._profile) if self._profile is not None else None
+        if "download" not in query:
+            handler._json(200, {"profile": doc})
+            return
+        if doc is None or doc["state"] != "done":
+            code = 409 if doc is not None and (
+                doc["state"] == "running"
+            ) else 404
+            handler._json(code, {
+                "error": "no finished profile to download",
+                "profile": doc,
+            })
+            return
+        try:
+            with open(doc["tar"], "rb") as f:
+                body = f.read()
+        except OSError as exc:
+            handler._json(410, {"error": f"trace artifact gone: {exc}"})
+            return
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/gzip")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.send_header(
+            "Content-Disposition",
+            f'attachment; filename="{os.path.basename(doc["tar"])}"',
+        )
+        handler.end_headers()
+        handler.wfile.write(body)
+
     def _drive(self) -> None:
         while not self._stop.is_set():
             try:
@@ -1522,3 +1694,10 @@ class ServeServer:
         if self._http_thread.is_alive():
             self._http_thread.join(timeout=10)
         self._driver_thread.join(timeout=10)
+        # _stop above aborts an in-flight capture's wait; reap the
+        # worker so no profile thread outlives the server.
+        with self._profile_lock:
+            profile_thread = self._profile_thread
+            self._profile_thread = None
+        if profile_thread is not None:
+            profile_thread.join(timeout=10)

@@ -231,6 +231,15 @@ class TestLoadSchema:
             "parked_slots": 1,
             "prefix_demotions": 3,
             "prefix_evictions": 1,
+            # KV-tier flow telemetry (ISSUE 18): park/restore counts
+            # plus per-direction wall seconds and bytes — the `oimctl
+            # kv` fleet view's bandwidth denominators.
+            "kv_parks": 2,
+            "kv_unparks": 1,
+            "kv_demote_seconds": 0.25,
+            "kv_promote_seconds": 0.125,
+            "kv_demote_bytes": 98304,
+            "kv_promote_bytes": 65536,
             "token_rate": 41.5,
             "shed_queue_full": 1,
             "shed_deadline": 0,
@@ -266,6 +275,14 @@ class TestLoadSchema:
         # Publishers predating the QoS fields (ISSUE 16) decode to
         # empty tenant tables, not errors.
         assert decoded["tenants"] == {} and decoded["qos_preemptions"] == 0
+        # Publishers predating the KV-tier flow fields (ISSUE 18)
+        # decode to zero flow, not errors — the mixed-fleet guarantee
+        # `oimctl kv` leans on.
+        assert decoded["kv_parks"] == 0 and decoded["kv_unparks"] == 0
+        assert decoded["kv_demote_seconds"] == 0.0
+        assert decoded["kv_promote_seconds"] == 0.0
+        assert decoded["kv_demote_bytes"] == 0
+        assert decoded["kv_promote_bytes"] == 0
 
     def test_path_helpers(self):
         assert load_key("serve.a") == "load/serve.a"

@@ -655,3 +655,97 @@ def test_prefix_residency_instruments_render():
         'oim_serve_prefix_bytes_saved_total{engine="e0",'
         'source="fetched"}' in text
     )
+
+
+def test_perf_forensics_instruments_render():
+    """The performance-forensics instruments (ISSUE 18: process-wide
+    XLA compile counters, the shared ring-dropped counter, KV-tier
+    flow bytes + per-tier residency, slow captures) are shared
+    definitions in oim_tpu/common/metrics.py and render in standard
+    exposition text."""
+    before = {
+        "compiles": metrics.XLA_COMPILES.value(),
+        "compile_obs": metrics.XLA_COMPILE_SECONDS.count(),
+        "ring": metrics.SERVE_REQUEST_RING_DROPPED.value("e0"),
+        "demote": metrics.SERVE_KV_TIER_BYTES.value("demote"),
+        "slow": metrics.SERVE_SLOW_CAPTURES.value("e0", "e2e"),
+    }
+    metrics.XLA_COMPILES.inc()
+    metrics.XLA_COMPILE_SECONDS.observe(0.5)
+    metrics.SERVE_REQUEST_RING_DROPPED.inc("e0")
+    metrics.SERVE_KV_TIER_BYTES.inc("demote", by=4096.0)
+    metrics.SERVE_KV_TIER_BYTES.inc("promote", by=2048.0)
+    metrics.SERVE_KV_TIER_RESIDENT.set(8192.0, "e0", "device")
+    metrics.SERVE_KV_TIER_RESIDENT.set(1024.0, "e0", "host")
+    metrics.SERVE_SLOW_CAPTURES.inc("e0", "e2e")
+    assert metrics.XLA_COMPILES.value() == before["compiles"] + 1
+    assert (
+        metrics.XLA_COMPILE_SECONDS.count() == before["compile_obs"] + 1
+    )
+    assert (
+        metrics.SERVE_REQUEST_RING_DROPPED.value("e0")
+        == before["ring"] + 1
+    )
+    assert (
+        metrics.SERVE_KV_TIER_BYTES.value("demote")
+        == before["demote"] + 4096.0
+    )
+    assert (
+        metrics.SERVE_SLOW_CAPTURES.value("e0", "e2e")
+        == before["slow"] + 1
+    )
+    text = metrics.registry().render()
+    assert "# TYPE oim_xla_compiles_total counter" in text
+    assert "# TYPE oim_xla_compile_seconds histogram" in text
+    assert "oim_xla_compile_seconds_bucket" in text
+    assert "# TYPE oim_serve_request_ring_dropped_total counter" in text
+    assert 'oim_serve_request_ring_dropped_total{engine="e0"}' in text
+    assert "# TYPE oim_serve_kv_tier_bytes_total counter" in text
+    assert 'oim_serve_kv_tier_bytes_total{op="demote"} 4096' in text
+    assert 'oim_serve_kv_tier_bytes_total{op="promote"} 2048' in text
+    assert "# TYPE oim_serve_kv_tier_resident_bytes gauge" in text
+    assert (
+        'oim_serve_kv_tier_resident_bytes{engine="e0",tier="device"} 8192'
+        in text
+    )
+    assert (
+        'oim_serve_kv_tier_resident_bytes{engine="e0",tier="host"} 1024'
+        in text
+    )
+    assert "# TYPE oim_serve_slow_captures_total counter" in text
+    assert (
+        'oim_serve_slow_captures_total{engine="e0",trigger="e2e"}' in text
+    )
+
+
+def test_process_self_telemetry_installs_and_renders():
+    """install_process_metrics() (ISSUE 18) is idempotent and wires the
+    RSS/CPU/threads gauges + GC pause counters onto the default
+    registry — live values, since every daemon's MetricsServer calls
+    it at start()."""
+    import gc
+
+    metrics.install_process_metrics()
+    callbacks_after_first = len(gc.callbacks)
+    metrics.install_process_metrics()  # second call must be a no-op
+    assert len(gc.callbacks) == callbacks_after_first
+    text = metrics.registry().render()
+    assert "# TYPE oim_process_resident_bytes gauge" in text
+    assert "# TYPE oim_process_cpu_seconds gauge" in text
+    assert "# TYPE oim_process_threads gauge" in text
+    assert "# TYPE oim_process_gc_pause_seconds_total counter" in text
+
+    def rendered_value(name: str) -> float:
+        for line in text.splitlines():
+            if line.startswith(name + " "):
+                return float(line.split()[1])
+        raise AssertionError(f"{name} not rendered")
+
+    # A test process certainly has memory, CPU time, and >= 1 thread.
+    assert rendered_value("oim_process_resident_bytes") > 0
+    assert rendered_value("oim_process_cpu_seconds") > 0
+    assert rendered_value("oim_process_threads") >= 1
+    # A forced collection books a (tiny but nonzero-count) pause.
+    pauses = metrics.PROCESS_GC_COLLECTIONS.value("2")
+    gc.collect()
+    assert metrics.PROCESS_GC_COLLECTIONS.value("2") >= pauses + 1

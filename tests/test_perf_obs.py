@@ -1,0 +1,784 @@
+"""Performance forensics (ISSUE 18).
+
+The production forensics layer end to end: the runtime recompile
+sentinel (silent across the warm decode/admission/CoW/migrate matrix,
+fires WITH request context on a forced fresh compile), tail-latency
+auto-capture artifacts whose phase sums reconcile with the ring entry,
+the on-demand ``/debugz/profile`` device-profiling cycle + ``oimctl
+profile`` download, KV-tier flow telemetry from engine byte counters
+through ``load/serve.<id>`` to the router's fleet ``kv`` aggregate and
+``oimctl kv`` (old-schema publishers tolerated), and error-latch
+survivability of every forensics endpoint — real engines on tiny
+models behind real HTTP listeners, the serve-chaos harness's stance.
+
+Warmed engines are module-shared (a warmup is the expensive part of
+every scenario here); tests that mutate shared state work in deltas.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tarfile
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oim_tpu.cli import oimctl
+from oim_tpu.common import events, metrics
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.serve import Engine, GenRequest, Router, disagg, sentinel
+from oim_tpu.serve.engine import RequestFailedError
+from oim_tpu.serve.server import ServeServer
+
+pytestmark = pytest.mark.perf_obs
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+# Overflow-tier pressure geometry (the test_serve_overflow recipe): a
+# 10-block pool where one cached entry + three concurrent worst cases
+# force the planner to demote.
+HOST_BASE = dict(
+    n_slots=4, max_len=64, chunk=4, prompt_buckets=(16, 32),
+    kv_block=8, kv_blocks=10, prefix_cache_size=2,
+    kv_host_bytes=1 << 20,
+)
+
+# The sentinel is process-global (jax.monitoring listeners cannot be
+# unregistered); installing once at import mirrors daemon init.
+sentinel.install()
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _prompt(seed: int, n: int) -> list[int]:
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG["vocab_size"], size=n).tolist()
+
+
+def _make_engine(setup, *, paged: bool = True, depth: int = 2, **kw):
+    cfg, params = setup
+    kwargs = dict(
+        n_slots=3, max_len=64, chunk=4, prompt_buckets=(16, 32),
+        prefix_cache_size=2, pipeline_depth=depth,
+    )
+    if paged:
+        kwargs["kv_block"] = 8
+    kwargs.update(kw)
+    return Engine(params, cfg, **kwargs)
+
+
+@pytest.fixture(scope="module")
+def warm_paged(setup):
+    """A warmed paged engine shared by the sentinel + slow-capture
+    scenarios (tests re-arm it when the sentinel story needs it)."""
+    engine = _make_engine(setup).warmup()
+    sentinel.disarm(engine)
+    yield engine
+    sentinel.disarm(engine)
+
+
+@pytest.fixture(scope="module")
+def warm_paged_b(setup):
+    """The migration target twin."""
+    engine = _make_engine(setup).warmup()
+    sentinel.disarm(engine)
+    yield engine
+    sentinel.disarm(engine)
+
+
+@pytest.fixture(scope="module")
+def host_engines(setup):
+    """Two warmed host-tier engines: one driven directly for the byte
+    accounting, both then fronted by ServeServers for the fleet view."""
+    cfg, params = setup
+    engines = [Engine(params, cfg, **HOST_BASE).warmup() for _ in range(2)]
+    for e in engines:
+        sentinel.disarm(e)
+    return engines
+
+
+def _steady_traffic(engine: Engine) -> None:
+    """The jit-guard traffic mix: decode chunks, a mid-stream
+    admission, and a prefix hit whose length is NOT block-aligned so
+    the paged planner takes the CoW path too."""
+    system = _prompt(1, 12)
+    r1 = engine.submit(GenRequest(
+        tokens=system, max_new_tokens=10, cache_prefix=True,
+    ))
+    engine.step()
+    engine.step()
+    r2 = engine.submit(GenRequest(
+        tokens=_prompt(2, 6), max_new_tokens=6, temperature=0.8, seed=7,
+    ))
+    engine.step()
+    r3 = engine.submit(GenRequest(
+        tokens=system + _prompt(3, 5), max_new_tokens=5,
+    ))
+    results = engine.run()
+    assert len(results[r1]) == 10
+    assert len(results[r2]) == 6
+    assert len(results[r3]) == 5
+
+
+def _recompile_events(subject: str = "") -> list[events.Event]:
+    out = [e for e in events.all_events() if e.kind == "serve.recompile"]
+    if subject:
+        out = [e for e in out if e.subject == subject]
+    return out
+
+
+def _url(server: ServeServer) -> str:
+    return f"http://{server.host}:{server.port}"
+
+
+def _get(base: str, path: str, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _post(base: str, path: str, payload, timeout=30):
+    body = (
+        payload if isinstance(payload, bytes)
+        else json.dumps(payload).encode()
+    )
+    req = urllib.request.Request(
+        base + path, body, {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _wait_profile_done(base: str, deadline_s=30.0) -> dict:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        _, doc = _get(base, "/debugz/profile")
+        prof = doc.get("profile") or {}
+        if prof.get("state") in ("done", "failed"):
+            return prof
+        time.sleep(0.05)
+    raise AssertionError("profile capture never finished")
+
+
+# ---------------------------------------------------------------------------
+# The runtime recompile sentinel
+
+
+class TestRecompileSentinel:
+    def test_warm_steady_state_sentinel_silent(self, warm_paged, request):
+        """THE production pin: a warmed (armed) engine emits zero
+        serve.recompile events across decode chunks, a mid-stream
+        admission, and a CoW-triggering prefix hit."""
+        engine = warm_paged
+        sentinel.arm(engine)
+        request.addfinalizer(lambda: sentinel.disarm(engine))
+        assert sentinel.armed(engine)
+        events.clear_all()
+        before = engine.recompiles
+        _steady_traffic(engine)
+        assert _recompile_events(engine._engine_label) == []
+        assert engine.recompiles == before
+        assert engine.stats()["recompiles"] == before
+
+    def test_warm_migrate_cycle_sentinel_silent(
+        self, warm_paged, warm_paged_b, request
+    ):
+        """Migration rides warm programs on BOTH backends: the full
+        suspend→export→import→resume cycle between two armed engines
+        emits zero serve.recompile events."""
+        src, dst = warm_paged, warm_paged_b
+        sentinel.arm(src)
+        sentinel.arm(dst)
+        request.addfinalizer(lambda: sentinel.disarm(src))
+        request.addfinalizer(lambda: sentinel.disarm(dst))
+
+        def cycle(seed: int) -> None:
+            got: list = []
+            rid = src.submit(
+                GenRequest(tokens=_prompt(seed, 12), max_new_tokens=10),
+                on_token=lambda t, lp: got.append(t) if t is not None
+                else None,
+            )
+            for _ in range(40):
+                src.step()
+                if got:
+                    break
+            src.begin_migrate_out()
+            src.run()
+            with pytest.raises(RequestFailedError):
+                src.result(rid, timeout=5)
+            manifest, arrays = src.export_slot(rid)
+            body = disagg.pack_transfer(manifest, arrays)
+            import_id, _rows, slot = dst.import_slot(
+                *disagg.unpack_transfer(body)
+            )
+            crid = dst.submit(GenRequest(
+                tokens=list(manifest["prompt_tokens"])
+                + list(manifest["tokens"]),
+                max_new_tokens=10 - len(manifest["tokens"]),
+                kv_import=import_id,
+                sample_base=slot["sample_base"],
+            ))
+            dst.run()
+            assert dst.result(crid, timeout=5)
+            src.release_migrated(rid)
+            src._draining = False
+            src._migrate_out = False
+
+        cycle(41)  # shake out any first-use program
+        events.clear_all()
+        cycle(42)
+        assert _recompile_events(src._engine_label) == []
+        assert _recompile_events(dst._engine_label) == []
+
+    def test_sentinel_fires_with_request_context(self, warm_paged, request):
+        """The negative control: a fresh jit in an armed process IS a
+        steady-state recompile — the event carries the engine's active
+        phase/rids context, the engine's counter moves, and the
+        process-wide compile metrics observe it."""
+        engine = warm_paged
+        sentinel.arm(engine)
+        request.addfinalizer(lambda: sentinel.disarm(engine))
+        _steady_traffic(engine)  # leaves a decode-phase context behind
+        ctx = engine._sentinel_ctx
+        assert ctx.get("phase") in ("admit", "decode")
+        events.clear_all()
+        compiles_before = metrics.XLA_COMPILES.value()
+        obs_before = metrics.XLA_COMPILE_SECONDS.count()
+        recompiles_before = engine.recompiles
+        jax.jit(lambda x: x * 3 + 2)(jnp.arange(5))
+        fired = _recompile_events(engine._engine_label)
+        assert fired, "sentinel missed a fresh compile in an armed process"
+        ev = fired[0]
+        assert ev.severity == events.WARNING
+        assert ev.fields["phase"] == ctx["phase"]
+        assert "rids" in ev.fields and ev.fields["rids"]
+        assert ev.fields["duration_s"] >= 0
+        assert engine.recompiles > recompiles_before
+        assert metrics.XLA_COMPILES.value() > compiles_before
+        assert metrics.XLA_COMPILE_SECONDS.count() > obs_before
+
+    def test_sibling_warmup_does_not_false_positive(
+        self, setup, warm_paged, request
+    ):
+        """A second engine warming in an armed process legitimately
+        compiles; the process-wide warmup bracket keeps those compiles
+        out of the armed engine's recompile story."""
+        armed = warm_paged
+        sentinel.arm(armed)
+        request.addfinalizer(lambda: sentinel.disarm(armed))
+        # Construct BEFORE the window: __init__'s own op dispatches
+        # (cache allocation) compile too, and they are bring-up, not
+        # warmup — the bracket under test covers the warmup recipe.
+        sibling = _make_engine(setup, paged=False, depth=1)
+        events.clear_all()
+        recompiles_before = armed.recompiles
+        sibling.warmup()
+        request.addfinalizer(lambda: sentinel.disarm(sibling))
+        # warmup()'s final act is arming the warmed engine itself.
+        assert sentinel.armed(sibling)
+        assert _recompile_events() == [], (
+            "sibling warmup compiles leaked serve.recompile events"
+        )
+        assert armed.recompiles == recompiles_before
+
+
+# ---------------------------------------------------------------------------
+# Tail-latency auto-capture
+
+
+class TestSlowCapture:
+    @pytest.fixture()
+    def slow_engine(self, warm_paged, monkeypatch, tmp_path):
+        """The shared warm engine with capture knobs + a private
+        flight dir for this test (flight_dir() prefers the crash
+        hook's configured dir; pin it so artifacts land here whatever
+        earlier suites configured)."""
+        monkeypatch.setitem(events._crash_state, "dir", str(tmp_path))
+        monkeypatch.setattr(warm_paged, "_slow_last_capture", 0.0)
+        return warm_paged
+
+    def test_artifact_reconciles_with_ring_entry(
+        self, slow_engine, monkeypatch, tmp_path
+    ):
+        """Acceptance (c): a deliberately slow request auto-dumps an
+        artifact whose per-chunk phase sums reconcile with its ring
+        entry, beside a stats snapshot and the ring neighborhood."""
+        engine = slow_engine
+        monkeypatch.setattr(engine, "_slow_e2e_s", 1e-6)
+        monkeypatch.setattr(engine, "_slow_interval_s", 0.0)
+        events.clear_all()
+        captures_before = engine.slow_captures
+        m_before = metrics.SERVE_SLOW_CAPTURES.value(
+            engine._engine_label, "e2e"
+        )
+        rid = engine.submit(GenRequest(
+            tokens=_prompt(5, 6), max_new_tokens=9, tenant="user.slow",
+        ))
+        engine.run()
+        engine.result(rid, timeout=5)
+        deadline = time.monotonic() + 5
+        caps: list = []
+        while not caps and time.monotonic() < deadline:
+            caps = sorted(tmp_path.glob("oim-slowcap-*.json"))
+            time.sleep(0.01)
+        assert caps, "no slow-capture artifact written"
+        artifact = json.loads(caps[0].read_text())
+        assert artifact["kind"] == "slow_capture"
+        assert artifact["trigger"] == "e2e"
+        entry = artifact["entry"]
+        assert entry["rid"] == rid and entry["tenant"] == "user.slow"
+        # Phase-sum reconciliation: the artifact's chunk walls are the
+        # ring entry's decode phase, chunk by chunk.
+        assert len(artifact["chunks"]) == entry["chunks"]
+        chunk_sum = sum(c["wall_s"] for c in artifact["chunks"])
+        assert abs(chunk_sum - entry["decode_s"]) <= 1e-3
+        total = (
+            entry["queue_s"] + entry["admit_s"] + entry["prefill_s"]
+            + entry["decode_s"] + entry["stream_s"]
+        )
+        assert total <= entry["e2e_s"] + 1e-3
+        # The stats snapshot and ring neighborhood ride along, and the
+        # entry is IN its own neighborhood.
+        assert (
+            artifact["stats"]["kv_blocks_total"]
+            == engine.stats()["kv_blocks_total"]
+        )
+        assert "ring_dropped" in artifact["stats"]
+        assert any(e["rid"] == rid for e in artifact["ring"])
+        # Event + counters point at the artifact.
+        evs = [
+            e for e in events.all_events()
+            if e.kind == "serve.slow_capture"
+        ]
+        assert evs and evs[0].severity == events.WARNING
+        assert evs[0].fields["path"] == str(caps[0])
+        assert evs[0].fields["trigger"] == "e2e"
+        assert engine.slow_captures == captures_before + 1
+        assert engine.stats()["slow_captures"] == engine.slow_captures
+        assert metrics.SERVE_SLOW_CAPTURES.value(
+            engine._engine_label, "e2e"
+        ) == m_before + 1
+
+    def test_rate_limit_one_artifact_per_interval(
+        self, slow_engine, monkeypatch, tmp_path
+    ):
+        engine = slow_engine
+        monkeypatch.setattr(engine, "_slow_e2e_s", 1e-6)
+        monkeypatch.setattr(engine, "_slow_interval_s", 60.0)
+        captures_before = engine.slow_captures
+        for seed in (6, 7, 8):
+            rid = engine.submit(GenRequest(
+                tokens=_prompt(seed, 4), max_new_tokens=3,
+            ))
+            engine.run()
+            engine.result(rid, timeout=5)
+        deadline = time.monotonic() + 5
+        while (
+            engine.slow_captures == captures_before
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert engine.slow_captures == captures_before + 1, (
+            "rate limit did not hold"
+        )
+        assert len(list(tmp_path.glob("oim-slowcap-*.json"))) == 1
+
+    def test_tpot_ewma_trigger(self, slow_engine, monkeypatch, tmp_path):
+        """The relative trigger: TPOT over a tiny multiple of the live
+        token-rate EWMA captures without any absolute threshold."""
+        engine = slow_engine
+        monkeypatch.setattr(engine, "_slow_tpot_mult", 1e-6)
+        monkeypatch.setattr(engine, "_slow_interval_s", 0.0)
+        # The EWMA is live (seeded by earlier traffic); rate 0 cannot
+        # trigger, so make sure at least two requests run.
+        for seed in (9, 10):
+            rid = engine.submit(GenRequest(
+                tokens=_prompt(seed, 4), max_new_tokens=6,
+            ))
+            engine.run()
+            engine.result(rid, timeout=5)
+        deadline = time.monotonic() + 5
+        caps: list = []
+        while not caps and time.monotonic() < deadline:
+            caps = sorted(tmp_path.glob("oim-slowcap-*.json"))
+            time.sleep(0.01)
+        assert caps, "tpot trigger never captured"
+        assert json.loads(caps[0].read_text())["trigger"] == "tpot"
+
+    def test_knob_validation(self, setup):
+        with pytest.raises(ValueError):
+            _make_engine(setup, slow_capture_e2e_s=-1.0)
+        with pytest.raises(ValueError):
+            _make_engine(setup, slow_capture_tpot_mult=-0.5)
+        with pytest.raises(ValueError):
+            _make_engine(setup, slow_capture_interval_s=-1.0)
+
+    def test_ctor_knobs_thread_through(self, setup):
+        engine = _make_engine(
+            setup, paged=False, slow_capture_e2e_s=2.5,
+            slow_capture_tpot_mult=8.0, slow_capture_interval_s=30.0,
+        )
+        assert engine._slow_e2e_s == 2.5
+        assert engine._slow_tpot_mult == 8.0
+        assert engine._slow_interval_s == 30.0
+
+
+# ---------------------------------------------------------------------------
+# On-demand device profiling
+
+
+class TestProfileEndpoint:
+    @pytest.fixture(scope="class")
+    def server(self, setup, tmp_path_factory):
+        flight = tmp_path_factory.mktemp("profile-flight")
+        saved = events._crash_state["dir"]
+        events._crash_state["dir"] = str(flight)
+        server = ServeServer(_make_engine(setup, paged=False)).start()
+        sentinel.disarm(server.engine)
+        yield server
+        server.stop()
+        events._crash_state["dir"] = saved
+
+    def test_profile_cycle_and_download(self, server, tmp_path):
+        """Acceptance (b): POST starts a bounded capture (409 while
+        running), the finished state names a tarball, and ?download=1
+        streams a readable archive holding real profiler artifacts."""
+        base = _url(server)
+        code, doc = _post(base, "/debugz/profile", {"seconds": 0.5})
+        assert code == 202 and doc["ok"]
+        assert doc["profile"]["state"] == "running"
+        # One at a time: a second start while running is refused.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(base, "/debugz/profile", {"seconds": 0.2})
+        assert err.value.code == 409
+        prof = _wait_profile_done(base)
+        assert prof["state"] == "done", prof
+        assert prof["tar"].endswith(".tar.gz") and prof["tar_bytes"] > 0
+        req = urllib.request.Request(base + "/debugz/profile?download=1")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers["Content-Type"] == "application/gzip"
+            assert "attachment" in resp.headers["Content-Disposition"]
+            data = resp.read()
+        assert len(data) == prof["tar_bytes"]
+        out = tmp_path / "download.tar.gz"
+        out.write_bytes(data)
+        with tarfile.open(out) as tar:
+            names = tar.getnames()
+        assert names, "empty profile tarball"
+        assert any(".xplane.pb" in n for n in names), names
+
+    def test_bad_requests_rejected(self, server):
+        base = _url(server)
+        for payload in (b"not json", b'{"seconds": "soon"}',
+                        b'{"seconds": -1}', b'{"seconds": true}'):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(base, "/debugz/profile", payload)
+            assert err.value.code == 400, payload
+        # Status GET is always 200, capture or not.
+        code, _doc = _get(base, "/debugz/profile")
+        assert code == 200
+
+    def test_oimctl_profile_direct_and_via_router(
+        self, server, tmp_path, capsys
+    ):
+        """The CLI drives the full cycle — start, poll, download —
+        against a live backend, directly and through the router's
+        per-backend proxy."""
+        out_dir = tmp_path / "cli"
+        assert oimctl.main([
+            "profile", "--serve", _url(server),
+            "--seconds", "0.3", "--out", str(out_dir),
+        ]) == 0
+        printed = capsys.readouterr().out
+        assert "wrote " in printed
+        tars = list(out_dir.glob("*.tar.gz"))
+        assert len(tars) == 1 and tars[0].stat().st_size > 0
+        with tarfile.open(tars[0]) as tar:
+            assert tar.getnames()
+
+        router = Router(
+            backends=(_url(server),), health_interval=0.2,
+        ).start()
+        try:
+            rbase = f"http://{router.host}:{router.port}"
+            out_dir2 = tmp_path / "cli-router"
+            assert oimctl.main([
+                "profile", "--router", rbase, "--backend", _url(server),
+                "--seconds", "0.3", "--out", str(out_dir2),
+            ]) == 0
+            assert list(out_dir2.glob("*.tar.gz"))
+            # Unknown backend: the proxy 404s with the known set.
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(
+                    rbase, "/debugz/profile?backend=nope",
+                    {"seconds": 0.2},
+                )
+            assert err.value.code == 404
+            # Missing ?backend= is a caller error, not a fan-out.
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(rbase, "/debugz/profile", {"seconds": 0.2})
+            assert err.value.code == 400
+        finally:
+            router.stop()
+
+    def test_oimctl_profile_arg_validation(self, capsys):
+        assert oimctl.main([
+            "profile", "--serve", "http://x:1", "--router", "http://y:2",
+        ]) == 2
+        assert oimctl.main(["profile", "--router", "http://y:2"]) == 2
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# Error-latch survivability (the forensics endpoints outlive the engine)
+
+
+class TestLatchSurvival:
+    def test_forensics_served_while_error_latched(self, warm_paged):
+        """A latched driver error 503s serving traffic — but the
+        forensics surfaces keep answering 200: a crashed driver is
+        exactly when an operator needs them."""
+        server = ServeServer(warm_paged).start()
+        try:
+            base = _url(server)
+            rid = server.engine.submit(GenRequest(
+                tokens=_prompt(11, 4), max_new_tokens=2,
+            ))
+            server.engine.result(rid, timeout=30)
+            with server._error_lock:
+                server.error = "injected: driver dead"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(base, "/v1/generate", {
+                    "tokens": [1, 2], "max_new_tokens": 1,
+                })
+            assert err.value.code == 503  # the latch IS set
+            code, doc = _get(base, "/debugz/requests")
+            assert code == 200
+            assert any(e["rid"] == rid for e in doc["requests"])
+            code, doc = _get(base, "/debugz/profile")
+            assert code == 200 and "profile" in doc
+            # ... while /healthz correctly reports the latched death.
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(base, "/healthz")
+            assert err.value.code == 503
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Shared ring-dropped counter (satellite 1)
+
+
+class TestRingDroppedMetric:
+    def test_ring_eviction_increments_shared_counter(self, setup):
+        engine = _make_engine(setup, paged=False, request_ring=2)
+        label = engine._engine_label
+        before = metrics.SERVE_REQUEST_RING_DROPPED.value(label)
+        for seed in (12, 13, 14):
+            rid = engine.submit(GenRequest(
+                tokens=_prompt(seed, 3), max_new_tokens=1,
+            ))
+            engine.run()
+            engine.result(rid, timeout=5)
+        deadline = time.monotonic() + 5
+        while (
+            engine.stats()["ring_dropped"] < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        dropped = engine.stats()["ring_dropped"]
+        assert dropped >= 1
+        assert (
+            metrics.SERVE_REQUEST_RING_DROPPED.value(label)
+            == before + dropped
+        )
+        assert (
+            f'oim_serve_request_ring_dropped_total{{engine="{label}"}}'
+            in metrics.registry().render()
+        )
+
+
+# ---------------------------------------------------------------------------
+# KV-tier flow telemetry: engine bytes → load → router fleet → oimctl kv
+
+
+class TestKvTierTelemetry:
+    def test_byte_accounting_matches_block_moves(self, host_engines):
+        """Every demote/park/promote/unpark site books bytes beside its
+        block count, so the totals stay in lockstep: bytes moved ==
+        blocks moved x the engine's block stride."""
+        engine = host_engines[0]
+        # Seed a cached entry, then overflow the 10-block pool so the
+        # planner demotes it; a later hit promotes it back.
+        base_tokens = _prompt(20, 16)
+        rid = engine.submit(GenRequest(
+            tokens=base_tokens, max_new_tokens=2, cache_prefix=True,
+        ))
+        engine.run()
+        engine.result(rid, timeout=5)
+        rids = [
+            engine.submit(GenRequest(
+                tokens=_prompt(21 + i, 16), max_new_tokens=24,
+            ))
+            for i in range(3)
+        ]
+        engine.run()
+        for r in rids:
+            engine.result(r, timeout=5)
+        rid = engine.submit(GenRequest(
+            tokens=base_tokens + _prompt(25, 4), max_new_tokens=2,
+        ))
+        engine.run()
+        engine.result(rid, timeout=5)
+        s = engine.stats()
+        assert s["kv_demotions"] > 0, "pressure did not demote"
+        assert s["kv_promotions"] > 0, "hit did not promote"
+        assert engine._block_bytes > 0
+        assert s["kv_demote_bytes"] == s["kv_demotions"] * engine._block_bytes
+        assert (
+            s["kv_promote_bytes"] == s["kv_promotions"] * engine._block_bytes
+        )
+        # The same fields ride Engine.load() for the leased load key...
+        load = engine.load()
+        for key in ("kv_parks", "kv_unparks", "kv_demote_seconds",
+                    "kv_promote_seconds", "kv_demote_bytes",
+                    "kv_promote_bytes"):
+            assert key in load, key
+        assert load["kv_demote_bytes"] == s["kv_demote_bytes"]
+        assert load["kv_demote_seconds"] >= 0.0
+        # ...and the shared flow/residency instruments saw the moves.
+        assert metrics.SERVE_KV_TIER_BYTES.value("demote") > 0
+        text = metrics.registry().render()
+        label = engine._engine_label
+        assert (
+            f'oim_serve_kv_tier_resident_bytes{{engine="{label}",'
+            f'tier="device"}}' in text
+        )
+        assert (
+            f'oim_serve_kv_tier_resident_bytes{{engine="{label}",'
+            f'tier="host"}}' in text
+        )
+
+    def test_fleet_view_through_router_and_oimctl(
+        self, host_engines, capsys
+    ):
+        """Acceptance (d): two live backends through the router — the
+        stats ``kv`` aggregate sums per-backend flow, and ``oimctl kv``
+        renders per-backend tier occupancy off it."""
+        servers = [ServeServer(e).start() for e in host_engines]
+        router = Router(
+            backends=tuple(_url(s) for s in servers),
+            health_interval=0.2,
+        ).start()
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if len(router.healthy_backends()) == 2:
+                    break
+                time.sleep(0.05)
+            base = f"http://{router.host}:{router.port}"
+            _post(base, "/v1/generate", {
+                "tokens": _prompt(30, 6), "max_new_tokens": 3,
+            }, timeout=120)
+            # Backends are optimistically healthy before the first
+            # probe tick lands their /v1/info load mirror — wait for
+            # the aggregate to see both.
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                _, stats = _get(base, "/v1/stats")
+                if stats.get("kv", {}).get("kv_blocks_total", 0) >= 20:
+                    break
+                time.sleep(0.05)
+            assert "kv" in stats
+            for key in ("kv_demotions", "kv_promotions",
+                        "kv_demote_bytes", "kv_promote_bytes",
+                        "kv_parks", "kv_unparks", "parked_slots",
+                        "kv_blocks_total", "kv_blocks_free",
+                        "kv_host_blocks_total", "kv_host_blocks_free"):
+                assert key in stats["kv"], key
+            # The fleet aggregate is the per-backend sum.
+            assert stats["kv"]["kv_blocks_total"] == sum(
+                (b.get("load") or {}).get("kv_blocks_total", 0)
+                for b in stats["backends"].values()
+            )
+            assert stats["kv"]["kv_blocks_total"] > 0
+            # The byte-accounting test's demote flow (engine 0) is in
+            # the aggregate: bytes summed fleet-wide.
+            assert stats["kv"]["kv_demote_bytes"] >= (
+                host_engines[0].kv_demote_bytes
+            )
+            assert oimctl.main(["kv", "--router", base]) == 0
+            out = capsys.readouterr().out
+            assert "BACKEND" in out and "DEV u/t" in out
+            assert "fleet: demoted" in out
+            assert out.count("yes") >= 2  # both backends rendered
+            # Single-backend mode reads the same fields off /v1/info.
+            assert oimctl.main(
+                ["kv", "--serve", _url(servers[0])]
+            ) == 0
+            assert "BACKEND" in capsys.readouterr().out
+        finally:
+            router.stop()
+            for s in servers:
+                s.stop()
+
+    def test_print_kv_tolerates_old_schema_rows(self, capsys):
+        """A pre-ISSUE-18 publisher's load row (none of the new
+        fields) renders as zeros/dashes, never a crash — the
+        mixed-fleet contract."""
+        old_row = {
+            "kv_blocks_total": 8, "kv_blocks_free": 3,
+            "kv_demotions": 2,  # old field without the byte/secs pair
+        }
+        oimctl._print_kv([
+            ("serve.old", True, old_row),
+            ("serve.empty", False, {}),
+        ], fleet_line="fleet: x")
+        out = capsys.readouterr().out
+        lines = out.splitlines()
+        assert len(lines) == 4  # header + 2 rows + fleet line
+        assert "serve.old" in lines[1] and "5/8" in lines[1]
+        assert "serve.empty" in lines[2] and "NO" in lines[2]
+        assert lines[3] == "fleet: x"
+
+    def test_load_schema_round_trip_tolerant_decode(self):
+        """Satellite 6: the new flow fields survive the registry
+        encode/decode round trip, and an OLD publisher's payload
+        (fields absent) decodes to zero flow — never None."""
+        from oim_tpu.autoscale.load import decode_load, encode_load
+
+        new = {
+            "queue_depth": 1, "kv_parks": 3, "kv_unparks": 2,
+            "kv_demote_seconds": 0.5, "kv_promote_seconds": 0.25,
+            "kv_demote_bytes": 4096, "kv_promote_bytes": 2048,
+        }
+        decoded = decode_load(encode_load(new))
+        for key, val in new.items():
+            assert decoded[key] == val
+        old_payload = json.dumps({"queue_depth": 2, "total_slots": 4})
+        decoded = decode_load(old_payload)
+        assert decoded is not None and decoded["queue_depth"] == 2
+        assert decoded["kv_parks"] == 0
+        assert decoded["kv_demote_bytes"] == 0
+        assert decoded["kv_demote_seconds"] == 0.0
+        # Type discipline still holds on the new fields.
+        assert decode_load(json.dumps({"kv_demote_bytes": "many"})) is None
