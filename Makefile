@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-serve-overflow test-serve-migrate test-qos test-autoscale test-jit-guard test-perf-obs lint lint-metrics lint-jax agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-serve-overflow test-serve-migrate test-qos test-autoscale test-jit-guard test-perf-obs lint lint-metrics lint-jax lint-conc agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -70,9 +70,9 @@ test-observability:
 # ownership stays clean in the analyzer, not grandfathered in baseline.
 test-serve:
 	$(PYTHON) -m tools.oimlint \
-	  --passes lock-discipline,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
+	  --passes lock-discipline,lock-order,atomicity,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
 	  --roots oim_tpu/serve
-	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	timeout -k 10 120 env JAX_PLATFORMS=cpu OIM_LOCK_SANITIZER=1 $(PYTHON) -m pytest \
 	  tests/test_serve_pipeline.py -q -m "not slow" -p no:cacheprovider
 
 # Paged KV cache (ISSUE 10): the paged-vs-dense token-identical
@@ -151,9 +151,9 @@ test-serve-overflow:
 # grandfathered in baseline.
 test-qos:
 	$(PYTHON) -m tools.oimlint \
-	  --passes lock-discipline,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
+	  --passes lock-discipline,lock-order,atomicity,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
 	  --roots oim_tpu/qos,oim_tpu/serve
-	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	timeout -k 10 120 env JAX_PLATFORMS=cpu OIM_LOCK_SANITIZER=1 $(PYTHON) -m pytest \
 	  tests/test_serve_qos.py -q -m "qos and not slow" \
 	  -p no:cacheprovider
 
@@ -169,11 +169,11 @@ test-qos:
 # not grandfathered in baseline.
 test-serve-chaos:
 	$(PYTHON) -m tools.oimlint \
-	  --passes lock-discipline,resource-lifecycle --roots oim_tpu/serve
+	  --passes lock-discipline,lock-order,atomicity,resource-lifecycle --roots oim_tpu/serve
 	$(PYTHON) -m tools.oimlint \
 	  --passes lock-discipline,resource-lifecycle,metrics \
 	  --roots oim_tpu/common
-	timeout -k 10 150 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	timeout -k 10 150 env JAX_PLATFORMS=cpu OIM_LOCK_SANITIZER=1 $(PYTHON) -m pytest \
 	  tests/test_serve_chaos.py -q -m "chaos and not slow" \
 	  -p no:cacheprovider
 
@@ -211,9 +211,9 @@ test-serve-disagg:
 # path's HTTP hop stay analyzer-clean, not grandfathered in baseline.
 test-serve-migrate:
 	$(PYTHON) -m tools.oimlint \
-	  --passes lock-discipline,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
+	  --passes lock-discipline,lock-order,atomicity,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
 	  --roots oim_tpu/serve,oim_tpu/autoscale
-	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	timeout -k 10 120 env JAX_PLATFORMS=cpu OIM_LOCK_SANITIZER=1 $(PYTHON) -m pytest \
 	  tests/test_serve_migrate.py -q -m "serve_migrate and not slow" \
 	  -p no:cacheprovider
 
@@ -257,6 +257,16 @@ lint-metrics:
 lint-jax:
 	$(PYTHON) -m tools.oimlint \
 	  --passes donation-safety,host-sync-discipline,retrace-risk
+
+# The concvet family standalone (ISSUE 19): lock-order (acquisition
+# graph cycles = potential deadlocks) and atomicity (check-then-act
+# races on guarded attributes) over the whole tree — the concurrency
+# slice of `make lint`, for the edit-compile loop on serve-plane
+# locking code (<10 s).  Runtime complement: the lock-order sanitizer
+# (oim_tpu/common/locksan.py, OIM_LOCK_SANITIZER=1 — the serve/chaos/
+# migrate/qos suites run with it on).
+lint-conc:
+	$(PYTHON) -m tools.oimlint --passes lock-order,atomicity
 
 # Steady-state recompile guard (ISSUE 11): a WARM engine must pay ZERO
 # XLA compiles under live traffic — N decode chunks + a mid-stream

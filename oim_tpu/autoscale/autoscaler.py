@@ -40,6 +40,7 @@ from time import monotonic, sleep as _sleep, time as _wall
 from typing import Callable
 
 from oim_tpu import log
+from oim_tpu.common import locksan
 from oim_tpu.autoscale import policy as policy_mod
 from oim_tpu.autoscale.actuator import Actuator, PoolExhaustedError
 from oim_tpu.autoscale.launcher import Launcher
@@ -187,7 +188,7 @@ class Autoscaler:
         # Actuation (RPCs, launcher) ALWAYS runs outside it.  RLock for
         # the FleetMonitor reason: our own db.store calls re-dispatch
         # watch events on this thread.
-        self._lock = threading.RLock()
+        self._lock = locksan.new_rlock("Autoscaler._lock")
         self._serve: dict[str, str] = {}  # sid → advertised url
         self._load: dict[str, dict] = {}  # cn → decoded load snapshot
         self._replicas: dict[str, ReplicaRecord] = {}
@@ -203,7 +204,7 @@ class Autoscaler:
         self._cancel_watch: Callable[[], None] | None = None
         self._remove_listener: Callable[[], None] | None = None
         self._monitor = monitor
-        self._cond = threading.Condition()
+        self._cond = locksan.new_condition("Autoscaler._cond")
         self._wake = False
         self._stop = False
         self._thread: threading.Thread | None = None

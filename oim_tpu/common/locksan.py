@@ -1,0 +1,214 @@
+"""Runtime lock-order sanitizer (concvet's dynamic half, ISSUE 19).
+
+The static ``lock-order`` pass proves what it can see: ``with``-nesting
+and one level of intra-class calls.  Cross-class call chains, callback
+hops, and composition it cannot resolve are exactly where ordering bugs
+hide — so the serve plane's locks are constructed through the factories
+below, and when ``OIM_LOCK_SANITIZER=1`` is set (the chaos/migrate/qos
+suites set it) every acquisition is checked against a process-global
+order table:
+
+- each thread keeps a stack of the sanitized locks it holds;
+- acquiring B while holding A records the directed edge ``A → B`` with
+  the acquiring stack as its witness (first observation wins);
+- acquiring B while holding A when ``B → A`` was ever observed — on ANY
+  thread, at ANY earlier time — raises :class:`LockOrderInversion`
+  BEFORE blocking on the acquire, with both witness stacks attached.
+  A potential deadlock becomes a deterministic, debuggable exception
+  even when the two threads never actually interleave fatally.
+
+Unset (production default), the factories return the raw ``threading``
+objects: zero wrapper, zero per-acquire work, nothing allocated beyond
+the lock itself.  Same-name edges are never recorded (RLock
+re-entrancy; a Condition re-acquiring its own lock after ``wait``).
+
+The factories are first-class lock constructors to the static passes
+too: ``tools/oimlint`` (lock-discipline, lock-order, atomicity) treats
+``locksan.new_lock/new_rlock/new_condition`` exactly like the
+``threading`` ctors, so adopting the sanitizer never blinds the
+analyzer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+
+
+def enabled() -> bool:
+    """True when the sanitizer env switch is set (checked at factory
+    call time, so a test can flip it before constructing an engine)."""
+    return os.environ.get("OIM_LOCK_SANITIZER", "") not in ("", "0")
+
+
+class LockOrderInversion(RuntimeError):
+    """Acquisition order contradicts a previously witnessed order."""
+
+
+# -- process-global order table ---------------------------------------------
+
+# (first_name, then_name) -> witness stack of the edge's first sighting.
+_order: dict[tuple[str, str], str] = {}
+_order_lock = threading.Lock()
+_tls = threading.local()
+
+
+def _held() -> list[str]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _stack() -> str:
+    # Drop the two sanitizer frames; keep the acquiring call chain.
+    return "".join(traceback.format_stack(limit=18)[:-2])
+
+
+def _check_and_note(name: str) -> None:
+    """Record edges held → ``name``; raise on a witnessed inversion.
+
+    Runs BEFORE the real acquire so an inversion surfaces as an
+    exception at the second acquisition site, never as a hang."""
+    held = _held()
+    if not held:
+        return
+    stack = None
+    for prior in held:
+        if prior == name:
+            continue  # re-entrant acquisition of the same lock
+        with _order_lock:
+            inverse = _order.get((name, prior))
+            if inverse is not None:
+                raise LockOrderInversion(
+                    f"lock-order inversion: acquiring {name!r} while "
+                    f"holding {prior!r}, but the opposite order "
+                    f"({name!r} before {prior!r}) was witnessed "
+                    f"earlier.\n--- earlier witness ({name!r} -> "
+                    f"{prior!r}) ---\n{inverse}--- this acquisition "
+                    f"({prior!r} -> {name!r}) ---\n{stack or _stack()}"
+                )
+            if (prior, name) not in _order:
+                if stack is None:
+                    stack = _stack()
+                _order[(prior, name)] = stack
+
+
+def _push(name: str) -> None:
+    _held().append(name)
+
+
+def _pop(name: str) -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == name:
+            del held[i]
+            return
+
+
+def reset() -> None:
+    """Clear the global order table (test isolation)."""
+    with _order_lock:
+        _order.clear()
+
+
+def order_table() -> dict[tuple[str, str], str]:
+    """Snapshot of the witnessed edges (observability/tests)."""
+    with _order_lock:
+        return dict(_order)
+
+
+# -- wrappers ----------------------------------------------------------------
+
+
+class _SanLock:
+    """Order-checking proxy over a ``threading`` lock primitive."""
+
+    __slots__ = ("name", "_raw")
+
+    def __init__(self, name: str, raw):
+        self.name = name
+        self._raw = raw
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        _check_and_note(self.name)
+        got = self._raw.acquire(blocking, timeout)
+        if got:
+            _push(self.name)
+        return got
+
+    def release(self) -> None:
+        self._raw.release()
+        _pop(self.name)
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<locksan {type(self).__name__} {self.name!r}>"
+
+
+class _SanRLock(_SanLock):
+    __slots__ = ()
+
+    def locked(self) -> bool:  # RLock has no locked() pre-3.12
+        raise AttributeError("locked() is not part of the RLock surface")
+
+
+class _SanCondition(_SanLock):
+    """Condition with the order discipline on its underlying lock.
+
+    ``wait`` releases the lock for the duration: the held-stack entry
+    is popped before blocking and re-pushed after (the re-acquire on
+    wake repeats an already-witnessed order, so it is not re-checked —
+    checking it would misfire against locks taken after the original
+    acquisition)."""
+
+    __slots__ = ()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        _pop(self.name)
+        try:
+            return self._raw.wait(timeout)
+        finally:
+            _push(self.name)
+
+    def wait_for(self, predicate, timeout: float | None = None) -> bool:
+        return self._raw.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1) -> None:
+        self._raw.notify(n)
+
+    def notify_all(self) -> None:
+        self._raw.notify_all()
+
+
+# -- factories ---------------------------------------------------------------
+
+
+def new_lock(name: str):
+    """A ``threading.Lock`` — raw when the sanitizer is off, an
+    order-checking wrapper named ``name`` when on."""
+    raw = threading.Lock()
+    return _SanLock(name, raw) if enabled() else raw
+
+
+def new_rlock(name: str):
+    """A ``threading.RLock`` — raw or order-checked, like
+    :func:`new_lock`."""
+    raw = threading.RLock()
+    return _SanRLock(name, raw) if enabled() else raw
+
+
+def new_condition(name: str):
+    """A ``threading.Condition`` (own lock) — raw or order-checked."""
+    raw = threading.Condition()
+    return _SanCondition(name, raw) if enabled() else raw

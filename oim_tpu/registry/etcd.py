@@ -716,13 +716,20 @@ class EtcdKVServer:
             lease_id = request.ID or self._next_lease
             self._next_lease = max(self._next_lease, lease_id) + 1
             if request.ID and request.ID in self._leases:
-                return rpc_pb2.LeaseGrantResponse(
-                    header=self._header(),
-                    error="lease already exists",
-                )
-            self._leases[lease_id] = set()
-            self._lease_ttl[lease_id] = ttl
-            self._lease_sweeper.arm(str(lease_id), time.monotonic() + ttl)
+                # Response built OUTSIDE the critical section: _header()
+                # takes self._lock itself (non-reentrant), so calling it
+                # here would self-deadlock on the duplicate-grant path.
+                duplicate = True
+            else:
+                duplicate = False
+                self._leases[lease_id] = set()
+                self._lease_ttl[lease_id] = ttl
+                self._lease_sweeper.arm(str(lease_id), time.monotonic() + ttl)
+        if duplicate:
+            return rpc_pb2.LeaseGrantResponse(
+                header=self._header(),
+                error="lease already exists",
+            )
         return rpc_pb2.LeaseGrantResponse(
             header=self._header(), ID=lease_id, TTL=ttl
         )

@@ -91,6 +91,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from oim_tpu.common import events as _events
+from oim_tpu.common import locksan
 from oim_tpu.common import metrics as _metrics
 from oim_tpu.common import tracing as _tracing
 from oim_tpu.serve import sentinel as _sentinel
@@ -1556,7 +1557,7 @@ class Engine:
     completion or dropped unread, never leaking a slot).
     """
 
-    _instance_lock = threading.Lock()
+    _instance_lock = locksan.new_lock("Engine._instance_lock")
     _instance_count = 0
 
     def __init__(
@@ -2211,7 +2212,7 @@ class Engine:
         # one-chunk pipeline lag (which would read as a 2x latency
         # regression at depth 2 with no hardware change).
         self._t_last_chunk_done: float | None = None
-        self._lock = threading.Lock()
+        self._lock = locksan.new_lock("Engine._lock")
         # Recently-completed-request ring (ISSUE 9): one compact record
         # per finalized request — rid, tenant CN, trace id, per-phase
         # durations, token counts, outcome (ok / deadline / cancelled /
@@ -2230,7 +2231,7 @@ class Engine:
         # the engine lock is released) appends while /debugz handler
         # threads read — and keeping it separate means ring access
         # never nests inside the engine lock in either order.
-        self._ring_lock = threading.Lock()
+        self._ring_lock = locksan.new_lock("Engine._ring_lock")
         # Failure-path finalizations queued under self._lock and
         # drained OUTSIDE it (the `ended`-callbacks pattern): span
         # serialization + trace-file writes + histogram observes must
@@ -2253,7 +2254,7 @@ class Engine:
         # for the total compile budget; one lock covers both.
         self._beam_fns: dict[tuple, object] = {}
         self._beam_traces: set[tuple] = set()
-        self._beam_lock = threading.Lock()
+        self._beam_lock = locksan.new_lock("Engine._beam_lock")
         # rid → (kind, message); result_full raises RequestFailedError.
         self._errors: dict[int, tuple[str, str]] = {}
         self._callbacks: dict[int, object] = {}  # rid → on_token
@@ -3673,9 +3674,11 @@ class Engine:
                 len(state.emitted), t_end,
             )
 
-    def _finish(self, slot: int, state: _SlotState) -> None:
-        # pop with default: a request finishing on its very first (admit)
-        # token was never registered in _slots.
+    def _finish_locked(self, slot: int, state: _SlotState) -> None:
+        # Caller holds self._lock (both call sites are inside the
+        # emission critical section; the *_locked helpers below require
+        # it).  pop with default: a request finishing on its very first
+        # (admit) token was never registered in _slots.
         self._slots.pop(slot, None)
         self._free.append(slot)
         # Disaggregated prefill (serve/disagg.py): a hold_kv request's
@@ -4972,7 +4975,7 @@ class Engine:
 
     def _hold_kv_locked(self, slot: int, state: _SlotState) -> None:
         """Retain a finishing hold_kv request's KV for export (lock
-        held, called by _finish BEFORE the slot's blocks release): one
+        held, called by _finish_locked BEFORE the slot's blocks release): one
         extra ref on every block the valid rows cover, recorded under
         the rid with a TTL.  The frontier is ``tokens - 1`` rows — the
         last emitted token has no cache row yet, exactly the state a
@@ -5991,7 +5994,10 @@ class Engine:
         (the device has work again)."""
         now = time.monotonic()
         acc[1] += now - t0
-        if self._t_device_free is not None:
+        # _t_device_free is driver-thread-only state (decl comment); the
+        # one locked write is abort()'s quiesce, which only runs against
+        # a wedged or dead driver — no concurrent check-then-act here.
+        if self._t_device_free is not None:  # oimlint: disable=atomicity
             if not self._warming:
                 idle = max(0.0, t0 - self._t_device_free)
                 self.device_idle_seconds += idle
@@ -6130,7 +6136,10 @@ class Engine:
                 # Only count when elision is the REASON for the
                 # boundary — an admission boundary never chains anyway.
                 self.tail_elisions += 1
-        if boundary and self._inflight is not None:
+        # _inflight is driver-thread pipelining state: only step() on
+        # the driver thread reads or swaps it; abort()'s locked clear
+        # runs only against a wedged/dead driver (watchdog contract).
+        if boundary and self._inflight is not None:  # oimlint: disable=atomicity
             prev, self._inflight = self._inflight, None
             self._process_chunk(prev, acc)
         self._admit_wave(acc)
@@ -6147,7 +6156,8 @@ class Engine:
             return
         prev = self._inflight
         handle = self._dispatch_chunk(acc, prev)
-        if self.pipeline_depth >= 2:
+        # Driver-thread-only _inflight handoff, same contract as above.
+        if self.pipeline_depth >= 2:  # oimlint: disable=atomicity
             self._inflight = handle
             if prev is not None:
                 # Chunk N's readback + emission run while the device
@@ -6675,7 +6685,7 @@ class Engine:
                         done = self._emit(state, token, lp)
                         self._admitting.pop(rid, None)
                         if done:
-                            self._finish(slot, state)
+                            self._finish_locked(slot, state)
                             finished.append(state)
                         else:
                             self._slots[slot] = state
@@ -6964,7 +6974,7 @@ class Engine:
                 if cb is not None:
                     notices.append((cb, fresh, done))
                 if done and slot in self._slots:
-                    self._finish(slot, state)
+                    self._finish_locked(slot, state)
                     finished.append(state)
         start = handle.t_dispatch
         if self._t_last_chunk_done is not None:

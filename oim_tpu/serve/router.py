@@ -106,6 +106,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu import log
 from oim_tpu.common import events, metrics, tracing
+from oim_tpu.common import locksan
 from oim_tpu.qos.policy import DEFAULT_POLICY as _QOS_DEFAULT
 from oim_tpu.serve.disagg import (
     prefix_digest,
@@ -346,7 +347,7 @@ class Router:
             raise ValueError(
                 "router needs static --backend urls or a registry address"
             )
-        self._lock = threading.Lock()
+        self._lock = locksan.new_lock("Router._lock")
         self._backends: dict[str, Backend] = {
             url.rstrip("/"): Backend(id=url.rstrip("/"), url=url.rstrip("/"))
             for url in backends

@@ -149,6 +149,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from oim_tpu.common import events, metrics, tracing
+from oim_tpu.common import locksan
 from oim_tpu.serve import disagg
 from oim_tpu.serve.httptls import check_serving_peer, peer_common_name
 from oim_tpu.serve.engine import (
@@ -309,7 +310,7 @@ class ServeServer:
         # never clobber a driver-death error that landed between its
         # check and its store.  Bare reads (handlers, the registration
         # health gate) stay lock-free — a reference read is atomic.
-        self._error_lock = threading.Lock()
+        self._error_lock = locksan.new_lock("ServeServer._error_lock")
         # True while self.error came from a stall verdict (clearable);
         # a driver-death error is permanent and must survive a clear.
         self._stall_error = False
@@ -318,7 +319,7 @@ class ServeServer:
         # thread under their OWN lock — /debugz/profile must never
         # touch the engine lock or the error latch, so it stays
         # servable while the backend is wedged.
-        self._profile_lock = threading.Lock()
+        self._profile_lock = locksan.new_lock("ServeServer._profile_lock")
         self._profile: dict | None = None
         self._profile_thread: threading.Thread | None = None
         self.watchdog = (

@@ -1,0 +1,28 @@
+"""oimlint fixture: atomicity known-bad snippets.
+
+The ISSUE 6 error-latch bug family: ``clear_stall`` reads the guarded
+``error`` outside its lock to decide whether to clear it, and
+``bump_if_error`` gates a mutation of a sibling (same guard lock) on a
+lock-free read."""
+
+import threading
+
+
+class Latch:
+    def __init__(self):
+        self._lk = threading.Lock()
+        self.error = None
+        self.count = 0
+
+    def set_error(self, msg):
+        with self._lk:
+            self.error = msg
+
+    def clear_stall(self):
+        if self.error is not None:  # oimlint-expect: atomicity
+            self.error = None
+
+    def bump_if_error(self):
+        if self.error:  # oimlint-expect: atomicity
+            with self._lk:
+                self.count += 1

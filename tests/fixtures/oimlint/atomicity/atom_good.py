@@ -1,0 +1,33 @@
+"""oimlint fixture: atomicity known-good twin.
+
+The check runs under the guard lock; a ``*_locked``-convention method
+checks lock-free legally (its caller holds the lock); constructor
+writes are pre-publication; an attribute never mutated under any lock
+is not guarded state and its lock-free check-then-act is out of scope
+(plain single-threaded code)."""
+
+import threading
+
+
+class SafeLatch:
+    def __init__(self):
+        self._lk = threading.Lock()
+        self.error = None
+        self.plain = 0
+
+    def set_error(self, msg):
+        with self._lk:
+            self.error = msg
+
+    def clear_stall(self):
+        with self._lk:
+            if self.error is not None:
+                self.error = None
+
+    def _reset_locked(self):
+        if self.error:
+            self.error = None
+
+    def unguarded_state(self):
+        if self.plain:
+            self.plain = 0

@@ -1,0 +1,11 @@
+"""oimlint fixture: internal HTTP clients for the protocol-drift HTTP
+extension — URL concatenation, f-string fragments with query strings,
+and a call to a route no handler serves (two findings on that line:
+unserved AND undocumented)."""
+
+
+def call(url, rid):
+    echo = url + "/v1/echo"
+    kv = f"{url}/v1/kv?rid={rid}"
+    ghost = url + "/v1/ghost"  # oimlint-expect: protocol-drift, protocol-drift
+    return echo, kv, ghost

@@ -1,0 +1,15 @@
+"""oimlint fixture: serve-plane handler for the protocol-drift HTTP
+extension — routes dispatched via Compare literals, membership tuples,
+and an ALL_CAPS module-level route table."""
+
+PROXIED = ("/v1/ping",)
+
+
+class Handler:
+    def handle(self, path):
+        clean = path.split("?", 1)[0]
+        if clean == "/v1/echo":
+            return "echo"
+        if clean in ("/v1/kv", "/v1/slot"):
+            return "kv-surface"
+        return None

@@ -1,0 +1,12 @@
+"""oimlint fixture: render helpers for load-schema-drift tests.
+
+``alpha`` is a legal column; ``zeta`` was removed from the schema but
+the accessor survived — it renders the ``get`` default forever.
+``beta`` published-but-not-rendered is legal (not every field is a
+column)."""
+
+
+def render_top(load):
+    alpha = load.get("alpha")
+    zeta = load.get("zeta")  # oimlint-expect: load-schema-drift
+    return alpha, zeta

@@ -1,0 +1,14 @@
+"""oimlint fixture: load-schema publisher for load-schema-drift tests.
+
+The annotated-assignment spelling is deliberate — the real
+``autoscale/load.py`` declares ``_DEFAULTS`` with an annotation, and
+the pass went blind to it once (AnnAssign vs Assign); this fixture
+pins that regression."""
+
+from typing import Any
+
+_DEFAULTS: dict[str, Any] = {
+    "alpha": 0,
+    "beta": 0.0,
+    "gamma": False,  # oimlint-expect: load-schema-drift
+}

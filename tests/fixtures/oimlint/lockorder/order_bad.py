@@ -1,0 +1,93 @@
+"""oimlint fixture: lock-order known-bad snippets.
+
+``Inverted`` nests its two locks in both orders (the classic 2-cycle);
+``SelfDead`` calls a helper that re-acquires a non-reentrant lock the
+caller already holds; ``Composer``/``Ring`` invert across classes
+through unique-attribute-name composition; ``ChainA``/``ChainB``/
+``ChainC`` form a three-lock cycle no pairwise check can see."""
+
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._ia = threading.Lock()
+        self._ib = threading.Lock()
+
+    def forward(self):
+        with self._ia:
+            with self._ib:  # oimlint-expect: lock-order
+                pass
+
+    def backward(self):
+        with self._ib:
+            with self._ia:
+                pass
+
+
+class SelfDead:
+    def __init__(self):
+        self._m = threading.Lock()
+
+    def outer(self):
+        with self._m:
+            self._inner()  # oimlint-expect: lock-order
+
+    def _inner(self):
+        with self._m:
+            pass
+
+
+class Ring:
+    def __init__(self, composer):
+        self._ring = threading.Lock()
+        self._composer = composer
+
+    def spin(self):
+        with self._ring:
+            with self._composer._own:
+                pass
+
+
+class Composer:
+    def __init__(self, ring):
+        self._own = threading.Lock()
+        self._ring_peer = ring
+
+    def use(self):
+        with self._own:
+            with self._ring_peer._ring:  # oimlint-expect: lock-order
+                pass
+
+
+class ChainA:
+    def __init__(self, b):
+        self._ca = threading.Lock()
+        self._peer_b = b
+
+    def hop(self):
+        with self._ca:
+            with self._peer_b._cb:  # oimlint-expect: lock-order
+                pass
+
+
+class ChainB:
+    def __init__(self, c):
+        self._cb = threading.Lock()
+        self._peer_c = c
+
+    def hop(self):
+        with self._cb:
+            with self._peer_c._cc:
+                pass
+
+
+class ChainC:
+    def __init__(self, a):
+        self._cc = threading.Lock()
+        self._peer_a = a
+
+    def hop(self):
+        with self._cc:
+            with self._peer_a._ca:
+                pass

@@ -1,0 +1,62 @@
+"""oimlint fixture: lock-order known-good twin.
+
+A consistent two-lock order (direct nesting AND through a
+``*_locked``-convention callee), an RLock whose re-acquisition through
+a call chain is legal, an ambiguous attribute name (``_lock`` — owned
+by both classes here) that must be skipped rather than guessed into a
+false edge, and a constructor that nests in the "wrong" order
+(single-threaded by contract, never an edge)."""
+
+import threading
+
+
+class Ordered:
+    def __init__(self, peer):
+        self._oa = threading.Lock()
+        self._ob = threading.Lock()
+        self._r = threading.RLock()
+        self._lock = threading.Lock()
+        self._peer = peer
+        # Constructor-only inverse nesting: pre-publication, no edge.
+        with self._ob:
+            with self._oa:
+                pass
+
+    def one(self):
+        with self._oa:
+            with self._ob:
+                pass
+
+    def two(self):
+        with self._oa:
+            self._flush_locked()
+
+    def _flush_locked(self):
+        with self._ob:
+            pass
+
+    def reenter(self):
+        with self._r:
+            self._again()
+
+    def _again(self):
+        with self._r:
+            pass
+
+    def ambiguous(self):
+        # ``_lock`` is owned by Ordered AND Other: resolution must
+        # skip the composed acquisition, not fabricate an edge.
+        with self._lock:
+            with self._peer._lock:
+                pass
+
+
+class Other:
+    def __init__(self, peer):
+        self._lock = threading.Lock()
+        self._peer = peer
+
+    def also_ambiguous(self):
+        with self._lock:
+            with self._peer._lock:
+                pass

@@ -315,6 +315,29 @@ def test_lease_revoke_deletes_attached_keys(etcd):
         cancel()
 
 
+def test_duplicate_lease_grant_answers_without_deadlock(etcd):
+    """Granting a lease ID that already exists must answer the
+    duplicate error, not hang: the error response's header used to be
+    built INSIDE the server's critical section, and ``_header()`` takes
+    the same non-reentrant lock (concvet lock-order finding — the
+    self-deadlock class).  The RPC timeout turns a regression into a
+    DEADLINE_EXCEEDED failure instead of a wedged suite."""
+    from oim_tpu.registry.etcd import ETCD_LEASE
+    from oim_tpu.spec.gen.etcd import rpc_pb2
+
+    _, _, db = etcd
+    stub = ETCD_LEASE.stub(db._channel_get())
+    first = stub.LeaseGrant(
+        rpc_pb2.LeaseGrantRequest(ID=424242, TTL=60), timeout=5
+    )
+    assert first.ID == 424242 and not first.error
+    dup = stub.LeaseGrant(
+        rpc_pb2.LeaseGrantRequest(ID=424242, TTL=60), timeout=5
+    )
+    assert dup.error  # duplicate reported, server still answering
+    assert db.keepalive_once(424242) >= 1  # lock released, lease intact
+
+
 def test_put_with_unknown_lease_rejected(etcd):
     from oim_tpu.spec.gen.etcd import rpc_pb2
 

@@ -1,0 +1,201 @@
+"""Runtime lock-order sanitizer (ISSUE 19, ``oim_tpu/common/locksan``).
+
+The concvet static passes prove the orders they can see; the sanitizer
+catches the rest at runtime — so these tests pin its whole contract:
+
+- OFF (env unset): the factories return the RAW ``threading``
+  primitives — no wrapper object, no per-acquire bookkeeping, nothing
+  for the hot path to pay;
+- ON: a seeded two-thread inversion raises
+  :class:`~oim_tpu.common.locksan.LockOrderInversion` with BOTH witness
+  stacks attached, before the second thread blocks — a potential
+  deadlock becomes a deterministic exception;
+- ON: consistent orders, RLock re-entry, and Condition wait/notify
+  stay silent (no false positives on the legal patterns the serve
+  plane runs);
+- ON: a warm engine's decode pays ZERO XLA compiles with every engine
+  lock wrapped (the jit-guard pin, sanitizer edition — instrumentation
+  must never perturb the compiled path).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from oim_tpu.common import locksan
+
+
+@pytest.fixture
+def san(monkeypatch):
+    """Sanitizer ON with a clean order table; cleaned up after."""
+    monkeypatch.setenv("OIM_LOCK_SANITIZER", "1")
+    locksan.reset()
+    yield
+    locksan.reset()
+
+
+class TestDisabled:
+    def test_factories_return_raw_primitives(self, monkeypatch):
+        """OFF = the actual threading objects, not wrappers: the serve
+        plane's production locks carry zero sanitizer overhead."""
+        monkeypatch.delenv("OIM_LOCK_SANITIZER", raising=False)
+        assert type(locksan.new_lock("x")) is type(threading.Lock())
+        assert type(locksan.new_rlock("x")) is type(threading.RLock())
+        assert type(locksan.new_condition("x")) is threading.Condition
+
+    def test_zero_is_off_too(self, monkeypatch):
+        monkeypatch.setenv("OIM_LOCK_SANITIZER", "0")
+        assert type(locksan.new_lock("x")) is type(threading.Lock())
+
+    def test_no_order_state_recorded(self, monkeypatch):
+        """Raw locks never touch the global order table."""
+        monkeypatch.delenv("OIM_LOCK_SANITIZER", raising=False)
+        locksan.reset()
+        a, b = locksan.new_lock("D.a"), locksan.new_lock("D.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass  # an inversion the sanitizer is NOT watching
+        assert locksan.order_table() == {}
+
+
+class TestInversionDetection:
+    def test_seeded_two_thread_inversion_raises(self, san):
+        """Thread 1 establishes a → b; thread 2's b → a raises with
+        both stacks, even though the threads never actually interleave
+        into the deadlock."""
+        a = locksan.new_lock("T.a")
+        b = locksan.new_lock("T.b")
+        caught: list[BaseException] = []
+
+        def t1_forward():
+            with a:
+                with b:
+                    pass
+
+        def t2_backward():
+            try:
+                with b:
+                    with a:
+                        pass
+            except locksan.LockOrderInversion as exc:
+                caught.append(exc)
+
+        t1 = threading.Thread(target=t1_forward)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=t2_backward)
+        t2.start()
+        t2.join()
+        assert caught, "inversion not detected"
+        msg = str(caught[0])
+        # Both acquisition chains cited, by the functions that ran them.
+        assert "t1_forward" in msg, msg
+        assert "t2_backward" in msg, msg
+        assert "T.a" in msg and "T.b" in msg
+
+    def test_inversion_raises_before_blocking(self, san):
+        """The check happens BEFORE the acquire: the second thread gets
+        the exception even while the lock is genuinely contended."""
+        a = locksan.new_lock("C.a")
+        b = locksan.new_lock("C.b")
+        with a:
+            with b:
+                pass
+        # a is now held by this thread; the inverse attempt must raise
+        # instantly, not deadlock waiting for a.
+        caught: list[BaseException] = []
+
+        def backward():
+            try:
+                with b:
+                    with a:
+                        pass
+            except locksan.LockOrderInversion as exc:
+                caught.append(exc)
+
+        with a:
+            t = threading.Thread(target=backward)
+            t.start()
+            t.join(timeout=10)
+            assert not t.is_alive(), "sanitizer blocked instead of raising"
+        assert caught
+
+    def test_consistent_order_is_silent(self, san):
+        a = locksan.new_lock("S.a")
+        b = locksan.new_lock("S.b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert ("S.a", "S.b") in locksan.order_table()
+
+    def test_rlock_reentry_is_silent(self, san):
+        r = locksan.new_rlock("S.r")
+        with r:
+            with r:
+                pass
+        assert locksan.order_table() == {}
+
+    def test_condition_wait_notify(self, san):
+        """Condition under the sanitizer: wait releases the lock (a
+        waiter must not pin its cond in the held stack), notify wakes,
+        and a lock taken around the condition keeps its order."""
+        outer = locksan.new_lock("W.outer")
+        cond = locksan.new_condition("W.cond")
+        ready = []
+
+        def waiter():
+            with cond:
+                while not ready:
+                    cond.wait(timeout=5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with outer:
+            with cond:
+                ready.append(1)
+                cond.notify()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert ("W.outer", "W.cond") in locksan.order_table()
+
+
+@pytest.mark.jit_guard
+def test_warm_decode_zero_compiles_with_sanitizer(monkeypatch):
+    """The jit-guard pin, sanitizer edition: with every engine lock
+    wrapped, a warm engine's pipelined decode still pays ZERO XLA
+    compiles — the wrapper lives on the host-side lock path and must
+    never perturb the compiled graph or its cache keys."""
+    import jax
+
+    from test_jit_guard import CFG, _prompt, compile_delta
+    from oim_tpu.models import TransformerConfig, init_params
+    from oim_tpu.serve import Engine, GenRequest
+
+    monkeypatch.setenv("OIM_LOCK_SANITIZER", "1")
+    locksan.reset()
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    engine = Engine(
+        params, cfg, n_slots=2, max_len=64, chunk=4,
+        prompt_buckets=(16,), pipeline_depth=2,
+    )
+    # The sanitizer is genuinely on: the engine lock is the wrapper.
+    assert isinstance(engine._lock, locksan._SanLock)
+    engine.warmup()
+    with compile_delta() as d:
+        rid = engine.submit(GenRequest(
+            tokens=_prompt(5, 8, CFG["vocab_size"]), max_new_tokens=8,
+        ))
+        results = engine.run()
+    assert len(results[rid]) == 8
+    assert d.count == 0, (
+        f"sanitizer-on steady state recompiled {d.count}x — the lock "
+        f"wrapper must be invisible to XLA"
+    )
+    locksan.reset()

@@ -26,10 +26,13 @@ from tools.oimlint import core, runner
 from tools.oimlint.core import Finding, SourceTree
 from tools.oimlint.passes import (
     ALL_PASSES,
+    CONC_PASSES,
     JAX_PASSES,
     authz,
     hostsync,
     jaxsites,
+    loadschema,
+    locksites,
     metricspass,
     protocol,
 )
@@ -116,6 +119,45 @@ class TestPassesOnFixtures:
             doc_file="mini_doc.md",
         )
         assert by_location(found) == expected_markers("protocol")
+
+    def test_lock_order(self):
+        """2-cycle, self-deadlock via call, composed cross-class
+        inversion, and a 3-lock cycle — each anchored where the pass
+        reports it; the known-good twin (consistent order, RLock
+        re-entry, ambiguous-name skip) contributes nothing."""
+        found = runner.run_passes(fixture_tree("lockorder"), ["lock-order"])
+        assert by_location(found) == expected_markers("lockorder")
+
+    def test_atomicity(self):
+        """The ISSUE 6 error-latch family: lock-free gating reads of
+        guarded attrs (same attr and sibling); the twin's under-lock
+        check, *_locked checker, and unguarded attr stay silent."""
+        found = runner.run_passes(fixture_tree("atomicity"), ["atomicity"])
+        assert by_location(found) == expected_markers("atomicity")
+
+    def test_load_schema_drift(self):
+        found = loadschema.run(
+            fixture_tree("loadschema"),
+            load_file="mini_load.py",
+            cli_file="mini_cli.py",
+            doc_file="mini_loaddoc.md",
+        )
+        assert by_location(found) == expected_markers("loadschema")
+
+    def test_http_route_drift(self):
+        """The protocol-drift HTTP extension in isolation: the method
+        surfaces are pointed at absent files (silent), the route
+        surfaces at the fixture trio."""
+        found = protocol.run(
+            fixture_tree("httproutes"),
+            client_files=("absent.py",),
+            fake_file="absent.py",
+            doc_file="absent.md",
+            http_served_files=("mini_httpserver.py",),
+            http_client_files=("mini_httpclient.py", "mini_httpserver.py"),
+            http_doc_file="mini_routes.md",
+        )
+        assert by_location(found) == expected_markers("httproutes")
 
     def test_donation_safety(self):
         found = runner.run_passes(
@@ -423,12 +465,15 @@ class TestLiveTree:
         )
         assert not stale, f"stale baseline entries (run --update-baseline): {stale}"
 
-    def test_all_nine_passes_registered(self):
+    def test_all_twelve_passes_registered(self):
         assert set(ALL_PASSES) == {
             "lock-discipline",
+            "lock-order",
+            "atomicity",
             "resource-lifecycle",
             "authz-coverage",
             "protocol-drift",
+            "load-schema-drift",
             "deadline-hygiene",
             "metrics",
             "donation-safety",
@@ -440,6 +485,7 @@ class TestLiveTree:
             "host-sync-discipline",
             "retrace-risk",
         }
+        assert set(CONC_PASSES) == {"lock-order", "atomicity"}
 
     def test_engine_hotpath_spine_is_marked(self):
         """The serve engine's pipeline spine must STAY designated
@@ -466,6 +512,88 @@ class TestLiveTree:
         # Spot-check the core verbs every daemon must serve.
         for name in ("get_chips", "create_allocation", "delete_allocation"):
             assert name in implemented and name in documented
+
+    def test_http_route_sources_nonempty(self):
+        """All three HTTP surfaces extract non-empty on the real tree —
+        an empty side would make the route diff vacuously green."""
+        tree = SourceTree()
+        served = protocol.served_routes(tree, protocol.HTTP_SERVED_FILES)
+        called = protocol.called_routes(tree, protocol.HTTP_CLIENT_FILES)
+        documented = protocol.documented_routes(tree, protocol.HTTP_DOC_FILE)
+        assert served and called and documented
+        # Spot-check the routes the serve plane lives on.
+        for route in ("/v1/generate", "/v1/kv", "/v1/drain", "/healthz"):
+            assert route in served and route in documented
+        for route in ("/v1/generate", "/v1/kv", "/debugz/profile"):
+            assert route in called
+
+    def test_load_schema_sources_nonempty(self):
+        """Same non-vacuity pin for the load-schema surfaces — the
+        published side in particular parses the AnnAssign spelling the
+        real load.py uses."""
+        tree = SourceTree()
+        published = loadschema.published_fields(tree, loadschema.LOAD_FILE)
+        documented = loadschema.documented_fields(tree, loadschema.DOC_FILE)
+        rendered = loadschema.rendered_fields(tree, loadschema.CLI_FILE)
+        assert published and documented and rendered
+        for name in ("queue_depth", "kv_fragmentation", "token_rate"):
+            assert name in published and name in documented
+
+    def test_serve_plane_locks_resolve_through_locksan(self):
+        """The serve plane constructs its locks through the locksan
+        factories; the shared resolver must still see every one — a
+        factory spelling the resolver misses silently blinds all three
+        lock passes."""
+        tree = SourceTree()
+        index = locksites.lock_index(tree)
+        names = {
+            node.name for nodes in index.values() for node in nodes
+        }
+        for name in (
+            "Engine._lock", "Engine._ring_lock", "Engine._beam_lock",
+            "Engine._instance_lock", "Router._lock",
+            "ServeServer._error_lock", "ServeServer._profile_lock",
+            "Autoscaler._lock", "Autoscaler._cond",
+        ):
+            assert name in names, f"lock {name} not in resolver index"
+
+    def test_zero_findings_not_vacuous_lock_order(self, tmp_path):
+        """Mutate the known-good lockorder twin (swap one nesting) and
+        the pass must fire — proving the clean run checks something."""
+        good = open(os.path.join(FIXTURES, "lockorder", "order_good.py")).read()
+        mutated = good.replace(
+            "    def two(self):\n"
+            "        with self._oa:\n"
+            "            self._flush_locked()\n",
+            "    def two(self):\n"
+            "        with self._ob:\n"
+            "            with self._oa:\n"
+            "                pass\n",
+        )
+        assert mutated != good
+        (tmp_path / "order_good.py").write_text(mutated)
+        tree = SourceTree(repo=str(tmp_path), roots=(".",))
+        found = runner.run_passes(tree, ["lock-order"])
+        assert any("potential deadlock" in f.message for f in found)
+
+    def test_zero_findings_not_vacuous_atomicity(self, tmp_path):
+        """Hoist the twin's guarded check out of its lock and the
+        atomicity pass must fire."""
+        good = open(os.path.join(FIXTURES, "atomicity", "atom_good.py")).read()
+        mutated = good.replace(
+            "    def clear_stall(self):\n"
+            "        with self._lk:\n"
+            "            if self.error is not None:\n"
+            "                self.error = None\n",
+            "    def clear_stall(self):\n"
+            "        if self.error is not None:\n"
+            "            self.error = None\n",
+        )
+        assert mutated != good
+        (tmp_path / "atom_good.py").write_text(mutated)
+        tree = SourceTree(repo=str(tmp_path), roots=(".",))
+        found = runner.run_passes(tree, ["atomicity"])
+        assert any("check-then-act" in f.message for f in found)
 
 
 class TestJaxHarvestRegressions:
@@ -549,7 +677,21 @@ class TestCLI:
         assert (
             runner.main(["--passes", "metrics", "--baseline", baseline]) == 0
         )
-        assert "no longer found" not in capsys.readouterr().out
+        assert "stale baseline entry" not in capsys.readouterr().out
+
+    def test_stale_baseline_entry_fails_the_gate(self, tmp_path, capsys):
+        """A baseline line whose finding no longer exists is a FAILURE
+        (ISSUE 19 CI hygiene), not a note — left in place it masks the
+        next regression at the same key."""
+        baseline = str(tmp_path / "baseline.txt")
+        with open(baseline, "w") as f:
+            f.write("metrics ghost.py: a finding somebody since fixed\n")
+        assert (
+            runner.main(["--passes", "metrics", "--baseline", baseline]) == 1
+        )
+        out = capsys.readouterr().out
+        assert "stale baseline entry" in out
+        assert "--update-baseline" in out
 
     def test_cli_exit_zero_on_clean_baseline(self):
         proc = subprocess.run(

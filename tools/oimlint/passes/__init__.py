@@ -6,12 +6,15 @@ doc/development.md "The oimvet static analyzer")."""
 from __future__ import annotations
 
 from tools.oimlint.passes import (
+    atomicity,
     authz,
     deadline,
     donation,
     hostsync,
     lifecycle,
     lockdiscipline,
+    lockorder,
+    loadschema,
     metricspass,
     protocol,
     retrace,
@@ -21,9 +24,12 @@ ALL_PASSES = {
     mod.PASS_ID: mod
     for mod in (
         lockdiscipline,
+        lockorder,
+        atomicity,
         lifecycle,
         authz,
         protocol,
+        loadschema,
         deadline,
         metricspass,
         donation,
@@ -35,3 +41,8 @@ ALL_PASSES = {
 # The jaxvet family (ISSUE 11): the three JAX hot-path hygiene passes,
 # runnable standalone via `make lint-jax` / `--passes` with this list.
 JAX_PASSES = (donation.PASS_ID, hostsync.PASS_ID, retrace.PASS_ID)
+
+# The concvet family (ISSUE 19): the two concurrency passes, runnable
+# standalone via `make lint-conc` / `--passes` with this list (their
+# runtime complement is oim_tpu/common/locksan.py, OIM_LOCK_SANITIZER=1).
+CONC_PASSES = (lockorder.PASS_ID, atomicity.PASS_ID)

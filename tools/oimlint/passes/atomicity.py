@@ -1,0 +1,203 @@
+"""atomicity: check-then-act races on lock-guarded attributes.
+
+The exact bug family ISSUE 6 fixed by hand in the serve plane's error
+latch: ``self.error`` was mutated under ``self._error_lock`` everywhere
+— except one path that READ it outside the lock to decide whether to
+write it, so a stall-clear could clobber a driver-death error that
+landed between its check and its store.  The lock-discipline pass
+cannot see this (every *mutation* is properly guarded); the race is in
+the unguarded *read that gates* the mutation.
+
+Per class:
+
+1. compute the guard map — for every instance attribute, the set of
+   class locks held at its mutation sites (shared ``locksites``
+   resolver: ``threading``-ctor and locksan-factory locks, ``with``
+   nesting).  Mutations inside ``*_locked``-convention methods count
+   as guarded (the caller holds the lock — which one is unknowable
+   statically, recorded as a wildcard).  An attribute with at least
+   one genuinely-guarded mutation OUTSIDE ``__init__`` is *guarded
+   state*;
+2. flag every ``if`` whose test reads a guarded attribute with none of
+   that attribute's guard locks held, when the gated suite mutates the
+   same attribute or a sibling (one sharing a guard lock).  Moving the
+   check under the lock is always the fix — the finding names the
+   attribute, the gating read, the mutated sibling, and the lock.
+
+Methods named ``*_locked`` are exempt as checkers (their caller holds
+the lock by convention), as are constructors (single-threaded by
+contract).  A deliberate lock-free fast path carries an in-code
+``# oimlint: disable=atomicity`` waiver with a justification, same as
+every other pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.oimlint.core import Finding, SourceTree, class_methods, module_classes
+from tools.oimlint.passes import locksites
+from tools.oimlint.passes.locksites import HeldLockWalker, LockNode, self_reads
+
+PASS_ID = "atomicity"
+DESCRIPTION = "guarded attrs must not be read lock-free to gate mutations"
+
+_LIFECYCLE_SKIP = {"__init__", "__new__", "__post_init__"}
+
+# Wildcard guard for mutations inside *_locked-convention methods.
+_CONVENTION = "<caller-held>"
+
+
+class _GuardScan(HeldLockWalker):
+    """Mutation sites with the lock set held at each."""
+
+    def __init__(self, cls_name, own_locks, index):
+        super().__init__(cls_name, own_locks, index)
+        # attr -> list[(line, frozenset[lock names held])]
+        self.mutations: dict[str, list[tuple[int, frozenset]]] = {}
+
+    def on_mutate(self, attr: str, line: int) -> None:
+        held = frozenset(
+            h.name for h in self.held if h.owner == self.cls_name
+        )
+        self.mutations.setdefault(attr, []).append((line, held))
+
+
+class _CheckScan(HeldLockWalker):
+    """``if`` tests reading guarded attrs, with held state and the
+    mutations inside each gated suite."""
+
+    def __init__(self, cls_name, own_locks, index, guards):
+        super().__init__(cls_name, own_locks, index)
+        self.guards = guards  # attr -> frozenset of guard lock names
+        # (line, read_attr, mutated_attr, mut_line)
+        self.races: list[tuple[int, str, str, int]] = []
+
+    def on_test(self, test: ast.expr, line: int, body: list[ast.stmt]) -> None:
+        reads = {
+            attr: rline
+            for attr, rline in self_reads(test).items()
+            if attr in self.guards
+        }
+        if not reads:
+            return
+        held = {h.name for h in self.held if h.owner == self.cls_name}
+        unguarded = {
+            attr: rline
+            for attr, rline in reads.items()
+            if not (held & self.guards[attr])
+            and not (_CONVENTION in self.guards[attr] and held)
+        }
+        if not unguarded:
+            return
+        muts = _suite_mutations(body, self.cls_name, self.own_locks, self.index)
+        for attr, rline in sorted(unguarded.items()):
+            for mut_attr, mut_line in sorted(muts.items()):
+                if mut_attr not in self.guards:
+                    continue
+                shared = self.guards[attr] & self.guards[mut_attr]
+                related = (
+                    mut_attr == attr
+                    or (shared - {_CONVENTION})
+                    or (_CONVENTION in self.guards[attr])
+                    or (_CONVENTION in self.guards[mut_attr])
+                )
+                if related:
+                    self.races.append((line, attr, mut_attr, mut_line))
+                    break  # one finding per gating read
+
+
+def _suite_mutations(
+    body: list[ast.stmt], cls_name, own_locks, index
+) -> dict[str, int]:
+    """Attrs mutated anywhere in the gated suite (locked or not — the
+    race is the check outside, wherever the act runs)."""
+
+    class _Muts(HeldLockWalker):
+        def __init__(self):
+            super().__init__(cls_name, own_locks, index)
+            self.out: dict[str, int] = {}
+
+        def on_mutate(self, attr: str, line: int) -> None:
+            self.out.setdefault(attr, line)
+
+    scan = _Muts()
+    for stmt in body:
+        scan.visit(stmt)
+    return scan.out
+
+
+def _class_findings(rel: str, cls: ast.ClassDef, index) -> list[Finding]:
+    own_locks = locksites.class_lock_attrs(cls)
+    if not own_locks:
+        return []
+    methods = class_methods(cls)
+
+    # Phase 1: the guard map.
+    guards: dict[str, set[str]] = {}
+    unguarded_elsewhere: set[str] = set()
+    for name, fn in methods.items():
+        scan = _GuardScan(cls.name, own_locks, index)
+        for stmt in fn.body:
+            scan.visit(stmt)
+        convention = name.endswith("_locked")
+        for attr, sites in scan.mutations.items():
+            for line, held in sites:
+                if name in _LIFECYCLE_SKIP:
+                    continue  # constructor writes are pre-publication
+                if held:
+                    guards.setdefault(attr, set()).update(held)
+                elif convention:
+                    guards.setdefault(attr, set()).add(_CONVENTION)
+                else:
+                    unguarded_elsewhere.add(attr)
+        del scan
+
+    # Guarded state = attrs with at least one guarded mutation.  Attrs
+    # ONLY ever guarded by convention with no concrete lock anywhere
+    # stay in (the *_locked body is the guarded half).
+    guard_map = {attr: frozenset(locks) for attr, locks in guards.items()}
+    if not guard_map:
+        return []
+
+    # Phase 2: unguarded gating reads.
+    findings: list[Finding] = []
+    for name, fn in methods.items():
+        if name in _LIFECYCLE_SKIP or name.endswith("_locked"):
+            continue
+        scan = _CheckScan(cls.name, own_locks, index, guard_map)
+        for stmt in fn.body:
+            scan.visit(stmt)
+        for line, attr, mut_attr, _mut_line in scan.races:
+            locks = sorted(guard_map[attr] - {_CONVENTION}) or sorted(
+                guard_map[mut_attr] - {_CONVENTION}
+            )
+            lock_desc = "/".join(locks) if locks else "the caller-held lock"
+            act = (
+                f"a mutation of self.{mut_attr}"
+                if mut_attr != attr
+                else f"its own mutation"
+            )
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    rel,
+                    line,
+                    f"{cls.name}.{name}: check-then-act race: self.{attr} "
+                    f"(guarded by {lock_desc}) is read without the lock to "
+                    f"gate {act}; move the check under the lock",
+                )
+            )
+    return findings
+
+
+def run(tree: SourceTree) -> list[Finding]:
+    index = locksites.lock_index(tree)
+    findings: list[Finding] = []
+    for rel in tree.files():
+        mod = tree.tree(rel)
+        if mod is None:
+            continue
+        for cls in module_classes(mod):
+            findings.extend(_class_findings(rel, cls, index))
+    return findings

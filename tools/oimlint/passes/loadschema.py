@@ -1,0 +1,173 @@
+"""load-schema-drift: the ``load/<cn>`` snapshot schema's three surfaces.
+
+PR 17 and PR 18 each added fields to the serving-load snapshot
+(``autoscale/load.py`` ``_DEFAULTS``), and each had to remember by hand
+that the schema has three surfaces: the publisher/decoder field set,
+the load-field table in ``doc/serving.md``, and the ``oimctl top``/
+``oimctl kv`` column accessors.  This pass pins them together the
+protocol-drift way, so the next schema addition cannot silently skip a
+surface:
+
+- **published**: the literal keys of the ``_DEFAULTS`` dict in
+  ``oim_tpu/autoscale/load.py`` — the tolerant-decode contract every
+  consumer indexes unconditionally;
+- **documented**: the field rows of the ``| field | meaning |`` table
+  in ``doc/serving.md`` (compound rows like ```active_slots` /
+  `total_slots` `` document several fields at once);
+- **rendered**: every ``load.get("...")`` key in
+  ``oim_tpu/cli/oimctl.py`` (the convention: the decoded snapshot is
+  always bound to a variable named ``load`` in the render helpers).
+
+Drift rules: published ↔ documented must agree BOTH ways (every field
+documented, no phantom doc rows); every rendered key must exist in
+``_DEFAULTS`` (a stale accessor renders a permanent default and reads
+as "nothing happening").  The reverse — a published field with no
+oimctl column — is legal: not every field is a column (``ts`` is a
+staleness input, ``tenants`` renders through ``oimctl tenants``'s
+fleet-merged view instead).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.oimlint.core import Finding, SourceTree
+
+PASS_ID = "load-schema-drift"
+DESCRIPTION = "load.py _DEFAULTS / doc load-field table / oimctl accessors agree"
+
+LOAD_FILE = "oim_tpu/autoscale/load.py"
+CLI_FILE = "oim_tpu/cli/oimctl.py"
+DOC_FILE = "doc/serving.md"
+
+_TABLE_HEADER = re.compile(r"^\|\s*field\s*\|\s*meaning\s*\|$")
+_FIELD_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
+
+
+def _tree_or_none(tree: SourceTree, rel: str):
+    try:
+        return tree.tree(rel)
+    except OSError:
+        return None
+
+
+def published_fields(tree: SourceTree, rel: str) -> dict[str, tuple[str, int]]:
+    """The literal keys of the ``_DEFAULTS`` dict assignment."""
+    out: dict[str, tuple[str, int]] = {}
+    mod = _tree_or_none(tree, rel)
+    if mod is None:
+        return out
+    for node in ast.walk(mod):
+        # Both spellings: `_DEFAULTS = {...}` and the annotated
+        # `_DEFAULTS: dict[str, Any] = {...}` load.py actually uses.
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        elif (
+            isinstance(node, ast.AnnAssign)
+            and isinstance(node.value, ast.Dict)
+            and isinstance(node.target, ast.Name)
+        ):
+            targets = [node.target.id]
+        else:
+            continue
+        if "_DEFAULTS" not in targets:
+            continue
+        for key in node.value.keys:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                out.setdefault(key.value, (rel, key.lineno))
+    return out
+
+
+def documented_fields(tree: SourceTree, rel: str) -> dict[str, tuple[str, int]]:
+    """Field names from the first column of the ``| field | meaning |``
+    table (only that table — the doc has other tables)."""
+    out: dict[str, tuple[str, int]] = {}
+    try:
+        lines = tree.lines(rel)
+    except OSError:
+        return out
+    in_table = False
+    for lineno, line in enumerate(lines, 1):
+        stripped = line.strip()
+        if _TABLE_HEADER.match(stripped):
+            in_table = True
+            continue
+        if not in_table:
+            continue
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = stripped.split("|")
+        if len(cells) < 2 or set(cells[1].strip()) <= {"-", " "}:
+            continue  # the |---|---| separator row
+        for name in _FIELD_RE.findall(cells[1]):
+            out.setdefault(name, (rel, lineno))
+    return out
+
+
+def rendered_fields(tree: SourceTree, rel: str) -> dict[str, tuple[str, int]]:
+    """Keys of every ``load.get("...")`` call — the render-helper
+    convention for the decoded snapshot."""
+    out: dict[str, tuple[str, int]] = {}
+    mod = _tree_or_none(tree, rel)
+    if mod is None:
+        return out
+    for node in ast.walk(mod):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "load"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            continue
+        out.setdefault(node.args[0].value, (rel, node.lineno))
+    return out
+
+
+def run(
+    tree: SourceTree,
+    load_file: str = LOAD_FILE,
+    cli_file: str = CLI_FILE,
+    doc_file: str = DOC_FILE,
+) -> list[Finding]:
+    published = published_fields(tree, load_file)
+    documented = documented_fields(tree, doc_file)
+    rendered = rendered_fields(tree, cli_file)
+    findings: list[Finding] = []
+    if not published:
+        return findings  # fixture run without the load module
+
+    for name in sorted(set(published) - set(documented)):
+        rel, line = published[name]
+        findings.append(
+            Finding(
+                PASS_ID, rel, line,
+                f"load field {name!r} is published in _DEFAULTS but missing "
+                f"from the {doc_file} load-field table",
+            )
+        )
+    for name in sorted(set(documented) - set(published)):
+        rel, line = documented[name]
+        findings.append(
+            Finding(
+                PASS_ID, rel, line,
+                f"load field {name!r} is documented but absent from "
+                f"{load_file} _DEFAULTS (phantom row)",
+            )
+        )
+    for name in sorted(set(rendered) - set(published)):
+        rel, line = rendered[name]
+        findings.append(
+            Finding(
+                PASS_ID, rel, line,
+                f"oimctl renders load field {name!r} which is absent from "
+                f"{load_file} _DEFAULTS (stale accessor renders a default "
+                f"forever)",
+            )
+        )
+    return findings

@@ -42,7 +42,12 @@ from tools.oimlint.core import (
 PASS_ID = "lock-discipline"
 DESCRIPTION = "shared attrs need locks; no blocking calls while locked"
 
-_LOCK_CTORS = ("Lock", "RLock", "Condition")
+# The threading ctors plus the locksan sanitizer factory spellings
+# (oim_tpu/common/locksan.py) — adopting the sanitizer must not blind
+# this pass to the serve plane's locks.
+_LOCK_CTORS = (
+    "Lock", "RLock", "Condition", "new_lock", "new_rlock", "new_condition",
+)
 _MUTATORS = {
     "append", "appendleft", "add", "insert", "extend", "update", "pop",
     "popleft", "popitem", "clear", "remove", "discard", "setdefault",

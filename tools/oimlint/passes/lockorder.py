@@ -1,0 +1,278 @@
+"""lock-order: whole-tree lock-acquisition graph + deadlock cycles.
+
+Every shipped review round has surfaced a serve-plane lock bug by hand
+(the ``_error_lock`` check-then-set race, the ConnCache close-latch
+leak, the stale-cancel ``_finish`` race).  The lock-discipline pass
+checks what happens *under* a lock; nothing checked lock *ordering*.
+This pass is the ``go vet``-grade half of that gap (the runtime
+sanitizer, ``oim_tpu/common/locksan.py``, is the race-detector half):
+
+1. resolve every lock attribute per class through the shared
+   ``locksites`` resolver (``threading.Lock/RLock/Condition`` and the
+   locksan factory spellings, instance- or ClassDef-level, including
+   composition like ``with self._host.lock:`` resolved by unique
+   attribute name across the tree);
+2. build the acquisition graph: an edge ``A → B`` means some thread
+   acquires B while holding A — from direct ``with``-statement nesting,
+   and from one level of intra-class call resolution (holding A,
+   ``self.m()`` is called and ``m`` acquires B somewhere in its body;
+   this is also how ``*_locked``-convention callees contribute edges:
+   the caller holds the guard, the callee's own nested ``with`` blocks
+   land as edges from everything the caller holds);
+3. report every cycle as a potential deadlock, citing BOTH acquisition
+   chains (method names, not line numbers, so baseline keys stay
+   stable), and every call that re-acquires a non-reentrant lock the
+   caller already holds (self-deadlock, the ``Lock``-not-``RLock``
+   class of hang).
+
+Known approximations, deliberate (the jaxsites contract — documented,
+never silent): call resolution is one level deep and intra-class only
+(a cross-class call chain that inverts two locks is invisible here —
+that is exactly what the runtime sanitizer exists for); a callee that
+acquires a lock only on a branch the holding caller never reaches
+still contributes the edge (over-approximation: waiver material, and
+waivers carry justifications).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from tools.oimlint.core import Finding, SourceTree, class_methods, module_classes
+from tools.oimlint.passes import locksites
+from tools.oimlint.passes.locksites import HeldLockWalker, LockNode
+
+PASS_ID = "lock-order"
+DESCRIPTION = "lock-acquisition graph must be cycle-free (deadlock check)"
+
+_LIFECYCLE_SKIP = {"__init__", "__new__", "__post_init__"}
+
+
+@dataclass(frozen=True)
+class _Edge:
+    """One witnessed ``held → acquired`` pair."""
+
+    src: LockNode
+    dst: LockNode
+    rel: str
+    line: int
+    where: str  # "Engine._finish" — method names only, baseline-stable
+    via_call: str | None = None  # callee name when from call resolution
+
+
+class _AcqScan(HeldLockWalker):
+    """Per-method acquisition events: direct nesting + self calls."""
+
+    def __init__(self, cls_name, own_locks, index):
+        super().__init__(cls_name, own_locks, index)
+        # (held_snapshot, acquired, line)
+        self.acquires: list[tuple[tuple[LockNode, ...], LockNode, int]] = []
+        # (held_snapshot, callee, line)
+        self.calls: list[tuple[tuple[LockNode, ...], str, int]] = []
+
+    def on_acquire(self, node: LockNode, line: int) -> None:
+        self.acquires.append((tuple(self.held), node, line))
+
+    def on_self_call(self, method: str, line: int) -> None:
+        if self.held:
+            self.calls.append((tuple(self.held), method, line))
+
+
+def _scan_class(rel: str, cls: ast.ClassDef, index) -> list[_Edge]:
+    own_locks = locksites.class_lock_attrs(cls)
+    methods = class_methods(cls)
+    scans: dict[str, _AcqScan] = {}
+    for name, fn in methods.items():
+        scan = _AcqScan(cls.name, own_locks, index)
+        for stmt in fn.body:
+            scan.visit(stmt)
+        scans[name] = scan
+
+    edges: list[_Edge] = []
+    for name, scan in scans.items():
+        if name in _LIFECYCLE_SKIP:
+            continue  # constructors are single-threaded by contract
+        where = f"{cls.name}.{name}"
+        # Direct with-nesting.
+        for held, acquired, line in scan.acquires:
+            for h in held:
+                if h.name != acquired.name:
+                    edges.append(_Edge(h, acquired, rel, line, where))
+        # One level of intra-class call resolution: holding H, calling
+        # self.m() contributes H → every lock m acquires anywhere.
+        for held, callee, line in scan.calls:
+            callee_scan = scans.get(callee)
+            if callee_scan is None:
+                continue
+            for _, acquired, _ in callee_scan.acquires:
+                for h in held:
+                    edges.append(
+                        _Edge(h, acquired, rel, line, where, via_call=callee)
+                    )
+    return edges
+
+
+def _witness(edge: _Edge) -> str:
+    via = f" via self.{edge.via_call}()" if edge.via_call else ""
+    return f"{edge.where}{via}: holds {edge.src.name}, acquires {edge.dst.name}"
+
+
+def _sccs(nodes: set[str], adj: dict[str, set[str]]) -> list[list[str]]:
+    """Tarjan strongly-connected components (iterative)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+
+    for root in sorted(nodes):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(comp)
+    return out
+
+
+def _cycle_in_scc(comp: list[str], adj: dict[str, set[str]]) -> list[str]:
+    """One concrete cycle inside a (≥2-node) SCC, as a node path."""
+    members = set(comp)
+    start = min(comp)
+    # BFS from start back to start, restricted to the SCC.
+    parents: dict[str, str] = {}
+    frontier = [start]
+    seen = {start}
+    while frontier:
+        nxt = []
+        for v in frontier:
+            for w in sorted(adj.get(v, ())):
+                if w not in members:
+                    continue
+                if w == start:
+                    chain = [v]
+                    while chain[-1] != start:
+                        chain.append(parents[chain[-1]])
+                    chain.reverse()  # start -> ... -> v
+                    return chain + [start]
+                if w not in seen:
+                    seen.add(w)
+                    parents[w] = v
+                    nxt.append(w)
+        frontier = nxt
+    return comp + [comp[0]]  # unreachable for a true SCC; defensive
+
+
+def run(tree: SourceTree) -> list[Finding]:
+    index = locksites.lock_index(tree)
+    edges: list[_Edge] = []
+    for rel in tree.files():
+        mod = tree.tree(rel)
+        if mod is None:
+            continue
+        for cls in module_classes(mod):
+            edges.extend(_scan_class(rel, cls, index))
+
+    findings: list[Finding] = []
+
+    # Self-deadlock: a call chain re-acquiring a non-reentrant lock.
+    # (RLocks and Conditions re-enter; with-nesting on the same name is
+    # excluded at edge construction — only call resolution lands here.)
+    seen_re: set[str] = set()
+    for e in edges:
+        if e.src.name == e.dst.name and e.src.kind == "Lock" and e.via_call:
+            msg = (
+                f"{e.where}: calls self.{e.via_call}() which re-acquires "
+                f"non-reentrant {e.src.name} already held (self-deadlock)"
+            )
+            if msg not in seen_re:
+                seen_re.add(msg)
+                findings.append(Finding(PASS_ID, e.rel, e.line, msg))
+
+    # Cycle detection over distinct-lock edges.
+    first: dict[tuple[str, str], _Edge] = {}
+    for e in edges:
+        if e.src.name != e.dst.name:
+            first.setdefault((e.src.name, e.dst.name), e)
+    adj: dict[str, set[str]] = {}
+    nodes: set[str] = set()
+    for (a, b) in first:
+        adj.setdefault(a, set()).add(b)
+        nodes.update((a, b))
+
+    reported_pairs: set[tuple[str, str]] = set()
+    for (a, b), e_ab in sorted(first.items()):
+        if a < b and (b, a) in first:
+            e_ba = first[(b, a)]
+            reported_pairs.add((a, b))
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    e_ab.rel,
+                    e_ab.line,
+                    f"potential deadlock: {a} -> {b} [{_witness(e_ab)}] "
+                    f"vs {b} -> {a} [{_witness(e_ba)}]",
+                )
+            )
+
+    for comp in _sccs(nodes, adj):
+        if len(comp) < 2:
+            continue
+        members = set(comp)
+        if any(
+            (a, b) in reported_pairs
+            for a in members
+            for b in members
+            if a < b
+        ):
+            continue  # already reported as a 2-cycle
+        path = _cycle_in_scc(comp, adj)
+        hops = [
+            _witness(first[(path[i], path[i + 1])])
+            for i in range(len(path) - 1)
+            if (path[i], path[i + 1]) in first
+        ]
+        e0 = first[(path[0], path[1])]
+        findings.append(
+            Finding(
+                PASS_ID,
+                e0.rel,
+                e0.line,
+                "potential deadlock cycle: "
+                + " -> ".join(path)
+                + " [" + "; ".join(hops) + "]",
+            )
+        )
+    return findings

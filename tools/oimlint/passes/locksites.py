@@ -1,0 +1,293 @@
+"""Shared lock-site resolver for the concvet pass family.
+
+The two concurrency passes (``lock-order``, ``atomicity``) need the same
+map the jaxvet family gets from ``jaxsites``: which instance/class
+attributes of every class are locks, and — per method — what happens
+while each lock is held.  This module builds that map once per tree,
+handling the lock-construction shapes this codebase actually uses:
+
+- direct:     ``self._lock = threading.Lock()`` (also ``RLock``,
+  ``Condition``) in any method, or a ClassDef-level
+  ``_instance_lock = threading.Lock()``;
+- sanitized:  ``self._lock = locksan.new_lock("Engine._lock")`` — the
+  runtime lock-order sanitizer's factory spellings
+  (``oim_tpu/common/locksan.py``) construct the same lock objects and
+  count identically, so adopting the sanitizer never blinds the
+  analyzer;
+- composed:   ``with self._host.lock:`` / ``with other._ring_lock:`` —
+  a lock attribute reached through another object.  Resolution is by
+  attribute NAME across the whole-tree lock index: a name owned by
+  exactly one class resolves to that class's lock node; an ambiguous
+  name (``_lock`` is owned by a dozen classes) is skipped, never
+  guessed (the jaxsites over/under-approximation contract — silence
+  beats a wrong edge, and the runtime sanitizer covers what static
+  name resolution cannot).
+
+Lock nodes are ``ClassName.attr`` strings; the node also remembers the
+constructor kind (``Lock``/``RLock``/``Condition``) so the lock-order
+pass can tell a re-entrant acquisition from a self-deadlock.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.oimlint.core import SourceTree, call_name, dotted, module_classes
+
+# Constructor spellings that produce a lock: the threading ctors plus
+# the locksan sanitizer factories (which return the same objects, or an
+# order-checking wrapper, depending on OIM_LOCK_SANITIZER).
+LOCK_CTOR_KINDS = {
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+    "new_lock": "Lock",
+    "new_rlock": "RLock",
+    "new_condition": "Condition",
+}
+
+_LIFECYCLE = {"__init__", "__new__", "__post_init__"}
+
+
+@dataclass(frozen=True)
+class LockNode:
+    """One resolved lock: ``owner`` class name, ``attr`` name, ctor kind."""
+
+    owner: str
+    attr: str
+    kind: str = "Lock"
+
+    @property
+    def name(self) -> str:
+        return f"{self.owner}.{self.attr}"
+
+
+@dataclass
+class ClassLockInfo:
+    """Lock attributes of one class: attr name → ctor kind."""
+
+    cls_name: str
+    rel: str
+    locks: dict[str, str] = field(default_factory=dict)
+
+
+def class_lock_attrs(cls: ast.ClassDef) -> dict[str, str]:
+    """``{attr: kind}`` for every lock the class constructs, whether
+    ``self.X = ...`` inside a method or ``X = ...`` at class level."""
+    locks: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        ctor = (call_name(node.value) or "").split(".")[-1]
+        if ctor not in LOCK_CTOR_KINDS:
+            continue
+        for target in node.targets:
+            t = dotted(target)
+            if t and t.startswith("self.") and t.count(".") == 1:
+                locks[t.split(".", 1)[1]] = LOCK_CTOR_KINDS[ctor]
+            elif isinstance(target, ast.Name):
+                # ClassDef-level lock (Engine._instance_lock) — but only
+                # when the assignment is a direct child of the class
+                # body, not a local inside a method.
+                if any(node is stmt for stmt in cls.body):
+                    locks[target.id] = LOCK_CTOR_KINDS[ctor]
+    return locks
+
+
+def lock_index(tree: SourceTree) -> dict[str, list[LockNode]]:
+    """Whole-tree index: lock attribute name → every class that owns
+    one (the composition-resolution table).  Memoized on the tree
+    instance like the jaxsites factory index."""
+    cached = getattr(tree, "_locksites_index", None)
+    if cached is not None:
+        return cached
+    out: dict[str, list[LockNode]] = {}
+    for rel in tree.files():
+        mod = tree.tree(rel)
+        if mod is None:
+            continue
+        for cls in module_classes(mod):
+            for attr, kind in class_lock_attrs(cls).items():
+                out.setdefault(attr, []).append(LockNode(cls.name, attr, kind))
+    tree._locksites_index = out  # type: ignore[attr-defined]
+    return out
+
+
+def resolve_lock_expr(
+    expr: ast.expr,
+    cls_name: str,
+    own_locks: dict[str, str],
+    index: dict[str, list[LockNode]],
+) -> LockNode | None:
+    """The lock node a ``with``-item acquires, or None.
+
+    ``with self.X:`` resolves against the class's own lock attrs first;
+    any other dotted chain ending in a known lock attr resolves through
+    the whole-tree index when the attr name is owned by exactly one
+    class (unique-name composition, the documented approximation)."""
+    name = dotted(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = dotted(expr.func)  # with self._lock.acquire_timeout()
+    if not name or "." not in name:
+        return None
+    head, attr = name.rsplit(".", 1)
+    if head == "self" and attr in own_locks:
+        return LockNode(cls_name, attr, own_locks[attr])
+    owners = index.get(attr, [])
+    if head == cls_name:
+        # Explicit class-qualified access (Engine._instance_lock).
+        for node in owners:
+            if node.owner == cls_name:
+                return node
+    if len(owners) == 1:
+        return owners[0]
+    return None  # unknown or ambiguous: skipped, never guessed
+
+
+class HeldLockWalker(ast.NodeVisitor):
+    """Method-body walk with a held-lock stack, for subclass hooks.
+
+    Tracks ``with``-acquisitions of resolvable lock nodes (nested
+    classes are fenced off — different ``self``; nested defs/lambdas
+    close over the outer ``self`` and are descended into, matching the
+    lock-discipline pass).  Subclasses override the ``on_*`` hooks."""
+
+    def __init__(
+        self,
+        cls_name: str,
+        own_locks: dict[str, str],
+        index: dict[str, list[LockNode]],
+    ):
+        self.cls_name = cls_name
+        self.own_locks = own_locks
+        self.index = index
+        self.held: list[LockNode] = []
+
+    # -- hooks -------------------------------------------------------------
+
+    def on_acquire(self, node: LockNode, line: int) -> None:
+        """Called when a ``with``-item acquires ``node`` (held stack
+        reflects the state BEFORE the acquisition)."""
+
+    def on_self_call(self, method: str, line: int) -> None:
+        """Called for every ``self.m(...)`` call."""
+
+    def on_mutate(self, attr: str, line: int) -> None:
+        """Called for every mutation of ``self.attr``."""
+
+    def on_test(self, test: ast.expr, line: int, body: list[ast.stmt]) -> None:
+        """Called for every ``if`` test (held stack = state at the
+        check); ``body`` is the gated suite (body + orelse)."""
+
+    # -- scope fencing -----------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        pass  # nested class: different ``self``
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # Nested defs close over self but run at an unknowable time
+        # with an unknowable held set — walk them with an EMPTY held
+        # stack (callbacks fire on other threads; assuming the
+        # enclosing locks are held would fabricate edges).
+        saved, self.held = self.held, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- lock tracking -----------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        entered: list[LockNode] = []
+        for item in node.items:
+            resolved = resolve_lock_expr(
+                item.context_expr, self.cls_name, self.own_locks, self.index
+            )
+            self.visit(item.context_expr)
+            if resolved is not None:
+                self.on_acquire(resolved, item.context_expr.lineno)
+                self.held.append(resolved)
+                entered.append(resolved)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - len(entered):]
+
+    # -- calls and mutations -----------------------------------------------
+
+    _MUTATORS = {
+        "append", "appendleft", "add", "insert", "extend", "update", "pop",
+        "popleft", "popitem", "clear", "remove", "discard", "setdefault",
+    }
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted(node.func) or ""
+        parts = name.split(".")
+        if len(parts) == 2 and parts[0] == "self":
+            self.on_self_call(parts[1], node.lineno)
+        if (
+            len(parts) == 3
+            and parts[0] == "self"
+            and parts[2] in self._MUTATORS
+            and parts[1] not in self.own_locks
+        ):
+            self.on_mutate(parts[1], node.lineno)
+        self.generic_visit(node)
+
+    def _mutate_target(self, target: ast.AST, line: int) -> None:
+        if isinstance(target, ast.Subscript):
+            self._mutate_target(target.value, line)  # self.X[k] = v
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._mutate_target(elt, line)
+            return
+        name = dotted(target)
+        if name and name.startswith("self.") and name.count(".") == 1:
+            attr = name.split(".", 1)[1]
+            if attr not in self.own_locks:
+                self.on_mutate(attr, line)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._mutate_target(target, node.lineno)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._mutate_target(node.target, node.lineno)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._mutate_target(node.target, node.lineno)
+            self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            self._mutate_target(target, node.lineno)
+
+    def visit_If(self, node: ast.If) -> None:
+        self.on_test(node.test, node.lineno, list(node.body) + list(node.orelse))
+        self.visit(node.test)
+        for stmt in node.body:
+            self.visit(stmt)
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+
+def self_reads(expr: ast.expr) -> dict[str, int]:
+    """``{attr: line}`` for every ``self.X`` READ inside ``expr``
+    (attribute loads, including through subscripts/calls on the
+    attribute — ``self._events[rid]``, ``self._profile.get(...)``,
+    ``rid in self._errors``)."""
+    out: dict[str, int] = {}
+    for node in ast.walk(expr):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and isinstance(node.ctx, ast.Load)
+        ):
+            out.setdefault(node.attr, node.lineno)
+    return out

@@ -18,6 +18,26 @@ sources of truth:
 Any one-sided name is drift: a client call the daemon will answer
 METHOD_NOT_FOUND, an implemented-but-undocumented method the C++ side
 will never learn about, or a documented method nobody serves.
+
+ISSUE 19 extends the same three-way gate to the serve plane's HTTP
+wire surface, which had grown to five internal client families (router
+proxy/splice, oimctl, checkpoint peer-load, disagg KV/slot ship,
+autoscaler drain) with no drift check at all:
+
+- **served**: route literals the serve-plane handlers dispatch on —
+  string constants inside ``Compare`` nodes (``path == "/v1/kv"``,
+  ``path in ("/v1/kv", "/v1/slot")``) in ``server.py``/``router.py``,
+  plus ALL_CAPS module-level route tuples (the router's ``PROXIED``);
+- **called**: route-shaped literals at client call sites — constants
+  NOT inside a ``Compare`` (URL concatenation ``url + "/v1/generate"``,
+  f-string fragments like ``f"{url}/v1/kv?rid=..."``, call arguments,
+  route tuples), query strings stripped;
+- **documented**: the ``| route | ... |`` table in ``doc/serving.md``.
+
+A called route nobody serves 404s in production; an undocumented route
+is invisible to operators; a documented route nobody serves is a
+phantom row.  Served-but-never-internally-called is legal (the public
+inference API's clients are external).
 """
 
 from __future__ import annotations
@@ -35,6 +55,29 @@ FAKE_FILE = "oim_tpu/agent/fake.py"
 DOC_FILE = "doc/agent-protocol.md"
 
 _DOC_ROW = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`\s*\|")
+
+# -- HTTP wire surface (ISSUE 19) --------------------------------------------
+
+HTTP_SERVED_FILES = (
+    "oim_tpu/serve/server.py",
+    "oim_tpu/serve/router.py",
+)
+HTTP_CLIENT_FILES = (
+    "oim_tpu/serve/router.py",
+    "oim_tpu/serve/disagg.py",
+    "oim_tpu/cli/oimctl.py",
+    "oim_tpu/checkpoint/manager.py",
+    "oim_tpu/autoscale/autoscaler.py",
+)
+HTTP_DOC_FILE = "doc/serving.md"
+
+# Route shape, anchored: the serve plane's URL namespace.  Anything
+# else ("?", "/", log fragments) is not a route literal.
+_ROUTE_RE = re.compile(
+    r"^/(?:v1/[a-z_]+(?:/[a-z_]+)*|debugz(?:/[a-z_]+)?|healthz|metrics)$"
+)
+_HTTP_DOC_HEADER = re.compile(r"^\|\s*route\s*\|")
+_HTTP_DOC_ROUTE = re.compile(r"`(/[^`\s]*)`")
 
 
 def _tree_or_none(tree: SourceTree, rel: str):
@@ -101,11 +144,110 @@ def _documented_methods(tree: SourceTree, rel: str) -> dict[str, tuple[str, int]
     return out
 
 
+def _route(value: str) -> str | None:
+    """The route a string literal names, query-stripped, or None when
+    the literal is not route-shaped."""
+    if not value.startswith("/"):
+        return None
+    path = value.split("?", 1)[0]
+    return path if _ROUTE_RE.fullmatch(path) else None
+
+
+def served_routes(tree: SourceTree, files) -> dict[str, tuple[str, int]]:
+    """Routes the handlers dispatch on: Compare-side literals (either
+    bare or inside membership tuples) plus ALL_CAPS module-level route
+    tuples like the router's ``PROXIED``."""
+    out: dict[str, tuple[str, int]] = {}
+    for rel in files:
+        mod = _tree_or_none(tree, rel)
+        if mod is None:
+            continue
+        for node in ast.walk(mod):
+            if isinstance(node, ast.Compare):
+                for side in [node.left] + list(node.comparators):
+                    for c in ast.walk(side):
+                        if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                            route = _route(c.value)
+                            if route:
+                                out.setdefault(route, (rel, c.lineno))
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, (ast.Tuple, ast.List)
+            ):
+                names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+                if not any(n.isupper() for n in names):
+                    continue
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        route = _route(elt.value)
+                        if route:
+                            out.setdefault(route, (rel, elt.lineno))
+    return out
+
+
+def called_routes(tree: SourceTree, files) -> dict[str, tuple[str, int]]:
+    """Routes at client call sites: every route-shaped string literal
+    NOT inside a Compare — URL concatenation operands, f-string
+    fragments (query-stripped), call args, route tuples."""
+    out: dict[str, tuple[str, int]] = {}
+    for rel in files:
+        mod = _tree_or_none(tree, rel)
+        if mod is None:
+            continue
+        in_compare: set[int] = set()
+        for node in ast.walk(mod):
+            if isinstance(node, ast.Compare):
+                for side in [node.left] + list(node.comparators):
+                    for c in ast.walk(side):
+                        in_compare.add(id(c))
+        for node in ast.walk(mod):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and id(node) not in in_compare
+            ):
+                route = _route(node.value)
+                if route:
+                    out.setdefault(route, (rel, node.lineno))
+    return out
+
+
+def documented_routes(tree: SourceTree, rel: str) -> dict[str, tuple[str, int]]:
+    """First-column backticked routes of the ``| route | ... |`` table
+    (only that table; the doc has other tables)."""
+    out: dict[str, tuple[str, int]] = {}
+    try:
+        lines = tree.lines(rel)
+    except OSError:
+        return out
+    in_table = False
+    for lineno, line in enumerate(lines, 1):
+        stripped = line.strip()
+        if _HTTP_DOC_HEADER.match(stripped) and "`" not in stripped.split("|")[1]:
+            in_table = True
+            continue
+        if not in_table:
+            continue
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = stripped.split("|")
+        if len(cells) < 2 or set(cells[1].strip()) <= {"-", " "}:
+            continue  # the |---|---| separator row
+        for raw in _HTTP_DOC_ROUTE.findall(cells[1]):
+            route = _route(raw)
+            if route:
+                out.setdefault(route, (rel, lineno))
+    return out
+
+
 def run(
     tree: SourceTree,
     client_files=CLIENT_FILES,
     fake_file: str = FAKE_FILE,
     doc_file: str = DOC_FILE,
+    http_served_files=HTTP_SERVED_FILES,
+    http_client_files=HTTP_CLIENT_FILES,
+    http_doc_file: str = HTTP_DOC_FILE,
 ) -> list[Finding]:
     used = _invoked_methods(tree, client_files)
     implemented = _implemented_methods(tree, fake_file)
@@ -129,4 +271,37 @@ def run(
     drift(doc_file, used, documented, "is invoked by the client")
     drift(doc_file, implemented, documented, "is served by the fake agent")
     drift(fake_file, documented, implemented, "is documented")
+
+    # -- HTTP wire surface (ISSUE 19) ------------------------------------
+    served = served_routes(tree, http_served_files)
+    called = called_routes(tree, http_client_files)
+    doc_routes = documented_routes(tree, http_doc_file)
+    if served or called or doc_routes:
+        for route in sorted(set(called) - set(served)):
+            rel, line = called[route]
+            findings.append(
+                Finding(
+                    PASS_ID, rel, line,
+                    f"HTTP route {route!r} is called by an internal client "
+                    f"but no serve-plane handler dispatches on it",
+                )
+            )
+        for route in sorted((set(served) | set(called)) - set(doc_routes)):
+            rel, line = served.get(route) or called[route]
+            findings.append(
+                Finding(
+                    PASS_ID, rel, line,
+                    f"HTTP route {route!r} is on the wire but missing from "
+                    f"the {http_doc_file} route table",
+                )
+            )
+        for route in sorted(set(doc_routes) - set(served)):
+            rel, line = doc_routes[route]
+            findings.append(
+                Finding(
+                    PASS_ID, rel, line,
+                    f"HTTP route {route!r} is documented but no handler "
+                    f"serves it (phantom row)",
+                )
+            )
     return findings

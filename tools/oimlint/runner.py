@@ -118,9 +118,13 @@ def main(argv: list[str] | None = None) -> int:
     new, stale = gate(findings, baseline)
     for finding in sorted(new, key=lambda f: (f.file, f.line)):
         print(finding.render())
-    if stale and not args.quiet:
+    # A stale entry is a FAILURE, not a note (ISSUE 19 CI hygiene): a
+    # baseline line whose finding no longer exists means somebody fixed
+    # the issue without shrinking the debt ledger — left in place it
+    # masks the next regression at the same key.
+    if stale:
         for key in sorted(stale):
-            print(f"oimlint: note: baseline entry no longer found: {key}")
+            print(f"oimlint: stale baseline entry (finding fixed): {key}")
         print(
             "oimlint: run --update-baseline to drop "
             f"{len(stale)} fixed entr{'y' if len(stale) == 1 else 'ies'}"
@@ -130,7 +134,8 @@ def main(argv: list[str] | None = None) -> int:
         print(
             f"oimlint: {len(new)} new finding(s), "
             f"{len(findings) - len(new)} baselined, "
+            f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'}, "
             f"{len(ALL_PASSES) if pass_ids is None else len(pass_ids)} "
             f"pass(es) in {dt:.1f}s"
         )
-    return 1 if new else 0
+    return 1 if new or stale else 0
