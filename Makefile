@@ -6,7 +6,7 @@
 PYTHON ?= python3
 PROTOC ?= protoc
 
-.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-serve-overflow test-serve-migrate test-qos test-autoscale test-jit-guard test-perf-obs lint lint-metrics lint-jax lint-conc agent clean start stop demo image test-kind
+.PHONY: all gen test test-cpu test-etcd test-health test-resilience test-observability test-serve test-serve-paged test-serve-chaos test-serve-disagg test-serve-prefix test-serve-overflow test-serve-migrate test-serve-prefill-kernel test-qos test-autoscale test-jit-guard test-perf-obs lint lint-metrics lint-jax lint-conc agent clean start stop demo image test-kind
 
 all: gen agent
 
@@ -138,6 +138,26 @@ test-serve-overflow:
 	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_serve_overflow.py -q -m "serve_overflow and not slow" \
 	  -p no:cacheprovider
+
+# Chunked paged flash-prefill (ISSUE 20, prefill_kernel marker): the
+# kernel-vs-gather exactness matrix ({greedy, temp>0, spec-decode,
+# prefix-CoW hit, mid-admission park} × {fp, kv_int8, kv_int4} ×
+# pipeline depth {1, 2} token-identical, every engine on the
+# INTERLEAVED prefill_chunk admission path), the solo-oracle pin, the
+# warm-interleaved-admission zero-compile row across segment counts,
+# the abort/cancel-mid-segment both-tier leak freedom, and the
+# stats/load/ring surface + phase-partition contracts.  Nominal ~50s;
+# the cap carries the box's 2-3x CPU-quota headroom.  Also runs the
+# oimlint lock/lifecycle/jaxvet/conc passes over the serve plane + ops
+# (the staging kernel + landing scatter live there) so the new pending-
+# prefill state stays analyzer-clean, not grandfathered in baseline.
+test-serve-prefill-kernel:
+	$(PYTHON) -m tools.oimlint \
+	  --passes lock-discipline,lock-order,atomicity,resource-lifecycle,donation-safety,host-sync-discipline,retrace-risk \
+	  --roots oim_tpu/serve,oim_tpu/ops
+	timeout -k 10 120 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_serve_prefill_kernel.py -q \
+	  -m "prefill_kernel and not slow" -p no:cacheprovider
 
 # Multi-tenant QoS (ISSUE 16, qos marker): weighted fair-share
 # admission convergence from a skewed backlog, router-side quota/rate

@@ -1334,6 +1334,132 @@ def _serve_diagnostics(extras, on_tpu, cfg, params) -> None:
             f"{kv4_blocks} int4 blocks at {kv4_row_bytes} B/row)"
         )
 
+        # Chunked flash-prefill A/B (ISSUE 20): interleaved-kernel
+        # engine (prompt KV written straight into the block pool in
+        # prefill_chunk segments that interleave with decode chunks)
+        # vs the one-shot gather control — the exact A/B the
+        # --prefill-kernel / --prefill-chunk pair switches.  Greedy
+        # workload, so the mismatch counter is an exactness bar, not a
+        # numerics shrug.  Same CPU-parity caveat as the flash-decode
+        # A/B: interpret-mode kernel legs are correctness controls;
+        # the deleted dense KV intermediate is HBM traffic the CPU
+        # never pays.
+        pf_kwargs = dict(
+            n_slots=8, max_len=512, chunk=32 if on_tpu else 4,
+            prompt_buckets=(128, 512), kv_block=64,
+        )
+        pf_kernel = Engine(
+            params, cfg, prefill_chunk=128, prefill_kernel=True,
+            **pf_kwargs,
+        )
+        pf_kernel.warmup()
+        pf_gather = Engine(
+            params, cfg, prefill_kernel=False, **pf_kwargs,
+        )
+        pf_gather.warmup()
+        pf_runs, pf_ctl_runs, pf_mismatch = _ab_legs(pf_kernel, pf_gather)
+        extras["serve_tok_per_s_prefill_kernel"] = round(
+            statistics.median(pf_runs)
+        )
+        extras["serve_tok_per_s_prefill_gather_ctl"] = round(
+            statistics.median(pf_ctl_runs)
+        )
+        extras["serve_prefill_kernel_mismatch_reqs"] = pf_mismatch
+        log(
+            f"bench: chunked flash-prefill "
+            f"{extras['serve_tok_per_s_prefill_kernel']} tok/s median vs "
+            f"one-shot gather control "
+            f"{extras['serve_tok_per_s_prefill_gather_ctl']} "
+            f"({ab_pairs} interleaved pair(s), {pf_mismatch} mismatched "
+            f"requests; CPU legs are parity controls)"
+        )
+
+        def _prefill_interleave_diagnostics(e):
+            """Active-decode TPOT while a max-length prompt admits:
+            stream one short request's tokens, land a 384-token prompt
+            mid-decode, and return (max inter-token gap of the active
+            decoder after the long submit, long prompt's TTFT, segment
+            count) — the stall the one-shot control pays for the whole
+            prefill shows up as that max gap; interleaving bounds it
+            at roughly one segment."""
+            arrivals: list[float] = []
+            first_long: list[float] = []
+
+            def on_active(tok, lp):
+                if tok is not None:
+                    arrivals.append(time.perf_counter())
+
+            def on_long(tok, lp):
+                if tok is not None and not first_long:
+                    first_long.append(time.perf_counter())
+
+            segs_before = e.stats()["prefill_segments"]
+            active = e.submit(
+                GenRequest(
+                    tokens=[(5 * j) % cfg.vocab_size for j in range(64)],
+                    max_new_tokens=48,
+                ),
+                on_token=on_active,
+            )
+            for _ in range(4):  # warm the decoder into its chunk loop
+                e.step()
+            t_sub = time.perf_counter()
+            long_rid = e.submit(
+                GenRequest(
+                    tokens=[
+                        (7 * j + 1) % cfg.vocab_size for j in range(384)
+                    ],
+                    max_new_tokens=8,
+                ),
+                on_token=on_long,
+            )
+            results = e.run()
+            assert len(results[active]) == 48
+            assert len(results[long_rid]) == 8
+            after = [t for t in arrivals if t >= t_sub]
+            gaps = [
+                b - a
+                for a, b in zip([t_sub] + after[:-1], after)
+            ]
+            ttft = (first_long[0] - t_sub) if first_long else 0.0
+            return (
+                max(gaps) if gaps else 0.0,
+                ttft,
+                e.stats()["prefill_segments"] - segs_before,
+            )
+
+        int_gap, int_ttft, int_segs = _prefill_interleave_diagnostics(
+            pf_kernel
+        )
+        ctl_gap, ctl_ttft, ctl_segs = _prefill_interleave_diagnostics(
+            pf_gather
+        )
+        del pf_kernel
+        del pf_gather
+        extras["serve_prefill_interleave_decode_gap_ms"] = round(
+            int_gap * 1000, 1
+        )
+        extras["serve_prefill_oneshot_decode_gap_ms"] = round(
+            ctl_gap * 1000, 1
+        )
+        extras["serve_prefill_interleave_ttft_ms"] = round(
+            int_ttft * 1000, 1
+        )
+        extras["serve_prefill_oneshot_ttft_ms"] = round(
+            ctl_ttft * 1000, 1
+        )
+        extras["serve_prefill_interleave_segments"] = int_segs
+        log(
+            f"bench: long-prompt interference — active decoder's max "
+            f"inter-token gap {extras['serve_prefill_interleave_decode_gap_ms']}"
+            f" ms interleaved ({int_segs} segments, TTFT "
+            f"{extras['serve_prefill_interleave_ttft_ms']} ms) vs "
+            f"{extras['serve_prefill_oneshot_decode_gap_ms']} ms one-shot "
+            f"control ({ctl_segs} segment, TTFT "
+            f"{extras['serve_prefill_oneshot_ttft_ms']} ms): interleaving "
+            f"trades TTFT for a bounded decode stall"
+        )
+
         if not on_tpu:
             return
         # Speculative serving on echo-heavy prompts (prompt-lookup's

@@ -48,6 +48,15 @@ _DEFAULTS: dict[str, Any] = {
     # which replicas run the fast path.
     "paged_kernel": False,
     "kv_int4": False,
+    # Chunked flash-prefill (ISSUE 20; False/zeros from publishers
+    # predating the fields — tolerant-decode defaults): whether the
+    # backend prefills through the block-pool flash kernel, its
+    # segment size (0 = one-shot admission), and the cumulative
+    # prompt-segment dispatch count — the fleet view of long-prompt
+    # admission pressure.
+    "prefill_kernel": False,
+    "prefill_chunk": 0,
+    "prefill_segments": 0,
     # Disaggregated prefill/decode (ISSUE 12; "mixed"/zeros from
     # pre-disaggregation publishers via the tolerant-decode defaults):
     # which POOL this backend serves, and its share of the fleet's

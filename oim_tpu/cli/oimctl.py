@@ -122,8 +122,8 @@ def _render_requests(entries: list[dict], dropped: int) -> None:
         f"{'RID':>5} {'BACKEND':<22} {'TENANT':<12} {'TIER':<11} "
         f"{'OUTCOME':<14} "
         f"{'E2E_MS':>9} {'QUEUE':>9} {'ADMIT':>9} {'PREFILL':>9} "
-        f"{'DECODE':>9} {'STREAM':>9} {'CHUNKS':>6} {'TOK i/o':>9} "
-        f"{'PREFIX':<10} TRACE"
+        f"{'DECODE':>9} {'STREAM':>9} {'CHUNKS':>6} {'SEGS':>4} "
+        f"{'TOK i/o':>9} {'PREFIX':<10} TRACE"
     )
     for e in entries:
         tok = f"{e.get('tokens_in', 0)}/{e.get('tokens_out', 0)}"
@@ -139,6 +139,12 @@ def _render_requests(entries: list[dict], dropped: int) -> None:
             f"{ms(e.get('admit_s'))} "
             f"{ms(e.get('prefill_s'))} {ms(e.get('decode_s'))} "
             f"{ms(e.get('stream_s'))} {e.get('chunks', 0):>6} "
+            # Chunked-prefill segment count (ISSUE 20; 0 from rings
+            # predating the field, 1 = one-shot admission): a
+            # neighbor's slow-TPOT window lining up with a many-SEGS
+            # admission is interleaved long-prompt prefill, not a
+            # backend stall.
+            f"{e.get('prefill_segments', 0):>4} "
             f"{tok:>9} "
             # Which path produced the leading KV rows (ISSUE 14):
             # local/fetched prefix hit vs recomputed prefill — a slow

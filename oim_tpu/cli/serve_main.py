@@ -262,6 +262,21 @@ def build_parser() -> argparse.ArgumentParser:
         "fires, doc/operations.md)",
     )
     p.add_argument(
+        "--prefill-kernel", choices=("auto", "on", "off"),
+        default="auto",
+        help="block-table-aware Pallas flash-prefill kernel for paged "
+        "engines (computes a prompt segment's causal attention reading "
+        "prior K/V from the block pool and writes the segment's new "
+        "K/V straight into the slot's blocks with fused quant — no "
+        "dense KV intermediate): auto (default) = on when the backend "
+        "is a TPU, on = force (interpret mode off-TPU, the exactness-"
+        "matrix configuration), off = the gather/scatter path (the "
+        "A/B control and exactness oracle; flip here if the prefill "
+        "mismatch counter fires, doc/operations.md).  Pairs with "
+        "--prefill-chunk: segments of long prompts then interleave "
+        "with decode chunks (doc/serving.md 'Chunked flash-prefill')",
+    )
+    p.add_argument(
         "--kv-block", type=int, default=0, metavar="T",
         help="paged KV cache with T-token blocks (0 = dense per-slot "
         "regions): HBM is reserved per request's worst case instead of "
@@ -562,6 +577,9 @@ def make_engine(args):
         # backend); on/off are the explicit A/B handles.
         paged_kernel={"auto": None, "on": True, "off": False}[
             args.paged_kernel
+        ],
+        prefill_kernel={"auto": None, "on": True, "off": False}[
+            args.prefill_kernel
         ],
         qos=qos,
         slow_capture_e2e_s=args.slow_capture_e2e,

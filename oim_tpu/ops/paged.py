@@ -45,11 +45,15 @@ contributes exactly nothing to the online softmax.  Both halves are
 pinned by ``tests/test_serve_paged.py``'s freed-and-reallocated
 last-block regressions.
 
-Decode can skip the gather entirely: ``ops/paged_attention.py`` is the
-Pallas flash-decode kernel that reads K/V straight from the pool
-through the block table (``Engine(paged_kernel=True)``, auto-on for TPU
-paged engines) — the gather path below stays as the A/B control and
-the prefill path.
+Both serve phases can skip the gather entirely:
+``ops/paged_attention.py`` holds the Pallas flash-decode kernel that
+reads K/V straight from the pool through the block table
+(``Engine(paged_kernel=True)``, auto-on for TPU paged engines) and the
+flash-prefill kernel that additionally WRITES a prompt segment's K/V
+straight into the slot's blocks with fused quant
+(``Engine(prefill_kernel=True)``; ``paged_store_blocks`` below is its
+block-granular landing scatter) — the gather/scatter path below stays
+as the A/B control and the exactness oracle on every backend.
 """
 
 from __future__ import annotations
@@ -93,6 +97,31 @@ def paged_store(cache, scale, new, tables, starts):
     srows = scale.reshape(n_blocks * block_size, *scale.shape[2:])
     srows = srows.at[flat].set(s, mode="drop")
     return rows.reshape(cache.shape), srows.reshape(scale.shape)
+
+
+def paged_store_blocks(cache, scale, blocks, block_scales, ids):
+    """Land whole staged blocks in the pool: ``blocks`` [N, block_size,
+    KVH, hd] (float payload — already quantized VALUES when the cache
+    is int8/int4, so the ``astype`` here is an exact integer cast) at
+    pool blocks ``ids`` [N] of ``cache`` [n_blocks, block_size, KVH,
+    hd], with ``block_scales`` [N, block_size, KVH] landing in the
+    matching ``scale`` plane (or None for fp pools).  Sentinel ids
+    (``n_blocks``) drop — same OOB contract as ``paged_store``, one
+    block at a time instead of one row at a time.  The landing half of
+    the flash-prefill kernel (``ops/paged_attention.py``): the kernel
+    STAGES merged blocks into fresh output buffers (never aliasing the
+    pool — an aliased in-place write would race Mosaic's double-
+    buffered prefetch of a clamped sentinel read against another grid
+    step's live overlay of the same block), and this scatter lands
+    them.  Live ids are unique by construction (distinct table entries
+    of one row name distinct blocks; write windows never cover blocks
+    shared across rows), so the scatter's duplicate-index order never
+    matters."""
+    out = cache.at[ids].set(blocks.astype(cache.dtype), mode="drop")
+    if scale is None:
+        return out, None
+    sout = scale.at[ids].set(block_scales, mode="drop")
+    return out, sout
 
 
 def paged_view(cache, scale, tables):

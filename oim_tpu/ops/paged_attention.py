@@ -48,10 +48,21 @@ allows, token-identically where it does not):
   exactness matrix runs in tier-1 on CPU; the HBM win is claimed by
   the TPU bench rows (doc/operations.md "CPU-backend caveat").
 
-Decode-only by design: admission prefill keeps the gather (prefill is
-compute-bound — the dense intermediate it materializes is the bytes the
-MXU was going to stream anyway), which also keeps this kernel's q tile
-small ([t·group, hd], t = 1 or spec_decode+1).
+Prefill rides the same machinery (ISSUE 20): ``paged_flash_prefill``
+first STAGES a prompt segment's freshly-projected K/V into
+block-granular merged buffers (``_prefill_stage`` below — fused
+int8/int4 quant per the exact ``ops/quant.py`` formulas, straddle
+blocks merged row-wise with the pool's current contents), lands them
+through ``ops/paged.py::paged_store_blocks``'s sentinel-dropping
+block scatter, then runs the SAME flash attend above over the updated
+pool — its q-row path already handles arbitrary ``t`` (``q_pos =
+starts[b] + i``), so a segment's causal prefill is just a tall decode.
+Segment K/V bytes cross HBM once, quantized, with no dense
+intermediate.  Staging never aliases the pool: an in-place aliased
+write would let Mosaic's double-buffered input prefetch of a clamped
+sentinel read race another grid step's live overlay of the same block
+— the staged-buffers-plus-XLA-scatter split keeps every read-before-
+write ordering explicit in the dataflow.
 """
 
 from __future__ import annotations
@@ -67,6 +78,7 @@ from jax.experimental.pallas import tpu as pltpu
 # interpret policy for BOTH flash kernels — a divergence here would be
 # a silent numerics split between training and serving attention.
 from oim_tpu.ops.flash_attention import _LANES, _NEG_BIG, _interpret, _lanes
+from oim_tpu.ops.paged import paged_store_blocks
 
 
 def supported_block_size(block_size: int, head_dim: int) -> bool:
@@ -248,3 +260,216 @@ def paged_flash_decode(
     return out.reshape(b, kvh, t, group, hd).transpose(
         0, 2, 1, 3, 4
     ).reshape(b, t, h, hd)
+
+
+def _prefill_stage_kernel(
+    tables_ref, starts_ref, kn_ref, vn_ref, kp_ref, vp_ref, *rest,
+    t, block_size, quantized, int4,
+):
+    """One grid step = one (slot b, window block jw): merge the rows of
+    pool block ``starts[b] // block_size + jw`` that fall inside this
+    row's write window ``[starts[b], starts[b] + t)`` — freshly
+    projected, quantized in place — with the block's CURRENT contents
+    everywhere else (the straddle rows a prior segment already wrote,
+    and the not-yet-written tail), and emit the merged block to the
+    staging output.  Rows are quantized independently (one scale per
+    [position, kv-head] row, the ``paged_store`` granularity), so the
+    row-wise merge is exact.  Blocks whose table entry is the sentinel
+    stage clamped garbage that the landing scatter then DROPS — this
+    kernel never needs its own sentinel predicate, only the pool's
+    everything-stays-finite invariant (``ops/paged.py``)."""
+    if quantized:
+        ksp_ref, vsp_ref, ko_ref, vo_ref, kso_ref, vso_ref = rest
+    else:
+        ko_ref, vo_ref = rest
+    b = pl.program_id(0)
+    jw = pl.program_id(1)
+    start = starts_ref[b]
+    # Window-relative offset of this block's row 0.  The new-KV operand
+    # is padded by one block on each side, so the dynamic slice below
+    # stays in range for every straddle (o ∈ (-bs, t + bs]); rows the
+    # slice pulls from the padding are masked off by ``inside``.
+    o = (start // block_size + jw) * block_size - start
+    s0 = jnp.minimum(o, t) + block_size
+    kseg = kn_ref[0, pl.ds(s0, block_size)].astype(jnp.float32)
+    vseg = vn_ref[0, pl.ds(s0, block_size)].astype(jnp.float32)
+    rows = jax.lax.broadcasted_iota(jnp.int32, kseg.shape, 0)
+    inside = ((o + rows) >= 0) & ((o + rows) < t)    # [bs, kvh, hd]
+    kp = kp_ref[0].astype(jnp.float32)
+    vp = vp_ref[0].astype(jnp.float32)
+    if not quantized:
+        # fp pool: the landing astype round-trips bf16 losslessly, so
+        # keep-rows rewrite bit-identical bytes.
+        ko_ref[0, 0] = jnp.where(inside, kseg, kp)
+        vo_ref[0, 0] = jnp.where(inside, vseg, vp)
+        return
+
+    def quant(x):
+        # EXACTLY ops/quant.py's quantize_int8 / quantize_int4 —
+        # last-axis reductions are order-independent, so the staged
+        # values are bit-identical to what paged_store would land.
+        amax = jnp.max(jnp.abs(x), axis=-1)
+        if int4:
+            scale = jnp.maximum(amax / 7.0, 1e-8)
+            q = jnp.clip(jnp.round(x / scale[..., None]), -7.0, 7.0)
+        else:
+            scale = jnp.maximum(amax / 127.0, 1e-8)
+            q = jnp.round(x / scale[..., None])
+        return q, scale
+
+    kq, ks = quant(kseg)
+    vq, vs = quant(vseg)
+    ko_ref[0, 0] = jnp.where(inside, kq, kp)
+    vo_ref[0, 0] = jnp.where(inside, vq, vp)
+    rows2 = jax.lax.broadcasted_iota(jnp.int32, ks.shape, 0)
+    inside2 = ((o + rows2) >= 0) & ((o + rows2) < t)  # [bs, kvh]
+    kso_ref[0, 0] = jnp.where(inside2, ks, ksp_ref[0].astype(jnp.float32))
+    vso_ref[0, 0] = jnp.where(inside2, vs, vsp_ref[0].astype(jnp.float32))
+
+
+# oimlint: hotpath
+def paged_flash_prefill(
+    q, k_new, v_new, k_pool, v_pool, k_scale, v_scale, tables, starts,
+    *, window: int = 0,
+):
+    """One prompt segment's causal attention straight off (and INTO)
+    the paged pool: stage ``k_new``/``v_new`` [B, t, KVH, hd] into the
+    write window ``[starts[b], starts[b] + t)`` of each row's blocks
+    with fused quant (``_prefill_stage_kernel``), land the merged
+    blocks through the sentinel-dropping block scatter
+    (``paged_store_blocks``), then attend with the flash-decode kernel
+    — whose q-row arithmetic already covers arbitrary ``t`` — over the
+    updated pool.  Returns ``(out [B, t, H, hd] float32, k_pool,
+    v_pool, k_scale, v_scale)``: the gather path's pre-``wo``
+    attention output plus the updated pool planes, so the caller swaps
+    this in exactly where it called ``paged_store`` + dense attention.
+
+    Exactness contract: the landed bytes equal ``paged_store``'s for
+    every in-window row (same quant formulas, same OOB-drop), prior
+    rows and future garbage keep their current pool bytes, and the
+    attend is the kernel the decode matrix already pins token-identical
+    to the gather — so kernel prefill == gather prefill, token for
+    token (tests/test_serve_prefill_kernel.py).
+
+    Same one-compile property as decode: tables/starts are data, the
+    segment length ``t`` is the only shape the engine varies (its
+    prefill_chunk bucket — one compile per bucket, pinned by the
+    jit-guard suite).
+    """
+    b, t, h, hd = q.shape
+    n_blocks, block_size, kvh, _ = k_pool.shape
+    n_tables = tables.shape[1]
+    if h % kvh:
+        raise ValueError(f"n_heads {h} not divisible by kv_heads {kvh}")
+    if not supported_block_size(block_size, hd):
+        raise ValueError(
+            f"paged_flash_prefill needs block_size and head_dim each "
+            f"<= {_LANES} or a multiple of {_LANES} (the lane-tiling "
+            f"constraint); got block_size={block_size}, head_dim={hd} "
+            f"— use the gather path (prefill_kernel=False) for this "
+            f"geometry"
+        )
+    quantized = k_scale is not None
+    int4 = bool(k_pool.dtype == jnp.int4)
+    # A t-row window starting at an arbitrary in-block offset straddles
+    # at most cdiv(t, bs) + 1 consecutive table entries.
+    n_w = -(-t // block_size) + 1
+    tables = tables.astype(jnp.int32)
+    starts = starts.astype(jnp.int32)
+    # Pad the new K/V by one block on each side so the staging kernel's
+    # dynamic straddle slice is always in range (pad rows mask off).
+    pad = ((0, 0), (block_size, block_size), (0, 0), (0, 0))
+    kn = jnp.pad(k_new, pad)
+    vn = jnp.pad(v_new, pad)
+
+    def seg_map(b_, jw_, tables_ref, starts_ref):
+        return (b_, 0, 0, 0)
+
+    def pool_map(b_, jw_, tables_ref, starts_ref):
+        entry = jnp.minimum(
+            starts_ref[b_] // block_size + jw_, n_tables - 1
+        )
+        return (jnp.minimum(tables_ref[b_, entry], n_blocks - 1), 0, 0, 0)
+
+    def pool_scale_map(b_, jw_, tables_ref, starts_ref):
+        entry = jnp.minimum(
+            starts_ref[b_] // block_size + jw_, n_tables - 1
+        )
+        return (jnp.minimum(tables_ref[b_, entry], n_blocks - 1), 0, 0)
+
+    def out_map(b_, jw_, tables_ref, starts_ref):
+        return (b_, jw_, 0, 0, 0)
+
+    def out_scale_map(b_, jw_, tables_ref, starts_ref):
+        return (b_, jw_, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, t + 2 * block_size, kvh, hd), seg_map),
+        pl.BlockSpec((1, t + 2 * block_size, kvh, hd), seg_map),
+        pl.BlockSpec((1, block_size, kvh, hd), pool_map),
+        pl.BlockSpec((1, block_size, kvh, hd), pool_map),
+    ]
+    operands = [kn, vn, k_pool, v_pool]
+    out_specs = [
+        pl.BlockSpec((1, 1, block_size, kvh, hd), out_map),
+        pl.BlockSpec((1, 1, block_size, kvh, hd), out_map),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b, n_w, block_size, kvh, hd), jnp.float32),
+        jax.ShapeDtypeStruct((b, n_w, block_size, kvh, hd), jnp.float32),
+    ]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, block_size, kvh), pool_scale_map),
+            pl.BlockSpec((1, block_size, kvh), pool_scale_map),
+        ]
+        operands += [k_scale, v_scale]
+        out_specs += [
+            pl.BlockSpec((1, 1, block_size, kvh), out_scale_map),
+            pl.BlockSpec((1, 1, block_size, kvh), out_scale_map),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((b, n_w, block_size, kvh), jnp.float32),
+            jax.ShapeDtypeStruct((b, n_w, block_size, kvh), jnp.float32),
+        ]
+    staged = pl.pallas_call(
+        functools.partial(
+            _prefill_stage_kernel,
+            t=t, block_size=block_size, quantized=quantized, int4=int4,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, n_w),
+            in_specs=in_specs,
+            out_specs=out_specs,
+        ),
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(tables, starts, *operands)
+    if quantized:
+        ko, vo, kso, vso = staged
+    else:
+        (ko, vo), kso, vso = staged, None, None
+    # Landing ids: the window's table entries, sentinel for anything
+    # past the table or already-sentinel (drops at the pool edge).
+    entries = starts[:, None] // block_size + jnp.arange(n_w)[None, :]
+    ids = jnp.take_along_axis(
+        tables, jnp.minimum(entries, n_tables - 1), axis=1
+    )
+    ids = jnp.where(
+        (entries < n_tables) & (ids < n_blocks), ids, n_blocks
+    ).reshape(-1)
+    bw = b * n_w
+    k_pool, k_scale = paged_store_blocks(
+        k_pool, k_scale, ko.reshape(bw, block_size, kvh, hd),
+        None if kso is None else kso.reshape(bw, block_size, kvh), ids,
+    )
+    v_pool, v_scale = paged_store_blocks(
+        v_pool, v_scale, vo.reshape(bw, block_size, kvh, hd),
+        None if vso is None else vso.reshape(bw, block_size, kvh), ids,
+    )
+    out = paged_flash_decode(
+        q, k_pool, v_pool, k_scale, v_scale, tables, starts,
+        window=window,
+    )
+    return out, k_pool, v_pool, k_scale, v_scale

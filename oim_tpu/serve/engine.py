@@ -117,7 +117,7 @@ from oim_tpu.ops.paged import (
     read_block,
     write_block,
 )
-from oim_tpu.ops.paged_attention import paged_flash_decode
+from oim_tpu.ops.paged_attention import paged_flash_decode, paged_flash_prefill
 from oim_tpu.serve.disagg import (
     KV_HOLD_MAX,
     KV_HOLD_TTL_S,
@@ -583,6 +583,7 @@ def _slot_store(cache, scale, new, starts):
 def _slot_attention(
     x, lp, k_cache, v_cache, k_scale, v_scale, starts,
     cfg: TransformerConfig, tables=None, paged_kernel: bool = False,
+    prefill_kernel: bool = False,
 ):
     """Cached attention with per-slot start positions.
 
@@ -612,6 +613,14 @@ def _slot_attention(
     store half and the qkv/rope/wo math above and below are shared
     either way, so the kernel path's output is pinned token-identical
     to the gather path's by tests/test_serve_paged.py.
+
+    ``prefill_kernel`` (trace-time static, paged only, admission legs
+    only) goes one further for prompt segments: the flash-PREFILL
+    kernel both writes the segment's K/V straight into the slot's
+    blocks (fused quant, no dense intermediate) and attends off the
+    pool — ``paged_store`` + gather + dense attention collapse into
+    one pass over the cache bytes.  Token-identical to the gather leg
+    by tests/test_serve_prefill_kernel.py.
     """
     b, t, _ = x.shape
     h, hd, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
@@ -640,6 +649,19 @@ def _slot_attention(
         k_view, ks_view = k_cache, k_scale
         v_view, vs_view = v_cache, v_scale
     else:
+        if prefill_kernel:
+            # Flash-prefill path: store and attend fused — the staged
+            # blocks land through the sentinel-dropping block scatter
+            # (bytes identical to paged_store's), then the flash
+            # kernel attends off the updated pool.
+            out, k_cache, v_cache, k_scale, v_scale = paged_flash_prefill(
+                q, k, v, k_cache, v_cache, k_scale, v_scale, tables,
+                starts, window=cfg.sliding_window,
+            )
+            out = out.astype(x.dtype).reshape(b, t, h * hd)
+            return x + jnp.einsum(
+                "btn,nd->btd", out, lp["wo"]
+            ).astype(x.dtype), (k_cache, v_cache, k_scale, v_scale)
         k_cache, k_scale = paged_store(k_cache, k_scale, k, tables, starts)
         v_cache, v_scale = paged_store(v_cache, v_scale, v, tables, starts)
         if paged_kernel:
@@ -689,7 +711,10 @@ def _slot_attention(
     )
 
 
-def _hidden_slots(params, tokens, kv, starts, cfg, paged_kernel=False):
+def _hidden_slots(
+    params, tokens, kv, starts, cfg, paged_kernel=False,
+    prefill_kernel=False,
+):
     """tokens [B, t] at per-slot positions ``starts`` → (final-norm
     hidden states [B, t, D], kv) — no unembedding, so prefill callers
     can unembed only the one position they sample from (the unembed is
@@ -703,7 +728,9 @@ def _hidden_slots(params, tokens, kv, starts, cfg, paged_kernel=False):
     block table [B, n_tables], threaded through the scan untouched —
     ``_slot_attention`` scatters/gathers through it per layer
     (``paged_kernel`` — trace-time static — flips that layer read to
-    the flash-decode kernel; ignored on the dense layout).
+    the flash-decode kernel; ``prefill_kernel`` flips the whole
+    store+attend to the flash-prefill kernel on admission legs; both
+    ignored on the dense layout).
     MoE routing follows ``models/decode.py``: drop-free per-token top-k
     (``_moe_exact``) on prefill AND incremental steps — per-token routing
     is what makes engine results independent of padding, batch packing,
@@ -735,6 +762,7 @@ def _hidden_slots(params, tokens, kv, starts, cfg, paged_kernel=False):
             idx(ks_all) if quantized else None,
             idx(vs_all) if quantized else None,
             starts, cfg, tables=tables, paged_kernel=paged_kernel,
+            prefill_kernel=prefill_kernel,
         )
         k_all, v_all = put(k_all, k_l), put(v_all, v_l)
         if quantized:
@@ -803,7 +831,7 @@ def _admit_batch(
     params, cache, row_tables, history, tok_counts, gen_counts,
     prompt_counts, full_rows, prompts, slots, starts,
     true_tails, temps, top_ps, min_ps, reps, press, freqs, keys,
-    *, cfg, top_k, track_history, penalize,
+    *, cfg, top_k, track_history, penalize, prefill_kernel=False,
 ):
     """Prefill a whole GROUP of admissions in one dispatch and sample
     each one's first generated token.  Returns
@@ -856,7 +884,10 @@ def _admit_batch(
         # only in each row's freshly-allocated blocks — the host
         # allocator never hands a shared block to a writer).
         kv = (cache.k, cache.v, cache.k_scale, cache.v_scale, row_tables)
-        x, kv = _hidden_slots(params, prompts, kv, starts, cfg)
+        x, kv = _hidden_slots(
+            params, prompts, kv, starts, cfg,
+            prefill_kernel=prefill_kernel,
+        )
         k_all, v_all, ks_all, vs_all = kv[:4]
         lengths = cache.lengths.at[slots].set(
             starts + true_tails, mode="drop"
@@ -973,7 +1004,7 @@ def _decode_chunk(
         kv, lengths, tok, tok_c, gen_c = carry
         x, kv = _hidden_slots(
             params, tok[:, None], kv, lengths, cfg,
-            paged_kernel=paged_kernel,
+            paged_kernel=paged_kernel, prefill_kernel=False,
         )
         logits = _unembed(x, dequantize_named(params, "wlm"), cfg)
         keys = jax.vmap(jax.random.fold_in)(bases, counts + i)
@@ -1077,7 +1108,8 @@ def _verify_emit(
     (kv, lengths, tok_next, emitted, lps, n_emit)."""
     inputs = jnp.concatenate([tok[:, None], drafts], axis=1)
     x, kv = _hidden_slots(
-        params, inputs, kv, lengths, cfg, paged_kernel=paged_kernel
+        params, inputs, kv, lengths, cfg, paged_kernel=paged_kernel,
+        prefill_kernel=False,
     )
     logits = _unembed(x, dequantize_named(params, "wlm"), cfg)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, L+1]
@@ -1466,6 +1498,15 @@ class _PhaseTrace:
     # "fetched" prefix-cache hit, or "recomputed" prefill — stamped at
     # admission, surfaced in the request ring (`oimctl requests`).
     prefix_source: str = "recomputed"
+    # Chunked-prefill attribution (ISSUE 20): how many prompt segments
+    # this request's admission dispatched (1 = one-shot; > 1 = the
+    # long-prompt interleaved path), plus one host dispatch wall per
+    # segment.  The interleaved segments all fall inside [t_prefill,
+    # t_first] — decode chunks the engine ran BETWEEN them belong to
+    # the slots that emitted, so the phase partition is untouched (the
+    # PR 9 reconciliation test keeps passing by construction).
+    prefill_segments: int = 0
+    segment_walls: list[float] = field(default_factory=list)
     # One record per decode chunk this request consumed tokens from:
     # (chunk seq, span start, done, tokens, dispatch_wait_s,
     # fetch_wait_s) — dispatch-wait vs fetch-wait from the step loop's
@@ -1525,6 +1566,32 @@ class _InFlightChunk:
     # with the dispatch-wait vs fetch-wait split without re-measuring.
     seq: int = 0
     dispatch_wall: float = 0.0
+
+
+@dataclass
+class _PendingPrefill:
+    """A long-prompt admission mid-flight through chunked prefill
+    (ISSUE 20): the slot is assigned and its blocks committed, the
+    first segment(s) dispatched, and ``segs`` holds what remains.  The
+    admission wave advances each pending by ONE segment per wave, so
+    decode chunks for active slots interleave between segments at
+    pipeline boundaries instead of stalling behind the whole prompt
+    (Sarathi-style stall-free scheduling); when the last segment is
+    gone the request JOINS that wave's normal group dispatch (final
+    ``tail``, real first-token sample).  The rid stays in
+    ``_admitting`` throughout, so abort() reclaims the slot exactly as
+    for a one-shot admission; cancel/deadline are reaped at the wave's
+    advance pass (the pending twin of _reap's slot loop)."""
+
+    rid: int
+    req: GenRequest
+    slot: int
+    plan: dict | None
+    segs: list[list[int]]  # remaining non-final segments
+    tail: list[int]        # final segment (group dispatch samples it)
+    start: int             # next segment's write position
+    t_submit: float
+    trace: _PhaseTrace
 
 
 class Engine:
@@ -1590,6 +1657,7 @@ class Engine:
         kv_block: int = 0,
         kv_blocks: int = 0,
         paged_kernel: bool | None = None,
+        prefill_kernel: bool | None = None,
         kv_host_bytes: int = 0,
         kv_park: bool = True,
         qos=None,
@@ -1687,6 +1755,28 @@ class Engine:
                     f"kv_block={kv_block}, head_dim={cfg.head_dim} — "
                     f"run this geometry with the gather path "
                     f"(paged_kernel=False / --paged-kernel off)"
+                )
+        # Paged flash-PREFILL kernel (ISSUE 20): same auto policy as
+        # paged_kernel — prompt-segment K/V lands straight in the
+        # slot's blocks with fused quant and the segment attends off
+        # the pool, no dense intermediate.  Gather stays the off-TPU
+        # default, the A/B control, and the exactness oracle.
+        if prefill_kernel and not self.paged:
+            raise ValueError("prefill_kernel needs a paged cache (kv_block)")
+        self.prefill_kernel = bool(self.paged) and (
+            prefill_kernel if prefill_kernel is not None
+            else jax.default_backend() == "tpu"
+        )
+        if self.prefill_kernel:
+            from oim_tpu.ops.paged_attention import supported_block_size
+
+            if not supported_block_size(kv_block, cfg.head_dim):
+                raise ValueError(
+                    f"prefill_kernel needs kv_block and head_dim each "
+                    f"<= 128 or a multiple of 128 (lane tiling); got "
+                    f"kv_block={kv_block}, head_dim={cfg.head_dim} — "
+                    f"run this geometry with the gather path "
+                    f"(prefill_kernel=False / --prefill-kernel off)"
                 )
         if spec_decode < 0 or (spec_decode and spec_ngram < 1):
             raise ValueError(
@@ -2079,7 +2169,8 @@ class Engine:
         self._admit = jax.jit(
             partial(_admit_batch, cfg=cfg, top_k=top_k,
                     track_history=bool(spec_decode) and draft_cfg is None,
-                    penalize=penalties),
+                    penalize=penalties,
+                    prefill_kernel=self.prefill_kernel),
             # cache, history, tok_counts, gen_counts (row_tables at 2
             # is NOT donated: dense engines pass a shared dummy).
             donate_argnums=(1, 3, 4, 5),
@@ -2246,6 +2337,16 @@ class Engine:
         # _slots: abort() fails these too (and reclaims their slots), so
         # a crash mid-admission can never strand a blocked result() call.
         self._admitting: dict[int, int] = {}
+        # rid → _PendingPrefill: long-prompt admissions advancing one
+        # segment per admission wave (ISSUE 20).  Every rid here is
+        # ALSO in _admitting (slot assigned, blocks committed) — this
+        # dict only carries the segment cursor and phase trace between
+        # waves.  Driver-thread-written under self._lock.
+        self._prefilling: dict[int, "_PendingPrefill"] = {}
+        # Cumulative prompt segments dispatched (final group segments
+        # included): stats()/load() surface it so operators can see
+        # how much admission work runs chunked vs one-shot.
+        self.prefill_segments = 0
         # rid → (tokens, logprobs), consumed by result_full/result.
         self._results: dict[int, tuple[list[int], list[float]]] = {}
         self._events: dict[int, threading.Event] = {}
@@ -2949,7 +3050,18 @@ class Engine:
             pending: list[tuple] = [
                 (rid, req, t, None) for rid, req, t in self._queue
             ]
-            pending += [(rid, None, None, None) for rid in self._admitting]
+            # Mid-prefill rids are in _admitting too; let their entry
+            # carry the request + partial phase clock for the ring.
+            pending += [
+                (rid, None, None, None)
+                for rid in self._admitting
+                if rid not in self._prefilling
+            ]
+            pending += [
+                (p.rid, p.req, p.t_submit, None)
+                for p in self._prefilling.values()
+            ]
+            self._prefilling.clear()
             pending += [
                 (s.rid, None, None, s) for s in self._slots.values()
             ]
@@ -2998,6 +3110,11 @@ class Engine:
             return bool(
                 self._queue or self._slots or self._prefix_installs
                 or self._parked or self._pending_host_writes
+                # Mid-prefill long prompts (ISSUE 20): the loop must
+                # keep stepping so their remaining segments dispatch
+                # and the final segment's wave samples their first
+                # token.
+                or self._prefilling
             )
 
     def info(self) -> dict:
@@ -3058,6 +3175,7 @@ class Engine:
                 ),
                 "kv_park": self.kv_park,
                 "paged_kernel": self.paged_kernel,
+                "prefill_kernel": self.prefill_kernel,
                 # Whether a tenant policy is loaded (ISSUE 16): with
                 # False, admission is FIFO and nothing preempts.
                 "qos": self._qos_policy is not None,
@@ -3150,6 +3268,15 @@ class Engine:
                 # mismatches → restart with the kernel off).
                 "paged_kernel": self.paged_kernel,
                 "kv_quant": self.kv_quant,
+                # Chunked flash-prefill (ISSUE 20): which prefill path
+                # this engine runs, the segment size, the cumulative
+                # prompt-segment dispatch count (one-shot admissions
+                # count 1), and how many long prompts are mid-
+                # interleave right now.
+                "prefill_kernel": self.prefill_kernel,
+                "prefill_chunk": self.prefill_chunk,
+                "prefill_segments": self.prefill_segments,
+                "prefilling": len(self._prefilling),
                 # Disaggregated-serving transfer state (serve/disagg.py;
                 # zeros on a dense engine).
                 "kv_holds": len(self._kv_holds),
@@ -3330,6 +3457,14 @@ class Engine:
                 # the mismatch counter says the kernel misbehaves).
                 "paged_kernel": self.paged_kernel,
                 "kv_int4": self.kv_int4,
+                # Chunked flash-prefill (ISSUE 20, tolerant decode:
+                # zeros/False from publishers predating the fields):
+                # which prefill path this backend runs, its segment
+                # size, and the cumulative segment-dispatch count —
+                # the fleet view of long-prompt admission pressure.
+                "prefill_kernel": self.prefill_kernel,
+                "prefill_chunk": self.prefill_chunk,
+                "prefill_segments": self.prefill_segments,
                 # KV-transfer counters (serve/disagg.py): this
                 # backend's share of the fleet's ship traffic, for the
                 # router's /v1/stats and `oimctl top` pool columns.
@@ -3520,6 +3655,19 @@ class Engine:
             "prefix": (
                 phases.prefix_source if phases is not None
                 else "recomputed"
+            ),
+            # Chunked-prefill attribution (ISSUE 20; `oimctl requests`
+            # SEGS column): how many prompt-segment dispatches this
+            # admission took (1 = one-shot) and the host walls of the
+            # non-final segments — the long-prompt interference
+            # forensic: a neighbor's slow TPOT window lining up with a
+            # many-SEGS admission is interleaved prefill, not a stall.
+            "prefill_segments": (
+                phases.prefill_segments if phases is not None else 0
+            ),
+            "segment_walls": (
+                [round(w, 6) for w in phases.segment_walls]
+                if phases is not None else []
             ),
             "ts": time.time(),
         }
@@ -5567,7 +5715,10 @@ class Engine:
         with self._lock:
             if not self._migrate_out:
                 return
-            if not (self._queue or self._slots or self._parked):
+            if not (
+                self._queue or self._slots or self._parked
+                or self._prefilling
+            ):
                 return
             for rid, req, t_sub in self._queue:
                 self._fail_locked(
@@ -5581,6 +5732,27 @@ class Engine:
             if self._queue:
                 self._queue.clear()
                 self._m_queued.set(0.0, self._engine_label)
+            # Mid-prefill long prompts (ISSUE 20) have no emitted
+            # tokens and no complete KV to capture: fail them like
+            # queued entries (sibling recomputes token-identically)
+            # and reclaim slot + blocks.
+            for rid in list(self._prefilling):
+                pend = self._prefilling.pop(rid)
+                self._admitting.pop(rid, None)
+                self._free.append(pend.slot)
+                self._release_slot_blocks_locked(pend.slot)
+                self._fail_locked(
+                    rid, "migrated",
+                    (
+                        f"backend draining mid-prefill "
+                        f"({pend.trace.prefill_segments} segments "
+                        f"written; recompute on a sibling)"
+                    ),
+                    req=pend.req, t_submit=pend.t_submit,
+                )
+                cb = self._callbacks.pop(rid, None)
+                if cb is not None:
+                    ended.append(cb)
             order = sorted(
                 self._slots.items(),
                 key=lambda kv: (
@@ -6129,6 +6301,13 @@ class Engine:
                 # the boundary must happen for it to run at all.
                 or self._qos_preempt_pending_locked()
             )
+            # A mid-prefill long prompt forces the boundary too
+            # (ISSUE 20): its next segment may only dispatch from the
+            # admission wave, and the wave early-returns while a chunk
+            # is in flight — without this, a saturated depth-2 engine
+            # would chain decode chunks forever and never finish the
+            # newcomer's prefill.
+            admit_boundary = admit_boundary or bool(self._prefilling)
             boundary = (
                 admit_boundary or self.pipeline_depth < 2 or elide_tail
             )
@@ -6286,6 +6465,78 @@ class Engine:
         for cb in ended:  # end-of-stream outside the lock
             cb(None, None)
 
+    def _advance_prefills(self) -> "list[_PendingPrefill]":
+        """One admission-boundary advance of every mid-prefill long
+        prompt (ISSUE 20).  Reap cancelled/expired pendings first
+        (slot and blocks freed, both tiers — the pending twin of
+        _reap's active-slot loop), then dispatch exactly ONE further
+        segment per pending — the pacing unit that bounds how much
+        prefill work lands between two decode chunks, which is the
+        whole point of interleaving.  Pendings whose segments were
+        already exhausted JOIN this wave's group dispatch (the caller
+        appends them to its rows) for their real first-token sample;
+        they are popped from ``_prefilling`` here but stay in
+        ``_admitting`` until registration, so abort() still reclaims
+        them if the group dispatch dies."""
+        now = time.monotonic()
+        ended = []
+        with self._lock:
+            for rid in list(self._prefilling):
+                pend = self._prefilling[rid]
+                if rid in self._cancelled:
+                    kind = "cancelled"
+                    msg = "client went away during chunked prefill"
+                elif (
+                    pend.req.deadline is not None
+                    and now >= pend.req.deadline
+                ):
+                    kind = "deadline"
+                    msg = (
+                        f"expired mid-prefill "
+                        f"({pend.trace.prefill_segments} segments written)"
+                    )
+                    if not self._warming:
+                        self._m_deadline.inc()
+                else:
+                    continue
+                self._prefilling.pop(rid)
+                self._admitting.pop(rid, None)
+                self._free.append(pend.slot)
+                self._release_slot_blocks_locked(pend.slot)
+                self._fail_locked(
+                    rid, kind, msg, req=pend.req, t_submit=pend.t_submit
+                )
+                cb = self._callbacks.pop(rid, None)
+                if cb is not None:
+                    ended.append(cb)
+            advancing = list(self._prefilling.values())
+        self._drain_fail_obs()
+        for cb in ended:  # end-of-stream outside the lock
+            cb(None, None)
+        joining = []
+        for pend in advancing:
+            if not pend.segs:
+                joining.append(pend)
+                continue
+            seg = pend.segs.pop(0)
+            # Sentinel context: a recompile during this segment
+            # dispatch names the request (replaced wholesale, never
+            # mutated — the compile listener reads it lock-free).
+            self._sentinel_ctx = {"phase": "admit", "rids": (pend.rid,)}
+            t0 = time.monotonic()
+            self._prefill_segment(
+                pend.slot, pend.req, seg, pend.start, pend.plan
+            )
+            pend.trace.segment_walls.append(time.monotonic() - t0)
+            pend.trace.prefill_segments += 1
+            pend.start += len(seg)
+            self.prefill_segments += 1
+        if joining:
+            with self._lock:
+                for pend in joining:
+                    self._prefilling.pop(pend.rid, None)
+        return joining
+
     def _admit_wave(self, acc: list) -> None:  # oimlint: hotpath
         """Admit whatever fits into free slots.
 
@@ -6317,6 +6568,13 @@ class Engine:
         # bounds how long a swap-out lasts once capacity returns.
         if self._parked:
             self._unpark_wave()
+        # Mid-prefill long prompts advance ONE segment each, and the
+        # ones whose prompt is fully written join this wave's group
+        # dispatch below (ISSUE 20) — before new admissions, because
+        # they were admitted first.
+        joins = (
+            self._advance_prefills() if self._prefilling else []
+        )
         with self._lock:
             admissions = []
             # Slot-shortage priority preemption (ISSUE 16): with every
@@ -6400,20 +6658,21 @@ class Engine:
             )
             self._m_queued.set(float(len(self._queue)), self._engine_label)
 
-        if admissions:
+        if admissions or joins:
             # Sentinel context (ISSUE 18): replaced wholesale, never
             # mutated — the compile listener reads it lock-free, so a
             # recompile during this wave's prefill dispatches names the
             # admitted requests.
             self._sentinel_ctx = {
                 "phase": "admit",
-                "rids": tuple(rid for _, rid, _, _, _ in admissions),
+                "rids": tuple(rid for _, rid, _, _, _ in admissions)
+                + tuple(p.rid for p in joins),
             }
             # Phase clock: every admission in this wave left the queue
             # at the pop above — one boundary instant serves the wave.
             t_admitted = time.monotonic()
             n_slots = self._cache.n_slots
-            # (slot, rid, req, t_submit, start, tail, bucket, t_prefill,
+            # (slot, rid, req, t_submit, start, tail, bucket, trace,
             #  plan)
             rows = []
             # The wave's prefill work (prefix-cache injections,
@@ -6430,6 +6689,20 @@ class Engine:
             # path produced this admission's leading KV rows —
             # "local"/"fetched" entry hit, or "recomputed" prefill.
             prefix_sources: dict[int, str] = {}
+            # Fully-prefilled joiners first (their final segment is
+            # the group dispatch below — the real first-token sample).
+            # Their trace keeps the ORIGINAL wave's t_admitted /
+            # t_prefill, so the engine.prefill span covers the whole
+            # interleaved window and the phase partition still
+            # reconciles against e2e (the PR 9 test).
+            for pend in joins:
+                pend.trace.prefill_segments += 1
+                self.prefill_segments += 1
+                rows.append((
+                    pend.slot, pend.rid, pend.req, pend.t_submit,
+                    pend.start, pend.tail,
+                    self._bucket(len(pend.tail)), pend.trace, pend.plan,
+                ))
             for slot, rid, req, t_submit, plan in admissions:
                 if plan is not None:
                     # Paged: the prefix was aliased (copy-free) at plan
@@ -6464,6 +6737,11 @@ class Engine:
                 # the same argument as prefix-cache injection: a KV row
                 # depends only on the tokens before it, and each
                 # segment attends its predecessors through ``starts``.
+                trace = _PhaseTrace(
+                    t_submit=t_submit, t_admitted=t_admitted,
+                    t_prefill=t_pf,
+                    prefix_source=prefix_sources.get(rid, "recomputed"),
+                )
                 if self.prefill_chunk and len(tail) > self.prefill_chunk:
                     segs = []
                     while len(tail) > self.prefill_chunk:
@@ -6483,11 +6761,40 @@ class Engine:
                     ):
                         tail = segs.pop() + tail
                         fstart -= self.prefill_chunk
-                    for seg in segs:
+                    if segs:
+                        # Interleaved long-prompt admission (ISSUE 20):
+                        # dispatch only the FIRST segment now.  The
+                        # rest advance one per admission wave — decode
+                        # chunks for active slots run between them —
+                        # and the request joins a later wave's group
+                        # dispatch for its first token.  Exact by the
+                        # same argument as same-wave chunking: each
+                        # segment's KV depends only on the tokens
+                        # before it, decode writes touching this
+                        # slot's frontier are overwritten by the next
+                        # segment before any read, and the first-token
+                        # sample happens once, keyed by the request's
+                        # absolute emission index.
+                        seg = segs.pop(0)
+                        t0 = time.monotonic()
                         self._prefill_segment(slot, req, seg, start, plan)
-                        start += len(seg)
+                        trace.segment_walls.append(
+                            time.monotonic() - t0
+                        )
+                        trace.prefill_segments = 1
+                        self.prefill_segments += 1
+                        with self._lock:
+                            self._prefilling[rid] = _PendingPrefill(
+                                rid=rid, req=req, slot=slot, plan=plan,
+                                segs=segs, tail=tail,
+                                start=start + len(seg),
+                                t_submit=t_submit, trace=trace,
+                            )
+                        continue
+                trace.prefill_segments = 1
+                self.prefill_segments += 1
                 rows.append((slot, rid, req, t_submit, start, tail,
-                             self._bucket(len(tail)), t_pf, plan))
+                             self._bucket(len(tail)), trace, plan))
             zero_key = self._zero_key  # hoisted: one PRNGKey per engine
             max_len = self.max_len
             groups = []  # (group rows, first_tokens, first_logprobs)
@@ -6638,7 +6945,7 @@ class Engine:
             with self._lock:
                 for (group, _, _), (f_host, lp_host) in zip(groups, fetched):
                     for i, (
-                        slot, rid, req, t_submit, _, _, _, t_pf, _
+                        slot, rid, req, t_submit, _, _, _, trace, _
                     ) in enumerate(group):
                         if rid not in self._admitting:
                             # abort() (watchdog stall verdict on a live
@@ -6652,19 +6959,19 @@ class Engine:
                             continue
                         token, lp = int(f_host[i]), float(lp_host[i])
                         self.tokens_generated += 1
+                        # Row 7 is the _PhaseTrace built at admission
+                        # prep (or carried through an interleaved
+                        # pending) — stamp first-token arrival and
+                        # adopt it as the slot's phase record, keeping
+                        # the prefill span covering the WHOLE
+                        # interleaved window (PR 9's partition still
+                        # reconciles: queue/prefill/decode sum to e2e).
+                        trace.t_first = t_first
                         state = _SlotState(
                             rid=rid, req=req,
                             base=jax.random.PRNGKey(req.seed),
                             t_submit=t_submit,
-                            phases=_PhaseTrace(
-                                t_submit=t_submit,
-                                t_admitted=t_admitted,
-                                t_prefill=t_pf,
-                                t_first=t_first,
-                                prefix_source=prefix_sources.get(
-                                    rid, "recomputed"
-                                ),
-                            ),
+                            phases=trace,
                         )
                         if rid in self._cancelled:
                             # cancel() landed while this admission was

@@ -205,6 +205,11 @@ class TestLoadSchema:
             # kv4 quant rung flags ride the same tolerant schema.
             "paged_kernel": True,
             "kv_int4": False,
+            # Chunked flash-prefill (ISSUE 20): staging-kernel admission
+            # flag + segment length + cumulative segment dispatches.
+            "prefill_kernel": True,
+            "prefill_chunk": 16,
+            "prefill_segments": 42,
             # Disaggregation fields (ISSUE 12): pool role + this
             # backend's share of the fleet's KV-ship traffic.
             "pool": "prefill",

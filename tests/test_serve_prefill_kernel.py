@@ -1,0 +1,459 @@
+"""Chunked paged flash-prefill (ISSUE 20): exact, compile-free,
+leak-free.
+
+The load-bearing properties:
+
+- **Kernel prefill == gather prefill, token for token.**  The Pallas
+  flash-prefill kernel (``ops/paged_attention.py``, interpret mode on
+  this CPU backend) computes a prompt segment's causal attention
+  reading prior K/V straight from the block pool and writes the
+  segment's new K/V straight into the slot's blocks with fused quant —
+  no dense KV intermediate.  The gather/scatter path stays the
+  exactness oracle, and the matrix below pins kernel == gather across
+  {greedy, temp>0, spec-decode, prefix-CoW hit, mid-admission park} ×
+  {fp, kv_int8, kv_int4} × pipeline depth {1, 2}.  Every engine here
+  also runs ``prefill_chunk``, so long prompts take the INTERLEAVED
+  admission path (first segment at the admission wave, one further
+  segment per wave, the request joining a later wave's group dispatch
+  for its first token) — the exactness bar covers the scheduling
+  restructure, not just the kernel.
+- **Zero steady-state compiles across segment counts.**  ``warmup()``'s
+  per-bucket dummies already walk the segment path (a bucket-16 dummy
+  at prefill_chunk 8 IS a two-dispatch interleaved admission), so a
+  warm kernel engine admits 1/2/3/4-segment prompts without a single
+  XLA compile — pinned by count via test_jit_guard's listener.
+- **Abort/cancel mid-segment frees blocks, both tiers.**  A pending
+  prefill owns a slot and its plan's blocks before any first token
+  exists; the reap in ``_advance_prefills`` and the abort sweep must
+  return both (and any park the admission forced must unwind), or the
+  pool leaks one long prompt at a time.
+
+Engines are shared per config (the test-serve compile-budget
+discipline); this file backs ``make test-serve-prefill-kernel``
+(120 s cap).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from test_jit_guard import compile_delta
+
+from oim_tpu.models import TransformerConfig, init_params
+from oim_tpu.serve import Engine, GenRequest
+from oim_tpu.serve.engine import RequestFailedError
+
+pytestmark = pytest.mark.prefill_kernel
+
+CFG = dict(
+    vocab_size=101,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    dtype="float32",
+    use_pallas=False,
+)
+
+# kv_blocks=10 with ~5-block worst cases is deliberate pressure
+# geometry: exactness runs drain through admission backpressure, and
+# the park scenario's two 6-block requests cannot coexist — the second
+# admission must park the first (ISSUE 15 semantics) mid-chunked-
+# prefill.  prefill_chunk == the smallest prompt bucket, so segment
+# dispatches ride the already-compiled bucket-8 admit program.
+BASE = dict(
+    n_slots=3, max_len=64, chunk=4, prompt_buckets=(8, 16, 32),
+    kv_block=8, kv_blocks=10, prefill_chunk=8, prefix_cache_size=2,
+    kv_host_bytes=1 << 20,
+)
+
+QUANTS = [{}, {"kv_int8": True}, {"kv_int4": True}]
+QUANT_IDS = ["fp", "kv8", "kv4"]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+_ENGINES: dict = {}
+
+
+def _pair(setup, **kw):
+    """(gather oracle, kernel) engine pair for a config — cached and
+    warmed once, shared by every scenario (pipeline depth is a runtime
+    A/B on the warm engines)."""
+    cfg, params = setup
+    key = tuple(sorted(kw.items()))
+    if key not in _ENGINES:
+        args = dict(BASE)
+        args.update(kw)
+        _ENGINES[key] = (
+            Engine(params, cfg, prefill_kernel=False, **args).warmup(),
+            Engine(params, cfg, prefill_kernel=True, **args).warmup(),
+        )
+    return _ENGINES[key]
+
+
+def _prompt(seed: int, n: int) -> list[int]:
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, CFG["vocab_size"], size=n).tolist()
+
+
+def _echo_prompt(n: int) -> list[int]:
+    pattern = [7, 21, 40, 3]
+    return [t % CFG["vocab_size"] for t in (pattern * ((n // 4) + 1))[:n]]
+
+
+def _flush_tiers(e: Engine) -> None:
+    e._warming = True
+    try:
+        with e._lock:
+            e._clear_prefix_cache_locked()
+            e._flush_host_tier_locked()
+    finally:
+        e._warming = False
+
+
+def _no_leaks(e: Engine) -> None:
+    """Device blocks = resident prefix entries' refs only; host blocks
+    = demoted entries + parked slots only; nothing mid-prefill."""
+    s = e.stats()
+    assert s["active_slots"] == 0 and s["queued"] == 0
+    assert s["parked_slots"] == 0 and s["prefilling"] == 0
+    with e._lock:
+        entry_blocks = set()
+        for blocks, _ in e._prefix_cache.values():
+            entry_blocks.update(blocks)
+        assert e._alloc.used_blocks == len(entry_blocks), (
+            e._alloc.used_blocks, entry_blocks,
+        )
+        host_blocks = set()
+        for blocks, _ in e._host_prefix.values():
+            host_blocks.update(blocks)
+        assert e._host.alloc.used_blocks == len(host_blocks), (
+            e._host.alloc.used_blocks, host_blocks,
+        )
+
+
+def _interleave_workload(e: Engine, depth: int, sampled: bool) -> tuple:
+    """The matrix traffic: a 30-token prompt (4 interleaved segment
+    dispatches at chunk 8), a short neighbor decoding beside it, and a
+    late 22-token admission landing mid-stream.  Returns (ordered
+    results, segment dispatches it cost)."""
+    e.set_pipeline_depth(depth)
+    _flush_tiers(e)
+    segs0 = e.stats()["prefill_segments"]
+    gkw = dict(temperature=0.8) if sampled else {}
+    r1 = e.submit(GenRequest(
+        tokens=_prompt(1, 30), max_new_tokens=6, seed=5, **gkw,
+    ))
+    r2 = e.submit(GenRequest(
+        tokens=_prompt(2, 5), max_new_tokens=8, seed=7, **gkw,
+    ))
+    e.step()
+    e.step()
+    r3 = e.submit(GenRequest(
+        tokens=_prompt(3, 22), max_new_tokens=5, seed=9, **gkw,
+    ))
+    results = e.run()
+    return (
+        [results[r] for r in (r1, r2, r3)],
+        e.stats()["prefill_segments"] - segs0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# The exactness matrix: kernel == gather, token for token
+
+
+@pytest.mark.parametrize("quant", QUANTS, ids=QUANT_IDS)
+@pytest.mark.parametrize("depth", [1, 2])
+def test_exactness_greedy(setup, quant, depth):
+    gather, kernel = _pair(setup, **quant)
+    ref, ref_segs = _interleave_workload(gather, depth, sampled=False)
+    out, out_segs = _interleave_workload(kernel, depth, sampled=False)
+    assert out == ref
+    # Both engines actually interleaved (4 long-prompt + 3 late + 1
+    # short dispatches) — a one-shot fallback would pass vacuously.
+    assert ref_segs >= 6 and out_segs == ref_segs
+    _no_leaks(gather)
+    _no_leaks(kernel)
+
+
+@pytest.mark.parametrize("quant", QUANTS, ids=QUANT_IDS)
+@pytest.mark.parametrize("depth", [1, 2])
+def test_exactness_sampled(setup, quant, depth):
+    gather, kernel = _pair(setup, **quant)
+    ref, _ = _interleave_workload(gather, depth, sampled=True)
+    out, _ = _interleave_workload(kernel, depth, sampled=True)
+    assert out == ref
+    _no_leaks(kernel)
+
+
+@pytest.mark.parametrize("quant", QUANTS, ids=QUANT_IDS)
+@pytest.mark.parametrize("depth", [1, 2])
+def test_exactness_spec_decode(setup, quant, depth):
+    """Prompt-lookup speculation over echo-heavy prompts: the draft
+    windows ride the SAME interleaved-prefill KV the kernel wrote."""
+    gather, kernel = _pair(setup, spec_decode=2, **quant)
+    outs = []
+    for e in (gather, kernel):
+        e.set_pipeline_depth(depth)
+        _flush_tiers(e)
+        r1 = e.submit(GenRequest(
+            tokens=_echo_prompt(28), max_new_tokens=8,
+        ))
+        r2 = e.submit(GenRequest(
+            tokens=_echo_prompt(9), max_new_tokens=6,
+        ))
+        results = e.run()
+        outs.append([results[r] for r in (r1, r2)])
+    assert outs[0] == outs[1]
+    _no_leaks(kernel)
+
+
+@pytest.mark.parametrize("quant", QUANTS, ids=QUANT_IDS)
+@pytest.mark.parametrize("depth", [1, 2])
+def test_exactness_prefix_cow_hit(setup, quant, depth):
+    """A chunked-tail admission on top of a prefix-cache hit whose
+    entry is NOT block-aligned: the CoW duplicate lands first, then
+    the kernel's segments write from the CoW'd frontier."""
+    gather, kernel = _pair(setup, **quant)
+    system = _prompt(11, 12)  # 12 tokens, kv_block 8 → partial block
+    hit = system + _prompt(12, 20)  # 32-token hit, chunked tail
+    outs = []
+    for e in (gather, kernel):
+        e.set_pipeline_depth(depth)
+        _flush_tiers(e)
+        seed_rid = e.submit(GenRequest(
+            tokens=system, max_new_tokens=2, cache_prefix=True,
+        ))
+        e.run()
+        e.result(seed_rid, timeout=0)
+        h0 = e.stats()["prefix_hits"]
+        rid = e.submit(GenRequest(tokens=hit, max_new_tokens=6))
+        e.run()
+        assert e.stats()["prefix_hits"] > h0, "prefix did not hit"
+        outs.append(e.result(rid, timeout=0))
+    assert outs[0] == outs[1]
+    _no_leaks(kernel)
+
+
+@pytest.mark.parametrize("quant", QUANTS, ids=QUANT_IDS)
+@pytest.mark.parametrize("depth", [1, 2])
+def test_exactness_mid_admission_park(setup, quant, depth):
+    """The second admission's worst case cannot coexist with the
+    first in the 10-block pool: admitting the chunked long prompt
+    parks the decoding neighbor (ISSUE 15 swap semantics), restores
+    it after — token-identical on both prefill paths."""
+    gather, kernel = _pair(setup, **quant)
+    pA, pB = _prompt(21, 16), _prompt(22, 24)
+    outs = []
+    for e in (gather, kernel):
+        e.set_pipeline_depth(depth)
+        _flush_tiers(e)
+        parks0 = e.stats()["kv_parks"]
+        ra = e.submit(GenRequest(tokens=pA, max_new_tokens=30, seed=3))
+        rb = e.submit(GenRequest(tokens=pB, max_new_tokens=24, seed=4))
+        e.run()
+        s = e.stats()
+        assert s["kv_parks"] > parks0, "admission did not park"
+        assert s["kv_unparks"] == s["kv_parks"]
+        outs.append([e.result(r, timeout=0) for r in (ra, rb)])
+    assert outs[0] == outs[1]
+    _no_leaks(gather)
+    _no_leaks(kernel)
+
+
+def test_solo_oracle_agreement(setup):
+    """The matrix compares engine against engine; this row pins the
+    pair against the SOLO fp oracle (same prompt, idle engine, no
+    chunking pressure) so 'identical' can never mean 'identically
+    wrong' for the whole family."""
+    from oim_tpu.models.decode import generate
+
+    cfg, params = setup
+    prompt = _prompt(1, 30)
+    out = generate(
+        params, jax.numpy.asarray(prompt, jax.numpy.int32)[None],
+        cfg, max_new_tokens=6,
+    )
+    oracle = np.asarray(out)[0, len(prompt):].tolist()
+    gather, kernel = _pair(setup)
+    for e in (gather, kernel):
+        _flush_tiers(e)
+        rid = e.submit(GenRequest(tokens=prompt, max_new_tokens=6))
+        e.run()
+        assert e.result(rid, timeout=0) == oracle
+
+
+# ---------------------------------------------------------------------------
+# Zero steady-state compiles across segment counts
+
+
+def test_warm_interleaved_admission_zero_compiles(setup):
+    """warmup()'s bucket dummies already walked the segment path, so
+    a warm kernel engine admits 1/2/3/4-segment prompts — interleaved
+    against a decoding neighbor — without one XLA compile."""
+    _, kernel = _pair(setup)
+    kernel.set_pipeline_depth(2)
+    _flush_tiers(kernel)
+    with compile_delta() as d:
+        neighbor = kernel.submit(GenRequest(
+            tokens=_prompt(31, 5), max_new_tokens=24,
+        ))
+        kernel.step()
+        for i, n in enumerate((8, 14, 22, 30)):  # 1, 2, 3, 4 segments
+            rid = kernel.submit(GenRequest(
+                tokens=_prompt(40 + i, n), max_new_tokens=4,
+            ))
+            kernel.run()
+            assert len(kernel.result(rid, timeout=0)) == 4
+        assert len(kernel.result(neighbor, timeout=0)) == 24
+    assert d.count == 0, (
+        f"warm interleaved admission recompiled {d.count}x — a live "
+        f"TPU pays 20-40s of dead air per event"
+    )
+    _no_leaks(kernel)
+
+
+# ---------------------------------------------------------------------------
+# Chaos: abort/cancel mid-segment frees blocks, both tiers
+
+
+def test_cancel_mid_segment_frees_blocks(setup):
+    """cancel() against a rid whose prompt is mid-interleave: the next
+    wave's reap frees the slot and its blocks; the stream ends; the
+    neighbor is untouched."""
+    _, kernel = _pair(setup)
+    kernel.set_pipeline_depth(2)
+    _flush_tiers(kernel)
+    neighbor = kernel.submit(GenRequest(
+        tokens=_prompt(51, 5), max_new_tokens=12,
+    ))
+    long_rid = kernel.submit(GenRequest(
+        tokens=_prompt(52, 30), max_new_tokens=6,
+    ))
+    kernel.step()  # first segment dispatched, pending registered
+    assert kernel.stats()["prefilling"] == 1
+    assert kernel.cancel(long_rid)
+    kernel.run()
+    with pytest.raises(RequestFailedError, match="chunked prefill"):
+        kernel.result(long_rid, timeout=0)
+    assert len(kernel.result(neighbor, timeout=0)) == 12
+    _no_leaks(kernel)
+
+
+def test_abort_mid_segment_frees_blocks(setup):
+    """The watchdog sweep lands while a long prompt is mid-interleave
+    (and the pool pressure may have parked a neighbor): every pending
+    fails, the slot and blocks return on BOTH tiers, and the engine
+    serves again immediately."""
+    _, kernel = _pair(setup)
+    kernel.set_pipeline_depth(2)
+    _flush_tiers(kernel)
+    ra = kernel.submit(GenRequest(tokens=_prompt(61, 16),
+                                  max_new_tokens=30))
+    rb = kernel.submit(GenRequest(tokens=_prompt(62, 24),
+                                  max_new_tokens=24))
+    kernel.step()
+    kernel.step()
+    assert kernel.stats()["active_slots"] + kernel.stats()["prefilling"] > 0
+    kernel.abort("chaos: injected mid-prefill abort")
+    assert kernel.stats()["prefilling"] == 0
+    for rid in (ra, rb):
+        with pytest.raises(RequestFailedError):
+            kernel.result(rid, timeout=0)
+    _no_leaks(kernel)
+    # The freed blocks are immediately reusable — and the reuse is
+    # exact (a stale write landing in a reallocated block would show
+    # here as a token divergence against the quiet-engine result).
+    rid = kernel.submit(GenRequest(tokens=_prompt(63, 30),
+                                   max_new_tokens=6))
+    kernel.run()
+    first = kernel.result(rid, timeout=0)
+    rid2 = kernel.submit(GenRequest(tokens=_prompt(63, 30),
+                                    max_new_tokens=6))
+    kernel.run()
+    assert kernel.result(rid2, timeout=0) == first
+    _no_leaks(kernel)
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: construction rules, info/stats/load, phase partition
+
+
+def test_prefill_kernel_needs_paged_cache(setup):
+    cfg, params = setup
+    with pytest.raises(ValueError, match="paged"):
+        Engine(params, cfg, n_slots=1, max_len=64,
+               prefill_kernel=True)
+
+
+def test_prefill_kernel_needs_supported_block_size(setup):
+    cfg, params = setup
+    with pytest.raises(ValueError, match="prefill"):
+        Engine(params, cfg, n_slots=1, max_len=960, kv_block=192,
+               prefill_kernel=True)
+
+
+def test_surfaces_report_prefill_state(setup):
+    gather, kernel = _pair(setup)
+    assert kernel.info()["engine"]["prefill_kernel"] is True
+    assert gather.info()["engine"]["prefill_kernel"] is False
+    s = kernel.stats()
+    assert s["prefill_kernel"] is True
+    assert s["prefill_chunk"] == BASE["prefill_chunk"]
+    assert s["prefill_segments"] > 0  # the matrix ran through here
+    assert s["prefilling"] == 0
+    ld = kernel.load()
+    assert ld["prefill_kernel"] is True
+    assert ld["prefill_chunk"] == BASE["prefill_chunk"]
+    assert ld["prefill_segments"] == s["prefill_segments"]
+    # Tolerant decode round-trip (the PR 19 schema-drift bar): the new
+    # fields survive encode/decode, and old-schema payloads default.
+    from oim_tpu.autoscale import decode_load, encode_load
+
+    dec = decode_load(encode_load(ld))
+    assert dec["prefill_segments"] == ld["prefill_segments"]
+    old = dict(ld)
+    for k in ("prefill_kernel", "prefill_chunk", "prefill_segments"):
+        old.pop(k)
+    dec_old = decode_load(encode_load(old))
+    assert dec_old["prefill_kernel"] is False
+    assert dec_old["prefill_segments"] == 0
+
+
+def test_ring_attributes_segments_and_partition(setup):
+    """The completed-request ring carries the segment count and walls,
+    and the phase partition still reconciles: queue + admit + prefill
+    + decode + stream == e2e (the PR 9 contract) with prefill covering
+    the WHOLE interleaved window."""
+    _, kernel = _pair(setup)
+    kernel.set_pipeline_depth(2)
+    _flush_tiers(kernel)
+    rid = kernel.submit(GenRequest(tokens=_prompt(71, 30),
+                                   max_new_tokens=6))
+    kernel.run()
+    kernel.result(rid, timeout=0)
+    entry = next(
+        e for e in reversed(kernel.requests()["requests"])
+        if e["rid"] == rid
+    )
+    assert entry["prefill_segments"] == 4  # 3 chunked + the final
+    assert len(entry["segment_walls"]) == 3  # non-final dispatch walls
+    assert all(w >= 0.0 for w in entry["segment_walls"])
+    parts = (
+        entry["queue_s"] + entry["admit_s"] + entry["prefill_s"]
+        + entry["decode_s"] + entry["stream_s"]
+    )
+    # The PR 9 partition contract: phases tile [submit, finalize] up
+    # to inter-chunk gaps — the interleaved window must not break it.
+    assert parts <= entry["e2e_s"] + 1e-3
+    assert parts >= 0.5 * entry["e2e_s"], (parts, entry)
+    # The interleaved window is inside the prefill span: the summed
+    # segment walls can never exceed it.
+    assert sum(entry["segment_walls"]) <= entry["prefill_s"] + 1e-6
